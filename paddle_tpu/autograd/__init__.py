@@ -1,0 +1,112 @@
+"""Autograd façade (``paddle.autograd`` / ``paddle.grad`` parity).
+
+The reference implements a C++ eager tape (paddle/fluid/eager/backward.cc,
+``egr::Backward``); on TPU the whole training step is traced and
+differentiated by ``jax.grad``, which removes the per-op dispatch boundary
+entirely (SURVEY.md §3.1).  This module provides:
+
+- ``grad`` / ``value_and_grad`` over a Layer's parameters via the
+  functional bridge;
+- ``PyLayer`` parity via ``jax.custom_vjp``;
+- ``no_grad`` (trivially a no-op marker since grads are explicit).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, Dict, Optional
+
+import jax
+
+from ..nn.layer import Layer, functional_call, raw_params, trainable_mask
+
+
+def value_and_grad(layer: Layer, loss_fn: Callable, has_aux: bool = False):
+    """Build ``fn(params, *args, rngs=None) -> ((loss, aux?), grads)``.
+
+    ``loss_fn(outputs, *args) -> scalar`` consumes the layer outputs, or pass
+    ``loss_fn=None`` and make the layer itself return the scalar loss.
+    Non-trainable parameters receive zero gradients (masked like the
+    reference's ``stop_gradient``).
+    """
+    mask = trainable_mask(layer)
+
+    def pure_loss(train_params, frozen_params, args, kwargs, rngs):
+        params = {**frozen_params, **train_params}
+        out = functional_call(layer, params, *args, rngs=rngs, training=True,
+                              **kwargs)
+        return out if loss_fn is None else loss_fn(out, *args)
+
+    vag = jax.value_and_grad(pure_loss, has_aux=has_aux)
+
+    def fn(params: Dict[str, jax.Array], *args, rngs=None, **kwargs):
+        train = {k: v for k, v in params.items() if mask.get(k, True)}
+        frozen = {k: v for k, v in params.items() if not mask.get(k, True)}
+        val, grads = vag(train, frozen, args, kwargs, rngs)
+        return val, grads
+
+    return fn
+
+
+def grad(layer: Layer, loss_fn: Callable = None, has_aux: bool = False):
+    vag = value_and_grad(layer, loss_fn, has_aux=has_aux)
+
+    def fn(params, *args, **kwargs):
+        _, g = vag(params, *args, **kwargs)
+        return g
+
+    return fn
+
+
+@contextlib.contextmanager
+def no_grad():
+    """API parity: jax only differentiates what you ask it to, so this is a
+    documentation-level marker (kept so reference code ports cleanly)."""
+    yield
+
+
+class PyLayer:
+    """``paddle.autograd.PyLayer`` parity on ``jax.custom_vjp``.
+
+    Subclass with static ``forward(ctx, *args)`` and ``backward(ctx, *grads)``;
+    call via ``MyLayer.apply(*args)``.  ``ctx.save_for_backward(*ts)`` stores
+    residuals.
+    """
+
+    class _Ctx:
+        def __init__(self):
+            self.saved = ()
+
+        def save_for_backward(self, *tensors):
+            self.saved = tensors
+
+        def saved_tensor(self):
+            return self.saved
+
+    @classmethod
+    def apply(cls, *args):
+        @jax.custom_vjp
+        def f(*xs):
+            ctx = cls._Ctx()
+            return cls.forward(ctx, *xs)
+
+        def fwd(*xs):
+            ctx = cls._Ctx()
+            out = cls.forward(ctx, *xs)
+            return out, ctx.saved
+
+        def bwd(saved, g):
+            ctx = cls._Ctx()
+            ctx.saved = saved
+            grads = cls.backward(ctx, g)
+            return grads if isinstance(grads, tuple) else (grads,)
+
+        f.defvjp(fwd, bwd)
+        return f(*args)
+
+
+def backward(tensors, grad_tensors=None):  # pragma: no cover - guidance only
+    raise RuntimeError(
+        "paddle_tpu has no eager tape: use paddle_tpu.autograd.value_and_grad "
+        "or the Trainer/jit.train_step compiled path (see docs/MIGRATION.md). "
+        "Reference parity: egr::Backward is replaced by jax.grad tracing.")

@@ -1,0 +1,206 @@
+"""Core runtime: dtypes, device placement, global flags.
+
+TPU-native replacement for the reference's device/runtime layer
+(paddle/phi/backends/*, paddle/fluid/platform/*).  There is no allocator,
+stream, or per-device kernel registry to manage — XLA owns device memory and
+scheduling — so this layer reduces to dtype policy, device query/placement,
+and the flag system (reference: paddle/common/flags.h, ``paddle.set_flags``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import random  # noqa: F401
+
+Tensor = jax.Array
+
+# ---------------------------------------------------------------------------
+# dtypes (paddle dtype name parity)
+# ---------------------------------------------------------------------------
+
+_DTYPE_ALIASES: Dict[str, Any] = {
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "int8": jnp.int8,
+    "uint8": jnp.uint8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "bool": jnp.bool_,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+    "float8_e4m3fn": jnp.float8_e4m3fn,
+    "float8_e5m2": jnp.float8_e5m2,
+}
+
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+uint8 = jnp.uint8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+bool_ = jnp.bool_
+
+_default_dtype = [jnp.float32]
+
+
+def set_default_dtype(d) -> None:
+    _default_dtype[0] = convert_dtype(d)
+
+
+def get_default_dtype():
+    return _default_dtype[0]
+
+
+def convert_dtype(d):
+    """Accept paddle-style strings, numpy dtypes, or jnp dtypes."""
+    if d is None:
+        return _default_dtype[0]
+    if isinstance(d, str):
+        if d not in _DTYPE_ALIASES:
+            raise ValueError(f"unknown dtype {d!r}")
+        return _DTYPE_ALIASES[d]
+    return jnp.dtype(d).type if isinstance(d, np.dtype) else d
+
+
+def dtype_name(d) -> str:
+    return jnp.dtype(d).name
+
+
+def is_floating_point(x) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def finfo(d):
+    return jnp.finfo(convert_dtype(d))
+
+
+def iinfo(d):
+    return jnp.iinfo(convert_dtype(d))
+
+
+# ---------------------------------------------------------------------------
+# device API (paddle.device parity)
+# ---------------------------------------------------------------------------
+
+_current_device: list = [None]
+
+
+def _platform_of(spec: str) -> str:
+    return {"tpu": "tpu", "gpu": "gpu", "cpu": "cpu", "xla": "tpu"}.get(spec, spec)
+
+
+def set_device(device: str):
+    """``paddle.device.set_device`` parity: "tpu", "tpu:0", "cpu"."""
+    name, _, idx = device.partition(":")
+    devs = jax.devices(_platform_of(name)) if name != "auto" else jax.devices()
+    dev = devs[int(idx)] if idx else devs[0]
+    _current_device[0] = dev
+    jax.config.update("jax_default_device", dev)
+    return dev
+
+
+def get_device() -> str:
+    dev = _current_device[0]
+    if dev is None:
+        dev = jax.devices()[0]
+    return f"{dev.platform}:{dev.id}"
+
+
+def get_all_devices():
+    return jax.devices()
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def is_compiled_with_cuda() -> bool:  # API parity; always False on this stack
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(d.platform == "tpu" for d in jax.devices())
+
+
+def synchronize() -> None:
+    """Block until all enqueued device work completes (stream-sync parity)."""
+    (jnp.zeros(()) + 0).block_until_ready()
+
+
+# ---------------------------------------------------------------------------
+# flags (paddle.set_flags / FLAGS_* parity; env prefix PDTPU_FLAGS_)
+# ---------------------------------------------------------------------------
+
+_FLAG_DEFAULTS: Dict[str, Any] = {
+    "check_nan_inf": False,          # FLAGS_check_nan_inf parity -> jax_debug_nans
+    "matmul_precision": "default",   # maps to jax default_matmul_precision
+    "deterministic": False,          # FLAGS_cudnn_deterministic analogue
+    "use_pallas_kernels": True,      # prefer pallas kernels where available
+    "remat_policy": "none",          # default rematerialisation policy name
+    "log_compiles": False,
+}
+_flags: Dict[str, Any] = {}
+
+
+def _flag_from_env(name: str, default):
+    raw = os.environ.get(f"PDTPU_FLAGS_{name}")
+    if raw is None:
+        return default
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    return type(default)(raw) if default is not None else raw
+
+
+for _k, _v in _FLAG_DEFAULTS.items():
+    _flags[_k] = _flag_from_env(_k, _v)
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    for k, v in flags.items():
+        key = k[6:] if k.startswith("FLAGS_") else k
+        if key not in _FLAG_DEFAULTS:
+            raise KeyError(f"unknown flag {k!r}; known: {sorted(_FLAG_DEFAULTS)}")
+        _flags[key] = v
+        if key == "check_nan_inf":
+            jax.config.update("jax_debug_nans", bool(v))
+        elif key == "log_compiles":
+            jax.config.update("jax_log_compiles", bool(v))
+        elif key == "matmul_precision" and v != "default":
+            jax.config.update("jax_default_matmul_precision", v)
+
+
+def get_flags(keys=None) -> Dict[str, Any]:
+    if keys is None:
+        return dict(_flags)
+    if isinstance(keys, str):
+        keys = [keys]
+    return {k: _flags[k[6:] if k.startswith("FLAGS_") else k] for k in keys}
+
+
+def seed(s: int):
+    random.seed(s)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """``paddle.to_tensor`` parity (place/stop_gradient accepted for API compat)."""
+    del stop_gradient
+    arr = jnp.asarray(data, dtype=convert_dtype(dtype) if dtype is not None else None)
+    if place is not None:
+        arr = jax.device_put(arr, place)
+    return arr

@@ -1,0 +1,162 @@
+"""RNG state management.
+
+Paddle keeps mutable global/per-axis RNG state (``paddle.seed``,
+``fleet.meta_parallel.get_rng_state_tracker`` in
+``python/paddle/distributed/fleet/layers/mpu/random.py``).  JAX RNG is
+functional, so we bridge the two worlds:
+
+- Eager: a process-global seed state that is folded per draw (convenience
+  only; not reproducible across jit boundaries).
+- Compiled: ``paddle_tpu.nn.functional_call`` installs an ``RngContext``
+  carrying an explicit ``jax.random.key``; every ``next_key()`` call inside
+  the traced forward derives a fresh key deterministically by fold-in
+  counter, so a compiled step is a pure function of (params, batch, key).
+
+Tracker names ("global_seed" / "local_seed") mirror the reference's
+model-parallel RNG tracker: "local" streams additionally fold in the ``mp``
+axis index when running under a mesh axis, so dropout masks differ across
+tensor-parallel ranks while "global" streams agree (the invariant the
+reference maintains for parallel == serial numerics).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_state = threading.local()
+
+
+def _ctx_stack():
+    if not hasattr(_state, "stack"):
+        _state.stack = []
+    return _state.stack
+
+
+class RngContext:
+    """Explicit RNG scope used during traced/compiled forwards."""
+
+    def __init__(self, key: jax.Array):
+        self.key = key
+        self.counter = 0
+
+    def next_key(self, tag: int = 0) -> jax.Array:
+        self.counter += 1
+        return jax.random.fold_in(jax.random.fold_in(self.key, self.counter), tag)
+
+
+@contextlib.contextmanager
+def rng_scope(key: Optional[jax.Array]):
+    if key is None:
+        yield
+        return
+    _ctx_stack().append(RngContext(key))
+    try:
+        yield
+    finally:
+        _ctx_stack().pop()
+
+
+_GLOBAL_SEED = [0]
+_EAGER_COUNTER = [0]
+
+
+def seed(s: int) -> None:
+    """``paddle.seed`` parity: reset the process-global RNG stream."""
+    _GLOBAL_SEED[0] = int(s)
+    _EAGER_COUNTER[0] = 0
+
+
+def default_key() -> jax.Array:
+    return jax.random.key(_GLOBAL_SEED[0])
+
+
+def next_key(name: str = "global") -> jax.Array:
+    """Draw the next RNG key.
+
+    Inside a ``functional_call``/compiled scope this is deterministic in the
+    step key; in eager mode it advances the global stream.
+    """
+    tag = _name_tag(name)
+    stack = _ctx_stack()
+    if stack:
+        return stack[-1].next_key(tag)
+    _EAGER_COUNTER[0] += 1
+    k = jax.random.fold_in(default_key(), _EAGER_COUNTER[0])
+    return jax.random.fold_in(k, tag)
+
+
+def in_rng_scope() -> bool:
+    return bool(_ctx_stack())
+
+
+def _name_tag(name: str) -> int:
+    # Stable small hash so distinct tracker names give distinct streams.
+    return sum((i + 1) * b for i, b in enumerate(name.encode())) % (2**31 - 1)
+
+
+class RNGStatesTracker:
+    """Parity with the reference's model-parallel RNG tracker.
+
+    Reference: paddle/distributed/fleet/layers/mpu/random.py
+    (``get_rng_state_tracker``, ``rng_state(name)``).  Here a named state is
+    a deterministic sub-stream; "local_seed" streams fold in the mesh axis
+    index of the tensor-parallel axis when available, so per-rank dropout
+    differs while replicated dropout matches.
+    """
+
+    def __init__(self):
+        self._names = {"global_seed", "local_seed"}
+        self._current = None
+
+    def add(self, name: str, seed_: int = 0) -> None:  # seed_ kept for API parity
+        self._names.add(name)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "global_seed"):
+        prev = self._current
+        self._current = name
+        try:
+            yield
+        finally:
+            self._current = prev
+
+    def current(self) -> str:
+        return self._current or "global_seed"
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _TRACKER
+
+
+def dropout_key() -> jax.Array:
+    """Key for dropout honouring the active tracker state.
+
+    Under the "local_seed" state and inside a mesh-mapped region with an
+    ``mp`` axis, folds in the axis index so tensor-parallel ranks draw
+    different masks (reference: mpu/random.py local seed semantics).
+    """
+    name = _TRACKER.current()
+    key = next_key(name)
+    if name == "local_seed":
+        try:
+            idx = jax.lax.axis_index("mp")
+            key = jax.random.fold_in(key, idx)
+        except NameError:
+            pass
+    return key
+
+
+def uniform(shape, dtype=jnp.float32, min=0.0, max=1.0, name: str = "global"):
+    return jax.random.uniform(next_key(name), shape, dtype=dtype, minval=min, maxval=max)
+
+
+def normal(shape, dtype=jnp.float32, mean=0.0, std=1.0, name: str = "global"):
+    return mean + std * jax.random.normal(next_key(name), shape, dtype=dtype)

@@ -1,0 +1,287 @@
+"""Step compiler (``paddle.jit`` parity, TPU-first).
+
+The reference's whole static-graph stack — ``@to_static`` SOT capture
+(python/paddle/jit/sot), ProgramDesc/PIR, InterpreterCore scheduling, CINN
+codegen (SURVEY.md §2.3) — collapses on TPU into ``jax.jit``: one trace, XLA
+fusion/scheduling, compiled-once execution.  This module provides:
+
+- ``to_static(fn)``: jax.jit with paddle-like surface (input_spec accepted
+  and used for AOT lowering).
+- ``TrainStep``: THE canonical training path.  Wraps (model, loss_fn,
+  optimizer, scaler) into one donated, sharded, compiled step function:
+  state -> state.  All parallelism (mesh axes, param partition specs, ZeRO
+  sharding of optimizer state) is applied here.
+- ``save``/``load``: AOT export of compiled functions via StableHLO
+  (``paddle.jit.save``'s inference-graph role).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core import random as prandom
+from ..nn.layer import Layer, functional_call, raw_params, trainable_mask
+
+
+def to_static(function=None, input_spec=None, full_graph=True, backend=None,
+              donate_argnums=(), static_argnums=()):
+    """``paddle.jit.to_static`` parity → jax.jit."""
+    def deco(fn):
+        jitted = jax.jit(fn, donate_argnums=donate_argnums,
+                         static_argnums=static_argnums)
+        functools.update_wrapper(jitted, fn, updated=[])
+        return jitted
+    return deco(function) if function is not None else deco
+
+
+# ---------------------------------------------------------------------------
+# sharding helpers
+# ---------------------------------------------------------------------------
+
+def _spec_of(meta_partition, ndim) -> P:
+    if meta_partition is None:
+        return P()
+    if isinstance(meta_partition, P):
+        return meta_partition
+    return P(*meta_partition)
+
+
+def zero_shard_spec(spec: P, shape, axis_name: str, axis_size: int) -> P:
+    """ZeRO-style sharding: additionally shard over ``axis_name`` on the
+    first dim that is divisible and not already sharded.
+
+    This is how ZeRO-1/2/3 semantics (reference:
+    dygraph_sharding_optimizer.py / group_sharded_stage3.py) map to GSPMD:
+    the stage choreography (reduce-to-owner, broadcast, allgather/release)
+    becomes a sharding annotation and XLA inserts the moving parts
+    (SURVEY.md §7.2).
+    """
+    if axis_size <= 1:
+        return spec
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    for i, (dim, cur) in enumerate(zip(shape, entries)):
+        if cur is None and dim % axis_size == 0:
+            entries[i] = axis_name
+            return P(*entries)
+    return spec  # nothing divisible; leave replicated
+
+
+def _named(mesh, spec):
+    return NamedSharding(mesh, spec)
+
+
+# ---------------------------------------------------------------------------
+# TrainStep
+# ---------------------------------------------------------------------------
+
+class TrainStep:
+    """Compiled, sharded training step.
+
+    Usage::
+
+        model = Llama(cfg)
+        opt = optimizer.AdamW(learning_rate=sched, parameters=model.parameters())
+        step = TrainStep(model, loss_fn, opt, mesh=topo.mesh)
+        state = step.init_state(seed=0)
+        state, metrics = step(state, batch)
+
+    ``loss_fn(model, batch) -> scalar`` runs with parameters functionally
+    swapped in, so inside it the model is called exactly like eager paddle
+    code.  The whole step (fwd, bwd, clip, optimizer, scaler) is one XLA
+    program with the state donated (in-place buffer reuse, reference:
+    InterpreterCore inplace pass).
+    """
+
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer,
+                 scaler=None, mesh: Optional[Mesh] = None,
+                 batch_axes=("dp", "sharding"), batch_spec=None,
+                 zero_stage: int = 0, zero_axes=("dp", "sharding"),
+                 extra_metrics: Optional[Callable] = None):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.scaler = scaler
+        self.mesh = mesh
+        self.zero_stage = zero_stage
+        self.extra_metrics = extra_metrics
+        if mesh is not None:
+            present = [a for a in batch_axes if a in mesh.axis_names
+                       and mesh.shape[a] > 1]
+            self.batch_spec = batch_spec if batch_spec is not None else (
+                P(tuple(present)) if present else P())
+            self.zero_axes = [a for a in zero_axes if a in mesh.axis_names
+                              and mesh.shape[a] > 1]
+        else:
+            self.batch_spec = P()
+            self.zero_axes = []
+        self._mask = trainable_mask(model)
+        self._compiled = jax.jit(self._step, donate_argnums=(0,))
+
+    # -- sharding specs ----------------------------------------------------
+
+    def param_specs(self) -> Dict[str, P]:
+        meta = self.model.param_meta()
+        params = raw_params(self.model)
+        specs = {}
+        for name, p in params.items():
+            spec = _spec_of(meta[name].partition if name in meta else None, p.ndim)
+            if self.zero_stage >= 3:
+                for ax in self.zero_axes:
+                    spec = zero_shard_spec(spec, p.shape, ax, self.mesh.shape[ax])
+            specs[name] = spec
+        return specs
+
+    def opt_state_specs(self, opt_state, param_specs) -> Any:
+        """Optimizer slots/master weights: mirror param sharding; ZeRO>=1
+        additionally shards them over the data axes."""
+        def spec_for(path_name, leaf):
+            base = param_specs.get(path_name, P())
+            if self.zero_stage >= 1 and hasattr(leaf, "ndim") and leaf.ndim > 0:
+                for ax in self.zero_axes:
+                    base = zero_shard_spec(base, leaf.shape, ax, self.mesh.shape[ax])
+            return base
+
+        out = {}
+        for slot, val in opt_state.items():
+            if isinstance(val, dict):
+                out[slot] = {k: spec_for(k, v) if v is not None else None
+                             for k, v in val.items()}
+            else:
+                out[slot] = P()
+        return out
+
+    # -- state -------------------------------------------------------------
+
+    def init_state(self, seed: int = 0) -> Dict[str, Any]:
+        params = raw_params(self.model)
+        opt_state = self.optimizer.init(params)
+        state = {"params": params, "opt": opt_state,
+                 "step": jnp.zeros((), jnp.int32),
+                 "rng": jax.random.key(seed)}
+        if self.scaler is not None and self.scaler.enable:
+            state["scaler"] = self.scaler.init_state()
+        return self.shard_state(state)
+
+    def shard_state(self, state):
+        if self.mesh is None:
+            return state
+        pspecs = self.param_specs()
+        ospecs = self.opt_state_specs(state["opt"], pspecs)
+        with self.mesh:
+            state["params"] = {
+                k: jax.device_put(v, _named(self.mesh, pspecs[k]))
+                for k, v in state["params"].items()}
+            new_opt = {}
+            for slot, val in state["opt"].items():
+                if isinstance(val, dict):
+                    new_opt[slot] = {
+                        k: (jax.device_put(v, _named(self.mesh, ospecs[slot][k]))
+                            if v is not None else None)
+                        for k, v in val.items()}
+                else:
+                    new_opt[slot] = jax.device_put(val, _named(self.mesh, P()))
+            state["opt"] = new_opt
+            state["step"] = jax.device_put(state["step"], _named(self.mesh, P()))
+        return state
+
+    # -- the step ----------------------------------------------------------
+
+    def _loss(self, train_params, frozen, batch, key, scaler_state):
+        from ..nn.layer import _swapped_params, _train_mode
+        params = {**frozen, **train_params}
+        with jax.named_scope("forward"), _swapped_params(self.model, params), \
+                _train_mode(self.model, True), prandom.rng_scope(key):
+            loss = self.loss_fn(self.model, batch)
+        scaled = loss
+        if self.scaler is not None and self.scaler.enable:
+            scaled = self.scaler.scale_value(loss, scaler_state)
+        return scaled, loss
+
+    def _step(self, state, batch):
+        mesh = self.mesh
+        if mesh is not None:
+            batch = jax.tree.map(
+                lambda x: jax.lax.with_sharding_constraint(
+                    x, _named(mesh, self.batch_spec)) if hasattr(x, "ndim") and x.ndim > 0 else x,
+                batch)
+        params = state["params"]
+        train = {k: v for k, v in params.items() if self._mask.get(k, True)}
+        frozen = {k: v for k, v in params.items() if not self._mask.get(k, True)}
+        key = jax.random.fold_in(state["rng"], state["step"])
+        scaler_state = state.get("scaler")
+        grad_fn = jax.value_and_grad(self._loss, has_aux=True)
+        (scaled, loss), grads = grad_fn(train, frozen, batch, key, scaler_state)
+        if self.scaler is not None and self.scaler.enable:
+            grads, scaler_state = self.scaler.unscale_and_update(grads, scaler_state)
+        if mesh is not None:
+            pspecs = self.param_specs()
+            grads = {k: jax.lax.with_sharding_constraint(
+                g, _named(mesh, pspecs[k])) for k, g in grads.items()}
+        with jax.named_scope("optimizer"):
+            new_params, new_opt = self.optimizer.apply(grads, state["opt"], params)
+        if scaler_state is not None and "found_inf" in scaler_state:
+            # paddle GradScaler semantics: skip the whole optimizer step on
+            # overflow (moments/step-count must not advance either)
+            keep_old = scaler_state["found_inf"]
+            sel = lambda old, new: jax.tree.map(
+                lambda o, n: jnp.where(keep_old, o, n) if o is not None else None,
+                old, new, is_leaf=lambda x: x is None)
+            new_params = sel(params, new_params)
+            new_opt = sel(state["opt"], new_opt)
+        new_state = {"params": new_params, "opt": new_opt,
+                     "step": state["step"] + 1, "rng": state["rng"]}
+        if scaler_state is not None:
+            new_state["scaler"] = {k: scaler_state[k]
+                                   for k in ("scale", "good_steps", "bad_steps")}
+        metrics = {"loss": loss, "lr": _current_lr(self.optimizer, state)}
+        if self.extra_metrics is not None:
+            metrics.update(self.extra_metrics(new_state, batch))
+        return new_state, metrics
+
+    def __call__(self, state, batch):
+        if self.mesh is not None:
+            with self.mesh:
+                return self._compiled(state, batch)
+        return self._compiled(state, batch)
+
+    def lower(self, state, batch):
+        return self._compiled.lower(state, batch)
+
+
+def _current_lr(optimizer, state):
+    from ..optimizer import LRScheduler
+    lr = optimizer._learning_rate
+    if isinstance(lr, LRScheduler):
+        return lr.lr_at(state["step"])
+    return jnp.asarray(lr, jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# AOT export (paddle.jit.save / load parity for inference graphs)
+# ---------------------------------------------------------------------------
+
+def save(fn, path: str, *example_args):
+    """Serialize a jitted function to StableHLO bytes + npz side-car.
+
+    Reference: paddle.jit.save -> *.pdmodel/*.pdiparams.  Here the "model"
+    is a serialized StableHLO program (jax.export) that can be reloaded and
+    executed without the Python model definition.
+    """
+    from jax import export as jexport
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    exp = jexport.export(jitted)(*example_args)
+    with open(path + ".stablehlo", "wb") as f:
+        f.write(exp.serialize())
+    return path + ".stablehlo"
+
+
+def load(path: str):
+    from jax import export as jexport
+    with open(path if path.endswith(".stablehlo") else path + ".stablehlo", "rb") as f:
+        exp = jexport.deserialize(f.read())
+    return exp.call

@@ -1,0 +1,15 @@
+"""``paddle_tpu.nn`` — module system + layer zoo + functional ops."""
+
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer import (Layer, ParamAttr, ParameterList, functional_call,  # noqa: F401
+                    raw_params, trainable_mask)
+from .layers_common import (  # noqa: F401
+    AvgPool2D, BatchNorm1D, BatchNorm2D, BCEWithLogitsLoss, Conv2D,
+    CrossEntropyLoss, Dropout, Embedding, Flatten, GELU, GroupNorm,
+    Hardsigmoid, Hardswish, Identity, L1Loss, LayerDict, LayerList,
+    LayerNorm, LeakyReLU, Linear, MaxPool2D, Mish, MSELoss,
+    MultiHeadAttention, NLLLoss, ReLU, RMSNorm, Sequential, Sigmoid, Silu,
+    Softmax, Softplus, Tanh, TransformerEncoder, TransformerEncoderLayer,
+    Upsample)
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401

@@ -1,0 +1,73 @@
+"""Gradient clipping (``paddle.nn.ClipGradByGlobalNorm`` etc.).
+
+Reference: python/paddle/nn/clip.py.  Clips act on a flat grad pytree inside
+the compiled step.  ``ClipGradByGlobalNorm`` is hybrid-parallel aware the
+same way the reference's HybridParallelOptimizer makes it: when gradients
+are sharded over mesh axes, the local sum-of-squares is psum-ed over those
+axes before the norm is formed (see distributed.fleet.HybridParallelOptimizer
+which passes ``axes`` here).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+class ClipGradBase:
+    def __call__(self, grads: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, grads):
+        return jax.tree.map(lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class ClipGradByNorm(ClipGradBase):
+    """Per-tensor norm clip."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, grads):
+        def clip_one(g):
+            n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+            return (g * scale).astype(g.dtype)
+        return jax.tree.map(clip_one, grads)
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm=1.0, group_name="default_group",
+                 sum_axes: Optional[Sequence[str]] = None):
+        self.clip_norm = clip_norm
+        # mesh axes over which grads are *partitioned* (not replicated);
+        # local sq-sums must be summed over them for a correct global norm
+        self.sum_axes = tuple(sum_axes or ())
+
+    def with_axes(self, axes: Sequence[str]) -> "ClipGradByGlobalNorm":
+        return ClipGradByGlobalNorm(self.clip_norm, sum_axes=axes)
+
+    def global_norm(self, grads) -> jax.Array:
+        leaves = jax.tree.leaves(grads)
+        sq = jnp.asarray(0.0, jnp.float32)
+        for g in leaves:
+            sq = sq + jnp.sum(jnp.square(g.astype(jnp.float32)))
+        for ax in self.sum_axes:
+            try:
+                sq = jax.lax.psum(sq, ax)
+            except NameError:
+                pass  # axis not bound (serial execution of the same code)
+        return jnp.sqrt(sq)
+
+    def __call__(self, grads):
+        norm = self.global_norm(grads)
+        scale = self.clip_norm / jnp.maximum(norm, self.clip_norm)
+        return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)

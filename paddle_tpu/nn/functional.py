@@ -1,0 +1,453 @@
+"""Functional ops (``paddle.nn.functional`` parity).
+
+Reference: python/paddle/nn/functional/*.py.  Everything here is a pure jnp
+function; the hot ops (attention, rms_norm, rope) dispatch to Pallas TPU
+kernels when available (paddle_tpu.ops.pallas), mirroring how the reference
+routes to fused CUDA kernels (paddle/phi/kernels/fusion/gpu/), with an XLA
+fallback that is always numerically authoritative.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import convert_dtype
+from ..core import random as prandom
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+relu = jax.nn.relu
+relu6 = jax.nn.relu6
+sigmoid = jax.nn.sigmoid
+tanh = jnp.tanh
+silu = jax.nn.silu
+swish = jax.nn.silu
+elu = jax.nn.elu
+celu = jax.nn.celu
+selu = jax.nn.selu
+softplus = jax.nn.softplus
+log_sigmoid = jax.nn.log_sigmoid
+hardswish = jax.nn.hard_swish
+leaky_relu = jax.nn.leaky_relu
+mish = jax.nn.mish
+
+
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def softmax(x, axis=-1, dtype=None):
+    if dtype is not None:
+        x = x.astype(convert_dtype(dtype))
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * sigmoid(b)
+
+
+def swiglu(x, y=None):
+    """Reference: paddle.incubate.nn.functional.swiglu (fused in phi)."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return silu(x) * y
+
+
+# ---------------------------------------------------------------------------
+# linear / embedding / dropout
+# ---------------------------------------------------------------------------
+
+def linear(x, weight, bias=None):
+    """Weight layout is (in_features, out_features), as in the reference."""
+    y = x @ weight
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def embedding(ids, weight, padding_idx=None):
+    out = jnp.take(weight, ids, axis=0)
+    if padding_idx is not None:
+        out = jnp.where((ids == padding_idx)[..., None], 0.0, out)
+    return out
+
+
+def one_hot(x, num_classes, dtype=jnp.float32):
+    return jax.nn.one_hot(x, num_classes, dtype=dtype)
+
+
+def dropout(x, p=0.5, training=True, mode="upscale_in_train", rng_key=None):
+    if not training or p == 0.0:
+        return x
+    key = rng_key if rng_key is not None else prandom.dropout_key()
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+def layer_norm(x, normalized_shape=None, weight=None, bias=None, epsilon=1e-5):
+    axes = tuple(range(x.ndim - (len(normalized_shape) if normalized_shape else 1), x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(axis=axes, keepdims=True)
+    var = jnp.square(xf - mean).mean(axis=axes, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, use_pallas=True):
+    """Reference: phi RmsNormKernel (paddle/phi/kernels/fusion/gpu)."""
+    from ..ops import dispatch
+    impl = dispatch.get("rms_norm") if use_pallas else None
+    if impl is not None:
+        return impl(x, weight, epsilon)
+    xf = x.astype(jnp.float32)
+    var = jnp.square(xf).mean(axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5, data_format="NCHW"):
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c = x.shape[:2]
+    spatial = x.shape[2:]
+    g = x.reshape(n, num_groups, c // num_groups, *spatial).astype(jnp.float32)
+    axes = tuple(range(2, g.ndim))
+    mean = g.mean(axis=axes, keepdims=True)
+    var = jnp.square(g - mean).mean(axis=axes, keepdims=True)
+    g = (g - mean) * jax.lax.rsqrt(var + epsilon)
+    out = g.reshape(n, c, *spatial)
+    if weight is not None:
+        out = out * weight.reshape(1, c, *([1] * len(spatial)))
+    if bias is not None:
+        out = out + bias.reshape(1, c, *([1] * len(spatial)))
+    out = out.astype(x.dtype)
+    if data_format == "NHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW"):
+    caxis = 1 if data_format == "NCHW" else -1
+    shape = [1] * x.ndim
+    shape[caxis] = x.shape[caxis]
+    axes = tuple(i for i in range(x.ndim) if i != (caxis % x.ndim))
+    if training:
+        mean = x.mean(axis=axes)
+        var = x.var(axis=axes)
+    else:
+        mean, var = running_mean, running_var
+    out = (x - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# rotary embedding (reference: fused_rotary_position_embedding / FusedRopeKernel)
+# ---------------------------------------------------------------------------
+
+def rope_cos_sin(seq_len, head_dim, base=10000.0, dtype=jnp.float32, position_ids=None):
+    inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    pos = jnp.arange(seq_len, dtype=jnp.float32) if position_ids is None else position_ids.astype(jnp.float32)
+    freqs = jnp.einsum("...s,d->...sd", pos, inv_freq)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def _rotate_every_two(x):
+    # GPT-J / non-NeoX style: pairs are (even, odd) interleaved
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    return jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+
+
+def apply_rotary_pos_emb(q, k, cos, sin, interleaved=False):
+    """q/k: [batch, seq, heads, head_dim]; cos/sin: [seq, head_dim] or
+    [batch, seq, head_dim] (explicit position_ids).  ``interleaved`` selects
+    GPT-J pairing (reference: use_neox_rotary_style=False)."""
+    if cos.ndim == 2:    # (s, d) -> (1, s, 1, d)
+        cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+    elif cos.ndim == 3:  # (b, s, d) -> (b, s, 1, d)
+        cos, sin = cos[:, :, None, :], sin[:, :, None, :]
+    rot = _rotate_every_two if interleaved else _rotate_half
+    q_out = q * cos + rot(q) * sin
+    k_out = k * cos + rot(k) * sin
+    return q_out.astype(q.dtype), k_out.astype(k.dtype)
+
+
+def fused_rotary_position_embedding(q, k, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True):
+    """paddle.incubate.nn.functional.fused_rotary_position_embedding parity.
+
+    NeoX style pairs dimension i with i+d/2 (half-split); non-NeoX pairs
+    (2i, 2i+1) interleaved, with frequencies repeated per pair.
+    """
+    d = q.shape[-1]
+    if cos is None or sin is None:
+        if use_neox_rotary_style:
+            cos, sin = rope_cos_sin(q.shape[1], d, dtype=q.dtype,
+                                    position_ids=position_ids)
+        else:
+            inv_freq = 1.0 / (10000.0 ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+            pos = (jnp.arange(q.shape[1], dtype=jnp.float32) if position_ids is None
+                   else position_ids.astype(jnp.float32))
+            freqs = jnp.einsum("...s,f->...sf", pos, inv_freq)
+            emb = jnp.repeat(freqs, 2, axis=-1)  # f0,f0,f1,f1,...
+            cos, sin = jnp.cos(emb).astype(q.dtype), jnp.sin(emb).astype(q.dtype)
+    q, k = apply_rotary_pos_emb(q, k, cos, sin,
+                                interleaved=not use_neox_rotary_style)
+    return q, k, v
+
+
+# ---------------------------------------------------------------------------
+# attention (reference: flash_attn kernels + scaled_dot_product_attention)
+# ---------------------------------------------------------------------------
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 scale=None):
+    """paddle.nn.functional.scaled_dot_product_attention parity.
+
+    Layout [batch, seq, num_heads, head_dim] (the reference's flash-attn
+    layout).  Dispatches to the Pallas flash-attention kernel on TPU for
+    the causal/no-mask cases; XLA fallback otherwise.
+    """
+    from ..ops import dispatch
+    impl = dispatch.get("flash_attention")
+    if impl is not None and attn_mask is None and dropout_p == 0.0:
+        return impl(query, key, value, causal=is_causal, scale=scale)
+    return _xla_attention(query, key, value, attn_mask, dropout_p, is_causal,
+                          training, scale)
+
+
+def _xla_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                   is_causal=False, training=True, scale=None):
+    b, sq, h, d = query.shape
+    sk = key.shape[1]
+    kh = key.shape[2]
+    if kh != h:  # grouped-query attention: repeat kv heads
+        rep = h // kh
+        key = jnp.repeat(key, rep, axis=2)
+        value = jnp.repeat(value, rep, axis=2)
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", query, key) * scale
+    logits = logits.astype(jnp.float32)
+    if is_causal:
+        causal = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        logits = jnp.where(causal[None, None], logits, -jnp.inf)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, -jnp.inf)
+        else:
+            logits = logits + attn_mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(query.dtype)
+    if dropout_p > 0.0 and training:
+        probs = dropout(probs, p=dropout_p, training=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, value)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, training=True):
+    """paddle.nn.functional.flash_attention.flash_attention parity."""
+    out = scaled_dot_product_attention(query, key, value, dropout_p=dropout,
+                                       is_causal=causal, training=training)
+    # The reference returns (out, softmax); softmax is only materialised when
+    # return_softmax is set, which the flash path never supports.
+    return out, None
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, label_smoothing=0.0):
+    logp = jax.nn.log_softmax(input.astype(jnp.float32), axis=axis)
+    if soft_label:
+        if weight is not None:
+            logp = logp * weight
+        loss = -(label * logp).sum(axis=axis)
+    else:
+        num_classes = input.shape[axis]
+        lab = label.squeeze(axis) if label.ndim == input.ndim else label
+        nll = -jnp.take_along_axis(logp, lab[..., None].astype(jnp.int32) % num_classes,
+                                   axis=axis).squeeze(axis)
+        if label_smoothing > 0.0:
+            smooth = -logp.mean(axis=axis)
+            nll = (1 - label_smoothing) * nll + label_smoothing * smooth
+        valid = lab != ignore_index
+        w = jnp.ones_like(nll)
+        if weight is not None:
+            w = jnp.take(jnp.asarray(weight, jnp.float32),
+                         lab.astype(jnp.int32) % num_classes, axis=0)
+        nll = jnp.where(valid, nll * w, 0.0)
+        if reduction == "mean":
+            # paddle weighted-mean semantics: divide by the sum of weights
+            denom = jnp.where(valid, w, 0.0).sum()
+            return nll.sum() / jnp.maximum(denom, 1e-12)
+        loss = nll
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1,
+                               ignore_index=-100, return_softmax=False):
+    loss = cross_entropy(logits, label, soft_label=soft_label, axis=axis,
+                         ignore_index=ignore_index, reduction="none")
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean"):
+    loss = jnp.square(input - label)
+    return {"mean": loss.mean, "sum": loss.sum, "none": lambda: loss}[reduction]()
+
+
+def l1_loss(input, label, reduction="mean"):
+    loss = jnp.abs(input - label)
+    return {"mean": loss.mean, "sum": loss.sum, "none": lambda: loss}[reduction]()
+
+
+def binary_cross_entropy_with_logits(logit, label, reduction="mean", pos_weight=None):
+    mx = jnp.clip(logit, 0, None)
+    loss = mx - logit * label + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    if pos_weight is not None:
+        loss = loss * (label * (pos_weight - 1) + 1)
+    return {"mean": loss.mean, "sum": loss.sum, "none": lambda: loss}[reduction]()
+
+
+def nll_loss(input, label, reduction="mean"):
+    nll = -jnp.take_along_axis(input, label[..., None].astype(jnp.int32), axis=-1).squeeze(-1)
+    return {"mean": nll.mean, "sum": nll.sum, "none": lambda: nll}[reduction]()
+
+
+# ---------------------------------------------------------------------------
+# convolution / pooling / resize (SDXL ops breadth)
+# ---------------------------------------------------------------------------
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    """Weight layout (out_c, in_c/groups, kh, kw), matching the reference."""
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    dilation = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+        pad = [(p[0], p[0]), (p[1], p[1])]
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "OIHW", "NHWC"))
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.float32).astype(x.dtype)
+    if bias is not None:
+        shape = [1, -1, 1, 1] if data_format == "NCHW" else [1, 1, 1, -1]
+        out = out + bias.reshape(shape).astype(out.dtype)
+    return out
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW"):
+    k = (kernel_size, kernel_size) if isinstance(kernel_size, int) else tuple(kernel_size)
+    s = k if stride is None else ((stride, stride) if isinstance(stride, int) else tuple(stride))
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    if data_format == "NCHW":
+        window = (1, 1, *k); strides = (1, 1, *s); pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    else:
+        window = (1, *k, 1); strides = (1, *s, 1); pads = ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
+    count = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add, window, strides, pads)
+    return summed / count
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW"):
+    k = (kernel_size, kernel_size) if isinstance(kernel_size, int) else tuple(kernel_size)
+    s = k if stride is None else ((stride, stride) if isinstance(stride, int) else tuple(stride))
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    if data_format == "NCHW":
+        window = (1, 1, *k); strides = (1, 1, *s); pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    else:
+        window = (1, *k, 1); strides = (1, *s, 1); pads = ((0, 0), (p[0], p[0]), (p[1], p[1]), (0, 0))
+    return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, strides, pads)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", data_format="NCHW"):
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+    else:
+        n, h, w, c = x.shape
+    if size is None:
+        sf = (scale_factor, scale_factor) if not isinstance(scale_factor, (tuple, list)) else scale_factor
+        size = (int(h * sf[0]), int(w * sf[1]))
+    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic"}[mode]
+    if data_format == "NCHW":
+        out = jax.image.resize(x, (n, c, size[0], size[1]), method=method)
+    else:
+        out = jax.image.resize(x, (n, size[0], size[1], c), method=method)
+    return out.astype(x.dtype)
+
+
+def pad(x, pad_width, mode="constant", value=0.0, data_format="NCHW"):
+    if isinstance(pad_width, (list, tuple)) and len(pad_width) == 4 and x.ndim == 4:
+        l, r, t, b = pad_width
+        if data_format == "NCHW":
+            cfg = ((0, 0), (0, 0), (t, b), (l, r))
+        else:
+            cfg = ((0, 0), (t, b), (l, r), (0, 0))
+    else:
+        cfg = pad_width
+    if mode == "constant":
+        return jnp.pad(x, cfg, constant_values=value)
+    return jnp.pad(x, cfg, mode={"reflect": "reflect", "replicate": "edge"}[mode])
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    k = (kernel_sizes, kernel_sizes) if isinstance(kernel_sizes, int) else tuple(kernel_sizes)
+    s = (strides, strides) if isinstance(strides, int) else tuple(strides)
+    p = (paddings, paddings) if isinstance(paddings, int) else tuple(paddings)
+    d = (dilations, dilations) if isinstance(dilations, int) else tuple(dilations)
+    n, c, h, w = x.shape
+    patches = jax.lax.conv_general_dilated_patches(
+        x, k, s, [(p[0], p[0]), (p[1], p[1])], rhs_dilation=d,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return patches.reshape(n, patches.shape[1], -1)

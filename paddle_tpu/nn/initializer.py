@@ -1,0 +1,126 @@
+"""Parameter initializers (``paddle.nn.initializer`` parity).
+
+Reference: python/paddle/nn/initializer/*.py.  Paddle initializers mutate a
+created parameter in place; here an initializer is a pure callable
+``init(key, shape, dtype) -> jax.Array`` so parameter creation stays
+functional and reproducible under a single step key.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+class Initializer:
+    def __call__(self, key, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, key, shape, dtype):
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, key, shape, dtype):
+        return jax.random.uniform(key, shape, dtype=jnp.float32,
+                                  minval=self.low, maxval=self.high).astype(dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, key, shape, dtype):
+        return (self.mean + self.std * jax.random.normal(key, shape, dtype=jnp.float32)).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, key, shape, dtype):
+        x = jax.random.truncated_normal(key, self.a, self.b, shape, dtype=jnp.float32)
+        return (self.mean + self.std * x).astype(dtype)
+
+
+def _fans(shape):
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    receptive = math.prod(shape[2:]) if len(shape) > 2 else 1
+    # Linear weights in this framework are (in_features, out_features);
+    # conv weights are (out, in, *k) as in the reference.
+    if len(shape) == 2:
+        fan_in, fan_out = shape[0], shape[1]
+    else:
+        fan_in, fan_out = shape[1] * receptive, shape[0] * receptive
+    return fan_in, fan_out
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, key, shape, dtype):
+        fi, fo = _fans(shape)
+        fi, fo = self.fan_in or fi, self.fan_out or fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(key, shape, jnp.float32, -limit, limit).astype(dtype)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, key, shape, dtype):
+        fi, fo = _fans(shape)
+        fi, fo = self.fan_in or fi, self.fan_out or fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return (std * jax.random.normal(key, shape, jnp.float32)).astype(dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="leaky_relu"):
+        self.fan_in, self.negative_slope = fan_in, negative_slope
+
+    def __call__(self, key, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope**2))
+        limit = gain * math.sqrt(3.0 / fi)
+        return jax.random.uniform(key, shape, jnp.float32, -limit, limit).astype(dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="leaky_relu"):
+        self.fan_in, self.negative_slope = fan_in, negative_slope
+
+    def __call__(self, key, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope**2))
+        std = gain / math.sqrt(fi)
+        return (std * jax.random.normal(key, shape, jnp.float32)).astype(dtype)
+
+
+# paddle default initializers: XavierUniform-ish for weights, zeros for bias.
+def default_weight_init():
+    return XavierUniform()
+
+
+def default_bias_init():
+    return Constant(0.0)
+
+
+Assign = Constant  # minimal alias surface

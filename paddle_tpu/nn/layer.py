@@ -1,0 +1,407 @@
+"""The Layer (module) system.
+
+Paddle-parity surface of ``paddle.nn.Layer`` (reference:
+python/paddle/nn/layer/layers.py) with a TPU-first execution model: a Layer
+is a *container of named parameters* plus a forward function; the parameters
+can be extracted as a flat pytree and the forward run purely via
+``functional_call(layer, params, *args)``.  That bridge is what makes every
+model jit/pjit-compilable while user code keeps the familiar stateful API
+(``self.weight = self.create_parameter(...)``, ``state_dict()``,
+``named_parameters()``...).
+
+Key differences from the reference, by design:
+- No C++ autograd tape: gradients come from ``jax.grad`` over
+  ``functional_call`` (see paddle_tpu.autograd).
+- Parameters are plain ``jax.Array``; metadata (trainable flag, partition
+  spec for pjit/GSPMD sharding) lives beside them in the owning layer.
+- Mutation during a traced forward is confined to trace time, so compiled
+  steps are pure.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import convert_dtype, get_default_dtype
+from ..core import random as prandom
+from . import initializer as I
+
+
+class ParamMeta:
+    """Per-parameter metadata kept outside the array itself."""
+
+    __slots__ = ("trainable", "partition", "is_bias", "name_hint")
+
+    def __init__(self, trainable=True, partition=None, is_bias=False, name_hint=None):
+        self.trainable = trainable
+        self.partition = partition  # jax.sharding.PartitionSpec or None
+        self.is_bias = is_bias
+        self.name_hint = name_hint
+
+
+class ParamAttr:
+    """``paddle.ParamAttr`` parity (subset: name/initializer/trainable)."""
+
+    def __init__(self, name=None, initializer=None, trainable=True, learning_rate=1.0):
+        self.name = name
+        self.initializer = initializer
+        self.trainable = trainable
+        self.learning_rate = learning_rate
+
+
+class ParameterList(list):
+    """Return type of ``Layer.parameters()``: a list of arrays that also
+    remembers the owning layer + flat names so optimizers can rebuild the
+    name->array mapping (the reference passes Parameter objects that carry
+    their own names; jax arrays cannot)."""
+
+    def __init__(self, arrays, owner=None, names=None):
+        super().__init__(arrays)
+        self.owner = owner
+        self.names = names or []
+
+
+class Layer:
+    """Base class for all neural network modules."""
+
+    def __init__(self, name_scope: Optional[str] = None, dtype=None):
+        d = self.__dict__
+        d["_parameters"] = OrderedDict()
+        d["_param_meta"] = {}
+        d["_buffers"] = OrderedDict()
+        d["_non_persistable_buffers"] = set()
+        d["_sub_layers"] = OrderedDict()
+        d["_pending_params"] = {}
+        d["_forward_pre_hooks"] = OrderedDict()
+        d["_forward_post_hooks"] = OrderedDict()
+        d["training"] = True
+        d["_dtype"] = convert_dtype(dtype) if dtype is not None else get_default_dtype()
+        d["_name_scope"] = name_scope or self.__class__.__name__.lower()
+
+    # -- construction ------------------------------------------------------
+
+    def create_parameter(self, shape, dtype=None, attr=None, is_bias=False,
+                         default_initializer=None, partition=None, trainable=True):
+        """Create (and stage) a parameter array.
+
+        Mirrors ``Layer.create_parameter`` in the reference.  ``partition``
+        is TPU-native extra metadata: a ``PartitionSpec`` over mesh axis
+        names consumed by the pjit step-compiler to shard this parameter.
+        """
+        dtype = convert_dtype(dtype) if dtype is not None else self._dtype
+        init = default_initializer
+        if isinstance(attr, ParamAttr):
+            init = attr.initializer or init
+            trainable = attr.trainable and trainable
+        if init is None:
+            init = I.default_bias_init() if is_bias else I.default_weight_init()
+        if not callable(init):
+            raise TypeError("default_initializer must be callable")
+        key = prandom.next_key("param_init")
+        value = init(key, tuple(shape), dtype)
+        meta = ParamMeta(trainable=trainable, partition=partition, is_bias=is_bias)
+        self._pending_params[id(value)] = meta
+        return value
+
+    def register_buffer(self, name: str, tensor, persistable: bool = True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffers.add(name)
+        object.__setattr__(self, name, tensor)
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        setattr(self, name, sublayer)
+        return sublayer
+
+    def add_parameter(self, name: str, parameter):
+        setattr(self, name, parameter)
+        return parameter
+
+    # -- attribute plumbing ------------------------------------------------
+
+    def __setattr__(self, name: str, value: Any):
+        params = self.__dict__.get("_parameters")
+        if params is None:  # before __init__
+            object.__setattr__(self, name, value)
+            return
+        if isinstance(value, Layer):
+            self._sub_layers[name] = value
+            self._parameters.pop(name, None)
+        elif id(value) in self._pending_params:
+            self._parameters[name] = value
+            self._param_meta[name] = self._pending_params.pop(id(value))
+            self._sub_layers.pop(name, None)
+        elif name in self._parameters:
+            # re-assignment of an existing parameter (e.g. set_state_dict)
+            self._parameters[name] = value
+        elif name in self._buffers:
+            self._buffers[name] = value
+        object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str):
+        # only called when normal lookup fails
+        raise AttributeError(f"{type(self).__name__!r} has no attribute {name!r}")
+
+    # -- traversal ---------------------------------------------------------
+
+    def named_sublayers(self, prefix="", include_self=False) -> Iterator[Tuple[str, "Layer"]]:
+        if include_self:
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            p = f"{prefix}.{name}" if prefix else name
+            yield p, sub
+            yield from sub.named_sublayers(prefix=p)
+
+    def sublayers(self, include_self=False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self) -> Iterator["Layer"]:
+        return iter(self._sub_layers.values())
+
+    def named_children(self):
+        return iter(self._sub_layers.items())
+
+    def named_parameters(self, prefix="", include_sublayers=True) -> Iterator[Tuple[str, jax.Array]]:
+        for name, p in self._parameters.items():
+            yield (f"{prefix}.{name}" if prefix else name), p
+        if include_sublayers:
+            for sname, sub in self._sub_layers.items():
+                sp = f"{prefix}.{sname}" if prefix else sname
+                yield from sub.named_parameters(prefix=sp)
+
+    def parameters(self, include_sublayers=True) -> ParameterList:
+        items = list(self.named_parameters(include_sublayers=include_sublayers))
+        return ParameterList([v for _, v in items], owner=self, names=[k for k, _ in items])
+
+    def named_buffers(self, prefix="", include_sublayers=True, persistable_only=False):
+        for name, b in self._buffers.items():
+            if persistable_only and name in self._non_persistable_buffers:
+                continue
+            yield (f"{prefix}.{name}" if prefix else name), b
+        if include_sublayers:
+            for sname, sub in self._sub_layers.items():
+                sp = f"{prefix}.{sname}" if prefix else sname
+                yield from sub.named_buffers(prefix=sp, persistable_only=persistable_only)
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def param_meta(self) -> Dict[str, ParamMeta]:
+        """Flat name -> ParamMeta for every parameter (used by the
+        step-compiler for sharding and by optimizers for trainability)."""
+        out = {}
+        for path, sub in self.named_sublayers(include_self=True, prefix=""):
+            for name, meta in sub._param_meta.items():
+                out[f"{path}.{name}" if path else name] = meta
+        return out
+
+    # -- state dict --------------------------------------------------------
+
+    def state_dict(self, include_sublayers=True, structured_name_prefix="",
+                   include_non_persistable_buffer=False) -> Dict[str, jax.Array]:
+        out = OrderedDict()
+        for k, v in self.named_parameters(prefix=structured_name_prefix,
+                                          include_sublayers=include_sublayers):
+            out[k] = v
+        for k, v in self.named_buffers(prefix=structured_name_prefix,
+                                       include_sublayers=include_sublayers,
+                                       persistable_only=not include_non_persistable_buffer):
+            out[k] = v
+        return out
+
+    def set_state_dict(self, state_dict: Dict[str, Any], use_structured_name=True):
+        own = self.state_dict(include_non_persistable_buffer=True)
+        missing, unexpected = [], []
+        for k in own:
+            if k not in state_dict:
+                missing.append(k)
+        for k, v in state_dict.items():
+            if k not in own:
+                unexpected.append(k)
+                continue
+            cur = own[k]
+            v = jnp.asarray(v)
+            if tuple(v.shape) != tuple(cur.shape):
+                raise ValueError(f"shape mismatch for {k}: {v.shape} vs {cur.shape}")
+            self._assign_by_path(k, v.astype(cur.dtype))
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    def _resolve_path(self, path: str) -> Tuple["Layer", str]:
+        parts = path.split(".")
+        layer = self
+        for p in parts[:-1]:
+            layer = layer._sub_layers[p]
+        return layer, parts[-1]
+
+    def _assign_by_path(self, path: str, value):
+        layer, name = self._resolve_path(path)
+        if name in layer._parameters:
+            layer._parameters[name] = value
+            object.__setattr__(layer, name, value)
+        elif name in layer._buffers:
+            layer._buffers[name] = value
+            object.__setattr__(layer, name, value)
+        else:
+            raise KeyError(f"no parameter or buffer named {path!r}")
+
+    # -- modes / apply -----------------------------------------------------
+
+    def train(self):
+        for l in self.named_sublayers(include_self=True):
+            l[1].__dict__["training"] = True
+        return self
+
+    def eval(self):
+        for l in self.named_sublayers(include_self=True):
+            l[1].__dict__["training"] = False
+        return self
+
+    def apply(self, fn: Callable[["Layer"], None]):
+        for _, l in self.named_sublayers(include_self=True):
+            fn(l)
+        return self
+
+    def astype(self, dtype):
+        """Cast floating-point parameters/buffers in place (``Layer.to`` subset)."""
+        dtype = convert_dtype(dtype)
+        for path, sub in self.named_sublayers(include_self=True, prefix=""):
+            for name, p in list(sub._parameters.items()):
+                if jnp.issubdtype(p.dtype, jnp.floating):
+                    sub._parameters[name] = p.astype(dtype)
+                    object.__setattr__(sub, name, sub._parameters[name])
+            for name, b in list(sub._buffers.items()):
+                if hasattr(b, "dtype") and jnp.issubdtype(b.dtype, jnp.floating):
+                    sub._buffers[name] = b.astype(dtype)
+                    object.__setattr__(sub, name, sub._buffers[name])
+            sub.__dict__["_dtype"] = dtype
+        return self
+
+    to = astype
+
+    # -- hooks -------------------------------------------------------------
+
+    def register_forward_pre_hook(self, hook):
+        handle = _HookHandle(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook):
+        handle = _HookHandle(self._forward_post_hooks)
+        self._forward_post_hooks[handle.id] = hook
+        return handle
+
+    # -- call --------------------------------------------------------------
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            res = hook(self, args)
+            if res is not None:
+                args = res if isinstance(res, tuple) else (res,)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, args, out)
+            if res is not None:
+                out = res
+        return out
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            lines.append(f"({name}): {sub_repr[0]}")
+            lines.extend("  " + l for l in sub_repr[1:])
+        extra = self.extra_repr()
+        head = f"{type(self).__name__}({extra}" + (")" if not lines else "")
+        if not lines:
+            return head
+        return head + "\n  " + "\n  ".join(lines) + "\n)"
+
+
+class _HookHandle:
+    _next_id = [0]
+
+    def __init__(self, registry):
+        self.id = _HookHandle._next_id[0]
+        _HookHandle._next_id[0] += 1
+        self._registry = registry
+
+    def remove(self):
+        self._registry.pop(self.id, None)
+
+
+# ---------------------------------------------------------------------------
+# functional bridge
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def _swapped_params(layer: Layer, params: Dict[str, Any]):
+    old = {}
+    try:
+        for k, v in params.items():
+            sub, name = layer._resolve_path(k)
+            old[k] = sub._parameters[name] if name in sub._parameters else sub._buffers[name]
+            layer._assign_by_path(k, v)
+        yield
+    finally:
+        for k, v in old.items():
+            layer._assign_by_path(k, v)
+
+
+@contextlib.contextmanager
+def _train_mode(layer: Layer, training: Optional[bool]):
+    if training is None:
+        yield
+        return
+    prev = [(l, l.training) for _, l in layer.named_sublayers(include_self=True)]
+    (layer.train() if training else layer.eval())
+    try:
+        yield
+    finally:
+        for l, t in prev:
+            l.__dict__["training"] = t
+
+
+def functional_call(layer: Layer, params: Optional[Dict[str, Any]], *args,
+                    rngs: Optional[jax.Array] = None, training: Optional[bool] = None,
+                    **kwargs):
+    """Run ``layer`` as a pure function of ``params``.
+
+    ``params`` maps flat dotted names (a subset is fine) to arrays; they are
+    swapped in for the duration of the call and restored afterwards.  Swap
+    happens at trace time, so under ``jax.jit`` the result is a fully pure
+    compiled function.  ``rngs`` installs an explicit RNG stream (see
+    core.random) so dropout &c. are deterministic in the step key.
+    """
+    params = params or {}
+    with _swapped_params(layer, params), _train_mode(layer, training), \
+            prandom.rng_scope(rngs):
+        return layer(*args, **kwargs)
+
+
+def raw_params(layer: Layer) -> Dict[str, jax.Array]:
+    """Flat name->array dict of all parameters (the optimizer pytree).
+
+    A plain dict (not OrderedDict) so its pytree type matches the dicts the
+    optimizer/train-step build — jax treats dict and OrderedDict as distinct
+    node types.
+    """
+    return dict(layer.named_parameters())
+
+
+def trainable_mask(layer: Layer) -> Dict[str, bool]:
+    meta = layer.param_meta()
+    return {k: meta[k].trainable for k in raw_params(layer)}

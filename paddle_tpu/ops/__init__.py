@@ -1,0 +1,670 @@
+"""Tensor ops namespace (``paddle.*`` tensor API parity).
+
+Reference: python/paddle/tensor/{creation,math,manipulation,linalg,...}.py.
+These are thin, jit-friendly wrappers over jnp — the reference needs ~2000
+hand-registered kernels per backend here; XLA gives us all of them from one
+trace, so this layer is purely API adaptation (paddle names/semantics:
+``axis`` not ``dim``, ``concat`` not ``concatenate``, paddle default int64
+index dtypes, etc.).
+"""
+
+from __future__ import annotations
+
+import builtins as _builtins
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core import convert_dtype, get_default_dtype, to_tensor
+from ..core import random as _random
+from . import dispatch  # noqa: F401
+
+Tensor = jax.Array
+
+
+# -- creation ---------------------------------------------------------------
+
+def zeros(shape, dtype=None):
+    return jnp.zeros(shape, dtype=convert_dtype(dtype))
+
+
+def ones(shape, dtype=None):
+    return jnp.ones(shape, dtype=convert_dtype(dtype))
+
+
+def full(shape, fill_value, dtype=None):
+    return jnp.full(shape, fill_value, dtype=convert_dtype(dtype) if dtype else None)
+
+
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=convert_dtype(dtype) if dtype else None)
+
+
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=convert_dtype(dtype) if dtype else None)
+
+
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=convert_dtype(dtype) if dtype else None)
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    return jnp.arange(start, end, step, dtype=convert_dtype(dtype) if dtype else None)
+
+
+def linspace(start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, num, dtype=convert_dtype(dtype) if dtype else None)
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return jnp.eye(num_rows, num_columns, dtype=convert_dtype(dtype))
+
+
+def empty(shape, dtype=None):
+    return jnp.zeros(shape, dtype=convert_dtype(dtype))
+
+
+def empty_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=convert_dtype(dtype) if dtype else None)
+
+
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def diag(x, offset=0):
+    return jnp.diag(x, k=offset)
+
+
+def rand(shape, dtype=None):
+    return _random.uniform(shape, dtype=convert_dtype(dtype))
+
+
+def randn(shape, dtype=None):
+    return _random.normal(shape, dtype=convert_dtype(dtype))
+
+
+def randint(low, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(_random.next_key("randint"), shape, low, high,
+                              dtype=convert_dtype(dtype))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0):
+    return _random.uniform(shape, dtype=convert_dtype(dtype), min=min, max=max)
+
+
+def normal(mean=0.0, std=1.0, shape=(1,)):
+    return _random.normal(shape, mean=mean, std=std)
+
+
+def randperm(n, dtype="int64"):
+    return jax.random.permutation(_random.next_key("randperm"), n).astype(convert_dtype(dtype))
+
+
+def bernoulli(x):
+    return jax.random.bernoulli(_random.next_key("bernoulli"), x).astype(x.dtype)
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    key = _random.next_key("multinomial")
+    logits = jnp.log(jnp.clip(x, 1e-30, None))
+    if replacement:
+        return jax.random.categorical(key, logits, axis=-1,
+                                      shape=(*x.shape[:-1], num_samples))
+    # without replacement: Gumbel top-k trick (top-k of perturbed logits is a
+    # weighted sample without replacement)
+    g = jax.random.gumbel(key, logits.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return idx.astype(jnp.int64)
+
+
+# -- math -------------------------------------------------------------------
+
+add = jnp.add
+subtract = jnp.subtract
+multiply = jnp.multiply
+divide = jnp.divide
+floor_divide = jnp.floor_divide
+mod = remainder = jnp.remainder
+pow = jnp.power
+abs = jnp.abs
+neg = jnp.negative
+exp = jnp.exp
+expm1 = jnp.expm1
+log = jnp.log
+log2 = jnp.log2
+log10 = jnp.log10
+log1p = jnp.log1p
+sqrt = jnp.sqrt
+rsqrt = jax.lax.rsqrt
+square = jnp.square
+sin = jnp.sin
+cos = jnp.cos
+tan = jnp.tan
+asin = jnp.arcsin
+acos = jnp.arccos
+atan = jnp.arctan
+atan2 = jnp.arctan2
+sinh = jnp.sinh
+cosh = jnp.cosh
+tanh = jnp.tanh
+asinh = jnp.arcsinh
+acosh = jnp.arccosh
+atanh = jnp.arctanh
+floor = jnp.floor
+ceil = jnp.ceil
+round = jnp.round
+trunc = jnp.trunc
+sign = jnp.sign
+erf = jax.scipy.special.erf
+erfinv = jax.scipy.special.erfinv
+lgamma = jax.scipy.special.gammaln
+digamma = jax.scipy.special.digamma
+reciprocal = jnp.reciprocal
+isnan = jnp.isnan
+isinf = jnp.isinf
+isfinite = jnp.isfinite
+maximum = jnp.maximum
+minimum = jnp.minimum
+fmax = jnp.fmax
+fmin = jnp.fmin
+hypot = jnp.hypot
+nan_to_num = jnp.nan_to_num
+logcumsumexp = None  # set below
+clip = jnp.clip
+
+
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1 - eps)
+    return jnp.log(x / (1 - x))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * (x @ y)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    return x * scale + bias if bias_after_scale else (x + bias) * scale
+
+
+def increment(x, value=1.0):
+    return x + value
+
+
+# -- reductions -------------------------------------------------------------
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.sum(x, axis=axis, dtype=convert_dtype(dtype) if dtype else None,
+                   keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=axis, keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=axis, keepdims=keepdim,
+                    dtype=convert_dtype(dtype) if dtype else None)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    return jnp.argmax(x, axis=axis, keepdims=keepdim).astype(convert_dtype(dtype))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    return jnp.argmin(x, axis=axis, keepdims=keepdim).astype(convert_dtype(dtype))
+
+
+def argsort(x, axis=-1, descending=False):
+    idx = jnp.argsort(x, axis=axis, descending=descending)
+    return idx.astype(jnp.int64)
+
+
+def sort(x, axis=-1, descending=False):
+    return jnp.sort(x, axis=axis, descending=descending)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    if not largest:
+        vals, idx = jax.lax.top_k(jnp.moveaxis(-x, axis, -1), k)
+        vals = -vals
+    else:
+        vals, idx = jax.lax.top_k(jnp.moveaxis(x, axis, -1), k)
+    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis).astype(jnp.int64)
+
+
+def cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        x, axis = x.reshape(-1), 0
+    return jnp.cumsum(x, axis=axis, dtype=convert_dtype(dtype) if dtype else None)
+
+
+def cumprod(x, dim=None, dtype=None):
+    return jnp.cumprod(x, axis=dim, dtype=convert_dtype(dtype) if dtype else None)
+
+
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+def amax(x, axis=None, keepdim=False):
+    return jnp.amax(x, axis=axis, keepdims=keepdim)
+
+
+def amin(x, axis=None, keepdim=False):
+    return jnp.amin(x, axis=axis, keepdims=keepdim)
+
+
+def all(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=axis, keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=axis, keepdims=keepdim)
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=axis, keepdims=keepdim)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    vals = jnp.sort(x, axis=axis)
+    idx = jnp.argsort(x, axis=axis)
+    taken = jnp.take(vals, k - 1, axis=axis)
+    tidx = jnp.take(idx, k - 1, axis=axis)
+    if keepdim:
+        taken = jnp.expand_dims(taken, axis)
+        tidx = jnp.expand_dims(tidx, axis)
+    return taken, tidx
+
+
+def mode(x, axis=-1, keepdim=False):
+    vals, counts = jnp.unique_counts(x) if axis is None else (None, None)
+    if axis is None:
+        i = jnp.argmax(counts)
+        return vals[i], i
+    orig_axis = axis % x.ndim
+    x = jnp.moveaxis(x, orig_axis, -1)
+    axis = -1
+    sorted_x = jnp.sort(x, axis=axis)
+    # run-length trick: the mode of each lane is the value with the longest
+    # equal-run in the sorted lane
+    n = x.shape[axis]
+    eq = jnp.cumsum(jnp.concatenate([jnp.zeros_like(jnp.take(sorted_x, [0], axis)),
+                                     (jnp.diff(sorted_x, axis=axis) != 0)], axis=axis),
+                    axis=axis)
+    counts = jax.vmap(lambda e: jnp.bincount(e, length=n))(
+        eq.reshape(-1, n).astype(jnp.int32))
+    best = jnp.argmax(counts, axis=-1)
+    first_of_run = jnp.argmax(eq.reshape(-1, n) == best[:, None], axis=-1)
+    modes = jnp.take_along_axis(sorted_x.reshape(-1, n), first_of_run[:, None], 1)
+    out = modes.reshape(x.shape[:-1])
+    if keepdim:
+        out = jnp.expand_dims(out, orig_axis)
+    return out, None
+
+
+# -- comparison / logical ---------------------------------------------------
+
+equal = jnp.equal
+not_equal = jnp.not_equal
+greater_than = jnp.greater
+greater_equal = jnp.greater_equal
+less_than = jnp.less
+less_equal = jnp.less_equal
+logical_and = jnp.logical_and
+logical_or = jnp.logical_or
+logical_not = jnp.logical_not
+logical_xor = jnp.logical_xor
+bitwise_and = jnp.bitwise_and
+bitwise_or = jnp.bitwise_or
+bitwise_xor = jnp.bitwise_xor
+bitwise_not = jnp.bitwise_not
+isclose = jnp.isclose
+allclose = jnp.allclose
+
+
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return jnp.nonzero(condition)
+    return jnp.where(condition, x, y)
+
+
+def masked_select(x, mask):
+    return x[mask]
+
+
+def masked_fill(x, mask, value):
+    return jnp.where(mask, value, x)
+
+
+# -- manipulation -----------------------------------------------------------
+
+def concat(x: Sequence, axis=0):
+    return jnp.concatenate(list(x), axis=axis)
+
+
+def stack(x: Sequence, axis=0):
+    return jnp.stack(list(x), axis=axis)
+
+
+def split(x, num_or_sections, axis=0):
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    sections = list(num_or_sections)
+    known = _builtins.sum(s for s in sections if s != -1)
+    sections = [x.shape[axis] - known if s == -1 else s for s in sections]
+    offsets, acc = [], 0
+    for s in sections[:-1]:
+        acc += s
+        offsets.append(acc)
+    return jnp.split(x, offsets, axis=axis)
+
+
+def chunk(x, chunks, axis=0):
+    return jnp.split(x, chunks, axis=axis)
+
+
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def swapaxes(x, axis0, axis1):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+def squeeze(x, axis=None):
+    return jnp.squeeze(x, axis=axis)
+
+
+def unsqueeze(x, axis):
+    return jnp.expand_dims(x, axis)
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    stop = stop_axis % nd
+    start = start_axis % nd
+    shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+    return jnp.reshape(x, shape)
+
+
+def tile(x, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+def expand(x, shape):
+    # paddle semantics: -1 entries keep the input dim, aligned to TRAILING
+    # dims when the target rank is larger (broadcast-style alignment)
+    shape = list(shape)
+    offset = len(shape) - x.ndim
+    shape = [x.shape[i - offset] if (s == -1 and i >= offset) else s
+             for i, s in enumerate(shape)]
+    return jnp.broadcast_to(x, shape)
+
+
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+def flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def gather(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def gather_nd(x, index):
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+def take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+def put_along_axis(x, indices, values, axis):
+    return jnp.put_along_axis(x, indices, values, axis=axis, inplace=False)
+
+
+def scatter(x, index, updates, overwrite=True):
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def index_add(x, index, axis, value):
+    idx = [_builtins.slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].add(value)
+
+
+def slice(x, axes, starts, ends):
+    idx = [_builtins.slice(None)] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        idx[ax] = _builtins.slice(s, e)
+    return x[tuple(idx)]
+
+
+def unbind(x, axis=0):
+    return [jnp.squeeze(s, axis) for s in jnp.split(x, x.shape[axis], axis=axis)]
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    return jnp.unique(x, return_index=return_index, return_inverse=return_inverse,
+                      return_counts=return_counts, axis=axis)
+
+
+def nonzero(x, as_tuple=False):
+    res = jnp.nonzero(x)
+    return res if as_tuple else jnp.stack(res, axis=-1)
+
+
+def searchsorted(sorted_sequence, values, right=False):
+    return jnp.searchsorted(sorted_sequence, values, side="right" if right else "left")
+
+
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength)
+
+
+def diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+def cast(x, dtype):
+    return x.astype(convert_dtype(dtype))
+
+
+def numel(x):
+    return x.size
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    size = index_num // nshards
+    lo, hi = shard_id * size, (shard_id + 1) * size
+    ok = (input >= lo) & (input < hi)
+    return jnp.where(ok, input - lo, ignore_value)
+
+
+# -- linalg -----------------------------------------------------------------
+
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y)
+
+
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+def t(x):
+    return x.T
+
+
+def mm(x, y):
+    return jnp.matmul(x, y)
+
+
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+def cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+def norm(x, p="fro", axis=None, keepdim=False):
+    if p == "fro":
+        return jnp.linalg.norm(x, axis=axis, keepdims=keepdim)
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+def dist(x, y, p=2):
+    return jnp.linalg.norm((x - y).reshape(-1), ord=p)
+
+
+def einsum(equation, *operands):
+    return jnp.einsum(equation, *operands)
+
+
+def tensordot(x, y, axes=2):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+class linalg:
+    inv = staticmethod(jnp.linalg.inv)
+    pinv = staticmethod(jnp.linalg.pinv)
+    det = staticmethod(jnp.linalg.det)
+    slogdet = staticmethod(jnp.linalg.slogdet)
+    svd = staticmethod(jnp.linalg.svd)
+    qr = staticmethod(jnp.linalg.qr)
+    eig = staticmethod(jnp.linalg.eig)
+    eigh = staticmethod(jnp.linalg.eigh)
+    eigvals = staticmethod(jnp.linalg.eigvals)
+    eigvalsh = staticmethod(jnp.linalg.eigvalsh)
+    cholesky = staticmethod(jnp.linalg.cholesky)
+    solve = staticmethod(jnp.linalg.solve)
+    lstsq = staticmethod(jnp.linalg.lstsq)
+    matrix_rank = staticmethod(jnp.linalg.matrix_rank)
+    matrix_power = staticmethod(jnp.linalg.matrix_power)
+    norm = staticmethod(jnp.linalg.norm)
+    cond = staticmethod(jnp.linalg.cond)
+    multi_dot = staticmethod(jnp.linalg.multi_dot)
+
+
+class fft:
+    fft = staticmethod(jnp.fft.fft)
+    ifft = staticmethod(jnp.fft.ifft)
+    fft2 = staticmethod(jnp.fft.fft2)
+    ifft2 = staticmethod(jnp.fft.ifft2)
+    fftn = staticmethod(jnp.fft.fftn)
+    ifftn = staticmethod(jnp.fft.ifftn)
+    rfft = staticmethod(jnp.fft.rfft)
+    irfft = staticmethod(jnp.fft.irfft)
+    rfft2 = staticmethod(jnp.fft.rfft2)
+    irfft2 = staticmethod(jnp.fft.irfft2)
+    fftshift = staticmethod(jnp.fft.fftshift)
+    ifftshift = staticmethod(jnp.fft.ifftshift)
+    fftfreq = staticmethod(jnp.fft.fftfreq)
+    rfftfreq = staticmethod(jnp.fft.rfftfreq)
+
+
+logcumsumexp = getattr(jnp, "logcumsumexp", None) or (
+    lambda x, axis=-1: jax.lax.associative_scan(jnp.logaddexp, x, axis=axis))
+
+
+# Star-export surface: everything public defined here, nothing imported.
+_EXCLUDE = {"jax", "jnp", "np", "dispatch", "Optional", "Sequence", "Union",
+            "Tensor", "convert_dtype", "get_default_dtype", "to_tensor",
+            "annotations"}
+__all__ = [_n for _n in dir() if not _n.startswith("_") and _n not in _EXCLUDE]

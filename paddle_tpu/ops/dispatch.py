@@ -1,0 +1,52 @@
+"""Kernel dispatch registry.
+
+TPU-native analogue of the reference's KernelFactory
+(paddle/phi/core/kernel_factory.cc): ops with a hand-written Pallas kernel
+register an implementation here keyed by name; callers fall back to the XLA
+composition when no kernel is registered or the flag
+``use_pallas_kernels`` is off.  Unlike the reference there is no per-dtype /
+per-layout key — XLA handles that — so the registry is a flat name->fn map
+gated on the current backend platform.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+
+from ..core import get_flags
+
+_REGISTRY: Dict[str, Callable] = {}
+_PLATFORM: Dict[str, str] = {}
+
+
+def register(name: str, fn: Callable = None, *, platform: str = "tpu"):
+    def deco(f):
+        _REGISTRY[name] = f
+        _PLATFORM[name] = platform
+        return f
+    return deco(fn) if fn is not None else deco
+
+
+def _backend() -> str:
+    try:
+        return jax.default_backend()
+    except Exception:
+        return "cpu"
+
+
+def get(name: str) -> Optional[Callable]:
+    if not get_flags(["use_pallas_kernels"])["use_pallas_kernels"]:
+        return None
+    fn = _REGISTRY.get(name)
+    if fn is None:
+        return None
+    plat = _PLATFORM[name]
+    if plat != "any" and _backend() != plat:
+        return None
+    return fn
+
+
+def registered() -> Dict[str, str]:
+    return dict(_PLATFORM)

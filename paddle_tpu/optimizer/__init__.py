@@ -1,0 +1,311 @@
+"""Optimizers (``paddle.optimizer`` parity), as pure pytree transforms.
+
+Reference: python/paddle/optimizer/{optimizer,adamw,momentum,lamb}.py and the
+fused CUDA kernels paddle/phi/kernels/gpu/{adamw,fused_adam,lamb}_kernel.cu.
+On TPU a "fused multi-tensor optimizer kernel" is simply the XLA-fused update
+over the whole parameter pytree inside the compiled step — no hand fusion
+needed.  Design:
+
+- ``opt.init(params) -> state`` and ``opt.apply(grads, state, params) ->
+  (new_params, new_state)`` are the pure core (used by jit.TrainStep).
+- ``multi_precision`` master weights (fp32 copies of low-precision params)
+  follow the reference's MPType pattern: update in fp32, cast back to the
+  param dtype, keep the fp32 master in optimizer state.
+- The paddle-style stateful surface (``opt.step()``/``clear_grad``) works
+  eagerly for small-model/debug use via the owning Layer captured from
+  ``parameters=model.parameters()``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.clip import ClipGradBase, ClipGradByGlobalNorm
+from ..nn.layer import Layer, ParameterList, raw_params
+from . import lr as lr_mod
+from .lr import LRScheduler
+
+PyTree = Any
+
+
+def _lr_value(lr, step):
+    if isinstance(lr, LRScheduler):
+        return lr.lr_at(step)
+    return jnp.asarray(lr, jnp.float32)
+
+
+class Optimizer:
+    """Base optimizer: pure functional core + paddle-style surface."""
+
+    def __init__(self, learning_rate=0.001, parameters: Optional[ParameterList] = None,
+                 weight_decay=0.0, grad_clip: Optional[ClipGradBase] = None,
+                 multi_precision=False, apply_decay_param_fun: Optional[Callable] = None):
+        self._lr = learning_rate
+        self.weight_decay = weight_decay or 0.0
+        self.grad_clip = grad_clip
+        self.multi_precision = multi_precision
+        self.apply_decay_param_fun = apply_decay_param_fun
+        self._owner: Optional[Layer] = None
+        self._names = None
+        if isinstance(parameters, ParameterList):
+            self._owner = parameters.owner
+            self._names = parameters.names
+        self._eager_state = None
+
+    # ---- functional core --------------------------------------------------
+
+    def init(self, params: PyTree) -> PyTree:
+        state = {"step": jnp.zeros((), jnp.int32)}
+        if self.multi_precision:
+            state["master"] = jax.tree.map(
+                lambda p: p.astype(jnp.float32)
+                if jnp.issubdtype(p.dtype, jnp.floating) and p.dtype != jnp.float32
+                else None, params)
+        state.update(self._init_slots(params))
+        return state
+
+    def _init_slots(self, params: PyTree) -> Dict[str, PyTree]:
+        return {}
+
+    def _update_one(self, name, p, g, lr, state_slots, step):
+        raise NotImplementedError
+
+    def _decay_mask(self, params: Dict[str, jax.Array]) -> Dict[str, bool]:
+        if self.apply_decay_param_fun is None:
+            return {k: True for k in params}
+        return {k: bool(self.apply_decay_param_fun(k)) for k in params}
+
+    def apply(self, grads: Dict[str, jax.Array], state: PyTree,
+              params: Dict[str, jax.Array]):
+        """Pure update. grads may cover a subset of params (frozen ones skipped)."""
+        if self.grad_clip is not None:
+            grads = self.grad_clip(grads)
+        step = state["step"]
+        lr = _lr_value(self._lr, step)
+        masters = state.get("master", {})
+        new_params, new_state = dict(params), {k: dict(v) if isinstance(v, dict) else v
+                                               for k, v in state.items()}
+        decay_mask = self._decay_mask(params)
+        for name, g in grads.items():
+            p = params[name]
+            master = masters.get(name) if isinstance(masters, dict) else None
+            p_compute = master if master is not None else p
+            slots = {k: v[name] for k, v in state.items()
+                     if isinstance(v, dict) and k not in ("master",) and name in v}
+            wd = self.weight_decay if decay_mask.get(name, True) else 0.0
+            new_p, new_slots = self._update_one(
+                name, p_compute.astype(jnp.float32), g.astype(jnp.float32),
+                lr, slots, step, wd)
+            if master is not None:
+                new_state["master"][name] = new_p
+                new_params[name] = new_p.astype(p.dtype)
+            else:
+                new_params[name] = new_p.astype(p.dtype)
+            for k, v in new_slots.items():
+                new_state[k][name] = v
+        new_state["step"] = step + 1
+        return new_params, new_state
+
+    # ---- paddle-style eager surface --------------------------------------
+
+    def step(self):
+        if self._owner is None:
+            raise RuntimeError("pass parameters=model.parameters() to use .step()")
+        if not hasattr(self, "_eager_grads") or self._eager_grads is None:
+            raise RuntimeError(
+                "no gradients staged: call opt.set_grads(grads) first, or use "
+                "the compiled paddle_tpu.jit.TrainStep path")
+        params = raw_params(self._owner)
+        if self._eager_state is None:
+            self._eager_state = self.init(params)
+        new_params, self._eager_state = self.apply(self._eager_grads, self._eager_state, params)
+        for k, v in new_params.items():
+            self._owner._assign_by_path(k, v)
+        self._eager_grads = None
+
+    def set_grads(self, grads: Dict[str, jax.Array]):
+        self._eager_grads = grads
+
+    def clear_grad(self):
+        self._eager_grads = None
+
+    def get_lr(self):
+        if isinstance(self._lr, LRScheduler):
+            return self._lr.get_lr()
+        return float(self._lr)
+
+    def set_lr(self, value):
+        self._lr = value
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    def state_dict(self):
+        return self._eager_state or {}
+
+    def set_state_dict(self, d):
+        self._eager_state = d
+
+
+class SGD(Optimizer):
+    def _update_one(self, name, p, g, lr, slots, step, wd):
+        if wd:
+            g = g + wd * p
+        return p - lr * g, {}
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=0.0, grad_clip=None,
+                 multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self.momentum = momentum
+        self.use_nesterov = use_nesterov
+
+    def _init_slots(self, params):
+        return {"velocity": jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)}
+
+    def _update_one(self, name, p, g, lr, slots, step, wd):
+        if wd:
+            g = g + wd * p
+        v = self.momentum * slots["velocity"] + g
+        if self.use_nesterov:
+            p = p - lr * (g + self.momentum * v)
+        else:
+            p = p - lr * v
+        return p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.0, grad_clip=None,
+                 multi_precision=False, lazy_mode=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _init_slots(self, params):
+        z = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return {"moment1": jax.tree.map(z, params),
+                "moment2": jax.tree.map(z, params)}
+
+    def _adam_core(self, p, g, lr, m, v, step, wd, decoupled):
+        if wd and not decoupled:
+            g = g + wd * p
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        t = (step + 1).astype(jnp.float32)
+        mhat = m / (1 - self.beta1 ** t)
+        vhat = v / (1 - self.beta2 ** t)
+        update = mhat / (jnp.sqrt(vhat) + self.epsilon)
+        if wd and decoupled:
+            update = update + wd * p
+        return p - lr * update, m, v
+
+    def _update_one(self, name, p, g, lr, slots, step, wd):
+        new_p, m, v = self._adam_core(p, g, lr, slots["moment1"], slots["moment2"],
+                                      step, wd, decoupled=False)
+        return new_p, {"moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: AdamwDenseKernel)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, grad_clip=None,
+                 multi_precision=False, apply_decay_param_fun=None, lr_ratio=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, multi_precision)
+        self.apply_decay_param_fun = apply_decay_param_fun
+
+    def _update_one(self, name, p, g, lr, slots, step, wd):
+        new_p, m, v = self._adam_core(p, g, lr, slots["moment1"], slots["moment2"],
+                                      step, wd, decoupled=True)
+        return new_p, {"moment1": m, "moment2": v}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, lamb_weight_decay, grad_clip,
+                         multi_precision)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_slots(self, params):
+        z = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return {"moment1": jax.tree.map(z, params),
+                "moment2": jax.tree.map(z, params)}
+
+    def _update_one(self, name, p, g, lr, slots, step, wd):
+        if self.exclude_fn is not None and self.exclude_fn(name):
+            wd = 0.0
+        m = self.beta1 * slots["moment1"] + (1 - self.beta1) * g
+        v = self.beta2 * slots["moment2"] + (1 - self.beta2) * jnp.square(g)
+        t = (step + 1).astype(jnp.float32)
+        mhat = m / (1 - self.beta1 ** t)
+        vhat = v / (1 - self.beta2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self.epsilon) + wd * p
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p - lr * trust * r, {"moment1": m, "moment2": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=0.0, grad_clip=None, initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self.epsilon = epsilon
+        self.init_acc = initial_accumulator_value
+
+    def _init_slots(self, params):
+        return {"moment": jax.tree.map(
+            lambda p: jnp.full(p.shape, self.init_acc, jnp.float32), params)}
+
+    def _update_one(self, name, p, g, lr, slots, step, wd):
+        if wd:
+            g = g + wd * p
+        acc = slots["moment"] + jnp.square(g)
+        return p - lr * g / (jnp.sqrt(acc) + self.epsilon), {"moment": acc}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=0.0, grad_clip=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self.rho, self.epsilon, self.momentum, self.centered = rho, epsilon, momentum, centered
+
+    def _init_slots(self, params):
+        z = lambda p: jnp.zeros(p.shape, jnp.float32)
+        slots = {"mean_square": jax.tree.map(z, params),
+                 "momentum_acc": jax.tree.map(z, params)}
+        if self.centered:
+            slots["mean_grad"] = jax.tree.map(z, params)
+        return slots
+
+    def _update_one(self, name, p, g, lr, slots, step, wd):
+        if wd:
+            g = g + wd * p
+        ms = self.rho * slots["mean_square"] + (1 - self.rho) * jnp.square(g)
+        out_slots = {"mean_square": ms}
+        denom = ms
+        if self.centered:
+            mg = self.rho * slots["mean_grad"] + (1 - self.rho) * g
+            denom = ms - jnp.square(mg)
+            out_slots["mean_grad"] = mg
+        mom = self.momentum * slots["momentum_acc"] + lr * g / jnp.sqrt(denom + self.epsilon)
+        out_slots["momentum_acc"] = mom
+        return p - mom, out_slots
+
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Lamb", "Adagrad",
+           "RMSProp", "lr", "LRScheduler"]
+
+lr = lr_mod

@@ -1,0 +1,244 @@
+"""LR schedulers (``paddle.optimizer.lr`` parity).
+
+Reference: python/paddle/optimizer/lr.py.  Each scheduler is a pure function
+of the integer step so it can live inside a compiled train step (the
+reference mutates host-side state and re-feeds the LR each step; here the LR
+is computed on-device from the step counter — no host sync).  The stateful
+``.step()/.get_lr()`` API is kept for parity.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+
+class LRScheduler:
+    def __init__(self, learning_rate=0.1, last_epoch=-1, verbose=False):
+        self.base_lr = learning_rate
+        self.last_epoch = last_epoch
+        self.step()  # advance to epoch 0, paddle semantics
+
+    # pure form: override this
+    def lr_at(self, step):
+        return jnp.asarray(self.base_lr, jnp.float32)
+
+    # stateful parity API
+    def step(self, epoch=None):
+        self.last_epoch = epoch if epoch is not None else self.last_epoch + 1
+
+    def get_lr(self):
+        return float(self.lr_at(jnp.asarray(self.last_epoch)))
+
+    def state_dict(self):
+        return {"last_epoch": self.last_epoch}
+
+    def set_state_dict(self, d):
+        self.last_epoch = d["last_epoch"]
+
+    def __call__(self, step):
+        return self.lr_at(step)
+
+
+class NoamDecay(LRScheduler):
+    def __init__(self, d_model, warmup_steps, learning_rate=1.0, last_epoch=-1,
+                 verbose=False):
+        self.d_model, self.warmup_steps = d_model, warmup_steps
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        s = jnp.maximum(step, 1).astype(jnp.float32)
+        return self.base_lr * (self.d_model ** -0.5) * jnp.minimum(
+            s ** -0.5, s * self.warmup_steps ** -1.5)
+
+
+class PiecewiseDecay(LRScheduler):
+    def __init__(self, boundaries, values, last_epoch=-1, verbose=False):
+        self.boundaries, self.values = list(boundaries), list(values)
+        super().__init__(values[0], last_epoch, verbose)
+
+    def lr_at(self, step):
+        lr = jnp.asarray(self.values[-1], jnp.float32)
+        for b, v in zip(reversed(self.boundaries), reversed(self.values[:-1])):
+            lr = jnp.where(step < b, v, lr)
+        return lr
+
+
+class NaturalExpDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        return self.base_lr * jnp.exp(-self.gamma * step)
+
+
+class ExponentialDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        return self.base_lr * (self.gamma ** step.astype(jnp.float32)
+                               if hasattr(step, "astype") else self.gamma ** step)
+
+
+class InverseTimeDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        return self.base_lr / (1 + self.gamma * step)
+
+
+class PolynomialDecay(LRScheduler):
+    def __init__(self, learning_rate, decay_steps, end_lr=0.0001, power=1.0,
+                 cycle=False, last_epoch=-1, verbose=False):
+        self.decay_steps, self.end_lr, self.power, self.cycle = \
+            decay_steps, end_lr, power, cycle
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        s = jnp.asarray(step, jnp.float32)
+        if self.cycle:
+            decay = self.decay_steps * jnp.ceil(jnp.maximum(s, 1) / self.decay_steps)
+        else:
+            decay = self.decay_steps
+            s = jnp.minimum(s, decay)
+        frac = (1 - s / decay) ** self.power
+        return (self.base_lr - self.end_lr) * frac + self.end_lr
+
+
+class LinearWarmup(LRScheduler):
+    def __init__(self, learning_rate, warmup_steps, start_lr, end_lr,
+                 last_epoch=-1, verbose=False):
+        self.inner = learning_rate if isinstance(learning_rate, LRScheduler) else None
+        self.peak = learning_rate if not isinstance(learning_rate, LRScheduler) else None
+        self.warmup_steps, self.start_lr, self.end_lr = warmup_steps, start_lr, end_lr
+        super().__init__(end_lr if self.peak is None else self.peak, last_epoch, verbose)
+
+    def lr_at(self, step):
+        s = jnp.asarray(step, jnp.float32)
+        warm = self.start_lr + (self.end_lr - self.start_lr) * jnp.minimum(
+            s, self.warmup_steps) / max(self.warmup_steps, 1)
+        if self.inner is not None:
+            after = self.inner.lr_at(jnp.maximum(step - self.warmup_steps, 0))
+        else:
+            after = jnp.asarray(self.peak, jnp.float32)
+        return jnp.where(s < self.warmup_steps, warm, after)
+
+
+class CosineAnnealingDecay(LRScheduler):
+    def __init__(self, learning_rate, T_max, eta_min=0, last_epoch=-1, verbose=False):
+        self.T_max, self.eta_min = T_max, eta_min
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        s = jnp.asarray(step, jnp.float32)
+        return self.eta_min + (self.base_lr - self.eta_min) * 0.5 * (
+            1 + jnp.cos(math.pi * jnp.minimum(s, self.T_max) / self.T_max))
+
+
+class StepDecay(LRScheduler):
+    def __init__(self, learning_rate, step_size, gamma=0.1, last_epoch=-1, verbose=False):
+        self.step_size, self.gamma = step_size, gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        return self.base_lr * self.gamma ** jnp.floor(
+            jnp.asarray(step, jnp.float32) / self.step_size)
+
+
+class MultiStepDecay(LRScheduler):
+    def __init__(self, learning_rate, milestones, gamma=0.1, last_epoch=-1, verbose=False):
+        self.milestones, self.gamma = list(milestones), gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        count = jnp.zeros((), jnp.float32)
+        for m in self.milestones:
+            count = count + (jnp.asarray(step) >= m)
+        return self.base_lr * self.gamma ** count
+
+
+class LambdaDecay(LRScheduler):
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1, verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        return self.base_lr * self.lr_lambda(step)
+
+
+class CosineAnnealingWarmRestarts(LRScheduler):
+    def __init__(self, learning_rate, T_0, T_mult=1, eta_min=0, last_epoch=-1,
+                 verbose=False):
+        if T_mult != 1:
+            raise NotImplementedError("T_mult != 1 requires host-side state")
+        self.T_0, self.eta_min = T_0, eta_min
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        s = jnp.mod(jnp.asarray(step, jnp.float32), self.T_0)
+        return self.eta_min + (self.base_lr - self.eta_min) * 0.5 * (
+            1 + jnp.cos(math.pi * s / self.T_0))
+
+
+class OneCycleLR(LRScheduler):
+    def __init__(self, max_learning_rate, total_steps, divide_factor=25.0,
+                 end_learning_rate=0.0001, phase_pct=0.3, anneal_strategy="cos",
+                 last_epoch=-1, verbose=False):
+        self.total_steps = total_steps
+        self.initial = max_learning_rate / divide_factor
+        self.max_lr = max_learning_rate
+        self.end_lr = end_learning_rate
+        self.up_steps = int(total_steps * phase_pct)
+        super().__init__(max_learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        s = jnp.asarray(step, jnp.float32)
+        up = self.initial + (self.max_lr - self.initial) * jnp.minimum(
+            s, self.up_steps) / max(self.up_steps, 1)
+        down_frac = jnp.clip((s - self.up_steps) /
+                             max(self.total_steps - self.up_steps, 1), 0, 1)
+        down = self.end_lr + (self.max_lr - self.end_lr) * 0.5 * (
+            1 + jnp.cos(math.pi * down_frac))
+        return jnp.where(s < self.up_steps, up, down)
+
+
+class ReduceOnPlateau(LRScheduler):
+    """Metric-driven; inherently host-side (matches reference semantics)."""
+
+    def __init__(self, learning_rate, mode="min", factor=0.1, patience=10,
+                 threshold=1e-4, cooldown=0, min_lr=0, verbose=False):
+        self.mode, self.factor, self.patience = mode, factor, patience
+        self.threshold, self.cooldown, self.min_lr = threshold, cooldown, min_lr
+        self.best = None
+        self.num_bad = 0
+        self.cooldown_left = 0
+        self.current = learning_rate
+        super().__init__(learning_rate)
+
+    def lr_at(self, step):
+        return jnp.asarray(self.current, jnp.float32)
+
+    def step(self, metrics=None, epoch=None):
+        self.last_epoch += 1
+        if metrics is None:
+            return
+        m = float(metrics)
+        better = (self.best is None or
+                  (m < self.best - self.threshold if self.mode == "min"
+                   else m > self.best + self.threshold))
+        if better:
+            self.best, self.num_bad = m, 0
+        elif self.cooldown_left > 0:
+            self.cooldown_left -= 1
+        else:
+            self.num_bad += 1
+            if self.num_bad > self.patience:
+                self.current = max(self.current * self.factor, self.min_lr)
+                self.cooldown_left = self.cooldown
+                self.num_bad = 0

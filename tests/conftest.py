@@ -1,0 +1,32 @@
+"""Test harness: force an 8-device CPU mesh so every parallelism strategy is
+exercised without TPU hardware (SURVEY.md §4: jax's virtual multi-device
+host replaces the reference's multi-process NCCL test rigs)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the shell presets JAX_PLATFORMS=axon (TPU)
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon TPU plugin (sitecustomize) force-registers itself regardless of
+# JAX_PLATFORMS; pin the config explicitly so tests run on the virtual
+# 8-device CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as pt
+    pt.seed(1234)
+    yield

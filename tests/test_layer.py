@@ -1,0 +1,152 @@
+"""Layer system tests: construction, traversal, state_dict, functional bridge.
+
+Modeled on the reference's Layer tests (test/legacy_test/test_imperative_*).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.nn.layer import functional_call, raw_params
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(4, 8)
+        self.fc2 = nn.Linear(8, 2)
+        self.drop = nn.Dropout(0.5)
+
+    def forward(self, x):
+        return self.fc2(self.drop(pt.nn.functional.relu(self.fc1(x))))
+
+
+def test_parameter_registration():
+    m = MLP()
+    names = [n for n, _ in m.named_parameters()]
+    assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+    assert m.fc1.weight.shape == (4, 8)
+    assert m.fc1.bias.shape == (8,)
+
+
+def test_state_dict_roundtrip():
+    m = MLP()
+    sd = m.state_dict()
+    assert set(sd) == {"fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"}
+    m2 = MLP()
+    m2.set_state_dict(sd)
+    for (k1, v1), (k2, v2) in zip(m.named_parameters(), m2.named_parameters()):
+        np.testing.assert_allclose(v1, v2)
+
+
+def test_forward_eager():
+    m = MLP().eval()
+    x = jnp.ones((3, 4))
+    y = m(x)
+    assert y.shape == (3, 2)
+
+
+def test_functional_call_pure():
+    m = MLP().eval()
+    params = raw_params(m)
+    x = jnp.ones((3, 4))
+    y1 = m(x)
+    zeroed = {k: jnp.zeros_like(v) for k, v in params.items()}
+    y0 = functional_call(m, zeroed, x)
+    np.testing.assert_allclose(np.asarray(y0), 0.0)
+    # original params restored after the call
+    y2 = m(x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2))
+
+
+def test_functional_call_jit_grad():
+    m = MLP().eval()
+    params = raw_params(m)
+    x = jnp.ones((3, 4))
+
+    @jax.jit
+    def loss_fn(p):
+        return functional_call(m, p, x).sum()
+
+    g = jax.grad(loss_fn)(dict(params))
+    assert set(g) == set(params)
+    assert g["fc2.bias"].shape == (2,)
+    np.testing.assert_allclose(np.asarray(g["fc2.bias"]), 3.0)  # sum over batch
+
+
+def test_dropout_rng_determinism():
+    m = MLP().train()
+    params = raw_params(m)
+    x = jnp.ones((5, 4))
+    key = jax.random.key(7)
+    y1 = functional_call(m, params, x, rngs=key, training=True)
+    y2 = functional_call(m, params, x, rngs=key, training=True)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2))
+    y3 = functional_call(m, params, x, rngs=jax.random.key(8), training=True)
+    assert not np.allclose(np.asarray(y1), np.asarray(y3))
+
+
+def test_train_eval_mode():
+    m = MLP()
+    assert m.training and m.drop.training
+    m.eval()
+    assert not m.training and not m.drop.training
+    m.train()
+    assert m.drop.training
+
+
+def test_buffers():
+    class WithBuf(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.register_buffer("count", jnp.zeros((1,)))
+            self.fc = nn.Linear(2, 2)
+
+        def forward(self, x):
+            return self.fc(x) + self.count
+
+    m = WithBuf()
+    sd = m.state_dict()
+    assert "count" in sd and "fc.weight" in sd
+    params = raw_params(m)
+    assert "count" not in params  # buffers are not parameters
+
+
+def test_sequential_and_layerlist():
+    s = nn.Sequential(nn.Linear(3, 4), nn.ReLU(), nn.Linear(4, 2))
+    y = s(jnp.ones((1, 3)))
+    assert y.shape == (1, 2)
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll) == 3
+    assert len(list(ll[0].named_parameters())) == 2
+
+
+def test_trainable_mask():
+    m = MLP()
+    meta = m.param_meta()
+    assert all(meta[k].trainable for k in meta)
+    m2 = nn.Linear(2, 2, weight_attr=nn.ParamAttr(trainable=False))
+    mask = pt.nn.trainable_mask(m2)
+    assert mask["weight"] is False and mask["bias"] is True
+
+
+def test_apply_and_astype():
+    m = MLP()
+    m.astype("bfloat16")
+    assert m.fc1.weight.dtype == jnp.bfloat16
+    m.astype("float32")
+    assert m.fc1.weight.dtype == jnp.float32
+
+
+def test_hooks():
+    m = nn.Linear(2, 2)
+    calls = []
+    h = m.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+    m(jnp.ones((1, 2)))
+    assert calls == [1]
+    h.remove()
+    m(jnp.ones((1, 2)))
+    assert calls == [1]

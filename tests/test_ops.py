@@ -1,0 +1,179 @@
+"""NumPy-oracle tests for functional ops (reference OpTest pattern:
+test/legacy_test/op_test.py — declare inputs, compare against NumPy impl,
+check grads)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.nn import functional as F
+
+
+def test_basic_ops_namespace():
+    x = pt.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    np.testing.assert_allclose(np.asarray(pt.sum(x)), 10.0)
+    np.testing.assert_allclose(np.asarray(pt.mean(x, axis=0)), [2.0, 3.0])
+    np.testing.assert_allclose(np.asarray(pt.matmul(x, x, transpose_y=True)),
+                               np.asarray(x) @ np.asarray(x).T)
+    y = pt.concat([x, x], axis=1)
+    assert y.shape == (2, 4)
+    parts = pt.split(y, [1, -1], axis=1)
+    assert parts[0].shape == (2, 1) and parts[1].shape == (2, 3)
+    assert pt.topk(x, 1)[0].shape == (2, 1)
+    np.testing.assert_allclose(np.asarray(pt.flatten(x)), [1, 2, 3, 4])
+
+
+def test_layer_norm_oracle(rng):
+    x = rng.standard_normal((4, 10)).astype(np.float32)
+    w = rng.standard_normal(10).astype(np.float32)
+    b = rng.standard_normal(10).astype(np.float32)
+    out = F.layer_norm(jnp.asarray(x), (10,), jnp.asarray(w), jnp.asarray(b))
+    mu, var = x.mean(-1, keepdims=True), x.var(-1, keepdims=True)
+    expect = (x - mu) / np.sqrt(var + 1e-5) * w + b
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-4, atol=1e-5)
+
+
+def test_rms_norm_oracle(rng):
+    x = rng.standard_normal((4, 16)).astype(np.float32)
+    w = rng.standard_normal(16).astype(np.float32)
+    out = F.rms_norm(jnp.asarray(x), jnp.asarray(w), 1e-6)
+    expect = x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-6) * w
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_cross_entropy_oracle(rng):
+    logits = rng.standard_normal((6, 5)).astype(np.float32)
+    labels = rng.integers(0, 5, size=(6,))
+    loss = F.cross_entropy(jnp.asarray(logits), jnp.asarray(labels))
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    expect = -np.log(p[np.arange(6), labels]).mean()
+    np.testing.assert_allclose(float(loss), expect, rtol=1e-4)
+
+
+def test_cross_entropy_ignore_index(rng):
+    logits = rng.standard_normal((4, 5)).astype(np.float32)
+    labels = np.array([1, -100, 2, -100])
+    loss = F.cross_entropy(jnp.asarray(logits), jnp.asarray(labels),
+                           ignore_index=-100)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    expect = -np.log(p[[0, 2], [1, 2]]).mean()
+    np.testing.assert_allclose(float(loss), expect, rtol=1e-4)
+
+
+def test_attention_oracle(rng):
+    b, s, h, d = 2, 8, 2, 4
+    q = rng.standard_normal((b, s, h, d)).astype(np.float32)
+    k = rng.standard_normal((b, s, h, d)).astype(np.float32)
+    v = rng.standard_normal((b, s, h, d)).astype(np.float32)
+    out = F.scaled_dot_product_attention(jnp.asarray(q), jnp.asarray(k),
+                                         jnp.asarray(v), is_causal=True)
+    # numpy oracle
+    logits = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    mask = np.tril(np.ones((s, s), bool))
+    logits = np.where(mask, logits, -np.inf)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    expect = np.einsum("bhqk,bkhd->bqhd", p, v)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-4, atol=1e-5)
+
+
+def test_gqa_attention(rng):
+    b, s, hq, hkv, d = 1, 4, 4, 2, 8
+    q = jnp.asarray(rng.standard_normal((b, s, hq, d)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((b, s, hkv, d)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((b, s, hkv, d)).astype(np.float32))
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    assert out.shape == (b, s, hq, d)
+
+
+def test_rope_rotation_properties(rng):
+    b, s, h, d = 1, 6, 2, 8
+    q = rng.standard_normal((b, s, h, d)).astype(np.float32)
+    k = rng.standard_normal((b, s, h, d)).astype(np.float32)
+    cos, sin = F.rope_cos_sin(s, d)
+    q2, k2 = F.apply_rotary_pos_emb(jnp.asarray(q), jnp.asarray(k), cos, sin)
+    # norm-preserving
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(q2), axis=-1),
+                               np.linalg.norm(q, axis=-1), rtol=1e-4)
+    # position 0 unchanged
+    np.testing.assert_allclose(np.asarray(q2)[:, 0], q[:, 0], rtol=1e-5, atol=1e-6)
+    # relative property: dot(q_m, k_n) depends only on m-n (spot check)
+    def dot(qr, kr, m, n):
+        return float(np.sum(np.asarray(qr)[0, m, 0] * np.asarray(kr)[0, n, 0]))
+    # construct q/k constant across positions
+    qc = np.tile(q[:, :1], (1, s, 1, 1))
+    kc = np.tile(k[:, :1], (1, s, 1, 1))
+    q3, k3 = F.apply_rotary_pos_emb(jnp.asarray(qc), jnp.asarray(kc), cos, sin)
+    assert abs(dot(q3, k3, 3, 1) - dot(q3, k3, 4, 2)) < 1e-3
+
+
+def test_conv2d_oracle(rng):
+    x = rng.standard_normal((1, 3, 8, 8)).astype(np.float32)
+    w = rng.standard_normal((4, 3, 3, 3)).astype(np.float32)
+    out = F.conv2d(jnp.asarray(x), jnp.asarray(w), padding=1)
+    assert out.shape == (1, 4, 8, 8)
+    # compare center pixel against direct computation
+    patch = x[0, :, 2:5, 2:5]
+    expect = (patch[None] * w).sum(axis=(1, 2, 3))
+    np.testing.assert_allclose(np.asarray(out)[0, :, 3, 3], expect, rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_group_norm_oracle(rng):
+    x = rng.standard_normal((2, 4, 3, 3)).astype(np.float32)
+    out = F.group_norm(jnp.asarray(x), num_groups=2)
+    g = x.reshape(2, 2, 2, 3, 3)
+    mu = g.mean(axis=(2, 3, 4), keepdims=True)
+    var = g.var(axis=(2, 3, 4), keepdims=True)
+    expect = ((g - mu) / np.sqrt(var + 1e-5)).reshape(2, 4, 3, 3)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-4, atol=1e-4)
+
+
+def test_dropout_scaling():
+    x = jnp.ones((1000,))
+    out = F.dropout(x, p=0.5, training=True)
+    kept = np.asarray(out) > 0
+    assert 0.35 < kept.mean() < 0.65
+    np.testing.assert_allclose(np.asarray(out)[kept], 2.0)
+    np.testing.assert_allclose(np.asarray(F.dropout(x, 0.5, training=False)), 1.0)
+
+
+def test_swiglu():
+    x = jnp.asarray([[1.0, -1.0]])
+    y = jnp.asarray([[2.0, 2.0]])
+    out = F.swiglu(x, y)
+    expect = (np.asarray(x) / (1 + np.exp(-np.asarray(x)))) * np.asarray(y)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
+
+
+def test_interpolate_and_pool(rng):
+    x = jnp.asarray(rng.standard_normal((1, 2, 4, 4)).astype(np.float32))
+    up = F.interpolate(x, scale_factor=2, mode="nearest")
+    assert up.shape == (1, 2, 8, 8)
+    avg = F.avg_pool2d(x, 2)
+    np.testing.assert_allclose(np.asarray(avg)[0, 0, 0, 0],
+                               np.asarray(x)[0, 0, :2, :2].mean(), rtol=1e-5)
+    mx = F.max_pool2d(x, 2)
+    np.testing.assert_allclose(np.asarray(mx)[0, 0, 0, 0],
+                               np.asarray(x)[0, 0, :2, :2].max(), rtol=1e-5)
+
+
+def test_grad_through_functional(rng):
+    """Gradient check vs finite differences (reference check_grad pattern)."""
+    x = jnp.asarray(rng.standard_normal((3, 5)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((5,)).astype(np.float32))
+
+    def f(w):
+        return F.rms_norm(x, w).sum()
+
+    g = jax.grad(f)(w)
+    eps = 1e-3
+    for i in range(5):
+        wp = w.at[i].add(eps)
+        wm = w.at[i].add(-eps)
+        fd = (float(f(wp)) - float(f(wm))) / (2 * eps)
+        assert abs(fd - float(g[i])) < 5e-2, (i, fd, float(g[i]))

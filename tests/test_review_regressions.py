@@ -1,0 +1,104 @@
+"""Regression tests for issues found in code review."""
+
+import copy
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import amp, nn, optimizer
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.nn import functional as F
+
+
+def test_sequential_named_tuples():
+    s = nn.Sequential(("fc", nn.Linear(4, 4)), ("act", nn.ReLU()))
+    assert list(s._sub_layers) == ["fc", "act"]
+    y = s(jnp.ones((1, 4)))
+    assert not np.allclose(np.asarray(y), 1.0)  # not identity
+
+
+def test_transformer_encoder_prototype_layer():
+    proto = nn.TransformerEncoderLayer(8, 2, 16, dropout=0.0)
+    enc = nn.TransformerEncoder(proto, 3)
+    assert len(enc.layers) == 3
+    # parameters are independent copies, not shared
+    p0 = enc.layers[0].linear1.weight
+    p1 = enc.layers[1].linear1.weight
+    assert p0 is not p1
+    out = enc.eval()(jnp.ones((1, 4, 8)))
+    assert out.shape == (1, 4, 8)
+
+
+def test_cross_entropy_class_weight():
+    logits = jnp.asarray([[2.0, 0.0], [0.0, 2.0]])
+    labels = jnp.asarray([0, 1])
+    w = jnp.asarray([1.0, 3.0])
+    loss = F.cross_entropy(logits, labels, weight=w)
+    logp = np.log(np.exp([2.0, 2.0]) / (np.exp(2.0) + np.exp(0.0)))
+    expect = (1.0 * -logp[0] + 3.0 * -logp[1]) / 4.0  # weighted mean
+    np.testing.assert_allclose(float(loss), expect, rtol=1e-5)
+
+
+def test_scaler_skips_optimizer_on_inf():
+    class One(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(2, 1, bias_attr=False)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    def loss_fn(model, batch):
+        return (model(batch["x"]) * batch["scale"]).mean()
+
+    model = One()
+    opt = optimizer.AdamW(learning_rate=0.1, parameters=model.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=4.0)
+    step = TrainStep(model, loss_fn, opt, scaler=scaler)
+    state = step.init_state(0)
+    w0 = np.asarray(state["params"]["fc.weight"]).copy()
+    m0 = np.asarray(state["opt"]["moment1"]["fc.weight"]).copy()
+    bad = {"x": jnp.ones((2, 2)), "scale": jnp.asarray(jnp.inf)}
+    state, m = step(state, bad)
+    # overflow: params AND optimizer moments unchanged, scale halved
+    np.testing.assert_allclose(np.asarray(state["params"]["fc.weight"]), w0)
+    np.testing.assert_allclose(np.asarray(state["opt"]["moment1"]["fc.weight"]), m0)
+    assert float(state["scaler"]["scale"]) == 2.0
+    good = {"x": jnp.ones((2, 2)), "scale": jnp.asarray(1.0)}
+    state, m = step(state, good)
+    assert not np.allclose(np.asarray(state["params"]["fc.weight"]), w0)
+
+
+def test_expand_trailing_align():
+    x = jnp.ones((3,))
+    assert pt.expand(x, [2, -1]).shape == (2, 3)
+    y = jnp.ones((4, 3))
+    assert pt.expand(y, [2, -1, -1]).shape == (2, 4, 3)
+
+
+def test_multinomial_without_replacement():
+    probs = jnp.ones((16,)) / 16.0
+    idx = np.asarray(pt.multinomial(probs, num_samples=8, replacement=False))
+    assert len(set(idx.tolist())) == 8  # all unique
+
+
+def test_rope_non_neox_style(rng):
+    q = jnp.asarray(rng.standard_normal((1, 5, 2, 8)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((1, 5, 2, 8)).astype(np.float32))
+    qn, kn, _ = F.fused_rotary_position_embedding(q, k, use_neox_rotary_style=False)
+    # norm preserved, position 0 unchanged, differs from neox style
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(qn), axis=-1),
+                               np.linalg.norm(np.asarray(q), axis=-1), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(qn)[:, 0], np.asarray(q)[:, 0],
+                               rtol=1e-5, atol=1e-6)
+    qx, _, _ = F.fused_rotary_position_embedding(q, k, use_neox_rotary_style=True)
+    assert not np.allclose(np.asarray(qn)[:, 1:], np.asarray(qx)[:, 1:])
+
+
+def test_ops_star_export_clean():
+    assert not hasattr(pt, "jnp")
+    import paddle_tpu.ops as ops
+    assert "jnp" not in ops.__all__ and "jax" not in ops.__all__
+    assert "matmul" in ops.__all__ and "concat" in ops.__all__
