@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Headline benchmark: Llama causal-LM training MFU on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": "llama_train_mfu", "value": <MFU>, "unit": "mfu_fraction",
+   "vs_baseline": <MFU / 0.40 north-star>}
+
+Config scales to the 16 GiB HBM of a single v5e: llama-350m, seq 2048,
+bf16 params + fp32 master weights + AdamW, flash-attention path, donated
+compiled step (the same TrainStep users run).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+
+
+PEAK_BF16_FLOPS = {
+    # per-chip peak bf16 FLOP/s
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,        # v5p
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,   # v6e
+    "cpu": 1e12,             # nominal, CI only
+}
+
+
+def peak_flops() -> float:
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "cpu")
+    for k, v in PEAK_BF16_FLOPS.items():
+        if kind.startswith(k):
+            return v
+    return PEAK_BF16_FLOPS.get(kind, 197e12)
+
+
+def main():
+    import paddle_tpu as pt
+    from paddle_tpu import amp, nn, optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.llama import PRESETS, causal_lm_loss, llama
+
+    on_tpu = jax.default_backend() != "cpu"
+    preset = os.environ.get("PDTPU_BENCH_PRESET",
+                            "llama-350m" if on_tpu else "tiny")
+    batch_size = int(os.environ.get("PDTPU_BENCH_BATCH", 8 if on_tpu else 2))
+    seq_len = int(os.environ.get("PDTPU_BENCH_SEQ", 2048 if on_tpu else 64))
+    steps = int(os.environ.get("PDTPU_BENCH_STEPS", 20 if on_tpu else 3))
+
+    remat = os.environ.get("PDTPU_BENCH_REMAT", "1") == "1"
+    pt.seed(0)
+    model = llama(preset, max_position_embeddings=seq_len,
+                  use_recompute=remat)
+    cfg = model.cfg
+    opt = optimizer.AdamW(learning_rate=3e-4, weight_decay=0.1,
+                          grad_clip=nn.ClipGradByGlobalNorm(1.0),
+                          parameters=model.parameters())
+    model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+    step = TrainStep(model, causal_lm_loss, opt)
+    state = step.init_state(seed=0)
+
+    ids = jax.random.randint(jax.random.key(0), (batch_size, seq_len), 0,
+                             cfg.vocab_size)
+    batch = {"input_ids": ids, "labels": jnp.roll(ids, -1, axis=1)}
+
+    # warmup / compile (float() forces a device->host transfer — under the
+    # axon relay block_until_ready alone does not synchronise)
+    state, m = step(state, batch)
+    _ = float(m["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step(state, batch)
+    _ = float(m["loss"])
+    dt = time.perf_counter() - t0
+
+    steps_per_sec = steps / dt
+    tokens_per_sec = steps_per_sec * batch_size * seq_len
+    n_params = cfg.num_params()
+    # causal-attention-aware model flops per token: 6N + 6*L*h*T
+    flops_per_token = 6 * n_params + 6 * cfg.num_hidden_layers * \
+        cfg.hidden_size * seq_len
+    mfu = tokens_per_sec * flops_per_token / peak_flops()
+
+    print(json.dumps({
+        "metric": "llama_train_mfu",
+        "value": round(mfu, 4),
+        "unit": "mfu_fraction",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {
+            "preset": preset, "params": n_params,
+            "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+            "ms_per_step": round(1000 * dt / steps, 2),
+            "batch": batch_size, "seq": seq_len,
+            "backend": jax.default_backend(),
+            "device": getattr(jax.devices()[0], "device_kind", "cpu"),
+            "loss": float(m["loss"]),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -167,8 +167,19 @@ def _flag_from_env(name: str, default):
     return type(default)(raw) if default is not None else raw
 
 
+def _apply_flag_side_effect(key: str, v) -> None:
+    if key == "check_nan_inf":
+        jax.config.update("jax_debug_nans", bool(v))
+    elif key == "log_compiles":
+        jax.config.update("jax_log_compiles", bool(v))
+    elif key == "matmul_precision" and v != "default":
+        jax.config.update("jax_default_matmul_precision", v)
+
+
 for _k, _v in _FLAG_DEFAULTS.items():
     _flags[_k] = _flag_from_env(_k, _v)
+    if _flags[_k] != _v:  # env override: apply the jax side effect too
+        _apply_flag_side_effect(_k, _flags[_k])
 
 
 def set_flags(flags: Dict[str, Any]) -> None:
@@ -177,12 +188,7 @@ def set_flags(flags: Dict[str, Any]) -> None:
         if key not in _FLAG_DEFAULTS:
             raise KeyError(f"unknown flag {k!r}; known: {sorted(_FLAG_DEFAULTS)}")
         _flags[key] = v
-        if key == "check_nan_inf":
-            jax.config.update("jax_debug_nans", bool(v))
-        elif key == "log_compiles":
-            jax.config.update("jax_log_compiles", bool(v))
-        elif key == "matmul_precision" and v != "default":
-            jax.config.update("jax_default_matmul_precision", v)
+        _apply_flag_side_effect(key, v)
 
 
 def get_flags(keys=None) -> Dict[str, Any]:

@@ -1,0 +1,34 @@
+"""``paddle_tpu.distributed`` — hybrid parallelism over TPU meshes.
+
+Subsystem map (reference SURVEY.md §2.4/2.5):
+- fleet: topology/strategy orchestration (fleet.init + hybrid_configs)
+- communication: collective API (all_reduce/.../p2p_shift) over mesh axes
+- mp_layers: tensor-parallel layers + Megatron-SP
+- pipeline: 1F1B/GPipe pipeline parallel via shard_map + ppermute
+- sharding: ZeRO stage 1/2/3 semantics (group_sharded_parallel)
+- moe: expert parallel MoE layer (all_to_all dispatch)
+- cp: context parallelism (Ulysses all_to_all + ring attention)
+- auto: shard_tensor / reshard (auto-parallel DistTensor parity)
+"""
+
+from . import fleet  # noqa: F401
+from .topology import AXIS_ORDER, HybridCommunicateGroup, HybridTopology  # noqa: F401
+from .communication import (ReduceOp, Group, new_group, all_reduce,  # noqa: F401
+                            all_gather, reduce_scatter, alltoall,
+                            alltoall_single, broadcast, reduce, scatter,
+                            send, recv, p2p_shift, barrier, get_rank,
+                            get_world_size, is_initialized,
+                            init_parallel_env)
+from .mp_layers import (ColumnParallelLinear, RowParallelLinear,  # noqa: F401
+                        VocabParallelEmbedding, ParallelCrossEntropy,
+                        ColumnSequenceParallelLinear,
+                        RowSequenceParallelLinear,
+                        scatter_to_sequence_parallel,
+                        gather_from_sequence_parallel,
+                        mark_as_sequence_parallel_parameter)
+from .auto import shard_tensor, reshard, DistAttr, Shard, Replicate, Partial  # noqa: F401
+from .recompute import recompute, RecomputeWrapper  # noqa: F401
+
+
+def get_hybrid_communicate_group():
+    return fleet.get_hybrid_communicate_group()

@@ -1,0 +1,128 @@
+"""Auto-parallel API (``paddle.distributed.shard_tensor`` parity).
+
+Reference: python/paddle/distributed/auto_parallel/api.py (shard_tensor,
+Shard/Replicate/Partial placements) over C++ DistTensor + reshard functions
+(paddle/phi/core/distributed/auto_parallel/).
+
+TPU redesign: a "DistTensor" IS a jax global Array with a NamedSharding —
+jax's sharding propagation plays the role of the reference's per-op SPMD
+rules, and ``reshard`` is ``jax.device_put`` to a new sharding (XLA emits
+the collective resharding program).  So this module is thin sugar mapping
+paddle placements onto PartitionSpecs.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import fleet
+
+
+class Placement:
+    pass
+
+
+class Shard(Placement):
+    """Shard along tensor dim ``dim`` over the corresponding mesh axis."""
+
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def __repr__(self):
+        return f"Shard({self.dim})"
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial(Placement):
+    """Pending-reduction placement.  jax has no user-visible partial arrays
+    outside shard_map; shard_tensor treats it as Replicate (the reduction
+    happens where the value is produced)."""
+
+    def __repr__(self):
+        return "Partial()"
+
+
+class DistAttr:
+    def __init__(self, mesh, placements):
+        self.mesh = mesh
+        self.placements = placements
+
+
+class ProcessMesh:
+    """paddle.distributed.ProcessMesh parity: an N-d mesh with named dims."""
+
+    def __init__(self, mesh: Sequence, dim_names: Optional[Sequence[str]] = None):
+        import numpy as np
+        arr = np.asarray(mesh)
+        self.dim_names = list(dim_names or [f"d{i}" for i in range(arr.ndim)])
+        devs = np.asarray(jax.devices(), dtype=object)[arr]
+        self.jax_mesh = Mesh(devs, tuple(self.dim_names))
+
+    @property
+    def shape(self):
+        return tuple(self.jax_mesh.devices.shape)
+
+
+def _to_jax_mesh(mesh) -> Mesh:
+    if isinstance(mesh, Mesh):
+        return mesh
+    if isinstance(mesh, ProcessMesh):
+        return mesh.jax_mesh
+    if mesh is None:
+        hcg = fleet.get_hybrid_communicate_group()
+        if hcg is None:
+            raise RuntimeError("no mesh: pass one or call fleet.init")
+        return hcg.mesh
+    raise TypeError(f"unsupported mesh type {type(mesh)}")
+
+
+def _placements_to_spec(mesh: Mesh, placements: Sequence[Placement],
+                        ndim: int) -> P:
+    entries: List = [None] * ndim
+    for axis_name, placement in zip(mesh.axis_names, placements):
+        if isinstance(placement, Shard):
+            if entries[placement.dim] is None:
+                entries[placement.dim] = axis_name
+            elif isinstance(entries[placement.dim], tuple):
+                entries[placement.dim] = entries[placement.dim] + (axis_name,)
+            else:
+                entries[placement.dim] = (entries[placement.dim], axis_name)
+        # Replicate/Partial: nothing
+    return P(*entries)
+
+
+def shard_tensor(x, mesh=None, placements: Sequence[Placement] = (),
+                 dist_attr=None, stop_gradient=None):
+    """Place ``x`` on the mesh with the given per-mesh-dim placements."""
+    if dist_attr is not None:
+        mesh, placements = dist_attr.mesh, dist_attr.placements
+    jmesh = _to_jax_mesh(mesh)
+    spec = _placements_to_spec(jmesh, placements, jax.numpy.ndim(x))
+    return jax.device_put(x, NamedSharding(jmesh, spec))
+
+
+def reshard(x, mesh=None, placements: Sequence[Placement] = ()):
+    """Change an array's distribution (reference: reshard pass inserting
+    collectives; here XLA derives them from device_put)."""
+    return shard_tensor(x, mesh, placements)
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def shard_layer(layer, mesh=None, shard_fn=None):
+    """Apply a per-parameter shard_fn(name, param) -> placements, or leave
+    parameters replicated on the mesh."""
+    jmesh = _to_jax_mesh(mesh)
+    for name, p in list(layer.named_parameters()):
+        placements = shard_fn(name, p) if shard_fn else [Replicate()]
+        layer._assign_by_path(name, shard_tensor(p, jmesh, placements))
+    return layer

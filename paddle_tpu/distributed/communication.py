@@ -1,0 +1,306 @@
+"""Collective communication API (``paddle.distributed.*`` parity).
+
+Reference: python/paddle/distributed/communication/{all_reduce,all_gather,
+reduce_scatter,all_to_all,broadcast,...}.py over C++ ProcessGroupNCCL
+(paddle/fluid/distributed/collective/process_group_nccl.cc).
+
+TPU redesign (SURVEY.md §5.8): there is no user-space communicator.  A
+"group" is a set of mesh axis names.  Two call modes:
+
+- **Inside shard_map/pjit-manual regions** (the hot path — pipeline bodies,
+  MoE dispatch, ring attention): these functions lower directly to
+  ``lax.psum/all_gather/psum_scatter/all_to_all/ppermute`` on ICI.
+- **Eager on global arrays** (debug/occasional): the call wraps itself in a
+  tiny jitted ``shard_map`` over the active mesh.
+
+ProcessGroup-task semantics (async handles, streams) dissolve: XLA's
+latency-hiding scheduler overlaps collectives with compute automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from . import fleet
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A named subset of mesh axes (the reference's ProcessGroup handle)."""
+
+    def __init__(self, axes: Union[str, Sequence[str]], mesh: Optional[Mesh] = None):
+        self.axes = (axes,) if isinstance(axes, str) else tuple(axes)
+        self._mesh = mesh
+
+    @property
+    def mesh(self) -> Mesh:
+        if self._mesh is not None:
+            return self._mesh
+        hcg = fleet.get_hybrid_communicate_group()
+        if hcg is None:
+            raise RuntimeError("no mesh: call fleet.init or pass mesh=")
+        return hcg.mesh
+
+    @property
+    def nranks(self) -> int:
+        m = self.mesh
+        n = 1
+        for a in self.axes:
+            n *= m.shape[a]
+        return n
+
+    # paddle Group API parity
+    @property
+    def world_size(self):
+        return self.nranks
+
+
+def new_group(axes="dp", mesh=None) -> Group:
+    """Reference: paddle.distributed.new_group(ranks).  Groups are axis
+    subsets, not rank lists — rank lists don't survive SPMD compilation."""
+    return Group(axes, mesh)
+
+
+def _axis_tuple(group):
+    if group is None:
+        hcg = fleet.get_hybrid_communicate_group()
+        if hcg is None:
+            return None
+        axes = tuple(hcg.active_axes())
+        return axes if axes else None
+    return group.axes if isinstance(group, Group) else (
+        (group,) if isinstance(group, str) else tuple(group))
+
+
+def _axis_bound(axes) -> bool:
+    """True when ``axes`` are bound in the current trace (inside shard_map)."""
+    try:
+        for a in axes:
+            jax.lax.axis_index(a)
+        return True
+    except Exception:
+        return False
+
+
+def _eager_wrap(fn, tensor, axes, out_specs_fn=None, in_spec=None):
+    """Run a collective on a global array by shard_mapping it over ``axes``."""
+    mesh = Group(axes).mesh
+    in_spec = in_spec if in_spec is not None else P(axes)
+    out_spec = out_specs_fn(in_spec) if out_specs_fn else in_spec
+    f = shard_map(fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec)
+    return f(tensor)
+
+
+# ---------------------------------------------------------------------------
+# collectives
+# ---------------------------------------------------------------------------
+
+def _reduce(x, op, axes):
+    if op in (ReduceOp.SUM, "sum"):
+        return jax.lax.psum(x, axes)
+    if op in (ReduceOp.AVG, "avg"):
+        n = 1
+        for a in axes:
+            n = n * jax.lax.psum(1, a)
+        return jax.lax.psum(x, axes) / n
+    if op in (ReduceOp.MAX, "max"):
+        return jax.lax.pmax(x, axes)
+    if op in (ReduceOp.MIN, "min"):
+        return jax.lax.pmin(x, axes)
+    if op in (ReduceOp.PROD, "prod"):
+        return jnp.exp(jax.lax.psum(jnp.log(x), axes))
+    raise ValueError(f"unknown reduce op {op}")
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """SUM/MAX/MIN/PROD all-reduce over the group's axes."""
+    axes = _axis_tuple(group)
+    if axes is None:
+        return tensor
+    if _axis_bound(axes):
+        return _reduce(tensor, op, axes)
+    # eager: replicated-in, replicated-out
+    return _eager_wrap(lambda x: _reduce(x, op, axes), tensor, axes,
+                       in_spec=P(), out_specs_fn=lambda s: P())
+
+
+def all_gather(tensor_or_list, tensor=None, group=None, axis=0, sync_op=True):
+    """paddle signature: all_gather(tensor_list, tensor, group) — also
+    usable functionally: gathered = all_gather(tensor, group=g)."""
+    out_list = None
+    if isinstance(tensor_or_list, list):
+        out_list, x = tensor_or_list, tensor
+    else:
+        x = tensor_or_list
+    axes = _axis_tuple(group)
+    if axes is None:
+        res = x
+    elif _axis_bound(axes):
+        res = x
+        for a in axes[::-1]:
+            res = jax.lax.all_gather(res, a, axis=axis, tiled=True)
+    else:
+        res = _eager_wrap(
+            lambda v: jax.lax.all_gather(v, axes[0] if len(axes) == 1 else axes,
+                                         axis=axis, tiled=True),
+            x, axes, in_spec=P(), out_specs_fn=lambda s: P())
+    if out_list is not None:
+        n = Group(axes).nranks if axes else 1
+        out_list.extend(jnp.split(res, n, axis=axis))
+        return out_list
+    return res
+
+
+def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, axis=0, sync_op=True):
+    axes = _axis_tuple(group)
+    if axes is None:
+        return tensor
+    if _axis_bound(axes):
+        res = tensor
+        for a in axes:
+            res = jax.lax.psum_scatter(res, a, scatter_dimension=axis, tiled=True)
+        return res
+    return _eager_wrap(
+        lambda v: jax.lax.psum_scatter(v, axes[0], scatter_dimension=axis,
+                                       tiled=True),
+        tensor, axes, in_spec=P(), out_specs_fn=lambda s: P(*(
+            [axes[0] if i == axis else None for i in range(tensor.ndim)])))
+
+
+def alltoall(tensor, group=None, split_axis=0, concat_axis=0, sync_op=True):
+    """all_to_all: scatter ``split_axis``, gather ``concat_axis``."""
+    axes = _axis_tuple(group)
+    if axes is None:
+        return tensor
+    a = axes[0]
+    if _axis_bound(axes):
+        return jax.lax.all_to_all(tensor, a, split_axis=split_axis,
+                                  concat_axis=concat_axis, tiled=True)
+    return _eager_wrap(
+        lambda v: jax.lax.all_to_all(v, a, split_axis=split_axis,
+                                     concat_axis=concat_axis, tiled=True),
+        tensor, axes,
+        in_spec=P(*([a if i == concat_axis else None for i in range(tensor.ndim)])),
+        out_specs_fn=lambda s: P(*([a if i == split_axis else None
+                                    for i in range(tensor.ndim)])))
+
+
+alltoall_single = alltoall
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """Broadcast from ``src`` rank of the group axis.
+
+    SPMD note: under jit all ranks hold the same global value already; the
+    explicit form matters inside shard_map, where we select src's shard and
+    psum-mask it across the axis.
+    """
+    axes = _axis_tuple(group)
+    if axes is None or not _axis_bound(axes):
+        return tensor  # global arrays are already consistent
+    a = axes[0]
+    idx = jax.lax.axis_index(a)
+    masked = jnp.where(idx == src, tensor, jnp.zeros_like(tensor))
+    return jax.lax.psum(masked, a)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    axes = _axis_tuple(group)
+    if axes is None:
+        return tensor
+    if _axis_bound(axes):
+        red = _reduce(tensor, op, axes)
+        idx = jax.lax.axis_index(axes[0])
+        return jnp.where(idx == dst, red, tensor)
+    return all_reduce(tensor, op=op, group=group)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    axes = _axis_tuple(group)
+    if axes is None:
+        return tensor
+    a = axes[0]
+    if tensor_list is not None:
+        stacked = jnp.stack(tensor_list, axis=0)
+    else:
+        stacked = tensor
+    if _axis_bound(axes):
+        idx = jax.lax.axis_index(a)
+        return jax.lax.dynamic_index_in_dim(stacked, idx, axis=0, keepdims=False)
+    n = Group(axes).nranks
+    return _eager_wrap(lambda v: v[0], stacked, axes,
+                       in_spec=P(a), out_specs_fn=lambda s: P())
+
+
+def send(tensor, dst, group=None):
+    """P2P send — see ``p2p_shift``; raw send/recv don't exist under SPMD."""
+    raise NotImplementedError(
+        "SPMD has no raw send/recv; use distributed.p2p_shift(x, offset, axis) "
+        "(ppermute) — the pipeline scheduler uses that internally")
+
+
+recv = send
+
+
+def p2p_shift(tensor, offset=1, axis="pp"):
+    """Rotate values along a mesh axis ring (ppermute): rank i -> i+offset.
+
+    The building block that replaces the reference's batched send/recv
+    (p2p_communication.py) for pipeline and ring attention.
+    """
+    n = jax.lax.psum(1, axis)
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return jax.lax.ppermute(tensor, axis, perm)
+
+
+def barrier(group=None):
+    jax.block_until_ready(jnp.zeros(()))
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        hcg = fleet.get_hybrid_communicate_group()
+        if hcg is not None:
+            ax = _axis_tuple(group)[0]
+            return hcg._rank_in(ax)
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        g = group if isinstance(group, Group) else Group(_axis_tuple(group))
+        return g.nranks
+    return jax.process_count()
+
+
+def is_initialized() -> bool:
+    return True
+
+
+def init_parallel_env(cluster_env: Optional[dict] = None):
+    """Reference: paddle.distributed.init_parallel_env → TCPStore + NCCL
+    init.  TPU: multi-host bootstrap via the jax coordination service; on a
+    single host this is a no-op."""
+    import os
+    if cluster_env or os.environ.get("PDTPU_COORDINATOR"):
+        env = cluster_env or {}
+        jax.distributed.initialize(
+            coordinator_address=env.get("coordinator",
+                                        os.environ.get("PDTPU_COORDINATOR")),
+            num_processes=int(env.get("num_processes",
+                                      os.environ.get("PDTPU_NUM_PROCESSES", 1))),
+            process_id=int(env.get("process_id",
+                                   os.environ.get("PDTPU_PROCESS_ID", 0))))
+    return None

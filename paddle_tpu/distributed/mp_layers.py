@@ -1,0 +1,188 @@
+"""Tensor-parallel layers (Megatron-style).
+
+Reference: python/paddle/distributed/fleet/layers/mpu/mp_layers.py
+(ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding) and
+mp_ops.py (_c_identity/mp_allreduce autograd ops), plus the vocab-parallel
+loss kernel c_softmax_with_cross_entropy.
+
+TPU redesign: the reference hand-writes the collective choreography
+(identity-forward/allreduce-backward, allreduce after RowParallel) as custom
+autograd ops.  Under GSPMD the same physics falls out of sharding
+annotations: the weight carries a PartitionSpec over the ``mp`` axis, the
+activation carries a sharding constraint, and XLA inserts exactly the
+all-reduce/all-gather the reference codes by hand — including their
+transposes in backward.  These layers therefore reduce to (a) partitioned
+parameter creation, (b) the right ``with_sharding_constraint`` calls, and
+they degrade to plain layers when no mesh axis "mp" exists (serial ==
+parallel numerics, the reference's key test invariant).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer
+from . import fleet
+
+
+def _mesh():
+    hcg = fleet.get_hybrid_communicate_group()
+    return hcg.mesh if hcg is not None else None
+
+
+def _mp_size() -> int:
+    m = _mesh()
+    return m.shape["mp"] if m is not None and "mp" in m.axis_names else 1
+
+
+def constrain(x, *spec_entries):
+    """with_sharding_constraint if a mesh is active, else identity."""
+    m = _mesh()
+    if m is None:
+        return x
+    spec = P(*spec_entries)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(m, spec))
+
+
+def _seq_axes(sequence_parallel: bool):
+    # Megatron-SP: outside the matmuls, activations are sharded on the
+    # sequence dim over the SAME mp axis (reference:
+    # sequence_parallel_utils.py); inside, on the hidden dim.
+    return "mp" if sequence_parallel else None
+
+
+class ColumnParallelLinear(Layer):
+    """Weight (in, out) sharded on out ("column") over mp.
+
+    gather_output=False leaves the activation sharded on the feature dim
+    (feeding a RowParallelLinear), True gathers it (reference parity).
+    """
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, fuse_matmul_bias=False,
+                 sequence_parallel=False, name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.sequence_parallel = sequence_parallel
+        self.out_features = out_features
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            partition=P(None, "mp"))
+        self.bias = self.create_parameter(
+            (out_features,), is_bias=True, partition=P("mp")) if has_bias else None
+
+    def forward(self, x):
+        if self.sequence_parallel:
+            # incoming activation is seq-sharded; XLA all-gathers it for the
+            # matmul (the AllGatherOp in the reference)
+            x = constrain(x, ("dp", "sharding"), "mp", None)
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            y = constrain(y, ("dp", "sharding"), None, None)
+        else:
+            y = constrain(y, ("dp", "sharding"), None, "mp")
+        return y
+
+
+class RowParallelLinear(Layer):
+    """Weight (in, out) sharded on in ("row") over mp; the contraction over
+    the sharded dim makes XLA emit the all-reduce (or reduce-scatter when
+    sequence_parallel leaves the output seq-sharded)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, fuse_matmul_bias=False,
+                 sequence_parallel=False, name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.sequence_parallel = sequence_parallel
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            partition=P("mp", None))
+        self.bias = self.create_parameter(
+            (out_features,), is_bias=True, partition=P()) if has_bias else None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = constrain(x, ("dp", "sharding"), None, "mp")
+        y = F.linear(x, self.weight, None)
+        if self.sequence_parallel:
+            # ReduceScatterOp: output seq-sharded over mp
+            y = constrain(y, ("dp", "sharding"), "mp", None)
+        else:
+            y = constrain(y, ("dp", "sharding"), None, None)
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding table sharded on the vocab dim over mp."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=I.Normal(0.0, 0.02) if weight_attr is None else None,
+            partition=P("mp", None))
+
+    def forward(self, ids):
+        out = F.embedding(ids, self.weight)
+        return constrain(out, ("dp", "sharding"), None, None)
+
+
+class ParallelCrossEntropy(Layer):
+    """Vocab-parallel softmax cross entropy.
+
+    Reference: the CUDA kernel c_softmax_with_cross_entropy, which computes
+    softmax over a vocab dim split across mp ranks with two allreduces
+    (max, sumexp).  GSPMD derives the same two collectives from the logits'
+    vocab sharding — we only keep the logits constrained and compute CE in
+    fp32.
+    """
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, label):
+        logits = constrain(logits, ("dp", "sharding"), None, "mp")
+        return F.cross_entropy(logits, label, reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+# ---------------------------------------------------------------------------
+# Megatron-SP helper layers (reference: fleet/utils/sequence_parallel_utils.py)
+# ---------------------------------------------------------------------------
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    def __init__(self, *args, **kwargs):
+        kwargs["sequence_parallel"] = True
+        super().__init__(*args, **kwargs)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    def __init__(self, *args, **kwargs):
+        kwargs["sequence_parallel"] = True
+        super().__init__(*args, **kwargs)
+
+
+def scatter_to_sequence_parallel(x):
+    """ScatterOp: shard activation seq dim over mp (no data movement under
+    GSPMD — just a resharding constraint)."""
+    return constrain(x, ("dp", "sharding"), "mp", None)
+
+
+def gather_from_sequence_parallel(x):
+    """GatherOp: make the activation fully replicated on the seq dim."""
+    return constrain(x, ("dp", "sharding"), None, None)
+
+
+def mark_as_sequence_parallel_parameter(param):  # API parity; grads of SP
+    return param  # params are already correct under GSPMD (global arrays)

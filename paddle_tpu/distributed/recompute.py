@@ -1,0 +1,52 @@
+"""Activation recomputation (``fleet.utils.recompute`` parity).
+
+Reference: python/paddle/distributed/fleet/recompute/recompute.py — a
+PyLayer that stashes RNG state, drops activations, and re-runs forward
+during backward.  TPU-native: ``jax.checkpoint`` (remat) does exactly this
+inside the compiled step, with selectable policies controlling what XLA may
+keep (the knob the reference lacks).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+
+from ..nn.layer import Layer
+
+POLICIES = {
+    "none": None,  # save nothing extra: recompute everything
+    "dots": jax.checkpoint_policies.checkpoint_dots,
+    "dots_no_batch": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+    "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+    "everything_saveable": jax.checkpoint_policies.everything_saveable,
+}
+
+
+def recompute(function: Callable, *args, use_reentrant=True, policy=None,
+              preserve_rng_state=True, **kwargs):
+    """Run ``function`` under rematerialisation.
+
+    RNG state is preserved by construction: dropout keys are derived
+    deterministically from the step key (core.random), so the recomputed
+    forward draws identical masks — the property the reference implements
+    with CUDA RNG state stashing.
+    """
+    pol = POLICIES.get(policy, policy) if isinstance(policy, str) else policy
+    fn = jax.checkpoint(function, policy=pol)
+    return fn(*args, **kwargs)
+
+
+class RecomputeWrapper(Layer):
+    """Wrap a sublayer so its forward runs under remat inside compiled steps."""
+
+    def __init__(self, inner: Layer, policy: Optional[str] = None):
+        super().__init__()
+        self.inner = inner
+        self._policy = POLICIES.get(policy, policy) if isinstance(policy, str) else policy
+
+    def forward(self, *args, **kwargs):
+        fn = jax.checkpoint(lambda *a: self.inner(*a, **kwargs),
+                            policy=self._policy)
+        return fn(*args)

@@ -28,13 +28,46 @@ from ..core import random as prandom
 from ..nn.layer import Layer, functional_call, raw_params, trainable_mask
 
 
+class InputSpec:
+    """``paddle.static.InputSpec`` parity.  Dynamic dims (None/-1) are not
+    representable in XLA's static-shape model; AOT warm-up skips them."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def is_static(self) -> bool:
+        return all(isinstance(d, int) and d >= 0 for d in self.shape)
+
+    def to_shape_struct(self):
+        from ..core import convert_dtype
+        return jax.ShapeDtypeStruct(self.shape, convert_dtype(self.dtype))
+
+
 def to_static(function=None, input_spec=None, full_graph=True, backend=None,
               donate_argnums=(), static_argnums=()):
-    """``paddle.jit.to_static`` parity → jax.jit."""
+    """``paddle.jit.to_static`` parity → jax.jit.
+
+    With a fully-static ``input_spec`` the function is AOT-lowered and
+    compiled immediately (the reference's program-capture step); dynamic
+    dims fall back to lazy shape-specialised jit with a warning.
+    """
     def deco(fn):
         jitted = jax.jit(fn, donate_argnums=donate_argnums,
                          static_argnums=static_argnums)
         functools.update_wrapper(jitted, fn, updated=[])
+        if input_spec:
+            specs = [s if isinstance(s, InputSpec) else InputSpec(*s)
+                     for s in input_spec]
+            if all(s.is_static() for s in specs):
+                jitted.lower(*[s.to_shape_struct() for s in specs]).compile()
+            else:
+                import warnings
+                warnings.warn(
+                    "to_static input_spec has dynamic dims; XLA requires "
+                    "static shapes — compiling lazily per concrete shape "
+                    "instead", stacklevel=2)
         return jitted
     return deco(function) if function is not None else deco
 
@@ -238,7 +271,10 @@ class TrainStep:
         if scaler_state is not None:
             new_state["scaler"] = {k: scaler_state[k]
                                    for k in ("scale", "good_steps", "bad_steps")}
-        metrics = {"loss": loss, "lr": _current_lr(self.optimizer, state)}
+        # lr from the OPTIMIZER's step counter (it does not advance on
+        # overflow-skipped steps, unlike the outer step counter)
+        metrics = {"loss": loss,
+                   "lr": _current_lr(self.optimizer, {"step": state["opt"]["step"]})}
         if self.extra_metrics is not None:
             metrics.update(self.extra_metrics(new_state, batch))
         return new_state, metrics

@@ -1,0 +1,17 @@
+"""In-repo model zoo (BASELINE.json configs).
+
+- llama: Llama-2 family (7B/13B/70B + small configs) — flagship
+- gpt: GPT/ERNIE-style decoder (13B TP+PP config)
+- moe: Mixtral-style mixture-of-experts (expert parallel)
+- sdxl_unet: Stable-Diffusion-XL UNet (conv/GroupNorm/attention breadth)
+"""
+
+from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel, PRESETS,  # noqa: F401
+                    causal_lm_loss, llama)
+
+
+def __getattr__(name):
+    import importlib
+    if name in ("gpt", "moe", "sdxl_unet"):
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(name)

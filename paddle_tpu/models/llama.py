@@ -1,0 +1,231 @@
+"""Llama model family (flagship; BASELINE.json configs[0]/[4]).
+
+Reference capability: PaddleNLP's LlamaForCausalLM expressed with the core
+framework's fleet layers (the reference core provides the layers; the model
+zoo lives in PaddleNLP — SURVEY.md §0 scope note).  Built here TPU-first:
+
+- tensor parallel via ColumnParallel/RowParallel/VocabParallelEmbedding
+  partition specs ("mp" axis), degrading to serial when mp=1;
+- Megatron-SP sequence sharding of norm/residual activations (sep §5.7-2);
+- GQA + RoPE + flash-attention dispatch (Pallas kernel on TPU);
+- optional per-layer rematerialisation;
+- everything jit-compiles into one XLA program via TrainStep.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer
+from ..distributed.mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
+                                     RowParallelLinear, VocabParallelEmbedding,
+                                     constrain)
+from ..distributed.recompute import RecomputeWrapper
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    initializer_range: float = 0.02
+    use_recompute: bool = False
+    recompute_policy: Optional[str] = None  # full recompute; "dots" saves s×s attn probs = OOM at long seq
+    sequence_parallel: bool = False
+    dtype: str = "float32"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    def num_params(self) -> int:
+        h, i, v, l = (self.hidden_size, self.intermediate_size,
+                      self.vocab_size, self.num_hidden_layers)
+        kvh = self.num_key_value_heads * self.head_dim
+        per_layer = h * h + 2 * h * kvh + h * h + 3 * h * i + 2 * h
+        embed = v * h * (1 if self.tie_word_embeddings else 2)
+        return l * per_layer + embed + h
+
+
+PRESETS = {
+    "llama2-7b": LlamaConfig(),
+    "llama2-13b": LlamaConfig(hidden_size=5120, intermediate_size=13824,
+                              num_hidden_layers=40, num_attention_heads=40,
+                              num_key_value_heads=40),
+    "llama2-70b": LlamaConfig(hidden_size=8192, intermediate_size=28672,
+                              num_hidden_layers=80, num_attention_heads=64,
+                              num_key_value_heads=8),
+    "llama-1b": LlamaConfig(hidden_size=2048, intermediate_size=5504,
+                            num_hidden_layers=16, num_attention_heads=16,
+                            num_key_value_heads=16, vocab_size=32000),
+    "llama-350m": LlamaConfig(hidden_size=1024, intermediate_size=2816,
+                              num_hidden_layers=24, num_attention_heads=16,
+                              num_key_value_heads=16),
+    "tiny": LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        num_key_value_heads=2, max_position_embeddings=128),
+}
+
+
+class LlamaRMSNorm(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.eps = cfg.rms_norm_eps
+        self.weight = self.create_parameter(
+            (cfg.hidden_size,), default_initializer=I.Constant(1.0))
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self.eps)
+
+
+class LlamaAttention(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        h, hd = cfg.hidden_size, cfg.head_dim
+        kv = cfg.num_key_value_heads * hd
+        init = I.Normal(0.0, cfg.initializer_range)
+        sp = cfg.sequence_parallel
+        self.q_proj = ColumnParallelLinear(h, h, has_bias=False,
+                                           weight_attr=None, sequence_parallel=sp)
+        self.k_proj = ColumnParallelLinear(h, kv, has_bias=False, sequence_parallel=sp)
+        self.v_proj = ColumnParallelLinear(h, kv, has_bias=False, sequence_parallel=sp)
+        self.o_proj = RowParallelLinear(h, h, has_bias=False, sequence_parallel=sp)
+
+    def forward(self, x, cos, sin, attn_mask=None):
+        cfg = self.cfg
+        b, s = x.shape[:2]
+        q = self.q_proj(x).reshape(b, s, cfg.num_attention_heads, cfg.head_dim)
+        k = self.k_proj(x).reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
+        v = self.v_proj(x).reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
+        # heads are mp-sharded (they came from column-parallel projections)
+        q = constrain(q, ("dp", "sharding"), None, "mp", None)
+        k = constrain(k, ("dp", "sharding"), None, "mp", None)
+        v = constrain(v, ("dp", "sharding"), None, "mp", None)
+        q, k = F.apply_rotary_pos_emb(q, k, cos, sin)
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                             is_causal=attn_mask is None)
+        out = out.reshape(b, s, cfg.num_attention_heads * cfg.head_dim)
+        return self.o_proj(out)
+
+
+class LlamaMLP(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        h, i = cfg.hidden_size, cfg.intermediate_size
+        sp = cfg.sequence_parallel
+        self.gate_proj = ColumnParallelLinear(h, i, has_bias=False, sequence_parallel=sp)
+        self.up_proj = ColumnParallelLinear(h, i, has_bias=False, sequence_parallel=sp)
+        self.down_proj = RowParallelLinear(i, h, has_bias=False, sequence_parallel=sp)
+
+    def forward(self, x):
+        return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = LlamaRMSNorm(cfg)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = LlamaRMSNorm(cfg)
+        self.mlp = LlamaMLP(cfg)
+
+    def forward(self, x, cos, sin, attn_mask=None):
+        x = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_mask)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embed_tokens = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
+        from ..nn.layers_common import LayerList
+        layers = []
+        for _ in range(cfg.num_hidden_layers):
+            layer = LlamaDecoderLayer(cfg)
+            if cfg.use_recompute:
+                layer = RecomputeWrapper(layer, policy=cfg.recompute_policy)
+            layers.append(layer)
+        self.layers = LayerList(layers)
+        self.norm = LlamaRMSNorm(cfg)
+
+    def forward(self, input_ids, attn_mask=None, position_ids=None):
+        cfg = self.cfg
+        x = self.embed_tokens(input_ids)
+        cos, sin = F.rope_cos_sin(input_ids.shape[1], cfg.head_dim,
+                                  base=cfg.rope_theta, dtype=x.dtype,
+                                  position_ids=position_ids)
+        for layer in self.layers:
+            x = layer(x, cos, sin, attn_mask)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.model = LlamaModel(cfg)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = ColumnParallelLinear(cfg.hidden_size, cfg.vocab_size,
+                                                has_bias=False)
+        self.loss_fn = ParallelCrossEntropy(ignore_index=-100)
+
+    def logits(self, hidden):
+        if self.cfg.tie_word_embeddings:
+            w = self.model.embed_tokens.weight  # (vocab, hidden), mp on vocab
+            logits = hidden @ w.T
+            return constrain(logits, ("dp", "sharding"), None, "mp")
+        return self.lm_head(hidden)
+
+    def forward(self, input_ids, labels=None, attn_mask=None, position_ids=None):
+        hidden = self.model(input_ids, attn_mask, position_ids)
+        logits = self.logits(hidden)
+        if labels is None:
+            return logits
+        loss = self.loss_fn(logits.astype(jnp.float32), labels)
+        valid = (labels != -100)
+        return jnp.sum(loss * valid) / jnp.maximum(jnp.sum(valid), 1)
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0):
+        """Greedy/temperature sampling (full-recompute decode; KV-cache
+        decode is the inference milestone)."""
+        ids = input_ids
+        for _ in range(max_new_tokens):
+            logits = self(ids)[:, -1]
+            if temperature > 0:
+                from ..core import random as prandom
+                nxt = jax.random.categorical(prandom.next_key("gen"),
+                                             logits / temperature, axis=-1)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+        return ids
+
+
+def llama(name_or_config="tiny", **overrides) -> LlamaForCausalLM:
+    cfg = (PRESETS[name_or_config] if isinstance(name_or_config, str)
+           else name_or_config)
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    return LlamaForCausalLM(cfg)
+
+
+def causal_lm_loss(model, batch):
+    """Standard loss_fn for TrainStep."""
+    return model(batch["input_ids"], labels=batch["labels"])

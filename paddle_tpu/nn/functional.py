@@ -91,9 +91,20 @@ def one_hot(x, num_classes, dtype=jnp.float32):
     return jax.nn.one_hot(x, num_classes, dtype=dtype)
 
 
+_warned_const_dropout = [False]
+
+
 def dropout(x, p=0.5, training=True, mode="upscale_in_train", rng_key=None):
     if not training or p == 0.0:
         return x
+    if rng_key is None and not prandom.in_rng_scope() and \
+            isinstance(x, jax.core.Tracer) and not _warned_const_dropout[0]:
+        import warnings
+        warnings.warn(
+            "dropout traced under jit without an RNG scope: the mask will be "
+            "CONSTANT across calls. Use jit.TrainStep / functional_call(..., "
+            "rngs=key), or pass rng_key explicitly.", stacklevel=2)
+        _warned_const_dropout[0] = True
     key = rng_key if rng_key is not None else prandom.dropout_key()
     keep = 1.0 - p
     mask = jax.random.bernoulli(key, keep, x.shape)

@@ -106,7 +106,13 @@ class Layer:
         key = prandom.next_key("param_init")
         value = init(key, tuple(shape), dtype)
         meta = ParamMeta(trainable=trainable, partition=partition, is_bias=is_bias)
-        self._pending_params[id(value)] = meta
+        # keyed by id but guarded by a weakref: a discarded staged param's id
+        # can be recycled by CPython — the weakref identity check in
+        # __setattr__ prevents misclassifying an unrelated array
+        import weakref
+        self._pending_params = {k: v for k, v in self._pending_params.items()
+                                if v[0]() is not None}  # purge dead entries
+        self._pending_params[id(value)] = (weakref.ref(value), meta)
         return value
 
     def register_buffer(self, name: str, tensor, persistable: bool = True):
@@ -133,9 +139,10 @@ class Layer:
         if isinstance(value, Layer):
             self._sub_layers[name] = value
             self._parameters.pop(name, None)
-        elif id(value) in self._pending_params:
+        elif id(value) in self._pending_params and \
+                self._pending_params[id(value)][0]() is value:
             self._parameters[name] = value
-            self._param_meta[name] = self._pending_params.pop(id(value))
+            self._param_meta[name] = self._pending_params.pop(id(value))[1]
             self._sub_layers.pop(name, None)
         elif name in self._parameters:
             # re-assignment of an existing parameter (e.g. set_state_dict)

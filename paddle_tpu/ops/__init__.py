@@ -23,6 +23,15 @@ from . import dispatch  # noqa: F401
 Tensor = jax.Array
 
 
+def _index_dtype(requested="int64"):
+    """Paddle's index dtype is int64; under jax's default x64-disabled config
+    an int64 cast is a warning + silent truncation, so honour the request
+    only when x64 is enabled."""
+    if requested in ("int64", jnp.int64) and not jax.config.jax_enable_x64:
+        return jnp.int32
+    return convert_dtype(requested)
+
+
 # -- creation ---------------------------------------------------------------
 
 def zeros(shape, dtype=None):
@@ -95,7 +104,7 @@ def randint(low, high=None, shape=(1,), dtype="int64"):
     if high is None:
         low, high = 0, low
     return jax.random.randint(_random.next_key("randint"), shape, low, high,
-                              dtype=convert_dtype(dtype))
+                              dtype=_index_dtype(dtype))
 
 
 def uniform(shape, dtype=None, min=-1.0, max=1.0):
@@ -107,7 +116,7 @@ def normal(mean=0.0, std=1.0, shape=(1,)):
 
 
 def randperm(n, dtype="int64"):
-    return jax.random.permutation(_random.next_key("randperm"), n).astype(convert_dtype(dtype))
+    return jax.random.permutation(_random.next_key("randperm"), n).astype(_index_dtype(dtype))
 
 
 def bernoulli(x):
@@ -124,7 +133,7 @@ def multinomial(x, num_samples=1, replacement=False):
     # weighted sample without replacement)
     g = jax.random.gumbel(key, logits.shape)
     _, idx = jax.lax.top_k(logits + g, num_samples)
-    return idx.astype(jnp.int64)
+    return idx.astype(_index_dtype())
 
 
 # -- math -------------------------------------------------------------------
@@ -246,16 +255,16 @@ def median(x, axis=None, keepdim=False):
 
 
 def argmax(x, axis=None, keepdim=False, dtype="int64"):
-    return jnp.argmax(x, axis=axis, keepdims=keepdim).astype(convert_dtype(dtype))
+    return jnp.argmax(x, axis=axis, keepdims=keepdim).astype(_index_dtype(dtype))
 
 
 def argmin(x, axis=None, keepdim=False, dtype="int64"):
-    return jnp.argmin(x, axis=axis, keepdims=keepdim).astype(convert_dtype(dtype))
+    return jnp.argmin(x, axis=axis, keepdims=keepdim).astype(_index_dtype(dtype))
 
 
 def argsort(x, axis=-1, descending=False):
     idx = jnp.argsort(x, axis=axis, descending=descending)
-    return idx.astype(jnp.int64)
+    return idx.astype(_index_dtype())
 
 
 def sort(x, axis=-1, descending=False):
@@ -268,7 +277,7 @@ def topk(x, k, axis=-1, largest=True, sorted=True):
         vals = -vals
     else:
         vals, idx = jax.lax.top_k(jnp.moveaxis(x, axis, -1), k)
-    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis).astype(jnp.int64)
+    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis).astype(_index_dtype())
 
 
 def cumsum(x, axis=None, dtype=None):
@@ -328,9 +337,9 @@ def mode(x, axis=-1, keepdim=False):
     # run-length trick: the mode of each lane is the value with the longest
     # equal-run in the sorted lane
     n = x.shape[axis]
-    eq = jnp.cumsum(jnp.concatenate([jnp.zeros_like(jnp.take(sorted_x, [0], axis)),
-                                     (jnp.diff(sorted_x, axis=axis) != 0)], axis=axis),
-                    axis=axis)
+    eq = jnp.cumsum(jnp.concatenate(
+        [jnp.zeros_like(sorted_x[..., :1], dtype=jnp.bool_),
+         (jnp.diff(sorted_x, axis=axis) != 0)], axis=axis), axis=axis)
     counts = jax.vmap(lambda e: jnp.bincount(e, length=n))(
         eq.reshape(-1, n).astype(jnp.int32))
     best = jnp.argmax(counts, axis=-1)

@@ -1,0 +1,206 @@
+"""Serial-vs-parallel equivalence tests (the reference's key correctness
+pattern: test/collective/fleet/hybrid_parallel_mp_layers.py — parallel
+numerics must equal the single-process run)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.mp_layers import (ColumnParallelLinear,
+                                              ParallelCrossEntropy,
+                                              RowParallelLinear,
+                                              VocabParallelEmbedding)
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.models.llama import PRESETS, causal_lm_loss, llama
+from paddle_tpu.nn.layer import raw_params
+
+
+@pytest.fixture(autouse=True)
+def reset_fleet():
+    yield
+    fleet._reset()
+
+
+def _init_mp(mp=2, dp=1, sharding=1):
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp,
+                               "sharding_degree": sharding}
+    return fleet.init(is_collective=True, strategy=strategy)
+
+
+class MpBlock(nn.Layer):
+    """Column->Row pair, the canonical Megatron block."""
+
+    def __init__(self):
+        super().__init__()
+        self.col = ColumnParallelLinear(16, 32, has_bias=True)
+        self.row = RowParallelLinear(32, 16, has_bias=True)
+
+    def forward(self, x):
+        return self.row(nn.functional.relu(self.col(x)))
+
+
+def test_topology_mesh_shape():
+    hcg = _init_mp(mp=2, dp=2, sharding=2)
+    assert hcg.mesh.shape["mp"] == 2
+    assert hcg.mesh.shape["dp"] == 2
+    assert hcg.mesh.shape["sharding"] == 2
+    assert hcg.get_model_parallel_world_size() == 2
+    assert hcg.topology.world_size == 8
+    assert sorted(hcg.active_axes()) == ["dp", "mp", "sharding"]
+
+
+def test_mp_forward_matches_serial():
+    # build serial weights first (no mesh)
+    pt.seed(0)
+    serial = MpBlock()
+    sd = {k: np.asarray(v) for k, v in serial.state_dict().items()}
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((4, 16)).astype(np.float32))
+    # Note: 2-D activations — constrain specs in mp_layers expect 3-D [b,s,h]
+    x3 = x[:, None, :]
+    y_serial = serial(x3)
+
+    hcg = _init_mp(mp=2)
+    parallel = MpBlock()
+    parallel.set_state_dict(sd)
+    step_fn = jax.jit(lambda p, xx: pt.nn.functional_call(parallel, p, xx))
+    with hcg.mesh:
+        params = {k: jax.device_put(v) for k, v in raw_params(parallel).items()}
+        y_par = step_fn(params, x3)
+    np.testing.assert_allclose(np.asarray(y_par), np.asarray(y_serial),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_vocab_parallel_embedding_and_ce():
+    pt.seed(0)
+    emb_serial = VocabParallelEmbedding(64, 16)
+    w = np.asarray(emb_serial.weight)
+    ids = jnp.asarray([[1, 5, 63, 0]])
+    out_serial = emb_serial(ids)
+
+    hcg = _init_mp(mp=2)
+    emb_par = VocabParallelEmbedding(64, 16)
+    emb_par.set_state_dict({"weight": w})
+    with hcg.mesh:
+        out_par = jax.jit(lambda p, i: pt.nn.functional_call(emb_par, p, i))(
+            raw_params(emb_par), ids)
+    np.testing.assert_allclose(np.asarray(out_par), np.asarray(out_serial),
+                               rtol=1e-6)
+
+    # vocab-parallel CE == serial CE
+    logits = jnp.asarray(np.random.default_rng(1).standard_normal(
+        (2, 4, 64)).astype(np.float32))
+    labels = jnp.asarray([[1, 2, 3, 4], [5, 6, 7, 8]])
+    ce = ParallelCrossEntropy()
+    serial_loss = nn.functional.cross_entropy(logits, labels, reduction="none")
+    with hcg.mesh:
+        par_loss = jax.jit(lambda l, y: ce(l, y))(logits, labels)
+    np.testing.assert_allclose(np.asarray(par_loss), np.asarray(serial_loss),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_llama_tiny_forward_and_learn():
+    pt.seed(0)
+    model = llama("tiny")
+    batch = {
+        "input_ids": jnp.asarray(np.random.default_rng(0).integers(0, 256, (2, 32))),
+        "labels": jnp.asarray(np.random.default_rng(0).integers(0, 256, (2, 32))),
+    }
+    opt = optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    step = TrainStep(model, causal_lm_loss, opt)
+    state = step.init_state(0)
+    losses = []
+    for _ in range(30):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_llama_tp_matches_serial():
+    """One full train step under mp=2+dp=2 == serial step (same init)."""
+    pt.seed(0)
+    serial_model = llama("tiny")
+    sd = {k: np.asarray(v) for k, v in serial_model.state_dict().items()}
+    batch = {
+        "input_ids": jnp.asarray(np.random.default_rng(0).integers(0, 256, (4, 16))),
+        "labels": jnp.asarray(np.random.default_rng(1).integers(0, 256, (4, 16))),
+    }
+    opt_s = optimizer.AdamW(learning_rate=1e-2, parameters=serial_model.parameters())
+    step_s = TrainStep(serial_model, causal_lm_loss, opt_s)
+    state_s = step_s.init_state(0)
+    state_s, m_s = step_s(state_s, batch)
+    state_s, m_s2 = step_s(state_s, batch)
+
+    hcg = _init_mp(mp=2, dp=2)
+    par_model = llama("tiny")
+    par_model.set_state_dict(sd)
+    opt_p = optimizer.AdamW(learning_rate=1e-2, parameters=par_model.parameters())
+    opt_p = fleet.distributed_optimizer(opt_p)
+    step_p = TrainStep(par_model, causal_lm_loss, opt_p, mesh=hcg.mesh)
+    state_p = step_p.init_state(0)
+    state_p, m_p = step_p(state_p, batch)
+    state_p, m_p2 = step_p(state_p, batch)
+
+    np.testing.assert_allclose(float(m_p["loss"]), float(m_s["loss"]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(m_p2["loss"]), float(m_s2["loss"]),
+                               rtol=1e-4)
+    # spot-check a sharded weight stayed numerically identical
+    k = "model.layers.0.self_attn.q_proj.weight"
+    np.testing.assert_allclose(np.asarray(state_p["params"][k]),
+                               np.asarray(state_s["params"][k]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_llama_sequence_parallel_matches():
+    pt.seed(0)
+    serial_model = llama("tiny")
+    sd = {k: np.asarray(v) for k, v in serial_model.state_dict().items()}
+    batch = {
+        "input_ids": jnp.asarray(np.random.default_rng(0).integers(0, 256, (2, 32))),
+        "labels": jnp.asarray(np.random.default_rng(1).integers(0, 256, (2, 32))),
+    }
+    out_serial = pt.nn.functional_call(serial_model, raw_params(serial_model),
+                                       batch["input_ids"],
+                                       labels=batch["labels"])
+
+    hcg = _init_mp(mp=2)
+    sp_model = llama("tiny", sequence_parallel=True)
+    sp_model.set_state_dict(sd)
+    with hcg.mesh:
+        out_sp = jax.jit(lambda p, b: pt.nn.functional_call(
+            sp_model, p, b["input_ids"], labels=b["labels"]))(
+                raw_params(sp_model), batch)
+    np.testing.assert_allclose(float(out_sp), float(out_serial), rtol=2e-5)
+
+
+def test_zero_sharding_specs():
+    """ZeRO-1: optimizer state sharded over data axes; ZeRO-3: params too."""
+    hcg = _init_mp(mp=1, dp=2, sharding=2)
+    model = llama("tiny")
+    opt = optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    step = TrainStep(model, causal_lm_loss, opt, mesh=hcg.mesh, zero_stage=1)
+    state = step.init_state(0)
+    # a moment slot should be sharded over dp/sharding on dim 0
+    m1 = state["opt"]["moment1"]["model.layers.0.mlp.gate_proj.weight"]
+    assert "dp" in str(m1.sharding.spec) or "sharding" in str(m1.sharding.spec)
+    # params not sharded over the data axes at stage 1 (mp annotation stays)
+    p = state["params"]["model.layers.0.mlp.gate_proj.weight"]
+    spec_str = str(p.sharding.spec)
+    assert "dp" not in spec_str and "sharding" not in spec_str
+
+    step3 = TrainStep(model, causal_lm_loss, opt, mesh=hcg.mesh, zero_stage=3)
+    state3 = step3.init_state(0)
+    p3 = state3["params"]["model.layers.0.mlp.gate_proj.weight"]
+    assert any(e is not None for e in p3.sharding.spec)
+    # and it still trains
+    batch = {
+        "input_ids": jnp.asarray(np.random.default_rng(0).integers(0, 256, (4, 16))),
+        "labels": jnp.asarray(np.random.default_rng(1).integers(0, 256, (4, 16))),
+    }
+    state3, m = step3(state3, batch)
+    assert np.isfinite(float(m["loss"]))
