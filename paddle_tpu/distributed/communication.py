@@ -239,9 +239,11 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     if _axis_bound(axes):
         idx = jax.lax.axis_index(a)
         return jax.lax.dynamic_index_in_dim(stacked, idx, axis=0, keepdims=False)
-    n = Group(axes).nranks
-    return _eager_wrap(lambda v: v[0], stacked, axes,
-                       in_spec=P(a), out_specs_fn=lambda s: P())
+    # eager on global arrays: a per-rank-different result IS a sharded array —
+    # return ``stacked`` sharded over the axis on dim 0 (rank i's shard is
+    # its scattered value); src is irrelevant since global values agree
+    mesh = Group(axes).mesh
+    return jax.device_put(stacked, NamedSharding(mesh, P(a)))
 
 
 def send(tensor, dst, group=None):

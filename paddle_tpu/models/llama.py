@@ -81,6 +81,12 @@ PRESETS = {
 }
 
 
+def _weight_attr(cfg: LlamaConfig):
+    # reference Llama init: Normal(0, initializer_range) on every projection
+    from ..nn.layer import ParamAttr
+    return ParamAttr(initializer=I.Normal(0.0, cfg.initializer_range))
+
+
 class LlamaRMSNorm(Layer):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
@@ -98,13 +104,16 @@ class LlamaAttention(Layer):
         self.cfg = cfg
         h, hd = cfg.hidden_size, cfg.head_dim
         kv = cfg.num_key_value_heads * hd
-        init = I.Normal(0.0, cfg.initializer_range)
+        attr = _weight_attr(cfg)
         sp = cfg.sequence_parallel
         self.q_proj = ColumnParallelLinear(h, h, has_bias=False,
-                                           weight_attr=None, sequence_parallel=sp)
-        self.k_proj = ColumnParallelLinear(h, kv, has_bias=False, sequence_parallel=sp)
-        self.v_proj = ColumnParallelLinear(h, kv, has_bias=False, sequence_parallel=sp)
-        self.o_proj = RowParallelLinear(h, h, has_bias=False, sequence_parallel=sp)
+                                           weight_attr=attr, sequence_parallel=sp)
+        self.k_proj = ColumnParallelLinear(h, kv, has_bias=False,
+                                           weight_attr=attr, sequence_parallel=sp)
+        self.v_proj = ColumnParallelLinear(h, kv, has_bias=False,
+                                           weight_attr=attr, sequence_parallel=sp)
+        self.o_proj = RowParallelLinear(h, h, has_bias=False,
+                                        weight_attr=attr, sequence_parallel=sp)
 
     def forward(self, x, cos, sin, attn_mask=None):
         cfg = self.cfg
@@ -127,10 +136,14 @@ class LlamaMLP(Layer):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
         h, i = cfg.hidden_size, cfg.intermediate_size
+        attr = _weight_attr(cfg)
         sp = cfg.sequence_parallel
-        self.gate_proj = ColumnParallelLinear(h, i, has_bias=False, sequence_parallel=sp)
-        self.up_proj = ColumnParallelLinear(h, i, has_bias=False, sequence_parallel=sp)
-        self.down_proj = RowParallelLinear(i, h, has_bias=False, sequence_parallel=sp)
+        self.gate_proj = ColumnParallelLinear(h, i, has_bias=False,
+                                              weight_attr=attr, sequence_parallel=sp)
+        self.up_proj = ColumnParallelLinear(h, i, has_bias=False,
+                                            weight_attr=attr, sequence_parallel=sp)
+        self.down_proj = RowParallelLinear(i, h, has_bias=False,
+                                           weight_attr=attr, sequence_parallel=sp)
 
     def forward(self, x):
         return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
@@ -183,7 +196,8 @@ class LlamaForCausalLM(Layer):
         self.model = LlamaModel(cfg)
         if not cfg.tie_word_embeddings:
             self.lm_head = ColumnParallelLinear(cfg.hidden_size, cfg.vocab_size,
-                                                has_bias=False)
+                                                has_bias=False,
+                                                weight_attr=_weight_attr(cfg))
         self.loss_fn = ParallelCrossEntropy(ignore_index=-100)
 
     def logits(self, hidden):

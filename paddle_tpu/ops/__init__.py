@@ -677,3 +677,11 @@ _EXCLUDE = {"jax", "jnp", "np", "dispatch", "Optional", "Sequence", "Union",
             "Tensor", "convert_dtype", "get_default_dtype", "to_tensor",
             "annotations"}
 __all__ = [_n for _n in dir() if not _n.startswith("_") and _n not in _EXCLUDE]
+
+# Register Pallas TPU kernels into the dispatch table (no-op off-TPU: the
+# registry gates on the active backend at call time).
+try:
+    from . import pallas as _pallas_kernels  # noqa: F401
+except Exception as _e:  # pallas unavailable (e.g. minimal jax build)
+    import warnings as _warnings
+    _warnings.warn(f"pallas kernel pack not loaded: {_e}")

@@ -1,0 +1,26 @@
+"""Pallas TPU kernel pack (reference: paddle/phi/kernels/fusion/gpu/).
+
+Registers kernels into the ops.dispatch registry; callers always have an
+XLA fallback so CPU tests remain authoritative for numerics.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .. import dispatch
+from . import flash_attention as _fa
+
+
+def _xla_fallback(q, k, v, causal, scale):
+    from ...nn import functional as F
+    return F._xla_attention(q, k, v, is_causal=causal, scale=scale)
+
+
+def _flash_attention_dispatch(q, k, v, causal=False, scale=None):
+    if not _fa.supported(q, k, v):
+        return _xla_fallback(q, k, v, causal, scale)
+    return _fa.flash_attention(q, k, v, causal=causal, scale=scale)
+
+
+dispatch.register("flash_attention", _flash_attention_dispatch, platform="tpu")

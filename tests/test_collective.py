@@ -128,3 +128,11 @@ def test_shard_tensor_and_reshard(mesh8):
     assert "dp" in str(sharded.sharding.spec)
     back = dist.reshard(sharded, mesh8.mesh, [dist.Replicate(), dist.Replicate()])
     np.testing.assert_allclose(np.asarray(back), np.asarray(x))
+
+
+def test_eager_scatter_returns_sharded(mesh8):
+    x = jnp.arange(8.0).reshape(4, 2)
+    out = dist.scatter(x, src=0, group=dist.new_group("dp"))
+    assert out.shape == (4, 2)
+    assert "dp" in str(out.sharding.spec)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))

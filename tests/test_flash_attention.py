@@ -1,0 +1,127 @@
+"""Flash-attention kernel numerics on CPU via the Pallas interpreter
+(authoritative TPU runs happen in verify/bench; these keep CI coverage)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.ops.pallas.flash_attention as fa
+
+
+@pytest.fixture(autouse=True)
+def interpret_mode(monkeypatch):
+    # run pallas_call in interpreter mode on CPU
+    import jax.experimental.pallas as pl
+    real_call = pl.pallas_call
+    monkeypatch.setattr(pl, "pallas_call",
+                        functools.partial(real_call, interpret=True))
+    yield
+
+
+def _oracle(q, k, v, causal):
+    q64, k64, v64 = [np.asarray(t, np.float64) for t in (q, k, v)]
+    b, s, h, d = q64.shape
+    hkv = k64.shape[2]
+    if hkv != h:
+        k64 = np.repeat(k64, h // hkv, axis=2)
+        v64 = np.repeat(v64, h // hkv, axis=2)
+    logits = np.einsum("bqhd,bkhd->bhqk", q64, k64) / np.sqrt(d)
+    if causal:
+        m = np.tril(np.ones((s, s), bool))
+        logits = np.where(m, logits, -np.inf)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, v64)
+
+
+@pytest.mark.parametrize("b,s,h,hkv,d,causal", [
+    (1, 128, 2, 2, 32, True),
+    (2, 64, 4, 2, 16, True),
+    (1, 128, 2, 2, 32, False),
+])
+def test_flash_fwd_matches_oracle(rng, b, s, h, hkv, d, causal):
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)).astype(np.float32)) * 0.5
+    k = jnp.asarray(rng.standard_normal((b, s, hkv, d)).astype(np.float32)) * 0.5
+    v = jnp.asarray(rng.standard_normal((b, s, hkv, d)).astype(np.float32))
+    out = fa.flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    ref = _oracle(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-5)
+
+
+def test_flash_bwd_matches_xla_grads(rng):
+    b, s, h, d = 1, 128, 2, 32
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)).astype(np.float32)) * 0.5
+    k = jnp.asarray(rng.standard_normal((b, s, h, d)).astype(np.float32)) * 0.5
+    v = jnp.asarray(rng.standard_normal((b, s, h, d)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((b, s, h, d)).astype(np.float32))
+
+    from paddle_tpu.nn.functional import _xla_attention
+
+    def loss_fa(q, k, v):
+        return (fa.flash_attention(q, k, v, causal=True, block_q=64,
+                                   block_k=64) * w).sum()
+
+    def loss_ref(q, k, v):
+        return (_xla_attention(q, k, v, is_causal=True) * w).sum()
+
+    g_fa = jax.grad(loss_fa, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b_ in zip("qkv", g_fa, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-3,
+                                   atol=1e-4, err_msg=f"d{name}")
+
+
+def test_flash_gqa_bwd(rng):
+    b, s, h, hkv, d = 1, 64, 4, 2, 16
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((b, s, hkv, d)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((b, s, hkv, d)).astype(np.float32))
+
+    from paddle_tpu.nn.functional import _xla_attention
+
+    g_fa = jax.grad(lambda *a: fa.flash_attention(*a, causal=True, block_q=32,
+                                                  block_k=32).sum(),
+                    argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(lambda *a: _xla_attention(*a, is_causal=True).sum(),
+                     argnums=(0, 1, 2))(q, k, v)
+    for name, a, b_ in zip("qkv", g_fa, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-3,
+                                   atol=1e-4, err_msg=f"d{name}")
+
+
+def test_block_picker():
+    assert fa._pick_block(2048, 512) == 512
+    assert fa._pick_block(100, 512) == 100  # fits whole
+    assert fa._pick_block(100, 64) == 4     # halves until it divides
+    assert fa._pick_block(8, 512) == 8
+
+
+def test_causal_bottom_right_alignment(rng):
+    """sq != sk: causal mask must align bottom-right like the XLA fallback
+    (decode-with-cache shape)."""
+    from paddle_tpu.nn.functional import _xla_attention
+    b, sq, sk, h, d = 1, 32, 64, 2, 16
+    q = jnp.asarray(rng.standard_normal((b, sq, h, d)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((b, sk, h, d)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((b, sk, h, d)).astype(np.float32))
+    out = fa.flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+    ref = _xla_attention(q, k, v, is_causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4,
+                               atol=1e-5)
+    # grads too
+    g = jax.grad(lambda *a: fa.flash_attention(*a, causal=True, block_q=16,
+                                               block_k=16).sum(),
+                 argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: _xla_attention(*a, is_causal=True).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for name, a, b_ in zip("qkv", g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-3,
+                                   atol=1e-4, err_msg=f"d{name}")
+
+
+def test_supported_rejects_non_4d():
+    assert not fa.supported(jnp.zeros((4, 8, 16)), jnp.zeros((4, 8, 16)),
+                            jnp.zeros((4, 8, 16)))
