@@ -46,6 +46,9 @@ class LlamaConfig:
     use_recompute: bool = False
     recompute_policy: Optional[str] = None  # full recompute; "dots" saves s×s attn probs = OOM at long seq
     sequence_parallel: bool = False
+    pipeline_stages: int = 1        # >1: stacked pp-sharded decoder body
+    num_microbatches: Optional[int] = None  # default: pipeline_stages
+    virtual_pp_degree: int = 1      # interleaved-schedule chunks per stage
     dtype: str = "float32"
 
     @property
@@ -169,13 +172,26 @@ class LlamaModel(Layer):
         self.cfg = cfg
         self.embed_tokens = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
         from ..nn.layers_common import LayerList
-        layers = []
-        for _ in range(cfg.num_hidden_layers):
-            layer = LlamaDecoderLayer(cfg)
-            if cfg.use_recompute:
-                layer = RecomputeWrapper(layer, policy=cfg.recompute_policy)
-            layers.append(layer)
-        self.layers = LayerList(layers)
+        if cfg.pipeline_stages > 1:
+            # pipeline-parallel body: per-layer params stacked and sharded
+            # over the pp mesh axis (distributed/pipeline.py)
+            from ..distributed.pipeline import StackedPipelineStages
+            self.layers = StackedPipelineStages(
+                lambda: LlamaDecoderLayer(cfg), cfg.num_hidden_layers,
+                num_stages=cfg.pipeline_stages,
+                num_microbatches=cfg.num_microbatches,
+                num_virtual_pipeline_stages=cfg.virtual_pp_degree,
+                use_recompute=cfg.use_recompute,
+                recompute_policy=cfg.recompute_policy,
+                extra_is_batched=(False, False, True))
+        else:
+            layers = []
+            for _ in range(cfg.num_hidden_layers):
+                layer = LlamaDecoderLayer(cfg)
+                if cfg.use_recompute:
+                    layer = RecomputeWrapper(layer, policy=cfg.recompute_policy)
+                layers.append(layer)
+            self.layers = LayerList(layers)
         self.norm = LlamaRMSNorm(cfg)
 
     def forward(self, input_ids, attn_mask=None, position_ids=None):
@@ -184,8 +200,11 @@ class LlamaModel(Layer):
         cos, sin = F.rope_cos_sin(input_ids.shape[1], cfg.head_dim,
                                   base=cfg.rope_theta, dtype=x.dtype,
                                   position_ids=position_ids)
-        for layer in self.layers:
-            x = layer(x, cos, sin, attn_mask)
+        if cfg.pipeline_stages > 1:
+            x = self.layers(x, cos, sin, attn_mask)
+        else:
+            for layer in self.layers:
+                x = layer(x, cos, sin, attn_mask)
         return self.norm(x)
 
 

@@ -115,6 +115,15 @@ class Layer:
         self._pending_params[id(value)] = (weakref.ref(value), meta)
         return value
 
+    def _register_parameter(self, name: str, value, meta: "ParamMeta"):
+        """Register an already-materialised array as a parameter without
+        drawing from the init RNG stream (used when hoisting/stacking
+        existing parameters, e.g. pipeline stage stacking)."""
+        self._parameters[name] = value
+        self._param_meta[name] = meta
+        object.__setattr__(self, name, value)
+        return value
+
     def register_buffer(self, name: str, tensor, persistable: bool = True):
         self._buffers[name] = tensor
         if not persistable:

@@ -1,0 +1,250 @@
+"""Pipeline-parallel tests (SURVEY.md §4: parallel == serial numerics).
+
+Reference test pattern: test/collective/fleet/hybrid_parallel_pp_layer.py —
+train a small model pipelined and compare against the single-process run.
+Here the 8-device CPU mesh replaces the multi-process NCCL rig.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.pipeline import (LayerDesc, PipelineLayer,
+                                             SharedLayerDesc,
+                                             StackedPipelineStages)
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.models.llama import causal_lm_loss, llama
+from paddle_tpu.nn.layer import functional_call, raw_params
+
+
+@pytest.fixture(autouse=True)
+def _fleet_reset():
+    yield
+    fleet._reset()
+
+
+class Block(nn.Layer):
+    """Tiny homogeneous block for engine-level tests."""
+
+    def __init__(self, width=16):
+        super().__init__()
+        self.fc = nn.Linear(width, width)
+
+    def forward(self, x):
+        return x + jax.nn.tanh(self.fc(x))
+
+
+def _serial_blocks(n, width, seed):
+    pt.seed(seed)
+    return [Block(width) for _ in range(n)]
+
+
+def test_stacked_matches_serial_no_mesh():
+    """pp=1 scan path == Python-loop application, identical init numerics."""
+    pt.seed(7)
+    stacked = StackedPipelineStages(lambda: Block(16), 4, num_stages=1)
+    layers = _serial_blocks(4, 16, 7)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(8, 16)),
+                    jnp.float32)
+    want = x
+    for l in layers:
+        want = l(want)
+    got = stacked(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_pipelined_matches_serial_numerics():
+    """GPipe schedule over a pp=4 mesh == serial forward."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"pp_degree": 4, "dp_degree": 2}
+    fleet.init(strategy=strategy)
+    pt.seed(7)
+    stacked = StackedPipelineStages(lambda: Block(16), 8, num_stages=4,
+                                    num_microbatches=4)
+    layers = _serial_blocks(8, 16, 7)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(8, 16)),
+                    jnp.float32)
+    want = x
+    for l in layers:
+        want = l(want)
+    with fleet.get_hybrid_communicate_group().mesh:
+        got = jax.jit(lambda p, x: functional_call(stacked, p, x))(
+            raw_params(stacked), x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_virtual_pipeline_chunks():
+    """Interleaved layout (2 chunks/stage) == serial forward."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"pp_degree": 2}
+    fleet.init(strategy=strategy)
+    pt.seed(3)
+    stacked = StackedPipelineStages(lambda: Block(8), 8, num_stages=2,
+                                    num_microbatches=2,
+                                    num_virtual_pipeline_stages=2)
+    layers = _serial_blocks(8, 8, 3)
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(4, 8)), jnp.float32)
+    want = x
+    for l in layers:
+        want = l(want)
+    with fleet.get_hybrid_communicate_group().mesh:
+        got = jax.jit(lambda p, x: functional_call(stacked, p, x))(
+            raw_params(stacked), x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_llama_pp_train_matches_single_device():
+    """Full TrainStep on a pp=2 x dp=2 x mp=2 mesh: loss trajectory matches
+    the unsharded single-program run (the reference's key invariant)."""
+    ids = np.random.default_rng(0).integers(0, 256, size=(4, 32))
+    batch = {"input_ids": jnp.asarray(ids, jnp.int32),
+             "labels": jnp.asarray(np.roll(ids, -1, 1), jnp.int32)}
+
+    def run(hybrid, pp_stages):
+        fleet._reset()
+        pt.seed(0)
+        if hybrid:
+            strategy = fleet.DistributedStrategy()
+            strategy.hybrid_configs = hybrid
+            hcg = fleet.init(strategy=strategy)
+            mesh = hcg.mesh
+        else:
+            mesh = None
+        model = llama("tiny", num_hidden_layers=4, pipeline_stages=pp_stages,
+                      num_microbatches=2)
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+        step = TrainStep(model, causal_lm_loss, opt, mesh=mesh)
+        state = step.init_state(seed=0)
+        losses = []
+        for _ in range(3):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+        return losses
+
+    # stacked-serial single device vs pipelined mesh — identical layer math
+    base = run(None, 1)
+    # note: pp model stacks params; serial model must too for identical init
+    base_stacked = run(None, 2)  # pp structure, no mesh: still pipelined sched
+    pp = run({"pp_degree": 2, "dp_degree": 2, "mp_degree": 2}, 2)
+    np.testing.assert_allclose(base_stacked, pp, rtol=2e-4)
+    # and the pipelined schedule itself must match plain serial numerics
+    np.testing.assert_allclose(base, pp, rtol=2e-3)
+
+
+def test_llama_pp_batched_mask_finite_grads():
+    """Per-example boolean masks travel through the shift register; the
+    fill/drain ticks must not poison gradients with NaN (all-masked rows)."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"pp_degree": 2}
+    fleet.init(strategy=strategy)
+    pt.seed(0)
+    model = llama("tiny", num_hidden_layers=2, pipeline_stages=2,
+                  num_microbatches=2)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 256, (4, 16)),
+                      jnp.int32)
+    # per-example causal+padding bool mask [B, 1, S, S]
+    causal = jnp.tril(jnp.ones((16, 16), bool))
+    pad = jnp.asarray(np.random.default_rng(1).random((4, 16)) > 0.2)
+    mask = causal[None, None] & pad[:, None, None, :]
+    # keep the diagonal: a fully-masked row is NaN in any execution path
+    mask = mask | jnp.eye(16, dtype=bool)[None, None]
+    params = raw_params(model)
+
+    def loss(p):
+        return functional_call(
+            model, p, ids, labels=jnp.roll(ids, -1, 1), attn_mask=mask)
+
+    with fleet.get_hybrid_communicate_group().mesh:
+        l, g = jax.jit(jax.value_and_grad(loss))(params)
+    assert np.isfinite(float(l))
+    flat = jax.tree.leaves(g)
+    assert all(bool(jnp.all(jnp.isfinite(x))) for x in flat)
+
+    # broadcast mask [1,1,S,S] must also work (demoted to a static extra)
+    bmask = causal[None, None]
+    with fleet.get_hybrid_communicate_group().mesh:
+        l2 = jax.jit(lambda p: functional_call(
+            model, p, ids, labels=jnp.roll(ids, -1, 1),
+            attn_mask=bmask))(params)
+    assert np.isfinite(float(l2))
+
+
+def test_pipeline_layer_api():
+    """PipelineLayer(LayerDescs) partitions and runs; shared descs tie."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"pp_degree": 2}
+    fleet.init(strategy=strategy)
+    pt.seed(1)
+
+    pipe = PipelineLayer(
+        layers=[LayerDesc(nn.Linear, 8, 16)] +
+               [LayerDesc(Block, 16) for _ in range(4)] +
+               [LayerDesc(nn.Linear, 16, 8)],
+        num_stages=2, num_microbatches=2)
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(4, 8)), jnp.float32)
+    with fleet.get_hybrid_communicate_group().mesh:
+        out = jax.jit(lambda p, x: functional_call(pipe, p, x))(
+            raw_params(pipe), x)
+    assert out.shape == (4, 8)
+    assert jnp.all(jnp.isfinite(out))
+
+    # serial reference with the same seed
+    pt.seed(1)
+    pre = nn.Linear(8, 16)
+    blocks = [Block(16) for _ in range(4)]
+    post = nn.Linear(16, 8)
+    want = post(_chain(blocks, pre(x)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def _chain(layers, x):
+    for l in layers:
+        x = l(x)
+    return x
+
+
+def test_shared_layer_desc_ties_params():
+    class Emb(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.weight = self.create_parameter((8, 4))
+
+        def forward(self, ids):
+            return self.weight[ids]
+
+    def head_fwd(layer, x):
+        return x @ layer.weight.T
+
+    pipe = PipelineLayer(layers=[
+        SharedLayerDesc("emb", Emb),
+        LayerDesc(Block, 4),
+        LayerDesc(Block, 4),
+        SharedLayerDesc("emb", Emb, forward_func=head_fwd),
+    ], num_stages=1)
+    names = [n for n, _ in pipe.named_parameters()]
+    # the shared table appears exactly once in the param pytree
+    assert sum("weight" in n and "fc" not in n for n in names) == 1
+
+    ids = jnp.asarray([0, 3, 5], jnp.int32)
+    out = pipe(ids)
+    assert out.shape == (3, 8)
+
+    # gradient flows from BOTH use sites into the single shared param
+    params = raw_params(pipe)
+    emb_name = next(n for n in params if n.endswith("weight")
+                    and "fc" not in n)
+
+    def loss(p):
+        return functional_call(pipe, p, ids).sum()
+
+    g = jax.grad(lambda p: loss(p))(params)[emb_name]
+    assert float(jnp.abs(g).sum()) > 0
