@@ -228,6 +228,16 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """Scatter: rank i of the group axis receives element i.
+
+    Shape contract differs by execution mode (inherent to SPMD):
+    - inside shard_map (axis bound): returns the LOCAL element, shape
+      ``rest`` — the reference's per-rank view;
+    - eager on global arrays: a per-rank-different value can only exist as
+      a sharded GLOBAL array, so the result keeps the leading group dim,
+      shape ``(n, *rest)`` sharded over the axis (rank i's addressable
+      shard is its element).
+    """
     axes = _axis_tuple(group)
     if axes is None:
         return tensor

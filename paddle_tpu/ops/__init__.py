@@ -682,6 +682,8 @@ __all__ = [_n for _n in dir() if not _n.startswith("_") and _n not in _EXCLUDE]
 # registry gates on the active backend at call time).
 try:
     from . import pallas as _pallas_kernels  # noqa: F401
-except Exception as _e:  # pallas unavailable (e.g. minimal jax build)
+except ImportError as _e:  # pallas unavailable (e.g. minimal jax build);
+    # real defects inside the kernel pack (NameError &c.) must fail loudly,
+    # not silently lose the TPU kernels — hence ImportError only
     import warnings as _warnings
     _warnings.warn(f"pallas kernel pack not loaded: {_e}")

@@ -18,7 +18,7 @@ def _xla_fallback(q, k, v, causal, scale):
 
 
 def _flash_attention_dispatch(q, k, v, causal=False, scale=None):
-    if not _fa.supported(q, k, v):
+    if not _fa.supported(q, k, v, causal=causal):
         return _xla_fallback(q, k, v, causal, scale)
     return _fa.flash_attention(q, k, v, causal=causal, scale=scale)
 

@@ -40,6 +40,19 @@ def _pick_block(n, preferred):
     return max(b, 1)
 
 
+def _block_live(iq, ik, block_q, block_k, offset):
+    """True when the (iq, ik) tile intersects the causal region (row i
+    attends key j iff j <= i + offset; bottom-right aligned)."""
+    return iq * block_q + block_q - 1 + offset >= ik * block_k
+
+
+def _causal_mask(s, iq, ik, block_q, block_k, offset):
+    """Apply the bottom-right-aligned causal mask to a score tile."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + iq * block_q
+    cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ik * block_k
+    return jnp.where(rows + offset >= cols, s, NEG_INF)
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -57,7 +70,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, m_scr, l_scr, acc_scr, *,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    run = (iq * block_q + block_q - 1 + offset >= ik * block_k) if causal else True
+    run = _block_live(iq, ik, block_q, block_k, offset) if causal else True
 
     @pl.when(run)
     def _compute():
@@ -68,9 +81,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, m_scr, l_scr, acc_scr, *,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + iq * block_q
-            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ik * block_k
-            s = jnp.where(rows + offset >= cols, s, NEG_INF)
+            s = _causal_mask(s, iq, ik, block_q, block_k, offset)
         m_prev = m_scr[:, 0]                          # (bq,)
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
         p = jnp.exp(s - m_cur[:, None])
@@ -146,7 +157,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    run = (iq * block_q + block_q - 1 + offset >= ik * block_k) if causal else True
+    run = _block_live(iq, ik, block_q, block_k, offset) if causal else True
 
     @pl.when(run)
     def _compute():
@@ -159,9 +170,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + iq * block_q
-            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ik * block_k
-            s = jnp.where(rows + offset >= cols, s, NEG_INF)
+            s = _causal_mask(s, iq, ik, block_q, block_k, offset)
         p = jnp.exp(s - lse[:, None])                 # (bq, bk) f32
         dv_scr[:] += jax.lax.dot_general(p.astype(do.dtype), do,
                                          (((0,), (0,)), ((), ())),
@@ -188,7 +197,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    run = (iq * block_q + block_q - 1 + offset >= ik * block_k) if causal else True
+    run = _block_live(iq, ik, block_q, block_k, offset) if causal else True
 
     @pl.when(run)
     def _compute():
@@ -201,9 +210,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + iq * block_q
-            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ik * block_k
-            s = jnp.where(rows + offset >= cols, s, NEG_INF)
+            s = _causal_mask(s, iq, ik, block_q, block_k, offset)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -327,9 +334,14 @@ def flash_attention(q, k, v, causal=False, scale=None,
                             int(block_q), int(block_k))
 
 
-def supported(q, k, v) -> bool:
+def supported(q, k, v, causal=False) -> bool:
     if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
         return False
     b, sq, h, d = q.shape
     sk, hkv = k.shape[1], k.shape[2]
+    if causal and sq > sk:
+        # offset = sk - sq < 0 leaves rows i < -offset with no visible key;
+        # the online softmax would silently emit uniform attention for them
+        # (and pollute dk/dv) instead of the fallback's NaN — reject.
+        return False
     return h % hkv == 0 and d <= 256 and sq >= 8 and sk >= 8

@@ -125,3 +125,15 @@ def test_causal_bottom_right_alignment(rng):
 def test_supported_rejects_non_4d():
     assert not fa.supported(jnp.zeros((4, 8, 16)), jnp.zeros((4, 8, 16)),
                             jnp.zeros((4, 8, 16)))
+
+
+def test_supported_rejects_causal_sq_gt_sk():
+    """Causal with more queries than keys has fully-masked rows; the kernel
+    must defer to the XLA fallback rather than emit uniform attention."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    q = jnp.zeros((1, 64, 4, 32))
+    k = v = jnp.zeros((1, 32, 4, 32))
+    assert not fa.supported(q, k, v, causal=True)
+    assert fa.supported(q, k, v, causal=False)
+    assert fa.supported(k, q, q, causal=True)  # sq < sk is fine
