@@ -28,6 +28,10 @@ from .mp_layers import (ColumnParallelLinear, RowParallelLinear,  # noqa: F401
                         mark_as_sequence_parallel_parameter)
 from .auto import shard_tensor, reshard, DistAttr, Shard, Replicate, Partial  # noqa: F401
 from .recompute import recompute, RecomputeWrapper  # noqa: F401
+from .pipeline import (LayerDesc, SharedLayerDesc, PipelineLayer,  # noqa: F401
+                       PipelineParallel, StackedPipelineStages)
+from . import sharding  # noqa: F401
+from .sharding import group_sharded_parallel  # noqa: F401
 
 
 def get_hybrid_communicate_group():

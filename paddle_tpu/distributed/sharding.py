@@ -1,0 +1,155 @@
+"""ZeRO-style sharded training (``paddle.distributed.sharding`` parity).
+
+Reference (SURVEY.md §2.5): stage-1
+meta_optimizers/dygraph_optimizer/dygraph_sharding_optimizer.py
+(DygraphShardingOptimizer: optimizer states partitioned over the sharding
+group, grads reduced to their owner rank, updated params broadcast),
+stage-2 meta_parallel/sharding/group_sharded_optimizer_stage2.py +
+group_sharded_stage2.py (gradient partitioning), stage-3
+group_sharded_stage3.py (parameter partitioning with pre-forward allgather
+/ post-backward release + CPU offload), entry point
+python/paddle/distributed/sharding/group_sharded.py
+(``group_sharded_parallel(model, optimizer, level="p_g_os")``).
+
+TPU redesign: the reference hand-chunks every tensor and choreographs
+reduce/broadcast/allgather/release by rank.  Under GSPMD the same physics
+is a *sharding annotation per stage*:
+
+- stage 1 ("os"):   optimizer states sharded over the zero axes; XLA emits
+  the reduce + per-shard update + implicit gather the reference codes by
+  hand.
+- stage 2 ("os_g"): + gradients constrained to the same sharding → the
+  grad all-reduce becomes a reduce-scatter, each rank updates its shard,
+  params all-gather on use (ZeRO-2's exact communication volume).
+- stage 3 ("p_g_os"): + parameters stored sharded; XLA's scheduler decides
+  gather/release timing (SURVEY.md §7.2 — validated empirically rather
+  than choreographed).
+- ``offload=True``: optimizer states live in host memory
+  (``memory_kind="pinned_host"``); XLA inserts the H2D/D2H transfers the
+  reference's CPU-adam path does manually.  TPU-only; ignored with a
+  warning elsewhere.
+
+All of it executes inside the one compiled TrainStep — the per-stage
+classes below exist for API parity and carry the chosen stage to the step
+compiler.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Optional
+
+import jax
+
+from . import fleet
+
+_LEVELS = {"os": 1, "os_g": 2, "p_g_os": 3}
+
+
+def group_sharded_parallel(model, optimizer, level: str, scaler=None,
+                           group=None, offload=False, sync_buffers=False,
+                           buffer_max_size=None, segment_size=None,
+                           sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    """Shard model training over the sharding axis at the given level.
+
+    Returns ``(model, optimizer, scaler)`` like the reference.  The level
+    is recorded on the optimizer; ``jit.TrainStep`` reads it (unless an
+    explicit ``zero_stage`` overrides) and applies the corresponding
+    sharding specs.  Extra knobs of the reference that control its manual
+    bucketing/communication (buffer_max_size, segment_size, sync_comm) are
+    accepted for signature parity and ignored — XLA owns scheduling.
+    """
+    if level not in _LEVELS:
+        raise ValueError(f"level must be one of {sorted(_LEVELS)}, got {level!r}")
+    stage = _LEVELS[level]
+    if stage == 1:
+        optimizer = DygraphShardingOptimizer(optimizer, offload=offload)
+    elif stage == 2:
+        optimizer = GroupShardedOptimizerStage2(optimizer, offload=offload)
+    elif stage == 3:
+        optimizer = _Stage3ShardedOptimizer(optimizer, offload=offload)
+        model = GroupShardedStage3(model, optimizer, offload=offload)
+    return model, optimizer, scaler
+
+
+def _check_offload(offload: bool) -> bool:
+    if not offload:
+        return False
+    if jax.default_backend() != "tpu":
+        warnings.warn("offload=True needs TPU host memory spaces; ignored "
+                      f"on backend {jax.default_backend()!r}")
+        return False
+    return True
+
+
+class _ShardedOptimizerWrapper:
+    """Delegating wrapper that pins a ZeRO stage onto an optimizer."""
+
+    _stage = 1
+
+    def __init__(self, inner, offload=False):
+        object.__setattr__(self, "_inner", inner)
+        object.__setattr__(self, "_zero_stage", self._stage)
+        object.__setattr__(self, "_zero_offload", _check_offload(offload))
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def __setattr__(self, name, value):
+        if name.startswith("_zero"):
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self._inner, name, value)
+
+
+class DygraphShardingOptimizer(_ShardedOptimizerWrapper):
+    """Stage-1 parity: optimizer states sharded over the sharding axes."""
+
+    _stage = 1
+
+
+class GroupShardedOptimizerStage2(_ShardedOptimizerWrapper):
+    """Stage-2 parity: + gradients sharded (reduce-scatter not all-reduce)."""
+
+    _stage = 2
+
+
+class _Stage3ShardedOptimizer(_ShardedOptimizerWrapper):
+    """Stage-3 marker carrier (wrapping, not mutating, the caller's
+    optimizer — the same object may drive an unsharded step elsewhere)."""
+
+    _stage = 3
+
+
+class GroupShardedStage2:
+    """Reference wraps the model too at stage 2; sharding lives in the
+    compiled step here, so this is a transparent pass-through kept for
+    call-shape parity."""
+
+    def __new__(cls, model, optimizer=None, **kwargs):
+        return model
+
+
+class GroupShardedStage3:
+    """Stage-3 parity: parameters stored sharded.  Pass-through wrapper —
+    param sharding is applied by TrainStep.param_specs via zero_stage=3."""
+
+    def __new__(cls, model, optimizer=None, offload=False, **kwargs):
+        return model
+
+
+def zero_stage_of(optimizer, explicit: Optional[int] = None) -> int:
+    """Resolve the effective ZeRO stage for the step compiler.
+
+    An explicit argument — including an explicit 0 to force ZeRO off —
+    always wins; ``None`` defers to the stage recorded by
+    ``group_sharded_parallel`` (0 if none)."""
+    if explicit is not None:
+        return explicit
+    stage = getattr(optimizer, "_zero_stage", None)
+    return stage if stage is not None else 0
+
+
+def zero_offload_of(optimizer) -> bool:
+    return bool(getattr(optimizer, "_zero_offload", False))

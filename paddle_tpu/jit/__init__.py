@@ -84,7 +84,11 @@ def _spec_of(meta_partition, ndim) -> P:
     return P(*meta_partition)
 
 
-def zero_shard_spec(spec: P, shape, axis_name: str, axis_size: int) -> P:
+ZERO_MIN_SIZE = 2048  # numel below which zero-sharding isn't worth the comm
+
+
+def zero_shard_spec(spec: P, shape, axis_name: str, axis_size: int,
+                    min_size: int = ZERO_MIN_SIZE) -> P:
     """ZeRO-style sharding: additionally shard over ``axis_name`` on the
     first dim that is divisible and not already sharded.
 
@@ -92,9 +96,15 @@ def zero_shard_spec(spec: P, shape, axis_name: str, axis_size: int) -> P:
     dygraph_sharding_optimizer.py / group_sharded_stage3.py) map to GSPMD:
     the stage choreography (reduce-to-owner, broadcast, allgather/release)
     becomes a sharding annotation and XLA inserts the moving parts
-    (SURVEY.md §7.2).
+    (SURVEY.md §7.2).  Small tensors stay replicated (the reference's
+    segment_size bucketing serves the same purpose).
     """
     if axis_size <= 1:
+        return spec
+    n = 1
+    for d in shape:
+        n *= d
+    if n < min_size:
         return spec
     entries = list(spec) + [None] * (len(shape) - len(spec))
     for i, (dim, cur) in enumerate(zip(shape, entries)):
@@ -104,8 +114,16 @@ def zero_shard_spec(spec: P, shape, axis_name: str, axis_size: int) -> P:
     return spec  # nothing divisible; leave replicated
 
 
-def _named(mesh, spec):
+def _named(mesh, spec, host=False):
+    if host:
+        return NamedSharding(mesh, spec, memory_kind="pinned_host")
     return NamedSharding(mesh, spec)
+
+
+def _zero_over(spec, shape, axes, mesh):
+    for ax in axes:
+        spec = zero_shard_spec(spec, shape, ax, mesh.shape[ax])
+    return spec
 
 
 # ---------------------------------------------------------------------------
@@ -133,14 +151,19 @@ class TrainStep:
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
                  scaler=None, mesh: Optional[Mesh] = None,
                  batch_axes=("dp", "sharding"), batch_spec=None,
-                 zero_stage: int = 0, zero_axes=("dp", "sharding"),
+                 zero_stage: Optional[int] = None,
+                 zero_axes=("dp", "sharding"),
                  extra_metrics: Optional[Callable] = None):
+        from ..distributed.sharding import zero_offload_of, zero_stage_of
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.scaler = scaler
         self.mesh = mesh
-        self.zero_stage = zero_stage
+        # group_sharded_parallel records the stage on the optimizer; an
+        # explicit zero_stage argument (including 0 = force off) wins
+        self.zero_stage = zero_stage_of(optimizer, zero_stage)
+        self.zero_offload = zero_offload_of(optimizer)
         self.extra_metrics = extra_metrics
         if mesh is not None:
             present = [a for a in batch_axes if a in mesh.axis_names
@@ -164,10 +187,19 @@ class TrainStep:
         for name, p in params.items():
             spec = _spec_of(meta[name].partition if name in meta else None, p.ndim)
             if self.zero_stage >= 3:
-                for ax in self.zero_axes:
-                    spec = zero_shard_spec(spec, p.shape, ax, self.mesh.shape[ax])
+                spec = _zero_over(spec, p.shape, self.zero_axes, self.mesh)
             specs[name] = spec
         return specs
+
+    def grad_specs(self, grads, param_specs) -> Dict[str, P]:
+        """ZeRO-2+: gradients sharded like the optimizer states, so the
+        grad all-reduce becomes a reduce-scatter (reference:
+        GroupShardedOptimizerStage2 grad partitioning)."""
+        if self.zero_stage < 2 or self.mesh is None:
+            return {k: param_specs[k] for k in grads}
+        return {k: _zero_over(param_specs[k], grads[k].shape,
+                              self.zero_axes, self.mesh)
+                for k in grads}
 
     def opt_state_specs(self, opt_state, param_specs) -> Any:
         """Optimizer slots/master weights: mirror param sharding; ZeRO>=1
@@ -175,8 +207,7 @@ class TrainStep:
         def spec_for(path_name, leaf):
             base = param_specs.get(path_name, P())
             if self.zero_stage >= 1 and hasattr(leaf, "ndim") and leaf.ndim > 0:
-                for ax in self.zero_axes:
-                    base = zero_shard_spec(base, leaf.shape, ax, self.mesh.shape[ax])
+                base = _zero_over(base, leaf.shape, self.zero_axes, self.mesh)
             return base
 
         out = {}
@@ -210,10 +241,15 @@ class TrainStep:
                 k: jax.device_put(v, _named(self.mesh, pspecs[k]))
                 for k, v in state["params"].items()}
             new_opt = {}
+            # offload: optimizer states live in pinned host memory; XLA
+            # inserts the transfers around the sharded update
+            host = self.zero_offload
             for slot, val in state["opt"].items():
                 if isinstance(val, dict):
                     new_opt[slot] = {
-                        k: (jax.device_put(v, _named(self.mesh, ospecs[slot][k]))
+                        k: (jax.device_put(v, _named(self.mesh,
+                                                     ospecs[slot][k],
+                                                     host=host))
                             if v is not None else None)
                         for k, v in val.items()}
                 else:
@@ -253,8 +289,9 @@ class TrainStep:
             grads, scaler_state = self.scaler.unscale_and_update(grads, scaler_state)
         if mesh is not None:
             pspecs = self.param_specs()
+            gspecs = self.grad_specs(grads, pspecs)
             grads = {k: jax.lax.with_sharding_constraint(
-                g, _named(mesh, pspecs[k])) for k, g in grads.items()}
+                g, _named(mesh, gspecs[k])) for k, g in grads.items()}
         with jax.named_scope("optimizer"):
             new_params, new_opt = self.optimizer.apply(grads, state["opt"], params)
         if scaler_state is not None and "found_inf" in scaler_state:
@@ -266,6 +303,18 @@ class TrainStep:
                 old, new, is_leaf=lambda x: x is None)
             new_params = sel(params, new_params)
             new_opt = sel(state["opt"], new_opt)
+        if self.zero_offload and mesh is not None:
+            # keep updated optimizer states in pinned host memory; without
+            # this the donated step writes them back to HBM and the offload
+            # silently ends after one step
+            ospecs = self.opt_state_specs(new_opt, self.param_specs())
+            new_opt = {
+                slot: ({k: (jax.device_put(v, _named(mesh, ospecs[slot][k],
+                                                     host=True))
+                            if v is not None else None)
+                        for k, v in val.items()}
+                       if isinstance(val, dict) else val)
+                for slot, val in new_opt.items()}
         new_state = {"params": new_params, "opt": new_opt,
                      "step": state["step"] + 1, "rng": state["rng"]}
         if scaler_state is not None:

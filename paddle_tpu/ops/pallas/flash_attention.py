@@ -328,6 +328,11 @@ _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
 def flash_attention(q, k, v, causal=False, scale=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
     """Public entry: [b, s, h, d] in/out; kv heads may divide q heads (GQA)."""
+    if causal and q.shape[1] > k.shape[1]:
+        raise ValueError(
+            f"causal flash attention requires sq <= sk, got sq={q.shape[1]} "
+            f"sk={k.shape[1]}: rows with no visible key have undefined "
+            "attention (use the XLA fallback)")
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     return _flash_attention(q, k, v, float(scale), bool(causal),
