@@ -32,6 +32,8 @@ from .pipeline import (LayerDesc, SharedLayerDesc, PipelineLayer,  # noqa: F401
                        PipelineParallel, StackedPipelineStages)
 from . import sharding  # noqa: F401
 from .sharding import group_sharded_parallel  # noqa: F401
+from . import moe  # noqa: F401
+from .moe import MoELayer  # noqa: F401
 
 
 def get_hybrid_communicate_group():

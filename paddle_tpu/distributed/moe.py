@@ -1,0 +1,279 @@
+"""Mixture-of-Experts with expert parallelism (``ep`` mesh axis).
+
+Reference surface (SURVEY.md §2.5): MoELayer + gates
+(python/paddle/incubate/distributed/models/moe/moe_layer.py,
+gate/gshard_gate.py, gate/switch_gate.py, gate/naive_gate.py), capacity +
+token dropping via the fused CUDA helper ops (number_count,
+limit_by_capacity, prune_gate_by_capacity, random_routing), grouped NCCL
+all-to-all dispatch/combine, and the expert-aware grad clip
+(moe/grad_clip.py).
+
+TPU redesign: the reference routes tokens with scatter/gather CUDA kernels
+and explicit alltoall calls.  Here routing is the GShard einsum
+formulation — dense one-hot dispatch/combine tensors contracted on the MXU
+— and expert placement is a sharding annotation: expert parameters are
+stacked on a leading expert axis sharded over ``ep``, the dispatched
+activations [E, C, H] carry the same constraint, and XLA emits the
+all-to-all exchange.  The helper ops become one-liners on cumsums
+(number_count/limit_by_capacity below) instead of kernels.
+
+Capacity semantics match the reference: each expert processes at most
+``capacity_factor * tokens / num_experts`` tokens; overflow tokens are
+dropped (their combine weight is zero, so they pass through the residual
+path of the surrounding block).
+
+Grad-clip note: expert params are global sharded arrays under GSPMD, so
+``ClipGradByGlobalNorm`` already reduces their squared norms globally —
+the reference's special expert-aware clip exists only because its expert
+params are process-local.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core import random as prandom
+from ..nn.layer import Layer, ParamMeta
+from .mp_layers import constrain as _constrain
+
+_SEP = "__"
+
+
+# ---------------------------------------------------------------------------
+# helper "ops" (reference: fused CUDA kernels, here cumsum one-liners)
+# ---------------------------------------------------------------------------
+
+def number_count(gate_idx, upper_range):
+    """Tokens routed to each expert (reference: number_count op)."""
+    return jnp.sum(jax.nn.one_hot(gate_idx, upper_range, dtype=jnp.int32),
+                   axis=0)
+
+
+def limit_by_capacity(expert_mask, capacity):
+    """Zero mask entries beyond each expert's capacity, preserving token
+    order (reference: limit_by_capacity + prune_gate_by_capacity ops).
+    ``expert_mask``: [N, E] one-hot; returns (kept_mask, position_in_expert).
+    """
+    pos = jnp.cumsum(expert_mask, axis=0) * expert_mask - expert_mask
+    kept = expert_mask * (pos < capacity)
+    return kept, pos
+
+
+# ---------------------------------------------------------------------------
+# gates
+# ---------------------------------------------------------------------------
+
+class NaiveGate(Layer):
+    """Linear router returning (combine_weights, dispatch_mask, aux_loss).
+
+    Subclasses implement ``route(probs, capacity)``.
+    """
+
+    top_k = 2
+
+    def __init__(self, d_model: int, num_experts: int,
+                 capacity_factor: float = 1.25,
+                 eval_capacity_factor: Optional[float] = None):
+        super().__init__()
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor or capacity_factor
+        self.weight = self.create_parameter(
+            (d_model, num_experts),
+            default_initializer=lambda k, s, d: jax.random.uniform(
+                k, s, d, -1 / math.sqrt(d_model), 1 / math.sqrt(d_model)))
+
+    def capacity(self, num_tokens: int) -> int:
+        f = self.capacity_factor if self.training else self.eval_capacity_factor
+        return max(int(f * num_tokens * self.top_k / self.num_experts), 4)
+
+    def forward(self, x):
+        """x: [N, H] tokens → (combine [N,E,C], dispatch [N,E,C] bool, aux)."""
+        logits = (x.astype(jnp.float32) @
+                  self.weight.astype(jnp.float32))        # [N, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        return self.route(probs, self.capacity(x.shape[0]))
+
+    def route(self, probs, capacity):
+        raise NotImplementedError
+
+
+class SwitchGate(NaiveGate):
+    """Top-1 routing (Switch Transformer; reference: switch_gate.py)."""
+
+    top_k = 1
+
+    def route(self, probs, capacity):
+        E = self.num_experts
+        idx1 = jnp.argmax(probs, axis=-1)                 # [N]
+        mask1 = jax.nn.one_hot(idx1, E, dtype=probs.dtype)
+        # load-balancing aux loss (mean prob × mean assignment, scaled by E)
+        aux = E * jnp.sum(jnp.mean(probs, axis=0) * jnp.mean(mask1, axis=0))
+        kept1, pos1 = limit_by_capacity(mask1, capacity)
+        gate1 = jnp.sum(probs * kept1, axis=-1)           # [N]
+        loc1 = jax.nn.one_hot(jnp.sum(pos1 * mask1, axis=-1).astype(jnp.int32),
+                              capacity, dtype=probs.dtype)  # [N, C]
+        combine = gate1[:, None, None] * kept1[:, :, None] * loc1[:, None, :]
+        return combine, combine > 0, aux
+
+
+class GShardGate(NaiveGate):
+    """Top-2 routing with random second-expert admission (gshard_gate.py)."""
+
+    top_k = 2
+
+    def __init__(self, *args, random_routing: bool = True, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.random_routing = random_routing
+
+    def route(self, probs, capacity):
+        E = self.num_experts
+        idx1 = jnp.argmax(probs, axis=-1)
+        mask1 = jax.nn.one_hot(idx1, E, dtype=probs.dtype)
+        probs_wo1 = probs * (1 - mask1)
+        idx2 = jnp.argmax(probs_wo1, axis=-1)
+        mask2 = jax.nn.one_hot(idx2, E, dtype=probs.dtype)
+
+        aux = E * jnp.sum(jnp.mean(probs, axis=0) * jnp.mean(mask1, axis=0))
+
+        gate1 = jnp.sum(probs * mask1, axis=-1)
+        gate2 = jnp.sum(probs * mask2, axis=-1)
+        if self.random_routing and self.training:
+            # admit the 2nd expert with prob 2*gate2 (GShard §3.2): biases
+            # traffic toward confident second choices
+            u = jax.random.uniform(prandom.next_key("moe_gate"),
+                                   gate2.shape, gate2.dtype)
+            mask2 = mask2 * (u < 2.0 * gate2).astype(mask2.dtype)[:, None]
+
+        kept1, pos1 = limit_by_capacity(mask1, capacity)
+        # 2nd-choice tokens queue behind ALL 1st-choice tokens per expert
+        pos2_base = jnp.sum(mask1, axis=0, keepdims=True)
+        pos2 = (jnp.cumsum(mask2, axis=0) - mask2) * mask2 + pos2_base * mask2
+        kept2 = mask2 * (pos2 < capacity)
+
+        gate1 = jnp.sum(probs * kept1, axis=-1)
+        gate2 = jnp.sum(probs * kept2, axis=-1)
+        denom = jnp.maximum(gate1 + gate2, 1e-9)
+        gate1, gate2 = gate1 / denom, gate2 / denom
+
+        def _combine(gate, kept, pos, mask):
+            loc = jax.nn.one_hot(
+                jnp.sum(pos * mask, axis=-1).astype(jnp.int32), capacity,
+                dtype=probs.dtype)
+            return gate[:, None, None] * kept[:, :, None] * loc[:, None, :]
+
+        combine = (_combine(gate1, kept1, pos1, mask1) +
+                   _combine(gate2, kept2, pos2, mask2))
+        return combine, combine > 0, aux
+
+
+GATES = {"naive": SwitchGate, "switch": SwitchGate, "gshard": GShardGate}
+
+
+# ---------------------------------------------------------------------------
+# MoE layer
+# ---------------------------------------------------------------------------
+
+class MoELayer(Layer):
+    """Expert-parallel MoE (reference: moe_layer.py MoELayer).
+
+    ``experts`` is a factory building one expert Layer (any [..., H] →
+    [..., H] module); ``num_experts`` instances are built with independent
+    init and their parameters stacked on a leading expert axis sharded over
+    ``ep``.
+
+    Aux-loss contract (jax-native — NO global side channel, it would leak
+    tracers across checkpoint/scan/vmap boundaries): after ``forward``
+    returns, ``self.aux_loss`` holds the load-balancing loss of THAT call.
+    It is valid only at the same trace level, i.e. read it immediately
+    after calling the layer (as MixtralDecoderLayer does) and thread it
+    outward through your function's outputs.  ``moe_group`` and
+    ``recompute_interval`` are accepted for reference-signature parity; the
+    expert group is the mesh's ``ep`` axis and recompute is the enclosing
+    block's concern.
+    """
+
+    def __init__(self, d_model: int, expert: Callable[[], Layer],
+                 num_experts: int, gate="gshard", top_k: Optional[int] = None,
+                 capacity_factor: float = 1.25, moe_group=None,
+                 recompute_interval: int = 0):
+        super().__init__()
+        self.num_experts = num_experts
+        if isinstance(gate, str):
+            self.gate = GATES[gate](d_model, num_experts,
+                                    capacity_factor=capacity_factor)
+        else:
+            self.gate = gate
+        if top_k is not None and top_k != self.gate.top_k:
+            raise ValueError(
+                f"top_k={top_k} conflicts with gate {type(self.gate).__name__}"
+                f" (top_k={self.gate.top_k}); pick gate='switch' for top-1 "
+                "or gate='gshard' for top-2")
+        instances = [expert() for _ in range(num_experts)]
+        object.__setattr__(self, "template", instances[0])
+        per_exp = [dict(inst.named_parameters()) for inst in instances]
+        metas = instances[0].param_meta()
+        self._param_names = list(per_exp[0].keys())
+        for name in self._param_names:
+            stacked = jnp.stack([pe[name] for pe in per_exp], axis=0)
+            meta = metas.get(name, ParamMeta())
+            base = list(meta.partition) if meta.partition is not None else []
+            base += [None] * (stacked.ndim - 1 - len(base))
+            self._register_parameter(
+                name.replace(".", _SEP), stacked,
+                ParamMeta(trainable=meta.trainable,
+                          partition=P("ep", *base), is_bias=meta.is_bias))
+        self.aux_loss = 0.0
+
+    def _extra_mode_layers(self):
+        # train()/eval() must reach the expert template even though it is
+        # outside the sublayer registry (its params are superseded by the
+        # stacked arrays)
+        return (self.template,)
+
+    def stacked_params(self):
+        return {n: getattr(self, n.replace(".", _SEP))
+                for n in self._param_names}
+
+    def forward(self, x):
+        """x: [..., H] → [..., H]; routing over the flattened token dim."""
+        from ..nn.layer import _swapped_params
+        shape = x.shape
+        H = shape[-1]
+        tokens = x.reshape(-1, H)                       # [N, H]
+        combine, dispatch, aux = self.gate(tokens)      # [N,E,C] ×2, scalar
+        self.aux_loss = aux  # same-trace readback only (see class docstring)
+
+        # dispatch: [E, C, H] — expert-sharded; XLA emits the all-to-all
+        expert_in = jnp.einsum("nec,nh->ech",
+                               dispatch.astype(x.dtype), tokens)
+        expert_in = _constrain(expert_in, "ep")
+
+        params = self.stacked_params()
+
+        def one_expert(p, h):
+            with _swapped_params(self.template, p):
+                return self.template(h)
+
+        expert_out = jax.vmap(one_expert)(params, expert_in)   # [E, C, H]
+        expert_out = _constrain(expert_out, "ep")
+
+        out = jnp.einsum("ech,nec->nh", expert_out,
+                         combine.astype(x.dtype))
+        return out.reshape(shape)
+
+
+def moe_dispatch(x, combine_weights, dispatch_mask):
+    """Functional dispatch (incubate.nn.functional.moe_dispatch parity)."""
+    return jnp.einsum("nec,nh->ech", dispatch_mask.astype(x.dtype), x)
+
+
+def moe_combine(expert_out, combine_weights):
+    """Functional combine (incubate.nn.functional.moe_combine parity)."""
+    return jnp.einsum("ech,nec->nh", expert_out,
+                      combine_weights.astype(expert_out.dtype))

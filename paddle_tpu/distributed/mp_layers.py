@@ -50,6 +50,20 @@ def constrain(x, *spec_entries):
     return jax.lax.with_sharding_constraint(x, NamedSharding(m, spec))
 
 
+def act_constrain(x, seq, feat):
+    """Constrain a [batch..., seq, feature] activation.
+
+    ``seq``/``feat`` are the mesh-axis entries for the sequence and feature
+    dims.  Rank-2 inputs (a [tokens, feature] slice, e.g. inside a vmapped
+    MoE expert) have no batch or sequence dim: the seq entry (which would
+    otherwise be mis-applied to the token dim — sequence parallelism is
+    meaningless there) is dropped and only the feature entry kept.
+    """
+    if x.ndim == 2:
+        return constrain(x, None, feat)
+    return constrain(x, ("dp", "sharding"), seq, feat)
+
+
 def _seq_axes(sequence_parallel: bool):
     # Megatron-SP: outside the matmuls, activations are sharded on the
     # sequence dim over the SAME mp axis (reference:
@@ -81,12 +95,12 @@ class ColumnParallelLinear(Layer):
         if self.sequence_parallel:
             # incoming activation is seq-sharded; XLA all-gathers it for the
             # matmul (the AllGatherOp in the reference)
-            x = constrain(x, ("dp", "sharding"), "mp", None)
+            x = act_constrain(x, "mp", None)
         y = F.linear(x, self.weight, self.bias)
         if self.gather_output:
-            y = constrain(y, ("dp", "sharding"), None, None)
+            y = act_constrain(y, None, None)
         else:
-            y = constrain(y, ("dp", "sharding"), None, "mp")
+            y = act_constrain(y, None, "mp")
         return y
 
 
@@ -109,13 +123,13 @@ class RowParallelLinear(Layer):
 
     def forward(self, x):
         if self.input_is_parallel:
-            x = constrain(x, ("dp", "sharding"), None, "mp")
+            x = act_constrain(x, None, "mp")
         y = F.linear(x, self.weight, None)
         if self.sequence_parallel:
             # ReduceScatterOp: output seq-sharded over mp
-            y = constrain(y, ("dp", "sharding"), "mp", None)
+            y = act_constrain(y, "mp", None)
         else:
-            y = constrain(y, ("dp", "sharding"), None, None)
+            y = act_constrain(y, None, None)
         if self.bias is not None:
             y = y + self.bias
         return y

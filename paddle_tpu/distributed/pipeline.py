@@ -50,25 +50,14 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core import random as prandom
 from ..nn.layer import Layer, ParamMeta
 from . import fleet
+from .mp_layers import _mesh, constrain as _constrain
 
 _SEP = "__"  # flat-name separator for stacked parameter attributes
-
-
-def _mesh():
-    hcg = fleet.get_hybrid_communicate_group()
-    return hcg.mesh if hcg is not None else None
 
 
 def _pp_size() -> int:
     m = _mesh()
     return m.shape["pp"] if m is not None and "pp" in m.axis_names else 1
-
-
-def _constrain(x, *entries):
-    m = _mesh()
-    if m is None:
-        return x
-    return jax.lax.with_sharding_constraint(x, NamedSharding(m, P(*entries)))
 
 
 # ---------------------------------------------------------------------------
@@ -148,9 +137,16 @@ class StackedPipelineStages(Layer):
                  num_microbatches: Optional[int] = None,
                  num_virtual_pipeline_stages: int = 1,
                  use_recompute: bool = False, recompute_policy=None,
-                 extra_is_batched: Sequence[bool] = ()):
+                 extra_is_batched: Sequence[bool] = (),
+                 has_aux: bool = False):
         super().__init__()
         self.n_layers = n_layers
+        # has_aux: template forward returns (x, aux_scalar); aux is summed
+        # over layers (and averaged over microbatches in the pipelined
+        # schedule, approximating the full-batch gate statistics) and
+        # returned as (out, aux_total) — aux flows through outputs, never a
+        # side channel, so it survives checkpoint/scan/vmap boundaries.
+        self.has_aux = has_aux
         self.num_stages = num_stages if num_stages is not None else _pp_size()
         self.num_microbatches = num_microbatches
         self.num_chunks = num_virtual_pipeline_stages
@@ -204,6 +200,11 @@ class StackedPipelineStages(Layer):
 
     # -- helpers -----------------------------------------------------------
 
+    def _extra_mode_layers(self):
+        # train()/eval() must reach the template even though it is outside
+        # the sublayer registry (its params are superseded by the stack)
+        return (self.template,)
+
     def stacked_params(self) -> Dict[str, jax.Array]:
         """Current (possibly traced/swapped) stacked arrays keyed by the
         template's flat param names."""
@@ -226,13 +227,25 @@ class StackedPipelineStages(Layer):
 
     def _scan_layers(self, params, keys, x, static_extras, batched_extras,
                      flags):
-        """Serially apply a [L, ...] slice of stacked layers via lax.scan."""
+        """Serially apply a [L, ...] slice of stacked layers via lax.scan.
+        Returns (out, aux_sum) when has_aux else (out, None)."""
+        if not self.has_aux:
+            def body(carry, xs):
+                p, k = xs
+                return (self._call_layer(p, k, carry, static_extras,
+                                         batched_extras, flags), None)
+            out, _ = jax.lax.scan(body, x, (params, keys))
+            return out, None
+
         def body(carry, xs):
+            h, aux = carry
             p, k = xs
-            return (self._call_layer(p, k, carry, static_extras,
-                                     batched_extras, flags), None)
-        out, _ = jax.lax.scan(body, x, (params, keys))
-        return out
+            h, a = self._call_layer(p, k, h, static_extras,
+                                    batched_extras, flags)
+            return (h, aux + a.astype(aux.dtype)), None
+        (out, aux), _ = jax.lax.scan(
+            body, (x, jnp.zeros((), jnp.float32)), (params, keys))
+        return out, aux
 
     # -- forward -----------------------------------------------------------
 
@@ -257,10 +270,12 @@ class StackedPipelineStages(Layer):
             and e.shape[0] == x.shape[0] for f, e in zip(flags, extras))
         static_extras, batched_extras = _split_extras(extras, flags)
         if self.num_stages <= 1:
-            return self._scan_layers(params, keys, x, static_extras,
-                                     batched_extras, flags)
-        return self._pipelined(params, keys, x, static_extras,
-                               batched_extras, flags)
+            out, aux = self._scan_layers(params, keys, x, static_extras,
+                                         batched_extras, flags)
+        else:
+            out, aux = self._pipelined(params, keys, x, static_extras,
+                                       batched_extras, flags)
+        return (out, aux) if self.has_aux else out
 
     # -- the pipelined schedule -------------------------------------------
 
@@ -290,8 +305,11 @@ class StackedPipelineStages(Layer):
         bex_m = tuple(to_micro(e) for e in batched_extras)
 
         def stage_fn(stage_params, stage_keys, h, bextras):
-            return self._scan_layers(stage_params, stage_keys, h,
-                                     static_extras, bextras, flags)
+            out, aux = self._scan_layers(stage_params, stage_keys, h,
+                                         static_extras, bextras, flags)
+            if aux is None:
+                aux = jnp.zeros((), jnp.float32)
+            return out, aux
 
         vstage = jax.vmap(stage_fn)  # over the stage dim
 
@@ -309,6 +327,8 @@ class StackedPipelineStages(Layer):
             return (jnp.ones(shape, dtype) if dtype == jnp.bool_
                     else jnp.zeros(shape, dtype))
 
+        s_idx = jnp.arange(S)
+
         def one_pass(x_m, bex_m, chunk, tick0):
             """GPipe shift-register over the stage ring for one chunk:
             T = M + S - 1 ticks (fill, steady state, drain)."""
@@ -316,10 +336,11 @@ class StackedPipelineStages(Layer):
             stage_k = ksc[:, chunk]
             state = _fill((S,) + x_m.shape[1:], x.dtype)
             bstate = tuple(_fill((S,) + e.shape[1:], e.dtype) for e in bex_m)
+            aux0 = jnp.zeros((), jnp.float32)
             T = M + S - 1
 
             def tick(carry, t):
-                state, bstate = carry
+                state, bstate, aux = carry
                 idx = jnp.minimum(t, M - 1)
                 new_state = shift(x_m[idx], state)
                 new_bstate = tuple(shift(e[idx], b)
@@ -328,20 +349,29 @@ class StackedPipelineStages(Layer):
                 # draws independent dropout masks
                 k_t = jax.vmap(jax.vmap(
                     lambda k: jax.random.fold_in(k, tick0 + t)))(stage_k)
-                out = _constrain(vstage(stage_p, k_t, new_state,
-                                        new_bstate), "pp")
-                return (out, new_bstate), out[-1]
+                out, aux_s = vstage(stage_p, k_t, new_state, new_bstate)
+                out = _constrain(out, "pp")
+                if self.has_aux:
+                    # count only live stages (fill/drain slots hold dummies)
+                    live = (t >= s_idx) & (t - s_idx < M)
+                    aux = aux + jnp.sum(jnp.where(live, aux_s, 0.0))
+                return (out, new_bstate, aux), out[-1]
 
-            _, ys = jax.lax.scan(tick, (state, bstate), jnp.arange(T))
-            return ys[T - M:]  # [M, mb, ...] in microbatch order
+            (_, _, aux), ys = jax.lax.scan(tick, (state, bstate, aux0),
+                                           jnp.arange(T))
+            return ys[T - M:], aux  # [M, mb, ...] in microbatch order
 
         # C passes over the ring; each microbatch traverses all L layers in
         # order.  (Classic interleaving merges the drains/fills of adjacent
         # chunks; the extra (C-1)*(S-1) bubble ticks here are the price of a
         # single fused scan per chunk — revisit if profiles show it.)
+        aux_total = jnp.zeros((), jnp.float32)
         for c in range(C):
-            x_m = one_pass(x_m, bex_m, c, c * (M + S - 1))
-        return x_m.reshape((B,) + x_m.shape[2:])
+            x_m, aux_c = one_pass(x_m, bex_m, c, c * (M + S - 1))
+            aux_total = aux_total + aux_c
+        # per-microbatch gate statistics averaged to the full-batch scale
+        return (x_m.reshape((B,) + x_m.shape[2:]),
+                aux_total / M if self.has_aux else None)
 
 
 def _split_extras(extras, flags):

@@ -153,6 +153,8 @@ class LlamaMLP(Layer):
 
 
 class LlamaDecoderLayer(Layer):
+    returns_aux = False  # MoE variants return (x, aux_loss)
+
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
         self.input_layernorm = LlamaRMSNorm(cfg)
@@ -167,9 +169,12 @@ class LlamaDecoderLayer(Layer):
 
 
 class LlamaModel(Layer):
+    decoder_layer_cls: type = None  # set below; subclasses override
+
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
         self.cfg = cfg
+        cls = type(self).decoder_layer_cls
         self.embed_tokens = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
         from ..nn.layers_common import LayerList
         if cfg.pipeline_stages > 1:
@@ -177,17 +182,18 @@ class LlamaModel(Layer):
             # over the pp mesh axis (distributed/pipeline.py)
             from ..distributed.pipeline import StackedPipelineStages
             self.layers = StackedPipelineStages(
-                lambda: LlamaDecoderLayer(cfg), cfg.num_hidden_layers,
+                lambda: cls(cfg), cfg.num_hidden_layers,
                 num_stages=cfg.pipeline_stages,
                 num_microbatches=cfg.num_microbatches,
                 num_virtual_pipeline_stages=cfg.virtual_pp_degree,
                 use_recompute=cfg.use_recompute,
                 recompute_policy=cfg.recompute_policy,
-                extra_is_batched=(False, False, True))
+                extra_is_batched=(False, False, True),
+                has_aux=getattr(cls, "returns_aux", False))
         else:
             layers = []
             for _ in range(cfg.num_hidden_layers):
-                layer = LlamaDecoderLayer(cfg)
+                layer = cls(cfg)
                 if cfg.use_recompute:
                     layer = RecomputeWrapper(layer, policy=cfg.recompute_policy)
                 layers.append(layer)
@@ -200,19 +206,30 @@ class LlamaModel(Layer):
         cos, sin = F.rope_cos_sin(input_ids.shape[1], cfg.head_dim,
                                   base=cfg.rope_theta, dtype=x.dtype,
                                   position_ids=position_ids)
+        aux = 0.0
         if cfg.pipeline_stages > 1:
             x = self.layers(x, cos, sin, attn_mask)
+            if isinstance(x, tuple):
+                x, aux = x
         else:
             for layer in self.layers:
                 x = layer(x, cos, sin, attn_mask)
+                if isinstance(x, tuple):
+                    x, a = x
+                    aux = aux + a
+        # same-trace stash consumed by the CausalLM head (no transform
+        # boundary between model and head, so this is legal under jit)
+        self.__dict__["_moe_aux"] = aux
         return self.norm(x)
 
 
 class LlamaForCausalLM(Layer):
+    model_cls: type = None  # set below; subclasses override
+
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
         self.cfg = cfg
-        self.model = LlamaModel(cfg)
+        self.model = type(self).model_cls(cfg)
         if not cfg.tie_word_embeddings:
             self.lm_head = ColumnParallelLinear(cfg.hidden_size, cfg.vocab_size,
                                                 has_bias=False,
@@ -249,6 +266,10 @@ class LlamaForCausalLM(Layer):
                 nxt = jnp.argmax(logits, axis=-1)
             ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
         return ids
+
+
+LlamaModel.decoder_layer_cls = LlamaDecoderLayer
+LlamaForCausalLM.model_cls = LlamaModel
 
 
 def llama(name_or_config="tiny", **overrides) -> LlamaForCausalLM:

@@ -270,14 +270,27 @@ class Layer:
 
     # -- modes / apply -----------------------------------------------------
 
+    def _extra_mode_layers(self):
+        """Override point: extra layers (outside the sublayer registry,
+        e.g. a stacked-parameter template) that must still follow
+        train()/eval() mode switches."""
+        return ()
+
+    def _walk_mode_layers(self):
+        yield self
+        for l in self._sub_layers.values():
+            yield from l._walk_mode_layers()
+        for l in self._extra_mode_layers():
+            yield from l._walk_mode_layers()
+
     def train(self):
-        for l in self.named_sublayers(include_self=True):
-            l[1].__dict__["training"] = True
+        for l in self._walk_mode_layers():
+            l.__dict__["training"] = True
         return self
 
     def eval(self):
-        for l in self.named_sublayers(include_self=True):
-            l[1].__dict__["training"] = False
+        for l in self._walk_mode_layers():
+            l.__dict__["training"] = False
         return self
 
     def apply(self, fn: Callable[["Layer"], None]):
@@ -382,7 +395,7 @@ def _train_mode(layer: Layer, training: Optional[bool]):
     if training is None:
         yield
         return
-    prev = [(l, l.training) for _, l in layer.named_sublayers(include_self=True)]
+    prev = [(l, l.training) for l in layer._walk_mode_layers()]
     (layer.train() if training else layer.eval())
     try:
         yield
