@@ -34,6 +34,9 @@ from . import sharding  # noqa: F401
 from .sharding import group_sharded_parallel  # noqa: F401
 from . import moe  # noqa: F401
 from .moe import MoELayer  # noqa: F401
+from . import cp  # noqa: F401
+from .cp import (ring_attention, ulysses_attention,  # noqa: F401
+                 context_parallel_attention)
 
 
 def get_hybrid_communicate_group():

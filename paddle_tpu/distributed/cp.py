@@ -1,0 +1,227 @@
+"""Context / sequence parallelism over the "sep" mesh axis.
+
+Reference capability (SURVEY.md §5.7): the reference core carries a dedicated
+"sep" process axis (python/paddle/distributed/fleet/base/topology.py,
+``sep_degree`` in hybrid_configs) used for DeepSpeed-Ulysses-style
+all-to-all attention, and PaddleNLP layers ring flash attention (P2P KV
+rotation) on top of the core's send/recv groups.
+
+TPU-native redesign — both schemes become collectives inside a partial-manual
+``shard_map`` over the "sep" axis (everything else — dp/mp/sharding — stays
+in GSPMD auto mode, so these compose with tensor parallelism and ZeRO):
+
+- **Ulysses** (``ulysses_attention``): activations arrive sequence-sharded
+  ``[b, S/n, h, d]``; one ``lax.all_to_all`` trades the sequence shard for a
+  head shard → ``[b, S, h/n, d]``; full-sequence attention runs locally (and
+  therefore dispatches to the Pallas flash kernel on TPU); a second
+  all-to-all restores sequence sharding.  Comm volume: 2 a2a of q/k/v/out —
+  rides the ICI torus as XLA all-to-all.
+
+- **Ring** (``ring_attention``): K/V chunks rotate around the sep ring via
+  ``lax.ppermute`` while each device keeps its Q chunk; partial softmax
+  statistics (running max / denominator / accumulator — the same online
+  softmax as the flash kernel, at chunk granularity) merge across steps, so
+  attention memory stays O(S/n · S/n) transient per step and activations are
+  O(S/n).  Each ring step is ``jax.checkpoint``-ed: backward re-runs the
+  rotation instead of saving per-step probability tiles.
+
+Under single-program SPMD every device executes the same unrolled ring, so
+the causal "late ranks do more work" imbalance that motivates zigzag
+layouts on GPU does not change the critical path here — masked tiles are
+computed-and-discarded in the same program.  A Pallas-fused ring step
+(mask-skipped) is a planned kernel-pack upgrade.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from . import fleet
+
+NEG_INF = -1e30
+
+
+def _mesh() -> Optional[Mesh]:
+    hcg = fleet.get_hybrid_communicate_group()
+    return hcg.mesh if hcg is not None else None
+
+
+def _sep_size(mesh: Optional[Mesh], axis: str) -> int:
+    if mesh is None or axis not in mesh.axis_names:
+        return 1
+    return mesh.shape[axis]
+
+
+def _serial_attention(q, k, v, causal, scale):
+    from ..nn import functional as F
+    return F.scaled_dot_product_attention(q, k, v, is_causal=causal,
+                                          scale=scale)
+
+
+# ---------------------------------------------------------------------------
+# ring attention
+# ---------------------------------------------------------------------------
+
+def _ring_step(carry, k_t, v_t, qg, q_pos, k_pos0, *, causal, scale, chunk):
+    """One online-softmax accumulation step against the visiting KV chunk.
+
+    qg: (b, c, hkv, g, d) grouped query; k_t/v_t: (b, c, hkv, d);
+    q_pos: (c,) global query positions; k_pos0: scalar, global position of
+    the visiting chunk's first key.  All statistics fp32.
+    """
+    m, l, acc = carry
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_t,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        k_pos = k_pos0 + jnp.arange(chunk)
+        mask = q_pos[:, None] >= k_pos[None, :]          # (c, c)
+        s = jnp.where(mask[None, None, None], s, NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # finite NEG_INF keeps exp() well-defined for fully-masked tiles: the
+    # first ring step visits the device's own (diagonal) chunk, so m is
+    # already > NEG_INF when a later chunk is fully in the future
+    p = jnp.exp(s - m_new[..., None])
+    alpha = jnp.exp(m - m_new)
+    l = l * alpha + p.sum(axis=-1)
+    acc = acc * alpha[..., None].transpose(0, 3, 1, 2, 4) + jnp.einsum(
+        "bhgqk,bkhd->bqhgd", p.astype(v_t.dtype), v_t,
+        preferred_element_type=jnp.float32)
+    return m_new, l, acc
+
+
+def _ring_inner(q, k, v, *, axis, n, causal, scale):
+    b, c, h, d = q.shape
+    hkv = k.shape[2]
+    g = h // hkv
+    qg = q.reshape(b, c, hkv, g, d)
+    rank = jax.lax.axis_index(axis)
+    q_pos = rank * c + jnp.arange(c)
+
+    m = jnp.full((b, hkv, g, c), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, hkv, g, c), jnp.float32)
+    acc = jnp.zeros((b, c, hkv, g, d), jnp.float32)
+    carry = (m, l, acc)
+
+    step = jax.checkpoint(
+        functools.partial(_ring_step, causal=causal, scale=scale, chunk=c))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    k_t, v_t = k, v
+    for t in range(n):
+        src = (rank - t) % n            # chunk index now visiting this device
+        carry = step(carry, k_t, v_t, qg, q_pos, src * c)
+        if t < n - 1:
+            k_t = jax.lax.ppermute(k_t, axis, perm)
+            v_t = jax.lax.ppermute(v_t, axis, perm)
+    m, l, acc = carry
+    out = acc / jnp.maximum(l, 1e-30)[..., None].transpose(0, 3, 1, 2, 4)
+    return out.reshape(b, c, h, d).astype(q.dtype)
+
+
+def ring_attention(q, k, v, causal=False, scale=None, axis="sep", mesh=None):
+    """Ring flash attention over the sep axis.
+
+    Takes GLOBAL-shaped ``[b, s, h, d]`` arrays inside jit (sequence is
+    sharded over ``axis`` by the shard_map below); outside any mesh, or when
+    the sep degree is 1, falls back to serial attention.  GQA supported
+    (kv heads may divide q heads).
+    """
+    mesh = mesh if mesh is not None else _mesh()
+    n = _sep_size(mesh, axis)
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if n == 1:
+        return _serial_attention(q, k, v, causal, scale)
+    if q.shape[1] % n:
+        raise ValueError(f"sequence {q.shape[1]} not divisible by sep={n}")
+    spec = P(None, axis, None, None)
+    fn = shard_map(
+        functools.partial(_ring_inner, axis=axis, n=n, causal=causal,
+                          scale=float(scale)),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names=frozenset({axis}), check_vma=False)
+    return fn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses (all-to-all) attention
+# ---------------------------------------------------------------------------
+
+def _ulysses_inner(q, k, v, *, axis, n, causal, scale):
+    # local [b, S/n, h, d] → heads scatter / sequence gather → [b, S, h/n, d]
+    a2a = functools.partial(jax.lax.all_to_all, axis_name=axis,
+                            split_axis=2, concat_axis=1, tiled=True)
+    q, k, v = a2a(q), a2a(k), a2a(v)
+    out = _serial_attention(q, k, v, causal, scale)   # flash kernel on TPU
+    return jax.lax.all_to_all(out, axis_name=axis, split_axis=1,
+                              concat_axis=2, tiled=True)
+
+
+def ulysses_attention(q, k, v, causal=False, scale=None, axis="sep",
+                      mesh=None):
+    """DeepSpeed-Ulysses attention: sequence shard ↔ head shard all-to-all.
+
+    Requires q heads divisible by the sep degree; kv heads are
+    repeat-interleaved to the least multiple of the degree when GQA leaves
+    a kv-head count that does not split n ways.
+    """
+    mesh = mesh if mesh is not None else _mesh()
+    n = _sep_size(mesh, axis)
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if n == 1:
+        return _serial_attention(q, k, v, causal, scale)
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    if s % n:
+        raise ValueError(f"sequence {s} not divisible by sep={n}")
+    if h % n:
+        raise ValueError(f"q heads {h} not divisible by sep={n}")
+    if hkv % n:
+        # repeat-interleave kv heads to the least multiple that splits
+        # n ways; block-splitting the repeated heads preserves the GQA
+        # q→kv mapping (floor((p·hkv'/h)/rep) == floor(p·hkv/h))
+        rep = n // math.gcd(hkv, n)
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    spec = P(None, axis, None, None)
+    fn = shard_map(
+        functools.partial(_ulysses_inner, axis=axis, n=n, causal=causal,
+                          scale=float(scale)),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names=frozenset({axis}), check_vma=False)
+    return fn(q, k, v)
+
+
+def context_parallel_attention(q, k, v, causal=False, scale=None,
+                               impl="ring", axis="sep", mesh=None):
+    """Dispatch by impl name ("ring" | "ulysses"); the model-facing entry."""
+    if impl == "ring":
+        return ring_attention(q, k, v, causal, scale, axis, mesh)
+    if impl == "ulysses":
+        return ulysses_attention(q, k, v, causal, scale, axis, mesh)
+    raise ValueError(f"unknown context-parallel impl {impl!r} "
+                     "(expected 'ring' or 'ulysses')")
+
+
+def split_sequence(x, axis_idx=1, axis="sep", mesh=None):
+    """Constrain a [b, s, ...] activation's sequence dim onto the sep axis
+    (the data-layout contract every cp attention above assumes).  A 4-D
+    [b, s, heads, d] input keeps its heads on "mp" so cp composes with
+    tensor parallelism instead of un-sharding the head dim."""
+    mesh = mesh if mesh is not None else _mesh()
+    if mesh is None or axis not in mesh.axis_names:
+        return x
+    from .mp_layers import constrain
+    entries = [None] * x.ndim
+    entries[axis_idx] = axis
+    entries[0] = ("dp", "sharding")
+    if x.ndim == 4:
+        entries[2] = "mp"
+    return constrain(x, *entries)

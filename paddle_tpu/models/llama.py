@@ -46,6 +46,7 @@ class LlamaConfig:
     use_recompute: bool = False
     recompute_policy: Optional[str] = None  # full recompute; "dots" saves s×s attn probs = OOM at long seq
     sequence_parallel: bool = False
+    context_parallel: Optional[str] = None  # None | "ring" | "ulysses" (sep axis)
     pipeline_stages: int = 1        # >1: stacked pp-sharded decoder body
     num_microbatches: Optional[int] = None  # default: pipeline_stages
     virtual_pp_degree: int = 1      # interleaved-schedule chunks per stage
@@ -129,8 +130,16 @@ class LlamaAttention(Layer):
         k = constrain(k, ("dp", "sharding"), None, "mp", None)
         v = constrain(v, ("dp", "sharding"), None, "mp", None)
         q, k = F.apply_rotary_pos_emb(q, k, cos, sin)
-        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
-                                             is_causal=attn_mask is None)
+        if cfg.context_parallel and attn_mask is None:
+            from ..distributed import cp
+            q = cp.split_sequence(q)
+            k = cp.split_sequence(k)
+            v = cp.split_sequence(v)
+            out = cp.context_parallel_attention(q, k, v, causal=True,
+                                                impl=cfg.context_parallel)
+        else:
+            out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                                 is_causal=attn_mask is None)
         out = out.reshape(b, s, cfg.num_attention_heads * cfg.head_dim)
         return self.o_proj(out)
 
