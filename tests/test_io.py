@@ -1,0 +1,220 @@
+"""paddle_tpu.io tests (reference test pattern: test/legacy_test/
+test_dataloader_*.py, test_batch_sampler.py — numpy-oracle + coverage of
+shuffle/sharding/worker modes)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import io
+
+
+def _ds(n=20, feat=3):
+    x = np.arange(n * feat, dtype=np.float32).reshape(n, feat)
+    y = np.arange(n, dtype=np.int64)
+    return io.TensorDataset([x, y]), x, y
+
+
+def test_tensor_dataset_and_len():
+    ds, x, y = _ds()
+    assert len(ds) == 20
+    xi, yi = ds[3]
+    np.testing.assert_array_equal(xi, x[3])
+    assert yi == 3
+
+
+def test_dataloader_basic_order_and_shapes():
+    ds, x, y = _ds()
+    dl = io.DataLoader(ds, batch_size=6)
+    batches = list(dl)
+    assert len(batches) == 4
+    assert batches[0][0].shape == (6, 3)
+    assert batches[-1][0].shape == (2, 3)  # remainder kept
+    np.testing.assert_array_equal(np.concatenate([b[1] for b in batches]), y)
+
+
+def test_dataloader_drop_last():
+    ds, _, _ = _ds()
+    assert len(list(io.DataLoader(ds, batch_size=6, drop_last=True))) == 3
+    assert len(io.DataLoader(ds, batch_size=6, drop_last=True)) == 3
+
+
+def test_dataloader_shuffle_covers_all():
+    ds, _, y = _ds()
+    dl = io.DataLoader(ds, batch_size=4, shuffle=True)
+    got = np.sort(np.concatenate([b[1] for b in dl]))
+    np.testing.assert_array_equal(got, y)
+
+
+def test_dataloader_workers_preserve_order():
+    ds, _, y = _ds(64)
+    dl = io.DataLoader(ds, batch_size=4, num_workers=3)
+    got = np.concatenate([b[1] for b in dl])
+    np.testing.assert_array_equal(got, y)  # order identical to sync path
+
+
+def test_dataloader_worker_exception_propagates():
+    class Bad(io.Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            if i == 5:
+                raise RuntimeError("boom")
+            return np.zeros(2)
+
+    with pytest.raises(RuntimeError, match="boom"):
+        list(io.DataLoader(Bad(), batch_size=2, num_workers=2))
+
+
+def test_get_worker_info():
+    seen = []
+
+    class Probe(io.Dataset):
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            info = io.get_worker_info()
+            seen.append(None if info is None else info.num_workers)
+            return np.zeros(1)
+
+    list(io.DataLoader(Probe(), batch_size=1, num_workers=2))
+    assert seen and all(v == 2 for v in seen)
+    assert io.get_worker_info() is None
+
+
+def test_iterable_dataset():
+    class Stream(io.IterableDataset):
+        def __iter__(self):
+            yield from (np.full(2, i, dtype=np.float32) for i in range(7))
+
+    batches = list(io.DataLoader(Stream(), batch_size=3))
+    assert [b.shape for b in batches] == [(3, 2), (3, 2), (1, 2)]
+
+
+def test_collate_nested_dict():
+    batch = [{"a": np.ones(2), "b": (1, 2.0)} for _ in range(4)]
+    out = io.default_collate_fn(batch)
+    assert out["a"].shape == (4, 2)
+    assert out["b"][0].shape == (4,) and out["b"][0].dtype == np.int64
+    assert out["b"][1].dtype == np.float32
+
+
+def test_distributed_batch_sampler_partitions():
+    ds, _, _ = _ds(22)
+    shards = []
+    for r in range(4):
+        s = io.DistributedBatchSampler(ds, batch_size=3, num_replicas=4, rank=r)
+        shards.append([i for b in s for i in b])
+    # equal shard sizes (padded by wrap-around), union covers the dataset
+    assert len({len(s) for s in shards}) == 1
+    assert set().union(*map(set, shards)) == set(range(22))
+
+
+def test_distributed_batch_sampler_epoch_shuffle_consistent():
+    ds, _, _ = _ds(16)
+
+    def order(rank, epoch):
+        s = io.DistributedBatchSampler(ds, batch_size=4, num_replicas=2,
+                                       rank=rank, shuffle=True)
+        s.set_epoch(epoch)
+        return [i for b in s for i in b]
+
+    # replicas see disjoint halves of one permutation per epoch
+    assert set(order(0, 1)) | set(order(1, 1)) == set(range(16))
+    assert set(order(0, 1)).isdisjoint(order(1, 1))
+    assert order(0, 1) != order(0, 2)  # reshuffles across epochs
+    assert order(0, 3) == order(0, 3)  # deterministic per epoch
+
+
+def test_concat_subset_split():
+    ds1, _, _ = _ds(10)
+    ds2, _, _ = _ds(5)
+    cat = io.ConcatDataset([ds1, ds2])
+    assert len(cat) == 15
+    np.testing.assert_array_equal(cat[12][0], ds2[2][0])
+    sub = io.Subset(ds1, [4, 2])
+    assert sub[1][1] == 2
+    a, b = io.random_split(ds1, [7, 3], generator=np.random.default_rng(0))
+    assert len(a) == 7 and len(b) == 3
+    a2, b2 = io.random_split(ds1, [0.7, 0.3], generator=np.random.default_rng(0))
+    assert len(a2) == 7 and len(b2) == 3
+
+
+def test_random_sampler_and_weighted():
+    ds, _, _ = _ds(10)
+    rs = io.RandomSampler(ds, generator=np.random.default_rng(0))
+    assert sorted(rs) == list(range(10))
+    ws = io.WeightedRandomSampler([0.0, 1.0, 0.0], num_samples=20)
+    assert set(ws) == {1}
+
+
+def test_device_prefetch_yields_device_arrays():
+    import jax
+    ds, _, y = _ds(8)
+    dl = io.DataLoader(ds, batch_size=4, device_prefetch=True)
+    batches = list(dl)
+    assert all(isinstance(b[0], jax.Array) for b in batches)
+    np.testing.assert_array_equal(np.concatenate([np.asarray(b[1]) for b in batches]), y)
+
+
+def test_worker_init_fn_exception_propagates():
+    ds, _, _ = _ds(8)
+
+    def bad_init(wid):
+        raise RuntimeError("init boom")
+
+    with pytest.raises(RuntimeError, match="init boom"):
+        list(io.DataLoader(ds, batch_size=2, num_workers=2, worker_init_fn=bad_init))
+
+
+def test_sampler_shuffle_conflict_raises():
+    ds, _, _ = _ds(8)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        io.DataLoader(ds, batch_size=2, shuffle=True, sampler=io.SequenceSampler(ds))
+
+
+def test_distributed_sampler_tiny_dataset():
+    ds, _, _ = _ds(3)
+    shards = []
+    for r in range(8):
+        s = io.DistributedBatchSampler(ds, batch_size=1, num_replicas=8, rank=r)
+        shards.append([i for b in s for i in b])
+    assert all(len(s) == 1 for s in shards)
+    assert set().union(*map(set, shards)) == {0, 1, 2}
+
+
+def test_collate_bool_preserved():
+    assert io.default_collate_fn([True, False]).dtype == np.bool_
+    assert io.default_collate_fn([np.bool_(True)]).dtype == np.bool_
+
+
+def test_device_prefetch_skips_string_fields():
+    class WithStr(io.Dataset):
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            return {"x": np.ones(2, np.float32), "name": f"s{i}"}
+
+    out = list(io.DataLoader(WithStr(), batch_size=2, device_prefetch=True))
+    assert out[0]["name"] == ["s0", "s1"]
+
+
+def test_iterable_dataset_multi_worker_shards():
+    class Shard(io.IterableDataset):
+        def __iter__(self):
+            info = io.get_worker_info()
+            yield from (np.int64(i) for i in range(info.id, 12, info.num_workers))
+
+    got = np.sort(np.concatenate(
+        list(io.DataLoader(Shard(), batch_size=3, num_workers=3))))
+    np.testing.assert_array_equal(got, np.arange(12))
+
+
+def test_dataloader_with_custom_batch_sampler():
+    ds, _, _ = _ds(10)
+    bs = io.BatchSampler(sampler=io.SequenceSampler(ds), batch_size=5)
+    out = list(io.DataLoader(ds, batch_sampler=bs))
+    assert len(out) == 2 and out[0][0].shape == (5, 3)
