@@ -43,6 +43,18 @@ from .ops import (rand, randn, randint, uniform, normal, randperm,  # noqa: F401
                   bernoulli, multinomial)
 
 
+def save(obj, path, **kw):
+    """``paddle.save`` parity (see paddle_tpu.ckpt)."""
+    from . import ckpt as _ckpt
+    return _ckpt.save(obj, path, **kw)
+
+
+def load(path, **kw):
+    """``paddle.load`` parity (see paddle_tpu.ckpt)."""
+    from . import ckpt as _ckpt
+    return _ckpt.load(path, **kw)
+
+
 def no_grad():
     return autograd.no_grad()
 

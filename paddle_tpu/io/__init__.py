@@ -531,6 +531,8 @@ class DataLoader:
 
         next_to_yield = [0]
 
+        fatal: List[BaseException] = []  # worker-init failures: always raised
+
         def worker(wid: int):
             _worker_info.info = WorkerInfo(wid, nw, (self.seed or 0) + wid, self.dataset)
             try:
@@ -538,10 +540,8 @@ class DataLoader:
                     try:
                         self.worker_init_fn(wid)
                     except BaseException as e:
-                        # deliver the failure to whichever batch the consumer
-                        # waits on next, instead of dying silently and hanging it
                         with out_lock:
-                            out_slots[next_to_yield[0]] = e
+                            fatal.append(e)
                             out_lock.notify_all()
                         return
                 while not stop.is_set():
@@ -573,8 +573,10 @@ class DataLoader:
         try:
             for i in range(len(batches)):
                 with out_lock:
-                    while i not in out_slots:
+                    while i not in out_slots and not fatal:
                         out_lock.wait()
+                    if fatal:
+                        raise fatal[0]
                     result = out_slots.pop(i)
                     next_to_yield[0] = i + 1
                     out_lock.notify_all()

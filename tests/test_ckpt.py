@@ -1,0 +1,150 @@
+"""Checkpoint tests: save/load parity, sharded save, reshard-on-load across
+mesh shapes (reference pattern: test/auto_parallel checkpoint tests — write
+on one topology, read on another, compare numerics)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu import ckpt
+
+
+def _mesh(shape, names):
+    devs = np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, names)
+
+
+def test_save_load_roundtrip(tmp_path):
+    obj = {"w": jnp.arange(6.0).reshape(2, 3), "step": 7, "nested": {"b": np.ones(4)}}
+    p = str(tmp_path / "model.pdparams")
+    ckpt.save(obj, p)
+    back = ckpt.load(p)
+    np.testing.assert_array_equal(back["w"], np.arange(6.0).reshape(2, 3))
+    assert back["step"] == 7
+    np.testing.assert_array_equal(back["nested"]["b"], np.ones(4))
+
+
+def test_sharded_save_and_plain_load(tmp_path):
+    mesh = _mesh((8,), ("dp",))
+    x = jnp.arange(32.0).reshape(8, 4)
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+    state = {"layer": {"w": xs, "name": "l0"}, "step": 3}
+    d = str(tmp_path / "ck")
+    ckpt.save_state_dict(state, d)
+    flat = ckpt.load_state_dict(d)
+    np.testing.assert_array_equal(flat["layer/w"], np.asarray(x))
+    assert flat["layer/name"] == "l0"
+    assert flat["step"] == 3
+
+
+def test_reshard_on_load(tmp_path):
+    # write sharded 8-way on dp, read back sharded 2x4 on (a, b)
+    mesh8 = _mesh((8,), ("dp",))
+    x = jnp.arange(64.0).reshape(8, 8)
+    xs = jax.device_put(x, NamedSharding(mesh8, P("dp", None)))
+    d = str(tmp_path / "ck")
+    ckpt.save_state_dict({"w": xs}, d)
+
+    mesh24 = _mesh((2, 4), ("a", "b"))
+    tmpl = jax.device_put(jnp.zeros((8, 8)), NamedSharding(mesh24, P("b", "a")))
+    out = ckpt.load_state_dict(d, template={"w": tmpl})
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(x))
+    assert out["w"].sharding.spec == P("b", "a")
+
+
+def test_load_with_template_numpy_leaves(tmp_path):
+    d = str(tmp_path / "ck")
+    ckpt.save_state_dict({"a": np.arange(5), "b": {"c": 2.5}}, d)
+    out = ckpt.load_state_dict(d, template={"a": np.zeros(5), "b": {"c": 0.0}})
+    np.testing.assert_array_equal(out["a"], np.arange(5))
+    assert out["b"]["c"] == 2.5
+
+
+def test_replicated_param_single_writer(tmp_path):
+    mesh = _mesh((8,), ("dp",))
+    w = jax.device_put(jnp.ones((4, 4)), NamedSharding(mesh, P()))  # replicated
+    d = str(tmp_path / "ck")
+    ckpt.save_state_dict({"w": w}, d)
+    files = [f for f in os.listdir(d) if f.endswith(".npy")]
+    assert len(files) == 1  # replicas deduped: one shard file only
+    out = ckpt.load_state_dict(d)
+    np.testing.assert_array_equal(out["w"], np.ones((4, 4)))
+
+
+def test_async_save_and_wait(tmp_path):
+    mesh = _mesh((8,), ("dp",))
+    xs = jax.device_put(jnp.arange(16.0).reshape(8, 2), NamedSharding(mesh, P("dp", None)))
+    d = str(tmp_path / "ck")
+    saver = ckpt.async_save({"w": xs, "step": 1}, d)
+    saver.wait()
+    out = ckpt.load_state_dict(d)
+    np.testing.assert_array_equal(out["w"], np.arange(16.0).reshape(8, 2))
+    assert out["step"] == 1
+
+
+def test_latest_checkpoint(tmp_path):
+    root = str(tmp_path)
+    for n in (10, 200, 30):
+        d = os.path.join(root, f"step_{n}")
+        ckpt.save_state_dict({"x": np.ones(2)}, d)
+    os.makedirs(os.path.join(root, "step_999"))  # torn: no metadata
+    assert ckpt.latest_checkpoint(root).endswith("step_200")
+    assert ckpt.latest_checkpoint(str(tmp_path / "nope")) is None
+
+
+def test_train_state_roundtrip(tmp_path):
+    """Full TrainStep state: save sharded, restore with template, same loss."""
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.jit import TrainStep
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    model = M()
+    opt = optimizer.AdamW(learning_rate=1e-2, parameters=model.parameters())
+    step = TrainStep(model, lambda m, b: (m(b[0]) - b[1]).mean() ** 2, opt)
+    state = step.init_state()
+    batch = (jnp.ones((8, 4)), jnp.zeros((8, 4)))
+    state, _ = step(state, batch)
+    d = str(tmp_path / "ck")
+    ckpt.save_state_dict(state, d)
+    restored = ckpt.load_state_dict(d, template=state)
+    s1, m1 = step(state, batch)
+    s2, m2 = step(restored, batch)
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-6)
+
+
+def test_async_save_numpy_leaf_uses_npy_files(tmp_path):
+    big = np.arange(1000, dtype=np.float32)
+    d = str(tmp_path / "ck")
+    ckpt.async_save({"buf": big}, d).wait()
+    assert any(f.endswith(".npy") for f in os.listdir(d))
+    import json
+    meta = json.load(open(os.path.join(d, "metadata.json")))
+    assert "buf" in meta["arrays"] and "buf" not in meta["objects"]
+    np.testing.assert_array_equal(ckpt.load_state_dict(d)["buf"], big)
+
+
+def test_load_returns_device_arrays_by_default(tmp_path):
+    import jax
+    p = str(tmp_path / "m.pd")
+    ckpt.save({"w": np.ones(3)}, p)
+    assert isinstance(ckpt.load(p)["w"], jax.Array)
+    assert isinstance(ckpt.load(p, return_numpy=True)["w"], np.ndarray)
+
+
+def test_missing_key_raises(tmp_path):
+    d = str(tmp_path / "ck")
+    ckpt.save_state_dict({"a": np.ones(2)}, d)
+    with pytest.raises(KeyError):
+        ckpt.load_state_dict(d, template={"zzz": np.zeros(2)})
