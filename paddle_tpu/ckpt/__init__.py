@@ -106,7 +106,8 @@ def _flatten(tree) -> Tuple[Dict[str, Any], Any]:
 
 
 def _key_to_fname(key: str) -> str:
-    return key.replace("/", ".")
+    # percent-escape so nested path 'a/b' and dotted key 'a.b' cannot collide
+    return key.replace("%", "%25").replace("/", "%2F")
 
 
 # ---------------------------------------------------------------------------
@@ -149,6 +150,14 @@ def _write_entries(entries, path: str, overwrite: bool = True) -> None:
     """The single writer of the v1 on-disk format (shard .npy files + a
     per-rank metadata JSON)."""
     os.makedirs(path, exist_ok=True)
+    # re-saving in place: drop rank 0's metadata FIRST so the directory reads
+    # as incomplete (and is skipped by latest_checkpoint) while shard files
+    # are being rewritten; it is atomically re-created at the end
+    if jax.process_index() == 0:
+        try:
+            os.remove(os.path.join(path, _META))
+        except FileNotFoundError:
+            pass
     meta: Dict[str, Any] = {"format": "paddle_tpu.ckpt.v1",
                             "process_count": jax.process_count(),
                             "arrays": {}, "objects": {}}
@@ -244,8 +253,21 @@ def _load_meta(path: str) -> Dict[str, Any]:
     metas = _meta_files(path)
     if not metas:
         raise FileNotFoundError(f"no checkpoint metadata in {path}")
+    # rank 0's metadata records how many writers this save had; ignore
+    # higher-rank metadata files left over from an older, wider save
+    expected = 1
+    if _META in metas:
+        with open(os.path.join(path, _META)) as f:
+            expected = json.load(f).get("process_count", 1)
     merged: Dict[str, Any] = {"arrays": {}, "objects": {}}
     for m in sorted(metas):
+        if m != _META:
+            try:
+                rank = int(m.split(".")[1])
+            except (IndexError, ValueError):
+                continue
+            if rank >= expected:
+                continue  # stale: from a previous save with more writers
         with open(os.path.join(path, m)) as f:
             meta = json.load(f)
         for k, v in meta.get("arrays", {}).items():

@@ -1,0 +1,55 @@
+"""Typed configuration: one home for strategy + runtime flags.
+
+Reference (SURVEY.md §5.6): DistributedStrategy (protobuf-backed bag,
+python/paddle/distributed/fleet/base/distributed_strategy.py) + FLAGS_*
+native flags (paddle/common/flags.h, ``paddle.set_flags``).
+
+Here: ``DistributedStrategy`` is a serializable dataclass (defined beside
+fleet, re-exported here), runtime flags live in ``paddle_tpu.core`` with the
+``PDTPU_FLAGS_*`` env prefix, and ``TrainConfig`` is the typed trainer-level
+config the hapi/trainer layers consume.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Optional
+
+from ..core import get_flags, set_flags  # noqa: F401
+from ..distributed.fleet import DistributedStrategy  # noqa: F401
+
+__all__ = ["DistributedStrategy", "TrainConfig", "set_flags", "get_flags"]
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    """Trainer-level knobs (the strategy covers parallelism; this covers the
+    loop): serializable so a run's full config can be checkpointed."""
+
+    # precision
+    amp_level: str = "O0"            # O0 | O1 | O2 (paddle.amp levels)
+    amp_dtype: str = "bfloat16"
+    master_weights: bool = True
+    # remat
+    recompute: bool = False
+    recompute_granularity: str = "full"
+    # loop
+    max_steps: int = 0
+    log_every: int = 10
+    save_every: int = 0
+    ckpt_dir: Optional[str] = None
+    keep_checkpoints: int = 3
+    # data
+    global_batch_size: int = 0
+    seed: int = 0
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, s: str) -> "TrainConfig":
+        return cls(**json.loads(s))
+
+    def replace(self, **kw) -> "TrainConfig":
+        return dataclasses.replace(self, **kw)

@@ -1,0 +1,118 @@
+"""Device API (``paddle.device`` parity).
+
+Reference: python/paddle/device/ — set_device / get_device / Stream /
+Event / synchronize.  On TPU, XLA owns streams and events; the Stream/Event
+objects here preserve the reference API shape (creation, waiting, recording,
+elapsed time) with semantics mapped to jax's async dispatch model: an Event
+"records" by capturing a completion fence on all pending work.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import (device_count, get_device, is_compiled_with_cuda,  # noqa: F401
+                    is_compiled_with_tpu, local_device_count, set_device,
+                    synchronize)
+
+__all__ = ["set_device", "get_device", "device_count", "local_device_count",
+           "synchronize", "Stream", "Event", "current_stream",
+           "is_compiled_with_cuda", "is_compiled_with_tpu", "XPUPlace",
+           "CPUPlace", "TPUPlace", "get_available_device"]
+
+
+def get_available_device() -> str:
+    return get_device()
+
+
+class TPUPlace:
+    def __init__(self, idx: int = 0):
+        self.idx = idx
+
+    def __repr__(self):
+        return f"TPUPlace({self.idx})"
+
+
+class CPUPlace:
+    def __repr__(self):
+        return "CPUPlace()"
+
+
+XPUPlace = TPUPlace  # accelerator place alias for ported scripts
+
+
+class Event:
+    """``paddle.device.Event`` parity.  ``record()`` fences all work enqueued
+    so far; ``synchronize()`` blocks on that fence; ``elapsed_time`` between
+    two synchronized events is host wall-clock in ms."""
+
+    def __init__(self, enable_timing: bool = True):
+        self.enable_timing = enable_timing
+        self._fence: Optional[jax.Array] = None
+        self._time_ns: Optional[int] = None
+
+    def record(self, stream: "Stream" = None):
+        del stream
+        self._fence = jnp.zeros(()) + 0  # enqueued after all pending work
+        if self.enable_timing:
+            # host wall-clock at enqueue: elapsed_time between two events
+            # measures enqueue-to-enqueue (for device-time-accurate numbers
+            # block between records, or use the profiler's device trace)
+            self._time_ns = time.perf_counter_ns()
+
+    def query(self) -> bool:
+        if self._fence is None:
+            return True
+        try:
+            return self._fence.is_ready()
+        except AttributeError:
+            return True
+
+    def synchronize(self):
+        if self._fence is not None:
+            self._fence.block_until_ready()
+
+    def elapsed_time(self, end: "Event") -> float:
+        self.synchronize()
+        end.synchronize()
+        if self._time_ns is None or end._time_ns is None:
+            raise RuntimeError("events must be recorded with enable_timing")
+        return (end._time_ns - self._time_ns) / 1e6
+
+
+class Stream:
+    """``paddle.device.Stream`` parity.  XLA schedules internally; a Stream
+    here is an ordering scope whose synchronize() drains the device."""
+
+    def __init__(self, device=None, priority: int = 2):
+        self.device = device
+        self.priority = priority
+
+    def record_event(self, event: Optional[Event] = None) -> Event:
+        event = event or Event()
+        event.record(self)
+        return event
+
+    def wait_event(self, event: Event):
+        event.synchronize()
+
+    def wait_stream(self, stream: "Stream"):
+        stream.synchronize()
+
+    def synchronize(self):
+        synchronize()
+
+    def query(self) -> bool:
+        return True
+
+
+_default_stream = Stream()
+
+
+def current_stream(device=None) -> Stream:
+    del device
+    return _default_stream

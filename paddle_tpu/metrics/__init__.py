@@ -1,0 +1,175 @@
+"""Metrics (``paddle.metric`` parity).
+
+Reference: python/paddle/metric/metrics.py — Metric base with
+``reset/update/accumulate/name``, plus Accuracy / Precision / Recall / Auc.
+Metric state lives on host (numpy): metrics consume the (small) per-step
+outputs after the compiled step returns, never inside the jit region.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Union
+
+import numpy as np
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]
+
+
+def _np(x) -> np.ndarray:
+    return np.asarray(x)
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        raise NotImplementedError
+
+    def compute(self, pred, label, *args):
+        """Optional pre-processing hook run on step outputs; default
+        passthrough (reference lets Model.fit call compute then update)."""
+        return pred, label
+
+
+class Accuracy(Metric):
+    """Top-k accuracy.  ``update`` accepts either correctness values from
+    ``compute`` or raw (pred, label) pairs."""
+
+    def __init__(self, topk: Union[int, Sequence[int]] = (1,), name: str = "acc"):
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self._name = name
+        self.maxk = max(self.topk)
+        self.reset()
+
+    def reset(self):
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label, *args):
+        pred = _np(pred)
+        label = _np(label)
+        if label.ndim == pred.ndim and label.shape[-1] != 1:
+            label = label.argmax(-1)  # one-hot -> index
+        label = label.reshape(label.shape[: pred.ndim - 1] + (1,)) \
+            if label.ndim < pred.ndim else label
+        top = np.argsort(-pred, axis=-1)[..., : self.maxk]
+        return (top == label).astype(np.float32)
+
+    def update(self, correct, *args):
+        correct = _np(correct)
+        num = int(np.prod(correct.shape[:-1]))
+        for i, k in enumerate(self.topk):
+            self.total[i] += float(correct[..., :k].sum())
+            self.count[i] += num
+        accs = self.total / np.maximum(self.count, 1)
+        return accs[0] if len(self.topk) == 1 else accs
+
+    def accumulate(self):
+        accs = self.total / np.maximum(self.count, 1)
+        return float(accs[0]) if len(self.topk) == 1 else list(map(float, accs))
+
+    def name(self):
+        if len(self.topk) == 1:
+            return [self._name]
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    """Binary precision: TP / (TP + FP).  pred is P(class=1)."""
+
+    def __init__(self, name: str = "precision"):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = (_np(preds).reshape(-1) > 0.5)
+        l = _np(labels).reshape(-1).astype(bool)
+        self.tp += int((p & l).sum())
+        self.fp += int((p & ~l).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return float(self.tp) / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    """Binary recall: TP / (TP + FN)."""
+
+    def __init__(self, name: str = "recall"):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = (_np(preds).reshape(-1) > 0.5)
+        l = _np(labels).reshape(-1).astype(bool)
+        self.tp += int((p & l).sum())
+        self.fn += int((~p & l).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return float(self.tp) / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """ROC-AUC via the reference's thresholded histogram estimator
+    (num_thresholds buckets over P(class=1))."""
+
+    def __init__(self, curve: str = "ROC", num_thresholds: int = 4095,
+                 name: str = "auc"):
+        if curve != "ROC":
+            raise NotImplementedError("only ROC supported, like the reference")
+        self.num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        preds = _np(preds)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            preds = preds[:, 1]
+        preds = preds.reshape(-1)
+        labels = _np(labels).reshape(-1)
+        buckets = np.minimum((preds * self.num_thresholds).astype(np.int64),
+                             self.num_thresholds)
+        np.add.at(self._stat_pos, buckets[labels >= 1], 1)
+        np.add.at(self._stat_neg, buckets[labels < 1], 1)
+
+    def accumulate(self):
+        # trapezoid over descending-threshold cumulative TP/FP
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        tp = np.cumsum(self._stat_pos[::-1])
+        fp = np.cumsum(self._stat_neg[::-1])
+        tpr = np.concatenate([[0.0], tp / tot_pos])
+        fpr = np.concatenate([[0.0], fp / tot_neg])
+        trapezoid = getattr(np, "trapezoid", np.trapz)
+        return float(trapezoid(tpr, fpr))
+
+    def name(self):
+        return self._name

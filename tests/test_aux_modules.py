@@ -1,0 +1,158 @@
+"""Tests for config / device / metrics / profiler (reference patterns:
+test/legacy_test/test_metrics.py numpy-oracle checks, profiler state
+machine tests)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+# -- config -----------------------------------------------------------------
+
+def test_train_config_roundtrip():
+    from paddle_tpu.config import TrainConfig
+    c = TrainConfig(amp_level="O2", max_steps=100).replace(seed=7)
+    back = TrainConfig.from_json(c.to_json())
+    assert back.amp_level == "O2" and back.max_steps == 100 and back.seed == 7
+
+
+def test_distributed_strategy_exported_from_config():
+    from paddle_tpu.config import DistributedStrategy
+    s = DistributedStrategy(hybrid_configs={"dp_degree": 2, "mp_degree": 4})
+    assert DistributedStrategy.from_json(s.to_json()).hybrid_configs["mp_degree"] == 4
+
+
+# -- device -----------------------------------------------------------------
+
+def test_device_api():
+    from paddle_tpu import device
+    assert device.device_count() == 8  # conftest forces 8 virtual devices
+    assert "cpu" in device.get_device()
+    s = device.current_stream()
+    e1 = s.record_event()
+    import time
+    time.sleep(0.05)
+    e2 = s.record_event()
+    ms = e1.elapsed_time(e2)
+    assert 40.0 < ms < 5000.0  # measures the gap between the record() calls
+    s.synchronize()
+    assert e2.query()
+
+
+# -- metrics ----------------------------------------------------------------
+
+def test_accuracy_topk():
+    from paddle_tpu.metrics import Accuracy
+    m = Accuracy(topk=(1, 2))
+    pred = np.array([[0.1, 0.7, 0.2], [0.8, 0.1, 0.1]])
+    label = np.array([[1], [2]])  # first correct, second wrong
+    m.update(m.compute(pred, label))
+    top1, top2 = m.accumulate()
+    assert top1 == pytest.approx(0.5)
+    assert top2 == pytest.approx(0.5)  # class 2 is not in top-2 of row 2? row2 top2={0,1}
+    assert m.name() == ["acc_top1", "acc_top2"]
+
+
+def test_accuracy_single_k_scalar():
+    from paddle_tpu.metrics import Accuracy
+    m = Accuracy()
+    pred = np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]])
+    label = np.array([0, 1, 1])
+    m.update(m.compute(pred, label))
+    assert m.accumulate() == pytest.approx(2 / 3)
+
+
+def test_precision_recall():
+    from paddle_tpu.metrics import Precision, Recall
+    preds = np.array([0.9, 0.8, 0.2, 0.7])
+    labels = np.array([1, 0, 1, 1])
+    p = Precision()
+    p.update(preds, labels)
+    assert p.accumulate() == pytest.approx(2 / 3)  # TP=2 (0.9,0.7), FP=1 (0.8)
+    r = Recall()
+    r.update(preds, labels)
+    assert r.accumulate() == pytest.approx(2 / 3)  # FN=1 (0.2)
+
+
+def test_auc_perfect_and_random():
+    from paddle_tpu.metrics import Auc
+    m = Auc()
+    m.update(np.array([0.9, 0.8, 0.1, 0.2]), np.array([1, 1, 0, 0]))
+    assert m.accumulate() == pytest.approx(1.0, abs=1e-3)
+    m2 = Auc()
+    m2.update(np.array([0.5, 0.5, 0.5, 0.5]), np.array([1, 0, 1, 0]))
+    assert m2.accumulate() == pytest.approx(0.5, abs=1e-2)
+    # oracle vs sklearn-style exact computation on mixed scores
+    scores = np.array([0.1, 0.4, 0.35, 0.8])
+    labels = np.array([0, 0, 1, 1])
+    m3 = Auc()
+    m3.update(scores, labels)
+    assert m3.accumulate() == pytest.approx(0.75, abs=1e-2)
+
+
+# -- profiler ---------------------------------------------------------------
+
+def test_make_scheduler_states():
+    from paddle_tpu.profiler import ProfilerState, make_scheduler
+    sch = make_scheduler(closed=1, ready=1, record=2, repeat=1, skip_first=1)
+    states = [sch(i) for i in range(6)]
+    assert states[0] == ProfilerState.CLOSED          # skip_first
+    assert states[1] == ProfilerState.CLOSED          # closed
+    assert states[2] == ProfilerState.READY
+    assert states[3] == ProfilerState.RECORD
+    assert states[4] == ProfilerState.RECORD_AND_RETURN
+    assert states[5] == ProfilerState.CLOSED          # repeat exhausted
+
+
+def test_profiler_records_and_exports(tmp_path):
+    from paddle_tpu import profiler as prof_mod
+    got = {}
+
+    def on_ready(p):
+        got["rows"] = p.aggregate()
+        got["path"] = p.export(str(tmp_path / "trace.json"))
+
+    p = prof_mod.Profiler(timer_only=True, on_trace_ready=on_ready)
+    p.start()
+    for _ in range(3):
+        with prof_mod.RecordEvent("forward"):
+            np.dot(np.ones((64, 64)), np.ones((64, 64)))
+        with prof_mod.RecordEvent("backward"):
+            pass
+        p.step()
+    p.stop()
+    names = {r[0] for r in got["rows"]}
+    assert "forward" in names and "backward" in names
+    trace = json.load(open(got["path"]))
+    assert any(e["name"] == "forward" for e in trace["traceEvents"])
+    fwd = next(r for r in got["rows"] if r[0] == "forward")
+    assert fwd[1] == 3 and fwd[2] > 0
+
+
+def test_profiler_scheduler_gates_recording():
+    from paddle_tpu import profiler as prof_mod
+    p = prof_mod.Profiler(timer_only=True,
+                          scheduler=prof_mod.make_scheduler(closed=2, ready=0,
+                                                            record=2))
+    p.start()
+    for i in range(4):
+        with prof_mod.RecordEvent("op"):
+            pass
+        p.step()
+    # steps 0,1 closed; 2,3 recording -> exactly 2 'op' events kept
+    assert sum(1 for e in p._events if e.name == "op") == 2
+    p.stop()
+
+
+def test_summary_table():
+    from paddle_tpu import profiler as prof_mod
+    p = prof_mod.Profiler(timer_only=True).start()
+    with prof_mod.RecordEvent("x"):
+        pass
+    table = p.summary()
+    assert "x" in table and "Calls" in table
+    p.stop()

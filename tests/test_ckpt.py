@@ -143,6 +143,32 @@ def test_load_returns_device_arrays_by_default(tmp_path):
     assert isinstance(ckpt.load(p, return_numpy=True)["w"], np.ndarray)
 
 
+def test_nested_vs_dotted_keys_no_collision(tmp_path):
+    d = str(tmp_path / "ck")
+    ckpt.save_state_dict({"a": {"b": np.ones(3)}, "a.b": np.zeros(3)}, d)
+    out = ckpt.load_state_dict(d)
+    np.testing.assert_array_equal(out["a/b"], np.ones(3))
+    np.testing.assert_array_equal(out["a.b"], np.zeros(3))
+
+
+def test_resave_drops_stale_rank_metadata(tmp_path):
+    import json
+    d = str(tmp_path / "ck")
+    ckpt.save_state_dict({"new": np.ones(2)}, d)
+    # simulate leftovers from an older 2-host save of a deleted key
+    np.save(os.path.join(d, "old_param.0-2.npy"), np.zeros(2))
+    stale = {"format": "paddle_tpu.ckpt.v1", "process_count": 2,
+             "arrays": {"old_param": {"dtype": "float32", "shape": [2],
+                                      "files": [{"ranges": [[0, 2]],
+                                                 "file": "old_param.0-2.npy"}]}},
+             "objects": {}}
+    with open(os.path.join(d, "metadata.1.json"), "w") as f:
+        json.dump(stale, f)
+    out = ckpt.load_state_dict(d)
+    assert "old_param" not in out  # stale higher-rank metadata ignored
+    np.testing.assert_array_equal(out["new"], np.ones(2))
+
+
 def test_missing_key_raises(tmp_path):
     d = str(tmp_path / "ck")
     ckpt.save_state_dict({"a": np.ones(2)}, d)
