@@ -37,6 +37,7 @@ from .moe import MoELayer  # noqa: F401
 from . import cp  # noqa: F401
 from .cp import (ring_attention, ulysses_attention,  # noqa: F401
                  context_parallel_attention)
+from .spawn import spawn  # noqa: F401
 
 
 def get_hybrid_communicate_group():

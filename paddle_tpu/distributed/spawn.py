@@ -1,0 +1,72 @@
+"""``paddle_tpu.distributed.spawn``: single-node multiprocess launch API.
+
+Reference: python/paddle/distributed/spawn.py — programmatic alternative to
+the launch CLI; spawns nprocs local processes running fn(rank, *args) with
+the env protocol set.
+
+TPU note: a TPU host normally runs ONE process driving all local chips, so
+on real hardware nprocs defaults to 1 and spawn exists mainly for porting
+parity and CPU-mesh testing (each child gets its own virtual device set via
+JAX_PLATFORMS=cpu).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import traceback
+from typing import Optional, Sequence
+
+from ..launch.store import free_port
+
+
+def _worker(fn, rank: int, nprocs: int, coordinator: str, args, err_q):
+    os.environ["PDTPU_PROCESS_ID"] = str(rank)
+    os.environ["PDTPU_NUM_PROCESSES"] = str(nprocs)
+    os.environ["PDTPU_COORDINATOR"] = coordinator
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    try:
+        fn(rank, *args)
+    except Exception:  # noqa: BLE001 — relay to parent
+        err_q.put((rank, traceback.format_exc()))
+        raise
+
+
+def spawn(fn, args: Sequence = (), nprocs: int = 1,
+          join: bool = True, daemon: bool = False,
+          coordinator: Optional[str] = None):
+    """Spawn ``nprocs`` processes running ``fn(rank, *args)``."""
+    coordinator = coordinator or f"127.0.0.1:{free_port()}"
+    if nprocs == 1 and join:
+        # fast path, in-process (matches reference behaviour for nprocs=1);
+        # still sets the env protocol so fn sees the same contract as the
+        # subprocess path
+        for k, v in (("PDTPU_PROCESS_ID", "0"), ("PDTPU_NUM_PROCESSES", "1"),
+                     ("PDTPU_COORDINATOR", coordinator),
+                     ("PADDLE_TRAINER_ID", "0"), ("PADDLE_TRAINERS_NUM", "1")):
+            os.environ[k] = v
+        fn(0, *args)
+        return None
+    ctx = mp.get_context("spawn")
+    err_q = ctx.SimpleQueue()
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(fn, rank, nprocs, coordinator, tuple(args),
+                              err_q),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if not join:
+        return procs
+    for p in procs:
+        p.join()
+    fails = [p.exitcode for p in procs if p.exitcode]
+    if fails:
+        msg = ""
+        while not err_q.empty():
+            rank, tb = err_q.get()
+            msg += f"\n--- rank {rank} ---\n{tb}"
+        raise RuntimeError(f"spawn: {len(fails)} process(es) failed{msg}")
+    return None

@@ -1,0 +1,21 @@
+"""Distributed launcher (``python -m paddle_tpu.launch``).
+
+Reference: python/paddle/distributed/launch/ — main.py (CLI),
+controllers/collective.py + controllers/controller.py (watch loop),
+controllers/master.py (HTTP/etcd rendezvous), job/pod.py, job/container.py
+(process model), context/ (args + device detect);
+python/paddle/distributed/fleet/elastic/manager.py (restart-based elastic).
+
+TPU redesign: one training process per *host* (a TPU host owns all its local
+chips through one jax runtime, unlike one-proc-per-GPU), rendezvous through a
+small TCPStore (paddle_tpu.launch.store — the reference's TCPStore analogue;
+jax.distributed's coordination service handles the device-level bootstrap),
+restart-based elasticity with preemption watch (SIGTERM → checkpoint window
+→ relaunch), per-rank log capture under --log_dir.
+"""
+
+from .context import Context, parse_args  # noqa: F401
+from .controller import CollectiveController  # noqa: F401
+from .job import Container, Job, Pod  # noqa: F401
+from .main import launch  # noqa: F401
+from .store import TCPStore  # noqa: F401

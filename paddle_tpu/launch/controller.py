@@ -1,0 +1,111 @@
+"""Collective controller: build the job, deploy the pod, watch it.
+
+Reference: python/paddle/distributed/launch/controllers/controller.py
+(watch loop: child exit → fail or elastic restart) and
+controllers/collective.py (collective job build). §3.5 call stack.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import time
+
+from .elastic import ElasticManager
+from .job import Job, Pod, build_container
+from .master import Master
+from .store import free_port
+
+logger = logging.getLogger("paddle_tpu.launch")
+
+
+class CollectiveController:
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.generation = 0
+
+    BASE_PORT = 6170  # reference launcher's default trainer base port
+
+    def _build_pod(self, master: Master, node_rank: int,
+                   hosts: list) -> Pod:
+        ctx = self.ctx
+        world = ctx.world_size
+        # one coordination endpoint for jax.distributed.initialize: port on
+        # the store host, stable across the generation
+        coord_host = master.store.endpoint.rsplit(":", 1)[0]
+        coord_key = f"job/{ctx.job_id}/gen{self.generation}/coord"
+        if node_rank == 0:
+            coord = f"{coord_host}:{free_port()}"
+            master.store.set(coord_key, coord.encode())
+        else:
+            coord = master.store.wait(coord_key).decode()
+        endpoints = [f"{hosts[g // ctx.nproc_per_node]}:"
+                     f"{self.BASE_PORT + g % ctx.nproc_per_node}"
+                     for g in range(world)]
+        pod = Pod()
+        for local in range(ctx.nproc_per_node):
+            g = node_rank * ctx.nproc_per_node + local
+            pod.containers.append(
+                build_container(ctx, g, local, world, coord, endpoints))
+        return pod
+
+    def run(self) -> int:
+        ctx = self.ctx
+        restarts = 0
+        while True:
+            master = Master(ctx, generation=self.generation)
+            node_rank, hosts = master.rendezvous()
+            pod = self._build_pod(master, node_rank, hosts)
+            elastic = None
+            if ctx.elastic_level > 0 and ctx.nnodes > 1:
+                elastic = ElasticManager(master.store, ctx.job_id, node_rank,
+                                         ctx.nnodes, ctx.elastic_timeout)
+                elastic.start()
+
+            stop_requested = {"flag": False}
+
+            def _on_term(signum, frame):
+                stop_requested["flag"] = True
+                pod.stop(grace=15.0)
+
+            prev = signal.signal(signal.SIGTERM, _on_term)
+            try:
+                pod.deploy()
+                code = self._watch(pod, elastic, stop_requested)
+            finally:
+                signal.signal(signal.SIGTERM, prev)
+                if elastic is not None:
+                    elastic.stop()
+                pod.stop()
+                master.close()
+
+            if code == 0 or stop_requested["flag"]:
+                return 0 if stop_requested["flag"] else code
+            if ctx.elastic_level > 0 and restarts < ctx.max_restarts:
+                restarts += 1
+                self.generation += 1
+                logger.warning("job failed (code %s); elastic restart %d/%d",
+                               code, restarts, ctx.max_restarts)
+                time.sleep(1.0)
+                continue
+            return code
+
+    def _watch(self, pod: Pod, elastic, stop_requested) -> int:
+        """Poll containers (and, in elastic mode, peer heartbeats)."""
+        while True:
+            if stop_requested["flag"]:
+                return 0
+            if not pod.alive():
+                return pod.join()
+            if pod.failed():
+                logger.error("container failed; tearing down pod")
+                pod.stop()
+                return pod.join() or 1
+            if elastic is not None:
+                dead = elastic.dead_nodes()
+                if dead:
+                    logger.error("peer node(s) %s lost; restarting", dead)
+                    pod.stop()
+                    pod.join()
+                    return 1
+            time.sleep(0.2)

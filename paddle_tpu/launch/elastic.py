@@ -1,0 +1,74 @@
+"""Restart-based elastic manager.
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py — etcd
+membership with heartbeats; on membership change within elastic_timeout the
+job's processes are killed and relaunched with recomputed ranks. State
+continuity relies on user checkpoints (paddle_tpu.ckpt resume), exactly as
+in the reference; on TPU the same path also covers preemption (SIGTERM from
+the scheduler → graceful stop → relaunch on the surviving slice).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from .store import TCPStore
+
+
+class ElasticManager:
+    """Heartbeat this node into the store and watch peer liveness."""
+
+    def __init__(self, store: TCPStore, job_id: str, node_rank: int,
+                 nnodes: int, timeout: float = 30.0,
+                 heartbeat_period: float = 2.0):
+        self.store = store
+        self.job_id = job_id
+        self.node_rank = node_rank
+        self.nnodes = nnodes
+        self.timeout = timeout
+        self.heartbeat_period = heartbeat_period
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._started_at: Optional[float] = None
+
+    def _key(self, rank: int) -> str:
+        return f"elastic/{self.job_id}/hb/{rank}"
+
+    def start(self) -> None:
+        self._started_at = time.time()
+        self._thread = threading.Thread(target=self._beat, daemon=True,
+                                        name="pdtpu-elastic-hb")
+        self._thread.start()
+
+    def _beat(self) -> None:
+        while not self._stop.is_set():
+            self.store.set(self._key(self.node_rank),
+                           repr(time.time()).encode())
+            self._stop.wait(self.heartbeat_period)
+
+    def dead_nodes(self) -> list:
+        """Ranks whose heartbeat is older than the timeout.
+
+        A peer with NO heartbeat yet is only dead once the startup grace
+        period (= timeout, measured from our own start()) has elapsed —
+        otherwise a node still deploying its pod would trigger a spurious
+        restart on every generation."""
+        now = time.time()
+        in_grace = (self._started_at is not None
+                    and now - self._started_at <= self.timeout)
+        dead = []
+        for r in range(self.nnodes):
+            raw = self.store.get(self._key(r))
+            if raw is None:
+                if not in_grace:
+                    dead.append(r)
+            elif now - float(raw) > self.timeout:
+                dead.append(r)
+        return dead
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -1,0 +1,25 @@
+"""CLI entry: ``python -m paddle_tpu.launch [opts] script.py [args]``.
+
+Reference: python/paddle/distributed/launch/main.py (fleetrun alias).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import List, Optional
+
+from .context import parse_args
+from .controller import CollectiveController
+
+
+def launch(argv: Optional[List[str]] = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    ctx = parse_args(argv)
+    return CollectiveController(ctx).run()
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

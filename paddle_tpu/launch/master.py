@@ -1,0 +1,69 @@
+"""Rendezvous master: rank assignment + peer discovery over TCPStore.
+
+Reference: python/paddle/distributed/launch/controllers/master.py
+(HTTPMaster for static clusters, ETCDMaster for elastic). Here one
+implementation covers both: node rank 0 embeds the store server; every node
+registers, waits for the full membership list, and derives global ranks.
+Elastic mode reuses the same store for heartbeats (elastic.py).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import List, Tuple
+
+from .store import TCPStore, free_port
+
+
+class Master:
+    def __init__(self, ctx, generation: int = 0):
+        self.ctx = ctx
+        self.generation = generation
+        endpoint = ctx.master or f"127.0.0.1:{free_port()}"
+        timeout = max(60.0, ctx.elastic_timeout * 2)
+        if ctx.nnodes == 1 or ctx.rank == 0 or ctx.master is None:
+            self.store = TCPStore(endpoint, is_master=True, timeout=timeout)
+        else:
+            # With auto rank assignment (--rank -1) no node knows it is the
+            # master, so the node whose address can bind the endpoint hosts
+            # the store and everyone else connects (first-binder-wins; a
+            # non-local or already-bound address raises OSError → client).
+            try:
+                self.store = TCPStore(endpoint, is_master=True,
+                                      timeout=timeout)
+            except OSError:
+                self.store = TCPStore(endpoint, is_master=False,
+                                      timeout=timeout)
+
+    def _key(self, name: str) -> str:
+        return f"job/{self.ctx.job_id}/gen{self.generation}/{name}"
+
+    def rendezvous(self) -> Tuple[int, List[str]]:
+        """Register this node, wait for everyone, return
+        (node_rank, all-node host list in rank order)."""
+        ctx = self.ctx
+        if ctx.nnodes == 1:
+            return 0, [ctx.host]
+        seq = self.store.add(self._key("joined"), 1) - 1
+        node_rank = ctx.rank if ctx.rank >= 0 else seq
+        info = json.dumps({"host": ctx.host, "nproc": ctx.nproc_per_node})
+        self.store.set(self._key(f"node/{node_rank}"), info.encode())
+        # wait for full membership
+        deadline = time.monotonic() + self.store.timeout
+        while True:
+            nodes = self.store.keys(self._key("node/"))
+            if len(nodes) >= ctx.nnodes:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"rendezvous: {len(nodes)}/{ctx.nnodes} nodes joined")
+            time.sleep(0.1)
+        hosts = []
+        for r in range(ctx.nnodes):
+            raw = self.store.wait(self._key(f"node/{r}"))
+            hosts.append(json.loads(raw)["host"])
+        return node_rank, hosts
+
+    def close(self):
+        self.store.close()

