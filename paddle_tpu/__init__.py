@@ -68,8 +68,22 @@ def __getattr__(name):
     import importlib
     if name in ("distributed", "io", "ckpt", "models", "profiler", "metrics",
                 "vision", "incubate", "hapi", "static", "device", "launch",
-                "utils", "config"):
-        mod = importlib.import_module(f".{name}", __name__)
+                "utils", "config", "sparse", "quantization"):
+        try:
+            mod = importlib.import_module(f".{name}", __name__)
+        except ModuleNotFoundError as e:
+            # keep hasattr()/getattr() probing working for not-yet-built
+            # submodules
+            raise AttributeError(
+                f"module 'paddle_tpu' has no attribute {name!r}") from e
         globals()[name] = mod
         return mod
+    if name == "Model":  # paddle.Model lives in hapi
+        from .hapi import Model
+        globals()["Model"] = Model
+        return Model
+    if name == "metric":  # paddle.metric alias
+        from . import metrics
+        globals()["metric"] = metrics
+        return metrics
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

@@ -1,0 +1,9 @@
+"""High-level training API (``paddle.Model`` / ``paddle.hapi`` parity).
+
+Reference: python/paddle/hapi/model.py, callbacks.py, progressbar.py.
+"""
+
+from .callbacks import (Callback, CallbackList, EarlyStopping,  # noqa: F401
+                        LogWriterCallback, LRScheduler, ModelCheckpoint,
+                        ProgBarLogger, config_callbacks)
+from .model import Model  # noqa: F401

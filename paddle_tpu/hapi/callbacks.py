@@ -1,0 +1,280 @@
+"""hapi callbacks (reference: python/paddle/hapi/callbacks.py).
+
+Callback zoo: ProgBarLogger, ModelCheckpoint, EarlyStopping, LRScheduler,
+plus the config/dispatch machinery (``config_callbacks`` → CallbackList).
+VisualDL is replaced by ``LogWriterCallback`` writing JSONL through
+paddle_tpu.metrics sinks (VisualDL itself is GPU-stack tooling).
+"""
+
+from __future__ import annotations
+
+import numbers
+import os
+import time
+from typing import Dict, List, Optional
+
+
+def _scalar(v):
+    """Materialize a 0-d device array to a Python float (logs carry device
+    arrays until a callback actually consumes them)."""
+    if hasattr(v, "ndim") and getattr(v, "ndim", None) == 0:
+        return float(v)
+    return v
+
+
+class Callback:
+    """Base class; hooks mirror the reference exactly so ported callbacks
+    drop in."""
+
+    def __init__(self):
+        self.model = None
+        self.params: Dict = {}
+
+    def set_params(self, params: Dict):
+        self.params = dict(params or {})
+
+    def set_model(self, model):
+        self.model = model
+
+    # train
+    def on_train_begin(self, logs=None): ...
+    def on_train_end(self, logs=None): ...
+    def on_epoch_begin(self, epoch, logs=None): ...
+    def on_epoch_end(self, epoch, logs=None): ...
+    def on_train_batch_begin(self, step, logs=None): ...
+    def on_train_batch_end(self, step, logs=None): ...
+    # eval
+    def on_eval_begin(self, logs=None): ...
+    def on_eval_end(self, logs=None): ...
+    def on_eval_batch_begin(self, step, logs=None): ...
+    def on_eval_batch_end(self, step, logs=None): ...
+    # predict
+    def on_predict_begin(self, logs=None): ...
+    def on_predict_end(self, logs=None): ...
+    def on_predict_batch_begin(self, step, logs=None): ...
+    def on_predict_batch_end(self, step, logs=None): ...
+
+
+class CallbackList:
+    def __init__(self, callbacks: List[Callback]):
+        self.callbacks = list(callbacks)
+
+    def set_params(self, params):
+        for c in self.callbacks:
+            c.set_params(params)
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def call(self, name, *args, **kwargs):
+        for c in self.callbacks:
+            getattr(c, name)(*args, **kwargs)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            return lambda *a, **k: self.call(name, *a, **k)
+        raise AttributeError(name)
+
+
+class ProgBarLogger(Callback):
+    """Per-epoch progress logging (reference ProgBarLogger; verbose 0/1/2)."""
+
+    def __init__(self, log_freq: int = 10, verbose: int = 2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def _fmt(self, logs):
+        parts = []
+        for k, v in (logs or {}).items():
+            v = _scalar(v)
+            if isinstance(v, numbers.Number):
+                parts.append(f"{k}: {v:.4f}")
+            elif isinstance(v, (list, tuple)) and v and isinstance(v[0], numbers.Number):
+                parts.append(f"{k}: " + "/".join(f"{x:.4f}" for x in v))
+        return " - ".join(parts)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._t0 = time.monotonic()
+        if self.verbose:
+            print(f"Epoch {epoch + 1}/{self.params.get('epochs', '?')}")
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose > 1 and (step + 1) % self.log_freq == 0:
+            n = self.params.get("steps")
+            print(f"step {step + 1}/{n if n else '?'} - {self._fmt(logs)}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dt = time.monotonic() - self._t0
+            print(f"Epoch {epoch + 1} done in {dt:.1f}s - {self._fmt(logs)}")
+
+    def on_eval_end(self, logs=None):
+        if self.verbose:
+            print(f"Eval - {self._fmt(logs)}")
+
+
+class ModelCheckpoint(Callback):
+    """Save every ``save_freq`` epochs + final (reference ModelCheckpoint)."""
+
+    def __init__(self, save_freq: int = 1, save_dir: Optional[str] = None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and (epoch + 1) % self.save_freq == 0:
+            path = os.path.join(self.save_dir, f"{epoch}")
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.save_dir:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class EarlyStopping(Callback):
+    """Stop when a monitored metric stops improving (reference parity:
+    monitor/mode/patience/min_delta/baseline/save_best_model)."""
+
+    def __init__(self, monitor: str = "loss", mode: str = "auto",
+                 patience: int = 0, verbose: int = 1, min_delta: float = 0.0,
+                 baseline: Optional[float] = None,
+                 save_best_model: bool = True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        if mode not in ("auto", "min", "max"):
+            mode = "auto"
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.best = None
+        self.wait = 0
+        self.stopped_epoch = -1
+
+    def _better(self, cur, best) -> bool:
+        if best is None:
+            return True
+        delta = cur - best
+        return delta > self.min_delta if self.mode == "max" \
+            else -delta > self.min_delta
+
+    def on_train_begin(self, logs=None):
+        self.wait = 0
+        self.best = self.baseline
+
+    def on_eval_end(self, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0]
+        if self._better(cur, self.best):
+            self.best = cur
+            self.wait = 0
+            if self.save_best_model and self.params.get("save_dir"):
+                self.model.save(os.path.join(self.params["save_dir"],
+                                             "best_model"))
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.model.stop_training = True
+                if self.verbose:
+                    print(f"EarlyStopping: no {self.monitor} improvement "
+                          f"in {self.wait} evals; stopping")
+
+
+class LRScheduler(Callback):
+    """Steps the optimizer's LRScheduler (reference: LRScheduler callback;
+    by_step/by_epoch). Our schedules are pure step-count functions inside
+    the compiled step, so this only drives *stateful* schedulers (e.g.
+    ReduceOnPlateau-style) that expose ``.step()``."""
+
+    def __init__(self, by_step: bool = True, by_epoch: bool = False):
+        super().__init__()
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if hasattr(lr, "step") else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if self.by_epoch and s is not None:
+            s.step()
+
+
+class LogWriterCallback(Callback):
+    """JSONL metric stream (in place of the reference's VisualDL callback)."""
+
+    def __init__(self, log_dir: str, log_freq: int = 1):
+        super().__init__()
+        self.log_dir = log_dir
+        self.log_freq = log_freq
+        self._fh = None
+        self._global_step = 0
+
+    def on_train_begin(self, logs=None):
+        import json  # noqa: F401 — opened lazily so predict-only runs skip IO
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._fh = open(os.path.join(self.log_dir, "metrics.jsonl"), "a")
+
+    def _write(self, tag, step, logs):
+        import json
+        if self._fh is None:
+            return
+        rec = {"tag": tag, "step": int(step)}
+        for k, v in (logs or {}).items():
+            v = _scalar(v)
+            if isinstance(v, numbers.Number):
+                rec[k] = float(v)
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._global_step += 1
+        if (step + 1) % self.log_freq == 0:
+            self._write("train", self._global_step, logs)
+
+    def on_eval_end(self, logs=None):
+        # stamped with the training global step so multi-epoch eval curves
+        # are ordered
+        self._write("eval", self._global_step, logs)
+
+    def on_train_end(self, logs=None):
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+
+def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
+                     log_freq=10, verbose=2, save_freq=1, save_dir=None,
+                     metrics=None, mode="train") -> CallbackList:
+    """Assemble the default callback set around user callbacks (reference
+    config_callbacks)."""
+    cbks = list(callbacks or [])
+    if not any(isinstance(c, ProgBarLogger) for c in cbks) and verbose:
+        cbks.append(ProgBarLogger(log_freq, verbose=verbose))
+    if not any(isinstance(c, LRScheduler) for c in cbks):
+        cbks.append(LRScheduler())
+    if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbks):
+        cbks.append(ModelCheckpoint(save_freq, save_dir))
+    lst = CallbackList(cbks)
+    lst.set_model(model)
+    lst.set_params({"epochs": epochs, "steps": steps, "verbose": verbose,
+                    "metrics": metrics or [], "save_dir": save_dir,
+                    "mode": mode})
+    return lst

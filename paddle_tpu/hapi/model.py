@@ -1,0 +1,327 @@
+"""``paddle.Model`` high-level API (reference: python/paddle/hapi/model.py).
+
+TPU redesign: the reference's Model drives dygraph per-op execution (or a
+static Program); here fit/evaluate/predict drive ONE jitted step each —
+train step = value_and_grad + optimizer apply with donated state, eval /
+predict steps = jitted pure forwards — so the whole epoch loop runs without
+per-op Python dispatch. Host-side work is only metric accumulation
+(paddle_tpu.metrics NumPy reducers) and callbacks.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import Layer, functional_call, raw_params
+from .callbacks import config_callbacks
+
+
+def _as_tuple(x):
+    if x is None:
+        return ()
+    return tuple(x) if isinstance(x, (tuple, list)) else (x,)
+
+
+class Model:
+    """Wraps a ``Layer`` with fit/evaluate/predict/save/load.
+
+    ``inputs``/``labels`` (InputSpec lists in the reference) are optional
+    here — jax shapes flow from the data — but their *lengths* still define
+    how a dataloader batch tuple splits into inputs vs labels (default: all
+    but the last element are inputs)."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self.stop_training = False
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List = []
+        self._state: Optional[Dict[str, Any]] = None
+        self._train_step = None
+        self._forward_step = None
+
+    # -- setup -------------------------------------------------------------
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        del amp_configs  # bf16 is the TPU default; fp16 GradScaler lives in jit.TrainStep
+        self._optimizer = optimizer
+        self._loss = loss
+        ms = _as_tuple(metrics)
+        from ..metrics import Metric
+        for m in ms:
+            if not isinstance(m, Metric):
+                raise ValueError(f"metrics must be paddle_tpu.metrics.Metric, got {m!r}")
+        self._metrics = list(ms)
+        self._train_step = self._forward_step = None
+        self._state = None
+
+    def _n_labels(self) -> int:
+        return len(self._labels) if self._labels else 1
+
+    def _split_batch(self, batch):
+        if isinstance(batch, dict):
+            raise ValueError("hapi Model expects tuple/list batches "
+                             "(inputs..., labels...)")
+        batch = _as_tuple(batch)
+        n = self._n_labels()
+        if len(batch) <= n:   # predict-style batch: everything is input
+            return batch, ()
+        return batch[:-n], batch[-n:]
+
+    def _ensure_state(self):
+        if self._state is None:
+            params = raw_params(self.network)
+            self._state = {"params": params, "step": jnp.zeros((), jnp.int32),
+                           "rng": jax.random.key(0)}
+            if self._optimizer is not None:
+                self._state["opt"] = self._optimizer.init(params)
+        return self._state
+
+    # -- compiled steps ----------------------------------------------------
+
+    def _build_train_step(self):
+        net, opt, loss_fn = self.network, self._optimizer, self._loss
+
+        def compute_loss(params, inputs, labels, key):
+            preds = functional_call(net, params, *inputs, rngs=key,
+                                    training=True)
+            loss = loss_fn(*(_as_tuple(preds) + tuple(labels)))
+            return loss, _as_tuple(preds)
+
+        @jax.jit
+        def step(state, inputs, labels):
+            key = jax.random.fold_in(state["rng"], state["step"])
+            (loss, preds), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(state["params"], inputs, labels,
+                                            key)
+            params, opt_state = opt.apply(grads, state["opt"],
+                                          state["params"])
+            new = {"params": params, "opt": opt_state,
+                   "step": state["step"] + 1, "rng": state["rng"]}
+            return new, loss, preds
+
+        return step
+
+    def _infer_step(self):
+        """One shared jitted inference forward for eval AND predict (they
+        are identical programs; two attributes would compile twice)."""
+        if self._forward_step is None:
+            net = self.network
+
+            @jax.jit
+            def step(params, inputs):
+                return _as_tuple(functional_call(net, params, *inputs,
+                                                 training=False))
+
+            self._forward_step = step
+        return self._forward_step
+
+    # -- batch-level API (reference train_batch/eval_batch/predict_batch) --
+
+    def _train_one(self, inputs, labels):
+        """Run one compiled train step; loss stays ON DEVICE (no host sync —
+        fit() materializes it only at log boundaries)."""
+        if self._optimizer is None or self._loss is None:
+            raise RuntimeError("call prepare(optimizer, loss) before training")
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        state = self._ensure_state()
+        inputs, labels = _as_tuple(inputs), _as_tuple(labels)
+        self._state, loss, preds = self._train_step(state, inputs, labels)
+        metric_out = self._update_metrics(preds, labels) if self._metrics else {}
+        return loss, metric_out
+
+    def train_batch(self, inputs, labels=None):
+        loss, metric_out = self._train_one(inputs, labels)
+        return float(loss), metric_out
+
+    def eval_batch(self, inputs, labels=None):
+        state = self._ensure_state()
+        inputs, labels = _as_tuple(inputs), _as_tuple(labels)
+        preds = self._infer_step()(state["params"], inputs)
+        loss = None
+        if self._loss is not None and labels:
+            loss = float(self._loss(*(preds + labels)))
+        metric_out = self._update_metrics(preds, labels)
+        return loss, metric_out
+
+    def predict_batch(self, inputs):
+        state = self._ensure_state()
+        preds = self._infer_step()(state["params"], _as_tuple(inputs))
+        return [jax.device_get(p) for p in preds]
+
+    def _update_metrics(self, preds, labels):
+        out = {}
+        for m in self._metrics:
+            res = m.compute(*(tuple(preds) + tuple(labels)))
+            m.update(*_as_tuple(res))
+            names, vals = m.name(), m.accumulate()
+            # Metric.name()/accumulate() return lists for multi-output
+            # metrics (e.g. Accuracy with several topk)
+            if isinstance(names, (list, tuple)):
+                for n, v in zip(names, _as_tuple(vals)):
+                    out[n] = v
+            else:
+                out[names] = vals
+        return out
+
+    # -- loops -------------------------------------------------------------
+
+    def _to_loader(self, data, batch_size, shuffle):
+        from ..io import DataLoader, Dataset, IterableDataset
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, (Dataset, IterableDataset)):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+        return data  # any iterable of batches
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            shuffle=True, callbacks=None):
+        loader = self._to_loader(train_data, batch_size, shuffle)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=steps, log_freq=log_freq,
+                                verbose=verbose, save_freq=save_freq,
+                                save_dir=save_dir,
+                                metrics=[m.name() for m in self._metrics])
+        self.stop_training = False
+        cbks.on_train_begin()
+        logs: Dict[str, Any] = {}
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                # loss stays a device array here; callbacks materialize it
+                # only when they actually log (log_freq / epoch end)
+                loss, metric_out = self._train_one(inputs, labels)
+                logs = {"loss": loss, **metric_out}
+                cbks.on_train_batch_end(step, logs)
+                if self.stop_training:
+                    break
+            logs = {k: (float(v) if hasattr(v, "ndim") else v)
+                    for k, v in logs.items()}
+            cbks.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_data, batch_size=batch_size,
+                                          verbose=0, _callbacks=cbks)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            if self.stop_training:
+                break
+        cbks.on_train_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 callbacks=None, _callbacks=None):
+        loader = self._to_loader(eval_data, batch_size, shuffle=False)
+        cbks = _callbacks or config_callbacks(
+            callbacks, model=self, log_freq=log_freq, verbose=verbose,
+            metrics=[m.name() for m in self._metrics], mode="eval")
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin()
+        losses = []   # (loss, n_samples) — sample-weighted so a short final
+        metric_out: Dict[str, Any] = {}   # batch doesn't skew the mean
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            inputs, labels = self._split_batch(batch)
+            loss, metric_out = self.eval_batch(inputs, labels)
+            if loss is not None:
+                first = (labels or inputs)[0]
+                n = int(first.shape[0]) if hasattr(first, "shape") else 1
+                losses.append((loss, n))
+            cbks.on_eval_batch_end(step, {"loss": loss, **metric_out})
+        logs = dict(metric_out)
+        if losses:
+            total = sum(n for _, n in losses)
+            logs["loss"] = sum(l * n for l, n in losses) / max(total, 1)
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, callbacks=None, verbose=0):
+        loader = self._to_loader(test_data, batch_size, shuffle=False)
+        cbks = config_callbacks(callbacks, model=self, verbose=verbose,
+                                mode="predict")
+        cbks.on_predict_begin()
+        outputs: List = []
+        for step, batch in enumerate(loader):
+            cbks.on_predict_batch_begin(step)
+            inputs, _ = self._split_batch(batch)
+            out = self.predict_batch(inputs)
+            outputs.append(out)
+            cbks.on_predict_batch_end(step)
+        cbks.on_predict_end()
+        # regroup: list-of-batches → tuple-of-output-streams (reference shape)
+        if not outputs:
+            return []
+        n_out = len(outputs[0])
+        return [[b[i] for b in outputs] for i in range(n_out)]
+
+    # -- persistence -------------------------------------------------------
+
+    def save(self, path: str, training: bool = True):
+        """``path + '.pdparams'`` (+ ``'.pdopt'``) like the reference."""
+        from .. import save as pt_save
+        self._sync_params_to_network()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        pt_save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None and self._state is not None \
+                and "opt" in self._state:
+            pt_save({"opt": self._state["opt"],
+                     "step": self._state["step"]}, path + ".pdopt")
+
+    def load(self, path: str, skip_mismatch: bool = False, reset_optimizer: bool = False):
+        from .. import load as pt_load
+        sd = pt_load(path + ".pdparams")
+        if skip_mismatch:
+            current = self.network.state_dict()
+            dropped = [k for k, v in sd.items()
+                       if k in current and hasattr(v, "shape")
+                       and tuple(current[k].shape) != tuple(v.shape)]
+            for k in dropped:
+                sd.pop(k)
+            if dropped:
+                print(f"Model.load: skipped {len(dropped)} mismatched "
+                      f"entries: {dropped}")
+        self.network.set_state_dict(sd)
+        self._state = None  # re-seeded from network params on next step
+        if not reset_optimizer and os.path.exists(path + ".pdopt") \
+                and self._optimizer is not None:
+            opt = pt_load(path + ".pdopt")
+            self._ensure_state()
+            self._state["opt"] = opt["opt"]
+            self._state["step"] = jnp.asarray(opt["step"])
+
+    def _sync_params_to_network(self):
+        """Write the trained functional state back into the Layer."""
+        if self._state is not None:
+            for k, v in self._state["params"].items():
+                self.network._assign_by_path(k, v)
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        lines = [f"{type(self.network).__name__}:"]
+        total = 0
+        for name, p in self.network.named_parameters():
+            n = int(p.size)
+            total += n
+            lines.append(f"  {name:50s} {str(tuple(p.shape)):20s} {n}")
+        lines.append(f"Total params: {total}")
+        s = "\n".join(lines)
+        print(s)
+        return {"total_params": total}

@@ -1,0 +1,152 @@
+"""hapi Model API tests (reference test pattern: test/legacy_test
+hapi tests — fit/evaluate/predict on tiny data, callbacks, save/load)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.hapi import (EarlyStopping, LogWriterCallback,
+                             ModelCheckpoint, Model)
+from paddle_tpu.io import TensorDataset
+from paddle_tpu.metrics import Accuracy
+from paddle_tpu.optimizer import AdamW
+
+
+def _toy_data(n=64, d=8, classes=4, seed=0):
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(n, d)).astype("float32")
+    w = r.normal(size=(d, classes)).astype("float32")
+    y = np.argmax(x @ w, axis=1).astype("int64")
+    return x, y
+
+
+def _mlp(d=8, classes=4):
+    return nn.Sequential(nn.Linear(d, 64), nn.ReLU(), nn.Linear(64, classes))
+
+
+def _ce(pred, label):
+    return pt.nn.functional.cross_entropy(pred, label).mean()
+
+
+class TestModelFit:
+    def test_fit_memorizes(self, capsys):
+        x, y = _toy_data()
+        m = Model(_mlp())
+        m.prepare(AdamW(learning_rate=1e-2, parameters=m.parameters()),
+                  loss=_ce, metrics=Accuracy())
+        ds = TensorDataset([x, y])
+        logs = m.fit(ds, batch_size=16, epochs=8, verbose=2, log_freq=2)
+        assert logs["acc"] > 0.9, logs
+        out = capsys.readouterr().out
+        assert "Epoch 1/8" in out and "loss" in out
+
+    def test_evaluate_and_predict(self):
+        x, y = _toy_data()
+        m = Model(_mlp())
+        m.prepare(AdamW(learning_rate=1e-2, parameters=m.parameters()),
+                  loss=_ce, metrics=Accuracy())
+        ds = TensorDataset([x, y])
+        m.fit(ds, batch_size=16, epochs=6, verbose=0)
+        ev = m.evaluate(ds, batch_size=16, verbose=0)
+        assert ev["acc"] > 0.9 and "loss" in ev
+        preds = m.predict(TensorDataset([x]), batch_size=16)
+        assert len(preds) == 1              # one output stream
+        assert len(preds[0]) == 4           # 64/16 batches
+        assert preds[0][0].shape == (16, 4)
+        all_preds = np.concatenate(preds[0])
+        acc = (np.argmax(all_preds, 1) == y).mean()
+        assert acc > 0.9
+
+    def test_train_batch_api(self):
+        x, y = _toy_data(n=16)
+        m = Model(_mlp())
+        m.prepare(AdamW(learning_rate=1e-2, parameters=m.parameters()),
+                  loss=_ce)
+        l0, _ = m.train_batch([jnp.asarray(x)], [jnp.asarray(y)])
+        for _ in range(30):
+            ln, _ = m.train_batch([jnp.asarray(x)], [jnp.asarray(y)])
+        assert ln < l0 * 0.5
+
+    def test_prepare_rejects_non_metric(self):
+        m = Model(_mlp())
+        with pytest.raises(ValueError):
+            m.prepare(metrics="accuracy")
+
+
+class TestCallbacks:
+    def test_early_stopping(self):
+        x, y = _toy_data()
+        m = Model(_mlp())
+        m.prepare(AdamW(learning_rate=1e-2, parameters=m.parameters()),
+                  loss=_ce, metrics=Accuracy())
+        ds = TensorDataset([x, y])
+        es = EarlyStopping(monitor="acc", patience=0, baseline=2.0,
+                           save_best_model=False, verbose=0)
+        m.fit(ds, eval_data=ds, batch_size=16, epochs=50, verbose=0,
+              callbacks=[es])
+        # baseline=2.0 is unreachable for accuracy → stops after 1st eval
+        assert m.stop_training
+        assert es.wait > es.patience
+
+    def test_model_checkpoint_and_logwriter(self, tmp_path):
+        x, y = _toy_data(n=32)
+        m = Model(_mlp())
+        m.prepare(AdamW(learning_rate=1e-2, parameters=m.parameters()),
+                  loss=_ce)
+        save_dir = str(tmp_path / "ck")
+        log_dir = str(tmp_path / "logs")
+        m.fit(TensorDataset([x, y]), batch_size=16, epochs=2, verbose=0,
+              save_dir=save_dir,
+              callbacks=[LogWriterCallback(log_dir, log_freq=1)])
+        assert os.path.exists(os.path.join(save_dir, "final.pdparams"))
+        assert os.path.exists(os.path.join(save_dir, "0.pdparams"))
+        lines = open(os.path.join(log_dir, "metrics.jsonl")).read().splitlines()
+        assert len(lines) >= 4
+        import json
+        rec = json.loads(lines[0])
+        assert rec["tag"] == "train" and "loss" in rec
+
+
+class TestSaveLoad:
+    def test_roundtrip_preserves_predictions(self, tmp_path):
+        x, y = _toy_data(n=32)
+        m = Model(_mlp())
+        m.prepare(AdamW(learning_rate=1e-2, parameters=m.parameters()),
+                  loss=_ce)
+        m.fit(TensorDataset([x, y]), batch_size=16, epochs=3, verbose=0)
+        path = str(tmp_path / "model")
+        m.save(path)
+        before = m.predict_batch([jnp.asarray(x)])[0]
+
+        m2 = Model(_mlp())
+        m2.prepare(AdamW(learning_rate=1e-2, parameters=m2.parameters()),
+                   loss=_ce)
+        m2.load(path)
+        after = m2.predict_batch([jnp.asarray(x)])[0]
+        np.testing.assert_allclose(np.asarray(before), np.asarray(after),
+                                   rtol=1e-5)
+        # optimizer state restored too
+        assert "opt" in m2._state and int(m2._state["step"]) > 0
+
+    def test_top_level_alias(self):
+        assert pt.Model is Model
+
+    def test_load_skip_mismatch(self, tmp_path):
+        x, y = _toy_data(n=16)
+        m = Model(_mlp(classes=4))
+        m.prepare(AdamW(learning_rate=1e-2, parameters=m.parameters()),
+                  loss=_ce)
+        path = str(tmp_path / "m4")
+        m.save(path)
+
+        m2 = Model(_mlp(classes=7))  # different head shape
+        with pytest.raises(ValueError):
+            m2.load(path)
+        m2.load(path, skip_mismatch=True)  # mismatched head entries skipped
+
+    def test_missing_submodule_probe(self):
+        assert not hasattr(pt, "definitely_not_a_module")
