@@ -649,6 +649,43 @@ class linalg:
     norm = staticmethod(jnp.linalg.norm)
     cond = staticmethod(jnp.linalg.cond)
     multi_dot = staticmethod(jnp.linalg.multi_dot)
+    lu_factor = staticmethod(jax.scipy.linalg.lu_factor)
+
+    @staticmethod
+    def lu(x, pivot=True, get_infos=False):
+        """paddle.linalg.lu packed convention: (LU, pivots[, infos]) with
+        1-based pivots — scipy's lu_factor layout, NOT scipy.linalg.lu's
+        (p, l, u) triple."""
+        lu_packed, piv = jax.scipy.linalg.lu_factor(x)
+        piv = piv.astype(jnp.int32) + 1
+        if get_infos:
+            infos = jnp.zeros(x.shape[:-2], jnp.int32)
+            return lu_packed, piv, infos
+        return lu_packed, piv
+
+    @staticmethod
+    def triangular_solve(x, y, upper=True, transpose=False,
+                         unitriangular=False):
+        return jax.scipy.linalg.solve_triangular(
+            x, y, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+
+    @staticmethod
+    def cholesky_solve(x, y, upper=False):
+        return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+    @staticmethod
+    def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+        return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                       fweights=fweights, aweights=aweights)
+
+    @staticmethod
+    def corrcoef(x, rowvar=True):
+        return jnp.corrcoef(x, rowvar=rowvar)
+
+    @staticmethod
+    def matrix_exp(x):
+        return jax.scipy.linalg.expm(x)
 
 
 class fft:
@@ -666,16 +703,21 @@ class fft:
     ifftshift = staticmethod(jnp.fft.ifftshift)
     fftfreq = staticmethod(jnp.fft.fftfreq)
     rfftfreq = staticmethod(jnp.fft.rfftfreq)
+    rfftn = staticmethod(jnp.fft.rfftn)
+    irfftn = staticmethod(jnp.fft.irfftn)
+    hfft = staticmethod(jnp.fft.hfft)
+    ihfft = staticmethod(jnp.fft.ihfft)
 
 
 logcumsumexp = getattr(jnp, "logcumsumexp", None) or (
     lambda x, axis=-1: jax.lax.associative_scan(jnp.logaddexp, x, axis=axis))
 
+from .more import *  # noqa: F401,F403,E402 — breadth ops (see more.py)
 
 # Star-export surface: everything public defined here, nothing imported.
-_EXCLUDE = {"jax", "jnp", "np", "dispatch", "Optional", "Sequence", "Union",
-            "Tensor", "convert_dtype", "get_default_dtype", "to_tensor",
-            "annotations"}
+_EXCLUDE = {"jax", "jnp", "np", "dispatch", "more", "Optional", "Sequence",
+            "Union", "Tensor", "convert_dtype", "get_default_dtype",
+            "to_tensor", "annotations"}
 __all__ = [_n for _n in dir() if not _n.startswith("_") and _n not in _EXCLUDE]
 
 # Register Pallas TPU kernels into the dispatch table (no-op off-TPU: the

@@ -1,0 +1,413 @@
+"""Tensor-op breadth: the remaining ``paddle.*`` public-op surface.
+
+Reference: python/paddle/tensor/math.py, manipulation.py, creation.py,
+linalg.py, search.py — NaN-aware reductions, quantiles/histograms, cumulative
+max/min, split/stack families, index/diag utilities, complex-number views,
+misc special functions. Everything lowers to jnp/lax so XLA fuses it; no
+per-op kernels exist or are needed (SURVEY §7.3).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "nansum", "nanmean", "nanmedian", "nanquantile", "quantile", "histogram",
+    "histogramdd", "cummax", "cummin", "meshgrid", "tensor_split", "vsplit",
+    "hsplit", "dsplit", "atleast_1d", "atleast_2d", "atleast_3d", "unflatten",
+    "take", "expand_as", "unstack", "diag_embed", "diagflat", "tril_indices",
+    "triu_indices", "rot90", "block_diag", "bucketize", "heaviside", "gcd",
+    "lcm", "deg2rad", "rad2deg", "frac", "angle", "real", "imag", "conj",
+    "as_complex", "as_real", "complex", "copysign", "ldexp", "frexp",
+    "trapezoid", "cumulative_trapezoid", "vander", "renorm", "multiplex",
+    "index_put", "polygamma", "i0", "i0e", "i1", "i1e", "sgn", "signbit",
+    "nextafter", "log_normal", "clip_by_norm", "crop", "exponential_",
+    "isneginf", "isposinf", "isreal", "positive", "negative", "bitwise_left_shift",
+    "bitwise_right_shift", "reduce_as", "gammaln", "gammainc", "gammaincc",
+    "combinations", "unfold", "view", "view_as", "as_strided",
+]
+
+# -- NaN-aware reductions ---------------------------------------------------
+
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.nansum(x, axis=axis, dtype=dtype, keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=axis, keepdims=keepdim)
+
+
+def nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+def nanquantile(x, q, axis=None, keepdim=False):
+    return jnp.nanquantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim,
+                        method=interpolation)
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False):
+    """paddle.histogram: counts in [min, max) over `bins` buckets; when
+    min==max==0 the data range is used."""
+    if min == 0 and max == 0:
+        lo, hi = jnp.min(input), jnp.max(input)
+    else:
+        lo, hi = min, max
+    hist, _ = jnp.histogram(input.reshape(-1), bins=bins, range=(lo, hi),
+                            weights=None if weight is None else weight.reshape(-1),
+                            density=density)
+    return hist
+
+
+def histogramdd(sample, bins=10, ranges=None, density=False, weights=None):
+    return jnp.histogramdd(sample, bins=bins, range=ranges, density=density,
+                           weights=weights)
+
+
+# -- cumulative max/min -----------------------------------------------------
+
+def _cum_with_indices(x, axis, op, dtype):
+    from . import _index_dtype
+    axis = axis % x.ndim
+    vals = jax.lax.associative_scan(op, x, axis=axis)
+    # indices: position where the running extremum was last updated
+    eq = x == vals
+    idx = jnp.arange(x.shape[axis]).reshape(
+        [-1 if i == axis else 1 for i in range(x.ndim)])
+    idx = jnp.where(eq, idx, 0)
+    inds = jax.lax.associative_scan(jnp.maximum, idx, axis=axis)
+    return vals, inds.astype(_index_dtype(dtype))
+
+
+def cummax(x, axis=None, dtype="int64"):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return _cum_with_indices(x, axis, jnp.maximum, dtype)
+
+
+def cummin(x, axis=None, dtype="int64"):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return _cum_with_indices(x, axis, jnp.minimum, dtype)
+
+
+# -- manipulation -----------------------------------------------------------
+
+def meshgrid(*args):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return list(jnp.meshgrid(*args, indexing="ij"))
+
+
+def tensor_split(x, num_or_indices, axis=0):
+    return jnp.array_split(x, num_or_indices, axis=axis) \
+        if isinstance(num_or_indices, int) \
+        else jnp.split(x, num_or_indices, axis=axis)
+
+
+def vsplit(x, num_or_indices):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def hsplit(x, num_or_indices):
+    return tensor_split(x, num_or_indices, axis=1 if x.ndim > 1 else 0)
+
+
+def dsplit(x, num_or_indices):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+atleast_1d = jnp.atleast_1d
+atleast_2d = jnp.atleast_2d
+atleast_3d = jnp.atleast_3d
+
+
+def unflatten(x, axis, shape):
+    axis = axis % x.ndim
+    new_shape = x.shape[:axis] + tuple(shape) + x.shape[axis + 1:]
+    return x.reshape(new_shape)
+
+
+def take(x, index, mode="raise"):
+    """paddle.take: flat-index gather with clip/wrap modes."""
+    flat = x.reshape(-1)
+    idx = index.reshape(-1)
+    n = flat.shape[0]
+    if mode == "wrap":
+        idx = ((idx % n) + n) % n
+    else:  # raise is not expressible in compiled code; clip like paddle's 'clip'
+        idx = jnp.clip(idx, -n, n - 1)
+        idx = jnp.where(idx < 0, idx + n, idx)
+    return flat[idx].reshape(index.shape)
+
+
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+def unstack(x, axis=0, num=None):
+    axis = axis % x.ndim
+    n = num or x.shape[axis]
+    return [jnp.squeeze(s, axis) for s in jnp.split(x, n, axis=axis)]
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    """Batched diagonal construction (last-dim vector → matrix diag)."""
+    *batch, n = input.shape
+    m = n + abs(offset)
+    out = jnp.zeros((*batch, m, m), input.dtype)
+    idx = jnp.arange(n)
+    rows = idx + (-offset if offset < 0 else 0)
+    cols = idx + (offset if offset > 0 else 0)
+    out = out.at[..., rows, cols].set(input)
+    # then move the two new dims into (dim1, dim2) positions
+    nd = out.ndim
+    dim1, dim2 = dim1 % nd, dim2 % nd
+    if (dim1, dim2) != (nd - 2, nd - 1):
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        order = sorted([(dim1, nd - 2), (dim2, nd - 1)])
+        for pos, src in order:
+            perm.insert(pos, src)
+        out = jnp.transpose(out, perm)
+    return out
+
+
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    from . import _index_dtype
+    col = col if col is not None else row
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return jnp.stack([r, c]).astype(_index_dtype(dtype))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    from . import _index_dtype
+    col = col if col is not None else row
+    r, c = jnp.triu_indices(row, k=offset, m=col)
+    return jnp.stack([r, c]).astype(_index_dtype(dtype))
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+def block_diag(inputs):
+    return jax.scipy.linalg.block_diag(*inputs)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    from . import _index_dtype
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, x, side=side)
+    return out.astype(jnp.int32 if out_int32 else _index_dtype("int64"))
+
+
+def crop(x, shape=None, offsets=None):
+    import builtins  # plain python slice (ops.slice shadows the builtin here)
+    offsets = offsets or [0] * x.ndim
+    shape = list(shape) if shape is not None else \
+        [x.shape[i] - offsets[i] for i in range(x.ndim)]
+    # paddle semantics: shape entry -1 means "to the end"
+    shape = [x.shape[i] - offsets[i] if s == -1 else s
+             for i, s in enumerate(shape)]
+    slices = tuple(builtins.slice(int(o), int(o) + int(s))
+                   for o, s in zip(offsets, shape))
+    return x[slices]
+
+
+def unfold(x, axis, size, step):
+    """Tensor.unfold: sliding windows along ``axis``; the window dim is
+    appended LAST (paddle/torch convention), the count replaces ``axis``."""
+    axis = axis % x.ndim
+    n = (x.shape[axis] - size) // step + 1
+    starts = jnp.arange(n) * step
+    def win(s):
+        return jax.lax.dynamic_slice_in_dim(x, s, size, axis)
+    out = jax.vmap(win)(starts)          # (n, ..., size at axis+1 ...)
+    out = jnp.moveaxis(out, axis + 1, -1)  # window dim → last
+    return jnp.moveaxis(out, 0, axis)      # window count → axis
+
+
+def view(x, shape_or_dtype):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return x.reshape(shape_or_dtype)
+    return x.view(shape_or_dtype)
+
+
+def view_as(x, other):
+    return x.reshape(other.shape)
+
+
+def as_strided(x, shape, stride, offset=0):
+    """Limited as_strided: materializes via flat gather (XLA has no strided
+    aliasing); supports forward use, not in-place aliasing semantics."""
+    flat = x.reshape(-1)
+    idx = jnp.zeros(tuple(shape), jnp.int32) + offset
+    for dim, (s, st) in enumerate(zip(shape, stride)):
+        ax = jnp.arange(s) * st
+        idx = idx + ax.reshape([-1 if i == dim else 1
+                                for i in range(len(shape))])
+    return flat[idx.reshape(-1)].reshape(tuple(shape))
+
+
+def reduce_as(x, target):
+    """paddle.reduce_as: sum x down to target's shape."""
+    if x.shape == tuple(target.shape):
+        return x
+    nd = x.ndim - len(target.shape)
+    axes = list(range(nd))
+    for i, (a, b) in enumerate(zip(x.shape[nd:], target.shape)):
+        if b == 1 and a != 1:
+            axes.append(nd + i)
+    out = jnp.sum(x, axis=tuple(axes), keepdims=False)
+    return out.reshape(target.shape)
+
+
+# -- complex views ----------------------------------------------------------
+
+angle = jnp.angle
+real = jnp.real
+imag = jnp.imag
+conj = jnp.conj
+
+
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def complex(real_part, imag_part):
+    return jax.lax.complex(jnp.asarray(real_part, jnp.float32),
+                           jnp.asarray(imag_part, jnp.float32))
+
+
+# -- misc math --------------------------------------------------------------
+
+heaviside = jnp.heaviside
+gcd = jnp.gcd
+lcm = jnp.lcm
+deg2rad = jnp.deg2rad
+rad2deg = jnp.rad2deg
+copysign = jnp.copysign
+ldexp = jnp.ldexp
+frexp = jnp.frexp
+signbit = jnp.signbit
+nextafter = jnp.nextafter
+isneginf = jnp.isneginf
+isposinf = jnp.isposinf
+isreal = jnp.isreal
+positive = jnp.positive
+negative = jnp.negative
+bitwise_left_shift = jnp.left_shift
+bitwise_right_shift = jnp.right_shift
+gammaln = jax.scipy.special.gammaln
+gammainc = jax.scipy.special.gammainc
+gammaincc = jax.scipy.special.gammaincc
+i0 = jax.scipy.special.i0
+i0e = jax.scipy.special.i0e
+i1 = jax.scipy.special.i1
+i1e = jax.scipy.special.i1e
+
+
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+def sgn(x):
+    if jnp.iscomplexobj(x):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0, x / jnp.where(mag == 0, 1, mag))
+    return jnp.sign(x)
+
+
+def polygamma(x, n):
+    return jax.scipy.special.polygamma(n, x)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1):
+    return jnp.trapezoid(y, x=x, dx=1.0 if dx is None else dx, axis=axis)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1):
+    import jax.scipy.integrate as _ji
+    if hasattr(_ji, "cumulative_trapezoid"):
+        return _ji.cumulative_trapezoid(
+            y, x=x, dx=1.0 if dx is None else dx, axis=axis)
+    # manual: cumsum of trapezoid areas
+    y0 = jnp.moveaxis(y, axis, -1)
+    if x is not None:
+        xd = jnp.diff(jnp.moveaxis(jnp.broadcast_to(x, y0.shape), -1, -1),
+                      axis=-1)
+    else:
+        xd = 1.0 if dx is None else dx
+    areas = (y0[..., 1:] + y0[..., :-1]) * 0.5 * xd
+    return jnp.moveaxis(jnp.cumsum(areas, axis=-1), -1, axis)
+
+
+def vander(x, n=None, increasing=False):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+def renorm(x, p, axis, max_norm):
+    axis = axis % x.ndim
+    other = tuple(i for i in range(x.ndim) if i != axis)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=other, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor
+
+
+def multiplex(inputs, index):
+    """paddle.multiplex: per-row select among candidate tensors."""
+    stacked = jnp.stack(inputs)                    # (n_candidates, batch, ...)
+    idx = index.reshape(-1)
+    rows = jnp.arange(stacked.shape[1])
+    return stacked[idx, rows]
+
+
+def index_put(x, indices, value, accumulate=False):
+    if accumulate:
+        return x.at[tuple(indices)].add(value)
+    return x.at[tuple(indices)].set(value)
+
+
+def clip_by_norm(x, max_norm):
+    norm = jnp.sqrt(jnp.sum(x * x))
+    return jnp.where(norm > max_norm, x * (max_norm / norm), x)
+
+
+def log_normal(mean=1.0, std=2.0, shape=None):
+    from ..core import random as _random
+    key = _random.next_key()
+    return jnp.exp(mean + std * jax.random.normal(key, tuple(shape or (1,))))
+
+
+def exponential_(x, lam=1.0):
+    from ..core import random as _random
+    key = _random.next_key()
+    return jax.random.exponential(key, x.shape, x.dtype) / lam
+
+
+def combinations(x, r=2, with_replacement=False):
+    import itertools
+    n = x.shape[0]
+    combos = (itertools.combinations_with_replacement(range(n), r)
+              if with_replacement else itertools.combinations(range(n), r))
+    idx = jnp.asarray(list(combos), dtype=jnp.int32)
+    if idx.size == 0:
+        return jnp.zeros((0, r), x.dtype)
+    return x[idx]

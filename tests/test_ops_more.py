@@ -1,0 +1,256 @@
+"""NumPy-oracle tests for the breadth ops (reference pattern: OpTest
+compares kernel output against a NumPy reference impl — SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+R = np.random.default_rng(7)
+
+
+def A(*shape, dtype="float32"):
+    return R.normal(size=shape).astype(dtype)
+
+
+class TestNanReductions:
+    def test_nansum_mean_median(self):
+        x = A(4, 5)
+        x[1, 2] = np.nan
+        np.testing.assert_allclose(pt.nansum(x), np.nansum(x), rtol=1e-6)
+        np.testing.assert_allclose(pt.nanmean(x), np.nanmean(x), rtol=1e-6)
+        np.testing.assert_allclose(pt.nanmedian(x), np.nanmedian(x), rtol=1e-6)
+
+    def test_quantile(self):
+        x = A(64)
+        np.testing.assert_allclose(pt.quantile(x, 0.25),
+                                   np.quantile(x, 0.25), rtol=1e-5)
+        np.testing.assert_allclose(
+            pt.nanquantile(x, [0.1, 0.9]), np.nanquantile(x, [0.1, 0.9]),
+            rtol=1e-5)
+
+    def test_nansum_keepdim_and_weighted_histogram(self):
+        x = A(3, 4)
+        assert pt.nansum(x, axis=0, keepdim=True).shape == (1, 4)
+        assert pt.nanmean(x, axis=1, keepdim=True).shape == (3, 1)
+        w = np.abs(A(3, 4))
+        got = pt.histogram(pt.to_tensor(x), bins=4, min=-2, max=2,
+                           weight=pt.to_tensor(w))
+        want, _ = np.histogram(x.reshape(-1), bins=4, range=(-2, 2),
+                               weights=w.reshape(-1))
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+    def test_histogram(self):
+        x = A(100)
+        got = pt.histogram(x, bins=10, min=-2, max=2)
+        want, _ = np.histogram(x, bins=10, range=(-2, 2))
+        np.testing.assert_array_equal(np.asarray(got), want)
+        # min==max==0 → data range
+        got = pt.histogram(x, bins=5)
+        want, _ = np.histogram(x, bins=5, range=(x.min(), x.max()))
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+
+class TestCumMaxMin:
+    def test_cummax_values_and_indices(self):
+        x = np.array([[1.0, 3.0, 2.0, 5.0, 4.0]], np.float32)
+        v, i = pt.cummax(x, axis=1)
+        np.testing.assert_allclose(np.asarray(v),
+                                   np.maximum.accumulate(x, 1))
+        np.testing.assert_array_equal(np.asarray(i), [[0, 1, 1, 3, 3]])
+
+    def test_cummin(self):
+        x = A(3, 6)
+        v, _ = pt.cummin(x, axis=1)
+        np.testing.assert_allclose(np.asarray(v),
+                                   np.minimum.accumulate(x, 1), rtol=1e-6)
+
+
+class TestManipulation:
+    def test_meshgrid(self):
+        a, b = np.arange(3.0), np.arange(4.0)
+        got = pt.meshgrid(a, b)
+        want = np.meshgrid(a, b, indexing="ij")
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), w)
+
+    def test_split_family(self):
+        x = A(6, 4, 2)
+        for got, want in zip(pt.tensor_split(x, 3), np.array_split(x, 3)):
+            np.testing.assert_array_equal(np.asarray(got), want)
+        for got, want in zip(pt.vsplit(x, 2), np.vsplit(x, 2)):
+            np.testing.assert_array_equal(np.asarray(got), want)
+        for got, want in zip(pt.hsplit(x, 2), np.hsplit(x, 2)):
+            np.testing.assert_array_equal(np.asarray(got), want)
+        for got, want in zip(pt.dsplit(x, 2), np.dsplit(x, 2)):
+            np.testing.assert_array_equal(np.asarray(got), want)
+
+    def test_unflatten_take_expand_as_unstack(self):
+        x = A(2, 12)
+        assert pt.unflatten(x, 1, (3, 4)).shape == (2, 3, 4)
+        idx = np.array([[0, 5], [23, -1]])
+        got = pt.take(pt.to_tensor(x), pt.to_tensor(idx))
+        # paddle take: negative indices count from the end (unlike
+        # np.take(mode="clip"), which clips them to 0)
+        flat = x.reshape(-1)
+        want = flat[np.array([[0, 5], [23, 23]])]
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+        y = A(3, 2, 12)
+        assert pt.expand_as(x, y).shape == (3, 2, 12)
+        parts = pt.unstack(pt.to_tensor(y), axis=1)
+        assert len(parts) == 2 and parts[0].shape == (3, 12)
+
+    def test_diag_embed_diagflat_indices(self):
+        v = A(2, 3)
+        out = np.asarray(pt.diag_embed(v))
+        assert out.shape == (2, 3, 3)
+        np.testing.assert_allclose(out[0], np.diag(v[0]), rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(pt.diagflat(v[0])),
+                                      np.diagflat(v[0]))
+        np.testing.assert_array_equal(
+            np.asarray(pt.tril_indices(4, 4)), np.stack(np.tril_indices(4)))
+
+    def test_rot90_blockdiag_bucketize(self):
+        x = A(3, 4)
+        np.testing.assert_array_equal(np.asarray(pt.rot90(x)), np.rot90(x))
+        got = np.asarray(pt.block_diag([np.eye(2), np.ones((1, 3))]))
+        assert got.shape == (3, 5)
+        edges = np.array([0.0, 1.0, 2.0])
+        vals = np.array([-0.5, 0.5, 1.5, 2.5])
+        np.testing.assert_array_equal(np.asarray(pt.bucketize(vals, edges)),
+                                      np.searchsorted(edges, vals))
+
+    def test_crop_unfold_as_strided(self):
+        x = A(4, 6)
+        got = np.asarray(pt.crop(x, shape=[2, -1], offsets=[1, 2]))
+        np.testing.assert_array_equal(got, x[1:3, 2:])
+        w = np.asarray(pt.unfold(pt.to_tensor(np.arange(10.0)), 0, 4, 3))
+        np.testing.assert_array_equal(w, [[0, 1, 2, 3], [3, 4, 5, 6],
+                                          [6, 7, 8, 9]])
+        # non-last axis: window dim must land LAST (paddle/torch convention)
+        m = A(10, 2)
+        w2 = np.asarray(pt.unfold(pt.to_tensor(m), 0, 4, 3))
+        assert w2.shape == (3, 2, 4)
+        np.testing.assert_allclose(w2[1, 0], m[3:7, 0], rtol=1e-6)
+        s = np.asarray(pt.as_strided(pt.to_tensor(np.arange(12.0)),
+                                     (3, 2), (4, 1)))
+        np.testing.assert_array_equal(
+            s, np.lib.stride_tricks.as_strided(
+                np.arange(12.0), (3, 2), (32, 8)))
+
+
+class TestComplexViews:
+    def test_complex_roundtrip(self):
+        x = A(3, 2)
+        c = pt.as_complex(pt.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(pt.real(c)), x[:, 0], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(pt.imag(c)), x[:, 1], rtol=1e-6)
+        back = np.asarray(pt.as_real(c))
+        np.testing.assert_allclose(back, x, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(pt.angle(c)),
+                                   np.angle(x[:, 0] + 1j * x[:, 1]), rtol=1e-5)
+
+
+class TestMiscMath:
+    def test_pointwise_oracle(self):
+        x = np.abs(A(16)) + 0.1
+        y = A(16)
+        np.testing.assert_allclose(np.asarray(pt.heaviside(y, x)),
+                                   np.heaviside(y, x), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(pt.copysign(x, y)),
+                                   np.copysign(x, y), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(pt.frac(y * 3)),
+                                   (y * 3) - np.trunc(y * 3), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(pt.deg2rad(x)),
+                                   np.deg2rad(x), rtol=1e-6)
+        a = np.array([4, 6, 9]); b = np.array([6, 4, 6])
+        np.testing.assert_array_equal(np.asarray(pt.gcd(a, b)), np.gcd(a, b))
+        np.testing.assert_array_equal(np.asarray(pt.lcm(a, b)), np.lcm(a, b))
+
+    def test_trapezoid_vander(self):
+        y = A(9)
+        np.testing.assert_allclose(np.asarray(pt.trapezoid(y, dx=0.5)),
+                                   np.trapezoid(y, dx=0.5), rtol=1e-5)
+        v = A(4)
+        np.testing.assert_allclose(np.asarray(pt.vander(v, 3)),
+                                   np.vander(v, 3), rtol=1e-5)
+
+    def test_renorm_multiplex_indexput_clipnorm(self):
+        x = A(3, 4)
+        out = np.asarray(pt.renorm(x, 2.0, 0, 1.0))
+        norms = np.linalg.norm(out.reshape(3, -1), axis=1)
+        assert (norms <= 1.0 + 1e-5).all()
+        cands = [A(4, 2), A(4, 2)]
+        idx = np.array([0, 1, 1, 0])
+        got = np.asarray(pt.multiplex(
+            [pt.to_tensor(c) for c in cands], pt.to_tensor(idx)))
+        want = np.stack([cands[idx[i]][i] for i in range(4)])
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        z = np.zeros((3, 3), np.float32)
+        got = np.asarray(pt.index_put(pt.to_tensor(z),
+                                      (np.array([0, 2]), np.array([1, 2])),
+                                      np.array([5.0, 7.0], np.float32)))
+        assert got[0, 1] == 5 and got[2, 2] == 7
+        big = np.ones(8, np.float32) * 10
+        clipped = np.asarray(pt.clip_by_norm(pt.to_tensor(big), 1.0))
+        np.testing.assert_allclose(np.linalg.norm(clipped), 1.0, rtol=1e-5)
+
+    def test_special_functions(self):
+        x = np.abs(A(8)) + 0.5
+        import scipy.special as ss
+        pytest.importorskip("scipy")
+        np.testing.assert_allclose(np.asarray(pt.i0(x)), ss.i0(x), rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(pt.polygamma(x, 1)),
+                                   ss.polygamma(1, x), rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(pt.gammainc(x, x)),
+                                   ss.gammainc(x, x), rtol=1e-4)
+
+    def test_sgn_complex(self):
+        c = np.array([3 + 4j, 0 + 0j], np.complex64)
+        got = np.asarray(pt.sgn(pt.to_tensor(c)))
+        np.testing.assert_allclose(got[0], 0.6 + 0.8j, rtol=1e-5)
+        assert got[1] == 0
+
+
+class TestLinalgExtras:
+    def test_triangular_and_cholesky_solve(self):
+        a = A(4, 4)
+        spd = a @ a.T + 4 * np.eye(4, dtype="float32")
+        b = A(4, 2)
+        lo = np.linalg.cholesky(spd).astype("float32")
+        got = np.asarray(pt.ops.linalg.triangular_solve(lo.T, b, upper=True))
+        want = np.linalg.solve(lo.T, b)
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+        got = np.asarray(pt.ops.linalg.cholesky_solve(b, lo, upper=False))
+        np.testing.assert_allclose(got, np.linalg.solve(spd, b),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_lu_packed_convention(self):
+        import scipy.linalg as sl
+        a = A(4, 4) + 4 * np.eye(4, dtype="float32")
+        lu, piv = pt.ops.linalg.lu(a)
+        want_lu, want_piv = sl.lu_factor(a)
+        np.testing.assert_allclose(np.asarray(lu), want_lu, rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(piv), want_piv + 1)  # 1-based
+        lu2, piv2, infos = pt.ops.linalg.lu(a, get_infos=True)
+        assert infos.shape == () and int(infos) == 0
+
+    def test_cov_corrcoef_expm(self):
+        x = A(3, 50)
+        np.testing.assert_allclose(np.asarray(pt.ops.linalg.cov(x)),
+                                   np.cov(x), rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(pt.ops.linalg.corrcoef(x)),
+                                   np.corrcoef(x), rtol=1e-4)
+        m = A(3, 3) * 0.1
+        import scipy.linalg as sl
+        np.testing.assert_allclose(np.asarray(pt.ops.linalg.matrix_exp(m)),
+                                   sl.expm(m), rtol=1e-4, atol=1e-5)
+
+    def test_fft_extras(self):
+        x = A(8)
+        np.testing.assert_allclose(np.asarray(pt.ops.fft.hfft(x)),
+                                   np.fft.hfft(x), rtol=1e-4, atol=1e-4)
+        c = A(4, 4)
+        np.testing.assert_allclose(np.asarray(pt.ops.fft.rfftn(c)),
+                                   np.fft.rfftn(c), rtol=1e-4, atol=1e-4)
