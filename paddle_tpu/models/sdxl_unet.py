@@ -1,0 +1,315 @@
+"""SDXL-style diffusion UNet (BASELINE.json configs[3]: "SDXL conv/attn").
+
+Reference capability: Stable-Diffusion-XL UNet served by PaddleMIX on the
+reference stack (the core repo provides conv/groupnorm/attention kernels —
+SURVEY.md §0 scope note; §2.1 fused kernels row). Architecture follows the
+public SDXL design: ResNet blocks (GroupNorm→SiLU→Conv), spatial
+transformer blocks with self+cross attention and GEGLU FFN, sinusoidal
+time embedding + SDXL's added pooled-text/size conditioning, skip-connected
+down/up path.
+
+TPU-first: everything is one jit program; convs lower to XLA convs on the
+MXU; attention uses the framework's flash-attention dispatch (Pallas on
+TPU); channels-last compute is left to XLA layout assignment (API stays
+NCHW for porting parity).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn.layers_common import (Conv2D, GroupNorm, LayerList, LayerNorm,
+                                Linear)
+
+
+@dataclasses.dataclass
+class UNetConfig:
+    in_channels: int = 4
+    out_channels: int = 4
+    block_out_channels: Tuple[int, ...] = (320, 640, 1280)
+    layers_per_block: int = 2
+    transformer_depth: Tuple[int, ...] = (0, 2, 10)  # per level
+    num_attention_heads: Tuple[int, ...] = (5, 10, 20)
+    cross_attention_dim: int = 2048
+    addition_time_embed_dim: int = 256     # SDXL micro-conditioning
+    projection_class_embeddings_input_dim: int = 2816
+    norm_num_groups: int = 32
+    sample_size: int = 128
+
+
+PRESETS = {
+    "sdxl": UNetConfig(),
+    "sd15": UNetConfig(block_out_channels=(320, 640, 1280, 1280),
+                       transformer_depth=(1, 1, 1, 0),
+                       num_attention_heads=(8, 8, 8, 8),
+                       cross_attention_dim=768,
+                       projection_class_embeddings_input_dim=0),
+    "tiny": UNetConfig(block_out_channels=(32, 64),
+                       layers_per_block=1,
+                       transformer_depth=(0, 1),
+                       num_attention_heads=(2, 4),
+                       cross_attention_dim=64,
+                       norm_num_groups=8,
+                       addition_time_embed_dim=32,
+                       projection_class_embeddings_input_dim=96,
+                       sample_size=16),
+}
+
+
+def timestep_embedding(t, dim, max_period=10000.0):
+    """Sinusoidal embedding, (B,) → (B, dim)."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(max_period)
+                    * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+class GEGLU(Layer):
+    def __init__(self, dim_in, dim_out):
+        super().__init__()
+        self.proj = Linear(dim_in, dim_out * 2)
+
+    def forward(self, x):
+        h, gate = jnp.split(self.proj(x), 2, axis=-1)
+        return h * F.gelu(gate)
+
+
+class CrossAttention(Layer):
+    """q from image tokens, k/v from `context` (or self-attn when None)."""
+
+    def __init__(self, query_dim, context_dim=None, heads=8, dim_head=64):
+        super().__init__()
+        inner = heads * dim_head
+        context_dim = context_dim or query_dim
+        self.heads, self.dim_head = heads, dim_head
+        self.to_q = Linear(query_dim, inner, bias_attr=False)
+        self.to_k = Linear(context_dim, inner, bias_attr=False)
+        self.to_v = Linear(context_dim, inner, bias_attr=False)
+        self.to_out = Linear(inner, query_dim)
+
+    def forward(self, x, context=None):
+        context = x if context is None else context
+        b, n = x.shape[:2]
+        m = context.shape[1]
+        q = self.to_q(x).reshape(b, n, self.heads, self.dim_head)
+        k = self.to_k(context).reshape(b, m, self.heads, self.dim_head)
+        v = self.to_v(context).reshape(b, m, self.heads, self.dim_head)
+        out = F.scaled_dot_product_attention(q, k, v)
+        return self.to_out(out.reshape(b, n, self.heads * self.dim_head))
+
+
+class BasicTransformerBlock(Layer):
+    def __init__(self, dim, context_dim, heads, dim_head):
+        super().__init__()
+        self.norm1 = LayerNorm(dim)
+        self.attn1 = CrossAttention(dim, None, heads, dim_head)          # self
+        self.norm2 = LayerNorm(dim)
+        self.attn2 = CrossAttention(dim, context_dim, heads, dim_head)   # cross
+        self.norm3 = LayerNorm(dim)
+        self.ff = GEGLU(dim, dim * 4)
+        self.ff_out = Linear(dim * 4, dim)
+
+    def forward(self, x, context):
+        x = x + self.attn1(self.norm1(x))
+        x = x + self.attn2(self.norm2(x), context)
+        x = x + self.ff_out(self.ff(self.norm3(x)))
+        return x
+
+
+class SpatialTransformer(Layer):
+    """(B,C,H,W) → tokens → depth × BasicTransformerBlock → back."""
+
+    def __init__(self, channels, depth, heads, context_dim, groups):
+        super().__init__()
+        dim_head = channels // heads
+        self.norm = GroupNorm(groups, channels)
+        self.proj_in = Linear(channels, channels)
+        self.blocks = LayerList([
+            BasicTransformerBlock(channels, context_dim, heads, dim_head)
+            for _ in range(depth)])
+        self.proj_out = Linear(channels, channels)
+
+    def forward(self, x, context):
+        b, c, h, w = x.shape
+        residual = x
+        x = self.norm(x)
+        x = x.transpose(0, 2, 3, 1).reshape(b, h * w, c)
+        x = self.proj_in(x)
+        for blk in self.blocks:
+            x = blk(x, context)
+        x = self.proj_out(x)
+        x = x.reshape(b, h, w, c).transpose(0, 3, 1, 2)
+        return x + residual
+
+
+class ResBlock(Layer):
+    def __init__(self, in_ch, out_ch, temb_ch, groups):
+        super().__init__()
+        self.norm1 = GroupNorm(groups, in_ch)
+        self.conv1 = Conv2D(in_ch, out_ch, 3, padding=1)
+        self.time_emb_proj = Linear(temb_ch, out_ch)
+        self.norm2 = GroupNorm(groups, out_ch)
+        self.conv2 = Conv2D(out_ch, out_ch, 3, padding=1)
+        self.skip = (Conv2D(in_ch, out_ch, 1) if in_ch != out_ch else None)
+
+    def forward(self, x, temb):
+        h = self.conv1(F.silu(self.norm1(x)))
+        h = h + self.time_emb_proj(F.silu(temb))[:, :, None, None]
+        h = self.conv2(F.silu(self.norm2(h)))
+        if self.skip is not None:
+            x = self.skip(x)
+        return x + h
+
+
+class Downsample(Layer):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = Conv2D(ch, ch, 3, stride=2, padding=1)
+
+    def forward(self, x):
+        return self.conv(x)
+
+
+class Upsample2x(Layer):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = Conv2D(ch, ch, 3, padding=1)
+
+    def forward(self, x):
+        x = F.interpolate(x, scale_factor=2, mode="nearest")
+        return self.conv(x)
+
+
+class SDXLUNet(Layer):
+    """unet(sample, timestep, encoder_hidden_states[, added_cond]) → eps."""
+
+    def __init__(self, config: UNetConfig):
+        super().__init__()
+        self.config = cfg = config
+        chs = cfg.block_out_channels
+        temb_ch = chs[0] * 4
+        g = cfg.norm_num_groups
+
+        self.conv_in = Conv2D(cfg.in_channels, chs[0], 3, padding=1)
+        self.time_lin1 = Linear(chs[0], temb_ch)
+        self.time_lin2 = Linear(temb_ch, temb_ch)
+        if cfg.projection_class_embeddings_input_dim:
+            self.add_lin1 = Linear(cfg.projection_class_embeddings_input_dim,
+                                   temb_ch)
+            self.add_lin2 = Linear(temb_ch, temb_ch)
+
+        # down path
+        self.down_res: List = []
+        self.down_attn: List = []
+        self.downsamplers: List = []
+        ch = chs[0]
+        self._skip_chs = [ch]
+        for level, out_ch in enumerate(chs):
+            for i in range(cfg.layers_per_block):
+                res = ResBlock(ch, out_ch, temb_ch, g)
+                self.add_sublayer(f"down_{level}_{i}_res", res)
+                attn = None
+                if cfg.transformer_depth[level] > 0:
+                    attn = SpatialTransformer(
+                        out_ch, cfg.transformer_depth[level],
+                        cfg.num_attention_heads[level],
+                        cfg.cross_attention_dim, g)
+                    self.add_sublayer(f"down_{level}_{i}_attn", attn)
+                self.down_res.append(res)
+                self.down_attn.append(attn)
+                ch = out_ch
+                self._skip_chs.append(ch)
+            if level < len(chs) - 1:
+                d = Downsample(ch)
+                self.add_sublayer(f"down_{level}_ds", d)
+                self.downsamplers.append(d)
+                self._skip_chs.append(ch)
+            else:
+                self.downsamplers.append(None)
+
+        # middle
+        self.mid_res1 = ResBlock(ch, ch, temb_ch, g)
+        self.mid_attn = SpatialTransformer(
+            ch, max(1, cfg.transformer_depth[-1]),
+            cfg.num_attention_heads[-1], cfg.cross_attention_dim, g)
+        self.mid_res2 = ResBlock(ch, ch, temb_ch, g)
+
+        # up path (reversed levels, layers_per_block+1 resblocks each)
+        self.up_res: List = []
+        self.up_attn: List = []
+        self.upsamplers: List = []
+        skip_chs = list(self._skip_chs)
+        for level, out_ch in list(enumerate(chs))[::-1]:
+            for i in range(cfg.layers_per_block + 1):
+                skip = skip_chs.pop()
+                res = ResBlock(ch + skip, out_ch, temb_ch, g)
+                self.add_sublayer(f"up_{level}_{i}_res", res)
+                attn = None
+                if cfg.transformer_depth[level] > 0:
+                    attn = SpatialTransformer(
+                        out_ch, cfg.transformer_depth[level],
+                        cfg.num_attention_heads[level],
+                        cfg.cross_attention_dim, g)
+                    self.add_sublayer(f"up_{level}_{i}_attn", attn)
+                self.up_res.append(res)
+                self.up_attn.append(attn)
+                ch = out_ch
+            if level > 0:
+                u = Upsample2x(ch)
+                self.add_sublayer(f"up_{level}_us", u)
+                self.upsamplers.append(u)
+            else:
+                self.upsamplers.append(None)
+
+        self.norm_out = GroupNorm(g, ch)
+        self.conv_out = Conv2D(ch, cfg.out_channels, 3, padding=1)
+
+    def forward(self, sample, timestep, encoder_hidden_states,
+                added_cond: Optional[jnp.ndarray] = None):
+        cfg = self.config
+        temb = timestep_embedding(timestep, cfg.block_out_channels[0])
+        temb = self.time_lin2(F.silu(self.time_lin1(temb)))
+        if cfg.projection_class_embeddings_input_dim and added_cond is not None:
+            temb = temb + self.add_lin2(F.silu(self.add_lin1(added_cond)))
+
+        h = self.conv_in(sample)
+        skips = [h]
+        idx = 0
+        for level in range(len(cfg.block_out_channels)):
+            for _ in range(cfg.layers_per_block):
+                h = self.down_res[idx](h, temb)
+                if self.down_attn[idx] is not None:
+                    h = self.down_attn[idx](h, encoder_hidden_states)
+                skips.append(h)
+                idx += 1
+            if self.downsamplers[level] is not None:
+                h = self.downsamplers[level](h)
+                skips.append(h)
+
+        h = self.mid_res1(h, temb)
+        h = self.mid_attn(h, encoder_hidden_states)
+        h = self.mid_res2(h, temb)
+
+        idx = 0
+        for pos, level in enumerate(range(len(cfg.block_out_channels))[::-1]):
+            for _ in range(cfg.layers_per_block + 1):
+                h = jnp.concatenate([h, skips.pop()], axis=1)
+                h = self.up_res[idx](h, temb)
+                if self.up_attn[idx] is not None:
+                    h = self.up_attn[idx](h, encoder_hidden_states)
+                idx += 1
+            if self.upsamplers[pos] is not None:
+                h = self.upsamplers[pos](h)
+
+        return self.conv_out(F.silu(self.norm_out(h)))
+
+
+def sdxl_unet(preset: str = "sdxl") -> SDXLUNet:
+    return SDXLUNet(PRESETS[preset])

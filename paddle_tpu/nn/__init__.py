@@ -10,6 +10,14 @@ from .layers_common import (  # noqa: F401
     Hardsigmoid, Hardswish, Identity, L1Loss, LayerDict, LayerList,
     LayerNorm, LeakyReLU, Linear, MaxPool2D, Mish, MSELoss,
     MultiHeadAttention, NLLLoss, ReLU, RMSNorm, Sequential, Sigmoid, Silu,
-    Softmax, Softplus, Tanh, TransformerEncoder, TransformerEncoderLayer,
+    Softmax, Softplus, Tanh, Transformer, TransformerDecoder,
+    TransformerDecoderLayer, TransformerEncoder, TransformerEncoderLayer,
     Upsample)
+from .layers_conv import (  # noqa: F401
+    AdaptiveAvgPool2D, AdaptiveMaxPool2D, AvgPool1D, BCELoss, Conv1D,
+    Conv2DTranspose, Conv3D, CosineSimilarity, Dropout2D, InstanceNorm1D,
+    InstanceNorm2D, KLDivLoss, MarginRankingLoss, MaxPool1D, Pad2D,
+    PixelShuffle, PixelUnshuffle, PReLU, SmoothL1Loss)
+from .layers_rnn import (  # noqa: F401
+    GRU, GRUCell, LSTM, LSTMCell, SimpleRNN, SimpleRNNCell)
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401

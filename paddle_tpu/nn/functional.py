@@ -462,3 +462,318 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
         x, k, s, [(p[0], p[0]), (p[1], p[1])], rhs_dilation=d,
         dimension_numbers=("NCHW", "OIHW", "NCHW"))
     return patches.reshape(n, patches.shape[1], -1)
+
+
+# ---------------------------------------------------------------------------
+# conv 1d/3d, transpose convs, adaptive pools, pixel shuffle
+# (reference: python/paddle/nn/functional/conv.py, pooling.py, vision.py)
+# ---------------------------------------------------------------------------
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL"):
+    """Weight layout (out_c, in_c/groups, k), matching the reference."""
+    stride = (stride,) if isinstance(stride, int) else tuple(stride)
+    dilation = (dilation,) if isinstance(dilation, int) else tuple(dilation)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = padding if isinstance(padding, int) else padding[0]
+        pad = [(p, p)]
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCH", "OIH", "NCH") if data_format == "NCL" else ("NHC", "OIH", "NHC"))
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.float32).astype(x.dtype)
+    if bias is not None:
+        shape = [1, -1, 1] if data_format == "NCL" else [1, 1, -1]
+        out = out + bias.reshape(shape).astype(out.dtype)
+    return out
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    stride = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+    dilation = (dilation,) * 3 if isinstance(dilation, int) else tuple(dilation)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+        pad = [(pp, pp) for pp in p]
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCDHW", "OIDHW", "NCDHW") if data_format == "NCDHW"
+        else ("NDHWC", "OIDHW", "NDHWC"))
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.float32).astype(x.dtype)
+    if bias is not None:
+        shape = [1, -1, 1, 1, 1] if data_format == "NCDHW" else [1, 1, 1, 1, -1]
+        out = out + bias.reshape(shape).astype(out.dtype)
+    return out
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCHW"):
+    """Gradient/fractionally-strided conv. Weight layout (in_c, out_c/groups,
+    kh, kw) — the reference's Conv2DTranspose convention.
+
+    Implemented as conv_general_dilated with lhs_dilation=stride (the
+    standard XLA lowering of transpose conv; MXU-friendly, no scatter)."""
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    if isinstance(padding, str):
+        # 'SAME' (out = in*stride) / 'VALID' via lax.conv_transpose, which
+        # handles transpose-conv string padding natively
+        if groups != 1:
+            raise NotImplementedError(
+                "conv2d_transpose: string padding with groups>1 is not "
+                "supported; pass explicit integer padding")
+        d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+        # transpose_kernel=True swaps the kernel spec's I/O axes, so "OIHW"
+        # here reads our (in, out, kh, kw) weight correctly
+        dn = ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" \
+            else ("NHWC", "OIHW", "NHWC")
+        out = jax.lax.conv_transpose(
+            x, weight, strides=s, padding=padding.upper(), rhs_dilation=d,
+            dimension_numbers=dn, transpose_kernel=True,
+            preferred_element_type=jnp.float32).astype(x.dtype)
+        if bias is not None:
+            shape = [1, -1, 1, 1] if data_format == "NCHW" else [1, 1, 1, -1]
+            out = out + bias.reshape(shape).astype(out.dtype)
+        return out
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    op = (output_padding, output_padding) if isinstance(output_padding, int) \
+        else tuple(output_padding)
+    kh, kw = weight.shape[-2:]
+    # effective kernel extent with dilation
+    ekh, ekw = (kh - 1) * d[0] + 1, (kw - 1) * d[1] + 1
+    pad = [(ekh - 1 - p[0], ekh - 1 - p[0] + op[0]),
+           (ekw - 1 - p[1], ekw - 1 - p[1] + op[1])]
+    # weight (I, O/g, kh, kw) → flip spatial, swap to (O, I/g, kh, kw)
+    w = jnp.flip(weight, axis=(-2, -1))
+    if groups == 1:
+        w = jnp.swapaxes(w, 0, 1)
+    else:
+        i, og, *k = w.shape
+        w = w.reshape(groups, i // groups, og, *k).swapaxes(1, 2) \
+             .reshape(groups * og, i // groups, *k)
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape,
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
+        else ("NHWC", "OIHW", "NHWC"))
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=pad, lhs_dilation=s,
+        rhs_dilation=d, dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.float32).astype(x.dtype)
+    if bias is not None:
+        shape = [1, -1, 1, 1] if data_format == "NCHW" else [1, 1, 1, -1]
+        out = out + bias.reshape(shape).astype(out.dtype)
+    return out
+
+
+def _adaptive_pool2d(x, output_size, data_format, reduce_fn, pool2d_fn):
+    """Shared adaptive-pool core: even windows fast-path through the regular
+    pool; uneven windows use per-bucket slice+reduce in H then W (exact for
+    max; exact for mean because every element in a bucket has equal weight
+    within each pass)."""
+    out = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+    else:
+        n, h, w, c = x.shape
+    if h % out[0] == 0 and w % out[1] == 0:
+        kh, kw = h // out[0], w // out[1]
+        return pool2d_fn(x, (kh, kw), stride=(kh, kw),
+                         data_format=data_format)
+    idx_h = [(int(i * h / out[0]), int(-(-((i + 1) * h) // out[0])))
+             for i in range(out[0])]
+    idx_w = [(int(j * w / out[1]), int(-(-((j + 1) * w) // out[1])))
+             for j in range(out[1])]
+    axis_h, axis_w = (2, 3) if data_format == "NCHW" else (1, 2)
+    rows = [reduce_fn(jax.lax.slice_in_dim(x, a, b, axis=axis_h),
+                      axis=axis_h, keepdims=True) for a, b in idx_h]
+    xh = jnp.concatenate(rows, axis=axis_h)
+    cols = [reduce_fn(jax.lax.slice_in_dim(xh, a, b, axis=axis_w),
+                      axis=axis_w, keepdims=True) for a, b in idx_w]
+    return jnp.concatenate(cols, axis=axis_w)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    return _adaptive_pool2d(x, output_size, data_format, jnp.mean,
+                            avg_pool2d)
+
+
+def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
+    return _adaptive_pool2d(x, output_size, data_format, jnp.max,
+                            max_pool2d)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0):
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = k if stride is None else (stride if isinstance(stride, int) else stride[0])
+    p = padding if isinstance(padding, int) else padding[0]
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, (1, 1, k), (1, 1, s),
+                                   ((0, 0), (0, 0), (p, p)))
+    count = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                  (1, 1, k), (1, 1, s), ((0, 0), (0, 0), (p, p)))
+    return summed / count
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0):
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = k if stride is None else (stride if isinstance(stride, int) else stride[0])
+    p = padding if isinstance(padding, int) else padding[0]
+    return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 1, k),
+                                 (1, 1, s), ((0, 0), (0, 0), (p, p)))
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (r * r), r, r, h, w)
+        x = x.transpose(0, 1, 4, 2, 5, 3)
+        return x.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, r, r, c // (r * r))
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h * r, w * r, c // (r * r))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = downscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // r, r, w // r, r)
+        x = x.transpose(0, 1, 3, 5, 2, 4)
+        return x.reshape(n, c * r * r, h // r, w // r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // r, r, w // r, r, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // r, w // r, c * r * r)
+
+
+def instance_norm(x, weight=None, bias=None, eps=1e-5, data_format="NCHW"):
+    """Per-(sample, channel) normalization over spatial dims."""
+    spatial = tuple(range(2, x.ndim)) if data_format.startswith("NC") \
+        else tuple(range(1, x.ndim - 1))
+    mean = jnp.mean(x, axis=spatial, keepdims=True)
+    var = jnp.var(x, axis=spatial, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    c_axis = 1 if data_format.startswith("NC") else -1
+    if weight is not None:
+        shape = [1] * x.ndim
+        shape[c_axis] = -1
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        shape = [1] * x.ndim
+        shape[c_axis] = -1
+        out = out + bias.reshape(shape)
+    return out.astype(x.dtype)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    norm = jnp.linalg.norm(x, ord=p, axis=axis, keepdims=True)
+    return x / jnp.maximum(norm, epsilon)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+def prelu(x, weight):
+    return jnp.where(x >= 0, x, weight * x)
+
+
+def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=False):
+    if training:
+        key = prandom.next_key()
+        a = jax.random.uniform(key, x.shape, minval=lower, maxval=upper)
+    else:
+        a = (lower + upper) / 2
+    return jnp.where(x >= 0, x, a * x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW"):
+    """Channel-wise dropout (whole feature maps zeroed together)."""
+    if not training or p == 0.0:
+        return x
+    key = prandom.next_key()
+    shape = ((x.shape[0], x.shape[1], 1, 1) if data_format == "NCHW"
+             else (x.shape[0], 1, 1, x.shape[3]))
+    keep = jax.random.bernoulli(key, 1.0 - p, shape)
+    return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+
+
+def kl_div(input, label, reduction="mean"):
+    """input is log-probabilities (reference convention)."""
+    out = label * (jnp.log(jnp.clip(label, 1e-12, None)) - input)
+    if reduction == "mean":
+        return out.mean()
+    if reduction == "batchmean":
+        return out.sum() / input.shape[0]
+    if reduction == "sum":
+        return out.sum()
+    return out
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    diff = jnp.abs(input - label)
+    out = jnp.where(diff < delta, 0.5 * diff * diff / delta,
+                    diff - 0.5 * delta)
+    if reduction == "mean":
+        return out.mean()
+    if reduction == "sum":
+        return out.sum()
+    return out
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    out = jnp.maximum(0.0, -label * (input - other) + margin)
+    if reduction == "mean":
+        return out.mean()
+    if reduction == "sum":
+        return out.sum()
+    return out
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    eps = 1e-12
+    out = -(label * jnp.log(jnp.clip(input, eps, None))
+            + (1 - label) * jnp.log(jnp.clip(1 - input, eps, None)))
+    if weight is not None:
+        out = out * weight
+    if reduction == "mean":
+        return out.mean()
+    if reduction == "sum":
+        return out.sum()
+    return out

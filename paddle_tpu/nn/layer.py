@@ -219,8 +219,13 @@ class Layer:
 
     # -- state dict --------------------------------------------------------
 
-    def state_dict(self, include_sublayers=True, structured_name_prefix="",
-                   include_non_persistable_buffer=False) -> Dict[str, jax.Array]:
+    def _raw_state_dict(self, include_sublayers=True,
+                        structured_name_prefix="",
+                        include_non_persistable_buffer=False) -> Dict[str, jax.Array]:
+        """state_dict keyed by the REAL attribute paths — used internally by
+        set_state_dict so subclasses that override state_dict() with name
+        translation (e.g. the RNN reference-naming shim) don't break
+        assignment."""
         out = OrderedDict()
         for k, v in self.named_parameters(prefix=structured_name_prefix,
                                           include_sublayers=include_sublayers):
@@ -231,8 +236,14 @@ class Layer:
             out[k] = v
         return out
 
+    def state_dict(self, include_sublayers=True, structured_name_prefix="",
+                   include_non_persistable_buffer=False) -> Dict[str, jax.Array]:
+        return self._raw_state_dict(include_sublayers,
+                                    structured_name_prefix,
+                                    include_non_persistable_buffer)
+
     def set_state_dict(self, state_dict: Dict[str, Any], use_structured_name=True):
-        own = self.state_dict(include_non_persistable_buffer=True)
+        own = self._raw_state_dict(include_non_persistable_buffer=True)
         missing, unexpected = [], []
         for k in own:
             if k not in state_dict:

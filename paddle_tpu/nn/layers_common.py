@@ -377,6 +377,11 @@ class TransformerEncoderLayer(Layer):
                  activation="relu", attn_dropout=None, act_dropout=None,
                  normalize_before=False, weight_attr=None, bias_attr=None):
         super().__init__()
+        self._config = dict(d_model=d_model, nhead=nhead,
+                            dim_feedforward=dim_feedforward, dropout=dropout,
+                            activation=activation, attn_dropout=attn_dropout,
+                            act_dropout=act_dropout,
+                            normalize_before=normalize_before)
         self.normalize_before = normalize_before
         self.self_attn = MultiHeadAttention(d_model, nhead,
                                             dropout=attn_dropout if attn_dropout is not None else dropout)
@@ -411,9 +416,12 @@ class TransformerEncoder(Layer):
         super().__init__()
         import copy
         if isinstance(encoder_layer, Layer):
-            # paddle semantics: deep-copy the prototype per layer (each copy
-            # keeps its own independently-initialised parameter arrays)
-            make = lambda: copy.deepcopy(encoder_layer)
+            if hasattr(encoder_layer, "_config"):
+                # re-instantiate per layer so each gets FRESH random init
+                # (a deepcopy would make all layers start byte-identical)
+                make = lambda: type(encoder_layer)(**encoder_layer._config)
+            else:
+                make = lambda: copy.deepcopy(encoder_layer)
         else:  # factory callable
             make = encoder_layer
         self.layers = LayerList([make() for _ in range(num_layers)])
@@ -480,3 +488,110 @@ class NLLLoss(Layer):
 
     def forward(self, input, label):
         return F.nll_loss(input, label, self.reduction)
+
+
+class TransformerDecoderLayer(Layer):
+    """Reference: python/paddle/nn/layer/transformer.py TransformerDecoderLayer
+    (self-attn + cross-attn + FFN, pre/post-norm)."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None):
+        super().__init__()
+        self._config = dict(d_model=d_model, nhead=nhead,
+                            dim_feedforward=dim_feedforward, dropout=dropout,
+                            activation=activation, attn_dropout=attn_dropout,
+                            act_dropout=act_dropout,
+                            normalize_before=normalize_before)
+        self.normalize_before = normalize_before
+        ad = attn_dropout if attn_dropout is not None else dropout
+        self.self_attn = MultiHeadAttention(d_model, nhead, dropout=ad)
+        self.cross_attn = MultiHeadAttention(d_model, nhead, dropout=ad)
+        self.linear1 = Linear(d_model, dim_feedforward)
+        self.linear2 = Linear(dim_feedforward, d_model)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.norm3 = LayerNorm(d_model)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.dropout3 = Dropout(dropout)
+        self.dropout_act = Dropout(act_dropout if act_dropout is not None else dropout)
+        self.activation = {"relu": F.relu, "gelu": F.gelu}[activation]
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None):
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm1(tgt)
+        tgt = residual + self.dropout1(self.self_attn(tgt, attn_mask=tgt_mask))
+        if not self.normalize_before:
+            tgt = self.norm1(tgt)
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm2(tgt)
+        tgt = residual + self.dropout2(
+            self.cross_attn(tgt, memory, memory, attn_mask=memory_mask))
+        if not self.normalize_before:
+            tgt = self.norm2(tgt)
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm3(tgt)
+        tgt = self.linear2(self.dropout_act(self.activation(self.linear1(tgt))))
+        tgt = residual + self.dropout3(tgt)
+        if not self.normalize_before:
+            tgt = self.norm3(tgt)
+        return tgt
+
+
+class TransformerDecoder(Layer):
+    def __init__(self, decoder_layer, num_layers, norm=None):
+        super().__init__()
+        import copy
+        if isinstance(decoder_layer, Layer):
+            if hasattr(decoder_layer, "_config"):
+                # fresh random init per layer (see TransformerEncoder)
+                make = lambda: type(decoder_layer)(**decoder_layer._config)
+            else:
+                make = lambda: copy.deepcopy(decoder_layer)
+        else:
+            make = decoder_layer
+        self.layers = LayerList([make() for _ in range(num_layers)])
+        self.norm = norm
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None):
+        for layer in self.layers:
+            tgt = layer(tgt, memory, tgt_mask=tgt_mask, memory_mask=memory_mask)
+        if self.norm is not None:
+            tgt = self.norm(tgt)
+        return tgt
+
+
+class Transformer(Layer):
+    """Full encoder-decoder (reference: paddle.nn.Transformer)."""
+
+    def __init__(self, d_model=512, nhead=8, num_encoder_layers=6,
+                 num_decoder_layers=6, dim_feedforward=2048, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False):
+        super().__init__()
+        enc_layer = TransformerEncoderLayer(
+            d_model, nhead, dim_feedforward, dropout, activation,
+            attn_dropout, act_dropout, normalize_before)
+        dec_layer = TransformerDecoderLayer(
+            d_model, nhead, dim_feedforward, dropout, activation,
+            attn_dropout, act_dropout, normalize_before)
+        enc_norm = LayerNorm(d_model) if normalize_before else None
+        dec_norm = LayerNorm(d_model) if normalize_before else None
+        self.encoder = TransformerEncoder(enc_layer, num_encoder_layers, enc_norm)
+        self.decoder = TransformerDecoder(dec_layer, num_decoder_layers, dec_norm)
+        self.d_model, self.nhead = d_model, nhead
+
+    def forward(self, src, tgt, src_mask=None, tgt_mask=None, memory_mask=None):
+        memory = self.encoder(src, src_mask=src_mask)
+        return self.decoder(tgt, memory, tgt_mask=tgt_mask,
+                            memory_mask=memory_mask)
+
+    @staticmethod
+    def generate_square_subsequent_mask(length):
+        import jax.numpy as jnp
+        return jnp.where(
+            jnp.tril(jnp.ones((length, length), jnp.bool_)), 0.0, -jnp.inf)

@@ -1,0 +1,237 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU (+ single cells).
+
+Reference: python/paddle/nn/layer/rnn.py (RNNBase, LSTM, GRU, *Cell classes;
+cuDNN-backed on GPU).
+
+TPU redesign: the time loop is ``jax.lax.scan`` — one compiled program, no
+per-step dispatch; the (4h,h)·(h) gate matmuls batch into single MXU calls
+per step. Multi-layer and bidirectional variants compose scans. Parameters
+live on per-cell sublayers (cell_{k}[_reverse].weight_ih), but
+state_dict()/set_state_dict() translate to/from the reference's flat naming
+(weight_ih_l{k}[_reverse]) so reference state_dicts port."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import initializer as I
+from .layer import Layer
+
+
+def _init_bound(hidden_size):
+    return 1.0 / math.sqrt(hidden_size)
+
+
+class RNNCellBase(Layer):
+    def __init__(self, input_size, hidden_size, gates):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        b = _init_bound(hidden_size)
+        g = gates * hidden_size
+        self.weight_ih = self.create_parameter(
+            (g, input_size), default_initializer=I.Uniform(-b, b))
+        self.weight_hh = self.create_parameter(
+            (g, hidden_size), default_initializer=I.Uniform(-b, b))
+        self.bias_ih = self.create_parameter(
+            (g,), is_bias=True, default_initializer=I.Uniform(-b, b))
+        self.bias_hh = self.create_parameter(
+            (g,), is_bias=True, default_initializer=I.Uniform(-b, b))
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh"):
+        super().__init__(input_size, hidden_size, 1)
+        self.activation = jnp.tanh if activation == "tanh" else jax.nn.relu
+
+    def forward(self, x, h):
+        pre = (x @ self.weight_ih.T + self.bias_ih
+               + h @ self.weight_hh.T + self.bias_hh)
+        return self.activation(pre)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size):
+        super().__init__(input_size, hidden_size, 4)
+
+    def forward(self, x, state):
+        h, c = state
+        gates = (x @ self.weight_ih.T + self.bias_ih
+                 + h @ self.weight_hh.T + self.bias_hh)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        c_new = f * c + i * jnp.tanh(g)
+        h_new = o * jnp.tanh(c_new)
+        return h_new, c_new
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size):
+        super().__init__(input_size, hidden_size, 3)
+
+    def forward(self, x, h):
+        gi = x @ self.weight_ih.T + self.bias_ih
+        gh = h @ self.weight_hh.T + self.bias_hh
+        i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+        h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(i_r + h_r)
+        z = jax.nn.sigmoid(i_z + h_z)
+        n = jnp.tanh(i_n + r * h_n)
+        return (1 - z) * n + z * h
+
+
+class _RNNBase(Layer):
+    """Stacked (and optionally bidirectional) scan over a cell type."""
+
+    MODE = "RNN"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", dropout=0.0, time_major=False):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.num_layers = num_layers
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        self.time_major = time_major
+        self.dropout = dropout
+        ndir = 2 if self.bidirectional else 1
+        self._cells = []
+        for layer in range(num_layers):
+            for d in range(ndir):
+                in_sz = input_size if layer == 0 else hidden_size * ndir
+                cell = self._make_cell(in_sz, hidden_size)
+                suffix = "_reverse" if d else ""
+                self.add_sublayer(f"cell_{layer}{suffix}", cell)
+                self._cells.append((layer, d, cell))
+
+    def _make_cell(self, in_sz, hidden):
+        raise NotImplementedError
+
+    def _zero_state(self, cell, batch):
+        if self.MODE == "LSTM":
+            z = jnp.zeros((batch, self.hidden_size))
+            return (z, z)
+        return jnp.zeros((batch, self.hidden_size))
+
+    def _scan_one(self, cell, x_tbf, init, reverse=False, seq_len=None):
+        """x_tbf: (T, B, F). Returns (T, B, H), final_state.
+
+        With ``seq_len`` (B,), steps at t >= len keep the previous state and
+        emit zeros, so padded positions never contaminate states/outputs.
+        In the reverse direction the padded steps come FIRST in scan order
+        and simply hold the initial state until the sequence's true tail."""
+        params = dict(cell.named_parameters())
+        T = x_tbf.shape[0]
+        ts = jnp.arange(T)
+
+        def step(state, inputs):
+            from .layer import functional_call
+            xt, t = inputs
+            new = functional_call(cell, params, xt, state)
+            if seq_len is not None:
+                valid = (t < seq_len)[:, None]
+                new = jax.tree.map(
+                    lambda n, o: jnp.where(valid, n, o), new, state)
+            h = new[0] if isinstance(new, tuple) else new
+            if seq_len is not None:
+                h = jnp.where((t < seq_len)[:, None], h, 0.0)
+            return new, h
+
+        final, ys = jax.lax.scan(step, init, (x_tbf, ts), reverse=reverse)
+        return ys, final
+
+    def forward(self, x, initial_states=None, sequence_length=None):
+        # normalize to (T, B, F)
+        if not self.time_major:
+            x = jnp.swapaxes(x, 0, 1)
+        T, B = x.shape[0], x.shape[1]
+        ndir = 2 if self.bidirectional else 1
+        finals = []
+        inp = x
+        for layer in range(self.num_layers):
+            outs = []
+            for d in range(ndir):
+                cell = dict(
+                    ((l, dd), c) for l, dd, c in self._cells)[(layer, d)]
+                if initial_states is not None:
+                    init = self._slice_state(initial_states,
+                                             layer * ndir + d)
+                else:
+                    init = self._zero_state(cell, B)
+                ys, fin = self._scan_one(cell, inp, init, reverse=bool(d),
+                                         seq_len=sequence_length)
+                outs.append(ys)
+                finals.append(fin)
+            inp = jnp.concatenate(outs, axis=-1) if ndir == 2 else outs[0]
+            if self.dropout and layer < self.num_layers - 1 and self.training:
+                # reference semantics: dropout between stacked layers only
+                from . import functional as F
+                inp = F.dropout(inp, self.dropout, training=True)
+        out = inp if self.time_major else jnp.swapaxes(inp, 0, 1)
+        return out, self._stack_finals(finals)
+
+    # -- reference-convention state_dict translation -----------------------
+
+    def _name_map(self):
+        """cell_{k}{suffix}.{w} ↔ {w}_l{k}{suffix} (reference naming)."""
+        m = {}
+        ndir = 2 if self.bidirectional else 1
+        for layer in range(self.num_layers):
+            for d in range(ndir):
+                suffix = "_reverse" if d else ""
+                for w in ("weight_ih", "weight_hh", "bias_ih", "bias_hh"):
+                    m[f"cell_{layer}{suffix}.{w}"] = f"{w}_l{layer}{suffix}"
+        return m
+
+    def state_dict(self, *a, **k):
+        sd = super().state_dict(*a, **k)
+        m = self._name_map()
+        return type(sd)((m.get(key, key), v) for key, v in sd.items())
+
+    def set_state_dict(self, state_dict, *a, **k):
+        inv = {v: key for key, v in self._name_map().items()}
+        translated = {inv.get(key, key): v for key, v in state_dict.items()}
+        return super().set_state_dict(translated, *a, **k)
+
+    def _slice_state(self, states, idx):
+        if self.MODE == "LSTM":
+            h, c = states
+            return (h[idx], c[idx])
+        return states[idx]
+
+    def _stack_finals(self, finals):
+        if self.MODE == "LSTM":
+            hs = jnp.stack([f[0] for f in finals])
+            cs = jnp.stack([f[1] for f in finals])
+            return (hs, cs)
+        return jnp.stack(finals)
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", activation="tanh", dropout=0.0,
+                 time_major=False):
+        self._activation = activation
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         dropout, time_major)
+
+    def _make_cell(self, in_sz, hidden):
+        return SimpleRNNCell(in_sz, hidden, self._activation)
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+    def _make_cell(self, in_sz, hidden):
+        return LSTMCell(in_sz, hidden)
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+
+    def _make_cell(self, in_sz, hidden):
+        return GRUCell(in_sz, hidden)

@@ -1,0 +1,316 @@
+"""nn breadth tests: conv variants vs torch oracle, RNN/LSTM/GRU scan
+correctness, transformer decoder, SDXL UNet train step."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+R = np.random.default_rng(3)
+
+
+def A(*shape):
+    return R.normal(size=shape).astype("float32")
+
+
+class TestConvOracle:
+    def test_conv1d(self):
+        x, w, b = A(2, 3, 16), A(5, 3, 4), A(5)
+        got = np.asarray(F.conv1d(x, w, b, stride=2, padding=1))
+        want = TF.conv1d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                         stride=2, padding=1).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_conv3d(self):
+        x, w = A(1, 2, 6, 6, 6), A(4, 2, 3, 3, 3)
+        got = np.asarray(F.conv3d(x, w, stride=1, padding=1))
+        want = TF.conv3d(torch.tensor(x), torch.tensor(w), padding=1).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_conv2d_transpose_strided(self):
+        x, w, b = A(2, 3, 8, 8), A(3, 5, 4, 4), A(5)
+        got = np.asarray(F.conv2d_transpose(x, w, b, stride=2, padding=1,
+                                            output_padding=1))
+        want = TF.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                   torch.tensor(b), stride=2, padding=1,
+                                   output_padding=1).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_conv2d_transpose_groups(self):
+        x, w = A(1, 4, 5, 5), A(4, 3, 3, 3)
+        got = np.asarray(F.conv2d_transpose(x, w, stride=2, groups=2))
+        want = TF.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                   stride=2, groups=2).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_instance_norm(self):
+        x, w, b = A(2, 3, 5, 5), A(3), A(3)
+        got = np.asarray(F.instance_norm(x, w, b))
+        want = TF.instance_norm(torch.tensor(x), weight=torch.tensor(w),
+                                bias=torch.tensor(b)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+    def test_adaptive_pools_nondivisible(self):
+        x = A(1, 2, 7, 5)
+        got = np.asarray(F.adaptive_avg_pool2d(x, (3, 2)))
+        want = TF.adaptive_avg_pool2d(torch.tensor(x), (3, 2)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+        got = np.asarray(F.adaptive_max_pool2d(x, (3, 2)))
+        want = TF.adaptive_max_pool2d(torch.tensor(x), (3, 2)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_pixel_shuffle_roundtrip(self):
+        x = A(2, 8, 3, 3)
+        up = F.pixel_shuffle(x, 2)
+        want = TF.pixel_shuffle(torch.tensor(x), 2).numpy()
+        np.testing.assert_allclose(np.asarray(up), want, rtol=1e-6)
+        back = F.pixel_unshuffle(up, 2)
+        np.testing.assert_allclose(np.asarray(back), x, rtol=1e-6)
+
+    def test_pool1d(self):
+        x = A(2, 3, 12)
+        got = np.asarray(F.avg_pool1d(x, 3, stride=2, padding=1))
+        want = TF.avg_pool1d(torch.tensor(x), 3, stride=2, padding=1,
+                             count_include_pad=False).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+class TestConvLayers:
+    def test_layer_shapes(self):
+        pt.seed(0)
+        assert nn.Conv1D(3, 8, 3, padding=1)(A(2, 3, 10)).shape == (2, 8, 10)
+        assert nn.Conv3D(2, 4, 3, padding=1)(A(1, 2, 4, 4, 4)).shape == (1, 4, 4, 4, 4)
+        assert nn.Conv2DTranspose(3, 6, 4, stride=2, padding=1)(A(1, 3, 8, 8)).shape == (1, 6, 16, 16)
+        assert nn.InstanceNorm2D(3)(A(2, 3, 5, 5)).shape == (2, 3, 5, 5)
+        assert nn.AdaptiveAvgPool2D(1)(A(2, 3, 7, 7)).shape == (2, 3, 1, 1)
+        assert nn.PixelShuffle(2)(A(1, 8, 4, 4)).shape == (1, 2, 8, 8)
+        assert nn.PReLU(4)(A(2, 4, 3, 3)).shape == (2, 4, 3, 3)
+
+    def test_losses(self):
+        p = np.abs(A(8)) / 2 + 0.1
+        l = (A(8) > 0).astype("float32")
+        got = float(nn.BCELoss()(pt.to_tensor(np.clip(p, 0, 1)), pt.to_tensor(l)))
+        want = float(TF.binary_cross_entropy(torch.tensor(np.clip(p, 0, 1)),
+                                             torch.tensor(l)))
+        assert abs(got - want) < 1e-4
+        x, y = A(4, 6), A(4, 6)
+        got = float(nn.SmoothL1Loss()(pt.to_tensor(x), pt.to_tensor(y)))
+        want = float(TF.smooth_l1_loss(torch.tensor(x), torch.tensor(y)))
+        assert abs(got - want) < 1e-4
+        logp = np.log(np.abs(A(4, 6)) / 10 + 0.01)
+        tgt = np.abs(A(4, 6)); tgt = tgt / tgt.sum()
+        got = float(nn.KLDivLoss()(pt.to_tensor(logp), pt.to_tensor(tgt)))
+        want = float(TF.kl_div(torch.tensor(logp), torch.tensor(tgt)))
+        assert abs(got - want) < 1e-4
+
+
+class TestRNN:
+    def _torch_lstm(self, x, jx_lstm, bidirectional=False, layers=1):
+        tl = torch.nn.LSTM(x.shape[-1], jx_lstm.hidden_size,
+                           num_layers=layers, batch_first=True,
+                           bidirectional=bidirectional)
+        # copy our params into torch
+        ndir = 2 if bidirectional else 1
+        for layer in range(layers):
+            for d in range(ndir):
+                suffix = "_reverse" if d else ""
+                cell = getattr(jx_lstm, f"cell_{layer}{suffix}")
+                getattr(tl, f"weight_ih_l{layer}{suffix}").data = \
+                    torch.tensor(np.asarray(cell.weight_ih))
+                getattr(tl, f"weight_hh_l{layer}{suffix}").data = \
+                    torch.tensor(np.asarray(cell.weight_hh))
+                getattr(tl, f"bias_ih_l{layer}{suffix}").data = \
+                    torch.tensor(np.asarray(cell.bias_ih))
+                getattr(tl, f"bias_hh_l{layer}{suffix}").data = \
+                    torch.tensor(np.asarray(cell.bias_hh))
+        return tl
+
+    def test_lstm_vs_torch(self):
+        pt.seed(0)
+        x = A(2, 7, 5)
+        m = nn.LSTM(5, 6)
+        out, (h, c) = m(x)
+        tl = self._torch_lstm(x, m)
+        want, (th, tc) = tl(torch.tensor(x))
+        np.testing.assert_allclose(np.asarray(out), want.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(h), th.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(c), tc.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_lstm_bidirectional_two_layers(self):
+        pt.seed(1)
+        x = A(3, 5, 4)
+        m = nn.LSTM(4, 3, num_layers=2, direction="bidirect")
+        out, (h, c) = m(x)
+        assert out.shape == (3, 5, 6)
+        assert h.shape == (4, 3, 3)
+        tl = self._torch_lstm(x, m, bidirectional=True, layers=2)
+        want, _ = tl(torch.tensor(x))
+        np.testing.assert_allclose(np.asarray(out), want.detach().numpy(),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_gru_vs_torch(self):
+        pt.seed(2)
+        x = A(2, 6, 4)
+        m = nn.GRU(4, 5)
+        out, h = m(x)
+        tg = torch.nn.GRU(4, 5, batch_first=True)
+        cell = m.cell_0
+        tg.weight_ih_l0.data = torch.tensor(np.asarray(cell.weight_ih))
+        tg.weight_hh_l0.data = torch.tensor(np.asarray(cell.weight_hh))
+        tg.bias_ih_l0.data = torch.tensor(np.asarray(cell.bias_ih))
+        tg.bias_hh_l0.data = torch.tensor(np.asarray(cell.bias_hh))
+        want, th = tg(torch.tensor(x))
+        np.testing.assert_allclose(np.asarray(out), want.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_simple_rnn_shapes_and_grad(self):
+        import jax
+        from paddle_tpu.nn.layer import functional_call, raw_params
+        pt.seed(3)
+        m = nn.SimpleRNN(4, 5, num_layers=2)
+        x = A(2, 6, 4)
+        out, h = m(x)
+        assert out.shape == (2, 6, 5) and h.shape == (2, 2, 5)
+        p = raw_params(m)
+        g = jax.grad(lambda p: functional_call(m, p, x)[0].sum())(p)
+        assert all(np.isfinite(np.asarray(v)).all() for v in g.values())
+
+
+class TestRNNFixes:
+    def test_sequence_length_masks_padding(self):
+        pt.seed(5)
+        m = nn.LSTM(4, 3)
+        x = A(2, 6, 4)
+        seq_len = np.array([6, 3])
+        out, (h, c) = m(x, sequence_length=pt.to_tensor(seq_len))
+        # outputs past each length are zero
+        assert np.abs(np.asarray(out[1, 3:])).max() == 0
+        assert np.abs(np.asarray(out[1, :3])).max() > 0
+        # final state of the short sequence == running it unpadded
+        out_s, (h_s, _) = m(x[1:2, :3])
+        np.testing.assert_allclose(np.asarray(h[0, 1]), np.asarray(h_s[0, 0]),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_interlayer_dropout_applied(self):
+        pt.seed(6)
+        m = nn.GRU(4, 4, num_layers=2, dropout=0.9)
+        x = A(2, 5, 4)
+        m.eval()
+        out_eval, _ = m(x)
+        m.train()
+        out_train, _ = m(x)
+        # with dropout 0.9 between layers, train output must differ from eval
+        assert np.abs(np.asarray(out_eval) - np.asarray(out_train)).max() > 1e-4
+
+    def test_state_dict_reference_naming(self):
+        pt.seed(7)
+        m = nn.LSTM(4, 3, num_layers=2, direction="bidirect")
+        sd = m.state_dict()
+        assert "weight_ih_l0" in sd and "weight_hh_l1_reverse" in sd
+        m2 = nn.LSTM(4, 3, num_layers=2, direction="bidirect")
+        m2.set_state_dict(sd)
+        x = A(1, 4, 4)
+        np.testing.assert_allclose(np.asarray(m(x)[0]), np.asarray(m2(x)[0]),
+                                   rtol=1e-6)
+
+
+class TestLayerFixes:
+    def test_transformer_layers_fresh_init(self):
+        pt.seed(8)
+        enc = nn.TransformerEncoder(
+            nn.TransformerEncoderLayer(8, 2, 16), 2)
+        w0 = np.asarray(enc.layers[0].linear1.weight)
+        w1 = np.asarray(enc.layers[1].linear1.weight)
+        assert np.abs(w0 - w1).max() > 1e-4  # NOT byte-identical
+
+    def test_conv_transpose_same_padding(self):
+        x, w = A(1, 3, 8, 8), A(3, 5, 3, 3)
+        out = F.conv2d_transpose(x, w, stride=2, padding="SAME")
+        assert out.shape == (1, 5, 16, 16)
+        with pytest.raises(NotImplementedError):
+            F.conv2d_transpose(A(1, 4, 8, 8), A(4, 2, 3, 3),
+                               padding="SAME", groups=2)
+
+    def test_instance_norm1d_nlc(self):
+        x = A(2, 6, 3)  # NLC: channels last
+        m = nn.InstanceNorm1D(3, data_format="NLC")
+        out = np.asarray(m(x))
+        # normalized over L per channel: mean≈0 along axis 1
+        assert np.abs(out.mean(axis=1)).max() < 1e-5
+
+
+class TestTransformerDecoder:
+    def test_decoder_and_full_transformer(self):
+        pt.seed(0)
+        model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=2,
+                               num_decoder_layers=2, dim_feedforward=32)
+        model.eval()
+        src, tgt = A(2, 7, 16), A(2, 5, 16)
+        mask = nn.Transformer.generate_square_subsequent_mask(5)
+        out = model(src, tgt, tgt_mask=mask)
+        assert out.shape == (2, 5, 16)
+        # causality: future tgt positions must not affect earlier outputs
+        tgt2 = tgt.copy()
+        tgt2[:, -1] += 100.0
+        out2 = model(src, pt.to_tensor(tgt2), tgt_mask=mask)
+        np.testing.assert_allclose(np.asarray(out[:, :4]),
+                                   np.asarray(out2[:, :4]), atol=1e-4)
+
+
+class TestSDXLUNet:
+    def test_tiny_unet_trains(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.models.sdxl_unet import sdxl_unet
+        from paddle_tpu.nn.layer import functional_call, raw_params
+        from paddle_tpu.optimizer import AdamW
+
+        pt.seed(0)
+        m = sdxl_unet("tiny")
+        x = jnp.asarray(A(2, 4, 16, 16))
+        t = jnp.array([3, 777])
+        ctx = jnp.asarray(A(2, 6, 64))
+        ac = jnp.asarray(A(2, 96))
+        eps = jnp.asarray(A(2, 4, 16, 16))
+
+        out = m(x, t, ctx, ac)
+        assert out.shape == x.shape
+
+        opt = AdamW(learning_rate=1e-3, parameters=m.parameters())
+        params = raw_params(m)
+        state = opt.init(params)
+
+        @jax.jit
+        def step(params, state):
+            def loss_fn(p):
+                pred = functional_call(m, p, x, t, ctx, ac, training=True)
+                return ((pred - eps) ** 2).mean()
+            loss, g = jax.value_and_grad(loss_fn)(params)
+            params, state = opt.apply(g, state, params)
+            return params, state, loss
+
+        losses = []
+        for _ in range(8):
+            params, state, loss = step(params, state)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
+
+    def test_unet_no_added_cond_preset(self):
+        import jax.numpy as jnp
+        from paddle_tpu.models.sdxl_unet import SDXLUNet, UNetConfig
+        pt.seed(0)
+        cfg = UNetConfig(block_out_channels=(16, 32), layers_per_block=1,
+                         transformer_depth=(0, 1), num_attention_heads=(2, 2),
+                         cross_attention_dim=32, norm_num_groups=8,
+                         projection_class_embeddings_input_dim=0)
+        m = SDXLUNet(cfg)
+        out = m(jnp.zeros((1, 4, 8, 8)), jnp.array([5]),
+                jnp.zeros((1, 3, 32)))
+        assert out.shape == (1, 4, 8, 8)
