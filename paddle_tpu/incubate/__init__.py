@@ -1,0 +1,13 @@
+"""``paddle.incubate`` parity surface (SURVEY §2.6 incubate row).
+
+- ``incubate.nn`` — FusedMultiTransformer + fused functional ops
+- ``incubate.nn.functional`` — fused_rms_norm/fused_layer_norm/
+  fused_bias_act/fused_rotary_position_embedding/masked_multihead_attention/
+  paged_attention/variable_length_memory_efficient_attention
+- expert-parallel MoE lives at ``paddle_tpu.distributed.moe`` (re-exported
+  here for reference-path compatibility)
+"""
+
+from . import nn  # noqa: F401
+from ..distributed import moe as distributed_moe  # noqa: F401
+from ..distributed.moe import MoELayer  # noqa: F401

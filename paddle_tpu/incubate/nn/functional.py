@@ -1,0 +1,192 @@
+"""``paddle.incubate.nn.functional`` parity — the fused-op surface.
+
+Reference: python/paddle/incubate/nn/functional/ (fused_rms_norm,
+fused_layer_norm, fused_bias_act, fused_dropout_add, fused_linear,
+fused_rotary_position_embedding, masked_multihead_attention,
+variable_length_memory_efficient_attention) backed by
+paddle/phi/kernels/fusion/gpu/ CUDA kernels.
+
+TPU redesign: "fused" is what XLA does by default — these entry points keep
+the reference call signatures and lower to jnp compositions XLA fuses into
+single kernels (elementwise chains fuse into the preceding matmul/reduce).
+The decode-attention ops (masked_multihead_attention, paged_attention) are
+the genuinely structural ones: they implement single-token KV-cache
+attention, the TPU analogue of the reference's decode kernels.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...nn import functional as F
+
+# direct re-exports where the base framework already has the op
+fused_rotary_position_embedding = F.fused_rotary_position_embedding
+flash_attention = F.flash_attention
+scaled_dot_product_attention = F.scaled_dot_product_attention
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, residual=None):
+    """rms_norm(+optional residual add) — reference RmsNormKernel.
+    ``begin_norm_axis``: normalize over axes [begin_norm_axis, ndim)."""
+    if residual is not None:
+        x = x + residual
+    if begin_norm_axis in (-1, x.ndim - 1):
+        out = F.rms_norm(x, norm_weight, epsilon)
+    else:
+        axes = tuple(range(begin_norm_axis % x.ndim, x.ndim))
+        ms = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axes,
+                      keepdims=True)
+        out = (x * jax.lax.rsqrt(ms + epsilon)).astype(x.dtype)
+        if norm_weight is not None:
+            out = out * norm_weight
+    if norm_bias is not None:
+        out = out + norm_bias
+    return (out, x) if residual is not None else out
+
+
+def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
+                     residual=None):
+    if residual is not None:
+        x = x + residual
+    out = F.layer_norm(x, weight=norm_weight, bias=norm_bias,
+                       epsilon=epsilon)
+    return (out, x) if residual is not None else out
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False):
+    w = weight.T if transpose_weight else weight
+    return F.linear(x, w, bias)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu"):
+    if bias is not None:
+        x = x + bias
+    def _geglu(v):
+        a, g = jnp.split(v, 2, axis=-1)
+        return a * F.gelu(g)
+
+    acts = {"gelu": F.gelu, "relu": F.relu, "silu": F.silu,
+            "swiglu": F.swiglu, "geglu": _geglu}
+    return acts[act_method](x)
+
+
+def fused_dropout_add(x, y, p=0.0, training=True, mode="upscale_in_train"):
+    return F.dropout(x, p, training=training, mode=mode) + y
+
+
+def swiglu(x, y=None):
+    return F.swiglu(x, y)
+
+
+# ---------------------------------------------------------------------------
+# decode attention (KV cache)
+# ---------------------------------------------------------------------------
+
+def masked_multihead_attention(q, k_cache, v_cache, seq_lens,
+                               new_k=None, new_v=None, scale=None):
+    """Single-step decode attention against a dense KV cache.
+
+    Reference: MaskedMultiheadAttentionKernel
+    (paddle/phi/kernels/fusion/gpu/, SURVEY §2.1 fused kernels row).
+
+    q:        (B, H, D)        — the new token's query
+    k_cache:  (B, S_max, H_kv, D) — updated IN-PLACE-style: returns new cache
+    seq_lens: (B,)             — current lengths (position of the new token)
+    new_k/new_v: (B, H_kv, D)  — this step's k/v, written at seq_lens
+
+    Returns (out (B, H, D), k_cache, v_cache).
+    """
+    b, h, d = q.shape
+    s_max = k_cache.shape[1]
+    h_kv = k_cache.shape[2]
+    if new_k is not None:
+        onehot = jax.nn.one_hot(seq_lens, s_max,
+                                dtype=k_cache.dtype)[:, :, None, None]
+        k_cache = k_cache * (1 - onehot) + onehot * new_k[:, None]
+        v_cache = v_cache * (1 - onehot) + onehot * new_v[:, None]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    rep = h // h_kv
+    k = jnp.repeat(k_cache, rep, axis=2) if rep > 1 else k_cache
+    v = jnp.repeat(v_cache, rep, axis=2) if rep > 1 else v_cache
+    # scores: (B, H, S)
+    scores = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    mask = jnp.arange(s_max)[None, None, :] <= seq_lens[:, None, None]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhs,bshd->bhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype), k_cache, v_cache
+
+
+def paged_attention(q, k_cache, v_cache, block_tables, context_lens,
+                    scale: Optional[float] = None):
+    """Decode attention over a PAGED (block) KV cache — vLLM-style serving.
+
+    Reference capability: paged/block attention in the reference serving
+    stack (PaddleNLP inference; core provides the fused decode kernels).
+
+    q:            (B, H, D)
+    k_cache/v_cache: (num_blocks, block_size, H_kv, D) — global block pool
+    block_tables: (B, max_blocks_per_seq) int32 — per-seq block ids
+    context_lens: (B,) — tokens so far (incl. current)
+
+    XLA impl: gather each sequence's blocks then masked attention; the
+    gather is a single dynamic-gather XLA op (TPU-friendly); a Pallas
+    double-buffered variant can drop in via ops.dispatch later.
+    """
+    b, h, d = q.shape
+    nb, bs, h_kv, _ = k_cache.shape
+    mb = block_tables.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    # (B, mb, bs, H_kv, D) → (B, S=mb*bs, H_kv, D)
+    k = k_cache[block_tables].reshape(b, mb * bs, h_kv, d)
+    v = v_cache[block_tables].reshape(b, mb * bs, h_kv, d)
+    rep = h // h_kv
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scores = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    mask = jnp.arange(mb * bs)[None, None, :] < context_lens[:, None, None]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhs,bshd->bhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def write_paged_kv(k_cache, v_cache, new_k, new_v, block_tables,
+                   context_lens) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter this step's (B, H_kv, D) k/v into the paged pool at position
+    context_lens-1 of each sequence."""
+    b = new_k.shape[0]
+    bs = k_cache.shape[1]
+    pos = context_lens - 1
+    blk = jnp.take_along_axis(block_tables, (pos // bs)[:, None],
+                              axis=1)[:, 0]
+    off = pos % bs
+    k_cache = k_cache.at[blk, off].set(new_k)
+    v_cache = v_cache.at[blk, off].set(new_v)
+    return k_cache, v_cache
+
+
+def variable_length_memory_efficient_attention(q, k, v, seq_lens=None,
+                                               kv_seq_lens=None, mask=None,
+                                               scale=None, causal=False):
+    """Varlen attention (reference cutlass memory_efficient_attention):
+    here, flash/XLA attention with a length mask."""
+    if mask is None and (seq_lens is not None or kv_seq_lens is not None):
+        sk = k.shape[1]
+        # mask only the KEY axis: fully-masked query rows would softmax over
+        # all -inf and emit NaN; padded query outputs are instead left as
+        # attention over the valid keys and callers drop them
+        klens = kv_seq_lens if kv_seq_lens is not None else seq_lens
+        km = jnp.arange(sk)[None] < klens[:, None]
+        mask = jnp.where(km[:, None, None, :], 0.0, -jnp.inf)
+    return F.scaled_dot_product_attention(q, k, v, attn_mask=mask,
+                                          is_causal=causal)

@@ -1,0 +1,68 @@
+"""Inference API (reference: paddle/fluid/inference/ AnalysisPredictor,
+paddle_infer.Config/create_predictor — SURVEY §2.3).
+
+TPU redesign: the reference's analysis passes + TensorRT subgraphs are
+XLA's job; a "predictor" here is an AOT-compiled XLA program. Two paths:
+
+- from a live Layer: ``Config(model=layer, example_args=...)`` — jit once,
+  optionally donate/convert dtypes;
+- from a ``paddle_tpu.jit.save`` artifact: ``Config(model_path=...)`` —
+  deserialize StableHLO and run without the Python model definition
+  (the *.pdmodel-file role).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+
+
+class Config:
+    """Mirror of paddle_infer.Config's role (model source + exec options)."""
+
+    def __init__(self, model=None, model_path: Optional[str] = None,
+                 example_args: Optional[Sequence[Any]] = None,
+                 params: Optional[dict] = None):
+        if (model is None) == (model_path is None):
+            raise ValueError("pass exactly one of model / model_path")
+        self.model = model
+        self.model_path = model_path
+        self.example_args = example_args
+        self.params = params
+
+
+class Predictor:
+    """paddle_infer.Predictor parity: run() over named/positional inputs."""
+
+    def __init__(self, config: Config):
+        self._config = config
+        if config.model_path is not None:
+            from .. import jit as pjit
+            self._fn = pjit.load(config.model_path)
+        else:
+            model = config.model
+            from ..nn.layer import Layer, functional_call, raw_params
+            if isinstance(model, Layer):
+                model.eval()
+                params = config.params or raw_params(model)
+
+                def fn(*args):
+                    return functional_call(model, params, *args,
+                                           training=False)
+                self._fn = jax.jit(fn)
+            else:
+                self._fn = jax.jit(model)
+        self._compiled = None
+
+    def run(self, *inputs):
+        out = self._fn(*inputs)
+        return jax.tree.leaves(out) if not isinstance(out, (list, tuple)) \
+            else list(out)
+
+    def __call__(self, *inputs):
+        return self._fn(*inputs)
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

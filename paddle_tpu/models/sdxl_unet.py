@@ -272,11 +272,24 @@ class SDXLUNet(Layer):
         self.conv_out = Conv2D(ch, cfg.out_channels, 3, padding=1)
 
     def forward(self, sample, timestep, encoder_hidden_states,
-                added_cond: Optional[jnp.ndarray] = None):
+                added_cond=None):
+        """``added_cond`` is either the pre-built conditioning vector of
+        size projection_class_embeddings_input_dim, or the SDXL pair
+        ``(text_embeds, time_ids)`` — time_ids (B, 6) micro-conditioning is
+        sinusoidally embedded at addition_time_embed_dim per id and
+        concatenated with the pooled text embedding."""
         cfg = self.config
         temb = timestep_embedding(timestep, cfg.block_out_channels[0])
         temb = self.time_lin2(F.silu(self.time_lin1(temb)))
         if cfg.projection_class_embeddings_input_dim and added_cond is not None:
+            if isinstance(added_cond, (tuple, list)):
+                text_embeds, time_ids = added_cond
+                b = time_ids.shape[0]
+                ids = timestep_embedding(time_ids.reshape(-1),
+                                         cfg.addition_time_embed_dim)
+                ids = ids.reshape(b, -1)
+                added_cond = jnp.concatenate(
+                    [text_embeds, ids.astype(text_embeds.dtype)], axis=-1)
             temb = temb + self.add_lin2(F.silu(self.add_lin1(added_cond)))
 
         h = self.conv_in(sample)

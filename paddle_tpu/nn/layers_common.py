@@ -411,20 +411,28 @@ class TransformerEncoderLayer(Layer):
         return src
 
 
+def _replicate_prototype(proto, num_layers):
+    """paddle semantics: the prototype IS layer 0; later layers are fresh
+    instances (so each gets independent random init, and weights loaded into
+    the prototype survive as layer 0). Fresh construction only when the
+    prototype is exactly a stock layer class whose ctor args were captured
+    in _config; subclasses (unknown signatures) fall back to deepcopy."""
+    import copy
+    if not isinstance(proto, Layer):        # factory callable
+        return [proto() for _ in range(num_layers)]
+    exact = type(proto) in (TransformerEncoderLayer, TransformerDecoderLayer)
+    if exact and hasattr(proto, "_config"):
+        make = lambda: type(proto)(**proto._config)
+    else:
+        make = lambda: copy.deepcopy(proto)
+    return [proto] + [make() for _ in range(num_layers - 1)]
+
+
 class TransformerEncoder(Layer):
     def __init__(self, encoder_layer, num_layers, norm=None):
         super().__init__()
-        import copy
-        if isinstance(encoder_layer, Layer):
-            if hasattr(encoder_layer, "_config"):
-                # re-instantiate per layer so each gets FRESH random init
-                # (a deepcopy would make all layers start byte-identical)
-                make = lambda: type(encoder_layer)(**encoder_layer._config)
-            else:
-                make = lambda: copy.deepcopy(encoder_layer)
-        else:  # factory callable
-            make = encoder_layer
-        self.layers = LayerList([make() for _ in range(num_layers)])
+        self.layers = LayerList(_replicate_prototype(encoder_layer,
+                                                     num_layers))
         self.norm = norm
 
     def forward(self, src, src_mask=None):
@@ -545,16 +553,8 @@ class TransformerDecoderLayer(Layer):
 class TransformerDecoder(Layer):
     def __init__(self, decoder_layer, num_layers, norm=None):
         super().__init__()
-        import copy
-        if isinstance(decoder_layer, Layer):
-            if hasattr(decoder_layer, "_config"):
-                # fresh random init per layer (see TransformerEncoder)
-                make = lambda: type(decoder_layer)(**decoder_layer._config)
-            else:
-                make = lambda: copy.deepcopy(decoder_layer)
-        else:
-            make = decoder_layer
-        self.layers = LayerList([make() for _ in range(num_layers)])
+        self.layers = LayerList(_replicate_prototype(decoder_layer,
+                                                     num_layers))
         self.norm = norm
 
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None):

@@ -1,0 +1,233 @@
+"""incubate surface tests: fused functional ops, decode attention vs dense
+oracle, paged attention vs dense, FusedMultiTransformer prefill/decode
+consistency, inference Predictor."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.incubate.nn import FusedMultiTransformer
+from paddle_tpu.incubate.nn import functional as IF
+from paddle_tpu.nn import functional as F
+
+R = np.random.default_rng(11)
+
+
+def A(*shape):
+    return R.normal(size=shape).astype("float32")
+
+
+class TestFusedFunctional:
+    def test_fused_rms_norm_with_residual(self):
+        x, res, w = A(2, 5, 8), A(2, 5, 8), A(8)
+        out, new_res = IF.fused_rms_norm(x, w, residual=res)
+        want = np.asarray(F.rms_norm(x + res, w))
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(new_res), x + res, rtol=1e-6)
+
+    def test_fused_bias_act(self):
+        x, b = A(4, 8), A(8)
+        np.testing.assert_allclose(
+            np.asarray(IF.fused_bias_act(x, b, "relu")),
+            np.maximum(x + b, 0), rtol=1e-6)
+        out = IF.fused_bias_act(A(4, 8), None, "swiglu")
+        assert out.shape == (4, 4)
+        # geglu = a * gelu(b), NOT sigmoid-gated glu
+        z = A(4, 8)
+        got = np.asarray(IF.fused_bias_act(z, None, "geglu"))
+        a, g = z[:, :4], z[:, 4:]
+        want = a * np.asarray(F.gelu(g))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_fused_rms_norm_begin_axis(self):
+        x, w = A(2, 3, 4), np.ones((3, 4), "float32")
+        got = np.asarray(IF.fused_rms_norm(x, w, begin_norm_axis=1))
+        ms = np.mean(x ** 2, axis=(1, 2), keepdims=True)
+        want = x / np.sqrt(ms + 1e-6)
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_varlen_attention_no_nan_past_len(self):
+        q, k, v = A(1, 4, 2, 8), A(1, 4, 2, 8), A(1, 4, 2, 8)
+        out = IF.variable_length_memory_efficient_attention(
+            q, k, v, seq_lens=jnp.array([2]), kv_seq_lens=jnp.array([2]))
+        assert not np.isnan(np.asarray(out)).any()
+
+    def test_fused_linear_and_dropout_add(self):
+        x, w, b = A(3, 4), A(4, 6), A(6)
+        np.testing.assert_allclose(np.asarray(IF.fused_linear(x, w, b)),
+                                   x @ w + b, rtol=1e-5)
+        y = A(3, 4)
+        out = IF.fused_dropout_add(x, y, p=0.0)
+        np.testing.assert_allclose(np.asarray(out), x + y, rtol=1e-6)
+
+
+def _dense_decode_oracle(q, ks, vs):
+    """q (B,H,D) against full ks/vs (B,S,H,D) — plain softmax attention."""
+    d = q.shape[-1]
+    scores = np.einsum("bhd,bshd->bhs", q, ks) / np.sqrt(d)
+    probs = np.exp(scores - scores.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    return np.einsum("bhs,bshd->bhd", probs, vs)
+
+
+class TestMaskedMHA:
+    def test_matches_dense_oracle(self):
+        b, s_max, h, d = 2, 8, 4, 16
+        lens = np.array([3, 5])
+        k_cache = np.zeros((b, s_max, h, d), "float32")
+        v_cache = np.zeros((b, s_max, h, d), "float32")
+        ks, vs = A(b, s_max, h, d), A(b, s_max, h, d)
+        for i in range(b):
+            k_cache[i, :lens[i]] = ks[i, :lens[i]]
+            v_cache[i, :lens[i]] = vs[i, :lens[i]]
+        q = A(b, h, d)
+        new_k, new_v = A(b, h, d), A(b, h, d)
+        out, kc, vc = IF.masked_multihead_attention(
+            q, jnp.asarray(k_cache), jnp.asarray(v_cache),
+            jnp.asarray(lens), jnp.asarray(new_k), jnp.asarray(new_v))
+        # oracle: attend over [0, len] inclusive with new kv at position len
+        for i in range(b):
+            ks_i = np.concatenate([ks[i, :lens[i]], new_k[i:i + 1]], 0)
+            vs_i = np.concatenate([vs[i, :lens[i]], new_v[i:i + 1]], 0)
+            want = _dense_decode_oracle(q[i:i + 1], ks_i[None], vs_i[None])
+            np.testing.assert_allclose(np.asarray(out[i:i + 1]), want,
+                                       rtol=1e-4, atol=1e-5)
+        # cache was updated at position len
+        np.testing.assert_allclose(np.asarray(kc)[0, lens[0]], new_k[0],
+                                   rtol=1e-6)
+
+    def test_gqa_repeat(self):
+        b, s_max, h, hkv, d = 1, 4, 4, 2, 8
+        k_cache, v_cache = A(b, s_max, hkv, d), A(b, s_max, hkv, d)
+        q = A(b, h, d)
+        lens = np.array([3])
+        out, _, _ = IF.masked_multihead_attention(
+            q, jnp.asarray(k_cache), jnp.asarray(v_cache), jnp.asarray(lens))
+        ks = np.repeat(k_cache, 2, axis=2)[:, :4]
+        vs = np.repeat(v_cache, 2, axis=2)[:, :4]
+        want = _dense_decode_oracle(q, ks, vs)
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4,
+                                   atol=1e-5)
+
+
+class TestPagedAttention:
+    def test_matches_dense(self):
+        b, h, d, bs, nb, mb = 2, 4, 16, 4, 8, 3
+        q = A(b, h, d)
+        k_pool, v_pool = A(nb, bs, h, d), A(nb, bs, h, d)
+        tables = np.array([[0, 2, 4], [1, 3, 5]], "int32")
+        lens = np.array([7, 10])
+        out = IF.paged_attention(jnp.asarray(q), jnp.asarray(k_pool),
+                                 jnp.asarray(v_pool), jnp.asarray(tables),
+                                 jnp.asarray(lens))
+        for i in range(b):
+            ks = k_pool[tables[i]].reshape(mb * bs, h, d)[:lens[i]]
+            vs = v_pool[tables[i]].reshape(mb * bs, h, d)[:lens[i]]
+            want = _dense_decode_oracle(q[i:i + 1], ks[None], vs[None])
+            np.testing.assert_allclose(np.asarray(out[i:i + 1]), want,
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_write_then_read_roundtrip(self):
+        b, h, d, bs, nb = 2, 2, 4, 4, 6
+        k_pool = jnp.zeros((nb, bs, h, d))
+        v_pool = jnp.zeros((nb, bs, h, d))
+        tables = jnp.asarray(np.array([[0, 1], [2, 3]], "int32"))
+        new_k, new_v = jnp.asarray(A(b, h, d)), jnp.asarray(A(b, h, d))
+        lens = jnp.asarray(np.array([5, 2]))  # positions 4 and 1
+        k_pool, v_pool = IF.write_paged_kv(k_pool, v_pool, new_k, new_v,
+                                           tables, lens)
+        # seq0 pos4 → block tables[0][1]=1, offset 0
+        np.testing.assert_allclose(np.asarray(k_pool[1, 0]),
+                                   np.asarray(new_k[0]), rtol=1e-6)
+        # seq1 pos1 → block 2, offset 1
+        np.testing.assert_allclose(np.asarray(v_pool[2, 1]),
+                                   np.asarray(new_v[1]), rtol=1e-6)
+
+
+class TestFusedMultiTransformer:
+    def test_prefill_then_decode_matches_full_forward(self):
+        pt.seed(0)
+        b, s, e = 2, 6, 32
+        m = FusedMultiTransformer(embed_dim=e, num_heads=4,
+                                  dim_feedforward=64, num_layers=2,
+                                  num_kv_heads=2)
+        m.eval()
+        x_full = jnp.asarray(A(b, s, e))
+        # full forward over s tokens (no cache)
+        out_full, _ = m(x_full)
+        # prefill s-1, then decode token s-1 with cache
+        caches = m.init_cache(b, max_len=16)
+        out_prefill, caches = m(x_full[:, :s - 1], caches=caches)
+        lens = jnp.full((b,), s - 1, jnp.int32)
+        out_dec, caches = m(x_full[:, s - 1:], caches=caches, seq_lens=lens)
+        np.testing.assert_allclose(np.asarray(out_dec[:, 0]),
+                                   np.asarray(out_full[:, -1]),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_chunked_prefill_matches_single_prefill(self):
+        pt.seed(3)
+        b, s, e = 2, 8, 32
+        m = FusedMultiTransformer(embed_dim=e, num_heads=4,
+                                  dim_feedforward=64, num_layers=2)
+        m.eval()
+        x = jnp.asarray(A(b, s, e))
+        out_full, caches_full = m(x, caches=m.init_cache(b, 16))
+        caches = m.init_cache(b, 16)
+        out_a, caches = m(x[:, :5], caches=caches)
+        out_b, caches = m(x[:, 5:], caches=caches, position_offset=5)
+        np.testing.assert_allclose(np.asarray(out_b),
+                                   np.asarray(out_full[:, 5:]),
+                                   rtol=1e-3, atol=1e-4)
+        # the caches must agree too (they feed every later decode)
+        np.testing.assert_allclose(np.asarray(caches[0][0][:, :s]),
+                                   np.asarray(caches_full[0][0][:, :s]),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_decode_loop_jits_once(self):
+        pt.seed(1)
+        b, e = 1, 16
+        m = FusedMultiTransformer(embed_dim=e, num_heads=2,
+                                  dim_feedforward=32, num_layers=1)
+        m.eval()
+        from paddle_tpu.nn.layer import functional_call, raw_params
+        params = raw_params(m)
+        caches = m.init_cache(b, max_len=8)
+
+        @jax.jit
+        def decode(params, x, caches, lens):
+            return functional_call(m, params, x, caches=caches,
+                                   seq_lens=lens, training=False)
+
+        x = jnp.asarray(A(b, 1, e))
+        lens = jnp.zeros((b,), jnp.int32)
+        for i in range(4):
+            out, caches = decode(params, x, caches, lens)
+            lens = lens + 1
+        assert out.shape == (b, 1, e)
+
+
+class TestPredictor:
+    def test_predictor_from_layer_and_artifact(self, tmp_path):
+        from paddle_tpu import nn
+        from paddle_tpu.inference import Config, create_predictor
+        from paddle_tpu import jit as pjit
+
+        pt.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x = jnp.asarray(A(3, 4))
+        p1 = create_predictor(Config(model=net))
+        out1 = p1(x)
+        assert out1.shape == (3, 2)
+
+        # AOT artifact path
+        from paddle_tpu.nn.layer import functional_call, raw_params
+        params = raw_params(net)
+        path = str(tmp_path / "net")
+        pjit.save(lambda a: functional_call(net, params, a, training=False),
+                  path, x)
+        p2 = create_predictor(Config(model_path=path))
+        out2 = p2(x)
+        np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                                   rtol=1e-5)

@@ -224,11 +224,22 @@ class TestRNNFixes:
 class TestLayerFixes:
     def test_transformer_layers_fresh_init(self):
         pt.seed(8)
-        enc = nn.TransformerEncoder(
-            nn.TransformerEncoderLayer(8, 2, 16), 2)
+        proto = nn.TransformerEncoderLayer(8, 2, 16)
+        enc = nn.TransformerEncoder(proto, 2)
+        assert enc.layers[0] is proto  # prototype becomes layer 0 (paddle)
         w0 = np.asarray(enc.layers[0].linear1.weight)
         w1 = np.asarray(enc.layers[1].linear1.weight)
         assert np.abs(w0 - w1).max() > 1e-4  # NOT byte-identical
+
+    def test_transformer_encoder_subclass_prototype(self):
+        class MyLayer(nn.TransformerEncoderLayer):
+            def __init__(self, d_model, extra):
+                super().__init__(d_model, 2, 16)
+                self.extra = extra
+
+        pt.seed(9)
+        enc = nn.TransformerEncoder(MyLayer(8, "x"), 2)  # must not crash
+        assert len(enc.layers) == 2 and enc.layers[1].extra == "x"
 
     def test_conv_transpose_same_padding(self):
         x, w = A(1, 3, 8, 8), A(3, 5, 3, 3)
