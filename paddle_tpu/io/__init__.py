@@ -325,12 +325,24 @@ class DistributedBatchSampler(BatchSampler):
 # collate (reference: python/paddle/io/dataloader/collate.py)
 # ---------------------------------------------------------------------------
 
+def _stack_arrays(batch):
+    """np.stack with the C++ GIL-released memcpy fast path when built
+    (native/pdtpu_native.cpp pdtpu_collate_stack) — lets the prefetch
+    thread pool collate in parallel."""
+    from .. import runtime_native
+    if runtime_native.available():
+        out = runtime_native.collate_stack(list(batch))
+        if out is not None:
+            return out
+    return np.stack(batch)
+
+
 def default_collate_fn(batch: Sequence[Any]):
     """Stack a list of samples into batched numpy arrays, recursing into
     dict / tuple / list sample structures."""
     sample = batch[0]
     if isinstance(sample, np.ndarray):
-        return np.stack(batch)
+        return _stack_arrays(batch)
     if isinstance(sample, (bool, np.bool_)):  # before int: bool subclasses int
         return np.asarray(batch, dtype=np.bool_)
     if isinstance(sample, (np.floating, float)):

@@ -128,19 +128,30 @@ class TCPStore:
     """
 
     def __init__(self, endpoint: str, is_master: bool = False,
-                 timeout: float = 60.0):
+                 timeout: float = 60.0, native: Optional[bool] = None):
         host, port = endpoint.rsplit(":", 1)
         self.endpoint = endpoint
         self.timeout = timeout
-        self._server: Optional[_StoreServer] = None
+        self._server = None
+        self._native_server = None
         if is_master:
-            self._server = _StoreServer((host, int(port)))
-            if int(port) == 0:
+            use_native = native
+            if use_native is None:
+                from .. import runtime_native
+                use_native = runtime_native.available()
+            if use_native:
+                # C++ server (native/pdtpu_native.cpp) — same wire protocol,
+                # immune to GIL stalls in the hosting training process
+                from ..runtime_native import StoreServer as _Native
+                self._native_server = _Native(host, int(port))
+                port = str(self._native_server.port)
+            else:
+                self._server = _StoreServer((host, int(port)))
                 port = str(self._server.server_address[1])
-                self.endpoint = f"{host}:{port}"
-            t = threading.Thread(target=self._server.serve_forever,
-                                 daemon=True, name="pdtpu-store")
-            t.start()
+                t = threading.Thread(target=self._server.serve_forever,
+                                     daemon=True, name="pdtpu-store")
+                t.start()
+            self.endpoint = f"{host}:{port}"
         self._sock = self._connect(host, int(port))
         self._lock = threading.Lock()
 
@@ -214,6 +225,9 @@ class TCPStore:
                 self._server.shutdown()
                 self._server.server_close()
                 self._server = None
+            if self._native_server is not None:
+                self._native_server.close()
+                self._native_server = None
 
 
 def free_port() -> int:

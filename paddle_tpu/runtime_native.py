@@ -1,0 +1,202 @@
+"""ctypes bindings for the C++ runtime library (native/pdtpu_native.cpp).
+
+Reference parity: the reference's TCPStore, reader blocking queue, and
+tensor collation are C++ (SURVEY §2.4 store row, §2.6 data pipeline row);
+this module is their TPU-host equivalent. Everything degrades gracefully:
+``available()`` is False when the library isn't built and callers fall back
+to pure Python (launch/store.py, io collate).
+
+Build: ``make -C native`` (done automatically on first import when a
+toolchain is present; result cached at native/build/libpdtpu_native.so).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_REPO, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libpdtpu_native.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_attempted = False
+
+
+def _is_fresh() -> bool:
+    src = os.path.join(_NATIVE_DIR, "pdtpu_native.cpp")
+    return (os.path.exists(_SO_PATH)
+            and os.path.getmtime(_SO_PATH) >= os.path.getmtime(src))
+
+
+def _try_build() -> bool:
+    global _build_attempted
+    if _build_attempted:
+        return os.path.exists(_SO_PATH)
+    _build_attempted = True
+    if _is_fresh():
+        return True
+    # Cross-process exclusive lock: N launched workers on one host must not
+    # run `make` concurrently into the same .so (a sibling could dlopen a
+    # half-written file). One builds, the rest wait then reuse.
+    import fcntl
+    os.makedirs(os.path.join(_NATIVE_DIR, "build"), exist_ok=True)
+    lock_path = os.path.join(_NATIVE_DIR, "build", ".build_lock")
+    try:
+        with open(lock_path, "w") as lock_f:
+            fcntl.lockf(lock_f, fcntl.LOCK_EX)
+            try:
+                if _is_fresh():   # another process built it while we waited
+                    return True
+                subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                               capture_output=True, timeout=120)
+                return os.path.exists(_SO_PATH)
+            finally:
+                fcntl.lockf(lock_f, fcntl.LOCK_UN)
+    except Exception:
+        return False
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        try:
+            if not _try_build():
+                return None
+            lib = ctypes.CDLL(_SO_PATH)
+        except Exception:
+            return None  # degrade to the pure-Python fallbacks
+        lib.pdtpu_store_server_create.restype = ctypes.c_void_p
+        lib.pdtpu_store_server_start.restype = ctypes.c_int
+        lib.pdtpu_store_server_start.argtypes = [ctypes.c_void_p,
+                                                 ctypes.c_char_p,
+                                                 ctypes.c_int]
+        lib.pdtpu_store_server_destroy.argtypes = [ctypes.c_void_p]
+        lib.pdtpu_queue_create.restype = ctypes.c_void_p
+        lib.pdtpu_queue_create.argtypes = [ctypes.c_size_t]
+        lib.pdtpu_queue_push.restype = ctypes.c_int
+        lib.pdtpu_queue_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_size_t, ctypes.c_double]
+        lib.pdtpu_queue_pop.restype = ctypes.POINTER(ctypes.c_char)
+        lib.pdtpu_queue_pop.argtypes = [ctypes.c_void_p,
+                                        ctypes.POINTER(ctypes.c_size_t),
+                                        ctypes.c_double,
+                                        ctypes.POINTER(ctypes.c_int)]
+        lib.pdtpu_queue_close.argtypes = [ctypes.c_void_p]
+        lib.pdtpu_queue_size.restype = ctypes.c_size_t
+        lib.pdtpu_queue_size.argtypes = [ctypes.c_void_p]
+        lib.pdtpu_queue_destroy.argtypes = [ctypes.c_void_p]
+        lib.pdtpu_block_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
+        lib.pdtpu_collate_stack.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.c_size_t, ctypes.c_size_t]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class StoreServer:
+    """C++ TCPStore server (drop-in for launch.store._StoreServer)."""
+
+    def __init__(self, host: str, port: int):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("pdtpu_native not built")
+        self._lib = lib
+        self._h = lib.pdtpu_store_server_create()
+        self.port = lib.pdtpu_store_server_start(
+            self._h, host.encode(), int(port))
+        if self.port < 0:
+            lib.pdtpu_store_server_destroy(self._h)
+            self._h = None
+            raise OSError(f"cannot bind store server on {host}:{port}")
+
+    def close(self):
+        if self._h is not None:
+            self._lib.pdtpu_store_server_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class BlockingQueue:
+    """Bounded MPMC byte-block queue (the reference reader-queue role)."""
+
+    def __init__(self, capacity: int):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("pdtpu_native not built")
+        self._lib = lib
+        self._h = lib.pdtpu_queue_create(capacity)
+
+    def push(self, data: bytes, timeout: float = 60.0) -> bool:
+        r = self._lib.pdtpu_queue_push(self._h, data, len(data),
+                                       float(timeout))
+        if r == -2:
+            raise RuntimeError("queue closed")
+        return r == 0
+
+    def pop(self, timeout: float = 60.0) -> Optional[bytes]:
+        size = ctypes.c_size_t()
+        status = ctypes.c_int()
+        p = self._lib.pdtpu_queue_pop(self._h, ctypes.byref(size),
+                                      float(timeout), ctypes.byref(status))
+        if not p:
+            if status.value == -2:
+                return None       # closed and drained
+            raise TimeoutError("queue pop timed out")
+        try:
+            return ctypes.string_at(p, size.value)
+        finally:
+            self._lib.pdtpu_block_free(p)
+
+    def close(self):
+        if self._h is not None:
+            self._lib.pdtpu_queue_close(self._h)
+
+    def __len__(self):
+        return int(self._lib.pdtpu_queue_size(self._h))
+
+    def destroy(self):
+        if self._h is not None:
+            self._lib.pdtpu_queue_destroy(self._h)
+            self._h = None
+
+
+def collate_stack(arrays: List[np.ndarray]) -> Optional[np.ndarray]:
+    """np.stack for a list of same-shape/dtype contiguous arrays via the
+    C++ memcpy loop (GIL released during the copy). Returns None when the
+    fast path doesn't apply (caller falls back to np.stack)."""
+    lib = _load()
+    if lib is None or not arrays:
+        return None
+    a0 = arrays[0]
+    if a0.dtype.hasobject:
+        # memcpy of PyObject* would copy borrowed references → corruption
+        return None
+    if not all(isinstance(a, np.ndarray) and a.shape == a0.shape
+               and a.dtype == a0.dtype and a.flags.c_contiguous
+               for a in arrays):
+        return None
+    n = len(arrays)
+    out = np.empty((n, *a0.shape), a0.dtype)
+    srcs = (ctypes.c_void_p * n)(
+        *[a.ctypes.data_as(ctypes.c_void_p).value for a in arrays])
+    lib.pdtpu_collate_stack(out.ctypes.data_as(ctypes.c_void_p), srcs, n,
+                            a0.nbytes)
+    return out

@@ -1,0 +1,184 @@
+"""C++ runtime component tests: the native TCPStore server must speak the
+exact Python-client protocol; BlockingQueue semantics; collate fast path."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import runtime_native as rn
+from paddle_tpu.launch.store import TCPStore, free_port
+
+pytestmark = pytest.mark.skipif(not rn.available(),
+                                reason="native lib not built (no toolchain)")
+
+
+class TestNativeStore:
+    def test_python_client_against_cpp_server(self):
+        s = TCPStore(f"127.0.0.1:{free_port()}", is_master=True, native=True)
+        assert s._native_server is not None  # really the C++ server
+        c = TCPStore(s.endpoint)
+        try:
+            s.set("k", b"v1")
+            assert c.get("k") == b"v1"
+            assert c.add("n", 5) == 5
+            assert s.add("n", -2) == 3
+            assert c.keys("") == ["k", "n"]
+            assert s.compare_set("c", b"", b"x")
+            assert not c.compare_set("c", b"y", b"z")
+            assert c.delete("k") and not c.delete("k")
+
+            def setter():
+                time.sleep(0.2)
+                c.set("late", b"yes")
+            t = threading.Thread(target=setter)
+            t.start()
+            assert s.wait("late", timeout=5) == b"yes"
+            t.join()
+            with pytest.raises(TimeoutError):
+                c.wait("never", timeout=0.2)
+        finally:
+            c.close()
+            s.close()
+
+    def test_cpp_server_barrier(self):
+        s = TCPStore(f"127.0.0.1:{free_port()}", is_master=True, native=True)
+        c = TCPStore(s.endpoint)
+        errs = []
+        def one(store):
+            try:
+                store.barrier("b", 2, timeout=5)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+        try:
+            ts = [threading.Thread(target=one, args=(x,)) for x in (s, c)]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+            assert not errs
+        finally:
+            c.close()
+            s.close()
+
+    def test_malformed_request_keeps_server_alive(self):
+        s = TCPStore(f"127.0.0.1:{free_port()}", is_master=True, native=True)
+        c = TCPStore(s.endpoint)
+        try:
+            c.set("n", b"not-a-number")
+            # add on a non-numeric value must fail THIS request only
+            with pytest.raises(Exception):
+                c.add("n", 1)
+            c2 = TCPStore(s.endpoint)
+            c2.set("ok", b"1")        # server still alive and serving
+            assert s.get("ok") == b"1"
+            c2.close()
+        finally:
+            c.close()
+            s.close()
+
+    def test_close_with_connected_client_does_not_hang(self):
+        s = TCPStore(f"127.0.0.1:{free_port()}", is_master=True, native=True)
+        c = TCPStore(s.endpoint)   # stays connected
+        t0 = time.time()
+        s.close()                  # must not block on the live client
+        assert time.time() - t0 < 5
+        c.close()
+
+    def test_hostname_binding(self):
+        s = TCPStore(f"localhost:{free_port()}", is_master=True, native=True)
+        try:
+            c = TCPStore(s.endpoint)
+            c.set("h", b"1")
+            assert s.get("h") == b"1"
+            c.close()
+        finally:
+            s.close()
+
+    def test_ephemeral_port_assignment(self):
+        s = TCPStore("127.0.0.1:0", is_master=True, native=True)
+        try:
+            assert not s.endpoint.endswith(":0")
+            c = TCPStore(s.endpoint)
+            c.set("x", b"1")
+            assert s.get("x") == b"1"
+            c.close()
+        finally:
+            s.close()
+
+
+class TestNativeQueue:
+    def test_fifo_and_blocking(self):
+        q = rn.BlockingQueue(4)
+        try:
+            for i in range(4):
+                assert q.push(f"item{i}".encode())
+            assert len(q) == 4
+            # full queue: push times out
+            assert not q.push(b"overflow", timeout=0.1)
+            got = [q.pop() for _ in range(4)]
+            assert got == [b"item0", b"item1", b"item2", b"item3"]
+            with pytest.raises(TimeoutError):
+                q.pop(timeout=0.1)
+        finally:
+            q.close()
+            q.destroy()
+
+    def test_producer_consumer_threads(self):
+        q = rn.BlockingQueue(2)
+        received = []
+        def consumer():
+            while True:
+                b = q.pop(timeout=10)
+                if b is None:
+                    return
+                received.append(b)
+        t = threading.Thread(target=consumer)
+        t.start()
+        for i in range(20):
+            q.push(str(i).encode() * 100)
+        time.sleep(0.2)
+        q.close()
+        t.join(timeout=10)
+        q.destroy()
+        assert len(received) == 20
+        assert received[7] == b"7" * 100
+
+    def test_close_unblocks_pop(self):
+        q = rn.BlockingQueue(2)
+        result = {}
+        def popper():
+            result["v"] = q.pop(timeout=30)
+        t = threading.Thread(target=popper)
+        t.start()
+        time.sleep(0.1)
+        q.close()
+        t.join(timeout=5)
+        assert not t.is_alive() and result["v"] is None
+        q.destroy()
+
+
+class TestNativeCollate:
+    def test_matches_np_stack(self):
+        arrs = [np.random.default_rng(i).normal(size=(16, 32)).astype("float32")
+                for i in range(8)]
+        out = rn.collate_stack(arrs)
+        np.testing.assert_array_equal(out, np.stack(arrs))
+        assert out.dtype == np.float32
+
+    def test_fast_path_declines_mixed(self):
+        assert rn.collate_stack([np.zeros((2, 2)), np.zeros((3, 2))]) is None
+        assert rn.collate_stack(
+            [np.zeros((2, 2), "float32"), np.zeros((2, 2), "int32")]) is None
+        # object dtype would memcpy borrowed PyObject* — must decline
+        objs = [np.array(["a", "bb"], dtype=object) for _ in range(2)]
+        assert rn.collate_stack(objs) is None
+
+    def test_dataloader_uses_it(self):
+        from paddle_tpu.io import DataLoader, TensorDataset
+        x = np.arange(64, dtype="float32").reshape(16, 4)
+        y = np.arange(16, dtype="int64")
+        dl = DataLoader(TensorDataset([x, y]), batch_size=4)
+        batches = list(dl)
+        assert len(batches) == 4
+        np.testing.assert_array_equal(batches[0][0], x[:4])
+        np.testing.assert_array_equal(batches[0][1], y[:4])
