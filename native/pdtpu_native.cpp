@@ -64,14 +64,32 @@ bool write_all(int fd, const void* buf, size_t n) {
   return true;
 }
 
+// u32 little-endian on the wire (struct '<I' on the Python side) —
+// explicit conversion keeps the protocol byte-order portable
+uint32_t le32_decode(const void* p) {
+  const unsigned char* b = static_cast<const unsigned char*>(p);
+  return static_cast<uint32_t>(b[0]) | (static_cast<uint32_t>(b[1]) << 8) |
+         (static_cast<uint32_t>(b[2]) << 16) |
+         (static_cast<uint32_t>(b[3]) << 24);
+}
+
+void le32_encode(uint32_t v, std::string* out) {
+  char b[4] = {static_cast<char>(v & 0xff), static_cast<char>((v >> 8) & 0xff),
+               static_cast<char>((v >> 16) & 0xff),
+               static_cast<char>((v >> 24) & 0xff)};
+  out->append(b, 4);
+}
+
 bool read_msg(int fd, std::vector<std::string>* fields) {
-  uint32_t nf;
-  if (!read_exact(fd, &nf, 4)) return false;
+  char nf_raw[4];
+  if (!read_exact(fd, nf_raw, 4)) return false;
+  uint32_t nf = le32_decode(nf_raw);
   if (nf > 1024) return false;  // sanity bound
   fields->clear();
   for (uint32_t i = 0; i < nf; ++i) {
-    uint32_t len;
-    if (!read_exact(fd, &len, 4)) return false;
+    char len_raw[4];
+    if (!read_exact(fd, len_raw, 4)) return false;
+    uint32_t len = le32_decode(len_raw);
     if (len > (64u << 20)) return false;  // 64 MiB per field bound
     std::string f(len, '\0');
     if (len && !read_exact(fd, &f[0], len)) return false;
@@ -82,11 +100,9 @@ bool read_msg(int fd, std::vector<std::string>* fields) {
 
 bool write_msg(int fd, const std::vector<std::string>& fields) {
   std::string out;
-  uint32_t nf = static_cast<uint32_t>(fields.size());
-  out.append(reinterpret_cast<const char*>(&nf), 4);
+  le32_encode(static_cast<uint32_t>(fields.size()), &out);
   for (const auto& f : fields) {
-    uint32_t len = static_cast<uint32_t>(f.size());
-    out.append(reinterpret_cast<const char*>(&len), 4);
+    le32_encode(static_cast<uint32_t>(f.size()), &out);
     out.append(f);
   }
   return write_all(fd, out.data(), out.size());
@@ -143,12 +159,14 @@ class StoreServer {
       stopping_ = true;
       cv_.notify_all();
     }
+    // shutdown unblocks accept(); the fd is CLOSED only after the accept
+    // thread joins, so a racing accept() can never hit a reused fd number
+    if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
+    if (accept_thread_.joinable()) accept_thread_.join();
     if (listen_fd_ >= 0) {
-      ::shutdown(listen_fd_, SHUT_RDWR);
       ::close(listen_fd_);
       listen_fd_ = -1;
     }
-    if (accept_thread_.joinable()) accept_thread_.join();
     // Unblock workers parked in recv() on live client connections BEFORE
     // joining, or Stop would hang until every remote peer disconnects.
     {
@@ -318,8 +336,11 @@ class BlockingQueue {
         return -1;
     }
     if (closed_) return -2;
-    char* copy = static_cast<char*>(::malloc(size));
-    ::memcpy(copy, data, size);
+    // malloc(1) floor: a non-null pointer even for empty payloads, so Pop's
+    // nullptr return unambiguously means timeout/closed
+    char* copy = static_cast<char*>(::malloc(size ? size : 1));
+    if (!copy) return -3;  // out of host memory — surface, don't segfault
+    if (size) ::memcpy(copy, data, size);
     q_.push_back({copy, size});
     not_empty_.notify_one();
     return 0;

@@ -68,7 +68,8 @@ def __getattr__(name):
     import importlib
     if name in ("distributed", "io", "ckpt", "models", "profiler", "metrics",
                 "vision", "incubate", "hapi", "static", "device", "launch",
-                "utils", "config", "sparse", "quantization", "inference"):
+                "utils", "config", "sparse", "quantization", "inference",
+                "audio"):
         try:
             mod = importlib.import_module(f".{name}", __name__)
         except ModuleNotFoundError as e:

@@ -1,0 +1,99 @@
+"""``paddle.audio`` parity (minimal): STFT spectrogram + mel features.
+
+Reference: python/paddle/audio/ (functional/window.py, features/layers.py).
+Capability-parity tier per SURVEY §2.6 (low priority); the compute-relevant
+pieces (stft via ops.fft, mel filterbank matmul) are here and jit-safe.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["get_window", "stft", "spectrogram", "mel_frequencies",
+           "compute_fbank_matrix", "Spectrogram", "MelSpectrogram"]
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True):
+    n = win_length
+    k = jnp.arange(n)
+    denom = n if fftbins else n - 1
+    if window in ("hann", "hanning"):
+        return 0.5 - 0.5 * jnp.cos(2 * math.pi * k / denom)
+    if window == "hamming":
+        return 0.54 - 0.46 * jnp.cos(2 * math.pi * k / denom)
+    if window in ("rect", "boxcar", "ones"):
+        return jnp.ones(n)
+    raise ValueError(f"unsupported window {window!r}")
+
+
+def stft(x, n_fft=512, hop_length=None, win_length=None, window="hann",
+         center=True):
+    """x: (..., T) → complex (..., n_fft//2+1, frames)."""
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    win = get_window(window, wl)
+    if wl < n_fft:
+        pad = (n_fft - wl) // 2
+        win = jnp.pad(win, (pad, n_fft - wl - pad))
+    if center:
+        pad_cfg = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad_cfg, mode="reflect")
+    t = x.shape[-1]
+    n_frames = 1 + (t - n_fft) // hop
+    idx = (jnp.arange(n_frames)[:, None] * hop + jnp.arange(n_fft)[None])
+    frames = x[..., idx] * win          # (..., frames, n_fft)
+    spec = jnp.fft.rfft(frames, axis=-1)
+    return jnp.swapaxes(spec, -1, -2)   # (..., bins, frames)
+
+
+def spectrogram(x, n_fft=512, hop_length=None, power=2.0, **kw):
+    s = jnp.abs(stft(x, n_fft=n_fft, hop_length=hop_length, **kw))
+    return s ** power
+
+
+def mel_frequencies(n_mels, f_min, f_max):
+    def hz_to_mel(f):
+        return 2595.0 * np.log10(1.0 + f / 700.0)
+
+    def mel_to_hz(m):
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+
+    mels = np.linspace(hz_to_mel(f_min), hz_to_mel(f_max), n_mels)
+    return mel_to_hz(mels)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None):
+    f_max = f_max or sr / 2
+    fft_freqs = np.linspace(0, sr / 2, n_fft // 2 + 1)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max)
+    fb = np.zeros((n_mels, len(fft_freqs)), np.float32)
+    for i in range(n_mels):
+        lo, ctr, hi = mel_f[i], mel_f[i + 1], mel_f[i + 2]
+        up = (fft_freqs - lo) / max(ctr - lo, 1e-8)
+        down = (hi - fft_freqs) / max(hi - ctr, 1e-8)
+        fb[i] = np.maximum(0, np.minimum(up, down))
+    return jnp.asarray(fb)
+
+
+class Spectrogram:
+    def __init__(self, n_fft=512, hop_length=None, power=2.0,
+                 window="hann"):
+        self.kw = dict(n_fft=n_fft, hop_length=hop_length, power=power,
+                       window=window)
+
+    def __call__(self, x):
+        return spectrogram(x, **self.kw)
+
+
+class MelSpectrogram:
+    def __init__(self, sr=16000, n_fft=512, hop_length=None, n_mels=64,
+                 f_min=0.0, f_max=None, power=2.0):
+        self.spec = Spectrogram(n_fft, hop_length, power)
+        self.fbank = compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max)
+
+    def __call__(self, x):
+        s = self.spec(x)                       # (..., bins, frames)
+        return jnp.einsum("mb,...bf->...mf", self.fbank, s)

@@ -16,9 +16,13 @@ multiprocess shared-memory queue design:
   defaults its replica/rank to the jax process topology, so each host reads
   only its shard (reference: ``DistributedBatchSampler`` over PADDLE_TRAINER_*
   env).
-- An optional C++ ring-buffer queue (paddle_tpu.runtime_native) replaces the
-  Python queue when built, mirroring the reference's native blocking queue
-  (paddle/fluid/operators/reader/).
+- Native fast path: batch collation uses the C++ GIL-released memcpy
+  (paddle_tpu.runtime_native.collate_stack) when built, so the prefetch
+  thread pool scales; the cross-thread handoff itself stays a Python queue
+  (its waits already release the GIL — a byte queue would only add
+  serialization). runtime_native.BlockingQueue (the reference's
+  paddle/fluid/operators/reader/ blocking-queue role) is exported as a
+  public building block for user-built streaming pipelines.
 """
 
 from __future__ import annotations
@@ -328,12 +332,12 @@ class DistributedBatchSampler(BatchSampler):
 def _stack_arrays(batch):
     """np.stack with the C++ GIL-released memcpy fast path when built
     (native/pdtpu_native.cpp pdtpu_collate_stack) — lets the prefetch
-    thread pool collate in parallel."""
+    thread pool collate in parallel. collate_stack itself returns None
+    when the lib is missing or the fast path doesn't apply."""
     from .. import runtime_native
-    if runtime_native.available():
-        out = runtime_native.collate_stack(list(batch))
-        if out is not None:
-            return out
+    out = runtime_native.collate_stack(list(batch))
+    if out is not None:
+        return out
     return np.stack(batch)
 
 

@@ -10,9 +10,11 @@ bootstraps the device runtime; this store carries the *launcher-level*
 protocol — rank assignment, peer discovery, elastic heartbeats — the part
 the reference does with HTTPMaster/ETCDMaster + TCPStore.
 
-A C++ implementation of the same protocol lives in ``native/store.cpp``
-(built as libpdtpu_store.so); ``TCPStore`` transparently uses it through
-ctypes when the extension is built, falling back to pure Python.
+A C++ implementation of the same protocol lives in
+``native/pdtpu_native.cpp`` (built as ``native/build/libpdtpu_native.so``
+via ``make -C native``); ``TCPStore`` uses its server through
+ctypes (paddle_tpu.runtime_native) when built, falling back to the pure
+Python socketserver here.
 """
 
 from __future__ import annotations

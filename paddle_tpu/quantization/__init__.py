@@ -1,0 +1,163 @@
+"""``paddle.quantization`` parity: QAT fake-quant + PTQ observers.
+
+Reference: python/paddle/quantization/ (QuantConfig, QAT, PTQ,
+FakeQuanterWithAbsMaxObserver, AbsmaxObserver) — SURVEY §2.6.
+
+TPU redesign: fake-quant is a straight-through-estimator round in the
+compiled graph (XLA fuses it into adjacent ops); QAT wraps Linear/Conv2D
+with weight (and optional activation) fake-quant. int8 inference conversion
+(`convert`) materializes quantized weights + scales.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Type
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+from ..nn.layers_common import Conv2D, Linear
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMax",
+           "AbsmaxObserver", "quantize_absmax", "dequantize"]
+
+
+def _ste_round(x):
+    """Round with straight-through gradient."""
+    return x + jax.lax.stop_gradient(jnp.round(x) - x)
+
+
+def quantize_absmax(x, bits: int = 8, axis=None):
+    qmax = 2.0 ** (bits - 1) - 1
+    scale = jnp.max(jnp.abs(x), axis=axis, keepdims=axis is not None)
+    scale = jnp.maximum(scale, 1e-8) / qmax
+    q = jnp.clip(jnp.round(x / scale), -qmax - 1, qmax).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize(q, scale):
+    return q.astype(jnp.float32) * scale
+
+
+class FakeQuanterWithAbsMax(Layer):
+    """Simulated quantization: quantize→dequantize with STE gradients."""
+
+    def __init__(self, bits: int = 8, axis=None):
+        super().__init__()
+        self.bits = bits
+        self.axis = axis
+
+    def forward(self, x):
+        qmax = 2.0 ** (self.bits - 1) - 1
+        scale = jnp.max(jnp.abs(jax.lax.stop_gradient(x)), axis=self.axis,
+                        keepdims=self.axis is not None)
+        scale = jnp.maximum(scale, 1e-8) / qmax
+        return _ste_round(x / scale).clip(-qmax - 1, qmax) * scale
+
+
+class AbsmaxObserver(Layer):
+    """PTQ observer: tracks running max |x| to derive scales offline."""
+
+    def __init__(self, bits: int = 8):
+        super().__init__()
+        self.bits = bits
+        self.register_buffer("absmax", jnp.zeros(()), persistable=True)
+
+    def forward(self, x):
+        self.absmax = jnp.maximum(self.absmax, jnp.max(jnp.abs(x)))
+        return x
+
+    def scale(self):
+        qmax = 2.0 ** (self.bits - 1) - 1
+        return jnp.maximum(self.absmax, 1e-8) / qmax
+
+
+@dataclasses.dataclass
+class QuantConfig:
+    """Which layer types get quantized and how many bits."""
+
+    weight_bits: int = 8
+    activation_bits: int = 8
+    quantize_activations: bool = False
+    layer_types: tuple = (Linear, Conv2D)
+
+    def add_type_config(self, layer_type, weight_bits=None):
+        self.layer_types = (*self.layer_types, layer_type)
+
+
+class _QuantWrapper(Layer):
+    """Wraps a layer: fake-quant its weight (and optionally input)."""
+
+    def __init__(self, inner: Layer, config: QuantConfig):
+        super().__init__()
+        self.inner = inner
+        self.wq = FakeQuanterWithAbsMax(config.weight_bits)
+        self.aq = (FakeQuanterWithAbsMax(config.activation_bits)
+                   if config.quantize_activations else None)
+
+    def forward(self, x):
+        if self.aq is not None:
+            x = self.aq(x)
+        w = self.inner.weight
+        try:
+            self.inner.weight = self.wq(self.inner.weight)
+            return self.inner(x)
+        finally:
+            self.inner.weight = w
+
+
+class QAT:
+    """Quantization-aware training driver: model → fake-quantized model."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        self._rewrite(model)
+        return model
+
+    def _rewrite(self, layer: Layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, self.config.layer_types):
+                layer._sub_layers[name] = _QuantWrapper(sub, self.config)
+            else:
+                self._rewrite(sub)
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        """Materialize int8 weights + scales for inference export."""
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+
+        def conv(layer):
+            for name, sub in list(layer._sub_layers.items()):
+                if isinstance(sub, _QuantWrapper):
+                    q, scale = quantize_absmax(sub.inner.weight,
+                                               self.config.weight_bits)
+                    sub.inner.weight = dequantize(q, scale)
+                    sub.inner.register_buffer("weight_scale", scale)
+                    sub.inner.register_buffer("weight_int8", q)
+                    layer._sub_layers[name] = sub.inner
+                else:
+                    conv(sub)
+
+        conv(model)
+        return model
+
+
+class PTQ:
+    """Post-training quantization: observe activations, then convert."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        qat = QAT(self.config)
+        return qat.quantize(model, inplace=inplace)
+
+    convert = QAT.convert

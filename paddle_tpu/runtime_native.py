@@ -149,6 +149,8 @@ class BlockingQueue:
                                        float(timeout))
         if r == -2:
             raise RuntimeError("queue closed")
+        if r == -3:
+            raise MemoryError("native queue: block allocation failed")
         return r == 0
 
     def pop(self, timeout: float = 60.0) -> Optional[bytes]:

@@ -1,0 +1,252 @@
+"""``paddle.static`` facade — Program/data/Executor on top of XLA.
+
+Reference: python/paddle/static/ (Program, program_guard, data, Executor,
+CompiledProgram) over the C++ ProgramDesc/InterpreterCore stack (SURVEY
+§2.3). The reference builds a protobuf op graph and interprets it; here a
+``Program`` records a lazy expression graph of ``Var`` nodes and
+``Executor.run`` JIT-compiles it with XLA (cached per feed signature) — the
+InterpreterCore/stream-scheduling machinery is exactly what XLA replaces
+(SURVEY §7.3).
+
+Deviation (documented): ops on placeholders must go through ``Var``
+operators/methods or ``static.apply(fn, ...)`` — the dynamic ``paddle_tpu.ops``
+functions operate on real arrays, so a Var cannot be passed to them
+directly. ``@paddle_tpu.jit.to_static`` remains the primary graph-capture
+path, as in the reference's 3.0 dynamic-first design.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Program", "program_guard", "default_main_program",
+           "default_startup_program", "data", "InputSpec", "Executor",
+           "CompiledProgram", "Var", "apply", "nn"]
+
+
+class Var:
+    """Symbolic node in a Program's expression graph."""
+
+    _next_id = [0]
+
+    def __init__(self, program: "Program", op: Optional[Tuple] = None,
+                 shape=None, dtype=None, name=None):
+        self.program = program
+        self.op = op          # None for placeholders, else (fn, args, kwargs)
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.name = name or f"var_{Var._next_id[0]}"
+        Var._next_id[0] += 1
+        program._vars[self.name] = self
+
+    # -- graph building ----------------------------------------------------
+
+    def _wrap(self, fn, *args, **kwargs):
+        return Var(self.program, op=(fn, args, kwargs))
+
+    def __add__(self, o): return self._wrap(jnp.add, self, o)
+    def __radd__(self, o): return self._wrap(jnp.add, o, self)
+    def __sub__(self, o): return self._wrap(jnp.subtract, self, o)
+    def __rsub__(self, o): return self._wrap(jnp.subtract, o, self)
+    def __mul__(self, o): return self._wrap(jnp.multiply, self, o)
+    def __rmul__(self, o): return self._wrap(jnp.multiply, o, self)
+    def __truediv__(self, o): return self._wrap(jnp.divide, self, o)
+    def __rtruediv__(self, o): return self._wrap(jnp.divide, o, self)
+    def __pow__(self, o): return self._wrap(jnp.power, self, o)
+    def __neg__(self): return self._wrap(jnp.negative, self)
+    def __matmul__(self, o): return self._wrap(jnp.matmul, self, o)
+    def __getitem__(self, idx): return self._wrap(lambda x, i: x[i], self, idx)
+    def __lt__(self, o): return self._wrap(jnp.less, self, o)
+    def __le__(self, o): return self._wrap(jnp.less_equal, self, o)
+    def __gt__(self, o): return self._wrap(jnp.greater, self, o)
+    def __ge__(self, o): return self._wrap(jnp.greater_equal, self, o)
+
+    def astype(self, dtype): return self._wrap(lambda x: x.astype(dtype), self)
+    def reshape(self, shape): return self._wrap(jnp.reshape, self, shape)
+    def transpose(self, perm): return self._wrap(jnp.transpose, self, perm)
+    def sum(self, axis=None, keepdim=False):
+        return self._wrap(lambda x: jnp.sum(x, axis=axis, keepdims=keepdim), self)
+    def mean(self, axis=None, keepdim=False):
+        return self._wrap(lambda x: jnp.mean(x, axis=axis, keepdims=keepdim), self)
+    def max(self, axis=None, keepdim=False):
+        return self._wrap(lambda x: jnp.max(x, axis=axis, keepdims=keepdim), self)
+    def min(self, axis=None, keepdim=False):
+        return self._wrap(lambda x: jnp.min(x, axis=axis, keepdims=keepdim), self)
+    def matmul(self, o): return self.__matmul__(o)
+    def exp(self): return self._wrap(jnp.exp, self)
+    def log(self): return self._wrap(jnp.log, self)
+    def tanh(self): return self._wrap(jnp.tanh, self)
+    def sqrt(self): return self._wrap(jnp.sqrt, self)
+    def abs(self): return self._wrap(jnp.abs, self)
+
+    def __repr__(self):
+        kind = "data" if self.op is None else "op"
+        return f"Var({self.name}, {kind}, shape={self.shape})"
+
+
+def apply(fn: Callable, *args, **kwargs) -> Var:
+    """Apply any jnp-compatible function to Vars/constants symbolically."""
+    prog = None
+    for a in list(args) + list(kwargs.values()):
+        if isinstance(a, Var):
+            prog = a.program
+            break
+    if prog is None:
+        raise ValueError("apply() needs at least one Var argument")
+    return Var(prog, op=(fn, args, kwargs))
+
+
+class Program:
+    """Records placeholders + the lazy op graph hanging off them."""
+
+    def __init__(self):
+        self._vars: Dict[str, Var] = {}
+        self._datas: List[Var] = []
+        self._cache: Dict[Any, Any] = {}
+
+    def data(self, name, shape, dtype="float32") -> Var:
+        v = Var(self, op=None, shape=shape, dtype=dtype, name=name)
+        self._datas.append(v)
+        return v
+
+    def _eval(self, fetch: Sequence[Var], feed: Dict[str, np.ndarray]):
+        """Compile (cached by feed shapes/dtypes) and run the graph."""
+        feed_names = tuple(v.name for v in self._datas if v.name in feed)
+        sig = (tuple((n, feed[n].shape, str(np.asarray(feed[n]).dtype))
+                     for n in feed_names),
+               tuple(v.name for v in fetch))
+        fn = self._cache.get(sig)
+        if fn is None:
+            def run_graph(*feed_vals):
+                env = dict(zip(feed_names, feed_vals))
+
+                def ev(node):
+                    if isinstance(node, Var):
+                        if node.name in env:
+                            return env[node.name]
+                        if node.op is None:
+                            raise KeyError(
+                                f"placeholder {node.name!r} not fed")
+                        f, args, kwargs = node.op
+                        val = f(*[ev(a) for a in args],
+                                **{k: ev(v) for k, v in kwargs.items()})
+                        env[node.name] = val
+                        return val
+                    if isinstance(node, (list, tuple)):
+                        return type(node)(ev(x) for x in node)
+                    return node
+
+                return tuple(ev(v) for v in fetch)
+
+            fn = jax.jit(run_graph)
+            self._cache[sig] = fn
+        return fn(*[jnp.asarray(feed[n]) for n in feed_names])
+
+    def global_block(self):
+        return self
+
+    @property
+    def vars(self):
+        return self._vars
+
+
+_tls = threading.local()
+
+
+def _stack() -> List[Program]:
+    if not hasattr(_tls, "stack"):
+        _tls.stack = [Program()]
+    return _tls.stack
+
+
+def default_main_program() -> Program:
+    return _stack()[-1]
+
+
+def default_startup_program() -> Program:
+    # parameter init happens eagerly in this design; the startup program is
+    # an empty Program kept for API parity
+    if not hasattr(_tls, "startup"):
+        _tls.startup = Program()
+    return _tls.startup
+
+
+class program_guard:
+    def __init__(self, main_program: Program,
+                 startup_program: Optional[Program] = None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        _stack().append(self.main)
+        return self.main
+
+    def __exit__(self, *exc):
+        _stack().pop()
+        return False
+
+
+def data(name: str, shape, dtype="float32") -> Var:
+    return default_main_program().data(name, shape, dtype)
+
+
+class InputSpec:
+    """Re-export of jit.InputSpec at the reference's static location."""
+
+    def __new__(cls, shape, dtype="float32", name=None):
+        from ..jit import InputSpec as _IS
+        return _IS(shape, dtype=dtype, name=name)
+
+
+class Executor:
+    """``paddle.static.Executor`` parity: run(program, feed, fetch_list)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list=None, return_numpy=True):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        single = isinstance(fetch_list, Var)
+        if single:
+            fetch_list = [fetch_list]
+        outs = program._eval(fetch_list, feed)
+        if return_numpy:
+            outs = [np.asarray(o) for o in outs]
+        return outs[0] if single else list(outs)
+
+
+class CompiledProgram:
+    """Reference CompiledProgram accepted alias — XLA always compiles."""
+
+    def __init__(self, program: Program, build_strategy=None):
+        self.program = program
+
+
+class nn:
+    """``paddle.static.nn`` subset: layers that create parameters eagerly
+    and record the op symbolically."""
+
+    @staticmethod
+    def fc(x: Var, size: int, activation=None, name=None):
+        from ..nn.layers_common import Linear
+        in_dim = x.shape[-1]
+        if in_dim in (None, -1):
+            raise ValueError("static.nn.fc needs a static last dim")
+        layer = Linear(int(in_dim), size)
+        w, b = layer.weight, layer.bias
+        out = apply(lambda v, w, b: v @ w + b, x, w, b)
+        if activation == "relu":
+            out = apply(jax.nn.relu, out)
+        elif activation == "tanh":
+            out = apply(jnp.tanh, out)
+        elif activation == "softmax":
+            out = apply(jax.nn.softmax, out)
+        return out

@@ -1,0 +1,119 @@
+"""``paddle.utils`` parity: try_import, run_check, unique_name, deprecated,
+cpp_extension pointer.
+
+Reference: python/paddle/utils/ (install_check.run_check, unique_name.py,
+deprecated decorator, cpp_extension/ for custom-op builds).
+"""
+
+from __future__ import annotations
+
+import functools
+import importlib
+import threading
+import warnings
+from typing import Optional
+
+__all__ = ["try_import", "run_check", "unique_name", "deprecated",
+           "require_version"]
+
+
+def try_import(module_name: str, err_msg: Optional[str] = None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed "
+                       f"(this image is frozen — gate the feature instead)")
+
+
+def run_check():
+    """Device sanity check (reference: paddle.utils.run_check prints GPU
+    status; here: jax backend + a tiny compiled matmul on every device)."""
+    import jax
+    import jax.numpy as jnp
+    devs = jax.devices()
+    x = jnp.ones((8, 8))
+    y = jax.jit(lambda a: a @ a)(x)
+    y.block_until_ready()
+    print(f"paddle_tpu is installed successfully! "
+          f"{len(devs)} {devs[0].platform} device(s) available; "
+          f"compiled matmul OK (sum={float(y.sum()):.0f}).")
+    return True
+
+
+class _UniqueName:
+    """paddle.utils.unique_name: generate/guard/switch."""
+
+    def __init__(self):
+        self._tls = threading.local()
+
+    def _counters(self):
+        if not hasattr(self._tls, "c"):
+            self._tls.c = {}
+        return self._tls.c
+
+    def generate(self, key: str) -> str:
+        c = self._counters()
+        n = c.get(key, 0)
+        c[key] = n + 1
+        return f"{key}_{n}"
+
+    def switch(self, new_counters=None):
+        old = self._counters()
+        self._tls.c = dict(new_counters or {})
+        return old
+
+    class guard:
+        def __init__(self, new_generator=None):
+            self.new = new_generator
+
+        def __enter__(self):
+            self.old = unique_name.switch({})
+            return self
+
+        def __exit__(self, *exc):
+            unique_name.switch(self.old)
+            return False
+
+
+unique_name = _UniqueName()
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = ""):
+    """Decorator emitting a DeprecationWarning on first call (reference
+    paddle.utils.deprecated)."""
+
+    def deco(fn):
+        warned = []
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            if not warned:
+                warned.append(1)
+                msg = f"{fn.__name__} is deprecated"
+                if since:
+                    msg += f" since {since}"
+                if update_to:
+                    msg += f"; use {update_to} instead"
+                if reason:
+                    msg += f" ({reason})"
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*a, **k)
+
+        return wrapper
+
+    return deco
+
+
+def require_version(min_version: str, max_version: Optional[str] = None):
+    from .. import __version__
+
+    def parse(v):
+        return tuple(int(x) for x in v.split(".")[:3])
+
+    cur = parse(__version__)
+    if parse(min_version) > cur or (max_version and parse(max_version) < cur):
+        raise RuntimeError(
+            f"paddle_tpu {__version__} outside required "
+            f"[{min_version}, {max_version or '∞'}]")
+    return True

@@ -1,0 +1,11 @@
+"""``paddle.vision`` parity: transforms, model zoo (ResNet/LeNet), datasets.
+
+Reference: python/paddle/vision/ (transforms/, models/resnet.py, datasets/)
+— SURVEY §2.6. Dataset downloads are gated (zero-egress image): the dataset
+classes accept pre-downloaded files and there is a RandomDataset for tests.
+"""
+
+from . import transforms  # noqa: F401
+from . import models  # noqa: F401
+from . import datasets  # noqa: F401
+from .models import LeNet, ResNet, resnet18, resnet34, resnet50  # noqa: F401

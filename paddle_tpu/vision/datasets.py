@@ -1,0 +1,99 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+
+Zero-egress image: no downloads. MNIST/Cifar load from pre-downloaded
+files when given a path; RandomDataset provides the test/CI data source
+(the reference's fake_data pattern).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["MNIST", "Cifar10", "RandomDataset"]
+
+
+class RandomDataset(Dataset):
+    """Deterministic random images + labels (CI/test data source)."""
+
+    def __init__(self, num_samples=256, image_shape=(3, 32, 32),
+                 num_classes=10, transform: Optional[Callable] = None,
+                 seed=0):
+        self.n = num_samples
+        self.shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, idx):
+        r = np.random.default_rng(self.seed * 1_000_003 + idx)
+        img = r.normal(size=self.shape).astype("float32")
+        label = np.int64(r.integers(0, self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+class MNIST(Dataset):
+    """idx-format MNIST from local files (no download — zero egress)."""
+
+    def __init__(self, image_path: str, label_path: str, mode="train",
+                 transform: Optional[Callable] = None):
+        self.transform = transform
+        with (gzip.open(image_path, "rb") if image_path.endswith(".gz")
+              else open(image_path, "rb")) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            assert magic == 2051, f"bad MNIST image magic {magic}"
+            self.images = np.frombuffer(f.read(), np.uint8).reshape(
+                n, rows, cols)
+        with (gzip.open(label_path, "rb") if label_path.endswith(".gz")
+              else open(label_path, "rb")) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            assert magic == 2049, f"bad MNIST label magic {magic}"
+            self.labels = np.frombuffer(f.read(), np.uint8).astype("int64")
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 python pickle batches from a local directory."""
+
+    def __init__(self, data_dir: str, mode="train",
+                 transform: Optional[Callable] = None):
+        self.transform = transform
+        files = ([f"data_batch_{i}" for i in range(1, 6)]
+                 if mode == "train" else ["test_batch"])
+        xs, ys = [], []
+        for fn in files:
+            with open(os.path.join(data_dir, fn), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            xs.append(d[b"data"])
+            ys.extend(d[b"labels"])
+        self.images = np.concatenate(xs).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(ys, "int64")
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]

@@ -1,0 +1,137 @@
+"""Vision model zoo: ResNet family + LeNet.
+
+Reference: python/paddle/vision/models/resnet.py, lenet.py. BatchNorm+conv
+blocks lower to XLA convs on the MXU; NCHW API kept for porting parity.
+"""
+
+from __future__ import annotations
+
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn.layers_common import (AvgPool2D, BatchNorm2D, Conv2D, Linear,
+                                MaxPool2D, ReLU, Sequential)
+from ..nn.layers_conv import AdaptiveAvgPool2D
+
+__all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "LeNet"]
+
+
+class BasicBlock(Layer):
+    expansion = 1
+
+    def __init__(self, in_ch, ch, stride=1):
+        super().__init__()
+        self.conv1 = Conv2D(in_ch, ch, 3, stride=stride, padding=1,
+                            bias_attr=False)
+        self.bn1 = BatchNorm2D(ch)
+        self.conv2 = Conv2D(ch, ch, 3, padding=1, bias_attr=False)
+        self.bn2 = BatchNorm2D(ch)
+        self.down = None
+        if stride != 1 or in_ch != ch * self.expansion:
+            self.down = Sequential(
+                Conv2D(in_ch, ch * self.expansion, 1, stride=stride,
+                       bias_attr=False),
+                BatchNorm2D(ch * self.expansion))
+
+    def forward(self, x):
+        identity = x if self.down is None else self.down(x)
+        out = F.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        return F.relu(out + identity)
+
+
+class BottleneckBlock(Layer):
+    expansion = 4
+
+    def __init__(self, in_ch, ch, stride=1):
+        super().__init__()
+        self.conv1 = Conv2D(in_ch, ch, 1, bias_attr=False)
+        self.bn1 = BatchNorm2D(ch)
+        self.conv2 = Conv2D(ch, ch, 3, stride=stride, padding=1,
+                            bias_attr=False)
+        self.bn2 = BatchNorm2D(ch)
+        self.conv3 = Conv2D(ch, ch * 4, 1, bias_attr=False)
+        self.bn3 = BatchNorm2D(ch * 4)
+        self.down = None
+        if stride != 1 or in_ch != ch * 4:
+            self.down = Sequential(
+                Conv2D(in_ch, ch * 4, 1, stride=stride, bias_attr=False),
+                BatchNorm2D(ch * 4))
+
+    def forward(self, x):
+        identity = x if self.down is None else self.down(x)
+        out = F.relu(self.bn1(self.conv1(x)))
+        out = F.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        return F.relu(out + identity)
+
+
+_CONFIGS = {
+    18: (BasicBlock, (2, 2, 2, 2)),
+    34: (BasicBlock, (3, 4, 6, 3)),
+    50: (BottleneckBlock, (3, 4, 6, 3)),
+    101: (BottleneckBlock, (3, 4, 23, 3)),
+    152: (BottleneckBlock, (3, 8, 36, 3)),
+}
+
+
+class ResNet(Layer):
+    def __init__(self, depth: int = 50, num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        block, layers = _CONFIGS[depth]
+        self.conv1 = Conv2D(3, 64, 7, stride=2, padding=3, bias_attr=False)
+        self.bn1 = BatchNorm2D(64)
+        self.maxpool = MaxPool2D(3, stride=2, padding=1)
+        ch = 64
+        stages = []
+        for i, (n, width) in enumerate(zip(layers, (64, 128, 256, 512))):
+            blocks = []
+            for j in range(n):
+                stride = 2 if (i > 0 and j == 0) else 1
+                blocks.append(block(ch, width, stride))
+                ch = width * block.expansion
+            stages.append(Sequential(*blocks))
+        self.layer1, self.layer2, self.layer3, self.layer4 = stages
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.fc = Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(F.relu(self.bn1(self.conv1(x))))
+        x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.reshape(x.shape[0], -1))
+        return x
+
+
+def resnet18(num_classes=1000, **kw):
+    return ResNet(18, num_classes=num_classes, **kw)
+
+
+def resnet34(num_classes=1000, **kw):
+    return ResNet(34, num_classes=num_classes, **kw)
+
+
+def resnet50(num_classes=1000, **kw):
+    return ResNet(50, num_classes=num_classes, **kw)
+
+
+class LeNet(Layer):
+    def __init__(self, num_classes: int = 10):
+        super().__init__()
+        self.features = Sequential(
+            Conv2D(1, 6, 3, stride=1, padding=1), ReLU(),
+            MaxPool2D(2, stride=2),
+            Conv2D(6, 16, 5, stride=1), ReLU(),
+            MaxPool2D(2, stride=2))
+        self.fc = Sequential(
+            Linear(400, 120), Linear(120, 84), Linear(84, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        return self.fc(x.reshape(x.shape[0], -1))

@@ -1,0 +1,140 @@
+"""Vision transforms (reference: python/paddle/vision/transforms/).
+
+NumPy/host-side, HWC uint8/float input (what a DataLoader worker sees),
+matching the reference's functional semantics for the common subset.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Compose", "Resize", "CenterCrop", "RandomCrop",
+           "RandomHorizontalFlip", "Normalize", "ToTensor", "Transpose"]
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+def _resize_np(img: np.ndarray, size) -> np.ndarray:
+    """Bilinear resize without external deps (HWC)."""
+    h, w = img.shape[:2]
+    if isinstance(size, int):
+        # reference semantics: shorter edge → size, keep aspect
+        if h < w:
+            oh, ow = size, max(1, int(round(w * size / h)))
+        else:
+            oh, ow = max(1, int(round(h * size / w))), size
+    else:
+        oh, ow = size
+    ys = (np.arange(oh) + 0.5) * h / oh - 0.5
+    xs = (np.arange(ow) + 0.5) * w / ow - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :, None]
+    img_f = img.astype(np.float32)
+    if img_f.ndim == 2:
+        img_f = img_f[:, :, None]
+        squeeze = True
+    else:
+        squeeze = False
+    out = ((img_f[y0][:, x0] * (1 - wy) * (1 - wx))
+           + (img_f[y0][:, x1] * (1 - wy) * wx)
+           + (img_f[y1][:, x0] * wy * (1 - wx))
+           + (img_f[y1][:, x1] * wy * wx))
+    if squeeze:
+        out = out[:, :, 0]
+    return out.astype(img.dtype) if img.dtype == np.uint8 else out
+
+
+class Resize:
+    def __init__(self, size):
+        self.size = size
+
+    def __call__(self, img):
+        return _resize_np(np.asarray(img), self.size)
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        h, w = img.shape[:2]
+        th, tw = self.size
+        i = max(0, (h - th) // 2)
+        j = max(0, (w - tw) // 2)
+        return img[i:i + th, j:j + tw]
+
+
+class RandomCrop:
+    def __init__(self, size, rng: Optional[np.random.Generator] = None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.rng = rng or np.random.default_rng()
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        h, w = img.shape[:2]
+        th, tw = self.size
+        i = int(self.rng.integers(0, max(1, h - th + 1)))
+        j = int(self.rng.integers(0, max(1, w - tw + 1)))
+        return img[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob: float = 0.5,
+                 rng: Optional[np.random.Generator] = None):
+        self.prob = prob
+        self.rng = rng or np.random.default_rng()
+
+    def __call__(self, img):
+        if self.rng.random() < self.prob:
+            return np.asarray(img)[:, ::-1].copy()
+        return np.asarray(img)
+
+
+class Normalize:
+    """(x - mean) / std per channel. data_format CHW (post-ToTensor) or HWC."""
+
+    def __init__(self, mean, std, data_format="CHW"):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        img = np.asarray(img, np.float32)
+        shape = (-1, 1, 1) if self.data_format == "CHW" else (1, 1, -1)
+        return (img - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+
+class ToTensor:
+    """HWC uint8 [0,255] → CHW float32 [0,1]."""
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        out = img.transpose(2, 0, 1).astype(np.float32)
+        if np.asarray(img).dtype == np.uint8:
+            out = out / 255.0
+        return out
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = tuple(order)
+
+    def __call__(self, img):
+        return np.asarray(img).transpose(self.order)
