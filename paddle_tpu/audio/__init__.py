@@ -34,6 +34,8 @@ def stft(x, n_fft=512, hop_length=None, win_length=None, window="hann",
     """x: (..., T) → complex (..., n_fft//2+1, frames)."""
     hop = hop_length or n_fft // 4
     wl = win_length or n_fft
+    if wl > n_fft:
+        raise ValueError(f"win_length ({wl}) must be <= n_fft ({n_fft})")
     win = get_window(window, wl)
     if wl < n_fft:
         pad = (n_fft - wl) // 2

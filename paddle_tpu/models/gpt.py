@@ -1,0 +1,256 @@
+"""GPT / ERNIE-style decoder family (BASELINE.json configs[1]: 13B TP+PP).
+
+Reference capability: PaddleNLP's GPT-3 / ERNIE models trained with fleet
+hybrid parallel on the reference core (SURVEY §0 scope note; fleet layers
+§2.5). Differences from the Llama family that make this a distinct
+architecture (matching the GPT/ERNIE lineage): learned absolute position
+embeddings (no RoPE), full multi-head attention (no GQA), LayerNorm (not
+RMSNorm) with biases, GELU 4h FFN, optional embedding dropout.
+
+TPU-first: same mesh-axis design as llama.py — ColumnParallel/RowParallel
+("mp"), Megatron-SP, pipeline stages via StackedPipelineStages ("pp"),
+recompute, vocab-parallel CE — all inside one jit program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer, ParamAttr
+from ..nn.layers_common import Dropout, Embedding, LayerList, LayerNorm
+from ..distributed.mp_layers import (ColumnParallelLinear,
+                                     ParallelCrossEntropy,
+                                     RowParallelLinear,
+                                     VocabParallelEmbedding, constrain)
+from ..distributed.recompute import RecomputeWrapper
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 1024
+    intermediate_size: Optional[int] = None      # default 4h
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    max_position_embeddings: int = 1024
+    layer_norm_eps: float = 1e-5
+    hidden_dropout: float = 0.0
+    attention_dropout: float = 0.0
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = True
+    use_recompute: bool = False
+    recompute_policy: Optional[str] = None
+    sequence_parallel: bool = False
+    pipeline_stages: int = 1
+    num_microbatches: Optional[int] = None
+    virtual_pp_degree: int = 1
+    dtype: str = "float32"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def ffn_size(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+
+PRESETS = {
+    # GPT-3 ladder (PaddleNLP gpt3 configs)
+    "gpt2-345m": GPTConfig(),
+    "gpt3-1.3b": GPTConfig(hidden_size=2048, num_hidden_layers=24,
+                           num_attention_heads=32,
+                           max_position_embeddings=2048),
+    "gpt3-6.7b": GPTConfig(hidden_size=4096, num_hidden_layers=32,
+                           num_attention_heads=32,
+                           max_position_embeddings=2048),
+    # BASELINE configs[1]: 13B decoder for TP+PP
+    "gpt3-13b": GPTConfig(hidden_size=5120, num_hidden_layers=40,
+                          num_attention_heads=40,
+                          max_position_embeddings=2048),
+    # ERNIE-style base (ernie-3.0 dense decoder shape)
+    "ernie-base": GPTConfig(vocab_size=40000, hidden_size=768,
+                            num_hidden_layers=12, num_attention_heads=12,
+                            max_position_embeddings=2048),
+    "tiny": GPTConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, max_position_embeddings=128),
+}
+
+
+def _attr(cfg: GPTConfig) -> ParamAttr:
+    return ParamAttr(initializer=I.Normal(0.0, cfg.initializer_range))
+
+
+class GPTAttention(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        h = cfg.hidden_size
+        sp = cfg.sequence_parallel
+        self.qkv_proj = ColumnParallelLinear(h, 3 * h, has_bias=True,
+                                             weight_attr=_attr(cfg),
+                                             sequence_parallel=sp)
+        self.out_proj = RowParallelLinear(h, h, has_bias=True,
+                                          weight_attr=_attr(cfg),
+                                          sequence_parallel=sp)
+        self.dropout = Dropout(cfg.hidden_dropout)
+
+    def forward(self, x, attn_mask=None):
+        cfg = self.cfg
+        b, s = x.shape[:2]
+        qkv = self.qkv_proj(x).reshape(b, s, 3, cfg.num_attention_heads,
+                                       cfg.head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q = constrain(q, ("dp", "sharding"), None, "mp", None)
+        k = constrain(k, ("dp", "sharding"), None, "mp", None)
+        v = constrain(v, ("dp", "sharding"), None, "mp", None)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None,
+            dropout_p=cfg.attention_dropout, training=self.training)
+        out = out.reshape(b, s, cfg.hidden_size)
+        return self.dropout(self.out_proj(out))
+
+
+class GPTMLP(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        sp = cfg.sequence_parallel
+        self.fc_in = ColumnParallelLinear(cfg.hidden_size, cfg.ffn_size,
+                                          has_bias=True,
+                                          weight_attr=_attr(cfg),
+                                          sequence_parallel=sp)
+        self.fc_out = RowParallelLinear(cfg.ffn_size, cfg.hidden_size,
+                                        has_bias=True,
+                                        weight_attr=_attr(cfg),
+                                        sequence_parallel=sp)
+        self.dropout = Dropout(cfg.hidden_dropout)
+
+    def forward(self, x):
+        return self.dropout(self.fc_out(F.gelu(self.fc_in(x))))
+
+
+class GPTDecoderLayer(Layer):
+    returns_aux = False
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln_1 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.attn = GPTAttention(cfg)
+        self.ln_2 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.mlp = GPTMLP(cfg)
+
+    def forward(self, x, attn_mask=None):
+        x = x + self.attn(self.ln_1(x), attn_mask)
+        x = x + self.mlp(self.ln_2(x))
+        return x
+
+
+class GPTModel(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embed_tokens = VocabParallelEmbedding(cfg.vocab_size,
+                                                   cfg.hidden_size)
+        # position table is small → replicated plain embedding (the token
+        # table is the one worth vocab-sharding)
+        self.embed_positions = Embedding(cfg.max_position_embeddings,
+                                         cfg.hidden_size)
+        self.embed_dropout = Dropout(cfg.hidden_dropout)
+        if cfg.pipeline_stages > 1:
+            from ..distributed.pipeline import StackedPipelineStages
+            self.h = StackedPipelineStages(
+                lambda: GPTDecoderLayer(cfg), cfg.num_hidden_layers,
+                num_stages=cfg.pipeline_stages,
+                num_microbatches=cfg.num_microbatches,
+                num_virtual_pipeline_stages=cfg.virtual_pp_degree,
+                use_recompute=cfg.use_recompute,
+                recompute_policy=cfg.recompute_policy,
+                extra_is_batched=(True,),
+                has_aux=False)
+        else:
+            layers = []
+            for _ in range(cfg.num_hidden_layers):
+                layer = GPTDecoderLayer(cfg)
+                if cfg.use_recompute:
+                    layer = RecomputeWrapper(layer,
+                                             policy=cfg.recompute_policy)
+                layers.append(layer)
+            self.h = LayerList(layers)
+        self.ln_f = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+
+    def forward(self, input_ids, attn_mask=None, position_ids=None):
+        cfg = self.cfg
+        if input_ids.shape[1] > cfg.max_position_embeddings:
+            # learned absolute positions: jax's OOB gather would silently
+            # clamp every index past the table to its last row
+            raise ValueError(
+                f"sequence length {input_ids.shape[1]} exceeds "
+                f"max_position_embeddings={cfg.max_position_embeddings}")
+        if position_ids is None:
+            position_ids = jnp.arange(input_ids.shape[1])[None, :]
+        x = (self.embed_tokens(input_ids)
+             + self.embed_positions(position_ids))
+        x = self.embed_dropout(x)
+        if cfg.pipeline_stages > 1:
+            x = self.h(x, attn_mask)
+        else:
+            for layer in self.h:
+                x = layer(x, attn_mask)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.model = GPTModel(cfg)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = ColumnParallelLinear(cfg.hidden_size,
+                                                cfg.vocab_size,
+                                                has_bias=False,
+                                                weight_attr=_attr(cfg))
+        self.loss_fn = ParallelCrossEntropy(ignore_index=-100)
+
+    def logits(self, hidden):
+        if self.cfg.tie_word_embeddings:
+            w = self.model.embed_tokens.weight
+            logits = hidden @ w.T
+            return constrain(logits, ("dp", "sharding"), None, "mp")
+        return self.lm_head(hidden)
+
+    def forward(self, input_ids, labels=None, attn_mask=None,
+                position_ids=None):
+        hidden = self.model(input_ids, attn_mask, position_ids)
+        logits = self.logits(hidden)
+        if labels is None:
+            return logits
+        loss = self.loss_fn(logits.astype(jnp.float32), labels)
+        valid = (labels != -100)
+        return jnp.sum(loss * valid) / jnp.maximum(jnp.sum(valid), 1)
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0):
+        ids = input_ids
+        for _ in range(max_new_tokens):
+            logits = self(ids)[:, -1]
+            if temperature > 0:
+                from ..core import random as prandom
+                nxt = jax.random.categorical(prandom.next_key("gen"),
+                                             logits / temperature, axis=-1)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+        return ids
+
+
+def gpt(name_or_config="tiny", **overrides) -> GPTForCausalLM:
+    cfg = (PRESETS[name_or_config] if isinstance(name_or_config, str)
+           else name_or_config)
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    return GPTForCausalLM(cfg)

@@ -29,11 +29,19 @@ def _ste_round(x):
     return x + jax.lax.stop_gradient(jnp.round(x) - x)
 
 
+def _int_dtype(bits: int):
+    if bits <= 8:
+        return jnp.int8
+    if bits <= 16:
+        return jnp.int16
+    return jnp.int32
+
+
 def quantize_absmax(x, bits: int = 8, axis=None):
     qmax = 2.0 ** (bits - 1) - 1
     scale = jnp.max(jnp.abs(x), axis=axis, keepdims=axis is not None)
     scale = jnp.maximum(scale, 1e-8) / qmax
-    q = jnp.clip(jnp.round(x / scale), -qmax - 1, qmax).astype(jnp.int8)
+    q = jnp.clip(jnp.round(x / scale), -qmax - 1, qmax).astype(_int_dtype(bits))
     return q, scale
 
 
@@ -58,7 +66,11 @@ class FakeQuanterWithAbsMax(Layer):
 
 
 class AbsmaxObserver(Layer):
-    """PTQ observer: tracks running max |x| to derive scales offline."""
+    """PTQ observer: tracks running max |x| to derive scales offline.
+
+    Calibration is a HOST-side pass (eager forwards over calibration data);
+    running it under jax.jit would leak a tracer into the buffer, so that
+    is rejected explicitly."""
 
     def __init__(self, bits: int = 8):
         super().__init__()
@@ -66,6 +78,11 @@ class AbsmaxObserver(Layer):
         self.register_buffer("absmax", jnp.zeros(()), persistable=True)
 
     def forward(self, x):
+        import jax.core
+        if isinstance(x, jax.core.Tracer):
+            raise RuntimeError(
+                "AbsmaxObserver calibration must run eagerly (outside "
+                "jax.jit) — the running absmax is host state")
         self.absmax = jnp.maximum(self.absmax, jnp.max(jnp.abs(x)))
         return x
 
@@ -82,9 +99,15 @@ class QuantConfig:
     activation_bits: int = 8
     quantize_activations: bool = False
     layer_types: tuple = (Linear, Conv2D)
+    type_bits: Dict[type, int] = dataclasses.field(default_factory=dict)
 
     def add_type_config(self, layer_type, weight_bits=None):
         self.layer_types = (*self.layer_types, layer_type)
+        if weight_bits is not None:
+            self.type_bits[layer_type] = weight_bits
+
+    def bits_for(self, layer) -> int:
+        return self.type_bits.get(type(layer), self.weight_bits)
 
 
 class _QuantWrapper(Layer):
@@ -93,7 +116,8 @@ class _QuantWrapper(Layer):
     def __init__(self, inner: Layer, config: QuantConfig):
         super().__init__()
         self.inner = inner
-        self.wq = FakeQuanterWithAbsMax(config.weight_bits)
+        self.weight_bits = config.bits_for(inner)
+        self.wq = FakeQuanterWithAbsMax(self.weight_bits)
         self.aq = (FakeQuanterWithAbsMax(config.activation_bits)
                    if config.quantize_activations else None)
 
@@ -124,12 +148,14 @@ class QAT:
     def _rewrite(self, layer: Layer):
         for name, sub in list(layer._sub_layers.items()):
             if isinstance(sub, self.config.layer_types):
-                layer._sub_layers[name] = _QuantWrapper(sub, self.config)
+                # setattr (NOT a raw _sub_layers write) so the owner's
+                # instance attribute used by its forward() is replaced too
+                setattr(layer, name, _QuantWrapper(sub, self.config))
             else:
                 self._rewrite(sub)
 
     def convert(self, model: Layer, inplace: bool = True) -> Layer:
-        """Materialize int8 weights + scales for inference export."""
+        """Materialize integer weights + scales for inference export."""
         if not inplace:
             import copy
             model = copy.deepcopy(model)
@@ -138,11 +164,14 @@ class QAT:
             for name, sub in list(layer._sub_layers.items()):
                 if isinstance(sub, _QuantWrapper):
                     q, scale = quantize_absmax(sub.inner.weight,
-                                               self.config.weight_bits)
+                                               sub.weight_bits)
                     sub.inner.weight = dequantize(q, scale)
                     sub.inner.register_buffer("weight_scale", scale)
                     sub.inner.register_buffer("weight_int8", q)
-                    layer._sub_layers[name] = sub.inner
+                    if getattr(sub, "observer", None) is not None:
+                        sub.inner.register_buffer("act_scale",
+                                                  sub.observer.scale())
+                    setattr(layer, name, sub.inner)
                 else:
                     conv(sub)
 
@@ -150,14 +179,41 @@ class QAT:
         return model
 
 
+class _ObserverWrapper(_QuantWrapper):
+    """PTQ wrapper: TRANSPARENT forward (no fake-quant perturbation) with an
+    input-activation observer — post-training calibration semantics."""
+
+    def __init__(self, inner: Layer, config: QuantConfig):
+        super().__init__(inner, config)
+        self.aq = None
+        self.observer = AbsmaxObserver(config.activation_bits)
+
+    def forward(self, x):
+        self.observer(x)
+        return self.inner(x)
+
+
 class PTQ:
-    """Post-training quantization: observe activations, then convert."""
+    """Post-training quantization: observe activations eagerly over
+    calibration data, then ``convert`` (weights absmax-quantized, observed
+    activation scales attached as ``act_scale`` buffers)."""
 
     def __init__(self, config: Optional[QuantConfig] = None):
         self.config = config or QuantConfig()
 
     def quantize(self, model: Layer, inplace: bool = True) -> Layer:
-        qat = QAT(self.config)
-        return qat.quantize(model, inplace=inplace)
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        self._rewrite(model)
+        return model
 
-    convert = QAT.convert
+    def _rewrite(self, layer: Layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, self.config.layer_types):
+                setattr(layer, name, _ObserverWrapper(sub, self.config))
+            else:
+                self._rewrite(sub)
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        return QAT.convert(self, model, inplace=inplace)

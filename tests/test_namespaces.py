@@ -184,6 +184,60 @@ class TestQuantization:
         assert hasattr(lin, "weight_int8") and lin.weight_int8.dtype == jnp.int8
 
 
+class TestQuantFixes:
+    def test_qat_wraps_attribute_access_models(self):
+        """The wrapper must be visible through self.fc, not just
+        _sub_layers — models call sublayers by attribute."""
+        import jax.numpy as jnp
+        from paddle_tpu import nn
+        from paddle_tpu.quantization import QAT, _QuantWrapper
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        pt.seed(0)
+        m = QAT().quantize(M())
+        assert isinstance(m.fc, _QuantWrapper)
+        x = jnp.asarray(np.random.randn(2, 4).astype("float32"))
+        out_model = np.asarray(m(x))
+        out_wrapper = np.asarray(m._sub_layers["fc"](x))
+        np.testing.assert_allclose(out_model, out_wrapper, rtol=1e-6)
+
+    def test_quantize_absmax_wide_bits(self):
+        from paddle_tpu.quantization import quantize_absmax, dequantize
+        import jax.numpy as jnp
+        x = np.random.randn(64).astype("float32") * 10
+        q, s = quantize_absmax(jnp.asarray(x), bits=16)
+        assert q.dtype == jnp.int16
+        np.testing.assert_allclose(np.asarray(dequantize(q, s)), x,
+                                   atol=np.abs(x).max() / 30000)
+
+    def test_ptq_observes_then_converts(self):
+        import jax.numpy as jnp
+        from paddle_tpu import nn
+        from paddle_tpu.quantization import PTQ
+
+        pt.seed(0)
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        ptq = PTQ()
+        m = ptq.quantize(m)
+        x = jnp.asarray(np.random.randn(16, 4).astype("float32") * 3)
+        before = np.asarray(m(x))  # observation pass is TRANSPARENT
+        ref = np.asarray(m(x))
+        np.testing.assert_allclose(before, ref, rtol=1e-6)
+        ptq.convert(m)
+        lin = m[0]
+        assert hasattr(lin, "act_scale") and float(lin.act_scale) > 0
+        assert hasattr(lin, "weight_int8")
+        after = np.asarray(m(x))
+        np.testing.assert_allclose(after, before, atol=0.1)  # 8-bit weights
+
+
 class TestVision:
     def test_transforms_pipeline(self):
         from paddle_tpu.vision import transforms as T
