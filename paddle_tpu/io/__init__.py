@@ -201,7 +201,16 @@ class RandomSampler(Sampler):
 
     def __iter__(self):
         n = len(self.data_source)
-        rng = self.generator or np.random.default_rng()
+        if self.generator is not None:
+            rng = self.generator
+        else:
+            # deterministic under paddle_tpu.seed (reference: paddle seeds
+            # the shuffle from the global generator); each epoch advances
+            # the eager stream so permutations differ across epochs
+            from ..core import random as prandom
+            seed_val = int(jax.random.randint(
+                prandom.next_key("dataloader_shuffle"), (), 0, 2**31 - 1))
+            rng = np.random.default_rng(seed_val)
         if self.replacement:
             yield from rng.integers(0, n, size=self.num_samples).tolist()
         else:

@@ -160,7 +160,8 @@ class GPTModel(Layer):
         # position table is small → replicated plain embedding (the token
         # table is the one worth vocab-sharding)
         self.embed_positions = Embedding(cfg.max_position_embeddings,
-                                         cfg.hidden_size)
+                                         cfg.hidden_size,
+                                         weight_attr=_attr(cfg))
         self.embed_dropout = Dropout(cfg.hidden_dropout)
         if cfg.pipeline_stages > 1:
             from ..distributed.pipeline import StackedPipelineStages

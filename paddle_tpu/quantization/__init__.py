@@ -107,7 +107,12 @@ class QuantConfig:
             self.type_bits[layer_type] = weight_bits
 
     def bits_for(self, layer) -> int:
-        return self.type_bits.get(type(layer), self.weight_bits)
+        # isinstance semantics, matching the wrapping check in _rewrite —
+        # a subclass of a configured type gets that type's bit width
+        for t, bits in self.type_bits.items():
+            if isinstance(layer, t):
+                return bits
+        return self.weight_bits
 
 
 class _QuantWrapper(Layer):
@@ -135,6 +140,8 @@ class _QuantWrapper(Layer):
 class QAT:
     """Quantization-aware training driver: model → fake-quantized model."""
 
+    wrapper_cls = _QuantWrapper
+
     def __init__(self, config: Optional[QuantConfig] = None):
         self.config = config or QuantConfig()
 
@@ -150,7 +157,7 @@ class QAT:
             if isinstance(sub, self.config.layer_types):
                 # setattr (NOT a raw _sub_layers write) so the owner's
                 # instance attribute used by its forward() is replaced too
-                setattr(layer, name, _QuantWrapper(sub, self.config))
+                setattr(layer, name, type(self).wrapper_cls(sub, self.config))
             else:
                 self._rewrite(sub)
 
@@ -167,7 +174,8 @@ class QAT:
                                                sub.weight_bits)
                     sub.inner.weight = dequantize(q, scale)
                     sub.inner.register_buffer("weight_scale", scale)
-                    sub.inner.register_buffer("weight_int8", q)
+                    # named by role, not dtype: int16/int32 for wide bits
+                    sub.inner.register_buffer("weight_quant", q)
                     if getattr(sub, "observer", None) is not None:
                         sub.inner.register_buffer("act_scale",
                                                   sub.observer.scale())
@@ -193,27 +201,10 @@ class _ObserverWrapper(_QuantWrapper):
         return self.inner(x)
 
 
-class PTQ:
+class PTQ(QAT):
     """Post-training quantization: observe activations eagerly over
     calibration data, then ``convert`` (weights absmax-quantized, observed
-    activation scales attached as ``act_scale`` buffers)."""
+    activation scales attached as ``act_scale`` buffers). Same driver as
+    QAT with a transparent observer wrapper instead of fake-quant."""
 
-    def __init__(self, config: Optional[QuantConfig] = None):
-        self.config = config or QuantConfig()
-
-    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
-        if not inplace:
-            import copy
-            model = copy.deepcopy(model)
-        self._rewrite(model)
-        return model
-
-    def _rewrite(self, layer: Layer):
-        for name, sub in list(layer._sub_layers.items()):
-            if isinstance(sub, self.config.layer_types):
-                setattr(layer, name, _ObserverWrapper(sub, self.config))
-            else:
-                self._rewrite(sub)
-
-    def convert(self, model: Layer, inplace: bool = True) -> Layer:
-        return QAT.convert(self, model, inplace=inplace)
+    wrapper_cls = _ObserverWrapper

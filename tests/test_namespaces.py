@@ -181,7 +181,7 @@ class TestQuantization:
             model._assign_by_path(k, v)
         qat.convert(model)
         lin = model[0]
-        assert hasattr(lin, "weight_int8") and lin.weight_int8.dtype == jnp.int8
+        assert hasattr(lin, "weight_quant") and lin.weight_quant.dtype == jnp.int8
 
 
 class TestQuantFixes:
@@ -233,7 +233,7 @@ class TestQuantFixes:
         ptq.convert(m)
         lin = m[0]
         assert hasattr(lin, "act_scale") and float(lin.act_scale) > 0
-        assert hasattr(lin, "weight_int8")
+        assert hasattr(lin, "weight_quant")
         after = np.asarray(m(x))
         np.testing.assert_allclose(after, before, atol=0.1)  # 8-bit weights
 
