@@ -38,6 +38,7 @@ from . import cp  # noqa: F401
 from .cp import (ring_attention, ulysses_attention,  # noqa: F401
                  context_parallel_attention)
 from .spawn import spawn  # noqa: F401
+from . import rpc  # noqa: F401
 
 
 def get_hybrid_communicate_group():

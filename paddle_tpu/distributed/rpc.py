@@ -1,0 +1,178 @@
+"""``paddle.distributed.rpc`` parity: minimal point-to-point RPC.
+
+Reference: python/paddle/distributed/rpc/ (init_rpc, rpc_sync, rpc_async,
+get_worker_info, shutdown) over brpc (SURVEY §2.7).
+
+TPU redesign: brpc is replaced by a small threaded TCP server per worker
+(same length-prefixed wire helpers as the rendezvous store) with pickled
+callables — RPC here is control-plane only (dataset coordination, eval
+dispatch); tensor traffic belongs on ICI collectives, not RPC, exactly as
+in the reference's intended usage. Worker discovery rides the TCPStore.
+
+Trust model (same as the reference): pickle over sockets is only safe
+among the mutually-trusting hosts of one training job.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import pickle
+import socket
+import socketserver
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from ..launch.store import TCPStore, _pack, _unpack, free_port
+
+_state = threading.local()
+
+
+@dataclass
+class WorkerInfo:
+    name: str
+    rank: int
+    endpoint: str
+
+
+class _RpcState:
+    def __init__(self):
+        self.name: Optional[str] = None
+        self.rank = -1
+        self.world_size = 0
+        self.store: Optional[TCPStore] = None
+        self.server: Optional[socketserver.ThreadingTCPServer] = None
+        self.workers: Dict[str, WorkerInfo] = {}
+        self.pool = cf.ThreadPoolExecutor(max_workers=8,
+                                          thread_name_prefix="pdtpu-rpc")
+        self.conn_lock = threading.Lock()
+        self.conns: Dict[str, socket.socket] = {}
+        self.send_locks: Dict[str, threading.Lock] = {}
+
+
+_global = _RpcState()
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            while True:
+                fields = _unpack(self.request)
+                try:
+                    fn, args, kwargs = pickle.loads(fields[0])
+                    result = (True, fn(*args, **kwargs))
+                except Exception as e:  # noqa: BLE001 — relay to caller
+                    result = (False, e)
+                self.request.sendall(_pack(pickle.dumps(result)))
+        except (ConnectionError, OSError, EOFError):
+            return
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None) -> None:
+    """Start this worker's RPC server and discover all peers by name."""
+    import os
+    g = _global
+    if g.server is not None:
+        raise RuntimeError("rpc already initialized")
+    rank = rank if rank is not None else int(
+        os.environ.get("PADDLE_TRAINER_ID", 0))
+    world_size = world_size if world_size is not None else int(
+        os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER") or f"127.0.0.1:{free_port()}"
+    g.name, g.rank, g.world_size = name, rank, world_size
+    g.store = TCPStore(master_endpoint, is_master=(rank == 0))
+
+    srv = socketserver.ThreadingTCPServer(("0.0.0.0", 0), _Handler)
+    srv.daemon_threads = True
+    g.server = srv
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True,
+                     name="pdtpu-rpc-server").start()
+
+    host = socket.gethostname()
+    try:
+        ip = socket.gethostbyname(host)
+    except OSError:
+        ip = "127.0.0.1"
+    g.store.set(f"rpc/worker/{rank}",
+                pickle.dumps(WorkerInfo(name, rank, f"{ip}:{port}")))
+    for r in range(world_size):
+        info: WorkerInfo = pickle.loads(g.store.wait(f"rpc/worker/{r}"))
+        g.workers[info.name] = info
+
+
+def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
+    g = _global
+    if g.server is None:
+        raise RuntimeError("call init_rpc first")
+    return g.workers[name or g.name]
+
+
+def get_all_worker_infos():
+    return sorted(_global.workers.values(), key=lambda w: w.rank)
+
+
+def _conn_to(name: str) -> socket.socket:
+    g = _global
+    with g.conn_lock:
+        s = g.conns.get(name)
+        if s is None:
+            info = g.workers[name]
+            host, port = info.endpoint.rsplit(":", 1)
+            s = socket.create_connection((host, int(port)), timeout=60)
+            g.conns[name] = s
+        return s
+
+
+def rpc_sync(to: str, fn, args=(), kwargs=None, timeout: float = 60.0) -> Any:
+    """Run fn(*args, **kwargs) on worker `to`, return its result."""
+    g = _global
+    if g.server is None:
+        raise RuntimeError("call init_rpc first")
+    payload = pickle.dumps((fn, tuple(args), dict(kwargs or {})))
+    s = _conn_to(to)
+    # one in-flight request per connection: serialize senders
+    with g.conn_lock:
+        lock = g.send_locks.setdefault(to, threading.Lock())
+    with lock:
+        s.settimeout(timeout)
+        s.sendall(_pack(payload))
+        fields = _unpack(s)
+    ok, result = pickle.loads(fields[0])
+    if not ok:
+        raise result
+    return result
+
+
+def rpc_async(to: str, fn, args=(), kwargs=None, timeout: float = 60.0):
+    """Like rpc_sync but returns a Future (``.wait()`` paddle alias)."""
+    fut = _global.pool.submit(rpc_sync, to, fn, args, kwargs, timeout)
+    fut.wait = fut.result  # paddle Future API: fut.wait()
+    return fut
+
+
+def shutdown(graceful: bool = True) -> None:
+    g = _global
+    if g.server is None:
+        return
+    if graceful and g.store is not None:
+        g.store.barrier("rpc/shutdown", g.world_size, timeout=60.0)
+    with g.conn_lock:
+        for s in g.conns.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        g.conns.clear()
+        g.send_locks.clear()
+    g.server.shutdown()
+    g.server.server_close()
+    g.server = None
+    if g.store is not None:
+        g.store.close()
+        g.store = None
+    g.workers.clear()
+    g.name = None

@@ -107,11 +107,11 @@ class QuantConfig:
             self.type_bits[layer_type] = weight_bits
 
     def bits_for(self, layer) -> int:
-        # isinstance semantics, matching the wrapping check in _rewrite —
-        # a subclass of a configured type gets that type's bit width
-        for t, bits in self.type_bits.items():
-            if isinstance(layer, t):
-                return bits
+        # most-specific match wins: walk the MRO so a subclass's own config
+        # beats its base class's, regardless of insertion order
+        for t in type(layer).__mro__:
+            if t in self.type_bits:
+                return self.type_bits[t]
         return self.weight_bits
 
 
