@@ -40,6 +40,18 @@ from .cp import (ring_attention, ulysses_attention,  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from . import rpc  # noqa: F401
 
+# paddle.distributed.save_state_dict / load_state_dict parity (reference:
+# python/paddle/distributed/checkpoint/) — implemented in paddle_tpu.ckpt
+# with cross-topology reshard-on-load
+from ..ckpt import load_state_dict, save_state_dict  # noqa: F401
+
+
+def __getattr__(name):
+    if name == "checkpoint":  # paddle.distributed.checkpoint module alias
+        from .. import ckpt
+        return ckpt
+    raise AttributeError(f"module 'paddle_tpu.distributed' has no attribute {name!r}")
+
 
 def get_hybrid_communicate_group():
     return fleet.get_hybrid_communicate_group()

@@ -25,8 +25,6 @@ from typing import Any, Dict, Optional
 
 from ..launch.store import TCPStore, _pack, _unpack, free_port
 
-_state = threading.local()
-
 
 @dataclass
 class WorkerInfo:
@@ -63,7 +61,15 @@ class _Handler(socketserver.BaseRequestHandler):
                     result = (True, fn(*args, **kwargs))
                 except Exception as e:  # noqa: BLE001 — relay to caller
                     result = (False, e)
-                self.request.sendall(_pack(pickle.dumps(result)))
+                try:
+                    payload = pickle.dumps(result)
+                except Exception as e:  # unpicklable result/exception:
+                    # still answer (with a picklable error) so the caller
+                    # gets a real message instead of a dead connection
+                    payload = pickle.dumps(
+                        (False, RuntimeError(
+                            f"rpc result not picklable: {e!r}")))
+                self.request.sendall(_pack(payload))
         except (ConnectionError, OSError, EOFError):
             return
 
@@ -92,16 +98,36 @@ def init_rpc(name: str, rank: Optional[int] = None,
     threading.Thread(target=srv.serve_forever, daemon=True,
                      name="pdtpu-rpc-server").start()
 
-    host = socket.gethostname()
-    try:
-        ip = socket.gethostbyname(host)
-    except OSError:
-        ip = "127.0.0.1"
+    ip = _routable_ip()
     g.store.set(f"rpc/worker/{rank}",
                 pickle.dumps(WorkerInfo(name, rank, f"{ip}:{port}")))
     for r in range(world_size):
-        info: WorkerInfo = pickle.loads(g.store.wait(f"rpc/worker/{r}"))
+        try:
+            raw = g.store.wait(f"rpc/worker/{r}", timeout=300.0)
+        except TimeoutError:
+            raise TimeoutError(
+                f"init_rpc: worker rank {r} never registered (crashed "
+                f"during startup, or wrong master_endpoint?)")
+        info: WorkerInfo = pickle.loads(raw)
         g.workers[info.name] = info
+
+
+def _routable_ip() -> str:
+    """Advertise an address peers can actually reach: gethostbyname often
+    yields 127.0.1.1 on Debian-style /etc/hosts, so prefer the interface a
+    routed UDP socket binds to."""
+    try:
+        ip = socket.gethostbyname(socket.gethostname())
+    except OSError:
+        ip = "127.0.0.1"
+    if ip.startswith("127."):
+        try:
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                s.connect(("10.255.255.255", 1))  # no packets sent
+                ip = s.getsockname()[0]
+        except OSError:
+            pass
+    return ip
 
 
 def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
@@ -115,16 +141,45 @@ def get_all_worker_infos():
     return sorted(_global.workers.values(), key=lambda w: w.rank)
 
 
+def _send_lock(name: str) -> threading.Lock:
+    g = _global
+    with g.conn_lock:
+        return g.send_locks.setdefault(name, threading.Lock())
+
+
 def _conn_to(name: str) -> socket.socket:
+    """Cached connection to a peer. The (possibly slow) connect happens
+    under the per-destination send lock, NOT the global map lock, so a slow
+    peer doesn't stall RPC traffic to every other destination."""
     g = _global
     with g.conn_lock:
         s = g.conns.get(name)
-        if s is None:
-            info = g.workers[name]
-            host, port = info.endpoint.rsplit(":", 1)
-            s = socket.create_connection((host, int(port)), timeout=60)
-            g.conns[name] = s
-        return s
+    if s is None:
+        info = g.workers[name]
+        host, port = info.endpoint.rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=60)
+        with g.conn_lock:
+            existing = g.conns.get(name)
+            if existing is not None:   # raced: keep the first, drop ours
+                s.close()
+                s = existing
+            else:
+                g.conns[name] = s
+    return s
+
+
+def _evict_conn(name: str) -> None:
+    """Drop a desynced/broken connection so the next call reconnects —
+    a timed-out request would otherwise leave its late response in the
+    buffer to be read as the NEXT call's answer."""
+    g = _global
+    with g.conn_lock:
+        s = g.conns.pop(name, None)
+    if s is not None:
+        try:
+            s.close()
+        except OSError:
+            pass
 
 
 def rpc_sync(to: str, fn, args=(), kwargs=None, timeout: float = 60.0) -> Any:
@@ -133,14 +188,17 @@ def rpc_sync(to: str, fn, args=(), kwargs=None, timeout: float = 60.0) -> Any:
     if g.server is None:
         raise RuntimeError("call init_rpc first")
     payload = pickle.dumps((fn, tuple(args), dict(kwargs or {})))
-    s = _conn_to(to)
-    # one in-flight request per connection: serialize senders
-    with g.conn_lock:
-        lock = g.send_locks.setdefault(to, threading.Lock())
-    with lock:
-        s.settimeout(timeout)
-        s.sendall(_pack(payload))
-        fields = _unpack(s)
+    # one in-flight request per destination: serialize senders; connect
+    # under the same lock (slow peers only stall their own destination)
+    with _send_lock(to):
+        s = _conn_to(to)
+        try:
+            s.settimeout(timeout)
+            s.sendall(_pack(payload))
+            fields = _unpack(s)
+        except Exception:
+            _evict_conn(to)
+            raise
     ok, result = pickle.loads(fields[0])
     if not ok:
         raise result

@@ -123,4 +123,60 @@ def default_bias_init():
     return Constant(0.0)
 
 
-Assign = Constant  # minimal alias surface
+class Orthogonal(Initializer):
+    """Reference: paddle.nn.initializer.Orthogonal (QR of a gaussian).
+
+    QR runs on a (max, min)-shaped gaussian — O(max·min²), not the naive
+    (max, max) square which would OOM on lopsided shapes like vocab
+    embeddings."""
+
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, key, shape, dtype):
+        if len(shape) < 2:
+            raise ValueError("Orthogonal requires >=2 dims")
+        rows = shape[0]
+        cols = 1
+        for s in shape[1:]:
+            cols *= s
+        big, small = max(rows, cols), min(rows, cols)
+        a = jax.random.normal(key, (big, small), jnp.float32)
+        q, r = jnp.linalg.qr(a)          # q: (big, small), semi-orthogonal
+        q = q * jnp.sign(jnp.diagonal(r))  # unique, uniform distribution
+        if rows < cols:
+            q = q.T
+        return (self.gain * q).reshape(shape).astype(dtype)
+
+
+class Assign(Initializer):
+    """Reference: paddle.nn.initializer.Assign (constant array init)."""
+
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, key, shape, dtype):
+        v = jnp.asarray(self.value, dtype=dtype)
+        if tuple(v.shape) != tuple(shape):
+            raise ValueError(f"Assign value shape {v.shape} != {shape}")
+        return v
+
+
+class Dirac(Initializer):
+    """Reference: paddle.nn.initializer.Dirac (identity-preserving convs)."""
+
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, key, shape, dtype):
+        if len(shape) < 3:
+            raise ValueError("Dirac requires conv-shaped (>=3d) params")
+        out_c, in_c = shape[0], shape[1]
+        w = jnp.zeros(shape, dtype)
+        centers = tuple(s // 2 for s in shape[2:])
+        og = out_c // self.groups
+        for g in range(self.groups):
+            for i in range(min(og, in_c)):
+                idx = (g * og + i, i) + centers
+                w = w.at[idx].set(1.0)
+        return w

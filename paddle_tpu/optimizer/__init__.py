@@ -309,3 +309,54 @@ __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Lamb", "Adagrad",
            "RMSProp", "lr", "LRScheduler"]
 
 lr = lr_mod
+
+
+class Adadelta(Optimizer):
+    """Reference: paddle.optimizer.Adadelta (adadelta kernel)."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=0.0, grad_clip=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self.epsilon, self.rho = epsilon, rho
+
+    def _init_slots(self, params):
+        z = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return {"avg_squared_grad": jax.tree.map(z, params),
+                "avg_squared_update": jax.tree.map(z, params)}
+
+    def _update_one(self, name, p, g, lr, slots, step, wd):
+        if wd:
+            g = g + wd * p
+        asg = self.rho * slots["avg_squared_grad"] + (1 - self.rho) * jnp.square(g)
+        asu = slots["avg_squared_update"]
+        update = g * jnp.sqrt(asu + self.epsilon) / jnp.sqrt(asg + self.epsilon)
+        asu = self.rho * asu + (1 - self.rho) * jnp.square(update)
+        return p - lr * update, {"avg_squared_grad": asg,
+                                 "avg_squared_update": asu}
+
+
+class Adamax(Optimizer):
+    """Reference: paddle.optimizer.Adamax (infinity-norm Adam variant)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.0,
+                 grad_clip=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _init_slots(self, params):
+        z = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return {"moment": jax.tree.map(z, params),
+                "inf_norm": jax.tree.map(z, params)}
+
+    def _update_one(self, name, p, g, lr, slots, step, wd):
+        if wd:
+            g = g + wd * p
+        m = self.beta1 * slots["moment"] + (1 - self.beta1) * g
+        u = jnp.maximum(self.beta2 * slots["inf_norm"], jnp.abs(g))
+        t = step + 1
+        lr_t = lr / (1 - self.beta1 ** t)
+        return p - lr_t * m / (u + self.epsilon), {"moment": m, "inf_norm": u}
+
+
+__all__ += ["Adadelta", "Adamax"]

@@ -151,3 +151,68 @@ def test_eager_step_surface():
     opt.set_grads({"weight": jnp.ones((2, 1))})
     opt.step()
     np.testing.assert_allclose(np.asarray(m.weight), 0.5)
+
+
+class TestOptimizerBreadth:
+    """Adadelta/Adamax vs torch oracle; Orthogonal/Assign/Dirac inits."""
+
+    def _run_opt(self, opt_cls, torch_cls, okw, tkw, steps=5):
+        import jax.numpy as jnp
+        import numpy as np
+        import torch
+        import paddle_tpu as pt
+        from paddle_tpu import nn
+
+        pt.seed(0)
+        w0 = np.random.default_rng(0).normal(size=(4, 3)).astype("float32")
+        g = np.random.default_rng(1).normal(size=(4, 3)).astype("float32")
+
+        layer = nn.Linear(4, 3, bias_attr=False)
+        layer.weight = jnp.asarray(w0)
+        opt = opt_cls(parameters=layer.parameters(), **okw)
+        params = {"weight": jnp.asarray(w0)}
+        state = opt.init(params)
+        for _ in range(steps):
+            params, state = opt.apply({"weight": jnp.asarray(g)}, state, params)
+
+        tw = torch.nn.Parameter(torch.tensor(w0))
+        topt = torch_cls([tw], **tkw)
+        for _ in range(steps):
+            tw.grad = torch.tensor(g)
+            topt.step()
+        import numpy.testing as npt
+        npt.assert_allclose(np.asarray(params["weight"]), tw.detach().numpy(),
+                            rtol=2e-3, atol=2e-4)
+
+    def test_adadelta_vs_torch(self):
+        import torch
+        from paddle_tpu.optimizer import Adadelta
+        self._run_opt(Adadelta, torch.optim.Adadelta,
+                      dict(learning_rate=1.0, rho=0.95, epsilon=1e-6),
+                      dict(lr=1.0, rho=0.95, eps=1e-6))
+
+    def test_adamax_vs_torch(self):
+        import torch
+        from paddle_tpu.optimizer import Adamax
+        self._run_opt(Adamax, torch.optim.Adamax,
+                      dict(learning_rate=0.01, beta1=0.9, beta2=0.999,
+                           epsilon=1e-8),
+                      dict(lr=0.01, betas=(0.9, 0.999), eps=1e-8))
+
+    def test_orthogonal_assign_dirac(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from paddle_tpu.nn import initializer as I
+
+        q = I.Orthogonal()(jax.random.key(0), (6, 4), jnp.float32)
+        np.testing.assert_allclose(np.asarray(q.T @ q), np.eye(4),
+                                   atol=1e-5)
+        v = np.arange(6.0).reshape(2, 3).astype("float32")
+        out = I.Assign(v)(jax.random.key(0), (2, 3), jnp.float32)
+        np.testing.assert_array_equal(np.asarray(out), v)
+        w = I.Dirac()(jax.random.key(0), (3, 3, 3, 3), jnp.float32)
+        x = np.random.default_rng(0).normal(size=(1, 3, 5, 5)).astype("float32")
+        from paddle_tpu.nn import functional as F
+        y = np.asarray(F.conv2d(x, w, padding=1))
+        np.testing.assert_allclose(y, x, rtol=1e-5)  # identity conv

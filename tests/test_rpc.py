@@ -91,3 +91,37 @@ class TestRpcTwoWorkers:
             assert p.returncode == 0, out
         assert "rank 0 got 20" in outs[0]
         assert "rank 1 got 30" in outs[1]
+
+
+def _slow(sec):
+    time.sleep(sec)
+    return "late"
+
+
+def _unpicklable():
+    return threading.Lock()
+
+
+class TestRpcRobustness:
+    def test_timeout_evicts_desynced_connection(self):
+        rpc.init_rpc("t", rank=0, world_size=1,
+                     master_endpoint=f"127.0.0.1:{free_port()}")
+        try:
+            with pytest.raises(Exception):
+                rpc.rpc_sync("t", _slow, args=(2.0,), timeout=0.3)
+            # the late response must NOT be read as the next call's result
+            time.sleep(2.2)
+            assert rpc.rpc_sync("t", _add, args=(1, 2)) == 3
+        finally:
+            rpc.shutdown(graceful=False)
+
+    def test_unpicklable_result_gives_clear_error(self):
+        rpc.init_rpc("u", rank=0, world_size=1,
+                     master_endpoint=f"127.0.0.1:{free_port()}")
+        try:
+            with pytest.raises(RuntimeError, match="not picklable"):
+                rpc.rpc_sync("u", _unpicklable)
+            # connection still healthy afterwards
+            assert rpc.rpc_sync("u", _add, args=(2, 2)) == 4
+        finally:
+            rpc.shutdown(graceful=False)
