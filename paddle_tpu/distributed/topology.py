@@ -58,8 +58,50 @@ class HybridTopology:
         devices = devices[:ws]
         degs = self.degrees()
         shape = tuple(degs[a] for a in AXIS_ORDER)
-        arr = np.array(devices, dtype=object).reshape(shape)
+        arr = self._device_grid(devices, shape)
         return Mesh(arr, AXIS_ORDER)
+
+    def _device_grid(self, devices, shape):
+        """Arrange devices so collectives ride the right fabric.
+
+        On TPU, ``mesh_utils.create_device_mesh`` maps the logical grid onto
+        the physical ICI torus (nearest-neighbour rings per axis); with
+        multiple slices, ``create_hybrid_device_mesh`` puts ONE axis across
+        the DCN — chosen as the outermost axis whose degree divides the
+        slice count order (pp first, then dp, then sharding; those tolerate
+        DCN latency, mp/sep/ep must stay on ICI). CPU/virtual meshes keep a
+        plain deterministic reshape."""
+        if getattr(devices[0], "platform", "cpu") != "tpu":
+            return np.array(devices, dtype=object).reshape(shape)
+        slices = {getattr(d, "slice_index", 0) for d in devices}
+        n_slices = len(slices)
+        if n_slices > 1:
+            # This validation must NOT be swallowed by the layout fallback:
+            # an mp/sep/ep ring spanning the DCN is a config error, not a
+            # layout preference.
+            dcn_shape = [1] * len(AXIS_ORDER)
+            for cand in ("pp", "dp", "sharding"):
+                i = AXIS_ORDER.index(cand)
+                if shape[i] % n_slices == 0:
+                    dcn_shape[i] = n_slices
+                    break
+            else:
+                raise ValueError(
+                    f"{n_slices} slices but no pp/dp/sharding degree "
+                    f"divisible by the slice count in {shape}")
+            try:
+                from jax.experimental import mesh_utils
+                ici_shape = [s // d for s, d in zip(shape, dcn_shape)]
+                return mesh_utils.create_hybrid_device_mesh(
+                    ici_shape, dcn_shape, devices=devices)
+            except (ImportError, NotImplementedError, ValueError):
+                return np.array(devices, dtype=object).reshape(shape)
+        try:
+            from jax.experimental import mesh_utils
+            return mesh_utils.create_device_mesh(shape, devices=devices)
+        except (ImportError, NotImplementedError, ValueError):
+            # fallback: logical order (correct, possibly suboptimal layout)
+            return np.array(devices, dtype=object).reshape(shape)
 
     @classmethod
     def from_hybrid_configs(cls, cfg: Dict) -> "HybridTopology":

@@ -18,4 +18,5 @@ from .context import Context, parse_args  # noqa: F401
 from .controller import CollectiveController  # noqa: F401
 from .job import Container, Job, Pod  # noqa: F401
 from .main import launch  # noqa: F401
+from .preempt import PreemptionGuard  # noqa: F401
 from .store import TCPStore  # noqa: F401

@@ -1,0 +1,69 @@
+"""Preemption-aware training support (SURVEY §5.3).
+
+Reference behavior: elastic restarts rely on user checkpoints; the launcher
+sends SIGTERM with a grace window before SIGKILL (launch/job.py). This
+module is the trainer-side half: catch the SIGTERM, finish the current
+step, save a checkpoint, exit cleanly — so the relaunched job (same or
+smaller slice) resumes via ckpt reshard-on-load.
+
+Usage::
+
+    guard = PreemptionGuard(save_fn=lambda: pt.save(state, path))
+    with guard:
+        for batch in loader:
+            state, metrics = step(state, batch)
+            if guard.preempted:       # SIGTERM arrived mid-epoch
+                break                  # guard saves on exit
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Callable, Optional
+
+
+class PreemptionGuard:
+    """Installs a SIGTERM (and optionally SIGINT) handler that flips
+    ``preempted`` instead of killing the process; on context exit after a
+    preemption, runs ``save_fn`` exactly once."""
+
+    def __init__(self, save_fn: Optional[Callable[[], None]] = None,
+                 catch_sigint: bool = False):
+        self.save_fn = save_fn
+        self._signals = [signal.SIGTERM] + (
+            [signal.SIGINT] if catch_sigint else [])
+        self._event = threading.Event()
+        self._prev = {}
+        self._saved = False
+
+    @property
+    def preempted(self) -> bool:
+        return self._event.is_set()
+
+    def _handler(self, signum, frame):
+        self._event.set()
+
+    def __enter__(self):
+        for s in self._signals:
+            self._prev[s] = signal.signal(s, self._handler)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        # Save BEFORE restoring handlers: a second SIGTERM during the
+        # checkpoint write must not kill the process mid-save.
+        if self.preempted and self.save_fn is not None and not self._saved:
+            self._saved = True
+            self.save_fn()
+        for s, prev in self._prev.items():
+            signal.signal(s, prev)
+        self._prev.clear()
+        return False
+
+    def checkpoint_now(self):
+        """Run save_fn immediately (periodic saves can share the fn).
+
+        Deliberately does NOT mark the exit-time save as done: a later
+        preemption must still snapshot the newest state on exit."""
+        if self.save_fn is not None:
+            self.save_fn()

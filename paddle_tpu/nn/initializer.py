@@ -172,6 +172,10 @@ class Dirac(Initializer):
         if len(shape) < 3:
             raise ValueError("Dirac requires conv-shaped (>=3d) params")
         out_c, in_c = shape[0], shape[1]
+        if out_c % self.groups:
+            raise ValueError(
+                f"Dirac: out_channels ({out_c}) must be divisible by "
+                f"groups ({self.groups})")
         w = jnp.zeros(shape, dtype)
         centers = tuple(s // 2 for s in shape[2:])
         og = out_c // self.groups
