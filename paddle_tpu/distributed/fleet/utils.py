@@ -1,0 +1,13 @@
+"""``paddle.distributed.fleet.utils`` parity surface.
+
+Reference: python/paddle/distributed/fleet/utils/ — recompute (activation
+checkpointing), sequence_parallel_utils (Megatron-SP ops). Both are
+implemented in their first-class homes here and re-exported at the
+reference path so training scripts port unchanged.
+"""
+
+from ..recompute import RecomputeWrapper, recompute  # noqa: F401
+from ..mp_layers import (  # noqa: F401
+    ColumnSequenceParallelLinear, RowSequenceParallelLinear,
+    gather_from_sequence_parallel, mark_as_sequence_parallel_parameter,
+    scatter_to_sequence_parallel)

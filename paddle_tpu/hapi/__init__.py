@@ -5,5 +5,5 @@ Reference: python/paddle/hapi/model.py, callbacks.py, progressbar.py.
 
 from .callbacks import (Callback, CallbackList, EarlyStopping,  # noqa: F401
                         LogWriterCallback, LRScheduler, ModelCheckpoint,
-                        ProgBarLogger, config_callbacks)
+                        ProgBarLogger, SpeedMonitor, config_callbacks)
 from .model import Model  # noqa: F401

@@ -217,6 +217,55 @@ class LRScheduler(Callback):
             s.step()
 
 
+class SpeedMonitor(Callback):
+    """Throughput (and optional MFU) per logging window.
+
+    SURVEY §5.5 TPU-equivalent: per-step timing, samples/sec, tokens/sec
+    and MFU computed in the trainer loop. ``tokens_per_sample`` turns
+    samples/sec into tokens/sec; ``flops_per_sample`` + the device's peak
+    enables MFU."""
+
+    def __init__(self, log_freq: int = 10, batch_size: Optional[int] = None,
+                 tokens_per_sample: Optional[int] = None,
+                 flops_per_sample: Optional[float] = None,
+                 peak_flops: Optional[float] = None, verbose: int = 1):
+        super().__init__()
+        self.log_freq = log_freq
+        self.batch_size = batch_size
+        self.tokens_per_sample = tokens_per_sample
+        self.flops_per_sample = flops_per_sample
+        self.peak_flops = peak_flops
+        self.verbose = verbose
+        self.last: Dict[str, float] = {}
+
+    def on_train_begin(self, logs=None):
+        self._t0 = time.monotonic()
+        self._n = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        self._n += 1
+        if self._n % self.log_freq:
+            return
+        dt = time.monotonic() - self._t0
+        self._t0 = time.monotonic()
+        steps_per_sec = self.log_freq / max(dt, 1e-9)
+        stats = {"steps_per_sec": steps_per_sec,
+                 "ms_per_step": 1000.0 / steps_per_sec}
+        bs = self.batch_size or self.params.get("batch_size")
+        if bs:
+            sps = steps_per_sec * bs
+            stats["samples_per_sec"] = sps
+            if self.tokens_per_sample:
+                stats["tokens_per_sec"] = sps * self.tokens_per_sample
+            if self.flops_per_sample and self.peak_flops:
+                stats["mfu"] = sps * self.flops_per_sample / self.peak_flops
+        self.last = stats
+        if logs is not None:
+            logs.update(stats)
+        if self.verbose:
+            print(" - ".join(f"{k}: {v:.4g}" for k, v in stats.items()))
+
+
 class LogWriterCallback(Callback):
     """JSONL metric stream (in place of the reference's VisualDL callback)."""
 
@@ -262,7 +311,8 @@ class LogWriterCallback(Callback):
 
 def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
                      log_freq=10, verbose=2, save_freq=1, save_dir=None,
-                     metrics=None, mode="train") -> CallbackList:
+                     metrics=None, mode="train",
+                     batch_size=None) -> CallbackList:
     """Assemble the default callback set around user callbacks (reference
     config_callbacks)."""
     cbks = list(callbacks or [])
@@ -276,5 +326,5 @@ def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
     lst.set_model(model)
     lst.set_params({"epochs": epochs, "steps": steps, "verbose": verbose,
                     "metrics": metrics or [], "save_dir": save_dir,
-                    "mode": mode})
+                    "mode": mode, "batch_size": batch_size})
     return lst

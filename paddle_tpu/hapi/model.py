@@ -190,7 +190,7 @@ class Model:
         cbks = config_callbacks(callbacks, model=self, epochs=epochs,
                                 steps=steps, log_freq=log_freq,
                                 verbose=verbose, save_freq=save_freq,
-                                save_dir=save_dir,
+                                save_dir=save_dir, batch_size=batch_size,
                                 metrics=[m.name() for m in self._metrics])
         self.stop_training = False
         cbks.on_train_begin()

@@ -45,6 +45,11 @@ class PreemptionGuard:
         self._event.set()
 
     def __enter__(self):
+        # fresh lifecycle per entry: a guard object may be reused across
+        # retry attempts, and a stale preempted/saved flag from the last
+        # run must not short-circuit the next one
+        self._event.clear()
+        self._saved = False
         for s in self._signals:
             self._prev[s] = signal.signal(s, self._handler)
         return self

@@ -28,8 +28,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 1024
+import os
+
+# Block sizes are tunable per hardware generation via PDTPU_FLASH_BLOCK_Q/K.
+# Defaults from the v5e on-chip sweep (2026-07-30, llama-350m train step):
+# (1024,1024) 0.433 MFU > (512,1024) 0.422 > (512,2048) 0.414 > others;
+# (1024,2048) exceeds VMEM.
+DEFAULT_BLOCK_Q = int(os.environ.get("PDTPU_FLASH_BLOCK_Q", 1024))
+DEFAULT_BLOCK_K = int(os.environ.get("PDTPU_FLASH_BLOCK_K", 1024))
 NEG_INF = -1e30
 
 

@@ -136,3 +136,32 @@ def test_eager_scatter_returns_sharded(mesh8):
     assert out.shape == (4, 2)
     assert "dp" in str(out.sharding.spec)
     np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+class TestMultiSliceMeshLayout:
+    """_device_grid: DCN axis selection + validation (fake TPU devices)."""
+
+    class _FakeDev:
+        platform = "tpu"
+
+        def __init__(self, idx, slice_index):
+            self.id = idx
+            self.slice_index = slice_index
+
+        def __repr__(self):
+            return f"dev{self.id}@slice{self.slice_index}"
+
+    def test_multislice_without_divisible_axis_raises(self):
+        from paddle_tpu.distributed.topology import HybridTopology
+        topo = HybridTopology(dp_degree=3, mp_degree=2)
+        devs = [self._FakeDev(i, i // 3) for i in range(6)]  # 2 slices
+        shape = (1, 3, 1, 1, 1, 2)  # pp,dp,sharding,ep,sep,mp
+        with pytest.raises(ValueError, match="slices"):
+            topo._device_grid(devs, shape)
+
+    def test_cpu_devices_keep_plain_reshape(self):
+        from paddle_tpu.distributed.topology import HybridTopology
+        import jax
+        topo = HybridTopology(dp_degree=4, mp_degree=2)
+        mesh = topo.build_mesh(jax.devices()[:8])
+        assert mesh.shape["dp"] == 4 and mesh.shape["mp"] == 2

@@ -150,3 +150,33 @@ class TestSaveLoad:
 
     def test_missing_submodule_probe(self):
         assert not hasattr(pt, "definitely_not_a_module")
+
+
+class TestSpeedMonitor:
+    def test_speed_stats_logged(self, capsys):
+        from paddle_tpu.hapi import SpeedMonitor
+        x, y = _toy_data(n=64)
+        m = Model(_mlp())
+        m.prepare(AdamW(learning_rate=1e-2, parameters=m.parameters()),
+                  loss=_ce)
+        sm = SpeedMonitor(log_freq=2, batch_size=16, tokens_per_sample=8,
+                          flops_per_sample=1e6, peak_flops=1e12, verbose=1)
+        m.fit(TensorDataset([x, y]), batch_size=16, epochs=1, verbose=0,
+              callbacks=[sm])
+        assert sm.last["steps_per_sec"] > 0
+        assert sm.last["tokens_per_sec"] == sm.last["samples_per_sec"] * 8
+        assert "mfu" in sm.last
+        assert "steps_per_sec" in capsys.readouterr().out
+
+    def test_fit_threads_batch_size_to_params(self):
+        from paddle_tpu.hapi import SpeedMonitor
+        x, y = _toy_data(n=32)
+        m = Model(_mlp())
+        m.prepare(AdamW(learning_rate=1e-2, parameters=m.parameters()),
+                  loss=_ce)
+        sm = SpeedMonitor(log_freq=1, tokens_per_sample=4, verbose=0)
+        m.fit(TensorDataset([x, y]), batch_size=8, epochs=1, verbose=0,
+              callbacks=[sm])
+        # batch_size comes from fit() via callback params — no re-passing
+        assert sm.last["samples_per_sec"] > 0
+        assert sm.last["tokens_per_sec"] == sm.last["samples_per_sec"] * 4

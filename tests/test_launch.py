@@ -300,10 +300,23 @@ class TestPreemptionGuard:
         # "relaunch": fresh step, load the saved state, keep training
         step2 = make_step()
         state2 = pt.load(path)
-        state2["rng"] = state["rng"]  # jax.random keys round-trip as raw arrays
+        # jax.random keys round-trip as raw key_data — rewrap on load
         import jax
         state2["rng"] = jax.random.wrap_key_data(
             jnp.asarray(jax.random.key_data(state["rng"])))
         for _ in range(10):
             state2, met2 = step2(state2, batch)
         assert float(met2["loss"]) < loss_at_preempt
+
+    def test_guard_reusable_across_runs(self, tmp_path):
+        import signal as sig
+        from paddle_tpu.launch import PreemptionGuard
+        saves = []
+        guard = PreemptionGuard(save_fn=lambda: saves.append(1))
+        for attempt in range(2):
+            with guard:
+                assert not guard.preempted   # stale flag must be cleared
+                os.kill(os.getpid(), sig.SIGTERM)
+                time.sleep(0.05)
+                assert guard.preempted
+        assert saves == [1, 1]               # saved on BOTH preemptions
