@@ -239,6 +239,17 @@ class SpeedMonitor(Callback):
         self.last: Dict[str, float] = {}
 
     def on_train_begin(self, logs=None):
+        self._reset_window()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        # windows must not span epoch boundaries (epoch-begin overhead)
+        self._reset_window()
+
+    def on_eval_end(self, logs=None):
+        # nor an eval pass run mid-training
+        self._reset_window()
+
+    def _reset_window(self):
         self._t0 = time.monotonic()
         self._n = 0
 

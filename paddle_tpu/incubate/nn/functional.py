@@ -111,17 +111,22 @@ def masked_multihead_attention(q, k_cache, v_cache, seq_lens,
         k_cache = k_cache * (1 - onehot) + onehot * new_k[:, None]
         v_cache = v_cache * (1 - onehot) + onehot * new_v[:, None]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
-    rep = h // h_kv
-    k = jnp.repeat(k_cache, rep, axis=2) if rep > 1 else k_cache
-    v = jnp.repeat(v_cache, rep, axis=2) if rep > 1 else v_cache
-    # scores: (B, H, S)
-    scores = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) * scale
-    mask = jnp.arange(s_max)[None, None, :] <= seq_lens[:, None, None]
+    g = h // h_kv
+    # GQA without materializing repeated KV: group the q heads per kv head
+    # and contract against the kv head axis directly (4x less HBM traffic
+    # at 4-way GQA); accumulate in fp32 on the MXU
+    qg = q.reshape(b, h_kv, g, d)
+    scores = jnp.einsum("bkgd,bskd->bkgs", qg, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    mask = jnp.arange(s_max)[None, None, None, :] <= \
+        seq_lens[:, None, None, None]
     scores = jnp.where(mask, scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhs,bshd->bhd", probs, v.astype(jnp.float32))
-    return out.astype(q.dtype), k_cache, v_cache
+    # probs stay fp32 through the PV contraction (decode is bandwidth-bound;
+    # bf16-rounding the probabilities would cost accuracy for nothing)
+    out = jnp.einsum("bkgs,bskd->bkgd", probs, v_cache,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, h, d).astype(q.dtype), k_cache, v_cache
 
 
 def paged_attention(q, k_cache, v_cache, block_tables, context_lens,
@@ -136,10 +141,19 @@ def paged_attention(q, k_cache, v_cache, block_tables, context_lens,
     block_tables: (B, max_blocks_per_seq) int32 — per-seq block ids
     context_lens: (B,) — tokens so far (incl. current)
 
-    XLA impl: gather each sequence's blocks then masked attention; the
-    gather is a single dynamic-gather XLA op (TPU-friendly); a Pallas
-    double-buffered variant can drop in via ops.dispatch later.
+    On TPU this dispatches to the Pallas kernel
+    (ops/pallas/decode_attention.py) whose scalar-prefetched block table
+    DMAs each page straight from the pool — the XLA gather below
+    materializes the gathered cache and is orders of magnitude slower
+    on TPU; it remains the CPU/fallback reference implementation.
     """
+    from ...ops import dispatch as _dispatch
+    kernel = _dispatch.get("paged_attention")
+    if kernel is not None:
+        out = kernel(q, k_cache, v_cache, block_tables, context_lens,
+                     scale=scale)
+        if out is not None:
+            return out
     b, h, d = q.shape
     nb, bs, h_kv, _ = k_cache.shape
     mb = block_tables.shape[1]

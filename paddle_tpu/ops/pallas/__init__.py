@@ -24,3 +24,17 @@ def _flash_attention_dispatch(q, k, v, causal=False, scale=None):
 
 
 dispatch.register("flash_attention", _flash_attention_dispatch, platform="tpu")
+
+from . import decode_attention as _da
+
+
+def _paged_attention_dispatch(q, k_pool, v_pool, block_tables, lens,
+                              scale=None):
+    if not _da.supported(q, k_pool, v_pool, block_tables, lens):
+        return None  # caller falls back to the XLA gather formulation
+    return _da.paged_attention(q, k_pool, v_pool, block_tables, lens,
+                               scale=scale)
+
+
+dispatch.register("paged_attention", _paged_attention_dispatch,
+                  platform="tpu")

@@ -1,0 +1,82 @@
+"""Paged decode attention kernel: interpret-mode correctness vs NumPy
+oracle on the CPU mesh (the real-chip run is covered by the on-chip
+microbench recorded in the kernel docstrings)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas import decode_attention as DA
+
+R = np.random.default_rng(0)
+
+
+def _oracle(q, kp, vp, tables, lens):
+    B, H, D = q.shape
+    NB, BS, HKV, _ = kp.shape
+    MB = tables.shape[1]
+    g = H // HKV
+    out = np.zeros((B, H, D), "float32")
+    for b in range(B):
+        ks = kp[tables[b]].reshape(MB * BS, HKV, D)[:lens[b]]
+        vs = vp[tables[b]].reshape(MB * BS, HKV, D)[:lens[b]]
+        for h in range(H):
+            hk = h // g
+            s = (ks[:, hk] @ q[b, h]) / np.sqrt(D)
+            p = np.exp(s - s.max())
+            p /= p.sum()
+            out[b, h] = p @ vs[:, hk]
+    return out
+
+
+def _case(B=2, H=4, HKV=2, D=128, BS=16, NB=32, MB=4, lens=None):
+    q = R.normal(size=(B, H, D)).astype("float32")
+    kp = R.normal(size=(NB, BS, HKV, D)).astype("float32")
+    vp = R.normal(size=(NB, BS, HKV, D)).astype("float32")
+    tables = R.integers(0, NB, size=(B, MB)).astype("int32")
+    lens = np.asarray(lens if lens is not None
+                      else [MB * BS] * B).astype("int32")
+    return q, kp, vp, tables, lens
+
+
+class TestPagedDecodeKernel:
+    def test_full_length_matches_oracle(self):
+        q, kp, vp, tables, lens = _case()
+        got = np.asarray(DA.paged_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(tables), jnp.asarray(lens), interpret=True))
+        np.testing.assert_allclose(got, _oracle(q, kp, vp, tables, lens),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_partial_lengths_and_page_boundaries(self):
+        q, kp, vp, tables, lens = _case(B=4, lens=[64, 33, 5, 48])
+        got = np.asarray(DA.paged_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(tables), jnp.asarray(lens), interpret=True))
+        np.testing.assert_allclose(got, _oracle(q, kp, vp, tables, lens),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_no_gqa(self):
+        q, kp, vp, tables, lens = _case(H=2, HKV=2, lens=[40, 17])
+        got = np.asarray(DA.paged_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(tables), jnp.asarray(lens), interpret=True))
+        np.testing.assert_allclose(got, _oracle(q, kp, vp, tables, lens),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_supported_gating(self):
+        q, kp, vp, tables, lens = _case()
+        # on CPU the kernel path must decline (falls back to XLA impl)
+        assert not DA.supported(jnp.asarray(q), jnp.asarray(kp),
+                                jnp.asarray(vp), jnp.asarray(tables),
+                                jnp.asarray(lens))
+
+    def test_dispatch_fallback_on_cpu(self):
+        """incubate.paged_attention must still work on CPU (XLA gather)."""
+        from paddle_tpu.incubate.nn import functional as IF
+        q, kp, vp, tables, lens = _case()
+        out = np.asarray(IF.paged_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(tables), jnp.asarray(lens)))
+        np.testing.assert_allclose(out, _oracle(q, kp, vp, tables, lens),
+                                   rtol=2e-4, atol=2e-5)
