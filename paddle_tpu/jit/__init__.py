@@ -159,6 +159,13 @@ class TrainStep:
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.scaler = scaler
+        if mesh is None:
+            # fleet.init() was called → pick up the global hybrid mesh
+            # (paddle semantics: fleet state is process-global)
+            from ..distributed import fleet as _fleet
+            hcg = _fleet.get_hybrid_communicate_group()
+            if hcg is not None:
+                mesh = hcg.mesh
         self.mesh = mesh
         # group_sharded_parallel records the stage on the optimizer; an
         # explicit zero_stage argument (including 0 = force off) wins

@@ -109,9 +109,12 @@ def paged_attention(q, k_pool, v_pool, block_tables, lens, scale=None,
         # Clamp dead pages (past the sequence length) to the last live page:
         # Pallas elides the re-fetch of an already-resident block, so short
         # sequences skip the dead DMA traffic — and padding entries of the
-        # block table are never dereferenced as pool indices.
+        # block table are never dereferenced as pool indices. The final
+        # clip covers len==0 slots whose ENTIRE row is padding (often -1):
+        # any in-range block is safe to fetch since compute is skipped.
         last_live = jnp.maximum(lens_[ib] - 1, 0) // page
-        return (tables[ib, jnp.minimum(ip, last_live)], 0, 0, 0)
+        idx = tables[ib, jnp.minimum(ip, last_live)]
+        return (jnp.clip(idx, 0, nb - 1), 0, 0, 0)
 
     def o_map(ib, ip, tables, lens_):
         return (ib, 0, 0)

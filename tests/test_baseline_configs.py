@@ -1,0 +1,121 @@
+"""BASELINE.json configs, exercised end-to-end at tiny scale.
+
+Each of the五 target configs (BASELINE.json "configs") gets one test that
+instantiates the SAME model family + parallelism strategy on the virtual
+8-device mesh and runs real train steps to a falling loss:
+
+1. Llama pure-DP (+ZeRO-1 on the dp axis)
+2. ERNIE/GPT 13B-family TP+PP hybrid
+3. Mixtral-style expert parallel (all-to-all over ep)
+4. SDXL UNet conv/GroupNorm/attention
+5. Llama 70B-family ZeRO-3 sharding
+
+The full-scale presets themselves (llama2-7b/70b, gpt3-13b, sdxl) are
+asserted to exist with the right dimensions.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.distributed import fleet
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.optimizer import AdamW
+
+
+def _lm_batch(vocab, b=8, s=16, seed=0):
+    r = np.random.default_rng(seed)
+    ids = r.integers(0, vocab, (b, s + 1)).astype("int32")
+    return {"input_ids": jnp.asarray(ids[:, :-1]),
+            "labels": jnp.asarray(ids[:, 1:].astype("int64"))}
+
+
+def _train(model, loss_fn, batch, steps=12, lr=5e-3):
+    opt = AdamW(learning_rate=lr, parameters=model.parameters())
+    step = TrainStep(model, loss_fn, opt)
+    state = step.init_state()
+    losses = []
+    for _ in range(steps):
+        state, met = step(state, batch)
+        losses.append(float(met["loss"]))
+    return losses
+
+
+@pytest.fixture
+def hybrid(request):
+    def make(**degrees):
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = degrees
+        return fleet.init(is_collective=True, strategy=s)
+    yield make
+    fleet._HYBRID_PARALLEL_GROUP = None
+
+
+class TestBaselineConfigs:
+    def test_cfg1_llama_pure_dp_zero1(self, hybrid):
+        from paddle_tpu.models.llama import PRESETS, causal_lm_loss, llama
+        # full-scale preset sanity (llama2-7b is the real target)
+        assert PRESETS["llama2-7b"].hidden_size == 4096
+        hybrid(dp_degree=4, sharding_degree=2)   # DP + ZeRO-1-style opt shard
+        m = llama("tiny")
+        losses = _train(m, causal_lm_loss, _lm_batch(256))
+        assert losses[-1] < losses[0] * 0.7, losses
+
+    def test_cfg2_ernie_tp_pp(self, hybrid):
+        from paddle_tpu.models.gpt import GPTConfig, PRESETS, gpt
+        assert PRESETS["gpt3-13b"].hidden_size == 5120    # 13B-class target
+        hybrid(mp_degree=2, pp_degree=2, dp_degree=2)
+        m = gpt(GPTConfig(vocab_size=128, hidden_size=32,
+                          num_hidden_layers=4, num_attention_heads=2,
+                          max_position_embeddings=32, pipeline_stages=2,
+                          num_microbatches=2))
+        losses = _train(
+            m, lambda mm, b: mm(b["input_ids"], labels=b["labels"]),
+            _lm_batch(128, b=4, s=16))
+        assert losses[-1] < losses[0] * 0.8, losses
+
+    def test_cfg3_moe_expert_parallel(self, hybrid):
+        from paddle_tpu.models.mixtral import causal_lm_loss, mixtral
+        hybrid(ep_degree=4, dp_degree=2)
+        m = mixtral("tiny")
+        losses = _train(m, causal_lm_loss, _lm_batch(256, b=8, s=8))
+        assert losses[-1] < losses[0], losses
+
+    def test_cfg4_sdxl_unet(self):
+        from paddle_tpu.models.sdxl_unet import sdxl_unet
+        pt.seed(0)
+        m = sdxl_unet("tiny")
+        r = np.random.default_rng(0)
+        batch = {"x": jnp.asarray(r.normal(size=(2, 4, 16, 16)).astype("float32")),
+                 "t": jnp.array([7, 420]),
+                 "ctx": jnp.asarray(r.normal(size=(2, 6, 64)).astype("float32")),
+                 "added": jnp.asarray(r.normal(size=(2, 96)).astype("float32")),
+                 "eps": jnp.asarray(r.normal(size=(2, 4, 16, 16)).astype("float32"))}
+
+        def diff_loss(mm, b):
+            return ((mm(b["x"], b["t"], b["ctx"], b["added"]) - b["eps"]) ** 2).mean()
+
+        losses = _train(m, diff_loss, batch, lr=2e-4)
+        assert losses[-1] < losses[0], losses
+
+    def test_cfg5_llama70b_family_zero3(self, hybrid):
+        from paddle_tpu.models.llama import PRESETS, causal_lm_loss, llama
+        p70 = PRESETS["llama2-70b"]
+        assert (p70.hidden_size, p70.num_hidden_layers,
+                p70.num_key_value_heads) == (8192, 80, 8)  # GQA 70B target
+        hybrid(sharding_degree=8)
+        m = llama("tiny")
+        opt = AdamW(learning_rate=5e-3, parameters=m.parameters())
+        step = TrainStep(m, causal_lm_loss, opt, zero_stage=3)
+        state = step.init_state()
+        batch = _lm_batch(256)
+        losses = []
+        for _ in range(12):
+            state, met = step(state, batch)
+            losses.append(float(met["loss"]))
+        assert losses[-1] < losses[0] * 0.7, losses
+        # params really are sharded over the sharding axis
+        specs = step.param_specs()
+        assert any("sharding" in str(s) for s in specs.values())
