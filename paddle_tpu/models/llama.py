@@ -119,7 +119,8 @@ class LlamaAttention(Layer):
         self.o_proj = RowParallelLinear(h, h, has_bias=False,
                                         weight_attr=attr, sequence_parallel=sp)
 
-    def forward(self, x, cos, sin, attn_mask=None):
+    def forward(self, x, cos, sin, attn_mask=None, cache=None,
+                seq_lens=None, position_offset=0):
         cfg = self.cfg
         b, s = x.shape[:2]
         q = self.q_proj(x).reshape(b, s, cfg.num_attention_heads, cfg.head_dim)
@@ -130,6 +131,31 @@ class LlamaAttention(Layer):
         k = constrain(k, ("dp", "sharding"), None, "mp", None)
         v = constrain(v, ("dp", "sharding"), None, "mp", None)
         q, k = F.apply_rotary_pos_emb(q, k, cos, sin)
+        if cache is not None and s == 1 and seq_lens is not None:
+            # single-token decode against the dense KV cache
+            from ..incubate.nn.functional import masked_multihead_attention
+            kc, vc = cache
+            out, kc, vc = masked_multihead_attention(
+                q[:, 0], kc, vc, seq_lens, k[:, 0], v[:, 0])
+            out = out[:, None].reshape(b, s,
+                                       cfg.num_attention_heads * cfg.head_dim)
+            return self.o_proj(out), (kc, vc)
+        if cache is not None:
+            # single-shot prefill: causal attention over the prompt, cache
+            # written at [0, s) (chunked prefill lives in incubate's
+            # FusedMultiTransformer; generate() prefills in one chunk)
+            if position_offset:
+                raise NotImplementedError(
+                    "llama cache prefill is single-chunk; use "
+                    "incubate.nn.FusedMultiTransformer for chunked prefill")
+            kc, vc = cache
+            kc = jax.lax.dynamic_update_slice_in_dim(
+                kc, k.astype(kc.dtype), 0, axis=1)
+            vc = jax.lax.dynamic_update_slice_in_dim(
+                vc, v.astype(vc.dtype), 0, axis=1)
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+            out = out.reshape(b, s, cfg.num_attention_heads * cfg.head_dim)
+            return self.o_proj(out), (kc, vc)
         if cfg.context_parallel and attn_mask is None:
             from ..distributed import cp
             q = cp.split_sequence(q)
@@ -162,7 +188,8 @@ class LlamaMLP(Layer):
 
 
 class LlamaDecoderLayer(Layer):
-    returns_aux = False  # MoE variants return (x, aux_loss)
+    returns_aux = False     # MoE variants return (x, aux_loss)
+    supports_cache = True   # MoE variants don't take cache= (yet)
 
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
@@ -171,7 +198,16 @@ class LlamaDecoderLayer(Layer):
         self.post_attention_layernorm = LlamaRMSNorm(cfg)
         self.mlp = LlamaMLP(cfg)
 
-    def forward(self, x, cos, sin, attn_mask=None):
+    def forward(self, x, cos, sin, attn_mask=None, cache=None,
+                seq_lens=None, position_offset=0):
+        if cache is not None:
+            attn, cache = self.self_attn(self.input_layernorm(x), cos, sin,
+                                         attn_mask, cache=cache,
+                                         seq_lens=seq_lens,
+                                         position_offset=position_offset)
+            x = x + attn
+            x = x + self.mlp(self.post_attention_layernorm(x))
+            return x, cache
         x = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_mask)
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
@@ -209,8 +245,28 @@ class LlamaModel(Layer):
             self.layers = LayerList(layers)
         self.norm = LlamaRMSNorm(cfg)
 
-    def forward(self, input_ids, attn_mask=None, position_ids=None):
+    def init_cache(self, batch, max_len, dtype=None):
+        """Per-layer dense (k, v) caches for cached generation; dtype
+        defaults to the config dtype (bf16 configs get bf16 caches)."""
         cfg = self.cfg
+        if cfg.pipeline_stages > 1:
+            raise NotImplementedError(
+                "cached generation requires pipeline_stages == 1")
+        dtype = jnp.dtype(dtype if dtype is not None else cfg.dtype)
+        shape = (batch, max_len, cfg.num_key_value_heads, cfg.head_dim)
+        return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                for _ in range(cfg.num_hidden_layers)]
+
+    def forward(self, input_ids, attn_mask=None, position_ids=None,
+                caches=None, seq_lens=None):
+        cfg = self.cfg
+        if caches is not None:
+            if attn_mask is not None or position_ids is not None:
+                raise NotImplementedError(
+                    "cached forward supports dense causal prefill/decode "
+                    "only — attn_mask/position_ids would be silently "
+                    "ignored (left-pad or trim prompts instead)")
+            return self._forward_cached(input_ids, caches, seq_lens)
         x = self.embed_tokens(input_ids)
         cos, sin = F.rope_cos_sin(input_ids.shape[1], cfg.head_dim,
                                   base=cfg.rope_theta, dtype=x.dtype,
@@ -230,6 +286,29 @@ class LlamaModel(Layer):
         # boundary between model and head, so this is legal under jit)
         self.__dict__["_moe_aux"] = aux
         return self.norm(x)
+
+    def _forward_cached(self, input_ids, caches, seq_lens):
+        """Prefill (seq_lens None) or one-token decode against the caches.
+        Returns (hidden, new_caches)."""
+        cfg = self.cfg
+        x = self.embed_tokens(input_ids)
+        b, s = input_ids.shape
+        decode = (s == 1 and seq_lens is not None)
+        if decode:
+            cos, sin = F.rope_cos_sin(1, cfg.head_dim, base=cfg.rope_theta,
+                                      dtype=x.dtype,
+                                      position_ids=seq_lens[:, None])
+        else:
+            cos, sin = F.rope_cos_sin(s, cfg.head_dim, base=cfg.rope_theta,
+                                      dtype=x.dtype)
+        new_caches = []
+        for layer, cache in zip(self.layers, caches):
+            inner = layer.inner if isinstance(layer, RecomputeWrapper) else layer
+            x, cache = inner(x, cos, sin, cache=cache,
+                             seq_lens=seq_lens if decode else None)
+            new_caches.append(cache)
+        self.__dict__["_moe_aux"] = 0.0
+        return self.norm(x), new_caches
 
 
 class LlamaForCausalLM(Layer):
@@ -261,20 +340,121 @@ class LlamaForCausalLM(Layer):
         valid = (labels != -100)
         return jnp.sum(loss * valid) / jnp.maximum(jnp.sum(valid), 1)
 
-    def generate(self, input_ids, max_new_tokens=32, temperature=0.0):
-        """Greedy/temperature sampling (full-recompute decode; KV-cache
-        decode is the inference milestone)."""
-        ids = input_ids
-        for _ in range(max_new_tokens):
-            logits = self(ids)[:, -1]
-            if temperature > 0:
-                from ..core import random as prandom
-                nxt = jax.random.categorical(prandom.next_key("gen"),
-                                             logits / temperature, axis=-1)
-            else:
-                nxt = jnp.argmax(logits, axis=-1)
-            ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
-        return ids
+    def _sample(self, logits, temperature):
+        if temperature > 0:
+            from ..core import random as prandom
+            return jax.random.categorical(prandom.next_key("gen"),
+                                          logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    def _decode_loop_fn(self, n_steps: int, temperature: float):
+        """Whole decode loop as ONE compiled program: a ``lax.scan`` over
+        n_steps one-token decodes with on-device sampling. One dispatch per
+        generate() call instead of one per token — on TPU (and especially
+        through remote-dispatch relays) per-call latency dominates the
+        decode math, so this is the difference between O(tokens) and O(1)
+        round-trips. Caches are donated (no per-token copy)."""
+        # single-slot memo: serving with varying max_new_tokens/temperature
+        # must not accumulate one XLA executable per combination
+        cached_key, fn = self.__dict__.get("_decode_loop_memo", (None, None))
+        key = (n_steps, temperature)
+        if cached_key != key:
+            fn = None
+        if fn is None:
+            from ..nn.layer import _swapped_params, functional_call
+
+            def one_step(params, tok, caches, lens, rng, i):
+                mp = {k[len("model."):]: v for k, v in params.items()
+                      if k.startswith("model.")}
+                hidden, caches = functional_call(
+                    self.model, mp, tok[:, None], caches=caches,
+                    seq_lens=lens, training=False)
+                with _swapped_params(self, params):
+                    lg = self.logits(hidden[:, -1:])[:, 0]
+                if temperature > 0:
+                    nxt = jax.random.categorical(
+                        jax.random.fold_in(rng, i), lg / temperature, axis=-1)
+                else:
+                    nxt = jnp.argmax(lg, axis=-1)
+                return nxt.astype(tok.dtype), caches
+
+            def loop(params, tok0, caches, lens0, rng):
+                def body(carry, i):
+                    tok, caches, lens = carry
+                    nxt, caches = one_step(params, tok, caches, lens, rng, i)
+                    return (nxt, caches, lens + 1), nxt
+
+                (_, caches, _), toks = jax.lax.scan(
+                    body, (tok0, caches, lens0), jnp.arange(n_steps))
+                return jnp.swapaxes(toks, 0, 1), caches   # (b, n_steps)
+
+            fn = jax.jit(loop, donate_argnums=(2,))
+            self.__dict__["_decode_loop_memo"] = (key, fn)
+        return fn
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
+                 use_cache=True, max_len=None):
+        """Autoregressive generation. ``use_cache=True`` (default) prefills
+        the dense KV caches once, then runs the WHOLE decode loop as one
+        compiled ``lax.scan`` (one dispatch per call). ``use_cache=False``
+        recomputes the full prefix each step; under GREEDY decoding
+        (temperature=0) the two paths are token-identical — with
+        temperature>0 they draw from different RNG stream shapes and
+        legitimately sample different tokens. Falls back to recompute for
+        configs without cache support (pipeline stages, MoE layers)."""
+        if max_new_tokens <= 0:
+            return input_ids
+        cache_ok = (use_cache and self.cfg.pipeline_stages == 1
+                    and getattr(type(self.model).decoder_layer_cls,
+                                "supports_cache", False))
+        if not cache_ok:
+            ids = input_ids
+            for _ in range(max_new_tokens):
+                logits = self(ids)[:, -1]
+                nxt = self._sample(logits, temperature)
+                ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+            return ids
+
+        from ..nn.layer import functional_call, raw_params
+        b, prompt_len = input_ids.shape
+        total = max_len if max_len is not None else \
+            (prompt_len + max_new_tokens)
+        if total < prompt_len + max_new_tokens:
+            raise ValueError(
+                f"max_len={total} < prompt ({prompt_len}) + max_new_tokens "
+                f"({max_new_tokens}): the cache would silently drop keys")
+        params = raw_params(self)
+        prefill = self.__dict__.get("_prefill_compiled")
+        if prefill is None:
+            from ..nn.layer import _swapped_params
+
+            # jitted: eager per-op dispatch of a whole prefill forward would
+            # dominate generate() latency (hundreds of op round-trips)
+            def _prefill(params, input_ids, caches):
+                mp = {k[len("model."):]: v for k, v in params.items()
+                      if k.startswith("model.")}
+                hidden, caches = functional_call(
+                    self.model, mp, input_ids, caches=caches,
+                    training=False)
+                with _swapped_params(self, params):
+                    lg = self.logits(hidden[:, -1:])[:, 0]
+                return lg, caches
+
+            prefill = jax.jit(_prefill, donate_argnums=(2,))
+            self.__dict__["_prefill_compiled"] = prefill
+        caches = self.model.init_cache(b, total)
+        logits, caches = prefill(params, input_ids, caches)
+        tok = self._sample(logits, temperature).astype(input_ids.dtype)
+        if max_new_tokens == 1:
+            return jnp.concatenate([input_ids, tok[:, None]], axis=1)
+
+        from ..core import random as prandom
+        rng = prandom.next_key("gen") if temperature > 0 else \
+            jax.random.key(0)
+        loop = self._decode_loop_fn(max_new_tokens - 1, float(temperature))
+        lens = jnp.full((b,), prompt_len, jnp.int32)
+        toks, _ = loop(params, tok, caches, lens, rng)
+        return jnp.concatenate([input_ids, tok[:, None], toks], axis=1)
 
 
 LlamaModel.decoder_layer_cls = LlamaDecoderLayer

@@ -50,7 +50,7 @@ def hybrid(request):
         s.hybrid_configs = degrees
         return fleet.init(is_collective=True, strategy=s)
     yield make
-    fleet._HYBRID_PARALLEL_GROUP = None
+    fleet._reset()
 
 
 class TestBaselineConfigs:

@@ -141,3 +141,68 @@ def test_lr_schedule_in_step():
         state, m = step(state, batch)
         lrs.append(float(m["lr"]))
     np.testing.assert_allclose(lrs, [1.0, 1.0, 0.1, 0.1], rtol=1e-6)
+
+
+class TestCachedGeneration:
+    """KV-cache generation must reproduce full-recompute token-by-token."""
+
+    def test_cached_equals_recompute_greedy(self):
+        import jax.numpy as jnp
+        import numpy as np
+        import paddle_tpu as pt
+        from paddle_tpu.models.llama import llama
+
+        pt.seed(0)
+        m = llama("tiny").eval()   # tiny has GQA (4 q heads, 2 kv heads)
+        ids = jnp.asarray(np.random.default_rng(3).integers(
+            0, 256, (3, 5)).astype("int32"))
+        a = np.asarray(m.generate(ids, max_new_tokens=7, use_cache=False))
+        b = np.asarray(m.generate(ids, max_new_tokens=7, use_cache=True))
+        np.testing.assert_array_equal(a, b)
+        assert b.shape == (3, 12)
+
+    def test_moe_generate_falls_back_to_recompute(self):
+        import jax.numpy as jnp
+        import numpy as np
+        import paddle_tpu as pt
+        from paddle_tpu.models.mixtral import mixtral
+
+        pt.seed(0)
+        m = mixtral("tiny").eval()
+        ids = jnp.asarray(np.random.default_rng(0).integers(
+            0, 256, (1, 4)).astype("int32"))
+        out = m.generate(ids, max_new_tokens=3)   # must not crash
+        assert out.shape == (1, 7)
+
+    def test_generate_edge_cases(self):
+        import jax.numpy as jnp
+        import numpy as np
+        import pytest
+        import paddle_tpu as pt
+        from paddle_tpu.models.llama import llama
+
+        pt.seed(0)
+        m = llama("tiny").eval()
+        ids = jnp.asarray(np.random.default_rng(0).integers(
+            0, 256, (1, 4)).astype("int32"))
+        # zero new tokens → prompt unchanged, both paths
+        np.testing.assert_array_equal(
+            np.asarray(m.generate(ids, max_new_tokens=0, use_cache=True)),
+            np.asarray(ids))
+        # max_len too small must raise, not silently drop keys
+        with pytest.raises(ValueError, match="max_len"):
+            m.generate(ids, max_new_tokens=8, max_len=6)
+
+    def test_cache_rejects_pipeline(self):
+        import pytest
+        import paddle_tpu as pt
+        from paddle_tpu.models.llama import LlamaConfig, llama
+
+        pt.seed(0)
+        m = llama(LlamaConfig(vocab_size=64, hidden_size=32,
+                              intermediate_size=64, num_hidden_layers=2,
+                              num_attention_heads=2, num_key_value_heads=2,
+                              max_position_embeddings=32,
+                              pipeline_stages=2))
+        with pytest.raises(NotImplementedError):
+            m.model.init_cache(1, 16)
