@@ -108,8 +108,12 @@ def masked_multihead_attention(q, k_cache, v_cache, seq_lens,
     if new_k is not None:
         onehot = jax.nn.one_hot(seq_lens, s_max,
                                 dtype=k_cache.dtype)[:, :, None, None]
-        k_cache = k_cache * (1 - onehot) + onehot * new_k[:, None]
-        v_cache = v_cache * (1 - onehot) + onehot * new_v[:, None]
+        # cast to the cache dtype: mixing dtypes here would silently promote
+        # the whole cache (and break scan carries that hold it)
+        k_cache = k_cache * (1 - onehot) \
+            + onehot * new_k.astype(k_cache.dtype)[:, None]
+        v_cache = v_cache * (1 - onehot) \
+            + onehot * new_v.astype(v_cache.dtype)[:, None]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     g = h // h_kv
     # GQA without materializing repeated KV: group the q heads per kv head

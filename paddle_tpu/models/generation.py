@@ -1,0 +1,152 @@
+"""Shared autoregressive generation machinery (KV-cache serving path).
+
+Used by the Llama and GPT families. Design (verified on-chip, M25):
+- prefill is ONE jitted call (eager per-op dispatch would dominate);
+- the decode loop is ONE compiled ``lax.scan`` over one-token steps with
+  on-device sampling — one dispatch per generate() call, KV caches donated;
+- configs without cache support (pipeline stages, MoE layers) fall back to
+  full-prefix recompute, which is also the greedy-decoding oracle.
+
+Host model contract: ``self.model.init_cache(b, total)``; cached forward
+``self.model(ids, caches=..., seq_lens=...) -> (hidden, caches)``;
+``self.logits(hidden)``; ``self._cache_supported()``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def make_dense_caches(n_layers, batch, max_len, kv_heads, head_dim, dtype):
+    """Per-layer dense (k, v) cache pairs (shared by the model families)."""
+    dtype = jnp.dtype(dtype)
+    shape = (batch, max_len, kv_heads, head_dim)
+    return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(n_layers)]
+
+
+def run_cached_layers(layers, x, caches, call):
+    """Thread (x, per-layer cache) through the decoder stack, unwrapping
+    RecomputeWrapper (remat is pointless for cached inference)."""
+    from ..distributed.recompute import RecomputeWrapper
+    new_caches = []
+    for layer, cache in zip(layers, caches):
+        inner = layer.inner if isinstance(layer, RecomputeWrapper) else layer
+        x, cache = call(inner, x, cache)
+        new_caches.append(cache)
+    return x, new_caches
+
+
+class CachedGenerationMixin:
+    def _cache_supported(self) -> bool:
+        return False  # families opt in
+
+    def _sample(self, logits, temperature):
+        if temperature > 0:
+            from ..core import random as prandom
+            return jax.random.categorical(prandom.next_key("gen"),
+                                          logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    def _decode_loop_fn(self, n_steps: int, temperature: float):
+        """Whole decode loop as ONE compiled program (lax.scan). Single-slot
+        memo: varying max_new_tokens/temperature must not accumulate one
+        XLA executable per combination."""
+        cached_key, fn = self.__dict__.get("_decode_loop_memo", (None, None))
+        key = (n_steps, temperature)
+        if cached_key != key:
+            fn = None
+        if fn is None:
+            from ..nn.layer import _swapped_params, functional_call
+
+            def one_step(params, tok, caches, lens, rng, i):
+                mp = {k[len("model."):]: v for k, v in params.items()
+                      if k.startswith("model.")}
+                hidden, caches = functional_call(
+                    self.model, mp, tok[:, None], caches=caches,
+                    seq_lens=lens, training=False)
+                with _swapped_params(self, params):
+                    lg = self.logits(hidden[:, -1:])[:, 0]
+                if temperature > 0:
+                    nxt = jax.random.categorical(
+                        jax.random.fold_in(rng, i), lg / temperature,
+                        axis=-1)
+                else:
+                    nxt = jnp.argmax(lg, axis=-1)
+                return nxt.astype(tok.dtype), caches
+
+            def loop(params, tok0, caches, lens0, rng):
+                def body(carry, i):
+                    tok, caches, lens = carry
+                    nxt, caches = one_step(params, tok, caches, lens, rng, i)
+                    return (nxt, caches, lens + 1), nxt
+
+                (_, caches, _), toks = jax.lax.scan(
+                    body, (tok0, caches, lens0), jnp.arange(n_steps))
+                return jnp.swapaxes(toks, 0, 1), caches   # (b, n_steps)
+
+            fn = jax.jit(loop, donate_argnums=(2,))
+            self.__dict__["_decode_loop_memo"] = (key, fn)
+        return fn
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
+                 use_cache=True, max_len=None):
+        """Autoregressive generation. ``use_cache=True`` (default) prefills
+        the dense KV caches once, then runs the WHOLE decode loop as one
+        compiled ``lax.scan`` (one dispatch per call). ``use_cache=False``
+        recomputes the full prefix each step; under GREEDY decoding
+        (temperature=0) the two paths are token-identical — with
+        temperature>0 they draw from different RNG stream shapes and
+        legitimately sample different tokens. Falls back to recompute for
+        configs without cache support (pipeline stages, MoE layers)."""
+        if max_new_tokens <= 0:
+            return input_ids
+        if not (use_cache and self._cache_supported()):
+            ids = input_ids
+            for _ in range(max_new_tokens):
+                logits = self(ids)[:, -1]
+                nxt = self._sample(logits, temperature)
+                ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+            return ids
+
+        from ..nn.layer import functional_call, raw_params
+        b, prompt_len = input_ids.shape
+        total = max_len if max_len is not None else \
+            (prompt_len + max_new_tokens)
+        if total < prompt_len + max_new_tokens:
+            raise ValueError(
+                f"max_len={total} < prompt ({prompt_len}) + max_new_tokens "
+                f"({max_new_tokens}): the cache would silently drop keys")
+        params = raw_params(self)
+        prefill = self.__dict__.get("_prefill_compiled")
+        if prefill is None:
+            from ..nn.layer import _swapped_params
+
+            # jitted: eager per-op dispatch of a whole prefill forward would
+            # dominate generate() latency (hundreds of op round-trips)
+            def _prefill(params, input_ids, caches):
+                mp = {k[len("model."):]: v for k, v in params.items()
+                      if k.startswith("model.")}
+                hidden, caches = functional_call(
+                    self.model, mp, input_ids, caches=caches,
+                    training=False)
+                with _swapped_params(self, params):
+                    lg = self.logits(hidden[:, -1:])[:, 0]
+                return lg, caches
+
+            prefill = jax.jit(_prefill, donate_argnums=(2,))
+            self.__dict__["_prefill_compiled"] = prefill
+        caches = self.model.init_cache(b, total)
+        logits, caches = prefill(params, input_ids, caches)
+        tok = self._sample(logits, temperature).astype(input_ids.dtype)
+        if max_new_tokens == 1:
+            return jnp.concatenate([input_ids, tok[:, None]], axis=1)
+
+        from ..core import random as prandom
+        rng = prandom.next_key("gen") if temperature > 0 else \
+            jax.random.key(0)
+        loop = self._decode_loop_fn(max_new_tokens - 1, float(temperature))
+        lens = jnp.full((b,), prompt_len, jnp.int32)
+        toks, _ = loop(params, tok, caches, lens, rng)
+        return jnp.concatenate([input_ids, tok[:, None], toks], axis=1)

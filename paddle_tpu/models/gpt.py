@@ -29,6 +29,7 @@ from ..distributed.mp_layers import (ColumnParallelLinear,
                                      RowParallelLinear,
                                      VocabParallelEmbedding, constrain)
 from ..distributed.recompute import RecomputeWrapper
+from .generation import CachedGenerationMixin
 
 
 @dataclasses.dataclass
@@ -101,7 +102,7 @@ class GPTAttention(Layer):
                                           sequence_parallel=sp)
         self.dropout = Dropout(cfg.hidden_dropout)
 
-    def forward(self, x, attn_mask=None):
+    def forward(self, x, attn_mask=None, cache=None, seq_lens=None):
         cfg = self.cfg
         b, s = x.shape[:2]
         qkv = self.qkv_proj(x).reshape(b, s, 3, cfg.num_attention_heads,
@@ -110,6 +111,25 @@ class GPTAttention(Layer):
         q = constrain(q, ("dp", "sharding"), None, "mp", None)
         k = constrain(k, ("dp", "sharding"), None, "mp", None)
         v = constrain(v, ("dp", "sharding"), None, "mp", None)
+        if cache is not None and s == 1 and seq_lens is not None:
+            # single-token decode against the dense KV cache
+            from ..incubate.nn.functional import masked_multihead_attention
+            kc, vc = cache
+            out, kc, vc = masked_multihead_attention(
+                q[:, 0], kc, vc, seq_lens, k[:, 0], v[:, 0])
+            out = out[:, None].reshape(b, s, cfg.hidden_size)
+            return self.dropout(self.out_proj(out)), (kc, vc)
+        if cache is not None:
+            kc, vc = cache
+            kc = jax.lax.dynamic_update_slice_in_dim(
+                kc, k.astype(kc.dtype), 0, axis=1)
+            vc = jax.lax.dynamic_update_slice_in_dim(
+                vc, v.astype(vc.dtype), 0, axis=1)
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True,
+                dropout_p=cfg.attention_dropout, training=self.training)
+            out = out.reshape(b, s, cfg.hidden_size)
+            return self.dropout(self.out_proj(out)), (kc, vc)
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None,
             dropout_p=cfg.attention_dropout, training=self.training)
@@ -137,6 +157,7 @@ class GPTMLP(Layer):
 
 class GPTDecoderLayer(Layer):
     returns_aux = False
+    supports_cache = True
 
     def __init__(self, cfg: GPTConfig):
         super().__init__()
@@ -145,7 +166,13 @@ class GPTDecoderLayer(Layer):
         self.ln_2 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
         self.mlp = GPTMLP(cfg)
 
-    def forward(self, x, attn_mask=None):
+    def forward(self, x, attn_mask=None, cache=None, seq_lens=None):
+        if cache is not None:
+            attn, cache = self.attn(self.ln_1(x), attn_mask, cache=cache,
+                                    seq_lens=seq_lens)
+            x = x + attn
+            x = x + self.mlp(self.ln_2(x))
+            return x, cache
         x = x + self.attn(self.ln_1(x), attn_mask)
         x = x + self.mlp(self.ln_2(x))
         return x
@@ -185,8 +212,48 @@ class GPTModel(Layer):
             self.h = LayerList(layers)
         self.ln_f = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
 
-    def forward(self, input_ids, attn_mask=None, position_ids=None):
+    def init_cache(self, batch, max_len, dtype=None):
+        """Per-layer dense (k, v) caches for cached generation."""
         cfg = self.cfg
+        if cfg.pipeline_stages > 1:
+            raise NotImplementedError(
+                "cached generation requires pipeline_stages == 1")
+        if max_len > cfg.max_position_embeddings:
+            raise ValueError(
+                f"max_len {max_len} exceeds max_position_embeddings "
+                f"{cfg.max_position_embeddings} (learned positions)")
+        from .generation import make_dense_caches
+        return make_dense_caches(
+            cfg.num_hidden_layers, batch, max_len,
+            cfg.num_attention_heads, cfg.head_dim,
+            dtype if dtype is not None else cfg.dtype)
+
+    def _forward_cached(self, input_ids, caches, seq_lens):
+        """Prefill (seq_lens None) or one-token decode against the caches.
+        Returns (hidden, new_caches)."""
+        b, s = input_ids.shape
+        decode = (s == 1 and seq_lens is not None)
+        pos = (seq_lens[:, None] if decode
+               else jnp.arange(s)[None, :])
+        x = self.embed_tokens(input_ids) + self.embed_positions(pos)
+        x = self.embed_dropout(x)
+        from .generation import run_cached_layers
+        x, new_caches = run_cached_layers(
+            self.h, x, caches,
+            lambda inner, x, cache: inner(
+                x, cache=cache, seq_lens=seq_lens if decode else None))
+        return self.ln_f(x), new_caches
+
+    def forward(self, input_ids, attn_mask=None, position_ids=None,
+                caches=None, seq_lens=None):
+        cfg = self.cfg
+        if caches is not None:
+            if attn_mask is not None or position_ids is not None:
+                raise NotImplementedError(
+                    "cached forward supports dense causal prefill/decode "
+                    "only — attn_mask/position_ids would be silently "
+                    "ignored")
+            return self._forward_cached(input_ids, caches, seq_lens)
         if input_ids.shape[1] > cfg.max_position_embeddings:
             # learned absolute positions: jax's OOB gather would silently
             # clamp every index past the table to its last row
@@ -206,7 +273,10 @@ class GPTModel(Layer):
         return self.ln_f(x)
 
 
-class GPTForCausalLM(Layer):
+class GPTForCausalLM(CachedGenerationMixin, Layer):
+    def _cache_supported(self) -> bool:
+        return self.cfg.pipeline_stages == 1
+
     def __init__(self, cfg: GPTConfig):
         super().__init__()
         self.cfg = cfg
@@ -234,20 +304,6 @@ class GPTForCausalLM(Layer):
         loss = self.loss_fn(logits.astype(jnp.float32), labels)
         valid = (labels != -100)
         return jnp.sum(loss * valid) / jnp.maximum(jnp.sum(valid), 1)
-
-    def generate(self, input_ids, max_new_tokens=32, temperature=0.0):
-        ids = input_ids
-        for _ in range(max_new_tokens):
-            logits = self(ids)[:, -1]
-            if temperature > 0:
-                from ..core import random as prandom
-                nxt = jax.random.categorical(prandom.next_key("gen"),
-                                             logits / temperature, axis=-1)
-            else:
-                nxt = jnp.argmax(logits, axis=-1)
-            ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
-        return ids
-
 
 def gpt(name_or_config="tiny", **overrides) -> GPTForCausalLM:
     cfg = (PRESETS[name_or_config] if isinstance(name_or_config, str)

@@ -28,6 +28,7 @@ from ..distributed.mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
                                      RowParallelLinear, VocabParallelEmbedding,
                                      constrain)
 from ..distributed.recompute import RecomputeWrapper
+from .generation import CachedGenerationMixin
 
 
 @dataclasses.dataclass
@@ -120,7 +121,7 @@ class LlamaAttention(Layer):
                                         weight_attr=attr, sequence_parallel=sp)
 
     def forward(self, x, cos, sin, attn_mask=None, cache=None,
-                seq_lens=None, position_offset=0):
+                seq_lens=None):
         cfg = self.cfg
         b, s = x.shape[:2]
         q = self.q_proj(x).reshape(b, s, cfg.num_attention_heads, cfg.head_dim)
@@ -144,10 +145,6 @@ class LlamaAttention(Layer):
             # single-shot prefill: causal attention over the prompt, cache
             # written at [0, s) (chunked prefill lives in incubate's
             # FusedMultiTransformer; generate() prefills in one chunk)
-            if position_offset:
-                raise NotImplementedError(
-                    "llama cache prefill is single-chunk; use "
-                    "incubate.nn.FusedMultiTransformer for chunked prefill")
             kc, vc = cache
             kc = jax.lax.dynamic_update_slice_in_dim(
                 kc, k.astype(kc.dtype), 0, axis=1)
@@ -199,12 +196,11 @@ class LlamaDecoderLayer(Layer):
         self.mlp = LlamaMLP(cfg)
 
     def forward(self, x, cos, sin, attn_mask=None, cache=None,
-                seq_lens=None, position_offset=0):
+                seq_lens=None):
         if cache is not None:
             attn, cache = self.self_attn(self.input_layernorm(x), cos, sin,
                                          attn_mask, cache=cache,
-                                         seq_lens=seq_lens,
-                                         position_offset=position_offset)
+                                         seq_lens=seq_lens)
             x = x + attn
             x = x + self.mlp(self.post_attention_layernorm(x))
             return x, cache
@@ -252,10 +248,16 @@ class LlamaModel(Layer):
         if cfg.pipeline_stages > 1:
             raise NotImplementedError(
                 "cached generation requires pipeline_stages == 1")
-        dtype = jnp.dtype(dtype if dtype is not None else cfg.dtype)
-        shape = (batch, max_len, cfg.num_key_value_heads, cfg.head_dim)
-        return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
-                for _ in range(cfg.num_hidden_layers)]
+        if not getattr(type(self).decoder_layer_cls, "supports_cache",
+                       False):
+            raise NotImplementedError(
+                f"{type(self).decoder_layer_cls.__name__} does not support "
+                "KV caches (MoE variants use the recompute generate path)")
+        from .generation import make_dense_caches
+        return make_dense_caches(
+            cfg.num_hidden_layers, batch, max_len,
+            cfg.num_key_value_heads, cfg.head_dim,
+            dtype if dtype is not None else cfg.dtype)
 
     def forward(self, input_ids, attn_mask=None, position_ids=None,
                 caches=None, seq_lens=None):
@@ -301,17 +303,17 @@ class LlamaModel(Layer):
         else:
             cos, sin = F.rope_cos_sin(s, cfg.head_dim, base=cfg.rope_theta,
                                       dtype=x.dtype)
-        new_caches = []
-        for layer, cache in zip(self.layers, caches):
-            inner = layer.inner if isinstance(layer, RecomputeWrapper) else layer
-            x, cache = inner(x, cos, sin, cache=cache,
-                             seq_lens=seq_lens if decode else None)
-            new_caches.append(cache)
+        from .generation import run_cached_layers
+        x, new_caches = run_cached_layers(
+            self.layers, x, caches,
+            lambda inner, x, cache: inner(
+                x, cos, sin, cache=cache,
+                seq_lens=seq_lens if decode else None))
         self.__dict__["_moe_aux"] = 0.0
         return self.norm(x), new_caches
 
 
-class LlamaForCausalLM(Layer):
+class LlamaForCausalLM(CachedGenerationMixin, Layer):
     model_cls: type = None  # set below; subclasses override
 
     def __init__(self, cfg: LlamaConfig):
@@ -340,121 +342,10 @@ class LlamaForCausalLM(Layer):
         valid = (labels != -100)
         return jnp.sum(loss * valid) / jnp.maximum(jnp.sum(valid), 1)
 
-    def _sample(self, logits, temperature):
-        if temperature > 0:
-            from ..core import random as prandom
-            return jax.random.categorical(prandom.next_key("gen"),
-                                          logits / temperature, axis=-1)
-        return jnp.argmax(logits, axis=-1)
-
-    def _decode_loop_fn(self, n_steps: int, temperature: float):
-        """Whole decode loop as ONE compiled program: a ``lax.scan`` over
-        n_steps one-token decodes with on-device sampling. One dispatch per
-        generate() call instead of one per token — on TPU (and especially
-        through remote-dispatch relays) per-call latency dominates the
-        decode math, so this is the difference between O(tokens) and O(1)
-        round-trips. Caches are donated (no per-token copy)."""
-        # single-slot memo: serving with varying max_new_tokens/temperature
-        # must not accumulate one XLA executable per combination
-        cached_key, fn = self.__dict__.get("_decode_loop_memo", (None, None))
-        key = (n_steps, temperature)
-        if cached_key != key:
-            fn = None
-        if fn is None:
-            from ..nn.layer import _swapped_params, functional_call
-
-            def one_step(params, tok, caches, lens, rng, i):
-                mp = {k[len("model."):]: v for k, v in params.items()
-                      if k.startswith("model.")}
-                hidden, caches = functional_call(
-                    self.model, mp, tok[:, None], caches=caches,
-                    seq_lens=lens, training=False)
-                with _swapped_params(self, params):
-                    lg = self.logits(hidden[:, -1:])[:, 0]
-                if temperature > 0:
-                    nxt = jax.random.categorical(
-                        jax.random.fold_in(rng, i), lg / temperature, axis=-1)
-                else:
-                    nxt = jnp.argmax(lg, axis=-1)
-                return nxt.astype(tok.dtype), caches
-
-            def loop(params, tok0, caches, lens0, rng):
-                def body(carry, i):
-                    tok, caches, lens = carry
-                    nxt, caches = one_step(params, tok, caches, lens, rng, i)
-                    return (nxt, caches, lens + 1), nxt
-
-                (_, caches, _), toks = jax.lax.scan(
-                    body, (tok0, caches, lens0), jnp.arange(n_steps))
-                return jnp.swapaxes(toks, 0, 1), caches   # (b, n_steps)
-
-            fn = jax.jit(loop, donate_argnums=(2,))
-            self.__dict__["_decode_loop_memo"] = (key, fn)
-        return fn
-
-    def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
-                 use_cache=True, max_len=None):
-        """Autoregressive generation. ``use_cache=True`` (default) prefills
-        the dense KV caches once, then runs the WHOLE decode loop as one
-        compiled ``lax.scan`` (one dispatch per call). ``use_cache=False``
-        recomputes the full prefix each step; under GREEDY decoding
-        (temperature=0) the two paths are token-identical — with
-        temperature>0 they draw from different RNG stream shapes and
-        legitimately sample different tokens. Falls back to recompute for
-        configs without cache support (pipeline stages, MoE layers)."""
-        if max_new_tokens <= 0:
-            return input_ids
-        cache_ok = (use_cache and self.cfg.pipeline_stages == 1
-                    and getattr(type(self.model).decoder_layer_cls,
-                                "supports_cache", False))
-        if not cache_ok:
-            ids = input_ids
-            for _ in range(max_new_tokens):
-                logits = self(ids)[:, -1]
-                nxt = self._sample(logits, temperature)
-                ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
-            return ids
-
-        from ..nn.layer import functional_call, raw_params
-        b, prompt_len = input_ids.shape
-        total = max_len if max_len is not None else \
-            (prompt_len + max_new_tokens)
-        if total < prompt_len + max_new_tokens:
-            raise ValueError(
-                f"max_len={total} < prompt ({prompt_len}) + max_new_tokens "
-                f"({max_new_tokens}): the cache would silently drop keys")
-        params = raw_params(self)
-        prefill = self.__dict__.get("_prefill_compiled")
-        if prefill is None:
-            from ..nn.layer import _swapped_params
-
-            # jitted: eager per-op dispatch of a whole prefill forward would
-            # dominate generate() latency (hundreds of op round-trips)
-            def _prefill(params, input_ids, caches):
-                mp = {k[len("model."):]: v for k, v in params.items()
-                      if k.startswith("model.")}
-                hidden, caches = functional_call(
-                    self.model, mp, input_ids, caches=caches,
-                    training=False)
-                with _swapped_params(self, params):
-                    lg = self.logits(hidden[:, -1:])[:, 0]
-                return lg, caches
-
-            prefill = jax.jit(_prefill, donate_argnums=(2,))
-            self.__dict__["_prefill_compiled"] = prefill
-        caches = self.model.init_cache(b, total)
-        logits, caches = prefill(params, input_ids, caches)
-        tok = self._sample(logits, temperature).astype(input_ids.dtype)
-        if max_new_tokens == 1:
-            return jnp.concatenate([input_ids, tok[:, None]], axis=1)
-
-        from ..core import random as prandom
-        rng = prandom.next_key("gen") if temperature > 0 else \
-            jax.random.key(0)
-        loop = self._decode_loop_fn(max_new_tokens - 1, float(temperature))
-        lens = jnp.full((b,), prompt_len, jnp.int32)
-        toks, _ = loop(params, tok, caches, lens, rng)
-        return jnp.concatenate([input_ids, tok[:, None], toks], axis=1)
+    def _cache_supported(self) -> bool:
+        return (self.cfg.pipeline_stages == 1
+                and getattr(type(self.model).decoder_layer_cls,
+                            "supports_cache", False))
 
 
 LlamaModel.decoder_layer_cls = LlamaDecoderLayer

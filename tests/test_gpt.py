@@ -120,3 +120,21 @@ class TestGPT:
         from paddle_tpu.models.gpt import PRESETS
         cfg = PRESETS["gpt3-13b"]
         assert cfg.hidden_size == 5120 and cfg.num_hidden_layers == 40
+
+
+class TestGPTCachedGeneration:
+    def test_cached_equals_recompute(self):
+        pt.seed(0)
+        m = gpt("tiny").eval()
+        ids = jnp.asarray(np.random.default_rng(5).integers(
+            0, 256, (2, 5)).astype("int32"))
+        a = np.asarray(m.generate(ids, max_new_tokens=6, use_cache=False))
+        b = np.asarray(m.generate(ids, max_new_tokens=6, use_cache=True))
+        np.testing.assert_array_equal(a, b)
+
+    def test_cache_respects_position_table(self):
+        import pytest
+        pt.seed(0)
+        m = gpt("tiny")  # max_position_embeddings=128
+        with pytest.raises(ValueError, match="max_position"):
+            m.model.init_cache(1, 256)

@@ -206,3 +206,13 @@ class TestCachedGeneration:
                               pipeline_stages=2))
         with pytest.raises(NotImplementedError):
             m.model.init_cache(1, 16)
+
+    def test_moe_init_cache_rejected_cleanly(self):
+        import pytest
+        import paddle_tpu as pt
+        from paddle_tpu.models.mixtral import mixtral
+
+        pt.seed(0)
+        m = mixtral("tiny")
+        with pytest.raises(NotImplementedError, match="KV caches"):
+            m.model.init_cache(1, 16)
