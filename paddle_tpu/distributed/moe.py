@@ -79,16 +79,25 @@ class NaiveGate(Layer):
     def __init__(self, d_model: int, num_experts: int,
                  capacity_factor: float = 1.25,
                  eval_capacity_factor: Optional[float] = None):
+        # eval_capacity_factor None (default) → dropless eval routing; set
+        # it explicitly to cap eval capacity like training
         super().__init__()
         self.num_experts = num_experts
         self.capacity_factor = capacity_factor
-        self.eval_capacity_factor = eval_capacity_factor or capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor  # None = dropless
         self.weight = self.create_parameter(
             (d_model, num_experts),
             default_initializer=lambda k, s, d: jax.random.uniform(
                 k, s, d, -1 / math.sqrt(d_model), 1 / math.sqrt(d_model)))
 
     def capacity(self, num_tokens: int) -> int:
+        if not self.training and self.eval_capacity_factor is None:
+            # eval default: DROPLESS routing. Inference must not drop
+            # tokens, and — critically for KV-cache serving — capacity from
+            # the per-call token count would make a one-token decode step
+            # route differently from the full-prefix recompute it must
+            # reproduce (the generate() greedy-identity contract).
+            return num_tokens
         f = self.capacity_factor if self.training else self.eval_capacity_factor
         return max(int(f * num_tokens * self.top_k / self.num_experts), 4)
 

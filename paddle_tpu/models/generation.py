@@ -30,6 +30,11 @@ def run_cached_layers(layers, x, caches, call):
     """Thread (x, per-layer cache) through the decoder stack, unwrapping
     RecomputeWrapper (remat is pointless for cached inference)."""
     from ..distributed.recompute import RecomputeWrapper
+    layers = list(layers)
+    if len(layers) != len(caches):
+        raise ValueError(
+            f"cache list has {len(caches)} entries for {len(layers)} "
+            f"decoder layers — was it built by a different config?")
     new_caches = []
     for layer, cache in zip(layers, caches):
         inner = layer.inner if isinstance(layer, RecomputeWrapper) else layer

@@ -186,7 +186,7 @@ class LlamaMLP(Layer):
 
 class LlamaDecoderLayer(Layer):
     returns_aux = False     # MoE variants return (x, aux_loss)
-    supports_cache = True   # MoE variants don't take cache= (yet)
+    supports_cache = True   # opt-in flag checked by init_cache/generate
 
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
@@ -252,7 +252,7 @@ class LlamaModel(Layer):
                        False):
             raise NotImplementedError(
                 f"{type(self).decoder_layer_cls.__name__} does not support "
-                "KV caches (MoE variants use the recompute generate path)")
+                "KV caches (generate() falls back to full recompute)")
         from .generation import make_dense_caches
         return make_dense_caches(
             cfg.num_hidden_layers, batch, max_len,

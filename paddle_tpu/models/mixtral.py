@@ -41,7 +41,8 @@ PRESETS = {
 
 
 class MixtralDecoderLayer(Layer):
-    returns_aux = True  # forward returns (x, router_aux_loss)
+    returns_aux = True      # train forward returns (x, router_aux_loss)
+    supports_cache = True   # cached inference (router aux ignored)
 
     def __init__(self, cfg: MixtralConfig):
         super().__init__()
@@ -53,7 +54,18 @@ class MixtralDecoderLayer(Layer):
             num_experts=cfg.num_experts, gate=cfg.gate, top_k=cfg.top_k,
             capacity_factor=cfg.capacity_factor)
 
-    def forward(self, x, cos, sin, attn_mask=None):
+    def forward(self, x, cos, sin, attn_mask=None, cache=None,
+                seq_lens=None):
+        if cache is not None:
+            # cached inference: attention uses the KV cache; the MoE block
+            # is per-token so it works unchanged (router aux is an
+            # inference no-op)
+            attn, cache = self.self_attn(self.input_layernorm(x), cos, sin,
+                                         attn_mask, cache=cache,
+                                         seq_lens=seq_lens)
+            x = x + attn
+            x = x + self.block_sparse_moe(self.post_attention_layernorm(x))
+            return x, cache
         x = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_mask)
         x = x + self.block_sparse_moe(self.post_attention_layernorm(x))
         # aux read immediately after the call, same trace level (the

@@ -212,3 +212,22 @@ def test_top_k_validation():
     with pytest.raises(ValueError):
         MoELayer(8, lambda: nn.Linear(8, 8), num_experts=2, gate="gshard",
                  top_k=1)
+
+
+class TestEvalDroplessRouting:
+    def test_eval_capacity_is_dropless_by_default(self):
+        from paddle_tpu.distributed.moe import NaiveGate
+        import paddle_tpu as pt
+        pt.seed(0)
+        g = NaiveGate(8, num_experts=4, capacity_factor=1.25)
+        g.eval()
+        assert g.capacity(100) == 100   # dropless: every token fits anywhere
+        g.train()
+        assert g.capacity(100) == max(int(1.25 * 100 * 2 / 4), 4)  # capped
+
+    def test_eval_factor_override_still_caps(self):
+        from paddle_tpu.distributed.moe import NaiveGate
+        g = NaiveGate(8, num_experts=4, capacity_factor=1.25,
+                      eval_capacity_factor=1.0)
+        g.eval()
+        assert g.capacity(100) == int(1.0 * 100 * 2 / 4)
