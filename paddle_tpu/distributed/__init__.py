@@ -39,6 +39,7 @@ from .cp import (ring_attention, ulysses_attention,  # noqa: F401
                  context_parallel_attention)
 from .spawn import spawn  # noqa: F401
 from . import rpc  # noqa: F401
+from . import stream  # noqa: F401
 
 # paddle.distributed.save_state_dict / load_state_dict parity (reference:
 # python/paddle/distributed/checkpoint/) — implemented in paddle_tpu.ckpt

@@ -1,0 +1,48 @@
+"""``paddle.distributed.stream`` parity surface.
+
+Reference: python/paddle/distributed/communication/stream/ — collective
+variants taking an explicit comm stream (``sync_op``/``use_calc_stream``)
+for manual comm/compute overlap on CUDA.
+
+TPU redesign: XLA's latency-hiding scheduler owns stream placement — there
+is no user-visible comm stream to select, and overlap happens by compiler
+scheduling (SURVEY §5.8). These wrappers accept and ignore the stream
+knobs so reference training scripts port unchanged; semantics equal the
+plain collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+from . import communication as _comm
+
+
+def _stream_variant(fn):
+    # In the reference these knobs are the TRAILING positional-or-keyword
+    # params; drop them however they're passed (extra trailing positionals
+    # included) so ported call sites work verbatim.
+    n_pos = len([p for p in inspect.signature(fn).parameters.values()
+                 if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)])
+
+    @functools.wraps(fn)
+    def wrapper(*args, sync_op=True, use_calc_stream=False, **kwargs):
+        del sync_op, use_calc_stream  # XLA schedules streams (see module doc)
+        if len(args) > n_pos:
+            args = args[:n_pos]   # trailing stream knobs passed positionally
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
+all_reduce = _stream_variant(_comm.all_reduce)
+all_gather = _stream_variant(_comm.all_gather)
+reduce_scatter = _stream_variant(_comm.reduce_scatter)
+alltoall = _stream_variant(_comm.alltoall)
+alltoall_single = _stream_variant(_comm.alltoall_single)
+broadcast = _stream_variant(_comm.broadcast)
+reduce = _stream_variant(_comm.reduce)
+scatter = _stream_variant(_comm.scatter)
+send = _stream_variant(_comm.send)
+recv = _stream_variant(_comm.recv)

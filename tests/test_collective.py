@@ -165,3 +165,17 @@ class TestMultiSliceMeshLayout:
         topo = HybridTopology(dp_degree=4, mp_degree=2)
         mesh = topo.build_mesh(jax.devices()[:8])
         assert mesh.shape["dp"] == 4 and mesh.shape["mp"] == 2
+
+
+class TestStreamVariants:
+    def test_stream_aliases_accept_reference_knobs(self):
+        import jax.numpy as jnp
+        from paddle_tpu.distributed import ReduceOp, stream
+
+        t = jnp.ones((4,))
+        a = stream.all_reduce(t, sync_op=True, use_calc_stream=True)
+        # positional trailing knobs (paddle reference call shape) tolerated
+        b = stream.all_reduce(t, ReduceOp.SUM, None, True, True)
+        # both variants equal the plain collective (sum over world size 8)
+        np.testing.assert_allclose(np.asarray(a), np.full(4, 8.0))
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a))
