@@ -16,7 +16,8 @@ from .topology import AXIS_ORDER, HybridCommunicateGroup, HybridTopology  # noqa
 from .communication import (ReduceOp, Group, new_group, all_reduce,  # noqa: F401
                             all_gather, reduce_scatter, alltoall,
                             alltoall_single, broadcast, reduce, scatter,
-                            send, recv, p2p_shift, barrier, get_rank,
+                            send, recv, isend, irecv, P2POp, P2PTask,
+                            batch_isend_irecv, p2p_shift, barrier, get_rank,
                             get_world_size, is_initialized,
                             init_parallel_env)
 from .mp_layers import (ColumnParallelLinear, RowParallelLinear,  # noqa: F401
@@ -39,6 +40,7 @@ from .cp import (ring_attention, ulysses_attention,  # noqa: F401
                  context_parallel_attention)
 from .spawn import spawn  # noqa: F401
 from . import rpc  # noqa: F401
+from . import ps  # noqa: F401
 from . import stream  # noqa: F401
 
 # paddle.distributed.save_state_dict / load_state_dict parity (reference:

@@ -277,6 +277,107 @@ def p2p_shift(tensor, offset=1, axis="pp"):
     return jax.lax.ppermute(tensor, axis, perm)
 
 
+def isend(tensor, dst=None, group=None):
+    """Marker for ``P2POp``/``batch_isend_irecv`` (reference:
+    paddle.distributed.isend). Standalone use has no SPMD meaning — batch
+    matched pairs instead."""
+    raise NotImplementedError(
+        "use P2POp(isend, t, peer_offset=k) + batch_isend_irecv([...]); "
+        "a lone isend has no SPMD analogue")
+
+
+def irecv(tensor=None, src=None, group=None):
+    """Marker for ``P2POp``/``batch_isend_irecv`` (reference irecv)."""
+    raise NotImplementedError(
+        "use P2POp(irecv, buf, peer_offset=-k) + batch_isend_irecv([...])")
+
+
+class P2POp:
+    """One half of a matched P2P exchange (reference:
+    paddle.distributed.P2POp(op, tensor, peer) in batch_isend_irecv.py).
+
+    SPMD deviation, documented: peers are **relative ring offsets**
+    (``peer_offset=+1`` = next rank on the axis), not absolute ranks —
+    under one traced program every rank runs the same op list, so the
+    pattern must be rank-uniform, which is exactly how the reference's
+    pipeline p2p layer uses the API (send next / recv prev).
+    """
+
+    def __init__(self, op, tensor, peer_offset=None, group=None, peer=None):
+        if op not in (isend, irecv):
+            raise ValueError("op must be distributed.isend or distributed.irecv")
+        if peer_offset is None:
+            raise ValueError(
+                "SPMD P2POp needs peer_offset=(peer_rank - my_rank) mod n; "
+                "absolute `peer` ranks are not resolvable inside one traced "
+                "program")
+        self.op, self.tensor, self.group = op, tensor, group
+        self.peer_offset = int(peer_offset)
+
+
+class P2PTask:
+    """Completed-exchange handle (reference returns async tasks; XLA
+    schedules the collective, so wait() just hands back the result)."""
+
+    def __init__(self, result):
+        self.result = result
+
+    def wait(self):
+        return self.result
+
+
+def batch_isend_irecv(op_list):
+    """Execute matched isend/irecv pairs as ppermutes (reference:
+    batch_isend_irecv → ncclGroupStart/End batched send/recv).
+
+    Every ``irecv`` with ``peer_offset=-k`` is fulfilled by the ``isend``
+    with ``peer_offset=+k`` (same |offset| = one ring ppermute, which is
+    how XLA expresses the batched NCCL pair). Returns one ``P2PTask`` per
+    op in order: isend tasks carry None, irecv tasks carry the received
+    tensor.
+    """
+    def _gkey(op):
+        axes = _axis_tuple(op.group)
+        return axes if axes is not None else ("pp",)
+
+    sends = {}
+    for op in op_list:
+        if op.op is isend:
+            key = (_gkey(op), op.peer_offset)
+            if key in sends:
+                raise ValueError(
+                    f"duplicate isend offset {op.peer_offset} on group "
+                    f"axes {key[0]}")
+            sends[key] = op
+    matched = set()
+    tasks = []
+    for op in op_list:
+        if op.op is isend:
+            tasks.append(P2PTask(None))
+            continue
+        k = -op.peer_offset  # recv-from -k pairs with send-to +k
+        key = (_gkey(op), k)
+        src = sends.get(key)
+        if src is None:
+            raise ValueError(
+                f"irecv(peer_offset={op.peer_offset}) has no matching "
+                f"isend(peer_offset={k}) on group axes {key[0]}")
+        matched.add(key)
+        a = key[0][0]
+        if _axis_bound(key[0]):
+            tasks.append(P2PTask(p2p_shift(src.tensor, k, a)))
+        else:
+            # eager on global arrays: dim 0 is the rank dim (same
+            # convention as scatter's eager path) — ring shift = roll
+            tasks.append(P2PTask(jnp.roll(src.tensor, k, axis=0)))
+    unmatched = set(sends) - matched
+    if unmatched:
+        raise ValueError(
+            "isend ops with no matching irecv in the batch (the send "
+            f"would silently vanish): {sorted((g, o) for g, o in unmatched)}")
+    return tasks
+
+
 def barrier(group=None):
     jax.block_until_ready(jnp.zeros(()))
 

@@ -51,15 +51,31 @@ class DistributedStrategy:
         return cls(**json.loads(s))
 
 
-def init(is_collective: bool = True, strategy: Optional[DistributedStrategy] = None,
-         devices=None) -> HybridCommunicateGroup:
+_PS_RUNTIME = None  # non-collective (parameter-server) mode state
+
+
+def init(role_maker=None, is_collective: bool = True,
+         strategy: Optional[DistributedStrategy] = None,
+         devices=None):
     """Build the global topology/mesh (reference: Fleet.init → topology 3.2).
 
     No rendezvous/NCCL init is needed; multi-host process bootstrap is
     ``paddle_tpu.distributed.init_parallel_env`` →
     ``jax.distributed.initialize``.
+
+    Passing a ``ps.PaddleCloudRoleMaker`` (or ``is_collective=False``)
+    selects parameter-server mode (reference: fleet.init(role) →
+    init_server/run_server/init_worker flow, SURVEY §2.5); the returned
+    object is then a ``ps.PsRuntime`` configured later via
+    ``fleet.set_ps_tables(configs)``.
     """
-    global _HYBRID_PARALLEL_GROUP
+    global _HYBRID_PARALLEL_GROUP, _PS_RUNTIME
+    from ..ps import PaddleCloudRoleMaker, PsRuntime
+    if isinstance(role_maker, PaddleCloudRoleMaker) or (
+            role_maker is None and not is_collective):
+        role = role_maker or PaddleCloudRoleMaker()
+        _PS_RUNTIME = PsRuntime(role, configs=[])
+        return _PS_RUNTIME
     strategy = strategy or DistributedStrategy()
     topo = HybridTopology.from_hybrid_configs(strategy.hybrid_configs)
     n = len(devices) if devices is not None else jax.device_count()
@@ -76,9 +92,51 @@ def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
     return _HYBRID_PARALLEL_GROUP
 
 
+def set_ps_tables(configs, master_endpoint=None):
+    """Declare the PS tables (reference: table config in the strategy
+    proto). Must run before init_server/init_worker."""
+    if _PS_RUNTIME is None:
+        raise RuntimeError("fleet.init(role_maker, is_collective=False) first")
+    _PS_RUNTIME.configs = list(configs)
+    if master_endpoint:
+        _PS_RUNTIME.master_endpoint = master_endpoint
+    return _PS_RUNTIME
+
+
+def _ps() :
+    if _PS_RUNTIME is None:
+        raise RuntimeError("not in parameter-server mode")
+    return _PS_RUNTIME
+
+
+def is_server() -> bool:
+    return _PS_RUNTIME is not None and _PS_RUNTIME.role.is_server()
+
+
+def is_worker() -> bool:
+    return _PS_RUNTIME is not None and _PS_RUNTIME.role.is_worker()
+
+
+def init_server():
+    _ps().init_server()
+
+
+def run_server():
+    _ps().run_server()
+
+
+def init_worker():
+    _ps().init_worker()
+
+
+def stop_worker():
+    _ps().stop_worker()
+
+
 def _reset():  # test helper
-    global _HYBRID_PARALLEL_GROUP
+    global _HYBRID_PARALLEL_GROUP, _PS_RUNTIME
     _HYBRID_PARALLEL_GROUP = None
+    _PS_RUNTIME = None
 
 
 def distributed_model(model):

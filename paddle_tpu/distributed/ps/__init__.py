@@ -1,0 +1,225 @@
+"""Parameter-server mode (``paddle.distributed.ps`` / fleet PS parity).
+
+Reference: paddle/fluid/distributed/ps/ (BrpcPsServer/Client, Table
+hierarchy, geo-async SGD), python/paddle/distributed/fleet — the
+non-collective role flow: ``PaddleCloudRoleMaker`` → ``fleet.init(role)``
+→ servers ``init_server()/run_server()``, trainers ``init_worker()`` …
+``stop_worker()`` (SURVEY §2.5 "Parameter server", §3.5 env protocol).
+
+TPU redesign: the PS exists for sparse state larger than HBM
+(recommendation embeddings). Servers are plain CPU processes hosting
+numpy tables behind the framework's control-plane RPC; trainers pull a
+batch's working-set of rows (host-side), run the *dense* compute on the
+TPU as one jitted step, then push row gradients back. Geo-async mirrors
+the reference's geo-SGD: trainers update a local replica and ship
+parameter deltas every ``geo_step`` steps. brpc/heter-PS's GPU-cache has
+no TPU analogue worth building — the pull/compute/push split already puts
+the dense math on the accelerator.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .table import DenseTable, SparseAccessor, SparseTable
+from .service import PsClient, PsService, TableConfig, _install_service, _svc_call
+
+__all__ = [
+    "DenseTable", "SparseTable", "SparseAccessor", "TableConfig",
+    "PsService", "PsClient", "PaddleCloudRoleMaker", "PsRuntime",
+    "DistributedEmbedding", "GeoWorkerTable",
+]
+
+
+class PaddleCloudRoleMaker:
+    """Role/topology from the reference's env protocol
+    (``PADDLE_TRAINING_ROLE``, ``PADDLE_PSERVERS_IP_PORT_LIST``,
+    ``PADDLE_TRAINERS_NUM``, ``PADDLE_TRAINER_ID``, ``POD_IP``,
+    ``PADDLE_PORT``) — reference: fleet/base/role_maker.py [SURVEY §3.2]."""
+
+    def __init__(self, is_collective: bool = False, env: Optional[dict] = None):
+        e = os.environ if env is None else env
+        self.is_collective = is_collective
+        role = e.get("PADDLE_TRAINING_ROLE", "TRAINER").upper()
+        self._is_server = role == "PSERVER"
+        self.server_endpoints: List[str] = [
+            p for p in e.get("PADDLE_PSERVERS_IP_PORT_LIST", "").split(",") if p]
+        self.trainer_num = int(e.get("PADDLE_TRAINERS_NUM", "1"))
+        self.trainer_id = int(e.get("PADDLE_TRAINER_ID", "0"))
+        if self._is_server:
+            me = f"{e.get('POD_IP', '127.0.0.1')}:{e.get('PADDLE_PORT', '0')}"
+            self.server_id = (self.server_endpoints.index(me)
+                              if me in self.server_endpoints else 0)
+        else:
+            self.server_id = -1
+
+    def is_server(self) -> bool:
+        return self._is_server
+
+    def is_worker(self) -> bool:
+        return not self._is_server
+
+    def worker_index(self) -> int:
+        return self.trainer_id
+
+    def worker_num(self) -> int:
+        return self.trainer_num
+
+    def server_num(self) -> int:
+        return len(self.server_endpoints) or 1
+
+
+class PsRuntime:
+    """Orchestrates one PS job. Two transports:
+
+    - ``local``: every server lives in-process (tests, single-host) —
+      ``PsRuntime.local(configs, num_servers)``.
+    - rpc: each process calls ``init_server()/run_server()`` or
+      ``init_worker()`` per its role, discovery rides the rpc name table
+      (servers register as ``ps0..psN-1``).
+    """
+
+    def __init__(self, role: PaddleCloudRoleMaker,
+                 configs: Sequence[TableConfig],
+                 master_endpoint: Optional[str] = None):
+        self.role = role
+        self.configs = list(configs)
+        self.master_endpoint = master_endpoint
+        self.client: Optional[PsClient] = None
+        self._service: Optional[PsService] = None
+        self._stop = threading.Event()
+
+    # ---- local transport --------------------------------------------
+    @classmethod
+    def local(cls, configs: Sequence[TableConfig], num_servers: int = 1):
+        rt = cls(PaddleCloudRoleMaker(env={}), configs)
+        rt.client = PsClient([PsService(configs, i) for i in range(num_servers)])
+        return rt
+
+    # ---- rpc transport ----------------------------------------------
+    def _world(self) -> int:
+        return self.role.server_num() + self.role.worker_num()
+
+    def _rpc_init(self, name: str, rank: int):
+        from .. import rpc
+        rpc.init_rpc(name, rank=rank, world_size=self._world(),
+                     master_endpoint=self.master_endpoint)
+
+    def init_server(self) -> None:
+        from . import service as _service_mod
+        self._service = PsService(self.configs, self.role.server_id)
+        _install_service(self._service)
+        _service_mod._RUNTIME_STOP = self._stop
+        self._rpc_init(f"ps{self.role.server_id}", self.role.server_id)
+
+    def run_server(self) -> None:
+        """Serve until a trainer's stop_worker (or local shutdown)
+        releases us (reference: run_server blocks until stop_server)."""
+        if self._service is None:
+            self.init_server()
+        self._stop.wait()
+        from .. import rpc
+        rpc.shutdown()
+
+    def init_worker(self) -> None:
+        rank = self.role.server_num() + self.role.worker_index()
+        self._rpc_init(f"trainer{self.role.worker_index()}", rank)
+        self.client = PsClient([f"ps{i}" for i in range(self.role.server_num())])
+
+    def stop_worker(self) -> None:
+        """Reference flow: trainer 0's stop also releases the servers."""
+        from .. import rpc
+        from .service import _stop_service
+        if self.role.worker_index() == 0 and self.client is not None \
+                and not self.client.local:
+            for name in self.client.servers:
+                try:
+                    rpc.rpc_sync(name, _stop_service)
+                except Exception:
+                    pass  # server already gone
+        rpc.shutdown()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        from .. import rpc
+        rpc.shutdown()
+
+
+class GeoWorkerTable:
+    """Geo-async trainer-side replica of one sparse table (reference
+    geo-SGD: train on a local copy, ship parameter deltas every
+    ``geo_step`` pushes, absorb the server's merged state on pull)."""
+
+    def __init__(self, client: PsClient, name: str, dim: int,
+                 accessor: Optional[SparseAccessor] = None,
+                 geo_step: int = 8, initializer=None, seed: int = 0):
+        self.client, self.name, self.geo_step = client, name, int(geo_step)
+        self.local = SparseTable(name, dim, accessor, initializer, seed)
+        self._pushes = 0
+
+    def pull(self, keys) -> np.ndarray:
+        """Sync with the server's merged view: local row becomes
+        server_row + (pending unsent local delta). Other workers'
+        contributions are thus absorbed on every pull while in-flight
+        local progress is preserved (reference geo-SGD pull path)."""
+        keys = np.asarray(keys, np.int64).ravel()
+        uniq = np.fromiter(dict.fromkeys(keys.tolist()), np.int64)
+        rows = self.client.pull_sparse(self.name, uniq)
+        with self.local.lock:
+            for k, server_row in zip(uniq.tolist(), rows):
+                local = self.local.rows.get(k)
+                base = self.local._geo_base.get(k)
+                pending = (local - base) if (local is not None
+                                             and base is not None) else 0.0
+                merged = server_row + pending
+                self.local.rows[k] = merged
+                if base is not None:
+                    self.local._geo_base[k] = server_row.copy()
+        return self.local.pull(keys)
+
+    def push(self, keys, grads) -> None:
+        self.local.push(keys, grads, geo_track=True)
+        self._pushes += 1
+        if self._pushes % self.geo_step == 0:
+            dk, dv = self.local.pop_geo_deltas()
+            if dk.size:
+                self.client.push_sparse_delta(self.name, dk, dv)
+
+
+class DistributedEmbedding:
+    """Sparse-embedding front half of a PS model
+    (reference: ``paddle.static.nn.sparse_embedding`` /
+    ``fleet.embedding`` routed to pull_sparse/push_sparse).
+
+    TPU usage pattern: ``pull(ids)`` host-side (input pipeline), feed the
+    dense rows into the jitted step as an ordinary array, take
+    ``d_rows`` out of the step's grads, then ``push(ids, d_rows)``.
+    Duplicate ids within a batch are pulled once and their gradients
+    summed before pushing, matching dense-embedding autograd semantics.
+    """
+
+    def __init__(self, client_or_runtime, name: str, dim: int):
+        rt = client_or_runtime
+        self.client = rt.client if isinstance(rt, PsRuntime) else rt
+        if self.client is None:
+            raise RuntimeError("runtime has no client (server role?)")
+        self.name, self.dim = name, int(dim)
+
+    def pull(self, ids):
+        """→ (unique_rows [n,dim] float32, inverse [ids.shape] int32):
+        ``rows[inverse]`` reconstructs per-position embeddings on device."""
+        ids = np.asarray(ids, np.int64)
+        uniq, inverse = np.unique(ids, return_inverse=True)
+        rows = self.client.pull_sparse(self.name, uniq)
+        self._last = (uniq, ids.shape)
+        return rows, inverse.reshape(ids.shape).astype(np.int32)
+
+    def push(self, d_rows) -> None:
+        """Push gradients w.r.t. the unique rows of the last pull."""
+        uniq, _ = self._last
+        self.client.push_sparse(self.name, uniq,
+                                np.asarray(d_rows, np.float32))

@@ -1,0 +1,201 @@
+"""PS service + client: key-sharded push/pull across servers.
+
+Reference: paddle/fluid/distributed/ps/service/ — ``BrpcPsServer`` /
+``BrpcPsClient`` (push_dense/pull_dense/push_sparse/pull_sparse RPCs,
+rows sharded over servers by key hash), SURVEY §2.5.
+
+TPU redesign: brpc → the framework's own control-plane RPC
+(``paddle_tpu.distributed.rpc``); one ``PsService`` object per server
+process hosts the tables, trainers talk through ``PsClient`` which shards
+keys by ``key % num_servers`` (the reference's default hash) and merges
+results. A ``local`` transport (direct object calls) serves single-process
+mode and tests; the wire transport rides rpc_sync to named ps workers.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import zlib
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .table import DenseTable, SparseAccessor, SparseTable
+
+__all__ = ["TableConfig", "PsService", "PsClient"]
+
+
+class TableConfig:
+    """Declarative table spec shared by every server and client
+    (reference: the ps table proto in DistributedStrategy)."""
+
+    def __init__(self, name: str, kind: str = "sparse", dim: int = 8,
+                 shape=None, rule: str = "sgd", lr: float = 0.01,
+                 initializer=None, seed: int = 0, **accessor_kw):
+        if kind not in ("sparse", "dense"):
+            raise ValueError("kind must be 'sparse' or 'dense'")
+        self.name, self.kind, self.dim = name, kind, int(dim)
+        self.shape = tuple(shape) if shape is not None else None
+        self.rule, self.lr = rule, float(lr)
+        self.initializer, self.seed = initializer, int(seed)
+        self.accessor_kw = accessor_kw
+
+    def build(self):
+        acc = SparseAccessor(self.rule, lr=self.lr, **self.accessor_kw)
+        if self.kind == "dense":
+            if self.shape is None:
+                raise ValueError(f"dense table {self.name!r} needs shape=")
+            return DenseTable(self.name, self.shape, acc,
+                              self.initializer, self.seed)
+        return SparseTable(self.name, self.dim, acc,
+                           self.initializer, self.seed)
+
+
+class PsService:
+    """Server-side table host. Methods are the RPC surface."""
+
+    def __init__(self, configs: Sequence[TableConfig], server_rank: int = 0):
+        self.server_rank = server_rank
+        self.tables: Dict[str, object] = {c.name: c.build() for c in configs}
+
+    def _sparse(self, name) -> SparseTable:
+        t = self.tables[name]
+        if not isinstance(t, SparseTable):
+            raise TypeError(f"table {name!r} is not sparse")
+        return t
+
+    def _dense(self, name) -> DenseTable:
+        t = self.tables[name]
+        if not isinstance(t, DenseTable):
+            raise TypeError(f"table {name!r} is not dense")
+        return t
+
+    # ---- RPC surface -------------------------------------------------
+    def pull_dense(self, name):
+        return self._dense(name).pull()
+
+    def push_dense(self, name, grad):
+        self._dense(name).push(grad)
+
+    def pull_sparse(self, name, keys):
+        return self._sparse(name).pull(keys)
+
+    def push_sparse(self, name, keys, grads):
+        self._sparse(name).push(keys, grads)
+
+    def push_sparse_delta(self, name, keys, deltas):
+        self._sparse(name).push_delta(keys, deltas)
+
+    def state_dict(self):
+        return {n: t.state_dict() for n, t in self.tables.items()}
+
+    def load_state_dict(self, state):
+        for n, s in state.items():
+            self.tables[n].load_state_dict(s)
+
+
+# module-level dispatcher so the rpc layer (pickle-by-name callables) can
+# reach the per-process service instance
+_SERVICE: Optional[PsService] = None
+
+
+def _install_service(svc: PsService) -> None:
+    global _SERVICE
+    _SERVICE = svc
+
+
+def _svc_call(method: str, *args):
+    if _SERVICE is None:
+        raise RuntimeError("no PsService running in this process "
+                           "(call fleet.init_server / run_server first)")
+    return getattr(_SERVICE, method)(*args)
+
+
+# set by PsRuntime.init_server so a trainer's stop request (rpc'd to this
+# process) can release run_server()'s wait
+_RUNTIME_STOP = None
+
+
+def _stop_service():
+    if _RUNTIME_STOP is not None:
+        _RUNTIME_STOP.set()
+
+
+class PsClient:
+    """Trainer-side handle. ``servers`` is either a list of ``PsService``
+    objects (local transport) or a list of rpc worker names (wire
+    transport over ``paddle_tpu.distributed.rpc``)."""
+
+    def __init__(self, servers: Sequence):
+        if not servers:
+            raise ValueError("need at least one server")
+        self.servers = list(servers)
+        self.local = isinstance(self.servers[0], PsService)
+        # wire transport: fan shard requests out concurrently (reference:
+        # brpc client issues per-shard requests in parallel)
+        self._pool = None if self.local else cf.ThreadPoolExecutor(
+            max_workers=min(16, len(self.servers)),
+            thread_name_prefix="pdtpu-ps")
+
+    def _call(self, idx: int, method: str, *args):
+        if self.local:
+            return getattr(self.servers[idx], method)(*args)
+        from .. import rpc
+        return rpc.rpc_sync(self.servers[idx], _svc_call, args=(method,) + args)
+
+    def _scatter_calls(self, calls):
+        """[(server_idx, method, args)] → results, concurrently when remote."""
+        if self._pool is None:
+            return [self._call(i, m, *a) for i, m, a in calls]
+        futs = [self._pool.submit(self._call, i, m, *a) for i, m, a in calls]
+        return [f.result() for f in futs]
+
+    # dense tables are hosted on one server picked by stable name hash
+    # (process-salted builtin hash would fork the table across processes)
+    def _dense_home(self, name: str) -> int:
+        return zlib.crc32(name.encode()) % len(self.servers)
+
+    def pull_dense(self, name: str) -> np.ndarray:
+        return self._call(self._dense_home(name), "pull_dense", name)
+
+    def push_dense(self, name: str, grad) -> None:
+        self._call(self._dense_home(name), "push_dense", name,
+                   np.asarray(grad, np.float32))
+
+    def _shard(self, keys):
+        keys = np.asarray(keys, np.int64).ravel()
+        owner = keys % len(self.servers)
+        return keys, owner
+
+    def pull_sparse(self, name: str, keys) -> np.ndarray:
+        keys, owner = self._shard(keys)
+        shards = [(s, np.nonzero(owner == s)[0])
+                  for s in range(len(self.servers))]
+        shards = [(s, idx) for s, idx in shards if idx.size]
+        if not shards:  # zero keys
+            dim = self._call(0, "pull_sparse", name,
+                             np.zeros(0, np.int64)).shape[-1]
+            return np.zeros((0, dim), np.float32)
+        results = self._scatter_calls(
+            [(s, "pull_sparse", (name, keys[idx])) for s, idx in shards])
+        out = np.empty((keys.size, results[0].shape[1]), np.float32)
+        for (s, idx), rows in zip(shards, results):
+            out[idx] = rows
+        return out
+
+    def push_sparse(self, name: str, keys, grads) -> None:
+        self._push(name, keys, grads, "push_sparse")
+
+    def push_sparse_delta(self, name: str, keys, deltas) -> None:
+        """Geo-async upstream merge (reference geo-SGD)."""
+        self._push(name, keys, deltas, "push_sparse_delta")
+
+    def _push(self, name, keys, values, method):
+        keys, owner = self._shard(keys)
+        values = np.asarray(values, np.float32).reshape(keys.size, -1)
+        calls = []
+        for s in range(len(self.servers)):
+            idx = np.nonzero(owner == s)[0]
+            if idx.size:
+                calls.append((s, method, (name, keys[idx], values[idx])))
+        self._scatter_calls(calls)

@@ -1,0 +1,227 @@
+"""Parameter-server tables: dense + sparse, host-resident.
+
+Reference: paddle/fluid/distributed/ps/table/ — ``Table`` hierarchy
+(``MemoryDenseTable``, ``MemorySparseTable``) with pluggable accessors
+(sparse SGD/AdaGrad/Adam rules), geo-async delta tracking
+(SURVEY §2.5 "Parameter server" row).
+
+TPU redesign: tables are host-RAM numpy state (the reference keeps them in
+server CPU memory too — this part of Paddle never touched the GPU except
+via heter-PS caching). Device compute stays dense jax; the PS exists so
+embedding tables far larger than HBM can live on host/parameter servers
+while pulled working-sets ride to the TPU as ordinary dense inputs. No
+kernel work belongs here, so numpy (not jnp) is deliberate: rows are
+mutated in place, which XLA arrays cannot do.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["SparseAccessor", "DenseTable", "SparseTable"]
+
+
+class SparseAccessor:
+    """Per-row update rule (reference: sparse accessor configs naming
+    ``sgd``/``adagrad``/``adam`` in table proto)."""
+
+    RULES = ("sgd", "adagrad", "adam")
+
+    def __init__(self, rule: str = "sgd", lr: float = 0.01,
+                 beta1: float = 0.9, beta2: float = 0.999, eps: float = 1e-8):
+        if rule not in self.RULES:
+            raise ValueError(f"unknown accessor rule {rule!r}; one of {self.RULES}")
+        self.rule = rule
+        self.lr = float(lr)
+        self.beta1, self.beta2, self.eps = float(beta1), float(beta2), float(eps)
+
+    def slot_count(self) -> int:
+        return {"sgd": 0, "adagrad": 1, "adam": 2}[self.rule]
+
+    def apply(self, param: np.ndarray, grad: np.ndarray,
+              slots: Optional[np.ndarray], step: int) -> None:
+        """In-place update of ``param`` (and ``slots``) given ``grad``."""
+        if self.rule == "sgd":
+            param -= self.lr * grad
+        elif self.rule == "adagrad":
+            g2 = slots[0]
+            g2 += grad * grad
+            param -= self.lr * grad / (np.sqrt(g2) + self.eps)
+        else:  # adam
+            m, v = slots[0], slots[1]
+            m *= self.beta1
+            m += (1 - self.beta1) * grad
+            v *= self.beta2
+            v += (1 - self.beta2) * grad * grad
+            mhat = m / (1 - self.beta1 ** step)
+            vhat = v / (1 - self.beta2 ** step)
+            param -= self.lr * mhat / (np.sqrt(vhat) + self.eps)
+
+
+class DenseTable:
+    """Replicated dense parameter block (reference: MemoryDenseTable —
+    summed worker grads applied server-side)."""
+
+    def __init__(self, name: str, shape, accessor: Optional[SparseAccessor] = None,
+                 initializer=None, seed: int = 0):
+        self.name = name
+        self.shape = tuple(int(s) for s in shape)
+        self.accessor = accessor or SparseAccessor("sgd", lr=0.01)
+        rng = np.random.default_rng(seed)
+        if initializer is None:
+            self.param = np.zeros(self.shape, np.float32)
+        else:
+            self.param = np.asarray(initializer(rng, self.shape), np.float32)
+        k = self.accessor.slot_count()
+        self.slots = np.zeros((k,) + self.shape, np.float32) if k else None
+        self.step = 0
+        self.lock = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self.lock:
+            return self.param.copy()
+
+    def push(self, grad: np.ndarray) -> None:
+        grad = np.asarray(grad, np.float32)
+        if grad.shape != self.shape:
+            raise ValueError(f"dense push shape {grad.shape} != {self.shape}")
+        with self.lock:
+            self.step += 1
+            self.accessor.apply(self.param, grad, self.slots, self.step)
+
+    def set(self, value: np.ndarray) -> None:
+        with self.lock:
+            self.param[...] = np.asarray(value, np.float32)
+
+    def state_dict(self):
+        with self.lock:
+            return {"param": self.param.copy(),
+                    "slots": None if self.slots is None else self.slots.copy(),
+                    "step": self.step}
+
+    def load_state_dict(self, state):
+        with self.lock:
+            self.param[...] = state["param"]
+            if self.slots is not None and state.get("slots") is not None:
+                self.slots[...] = state["slots"]
+            self.step = int(state.get("step", 0))
+
+
+class SparseTable:
+    """Hash-keyed embedding rows, lazily created on first pull
+    (reference: MemorySparseTable shards rows over servers; lazy init with
+    the table's initializer; geo-SGD keeps per-key deltas).
+
+    Thread-safe; rows are float32 ``dim``-vectors keyed by int64 ids.
+    """
+
+    def __init__(self, name: str, dim: int, accessor: Optional[SparseAccessor] = None,
+                 initializer=None, seed: int = 0):
+        self.name = name
+        self.dim = int(dim)
+        self.accessor = accessor or SparseAccessor("sgd", lr=0.01)
+        self._init = initializer
+        self._seed = int(seed)
+        self.rows: Dict[int, np.ndarray] = {}
+        self.slots: Dict[int, np.ndarray] = {}
+        self.steps: Dict[int, int] = {}
+        self.lock = threading.Lock()
+        # geo-async: per-key accumulated parameter deltas since last fetch
+        self._geo_base: Dict[int, np.ndarray] = {}
+
+    def _new_row(self, key: int) -> np.ndarray:
+        if self._init is None:
+            return np.zeros(self.dim, np.float32)
+        # deterministic per-key init so every server/replica agrees
+        rng = np.random.default_rng((self._seed * 0x9E3779B9 + key) & 0xFFFFFFFF)
+        return np.asarray(self._init(rng, (self.dim,)), np.float32)
+
+    def pull(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.asarray(keys, np.int64).ravel()
+        out = np.empty((keys.size, self.dim), np.float32)
+        with self.lock:
+            for i, k in enumerate(keys.tolist()):
+                row = self.rows.get(k)
+                if row is None:
+                    row = self._new_row(k)
+                    self.rows[k] = row
+                out[i] = row
+        return out
+
+    def push(self, keys: np.ndarray, grads: np.ndarray,
+             geo_track: bool = False) -> None:
+        keys = np.asarray(keys, np.int64).ravel()
+        grads = np.asarray(grads, np.float32).reshape(keys.size, self.dim)
+        k_slots = self.accessor.slot_count()
+        with self.lock:
+            for i, k in enumerate(keys.tolist()):
+                row = self.rows.get(k)
+                if row is None:
+                    row = self._new_row(k)
+                    self.rows[k] = row
+                if k_slots and k not in self.slots:
+                    self.slots[k] = np.zeros((k_slots, self.dim), np.float32)
+                if geo_track and k not in self._geo_base:
+                    self._geo_base[k] = row.copy()
+                self.steps[k] = self.steps.get(k, 0) + 1
+                self.accessor.apply(row, grads[i],
+                                    self.slots.get(k), self.steps[k])
+
+    def push_delta(self, keys: np.ndarray, deltas: np.ndarray) -> None:
+        """Geo-async merge: add raw parameter deltas (reference geo-SGD:
+        servers sum worker deltas rather than applying grads)."""
+        keys = np.asarray(keys, np.int64).ravel()
+        deltas = np.asarray(deltas, np.float32).reshape(keys.size, self.dim)
+        with self.lock:
+            for i, k in enumerate(keys.tolist()):
+                row = self.rows.get(k)
+                if row is None:
+                    row = self._new_row(k)
+                    self.rows[k] = row
+                row += deltas[i]
+
+    def pop_geo_deltas(self):
+        """Return and clear (keys, deltas) accumulated by geo-tracked
+        pushes — what a geo worker sends upstream."""
+        with self.lock:
+            keys = np.fromiter(self._geo_base.keys(), np.int64,
+                               len(self._geo_base))
+            deltas = np.stack([self.rows[k] - self._geo_base[k]
+                               for k in keys.tolist()]) if keys.size else \
+                np.zeros((0, self.dim), np.float32)
+            self._geo_base.clear()
+        return keys, deltas
+
+    def __len__(self):
+        with self.lock:
+            return len(self.rows)
+
+    def state_dict(self):
+        with self.lock:
+            keys = np.fromiter(self.rows.keys(), np.int64, len(self.rows))
+            klist = keys.tolist()
+            vals = (np.stack([self.rows[k] for k in klist])
+                    if keys.size else np.zeros((0, self.dim), np.float32))
+            n_slots = self.accessor.slot_count()
+            slots = (np.stack([self.slots.get(
+                k, np.zeros((n_slots, self.dim), np.float32)) for k in klist])
+                if keys.size and n_slots else None)
+            steps = np.asarray([self.steps.get(k, 0) for k in klist], np.int64)
+            return {"keys": keys, "values": vals, "slots": slots,
+                    "steps": steps}
+
+    def load_state_dict(self, state):
+        with self.lock:
+            self.rows = {int(k): np.asarray(v, np.float32).copy()
+                         for k, v in zip(state["keys"], state["values"])}
+            # stale accumulators from prior contents must not leak onto
+            # freshly loaded rows
+            self.slots, self.steps, self._geo_base = {}, {}, {}
+            if state.get("slots") is not None:
+                for k, s in zip(state["keys"], state["slots"]):
+                    self.slots[int(k)] = np.asarray(s, np.float32).copy()
+            for k, st in zip(state["keys"], state.get("steps", ())):
+                self.steps[int(k)] = int(st)

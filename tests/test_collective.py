@@ -179,3 +179,52 @@ class TestStreamVariants:
         # both variants equal the plain collective (sum over world size 8)
         np.testing.assert_allclose(np.asarray(a), np.full(4, 8.0))
         np.testing.assert_allclose(np.asarray(b), np.asarray(a))
+
+
+def test_batch_isend_irecv_ring(mesh8):
+    """Matched isend/irecv batch = one ppermute (reference
+    batch_isend_irecv semantics: send next / recv prev)."""
+    def body(_):
+        idx = jax.lax.axis_index("dp").astype(jnp.float32)
+        mine = jnp.full((2,), idx)
+        g = dist.new_group("dp")
+        ops = [dist.P2POp(dist.isend, mine, peer_offset=+1, group=g),
+               dist.P2POp(dist.irecv, None, peer_offset=-1, group=g)]
+        tasks = dist.batch_isend_irecv(ops)
+        assert tasks[0].wait() is None
+        return tasks[1].wait()
+
+    out = shard_map(body, mesh=mesh8.mesh, in_specs=P(),
+                    out_specs=P("dp"))(jnp.zeros(()))
+    np.testing.assert_allclose(np.asarray(out).reshape(4, 2)[:, 0],
+                               [3, 0, 1, 2])
+
+
+def test_batch_isend_irecv_validation(mesh8):
+    with pytest.raises(ValueError, match="no matching"):
+        dist.batch_isend_irecv(
+            [dist.P2POp(dist.irecv, None, peer_offset=-1)])
+    with pytest.raises(ValueError, match="no matching irecv"):
+        dist.batch_isend_irecv(
+            [dist.P2POp(dist.isend, jnp.zeros(2), peer_offset=+1)])
+    # same offset on different axes is legal (matched per group)
+    t = dist.batch_isend_irecv(
+        [dist.P2POp(dist.isend, jnp.arange(4.0)[:, None], peer_offset=+1,
+                    group=dist.new_group("dp")),
+         dist.P2POp(dist.irecv, None, peer_offset=-1,
+                    group=dist.new_group("dp")),
+         dist.P2POp(dist.isend, jnp.arange(2.0)[:, None], peer_offset=+1,
+                    group=dist.new_group("mp")),
+         dist.P2POp(dist.irecv, None, peer_offset=-1,
+                    group=dist.new_group("mp"))])
+    np.testing.assert_allclose(np.asarray(t[3].wait()).ravel(), [1, 0])
+    with pytest.raises(ValueError, match="peer_offset"):
+        dist.P2POp(dist.isend, jnp.zeros(2))
+    with pytest.raises(NotImplementedError):
+        dist.isend(jnp.zeros(2), dst=1)
+    # eager path: dim0 = rank dim, ring shift = roll
+    vals = jnp.arange(4.0)[:, None]
+    t = dist.batch_isend_irecv(
+        [dist.P2POp(dist.isend, vals, peer_offset=+1, group=dist.new_group("dp")),
+         dist.P2POp(dist.irecv, None, peer_offset=-1, group=dist.new_group("dp"))])
+    np.testing.assert_allclose(np.asarray(t[1].wait()).ravel(), [3, 0, 1, 2])

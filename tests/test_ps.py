@@ -1,0 +1,314 @@
+"""Parameter-server tests (SURVEY §2.5 'Parameter server' row).
+
+Mirrors the reference test pattern (test/ps/, test_dist_base.py): tables
+exercised directly, then an end-to-end sparse CTR model where the dense
+half runs as a jitted device step and embedding rows ride pull/push."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.ps import (
+    DenseTable, DistributedEmbedding, GeoWorkerTable, PaddleCloudRoleMaker,
+    PsClient, PsRuntime, PsService, SparseAccessor, SparseTable, TableConfig,
+)
+
+
+def _uniform_init(rng, shape):
+    return rng.uniform(-0.1, 0.1, shape)
+
+
+class TestTables:
+    def test_dense_sgd(self):
+        t = DenseTable("w", (3, 2), SparseAccessor("sgd", lr=0.5))
+        g = np.ones((3, 2), np.float32)
+        t.push(g)
+        np.testing.assert_allclose(t.pull(), -0.5 * g)
+
+    def test_sparse_lazy_init_deterministic(self):
+        a = SparseTable("e", 4, initializer=_uniform_init, seed=7)
+        b = SparseTable("e", 4, initializer=_uniform_init, seed=7)
+        ka = a.pull([5, 9])
+        np.testing.assert_array_equal(ka, b.pull([5, 9]))
+        assert len(a) == 2
+
+    def test_sparse_adagrad_adam_slots(self):
+        for rule in ("adagrad", "adam"):
+            t = SparseTable("e", 3, SparseAccessor(rule, lr=0.1))
+            keys = np.array([1, 2])
+            g = np.ones((2, 3), np.float32)
+            before = t.pull(keys).copy()
+            for _ in range(3):
+                t.push(keys, g)
+            after = t.pull(keys)
+            assert (after < before).all()  # moved against the gradient
+
+    def test_state_dict_roundtrip(self):
+        t = SparseTable("e", 2, initializer=_uniform_init)
+        t.pull([3, 1, 4])
+        s = t.state_dict()
+        t2 = SparseTable("e", 2)
+        t2.load_state_dict(s)
+        np.testing.assert_array_equal(t.pull([1, 3, 4]), t2.pull([1, 3, 4]))
+
+    def test_state_dict_preserves_optimizer_slots(self):
+        """Resume must keep adam moments/steps — identical trajectories."""
+        def make():
+            t = SparseTable("e", 3, SparseAccessor("adam", lr=0.1),
+                            initializer=_uniform_init, seed=1)
+            t.push([1, 2], np.ones((2, 3), np.float32))
+            return t
+        a, b = make(), make()
+        restored = SparseTable("e", 3, SparseAccessor("adam", lr=0.1))
+        restored.rows = {99: np.ones(3, np.float32)}  # stale content
+        restored.slots = {99: np.zeros((2, 3), np.float32)}
+        restored.load_state_dict(a.state_dict())
+        assert 99 not in restored.rows and 99 not in restored.slots
+        g = np.full((2, 3), 0.5, np.float32)
+        b.push([1, 2], g)
+        restored.push([1, 2], g)
+        np.testing.assert_allclose(restored.pull([1, 2]), b.pull([1, 2]),
+                                   atol=1e-7)
+
+
+class TestClientSharding:
+    def test_pull_push_spans_servers(self):
+        cfg = [TableConfig("emb", "sparse", dim=2, rule="sgd", lr=1.0,
+                           initializer=_uniform_init)]
+        servers = [PsService(cfg, i) for i in range(3)]
+        c = PsClient(servers)
+        keys = np.arange(10)
+        rows = c.pull_sparse("emb", keys)
+        assert rows.shape == (10, 2)
+        # rows landed on owner servers only (key % 3)
+        for s in range(3):
+            assert set(servers[s].tables["emb"].rows) == \
+                {int(k) for k in keys if k % 3 == s}
+        c.push_sparse("emb", keys, np.ones((10, 2), np.float32))
+        np.testing.assert_allclose(c.pull_sparse("emb", keys), rows - 1.0,
+                                   atol=1e-6)
+
+    def test_dense_home_and_empty_pull(self):
+        cfg = [TableConfig("w", "dense", shape=(2, 2), rule="sgd", lr=1.0)]
+        c = PsClient([PsService(cfg, i) for i in range(2)])
+        c.push_dense("w", np.ones((2, 2)))
+        np.testing.assert_allclose(c.pull_dense("w"), -np.ones((2, 2)))
+        cfg2 = [TableConfig("e", "sparse", dim=5)]
+        c2 = PsClient([PsService(cfg2, 0)])
+        assert c2.pull_sparse("e", np.zeros(0)).shape == (0, 5)
+
+
+class TestGeoAsync:
+    def test_deltas_merge_upstream(self):
+        cfg = [TableConfig("e", "sparse", dim=2, rule="sgd", lr=0.5)]
+        server_client = PsClient([PsService(cfg, 0)])
+        w = GeoWorkerTable(server_client, "e", 2,
+                           SparseAccessor("sgd", lr=0.5), geo_step=2)
+        keys = np.array([1, 2])
+        g = np.ones((2, 2), np.float32)
+        w.pull(keys)
+        w.push(keys, g)                      # local only (1 < geo_step)
+        srv_rows = server_client.pull_sparse("e", keys)
+        np.testing.assert_allclose(srv_rows, 0.0)
+        w.push(keys, g)                      # hits geo_step → delta shipped
+        srv_rows = server_client.pull_sparse("e", keys)
+        np.testing.assert_allclose(srv_rows, -1.0)  # two lr=0.5 sgd steps
+
+    def test_two_workers_converge(self):
+        """Two geo workers on disjoint-ish keys both pull the merged view."""
+        cfg = [TableConfig("e", "sparse", dim=1, rule="sgd", lr=0.1)]
+        server = PsClient([PsService(cfg, 0)])
+        w1 = GeoWorkerTable(server, "e", 1, SparseAccessor("sgd", .1), geo_step=1)
+        w2 = GeoWorkerTable(server, "e", 1, SparseAccessor("sgd", .1), geo_step=1)
+        k = np.array([7])
+        for _ in range(5):
+            w1.pull(k); w1.push(k, np.ones((1, 1)))
+            w2.pull(k); w2.push(k, np.ones((1, 1)))
+        merged = server.pull_sparse("e", k)[0, 0]
+        assert merged == pytest.approx(-1.0, abs=1e-5)  # 10 × lr .1
+        # workers absorb each other's merged contributions on pull
+        assert w1.pull(k)[0, 0] == pytest.approx(merged, abs=1e-5)
+        assert w2.pull(k)[0, 0] == pytest.approx(merged, abs=1e-5)
+
+    def test_pull_preserves_pending_local_delta(self):
+        """Unsent local progress must survive a sync pull."""
+        cfg = [TableConfig("e", "sparse", dim=1, rule="sgd", lr=1.0)]
+        server = PsClient([PsService(cfg, 0)])
+        w = GeoWorkerTable(server, "e", 1, SparseAccessor("sgd", 1.0),
+                           geo_step=100)  # never auto-ships
+        k = np.array([3])
+        w.pull(k)
+        w.push(k, np.ones((1, 1)))          # local: -1, server: 0
+        # another worker moves the server by -5
+        server.push_sparse_delta("e", k, np.full((1, 1), -5.0))
+        got = w.pull(k)[0, 0]
+        assert got == pytest.approx(-6.0)   # server -5 + pending -1
+
+
+class TestFleetPsFlow:
+    def test_role_maker_env(self):
+        env = {"PADDLE_TRAINING_ROLE": "PSERVER",
+               "PADDLE_PSERVERS_IP_PORT_LIST": "127.0.0.1:1,127.0.0.1:2",
+               "POD_IP": "127.0.0.1", "PADDLE_PORT": "2",
+               "PADDLE_TRAINERS_NUM": "3"}
+        r = PaddleCloudRoleMaker(env=env)
+        assert r.is_server() and r.server_id == 1 and r.server_num() == 2
+        r2 = PaddleCloudRoleMaker(env={"PADDLE_TRAINER_ID": "2",
+                                       "PADDLE_TRAINERS_NUM": "3"})
+        assert r2.is_worker() and r2.worker_index() == 2
+
+    def test_fleet_init_ps_mode(self):
+        fleet._reset()
+        try:
+            rt = fleet.init(PaddleCloudRoleMaker(env={}), is_collective=False)
+            assert isinstance(rt, PsRuntime)
+            assert fleet.is_worker() and not fleet.is_server()
+            fleet.set_ps_tables([TableConfig("e", "sparse", dim=2)])
+            assert rt.configs[0].name == "e"
+        finally:
+            fleet._reset()
+
+
+class TestEndToEndCTR:
+    def test_sparse_lr_converges_with_device_dense_step(self):
+        """The TPU PS pattern: pull rows host-side, jitted dense step on
+        device returns row grads, push back. A tiny CTR logistic
+        regression must fit a deterministic rule."""
+        dim = 4
+        cfg = [TableConfig("emb", "sparse", dim=dim, rule="adagrad", lr=0.5,
+                           initializer=_uniform_init, seed=3)]
+        runtime = PsRuntime.local(cfg, num_servers=2)
+        emb = DistributedEmbedding(runtime, "emb", dim)
+
+        w = jnp.zeros((dim,), jnp.float32)  # dense head, trained on device
+
+        @jax.jit
+        def step(w, rows, inverse, labels):
+            def loss_fn(w, rows):
+                feats = rows[inverse].sum(1)           # [B, dim] bag-of-ids
+                logits = feats @ w
+                p = jax.nn.sigmoid(logits)
+                eps = 1e-6
+                return -jnp.mean(labels * jnp.log(p + eps)
+                                 + (1 - labels) * jnp.log(1 - p + eps))
+            loss, (dw, drows) = jax.value_and_grad(loss_fn, argnums=(0, 1))(w, rows)
+            return loss, w - 0.5 * dw, drows
+
+        rng = np.random.default_rng(0)
+        score = np.where(np.arange(20) < 10, 1.0, -1.0)  # additive ground truth
+        losses = []
+        for it in range(60):
+            ids = rng.integers(0, 20, size=(16, 3))
+            labels = jnp.asarray((score[ids].sum(1) > 0).astype(np.float32))
+            rows, inverse = emb.pull(ids)
+            loss, w, drows = step(w, jnp.asarray(rows), jnp.asarray(inverse),
+                                  labels)
+            emb.push(np.asarray(drows))
+            losses.append(float(loss))
+        assert losses[-1] < 0.45 < losses[0] + 0.3
+        assert np.mean(losses[-10:]) < np.mean(losses[:10])
+
+    def test_duplicate_ids_grads_summed(self):
+        cfg = [TableConfig("emb", "sparse", dim=2, rule="sgd", lr=1.0)]
+        emb = DistributedEmbedding(PsRuntime.local(cfg), "emb", 2)
+        ids = np.array([[5, 5, 3]])
+        rows, inverse = emb.pull(ids)
+        assert rows.shape[0] == 2  # unique ids only
+        # d(loss)/d(rows) where loss = sum(rows[inverse]) → grad 2 for id 5
+        d_rows = np.zeros_like(rows)
+        np.add.at(d_rows, inverse.ravel(), 1.0)
+        emb.push(d_rows)
+        out = emb.client.pull_sparse("emb", np.array([5, 3]))
+        np.testing.assert_allclose(out[0], -2.0)
+        np.testing.assert_allclose(out[1], -1.0)
+
+
+class TestRpcTransport:
+    def test_client_over_rpc_loopback(self):
+        """Wire transport: service installed in-process, client calls it
+        through the rpc layer (world_size=1 loopback)."""
+        from paddle_tpu.distributed import rpc
+        from paddle_tpu.distributed.ps.service import _install_service
+        from paddle_tpu.launch.store import free_port
+
+        cfg = [TableConfig("e", "sparse", dim=3, rule="sgd", lr=1.0)]
+        _install_service(PsService(cfg, 0))
+        rpc.init_rpc("ps0", rank=0, world_size=1,
+                     master_endpoint=f"127.0.0.1:{free_port()}")
+        try:
+            c = PsClient(["ps0"])
+            keys = np.array([1, 2, 3])
+            rows = c.pull_sparse("e", keys)
+            np.testing.assert_allclose(rows, 0.0)
+            c.push_sparse("e", keys, np.full((3, 3), 2.0, np.float32))
+            np.testing.assert_allclose(c.pull_sparse("e", keys), -2.0)
+        finally:
+            rpc.shutdown()
+            _install_service(None)
+
+
+class TestPsTwoProcesses:
+    def test_server_trainer_flow(self, tmp_path):
+        """Full reference PS flow across two real processes: PSERVER runs
+        until TRAINER 0's stop_worker releases it (SURVEY §2.5/§3.5)."""
+        import os
+        import subprocess
+        import sys
+        import textwrap
+
+        from paddle_tpu.launch.store import free_port
+        port = free_port()
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = tmp_path / "ps_job.py"
+        script.write_text(textwrap.dedent(f"""
+            import os, sys
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            sys.path.insert(0, {repo!r})
+            import numpy as np
+            from paddle_tpu.distributed import fleet
+            from paddle_tpu.distributed.ps import (PaddleCloudRoleMaker,
+                                                   TableConfig)
+
+            role = PaddleCloudRoleMaker()
+            rt = fleet.init(role, is_collective=False)
+            fleet.set_ps_tables(
+                [TableConfig("emb", "sparse", dim=2, rule="sgd", lr=1.0)],
+                master_endpoint="127.0.0.1:{port}")
+            if fleet.is_server():
+                fleet.init_server()
+                fleet.run_server()          # must return after trainer stop
+                print("server exited cleanly")
+            else:
+                fleet.init_worker()
+                keys = np.array([1, 2, 9])
+                rows = rt.client.pull_sparse("emb", keys)
+                assert rows.shape == (3, 2) and (rows == 0).all()
+                rt.client.push_sparse("emb", keys,
+                                      np.ones((3, 2), np.float32))
+                out = rt.client.pull_sparse("emb", keys)
+                assert (out == -1.0).all(), out
+                print("trainer ok")
+                fleet.stop_worker()
+        """))
+        base = {**os.environ, "JAX_PLATFORMS": "cpu",
+                "PADDLE_PSERVERS_IP_PORT_LIST": "127.0.0.1:9000",
+                "PADDLE_TRAINERS_NUM": "1"}
+        srv = subprocess.Popen(
+            [sys.executable, str(script)],
+            env={**base, "PADDLE_TRAINING_ROLE": "PSERVER",
+                 "POD_IP": "127.0.0.1", "PADDLE_PORT": "9000"},
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        trn = subprocess.Popen(
+            [sys.executable, str(script)],
+            env={**base, "PADDLE_TRAINING_ROLE": "TRAINER",
+                 "PADDLE_TRAINER_ID": "0"},
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        t_out, _ = trn.communicate(timeout=120)
+        assert trn.returncode == 0, t_out
+        assert "trainer ok" in t_out
+        s_out, _ = srv.communicate(timeout=60)   # must NOT hang
+        assert srv.returncode == 0, s_out
+        assert "server exited cleanly" in s_out
