@@ -59,9 +59,14 @@ def main():
     steps = int(os.environ.get("PDTPU_BENCH_STEPS", 20 if on_tpu else 3))
 
     remat = os.environ.get("PDTPU_BENCH_REMAT", "0") == "1"
+    # seq-chunked rematerialized vocab CE skips the [B,S,V] logits
+    # materialization; it makes bs8 fit (bs8 is slower end-to-end, so the
+    # default stays bs4 + unchunked: 0.437 vs 0.435 chunked, sweep
+    # 2026-07-30) — the knob exists for memory-tight configs
+    loss_chunks = int(os.environ.get("PDTPU_BENCH_LOSS_CHUNKS", 1))
     pt.seed(0)
     model = llama(preset, max_position_embeddings=seq_len,
-                  use_recompute=remat)
+                  use_recompute=remat, loss_seq_chunks=loss_chunks)
     cfg = model.cfg
     opt = optimizer.AdamW(learning_rate=3e-4, weight_decay=0.1,
                           grad_clip=nn.ClipGradByGlobalNorm(1.0),
