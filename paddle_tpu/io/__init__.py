@@ -423,8 +423,15 @@ class DataLoader:
                  batch_sampler=None, num_workers=0, collate_fn=None,
                  drop_last=False, prefetch_factor=2, device_prefetch=False,
                  places=None, return_list=True, use_shared_memory=None,
-                 worker_init_fn=None, timeout=0, seed: Optional[int] = None):
-        del places, return_list, use_shared_memory, timeout  # API compat
+                 worker_init_fn=None, timeout=0, seed: Optional[int] = None,
+                 mp_context: Optional[str] = None):
+        del places, return_list, timeout  # API compat
+        # use_shared_memory=True selects *process* workers handing batches
+        # over SharedMemory segments (the reference's default worker model;
+        # GIL-free transforms). Default False: thread prefetch is enough
+        # when collate is numpy-bound. Map-style datasets only.
+        self.use_shared_memory = bool(use_shared_memory)
+        self.mp_context = mp_context
         self.dataset = dataset
         self.num_workers = int(num_workers)
         self.collate_fn = collate_fn or default_collate_fn
@@ -619,9 +626,25 @@ class DataLoader:
         for idxs in self.batch_sampler:
             yield self._fetch(idxs)
 
+    def _iter_process_workers(self):
+        from .process_workers import ProcessPoolIter
+        pool = ProcessPoolIter(self.dataset, list(self.batch_sampler),
+                               self.collate_fn, self.num_workers,
+                               prefetch_factor=self.prefetch_factor,
+                               worker_init_fn=self.worker_init_fn,
+                               seed=self.seed or 0,
+                               mp_context=self.mp_context)
+        return iter(pool)
+
     def __iter__(self):
         if self._iterable:
+            if self.use_shared_memory and self.num_workers > 0:
+                raise ValueError(
+                    "use_shared_memory process workers need a map-style "
+                    "dataset (IterableDataset streams per worker thread)")
             it = self._iter_iterable()
+        elif self.num_workers > 0 and self.use_shared_memory:
+            it = self._iter_process_workers()
         elif self.num_workers > 0:
             it = self._iter_workers()
         else:

@@ -1,0 +1,257 @@
+"""Multiprocess DataLoader workers over shared memory.
+
+Reference: python/paddle/io/dataloader/worker.py + the C++ shared-memory
+queue (``use_shared_memory=True`` default in paddle.io.DataLoader,
+SURVEY §2.6 "Data pipeline"): worker *processes* run dataset+collate and
+hand batches to the trainer through shared memory, bypassing both the
+GIL and pipe serialization.
+
+TPU redesign: the accelerator does not read host queues — batches end as
+``jax.device_put`` H2D copies — so the worker side stays pure
+numpy/CPython. Worker processes matter on TPU for the same reason as on
+GPU: heavy Python transforms (tokenization, image decode) are GIL-bound
+in threads. Each finished batch is packed into ONE SharedMemory segment
+(all array leaves concatenated, page-aligned offsets); the parent maps
+zero-copy numpy views and unlinks the segment two batches later (the
+views' lifetime window a training step actually uses).
+
+Map-style datasets only — the iterable path keeps thread workers (its
+per-worker streaming contract has no index protocol to ship across
+processes).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import pickle
+import queue as _queue
+import threading
+from multiprocessing import shared_memory
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+_END = "__end__"
+_ALIGN = 128
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+def _pack_batch(batch) -> tuple:
+    """Flatten a batch pytree; numpy leaves go to one shm segment."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(batch)
+    metas: List[Any] = []
+    total = 0
+    for leaf in leaves:
+        if isinstance(leaf, np.ndarray):
+            off = _align(total)
+            metas.append(("arr", off, leaf.dtype.str, leaf.shape))
+            total = off + leaf.nbytes
+        else:
+            metas.append(("obj", leaf))
+    shm_name = None
+    if total:
+        seg = shared_memory.SharedMemory(create=True, size=max(total, 1))
+        for meta, leaf in zip(metas, leaves):
+            if meta[0] == "arr":
+                _, off, dstr, shape = meta
+                dst = np.ndarray(shape, dtype=np.dtype(dstr),
+                                 buffer=seg.buf, offset=off)
+                dst[...] = leaf
+        shm_name = seg.name
+        # ownership moves to the consumer (which unlinks): silence this
+        # process's resource_tracker so worker exit doesn't double-free
+        try:
+            from multiprocessing import resource_tracker
+            resource_tracker.unregister(seg._name, "shared_memory")
+        except Exception:
+            pass
+        seg.close()  # worker's mapping; the segment itself lives on
+    return shm_name, metas, pickle.dumps(treedef)
+
+
+def _unpack_batch(shm_name, metas, treedef_bytes):
+    """Copy arrays out of the segment and retire it immediately.
+
+    The copy is deliberate: numpy does not pin the SharedMemory mmap for
+    a view's lifetime (the mapping dies with the SharedMemory object, so
+    zero-copy views dangle once the segment is retired — observed as a
+    segfault on any consumer that retains batches). One parent-side
+    memcpy per batch is the reference's behaviour too (its C++ shm queue
+    copies into the reader's tensor) and is still far cheaper than pipe
+    pickling, which serializes AND copies twice."""
+    import jax
+    treedef = pickle.loads(treedef_bytes)
+    seg = shared_memory.SharedMemory(name=shm_name) if shm_name else None
+    leaves = []
+    for meta in metas:
+        if meta[0] == "arr":
+            _, off, dstr, shape = meta
+            if seg is None:  # every leaf zero-size → no segment was made
+                leaves.append(np.zeros(shape, dtype=np.dtype(dstr)))
+            else:
+                view = np.ndarray(shape, dtype=np.dtype(dstr),
+                                  buffer=seg.buf, offset=off)
+                leaves.append(view.copy())
+        else:
+            leaves.append(meta[1])
+    if seg is not None:
+        _unlink_quiet(seg)
+        seg.close()
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _unlink_quiet(seg):
+    # no resource_tracker.unregister here: the creating worker already
+    # unregistered at pack time (and under fork both sides share one
+    # tracker process — a second unregister makes the tracker print
+    # KeyError tracebacks for every batch)
+    try:
+        seg.unlink()
+    except FileNotFoundError:
+        pass
+
+
+def _worker_main(dataset, collate_fn, worker_init_fn, wid, nw, seed,
+                 task_q, result_q):
+    # late import keeps jax out of the child's critical path; workers never
+    # touch the device (forked TPU handles are unsafe, same as CUDA in the
+    # reference's workers)
+    from . import WorkerInfo, _worker_info
+    _worker_info.info = WorkerInfo(wid, nw, seed + wid, dataset)
+    try:
+        if worker_init_fn is not None:
+            try:
+                worker_init_fn(wid)
+            except BaseException as e:
+                # -2 = init failure: parent raises the real exception
+                # (parity with the thread path's `fatal` list)
+                result_q.put((-2, "err", pickle.dumps(e)))
+                return
+        while True:
+            task = task_q.get()
+            if task is None:
+                return
+            i, idxs = task
+            try:
+                samples = [dataset[j] for j in idxs]
+                payload = _pack_batch(collate_fn(samples))
+                result_q.put((i, "ok", payload))
+            except BaseException as e:  # propagate to the consumer
+                try:
+                    result_q.put((i, "err", pickle.dumps(e)))
+                except Exception:
+                    result_q.put((i, "err", pickle.dumps(
+                        RuntimeError(f"worker {wid}: {type(e).__name__}: {e}"))))
+    finally:
+        result_q.put((-1, _END, wid))
+
+
+class ProcessPoolIter:
+    """Ordered multiprocess prefetch over a batch sampler (the process
+    analogue of DataLoader._iter_workers' ordered thread pool)."""
+
+    def __init__(self, dataset, batches, collate_fn, num_workers,
+                 prefetch_factor=2, worker_init_fn=None, seed=0,
+                 mp_context: Optional[str] = None):
+        self.batches = list(batches)
+        self.nw = num_workers
+        self.max_ahead = max(1, prefetch_factor) * num_workers
+        ctx = mp.get_context(mp_context or "fork")
+        self.task_q = ctx.Queue()
+        self.result_q = ctx.Queue()
+        self.procs = [
+            ctx.Process(target=_worker_main,
+                        args=(dataset, collate_fn, worker_init_fn, w,
+                              num_workers, seed, self.task_q, self.result_q),
+                        daemon=True)
+            for w in range(num_workers)]
+        for p in self.procs:
+            p.start()
+        self._next_task = 0
+        self._done = False
+        # prime the task queue up to the prefetch window
+        while self._next_task < min(self.max_ahead, len(self.batches)):
+            self._submit()
+
+    def _submit(self):
+        self.task_q.put((self._next_task, self.batches[self._next_task]))
+        self._next_task += 1
+
+    def __iter__(self):
+        slots: Dict[int, Any] = {}
+        try:
+            for i in range(len(self.batches)):
+                while i not in slots:
+                    try:
+                        j, status, payload = self.result_q.get(timeout=5.0)
+                    except _queue.Empty:
+                        dead = [w for w, p in enumerate(self.procs)
+                                if not p.is_alive()]
+                        if dead:  # hard death (OOM-kill/segfault): no
+                            # Python-level sentinel ever arrives — raise
+                            # instead of hanging the training loop
+                            raise RuntimeError(
+                                f"DataLoader worker(s) {dead} died "
+                                f"(exitcodes "
+                                f"{[self.procs[w].exitcode for w in dead]})")
+                        continue
+                    if status == _END:
+                        raise RuntimeError(
+                            f"DataLoader worker {payload} exited early")
+                    if status == "err":
+                        raise pickle.loads(payload)
+                    slots[j] = payload
+                batch = _unpack_batch(*slots.pop(i))
+                if self._next_task < len(self.batches):
+                    self._submit()
+                yield batch
+        finally:
+            # map-then-unlink any fetched-but-unyielded segments
+            for payload in slots.values():
+                _unpack_batch(*payload)
+            self.close()
+
+    def close(self):
+        if self._done:
+            return
+        self._done = True
+        # cancel queued work so workers see the sentinel promptly
+        while True:
+            try:
+                self.task_q.get_nowait()
+            except (_queue.Empty, OSError, EOFError):
+                break
+        for _ in self.procs:
+            self.task_q.put(None)
+        for p in self.procs:
+            # generous join: a worker mid-batch must finish and send its
+            # segment name or the segment can never be unlinked (terminate
+            # between shm create and send is the one unavoidable leak)
+            p.join(timeout=10.0)
+            if p.is_alive():
+                p.terminate()
+        # drain late results so their segments don't leak; use a short
+        # timeout, not get_nowait — the queue feeder may still be flushing
+        while True:
+            try:
+                j, status, payload = self.result_q.get(timeout=0.25)
+                if status == "ok" and payload[0]:
+                    try:
+                        seg = shared_memory.SharedMemory(name=payload[0])
+                        _unlink_quiet(seg)
+                        seg.close()
+                    except FileNotFoundError:
+                        pass
+            except (_queue.Empty, OSError, EOFError):
+                break
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -339,8 +339,15 @@ class LlamaForCausalLM(CachedGenerationMixin, Layer):
         if labels is None:
             return self.logits(hidden)
         chunks = self.cfg.loss_seq_chunks
-        if chunks > 1 and hidden.shape[1] % chunks == 0:
-            return self._chunked_loss(hidden, labels, chunks)
+        if chunks > 1:
+            if hidden.shape[1] % chunks == 0:
+                return self._chunked_loss(hidden, labels, chunks)
+            import warnings
+            warnings.warn(
+                f"loss_seq_chunks={chunks} does not divide seq_len="
+                f"{hidden.shape[1]}; falling back to the monolithic "
+                "[B,S,V] logits path (full logits WILL be materialized)",
+                stacklevel=2)
         logits = self.logits(hidden)
         loss = self.loss_fn(logits.astype(jnp.float32), labels)
         valid = (labels != -100)

@@ -218,3 +218,131 @@ def test_dataloader_with_custom_batch_sampler():
     bs = io.BatchSampler(sampler=io.SequenceSampler(ds), batch_size=5)
     out = list(io.DataLoader(ds, batch_sampler=bs))
     assert len(out) == 2 and out[0][0].shape == (5, 3)
+
+
+class _SquareDataset(io.Dataset):
+    """Module-level (fork-picklable) map dataset recording worker pids."""
+
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        import os
+        return {"x": np.full((4,), i, np.float32),
+                "pid": np.array([os.getpid()], np.int64)}
+
+
+class _FailAt(io.Dataset):
+    def __init__(self, n, bad):
+        self.n, self.bad = n, bad
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        if i == self.bad:
+            raise ValueError("poisoned sample")
+        return np.float32(i)
+
+
+class _KillSelf(io.Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        import os
+        import signal
+        os.kill(os.getpid(), signal.SIGKILL)  # simulate OOM-kill
+
+
+class _EmptyArrays(io.Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return {"e": np.zeros((0,), np.float32)}
+
+
+class TestProcessWorkers:
+    """use_shared_memory=True: the reference's process-worker model
+    (worker.py + shared-memory queue) — batches cross via shm segments."""
+
+    def test_parity_order_and_cross_process(self):
+        import os
+        ds = _SquareDataset(23)
+        serial = list(io.DataLoader(ds, batch_size=4, num_workers=0))
+        shm = list(io.DataLoader(ds, batch_size=4, num_workers=2,
+                                 use_shared_memory=True))
+        assert len(serial) == len(shm) == 6
+        for a, b in zip(serial, shm):
+            np.testing.assert_array_equal(a["x"], b["x"])
+        pids = {int(p) for b in shm for p in b["pid"].ravel()}
+        assert os.getpid() not in pids          # collate ran out-of-process
+        assert len(pids) >= 1
+
+    def test_no_shm_leak(self):
+        import glob
+        # psm_*: CPython SharedMemory's name prefix — ignore unrelated
+        # /dev/shm tenants so concurrent processes can't flake this test
+        before = set(glob.glob("/dev/shm/psm_*"))
+        for _ in range(2):
+            _ = list(io.DataLoader(_SquareDataset(16), batch_size=4,
+                                   num_workers=2, use_shared_memory=True))
+        leaked = set(glob.glob("/dev/shm/psm_*")) - before
+        assert not leaked, leaked
+
+    def test_worker_exception_propagates(self):
+        dl = io.DataLoader(_FailAt(12, bad=7), batch_size=4, num_workers=2,
+                           use_shared_memory=True)
+        with pytest.raises(ValueError, match="poisoned"):
+            list(dl)
+
+    def test_iterable_rejected(self):
+        class Stream(io.IterableDataset):
+            def __iter__(self):
+                yield from range(4)
+        dl = io.DataLoader(Stream(), batch_size=2, num_workers=2,
+                           use_shared_memory=True)
+        with pytest.raises(ValueError, match="map-style"):
+            iter(dl)
+
+    def test_early_abandon_cleans_up(self):
+        import glob
+        before = set(glob.glob("/dev/shm/psm_*"))
+        it = iter(io.DataLoader(_SquareDataset(40), batch_size=4,
+                                num_workers=2, use_shared_memory=True))
+        next(it); next(it)
+        it.close()          # generator close → pool shutdown
+        del it
+        import gc; gc.collect()
+        leaked = set(glob.glob("/dev/shm/psm_*")) - before
+        assert not leaked, leaked
+
+    def test_worker_init_exception_propagates_real_error(self):
+        def bad_init(wid):
+            raise ValueError("bad seed config")
+        dl = io.DataLoader(_SquareDataset(8), batch_size=4, num_workers=2,
+                           use_shared_memory=True, worker_init_fn=bad_init)
+        with pytest.raises(ValueError, match="bad seed config"):
+            list(dl)
+
+    def test_hard_worker_death_raises_not_hangs(self):
+        dl = io.DataLoader(_KillSelf(8), batch_size=4, num_workers=1,
+                           use_shared_memory=True)
+        with pytest.raises(RuntimeError, match="died|exited early"):
+            list(dl)
+
+    def test_all_empty_array_batch(self):
+        """Zero total bytes → no shm segment; unpack must not crash."""
+        out = list(io.DataLoader(_EmptyArrays(4), batch_size=2,
+                                 num_workers=1, use_shared_memory=True))
+        assert len(out) == 2 and out[0]["e"].shape == (2, 0)
