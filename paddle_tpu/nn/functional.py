@@ -56,6 +56,14 @@ def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5):
     return jnp.clip(slope * x + offset, 0.0, 1.0)
 
 
+def relu6(x):
+    return jnp.clip(x, 0.0, 6.0)
+
+
+def hardswish(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
 def glu(x, axis=-1):
     a, b = jnp.split(x, 2, axis=axis)
     return a * sigmoid(b)

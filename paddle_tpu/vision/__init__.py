@@ -8,4 +8,8 @@ classes accept pre-downloaded files and there is a RandomDataset for tests.
 from . import transforms  # noqa: F401
 from . import models  # noqa: F401
 from . import datasets  # noqa: F401
-from .models import LeNet, ResNet, resnet18, resnet34, resnet50  # noqa: F401
+from .models import (LeNet, ResNet, resnet18, resnet34, resnet50,  # noqa: F401
+                     VGG, vgg11, vgg13, vgg16, vgg19, AlexNet, alexnet,
+                     SqueezeNet, squeezenet1_0, squeezenet1_1,
+                     MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2,
+                     DenseNet, densenet121)

@@ -8,8 +8,8 @@ from __future__ import annotations
 
 from ..nn import functional as F
 from ..nn.layer import Layer
-from ..nn.layers_common import (AvgPool2D, BatchNorm2D, Conv2D, Linear,
-                                MaxPool2D, ReLU, Sequential)
+from ..nn.layers_common import (AvgPool2D, BatchNorm2D, Conv2D, Dropout,
+                                Linear, MaxPool2D, ReLU, Sequential)
 from ..nn.layers_conv import AdaptiveAvgPool2D
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "LeNet"]
@@ -135,3 +135,313 @@ class LeNet(Layer):
     def forward(self, x):
         x = self.features(x)
         return self.fc(x.reshape(x.shape[0], -1))
+
+
+# ---------------------------------------------------------------------------
+# VGG (reference: python/paddle/vision/models/vgg.py)
+# ---------------------------------------------------------------------------
+
+_VGG_CFGS = {
+    11: [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    13: [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+         512, 512, "M"],
+    16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+         512, 512, 512, "M"],
+    19: [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(Layer):
+    def __init__(self, depth: int = 16, batch_norm: bool = False,
+                 num_classes: int = 1000):
+        super().__init__()
+        layers = []
+        c = 3
+        for v in _VGG_CFGS[depth]:
+            if v == "M":
+                layers.append(MaxPool2D(2, 2))
+            else:
+                layers.append(Conv2D(c, v, 3, padding=1))
+                if batch_norm:
+                    layers.append(BatchNorm2D(v))
+                layers.append(ReLU())
+                c = v
+        self.features = Sequential(*layers)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.avgpool = AdaptiveAvgPool2D(7)
+            self.classifier = Sequential(
+                Linear(512 * 7 * 7, 4096), ReLU(), Dropout(0.5),
+                Linear(4096, 4096), ReLU(), Dropout(0.5),
+                Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.avgpool(x)
+            x = x.reshape(x.shape[0], -1)
+            x = self.classifier(x)
+        return x
+
+
+def vgg11(batch_norm=False, **kw):
+    return VGG(11, batch_norm, **kw)
+
+
+def vgg13(batch_norm=False, **kw):
+    return VGG(13, batch_norm, **kw)
+
+
+def vgg16(batch_norm=False, **kw):
+    return VGG(16, batch_norm, **kw)
+
+
+def vgg19(batch_norm=False, **kw):
+    return VGG(19, batch_norm, **kw)
+
+
+# ---------------------------------------------------------------------------
+# AlexNet (reference: python/paddle/vision/models/alexnet.py)
+# ---------------------------------------------------------------------------
+
+class AlexNet(Layer):
+    def __init__(self, num_classes: int = 1000):
+        super().__init__()
+        self.features = Sequential(
+            Conv2D(3, 64, 11, stride=4, padding=2), ReLU(), MaxPool2D(3, 2),
+            Conv2D(64, 192, 5, padding=2), ReLU(), MaxPool2D(3, 2),
+            Conv2D(192, 384, 3, padding=1), ReLU(),
+            Conv2D(384, 256, 3, padding=1), ReLU(),
+            Conv2D(256, 256, 3, padding=1), ReLU(), MaxPool2D(3, 2))
+        self.avgpool = AdaptiveAvgPool2D(6)
+        self.classifier = Sequential(
+            Dropout(0.5), Linear(256 * 6 * 6, 4096), ReLU(),
+            Dropout(0.5), Linear(4096, 4096), ReLU(),
+            Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x))
+        return self.classifier(x.reshape(x.shape[0], -1))
+
+
+def alexnet(**kw):
+    return AlexNet(**kw)
+
+
+# ---------------------------------------------------------------------------
+# SqueezeNet (reference: python/paddle/vision/models/squeezenet.py)
+# ---------------------------------------------------------------------------
+
+class _Fire(Layer):
+    def __init__(self, c_in, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = Conv2D(c_in, squeeze, 1)
+        self.e1 = Conv2D(squeeze, e1, 1)
+        self.e3 = Conv2D(squeeze, e3, 3, padding=1)
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        s = F.relu(self.squeeze(x))
+        return jnp.concatenate([F.relu(self.e1(s)), F.relu(self.e3(s))],
+                               axis=1)
+
+
+class SqueezeNet(Layer):
+    def __init__(self, version: str = "1.1", num_classes: int = 1000):
+        super().__init__()
+        if version == "1.0":
+            self.features = Sequential(
+                Conv2D(3, 96, 7, stride=2), ReLU(), MaxPool2D(3, 2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), MaxPool2D(3, 2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                MaxPool2D(3, 2), _Fire(512, 64, 256, 256))
+        else:
+            self.features = Sequential(
+                Conv2D(3, 64, 3, stride=2), ReLU(), MaxPool2D(3, 2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                MaxPool2D(3, 2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                MaxPool2D(3, 2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        self.classifier = Sequential(
+            Dropout(0.5), Conv2D(512, num_classes, 1), ReLU(),
+            AdaptiveAvgPool2D(1))
+
+    def forward(self, x):
+        x = self.classifier(self.features(x))
+        return x.reshape(x.shape[0], -1)
+
+
+def squeezenet1_0(**kw):
+    return SqueezeNet("1.0", **kw)
+
+
+def squeezenet1_1(**kw):
+    return SqueezeNet("1.1", **kw)
+
+
+# ---------------------------------------------------------------------------
+# MobileNet V1/V2 (reference: python/paddle/vision/models/mobilenetv1.py, v2)
+# ---------------------------------------------------------------------------
+
+class _ConvBNRelu(Layer):
+    def __init__(self, c_in, c_out, k, stride=1, groups=1, act="relu6"):
+        super().__init__()
+        self.conv = Conv2D(c_in, c_out, k, stride=stride,
+                           padding=(k - 1) // 2, groups=groups,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(c_out)
+        self.act = act
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return F.relu6(x) if self.act == "relu6" else (
+            F.relu(x) if self.act == "relu" else x)
+
+
+class MobileNetV1(Layer):
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000):
+        super().__init__()
+        def c(ch):
+            return max(8, int(ch * scale))
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
+              [(512, 1024, 2), (1024, 1024, 1)]
+        layers = [_ConvBNRelu(3, c(32), 3, stride=2, act="relu")]
+        for c_in, c_out, s in cfg:
+            layers.append(_ConvBNRelu(c(c_in), c(c_in), 3, stride=s,
+                                      groups=c(c_in), act="relu"))
+            layers.append(_ConvBNRelu(c(c_in), c(c_out), 1, act="relu"))
+        self.features = Sequential(*layers)
+        self.pool = AdaptiveAvgPool2D(1)
+        self.fc = Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.pool(self.features(x))
+        return self.fc(x.reshape(x.shape[0], -1))
+
+
+class _InvertedResidual(Layer):
+    def __init__(self, c_in, c_out, stride, expand):
+        super().__init__()
+        hidden = int(round(c_in * expand))
+        self.use_res = stride == 1 and c_in == c_out
+        layers = []
+        if expand != 1:
+            layers.append(_ConvBNRelu(c_in, hidden, 1))
+        layers += [
+            _ConvBNRelu(hidden, hidden, 3, stride=stride, groups=hidden),
+            _ConvBNRelu(hidden, c_out, 1, act="none"),
+        ]
+        self.block = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(Layer):
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000):
+        super().__init__()
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        def c(ch):
+            return max(8, int(ch * scale))
+        layers = [_ConvBNRelu(3, c(32), 3, stride=2)]
+        c_in = c(32)
+        for t, ch, n, s in cfg:
+            for i in range(n):
+                layers.append(_InvertedResidual(c_in, c(ch),
+                                                s if i == 0 else 1, t))
+                c_in = c(ch)
+        last = c(1280) if scale > 1.0 else 1280
+        layers.append(_ConvBNRelu(c_in, last, 1))
+        self.features = Sequential(*layers)
+        self.pool = AdaptiveAvgPool2D(1)
+        self.classifier = Sequential(Dropout(0.2), Linear(last, num_classes))
+
+    def forward(self, x):
+        x = self.pool(self.features(x))
+        return self.classifier(x.reshape(x.shape[0], -1))
+
+
+def mobilenet_v1(scale=1.0, **kw):
+    return MobileNetV1(scale=scale, **kw)
+
+
+def mobilenet_v2(scale=1.0, **kw):
+    return MobileNetV2(scale=scale, **kw)
+
+
+# ---------------------------------------------------------------------------
+# DenseNet (reference: python/paddle/vision/models/densenet.py)
+# ---------------------------------------------------------------------------
+
+class _DenseLayer(Layer):
+    def __init__(self, c_in, growth, bn_size):
+        super().__init__()
+        self.bn1 = BatchNorm2D(c_in)
+        self.conv1 = Conv2D(c_in, bn_size * growth, 1, bias_attr=False)
+        self.bn2 = BatchNorm2D(bn_size * growth)
+        self.conv2 = Conv2D(bn_size * growth, growth, 3, padding=1,
+                            bias_attr=False)
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        y = self.conv1(F.relu(self.bn1(x)))
+        y = self.conv2(F.relu(self.bn2(y)))
+        return jnp.concatenate([x, y], axis=1)
+
+
+class _Transition(Layer):
+    def __init__(self, c_in, c_out):
+        super().__init__()
+        self.bn = BatchNorm2D(c_in)
+        self.conv = Conv2D(c_in, c_out, 1, bias_attr=False)
+        self.pool = AvgPool2D(2, 2)
+
+    def forward(self, x):
+        return self.pool(self.conv(F.relu(self.bn(x))))
+
+
+_DENSENET_CFGS = {121: (32, (6, 12, 24, 16)), 161: (48, (6, 12, 36, 24)),
+                  169: (32, (6, 12, 32, 32)), 201: (32, (6, 12, 48, 32))}
+
+
+class DenseNet(Layer):
+    def __init__(self, layers: int = 121, bn_size: int = 4,
+                 num_classes: int = 1000):
+        super().__init__()
+        growth, blocks = _DENSENET_CFGS[layers]
+        c = 2 * growth
+        feats = [Conv2D(3, c, 7, stride=2, padding=3, bias_attr=False),
+                 BatchNorm2D(c), ReLU(), MaxPool2D(3, 2, padding=1)]
+        for bi, n in enumerate(blocks):
+            for _ in range(n):
+                feats.append(_DenseLayer(c, growth, bn_size))
+                c += growth
+            if bi != len(blocks) - 1:
+                feats.append(_Transition(c, c // 2))
+                c //= 2
+        feats += [BatchNorm2D(c), ReLU()]
+        self.features = Sequential(*feats)
+        self.pool = AdaptiveAvgPool2D(1)
+        self.fc = Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.pool(self.features(x))
+        return self.fc(x.reshape(x.shape[0], -1))
+
+
+def densenet121(**kw):
+    return DenseNet(121, **kw)
+
+
+__all__ += ["VGG", "vgg11", "vgg13", "vgg16", "vgg19", "AlexNet", "alexnet",
+            "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
+            "MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2",
+            "DenseNet", "densenet121"]

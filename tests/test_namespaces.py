@@ -298,3 +298,56 @@ class TestAudio:
         mel = audio.MelSpectrogram(sr=4000, n_fft=256, n_mels=20)(x)
         assert mel.shape[0] == 20
         assert np.isfinite(np.asarray(mel)).all()
+
+
+class TestVisionZoo:
+    """New model families (reference python/paddle/vision/models/):
+    forward shape + finite grads on tiny inputs."""
+
+    def _check(self, model, in_shape=(1, 3, 64, 64), n_cls=10):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.nn.layer import functional_call, raw_params
+        x = jnp.ones(in_shape, jnp.float32)
+        out = model(x)
+        assert out.shape == (in_shape[0], n_cls)
+        p = raw_params(model)
+        g = jax.grad(
+            lambda p: functional_call(model, p, x, training=True).sum())(p)
+        leaves = jax.tree_util.tree_leaves(g)
+        assert leaves and all(np.isfinite(np.asarray(v)).all()
+                              for v in leaves)
+
+    def test_vgg11_bn(self):
+        from paddle_tpu.vision.models import vgg11
+        pt.seed(0)
+        self._check(vgg11(batch_norm=True, num_classes=10))
+
+    def test_alexnet(self):
+        from paddle_tpu.vision.models import alexnet
+        pt.seed(0)
+        self._check(alexnet(num_classes=10))
+
+    def test_squeezenet(self):
+        from paddle_tpu.vision.models import squeezenet1_1
+        pt.seed(0)
+        self._check(squeezenet1_1(num_classes=10))
+
+    def test_mobilenet_v1_v2(self):
+        from paddle_tpu.vision.models import mobilenet_v1, mobilenet_v2
+        pt.seed(0)
+        self._check(mobilenet_v1(scale=0.25, num_classes=10))
+        self._check(mobilenet_v2(scale=0.25, num_classes=10))
+
+    def test_densenet121(self):
+        from paddle_tpu.vision.models import densenet121
+        pt.seed(0)
+        self._check(densenet121(num_classes=10))
+
+    def test_relu6_hardswish(self):
+        import jax.numpy as jnp
+        from paddle_tpu.nn import functional as F
+        x = jnp.array([-4.0, -1.0, 0.0, 3.0, 7.0])
+        np.testing.assert_allclose(F.relu6(x), [0, 0, 0, 3, 6])
+        np.testing.assert_allclose(
+            F.hardswish(x), x * np.clip(np.asarray(x) + 3, 0, 6) / 6)
