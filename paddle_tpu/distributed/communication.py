@@ -417,3 +417,35 @@ def init_parallel_env(cluster_env: Optional[dict] = None):
             process_id=int(env.get("process_id",
                                    os.environ.get("PDTPU_PROCESS_ID", 0))))
     return None
+
+
+# ---------------------------------------------------------------------------
+# collective-consistency watchdog hooks (SURVEY §5.2): when
+# debug.collective_debug() is active, every collective issued through this
+# module is recorded for cross-rank sequence verification
+# ---------------------------------------------------------------------------
+
+import functools as _functools
+
+from . import debug as _debug
+
+
+def _traced(fn, name):
+    @_functools.wraps(fn)
+    def wrapper(tensor, *a, **kw):
+        if _debug.get_trace() is not None:
+            grp = kw.get("group", kw.get("axis"))
+            axes = _axis_tuple(grp) if not isinstance(grp, str) else (grp,)
+            _debug.record(name, axes or ("world",),
+                          getattr(tensor, "shape", None),
+                          getattr(tensor, "dtype", None))
+        return fn(tensor, *a, **kw)
+    return wrapper
+
+
+for _n in ("all_reduce", "all_gather", "reduce_scatter", "alltoall",
+           "alltoall_single", "broadcast", "reduce", "scatter", "p2p_shift",
+           "batch_isend_irecv"):
+    if _n in globals():
+        globals()[_n] = _traced(globals()[_n], _n)
+del _n

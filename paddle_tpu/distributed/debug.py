@@ -1,0 +1,103 @@
+"""Collective-consistency watchdog (SURVEY §5.2 TPU equivalent).
+
+Reference capability: ProcessGroupNCCL's watchdog thread detects hung /
+mismatched collectives by timeout (paddle/fluid/distributed/collective/
+process_group_nccl.cc). On TPU the classic deadlock cause survives in
+multi-host SPMD: every process must issue the SAME sequence of
+collectives; a rank that diverges (data-dependent Python branch, skipped
+step, different mesh) hangs the whole slice with no diagnostics.
+
+This module gives the debugging tool the reference has and jax lacks:
+
+- ``collective_debug()``: context manager that records every collective
+  issued through ``paddle_tpu.distributed`` (op, axes, shape, dtype) into
+  a per-process trace.
+- ``check_consistency(...)``: cross-checks the trace digest across
+  processes through the rendezvous ``TCPStore`` and raises on the ranks
+  whose sequence differs — turning a silent hang into a named error,
+  BEFORE the mismatched program is issued again.
+
+Zero overhead when disabled (one falsy global check per collective).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import List, Optional, Tuple
+
+__all__ = ["collective_debug", "get_trace", "check_consistency",
+           "CollectiveMismatchError"]
+
+_state = threading.local()
+
+
+class CollectiveMismatchError(RuntimeError):
+    pass
+
+
+def _tracing() -> bool:
+    return getattr(_state, "trace", None) is not None
+
+
+def record(op: str, axes, shape=None, dtype=None) -> None:
+    """Called by the communication layer for every collective issued."""
+    trace = getattr(_state, "trace", None)
+    if trace is None:
+        return
+    trace.append((op, tuple(axes) if axes else (),
+                  tuple(shape) if shape is not None else (),
+                  str(dtype) if dtype is not None else ""))
+
+
+class collective_debug:
+    """``with collective_debug() as trace:`` — record collective calls."""
+
+    def __enter__(self) -> List[Tuple]:
+        _state.trace = []
+        return _state.trace
+
+    def __exit__(self, *exc):
+        self._trace = _state.trace
+        _state.trace = None
+        return False
+
+
+def get_trace() -> Optional[List[Tuple]]:
+    return getattr(_state, "trace", None)
+
+
+def _digest(trace) -> str:
+    h = hashlib.sha256()
+    for entry in trace:
+        h.update(repr(entry).encode())
+    return h.hexdigest()
+
+
+def check_consistency(trace, rank: int, world_size: int, store=None,
+                      master_endpoint: Optional[str] = None,
+                      timeout: float = 30.0) -> None:
+    """Raise ``CollectiveMismatchError`` on ranks whose collective
+    sequence differs from rank 0's.
+
+    Exchange rides the rendezvous TCPStore (control plane — never the
+    accelerator fabric, which may be the thing that's wedged).
+    """
+    if world_size <= 1:
+        return
+    if store is None:
+        from ..launch.store import TCPStore
+        store = TCPStore(master_endpoint, is_master=(rank == 0),
+                         timeout=timeout)
+    d = _digest(trace)
+    store.set(f"collective_watchdog/{rank}", d.encode())
+    # everyone compares against rank 0 (wait gives the natural timeout)
+    ref = store.wait("collective_watchdog/0", timeout=timeout)
+    ref = ref.decode() if isinstance(ref, bytes) else ref
+    if d != ref:
+        raise CollectiveMismatchError(
+            f"rank {rank} issued a different collective sequence than "
+            f"rank 0 ({len(trace)} calls, digest {d[:12]} != {ref[:12]}). "
+            "First differing call can be found by diffing get_trace() "
+            "dumps; typical causes: data-dependent branch around a "
+            "collective, unequal dataset shards, mesh mismatch.")

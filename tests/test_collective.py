@@ -228,3 +228,53 @@ def test_batch_isend_irecv_validation(mesh8):
         [dist.P2POp(dist.isend, vals, peer_offset=+1, group=dist.new_group("dp")),
          dist.P2POp(dist.irecv, None, peer_offset=-1, group=dist.new_group("dp"))])
     np.testing.assert_allclose(np.asarray(t[1].wait()).ravel(), [3, 0, 1, 2])
+
+
+class TestCollectiveWatchdog:
+    """SURVEY §5.2 TPU equivalent: collective-sequence mismatch detector
+    (the reference's ProcessGroupNCCL watchdog analogue)."""
+
+    def test_trace_records_collectives(self, mesh8):
+        from paddle_tpu.distributed import debug
+
+        with debug.collective_debug() as trace:
+            x = jnp.ones((8, 4))
+            dist.all_reduce(x, group=dist.new_group("dp"))
+            dist.reduce_scatter(x, group=dist.new_group("dp"))
+        assert [t[0] for t in trace] == ["all_reduce", "reduce_scatter"]
+        assert trace[0][1] == ("dp",) and trace[0][2] == (8, 4)
+        # disabled outside the context
+        dist.all_reduce(jnp.ones(2), group=dist.new_group("dp"))
+        assert len(trace) == 2
+
+    def test_consistency_check_passes_and_fails(self, mesh8):
+        import threading
+
+        from paddle_tpu.distributed import debug
+        from paddle_tpu.launch.store import TCPStore, free_port
+
+        def run_case(traces, expect_fail):
+            ep = f"127.0.0.1:{free_port()}"
+            master = TCPStore(ep, is_master=True)
+            errs = {}
+
+            def rank_fn(r):
+                store = master if r == 0 else TCPStore(ep)
+                try:
+                    debug.check_consistency(traces[r], r, len(traces),
+                                            store=store, timeout=10.0)
+                except debug.CollectiveMismatchError as e:
+                    errs[r] = e
+
+            ts = [threading.Thread(target=rank_fn, args=(r,))
+                  for r in range(len(traces))]
+            for t in ts: t.start()
+            for t in ts: t.join(timeout=20)
+            return errs
+
+        same = [("all_reduce", ("dp",), (4,), "float32")]
+        diff = [("all_gather", ("mp",), (4,), "float32")]
+        assert run_case([same, list(same)], False) == {}
+        errs = run_case([same, diff], True)
+        assert list(errs) == [1]  # the diverging rank is named
+        assert "different collective sequence" in str(errs[1])
