@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Pretrain a Llama-family model with one-line hybrid parallelism.
+
+Single host:
+    python examples/train_llama.py --preset tiny --steps 20
+v5e-64 pod (per host, via the launcher):
+    python -m paddle_tpu.launch --nnodes 8 examples/train_llama.py \
+        --preset llama2-7b --dp 8 --sharding 8
+
+The script is the reference fleet recipe restated TPU-first: strategy →
+mesh, model + AdamW + bf16 master weights → one donated XLA program per
+step (see README Quickstart / docs/ARCHITECTURE.md §2).
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # the TPU plugin overrides the env var; config wins
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="tiny")
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=256)
+    ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--dp", type=int, default=0)
+    ap.add_argument("--mp", type=int, default=1)
+    ap.add_argument("--pp", type=int, default=1)
+    ap.add_argument("--sharding", type=int, default=1)
+    ap.add_argument("--loss-chunks", type=int, default=1)
+    args = ap.parse_args()
+
+    import paddle_tpu as pt
+    from paddle_tpu import amp, nn, optimizer
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.llama import causal_lm_loss, llama
+
+    if args.dp or args.mp > 1 or args.pp > 1 or args.sharding > 1:
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": args.dp or 1, "mp_degree": args.mp,
+                            "pp_degree": args.pp,
+                            "sharding_degree": args.sharding}
+        fleet.init(is_collective=True, strategy=s)
+
+    pt.seed(0)
+    model = llama(args.preset, max_position_embeddings=args.seq,
+                  loss_seq_chunks=args.loss_chunks)
+    opt = optimizer.AdamW(learning_rate=args.lr, weight_decay=0.1,
+                          grad_clip=nn.ClipGradByGlobalNorm(1.0),
+                          parameters=model.parameters())
+    model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+    step = TrainStep(model, causal_lm_loss, opt)
+    state = step.init_state(seed=0)
+
+    key = jax.random.key(0)
+    ids = jax.random.randint(key, (args.batch, args.seq), 0,
+                             model.cfg.vocab_size)
+    batch = {"input_ids": ids, "labels": jnp.roll(ids, -1, axis=1)}
+
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        state, metrics = step(state, batch)
+        if i % 10 == 0 or i == args.steps - 1:
+            print(f"step {i}: loss={float(metrics['loss']):.4f} "
+                  f"({(time.perf_counter() - t0):.1f}s)", flush=True)
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Parameter-server CTR training (sparse embeddings on host, dense on TPU).
+
+Single-process demo (in-process servers):
+    python examples/train_ps_ctr.py --steps 100
+
+Real PS cluster (reference role env protocol):
+    PADDLE_TRAINING_ROLE=PSERVER ... python examples/train_ps_ctr.py
+    PADDLE_TRAINING_ROLE=TRAINER ... python examples/train_ps_ctr.py
+
+The pattern (docs/ARCHITECTURE.md §3 "Parameter server"): pull the
+batch's embedding rows host-side, run the dense half as one jitted step
+on the chip, push row gradients back.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # the TPU plugin overrides the env var; config wins
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--dim", type=int, default=16)
+    ap.add_argument("--vocab", type=int, default=10000)
+    ap.add_argument("--servers", type=int, default=2)
+    args = ap.parse_args()
+
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.ps import (DistributedEmbedding,
+                                           PaddleCloudRoleMaker, PsRuntime,
+                                           TableConfig)
+
+    tables = [TableConfig("emb", "sparse", dim=args.dim, rule="adagrad",
+                          lr=0.1,
+                          initializer=lambda rng, s: rng.uniform(-.05, .05, s))]
+
+    if os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST"):
+        role = PaddleCloudRoleMaker()
+        rt = fleet.init(role, is_collective=False)
+        fleet.set_ps_tables(tables)
+        if fleet.is_server():
+            fleet.init_server()
+            fleet.run_server()
+            return
+        fleet.init_worker()
+    else:
+        rt = PsRuntime.local(tables, num_servers=args.servers)
+
+    emb = DistributedEmbedding(rt, "emb", args.dim)
+    w = jnp.zeros((args.dim,), jnp.float32)
+
+    @jax.jit
+    def step(w, rows, inverse, labels):
+        def loss_fn(w, rows):
+            feats = rows[inverse].sum(1)
+            p = jax.nn.sigmoid(feats @ w)
+            eps = 1e-6
+            return -jnp.mean(labels * jnp.log(p + eps)
+                             + (1 - labels) * jnp.log(1 - p + eps))
+        loss, (dw, drows) = jax.value_and_grad(loss_fn, (0, 1))(w, rows)
+        return loss, w - 0.1 * dw, drows
+
+    rng = np.random.default_rng(0)
+    score = rng.normal(size=args.vocab)
+    for i in range(args.steps):
+        ids = rng.integers(0, args.vocab, size=(64, 8))
+        labels = jnp.asarray((score[ids].sum(1) > 0).astype(np.float32))
+        rows, inv = emb.pull(ids)
+        loss, w, drows = step(w, jnp.asarray(rows), jnp.asarray(inv), labels)
+        emb.push(np.asarray(drows))
+        if i % 20 == 0 or i == args.steps - 1:
+            print(f"step {i}: loss={float(loss):.4f}", flush=True)
+    if os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST"):
+        fleet.stop_worker()
+    print("done")
+
+
+if __name__ == "__main__":
+    main()
