@@ -1,0 +1,82 @@
+"""HuggingFace checkpoint import.
+
+Reference capability: PaddleNLP's ``from_pretrained`` conversion of HF
+torch checkpoints into paddle weights (PaddleNLP
+paddlenlp/transformers/llama/modeling.py name-mapping tables; SURVEY §0
+scope note — the model zoo lives in sibling repos).
+
+Our module names already mirror HF (``model.layers.N.self_attn.q_proj``),
+so conversion is: (a) transpose 2-D linear kernels — torch ``nn.Linear``
+stores ``[out, in]``, this framework (paddle convention) stores
+``[in, out]``; (b) keep embeddings/norms as-is. Works straight from a
+``transformers`` model object, a torch ``state_dict``, or a dict of
+numpy arrays — no torch required for the numpy path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+__all__ = ["load_hf_state_dict", "from_hf"]
+
+# parameters that keep their layout (everything else 2-D is a linear
+# kernel and gets transposed)
+_NO_TRANSPOSE_SUFFIXES = (
+    "embed_tokens.weight",      # [vocab, hidden] on both sides
+    "input_layernorm.weight",
+    "post_attention_layernorm.weight",
+    "norm.weight",
+)
+
+
+def _to_numpy(v) -> np.ndarray:
+    if isinstance(v, np.ndarray):
+        return v
+    # torch tensor (incl. bf16) without importing torch at module scope
+    if hasattr(v, "detach"):
+        t = v.detach().cpu()
+        if str(t.dtype) == "torch.bfloat16":
+            t = t.float()
+        return t.numpy()
+    return np.asarray(v)
+
+
+def load_hf_state_dict(hf_state: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """HF llama/mixtral-style state_dict → this framework's state_dict."""
+    out = {}
+    for name, val in hf_state.items():
+        arr = _to_numpy(val)
+        if name.endswith("rotary_emb.inv_freq"):
+            continue  # recomputed, never a parameter here
+        if arr.ndim == 2 and not name.endswith(_NO_TRANSPOSE_SUFFIXES):
+            arr = arr.T
+        out[name] = arr
+    return out
+
+
+def from_hf(model, hf_model_or_state) -> None:
+    """Load a transformers model (or its state_dict) into ``model``.
+
+    >>> hf = transformers.LlamaForCausalLM(cfg)
+    >>> net = llama(matching_cfg)
+    >>> from_hf(net, hf)
+    """
+    state = (hf_model_or_state.state_dict()
+             if hasattr(hf_model_or_state, "state_dict")
+             else hf_model_or_state)
+    converted = load_hf_state_dict(state)
+    ours = model.state_dict()
+    missing = [k for k in ours if k not in converted]
+    unexpected = [k for k in converted if k not in ours]
+    if missing or unexpected:
+        raise ValueError(
+            f"HF conversion mismatch — missing: {missing[:5]} "
+            f"unexpected: {unexpected[:5]} "
+            f"({len(missing)}/{len(unexpected)} total)")
+    for k, v in converted.items():
+        if tuple(v.shape) != tuple(ours[k].shape):
+            raise ValueError(
+                f"{k}: converted shape {v.shape} != model {ours[k].shape}")
+    model.set_state_dict(converted)

@@ -205,8 +205,12 @@ class LlamaDecoderLayer(Layer):
             x = x + attn
             x = x + self.mlp(self.post_attention_layernorm(x))
             return x, cache
-        x = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_mask)
-        x = x + self.mlp(self.post_attention_layernorm(x))
+        # named scopes → readable xprof/Perfetto traces (profiler facade)
+        with jax.named_scope("attn"):
+            x = x + self.self_attn(self.input_layernorm(x), cos, sin,
+                                   attn_mask)
+        with jax.named_scope("mlp"):
+            x = x + self.mlp(self.post_attention_layernorm(x))
         return x
 
 

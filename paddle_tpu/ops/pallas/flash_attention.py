@@ -36,6 +36,11 @@ import os
 # (1024,2048) exceeds VMEM.
 DEFAULT_BLOCK_Q = int(os.environ.get("PDTPU_FLASH_BLOCK_Q", 1024))
 DEFAULT_BLOCK_K = int(os.environ.get("PDTPU_FLASH_BLOCK_K", 1024))
+# backward defaults to the forward blocks unless overridden — the bwd
+# kernels have different VMEM pressure (5 operands + 2 scratch), so their
+# optimum can differ from the fwd's
+BWD_BLOCK_Q = int(os.environ.get("PDTPU_FLASH_BWD_BLOCK_Q", 0)) or None
+BWD_BLOCK_K = int(os.environ.get("PDTPU_FLASH_BWD_BLOCK_K", 0)) or None
 NEG_INF = -1e30
 
 
@@ -234,8 +239,8 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
     b, sq, h, d = q.shape
     sk, hkv = k.shape[1], k.shape[2]
     group = h // hkv
-    bq = _pick_block(sq, block_q)
-    bk = _pick_block(sk, block_k)
+    bq = _pick_block(sq, BWD_BLOCK_Q or block_q)
+    bk = _pick_block(sk, BWD_BLOCK_K or block_k)
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
