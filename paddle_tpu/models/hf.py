@@ -45,14 +45,29 @@ def _to_numpy(v) -> np.ndarray:
 
 def load_hf_state_dict(hf_state: Dict[str, Any]) -> Dict[str, np.ndarray]:
     """HF llama/mixtral-style state_dict → this framework's state_dict."""
+    import re
+
     out = {}
+    experts: Dict[str, Dict[int, np.ndarray]] = {}
     for name, val in hf_state.items():
         arr = _to_numpy(val)
         if name.endswith("rotary_emb.inv_freq"):
             continue  # recomputed, never a parameter here
+        m = re.match(r"(.*block_sparse_moe)\.experts\.(\d+)\.(w[123])\.weight$",
+                     name)
+        if m:
+            # Mixtral per-expert w1(gate)/w3(up)/w2(down) [out,in] →
+            # stacked batched kernels [E, in, out]
+            prefix, eid, w = m.group(1), int(m.group(2)), m.group(3)
+            ours = {"w1": "gate_proj__weight", "w3": "up_proj__weight",
+                    "w2": "down_proj__weight"}[w]
+            experts.setdefault(f"{prefix}.{ours}", {})[eid] = arr.T
+            continue
         if arr.ndim == 2 and not name.endswith(_NO_TRANSPOSE_SUFFIXES):
             arr = arr.T
         out[name] = arr
+    for key, by_id in experts.items():
+        out[key] = np.stack([by_id[i] for i in range(len(by_id))])
     return out
 
 
