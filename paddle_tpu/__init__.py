@@ -83,6 +83,10 @@ def __getattr__(name):
         from .hapi import Model
         globals()["Model"] = Model
         return Model
+    if name == "flops":  # paddle.flops lives in hapi (dynamic_flops)
+        from .hapi import flops
+        globals()["flops"] = flops
+        return flops
     if name == "metric":  # paddle.metric alias
         from . import metrics
         globals()["metric"] = metrics

@@ -180,3 +180,22 @@ class TestSpeedMonitor:
         # batch_size comes from fit() via callback params — no re-passing
         assert sm.last["samples_per_sec"] > 0
         assert sm.last["tokens_per_sec"] == sm.last["samples_per_sec"] * 4
+
+
+class TestFlops:
+    def test_linear_flops_exact(self):
+        import paddle_tpu as pt
+        from paddle_tpu import nn
+
+        pt.seed(0)
+        net = nn.Linear(64, 128, bias_attr=False)
+        total = pt.flops(net, input_size=(8, 64), print_detail=True)
+        # one matmul: 2 * batch * in * out
+        expect = 2 * 8 * 64 * 128
+        assert abs(total - expect) <= 0.05 * expect, (total, expect)
+
+    def test_flops_needs_input(self):
+        import paddle_tpu as pt
+        from paddle_tpu import nn
+        with pytest.raises(ValueError, match="input_size"):
+            pt.flops(nn.Linear(4, 4))
