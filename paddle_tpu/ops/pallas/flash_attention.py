@@ -57,6 +57,14 @@ def _block_live(iq, ik, block_q, block_k, offset):
     return iq * block_q + block_q - 1 + offset >= ik * block_k
 
 
+def _block_fully_visible(iq, ik, block_q, block_k, offset):
+    """True when every (row, col) in the tile satisfies the causal
+    predicate — the mask (2 iotas + compare + select per element) can be
+    skipped entirely. For square blocks this is every tile strictly below
+    the diagonal, i.e. most of the live tiles at long seq."""
+    return iq * block_q + offset >= ik * block_k + block_k - 1
+
+
 def _causal_mask(s, iq, ik, block_q, block_k, offset):
     """Apply the bottom-right-aligned causal mask to a score tile."""
     rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + iq * block_q
@@ -81,17 +89,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, m_scr, l_scr, acc_scr, *,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    run = _block_live(iq, ik, block_q, block_k, offset) if causal else True
-
-    @pl.when(run)
-    def _compute():
+    def _body(masked):
         q = q_ref[0, 0]                              # (bq, d), input dtype
         k = k_ref[0, 0]                              # (bk, d)
         v = v_ref[0, 0]                              # (bk, d)
         # MXU runs at full rate on the input dtype (bf16) with f32 accumulate
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
+        if masked:
             s = _causal_mask(s, iq, ik, block_q, block_k, offset)
         m_prev = m_scr[:, 0]                          # (bq,)
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
@@ -103,6 +108,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, m_scr, l_scr, acc_scr, *,
             preferred_element_type=jnp.float32)
         m_scr[:, 0] = m_cur
         l_scr[:, 0] = l_cur
+
+    if not causal:
+        _body(False)
+    else:
+        # grid-step predication: interior (fully visible) tiles skip the
+        # mask's iota/compare/select VPU work entirely
+        live = _block_live(iq, ik, block_q, block_k, offset)
+        full = _block_fully_visible(iq, ik, block_q, block_k, offset)
+        pl.when(live & full)(lambda: _body(False))
+        pl.when(live & jnp.logical_not(full))(lambda: _body(True))
 
     @pl.when(ik == nk - 1)
     def _finalize():
@@ -168,10 +183,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    run = _block_live(iq, ik, block_q, block_k, offset) if causal else True
-
-    @pl.when(run)
-    def _compute():
+    def _body(masked):
         q = q_ref[0, 0]                               # (bq, d)
         k = k_ref[0, 0]                               # (bk, d)
         v = v_ref[0, 0]
@@ -180,7 +192,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0][:, 0]                 # (bq,)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
+        if masked:
             s = _causal_mask(s, iq, ik, block_q, block_k, offset)
         p = jnp.exp(s - lse[:, None])                 # (bq, bk) f32
         dv_scr[:] += jax.lax.dot_general(p.astype(do.dtype), do,
@@ -192,6 +204,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] += jax.lax.dot_general(ds.astype(q.dtype), q,
                                          (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
+
+    if not causal:
+        _body(False)
+    else:
+        live = _block_live(iq, ik, block_q, block_k, offset)
+        full = _block_fully_visible(iq, ik, block_q, block_k, offset)
+        pl.when(live & full)(lambda: _body(False))
+        pl.when(live & jnp.logical_not(full))(lambda: _body(True))
 
     @pl.when(iq == nq - 1)
     def _finalize():
@@ -208,10 +228,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    run = _block_live(iq, ik, block_q, block_k, offset) if causal else True
-
-    @pl.when(run)
-    def _compute():
+    def _body(masked):
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
@@ -220,7 +237,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0][:, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
+        if masked:
             s = _causal_mask(s, iq, ik, block_q, block_k, offset)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -229,6 +246,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_scr[:] += jax.lax.dot_general(ds.astype(k.dtype), k,
                                          (((1,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
+
+    if not causal:
+        _body(False)
+    else:
+        live = _block_live(iq, ik, block_q, block_k, offset)
+        full = _block_fully_visible(iq, ik, block_q, block_k, offset)
+        pl.when(live & full)(lambda: _body(False))
+        pl.when(live & jnp.logical_not(full))(lambda: _body(True))
 
     @pl.when(ik == nk - 1)
     def _finalize():
