@@ -71,6 +71,37 @@ def load_hf_state_dict(hf_state: Dict[str, Any]) -> Dict[str, np.ndarray]:
     return out
 
 
+_GPT2_RENAMES = (
+    ("transformer.wte.", "model.embed_tokens."),
+    ("transformer.wpe.", "model.embed_positions."),
+    ("transformer.ln_f.", "model.ln_f."),
+    ("transformer.h.", "model.h."),
+    (".attn.c_attn.", ".attn.qkv_proj."),
+    (".attn.c_proj.", ".attn.out_proj."),
+    (".mlp.c_fc.", ".mlp.fc_in."),
+    (".mlp.c_proj.", ".mlp.fc_out."),
+)
+
+
+def load_gpt2_state_dict(hf_state: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """HF GPT-2 state_dict → this framework's GPT state_dict.
+
+    GPT-2's ``Conv1D`` already stores kernels ``[in, out]`` (unlike
+    ``nn.Linear``), so this is pure renaming — fused c_attn maps onto our
+    fused qkv_proj directly. The causal-mask buffers (``attn.bias``,
+    ``attn.masked_bias``) and the tied ``lm_head.weight`` are dropped.
+    """
+    out = {}
+    for name, val in hf_state.items():
+        if (name.endswith("attn.bias") and _to_numpy(val).ndim != 1) or \
+                name.endswith("attn.masked_bias") or name == "lm_head.weight":
+            continue
+        for old, new in _GPT2_RENAMES:
+            name = name.replace(old, new)
+        out[name] = _to_numpy(val)
+    return out
+
+
 def from_hf(model, hf_model_or_state) -> None:
     """Load a transformers model (or its state_dict) into ``model``.
 
@@ -81,7 +112,10 @@ def from_hf(model, hf_model_or_state) -> None:
     state = (hf_model_or_state.state_dict()
              if hasattr(hf_model_or_state, "state_dict")
              else hf_model_or_state)
-    converted = load_hf_state_dict(state)
+    if any(k.startswith("transformer.wte") for k in state):
+        converted = load_gpt2_state_dict(state)
+    else:
+        converted = load_hf_state_dict(state)
     ours = model.state_dict()
     missing = [k for k in ours if k not in converted]
     unexpected = [k for k in converted if k not in ours]

@@ -102,3 +102,25 @@ class TestGenerationParity:
                                        temperature=0.0))
         np.testing.assert_array_equal(got[:, ids.shape[1]:],
                                       ref[:, ids.shape[1]:])
+
+
+class TestHfGpt2:
+    def test_logits_parity(self):
+        from paddle_tpu.models.gpt import GPTConfig, gpt
+        hf_cfg = transformers.GPT2Config(
+            vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+            n_inner=None, activation_function="gelu_new",
+            resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+            layer_norm_epsilon=1e-5)
+        torch.manual_seed(0)
+        hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+        ours = gpt(GPTConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=64,
+            tie_word_embeddings=True)).eval()
+        from_hf(ours, hf)
+        ids = np.random.default_rng(3).integers(0, 128, size=(2, 12))
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids)).logits.numpy()
+        got = np.asarray(ours(jnp.asarray(ids)))
+        np.testing.assert_allclose(got, ref, atol=5e-4, rtol=5e-3)
