@@ -1,0 +1,206 @@
+"""BERT-family encoder (PaddleNLP ``BertModel`` scope).
+
+Reference capability: PaddleNLP paddlenlp/transformers/bert/modeling.py
+(the encoder workhorse of the Paddle ecosystem; SURVEY §0 scope note).
+Module names deliberately mirror the HF layout
+(``encoder.layer.N.attention.self.query`` …) so ``models.hf.from_hf``
+imports HF BERT checkpoints by pure transpose, and the torch-oracle
+parity test pins the architecture.
+
+TPU notes: post-LN encoder traces to one XLA program; attention uses the
+shared scaled_dot_product_attention path (flash kernel when applicable,
+bidirectional here so the XLA fallback's full matmul is the right call —
+no causal skipping to exploit).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn.layers_common import Dropout, Embedding, LayerNorm, Linear
+
+__all__ = ["BertConfig", "BertModel", "bert"]
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+PRESETS = {
+    "tiny": BertConfig(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                       num_attention_heads=4, intermediate_size=128,
+                       max_position_embeddings=64,
+                       hidden_dropout=0.0, attention_dropout=0.0),
+    "bert-base": BertConfig(),
+    "bert-large": BertConfig(hidden_size=1024, num_hidden_layers=24,
+                             num_attention_heads=16, intermediate_size=4096),
+}
+
+
+class _Embeddings(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = Embedding(cfg.max_position_embeddings,
+                                             cfg.hidden_size)
+        self.token_type_embeddings = Embedding(cfg.type_vocab_size,
+                                               cfg.hidden_size)
+        self.LayerNorm = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.hidden_dropout)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = jnp.arange(s)[None, :]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros((b, s), jnp.int32)
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.LayerNorm(x))
+
+
+class _SelfAttention(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.query = Linear(cfg.hidden_size, cfg.hidden_size)
+        self.key = Linear(cfg.hidden_size, cfg.hidden_size)
+        self.value = Linear(cfg.hidden_size, cfg.hidden_size)
+        self.nh, self.hd = cfg.num_attention_heads, cfg.head_dim
+        self.p = cfg.attention_dropout
+
+    def forward(self, x, attn_mask=None):
+        b, s, h = x.shape
+        q = self.query(x).reshape(b, s, self.nh, self.hd)
+        k = self.key(x).reshape(b, s, self.nh, self.hd)
+        v = self.value(x).reshape(b, s, self.nh, self.hd)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.p,
+            training=self.training)
+        return out.reshape(b, s, h)
+
+
+class _AttentionOutput(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.dense = Linear(cfg.hidden_size, cfg.hidden_size)
+        self.LayerNorm = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.hidden_dropout)
+
+    def forward(self, x, residual):
+        return self.LayerNorm(residual + self.dropout(self.dense(x)))
+
+
+class _Attention(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.self = _SelfAttention(cfg)
+        self.output = _AttentionOutput(cfg)
+
+    def forward(self, x, attn_mask=None):
+        return self.output(self.self(x, attn_mask), x)
+
+
+class _Intermediate(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.dense = Linear(cfg.hidden_size, cfg.intermediate_size)
+
+    def forward(self, x):
+        return F.gelu(self.dense(x))
+
+
+class _Output(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.dense = Linear(cfg.intermediate_size, cfg.hidden_size)
+        self.LayerNorm = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.hidden_dropout)
+
+    def forward(self, x, residual):
+        return self.LayerNorm(residual + self.dropout(self.dense(x)))
+
+
+class _EncoderLayer(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.attention = _Attention(cfg)
+        self.intermediate = _Intermediate(cfg)
+        self.output = _Output(cfg)
+
+    def forward(self, x, attn_mask=None):
+        x = self.attention(x, attn_mask)
+        return self.output(self.intermediate(x), x)
+
+
+class _Encoder(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        from ..nn.layers_common import LayerList
+        self.layer = LayerList([_EncoderLayer(cfg)
+                                for _ in range(cfg.num_hidden_layers)])
+
+    def forward(self, x, attn_mask=None):
+        for lyr in self.layer:
+            x = lyr(x, attn_mask)
+        return x
+
+
+class _Pooler(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.dense = Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, x):
+        return jnp.tanh(self.dense(x[:, 0]))
+
+
+class BertModel(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = _Embeddings(cfg)
+        self.encoder = _Encoder(cfg)
+        self.pooler = _Pooler(cfg)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        """→ (sequence_output [b,s,h], pooled_output [b,h]) — the
+        PaddleNLP BertModel return shape."""
+        mask = None
+        if attention_mask is not None:
+            # [b, s] 1/0 padding mask → additive [b, 1, 1, s]
+            mask = (1.0 - attention_mask[:, None, None, :].astype(
+                jnp.float32)) * -1e9
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        x = self.encoder(x, mask)
+        return x, self.pooler(x)
+
+
+def bert(name_or_config="tiny", **overrides) -> BertModel:
+    cfg = (PRESETS[name_or_config] if isinstance(name_or_config, str)
+           else name_or_config)
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    return BertModel(cfg)

@@ -28,6 +28,10 @@ _NO_TRANSPOSE_SUFFIXES = (
     "input_layernorm.weight",
     "post_attention_layernorm.weight",
     "norm.weight",
+    # BERT embeddings (2-D lookup tables, not kernels)
+    "word_embeddings.weight",
+    "position_embeddings.weight",
+    "token_type_embeddings.weight",
 )
 
 

@@ -124,3 +124,32 @@ class TestHfGpt2:
             ref = hf(torch.tensor(ids)).logits.numpy()
         got = np.asarray(ours(jnp.asarray(ids)))
         np.testing.assert_allclose(got, ref, atol=5e-4, rtol=5e-3)
+
+
+class TestHfBert:
+    def test_logits_parity(self):
+        from paddle_tpu.models.bert import bert
+        hf_cfg = transformers.BertConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=128,
+            max_position_embeddings=64, type_vocab_size=2,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+            layer_norm_eps=1e-12)
+        torch.manual_seed(0)
+        hf = transformers.BertModel(hf_cfg).eval()
+        ours = bert("tiny").eval()
+        from_hf(ours, hf)
+        rng = np.random.default_rng(4)
+        ids = rng.integers(0, 128, size=(2, 16))
+        mask = np.ones((2, 16), np.int64)
+        mask[1, 10:] = 0  # padding on one row
+        with torch.no_grad():
+            out = hf(torch.tensor(ids), attention_mask=torch.tensor(mask))
+        seq, pooled = ours(jnp.asarray(ids),
+                           attention_mask=jnp.asarray(mask))
+        np.testing.assert_allclose(
+            np.asarray(seq)[:, :10], out.last_hidden_state.numpy()[:, :10],
+            atol=5e-4, rtol=5e-3)
+        np.testing.assert_allclose(np.asarray(pooled),
+                                   out.pooler_output.numpy(),
+                                   atol=5e-4, rtol=5e-3)
