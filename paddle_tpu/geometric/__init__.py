@@ -1,0 +1,89 @@
+"""``paddle.geometric`` parity: graph message passing + segment ops.
+
+Reference: python/paddle/geometric/ (message_passing/send_recv.py —
+``send_u_recv``, ``send_ue_recv``, ``segment_sum/mean/max/min``; sampling
+lives in PGL and is out of the core surface).
+
+TPU redesign: everything lowers to ``jax.ops.segment_*`` scatter-reduces,
+which XLA turns into efficient sorted-segment kernels; fixed
+``num_segments`` keeps shapes static for jit (pass ``out_size`` — the
+reference's knob — whenever the node count is known; defaults fall back
+to ``int(dst.max()) + 1`` which forces a host sync outside jit).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "send_u_recv", "send_ue_recv"]
+
+
+def _num_segments(ids, out_size):
+    if out_size is not None:
+        return int(out_size)
+    return int(jnp.max(ids)) + 1
+
+
+def segment_sum(data, segment_ids, out_size: Optional[int] = None):
+    return jax.ops.segment_sum(data, segment_ids,
+                               num_segments=_num_segments(segment_ids,
+                                                          out_size))
+
+
+def segment_mean(data, segment_ids, out_size: Optional[int] = None):
+    n = _num_segments(segment_ids, out_size)
+    tot = jax.ops.segment_sum(data, segment_ids, num_segments=n)
+    cnt = jax.ops.segment_sum(jnp.ones(data.shape[:1], data.dtype),
+                              segment_ids, num_segments=n)
+    cnt = cnt.reshape((n,) + (1,) * (data.ndim - 1))
+    return tot / jnp.maximum(cnt, 1)
+
+
+def segment_max(data, segment_ids, out_size: Optional[int] = None):
+    n = _num_segments(segment_ids, out_size)
+    out = jax.ops.segment_max(data, segment_ids, num_segments=n)
+    # reference semantics: empty segments are zero, not -inf
+    return jnp.where(jnp.isfinite(out), out, 0).astype(data.dtype)
+
+
+def segment_min(data, segment_ids, out_size: Optional[int] = None):
+    n = _num_segments(segment_ids, out_size)
+    out = jax.ops.segment_min(data, segment_ids, num_segments=n)
+    return jnp.where(jnp.isfinite(out), out, 0).astype(data.dtype)
+
+
+_REDUCERS = {"sum": segment_sum, "mean": segment_mean,
+             "max": segment_max, "min": segment_min}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
+                out_size: Optional[int] = None):
+    """Gather source-node features along edges, reduce at destinations
+    (reference: paddle.geometric.send_u_recv)."""
+    if reduce_op not in _REDUCERS:
+        raise ValueError(f"reduce_op must be one of {sorted(_REDUCERS)}")
+    return _REDUCERS[reduce_op](x[src_index], dst_index, out_size)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum", out_size: Optional[int] = None):
+    """Combine source features with edge features, then reduce at
+    destinations (reference: paddle.geometric.send_ue_recv)."""
+    msg = x[src_index]
+    if message_op == "add":
+        msg = msg + y
+    elif message_op == "sub":
+        msg = msg - y
+    elif message_op == "mul":
+        msg = msg * y
+    elif message_op == "div":
+        msg = msg / y
+    else:
+        raise ValueError("message_op must be add/sub/mul/div")
+    if reduce_op not in _REDUCERS:
+        raise ValueError(f"reduce_op must be one of {sorted(_REDUCERS)}")
+    return _REDUCERS[reduce_op](msg, dst_index, out_size)

@@ -1,0 +1,47 @@
+"""``paddle.signal`` parity: stft / istft.
+
+Reference: python/paddle/signal.py (stft, istft over the fft ops).
+stft is shared with ``paddle_tpu.audio``; istft is the overlap-add
+inverse with window-envelope normalization (NOLA), trace-compatible.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..audio import get_window, stft  # noqa: F401  (stft re-exported)
+
+__all__ = ["stft", "istft"]
+
+
+def istft(x, n_fft=512, hop_length=None, win_length=None, window="hann",
+          center=True, length=None):
+    """Inverse of :func:`stft`. x: complex (..., n_fft//2+1, frames) →
+    real (..., T)."""
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    win = get_window(window, wl)
+    if wl < n_fft:
+        pad = (n_fft - wl) // 2
+        win = jnp.pad(win, (pad, n_fft - wl - pad))
+    frames = jnp.fft.irfft(jnp.swapaxes(x, -1, -2), n=n_fft, axis=-1)
+    frames = frames * win                       # (..., n_frames, n_fft)
+    n_frames = frames.shape[-2]
+    t_full = n_fft + hop * (n_frames - 1)
+    # overlap-add via scatter
+    out = jnp.zeros(frames.shape[:-2] + (t_full,), frames.dtype)
+    env = jnp.zeros((t_full,), frames.dtype)
+    win_sq = win * win
+    for f in range(n_frames):  # unrolled: n_frames is static under jit
+        sl = slice(f * hop, f * hop + n_fft)
+        out = out.at[..., sl].add(frames[..., f, :])
+        env = env.at[sl].add(win_sq)
+    out = out / jnp.maximum(env, 1e-11)
+    if center:
+        out = out[..., n_fft // 2: t_full - n_fft // 2]
+    if length is not None:
+        out = out[..., :length]
+        if out.shape[-1] < length:
+            pad_cfg = [(0, 0)] * (out.ndim - 1) + [(0, length - out.shape[-1])]
+            out = jnp.pad(out, pad_cfg)
+    return out

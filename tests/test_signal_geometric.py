@@ -1,0 +1,69 @@
+"""paddle.signal (stft/istft round-trip) and paddle.geometric
+(segment ops, send_u_recv/send_ue_recv) parity tests."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import geometric as G
+from paddle_tpu import signal as S
+
+
+class TestSignal:
+    def test_stft_istft_roundtrip(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(2, 2048)).astype(np.float32))
+        spec = S.stft(x, n_fft=256, hop_length=64)
+        assert spec.shape == (2, 129, 2048 // 64 + 1)
+        back = S.istft(spec, n_fft=256, hop_length=64, length=2048)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x),
+                                   atol=1e-4)
+
+    def test_istft_inside_jit(self):
+        x = jnp.ones((1, 512))
+        f = jax.jit(lambda x: S.istft(S.stft(x, n_fft=128, hop_length=32),
+                                      n_fft=128, hop_length=32, length=512))
+        np.testing.assert_allclose(np.asarray(f(x)), 1.0, atol=1e-4)
+
+
+class TestGeometric:
+    def test_segment_ops(self):
+        data = jnp.array([[1., 2.], [3., 4.], [5., 6.], [7., 8.]])
+        ids = jnp.array([0, 0, 1, 3])
+        np.testing.assert_allclose(G.segment_sum(data, ids, out_size=4),
+                                   [[4, 6], [5, 6], [0, 0], [7, 8]])
+        np.testing.assert_allclose(G.segment_mean(data, ids, out_size=4),
+                                   [[2, 3], [5, 6], [0, 0], [7, 8]])
+        np.testing.assert_allclose(G.segment_max(data, ids, out_size=4),
+                                   [[3, 4], [5, 6], [0, 0], [7, 8]])
+        np.testing.assert_allclose(G.segment_min(data, ids, out_size=4),
+                                   [[1, 2], [5, 6], [0, 0], [7, 8]])
+
+    def test_send_u_recv(self):
+        x = jnp.array([[1.0], [2.0], [4.0]])
+        src = jnp.array([0, 1, 2, 2])
+        dst = jnp.array([1, 2, 0, 0])
+        out = G.send_u_recv(x, src, dst, reduce_op="sum", out_size=3)
+        np.testing.assert_allclose(out, [[8.0], [1.0], [2.0]])
+        out = G.send_u_recv(x, src, dst, reduce_op="mean", out_size=3)
+        np.testing.assert_allclose(out, [[4.0], [1.0], [2.0]])
+
+    def test_send_ue_recv_and_jit(self):
+        x = jnp.array([[1.0], [2.0]])
+        e = jnp.array([[10.0], [20.0]])
+        src = jnp.array([0, 1])
+        dst = jnp.array([1, 1])
+        out = G.send_ue_recv(x, e, src, dst, "add", "sum", out_size=2)
+        np.testing.assert_allclose(out, [[0.0], [33.0]])
+        f = jax.jit(lambda x: G.send_u_recv(x, src, dst, "max", out_size=2))
+        np.testing.assert_allclose(f(x), [[0.0], [2.0]])
+
+    def test_bad_ops_raise(self):
+        x = jnp.zeros((2, 1))
+        with pytest.raises(ValueError, match="reduce_op"):
+            G.send_u_recv(x, jnp.array([0]), jnp.array([1]), "prod", 2)
+        with pytest.raises(ValueError, match="message_op"):
+            G.send_ue_recv(x, x, jnp.array([0, 1]), jnp.array([0, 1]),
+                           "pow", "sum", 2)
