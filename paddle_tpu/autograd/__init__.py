@@ -110,3 +110,53 @@ def backward(tensors, grad_tensors=None):  # pragma: no cover - guidance only
         "paddle_tpu has no eager tape: use paddle_tpu.autograd.value_and_grad "
         "or the Trainer/jit.train_step compiled path (see docs/MIGRATION.md). "
         "Reference parity: egr::Backward is replaced by jax.grad tracing.")
+
+
+# ---------------------------------------------------------------------------
+# functional higher-order AD (reference: paddle.autograd.jacobian/hessian,
+# paddle.incubate.autograd.{Jacobian,Hessian,jvp,vjp} — python/paddle/
+# autograd/autograd.py). On TPU these ARE jax's transforms; the wrappers
+# keep the reference call shapes.
+# ---------------------------------------------------------------------------
+
+def jacobian(func, xs, batch_axis=None, mode="rev"):
+    """J[i,j] = d func(xs)[i] / d xs[j]. ``mode``: 'rev' (jacrev, tall
+    Jacobians) or 'fwd' (jacfwd, wide Jacobians)."""
+    import jax
+    jac_fn = jax.jacrev if mode == "rev" else jax.jacfwd
+    if batch_axis is None:
+        return jac_fn(func)(xs)
+    if batch_axis != 0:
+        raise ValueError("batch_axis must be None or 0")
+    return jax.vmap(jac_fn(func))(xs)
+
+
+def hessian(func, xs, batch_axis=None):
+    """H[i,j] = d^2 func(xs) / d xs[i] d xs[j] for scalar-output func."""
+    import jax
+    if batch_axis is None:
+        return jax.hessian(func)(xs)
+    if batch_axis != 0:
+        raise ValueError("batch_axis must be None or 0")
+    return jax.vmap(jax.hessian(func))(xs)
+
+
+def jvp(func, xs, v):
+    """Forward-mode: (func(xs), J @ v) — reference incubate.autograd.jvp."""
+    import jax
+    xs = xs if isinstance(xs, (tuple, list)) else (xs,)
+    v = v if isinstance(v, (tuple, list)) else (v,)
+    return jax.jvp(func, tuple(xs), tuple(v))
+
+
+def vjp(func, xs, v=None):
+    """Reverse-mode: (func(xs), v^T @ J) — reference incubate.autograd.vjp.
+    With v=None and scalar output, returns plain gradients."""
+    import jax
+    xs = xs if isinstance(xs, (tuple, list)) else (xs,)
+    out, pullback = jax.vjp(func, *xs)
+    if v is None:
+        import jax.numpy as jnp
+        v = jnp.ones_like(out)
+    grads = pullback(v)
+    return out, grads if len(grads) > 1 else grads[0]

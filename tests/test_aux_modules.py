@@ -156,3 +156,40 @@ def test_summary_table():
     table = p.summary()
     assert "x" in table and "Calls" in table
     p.stop()
+
+
+class TestHigherOrderAD:
+    """paddle.autograd.jacobian/hessian + incubate jvp/vjp parity."""
+
+    def test_jacobian_modes(self):
+        import jax.numpy as jnp
+        from paddle_tpu import autograd as ag
+
+        f = lambda x: jnp.stack([x[0] ** 2, x[0] * x[1], x[1] ** 3])
+        x = jnp.array([2.0, 3.0])
+        expect = np.array([[4.0, 0.0], [3.0, 2.0], [0.0, 27.0]])
+        np.testing.assert_allclose(ag.jacobian(f, x, mode="rev"), expect)
+        np.testing.assert_allclose(ag.jacobian(f, x, mode="fwd"), expect)
+        xb = jnp.stack([x, 2 * x])
+        jb = ag.jacobian(f, xb, batch_axis=0)
+        assert jb.shape == (2, 3, 2)
+
+    def test_hessian(self):
+        import jax.numpy as jnp
+        from paddle_tpu import autograd as ag
+
+        f = lambda x: (x[0] ** 2 * x[1] + x[1] ** 3)
+        H = ag.hessian(f, jnp.array([1.0, 2.0]))
+        np.testing.assert_allclose(H, [[4.0, 2.0], [2.0, 12.0]])
+
+    def test_jvp_vjp(self):
+        import jax.numpy as jnp
+        from paddle_tpu import autograd as ag
+
+        f = lambda x: jnp.sin(x).sum()
+        x = jnp.array([0.0, jnp.pi / 2])
+        out, tangent = ag.jvp(f, x, jnp.array([1.0, 1.0]))
+        np.testing.assert_allclose(float(tangent), 1.0, atol=1e-6)
+        out, grads = ag.vjp(f, x)
+        np.testing.assert_allclose(np.asarray(grads),
+                                   np.cos(np.asarray(x)), atol=1e-6)
