@@ -64,9 +64,11 @@ def main():
     # default stays bs4 + unchunked: 0.437 vs 0.435 chunked, sweep
     # 2026-07-30) — the knob exists for memory-tight configs
     loss_chunks = int(os.environ.get("PDTPU_BENCH_LOSS_CHUNKS", 1))
+    fuse = os.environ.get("PDTPU_BENCH_FUSE", "0") == "1"
     pt.seed(0)
     model = llama(preset, max_position_embeddings=seq_len,
-                  use_recompute=remat, loss_seq_chunks=loss_chunks)
+                  use_recompute=remat, loss_seq_chunks=loss_chunks,
+                  fuse_qkv_mlp=fuse)
     cfg = model.cfg
     opt = optimizer.AdamW(learning_rate=3e-4, weight_decay=0.1,
                           grad_clip=nn.ClipGradByGlobalNorm(1.0),

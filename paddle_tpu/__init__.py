@@ -69,7 +69,7 @@ def __getattr__(name):
     if name in ("distributed", "io", "ckpt", "models", "profiler", "metrics",
                 "vision", "incubate", "hapi", "static", "device", "launch",
                 "utils", "config", "sparse", "quantization", "inference",
-                "audio", "distribution", "geometric", "signal"):
+                "audio", "distribution", "geometric", "signal", "regularizer"):
         try:
             mod = importlib.import_module(f".{name}", __name__)
         except ModuleNotFoundError as e:

@@ -52,6 +52,7 @@ class LlamaConfig:
     num_microbatches: Optional[int] = None  # default: pipeline_stages
     virtual_pp_degree: int = 1      # interleaved-schedule chunks per stage
     loss_seq_chunks: int = 1        # >1: rematerialized seq-chunked vocab CE
+    fuse_qkv_mlp: bool = False      # trace-time concat of qkv / gate+up kernels
     dtype: str = "float32"
 
     @property
@@ -125,9 +126,26 @@ class LlamaAttention(Layer):
                 seq_lens=None):
         cfg = self.cfg
         b, s = x.shape[:2]
-        q = self.q_proj(x).reshape(b, s, cfg.num_attention_heads, cfg.head_dim)
-        k = self.k_proj(x).reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
-        v = self.v_proj(x).reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
+        if cfg.fuse_qkv_mlp and not cfg.sequence_parallel:
+            # one [h, h+2kv] matmul instead of three — parameters stay
+            # separate (HF import / TP specs untouched); the concat is a
+            # cheap trace-time reshuffle XLA schedules once per step
+            h_out = cfg.num_attention_heads * cfg.head_dim
+            kv = cfg.num_key_value_heads * cfg.head_dim
+            w = jnp.concatenate([self.q_proj.weight, self.k_proj.weight,
+                                 self.v_proj.weight], axis=1)
+            qkv = x @ w.astype(x.dtype)
+            q, k, v = jnp.split(qkv, [h_out, h_out + kv], axis=-1)
+            q = q.reshape(b, s, cfg.num_attention_heads, cfg.head_dim)
+            k = k.reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
+            v = v.reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
+        else:
+            q = self.q_proj(x).reshape(b, s, cfg.num_attention_heads,
+                                       cfg.head_dim)
+            k = self.k_proj(x).reshape(b, s, cfg.num_key_value_heads,
+                                       cfg.head_dim)
+            v = self.v_proj(x).reshape(b, s, cfg.num_key_value_heads,
+                                       cfg.head_dim)
         # heads are mp-sharded (they came from column-parallel projections)
         q = constrain(q, ("dp", "sharding"), None, "mp", None)
         k = constrain(k, ("dp", "sharding"), None, "mp", None)
@@ -171,6 +189,7 @@ class LlamaAttention(Layer):
 class LlamaMLP(Layer):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
+        self.cfg = cfg
         h, i = cfg.hidden_size, cfg.intermediate_size
         attr = _weight_attr(cfg)
         sp = cfg.sequence_parallel
@@ -182,6 +201,13 @@ class LlamaMLP(Layer):
                                            weight_attr=attr, sequence_parallel=sp)
 
     def forward(self, x):
+        cfg = self.cfg
+        if cfg.fuse_qkv_mlp and not cfg.sequence_parallel:
+            w = jnp.concatenate([self.gate_proj.weight, self.up_proj.weight],
+                                axis=1)
+            gu = x @ w.astype(x.dtype)
+            g, u = jnp.split(gu, 2, axis=-1)
+            return self.down_proj(F.swiglu(g, u))
         return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
 
 

@@ -45,6 +45,17 @@ class Optimizer:
                  multi_precision=False, apply_decay_param_fun: Optional[Callable] = None):
         self._lr = learning_rate
         self.weight_decay = weight_decay or 0.0
+        # paddle.regularizer objects are accepted wherever a scalar is
+        # (reference: optimizer.py regularization= / weight_decay=)
+        from ..regularizer import L1Decay, L2Decay
+        self._l1_coeff = 0.0
+        if isinstance(self.weight_decay, L1Decay):
+            self._l1_coeff = self.weight_decay.coeff
+            self._wd_coeff = 0.0
+        elif isinstance(self.weight_decay, L2Decay):
+            self._wd_coeff = self.weight_decay.coeff
+        else:
+            self._wd_coeff = float(self.weight_decay)
         self.grad_clip = grad_clip
         self.multi_precision = multi_precision
         self.apply_decay_param_fun = apply_decay_param_fun
@@ -95,7 +106,10 @@ class Optimizer:
             p_compute = master if master is not None else p
             slots = {k: v[name] for k, v in state.items()
                      if isinstance(v, dict) and k not in ("master",) and name in v}
-            wd = self.weight_decay if decay_mask.get(name, True) else 0.0
+            wd = self._wd_coeff if decay_mask.get(name, True) else 0.0
+            if self._l1_coeff and decay_mask.get(name, True):
+                # L1Decay: subgradient of coeff*|w| added to the grad
+                g = g + self._l1_coeff * jnp.sign(p_compute)
             new_p, new_slots = self._update_one(
                 name, p_compute.astype(jnp.float32), g.astype(jnp.float32),
                 lr, slots, step, wd)

@@ -13,7 +13,10 @@ import threading
 import warnings
 from typing import Optional
 
+from . import cpp_extension  # noqa: F401
+
 __all__ = ["try_import", "run_check", "unique_name", "deprecated",
+           "cpp_extension",
            "require_version"]
 
 

@@ -1,0 +1,73 @@
+"""``paddle.utils.cpp_extension`` parity: runtime C++ custom-op builds.
+
+Reference: python/paddle/utils/cpp_extension/ (setup/load compile custom
+operators against libpaddle with nvcc/gcc).
+
+TPU redesign: custom device code is Pallas (Python), so the native
+extension path targets the HOST runtime — the same role as the rest of
+``native/``: data-loader transforms, tokenizers, IO. ``load()`` compiles
+C/C++ sources with the system toolchain into a shared object (cached by
+source hash) and returns a ``ctypes.CDLL``; declare signatures on the
+returned handle. No Python.h needed — plain ``extern "C"`` functions,
+the ctypes pattern used by ``paddle_tpu.runtime_native``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional, Sequence
+
+__all__ = ["load", "get_build_directory"]
+
+
+def get_build_directory() -> str:
+    d = os.environ.get("PDTPU_EXTENSION_DIR",
+                       os.path.join(tempfile.gettempdir(),
+                                    "pdtpu_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load(name: str, sources: Sequence[str], extra_cflags: Sequence[str] = (),
+         extra_ldflags: Sequence[str] = (), verbose: bool = False,
+         build_directory: Optional[str] = None) -> ctypes.CDLL:
+    """Compile ``sources`` (paths or inline code strings containing a
+    newline) into ``lib<name>.so`` and dlopen it. Rebuilds only when the
+    combined source/flags hash changes."""
+    build_dir = build_directory or get_build_directory()
+    texts = []
+    for s in sources:
+        if "\n" in s:  # inline source string
+            texts.append(s)
+        else:
+            with open(s) as f:
+                texts.append(f.read())
+    h = hashlib.sha256(
+        ("\0".join(texts) + repr(tuple(extra_cflags))
+         + repr(tuple(extra_ldflags))).encode()).hexdigest()[:16]
+    lib_path = os.path.join(build_dir, f"lib{name}_{h}.so")
+    if not os.path.exists(lib_path):
+        compile_srcs = []
+        for i, s in enumerate(sources):
+            if "\n" in s:  # materialize inline source
+                p = os.path.join(build_dir, f"{name}_{h}_{i}.cc")
+                with open(p, "w") as f:
+                    f.write(s)
+                compile_srcs.append(p)
+            else:
+                compile_srcs.append(s)
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+               *extra_cflags, *compile_srcs, "-o", lib_path, *extra_ldflags]
+        if verbose:
+            print("cpp_extension:", " ".join(cmd))
+        try:
+            subprocess.run(cmd, check=True, capture_output=not verbose)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"cpp_extension build failed:\n"
+                f"{(e.stderr or b'').decode(errors='replace')}") from e
+    return ctypes.CDLL(lib_path)
