@@ -69,7 +69,8 @@ def __getattr__(name):
     if name in ("distributed", "io", "ckpt", "models", "profiler", "metrics",
                 "vision", "incubate", "hapi", "static", "device", "launch",
                 "utils", "config", "sparse", "quantization", "inference",
-                "audio", "distribution", "geometric", "signal", "regularizer"):
+                "audio", "distribution", "geometric", "signal", "regularizer",
+                "callbacks"):
         try:
             mod = importlib.import_module(f".{name}", __name__)
         except ModuleNotFoundError as e:

@@ -1,0 +1,12 @@
+"""``paddle.callbacks`` namespace parity (reference exposes the hapi
+callbacks at top level: paddle.callbacks.{Callback,ProgBarLogger,
+ModelCheckpoint,EarlyStopping,LRScheduler,VisualDL,...})."""
+
+from .hapi.callbacks import (Callback, CallbackList, EarlyStopping,  # noqa: F401
+                             LogWriterCallback, LRScheduler,
+                             ModelCheckpoint, ProgBarLogger, SpeedMonitor,
+                             VisualDL)
+
+__all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
+           "EarlyStopping", "LRScheduler", "SpeedMonitor",
+           "LogWriterCallback", "VisualDL"]

@@ -339,3 +339,10 @@ def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
                     "metrics": metrics or [], "save_dir": save_dir,
                     "mode": mode, "batch_size": batch_size})
     return lst
+
+
+class VisualDL(LogWriterCallback):
+    """Reference call-shape alias (paddle.callbacks.VisualDL): streams the
+    same scalars to ``log_dir`` as JSONL — point any dashboard at
+    ``metrics.jsonl`` (the VisualDL binary itself is a separate non-pip
+    service; the callback contract is what the framework owes)."""

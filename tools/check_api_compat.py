@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""API-compatibility gate (reference: tools/check_file_diff_approvals.py +
+the API-spec diff CI job — removing/changing public API requires review).
+
+Usage:
+    python tools/check_api_compat.py --update   # record current surface
+    python tools/check_api_compat.py            # fail on removals
+
+The recorded spec (tools/api_spec.txt) lists every public name reachable
+from the package's documented namespaces plus callable signatures.
+Additions pass; removals or signature changes fail the gate.
+"""
+
+import argparse
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+NAMESPACES = [
+    "paddle_tpu", "paddle_tpu.nn", "paddle_tpu.nn.functional",
+    "paddle_tpu.nn.initializer", "paddle_tpu.optimizer",
+    "paddle_tpu.optimizer.lr", "paddle_tpu.amp", "paddle_tpu.autograd",
+    "paddle_tpu.io", "paddle_tpu.metrics", "paddle_tpu.distributed",
+    "paddle_tpu.distributed.fleet", "paddle_tpu.distribution",
+    "paddle_tpu.signal", "paddle_tpu.geometric", "paddle_tpu.regularizer",
+    "paddle_tpu.callbacks", "paddle_tpu.jit", "paddle_tpu.ckpt",
+    "paddle_tpu.hapi", "paddle_tpu.vision", "paddle_tpu.audio",
+    "paddle_tpu.sparse", "paddle_tpu.quantization", "paddle_tpu.incubate",
+    "paddle_tpu.inference", "paddle_tpu.static", "paddle_tpu.profiler",
+    "paddle_tpu.utils",
+]
+
+SPEC_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "api_spec.txt")
+
+
+def public_names(mod):
+    names = getattr(mod, "__all__", None)
+    if names is None:
+        names = [n for n in dir(mod) if not n.startswith("_")]
+    return sorted(set(names))
+
+
+def signature_of(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return ""
+
+
+def collect():
+    import importlib
+    lines = []
+    for ns in NAMESPACES:
+        try:
+            mod = importlib.import_module(ns)
+        except Exception as e:  # never skip silently
+            print(f"FATAL: cannot import {ns}: {e}", file=sys.stderr)
+            sys.exit(2)
+        for name in public_names(mod):
+            obj = getattr(mod, name, None)
+            if obj is None:
+                continue
+            sig = signature_of(obj) if callable(obj) else ""
+            lines.append(f"{ns}.{name}{sig}")
+    return sorted(set(lines))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--update", action="store_true")
+    args = ap.parse_args()
+
+    current = collect()
+    if args.update or not os.path.exists(SPEC_PATH):
+        with open(SPEC_PATH, "w") as f:
+            f.write("\n".join(current) + "\n")
+        print(f"recorded {len(current)} public APIs -> {SPEC_PATH}")
+        return 0
+
+    with open(SPEC_PATH) as f:
+        recorded = set(l.strip() for l in f if l.strip())
+    cur_set = set(current)
+    cur_names = {l.split("(")[0] for l in cur_set}
+
+    removed, changed = [], []
+    for line in sorted(recorded - cur_set):
+        name = line.split("(")[0]
+        (changed if name in cur_names else removed).append(line)
+    added = sorted(l for l in cur_set - recorded
+                   if l.split("(")[0] not in {r.split("(")[0]
+                                              for r in recorded})
+    if added:
+        print(f"{len(added)} new APIs (ok — run --update to record)")
+    if changed:
+        print("SIGNATURE CHANGES (breaking):")
+        for l in changed:
+            print(f"  {l}")
+    if removed:
+        print("REMOVED APIs (breaking):")
+        for l in removed:
+            print(f"  {l}")
+    if removed or changed:
+        print("api-compat gate FAILED")
+        return 1
+    print(f"api-compat gate OK ({len(cur_set)} APIs, {len(added)} new)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Op-benchmark gate (reference: the op-benchmark CI job comparing PR
+kernel timings against baselines).
+
+Times a fixed set of hot ops on the current backend and compares against
+``tools/op_baseline.json`` (per host/backend). Regressions beyond the
+tolerance fail; ``--update`` records new baselines.
+
+    python tools/op_benchmark.py --update
+    python tools/op_benchmark.py --tolerance 0.25
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # the TPU plugin overrides the env var; config wins
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+
+BASE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "op_baseline.json")
+
+
+def _time(f, *args, iters=100):
+    """Per-iter ms, one host sync per block (the tunneled-TPU round-trip
+    is ~100 ms — a large block amortizes it below the noise floor)."""
+    out = f(*args)
+    _ = float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = f(*args)
+        _ = float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best * 1000  # ms
+
+
+def suite():
+    from paddle_tpu.nn import functional as F
+
+    key = jax.random.key(0)
+    x = jax.random.normal(key, (4096, 1024), jnp.bfloat16)
+    w = jax.random.normal(key, (1024, 4096), jnp.bfloat16)
+    q = jax.random.normal(key, (2, 1024, 8, 64), jnp.bfloat16)
+    ops = {
+        "matmul_4kx1kx4k": (jax.jit(lambda a, b: a @ b), (x, w)),
+        "flash_attn_fwd": (jax.jit(lambda q: F.scaled_dot_product_attention(
+            q, q, q, is_causal=True)), (q,)),
+        "rms_norm": (jax.jit(lambda a: a * jax.lax.rsqrt(
+            jnp.mean(a.astype(jnp.float32) ** 2, -1, keepdims=True) + 1e-6
+        ).astype(a.dtype)), (x,)),
+        "softmax_ce": (jax.jit(lambda a: -jax.nn.log_softmax(
+            a.astype(jnp.float32))[..., 0].mean()), (x,)),
+    }
+    return {name: _time(f, *args) for name, (f, args) in ops.items()}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--update", action="store_true")
+    ap.add_argument("--tolerance", type=float, default=0.5,
+                    help="allowed fractional slowdown before failing")
+    args = ap.parse_args()
+
+    backend = jax.default_backend()
+    results = suite()
+    print(json.dumps({"backend": backend, "ms": results}, indent=2))
+
+    base = {}
+    if os.path.exists(BASE_PATH):
+        with open(BASE_PATH) as f:
+            base = json.load(f)
+    if args.update or backend not in base:
+        base[backend] = results
+        with open(BASE_PATH, "w") as f:
+            json.dump(base, f, indent=2)
+        print(f"baseline recorded for {backend!r} -> {BASE_PATH}")
+        return 0
+
+    failures = []
+    for name, ms in results.items():
+        ref = base[backend].get(name)
+        if ref and ms > ref * (1 + args.tolerance):
+            failures.append(f"{name}: {ms:.3f} ms vs baseline {ref:.3f} ms")
+    if failures:
+        print("op-benchmark gate FAILED:")
+        for f_ in failures:
+            print(f"  {f_}")
+        return 1
+    print("op-benchmark gate OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
