@@ -785,3 +785,120 @@ def binary_cross_entropy(input, label, weight=None, reduction="mean"):
     if reduction == "sum":
         return out.sum()
     return out
+
+
+# ---------------------------------------------------------------------------
+# spatial sampling (reference: python/paddle/nn/functional/vision.py —
+# affine_grid, grid_sample; common.py — fold, upsample)
+# ---------------------------------------------------------------------------
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             data_format="NCHW"):
+    """Alias of interpolate (reference keeps both)."""
+    return interpolate(x, size=size, scale_factor=scale_factor, mode=mode,
+                       data_format=data_format)
+
+
+def affine_grid(theta, out_shape, align_corners=True):
+    """theta: (N, 2, 3) affine matrices → sampling grid (N, H, W, 2) in
+    normalized [-1, 1] coords (reference/torch semantics)."""
+    n, h, w = out_shape[0], out_shape[-2], out_shape[-1]
+
+    def axis_coords(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    ys = axis_coords(h)
+    xs = axis_coords(w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)   # (H, W, 3)
+    theta = jnp.asarray(theta)                                # (N, 2, 3)
+    return jnp.einsum("hwk,nik->nhwi", base, theta)           # (N, H, W, 2)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """Sample NCHW ``x`` at normalized grid locations (N, Hg, Wg, 2),
+    xy-ordered like the reference/torch. bilinear|nearest;
+    zeros|border|reflection padding."""
+    n, c, h, w = x.shape
+
+    def denorm(coord, size):
+        coord = coord.astype(jnp.float32)
+        if align_corners:
+            return (coord + 1) * (size - 1) / 2
+        return ((coord + 1) * size - 1) / 2
+
+    gx = denorm(grid[..., 0], w)                              # (N, Hg, Wg)
+    gy = denorm(grid[..., 1], h)
+
+    def reflect(coord, size):
+        if align_corners:
+            span = 2 * (size - 1)
+            if size == 1:
+                return jnp.zeros_like(coord)
+            coord = jnp.abs(coord) % span
+            return jnp.where(coord > size - 1, span - coord, coord)
+        span = 2 * size
+        coord = jnp.abs(coord + 0.5) % span
+        coord = jnp.where(coord > size - 0.5, span - coord, coord) - 0.5
+        return jnp.clip(coord, 0, size - 1)
+
+    def gather(ix, iy):
+        """x[n, :, iy, ix] with out-of-range → 0 (zeros mode)."""
+        inside = ((ix >= 0) & (ix <= w - 1) & (iy >= 0)
+                  & (iy <= h - 1))
+        ixc = jnp.clip(ix, 0, w - 1).astype(jnp.int32)
+        iyc = jnp.clip(iy, 0, h - 1).astype(jnp.int32)
+        batch = jnp.arange(n)[:, None, None]
+        vals = x[batch, :, iyc, ixc]                          # (N,Hg,Wg,C)
+        if padding_mode == "zeros":
+            vals = jnp.where(inside[..., None], vals, 0.0)
+        return vals
+
+    if padding_mode == "reflection":
+        gx, gy = reflect(gx, w), reflect(gy, h)
+    elif padding_mode == "border":
+        gx = jnp.clip(gx, 0, w - 1)
+        gy = jnp.clip(gy, 0, h - 1)
+
+    if mode == "nearest":
+        out = gather(jnp.round(gx), jnp.round(gy))
+        return jnp.moveaxis(out, -1, 1)
+
+    x0, y0 = jnp.floor(gx), jnp.floor(gy)
+    x1, y1 = x0 + 1, y0 + 1
+    wx = (gx - x0)[..., None]
+    wy = (gy - y0)[..., None]
+    out = (gather(x0, y0) * (1 - wx) * (1 - wy)
+           + gather(x1, y0) * wx * (1 - wy)
+           + gather(x0, y1) * (1 - wx) * wy
+           + gather(x1, y1) * wx * wy)
+    return jnp.moveaxis(out, -1, 1)                           # NCHW
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """col2im — inverse of :func:`unfold`; overlaps are summed
+    (reference: paddle.nn.functional.fold)."""
+    oh, ow = ((output_sizes, output_sizes)
+              if isinstance(output_sizes, int) else tuple(output_sizes))
+    k = ((kernel_sizes, kernel_sizes)
+         if isinstance(kernel_sizes, int) else tuple(kernel_sizes))
+    s = (strides, strides) if isinstance(strides, int) else tuple(strides)
+    p = (paddings, paddings) if isinstance(paddings, int) else tuple(paddings)
+    d = (dilations, dilations) if isinstance(dilations, int) else tuple(dilations)
+    n, ck, L = x.shape
+    c = ck // (k[0] * k[1])
+    nh = (oh + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+    nw = (ow + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+    cols = x.reshape(n, c, k[0], k[1], nh, nw)
+    out = jnp.zeros((n, c, oh + 2 * p[0], ow + 2 * p[1]), x.dtype)
+    for i in range(k[0]):          # static unroll: kernel sizes are small
+        for j in range(k[1]):
+            ys = i * d[0]
+            xs = j * d[1]
+            out = out.at[:, :, ys:ys + nh * s[0]:s[0],
+                         xs:xs + nw * s[1]:s[1]].add(cols[:, :, i, j])
+    return out[:, :, p[0]:p[0] + oh, p[1]:p[1] + ow]

@@ -6,6 +6,8 @@ import pytest
 import torch
 import torch.nn.functional as TF
 
+import jax.numpy as jnp
+
 import paddle_tpu as pt
 from paddle_tpu import nn
 from paddle_tpu.nn import functional as F
@@ -325,3 +327,62 @@ class TestSDXLUNet:
         out = m(jnp.zeros((1, 4, 8, 8)), jnp.array([5]),
                 jnp.zeros((1, 3, 32)))
         assert out.shape == (1, 4, 8, 8)
+
+
+class TestSpatialSampling:
+    """grid_sample / affine_grid / fold vs the torch oracle."""
+
+    def _torch(self):
+        import torch
+        return torch
+
+    @pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+    @pytest.mark.parametrize("pad", ["zeros", "border", "reflection"])
+    @pytest.mark.parametrize("align", [True, False])
+    def test_grid_sample_matches_torch(self, mode, pad, align):
+        torch = self._torch()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 3, 5, 7)).astype(np.float32)
+        grid = rng.uniform(-1.3, 1.3, size=(2, 4, 6, 2)).astype(np.float32)
+        ref = torch.nn.functional.grid_sample(
+            torch.tensor(x), torch.tensor(grid), mode=mode,
+            padding_mode=pad, align_corners=align).numpy()
+        got = np.asarray(F.grid_sample(jnp.asarray(x), jnp.asarray(grid),
+                                       mode=mode, padding_mode=pad,
+                                       align_corners=align))
+        if mode == "nearest":
+            # ties at .5 can round differently; compare off-tie fraction
+            close = np.isclose(got, ref, atol=1e-5)
+            assert close.mean() > 0.97, close.mean()
+        else:
+            np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    @pytest.mark.parametrize("align", [True, False])
+    def test_affine_grid_matches_torch(self, align):
+        torch = self._torch()
+        theta = np.array([[[0.8, 0.1, 0.2], [-0.1, 1.1, -0.3]],
+                          [[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]]], np.float32)
+        ref = torch.nn.functional.affine_grid(
+            torch.tensor(theta), size=(2, 3, 5, 7),
+            align_corners=align).numpy()
+        got = np.asarray(F.affine_grid(jnp.asarray(theta), (2, 3, 5, 7),
+                                       align_corners=align))
+        np.testing.assert_allclose(got, ref, atol=1e-6)
+
+    def test_fold_inverts_unfold(self):
+        torch = self._torch()
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(2, 3, 8, 10)).astype(np.float32)
+        cols = np.asarray(F.unfold(jnp.asarray(x), 3, strides=2, paddings=1))
+        ref = torch.nn.functional.fold(
+            torch.tensor(cols), output_size=(8, 10), kernel_size=3,
+            stride=2, padding=1).numpy()
+        got = np.asarray(F.fold(jnp.asarray(cols), (8, 10), 3, strides=2,
+                                paddings=1))
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_upsample_alias(self):
+        x = jnp.arange(16.0).reshape(1, 1, 4, 4)
+        a = F.upsample(x, scale_factor=2, mode="nearest")
+        b = F.interpolate(x, scale_factor=2, mode="nearest")
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
