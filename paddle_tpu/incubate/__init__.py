@@ -9,5 +9,6 @@
 """
 
 from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
 from ..distributed import moe as distributed_moe  # noqa: F401
 from ..distributed.moe import MoELayer  # noqa: F401

@@ -1,0 +1,100 @@
+"""``paddle.incubate.optimizer`` parity: LookAhead, ModelAverage.
+
+Reference: python/paddle/incubate/optimizer/lookahead.py (slow/fast
+weights, k-step interpolation) and modelaverage.py (running parameter
+average applied for eval, restored for training).
+
+TPU redesign: both are pure wrappers over the inner optimizer's
+functional (init/apply) core, so they compose into the jitted TrainStep
+unchanged — the k-step LookAhead sync is a ``jnp.where`` on
+``step % k == 0`` (no host branch), ModelAverage keeps the running
+average as extra state and ``apply_average``/``restore`` are functional.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """slow += alpha * (fast - slow) every k steps; fast := slow then."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        self.inner = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        # surface parity with the wrapped optimizer
+        self.grad_clip = getattr(inner_optimizer, "grad_clip", None)
+        self.multi_precision = getattr(inner_optimizer, "multi_precision",
+                                       False)
+
+    def init(self, params):
+        state = {"inner": self.inner.init(params),
+                 "slow": {k: v for k, v in params.items()},
+                 "la_step": jnp.zeros((), jnp.int32)}
+        return state
+
+    def apply(self, grads, state, params):
+        new_params, inner_state = self.inner.apply(grads, state["inner"],
+                                                   params)
+        la_step = state["la_step"] + 1
+        sync = (la_step % self.k) == 0
+        out_params: Dict[str, jax.Array] = {}
+        new_slow: Dict[str, jax.Array] = {}
+        for name, fast in new_params.items():
+            slow = state["slow"][name]
+            synced = slow.astype(jnp.float32) + self.alpha * (
+                fast.astype(jnp.float32) - slow.astype(jnp.float32))
+            synced = synced.astype(fast.dtype)
+            new_slow[name] = jnp.where(sync, synced, slow)
+            out_params[name] = jnp.where(sync, synced, fast)
+        return out_params, {"inner": inner_state, "slow": new_slow,
+                            "la_step": la_step}
+
+
+class ModelAverage:
+    """Maintain a running average of parameters; swap it in for eval.
+
+    ``min_average_window``/``max_average_window`` mirror the reference's
+    window semantics (restart accumulation when the window overflows).
+    """
+
+    def __init__(self, inner_optimizer, average_window_rate=0.15,
+                 min_average_window=10000, max_average_window=20000):
+        self.inner = inner_optimizer
+        self.rate = float(average_window_rate)
+        self.min_w = int(min_average_window)
+        self.max_w = int(max_average_window)
+        self.grad_clip = getattr(inner_optimizer, "grad_clip", None)
+        self.multi_precision = getattr(inner_optimizer, "multi_precision",
+                                       False)
+
+    def init(self, params):
+        return {"inner": self.inner.init(params),
+                "sum": {k: jnp.zeros_like(v, jnp.float32)
+                        for k, v in params.items()},
+                "num": jnp.zeros((), jnp.int32)}
+
+    def apply(self, grads, state, params):
+        new_params, inner_state = self.inner.apply(grads, state["inner"],
+                                                   params)
+        num = state["num"] + 1
+        restart = num > self.max_w
+        new_sum = {}
+        for name, p in new_params.items():
+            s = state["sum"][name] + p.astype(jnp.float32)
+            new_sum[name] = jnp.where(restart, p.astype(jnp.float32), s)
+        num = jnp.where(restart, jnp.int32(1), num)
+        return new_params, {"inner": inner_state, "sum": new_sum,
+                            "num": num}
+
+    def average_params(self, state, params):
+        """→ averaged params for evaluation (reference: apply())."""
+        n = jnp.maximum(state["num"], 1).astype(jnp.float32)
+        return {k: (state["sum"][k] / n).astype(v.dtype)
+                for k, v in params.items()}
