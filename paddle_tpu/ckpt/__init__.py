@@ -425,3 +425,15 @@ def async_save(state_dict: Any, path: str) -> AsyncCheckpointer:
     ckpt = AsyncCheckpointer()
     ckpt.save(state_dict, path)
     return ckpt
+
+
+# orbax interop (ecosystem-format checkpoints) — lazy import; see orbax_io
+def __getattr__(name):
+    if name in ("save_orbax", "load_orbax", "async_save_orbax", "orbax_io"):
+        import importlib
+        mod = importlib.import_module(".orbax_io", __name__)
+        globals()["orbax_io"] = mod
+        if name == "orbax_io":
+            return mod
+        return getattr(mod, name)
+    raise AttributeError(f"module 'paddle_tpu.ckpt' has no attribute {name!r}")

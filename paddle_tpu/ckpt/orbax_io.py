@@ -1,0 +1,54 @@
+"""Orbax interop: read/write checkpoints in the TPU-ecosystem format.
+
+Reference capability: paddle's checkpoint files interoperate with its
+ecosystem tooling; on TPU the ecosystem standard is orbax
+(tensorstore-backed sharded arrays, async write). This adapter maps the
+framework's state_dicts (flat name→array, possibly nested train states)
+to orbax PyTree checkpoints, so paddle_tpu training can resume from or
+hand off to maxtext/flax-style pipelines.
+
+The native format (``paddle_tpu.ckpt.save/load``) remains the default —
+it carries reshard-on-load metadata orbax does not; use orbax_io at the
+ecosystem boundary.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+__all__ = ["save_orbax", "load_orbax", "async_save_orbax"]
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.PyTreeCheckpointer()
+
+
+def save_orbax(path: str, state: Any) -> None:
+    """Write ``state`` (any pytree of arrays) as an orbax checkpoint."""
+    path = os.path.abspath(path)
+    _checkpointer().save(path, state, force=True)
+
+
+def load_orbax(path: str, template: Optional[Any] = None) -> Any:
+    """Read an orbax checkpoint. ``template`` (matching pytree of arrays
+    or ShapeDtypeStructs) restores placement/dtype; without it arrays
+    come back as numpy."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    if template is None:
+        return _checkpointer().restore(path)
+    return _checkpointer().restore(
+        path, restore_args=ocp.checkpoint_utils.construct_restore_args(
+            template))
+
+
+def async_save_orbax(path: str, state: Any):
+    """Async write (reference: our ckpt.async_save); returns an object
+    with ``wait_until_finished()``."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+    ckptr.save(path, state, force=True)
+    return ckptr

@@ -174,3 +174,31 @@ def test_missing_key_raises(tmp_path):
     ckpt.save_state_dict({"a": np.ones(2)}, d)
     with pytest.raises(KeyError):
         ckpt.load_state_dict(d, template={"zzz": np.zeros(2)})
+
+
+class TestOrbaxInterop:
+    def test_roundtrip(self, tmp_path):
+        import jax.numpy as jnp
+        from paddle_tpu import ckpt
+
+        state = {"params": {"w": jnp.arange(6.0).reshape(2, 3),
+                            "b": jnp.ones((3,), jnp.bfloat16)},
+                 "step": jnp.int32(7)}
+        p = str(tmp_path / "orbax_ckpt")
+        ckpt.save_orbax(p, state)
+        back = ckpt.load_orbax(p)
+        np.testing.assert_allclose(np.asarray(back["params"]["w"]),
+                                   np.arange(6).reshape(2, 3))
+        assert int(back["step"]) == 7
+        # template restore keeps dtype
+        restored = ckpt.load_orbax(p, template=state)
+        assert restored["params"]["b"].dtype == jnp.bfloat16
+
+    def test_async_save(self, tmp_path):
+        import jax.numpy as jnp
+        from paddle_tpu import ckpt
+
+        p = str(tmp_path / "orbax_async")
+        h = ckpt.async_save_orbax(p, {"x": jnp.zeros((4,))})
+        h.wait_until_finished()
+        assert np.asarray(ckpt.load_orbax(p)["x"]).shape == (4,)
