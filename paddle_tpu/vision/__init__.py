@@ -7,6 +7,7 @@ classes accept pre-downloaded files and there is a RandomDataset for tests.
 
 from . import transforms  # noqa: F401
 from . import models  # noqa: F401
+from . import ops  # noqa: F401
 from . import datasets  # noqa: F401
 from .models import (LeNet, ResNet, resnet18, resnet34, resnet50,  # noqa: F401
                      VGG, vgg11, vgg13, vgg16, vgg19, AlexNet, alexnet,

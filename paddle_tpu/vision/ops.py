@@ -1,0 +1,132 @@
+"""``paddle.vision.ops`` parity: detection primitives.
+
+Reference: python/paddle/vision/ops.py (nms, roi_align, box coders;
+backed by CUDA kernels in phi).
+
+TPU redesign: everything is expressed as fixed-shape tensor math so it
+jits — nms is the classic greedy suppression as a fori_loop over a
+precomputed IoU matrix (no dynamic shapes: returns keep mask/indices
+padded to ``top_k``); roi_align is gather-based bilinear sampling.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["box_iou", "nms", "roi_align"]
+
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU of [N,4] and [M,4] xyxy boxes → [N,M]."""
+    a1, a2 = jnp.split(boxes1, 2, axis=-1)          # [N,2] mins / maxs
+    b1, b2 = jnp.split(boxes2, 2, axis=-1)
+    lt = jnp.maximum(a1[:, None], b1[None])          # [N,M,2]
+    rb = jnp.minimum(a2[:, None], b2[None])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.prod(jnp.clip(a2 - a1, 0), axis=-1)
+    area_b = jnp.prod(jnp.clip(b2 - b1, 0), axis=-1)
+    return inter / jnp.maximum(area_a[:, None] + area_b[None] - inter, 1e-9)
+
+
+def nms(boxes, iou_threshold: float = 0.3, scores=None,
+        top_k: Optional[int] = None):
+    """Greedy non-maximum suppression (reference: paddle.vision.ops.nms).
+
+    Returns indices of kept boxes in descending score order. Without
+    ``top_k`` the result is a concrete (host) int array; with ``top_k``
+    the shape is static [top_k] padded with -1, usable under jit.
+    """
+    n = boxes.shape[0]
+    scores = jnp.arange(n, 0, -1, dtype=jnp.float32) if scores is None \
+        else jnp.asarray(scores)
+    order = jnp.argsort(-scores)
+    sorted_boxes = boxes[order]
+    iou = box_iou(sorted_boxes, sorted_boxes)
+
+    def body(i, keep):
+        # drop i if it overlaps any earlier KEPT box beyond the threshold
+        overlap = (iou[i] > iou_threshold) & keep & \
+            (jnp.arange(n) < i)
+        return keep.at[i].set(~overlap.any())
+
+    keep = jax.lax.fori_loop(1, n, body, jnp.ones((n,), bool))
+    if top_k is None:
+        idx = jnp.nonzero(keep)[0]          # host-concrete path
+        return order[idx]
+    ranked = jnp.where(keep, jnp.arange(n), n)
+    sel = jnp.sort(ranked)[:top_k]
+    return jnp.where(sel < n, order[jnp.clip(sel, 0, n - 1)], -1)
+
+
+def roi_align(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """RoIAlign (reference: paddle.vision.ops.roi_align).
+
+    x: [N,C,H,W]; boxes: [K,4] xyxy in input coords; ``boxes_num``: [N]
+    rois per image (defaults: all rois on image 0). → [K,C,oh,ow].
+    """
+    oh, ow = ((output_size, output_size)
+              if isinstance(output_size, int) else tuple(output_size))
+    n, c, h, w = x.shape
+    k = boxes.shape[0]
+    if boxes_num is None:
+        batch_idx = jnp.zeros((k,), jnp.int32)
+    else:
+        batch_idx = jnp.repeat(jnp.arange(n), boxes_num,
+                               total_repeat_length=k)
+    off = 0.5 if aligned else 0.0
+    x1 = boxes[:, 0] * spatial_scale - off
+    y1 = boxes[:, 1] * spatial_scale - off
+    x2 = boxes[:, 2] * spatial_scale - off
+    y2 = boxes[:, 3] * spatial_scale - off
+    rw = x2 - x1
+    rh = y2 - y1
+    if not aligned:  # legacy: clamp to min size 1
+        rw = jnp.maximum(rw, 1.0)
+        rh = jnp.maximum(rh, 1.0)
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+    # sample grid: sr×sr points per output bin, averaged
+    def bin_coords(start, extent, nbins):
+        # [K, nbins, sr]: start + (bin + (s+0.5)/sr) * extent/nbins
+        s = (jnp.arange(sr) + 0.5) / sr
+        b = jnp.arange(nbins)
+        return (start[:, None, None]
+                + (b[None, :, None] + s[None, None, :])
+                * (extent / nbins)[:, None, None])
+
+    ys = bin_coords(y1, rh, oh)                     # [K, oh, sr]
+    xs = bin_coords(x1, rw, ow)                     # [K, ow, sr]
+
+    def bilinear(img, yy, xx):
+        """img: [C,H,W]; yy/xx: [P] → [P,C]"""
+        y0 = jnp.floor(yy)
+        x0 = jnp.floor(xx)
+        wy = (yy - y0)[:, None]
+        wx = (xx - x0)[:, None]
+
+        def at(yi, xi):
+            inside = (yi >= 0) & (yi <= h - 1) & (xi >= 0) & (xi <= w - 1)
+            v = img[:, jnp.clip(yi, 0, h - 1).astype(jnp.int32),
+                    jnp.clip(xi, 0, w - 1).astype(jnp.int32)]  # [C,P]
+            return jnp.where(inside[None], v, 0.0).T             # [P,C]
+
+        return (at(y0, x0) * (1 - wy) * (1 - wx)
+                + at(y0, x0 + 1) * (1 - wy) * wx
+                + at(y0 + 1, x0) * wy * (1 - wx)
+                + at(y0 + 1, x0 + 1) * wy * wx)
+
+    def roi_pool(i):
+        img = x[batch_idx[i]]
+        ys_r = ys[i]                                 # [oh, sr]
+        xs_r = xs[i]                                 # [ow, sr]
+        yy = jnp.tile(ys_r[:, None, :, None], (1, ow, 1, sr)).reshape(-1)
+        xx = jnp.tile(xs_r[None, :, None, :], (oh, 1, sr, 1)).reshape(-1)
+        vals = bilinear(img, yy, xx)                 # [oh*ow*sr*sr, C]
+        vals = vals.reshape(oh, ow, sr * sr, c).mean(axis=2)
+        return jnp.moveaxis(vals, -1, 0)             # [C, oh, ow]
+
+    return jax.vmap(roi_pool)(jnp.arange(k))

@@ -1,0 +1,74 @@
+"""paddle.vision.ops parity: nms, roi_align, box_iou — hand-computed
+oracles (torchvision is not in the image)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.vision import ops as V
+
+
+class TestBoxIou:
+    def test_known_values(self):
+        a = jnp.array([[0.0, 0, 2, 2], [0, 0, 1, 1]])
+        b = jnp.array([[1.0, 1, 3, 3], [0, 0, 2, 2]])
+        iou = np.asarray(V.box_iou(a, b))
+        np.testing.assert_allclose(iou[0], [1 / 7, 1.0], atol=1e-6)
+        np.testing.assert_allclose(iou[1], [0.0, 0.25], atol=1e-6)
+
+
+class TestNms:
+    def test_greedy_suppression(self):
+        boxes = jnp.array([[0.0, 0, 10, 10],     # score .9 — kept
+                           [1.0, 1, 10, 10],     # high IoU with 0 — dropped
+                           [20.0, 20, 30, 30],   # kept
+                           [0.0, 0, 5, 5]])      # IoU with 0 = .25 — kept @.3
+        scores = jnp.array([0.9, 0.8, 0.7, 0.6])
+        keep = np.asarray(V.nms(boxes, 0.3, scores))
+        np.testing.assert_array_equal(keep, [0, 2, 3])
+
+    def test_static_topk_jit(self):
+        boxes = jnp.array([[0.0, 0, 10, 10], [1.0, 1, 10, 10],
+                           [20.0, 20, 30, 30]])
+        scores = jnp.array([0.9, 0.8, 0.7])
+        f = jax.jit(lambda b, s: V.nms(b, 0.3, s, top_k=3))
+        out = np.asarray(f(boxes, scores))
+        np.testing.assert_array_equal(out, [0, 2, -1])
+
+    def test_threshold_one_keeps_all(self):
+        boxes = jnp.array([[0.0, 0, 2, 2], [0, 0, 2, 2]])
+        keep = np.asarray(V.nms(boxes, 1.0, jnp.array([0.5, 0.9])))
+        np.testing.assert_array_equal(keep, [1, 0])
+
+
+class TestRoiAlign:
+    def test_identity_roi_on_linear_image(self):
+        # image = x coordinate; an aligned full-image roi sampled at the
+        # pixel centres must reproduce the linear ramp exactly
+        h = w = 8
+        img = jnp.broadcast_to(jnp.arange(w, dtype=jnp.float32), (1, 1, h, w))
+        boxes = jnp.array([[0.5, 0.5, w - 0.5, h - 0.5]])  # pixel-centre box
+        out = np.asarray(V.roi_align(img, boxes, output_size=7,
+                                     sampling_ratio=1))
+        assert out.shape == (1, 1, 7, 7)
+        expect = 0.5 + np.arange(7) + 0.0  # centres of 1-px bins from 0.5..7.5
+        np.testing.assert_allclose(out[0, 0, 0], expect, atol=1e-5)
+        # rows identical (image constant along y)
+        np.testing.assert_allclose(out[0, 0], np.tile(expect, (7, 1)),
+                                   atol=1e-5)
+
+    def test_batch_routing_and_scale(self):
+        x = jnp.stack([jnp.zeros((1, 4, 4)), jnp.ones((1, 4, 4))])
+        boxes = jnp.array([[0.0, 0, 8, 8], [0.0, 0, 8, 8]])
+        out = np.asarray(V.roi_align(x, boxes, boxes_num=jnp.array([1, 1]),
+                                     output_size=2, spatial_scale=0.5))
+        np.testing.assert_allclose(out[0], 0.0, atol=1e-6)
+        np.testing.assert_allclose(out[1], 1.0, atol=1e-6)
+
+    def test_grad_flows(self):
+        x = jnp.ones((1, 2, 6, 6))
+        boxes = jnp.array([[1.0, 1, 5, 5]])
+        g = jax.grad(lambda x: V.roi_align(x, boxes, output_size=3).sum())(x)
+        assert np.isfinite(np.asarray(g)).all() and float(jnp.abs(g).sum()) > 0
