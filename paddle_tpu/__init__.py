@@ -88,6 +88,10 @@ def __getattr__(name):
         from .hapi import flops
         globals()["flops"] = flops
         return flops
+    if name == "summary":  # paddle.summary lives in hapi (model_summary)
+        from .hapi import summary
+        globals()["summary"] = summary
+        return summary
     if name == "metric":  # paddle.metric alias
         from . import metrics
         globals()["metric"] = metrics

@@ -6,4 +6,4 @@ Reference: python/paddle/hapi/model.py, callbacks.py, progressbar.py.
 from .callbacks import (Callback, CallbackList, EarlyStopping,  # noqa: F401
                         LogWriterCallback, LRScheduler, ModelCheckpoint,
                         ProgBarLogger, SpeedMonitor, config_callbacks)
-from .model import Model, flops  # noqa: F401
+from .model import Model, flops, summary  # noqa: F401

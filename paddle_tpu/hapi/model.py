@@ -362,3 +362,17 @@ def flops(net, input_size=None, inputs=None, dtype="float32",
         n_params = sum(int(v.size) for v in params.values())
         print(f"FLOPs: {total:,}  Params: {n_params:,}")
     return total
+
+
+def summary(net, input_size=None, dtypes=None):
+    """``paddle.summary`` parity: layer-name/shape/param table for a bare
+    Layer (reference: python/paddle/hapi/model_summary.py)."""
+    lines = [f"{type(net).__name__}:"]
+    total = 0
+    for name, p in net.named_parameters():
+        n = int(p.size)
+        total += n
+        lines.append(f"  {name:50s} {str(tuple(p.shape)):20s} {n}")
+    lines.append(f"Total params: {total}")
+    print("\n".join(lines))
+    return {"total_params": total}
