@@ -1,0 +1,140 @@
+"""``paddle.nn.utils`` parity: weight_norm, spectral_norm,
+parameters_to_vector / vector_to_parameters.
+
+Reference: python/paddle/nn/utils/ (weight_norm_hook.py,
+spectral_norm_hook.py, transform_parameters.py).
+
+TPU redesign: the reference reparameterizes with forward pre-hooks that
+mutate ``layer.weight`` in place. Under functional jax the same effect is
+a wrapper Layer that owns the reparameterized leaves (``weight_g``/
+``weight_v``; spectral ``u``) and computes the effective weight inside
+the traced forward — so the reparameterization differentiates and jits
+like any other computation.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .layer import Layer
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters"]
+
+
+def _norm_except(w, dim):
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(w.astype(jnp.float32)), axis=axes,
+                            keepdims=True))
+
+
+class WeightNormWrapper(Layer):
+    """w = g * v / ||v||  (per-slice along ``dim``)."""
+
+    def __init__(self, layer: Layer, name: str = "weight", dim: int = 0):
+        super().__init__()
+        self.layer = layer
+        self.pname = name
+        self.dim = dim
+        w = getattr(layer, name)
+        g = _norm_except(w, dim).astype(w.dtype)
+        self.weight_g = self.create_parameter(g.shape)
+        self.weight_v = self.create_parameter(w.shape)
+        self.weight_g = g
+        self.weight_v = w
+        # the inner weight is no longer a trainable parameter (reference:
+        # weight_norm deletes it and re-adds weight_g/weight_v)
+        layer._parameters.pop(name, None)
+        layer._param_meta.pop(name, None)
+
+    def forward(self, *args, **kwargs):
+        v = self.weight_v
+        w = (self.weight_g.astype(jnp.float32)
+             * v.astype(jnp.float32) / _norm_except(v, self.dim)).astype(
+                 v.dtype)
+        # swap the effective weight in functionally for this call
+        old = getattr(self.layer, self.pname)
+        setattr(self.layer, self.pname, w)
+        try:
+            return self.layer(*args, **kwargs)
+        finally:
+            setattr(self.layer, self.pname, old)
+
+
+def weight_norm(layer: Layer, name: str = "weight", dim: int = 0):
+    return WeightNormWrapper(layer, name, dim)
+
+
+def remove_weight_norm(wrapped: "WeightNormWrapper") -> Layer:
+    """Bake the current effective weight back into the inner layer."""
+    v = wrapped.weight_v
+    w = (wrapped.weight_g.astype(jnp.float32) * v.astype(jnp.float32)
+         / _norm_except(v, wrapped.dim)).astype(v.dtype)
+    setattr(wrapped.layer, wrapped.pname, w)
+    return wrapped.layer
+
+
+class SpectralNormWrapper(Layer):
+    """w / sigma_max(w), sigma estimated by power iteration whose state
+    (u) rides as a buffer (reference: spectral_norm_hook)."""
+
+    def __init__(self, layer: Layer, name: str = "weight",
+                 n_power_iterations: int = 1, eps: float = 1e-12, dim: int = 0):
+        super().__init__()
+        self.layer = layer
+        self.pname = name
+        self.n_iters = n_power_iterations
+        self.eps = eps
+        self.dim = dim
+        w = getattr(layer, name)
+        h = w.shape[dim]
+        self.register_buffer("u", jax.random.normal(
+            jax.random.key(0), (h,), jnp.float32))
+
+    def forward(self, *args, **kwargs):
+        w = getattr(self.layer, self.pname)
+        mat = jnp.moveaxis(w, self.dim, 0).reshape(w.shape[self.dim], -1)
+        mat = mat.astype(jnp.float32)
+        u = self.u
+        for _ in range(self.n_iters):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + self.eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + self.eps)
+        sigma = u @ mat @ v
+        if not isinstance(u, jax.core.Tracer):
+            # persist power-iteration state only in eager mode — storing a
+            # tracer would leak it across jit traces (under jit each call
+            # re-iterates from the last eager state, which is stable)
+            self.u = jax.lax.stop_gradient(u)
+        w_sn = (w.astype(jnp.float32) / sigma).astype(w.dtype)
+        old = getattr(self.layer, self.pname)
+        setattr(self.layer, self.pname, w_sn)
+        try:
+            return self.layer(*args, **kwargs)
+        finally:
+            setattr(self.layer, self.pname, old)
+
+
+def spectral_norm(layer: Layer, name: str = "weight",
+                  n_power_iterations: int = 1, eps: float = 1e-12,
+                  dim: int = 0):
+    return SpectralNormWrapper(layer, name, n_power_iterations, eps, dim)
+
+
+def parameters_to_vector(parameters: Sequence[jax.Array]) -> jax.Array:
+    return jnp.concatenate([jnp.ravel(p) for p in parameters])
+
+
+def vector_to_parameters(vec: jax.Array,
+                         parameters: Sequence[jax.Array]) -> List[jax.Array]:
+    out = []
+    offset = 0
+    for p in parameters:
+        n = int(p.size)
+        out.append(vec[offset:offset + n].reshape(p.shape).astype(p.dtype))
+        offset += n
+    return out

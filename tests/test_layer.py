@@ -150,3 +150,58 @@ def test_hooks():
     h.remove()
     m(jnp.ones((1, 2)))
     assert calls == [1]
+
+
+class TestNnUtils:
+    def test_weight_norm_reparam(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu import nn
+        from paddle_tpu.nn.utils import remove_weight_norm, weight_norm
+
+        pt.seed(0)
+        lin = nn.Linear(4, 3, bias_attr=False)
+        w0 = np.asarray(lin.weight)
+        wrapped = weight_norm(lin, dim=0)
+        x = jnp.ones((2, 4))
+        out1 = np.asarray(wrapped(x))
+        # effective weight equals original at init: g = ||v||
+        np.testing.assert_allclose(out1, np.ones((2, 4)) @ w0, atol=1e-5)
+        # params are now g and v, not the raw weight
+        names = dict(wrapped.named_parameters())
+        assert any(k.endswith("weight_g") for k in names)
+        assert not any(k.endswith("layer.weight") for k in names)
+        # grads flow to both g and v
+        from paddle_tpu.nn.layer import functional_call, raw_params
+        p = raw_params(wrapped)
+        g = jax.grad(lambda p: functional_call(wrapped, p, x).sum())(p)
+        assert all(np.abs(np.asarray(v)).sum() > 0 for v in g.values())
+        inner = remove_weight_norm(wrapped)
+        np.testing.assert_allclose(np.asarray(inner(x)), out1, atol=1e-5)
+
+    def test_spectral_norm_scales_to_unit_sigma(self):
+        import jax.numpy as jnp
+        from paddle_tpu import nn
+        from paddle_tpu.nn.utils import spectral_norm
+
+        pt.seed(0)
+        lin = nn.Linear(6, 5, bias_attr=False)
+        sn = spectral_norm(lin, n_power_iterations=30)
+        _ = sn(jnp.ones((1, 6)))  # eager: u converges
+        w = np.asarray(lin.weight)
+        sigma = np.linalg.svd(w, compute_uv=False)[0]
+        out = np.asarray(sn(jnp.ones((1, 6))))
+        expect = np.ones((1, 6)) @ (w / sigma)
+        np.testing.assert_allclose(out, expect, rtol=1e-3)
+
+    def test_vector_roundtrip(self):
+        import jax.numpy as jnp
+        from paddle_tpu.nn.utils import (parameters_to_vector,
+                                         vector_to_parameters)
+
+        ps = [jnp.arange(6.0).reshape(2, 3), jnp.ones((4,))]
+        vec = parameters_to_vector(ps)
+        assert vec.shape == (10,)
+        back = vector_to_parameters(vec, ps)
+        for a, b in zip(ps, back):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
