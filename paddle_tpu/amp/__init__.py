@@ -165,3 +165,4 @@ class GradScaler:
 
     def load_state_dict(self, d):
         self._state = dict(d)
+from . import debugging  # noqa: F401

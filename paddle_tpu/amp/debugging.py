@@ -1,0 +1,68 @@
+"""``paddle.amp.debugging`` parity: numeric-anomaly tooling.
+
+Reference: python/paddle/amp/debugging.py (enable_tensor_checker /
+disable_tensor_checker / TensorCheckerConfig / check_numerics — backed
+by FLAGS_check_nan_inf per-op scans, SURVEY §5.2).
+
+TPU mapping: the global checker toggles ``jax_debug_nans`` (XLA re-runs
+the offending computation un-fused and raises at the op, which is the
+reference's per-op scan capability); ``check_numerics`` is a value-level
+probe usable in BOTH modes — eager raises immediately, traced code
+routes through ``jax.debug.callback`` so the error surfaces host-side
+with the user's tag.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["TensorCheckerConfig", "enable_tensor_checker",
+           "disable_tensor_checker", "check_numerics"]
+
+
+@dataclass
+class TensorCheckerConfig:
+    enable: bool = True
+    debug_mode: str = "check_nan_inf_and_abort"  # reference enum names
+    output_dir: Optional[str] = None
+
+
+_active: list = [None]
+
+
+def enable_tensor_checker(config: Optional[TensorCheckerConfig] = None):
+    config = config or TensorCheckerConfig()
+    _active[0] = config
+    jax.config.update("jax_debug_nans", bool(config.enable))
+    return config
+
+
+def disable_tensor_checker():
+    _active[0] = None
+    jax.config.update("jax_debug_nans", False)
+
+
+def _raise_if_bad(n_nan, n_inf, message):
+    if int(n_nan) or int(n_inf):
+        raise FloatingPointError(
+            f"check_numerics failed{': ' + message if message else ''} — "
+            f"{int(n_nan)} NaN and {int(n_inf)} Inf values")
+
+
+def check_numerics(x, message: str = "", raise_on_trace: bool = True):
+    """Assert ``x`` is finite. Returns ``x`` so it can be inserted inline
+    (``h = check_numerics(h, "after attn")``)."""
+    xf = jnp.asarray(x).astype(jnp.float32)
+    n_nan = jnp.sum(jnp.isnan(xf))
+    n_inf = jnp.sum(jnp.isinf(xf))
+    if isinstance(n_nan, jax.core.Tracer):
+        if raise_on_trace:
+            jax.debug.callback(_raise_if_bad, n_nan, n_inf, message,
+                               ordered=False)
+        return x
+    _raise_if_bad(n_nan, n_inf, message)
+    return x

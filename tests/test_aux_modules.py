@@ -254,3 +254,39 @@ class TestCppExtension:
         with pytest.raises(RuntimeError, match="build failed"):
             cpp_extension.load("bad", ["int broken(\n"],
                                build_directory=str(tmp_path))
+
+
+class TestAmpDebugging:
+    def test_check_numerics_eager(self):
+        import jax.numpy as jnp
+        from paddle_tpu.amp import debugging as dbg
+
+        x = jnp.ones((4,))
+        assert dbg.check_numerics(x, "ok") is x
+        bad = x.at[1].set(jnp.nan).at[2].set(jnp.inf)
+        with pytest.raises(FloatingPointError, match="after attn.*1 NaN"):
+            dbg.check_numerics(bad, "after attn")
+
+    def test_check_numerics_traced(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.amp import debugging as dbg
+
+        @jax.jit
+        def f(x):
+            return dbg.check_numerics(x * 2, "traced")
+
+        np.testing.assert_allclose(np.asarray(f(jnp.ones(3))), 2.0)
+        with pytest.raises(Exception, match="traced"):
+            jax.block_until_ready(f(jnp.full((3,), jnp.nan)))
+
+    def test_tensor_checker_toggles_debug_nans(self):
+        import jax
+        from paddle_tpu.amp import debugging as dbg
+
+        cfg = dbg.enable_tensor_checker()
+        try:
+            assert jax.config.jax_debug_nans
+        finally:
+            dbg.disable_tensor_checker()
+        assert not jax.config.jax_debug_nans
