@@ -23,6 +23,7 @@ import jax
 from ...nn.clip import ClipGradByGlobalNorm
 from ..topology import AXIS_ORDER, HybridCommunicateGroup, HybridTopology
 from . import utils  # noqa: F401 — fleet.utils.recompute &c. (reference path)
+from . import elastic  # noqa: F401 — fleet.elastic (reference path)
 
 _HYBRID_PARALLEL_GROUP: Optional[HybridCommunicateGroup] = None
 

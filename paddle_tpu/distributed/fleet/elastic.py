@@ -1,0 +1,12 @@
+"""``paddle.distributed.fleet.elastic`` namespace parity.
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py (etcd
+membership, scale events, relaunch) — SURVEY §2.7/§5.3. The TPU-native
+implementation lives in ``paddle_tpu.launch.elastic`` (store-based
+heartbeats, restart-based elasticity, preemption guard); this module is
+the reference import path.
+"""
+
+from ...launch.elastic import ElasticManager  # noqa: F401
+
+__all__ = ["ElasticManager"]
