@@ -14,6 +14,7 @@ import warnings
 from typing import Optional
 
 from . import cpp_extension  # noqa: F401
+from . import dlpack  # noqa: F401
 
 __all__ = ["try_import", "run_check", "unique_name", "deprecated",
            "cpp_extension",

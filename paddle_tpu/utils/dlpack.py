@@ -1,0 +1,25 @@
+"""``paddle.utils.dlpack`` parity: zero-copy tensor interchange.
+
+Reference: python/paddle/utils/dlpack.py (to_dlpack/from_dlpack).
+
+jax speaks DLPack natively; these wrappers keep the reference call
+shapes and accept any DLPack-exporting object (torch tensors included),
+which is the practical CPU-side interop path for mixed pipelines.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """jax array → DLPack capsule (consumable by torch/numpy/cupy)."""
+    return jnp.asarray(x).__dlpack__()
+
+
+def from_dlpack(capsule_or_tensor):
+    """DLPack capsule or any __dlpack__-exporting object → jax array."""
+    return jnp.from_dlpack(capsule_or_tensor)

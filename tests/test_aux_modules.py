@@ -290,3 +290,18 @@ class TestAmpDebugging:
         finally:
             dbg.disable_tensor_checker()
         assert not jax.config.jax_debug_nans
+
+
+class TestDlpack:
+    def test_torch_roundtrip(self):
+        import torch
+
+        import jax.numpy as jnp
+        from paddle_tpu.utils import dlpack
+
+        t = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+        arr = dlpack.from_dlpack(t)
+        np.testing.assert_array_equal(np.asarray(arr),
+                                      t.numpy())
+        back = torch.from_dlpack(dlpack.to_dlpack(jnp.ones((4,))))
+        np.testing.assert_array_equal(back.numpy(), np.ones(4))
