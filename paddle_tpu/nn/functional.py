@@ -902,3 +902,86 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
             out = out.at[:, :, ys:ys + nh * s[0]:s[0],
                          xs:xs + nw * s[1]:s[1]].add(cols[:, :, i, j])
     return out[:, :, p[0]:p[0] + oh, p[1]:p[1] + ow]
+
+
+# ---------------------------------------------------------------------------
+# loss long tail (reference: python/paddle/nn/functional/loss.py)
+# ---------------------------------------------------------------------------
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC loss (reference: paddle.nn.functional.ctc_loss over warpctc).
+
+    log_probs: [T, B, C] log-probabilities (reference layout);
+    labels: [B, L] padded label ids; lengths per sample. Lowers to
+    optax's TPU-friendly lattice implementation.
+    """
+    import optax
+    del norm_by_times
+    # optax wants [B, T, C] logits and paddings
+    logits = jnp.transpose(log_probs, (1, 0, 2))
+    b, t, _ = logits.shape
+    l = labels.shape[1]
+    logit_pad = (jnp.arange(t)[None] >= input_lengths[:, None]).astype(
+        jnp.float32)
+    label_pad = (jnp.arange(l)[None] >= label_lengths[:, None]).astype(
+        jnp.float32)
+    per_seq = optax.ctc_loss(logits, logit_pad, labels, label_pad,
+                             blank_id=blank)
+    if reduction == "mean":
+        # reference averages per-label-length then over batch
+        return jnp.mean(per_seq / jnp.maximum(label_lengths, 1))
+    if reduction == "sum":
+        return jnp.sum(per_seq)
+    return per_seq
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean"):
+    d = input - label
+    ad = jnp.abs(d)
+    out = jnp.where(ad <= delta, 0.5 * d * d, delta * (ad - 0.5 * delta))
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2,
+                        epsilon=1e-6, swap=False, reduction="mean"):
+    def dist(a, b):
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a - b) + epsilon, p),
+                                 axis=-1), 1.0 / p)
+
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(positive, negative))
+    out = jnp.maximum(d_pos - d_neg + margin, 0.0)
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean"):
+    cos = jnp.sum(input1 * input2, -1) / jnp.maximum(
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1),
+        1e-12)
+    out = jnp.where(label > 0, 1.0 - cos, jnp.maximum(cos - margin, 0.0))
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    out = jnp.where(label > 0, input, jnp.maximum(margin - input, 0.0))
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out

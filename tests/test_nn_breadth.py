@@ -386,3 +386,50 @@ class TestSpatialSampling:
         a = F.upsample(x, scale_factor=2, mode="nearest")
         b = F.interpolate(x, scale_factor=2, mode="nearest")
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestLossLongTail:
+    def test_ctc_loss_matches_torch(self):
+        torch.manual_seed(0)
+        T, B, C, L = 12, 3, 5, 4
+        logits = torch.randn(T, B, C).log_softmax(-1)
+        labels = torch.randint(1, C, (B, L))
+        in_len = torch.tensor([12, 10, 8])
+        lb_len = torch.tensor([4, 3, 2])
+        ref = TF.ctc_loss(logits, labels, in_len, lb_len, blank=0,
+                          reduction="mean", zero_infinity=False)
+        got = F.ctc_loss(jnp.asarray(logits.numpy()),
+                         jnp.asarray(labels.numpy()),
+                         jnp.asarray(in_len.numpy()),
+                         jnp.asarray(lb_len.numpy()), blank=0)
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-4)
+
+    def test_huber_matches_torch(self):
+        x = np.random.default_rng(0).normal(size=(8,)).astype(np.float32)
+        y = np.zeros((8,), np.float32)
+        ref = TF.huber_loss(torch.tensor(x), torch.tensor(y), delta=0.7)
+        got = F.huber_loss(jnp.asarray(x), jnp.asarray(y), delta=0.7)
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-6)
+
+    def test_triplet_and_cosine_and_hinge(self):
+        rng = np.random.default_rng(1)
+        a, p_, n = (jnp.asarray(rng.normal(size=(4, 8)).astype(np.float32))
+                    for _ in range(3))
+        ref = TF.triplet_margin_loss(torch.tensor(np.asarray(a)),
+                                     torch.tensor(np.asarray(p_)),
+                                     torch.tensor(np.asarray(n)))
+        got = F.triplet_margin_loss(a, p_, n)
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-4)
+
+        lbl = jnp.asarray([1.0, -1.0, 1.0, -1.0])
+        ref = TF.cosine_embedding_loss(torch.tensor(np.asarray(a)),
+                                       torch.tensor(np.asarray(p_)),
+                                       torch.tensor(np.asarray(lbl)))
+        got = F.cosine_embedding_loss(a, p_, lbl)
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+
+        x1 = a[:, 0]
+        ref = TF.hinge_embedding_loss(torch.tensor(np.asarray(x1)),
+                                      torch.tensor(np.asarray(lbl)))
+        got = F.hinge_embedding_loss(x1, lbl)
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
