@@ -102,10 +102,27 @@ def _platform_of(spec: str) -> str:
 
 
 def set_device(device: str):
-    """``paddle.device.set_device`` parity: "tpu", "tpu:0", "cpu"."""
+    """``paddle.device.set_device`` parity: "tpu", "tpu:0", "cpu".
+
+    Migration affordance: reference accelerator names ("gpu:0", "npu",
+    "xpu", "cuda") resolve to this host's accelerator with a one-time
+    warning — ported scripts run unchanged.
+    """
     name, _, idx = device.partition(":")
-    devs = jax.devices(_platform_of(name)) if name != "auto" else jax.devices()
-    dev = devs[int(idx)] if idx else devs[0]
+    try:
+        devs = (jax.devices(_platform_of(name)) if name != "auto"
+                else jax.devices())
+    except RuntimeError:
+        if name in ("gpu", "cuda", "npu", "xpu", "mlu"):
+            devs = jax.devices()
+            import warnings
+            warnings.warn(
+                f"set_device({device!r}): no {name} on this host — using "
+                f"the default accelerator ({devs[0].platform}) instead",
+                stacklevel=2)
+        else:
+            raise
+    dev = devs[int(idx)] if idx and int(idx) < len(devs) else devs[0]
     _current_device[0] = dev
     jax.config.update("jax_default_device", dev)
     return dev

@@ -305,3 +305,23 @@ class TestDlpack:
                                       t.numpy())
         back = torch.from_dlpack(dlpack.to_dlpack(jnp.ones((4,))))
         np.testing.assert_array_equal(back.numpy(), np.ones(4))
+
+
+class TestSetDeviceMigration:
+    def test_gpu_name_falls_back_with_warning(self):
+        import warnings
+
+        import paddle_tpu as pt
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            dev = pt.core.set_device("gpu:0")
+            assert dev.platform in ("cpu", "tpu")
+            assert any("no gpu on this host" in str(x.message).lower()
+                       for x in w)
+        pt.core.set_device("cpu")  # restore
+
+    def test_unknown_platform_still_raises(self):
+        import paddle_tpu as pt
+        with pytest.raises(RuntimeError):
+            pt.core.set_device("quantum:0")
