@@ -109,11 +109,13 @@ def set_device(device: str):
     warning — ported scripts run unchanged.
     """
     name, _, idx = device.partition(":")
+    fallback = False
     try:
         devs = (jax.devices(_platform_of(name)) if name != "auto"
                 else jax.devices())
     except RuntimeError:
         if name in ("gpu", "cuda", "npu", "xpu", "mlu"):
+            fallback = True
             devs = jax.devices()
             import warnings
             warnings.warn(
@@ -122,7 +124,13 @@ def set_device(device: str):
                 stacklevel=2)
         else:
             raise
-    dev = devs[int(idx)] if idx and int(idx) < len(devs) else devs[0]
+    if not idx:
+        dev = devs[0]
+    elif fallback:
+        # indices from the ported script's world don't map here: clamp
+        dev = devs[min(max(int(idx), 0), len(devs) - 1)]
+    else:
+        dev = devs[int(idx)]  # out-of-range stays an IndexError
     _current_device[0] = dev
     jax.config.update("jax_default_device", dev)
     return dev

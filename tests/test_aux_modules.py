@@ -311,15 +311,36 @@ class TestSetDeviceMigration:
     def test_gpu_name_falls_back_with_warning(self):
         import warnings
 
+        import jax
+
         import paddle_tpu as pt
 
+        try:
+            jax.devices("gpu")
+            pytest.skip("host actually has a GPU backend")
+        except RuntimeError:
+            pass
+        before = pt.core.get_device()
         with warnings.catch_warnings(record=True) as w:
             warnings.simplefilter("always")
-            dev = pt.core.set_device("gpu:0")
-            assert dev.platform in ("cpu", "tpu")
-            assert any("no gpu on this host" in str(x.message).lower()
-                       for x in w)
-        pt.core.set_device("cpu")  # restore
+            try:
+                dev = pt.core.set_device("gpu:0")
+                assert dev.platform in ("cpu", "tpu")
+                assert any("no gpu on this host" in str(x.message).lower()
+                           for x in w)
+                # fallback path clamps out-of-range indices silently
+                assert pt.core.set_device("gpu:99").platform == dev.platform
+            finally:
+                pt.core.set_device(before)
+
+    def test_native_out_of_range_still_raises(self):
+        import jax
+
+        import paddle_tpu as pt
+
+        n = len(jax.devices())
+        with pytest.raises(IndexError):
+            pt.core.set_device(f"{jax.devices()[0].platform}:{n + 5}")
 
     def test_unknown_platform_still_raises(self):
         import paddle_tpu as pt
