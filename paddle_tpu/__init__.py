@@ -92,6 +92,16 @@ def __getattr__(name):
         from .hapi import summary
         globals()["summary"] = summary
         return summary
+    if name == "version":
+        import importlib
+        mod = importlib.import_module(".version", __name__)
+        globals()["version"] = mod
+        return mod
+    if name in ("enable_static", "disable_static", "in_dynamic_mode"):
+        from . import static as _static
+        fn = getattr(_static, name)
+        globals()[name] = fn
+        return fn
     if name == "metric":  # paddle.metric alias
         from . import metrics
         globals()["metric"] = metrics

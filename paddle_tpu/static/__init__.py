@@ -250,3 +250,26 @@ class nn:
         elif activation == "softmax":
             out = apply(jax.nn.softmax, out)
         return out
+
+
+# -- mode toggles (reference: paddle.enable_static/disable_static,
+# paddle.in_dynamic_mode — base/framework.py). Dygraph is the default and
+# the documented path; static mode routes nn/ops through the Program
+# facade for call-shape compatibility.
+_static_mode = [False]
+
+
+def enable_static():
+    _static_mode[0] = True
+
+
+def disable_static():
+    _static_mode[0] = False
+
+
+def in_static_mode() -> bool:
+    return _static_mode[0]
+
+
+def in_dynamic_mode() -> bool:
+    return not _static_mode[0]

@@ -1,0 +1,22 @@
+"""``paddle.version`` parity (reference: generated python/paddle/version.py)."""
+
+from . import __version__ as full_version
+
+major, minor, patch = full_version.split(".")[:3]
+rc = 0
+
+
+def show():
+    print(f"paddle_tpu {full_version} (tpu-native, jax/XLA/Pallas backend)")
+
+
+def cuda():  # reference API shape; this framework targets TPU
+    return False
+
+
+def cudnn():
+    return False
+
+
+def xpu():
+    return False

@@ -351,3 +351,18 @@ class TestVisionZoo:
         np.testing.assert_allclose(F.relu6(x), [0, 0, 0, 3, 6])
         np.testing.assert_allclose(
             F.hardswish(x), x * np.clip(np.asarray(x) + 3, 0, 6) / 6)
+
+
+class TestVersionAndModes:
+    def test_version_module(self):
+        assert pt.version.full_version == pt.__version__
+        assert pt.version.cuda() is False
+
+    def test_static_mode_toggles(self):
+        assert pt.in_dynamic_mode()
+        pt.enable_static()
+        try:
+            assert not pt.in_dynamic_mode()
+        finally:
+            pt.disable_static()
+        assert pt.in_dynamic_mode()
