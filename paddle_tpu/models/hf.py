@@ -32,6 +32,9 @@ _NO_TRANSPOSE_SUFFIXES = (
     "word_embeddings.weight",
     "position_embeddings.weight",
     "token_type_embeddings.weight",
+    # T5: shared embedding + relative-bias table
+    "shared.weight",
+    "relative_attention_bias.weight",
 )
 
 
@@ -53,10 +56,13 @@ def load_hf_state_dict(hf_state: Dict[str, Any]) -> Dict[str, np.ndarray]:
 
     out = {}
     experts: Dict[str, Dict[int, np.ndarray]] = {}
+    has_shared = any(k == "shared.weight" for k in hf_state)
     for name, val in hf_state.items():
         arr = _to_numpy(val)
         if name.endswith("rotary_emb.inv_freq"):
             continue  # recomputed, never a parameter here
+        if has_shared and name.endswith("embed_tokens.weight"):
+            continue  # T5 duplicates of shared.weight
         m = re.match(r"(.*block_sparse_moe)\.experts\.(\d+)\.(w[123])\.weight$",
                      name)
         if m:
@@ -121,6 +127,9 @@ def from_hf(model, hf_model_or_state) -> None:
     else:
         converted = load_hf_state_dict(state)
     ours = model.state_dict()
+    if "lm_head.weight" in converted and "lm_head.weight" not in ours:
+        # tied-embedding models (T5 etc.) export a duplicate head
+        converted.pop("lm_head.weight")
     missing = [k for k in ours if k not in converted]
     unexpected = [k for k in converted if k not in ours]
     if missing or unexpected:

@@ -1,0 +1,257 @@
+"""T5 encoder-decoder family (PaddleNLP ``T5ForConditionalGeneration``
+scope).
+
+Reference capability: PaddleNLP paddlenlp/transformers/t5/modeling.py
+(the ecosystem's seq2seq workhorse; SURVEY §0 scope note). Module names
+mirror the HF layout (``encoder.block.N.layer.0.SelfAttention.q`` …) so
+``models.hf.from_hf`` imports HF T5 checkpoints by pure transpose, and
+the torch-oracle parity test pins the architecture.
+
+T5-specific numerics kept exactly: no 1/sqrt(d) attention scale, shared
+bucketed relative-position bias held by block 0 of each stack, RMS-style
+T5LayerNorm in fp32, and the d_model**-0.5 output scale when embeddings
+are tied.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn.layers_common import Embedding, LayerList, Linear
+
+__all__ = ["T5Config", "T5Model", "t5"]
+
+
+@dataclasses.dataclass
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 512
+    d_kv: int = 64
+    d_ff: int = 2048
+    num_layers: int = 6
+    num_decoder_layers: int = 6
+    num_heads: int = 8
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    layer_norm_epsilon: float = 1e-6
+    tie_word_embeddings: bool = True
+    decoder_start_token_id: int = 0
+
+
+PRESETS = {
+    "tiny": T5Config(vocab_size=128, d_model=64, d_kv=16, d_ff=128,
+                     num_layers=2, num_decoder_layers=2, num_heads=4),
+    "t5-small": T5Config(),
+    "t5-base": T5Config(d_model=768, d_ff=3072, num_layers=12,
+                        num_decoder_layers=12, num_heads=12),
+}
+
+
+class _T5LayerNorm(Layer):
+    """RMS norm, no bias/mean-centering (HF T5LayerNorm semantics)."""
+
+    def __init__(self, d, eps):
+        super().__init__()
+        self.weight = self.create_parameter((d,))
+        self.weight = jnp.ones((d,), jnp.float32)
+        self.eps = eps
+
+    def forward(self, x):
+        xf = x.astype(jnp.float32)
+        var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+        return (xf * jax.lax.rsqrt(var + self.eps)).astype(x.dtype) \
+            * self.weight.astype(x.dtype)
+
+
+def _relative_position_bucket(rel, bidirectional, num_buckets, max_distance):
+    """Exact HF bucketing (modeling_t5._relative_position_bucket)."""
+    ret = 0
+    n = -rel
+    if bidirectional:
+        num_buckets //= 2
+        ret += (n < 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-9)
+        / jnp.log(max_distance / max_exact) * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    val_large = jnp.minimum(val_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_large)
+
+
+class _T5Attention(Layer):
+    def __init__(self, cfg: T5Config, has_relative_bias: bool,
+                 bidirectional: bool):
+        super().__init__()
+        inner = cfg.num_heads * cfg.d_kv
+        self.q = Linear(cfg.d_model, inner, bias_attr=False)
+        self.k = Linear(cfg.d_model, inner, bias_attr=False)
+        self.v = Linear(cfg.d_model, inner, bias_attr=False)
+        self.o = Linear(inner, cfg.d_model, bias_attr=False)
+        self.nh, self.dkv = cfg.num_heads, cfg.d_kv
+        self.cfg = cfg
+        self.bidirectional = bidirectional
+        if has_relative_bias:
+            self.relative_attention_bias = Embedding(
+                cfg.relative_attention_num_buckets, cfg.num_heads)
+
+    def compute_bias(self, qlen, klen):
+        ctx = jnp.arange(qlen)[:, None]
+        mem = jnp.arange(klen)[None, :]
+        buckets = _relative_position_bucket(
+            mem - ctx, self.bidirectional,
+            self.cfg.relative_attention_num_buckets,
+            self.cfg.relative_attention_max_distance)
+        vals = self.relative_attention_bias(buckets)      # [q, k, H]
+        return jnp.transpose(vals, (2, 0, 1))[None]       # [1, H, q, k]
+
+    def forward(self, x, kv=None, position_bias=None, mask=None):
+        b, sq = x.shape[:2]
+        kv = x if kv is None else kv
+        sk = kv.shape[1]
+        q = self.q(x).reshape(b, sq, self.nh, self.dkv)
+        k = self.k(kv).reshape(b, sk, self.nh, self.dkv)
+        v = self.v(kv).reshape(b, sk, self.nh, self.dkv)
+        # T5: NO 1/sqrt(d) scale; bias added to raw logits
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        if position_bias is not None:
+            logits = logits + position_bias
+        if mask is not None:
+            logits = logits + mask
+        probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        return self.o(out.reshape(b, sq, self.nh * self.dkv))
+
+
+class _T5FF(Layer):
+    def __init__(self, cfg: T5Config):
+        super().__init__()
+        self.wi = Linear(cfg.d_model, cfg.d_ff, bias_attr=False)
+        self.wo = Linear(cfg.d_ff, cfg.d_model, bias_attr=False)
+
+    def forward(self, x):
+        return self.wo(F.relu(self.wi(x)))
+
+
+class _SelfLayer(Layer):
+    def __init__(self, cfg, has_bias, bidirectional):
+        super().__init__()
+        self.SelfAttention = _T5Attention(cfg, has_bias, bidirectional)
+        self.layer_norm = _T5LayerNorm(cfg.d_model, cfg.layer_norm_epsilon)
+
+    def forward(self, x, position_bias=None, mask=None):
+        return x + self.SelfAttention(self.layer_norm(x),
+                                      position_bias=position_bias, mask=mask)
+
+
+class _CrossLayer(Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.EncDecAttention = _T5Attention(cfg, False, True)
+        self.layer_norm = _T5LayerNorm(cfg.d_model, cfg.layer_norm_epsilon)
+
+    def forward(self, x, enc, mask=None):
+        return x + self.EncDecAttention(self.layer_norm(x), kv=enc, mask=mask)
+
+
+class _FFLayer(Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.DenseReluDense = _T5FF(cfg)
+        self.layer_norm = _T5LayerNorm(cfg.d_model, cfg.layer_norm_epsilon)
+
+    def forward(self, x):
+        return x + self.DenseReluDense(self.layer_norm(x))
+
+
+class _Block(Layer):
+    def __init__(self, cfg, has_bias, is_decoder):
+        super().__init__()
+        layers = [_SelfLayer(cfg, has_bias, bidirectional=not is_decoder)]
+        if is_decoder:
+            layers.append(_CrossLayer(cfg))
+        layers.append(_FFLayer(cfg))
+        self.layer = LayerList(layers)
+        self.is_decoder = is_decoder
+
+    def forward(self, x, enc=None, position_bias=None, self_mask=None,
+                cross_mask=None):
+        x = self.layer[0](x, position_bias, self_mask)
+        if self.is_decoder:
+            x = self.layer[1](x, enc, cross_mask)
+        return self.layer[-1](x)
+
+
+class _Stack(Layer):
+    def __init__(self, cfg, n_layers, is_decoder):
+        super().__init__()
+        self.block = LayerList([_Block(cfg, has_bias=(i == 0),
+                                       is_decoder=is_decoder)
+                                for i in range(n_layers)])
+        self.final_layer_norm = _T5LayerNorm(cfg.d_model,
+                                             cfg.layer_norm_epsilon)
+        self.is_decoder = is_decoder
+
+    def forward(self, x, enc=None, self_mask=None, cross_mask=None):
+        sq = x.shape[1]
+        bias = self.block[0].layer[0].SelfAttention.compute_bias(sq, sq)
+        for blk in self.block:
+            x = blk(x, enc, bias, self_mask, cross_mask)
+        return self.final_layer_norm(x)
+
+
+class T5Model(Layer):
+    """Conditional generation model (HF T5ForConditionalGeneration
+    layout): forward(input_ids, decoder_input_ids) → logits."""
+
+    def __init__(self, cfg: T5Config):
+        super().__init__()
+        self.cfg = cfg
+        self.shared = Embedding(cfg.vocab_size, cfg.d_model)
+        self.encoder = _Stack(cfg, cfg.num_layers, is_decoder=False)
+        self.decoder = _Stack(cfg, cfg.num_decoder_layers, is_decoder=True)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = Linear(cfg.d_model, cfg.vocab_size,
+                                  bias_attr=False)
+
+    def forward(self, input_ids, decoder_input_ids, attention_mask=None,
+                labels=None):
+        cfg = self.cfg
+        enc_mask = None
+        if attention_mask is not None:
+            enc_mask = (1.0 - attention_mask[:, None, None, :].astype(
+                jnp.float32)) * -1e9
+        enc = self.encoder(self.shared(input_ids), self_mask=enc_mask)
+        sq = decoder_input_ids.shape[1]
+        causal = jnp.where(
+            jnp.tril(jnp.ones((sq, sq), bool))[None, None], 0.0, -1e9)
+        dec = self.decoder(self.shared(decoder_input_ids), enc,
+                           self_mask=causal, cross_mask=enc_mask)
+        if cfg.tie_word_embeddings:
+            dec = dec * (cfg.d_model ** -0.5)
+            logits = dec @ self.shared.weight.T
+        else:
+            logits = self.lm_head(dec)
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(logits.astype(jnp.float32).reshape(
+            -1, cfg.vocab_size), labels.reshape(-1), reduction="none")
+        valid = (labels.reshape(-1) != -100)
+        return jnp.sum(loss * valid) / jnp.maximum(jnp.sum(valid), 1)
+
+
+def t5(name_or_config="tiny", **overrides) -> T5Model:
+    cfg = (PRESETS[name_or_config] if isinstance(name_or_config, str)
+           else name_or_config)
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    return T5Model(cfg)

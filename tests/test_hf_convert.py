@@ -153,3 +153,32 @@ class TestHfBert:
         np.testing.assert_allclose(np.asarray(pooled),
                                    out.pooler_output.numpy(),
                                    atol=5e-4, rtol=5e-3)
+
+
+class TestHfT5:
+    def test_logits_parity(self):
+        from paddle_tpu.models.t5 import T5Config, t5
+        hf_cfg = transformers.T5Config(
+            vocab_size=128, d_model=64, d_kv=16, d_ff=128, num_layers=2,
+            num_decoder_layers=2, num_heads=4,
+            relative_attention_num_buckets=32,
+            relative_attention_max_distance=128,
+            dropout_rate=0.0, layer_norm_epsilon=1e-6,
+            feed_forward_proj="relu", tie_word_embeddings=True,
+            decoder_start_token_id=0, pad_token_id=0, eos_token_id=1)
+        torch.manual_seed(0)
+        hf = transformers.T5ForConditionalGeneration(hf_cfg).eval()
+        ours = t5("tiny").eval()
+        from_hf(ours, hf)
+        rng = np.random.default_rng(5)
+        enc_ids = rng.integers(2, 128, size=(2, 12))
+        dec_ids = rng.integers(2, 128, size=(2, 7))
+        mask = np.ones((2, 12), np.int64)
+        mask[1, 9:] = 0
+        with torch.no_grad():
+            ref = hf(input_ids=torch.tensor(enc_ids),
+                     attention_mask=torch.tensor(mask),
+                     decoder_input_ids=torch.tensor(dec_ids)).logits.numpy()
+        got = np.asarray(ours(jnp.asarray(enc_ids), jnp.asarray(dec_ids),
+                              attention_mask=jnp.asarray(mask)))
+        np.testing.assert_allclose(got, ref, atol=5e-4, rtol=5e-3)
