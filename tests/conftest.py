@@ -16,6 +16,16 @@ import jax  # noqa: E402
 # JAX_PLATFORMS; pin the config explicitly so tests run on the virtual
 # 8-device CPU mesh.
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compile cache: the suite is compile-bound (~20 min cold), and
+# every run recompiles identical tiny programs. Cache under .pytest_cache
+# (gitignored) so warm runs skip XLA compilation entirely.
+_cache_dir = os.path.join(os.path.dirname(__file__), "..", ".pytest_cache",
+                          "xla_cache")
+os.makedirs(_cache_dir, exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
