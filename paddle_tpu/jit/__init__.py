@@ -26,6 +26,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import random as prandom
 from ..nn.layer import Layer, functional_call, raw_params, trainable_mask
+from . import control_flow
+from .control_flow import (GraphBreakError, case, cond, switch_case,
+                           while_loop)
 
 
 class InputSpec:
@@ -68,7 +71,7 @@ def to_static(function=None, input_spec=None, full_graph=True, backend=None,
                     "to_static input_spec has dynamic dims; XLA requires "
                     "static shapes — compiling lazily per concrete shape "
                     "instead", stacklevel=2)
-        return jitted
+        return control_flow.intercept_graph_breaks(fn, jitted, full_graph)
     return deco(function) if function is not None else deco
 
 

@@ -1,0 +1,168 @@
+"""Trace-safe dynamic control flow for ``to_static`` (Dy2Static parity).
+
+Reference: python/paddle/jit/sot (bytecode capture with graph-break
+fallback) and python/paddle/static/nn/control_flow.py (cond / while_loop /
+case / switch_case program ops).  The TPU-native design keeps jax.jit's
+one-trace model and offers the reference's two coping strategies for
+value-dependent Python control flow:
+
+- explicit trace-safe surfaces: ``cond``/``while_loop``/``case``/
+  ``switch_case`` lower to ``lax.cond``/``lax.while_loop``/``lax.switch``,
+  so the branch/loop is part of the compiled program (the reference's
+  ControlFlowOp path);
+- graph-break handling in ``to_static``: a raw tensor-dependent ``if``
+  raises jax's TracerBoolConversionError mid-trace.  ``full_graph=True``
+  re-raises it as a GraphBreakError that names the offending user
+  file:line and the fix; ``full_graph=False`` (the reference SOT default)
+  falls back to eager execution of the whole call, like SOT's graph-break
+  interpreter, with a one-time warning.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+from jax import lax
+
+__all__ = ["cond", "while_loop", "case", "switch_case", "GraphBreakError"]
+
+
+class GraphBreakError(RuntimeError):
+    """A value-dependent Python branch was hit while tracing under
+    ``to_static(full_graph=True)``."""
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable, *operands):
+    """``paddle.static.nn.cond`` parity.
+
+    Both the closure style (``cond(p, lambda: x + 1, lambda: x - 1)``) and
+    the operand style (``cond(p, f, g, x)``) are supported; both branches
+    must return pytrees of identical structure/shape (XLA compiles both).
+    """
+    return lax.cond(pred, true_fn, false_fn, *operands)
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence):
+    """``paddle.static.nn.while_loop`` parity over ``lax.while_loop``.
+
+    ``cond_fn``/``body_fn`` take the loop vars positionally; ``body_fn``
+    returns the same number of vars with unchanged shapes/dtypes (XLA's
+    fixed-point requirement — the reference's while op allowed shape
+    growth, which has no static-shape equivalent)."""
+    vals = tuple(loop_vars)
+    out = lax.while_loop(lambda vs: cond_fn(*vs),
+                         lambda vs: tuple(body_fn(*vs)), vals)
+    return list(out)
+
+
+def case(pred_fn_pairs, default: Optional[Callable] = None):
+    """``paddle.static.nn.case``: first predicate that is True wins.
+
+    Lowers to nested ``lax.cond`` so every predicate may be a traced
+    scalar; all branches are compiled."""
+    pairs = list(pred_fn_pairs)
+    if not pairs:
+        if default is None:
+            raise ValueError("case() needs at least one (pred, fn) pair or "
+                             "a default")
+        return default()
+    if default is None:
+        # reference semantics: last branch is the fallback
+        *pairs, (_, default) = pairs
+
+    def build(i):
+        if i == len(pairs):
+            return default()
+        pred, fn = pairs[i]
+        return lax.cond(pred, fn, lambda: build(i + 1))
+
+    return build(0)
+
+
+def switch_case(branch_index, branch_fns, default: Optional[Callable] = None):
+    """``paddle.static.nn.switch_case`` parity over ``lax.switch``.
+
+    ``branch_fns`` may be a list of callables or (index, callable) pairs;
+    out-of-range indices take ``default`` (reference semantics; lax.switch
+    alone would clamp)."""
+    if isinstance(branch_fns, dict):
+        branch_fns = list(branch_fns.items())
+    if branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        keyed = sorted((int(k), fn) for k, fn in branch_fns)
+        keys = [k for k, _ in keyed]
+        fns = [fn for _, fn in keyed]
+    else:
+        fns = list(branch_fns)
+        keys = list(range(len(fns)))
+    if default is None:
+        default = fns[-1]
+    import jax.numpy as jnp
+    idx = jnp.asarray(branch_index)
+    # map the sparse key set onto dense lax.switch slots; unmatched → default
+    table = fns + [default]
+    sel = jnp.full((), len(fns), jnp.int32)
+    for slot, k in enumerate(keys):
+        sel = jnp.where(idx == k, jnp.int32(slot), sel)
+    return lax.switch(sel, table)
+
+
+# ---------------------------------------------------------------------------
+# graph-break interception for to_static
+# ---------------------------------------------------------------------------
+
+def _user_frame(tb, fn) -> str:
+    """Best-effort file:line of the user frame that triggered the break
+    (innermost traceback frame outside jax/paddle_tpu internals)."""
+    import os
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    jax_dir = os.path.dirname(os.path.abspath(jax.__file__))
+    loc = None
+    while tb is not None:
+        fname = tb.tb_frame.f_code.co_filename
+        if not fname.startswith((pkg_dir, jax_dir)):
+            loc = f"{fname}:{tb.tb_lineno}"
+        tb = tb.tb_next
+    return loc or f"<{getattr(fn, '__name__', 'function')}>"
+
+
+def graph_break_message(loc: str) -> str:
+    return (
+        f"graph break: value-dependent Python control flow at {loc}. "
+        "Under to_static the function is traced once, so a branch on a "
+        "tensor value cannot run in Python. Fix: (a) use "
+        "paddle_tpu.jit.cond / while_loop / case for data-dependent "
+        "branching, (b) mark the driving argument static via "
+        "static_argnums, or (c) pass full_graph=False to run this call "
+        "eagerly (the reference SOT's graph-break fallback).")
+
+
+def intercept_graph_breaks(fn: Callable, jitted: Callable,
+                           full_graph: bool) -> Callable:
+    """Wrap a jitted callable: on TracerBoolConversionError either raise a
+    paddle-style GraphBreakError (full_graph=True) or fall back to one
+    eager call of ``fn`` (full_graph=False)."""
+    import functools
+    warned = []
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        try:
+            return jitted(*args, **kwargs)
+        except jax.errors.TracerBoolConversionError as e:
+            loc = _user_frame(e.__traceback__, fn)
+            if full_graph:
+                raise GraphBreakError(graph_break_message(loc)) from e
+            if not warned:
+                warned.append(True)
+                warnings.warn(
+                    f"to_static: graph break at {loc}; running this call "
+                    "eagerly (full_graph=False). Use paddle_tpu.jit.cond/"
+                    "while_loop to keep it compiled.", stacklevel=2)
+            return fn(*args, **kwargs)
+
+    wrapper.lower = jitted.lower
+    wrapper.eval_shape = getattr(jitted, "eval_shape", None)
+    wrapper._jitted = jitted
+    return wrapper

@@ -251,6 +251,15 @@ class nn:
             out = apply(jax.nn.softmax, out)
         return out
 
+    # control-flow ops (reference: python/paddle/static/nn/control_flow.py)
+    # — lax-backed, usable both eagerly and inside compiled programs
+    from ..jit.control_flow import (case, cond,  # noqa: F401
+                                    switch_case, while_loop)
+    case = staticmethod(case)
+    cond = staticmethod(cond)
+    switch_case = staticmethod(switch_case)
+    while_loop = staticmethod(while_loop)
+
 
 # -- mode toggles (reference: paddle.enable_static/disable_static,
 # paddle.in_dynamic_mode — base/framework.py). Dygraph is the default and

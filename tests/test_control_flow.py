@@ -1,0 +1,171 @@
+"""Dynamic control flow under to_static (reference: python/paddle/jit/sot
+graph-break semantics + python/paddle/static/nn/control_flow.py ops)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.jit import (GraphBreakError, case, cond, switch_case,
+                            to_static, while_loop)
+
+
+class TestCond:
+    def test_closure_style(self):
+        x = jnp.asarray(3.0)
+        out = cond(x > 2, lambda: x + 1, lambda: x - 1)
+        assert float(out) == 4.0
+
+    def test_operand_style_compiled_matches_eager(self):
+        def f(flag, x):
+            return cond(flag, lambda v: v * 2, lambda v: v / 2, x)
+
+        x = jnp.arange(4.0)
+        for flag in (True, False):
+            eager = f(jnp.asarray(flag), x)
+            compiled = to_static(f)(jnp.asarray(flag), x)
+            np.testing.assert_allclose(np.asarray(compiled),
+                                       np.asarray(eager))
+
+    def test_grad_through_cond(self):
+        def f(x):
+            return cond(x.sum() > 0, lambda v: (v ** 2).sum(),
+                        lambda v: v.sum(), x)
+
+        g = jax.grad(f)(jnp.array([1.0, 2.0]))
+        np.testing.assert_allclose(np.asarray(g), [2.0, 4.0])
+
+
+class TestWhileLoop:
+    def test_matches_python_loop(self):
+        def f(n):
+            i, acc = while_loop(lambda i, acc: i < n,
+                                lambda i, acc: [i + 1, acc + i],
+                                [jnp.asarray(0), jnp.asarray(0)])
+            return acc
+
+        assert int(to_static(f)(jnp.asarray(5))) == 0 + 1 + 2 + 3 + 4
+
+    def test_tensor_loop_vars(self):
+        def f(x):
+            _, y = while_loop(
+                lambda i, v: i < 3,
+                lambda i, v: [i + 1, v * 2.0],
+                [jnp.asarray(0), x])
+            return y
+
+        np.testing.assert_allclose(np.asarray(to_static(f)(jnp.ones(2))),
+                                   8.0)
+
+
+class TestCaseSwitch:
+    def test_case_first_true_wins(self):
+        def f(x):
+            return case([(x < 0, lambda: x - 100),
+                         (x < 10, lambda: x + 1),
+                         (x < 100, lambda: x + 2)])
+
+        assert float(to_static(f)(jnp.asarray(5.0))) == 6.0
+        assert float(to_static(f)(jnp.asarray(50.0))) == 52.0
+        # nothing matches → last branch is the fallback
+        assert float(to_static(f)(jnp.asarray(500.0))) == 502.0
+
+    def test_case_with_default(self):
+        x = jnp.asarray(7.0)
+        out = case([(x > 100, lambda: x)], default=lambda: x * 0)
+        assert float(out) == 0.0
+
+    def test_switch_case_dense(self):
+        def f(i, x):
+            return switch_case(i, [lambda: x + 1, lambda: x + 2,
+                                   lambda: x + 3])
+
+        x = jnp.asarray(0.0)
+        assert float(to_static(f)(jnp.asarray(1), x)) == 2.0
+        # out of range → default (last branch, reference semantics)
+        assert float(to_static(f)(jnp.asarray(9), x)) == 3.0
+
+    def test_switch_case_sparse_keys(self):
+        x = jnp.asarray(0.0)
+        out = switch_case(jnp.asarray(10),
+                          [(2, lambda: x + 2), (10, lambda: x + 10)],
+                          default=lambda: x - 1)
+        assert float(out) == 10.0
+        out = switch_case(jnp.asarray(3),
+                          [(2, lambda: x + 2), (10, lambda: x + 10)],
+                          default=lambda: x - 1)
+        assert float(out) == -1.0
+
+
+class TestGraphBreak:
+    def test_full_graph_raises_with_location(self):
+        @to_static
+        def f(x):
+            if x.sum() > 0:  # value-dependent Python branch
+                return x + 1
+            return x - 1
+
+        with pytest.raises(GraphBreakError) as ei:
+            f(jnp.ones(3))
+        msg = str(ei.value)
+        assert "graph break" in msg
+        assert "test_control_flow.py" in msg  # names the user frame
+        assert "jit.cond" in msg or "cond" in msg
+
+    def test_full_graph_false_falls_back_to_eager(self):
+        def f(x):
+            if x.sum() > 0:
+                return x + 1
+            return x - 1
+
+        g = to_static(f, full_graph=False)
+        with pytest.warns(UserWarning, match="graph break"):
+            out = g(jnp.ones(3))
+        np.testing.assert_allclose(np.asarray(out), 2.0)
+        np.testing.assert_allclose(np.asarray(g(-jnp.ones(3))), -2.0)
+
+    def test_static_argnums_keeps_compiled(self):
+        @pt.jit.to_static(static_argnums=(1,))
+        def f(x, flag):
+            if flag:  # static python value — no break
+                return x + 1
+            return x - 1
+
+        np.testing.assert_allclose(np.asarray(f(jnp.ones(2), True)), 2.0)
+        np.testing.assert_allclose(np.asarray(f(jnp.ones(2), False)), 0.0)
+
+
+class GatedBlock(nn.Layer):
+    """A model whose forward branches on a data statistic — the shape of
+    thing that needs jit.cond to stay compiled."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.fc(x)
+        return cond(jnp.mean(jnp.abs(h)) > 0.5,
+                    lambda v: jax.nn.relu(v), lambda v: v * 0.1, h)
+
+
+class TestModelWithDataDependentBranch:
+    def test_compiled_matches_eager(self):
+        pt.seed(0)
+        model = GatedBlock()
+        x = jnp.linspace(-1, 1, 8).reshape(2, 4)
+        eager = model(x)
+        compiled = to_static(model.__call__)(x)
+        np.testing.assert_allclose(np.asarray(compiled), np.asarray(eager),
+                                   rtol=1e-6)
+
+    def test_static_nn_namespace(self):
+        from paddle_tpu import static
+        x = jnp.asarray(1.0)
+        assert float(static.nn.cond(x > 0, lambda: x, lambda: -x)) == 1.0
+        out = static.nn.while_loop(lambda i: i < 3, lambda i: [i + 1],
+                                   [jnp.asarray(0)])
+        assert int(out[0]) == 3
