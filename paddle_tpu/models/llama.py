@@ -82,6 +82,13 @@ PRESETS = {
     "llama-350m": LlamaConfig(hidden_size=1024, intermediate_size=2816,
                               num_hidden_layers=24, num_attention_heads=16,
                               num_key_value_heads=16),
+    # same parameter count as llama-350m but 8 heads of head_dim 128 — the
+    # north-star's (Llama-2-7B) attention geometry, where qk/sv matmuls
+    # fill the 128-wide MXU instead of running K/N=64 at half occupancy
+    "llama-350m-hd128": LlamaConfig(hidden_size=1024, intermediate_size=2816,
+                                    num_hidden_layers=24,
+                                    num_attention_heads=8,
+                                    num_key_value_heads=8),
     "tiny": LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
                         num_hidden_layers=2, num_attention_heads=4,
                         num_key_value_heads=2, max_position_embeddings=128),

@@ -42,6 +42,15 @@ DEFAULT_BLOCK_K = int(os.environ.get("PDTPU_FLASH_BLOCK_K", 1024))
 BWD_BLOCK_Q = int(os.environ.get("PDTPU_FLASH_BWD_BLOCK_Q", 0)) or None
 BWD_BLOCK_K = int(os.environ.get("PDTPU_FLASH_BWD_BLOCK_K", 0)) or None
 NEG_INF = -1e30
+# The softmax runs in the base-2 domain: fold log2(e) into the qk scale so
+# the VPU evaluates exp2 directly instead of exp (= exp2 plus a per-element
+# multiply). The domain is internal — the saved per-row statistic is
+# log2-sum-exp2 and both bwd kernels consume it in the same domain.
+LOG2E = math.log2(math.e)
+# grid = (batch, head, major-block, minor-block): only the innermost dim
+# carries the running-statistics dependency; the rest are parallel
+_DIMS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
 
 
 def _pick_block(n, preferred):
@@ -93,15 +102,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, m_scr, l_scr, acc_scr, *,
         q = q_ref[0, 0]                              # (bq, d), input dtype
         k = k_ref[0, 0]                              # (bk, d)
         v = v_ref[0, 0]                              # (bk, d)
-        # MXU runs at full rate on the input dtype (bf16) with f32 accumulate
+        # MXU runs at full rate on the input dtype (bf16) with f32 accumulate;
+        # scores land in the base-2 domain (scale carries log2(e))
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32) * (
+                                    scale * LOG2E)
         if masked:
             s = _causal_mask(s, iq, ik, block_q, block_k, offset)
         m_prev = m_scr[:, 0]                          # (bq,)
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
-        p = jnp.exp(s - m_cur[:, None])
-        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp2(s - m_cur[:, None])
+        alpha = jnp.exp2(m_prev - m_cur)
         l_cur = alpha * l_scr[:, 0] + jnp.sum(p, axis=1)
         acc_scr[:] = acc_scr[:] * alpha[:, None] + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -124,8 +135,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, m_scr, l_scr, acc_scr, *,
         l = l_scr[:, 0]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_scr[:] / safe_l[:, None]).astype(o_ref.dtype)
-        # logsumexp per row, saved for backward
-        l_ref[0, 0] = (m_scr[:] + jnp.log(safe_l)[:, None]).astype(jnp.float32)
+        # per-row log2-sum-exp2 (base-2 domain), saved for backward
+        l_ref[0, 0] = (m_scr[:] + jnp.log2(safe_l)[:, None]).astype(jnp.float32)
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
@@ -164,6 +175,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
             pltpu.VMEM((bq, 1), jnp.float32),   # running denom
             pltpu.VMEM((bq, d), jnp.float32),   # output accumulator
         ],
+        compiler_params=_DIMS,
     )(qt, kt, vt)
     return out.transpose(0, 2, 1, 3), lse[..., 0]  # (b,s,h,d), (b,h,s)
 
@@ -191,10 +203,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0, 0][:, 0]                     # (bq,)
         delta = delta_ref[0, 0][:, 0]                 # (bq,)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32) * (
+                                    scale * LOG2E)
         if masked:
             s = _causal_mask(s, iq, ik, block_q, block_k, offset)
-        p = jnp.exp(s - lse[:, None])                 # (bq, bk) f32
+        p = jnp.exp2(s - lse[:, None])                # (bq, bk) f32
         dv_scr[:] += jax.lax.dot_general(p.astype(do.dtype), do,
                                          (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
@@ -236,10 +249,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0, 0][:, 0]
         delta = delta_ref[0, 0][:, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32) * (
+                                    scale * LOG2E)
         if masked:
             s = _causal_mask(s, iq, ik, block_q, block_k, offset)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp2(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
@@ -306,6 +320,7 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
             pltpu.VMEM((bk, d), jnp.float32),
             pltpu.VMEM((bk, d), jnp.float32),
         ],
+        compiler_params=_DIMS,
     )(qt, kt, vt, dot, lse4, delta4)
 
     kernel_dq = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -327,6 +342,7 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
                                lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=_DIMS,
     )(qt, kt, vt, dot, lse4, delta4)
 
     # fold GQA group: sum per-q-head dk/dv into kv heads
