@@ -58,8 +58,14 @@ def white_cast(x):
 
 
 def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
-             master_weight=None, save_dtype=None):
-    """O2 decoration: cast params to ``dtype``, enable master weights."""
+             master_weight=None, save_dtype=None, master_grad=False):
+    """O2 decoration: cast params to ``dtype``, enable master weights.
+
+    ``master_grad=True`` additionally promotes low-precision gradients to
+    fp32 *before* grad clipping and the optimizer update (reference:
+    paddle.amp.decorate's master_grad — there the cast happens in the eager
+    accumulation hooks; here the optimizer applies it at the head of its
+    pure update, so global-norm clipping sees fp32 too)."""
     d = convert_dtype(dtype)
     single = not isinstance(models, (list, tuple))
     model_list = [models] if single else list(models)
@@ -71,6 +77,8 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
         for o in opt_list:
             if master_weight is not False:
                 o.multi_precision = True
+            if master_grad:
+                o.master_grad = True
         if single and opt_single:
             return models, optimizers
         return model_list, opt_list

@@ -398,8 +398,15 @@ def get_world_size(group=None) -> int:
     return jax.process_count()
 
 
+_parallel_env_initialized = False
+
+
 def is_initialized() -> bool:
-    return True
+    """True once the parallel environment exists — either ``fleet.init``
+    built a hybrid group or ``init_parallel_env`` ran (reference:
+    paddle.distributed.is_initialized, truthful-before-init)."""
+    return (_parallel_env_initialized
+            or fleet.get_hybrid_communicate_group() is not None)
 
 
 def init_parallel_env(cluster_env: Optional[dict] = None):
@@ -416,6 +423,8 @@ def init_parallel_env(cluster_env: Optional[dict] = None):
                                       os.environ.get("PDTPU_NUM_PROCESSES", 1))),
             process_id=int(env.get("process_id",
                                    os.environ.get("PDTPU_PROCESS_ID", 0))))
+    global _parallel_env_initialized
+    _parallel_env_initialized = True
     return None
 
 

@@ -138,6 +138,8 @@ def _reset():  # test helper
     global _HYBRID_PARALLEL_GROUP, _PS_RUNTIME
     _HYBRID_PARALLEL_GROUP = None
     _PS_RUNTIME = None
+    from .. import communication as _comm
+    _comm._parallel_env_initialized = False
 
 
 def distributed_model(model):

@@ -43,17 +43,31 @@ def segment_mean(data, segment_ids, out_size: Optional[int] = None):
     return tot / jnp.maximum(cnt, 1)
 
 
+def _empty_segment_mask(data, segment_ids, n):
+    """[n,1,...] bool mask of segments with zero members — detected by
+    count, not by sentinel value, so integer dtypes and legitimate ±inf
+    maxima are handled correctly."""
+    cnt = jax.ops.segment_sum(jnp.ones(segment_ids.shape[:1], jnp.int32),
+                              segment_ids, num_segments=n)
+    return (cnt == 0).reshape((n,) + (1,) * (data.ndim - 1))
+
+
 def segment_max(data, segment_ids, out_size: Optional[int] = None):
     n = _num_segments(segment_ids, out_size)
     out = jax.ops.segment_max(data, segment_ids, num_segments=n)
-    # reference semantics: empty segments are zero, not -inf
-    return jnp.where(jnp.isfinite(out), out, 0).astype(data.dtype)
+    # reference semantics: empty segments are zero, not the -inf/INT_MIN
+    # identity
+    empty = _empty_segment_mask(data, segment_ids, n)
+    return jnp.where(empty, jnp.zeros((), data.dtype),
+                     out).astype(data.dtype)
 
 
 def segment_min(data, segment_ids, out_size: Optional[int] = None):
     n = _num_segments(segment_ids, out_size)
     out = jax.ops.segment_min(data, segment_ids, num_segments=n)
-    return jnp.where(jnp.isfinite(out), out, 0).astype(data.dtype)
+    empty = _empty_segment_mask(data, segment_ids, n)
+    return jnp.where(empty, jnp.zeros((), data.dtype),
+                     out).astype(data.dtype)
 
 
 _REDUCERS = {"sum": segment_sum, "mean": segment_mean,

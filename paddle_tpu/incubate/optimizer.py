@@ -60,8 +60,12 @@ class LookAhead:
 class ModelAverage:
     """Maintain a running average of parameters; swap it in for eval.
 
-    ``min_average_window``/``max_average_window`` mirror the reference's
-    window semantics (restart accumulation when the window overflows).
+    Reference window semantics (paddle.incubate.ModelAverage /
+    average_accumulates kernel): the accumulation window is
+    ``min(max_average_window, max(min_average_window, rate * num_updates))``.
+    When the current block fills the window it rolls into an ``old`` block
+    (rather than being dropped), so the average is always backed by at
+    least one full window of history around restarts.
     """
 
     def __init__(self, inner_optimizer, average_window_rate=0.15,
@@ -75,26 +79,39 @@ class ModelAverage:
                                        False)
 
     def init(self, params):
+        zeros = {k: jnp.zeros_like(v, jnp.float32)
+                 for k, v in params.items()}
         return {"inner": self.inner.init(params),
-                "sum": {k: jnp.zeros_like(v, jnp.float32)
-                        for k, v in params.items()},
-                "num": jnp.zeros((), jnp.int32)}
+                "sum": zeros,
+                "old_sum": dict(zeros),
+                "num": jnp.zeros((), jnp.int32),
+                "old_num": jnp.zeros((), jnp.int32),
+                "updates": jnp.zeros((), jnp.int32)}
 
     def apply(self, grads, state, params):
         new_params, inner_state = self.inner.apply(grads, state["inner"],
                                                    params)
+        updates = state["updates"] + 1
         num = state["num"] + 1
-        restart = num > self.max_w
-        new_sum = {}
+        window = jnp.minimum(
+            jnp.int32(self.max_w),
+            jnp.maximum(jnp.int32(self.min_w),
+                        (self.rate * updates).astype(jnp.int32)))
+        roll = num >= window
+        new_sum, new_old_sum = {}, {}
         for name, p in new_params.items():
             s = state["sum"][name] + p.astype(jnp.float32)
-            new_sum[name] = jnp.where(restart, p.astype(jnp.float32), s)
-        num = jnp.where(restart, jnp.int32(1), num)
-        return new_params, {"inner": inner_state, "sum": new_sum,
-                            "num": num}
+            new_old_sum[name] = jnp.where(roll, s, state["old_sum"][name])
+            new_sum[name] = jnp.where(roll, jnp.zeros_like(s), s)
+        return new_params, {
+            "inner": inner_state, "sum": new_sum, "old_sum": new_old_sum,
+            "num": jnp.where(roll, jnp.int32(0), num),
+            "old_num": jnp.where(roll, num, state["old_num"]),
+            "updates": updates}
 
     def average_params(self, state, params):
         """→ averaged params for evaluation (reference: apply())."""
-        n = jnp.maximum(state["num"], 1).astype(jnp.float32)
-        return {k: (state["sum"][k] / n).astype(v.dtype)
-                for k, v in params.items()}
+        n = jnp.maximum(state["num"] + state["old_num"], 1).astype(
+            jnp.float32)
+        return {k: ((state["sum"][k] + state["old_sum"][k]) / n).astype(
+            v.dtype) for k, v in params.items()}

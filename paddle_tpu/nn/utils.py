@@ -99,9 +99,15 @@ class SpectralNormWrapper(Layer):
         mat = jnp.moveaxis(w, self.dim, 0).reshape(w.shape[self.dim], -1)
         mat = mat.astype(jnp.float32)
         u = self.u
-        for _ in range(self.n_iters):
-            v = mat.T @ u
-            v = v / (jnp.linalg.norm(v) + self.eps)
+        # v is defined even for n_power_iterations=0 (reference accepts 0
+        # and reuses the cached u); for n>=1 the iteration order is
+        # unchanged: v = norm(matT u); u = norm(mat v), repeated
+        v = mat.T @ u
+        v = v / (jnp.linalg.norm(v) + self.eps)
+        for it in range(self.n_iters):
+            if it:
+                v = mat.T @ u
+                v = v / (jnp.linalg.norm(v) + self.eps)
             u = mat @ v
             u = u / (jnp.linalg.norm(u) + self.eps)
         sigma = u @ mat @ v

@@ -28,6 +28,7 @@ __all__ = [
     "isneginf", "isposinf", "isreal", "positive", "negative", "bitwise_left_shift",
     "bitwise_right_shift", "reduce_as", "gammaln", "gammainc", "gammaincc",
     "combinations", "unfold", "view", "view_as", "as_strided",
+    "scatter_nd", "cdist", "pdist",
 ]
 
 # -- NaN-aware reductions ---------------------------------------------------
@@ -400,6 +401,41 @@ def exponential_(x, lam=1.0):
     from ..core import random as _random
     key = _random.next_key()
     return jax.random.exponential(key, x.shape, x.dtype) / lam
+
+
+def scatter_nd(index, updates, shape):
+    """Reference: paddle.scatter_nd (tensor/manipulation.py) — zeros(shape)
+    with ``updates`` scatter-ADDed at ``index`` (duplicates accumulate)."""
+    from . import scatter_nd_add
+    updates = jnp.asarray(updates)
+    return scatter_nd_add(jnp.zeros(tuple(shape), updates.dtype), index,
+                          updates)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary"):
+    """Reference: paddle.cdist (tensor/linalg.py). Batched pairwise p-norm
+    distance: x [*B,P,M], y [*B,R,M] -> [*B,P,R]. The euclidean case uses
+    the MXU-friendly |x|^2+|y|^2-2xy formulation unless disabled."""
+    import math as _math
+    p = float(p)
+    if p == 2.0 and compute_mode != "donot_use_mm_for_euclid_dist":
+        x2 = jnp.sum(x * x, axis=-1, keepdims=True)
+        y2 = jnp.sum(y * y, axis=-1, keepdims=True)
+        sq = x2 + jnp.swapaxes(y2, -1, -2) - 2.0 * (x @ jnp.swapaxes(y, -1, -2))
+        return jnp.sqrt(jnp.maximum(sq, 0.0))
+    diff = jnp.abs(x[..., :, None, :] - y[..., None, :, :])
+    if p == 0.0:
+        return jnp.sum((diff != 0).astype(x.dtype), axis=-1)
+    if _math.isinf(p):
+        return jnp.max(diff, axis=-1)
+    return jnp.sum(diff ** p, axis=-1) ** (1.0 / p)
+
+
+def pdist(x, p=2.0):
+    """Reference: paddle.pdist — condensed (upper-triangle, row-major)
+    pairwise distances of one point set: [N,M] -> [N*(N-1)/2]."""
+    rows, cols = jnp.triu_indices(x.shape[0], k=1)
+    return cdist(x, x, p=p)[rows, cols]
 
 
 def combinations(x, r=2, with_replacement=False):

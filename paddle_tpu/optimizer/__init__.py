@@ -58,6 +58,7 @@ class Optimizer:
             self._wd_coeff = float(self.weight_decay)
         self.grad_clip = grad_clip
         self.multi_precision = multi_precision
+        self.master_grad = False  # set by amp.decorate(master_grad=True)
         self.apply_decay_param_fun = apply_decay_param_fun
         self._owner: Optional[Layer] = None
         self._names = None
@@ -92,6 +93,12 @@ class Optimizer:
     def apply(self, grads: Dict[str, jax.Array], state: PyTree,
               params: Dict[str, jax.Array]):
         """Pure update. grads may cover a subset of params (frozen ones skipped)."""
+        if getattr(self, "master_grad", False):
+            # amp master_grad: promote low-precision grads before clipping
+            # so the global-norm (and every later consumer) sees fp32
+            grads = jax.tree.map(
+                lambda g: g.astype(jnp.float32)
+                if jnp.issubdtype(g.dtype, jnp.floating) else g, grads)
         if self.grad_clip is not None:
             grads = self.grad_clip(grads)
         step = state["step"]

@@ -1,7 +1,9 @@
-"""Vision model zoo: ResNet family + LeNet.
+"""Vision model zoo: ResNet, LeNet, VGG, AlexNet, SqueezeNet, MobileNetV1/V2,
+DenseNet.
 
-Reference: python/paddle/vision/models/resnet.py, lenet.py. BatchNorm+conv
-blocks lower to XLA convs on the MXU; NCHW API kept for porting parity.
+Reference: python/paddle/vision/models/{resnet,lenet,vgg,alexnet,squeezenet,
+mobilenetv1,mobilenetv2,densenet}.py. BatchNorm+conv blocks lower to XLA
+convs on the MXU; NCHW API kept for porting parity.
 """
 
 from __future__ import annotations
@@ -12,7 +14,14 @@ from ..nn.layers_common import (AvgPool2D, BatchNorm2D, Conv2D, Dropout,
                                 Linear, MaxPool2D, ReLU, Sequential)
 from ..nn.layers_conv import AdaptiveAvgPool2D
 
-__all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "LeNet"]
+__all__ = [
+    "ResNet", "resnet18", "resnet34", "resnet50", "LeNet",
+    "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
+    "AlexNet", "alexnet",
+    "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
+    "MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2",
+    "DenseNet", "densenet121",
+]
 
 
 class BasicBlock(Layer):

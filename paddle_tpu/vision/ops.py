@@ -88,18 +88,43 @@ def roi_align(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
     if not aligned:  # legacy: clamp to min size 1
         rw = jnp.maximum(rw, 1.0)
         rh = jnp.maximum(rh, 1.0)
-    sr = sampling_ratio if sampling_ratio > 0 else 2
-    # sample grid: sr×sr points per output bin, averaged
-    def bin_coords(start, extent, nbins):
-        # [K, nbins, sr]: start + (bin + (s+0.5)/sr) * extent/nbins
-        s = (jnp.arange(sr) + 0.5) / sr
-        b = jnp.arange(nbins)
-        return (start[:, None, None]
-                + (b[None, :, None] + s[None, None, :])
-                * (extent / nbins)[:, None, None])
+    if sampling_ratio > 0:
+        sr_cap = int(sampling_ratio)
+        sr_y = jnp.full((k,), float(sr_cap), jnp.float32)
+        sr_x = sr_y
+    else:
+        # adaptive (reference/torchvision): ceil(roi extent / output bins)
+        # samples per bin, per roi. Shapes must stay static on TPU, so the
+        # grid is sr_cap wide with per-roi validity masks; rois larger than
+        # sr_cap× the output grid sample sr_cap points per bin (documented
+        # deviation). With concrete boxes (eager path) the cap is tightened
+        # to what the batch actually needs, so small rois don't pay for the
+        # full masked grid.
+        sr_cap = 8
+        if not isinstance(rh, jax.core.Tracer):
+            import math
+            need = max(
+                [1.0] + [math.ceil(float(e) / n) for e, n in
+                         [(float(jnp.max(rh)), oh), (float(jnp.max(rw)), ow)]])
+            sr_cap = max(1, min(sr_cap, int(need)))
+        sr_y = jnp.clip(jnp.ceil(rh / oh), 1.0, float(sr_cap))
+        sr_x = jnp.clip(jnp.ceil(rw / ow), 1.0, float(sr_cap))
 
-    ys = bin_coords(y1, rh, oh)                     # [K, oh, sr]
-    xs = bin_coords(x1, rw, ow)                     # [K, ow, sr]
+    # sample grid: up to sr_cap×sr_cap points per output bin, masked to the
+    # per-roi (sr_y, sr_x) counts and averaged
+    def bin_coords(start, extent, nbins, sr_vec):
+        # [K, nbins, sr_cap]: start + (bin + (s+0.5)/sr_roi) * extent/nbins
+        s = jnp.arange(sr_cap)
+        b = jnp.arange(nbins)
+        pos = (start[:, None, None]
+               + (b[None, :, None] + (s[None, None, :] + 0.5)
+                  / sr_vec[:, None, None])
+               * (extent / nbins)[:, None, None])
+        valid = s[None, None, :] < sr_vec[:, None, None]
+        return pos, valid
+
+    ys, yv = bin_coords(y1, rh, oh, sr_y)           # [K, oh, sr_cap]
+    xs, xv = bin_coords(x1, rw, ow, sr_x)           # [K, ow, sr_cap]
 
     def bilinear(img, yy, xx):
         """img: [C,H,W]; yy/xx: [P] → [P,C]"""
@@ -121,12 +146,17 @@ def roi_align(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
 
     def roi_pool(i):
         img = x[batch_idx[i]]
-        ys_r = ys[i]                                 # [oh, sr]
-        xs_r = xs[i]                                 # [ow, sr]
-        yy = jnp.tile(ys_r[:, None, :, None], (1, ow, 1, sr)).reshape(-1)
-        xx = jnp.tile(xs_r[None, :, None, :], (oh, 1, sr, 1)).reshape(-1)
-        vals = bilinear(img, yy, xx)                 # [oh*ow*sr*sr, C]
-        vals = vals.reshape(oh, ow, sr * sr, c).mean(axis=2)
+        ys_r = ys[i]                                 # [oh, sr_cap]
+        xs_r = xs[i]                                 # [ow, sr_cap]
+        yy = jnp.tile(ys_r[:, None, :, None], (1, ow, 1, sr_cap)).reshape(-1)
+        xx = jnp.tile(xs_r[None, :, None, :], (oh, 1, sr_cap, 1)).reshape(-1)
+        vv = (jnp.tile(yv[i][:, None, :, None], (1, ow, 1, sr_cap))
+              & jnp.tile(xv[i][None, :, None, :], (oh, 1, sr_cap, 1))
+              ).reshape(-1)
+        vals = bilinear(img, yy, xx)                 # [oh*ow*cap*cap, C]
+        vals = jnp.where(vv[:, None], vals, 0.0)
+        vals = (vals.reshape(oh, ow, sr_cap * sr_cap, c).sum(axis=2)
+                / (sr_y[i] * sr_x[i]))
         return jnp.moveaxis(vals, -1, 0)             # [C, oh, ow]
 
     return jax.vmap(roi_pool)(jnp.arange(k))

@@ -289,3 +289,31 @@ class TestIncubateOptimizers:
             params, state = step(params, state)
         assert np.isfinite(np.asarray(params["w"])).all()
         assert float(jnp.abs(params["w"]).mean()) < 1.0
+
+
+def test_model_average_window_roll():
+    """The window rolls into the old block: after the window fills, the
+    average still covers (old block + current block), never a bare restart
+    (reference: min/max_average_window + rate semantics)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu import optimizer
+    from paddle_tpu.incubate.optimizer import ModelAverage
+
+    inner = optimizer.SGD(learning_rate=1.0)
+    ma = ModelAverage(inner, average_window_rate=1.0, min_average_window=2,
+                      max_average_window=3)
+    params = {"w": jnp.zeros(())}
+    state = ma.init(params)
+    g = {"w": jnp.ones(())}
+    # params go -1,-2,-3,... window = min(3, max(2, updates)); at update 2
+    # num==window==2 → roll: old=(sum of -1,-2), num=0
+    for _ in range(3):
+        params, state = ma.apply(g, state, params)
+    assert int(state["num"]) == 1 and int(state["old_num"]) == 2
+    avg = ma.average_params(state, params)
+    assert float(avg["w"]) == -2.0  # (-1-2-3)/3 — history survives the roll
+    # one more step: average covers old block + new partial block
+    params, state = ma.apply(g, state, params)
+    avg = ma.average_params(state, params)
+    assert float(avg["w"]) == (-1 - 2 - 3 - 4) / 4.0

@@ -205,3 +205,14 @@ class TestNnUtils:
         back = vector_to_parameters(vec, ps)
         for a, b in zip(ps, back):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_spectral_norm_zero_power_iterations():
+    """n_power_iterations=0 must reuse the cached u (reference accepts 0)."""
+    from paddle_tpu import nn
+    from paddle_tpu.nn.utils import spectral_norm
+    lin = nn.Linear(4, 3)
+    sn = spectral_norm(lin, n_power_iterations=0)
+    out = sn(jnp.ones((2, 4)))
+    assert out.shape == (2, 3)
+    assert bool(jnp.all(jnp.isfinite(out)))

@@ -254,3 +254,44 @@ class TestLinalgExtras:
         c = A(4, 4)
         np.testing.assert_allclose(np.asarray(pt.ops.fft.rfftn(c)),
                                    np.fft.rfftn(c), rtol=1e-4, atol=1e-4)
+
+
+class TestDistanceAndScatterNd:
+    def test_scatter_nd(self):
+        index = np.array([[1], [2], [1]], np.int64)
+        updates = np.array([9.0, 10.0, 11.0], np.float32)
+        out = np.asarray(pt.scatter_nd(index, updates, [4]))
+        # duplicates accumulate (paddle.scatter_nd semantics)
+        np.testing.assert_allclose(out, [0.0, 20.0, 10.0, 0.0])
+
+    def test_scatter_nd_2d_index(self):
+        index = np.array([[0, 1], [2, 3]], np.int64)
+        updates = A(2, 5)
+        out = np.asarray(pt.scatter_nd(index, updates, [3, 4, 5]))
+        expect = np.zeros((3, 4, 5), np.float32)
+        expect[0, 1] += updates[0]
+        expect[2, 3] += updates[1]
+        np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+    @pytest.mark.parametrize("p", [0.0, 1.0, 2.0, 3.0, float("inf")])
+    def test_cdist_vs_torch(self, p):
+        import torch
+        x, y = A(2, 5, 4), A(2, 7, 4)
+        ours = np.asarray(pt.cdist(x, y, p=p))
+        ref = torch.cdist(torch.tensor(x), torch.tensor(y), p=p).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_cdist_no_mm_matches_mm(self):
+        x, y = A(3, 4), A(5, 4)
+        mm = np.asarray(pt.cdist(x, y))
+        no_mm = np.asarray(
+            pt.cdist(x, y, compute_mode="donot_use_mm_for_euclid_dist"))
+        np.testing.assert_allclose(mm, no_mm, rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("p", [1.0, 2.0])
+    def test_pdist_vs_torch(self, p):
+        import torch
+        x = A(6, 3)
+        ours = np.asarray(pt.pdist(x, p=p))
+        ref = torch.pdist(torch.tensor(x), p=p).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)

@@ -67,3 +67,24 @@ class TestGeometric:
         with pytest.raises(ValueError, match="message_op"):
             G.send_ue_recv(x, x, jnp.array([0, 1]), jnp.array([0, 1]),
                            "pow", "sum", 2)
+
+
+class TestSegmentEmptyAndIntDtypes:
+    def test_segment_max_int_empty_segment(self):
+        import paddle_tpu.geometric as G
+        data = jnp.array([3, 1, 7], jnp.int32)
+        ids = jnp.array([0, 0, 2])
+        out = np.asarray(G.segment_max(data, ids, out_size=3))
+        # empty segment 1 is zero, not INT_MIN
+        np.testing.assert_array_equal(out, [3, 0, 7])
+        out_min = np.asarray(G.segment_min(data, ids, out_size=3))
+        np.testing.assert_array_equal(out_min, [1, 0, 7])
+
+    def test_segment_max_keeps_legitimate_inf(self):
+        import paddle_tpu.geometric as G
+        data = jnp.array([float("inf"), 1.0, float("-inf")])
+        ids = jnp.array([0, 0, 1])
+        out = np.asarray(G.segment_max(data, ids, out_size=2))
+        assert out[0] == np.inf          # real +inf max survives
+        out_min = np.asarray(G.segment_min(data, ids, out_size=2))
+        assert out_min[1] == -np.inf     # real -inf min survives

@@ -222,3 +222,53 @@ class TestCachedGeneration:
         loss = m(ids[:, :-1], labels=ids[:, 1:].astype(jnp.int64))
         assert np.isfinite(float(loss))
         assert float(m.model._moe_aux) != 0.0  # router aux was produced
+
+
+def test_amp_master_grad():
+    """master_grad promotes bf16 grads to fp32 inside Optimizer.apply —
+    the update from bf16 grads must equal the update from the same grads
+    pre-cast to fp32 by the caller."""
+    def fresh():
+        pt.seed(7)
+        model = TinyReg()
+        opt = optimizer.SGD(learning_rate=0.5,
+                            grad_clip=nn.ClipGradByGlobalNorm(1e-3),
+                            parameters=model.parameters())
+        return amp.decorate(model, opt, level="O2", dtype="bfloat16")
+
+    _, opt_mg = fresh()
+    opt_mg.master_grad = True
+    _, opt_ref = fresh()
+    params = {"w": jnp.full((8, 16), 1.0, jnp.bfloat16)}
+    g16 = {"w": jnp.asarray(
+        np.random.default_rng(0).normal(size=(8, 16)), jnp.bfloat16)}
+    g32 = jax.tree.map(lambda g: g.astype(jnp.float32), g16)
+    p_mg, _ = opt_mg.apply(g16, opt_mg.init(params), params)
+    p_ref, _ = opt_ref.apply(g32, opt_ref.init(params), params)
+    # bitwise-equal: the promotion happened before clipping/update
+    np.testing.assert_array_equal(np.asarray(p_mg["w"], np.float32),
+                                  np.asarray(p_ref["w"], np.float32))
+
+    # end-to-end: decorate(master_grad=True) sets the flag and trains
+    model, opt = fresh()
+    amp.decorate(model, opt, master_grad=True)
+    assert opt.master_grad
+    step = TrainStep(model, loss_fn, opt)
+    state = step.init_state(0)
+    batch = _make_batch(jax.random.key(0))
+    batch = {"x": batch["x"].astype(jnp.bfloat16),
+             "y": batch["y"].astype(jnp.bfloat16)}
+    state, m = step(state, batch)
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_is_initialized_truthful():
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import fleet
+    fleet._reset()
+    try:
+        assert not dist.is_initialized()
+        fleet.init(is_collective=True)
+        assert dist.is_initialized()
+    finally:
+        fleet._reset()

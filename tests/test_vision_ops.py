@@ -72,3 +72,36 @@ class TestRoiAlign:
         boxes = jnp.array([[1.0, 1, 5, 5]])
         g = jax.grad(lambda x: V.roi_align(x, boxes, output_size=3).sum())(x)
         assert np.isfinite(np.asarray(g)).all() and float(jnp.abs(g).sum()) > 0
+
+
+class TestRoiAlignAdaptiveSampling:
+    def test_adaptive_matches_explicit_ratio(self):
+        # roi of 8px mapped to a 2-bin output → adaptive sr = ceil(8/2) = 4;
+        # must equal an explicit sampling_ratio=4 call exactly
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            size=(1, 2, 16, 16)).astype(np.float32))
+        boxes = jnp.array([[2.0, 2.0, 10.0, 10.0]])
+        adaptive = np.asarray(V.roi_align(x, boxes, output_size=2,
+                                          sampling_ratio=-1))
+        explicit = np.asarray(V.roi_align(x, boxes, output_size=2,
+                                          sampling_ratio=4))
+        np.testing.assert_allclose(adaptive, explicit, rtol=1e-5, atol=1e-6)
+
+    def test_adaptive_per_roi(self):
+        # two rois of different sizes get different per-roi sample counts;
+        # each must match its own explicit-ratio call
+        x = jnp.asarray(np.random.default_rng(1).normal(
+            size=(1, 1, 16, 16)).astype(np.float32))
+        small = jnp.array([[1.0, 1.0, 3.0, 3.0]])    # 2px/2bins → sr 1
+        large = jnp.array([[0.0, 0.0, 12.0, 12.0]])  # 12px/2bins → sr 6
+        both = np.asarray(V.roi_align(
+            x, jnp.concatenate([small, large]), output_size=2,
+            sampling_ratio=-1))
+        np.testing.assert_allclose(
+            both[0], np.asarray(V.roi_align(x, small, output_size=2,
+                                            sampling_ratio=1))[0],
+            rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            both[1], np.asarray(V.roi_align(x, large, output_size=2,
+                                            sampling_ratio=6))[0],
+            rtol=1e-5, atol=1e-6)
