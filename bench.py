@@ -56,8 +56,9 @@ def main():
     # uncredited extra forward (0.32), bs8 no-remat OOMs by 1.7 GiB
     batch_size = int(os.environ.get("PDTPU_BENCH_BATCH", 4 if on_tpu else 2))
     seq_len = int(os.environ.get("PDTPU_BENCH_SEQ", 2048 if on_tpu else 64))
-    # 40 steps ≈ 10s of steady-state: halves run-to-run MFU noise vs 20
-    steps = int(os.environ.get("PDTPU_BENCH_STEPS", 40 if on_tpu else 3))
+    # 60 steps ≈ 15s of steady-state (r2: widened from 40 — headline
+    # run-to-run spread was ~0.002 MFU at 40)
+    steps = int(os.environ.get("PDTPU_BENCH_STEPS", 60 if on_tpu else 3))
 
     remat = os.environ.get("PDTPU_BENCH_REMAT", "0") == "1"
     # seq-chunked rematerialized vocab CE skips the [B,S,V] logits
