@@ -27,7 +27,11 @@ from .mp_layers import (ColumnParallelLinear, RowParallelLinear,  # noqa: F401
                         scatter_to_sequence_parallel,
                         gather_from_sequence_parallel,
                         mark_as_sequence_parallel_parameter)
-from .auto import shard_tensor, reshard, DistAttr, Shard, Replicate, Partial  # noqa: F401
+from .auto import (DistAttr, Partial, PartialTensor,  # noqa: F401
+                   ProcessMesh, Replicate, Shard, ShardDataloader,
+                   dtensor_from_fn, reshard, shard_dataloader, shard_layer,
+                   shard_tensor)
+from .parallel import DataParallel  # noqa: F401
 from .recompute import recompute, RecomputeWrapper  # noqa: F401
 from .pipeline import (LayerDesc, SharedLayerDesc, PipelineLayer,  # noqa: F401
                        PipelineParallel, StackedPipelineStages)

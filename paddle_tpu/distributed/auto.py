@@ -41,12 +41,24 @@ class Replicate(Placement):
 
 
 class Partial(Placement):
-    """Pending-reduction placement.  jax has no user-visible partial arrays
-    outside shard_map; shard_tensor treats it as Replicate (the reduction
-    happens where the value is produced)."""
+    """Pending-reduction placement (reference: Partial(reduce_type) in
+    auto_parallel/placement_type.py).
+
+    jax global Arrays cannot carry a pending reduction, so ``shard_tensor``
+    with a Partial placement returns a :class:`PartialTensor` — an explicit
+    pending-reduction value whose per-rank shards sum (or mean/max/min) to
+    the global.  ``reshard`` materializes it with the reduction; any other
+    use raises loudly instead of silently reading partial values (the
+    round-1 behavior of treating Partial as Replicate was a silent
+    semantic downgrade)."""
+
+    def __init__(self, reduce_type: str = "sum"):
+        if reduce_type not in ("sum", "avg", "mean", "max", "min"):
+            raise ValueError(f"unsupported Partial reduce_type {reduce_type}")
+        self.reduce_type = "mean" if reduce_type == "avg" else reduce_type
 
     def __repr__(self):
-        return "Partial()"
+        return f"Partial({self.reduce_type!r})"
 
 
 class DistAttr:
@@ -98,24 +110,233 @@ def _placements_to_spec(mesh: Mesh, placements: Sequence[Placement],
     return P(*entries)
 
 
+class PartialTensor:
+    """Explicit pending-reduction value (the reference's DistTensor with a
+    Partial placement).
+
+    Internally a stacked global array of shape ``(axis_size, *shape)``
+    sharded over the partial mesh axis on dim 0, so each rank owns one
+    addend.  ``reshard`` to Replicate/Shard applies the reduction (XLA
+    lowers the sum over the sharded dim to an all-reduce); any arithmetic
+    or export raises, because reading partial values is the bug the
+    reference's placement system exists to prevent."""
+
+    def __init__(self, stacked, mesh: Mesh, axes: Sequence[str],
+                 placements: Sequence[Placement], reduce_type: str):
+        self._stacked = stacked          # (prod(axis sizes), *shape)
+        self.mesh = mesh
+        self.axes = tuple(axes)          # mesh axes the value is partial over
+        self.placements = list(placements)
+        self.reduce_type = reduce_type
+
+    @property
+    def shape(self):
+        return self._stacked.shape[1:]
+
+    @property
+    def dtype(self):
+        return self._stacked.dtype
+
+    def __repr__(self):
+        return (f"PartialTensor(shape={tuple(self.shape)}, "
+                f"axes={self.axes}, reduce={self.reduce_type!r})")
+
+    def _reduce(self):
+        import jax.numpy as jnp
+        red = {"sum": jnp.sum, "mean": jnp.mean, "max": jnp.max,
+               "min": jnp.min}[self.reduce_type]
+        return red(self._stacked, axis=0)
+
+    def _refuse(self, what):
+        raise RuntimeError(
+            f"PartialTensor is a pending reduction over mesh axes "
+            f"{self.axes}; {what} would read partial values. "
+            "reshard(x, mesh, [Replicate()/Shard(d), ...]) first.")
+
+    def __array__(self, *a, **k):
+        self._refuse("converting to an array")
+
+    def __jax_array__(self):
+        self._refuse("using it in an op")
+
+    def _refuse_op(self, *a, **k):
+        self._refuse("arithmetic")
+
+    __add__ = __radd__ = __sub__ = __rsub__ = _refuse_op
+    __mul__ = __rmul__ = __truediv__ = __rtruediv__ = _refuse_op
+    __matmul__ = __rmatmul__ = __neg__ = _refuse_op
+
+
 def shard_tensor(x, mesh=None, placements: Sequence[Placement] = (),
                  dist_attr=None, stop_gradient=None):
-    """Place ``x`` on the mesh with the given per-mesh-dim placements."""
+    """Place ``x`` on the mesh with the given per-mesh-dim placements.
+
+    With one or more ``Partial`` placements the result is a
+    :class:`PartialTensor` whose per-rank addends reduce to ``x`` (rank 0
+    holds ``x``, the rest the reduction identity — the reference's
+    init-on-rank-0 convention)."""
     if dist_attr is not None:
         mesh, placements = dist_attr.mesh, dist_attr.placements
     jmesh = _to_jax_mesh(mesh)
+    partial_axes = [ax for ax, pl in zip(jmesh.axis_names, placements)
+                    if isinstance(pl, Partial)]
+    if partial_axes:
+        return _make_partial(x, jmesh, partial_axes, placements)
     spec = _placements_to_spec(jmesh, placements, jax.numpy.ndim(x))
+    _check_divisible(x, jmesh, spec)
     return jax.device_put(x, NamedSharding(jmesh, spec))
+
+
+def _check_divisible(x, jmesh: Mesh, spec: P):
+    """XLA shards evenly: every Shard-ed dim must divide by the axis size.
+    The reference's reshard supports ragged tails; here that would need
+    silent padding that changes the global shape — raise with the fix
+    instead."""
+    import numpy as np
+    shape = jax.numpy.shape(x)
+    for d, entry in enumerate(spec):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        n = int(np.prod([jmesh.shape[a] for a in axes]))
+        if shape[d] % n:
+            raise ValueError(
+                f"cannot Shard dim {d} (size {shape[d]}) over mesh axes "
+                f"{axes} (total {n}): XLA requires even tiles. Pad the dim "
+                f"to a multiple of {n} (e.g. paddle_tpu.concat with a pad "
+                "block) or shard a divisible dim.")
+
+
+def _make_partial(x, jmesh: Mesh, axes: Sequence[str],
+                  placements: Sequence[Placement]) -> PartialTensor:
+    import jax.numpy as jnp
+    import numpy as np
+    reduce_types = {pl.reduce_type for pl in placements
+                    if isinstance(pl, Partial)}
+    if len(reduce_types) > 1:
+        raise ValueError(f"mixed Partial reduce types {reduce_types}")
+    reduce_type = reduce_types.pop()
+    n = int(np.prod([jmesh.shape[a] for a in axes]))
+    x = jnp.asarray(x)
+    if reduce_type in ("sum",):
+        identity = jnp.zeros_like(x)
+    elif reduce_type == "mean":
+        identity = x  # mean of n copies of x is x
+    else:  # max/min: identity = x itself keeps the reduction exact
+        identity = x
+    stacked = jnp.stack([x] + [identity] * (n - 1))
+    # shard the stack dim over the partial axes; remaining placements
+    # (Shard/Replicate on other mesh axes) apply to the value dims, shifted
+    # by the stacking dim
+    shifted = [Shard(pl.dim + 1) if isinstance(pl, Shard) else Replicate()
+               for pl in placements]
+    entries: List = list(_placements_to_spec(jmesh, shifted, x.ndim + 1))
+    entries[0] = tuple(axes) if len(axes) > 1 else axes[0]
+    spec = P(*entries)
+    _check_divisible(stacked, jmesh, spec)
+    stacked = jax.device_put(stacked, NamedSharding(jmesh, spec))
+    return PartialTensor(stacked, jmesh, axes, placements, reduce_type)
 
 
 def reshard(x, mesh=None, placements: Sequence[Placement] = ()):
     """Change an array's distribution (reference: reshard pass inserting
-    collectives; here XLA derives them from device_put)."""
+    collectives; here XLA derives them from device_put).  Resharding a
+    :class:`PartialTensor` to Replicate/Shard materializes the pending
+    reduction (all-reduce over the partial axes)."""
+    if isinstance(x, PartialTensor):
+        if any(isinstance(pl, Partial) for pl in placements):
+            raise RuntimeError(
+                "reshard of a PartialTensor to a Partial placement is a "
+                "no-op request; target Replicate()/Shard(d) to reduce")
+        x = x._reduce()
     return shard_tensor(x, mesh, placements)
 
 
 def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
     return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+class ShardDataloader:
+    """Wrap a DataLoader so every batch lands on the mesh sharded along the
+    batch dim (reference: paddle.distributed.shard_dataloader,
+    auto_parallel/api.py).
+
+    ``shard_dims`` names the mesh axis (or axes) carrying data parallelism;
+    by default the mesh's first axis.  Batches may be arrays, sequences, or
+    dicts — every array leaf is placed with Shard(0) over those axes.  With
+    ``input_keys`` only the named dict entries are sharded (the rest are
+    replicated)."""
+
+    def __init__(self, dataloader, meshes=None, input_keys=None,
+                 shard_dims=None, is_dataset_splitted=False):
+        self._dl = dataloader
+        if isinstance(meshes, (list, tuple)):
+            if len({id(m) for m in meshes}) > 1:
+                # reference: per-pipeline-stage meshes (inputs on the first
+                # stage, labels on the last); a single-SPMD program has one
+                # mesh, so silently using meshes[0] would misplace data
+                raise NotImplementedError(
+                    "per-stage mesh lists are not supported: the pipeline "
+                    "is one SPMD program over one mesh — pass that mesh")
+            meshes = meshes[0] if meshes else None
+        self._mesh = _to_jax_mesh(meshes)
+        if shard_dims is None:
+            axes: Sequence[str] = (self._mesh.axis_names[0],)
+        elif isinstance(shard_dims, str):
+            axes = (shard_dims,)
+        elif isinstance(shard_dims, int):
+            axes = (self._mesh.axis_names[shard_dims],)
+        else:
+            axes = tuple(a if isinstance(a, str) else self._mesh.axis_names[a]
+                         for a in shard_dims)
+        for a in axes:
+            if a not in self._mesh.axis_names:
+                raise ValueError(f"shard_dims axis {a!r} not in mesh axes "
+                                 f"{self._mesh.axis_names}")
+        self._axes = axes
+        self._input_keys = set(input_keys) if input_keys else None
+        # per-host pre-split datasets would double-shard under a global
+        # device_put; unsupported in the single-controller SPMD model
+        if is_dataset_splitted:
+            raise NotImplementedError(
+                "is_dataset_splitted=True: under SPMD the loader yields the "
+                "global batch and sharding places it; pre-split per-host "
+                "loading is handled by io.DistributedBatchSampler instead")
+
+    def _place(self, leaf):
+        import numpy as np
+        if not isinstance(leaf, (jax.Array, np.ndarray)) or jax.numpy.ndim(
+                leaf) == 0:
+            return leaf
+        n = int(np.prod([self._mesh.shape[a] for a in self._axes]))
+        if leaf.shape[0] % n:
+            raise ValueError(
+                f"batch dim {leaf.shape[0]} is not divisible by the "
+                f"{'x'.join(self._axes)} axis size {n} (XLA shards evenly); "
+                "use DataLoader(drop_last=True) or pad the final batch")
+        spec = P(self._axes[0] if len(self._axes) == 1 else self._axes)
+        return jax.device_put(leaf, NamedSharding(self._mesh, spec))
+
+    def _shard_batch(self, batch):
+        if isinstance(batch, dict):
+            return {k: (jax.tree.map(self._place, v)
+                        if self._input_keys is None or k in self._input_keys
+                        else v)
+                    for k, v in batch.items()}
+        return jax.tree.map(self._place, batch)
+
+    def __iter__(self):
+        for batch in self._dl:
+            yield self._shard_batch(batch)
+
+    def __len__(self):
+        return len(self._dl)
+
+
+def shard_dataloader(dataloader, meshes=None, input_keys=None,
+                     shard_dims=None, is_dataset_splitted=False):
+    return ShardDataloader(dataloader, meshes, input_keys, shard_dims,
+                           is_dataset_splitted)
 
 
 def shard_layer(layer, mesh=None, shard_fn=None):
