@@ -84,6 +84,10 @@ def __getattr__(name):
         from .hapi import Model
         globals()["Model"] = Model
         return Model
+    if name == "DataParallel":  # the class itself: isinstance/subclass work
+        from .distributed.parallel import DataParallel
+        globals()["DataParallel"] = DataParallel
+        return DataParallel
     if name == "flops":  # paddle.flops lives in hapi (dynamic_flops)
         from .hapi import flops
         globals()["flops"] = flops
@@ -107,3 +111,15 @@ def __getattr__(name):
         globals()["metric"] = metrics
         return metrics
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    # surface the lazily-resolved names so dir()/introspection (and the
+    # api-compat spec scanner) see the full public surface
+    return sorted(set(globals()) | {
+        "distributed", "io", "ckpt", "models", "profiler", "metrics",
+        "vision", "incubate", "hapi", "static", "device", "launch", "utils",
+        "config", "sparse", "quantization", "inference", "audio",
+        "distribution", "geometric", "signal", "regularizer", "callbacks",
+        "Model", "DataParallel", "flops", "summary", "version", "metric",
+        "enable_static", "disable_static", "in_dynamic_mode"})

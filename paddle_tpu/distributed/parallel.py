@@ -1,0 +1,98 @@
+"""``paddle.DataParallel`` parity (reference:
+python/paddle/distributed/parallel.py).
+
+In the reference, DataParallel hooks a gradient Reducer into eager
+backward: every ``loss.backward()`` all-reduces grads across ranks, and
+``no_sync()`` suppresses that all-reduce so grads accumulate locally for
+gradient accumulation.
+
+TPU redesign: under single-controller SPMD the cross-device grad
+reduction is part of the compiled step (XLA derives it from the sharded
+batch — SURVEY §7.0 dissolves the Reducer).  The wrapper therefore
+carries the *contract*, not the transport:
+
+- ``DataParallel(model)`` validates/uses the dp environment and delegates
+  forward/state to the wrapped Layer (checkpoints stay wrapper-free, like
+  the reference's ``state_dict`` delegation);
+- ``no_sync()`` flips a flag that ``jit.TrainStep`` reads at dispatch
+  time: inside the context a step ACCUMULATES gradients into the train
+  state and skips the optimizer; the first step outside folds the
+  accumulated grads in and applies the update.  Reference semantics —
+  grads SUM across microsteps, so callers scale the loss by
+  1/accumulate_steps exactly as they would with the reference.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from ..nn.layer import Layer
+from . import fleet
+
+
+class DataParallel(Layer):
+    """Layer wrapper routing training to the mesh's dp axis.
+
+    Usage (compiled path)::
+
+        model = paddle_tpu.DataParallel(model)
+        step = TrainStep(model, loss_fn, opt, mesh=mesh)  # accumulation on
+        with model.no_sync():
+            state, _ = step(state, micro1)   # grads staged, no update
+        state, m = step(state, micro2)       # folds staged grads, updates
+    """
+
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        # reference ignores these on single-process too; kept for signature
+        # parity (comm buffers have no meaning under XLA collectives)
+        del strategy, comm_buffer_size, last_comm_buffer_size
+        del find_unused_parameters, group
+        self._layers = layers
+        self._grad_sync = True
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Accumulate grads without applying the optimizer (reference:
+        DataParallel.no_sync suppressing the Reducer all-reduce)."""
+        prev = self._grad_sync
+        self._grad_sync = False
+        try:
+            yield
+        finally:
+            self._grad_sync = prev
+
+    def scale_loss(self, loss):
+        """Reference API: pre-backward loss scaling hook. The SPMD grad of
+        a mean loss over the sharded global batch is already the mean —
+        identity here."""
+        return loss
+
+    # checkpoint surface stays wrapper-free (reference behavior)
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    load_dict = set_state_dict
+
+
+def init_parallel_env(*args, **kwargs):
+    from .communication import init_parallel_env as _impl
+    return _impl(*args, **kwargs)
+
+
+def get_rank(*args, **kwargs):
+    from .communication import get_rank as _impl
+    return _impl(*args, **kwargs)
+
+
+def get_world_size(*args, **kwargs):
+    from .communication import get_world_size as _impl
+    return _impl(*args, **kwargs)

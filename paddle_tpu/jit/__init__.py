@@ -156,9 +156,17 @@ class TrainStep:
                  batch_axes=("dp", "sharding"), batch_spec=None,
                  zero_stage: Optional[int] = None,
                  zero_axes=("dp", "sharding"),
-                 extra_metrics: Optional[Callable] = None):
+                 extra_metrics: Optional[Callable] = None,
+                 gradient_accumulation: Optional[bool] = None):
+        from ..distributed.parallel import DataParallel
         from ..distributed.sharding import zero_offload_of, zero_stage_of
         self.model = model
+        # DataParallel's no_sync() drives per-call accumulation; carrying
+        # acc-grad buffers in the state costs memory, so they exist only
+        # when the wrapper (or an explicit flag) asks for them
+        self._accum = (isinstance(model, DataParallel)
+                       if gradient_accumulation is None
+                       else bool(gradient_accumulation))
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.scaler = scaler
@@ -186,7 +194,8 @@ class TrainStep:
             self.batch_spec = P()
             self.zero_axes = []
         self._mask = trainable_mask(model)
-        self._compiled = jax.jit(self._step, donate_argnums=(0,))
+        self._compiled = jax.jit(self._step, donate_argnums=(0,),
+                                 static_argnums=(2,))
 
     # -- sharding specs ----------------------------------------------------
 
@@ -237,8 +246,14 @@ class TrainStep:
         state = {"params": params, "opt": opt_state,
                  "step": jnp.zeros((), jnp.int32),
                  "rng": jax.random.key(seed)}
+        if self._accum:
+            state["acc_grads"] = {
+                k: jnp.zeros_like(v) for k, v in params.items()
+                if self._mask.get(k, True)}
         if self.scaler is not None and self.scaler.enable:
             state["scaler"] = self.scaler.init_state()
+            if self._accum:
+                state["scaler"]["acc_found_inf"] = jnp.asarray(False)
         return self.shard_state(state)
 
     def shard_state(self, state):
@@ -265,6 +280,11 @@ class TrainStep:
                 else:
                     new_opt[slot] = jax.device_put(val, _named(self.mesh, P()))
             state["opt"] = new_opt
+            if "acc_grads" in state:
+                gspecs = self.grad_specs(state["acc_grads"], pspecs)
+                state["acc_grads"] = {
+                    k: jax.device_put(v, _named(self.mesh, gspecs[k]))
+                    for k, v in state["acc_grads"].items()}
             state["step"] = jax.device_put(state["step"], _named(self.mesh, P()))
         return state
 
@@ -281,7 +301,7 @@ class TrainStep:
             scaled = self.scaler.scale_value(loss, scaler_state)
         return scaled, loss
 
-    def _step(self, state, batch):
+    def _step(self, state, batch, accumulate=False):
         mesh = self.mesh
         if mesh is not None:
             batch = jax.tree.map(
@@ -297,6 +317,40 @@ class TrainStep:
         (scaled, loss), grads = grad_fn(train, frozen, batch, key, scaler_state)
         if self.scaler is not None and self.scaler.enable:
             grads, scaler_state = self.scaler.unscale_and_update(grads, scaler_state)
+        if accumulate:
+            # no_sync microstep (reference: DataParallel.no_sync suppresses
+            # the Reducer all-reduce): stage grads by SUM — callers scale
+            # the loss by 1/accumulate_steps, exactly as with the
+            # reference — and leave params/optimizer untouched
+            new_state = {
+                **state,
+                "acc_grads": {k: state["acc_grads"][k] + g
+                              for k, g in grads.items()},
+                "step": state["step"] + 1}
+            if scaler_state is not None:
+                new_state["scaler"] = {
+                    k: scaler_state[k]
+                    for k in ("scale", "good_steps", "bad_steps")}
+                # overflow on ANY microstep must skip the whole accumulated
+                # update (reference scaler semantics) — sticky until the
+                # update step consumes it
+                new_state["scaler"]["acc_found_inf"] = (
+                    state["scaler"].get("acc_found_inf", jnp.asarray(False))
+                    | scaler_state.get("found_inf", jnp.asarray(False)))
+            metrics = {"loss": loss,
+                       "lr": _current_lr(self.optimizer,
+                                         {"step": state["opt"]["step"]})}
+            if self.extra_metrics is not None:
+                metrics.update(self.extra_metrics(new_state, batch))
+            return new_state, metrics
+        if "acc_grads" in state:
+            grads = {k: g + state["acc_grads"][k] for k, g in grads.items()}
+            if scaler_state is not None and "found_inf" in scaler_state:
+                scaler_state = {
+                    **scaler_state,
+                    "found_inf": scaler_state["found_inf"]
+                    | state["scaler"].get("acc_found_inf",
+                                          jnp.asarray(False))}
         if mesh is not None:
             pspecs = self.param_specs()
             gspecs = self.grad_specs(grads, pspecs)
@@ -327,9 +381,14 @@ class TrainStep:
                 for slot, val in new_opt.items()}
         new_state = {"params": new_params, "opt": new_opt,
                      "step": state["step"] + 1, "rng": state["rng"]}
+        if "acc_grads" in state:
+            new_state["acc_grads"] = {
+                k: jnp.zeros_like(v) for k, v in state["acc_grads"].items()}
         if scaler_state is not None:
             new_state["scaler"] = {k: scaler_state[k]
                                    for k in ("scale", "good_steps", "bad_steps")}
+            if "acc_grads" in state:
+                new_state["scaler"]["acc_found_inf"] = jnp.asarray(False)
         # lr from the OPTIMIZER's step counter (it does not advance on
         # overflow-skipped steps, unlike the outer step counter)
         metrics = {"loss": loss,
@@ -338,14 +397,22 @@ class TrainStep:
             metrics.update(self.extra_metrics(new_state, batch))
         return new_state, metrics
 
-    def __call__(self, state, batch):
+    def __call__(self, state, batch, accumulate: Optional[bool] = None):
+        if accumulate is None:
+            # DataParallel.no_sync() context → accumulate this call
+            accumulate = not getattr(self.model, "_grad_sync", True)
+        if accumulate and not self._accum:
+            raise RuntimeError(
+                "gradient accumulation requested but this TrainStep was "
+                "built without buffers: wrap the model in "
+                "paddle_tpu.DataParallel or pass gradient_accumulation=True")
         if self.mesh is not None:
             with self.mesh:
-                return self._compiled(state, batch)
-        return self._compiled(state, batch)
+                return self._compiled(state, batch, accumulate)
+        return self._compiled(state, batch, accumulate)
 
     def lower(self, state, batch):
-        return self._compiled.lower(state, batch)
+        return self._compiled.lower(state, batch, False)
 
 
 def _current_lr(optimizer, state):

@@ -1,0 +1,188 @@
+"""paddle.DataParallel + no_sync parity (reference:
+python/paddle/distributed/parallel.py — Reducer all-reduce suppression for
+gradient accumulation).  Serial-vs-parallel and accumulation-vs-big-batch
+equivalence, the reference's own test strategy (SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer
+from paddle_tpu.jit import TrainStep
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 1)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+def loss_fn(model, batch):
+    return nn.functional.mse_loss(model(batch["x"]), batch["y"])
+
+
+def _batch(key, n):
+    x = jax.random.normal(key, (n, 8))
+    y = (x @ jnp.linspace(0.1, 0.9, 8)[:, None]) + 0.05
+    return {"x": x, "y": y}
+
+
+def _mesh():
+    return Mesh(np.asarray(jax.devices()).reshape(8), ("dp",))
+
+
+def _make(wrap=True, mesh=None):
+    pt.seed(42)
+    model = Net()
+    if wrap:
+        model = pt.DataParallel(model)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    return model, TrainStep(model, loss_fn, opt, mesh=mesh)
+
+
+class TestDataParallelWrapper:
+    def test_forward_delegates(self):
+        pt.seed(0)
+        inner = Net()
+        dp = pt.DataParallel(inner)
+        x = jnp.ones((2, 8))
+        np.testing.assert_allclose(np.asarray(dp(x)),
+                                   np.asarray(inner(x)))
+
+    def test_state_dict_wrapper_free(self):
+        pt.seed(0)
+        dp = pt.DataParallel(Net())
+        sd = dp.state_dict()
+        assert "fc1.weight" in sd          # no "_layers." prefix
+        dp2 = pt.DataParallel(Net())
+        dp2.set_state_dict(sd)
+        np.testing.assert_allclose(
+            np.asarray(dp2.state_dict()["fc1.weight"]),
+            np.asarray(sd["fc1.weight"]))
+
+    def test_scale_loss_identity(self):
+        dp = pt.DataParallel(Net())
+        assert float(dp.scale_loss(jnp.asarray(3.0))) == 3.0
+
+
+class TestSerialVsParallel:
+    def test_dp_matches_serial(self):
+        """Same model/batch: single-device step == dp-sharded step."""
+        batch = _batch(jax.random.key(0), 16)
+        _, step_serial = _make(wrap=False, mesh=None)
+        _, step_dp = _make(wrap=True, mesh=_mesh())
+        s1 = step_serial.init_state(0)
+        s2 = step_dp.init_state(0)
+        for _ in range(3):
+            s1, m1 = step_serial(s1, batch)
+            s2, m2 = step_dp(s2, batch)
+        np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                                   rtol=1e-5)
+        for k in s1["params"]:
+            np.testing.assert_allclose(
+                np.asarray(s1["params"][k]),
+                np.asarray(s2["params"]["_layers." + k]),
+                rtol=1e-5, atol=1e-6)
+
+
+class TestNoSyncAccumulation:
+    def test_two_microsteps_match_big_batch(self):
+        """2-step accumulation (loss scaled by 1/2, reference recipe)
+        == one step on the concatenated batch."""
+        mesh = _mesh()
+        big = _batch(jax.random.key(1), 16)
+        half1 = {k: v[:8] for k, v in big.items()}
+        half2 = {k: v[8:] for k, v in big.items()}
+
+        def scaled_loss(model, batch):
+            return loss_fn(model, batch) / 2.0
+
+        pt.seed(42)
+        dp = pt.DataParallel(Net())
+        opt = optimizer.SGD(learning_rate=0.1, parameters=dp.parameters())
+        step_acc = TrainStep(dp, scaled_loss, opt, mesh=mesh)
+        sa = step_acc.init_state(0)
+        with dp.no_sync():
+            sa, _ = step_acc(sa, half1)      # staged, no update
+        sa, _ = step_acc(sa, half2)          # folds staged grads, updates
+
+        _, step_big = _make(wrap=True, mesh=mesh)
+        sb = step_big.init_state(0)
+        sb, _ = step_big(sb, big)
+
+        for k in sb["params"]:
+            np.testing.assert_allclose(np.asarray(sa["params"][k]),
+                                       np.asarray(sb["params"][k]),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_microstep_does_not_touch_params(self):
+        mesh = _mesh()
+        dp, step = _make(wrap=True, mesh=mesh)
+        state = step.init_state(0)
+        p0 = {k: np.asarray(v) for k, v in state["params"].items()}
+        with dp.no_sync():
+            state, m = step(state, _batch(jax.random.key(2), 8))
+        for k, v in state["params"].items():
+            np.testing.assert_array_equal(np.asarray(v), p0[k])
+        # grads staged
+        assert any(float(jnp.abs(g).sum()) > 0
+                   for g in state["acc_grads"].values())
+        assert np.isfinite(float(m["loss"]))
+
+    def test_accumulation_needs_buffers(self):
+        _, step = _make(wrap=False, mesh=None)
+        state = step.init_state(0)
+        with pytest.raises(RuntimeError, match="gradient accumulation"):
+            step(state, _batch(jax.random.key(3), 8), accumulate=True)
+
+    def test_explicit_flag_without_wrapper(self):
+        """gradient_accumulation=True enables the same path on a bare
+        Layer via step(..., accumulate=True)."""
+        pt.seed(42)
+        model = Net()
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=model.parameters())
+        step = TrainStep(model, lambda m, b: loss_fn(m, b) / 2.0, opt,
+                         gradient_accumulation=True)
+        state = step.init_state(0)
+        big = _batch(jax.random.key(1), 16)
+        state, _ = step(state, {k: v[:8] for k, v in big.items()},
+                        accumulate=True)
+        state, _ = step(state, {k: v[8:] for k, v in big.items()})
+        assert float(jnp.abs(state["acc_grads"]["fc1.weight"]).sum()) == 0
+
+
+class TestNoSyncScalerOverflow:
+    @pytest.mark.parametrize("dynamic", [True, False])
+    def test_overflow_microstep_skips_accumulated_update(self, dynamic):
+        """An inf on ANY microstep must skip the whole accumulated update
+        (reference GradScaler semantics), in both scaler modes."""
+        from paddle_tpu import amp
+
+        pt.seed(42)
+        dp = pt.DataParallel(Net())
+        opt = optimizer.SGD(learning_rate=0.1, parameters=dp.parameters())
+        scaler = amp.GradScaler(init_loss_scaling=2.0,
+                                use_dynamic_loss_scaling=dynamic)
+        step = TrainStep(dp, loss_fn, opt, scaler=scaler)
+        state = step.init_state(0)
+        p0 = {k: np.asarray(v) for k, v in state["params"].items()}
+        bad = _batch(jax.random.key(0), 8)
+        bad["x"] = bad["x"].at[0, 0].set(jnp.inf)
+        with dp.no_sync():
+            state, _ = step(state, bad)                    # overflow staged
+        state, _ = step(state, _batch(jax.random.key(1), 8))  # finite step
+        for k, v in state["params"].items():
+            np.testing.assert_array_equal(np.asarray(v), p0[k])
+        # the sticky flag is consumed: the next clean cycle updates again
+        state, _ = step(state, _batch(jax.random.key(2), 8))
+        assert any(not np.array_equal(np.asarray(v), p0[k])
+                   for k, v in state["params"].items())
