@@ -340,7 +340,7 @@ class DistributedBatchSampler(BatchSampler):
 
 def _stack_arrays(batch):
     """np.stack with the C++ GIL-released memcpy fast path when built
-    (native/pdtpu_native.cpp pdtpu_collate_stack) — lets the prefetch
+    (paddle_tpu/native/pdtpu_native.cpp pdtpu_collate_stack) — lets the prefetch
     thread pool collate in parallel. collate_stack itself returns None
     when the lib is missing or the fast path doesn't apply."""
     from .. import runtime_native

@@ -11,7 +11,7 @@ protocol — rank assignment, peer discovery, elastic heartbeats — the part
 the reference does with HTTPMaster/ETCDMaster + TCPStore.
 
 A C++ implementation of the same protocol lives in
-``native/pdtpu_native.cpp`` (built as ``native/build/libpdtpu_native.so``
+``paddle_tpu/native/pdtpu_native.cpp`` (built as ``build/libpdtpu_native.so``
 via ``make -C native``); ``TCPStore`` uses its server through
 ctypes (paddle_tpu.runtime_native) when built, falling back to the pure
 Python socketserver here.
@@ -142,7 +142,7 @@ class TCPStore:
                 from .. import runtime_native
                 use_native = runtime_native.available()
             if use_native:
-                # C++ server (native/pdtpu_native.cpp) — same wire protocol,
+                # C++ server (paddle_tpu/native/pdtpu_native.cpp) — same wire protocol,
                 # immune to GIL stalls in the hosting training process
                 from ..runtime_native import StoreServer as _Native
                 self._native_server = _Native(host, int(port))

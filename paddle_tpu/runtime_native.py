@@ -1,4 +1,5 @@
-"""ctypes bindings for the C++ runtime library (native/pdtpu_native.cpp).
+"""ctypes bindings for the C++ runtime library (paddle_tpu/native/
+pdtpu_native.cpp).
 
 Reference parity: the reference's TCPStore, reader blocking queue, and
 tensor collation are C++ (SURVEY §2.4 store row, §2.6 data pipeline row);
@@ -6,8 +7,10 @@ this module is their TPU-host equivalent. Everything degrades gracefully:
 ``available()`` is False when the library isn't built and callers fall back
 to pure Python (launch/store.py, io collate).
 
-Build: ``make -C native`` (done automatically on first import when a
-toolchain is present; result cached at native/build/libpdtpu_native.so).
+Build: ``make -C paddle_tpu/native`` (done automatically on first import
+when a toolchain is present). The .so lands next to the sources when that
+directory is writable (repo checkout / venv), else in
+``~/.cache/paddle_tpu`` (read-only site-packages install).
 """
 
 from __future__ import annotations
@@ -20,9 +23,27 @@ from typing import List, Optional
 
 import numpy as np
 
-_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_NATIVE_DIR = os.path.join(_REPO, "native")
-_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libpdtpu_native.so")
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "native")
+
+
+def _build_dir() -> str:
+    if os.access(_NATIVE_DIR, os.W_OK):
+        return os.path.join(_NATIVE_DIR, "build")
+    # shared per-user cache: key by source content, not mtime — wheel
+    # timestamps are normalized (SOURCE_DATE_EPOCH), so after an upgrade a
+    # stale .so would otherwise read as fresh and be dlopened against new
+    # bindings
+    import hashlib
+    with open(os.path.join(_NATIVE_DIR, "pdtpu_native.cpp"), "rb") as f:
+        key = hashlib.sha1(f.read()).hexdigest()[:12]
+    return os.path.join(
+        os.environ.get("XDG_CACHE_HOME",
+                       os.path.expanduser("~/.cache")),
+        "paddle_tpu", f"native-build-{key}")
+
+
+_SO_PATH = os.path.join(_build_dir(), "libpdtpu_native.so")
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -40,21 +61,22 @@ def _try_build() -> bool:
     if _build_attempted:
         return os.path.exists(_SO_PATH)
     _build_attempted = True
-    if _is_fresh():
-        return True
     # Cross-process exclusive lock: N launched workers on one host must not
-    # run `make` concurrently into the same .so (a sibling could dlopen a
-    # half-written file). One builds, the rest wait then reuse.
+    # run `make` concurrently into the same .so, and none may dlopen a
+    # half-written file — so even the freshness check happens under the
+    # lock (a sibling could be mid-link when we see the path exist).
     import fcntl
-    os.makedirs(os.path.join(_NATIVE_DIR, "build"), exist_ok=True)
-    lock_path = os.path.join(_NATIVE_DIR, "build", ".build_lock")
+    build = os.path.dirname(_SO_PATH)
+    os.makedirs(build, exist_ok=True)
+    lock_path = os.path.join(build, ".build_lock")
     try:
         with open(lock_path, "w") as lock_f:
             fcntl.lockf(lock_f, fcntl.LOCK_EX)
             try:
-                if _is_fresh():   # another process built it while we waited
+                if _is_fresh():
                     return True
-                subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                subprocess.run(["make", "-C", _NATIVE_DIR,
+                                f"BUILD={build}"], check=True,
                                capture_output=True, timeout=120)
                 return os.path.exists(_SO_PATH)
             finally:
@@ -72,34 +94,40 @@ def _load():
             if not _try_build():
                 return None
             lib = ctypes.CDLL(_SO_PATH)
+            _bind(lib)
         except Exception:
             return None  # degrade to the pure-Python fallbacks
-        lib.pdtpu_store_server_create.restype = ctypes.c_void_p
-        lib.pdtpu_store_server_start.restype = ctypes.c_int
-        lib.pdtpu_store_server_start.argtypes = [ctypes.c_void_p,
-                                                 ctypes.c_char_p,
-                                                 ctypes.c_int]
-        lib.pdtpu_store_server_destroy.argtypes = [ctypes.c_void_p]
-        lib.pdtpu_queue_create.restype = ctypes.c_void_p
-        lib.pdtpu_queue_create.argtypes = [ctypes.c_size_t]
-        lib.pdtpu_queue_push.restype = ctypes.c_int
-        lib.pdtpu_queue_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                         ctypes.c_size_t, ctypes.c_double]
-        lib.pdtpu_queue_pop.restype = ctypes.POINTER(ctypes.c_char)
-        lib.pdtpu_queue_pop.argtypes = [ctypes.c_void_p,
-                                        ctypes.POINTER(ctypes.c_size_t),
-                                        ctypes.c_double,
-                                        ctypes.POINTER(ctypes.c_int)]
-        lib.pdtpu_queue_close.argtypes = [ctypes.c_void_p]
-        lib.pdtpu_queue_size.restype = ctypes.c_size_t
-        lib.pdtpu_queue_size.argtypes = [ctypes.c_void_p]
-        lib.pdtpu_queue_destroy.argtypes = [ctypes.c_void_p]
-        lib.pdtpu_block_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
-        lib.pdtpu_collate_stack.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
-            ctypes.c_size_t, ctypes.c_size_t]
         _lib = lib
         return _lib
+
+
+def _bind(lib):
+    # inside the caller's try: a mismatched .so (missing symbol →
+    # AttributeError) must degrade like any other load failure
+    lib.pdtpu_store_server_create.restype = ctypes.c_void_p
+    lib.pdtpu_store_server_start.restype = ctypes.c_int
+    lib.pdtpu_store_server_start.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_char_p,
+                                             ctypes.c_int]
+    lib.pdtpu_store_server_destroy.argtypes = [ctypes.c_void_p]
+    lib.pdtpu_queue_create.restype = ctypes.c_void_p
+    lib.pdtpu_queue_create.argtypes = [ctypes.c_size_t]
+    lib.pdtpu_queue_push.restype = ctypes.c_int
+    lib.pdtpu_queue_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_size_t, ctypes.c_double]
+    lib.pdtpu_queue_pop.restype = ctypes.POINTER(ctypes.c_char)
+    lib.pdtpu_queue_pop.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_size_t),
+                                    ctypes.c_double,
+                                    ctypes.POINTER(ctypes.c_int)]
+    lib.pdtpu_queue_close.argtypes = [ctypes.c_void_p]
+    lib.pdtpu_queue_size.restype = ctypes.c_size_t
+    lib.pdtpu_queue_size.argtypes = [ctypes.c_void_p]
+    lib.pdtpu_queue_destroy.argtypes = [ctypes.c_void_p]
+    lib.pdtpu_block_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
+    lib.pdtpu_collate_stack.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.c_size_t, ctypes.c_size_t]
 
 
 def available() -> bool:

@@ -5,7 +5,7 @@ operators against libpaddle with nvcc/gcc).
 
 TPU redesign: custom device code is Pallas (Python), so the native
 extension path targets the HOST runtime — the same role as the rest of
-``native/``: data-loader transforms, tokenizers, IO. ``load()`` compiles
+``paddle_tpu/native/``: data-loader transforms, tokenizers, IO. ``load()`` compiles
 C/C++ sources with the system toolchain into a shared object (cached by
 source hash) and returns a ``ctypes.CDLL``; declare signatures on the
 returned handle. No Python.h needed — plain ``extern "C"`` functions,
