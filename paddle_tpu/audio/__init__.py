@@ -99,3 +99,58 @@ class MelSpectrogram:
     def __call__(self, x):
         s = self.spec(x)                       # (..., bins, frames)
         return jnp.einsum("mb,...bf->...mf", self.fbank, s)
+
+
+def power_to_db(x, ref=1.0, amin=1e-10, top_db=80.0):
+    """Reference: paddle.audio.features (librosa-compatible dB scaling)."""
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, x))
+    log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(amin, ref))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return log_spec
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho"):
+    """Type-II DCT matrix (n_mels, n_mfcc) — the MFCC projection."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[:, None]
+    dct = np.cos(math.pi / n_mels * (n + 0.5) * k)          # (n_mfcc, n_mels)
+    if norm == "ortho":
+        dct[0] *= 1.0 / math.sqrt(2.0)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return jnp.asarray(dct.T.astype(np.float32))             # (n_mels, n_mfcc)
+
+
+class LogMelSpectrogram:
+    """Reference: paddle.audio.features.LogMelSpectrogram."""
+
+    def __init__(self, sr=16000, n_fft=512, hop_length=None, n_mels=64,
+                 f_min=0.0, f_max=None, power=2.0, ref_value=1.0,
+                 amin=1e-10, top_db=None):
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, n_mels, f_min,
+                                  f_max, power)
+        self.ref, self.amin, self.top_db = ref_value, amin, top_db
+
+    def __call__(self, x):
+        return power_to_db(self.mel(x), self.ref, self.amin, self.top_db)
+
+
+class MFCC:
+    """Reference: paddle.audio.features.MFCC — log-mel → DCT-II cepstra."""
+
+    def __init__(self, sr=16000, n_mfcc=40, n_fft=512, hop_length=None,
+                 n_mels=64, f_min=0.0, f_max=None, top_db=None):
+        if n_mfcc > n_mels:
+            raise ValueError(f"n_mfcc ({n_mfcc}) must be <= n_mels ({n_mels})")
+        self.logmel = LogMelSpectrogram(sr, n_fft, hop_length, n_mels,
+                                        f_min, f_max, top_db=top_db)
+        self.dct = create_dct(n_mfcc, n_mels)
+
+    def __call__(self, x):
+        lm = self.logmel(x)                     # (..., mels, frames)
+        return jnp.einsum("mk,...mf->...kf", self.dct, lm)
+
+
+__all__ += ["power_to_db", "create_dct", "LogMelSpectrogram", "MFCC"]

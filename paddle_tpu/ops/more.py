@@ -9,6 +9,7 @@ per-op kernels exist or are needed (SURVEY §7.3).
 
 from __future__ import annotations
 
+import builtins as _builtins
 from typing import Optional, Sequence
 
 import jax
@@ -29,6 +30,18 @@ __all__ = [
     "bitwise_right_shift", "reduce_as", "gammaln", "gammainc", "gammaincc",
     "combinations", "unfold", "view", "view_as", "as_strided",
     "scatter_nd", "cdist", "pdist",
+    # round-2 tail batch (tensor/manipulation.py, math.py, linalg.py,
+    # random.py, search.py)
+    "masked_scatter", "index_fill", "index_fill_", "select_scatter",
+    "slice_scatter", "diagonal_scatter", "column_stack", "row_stack",
+    "dstack", "hstack", "vstack", "logaddexp", "unique_consecutive",
+    "matrix_power", "bitwise_invert", "fix", "fmod", "inverse", "rank",
+    "fliplr", "flipud", "broadcast_tensors", "broadcast_shape",
+    "standard_normal", "standard_gamma", "poisson", "binomial",
+    "index_sample", "index_put_", "strided_slice", "is_complex",
+    "is_floating_point", "is_integer", "nanmin", "nanmax", "addmv",
+    "baddbmm", "mv", "cholesky", "cholesky_inverse", "multi_dot",
+    "histogram_bin_edges", "assign", "clone", "detach",
 ]
 
 # -- NaN-aware reductions ---------------------------------------------------
@@ -447,3 +460,275 @@ def combinations(x, r=2, with_replacement=False):
     if idx.size == 0:
         return jnp.zeros((0, r), x.dtype)
     return x[idx]
+
+
+# -- round-2 tail batch -----------------------------------------------------
+
+def masked_scatter(x, mask, value):
+    """Reference: paddle.masked_scatter — masked positions take values from
+    ``value`` in row-major order."""
+    x = jnp.asarray(x)
+    mask = jnp.broadcast_to(jnp.asarray(mask, bool), x.shape)
+    src = jnp.ravel(jnp.asarray(value))
+    if not isinstance(mask, jax.core.Tracer):
+        # eager: enforce the reference's size contract (under jit the
+        # count is data-dependent and cannot be checked at trace time)
+        needed = int(jnp.sum(mask))
+        if src.shape[0] < needed:
+            raise ValueError(
+                f"masked_scatter: value has {src.shape[0]} elements but "
+                f"mask selects {needed}")
+    idx = jnp.cumsum(mask.ravel()) - 1
+    picked = src[jnp.clip(idx, 0, src.shape[0] - 1)].reshape(x.shape)
+    return jnp.where(mask, picked.astype(x.dtype), x)
+
+
+def index_fill(x, index, axis, value):
+    x = jnp.asarray(x)
+    sl = [_builtins.slice(None)] * x.ndim
+    sl[axis] = jnp.asarray(index)
+    return x.at[tuple(sl)].set(value)
+
+
+index_fill_ = index_fill
+
+
+def select_scatter(x, values, axis, index):
+    x = jnp.asarray(x)
+    sl = [_builtins.slice(None)] * x.ndim
+    sl[axis] = index
+    return x.at[tuple(sl)].set(jnp.asarray(values).astype(x.dtype))
+
+
+def slice_scatter(x, value, axes, starts, ends, strides=None):
+    x = jnp.asarray(x)
+    strides = strides or [1] * len(axes)
+    sl = [_builtins.slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        sl[ax] = _builtins.slice(s, e, st)
+    return x.at[tuple(sl)].set(jnp.asarray(value).astype(x.dtype))
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1):
+    x = jnp.asarray(x)
+    rows, cols = x.shape[axis1], x.shape[axis2]
+    # true off-diagonal length of a (rows, cols) matrix (torch/paddle)
+    k = min(rows, cols - offset) if offset >= 0 else min(rows + offset, cols)
+    i = jnp.arange(max(k, 0))
+    r = i + max(-offset, 0)
+    c = i + max(offset, 0)
+    # move the two diag axes to the front for uniform indexing
+    xm = jnp.moveaxis(x, (axis1, axis2), (0, 1))
+    ym = jnp.asarray(y).astype(x.dtype)
+    ym = jnp.moveaxis(ym, -1, 0) if ym.ndim > 1 else ym
+    out = xm.at[r, c].set(ym)
+    return jnp.moveaxis(out, (0, 1), (axis1, axis2))
+
+
+def column_stack(xs):
+    return jnp.column_stack(xs)
+
+
+def row_stack(xs):
+    return jnp.vstack(xs)
+
+
+def dstack(xs):
+    return jnp.dstack(xs)
+
+
+def hstack(xs):
+    return jnp.hstack(xs)
+
+
+def vstack(xs):
+    return jnp.vstack(xs)
+
+
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None):
+    """Eager-only (data-dependent output shape, like ``unique``)."""
+    import numpy as np
+    a = np.asarray(x)
+    if axis is None:
+        a = a.ravel()
+        keep = np.ones(a.shape[0], bool)
+        keep[1:] = a[1:] != a[:-1]
+    else:
+        moved = np.moveaxis(a, axis, 0)
+        keep = np.ones(moved.shape[0], bool)
+        keep[1:] = (moved[1:] != moved[:-1]).reshape(
+            moved.shape[0] - 1, -1).any(axis=1)
+        a = moved
+    out = jnp.asarray(np.moveaxis(a[keep], 0, axis) if axis is not None
+                      else a[keep])
+    res = [out]
+    if return_inverse:
+        res.append(jnp.asarray(np.cumsum(keep) - 1))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        res.append(jnp.asarray(np.diff(np.append(idx, keep.shape[0]))))
+    return res[0] if len(res) == 1 else tuple(res)
+
+
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def bitwise_invert(x):
+    return jnp.bitwise_not(x)
+
+
+def fix(x):
+    return jnp.trunc(x)
+
+
+def fmod(x, y):
+    return jnp.fmod(x, y)
+
+
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+def rank(x):
+    return jnp.asarray(jnp.ndim(x))
+
+
+def fliplr(x):
+    return jnp.fliplr(x)
+
+
+def flipud(x):
+    return jnp.flipud(x)
+
+
+def broadcast_tensors(inputs):
+    return list(jnp.broadcast_arrays(*inputs))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def _next_key():
+    from ..core import random as _random
+    return _random.next_key()
+
+
+def standard_normal(shape, dtype=None):
+    return jax.random.normal(_next_key(), tuple(shape),
+                             dtype or jnp.float32)
+
+
+def standard_gamma(alpha):
+    alpha = jnp.asarray(alpha)
+    return jax.random.gamma(_next_key(), alpha)
+
+
+def poisson(x):
+    return jax.random.poisson(_next_key(), jnp.asarray(x)).astype(
+        jnp.asarray(x).dtype)
+
+
+def binomial(count, prob):
+    from . import _index_dtype
+    count = jnp.asarray(count)
+    # reference returns int64; _index_dtype canonicalizes per x64 config
+    return jax.random.binomial(_next_key(), count,
+                               jnp.asarray(prob)).astype(
+                                   _index_dtype("int64"))
+
+
+def index_sample(x, index):
+    """Reference: paddle.index_sample — per-row gather: x [N,M],
+    index [N,K] -> [N,K]."""
+    return jnp.take_along_axis(jnp.asarray(x), jnp.asarray(index), axis=1)
+
+
+def index_put_(x, indices, value, accumulate=False):
+    return index_put(x, indices, value, accumulate)
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    x = jnp.asarray(x)
+    sl = [_builtins.slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        sl[ax] = _builtins.slice(s, e, st)
+    return x[tuple(sl)]
+
+
+def is_complex(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer)
+
+
+def nanmin(x, axis=None, keepdim=False):
+    return jnp.nanmin(x, axis=axis, keepdims=keepdim)
+
+
+def nanmax(x, axis=None, keepdim=False):
+    return jnp.nanmax(x, axis=axis, keepdims=keepdim)
+
+
+def mv(x, vec):
+    return jnp.asarray(x) @ jnp.asarray(vec)
+
+
+def addmv(x, mat, vec, beta=1.0, alpha=1.0):
+    return beta * jnp.asarray(x) + alpha * (jnp.asarray(mat)
+                                            @ jnp.asarray(vec))
+
+
+def baddbmm(x, batch1, batch2, beta=1.0, alpha=1.0):
+    return beta * jnp.asarray(x) + alpha * jnp.matmul(batch1, batch2)
+
+
+def cholesky(x, upper=False):
+    c = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(c, -1, -2).conj() if upper else c
+
+
+def cholesky_inverse(x, upper=False):
+    """inv(A) from A's Cholesky factor via two triangular solves
+    (reference: paddle.cholesky_inverse)."""
+    from jax.scipy.linalg import cho_solve
+    l = jnp.swapaxes(jnp.asarray(x), -1, -2).conj() if upper else jnp.asarray(x)
+    return cho_solve((l, True), jnp.eye(l.shape[-1], dtype=l.dtype))
+
+
+def multi_dot(xs):
+    return jnp.linalg.multi_dot(xs)
+
+
+def histogram_bin_edges(x, bins=100, min=0, max=0):
+    import numpy as np
+    rng = None if (min == 0 and max == 0) else (float(min), float(max))
+    return jnp.asarray(np.histogram_bin_edges(np.asarray(x), bins=bins,
+                                              range=rng))
+
+
+def assign(x, output=None):
+    """Reference: paddle.assign — value copy (functional here; ``output``
+    is returned rather than mutated, XLA has no aliasing assignment)."""
+    out = jnp.array(jnp.asarray(x))
+    return out
+
+
+def clone(x):
+    return jnp.array(jnp.asarray(x))
+
+
+def detach(x):
+    return jax.lax.stop_gradient(jnp.asarray(x))

@@ -208,3 +208,45 @@ class PTQ(QAT):
     QAT with a transparent observer wrapper instead of fake-quant."""
 
     wrapper_cls = _ObserverWrapper
+
+
+class FakeQuanterChannelWiseAbsMax(FakeQuanterWithAbsMax):
+    """Per-output-channel scales (reference:
+    FakeQuanterChannelWiseAbsMaxObserver) — axis 0 of the weight by
+    default, matching the reference's channel-wise weight quant."""
+
+    def __init__(self, bits: int = 8, quant_axis: int = 0):
+        # reduce over every axis EXCEPT the channel axis
+        super().__init__(bits=bits, axis=None)
+        self.quant_axis = quant_axis
+
+    def forward(self, x):
+        qmax = 2.0 ** (self.bits - 1) - 1
+        red = tuple(i for i in range(x.ndim) if i != self.quant_axis)
+        scale = jnp.max(jnp.abs(jax.lax.stop_gradient(x)), axis=red,
+                        keepdims=True)
+        scale = jnp.maximum(scale, 1e-8) / qmax
+        return _ste_round(x / scale).clip(-qmax - 1, qmax) * scale
+
+
+class MovingAverageAbsmaxObserver(AbsmaxObserver):
+    """EMA absmax (reference: MovingAverageAbsmaxObserver) — smoother than
+    the running max for long calibration streams."""
+
+    def __init__(self, bits: int = 8, moving_rate: float = 0.9):
+        super().__init__(bits=bits)
+        self.moving_rate = moving_rate
+
+    def forward(self, x):
+        import jax.core
+        if isinstance(x, jax.core.Tracer):
+            raise RuntimeError(
+                "observer calibration must run eagerly (outside jax.jit)")
+        cur = jnp.max(jnp.abs(x))
+        self.absmax = jnp.where(
+            self.absmax == 0.0, cur,
+            self.moving_rate * self.absmax + (1 - self.moving_rate) * cur)
+        return x
+
+
+__all__ += ["FakeQuanterChannelWiseAbsMax", "MovingAverageAbsmaxObserver"]

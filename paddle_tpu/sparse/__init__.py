@@ -174,3 +174,90 @@ class nn:
     class ReLU:
         def __call__(self, x):
             return relu(x)
+
+
+# -- unary value-wise ops (reference: python/paddle/sparse/unary.py) --------
+
+def _valuewise(fn, x):
+    b = _coo(x)
+    return SparseCooTensor(jsparse.BCOO((fn(b.data), b.indices),
+                                        shape=b.shape))
+
+
+def _make_unary(name, fn):
+    def op(x):
+        return _valuewise(fn, x)
+    op.__name__ = name
+    op.__doc__ = (f"Value-wise sparse {name} (zero-preserving; reference: "
+                  "paddle.sparse.unary)")
+    return op
+
+
+sin = _make_unary("sin", jnp.sin)
+sinh = _make_unary("sinh", jnp.sinh)
+tan = _make_unary("tan", jnp.tan)
+tanh = _make_unary("tanh", jnp.tanh)
+asin = _make_unary("asin", jnp.arcsin)
+asinh = _make_unary("asinh", jnp.arcsinh)
+atan = _make_unary("atan", jnp.arctan)
+atanh = _make_unary("atanh", jnp.arctanh)
+sqrt = _make_unary("sqrt", jnp.sqrt)
+square = _make_unary("square", jnp.square)
+abs = _make_unary("abs", jnp.abs)
+neg = _make_unary("neg", jnp.negative)
+expm1 = _make_unary("expm1", jnp.expm1)
+log1p = _make_unary("log1p", jnp.log1p)
+sign = _make_unary("sign", jnp.sign)
+leaky_relu = _make_unary("leaky_relu",
+                         lambda v: jax.nn.leaky_relu(v, 0.01))
+relu6 = _make_unary("relu6", lambda v: jnp.clip(v, 0.0, 6.0))
+
+
+def pow(x, factor):
+    return _valuewise(lambda v: v ** factor, x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    b = _coo(x)
+    idx = b.indices if index_dtype is None else b.indices.astype(index_dtype)
+    val = b.data if value_dtype is None else b.data.astype(value_dtype)
+    return SparseCooTensor(jsparse.BCOO((val, idx), shape=b.shape))
+
+
+def transpose(x, perm):
+    b = _coo(x)
+    new_idx = b.indices[:, jnp.asarray(perm)]
+    new_shape = tuple(b.shape[p] for p in perm)
+    return SparseCooTensor(jsparse.BCOO((b.data, new_idx), shape=new_shape))
+
+
+def coalesce(x):
+    return SparseCooTensor(_coo(x).sum_duplicates())
+
+
+def softmax(x, axis=-1):
+    """Row-wise softmax over stored values only (reference:
+    paddle.sparse.nn.functional.softmax CSR semantics — zeros stay
+    structural, the softmax runs over each row's nonzeros)."""
+    b = _coo(x).sum_duplicates()
+    if axis not in (-1, b.indices.shape[1] - 1):
+        raise NotImplementedError("sparse softmax supports the last axis")
+    # a "row" is one setting of ALL leading index dims (ndim > 2 works);
+    # collapse them to a flat row id
+    lead = b.indices[:, :-1]
+    strides = np.cumprod((1,) + tuple(b.shape[:-1][::-1]))[::-1][1:]
+    rows = (lead * jnp.asarray(strides.copy())[None, :]).sum(axis=1)
+    n_rows = int(np.prod(b.shape[:-1]))
+    rowmax = jnp.full((n_rows,), -jnp.inf, b.data.dtype).at[rows].max(b.data)
+    e = jnp.exp(b.data - rowmax[rows])
+    denom = jnp.zeros((n_rows,), b.data.dtype).at[rows].add(e)
+    return SparseCooTensor(jsparse.BCOO((e / denom[rows], b.indices),
+                                        shape=b.shape))
+
+
+__all__ += ["sin", "sinh", "tan", "tanh", "asin", "asinh", "atan", "atanh",
+            "sqrt", "square", "abs", "neg", "expm1", "log1p", "sign",
+            "leaky_relu", "relu6", "pow", "cast", "transpose", "coalesce",
+            "softmax"]
+nn.functional = type("functional", (), {"softmax": staticmethod(softmax),
+                                        "relu": staticmethod(relu)})

@@ -295,3 +295,169 @@ class TestDistanceAndScatterNd:
         ours = np.asarray(pt.pdist(x, p=p))
         ref = torch.pdist(torch.tensor(x), p=p).numpy()
         np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestRound2TailBatch:
+    def test_masked_scatter_vs_torch(self):
+        import torch
+        x = A(3, 4)
+        mask = x > 0
+        vals = A(12)
+        ours = np.asarray(pt.masked_scatter(x, mask, vals))
+        ref = torch.tensor(x).masked_scatter(torch.tensor(mask),
+                                             torch.tensor(vals)).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-6)
+
+    def test_select_slice_diagonal_scatter_vs_torch(self):
+        import torch
+        x = A(4, 5)
+        v = A(5)
+        np.testing.assert_allclose(
+            np.asarray(pt.select_scatter(x, v, 0, 2)),
+            torch.tensor(x).select_scatter(torch.tensor(v), 0, 2).numpy(),
+            rtol=1e-6)
+        sl = A(4, 2)
+        np.testing.assert_allclose(
+            np.asarray(pt.slice_scatter(x, sl, axes=[1], starts=[1],
+                                        ends=[5], strides=[2])),
+            torch.tensor(x).slice_scatter(torch.tensor(sl), 1, 1, 5,
+                                          2).numpy(), rtol=1e-6)
+        d = A(4)
+        np.testing.assert_allclose(
+            np.asarray(pt.diagonal_scatter(x, d)),
+            torch.tensor(x).diagonal_scatter(torch.tensor(d)).numpy(),
+            rtol=1e-6)
+
+    def test_unique_consecutive(self):
+        x = np.array([1, 1, 2, 2, 3, 1, 1, 2], np.int32)
+        out, inv, cnt = pt.unique_consecutive(x, return_inverse=True,
+                                              return_counts=True)
+        np.testing.assert_array_equal(np.asarray(out), [1, 2, 3, 1, 2])
+        np.testing.assert_array_equal(np.asarray(inv),
+                                      [0, 0, 1, 1, 2, 3, 3, 4])
+        np.testing.assert_array_equal(np.asarray(cnt), [2, 2, 1, 2, 1])
+
+    def test_index_sample_and_strided_slice(self):
+        x = A(3, 6)
+        idx = np.array([[0, 2], [1, 3], [5, 0]])
+        np.testing.assert_allclose(
+            np.asarray(pt.index_sample(x, idx)),
+            np.take_along_axis(x, idx, axis=1), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(pt.strided_slice(x, axes=[1], starts=[1], ends=[6],
+                                        strides=[2])),
+            x[:, 1:6:2], rtol=1e-6)
+
+    def test_linalg_tail_vs_numpy(self):
+        a = A(3, 3)
+        spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+        chol = np.asarray(pt.cholesky(spd))
+        np.testing.assert_allclose(chol @ chol.T, spd, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(pt.cholesky_inverse(chol)), np.linalg.inv(spd),
+            rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(pt.matrix_power(a, 3)),
+                                   np.linalg.matrix_power(a, 3), rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(pt.inverse(spd)),
+                                   np.linalg.inv(spd), rtol=1e-3, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(pt.multi_dot([A(2, 3), A(3, 4), A(4, 2)] )).shape,
+            (2, 2))
+
+    def test_blas_tail_vs_torch(self):
+        import torch
+        x, m, v = A(3), A(3, 4), A(4)
+        np.testing.assert_allclose(
+            np.asarray(pt.addmv(x, m, v, beta=0.5, alpha=2.0)),
+            torch.addmv(torch.tensor(x), torch.tensor(m), torch.tensor(v),
+                        beta=0.5, alpha=2.0).numpy(), rtol=1e-5)
+        b1, b2, base = A(2, 3, 4), A(2, 4, 5), A(2, 3, 5)
+        np.testing.assert_allclose(
+            np.asarray(pt.baddbmm(base, b1, b2, beta=0.3, alpha=1.5)),
+            torch.baddbmm(torch.tensor(base), torch.tensor(b1),
+                          torch.tensor(b2), beta=0.3, alpha=1.5).numpy(),
+            rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(pt.mv(m, v)), m @ v,
+                                   rtol=1e-5)
+
+    def test_stacks_flips_misc(self):
+        a, b = A(3), A(3)
+        np.testing.assert_allclose(np.asarray(pt.column_stack([a, b])),
+                                   np.column_stack([a, b]))
+        np.testing.assert_allclose(np.asarray(pt.hstack([a, b])),
+                                   np.hstack([a, b]))
+        m = A(2, 3)
+        np.testing.assert_allclose(np.asarray(pt.fliplr(m)), np.fliplr(m))
+        np.testing.assert_allclose(np.asarray(pt.flipud(m)), np.flipud(m))
+        np.testing.assert_allclose(np.asarray(pt.logaddexp(a, b)),
+                                   np.logaddexp(a, b), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(pt.fmod(a, 0.3)),
+                                   np.fmod(a, 0.3), rtol=1e-5, atol=1e-6)
+        assert pt.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+        assert int(pt.rank(m)) == 2
+        assert pt.is_floating_point(m) and not pt.is_integer(m)
+        x = np.array([np.nan, 1.0, 5.0], np.float32)
+        assert float(pt.nanmax(x)) == 5.0 and float(pt.nanmin(x)) == 1.0
+
+    def test_index_fill_and_masked_fill_family(self):
+        x = A(3, 4)
+        out = np.asarray(pt.index_fill(x, np.array([0, 2]), 0, 9.0))
+        assert (out[[0, 2]] == 9.0).all() and (out[1] == x[1]).all()
+
+    def test_random_tail_shapes(self):
+        import paddle_tpu as p
+        assert p.standard_normal([3, 4]).shape == (3, 4)
+        g = p.standard_gamma(np.full((5,), 2.0, np.float32))
+        assert g.shape == (5,) and (np.asarray(g) > 0).all()
+        lam = np.full((4,), 3.0, np.float32)
+        assert p.poisson(lam).shape == (4,)
+        b = p.binomial(np.full((6,), 10, np.int32),
+                       np.full((6,), 0.5, np.float32))
+        assert (np.asarray(b) <= 10).all() and (np.asarray(b) >= 0).all()
+
+    def test_assign_clone_detach(self):
+        import jax
+        x = jnp_ones = pt.ones([2, 2])
+        y = pt.assign(x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x))
+        g = jax.grad(lambda v: (pt.detach(v) * v).sum())(
+            pt.ones([3]))
+        # detach blocks the first factor's gradient: d/dv (c*v) = c = 1
+        np.testing.assert_allclose(np.asarray(g), 1.0)
+
+
+class TestRound2ReviewRegressions:
+    def test_diagonal_scatter_nonsquare_offsets(self):
+        import torch
+        x = A(4, 5)
+        for off in (-2, -1, 0, 1, 2):
+            n = torch.tensor(x).diagonal(offset=off).shape[0]
+            d = A(n)
+            np.testing.assert_allclose(
+                np.asarray(pt.diagonal_scatter(x, d, offset=off)),
+                torch.tensor(x).diagonal_scatter(torch.tensor(d),
+                                                 offset=off).numpy(),
+                rtol=1e-6)
+
+    def test_masked_scatter_too_few_values_raises(self):
+        x = A(3, 4)
+        mask = np.ones((3, 4), bool)
+        with pytest.raises(ValueError, match="fewer|selects"):
+            pt.masked_scatter(x, mask, A(5))
+
+    def test_sparse_softmax_3d(self):
+        from paddle_tpu import sparse as S
+        t = S.sparse_coo_tensor([[0, 0, 1], [0, 1, 1], [0, 0, 2]],
+                                [1.0, 2.0, 3.0], (2, 2, 3))
+        d = np.asarray(S.softmax(t).to_dense())
+        # each (i,j) row with nonzeros normalizes independently
+        np.testing.assert_allclose(d[0, 0, 0], 1.0, rtol=1e-5)
+        np.testing.assert_allclose(d[0, 1, 0], 1.0, rtol=1e-5)
+        np.testing.assert_allclose(d[1, 1, 2], 1.0, rtol=1e-5)
+
+    def test_cholesky_inverse_accuracy(self):
+        a = A(4, 4)
+        spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        l = np.linalg.cholesky(spd)
+        np.testing.assert_allclose(np.asarray(pt.cholesky_inverse(l)),
+                                   np.linalg.inv(spd), rtol=1e-4, atol=1e-5)
