@@ -32,6 +32,7 @@ from .auto import (DistAttr, Partial, PartialTensor,  # noqa: F401
                    dtensor_from_fn, reshard, shard_dataloader, shard_layer,
                    shard_tensor)
 from .parallel import DataParallel  # noqa: F401
+from .engine import DistModel, Engine, to_static  # noqa: F401
 from .recompute import recompute, RecomputeWrapper  # noqa: F401
 from .pipeline import (LayerDesc, SharedLayerDesc, PipelineLayer,  # noqa: F401
                        PipelineParallel, StackedPipelineStages)

@@ -1,0 +1,232 @@
+"""Auto-parallel static engine (reference:
+python/paddle/distributed/auto_parallel/static/engine.py ``Engine`` and
+api.py ``to_static``/``DistModel``).
+
+In the reference, Engine captures the dygraph model into a distributed
+static Program, runs the planner/partitioner over the cluster topology,
+and executes with a fleet executor.  The TPU-native pipeline is shorter by
+construction: parameters carry placements (mesh axes in ``param_meta``),
+``jit.TrainStep`` compiles ONE SPMD program with those shardings, and XLA
+is the planner/partitioner.  The Engine here is therefore a thin,
+reference-shaped driver: mode management (train/eval/predict), dataloader
+sharding, and a compiled step per mode.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+from . import fleet
+from .auto import _to_jax_mesh, shard_dataloader
+
+__all__ = ["Engine", "to_static", "DistModel"]
+
+
+class Engine:
+    """Reference-shaped auto-parallel driver over ``jit.TrainStep``.
+
+    Usage::
+
+        engine = dist.Engine(model, loss_fn, optimizer, mesh=mesh)
+        engine.fit(train_loader, epochs=2)
+        metrics = engine.evaluate(val_loader)
+        preds = engine.predict(test_loader)
+    """
+
+    def __init__(self, model: Layer, loss: Optional[Callable] = None,
+                 optimizer=None, metrics=None, strategy=None,
+                 mesh=None, scaler=None):
+        from ..jit import TrainStep
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.metrics = metrics
+        self.strategy = strategy
+        self._step = None
+        self._state = None
+        if loss is not None and optimizer is not None:
+            # TrainStep owns the fleet-mesh fallback; loss already has the
+            # (model, batch) shape it expects
+            self._step = TrainStep(model, loss, optimizer, scaler=scaler,
+                                   mesh=_to_jax_mesh(mesh)
+                                   if mesh is not None else None)
+            self.mesh = self._step.mesh
+        elif mesh is not None:
+            self.mesh = _to_jax_mesh(mesh)
+        else:
+            hcg = fleet.get_hybrid_communicate_group()
+            self.mesh = hcg.mesh if hcg is not None else None
+        self._eval_fn = None
+        self._predict_fn = None
+
+    # -- state -------------------------------------------------------------
+
+    @property
+    def state(self):
+        if self._state is None:
+            if self._step is None:
+                raise RuntimeError(
+                    "Engine has no training step: pass loss and optimizer")
+            self._state = self._step.init_state()
+        return self._state
+
+    def _loader(self, data, shard=True):
+        if data is None:
+            return ()
+        if self.mesh is not None and shard and not hasattr(data, "_mesh"):
+            if self._step is not None:
+                # reuse the step's own batch axes so loader sharding and
+                # the step's sharding constraint can never disagree
+                entry = self._step.batch_spec[0] \
+                    if len(self._step.batch_spec) else None
+                axes = list(entry) if isinstance(entry, tuple) \
+                    else [entry] if entry else []
+            else:
+                axes = [a for a in ("dp", "sharding") if a in
+                        self.mesh.axis_names and self.mesh.shape[a] > 1]
+            if axes:
+                return shard_dataloader(data, self.mesh, shard_dims=axes)
+        return data
+
+    # -- modes -------------------------------------------------------------
+
+    def fit(self, train_data, epochs: int = 1, valid_data=None,
+            log_freq: int = 10, callback: Optional[Callable] = None):
+        """Train over the (auto-sharded) loader; returns last metrics."""
+        metrics = {}
+        loader = self._loader(train_data)
+        for epoch in range(epochs):
+            for i, batch in enumerate(loader):
+                # the step donates the state buffers: keep self._state
+                # pointing at the LIVE pytree so mid-fit evaluate() (and a
+                # user interrupt) never reads donated arrays
+                self._state, metrics = self._step(self.state, batch)
+                if callback is not None and i % log_freq == 0:
+                    callback(epoch, i, {k: float(v)
+                                        for k, v in metrics.items()})
+            if valid_data is not None:
+                metrics["eval_loss"] = self.evaluate(valid_data)["loss"]
+        return {k: float(v) for k, v in metrics.items()}
+
+    def evaluate(self, valid_data):
+        """Mean loss over the loader with the CURRENT trained params."""
+        if self.loss is None:
+            raise RuntimeError("Engine needs a loss for evaluate()")
+        from ..nn.layer import _swapped_params, _train_mode
+
+        if self._eval_fn is None:
+            def eval_one(params, batch):
+                with _swapped_params(self.model, params), \
+                        _train_mode(self.model, False):
+                    return self.loss(self.model, batch)
+            self._eval_fn = jax.jit(eval_one)
+        params = (self.state["params"] if self._step is not None
+                  else None)
+        from ..nn.layer import raw_params
+        if params is None:
+            params = raw_params(self.model)
+        total, n = 0.0, 0
+        for batch in self._loader(valid_data):
+            total += float(self._eval_fn(params, batch))
+            n += 1
+        return {"loss": total / max(n, 1)}
+
+    def predict(self, test_data):
+        """Forward-only over the loader; list of per-batch outputs."""
+        from ..nn.layer import _swapped_params, _train_mode, raw_params
+
+        if self._predict_fn is None:
+            def predict_one(params, batch):
+                with _swapped_params(self.model, params), \
+                        _train_mode(self.model, False):
+                    if isinstance(batch, dict):
+                        # by keyword: order-safe against dict insertion
+                        feats = {k: v for k, v in batch.items()
+                                 if k not in ("labels", "y")}
+                        return self.model(**feats)
+                    return self.model(batch)
+            self._predict_fn = jax.jit(predict_one)
+        params = (self.state["params"] if self._step is not None
+                  else raw_params(self.model))
+        return [self._predict_fn(params, b) for b in self._loader(test_data)]
+
+    # -- reference surface sugar ------------------------------------------
+
+    def prepare(self, *a, **k):  # reference: mode pre-build; lazy here
+        return self
+
+    def cost(self, *a, **k):
+        raise NotImplementedError(
+            "cost estimation is XLA's job on TPU: compile with "
+            "jit(...).lower().compile() and read cost_analysis()")
+
+    def save(self, path: str):
+        """Full resumable state — params AND optimizer slots/step/rng (the
+        reference Engine checkpoints optimizer state too; dropping it would
+        silently replay LR warmup and zero the moments on resume)."""
+        from .. import ckpt
+        if self._step is None:
+            from ..nn.layer import raw_params
+            ckpt.save({"params": raw_params(self.model)}, path)
+            return
+        st = dict(self.state)
+        st["rng"] = jax.random.key_data(st["rng"])
+        ckpt.save(st, path)
+
+    def load(self, path: str):
+        from .. import ckpt
+        st = dict(ckpt.load(path))
+        if self._step is None:
+            # inference-only engine: push params into the live model
+            params = st.get("params", st)
+            for name, v in dict(params).items():
+                self.model._assign_by_path(name, jnp.asarray(v))
+            return
+        if "rng" in st:
+            st["rng"] = jax.random.wrap_key_data(jnp.asarray(st["rng"]))
+        full = self._step.shard_state(st)
+        self._state = full
+
+
+class DistModel:
+    """Reference: the object ``dist.to_static`` returns — call it per batch
+    to run one compiled training step (train mode) or a forward (eval)."""
+
+    def __init__(self, engine: Engine):
+        self._engine = engine
+        self._mode = "train"
+
+    def train(self):
+        self._mode = "train"
+        return self
+
+    def eval(self):
+        self._mode = "eval"
+        return self
+
+    def __call__(self, batch):
+        if self._mode == "train":
+            self._engine._state, metrics = self._engine._step(
+                self._engine.state, batch)
+            return metrics["loss"]
+        return self._engine.evaluate([batch])["loss"]
+
+    def state_dict(self):
+        return dict(self._engine.state["params"])
+
+    @property
+    def engine(self):
+        return self._engine
+
+
+def to_static(model: Layer, data_loader=None, loss=None, optimizer=None,
+              strategy=None, mesh=None) -> DistModel:
+    """Reference: paddle.distributed.to_static — dygraph model + loader +
+    loss + optimizer → distributed static model."""
+    engine = Engine(model, loss=loss, optimizer=optimizer,
+                    strategy=strategy, mesh=mesh)
+    return DistModel(engine)
