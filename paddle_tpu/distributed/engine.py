@@ -98,8 +98,13 @@ class Engine:
             log_freq: int = 10, callback: Optional[Callable] = None):
         """Train over the (auto-sharded) loader; returns last metrics."""
         metrics = {}
-        loader = self._loader(train_data)
+        if iter(train_data) is train_data:
+            raise TypeError(
+                "fit() needs a re-iterable loader/dataset, not a one-shot "
+                "iterator — epochs after the first would silently run "
+                "zero steps")
         for epoch in range(epochs):
+            loader = self._loader(train_data)
             for i, batch in enumerate(loader):
                 # the step donates the state buffers: keep self._state
                 # pointing at the LIVE pytree so mid-fit evaluate() (and a
@@ -133,20 +138,31 @@ class Engine:
         for batch in self._loader(valid_data):
             total += float(self._eval_fn(params, batch))
             n += 1
-        return {"loss": total / max(n, 1)}
+        if n == 0:
+            raise ValueError(
+                "evaluate(): the loader yielded no batches — a silent 0.0 "
+                "here would read as a perfect score")
+        return {"loss": total / n}
 
-    def predict(self, test_data):
-        """Forward-only over the loader; list of per-batch outputs."""
+    def predict(self, test_data, input_keys=None):
+        """Forward-only over the loader; list of per-batch outputs.
+
+        ``input_keys``: which dict-batch entries feed the model (the
+        reference's feed list); default drops the common label keys."""
         from ..nn.layer import _swapped_params, _train_mode, raw_params
 
         if self._predict_fn is None:
+            keys = tuple(input_keys) if input_keys is not None else None
+
             def predict_one(params, batch):
                 with _swapped_params(self.model, params), \
                         _train_mode(self.model, False):
                     if isinstance(batch, dict):
                         # by keyword: order-safe against dict insertion
                         feats = {k: v for k, v in batch.items()
-                                 if k not in ("labels", "y")}
+                                 if (k in keys if keys is not None
+                                     else k not in ("labels", "label",
+                                                    "y"))}
                         return self.model(**feats)
                     return self.model(batch)
             self._predict_fn = jax.jit(predict_one)

@@ -69,16 +69,21 @@ class _Embeddings(Layer):
         self.LayerNorm = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
         self.dropout = Dropout(cfg.hidden_dropout)
 
-    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+    def _sum(self, input_ids, token_type_ids=None, position_ids=None):
+        """Pre-norm embedding sum — shared with subclasses (ERNIE) that
+        add extra terms before the LayerNorm."""
         b, s = input_ids.shape
         if position_ids is None:
             position_ids = jnp.arange(s)[None, :]
         if token_type_ids is None:
             token_type_ids = jnp.zeros((b, s), jnp.int32)
-        x = (self.word_embeddings(input_ids)
-             + self.position_embeddings(position_ids)
-             + self.token_type_embeddings(token_type_ids))
-        return self.dropout(self.LayerNorm(x))
+        return (self.word_embeddings(input_ids)
+                + self.position_embeddings(position_ids)
+                + self.token_type_embeddings(token_type_ids))
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        return self.dropout(self.LayerNorm(
+            self._sum(input_ids, token_type_ids, position_ids)))
 
 
 class _SelfAttention(Layer):

@@ -1,0 +1,108 @@
+"""ERNIE encoder family (the flagship Paddle-ecosystem model line).
+
+Reference capability: PaddleNLP paddlenlp/transformers/ernie/modeling.py
+(`ErnieModel`) — BASELINE.json config[1] names ERNIE explicitly.  ERNIE
+3.0's public encoder is the BERT computation plus a task-type embedding
+(``use_task_id``); the decoder-only ERNIE 3.5 scale path is covered by the
+GPT/Llama families (tests/test_baseline_configs.py cfg2 runs the 13B-class
+TP+PP geometry).
+
+Module names mirror the HF ``ErnieModel`` layout so ``models.hf.from_hf``
+imports checkpoints by pure transpose, and the torch-oracle parity test
+pins the wiring (tests/test_hf_convert.py::TestHfErnie).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..nn.layers_common import Embedding
+from .bert import BertConfig, BertModel, _Embeddings
+
+__all__ = ["ErnieConfig", "ErnieModel", "ernie"]
+
+
+@dataclasses.dataclass
+class ErnieConfig(BertConfig):
+    task_type_vocab_size: int = 3
+    use_task_id: bool = True
+
+
+PRESETS = {
+    "tiny": ErnieConfig(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                        num_attention_heads=4, intermediate_size=128,
+                        max_position_embeddings=64, hidden_dropout=0.0,
+                        attention_dropout=0.0),
+    # ERNIE 3.0 public encoder sizes (PaddleNLP model cards)
+    "ernie-3.0-base": ErnieConfig(vocab_size=40000, hidden_size=768,
+                                  num_hidden_layers=12,
+                                  num_attention_heads=12,
+                                  intermediate_size=3072,
+                                  max_position_embeddings=2048),
+    "ernie-3.0-medium": ErnieConfig(vocab_size=40000, hidden_size=768,
+                                    num_hidden_layers=6,
+                                    num_attention_heads=12,
+                                    intermediate_size=3072,
+                                    max_position_embeddings=2048),
+    "ernie-3.0-micro": ErnieConfig(vocab_size=40000, hidden_size=384,
+                                   num_hidden_layers=4,
+                                   num_attention_heads=12,
+                                   intermediate_size=1536,
+                                   max_position_embeddings=2048),
+}
+
+
+class _ErnieEmbeddings(_Embeddings):
+    """BERT embeddings + ERNIE's task-type embedding (use_task_id) — the
+    shared word/position/type + LayerNorm path lives in bert._Embeddings
+    so a fix there covers both families."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__(cfg)
+        self.use_task_id = cfg.use_task_id
+        if cfg.use_task_id:
+            self.task_type_embeddings = Embedding(cfg.task_type_vocab_size,
+                                                  cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                task_type_ids=None):
+        x = self._sum(input_ids, token_type_ids, position_ids)
+        if self.use_task_id:
+            if task_type_ids is None:
+                task_type_ids = jnp.zeros(input_ids.shape, jnp.int32)
+            # the task term joins BEFORE the shared LayerNorm
+            x = x + self.task_type_embeddings(task_type_ids)
+        return self.dropout(self.LayerNorm(x))
+
+
+class ErnieModel(BertModel):
+    """BertModel with the ERNIE embedding block; mask handling and the
+    encoder/pooler are inherited."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__(cfg)
+        self.embeddings = _ErnieEmbeddings(cfg)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        """→ (sequence_output [b,s,h], pooled_output [b,h]) — the
+        PaddleNLP ErnieModel return shape."""
+        mask = None
+        if attention_mask is not None:
+            mask = (1.0 - attention_mask[:, None, None, :].astype(
+                jnp.float32)) * -1e9
+        x = self.embeddings(input_ids, token_type_ids, position_ids,
+                            task_type_ids)
+        x = self.encoder(x, mask)
+        return x, self.pooler(x)
+
+
+def ernie(name_or_config="tiny", **overrides) -> ErnieModel:
+    cfg = (PRESETS[name_or_config] if isinstance(name_or_config, str)
+           else name_or_config)
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    return ErnieModel(cfg)

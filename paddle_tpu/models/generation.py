@@ -43,29 +43,73 @@ def run_cached_layers(layers, x, caches, call):
     return x, new_caches
 
 
+def filter_logits(lg, top_k: int = 0, top_p: float = 1.0,
+                  repetition_penalty: float = 1.0, seen=None):
+    """Decode-strategy logit transforms (reference:
+    paddle generation's TopKProcess/TopPProcess/repetition penalty),
+    trace-safe so they run inside the compiled decode scan.
+
+    ``seen``: (b, vocab) count of already-emitted tokens (prompt included)
+    for the repetition penalty; pass None to skip."""
+    if repetition_penalty != 1.0 and seen is not None:
+        pen = jnp.where(lg > 0, lg / repetition_penalty,
+                        lg * repetition_penalty)
+        lg = jnp.where(seen > 0, pen, lg)
+    if (top_k and top_k > 0) or top_p < 1.0:
+        # one descending sort serves both filters (this runs per decoded
+        # token inside the compiled scan — no second O(V log V) pass)
+        srt = jnp.sort(lg, axis=-1)[..., ::-1]
+        if top_k and top_k > 0:
+            kth = srt[..., int(top_k) - 1][..., None]
+            lg = jnp.where(lg < kth, -jnp.inf, lg)
+            # reference order: TopP sees the TopK-filtered distribution
+            srt = jnp.where(jnp.arange(srt.shape[-1]) < int(top_k), srt,
+                            -jnp.inf)
+        if top_p < 1.0:
+            probs = jax.nn.softmax(srt, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep = (cum - probs) < top_p       # always keeps the top token
+            kth = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1,
+                          keepdims=True)
+            lg = jnp.where(lg < kth, -jnp.inf, lg)
+    return lg
+
+
+def _seen_counts(ids, vocab_size):
+    b = ids.shape[0]
+    seen = jnp.zeros((b, vocab_size), jnp.int32)
+    return seen.at[jnp.arange(b)[:, None], ids].add(1)
+
+
 class CachedGenerationMixin:
     def _cache_supported(self) -> bool:
         return False  # families opt in
 
-    def _sample(self, logits, temperature):
+    def _sample(self, logits, temperature, top_k=0, top_p=1.0,
+                repetition_penalty=1.0, seen=None):
+        logits = filter_logits(logits, top_k, top_p, repetition_penalty,
+                               seen)
         if temperature > 0:
             from ..core import random as prandom
             return jax.random.categorical(prandom.next_key("gen"),
                                           logits / temperature, axis=-1)
         return jnp.argmax(logits, axis=-1)
 
-    def _decode_loop_fn(self, n_steps: int, temperature: float):
+    def _decode_loop_fn(self, n_steps: int, temperature: float,
+                        top_k: int = 0, top_p: float = 1.0,
+                        repetition_penalty: float = 1.0):
         """Whole decode loop as ONE compiled program (lax.scan). Single-slot
-        memo: varying max_new_tokens/temperature must not accumulate one
-        XLA executable per combination."""
+        memo: varying max_new_tokens/temperature/strategy must not
+        accumulate one XLA executable per combination."""
         cached_key, fn = self.__dict__.get("_decode_loop_memo", (None, None))
-        key = (n_steps, temperature)
+        key = (n_steps, temperature, top_k, top_p, repetition_penalty)
         if cached_key != key:
             fn = None
+        track_seen = repetition_penalty != 1.0
         if fn is None:
             from ..nn.layer import _swapped_params, functional_call
 
-            def one_step(params, tok, caches, lens, rng, i):
+            def one_step(params, tok, caches, lens, rng, i, seen):
                 mp = {k[len("model."):]: v for k, v in params.items()
                       if k.startswith("model.")}
                 hidden, caches = functional_call(
@@ -73,6 +117,8 @@ class CachedGenerationMixin:
                     seq_lens=lens, training=False)
                 with _swapped_params(self, params):
                     lg = self.logits(hidden[:, -1:])[:, 0]
+                lg = filter_logits(lg, top_k, top_p, repetition_penalty,
+                                   seen)
                 if temperature > 0:
                     nxt = jax.random.categorical(
                         jax.random.fold_in(rng, i), lg / temperature,
@@ -81,14 +127,18 @@ class CachedGenerationMixin:
                     nxt = jnp.argmax(lg, axis=-1)
                 return nxt.astype(tok.dtype), caches
 
-            def loop(params, tok0, caches, lens0, rng):
+            def loop(params, tok0, caches, lens0, rng, seen0):
                 def body(carry, i):
-                    tok, caches, lens = carry
-                    nxt, caches = one_step(params, tok, caches, lens, rng, i)
-                    return (nxt, caches, lens + 1), nxt
+                    tok, caches, lens, seen = carry
+                    nxt, caches = one_step(params, tok, caches, lens, rng,
+                                           i, seen)
+                    if track_seen:
+                        seen = seen.at[jnp.arange(seen.shape[0]),
+                                       nxt].add(1)
+                    return (nxt, caches, lens + 1, seen), nxt
 
-                (_, caches, _), toks = jax.lax.scan(
-                    body, (tok0, caches, lens0), jnp.arange(n_steps))
+                (_, caches, _, _), toks = jax.lax.scan(
+                    body, (tok0, caches, lens0, seen0), jnp.arange(n_steps))
                 return jnp.swapaxes(toks, 0, 1), caches   # (b, n_steps)
 
             fn = jax.jit(loop, donate_argnums=(2,))
@@ -96,7 +146,8 @@ class CachedGenerationMixin:
         return fn
 
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
-                 use_cache=True, max_len=None):
+                 use_cache=True, max_len=None, top_k=0, top_p=1.0,
+                 repetition_penalty=1.0, decode_strategy=None):
         """Autoregressive generation. ``use_cache=True`` (default) prefills
         the dense KV caches once, then runs the WHOLE decode loop as one
         compiled ``lax.scan`` (one dispatch per call). ``use_cache=False``
@@ -104,14 +155,39 @@ class CachedGenerationMixin:
         (temperature=0) the two paths are token-identical — with
         temperature>0 they draw from different RNG stream shapes and
         legitimately sample different tokens. Falls back to recompute for
-        configs without cache support (pipeline stages, MoE layers)."""
+        configs without cache support (pipeline stages, MoE layers).
+
+        ``top_k``/``top_p``/``repetition_penalty`` follow the reference
+        generate() semantics (TopKProcess/TopPProcess; penalty counts the
+        prompt too). ``decode_strategy`` is the reference's name for the
+        mode: "greedy_search" forces temperature 0, "sampling" requires
+        temperature > 0."""
+        if decode_strategy == "greedy_search":
+            temperature = 0.0
+        elif decode_strategy == "sampling" and temperature <= 0:
+            temperature = 1.0
+        elif decode_strategy not in (None, "greedy_search", "sampling"):
+            raise ValueError(
+                f"unsupported decode_strategy {decode_strategy!r} (beam "
+                "search: use examples' beam helper or batch-expand + "
+                "sampling)")
         if max_new_tokens <= 0:
             return input_ids
+        vocab = getattr(self.cfg, "vocab_size", None)
+        track_seen = repetition_penalty != 1.0 and vocab is not None
         if not (use_cache and self._cache_supported()):
             ids = input_ids
+            # counts built once from the prompt, then updated per token
+            # (rebuilding the (b, vocab) matrix per step would be
+            # O(steps·b·vocab))
+            seen = _seen_counts(ids, vocab) if track_seen else None
+            bidx = jnp.arange(ids.shape[0])
             for _ in range(max_new_tokens):
                 logits = self(ids)[:, -1]
-                nxt = self._sample(logits, temperature)
+                nxt = self._sample(logits, temperature, top_k, top_p,
+                                   repetition_penalty, seen)
+                if seen is not None:
+                    seen = seen.at[bidx, nxt].add(1)
                 ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
             return ids
 
@@ -144,14 +220,23 @@ class CachedGenerationMixin:
             self.__dict__["_prefill_compiled"] = prefill
         caches = self.model.init_cache(b, total)
         logits, caches = prefill(params, input_ids, caches)
-        tok = self._sample(logits, temperature).astype(input_ids.dtype)
+        seen = _seen_counts(input_ids, vocab) if track_seen else None
+        tok = self._sample(logits, temperature, top_k, top_p,
+                           repetition_penalty, seen).astype(input_ids.dtype)
         if max_new_tokens == 1:
             return jnp.concatenate([input_ids, tok[:, None]], axis=1)
 
         from ..core import random as prandom
         rng = prandom.next_key("gen") if temperature > 0 else \
             jax.random.key(0)
-        loop = self._decode_loop_fn(max_new_tokens - 1, float(temperature))
+        loop = self._decode_loop_fn(max_new_tokens - 1, float(temperature),
+                                    int(top_k), float(top_p),
+                                    float(repetition_penalty))
         lens = jnp.full((b,), prompt_len, jnp.int32)
-        toks, _ = loop(params, tok, caches, lens, rng)
+        if seen is not None:
+            seen = seen.at[jnp.arange(b), tok].add(1)
+        else:
+            # fixed carry structure: a 1-wide dummy when penalty is off
+            seen = jnp.zeros((b, 1), jnp.int32)
+        toks, _ = loop(params, tok, caches, lens, rng, seen)
         return jnp.concatenate([input_ids, tok[:, None], toks], axis=1)

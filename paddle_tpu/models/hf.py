@@ -28,10 +28,11 @@ _NO_TRANSPOSE_SUFFIXES = (
     "input_layernorm.weight",
     "post_attention_layernorm.weight",
     "norm.weight",
-    # BERT embeddings (2-D lookup tables, not kernels)
+    # BERT/ERNIE embeddings (2-D lookup tables, not kernels)
     "word_embeddings.weight",
     "position_embeddings.weight",
     "token_type_embeddings.weight",
+    "task_type_embeddings.weight",
     # T5: shared embedding + relative-bias table
     "shared.weight",
     "relative_attention_bias.weight",

@@ -4,6 +4,7 @@ pipeline config compiles, generation."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import paddle_tpu as pt
 from paddle_tpu.models.gpt import GPTConfig, gpt
@@ -184,3 +185,70 @@ class TestChunkedLoss:
                                  m.cfg.vocab_size)
         loss = m(ids, labels=jnp.roll(ids, -1, 1))
         assert jnp.isfinite(loss)
+
+
+class TestDecodeStrategies:
+    """Reference generate() strategies: top-k/top-p filtering + repetition
+    penalty (paddle generation_utils TopKProcess/TopPProcess)."""
+
+    def _model(self):
+        from paddle_tpu.models.llama import llama
+        pt.seed(0)
+        return llama("tiny").eval()
+
+    def test_filter_logits_top_k(self):
+        from paddle_tpu.models.generation import filter_logits
+        lg = jnp.asarray([[1.0, 5.0, 3.0, 2.0]])
+        out = np.asarray(filter_logits(lg, top_k=2))
+        assert np.isfinite(out[0, 1]) and np.isfinite(out[0, 2])
+        assert out[0, 0] == -np.inf and out[0, 3] == -np.inf
+
+    def test_filter_logits_top_p(self):
+        from paddle_tpu.models.generation import filter_logits
+        # softmax of [4, 2, 0] ≈ [.867, .117, .016]: top_p=.9 keeps 2
+        lg = jnp.asarray([[4.0, 2.0, 0.0]])
+        out = np.asarray(filter_logits(lg, top_p=0.9))
+        assert np.isfinite(out[0, 0]) and np.isfinite(out[0, 1])
+        assert out[0, 2] == -np.inf
+        # top_p tiny still keeps the argmax
+        out = np.asarray(filter_logits(lg, top_p=1e-6))
+        assert np.isfinite(out[0, 0]) and out[0, 1] == -np.inf
+
+    def test_filter_logits_repetition_penalty(self):
+        from paddle_tpu.models.generation import filter_logits
+        lg = jnp.asarray([[2.0, -2.0, 1.0]])
+        seen = jnp.asarray([[1, 1, 0]])
+        out = np.asarray(filter_logits(lg, repetition_penalty=2.0,
+                                       seen=seen))
+        np.testing.assert_allclose(out, [[1.0, -4.0, 1.0]])
+
+    def test_generate_with_strategies_runs_both_paths(self):
+        m = self._model()
+        ids = jnp.asarray(np.random.default_rng(0).integers(
+            0, 256, size=(2, 8)))
+        for kw in ({"top_k": 5, "temperature": 1.0},
+                   {"top_p": 0.8, "temperature": 1.0},
+                   {"repetition_penalty": 1.3},
+                   {"decode_strategy": "greedy_search"}):
+            a = m.generate(ids, max_new_tokens=4, use_cache=True, **kw)
+            b = m.generate(ids, max_new_tokens=4, use_cache=False, **kw)
+            assert a.shape == b.shape == (2, 12)
+            if kw.get("temperature", 0.0) == 0.0:
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_repetition_penalty_discourages_repeats(self):
+        """A greedy model stuck in a loop must break out with the
+        penalty high."""
+        m = self._model()
+        ids = jnp.asarray([[5, 5, 5, 5, 5, 5, 5, 5]])
+        plain = np.asarray(m.generate(ids, max_new_tokens=8))
+        pen = np.asarray(m.generate(ids, max_new_tokens=8,
+                                    repetition_penalty=8.0))
+        # penalized run must differ from the unpenalized continuation
+        assert not np.array_equal(plain, pen)
+
+    def test_bad_strategy_rejected(self):
+        m = self._model()
+        with pytest.raises(ValueError, match="decode_strategy"):
+            m.generate(jnp.zeros((1, 4), jnp.int32),
+                       decode_strategy="beam_search")

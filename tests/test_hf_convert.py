@@ -182,3 +182,48 @@ class TestHfT5:
         got = np.asarray(ours(jnp.asarray(enc_ids), jnp.asarray(dec_ids),
                               attention_mask=jnp.asarray(mask)))
         np.testing.assert_allclose(got, ref, atol=5e-4, rtol=5e-3)
+
+
+class TestHfErnie:
+    def test_logits_parity_with_task_ids(self):
+        from paddle_tpu.models.ernie import ernie
+        hf_cfg = transformers.ErnieConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=128,
+            max_position_embeddings=64, type_vocab_size=2,
+            task_type_vocab_size=3, use_task_id=True,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+            layer_norm_eps=1e-12)
+        torch.manual_seed(0)
+        hf = transformers.ErnieModel(hf_cfg).eval()
+        ours = ernie("tiny").eval()
+        from_hf(ours, hf)
+        rng = np.random.default_rng(5)
+        ids = rng.integers(0, 128, size=(2, 16))
+        task = rng.integers(0, 3, size=(2, 16))
+        mask = np.ones((2, 16), np.int64)
+        mask[0, 12:] = 0
+        with torch.no_grad():
+            out = hf(torch.tensor(ids), attention_mask=torch.tensor(mask),
+                     task_type_ids=torch.tensor(task))
+        seq, pooled = ours(jnp.asarray(ids),
+                           attention_mask=jnp.asarray(mask),
+                           task_type_ids=jnp.asarray(task))
+        np.testing.assert_allclose(
+            np.asarray(seq)[:, :12], out.last_hidden_state.numpy()[:, :12],
+            atol=5e-4, rtol=5e-3)
+        np.testing.assert_allclose(np.asarray(pooled),
+                                   out.pooler_output.numpy(),
+                                   atol=5e-4, rtol=5e-3)
+
+    def test_task_embedding_changes_output(self):
+        """The ERNIE-specific path must actually contribute."""
+        import paddle_tpu as pt
+        from paddle_tpu.models.ernie import ernie
+        pt.seed(0)
+        m = ernie("tiny").eval()
+        ids = jnp.asarray(np.random.default_rng(0).integers(
+            0, 128, size=(1, 8)))
+        a, _ = m(ids, task_type_ids=jnp.zeros((1, 8), jnp.int32))
+        b, _ = m(ids, task_type_ids=jnp.ones((1, 8), jnp.int32))
+        assert float(jnp.abs(a - b).max()) > 1e-4
