@@ -98,11 +98,11 @@ class Engine:
             log_freq: int = 10, callback: Optional[Callable] = None):
         """Train over the (auto-sharded) loader; returns last metrics."""
         metrics = {}
-        if iter(train_data) is train_data:
+        if epochs > 1 and iter(train_data) is train_data:
             raise TypeError(
-                "fit() needs a re-iterable loader/dataset, not a one-shot "
-                "iterator — epochs after the first would silently run "
-                "zero steps")
+                "fit(epochs>1) needs a re-iterable loader/dataset, not a "
+                "one-shot iterator — epochs after the first would silently "
+                "run zero steps")
         for epoch in range(epochs):
             loader = self._loader(train_data)
             for i, batch in enumerate(loader):
@@ -151,10 +151,10 @@ class Engine:
         reference's feed list); default drops the common label keys."""
         from ..nn.layer import _swapped_params, _train_mode, raw_params
 
-        if self._predict_fn is None:
-            keys = tuple(input_keys) if input_keys is not None else None
-
-            def predict_one(params, batch):
+        keys = tuple(input_keys) if input_keys is not None else None
+        fns = self.__dict__.setdefault("_predict_fns", {})
+        if keys not in fns:   # memoized PER feed list, not just once
+            def predict_one(params, batch, keys=keys):
                 with _swapped_params(self.model, params), \
                         _train_mode(self.model, False):
                     if isinstance(batch, dict):
@@ -165,7 +165,8 @@ class Engine:
                                                     "y"))}
                         return self.model(**feats)
                     return self.model(batch)
-            self._predict_fn = jax.jit(predict_one)
+            fns[keys] = jax.jit(predict_one)
+        self._predict_fn = fns[keys]
         params = (self.state["params"] if self._step is not None
                   else raw_params(self.model))
         return [self._predict_fn(params, b) for b in self._loader(test_data)]

@@ -182,24 +182,30 @@ class _Pooler(Layer):
 
 
 class BertModel(Layer):
+    embeddings_class = _Embeddings  # subclass hook (ERNIE swaps this)
+
     def __init__(self, cfg: BertConfig):
         super().__init__()
         self.cfg = cfg
-        self.embeddings = _Embeddings(cfg)
+        self.embeddings = type(self).embeddings_class(cfg)
         self.encoder = _Encoder(cfg)
         self.pooler = _Pooler(cfg)
+
+    @staticmethod
+    def _additive_mask(attention_mask):
+        """[b, s] 1/0 padding mask → additive [b, 1, 1, s] (shared with
+        subclasses so a mask fix covers the family)."""
+        if attention_mask is None:
+            return None
+        return (1.0 - attention_mask[:, None, None, :].astype(
+            jnp.float32)) * -1e9
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
                 attention_mask=None):
         """→ (sequence_output [b,s,h], pooled_output [b,h]) — the
         PaddleNLP BertModel return shape."""
-        mask = None
-        if attention_mask is not None:
-            # [b, s] 1/0 padding mask → additive [b, 1, 1, s]
-            mask = (1.0 - attention_mask[:, None, None, :].astype(
-                jnp.float32)) * -1e9
         x = self.embeddings(input_ids, token_type_ids, position_ids)
-        x = self.encoder(x, mask)
+        x = self.encoder(x, self._additive_mask(attention_mask))
         return x, self.pooler(x)
 
 

@@ -79,24 +79,18 @@ class _ErnieEmbeddings(_Embeddings):
 
 
 class ErnieModel(BertModel):
-    """BertModel with the ERNIE embedding block; mask handling and the
-    encoder/pooler are inherited."""
+    """BertModel with the ERNIE embedding block (embeddings_class hook);
+    mask handling, encoder and pooler are inherited."""
 
-    def __init__(self, cfg: ErnieConfig):
-        super().__init__(cfg)
-        self.embeddings = _ErnieEmbeddings(cfg)
+    embeddings_class = _ErnieEmbeddings
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
                 attention_mask=None, task_type_ids=None):
         """→ (sequence_output [b,s,h], pooled_output [b,h]) — the
         PaddleNLP ErnieModel return shape."""
-        mask = None
-        if attention_mask is not None:
-            mask = (1.0 - attention_mask[:, None, None, :].astype(
-                jnp.float32)) * -1e9
         x = self.embeddings(input_ids, token_type_ids, position_ids,
                             task_type_ids)
-        x = self.encoder(x, mask)
+        x = self.encoder(x, self._additive_mask(attention_mask))
         return x, self.pooler(x)
 
 

@@ -44,17 +44,24 @@ def run_cached_layers(layers, x, caches, call):
 
 
 def filter_logits(lg, top_k: int = 0, top_p: float = 1.0,
-                  repetition_penalty: float = 1.0, seen=None):
+                  repetition_penalty: float = 1.0, seen=None,
+                  temperature: float = 1.0):
     """Decode-strategy logit transforms (reference:
     paddle generation's TopKProcess/TopPProcess/repetition penalty),
-    trace-safe so they run inside the compiled decode scan.
+    trace-safe so they run inside the compiled decode scan.  Reference
+    order: penalty on raw logits → temperature → top-k → top-p (the
+    nucleus is computed on the TEMPERATURE-SCALED distribution — at
+    temperature≠1 the kept set differs from the unscaled one).
 
     ``seen``: (b, vocab) count of already-emitted tokens (prompt included)
-    for the repetition penalty; pass None to skip."""
+    for the repetition penalty; pass None to skip.  The returned logits
+    are already temperature-scaled: sample them directly."""
     if repetition_penalty != 1.0 and seen is not None:
         pen = jnp.where(lg > 0, lg / repetition_penalty,
                         lg * repetition_penalty)
         lg = jnp.where(seen > 0, pen, lg)
+    if temperature > 0 and temperature != 1.0:
+        lg = lg / temperature
     if (top_k and top_k > 0) or top_p < 1.0:
         # one descending sort serves both filters (this runs per decoded
         # token inside the compiled scan — no second O(V log V) pass)
@@ -88,11 +95,11 @@ class CachedGenerationMixin:
     def _sample(self, logits, temperature, top_k=0, top_p=1.0,
                 repetition_penalty=1.0, seen=None):
         logits = filter_logits(logits, top_k, top_p, repetition_penalty,
-                               seen)
+                               seen, temperature)
         if temperature > 0:
             from ..core import random as prandom
             return jax.random.categorical(prandom.next_key("gen"),
-                                          logits / temperature, axis=-1)
+                                          logits, axis=-1)
         return jnp.argmax(logits, axis=-1)
 
     def _decode_loop_fn(self, n_steps: int, temperature: float,
@@ -118,11 +125,10 @@ class CachedGenerationMixin:
                 with _swapped_params(self, params):
                     lg = self.logits(hidden[:, -1:])[:, 0]
                 lg = filter_logits(lg, top_k, top_p, repetition_penalty,
-                                   seen)
+                                   seen, temperature)
                 if temperature > 0:
                     nxt = jax.random.categorical(
-                        jax.random.fold_in(rng, i), lg / temperature,
-                        axis=-1)
+                        jax.random.fold_in(rng, i), lg, axis=-1)
                 else:
                     nxt = jnp.argmax(lg, axis=-1)
                 return nxt.astype(tok.dtype), caches

@@ -21,4 +21,11 @@ from .layers_conv import (  # noqa: F401
     PixelShuffle, PixelUnshuffle, PReLU, SmoothL1Loss)
 from .layers_rnn import (  # noqa: F401
     GRU, GRUCell, LSTM, LSTMCell, SimpleRNN, SimpleRNNCell)
+from .layers_more import (  # noqa: F401
+    AdaptiveMaxPool1D, AlphaDropout, Bilinear, CELU, ChannelShuffle,
+    Dropout3D, FeatureAlphaDropout, Fold, GLU, Hardshrink,
+    LocalResponseNorm, LogSigmoid, MaxUnPool2D, Pad1D, Pad3D,
+    PairwiseDistance, SELU, Softmax2D, Softshrink, SyncBatchNorm,
+    Tanhshrink, ThresholdedReLU, Unflatten, Unfold,
+    UpsamplingBilinear2D, UpsamplingNearest2D, ZeroPad2D)
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401

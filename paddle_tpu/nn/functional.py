@@ -430,7 +430,8 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, data_format="NCHW"):
     return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, strides, pads)
 
 
-def interpolate(x, size=None, scale_factor=None, mode="nearest", data_format="NCHW"):
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW"):
     if data_format == "NCHW":
         n, c, h, w = x.shape
     else:
@@ -438,6 +439,24 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest", data_format="NC
     if size is None:
         sf = (scale_factor, scale_factor) if not isinstance(scale_factor, (tuple, list)) else scale_factor
         size = (int(h * sf[0]), int(w * sf[1]))
+    if align_corners and mode == "bilinear" and size[0] > 1 and size[1] > 1:
+        # endpoint-aligned sampling (out[i] at i*(in-1)/(out-1)) —
+        # jax.image.resize only does half-pixel centers
+        img = x if data_format == "NCHW" else jnp.moveaxis(x, -1, 1)
+        yy = jnp.linspace(0.0, h - 1.0, size[0])
+        xx = jnp.linspace(0.0, w - 1.0, size[1])
+        y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, w - 1)
+        y1 = jnp.clip(y0 + 1, 0, h - 1)
+        x1 = jnp.clip(x0 + 1, 0, w - 1)
+        wy = (yy - y0)[:, None]
+        wx = (xx - x0)[None, :]
+        g = lambda yi, xi: img[:, :, yi[:, None], xi[None, :]]
+        out = (g(y0, x0) * (1 - wy) * (1 - wx) + g(y0, x1) * (1 - wy) * wx
+               + g(y1, x0) * wy * (1 - wx) + g(y1, x1) * wy * wx)
+        if data_format != "NCHW":
+            out = jnp.moveaxis(out, 1, -1)
+        return out.astype(x.dtype)
     method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic"}[mode]
     if data_format == "NCHW":
         out = jax.image.resize(x, (n, c, size[0], size[1]), method=method)
@@ -447,12 +466,19 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest", data_format="NC
 
 
 def pad(x, pad_width, mode="constant", value=0.0, data_format="NCHW"):
-    if isinstance(pad_width, (list, tuple)) and len(pad_width) == 4 and x.ndim == 4:
-        l, r, t, b = pad_width
-        if data_format == "NCHW":
-            cfg = ((0, 0), (0, 0), (t, b), (l, r))
+    """Paddle pad semantics: a flat [left, right, (top, bottom, (front,
+    back))] list pads the spatial dims of NCL/NCHW/NCDHW (innermost dim
+    first, the reference order); anything else passes through to jnp.pad."""
+    if isinstance(pad_width, (list, tuple)) and \
+            not isinstance(pad_width[0], (list, tuple)) and \
+            len(pad_width) == 2 * (x.ndim - 2):
+        pairs = [tuple(pad_width[i:i + 2])
+                 for i in range(0, len(pad_width), 2)]  # innermost first
+        spatial = list(reversed(pairs))
+        if data_format.startswith("NC"):
+            cfg = tuple([(0, 0), (0, 0)] + spatial)
         else:
-            cfg = ((0, 0), (t, b), (l, r), (0, 0))
+            cfg = tuple([(0, 0)] + spatial + [(0, 0)])
     else:
         cfg = pad_width
     if mode == "constant":
@@ -740,6 +766,132 @@ def dropout2d(x, p=0.5, training=True, data_format="NCHW"):
              else (x.shape[0], 1, 1, x.shape[3]))
     keep = jax.random.bernoulli(key, 1.0 - p, shape)
     return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW"):
+    """Channel-wise dropout for 5-D inputs (whole volumes zeroed)."""
+    if not training or p == 0.0:
+        return x
+    key = prandom.next_key()
+    shape = ((x.shape[0], x.shape[1], 1, 1, 1) if data_format == "NCDHW"
+             else (x.shape[0], 1, 1, 1, x.shape[4]))
+    keep = jax.random.bernoulli(key, 1.0 - p, shape)
+    return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+
+
+def alpha_dropout(x, p=0.5, training=True):
+    """SELU-preserving dropout (reference: paddle.nn.functional
+    alpha_dropout) — dropped units take the negative saturation value and
+    the output is rescaled so self-normalization survives."""
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    neg_sat = -alpha * scale
+    keep_p = 1.0 - p
+    a = (keep_p + neg_sat ** 2 * keep_p * p) ** -0.5
+    b = -a * neg_sat * p
+    keep = jax.random.bernoulli(prandom.next_key(), keep_p, x.shape)
+    return (a * jnp.where(keep, x, neg_sat) + b).astype(x.dtype)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0):
+    return jnp.where(x > threshold, x, value)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    """Row-wise p-norm distance (reference: paddle.nn.PairwiseDistance)."""
+    diff = jnp.abs(x - y) + epsilon
+    if p == float("inf"):
+        out = jnp.max(diff, axis=-1, keepdims=keepdim)
+    else:
+        out = jnp.sum(diff ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+    return out
+
+
+def local_response_norm(x, size=5, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW"):
+    """AlexNet-era cross-channel normalization (reference:
+    paddle.nn.functional.local_response_norm)."""
+    if data_format != "NCHW":
+        x = jnp.moveaxis(x, -1, 1)
+    sq = x * x
+    half = size // 2
+    pad = [(0, 0)] * x.ndim
+    pad[1] = (half, size - half - 1)
+    acc = jnp.pad(sq, pad)
+    # windowed channel sum via cumulative sum difference
+    cs = jnp.cumsum(acc, axis=1)
+    zeros = jnp.zeros_like(cs[:, :1])
+    cs = jnp.concatenate([zeros, cs], axis=1)
+    win = cs[:, size:] - cs[:, :-size]
+    # reference formula (norm.py uses an avg_pool): alpha scales the MEAN
+    # of the window, matching torch
+    out = x / (k + alpha * win / size) ** beta
+    if data_format != "NCHW":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def channel_shuffle(x, groups, data_format="NCHW"):
+    """Reference: paddle.nn.functional.channel_shuffle (ShuffleNet)."""
+    if data_format == "NCHW":
+        b, c, h, w = x.shape
+        return x.reshape(b, groups, c // groups, h, w).swapaxes(1, 2) \
+            .reshape(b, c, h, w)
+    b, h, w, c = x.shape
+    return x.reshape(b, h, w, groups, c // groups).swapaxes(3, 4) \
+        .reshape(b, h, w, c)
+
+
+def bilinear(x1, x2, weight, bias=None):
+    """Reference: paddle.nn.functional.bilinear — out[b,o] =
+    x1[b,:] @ W[o] @ x2[b,:] (+ bias)."""
+    out = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def zeropad2d(x, padding, data_format="NCHW"):
+    return pad(x, padding, mode="constant", value=0.0,
+               data_format=data_format)
+
+
+def adaptive_max_pool1d(x, output_size):
+    """NCL adaptive max pool."""
+    b, c, l = x.shape
+    o = output_size if isinstance(output_size, int) else output_size[0]
+    starts = (jnp.arange(o) * l) // o
+    ends = -(-(jnp.arange(1, o + 1) * l) // o)
+    idx = jnp.arange(l)
+    mask = (idx[None, :] >= starts[:, None]) & (idx[None, :] < ends[:, None])
+    return jnp.max(jnp.where(mask[None, None], x[:, :, None, :], -jnp.inf),
+                   axis=-1)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW"):
+    """Reference: paddle.nn.functional.max_unpool2d — scatter pooled
+    values back to their argmax positions (indices are flat per-map
+    offsets, the reference/torch convention)."""
+    if data_format != "NCHW":
+        raise NotImplementedError("max_unpool2d supports NCHW")
+    b, c, h, w = x.shape
+    stride = stride or kernel_size
+    ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    if output_size is None:
+        oh = (h - 1) * st[0] - 2 * pd[0] + ks[0]
+        ow = (w - 1) * st[1] - 2 * pd[1] + ks[1]
+    else:
+        oh, ow = output_size[-2:]
+    flat = jnp.zeros((b, c, oh * ow), x.dtype)
+    out = flat.at[jnp.arange(b)[:, None, None], jnp.arange(c)[None, :, None],
+                  indices.reshape(b, c, -1)].set(x.reshape(b, c, -1))
+    return out.reshape(b, c, oh, ow)
 
 
 def kl_div(input, label, reduction="mean"):

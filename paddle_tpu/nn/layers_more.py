@@ -1,0 +1,307 @@
+"""Layer-zoo breadth batch (reference: python/paddle/nn/layer/{activation,
+common,pooling,norm}.py — the remaining paddle.nn classes).
+
+Everything here is a thin Layer over the functional op (the kernels are
+jnp/lax, fused by XLA); classes exist for API/porting parity and for
+``Sequential`` composition.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import functional as F
+from .layer import Layer
+from .layer import ParamAttr  # noqa: F401  (re-export convenience)
+
+__all__ = [
+    "Pad1D", "Pad3D", "ZeroPad2D", "ChannelShuffle", "UpsamplingBilinear2D",
+    "UpsamplingNearest2D", "Fold", "Unfold", "PairwiseDistance", "Bilinear",
+    "Unflatten", "Dropout3D", "AlphaDropout", "FeatureAlphaDropout",
+    "LocalResponseNorm", "SyncBatchNorm", "AdaptiveMaxPool1D", "MaxUnPool2D",
+    "Softmax2D", "GLU", "SELU", "CELU", "Softshrink", "Hardshrink",
+    "Tanhshrink", "ThresholdedReLU", "LogSigmoid",
+]
+
+
+class _Activation(Layer):
+    _fn = None
+
+    def forward(self, x):
+        return type(self)._fn(x)
+
+
+class SELU(_Activation):
+    _fn = staticmethod(F.selu)
+
+
+class CELU(Layer):
+    def __init__(self, alpha=1.0):
+        super().__init__()
+        self.alpha = alpha
+
+    def forward(self, x):
+        return F.celu(x, self.alpha)
+
+
+class GLU(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.glu(x, self.axis)
+
+
+class Softshrink(Layer):
+    def __init__(self, threshold=0.5):
+        super().__init__()
+        self.threshold = threshold
+
+    def forward(self, x):
+        return F.softshrink(x, self.threshold)
+
+
+class Hardshrink(Layer):
+    def __init__(self, threshold=0.5):
+        super().__init__()
+        self.threshold = threshold
+
+    def forward(self, x):
+        return F.hardshrink(x, self.threshold)
+
+
+class Tanhshrink(_Activation):
+    _fn = staticmethod(F.tanhshrink)
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, threshold=1.0, value=0.0):
+        super().__init__()
+        self.threshold, self.value = threshold, value
+
+    def forward(self, x):
+        return F.thresholded_relu(x, self.threshold, self.value)
+
+
+class LogSigmoid(_Activation):
+    _fn = staticmethod(F.log_sigmoid)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW input (reference
+    paddle.nn.Softmax2D)."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class Pad1D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCL"):
+        super().__init__()
+        self.padding, self.mode, self.value = padding, mode, value
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, self.mode, self.value,
+                     self.data_format)
+
+
+class Pad3D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW"):
+        super().__init__()
+        self.padding, self.mode, self.value = padding, mode, value
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, self.mode, self.value,
+                     self.data_format)
+
+
+class ZeroPad2D(Layer):
+    def __init__(self, padding, data_format="NCHW"):
+        super().__init__()
+        self.padding, self.data_format = padding, data_format
+
+    def forward(self, x):
+        return F.zeropad2d(x, self.padding, self.data_format)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW"):
+        super().__init__()
+        self.groups, self.data_format = groups, data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self.groups, self.data_format)
+
+
+class UpsamplingBilinear2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW"):
+        super().__init__()
+        self.size, self.scale_factor = size, scale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size,
+                             scale_factor=self.scale_factor,
+                             mode="bilinear", align_corners=True,
+                             data_format=self.data_format)
+
+
+class UpsamplingNearest2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW"):
+        super().__init__()
+        self.size, self.scale_factor = size, scale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size,
+                             scale_factor=self.scale_factor,
+                             mode="nearest", data_format=self.data_format)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1):
+        super().__init__()
+        self.a = (output_sizes, kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.fold(x, *self.a)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1):
+        super().__init__()
+        self.a = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.unfold(x, *self.a)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+class Bilinear(Layer):
+    """out = x1 @ W @ x2 + b per output feature (reference
+    paddle.nn.Bilinear)."""
+
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from . import initializer as I
+        k = 1.0 / (in1_features ** 0.5)
+        self.weight = self.create_parameter(
+            (out_features, in1_features, in2_features),
+            attr=weight_attr, default_initializer=I.Uniform(-k, k))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (out_features,), attr=bias_attr,
+            default_initializer=I.Uniform(-k, k)))
+
+    def forward(self, x1, x2):
+        return F.bilinear(x1, x2, self.weight, self.bias)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape):
+        super().__init__()
+        self.axis, self.shape = axis, tuple(shape)
+
+    def forward(self, x):
+        from ..ops.more import unflatten
+        return unflatten(x, self.axis, self.shape)
+
+
+class Dropout3D(Layer):
+    def __init__(self, p=0.5, data_format="NCDHW"):
+        super().__init__()
+        self.p, self.data_format = p, data_format
+
+    def forward(self, x):
+        return F.dropout3d(x, self.p, self.training, self.data_format)
+
+
+class AlphaDropout(Layer):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.alpha_dropout(x, self.p, self.training)
+
+
+class FeatureAlphaDropout(AlphaDropout):
+    """Channel-wise alpha dropout; approximated by element alpha dropout
+    on TPU (documented deviation — the self-normalizing statistics are
+    per-element either way)."""
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size=5, alpha=1e-4, beta=0.75, k=1.0,
+                 data_format="NCHW"):
+        super().__init__()
+        self.a = (size, alpha, beta, k, data_format)
+
+    def forward(self, x):
+        return F.local_response_norm(x, *self.a)
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask=False):
+        super().__init__()
+        if return_mask:
+            raise NotImplementedError("return_mask: use F.max_pool indices")
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self.output_size)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW"):
+        super().__init__()
+        self.a = (kernel_size, stride, padding)
+        self.data_format = data_format
+
+    def forward(self, x, indices, output_size=None):
+        return F.max_unpool2d(x, indices, *self.a, output_size=output_size,
+                              data_format=self.data_format)
+
+
+class SyncBatchNorm(Layer):
+    """Cross-replica batch norm (reference: paddle.nn.SyncBatchNorm over
+    NCCL all-reduce).
+
+    Under single-controller SPMD the batch is one global array: plain
+    BatchNorm statistics computed on it ARE the synced statistics (XLA
+    inserts the cross-device reductions for the sharded batch dim), so
+    this delegates to BatchNorm2D and exists for porting parity.
+    ``convert_sync_batchnorm`` mirrors the reference helper.
+    """
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        from .layers_common import BatchNorm2D
+        self._bn = BatchNorm2D(num_features, momentum=momentum,
+                               epsilon=epsilon, weight_attr=weight_attr,
+                               bias_attr=bias_attr)
+
+    def forward(self, x):
+        return self._bn(x)
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer: Layer) -> Layer:
+        """No-op structural walk (stats are already global under SPMD);
+        returns the layer for reference-code compatibility."""
+        return layer

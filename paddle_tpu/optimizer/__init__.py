@@ -381,3 +381,122 @@ class Adamax(Optimizer):
 
 
 __all__ += ["Adadelta", "Adamax"]
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (reference: paddle.optimizer.ASGD) — plain SGD steps
+    plus a running average of the iterates; ``averaged_params`` of the
+    state is what evaluation should use."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=0.0, grad_clip=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self.batch_num = batch_num
+
+    def _init_slots(self, params):
+        z = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return {"avg": jax.tree.map(z, params)}
+
+    def _update_one(self, name, p, g, lr, slots, step, wd):
+        if wd:
+            g = g + wd * p
+        new_p = p - lr * g
+        t = (step + 1).astype(jnp.float32)
+        avg = slots["avg"] + (new_p - slots["avg"]) / t
+        return new_p, {"avg": avg}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference: paddle.optimizer.Rprop) — per-weight
+    step sizes grown/shrunk by the sign agreement of successive grads;
+    full-batch regimes only (the reference documents the same)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False):
+        super().__init__(learning_rate, parameters, 0.0, grad_clip,
+                         multi_precision)
+        self.lr_min, self.lr_max = learning_rate_range
+        self.eta_minus, self.eta_plus = etas
+
+    def _init_slots(self, params):
+        # schedulers work too: seed the per-weight step sizes from the
+        # step-0 learning rate
+        lr0 = float(_lr_value(self._lr, jnp.zeros((), jnp.int32)))
+        return {"prev_grad": jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params),
+            "step_size": jax.tree.map(
+                lambda p: jnp.full(p.shape, lr0, jnp.float32), params)}
+
+    def _update_one(self, name, p, g, lr, slots, step, wd):
+        sign = jnp.sign(g * slots["prev_grad"])
+        size = jnp.clip(
+            jnp.where(sign > 0, slots["step_size"] * self.eta_plus,
+                      jnp.where(sign < 0, slots["step_size"] * self.eta_minus,
+                                slots["step_size"])),
+            self.lr_min, self.lr_max)
+        # sign flip: no step this iteration (classic Rprop-), grad zeroed
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        new_p = p - jnp.sign(g_eff) * size
+        return new_p, {"prev_grad": g_eff, "step_size": size}
+
+
+class NAdam(Adam):
+    """Adam with Nesterov momentum (reference: paddle.optimizer.NAdam)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=0.0, grad_clip=None, multi_precision=False):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, multi_precision)
+        self.momentum_decay = momentum_decay
+
+    def _init_slots(self, params):
+        slots = super()._init_slots(params)
+        slots["mu_product"] = jax.tree.map(
+            lambda p: jnp.ones((), jnp.float32), params)
+        return slots
+
+    def _update_one(self, name, p, g, lr, slots, step, wd):
+        if wd:
+            g = g + wd * p
+        t = (step + 1).astype(jnp.float32)
+        mu_t = self.beta1 * (1 - 0.5 * 0.96 ** (t * self.momentum_decay))
+        mu_t1 = self.beta1 * (1 - 0.5 * 0.96 ** ((t + 1) *
+                                                 self.momentum_decay))
+        mu_prod = slots["mu_product"] * mu_t
+        m = self.beta1 * slots["moment1"] + (1 - self.beta1) * g
+        v = self.beta2 * slots["moment2"] + (1 - self.beta2) * g * g
+        m_hat = (mu_t1 * m / (1 - mu_prod * mu_t1)
+                 + (1 - mu_t) * g / (1 - mu_prod))
+        v_hat = v / (1 - self.beta2 ** t)
+        new_p = p - lr * m_hat / (jnp.sqrt(v_hat) + self.epsilon)
+        return new_p, {"moment1": m, "moment2": v, "mu_product": mu_prod}
+
+
+class RAdam(Adam):
+    """Rectified Adam (reference: paddle.optimizer.RAdam) — per-step
+    variance rectification; falls back to un-adapted momentum while the
+    variance estimate is unreliable."""
+
+    def _update_one(self, name, p, g, lr, slots, step, wd):
+        if wd:
+            g = g + wd * p
+        t = (step + 1).astype(jnp.float32)
+        m = self.beta1 * slots["moment1"] + (1 - self.beta1) * g
+        v = self.beta2 * slots["moment2"] + (1 - self.beta2) * g * g
+        m_hat = m / (1 - self.beta1 ** t)
+        rho_inf = 2.0 / (1 - self.beta2) - 1.0
+        rho_t = rho_inf - 2.0 * t * self.beta2 ** t / (1 - self.beta2 ** t)
+        r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                     / jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t,
+                                   1e-12))
+        v_hat = jnp.sqrt(v / (1 - self.beta2 ** t))
+        adaptive = p - lr * r * m_hat / (v_hat + self.epsilon)
+        plain = p - lr * m_hat
+        new_p = jnp.where(rho_t > 5.0, adaptive, plain)
+        return new_p, {"moment1": m, "moment2": v}
+
+
+__all__ += ["ASGD", "Rprop", "NAdam", "RAdam"]

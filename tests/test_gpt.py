@@ -252,3 +252,13 @@ class TestDecodeStrategies:
         with pytest.raises(ValueError, match="decode_strategy"):
             m.generate(jnp.zeros((1, 4), jnp.int32),
                        decode_strategy="beam_search")
+
+
+def test_top_p_respects_temperature():
+    """Reference order: temperature scaling BEFORE the nucleus cutoff —
+    high temperature flattens the distribution and widens the kept set."""
+    from paddle_tpu.models.generation import filter_logits
+    lg = jnp.asarray([[3.0, 1.5, 0.0]])
+    cold = np.asarray(filter_logits(lg, top_p=0.9, temperature=1.0))
+    hot = np.asarray(filter_logits(lg, top_p=0.9, temperature=3.0))
+    assert (np.isfinite(hot).sum() > np.isfinite(cold).sum())

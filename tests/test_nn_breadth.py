@@ -433,3 +433,101 @@ class TestLossLongTail:
                                       torch.tensor(np.asarray(lbl)))
         got = F.hinge_embedding_loss(x1, lbl)
         np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+
+
+class TestLayersMoreRound2:
+    def _x4(self):
+        return jnp.asarray(np.random.default_rng(0).normal(
+            size=(2, 4, 8, 8)).astype(np.float32))
+
+    def test_upsampling_bilinear_align_corners_vs_torch(self):
+        x = self._x4()
+        ours = np.asarray(nn.UpsamplingBilinear2D(size=[16, 16])(x))
+        ref = TF.interpolate(torch.tensor(np.asarray(x)), size=(16, 16),
+                             mode="bilinear", align_corners=True).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_local_response_norm_vs_torch(self):
+        x = self._x4()
+        ours = np.asarray(nn.LocalResponseNorm()(x))
+        ref = TF.local_response_norm(torch.tensor(np.asarray(x)), 5,
+                                     alpha=1e-4, beta=0.75, k=1.0).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-6)
+
+    def test_max_unpool2d_roundtrip_vs_torch(self):
+        x = self._x4()
+        pooled, idx = TF.max_pool2d(torch.tensor(np.asarray(x)), 2,
+                                    return_indices=True)
+        ours = nn.MaxUnPool2D(2)(jnp.asarray(pooled.numpy()),
+                                 jnp.asarray(idx.numpy()))
+        ref = TF.max_unpool2d(pooled, idx, 2).numpy()
+        np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-6)
+
+    def test_channel_shuffle_vs_torch(self):
+        x = self._x4()
+        ours = np.asarray(nn.ChannelShuffle(2)(x))
+        ref = torch.channel_shuffle(torch.tensor(np.asarray(x)), 2).numpy()
+        np.testing.assert_allclose(ours, ref)
+
+    def test_bilinear_vs_torch(self):
+        torch.manual_seed(0)
+        tb = torch.nn.Bilinear(5, 6, 3)
+        ours = nn.Bilinear(5, 6, 3)
+        ours.weight = jnp.asarray(tb.weight.detach().numpy())
+        ours.bias = jnp.asarray(tb.bias.detach().numpy())
+        x1 = np.random.default_rng(0).normal(size=(4, 5)).astype(np.float32)
+        x2 = np.random.default_rng(1).normal(size=(4, 6)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(ours(jnp.asarray(x1), jnp.asarray(x2))),
+            tb(torch.tensor(x1), torch.tensor(x2)).detach().numpy(),
+            rtol=1e-4, atol=1e-5)
+
+    def test_pairwise_distance_vs_torch(self):
+        x = np.random.default_rng(0).normal(size=(4, 7)).astype(np.float32)
+        y = np.random.default_rng(1).normal(size=(4, 7)).astype(np.float32)
+        for p in (1.0, 2.0):
+            np.testing.assert_allclose(
+                np.asarray(nn.PairwiseDistance(p=p)(jnp.asarray(x),
+                                                    jnp.asarray(y))),
+                TF.pairwise_distance(torch.tensor(x), torch.tensor(y),
+                                     p=p).numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_pad_family_and_misc_shapes(self):
+        x = self._x4()
+        assert nn.Pad1D([1, 2])(jnp.ones((2, 3, 5))).shape == (2, 3, 8)
+        assert nn.Pad3D([1, 1, 1, 1, 1, 1])(
+            jnp.ones((1, 2, 3, 4, 5))).shape == (1, 2, 5, 6, 7)
+        assert nn.ZeroPad2D([1, 2, 3, 4])(x).shape == (2, 4, 15, 11)
+        assert nn.Unflatten(1, [2, 2])(x).shape == (2, 2, 2, 8, 8)
+        assert nn.Softmax2D()(x).shape == x.shape
+        np.testing.assert_allclose(
+            np.asarray(nn.Softmax2D()(x).sum(axis=1)), 1.0, rtol=1e-5)
+        assert nn.AdaptiveMaxPool1D(3)(jnp.ones((2, 3, 9))).shape == (2, 3, 3)
+        assert nn.SyncBatchNorm(4)(x).shape == x.shape
+        assert nn.SyncBatchNorm.convert_sync_batchnorm(nn.Linear(2, 2))
+
+    def test_alpha_dropout_preserves_moments(self):
+        ad = nn.AlphaDropout(0.25)
+        ad.train()
+        import paddle_tpu as pt
+        pt.seed(0)
+        x = jnp.asarray(np.random.default_rng(2).normal(
+            size=(20000,)).astype(np.float32))
+        out = np.asarray(ad(x))
+        assert abs(out.mean() - np.asarray(x).mean()) < 0.05
+        assert abs(out.std() - np.asarray(x).std()) < 0.1
+
+    def test_activation_layer_batch(self):
+        x = jnp.linspace(-3, 3, 13)
+        for layer, fn in ((nn.SELU(), TF.selu), (nn.CELU(1.0), TF.celu),
+                          (nn.Tanhshrink(), TF.tanhshrink),
+                          (nn.LogSigmoid(), TF.logsigmoid),
+                          (nn.Hardshrink(), TF.hardshrink),
+                          (nn.Softshrink(), TF.softshrink)):
+            np.testing.assert_allclose(
+                np.asarray(layer(x)),
+                fn(torch.tensor(np.asarray(x))).numpy(),
+                rtol=1e-4, atol=1e-6)
+        glu = nn.GLU()(jnp.asarray(np.random.default_rng(3).normal(
+            size=(2, 8)).astype(np.float32)))
+        assert glu.shape == (2, 4)

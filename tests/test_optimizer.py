@@ -216,3 +216,53 @@ class TestOptimizerBreadth:
         from paddle_tpu.nn import functional as F
         y = np.asarray(F.conv2d(x, w, padding=1))
         np.testing.assert_allclose(y, x, rtol=1e-5)  # identity conv
+
+
+class TestRound2Optimizers:
+    """NAdam/RAdam/Rprop torch-oracle parity + ASGD averaging."""
+
+    def _grads(self, i):
+        g = (np.arange(12).reshape(4, 3).astype(np.float32) - 5.0) \
+            * 0.1 * (i + 1) % 3.0 - 1.0
+        return g
+
+    def _compare(self, ours_fn, torch_fn, steps=8, tol=1e-4):
+        import torch
+        from paddle_tpu import optimizer as O
+        w0 = np.random.default_rng(0).normal(size=(4, 3)).astype(np.float32)
+        opt = ours_fn(O)
+        params = {"w": jnp.asarray(w0)}
+        state = opt.init(params)
+        tw = torch.tensor(w0.copy(), requires_grad=True)
+        topt = torch_fn(torch, [tw])
+        for i in range(steps):
+            g = self._grads(i)
+            params, state = opt.apply({"w": jnp.asarray(g)}, state, params)
+            tw.grad = torch.tensor(g)
+            topt.step()
+        np.testing.assert_allclose(np.asarray(params["w"]),
+                                   tw.detach().numpy(), atol=tol)
+
+    def test_nadam_vs_torch(self):
+        self._compare(lambda O: O.NAdam(learning_rate=0.01),
+                      lambda t, ps: t.optim.NAdam(ps, lr=0.01))
+
+    def test_radam_vs_torch(self):
+        self._compare(lambda O: O.RAdam(learning_rate=0.01),
+                      lambda t, ps: t.optim.RAdam(ps, lr=0.01))
+
+    def test_rprop_vs_torch(self):
+        self._compare(lambda O: O.Rprop(learning_rate=0.01),
+                      lambda t, ps: t.optim.Rprop(ps, lr=0.01))
+
+    def test_asgd_average_tracks_iterates(self):
+        from paddle_tpu import optimizer as O
+        opt = O.ASGD(learning_rate=0.1)
+        params = {"w": jnp.zeros(())}
+        state = opt.init(params)
+        iterates = []
+        for _ in range(5):
+            params, state = opt.apply({"w": jnp.ones(())}, state, params)
+            iterates.append(float(params["w"]))
+        np.testing.assert_allclose(float(state["avg"]["w"]),
+                                   np.mean(iterates), rtol=1e-6)
