@@ -1,6 +1,6 @@
-"""``paddle.vision`` parity: transforms, model zoo (ResNet/LeNet), datasets.
+"""``paddle.vision`` parity: transforms, model zoo, ops, datasets.
 
-Reference: python/paddle/vision/ (transforms/, models/resnet.py, datasets/)
+Reference: python/paddle/vision/ (transforms/, models/, datasets/)
 — SURVEY §2.6. Dataset downloads are gated (zero-egress image): the dataset
 classes accept pre-downloaded files and there is a RandomDataset for tests.
 """
@@ -9,8 +9,5 @@ from . import transforms  # noqa: F401
 from . import models  # noqa: F401
 from . import ops  # noqa: F401
 from . import datasets  # noqa: F401
-from .models import (LeNet, ResNet, resnet18, resnet34, resnet50,  # noqa: F401
-                     VGG, vgg11, vgg13, vgg16, vgg19, AlexNet, alexnet,
-                     SqueezeNet, squeezenet1_0, squeezenet1_1,
-                     MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2,
-                     DenseNet, densenet121)
+from .models import *  # noqa: F401,F403 — the zoo's __all__ IS the
+#                        paddle.vision re-export surface (one list to keep)

@@ -1,8 +1,8 @@
-"""Vision model zoo: ResNet, LeNet, VGG, AlexNet, SqueezeNet, MobileNetV1/V2,
-DenseNet.
+"""Vision model zoo: ResNet (+ResNeXt/WideResNet), LeNet, VGG, AlexNet,
+SqueezeNet, MobileNetV1/V2, DenseNet, ShuffleNetV2, GoogLeNet.
 
 Reference: python/paddle/vision/models/{resnet,lenet,vgg,alexnet,squeezenet,
-mobilenetv1,mobilenetv2,densenet}.py. BatchNorm+conv blocks lower to XLA
+mobilenetv1,mobilenetv2,densenet,shufflenetv2,googlenet}.py. BatchNorm+conv blocks lower to XLA
 convs on the MXU; NCHW API kept for porting parity.
 """
 
@@ -51,14 +51,17 @@ class BasicBlock(Layer):
 class BottleneckBlock(Layer):
     expansion = 4
 
-    def __init__(self, in_ch, ch, stride=1):
+    def __init__(self, in_ch, ch, stride=1, groups=1, base_width=64):
         super().__init__()
-        self.conv1 = Conv2D(in_ch, ch, 1, bias_attr=False)
-        self.bn1 = BatchNorm2D(ch)
-        self.conv2 = Conv2D(ch, ch, 3, stride=stride, padding=1,
-                            bias_attr=False)
-        self.bn2 = BatchNorm2D(ch)
-        self.conv3 = Conv2D(ch, ch * 4, 1, bias_attr=False)
+        # ResNeXt/WideResNet parameterization (reference resnet.py):
+        # the 3x3 runs at width = ch * base_width/64 with `groups` groups
+        width = int(ch * (base_width / 64.0)) * groups
+        self.conv1 = Conv2D(in_ch, width, 1, bias_attr=False)
+        self.bn1 = BatchNorm2D(width)
+        self.conv2 = Conv2D(width, width, 3, stride=stride, padding=1,
+                            groups=groups, bias_attr=False)
+        self.bn2 = BatchNorm2D(width)
+        self.conv3 = Conv2D(width, ch * 4, 1, bias_attr=False)
         self.bn3 = BatchNorm2D(ch * 4)
         self.down = None
         if stride != 1 or in_ch != ch * 4:
@@ -85,9 +88,14 @@ _CONFIGS = {
 
 class ResNet(Layer):
     def __init__(self, depth: int = 50, num_classes: int = 1000,
-                 with_pool: bool = True):
+                 with_pool: bool = True, groups: int = 1,
+                 width_per_group: int = 64):
         super().__init__()
         block, layers = _CONFIGS[depth]
+        if (groups != 1 or width_per_group != 64) \
+                and block is not BottleneckBlock:
+            raise ValueError("groups/width_per_group need a bottleneck "
+                             "depth (50/101/152)")
         self.conv1 = Conv2D(3, 64, 7, stride=2, padding=3, bias_attr=False)
         self.bn1 = BatchNorm2D(64)
         self.maxpool = MaxPool2D(3, stride=2, padding=1)
@@ -97,7 +105,11 @@ class ResNet(Layer):
             blocks = []
             for j in range(n):
                 stride = 2 if (i > 0 and j == 0) else 1
-                blocks.append(block(ch, width, stride))
+                if block is BottleneckBlock:
+                    blocks.append(block(ch, width, stride, groups=groups,
+                                        base_width=width_per_group))
+                else:
+                    blocks.append(block(ch, width, stride))
                 ch = width * block.expansion
             stages.append(Sequential(*blocks))
         self.layer1, self.layer2, self.layer3, self.layer4 = stages
@@ -454,3 +466,211 @@ __all__ += ["VGG", "vgg11", "vgg13", "vgg16", "vgg19", "AlexNet", "alexnet",
             "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
             "MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2",
             "DenseNet", "densenet121"]
+
+
+def resnet101(num_classes=1000, **kw):
+    return ResNet(101, num_classes=num_classes, **kw)
+
+
+def resnet152(num_classes=1000, **kw):
+    return ResNet(152, num_classes=num_classes, **kw)
+
+
+def resnext50_32x4d(num_classes=1000, **kw):
+    """Reference: paddle.vision.models.resnext50_32x4d."""
+    return ResNet(50, num_classes=num_classes, groups=32,
+                  width_per_group=4, **kw)
+
+
+def resnext101_64x4d(num_classes=1000, **kw):
+    return ResNet(101, num_classes=num_classes, groups=64,
+                  width_per_group=4, **kw)
+
+
+def wide_resnet50_2(num_classes=1000, **kw):
+    """Reference: paddle.vision.models.wide_resnet50_2 (2x-wide 3x3s)."""
+    return ResNet(50, num_classes=num_classes, width_per_group=128, **kw)
+
+
+def wide_resnet101_2(num_classes=1000, **kw):
+    return ResNet(101, num_classes=num_classes, width_per_group=128, **kw)
+
+
+# -- ShuffleNetV2 (reference: paddle/vision/models/shufflenetv2.py) ---------
+
+class _ShuffleUnit(Layer):
+    """Stride-1 unit: split channels, transform one half, concat, shuffle.
+    Stride-2 unit: both branches transform, spatial down."""
+
+    def __init__(self, in_ch, out_ch, stride):
+        super().__init__()
+        from ..nn.layers_more import ChannelShuffle
+        self.stride = stride
+        branch_ch = out_ch // 2
+        if stride == 1:
+            main_in = in_ch // 2
+        else:
+            main_in = in_ch
+            self.branch1 = Sequential(
+                Conv2D(in_ch, in_ch, 3, stride=2, padding=1, groups=in_ch,
+                       bias_attr=False),
+                BatchNorm2D(in_ch),
+                Conv2D(in_ch, branch_ch, 1, bias_attr=False),
+                BatchNorm2D(branch_ch), ReLU())
+        self.branch2 = Sequential(
+            Conv2D(main_in, branch_ch, 1, bias_attr=False),
+            BatchNorm2D(branch_ch), ReLU(),
+            Conv2D(branch_ch, branch_ch, 3, stride=stride, padding=1,
+                   groups=branch_ch, bias_attr=False),
+            BatchNorm2D(branch_ch),
+            Conv2D(branch_ch, branch_ch, 1, bias_attr=False),
+            BatchNorm2D(branch_ch), ReLU())
+        self.shuffle = ChannelShuffle(2)
+
+    def forward(self, x):
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            x1, x2 = x[:, :half], x[:, half:]
+            out = jnp_concat([x1, self.branch2(x2)])
+        else:
+            out = jnp_concat([self.branch1(x), self.branch2(x)])
+        return self.shuffle(out)
+
+
+def jnp_concat(xs):
+    import jax.numpy as jnp
+    return jnp.concatenate(xs, axis=1)
+
+
+class ShuffleNetV2(Layer):
+    """Reference: paddle.vision.models.ShuffleNetV2."""
+
+    _STAGE_CH = {0.5: (48, 96, 192, 1024), 1.0: (116, 232, 464, 1024),
+                 1.5: (176, 352, 704, 1024), 2.0: (244, 488, 976, 2048)}
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        c2, c3, c4, c5 = self._STAGE_CH[scale]
+        self.conv1 = Sequential(Conv2D(3, 24, 3, stride=2, padding=1,
+                                       bias_attr=False),
+                                BatchNorm2D(24), ReLU())
+        self.maxpool = MaxPool2D(3, stride=2, padding=1)
+        ch = 24
+        stages = []
+        for out_ch, repeat in zip((c2, c3, c4), (4, 8, 4)):
+            units = [_ShuffleUnit(ch, out_ch, 2)]
+            units += [_ShuffleUnit(out_ch, out_ch, 1)
+                      for _ in range(repeat - 1)]
+            stages.append(Sequential(*units))
+            ch = out_ch
+        self.stage2, self.stage3, self.stage4 = stages
+        self.conv5 = Sequential(Conv2D(ch, c5, 1, bias_attr=False),
+                                BatchNorm2D(c5), ReLU())
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.fc = Linear(c5, num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        x = self.conv5(self.stage4(self.stage3(self.stage2(x))))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.reshape(x.shape[0], -1))
+        return x
+
+
+def shufflenet_v2_x0_5(**kw):
+    return ShuffleNetV2(scale=0.5, **kw)
+
+
+def shufflenet_v2_x1_0(**kw):
+    return ShuffleNetV2(scale=1.0, **kw)
+
+
+def shufflenet_v2_x1_5(**kw):
+    return ShuffleNetV2(scale=1.5, **kw)
+
+
+def shufflenet_v2_x2_0(**kw):
+    return ShuffleNetV2(scale=2.0, **kw)
+
+
+# -- GoogLeNet (reference: paddle/vision/models/googlenet.py) ---------------
+
+class _Inception(Layer):
+    def __init__(self, in_ch, c1, c3r, c3, c5r, c5, pool_proj):
+        super().__init__()
+        def cbr(i, o, k, p=0):
+            return Sequential(Conv2D(i, o, k, padding=p, bias_attr=False),
+                              BatchNorm2D(o), ReLU())
+        self.b1 = cbr(in_ch, c1, 1)
+        self.b2 = Sequential(cbr(in_ch, c3r, 1), cbr(c3r, c3, 3, 1))
+        self.b3 = Sequential(cbr(in_ch, c5r, 1), cbr(c5r, c5, 5, 2))
+        self.b4 = Sequential(MaxPool2D(3, stride=1, padding=1),
+                             cbr(in_ch, pool_proj, 1))
+
+    def forward(self, x):
+        return jnp_concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)])
+
+
+class GoogLeNet(Layer):
+    """Inception v1 (reference: paddle.vision.models.GoogLeNet); the aux
+    classifiers are train-time-only in the reference and omitted here
+    (documented deviation — the backbone/logits match)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        def cbr(i, o, k, s=1, p=0):
+            return Sequential(Conv2D(i, o, k, stride=s, padding=p,
+                                     bias_attr=False),
+                              BatchNorm2D(o), ReLU())
+        self.stem = Sequential(
+            cbr(3, 64, 7, 2, 3), MaxPool2D(3, stride=2, padding=1),
+            cbr(64, 64, 1), cbr(64, 192, 3, 1, 1),
+            MaxPool2D(3, stride=2, padding=1))
+        self.inc3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.inc3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = MaxPool2D(3, stride=2, padding=1)
+        self.inc4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.inc4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.inc4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.inc4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.inc4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = MaxPool2D(3, stride=2, padding=1)
+        self.inc5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.inc5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.dropout = Dropout(0.2)
+            self.fc = Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.inc3b(self.inc3a(x)))
+        x = self.inc4e(self.inc4d(self.inc4c(self.inc4b(self.inc4a(x)))))
+        x = self.inc5b(self.inc5a(self.pool4(x)))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.reshape(x.shape[0], -1)))
+        return x
+
+
+def googlenet(**kw):
+    return GoogLeNet(**kw)
+
+
+__all__ += [
+    "resnet101", "resnet152", "resnext50_32x4d", "resnext101_64x4d",
+    "wide_resnet50_2", "wide_resnet101_2",
+    "ShuffleNetV2", "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+    "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
+    "GoogLeNet", "googlenet",
+]

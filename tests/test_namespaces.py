@@ -366,3 +366,46 @@ class TestVersionAndModes:
         finally:
             pt.disable_static()
         assert pt.in_dynamic_mode()
+
+
+class TestVisionModelTail:
+    """Round-2 vision families (reference:
+    python/paddle/vision/models/{resnet,shufflenetv2,googlenet}.py)."""
+
+    def _run(self, model, size=64):
+        import jax.numpy as jnp
+        x = jnp.zeros((1, 3, size, size))
+        out = model.eval()(x)
+        assert out.shape == (1, 10)
+        return model
+
+    def test_resnext_and_wide_resnet(self):
+        from paddle_tpu.vision.models import (resnext50_32x4d,
+                                              wide_resnet50_2)
+        pt.seed(0)
+        rx = self._run(resnext50_32x4d(num_classes=10))
+        # grouped 3x3: weight in-channel dim is width/groups
+        w = rx.layer1[0].conv2.weight
+        assert w.shape[1] * 32 == w.shape[0]
+        wr = self._run(wide_resnet50_2(num_classes=10))
+        assert wr.layer1[0].conv2.weight.shape[0] == 128  # 2x width
+
+    def test_shufflenet_v2(self):
+        from paddle_tpu.vision.models import shufflenet_v2_x0_5
+        pt.seed(0)
+        m = self._run(shufflenet_v2_x0_5(num_classes=10))
+        n = sum(int(np.prod(p.shape)) for p in m.parameters())
+        assert n < 1.5e6  # x0.5 is the sub-1.5M-param preset
+
+    def test_googlenet(self):
+        from paddle_tpu.vision.models import googlenet
+        pt.seed(0)
+        m = self._run(googlenet(num_classes=10))
+        n = sum(int(np.prod(p.shape)) for p in m.parameters())
+        assert 5e6 < n < 8e6  # inception-v1 backbone scale
+
+    def test_resnext_needs_bottleneck(self):
+        import pytest
+        from paddle_tpu.vision.models import ResNet
+        with pytest.raises(ValueError, match="bottleneck"):
+            ResNet(18, groups=32, width_per_group=4)
