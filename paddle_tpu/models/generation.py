@@ -151,9 +151,135 @@ class CachedGenerationMixin:
             self.__dict__["_decode_loop_memo"] = (key, fn)
         return fn
 
+    def _beam_loop_fn(self, n_steps: int, num_beams: int,
+                      temperature: float = 0.0,
+                      repetition_penalty: float = 1.0):
+        """Whole beam-search decode as ONE compiled lax.scan (reference:
+        generation BeamSearchDecoder). Beams ride the batch dim (b·nb);
+        each step reorders caches, histories and penalty counts by the
+        surviving beams' parent indices. Fixed length — no EOS early-exit
+        (XLA static shapes; the reference pads to max length too)."""
+        cached_key, fn = self.__dict__.get("_beam_loop_memo", (None, None))
+        key = (n_steps, num_beams, temperature, repetition_penalty)
+        if cached_key != key:
+            fn = None
+        if fn is None:
+            from ..nn.layer import _swapped_params, functional_call
+            nb = num_beams
+
+            def loop(params, tok0, caches, lens0, scores0, seen0):
+                b = scores0.shape[0]
+                hist0 = jnp.zeros((b, nb, n_steps + 1), tok0.dtype)
+                hist0 = hist0.at[:, :, 0].set(tok0.reshape(b, nb))
+
+                def body(carry, i):
+                    tok, caches, lens, scores, hist, seen = carry
+                    mp = {k[len("model."):]: v for k, v in params.items()
+                          if k.startswith("model.")}
+                    hidden, caches = functional_call(
+                        self.model, mp, tok[:, None], caches=caches,
+                        seq_lens=lens, training=False)
+                    with _swapped_params(self, params):
+                        lg = self.logits(hidden[:, -1:])[:, 0]
+                    lg = filter_logits(
+                        lg.astype(jnp.float32),
+                        repetition_penalty=repetition_penalty, seen=seen,
+                        temperature=temperature if temperature > 0 else 1.0)
+                    logp = jax.nn.log_softmax(lg)
+                    vocab = logp.shape[-1]
+                    total = scores[:, :, None] + logp.reshape(b, nb, vocab)
+                    top_v, top_i = jax.lax.top_k(
+                        total.reshape(b, nb * vocab), nb)
+                    parent = top_i // vocab             # (b, nb)
+                    nxt = (top_i % vocab).astype(tok.dtype)
+                    flat_parent = (jnp.arange(b)[:, None] * nb
+                                   + parent).reshape(-1)
+                    caches = jax.tree.map(lambda c: c[flat_parent], caches)
+                    hist = hist[jnp.arange(b)[:, None], parent]
+                    hist = hist.at[:, :, i + 1].set(nxt)
+                    if repetition_penalty != 1.0:
+                        seen = seen[flat_parent].at[
+                            jnp.arange(b * nb), nxt.reshape(-1)].add(1)
+                    return (nxt.reshape(-1), caches, lens + 1, top_v,
+                            hist, seen), None
+
+                (tokN, caches, _, scores, hist, _), _ = jax.lax.scan(
+                    body, (tok0, caches, lens0, scores0, hist0, seen0),
+                    jnp.arange(n_steps))
+                return hist, scores
+
+            fn = jax.jit(loop, donate_argnums=(2,))
+            self.__dict__["_beam_loop_memo"] = (key, fn)
+        return fn
+
+    def _prefill_fn(self):
+        """Jitted prompt prefill (eager per-op dispatch of a whole forward
+        would dominate generate() latency); memoized per model."""
+        prefill = self.__dict__.get("_prefill_compiled")
+        if prefill is None:
+            from ..nn.layer import _swapped_params, functional_call
+
+            def _prefill(params, input_ids, caches):
+                mp = {k[len("model."):]: v for k, v in params.items()
+                      if k.startswith("model.")}
+                hidden, caches = functional_call(
+                    self.model, mp, input_ids, caches=caches,
+                    training=False)
+                with _swapped_params(self, params):
+                    lg = self.logits(hidden[:, -1:])[:, 0]
+                return lg, caches
+
+            prefill = jax.jit(_prefill, donate_argnums=(2,))
+            self.__dict__["_prefill_compiled"] = prefill
+        return prefill
+
+    def _beam_search(self, input_ids, max_new_tokens, num_beams, total,
+                     temperature=0.0, repetition_penalty=1.0):
+        from ..nn.layer import raw_params
+        b, prompt_len = input_ids.shape
+        nb = num_beams
+        expanded = jnp.repeat(input_ids, nb, axis=0)     # (b·nb, p)
+        caches = self.model.init_cache(b * nb, total)
+        params = raw_params(self)
+        prefill = self._prefill_fn()
+        logits, caches = prefill(params, expanded, caches)
+        vocab_size = logits.shape[-1]
+        track = repetition_penalty != 1.0
+        seen = (_seen_counts(expanded, vocab_size) if track
+                else jnp.zeros((b * nb, 1), jnp.int32))
+        logits = filter_logits(
+            logits.astype(jnp.float32),
+            repetition_penalty=repetition_penalty,
+            seen=seen if track else None,
+            temperature=temperature if temperature > 0 else 1.0)
+        logp = jax.nn.log_softmax(logits)
+        # seed: only beam 0 is live, and its first expansion takes the
+        # top-nb distinct tokens (the standard first-step trick)
+        first_v, first_tok = jax.lax.top_k(
+            logp.reshape(b, nb, vocab_size)[:, 0], nb)
+        scores = first_v                                  # (b, nb)
+        tok0 = first_tok.astype(input_ids.dtype).reshape(-1)
+        if track:
+            seen = seen.at[jnp.arange(b * nb), tok0].add(1)
+        if max_new_tokens == 1:
+            best = jnp.argmax(scores, axis=1)
+            picked = first_tok[jnp.arange(b), best][:, None]
+            return jnp.concatenate(
+                [input_ids, picked.astype(input_ids.dtype)], axis=1)
+        loop = self._beam_loop_fn(max_new_tokens - 1, nb,
+                                  float(temperature),
+                                  float(repetition_penalty))
+        lens = jnp.full((b * nb,), prompt_len, jnp.int32)
+        hist, scores = loop(params, tok0, caches, lens, scores, seen)
+        best = jnp.argmax(scores, axis=1)                 # (b,)
+        toks = hist[jnp.arange(b), best]                  # (b, n_steps+1)
+        return jnp.concatenate([input_ids, toks.astype(input_ids.dtype)],
+                               axis=1)
+
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
                  use_cache=True, max_len=None, top_k=0, top_p=1.0,
-                 repetition_penalty=1.0, decode_strategy=None):
+                 repetition_penalty=1.0, decode_strategy=None,
+                 num_beams=1):
         """Autoregressive generation. ``use_cache=True`` (default) prefills
         the dense KV caches once, then runs the WHOLE decode loop as one
         compiled ``lax.scan`` (one dispatch per call). ``use_cache=False``
@@ -168,15 +294,43 @@ class CachedGenerationMixin:
         prompt too). ``decode_strategy`` is the reference's name for the
         mode: "greedy_search" forces temperature 0, "sampling" requires
         temperature > 0."""
+        if decode_strategy not in (None, "greedy_search", "sampling",
+                                   "beam_search"):
+            raise ValueError(
+                f"unsupported decode_strategy {decode_strategy!r}")
+        if num_beams > 1 and decode_strategy not in (None, "beam_search"):
+            raise ValueError(
+                f"num_beams={num_beams} requires "
+                f"decode_strategy='beam_search', got {decode_strategy!r}")
+        if decode_strategy == "beam_search":
+            if num_beams <= 1:
+                raise ValueError(
+                    "beam_search needs num_beams > 1 (reference semantics; "
+                    "num_beams=1 IS greedy_search)")
+            if top_k or top_p < 1.0:
+                raise NotImplementedError(
+                    "top_k/top_p do not apply to deterministic beam "
+                    "search — use decode_strategy='sampling'")
+            if not (use_cache and self._cache_supported()):
+                raise NotImplementedError(
+                    "beam_search needs the KV-cache path (this config "
+                    "falls back to recompute)")
+            if max_new_tokens <= 0:
+                return input_ids
+            b, prompt_len = input_ids.shape
+            total = max_len if max_len is not None else \
+                (prompt_len + max_new_tokens)
+            if total < prompt_len + max_new_tokens:
+                raise ValueError(
+                    f"max_len={total} < prompt ({prompt_len}) + "
+                    f"max_new_tokens ({max_new_tokens}): the cache would "
+                    "silently drop keys")
+            return self._beam_search(input_ids, max_new_tokens, num_beams,
+                                     total, temperature, repetition_penalty)
         if decode_strategy == "greedy_search":
             temperature = 0.0
         elif decode_strategy == "sampling" and temperature <= 0:
             temperature = 1.0
-        elif decode_strategy not in (None, "greedy_search", "sampling"):
-            raise ValueError(
-                f"unsupported decode_strategy {decode_strategy!r} (beam "
-                "search: use examples' beam helper or batch-expand + "
-                "sampling)")
         if max_new_tokens <= 0:
             return input_ids
         vocab = getattr(self.cfg, "vocab_size", None)
@@ -197,7 +351,7 @@ class CachedGenerationMixin:
                 ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
             return ids
 
-        from ..nn.layer import functional_call, raw_params
+        from ..nn.layer import raw_params
         b, prompt_len = input_ids.shape
         total = max_len if max_len is not None else \
             (prompt_len + max_new_tokens)
@@ -206,24 +360,7 @@ class CachedGenerationMixin:
                 f"max_len={total} < prompt ({prompt_len}) + max_new_tokens "
                 f"({max_new_tokens}): the cache would silently drop keys")
         params = raw_params(self)
-        prefill = self.__dict__.get("_prefill_compiled")
-        if prefill is None:
-            from ..nn.layer import _swapped_params
-
-            # jitted: eager per-op dispatch of a whole prefill forward would
-            # dominate generate() latency (hundreds of op round-trips)
-            def _prefill(params, input_ids, caches):
-                mp = {k[len("model."):]: v for k, v in params.items()
-                      if k.startswith("model.")}
-                hidden, caches = functional_call(
-                    self.model, mp, input_ids, caches=caches,
-                    training=False)
-                with _swapped_params(self, params):
-                    lg = self.logits(hidden[:, -1:])[:, 0]
-                return lg, caches
-
-            prefill = jax.jit(_prefill, donate_argnums=(2,))
-            self.__dict__["_prefill_compiled"] = prefill
+        prefill = self._prefill_fn()
         caches = self.model.init_cache(b, total)
         logits, caches = prefill(params, input_ids, caches)
         seen = _seen_counts(input_ids, vocab) if track_seen else None

@@ -251,7 +251,7 @@ class TestDecodeStrategies:
         m = self._model()
         with pytest.raises(ValueError, match="decode_strategy"):
             m.generate(jnp.zeros((1, 4), jnp.int32),
-                       decode_strategy="beam_search")
+                       decode_strategy="contrastive_search")
 
 
 def test_top_p_respects_temperature():
@@ -262,3 +262,96 @@ def test_top_p_respects_temperature():
     cold = np.asarray(filter_logits(lg, top_p=0.9, temperature=1.0))
     hot = np.asarray(filter_logits(lg, top_p=0.9, temperature=3.0))
     assert (np.isfinite(hot).sum() > np.isfinite(cold).sum())
+
+
+class TestBeamSearch:
+    def _model(self):
+        from paddle_tpu.models.llama import llama
+        pt.seed(0)
+        return llama("tiny").eval()
+
+    def test_beam_equals_exhaustive_search(self):
+        """num_beams >= vocab-path count: beam search must find the exact
+        argmax sequence; verify against brute-force over all 2-token
+        continuations scored by the model."""
+        m = self._model()
+        ids = jnp.asarray(np.random.default_rng(1).integers(
+            0, 256, size=(1, 6)))
+        out = m.generate(ids, max_new_tokens=2,
+                         decode_strategy="beam_search", num_beams=8)
+        assert out.shape == (1, 8)
+
+        # brute force: score every (t1 from top-8 first tokens, t2) pair
+        logits1 = np.asarray(m(ids)[:, -1], np.float32)
+        lp1 = np.log(np.exp(logits1[0] - logits1[0].max())
+                     / np.exp(logits1[0] - logits1[0].max()).sum())
+        top8 = np.argsort(lp1)[::-1][:8]
+        best_score, best_pair = -np.inf, None
+        for t1 in top8:
+            seq = jnp.concatenate([ids, jnp.asarray([[t1]], ids.dtype)], 1)
+            logits2 = np.asarray(m(seq)[:, -1], np.float32)[0]
+            lp2 = np.log(np.exp(logits2 - logits2.max())
+                         / np.exp(logits2 - logits2.max()).sum())
+            t2 = int(np.argmax(lp2))
+            s = lp1[t1] + lp2[t2]
+            if s > best_score:
+                best_score, best_pair = s, (int(t1), t2)
+        assert tuple(np.asarray(out)[0, -2:]) == best_pair
+
+    def test_beam_one_equals_greedy_argmax_path(self):
+        """With enough beams the top beam's first token == greedy's."""
+        m = self._model()
+        ids = jnp.asarray(np.random.default_rng(2).integers(
+            0, 256, size=(2, 5)))
+        beam = np.asarray(m.generate(ids, max_new_tokens=1,
+                                     decode_strategy="beam_search",
+                                     num_beams=4))
+        greedy = np.asarray(m.generate(ids, max_new_tokens=1))
+        np.testing.assert_array_equal(beam, greedy)
+
+    def test_beam_requires_cache(self):
+        m = self._model()
+        with pytest.raises(NotImplementedError, match="KV-cache"):
+            m.generate(jnp.zeros((1, 4), jnp.int32), max_new_tokens=2,
+                       decode_strategy="beam_search", num_beams=2,
+                       use_cache=False)
+
+
+class TestBeamSearchValidation:
+    def _m(self):
+        from paddle_tpu.models.llama import llama
+        pt.seed(0)
+        return llama("tiny").eval()
+
+    def test_num_beams_one_rejected(self):
+        with pytest.raises(ValueError, match="num_beams > 1"):
+            self._m().generate(jnp.zeros((1, 4), jnp.int32),
+                               decode_strategy="beam_search")
+
+    def test_beams_with_wrong_strategy_rejected(self):
+        with pytest.raises(ValueError, match="requires"):
+            self._m().generate(jnp.zeros((1, 4), jnp.int32),
+                               decode_strategy="sampling", num_beams=4)
+
+    def test_top_k_with_beam_rejected(self):
+        with pytest.raises(NotImplementedError, match="top_k"):
+            self._m().generate(jnp.zeros((1, 4), jnp.int32),
+                               decode_strategy="beam_search", num_beams=2,
+                               top_k=5)
+
+    def test_max_len_validated(self):
+        with pytest.raises(ValueError, match="max_len"):
+            self._m().generate(jnp.zeros((1, 10), jnp.int32),
+                               max_new_tokens=20, max_len=12,
+                               decode_strategy="beam_search", num_beams=2)
+
+    def test_repetition_penalty_applies_in_beam(self):
+        m = self._m()
+        ids = jnp.asarray([[7, 7, 7, 7, 7, 7]])
+        plain = np.asarray(m.generate(ids, max_new_tokens=6,
+                                      decode_strategy="beam_search",
+                                      num_beams=3))
+        pen = np.asarray(m.generate(ids, max_new_tokens=6,
+                                    decode_strategy="beam_search",
+                                    num_beams=3, repetition_penalty=8.0))
+        assert not np.array_equal(plain, pen)
