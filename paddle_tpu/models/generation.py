@@ -238,15 +238,19 @@ class CachedGenerationMixin:
         from ..nn.layer import raw_params
         b, prompt_len = input_ids.shape
         nb = num_beams
-        expanded = jnp.repeat(input_ids, nb, axis=0)     # (b·nb, p)
-        caches = self.model.init_cache(b * nb, total)
         params = raw_params(self)
         prefill = self._prefill_fn()
-        logits, caches = prefill(params, expanded, caches)
+        # prefill ONCE at batch b (the dominant FLOP cost for long
+        # prompts), then repeat the caches across beams — the rows are
+        # byte-identical, so nb separate prefills would be pure waste
+        caches = self.model.init_cache(b, total)
+        logits, caches = prefill(params, input_ids, caches)
+        caches = jax.tree.map(lambda c: jnp.repeat(c, nb, axis=0), caches)
+        logits = jnp.repeat(logits, nb, axis=0)          # (b·nb, V)
         vocab_size = logits.shape[-1]
         track = repetition_penalty != 1.0
-        seen = (_seen_counts(expanded, vocab_size) if track
-                else jnp.zeros((b * nb, 1), jnp.int32))
+        seen = (_seen_counts(jnp.repeat(input_ids, nb, axis=0), vocab_size)
+                if track else jnp.zeros((b * nb, 1), jnp.int32))
         logits = filter_logits(
             logits.astype(jnp.float32),
             repetition_penalty=repetition_penalty,
@@ -298,10 +302,21 @@ class CachedGenerationMixin:
                                    "beam_search"):
             raise ValueError(
                 f"unsupported decode_strategy {decode_strategy!r}")
-        if num_beams > 1 and decode_strategy not in (None, "beam_search"):
+        if num_beams > 1:
+            if decode_strategy is None:       # reference: beams imply beam search
+                decode_strategy = "beam_search"
+            elif decode_strategy != "beam_search":
+                raise ValueError(
+                    f"num_beams={num_beams} requires "
+                    f"decode_strategy='beam_search', got {decode_strategy!r}")
+        # shared cache-capacity contract for every cached strategy
+        prompt_len = input_ids.shape[1]
+        total = max_len if max_len is not None else \
+            (prompt_len + max_new_tokens)
+        if total < prompt_len + max_new_tokens:
             raise ValueError(
-                f"num_beams={num_beams} requires "
-                f"decode_strategy='beam_search', got {decode_strategy!r}")
+                f"max_len={total} < prompt ({prompt_len}) + max_new_tokens "
+                f"({max_new_tokens}): the cache would silently drop keys")
         if decode_strategy == "beam_search":
             if num_beams <= 1:
                 raise ValueError(
@@ -317,14 +332,6 @@ class CachedGenerationMixin:
                     "falls back to recompute)")
             if max_new_tokens <= 0:
                 return input_ids
-            b, prompt_len = input_ids.shape
-            total = max_len if max_len is not None else \
-                (prompt_len + max_new_tokens)
-            if total < prompt_len + max_new_tokens:
-                raise ValueError(
-                    f"max_len={total} < prompt ({prompt_len}) + "
-                    f"max_new_tokens ({max_new_tokens}): the cache would "
-                    "silently drop keys")
             return self._beam_search(input_ids, max_new_tokens, num_beams,
                                      total, temperature, repetition_penalty)
         if decode_strategy == "greedy_search":
@@ -352,13 +359,7 @@ class CachedGenerationMixin:
             return ids
 
         from ..nn.layer import raw_params
-        b, prompt_len = input_ids.shape
-        total = max_len if max_len is not None else \
-            (prompt_len + max_new_tokens)
-        if total < prompt_len + max_new_tokens:
-            raise ValueError(
-                f"max_len={total} < prompt ({prompt_len}) + max_new_tokens "
-                f"({max_new_tokens}): the cache would silently drop keys")
+        b = input_ids.shape[0]       # total/prompt_len validated above
         params = raw_params(self)
         prefill = self._prefill_fn()
         caches = self.model.init_cache(b, total)

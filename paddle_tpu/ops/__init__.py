@@ -729,3 +729,95 @@ except ImportError as _e:  # pallas unavailable (e.g. minimal jax build);
     # not silently lose the TPU kernels — hence ImportError only
     import warnings as _warnings
     _warnings.warn(f"pallas kernel pack not loaded: {_e}")
+
+
+# -- linalg tail (reference: python/paddle/tensor/linalg.py round-2 batch) --
+
+def _linalg_lu_unpack(lu_data, lu_pivots, unpack_ludata=True,
+                      unpack_pivots=True):
+    """paddle.linalg.lu_unpack: packed LU + 1-based sequential pivots →
+    (P, L, U)."""
+    n = lu_data.shape[-2]
+    m = lu_data.shape[-1]
+    k = _builtins.min(n, m)  # the module's paddle `min` op shadows the builtin
+    L = jnp.tril(lu_data[..., :, :k], -1) + jnp.eye(n, k, dtype=lu_data.dtype)
+    U = jnp.triu(lu_data[..., :k, :])
+    if not unpack_pivots:
+        return None, L, U
+    # sequential row-swap pivots → permutation matrix (static loop: the
+    # pivot length is a shape constant)
+    perm = jnp.broadcast_to(jnp.arange(n), lu_pivots.shape[:-1] + (n,))
+    piv0 = lu_pivots.astype(jnp.int32) - 1
+    for i in range(piv0.shape[-1]):
+        j = piv0[..., i]
+        pi = jnp.take_along_axis(perm, jnp.full(perm.shape[:-1] + (1,), i,
+                                                jnp.int32), -1)
+        pj = jnp.take_along_axis(perm, j[..., None], -1)
+        perm = jnp.put_along_axis(perm, jnp.full(perm.shape[:-1] + (1,), i,
+                                                 jnp.int32), pj, -1,
+                                  inplace=False)
+        perm = jnp.put_along_axis(perm, j[..., None], pi, -1, inplace=False)
+    P = jax.nn.one_hot(perm, n, dtype=lu_data.dtype)
+    # rows of P: P[i, perm[i]] = 1 → P @ A applies the permutation; paddle
+    # returns P with A = P @ L @ U
+    P = jnp.swapaxes(P, -1, -2)
+    if not unpack_ludata:
+        return P, None, None
+    return P, L, U
+
+
+def _linalg_svdvals(x):
+    return jnp.linalg.svd(x, compute_uv=False)
+
+
+def _linalg_householder_product(x, tau):
+    return jax.lax.linalg.householder_product(x, tau)
+
+
+def _linalg_ormqr(x, tau, y, left=True, transpose=False):
+    """Multiply ``y`` by the FULL Q of a QR factorization given in
+    householder form (reference: paddle.linalg.ormqr / torch.ormqr).
+    householder_product alone yields the thin Q; zero-padded reflectors
+    (tau=0 → identity) extend it to m×m."""
+    m = x.shape[-2]
+    k = x.shape[-1]
+    if k < m:
+        pad_x = [(0, 0)] * (x.ndim - 1) + [(0, m - k)]
+        x = jnp.pad(x, pad_x)
+        tau = jnp.pad(tau, [(0, 0)] * (tau.ndim - 1) + [(0, m - k)])
+    q = jax.lax.linalg.householder_product(x, tau)
+    q = jnp.swapaxes(q, -1, -2) if transpose else q
+    return q @ y if left else y @ q
+
+
+def _linalg_svd_lowrank(x, q=6, niter=2, M=None):
+    """Randomized low-rank SVD (Halko et al.; reference:
+    paddle.linalg.svd_lowrank)."""
+    from ..core import random as _random
+    if M is not None:
+        x = x - M
+    m, n = x.shape[-2], x.shape[-1]
+    q = _builtins.min(q, m, n)
+    g = jax.random.normal(_random.next_key(), x.shape[:-2] + (n, q),
+                          jnp.float32).astype(x.dtype)
+    xt = jnp.swapaxes(x, -1, -2)
+    # re-orthonormalize every power iteration (torch's
+    # get_approximate_basis does the same): raw (XX^T)^niter amplifies
+    # singular-value ratios to the 2·niter+1 power, which under float32
+    # collapses the weak directions the iteration exists to refine
+    Q, _ = jnp.linalg.qr(x @ g)
+    for _ in range(niter):
+        z, _ = jnp.linalg.qr(xt @ Q)
+        Q, _ = jnp.linalg.qr(x @ z)
+    B = jnp.swapaxes(Q, -1, -2) @ x
+    u, s, vh = jnp.linalg.svd(B, full_matrices=False)
+    return Q @ u, s, jnp.swapaxes(vh, -1, -2)
+
+
+linalg.lu_unpack = staticmethod(_linalg_lu_unpack)
+linalg.svdvals = staticmethod(_linalg_svdvals)
+linalg.householder_product = staticmethod(_linalg_householder_product)
+linalg.ormqr = staticmethod(_linalg_ormqr)
+linalg.svd_lowrank = staticmethod(_linalg_svd_lowrank)
+linalg.vector_norm = staticmethod(jnp.linalg.vector_norm)
+linalg.matrix_norm = staticmethod(jnp.linalg.matrix_norm)

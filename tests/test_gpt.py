@@ -355,3 +355,16 @@ class TestBeamSearchValidation:
                                     decode_strategy="beam_search",
                                     num_beams=3, repetition_penalty=8.0))
         assert not np.array_equal(plain, pen)
+
+
+def test_num_beams_alone_triggers_beam_search():
+    """num_beams>1 with default strategy runs beam search (reference
+    behavior), never silent greedy."""
+    from paddle_tpu.models.llama import llama
+    pt.seed(0)
+    m = llama("tiny").eval()
+    ids = jnp.asarray(np.random.default_rng(3).integers(0, 256, (1, 5)))
+    implicit = np.asarray(m.generate(ids, max_new_tokens=4, num_beams=3))
+    explicit = np.asarray(m.generate(ids, max_new_tokens=4, num_beams=3,
+                                     decode_strategy="beam_search"))
+    np.testing.assert_array_equal(implicit, explicit)

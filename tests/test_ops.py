@@ -177,3 +177,77 @@ def test_grad_through_functional(rng):
         wm = w.at[i].add(-eps)
         fd = (float(f(wp)) - float(f(wm))) / (2 * eps)
         assert abs(fd - float(g[i])) < 5e-2, (i, fd, float(g[i]))
+
+
+class TestLinalgTailRound2:
+    def test_lu_unpack_matches_torch(self):
+        import torch
+        from paddle_tpu.ops import linalg
+        a = np.random.default_rng(0).normal(size=(5, 5)).astype(np.float32)
+        lu, piv = linalg.lu(jnp.asarray(a))
+        P, L, U = linalg.lu_unpack(lu, piv)
+        np.testing.assert_allclose(np.asarray(P @ L @ U), a, atol=1e-5)
+        tp, tl, tu = torch.lu_unpack(*torch.linalg.lu_factor(
+            torch.tensor(a)))
+        np.testing.assert_allclose(np.asarray(P), tp.numpy(), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(L), tl.numpy(), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(U), tu.numpy(), atol=1e-5)
+
+    def test_svdvals_and_norms(self):
+        import torch
+        from paddle_tpu.ops import linalg
+        a = np.random.default_rng(1).normal(size=(4, 6)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(linalg.svdvals(jnp.asarray(a))),
+            torch.linalg.svdvals(torch.tensor(a)).numpy(), rtol=1e-4)
+        np.testing.assert_allclose(
+            float(linalg.vector_norm(jnp.asarray(a))),
+            float(np.linalg.norm(a.ravel())), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(linalg.matrix_norm(jnp.asarray(a))),
+            float(np.linalg.norm(a, "fro")), rtol=1e-5)
+
+    def test_svd_lowrank_reconstructs(self):
+        """Exact-rank-3 matrix, q=3: the randomized range finder must
+        recover it (full-rank inputs lose the weakest directions to the
+        float32 power iteration — the method's documented regime is
+        effectively-low-rank data)."""
+        from paddle_tpu.ops import linalg
+        r = np.random.default_rng(2)
+        b = (r.normal(size=(8, 3)) @ r.normal(size=(3, 5))).astype(
+            np.float32)
+        u, s, v = linalg.svd_lowrank(jnp.asarray(b), q=3, niter=2)
+        np.testing.assert_allclose(np.asarray(u @ jnp.diag(s) @ v.T), b,
+                                   atol=1e-4)
+        assert s.shape == (3,) and u.shape == (8, 3) and v.shape == (5, 3)
+
+    def test_ormqr_full_q_vs_torch(self):
+        import torch
+        from paddle_tpu.ops import linalg
+        A = torch.tensor(np.random.default_rng(3).normal(
+            size=(6, 3)).astype(np.float32))
+        h, tau = torch.geqrf(A)
+        C = torch.tensor(np.random.default_rng(4).normal(
+            size=(6, 2)).astype(np.float32))
+        D = torch.tensor(np.random.default_rng(5).normal(
+            size=(2, 6)).astype(np.float32))   # right-multiply operand
+        for left, trans in ((True, False), (True, True),
+                            (False, False), (False, True)):
+            c = C if left else D
+            ref = torch.ormqr(h, tau, c, left=left,
+                              transpose=trans).numpy()
+            ours = np.asarray(linalg.ormqr(
+                jnp.asarray(h.numpy()), jnp.asarray(tau.numpy()),
+                jnp.asarray(c.numpy()), left=left, transpose=trans))
+            np.testing.assert_allclose(ours, ref, atol=1e-4)
+
+    def test_householder_product(self):
+        import torch
+        from paddle_tpu.ops import linalg
+        A = torch.tensor(np.random.default_rng(5).normal(
+            size=(5, 3)).astype(np.float32))
+        h, tau = torch.geqrf(A)
+        ref = torch.linalg.householder_product(h, tau).numpy()
+        ours = np.asarray(linalg.householder_product(
+            jnp.asarray(h.numpy()), jnp.asarray(tau.numpy())))
+        np.testing.assert_allclose(ours, ref, atol=1e-4)
