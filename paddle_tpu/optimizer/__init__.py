@@ -500,3 +500,8 @@ class RAdam(Adam):
 
 
 __all__ += ["ASGD", "Rprop", "NAdam", "RAdam"]
+
+
+from .lbfgs import LBFGS  # noqa: E402,F401
+
+__all__ += ["LBFGS"]

@@ -1,0 +1,250 @@
+"""L-BFGS with strong-Wolfe line search (reference:
+python/paddle/optimizer/lbfgs.py).
+
+The reference's ``step(closure)`` re-runs the closure which calls
+``loss.backward()`` into parameter ``.grad`` slots — an eager-tape
+contract that does not exist here.  The jax-idiomatic contract (documented
+deviation): the closure is a PURE function of the parameter pytree,
+``closure(params) -> loss``; value+grad at line-search trial points come
+from ``jax.value_and_grad`` of that function, jitted once.  Everything
+else (two-loop recursion, history rules, strong-Wolfe/backtracking line
+search, tolerances) follows the reference/torch algorithm.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["LBFGS"]
+
+
+def _cubic_interpolate(x1, f1, g1, x2, f2, g2, bounds=None):
+    # torch/reference cubic interpolation for strong Wolfe
+    if bounds is not None:
+        lo, hi = bounds
+    else:
+        lo, hi = (x1, x2) if x1 <= x2 else (x2, x1)
+    d1 = g1 + g2 - 3 * (f1 - f2) / (x1 - x2)
+    sq = d1 * d1 - g1 * g2
+    if sq >= 0:
+        d2 = sq ** 0.5
+        denom = (g2 - g1 + 2 * d2) if x1 <= x2 else (g1 - g2 + 2 * d2)
+        if denom == 0.0:   # plateau bracket: fall back to bisection
+            return (lo + hi) / 2.0
+        if x1 <= x2:
+            pos = x2 - (x2 - x1) * ((g2 + d2 - d1) / denom)
+        else:
+            pos = x1 - (x1 - x2) * ((g1 + d2 - d1) / denom)
+        return min(max(pos, lo), hi)
+    return (lo + hi) / 2.0
+
+
+class LBFGS:
+    """Reference surface: ``LBFGS(learning_rate, max_iter, ...,
+    parameters=model.parameters())`` + ``opt.step(closure)``.
+
+    ``closure(params) -> loss`` must be pure (params pytree in, scalar
+    out); ``step`` runs up to ``max_iter`` L-BFGS iterations and writes
+    the result back into the owning model (when constructed from
+    ``model.parameters()``) or returns it."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-07, tolerance_change=1e-09,
+                 history_size=100, line_search_fn: Optional[str] = None,
+                 parameters=None, weight_decay=0.0, grad_clip=None,
+                 name=None):
+        from ..nn.layer import ParameterList
+        del name  # reference signature compat
+        self.lr = float(learning_rate)
+        from ..regularizer import L2Decay
+        self.weight_decay = (weight_decay.coeff
+                             if isinstance(weight_decay, L2Decay)
+                             else float(weight_decay or 0.0))
+        if grad_clip is not None:
+            # clipping inside a Wolfe line search breaks its descent
+            # assumptions; the reference accepts-and-applies, we reject
+            # loudly rather than silently diverge
+            raise NotImplementedError(
+                "grad_clip with LBFGS is not supported (the line search "
+                "owns the step length); clip inside the closure if needed")
+        self.max_iter = int(max_iter)
+        self.max_eval = max_eval if max_eval is not None \
+            else max_iter * 5 // 4
+        self.tol_grad = tolerance_grad
+        self.tol_change = tolerance_change
+        self.history_size = int(history_size)
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError(
+                f"line_search_fn must be None or 'strong_wolfe', got "
+                f"{line_search_fn!r}")
+        self.line_search_fn = line_search_fn
+        self._owner = None
+        self._names = None
+        if isinstance(parameters, ParameterList):
+            self._owner = parameters.owner
+            self._names = parameters.names
+        self._vg = None          # jitted value_and_grad of the closure
+        self._closure_id = None
+
+    # -- internals ---------------------------------------------------------
+
+    def _value_and_grad(self, closure):
+        if self._vg is None or self._closure_id != id(closure):
+            wd = self.weight_decay
+
+            def objective(flat, unravel):
+                loss = closure(unravel(flat))
+                if wd:
+                    # L2 regularization folded into the objective so the
+                    # line search sees the same function it differentiates
+                    loss = loss + 0.5 * wd * jnp.sum(flat * flat)
+                return loss
+
+            self._vg = jax.jit(jax.value_and_grad(objective, argnums=0),
+                               static_argnums=(1,))
+            self._closure_id = id(closure)
+        return self._vg
+
+    def _strong_wolfe(self, vg, unravel, flat, direction, f0, g0_dot, t,
+                      c1=1e-4, c2=0.9, max_ls=25):
+        """Bracket + zoom strong-Wolfe search along ``direction``.
+
+        Returns (t, f, g_vec, evals) — the gradient VECTOR at the accepted
+        point rides along so the caller never re-evaluates it."""
+        def phi(step):
+            f, g = vg(flat + step * direction, unravel)
+            return float(f), g
+
+        f_prev, g_prev_dot, t_prev = f0, g0_dot, 0.0
+        g_prev_vec = None
+        f_new, g_new = phi(t)
+        g_new_dot = float(g_new @ direction)
+        evals = 1
+        bracket = None
+        for _ in range(max_ls):
+            if f_new > f0 + c1 * t * g0_dot or \
+                    (evals > 1 and f_new >= f_prev):
+                bracket = ((t_prev, f_prev, g_prev_dot, g_prev_vec),
+                           (t, f_new, g_new_dot, g_new))
+                break
+            if abs(g_new_dot) <= -c2 * g0_dot:
+                return t, f_new, g_new, evals     # Wolfe satisfied
+            if g_new_dot >= 0:
+                bracket = ((t, f_new, g_new_dot, g_new),
+                           (t_prev, f_prev, g_prev_dot, g_prev_vec))
+                break
+            t_prev, f_prev, g_prev_dot = t, f_new, g_new_dot
+            g_prev_vec = g_new
+            t = min(10 * t, 1e10)
+            f_new, g_new = phi(t)
+            g_new_dot = float(g_new @ direction)
+            evals += 1
+        if bracket is None:
+            return t, f_new, g_new, evals
+        (lo_t, lo_f, lo_g, lo_vec), (hi_t, hi_f, hi_g, _) = bracket
+        for _ in range(max_ls):
+            if abs(hi_t - lo_t) < 1e-9:
+                break
+            t = _cubic_interpolate(lo_t, lo_f, lo_g, hi_t, hi_f, hi_g)
+            f_new, g_new = phi(t)
+            g_new_dot = float(g_new @ direction)
+            evals += 1
+            if f_new > f0 + c1 * t * g0_dot or f_new >= lo_f:
+                hi_t, hi_f, hi_g = t, f_new, g_new_dot
+            else:
+                if abs(g_new_dot) <= -c2 * g0_dot:
+                    return t, f_new, g_new, evals
+                if g_new_dot * (hi_t - lo_t) >= 0:
+                    hi_t, hi_f, hi_g = lo_t, lo_f, lo_g
+                lo_t, lo_f, lo_g = t, f_new, g_new_dot
+                lo_vec = g_new
+        if lo_vec is None:   # zoom never accepted a point past t=0
+            _, lo_vec = phi(lo_t)
+            evals += 1
+        return lo_t, lo_f, lo_vec, evals
+
+    # -- reference surface -------------------------------------------------
+
+    def step(self, closure: Callable):
+        from ..nn.layer import raw_params
+
+        if self._owner is not None:
+            params = {k: v for k, v in raw_params(self._owner).items()
+                      if self._names is None or k in self._names}
+        else:
+            raise RuntimeError(
+                "pass parameters=model.parameters() so step() knows what "
+                "to optimize, or use minimize(closure, params)")
+        new_params, loss = self.minimize(closure, params)
+        for k, v in new_params.items():
+            self._owner._assign_by_path(k, v)
+        return loss
+
+    def minimize(self, closure: Callable, params):
+        """Functional form: → (optimized params, final loss)."""
+        from jax.flatten_util import ravel_pytree
+        flat, unravel = ravel_pytree(params)
+        vg = self._value_and_grad(closure)
+        f, g = vg(flat, unravel)
+        f = float(f)
+        evals = 1
+        s_hist, y_hist, rho_hist = [], [], []
+        for it in range(self.max_iter):
+            if float(jnp.max(jnp.abs(g))) <= self.tol_grad:
+                break
+            # two-loop recursion
+            q = g
+            alphas = []
+            for s, y, rho in zip(reversed(s_hist), reversed(y_hist),
+                                 reversed(rho_hist)):
+                a = rho * float(s @ q)
+                alphas.append(a)
+                q = q - a * y
+            if y_hist:
+                gamma = float(s_hist[-1] @ y_hist[-1]) / max(
+                    float(y_hist[-1] @ y_hist[-1]), 1e-20)
+                q = q * gamma
+            for (s, y, rho), a in zip(zip(s_hist, y_hist, rho_hist),
+                                      reversed(alphas)):
+                b = rho * float(y @ q)
+                q = q + (a - b) * s
+            direction = -q
+            g_dot = float(g @ direction)
+            if g_dot > -1e-20:   # not a descent direction: reset history
+                direction = -g
+                g_dot = float(g @ direction)
+                s_hist, y_hist, rho_hist = [], [], []
+            t = self.lr if it > 0 else min(
+                1.0, 1.0 / max(float(jnp.sum(jnp.abs(g))), 1e-20)) * self.lr
+            if self.line_search_fn == "strong_wolfe":
+                # the search returns f and the grad VECTOR at the accepted
+                # point — no re-evaluation needed
+                t, f_new, g_new, used = self._strong_wolfe(
+                    vg, unravel, flat, direction, f, g_dot, t)
+                new_flat = flat + t * direction
+                evals += used
+            else:
+                new_flat = flat + t * direction
+                f2, g_new = vg(new_flat, unravel)
+                f_new = float(f2)
+                evals += 1
+            s = new_flat - flat
+            y = g_new - g
+            sy = float(s @ y)
+            if sy > 1e-10:
+                if len(s_hist) >= self.history_size:
+                    s_hist.pop(0)
+                    y_hist.pop(0)
+                    rho_hist.pop(0)
+                s_hist.append(s)
+                y_hist.append(y)
+                rho_hist.append(1.0 / sy)
+            converged = (abs(f_new - f) < self.tol_change
+                         or float(jnp.max(jnp.abs(s))) < self.tol_change)
+            flat, f, g = new_flat, f_new, g_new
+            if converged or evals >= self.max_eval:
+                break
+        return unravel(flat), f

@@ -266,3 +266,69 @@ class TestRound2Optimizers:
             iterates.append(float(params["w"]))
         np.testing.assert_allclose(float(state["avg"]["w"]),
                                    np.mean(iterates), rtol=1e-6)
+
+
+class TestLBFGS:
+    def test_rosenbrock_converges(self):
+        from paddle_tpu.optimizer import LBFGS
+
+        def rosen(p):
+            x, y = p["x"], p["y"]
+            return (1 - x) ** 2 + 100.0 * (y - x * x) ** 2
+
+        opt = LBFGS(max_iter=80, line_search_fn="strong_wolfe")
+        params, loss = opt.minimize(
+            rosen, {"x": jnp.asarray(-1.2), "y": jnp.asarray(1.0)})
+        assert loss < 1e-7
+        np.testing.assert_allclose(
+            [float(params["x"]), float(params["y"])], [1.0, 1.0], atol=1e-3)
+
+    def test_step_closure_on_model(self):
+        import paddle_tpu as pt
+        from paddle_tpu import nn
+        from paddle_tpu.nn.layer import functional_call
+        from paddle_tpu.optimizer import LBFGS
+
+        pt.seed(0)
+        m = nn.Linear(4, 1)
+        X = jnp.asarray(np.random.default_rng(0).normal(
+            size=(64, 4)).astype(np.float32))
+        Y = X @ jnp.asarray([[1.0], [-2.0], [3.0], [0.5]]) + 0.7
+        opt = LBFGS(max_iter=50, line_search_fn="strong_wolfe",
+                    parameters=m.parameters())
+        loss = opt.step(lambda p: ((functional_call(m, p, X) - Y) ** 2)
+                        .mean())
+        assert loss < 1e-7
+        np.testing.assert_allclose(np.asarray(m.weight)[:, 0],
+                                   [1, -2, 3, 0.5], atol=1e-3)
+        np.testing.assert_allclose(float(m.bias[0]), 0.7, atol=1e-3)
+
+    def test_no_line_search_mode(self):
+        from paddle_tpu.optimizer import LBFGS
+
+        def quad(p):
+            return (p["w"] ** 2).sum()
+
+        opt = LBFGS(learning_rate=0.5, max_iter=30)
+        params, loss = opt.minimize(quad, {"w": jnp.ones(3)})
+        assert loss < 1e-6
+
+    def test_bad_line_search_rejected(self):
+        from paddle_tpu.optimizer import LBFGS
+        with pytest.raises(ValueError, match="strong_wolfe"):
+            LBFGS(line_search_fn="armijo")
+
+    def test_weight_decay_and_signature_compat(self):
+        from paddle_tpu.optimizer import LBFGS
+
+        def quad(p):
+            return ((p["w"] - 2.0) ** 2).sum()
+
+        # reference kwargs accepted; wd pulls the optimum below 2.0
+        opt = LBFGS(max_iter=40, line_search_fn="strong_wolfe",
+                    weight_decay=1.0, name="lbfgs")
+        params, _ = opt.minimize(quad, {"w": jnp.zeros(3)})
+        w = float(params["w"][0])
+        assert 1.2 < w < 1.5   # analytic optimum 2*2/(2+1) = 4/3
+        with pytest.raises(NotImplementedError, match="grad_clip"):
+            LBFGS(grad_clip=object())
