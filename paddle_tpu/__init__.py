@@ -123,3 +123,52 @@ def __dir__():
         "distribution", "geometric", "signal", "regularizer", "callbacks",
         "Model", "DataParallel", "flops", "summary", "version", "metric",
         "enable_static", "disable_static", "in_dynamic_mode"})
+
+
+def tensor(data, dtype=None, place=None, stop_gradient=True):
+    """``paddle.tensor`` alias of ``to_tensor`` (reference accepts both)."""
+    return to_tensor(data, dtype=dtype, place=place,
+                     stop_gradient=stop_gradient)
+
+
+def is_tensor(x) -> bool:
+    import numpy as _np
+    return isinstance(x, (_jax.Array, _np.ndarray))
+
+
+def iinfo(dtype):
+    import jax.numpy as _jnp
+    return _jnp.iinfo(core.convert_dtype(dtype))
+
+
+def finfo(dtype):
+    import jax.numpy as _jnp
+    return _jnp.finfo(core.convert_dtype(dtype))
+
+
+def get_rng_state():
+    """Reference: paddle.get_rng_state — opaque state restorable with
+    set_rng_state (here the (seed, eager-draw counter) pair)."""
+    from .core import random as _r
+    return (_r._GLOBAL_SEED[0], _r._EAGER_COUNTER[0])
+
+
+def set_rng_state(state):
+    from .core import random as _r
+    _r._GLOBAL_SEED[0], _r._EAGER_COUNTER[0] = int(state[0]), int(state[1])
+
+
+def is_grad_enabled() -> bool:
+    return autograd.is_grad_enabled()
+
+
+def set_grad_enabled(mode: bool):
+    return autograd.set_grad_enabled(mode)
+
+
+# the Place CLASSES themselves (isinstance works, like DataParallel above);
+# CUDAPlace/XPUPlace alias the accelerator place — the accelerator is the TPU
+from .device import CPUPlace, TPUPlace  # noqa: F401,E402
+
+CUDAPlace = TPUPlace
+XPUPlace = TPUPlace

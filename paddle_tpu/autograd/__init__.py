@@ -58,11 +58,48 @@ def grad(layer: Layer, loss_fn: Callable = None, has_aux: bool = False):
     return fn
 
 
+_grad_enabled = [True]
+
+
 @contextlib.contextmanager
 def no_grad():
     """API parity: jax only differentiates what you ask it to, so this is a
-    documentation-level marker (kept so reference code ports cleanly)."""
-    yield
+    documentation-level marker (kept so reference code ports cleanly); it
+    still flips the queryable flag for code that branches on it."""
+    prev = _grad_enabled[0]
+    _grad_enabled[0] = False
+    try:
+        yield
+    finally:
+        _grad_enabled[0] = prev
+
+
+def is_grad_enabled() -> bool:
+    """Reference: paddle.is_grad_enabled — the eager-mode flag no_grad/
+    set_grad_enabled toggle (grads themselves are always explicit here)."""
+    return _grad_enabled[0]
+
+
+class _GradMode:
+    """Flips the flag IMMEDIATELY (imperative torch/paddle style) and also
+    works as a context manager that restores the previous mode on exit."""
+
+    def __init__(self, mode: bool):
+        self._prev = _grad_enabled[0]
+        _grad_enabled[0] = bool(mode)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        _grad_enabled[0] = self._prev
+        return False
+
+
+def set_grad_enabled(mode: bool):
+    """Reference: paddle.set_grad_enabled — a plain call takes effect
+    immediately; `with` additionally restores the previous mode."""
+    return _GradMode(mode)
 
 
 class PyLayer:

@@ -233,5 +233,20 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True):
     del stop_gradient
     arr = jnp.asarray(data, dtype=convert_dtype(dtype) if dtype is not None else None)
     if place is not None:
-        arr = jax.device_put(arr, place)
+        arr = jax.device_put(arr, _place_to_device(place))
     return arr
+
+
+def _place_to_device(place):
+    """Map paddle Place objects (CPUPlace/TPUPlace/CUDAPlace aliases) onto
+    jax devices; raw jax devices/shardings pass through."""
+    from ..device import CPUPlace, TPUPlace
+    if isinstance(place, CPUPlace):
+        cpus = [d for d in jax.devices() if d.platform == "cpu"] or \
+            jax.devices("cpu")
+        return cpus[0]
+    if isinstance(place, TPUPlace):
+        accel = [d for d in jax.devices() if d.platform != "cpu"] or \
+            jax.devices()
+        return accel[min(place.idx, len(accel) - 1)]
+    return place

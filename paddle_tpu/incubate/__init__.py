@@ -12,3 +12,5 @@ from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from ..distributed import moe as distributed_moe  # noqa: F401
 from ..distributed.moe import MoELayer  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401 — the
+#   reference exports both at paddle.incubate top level too

@@ -26,7 +26,9 @@ import numpy as np
 
 __all__ = ["Program", "program_guard", "default_main_program",
            "default_startup_program", "data", "InputSpec", "Executor",
-           "CompiledProgram", "Var", "apply", "nn"]
+           "CompiledProgram", "Var", "apply", "nn",
+           "gradients", "append_backward", "Scope", "global_scope",
+           "scope_guard", "save_inference_model", "load_inference_model"]
 
 
 class Var:
@@ -124,24 +126,7 @@ class Program:
         if fn is None:
             def run_graph(*feed_vals):
                 env = dict(zip(feed_names, feed_vals))
-
-                def ev(node):
-                    if isinstance(node, Var):
-                        if node.name in env:
-                            return env[node.name]
-                        if node.op is None:
-                            raise KeyError(
-                                f"placeholder {node.name!r} not fed")
-                        f, args, kwargs = node.op
-                        val = f(*[ev(a) for a in args],
-                                **{k: ev(v) for k, v in kwargs.items()})
-                        env[node.name] = val
-                        return val
-                    if isinstance(node, (list, tuple)):
-                        return type(node)(ev(x) for x in node)
-                    return node
-
-                return tuple(ev(v) for v in fetch)
+                return tuple(_eval_var(v, env) for v in fetch)
 
             fn = jax.jit(run_graph)
             self._cache[sig] = fn
@@ -282,3 +267,169 @@ def in_static_mode() -> bool:
 
 def in_dynamic_mode() -> bool:
     return not _static_mode[0]
+
+
+# -- static autodiff (reference: paddle.static.gradients / append_backward
+# over the Program; here jax.grad of the recorded Var DAG) ------------------
+
+def _eval_var(node, env):
+    """THE evaluator over the recorded op DAG — used by Program._eval,
+    gradients() closures and save_inference_model (one copy to fix)."""
+    if isinstance(node, Var):
+        if node.name in env:
+            return env[node.name]
+        if node.op is None:
+            raise KeyError(f"placeholder {node.name!r} not fed")
+        f, args, kwargs = node.op
+        val = f(*[_eval_var(a, env) for a in args],
+                **{k: _eval_var(v, env) for k, v in kwargs.items()})
+        env[node.name] = val
+        return val
+    if isinstance(node, (list, tuple)):
+        return type(node)(_eval_var(x, env) for x in node)
+    return node
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """d(sum(targets))/d(input) as new graph Vars (reference:
+    paddle.static.gradients — there a backward-op pass over the Program;
+    here jax.grad of the DAG evaluation, compiled with the rest of the
+    program)."""
+    targets = [targets] if isinstance(targets, Var) else list(targets)
+    inputs = [inputs] if isinstance(inputs, Var) else list(inputs)
+    if target_gradients is not None:
+        raise NotImplementedError(
+            "target_gradients: seed the cotangent by scaling the target "
+            "instead")
+    prog = targets[0].program
+    datas = tuple(prog._datas)
+
+    def make_grad(inp):
+        def grad_op(*data_vals):
+            base = {d.name: v for d, v in zip(datas, data_vals)}
+            # linearization point: feed value for placeholders, else the
+            # intermediate's current value (differentiating w.r.t. an
+            # intermediate treats it as an independent leaf — reference
+            # gradients() supports both)
+            x0 = base[inp.name] if inp.name in base else \
+                _eval_var(inp, dict(base))
+
+            def scalar_of(x):
+                env = dict(base)
+                env[inp.name] = x
+                total = 0.0
+                for t in targets:
+                    total = total + jnp.sum(_eval_var(t, dict(env)))
+                return total
+
+            return jax.grad(scalar_of)(x0)
+        grad_op.__name__ = f"grad_{inp.name}"
+        return grad_op
+
+    return [apply(make_grad(i), *datas) for i in inputs]
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    """Reference: paddle.static.append_backward → [(var, grad_var)].
+    The facade's differentiable leaves are the program's data placeholders
+    (parameters live eagerly on Layers in this design — documented
+    deviation; use jit.TrainStep for parameter training)."""
+    prog = loss.program
+    leaves = parameter_list if parameter_list is not None else \
+        list(prog._datas)
+    grads = gradients(loss, leaves, no_grad_set=no_grad_set)
+    return list(zip(leaves, grads))
+
+
+# -- scopes (reference: paddle.static.global_scope/scope_guard over the C++
+# Scope tree; a plain name→value mapping here) ------------------------------
+
+class Scope:
+    def __init__(self):
+        self._vars: Dict[str, Any] = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, None)
+
+    def set_var(self, name, value):
+        self._vars[name] = value
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+_scope_stack: List[Scope] = [Scope()]
+
+
+def global_scope() -> Scope:
+    return _scope_stack[-1]
+
+
+class scope_guard:
+    def __init__(self, scope: Scope):
+        self.scope = scope
+
+    def __enter__(self):
+        _scope_stack.append(self.scope)
+        return self.scope
+
+    def __exit__(self, *exc):
+        _scope_stack.pop()
+        return False
+
+
+# -- inference model save/load (reference: paddle.static.save/
+# load_inference_model → __model__ + params; here a StableHLO AOT artifact
+# via jit.save) -------------------------------------------------------------
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor,
+                         **kwargs):
+    from .. import jit as _jit
+    feed_vars = [feed_vars] if isinstance(feed_vars, Var) else list(feed_vars)
+    fetch_vars = [fetch_vars] if isinstance(fetch_vars, Var) \
+        else list(fetch_vars)
+    for v in feed_vars:
+        if any(d in (None, -1) for d in v.shape):
+            raise ValueError(
+                f"feed var {v.name!r} has dynamic dims {v.shape}; AOT "
+                "export needs static shapes")
+    names = [v.name for v in feed_vars]
+
+    def fn(*feed_vals):
+        env = dict(zip(names, feed_vals))
+        return tuple(_eval_var(v, env) for v in fetch_vars)
+
+    from ..core import convert_dtype
+    examples = [jnp.zeros(tuple(v.shape), convert_dtype(v.dtype))
+                for v in feed_vars]
+    _jit.save(fn, path_prefix, *examples)
+    import json
+    with open(path_prefix + ".feeds.json", "w") as f:
+        json.dump({"feed_names": names,
+                   "n_fetch": len(fetch_vars)}, f)
+
+
+class _LoadedInference:
+    """Program stand-in returned by load_inference_model; Executor.run
+    works on it with the returned fetch targets."""
+
+    def __init__(self, fn, feed_names, n_fetch):
+        self._fn = fn
+        self.feed_names = feed_names
+        self.n_fetch = n_fetch
+
+    def _eval(self, fetch, feed):
+        outs = self._fn(*[jnp.asarray(feed[n]) for n in self.feed_names])
+        return tuple(outs[i] for i in fetch)
+
+
+def load_inference_model(path_prefix: str, executor, **kwargs):
+    """→ [program, feed_target_names, fetch_targets] (reference shape)."""
+    import json
+
+    from .. import jit as _jit
+    fn = _jit.load(path_prefix)
+    with open(path_prefix + ".feeds.json") as f:
+        meta = json.load(f)
+    prog = _LoadedInference(fn, meta["feed_names"], meta["n_fetch"])
+    return [prog, list(meta["feed_names"]), list(range(meta["n_fetch"]))]

@@ -409,3 +409,106 @@ class TestVisionModelTail:
         from paddle_tpu.vision.models import ResNet
         with pytest.raises(ValueError, match="bottleneck"):
             ResNet(18, groups=32, width_per_group=4)
+
+
+class TestTopLevelParityRound2:
+    def test_places_and_tensor_aliases(self):
+        import jax.numpy as jnp
+        assert repr(pt.CPUPlace()) == "CPUPlace()"
+        assert "Place(0)" in repr(pt.CUDAPlace(0))   # accelerator = TPU
+        t = pt.tensor([1.0, 2.0])
+        assert pt.is_tensor(t) and not pt.is_tensor("x")
+        assert pt.iinfo("int32").max == 2**31 - 1
+        assert pt.finfo("float32").eps > 0
+
+    def test_rng_state_roundtrip(self):
+        pt.seed(7)
+        _ = pt.randn([3])
+        state = pt.get_rng_state()
+        a = np.asarray(pt.randn([4]))
+        pt.set_rng_state(state)
+        b = np.asarray(pt.randn([4]))
+        np.testing.assert_array_equal(a, b)
+
+    def test_grad_enabled_flag(self):
+        assert pt.is_grad_enabled()
+        with pt.no_grad():
+            assert not pt.is_grad_enabled()
+        with pt.set_grad_enabled(False):
+            assert not pt.is_grad_enabled()
+        assert pt.is_grad_enabled()
+
+    def test_incubate_top_level(self):
+        from paddle_tpu import incubate
+        assert hasattr(incubate, "LookAhead")
+        assert hasattr(incubate, "ModelAverage")
+
+
+class TestStaticRound2:
+    def test_gradients_and_append_backward(self):
+        from paddle_tpu import static
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = prog.data("x", (3,), "float32")
+            y = prog.data("y", (3,), "float32")
+            z = (x * y + x.exp()).sum()
+            gx, gy = static.gradients(z, [x, y])
+        exe = static.Executor()
+        xv = np.array([0.1, 0.2, 0.3], np.float32)
+        yv = np.array([1.0, 2.0, 3.0], np.float32)
+        _, g1, g2 = exe.run(prog, feed={"x": xv, "y": yv},
+                            fetch_list=[z, gx, gy])
+        np.testing.assert_allclose(g1, yv + np.exp(xv), rtol=1e-5)
+        np.testing.assert_allclose(g2, xv, rtol=1e-6)
+        pairs = static.append_backward(z)
+        assert [v.name for v, _ in pairs] == ["x", "y"]
+
+    def test_scope_guard(self):
+        from paddle_tpu import static
+        sc = static.Scope()
+        with static.scope_guard(sc):
+            static.global_scope().set_var("a", 1)
+            assert static.global_scope().find_var("a") == 1
+        assert static.global_scope().find_var("a") is None
+
+    def test_save_load_inference_model(self, tmp_path):
+        from paddle_tpu import static
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = prog.data("x", (4,), "float32")
+            out = (x * 2.0 + 1.0).sum()
+        exe = static.Executor()
+        path = str(tmp_path / "inf")
+        static.save_inference_model(path, [x], [out], exe)
+        prog2, feeds, fetches = static.load_inference_model(path, exe)
+        xv = np.arange(4, dtype=np.float32)
+        ref = exe.run(prog, feed={"x": xv}, fetch_list=[out])
+        got = exe.run(prog2, feed={"x": xv}, fetch_list=fetches)
+        np.testing.assert_allclose(got[0], ref[0], rtol=1e-6)
+
+    def test_gradients_wrt_intermediate(self):
+        from paddle_tpu import static
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = prog.data("x", (3,), "float32")
+            h = x * 2.0
+            z = (h * h).sum()
+            (gh,) = static.gradients(z, [h])
+        exe = static.Executor()
+        xv = np.array([1.0, 2.0, 3.0], np.float32)
+        (g,) = exe.run(prog, feed={"x": xv}, fetch_list=[gh])
+        np.testing.assert_allclose(g, 2 * (2 * xv), rtol=1e-6)  # dz/dh = 2h
+
+    def test_set_grad_enabled_imperative(self):
+        pt.set_grad_enabled(False)
+        assert not pt.is_grad_enabled()
+        pt.set_grad_enabled(True)
+        assert pt.is_grad_enabled()
+
+    def test_place_isinstance_and_to_tensor_bridge(self):
+        t = pt.to_tensor([1.0, 2.0], place=pt.CPUPlace())
+        assert pt.is_tensor(t)
+        assert isinstance(pt.CUDAPlace(0), pt.CUDAPlace)
+        assert isinstance(pt.CPUPlace(), pt.CPUPlace)
+        t2 = pt.tensor([3.0], place=pt.CUDAPlace(0))
+        assert pt.is_tensor(t2)
