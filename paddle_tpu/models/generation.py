@@ -104,15 +104,22 @@ class CachedGenerationMixin:
 
     def _decode_loop_fn(self, n_steps: int, temperature: float,
                         top_k: int = 0, top_p: float = 1.0,
-                        repetition_penalty: float = 1.0):
+                        repetition_penalty: float = 1.0,
+                        eos_token_id=None, pad_token_id=None):
         """Whole decode loop as ONE compiled program (lax.scan). Single-slot
         memo: varying max_new_tokens/temperature/strategy must not
-        accumulate one XLA executable per combination."""
+        accumulate one XLA executable per combination.
+
+        EOS semantics (reference generate): a row that has emitted
+        ``eos_token_id`` keeps emitting ``pad_token_id`` (default: the eos
+        id) — the scan stays fixed-length, finished rows are frozen."""
         cached_key, fn = self.__dict__.get("_decode_loop_memo", (None, None))
-        key = (n_steps, temperature, top_k, top_p, repetition_penalty)
+        key = (n_steps, temperature, top_k, top_p, repetition_penalty,
+               eos_token_id, pad_token_id)
         if cached_key != key:
             fn = None
         track_seen = repetition_penalty != 1.0
+        pad = pad_token_id if pad_token_id is not None else eos_token_id
         if fn is None:
             from ..nn.layer import _swapped_params, functional_call
 
@@ -133,18 +140,23 @@ class CachedGenerationMixin:
                     nxt = jnp.argmax(lg, axis=-1)
                 return nxt.astype(tok.dtype), caches
 
-            def loop(params, tok0, caches, lens0, rng, seen0):
+            def loop(params, tok0, caches, lens0, rng, seen0, done0):
                 def body(carry, i):
-                    tok, caches, lens, seen = carry
+                    tok, caches, lens, seen, done = carry
                     nxt, caches = one_step(params, tok, caches, lens, rng,
                                            i, seen)
+                    if eos_token_id is not None:
+                        nxt = jnp.where(done, jnp.asarray(pad, nxt.dtype),
+                                        nxt)
+                        done = done | (nxt == eos_token_id)
                     if track_seen:
                         seen = seen.at[jnp.arange(seen.shape[0]),
                                        nxt].add(1)
-                    return (nxt, caches, lens + 1, seen), nxt
+                    return (nxt, caches, lens + 1, seen, done), nxt
 
-                (_, caches, _, _), toks = jax.lax.scan(
-                    body, (tok0, caches, lens0, seen0), jnp.arange(n_steps))
+                (_, caches, _, _, _), toks = jax.lax.scan(
+                    body, (tok0, caches, lens0, seen0, done0),
+                    jnp.arange(n_steps))
                 return jnp.swapaxes(toks, 0, 1), caches   # (b, n_steps)
 
             fn = jax.jit(loop, donate_argnums=(2,))
@@ -153,27 +165,30 @@ class CachedGenerationMixin:
 
     def _beam_loop_fn(self, n_steps: int, num_beams: int,
                       temperature: float = 0.0,
-                      repetition_penalty: float = 1.0):
+                      repetition_penalty: float = 1.0,
+                      eos_token_id=None, pad_token_id=None):
         """Whole beam-search decode as ONE compiled lax.scan (reference:
         generation BeamSearchDecoder). Beams ride the batch dim (b·nb);
         each step reorders caches, histories and penalty counts by the
         surviving beams' parent indices. Fixed length — no EOS early-exit
         (XLA static shapes; the reference pads to max length too)."""
         cached_key, fn = self.__dict__.get("_beam_loop_memo", (None, None))
-        key = (n_steps, num_beams, temperature, repetition_penalty)
+        key = (n_steps, num_beams, temperature, repetition_penalty,
+               eos_token_id, pad_token_id)
         if cached_key != key:
             fn = None
+        pad = pad_token_id if pad_token_id is not None else eos_token_id
         if fn is None:
             from ..nn.layer import _swapped_params, functional_call
             nb = num_beams
 
-            def loop(params, tok0, caches, lens0, scores0, seen0):
+            def loop(params, tok0, caches, lens0, scores0, seen0, done0):
                 b = scores0.shape[0]
                 hist0 = jnp.zeros((b, nb, n_steps + 1), tok0.dtype)
                 hist0 = hist0.at[:, :, 0].set(tok0.reshape(b, nb))
 
                 def body(carry, i):
-                    tok, caches, lens, scores, hist, seen = carry
+                    tok, caches, lens, scores, hist, seen, done = carry
                     mp = {k[len("model."):]: v for k, v in params.items()
                           if k.startswith("model.")}
                     hidden, caches = functional_call(
@@ -187,6 +202,13 @@ class CachedGenerationMixin:
                         temperature=temperature if temperature > 0 else 1.0)
                     logp = jax.nn.log_softmax(lg)
                     vocab = logp.shape[-1]
+                    if eos_token_id is not None:
+                        # frozen beams extend only by pad at zero cost, so
+                        # they compete in top-k by their FINAL score
+                        pad_row = jnp.full((vocab,), -jnp.inf,
+                                           logp.dtype).at[pad].set(0.0)
+                        logp = jnp.where(done[:, None], pad_row[None],
+                                         logp)
                     total = scores[:, :, None] + logp.reshape(b, nb, vocab)
                     top_v, top_i = jax.lax.top_k(
                         total.reshape(b, nb * vocab), nb)
@@ -200,11 +222,15 @@ class CachedGenerationMixin:
                     if repetition_penalty != 1.0:
                         seen = seen[flat_parent].at[
                             jnp.arange(b * nb), nxt.reshape(-1)].add(1)
+                    if eos_token_id is not None:
+                        done = done[flat_parent] | \
+                            (nxt.reshape(-1) == eos_token_id)
                     return (nxt.reshape(-1), caches, lens + 1, top_v,
-                            hist, seen), None
+                            hist, seen, done), None
 
-                (tokN, caches, _, scores, hist, _), _ = jax.lax.scan(
-                    body, (tok0, caches, lens0, scores0, hist0, seen0),
+                (tokN, caches, _, scores, hist, _, _), _ = jax.lax.scan(
+                    body,
+                    (tok0, caches, lens0, scores0, hist0, seen0, done0),
                     jnp.arange(n_steps))
                 return hist, scores
 
@@ -234,7 +260,8 @@ class CachedGenerationMixin:
         return prefill
 
     def _beam_search(self, input_ids, max_new_tokens, num_beams, total,
-                     temperature=0.0, repetition_penalty=1.0):
+                     temperature=0.0, repetition_penalty=1.0,
+                     eos_token_id=None, pad_token_id=None):
         from ..nn.layer import raw_params
         b, prompt_len = input_ids.shape
         nb = num_beams
@@ -272,9 +299,13 @@ class CachedGenerationMixin:
                 [input_ids, picked.astype(input_ids.dtype)], axis=1)
         loop = self._beam_loop_fn(max_new_tokens - 1, nb,
                                   float(temperature),
-                                  float(repetition_penalty))
+                                  float(repetition_penalty),
+                                  eos_token_id, pad_token_id)
         lens = jnp.full((b * nb,), prompt_len, jnp.int32)
-        hist, scores = loop(params, tok0, caches, lens, scores, seen)
+        done0 = (tok0 == eos_token_id) if eos_token_id is not None else \
+            jnp.zeros((b * nb,), bool)
+        hist, scores = loop(params, tok0, caches, lens, scores, seen,
+                            done0)
         best = jnp.argmax(scores, axis=1)                 # (b,)
         toks = hist[jnp.arange(b), best]                  # (b, n_steps+1)
         return jnp.concatenate([input_ids, toks.astype(input_ids.dtype)],
@@ -283,7 +314,7 @@ class CachedGenerationMixin:
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
                  use_cache=True, max_len=None, top_k=0, top_p=1.0,
                  repetition_penalty=1.0, decode_strategy=None,
-                 num_beams=1):
+                 num_beams=1, eos_token_id=None, pad_token_id=None):
         """Autoregressive generation. ``use_cache=True`` (default) prefills
         the dense KV caches once, then runs the WHOLE decode loop as one
         compiled ``lax.scan`` (one dispatch per call). ``use_cache=False``
@@ -297,7 +328,15 @@ class CachedGenerationMixin:
         generate() semantics (TopKProcess/TopPProcess; penalty counts the
         prompt too). ``decode_strategy`` is the reference's name for the
         mode: "greedy_search" forces temperature 0, "sampling" requires
-        temperature > 0."""
+        temperature > 0; "beam_search" (or num_beams > 1) runs the
+        compiled beam decoder.
+
+        ``eos_token_id``: a row that emits it keeps emitting
+        ``pad_token_id`` (default: the eos id) for the remaining steps —
+        output length stays fixed (XLA static shapes; the reference pads
+        batch generation to max length the same way). In beam search a
+        finished beam is frozen: it extends only by pad at zero cost, so
+        it competes in the final ranking by its score at EOS."""
         if decode_strategy not in (None, "greedy_search", "sampling",
                                    "beam_search"):
             raise ValueError(
@@ -333,7 +372,8 @@ class CachedGenerationMixin:
             if max_new_tokens <= 0:
                 return input_ids
             return self._beam_search(input_ids, max_new_tokens, num_beams,
-                                     total, temperature, repetition_penalty)
+                                     total, temperature, repetition_penalty,
+                                     eos_token_id, pad_token_id)
         if decode_strategy == "greedy_search":
             temperature = 0.0
         elif decode_strategy == "sampling" and temperature <= 0:
@@ -342,6 +382,7 @@ class CachedGenerationMixin:
             return input_ids
         vocab = getattr(self.cfg, "vocab_size", None)
         track_seen = repetition_penalty != 1.0 and vocab is not None
+        pad_id = pad_token_id if pad_token_id is not None else eos_token_id
         if not (use_cache and self._cache_supported()):
             ids = input_ids
             # counts built once from the prompt, then updated per token
@@ -349,10 +390,15 @@ class CachedGenerationMixin:
             # O(steps·b·vocab))
             seen = _seen_counts(ids, vocab) if track_seen else None
             bidx = jnp.arange(ids.shape[0])
+            done = jnp.zeros((ids.shape[0],), bool)
             for _ in range(max_new_tokens):
                 logits = self(ids)[:, -1]
                 nxt = self._sample(logits, temperature, top_k, top_p,
                                    repetition_penalty, seen)
+                if eos_token_id is not None:
+                    nxt = jnp.where(done, jnp.asarray(pad_id, nxt.dtype),
+                                    nxt)
+                    done = done | (nxt == eos_token_id)
                 if seen is not None:
                     seen = seen.at[bidx, nxt].add(1)
                 ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
@@ -375,12 +421,15 @@ class CachedGenerationMixin:
             jax.random.key(0)
         loop = self._decode_loop_fn(max_new_tokens - 1, float(temperature),
                                     int(top_k), float(top_p),
-                                    float(repetition_penalty))
+                                    float(repetition_penalty),
+                                    eos_token_id, pad_token_id)
         lens = jnp.full((b,), prompt_len, jnp.int32)
         if seen is not None:
             seen = seen.at[jnp.arange(b), tok].add(1)
         else:
             # fixed carry structure: a 1-wide dummy when penalty is off
             seen = jnp.zeros((b, 1), jnp.int32)
-        toks, _ = loop(params, tok, caches, lens, rng, seen)
+        done = (tok == eos_token_id) if eos_token_id is not None else \
+            jnp.zeros((b,), bool)
+        toks, _ = loop(params, tok, caches, lens, rng, seen, done)
         return jnp.concatenate([input_ids, tok[:, None], toks], axis=1)

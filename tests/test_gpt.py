@@ -368,3 +368,64 @@ def test_num_beams_alone_triggers_beam_search():
     explicit = np.asarray(m.generate(ids, max_new_tokens=4, num_beams=3,
                                      decode_strategy="beam_search"))
     np.testing.assert_array_equal(implicit, explicit)
+
+
+class TestEosGeneration:
+    """eos_token_id semantics (reference generate): a finished row pads to
+    the fixed length; cached and uncached paths agree under greedy."""
+
+    def _model(self):
+        from paddle_tpu.models.llama import llama
+        pt.seed(0)
+        return llama("tiny").eval()
+
+    def _eos_of(self, m, ids):
+        # pick the model's own first greedy token as "eos" so it triggers
+        out = m.generate(ids, max_new_tokens=1)
+        return int(np.asarray(out)[0, -1])
+
+    def test_pad_after_eos_both_paths(self):
+        m = self._model()
+        ids = jnp.asarray(np.random.default_rng(0).integers(
+            0, 256, size=(1, 6)))
+        eos = self._eos_of(m, ids)
+        a = np.asarray(m.generate(ids, max_new_tokens=6, eos_token_id=eos,
+                                  pad_token_id=0, use_cache=True))
+        b = np.asarray(m.generate(ids, max_new_tokens=6, eos_token_id=eos,
+                                  pad_token_id=0, use_cache=False))
+        np.testing.assert_array_equal(a, b)
+        # first new token IS eos here → everything after is pad
+        assert a[0, 6] == eos and (a[0, 7:] == 0).all()
+
+    def test_pad_defaults_to_eos(self):
+        m = self._model()
+        ids = jnp.asarray(np.random.default_rng(0).integers(
+            0, 256, size=(1, 6)))
+        eos = self._eos_of(m, ids)
+        out = np.asarray(m.generate(ids, max_new_tokens=5,
+                                    eos_token_id=eos))
+        assert (out[0, 6:] == eos).all()
+
+    def test_beam_freezes_finished(self):
+        m = self._model()
+        ids = jnp.asarray(np.random.default_rng(1).integers(
+            0, 256, size=(1, 6)))
+        # greedy continuation's token as eos: the top beam finishes at
+        # step 1 and must pad from then on
+        eos = self._eos_of(m, ids)
+        out = np.asarray(m.generate(ids, max_new_tokens=5, num_beams=3,
+                                    eos_token_id=eos, pad_token_id=0))
+        row = out[0, 6:]
+        # the frozen top beam's constant score keeps it winning: eos MUST
+        # appear, and everything after it is pad
+        assert eos in row, row
+        i = list(row).index(eos)
+        assert (row[i + 1:] == 0).all()
+
+    def test_no_eos_unchanged(self):
+        m = self._model()
+        ids = jnp.asarray(np.random.default_rng(2).integers(
+            0, 256, size=(2, 5)))
+        a = np.asarray(m.generate(ids, max_new_tokens=4))
+        b = np.asarray(m.generate(ids, max_new_tokens=4, eos_token_id=None))
+        np.testing.assert_array_equal(a, b)
