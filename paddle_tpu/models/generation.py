@@ -114,12 +114,14 @@ class CachedGenerationMixin:
         ``eos_token_id`` keeps emitting ``pad_token_id`` (default: the eos
         id) — the scan stays fixed-length, finished rows are frozen."""
         cached_key, fn = self.__dict__.get("_decode_loop_memo", (None, None))
-        key = (n_steps, temperature, top_k, top_p, repetition_penalty,
-               eos_token_id, pad_token_id)
-        if cached_key != key:
-            fn = None
         track_seen = repetition_penalty != 1.0
         pad = pad_token_id if pad_token_id is not None else eos_token_id
+        # key on the RESOLVED pad: pad_token_id=None vs pad==eos trace the
+        # same program and must share the memo slot
+        key = (n_steps, temperature, top_k, top_p, repetition_penalty,
+               eos_token_id, pad)
+        if cached_key != key:
+            fn = None
         if fn is None:
             from ..nn.layer import _swapped_params, functional_call
 
@@ -173,11 +175,11 @@ class CachedGenerationMixin:
         surviving beams' parent indices. Fixed length — no EOS early-exit
         (XLA static shapes; the reference pads to max length too)."""
         cached_key, fn = self.__dict__.get("_beam_loop_memo", (None, None))
+        pad = pad_token_id if pad_token_id is not None else eos_token_id
         key = (n_steps, num_beams, temperature, repetition_penalty,
-               eos_token_id, pad_token_id)
+               eos_token_id, pad)
         if cached_key != key:
             fn = None
-        pad = pad_token_id if pad_token_id is not None else eos_token_id
         if fn is None:
             from ..nn.layer import _swapped_params, functional_call
             nb = num_beams
