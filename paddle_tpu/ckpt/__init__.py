@@ -342,10 +342,13 @@ def load_state_dict(path: str, template: Any = None,
             if shard is None and isinstance(like, jax.Array) and hasattr(like, "sharding"):
                 shard = like.sharding
             if prng_impl is not None:
-                # typed PRNG key: stored as raw uint32 key data; re-wrap
+                # typed PRNG key: stored as raw uint32 key data; place the
+                # raw data on the target sharding FIRST (device_put rejects
+                # typed key arrays on multi-process shardings), then re-wrap
                 data = r.read(tuple(slice(0, d) for d in r.shape))
-                restored = jax.random.wrap_key_data(jnp.asarray(data), impl=prng_impl)
-                return jax.device_put(restored, shard) if shard is not None else restored
+                gdata = (jax.device_put(jnp.asarray(data), shard)
+                         if shard is not None else jnp.asarray(data))
+                return jax.random.wrap_key_data(gdata, impl=prng_impl)
             if shard is not None:
                 return jax.make_array_from_callback(r.shape, shard, r.read)
             return r.read(tuple(slice(0, d) for d in r.shape))

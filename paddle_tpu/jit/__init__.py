@@ -286,6 +286,18 @@ class TrainStep:
                     k: jax.device_put(v, _named(self.mesh, gspecs[k]))
                     for k, v in state["acc_grads"].items()}
             state["step"] = jax.device_put(state["step"], _named(self.mesh, P()))
+            # the rng key must be a mesh-replicated global array too —
+            # otherwise a checkpoint-restored key stays committed to one
+            # device and conflicts with the mesh-sharded state under jit.
+            # device_put rejects typed key arrays on multi-process
+            # shardings, so replicate the raw key_data and re-wrap.
+            # (rng-less states — e.g. params/opt-only dicts fed through
+            # Engine.load — pass through untouched)
+            if "rng" in state:
+                impl = str(jax.random.key_impl(state["rng"]))
+                key_data = jax.device_put(jax.random.key_data(state["rng"]),
+                                          _named(self.mesh, P()))
+                state["rng"] = jax.random.wrap_key_data(key_data, impl=impl)
         return state
 
     # -- the step ----------------------------------------------------------

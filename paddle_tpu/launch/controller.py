@@ -29,7 +29,9 @@ class CollectiveController:
     def _build_pod(self, master: Master, node_rank: int,
                    hosts: list) -> Pod:
         ctx = self.ctx
-        world = ctx.world_size
+        # world size follows the FROZEN membership (elastic shrink may have
+        # settled below ctx.nnodes), not the CLI maximum
+        world = len(hosts) * ctx.nproc_per_node
         # one coordination endpoint for jax.distributed.initialize: port on
         # the store host, stable across the generation
         coord_host = master.store.endpoint.rsplit(":", 1)[0]
@@ -52,43 +54,92 @@ class CollectiveController:
     def run(self) -> int:
         ctx = self.ctx
         restarts = 0
-        while True:
-            master = Master(ctx, generation=self.generation)
-            node_rank, hosts = master.rendezvous()
-            pod = self._build_pod(master, node_rank, hosts)
-            elastic = None
-            if ctx.elastic_level > 0 and ctx.nnodes > 1:
-                elastic = ElasticManager(master.store, ctx.job_id, node_rank,
-                                         ctx.nnodes, ctx.elastic_timeout)
-                elastic.start()
+        # ONE master/store for the controller's lifetime: the shared round
+        # counter (below) and cross-generation rendezvous state must survive
+        # generation changes, so the store cannot be torn down per attempt
+        master = Master(ctx, generation=self.generation)
+        round_key = f"job/{ctx.job_id}/round"
+        master.store.compare_set(round_key, b"", b"0")
+        self.generation = int(master.store.get(round_key))
+        try:
+            while True:
+                master.generation = self.generation
+                try:
+                    node_rank, hosts = master.rendezvous()
+                except TimeoutError as e:
+                    # frozen out of this round (joined late) or quorum never
+                    # formed; in elastic mode wait for the round to advance
+                    # and try again rather than crashing the node
+                    if ctx.elastic_level > 0 and restarts < ctx.max_restarts:
+                        restarts += 1
+                        logger.warning(
+                            "rendezvous at round %d failed (%s); waiting "
+                            "for the next round", self.generation, e)
+                        self.generation = self._await_round_change(
+                            master.store, round_key, self.generation)
+                        continue
+                    raise
+                pod = self._build_pod(master, node_rank, hosts)
+                elastic = None
+                if ctx.elastic_level > 0 and len(hosts) > 1:
+                    elastic = ElasticManager(master.store, ctx.job_id,
+                                             node_rank, len(hosts),
+                                             ctx.elastic_timeout,
+                                             generation=self.generation)
+                    elastic.start()
 
-            stop_requested = {"flag": False}
+                stop_requested = {"flag": False}
 
-            def _on_term(signum, frame):
-                stop_requested["flag"] = True
-                pod.stop(grace=15.0)
+                def _on_term(signum, frame):
+                    stop_requested["flag"] = True
+                    pod.stop(grace=15.0)
 
-            prev = signal.signal(signal.SIGTERM, _on_term)
-            try:
-                pod.deploy()
-                code = self._watch(pod, elastic, stop_requested)
-            finally:
-                signal.signal(signal.SIGTERM, prev)
-                if elastic is not None:
-                    elastic.stop()
-                pod.stop()
-                master.close()
+                prev = signal.signal(signal.SIGTERM, _on_term)
+                try:
+                    pod.deploy()
+                    code = self._watch(pod, elastic, stop_requested)
+                finally:
+                    signal.signal(signal.SIGTERM, prev)
+                    if elastic is not None:
+                        elastic.stop()
+                    pod.stop()
 
-            if code == 0 or stop_requested["flag"]:
-                return 0 if stop_requested["flag"] else code
-            if ctx.elastic_level > 0 and restarts < ctx.max_restarts:
-                restarts += 1
-                self.generation += 1
-                logger.warning("job failed (code %s); elastic restart %d/%d",
-                               code, restarts, ctx.max_restarts)
-                time.sleep(1.0)
-                continue
-            return code
+                if code == 0 or stop_requested["flag"]:
+                    return 0 if stop_requested["flag"] else code
+                if ctx.elastic_level > 0 and restarts < ctx.max_restarts:
+                    restarts += 1
+                    # advance the SHARED round via CAS: only the first
+                    # failing node increments; every other node's CAS loses
+                    # and it adopts the stored value, so divergent local
+                    # restart counts cannot split the job into disjoint
+                    # rendezvous namespaces
+                    g = self.generation
+                    master.store.compare_set(round_key, str(g).encode(),
+                                             str(g + 1).encode())
+                    self.generation = int(master.store.get(round_key))
+                    logger.warning(
+                        "job failed (code %s); elastic restart %d/%d at "
+                        "round %d", code, restarts, ctx.max_restarts,
+                        self.generation)
+                    time.sleep(1.0)
+                    continue
+                return code
+        finally:
+            master.close()
+
+    @staticmethod
+    def _await_round_change(store, round_key: str, current: int,
+                            poll: float = 0.5) -> int:
+        deadline = time.monotonic() + store.timeout
+        while time.monotonic() < deadline:
+            raw = store.get(round_key)
+            if raw is not None and int(raw) != current:
+                return int(raw)
+            time.sleep(poll)
+        raise TimeoutError(
+            f"round never advanced past {current}; the active cluster is "
+            "running without this node (scale-up rejoin requires the next "
+            "membership change)")
 
     def _watch(self, pod: Pod, elastic, stop_requested) -> int:
         """Poll containers (and, in elastic mode, peer heartbeats)."""

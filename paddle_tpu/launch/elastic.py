@@ -22,19 +22,22 @@ class ElasticManager:
 
     def __init__(self, store: TCPStore, job_id: str, node_rank: int,
                  nnodes: int, timeout: float = 30.0,
-                 heartbeat_period: float = 2.0):
+                 heartbeat_period: float = 2.0, generation: int = 0):
         self.store = store
         self.job_id = job_id
         self.node_rank = node_rank
         self.nnodes = nnodes
         self.timeout = timeout
         self.heartbeat_period = heartbeat_period
+        self.generation = generation
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._started_at: Optional[float] = None
 
     def _key(self, rank: int) -> str:
-        return f"elastic/{self.job_id}/hb/{rank}"
+        # generation-scoped: a relaunched (possibly shrunk) cluster must not
+        # read the dead generation's stale heartbeats
+        return f"elastic/{self.job_id}/gen{self.generation}/hb/{rank}"
 
     def start(self) -> None:
         self._started_at = time.time()

@@ -40,30 +40,60 @@ class Master:
         return f"job/{self.ctx.job_id}/gen{self.generation}/{name}"
 
     def rendezvous(self) -> Tuple[int, List[str]]:
-        """Register this node, wait for everyone, return
-        (node_rank, all-node host list in rank order)."""
+        """Register this node, wait for membership, return
+        (effective node rank, all-node host list in rank order).
+
+        With an elastic range (``--nnodes MIN:MAX``) the first joiner acts as
+        the decider: it freezes membership as soon as MAX nodes joined, or —
+        once the settle window (= elastic_timeout) closes — with any quorum
+        of >= MIN nodes (reference: fleet elastic manager's etcd membership
+        scaling, python/paddle/distributed/fleet/elastic/manager.py).  The
+        frozen list is what every node derives its rank and world size from,
+        so a shrink-after-failure relaunch converges on a consistent,
+        smaller cluster instead of waiting for the dead node."""
         ctx = self.ctx
         if ctx.nnodes == 1:
             return 0, [ctx.host]
         seq = self.store.add(self._key("joined"), 1) - 1
         node_rank = ctx.rank if ctx.rank >= 0 else seq
-        info = json.dumps({"host": ctx.host, "nproc": ctx.nproc_per_node})
+        info = json.dumps({"host": ctx.host, "nproc": ctx.nproc_per_node,
+                           "rank": node_rank})
         self.store.set(self._key(f"node/{node_rank}"), info.encode())
-        # wait for full membership
-        deadline = time.monotonic() + self.store.timeout
-        while True:
-            nodes = self.store.keys(self._key("node/"))
-            if len(nodes) >= ctx.nnodes:
-                break
-            if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"rendezvous: {len(nodes)}/{ctx.nnodes} nodes joined")
-            time.sleep(0.1)
-        hosts = []
-        for r in range(ctx.nnodes):
-            raw = self.store.wait(self._key(f"node/{r}"))
-            hosts.append(json.loads(raw)["host"])
-        return node_rank, hosts
+        nmin = ctx.min_nodes
+        if seq == 0:
+            deadline = time.monotonic() + self.store.timeout
+            # a FRESH job (generation 0) waits for full membership — nodes
+            # may still be booting; only a restart generation settles early
+            # with the survivors (the dead peer is not coming back).  The
+            # settle window must outlast a HEALTHY peer's restart path —
+            # dead-node detection (<= elastic_timeout) + pod teardown grace
+            # (<= ~10s) + restart sleep — or a mere worker crash would
+            # permanently shrink the cluster past nodes that are alive.
+            elastic_restart = nmin < ctx.nnodes and self.generation > 0
+            settle = time.monotonic() + (ctx.elastic_timeout + 15.0
+                                         if elastic_restart else
+                                         self.store.timeout)
+            while True:
+                nodes = self.store.keys(self._key("node/"))
+                if len(nodes) >= ctx.nnodes:
+                    break
+                if len(nodes) >= nmin and time.monotonic() > settle:
+                    break
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"rendezvous: {len(nodes)}/{ctx.nnodes} nodes joined")
+                time.sleep(0.1)
+            members = [json.loads(self.store.wait(k))
+                       for k in self.store.keys(self._key("node/"))]
+            members.sort(key=lambda m: m["rank"])
+            self.store.set(self._key("members"), json.dumps(members).encode())
+        members = json.loads(self.store.wait(self._key("members")))
+        ranks = [m["rank"] for m in members]
+        if node_rank not in ranks:
+            raise TimeoutError(
+                f"node rank {node_rank} joined after membership froze "
+                f"(members: {ranks}); rejoin at the next generation")
+        return ranks.index(node_rank), [m["host"] for m in members]
 
     def close(self):
         self.store.close()

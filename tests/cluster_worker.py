@@ -1,0 +1,110 @@
+"""Training worker for the multi-process cluster tests
+(tests/test_multiprocess_cluster.py).
+
+Launched by ``paddle_tpu.launch`` (or directly, for the single-process
+reference run).  Each OS process provisions PDTPU_TEST_DEVICES virtual CPU
+devices, joins the jax.distributed cluster through
+``paddle_tpu.distributed.init_parallel_env`` (the exact wiring a real
+multi-host TPU pod uses — reference: paddle.distributed.init_parallel_env),
+and trains a tiny MLP with dp over ALL global devices.  The global batch is
+derived from the step index alone, so loss trajectories are comparable
+across cluster topologies.
+
+Env protocol (PDTPU_TEST_*):
+  DEVICES   virtual CPU devices per process (default 4)
+  STEPS     total train steps (default 10)
+  OUT       path: rank 0 appends one JSON line per run/generation
+  CKPT_DIR  if set, save a sharded checkpoint every step + resume-on-start
+  KILL_RANK / KILL_STEP  simulate node death: this process SIGKILLs itself
+            after completing (and checkpointing) step KILL_STEP — only on a
+            fresh (non-resumed) run, so the relaunch survives
+  STEP_SLEEP  seconds to sleep after each step (gives an external killer a
+            window to land mid-training; default 0)
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                           + os.environ.get("PDTPU_TEST_DEVICES", "4"))
+sys.path.insert(0, os.environ["PDTPU_REPO"])
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu import ckpt, distributed as dist, nn  # noqa: E402
+from paddle_tpu.jit import TrainStep  # noqa: E402
+from paddle_tpu.optimizer import AdamW  # noqa: E402
+
+GLOBAL_BATCH = 32
+DIM = 16
+
+
+def global_batch(step: int):
+    g = np.random.default_rng(1000 + step)
+    return {"x": g.standard_normal((GLOBAL_BATCH, DIM)).astype(np.float32),
+            "y": g.standard_normal((GLOBAL_BATCH, DIM)).astype(np.float32)}
+
+
+def main():
+    dist.init_parallel_env()
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    pt.seed(0)
+    model = nn.Sequential(nn.Linear(DIM, 32), nn.ReLU(), nn.Linear(32, DIM))
+    opt = AdamW(learning_rate=1e-2, parameters=model.parameters())
+    step = TrainStep(model, lambda m, b: ((m(b["x"]) - b["y"]) ** 2).mean(),
+                     opt, mesh=mesh)
+    state = step.init_state(seed=0)
+
+    total = int(os.environ.get("PDTPU_TEST_STEPS", "10"))
+    ckpt_dir = os.environ.get("PDTPU_TEST_CKPT_DIR") or None
+    kill_rank = int(os.environ.get("PDTPU_TEST_KILL_RANK", "-1"))
+    kill_step = int(os.environ.get("PDTPU_TEST_KILL_STEP", "-1"))
+
+    start, resumed_from = 0, None
+    if ckpt_dir:
+        latest = ckpt.latest_checkpoint(ckpt_dir)
+        if latest:
+            # reshard-on-load: the checkpoint may have been written by a
+            # different (larger) cluster; each device reads its own window
+            state = ckpt.load_state_dict(latest, template=state)
+            start, resumed_from = int(state["step"]), latest
+
+    batch_sharding = NamedSharding(mesh, P("dp"))
+    losses = {}
+    for s in range(start, total):
+        full = global_batch(s)
+        batch = {k: jax.make_array_from_callback(
+                     v.shape, batch_sharding, lambda idx, v=v: v[idx])
+                 for k, v in full.items()}
+        state, met = step(state, batch)
+        losses[s] = float(met["loss"])
+        if ckpt_dir:
+            ckpt.save_state_dict(state, os.path.join(ckpt_dir, f"step_{s + 1}"))
+        sleep = float(os.environ.get("PDTPU_TEST_STEP_SLEEP", "0"))
+        if sleep:
+            import time
+            time.sleep(sleep)
+        if (resumed_from is None and s + 1 == kill_step
+                and jax.process_index() == kill_rank):
+            import signal
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    if jax.process_index() == 0:
+        record = {"losses": losses, "world": jax.process_count(),
+                  "devices": jax.device_count(), "start": start,
+                  "resumed_from": resumed_from}
+        with open(os.environ["PDTPU_TEST_OUT"], "a") as f:
+            f.write(json.dumps(record) + "\n")
+    print("worker-done", flush=True)
+
+
+if __name__ == "__main__":
+    main()
