@@ -61,16 +61,22 @@ def main():
     emb = DistributedEmbedding(rt, "emb", args.dim)
     w = jnp.zeros((args.dim,), jnp.float32)
 
+    from paddle_tpu.sparse import embedding_rows_grad
+
     @jax.jit
-    def step(w, rows, inverse, labels):
-        def loss_fn(w, rows):
-            feats = rows[inverse].sum(1)
+    def step(w, rows, inverse, labels, ids):
+        def loss_fn(w, looked):
+            feats = looked.sum(1)
             p = jax.nn.sigmoid(feats @ w)
             eps = 1e-6
             return -jnp.mean(labels * jnp.log(p + eps)
                              + (1 - labels) * jnp.log(1 - p + eps))
-        loss, (dw, drows) = jax.value_and_grad(loss_fn, (0, 1))(w, rows)
-        return loss, w - 0.1 * dw, drows
+        looked = rows[inverse]
+        loss, (dw, dlooked) = jax.value_and_grad(loss_fn, (0, 1))(w, looked)
+        # SelectedRows gradient: one (row, value) per lookup, coalesced on
+        # device — what gets pushed to the sparse table
+        rg = embedding_rows_grad(ids, dlooked, args.vocab).coalesce()
+        return loss, w - 0.1 * dw, rg
 
     rng = np.random.default_rng(0)
     score = rng.normal(size=args.vocab)
@@ -78,8 +84,9 @@ def main():
         ids = rng.integers(0, args.vocab, size=(64, 8))
         labels = jnp.asarray((score[ids].sum(1) > 0).astype(np.float32))
         rows, inv = emb.pull(ids)
-        loss, w, drows = step(w, jnp.asarray(rows), jnp.asarray(inv), labels)
-        emb.push(np.asarray(drows))
+        loss, w, rg = step(w, jnp.asarray(rows), jnp.asarray(inv), labels,
+                           jnp.asarray(ids))
+        emb.push_rows(rg)
         if i % 20 == 0 or i == args.steps - 1:
             print(f"step {i}: loss={float(loss):.4f}", flush=True)
     if os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST"):

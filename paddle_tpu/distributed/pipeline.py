@@ -190,7 +190,13 @@ class StackedPipelineStages(Layer):
         self._layer_perm = perm
 
         for name in self._param_names:
-            stacked = jnp.stack([per_layer[i][name] for i in perm], axis=0)
+            vals = [per_layer[i][name] for i in perm]
+            if isinstance(vals[0], jax.ShapeDtypeStruct):
+                # meta_init() construction: stack abstractly
+                stacked = jax.eval_shape(
+                    lambda *xs: jnp.stack(xs, axis=0), *vals)
+            else:
+                stacked = jnp.stack(vals, axis=0)
             meta = metas.get(name, ParamMeta())
             base = meta.partition
             entries = (list(base) if base is not None else [])

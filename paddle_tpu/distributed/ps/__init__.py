@@ -223,3 +223,22 @@ class DistributedEmbedding:
         uniq, _ = self._last
         self.client.push_sparse(self.name, uniq,
                                 np.asarray(d_rows, np.float32))
+
+    def push_rows(self, rows_grad) -> None:
+        """Push a device-side ``sparse.RowsGrad`` (SelectedRows) keyed by
+        raw vocabulary ids — the per-lookup gradient straight out of the
+        jitted step, no pull bookkeeping needed.  Drop-slot rows (id >=
+        vocab, from padding or coalesce parking) are filtered host-side."""
+        rows = np.asarray(rows_grad.rows, np.int64)
+        vals = np.asarray(rows_grad.values, np.float32)
+        keep = rows < rows_grad.dense_shape[0]
+        rows, vals = rows[keep], vals[keep]
+        if not rows.size:
+            return
+        # host-side coalesce: duplicate lookups must reach the table as ONE
+        # summed update (SelectedRows merge semantics) — per-duplicate
+        # accessor.apply calls would bump adaptive-rule steps per lookup
+        uniq, inv = np.unique(rows, return_inverse=True)
+        summed = np.zeros((uniq.size, vals.shape[1]), np.float32)
+        np.add.at(summed, inv, vals)
+        self.client.push_sparse(self.name, uniq, summed)

@@ -449,3 +449,13 @@ def _kl_uniform(p, q):
 @register_kl(Exponential, Exponential)
 def _kl_exponential(p, q):
     return jnp.log(p.rate) - jnp.log(q.rate) + q.rate / p.rate - 1
+
+
+# round-3 tail (Gamma/Chi2/Poisson/Cauchy/StudentT/Binomial/Multinomial/
+# MultivariateNormal/ContinuousBernoulli + transforms) — see tail3.py
+from .tail3 import (  # noqa: E402,F401
+    AffineTransform, Binomial, Cauchy, ChainTransform, Chi2,
+    ContinuousBernoulli, ExpTransform, ExponentialFamily, Gamma,
+    Multinomial, MultivariateNormal, Poisson, PowerTransform,
+    SigmoidTransform, StudentT, TanhTransform, Transform,
+    TransformedDistribution)

@@ -1,0 +1,443 @@
+"""Round-3 distribution tail.
+
+Reference: python/paddle/distribution/{cauchy,chi2,continuous_bernoulli,
+exponential_family,gamma,multinomial,multivariate_normal,poisson,
+student_t,transformed_distribution,binomial}.py.  Torch/scipy-oracle
+tests in tests/test_dist_tail3.py.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import gammaln, xlogy
+
+from . import Distribution, _next_key
+
+
+class ExponentialFamily(Distribution):
+    """Reference: paddle.distribution.ExponentialFamily — base class
+    carrying the Bregman-divergence entropy identity; concrete members
+    implement ``_natural_parameters`` / ``_log_normalizer``."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+
+class Gamma(ExponentialFamily):
+    def __init__(self, concentration, rate):
+        self.concentration = jnp.asarray(concentration, jnp.float32)
+        self.rate = jnp.asarray(rate, jnp.float32)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.concentration.shape, self.rate.shape)
+        g = jax.random.gamma(_next_key(key), self.concentration, shape)
+        return g / self.rate
+
+    rsample = sample
+
+    def log_prob(self, value):
+        a, b = self.concentration, self.rate
+        return (xlogy(a, b) + xlogy(a - 1, value) - b * value - gammaln(a))
+
+    def entropy(self):
+        from jax.scipy.special import digamma
+        a, b = self.concentration, self.rate
+        out = a - jnp.log(b) + gammaln(a) + (1 - a) * digamma(a)
+        return jnp.broadcast_to(out, jnp.broadcast_shapes(a.shape, b.shape))
+
+    @property
+    def mean(self):
+        return self.concentration / self.rate
+
+    @property
+    def variance(self):
+        return self.concentration / self.rate ** 2
+
+
+class Chi2(Gamma):
+    """Reference: paddle.distribution.Chi2 — Gamma(df/2, 1/2)."""
+
+    def __init__(self, df):
+        self.df = jnp.asarray(df, jnp.float32)
+        super().__init__(self.df / 2.0, jnp.asarray(0.5, jnp.float32))
+
+
+class Poisson(ExponentialFamily):
+    def __init__(self, rate):
+        self.rate = jnp.asarray(rate, jnp.float32)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + self.rate.shape
+        return jax.random.poisson(_next_key(key), self.rate,
+                                  shape).astype(jnp.float32)
+
+    def log_prob(self, value):
+        return xlogy(value, self.rate) - self.rate - gammaln(value + 1)
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        return self.loc + self.scale * jax.random.cauchy(_next_key(key),
+                                                         shape)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        z = (value - self.loc) / self.scale
+        return -jnp.log(math.pi * self.scale * (1 + z ** 2))
+
+    def cdf(self, value):
+        return jnp.arctan((value - self.loc) / self.scale) / math.pi + 0.5
+
+    def entropy(self):
+        out = jnp.log(4 * math.pi * self.scale)
+        return jnp.broadcast_to(out, jnp.broadcast_shapes(
+            self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy has no variance")
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0):
+        self.df = jnp.asarray(df, jnp.float32)
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.df.shape, self.loc.shape, self.scale.shape)
+        t = jax.random.t(_next_key(key), self.df, shape)
+        return self.loc + self.scale * t
+
+    rsample = sample
+
+    def log_prob(self, value):
+        df, loc, scale = self.df, self.loc, self.scale
+        z = (value - loc) / scale
+        return (gammaln((df + 1) / 2) - gammaln(df / 2)
+                - 0.5 * jnp.log(df * math.pi) - jnp.log(scale)
+                - (df + 1) / 2 * jnp.log1p(z ** 2 / df))
+
+    @property
+    def mean(self):
+        return jnp.where(self.df > 1, self.loc, jnp.nan)
+
+    @property
+    def variance(self):
+        v = self.scale ** 2 * self.df / (self.df - 2)
+        return jnp.where(self.df > 2, v, jnp.nan)
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = jnp.asarray(total_count, jnp.float32)
+        self.probs = jnp.asarray(probs, jnp.float32)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.total_count.shape, self.probs.shape)
+        return jax.random.binomial(_next_key(key), self.total_count,
+                                   self.probs, shape=shape)
+
+    def log_prob(self, value):
+        n, p = self.total_count, self.probs
+        return (gammaln(n + 1) - gammaln(value + 1) - gammaln(n - value + 1)
+                + xlogy(value, p) + xlogy(n - value, 1 - p))
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1 - self.probs)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs = jnp.asarray(probs, jnp.float32)
+        self.probs = self.probs / self.probs.sum(-1, keepdims=True)
+
+    def sample(self, shape=(), key=None):
+        key = _next_key(key)
+        shape = tuple(shape) + self.probs.shape[:-1]
+        k = self.probs.shape[-1]
+        idx = jax.random.categorical(
+            key, jnp.log(jnp.broadcast_to(self.probs, shape + (k,))),
+            shape=(self.total_count,) + shape)
+        counts = jax.nn.one_hot(idx, k).sum(axis=0)
+        return counts
+
+    def log_prob(self, value):
+        n = jnp.asarray(self.total_count, jnp.float32)
+        return (gammaln(n + 1) - gammaln(value + 1).sum(-1)
+                + xlogy(value, self.probs).sum(-1))
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1 - self.probs)
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        if scale_tril is not None:
+            self.scale_tril = jnp.asarray(scale_tril, jnp.float32)
+            self.covariance_matrix = self.scale_tril @ jnp.swapaxes(
+                self.scale_tril, -1, -2)
+        else:
+            self.covariance_matrix = jnp.asarray(covariance_matrix,
+                                                 jnp.float32)
+            self.scale_tril = jnp.linalg.cholesky(self.covariance_matrix)
+
+    def sample(self, shape=(), key=None):
+        shape = tuple(shape) + self.loc.shape
+        eps = jax.random.normal(_next_key(key), shape)
+        return self.loc + jnp.einsum("...ij,...j->...i",
+                                     self.scale_tril, eps)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        d = self.loc.shape[-1]
+        diff = value - self.loc
+        # batched triangular solve (jnp.linalg.solve broadcasts; the
+        # scipy wrapper does not)
+        sol = jnp.linalg.solve(self.scale_tril, diff[..., None])[..., 0]
+        maha = (sol ** 2).sum(-1)
+        logdet = jnp.log(jnp.diagonal(self.scale_tril, axis1=-2,
+                                      axis2=-1)).sum(-1)
+        return -0.5 * (d * math.log(2 * math.pi) + maha) - logdet
+
+    def entropy(self):
+        d = self.loc.shape[-1]
+        logdet = jnp.log(jnp.diagonal(self.scale_tril, axis1=-2,
+                                      axis2=-1)).sum(-1)
+        return 0.5 * d * (1 + math.log(2 * math.pi)) + logdet
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return jnp.diagonal(self.covariance_matrix, axis1=-2, axis2=-1)
+
+
+class ContinuousBernoulli(ExponentialFamily):
+    """Reference: paddle.distribution.ContinuousBernoulli
+    (Loaiza-Ganem & Cunningham 2019)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs = jnp.asarray(probs, jnp.float32)
+        self._lims = lims
+
+    def _log_norm_const(self):
+        p = self.probs
+        # C(p) = 2*atanh(1-2p) / (1-2p), with the p→1/2 limit = 2
+        safe = jnp.where((p < self._lims[0]) | (p > self._lims[1]), p, 0.25)
+        c = 2 * jnp.arctanh(1 - 2 * safe) / (1 - 2 * safe)
+        return jnp.where((p < self._lims[0]) | (p > self._lims[1]),
+                         jnp.log(c), jnp.log(2.0))
+
+    def log_prob(self, value):
+        p = self.probs
+        return (xlogy(value, p) + xlogy(1 - value, 1 - p)
+                + self._log_norm_const())
+
+    def sample(self, shape=(), key=None):
+        u = jax.random.uniform(_next_key(key),
+                               tuple(shape) + self.probs.shape)
+        p = self.probs
+        mid = (p >= self._lims[0]) & (p <= self._lims[1])
+        safe = jnp.where(mid, 0.25, p)
+        s = (jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+             / (jnp.log(safe) - jnp.log1p(-safe)))
+        return jnp.where(mid, u, s)
+
+    @property
+    def mean(self):
+        p = self.probs
+        mid = (p >= self._lims[0]) & (p <= self._lims[1])
+        safe = jnp.where(mid, 0.25, p)
+        m = safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(1 - 2 * safe))
+        return jnp.where(mid, 0.5, m)
+
+    @property
+    def variance(self):
+        p = self.probs
+        mid = (p >= self._lims[0]) & (p <= self._lims[1])
+        safe = jnp.where(mid, 0.25, p)
+        v = (safe * (safe - 1) / (1 - 2 * safe) ** 2
+             + 1 / (2 * jnp.arctanh(1 - 2 * safe)) ** 2)
+        return jnp.where(mid, 1.0 / 12, v)
+
+
+class TransformedDistribution(Distribution):
+    """Reference: paddle.distribution.TransformedDistribution — base
+    distribution pushed through a chain of paddle Transforms."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+
+    def sample(self, shape=(), key=None):
+        x = self.base.sample(shape, key)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=(), key=None):
+        x = self.base.rsample(shape, key)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        lp = 0.0
+        x = value
+        for t in reversed(self.transforms):
+            y = x
+            x = t.inverse(y)
+            lp = lp - t.forward_log_det_jacobian(x)
+        return lp + self.base.log_prob(x)
+
+
+# ---------------------------------------------------------------------------
+# transforms (reference: python/paddle/distribution/transform.py)
+# ---------------------------------------------------------------------------
+
+class Transform:
+    """Reference: paddle.distribution.Transform base."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return -self.forward_log_det_jacobian(self.inverse(y))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def forward(self, x):
+        return self.loc + self.scale * x
+
+    def inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), jnp.shape(x))
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return jnp.exp(x)
+
+    def inverse(self, y):
+        return jnp.log(y)
+
+    def forward_log_det_jacobian(self, x):
+        return x
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return jnp.tanh(x)
+
+    def inverse(self, y):
+        return jnp.arctanh(y)
+
+    def forward_log_det_jacobian(self, x):
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = jnp.asarray(power, jnp.float32)
+
+    def forward(self, x):
+        return jnp.power(x, self.power)
+
+    def inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t.forward_log_det_jacobian(x)
+            x = t.forward(x)
+        return total

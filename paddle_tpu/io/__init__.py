@@ -667,3 +667,19 @@ def _device_prefetch(it: Iterator, depth: int = 2):
         if len(buf) > depth:
             yield buf.pop(0)
     yield from buf
+
+
+def default_convert_fn(batch):
+    """Reference: paddle.io.dataloader.collate.default_convert_fn —
+    convert leaves to arrays WITHOUT adding a batch dim (the no-batch
+    collate used when batch_size=None)."""
+    import numpy as _np
+
+    import jax.numpy as _jnp
+    if isinstance(batch, (list, tuple)):
+        return type(batch)(default_convert_fn(b) for b in batch)
+    if isinstance(batch, dict):
+        return {k: default_convert_fn(v) for k, v in batch.items()}
+    if isinstance(batch, (_np.ndarray, int, float)):
+        return _jnp.asarray(batch)
+    return batch

@@ -49,17 +49,37 @@ class InputSpec:
 
 
 def to_static(function=None, input_spec=None, full_graph=True, backend=None,
-              donate_argnums=(), static_argnums=()):
+              donate_argnums=(), static_argnums=(),
+              convert_control_flow=True):
     """``paddle.jit.to_static`` parity → jax.jit.
 
     With a fully-static ``input_spec`` the function is AOT-lowered and
     compiled immediately (the reference's program-capture step); dynamic
     dims fall back to lazy shape-specialised jit with a warning.
+
+    ``convert_control_flow=True`` (default) applies the SOT-lite AST
+    transform (reference: python/paddle/jit/sot): plain Python ``if`` /
+    ``while`` on traced values are rewritten into ``lax.cond`` /
+    ``lax.while_loop`` automatically; unconvertible patterns keep the
+    graph-break diagnostic / eager-fallback behavior.
     """
     def deco(fn):
-        jitted = jax.jit(fn, donate_argnums=donate_argnums,
+        target = fn
+        if convert_control_flow:
+            from . import sot as _sot
+            from ..nn.layer import Layer
+            if isinstance(fn, Layer):
+                converted, ok = _sot.convert_control_flow(fn.forward)
+                if ok:
+                    # instance attribute shadows the class method; hooks
+                    # and __call__ plumbing stay intact
+                    fn.forward = converted
+            else:
+                target, _ = _sot.convert_control_flow(fn)
+        jitted = jax.jit(target, donate_argnums=donate_argnums,
                          static_argnums=static_argnums)
-        functools.update_wrapper(jitted, fn, updated=[])
+        if not isinstance(fn, type) and callable(fn) and hasattr(fn, "__name__"):
+            functools.update_wrapper(jitted, fn, updated=[])
         if input_spec:
             specs = [s if isinstance(s, InputSpec) else InputSpec(*s)
                      for s in input_spec]
@@ -256,6 +276,57 @@ class TrainStep:
                 state["scaler"]["acc_found_inf"] = jnp.asarray(False)
         return self.shard_state(state)
 
+    def abstract_state(self) -> Dict[str, Any]:
+        """Abstract (ShapeDtypeStruct) analogue of
+        ``init_state()+shard_state()`` for AOT lowering: every leaf carries
+        its shape, dtype, and target sharding, but nothing materialises.
+        Works with ``nn.meta_init()``-constructed models, so a 70B step can
+        be compiled and memory-analysed on a host that could never hold it
+        (tools/memproof.py; SURVEY §6 HBM-highwater validation)."""
+        if self.mesh is None:
+            raise ValueError("abstract_state requires a mesh")
+        pspecs = self.param_specs()
+        params = raw_params(self.model)
+
+        def struct(leaf, spec, host=False):
+            return jax.ShapeDtypeStruct(
+                tuple(leaf.shape), leaf.dtype,
+                sharding=_named(self.mesh, spec, host=host))
+
+        aparams = {k: struct(v, pspecs[k]) for k, v in params.items()}
+        opt_abs = jax.eval_shape(self.optimizer.init, aparams)
+        ospecs = self.opt_state_specs(opt_abs, pspecs)
+        host = self.zero_offload
+        opt = {}
+        for slot, val in opt_abs.items():
+            if isinstance(val, dict):
+                opt[slot] = {k: (struct(v, ospecs[slot][k], host=host)
+                                 if v is not None else None)
+                             for k, v in val.items()}
+            else:
+                opt[slot] = struct(val, P())
+        rng = jax.eval_shape(lambda: jax.random.key(0))
+        state = {"params": aparams, "opt": opt,
+                 "step": jax.ShapeDtypeStruct((), jnp.int32,
+                                              sharding=_named(self.mesh, P())),
+                 "rng": jax.ShapeDtypeStruct(rng.shape, rng.dtype,
+                                             sharding=_named(self.mesh, P()))}
+        if self._accum:
+            gspecs = self.grad_specs(
+                {k: v for k, v in aparams.items()
+                 if self._mask.get(k, True)}, pspecs)
+            state["acc_grads"] = {
+                k: struct(aparams[k], gspecs[k]) for k in gspecs}
+        if self.scaler is not None and self.scaler.enable:
+            sc = jax.eval_shape(self.scaler.init_state)
+            state["scaler"] = jax.tree.map(
+                lambda v: jax.ShapeDtypeStruct(
+                    v.shape, v.dtype, sharding=_named(self.mesh, P())), sc)
+            if self._accum:
+                state["scaler"]["acc_found_inf"] = jax.ShapeDtypeStruct(
+                    (), jnp.bool_, sharding=_named(self.mesh, P()))
+        return state
+
     def shard_state(self, state):
         if self.mesh is None:
             return state
@@ -315,6 +386,22 @@ class TrainStep:
 
     def _step(self, state, batch, accumulate=False):
         mesh = self.mesh
+        if self.zero_offload and mesh is not None:
+            # offloaded optimizer states live in pinned host memory between
+            # steps; XLA compute requires device space, so the step opens
+            # with an explicit host->HBM transfer (and closes with the
+            # device_put back to host below)
+            ospecs = self.opt_state_specs(state["opt"], self.param_specs())
+            opt_dev = {}
+            for slot, val in state["opt"].items():
+                if isinstance(val, dict):
+                    opt_dev[slot] = {
+                        k: (jax.device_put(v, _named(mesh, ospecs[slot][k]))
+                            if v is not None else None)
+                        for k, v in val.items()}
+                else:
+                    opt_dev[slot] = val
+            state = {**state, "opt": opt_dev}
         if mesh is not None:
             batch = jax.tree.map(
                 lambda x: jax.lax.with_sharding_constraint(
@@ -424,6 +511,11 @@ class TrainStep:
         return self._compiled(state, batch, accumulate)
 
     def lower(self, state, batch):
+        # same mesh context as __call__: kernel dispatch (shard_map wrapping
+        # of Pallas calls) keys off the active physical mesh during tracing
+        if self.mesh is not None:
+            with self.mesh:
+                return self._compiled.lower(state, batch, False)
         return self._compiled.lower(state, batch, False)
 
 

@@ -138,28 +138,62 @@ def graph_break_message(loc: str) -> str:
         "eagerly (the reference SOT's graph-break fallback).")
 
 
+def _sig_key(args, kwargs):
+    """Hashable call signature (structure + array shapes/dtypes + scalar
+    values) — the SOT guard key: one graph break for a signature sends
+    every later call with that signature straight to eager, skipping the
+    doomed (and expensive) retrace."""
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+
+    def leaf_key(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return ("arr", tuple(x.shape), str(x.dtype))
+        try:
+            hash(x)
+            return x
+        except TypeError:
+            return ("unhashable", type(x).__name__)
+
+    return (treedef, tuple(leaf_key(leaf) for leaf in leaves))
+
+
 def intercept_graph_breaks(fn: Callable, jitted: Callable,
                            full_graph: bool) -> Callable:
-    """Wrap a jitted callable: on TracerBoolConversionError either raise a
-    paddle-style GraphBreakError (full_graph=True) or fall back to one
-    eager call of ``fn`` (full_graph=False)."""
+    """Wrap a jitted callable: on a graph break (TracerBoolConversionError
+    from raw Python branching, or GraphBreakError from the SOT-lite
+    converter's unconvertible cases) either raise a paddle-style
+    GraphBreakError (full_graph=True) or fall back to eager calls of
+    ``fn`` (full_graph=False), memoised per call signature."""
     import functools
     warned = []
+    broken_sigs = set()
 
-    @functools.wraps(fn)
+    @functools.wraps(fn) if hasattr(fn, "__name__") else (lambda f: f)
     def wrapper(*args, **kwargs):
+        if broken_sigs:
+            try:
+                if _sig_key(args, kwargs) in broken_sigs:
+                    return fn(*args, **kwargs)
+            except TypeError:
+                pass
         try:
             return jitted(*args, **kwargs)
-        except jax.errors.TracerBoolConversionError as e:
-            loc = _user_frame(e.__traceback__, fn)
+        except (jax.errors.TracerBoolConversionError, GraphBreakError) as e:
+            if isinstance(e, GraphBreakError):
+                msg = str(e)
+            else:
+                msg = graph_break_message(_user_frame(e.__traceback__, fn))
             if full_graph:
-                raise GraphBreakError(graph_break_message(loc)) from e
+                raise GraphBreakError(msg) from e
             if not warned:
                 warned.append(True)
                 warnings.warn(
-                    f"to_static: graph break at {loc}; running this call "
-                    "eagerly (full_graph=False). Use paddle_tpu.jit.cond/"
-                    "while_loop to keep it compiled.", stacklevel=2)
+                    f"to_static: {msg} — running eagerly "
+                    "(full_graph=False).", stacklevel=2)
+            try:
+                broken_sigs.add(_sig_key(args, kwargs))
+            except TypeError:
+                pass
             return fn(*args, **kwargs)
 
     wrapper.lower = jitted.lower

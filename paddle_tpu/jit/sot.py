@@ -1,0 +1,487 @@
+"""SOT-lite: automatic conversion of plain Python control flow on traced
+values into compiled ``lax.cond`` / ``lax.while_loop``.
+
+Reference: python/paddle/jit/sot — the reference intercepts CPython
+bytecode, builds a graph, and breaks/falls back where capture fails.  The
+TPU-native analogue is source-level: ``to_static`` re-writes the decorated
+function's AST so that
+
+- ``if <tensor-pred>: ... else: ...`` becomes a ``lax.cond`` whose branch
+  functions carry the assigned variables (paddle dy2static's
+  ``convert_ifelse`` protocol, including its UndefinedVar placeholder
+  semantics for one-sided assignments);
+- ``while <tensor-pred>: ...`` becomes a ``lax.while_loop`` over the
+  loop-carried variables;
+- predicates that turn out CONCRETE at trace time keep exact Python
+  semantics (only the taken branch runs, loops unroll) — the dispatch is
+  by value, not by syntax;
+- anything unconvertible (branch returns on one side only, break/continue,
+  structure mismatch between branches, undefined loop carries) raises
+  ``GraphBreakError`` mid-trace, which ``to_static`` surfaces with the
+  file:line diagnostic (full_graph=True) or falls back to one eager call
+  (full_graph=False), exactly like SOT's graph-break interpreter.
+
+The transform is applied once at decoration time; failures to even parse
+(no source, exotic syntax) silently leave the function untouched — the
+pre-existing graph-break machinery then owns the behavior.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+import types
+from typing import Callable, Tuple
+
+import jax
+from jax import lax
+
+from .control_flow import GraphBreakError
+
+__all__ = ["convert_control_flow"]
+
+
+class _Undef:
+    """paddle dy2static UndefinedVar analogue: placeholder for a name that
+    is not bound at the branch point."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<undefined>"
+
+
+_SOT_UNDEF = _Undef()
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _sot_if(pred, tfn, ffn, local_ns, names, dummy_ok, loc):
+    vals = tuple(local_ns.get(n, _SOT_UNDEF) for n in names)
+    if _is_tracer(pred):
+        import jax.numpy as jnp
+        # a name first bound INSIDE both branches (and never read before
+        # its write) needs no real input — any placeholder threads through
+        # lax.cond's operand slot and is overwritten by both branches
+        vals = tuple(jnp.zeros(()) if (v is _SOT_UNDEF and n in dummy_ok)
+                     else v for n, v in zip(names, vals))
+        if any(v is _SOT_UNDEF for v in vals):
+            missing = [n for n, v in zip(names, vals) if v is _SOT_UNDEF]
+            raise GraphBreakError(
+                f"graph break at {loc}: branch on a traced value where "
+                f"variable(s) {missing} are only defined on one side; "
+                "lax.cond needs both branches to produce every output. "
+                "Define them before the if, or see to_static(full_graph=...)")
+        try:
+            return lax.cond(pred, lambda vs: tuple(tfn(*vs)),
+                            lambda vs: tuple(ffn(*vs)), vals)
+        except (TypeError, ValueError) as e:
+            raise GraphBreakError(
+                f"graph break at {loc}: auto-converted `if` could not "
+                f"compile ({e})") from e
+    return tfn(*vals) if pred else ffn(*vals)
+
+
+def _sot_if_ret(pred, tfn, ffn, local_ns, names, dummy_ok, loc):
+    """Value-form: both branches terminate in ``return``."""
+    vals = tuple(local_ns.get(n, _SOT_UNDEF) for n in names)
+    if _is_tracer(pred):
+        import jax.numpy as jnp
+        vals = tuple(jnp.zeros(()) if (v is _SOT_UNDEF and n in dummy_ok)
+                     else v for n, v in zip(names, vals))
+        if any(v is _SOT_UNDEF for v in vals):
+            missing = [n for n, v in zip(names, vals) if v is _SOT_UNDEF]
+            raise GraphBreakError(
+                f"graph break at {loc}: branch on a traced value reads "
+                f"undefined variable(s) {missing}")
+        try:
+            return lax.cond(pred, lambda vs: tfn(*vs), lambda vs: ffn(*vs),
+                            vals)
+        except (TypeError, ValueError) as e:
+            raise GraphBreakError(
+                f"graph break at {loc}: auto-converted `if/return` could "
+                f"not compile ({e})") from e
+    return tfn(*vals) if pred else ffn(*vals)
+
+
+def _sot_while(cfn, bfn, local_ns, names, loc):
+    vals = tuple(local_ns.get(n, _SOT_UNDEF) for n in names)
+    undef = any(v is _SOT_UNDEF for v in vals)
+    t = cfn(*vals)
+    if _is_tracer(t):
+        if undef:
+            missing = [n for n, v in zip(names, vals) if v is _SOT_UNDEF]
+            raise GraphBreakError(
+                f"graph break at {loc}: traced `while` with loop-carried "
+                f"variable(s) {missing} undefined before the loop")
+        try:
+            return lax.while_loop(lambda vs: cfn(*vs),
+                                  lambda vs: tuple(bfn(*vs)), vals)
+        except (TypeError, ValueError) as e:
+            raise GraphBreakError(
+                f"graph break at {loc}: auto-converted `while` could not "
+                f"compile ({e}). lax.while_loop requires the body to keep "
+                "every carried shape/dtype fixed") from e
+    # concrete predicate: plain Python semantics (loop unrolls under trace)
+    while t:
+        vals = tuple(bfn(*vals))
+        t = cfn(*vals)
+    return vals
+
+
+class _Names(ast.NodeVisitor):
+    def __init__(self):
+        self.stores, self.loads = set(), set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.stores.add(node.id)
+        else:
+            self.loads.add(node.id)
+
+    def visit_AugAssign(self, node):
+        # `y += 1` both reads and writes y
+        if isinstance(node.target, ast.Name):
+            self.loads.add(node.target.id)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        self.stores.add(node.name)   # nested defs bind a local name
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass  # lambda params are not enclosing-scope names
+
+
+def _names(nodes) -> Tuple[set, set]:
+    v = _Names()
+    for n in (nodes if isinstance(nodes, (list, tuple)) else [nodes]):
+        v.visit(n)
+    return v.stores, v.loads
+
+
+class _Blocker(ast.NodeVisitor):
+    """Detects statements that make a block unconvertible: control escape,
+    scope manipulation, or SIDE EFFECTS.  lax.cond traces BOTH branches,
+    so a branch whose statements mutate state (attribute/subscript stores,
+    bare call expressions) must NOT be captured — it would execute
+    unconditionally (and can leak tracers into objects).  Such branches
+    keep the graph-break behavior instead."""
+
+    def __init__(self):
+        self.blocked = False
+        self.has_return = False
+
+    def generic_visit(self, node):
+        if isinstance(node, (ast.Break, ast.Continue, ast.Global,
+                             ast.Nonlocal, ast.Yield, ast.YieldFrom,
+                             ast.Await, ast.Try, ast.With, ast.Raise,
+                             ast.Delete, ast.Import, ast.ImportFrom)):
+            self.blocked = True
+        if isinstance(node, ast.Expr) and not isinstance(
+                node.value, ast.Constant):
+            self.blocked = True   # bare expression: called for effect
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if not self._pure_target(t):
+                    self.blocked = True
+        if isinstance(node, ast.Return):
+            self.has_return = True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # nested scopes keep their own control flow
+        super().generic_visit(node)
+
+    @staticmethod
+    def _pure_target(t):
+        if isinstance(t, ast.Name):
+            return True
+        if isinstance(t, (ast.Tuple, ast.List)):
+            return all(_Blocker._pure_target(e) for e in t.elts)
+        if isinstance(t, ast.Starred):
+            return _Blocker._pure_target(t.value)
+        return False  # Attribute / Subscript store: a side effect
+
+
+def _scan(stmts):
+    b = _Blocker()
+    for s in stmts:
+        b.visit(s)
+    return b
+
+
+def _terminates_in_return(stmts) -> bool:
+    return bool(stmts) and isinstance(stmts[-1], ast.Return)
+
+
+def _helper_call_names(stmt):
+    """For a generated ``_sot_*`` helper-call statement, the variable names
+    it actually READS from ``locals()``: the names tuple minus the
+    dummy-substitutable tuple.  None for ordinary statements."""
+    val = getattr(stmt, "value", None) if isinstance(
+        stmt, (ast.Assign, ast.Return)) else None
+    if (isinstance(val, ast.Call) and isinstance(val.func, ast.Name)
+            and val.func.id in ("_sot_if", "_sot_if_ret", "_sot_while")):
+        tuples = [a for a in val.args
+                  if isinstance(a, ast.Tuple)
+                  and all(isinstance(e, ast.Constant) for e in a.elts)]
+        if tuples:
+            names = [e.value for e in tuples[0].elts]
+            dummy = ([e.value for e in tuples[1].elts]
+                     if len(tuples) > 1 else [])
+            return [n for n in names if n not in dummy]
+    return None
+
+
+def _reads_before_write(stmts) -> set:
+    """Names read before (or without) a preceding top-level write, in
+    statement order.  Statement-granular: a read and write in the same
+    statement (``y = y + 1``) counts as a read."""
+    written, needs = set(), set()
+    for s in stmts:
+        hnames = _helper_call_names(s)
+        if hnames is not None:
+            needs |= {n for n in hnames if n not in written}
+            st, _ = _names([s])
+            written |= st
+            continue
+        st, ld = _names([s])
+        needs |= {n for n in ld if n not in written}
+        written |= st
+    return needs
+
+
+def _guaranteed_stores(stmts) -> set:
+    """Names bound on EVERY path through these statements (top-level
+    assigns only; conditional inner binds don't count)."""
+    out = set()
+    for s in stmts:
+        if isinstance(s, ast.Assign):
+            st, _ = _names([s])
+            out |= st
+        elif isinstance(s, (ast.AugAssign, ast.AnnAssign)):
+            if isinstance(s.target, ast.Name):
+                out.add(s.target.id)
+    return out
+
+
+class _CFTransformer(ast.NodeTransformer):
+    def __init__(self, fn_locals: set, filename: str):
+        self.fn_locals = fn_locals
+        self.filename = filename
+        self.counter = 0
+        self.changed = False
+
+    # never descend into nested function/class definitions
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        return node
+
+    def _loc(self, node) -> str:
+        return f"{self.filename}:{node.lineno}"
+
+    def _make_fn(self, name, params, body_stmts, tail_return):
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=p) for p in params],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        body = list(body_stmts)
+        if tail_return is not None:
+            body = body + [tail_return]
+        if not body:
+            body = [ast.Pass()]
+        return ast.FunctionDef(name=name, args=args, body=body,
+                               decorator_list=[], returns=None,
+                               type_params=[])
+
+    def _names_tuple(self, names, ctx):
+        return ast.Tuple(elts=[ast.Name(id=n, ctx=ctx()) for n in names],
+                         ctx=ctx())
+
+    def _call_helper(self, helper, test, tname, fname, names, dummy_ok,
+                     loc):
+        return ast.Call(
+            func=ast.Name(id=helper, ctx=ast.Load()),
+            args=[test,
+                  ast.Name(id=tname, ctx=ast.Load()),
+                  ast.Name(id=fname, ctx=ast.Load()),
+                  ast.Call(func=ast.Name(id="locals", ctx=ast.Load()),
+                           args=[], keywords=[]),
+                  ast.Tuple(elts=[ast.Constant(value=n) for n in names],
+                            ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Constant(value=n) for n in dummy_ok],
+                            ctx=ast.Load()),
+                  ast.Constant(value=loc)],
+            keywords=[])
+
+    def visit_If(self, node):
+        node = self.generic_visit(node)  # inner ifs/whiles first
+        body_scan, else_scan = _scan(node.body), _scan(node.orelse)
+        if body_scan.blocked or else_scan.blocked:
+            return node
+        i = self.counter
+        self.counter += 1
+        tname, fname = f"_sot_true_{i}", f"_sot_false_{i}"
+        loc = self._loc(node)
+
+        rb = (_reads_before_write(node.body)
+              | _reads_before_write(node.orelse))
+
+        if body_scan.has_return or else_scan.has_return:
+            # value-form: only when BOTH branches terminate in return
+            if not (_terminates_in_return(node.body)
+                    and _terminates_in_return(node.orelse)):
+                return node
+            stores = (_names(node.body)[0] | _names(node.orelse)[0])
+            params = sorted(stores & self.fn_locals)
+            # each branch returns its own expression (no carry
+            # passthrough): any name not read-before-write may be dummied
+            dummy = sorted(set(params) - rb)
+            t_fn = self._make_fn(tname, params, node.body, None)
+            f_fn = self._make_fn(fname, params, node.orelse, None)
+            ret = ast.Return(value=self._call_helper(
+                "_sot_if_ret", node.test, tname, fname, params, dummy, loc))
+            self.changed = True
+            return [t_fn, f_fn, ret]
+
+        stores = (_names(node.body)[0] | _names(node.orelse)[0])
+        out = sorted(stores & self.fn_locals)
+        if not out:
+            return node  # side-effect-only branch: leave to graph-break
+        # a name needs a REAL input value unless BOTH branches bind it on
+        # every path and neither reads it first (then the untaken branch
+        # never passes the input through)
+        both = (_guaranteed_stores(node.body)
+                & _guaranteed_stores(node.orelse))
+        dummy = sorted((both - rb) & set(out))
+        tail = ast.Return(value=self._names_tuple(out, ast.Load))
+        t_fn = self._make_fn(tname, out, node.body, tail)
+        f_fn = self._make_fn(fname, out, node.orelse, tail)
+        assign = ast.Assign(
+            targets=[self._names_tuple(out, ast.Store)],
+            value=self._call_helper("_sot_if", node.test, tname, fname,
+                                    out, dummy, loc))
+        self.changed = True
+        return [t_fn, f_fn, assign]
+
+    def visit_While(self, node):
+        node = self.generic_visit(node)
+        if node.orelse:
+            return node
+        scan = _scan(node.body)
+        if scan.blocked or scan.has_return:
+            return node
+        body_stores, _ = _names(node.body)
+        _, test_loads = _names(node.test)
+        carry = sorted((body_stores | (test_loads & self.fn_locals))
+                       & self.fn_locals)
+        if not carry:
+            return node
+        i = self.counter
+        self.counter += 1
+        cname, bname = f"_sot_cond_{i}", f"_sot_body_{i}"
+        loc = self._loc(node)
+        c_fn = self._make_fn(cname, carry, [ast.Return(value=node.test)],
+                             None)
+        b_fn = self._make_fn(
+            bname, carry, node.body,
+            ast.Return(value=self._names_tuple(carry, ast.Load)))
+        assign = ast.Assign(
+            targets=[self._names_tuple(carry, ast.Store)],
+            value=ast.Call(
+                func=ast.Name(id="_sot_while", ctx=ast.Load()),
+                args=[ast.Name(id=cname, ctx=ast.Load()),
+                      ast.Name(id=bname, ctx=ast.Load()),
+                      ast.Call(func=ast.Name(id="locals", ctx=ast.Load()),
+                               args=[], keywords=[]),
+                      ast.Tuple(elts=[ast.Constant(value=n) for n in carry],
+                                ctx=ast.Load()),
+                      ast.Constant(value=loc)],
+                keywords=[]))
+        self.changed = True
+        return [c_fn, b_fn, assign]
+
+
+def convert_control_flow(fn: Callable) -> Tuple[Callable, bool]:
+    """Return (converted_fn, changed).  On any structural obstacle the
+    original function is returned unchanged."""
+    bound_self = None
+    target = fn
+    if inspect.ismethod(fn):
+        bound_self, target = fn.__self__, fn.__func__
+    if not inspect.isfunction(target):
+        return fn, False
+    if hasattr(target, "__wrapped__"):
+        # functools.wraps chain: getsource would return the INNER
+        # function's source and the recompile would silently drop the
+        # wrapper's behavior (and mismatch closure cells) — leave it alone
+        return fn, False
+    try:
+        src = textwrap.dedent(inspect.getsource(target))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn, False
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn, False
+    fdef.decorator_list = []
+
+    # the function's own local names: parameters + every store in the body
+    params = {a.arg for a in (fdef.args.posonlyargs + fdef.args.args
+                              + fdef.args.kwonlyargs)}
+    if fdef.args.vararg:
+        params.add(fdef.args.vararg.arg)
+    if fdef.args.kwarg:
+        params.add(fdef.args.kwarg.arg)
+    body_stores, _ = _names(fdef.body)
+    fn_locals = params | body_stores
+
+    tr = _CFTransformer(fn_locals, inspect.getfile(target))
+    # visit the body statements directly: the top-level def itself must not
+    # trip the nested-scope guard
+    new_body = []
+    for stmt in fdef.body:
+        res = tr.visit(stmt)
+        if isinstance(res, list):
+            new_body.extend(res)
+        elif res is not None:
+            new_body.append(res)
+    fdef.body = new_body
+    if not tr.changed:
+        return fn, False
+    ast.fix_missing_locations(tree)
+    try:
+        code = compile(tree, filename=f"<sot:{target.__name__}>",
+                       mode="exec")
+    except SyntaxError:
+        return fn, False
+    ns = dict(target.__globals__)
+    # freevars: the re-compiled def has no closure cells; snapshot values
+    if target.__closure__:
+        for name, cell in zip(target.__code__.co_freevars,
+                              target.__closure__):
+            try:
+                ns[name] = cell.cell_contents
+            except ValueError:
+                return fn, False  # unfilled cell (recursive def)
+    ns.update(_sot_if=_sot_if, _sot_if_ret=_sot_if_ret,
+              _sot_while=_sot_while, _SOT_UNDEF=_SOT_UNDEF)
+    exec(code, ns)
+    new_fn = ns[fdef.name]
+    if target.__defaults__ is not None:
+        new_fn.__defaults__ = target.__defaults__
+    if target.__kwdefaults__:
+        new_fn.__kwdefaults__ = dict(target.__kwdefaults__)
+    if bound_self is not None:
+        new_fn = types.MethodType(new_fn, bound_self)
+    return new_fn, True

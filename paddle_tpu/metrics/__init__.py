@@ -173,3 +173,14 @@ class Auc(Metric):
 
     def name(self):
         return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Reference: paddle.metric.accuracy — top-k accuracy of softmax
+    ``input`` [N, C] against int ``label`` [N] or [N, 1]."""
+    import jax.numpy as jnp
+    input = jnp.asarray(input)
+    label = jnp.asarray(label).reshape(-1)
+    topk = jnp.argsort(-input, axis=-1)[:, :k]
+    hit = (topk == label[:, None]).any(axis=-1)
+    return hit.mean(dtype=jnp.float32)

@@ -4,7 +4,7 @@ from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from . import utils  # noqa: F401
 from .layer import (Layer, ParamAttr, ParameterList, functional_call,  # noqa: F401
-                    raw_params, trainable_mask)
+                    meta_init, raw_params, trainable_mask)
 from .layers_common import (  # noqa: F401
     AvgPool2D, BatchNorm1D, BatchNorm2D, BCEWithLogitsLoss, Conv2D,
     CrossEntropyLoss, Dropout, Embedding, Flatten, GELU, GroupNorm,
@@ -28,4 +28,11 @@ from .layers_more import (  # noqa: F401
     PairwiseDistance, SELU, Softmax2D, Softshrink, SyncBatchNorm,
     Tanhshrink, ThresholdedReLU, Unflatten, Unfold,
     UpsamplingBilinear2D, UpsamplingNearest2D, ZeroPad2D)
+from .layers_tail3 import (  # noqa: F401
+    CTCLoss, CosineEmbeddingLoss, FractionalMaxPool2D, FractionalMaxPool3D,
+    GaussianNLLLoss, HingeEmbeddingLoss, LPPool1D, LPPool2D, LogSoftmax,
+    MaxUnPool1D, MaxUnPool3D, Maxout, MultiLabelSoftMarginLoss,
+    MultiMarginLoss, PoissonNLLLoss, RNNTLoss, RReLU, SoftMarginLoss,
+    Softsign, SpectralNorm, TripletMarginLoss,
+    TripletMarginWithDistanceLoss, ZeroPad1D, ZeroPad3D)
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401

@@ -1137,3 +1137,17 @@ def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
     if reduction == "sum":
         return jnp.sum(out)
     return out
+
+
+# round-3 tail (losses, lp/fractional pooling, gumbel softmax, rnnt) —
+# see functional_tail3.py
+from .functional_tail3 import *  # noqa: F401,F403,E402
+from .functional_tail3 import (soft_margin_loss, multi_margin_loss,  # noqa: F401,E402
+                               multi_label_soft_margin_loss,
+                               triplet_margin_with_distance_loss,
+                               poisson_nll_loss, gaussian_nll_loss,
+                               sigmoid_focal_loss, dice_loss, npair_loss,
+                               square_error_cost, rnnt_loss, gumbel_softmax,
+                               lp_pool1d, lp_pool2d, max_unpool1d,
+                               max_unpool3d, fractional_max_pool2d,
+                               fractional_max_pool3d)

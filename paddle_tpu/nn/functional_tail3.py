@@ -1,0 +1,395 @@
+"""Round-3 functional tail: loss zoo completion + pooling/activation ops.
+
+Reference: python/paddle/nn/functional/{loss,pooling,activation}.py members
+not yet covered (SURVEY §2.6 nn row).  Torch-oracle tests in
+tests/test_nn_tail3.py.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as prandom
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return out.mean()
+    if reduction == "sum":
+        return out.sum()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """Reference: paddle.nn.functional.soft_margin_loss —
+    log(1 + exp(-label * input))."""
+    out = jnp.log1p(jnp.exp(-label * input))
+    return _reduce(out, reduction)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Reference: multi_margin_loss — hinge over the true class margin."""
+    n, c = input.shape
+    true = jnp.take_along_axis(input, label[:, None], axis=1)  # (n, 1)
+    m = jnp.maximum(0.0, margin - true + input) ** p
+    if weight is not None:
+        m = m * weight[label][:, None]
+    mask = jax.nn.one_hot(label, c, dtype=bool)
+    out = jnp.where(mask, 0.0, m).sum(axis=1) / c
+    return _reduce(out, reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    """Reference: multi-label one-versus-all soft margin."""
+    out = -(label * jax.nn.log_sigmoid(input)
+            + (1 - label) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        out = out * weight
+    out = out.mean(axis=-1)
+    return _reduce(out, reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    dist = distance_function
+    if dist is None:
+        dist = lambda a, b: jnp.linalg.norm(a - b, axis=-1)
+    dp = dist(input, positive)
+    dn = dist(input, negative)
+    if swap:
+        dn = jnp.minimum(dn, dist(positive, negative))
+    return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    if log_input:
+        out = jnp.exp(input) - label * input
+    else:
+        out = input - label * jnp.log(input + epsilon)
+    if full:
+        # Stirling term for label > 1 (reference/torch semantics)
+        stirling = (label * jnp.log(label) - label
+                    + 0.5 * jnp.log(2 * math.pi * label))
+        out = out + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(out, reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    var = jnp.clip(variance, epsilon, None)
+    out = 0.5 * (jnp.log(var) + (input - label) ** 2 / var)
+    if full:
+        out = out + 0.5 * math.log(2 * math.pi)
+    return _reduce(out, reduction)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
+                       gamma=2.0, reduction="sum", name=None):
+    """Reference: paddle.nn.functional.sigmoid_focal_loss (RetinaNet)."""
+    p = jax.nn.sigmoid(logit)
+    ce = -(label * jax.nn.log_sigmoid(logit)
+           + (1 - label) * jax.nn.log_sigmoid(-logit))
+    p_t = p * label + (1 - p) * (1 - label)
+    out = ce * ((1 - p_t) ** gamma)
+    if alpha >= 0:
+        out = out * (alpha * label + (1 - alpha) * (1 - label))
+    if normalizer is not None:
+        out = out / normalizer
+    return _reduce(out, reduction)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Reference: paddle dice_loss — input [N, ..., C] probabilities,
+    label [N, ..., 1] int class ids."""
+    c = input.shape[-1]
+    oh = jax.nn.one_hot(jnp.squeeze(label, -1), c, dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inter = (input * oh).sum(axis=reduce_dims)
+    union = input.sum(axis=reduce_dims) + oh.sum(axis=reduce_dims)
+    return (1.0 - (2 * inter + epsilon) / (union + epsilon)).mean()
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """Reference: paddle npair_loss (Sohn 2016): softmax CE over the
+    anchor·positiveᵀ similarity matrix + L2 on the embeddings."""
+    labels = labels.reshape(-1)
+    same = (labels[:, None] == labels[None, :]).astype(anchor.dtype)
+    tgt = same / same.sum(axis=1, keepdims=True)
+    sim = anchor @ positive.T
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -(tgt * logp).sum(axis=1).mean()
+    reg = l2_reg * ((anchor ** 2).sum(axis=1).mean()
+                    + (positive ** 2).sum(axis=1).mean()) / 2
+    return ce + reg
+
+
+def square_error_cost(input, label, name=None):
+    return (input - label) ** 2
+
+
+def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """Reference: paddle.nn.functional.rnnt_loss (RNA/RNN-T transducer).
+
+    ``logits``: (B, T, U+1, V) joint-network outputs; ``labels``: (B, U)
+    int targets.  Log-domain forward DP over the (T, U) lattice via a
+    wavefront scan — XLA-friendly (no data-dependent Python loops)."""
+    b, t_max, u1, v = logits.shape
+    u_max = u1 - 1
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    # per (t, u): emit-prob of the next label, and blank prob
+    lab = labels.astype(jnp.int32)
+    emit = jnp.take_along_axis(
+        logp, jnp.pad(lab, ((0, 0), (0, 1)))[:, None, :, None]
+        .repeat(t_max, 1), axis=-1)[..., 0]          # (B, T, U+1)
+    blank_p = logp[..., blank]                       # (B, T, U+1)
+    NEG = -1e30
+
+    # alpha DP: alpha[t, u] = logsumexp(alpha[t-1, u] + blank[t-1, u],
+    #                                   alpha[t, u-1] + emit[t, u-1])
+    # scan over t; inner associative scan over u per row
+    def row_scan(alpha_prev, x):
+        blank_prev, emit_row = x     # (B, U+1) each
+        # base: coming from the row above (time step t-1)
+        base = alpha_prev + blank_prev
+        # then cumulative emissions along u:
+        # alpha[u] = logsumexp over j<=u of base[j] + sum(emit[j..u-1])
+        def u_step(carry, xu):
+            base_u, emit_u = xu
+            cur = jnp.logaddexp(carry + emit_u, base_u)
+            return cur, cur
+        e_shift = jnp.concatenate([jnp.full((b, 1), NEG), emit_row[:, :-1]],
+                                  axis=1)
+        _, rows = jax.lax.scan(
+            u_step, jnp.full((b,), NEG),
+            (base.T, e_shift.T))
+        alpha = rows.T
+        return alpha, alpha
+
+    alpha0 = jnp.full((b, u1), NEG).at[:, 0].set(0.0)
+    # u-cumulation for t=0 row: only emits
+    def u0_step(carry, emit_u):
+        cur = carry + emit_u
+        return cur, cur
+    _, a0rows = jax.lax.scan(u0_step, jnp.zeros((b,)),
+                             emit[:, 0, :-1].T)
+    alpha_t0 = jnp.concatenate([jnp.zeros((b, 1)), a0rows.T], axis=1)
+
+    def t_step(alpha_prev, x):
+        blank_prev, emit_row = x
+        return row_scan(alpha_prev, (blank_prev, emit_row))
+
+    _, alphas = jax.lax.scan(
+        t_step, alpha_t0,
+        (blank_p[:, :-1].transpose(1, 0, 2), emit[:, 1:].transpose(1, 0, 2)))
+    alphas = jnp.concatenate([alpha_t0[None], alphas], axis=0)  # (T, B, U+1)
+
+    t_idx = (logit_lengths - 1).astype(jnp.int32)
+    u_idx = label_lengths.astype(jnp.int32)
+    a_final = alphas[t_idx, jnp.arange(b), u_idx]
+    blank_final = blank_p[jnp.arange(b), t_idx, u_idx]
+    nll = -(a_final + blank_final)
+    if reduction == "mean":
+        return nll.mean()
+    if reduction == "sum":
+        return nll.sum()
+    return nll
+
+
+# ---------------------------------------------------------------------------
+# activations / pooling
+# ---------------------------------------------------------------------------
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    """Reference: paddle.nn.functional.gumbel_softmax."""
+    key = prandom.next_key("gumbel")
+    g = jax.random.gumbel(key, x.shape, x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        # straight-through: one-hot forward, softmax gradient
+        if axis in (-1, x.ndim - 1):
+            hard_y = jax.nn.one_hot(jnp.argmax(y, axis=axis),
+                                    y.shape[axis], dtype=y.dtype)
+        else:
+            y_max = jnp.max(y, axis=axis, keepdims=True)
+            hard_y = (y == y_max).astype(y.dtype)
+        return jax.lax.stop_gradient(hard_y - y) + y
+    return y
+
+
+def _sum_pool(x, window, strides, pad_spatial, ceil_mode):
+    """Sum-reduce spatial windows over the trailing len(window) dims."""
+    ndim = x.ndim
+    k = len(window)
+    full_window = (1,) * (ndim - k) + tuple(window)
+    full_strides = (1,) * (ndim - k) + tuple(strides)
+    pads = [(0, 0)] * (ndim - k)
+    for i in range(k):
+        lo = pad_spatial[i]
+        hi = pad_spatial[i]
+        if ceil_mode:
+            n = x.shape[ndim - k + i] + 2 * pad_spatial[i]
+            rem = (n - window[i]) % strides[i]
+            if rem:
+                hi += strides[i] - rem
+        pads.append((lo, hi))
+    return jax.lax.reduce_window(x, 0.0, jax.lax.add, full_window,
+                                 full_strides, pads)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    """Reference: paddle.nn.functional.lp_pool1d —
+    (sum x^p over window)^(1/p)."""
+    p = float(norm_type)
+    s = kernel_size if stride is None else stride
+    summed = _sum_pool(x ** p, (kernel_size,), (s,), (padding,), ceil_mode)
+    return summed ** (1.0 / p)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    p = float(norm_type)
+    ks = ((kernel_size, kernel_size) if isinstance(kernel_size, int)
+          else tuple(kernel_size))
+    st = (ks if stride is None else
+          ((stride, stride) if isinstance(stride, int) else tuple(stride)))
+    pd = ((padding, padding) if isinstance(padding, int)
+          else tuple(padding))
+    summed = _sum_pool(x ** p, ks, st, pd, ceil_mode)
+    return summed ** (1.0 / p)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """Reference: max_unpool1d — inverse of max_pool1d w/ return_mask."""
+    from .functional import max_unpool2d
+    out = max_unpool2d(x[..., None, :], indices[..., None, :],
+                       (1, kernel_size),
+                       stride=(1, stride or kernel_size),
+                       padding=(0, padding) if padding else 0,
+                       output_size=(None if output_size is None
+                                    else (1, output_size[-1])))
+    return out[..., 0, :]
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    """Reference: max_unpool3d — scatter back by flat DHW indices."""
+    b, c, d, h, w = x.shape
+    if output_size is None:
+        ks = ((kernel_size,) * 3 if isinstance(kernel_size, int)
+              else tuple(kernel_size))
+        st = (ks if stride is None else
+              ((stride,) * 3 if isinstance(stride, int) else tuple(stride)))
+        pd = ((padding,) * 3 if isinstance(padding, int)
+              else tuple(padding))
+        od = (d - 1) * st[0] - 2 * pd[0] + ks[0]
+        oh = (h - 1) * st[1] - 2 * pd[1] + ks[1]
+        ow = (w - 1) * st[2] - 2 * pd[2] + ks[2]
+    else:
+        od, oh, ow = output_size[-3:]
+    flat = jnp.zeros((b, c, od * oh * ow), x.dtype)
+    out = flat.at[jnp.arange(b)[:, None, None], jnp.arange(c)[None, :, None],
+                  indices.reshape(b, c, -1)].set(x.reshape(b, c, -1))
+    return out.reshape(b, c, od, oh, ow)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None,
+                          random_u=None, return_mask=False, name=None):
+    """Reference: paddle.nn.functional.fractional_max_pool2d.
+
+    Pooling regions follow the fractional scheme (Graham 2014) with a
+    single random u per call (paddle's ``random_u``): region boundaries
+    alpha = in/out, start_i = ceil(alpha*(i+u)) - ceil(alpha*u)."""
+    b, c, h, w = x.shape
+    oh, ow = ((output_size, output_size) if isinstance(output_size, int)
+              else tuple(output_size)[-2:])
+    if random_u is None:
+        key = prandom.next_key("fractional_pool")
+        u = float(jax.random.uniform(key, ()))
+    else:
+        u = float(random_u)
+
+    def edges(n_in, n_out):
+        import numpy as np
+        alpha = n_in / n_out
+        idx = np.arange(n_out + 1)
+        pts = np.ceil(alpha * (idx + u)).astype(int) - int(np.ceil(alpha * u))
+        pts[-1] = n_in
+        return pts
+
+    eh, ew = edges(h, oh), edges(w, ow)
+    rows = []
+    for i in range(oh):
+        cols = []
+        for j in range(ow):
+            win = x[:, :, eh[i]:eh[i + 1], ew[j]:ew[j + 1]]
+            cols.append(win.max(axis=(2, 3)))
+        rows.append(jnp.stack(cols, axis=-1))
+    out = jnp.stack(rows, axis=-2)
+    if return_mask:
+        # flat HxW argmax index per output cell
+        masks = []
+        for i in range(oh):
+            mcols = []
+            for j in range(ow):
+                win = x[:, :, eh[i]:eh[i + 1], ew[j]:ew[j + 1]]
+                wh = win.shape[2]
+                ww = win.shape[3]
+                am = jnp.argmax(win.reshape(b, c, -1), axis=-1)
+                r = am // ww + eh[i]
+                cc = am % ww + ew[j]
+                mcols.append(r * w + cc)
+            masks.append(jnp.stack(mcols, axis=-1))
+        return out, jnp.stack(masks, axis=-2)
+    return out
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None,
+                          random_u=None, return_mask=False, name=None):
+    """Reference: fractional_max_pool3d — depth added to the 2-D scheme."""
+    b, c, d, h, w = x.shape
+    od, oh, ow = ((output_size,) * 3 if isinstance(output_size, int)
+                  else tuple(output_size)[-3:])
+    if random_u is None:
+        key = prandom.next_key("fractional_pool")
+        u = float(jax.random.uniform(key, ()))
+    else:
+        u = float(random_u)
+
+    def edges(n_in, n_out):
+        import numpy as np
+        alpha = n_in / n_out
+        idx = np.arange(n_out + 1)
+        pts = np.ceil(alpha * (idx + u)).astype(int) - int(np.ceil(alpha * u))
+        pts[-1] = n_in
+        return pts
+
+    ed, eh, ew = edges(d, od), edges(h, oh), edges(w, ow)
+    out = jnp.stack([
+        jnp.stack([
+            jnp.stack([
+                x[:, :, ed[a]:ed[a + 1], eh[i]:eh[i + 1],
+                  ew[j]:ew[j + 1]].max(axis=(2, 3, 4))
+                for j in range(ow)], axis=-1)
+            for i in range(oh)], axis=-2)
+        for a in range(od)], axis=-3)
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool3d(return_mask=True): use the 2-D variant "
+            "or max_pool3d(return_mask=True)")
+    return out

@@ -116,11 +116,19 @@ class KaimingNormal(Initializer):
 
 # paddle default initializers: XavierUniform-ish for weights, zeros for bias.
 def default_weight_init():
+    if _GLOBAL_INIT[0] is not None:
+        return _GLOBAL_INIT[0]
     return XavierUniform()
 
 
 def default_bias_init():
+    if _GLOBAL_INIT[1] is not None:
+        return _GLOBAL_INIT[1]
     return Constant(0.0)
+
+
+_GLOBAL_INIT = [None, None]   # (weight_init, bias_init) — see
+                              # set_global_initializer below
 
 
 class Orthogonal(Initializer):
@@ -184,3 +192,46 @@ class Dirac(Initializer):
                 idx = (g * og + i, i) + centers
                 w = w.at[idx].set(1.0)
         return w
+
+
+def calculate_gain(nonlinearity, param=None):
+    """Reference: paddle.nn.initializer.calculate_gain."""
+    gains = {"linear": 1.0, "conv1d": 1.0, "conv2d": 1.0, "conv3d": 1.0,
+             "conv_transpose1d": 1.0, "conv_transpose2d": 1.0,
+             "conv_transpose3d": 1.0, "sigmoid": 1.0,
+             "tanh": 5.0 / 3.0, "relu": math.sqrt(2.0),
+             "selu": 3.0 / 4.0}
+    if nonlinearity in gains:
+        return gains[nonlinearity]
+    if nonlinearity == "leaky_relu":
+        slope = 0.01 if param is None else float(param)
+        return math.sqrt(2.0 / (1 + slope ** 2))
+    raise ValueError(f"unknown nonlinearity {nonlinearity!r}")
+
+
+class Bilinear(Initializer):
+    """Reference: paddle.nn.initializer.Bilinear — bilinear-upsampling
+    kernel for transposed convolutions [C_out, C_in, k, k]."""
+
+    def __call__(self, key, shape, dtype):
+        import numpy as np
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D shape")
+        k = shape[3]
+        factor = (k + 1) // 2
+        center = factor - 1.0 if k % 2 == 1 else factor - 0.5
+        og = np.ogrid[:k, :k]
+        filt = ((1 - np.abs(og[0] - center) / factor)
+                * (1 - np.abs(og[1] - center) / factor))
+        # place the kernel on the diagonal channel pairs
+        w = np.zeros(shape, np.float32)
+        for i in range(shape[0]):
+            w[i, i % shape[1]] = filt
+        return jnp.asarray(w, dtype)
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Reference: paddle.nn.initializer.set_global_initializer — default
+    initializers used when a layer gives none."""
+    _GLOBAL_INIT[0] = weight_init
+    _GLOBAL_INIT[1] = bias_init

@@ -33,6 +33,25 @@ from ..core import random as prandom
 from . import initializer as I
 
 
+_META_INIT = [False]
+
+
+@contextlib.contextmanager
+def meta_init():
+    """Construct layers abstractly: parameters become
+    ``jax.ShapeDtypeStruct`` leaves instead of materialised arrays
+    (reference: ``paddle.LazyGuard`` — python/paddle/fluid/lazy_init.py).
+
+    Use for AOT compilation/memory analysis of models that do not fit host
+    RAM (``TrainStep.abstract_state`` + ``tools/memproof.py``).  A
+    meta-constructed model cannot run eagerly; it can only be lowered."""
+    _META_INIT[0] = True
+    try:
+        yield
+    finally:
+        _META_INIT[0] = False
+
+
 class ParamMeta:
     """Per-parameter metadata kept outside the array itself."""
 
@@ -104,7 +123,15 @@ class Layer:
         if not callable(init):
             raise TypeError("default_initializer must be callable")
         key = prandom.next_key("param_init")
-        value = init(key, tuple(shape), dtype)
+        if _META_INIT[0]:
+            # meta/abstract construction (paddle.LazyGuard analogue): record
+            # shape+dtype only — no initializer runs, nothing materialises.
+            # Enables AOT memory/compile analysis of models far larger than
+            # host RAM (tools/memproof.py).
+            value = jax.ShapeDtypeStruct(tuple(int(d) for d in shape),
+                                         jnp.empty((), dtype).dtype)
+        else:
+            value = init(key, tuple(shape), dtype)
         meta = ParamMeta(trainable=trainable, partition=partition, is_bias=is_bias)
         # keyed by id but guarded by a weakref: a discarded staged param's id
         # can be recycled by CPython — the weakref identity check in
@@ -112,7 +139,10 @@ class Layer:
         import weakref
         self._pending_params = {k: v for k, v in self._pending_params.items()
                                 if v[0]() is not None}  # purge dead entries
-        self._pending_params[id(value)] = (weakref.ref(value), meta)
+        # ShapeDtypeStruct (meta_init) is not weakref-able; a strong ref is
+        # fine there — structs are tiny and construction is short-lived
+        ref = ((lambda v=value: v) if _META_INIT[0] else weakref.ref(value))
+        self._pending_params[id(value)] = (ref, meta)
         return value
 
     def _register_parameter(self, name: str, value, meta: "ParamMeta"):
@@ -315,7 +345,12 @@ class Layer:
         for path, sub in self.named_sublayers(include_self=True, prefix=""):
             for name, p in list(sub._parameters.items()):
                 if jnp.issubdtype(p.dtype, jnp.floating):
-                    sub._parameters[name] = p.astype(dtype)
+                    if isinstance(p, jax.ShapeDtypeStruct):
+                        # meta_init() construction: cast abstractly
+                        sub._parameters[name] = jax.ShapeDtypeStruct(
+                            p.shape, jnp.empty((), dtype).dtype)
+                    else:
+                        sub._parameters[name] = p.astype(dtype)
                     object.__setattr__(sub, name, sub._parameters[name])
             for name, b in list(sub._buffers.items()):
                 if hasattr(b, "dtype") and jnp.issubdtype(b.dtype, jnp.floating):

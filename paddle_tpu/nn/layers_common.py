@@ -135,11 +135,20 @@ class Linear(Layer):
 
 
 class Embedding(Layer):
+    """``paddle.nn.Embedding`` parity.
+
+    ``sparse=True`` marks the weight for rows-sparse (SelectedRows)
+    gradients: compute them with :meth:`rows_grad` and feed the result to
+    ``Optimizer.apply`` (SGD scatter-add / Adam ``lazy_mode``) — the
+    dense autodiff path is unaffected (XLA's scatter-add on the dense
+    cotangent is already rows-shaped work on TPU)."""
+
     def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
                  sparse=False, weight_attr=None, name=None, partition=None):
         super().__init__()
         self.num_embeddings, self.embedding_dim = num_embeddings, embedding_dim
         self.padding_idx = padding_idx
+        self.sparse = sparse
         self.weight = self.create_parameter(
             (num_embeddings, embedding_dim), attr=weight_attr,
             default_initializer=I.Normal(0.0, 1.0) if weight_attr is None else None,
@@ -147,6 +156,13 @@ class Embedding(Layer):
 
     def forward(self, x):
         return F.embedding(x, self.weight, padding_idx=self.padding_idx)
+
+    def rows_grad(self, ids, grad_out):
+        """SelectedRows gradient of ``forward(ids)`` w.r.t. the weight:
+        (rows, values) for the optimizer's sparse rule."""
+        from ..sparse.rows import embedding_rows_grad
+        return embedding_rows_grad(ids, grad_out, self.num_embeddings,
+                                   padding_idx=self.padding_idx)
 
     def extra_repr(self):
         return f"{self.num_embeddings}, {self.embedding_dim}"

@@ -1,0 +1,229 @@
+"""Round-3 nn layer tail (SURVEY §2.6 nn row).
+
+Reference: python/paddle/nn/layer/{activation,pooling,loss,norm}.py
+members not yet covered.  Thin Layer wrappers over the functional ops;
+torch-oracle tests in tests/test_nn_tail3.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import functional as F
+from .layer import Layer
+
+
+def maxout(x, groups, axis=1, name=None):
+    """Reference: paddle.nn.functional.maxout — max over ``groups``-sized
+    chunks of the channel dim."""
+    x = jnp.asarray(x)
+    axis = axis % x.ndim
+    c = x.shape[axis]
+    if c % groups:
+        raise ValueError(f"channels {c} not divisible by groups {groups}")
+    shape = (x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1:])
+    return jnp.max(x.reshape(shape), axis=axis + 1)
+
+
+F.maxout = maxout
+
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1, name=None):
+        super().__init__()
+        self.groups, self.axis = groups, axis
+
+    def forward(self, x):
+        return maxout(x, self.groups, self.axis)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8, upper=1.0 / 3, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class Softsign(Layer):
+    def forward(self, x):
+        return F.softsign(x)
+
+
+class LogSoftmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.log_softmax(x, axis=self.axis)
+
+
+class LPPool1D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self.args = (norm_type, kernel_size, stride, padding, ceil_mode,
+                     data_format)
+
+    def forward(self, x):
+        return F.lp_pool1d(x, *self.args)
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self.args = (norm_type, kernel_size, stride, padding, ceil_mode,
+                     data_format)
+
+    def forward(self, x):
+        return F.lp_pool2d(x, *self.args)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format)
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, *self.args,
+                              output_size=self.output_size)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, data_format)
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, *self.args,
+                              output_size=self.output_size)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.args = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, *self.args)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.args = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, *self.args)
+
+
+class ZeroPad1D(Layer):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__()
+        self.padding = ([padding] * 2 if isinstance(padding, int)
+                        else list(padding))
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, "constant", 0.0, self.data_format)
+
+
+class ZeroPad3D(Layer):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__()
+        self.padding = ([padding] * 6 if isinstance(padding, int)
+                        else list(padding))
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, "constant", 0.0, self.data_format)
+
+
+class SpectralNorm(Layer):
+    """Reference: paddle.nn.SpectralNorm — standalone layer returning the
+    spectrally-normalised WEIGHT (unlike nn.utils.spectral_norm, which
+    hooks an existing layer's parameter)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.dim, self.power_iters, self.eps = dim, power_iters, eps
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        from . import initializer as I
+        self.weight_u = self.create_parameter(
+            (h,), default_initializer=I.Normal(0.0, 1.0), trainable=False)
+        self.weight_v = self.create_parameter(
+            (w,), default_initializer=I.Normal(0.0, 1.0), trainable=False)
+
+    def forward(self, weight):
+        mat = jnp.moveaxis(weight, self.dim, 0).reshape(weight.shape[self.dim], -1)
+        u, v = self.weight_u, self.weight_v
+        for _ in range(self.power_iters):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + self.eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + self.eps)
+        sigma = u @ mat @ v
+        return weight / sigma
+
+
+def _loss_cls(name, fn, arg_names, defaults):
+    def __init__(self, **kwargs):
+        Layer.__init__(self)
+        self.kwargs = {**defaults, **kwargs}
+
+    def forward(self, *args):
+        return fn(*args, **self.kwargs)
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward,
+                                 "__doc__": f"Reference: paddle.nn.{name} "
+                                            f"(wraps F.{fn.__name__})"})
+
+
+SoftMarginLoss = _loss_cls("SoftMarginLoss", F.soft_margin_loss, (),
+                           {"reduction": "mean"})
+MultiMarginLoss = _loss_cls("MultiMarginLoss", F.multi_margin_loss, (),
+                            {"p": 1, "margin": 1.0, "weight": None,
+                             "reduction": "mean"})
+MultiLabelSoftMarginLoss = _loss_cls(
+    "MultiLabelSoftMarginLoss", F.multi_label_soft_margin_loss, (),
+    {"weight": None, "reduction": "mean"})
+TripletMarginLoss = _loss_cls(
+    "TripletMarginLoss", F.triplet_margin_loss, (),
+    {"margin": 1.0, "p": 2, "swap": False, "reduction": "mean"})
+TripletMarginWithDistanceLoss = _loss_cls(
+    "TripletMarginWithDistanceLoss", F.triplet_margin_with_distance_loss,
+    (), {"distance_function": None, "margin": 1.0, "swap": False,
+         "reduction": "mean"})
+CosineEmbeddingLoss = _loss_cls(
+    "CosineEmbeddingLoss", F.cosine_embedding_loss, (),
+    {"margin": 0.0, "reduction": "mean"})
+HingeEmbeddingLoss = _loss_cls(
+    "HingeEmbeddingLoss", F.hinge_embedding_loss, (),
+    {"margin": 1.0, "reduction": "mean"})
+PoissonNLLLoss = _loss_cls(
+    "PoissonNLLLoss", F.poisson_nll_loss, (),
+    {"log_input": True, "full": False, "epsilon": 1e-8,
+     "reduction": "mean"})
+GaussianNLLLoss = _loss_cls(
+    "GaussianNLLLoss", F.gaussian_nll_loss, (),
+    {"full": False, "epsilon": 1e-6, "reduction": "mean"})
+CTCLoss = _loss_cls("CTCLoss", F.ctc_loss, (),
+                    {"blank": 0, "reduction": "mean"})
+RNNTLoss = _loss_cls("RNNTLoss", F.rnnt_loss, (),
+                     {"blank": 0, "fastemit_lambda": 0.0,
+                      "reduction": "mean"})

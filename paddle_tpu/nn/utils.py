@@ -144,3 +144,38 @@ def vector_to_parameters(vec: jax.Array,
         out.append(vec[offset:offset + n].reshape(p.shape).astype(p.dtype))
         offset += n
     return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """Reference: paddle.nn.utils.clip_grad_norm_ — functional variant:
+    jax arrays are immutable, so this takes GRADIENTS and returns the
+    clipped gradients plus the total norm (rebind at the call site)."""
+    import jax.numpy as jnp
+    grads = parameters if isinstance(parameters, (list, tuple)) else [parameters]
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.asarray([jnp.abs(g).max() for g in grads]))
+    else:
+        total = jnp.sum(jnp.asarray(
+            [jnp.sum(jnp.abs(g) ** norm_type) for g in grads])) ** (
+                1.0 / norm_type)
+    if error_if_nonfinite:
+        import jax as _jax
+        if not isinstance(total, _jax.core.Tracer) and \
+                not bool(jnp.isfinite(total)):
+            raise RuntimeError(
+                f"gradient norm is {float(total)}; set "
+                "error_if_nonfinite=False to clip anyway")
+    scale = jnp.minimum(1.0, max_norm / (total + 1e-6))
+    clipped = [g * scale for g in grads]
+    out = clipped if isinstance(parameters, (list, tuple)) else clipped[0]
+    return out, total
+
+
+def clip_grad_value_(parameters, clip_value):
+    """Reference: paddle.nn.utils.clip_grad_value_ — functional variant
+    (returns clipped gradients; see clip_grad_norm_)."""
+    import jax.numpy as jnp
+    grads = parameters if isinstance(parameters, (list, tuple)) else [parameters]
+    clipped = [jnp.clip(g, -clip_value, clip_value) for g in grads]
+    return clipped if isinstance(parameters, (list, tuple)) else clipped[0]

@@ -713,6 +713,7 @@ logcumsumexp = getattr(jnp, "logcumsumexp", None) or (
     lambda x, axis=-1: jax.lax.associative_scan(jnp.logaddexp, x, axis=axis))
 
 from .more import *  # noqa: F401,F403,E402 — breadth ops (see more.py)
+from .tail3 import *  # noqa: F401,F403,E402 — round-3 tail (see tail3.py)
 
 # Star-export surface: everything public defined here, nothing imported.
 _EXCLUDE = {"jax", "jnp", "np", "dispatch", "more", "Optional", "Sequence",
@@ -821,3 +822,60 @@ linalg.ormqr = staticmethod(_linalg_ormqr)
 linalg.svd_lowrank = staticmethod(_linalg_svd_lowrank)
 linalg.vector_norm = staticmethod(jnp.linalg.vector_norm)
 linalg.matrix_norm = staticmethod(jnp.linalg.matrix_norm)
+
+
+def _linalg_cholesky_inverse(x, upper=False):
+    """Reference: paddle.linalg.cholesky_inverse — inverse of A from its
+    Cholesky factor (A = LL^T or U^T U)."""
+    x = jnp.asarray(x)
+    ident = jnp.eye(x.shape[-1], dtype=x.dtype)
+    inv_f = jax.scipy.linalg.solve_triangular(x, ident, lower=not upper)
+    return (inv_f.T @ inv_f) if not upper else (inv_f @ inv_f.T)
+
+
+linalg.cholesky_inverse = staticmethod(_linalg_cholesky_inverse)
+# paddle.linalg re-exports these (python/paddle/linalg.py)
+from .tail3 import corrcoef as _t3_corrcoef, cov as _t3_cov  # noqa: E402
+
+linalg.corrcoef = staticmethod(_t3_corrcoef)
+linalg.cov = staticmethod(_t3_cov)
+linalg.solve_triangular = linalg.triangular_solve
+
+
+def _fft_hfftn(x, s=None, axes=None, norm="backward"):
+    """Reference: paddle.fft.hfftn — FFT of a Hermitian-symmetric signal:
+    ordinary (i)FFT over the leading axes, 1-D hfft on the last."""
+    x = jnp.asarray(x)
+    if axes is None:
+        axes = tuple(range(x.ndim))
+    axes = tuple(axes)
+    head = axes[:-1]
+    if head:
+        x = jnp.fft.fftn(x, s=None if s is None else s[:-1], axes=head,
+                         norm=norm)
+    return jnp.fft.hfft(x, n=None if s is None else s[-1], axis=axes[-1],
+                        norm=norm)
+
+
+def _fft_ihfftn(x, s=None, axes=None, norm="backward"):
+    x = jnp.asarray(x)
+    if axes is None:
+        axes = tuple(range(x.ndim))
+    axes = tuple(axes)
+    out = jnp.fft.ihfft(x, n=None if s is None else s[-1], axis=axes[-1],
+                        norm=norm)
+    head = axes[:-1]
+    if head:
+        out = jnp.fft.ifftn(out, s=None if s is None else s[:-1], axes=head,
+                            norm=norm)
+    return out
+
+
+fft.hfftn = staticmethod(_fft_hfftn)
+fft.ihfftn = staticmethod(_fft_ihfftn)
+fft.hfft2 = staticmethod(
+    lambda x, s=None, axes=(-2, -1), norm="backward":
+    _fft_hfftn(x, s=s, axes=axes, norm=norm))
+fft.ihfft2 = staticmethod(
+    lambda x, s=None, axes=(-2, -1), norm="backward":
+    _fft_ihfftn(x, s=s, axes=axes, norm=norm))

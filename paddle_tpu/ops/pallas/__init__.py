@@ -17,10 +17,54 @@ def _xla_fallback(q, k, v, causal, scale):
     return F._xla_attention(q, k, v, is_causal=causal, scale=scale)
 
 
+def _active_mesh():
+    """The physical mesh entered via ``with mesh:`` (TrainStep does this
+    around trace/lower), or None."""
+    from jax._src.mesh import thread_resources
+    mesh = thread_resources.env.physical_mesh
+    return None if (mesh.empty or mesh.size == 1) else mesh
+
+
+def _flash_shard_spec(mesh, q, k):
+    """PartitionSpec keeping the kernel per-device on a hybrid mesh: batch
+    over the data axes, heads over mp, seq/head_dim replicated.  Mosaic
+    kernels cannot be auto-partitioned by GSPMD — without an explicit
+    shard_map the multi-chip lowering fails outright.  Returns None when
+    the kernel cannot be cleanly partitioned (caller falls back to XLA)."""
+    import math as _math
+
+    from jax.sharding import PartitionSpec as P
+    names = mesh.axis_names
+    if "sep" in names and mesh.shape["sep"] > 1:
+        return None  # sequence parallel: the ring-attention path owns this
+    batch_axes = tuple(a for a in ("dp", "sharding")
+                       if a in names and mesh.shape[a] > 1)
+    mp = "mp" if "mp" in names and mesh.shape["mp"] > 1 else None
+    bdeg = _math.prod(mesh.shape[a] for a in batch_axes) if batch_axes else 1
+    mdeg = mesh.shape[mp] if mp else 1
+    b, _, h, _ = q.shape
+    hk = k.shape[2]
+    if b % bdeg or h % mdeg or hk % mdeg:
+        return None
+    return P(batch_axes if batch_axes else None, None, mp, None)
+
+
 def _flash_attention_dispatch(q, k, v, causal=False, scale=None):
     if not _fa.supported(q, k, v, causal=causal):
         return _xla_fallback(q, k, v, causal, scale)
-    return _fa.flash_attention(q, k, v, causal=causal, scale=scale)
+    mesh = _active_mesh()
+    if mesh is None:
+        return _fa.flash_attention(q, k, v, causal=causal, scale=scale)
+    spec = _flash_shard_spec(mesh, q, k)
+    if spec is None:
+        return _xla_fallback(q, k, v, causal, scale)
+    fn = jax.shard_map(
+        lambda q_, k_, v_: _fa.flash_attention(q_, k_, v_, causal=causal,
+                                               scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        # pallas_call's out_shape carries no varying-mesh-axes annotation
+        check_vma=False)
+    return fn(q, k, v)
 
 
 dispatch.register("flash_attention", _flash_attention_dispatch, platform="tpu")

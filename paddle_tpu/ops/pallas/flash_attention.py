@@ -41,6 +41,10 @@ DEFAULT_BLOCK_K = int(os.environ.get("PDTPU_FLASH_BLOCK_K", 1024))
 # optimum can differ from the fwd's
 BWD_BLOCK_Q = int(os.environ.get("PDTPU_FLASH_BWD_BLOCK_Q", 0)) or None
 BWD_BLOCK_K = int(os.environ.get("PDTPU_FLASH_BWD_BLOCK_K", 0)) or None
+# "merged": one kernel produces dk/dv (VMEM-accumulated) + dq (per-k-block
+# partials, reduced outside) — each tile's s/p recompute shared by all
+# three grads.  "split": the original dkv + dq kernel pair.
+BWD_MODE = os.environ.get("PDTPU_FLASH_BWD_MODE", "merged")
 NEG_INF = -1e30
 # The softmax runs in the base-2 domain: fold log2(e) into the qk scale so
 # the VPU evaluates exp2 directly instead of exp (= exp2 plus a per-element
@@ -232,6 +236,67 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def _bwd_merged_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, dqp_ref, dk_scr, dv_scr, *,
+                       scale, causal, block_q, block_k, offset):
+    """One-pass backward: dk/dv accumulate in VMEM over the inner q-blocks
+    (kv-major grid, as in _bwd_dkv_kernel) and the per-tile dq
+    contribution ds @ k is written to a per-k-block partial (unique
+    (ik, iq) slot — no cross-step accumulation), reduced outside.  Halves
+    the s/p recompute vs the split dkv+dq pair: each tile's qk product and
+    exp2 are computed once and feed all three gradients."""
+    ik, iq = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _body(masked):
+        q = q_ref[0, 0]                               # (bq, d)
+        k = k_ref[0, 0]                               # (bk, d)
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]                             # (bq, d)
+        lse = lse_ref[0, 0][:, 0]                     # (bq,)
+        delta = delta_ref[0, 0][:, 0]                 # (bq,)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * (
+                                    scale * LOG2E)
+        if masked:
+            s = _causal_mask(s, iq, ik, block_q, block_k, offset)
+        p = jnp.exp2(s - lse[:, None])                # (bq, bk) f32
+        dv_scr[:] += jax.lax.dot_general(p.astype(do.dtype), do,
+                                         (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_scr[:] += jax.lax.dot_general(ds.astype(q.dtype), q,
+                                         (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dqp_ref[0, 0, 0] = jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if not causal:
+        _body(False)
+    else:
+        live = _block_live(iq, ik, block_q, block_k, offset)
+        full = _block_fully_visible(iq, ik, block_q, block_k, offset)
+        pl.when(live & full)(lambda: _body(False))
+        pl.when(live & jnp.logical_not(full))(lambda: _body(True))
+        # dead tiles still own a unique dq-partial slot: zero it
+        pl.when(jnp.logical_not(live))(
+            lambda: dqp_ref.__setitem__((0, 0, 0),
+                                        jnp.zeros_like(dqp_ref[0, 0, 0])))
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_scr, *, scale, causal, block_q, block_k, offset):
     iq, ik = pl.program_id(2), pl.program_id(3)
@@ -274,12 +339,36 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
 
 
+def _bwd_vmem_estimate(bq, bk, d, itemsize, merged):
+    """Rough per-core VMEM bytes for one bwd grid cell: operand blocks
+    (q, k, v, do), f32 score/ds tiles, accumulator scratch, and (merged)
+    the dq-partial output block.  Used to auto-shrink blocks below the
+    ~16 MiB scoped-vmem limit instead of failing at compile time."""
+    operands = (2 * bq * d + 2 * bk * d) * itemsize
+    tiles = 3 * bq * bk * 4            # s/p, dp, ds in f32
+    scratch = 2 * bk * d * 4 + 2 * bk * d * 4   # dk/dv scratch + out blocks
+    if merged:
+        scratch += bq * d * 4          # dq-partial output block
+    # calibrated against the compiler's accounting: a d128 f32 merged cell
+    # at 1024/1024 measures 16.32M (estimate 17.3M); a d64 bf16 cell
+    # estimates 14.4M and compiles at 1024 blocks
+    return operands + tiles + scratch
+
+
 def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
     b, sq, h, d = q.shape
     sk, hkv = k.shape[1], k.shape[2]
     group = h // hkv
     bq = _pick_block(sq, BWD_BLOCK_Q or block_q)
     bk = _pick_block(sk, BWD_BLOCK_K or block_k)
+    vmem_budget = int(15.5 * 2 ** 20)
+    while (_bwd_vmem_estimate(bq, bk, d, q.dtype.itemsize,
+                              BWD_MODE == "merged") > vmem_budget
+           and max(bq, bk) > 128):
+        if bq >= bk:
+            bq //= 2
+        else:
+            bk //= 2
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
@@ -290,6 +379,63 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
                     axis=-1)                         # (b, h, sq)
     lse4 = lse[..., None]                            # (b, h, sq, 1)
     delta4 = delta[..., None]
+
+    mode = BWD_MODE
+    if mode == "merged" and sk // bk > 8:
+        # the dq-partials buffer is (sk/bk) x the dq footprint in f32 HBM;
+        # past ~8 k-blocks (long context) that transient outweighs the
+        # saved recompute — fall back to the split pair, which accumulates
+        # dq in VMEM scratch
+        mode = "split"
+    if mode == "merged":
+        # one-pass kernel: dq comes out as per-k-block partials (unique
+        # (ik, iq) slot each) reduced here; each tile's s/p recompute is
+        # shared by all three gradients
+        nkb = sk // bk
+        kernel_m = functools.partial(_bwd_merged_kernel, scale=scale,
+                                     causal=causal, block_q=bq, block_k=bk,
+                                     offset=sk - sq)
+        dk_h, dv_h, dqp = pl.pallas_call(
+            kernel_m,
+            grid=(b, h, nkb, sq // bq),
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, d),
+                             lambda ib, ih, ik, iq: (ib, ih, iq, 0)),
+                pl.BlockSpec((1, 1, bk, d),
+                             lambda ib, ih, ik, iq, g=group: (ib, ih // g, ik, 0)),
+                pl.BlockSpec((1, 1, bk, d),
+                             lambda ib, ih, ik, iq, g=group: (ib, ih // g, ik, 0)),
+                pl.BlockSpec((1, 1, bq, d),
+                             lambda ib, ih, ik, iq: (ib, ih, iq, 0)),
+                pl.BlockSpec((1, 1, bq, 1),
+                             lambda ib, ih, ik, iq: (ib, ih, iq, 0)),
+                pl.BlockSpec((1, 1, bq, 1),
+                             lambda ib, ih, ik, iq: (ib, ih, iq, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, bk, d),
+                             lambda ib, ih, ik, iq: (ib, ih, ik, 0)),
+                pl.BlockSpec((1, 1, bk, d),
+                             lambda ib, ih, ik, iq: (ib, ih, ik, 0)),
+                pl.BlockSpec((1, 1, 1, bq, d),
+                             lambda ib, ih, ik, iq: (ib, ih, ik, iq, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, h, sk, d), jnp.float32),
+                jax.ShapeDtypeStruct((b, h, sk, d), jnp.float32),
+                jax.ShapeDtypeStruct((b, h, nkb, sq, d), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bk, d), jnp.float32),
+                pltpu.VMEM((bk, d), jnp.float32),
+            ],
+            compiler_params=_DIMS,
+        )(qt, kt, vt, dot, lse4, delta4)
+        dq = dqp.sum(axis=2).astype(q.dtype)
+        dk = dk_h.reshape(b, hkv, group, sk, d).sum(axis=2).astype(k.dtype)
+        dv = dv_h.reshape(b, hkv, group, sk, d).sum(axis=2).astype(v.dtype)
+        return (dq.transpose(0, 2, 1, 3), dk.transpose(0, 2, 1, 3),
+                dv.transpose(0, 2, 1, 3))
 
     # dk/dv: kv-major grid; per q-head gradients for k/v then summed over
     # the GQA group outside (simpler than atomics across grid cells)

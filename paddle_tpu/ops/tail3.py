@@ -1,0 +1,157 @@
+"""Round-3 op tail: top-level math/stat ops + inplace-suffix surface.
+
+Reference: python/paddle/tensor/{math,stat,creation,manipulation}.py
+members not yet covered (SURVEY §2.6 tensor-ops row).  Oracle tests in
+tests/test_ops_tail3.py (NumPy/torch cross-checks).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# stats
+# ---------------------------------------------------------------------------
+
+def corrcoef(x, rowvar=True, name=None):
+    """Reference: paddle.linalg.corrcoef / paddle.corrcoef."""
+    return jnp.corrcoef(jnp.asarray(x), rowvar=rowvar)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    """Reference: paddle.linalg.cov — ddof is a BOOL (True → N-1)."""
+    return jnp.cov(jnp.asarray(x), rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+def histc(input, bins=100, min=0, max=0, name=None):
+    """Reference: paddle.histc (torch-compatible histogram counts)."""
+    x = jnp.asarray(input).reshape(-1).astype(jnp.float32)
+    lo, hi = float(min), float(max)
+    if lo == 0.0 and hi == 0.0:
+        lo, hi = jnp.min(x), jnp.max(x)
+        hi = jnp.where(hi == lo, lo + 1.0, hi)
+    edges = jnp.linspace(lo, hi, bins + 1)
+    idx = jnp.clip(jnp.searchsorted(edges, x, side="right") - 1, 0, bins - 1)
+    inside = (x >= lo) & (x <= hi)
+    idx = jnp.where(inside, idx, bins)   # out-of-range -> dropped slot
+    return (jnp.zeros((bins,), jnp.float32)
+            .at[idx].add(1.0, mode="drop"))
+
+
+# ---------------------------------------------------------------------------
+# math tail
+# ---------------------------------------------------------------------------
+
+def polar(abs, angle, name=None):
+    """Reference: paddle.polar — complex from magnitude+phase."""
+    a = jnp.asarray(abs)
+    th = jnp.asarray(angle)
+    return jax.lax.complex(a * jnp.cos(th), a * jnp.sin(th))
+
+
+def logaddexp2(x, y, name=None):
+    return jnp.logaddexp2(jnp.asarray(x), jnp.asarray(y))
+
+
+def xlogy(x, y, name=None):
+    from jax.scipy.special import xlogy as _xlogy
+    return _xlogy(jnp.asarray(x), jnp.asarray(y))
+
+
+def erfc(x, name=None):
+    from jax.scipy.special import erfc as _erfc
+    return _erfc(jnp.asarray(x))
+
+
+def sinc(x, name=None):
+    return jnp.sinc(jnp.asarray(x))
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return jnp.isin(jnp.asarray(x), jnp.asarray(test_x),
+                    assume_unique=assume_unique, invert=invert)
+
+
+def cartesian_prod(x, name=None):
+    """Reference: paddle.cartesian_prod(list of 1-D tensors) -> [N, k]."""
+    arrs = [jnp.asarray(a) for a in x]
+    if len(arrs) == 1:
+        return arrs[0][:, None].reshape(-1, 1)
+    grids = jnp.meshgrid(*arrs, indexing="ij")
+    return jnp.stack([g.reshape(-1) for g in grids], axis=1)
+
+
+def swapdims(x, dim0, dim1, name=None):
+    return jnp.swapaxes(jnp.asarray(x), dim0, dim1)
+
+
+# ---------------------------------------------------------------------------
+# inplace-suffix surface
+# ---------------------------------------------------------------------------
+# The reference exposes `<op>_` in-place variants at the top level
+# (python/paddle/tensor/math.py: exp_, scale_, clip_, ...).  jax arrays
+# are immutable, so these are VALUE-returning aliases: `x = paddle.exp_(x)`
+# ports cleanly; code relying on aliasing (mutating a tensor another
+# reference observes) must be restructured — documented deviation.
+
+_INPLACE_BASES = [
+    "exp", "sqrt", "rsqrt", "reciprocal", "floor", "ceil", "round",
+    "abs", "scale", "clip", "tanh", "add", "subtract", "multiply",
+    "divide", "floor_divide", "remainder", "pow", "lerp", "addmm",
+    "erfinv", "trunc", "frac", "digamma", "lgamma", "neg",
+]
+
+
+def _make_inplace(base):
+    def _fn(x, *args, **kwargs):
+        from .. import ops as _ops
+        return getattr(_ops, base)(x, *args, **kwargs)
+    _fn.__name__ = base + "_"
+    _fn.__qualname__ = base + "_"
+    _fn.__doc__ = (f"Reference: paddle.{base}_ (in-place variant). "
+                   "jax arrays are immutable: returns the result instead "
+                   "of mutating — rebind the name at the call site.")
+    return _fn
+
+
+def zero_(x, name=None):
+    """Reference: paddle.Tensor.zero_ — value-returning under jax."""
+    return jnp.zeros_like(jnp.asarray(x))
+
+
+def fill_(x, value, name=None):
+    return jnp.full_like(jnp.asarray(x), value)
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    x = jnp.asarray(x)
+    n = min(x.shape[-2], x.shape[-1])
+    i = jnp.arange(n - abs(int(offset)))
+    if offset >= 0:
+        return x.at[..., i, i + offset].set(value)
+    return x.at[..., i - offset, i].set(value)
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    from ..core import random as prandom
+    key = prandom.next_key("uniform_")
+    x = jnp.asarray(x)
+    return jax.random.uniform(key, x.shape, x.dtype if
+                              jnp.issubdtype(x.dtype, jnp.floating)
+                              else jnp.float32, min, max)
+
+
+def normal_(x, mean=0.0, std=1.0, seed=0, name=None):
+    from ..core import random as prandom
+    key = prandom.next_key("normal_")
+    x = jnp.asarray(x)
+    dt = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
+    return mean + std * jax.random.normal(key, x.shape, dt)
+
+
+for _base in _INPLACE_BASES:
+    globals()[_base + "_"] = _make_inplace(_base)
+del _base

@@ -90,9 +90,25 @@ class Optimizer:
             return {k: True for k in params}
         return {k: bool(self.apply_decay_param_fun(k)) for k in params}
 
+    def _update_rows(self, name, p, rg, lr, slots, step, wd):
+        """Rows-sparse update (grad is a RowsGrad).  Default: densify and
+        run the dense rule; SGD/Adam override with true sparse updates
+        (reference: phi selected_rows kernels)."""
+        return self._update_one(name, p, rg.to_dense().astype(jnp.float32),
+                                lr, slots, step, wd)
+
     def apply(self, grads: Dict[str, jax.Array], state: PyTree,
               params: Dict[str, jax.Array]):
-        """Pure update. grads may cover a subset of params (frozen ones skipped)."""
+        """Pure update. grads may cover a subset of params (frozen ones
+        skipped).  A grad leaf may be a ``sparse.RowsGrad`` — it bypasses
+        grad_clip/master_grad promotion (reference: SelectedRows grads are
+        exempt from global-norm clip in the dense path) and routes to the
+        optimizer's sparse rule."""
+        from ..sparse.rows import RowsGrad
+        rows_grads = {k: g for k, g in grads.items()
+                      if isinstance(g, RowsGrad)}
+        grads = {k: g for k, g in grads.items()
+                 if not isinstance(g, RowsGrad)}
         if getattr(self, "master_grad", False):
             # amp master_grad: promote low-precision grads before clipping
             # so the global-norm (and every later consumer) sees fp32
@@ -125,6 +141,20 @@ class Optimizer:
                 new_params[name] = new_p.astype(p.dtype)
             else:
                 new_params[name] = new_p.astype(p.dtype)
+            for k, v in new_slots.items():
+                new_state[k][name] = v
+        for name, rg in rows_grads.items():
+            p = params[name]
+            master = masters.get(name) if isinstance(masters, dict) else None
+            p_compute = master if master is not None else p
+            slots = {k: v[name] for k, v in state.items()
+                     if isinstance(v, dict) and k not in ("master",) and name in v}
+            wd = self._wd_coeff if decay_mask.get(name, True) else 0.0
+            new_p, new_slots = self._update_rows(
+                name, p_compute.astype(jnp.float32), rg, lr, slots, step, wd)
+            if master is not None:
+                new_state["master"][name] = new_p
+            new_params[name] = new_p.astype(p.dtype)
             for k, v in new_slots.items():
                 new_state[k][name] = v
         new_state["step"] = step + 1
@@ -178,6 +208,19 @@ class SGD(Optimizer):
             g = g + wd * p
         return p - lr * g, {}
 
+    def _update_rows(self, name, p, rg, lr, slots, step, wd):
+        """Scatter-add update: on touched rows this exactly equals the
+        dense rule (SGD is linear in the grad, so duplicate rows need no
+        coalescing); weight decay applies to touched rows only (reference
+        sparse-SGD semantics), using pre-update values like the dense
+        ``g + wd*p``."""
+        if wd:
+            cg = rg.coalesce()
+            touched = p.at[cg.rows].get(mode="fill", fill_value=0.0)
+            p = p.at[cg.rows].add(-lr * wd * touched, mode="drop")
+        return p.at[rg.rows].add(-lr * rg.values.astype(p.dtype),
+                                 mode="drop"), {}
+
 
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
@@ -202,6 +245,32 @@ class Momentum(Optimizer):
         return p, {"velocity": v}
 
 
+class LarsMomentum(Momentum):
+    """Reference: paddle.optimizer.LarsMomentum — layer-adaptive rate
+    scaling: local_lr = lr * lars_coeff * ||w|| / (||g|| + wd*||w||)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 lars_coeff=0.001, lars_weight_decay=0.0005, grad_clip=None,
+                 multi_precision=False, epsilon=1e-9):
+        super().__init__(learning_rate, momentum, parameters,
+                         weight_decay=0.0, grad_clip=grad_clip,
+                         multi_precision=multi_precision)
+        self.lars_coeff = lars_coeff
+        self.lars_wd = lars_weight_decay
+        self.epsilon = epsilon
+
+    def _update_one(self, name, p, g, lr, slots, step, wd):
+        w_norm = jnp.linalg.norm(p)
+        g_norm = jnp.linalg.norm(g)
+        local = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            lr * self.lars_coeff * w_norm
+            / (g_norm + self.lars_wd * w_norm + self.epsilon), lr)
+        g = g + self.lars_wd * p
+        v = self.momentum * slots["velocity"] + local * g
+        return p - v, {"velocity": v}
+
+
 class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=0.0, grad_clip=None,
@@ -209,11 +278,33 @@ class Adam(Optimizer):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          multi_precision)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_mode = lazy_mode
 
     def _init_slots(self, params):
         z = lambda p: jnp.zeros(p.shape, jnp.float32)
         return {"moment1": jax.tree.map(z, params),
                 "moment2": jax.tree.map(z, params)}
+
+    def _update_rows(self, name, p, rg, lr, slots, step, wd):
+        """``lazy_mode`` sparse Adam (reference:
+        AdamDenseParamSparseGradKernel): moments and parameter update only
+        for the touched (unique) rows; untouched rows keep stale moments.
+        Without lazy_mode the RowsGrad densifies and every row's moments
+        decay, exactly like dense Adam on a mostly-zero grad."""
+        if not self.lazy_mode:
+            return super()._update_rows(name, p, rg, lr, slots, step, wd)
+        cg = rg.coalesce()
+        rows = cg.rows
+        g = cg.values.astype(jnp.float32)
+        m, v = slots["moment1"], slots["moment2"]
+        p_r = p.at[rows].get(mode="fill", fill_value=0.0)
+        m_r = m.at[rows].get(mode="fill", fill_value=0.0)
+        v_r = v.at[rows].get(mode="fill", fill_value=0.0)
+        new_p_r, m_r, v_r = self._adam_core(p_r, g, lr, m_r, v_r, step, wd,
+                                            decoupled=False)
+        return (p.at[rows].set(new_p_r, mode="drop"),
+                {"moment1": m.at[rows].set(m_r, mode="drop"),
+                 "moment2": v.at[rows].set(v_r, mode="drop")})
 
     def _adam_core(self, p, g, lr, m, v, step, wd, decoupled):
         if wd and not decoupled:

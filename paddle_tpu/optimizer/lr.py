@@ -242,3 +242,87 @@ class ReduceOnPlateau(LRScheduler):
                 self.current = max(self.current * self.factor, self.min_lr)
                 self.cooldown_left = self.cooldown
                 self.num_bad = 0
+
+
+class LinearLR(LRScheduler):
+    """Reference: paddle.optimizer.lr.LinearLR — linear ramp from
+    start_factor to end_factor over total_steps."""
+
+    def __init__(self, learning_rate, total_steps, start_factor=1.0 / 3,
+                 end_factor=1.0, last_epoch=-1, verbose=False):
+        self.total_steps = total_steps
+        self.start_factor, self.end_factor = start_factor, end_factor
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        s = jnp.minimum(jnp.asarray(step, jnp.float32), self.total_steps)
+        f = self.start_factor + (self.end_factor - self.start_factor) * (
+            s / max(self.total_steps, 1))
+        return self.base_lr * f
+
+
+class MultiplicativeDecay(LRScheduler):
+    """Reference: paddle.optimizer.lr.MultiplicativeDecay — lr multiplied
+    by lr_lambda(epoch) each step (cumulative product).
+
+    ``lr_lambda`` is an arbitrary Python callable, so the cumulative
+    product is precomputed ONCE (at construction) into a lookup table of
+    ``max_steps`` entries; past the horizon the product continues with the
+    table's last ratio (for the common constant-factor lambda this is
+    exact at every step)."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1,
+                 verbose=False, max_steps=10000):
+        import numpy as np
+        self.lr_lambda = lr_lambda
+        self.max_steps = int(max_steps)
+        factors = np.asarray([lr_lambda(i)
+                              for i in range(1, self.max_steps + 1)],
+                             np.float64)
+        self._table = jnp.asarray(
+            np.concatenate([[1.0], np.cumprod(factors)]), jnp.float32)
+        self._last_ratio = float(factors[-1]) if len(factors) else 1.0
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        s = jnp.asarray(step, jnp.int32)
+        idx = jnp.clip(s, 0, self.max_steps)
+        over = jnp.maximum(s - self.max_steps, 0).astype(jnp.float32)
+        return (self.base_lr * self._table[idx]
+                * self._last_ratio ** over)
+
+
+class CyclicLR(LRScheduler):
+    """Reference: paddle.optimizer.lr.CyclicLR (triangular policy
+    family)."""
+
+    def __init__(self, base_learning_rate, max_learning_rate,
+                 step_size_up, step_size_down=None, mode="triangular",
+                 exp_gamma=1.0, scale_fn=None, scale_mode="cycle",
+                 last_epoch=-1, verbose=False):
+        self.max_lr = max_learning_rate
+        self.up = step_size_up
+        self.down = step_size_down if step_size_down is not None else \
+            step_size_up
+        self.mode, self.exp_gamma = mode, exp_gamma
+        # a user scale_fn overrides the built-in mode scaling (reference
+        # semantics); it must be jnp-traceable (it receives a traced count)
+        self.scale_fn, self.scale_mode = scale_fn, scale_mode
+        super().__init__(base_learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        s = jnp.asarray(step, jnp.float32)
+        total = self.up + self.down
+        cycle = jnp.floor(1 + s / total)
+        pos = s - (cycle - 1) * total
+        frac = jnp.where(pos < self.up, pos / self.up,
+                         1 - (pos - self.up) / self.down)
+        amp = (self.max_lr - self.base_lr) * frac
+        if self.scale_fn is not None:
+            amp = amp * self.scale_fn(cycle if self.scale_mode == "cycle"
+                                      else s)
+        elif self.mode == "triangular2":
+            amp = amp / (2.0 ** (cycle - 1))
+        elif self.mode == "exp_range":
+            amp = amp * (self.exp_gamma ** s)
+        return self.base_lr + amp

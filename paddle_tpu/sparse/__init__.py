@@ -8,6 +8,17 @@ TPU redesign: COO rides jax.experimental.sparse.BCOO (XLA-lowered scatter/
 gather — TPU-compatible, differentiable); CSR is a thin index-triplet
 wrapper that converts through COO for compute. Dense fallbacks keep
 everything jit-safe.
+
+Rows-sparse (SelectedRows) gradients live in ``rows.py``: RowsGrad +
+embedding_rows_grad feed the optimizers' sparse rules (SGD scatter-add,
+Adam lazy_mode) and the parameter-server push path.
+
+De-scoped (explicit): ``paddle.sparse.nn.Conv2D/Conv3D`` (submanifold
+point-cloud convolutions).  Their rulebook/hash-table kernel design is
+built around dynamic nnz — incompatible with XLA's static shapes — and
+the reference workloads they serve (3D detection) are outside this
+framework's north-star; a dense conv over ``to_dense()`` is the
+supported escape hatch.
 """
 
 from __future__ import annotations
@@ -261,3 +272,8 @@ __all__ += ["sin", "sinh", "tan", "tanh", "asin", "asinh", "atan", "atanh",
             "softmax"]
 nn.functional = type("functional", (), {"softmax": staticmethod(softmax),
                                         "relu": staticmethod(relu)})
+
+# rows-sparse gradients (SelectedRows parity — see rows.py)
+from .rows import RowsGrad, embedding_rows_grad  # noqa: E402,F401
+
+__all__ += ["RowsGrad", "embedding_rows_grad"]

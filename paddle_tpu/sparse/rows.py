@@ -1,0 +1,97 @@
+"""Rows-sparse gradients — the TPU-native SelectedRows.
+
+Reference: paddle/fluid/framework/selected_rows.h + the sparse kernels
+consuming it (paddle/phi/kernels/selected_rows/, e.g. adam lazy_mode) —
+an embedding lookup's weight gradient is (rows, values) rather than a
+dense vocab-sized tensor, and the optimizer touches only those rows.
+
+XLA has no dynamic-shape SelectedRows, but the same contract holds with
+static shapes: ``rows`` has one entry per lookup (duplicates allowed),
+out-of-range row ids are dropped by XLA scatter (``mode="drop"``) — the
+padding / "null row" channel.  ``coalesce`` merges duplicates with a
+sort + segment-sum, keeping the static length by parking unused slots at
+an out-of-range row with zero values.
+
+Consumers:
+- ``Optimizer.apply`` accepts RowsGrad leaves: SGD scatter-adds, Adam
+  with ``lazy_mode=True`` updates moments for touched rows only
+  (paddle's AdamDenseParamSparseGradKernel semantics).
+- the parameter-server path (``distributed/ps``): push (rows, values)
+  straight into a SparseTable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["RowsGrad", "embedding_rows_grad"]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class RowsGrad:
+    """Rows-sparse gradient of a ``[num_rows, dim]`` parameter.
+
+    rows:   (n,) int32 row ids; ids >= dense_shape[0] are dropped slots
+    values: (n, dim) per-lookup gradients (duplicates NOT merged unless
+            ``coalesce()`` was called)
+    dense_shape: static (num_rows, dim)
+    """
+
+    rows: jax.Array
+    values: jax.Array
+    dense_shape: Tuple[int, int]
+
+    def tree_flatten(self):
+        return (self.rows, self.values), self.dense_shape
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+    @property
+    def dim(self) -> int:
+        return self.dense_shape[1]
+
+    def to_dense(self) -> jax.Array:
+        out = jnp.zeros(self.dense_shape, self.values.dtype)
+        return out.at[self.rows].add(self.values, mode="drop")
+
+    def coalesce(self) -> "RowsGrad":
+        """Merge duplicate rows (sum), static output length: unused slots
+        park at an out-of-range row with zero values."""
+        n = int(self.rows.shape[0])
+        order = jnp.argsort(self.rows)
+        r = self.rows[order]
+        v = self.values[order]
+        is_new = jnp.concatenate(
+            [jnp.ones((1,), bool), r[1:] != r[:-1]])
+        seg = jnp.cumsum(is_new) - 1          # run id per sorted entry
+        summed = jax.ops.segment_sum(v, seg, num_segments=n)
+        rows_u = jnp.full((n,), self.dense_shape[0], jnp.int32)
+        rows_u = rows_u.at[seg].set(r.astype(jnp.int32))
+        return RowsGrad(rows_u, summed, self.dense_shape)
+
+    def scale(self, s) -> "RowsGrad":
+        return RowsGrad(self.rows, self.values * s, self.dense_shape)
+
+
+def embedding_rows_grad(ids, grad_out, num_embeddings: int,
+                        padding_idx: Optional[int] = None) -> RowsGrad:
+    """The SelectedRows gradient of ``F.embedding(ids, weight)`` w.r.t.
+    ``weight``: one (row, value) pair per lookup.
+
+    ``grad_out`` is the cotangent of the lookup result, shape
+    ``ids.shape + (dim,)``.  ``padding_idx`` rows are routed to the drop
+    slot (their gradient is defined as zero, reference embedding kernel).
+    """
+    dim = grad_out.shape[-1]
+    rows = ids.reshape(-1).astype(jnp.int32)
+    values = grad_out.reshape(-1, dim)
+    if padding_idx is not None:
+        rows = jnp.where(rows == padding_idx, num_embeddings, rows)
+    return RowsGrad(rows, values, (int(num_embeddings), int(dim)))

@@ -17,6 +17,7 @@ path, as in the reference's 3.0 dynamic-first design.
 
 from __future__ import annotations
 
+import contextlib as _contextlib
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -433,3 +434,20 @@ def load_inference_model(path_prefix: str, executor, **kwargs):
         meta = json.load(f)
     prog = _LoadedInference(fn, meta["feed_names"], meta["n_fetch"])
     return [prog, list(meta["feed_names"]), list(range(meta["n_fetch"]))]
+
+
+@_contextlib.contextmanager
+def name_scope(prefix=None):
+    """Reference: paddle.static.name_scope — names ops for debugging; maps
+    to jax.named_scope (shows up in HLO op metadata / profiles)."""
+    import jax as _jax
+    with _jax.named_scope(prefix or "scope"):
+        yield
+
+
+def cpu_places(device_count=None):
+    """Reference: paddle.static.cpu_places."""
+    from ..device import CPUPlace
+    import os as _os
+    n = device_count or int(_os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]

@@ -264,7 +264,7 @@ class _Fire(Layer):
     def forward(self, x):
         import jax.numpy as jnp
         s = F.relu(self.squeeze(x))
-        return jnp.concatenate([F.relu(self.e1(s)), F.relu(self.e3(s))],
+        return jnp_concat([F.relu(self.e1(s)), F.relu(self.e3(s))],
                                axis=1)
 
 
@@ -415,7 +415,7 @@ class _DenseLayer(Layer):
         import jax.numpy as jnp
         y = self.conv1(F.relu(self.bn1(x)))
         y = self.conv2(F.relu(self.bn2(y)))
-        return jnp.concatenate([x, y], axis=1)
+        return jnp_concat([x, y], axis=1)
 
 
 class _Transition(Layer):
@@ -537,9 +537,11 @@ class _ShuffleUnit(Layer):
         return self.shuffle(out)
 
 
-def jnp_concat(xs):
+
+
+def jnp_concat(xs, axis=1):
     import jax.numpy as jnp
-    return jnp.concatenate(xs, axis=1)
+    return jnp.concatenate(xs, axis=axis)
 
 
 class ShuffleNetV2(Layer):
@@ -674,3 +676,271 @@ __all__ += [
     "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
     "GoogLeNet", "googlenet",
 ]
+
+
+# ---------------------------------------------------------------------------
+# MobileNetV3 (reference: python/paddle/vision/models/mobilenetv3.py)
+# ---------------------------------------------------------------------------
+
+def _make_divisible(v, divisor=8):
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class _SqueezeExcite(Layer):
+    def __init__(self, ch, squeeze=4):
+        super().__init__()
+        mid = _make_divisible(ch // squeeze)
+        self.fc1 = Conv2D(ch, mid, 1)
+        self.fc2 = Conv2D(mid, ch, 1)
+
+    def forward(self, x):
+        s = x.mean(axis=(2, 3), keepdims=True)
+        s = F.relu(self.fc1(s))
+        s = F.hardsigmoid(self.fc2(s))
+        return x * s
+
+
+class _MNV3Block(Layer):
+    def __init__(self, c_in, c_mid, c_out, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and c_in == c_out
+        self.expand = (_ConvBNRelu(c_in, c_mid, 1, act="none")
+                       if c_mid != c_in else None)
+        self.dw = _ConvBNRelu(c_mid, c_mid, k, stride=stride, groups=c_mid,
+                              act="none")
+        self.se = _SqueezeExcite(c_mid) if use_se else None
+        self.project = _ConvBNRelu(c_mid, c_out, 1, act="none")
+        self.act = act
+
+    def _a(self, x):
+        return F.hardswish(x) if self.act == "hardswish" else F.relu(x)
+
+    def forward(self, x):
+        out = x
+        if self.expand is not None:
+            out = self._a(self.expand(out))
+        out = self._a(self.dw(out))
+        if self.se is not None:
+            out = self.se(out)
+        out = self.project(out)
+        return x + out if self.use_res else out
+
+
+_MNV3_LARGE = [
+    # k, exp, out, se, act, stride
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+_MNV3_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class MobileNetV3(Layer):
+    """Reference: paddle MobileNetV3Large/Small (Howard 2019)."""
+
+    def __init__(self, config="large", scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        cfg = _MNV3_LARGE if config == "large" else _MNV3_SMALL
+        last_mid = 960 if config == "large" else 576
+        last_ch = 1280 if config == "large" else 1024
+        c = lambda ch: _make_divisible(ch * scale)
+        self.stem = _ConvBNRelu(3, c(16), 3, stride=2, act="none")
+        blocks = []
+        c_in = c(16)
+        for k, exp, out, se, act, stride in cfg:
+            blocks.append(_MNV3Block(c_in, c(exp), c(out), k, stride, se,
+                                     act))
+            c_in = c(out)
+        self.blocks = Sequential(*blocks)
+        self.last_conv = _ConvBNRelu(c_in, c(last_mid), 1, act="none")
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.head = Sequential(Linear(c(last_mid), last_ch),
+                                   Linear(last_ch, num_classes))
+
+    def forward(self, x):
+        x = F.hardswish(self.stem(x))
+        x = self.blocks(x)
+        x = F.hardswish(self.last_conv(x))
+        if self.with_pool:
+            x = x.mean(axis=(2, 3))
+        if self.num_classes > 0:
+            x = self.head[0](x)
+            x = F.hardswish(x)
+            x = self.head[1](x)
+        return x
+
+
+def mobilenet_v3_large(scale=1.0, **kw):
+    return MobileNetV3("large", scale=scale, **kw)
+
+
+def mobilenet_v3_small(scale=1.0, **kw):
+    return MobileNetV3("small", scale=scale, **kw)
+
+
+# ---------------------------------------------------------------------------
+# InceptionV3 (reference: python/paddle/vision/models/inceptionv3.py)
+# ---------------------------------------------------------------------------
+
+class _IncConv(Layer):
+    def __init__(self, c_in, c_out, k, stride=1, padding=0):
+        super().__init__()
+        self.conv = Conv2D(c_in, c_out, k, stride=stride, padding=padding,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(c_out)
+
+    def forward(self, x):
+        return F.relu(self.bn(self.conv(x)))
+
+
+class _InceptionA(Layer):
+    def __init__(self, c_in, pool_features):
+        super().__init__()
+        self.b1 = _IncConv(c_in, 64, 1)
+        self.b5 = Sequential(_IncConv(c_in, 48, 1),
+                             _IncConv(48, 64, 5, padding=2))
+        self.b3 = Sequential(_IncConv(c_in, 64, 1),
+                             _IncConv(64, 96, 3, padding=1),
+                             _IncConv(96, 96, 3, padding=1))
+        self.bp = _IncConv(c_in, pool_features, 1)
+
+    def forward(self, x):
+        pool = F.avg_pool2d(F.pad(x, [1, 1, 1, 1]), 3, stride=1)
+        return jnp_concat(
+            [self.b1(x), self.b5(x), self.b3(x), self.bp(pool)], axis=1)
+
+
+class _InceptionB(Layer):
+    def __init__(self, c_in):
+        super().__init__()
+        self.b3 = _IncConv(c_in, 384, 3, stride=2)
+        self.b3d = Sequential(_IncConv(c_in, 64, 1),
+                              _IncConv(64, 96, 3, padding=1),
+                              _IncConv(96, 96, 3, stride=2))
+
+    def forward(self, x):
+        pool = F.max_pool2d(x, 3, stride=2)
+        return jnp_concat([self.b3(x), self.b3d(x), pool], axis=1)
+
+
+class _InceptionC(Layer):
+    def __init__(self, c_in, c7):
+        super().__init__()
+        self.b1 = _IncConv(c_in, 192, 1)
+        self.b7 = Sequential(_IncConv(c_in, c7, 1),
+                             _IncConv(c7, c7, (1, 7), padding=(0, 3)),
+                             _IncConv(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = Sequential(_IncConv(c_in, c7, 1),
+                              _IncConv(c7, c7, (7, 1), padding=(3, 0)),
+                              _IncConv(c7, c7, (1, 7), padding=(0, 3)),
+                              _IncConv(c7, c7, (7, 1), padding=(3, 0)),
+                              _IncConv(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = _IncConv(c_in, 192, 1)
+
+    def forward(self, x):
+        pool = F.avg_pool2d(F.pad(x, [1, 1, 1, 1]), 3, stride=1)
+        return jnp_concat(
+            [self.b1(x), self.b7(x), self.b7d(x), self.bp(pool)], axis=1)
+
+
+class _InceptionD(Layer):
+    def __init__(self, c_in):
+        super().__init__()
+        self.b3 = Sequential(_IncConv(c_in, 192, 1),
+                             _IncConv(192, 320, 3, stride=2))
+        self.b7 = Sequential(_IncConv(c_in, 192, 1),
+                             _IncConv(192, 192, (1, 7), padding=(0, 3)),
+                             _IncConv(192, 192, (7, 1), padding=(3, 0)),
+                             _IncConv(192, 192, 3, stride=2))
+
+    def forward(self, x):
+        pool = F.max_pool2d(x, 3, stride=2)
+        return jnp_concat([self.b3(x), self.b7(x), pool], axis=1)
+
+
+class _InceptionE(Layer):
+    def __init__(self, c_in):
+        super().__init__()
+        self.b1 = _IncConv(c_in, 320, 1)
+        self.b3_stem = _IncConv(c_in, 384, 1)
+        self.b3_a = _IncConv(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _IncConv(384, 384, (3, 1), padding=(1, 0))
+        self.bd_stem = Sequential(_IncConv(c_in, 448, 1),
+                                  _IncConv(448, 384, 3, padding=1))
+        self.bd_a = _IncConv(384, 384, (1, 3), padding=(0, 1))
+        self.bd_b = _IncConv(384, 384, (3, 1), padding=(1, 0))
+        self.bp = _IncConv(c_in, 192, 1)
+
+    def forward(self, x):
+        s3 = self.b3_stem(x)
+        sd = self.bd_stem(x)
+        pool = F.avg_pool2d(F.pad(x, [1, 1, 1, 1]), 3, stride=1)
+        return jnp_concat(
+            [self.b1(x), self.b3_a(s3), self.b3_b(s3),
+             self.bd_a(sd), self.bd_b(sd), self.bp(pool)], axis=1)
+
+
+class InceptionV3(Layer):
+    """Reference: paddle.vision.models.InceptionV3 (299x299 input)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = Sequential(
+            _IncConv(3, 32, 3, stride=2), _IncConv(32, 32, 3),
+            _IncConv(32, 64, 3, padding=1))
+        self.stem2 = Sequential(_IncConv(64, 80, 1),
+                                _IncConv(80, 192, 3))
+        self.blocks = Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160),
+            _InceptionC(768, 160), _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048))
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = F.max_pool2d(x, 3, stride=2)
+        x = self.stem2(x)
+        x = F.max_pool2d(x, 3, stride=2)
+        x = self.blocks(x)
+        if self.with_pool:
+            x = x.mean(axis=(2, 3))
+        if self.num_classes > 0:
+            x = self.fc(x)
+        return x
+
+
+def inception_v3(**kw):
+    return InceptionV3(**kw)
+
+
+def lenet(num_classes=10):
+    """Reference: paddle.vision.models.LeNet factory."""
+    return LeNet(num_classes=num_classes)
+
+
+__all__ += ["MobileNetV3", "mobilenet_v3_large", "mobilenet_v3_small",
+            "InceptionV3", "inception_v3", "lenet"]

@@ -160,3 +160,8 @@ def roi_align(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
         return jnp.moveaxis(vals, -1, 0)             # [C, oh, ow]
 
     return jax.vmap(roi_pool)(jnp.arange(k))
+
+
+# round-3 tail (roi/psroi pooling, deformable conv, SSD/YOLO box ops,
+# matrix NMS, FPN routing) — see ops_tail3.py
+from .ops_tail3 import *  # noqa: E402,F401,F403

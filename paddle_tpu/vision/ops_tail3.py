@@ -1,0 +1,413 @@
+"""Round-3 vision ops tail (reference: python/paddle/vision/ops.py).
+
+Static-shape XLA formulations; oracle tests in
+tests/test_vision_tail3.py.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+
+__all__ = ["roi_pool", "psroi_pool", "deform_conv2d", "box_coder",
+           "prior_box", "yolo_box", "matrix_nms",
+           "distribute_fpn_proposals",
+           "RoIPool", "PSRoIPool", "RoIAlign", "DeformConv2D"]
+
+
+def _batch_index(boxes_num, n, k):
+    if boxes_num is None:
+        return jnp.zeros((k,), jnp.int32)
+    return jnp.repeat(jnp.arange(n), boxes_num, total_repeat_length=k)
+
+
+def roi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0):
+    """Reference: paddle.vision.ops.roi_pool — max-pool each RoI into a
+    fixed [oh, ow] grid (quantized bin edges, Fast R-CNN semantics)."""
+    oh, ow = ((output_size, output_size)
+              if isinstance(output_size, int) else tuple(output_size))
+    n, c, h, w = x.shape
+    k = boxes.shape[0]
+    bidx = _batch_index(boxes_num, n, k)
+    b = jnp.round(boxes * spatial_scale).astype(jnp.int32)
+    x1, y1, x2, y2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    rw = jnp.maximum(x2 - x1 + 1, 1)
+    rh = jnp.maximum(y2 - y1 + 1, 1)
+
+    ys = jnp.arange(h)
+    xs = jnp.arange(w)
+
+    def one_bin(i, j):
+        # bin [i, j] covers rows floor(i*rh/oh) .. ceil((i+1)*rh/oh)
+        y_lo = y1 + (i * rh) // oh
+        y_hi = y1 + -((-(i + 1) * rh) // oh)   # ceil div
+        x_lo = x1 + (j * rw) // ow
+        x_hi = x1 + -((-(j + 1) * rw) // ow)
+        ymask = (ys[None, :] >= y_lo[:, None]) & (ys[None, :] < jnp.maximum(y_hi, y_lo + 1)[:, None]) & \
+                (ys[None, :] >= 0) & (ys[None, :] < h)
+        xmask = (xs[None, :] >= x_lo[:, None]) & (xs[None, :] < jnp.maximum(x_hi, x_lo + 1)[:, None]) & \
+                (xs[None, :] >= 0) & (xs[None, :] < w)
+        m = ymask[:, None, :, None] & xmask[:, None, None, :]   # (k,1,h,w)
+        feats = x[bidx]                                          # (k,c,h,w)
+        neg = jnp.finfo(x.dtype).min
+        return jnp.max(jnp.where(m, feats, neg), axis=(2, 3))
+
+    out = jnp.stack([jnp.stack([one_bin(i, j) for j in range(ow)], axis=-1)
+                     for i in range(oh)], axis=-2)
+    return out  # (k, c, oh, ow)
+
+
+def psroi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0):
+    """Reference: paddle.vision.ops.psroi_pool — position-sensitive RoI
+    average pool: input channels C = out_c * oh * ow; bin (i, j) reads its
+    own channel group (R-FCN)."""
+    oh, ow = ((output_size, output_size)
+              if isinstance(output_size, int) else tuple(output_size))
+    n, c, h, w = x.shape
+    out_c = c // (oh * ow)
+    k = boxes.shape[0]
+    bidx = _batch_index(boxes_num, n, k)
+    bx = boxes * spatial_scale
+    x1, y1, x2, y2 = bx[:, 0], bx[:, 1], bx[:, 2], bx[:, 3]
+    rw = jnp.maximum(x2 - x1, 0.1)
+    rh = jnp.maximum(y2 - y1, 0.1)
+    ys = jnp.arange(h)
+    xs = jnp.arange(w)
+    feats = x[bidx].reshape(k, oh, ow, out_c, h, w)
+
+    def one_bin(i, j):
+        y_lo = jnp.floor(y1 + i * rh / oh).astype(jnp.int32)
+        y_hi = jnp.ceil(y1 + (i + 1) * rh / oh).astype(jnp.int32)
+        x_lo = jnp.floor(x1 + j * rw / ow).astype(jnp.int32)
+        x_hi = jnp.ceil(x1 + (j + 1) * rw / ow).astype(jnp.int32)
+        ymask = (ys[None, :] >= jnp.clip(y_lo, 0, h)[:, None]) & \
+                (ys[None, :] < jnp.clip(y_hi, 0, h)[:, None])
+        xmask = (xs[None, :] >= jnp.clip(x_lo, 0, w)[:, None]) & \
+                (xs[None, :] < jnp.clip(x_hi, 0, w)[:, None])
+        m = (ymask[:, None, :, None] & xmask[:, None, None, :])
+        cnt = jnp.maximum(m.sum(axis=(2, 3)), 1)
+        grp = feats[:, i, j]                         # (k, out_c, h, w)
+        return jnp.where(m, grp, 0.0).sum(axis=(2, 3)) / cnt
+
+    out = jnp.stack([jnp.stack([one_bin(i, j) for j in range(ow)], axis=-1)
+                     for i in range(oh)], axis=-2)
+    return out  # (k, out_c, oh, ow)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None):
+    """Reference: paddle.vision.ops.deform_conv2d (DCNv1/v2).
+
+    x: [N,Cin,H,W]; offset: [N, 2*dg*kh*kw, Ho, Wo] (y then x per tap,
+    reference layout); mask: [N, dg*kh*kw, Ho, Wo] (v2 modulation).
+    Gather-based bilinear sampling + one matmul — the XLA-native layout
+    of the CUDA im2col kernel."""
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    n, cin, h, w = x.shape
+    cout, cin_g, kh, kw = weight.shape
+    ho = (h + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+    wo = (w + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+    dg = deformable_groups
+
+    base_y = (jnp.arange(ho) * s[0] - p[0])[:, None, None]      # (ho,1,1)
+    base_x = (jnp.arange(wo) * s[1] - p[1])[None, :, None]      # (1,wo,1)
+    tap_y = (jnp.arange(kh) * d[0])[None, None, :, None]        # ky
+    tap_x = (jnp.arange(kw) * d[1])[None, None, None, :]        # kx
+    # offsets: [N, dg, kh, kw, 2, Ho, Wo] (y, x)
+    off = offset.reshape(n, dg, kh, kw, 2, ho, wo)
+    oy = off[:, :, :, :, 0].transpose(0, 1, 4, 5, 2, 3)  # (n,dg,ho,wo,kh,kw)
+    ox = off[:, :, :, :, 1].transpose(0, 1, 4, 5, 2, 3)
+    py = (base_y[None, None, :, :, :, None] + tap_y[None, None] + oy)
+    px = (base_x[None, None, :, :, None, :] + tap_x[None, None] + ox)
+    # bilinear sample each (n, dg, ho, wo, kh, kw) position per channel
+    cg = cin // dg
+    xg = x.reshape(n, dg, cg, h, w)
+
+    y0 = jnp.floor(py).astype(jnp.int32)
+    x0 = jnp.floor(px).astype(jnp.int32)
+    fy = py - y0
+    fx = px - x0
+    samples = 0.0
+    for dy, wy in ((0, 1 - fy), (1, fy)):
+        for dx, wx in ((0, 1 - fx), (1, fx)):
+            yy = y0 + dy
+            xx = x0 + dx
+            valid = ((yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1))
+            yi = jnp.clip(yy, 0, h - 1)
+            xi = jnp.clip(xx, 0, w - 1)
+            # vmap the gather over batch and deformable group
+            def take(xg_bd, yi_bd, xi_bd):
+                return xg_bd[:, yi_bd, xi_bd]       # (cg, ho,wo,kh,kw)
+            g = jax.vmap(jax.vmap(take))(xg, yi, xi)
+            samples = samples + g * (wy * wx * valid)[:, :, None]
+    # samples: (n, dg, cg, ho, wo, kh, kw)
+    if mask is not None:
+        m = mask.reshape(n, dg, kh, kw, ho, wo).transpose(0, 1, 4, 5, 2, 3)
+        samples = samples * m[:, :, None]
+    cols = samples.reshape(n, cin, ho, wo, kh * kw)
+    wg = weight.reshape(groups, cout // groups, cin_g, kh * kw)
+    xcols = cols.reshape(n, groups, cin // groups, ho, wo, kh * kw)
+    out = jnp.einsum("ngchwk,gock->ngohw", xcols, wg,
+                     preferred_element_type=jnp.float32)
+    out = out.reshape(n, cout, ho, wo).astype(x.dtype)
+    if bias is not None:
+        out = out + bias[None, :, None, None]
+    return out
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0):
+    """Reference: paddle.vision.ops.box_coder (SSD box transforms)."""
+    pb = jnp.asarray(prior_box, jnp.float32)
+    tb = jnp.asarray(target_box, jnp.float32)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + ph * 0.5
+    if prior_box_var is None:
+        var = jnp.ones((4,), jnp.float32)
+    else:
+        var = jnp.asarray(prior_box_var, jnp.float32)
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        if var.ndim == 1:
+            vx, vy, vw, vh = var[0], var[1], var[2], var[3]
+        else:
+            vx, vy, vw, vh = var[:, 0], var[:, 1], var[:, 2], var[:, 3]
+        out = jnp.stack([(tcx[None] - pcx[:, None]) / pw[:, None],
+                         (tcy[None] - pcy[:, None]) / ph[:, None],
+                         jnp.log(tw[None] / pw[:, None]),
+                         jnp.log(th[None] / ph[:, None])], axis=-1)
+        return out / jnp.reshape(jnp.stack([vx, vy, vw, vh], -1),
+                                 (-1, 1, 4) if var.ndim > 1 else (1, 1, 4))
+    # decode_center_size: target [M, N, 4] deltas against priors
+    if tb.ndim == 2:
+        tb = tb[:, None]
+    if var.ndim == 1:
+        var = jnp.broadcast_to(var, (4,))
+        vx, vy, vw, vh = var
+        dx, dy, dw, dh = (tb[..., 0] * vx, tb[..., 1] * vy,
+                          tb[..., 2] * vw, tb[..., 3] * vh)
+    else:
+        dx = tb[..., 0] * var[:, None, 0] if axis == 0 else tb[..., 0]
+        dy, dw, dh = tb[..., 1], tb[..., 2], tb[..., 3]
+    cx = dx * pw[:, None] + pcx[:, None]
+    cy = dy * ph[:, None] + pcy[:, None]
+    bw = jnp.exp(dw) * pw[:, None]
+    bh = jnp.exp(dh) * ph[:, None]
+    return jnp.stack([cx - bw * 0.5, cy - bh * 0.5,
+                      cx + bw * 0.5 - norm, cy + bh * 0.5 - norm], axis=-1)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False):
+    """Reference: paddle.vision.ops.prior_box (SSD anchors)."""
+    _, _, fh, fw = input.shape
+    _, _, ih, iw = image.shape
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    boxes = []
+    for ms in min_sizes:
+        boxes.append((ms, ms))
+        if max_sizes:
+            mx = max_sizes[min_sizes.index(ms)]
+            boxes.append((math.sqrt(ms * mx), math.sqrt(ms * mx)))
+        for ar in ars:
+            if abs(ar - 1.0) < 1e-6:
+                continue
+            boxes.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+    num = len(boxes)
+    cx = (jnp.arange(fw) + offset) * step_w
+    cy = (jnp.arange(fh) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)                     # (fh, fw)
+    bw = jnp.asarray([b[0] for b in boxes], jnp.float32) / 2
+    bh = jnp.asarray([b[1] for b in boxes], jnp.float32) / 2
+    out = jnp.stack([
+        (cxg[..., None] - bw) / iw, (cyg[..., None] - bh) / ih,
+        (cxg[..., None] + bw) / iw, (cyg[..., None] + bh) / ih], axis=-1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                           (fh, fw, num, 4))
+    return out, var
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Reference: paddle.vision.ops.yolo_box (YOLOv3 head decode)."""
+    n, c, h, w = x.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    gx = (jnp.arange(w))[None, None, None, :]
+    gy = (jnp.arange(h))[None, None, :, None]
+    sig = jax.nn.sigmoid
+    bx = (gx + sig(x[:, :, 0]) * scale_x_y
+          - (scale_x_y - 1) / 2) / w
+    by = (gy + sig(x[:, :, 1]) * scale_x_y
+          - (scale_x_y - 1) / 2) / h
+    bw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None] / (
+        downsample_ratio * w)
+    bh = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None] / (
+        downsample_ratio * h)
+    conf = sig(x[:, :, 4])
+    probs = sig(x[:, :, 5:]) * conf[:, :, None]
+    imh = img_size[:, 0].astype(jnp.float32)[:, None]
+    imw = img_size[:, 1].astype(jnp.float32)[:, None]
+    flat = lambda a: a.reshape(n, -1)
+    x1 = flat(bx - bw / 2) * imw
+    y1 = flat(by - bh / 2) * imh
+    x2 = flat(bx + bw / 2) * imw
+    y2 = flat(by + bh / 2) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, imw - 1)
+        y1 = jnp.clip(y1, 0, imh - 1)
+        x2 = jnp.clip(x2, 0, imw - 1)
+        y2 = jnp.clip(y2, 0, imh - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)
+    scores = probs.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
+    keep = flat(conf) > conf_thresh
+    scores = jnp.where(keep[..., None], scores, 0.0)
+    return boxes, scores
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=100, keep_top_k=100, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=-1, normalized=True):
+    """Reference: paddle.vision.ops.matrix_nms (SOLOv2) — soft decay of
+    each box's score by its IoU with higher-scored same-class boxes.
+    Single-image [M,4] boxes / [C,M] scores; returns (out [K,6], index)."""
+    from .ops import box_iou
+    c, m = scores.shape
+    top = min(nms_top_k, m)
+    out_rows = []
+    idx_rows = []
+    for cls in range(c):
+        if cls == background_label:
+            continue
+        s = scores[cls]
+        order = jnp.argsort(-s)[:top]
+        sc = s[order]
+        bx = bboxes[order]
+        iou = box_iou(bx, bx)
+        tri = jnp.tril(iou, k=-1)       # iou with HIGHER-scored boxes
+        max_iou = tri.max(axis=1)       # per box
+        comp = jnp.max(tri, axis=0)
+        if use_gaussian:
+            decay = jnp.exp(-(tri ** 2 - comp[None, :] ** 2)
+                            / gaussian_sigma).min(axis=1)
+        else:
+            decay = ((1 - tri) / (1 - comp[None, :] + 1e-12)).min(axis=1)
+        dec = jnp.where(jnp.arange(top) == 0, 1.0, decay)
+        new_s = sc * dec
+        valid = new_s > max(score_threshold, post_threshold)
+        out_rows.append(jnp.concatenate(
+            [jnp.full((top, 1), cls, jnp.float32),
+             jnp.where(valid, new_s, 0.0)[:, None], bx], axis=1))
+        idx_rows.append(order)
+    out = jnp.concatenate(out_rows, axis=0)
+    idx = jnp.concatenate(idx_rows, axis=0)
+    order = jnp.argsort(-out[:, 1])[:keep_top_k]
+    return out[order], idx[order]
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None):
+    """Reference: paddle.vision.ops.distribute_fpn_proposals — route each
+    RoI to an FPN level by its scale.  Static-shape variant: returns one
+    [K,4] tensor per level with non-member rows zeroed + a mask list +
+    the restore index."""
+    off = 1.0 if pixel_offset else 0.0
+    w = fpn_rois[:, 2] - fpn_rois[:, 0] + off
+    h = fpn_rois[:, 3] - fpn_rois[:, 1] + off
+    scale = jnp.sqrt(jnp.maximum(w * h, 1e-12))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    outs, masks = [], []
+    for level in range(min_level, max_level + 1):
+        m = lvl == level
+        outs.append(jnp.where(m[:, None], fpn_rois, 0.0))
+        masks.append(m)
+    restore = jnp.argsort(jnp.argsort(lvl, stable=True), stable=True)
+    return outs, masks, restore
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size, self.spatial_scale = output_size, spatial_scale
+
+    def forward(self, x, boxes, boxes_num=None):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size, self.spatial_scale = output_size, spatial_scale
+
+    def forward(self, x, boxes, boxes_num=None):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0, sampling_ratio=-1,
+                 aligned=True):
+        super().__init__()
+        self.args = (output_size, spatial_scale, sampling_ratio,
+                     aligned)
+
+    def forward(self, x, boxes, boxes_num=None):
+        from .ops import roi_align
+        return roi_align(x, boxes, boxes_num, *self.args)
+
+
+class DeformConv2D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        k = ((kernel_size, kernel_size) if isinstance(kernel_size, int)
+             else tuple(kernel_size))
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.deformable_groups, self.groups = deformable_groups, groups
+        from ..nn import initializer as I
+        fan_in = in_channels * k[0] * k[1]
+        bound = 1 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, k[0], k[1]),
+            attr=weight_attr, default_initializer=I.Uniform(-bound, bound))
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                (out_channels,), attr=bias_attr, is_bias=True,
+                default_initializer=I.Uniform(-bound, bound))
+        else:
+            self.bias = None
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             self.stride, self.padding, self.dilation,
+                             self.deformable_groups, self.groups, mask)

@@ -138,3 +138,11 @@ class Transpose:
 
     def __call__(self, img):
         return np.asarray(img).transpose(self.order)
+
+
+# round-3 tail (functional API + random/color/geometric transforms) —
+# see transforms_tail3.py
+from .transforms_tail3 import *  # noqa: E402,F401,F403
+from . import transforms_tail3 as _t3  # noqa: E402
+
+__all__ += _t3.__all__

@@ -1,0 +1,460 @@
+"""Vision transforms tail (reference: python/paddle/vision/transforms/
+{functional,transforms}.py members beyond the round-1 subset).
+
+Host-side numpy on HWC uint8/float images, like the base module — these
+run in DataLoader workers, not on the TPU.
+"""
+
+from __future__ import annotations
+
+import math
+import numbers
+
+import numpy as np
+
+from .transforms import CenterCrop, Normalize, ToTensor, _resize_np
+
+__all__ = [
+    "crop", "center_crop", "resize", "hflip", "vflip", "normalize", "pad",
+    "rotate", "affine", "perspective", "erase", "adjust_brightness",
+    "adjust_contrast", "adjust_saturation", "adjust_hue", "to_grayscale",
+    "to_tensor",
+    "RandomVerticalFlip", "Pad", "RandomRotation", "RandomResizedCrop",
+    "ColorJitter", "Grayscale", "BrightnessTransform", "ContrastTransform",
+    "SaturationTransform", "HueTransform", "RandomAffine",
+    "RandomPerspective", "RandomErasing",
+]
+
+
+# ---------------------------------------------------------------------------
+# functional
+# ---------------------------------------------------------------------------
+
+def _as_float(img):
+    return img.astype(np.float32), img.dtype
+
+
+def _restore(out, dtype):
+    if dtype == np.uint8:
+        return np.clip(np.round(out), 0, 255).astype(np.uint8)
+    return out.astype(dtype)
+
+
+def crop(img, top, left, height, width):
+    return img[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = img.shape[:2]
+    th, tw = output_size
+    top = int(round((h - th) / 2.0))
+    left = int(round((w - tw) / 2.0))
+    return crop(img, top, left, th, tw)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return _resize_np(img, size)
+
+
+def hflip(img):
+    return img[:, ::-1].copy()
+
+
+def vflip(img):
+    return img[::-1].copy()
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    img = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        return (img - mean[:, None, None]) / std[:, None, None]
+    return (img - mean) / std
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    if isinstance(padding, numbers.Number):
+        pl = pr = pt_ = pb = int(padding)
+    elif len(padding) == 2:
+        pl, pt_ = int(padding[0]), int(padding[1])
+        pr, pb = pl, pt_
+    else:
+        pl, pt_, pr, pb = (int(p) for p in padding)
+    spec = [(pt_, pb), (pl, pr)] + [(0, 0)] * (img.ndim - 2)
+    if padding_mode == "constant":
+        return np.pad(img, spec, constant_values=fill)
+    mode = {"reflect": "reflect", "edge": "edge",
+            "symmetric": "symmetric"}[padding_mode]
+    return np.pad(img, spec, mode=mode)
+
+
+def _warp_np(img, matrix, fill=0.0):
+    """Inverse-warp with bilinear sampling: ``matrix`` (3x3) maps OUTPUT
+    pixel coords (x, y, 1) to INPUT coords."""
+    imgf, dtype = _as_float(img)
+    if imgf.ndim == 2:
+        imgf = imgf[:, :, None]
+        squeeze = True
+    else:
+        squeeze = False
+    h, w = imgf.shape[:2]
+    ys, xs = np.mgrid[0:h, 0:w]
+    ones = np.ones_like(xs)
+    coords = np.stack([xs, ys, ones], axis=-1).reshape(-1, 3).astype(
+        np.float64)
+    src = coords @ np.asarray(matrix, np.float64).T
+    sx = src[:, 0] / src[:, 2]
+    sy = src[:, 1] / src[:, 2]
+    x0 = np.floor(sx).astype(int)
+    y0 = np.floor(sy).astype(int)
+    fx = (sx - x0)[:, None]
+    fy = (sy - y0)[:, None]
+
+    def sample(yy, xx):
+        valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+        out = np.full((yy.size, imgf.shape[2]), float(fill), np.float32)
+        out[valid] = imgf[yy[valid], xx[valid]]
+        return out
+
+    out = (sample(y0, x0) * (1 - fy) * (1 - fx)
+           + sample(y0, x0 + 1) * (1 - fy) * fx
+           + sample(y0 + 1, x0) * fy * (1 - fx)
+           + sample(y0 + 1, x0 + 1) * fy * fx)
+    out = out.reshape(h, w, imgf.shape[2])
+    if squeeze:
+        out = out[:, :, 0]
+    return _restore(out, dtype)
+
+
+def _affine_matrix(angle, translate, scale, shear, center):
+    rot = math.radians(angle)
+    sx, sy = (math.radians(s) for s in shear)
+    cx, cy = center
+    tx, ty = translate
+    # forward: T(center) R S Sh T(-center) T(translate); invert for warp
+    a = math.cos(rot - sy) / math.cos(sy)
+    b = -math.cos(rot - sy) * math.tan(sx) / math.cos(sy) - math.sin(rot)
+    c = math.sin(rot - sy) / math.cos(sy)
+    d = -math.sin(rot - sy) * math.tan(sx) / math.cos(sy) + math.cos(rot)
+    fwd = np.array([[a * scale, b * scale,
+                     cx + tx - (a * scale * cx + b * scale * cy)],
+                    [c * scale, d * scale,
+                     cy + ty - (c * scale * cx + d * scale * cy)],
+                    [0, 0, 1]], np.float64)
+    return np.linalg.inv(fwd)
+
+
+def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="bilinear", fill=0, center=None):
+    if isinstance(shear, numbers.Number):
+        shear = (float(shear), 0.0)
+    h, w = img.shape[:2]
+    if center is None:
+        center = ((w - 1) * 0.5, (h - 1) * 0.5)
+    return _warp_np(img, _affine_matrix(angle, translate, scale, shear,
+                                        center), fill)
+
+
+def rotate(img, angle, interpolation="bilinear", expand=False, center=None,
+           fill=0):
+    if expand:
+        h, w = img.shape[:2]
+        rot = math.radians(angle)
+        nw = int(round(abs(w * math.cos(rot)) + abs(h * math.sin(rot))))
+        nh = int(round(abs(w * math.sin(rot)) + abs(h * math.cos(rot))))
+        canvas_spec = ((nh - h + 1) // 2, (nw - w + 1) // 2)
+        padded = np.pad(img, [(canvas_spec[0], nh - h - canvas_spec[0]),
+                              (canvas_spec[1], nw - w - canvas_spec[1])]
+                        + [(0, 0)] * (img.ndim - 2),
+                        constant_values=fill)
+        return rotate(padded, angle, interpolation, False, None, fill)
+    return affine(img, angle=angle, fill=fill, center=center)
+
+
+def perspective(img, startpoints, endpoints, interpolation="bilinear",
+                fill=0):
+    """Warp so that ``startpoints`` (in the input) land on ``endpoints``."""
+    a = []
+    bvec = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([sx, sy, 1, 0, 0, 0, -ex * sx, -ex * sy])
+        a.append([0, 0, 0, sx, sy, 1, -ey * sx, -ey * sy])
+        bvec += [ex, ey]
+    coeffs = np.linalg.solve(np.asarray(a, np.float64),
+                             np.asarray(bvec, np.float64))
+    fwd = np.append(coeffs, 1.0).reshape(3, 3)
+    return _warp_np(img, np.linalg.inv(fwd), fill)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    out = img if inplace else img.copy()
+    out[i:i + h, j:j + w] = v
+    return out
+
+
+def adjust_brightness(img, brightness_factor):
+    imgf, dtype = _as_float(img)
+    return _restore(imgf * brightness_factor, dtype)
+
+
+def adjust_contrast(img, contrast_factor):
+    imgf, dtype = _as_float(img)
+    mean = to_grayscale(imgf).mean()
+    return _restore((imgf - mean) * contrast_factor + mean, dtype)
+
+
+def adjust_saturation(img, saturation_factor):
+    imgf, dtype = _as_float(img)
+    gray = to_grayscale(imgf, num_output_channels=img.shape[-1])
+    return _restore(imgf * saturation_factor
+                    + gray * (1 - saturation_factor), dtype)
+
+
+def adjust_hue(img, hue_factor):
+    """hue_factor in [-0.5, 0.5]: shift the HSV hue channel."""
+    imgf, dtype = _as_float(img)
+    scale = 255.0 if dtype == np.uint8 else 1.0
+    x = imgf / scale
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    maxc = x.max(-1)
+    minc = x.min(-1)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-12), 0.0)
+    dz = np.maximum(delta, 1e-12)
+    rc = (maxc - r) / dz
+    gc = (maxc - g) / dz
+    bc = (maxc - b) / dz
+    h = np.where(r == maxc, bc - gc,
+                 np.where(g == maxc, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = (h / 6.0) % 1.0
+    h = np.where(delta == 0, 0.0, h)
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(int) % 6
+    conds = [i == k for k in range(6)]
+    r2 = np.select(conds, [v, q, p, p, t, v])
+    g2 = np.select(conds, [t, v, v, q, p, p])
+    b2 = np.select(conds, [p, p, t, v, v, q])
+    return _restore(np.stack([r2, g2, b2], axis=-1) * scale, dtype)
+
+
+def to_grayscale(img, num_output_channels=1):
+    imgf, dtype = _as_float(img)
+    if imgf.ndim == 2:
+        gray = imgf
+    else:
+        gray = (0.299 * imgf[..., 0] + 0.587 * imgf[..., 1]
+                + 0.114 * imgf[..., 2])
+    if num_output_channels == 1:
+        out = gray[..., None] if img.ndim == 3 else gray
+    else:
+        out = np.stack([gray] * num_output_channels, axis=-1)
+    return _restore(out, dtype)
+
+
+def to_tensor(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+# ---------------------------------------------------------------------------
+# transform classes
+# ---------------------------------------------------------------------------
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return vflip(img)
+        return img
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.args = (padding, fill, padding_mode)
+
+    def __call__(self, img):
+        return pad(img, *self.args)
+
+
+class RandomRotation:
+    def __init__(self, degrees, interpolation="bilinear", expand=False,
+                 center=None, fill=0):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.kw = dict(interpolation=interpolation, expand=expand,
+                       center=center, fill=fill)
+
+    def __call__(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, **self.kw)
+
+
+class RandomResizedCrop:
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        self.size = ((size, size) if isinstance(size, int)
+                     else tuple(size))
+        self.scale, self.ratio = scale, ratio
+
+    def __call__(self, img):
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = math.exp(np.random.uniform(math.log(self.ratio[0]),
+                                            math.log(self.ratio[1])))
+            cw = int(round(math.sqrt(target * ar)))
+            ch = int(round(math.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = np.random.randint(0, h - ch + 1)
+                left = np.random.randint(0, w - cw + 1)
+                return resize(crop(img, top, left, ch, cw), self.size)
+        return resize(center_crop(img, min(h, w)), self.size)
+
+
+class Grayscale:
+    def __init__(self, num_output_channels=1):
+        self.n = num_output_channels
+
+    def __call__(self, img):
+        return to_grayscale(img, self.n)
+
+
+class BrightnessTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        return adjust_brightness(img,
+                                 np.random.uniform(max(0, 1 - self.value),
+                                                   1 + self.value))
+
+
+class ContrastTransform(BrightnessTransform):
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        return adjust_contrast(img,
+                               np.random.uniform(max(0, 1 - self.value),
+                                                 1 + self.value))
+
+
+class SaturationTransform(BrightnessTransform):
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        return adjust_saturation(img,
+                                 np.random.uniform(max(0, 1 - self.value),
+                                                   1 + self.value))
+
+
+class HueTransform:
+    def __init__(self, value):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        return adjust_hue(img, np.random.uniform(-self.value, self.value))
+
+
+class ColorJitter:
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.ts = [BrightnessTransform(brightness),
+                   ContrastTransform(contrast),
+                   SaturationTransform(saturation), HueTransform(hue)]
+
+    def __call__(self, img):
+        order = np.random.permutation(len(self.ts))
+        for i in order:
+            img = self.ts[i](img)
+        return img
+
+
+class RandomAffine:
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="bilinear", fill=0, center=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees, self.translate = degrees, translate
+        self.scale, self.shear = scale, shear
+        self.fill, self.center = fill, center
+
+    def __call__(self, img):
+        h, w = img.shape[:2]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = (np.random.uniform(*self.scale) if self.scale is not None
+              else 1.0)
+        sh = (0.0, 0.0)
+        if self.shear is not None:
+            shear = self.shear
+            if isinstance(shear, numbers.Number):
+                shear = (-shear, shear)
+            sh = (np.random.uniform(shear[0], shear[1]), 0.0)
+        return affine(img, angle, (tx, ty), sc, sh, fill=self.fill,
+                      center=self.center)
+
+
+class RandomPerspective:
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="bilinear", fill=0):
+        self.prob, self.d = prob, distortion_scale
+        self.fill = fill
+
+    def __call__(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        h, w = img.shape[:2]
+        dw = int(self.d * w / 2)
+        dh = int(self.d * h / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(np.random.randint(0, dw + 1), np.random.randint(0, dh + 1)),
+               (w - 1 - np.random.randint(0, dw + 1),
+                np.random.randint(0, dh + 1)),
+               (w - 1 - np.random.randint(0, dw + 1),
+                h - 1 - np.random.randint(0, dh + 1)),
+               (np.random.randint(0, dw + 1),
+                h - 1 - np.random.randint(0, dh + 1))]
+        return perspective(img, start, end, fill=self.fill)
+
+
+class RandomErasing:
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False):
+        self.prob, self.scale, self.ratio = prob, scale, ratio
+        self.value, self.inplace = value, inplace
+
+    def __call__(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            eh = int(round(math.sqrt(target * ar)))
+            ew = int(round(math.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                return erase(img, i, j, eh, ew, self.value, self.inplace)
+        return img

@@ -1,0 +1,142 @@
+"""Round-3 distribution tail — scipy/torch oracle tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as pt
+from paddle_tpu import distribution as D
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    pt.seed(7)
+
+
+class TestLogProbs:
+    def test_gamma(self):
+        d = D.Gamma(2.5, 1.5)
+        x = np.asarray([0.3, 1.0, 4.0], np.float32)
+        np.testing.assert_allclose(
+            np.asarray(d.log_prob(jnp.asarray(x))),
+            st.gamma.logpdf(x, 2.5, scale=1 / 1.5), rtol=1e-5)
+        np.testing.assert_allclose(float(d.mean), 2.5 / 1.5, rtol=1e-6)
+        np.testing.assert_allclose(
+            float(d.entropy()), st.gamma.entropy(2.5, scale=1 / 1.5),
+            rtol=1e-5)
+
+    def test_chi2(self):
+        d = D.Chi2(4.0)
+        x = np.asarray([0.5, 2.0, 7.0], np.float32)
+        np.testing.assert_allclose(np.asarray(d.log_prob(jnp.asarray(x))),
+                                   st.chi2.logpdf(x, 4.0), rtol=1e-5)
+
+    def test_poisson(self):
+        d = D.Poisson(3.0)
+        k = np.asarray([0.0, 2.0, 5.0], np.float32)
+        np.testing.assert_allclose(np.asarray(d.log_prob(jnp.asarray(k))),
+                                   st.poisson.logpmf(k, 3.0), rtol=1e-5)
+
+    def test_cauchy(self):
+        d = D.Cauchy(1.0, 2.0)
+        x = np.asarray([-3.0, 0.0, 5.0], np.float32)
+        np.testing.assert_allclose(np.asarray(d.log_prob(jnp.asarray(x))),
+                                   st.cauchy.logpdf(x, 1.0, 2.0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(d.cdf(jnp.asarray(x))),
+                                   st.cauchy.cdf(x, 1.0, 2.0), rtol=1e-5)
+
+    def test_student_t(self):
+        d = D.StudentT(5.0, 0.5, 2.0)
+        x = np.asarray([-1.0, 0.5, 3.0], np.float32)
+        np.testing.assert_allclose(
+            np.asarray(d.log_prob(jnp.asarray(x))),
+            st.t.logpdf(x, 5.0, loc=0.5, scale=2.0), rtol=1e-5)
+
+    def test_binomial(self):
+        d = D.Binomial(10, 0.3)
+        k = np.asarray([0.0, 3.0, 10.0], np.float32)
+        np.testing.assert_allclose(np.asarray(d.log_prob(jnp.asarray(k))),
+                                   st.binom.logpmf(k, 10, 0.3), rtol=1e-4)
+
+    def test_multinomial(self):
+        p = np.asarray([0.2, 0.3, 0.5], np.float32)
+        d = D.Multinomial(6, p)
+        x = np.asarray([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(
+            float(d.log_prob(jnp.asarray(x))),
+            st.multinomial.logpmf(x, 6, p), rtol=1e-5)
+        s = d.sample((50,))
+        assert s.shape == (50, 3)
+        np.testing.assert_allclose(np.asarray(s.sum(-1)), 6.0)
+
+    def test_mvn(self):
+        mu = np.asarray([0.5, -1.0], np.float32)
+        cov = np.asarray([[2.0, 0.3], [0.3, 1.0]], np.float32)
+        d = D.MultivariateNormal(mu, covariance_matrix=cov)
+        x = np.asarray([[0.0, 0.0], [1.0, -2.0]], np.float32)
+        np.testing.assert_allclose(
+            np.asarray(d.log_prob(jnp.asarray(x))),
+            st.multivariate_normal.logpdf(x, mu, cov), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(d.entropy()), st.multivariate_normal.entropy(mu, cov),
+            rtol=1e-5)
+        s = np.asarray(d.sample((4000,)))
+        np.testing.assert_allclose(s.mean(0), mu, atol=0.15)
+        np.testing.assert_allclose(np.cov(s.T), cov, atol=0.2)
+
+    def test_continuous_bernoulli(self):
+        import torch
+        d = D.ContinuousBernoulli(0.3)
+        td = torch.distributions.ContinuousBernoulli(0.3)
+        x = np.asarray([0.1, 0.5, 0.9], np.float32)
+        np.testing.assert_allclose(
+            np.asarray(d.log_prob(jnp.asarray(x))),
+            td.log_prob(torch.tensor(x)).numpy(), rtol=1e-4)
+        np.testing.assert_allclose(float(d.mean), float(td.mean), rtol=1e-4)
+
+
+class TestTransforms:
+    def test_transformed_lognormal(self):
+        td = D.TransformedDistribution(D.Normal(0.2, 0.8),
+                                       [D.ExpTransform()])
+        ref = D.LogNormal(0.2, 0.8)
+        x = jnp.asarray([0.5, 1.0, 2.5])
+        np.testing.assert_allclose(np.asarray(td.log_prob(x)),
+                                   np.asarray(ref.log_prob(x)), rtol=1e-5)
+
+    def test_affine_chain_roundtrip(self):
+        chain = D.ChainTransform([D.AffineTransform(1.0, 2.0),
+                                  D.TanhTransform()])
+        x = jnp.asarray([-0.5, 0.0, 0.7])
+        y = chain.forward(x)
+        np.testing.assert_allclose(np.asarray(chain.inverse(y)),
+                                   np.asarray(x), rtol=1e-5)
+
+    def test_sigmoid_power_ldj(self):
+        import torch
+        x = np.asarray([-1.0, 0.3, 2.0], np.float32)
+        ours = np.asarray(D.SigmoidTransform()
+                          .forward_log_det_jacobian(jnp.asarray(x)))
+        ref = (torch.distributions.transforms.SigmoidTransform()
+               .log_abs_det_jacobian(torch.tensor(x),
+                                     torch.sigmoid(torch.tensor(x))))
+        np.testing.assert_allclose(ours, ref.numpy(), rtol=1e-5)
+        p = np.asarray([0.5, 1.5, 3.0], np.float32)
+        ours = np.asarray(D.PowerTransform(2.0)
+                          .forward_log_det_jacobian(jnp.asarray(p)))
+        np.testing.assert_allclose(ours, np.log(2.0 * p), rtol=1e-5)
+
+
+class TestSampling:
+    def test_moments(self):
+        n = 8000
+        g = D.Gamma(3.0, 2.0).sample((n,))
+        np.testing.assert_allclose(float(g.mean()), 1.5, atol=0.1)
+        p = D.Poisson(4.0).sample((n,))
+        np.testing.assert_allclose(float(p.mean()), 4.0, atol=0.15)
+        t = D.StudentT(10.0, 1.0, 0.5).sample((n,))
+        np.testing.assert_allclose(float(t.mean()), 1.0, atol=0.1)
+        b = D.Binomial(12, 0.25).sample((n,))
+        np.testing.assert_allclose(float(b.mean()), 3.0, atol=0.15)

@@ -1,0 +1,237 @@
+"""Round-3 nn tail: loss zoo + pooling/activation torch-oracle tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def _t(x):
+    return torch.tensor(np.asarray(x))
+
+
+class TestLosses:
+    def test_soft_margin(self, rng):
+        x = rng.standard_normal((6, 4)).astype("float32")
+        y = np.sign(rng.standard_normal((6, 4))).astype("float32")
+        ours = float(F.soft_margin_loss(jnp.asarray(x), jnp.asarray(y)))
+        ref = float(tF.soft_margin_loss(_t(x), _t(y)))
+        assert abs(ours - ref) < 1e-5
+
+    def test_multi_margin(self, rng):
+        x = rng.standard_normal((6, 5)).astype("float32")
+        y = rng.integers(0, 5, 6)
+        ours = float(F.multi_margin_loss(jnp.asarray(x), jnp.asarray(y)))
+        ref = float(tF.multi_margin_loss(_t(x), torch.tensor(y)))
+        assert abs(ours - ref) < 1e-5
+
+    def test_multi_label_soft_margin(self, rng):
+        x = rng.standard_normal((6, 5)).astype("float32")
+        y = rng.integers(0, 2, (6, 5)).astype("float32")
+        ours = float(F.multi_label_soft_margin_loss(jnp.asarray(x),
+                                                    jnp.asarray(y)))
+        ref = float(tF.multilabel_soft_margin_loss(_t(x), _t(y)))
+        assert abs(ours - ref) < 1e-5
+
+    def test_triplet_with_distance(self, rng):
+        a, p, n = (rng.standard_normal((6, 8)).astype("float32")
+                   for _ in range(3))
+        ours = float(F.triplet_margin_with_distance_loss(
+            jnp.asarray(a), jnp.asarray(p), jnp.asarray(n), swap=True))
+        ref = float(tF.triplet_margin_with_distance_loss(
+            _t(a), _t(p), _t(n), swap=True))
+        assert abs(ours - ref) < 1e-5
+
+    def test_poisson_gaussian_nll(self, rng):
+        x = rng.uniform(0.1, 2.0, (6, 4)).astype("float32")
+        y = rng.uniform(0.1, 4.0, (6, 4)).astype("float32")
+        v = rng.uniform(0.2, 2.0, (6, 4)).astype("float32")
+        ours = float(F.poisson_nll_loss(jnp.asarray(x), jnp.asarray(y),
+                                        full=True))
+        ref = float(tF.poisson_nll_loss(_t(x), _t(y), full=True))
+        assert abs(ours - ref) < 1e-4
+        ours = float(F.gaussian_nll_loss(jnp.asarray(x), jnp.asarray(y),
+                                         jnp.asarray(v)))
+        ref = float(tF.gaussian_nll_loss(_t(x), _t(y), var=_t(v)))
+        assert abs(ours - ref) < 1e-4
+
+    def test_sigmoid_focal_matches_torchvision_formula(self, rng):
+        logit = rng.standard_normal((8, 3)).astype("float32")
+        label = rng.integers(0, 2, (8, 3)).astype("float32")
+        ours = float(F.sigmoid_focal_loss(jnp.asarray(logit),
+                                          jnp.asarray(label),
+                                          reduction="mean"))
+        p = 1 / (1 + np.exp(-logit))
+        ce = -(label * np.log(p) + (1 - label) * np.log(1 - p))
+        p_t = p * label + (1 - p) * (1 - label)
+        ref = ce * (1 - p_t) ** 2.0
+        ref = ref * (0.25 * label + 0.75 * (1 - label))
+        assert abs(ours - float(ref.mean())) < 1e-5
+
+    def test_dice_square_error(self, rng):
+        probs = jax.nn.softmax(
+            jnp.asarray(rng.standard_normal((4, 6, 3)).astype("float32")))
+        label = jnp.asarray(rng.integers(0, 3, (4, 6, 1)))
+        d = float(F.dice_loss(probs, label))
+        assert 0.0 < d < 1.0
+        x = rng.standard_normal(5).astype("float32")
+        y = rng.standard_normal(5).astype("float32")
+        np.testing.assert_allclose(
+            np.asarray(F.square_error_cost(jnp.asarray(x), jnp.asarray(y))),
+            (x - y) ** 2, rtol=1e-6)
+
+    def test_npair_loss_finite_and_decreases_for_aligned(self, rng):
+        a = rng.standard_normal((6, 8)).astype("float32")
+        labels = jnp.asarray([0, 0, 1, 1, 2, 2])
+        bad = float(F.npair_loss(jnp.asarray(a),
+                                 jnp.asarray(rng.standard_normal(
+                                     (6, 8)).astype("float32")), labels))
+        good = float(F.npair_loss(jnp.asarray(a) * 3, jnp.asarray(a) * 3,
+                                  labels, l2_reg=0.0))
+        assert np.isfinite(bad) and np.isfinite(good)
+
+    def test_rnnt_loss_matches_torchaudio(self, rng):
+        ta = pytest.importorskip("torchaudio")
+        b, t, u, v = 2, 5, 3, 6
+        logits = rng.standard_normal((b, t, u + 1, v)).astype("float32")
+        labels = rng.integers(1, v, (b, u)).astype("int32")
+        tlen = np.asarray([t, t - 1], np.int32)
+        ulen = np.asarray([u, u - 1], np.int32)
+        ours = float(F.rnnt_loss(jnp.asarray(logits), jnp.asarray(labels),
+                                 jnp.asarray(tlen), jnp.asarray(ulen)))
+        ref = float(ta.functional.rnnt_loss(
+            torch.tensor(logits), torch.tensor(labels.astype(np.int32)),
+            torch.tensor(tlen), torch.tensor(ulen), blank=0,
+            reduction="mean"))
+        assert abs(ours - ref) < 1e-3, (ours, ref)
+
+    def test_rnnt_loss_brute_force_oracle(self, rng):
+        """Exact check: enumerate every monotone (T,U) alignment path and
+        logsumexp their probabilities (tiny lattice, no torchaudio
+        needed)."""
+        import itertools
+        from scipy.special import log_softmax, logsumexp
+        b, t, u, v = 1, 3, 2, 4
+        logits = rng.standard_normal((b, t, u + 1, v)).astype("float32")
+        labels = np.asarray([[2, 3]], np.int32)
+        logp = log_softmax(logits.astype(np.float64), axis=-1)
+
+        # a path is a sequence of T blanks and U emits (the last step must
+        # be the final blank at (T-1, U)); enumerate interleavings
+        paths = []
+        for emit_positions in itertools.combinations(range(t + u - 1), u):
+            lp, ti, ui, ok = 0.0, 0, 0, True
+            for s in range(t + u):
+                if s < t + u - 1 and s in emit_positions:
+                    if ui >= u:
+                        ok = False
+                        break
+                    lp += logp[0, ti, ui, labels[0, ui]]
+                    ui += 1
+                else:
+                    if ti >= t:
+                        ok = False
+                        break
+                    lp += logp[0, ti, ui, 0]
+                    ti += 1
+            if ok and ti == t and ui == u:
+                paths.append(lp)
+        ref = -logsumexp(paths)
+        ours = float(F.rnnt_loss(jnp.asarray(logits), jnp.asarray(labels),
+                                 jnp.asarray([t]), jnp.asarray([u]),
+                                 reduction="none")[0])
+        assert abs(ours - ref) < 1e-3, (ours, ref)
+
+    def test_loss_classes(self, rng):
+        x = rng.standard_normal((4, 3)).astype("float32")
+        y = np.sign(rng.standard_normal((4, 3))).astype("float32")
+        cls = nn.SoftMarginLoss(reduction="sum")
+        fnv = F.soft_margin_loss(jnp.asarray(x), jnp.asarray(y),
+                                 reduction="sum")
+        assert abs(float(cls(jnp.asarray(x), jnp.asarray(y)))
+                   - float(fnv)) < 1e-6
+
+
+class TestPoolingActivation:
+    def test_lp_pool(self, rng):
+        x = rng.standard_normal((2, 3, 12)).astype("float32")
+        ours = np.asarray(F.lp_pool1d(jnp.asarray(x), 2.0, 3))
+        ref = tF.lp_pool1d(_t(x), 2.0, 3).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+        x2 = np.abs(rng.standard_normal((2, 3, 8, 10))).astype("float32")
+        ours = np.asarray(F.lp_pool2d(jnp.asarray(x2), 3.0, (2, 2)))
+        ref = tF.lp_pool2d(_t(x2), 3.0, (2, 2)).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_max_unpool1d_roundtrip(self, rng):
+        x = rng.standard_normal((2, 3, 12)).astype("float32")
+        tout, tidx = tF.max_pool1d(_t(x), 2, return_indices=True)
+        ours = np.asarray(F.max_unpool1d(jnp.asarray(tout.numpy()),
+                                         jnp.asarray(tidx.numpy()), 2))
+        ref = tF.max_unpool1d(tout, tidx, 2).numpy()
+        np.testing.assert_allclose(ours, ref, atol=1e-6)
+
+    def test_max_unpool3d_roundtrip(self, rng):
+        x = rng.standard_normal((2, 2, 4, 4, 4)).astype("float32")
+        tout, tidx = tF.max_pool3d(_t(x), 2, return_indices=True)
+        ours = np.asarray(F.max_unpool3d(jnp.asarray(tout.numpy()),
+                                         jnp.asarray(tidx.numpy()), 2))
+        ref = tF.max_unpool3d(tout, tidx, 2).numpy()
+        np.testing.assert_allclose(ours, ref, atol=1e-6)
+
+    def test_fractional_max_pool2d(self, rng):
+        x = rng.standard_normal((2, 3, 9, 9)).astype("float32")
+        out = np.asarray(F.fractional_max_pool2d(jnp.asarray(x), 4,
+                                                 random_u=0.3))
+        assert out.shape == (2, 3, 4, 4)
+        # every output is the max of SOME input window: values must exist
+        assert np.isin(out, x).all()
+        out3 = np.asarray(F.fractional_max_pool3d(
+            jnp.asarray(rng.standard_normal((1, 2, 6, 6, 6))
+                        .astype("float32")), 3, random_u=0.7))
+        assert out3.shape == (1, 2, 3, 3, 3)
+
+    def test_gumbel_softmax(self):
+        pt.seed(0)
+        x = jnp.asarray(np.random.default_rng(0)
+                        .standard_normal((5, 7)).astype("float32"))
+        y = F.gumbel_softmax(x, temperature=0.5)
+        np.testing.assert_allclose(np.asarray(y.sum(-1)), 1.0, rtol=1e-5)
+        h = F.gumbel_softmax(x, hard=True)
+        assert set(np.unique(np.asarray(h)).tolist()) <= {0.0, 1.0}
+        # straight-through: gradient flows
+        g = jax.grad(lambda z: F.gumbel_softmax(z, hard=True).sum())(x)
+        assert np.isfinite(np.asarray(g)).all()
+
+    def test_maxout(self, rng):
+        x = rng.standard_normal((2, 6, 4)).astype("float32")
+        ours = np.asarray(nn.Maxout(groups=3, axis=1)(jnp.asarray(x)))
+        ref = x.reshape(2, 2, 3, 4).max(axis=2)
+        np.testing.assert_allclose(ours, ref, atol=1e-6)
+
+    def test_misc_classes(self, rng):
+        x = jnp.asarray(rng.standard_normal((2, 3, 6)).astype("float32"))
+        assert nn.Softsign()(x).shape == x.shape
+        assert nn.LogSoftmax()(x).shape == x.shape
+        assert nn.ZeroPad1D([1, 2])(x).shape == (2, 3, 9)
+        x5 = jnp.ones((1, 1, 2, 2, 2))
+        assert nn.ZeroPad3D(1)(x5).shape == (1, 1, 4, 4, 4)
+        m = nn.RReLU()
+        m.eval()
+        neg = jnp.asarray([-1.0, 2.0])
+        out = np.asarray(m(neg))
+        assert out[1] == 2.0 and out[0] < 0.0
+
+    def test_spectral_norm_layer(self, rng):
+        w = jnp.asarray(rng.standard_normal((4, 6)).astype("float32"))
+        sn = nn.SpectralNorm(w.shape, power_iters=20)
+        out = np.asarray(sn(w))
+        s = np.linalg.svd(np.asarray(w), compute_uv=False)[0]
+        np.testing.assert_allclose(np.linalg.svd(out, compute_uv=False)[0],
+                                   1.0, rtol=1e-3)

@@ -1,0 +1,133 @@
+"""Round-3 op tail oracle tests (tests the tail3 batches against
+NumPy/SciPy/torch references)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+class TestStats:
+    def test_corrcoef_cov(self, rng):
+        x = rng.standard_normal((4, 30)).astype("float32")
+        np.testing.assert_allclose(np.asarray(pt.corrcoef(x)),
+                                   np.corrcoef(x), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(pt.cov(x)), np.cov(x),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(pt.cov(x, ddof=False)),
+                                   np.cov(x, ddof=0), rtol=1e-5, atol=1e-6)
+
+    def test_linalg_aliases(self, rng):
+        x = rng.standard_normal((4, 30)).astype("float32")
+        np.testing.assert_allclose(np.asarray(pt.linalg.corrcoef(x)),
+                                   np.corrcoef(x), rtol=1e-5, atol=1e-6)
+
+    def test_histc(self, rng):
+        import torch
+        x = rng.standard_normal(200).astype("float32")
+        ours = np.asarray(pt.histc(x, bins=12, min=-1.5, max=1.5))
+        ref = torch.histc(torch.tensor(x), bins=12, min=-1.5, max=1.5)
+        np.testing.assert_allclose(ours, ref.numpy(), atol=0)
+
+    def test_histc_auto_range(self, rng):
+        import torch
+        x = rng.standard_normal(64).astype("float32")
+        ours = np.asarray(pt.histc(x, bins=7))
+        ref = torch.histc(torch.tensor(x), bins=7)
+        np.testing.assert_allclose(ours, ref.numpy(), atol=0)
+
+
+class TestMathTail:
+    def test_polar_xlogy_logaddexp2_erfc_sinc(self, rng):
+        import torch
+        a = rng.uniform(0.1, 2.0, 16).astype("float32")
+        th = rng.uniform(-3, 3, 16).astype("float32")
+        ref = torch.polar(torch.tensor(a), torch.tensor(th)).numpy()
+        np.testing.assert_allclose(np.asarray(pt.polar(a, th)), ref,
+                                   rtol=1e-5, atol=1e-6)
+        x = rng.uniform(0.1, 3, 16).astype("float32")
+        y = rng.uniform(0.1, 3, 16).astype("float32")
+        np.testing.assert_allclose(
+            np.asarray(pt.xlogy(x, y)),
+            torch.special.xlogy(torch.tensor(x), torch.tensor(y)).numpy(),
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(pt.logaddexp2(x, y)),
+            torch.logaddexp2(torch.tensor(x), torch.tensor(y)).numpy(),
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(pt.erfc(x)), torch.erfc(torch.tensor(x)).numpy(),
+            rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(pt.sinc(x)), torch.sinc(torch.tensor(x)).numpy(),
+            rtol=1e-5, atol=1e-6)
+
+    def test_isin_cartesian_swapdims(self):
+        x = jnp.asarray([1, 2, 3, 4, 5])
+        np.testing.assert_array_equal(
+            np.asarray(pt.isin(x, jnp.asarray([2, 5]))),
+            [False, True, False, False, True])
+        out = np.asarray(pt.cartesian_prod(
+            [jnp.asarray([1, 2]), jnp.asarray([3, 4, 5])]))
+        import torch
+        ref = torch.cartesian_prod(torch.tensor([1, 2]),
+                                   torch.tensor([3, 4, 5])).numpy()
+        np.testing.assert_array_equal(out, ref)
+        z = jnp.ones((2, 3, 4))
+        assert pt.swapdims(z, 0, 2).shape == (4, 3, 2)
+
+
+class TestInplaceSurface:
+    def test_value_returning_aliases(self, rng):
+        x = jnp.asarray(rng.uniform(0.5, 2.0, 8).astype("float32"))
+        np.testing.assert_allclose(np.asarray(pt.exp_(x)),
+                                   np.asarray(pt.exp(x)))
+        np.testing.assert_allclose(np.asarray(pt.scale_(x, 3.0)),
+                                   np.asarray(pt.scale(x, 3.0)))
+        np.testing.assert_allclose(np.asarray(pt.clip_(x, 0.8, 1.5)),
+                                   np.asarray(pt.clip(x, 0.8, 1.5)))
+        np.testing.assert_allclose(np.asarray(pt.add_(x, x)),
+                                   np.asarray(x + x))
+
+    def test_fill_family(self):
+        x = jnp.ones((3, 4))
+        assert float(pt.zero_(x).sum()) == 0.0
+        assert float(pt.fill_(x, 2.5).mean()) == 2.5
+        d = np.asarray(pt.fill_diagonal_(jnp.zeros((4, 4)), 7.0))
+        np.testing.assert_allclose(np.diag(d), 7.0)
+        assert d.sum() == 4 * 7.0
+
+    def test_random_inplace_shapes(self):
+        x = jnp.zeros((5, 2))
+        u = pt.uniform_(x, -2.0, -1.0)
+        assert u.shape == x.shape and float(u.max()) <= -1.0
+        n = pt.normal_(x, mean=10.0, std=0.1)
+        assert abs(float(n.mean()) - 10.0) < 1.0
+
+
+class TestLinalgFftTail:
+    def test_cholesky_inverse(self, rng):
+        import torch
+        a = rng.standard_normal((5, 5)).astype("float32")
+        spd = a @ a.T + 5 * np.eye(5, dtype="float32")
+        lo = np.linalg.cholesky(spd).astype("float32")
+        ours = np.asarray(pt.linalg.cholesky_inverse(jnp.asarray(lo)))
+        ref = torch.cholesky_inverse(torch.tensor(lo)).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-3, atol=1e-4)
+
+    @pytest.mark.parametrize("fn,tfn", [("hfft2", "hfft2"),
+                                        ("ihfft2", "ihfft2"),
+                                        ("hfftn", "hfftn"),
+                                        ("ihfftn", "ihfftn")])
+    def test_hermitian_ffts(self, rng, fn, tfn):
+        import torch
+        x = (rng.standard_normal((4, 6)) + 1j * rng.standard_normal((4, 6)))
+        if fn.startswith("ihfft"):
+            x = x.real.astype("float32")
+        else:
+            x = x.astype("complex64")
+        ours = np.asarray(getattr(pt.fft, fn)(jnp.asarray(x)))
+        ref = getattr(torch.fft, tfn)(torch.tensor(x)).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)

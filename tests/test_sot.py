@@ -1,0 +1,277 @@
+"""SOT-lite: automatic control-flow conversion under ``to_static``
+(reference: python/paddle/jit/sot bytecode capture; here an AST rewrite —
+see paddle_tpu/jit/sot.py).
+
+Contract (VERDICT r2 #3): a function/model written with a bare
+data-dependent ``if``/``while`` runs under to_static unmodified, matches
+eager, and unconvertible patterns keep the graph-break diagnostic or the
+eager fallback with a signature-keyed guard cache."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.jit import GraphBreakError, to_static
+from paddle_tpu.jit.sot import convert_control_flow
+
+
+class TestIfConversion:
+    def test_if_else_assignment(self):
+        def f(x):
+            if x.sum() > 0:
+                y = x * 2.0
+            else:
+                y = x - 1.0
+            return y + 1.0
+
+        g = to_static(f)
+        pos = jnp.asarray([1.0, 2.0])
+        neg = jnp.asarray([-3.0, 1.0])
+        np.testing.assert_allclose(g(pos), f(pos))
+        np.testing.assert_allclose(g(neg), f(neg))
+
+    def test_if_without_else(self):
+        def f(x):
+            y = x + 1.0
+            if y.mean() > 0:
+                y = y * 10.0
+            return y
+
+        g = to_static(f)
+        for v in ([1.0, 1.0], [-5.0, -5.0]):
+            x = jnp.asarray(v)
+            np.testing.assert_allclose(g(x), f(x))
+
+    def test_elif_chain_returns(self):
+        def f(x):
+            s = x.sum()
+            if s > 1.0:
+                return x * 2.0
+            elif s > -1.0:
+                return x * 0.5
+            else:
+                return -x
+
+        g = to_static(f)
+        for v in ([5.0], [0.1], [-9.0]):
+            x = jnp.asarray(v)
+            np.testing.assert_allclose(g(x), f(x))
+
+    def test_branches_actually_compiled_once(self):
+        """The converted function traces ONCE and both branches live in the
+        compiled program — no per-value retrace, no eager fallback."""
+        traces = []
+
+        def f(x):
+            traces.append(1)
+            if x.sum() > 0:
+                y = x * 2.0
+            else:
+                y = x * 3.0
+            return y
+
+        g = to_static(f)
+        a = g(jnp.asarray([1.0]))
+        b = g(jnp.asarray([-1.0]))
+        np.testing.assert_allclose(a, [2.0])
+        np.testing.assert_allclose(b, [-3.0])
+        assert len(traces) == 1  # same shape -> one trace, value-dispatched
+
+    def test_nested_if(self):
+        def f(x):
+            if x.sum() > 0:
+                if x.max() > 10.0:
+                    y = x * 100.0
+                else:
+                    y = x * 2.0
+            else:
+                y = -x
+            return y
+
+        g = to_static(f)
+        for v in ([20.0], [1.0], [-4.0]):
+            x = jnp.asarray(v)
+            np.testing.assert_allclose(g(x), f(x))
+
+    def test_concrete_pred_keeps_python_semantics(self):
+        def f(x, flag):
+            if flag:          # concrete python bool: only taken branch runs
+                y = x + 1.0
+            else:
+                y = x.bad_attribute_that_would_raise  # must never execute
+            return y
+
+        g = to_static(f, static_argnums=(1,))
+        np.testing.assert_allclose(g(jnp.asarray([1.0]), True), [2.0])
+
+
+class TestWhileConversion:
+    def test_while_tensor_pred(self):
+        def f(x):
+            while x.sum() < 100.0:
+                x = x * 2.0
+            return x
+
+        g = to_static(f)
+        x = jnp.asarray([1.0, 2.0])
+        np.testing.assert_allclose(g(x), f(x))
+
+    def test_while_multi_carry(self):
+        def f(x):
+            n = jnp.zeros((), jnp.int32)
+            while x.sum() < 50.0:
+                x = x + 1.0
+                n = n + 1
+            return x, n
+
+        g = to_static(f)
+        ex, en = f(jnp.asarray([0.0]))
+        cx, cn = g(jnp.asarray([0.0]))
+        np.testing.assert_allclose(cx, ex)
+        assert int(cn) == int(en) == 50
+
+    def test_while_concrete_pred_unrolls(self):
+        def f(x):
+            i = 0
+            while i < 3:     # concrete: unrolls under trace
+                x = x * 2.0
+                i += 1
+            return x
+
+        g = to_static(f)
+        np.testing.assert_allclose(g(jnp.asarray([1.0])), [8.0])
+
+
+class TestFallback:
+    def test_one_sided_assignment_full_graph_raises(self):
+        def f(x):
+            if x.sum() > 0:
+                extra = x * 5.0
+                return extra
+            return x  # `extra` undefined on this path; value-form declined
+
+        g = to_static(f, full_graph=True)
+        with pytest.raises(GraphBreakError):
+            g(jnp.asarray([1.0]))
+
+    def test_unconvertible_falls_back_eagerly(self):
+        seen = []
+
+        def f(x):
+            if x.sum() > 0:   # side-effect branch: not convertible
+                seen.append(1)
+            return x * 2.0
+
+        g = to_static(f, full_graph=False)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = g(jnp.asarray([3.0]))
+            np.testing.assert_allclose(out, [6.0])
+            assert seen == [1]
+            # guard cache: second call with same signature goes straight to
+            # eager (side effect runs again; no exception, no re-jit)
+            g(jnp.asarray([4.0]))
+            assert seen == [1, 1]
+
+    def test_attribute_store_branch_not_captured(self):
+        """lax.cond traces BOTH branches; a branch mutating object state
+        must keep graph-break behavior, not convert (else the mutation
+        runs unconditionally and leaks tracers)."""
+        class Box:
+            hits = 0
+
+        box = Box()
+
+        def f(x):
+            if x.sum() > 0:
+                y = x * 2.0
+                box.hits = box.hits + 1   # side effect: blocks conversion
+            else:
+                y = -x
+            return y
+
+        g = to_static(f, full_graph=False)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = g(jnp.asarray([-1.0]))   # negative: branch NOT taken
+        np.testing.assert_allclose(out, [1.0])
+        assert box.hits == 0               # eager fallback, branch skipped
+
+    def test_conversion_off_restores_old_behavior(self):
+        def f(x):
+            if x.sum() > 0:
+                y = x * 2.0
+            else:
+                y = -x
+            return y
+
+        g = to_static(f, convert_control_flow=False, full_graph=True)
+        with pytest.raises(GraphBreakError):
+            g(jnp.asarray([1.0]))
+
+
+class TestLayerConversion:
+    def test_model_with_bare_if_runs_unmodified(self):
+        class Gate(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.lin(x)
+                if h.mean() > 0:       # bare data-dependent branch
+                    out = h * 2.0
+                else:
+                    out = h * 0.5
+                return out
+
+        pt.seed(0)
+        m = Gate()
+        x = jnp.asarray(np.random.default_rng(0)
+                        .standard_normal((2, 4)).astype("float32"))
+        eager = m(x)
+        g = to_static(m)
+        np.testing.assert_allclose(np.asarray(g(x)), np.asarray(eager),
+                                   rtol=1e-6)
+
+    def test_model_with_while_decode_loop(self):
+        class Doubler(nn.Layer):
+            def forward(self, x):
+                while x.sum() < 30.0:
+                    x = x * 2.0
+                return x
+
+        m = Doubler()
+        x = jnp.asarray([1.0, 1.5])
+        g = to_static(m)
+        np.testing.assert_allclose(np.asarray(g(x)), np.asarray(m(x)))
+
+
+class TestConvertFunction:
+    def test_no_control_flow_unchanged(self):
+        def f(x):
+            return x * 2
+
+        _, changed = convert_control_flow(f)
+        assert not changed
+
+    def test_closure_snapshot(self):
+        scale = jnp.asarray(3.0)
+
+        def make():
+            def f(x):
+                if x.sum() > 0:
+                    y = x * scale
+                else:
+                    y = x
+                return y
+            return f
+
+        f = make()
+        g = to_static(f)
+        np.testing.assert_allclose(g(jnp.asarray([2.0])), [6.0])

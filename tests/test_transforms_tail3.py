@@ -1,0 +1,119 @@
+"""Vision transforms tail — property/invariant tests (torchvision is not
+in the image, so oracles are analytic: identity params, exact flips,
+known-angle rotations, HSV round-trips)."""
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu.vision.transforms as T
+
+
+@pytest.fixture
+def img(rng):
+    return rng.uniform(0, 255, (16, 20, 3)).astype(np.uint8)
+
+
+class TestFunctional:
+    def test_crops_flips(self, img):
+        assert T.crop(img, 2, 3, 5, 7).shape == (5, 7, 3)
+        np.testing.assert_array_equal(T.hflip(img), img[:, ::-1])
+        np.testing.assert_array_equal(T.vflip(img), img[::-1])
+        cc = T.center_crop(img, 10)
+        np.testing.assert_array_equal(cc, img[3:13, 5:15])
+
+    def test_pad_modes(self, img):
+        assert T.pad(img, 3).shape == (22, 26, 3)
+        assert T.pad(img, (1, 2)).shape == (20, 22, 3)
+        assert T.pad(img, (1, 2, 3, 4)).shape == (22, 24, 3)
+        r = T.pad(img, 2, padding_mode="reflect")
+        np.testing.assert_array_equal(r[0, 2:-2], img[2])
+
+    def test_rotate_identity_and_90(self, img):
+        ident = T.rotate(img, 0.0)
+        np.testing.assert_allclose(ident.astype(int), img.astype(int),
+                                   atol=1)
+        sq = img[:16, :16]
+        r90 = T.rotate(sq, 90.0)
+        # interior matches np.rot90 (boundary pixels interpolate)
+        ref = np.rot90(sq, axes=(1, 0))  # rotate() is counter-clockwise?
+        ref_ccw = np.rot90(sq)
+        match_cw = np.mean(np.abs(r90[2:-2, 2:-2].astype(int)
+                                  - ref[2:-2, 2:-2].astype(int)) <= 1)
+        match_ccw = np.mean(np.abs(r90[2:-2, 2:-2].astype(int)
+                                   - ref_ccw[2:-2, 2:-2].astype(int)) <= 1)
+        assert max(match_cw, match_ccw) > 0.95
+
+    def test_affine_identity(self, img):
+        out = T.affine(img, angle=0.0, translate=(0, 0), scale=1.0)
+        np.testing.assert_allclose(out.astype(int), img.astype(int), atol=1)
+
+    def test_affine_translate(self, img):
+        out = T.affine(img, translate=(3, 0))
+        np.testing.assert_array_equal(out[:, 3:], img[:, :-3])
+
+    def test_perspective_identity(self, img):
+        h, w = img.shape[:2]
+        pts = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        out = T.perspective(img, pts, pts)
+        np.testing.assert_allclose(out.astype(int), img.astype(int), atol=1)
+
+    def test_adjusts(self, img):
+        np.testing.assert_array_equal(T.adjust_brightness(img, 1.0), img)
+        np.testing.assert_allclose(
+            T.adjust_brightness(img, 0.5).astype(float),
+            np.clip(np.round(img * 0.5), 0, 255), atol=1)
+        np.testing.assert_allclose(T.adjust_contrast(img, 1.0).astype(int),
+                                   img.astype(int), atol=1)
+        np.testing.assert_allclose(
+            T.adjust_saturation(img, 1.0).astype(int), img.astype(int),
+            atol=1)
+        np.testing.assert_allclose(T.adjust_hue(img, 0.0).astype(int),
+                                   img.astype(int), atol=1)
+        # hue shift by 1/3 permutes pure-channel colors: red -> green
+        red = np.zeros((2, 2, 3), np.uint8)
+        red[..., 0] = 200
+        shifted = T.adjust_hue(red, 1.0 / 3.0)
+        assert shifted[..., 1].min() > 150 and shifted[..., 0].max() < 50
+
+    def test_grayscale_and_erase(self, img):
+        g = T.to_grayscale(img)
+        assert g.shape == (16, 20, 1)
+        g3 = T.to_grayscale(img, 3)
+        assert (g3[..., 0] == g3[..., 1]).all()
+        e = T.erase(img, 2, 3, 4, 5, 0)
+        assert (e[2:6, 3:8] == 0).all()
+        assert (e[0:2] == img[0:2]).all()
+
+
+class TestClasses:
+    def test_random_classes_shapes(self, img):
+        np.random.seed(0)
+        assert T.RandomVerticalFlip(1.0)(img).shape == img.shape
+        assert T.RandomRotation(15)(img).shape == img.shape
+        assert T.RandomResizedCrop(8)(img).shape == (8, 8, 3)
+        assert T.RandomAffine(10, translate=(0.1, 0.1), scale=(0.9, 1.1),
+                              shear=5)(img).shape == img.shape
+        assert T.RandomPerspective(1.0)(img).shape == img.shape
+        assert T.Grayscale(3)(img).shape == img.shape
+        assert T.ColorJitter(0.3, 0.3, 0.3, 0.2)(img).shape == img.shape
+        assert T.Pad(2)(img).shape == (20, 24, 3)
+
+    def test_random_erasing(self, img):
+        np.random.seed(1)
+        out = T.RandomErasing(prob=1.0)(img)
+        assert out.shape == img.shape
+        assert (out != img).any()
+
+    def test_vflip_prob_zero_identity(self, img):
+        np.testing.assert_array_equal(T.RandomVerticalFlip(0.0)(img), img)
+
+    def test_compose_pipeline(self, img):
+        np.random.seed(2)
+        pipe = T.Compose([T.RandomResizedCrop(12),
+                          T.RandomHorizontalFlip(0.5),
+                          T.ColorJitter(0.2, 0.2, 0.2, 0.1),
+                          T.ToTensor()])
+        out = pipe(img)
+        assert out.shape == (3, 12, 12)

@@ -26,7 +26,9 @@ NAMESPACES = [
     "paddle_tpu.distributed.fleet", "paddle_tpu.distribution",
     "paddle_tpu.signal", "paddle_tpu.geometric", "paddle_tpu.regularizer",
     "paddle_tpu.callbacks", "paddle_tpu.jit", "paddle_tpu.ckpt",
-    "paddle_tpu.hapi", "paddle_tpu.vision", "paddle_tpu.audio",
+    "paddle_tpu.hapi", "paddle_tpu.vision", "paddle_tpu.vision.ops",
+    "paddle_tpu.vision.models", "paddle_tpu.vision.transforms",
+    "paddle_tpu.audio",
     "paddle_tpu.sparse", "paddle_tpu.quantization", "paddle_tpu.incubate",
     "paddle_tpu.inference", "paddle_tpu.static", "paddle_tpu.profiler",
     "paddle_tpu.utils",

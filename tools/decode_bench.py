@@ -1,0 +1,123 @@
+"""End-to-end decode throughput benchmark (VERDICT r2 #5; SURVEY L10).
+
+Measures, on the real chip:
+1. ``generate()`` decode tokens/sec for llama-350m at bs in {1, 8}
+   (greedy, KV cache, prefill 128) using the SLOPE method: time two decode
+   lengths inside the compiled loop and divide the delta — prefill cost,
+   dispatch overhead and the relay RTT cancel (docs/BENCH.md protocol).
+2. op-level paged vs contiguous (masked) decode attention at the same
+   shapes, amortized inside one jit.
+
+Prints one JSON line per measurement.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bench_generate(preset="llama-350m", batch=1, prefill=128,
+                   n_lo=16, n_hi=144, repeats=3):
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import llama
+
+    pt.seed(0)
+    model = llama(preset, max_position_embeddings=prefill + n_hi + 8,
+                  dtype="bfloat16")
+    model.eval()
+    ids = jax.random.randint(jax.random.key(1), (batch, prefill), 0,
+                             model.cfg.vocab_size)
+
+    def run(n):
+        out = model.generate(ids, max_new_tokens=n)
+        jax.block_until_ready(out)
+        return out
+
+    # compile both lengths
+    run(n_lo), run(n_hi)
+
+    def timed(n):
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            out = run(n)
+            _ = int(np.asarray(out)[0, -1])  # force host sync through relay
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_lo, t_hi = timed(n_lo), timed(n_hi)
+    per_tok = (t_hi - t_lo) / (n_hi - n_lo)
+    return {"metric": "decode_tokens_per_sec", "preset": preset,
+            "batch": batch, "prefill": prefill,
+            "ms_per_token": round(1000 * per_tok, 3),
+            "tokens_per_sec": round(batch / per_tok, 1),
+            "sec_lo": round(t_lo, 3), "sec_hi": round(t_hi, 3),
+            "decode_lens": [n_lo, n_hi]}
+
+
+def bench_decode_attention(batch=8, heads=16, head_dim=64, ctx=1024,
+                           block_size=64, iters=200):
+    """Paged vs contiguous decode attention, op-level, slope-amortized."""
+    from paddle_tpu.incubate.nn import functional as IF
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal(
+        (batch, heads, head_dim)).astype("float32"))
+    kc = jnp.asarray(rng.standard_normal(
+        (batch, ctx, heads, head_dim)).astype("float32"))
+    vc = jnp.asarray(rng.standard_normal(
+        (batch, ctx, heads, head_dim)).astype("float32"))
+    lens = jnp.full((batch,), ctx, jnp.int32)
+
+    n_blocks = ctx // block_size
+    k_pool = kc.reshape(batch * n_blocks, block_size, heads, head_dim)
+    v_pool = vc.reshape(batch * n_blocks, block_size, heads, head_dim)
+    tables = jnp.arange(batch * n_blocks, dtype=jnp.int32).reshape(
+        batch, n_blocks)
+
+    def loop(fn, *args):
+        def body(x, _):
+            out = fn(*args)
+            return x + out.sum(), None
+        return jax.lax.scan(body, jnp.zeros(()), None, length=iters)[0]
+
+    def contiguous(q=q):
+        return IF.masked_multihead_attention(q, kc, vc, lens)[0]
+
+    def paged(q=q):
+        return IF.paged_attention(q, k_pool, v_pool, tables, lens)
+
+    out = {}
+    for name, fn in (("contiguous_masked", contiguous), ("paged", paged)):
+        jitted = jax.jit(lambda fn=fn: loop(fn))
+        try:
+            _ = float(jitted())            # compile + warm
+            t0 = time.perf_counter()
+            _ = float(jitted())
+            dt = time.perf_counter() - t0
+            out[name + "_us_per_call"] = round(1e6 * dt / iters, 1)
+        except Exception as e:  # noqa: BLE001
+            out[name + "_error"] = str(e)[:200]
+    out.update({"metric": "decode_attention_paged_vs_contiguous",
+                "batch": batch, "ctx": ctx, "heads": heads,
+                "head_dim": head_dim, "block_size": block_size})
+    return out
+
+
+def main():
+    for batch in (1, 8):
+        print(json.dumps(bench_generate(batch=batch)), flush=True)
+    print(json.dumps(bench_decode_attention()), flush=True)
+
+
+if __name__ == "__main__":
+    main()

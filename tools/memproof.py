@@ -1,0 +1,259 @@
+"""Compiler-verified HBM-highwater proof for the BASELINE.json configs.
+
+SURVEY §6 / VERDICT r2 "What's missing" #2: everything multi-chip runs at
+tiny shapes on the CPU mesh; nothing demonstrated that the REAL 7B/13B/70B
+shapes fit per-chip HBM under the claimed sharding.  XLA can prove this
+without hardware: ``jax.experimental.topologies.get_topology_desc`` gives a
+deviceless TPU topology, ``nn.meta_init()`` constructs the model abstractly
+(no host RAM), ``TrainStep.abstract_state()`` carries shapes+shardings, and
+``lower().compile().memory_analysis()`` returns the compiler's own
+per-chip memory accounting.
+
+Run:  python tools/memproof.py [--only NAME] [--out docs/memproof.json]
+
+Each entry records argument/output/temp/alias bytes and the derived
+highwater (args + out - alias + temp), compared against the chip HBM
+budget.  Configs marked ``expected="exceeds"`` document WHY the naive
+claim fails and are paired with a corrected variant that fits.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+GIB = 1024 ** 3
+HBM = {"v5e": 16 * GIB, "v5p": 95 * GIB}
+
+
+@dataclasses.dataclass
+class Case:
+    name: str
+    chip: str                 # "v5e" | "v5p"
+    topology: str             # get_topology_desc name
+    hybrid: dict              # fleet hybrid_configs
+    model: str                # "llama2-7b" | "gpt3-13b" | "llama2-70b"
+    zero_stage: int
+    batch: int                # GLOBAL batch size
+    seq: int
+    use_recompute: bool = True
+    pipeline_stages: int = 1
+    num_microbatches: int = 1
+    loss_seq_chunks: int = 1   # llama: rematerialized seq-chunked vocab CE
+    offload: bool = False      # ZeRO optimizer states in pinned host memory
+    note: str = ""
+
+
+CASES = [
+    # BASELINE config 1: Llama-2 7B pure-DP (+ZeRO-1) — the literal claim
+    # on a v5e-8: bf16 params replicated per chip, ZeRO-1 shards only the
+    # optimizer state.  Measured to show whether the claim holds at 4k seq.
+    Case("7b-dp8-zero1-v5e8", "v5e", "v5e:2x4", {"dp_degree": 8},
+         "llama2-7b", 1, batch=8, seq=4096,
+         note="BASELINE claim: pure DP + ZeRO-1"),
+    # corrected variant: ZeRO-3 over the same 8 chips (params+grads+opt all
+    # sharded over the data axis; XLA all-gathers per layer)
+    Case("7b-sh8-zero3-v5e8", "v5e", "v5e:2x4", {"sharding_degree": 8},
+         "llama2-7b", 3, batch=8, seq=4096,
+         note="corrected: ZeRO-3 sharding over 8 chips"),
+    # BASELINE config 2: 13B-class TP+PP hybrid on a v5e-64
+    Case("13b-mp8pp4dp2-v5e64", "v5e", "v5e:8x8",
+         {"mp_degree": 8, "pp_degree": 4, "dp_degree": 2},
+         "gpt3-13b", 1, batch=16, seq=2048,
+         pipeline_stages=4, num_microbatches=8,
+         note="BASELINE claim: TP8 x PP4 x DP2 + ZeRO-1"),
+    # BASELINE config 5: Llama-2 70B ZeRO-3 on a v5p-128
+    Case("70b-sh128-zero3-v5p128", "v5p", "v5p:4x4x8",
+         {"sharding_degree": 128},
+         "llama2-70b", 3, batch=128, seq=4096,
+         note="BASELINE claim: ZeRO-3 over 128 chips"),
+    # ---- corrected variants (docs/MEMPROOF.md discusses each) ----------
+    # 7B ZeRO-3 misses 16 GiB by ~0.8 GiB on f32 vocab logits; the
+    # loss_seq_chunks knob remats the CE in sequence chunks
+    Case("7b-sh8-zero3-cechunk-v5e8", "v5e", "v5e:2x4",
+         {"sharding_degree": 8},
+         "llama2-7b", 3, batch=8, seq=4096, loss_seq_chunks=8,
+         note="corrected attempt: ZeRO-3 + seq-chunked CE (still ~0.6 over)"),
+    # master+moments (f32, the bulk of the argument bytes) to pinned host:
+    # the reference's sharding offload knob, here a memory_kind annotation
+    Case("7b-sh8-zero3-offload-v5e8", "v5e", "v5e:2x4",
+         {"sharding_degree": 8},
+         "llama2-7b", 3, batch=8, seq=4096, loss_seq_chunks=8, offload=True,
+         note="corrected: ZeRO-3 + CE chunks + optimizer-state host offload"),
+    # 13B TP+PP misses by ~0.5 GiB at global batch 16; halving the batch
+    # (dp microbatch 4) clears it
+    Case("13b-mp8pp4dp2-b8-v5e64", "v5e", "v5e:8x8",
+         {"mp_degree": 8, "pp_degree": 4, "dp_degree": 2},
+         "gpt3-13b", 1, batch=8, seq=2048,
+         pipeline_stages=4, num_microbatches=8,
+         note="corrected: TP8 x PP4 x DP2, global batch 8"),
+    # flat ZeRO-3 on 80 separate layers lets XLA hoist every all-gather
+    # (144 GiB/chip of temp); the stacked-scan PP body bounds parameter
+    # liveness per stage — pp8 x sharding16 is the corrected 70B recipe
+    Case("70b-pp8sh16-zero3-v5p128", "v5p", "v5p:4x4x8",
+         {"pp_degree": 8, "sharding_degree": 16},
+         "llama2-70b", 3, batch=64, seq=4096,
+         pipeline_stages=8, num_microbatches=8, loss_seq_chunks=8,
+         note="corrected attempt: PP8 x ZeRO-3(16) — 53.5G real + 52% "
+              "allocator fragmentation"),
+    Case("70b-pp8sh16-zero3-off-v5p128", "v5p", "v5p:4x4x8",
+         {"pp_degree": 8, "sharding_degree": 16},
+         "llama2-70b", 3, batch=32, seq=4096,
+         pipeline_stages=8, num_microbatches=8, loss_seq_chunks=8,
+         offload=True,
+         note="corrected attempt: PP8 x ZeRO-3(16) + offload — temp "
+              "unchanged; the gather hoisting is the binding constraint"),
+    # the Megatron-shaped recipe: TP shards every layer's weights (no
+    # ZeRO-3 per-layer regather for XLA to hoist), PP bounds live layers,
+    # sharded optimizer states over the remaining axis
+    Case("70b-mp8pp4sh4-v5p128", "v5p", "v5p:4x4x8",
+         {"mp_degree": 8, "pp_degree": 4, "sharding_degree": 4},
+         "llama2-70b", 1, batch=32, seq=4096,
+         pipeline_stages=4, num_microbatches=8, loss_seq_chunks=8,
+         note="corrected: TP8 x PP4 x sharded-opt(4) + ZeRO-1"),
+]
+
+
+def build_case(case: Case):
+    from jax.experimental import topologies
+    from jax.sharding import NamedSharding
+
+    from paddle_tpu import nn
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.optimizer import AdamW
+
+    td = topologies.get_topology_desc(platform="tpu",
+                                      topology_name=case.topology)
+    devs = list(td.devices)
+    fleet._reset()
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = dict(case.hybrid)
+    fleet.init(is_collective=True, strategy=s, devices=devs)
+
+    if case.model.startswith("llama"):
+        from paddle_tpu.models.llama import PRESETS, causal_lm_loss, llama
+        cfg = dataclasses.replace(
+            PRESETS[case.model], dtype="bfloat16",
+            use_recompute=case.use_recompute,
+            pipeline_stages=case.pipeline_stages,
+            num_microbatches=(case.num_microbatches
+                              if case.pipeline_stages > 1 else None),
+            loss_seq_chunks=case.loss_seq_chunks,
+            max_position_embeddings=max(case.seq,
+                                        PRESETS[case.model].max_position_embeddings))
+        with nn.meta_init():
+            model = llama(cfg)
+        loss_fn = causal_lm_loss
+    else:
+        from paddle_tpu.models.gpt import PRESETS, gpt
+        cfg = dataclasses.replace(
+            PRESETS[case.model], dtype="bfloat16",
+            use_recompute=case.use_recompute,
+            pipeline_stages=case.pipeline_stages,
+            num_microbatches=case.num_microbatches,
+            max_position_embeddings=max(case.seq,
+                                        PRESETS[case.model].max_position_embeddings))
+        with nn.meta_init():
+            model = gpt(cfg)
+        loss_fn = lambda mm, b: mm(b["input_ids"], labels=b["labels"])
+
+    opt = AdamW(learning_rate=1e-4, parameters=model.parameters())
+    # same recipe as bench.py / real training: bf16 params + f32 master
+    # weights via amp O2 (cfg.dtype alone does not cast parameters)
+    from paddle_tpu import amp
+    model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+    if case.offload:
+        opt._zero_offload = True
+    step = TrainStep(model, loss_fn, opt, zero_stage=case.zero_stage)
+    astate = step.abstract_state()
+    bsh = NamedSharding(step.mesh, step.batch_spec)
+    batch = {"input_ids": jax.ShapeDtypeStruct((case.batch, case.seq),
+                                               jnp.int32, sharding=bsh),
+             "labels": jax.ShapeDtypeStruct((case.batch, case.seq),
+                                            jnp.int64, sharding=bsh)}
+    return step, astate, batch, cfg
+
+
+def run_case(case: Case) -> dict:
+    t0 = time.monotonic()
+    rec = {"name": case.name, "chip": case.chip, "topology": case.topology,
+           "hybrid": case.hybrid, "model": case.model,
+           "zero_stage": case.zero_stage, "global_batch": case.batch,
+           "seq": case.seq, "use_recompute": case.use_recompute,
+           "dtype": "bfloat16 params, f32 master+moments (multi_precision)",
+           "note": case.note}
+    try:
+        step, astate, batch, _ = build_case(case)
+        compiled = step.lower(astate, batch).compile()
+        ma = compiled.memory_analysis()
+        high = (ma.argument_size_in_bytes + ma.output_size_in_bytes
+                - ma.alias_size_in_bytes + ma.temp_size_in_bytes)
+        budget = HBM[case.chip]
+        rec.update({
+            "argument_bytes": ma.argument_size_in_bytes,
+            "output_bytes": ma.output_size_in_bytes,
+            "alias_bytes": ma.alias_size_in_bytes,
+            "temp_bytes": ma.temp_size_in_bytes,
+            "generated_code_bytes": ma.generated_code_size_in_bytes,
+            "highwater_bytes": high,
+            "highwater_gib": round(high / GIB, 3),
+            "hbm_budget_gib": round(budget / GIB, 3),
+            "fits": bool(high <= budget),
+            "utilization": round(high / budget, 4),
+            "compile_seconds": round(time.monotonic() - t0, 1),
+        })
+    except Exception as e:  # noqa: BLE001 — record the failure
+        import re
+        msg = f"{type(e).__name__}: {e}"
+        m = re.search(r"Used ([\d.]+)G of ([\d.]+)G hbm. Exceeded hbm "
+                      r"capacity by ([\d.]+)G", msg)
+        if m:
+            # the compiler's own OOM accounting IS the measurement
+            rec.update({"fits": False,
+                        "compiler_used_gib": float(m.group(1)),
+                        "compiler_budget_gib": float(m.group(2)),
+                        "exceeded_by_gib": float(m.group(3))})
+        rec.update({"error": msg.split("Largest program allocations")[0]
+                    .strip()[:2000],
+                    "compile_seconds": round(time.monotonic() - t0, 1)})
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", default=None,
+                    help="substring filter on case names")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "memproof.json"))
+    args = ap.parse_args()
+    results = {}
+    if os.path.exists(args.out):
+        results = {r["name"]: r for r in json.load(open(args.out))}
+    for case in CASES:
+        if args.only and args.only not in case.name:
+            continue
+        print(f"== {case.name} ({case.topology}, {case.hybrid}) ...",
+              flush=True)
+        rec = run_case(case)
+        results[rec["name"]] = rec
+        print(json.dumps(rec, indent=1), flush=True)
+        # progressive merge-write so long compiles still leave a record
+        ordered = [results[c.name] for c in CASES if c.name in results]
+        with open(args.out, "w") as f:
+            json.dump(ordered, f, indent=1)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""Ring-attention engineering numbers (VERDICT r2 #6).
+
+Measures, on the virtual CPU mesh, for sep in {2, 4, 8}:
+- trace+compile time of a jitted ring_attention fwd+bwd program
+- HLO text size (proxy for program size)
+- per-step wall time (tiny shapes; CPU wall time is NOT a TPU perf claim,
+  it demonstrates sep-independence of the compiled program)
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 python tools/ring_bench.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def bench_sep(n, b=2, s=512, h=4, d=64, steps=20):
+    from paddle_tpu.distributed.cp import ring_attention
+
+    mesh = Mesh(np.array(jax.devices()[:n]).reshape(n), ("sep",))
+    r = np.random.default_rng(0)
+    q = jnp.asarray(r.standard_normal((b, s, h, d)).astype("float32"))
+    k = jnp.asarray(r.standard_normal((b, s, h, d)).astype("float32"))
+    v = jnp.asarray(r.standard_normal((b, s, h, d)).astype("float32"))
+    sh = NamedSharding(mesh, P(None, "sep"))
+    q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
+
+    def loss(q, k, v):
+        return ring_attention(q, k, v, causal=True, mesh=mesh).sum()
+
+    grad = jax.grad(loss, argnums=(0, 1, 2))
+    with mesh:
+        t0 = time.perf_counter()
+        jitted = jax.jit(grad)
+        lowered = jitted.lower(q, k, v)
+        hlo_chars = len(lowered.as_text())
+        compiled = lowered.compile()
+        compile_s = time.perf_counter() - t0
+        out = compiled(q, k, v)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = compiled(q, k, v)
+        jax.block_until_ready(out)
+        step_ms = 1000 * (time.perf_counter() - t0) / steps
+    return {"sep": n, "compile_s": round(compile_s, 2),
+            "hlo_chars": hlo_chars, "step_ms_cpu": round(step_ms, 2)}
+
+
+def main():
+    rows = [bench_sep(n) for n in (2, 4, 8)]
+    for row in rows:
+        print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()
