@@ -44,12 +44,23 @@ __all__ = ["convert_control_flow"]
 
 class _Undef:
     """paddle dy2static UndefinedVar analogue: placeholder for a name that
-    is not bound at the branch point."""
+    is not bound at the branch point.  USING it (rather than overwriting
+    it) raises — mirroring Python's UnboundLocalError, just later."""
 
     __slots__ = ()
 
     def __repr__(self):
         return "<undefined>"
+
+    def _raise(self, *a, **k):
+        raise UnboundLocalError(
+            "variable bound only inside an untaken branch was used "
+            "(SOT-converted control flow; see paddle_tpu.jit.to_static)")
+
+    __bool__ = __iter__ = __len__ = __getattr__ = __call__ = _raise
+    __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _raise
+    __truediv__ = __rtruediv__ = __getitem__ = __array__ = _raise
+    __float__ = __int__ = __index__ = _raise
 
 
 _SOT_UNDEF = _Undef()
@@ -465,7 +476,19 @@ def convert_control_flow(fn: Callable) -> Tuple[Callable, bool]:
                        mode="exec")
     except SyntaxError:
         return fn, False
-    ns = dict(target.__globals__)
+    # globals: fall back to the ORIGINAL module namespace on missing keys,
+    # so late-bound names (helpers defined after the decorator ran, the
+    # function's own name for recursion) resolve at call time exactly like
+    # the unconverted function — a plain dict snapshot would freeze them
+    class _FallbackNS(dict):
+        def __init__(self, base):
+            super().__init__()
+            self._base = base
+
+        def __missing__(self, key):
+            return self._base[key]
+
+    ns = _FallbackNS(target.__globals__)
     # freevars: the re-compiled def has no closure cells; snapshot values
     if target.__closure__:
         for name, cell in zip(target.__code__.co_freevars,

@@ -30,8 +30,8 @@ def _reduce(out, reduction):
 
 def soft_margin_loss(input, label, reduction="mean", name=None):
     """Reference: paddle.nn.functional.soft_margin_loss —
-    log(1 + exp(-label * input))."""
-    out = jnp.log1p(jnp.exp(-label * input))
+    log(1 + exp(-label * input)), in the overflow-stable softplus form."""
+    out = jax.nn.softplus(-label * input)
     return _reduce(out, reduction)
 
 
@@ -147,6 +147,11 @@ def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
     ``logits``: (B, T, U+1, V) joint-network outputs; ``labels``: (B, U)
     int targets.  Log-domain forward DP over the (T, U) lattice via a
     wavefront scan — XLA-friendly (no data-dependent Python loops)."""
+    if fastemit_lambda:
+        raise NotImplementedError(
+            "rnnt_loss: fastemit_lambda > 0 (FastEmit regularization) is "
+            "not implemented — pass 0.0, or regularize emission latency "
+            "externally")
     b, t_max, u1, v = logits.shape
     u_max = u1 - 1
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)

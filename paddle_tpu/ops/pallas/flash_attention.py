@@ -361,11 +361,18 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
     group = h // hkv
     bq = _pick_block(sq, BWD_BLOCK_Q or block_q)
     bk = _pick_block(sk, BWD_BLOCK_K or block_k)
+    # VMEM auto-shrink — per dimension: an explicit PDTPU_FLASH_BWD_BLOCK_*
+    # override pins THAT dimension (the operator knows the real budget);
+    # the other still shrinks
+    lock_q, lock_k = bool(BWD_BLOCK_Q), bool(BWD_BLOCK_K)
     vmem_budget = int(15.5 * 2 ** 20)
-    while (_bwd_vmem_estimate(bq, bk, d, q.dtype.itemsize,
-                              BWD_MODE == "merged") > vmem_budget
-           and max(bq, bk) > 128):
-        if bq >= bk:
+    while _bwd_vmem_estimate(bq, bk, d, q.dtype.itemsize,
+                             BWD_MODE == "merged") > vmem_budget:
+        can_q = not lock_q and bq > 128
+        can_k = not lock_k and bk > 128
+        if not (can_q or can_k):
+            break
+        if can_q and (bq >= bk or not can_k):
             bq //= 2
         else:
             bk //= 2

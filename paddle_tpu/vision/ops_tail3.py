@@ -192,17 +192,15 @@ def box_coder(prior_box, prior_box_var, target_box,
                          jnp.log(th[None] / ph[:, None])], axis=-1)
         return out / jnp.reshape(jnp.stack([vx, vy, vw, vh], -1),
                                  (-1, 1, 4) if var.ndim > 1 else (1, 1, 4))
-    # decode_center_size: target [M, N, 4] deltas against priors
+    # decode_center_size: target [N(priors), M, 4] deltas against priors
     if tb.ndim == 2:
         tb = tb[:, None]
     if var.ndim == 1:
-        var = jnp.broadcast_to(var, (4,))
-        vx, vy, vw, vh = var
-        dx, dy, dw, dh = (tb[..., 0] * vx, tb[..., 1] * vy,
-                          tb[..., 2] * vw, tb[..., 3] * vh)
+        tb = tb * var                       # (4,) broadcasts over all dims
     else:
-        dx = tb[..., 0] * var[:, None, 0] if axis == 0 else tb[..., 0]
-        dy, dw, dh = tb[..., 1], tb[..., 2], tb[..., 3]
+        # per-prior variance: broadcast along the prior axis
+        tb = tb * var[:, None, :]
+    dx, dy, dw, dh = tb[..., 0], tb[..., 1], tb[..., 2], tb[..., 3]
     cx = dx * pw[:, None] + pcx[:, None]
     cy = dy * ph[:, None] + pcy[:, None]
     bw = jnp.exp(dw) * pw[:, None]
@@ -288,6 +286,9 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
     scores = probs.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
     keep = flat(conf) > conf_thresh
     scores = jnp.where(keep[..., None], scores, 0.0)
+    # reference kernel emits all-zero rows for suppressed anchors (ported
+    # consumers filter on boxes.sum(-1) != 0)
+    boxes = jnp.where(keep[..., None], boxes, 0.0)
     return boxes, scores
 
 
@@ -310,9 +311,10 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
         sc = s[order]
         bx = bboxes[order]
         iou = box_iou(bx, bx)
-        tri = jnp.tril(iou, k=-1)       # iou with HIGHER-scored boxes
-        max_iou = tri.max(axis=1)       # per box
-        comp = jnp.max(tri, axis=0)
+        tri = jnp.tril(iou, k=-1)       # tri[j, i] = iou with higher-scored i
+        # compensate term: each HIGHER box i's own max IoU with boxes above
+        # it (SOLOv2 eq. 4) — a row max, indexed by i in the decay
+        comp = tri.max(axis=1)
         if use_gaussian:
             decay = jnp.exp(-(tri ** 2 - comp[None, :] ** 2)
                             / gaussian_sigma).min(axis=1)

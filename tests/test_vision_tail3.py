@@ -1,0 +1,133 @@
+"""Round-3 vision ops tail — oracle tests (torch for roi/deform; analytic
+for the detection box ops)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu.vision.ops as VO
+
+
+class TestRoiPooling:
+    def test_roi_pool_analytic(self):
+        # 1x1x4x4 ramp, one roi covering the full map, 2x2 output
+        x = jnp.arange(16, dtype=jnp.float32).reshape(1, 1, 4, 4)
+        out = VO.roi_pool(x, jnp.asarray([[0., 0., 3., 3.]]), None, 2)
+        np.testing.assert_allclose(np.asarray(out)[0, 0],
+                                   [[5., 7.], [13., 15.]])
+
+    def test_psroi_pool_analytic(self):
+        # C = out_c * oh * ow = 1*2*2: each bin reads its own channel
+        x = jnp.stack([jnp.full((4, 4), float(i)) for i in range(4)])[None]
+        out = VO.psroi_pool(x, jnp.asarray([[0., 0., 4., 4.]]), None, 2)
+        np.testing.assert_allclose(np.asarray(out)[0, 0],
+                                   [[0., 1.], [2., 3.]])
+
+    def test_deform_conv_zero_offset_is_conv(self, rng):
+        import torch.nn.functional as tF
+        x = rng.standard_normal((2, 4, 10, 10)).astype("float32")
+        w = rng.standard_normal((6, 4, 3, 3)).astype("float32")
+        off = jnp.zeros((2, 2 * 9, 10, 10))
+        ours = VO.deform_conv2d(jnp.asarray(x), off, jnp.asarray(w),
+                                padding=1)
+        ref = tF.conv2d(torch.tensor(x), torch.tensor(w), padding=1)
+        np.testing.assert_allclose(np.asarray(ours), ref.numpy(),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_deform_conv_integer_offset_shifts(self, rng):
+        # offset (0, +1) on every tap == conv over x shifted left by 1
+        import torch.nn.functional as tF
+        x = rng.standard_normal((1, 2, 8, 8)).astype("float32")
+        w = rng.standard_normal((3, 2, 3, 3)).astype("float32")
+        off = np.zeros((1, 2 * 9, 8, 8), np.float32)
+        off[:, 1::2] = 1.0   # x-offsets (reference layout: y, x per tap)
+        ours = np.asarray(VO.deform_conv2d(jnp.asarray(x),
+                                           jnp.asarray(off),
+                                           jnp.asarray(w), padding=1))
+        xs = np.zeros_like(x)
+        xs[..., :-1] = x[..., 1:]
+        ref = tF.conv2d(torch.tensor(xs), torch.tensor(w),
+                        padding=1).numpy()
+        # interior only (border taps sample the zero pad differently)
+        np.testing.assert_allclose(ours[..., 1:-1, 1:-2],
+                                   ref[..., 1:-1, 1:-2], rtol=1e-3,
+                                   atol=1e-4)
+
+
+class TestBoxOps:
+    def test_box_coder_encode_decode_roundtrip(self, rng):
+        priors = jnp.asarray([[0., 0., 10., 10.], [5., 5., 20., 25.]])
+        var = [0.1, 0.1, 0.2, 0.2]
+        targets = jnp.asarray([[1., 2., 8., 9.], [6., 4., 18., 28.]])
+        enc = VO.box_coder(priors, var, targets, "encode_center_size")
+        # decode the diagonal (prior i with its own code) back
+        deltas = jnp.stack([enc[0, 0], enc[1, 1]])
+        dec = VO.box_coder(priors, var, deltas, "decode_center_size")
+        rec = jnp.stack([dec[0, 0], dec[1, 0]])
+        np.testing.assert_allclose(np.asarray(rec), np.asarray(targets),
+                                   atol=1e-4)
+
+    def test_box_coder_per_prior_variance_decode(self):
+        priors = jnp.asarray([[0., 0., 10., 10.]])
+        pvar = jnp.asarray([[0.1, 0.2, 0.3, 0.4]])
+        deltas = jnp.asarray([[1.0, 1.0, 0.5, 0.5]])
+        dec = np.asarray(VO.box_coder(priors, pvar, deltas,
+                                      "decode_center_size"))[0, 0]
+        # cx = 0.1*1*10 + 5; cy = 0.2*1*10 + 5; w = exp(0.3*0.5)*10 ...
+        w = np.exp(0.15) * 10
+        h = np.exp(0.2) * 10
+        np.testing.assert_allclose(
+            dec, [6 - w / 2, 7 - h / 2, 6 + w / 2, 7 + h / 2], rtol=1e-5)
+
+    def test_matrix_nms_decay_ordering(self):
+        # three same-class boxes: A (score .9), B overlaps A heavily
+        # (score .8), C overlaps B but not A (score .7).  B must decay
+        # hard; C's decay is compensated by B's own suppression.
+        boxes = jnp.asarray([[0., 0., 10., 10.],
+                             [0., 0., 10., 9.],      # iou(A,B) ~ .9
+                             [0., 8., 10., 18.]])    # overlaps B a bit
+        scores = jnp.asarray([[0.9, 0.8, 0.7]])
+        out, idx = VO.matrix_nms(boxes, scores, score_threshold=0.0,
+                                 nms_top_k=3, keep_top_k=3)
+        out = np.asarray(out)
+        by_idx = {int(i): float(s) for i, s in zip(np.asarray(idx),
+                                                   out[:, 1])}
+        assert by_idx[0] == pytest.approx(0.9)        # top box undecayed
+        assert by_idx[1] < 0.15                       # heavy overlap decays
+        # C only mildly overlaps B (iou ~ .05 with B, 0.09 with A):
+        # stays close to its raw score
+        assert by_idx[2] > 0.5
+
+    def test_yolo_box_shapes_and_zeroing(self, rng):
+        x = jnp.asarray(rng.standard_normal((2, 3 * 85, 5, 5))
+                        .astype("float32"))
+        boxes, scores = VO.yolo_box(x, jnp.asarray([[320, 320], [416, 416]]),
+                                    [10, 13, 16, 30, 33, 23], 80,
+                                    conf_thresh=0.5)
+        assert boxes.shape == (2, 75, 4) and scores.shape == (2, 75, 80)
+        b = np.asarray(boxes)
+        s = np.asarray(scores)
+        dead = s.sum(-1) == 0
+        assert (np.abs(b[dead]).sum() == 0)  # suppressed rows are zero
+
+    def test_prior_box_counts(self):
+        pb, var = VO.prior_box(jnp.zeros((1, 1, 4, 4)),
+                               jnp.zeros((1, 3, 32, 32)),
+                               min_sizes=[8.0], max_sizes=[16.0],
+                               aspect_ratios=[2.0], flip=True, clip=True)
+        # 1 min + 1 sqrt(min*max) + 2 ar boxes = 4 per cell
+        assert pb.shape == (4, 4, 4, 4) and var.shape == pb.shape
+        assert float(pb.min()) >= 0.0 and float(pb.max()) <= 1.0
+
+    def test_distribute_fpn_proposals(self):
+        rois = jnp.asarray([[0., 0., 32., 32.], [0., 0., 224., 224.],
+                            [0., 0., 64., 64.]])
+        outs, masks, restore = VO.distribute_fpn_proposals(
+            rois, 2, 5, 4, 224)
+        lvls = np.asarray([np.asarray(m) for m in masks])
+        assert lvls.sum() == 3                       # each roi routed once
+        assert np.asarray(masks[4 - 2])[1]           # refer-scale -> level 4
+        assert np.asarray(masks[0])[0]               # small roi -> level 2
+        assert len(np.asarray(restore)) == 3

@@ -26,14 +26,17 @@ import numpy as np
 
 
 def bench_generate(preset="llama-350m", batch=1, prefill=128,
-                   n_lo=16, n_hi=144, repeats=3):
+                   n_lo=16, n_hi=528, repeats=4):
+    """n_hi - n_lo = 512 decode steps: the relay's ~0.1 s stalls must be
+    small against the measured delta or the slope is noise."""
     import paddle_tpu as pt
     from paddle_tpu.models.llama import llama
 
     pt.seed(0)
     model = llama(preset, max_position_embeddings=prefill + n_hi + 8,
                   dtype="bfloat16")
-    model.eval()
+    model.astype("bfloat16")   # cfg.dtype sets cache dtype only; decode is
+    model.eval()               # bandwidth-bound, params must be bf16 too
     ids = jax.random.randint(jax.random.key(1), (batch, prefill), 0,
                              model.cfg.vocab_size)
 
@@ -55,6 +58,11 @@ def bench_generate(preset="llama-350m", batch=1, prefill=128,
         return best
 
     t_lo, t_hi = timed(n_lo), timed(n_hi)
+    for _ in range(3):
+        if t_hi > t_lo:
+            break
+        # a relay stall poisoned a window (negative slope): re-measure
+        t_lo, t_hi = min(t_lo, timed(n_lo)), min(t_hi, timed(n_hi))
     per_tok = (t_hi - t_lo) / (n_hi - n_lo)
     return {"metric": "decode_tokens_per_sec", "preset": preset,
             "batch": batch, "prefill": prefill,

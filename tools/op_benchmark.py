@@ -72,13 +72,28 @@ def suite():
         "masked_decode_attn": (jax.jit(
             lambda qd, kc, lens: IF.masked_multihead_attention(
                 qd, kc, kc, lens)[0]), (qd, kc, lens)),
+        # paged (block-pool) decode — the serving path's kernel
+        # (docs/BENCH.md "Decode throughput" has the e2e numbers).  The
+        # CPU fallback is a materializing gather — far off the Pallas
+        # path's cost — so it gets a reduced iteration count
+        "paged_decode_attn": (jax.jit(
+            lambda qd, kp, bt, lens: IF.paged_attention(
+                qd, kp, kp, bt, lens)),
+            (qd, kc.reshape(8 * 16, 64, 8, 64),
+             jnp.arange(8 * 16, dtype=jnp.int32).reshape(8, 16), lens),
+            {"iters": 100 if jax.default_backend() == "tpu" else 3}),
         "rms_norm": (jax.jit(lambda a: a * jax.lax.rsqrt(
             jnp.mean(a.astype(jnp.float32) ** 2, -1, keepdims=True) + 1e-6
         ).astype(a.dtype)), (x,)),
         "softmax_ce": (jax.jit(lambda a: -jax.nn.log_softmax(
             a.astype(jnp.float32))[..., 0].mean()), (x,)),
     }
-    return {name: _time(f, *args) for name, (f, args) in ops.items()}
+    out = {}
+    for name, spec in ops.items():
+        f, args = spec[0], spec[1]
+        kw = spec[2] if len(spec) > 2 else {}
+        out[name] = _time(f, *args, **kw)
+    return out
 
 
 def main():
