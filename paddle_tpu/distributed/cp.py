@@ -132,13 +132,74 @@ def _ring_inner(q, k, v, *, axis, n, causal, scale):
     return out.reshape(b, c, h, d).astype(q.dtype)
 
 
-def ring_attention(q, k, v, causal=False, scale=None, axis="sep", mesh=None):
+def _ring_inner_flash(q, k, v, *, axis, n, causal, scale):
+    """Ring step with the Pallas flash kernel per visiting chunk.
+
+    Each chunk pair is one of three STATIC cases — fully visible
+    (src < rank), diagonal (src == rank, ordinary causal), fully masked
+    (src > rank) — selected by ``lax.switch`` at runtime, so the kernel
+    never needs a traced causal offset.  Chunks merge by the kernel's
+    log2-sum-exp2 statistic (``flash_attention_with_lse``; its custom VJP
+    carries the lse cotangent, so autodiff through the merge is exact)."""
+    from ..ops.pallas import flash_attention as fa
+    b, c, h, d = q.shape
+    rank = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def full_chunk(kv):
+        return fa.flash_attention_with_lse(q, *kv, causal=False,
+                                           scale=scale)
+
+    def diag_chunk(kv):
+        return fa.flash_attention_with_lse(q, *kv, causal=True,
+                                           scale=scale)
+
+    def skip_chunk(kv):
+        return (jnp.zeros((b, c, h, d), q.dtype),
+                jnp.full((b, h, c), NEG_INF, jnp.float32))
+
+    def body(carry, t):
+        out_acc, lse_acc, k_t, v_t = carry
+        src = (rank - t) % n
+        if causal:
+            branch = jnp.where(src < rank, 0, jnp.where(src == rank, 1, 2))
+            out_c, lse_c = jax.lax.switch(
+                branch, [full_chunk, diag_chunk, skip_chunk], (k_t, v_t))
+        else:
+            out_c, lse_c = full_chunk((k_t, v_t))
+        # two-way merge of normalized pieces in the base-2 domain
+        m = jnp.maximum(lse_acc, lse_c)
+        wa = jnp.exp2(lse_acc - m)
+        wc = jnp.exp2(lse_c - m)
+        denom = wa + wc
+        lse_new = m + jnp.log2(denom)
+        na = (wa / denom).transpose(0, 2, 1)[..., None]   # (b, c, h, 1)
+        nc = (wc / denom).transpose(0, 2, 1)[..., None]
+        out_new = (out_acc.astype(jnp.float32) * na
+                   + out_c.astype(jnp.float32) * nc)
+        k_t = jax.lax.ppermute(k_t, axis, perm)
+        v_t = jax.lax.ppermute(v_t, axis, perm)
+        return (out_new, lse_new, k_t, v_t), None
+
+    out0 = jnp.zeros((b, c, h, d), jnp.float32)
+    lse0 = jnp.full((b, h, c), NEG_INF, jnp.float32)
+    (out, _, _, _), _ = jax.lax.scan(
+        jax.checkpoint(body), (out0, lse0, k, v), jnp.arange(n))
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, causal=False, scale=None, axis="sep", mesh=None,
+                   use_flash=None):
     """Ring flash attention over the sep axis.
 
     Takes GLOBAL-shaped ``[b, s, h, d]`` arrays inside jit (sequence is
     sharded over ``axis`` by the shard_map below); outside any mesh, or when
     the sep degree is 1, falls back to serial attention.  GQA supported
     (kv heads may divide q heads).
+
+    ``use_flash=None`` (auto) routes the per-chunk compute to the Pallas
+    flash kernel on TPU when the chunk shapes qualify; the einsum
+    online-softmax path remains the fallback (and the CPU test oracle).
     """
     mesh = mesh if mesh is not None else _mesh()
     n = _sep_size(mesh, axis)
@@ -148,9 +209,20 @@ def ring_attention(q, k, v, causal=False, scale=None, axis="sep", mesh=None):
         return _serial_attention(q, k, v, causal, scale)
     if q.shape[1] % n:
         raise ValueError(f"sequence {q.shape[1]} not divisible by sep={n}")
+    if use_flash is None:
+        from ..ops import dispatch as _dispatch
+        from ..ops.pallas import flash_attention as _fa
+        q_chunk = jax.ShapeDtypeStruct(
+            (q.shape[0], q.shape[1] // n) + q.shape[2:], q.dtype)
+        kv_chunk = jax.ShapeDtypeStruct(
+            (k.shape[0], k.shape[1] // n) + k.shape[2:], k.dtype)
+        use_flash = (_dispatch.get("flash_attention") is not None
+                     and _fa.supported(q_chunk, kv_chunk, kv_chunk,
+                                       causal=False))
+    inner = _ring_inner_flash if use_flash else _ring_inner
     spec = P(None, axis, None, None)
     fn = shard_map(
-        functools.partial(_ring_inner, axis=axis, n=n, causal=causal,
+        functools.partial(inner, axis=axis, n=n, causal=causal,
                           scale=float(scale)),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         axis_names=frozenset({axis}), check_vma=False)

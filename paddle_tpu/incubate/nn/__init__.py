@@ -136,3 +136,131 @@ class FusedMultiTransformer(Layer):
             x = residual + blk.out_proj(attn)
             x = x + self._ffn(blk.ln_ffn(x), blk)
         return x, (new_caches if caches is not None else None)
+
+
+class FusedLinear(Layer):
+    """Reference: paddle.incubate.nn.FusedLinear (fused matmul+bias).
+
+    On TPU XLA fuses the bias add into the matmul epilogue unaided; the
+    class exists for API parity with ported inference code."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        from ...nn import initializer as I
+        self.transpose_weight = transpose_weight
+        shape = ((out_features, in_features) if transpose_weight
+                 else (in_features, out_features))
+        self.weight = self.create_parameter(
+            shape, attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (out_features,), attr=bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0)))
+
+    def forward(self, x):
+        w = self.weight.T if self.transpose_weight else self.weight
+        y = x @ w
+        return y if self.bias is None else y + self.bias
+
+
+class FusedMultiHeadAttention(Layer):
+    """Reference: paddle.incubate.nn.FusedMultiHeadAttention — pre/post-LN
+    self-attention block with residual (fused_attention kernel)."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.0,
+                 attn_dropout_rate=0.0, normalize_before=False,
+                 need_weights=False, weight_attr=None, bias_attr=None,
+                 epsilon=1e-5, name=None):
+        super().__init__()
+        from ...nn.layers_common import LayerNorm, Linear
+        if need_weights:
+            raise ValueError(
+                "FusedMultiHeadAttention does not materialize attention "
+                "weights (reference asserts need_weights=False too)")
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.qkv_proj = Linear(embed_dim, 3 * embed_dim,
+                               weight_attr=weight_attr, bias_attr=bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim,
+                               weight_attr=weight_attr, bias_attr=bias_attr)
+        self.norm = LayerNorm(embed_dim, epsilon=epsilon)
+
+    def forward(self, x, attn_mask=None):
+        b, s, e = x.shape
+        h = x
+        if self.normalize_before:
+            h = self.norm(h)
+        qkv = self.qkv_proj(h).reshape(b, s, 3, self.num_heads,
+                                       self.head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.attn_dropout_rate, training=self.training)
+        out = self.out_proj(attn.reshape(b, s, e))
+        out = F.dropout(out, p=self.dropout_rate, training=self.training)
+        out = x + out
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedFeedForward(Layer):
+    """Reference: paddle.incubate.nn.FusedFeedForward — pre/post-LN MLP
+    block with residual (fused_feedforward kernel)."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 name=None):
+        super().__init__()
+        from ...nn.layers_common import LayerNorm, Linear
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                                 else act_dropout_rate)
+        self.activation = activation
+        self.fc1 = Linear(d_model, dim_feedforward,
+                          weight_attr=weight_attr, bias_attr=bias_attr)
+        self.fc2 = Linear(dim_feedforward, d_model,
+                          weight_attr=weight_attr, bias_attr=bias_attr)
+        self.norm = LayerNorm(d_model, epsilon=epsilon)
+
+    def forward(self, x):
+        h = x
+        if self.normalize_before:
+            h = self.norm(h)
+        h = getattr(F, self.activation)(self.fc1(h))
+        h = F.dropout(h, p=self.act_dropout_rate, training=self.training)
+        h = F.dropout(self.fc2(h), p=self.dropout_rate,
+                      training=self.training)
+        out = x + h
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """Reference: paddle.incubate.nn.FusedTransformerEncoderLayer —
+    FusedMultiHeadAttention + FusedFeedForward."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=(dropout_rate if attn_dropout_rate is None
+                               else attn_dropout_rate),
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None):
+        return self.ffn(self.fused_attn(src, src_mask))

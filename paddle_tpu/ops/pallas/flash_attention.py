@@ -355,7 +355,8 @@ def _bwd_vmem_estimate(bq, bk, d, itemsize, merged):
     return operands + tiles + scratch
 
 
-def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
+def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k,
+               dlse=None):
     b, sq, h, d = q.shape
     sk, hkv = k.shape[1], k.shape[2]
     group = h // hkv
@@ -384,6 +385,10 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
     # delta = rowsum(dO * O), fp32 (cheap XLA op)
     delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32),
                     axis=-1)                         # (b, h, sq)
+    if dlse is not None:
+        # lse cotangent: ∂L/∂z_j += dlse·p_j·log2(e) — folds into the
+        # kernels' p∘(dp − delta) form as delta' = delta − dlse·log2(e)
+        delta = delta - dlse.astype(jnp.float32) * LOG2E
     lse4 = lse[..., None]                            # (b, h, sq, 1)
     delta4 = delta[..., None]
 
@@ -528,6 +533,47 @@ def _flash_attention_bwd(scale, causal, block_q, block_k, res, g):
 
 
 _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention_lse(q, k, v, scale, causal, block_q, block_k):
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+
+
+def _flash_attention_lse_fwd(q, k, v, scale, causal, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_attention_lse_bwd(scale, causal, block_q, block_k, res, g):
+    q, k, v, out, lse = res
+    do, dlse = g
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, do, scale, causal,
+                            block_q, block_k, dlse=dlse)
+    return dq, dk, dv
+
+
+_flash_attention_lse.defvjp(_flash_attention_lse_fwd,
+                            _flash_attention_lse_bwd)
+
+
+def flash_attention_with_lse(q, k, v, causal=False, scale=None,
+                             block_q=DEFAULT_BLOCK_Q,
+                             block_k=DEFAULT_BLOCK_K):
+    """Like :func:`flash_attention` but also returns the per-row
+    log2-sum-exp2 statistic ``lse`` (b, h, sq) — the merge currency of
+    ring/context-parallel attention.  Differentiable in BOTH outputs: the
+    lse cotangent folds into the backward kernels' delta term
+    (delta' = delta − dlse·log2(e), from ∂lse2/∂z = p/ln 2)."""
+    if causal and q.shape[1] > k.shape[1]:
+        raise ValueError(
+            f"causal flash attention requires sq <= sk, got sq={q.shape[1]} "
+            f"sk={k.shape[1]}: rows with no visible key have undefined "
+            "attention (use the XLA fallback)")
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash_attention_lse(q, k, v, float(scale), bool(causal),
+                                int(block_q), int(block_k))
 
 
 def flash_attention(q, k, v, causal=False, scale=None,

@@ -130,3 +130,73 @@ def test_llama_with_context_parallel_matches_serial(impl):
     loss_p = jax.jit(lambda b: causal_lm_loss(par, b))(batch)
     np.testing.assert_allclose(float(loss_p), float(loss_s),
                                atol=3e-5, rtol=3e-5)
+
+
+class TestFlashRing:
+    """Pallas-chunk ring (VERDICT r2 #6 stage B): per-chunk compute via
+    flash_attention_with_lse + base-2 lse merge, exercised through the
+    Pallas interpreter on the CPU mesh."""
+
+    @pytest.fixture(autouse=True)
+    def interpret_mode(self, monkeypatch):
+        import functools as ft
+        from jax.experimental import pallas as pl
+        real = pl.pallas_call
+        monkeypatch.setattr(pl, "pallas_call",
+                            ft.partial(real, interpret=True))
+
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("hkv", [4, 2])
+    def test_flash_ring_matches_serial_fwd_bwd(self, rng, causal, hkv):
+        from jax.sharding import Mesh
+        q = jnp.asarray(rng.standard_normal((2, 256, 4, 32))
+                        .astype("float32"))
+        k = jnp.asarray(rng.standard_normal((2, 256, hkv, 32))
+                        .astype("float32"))
+        v = jnp.asarray(rng.standard_normal((2, 256, hkv, 32))
+                        .astype("float32"))
+        mesh = Mesh(np.array(jax.devices()[:4]), ("sep",))
+        scale = 1.0 / np.sqrt(32)
+        ref = cp._serial_attention(q, k, v, causal, scale)
+        out = cp.ring_attention(q, k, v, causal=causal, mesh=mesh,
+                                use_flash=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-2)
+
+        gf = jax.grad(lambda *a: (cp.ring_attention(
+            *a, causal=causal, mesh=mesh, use_flash=True) ** 2).sum(),
+            (0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda *a: (cp._serial_attention(
+            *a, causal, scale) ** 2).sum(), (0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            scale_b = max(float(jnp.max(jnp.abs(b))), 1.0)
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-2 * scale_b)
+
+
+def test_flash_lse_cotangent_matches_reference(rng, monkeypatch):
+    """flash_attention_with_lse: the lse output is differentiable (the
+    cotangent folds into delta' = delta - dlse*log2e)."""
+    import functools as ft
+    from jax.experimental import pallas as pl
+    import paddle_tpu.ops.pallas.flash_attention as fa
+    monkeypatch.setattr(pl, "pallas_call",
+                        ft.partial(pl.pallas_call, interpret=True))
+    q = jnp.asarray(rng.standard_normal((1, 64, 2, 16)).astype("float32"))
+
+    def ref(q):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, q) / np.sqrt(16)
+        mask = jnp.tril(jnp.ones((64, 64), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+        lse2 = jax.scipy.special.logsumexp(s, -1) * np.log2(np.e)
+        out = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), q)
+        return (out ** 2).sum() + (jnp.sin(lse2) * 3.0).sum()
+
+    def ours(q):
+        out, lse = fa.flash_attention_with_lse(q, q, q, causal=True,
+                                               block_q=32, block_k=32)
+        return (out ** 2).sum() + (jnp.sin(lse) * 3.0).sum()
+
+    np.testing.assert_allclose(np.asarray(jax.grad(ours)(q)),
+                               np.asarray(jax.grad(ref)(q)),
+                               atol=1e-4)

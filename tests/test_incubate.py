@@ -317,3 +317,63 @@ def test_model_average_window_roll():
     params, state = ma.apply(g, state, params)
     avg = ma.average_params(state, params)
     assert float(avg["w"]) == (-1 - 2 - 3 - 4) / 4.0
+
+
+class TestFusedBlocks:
+    """Round-3 incubate tail: FusedLinear / FusedMultiHeadAttention /
+    FusedFeedForward / FusedTransformerEncoderLayer (reference:
+    python/paddle/incubate/nn/layer/fused_transformer.py)."""
+
+    def test_fused_linear_matches_linear(self, rng):
+        import paddle_tpu as pt
+        from paddle_tpu.incubate import nn as inn
+        x = jnp.asarray(rng.standard_normal((3, 5)).astype("float32"))
+        pt.seed(3)
+        fl = inn.FusedLinear(5, 7)
+        ref = x @ fl.weight + fl.bias
+        np.testing.assert_allclose(np.asarray(fl(x)), np.asarray(ref),
+                                   rtol=1e-6)
+        ft = inn.FusedLinear(5, 7, transpose_weight=True)
+        assert ft.weight.shape == (7, 5)
+        assert ft(x).shape == (3, 7)
+
+    def test_fused_encoder_layer_matches_manual_reference(self, rng):
+        """Post-LN fused encoder layer == the same math spelled out with
+        the layer's own weights (dropout off): qkv slice, sdpa, residual,
+        norm, FFN, residual, norm."""
+        import paddle_tpu as pt
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.incubate import nn as inn
+        pt.seed(0)
+        fused = inn.FusedTransformerEncoderLayer(16, 4, 32,
+                                                 dropout_rate=0.0)
+        fused.eval()
+        x = jnp.asarray(rng.standard_normal((2, 6, 16)).astype("float32"))
+        out = fused(x)
+
+        attn = fused.fused_attn
+        qkv = attn.qkv_proj(x).reshape(2, 6, 3, 4, 4)
+        ref = F.scaled_dot_product_attention(qkv[:, :, 0], qkv[:, :, 1],
+                                             qkv[:, :, 2])
+        h = attn.norm(x + attn.out_proj(ref.reshape(2, 6, 16)))
+        ffn = fused.ffn
+        ref_out = ffn.norm(h + ffn.fc2(jnp.maximum(ffn.fc1(h), 0.0)))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_fused_mha_rejects_need_weights(self):
+        from paddle_tpu.incubate import nn as inn
+        with pytest.raises(ValueError):
+            inn.FusedMultiHeadAttention(16, 4, need_weights=True)
+
+    def test_fused_ffn_prenorm_residual(self, rng):
+        from paddle_tpu.incubate import nn as inn
+        import paddle_tpu as pt
+        pt.seed(1)
+        ffn = inn.FusedFeedForward(8, 16, dropout_rate=0.0,
+                                   normalize_before=True)
+        ffn.eval()
+        x = jnp.asarray(rng.standard_normal((2, 3, 8)).astype("float32"))
+        ref = x + ffn.fc2(jnp.maximum(ffn.fc1(ffn.norm(x)), 0.0))
+        np.testing.assert_allclose(np.asarray(ffn(x)), np.asarray(ref),
+                                   rtol=1e-5)

@@ -512,3 +512,29 @@ class TestStaticRound2:
         assert isinstance(pt.CPUPlace(), pt.CPUPlace)
         t2 = pt.tensor([3.0], place=pt.CUDAPlace(0))
         assert pt.is_tensor(t2)
+
+
+class TestVisionModelsTail3:
+    """Round-3 model zoo tail (reference:
+    python/paddle/vision/models/{mobilenetv3,inceptionv3,lenet}.py)."""
+
+    _check = TestVisionZoo.__dict__["_check"]
+
+    def test_mobilenet_v3(self):
+        from paddle_tpu.vision.models import (mobilenet_v3_large,
+                                              mobilenet_v3_small)
+        pt.seed(0)
+        self._check(mobilenet_v3_small(scale=0.5, num_classes=10))
+        self._check(mobilenet_v3_large(scale=0.35, num_classes=10))
+
+    def test_inception_v3(self):
+        from paddle_tpu.vision.models import inception_v3
+        pt.seed(0)
+        self._check(inception_v3(num_classes=10), in_shape=(1, 3, 96, 96))
+
+    def test_lenet_factory(self):
+        import jax.numpy as jnp
+        from paddle_tpu.vision.models import lenet
+        pt.seed(0)
+        m = lenet(num_classes=10)
+        assert m(jnp.ones((2, 1, 28, 28))).shape == (2, 10)

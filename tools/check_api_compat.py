@@ -30,6 +30,7 @@ NAMESPACES = [
     "paddle_tpu.vision.models", "paddle_tpu.vision.transforms",
     "paddle_tpu.audio",
     "paddle_tpu.sparse", "paddle_tpu.quantization", "paddle_tpu.incubate",
+    "paddle_tpu.incubate.nn",
     "paddle_tpu.inference", "paddle_tpu.static", "paddle_tpu.profiler",
     "paddle_tpu.utils",
 ]
