@@ -1151,3 +1151,17 @@ from .functional_tail3 import (soft_margin_loss, multi_margin_loss,  # noqa: F40
                                lp_pool1d, lp_pool2d, max_unpool1d,
                                max_unpool3d, fractional_max_pool2d,
                                fractional_max_pool3d)
+
+
+# static-graph interop: F.* also record onto static.Var placeholders
+import sys as _sys  # noqa: E402
+
+from ..static import enable_var_dispatch as _evd  # noqa: E402
+
+_this = _sys.modules[__name__]
+# only wrap callables that BELONG to this surface (defined in
+# nn.functional* or re-exported from jax.nn) — dir() alone would also
+# grab imported helpers like convert_dtype or typing.Optional
+_evd(_this, [n for n in dir(_this)
+             if getattr(getattr(_this, n, None), "__module__",
+                        "").startswith(("paddle_tpu.nn", "jax"))])

@@ -8,11 +8,13 @@ CompiledProgram) over the C++ ProgramDesc/InterpreterCore stack (SURVEY
 InterpreterCore/stream-scheduling machinery is exactly what XLA replaces
 (SURVEY §7.3).
 
-Deviation (documented): ops on placeholders must go through ``Var``
-operators/methods or ``static.apply(fn, ...)`` — the dynamic ``paddle_tpu.ops``
-functions operate on real arrays, so a Var cannot be passed to them
-directly. ``@paddle_tpu.jit.to_static`` remains the primary graph-capture
-path, as in the reference's 3.0 dynamic-first design.
+Since round 3, the dynamic ``paddle_tpu.ops`` / ``nn.functional``
+callables ALSO accept ``Var`` placeholders directly (``enable_var_dispatch``
+wraps them at import: a call with Var arguments records a graph node
+instead of executing) — reference static-graph code can call ``paddle.*``
+ops unchanged, like the reference's own in-graph dispatch.
+``@paddle_tpu.jit.to_static`` remains the primary graph-capture path, as
+in the reference's 3.0 dynamic-first design.
 """
 
 from __future__ import annotations
@@ -93,12 +95,10 @@ class Var:
 
 
 def apply(fn: Callable, *args, **kwargs) -> Var:
-    """Apply any jnp-compatible function to Vars/constants symbolically."""
-    prog = None
-    for a in list(args) + list(kwargs.values()):
-        if isinstance(a, Var):
-            prog = a.program
-            break
+    """Apply any jnp-compatible function to Vars/constants symbolically.
+    Shares the Var discovery (one nesting level of lists/tuples) with the
+    ``enable_var_dispatch`` wrapping below."""
+    prog = _find_program(args) or _find_program(tuple(kwargs.values()))
     if prog is None:
         raise ValueError("apply() needs at least one Var argument")
     return Var(prog, op=(fn, args, kwargs))
@@ -272,6 +272,76 @@ def in_dynamic_mode() -> bool:
 
 # -- static autodiff (reference: paddle.static.gradients / append_backward
 # over the Program; here jax.grad of the recorded Var DAG) ------------------
+
+def _find_program(items) -> Optional["Program"]:
+    for a in items:
+        if isinstance(a, Var):
+            return a.program
+        if isinstance(a, (list, tuple)):
+            for b in a:
+                if isinstance(b, Var):
+                    return b.program
+    return None
+
+
+def _wrap_for_vars(fn):
+    """Static-graph interception: calling a dynamic op with Var arguments
+    records a graph node instead of executing — the same ``paddle.*``
+    function works in both modes, like the reference's in-graph op
+    dispatch (python/paddle/base/framework.py in_dygraph_mode branches)."""
+    import functools as _functools
+
+    @_functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        prog = _find_program(args) or _find_program(tuple(kwargs.values()))
+        if prog is None:
+            return fn(*args, **kwargs)
+        return Var(prog, op=(fn, args, kwargs))
+
+    wrapper._var_dispatch = True
+    return wrapper
+
+
+def _wrappable(f) -> bool:
+    import types as _types
+    return (callable(f) and not isinstance(f, type)
+            and not isinstance(f, _types.ModuleType)
+            # typing constructs (Optional, Union, ...) are callable but
+            # must never be rebound to functions
+            and getattr(f, "__module__", "") != "typing"
+            and not getattr(f, "_var_dispatch", False))
+
+
+def enable_var_dispatch(module, names=None) -> int:
+    """Wrap a module's public functions so they accept static ``Var``s
+    (lazily recorded) as well as real arrays.  Returns the wrap count.
+    Wraps plain functions, jnp ufunc objects, jax custom_jvp/custom_vjp
+    callables and partials — everything except classes and modules."""
+    count = 0
+    for n in (names if names is not None
+              else getattr(module, "__all__", None) or dir(module)):
+        if n.startswith("_"):
+            continue
+        f = getattr(module, n, None)
+        if _wrappable(f):
+            setattr(module, n, _wrap_for_vars(f))
+            count += 1
+    return count
+
+
+def enable_var_dispatch_class(cls) -> int:
+    """Same, for staticmethod-namespace classes (``paddle_tpu.linalg`` /
+    ``paddle_tpu.fft``)."""
+    count = 0
+    for n in list(vars(cls)):
+        if n.startswith("_"):
+            continue
+        f = getattr(cls, n, None)
+        if _wrappable(f):
+            setattr(cls, n, staticmethod(_wrap_for_vars(f)))
+            count += 1
+    return count
+
 
 def _eval_var(node, env):
     """THE evaluator over the recorded op DAG — used by Program._eval,

@@ -40,3 +40,15 @@ def test_train_moe_ep():
 def test_train_ps_ctr():
     out = _run("train_ps_ctr.py", "--steps", "30")
     assert "loss=" in out
+
+
+def test_train_long_context_ring():
+    out = _run("train_long_context.py", "--steps", "4", "--seq", "128",
+               "--sep", "4", "--dp", "2")
+    assert "loss=" in out and "sep=4" in out
+
+
+def test_train_long_context_ulysses():
+    out = _run("train_long_context.py", "--steps", "4", "--seq", "128",
+               "--sep", "2", "--dp", "2", "--impl", "ulysses")
+    assert "loss=" in out
