@@ -88,39 +88,115 @@ def swiglu(x, y=None):
 # decode attention (KV cache)
 # ---------------------------------------------------------------------------
 
+def quantize_kv(x):
+    """THE int8 KV quantizer (symmetric, per-(…, head) over the last dim):
+    returns (int8 values, f32 scales).  Shared by the decode write below,
+    the model families' prefill writes, and the tests — one formula to
+    change."""
+    xf = x.astype(jnp.float32)
+    s = jnp.max(jnp.abs(xf), axis=-1) / 127.0 + 1e-12
+    return jnp.round(xf / s[..., None]).astype(jnp.int8), s
+
+
+def prefill_write_cache(cache, k, v):
+    """Write a prefill chunk at positions [0, s) into a dense cache tuple
+    — 2-tuple fp or 4-tuple int8-quantized (see make_dense_caches)."""
+    upd = jax.lax.dynamic_update_slice_in_dim
+    if len(cache) == 4:
+        kc, vc, ks, vs = cache
+        k_q, ks_new = quantize_kv(k)
+        v_q, vs_new = quantize_kv(v)
+        return (upd(kc, k_q, 0, axis=1), upd(vc, v_q, 0, axis=1),
+                upd(ks, ks_new, 0, axis=1), upd(vs, vs_new, 0, axis=1))
+    kc, vc = cache
+    return (upd(kc, k.astype(kc.dtype), 0, axis=1),
+            upd(vc, v.astype(vc.dtype), 0, axis=1))
+
+
 def masked_multihead_attention(q, k_cache, v_cache, seq_lens,
-                               new_k=None, new_v=None, scale=None):
+                               new_k=None, new_v=None, scale=None,
+                               k_scale=None, v_scale=None,
+                               uniform_lens=False):
     """Single-step decode attention against a dense KV cache.
 
     Reference: MaskedMultiheadAttentionKernel
-    (paddle/phi/kernels/fusion/gpu/, SURVEY §2.1 fused kernels row).
+    (paddle/phi/kernels/fusion/gpu/, SURVEY §2.1 fused kernels row; the
+    reference kernel also carries the int8 cache_kv_quant path).
 
     q:        (B, H, D)        — the new token's query
     k_cache:  (B, S_max, H_kv, D) — updated IN-PLACE-style: returns new cache
     seq_lens: (B,)             — current lengths (position of the new token)
     new_k/new_v: (B, H_kv, D)  — this step's k/v, written at seq_lens
+    k_scale/v_scale: (B, S_max, H_kv) f32 — present iff the caches are
+    int8-quantized (per-position, per-head symmetric scales).  Decode is
+    HBM-bandwidth-bound, so int8 caches halve the dominant traffic; the
+    dequant multiply fuses into the einsum operand load.
 
-    Returns (out (B, H, D), k_cache, v_cache).
+    Returns (out, k_cache, v_cache) — plus the updated scales when
+    quantized: (out, k_cache, v_cache, k_scale, v_scale).
     """
     b, h, d = q.shape
     s_max = k_cache.shape[1]
     h_kv = k_cache.shape[2]
+    quantized = k_scale is not None
     if new_k is not None:
-        onehot = jax.nn.one_hot(seq_lens, s_max,
-                                dtype=k_cache.dtype)[:, :, None, None]
-        # cast to the cache dtype: mixing dtypes here would silently promote
-        # the whole cache (and break scan carries that hold it)
-        k_cache = k_cache * (1 - onehot) \
-            + onehot * new_k.astype(k_cache.dtype)[:, None]
-        v_cache = v_cache * (1 - onehot) \
-            + onehot * new_v.astype(v_cache.dtype)[:, None]
+        # One-token cache write.  Measured on-chip (v5e, bs8 decode,
+        # docs/BENCH.md): the "where" full-cache rewrite STREAMS at HBM
+        # bandwidth and beats both indexed alternatives —
+        # dynamic_update_slice at a traced start (4.0/7.6 ms bf16/int8 per
+        # step: the traced index defeats in-place aliasing inside the scan,
+        # so XLA copies the cache) and per-row scatter (3.5/5.7 ms) vs
+        # where at 3.0/1.4-2.7 ms.  PDTPU_MMA_WRITE=where|slice|scatter
+        # keeps the experiment reproducible.
+        if quantized:
+            k_q, ks_new = quantize_kv(new_k)
+            v_q, vs_new = quantize_kv(new_v)
+            writes = [("k", k_q), ("v", v_q),
+                      ("ks", ks_new), ("vs", vs_new)]
+        else:
+            # cast to the cache dtype: mixing dtypes here would silently
+            # promote the whole cache (and break scan carries holding it)
+            writes = [("k", new_k.astype(k_cache.dtype)),
+                      ("v", new_v.astype(v_cache.dtype))]
+        import os as _os
+        strategy = _os.environ.get("PDTPU_MMA_WRITE", "where")
+        if strategy not in ("where", "slice", "scatter"):
+            raise ValueError(
+                f"PDTPU_MMA_WRITE={strategy!r}: expected "
+                "where|slice|scatter")
+        if strategy == "slice" and not uniform_lens:
+            raise ValueError(
+                "PDTPU_MMA_WRITE=slice writes ONE slab at seq_lens[0]; it "
+                "requires uniform_lens=True (every row's length advancing "
+                "in lockstep) — ragged lens would be silently corrupted")
+        caches = {"k": k_cache, "v": v_cache, "ks": k_scale, "vs": v_scale}
+        for name, val in writes:
+            if strategy == "slice":
+                caches[name] = jax.lax.dynamic_update_slice_in_dim(
+                    caches[name], val[:, None], seq_lens[0], axis=1)
+            elif strategy == "where":
+                onemask = (jnp.arange(s_max)[None, :] ==
+                           seq_lens[:, None])
+                shaped = onemask[(...,) + (None,) * (val.ndim - 1)]
+                caches[name] = jnp.where(shaped, val[:, None], caches[name])
+            else:
+                caches[name] = caches[name].at[
+                    jnp.arange(q.shape[0]), seq_lens].set(val, mode="drop")
+        k_cache, v_cache = caches["k"], caches["v"]
+        k_scale, v_scale = caches["ks"], caches["vs"]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     g = h // h_kv
+    if quantized:
+        k_read = k_cache.astype(jnp.bfloat16) * \
+            k_scale.astype(jnp.bfloat16)[..., None]
+        v_read = v_cache.astype(jnp.float32) * v_scale[..., None]
+    else:
+        k_read, v_read = k_cache, v_cache
     # GQA without materializing repeated KV: group the q heads per kv head
     # and contract against the kv head axis directly (4x less HBM traffic
     # at 4-way GQA); accumulate in fp32 on the MXU
     qg = q.reshape(b, h_kv, g, d)
-    scores = jnp.einsum("bkgd,bskd->bkgs", qg, k_cache,
+    scores = jnp.einsum("bkgd,bskd->bkgs", qg, k_read,
                         preferred_element_type=jnp.float32) * scale
     mask = jnp.arange(s_max)[None, None, None, :] <= \
         seq_lens[:, None, None, None]
@@ -128,9 +204,12 @@ def masked_multihead_attention(q, k_cache, v_cache, seq_lens,
     probs = jax.nn.softmax(scores, axis=-1)
     # probs stay fp32 through the PV contraction (decode is bandwidth-bound;
     # bf16-rounding the probabilities would cost accuracy for nothing)
-    out = jnp.einsum("bkgs,bskd->bkgd", probs, v_cache,
+    out = jnp.einsum("bkgs,bskd->bkgd", probs, v_read,
                      preferred_element_type=jnp.float32)
-    return out.reshape(b, h, d).astype(q.dtype), k_cache, v_cache
+    out = out.reshape(b, h, d).astype(q.dtype)
+    if quantized:
+        return out, k_cache, v_cache, k_scale, v_scale
+    return out, k_cache, v_cache
 
 
 def paged_attention(q, k_cache, v_cache, block_tables, context_lens,

@@ -18,10 +18,35 @@ import jax
 import jax.numpy as jnp
 
 
+def _is_int8(dtype) -> bool:
+    """Normalize every spelling of int8 — "int8", "paddle.int8", np.int8,
+    jnp.int8 — so none silently allocates raw UNSCALED int8 caches."""
+    if dtype is None:
+        return False
+    if str(dtype) in ("int8", "paddle.int8"):
+        return True
+    try:
+        import numpy as _np
+        return _np.dtype(dtype) == _np.int8
+    except TypeError:
+        return False
+
+
 def make_dense_caches(n_layers, batch, max_len, kv_heads, head_dim, dtype):
-    """Per-layer dense (k, v) cache pairs (shared by the model families)."""
-    dtype = jnp.dtype(dtype)
+    """Per-layer dense (k, v) cache pairs (shared by the model families).
+
+    ``dtype="int8"`` allocates QUANTIZED caches: 4-tuples
+    ``(k_int8, v_int8, k_scale, v_scale)`` with per-(position, head)
+    f32 scales — decode is HBM-bandwidth-bound (docs/BENCH.md "Decode
+    throughput"), so halving the cache bytes is the lever that matters."""
     shape = (batch, max_len, kv_heads, head_dim)
+    if _is_int8(dtype):
+        sshape = (batch, max_len, kv_heads)
+        return [(jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+                 jnp.ones(sshape, jnp.float32),
+                 jnp.ones(sshape, jnp.float32))
+                for _ in range(n_layers)]
+    dtype = jnp.dtype(dtype)
     return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
             for _ in range(n_layers)]
 
@@ -263,7 +288,8 @@ class CachedGenerationMixin:
 
     def _beam_search(self, input_ids, max_new_tokens, num_beams, total,
                      temperature=0.0, repetition_penalty=1.0,
-                     eos_token_id=None, pad_token_id=None):
+                     eos_token_id=None, pad_token_id=None,
+                     kv_cache_dtype=None):
         from ..nn.layer import raw_params
         b, prompt_len = input_ids.shape
         nb = num_beams
@@ -272,7 +298,7 @@ class CachedGenerationMixin:
         # prefill ONCE at batch b (the dominant FLOP cost for long
         # prompts), then repeat the caches across beams — the rows are
         # byte-identical, so nb separate prefills would be pure waste
-        caches = self.model.init_cache(b, total)
+        caches = self.model.init_cache(b, total, dtype=kv_cache_dtype)
         logits, caches = prefill(params, input_ids, caches)
         caches = jax.tree.map(lambda c: jnp.repeat(c, nb, axis=0), caches)
         logits = jnp.repeat(logits, nb, axis=0)          # (b·nb, V)
@@ -316,7 +342,8 @@ class CachedGenerationMixin:
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
                  use_cache=True, max_len=None, top_k=0, top_p=1.0,
                  repetition_penalty=1.0, decode_strategy=None,
-                 num_beams=1, eos_token_id=None, pad_token_id=None):
+                 num_beams=1, eos_token_id=None, pad_token_id=None,
+                 kv_cache_dtype=None):
         """Autoregressive generation. ``use_cache=True`` (default) prefills
         the dense KV caches once, then runs the WHOLE decode loop as one
         compiled ``lax.scan`` (one dispatch per call). ``use_cache=False``
@@ -332,6 +359,13 @@ class CachedGenerationMixin:
         mode: "greedy_search" forces temperature 0, "sampling" requires
         temperature > 0; "beam_search" (or num_beams > 1) runs the
         compiled beam decoder.
+
+        ``kv_cache_dtype="int8"`` quantizes the KV caches (per-position,
+        per-head symmetric scales) — decode is HBM-bandwidth-bound, so
+        this speeds up cache-dominated operating points (large
+        batch·context; docs/BENCH.md "int8 KV cache") at a small accuracy
+        cost.  It requires the cached path (errors on recompute
+        fallback).
 
         ``eos_token_id``: a row that emits it keeps emitting
         ``pad_token_id`` (default: the eos id) for the remaining steps —
@@ -375,7 +409,8 @@ class CachedGenerationMixin:
                 return input_ids
             return self._beam_search(input_ids, max_new_tokens, num_beams,
                                      total, temperature, repetition_penalty,
-                                     eos_token_id, pad_token_id)
+                                     eos_token_id, pad_token_id,
+                                     kv_cache_dtype=kv_cache_dtype)
         if decode_strategy == "greedy_search":
             temperature = 0.0
         elif decode_strategy == "sampling" and temperature <= 0:
@@ -386,6 +421,13 @@ class CachedGenerationMixin:
         track_seen = repetition_penalty != 1.0 and vocab is not None
         pad_id = pad_token_id if pad_token_id is not None else eos_token_id
         if not (use_cache and self._cache_supported()):
+            if kv_cache_dtype is not None:
+                # silent full-precision recompute would let the caller
+                # believe they validated a quantized cache
+                raise ValueError(
+                    "kv_cache_dtype set but this call uses the recompute "
+                    "path (use_cache=False or no cache support) — there "
+                    "is no cache to quantize")
             ids = input_ids
             # counts built once from the prompt, then updated per token
             # (rebuilding the (b, vocab) matrix per step would be
@@ -410,7 +452,7 @@ class CachedGenerationMixin:
         b = input_ids.shape[0]       # total/prompt_len validated above
         params = raw_params(self)
         prefill = self._prefill_fn()
-        caches = self.model.init_cache(b, total)
+        caches = self.model.init_cache(b, total, dtype=kv_cache_dtype)
         logits, caches = prefill(params, input_ids, caches)
         seen = _seen_counts(input_ids, vocab) if track_seen else None
         tok = self._sample(logits, temperature, top_k, top_p,

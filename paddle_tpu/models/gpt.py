@@ -112,24 +112,31 @@ class GPTAttention(Layer):
         k = constrain(k, ("dp", "sharding"), None, "mp", None)
         v = constrain(v, ("dp", "sharding"), None, "mp", None)
         if cache is not None and s == 1 and seq_lens is not None:
-            # single-token decode against the dense KV cache
+            # single-token decode against the dense (or int8-quantized
+            # 4-tuple) KV cache
             from ..incubate.nn.functional import masked_multihead_attention
-            kc, vc = cache
-            out, kc, vc = masked_multihead_attention(
-                q[:, 0], kc, vc, seq_lens, k[:, 0], v[:, 0])
+            if len(cache) == 4:
+                kc, vc, ks, vs = cache
+                out, kc, vc, ks, vs = masked_multihead_attention(
+                    q[:, 0], kc, vc, seq_lens, k[:, 0], v[:, 0],
+                    k_scale=ks, v_scale=vs, uniform_lens=True)
+                new_cache = (kc, vc, ks, vs)
+            else:
+                kc, vc = cache
+                out, kc, vc = masked_multihead_attention(
+                    q[:, 0], kc, vc, seq_lens, k[:, 0], v[:, 0],
+                    uniform_lens=True)  # generate(): lens move in lockstep
+                new_cache = (kc, vc)
             out = out[:, None].reshape(b, s, cfg.hidden_size)
-            return self.dropout(self.out_proj(out)), (kc, vc)
+            return self.dropout(self.out_proj(out)), new_cache
         if cache is not None:
-            kc, vc = cache
-            kc = jax.lax.dynamic_update_slice_in_dim(
-                kc, k.astype(kc.dtype), 0, axis=1)
-            vc = jax.lax.dynamic_update_slice_in_dim(
-                vc, v.astype(vc.dtype), 0, axis=1)
+            from ..incubate.nn.functional import prefill_write_cache
+            new_cache = prefill_write_cache(cache, k, v)
             out = F.scaled_dot_product_attention(
                 q, k, v, is_causal=True,
                 dropout_p=cfg.attention_dropout, training=self.training)
             out = out.reshape(b, s, cfg.hidden_size)
-            return self.dropout(self.out_proj(out)), (kc, vc)
+            return self.dropout(self.out_proj(out)), new_cache
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None,
             dropout_p=cfg.attention_dropout, training=self.training)

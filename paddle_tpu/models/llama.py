@@ -159,26 +159,35 @@ class LlamaAttention(Layer):
         v = constrain(v, ("dp", "sharding"), None, "mp", None)
         q, k = F.apply_rotary_pos_emb(q, k, cos, sin)
         if cache is not None and s == 1 and seq_lens is not None:
-            # single-token decode against the dense KV cache
+            # single-token decode against the dense KV cache (2-tuple) or
+            # the int8-quantized cache (4-tuple with per-position scales)
             from ..incubate.nn.functional import masked_multihead_attention
-            kc, vc = cache
-            out, kc, vc = masked_multihead_attention(
-                q[:, 0], kc, vc, seq_lens, k[:, 0], v[:, 0])
+            if len(cache) == 4:
+                kc, vc, ks, vs = cache
+                out, kc, vc, ks, vs = masked_multihead_attention(
+                    q[:, 0], kc, vc, seq_lens, k[:, 0], v[:, 0],
+                    k_scale=ks, v_scale=vs, uniform_lens=True)
+                new_cache = (kc, vc, ks, vs)
+            else:
+                kc, vc = cache
+                # generate()'s decode loop advances every row's length in
+                # lockstep -> the fast single-slab cache write applies
+                out, kc, vc = masked_multihead_attention(
+                    q[:, 0], kc, vc, seq_lens, k[:, 0], v[:, 0],
+                    uniform_lens=True)
+                new_cache = (kc, vc)
             out = out[:, None].reshape(b, s,
                                        cfg.num_attention_heads * cfg.head_dim)
-            return self.o_proj(out), (kc, vc)
+            return self.o_proj(out), new_cache
         if cache is not None:
             # single-shot prefill: causal attention over the prompt, cache
             # written at [0, s) (chunked prefill lives in incubate's
             # FusedMultiTransformer; generate() prefills in one chunk)
-            kc, vc = cache
-            kc = jax.lax.dynamic_update_slice_in_dim(
-                kc, k.astype(kc.dtype), 0, axis=1)
-            vc = jax.lax.dynamic_update_slice_in_dim(
-                vc, v.astype(vc.dtype), 0, axis=1)
+            from ..incubate.nn.functional import prefill_write_cache
+            new_cache = prefill_write_cache(cache, k, v)
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
             out = out.reshape(b, s, cfg.num_attention_heads * cfg.head_dim)
-            return self.o_proj(out), (kc, vc)
+            return self.o_proj(out), new_cache
         if cfg.context_parallel and attn_mask is None:
             from ..distributed import cp
             q = cp.split_sequence(q)

@@ -1,0 +1,124 @@
+"""int8-quantized KV cache (reference: the masked-MHA kernel's
+cache_kv_quant path; SURVEY §2.1 fused kernels / L10 serving).
+
+Decode is HBM-bandwidth-bound (docs/BENCH.md), so int8 caches halve the
+dominant traffic.  Contract: per-(position, head) symmetric scales;
+quantized decode tracks the f32-cache decode closely."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.incubate.nn.functional import masked_multihead_attention
+from paddle_tpu.models.generation import make_dense_caches
+from paddle_tpu.models.llama import llama
+
+
+class TestQuantizedMMA:
+    def test_matches_fp_attention(self, rng):
+        b, s_max, h, d = 2, 32, 4, 16
+        kc = jnp.asarray(rng.standard_normal((b, s_max, h, d))
+                         .astype("float32"))
+        vc = jnp.asarray(rng.standard_normal((b, s_max, h, d))
+                         .astype("float32"))
+        q = jnp.asarray(rng.standard_normal((b, h, d)).astype("float32"))
+        lens = jnp.asarray([20, 11], jnp.int32)
+
+        ref, _, _ = masked_multihead_attention(q, kc, vc, lens)
+
+        # quantize the same cache contents (the shared quantizer)
+        from paddle_tpu.incubate.nn.functional import quantize_kv
+        kq, ks = quantize_kv(kc)
+        vq, vs = quantize_kv(vc)
+        out, _, _, _, _ = masked_multihead_attention(
+            q, kq, vq, lens, k_scale=ks, v_scale=vs)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=0.05)
+
+    def test_write_path_roundtrip(self, rng):
+        b, s_max, h, d = 2, 8, 2, 16
+        (kc, vc, ks, vs) = make_dense_caches(1, b, s_max, h, d, "int8")[0]
+        new_k = jnp.asarray(rng.standard_normal((b, h, d))
+                            .astype("float32"))
+        new_v = jnp.asarray(rng.standard_normal((b, h, d))
+                            .astype("float32"))
+        lens = jnp.asarray([3, 5], jnp.int32)
+        q = jnp.asarray(rng.standard_normal((b, h, d)).astype("float32"))
+        out, kc, vc, ks, vs = masked_multihead_attention(
+            q, kc, vc, lens, new_k, new_v, k_scale=ks, v_scale=vs)
+        # the written slot dequantizes back to new_k within int8 precision
+        got = np.asarray(kc)[0, 3].astype(np.float32) * \
+            np.asarray(ks)[0, 3][:, None]
+        np.testing.assert_allclose(got, np.asarray(new_k)[0], atol=0.02)
+        assert kc.dtype == jnp.int8 and vs.dtype == jnp.float32
+
+
+class TestGenerateInt8:
+    def test_greedy_generation_tracks_fp_cache(self):
+        pt.seed(0)
+        model = llama("tiny", max_position_embeddings=96)
+        model.eval()
+        ids = jax.random.randint(jax.random.key(0), (2, 16), 0,
+                                 model.cfg.vocab_size)
+        fp = model.generate(ids, max_new_tokens=24)
+        q8 = model.generate(ids, max_new_tokens=24,
+                            kv_cache_dtype="int8")
+        assert fp.shape == q8.shape
+        agree = float(np.mean(np.asarray(fp[:, 16:]) ==
+                              np.asarray(q8[:, 16:])))
+        # int8 cache noise may flip a near-tie late in the rollout, but
+        # the sequences must track closely on a tiny model
+        assert agree >= 0.75, agree
+
+    def test_gpt_int8_generation(self):
+        from paddle_tpu.models.gpt import GPTConfig, gpt
+        pt.seed(0)
+        m = gpt(GPTConfig(vocab_size=128, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=2,
+                          max_position_embeddings=64))
+        m.eval()
+        ids = jax.random.randint(jax.random.key(2), (2, 8), 0, 128)
+        fp = m.generate(ids, max_new_tokens=12)
+        q8 = m.generate(ids, max_new_tokens=12, kv_cache_dtype="int8")
+        assert fp.shape == q8.shape
+        agree = float(np.mean(np.asarray(fp[:, 8:]) ==
+                              np.asarray(q8[:, 8:])))
+        assert agree >= 0.7, agree
+
+    def test_dtype_spelling_normalized(self):
+        from paddle_tpu.models.generation import make_dense_caches
+        for spelled in ("int8", jnp.int8, np.int8):
+            caches = make_dense_caches(1, 1, 4, 2, 8, spelled)
+            assert len(caches[0]) == 4, spelled
+
+    def test_recompute_fallback_rejects_int8(self):
+        pt.seed(0)
+        model = llama("tiny", max_position_embeddings=64)
+        ids = jnp.zeros((1, 4), jnp.int32)
+        with pytest.raises(ValueError):
+            model.generate(ids, max_new_tokens=2, use_cache=False,
+                           kv_cache_dtype="int8")
+
+    def test_int8_cache_structure(self):
+        pt.seed(0)
+        model = llama("tiny", max_position_embeddings=64)
+        caches = model.model.init_cache(2, 64, dtype="int8")
+        assert len(caches[0]) == 4
+        k, v, ks, vs = caches[0]
+        assert k.dtype == jnp.int8 and ks.shape == k.shape[:3]
+
+    def test_prefill_quantization_consistency(self, rng):
+        """Prefill-written int8 rows must dequantize to the true K/V so
+        later decode steps attend to a faithful prompt."""
+        pt.seed(0)
+        model = llama("tiny", max_position_embeddings=48)
+        model.eval()
+        ids = jax.random.randint(jax.random.key(1), (1, 12), 0,
+                                 model.cfg.vocab_size)
+        caches = model.model.init_cache(1, 48, dtype="int8")
+        _, caches = model.model(ids, caches=caches)
+        k, v, ks, vs = caches[0]
+        assert bool((jnp.abs(ks[0, :12]) > 1e-9).all())   # scales written
+        assert int(jnp.sum(jnp.abs(k[0, 12:]).astype(jnp.int32))) == 0

@@ -26,7 +26,7 @@ import numpy as np
 
 
 def bench_generate(preset="llama-350m", batch=1, prefill=128,
-                   n_lo=16, n_hi=528, repeats=4):
+                   n_lo=16, n_hi=528, repeats=4, kv_cache_dtype=None):
     """n_hi - n_lo = 512 decode steps: the relay's ~0.1 s stalls must be
     small against the measured delta or the slope is noise."""
     import paddle_tpu as pt
@@ -41,7 +41,8 @@ def bench_generate(preset="llama-350m", batch=1, prefill=128,
                              model.cfg.vocab_size)
 
     def run(n):
-        out = model.generate(ids, max_new_tokens=n)
+        out = model.generate(ids, max_new_tokens=n,
+                             kv_cache_dtype=kv_cache_dtype)
         jax.block_until_ready(out)
         return out
 
@@ -65,6 +66,7 @@ def bench_generate(preset="llama-350m", batch=1, prefill=128,
         t_lo, t_hi = min(t_lo, timed(n_lo)), min(t_hi, timed(n_hi))
     per_tok = (t_hi - t_lo) / (n_hi - n_lo)
     return {"metric": "decode_tokens_per_sec", "preset": preset,
+            "kv": str(kv_cache_dtype or "bf16"),
             "batch": batch, "prefill": prefill,
             "ms_per_token": round(1000 * per_tok, 3),
             "tokens_per_sec": round(batch / per_tok, 1),
@@ -124,6 +126,10 @@ def bench_decode_attention(batch=8, heads=16, head_dim=64, ctx=1024,
 def main():
     for batch in (1, 8):
         print(json.dumps(bench_generate(batch=batch)), flush=True)
+    # int8 KV cache: halves the dominant decode traffic (docs/BENCH.md)
+    for batch in (1, 8):
+        print(json.dumps(bench_generate(batch=batch,
+                                        kv_cache_dtype="int8")), flush=True)
     print(json.dumps(bench_decode_attention()), flush=True)
 
 
