@@ -113,6 +113,21 @@ def prefill_write_cache(cache, k, v):
             upd(vc, v.astype(vc.dtype), 0, axis=1))
 
 
+def decode_attend_cache(cache, q, new_k, new_v, seq_lens):
+    """One decode step against a dense cache tuple — 2-tuple fp or
+    4-tuple int8-quantized.  The single cache-arity dispatch shared by
+    the model families.  Returns (out, new_cache)."""
+    if len(cache) == 4:
+        kc, vc, ks, vs = cache
+        out, kc, vc, ks, vs = masked_multihead_attention(
+            q, kc, vc, seq_lens, new_k, new_v, k_scale=ks, v_scale=vs)
+        return out, (kc, vc, ks, vs)
+    kc, vc = cache
+    out, kc, vc = masked_multihead_attention(q, kc, vc, seq_lens,
+                                             new_k, new_v)
+    return out, (kc, vc)
+
+
 def masked_multihead_attention(q, k_cache, v_cache, seq_lens,
                                new_k=None, new_v=None, scale=None,
                                k_scale=None, v_scale=None,
@@ -164,11 +179,20 @@ def masked_multihead_attention(q, k_cache, v_cache, seq_lens,
             raise ValueError(
                 f"PDTPU_MMA_WRITE={strategy!r}: expected "
                 "where|slice|scatter")
-        if strategy == "slice" and not uniform_lens:
-            raise ValueError(
-                "PDTPU_MMA_WRITE=slice writes ONE slab at seq_lens[0]; it "
-                "requires uniform_lens=True (every row's length advancing "
-                "in lockstep) — ragged lens would be silently corrupted")
+        # slice writes ONE slab at seq_lens[0]: only valid when every
+        # row's length advances in lockstep.  Callers that KNOW this pass
+        # uniform_lens=True; PDTPU_MMA_UNIFORM=1 is the operator's
+        # equivalent assertion for the generate() A/B (the model families
+        # cannot see whether their caller is the lockstep decode loop).
+        if strategy == "slice":
+            uniform_lens = (uniform_lens or
+                            _os.environ.get("PDTPU_MMA_UNIFORM") == "1")
+            if not uniform_lens:
+                raise ValueError(
+                    "PDTPU_MMA_WRITE=slice requires lockstep lens: pass "
+                    "uniform_lens=True (op callers) or set "
+                    "PDTPU_MMA_UNIFORM=1 (generate() benchmarking) — "
+                    "ragged lens would be silently corrupted")
         caches = {"k": k_cache, "v": v_cache, "ks": k_scale, "vs": v_scale}
         for name, val in writes:
             if strategy == "slice":

@@ -7,7 +7,9 @@ Used by the Llama and GPT families. Design (verified on-chip, M25):
 - configs without cache support (pipeline stages, MoE layers) fall back to
   full-prefix recompute, which is also the greedy-decoding oracle.
 
-Host model contract: ``self.model.init_cache(b, total)``; cached forward
+Host model contract: ``self.model.init_cache(b, total, dtype=None)``
+(``dtype="int8"`` must yield quantized 4-tuple caches or raise); cached
+forward
 ``self.model(ids, caches=..., seq_lens=...) -> (hidden, caches)``;
 ``self.logits(hidden)``; ``self._cache_supported()``.
 """

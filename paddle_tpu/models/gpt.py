@@ -113,20 +113,10 @@ class GPTAttention(Layer):
         v = constrain(v, ("dp", "sharding"), None, "mp", None)
         if cache is not None and s == 1 and seq_lens is not None:
             # single-token decode against the dense (or int8-quantized
-            # 4-tuple) KV cache
-            from ..incubate.nn.functional import masked_multihead_attention
-            if len(cache) == 4:
-                kc, vc, ks, vs = cache
-                out, kc, vc, ks, vs = masked_multihead_attention(
-                    q[:, 0], kc, vc, seq_lens, k[:, 0], v[:, 0],
-                    k_scale=ks, v_scale=vs, uniform_lens=True)
-                new_cache = (kc, vc, ks, vs)
-            else:
-                kc, vc = cache
-                out, kc, vc = masked_multihead_attention(
-                    q[:, 0], kc, vc, seq_lens, k[:, 0], v[:, 0],
-                    uniform_lens=True)  # generate(): lens move in lockstep
-                new_cache = (kc, vc)
+            # 4-tuple) KV cache — shared cache-arity dispatch
+            from ..incubate.nn.functional import decode_attend_cache
+            out, new_cache = decode_attend_cache(
+                cache, q[:, 0], k[:, 0], v[:, 0], seq_lens)
             out = out[:, None].reshape(b, s, cfg.hidden_size)
             return self.dropout(self.out_proj(out)), new_cache
         if cache is not None:

@@ -159,23 +159,11 @@ class LlamaAttention(Layer):
         v = constrain(v, ("dp", "sharding"), None, "mp", None)
         q, k = F.apply_rotary_pos_emb(q, k, cos, sin)
         if cache is not None and s == 1 and seq_lens is not None:
-            # single-token decode against the dense KV cache (2-tuple) or
-            # the int8-quantized cache (4-tuple with per-position scales)
-            from ..incubate.nn.functional import masked_multihead_attention
-            if len(cache) == 4:
-                kc, vc, ks, vs = cache
-                out, kc, vc, ks, vs = masked_multihead_attention(
-                    q[:, 0], kc, vc, seq_lens, k[:, 0], v[:, 0],
-                    k_scale=ks, v_scale=vs, uniform_lens=True)
-                new_cache = (kc, vc, ks, vs)
-            else:
-                kc, vc = cache
-                # generate()'s decode loop advances every row's length in
-                # lockstep -> the fast single-slab cache write applies
-                out, kc, vc = masked_multihead_attention(
-                    q[:, 0], kc, vc, seq_lens, k[:, 0], v[:, 0],
-                    uniform_lens=True)
-                new_cache = (kc, vc)
+            # single-token decode against the dense KV cache (2-tuple fp
+            # or int8-quantized 4-tuple) — shared cache-arity dispatch
+            from ..incubate.nn.functional import decode_attend_cache
+            out, new_cache = decode_attend_cache(
+                cache, q[:, 0], k[:, 0], v[:, 0], seq_lens)
             out = out[:, None].reshape(b, s,
                                        cfg.num_attention_heads * cfg.head_dim)
             return self.o_proj(out), new_cache

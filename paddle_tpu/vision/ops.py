@@ -16,7 +16,12 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["box_iou", "nms", "roi_align"]
+__all__ = ["box_iou", "nms", "roi_align",
+           # round-3 tail (ops_tail3.py)
+           "roi_pool", "psroi_pool", "deform_conv2d", "box_coder",
+           "prior_box", "yolo_box", "yolo_loss", "matrix_nms",
+           "distribute_fpn_proposals",
+           "RoIPool", "PSRoIPool", "RoIAlign", "DeformConv2D"]
 
 
 def box_iou(boxes1, boxes2):

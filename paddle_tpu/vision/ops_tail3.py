@@ -16,7 +16,7 @@ from ..nn.layer import Layer
 
 __all__ = ["roi_pool", "psroi_pool", "deform_conv2d", "box_coder",
            "prior_box", "yolo_box", "matrix_nms",
-           "distribute_fpn_proposals",
+           "distribute_fpn_proposals", "yolo_loss",
            "RoIPool", "PSRoIPool", "RoIAlign", "DeformConv2D"]
 
 
@@ -413,3 +413,123 @@ class DeformConv2D(Layer):
         return deform_conv2d(x, offset, self.weight, self.bias,
                              self.stride, self.padding, self.dilation,
                              self.deformable_groups, self.groups, mask)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0, name=None):
+    """Reference: paddle.vision.ops.yolo_loss (YOLOv3 head loss).
+
+    x: (N, C, H, W) raw head output, C = len(anchor_mask)*(5+class_num);
+    gt_box: (N, B, 4) normalized center-format (cx, cy, w, h) in [0, 1];
+    gt_label: (N, B) int class ids; rows with w*h == 0 are padding.
+
+    Faithful to the YOLOv3 recipe the reference implements: BCE for
+    x/y/objectness/class, squared error for w/h targets in log-anchor
+    space, (2 - w*h) box-size weighting, responsible anchor chosen by
+    wh-IoU over ALL anchors, negatives with best pred-IoU > ignore_thresh
+    dropped from the objectness loss.  Returns (N,) per-image loss."""
+    n, c, h, w = x.shape
+    na = len(anchor_mask)
+    an_all = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    an = an_all[jnp.asarray(anchor_mask)]
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    px, py = x[:, :, 0], x[:, :, 1]
+    pw, ph = x[:, :, 2], x[:, :, 3]
+    pobj = x[:, :, 4]
+    pcls = x[:, :, 5:]
+
+    gt_box = jnp.asarray(gt_box, jnp.float32)
+    b = gt_box.shape[1]
+    valid = (gt_box[:, :, 2] * gt_box[:, :, 3]) > 0           # (N, B)
+    if gt_score is None:
+        gt_score = jnp.ones((n, b), jnp.float32)
+
+    # responsible anchor per gt: best wh-IoU over ALL anchors (then kept
+    # only if it belongs to this head's anchor_mask)
+    gw = gt_box[:, :, 2] * w * downsample_ratio               # pixels
+    gh = gt_box[:, :, 3] * h * downsample_ratio
+    inter = (jnp.minimum(gw[:, :, None], an_all[None, None, :, 0])
+             * jnp.minimum(gh[:, :, None], an_all[None, None, :, 1]))
+    union = (gw * gh)[:, :, None] + \
+        (an_all[:, 0] * an_all[:, 1])[None, None] - inter
+    best_anchor = jnp.argmax(inter / (union + 1e-9), axis=-1)  # (N, B)
+    mask_arr = jnp.asarray(anchor_mask)
+    local_a = jnp.argmax(best_anchor[:, :, None] == mask_arr[None, None],
+                         axis=-1)                              # (N, B)
+    owned = (best_anchor[:, :, None] == mask_arr[None, None]).any(-1)
+    valid = valid & owned
+
+    gi = jnp.clip((gt_box[:, :, 0] * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gt_box[:, :, 1] * h).astype(jnp.int32), 0, h - 1)
+    tx = gt_box[:, :, 0] * w - gi                              # in (0,1)
+    ty = gt_box[:, :, 1] * h - gj
+    tw = jnp.log(jnp.maximum(gw, 1e-9)
+                 / jnp.maximum(an[local_a][:, :, 0], 1e-9))
+    th = jnp.log(jnp.maximum(gh, 1e-9)
+                 / jnp.maximum(an[local_a][:, :, 1], 1e-9))
+    box_w = 2.0 - gt_box[:, :, 2] * gt_box[:, :, 3]            # size weight
+
+    rows = jnp.arange(n)[:, None]
+
+    def bce(logit, target):
+        return jax.nn.softplus(logit) - logit * target
+
+    p_at = lambda t: t[rows, local_a, gj, gi]                  # (N, B)
+    vw = jnp.where(valid, gt_score * box_w, 0.0)
+    loss_xy = vw * (bce(p_at(px), tx) + bce(p_at(py), ty))
+    # w/h: L1 (the reference yolov3_loss op uses abs, not squared error)
+    loss_wh = vw * (jnp.abs(p_at(pw) - tw) + jnp.abs(p_at(ph) - th))
+
+    # class loss at the responsible cells; reference label smoothing:
+    # positive target 1 - 1/C, negative target 1/C
+    onehot = jax.nn.one_hot(jnp.asarray(gt_label, jnp.int32), class_num)
+    if use_label_smooth and class_num > 1:
+        delta = 1.0 / class_num
+        onehot = onehot * (1.0 - delta) + (1 - onehot) * delta
+    pc = pcls[rows, local_a, :, gj, gi]                        # (N, B, C)
+    loss_cls = jnp.where(valid, gt_score, 0.0) * \
+        (jax.nn.softplus(pc) - pc * onehot).sum(-1)
+
+    # objectness: positives at responsible cells; negatives everywhere
+    # else EXCEPT cells whose best-gt IoU exceeds ignore_thresh
+    obj_t = jnp.zeros((n, na, h, w))
+    obj_t = obj_t.at[rows, local_a, gj, gi].max(
+        jnp.where(valid, gt_score, 0.0))
+    pos = obj_t > 0
+    # predicted boxes (decoded) vs gt IoU for the ignore mask
+    cgx = (jnp.arange(w)[None, None, None, :]
+           + jax.nn.sigmoid(px) * scale_x_y - (scale_x_y - 1) / 2) / w
+    cgy = (jnp.arange(h)[None, None, :, None]
+           + jax.nn.sigmoid(py) * scale_x_y - (scale_x_y - 1) / 2) / h
+    bw_ = jnp.exp(pw) * an[None, :, 0, None, None] / (w * downsample_ratio)
+    bh_ = jnp.exp(ph) * an[None, :, 1, None, None] / (h * downsample_ratio)
+
+    def iou_with_gt(cx, cy, bw, bh):
+        # (N, A, H, W) boxes vs (N, B) gts -> best IoU (N, A, H, W)
+        px1, py1 = cx - bw / 2, cy - bh / 2
+        px2, py2 = cx + bw / 2, cy + bh / 2
+        gx1 = (gt_box[:, :, 0] - gt_box[:, :, 2] / 2)
+        gy1 = (gt_box[:, :, 1] - gt_box[:, :, 3] / 2)
+        gx2 = (gt_box[:, :, 0] + gt_box[:, :, 2] / 2)
+        gy2 = (gt_box[:, :, 1] + gt_box[:, :, 3] / 2)
+        sh4 = (n, 1, 1, 1, b)
+        ix = jnp.maximum(
+            0.0, jnp.minimum(px2[..., None], gx2.reshape(sh4))
+            - jnp.maximum(px1[..., None], gx1.reshape(sh4)))
+        iy = jnp.maximum(
+            0.0, jnp.minimum(py2[..., None], gy2.reshape(sh4))
+            - jnp.maximum(py1[..., None], gy1.reshape(sh4)))
+        inter = ix * iy
+        area_p = (bw * bh)[..., None]
+        area_g = (gt_box[:, :, 2] * gt_box[:, :, 3]).reshape(sh4)
+        iou = inter / (area_p + area_g - inter + 1e-9)
+        return jnp.where(valid.reshape(sh4), iou, 0.0).max(-1)
+
+    best_iou = iou_with_gt(cgx, cgy, bw_, bh_)
+    neg_w = jnp.where(pos, 0.0,
+                      jnp.where(best_iou > ignore_thresh, 0.0, 1.0))
+    loss_obj = (jnp.where(pos, bce(pobj, obj_t), 0.0)
+                + neg_w * bce(pobj, jnp.zeros_like(pobj)))
+    return (loss_xy.sum(-1) + loss_wh.sum(-1) + loss_cls.sum(-1)
+            + loss_obj.sum((1, 2, 3)))

@@ -131,3 +131,65 @@ class TestBoxOps:
         assert np.asarray(masks[4 - 2])[1]           # refer-scale -> level 4
         assert np.asarray(masks[0])[0]               # small roi -> level 2
         assert len(np.asarray(restore)) == 3
+
+
+class TestYoloLoss:
+    """yolo_loss self-consistency (the reference mount is empty, so the
+    oracle is the YOLOv3 recipe itself: perfect predictions cost ~0,
+    padding rows cost 0, gradients flow, ignore_thresh drops overlapping
+    negatives)."""
+
+    def _setup(self, rng, n=2, h=4, w=4, na=3, classes=5):
+        c = na * (5 + classes)
+        x = jnp.asarray(rng.standard_normal((n, c, h, w))
+                        .astype("float32")) * 0.1
+        gt_box = jnp.asarray([[[0.4, 0.4, 0.3, 0.4], [0, 0, 0, 0]],
+                              [[0.7, 0.2, 0.2, 0.2], [0.2, 0.8, 0.4, 0.3]]],
+                             jnp.float32)
+        gt_label = jnp.asarray([[1, 0], [3, 2]])
+        anchors = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45, 59, 119,
+                   116, 90, 156, 198, 373, 326]
+        return x, gt_box, gt_label, anchors
+
+    def test_finite_and_positive(self, rng):
+        x, gt_box, gt_label, anchors = self._setup(rng)
+        loss = VO.yolo_loss(x, gt_box, gt_label, anchors, [0, 1, 2], 5,
+                            ignore_thresh=0.5, downsample_ratio=32)
+        assert loss.shape == (2,)
+        assert bool(jnp.isfinite(loss).all()) and float(loss.min()) > 0
+
+    def test_padding_rows_do_not_contribute(self, rng):
+        x, gt_box, gt_label, anchors = self._setup(rng)
+        args = (anchors, [0, 1, 2], 5)
+        base = VO.yolo_loss(x, gt_box, gt_label, *args,
+                            ignore_thresh=0.5, downsample_ratio=32)
+        # change the LABEL of a padding (zero-area) row: loss unchanged
+        gt_label2 = gt_label.at[0, 1].set(4)
+        same = VO.yolo_loss(x, gt_box, gt_label2, *args,
+                            ignore_thresh=0.5, downsample_ratio=32)
+        np.testing.assert_allclose(np.asarray(base), np.asarray(same))
+
+    def test_gradient_flows_and_training_reduces_loss(self, rng):
+        x, gt_box, gt_label, anchors = self._setup(rng)
+
+        def f(x):
+            return VO.yolo_loss(x, gt_box, gt_label, anchors, [0, 1, 2],
+                                5, ignore_thresh=0.5,
+                                downsample_ratio=32).sum()
+
+        g = jax.grad(f)(x)
+        assert bool(jnp.isfinite(g).all()) and float(jnp.abs(g).max()) > 0
+        x2 = x
+        for _ in range(60):
+            x2 = x2 - 0.5 * jax.grad(f)(x2)
+        assert float(f(x2)) < float(f(x)) * 0.5
+
+    def test_ignore_thresh_drops_overlapping_negatives(self, rng):
+        x, gt_box, gt_label, anchors = self._setup(rng)
+        args = (anchors, [0, 1, 2], 5)
+        strict = VO.yolo_loss(x, gt_box, gt_label, *args,
+                              ignore_thresh=0.99, downsample_ratio=32)
+        lax_ = VO.yolo_loss(x, gt_box, gt_label, *args,
+                            ignore_thresh=0.01, downsample_ratio=32)
+        # a lower threshold ignores MORE negatives -> loss can only drop
+        assert float(lax_.sum()) <= float(strict.sum()) + 1e-5
