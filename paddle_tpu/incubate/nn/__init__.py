@@ -17,7 +17,8 @@ from ...nn import functional as F
 from ...nn.layer import Layer
 from ...nn.layers_common import LayerList
 from . import functional  # noqa: F401
-from .functional import masked_multihead_attention
+from .functional import (decode_attend_cache, masked_multihead_attention,
+                         prefill_write_cache, read_cache_prefix)
 
 
 class FusedMultiTransformer(Layer):
@@ -62,10 +63,13 @@ class FusedMultiTransformer(Layer):
             self._layers.append(blk)
 
     def init_cache(self, batch, max_len, dtype=jnp.float32):
-        """List of (k, v) dense caches, one per layer."""
-        shape = (batch, max_len, self.num_kv_heads, self.head_dim)
-        return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
-                for _ in range(self.num_layers)]
+        """List of dense caches, one per layer: (k, v) 2-tuples, or
+        quantized (k_i8, v_i8, k_scale, v_scale) 4-tuples for
+        ``dtype="int8"`` (see models.generation.make_dense_caches — raw
+        unscaled int8 caches must never exist)."""
+        from ...models.generation import make_dense_caches
+        return make_dense_caches(self.num_layers, batch, max_len,
+                                 self.num_kv_heads, self.head_dim, dtype)
 
     def _split_qkv(self, qkv, b, s):
         h, hkv, d = self.num_heads, self.num_kv_heads, self.head_dim
@@ -98,33 +102,27 @@ class FusedMultiTransformer(Layer):
                                           base=self.rope_theta,
                                           position_ids=seq_lens[:, None])
                 q, k = F.apply_rotary_pos_emb(q, k, cos, sin)
-                kc, vc = caches[i]
-                out, kc, vc = masked_multihead_attention(
-                    q[:, 0], kc, vc, seq_lens, k[:, 0], v[:, 0])
+                out, new_cache = decode_attend_cache(
+                    caches[i], q[:, 0], k[:, 0], v[:, 0], seq_lens)
                 attn = out[:, None]
-                new_caches.append((kc, vc))
+                new_caches.append(new_cache)
             else:
                 cos, sin = F.rope_cos_sin(cos_sin_len, self.head_dim,
                                           base=self.rope_theta)
                 cos, sin = cos[position_offset:], sin[position_offset:]
                 q, k = F.apply_rotary_pos_emb(q, k, cos, sin)
                 if caches is not None:
-                    kc, vc = caches[i]
-                    kc = jax.lax.dynamic_update_slice_in_dim(
-                        kc, k.astype(kc.dtype), position_offset, axis=1)
-                    vc = jax.lax.dynamic_update_slice_in_dim(
-                        vc, v.astype(vc.dtype), position_offset, axis=1)
-                    new_caches.append((kc, vc))
+                    new_caches.append(prefill_write_cache(
+                        caches[i], k, v, offset=position_offset))
                 if position_offset and caches is not None:
                     # chunked prefill: attend over the cached prefix TOO,
                     # with an offset-causal mask (query i sees keys
                     # < position_offset + i + 1)
-                    k_all = new_caches[-1][0][:, :position_offset + s]
-                    v_all = new_caches[-1][1][:, :position_offset + s]
+                    k, v = read_cache_prefix(
+                        new_caches[-1], position_offset + s, q.dtype)
                     mask = (jnp.arange(position_offset + s)[None, :]
                             <= position_offset + jnp.arange(s)[:, None])
                     mask = jnp.where(mask, 0.0, -jnp.inf)[None, None]
-                    k, v = k_all.astype(q.dtype), v_all.astype(q.dtype)
                 else:
                     mask = None
                 rep = self.num_heads // self.num_kv_heads

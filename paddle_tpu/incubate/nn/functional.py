@@ -98,19 +98,35 @@ def quantize_kv(x):
     return jnp.round(xf / s[..., None]).astype(jnp.int8), s
 
 
-def prefill_write_cache(cache, k, v):
-    """Write a prefill chunk at positions [0, s) into a dense cache tuple
-    — 2-tuple fp or 4-tuple int8-quantized (see make_dense_caches)."""
+def prefill_write_cache(cache, k, v, offset=0):
+    """Write a prefill chunk at positions [offset, offset+s) into a dense
+    cache tuple — 2-tuple fp or 4-tuple int8-quantized (see
+    make_dense_caches)."""
     upd = jax.lax.dynamic_update_slice_in_dim
     if len(cache) == 4:
         kc, vc, ks, vs = cache
         k_q, ks_new = quantize_kv(k)
         v_q, vs_new = quantize_kv(v)
-        return (upd(kc, k_q, 0, axis=1), upd(vc, v_q, 0, axis=1),
-                upd(ks, ks_new, 0, axis=1), upd(vs, vs_new, 0, axis=1))
+        return (upd(kc, k_q, offset, axis=1), upd(vc, v_q, offset, axis=1),
+                upd(ks, ks_new, offset, axis=1),
+                upd(vs, vs_new, offset, axis=1))
     kc, vc = cache
-    return (upd(kc, k.astype(kc.dtype), 0, axis=1),
-            upd(vc, v.astype(vc.dtype), 0, axis=1))
+    return (upd(kc, k.astype(kc.dtype), offset, axis=1),
+            upd(vc, v.astype(vc.dtype), offset, axis=1))
+
+
+def read_cache_prefix(cache, length, dtype):
+    """Read positions [0, length) from a dense cache tuple as ``dtype``
+    K/V — dequantizing through the per-(position, head) scales for the
+    int8 4-tuple layout.  Used by chunked prefill to attend over the
+    already-cached prefix."""
+    if len(cache) == 4:
+        kc, vc, ks, vs = cache
+        k = kc[:, :length].astype(dtype) * ks[:, :length, :, None].astype(dtype)
+        v = vc[:, :length].astype(dtype) * vs[:, :length, :, None].astype(dtype)
+        return k, v
+    kc, vc = cache
+    return kc[:, :length].astype(dtype), vc[:, :length].astype(dtype)
 
 
 def decode_attend_cache(cache, q, new_k, new_v, seq_lens):

@@ -366,6 +366,9 @@ def default_collate_fn(batch: Sequence[Any]):
         return np.stack([np.asarray(s) for s in batch])
     if isinstance(sample, dict):
         return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, tuple) and hasattr(sample, "_fields"):
+        # namedtuple: constructor takes positional fields, not a generator
+        return type(sample)(*(default_collate_fn(f) for f in zip(*batch)))
     if isinstance(sample, (tuple, list)):
         return type(sample)(default_collate_fn(fields) for fields in zip(*batch))
     if isinstance(sample, (str, bytes)):
@@ -676,6 +679,9 @@ def default_convert_fn(batch):
     import numpy as _np
 
     import jax.numpy as _jnp
+    if isinstance(batch, tuple) and hasattr(batch, "_fields"):
+        # namedtuple: constructor takes positional fields, not a generator
+        return type(batch)(*(default_convert_fn(b) for b in batch))
     if isinstance(batch, (list, tuple)):
         return type(batch)(default_convert_fn(b) for b in batch)
     if isinstance(batch, dict):

@@ -128,16 +128,37 @@ def fill_(x, value, name=None):
 
 def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
     x = jnp.asarray(x)
-    n = min(x.shape[-2], x.shape[-1])
+    rows, cols = x.shape[-2], x.shape[-1]
+    if wrap and rows > cols and x.ndim == 2:
+        if offset:
+            raise NotImplementedError(
+                "fill_diagonal_: offset != 0 with wrap=True is unsupported")
+        # reference wraps the diagonal for tall matrices: restart it every
+        # (cols + 1) rows. Indices computed in numpy (shapes are static)
+        # so the path stays jit-safe.
+        import numpy as _np
+        r = _np.arange(rows)
+        keep = (r % (cols + 1)) < cols
+        rr, cc = r[keep], (r % (cols + 1))[keep]
+        return x.at[rr, cc].set(value)
+    n = min(rows, cols)
     i = jnp.arange(n - abs(int(offset)))
     if offset >= 0:
         return x.at[..., i, i + offset].set(value)
     return x.at[..., i - offset, i].set(value)
 
 
-def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+def _seeded_key(tag, seed):
+    """seed != 0 is an explicit reproducibility request (reference
+    semantics for uniform_/normal_); 0 draws from the global stream."""
     from ..core import random as prandom
-    key = prandom.next_key("uniform_")
+    if seed:
+        return jax.random.PRNGKey(int(seed))
+    return prandom.next_key(tag)
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    key = _seeded_key("uniform_", seed)
     x = jnp.asarray(x)
     return jax.random.uniform(key, x.shape, x.dtype if
                               jnp.issubdtype(x.dtype, jnp.floating)
@@ -145,8 +166,7 @@ def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
 
 
 def normal_(x, mean=0.0, std=1.0, seed=0, name=None):
-    from ..core import random as prandom
-    key = prandom.next_key("normal_")
+    key = _seeded_key("normal_", seed)
     x = jnp.asarray(x)
     dt = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
     return mean + std * jax.random.normal(key, x.shape, dt)

@@ -38,9 +38,11 @@ class Var:
     """Symbolic node in a Program's expression graph."""
 
     _next_id = [0]
+    _any_created = [False]   # cheap eager-path guard for _wrap_for_vars
 
     def __init__(self, program: "Program", op: Optional[Tuple] = None,
                  shape=None, dtype=None, name=None):
+        Var._any_created[0] = True
         self.program = program
         self.op = op          # None for placeholders, else (fn, args, kwargs)
         self.shape = tuple(shape) if shape is not None else None
@@ -293,6 +295,11 @@ def _wrap_for_vars(fn):
 
     @_functools.wraps(fn)
     def wrapper(*args, **kwargs):
+        # fast path: no Var has ever been constructed in this process, so
+        # the nested isinstance scan cannot find one — eager calls pay one
+        # list-index check instead of a per-arg walk
+        if not Var._any_created[0]:
+            return fn(*args, **kwargs)
         prog = _find_program(args) or _find_program(tuple(kwargs.values()))
         if prog is None:
             return fn(*args, **kwargs)
@@ -316,7 +323,14 @@ def enable_var_dispatch(module, names=None) -> int:
     """Wrap a module's public functions so they accept static ``Var``s
     (lazily recorded) as well as real arrays.  Returns the wrap count.
     Wraps plain functions, jnp ufunc objects, jax custom_jvp/custom_vjp
-    callables and partials — everything except classes and modules."""
+    callables and partials — everything except classes and modules.
+
+    Caveat: this rebinds MODULE ATTRIBUTES, so call sites that did
+    ``from module import fn`` *before* wrapping hold the unwrapped
+    function and bypass Var dispatch (they still work eagerly — a Var
+    argument there raises).  Intra-package code therefore keeps such
+    imports module-qualified (``F.relu``, ``ops.concat``); do the same
+    in ported static-graph code, as ``import paddle`` users already do."""
     count = 0
     for n in (names if names is not None
               else getattr(module, "__all__", None) or dir(module)):

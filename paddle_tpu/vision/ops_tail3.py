@@ -306,7 +306,10 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
     for cls in range(c):
         if cls == background_label:
             continue
-        s = scores[cls]
+        # reference filters score_threshold BEFORE NMS: below-threshold
+        # boxes must not enter the top_k set nor influence decay — push
+        # them to the sort tail, where they can never be "higher-scored"
+        s = jnp.where(scores[cls] > score_threshold, scores[cls], -jnp.inf)
         order = jnp.argsort(-s)[:top]
         sc = s[order]
         bx = bboxes[order]
@@ -322,7 +325,9 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
             decay = ((1 - tri) / (1 - comp[None, :] + 1e-12)).min(axis=1)
         dec = jnp.where(jnp.arange(top) == 0, 1.0, decay)
         new_s = sc * dec
-        valid = new_s > max(score_threshold, post_threshold)
+        # post_threshold applies to DECAYED scores (pre-filter already
+        # removed sub-score_threshold candidates above)
+        valid = jnp.isfinite(new_s) & (new_s > post_threshold)
         out_rows.append(jnp.concatenate(
             [jnp.full((top, 1), cls, jnp.float32),
              jnp.where(valid, new_s, 0.0)[:, None], bx], axis=1))
