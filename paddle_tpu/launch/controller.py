@@ -25,6 +25,7 @@ class CollectiveController:
         self.generation = 0
 
     BASE_PORT = 6170  # reference launcher's default trainer base port
+    GROW = -2         # _watch sentinel: membership grew, relaunch bigger
 
     def _build_pod(self, master: Master, node_rank: int,
                    hosts: list) -> Pod:
@@ -68,25 +69,33 @@ class CollectiveController:
                     node_rank, hosts = master.rendezvous()
                 except TimeoutError as e:
                     # frozen out of this round (joined late) or quorum never
-                    # formed; in elastic mode wait for the round to advance
-                    # and try again rather than crashing the node
+                    # formed; in elastic mode announce ourselves — a HEALTHY
+                    # cluster sees the join request and advances the round
+                    # (scale-up) — then wait for the next round
                     if ctx.elastic_level > 0 and restarts < ctx.max_restarts:
                         restarts += 1
+                        self.generation = int(master.store.get(round_key))
+                        ElasticManager.announce_join(
+                            master.store, ctx.job_id, self.generation)
                         logger.warning(
-                            "rendezvous at round %d failed (%s); waiting "
-                            "for the next round", self.generation, e)
+                            "rendezvous at round %d failed (%s); join "
+                            "announced, waiting for the next round",
+                            self.generation, e)
                         self.generation = self._await_round_change(
                             master.store, round_key, self.generation)
                         continue
                     raise
                 pod = self._build_pod(master, node_rank, hosts)
                 elastic = None
-                if ctx.elastic_level > 0 and len(hosts) > 1:
+                if ctx.elastic_level > 0 and ctx.nnodes > 1:
+                    # even a world-1 job needs the manager: it is how a
+                    # below-MAX cluster notices a joining node (scale-up)
                     elastic = ElasticManager(master.store, ctx.job_id,
                                              node_rank, len(hosts),
                                              ctx.elastic_timeout,
                                              generation=self.generation)
                     elastic.start()
+                can_grow = len(hosts) < ctx.nnodes
 
                 stop_requested = {"flag": False}
 
@@ -97,7 +106,8 @@ class CollectiveController:
                 prev = signal.signal(signal.SIGTERM, _on_term)
                 try:
                     pod.deploy()
-                    code = self._watch(pod, elastic, stop_requested)
+                    code = self._watch(pod, elastic, stop_requested,
+                                       can_grow)
                 finally:
                     signal.signal(signal.SIGTERM, prev)
                     if elastic is not None:
@@ -106,17 +116,21 @@ class CollectiveController:
 
                 if code == 0 or stop_requested["flag"]:
                     return 0 if stop_requested["flag"] else code
+                if code == self.GROW:
+                    # scale-up: a frozen-out node asked in — advance the
+                    # shared round and re-rendezvous at the larger world.
+                    # Not a failure: does not consume the restart budget.
+                    self.generation = self._advance_round(
+                        master.store, round_key, self.generation)
+                    logger.warning(
+                        "scale-up: node join requested; relaunching at "
+                        "round %d with larger membership", self.generation)
+                    time.sleep(0.5)
+                    continue
                 if ctx.elastic_level > 0 and restarts < ctx.max_restarts:
                     restarts += 1
-                    # advance the SHARED round via CAS: only the first
-                    # failing node increments; every other node's CAS loses
-                    # and it adopts the stored value, so divergent local
-                    # restart counts cannot split the job into disjoint
-                    # rendezvous namespaces
-                    g = self.generation
-                    master.store.compare_set(round_key, str(g).encode(),
-                                             str(g + 1).encode())
-                    self.generation = int(master.store.get(round_key))
+                    self.generation = self._advance_round(
+                        master.store, round_key, self.generation)
                     logger.warning(
                         "job failed (code %s); elastic restart %d/%d at "
                         "round %d", code, restarts, ctx.max_restarts,
@@ -126,6 +140,16 @@ class CollectiveController:
                 return code
         finally:
             master.close()
+
+    @staticmethod
+    def _advance_round(store, round_key: str, current: int) -> int:
+        """Advance the SHARED round via CAS: only the first node's CAS
+        lands; every other node's loses and it adopts the stored value, so
+        divergent local views cannot split the job into disjoint
+        rendezvous namespaces."""
+        store.compare_set(round_key, str(current).encode(),
+                          str(current + 1).encode())
+        return int(store.get(round_key))
 
     @staticmethod
     def _await_round_change(store, round_key: str, current: int,
@@ -141,8 +165,10 @@ class CollectiveController:
             "running without this node (scale-up rejoin requires the next "
             "membership change)")
 
-    def _watch(self, pod: Pod, elastic, stop_requested) -> int:
-        """Poll containers (and, in elastic mode, peer heartbeats)."""
+    def _watch(self, pod: Pod, elastic, stop_requested,
+               can_grow: bool = False) -> int:
+        """Poll containers (and, in elastic mode, peer heartbeats and —
+        below MAX membership — scale-up join requests)."""
         while True:
             if stop_requested["flag"]:
                 return 0
@@ -159,4 +185,10 @@ class CollectiveController:
                     pod.stop()
                     pod.join()
                     return 1
+                if can_grow and elastic.join_requested():
+                    logger.warning(
+                        "node join requested; stopping pod to grow")
+                    pod.stop()
+                    pod.join()
+                    return self.GROW
             time.sleep(0.2)

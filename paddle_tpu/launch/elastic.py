@@ -39,6 +39,28 @@ class ElasticManager:
         # read the dead generation's stale heartbeats
         return f"elastic/{self.job_id}/gen{self.generation}/hb/{rank}"
 
+    # -- scale-up (reference: fleet elastic manager relaunches on ANY
+    # membership change, node-join included) -------------------------------
+
+    @staticmethod
+    def _join_key(job_id: str, generation: int) -> str:
+        # generation-scoped so a request consumed by round g's relaunch can
+        # never re-trigger a restart at round g+1
+        return f"elastic/{job_id}/gen{generation}/join_req"
+
+    @classmethod
+    def announce_join(cls, store: TCPStore, job_id: str,
+                      generation: int) -> None:
+        """Called by a node frozen OUT of the current round's membership:
+        ask the healthy cluster to advance the round and re-admit us."""
+        store.set(cls._join_key(job_id, generation),
+                  repr(time.time()).encode())
+
+    def join_requested(self) -> bool:
+        """A frozen-out node wants in at this generation."""
+        return self.store.get(
+            self._join_key(self.job_id, self.generation)) is not None
+
     def start(self) -> None:
         self._started_at = time.time()
         self._thread = threading.Thread(target=self._beat, daemon=True,

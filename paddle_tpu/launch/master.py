@@ -62,16 +62,22 @@ class Master:
         nmin = ctx.min_nodes
         if seq == 0:
             deadline = time.monotonic() + self.store.timeout
-            # a FRESH job (generation 0) waits for full membership — nodes
-            # may still be booting; only a restart generation settles early
-            # with the survivors (the dead peer is not coming back).  The
-            # settle window must outlast a HEALTHY peer's restart path —
-            # dead-node detection (<= elastic_timeout) + pod teardown grace
-            # (<= ~10s) + restart sleep — or a mere worker crash would
-            # permanently shrink the cluster past nodes that are alive.
-            elastic_restart = nmin < ctx.nnodes and self.generation > 0
+            # An elastic range (MIN:MAX) settles with any >= MIN quorum once
+            # the settle window closes — on a FRESH job this is what lets a
+            # below-MAX cluster start at all (late nodes join via the
+            # scale-up path: announce_join → round advance → bigger world),
+            # and on a restart generation it is what lets survivors proceed
+            # without the dead peer.  The window must outlast a HEALTHY
+            # peer's restart path — dead-node detection (<= elastic_timeout)
+            # + pod teardown grace (<= ~10s) + restart sleep — or a mere
+            # worker crash would permanently shrink the cluster past nodes
+            # that are alive.  A fixed-size job (MIN == MAX) always waits
+            # for full membership.
+            # NOT elastic (elastic_level 0): always wait for full
+            # membership — no manager would ever re-admit a frozen-out node
+            elastic_range = nmin < ctx.nnodes and ctx.elastic_level > 0
             settle = time.monotonic() + (ctx.elastic_timeout + 15.0
-                                         if elastic_restart else
+                                         if elastic_range else
                                          self.store.timeout)
             while True:
                 nodes = self.store.keys(self._key("node/"))

@@ -20,6 +20,11 @@ Env protocol (PDTPU_TEST_*):
             fresh (non-resumed) run, so the relaunch survives
   STEP_SLEEP  seconds to sleep after each step (gives an external killer a
             window to land mid-training; default 0)
+  TOPO      "dp" (default) or "zero": (dp, sharding=2) mesh with ZeRO-2
+            partitioned optimizer state — a shrink/grow across THIS
+            topology forces reshard-on-load of partitioned moments
+  DIM       feature width (default 16; "zero" runs need >= 64 so the
+            weights clear the ZERO_MIN_SIZE sharding floor)
 """
 
 import json
@@ -42,7 +47,8 @@ from paddle_tpu.jit import TrainStep  # noqa: E402
 from paddle_tpu.optimizer import AdamW  # noqa: E402
 
 GLOBAL_BATCH = 32
-DIM = 16
+DIM = int(os.environ.get("PDTPU_TEST_DIM", "16"))
+HIDDEN = max(32, 2 * DIM)
 
 
 def global_batch(step: int):
@@ -55,12 +61,24 @@ def main():
     dist.init_parallel_env()
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    topo = os.environ.get("PDTPU_TEST_TOPO", "dp")
     pt.seed(0)
-    model = nn.Sequential(nn.Linear(DIM, 32), nn.ReLU(), nn.Linear(32, DIM))
+    model = nn.Sequential(nn.Linear(DIM, HIDDEN), nn.ReLU(),
+                          nn.Linear(HIDDEN, DIM))
     opt = AdamW(learning_rate=1e-2, parameters=model.parameters())
-    step = TrainStep(model, lambda m, b: ((m(b["x"]) - b["y"]) ** 2).mean(),
-                     opt, mesh=mesh)
+    loss_fn = lambda m, b: ((m(b["x"]) - b["y"]) ** 2).mean()  # noqa: E731
+    if topo == "zero":
+        # (dp, sharding=2) hybrid: optimizer moments ZeRO-partitioned over
+        # the sharding axis — world changes across THIS mesh exercise
+        # reshard-on-load of partitioned state, not just dp data resharding
+        devs = np.array(jax.devices()).reshape(-1, 2)
+        mesh = Mesh(devs, ("dp", "sharding"))
+        step = TrainStep(model, loss_fn, opt, mesh=mesh, zero_stage=2)
+        batch_sharding = NamedSharding(mesh, P(("dp", "sharding")))
+    else:
+        mesh = Mesh(np.array(jax.devices()), ("dp",))
+        step = TrainStep(model, loss_fn, opt, mesh=mesh)
+        batch_sharding = NamedSharding(mesh, P("dp"))
     state = step.init_state(seed=0)
 
     total = int(os.environ.get("PDTPU_TEST_STEPS", "10"))
@@ -77,7 +95,6 @@ def main():
             state = ckpt.load_state_dict(latest, template=state)
             start, resumed_from = int(state["step"]), latest
 
-    batch_sharding = NamedSharding(mesh, P("dp"))
     losses = {}
     for s in range(start, total):
         full = global_batch(s)

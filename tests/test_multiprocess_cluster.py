@@ -6,13 +6,19 @@ tests cannot reach: ``paddle_tpu.launch`` → per-process env protocol →
 collectives (gloo on CPU, ICI/DCN on TPU) → joint training.  SURVEY §4
 patterns 2-3, §5.3, §5.8.
 
-Two contracts:
+Three contracts:
 - cluster parity: 2 OS processes × 4 virtual CPU devices each train dp=8
   jointly and reproduce the single-process 8-device loss trajectory.
 - elastic shrink-resume: kill one node mid-run → the surviving node detects
   the death, relaunches at a smaller world size, resumes from the sharded
   checkpoint via reshard-on-load, and the continued trajectory matches an
   uninterrupted reference run.
+- elastic grow-resume: a node joins a HEALTHY below-MAX job mid-run → the
+  running cluster sees the join request, advances the shared rendezvous
+  round, relaunches at the larger world, and resumes from the latest
+  checkpoint with the trajectory again matching the reference run
+  (reference: fleet elastic manager relaunches on ANY membership change,
+  node-join included — SURVEY §2.7, §5.3).
 """
 
 import json
@@ -42,7 +48,8 @@ def _run_single_reference(tmp_path, steps):
     env = {**os.environ, "PDTPU_REPO": REPO, "PDTPU_TEST_DEVICES": "8",
            "PDTPU_TEST_STEPS": str(steps), "PDTPU_TEST_OUT": out}
     for k in ("PDTPU_COORDINATOR", "PDTPU_TEST_CKPT_DIR",
-              "PDTPU_TEST_KILL_RANK", "PDTPU_TEST_KILL_STEP"):
+              "PDTPU_TEST_KILL_RANK", "PDTPU_TEST_KILL_STEP",
+              "PDTPU_TEST_STEP_SLEEP"):
         env.pop(k, None)
     r = subprocess.run([sys.executable, WORKER], env=env,
                        capture_output=True, text=True, timeout=300)
@@ -135,6 +142,137 @@ class TestElasticShrinkResume:
         # resumed from the kill-point checkpoint (or at worst one step
         # earlier, if the survivor was torn down mid-save)
         assert self.KILL_AFTER - 1 <= final["start"] <= self.KILL_AFTER
+
+        single = _run_single_reference(tmp_path, self.STEPS)
+        steps = sorted(int(s) for s in final["losses"])
+        assert steps[-1] == self.STEPS - 1
+        a = [final["losses"][str(i)] for i in steps]
+        b = [single["losses"][str(i)] for i in steps]
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+class TestElasticShrinkResumeSharded:
+    """Shrink across a SHARDED (dp, sharding=2) ZeRO-2 topology: the
+    relaunch must reshard-on-load partitioned optimizer moments (8-device
+    (4,2) mesh -> 4-device (2,2) mesh), not just redistribute dp data."""
+
+    STEPS = 10
+    KILL_AFTER = 5
+
+    def test_kill_node_shrink_sharded_state(self, tmp_path, monkeypatch):
+        out = str(tmp_path / "elastic_sharded.jsonl")
+        ckpt_dir = str(tmp_path / "ckpt")
+        port = free_port()
+        master = f"127.0.0.1:{port}"
+
+        monkeypatch.setenv("PDTPU_REPO", REPO)
+        monkeypatch.setenv("PDTPU_TEST_DEVICES", "4")
+        monkeypatch.setenv("PDTPU_TEST_STEPS", str(self.STEPS))
+        monkeypatch.setenv("PDTPU_TEST_OUT", out)
+        monkeypatch.setenv("PDTPU_TEST_CKPT_DIR", ckpt_dir)
+        monkeypatch.setenv("PDTPU_TEST_TOPO", "zero")
+        monkeypatch.setenv("PDTPU_TEST_DIM", "64")
+        monkeypatch.setenv("PDTPU_TEST_KILL_RANK", "1")
+        monkeypatch.setenv("PDTPU_TEST_KILL_STEP", str(self.KILL_AFTER))
+
+        env_b = {**os.environ, "PYTHONPATH": REPO}
+        node_b = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.launch",
+             "--nnodes", "1:2", "--rank", "1", "--master", master,
+             "--nproc_per_node", "1", "--elastic_level", "1",
+             "--elastic_timeout", "4", "--max_restarts", "0",
+             "--job_id", "mpc4",
+             "--log_dir", str(tmp_path / "log_b"), WORKER],
+            env=env_b, cwd=REPO, start_new_session=True,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+        ctx = parse_args(["--nnodes", "1:2", "--rank", "0",
+                          "--master", master, "--nproc_per_node", "1",
+                          "--elastic_level", "1", "--elastic_timeout", "4",
+                          "--job_id", "mpc4",
+                          "--log_dir", str(tmp_path / "log_a"), WORKER])
+        try:
+            rc = CollectiveController(ctx).run()
+        finally:
+            try:
+                os.killpg(node_b.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            node_b.wait(timeout=30)
+
+        assert rc == 0
+        final = _read_records(out)[-1]
+        assert final["world"] == 1 and final["devices"] == 4
+        assert final["resumed_from"] is not None
+        assert self.KILL_AFTER - 1 <= final["start"] <= self.KILL_AFTER
+
+        single = _run_single_reference(tmp_path, self.STEPS)
+        steps = sorted(int(s) for s in final["losses"])
+        assert steps[-1] == self.STEPS - 1
+        a = [final["losses"][str(i)] for i in steps]
+        b = [single["losses"][str(i)] for i in steps]
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+class TestElasticGrowResume:
+    """Scale-UP: node B joins a healthy world-1 job mid-run."""
+
+    STEPS = 12
+    JOIN_DELAY = 22      # seconds before node B even starts booting
+    ELASTIC_TIMEOUT = 3  # gen-0 settle window = this + 15s < JOIN_DELAY
+
+    def test_node_join_grows_world_resume_from_ckpt(self, tmp_path,
+                                                    monkeypatch):
+        out = str(tmp_path / "grow.jsonl")
+        ckpt_dir = str(tmp_path / "ckpt")
+        master = f"127.0.0.1:{free_port()}"
+
+        monkeypatch.setenv("PDTPU_REPO", REPO)
+        monkeypatch.setenv("PDTPU_TEST_DEVICES", "4")
+        monkeypatch.setenv("PDTPU_TEST_STEPS", str(self.STEPS))
+        monkeypatch.setenv("PDTPU_TEST_OUT", out)
+        monkeypatch.setenv("PDTPU_TEST_CKPT_DIR", ckpt_dir)
+        # stretch training so node A is still mid-run when B's join lands:
+        # A settles alone at ~18s, then 12 steps x 2.5s = 30s of training
+        monkeypatch.setenv("PDTPU_TEST_STEP_SLEEP", "2.5")
+        monkeypatch.delenv("PDTPU_TEST_KILL_RANK", raising=False)
+        monkeypatch.delenv("PDTPU_TEST_KILL_STEP", raising=False)
+
+        common = ["--nnodes", "1:2", "--master", master,
+                  "--nproc_per_node", "1", "--elastic_level", "1",
+                  "--elastic_timeout", str(self.ELASTIC_TIMEOUT),
+                  "--max_restarts", "2", "--job_id", "mpc3"]
+        env_b = {**os.environ, "PYTHONPATH": REPO}
+        cmd_b = " ".join(
+            [sys.executable, "-m", "paddle_tpu.launch", "--rank", "1",
+             "--log_dir", str(tmp_path / "log_b")] + common + [WORKER])
+        node_b = subprocess.Popen(
+            ["/bin/sh", "-c", f"sleep {self.JOIN_DELAY} && exec {cmd_b}"],
+            env=env_b, cwd=REPO, start_new_session=True,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+        # node A: boots alone (gen-0 elastic settle admits a 1-node
+        # quorum), trains, then grows when B's join request arrives
+        ctx = parse_args(["--rank", "0",
+                          "--log_dir", str(tmp_path / "log_a")]
+                         + common + [WORKER])
+        try:
+            rc = CollectiveController(ctx).run()
+        finally:
+            try:
+                os.killpg(node_b.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            node_b.wait(timeout=30)
+
+        assert rc == 0
+        records = _read_records(out)
+        final = records[-1]
+        # the job finished at the GROWN world, resumed from a checkpoint
+        # taken while running alone
+        assert final["world"] == 2 and final["devices"] == 8
+        assert final["resumed_from"] is not None
+        assert 1 <= final["start"] <= self.STEPS - 1
 
         single = _run_single_reference(tmp_path, self.STEPS)
         steps = sorted(int(s) for s in final["losses"])
