@@ -30,13 +30,18 @@ BASE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "op_baseline.json")
 
 
+ITER_SCALE = 1.0  # --fast shrinks every op's iteration budget
+REPS = 5
+
+
 def _time(f, *args, iters=100):
     """Per-iter ms, one host sync per block (the tunneled-TPU round-trip
     is ~100 ms — a large block amortizes it below the noise floor)."""
+    iters = max(1, int(iters * ITER_SCALE))
     out = f(*args)
     _ = float(jnp.sum(jax.tree_util.tree_leaves(out)[0]))
     best = float("inf")
-    for _ in range(5):
+    for _ in range(REPS):
         t0 = time.perf_counter()
         for _ in range(iters):
             out = f(*args)
@@ -101,7 +106,14 @@ def main():
     ap.add_argument("--update", action="store_true")
     ap.add_argument("--tolerance", type=float, default=0.5,
                     help="allowed fractional slowdown before failing")
+    ap.add_argument("--fast", action="store_true",
+                    help="~10x fewer iterations + 2 reps: noisier, meant "
+                         "for the standing CI gate (tools/ci.py) where the "
+                         "tolerance is loose anyway")
     args = ap.parse_args()
+    if args.fast:
+        global ITER_SCALE, REPS
+        ITER_SCALE, REPS = 0.1, 2
 
     backend = jax.default_backend()
     results = suite()
