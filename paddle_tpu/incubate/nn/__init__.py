@@ -71,6 +71,14 @@ class FusedMultiTransformer(Layer):
         return make_dense_caches(self.num_layers, batch, max_len,
                                  self.num_kv_heads, self.head_dim, dtype)
 
+    def quantize_weights(self, algo="weight_only_int8", group_size=-1):
+        """Serving-time weight-only quantization of every projection in
+        the stack (reference: the FusedMultiTransformer kernel's
+        weight_only int8/int4 mode over the Cutlass fpA_intB GEMM).
+        Returns the number of Linears swapped."""
+        from ...nn.quant import quantize_linears
+        return quantize_linears(self, algo=algo, group_size=group_size)
+
     def _split_qkv(self, qkv, b, s):
         h, hkv, d = self.num_heads, self.num_kv_heads, self.head_dim
         q, k, v = jnp.split(qkv, [h * d, h * d + hkv * d], axis=-1)

@@ -42,10 +42,10 @@ class Predictor:
             self._fn = pjit.load(config.model_path)
         else:
             model = config.model
-            from ..nn.layer import Layer, functional_call, raw_params
+            from ..nn.layer import Layer, functional_call, serving_params
             if isinstance(model, Layer):
                 model.eval()
-                params = config.params or raw_params(model)
+                params = config.params or serving_params(model)
 
                 def fn(*args):
                     return functional_call(model, params, *args,

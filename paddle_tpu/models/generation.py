@@ -292,10 +292,10 @@ class CachedGenerationMixin:
                      temperature=0.0, repetition_penalty=1.0,
                      eos_token_id=None, pad_token_id=None,
                      kv_cache_dtype=None):
-        from ..nn.layer import raw_params
+        from ..nn.layer import serving_params
         b, prompt_len = input_ids.shape
         nb = num_beams
-        params = raw_params(self)
+        params = serving_params(self)
         prefill = self._prefill_fn()
         # prefill ONCE at batch b (the dominant FLOP cost for long
         # prompts), then repeat the caches across beams — the rows are
@@ -450,9 +450,9 @@ class CachedGenerationMixin:
                 ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
             return ids
 
-        from ..nn.layer import raw_params
+        from ..nn.layer import serving_params
         b = input_ids.shape[0]       # total/prompt_len validated above
-        params = raw_params(self)
+        params = serving_params(self)
         prefill = self._prefill_fn()
         caches = self.model.init_cache(b, total, dtype=kv_cache_dtype)
         logits, caches = prefill(params, input_ids, caches)

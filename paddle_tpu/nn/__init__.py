@@ -2,6 +2,7 @@
 
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from . import quant  # noqa: F401
 from . import utils  # noqa: F401
 from .layer import (Layer, ParamAttr, ParameterList, functional_call,  # noqa: F401
                     meta_init, raw_params, trainable_mask)

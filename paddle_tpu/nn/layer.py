@@ -477,6 +477,20 @@ def raw_params(layer: Layer) -> Dict[str, jax.Array]:
     return dict(layer.named_parameters())
 
 
+def serving_params(layer: Layer) -> Dict[str, jax.Array]:
+    """Parameters PLUS array buffers — the inference-path pytree.
+
+    Weight-only quantized layers (nn.quant.QuantizedLinear) keep their
+    int8/int4 weights as buffers; passing them through functional_call as
+    inputs (instead of closing over them) keeps compiled decode loops
+    free of hundreds of MB of baked-in constants."""
+    params = dict(layer.named_parameters())
+    for name, buf in layer.named_buffers():
+        if buf is not None and name not in params:
+            params[name] = buf
+    return params
+
+
 def trainable_mask(layer: Layer) -> Dict[str, bool]:
     meta = layer.param_meta()
     return {k: meta[k].trainable for k in raw_params(layer)}

@@ -87,6 +87,42 @@ class TestGenerateInt8:
                               np.asarray(q8[:, 8:])))
         assert agree >= 0.7, agree
 
+    def test_logit_error_bound_teacher_forced(self):
+        """The BINDING quality gate (VERDICT r3 weak #3): token agreement
+        can hide a degraded cache, so bound the LOGIT error directly.
+        Teacher-forced decode (same tokens fed to both cache dtypes, so
+        trajectories cannot diverge) over 16 steps: per-step max |Δlogit|
+        stays within a small fraction of the fp logit scale."""
+        pt.seed(0)
+        model = llama("tiny", max_position_embeddings=96)
+        model.eval()
+        ids = jax.random.randint(jax.random.key(3), (2, 16), 0,
+                                 model.cfg.vocab_size)
+        toks = jax.random.randint(jax.random.key(4), (2, 16), 0,
+                                  model.cfg.vocab_size)
+
+        def rollout(dtype):
+            caches = model.model.init_cache(2, 96, dtype=dtype)
+            _, caches = model.model(ids, caches=caches)
+            lens = jnp.full((2,), 16, jnp.int32)
+            logits = []
+            for t in range(16):
+                h, caches = model.model(toks[:, t:t + 1], caches=caches,
+                                        seq_lens=lens)
+                logits.append(model.logits(h[:, -1]))
+                lens = lens + 1
+            return jnp.stack(logits)
+
+        fp = rollout(jnp.float32)
+        q8 = rollout("int8")
+        scale = float(jnp.std(fp))
+        err = float(jnp.abs(fp - q8).max()) / scale
+        # int8 cache noise must stay a small perturbation of the logits,
+        # not just "usually picks the same argmax"
+        assert err < 0.25, f"relative logit error {err}"
+        mean_err = float(jnp.abs(fp - q8).mean()) / scale
+        assert mean_err < 0.05, f"mean relative logit error {mean_err}"
+
     def test_dtype_spelling_normalized(self):
         from paddle_tpu.models.generation import make_dense_caches
         for spelled in ("int8", jnp.int8, np.int8):

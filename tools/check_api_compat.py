@@ -29,6 +29,7 @@ NAMESPACES = [
     "paddle_tpu.hapi", "paddle_tpu.vision", "paddle_tpu.vision.ops",
     "paddle_tpu.vision.models", "paddle_tpu.vision.transforms",
     "paddle_tpu.audio",
+    "paddle_tpu.nn.quant",
     "paddle_tpu.sparse", "paddle_tpu.quantization", "paddle_tpu.incubate",
     "paddle_tpu.incubate.nn",
     "paddle_tpu.inference", "paddle_tpu.static", "paddle_tpu.profiler",

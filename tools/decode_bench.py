@@ -26,9 +26,15 @@ import numpy as np
 
 
 def bench_generate(preset="llama-350m", batch=1, prefill=128,
-                   n_lo=16, n_hi=528, repeats=4, kv_cache_dtype=None):
+                   n_lo=16, n_hi=528, repeats=4, kv_cache_dtype=None,
+                   weight_quant=None):
     """n_hi - n_lo = 512 decode steps: the relay's ~0.1 s stalls must be
-    small against the measured delta or the slope is noise."""
+    small against the measured delta or the slope is noise.
+
+    ``weight_quant``: "int8" | "int4" stores every projection weight-only
+    quantized (nn.quant) — at batch 1 the parameter stream IS the HBM
+    roofline, so this is decode's other halving lever next to the int8
+    KV cache."""
     import paddle_tpu as pt
     from paddle_tpu.models.llama import llama
 
@@ -37,6 +43,10 @@ def bench_generate(preset="llama-350m", batch=1, prefill=128,
                   dtype="bfloat16")
     model.astype("bfloat16")   # cfg.dtype sets cache dtype only; decode is
     model.eval()               # bandwidth-bound, params must be bf16 too
+    if weight_quant:
+        from paddle_tpu.nn.quant import quantize_linears
+        n = quantize_linears(model, algo=f"weight_only_{weight_quant}")
+        print(f"# weight_quant={weight_quant}: {n} linears", flush=True)
     ids = jax.random.randint(jax.random.key(1), (batch, prefill), 0,
                              model.cfg.vocab_size)
 
@@ -130,6 +140,11 @@ def main():
     for batch in (1, 8):
         print(json.dumps(bench_generate(batch=batch,
                                         kv_cache_dtype="int8")), flush=True)
+    # weight-only int8 stacked with the int8 KV cache: both halves of the
+    # decode HBM stream quantized (bs1 = params-dominated, bs8 = cache)
+    for batch in (1, 8):
+        print(json.dumps(bench_generate(batch=batch, kv_cache_dtype="int8",
+                                        weight_quant="int8")), flush=True)
     print(json.dumps(bench_decode_attention()), flush=True)
 
 

@@ -53,10 +53,13 @@ def _time(f, *args, iters=100):
 def suite():
     from paddle_tpu.incubate.nn import functional as IF
     from paddle_tpu.nn import functional as F
+    from paddle_tpu.nn import quant as QN
 
     key = jax.random.key(0)
     x = jax.random.normal(key, (4096, 1024), jnp.bfloat16)
     w = jax.random.normal(key, (1024, 4096), jnp.bfloat16)
+    _wq8 = QN.weight_quantize(w, algo="weight_only_int8")
+    _wq4 = QN.weight_quantize(w, algo="weight_only_int4")
     q = jax.random.normal(key, (2, 1024, 8, 64), jnp.bfloat16)
     # decode-shape operands: one new token against a 1024-token KV cache
     qd = jax.random.normal(key, (8, 8, 64), jnp.bfloat16)
@@ -87,6 +90,15 @@ def suite():
             (qd, kc.reshape(8 * 16, 64, 8, 64),
              jnp.arange(8 * 16, dtype=jnp.int32).reshape(8, 16), lens),
             {"iters": 100 if jax.default_backend() == "tpu" else 3}),
+        # weight-only serving GEMMs (nn.quant): the decode-path matmul
+        # with int8 / packed-int4 weight streams (SURVEY §2.1 fpA_intB)
+        "weight_only_int8_gemm": (jax.jit(
+            lambda a, qw, s: QN.weight_only_linear(a, qw, weight_scale=s)),
+            (x, *_wq8)),
+        "weight_only_int4_gemm": (jax.jit(
+            lambda a, qw, s: QN.weight_only_linear(
+                a, qw, weight_scale=s, weight_dtype="int4")),
+            (x, *_wq4)),
         "rms_norm": (jax.jit(lambda a: a * jax.lax.rsqrt(
             jnp.mean(a.astype(jnp.float32) ** 2, -1, keepdims=True) + 1e-6
         ).astype(a.dtype)), (x,)),
@@ -123,17 +135,26 @@ def main():
     if os.path.exists(BASE_PATH):
         with open(BASE_PATH) as f:
             base = json.load(f)
-    if args.update or backend not in base:
+    if args.update:
         base[backend] = results
         with open(BASE_PATH, "w") as f:
             json.dump(base, f, indent=2)
         print(f"baseline recorded for {backend!r} -> {BASE_PATH}")
         return 0
+    if backend not in base:
+        # a GATE run must never self-record (a bogus section written as a
+        # side effect would be committed as truth) — state it and pass
+        print(f"op-benchmark: no baseline for backend {backend!r}; "
+              "skipping comparison (run with --update to record one)")
+        return 0
 
     failures = []
     for name, ms in results.items():
         ref = base[backend].get(name)
-        if ref and ms > ref * (1 + args.tolerance):
+        if ref is None:
+            print(f"op-benchmark: WARNING no {backend!r} baseline entry "
+                  f"for {name!r} — not gated (run --update)")
+        elif ms > ref * (1 + args.tolerance):
             failures.append(f"{name}: {ms:.3f} ms vs baseline {ref:.3f} ms")
     if failures:
         print("op-benchmark gate FAILED:")
