@@ -12,11 +12,22 @@ function's AST so that
   semantics for one-sided assignments);
 - ``while <tensor-pred>: ...`` becomes a ``lax.while_loop`` over the
   loop-carried variables;
+- ``for i in range(...)`` becomes ONE ``lax.while_loop`` when any bound
+  is traced — so a new trip count does not retrace (the reference SOT's
+  guard-cache goal, reached by making the bound a loop input);
+  ``for x in <traced array>`` becomes a ``lax.scan`` over the leading
+  axis;
+- ``break`` / ``continue`` in while / range-for loops are lowered by a
+  pre-pass into flag variables + guard ``if``s (the reference dy2static's
+  convert_break_continue cond-flag transform), which then convert like
+  hand-written control flow;
 - predicates that turn out CONCRETE at trace time keep exact Python
   semantics (only the taken branch runs, loops unroll) — the dispatch is
-  by value, not by syntax;
-- anything unconvertible (branch returns on one side only, break/continue,
-  structure mismatch between branches, undefined loop carries) raises
+  by value, not by syntax, and a predicate that BECOMES traced mid-unroll
+  (a break flag fed by a traced comparison) hands the remaining
+  iterations to a compiled while_loop;
+- anything unconvertible (branch returns on one side only, structure
+  mismatch between branches, undefined loop carries) raises
   ``GraphBreakError`` mid-trace, which ``to_static`` surfaces with the
   file:line diagnostic (full_graph=True) or falls back to one eager call
   (full_graph=False), exactly like SOT's graph-break interpreter.
@@ -120,26 +131,126 @@ def _sot_if_ret(pred, tfn, ffn, local_ns, names, dummy_ok, loc):
 
 def _sot_while(cfn, bfn, local_ns, names, loc):
     vals = tuple(local_ns.get(n, _SOT_UNDEF) for n in names)
-    undef = any(v is _SOT_UNDEF for v in vals)
-    t = cfn(*vals)
-    if _is_tracer(t):
-        if undef:
+    # Concrete predicates keep plain Python semantics (the loop unrolls
+    # under trace) — but the predicate can BECOME traced mid-unroll (a
+    # lowered `break` flag fed by a traced comparison), so the dispatch
+    # re-checks every iteration and hands the REMAINING iterations to one
+    # lax.while_loop at the transition.
+    while True:
+        t = cfn(*vals)
+        if _is_tracer(t):
+            if any(v is _SOT_UNDEF for v in vals):
+                missing = [n for n, v in zip(names, vals)
+                           if v is _SOT_UNDEF]
+                raise GraphBreakError(
+                    f"graph break at {loc}: traced `while` with "
+                    f"loop-carried variable(s) {missing} undefined before "
+                    "the loop")
+            try:
+                return lax.while_loop(lambda vs: cfn(*vs),
+                                      lambda vs: tuple(bfn(*vs)), vals)
+            except (TypeError, ValueError) as e:
+                raise GraphBreakError(
+                    f"graph break at {loc}: auto-converted `while` could "
+                    f"not compile ({e}). lax.while_loop requires the body "
+                    "to keep every carried shape/dtype fixed") from e
+        if not t:
+            return vals
+        vals = tuple(bfn(*vals))
+
+
+def _sot_not(x):
+    return jax.numpy.logical_not(x) if _is_tracer(x) else (not x)
+
+
+def _sot_or(a, b):
+    if _is_tracer(a) or _is_tracer(b):
+        return jax.numpy.logical_or(a, b)
+    return a or b
+
+
+def _sot_and(a, b):
+    if _is_tracer(a) or _is_tracer(b):
+        return jax.numpy.logical_and(a, b)
+    return a and b
+
+
+def _sot_and_lazy(a, bf):
+    """Short-circuiting and: ``bf`` (a thunk) is NOT evaluated when ``a``
+    is concretely false — a lowered-break while test must not re-run a
+    side-effecting condition (walrus, iterator pop) after break fired."""
+    if not _is_tracer(a) and not a:
+        return False
+    return _sot_and(a, bf())
+
+
+def _sot_step_lt(i, hi, st):
+    """range-style continuation test, concrete or traced, either sign.
+    A traced step of 0 (where Python's range() would raise) terminates
+    the loop immediately instead of spinning the device forever."""
+    if _is_tracer(i) or _is_tracer(hi) or _is_tracer(st):
+        import jax.numpy as jnp
+        return jnp.where(st == 0, False,
+                         jnp.where(st > 0, i < hi, i > hi))
+    if st == 0:
+        raise ValueError("range() arg 3 must not be zero")
+    return i < hi if st > 0 else i > hi
+
+
+def _sot_for_range(lo, hi, st, bfn, local_ns, names, loc):
+    """``for i in range(lo, hi, st)`` (no break/continue — those were
+    lowered to a while beforehand).  Concrete bounds keep Python
+    semantics (the loop unrolls under trace); ANY traced bound lowers to
+    one ``lax.while_loop`` whose trip count is an input — so calling the
+    compiled function with a different ``n`` does NOT recompile (the
+    reference SOT's guard-cache goal, reached jax-style by making the
+    bound dynamic instead of guarding a specialization)."""
+    vals = tuple(local_ns.get(n, _SOT_UNDEF) for n in names)
+    traced = any(map(_is_tracer, (lo, hi, st)))
+    if traced:
+        if any(v is _SOT_UNDEF for v in vals):
             missing = [n for n, v in zip(names, vals) if v is _SOT_UNDEF]
             raise GraphBreakError(
-                f"graph break at {loc}: traced `while` with loop-carried "
+                f"graph break at {loc}: traced `for` with loop-carried "
                 f"variable(s) {missing} undefined before the loop")
+        if isinstance(st, int) and st == 0:
+            raise ValueError("range() arg 3 must not be zero")
         try:
-            return lax.while_loop(lambda vs: cfn(*vs),
-                                  lambda vs: tuple(bfn(*vs)), vals)
+            out = lax.while_loop(
+                lambda c: _sot_step_lt(c[0], hi, st),
+                lambda c: (c[0] + st,) + tuple(bfn(c[0], *c[1:])),
+                (jax.numpy.asarray(lo),) + vals)
+            return out[1:]
         except (TypeError, ValueError) as e:
             raise GraphBreakError(
-                f"graph break at {loc}: auto-converted `while` could not "
-                f"compile ({e}). lax.while_loop requires the body to keep "
-                "every carried shape/dtype fixed") from e
-    # concrete predicate: plain Python semantics (loop unrolls under trace)
-    while t:
-        vals = tuple(bfn(*vals))
-        t = cfn(*vals)
+                f"graph break at {loc}: auto-converted `for` could not "
+                f"compile ({e})") from e
+    for i in range(lo, hi, st):
+        vals = tuple(bfn(i, *vals))
+    return vals
+
+
+def _sot_for_iter(it, bfn, local_ns, names, loc):
+    """``for x in <iterable>``: jax arrays iterate via ONE ``lax.scan``
+    over the leading axis (a traced array cannot be Python-iterated);
+    everything else keeps Python semantics."""
+    vals = tuple(local_ns.get(n, _SOT_UNDEF) for n in names)
+    if _is_tracer(it):
+        if any(v is _SOT_UNDEF for v in vals):
+            missing = [n for n, v in zip(names, vals) if v is _SOT_UNDEF]
+            raise GraphBreakError(
+                f"graph break at {loc}: traced `for` with loop-carried "
+                f"variable(s) {missing} undefined before the loop")
+        try:
+            out, _ = lax.scan(lambda c, x: (tuple(bfn(x, *c)), None),
+                              vals, it)
+            return out
+        except (TypeError, ValueError) as e:
+            raise GraphBreakError(
+                f"graph break at {loc}: auto-converted `for` over a "
+                f"traced array could not compile ({e})") from e
+    for x in it:
+        vals = tuple(bfn(x, *vals))
     return vals
 
 
@@ -238,7 +349,8 @@ def _helper_call_names(stmt):
     val = getattr(stmt, "value", None) if isinstance(
         stmt, (ast.Assign, ast.Return)) else None
     if (isinstance(val, ast.Call) and isinstance(val.func, ast.Name)
-            and val.func.id in ("_sot_if", "_sot_if_ret", "_sot_while")):
+            and val.func.id in ("_sot_if", "_sot_if_ret", "_sot_while",
+                                "_sot_for_range", "_sot_for_iter")):
         tuples = [a for a in val.args
                   if isinstance(a, ast.Tuple)
                   and all(isinstance(e, ast.Constant) for e in a.elts)]
@@ -282,12 +394,225 @@ def _guaranteed_stores(stmts) -> set:
     return out
 
 
+class _BCFinder(ast.NodeVisitor):
+    """break/continue bound to THIS loop level (not inside nested loops
+    or nested function definitions) — the one boundary-rule visitor."""
+
+    def __init__(self):
+        self.has_brk = self.has_cont = False
+
+    def visit_Break(self, node):
+        self.has_brk = True
+
+    def visit_Continue(self, node):
+        self.has_cont = True
+
+    def visit_While(self, node):     # inner loops own their bc
+        pass
+
+    def visit_For(self, node):
+        pass
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _bc_flags(stmts):
+    f = _BCFinder()
+    for s in (stmts if isinstance(stmts, (list, tuple)) else [stmts]):
+        f.visit(s)
+    return f.has_brk, f.has_cont
+
+
+def _has_loop_bc(stmts) -> bool:
+    return any(_bc_flags(stmts))
+
+
+def _assign_const(name, value):
+    return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                      value=ast.Constant(value=value))
+
+
+def _call_expr(fname, *args):
+    return ast.Call(func=ast.Name(id=fname, ctx=ast.Load()),
+                    args=list(args), keywords=[])
+
+
+class _LowerBreakContinue(ast.NodeTransformer):
+    """Pre-pass: rewrite ``break``/``continue`` in ``while`` loops (and
+    ``for i in range(...)`` loops, first lowered to a while) into flag
+    variables + guard ``if``s — the standard cond-flag transform the
+    reference's dy2static applies (convert_break_continue).  The main
+    _CFTransformer then converts the resulting plain ifs/whiles exactly
+    like hand-written ones."""
+
+    def __init__(self):
+        self.counter = 0
+        self.changed = False
+
+    # break/continue never cross a function boundary
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        return node
+
+    def _guard(self, brk, cont):
+        if brk and cont:
+            return _call_expr("_sot_not",
+                              _call_expr("_sot_or",
+                                         ast.Name(id=brk, ctx=ast.Load()),
+                                         ast.Name(id=cont, ctx=ast.Load())))
+        flag = brk or cont
+        return _call_expr("_sot_not", ast.Name(id=flag, ctx=ast.Load()))
+
+    def _lower(self, stmts, brk, cont):
+        out = []
+        for idx, s in enumerate(stmts):
+            if isinstance(s, ast.Break):
+                out.append(_assign_const(brk, True))
+                return out            # rest is statically unreachable
+            if isinstance(s, ast.Continue):
+                out.append(_assign_const(cont, True))
+                return out
+            if isinstance(s, ast.If) and _has_loop_bc([s]):
+                body = self._lower(s.body, brk, cont) or [ast.Pass()]
+                orelse = (self._lower(s.orelse, brk, cont)
+                          if s.orelse else [])
+                out.append(ast.If(test=s.test, body=body, orelse=orelse))
+                rest = self._lower(stmts[idx + 1:], brk, cont)
+                if rest:
+                    out.append(ast.If(test=self._guard(brk, cont),
+                                      body=rest, orelse=[]))
+                return out
+            out.append(s)
+        return out
+
+    def _flags_for(self, body):
+        i = self.counter
+        self.counter += 1
+        has_brk, has_cont = _bc_flags(body)
+        return (f"_sot_brk_{i}" if has_brk else None,
+                f"_sot_cont_{i}" if has_cont else None)
+
+    def visit_While(self, node):
+        node = self.generic_visit(node)     # inner loops first
+        if node.orelse or not _has_loop_bc(node.body):
+            return node
+        if _names(node.test)[0]:
+            # the test itself BINDS names (walrus): relocating it into
+            # guards/thunks would swallow the binding — stay Python
+            return node
+        brk, cont = self._flags_for(node.body)
+        body = self._lower(node.body, brk, cont)
+        if cont:
+            body = [_assign_const(cont, False)] + body
+        test = node.test
+        pre = []
+        if brk:
+            pre = [_assign_const(brk, False)]
+            # lazy: after break fires, the ORIGINAL test (possibly
+            # side-effecting — walrus, iterator pop) must not run again
+            thunk = ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                                   kwonlyargs=[], kw_defaults=[],
+                                   kwarg=None, defaults=[]),
+                body=node.test)
+            test = _call_expr("_sot_and_lazy", self._guard(brk, None),
+                              thunk)
+        self.changed = True
+        return pre + [ast.While(test=test, body=body, orelse=[])]
+
+    def visit_For(self, node):
+        node = self.generic_visit(node)
+        if (node.orelse or not _has_loop_bc(node.body)
+                or not isinstance(node.target, ast.Name)):
+            return node
+        rng = node.iter
+        if not (isinstance(rng, ast.Call) and isinstance(rng.func, ast.Name)
+                and rng.func.id == "range" and not rng.keywords
+                and 1 <= len(rng.args) <= 3):
+            return node     # only range() fors get the while lowering
+        i = self.counter    # reserve names before _flags_for bumps it
+        lo, hi, st = _range_args(rng)
+        ivar = f"_sot_i_{i}"
+        # range() evaluates its bounds ONCE — hoist them into temps so
+        # the per-iteration test/increment can't re-run user expressions
+        hivar, stvar = f"_sot_hi_{i}", f"_sot_st_{i}"
+        brk, cont = self._flags_for(node.body)
+        body = self._lower(node.body, brk, cont)
+        if cont:
+            body = [_assign_const(cont, False)] + body
+        # target binds at iteration top; increment runs OUTSIDE the
+        # guards so `continue` still advances the index
+        body = ([ast.Assign(targets=[ast.Name(id=node.target.id,
+                                              ctx=ast.Store())],
+                            value=ast.Name(id=ivar, ctx=ast.Load()))]
+                + body
+                + [ast.Assign(targets=[ast.Name(id=ivar, ctx=ast.Store())],
+                              value=ast.BinOp(
+                                  left=ast.Name(id=ivar, ctx=ast.Load()),
+                                  op=ast.Add(),
+                                  right=ast.Name(id=stvar,
+                                                 ctx=ast.Load())))])
+        test = _call_expr("_sot_step_lt",
+                          ast.Name(id=ivar, ctx=ast.Load()),
+                          ast.Name(id=hivar, ctx=ast.Load()),
+                          ast.Name(id=stvar, ctx=ast.Load()))
+        if brk:
+            test = _call_expr("_sot_and", self._guard(brk, None), test)
+        pre = [ast.Assign(targets=[ast.Name(id=ivar, ctx=ast.Store())],
+                          value=lo),
+               ast.Assign(targets=[ast.Name(id=hivar, ctx=ast.Store())],
+                          value=hi),
+               ast.Assign(targets=[ast.Name(id=stvar, ctx=ast.Store())],
+                          value=st)]
+        if brk:
+            pre.append(_assign_const(brk, False))
+        self.changed = True
+        return pre + [ast.While(test=test, body=body, orelse=[])]
+
+
+def _range_args(rng: ast.Call):
+    """(lo, hi, step) AST expressions for a syntactic range() call."""
+    if len(rng.args) == 1:
+        return ast.Constant(value=0), rng.args[0], ast.Constant(value=1)
+    if len(rng.args) == 2:
+        return rng.args[0], rng.args[1], ast.Constant(value=1)
+    return rng.args[0], rng.args[1], rng.args[2]
+
+
 class _CFTransformer(ast.NodeTransformer):
     def __init__(self, fn_locals: set, filename: str):
         self.fn_locals = fn_locals
         self.filename = filename
         self.counter = 0
         self.changed = False
+        self._live = set()   # names read after the statement being visited
+
+    def transform_block(self, stmts, live_after):
+        """Visit a statement list threading backward liveness: when a
+        loop is converted, names its body stores that are read AFTER the
+        loop must ride the carry (or the conversion is declined) — a
+        read-before-write heuristic alone would hand back stale values."""
+        out = []
+        for idx, stmt in enumerate(stmts):
+            rest_loads = (_names(stmts[idx + 1:])[1] if idx + 1 < len(stmts)
+                          else set())
+            self._live = rest_loads | live_after
+            res = self.visit(stmt)
+            if isinstance(res, list):
+                out.extend(res)
+            elif res is not None:
+                out.append(res)
+        return out
 
     # never descend into nested function/class definitions
     def visit_FunctionDef(self, node):
@@ -336,7 +661,10 @@ class _CFTransformer(ast.NodeTransformer):
             keywords=[])
 
     def visit_If(self, node):
-        node = self.generic_visit(node)  # inner ifs/whiles first
+        live = self._live
+        node.body = self.transform_block(node.body, live)
+        node.orelse = self.transform_block(node.orelse, live)
+        self._live = live
         body_scan, else_scan = _scan(node.body), _scan(node.orelse)
         if body_scan.blocked or else_scan.blocked:
             return node
@@ -386,15 +714,28 @@ class _CFTransformer(ast.NodeTransformer):
         return [t_fn, f_fn, assign]
 
     def visit_While(self, node):
-        node = self.generic_visit(node)
+        live = self._live
+        _, test_loads = _names(node.test)
+        inner_live = live | _names(node.body)[1] | test_loads
+        node.body = self.transform_block(node.body, inner_live)
+        self._live = live
         if node.orelse:
             return node
+        if _names(node.test)[0]:
+            return node   # walrus in test: cfn can't surface the binding
         scan = _scan(node.body)
         if scan.blocked or scan.has_return:
             return node
         body_stores, _ = _names(node.body)
-        _, test_loads = _names(node.test)
-        carry = sorted((body_stores | (test_loads & self.fn_locals))
+        # carry = the genuinely loop-carried names: read-before-write in
+        # the body (accumulators), read by the test, or read AFTER the
+        # loop (live — must surface the final value).  Loop-LOCAL
+        # temporaries (written before read each iteration, dead after)
+        # stay local to the body function — threading them would demand
+        # a pre-loop definition that Python never required.
+        rbw = _reads_before_write(node.body)
+        carry = sorted(((body_stores & (rbw | live))
+                        | (test_loads & self.fn_locals))
                        & self.fn_locals)
         if not carry:
             return node
@@ -421,6 +762,65 @@ class _CFTransformer(ast.NodeTransformer):
                 keywords=[]))
         self.changed = True
         return [c_fn, b_fn, assign]
+
+    def visit_For(self, node):
+        """``for <name> in range(...)`` → _sot_for_range (while_loop for
+        traced bounds: one compilation serves every trip count);
+        ``for <name> in <expr>`` → _sot_for_iter (lax.scan for traced
+        arrays).  break/continue cases were already lowered to whiles by
+        the pre-pass; anything else unrollable stays plain Python."""
+        live = self._live
+        inner_live = live | _names(node.body)[1]
+        node.body = self.transform_block(node.body, inner_live)
+        self._live = live
+        if node.orelse or not isinstance(node.target, ast.Name):
+            return node
+        scan = _scan(node.body)
+        if scan.blocked or scan.has_return:
+            return node
+        tgt = node.target.id
+        if tgt in live:
+            # Python binds the target after the loop; a traced conversion
+            # cannot surface it — stay Python (loud graph-break if the
+            # bounds then turn out traced, never a silently stale value)
+            return node
+        body_stores, _ = _names(node.body)
+        # only genuine carries (see visit_While): loop temporaries stay
+        # local to the body function
+        rbw = _reads_before_write(node.body)
+        carry = sorted(((body_stores & (rbw | live)) - {tgt})
+                       & self.fn_locals)
+        if not carry:
+            return node
+        i = self.counter
+        self.counter += 1
+        bname = f"_sot_forbody_{i}"
+        loc = self._loc(node)
+        b_fn = self._make_fn(
+            bname, [tgt] + carry, node.body,
+            ast.Return(value=self._names_tuple(carry, ast.Load)))
+        common = [ast.Name(id=bname, ctx=ast.Load()),
+                  ast.Call(func=ast.Name(id="locals", ctx=ast.Load()),
+                           args=[], keywords=[]),
+                  ast.Tuple(elts=[ast.Constant(value=n) for n in carry],
+                            ctx=ast.Load()),
+                  ast.Constant(value=loc)]
+        rng = node.iter
+        if (isinstance(rng, ast.Call) and isinstance(rng.func, ast.Name)
+                and rng.func.id == "range" and not rng.keywords
+                and 1 <= len(rng.args) <= 3):
+            lo, hi, st = _range_args(rng)
+            call = ast.Call(func=ast.Name(id="_sot_for_range",
+                                          ctx=ast.Load()),
+                            args=[lo, hi, st] + common, keywords=[])
+        else:
+            call = ast.Call(func=ast.Name(id="_sot_for_iter",
+                                          ctx=ast.Load()),
+                            args=[node.iter] + common, keywords=[])
+        assign = ast.Assign(
+            targets=[self._names_tuple(carry, ast.Store)], value=call)
+        self.changed = True
+        return [b_fn, assign]
 
 
 def convert_control_flow(fn: Callable) -> Tuple[Callable, bool]:
@@ -454,21 +854,25 @@ def convert_control_flow(fn: Callable) -> Tuple[Callable, bool]:
         params.add(fdef.args.vararg.arg)
     if fdef.args.kwarg:
         params.add(fdef.args.kwarg.arg)
+    # pre-pass: break/continue → flag variables + guard ifs (while-ified
+    # range fors), so the main transformer sees plain convertible loops
+    bc = _LowerBreakContinue()
+    fdef.body = [bc.visit(s) if not isinstance(s, list) else s
+                 for s in fdef.body]
+    flat = []
+    for s in fdef.body:
+        flat.extend(s if isinstance(s, list) else [s])
+    fdef.body = flat
+    ast.fix_missing_locations(fdef)   # pre-pass nodes need linenos
+
     body_stores, _ = _names(fdef.body)
     fn_locals = params | body_stores
 
     tr = _CFTransformer(fn_locals, inspect.getfile(target))
-    # visit the body statements directly: the top-level def itself must not
-    # trip the nested-scope guard
-    new_body = []
-    for stmt in fdef.body:
-        res = tr.visit(stmt)
-        if isinstance(res, list):
-            new_body.extend(res)
-        elif res is not None:
-            new_body.append(res)
-    fdef.body = new_body
-    if not tr.changed:
+    # transform the body statements directly (the top-level def itself
+    # must not trip the nested-scope guard), threading backward liveness
+    fdef.body = tr.transform_block(fdef.body, set())
+    if not (tr.changed or bc.changed):
         return fn, False
     ast.fix_missing_locations(tree)
     try:
@@ -498,7 +902,10 @@ def convert_control_flow(fn: Callable) -> Tuple[Callable, bool]:
             except ValueError:
                 return fn, False  # unfilled cell (recursive def)
     ns.update(_sot_if=_sot_if, _sot_if_ret=_sot_if_ret,
-              _sot_while=_sot_while, _SOT_UNDEF=_SOT_UNDEF)
+              _sot_while=_sot_while, _SOT_UNDEF=_SOT_UNDEF,
+              _sot_not=_sot_not, _sot_or=_sot_or, _sot_and=_sot_and,
+              _sot_and_lazy=_sot_and_lazy, _sot_step_lt=_sot_step_lt,
+              _sot_for_range=_sot_for_range, _sot_for_iter=_sot_for_iter)
     exec(code, ns)
     new_fn = ns[fdef.name]
     if target.__defaults__ is not None:

@@ -275,3 +275,211 @@ class TestConvertFunction:
         f = make()
         g = to_static(f)
         np.testing.assert_allclose(g(jnp.asarray([2.0])), [6.0])
+
+
+class TestForConversion:
+    """round-4 (M95): for / break / continue + no-recompile guarantees
+    (VERDICT r3 missing #3 / next #5)."""
+
+    def test_for_range_traced_bound_single_trace(self):
+        traces = [0]
+
+        def f(x, n):
+            traces[0] += 1
+            s = jnp.zeros(())
+            for i in range(n):
+                s = s + x[i] * (i + 1)
+            return s
+
+        g = to_static(f)
+        x = jnp.arange(8.0)
+        for n in (3, 5, 8, 2):
+            want = sum(float(x[i]) * (i + 1) for i in range(n))
+            np.testing.assert_allclose(float(g(x, n)), want, rtol=1e-6)
+        # the guard-cache property, jax-style: the bound is a traced
+        # input of ONE while_loop program — new n values do NOT retrace
+        assert traces[0] == 1, traces[0]
+
+    def test_decode_loop_with_eos_break(self):
+        traces = [0]
+
+        def decode(toks, eos, n):
+            traces[0] += 1
+            count = jnp.zeros((), jnp.int32)
+            for step in range(n):
+                t = toks[step]
+                if t == eos:
+                    break
+                count = count + 1
+            return count
+
+        d = to_static(decode)
+        toks = jnp.asarray([3, 1, 4, 1, 5, 9, 2, 6])
+        assert int(d(toks, 4, 8)) == 2
+        assert int(d(toks, 9, 8)) == 5
+        assert int(d(toks, 99, 8)) == 8   # EOS never fires
+        assert int(d(toks, 99, 5)) == 5   # shorter budget, same trace
+        assert traces[0] == 1, traces[0]
+
+    def test_continue_lowered(self):
+        def pos_sum(x):
+            s = jnp.zeros(())
+            for i in range(6):
+                v = x[i]
+                if v < 0:
+                    continue
+                s = s + v
+            return s
+
+        p = to_static(pos_sum)
+        xv = jnp.asarray([1.0, -2.0, 3.0, -4.0, 5.0, -6.0])
+        assert float(p(xv)) == 9.0
+
+    def test_while_break_on_traced_pred(self):
+        def wb(x):
+            i = jnp.zeros((), jnp.int32)
+            s = jnp.zeros(())
+            while i < 10:
+                if x[i] > 3:
+                    break
+                s = s + x[i]
+                i = i + 1
+            return s
+
+        w = to_static(wb)
+        arr = jnp.asarray([1., 2., 3., 4., 0., 0., 0., 0., 0., 0., 0.])
+        assert float(w(arr)) == 6.0
+
+    def test_for_over_traced_array_scans(self):
+        def fa(xs):
+            s = jnp.zeros(())
+            for row in xs:
+                s = s + row.max()
+            return s
+
+        a = to_static(fa)
+        m = jnp.asarray([[1., 2.], [5., 3.], [0., 4.]])
+        assert float(a(m)) == 11.0
+
+    def test_concrete_loop_keeps_python_semantics(self):
+        def conc(x, n):
+            s = 0.0
+            for i in range(n):
+                if i == 2:
+                    continue
+                s = s + float(i)
+            return s + float(x[0]) * 0
+
+        c2, ok = convert_control_flow(conc)
+        assert ok
+        assert c2(np.ones(1), 5) == 8.0   # 0+1+3+4 — i==2 skipped
+
+    def test_static_bool_arg_traces_at_most_twice(self):
+        """Concrete-predicate guard behavior: with the branch value a
+        static argument, jit's value-keyed cache IS the guard cache —
+        many calls, at most one trace per distinct branch outcome."""
+        traces = [0]
+
+        def f(x, flag):
+            traces[0] += 1
+            if flag:
+                y = x * 2
+            else:
+                y = x - 1
+            return y
+
+        g = to_static(f, static_argnums=(1,))
+        for flag in (True, False, True, False, True, True, False):
+            expect = 2.0 if flag else 0.0
+            np.testing.assert_allclose(float(g(jnp.ones(()), flag)), expect)
+        assert traces[0] == 2, traces[0]
+
+    def test_break_in_nested_loop_stays_inner(self):
+        def f(x):
+            total = jnp.zeros(())
+            for i in range(3):
+                for j in range(4):
+                    if x[i, j] < 0:
+                        break
+                    total = total + x[i, j]
+            return total
+
+        g = to_static(f)
+        m = jnp.asarray([[1., 2., -1., 9.],   # stops after 1+2
+                         [5., -1., 9., 9.],   # stops after 5
+                         [1., 1., 1., 1.]])   # full row
+        assert float(g(m)) == 12.0
+
+
+class TestLoopLivenessAndSemantics:
+    """round-4 review findings: liveness-carried values, once-evaluated
+    range bounds, short-circuit test after break, traced zero step."""
+
+    def test_body_store_read_after_loop_is_carried(self):
+        def f(x):
+            y = -1.0
+            i = jnp.zeros((), jnp.int32)
+            while i < 3:
+                y = x * i
+                i = i + 1
+            return y
+
+        g, ok = convert_control_flow(f)
+        assert ok
+        assert float(g(jnp.asarray(2.0))) == 4.0  # x*2, not the stale -1
+
+    def test_for_target_read_after_loop_stays_python(self):
+        def f(x, n):
+            s = jnp.zeros(())
+            for i in range(n):
+                s = s + x[i]
+            return s, i   # Python binds i after the loop
+
+        g, ok = convert_control_flow(f)
+        s, i = g(jnp.arange(4.0), 3)   # concrete n: Python semantics
+        assert float(s) == 3.0 and i == 2
+
+    def test_range_bounds_evaluated_once(self):
+        def f(x):
+            n = 4
+            total = 0
+            for i in range(n):
+                n = 0          # must NOT affect the already-built range
+                total = total + 1
+                if x[0] < -99:
+                    break      # forces the while-lowering path
+            return total
+
+        g, ok = convert_control_flow(f)
+        assert ok
+        assert g(np.ones(1)) == 4
+
+    def test_break_does_not_rerun_side_effecting_test(self):
+        def f(xs):
+            calls = []
+            i = 0
+            v = None
+            while (v := (xs[i] if i < len(xs) else None)) is not None:
+                calls.append(v)
+                if v == 2:
+                    break
+                i = i + 1
+            return v, len(calls)
+
+        g, ok = convert_control_flow(f)
+        # a walrus-binding test DECLINES conversion (relocating it would
+        # swallow the binding or re-run the side effect) — behavior must
+        # be exactly Python's either way
+        v, n = g([1, 2, 3])
+        assert v == 2 and n == 2   # the test never re-ran after break
+
+    def test_traced_zero_step_terminates(self):
+        def f(x, st):
+            s = jnp.zeros(())
+            for i in range(5, 0, st):
+                s = s + x[i]
+            return s
+
+        g = to_static(f)
+        assert float(g(jnp.arange(6.0), -1)) == 5 + 4 + 3 + 2 + 1
+        assert float(g(jnp.arange(6.0), 0)) == 0.0   # exits, no hang
