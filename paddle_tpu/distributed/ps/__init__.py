@@ -85,10 +85,19 @@ class PsRuntime:
 
     def __init__(self, role: PaddleCloudRoleMaker,
                  configs: Sequence[TableConfig],
-                 master_endpoint: Optional[str] = None):
+                 master_endpoint: Optional[str] = None,
+                 snapshot_dir: Optional[str] = None,
+                 snapshot_every: int = 0):
         self.role = role
         self.configs = list(configs)
         self.master_endpoint = master_endpoint
+        # server-side fault tolerance (reference: PS table snapshots,
+        # SURVEY §5.3): PDTPU_PS_SNAPSHOT_DIR / _EVERY mirror the args so
+        # launch scripts can turn it on without code changes
+        self.snapshot_dir = snapshot_dir or os.environ.get(
+            "PDTPU_PS_SNAPSHOT_DIR") or None
+        self.snapshot_every = int(snapshot_every or os.environ.get(
+            "PDTPU_PS_SNAPSHOT_EVERY", "0"))
         self.client: Optional[PsClient] = None
         self._service: Optional[PsService] = None
         self._stop = threading.Event()
@@ -109,9 +118,13 @@ class PsRuntime:
         rpc.init_rpc(name, rank=rank, world_size=self._world(),
                      master_endpoint=self.master_endpoint)
 
-    def init_server(self) -> None:
+    def init_server(self, dirname: Optional[str] = None) -> None:
+        """``dirname`` warm-starts from that snapshot dir (reference:
+        fleet.init_server(dirname) loads a saved model)."""
         from . import service as _service_mod
-        self._service = PsService(self.configs, self.role.server_id)
+        self._service = PsService(self.configs, self.role.server_id,
+                                  snapshot_dir=dirname or self.snapshot_dir,
+                                  snapshot_every=self.snapshot_every)
         _install_service(self._service)
         _service_mod._RUNTIME_STOP = self._stop
         self._rpc_init(f"ps{self.role.server_id}", self.role.server_id)

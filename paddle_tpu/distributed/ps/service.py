@@ -15,6 +15,9 @@ mode and tests; the wire transport rides rpc_sync to named ps workers.
 from __future__ import annotations
 
 import concurrent.futures as cf
+import json
+import os
+import threading
 import zlib
 from typing import Dict, List, Optional, Sequence
 
@@ -52,11 +55,87 @@ class TableConfig:
 
 
 class PsService:
-    """Server-side table host. Methods are the RPC surface."""
+    """Server-side table host. Methods are the RPC surface.
 
-    def __init__(self, configs: Sequence[TableConfig], server_rank: int = 0):
+    Fault tolerance (reference: the PS table snapshot path —
+    fleet.save_one_table / server-side checkpointing, SURVEY §5.3 "PS
+    mode has server-side fault tolerance"): with ``snapshot_dir`` set the
+    server persists every table every ``snapshot_every`` pushes (atomic
+    tmp+rename npz per table, manifest written last) and a RESTARTED
+    server with the same dir resumes from the latest snapshot — a killed
+    table server loses at most the pushes since the last snapshot."""
+
+    def __init__(self, configs: Sequence[TableConfig], server_rank: int = 0,
+                 snapshot_dir: Optional[str] = None,
+                 snapshot_every: int = 0):
         self.server_rank = server_rank
         self.tables: Dict[str, object] = {c.name: c.build() for c in configs}
+        self.snapshot_dir = snapshot_dir
+        self.snapshot_every = int(snapshot_every)
+        self._push_count = 0
+        self._snap_lock = threading.Lock()
+        if snapshot_dir:
+            self.load_snapshot()   # warm-start if a snapshot exists
+
+    # ---- snapshot / restore ------------------------------------------
+    def _snap_path(self, dirname=None) -> str:
+        d = dirname or self.snapshot_dir
+        if not d:
+            raise ValueError("no snapshot_dir configured")
+        return os.path.join(d, f"server{self.server_rank}")
+
+    def save_snapshot(self, dirname: Optional[str] = None) -> str:
+        """Atomically persist every table; returns the snapshot dir."""
+        root = self._snap_path(dirname)
+        os.makedirs(root, exist_ok=True)
+        with self._snap_lock:
+            names = []
+            for name, table in self.tables.items():
+                state = table.state_dict()
+                arrays = {k: v for k, v in state.items()
+                          if isinstance(v, np.ndarray)}
+                scalars = {k: v for k, v in state.items()
+                           if not isinstance(v, np.ndarray) and v is not None}
+                tmp = os.path.join(root, f"{name}.npz.tmp")
+                with open(tmp, "wb") as f:
+                    np.savez(f, __scalars__=json.dumps(scalars), **arrays)
+                os.replace(tmp, os.path.join(root, f"{name}.npz"))
+                names.append(name)
+            manifest = {"tables": names, "push_count": self._push_count,
+                        "server_rank": self.server_rank}
+            tmp = os.path.join(root, "manifest.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump(manifest, f)
+            os.replace(tmp, os.path.join(root, "manifest.json"))
+        return root
+
+    def load_snapshot(self, dirname: Optional[str] = None) -> bool:
+        """Restore from the latest snapshot; False if none exists."""
+        root = self._snap_path(dirname)
+        mpath = os.path.join(root, "manifest.json")
+        if not os.path.exists(mpath):
+            return False
+        with open(mpath) as f:
+            manifest = json.load(f)
+        for name in manifest["tables"]:
+            if name not in self.tables:
+                continue   # config changed since the snapshot
+            with np.load(os.path.join(root, f"{name}.npz"),
+                         allow_pickle=False) as z:
+                state = {k: z[k] for k in z.files if k != "__scalars__"}
+                if "__scalars__" in z.files:
+                    state.update(json.loads(str(z["__scalars__"])))
+            # savez stores None-valued entries as absent: normalize
+            state.setdefault("slots", None)
+            self.tables[name].load_state_dict(state)
+        self._push_count = int(manifest.get("push_count", 0))
+        return True
+
+    def _maybe_snapshot(self) -> None:
+        self._push_count += 1
+        if (self.snapshot_dir and self.snapshot_every
+                and self._push_count % self.snapshot_every == 0):
+            self.save_snapshot()
 
     def _sparse(self, name) -> SparseTable:
         t = self.tables[name]
@@ -76,15 +155,18 @@ class PsService:
 
     def push_dense(self, name, grad):
         self._dense(name).push(grad)
+        self._maybe_snapshot()
 
     def pull_sparse(self, name, keys):
         return self._sparse(name).pull(keys)
 
     def push_sparse(self, name, keys, grads):
         self._sparse(name).push(keys, grads)
+        self._maybe_snapshot()
 
     def push_sparse_delta(self, name, keys, deltas):
         self._sparse(name).push_delta(keys, deltas)
+        self._maybe_snapshot()
 
     def state_dict(self):
         return {n: t.state_dict() for n, t in self.tables.items()}

