@@ -36,7 +36,8 @@ from .nn.layer import ParamAttr  # noqa: F401
 
 # paddle.* tensor-op namespace parity: re-export the ops module surface.
 from .ops import *  # noqa: F401,F403
-from .ops import linalg, fft  # noqa: F401
+# linalg/fft as real importable modules (reference: python/paddle/linalg.py)
+from . import linalg, fft  # noqa: F401
 
 # random ops at top level (paddle.rand / paddle.normal / ...)
 from .ops import (rand, randn, randint, uniform, normal, randperm,  # noqa: F401
@@ -70,7 +71,7 @@ def __getattr__(name):
                 "vision", "incubate", "hapi", "static", "device", "launch",
                 "utils", "config", "sparse", "quantization", "inference",
                 "audio", "distribution", "geometric", "signal", "regularizer",
-                "callbacks"):
+                "callbacks", "text", "hub", "onnx"):
         try:
             mod = importlib.import_module(f".{name}", __name__)
         except ModuleNotFoundError as e:
@@ -121,6 +122,7 @@ def __dir__():
         "vision", "incubate", "hapi", "static", "device", "launch", "utils",
         "config", "sparse", "quantization", "inference", "audio",
         "distribution", "geometric", "signal", "regularizer", "callbacks",
+        "text", "hub", "onnx",
         "Model", "DataParallel", "flops", "summary", "version", "metric",
         "enable_static", "disable_static", "in_dynamic_mode"})
 
@@ -168,7 +170,76 @@ def set_grad_enabled(mode: bool):
 
 # the Place CLASSES themselves (isinstance works, like DataParallel above);
 # CUDAPlace/XPUPlace alias the accelerator place — the accelerator is the TPU
-from .device import CPUPlace, TPUPlace  # noqa: F401,E402
+from .device import CPUPlace, CUDAPinnedPlace, TPUPlace  # noqa: F401,E402
 
 CUDAPlace = TPUPlace
 XPUPlace = TPUPlace
+
+# dtype OBJECTS at the top level (reference: paddle.bool / paddle.complex64
+# / paddle.dtype).  `bool` intentionally shadows the builtin inside this
+# namespace only, exactly as the reference does; `dtype` is the type of a
+# Tensor's .dtype attribute so `isinstance(x.dtype, paddle.dtype)` ports.
+import numpy as _np  # noqa: E402
+
+bool = bool_  # noqa: A001
+complex64 = _np.complex64
+complex128 = _np.complex128
+dtype = _np.dtype
+
+
+def enable_grad():
+    return autograd.enable_grad()
+
+
+# CUDA-prefixed rng-state API: the reference keeps separate host/device rng
+# streams; here one global stream drives both (documented deviation)
+def get_cuda_rng_state():
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    return set_rng_state(state)
+
+
+def to_dlpack(x):
+    """Reference: paddle.utils.dlpack.to_dlpack / paddle.to_dlpack.
+
+    DLPack is a host/GPU interchange protocol; TPU HBM buffers are not
+    dlpack-addressable, so device arrays are staged through host memory
+    first (one copy — same as the reference's GPU→consumer-on-CPU path).
+    Returns a modern-protocol exporter object (implements ``__dlpack__``),
+    which every current consumer (``torch.from_dlpack``,
+    ``np.from_dlpack``, this module's ``from_dlpack``) accepts; the legacy
+    raw-capsule form is not produced."""
+    if isinstance(x, _jax.Array):
+        try:
+            x.__dlpack_device__()  # raises for TPU-resident buffers
+            return x
+        except Exception:  # BufferError / runtime UNIMPLEMENTED
+            # depending on the PJRT plugin: stage via host.  np.asarray on
+            # a jax array yields a readonly view — copy so export works.
+            return _np.array(x)
+    return _np.asarray(x)
+
+
+def from_dlpack(ext_array):
+    """Accepts any object implementing the DLPack exchange protocol
+    (``__dlpack__``/``__dlpack_device__``) — torch/NumPy/jax arrays or
+    the object ``to_dlpack`` returns."""
+    from jax import dlpack as _dl
+    return _dl.from_dlpack(ext_array)
+
+
+def LazyGuard():
+    """Reference: paddle.LazyGuard — construct layers without materialising
+    parameters.  TPU-native analogue: nn.layer.meta_init() (parameters
+    become ShapeDtypeStructs; lower/compile works, eager exec does not)."""
+    from .nn.layer import meta_init
+    return meta_init()
+
+
+# paddle Tensor METHOD surface (x.abs(), x.unsqueeze(0), x.add_(y), ...)
+# installed onto jax.Array + Tracer — see core/tensor_methods.py
+from .core import tensor_methods as _tensor_methods  # noqa: E402
+
+_tensor_methods.install()

@@ -154,3 +154,14 @@ class MFCC:
 
 
 __all__ += ["power_to_db", "create_dct", "LogMelSpectrogram", "MFCC"]
+
+
+# paddle.audio submodule structure (reference: python/paddle/audio/)
+from . import backends  # noqa: E402,F401
+from . import features  # noqa: E402,F401
+from . import functional  # noqa: E402,F401
+from . import datasets  # noqa: E402,F401
+from .backends import info, load, save  # noqa: E402,F401
+
+__all__ += ["backends", "features", "functional", "datasets", "info",
+            "load", "save"]

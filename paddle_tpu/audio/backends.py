@@ -1,0 +1,101 @@
+"""paddle_tpu.audio.backends — WAV IO on the Python stdlib.
+
+Reference: python/paddle/audio/backends/ (soundfile/wave backends).  The
+stdlib ``wave`` backend covers PCM WAV load/save/info with zero extra
+dependencies; other formats raise with a clear message.
+"""
+
+from __future__ import annotations
+
+import wave
+
+import numpy as np
+
+
+class AudioInfo:
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+    def __repr__(self):
+        return (f"AudioInfo(sample_rate={self.sample_rate}, "
+                f"num_samples={self.num_samples}, "
+                f"num_channels={self.num_channels}, "
+                f"bits_per_sample={self.bits_per_sample})")
+
+
+def list_available_backends():
+    return ["wave"]
+
+
+def get_current_backend():
+    return "wave"
+
+
+def set_backend(backend_name: str):
+    if backend_name not in ("wave",):
+        raise ValueError("only the stdlib 'wave' backend is available "
+                         "(PCM WAV); transcode other formats on the "
+                         "dataloader side")
+
+
+def info(filepath: str) -> AudioInfo:
+    with wave.open(filepath, "rb") as w:
+        return AudioInfo(w.getframerate(), w.getnframes(), w.getnchannels(),
+                         8 * w.getsampwidth())
+
+
+def load(filepath: str, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Returns (tensor, sample_rate); float32 in [-1, 1] when normalize."""
+    import jax.numpy as jnp
+    with wave.open(filepath, "rb") as w:
+        sr = w.getframerate()
+        n = w.getnframes()
+        ch = w.getnchannels()
+        width = w.getsampwidth()
+        w.setpos(int(frame_offset))
+        count = n - int(frame_offset) if num_frames < 0 else int(num_frames)
+        raw = w.readframes(count)
+    if width == 3:
+        # 24-bit PCM: unpack 3-byte little-endian signed ints
+        b = np.frombuffer(raw, np.uint8).reshape(-1, 3)
+        data = (b[:, 0].astype(np.int32)
+                | (b[:, 1].astype(np.int32) << 8)
+                | (b[:, 2].astype(np.int32) << 16))
+        data = np.where(data >= 1 << 23, data - (1 << 24), data)
+        data = data.reshape(-1, ch)
+    elif width in (1, 2, 4):
+        dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+        data = np.frombuffer(raw, dtype).reshape(-1, ch)
+    else:
+        raise ValueError(f"audio.load: unsupported PCM sample width "
+                         f"{width * 8} bits (supported: 8/16/24/32)")
+    if normalize:
+        if width == 1:
+            data = (data.astype(np.float32) - 128.0) / 128.0
+        else:
+            data = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    out = data.T if channels_first else data
+    return jnp.asarray(out), sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first=True,
+         bits_per_sample=16):
+    if bits_per_sample != 16:
+        raise ValueError("wave backend writes 16-bit PCM")
+    data = np.asarray(src)
+    if channels_first:
+        data = data.T
+    if data.dtype.kind == "f":
+        data = np.clip(data, -1.0, 1.0)
+        data = (data * 32767.0).astype(np.int16)
+    with wave.open(filepath, "wb") as w:
+        w.setnchannels(data.shape[1] if data.ndim > 1 else 1)
+        w.setsampwidth(2)
+        w.setframerate(int(sample_rate))
+        w.writeframes(data.astype(np.int16).tobytes())

@@ -1,0 +1,79 @@
+"""paddle_tpu.audio.datasets — reference: python/paddle/audio/datasets/
+(TESS, ESC50).
+
+Zero-egress environment: datasets read from a local ``data_dir`` laid out
+as the upstream archives extract (no downloads); a missing directory
+raises with the expected layout in the message.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..io import Dataset
+from . import backends
+
+
+class _FolderAudioDataset(Dataset):
+    """Audio files under class-encoding filenames, label parsed per
+    subclass rule."""
+
+    def __init__(self, data_dir, mode="train", feat_type="raw", **kw):
+        if not data_dir or not os.path.isdir(data_dir):
+            raise FileNotFoundError(
+                f"{type(self).__name__}: pass data_dir pointing at the "
+                f"extracted archive (downloads are disabled in this "
+                f"environment); got {data_dir!r}")
+        self.mode = mode
+        self.feat_type = feat_type
+        self.files, self.labels = self._index(data_dir)
+
+    def _index(self, data_dir):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.files)
+
+    def __getitem__(self, idx):
+        wav, sr = backends.load(self.files[idx])
+        return wav, self.labels[idx]
+
+
+class TESS(_FolderAudioDataset):
+    """Toronto Emotional Speech Set: WAV files named *_<emotion>.wav in
+    per-speaker folders."""
+
+    EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+
+    def _index(self, data_dir):
+        files, labels = [], []
+        for root, _, names in sorted(os.walk(data_dir)):
+            for n in sorted(names):
+                if not n.lower().endswith(".wav"):
+                    continue
+                emo = n.rsplit("_", 1)[-1][:-4].lower()
+                if emo in self.EMOTIONS:
+                    files.append(os.path.join(root, n))
+                    labels.append(self.EMOTIONS.index(emo))
+        return files, labels
+
+
+class ESC50(_FolderAudioDataset):
+    """ESC-50 environmental sounds: files named F-C-T-L.wav where L is
+    the class id; fold F==5 is the validation split."""
+
+    def _index(self, data_dir):
+        files, labels = [], []
+        want_valid = self.mode != "train"
+        for root, _, names in sorted(os.walk(data_dir)):
+            for n in sorted(names):
+                if not n.lower().endswith(".wav"):
+                    continue
+                parts = n[:-4].split("-")
+                if len(parts) != 4:
+                    continue
+                fold, label = int(parts[0]), int(parts[3])
+                if (fold == 5) == want_valid:
+                    files.append(os.path.join(root, n))
+                    labels.append(label)
+        return files, labels

@@ -1,0 +1,5 @@
+"""paddle_tpu.audio.features — reference:
+python/paddle/audio/features/layers.py (the feature-extraction Layers)."""
+
+from . import (MFCC, LogMelSpectrogram, MelSpectrogram,  # noqa: F401
+               Spectrogram)

@@ -1,0 +1,39 @@
+"""paddle_tpu.audio.functional — reference:
+python/paddle/audio/functional/ (window/fbank/dct/db helpers)."""
+
+from . import (compute_fbank_matrix, create_dct,  # noqa: F401
+               get_window, mel_frequencies, power_to_db)
+
+
+def hz_to_mel(freq, htk=False):
+    """Reference: paddle.audio.functional.hz_to_mel (Slaney by default)."""
+    import numpy as np
+    f = np.asarray(freq, np.float64)
+    if htk:
+        return 2595.0 * np.log10(1.0 + f / 700.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (f - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = np.log(6.4) / 27.0
+    return np.where(f >= min_log_hz,
+                    min_log_mel + np.log(f / min_log_hz) / logstep, mels)
+
+
+def mel_to_hz(mel, htk=False):
+    import numpy as np
+    m = np.asarray(mel, np.float64)
+    if htk:
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * m
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = np.log(6.4) / 27.0
+    return np.where(m >= min_log_mel,
+                    min_log_hz * np.exp(logstep * (m - min_log_mel)), freqs)
+
+
+def fft_frequencies(sr, n_fft):
+    import numpy as np
+    return np.linspace(0, sr / 2.0, 1 + n_fft // 2)

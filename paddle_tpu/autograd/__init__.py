@@ -102,6 +102,33 @@ def set_grad_enabled(mode: bool):
     return _GradMode(mode)
 
 
+def enable_grad():
+    """Reference: paddle.enable_grad — re-enable grad inside a no_grad
+    region (context manager, same flag no_grad toggles)."""
+    return _GradMode(True)
+
+
+# saved_tensors_hooks (reference: paddle.autograd.saved_tensors_hooks —
+# python/paddle/autograd/saved_tensors_hooks.py).  The hooks wrap what
+# PyLayer.ctx.save_for_backward stores: pack_hook runs at save time,
+# unpack_hook when the backward reads it — the same contract the reference
+# uses for CPU-offload / recompute of residuals.
+_saved_tensor_hooks = []
+
+
+class saved_tensors_hooks:
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook, self.unpack_hook = pack_hook, unpack_hook
+
+    def __enter__(self):
+        _saved_tensor_hooks.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _saved_tensor_hooks.pop()
+        return False
+
+
 class PyLayer:
     """``paddle.autograd.PyLayer`` parity on ``jax.custom_vjp``.
 
@@ -113,11 +140,19 @@ class PyLayer:
     class _Ctx:
         def __init__(self):
             self.saved = ()
+            # hook pair captured at SAVE time (reference semantics: the
+            # unpack hook applies at backward even after the `with` exits)
+            self._hooks = _saved_tensor_hooks[-1] if _saved_tensor_hooks \
+                else None
 
         def save_for_backward(self, *tensors):
+            if self._hooks is not None:
+                tensors = tuple(self._hooks[0](t) for t in tensors)
             self.saved = tensors
 
         def saved_tensor(self):
+            if self._hooks is not None:
+                return tuple(self._hooks[1](t) for t in self.saved)
             return self.saved
 
     @classmethod
@@ -132,14 +167,24 @@ class PyLayer:
             out = cls.forward(ctx, *xs)
             return out, ctx.saved
 
+        # hook pair active at apply() time rides the closure so backward
+        # unpacks with it even after the `with saved_tensors_hooks` exits
+        hooks = _saved_tensor_hooks[-1] if _saved_tensor_hooks else None
+
         def bwd(saved, g):
             ctx = cls._Ctx()
+            ctx._hooks = hooks
             ctx.saved = saved
             grads = cls.backward(ctx, g)
             return grads if isinstance(grads, tuple) else (grads,)
 
         f.defvjp(fwd, bwd)
         return f(*args)
+
+
+# reference: paddle.autograd.PyLayerContext — the ctx object forward/
+# backward receive; exposed so `isinstance(ctx, PyLayerContext)` works
+PyLayerContext = PyLayer._Ctx
 
 
 def backward(tensors, grad_tensors=None):  # pragma: no cover - guidance only

@@ -22,7 +22,7 @@ from ..core import (device_count, get_device, is_compiled_with_cuda,  # noqa: F4
 __all__ = ["set_device", "get_device", "device_count", "local_device_count",
            "synchronize", "Stream", "Event", "current_stream",
            "is_compiled_with_cuda", "is_compiled_with_tpu", "XPUPlace",
-           "CPUPlace", "TPUPlace", "get_available_device"]
+           "CPUPlace", "TPUPlace", "CUDAPinnedPlace", "get_available_device"]
 
 
 def get_available_device() -> str:
@@ -43,6 +43,16 @@ class CPUPlace:
 
 
 XPUPlace = TPUPlace  # accelerator place alias for ported scripts
+
+
+class CUDAPinnedPlace:
+    """Reference: paddle.CUDAPinnedPlace — page-locked host staging memory.
+    On TPU the analogue is host memory XLA stages transfers from; arrays
+    placed here live on the host (``memory_kind='pinned_host'`` where the
+    runtime supports it, plain host otherwise)."""
+
+    def __repr__(self):
+        return "CUDAPinnedPlace()"
 
 
 class Event:

@@ -53,11 +53,22 @@ from . import stream  # noqa: F401
 # with cross-topology reshard-on-load
 from ..ckpt import load_state_dict, save_state_dict  # noqa: F401
 
+# round-4 tail: object collectives, gloo host group, ParallelEnv,
+# Placement, split/shard_optimizer/unshard_dtensor — see misc.py
+from .misc import (  # noqa: F401
+    ParallelEnv, Placement, Strategy, all_gather_object,
+    broadcast_object_list, destroy_process_group, get_backend, get_group,
+    gloo_barrier, gloo_init_parallel_env, gloo_release, is_available,
+    scatter_object_list, shard_optimizer, split, unshard_dtensor, wait)
+
 
 def __getattr__(name):
     if name == "checkpoint":  # paddle.distributed.checkpoint module alias
         from .. import ckpt
         return ckpt
+    if name == "launch":  # paddle.distributed.launch module alias
+        from .. import launch
+        return launch
     raise AttributeError(f"module 'paddle_tpu.distributed' has no attribute {name!r}")
 
 

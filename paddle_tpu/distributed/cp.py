@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional
 
 import jax
@@ -216,7 +217,13 @@ def ring_attention(q, k, v, causal=False, scale=None, axis="sep", mesh=None,
             (q.shape[0], q.shape[1] // n) + q.shape[2:], q.dtype)
         kv_chunk = jax.ShapeDtypeStruct(
             (k.shape[0], k.shape[1] // n) + k.shape[2:], k.dtype)
+        # on-chip chunk A/B (tools/ring_chunk_bench.py, BENCH.md §ring):
+        # the kernel wins 4-5x at chunk >= 2048 but its fixed costs lose
+        # to the einsum online-softmax step below that — long context
+        # (the regime ring exists for) is exactly the >= 2048 side
+        min_chunk = int(os.environ.get("PDTPU_RING_FLASH_MIN_CHUNK", 2048))
         use_flash = (_dispatch.get("flash_attention") is not None
+                     and q.shape[1] // n >= min_chunk
                      and _fa.supported(q_chunk, kv_chunk, kv_chunk,
                                        causal=False))
     inner = _ring_inner_flash if use_flash else _ring_inner

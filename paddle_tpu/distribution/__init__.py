@@ -459,3 +459,14 @@ from .tail3 import (  # noqa: E402,F401
     Multinomial, MultivariateNormal, Poisson, PowerTransform,
     SigmoidTransform, StudentT, TanhTransform, Transform,
     TransformedDistribution)
+# round-4 tail (remaining transforms, ChiSquared/Independent/LKJCholesky)
+from .tail4 import (  # noqa: E402,F401
+    AbsTransform, ChiSquared, Independent, IndependentTransform,
+    LKJCholesky, ReshapeTransform, SoftmaxTransform, StackTransform,
+    StickBreakingTransform)
+
+# __all__ covers the full surface (the api-compat spec reads it); keep it
+# in sync by construction rather than by hand
+__all__ = sorted(n for n in dir() if not n.startswith("_")
+                 and n not in ("annotations", "jax", "jnp", "math",
+                               "tail3", "tail4", "Optional"))

@@ -1,0 +1,269 @@
+"""Round-4 distribution tail: remaining transforms + ChiSquared /
+Independent / LKJCholesky.
+
+Reference: python/paddle/distribution/{transform,independent,lkj_cholesky}.py
+(SURVEY §2.6).  Oracle tests (torch.distributions) in
+tests/test_distribution_tail4.py.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from . import Beta, Distribution, _next_key
+from .tail3 import Chi2, Transform
+
+
+class ChiSquared(Chi2):
+    """Reference spells Gamma(df/2, 1/2) both Chi2 and ChiSquared."""
+
+
+# ---------------------------------------------------------------------------
+# transforms
+# ---------------------------------------------------------------------------
+
+class AbsTransform(Transform):
+    """y = |x|.  Not bijective: inverse picks the positive branch (the
+    reference does the same) and the log-det is undefined."""
+
+    def forward(self, x):
+        return jnp.abs(x)
+
+    def inverse(self, y):
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError(
+            "AbsTransform is not bijective — no log-det jacobian")
+
+
+class IndependentTransform(Transform):
+    """Reinterpret the trailing ``reinterpreted_batch_rank`` dims of the
+    base transform's log-det as event dims (summed)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        ld = self.base.forward_log_det_jacobian(x)
+        return jnp.sum(ld, axis=tuple(range(-self.rank, 0)))
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        if math.prod(self.in_event_shape) != math.prod(self.out_event_shape):
+            raise ValueError("ReshapeTransform: element counts differ")
+
+    def forward(self, x):
+        x = jnp.asarray(x)
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def inverse(self, y):
+        y = jnp.asarray(y)
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def forward_log_det_jacobian(self, x):
+        x = jnp.asarray(x)
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last axis.  Not bijective (softmax is
+    shift-invariant): inverse returns log(y), the reference convention."""
+
+    def forward(self, x):
+        return jax.nn.softmax(jnp.asarray(x), axis=-1)
+
+    def inverse(self, y):
+        return jnp.log(jnp.asarray(y))
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError(
+            "SoftmaxTransform is not bijective — no log-det jacobian")
+
+
+class StackTransform(Transform):
+    """Apply transforms[i] to slice i along ``axis``."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _map(self, method, x):
+        parts = [getattr(t, method)(xi) for t, xi in zip(
+            self.transforms,
+            jnp.split(jnp.asarray(x), len(self.transforms), self.axis))]
+        return jnp.concatenate(parts, axis=self.axis)
+
+    def forward(self, x):
+        return self._map("forward", x)
+
+    def inverse(self, y):
+        return self._map("inverse", y)
+
+    def forward_log_det_jacobian(self, x):
+        return self._map("forward_log_det_jacobian", x)
+
+
+class StickBreakingTransform(Transform):
+    """R^K → interior of the (K+1)-simplex by stick breaking.
+
+    z_i = sigmoid(x_i - log(K - i)); y_i = z_i · prod_{j<i}(1 - z_j);
+    the final element is the remaining stick.  The log(K-i) offset makes
+    x = 0 map to the uniform simplex point (reference/torch convention).
+    """
+
+    def forward(self, x):
+        x = jnp.asarray(x)
+        K = x.shape[-1]
+        offset = jnp.log(jnp.arange(K, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        zpad = jnp.concatenate([jnp.zeros_like(z[..., :1]), z], axis=-1)
+        rest = jnp.cumprod(1.0 - zpad, axis=-1)        # prod_{j<i}(1-z_j)
+        y_head = z * rest[..., :-1]
+        return jnp.concatenate([y_head, rest[..., -1:]], axis=-1)
+
+    def inverse(self, y):
+        y = jnp.asarray(y)
+        K = y.shape[-1] - 1
+        csum = jnp.cumsum(y[..., :-1], axis=-1)
+        remaining = 1.0 - jnp.concatenate(
+            [jnp.zeros_like(csum[..., :1]), csum[..., :-1]], axis=-1)
+        z = y[..., :-1] / remaining
+        offset = jnp.log(jnp.arange(K, 0, -1, dtype=y.dtype))
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def forward_log_det_jacobian(self, x):
+        x = jnp.asarray(x)
+        K = x.shape[-1]
+        offset = jnp.log(jnp.arange(K, 0, -1, dtype=x.dtype))
+        xo = x - offset
+        z = jax.nn.sigmoid(xo)
+        zpad = jnp.concatenate([jnp.zeros_like(z[..., :1]), z[..., :-1]],
+                               axis=-1)
+        log_rest = jnp.cumsum(jnp.log1p(-zpad), axis=-1)
+        # d y_i / d x_i = z_i (1 - z_i) · prod_{j<i}(1 - z_j)
+        return jnp.sum(-jax.nn.softplus(-xo) - jax.nn.softplus(xo)
+                       + log_rest, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Independent
+# ---------------------------------------------------------------------------
+
+class Independent(Distribution):
+    """Reference: paddle.distribution.Independent — reinterpret the
+    trailing ``reinterpreted_batch_rank`` batch dims as event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def sample(self, shape=(), key=None):
+        return self.base.sample(shape, key)
+
+    def rsample(self, shape=(), key=None):
+        return self.base.rsample(shape, key)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        return jnp.sum(lp, axis=tuple(range(-self.rank, 0)))
+
+    def entropy(self):
+        ent = self.base.entropy()
+        return jnp.sum(ent, axis=tuple(range(-self.rank, 0)))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+
+# ---------------------------------------------------------------------------
+# LKJCholesky
+# ---------------------------------------------------------------------------
+
+class LKJCholesky(Distribution):
+    """Reference: paddle.distribution.LKJCholesky — distribution over
+    Cholesky factors of correlation matrices, LKJ(η) density
+    p(L) ∝ prod_i L_ii^{d - i - 1 + 2(η-1)} (rows 1-indexed from 2).
+
+    Sampling uses the onion construction (LKJ 2009 §3.2): grow the
+    correlation matrix one dimension at a time — radius² ~ Beta(k/2, β),
+    direction uniform on the sphere — then Cholesky-factor the result.
+    ``dim`` is static so the growth loop unrolls at trace time.
+    """
+
+    def __init__(self, dim=2, concentration=1.0, sample_method="onion"):
+        if dim < 2:
+            raise ValueError("LKJCholesky: dim must be >= 2")
+        if sample_method not in ("onion", "cvine"):
+            raise ValueError("sample_method must be 'onion' or 'cvine'")
+        self.dim = int(dim)
+        self.concentration = jnp.asarray(concentration, jnp.float32)
+        self.sample_method = sample_method
+
+    def sample(self, shape=(), key=None):
+        key = _next_key(key)
+        shape = tuple(shape)
+        d = self.dim
+        eta = jnp.broadcast_to(self.concentration, shape)
+        beta0 = eta + (d - 2) / 2.0
+        k_u, *k_rows = jax.random.split(key, d)
+        u = Beta(beta0, beta0).sample((), key=k_u)          # (shape,)
+        r12 = 2.0 * u - 1.0
+        R = jnp.zeros(shape + (d, d), jnp.float32)
+        R = R.at[..., 0, 0].set(1.0).at[..., 1, 1].set(1.0)
+        R = R.at[..., 0, 1].set(r12).at[..., 1, 0].set(r12)
+        beta = beta0
+        for k in range(2, d):
+            beta = beta - 0.5
+            kb, kn = jax.random.split(k_rows[k - 2])
+            y = Beta(jnp.full(shape, k / 2.0), beta).sample((), key=kb)
+            n = jax.random.normal(kn, shape + (k,))
+            sphere = n / jnp.linalg.norm(n, axis=-1, keepdims=True)
+            w = jnp.sqrt(y)[..., None] * sphere
+            A = jnp.linalg.cholesky(R[..., :k, :k])
+            z = jnp.einsum("...ij,...j->...i", A, w)
+            R = R.at[..., k, :k].set(z).at[..., :k, k].set(z)
+            R = R.at[..., k, k].set(1.0)
+        return jnp.linalg.cholesky(R)
+
+    def log_prob(self, value):
+        L = jnp.asarray(value)
+        d = self.dim
+        diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+        order = 2.0 * (self.concentration[..., None] - 1.0) + d \
+            - jnp.arange(2, d + 1, dtype=jnp.float32)
+        unnorm = jnp.sum(order * jnp.log(diag), axis=-1)
+        # normalizer for the onion density (LKJ 2009, eq. 16 / torch's form)
+        from jax.scipy.special import gammaln, multigammaln
+        dm1 = d - 1
+        alpha = self.concentration + 0.5 * dm1
+        denom = gammaln(alpha) * dm1
+        numer = multigammaln(alpha - 0.5, dm1)
+        pi_const = 0.5 * dm1 * math.log(math.pi)
+        return unnorm - (pi_const + numer - denom)
+
+    @property
+    def mean(self):  # identity is the mode/mean of the factor's diagonal
+        raise NotImplementedError(
+            "LKJCholesky.mean is not defined in closed form")

@@ -1,0 +1,17 @@
+"""paddle_tpu.fft — importable module form of the fft namespace.
+
+Reference: python/paddle/fft.py.  Implementations live on ``ops.fft``
+(jnp.fft plus the hermitian nd variants); this module hoists them so both
+``paddle_tpu.fft.rfft`` and ``import paddle_tpu.fft`` work.
+"""
+
+from __future__ import annotations
+
+from .ops import fft as _ns
+
+_EXPORTED = [n for n in dir(_ns) if not n.startswith("_")]
+for _n in _EXPORTED:
+    globals()[_n] = getattr(_ns, _n)
+del _n
+
+__all__ = sorted(_EXPORTED)

@@ -101,3 +101,13 @@ def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
     if reduce_op not in _REDUCERS:
         raise ValueError(f"reduce_op must be one of {sorted(_REDUCERS)}")
     return _REDUCERS[reduce_op](msg, dst_index, out_size)
+
+
+# round-4: reindex + neighbor sampling + per-edge messages (host-side
+# sampling by design — see sampling.py docstring)
+from .sampling import (  # noqa: E402,F401
+    reindex_graph, reindex_heter_graph, sample_neighbors, send_uv,
+    weighted_sample_neighbors)
+
+__all__ += ["reindex_graph", "reindex_heter_graph", "sample_neighbors",
+            "send_uv", "weighted_sample_neighbors"]

@@ -1,0 +1,174 @@
+"""Graph reindex + neighbor sampling (reference:
+python/paddle/geometric/{reindex,sampling}/*.py).
+
+Design note (SURVEY §7.0 stance): neighbor sampling is dataloader-side
+preprocessing — the reference runs it in CPU kernels feeding the trainer,
+never on the accelerator.  Here it runs on host NumPy for the same reason
+(dynamic output shapes are hostile to XLA and belong off-chip); the
+*reindexed* fixed-shape tensors it produces are what go to the TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def _reindex(x, neighbors, counts_concat=None):
+    """Shared core: local ids with x first, then new neighbor ids in
+    order of first appearance.  dst (repeat of target local ids by count)
+    is only built when counts aligned with x are given."""
+    x = np.asarray(x)
+    neighbors = np.asarray(neighbors)
+    order = {int(n): i for i, n in enumerate(x)}
+    src = np.empty(len(neighbors), np.int64)
+    for i, n in enumerate(neighbors):
+        n = int(n)
+        if n not in order:
+            order[n] = len(order)
+        src[i] = order[n]
+    dst = None
+    if counts_concat is not None:
+        dst = jnp.asarray(
+            np.repeat(np.arange(len(x), dtype=np.int64), counts_concat))
+    out_nodes = np.fromiter(order.keys(), np.int64, len(order))
+    return (jnp.asarray(src), dst, jnp.asarray(out_nodes))
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None):
+    """Reference: paddle.geometric.reindex_graph — map global node ids of
+    a sampled subgraph to contiguous local ids (targets first)."""
+    return _reindex(x, neighbors, np.asarray(count))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None):
+    """Reference: paddle.geometric.reindex_heter_graph — like
+    reindex_graph but over per-edge-type neighbor lists sharing one node
+    numbering."""
+    neigh_all = np.concatenate([np.asarray(n) for n in neighbors])
+    src, _, out_nodes = _reindex(x, neigh_all)
+    # split src back per edge type; dst is per-type repeat of targets
+    sizes = [len(np.asarray(n)) for n in neighbors]
+    offs = np.cumsum([0] + sizes)
+    srcs = [src[offs[i]:offs[i + 1]] for i in range(len(sizes))]
+    dsts = [jnp.asarray(np.repeat(np.arange(len(np.asarray(x)),
+                                            dtype=np.int64), np.asarray(c)))
+            for c in count]
+    return srcs, dsts, out_nodes
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None,
+                     rng: Optional[np.random.Generator] = None):
+    """Reference: paddle.geometric.sample_neighbors — uniform sampling
+    (without replacement) from a CSC graph; returns (out_neighbors,
+    out_count[, out_eids])."""
+    row = np.asarray(row)
+    colptr = np.asarray(colptr)
+    nodes = np.asarray(input_nodes)
+    rng = rng or np.random.default_rng()
+    outs, cnts, out_eids = [], [], []
+    for v in nodes:
+        lo, hi = int(colptr[v]), int(colptr[v + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            pick = np.arange(lo, hi)
+        else:
+            pick = lo + rng.choice(deg, size=sample_size, replace=False)
+        outs.append(row[pick])
+        cnts.append(len(pick))
+        if return_eids:
+            out_eids.append(np.asarray(eids)[pick])
+    neigh = jnp.asarray(np.concatenate(outs) if outs else
+                        np.zeros((0,), row.dtype))
+    count = jnp.asarray(np.asarray(cnts, np.int64))
+    if return_eids:
+        return neigh, count, jnp.asarray(np.concatenate(out_eids))
+    return neigh, count
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              rng: Optional[np.random.Generator] = None):
+    """Reference: paddle.geometric.weighted_sample_neighbors —
+    weight-proportional sampling without replacement (Efraimidis-Spirakis
+    exponential-key trick, the reference's GPU kernel algorithm)."""
+    row = np.asarray(row)
+    colptr = np.asarray(colptr)
+    w = np.asarray(edge_weight, np.float64)
+    nodes = np.asarray(input_nodes)
+    rng = rng or np.random.default_rng()
+    outs, cnts, out_eids = [], [], []
+    for v in nodes:
+        lo, hi = int(colptr[v]), int(colptr[v + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            pick = np.arange(lo, hi)
+        else:
+            keys = rng.exponential(size=deg) / np.maximum(w[lo:hi], 1e-30)
+            pick = lo + np.argsort(keys)[:sample_size]
+        outs.append(row[pick])
+        cnts.append(len(pick))
+        if return_eids:
+            out_eids.append(np.asarray(eids)[pick])
+    neigh = jnp.asarray(np.concatenate(outs) if outs else
+                        np.zeros((0,), row.dtype))
+    count = jnp.asarray(np.asarray(cnts, np.int64))
+    if return_eids:
+        return neigh, count, jnp.asarray(np.concatenate(out_eids))
+    return neigh, count
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Reference: paddle.geometric.send_uv — per-edge message combining
+    source and destination node features (no reduce)."""
+    xs = jnp.asarray(x)[jnp.asarray(src_index)]
+    yd = jnp.asarray(y)[jnp.asarray(dst_index)]
+    if message_op == "add":
+        return xs + yd
+    if message_op == "sub":
+        return xs - yd
+    if message_op == "mul":
+        return xs * yd
+    if message_op == "div":
+        return xs / yd
+    raise ValueError("message_op must be add/sub/mul/div")
+
+
+def khop_sampler(row, colptr, input_nodes, sample_sizes: Sequence[int],
+                 rng: Optional[np.random.Generator] = None):
+    """Reference: paddle.incubate.graph_khop_sampler — multi-hop neighbor
+    sampling + reindex.  Returns (edge_src, edge_dst, sample_index,
+    reindex_x): local-id edges over the union of sampled nodes, the
+    global ids of that union (frontier order), and the local ids of the
+    original input nodes."""
+    rng = rng or np.random.default_rng()
+    frontier = np.asarray(input_nodes)
+    all_src, all_cnt = [], []
+    targets = []
+    for size in sample_sizes:
+        neigh, cnt = sample_neighbors(row, colptr, frontier,
+                                      sample_size=size, rng=rng)
+        all_src.append(np.asarray(neigh))
+        all_cnt.append(np.asarray(cnt))
+        targets.append(frontier)
+        # next frontier: newly discovered nodes
+        frontier = np.unique(np.asarray(neigh))
+    tgt_concat = np.concatenate(targets)
+    cnt_concat = np.concatenate(all_cnt)
+    neigh_concat = np.concatenate(all_src)
+    # one shared numbering: all hop targets first, then new neighbors
+    uniq_targets, first_idx = np.unique(tgt_concat, return_index=True)
+    ordered_targets = tgt_concat[np.sort(first_idx)]
+    src, _, out_nodes = _reindex(ordered_targets, neigh_concat)
+    # dst must repeat each *target occurrence* by its count, in local ids
+    local = {int(n): i for i, n in enumerate(np.asarray(out_nodes))}
+    dst = np.repeat(np.asarray([local[int(t)] for t in tgt_concat],
+                               dtype=np.int64), cnt_concat)
+    sample_index = out_nodes
+    reindex_x = jnp.asarray(np.asarray(
+        [local[int(t)] for t in np.asarray(input_nodes)], dtype=np.int64))
+    return src, jnp.asarray(dst), sample_index, reindex_x

@@ -1,0 +1,67 @@
+"""paddle_tpu.hub — model hub (local-source protocol).
+
+Reference: python/paddle/hub.py (help/list/load over a repo's
+``hubconf.py`` entrypoints).  The ``local`` / ``dir`` source is fully
+implemented; ``github``/``gitee`` sources need network egress, which
+this environment forbids — clone the repo and point ``source='local'``
+at it.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["help", "list", "load"]
+
+_builtin_list = list
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"hub: no hubconf.py under {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location(
+        f"pdtpu_hubconf_{abs(hash(repo_dir))}", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _resolve(repo_dir, source):
+    if source in ("local", "dir"):
+        return repo_dir
+    raise NotImplementedError(
+        f"hub source {source!r} needs network egress (disabled here): "
+        "clone the repo locally and pass source='local'")
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    """Entrypoint names exported by the repo's hubconf.py."""
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    deps = getattr(mod, "dependencies", [])
+    del deps
+    return sorted(n for n in dir(mod)
+                  if callable(getattr(mod, n)) and not n.startswith("_"))
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    """Docstring of one entrypoint."""
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"hub: no entrypoint {model!r}; available: "
+                         f"{list(repo_dir, source)}")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    """Instantiate one entrypoint."""
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"hub: no entrypoint {model!r}; available: "
+                         f"{list(repo_dir, source)}")
+    return fn(**kwargs)

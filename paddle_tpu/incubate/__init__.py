@@ -14,3 +14,18 @@ from ..distributed import moe as distributed_moe  # noqa: F401
 from ..distributed.moe import MoELayer  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401 — the
 #   reference exports both at paddle.incubate top level too
+
+# segment ops live in geometric; the reference exports them here too
+from ..geometric import (  # noqa: F401
+    segment_max, segment_mean, segment_min, segment_sum)
+from ..geometric.sampling import (  # noqa: F401
+    khop_sampler as graph_khop_sampler,
+    reindex_graph as graph_reindex,
+    sample_neighbors as graph_sample_neighbors)
+from .ops import (  # noqa: F401
+    identity_loss, softmax_mask_fuse, softmax_mask_fuse_upper_triangle)
+from . import asp  # noqa: F401
+# reference: paddle.incubate.autograd re-exports the functional AD surface
+from ..autograd import (  # noqa: F401
+    hessian, jacobian, jvp, vjp)
+from .. import autograd  # noqa: F401

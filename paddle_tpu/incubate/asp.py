@@ -1,0 +1,71 @@
+"""paddle_tpu.incubate.asp — automatic structured (n:m) sparsity.
+
+Reference: python/paddle/incubate/asp/* (ASPHelper, create_mask,
+check_sparsity, prune_model).  TPU note: n:m sparse tensor cores are a
+GPU feature; on TPU the value of ASP is the *pruning workflow* (train →
+mask → fine-tune), so masks are computed exactly (greedy best n-of-m by
+magnitude, the reference's mask_1d algorithm) and applied as dense
+masked weights.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+_EXCLUDED = set()
+
+
+def calculate_density(x) -> float:
+    """Fraction of non-zero entries (reference: asp.calculate_density)."""
+    x = np.asarray(x)
+    return float(np.count_nonzero(x)) / max(1, x.size)
+
+
+def create_mask(tensor, func_name="mask_1d", n=2, m=4):
+    """n:m mask along the last axis: keep the n largest-|w| of every m
+    consecutive weights (reference mask_1d; mask_2d_greedy reduces to the
+    same per-row rule on the reshaped view used here)."""
+    t = np.asarray(tensor)
+    flat = t.reshape(-1, m) if t.size % m == 0 else None
+    if flat is None:
+        raise ValueError(f"create_mask: tensor size {t.size} not divisible "
+                         f"by m={m}")
+    order = np.argsort(-np.abs(flat), axis=1)
+    mask = np.zeros_like(flat, dtype=np.float32)
+    np.put_along_axis(mask, order[:, :n], 1.0, axis=1)
+    return jnp.asarray(mask.reshape(t.shape))
+
+
+def check_sparsity(tensor, func_name="check_mask_1d", n=2, m=4) -> bool:
+    """True iff every m-group has at most n non-zeros."""
+    t = np.asarray(tensor)
+    if t.size % m:
+        return False
+    groups = (np.abs(t.reshape(-1, m)) > 0).sum(axis=1)
+    return bool((groups <= n).all())
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply n:m masks to every >=2-D weight of ``model`` (in place on the
+    layer's parameters) and return {param_name: mask}.  Biases, norms and
+    excluded layers are skipped, mirroring ASPHelper._is_supported_layer."""
+    masks = {}
+    for name, p in model.named_parameters():
+        if name in _EXCLUDED or p.ndim < 2 or p.shape[-1] % m:
+            continue
+        mask = create_mask(p, func_name=mask_algo, n=n, m=m)
+        masks[name] = mask
+        holder, attr = model, name.split(".")
+        for part in attr[:-1]:
+            holder = getattr(holder, part)
+        setattr(holder, attr[-1], jnp.asarray(p) * mask)
+    return masks

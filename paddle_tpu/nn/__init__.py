@@ -4,7 +4,7 @@ from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from . import quant  # noqa: F401
 from . import utils  # noqa: F401
-from .layer import (Layer, ParamAttr, ParameterList, functional_call,  # noqa: F401
+from .layer import (Layer, ParamAttr, Parameter, ParameterList, functional_call,  # noqa: F401
                     meta_init, raw_params, trainable_mask)
 from .layers_common import (  # noqa: F401
     AvgPool2D, BatchNorm1D, BatchNorm2D, BCEWithLogitsLoss, Conv2D,
@@ -21,7 +21,12 @@ from .layers_conv import (  # noqa: F401
     InstanceNorm2D, KLDivLoss, MarginRankingLoss, MaxPool1D, Pad2D,
     PixelShuffle, PixelUnshuffle, PReLU, SmoothL1Loss)
 from .layers_rnn import (  # noqa: F401
-    GRU, GRUCell, LSTM, LSTMCell, SimpleRNN, SimpleRNNCell)
+    GRU, GRUCell, LSTM, LSTMCell, RNNCellBase, SimpleRNN, SimpleRNNCell)
+from .layers_tail4 import (  # noqa: F401
+    RNN, AdaptiveAvgPool1D, AdaptiveAvgPool3D, AdaptiveMaxPool3D,
+    AvgPool3D, BatchNorm, BatchNorm3D, BeamSearchDecoder, Conv1DTranspose,
+    Conv3DTranspose, ELU, GumbelSoftmax, Hardtanh, HSigmoidLoss,
+    InstanceNorm3D, MaxPool3D, ReLU6, dynamic_decode)
 from .layers_more import (  # noqa: F401
     AdaptiveMaxPool1D, AlphaDropout, Bilinear, CELU, ChannelShuffle,
     Dropout3D, FeatureAlphaDropout, Fold, GLU, Hardshrink,
@@ -37,3 +42,5 @@ from .layers_tail3 import (  # noqa: F401
     Softsign, SpectralNorm, TripletMarginLoss,
     TripletMarginWithDistanceLoss, ZeroPad1D, ZeroPad3D)
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+
+SiLU = Silu  # reference spells it both ways across versions

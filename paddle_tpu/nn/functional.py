@@ -215,6 +215,39 @@ def _rotate_every_two(x):
     return jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
 
 
+def _rotate_half_mm(x):
+    """rotate_half as one tiny (d, d) matmul: y = x @ R with
+    R[k+d/2, k] = −1, R[k−d/2, k] = 1.  Step attribution (BENCH.md
+    §attribution) measured the split/concat/negate formulation at 29 ms
+    /step (13%) on the d64 headline — pure layout traffic; the matmul
+    form rides the MXU at ~0.5 GFLOP/step instead and fuses with the
+    surrounding cos/sin elementwise."""
+    d = x.shape[-1]
+    half = d // 2
+    import numpy as _np
+    r = _np.zeros((d, d), _np.float32)
+    r[half:, :half] = -_np.eye(half, dtype=_np.float32)
+    r[:half, half:] = _np.eye(half, dtype=_np.float32)
+    # precision=HIGHEST: the default TPU matmul rounds f32 operands to
+    # bf16, which would silently change f32-model rope numerics; with R
+    # in {0, ±1} the highest-precision product is exact and still tiny
+    return jax.lax.dot_general(
+        x, jnp.asarray(r, x.dtype),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST)
+
+
+_ROPE_IMPL = None  # resolved lazily from PDTPU_ROPE_IMPL (matmul|layout)
+
+
+def _rope_rotate_half(x):
+    global _ROPE_IMPL
+    if _ROPE_IMPL is None:
+        import os as _os
+        _ROPE_IMPL = _os.environ.get("PDTPU_ROPE_IMPL", "matmul")
+    return _rotate_half_mm(x) if _ROPE_IMPL == "matmul" else _rotate_half(x)
+
+
 def apply_rotary_pos_emb(q, k, cos, sin, interleaved=False):
     """q/k: [batch, seq, heads, head_dim]; cos/sin: [seq, head_dim] or
     [batch, seq, head_dim] (explicit position_ids).  ``interleaved`` selects
@@ -223,7 +256,7 @@ def apply_rotary_pos_emb(q, k, cos, sin, interleaved=False):
         cos, sin = cos[None, :, None, :], sin[None, :, None, :]
     elif cos.ndim == 3:  # (b, s, d) -> (b, s, 1, d)
         cos, sin = cos[:, :, None, :], sin[:, :, None, :]
-    rot = _rotate_every_two if interleaved else _rotate_half
+    rot = _rotate_every_two if interleaved else _rope_rotate_half
     q_out = q * cos + rot(q) * sin
     k_out = k * cos + rot(k) * sin
     return q_out.astype(q.dtype), k_out.astype(k.dtype)
@@ -383,9 +416,31 @@ def nll_loss(input, label, reduction="mean"):
 # convolution / pooling / resize (SDXL ops breadth)
 # ---------------------------------------------------------------------------
 
+def _conv_pet(dtype):
+    """Conv accumulation request: asking XLA for an f32 OUTPUT from bf16
+    operands breaks the conv VJP (the rhs-transpose conv then pairs a
+    bf16 operand with the f32 cotangent, which lax.conv rejects).  The
+    TPU MXU accumulates bf16 convs in f32 internally regardless, so for
+    low-precision operands we keep the operand dtype as the output."""
+    return jnp.float32 if dtype == jnp.float32 else None
+
+
+def _conv_dtypes(x, weight):
+    """lax.conv demands matching operand dtypes; under AMP the reference
+    white-lists conv to run in the LOW precision side (amp/auto_cast), so
+    a mixed f32-activation/bf16-weight pair computes in bf16."""
+    if x.dtype == weight.dtype:
+        return x, weight
+    narrow = min((x.dtype, weight.dtype),
+                 key=lambda d: jnp.finfo(d).bits if
+                 jnp.issubdtype(d, jnp.floating) else 99)
+    return x.astype(narrow), weight.astype(narrow)
+
+
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCHW"):
     """Weight layout (out_c, in_c/groups, kh, kw), matching the reference."""
+    x, weight = _conv_dtypes(x, weight)
     stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
     dilation = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
     if isinstance(padding, str):
@@ -399,7 +454,7 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
     out = jax.lax.conv_general_dilated(
         x, weight, window_strides=stride, padding=pad,
         rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
-        preferred_element_type=jnp.float32).astype(x.dtype)
+        preferred_element_type=_conv_pet(x.dtype)).astype(x.dtype)
     if bias is not None:
         shape = [1, -1, 1, 1] if data_format == "NCHW" else [1, 1, 1, -1]
         out = out + bias.reshape(shape).astype(out.dtype)
@@ -506,6 +561,7 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
 def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCL"):
     """Weight layout (out_c, in_c/groups, k), matching the reference."""
+    x, weight = _conv_dtypes(x, weight)
     stride = (stride,) if isinstance(stride, int) else tuple(stride)
     dilation = (dilation,) if isinstance(dilation, int) else tuple(dilation)
     if isinstance(padding, str):
@@ -519,7 +575,7 @@ def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
     out = jax.lax.conv_general_dilated(
         x, weight, window_strides=stride, padding=pad, rhs_dilation=dilation,
         dimension_numbers=dn, feature_group_count=groups,
-        preferred_element_type=jnp.float32).astype(x.dtype)
+        preferred_element_type=_conv_pet(x.dtype)).astype(x.dtype)
     if bias is not None:
         shape = [1, -1, 1] if data_format == "NCL" else [1, 1, -1]
         out = out + bias.reshape(shape).astype(out.dtype)
@@ -528,6 +584,7 @@ def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 
 def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCDHW"):
+    x, weight = _conv_dtypes(x, weight)
     stride = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
     dilation = (dilation,) * 3 if isinstance(dilation, int) else tuple(dilation)
     if isinstance(padding, str):
@@ -542,7 +599,7 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
     out = jax.lax.conv_general_dilated(
         x, weight, window_strides=stride, padding=pad, rhs_dilation=dilation,
         dimension_numbers=dn, feature_group_count=groups,
-        preferred_element_type=jnp.float32).astype(x.dtype)
+        preferred_element_type=_conv_pet(x.dtype)).astype(x.dtype)
     if bias is not None:
         shape = [1, -1, 1, 1, 1] if data_format == "NCDHW" else [1, 1, 1, 1, -1]
         out = out + bias.reshape(shape).astype(out.dtype)
@@ -557,6 +614,7 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
 
     Implemented as conv_general_dilated with lhs_dilation=stride (the
     standard XLA lowering of transpose conv; MXU-friendly, no scatter)."""
+    x, weight = _conv_dtypes(x, weight)
     s = (stride, stride) if isinstance(stride, int) else tuple(stride)
     if isinstance(padding, str):
         # 'SAME' (out = in*stride) / 'VALID' via lax.conv_transpose, which
@@ -573,7 +631,7 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
         out = jax.lax.conv_transpose(
             x, weight, strides=s, padding=padding.upper(), rhs_dilation=d,
             dimension_numbers=dn, transpose_kernel=True,
-            preferred_element_type=jnp.float32).astype(x.dtype)
+            preferred_element_type=_conv_pet(x.dtype)).astype(x.dtype)
         if bias is not None:
             shape = [1, -1, 1, 1] if data_format == "NCHW" else [1, 1, 1, -1]
             out = out + bias.reshape(shape).astype(out.dtype)
@@ -602,7 +660,7 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=(1, 1), padding=pad, lhs_dilation=s,
         rhs_dilation=d, dimension_numbers=dn, feature_group_count=groups,
-        preferred_element_type=jnp.float32).astype(x.dtype)
+        preferred_element_type=_conv_pet(x.dtype)).astype(x.dtype)
     if bias is not None:
         shape = [1, -1, 1, 1] if data_format == "NCHW" else [1, 1, 1, -1]
         out = out + bias.reshape(shape).astype(out.dtype)
@@ -1151,6 +1209,19 @@ from .functional_tail3 import (soft_margin_loss, multi_margin_loss,  # noqa: F40
                                lp_pool1d, lp_pool2d, max_unpool1d,
                                max_unpool3d, fractional_max_pool2d,
                                fractional_max_pool3d)
+
+
+# round-4 tail (3-D pools, nd transpose convs, sequence/margin losses,
+# sparse attention, gather_tree, hsigmoid) — see functional_tail4.py
+from .functional_tail4 import *  # noqa: F401,F403,E402
+from .functional_tail4 import (avg_pool3d, max_pool3d,  # noqa: F401,E402
+                               adaptive_avg_pool1d, adaptive_max_pool1d,
+                               adaptive_avg_pool3d, adaptive_max_pool3d,
+                               conv1d_transpose, conv3d_transpose,
+                               label_smooth, log_loss, sequence_mask,
+                               temporal_shift, gather_tree, hsigmoid_loss,
+                               margin_cross_entropy, class_center_sample,
+                               sparse_attention, relu_, elu_, softmax_)
 
 
 # static-graph interop: F.* also record onto static.Var placeholders

@@ -1,0 +1,388 @@
+"""Round-4 nn.functional tail: 3-D pooling, 1-D/3-D transpose convs,
+sequence/loss ops, ArcFace margin CE, block-sparse attention, beam-search
+gather_tree, hierarchical sigmoid.
+
+Reference: python/paddle/nn/functional/{pooling,conv,loss,common,extension}.py
+(SURVEY §2.6 layers & functional row).  Oracle tests in
+tests/test_nn_tail4.py.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# 3-D pooling
+# ---------------------------------------------------------------------------
+
+def _triple(v):
+    return (v, v, v) if isinstance(v, int) else tuple(v)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, data_format="NCDHW"):
+    k = _triple(kernel_size)
+    s = k if stride is None else _triple(stride)
+    p = _triple(padding)
+    if data_format == "NCDHW":
+        window = (1, 1, *k)
+        strides = (1, 1, *s)
+        pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]), (p[2], p[2]))
+    else:
+        window = (1, *k, 1)
+        strides = (1, *s, 1)
+        pads = ((0, 0), (p[0], p[0]), (p[1], p[1]), (p[2], p[2]), (0, 0))
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
+    count = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                  window, strides, pads)
+    return summed / count
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, data_format="NCDHW"):
+    k = _triple(kernel_size)
+    s = k if stride is None else _triple(stride)
+    p = _triple(padding)
+    if data_format == "NCDHW":
+        window = (1, 1, *k)
+        strides = (1, 1, *s)
+        pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]), (p[2], p[2]))
+    else:
+        window = (1, *k, 1)
+        strides = (1, *s, 1)
+        pads = ((0, 0), (p[0], p[0]), (p[1], p[1]), (p[2], p[2]), (0, 0))
+    return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, strides,
+                                 pads)
+
+
+# ---------------------------------------------------------------------------
+# adaptive pooling (1-D / 3-D) — generic exact per-axis bucketing.
+# Per-axis sequential reduction is exact: within one output cell every
+# axis's bucket size is fixed, so mean-of-means equals the true mean.
+# ---------------------------------------------------------------------------
+
+def _adaptive_pool_axes(x, out_sizes, axes, reduce_fn):
+    for axis, out in zip(axes, out_sizes):
+        n = x.shape[axis]
+        pieces = [reduce_fn(
+            jax.lax.slice_in_dim(x, int(i * n / out),
+                                 int(-(-((i + 1) * n) // out)), axis=axis),
+            axis=axis, keepdims=True) for i in range(out)]
+        x = jnp.concatenate(pieces, axis=axis)
+    return x
+
+
+def adaptive_avg_pool1d(x, output_size):
+    out = output_size if isinstance(output_size, int) else output_size[0]
+    return _adaptive_pool_axes(x, (out,), (2,), jnp.mean)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False):
+    out = output_size if isinstance(output_size, int) else output_size[0]
+    y = _adaptive_pool_axes(x, (out,), (2,), jnp.max)
+    if return_mask:
+        # index (within the full L axis) of each window's max
+        n = x.shape[2]
+        idx = []
+        for i in range(out):
+            a = int(i * n / out)
+            b = int(-(-((i + 1) * n) // out))
+            seg = jax.lax.slice_in_dim(x, a, b, axis=2)
+            idx.append(jnp.argmax(seg, axis=2, keepdims=True) + a)
+        return y, jnp.concatenate(idx, axis=2)
+    return y
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    out = _triple(output_size)
+    axes = (2, 3, 4) if data_format == "NCDHW" else (1, 2, 3)
+    return _adaptive_pool_axes(x, out, axes, jnp.mean)
+
+
+def adaptive_max_pool3d(x, output_size, data_format="NCDHW"):
+    out = _triple(output_size)
+    axes = (2, 3, 4) if data_format == "NCDHW" else (1, 2, 3)
+    return _adaptive_pool_axes(x, out, axes, jnp.max)
+
+
+# ---------------------------------------------------------------------------
+# 1-D / 3-D transpose convolution (shared n-d core; same lhs_dilation
+# lowering as conv2d_transpose — MXU-friendly, no scatter)
+# ---------------------------------------------------------------------------
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, specs, channel_last):
+    from .functional import _conv_dtypes, _conv_pet
+    x, weight = _conv_dtypes(x, weight)
+    nd = len(weight.shape) - 2
+    as_nd = lambda v: (v,) * nd if isinstance(v, int) else tuple(v)
+    s, d = as_nd(stride), as_nd(dilation)
+    p, op = as_nd(padding), as_nd(output_padding)
+    ks = weight.shape[-nd:]
+    ek = [(k - 1) * dd + 1 for k, dd in zip(ks, d)]
+    pad = [(e - 1 - pp, e - 1 - pp + o) for e, pp, o in zip(ek, p, op)]
+    axes = tuple(range(2, 2 + nd))
+    w = jnp.flip(weight, axis=axes)  # (I, O/g, *k) → rotate spatial
+    if groups == 1:
+        w = jnp.swapaxes(w, 0, 1)
+    else:
+        i, og = w.shape[0], w.shape[1]
+        w = w.reshape(groups, i // groups, og, *ks).swapaxes(1, 2) \
+             .reshape(groups * og, i // groups, *ks)
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, specs)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1,) * nd, padding=pad, lhs_dilation=s,
+        rhs_dilation=d, dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=_conv_pet(x.dtype)).astype(x.dtype)
+    if bias is not None:
+        shape = [1, -1] + [1] * nd if not channel_last else \
+            [1] + [1] * nd + [-1]
+        out = out + bias.reshape(shape).astype(out.dtype)
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCL"):
+    """Weight layout (in_c, out_c/groups, k) — the reference's
+    Conv1DTranspose convention."""
+    specs = ("NCH", "OIH", "NCH") if data_format == "NCL" \
+        else ("NHC", "OIH", "NHC")
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, specs,
+                              data_format != "NCL")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCDHW"):
+    specs = ("NCDHW", "OIDHW", "NCDHW") if data_format == "NCDHW" \
+        else ("NDHWC", "OIDHW", "NDHWC")
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, specs,
+                              data_format != "NCDHW")
+
+
+# ---------------------------------------------------------------------------
+# losses / label utilities
+# ---------------------------------------------------------------------------
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    """Reference: F.label_smooth — (1-ε)·y + ε·prior (uniform default)."""
+    label = jnp.asarray(label)
+    if prior_dist is not None:
+        prior = jnp.asarray(prior_dist)
+    else:
+        prior = 1.0 / label.shape[-1]
+    return (1.0 - epsilon) * label + epsilon * prior
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """Reference: F.log_loss — per-element binary log loss on
+    probabilities."""
+    x = jnp.asarray(input)
+    y = jnp.asarray(label)
+    return -y * jnp.log(x + epsilon) - (1.0 - y) * jnp.log(1.0 - x + epsilon)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """Reference: F.sequence_mask — mask[..., j] = j < x[...]."""
+    from ..core import convert_dtype
+    x = jnp.asarray(x)
+    if maxlen is None:
+        raise ValueError(
+            "sequence_mask: maxlen must be given under jit (output shape "
+            "would otherwise depend on data — XLA needs static shapes)")
+    r = jnp.arange(int(maxlen))
+    mask = r[None, :] < x.reshape(-1, 1)
+    return mask.reshape(*x.shape, int(maxlen)).astype(convert_dtype(dtype))
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """Reference: F.temporal_shift (TSM) — shift the first channel fold
+    backward in time, the second fold forward, zero-padding boundaries."""
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    nt, c, h, w = x.shape
+    v = x.reshape(-1, seg_num, c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    pad_t = jnp.zeros_like(v[:, :1])
+    prev = jnp.concatenate([pad_t, v[:, :-1]], axis=1)   # frame t-1
+    nxt = jnp.concatenate([v[:, 1:], pad_t], axis=1)     # frame t+1
+    out = jnp.concatenate([prev[:, :, :c1], nxt[:, :, c1:c2], v[:, :, c2:]],
+                          axis=2).reshape(nt, c, h, w)
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+def gather_tree(ids, parents):
+    """Reference: F.gather_tree — walk beam-search parent pointers from
+    the last step backward so each beam holds its full token path.
+    Shapes (T, B, beam); a reverse lax.scan carries the beam indices."""
+    ids = jnp.asarray(ids)
+    parents = jnp.asarray(parents)
+    T, B, K = ids.shape
+    binx = jnp.arange(B)[:, None]
+
+    def step(beam_at, inputs):
+        ids_t, parents_t = inputs
+        out_t = ids_t[binx, beam_at]
+        beam_prev = parents_t[binx, beam_at]
+        return beam_prev, out_t
+
+    init = jnp.broadcast_to(jnp.arange(K)[None, :], (B, K))
+    _, outs = jax.lax.scan(step, init, (ids, parents), reverse=True)
+    return outs
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Reference: F.hsigmoid_loss — hierarchical sigmoid over a complete
+    binary tree (default) or a custom path table.
+
+    Default-tree node codes follow the reference's SimpleCode: for class c,
+    ``code = c + num_classes``; walking bits from the lowest, the internal
+    node visited at bit i is ``(code >> (i+1)) - 1`` and the branch taken
+    is ``(code >> i) & 1``.  Bits above the code's MSB are masked out.
+    """
+    x = jnp.asarray(input)                      # (B, F)
+    lab = jnp.asarray(label).reshape(-1)        # (B,)
+    w = jnp.asarray(weight)                     # (num_classes-1, F) default
+    if path_table is not None:
+        pt = jnp.asarray(path_table)            # (B, L) node ids, -1 pad
+        pc = jnp.asarray(path_code).astype(jnp.float32)  # (B, L) bits
+        valid = (pt >= 0)
+        idx = jnp.where(valid, pt, 0)
+    else:
+        code = lab + num_classes                # (B,)
+        maxL = max(1, int(math.ceil(math.log2(2 * num_classes - 1))))
+        bits = jnp.arange(maxL)                 # (L,)
+        idx = (code[:, None] >> (bits[None, :] + 1)) - 1
+        pc = ((code[:, None] >> bits[None, :]) & 1).astype(jnp.float32)
+        # bit i participates iff the node index is a real internal node,
+        # i.e. code has a set bit above position i
+        valid = (code[:, None] >> (bits[None, :] + 1)) > 0
+        idx = jnp.where(valid, idx, 0)
+    wg = w[idx]                                 # (B, L, F)
+    pre = jnp.einsum("bf,blf->bl", x, wg)
+    if bias is not None:
+        b = jnp.asarray(bias).reshape(-1)
+        pre = pre + b[idx]
+    # BCE with logits against the branch bit, summed over the valid path
+    per_bit = jnp.maximum(pre, 0) - pre * pc + jnp.log1p(jnp.exp(-jnp.abs(pre)))
+    loss = jnp.sum(jnp.where(valid, per_bit, 0.0), axis=1, keepdims=True)
+    return loss
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """Reference: F.margin_cross_entropy — combined-margin (ArcFace-family)
+    softmax CE.  ``logits`` are cosine similarities; the target class gets
+    cos(m1·θ + m2) - m3 before scaling.
+
+    The reference's class-parallel mode shards classes over a process
+    group; here shard the class axis with mp_layers.ParallelCrossEntropy
+    instead (group must be None).
+    """
+    if group is not None and group is not False:
+        raise NotImplementedError(
+            "margin_cross_entropy(group=...): class-parallel margin CE is "
+            "expressed via mesh sharding — see distributed/mp_layers.py "
+            "ParallelCrossEntropy (SURVEY §2.5)")
+    cos = jnp.asarray(logits)
+    lab = jnp.asarray(label).reshape(-1)
+    onehot = jax.nn.one_hot(lab, cos.shape[-1], dtype=cos.dtype)
+    theta = jnp.arccos(jnp.clip(cos, -1.0 + 1e-7, 1.0 - 1e-7))
+    target = jnp.cos(margin1 * theta + margin2) - margin3
+    adjusted = jnp.where(onehot > 0, target, cos) * scale
+    logp = jax.nn.log_softmax(adjusted, axis=-1)
+    loss = -jnp.sum(onehot * logp, axis=-1, keepdims=True)
+    if reduction == "mean":
+        loss = jnp.mean(loss)
+    elif reduction == "sum":
+        loss = jnp.sum(loss)
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Reference: F.class_center_sample — keep every positive class center
+    and fill to ``num_samples`` with uniformly sampled negatives; returns
+    (remapped_label, sampled_class_ids) with ids sorted ascending."""
+    from ..core import random as prandom
+    lab = jnp.asarray(label).reshape(-1)
+    pos = jnp.zeros((num_classes,), jnp.float32).at[lab].set(1.0)
+    noise = jax.random.uniform(prandom.next_key("class_center_sample"),
+                               (num_classes,))
+    # positives rank above any negative; negatives ordered by noise
+    score = pos * 2.0 + noise
+    _, picked = jax.lax.top_k(score, num_samples)
+    sampled = jnp.sort(picked)
+    remapped = jnp.searchsorted(sampled, lab)
+    return remapped, sampled
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Reference: F.sparse_attention — attention restricted to a per-row
+    CSR column set.  Shapes: q/k/v (B, H, M, D), offset (B, H, M+1),
+    columns (B, H, nnz).
+
+    TPU formulation: expand each nnz slot to its row id (searchsorted over
+    the offset vector — static shapes), gather k/v at the listed columns,
+    and do a segment-softmax over slots.  No dense M×M score matrix is
+    materialised; cost is O(nnz·D)."""
+    q = jnp.asarray(query)
+    k = jnp.asarray(key)
+    v = jnp.asarray(value)
+    off = jnp.asarray(sparse_csr_offset).astype(jnp.int32)
+    cols = jnp.asarray(sparse_csr_columns).astype(jnp.int32)
+    B, H, M, D = q.shape
+    nnz = cols.shape[-1]
+    inv_sqrt_d = 1.0 / math.sqrt(D)
+
+    def one_head(qh, kh, vh, offh, colh):
+        slot = jnp.arange(nnz)
+        row = jnp.searchsorted(offh, slot, side="right") - 1  # (nnz,)
+        row = jnp.clip(row, 0, M - 1)
+        live = slot < offh[-1]
+        scores = jnp.sum(qh[row] * kh[colh], axis=-1) * inv_sqrt_d
+        scores = jnp.where(live, scores, -jnp.inf)
+        mx = jax.ops.segment_max(scores, row, num_segments=M)
+        mx = jnp.where(jnp.isfinite(mx), mx, 0.0)
+        ex = jnp.where(live, jnp.exp(scores - mx[row]), 0.0)
+        den = jax.ops.segment_sum(ex, row, num_segments=M)
+        p = ex / jnp.maximum(den[row], 1e-20)
+        out = jax.ops.segment_sum(p[:, None] * vh[colh], row,
+                                  num_segments=M)
+        return out.astype(qh.dtype)
+
+    flat = lambda t: t.reshape(B * H, *t.shape[2:])
+    out = jax.vmap(one_head)(flat(q), flat(k), flat(v), flat(off),
+                             flat(cols))
+    return out.reshape(B, H, M, D)
+
+
+# ---------------------------------------------------------------------------
+# inplace-suffix aliases (value-returning; see ops/tail3.py deviation note)
+# ---------------------------------------------------------------------------
+
+def relu_(x, name=None):
+    return jax.nn.relu(jnp.asarray(x))
+
+
+def elu_(x, alpha=1.0, name=None):
+    return jax.nn.elu(jnp.asarray(x), alpha)
+
+
+def softmax_(x, axis=-1, name=None):
+    return jax.nn.softmax(jnp.asarray(x), axis=axis)

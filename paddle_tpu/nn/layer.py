@@ -64,6 +64,25 @@ class ParamMeta:
         self.name_hint = name_hint
 
 
+class Parameter:
+    """Reference: the EagerParamBase/``paddle.nn.Parameter`` idiom —
+    wrap an array so assigning it to a Layer attribute registers it as a
+    (trainable) parameter:
+
+        self.scale = nn.Parameter(jnp.ones((d,)))
+
+    ``Layer.__setattr__`` unwraps it; the attribute then holds the plain
+    array (jax arrays carry no identity, so the wrapper is consumed at
+    assignment)."""
+
+    __slots__ = ("data", "trainable")
+
+    def __init__(self, data, trainable=True):
+        import jax.numpy as _jnp
+        self.data = _jnp.asarray(data)
+        self.trainable = trainable
+
+
 class ParamAttr:
     """``paddle.ParamAttr`` parity (subset: name/initializer/trainable)."""
 
@@ -174,6 +193,13 @@ class Layer:
         params = self.__dict__.get("_parameters")
         if params is None:  # before __init__
             object.__setattr__(self, name, value)
+            return
+        if isinstance(value, Parameter):
+            self._parameters[name] = value.data
+            self._param_meta[name] = ParamMeta(trainable=value.trainable,
+                                               name_hint=name)
+            self._sub_layers.pop(name, None)
+            object.__setattr__(self, name, value.data)
             return
         if isinstance(value, Layer):
             self._sub_layers[name] = value

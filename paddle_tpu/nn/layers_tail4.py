@@ -1,0 +1,392 @@
+"""Round-4 nn layer tail: 3-D pools/norms/convs, remaining activation
+classes, HSigmoidLoss, single-cell RNN wrapper, BeamSearchDecoder +
+dynamic_decode.
+
+Reference: python/paddle/nn/layer/{pooling,norm,conv,activation,loss,rnn}.py
+(SURVEY §2.6 layers row).  Tests: tests/test_nn_tail4.py.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from . import functional as F
+from . import initializer as I
+from .layer import Layer, functional_call
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+class AvgPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW"):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = \
+            kernel_size, stride, padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            self.data_format)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW"):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = \
+            kernel_size, stride, padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            self.data_format)
+
+
+class AdaptiveAvgPool1D(Layer):
+    def __init__(self, output_size):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, self.output_size)
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size, data_format="NCDHW"):
+        super().__init__()
+        self.output_size, self.data_format = output_size, data_format
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self.output_size, self.data_format)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, data_format="NCDHW"):
+        super().__init__()
+        self.output_size, self.data_format = output_size, data_format
+        if return_mask:
+            raise NotImplementedError(
+                "AdaptiveMaxPool3D(return_mask=True): 3-D argmax masks are "
+                "not implemented (1-D has them via F.adaptive_max_pool1d); "
+                "raising rather than silently dropping the mask")
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self.output_size, self.data_format)
+
+
+# ---------------------------------------------------------------------------
+# norms / convs (BatchNorm/InstanceNorm cores are ndim-agnostic — the 3-D
+# classes pin the expected rank for shape checking and API parity)
+# ---------------------------------------------------------------------------
+
+from .layers_common import BatchNorm2D  # noqa: E402
+from .layers_conv import InstanceNorm2D  # noqa: E402
+
+
+class BatchNorm3D(BatchNorm2D):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW",
+                 name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, "NCHW" if data_format == "NCDHW"
+                         else "NHWC", name)
+
+
+class BatchNorm(BatchNorm2D):
+    """Reference: the 1.x-style paddle.nn.BatchNorm (channel axis 1, an
+    optional fused activation)."""
+
+    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, data_layout="NCHW",
+                 **kw):
+        super().__init__(num_channels, momentum, epsilon, param_attr,
+                         bias_attr, data_layout)
+        self._act = act
+
+    def forward(self, x):
+        out = super().forward(x)
+        if self._act:
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class InstanceNorm3D(InstanceNorm2D):
+    pass
+
+
+class Conv1DTranspose(Layer):
+    """Weight layout (in_c, out_c/groups, k) per the reference."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__()
+        k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+        self.stride, self.padding, self.output_padding = \
+            stride, padding, output_padding
+        self.dilation, self.groups, self.data_format = \
+            dilation, groups, data_format
+        fan_in = in_channels * k // groups
+        self.weight = self.create_parameter(
+            (in_channels, out_channels // groups, k), attr=weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=fan_in))
+        self.bias = None
+        if bias_attr is not False:
+            bound = 1 / math.sqrt(fan_in)
+            self.bias = self.create_parameter(
+                (out_channels,), attr=bias_attr, is_bias=True,
+                default_initializer=I.Uniform(-bound, bound))
+
+    def forward(self, x):
+        return F.conv1d_transpose(
+            x, self.weight, self.bias, self.stride, self.padding,
+            self.output_padding, self.dilation, self.groups,
+            self.data_format)
+
+
+class Conv3DTranspose(Layer):
+    """Weight layout (in_c, out_c/groups, kd, kh, kw) per the reference."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__()
+        k = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.stride, self.padding, self.output_padding = \
+            stride, padding, output_padding
+        self.dilation, self.groups, self.data_format = \
+            dilation, groups, data_format
+        fan_in = in_channels * k[0] * k[1] * k[2] // groups
+        self.weight = self.create_parameter(
+            (in_channels, out_channels // groups, *k), attr=weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=fan_in))
+        self.bias = None
+        if bias_attr is not False:
+            bound = 1 / math.sqrt(fan_in)
+            self.bias = self.create_parameter(
+                (out_channels,), attr=bias_attr, is_bias=True,
+                default_initializer=I.Uniform(-bound, bound))
+
+    def forward(self, x):
+        return F.conv3d_transpose(
+            x, self.weight, self.bias, self.stride, self.padding,
+            self.output_padding, self.dilation, self.groups,
+            self.data_format)
+
+
+# ---------------------------------------------------------------------------
+# activation classes
+# ---------------------------------------------------------------------------
+
+class ELU(Layer):
+    def __init__(self, alpha=1.0, name=None):
+        super().__init__()
+        self.alpha = alpha
+
+    def forward(self, x):
+        return F.elu(x, self.alpha)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return F.relu6(x)
+
+
+class Hardtanh(Layer):
+    def __init__(self, min=-1.0, max=1.0, name=None):
+        super().__init__()
+        self.min, self.max = min, max
+
+    def forward(self, x):
+        return F.hardtanh(x, self.min, self.max)
+
+
+class GumbelSoftmax(Layer):
+    def __init__(self, temperature=1.0, hard=False, axis=-1, name=None):
+        super().__init__()
+        self.temperature, self.hard, self.axis = temperature, hard, axis
+
+    def forward(self, x):
+        return F.gumbel_softmax(x, self.temperature, self.hard, self.axis)
+
+
+# ---------------------------------------------------------------------------
+# hierarchical sigmoid
+# ---------------------------------------------------------------------------
+
+class HSigmoidLoss(Layer):
+    """Reference: paddle.nn.HSigmoidLoss — holds the internal-node weight
+    table ((num_classes-1, feature) for the default complete tree)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("HSigmoidLoss: num_classes must be >= 2")
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        rows = num_classes if is_custom else num_classes - 1
+        bound = 1.0 / math.sqrt(feature_size)
+        self.weight = self.create_parameter(
+            (rows, feature_size), attr=weight_attr,
+            default_initializer=I.Uniform(-bound, bound))
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                (rows,), attr=bias_attr, is_bias=True,
+                default_initializer=I.Uniform(-bound, bound))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table=path_table,
+                               path_code=path_code)
+
+
+# ---------------------------------------------------------------------------
+# single-cell RNN wrapper + beam-search decoding
+# ---------------------------------------------------------------------------
+
+class RNN(Layer):
+    """Reference: paddle.nn.RNN — scan one cell over time.
+
+    forward(inputs, initial_states=None, sequence_length=None)
+      → (outputs, final_states); inputs (B, T, F) unless time_major.
+    """
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse, self.time_major = is_reverse, time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = inputs if self.time_major else jnp.swapaxes(inputs, 0, 1)
+        B = x.shape[1]
+        if initial_states is None:
+            if hasattr(self.cell, "get_initial_states"):
+                initial_states = self.cell.get_initial_states(B)
+            else:
+                h = jnp.zeros((B, self.cell.hidden_size))
+                initial_states = (h, jnp.zeros_like(h)) \
+                    if "LSTM" in type(self.cell).__name__ else h
+        params = dict(self.cell.named_parameters())
+        ts = jnp.arange(x.shape[0])
+
+        def step(state, inp):
+            xt, t = inp
+            new = functional_call(self.cell, params, xt, state)
+            if sequence_length is not None:
+                valid = (t < sequence_length)[:, None]
+                new = jax.tree.map(
+                    lambda n, o: jnp.where(valid, n, o), new, state)
+            h = new[0] if isinstance(new, tuple) else new
+            if sequence_length is not None:
+                h = jnp.where((t < sequence_length)[:, None], h, 0.0)
+            return new, h
+
+        final, ys = jax.lax.scan(step, initial_states, (x, ts),
+                                 reverse=self.is_reverse)
+        out = ys if self.time_major else jnp.swapaxes(ys, 0, 1)
+        return out, final
+
+
+class BeamSearchDecoder:
+    """Reference: paddle.nn.BeamSearchDecoder — beam decoding around an
+    RNN cell with an embedding fn and an output (vocab projection) fn.
+
+    TPU-native formulation: the whole decode is ONE ``lax.scan`` inside
+    ``dynamic_decode`` (fixed ``max_step_num`` trip count, finished-beam
+    masking) instead of the reference's per-step dynamic loop, so it
+    compiles once and runs on-chip.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token, self.end_token = int(start_token), int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- helpers over (B*K, ...) flattened beam states ---------------------
+
+    def _tile(self, tree, K):
+        return jax.tree.map(
+            lambda t: jnp.repeat(t, K, axis=0), tree)
+
+    def _gather_beams(self, tree, parent, B, K):
+        # parent: (B, K) beam index per slot → flat (B*K,) row gather
+        flat = (jnp.arange(B)[:, None] * K + parent).reshape(-1)
+        return jax.tree.map(lambda t: t[flat], tree)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=20,
+                   output_time_major=False, **kwargs):
+    """Reference: paddle.nn.dynamic_decode.  Returns (predicted_ids,
+    final_cell_states); predicted_ids (B, T, beam) (or time-major),
+    finalized through F.gather_tree so each beam carries its full ancestry.
+
+    The decode is one compiled ``lax.scan`` of ``max_step_num`` steps;
+    beams that emit ``end_token`` are frozen (their score stops changing
+    and they keep emitting ``end_token``).
+    """
+    cell = decoder.cell
+    K = decoder.beam_size
+    params = dict(cell.named_parameters())
+
+    if inits is None:
+        raise ValueError("dynamic_decode: pass inits (initial cell states, "
+                         "batch-major) — e.g. encoder final states")
+    B = jax.tree.leaves(inits)[0].shape[0]
+    states = decoder._tile(inits, K)                      # (B*K, ...)
+
+    tokens0 = jnp.full((B * K,), decoder.start_token, jnp.int32)
+    # lane 0 active, lanes 1.. start at -inf so step 1 expands one beam
+    lp0 = jnp.where(jnp.arange(K) == 0, 0.0, -1e9)
+    log_probs0 = jnp.broadcast_to(lp0[None, :], (B, K))
+    finished0 = jnp.zeros((B, K), bool)
+
+    def embed(tok):
+        if decoder.embedding_fn is not None:
+            return decoder.embedding_fn(tok)
+        return jax.nn.one_hot(tok, getattr(cell, "input_size"))
+
+    def step(carry, _):
+        tokens, states, log_probs, finished = carry
+        x = embed(tokens)
+        new_states = functional_call(cell, params, x, states)
+        h = new_states[0] if isinstance(new_states, tuple) else new_states
+        logits = decoder.output_fn(h) if decoder.output_fn is not None else h
+        V = logits.shape[-1]
+        step_lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        step_lp = step_lp.reshape(B, K, V)
+        # finished beams: only end_token continues, at no cost
+        eos_row = jnp.full((V,), -1e9).at[decoder.end_token].set(0.0)
+        step_lp = jnp.where(finished[:, :, None], eos_row[None, None, :],
+                            step_lp)
+        total = log_probs[:, :, None] + step_lp                # (B, K, V)
+        flat = total.reshape(B, K * V)
+        new_lp, idx = jax.lax.top_k(flat, K)                   # (B, K)
+        parent = idx // V
+        token = (idx % V).astype(jnp.int32)
+        new_states = decoder._gather_beams(new_states, parent, B, K)
+        new_finished = jnp.take_along_axis(finished, parent, axis=1) | \
+            (token == decoder.end_token)
+        carry = (token.reshape(-1), new_states, new_lp, new_finished)
+        return carry, (token, parent.astype(jnp.int32))
+
+    (tokens, states, log_probs, finished), (ids, parents) = jax.lax.scan(
+        step, (tokens0, states, log_probs0, finished0), None,
+        length=max_step_num)
+    # ids/parents: (T, B, K) → ancestry-resolved sequences
+    seqs = F.gather_tree(ids, parents)
+    if not output_time_major:
+        seqs = jnp.transpose(seqs, (1, 0, 2))                  # (B, T, K)
+    return seqs, states

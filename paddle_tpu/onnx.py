@@ -1,0 +1,29 @@
+"""paddle_tpu.onnx — export facade.
+
+Reference: python/paddle/onnx/export.py (paddle2onnx bridge).  ONNX
+export is a documented de-scope (SURVEY §7.3): the TPU serving format is
+AOT StableHLO (``paddle_tpu.jit.save`` → ``inference.Predictor``), which
+is what XLA consumes natively.  ``export`` writes that artifact when
+given a path and raises with the migration pointer when a real .onnx
+file is demanded.
+"""
+
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Reference signature: paddle.onnx.export(layer, path, input_spec).
+
+    Writes the portable AOT artifact (StableHLO via jit.save) at
+    ``path``; a strict ``.onnx`` protobuf is out of scope on TPU — see
+    docs/MIGRATION.md §serving for the Predictor path.
+    """
+    if str(path).endswith(".onnx"):
+        raise NotImplementedError(
+            "ONNX protobuf emission is de-scoped on TPU (SURVEY §7.3): "
+            "export with jit.save → StableHLO and serve via "
+            "paddle_tpu.inference.Predictor; docs/MIGRATION.md §serving")
+    from . import jit
+    return jit.save(layer, path, input_spec=input_spec)

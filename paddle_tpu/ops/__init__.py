@@ -714,11 +714,16 @@ logcumsumexp = getattr(jnp, "logcumsumexp", None) or (
 
 from .more import *  # noqa: F401,F403,E402 — breadth ops (see more.py)
 from .tail3 import *  # noqa: F401,F403,E402 — round-3 tail (see tail3.py)
+from .tail4 import *  # noqa: F401,F403,E402 — round-4 tail (see tail4.py)
 
 # Star-export surface: everything public defined here, nothing imported.
 _EXCLUDE = {"jax", "jnp", "np", "dispatch", "more", "Optional", "Sequence",
             "Union", "Tensor", "convert_dtype", "get_default_dtype",
-            "to_tensor", "annotations"}
+            "to_tensor", "annotations",
+            # the class-namespace forms stay reachable as ops.linalg/ops.fft
+            # but must not shadow the real paddle_tpu.linalg/.fft MODULES in
+            # the top-level star-import (python/paddle/linalg.py parity)
+            "linalg", "fft"}
 __all__ = [_n for _n in dir() if not _n.startswith("_") and _n not in _EXCLUDE]
 
 # Register Pallas TPU kernels into the dispatch table (no-op off-TPU: the

@@ -1,0 +1,156 @@
+"""Round-4 op tail: remaining top-level tensor API + full inplace-suffix
+surface.
+
+Reference: python/paddle/tensor/{math,random,creation,manipulation,logic}.py
+members not yet covered (SURVEY §2.6 tensor-ops row, VERDICT r3 missing #2).
+Oracle tests in tests/test_ops_tail4.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .tail3 import _make_inplace, _seeded_key
+
+
+# ---------------------------------------------------------------------------
+# math / linalg tail
+# ---------------------------------------------------------------------------
+
+def multigammaln(x, p, name=None):
+    """Reference: paddle.multigammaln (log multivariate gamma)."""
+    from jax.scipy.special import multigammaln as _m
+    return _m(jnp.asarray(x), int(p))
+
+
+def vdot(x, y, name=None):
+    """Reference: paddle.vdot — 1-D dot with complex conjugation of x."""
+    return jnp.vdot(jnp.asarray(x), jnp.asarray(y))
+
+
+def sigmoid(x, name=None):
+    """Reference: paddle.sigmoid (top-level alias of F.sigmoid)."""
+    return jax.nn.sigmoid(jnp.asarray(x))
+
+
+def permute(x, *perm, name=None):
+    """Reference: paddle.permute — accepts a perm sequence or varargs."""
+    if len(perm) == 1 and isinstance(perm[0], (list, tuple)):
+        perm = tuple(perm[0])
+    return jnp.transpose(jnp.asarray(x), perm)
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    from ..core import convert_dtype, get_default_dtype
+    dt = convert_dtype(dtype) if dtype is not None else get_default_dtype()
+    return jnp.logspace(start, stop, int(num), base=base, dtype=dt)
+
+
+def tolist(x, name=None):
+    """Reference: paddle.tolist — nested Python list (host transfer)."""
+    import numpy as np
+    return np.asarray(x).tolist()
+
+
+def is_empty(x, name=None):
+    """Reference: paddle.is_empty — numel == 0 (static under jit)."""
+    return jnp.asarray(jnp.asarray(x).size == 0)
+
+
+def floor_mod(x, y, name=None):
+    """Reference: paddle.floor_mod (alias of mod/remainder, sign follows
+    the divisor)."""
+    return jnp.mod(jnp.asarray(x), jnp.asarray(y))
+
+
+def cat(x, axis=0, name=None):
+    """Reference: paddle.cat (torch-compat alias of concat)."""
+    from . import concat as _concat
+    return _concat(x, axis=axis)
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    from ..core import convert_dtype
+    x = jnp.asarray(x)
+    if high is None:
+        low, high = 0, low
+    dt = convert_dtype(dtype) if dtype is not None else x.dtype
+    key = _seeded_key("randint_like", 0)
+    return jax.random.randint(key, x.shape, int(low), int(high)).astype(dt)
+
+
+# ---------------------------------------------------------------------------
+# random in-place fills (value-returning: jax arrays are immutable, same
+# deviation note as tail3's uniform_/normal_)
+# ---------------------------------------------------------------------------
+
+def bernoulli_(x, p=0.5, seed=0, name=None):
+    """Reference: paddle.bernoulli_ — fill with Bernoulli(p) samples."""
+    key = _seeded_key("bernoulli_", seed)
+    x = jnp.asarray(x)
+    dt = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
+    return jax.random.bernoulli(key, p, x.shape).astype(dt)
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    """Reference: paddle.cauchy_ — fill with Cauchy(loc, scale) samples."""
+    key = _seeded_key("cauchy_", 0)
+    x = jnp.asarray(x)
+    dt = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
+    return loc + scale * jax.random.cauchy(key, x.shape, dt)
+
+
+def geometric_(x, probs, name=None):
+    """Reference: paddle.geometric_ — fill with Geometric(probs) samples
+    (trial count of first success, support {1, 2, ...})."""
+    key = _seeded_key("geometric_", 0)
+    x = jnp.asarray(x)
+    dt = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
+    u = jax.random.uniform(key, x.shape, jnp.float32,
+                           minval=jnp.finfo(jnp.float32).tiny)
+    k = jnp.floor(jnp.log(u) / jnp.log1p(-jnp.asarray(probs, jnp.float32)))
+    return (k + 1.0).astype(dt)
+
+
+# ---------------------------------------------------------------------------
+# printing / host utilities
+# ---------------------------------------------------------------------------
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Reference: paddle.set_printoptions — jax array reprs are rendered by
+    numpy, so this maps onto numpy's global print options."""
+    import numpy as np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+# ---------------------------------------------------------------------------
+# remaining inplace-suffix surface (bases already exist in ops)
+# ---------------------------------------------------------------------------
+
+_INPLACE_BASES4 = [
+    "acos", "acosh", "asin", "asinh", "atan", "atan2", "atanh", "copysign",
+    "cos", "cosh", "cumprod", "cumsum", "erf", "expm1", "flatten",
+    "gammainc", "gammaincc", "gammaln", "hypot", "i0", "index_add", "lcm",
+    "gcd", "ldexp", "log", "log10", "log1p", "log2", "logical_and",
+    "logical_not", "logical_or", "logical_xor", "logit", "masked_fill",
+    "masked_scatter", "multigammaln", "nan_to_num", "nextafter", "renorm",
+    "reshape", "scatter", "sigmoid", "sin", "sinh", "square", "squeeze",
+    "stanh", "t", "tan", "tril", "triu", "unsqueeze", "where", "polygamma",
+]
+
+for _base in _INPLACE_BASES4:
+    globals()[_base + "_"] = _make_inplace(_base)
+del _base

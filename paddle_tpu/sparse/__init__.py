@@ -277,3 +277,116 @@ nn.functional = type("functional", (), {"softmax": staticmethod(softmax),
 from .rows import RowsGrad, embedding_rows_grad  # noqa: E402,F401
 
 __all__ += ["RowsGrad", "embedding_rows_grad"]
+
+
+# ---------------------------------------------------------------------------
+# round-4 sparse tail (reference: paddle/sparse/{unary,binary,matmul}.py)
+# ---------------------------------------------------------------------------
+
+deg2rad = _make_unary("deg2rad", jnp.deg2rad)
+rad2deg = _make_unary("rad2deg", jnp.rad2deg)
+isnan = _make_unary("isnan", jnp.isnan)
+
+
+def divide(x, y):
+    """x sparse / y (sparse or dense), on x's sparsity pattern."""
+    b = _coo(x).sum_duplicates()
+    yd = y.to_dense() if isinstance(y, (SparseCooTensor, SparseCsrTensor)) \
+        else jnp.asarray(y)
+    gathered = yd[tuple(b.indices[:, i] for i in range(b.indices.shape[1]))]
+    return SparseCooTensor(jsparse.BCOO((b.data / gathered, b.indices),
+                                        shape=b.shape))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    """beta·input + alpha·(x @ y): x sparse COO, input/y dense
+    (reference: paddle.sparse.addmm)."""
+    return beta * jnp.asarray(input) + alpha * matmul(x, jnp.asarray(y))
+
+
+def mv(x, vec):
+    """Sparse matrix × dense vector (reference: paddle.sparse.mv)."""
+    b = _coo(x).sum_duplicates()
+    v = jnp.asarray(vec)
+    contrib = b.data * v[b.indices[:, 1]]
+    return jnp.zeros((b.shape[0],), b.data.dtype).at[b.indices[:, 0]] \
+        .add(contrib)
+
+
+def mask_as(x, mask):
+    """Dense x sampled at mask's sparsity pattern (reference:
+    paddle.sparse.mask_as)."""
+    b = _coo(mask).sum_duplicates()
+    xd = jnp.asarray(x)
+    vals = xd[tuple(b.indices[:, i] for i in range(b.indices.shape[1]))]
+    return SparseCooTensor(jsparse.BCOO((vals, b.indices), shape=b.shape))
+
+
+def reshape(x, shape):
+    """Reindex stored entries to the new shape (same element order as the
+    dense reshape)."""
+    b = _coo(x).sum_duplicates()
+    old = b.shape
+    new = tuple(int(s) for s in shape)
+    if -1 in new:
+        known = int(np.prod([s for s in new if s != -1]))
+        new = tuple(int(np.prod(old)) // known if s == -1 else s
+                    for s in new)
+    flat = jnp.zeros((b.indices.shape[0],), jnp.int32)
+    for i, dim in enumerate(old):
+        flat = flat * dim + b.indices[:, i]
+    new_idx = []
+    rem = flat
+    for dim in reversed(new):
+        new_idx.append(rem % dim)
+        rem = rem // dim
+    idx = jnp.stack(list(reversed(new_idx)), axis=1).astype(b.indices.dtype)
+    return SparseCooTensor(jsparse.BCOO((b.data, idx), shape=new))
+
+
+def slice(x, axes, starts, ends):
+    """Sub-window of a sparse tensor.  nnz of the result is data-dependent
+    → host-side filtering (dataloader domain), same stance as geometric
+    sampling."""
+    b = _coo(x).sum_duplicates()
+    idx = np.asarray(b.indices)
+    data = np.asarray(b.data)
+    new_shape = list(b.shape)
+    keep = np.ones(idx.shape[0], bool)
+    for ax, st, en in zip(axes, starts, ends):
+        ax = int(ax)
+        st = int(st) if st >= 0 else int(st) + b.shape[ax]
+        en = min(int(en) if en >= 0 else int(en) + b.shape[ax], b.shape[ax])
+        keep &= (idx[:, ax] >= st) & (idx[:, ax] < en)
+        new_shape[ax] = en - st
+    idx = idx[keep].copy()
+    for ax, st, _ in zip(axes, starts, ends):
+        st = int(st) if st >= 0 else int(st) + b.shape[int(ax)]
+        idx[:, int(ax)] -= st
+    return SparseCooTensor(jsparse.BCOO(
+        (jnp.asarray(data[keep]), jnp.asarray(idx)),
+        shape=tuple(new_shape)))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    """Sum over all entries (dense 0-D) or along one axis (sparse)."""
+    b = _coo(x).sum_duplicates()
+    if axis is None:
+        out = jnp.sum(b.data, dtype=dtype)
+        return out.reshape((1,) * len(b.shape)) if keepdim else out
+    ax = int(axis) % len(b.shape)
+    rest = [i for i in range(len(b.shape)) if i != ax]
+    new_idx = b.indices[:, rest]
+    new_shape = tuple(b.shape[i] for i in rest)
+    out = jsparse.BCOO((b.data if dtype is None else b.data.astype(dtype),
+                        new_idx), shape=new_shape).sum_duplicates()
+    if keepdim:
+        idx = jnp.insert(out.indices, ax, 0, axis=1)
+        shape = list(new_shape)
+        shape.insert(ax, 1)
+        out = jsparse.BCOO((out.data, idx), shape=tuple(shape))
+    return SparseCooTensor(out)
+
+
+__all__ += ["deg2rad", "rad2deg", "isnan", "divide", "addmm", "mv",
+            "mask_as", "reshape", "slice", "sum"]

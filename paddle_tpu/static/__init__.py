@@ -218,35 +218,21 @@ class CompiledProgram:
         self.program = program
 
 
-class nn:
-    """``paddle.static.nn`` subset: layers that create parameters eagerly
-    and record the op symbolically."""
+# ``paddle.static.nn`` is a real submodule (reference:
+# python/paddle/static/nn/) — layer builders that create parameters
+# eagerly and record ops symbolically, plus the (padded, length) sequence
+# op suite.  Imported lazily via __getattr__ below to avoid a circular
+# import (nn.py needs jit.control_flow which needs this module).
 
-    @staticmethod
-    def fc(x: Var, size: int, activation=None, name=None):
-        from ..nn.layers_common import Linear
-        in_dim = x.shape[-1]
-        if in_dim in (None, -1):
-            raise ValueError("static.nn.fc needs a static last dim")
-        layer = Linear(int(in_dim), size)
-        w, b = layer.weight, layer.bias
-        out = apply(lambda v, w, b: v @ w + b, x, w, b)
-        if activation == "relu":
-            out = apply(jax.nn.relu, out)
-        elif activation == "tanh":
-            out = apply(jnp.tanh, out)
-        elif activation == "softmax":
-            out = apply(jax.nn.softmax, out)
-        return out
 
-    # control-flow ops (reference: python/paddle/static/nn/control_flow.py)
-    # — lax-backed, usable both eagerly and inside compiled programs
-    from ..jit.control_flow import (case, cond,  # noqa: F401
-                                    switch_case, while_loop)
-    case = staticmethod(case)
-    cond = staticmethod(cond)
-    switch_case = staticmethod(switch_case)
-    while_loop = staticmethod(while_loop)
+def __getattr__(name):
+    if name == "nn":
+        import importlib
+        mod = importlib.import_module(".nn", __name__)
+        globals()["nn"] = mod
+        return mod
+    raise AttributeError(f"module 'paddle_tpu.static' has no attribute "
+                         f"{name!r}")
 
 
 # -- mode toggles (reference: paddle.enable_static/disable_static,
@@ -535,3 +521,156 @@ def cpu_places(device_count=None):
     import os as _os
     n = device_count or int(_os.environ.get("CPU_NUM", 1))
     return [CPUPlace() for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# round-4 static tail (reference: python/paddle/static/__init__.py surface)
+# ---------------------------------------------------------------------------
+
+Variable = Var  # reference name for graph variables
+
+
+def cuda_places(device_ids=None):
+    """Reference: paddle.static.cuda_places — accelerator places; the
+    accelerator here is the TPU."""
+    from ..device import TPUPlace, device_count
+    ids = device_ids if device_ids is not None else range(device_count())
+    return [TPUPlace(i) for i in ids]
+
+
+xpu_places = cuda_places
+npu_places = cuda_places
+
+
+@_contextlib.contextmanager
+def device_guard(device=None):
+    """Reference: paddle.static.device_guard — pin ops to a device.  Under
+    XLA, placement is whole-computation (jit backend) not per-op; 'cpu'
+    guards map to jax.default_device(cpu) which IS per-region."""
+    import jax as _jax
+    if device and str(device).startswith("cpu"):
+        with _jax.default_device(_jax.devices("cpu")[0]):
+            yield
+    else:
+        yield  # accelerator placement is the jit default
+
+
+@_contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    """Reference: paddle.static.ipu_shard_guard — IPU pipeline-stage
+    annotation.  No IPUs here: accepted and ignored so ported code runs;
+    use distributed.pipeline for real pipeline parallelism."""
+    yield
+
+
+def save(program, model_path, protocol=4):
+    """Reference: paddle.static.save — persist program parameter state."""
+    from .. import ckpt as _ckpt
+    _ckpt.save(save_program_state(program), model_path + ".pdparams")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """Reference: paddle.static.load — restore parameters saved by save()."""
+    from .. import ckpt as _ckpt
+    set_program_state(program, _ckpt.load(model_path + ".pdparams"))
+
+
+def save_program_state(program=None):
+    """Snapshot {name: array} of the program's parameters."""
+    prog = program or default_main_program()
+    return dict(getattr(prog, "params", {}))
+
+
+def load_program_state(model_path, var_list=None):
+    """Reference: paddle.static.load_program_state — returns the raw
+    {name: array} dict for set_program_state."""
+    from .. import ckpt as _ckpt
+    return _ckpt.load(model_path + ".pdparams")
+
+
+def set_program_state(program, state_dict):
+    prog = program or default_main_program()
+    if not hasattr(prog, "params"):
+        prog.params = {}
+    prog.params.update(state_dict)
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Reference: paddle.static.normalize_program — prune the program to
+    the feed→fetch closure for inference export.  Program here records a
+    pure expression graph already (dead nodes are never executed — the
+    Executor evaluates fetches by demand), so this returns the program
+    with feeds/fetches pinned."""
+    program._normalized_io = ([getattr(v, "name", v) for v in feed_vars],
+                              list(fetch_vars))
+    return program
+
+
+class WeightNormParamAttr:
+    """Reference: paddle.static.WeightNormParamAttr — ParamAttr requesting
+    weight normalisation (w = g·v/||v||).  Consumed by nn.utils.weight_norm;
+    carried here so ported configs construct."""
+
+    def __init__(self, dim=None, name=None, initializer=None, trainable=True,
+                 **kw):
+        self.dim, self.name = dim, name
+        self.initializer, self.trainable = initializer, trainable
+
+
+class ExponentialMovingAverage:
+    """Reference: paddle.static.ExponentialMovingAverage — shadow
+    parameters s = decay·s + (1-decay)·p with optional Adam-style
+    debiasing; apply()/restore() swap them in and out.
+
+    Functional form: ``update(params)`` takes the current {name: array}
+    pytree (works with Layer.state_dict or TrainStep params)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self.decay = float(decay)
+        self._shadow = {}
+        self._backup = {}
+        self._step = 0
+
+    def update(self, params):
+        self._step += 1
+        d = self.decay
+        for k, v in dict(params).items():
+            prev = self._shadow.get(k)
+            self._shadow[k] = (1 - d) * v if prev is None \
+                else d * prev + (1 - d) * v
+        return {k: s / (1 - d ** self._step)
+                for k, s in self._shadow.items()}
+
+    @_contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        """Context: yields the debiased shadow dict (reference swaps them
+        into scope; here you pass them to your eval step)."""
+        d = self.decay
+        debiased = {k: s / (1 - d ** max(1, self._step))
+                    for k, s in self._shadow.items()}
+        self._backup = debiased
+        try:
+            yield debiased
+        finally:
+            if need_restore:
+                self._backup = {}
+
+    def restore(self, executor=None):
+        self._backup = {}
+
+
+__all__ += ["Variable", "cuda_places", "xpu_places", "npu_places",
+            "device_guard", "ipu_shard_guard", "save", "load",
+            "save_program_state", "load_program_state", "set_program_state",
+            "normalize_program", "WeightNormParamAttr",
+            "ExponentialMovingAverage"]
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """Reference: paddle.static.py_func (alias of static.nn.py_func)."""
+    from .nn import py_func as _impl
+    return _impl(func, x, out, backward_func, skip_vars_in_backward_input)
+
+
+__all__ += ["py_func"]

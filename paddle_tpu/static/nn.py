@@ -1,0 +1,575 @@
+"""paddle_tpu.static.nn — the static-graph layer builders.
+
+Reference: python/paddle/static/nn/{common,sequence_lod,control_flow}.py.
+
+Two deliberate TPU-first redesigns (SURVEY §7.0/§7.3):
+
+1. **Parameters are created eagerly** at build time (each call = one layer
+   instantiation, exactly the reference's semantics) and the computation
+   records onto ``static.Var`` via the op-dispatch layer, or runs
+   immediately when given arrays.
+
+2. **LoD sequences become (padded, length)**: the reference's
+   variable-length LoD tensor is replaced by a dense ``(B, T, ...)``
+   tensor plus a ``(B,)`` length vector — XLA needs static shapes, and
+   padded-dense is the layout every TPU sequence model uses anyway.  All
+   ``sequence_*`` ops below take/return this pair convention.  Ops whose
+   *output* shape is data-dependent (``sequence_unpad``/``sequence_
+   reshape``/``sequence_expand``) run on host NumPy: they are dataloader-
+   domain transforms, same stance as geometric sampling.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+# control-flow ops (lax-backed, shared with jit)
+from ..jit.control_flow import case, cond, switch_case, while_loop  # noqa: F401
+
+
+def _time_mask(length, T, dtype=jnp.float32):
+    return (jnp.arange(T)[None, :] < jnp.asarray(length)[:, None]).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# parameterised builders (create params eagerly, then run/record)
+# ---------------------------------------------------------------------------
+
+def _track_params(layer, prefix):
+    """Register a builder-created layer's parameters on the active
+    Program so static.save/save_program_state persist them (the
+    reference's builders register Variables in the block the same way)."""
+    from . import default_main_program
+    prog = default_main_program()
+    if not hasattr(prog, "params"):
+        prog.params = {}
+    base = f"{prefix}_{len(prog.params)}"
+    for pname, p in layer.named_parameters():
+        prog.params[f"{base}.{pname}"] = p
+    return layer
+
+
+def fc(x, size, num_flatten_dims=1, activation=None, name=None, **kw):
+    """Reference semantics: dims [num_flatten_dims:] flatten into the
+    matmul's feature axis; output shape is
+    x.shape[:num_flatten_dims] + [size]."""
+    import math as _math
+
+    from ..nn.layers_common import Linear
+    from . import apply
+    if isinstance(num_flatten_dims, str):
+        raise TypeError(
+            "static.nn.fc: activation is keyword-only "
+            "(fc(x, size, activation='relu')) — got a string for "
+            "num_flatten_dims")
+    nfd = int(num_flatten_dims)
+    if any(s in (None, -1) for s in x.shape[nfd:]):
+        raise ValueError("static.nn.fc needs static dims past "
+                         f"num_flatten_dims={nfd}")
+    feat = int(_math.prod(int(s) for s in x.shape[nfd:]))
+    layer = _track_params(Linear(feat, size), "fc")
+    w, b = layer.weight, layer.bias
+
+    def run(v, ww, bb):
+        flat = v.reshape((-1, feat))
+        # leading dims from the runtime value (batch may be -1 at build)
+        return (flat @ ww + bb).reshape(tuple(v.shape[:nfd]) + (size,))
+
+    out = apply(run, x, w, b)
+    if activation == "relu":
+        out = apply(jax.nn.relu, out)
+    elif activation == "tanh":
+        out = apply(jnp.tanh, out)
+    elif activation == "softmax":
+        out = apply(jax.nn.softmax, out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    """size = (vocab, dim).  is_sparse maps to the rows-sparse gradient
+    channel (sparse/rows.py) the same way the reference's sparse
+    embedding update does."""
+    from ..nn.layers_common import Embedding
+    layer = _track_params(Embedding(size[0], size[1],
+                                    padding_idx=padding_idx), "embedding")
+    return layer(input)
+
+
+def sparse_embedding(input, size, padding_idx=None, param_attr=None,
+                     dtype="float32", **kw):
+    """Reference: static.nn.sparse_embedding — the PS-mode large-table
+    embedding; here the table is dense on HBM and updates flow through
+    RowsGrad (SURVEY §2.5 parameter-server row)."""
+    return embedding(input, size, is_sparse=True, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               is_test=False, **kw):
+    from ..nn.layers_tail4 import BatchNorm
+    ch = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    layer = _track_params(
+        BatchNorm(int(ch), act=act, momentum=momentum, epsilon=epsilon,
+                  param_attr=param_attr, bias_attr=bias_attr,
+                  data_layout=data_layout), "batch_norm")
+    if is_test:
+        layer.eval()
+    return layer(input)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from ..nn import functional as F
+    shape = tuple(int(s) for s in input.shape[begin_norm_axis:])
+    from ..nn.layers_common import LayerNorm
+    layer = _track_params(LayerNorm(shape, epsilon=epsilon), "layer_norm")
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    from ..nn.layers_common import GroupNorm
+    layer = _track_params(GroupNorm(groups, int(input.shape[1]),
+                                    epsilon=epsilon), "group_norm")
+    out = layer(input)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    from ..nn.layers_conv import InstanceNorm2D
+    layer = _track_params(InstanceNorm2D(int(input.shape[1]),
+                                         epsilon=epsilon), "instance_norm")
+    return layer(input)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None, **kw):
+    """Reference: static.nn.data_norm — normalisation by accumulated batch
+    statistics (batch_size/batch_sum/batch_square_sum), used by CTR
+    models.  Statistics initialise to the reference defaults (size 1e4,
+    sum 0, square-sum 1e4 → mean 0, var 1)."""
+    d = int(input.shape[-1])
+    batch_size = jnp.full((d,), 1e4, jnp.float32)
+    batch_sum = jnp.zeros((d,), jnp.float32)
+    batch_sq = jnp.full((d,), 1e4, jnp.float32)
+    mean = batch_sum / batch_size
+    var = batch_sq / batch_size - mean ** 2
+    out = (input - mean) / jnp.sqrt(var + epsilon)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, act=None, param_attr=None, bias_attr=None,
+           data_format="NCHW", name=None):
+    from ..nn.layers_common import Conv2D
+    layer = _track_params(
+        Conv2D(int(input.shape[1]), num_filters, filter_size,
+               stride=stride, padding=padding, dilation=dilation,
+               groups=groups, data_format=data_format), "conv2d")
+    out = layer(input)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, act=None, **kw):
+    from ..nn.layers_conv import Conv3D
+    layer = _track_params(
+        Conv3D(int(input.shape[1]), num_filters, filter_size,
+               stride=stride, padding=padding, dilation=dilation,
+               groups=groups), "conv3d")
+    out = layer(input)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv2d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, dilation=1, groups=1, act=None,
+                     **kw):
+    from ..nn.layers_conv import Conv2DTranspose
+    layer = _track_params(
+        Conv2DTranspose(int(input.shape[1]), num_filters, filter_size,
+                        stride=stride, padding=padding,
+                        dilation=dilation, groups=groups), "conv2d_transpose")
+    out = layer(input)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d_transpose(input, num_filters, filter_size=None, stride=1,
+                     padding=0, dilation=1, groups=1, act=None, **kw):
+    from ..nn.layers_tail4 import Conv3DTranspose
+    layer = _track_params(
+        Conv3DTranspose(int(input.shape[1]), num_filters, filter_size,
+                        stride=stride, padding=padding,
+                        dilation=dilation, groups=groups), "conv3d_transpose")
+    out = layer(input)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  **kw):
+    from ..vision.ops import DeformConv2D
+    layer = DeformConv2D(int(input.shape[1]), num_filters, filter_size,
+                         stride=stride, padding=padding, dilation=dilation,
+                         deformable_groups=deformable_groups, groups=groups)
+    return layer(input, offset, mask)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    """mode: all (one alpha) / channel / element."""
+    from ..nn import functional as F
+    if mode == "all":
+        shape = (1,)
+    elif mode == "channel":
+        shape = (int(x.shape[1]),)
+    else:
+        shape = tuple(int(s) for s in x.shape[1:])
+    alpha = jnp.full(shape, 0.25, jnp.float32)
+    return F.prelu(x, alpha)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Power-iteration spectral normalisation of a weight tensor."""
+    w = jnp.moveaxis(jnp.asarray(weight), dim, 0)
+    mat = w.reshape(w.shape[0], -1)
+    u = jnp.ones((mat.shape[0],), mat.dtype) / math.sqrt(mat.shape[0])
+    for _ in range(max(1, power_iters)):
+        v = mat.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = mat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ mat @ v
+    return jnp.moveaxis((w / sigma), 0, dim)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Reference: static.nn.row_conv — lookahead convolution
+    out[t] = Σ_{i=0..C} x[t+i] ∘ filter[i] (zero past the end)."""
+    x = jnp.asarray(input)                      # (B, T, D)
+    C = int(future_context_size)
+    D = int(x.shape[-1])
+    filt = jnp.full((C + 1, D), 1.0 / (C + 1), jnp.float32)
+    outs = 0.0
+    for i in range(C + 1):
+        shifted = jnp.pad(x[:, i:], ((0, 0), (0, i), (0, 0)))
+        outs = outs + shifted * filt[i]
+    if act:
+        from ..nn import functional as F
+        outs = getattr(F, act)(outs)
+    return outs
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Reference: static.nn.nce — noise-contrastive estimation loss with a
+    uniform negative sampler; per-sample loss (B, 1)."""
+    from ..core import random as prandom
+    x = jnp.asarray(input)                       # (B, D)
+    lab = jnp.asarray(label).reshape(-1)
+    B, D = x.shape
+    V, S = int(num_total_classes), int(num_neg_samples)
+    w = jax.random.normal(jax.random.PRNGKey(seed or 7), (V, D)) \
+        * (1.0 / math.sqrt(D))
+    b = jnp.zeros((V,))
+    key = jax.random.PRNGKey(int(seed)) if seed else \
+        prandom.next_key("nce")
+    neg = jax.random.randint(key, (B, S), 0, V)
+    logq = math.log(S / V)  # uniform noise: S·q(y) = S/V
+    pos_logit = jnp.sum(x * w[lab], -1) + b[lab] - logq
+    neg_logit = jnp.einsum("bd,bsd->bs", x, w[neg]) + b[neg] - logq
+    loss = jax.nn.softplus(-pos_logit) + \
+        jnp.sum(jax.nn.softplus(neg_logit), -1)
+    return loss[:, None]
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Reference: static.nn.py_func — run host Python inside the graph.
+    Maps onto ``jax.pure_callback`` (result shape/dtype taken from the
+    ``out`` template); ``backward_func`` becomes a custom VJP whose
+    cotangent also round-trips through host."""
+    xs = tuple(x) if isinstance(x, (list, tuple)) else (x,)
+    template = jax.tree.map(
+        lambda t: jax.ShapeDtypeStruct(t.shape, t.dtype), out)
+
+    def call(*args):
+        # concrete args → run on host directly (also sidesteps PJRT
+        # plugins without host-callback support); tracers → pure_callback
+        if not any(isinstance(a, jax.core.Tracer) for a in args):
+            res = func(*[np.asarray(a) for a in args])
+            return jax.tree.map(jnp.asarray, res)
+        return jax.pure_callback(func, template, *args)
+
+    if backward_func is None:
+        return call(*xs)
+
+    @jax.custom_vjp
+    def f(*args):
+        return call(*args)
+
+    def fwd(*args):
+        return call(*args), args
+
+    def bwd(res, g):
+        grads = jax.pure_callback(
+            backward_func,
+            jax.tree.map(lambda t: jax.ShapeDtypeStruct(t.shape, t.dtype),
+                         res),
+            *res, g)
+        return tuple(grads) if isinstance(grads, (list, tuple)) else (grads,)
+
+    f.defvjp(fwd, bwd)
+    return f(*xs)
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """Reference: static.nn.static_pylayer — custom forward/backward pair
+    as a graph op; identical mechanics to autograd.PyLayer on custom_vjp."""
+    if backward_fn is None:
+        return forward_fn(*inputs)
+
+    @jax.custom_vjp
+    def f(*args):
+        return forward_fn(*args)
+
+    def fwd(*args):
+        return forward_fn(*args), args
+
+    def bwd(res, g):
+        out = backward_fn(g)
+        return tuple(out) if isinstance(out, (list, tuple)) else (out,)
+
+    f.defvjp(fwd, bwd)
+    return f(*inputs)
+
+
+# ---------------------------------------------------------------------------
+# sequence ops over the (padded, length) convention
+# ---------------------------------------------------------------------------
+
+def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
+    """Concatenated rows (total, D) + length (B,) → (padded (B, L, D),
+    length).  The inverse of sequence_unpad."""
+    if length is None:
+        raise ValueError("sequence_pad needs the per-sequence length "
+                         "vector (the (padded, length) convention — see "
+                         "module docstring)")
+    ln = np.asarray(length)
+    xs = np.asarray(x)
+    L = int(maxlen) if maxlen else int(ln.max())
+    D = xs.shape[1:]
+    out = np.full((len(ln), L) + D, np.asarray(pad_value), xs.dtype)
+    off = 0
+    for i, n in enumerate(ln):
+        out[i, :n] = xs[off:off + n]
+        off += n
+    return jnp.asarray(out), jnp.asarray(ln)
+
+
+def sequence_unpad(x, length, name=None):
+    """(B, L, D) + length → concatenated (total, D).  Output shape is
+    data-dependent → host-side (dataloader domain)."""
+    xs = np.asarray(x)
+    ln = np.asarray(length)
+    return jnp.asarray(np.concatenate([xs[i, :n] for i, n in enumerate(ln)],
+                                      axis=0))
+
+
+def sequence_pool(input, pool_type, length=None, pad_value=0.0):
+    """pool_type: sum/average/sqrt/max/last/first over the valid prefix."""
+    x = jnp.asarray(input)
+    B, T = x.shape[0], x.shape[1]
+    if length is None:
+        length = jnp.full((B,), T, jnp.int32)
+    mask = _time_mask(length, T, x.dtype)
+    while mask.ndim < x.ndim:
+        mask = mask[..., None]
+    pool_type = pool_type.lower()
+    if pool_type == "sum":
+        return jnp.sum(x * mask, axis=1)
+    if pool_type == "average":
+        return jnp.sum(x * mask, axis=1) / jnp.maximum(
+            jnp.asarray(length, x.dtype)[:, None], 1)
+    if pool_type == "sqrt":
+        return jnp.sum(x * mask, axis=1) / jnp.sqrt(jnp.maximum(
+            jnp.asarray(length, x.dtype)[:, None], 1))
+    if pool_type == "max":
+        neg = jnp.asarray(jnp.finfo(jnp.float32).min, x.dtype)
+        return jnp.max(jnp.where(mask > 0, x, neg), axis=1)
+    if pool_type == "last":
+        idx = jnp.maximum(jnp.asarray(length) - 1, 0)
+        return jnp.take_along_axis(
+            x, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    if pool_type == "first":
+        return x[:, 0]
+    raise ValueError(f"unknown pool_type {pool_type!r}")
+
+
+def sequence_first_step(input, length=None):
+    return sequence_pool(input, "first", length)
+
+
+def sequence_last_step(input, length=None):
+    return sequence_pool(input, "last", length)
+
+
+def sequence_softmax(input, length=None, name=None):
+    x = jnp.asarray(input)
+    if length is None:
+        return jax.nn.softmax(x, axis=1)
+    mask = _time_mask(length, x.shape[1], jnp.float32)
+    while mask.ndim < x.ndim:
+        mask = mask[..., None]
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, x.dtype)
+    return jax.nn.softmax(jnp.where(mask > 0, x, neg), axis=1) * mask.astype(x.dtype)
+
+
+def sequence_reverse(x, length=None, name=None):
+    """Reverse the valid prefix of each row, keep padding in place."""
+    x = jnp.asarray(x)
+    T = x.shape[1]
+    if length is None:
+        return jnp.flip(x, axis=1)
+    ln = jnp.asarray(length)[:, None]
+    t = jnp.arange(T)[None, :]
+    src = jnp.where(t < ln, ln - 1 - t, t).astype(jnp.int32)
+    return jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+
+
+def sequence_concat(input, length=None, name=None):
+    """Concatenate sequences time-wise: parts [(B, Ti, D)] + lengths
+    [(B,)] → (B, ΣTi, D) packed back-to-back per row, plus new lengths."""
+    if length is None:
+        return jnp.concatenate([jnp.asarray(p) for p in input], axis=1)
+    parts = [jnp.asarray(p) for p in input]
+    lens = [jnp.asarray(l) for l in length]
+    B = parts[0].shape[0]
+    Ltot = sum(int(p.shape[1]) for p in parts)
+    total = sum(lens)
+    out = jnp.zeros((B, Ltot) + parts[0].shape[2:], parts[0].dtype)
+    offset = jnp.zeros((B,), jnp.int32)
+    for p, ln in zip(parts, lens):
+        T = p.shape[1]
+        t = jnp.arange(T)[None, :]
+        dstpos = offset[:, None] + t                      # (B, T)
+        valid = t < ln[:, None]
+        dstpos = jnp.where(valid, dstpos, Ltot)           # drop slot
+        bidx = jnp.broadcast_to(jnp.arange(B)[:, None], dstpos.shape)
+        out = out.at[bidx, dstpos].set(p, mode="drop")
+        offset = offset + ln.astype(jnp.int32)
+    return out, total
+
+
+def sequence_expand(x, y_length, ref_level=0, name=None):
+    """Repeat each row i of x y_length[i] times (host-side: output rows
+    are data-dependent)."""
+    xs = np.asarray(x)
+    reps = np.asarray(y_length)
+    return jnp.asarray(np.repeat(xs, reps, axis=0))
+
+
+def sequence_expand_as(x, y, name=None):
+    xs = np.asarray(x)
+    return jnp.asarray(np.repeat(xs, len(np.asarray(y)) // len(xs), axis=0))
+
+
+def sequence_reshape(input, new_dim, name=None):
+    """Re-chunk concatenated rows to a new feature width (host-side)."""
+    xs = np.asarray(input)
+    return jnp.asarray(xs.reshape(-1, new_dim))
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    """(B, T) ids → (B, T, win) sliding windows padded with pad_value."""
+    x = jnp.asarray(input)
+    outs = []
+    T = x.shape[1]
+    for i in range(int(win_size)):
+        shifted = jnp.pad(x[:, i:], ((0, 0), (0, i)),
+                          constant_values=pad_value)
+        outs.append(shifted)
+    return jnp.stack(outs, axis=-1)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, length=None, act=None, param_attr=None):
+    """Context-window convolution over time (reference sequence_conv with
+    the default symmetric context padding)."""
+    x = jnp.asarray(input)                      # (B, T, D)
+    B, T, D = x.shape
+    half = (int(filter_size) - 1) // 2
+    ctx = []
+    for i in range(-half, int(filter_size) - half):
+        if i < 0:
+            shifted = jnp.pad(x[:, :T + i], ((0, 0), (-i, 0), (0, 0)))
+        elif i > 0:
+            shifted = jnp.pad(x[:, i:], ((0, 0), (0, i), (0, 0)))
+        else:
+            shifted = x
+        ctx.append(shifted)
+    stacked = jnp.concatenate(ctx, axis=-1)     # (B, T, fs*D)
+    w = jax.random.normal(jax.random.PRNGKey(11),
+                          (stacked.shape[-1], num_filters)) \
+        / math.sqrt(stacked.shape[-1])
+    out = stacked @ w
+    if length is not None:
+        out = out * _time_mask(length, T, out.dtype)[..., None]
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    """Per-row slice: row i keeps [offset[i], offset[i]+length[i]).
+    Slice length must be uniform (static shapes); returns (B, max_len, D)
+    with rows gathered from their offsets."""
+    x = jnp.asarray(input)
+    off = jnp.asarray(offset).reshape(-1)
+    ln = np.asarray(length).reshape(-1)
+    L = int(ln.max())
+    t = jnp.arange(L)[None, :]
+    src = jnp.clip(off[:, None] + t, 0, x.shape[1] - 1).astype(jnp.int32)
+    out = jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+    mask = (t < jnp.asarray(ln)[:, None])
+    return out * mask.reshape(mask.shape + (1,) * (x.ndim - 2)).astype(x.dtype)
+
+
+def sequence_scatter(input, index, updates, length=None, name=None):
+    """Scatter-add updates into per-row time positions: index (B, K) time
+    slots, updates (B, K, D)."""
+    x = jnp.asarray(input)
+    idx = jnp.asarray(index).astype(jnp.int32)
+    upd = jnp.asarray(updates)
+    B = x.shape[0]
+    bidx = jnp.broadcast_to(jnp.arange(B)[:, None], idx.shape)
+    return x.at[bidx, idx].add(upd)

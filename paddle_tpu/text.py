@@ -1,0 +1,251 @@
+"""paddle_tpu.text — NLP datasets + Viterbi decoding.
+
+Reference: python/paddle/text/ (datasets/* and viterbi_decode.py).
+
+Viterbi is the real compute here and is implemented as a ``lax.scan``
+dynamic program (one pass over time, argmax backtrace on the reverse
+pass) — compiles once, runs on-chip.  Datasets read from local files
+(zero-egress environment): every class takes ``data_file`` pointing at
+the upstream-format archive member and raises with the expected format
+when absent.
+"""
+
+from __future__ import annotations
+
+import os
+import tarfile
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .io import Dataset
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16", "ViterbiDecoder", "viterbi_decode"]
+
+
+# ---------------------------------------------------------------------------
+# Viterbi decode (reference: paddle.text.viterbi_decode / ViterbiDecoder)
+# ---------------------------------------------------------------------------
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """Max-score tag path under a linear-chain CRF.
+
+    potentials (B, T, N) emission scores, transition_params (N, N) with
+    ``trans[i, j]`` = score of moving FROM tag j TO tag i (the reference's
+    convention), lengths (B,) valid steps.  Returns (scores, paths
+    (B, T) int64 with zeros past each length).
+
+    With ``include_bos_eos_tag`` the last two tags are BOS/EOS: BOS→first
+    and last→EOS transitions are added, as in the reference.
+    """
+    em = jnp.asarray(potentials, jnp.float32)
+    trans = jnp.asarray(transition_params, jnp.float32)
+    B, T, N = em.shape
+    if lengths is None:
+        lengths = jnp.full((B,), T, jnp.int32)
+    lengths = jnp.asarray(lengths, jnp.int32)
+
+    if include_bos_eos_tag:
+        bos, eos = N - 2, N - 1
+        alpha0 = em[:, 0] + trans[:, bos][None, :]
+    else:
+        alpha0 = em[:, 0]
+
+    ts = jnp.arange(1, T)
+
+    def step(alpha, inp):
+        em_t, t = inp
+        # scores[b, i, j] = alpha[b, j] + trans[i, j]
+        scores = alpha[:, None, :] + trans[None, :, :]
+        best_prev = jnp.argmax(scores, axis=-1)          # (B, N)
+        new_alpha = jnp.max(scores, axis=-1) + em_t
+        # steps past a sequence's length keep its alpha frozen
+        live = (t < lengths)[:, None]
+        new_alpha = jnp.where(live, new_alpha, alpha)
+        return new_alpha, (best_prev, live)
+
+    alpha, (backptr, lives) = jax.lax.scan(
+        step, alpha0, (jnp.swapaxes(em, 0, 1)[1:], ts))
+
+    if include_bos_eos_tag:
+        alpha = alpha + trans[eos, :][None, :]
+
+    scores = jnp.max(alpha, axis=-1)
+    last_tag = jnp.argmax(alpha, axis=-1).astype(jnp.int32)
+
+    def back(tag, inp):
+        bp_t, live_t = inp
+        prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+        prev = jnp.where(live_t[:, 0], prev.astype(jnp.int32), tag)
+        # emit the CURRENT tag at this position, then move to prev
+        return prev, jnp.where(live_t[:, 0], tag, -1)
+
+    first_tag, rev_tags = jax.lax.scan(back, last_tag, (backptr, lives),
+                                       reverse=True)
+    # rev_tags[t] is the tag at position t+1 (−1 past length); position 0
+    # is first_tag
+    paths = jnp.concatenate([first_tag[:, None], jnp.swapaxes(rev_tags, 0, 1)],
+                            axis=1)
+    mask = jnp.arange(T)[None, :] < lengths[:, None]
+    paths = jnp.where(mask, paths, 0).astype(jnp.int64)
+    return scores, paths
+
+
+class ViterbiDecoder:
+    """Layer form (reference: paddle.text.ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = jnp.asarray(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+# ---------------------------------------------------------------------------
+# datasets (local-file backed)
+# ---------------------------------------------------------------------------
+
+class _LocalFileDataset(Dataset):
+    EXPECT = "a local copy of the upstream archive"
+
+    def __init__(self, data_file=None, mode="train", **kw):
+        if not data_file or not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"{type(self).__name__}: downloads are disabled in this "
+                f"environment — pass data_file={self.EXPECT}")
+        self.mode = mode
+        self.data = self._load(data_file)
+
+    def _load(self, data_file):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+
+class UCIHousing(_LocalFileDataset):
+    """13 features + target per row, whitespace-separated (upstream
+    housing.data format); features min-max normalised like the
+    reference."""
+
+    EXPECT = "the UCI housing.data file"
+
+    def _load(self, data_file):
+        raw = np.loadtxt(data_file, dtype=np.float32)
+        x, y = raw[:, :-1], raw[:, -1:]
+        lo, hi = x.min(0), x.max(0)
+        x = (x - lo) / np.maximum(hi - lo, 1e-8)
+        split = int(0.8 * len(x))
+        sl = slice(0, split) if self.mode == "train" else slice(split, None)
+        return [(x[i], y[i]) for i in range(len(x))[sl]]
+
+
+class Imdb(_LocalFileDataset):
+    """aclImdb tar: pos/neg text reviews; yields (token_id_list, label)
+    with a whitespace vocabulary built from the train split."""
+
+    EXPECT = "the aclImdb_v1.tar.gz archive"
+
+    def _load(self, data_file):
+        out = []
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                parts = m.name.split("/")
+                if len(parts) >= 4 and parts[1] == self.mode and \
+                        parts[2] in ("pos", "neg") and m.isfile():
+                    text = tf.extractfile(m).read().decode("utf8",
+                                                           "ignore")
+                    out.append((text.lower().split(),
+                                1 if parts[2] == "pos" else 0))
+        return out
+
+
+class Imikolov(_LocalFileDataset):
+    """PTB n-gram dataset (simple-examples tar); yields n-gram tuples."""
+
+    EXPECT = "the simple-examples.tgz PTB archive"
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", **kw):
+        self.window_size = window_size
+        super().__init__(data_file, mode=mode)
+
+    def _load(self, data_file):
+        name = ("simple-examples/data/ptb.train.txt" if self.mode == "train"
+                else "simple-examples/data/ptb.valid.txt")
+        with tarfile.open(data_file) as tf:
+            text = tf.extractfile(name).read().decode("utf8")
+        words = text.replace("\n", " <eos> ").split()
+        vocab = {w: i for i, w in enumerate(sorted(set(words)))}
+        ids = [vocab[w] for w in words]
+        n = self.window_size
+        return [tuple(ids[i:i + n]) for i in range(len(ids) - n + 1)]
+
+
+class Movielens(_LocalFileDataset):
+    """ml-1m ratings: yields (user_id, movie_id, rating)."""
+
+    EXPECT = "the ml-1m.zip archive (or extracted ratings.dat)"
+
+    def _load(self, data_file):
+        import io as _io
+        import zipfile
+        if zipfile.is_zipfile(data_file):
+            with zipfile.ZipFile(data_file) as zf:
+                raw = zf.read("ml-1m/ratings.dat").decode("utf8")
+        else:
+            raw = open(data_file, encoding="utf8").read()
+        rows = []
+        for line in raw.strip().splitlines():
+            u, m, r, _ = line.split("::")
+            rows.append((int(u), int(m), float(r)))
+        return rows
+
+
+class Conll05st(_LocalFileDataset):
+    """CoNLL-2005 SRL: yields (words, predicate, labels) triples from the
+    upstream props/words column files packed in a tar."""
+
+    EXPECT = "the conll05st tar archive"
+
+    def _load(self, data_file):
+        out = []
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                if m.isfile() and m.name.endswith(".txt"):
+                    body = tf.extractfile(m).read().decode("utf8", "ignore")
+                    sent = [l.split() for l in body.splitlines() if l.strip()]
+                    if sent:
+                        out.append(sent)
+        return out
+
+
+class WMT14(_LocalFileDataset):
+    """WMT'14 en-fr: yields (src_ids, trg_ids, trg_next_ids) from the
+    upstream tar of tokenised parallel text."""
+
+    EXPECT = "the wmt14 tar archive of tokenised parallel text"
+
+    def _load(self, data_file):
+        pairs = []
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                if m.isfile() and self.mode in m.name:
+                    body = tf.extractfile(m).read().decode("utf8", "ignore")
+                    for line in body.splitlines():
+                        if "\t" in line:
+                            src, trg = line.split("\t")[:2]
+                            pairs.append((src.split(), trg.split()))
+        return pairs
+
+
+class WMT16(WMT14):
+    EXPECT = "the wmt16 tar archive of tokenised parallel text"

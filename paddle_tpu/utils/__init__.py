@@ -17,8 +17,10 @@ from . import cpp_extension  # noqa: F401
 from . import dlpack  # noqa: F401
 
 __all__ = ["try_import", "run_check", "unique_name", "deprecated",
-           "cpp_extension",
+           "cpp_extension", "download",
            "require_version"]
+
+from . import download  # noqa: E402,F401
 
 
 def try_import(module_name: str, err_msg: Optional[str] = None):

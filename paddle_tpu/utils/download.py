@@ -1,0 +1,32 @@
+"""paddle_tpu.utils.download — weight-cache resolution.
+
+Reference: python/paddle/utils/download.py (get_weights_path_from_url /
+get_path_from_url over a ~/.cache dir).  This environment has zero
+network egress, so resolution is cache-only: a URL whose file is already
+in the cache (placed there by the operator) resolves; anything else
+raises with the cache path to populate.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url", "WEIGHTS_HOME"]
+
+WEIGHTS_HOME = os.path.expanduser(
+    os.environ.get("PDTPU_WEIGHTS_HOME", "~/.cache/paddle_tpu/weights"))
+
+
+def get_path_from_url(url, root_dir=None, md5sum=None, check_exist=True):
+    root = root_dir or WEIGHTS_HOME
+    fname = url.split("/")[-1].split("?")[0]
+    path = os.path.join(root, fname)
+    if os.path.exists(path):
+        return path
+    raise FileNotFoundError(
+        f"download is disabled (zero-egress environment); place the file "
+        f"for {url!r} at {path!r} and retry")
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

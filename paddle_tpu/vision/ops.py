@@ -170,3 +170,6 @@ def roi_align(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
 # round-3 tail (roi/psroi pooling, deformable conv, SSD/YOLO box ops,
 # matrix NMS, FPN routing) — see ops_tail3.py
 from .ops_tail3 import *  # noqa: E402,F401,F403
+from .ops_tail4 import *  # noqa: E402,F401,F403
+from .ops_tail4 import __all__ as _t4_all  # noqa: E402
+__all__ += _t4_all

@@ -1,0 +1,126 @@
+"""Round-4 vision.ops tail: batched_nms, generate_proposals (RPN),
+read_file/decode_jpeg.
+
+Reference: python/paddle/vision/ops.py (SURVEY §2.6 vision row).
+Tests: tests/test_vision_tail4.py.
+"""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .ops import box_iou, nms
+
+__all__ = ["batched_nms", "generate_proposals", "read_file", "decode_jpeg"]
+
+
+def batched_nms(boxes, scores, category_idxs, iou_threshold=0.3,
+                top_k=None):
+    """Reference: paddle.vision.ops.batched_nms — per-category NMS in one
+    pass via the coordinate-offset trick: boxes of different categories
+    are translated to disjoint regions so they can never suppress each
+    other."""
+    b = jnp.asarray(boxes)
+    cat = jnp.asarray(category_idxs)
+    span = jnp.max(b) - jnp.min(b) + 1.0
+    shifted = b + (cat.astype(b.dtype) * span)[:, None]
+    return nms(shifted, iou_threshold, scores=jnp.asarray(scores),
+               top_k=top_k)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=True):
+    """Reference: paddle.vision.ops.generate_proposals — RPN head:
+    decode anchor deltas, clip to image, drop tiny boxes, keep
+    pre_nms_top_n by score, NMS, keep post_nms_top_n.
+
+    Shapes: scores (N, A, H, W), bbox_deltas (N, 4*A, H, W),
+    anchors/variances (H, W, A, 4).  Static-shape formulation: the NMS
+    stage uses the padded fixed-top_k path (invalid slots get score 0 and
+    are dropped at the end on host).
+    """
+    scores = jnp.asarray(scores)
+    deltas = jnp.asarray(bbox_deltas)
+    anchors = jnp.asarray(anchors).reshape(-1, 4)
+    variances = jnp.asarray(variances).reshape(-1, 4)
+    N, A = scores.shape[0], scores.shape[1]
+    offset = 1.0 if pixel_offset else 0.0
+
+    all_rois, all_probs, nums = [], [], []
+    for n in range(N):
+        sc = scores[n].transpose(1, 2, 0).reshape(-1)          # (HWA,)
+        dl = deltas[n].reshape(A, 4, *deltas.shape[2:]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)              # (HWA, 4)
+        # decode (the reference box_coder decode_center_size with variances)
+        aw = anchors[:, 2] - anchors[:, 0] + offset
+        ah = anchors[:, 3] - anchors[:, 1] + offset
+        acx = anchors[:, 0] + 0.5 * aw
+        acy = anchors[:, 1] + 0.5 * ah
+        cx = variances[:, 0] * dl[:, 0] * aw + acx
+        cy = variances[:, 1] * dl[:, 1] * ah + acy
+        w = jnp.exp(jnp.minimum(variances[:, 2] * dl[:, 2], 10.0)) * aw
+        h = jnp.exp(jnp.minimum(variances[:, 3] * dl[:, 3], 10.0)) * ah
+        boxes = jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                           cx + 0.5 * w - offset, cy + 0.5 * h - offset],
+                          axis=1)
+        H, W = float(img_size[n][0]), float(img_size[n][1])
+        boxes = jnp.clip(boxes, jnp.asarray([0.0, 0.0, 0.0, 0.0]),
+                         jnp.asarray([W - offset, H - offset, W - offset,
+                                      H - offset]))
+        # drop boxes below min_size
+        bw = boxes[:, 2] - boxes[:, 0] + offset
+        bh = boxes[:, 3] - boxes[:, 1] + offset
+        valid = (bw >= min_size) & (bh >= min_size)
+        sc = jnp.where(valid, sc, -jnp.inf)
+        k1 = min(int(pre_nms_top_n), sc.shape[0])
+        top_sc, top_idx = jax.lax.top_k(sc, k1)
+        top_boxes = boxes[top_idx]
+        keep = nms(top_boxes, nms_thresh, scores=top_sc,
+                   top_k=min(int(post_nms_top_n), k1))
+        keep_np = np.asarray(keep)
+        keep_np = keep_np[keep_np >= 0]
+        rois = np.asarray(top_boxes)[keep_np]
+        probs = np.asarray(top_sc)[keep_np]
+        fin = np.isfinite(probs)
+        all_rois.append(rois[fin])
+        all_probs.append(probs[fin])
+        nums.append(int(fin.sum()))
+    rois = jnp.asarray(np.concatenate(all_rois, axis=0)) if all_rois else \
+        jnp.zeros((0, 4))
+    probs = jnp.asarray(np.concatenate(all_probs, axis=0))
+    if return_rois_num:
+        return rois, probs, jnp.asarray(np.asarray(nums, np.int32))
+    return rois, probs
+
+
+def read_file(path, name=None):
+    """Reference: paddle.vision.ops.read_file — raw bytes as a uint8
+    tensor (host IO, dataloader domain)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    return jnp.asarray(np.frombuffer(data, np.uint8))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Reference: paddle.vision.ops.decode_jpeg — JPEG bytes → CHW uint8
+    tensor.  Decoding runs on host (PIL); the reference's nvjpeg GPU path
+    is IO-domain and stays off-chip here by design."""
+    from PIL import Image
+    buf = np.asarray(x).tobytes()
+    img = Image.open(io.BytesIO(buf))
+    if mode in ("gray", "grayscale", "L"):
+        img = img.convert("L")
+    elif mode in ("rgb", "RGB"):
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None, :, :]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return jnp.asarray(arr)

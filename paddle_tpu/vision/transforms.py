@@ -10,8 +10,44 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-__all__ = ["Compose", "Resize", "CenterCrop", "RandomCrop",
+__all__ = ["BaseTransform", "Compose", "Resize", "CenterCrop", "RandomCrop",
            "RandomHorizontalFlip", "Normalize", "ToTensor", "Transpose"]
+
+
+class BaseTransform:
+    """Reference: paddle.vision.transforms.BaseTransform — dispatch a
+    transform over typed inputs (image/coords/boxes/mask) declared by
+    ``keys``; subclasses override ``_get_params`` and the ``_apply_*``
+    hooks.  Single-input subclasses only override ``_apply_image``."""
+
+    def __init__(self, keys=None):
+        self.keys = tuple(keys) if keys else ("image",)
+        self.params = None
+
+    def _get_params(self, inputs):
+        return None
+
+    def _apply_image(self, image):
+        return image
+
+    def _apply_coords(self, coords):
+        return coords
+
+    def _apply_boxes(self, boxes):
+        return boxes
+
+    def _apply_mask(self, mask):
+        return mask
+
+    def __call__(self, inputs):
+        single = not isinstance(inputs, (list, tuple))
+        items = (inputs,) if single else tuple(inputs)
+        self.params = self._get_params(items)
+        outs = []
+        for key, item in zip(self.keys, items):
+            apply = getattr(self, f"_apply_{key}", None)
+            outs.append(apply(item) if apply else item)
+        return outs[0] if single else tuple(outs)
 
 
 class Compose:

@@ -1,0 +1,145 @@
+"""Round-4 distribution tail: transforms + ChiSquared/Independent/
+LKJCholesky.  Oracle: torch.distributions (CPU).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu.distribution as D
+
+torch = pytest.importorskip("torch")
+
+
+class TestSimpleTransforms:
+    def test_abs(self):
+        t = D.AbsTransform()
+        np.testing.assert_allclose(np.asarray(t.forward(jnp.asarray([-2., 3.]))),
+                                   [2., 3.])
+        np.testing.assert_allclose(np.asarray(t.inverse(jnp.asarray([2.]))),
+                                   [2.])
+        with pytest.raises(NotImplementedError):
+            t.forward_log_det_jacobian(jnp.asarray([1.0]))
+
+    def test_reshape(self):
+        t = D.ReshapeTransform((2, 3), (6,))
+        x = jnp.arange(12.0).reshape(2, 2, 3)
+        y = t.forward(x)
+        assert y.shape == (2, 6)
+        np.testing.assert_allclose(np.asarray(t.inverse(y)), np.asarray(x))
+        assert t.forward_log_det_jacobian(x).shape == (2,)
+
+    def test_softmax(self):
+        t = D.SoftmaxTransform()
+        x = jnp.asarray(np.random.RandomState(0).randn(3, 4)
+                        .astype(np.float32))
+        y = t.forward(x)
+        np.testing.assert_allclose(np.asarray(y.sum(-1)), 1.0, atol=1e-6)
+        # inverse(forward) recovers x up to the softmax shift invariance
+        x2 = t.inverse(y)
+        d = np.asarray(x - x2)
+        np.testing.assert_allclose(d - d.mean(-1, keepdims=True), 0.0,
+                                   atol=1e-5)
+
+    def test_independent_transform_sums_log_det(self):
+        base = D.ExpTransform()
+        t = D.IndependentTransform(base, 1)
+        x = jnp.asarray(np.random.RandomState(1).randn(5, 3)
+                        .astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(t.forward_log_det_jacobian(x)),
+            np.asarray(base.forward_log_det_jacobian(x)).sum(-1), atol=1e-5)
+
+    def test_stack_transform(self):
+        t = D.StackTransform([D.ExpTransform(), D.AffineTransform(0., 2.)],
+                             axis=0)
+        x = jnp.asarray(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+        y = np.asarray(t.forward(x))
+        np.testing.assert_allclose(y[0], np.exp([1.0, 2.0]), rtol=1e-6)
+        np.testing.assert_allclose(y[1], [6.0, 8.0], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(t.inverse(t.forward(x))),
+                                   np.asarray(x), rtol=1e-5)
+
+
+class TestStickBreaking:
+    def test_matches_torch(self):
+        t = D.StickBreakingTransform()
+        tt = torch.distributions.StickBreakingTransform()
+        x = np.random.RandomState(2).randn(4, 5).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(t.forward(x)),
+                                   tt(torch.tensor(x)).numpy(), atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(t.forward_log_det_jacobian(x)),
+            tt.log_abs_det_jacobian(torch.tensor(x),
+                                    tt(torch.tensor(x))).numpy(),
+            rtol=1e-4, atol=5e-4)
+
+    def test_roundtrip_and_simplex(self):
+        t = D.StickBreakingTransform()
+        x = np.random.RandomState(3).randn(6, 4).astype(np.float32)
+        y = t.forward(x)
+        np.testing.assert_allclose(np.asarray(y).sum(-1), 1.0, atol=1e-6)
+        assert np.asarray(y).min() > 0
+        np.testing.assert_allclose(np.asarray(t.inverse(y)), x, atol=5e-4)
+
+
+class TestIndependent:
+    def test_log_prob_sums_event_dims(self):
+        base = D.Normal(np.zeros((3, 4), np.float32),
+                        np.ones((3, 4), np.float32))
+        ind = D.Independent(base, 1)
+        v = jnp.asarray(np.random.RandomState(4).randn(3, 4)
+                        .astype(np.float32))
+        np.testing.assert_allclose(np.asarray(ind.log_prob(v)),
+                                   np.asarray(base.log_prob(v)).sum(-1),
+                                   rtol=1e-5)
+        assert ind.entropy().shape == (3,)
+        s = ind.sample((2,))
+        assert s.shape == (2, 3, 4)
+
+
+class TestChiSquared:
+    def test_alias_of_chi2(self):
+        c = D.ChiSquared(3.0)
+        assert isinstance(c, D.Chi2)
+        t = torch.distributions.Chi2(torch.tensor(3.0))
+        v = np.array([0.5, 1.0, 4.0], np.float32)
+        np.testing.assert_allclose(np.asarray(c.log_prob(jnp.asarray(v))),
+                                   t.log_prob(torch.tensor(v)).numpy(),
+                                   rtol=1e-4)
+
+
+class TestLKJCholesky:
+    @pytest.mark.parametrize("dim,eta", [(2, 0.5), (3, 1.0), (4, 2.5)])
+    def test_log_prob_matches_torch(self, dim, eta):
+        tl = torch.distributions.LKJCholesky(dim, eta)
+        Ls = tl.sample((6,))
+        got = np.asarray(D.LKJCholesky(dim, eta).log_prob(
+            jnp.asarray(Ls.numpy())))
+        np.testing.assert_allclose(got, tl.log_prob(Ls).numpy(),
+                                   rtol=1e-4, atol=5e-4)
+
+    def test_samples_are_cholesky_of_correlation(self):
+        L = D.LKJCholesky(3, 1.0).sample((500,))
+        R = np.asarray(jnp.einsum("bij,bkj->bik", L, L))
+        np.testing.assert_allclose(np.diagonal(R, axis1=1, axis2=2), 1.0,
+                                   atol=1e-4)
+        assert np.all(np.abs(R) <= 1.0 + 1e-5)
+        # lower-triangular with positive diagonal
+        Ln = np.asarray(L)
+        assert np.allclose(np.triu(Ln, 1), 0.0, atol=1e-6)
+        assert np.all(np.diagonal(Ln, axis1=1, axis2=2) > 0)
+
+    def test_marginal_matches_lkj_beta(self):
+        # r12 of LKJ(d, η) is 2·Beta(α,α)−1 with α = η + (d−2)/2;
+        # at d=3, η=1: var = 4·α²/((2α)²(2α+1)) = 0.25
+        L = D.LKJCholesky(3, 1.0).sample((4000,))
+        R = np.asarray(jnp.einsum("bij,bkj->bik", L, L))
+        r12 = R[:, 0, 1]
+        assert abs(r12.mean()) < 0.05
+        assert abs(r12.var() - 0.25) < 0.03
+
+    def test_concentration_tightens(self):
+        L = D.LKJCholesky(3, 50.0).sample((1000,))
+        R = np.asarray(jnp.einsum("bij,bkj->bik", L, L))
+        assert np.abs(R[:, 0, 1]).mean() < 0.15

@@ -1,0 +1,151 @@
+"""Round-4 geometric sampling + incubate tail (graph ops, fused masked
+softmax, identity_loss, ASP n:m sparsity).
+
+Oracles: hand-computed reindex/sampling invariants; NumPy softmax.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu.geometric as G
+import paddle_tpu.incubate as inc
+import paddle_tpu.nn as nn
+
+
+@pytest.fixture
+def csc_graph():
+    # 4 nodes; in-neighbors of v = row[colptr[v]:colptr[v+1]]
+    colptr = np.array([0, 2, 4, 5, 7])
+    row = np.array([1, 2, 0, 3, 0, 1, 2])
+    return row, colptr
+
+
+class TestReindex:
+    def test_reindex_graph_ordering(self):
+        x = np.array([0, 5, 9])
+        neigh = np.array([5, 9, 7, 0, 7, 3])
+        count = np.array([2, 2, 2])
+        src, dst, nodes = G.reindex_graph(x, neigh, count)
+        assert nodes.tolist() == [0, 5, 9, 7, 3]
+        assert src.tolist() == [1, 2, 3, 0, 3, 4]
+        assert dst.tolist() == [0, 0, 1, 1, 2, 2]
+
+    def test_reindex_heter_shares_numbering(self):
+        x = np.array([0, 5, 9])
+        srcs, dsts, nodes = G.reindex_heter_graph(
+            x, [np.array([5, 0]), np.array([9, 3])],
+            [np.array([1, 1, 0]), np.array([0, 1, 1])])
+        assert nodes.tolist()[:3] == [0, 5, 9]
+        assert dsts[0].tolist() == [0, 1] and dsts[1].tolist() == [1, 2]
+        # 3 appears only in type-1 neighbors → gets the next fresh id
+        assert srcs[1].tolist() == [2, nodes.tolist().index(3)]
+
+
+class TestSampling:
+    def test_full_neighborhood(self, csc_graph):
+        row, colptr = csc_graph
+        # node 0 owns slots 0..1 (row 1,2); node 3 owns slots 5..6 (row 1,2)
+        neigh, cnt = G.sample_neighbors(row, colptr, np.array([0, 3]),
+                                        sample_size=-1)
+        assert cnt.tolist() == [2, 2]
+        assert sorted(neigh.tolist()[:2]) == [1, 2]
+        assert sorted(neigh.tolist()[2:]) == [1, 2]
+
+    def test_sample_size_respected(self, csc_graph):
+        row, colptr = csc_graph
+        neigh, cnt = G.sample_neighbors(row, colptr, np.array([3]),
+                                        sample_size=2,
+                                        rng=np.random.default_rng(0))
+        assert cnt.tolist() == [2]
+        assert len(set(neigh.tolist())) == 2  # without replacement
+
+    def test_return_eids(self, csc_graph):
+        row, colptr = csc_graph
+        eids = np.arange(100, 107)
+        neigh, cnt, out_eids = G.sample_neighbors(
+            row, colptr, np.array([1]), sample_size=-1, eids=eids,
+            return_eids=True)
+        assert out_eids.tolist() == [102, 103]
+
+    def test_weighted_prefers_heavy_edges(self, csc_graph):
+        row, colptr = csc_graph
+        # node 3 owns slots 5..6 (row 1, 2); weight slot 5 hugely
+        w = np.array([1, 1, 1, 1, 1, 1000.0, 0.001])
+        picks = []
+        for s in range(30):
+            neigh, _ = G.weighted_sample_neighbors(
+                row, colptr, w, np.array([3]), sample_size=1,
+                rng=np.random.default_rng(s))
+            picks.append(neigh.tolist()[0])
+        assert picks.count(1) >= 28  # row[5] == 1 carries ~all the weight
+
+    def test_khop_sampler_shapes(self, csc_graph):
+        row, colptr = csc_graph
+        es, ed, sidx, rx = inc.graph_khop_sampler(
+            row, colptr, np.array([0]), [2, 2],
+            rng=np.random.default_rng(2))
+        assert len(es) == len(ed)
+        assert rx.tolist() == [0]
+        # every edge endpoint is a valid local id
+        assert max(es.tolist() + ed.tolist()) < len(sidx)
+
+    def test_send_uv(self):
+        m = G.send_uv(jnp.arange(4.0)[:, None], 2 * jnp.ones((4, 1)),
+                      jnp.asarray([0, 2]), jnp.asarray([1, 3]), "mul")
+        assert m.tolist() == [[0.0], [4.0]]
+
+
+class TestIncubateOps:
+    def test_softmax_mask_fuse_matches_numpy(self):
+        x = np.random.RandomState(0).randn(2, 2, 4, 4).astype(np.float32)
+        mask = np.zeros((2, 1, 4, 4), np.float32)
+        mask[:, :, :, -1] = -1e9  # forbid last column
+        got = np.asarray(inc.softmax_mask_fuse(jnp.asarray(x),
+                                               jnp.asarray(mask)))
+        z = x + mask
+        e = np.exp(z - z.max(-1, keepdims=True))
+        np.testing.assert_allclose(got, e / e.sum(-1, keepdims=True),
+                                   atol=1e-5)
+        assert got[..., -1].max() < 1e-6
+
+    def test_upper_triangle_is_causal(self):
+        x = jnp.asarray(np.random.RandomState(1)
+                        .randn(1, 1, 5, 5).astype(np.float32))
+        p = np.asarray(inc.softmax_mask_fuse_upper_triangle(x))
+        np.testing.assert_allclose(p.sum(-1), 1.0, atol=1e-5)
+        assert np.abs(np.triu(p[0, 0], 1)).max() < 1e-6
+
+    def test_identity_loss(self):
+        v = jnp.asarray([1.0, 3.0])
+        assert float(inc.identity_loss(v, "sum")) == 4.0
+        assert float(inc.identity_loss(v, 1)) == 2.0
+        np.testing.assert_allclose(np.asarray(inc.identity_loss(v, "none")),
+                                   [1.0, 3.0])
+
+
+class TestASP:
+    def test_create_mask_keeps_top2_of_4(self):
+        t = np.array([[0.1, -0.9, 0.5, 0.2], [4.0, 0.0, -3.0, 1.0]],
+                     np.float32)
+        m = np.asarray(inc.asp.create_mask(t))
+        np.testing.assert_array_equal(m, [[0, 1, 1, 0], [1, 0, 1, 0]])
+
+    def test_prune_model_halves_density(self):
+        lin = nn.Linear(8, 8)
+        masks = inc.asp.prune_model(lin)
+        assert "weight" in masks and "bias" not in masks
+        assert inc.asp.check_sparsity(lin.weight, n=2, m=4)
+        assert abs(inc.asp.calculate_density(lin.weight) - 0.5) < 1e-6
+
+    def test_excluded_layers(self):
+        lin = nn.Linear(4, 4)
+        inc.asp.set_excluded_layers(["weight"])
+        try:
+            masks = inc.asp.prune_model(lin)
+            assert masks == {}
+        finally:
+            inc.asp.reset_excluded_layers()
+
+    def test_check_sparsity_rejects_dense(self):
+        assert not inc.asp.check_sparsity(np.ones((4, 4)), n=2, m=4)

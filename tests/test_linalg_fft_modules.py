@@ -1,0 +1,74 @@
+"""linalg/fft importable-module parity + lu_solve/pca_lowrank.
+
+Reference: python/paddle/linalg.py, python/paddle/fft.py (module
+re-export form) — `import paddle.linalg` works there, so it must here.
+"""
+
+import importlib
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+
+
+class TestModuleForm:
+    def test_import_module_works(self):
+        L = importlib.import_module("paddle_tpu.linalg")
+        F = importlib.import_module("paddle_tpu.fft")
+        assert P.linalg is L and P.fft is F
+
+    def test_surface_hoisted(self):
+        for name in ("svd qr cholesky solve det slogdet lu lu_unpack "
+                     "svdvals ormqr householder_product svd_lowrank "
+                     "cholesky_inverse matrix_exp vector_norm").split():
+            assert callable(getattr(P.linalg, name)), name
+        for name in ("fft ifft rfft irfft fft2 hfft2 ihfftn fftshift "
+                     "fftfreq").split():
+            assert callable(getattr(P.fft, name)), name
+
+
+class TestLuSolve:
+    def test_solves_against_numpy(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(5, 5).astype(np.float32) + 5 * np.eye(5, dtype=np.float32)
+        b = rng.randn(5, 2).astype(np.float32)
+        lu, piv = P.linalg.lu(P.to_tensor(a))
+        x = np.asarray(P.linalg.lu_solve(P.to_tensor(b), lu, piv))
+        np.testing.assert_allclose(a @ x, b, atol=1e-4)
+
+    def test_trans(self):
+        rng = np.random.RandomState(1)
+        a = rng.randn(4, 4).astype(np.float32) + 4 * np.eye(4, dtype=np.float32)
+        b = rng.randn(4, 1).astype(np.float32)
+        lu, piv = P.linalg.lu(P.to_tensor(a))
+        x = np.asarray(P.linalg.lu_solve(P.to_tensor(b), lu, piv, trans="T"))
+        np.testing.assert_allclose(a.T @ x, b, atol=1e-4)
+
+
+class TestPcaLowrank:
+    def test_recovers_leading_spectrum(self):
+        rng = np.random.RandomState(2)
+        m = rng.randn(40, 10).astype(np.float32)
+        u, s, v = P.linalg.pca_lowrank(P.to_tensor(m), q=4, niter=4)
+        mc = m - m.mean(0)
+        sv_true = np.linalg.svd(mc, compute_uv=False)[:4]
+        # randomized method: leading values tight, trailing value loose
+        np.testing.assert_allclose(np.asarray(s)[:2], sv_true[:2], rtol=0.02)
+        np.testing.assert_allclose(np.asarray(s), sv_true, rtol=0.15)
+
+    def test_shapes_and_orthonormality(self):
+        rng = np.random.RandomState(3)
+        m = rng.randn(20, 8).astype(np.float32)
+        u, s, v = P.linalg.pca_lowrank(P.to_tensor(m), q=3)
+        assert u.shape == (20, 3) and s.shape == (3,) and v.shape == (8, 3)
+        np.testing.assert_allclose(np.asarray(u).T @ np.asarray(u),
+                                   np.eye(3), atol=1e-4)
+
+    def test_center_false(self):
+        rng = np.random.RandomState(4)
+        m = rng.randn(15, 6).astype(np.float32) + 10.0
+        u, s, v = P.linalg.pca_lowrank(P.to_tensor(m), q=2, center=False,
+                                       niter=4)
+        sv_true = np.linalg.svd(m, compute_uv=False)[:1]
+        np.testing.assert_allclose(np.asarray(s)[:1], sv_true, rtol=0.02)

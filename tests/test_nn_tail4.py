@@ -1,0 +1,376 @@
+"""Round-4 nn tail: 3-D pools/convs, sequence/margin losses, sparse
+attention, gather_tree, hsigmoid, RNN wrapper, beam-search decode.
+
+Oracles: torch (CPU) where it has the op, NumPy formulas otherwise.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as TF  # noqa: E402
+
+
+def t2n(t):
+    return t.detach().numpy()
+
+
+class TestPool3D:
+    def test_avg_pool3d_matches_torch(self):
+        x = np.random.RandomState(0).randn(2, 3, 8, 8, 8).astype(np.float32)
+        got = np.asarray(F.avg_pool3d(jnp.asarray(x), 2))
+        want = t2n(TF.avg_pool3d(torch.tensor(x), 2))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_max_pool3d_matches_torch(self):
+        x = np.random.RandomState(1).randn(2, 3, 8, 8, 8).astype(np.float32)
+        got = np.asarray(F.max_pool3d(jnp.asarray(x), 2, stride=2,
+                                      padding=1))
+        want = t2n(TF.max_pool3d(torch.tensor(x), 2, stride=2, padding=1))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_adaptive_avg_pool1d_uneven(self):
+        x = np.random.RandomState(2).randn(2, 4, 10).astype(np.float32)
+        got = np.asarray(F.adaptive_avg_pool1d(jnp.asarray(x), 3))
+        want = t2n(TF.adaptive_avg_pool1d(torch.tensor(x), 3))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_adaptive_max_pool1d_with_mask(self):
+        x = np.random.RandomState(3).randn(2, 4, 10).astype(np.float32)
+        got, idx = F.adaptive_max_pool1d(jnp.asarray(x), 3, return_mask=True)
+        want, widx = TF.adaptive_max_pool1d(torch.tensor(x), 3,
+                                            return_indices=True)
+        np.testing.assert_allclose(np.asarray(got), t2n(want), atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(idx), t2n(widx))
+
+    def test_adaptive_avg_pool3d(self):
+        x = np.random.RandomState(4).randn(1, 2, 7, 9, 5).astype(np.float32)
+        got = np.asarray(F.adaptive_avg_pool3d(jnp.asarray(x), (3, 4, 2)))
+        want = t2n(TF.adaptive_avg_pool3d(torch.tensor(x), (3, 4, 2)))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_adaptive_max_pool3d(self):
+        x = np.random.RandomState(5).randn(1, 2, 6, 6, 6).astype(np.float32)
+        got = np.asarray(F.adaptive_max_pool3d(jnp.asarray(x), 2))
+        want = t2n(TF.adaptive_max_pool3d(torch.tensor(x), 2))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_layer_classes(self):
+        x = jnp.ones((1, 2, 4, 4, 4))
+        assert nn.AvgPool3D(2)(x).shape == (1, 2, 2, 2, 2)
+        assert nn.MaxPool3D(2)(x).shape == (1, 2, 2, 2, 2)
+        assert nn.AdaptiveAvgPool3D(2)(x).shape == (1, 2, 2, 2, 2)
+        assert nn.AdaptiveMaxPool3D(2)(x).shape == (1, 2, 2, 2, 2)
+        assert nn.AdaptiveAvgPool1D(2)(jnp.ones((1, 2, 6))).shape == (1, 2, 2)
+
+
+class TestConvTranspose:
+    def test_conv1d_transpose_matches_torch(self):
+        rs = np.random.RandomState(6)
+        x = rs.randn(2, 3, 10).astype(np.float32)
+        w = rs.randn(3, 4, 3).astype(np.float32)
+        b = rs.randn(4).astype(np.float32)
+        got = np.asarray(F.conv1d_transpose(
+            jnp.asarray(x), jnp.asarray(w), jnp.asarray(b), stride=2,
+            padding=1, output_padding=1))
+        want = t2n(TF.conv_transpose1d(torch.tensor(x), torch.tensor(w),
+                                       torch.tensor(b), stride=2, padding=1,
+                                       output_padding=1))
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_conv3d_transpose_matches_torch(self):
+        rs = np.random.RandomState(7)
+        x = rs.randn(1, 2, 4, 4, 4).astype(np.float32)
+        w = rs.randn(2, 3, 3, 3, 3).astype(np.float32)
+        got = np.asarray(F.conv3d_transpose(
+            jnp.asarray(x), jnp.asarray(w), stride=2, padding=1))
+        want = t2n(TF.conv_transpose3d(torch.tensor(x), torch.tensor(w),
+                                       stride=2, padding=1))
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_conv1d_transpose_groups(self):
+        rs = np.random.RandomState(8)
+        x = rs.randn(1, 4, 6).astype(np.float32)
+        w = rs.randn(4, 2, 3).astype(np.float32)
+        got = np.asarray(F.conv1d_transpose(jnp.asarray(x), jnp.asarray(w),
+                                            groups=2))
+        want = t2n(TF.conv_transpose1d(torch.tensor(x), torch.tensor(w),
+                                       groups=2))
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_layer_classes(self):
+        y = nn.Conv1DTranspose(3, 6, 3, stride=2)(jnp.ones((1, 3, 5)))
+        assert y.shape == (1, 6, 11)
+        y = nn.Conv3DTranspose(2, 4, 3)(jnp.ones((1, 2, 4, 4, 4)))
+        assert y.shape == (1, 4, 6, 6, 6)
+
+
+class TestLossTail:
+    def test_label_smooth(self):
+        y = jnp.asarray(np.eye(4, dtype=np.float32))
+        out = np.asarray(F.label_smooth(y, epsilon=0.1))
+        np.testing.assert_allclose(out, 0.9 * np.eye(4) + 0.1 / 4, atol=1e-6)
+
+    def test_label_smooth_prior(self):
+        y = jnp.asarray(np.eye(2, dtype=np.float32))
+        prior = jnp.asarray(np.array([0.8, 0.2], np.float32))
+        out = np.asarray(F.label_smooth(y, prior_dist=prior, epsilon=0.5))
+        np.testing.assert_allclose(out[0], [0.5 + 0.4, 0.1], atol=1e-6)
+
+    def test_log_loss(self):
+        p = np.array([[0.9], [0.1]], np.float32)
+        y = np.array([[1.0], [0.0]], np.float32)
+        got = np.asarray(F.log_loss(jnp.asarray(p), jnp.asarray(y)))
+        eps = 1e-4
+        want = -y * np.log(p + eps) - (1 - y) * np.log(1 - p + eps)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_sequence_mask(self):
+        got = np.asarray(F.sequence_mask(jnp.asarray([1, 3, 2]), maxlen=4))
+        want = np.array([[1, 0, 0, 0], [1, 1, 1, 0], [1, 1, 0, 0]])
+        np.testing.assert_array_equal(got, want)
+
+    def test_margin_cross_entropy_reduces_to_ce_at_zero_margin(self):
+        rs = np.random.RandomState(9)
+        cos = np.clip(rs.randn(4, 10), -0.99, 0.99).astype(np.float32)
+        lab = np.array([1, 5, 3, 9])
+        loss = float(F.margin_cross_entropy(
+            jnp.asarray(cos), jnp.asarray(lab), margin1=1.0, margin2=0.0,
+            margin3=0.0, scale=4.0))
+        want = float(TF.cross_entropy(torch.tensor(cos * 4.0),
+                                      torch.tensor(lab)))
+        assert abs(loss - want) < 1e-4
+
+    def test_margin_cross_entropy_margin_raises_loss(self):
+        cos = np.full((2, 5), 0.1, np.float32)
+        cos[0, 2] = 0.9
+        cos[1, 4] = 0.9
+        lab = jnp.asarray([2, 4])
+        l0 = float(F.margin_cross_entropy(jnp.asarray(cos), lab,
+                                          margin2=0.0, scale=4.0))
+        l1 = float(F.margin_cross_entropy(jnp.asarray(cos), lab,
+                                          margin2=0.5, scale=4.0))
+        assert l1 > l0
+
+    def test_class_center_sample(self):
+        lab = jnp.asarray([3, 7, 3, 1])
+        remapped, sampled = F.class_center_sample(lab, 20, 8)
+        s = np.asarray(sampled)
+        assert len(s) == 8 and len(set(s.tolist())) == 8
+        for pos in (1, 3, 7):
+            assert pos in s
+        r = np.asarray(remapped)
+        np.testing.assert_array_equal(s[r], np.asarray(lab))
+
+
+class TestHSigmoid:
+    def test_loss_positive_and_grads_flow(self):
+        rs = np.random.RandomState(10)
+        x = jnp.asarray(rs.randn(6, 8).astype(np.float32))
+        lab = jnp.asarray(rs.randint(0, 10, (6,)))
+        layer = nn.HSigmoidLoss(8, 10)
+        loss = layer(x, lab)
+        assert loss.shape == (6, 1) and np.asarray(loss).min() > 0
+
+    def test_default_tree_matches_manual_bce(self):
+        # num_classes=4: codes are label+4 in [4,7] — exactly 2 bits of path
+        rs = np.random.RandomState(11)
+        x = rs.randn(3, 5).astype(np.float32)
+        w = rs.randn(3, 5).astype(np.float32)  # 3 internal nodes
+        lab = np.array([0, 2, 3])
+        got = np.asarray(F.hsigmoid_loss(jnp.asarray(x), jnp.asarray(lab),
+                                         4, jnp.asarray(w)))
+        want = np.zeros((3, 1), np.float32)
+        for i, c in enumerate(lab):
+            code = c + 4
+            for bit in range(2):  # codes 4..7 have exactly 2 path bits
+                nidx = (code >> (bit + 1)) - 1
+                bval = (code >> bit) & 1
+                pre = x[i] @ w[nidx]
+                want[i, 0] += max(pre, 0) - pre * bval + np.log1p(
+                    np.exp(-abs(pre)))
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_custom_path(self):
+        rs = np.random.RandomState(12)
+        x = jnp.asarray(rs.randn(2, 4).astype(np.float32))
+        w = jnp.asarray(rs.randn(6, 4).astype(np.float32))
+        pt = jnp.asarray([[0, 2, -1], [1, 4, 5]])
+        pc = jnp.asarray([[1, 0, 0], [0, 1, 1]])
+        loss = F.hsigmoid_loss(x, jnp.asarray([0, 1]), 6, w,
+                               path_table=pt, path_code=pc)
+        assert loss.shape == (2, 1) and np.isfinite(np.asarray(loss)).all()
+
+
+class TestSparseAttention:
+    def test_matches_dense_with_full_pattern(self):
+        rs = np.random.RandomState(13)
+        B, H, M, D = 1, 2, 4, 8
+        q = rs.randn(B, H, M, D).astype(np.float32)
+        k = rs.randn(B, H, M, D).astype(np.float32)
+        v = rs.randn(B, H, M, D).astype(np.float32)
+        # full pattern: every row attends to all 4 columns
+        off = np.tile(np.arange(0, 17, 4, dtype=np.int32), (B, H, 1))
+        cols = np.tile(np.tile(np.arange(4, dtype=np.int32), 4), (B, H, 1))
+        got = np.asarray(F.sparse_attention(q, k, v, off, cols))
+        scores = q @ k.transpose(0, 1, 3, 2) / np.sqrt(D)
+        p = np.exp(scores - scores.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        np.testing.assert_allclose(got, p @ v, atol=1e-5)
+
+    def test_respects_sparsity(self):
+        B, H, M, D = 1, 1, 3, 4
+        q = np.ones((B, H, M, D), np.float32)
+        k = np.ones((B, H, M, D), np.float32)
+        v = np.arange(M, dtype=np.float32)[None, None, :, None] \
+            * np.ones((B, H, M, D), np.float32)
+        # row i attends only to column i → output row i == v[i]
+        off = np.array([[[0, 1, 2, 3]]], np.int32)
+        cols = np.array([[[0, 1, 2]]], np.int32)
+        got = np.asarray(F.sparse_attention(q, k, v, off, cols))
+        np.testing.assert_allclose(got[0, 0, :, 0], [0., 1., 2.], atol=1e-6)
+
+
+class TestGatherTree:
+    def test_matches_manual_backtrace(self):
+        # T=3, B=1, K=2
+        ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], np.int32)
+        parents = np.array([[[0, 0]], [[0, 0]], [[1, 0]]], np.int32)
+        got = np.asarray(F.gather_tree(ids, parents))
+        # beam 0 at t=2 came from parent 1 at t=1 (id 4), whose parent is 0
+        np.testing.assert_array_equal(got[:, 0, 0], [1, 4, 5])
+        np.testing.assert_array_equal(got[:, 0, 1], [1, 3, 6])
+
+
+class TestRNNWrapper:
+    def test_rnn_wraps_cell_like_simplernn(self):
+        cell = nn.SimpleRNNCell(4, 8)
+        rnn = nn.RNN(cell)
+        x = jnp.asarray(np.random.RandomState(14).randn(2, 5, 4)
+                        .astype(np.float32))
+        out, final = rnn(x)
+        assert out.shape == (2, 5, 8) and final.shape == (2, 8)
+        np.testing.assert_allclose(np.asarray(out[:, -1]),
+                                   np.asarray(final), atol=1e-6)
+
+    def test_sequence_length_masks(self):
+        cell = nn.SimpleRNNCell(4, 8)
+        rnn = nn.RNN(cell)
+        x = jnp.asarray(np.random.RandomState(15).randn(2, 5, 4)
+                        .astype(np.float32))
+        out, final = rnn(x, sequence_length=jnp.asarray([3, 5]))
+        assert np.abs(np.asarray(out[0, 3:])).max() == 0.0
+        np.testing.assert_allclose(np.asarray(final[0]),
+                                   np.asarray(out[0, 2]), atol=1e-6)
+
+    def test_rnncellbase_exported(self):
+        assert issubclass(nn.LSTMCell, nn.RNNCellBase)
+
+
+class TestBeamSearchDecode:
+    def _make(self, V=7, E=8, H=8):
+        cell = nn.SimpleRNNCell(E, H)
+        emb = nn.Embedding(V, E)
+        proj = nn.Linear(H, V)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=V - 1,
+                                   beam_size=3, embedding_fn=emb,
+                                   output_fn=proj)
+        return dec, cell
+
+    def test_shapes_and_determinism(self):
+        dec, cell = self._make()
+        inits = jnp.zeros((2, 8))
+        seqs, final = nn.dynamic_decode(dec, inits=inits, max_step_num=5)
+        assert seqs.shape == (2, 5, 3)
+        seqs2, _ = nn.dynamic_decode(dec, inits=inits, max_step_num=5)
+        np.testing.assert_array_equal(np.asarray(seqs), np.asarray(seqs2))
+
+    def test_best_beam_is_greedy_when_unambiguous(self):
+        # with a deterministic cell, beam 0 must equal greedy rollout
+        dec, cell = self._make()
+        inits = jnp.zeros((1, 8))
+        seqs, _ = nn.dynamic_decode(dec, inits=inits, max_step_num=4)
+        params = dict(cell.named_parameters())
+        from paddle_tpu.nn.layer import functional_call
+        tok = jnp.zeros((1,), jnp.int32)
+        st = inits
+        greedy = []
+        for _ in range(4):
+            h = functional_call(cell, params, dec.embedding_fn(tok), st)
+            h = h[0] if isinstance(h, tuple) else h
+            logits = dec.output_fn(h)
+            tok = jnp.argmax(logits, -1).astype(jnp.int32)
+            greedy.append(int(tok[0]))
+            st = h
+        assert np.asarray(seqs)[0, :, 0].tolist() == greedy
+
+    def test_time_major_output(self):
+        dec, _ = self._make()
+        seqs, _ = nn.dynamic_decode(dec, inits=jnp.zeros((2, 8)),
+                                    max_step_num=4, output_time_major=True)
+        assert seqs.shape == (4, 2, 3)
+
+
+class TestNewActivationsNorms:
+    def test_activation_classes(self):
+        x = jnp.asarray(np.linspace(-2, 2, 9, dtype=np.float32))
+        np.testing.assert_allclose(np.asarray(nn.ELU(0.5)(x)),
+                                   t2n(TF.elu(torch.tensor(np.asarray(x)),
+                                              0.5)), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(nn.ReLU6()(x)),
+                                   t2n(TF.relu6(torch.tensor(np.asarray(x)))),
+                                   atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(nn.Hardtanh(-1, 1)(x)),
+            t2n(TF.hardtanh(torch.tensor(np.asarray(x)))), atol=1e-6)
+        assert nn.SiLU()(x).shape == x.shape
+        g = nn.GumbelSoftmax(hard=True)(jnp.asarray(
+            np.random.RandomState(16).randn(4, 6).astype(np.float32)))
+        np.testing.assert_allclose(np.asarray(g).sum(-1), 1.0, atol=1e-6)
+
+    def test_batchnorm3d_and_instance3d(self):
+        x = jnp.asarray(np.random.RandomState(17)
+                        .randn(2, 3, 4, 4, 4).astype(np.float32))
+        bn = nn.BatchNorm3D(3)
+        bn.eval()
+        y = bn(x)
+        assert y.shape == x.shape
+        inorm = nn.InstanceNorm3D(3)
+        z = np.asarray(inorm(x))
+        np.testing.assert_allclose(z.mean(axis=(2, 3, 4)), 0.0, atol=1e-4)
+
+    def test_batchnorm_fluid_style_with_act(self):
+        x = jnp.asarray(np.random.RandomState(18)
+                        .randn(2, 3, 4, 4).astype(np.float32))
+        bn = nn.BatchNorm(3, act="relu")
+        bn.eval()
+        assert np.asarray(bn(x)).min() >= 0.0
+
+    def test_temporal_shift(self):
+        x = np.random.RandomState(19).randn(4, 8, 2, 2).astype(np.float32)
+        out = np.asarray(F.temporal_shift(jnp.asarray(x), seg_num=2,
+                                          shift_ratio=0.25))
+        v = x.reshape(2, 2, 8, 2, 2)
+        # first 2 channels: frame t gets t-1 (zero at t=0)
+        np.testing.assert_allclose(
+            out.reshape(2, 2, 8, 2, 2)[:, 1, :2], v[:, 0, :2], atol=1e-6)
+        np.testing.assert_allclose(
+            out.reshape(2, 2, 8, 2, 2)[:, 0, :2], 0.0, atol=1e-6)
+        # channels 2:4: frame t gets t+1 (zero at last)
+        np.testing.assert_allclose(
+            out.reshape(2, 2, 8, 2, 2)[:, 0, 2:4], v[:, 1, 2:4], atol=1e-6)
+        # rest unchanged
+        np.testing.assert_allclose(
+            out.reshape(2, 2, 8, 2, 2)[:, :, 4:], v[:, :, 4:], atol=1e-6)
+
+    def test_inplace_style_functionals(self):
+        x = jnp.asarray(np.array([-1., 2.], np.float32))
+        np.testing.assert_allclose(np.asarray(F.relu_(x)), [0., 2.])
+        assert np.asarray(F.softmax_(x)).sum() == pytest.approx(1.0)
+        np.testing.assert_allclose(np.asarray(F.elu_(x))[1], 2.0)

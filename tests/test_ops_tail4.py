@@ -1,0 +1,207 @@
+"""Round-4 op tail: top-level tensor API + inplace-suffix surface.
+
+Oracle: NumPy/scipy formulas computed independently (reference:
+python/paddle/tensor/{math,random,creation}.py semantics).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.autograd as AG
+
+
+@pytest.fixture
+def x22():
+    return P.to_tensor(np.array([[1., 2.], [3., 4.]], np.float32))
+
+
+class TestMathTail:
+    def test_multigammaln(self, x22):
+        from scipy.special import multigammaln as sp
+        got = np.asarray(P.multigammaln(x22 + 3, 2))
+        want = np.vectorize(lambda v: sp(v, 2))(np.asarray(x22) + 3)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_vdot(self):
+        a = np.arange(4.0).astype(np.float32)
+        assert abs(float(P.vdot(P.to_tensor(a), P.to_tensor(a)))
+                   - float(np.vdot(a, a))) < 1e-5
+
+    def test_sigmoid_top_level(self, x22):
+        np.testing.assert_allclose(np.asarray(P.sigmoid(x22)),
+                                   1 / (1 + np.exp(-np.asarray(x22))),
+                                   rtol=1e-6)
+
+    def test_permute_both_forms(self, x22):
+        np.testing.assert_array_equal(np.asarray(P.permute(x22, 1, 0)),
+                                      np.asarray(x22).T)
+        np.testing.assert_array_equal(np.asarray(P.permute(x22, [1, 0])),
+                                      np.asarray(x22).T)
+
+    def test_logspace(self):
+        np.testing.assert_allclose(np.asarray(P.logspace(0, 2, 3)),
+                                   [1., 10., 100.], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(P.logspace(0, 3, 4, base=2.0)),
+                                   [1., 2., 4., 8.], rtol=1e-6)
+
+    def test_tolist(self, x22):
+        assert P.tolist(x22) == [[1., 2.], [3., 4.]]
+
+    def test_is_empty(self, x22):
+        assert not np.asarray(P.is_empty(x22))
+        assert np.asarray(P.is_empty(P.to_tensor(np.zeros((0, 3)))))
+
+    def test_floor_mod_sign_follows_divisor(self):
+        got = np.asarray(P.floor_mod(P.to_tensor([-3., 3.]),
+                                     P.to_tensor([2., -2.])))
+        np.testing.assert_allclose(got, [1., -1.])
+
+    def test_cat_alias(self, x22):
+        assert P.cat([x22, x22], axis=1).shape == (2, 4)
+
+    def test_randint_like(self):
+        base = P.to_tensor(np.zeros((100,), np.int32))
+        r = np.asarray(P.randint_like(base, 3, 7))
+        assert r.dtype == np.int32 and r.min() >= 3 and r.max() < 7
+
+
+class TestRandomFills:
+    def test_bernoulli_(self, x22):
+        vals = np.unique(np.asarray(P.bernoulli_(
+            P.to_tensor(np.zeros((500,), np.float32)), 0.5)))
+        assert set(vals.tolist()) <= {0.0, 1.0}
+        # p=0 / p=1 degenerate cases
+        assert np.asarray(P.bernoulli_(x22, 0.0)).max() == 0.0
+        assert np.asarray(P.bernoulli_(x22, 1.0)).min() == 1.0
+
+    def test_cauchy_shape_dtype(self, x22):
+        c = P.cauchy_(x22, loc=1.0, scale=2.0)
+        assert c.shape == (2, 2) and c.dtype == jnp.float32
+
+    def test_geometric_support(self):
+        g = np.asarray(P.geometric_(
+            P.to_tensor(np.zeros((1000,), np.float32)), 0.5))
+        assert g.min() >= 1.0 and np.allclose(g, np.round(g))
+        # mean of Geometric(p) is 1/p
+        assert abs(g.mean() - 2.0) < 0.3
+
+
+class TestInplaceSurface:
+    def test_value_returning_aliases(self, x22):
+        xn = np.asarray(x22)
+        np.testing.assert_allclose(np.asarray(P.cos_(x22)), np.cos(xn),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(P.log_(x22)), np.log(xn),
+                                   rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(P.tril_(x22)), np.tril(xn))
+        np.testing.assert_array_equal(np.asarray(P.t_(x22)), xn.T)
+        np.testing.assert_array_equal(
+            np.asarray(P.reshape_(x22, [4])), xn.reshape(4))
+        np.testing.assert_array_equal(
+            np.asarray(P.unsqueeze_(x22, 0)), xn[None])
+
+    def test_full_surface_exists(self):
+        for n in ("acos_ asin_ atan_ atan2_ atanh_ copysign_ cumprod_ "
+                  "cumsum_ erf_ expm1_ flatten_ gammaln_ hypot_ i0_ "
+                  "index_add_ lcm_ gcd_ ldexp_ log10_ log1p_ log2_ "
+                  "logical_and_ logical_not_ logit_ masked_fill_ "
+                  "nan_to_num_ nextafter_ renorm_ scatter_ sigmoid_ sin_ "
+                  "square_ squeeze_ stanh_ tan_ triu_ where_ "
+                  "polygamma_").split():
+            assert callable(getattr(P, n)), n
+
+
+class TestHostUtilities:
+    def test_set_printoptions(self):
+        P.set_printoptions(precision=3)
+        s = repr(np.array([1.23456789]))
+        assert "1.235" in s
+        P.set_printoptions(precision=8)
+
+    def test_dlpack_roundtrip(self, x22):
+        y = P.from_dlpack(P.to_dlpack(x22))
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x22))
+
+    def test_dlpack_torch_interop(self, x22):
+        torch = pytest.importorskip("torch")
+        t = torch.from_dlpack(P.to_dlpack(x22))
+        np.testing.assert_allclose(t.numpy(), np.asarray(x22))
+        back = P.from_dlpack(torch.arange(4.0))
+        np.testing.assert_allclose(np.asarray(back), np.arange(4.0))
+
+    def test_dtype_objects(self, x22):
+        assert P.bool is P.bool_
+        assert isinstance(x22.dtype, P.dtype)
+        assert P.complex64 is np.complex64
+
+    def test_cuda_rng_state_alias(self):
+        st = P.get_cuda_rng_state()
+        P.set_cuda_rng_state(st)
+        assert P.get_rng_state() == st
+
+
+class TestGradModeAndHooks:
+    def test_enable_grad_nested(self):
+        with P.no_grad():
+            assert not P.is_grad_enabled()
+            with P.enable_grad():
+                assert P.is_grad_enabled()
+            assert not P.is_grad_enabled()
+        assert P.is_grad_enabled()
+
+    def test_saved_tensors_hooks_pack_unpack(self):
+        packed, unpacked = [], []
+
+        class Sq(AG.PyLayer):
+            @staticmethod
+            def forward(ctx, a):
+                ctx.save_for_backward(a)
+                return a * a
+
+            @staticmethod
+            def backward(ctx, g):
+                (a,) = ctx.saved_tensor()
+                return 2 * a * g
+
+        with AG.saved_tensors_hooks(
+                lambda t: (packed.append(1), t)[1],
+                lambda t: (unpacked.append(1), t)[1]):
+            gr = jax.grad(lambda a: Sq.apply(a).sum())(jnp.ones((3,)))
+        np.testing.assert_allclose(np.asarray(gr), 2.0)
+        assert packed and unpacked
+
+    def test_hooks_can_transform(self):
+        # pack to float16 and unpack back — the offload/compress use case
+        class Sq(AG.PyLayer):
+            @staticmethod
+            def forward(ctx, a):
+                ctx.save_for_backward(a)
+                return a * a
+
+            @staticmethod
+            def backward(ctx, g):
+                (a,) = ctx.saved_tensor()
+                return 2 * a * g
+
+        with AG.saved_tensors_hooks(lambda t: t.astype(jnp.float16),
+                                    lambda t: t.astype(jnp.float32)):
+            gr = jax.grad(lambda a: Sq.apply(a).sum())(3.0 * jnp.ones((3,)))
+        np.testing.assert_allclose(np.asarray(gr), 6.0)
+
+    def test_pylayer_context_type(self):
+        assert isinstance(AG.PyLayerContext, type)
+
+
+class TestLazyGuard:
+    def test_meta_params(self):
+        with P.LazyGuard():
+            lin = P.nn.Linear(16, 16)
+        p = list(lin.parameters())[0]
+        assert isinstance(p, jax.ShapeDtypeStruct)
+
+    def test_places(self):
+        import paddle_tpu.device as D
+        assert "CUDAPinnedPlace" in repr(D.CUDAPinnedPlace())

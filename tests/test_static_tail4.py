@@ -1,0 +1,251 @@
+"""Round-4 static tail: static.nn module (builders + (padded, length)
+sequence ops), py_func/static_pylayer, program state, EMA, places/guards.
+
+Reference: python/paddle/static/nn/* — the sequence ops here follow the
+repo's documented (padded, length) redesign of LoD (static/nn.py
+docstring).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu.static as S
+
+
+class TestModuleForm:
+    def test_static_nn_is_module(self):
+        import importlib
+        m = importlib.import_module("paddle_tpu.static.nn")
+        assert S.nn is m
+        for name in ("fc embedding batch_norm layer_norm conv2d "
+                     "conv2d_transpose sequence_pad sequence_pool py_func "
+                     "static_pylayer while_loop cond").split():
+            assert callable(getattr(S.nn, name)), name
+
+
+class TestBuilders:
+    def test_fc_in_program(self):
+        with S.program_guard(S.Program()):
+            x = S.data("x", [2, 4])
+            y = S.nn.fc(x, 3, activation="relu")
+            out = S.Executor().run(feed={"x": np.ones((2, 4), np.float32)},
+                                   fetch_list=[y])
+        assert out[0].shape == (2, 3) and out[0].min() >= 0
+
+    def test_conv_and_norm_builders_eager(self):
+        x = jnp.asarray(np.random.RandomState(0)
+                        .randn(2, 3, 8, 8).astype(np.float32))
+        y = S.nn.conv2d(x, 6, 3, padding=1, act="relu")
+        assert y.shape == (2, 6, 8, 8) and np.asarray(y).min() >= 0
+        z = S.nn.layer_norm(x, begin_norm_axis=1)
+        np.testing.assert_allclose(np.asarray(z).mean(axis=(1, 2, 3)), 0.0,
+                                   atol=1e-4)
+        g = S.nn.group_norm(x, groups=3)
+        assert g.shape == x.shape
+        b = S.nn.batch_norm(x, is_test=True)
+        assert b.shape == x.shape
+        e = S.nn.embedding(jnp.asarray([[1, 2], [3, 4]]), (10, 5))
+        assert e.shape == (2, 2, 5)
+
+    def test_data_norm_default_stats_identity(self):
+        x = jnp.asarray(np.random.RandomState(1).randn(4, 6)
+                        .astype(np.float32))
+        # defaults: mean 0, var 1 → output ≈ input
+        np.testing.assert_allclose(np.asarray(S.nn.data_norm(x)),
+                                   np.asarray(x), atol=1e-4)
+
+    def test_spectral_norm_unit_sigma(self):
+        w = np.random.RandomState(2).randn(6, 4).astype(np.float32)
+        wn = np.asarray(S.nn.spectral_norm(jnp.asarray(w), power_iters=50))
+        assert abs(np.linalg.svd(wn, compute_uv=False)[0] - 1.0) < 1e-3
+
+    def test_row_conv_lookahead_only(self):
+        x = np.zeros((1, 5, 2), np.float32)
+        x[0, 3] = 1.0  # impulse at t=3
+        out = np.asarray(S.nn.row_conv(jnp.asarray(x), 2))
+        # averaging filter 1/3: t=1..3 see the impulse, t=4 does not
+        assert out[0, 4].max() == 0.0
+        np.testing.assert_allclose(out[0, 1:4], 1 / 3, atol=1e-6)
+
+    def test_prelu_modes(self):
+        x = jnp.asarray(np.array([[-4.0, 8.0]], np.float32))
+        np.testing.assert_allclose(np.asarray(S.nn.prelu(x, "all")),
+                                   [[-1.0, 8.0]])
+
+    def test_nce_positive_loss_and_shape(self):
+        x = jnp.asarray(np.random.RandomState(3).randn(5, 8)
+                        .astype(np.float32))
+        lab = jnp.asarray([0, 1, 2, 3, 4])
+        loss = S.nn.nce(x, lab, num_total_classes=50, num_neg_samples=5,
+                        seed=3)
+        assert loss.shape == (5, 1) and np.asarray(loss).min() > 0
+
+
+class TestSequenceOps:
+    @pytest.fixture
+    def padded(self):
+        flat = np.arange(10.0, dtype=np.float32).reshape(5, 2)
+        return S.nn.sequence_pad(flat, 0.0, maxlen=3, length=[2, 3])
+
+    def test_pad_unpad_roundtrip(self, padded):
+        x, ln = padded
+        assert x.shape == (2, 3, 2)
+        assert np.asarray(x)[0, 2].max() == 0.0  # padded slot
+        flat = S.nn.sequence_unpad(x, ln)
+        np.testing.assert_allclose(np.asarray(flat),
+                                   np.arange(10.0).reshape(5, 2))
+
+    def test_pool_variants(self, padded):
+        x, ln = padded
+        xn = np.asarray(x)
+        np.testing.assert_allclose(np.asarray(S.nn.sequence_pool(x, "sum", ln)),
+                                   [xn[0, :2].sum(0), xn[1].sum(0)], atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(S.nn.sequence_pool(x, "average", ln)),
+            [xn[0, :2].mean(0), xn[1].mean(0)], atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(S.nn.sequence_pool(x, "max", ln)),
+            [xn[0, :2].max(0), xn[1].max(0)], atol=1e-5)
+        np.testing.assert_allclose(np.asarray(S.nn.sequence_last_step(x, ln)),
+                                   [xn[0, 1], xn[1, 2]], atol=1e-6)
+        np.testing.assert_allclose(np.asarray(S.nn.sequence_first_step(x)),
+                                   xn[:, 0], atol=1e-6)
+
+    def test_softmax_masks_padding(self, padded):
+        x, ln = padded
+        p = np.asarray(S.nn.sequence_softmax(x, ln))
+        np.testing.assert_allclose(p[0, :2].sum(0), 1.0, atol=1e-5)
+        assert p[0, 2].max() == 0.0
+        np.testing.assert_allclose(p[1].sum(0), 1.0, atol=1e-5)
+
+    def test_reverse_valid_prefix(self, padded):
+        x, ln = padded
+        r = np.asarray(S.nn.sequence_reverse(x, ln))
+        xn = np.asarray(x)
+        np.testing.assert_allclose(r[0, 0], xn[0, 1])
+        np.testing.assert_allclose(r[0, 1], xn[0, 0])
+        np.testing.assert_allclose(r[0, 2], xn[0, 2])  # padding untouched
+        np.testing.assert_allclose(r[1], xn[1, ::-1])
+
+    def test_concat_packs_back_to_back(self):
+        a = jnp.asarray(np.ones((2, 2, 1), np.float32))
+        b = jnp.asarray(2 * np.ones((2, 2, 1), np.float32))
+        out, ln = S.nn.sequence_concat([a, b], [jnp.asarray([1, 2]),
+                                                jnp.asarray([2, 1])])
+        assert ln.tolist() == [3, 3]
+        np.testing.assert_allclose(np.asarray(out)[0, :3, 0], [1, 2, 2])
+        np.testing.assert_allclose(np.asarray(out)[1, :3, 0], [1, 1, 2])
+
+    def test_expand_and_reshape(self):
+        x = np.array([[1.0], [2.0]], np.float32)
+        e = S.nn.sequence_expand(x, [2, 3])
+        np.testing.assert_allclose(np.asarray(e)[:, 0], [1, 1, 2, 2, 2])
+        r = S.nn.sequence_reshape(np.arange(12.0).reshape(6, 2), 4)
+        assert r.shape == (3, 4)
+
+    def test_enumerate_windows(self):
+        ids = jnp.asarray([[1, 2, 3]])
+        w = np.asarray(S.nn.sequence_enumerate(ids, 2, pad_value=0))
+        np.testing.assert_array_equal(w[0], [[1, 2], [2, 3], [3, 0]])
+
+    def test_slice_and_scatter(self):
+        x = jnp.asarray(np.arange(12.0, np.float32).reshape(2, 6, 1)
+                        if False else
+                        np.arange(12.0).reshape(2, 6, 1).astype(np.float32))
+        sl = np.asarray(S.nn.sequence_slice(x, [1, 2], [2, 2]))
+        np.testing.assert_allclose(sl[0, :, 0], [1, 2])
+        np.testing.assert_allclose(sl[1, :, 0], [8, 9])
+        sc = np.asarray(S.nn.sequence_scatter(
+            x, jnp.asarray([[0], [5]]), jnp.asarray([[[10.0]], [[10.0]]])))
+        assert sc[0, 0, 0] == 10.0 and sc[1, 5, 0] == 21.0
+
+    def test_sequence_conv_shape_and_mask(self):
+        x = jnp.asarray(np.random.RandomState(4).randn(2, 5, 3)
+                        .astype(np.float32))
+        out = S.nn.sequence_conv(x, 4, filter_size=3,
+                                 length=jnp.asarray([3, 5]))
+        assert out.shape == (2, 5, 4)
+        assert np.abs(np.asarray(out)[0, 3:]).max() == 0.0
+
+
+class TestPyFuncAndPylayer:
+    def test_py_func_forward(self):
+        out_t = jax.ShapeDtypeStruct((3,), np.float32)
+        y = S.nn.py_func(lambda a: (np.asarray(a) * 2).astype(np.float32),
+                         jnp.ones((3,), jnp.float32), out_t)
+        np.testing.assert_allclose(np.asarray(y), 2.0)
+
+    def test_py_func_under_jit(self):
+        f = jax.jit(lambda v: S.nn.py_func(
+            lambda a: (np.asarray(a) * 2).astype(np.float32), v,
+            jax.ShapeDtypeStruct((3,), np.float32)))
+        np.testing.assert_allclose(np.asarray(f(jnp.ones((3,)))), 2.0)
+
+    def test_py_func_backward(self):
+        def fwd(a):
+            return (np.asarray(a) ** 2).astype(np.float32)
+
+        def bwd(a, g):
+            return (2 * np.asarray(a) * np.asarray(g)).astype(np.float32)
+
+        gr = jax.grad(lambda v: S.nn.py_func(
+            fwd, v, jax.ShapeDtypeStruct((1,), np.float32), bwd).sum())(
+                jnp.asarray([3.0], jnp.float32))
+        np.testing.assert_allclose(np.asarray(gr), [6.0])
+
+    def test_static_pylayer(self):
+        out = S.nn.static_pylayer(lambda a: a * 3, [jnp.asarray(2.0)])
+        assert float(out) == 6.0
+        g = jax.grad(lambda v: S.nn.static_pylayer(
+            lambda a: a * a, [v], lambda ct: 5.0 * ct))(jnp.asarray(2.0))
+        assert float(g) == 5.0
+
+
+class TestStaticTail:
+    def test_variable_alias(self):
+        assert S.Variable is S.Var
+
+    def test_places_and_guards(self):
+        assert len(S.cuda_places([0, 1])) == 2
+        assert S.xpu_places is S.cuda_places
+        with S.device_guard("cpu"):
+            pass
+        with S.ipu_shard_guard(0):
+            pass
+
+    def test_program_state_roundtrip(self, tmp_path):
+        prog = S.Program()
+        S.set_program_state(prog, {"a": np.ones(3, np.float32)})
+        path = str(tmp_path / "m")
+        S.save(prog, path)
+        prog2 = S.Program()
+        S.load(prog2, path)
+        np.testing.assert_allclose(np.asarray(prog2.params["a"]), 1.0)
+        st = S.load_program_state(path)
+        assert "a" in st
+
+    def test_normalize_program(self):
+        prog = S.Program()
+        with S.program_guard(prog):
+            x = S.data("x", [2, 2])
+            y = x + 1.0
+        out = S.normalize_program(prog, [x], [y])
+        assert out is prog and prog._normalized_io[0] == ["x"]
+
+    def test_weight_norm_param_attr(self):
+        a = S.WeightNormParamAttr(dim=0, name="w")
+        assert a.dim == 0 and a.trainable
+
+    def test_ema_debias_and_converge(self):
+        ema = S.ExponentialMovingAverage(0.9)
+        p = {"w": jnp.asarray(10.0)}
+        out = ema.update(p)
+        np.testing.assert_allclose(float(out["w"]), 10.0, rtol=1e-6)
+        for _ in range(60):
+            out = ema.update(p)
+        np.testing.assert_allclose(float(out["w"]), 10.0, rtol=1e-4)
+        with ema.apply() as shadow:
+            assert "w" in shadow
+        ema.restore()

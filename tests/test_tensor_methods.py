@@ -1,0 +1,123 @@
+"""paddle Tensor METHOD surface (core/tensor_methods.py): x.abs(),
+x.unsqueeze(0), x.add_(y) ... on jax arrays, eager AND under jit.
+
+Reference: python/paddle/tensor/__init__.py's Tensor monkey-patch.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.core import tensor_methods
+
+
+@pytest.fixture
+def x22():
+    return P.to_tensor(np.array([[1.0, -2.0], [3.0, -4.0]], np.float32))
+
+
+class TestInstall:
+    def test_wide_surface_installed(self):
+        names = tensor_methods.installed_names()
+        assert len(names) >= 300
+        for n in ("abs unsqueeze squeeze matmul add subtract multiply "
+                  "divide gather scatter tril triu cumsum argsort topk "
+                  "masked_fill index_select numpy detach clone dim cpu "
+                  "add_ exp_ zero_ uniform_").split():
+            assert n in names, n
+
+    def test_idempotent(self):
+        before = len(tensor_methods.installed_names())
+        tensor_methods.install()
+        assert len(tensor_methods.installed_names()) == before
+
+    def test_jax_native_not_overridden(self):
+        # reshape/sum/mean come from jax and already match the reference
+        x = jnp.ones((2, 3))
+        assert x.reshape(3, 2).shape == (3, 2)
+        assert float(x.sum()) == 6.0
+
+
+class TestEagerMethods:
+    def test_math_methods(self, x22):
+        xn = np.asarray(x22)
+        np.testing.assert_allclose(np.asarray(x22.abs()), np.abs(xn))
+        np.testing.assert_allclose(np.asarray(x22.add(x22)), 2 * xn)
+        np.testing.assert_allclose(np.asarray(x22.multiply(x22)), xn * xn)
+        np.testing.assert_allclose(np.asarray(x22.pow(2)), xn ** 2,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(x22.maximum(x22.neg())),
+                                   np.maximum(xn, -xn))
+
+    def test_shape_methods(self, x22):
+        assert x22.unsqueeze(0).shape == (1, 2, 2)
+        assert x22.unsqueeze(0).squeeze(0).shape == (2, 2)
+        assert x22.t().shape == (2, 2)
+        assert x22.tile([2, 1]).shape == (4, 2)
+        assert x22.flip(0).shape == (2, 2)
+
+    def test_matmul_and_linalg(self, x22):
+        np.testing.assert_allclose(np.asarray(x22.matmul(x22.t())),
+                                   np.asarray(x22) @ np.asarray(x22).T,
+                                   rtol=1e-5)
+        assert x22.norm() > 0
+
+    def test_inplace_value_returning(self, x22):
+        xn = np.asarray(x22)
+        np.testing.assert_allclose(np.asarray(x22.add_(x22)), 2 * xn)
+        np.testing.assert_allclose(np.asarray(x22.zero_()), 0.0)
+        u = x22.uniform_(0.0, 1.0)
+        assert 0.0 <= np.asarray(u).min() and np.asarray(u).max() <= 1.0
+
+    def test_host_methods(self, x22):
+        np.testing.assert_allclose(x22.numpy(), np.asarray(x22))
+        assert x22.tolist() == [[1.0, -2.0], [3.0, -4.0]]
+        assert x22.dim() == 2 and x22.ndimension() == 2
+        assert x22.element_size() == 4
+        assert x22.clone().shape == x22.shape
+        assert x22.cpu().shape == x22.shape
+
+    def test_comparison_methods(self, x22):
+        got = np.asarray(x22.greater_than(P.zeros([2, 2])))
+        np.testing.assert_array_equal(got, np.asarray(x22) > 0)
+
+    def test_error_guidance(self, x22):
+        with pytest.raises(RuntimeError, match="TrainStep"):
+            x22.backward()
+        with pytest.raises(RuntimeError, match="immutable"):
+            x22.set_value(np.zeros((2, 2)))
+
+
+class TestTracedMethods:
+    def test_methods_on_tracers(self, x22):
+        @jax.jit
+        def f(v):
+            return v.abs().unsqueeze(-1).squeeze(-1).multiply(v.sign())
+
+        np.testing.assert_allclose(np.asarray(f(x22)), np.asarray(x22))
+
+    def test_grad_through_methods(self):
+        g = jax.grad(lambda v: v.square().sum())(jnp.asarray([3.0, -1.0]))
+        np.testing.assert_allclose(np.asarray(g), [6.0, -2.0])
+
+    def test_detach_stops_gradient(self):
+        g = jax.grad(lambda v: (v.detach() * v).sum())(jnp.asarray([2.0]))
+        np.testing.assert_allclose(np.asarray(g), [2.0])
+
+    def test_method_chain_in_scan(self):
+        def body(c, _):
+            return c.add(c.abs().rsqrt()), None
+
+        out, _ = jax.lax.scan(body, jnp.ones((3,)), None, length=4)
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestSpecRecordsMethods:
+    def test_api_spec_contains_tensor_methods(self):
+        import os
+        spec = open(os.path.join(os.path.dirname(__file__), "..", "tools",
+                                 "api_spec.txt")).read()
+        assert "paddle_tpu.Tensor.abs()" in spec
+        assert "paddle_tpu.Tensor.add_()" in spec

@@ -1,0 +1,165 @@
+"""Round-4: paddle.text (ViterbiDecoder + datasets), paddle.hub (local
+hubconf protocol), paddle.audio submodule structure (wave backend IO).
+"""
+
+import itertools
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.audio as A
+from paddle_tpu.text import ViterbiDecoder, viterbi_decode
+
+
+class TestViterbi:
+    def test_matches_brute_force(self):
+        rng = np.random.RandomState(0)
+        B, T, N = 2, 4, 3
+        em = rng.randn(B, T, N).astype(np.float32)
+        trans = rng.randn(N, N).astype(np.float32)
+        lens = np.array([4, 3], np.int32)
+
+        def brute(em_b, L):
+            best, path = -1e9, None
+            for p in itertools.product(range(N), repeat=L):
+                s = em_b[0, p[0]]
+                for t in range(1, L):
+                    s += trans[p[t], p[t - 1]] + em_b[t, p[t]]
+                if s > best:
+                    best, path = s, p
+            return best, path
+
+        scores, paths = viterbi_decode(em, trans, lens,
+                                       include_bos_eos_tag=False)
+        for b in range(B):
+            bs, bp = brute(em[b], int(lens[b]))
+            assert abs(float(scores[b]) - bs) < 1e-4
+            assert np.asarray(paths)[b][:lens[b]].tolist() == list(bp)
+
+    def test_padding_zeroed(self):
+        em = np.random.RandomState(1).randn(1, 5, 6).astype(np.float32)
+        trans = np.random.RandomState(2).randn(6, 6).astype(np.float32)
+        _, paths = viterbi_decode(em, trans, jnp.asarray([3]),
+                                  include_bos_eos_tag=False)
+        assert np.asarray(paths)[0, 3:].tolist() == [0, 0]
+
+    def test_bos_eos_changes_path_scores(self):
+        em = np.random.RandomState(3).randn(1, 4, 5).astype(np.float32)
+        trans = np.random.RandomState(4).randn(5, 5).astype(np.float32)
+        s1, _ = viterbi_decode(em, trans, include_bos_eos_tag=False)
+        s2, _ = viterbi_decode(em, trans, include_bos_eos_tag=True)
+        assert abs(float(s1[0]) - float(s2[0])) > 1e-6
+
+    def test_decoder_layer_form(self):
+        em = np.random.RandomState(5).randn(2, 3, 4).astype(np.float32)
+        trans = np.random.RandomState(6).randn(4, 4).astype(np.float32)
+        dec = ViterbiDecoder(trans, include_bos_eos_tag=False)
+        scores, paths = dec(jnp.asarray(em))
+        s2, p2 = viterbi_decode(em, trans, include_bos_eos_tag=False)
+        np.testing.assert_allclose(np.asarray(scores), np.asarray(s2))
+        np.testing.assert_array_equal(np.asarray(paths), np.asarray(p2))
+
+
+class TestTextDatasets:
+    def test_missing_file_raises_with_guidance(self):
+        from paddle_tpu.text import Imdb, UCIHousing
+        with pytest.raises(FileNotFoundError, match="downloads are disabled"):
+            UCIHousing(data_file=None)
+        with pytest.raises(FileNotFoundError):
+            Imdb(data_file="/nonexistent")
+
+    def test_ucihousing_local_file(self, tmp_path):
+        from paddle_tpu.text import UCIHousing
+        rng = np.random.RandomState(0)
+        data = np.hstack([rng.rand(50, 13), rng.rand(50, 1) * 50])
+        f = tmp_path / "housing.data"
+        np.savetxt(f, data)
+        train = UCIHousing(data_file=str(f), mode="train")
+        test = UCIHousing(data_file=str(f), mode="test")
+        assert len(train) == 40 and len(test) == 10
+        x, y = train[0]
+        assert x.shape == (13,) and 0.0 <= x.min() and x.max() <= 1.0
+
+    def test_movielens_ratings(self, tmp_path):
+        from paddle_tpu.text import Movielens
+        f = tmp_path / "ratings.dat"
+        f.write_text("1::10::5::978300760\n2::20::3::978302109\n")
+        ds = Movielens(data_file=str(f))
+        assert ds[0] == (1, 10, 5.0) and len(ds) == 2
+
+
+class TestHub:
+    @pytest.fixture
+    def repo(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "dependencies = []\n"
+            "def small_model(width=4):\n"
+            "    'builds the tiny model'\n"
+            "    import paddle_tpu.nn as nn\n"
+            "    return nn.Linear(width, width)\n")
+        return str(tmp_path)
+
+    def test_list_help_load(self, repo):
+        import paddle_tpu.hub as hub
+        assert hub.list(repo) == ["small_model"]
+        assert "tiny model" in hub.help(repo, "small_model")
+        m = hub.load(repo, "small_model", width=8)
+        assert m.weight.shape == (8, 8)
+
+    def test_remote_source_raises(self, repo):
+        import paddle_tpu.hub as hub
+        with pytest.raises(NotImplementedError, match="egress"):
+            hub.load("owner/repo", "m", source="github")
+
+    def test_missing_entrypoint(self, repo):
+        import paddle_tpu.hub as hub
+        with pytest.raises(ValueError, match="small_model"):
+            hub.load(repo, "nope")
+
+
+class TestAudioStructure:
+    def test_submodules_exist(self):
+        for name in ("backends", "features", "functional", "datasets"):
+            assert hasattr(A, name), name
+        assert callable(A.features.MelSpectrogram)
+        assert callable(A.functional.get_window)
+
+    def test_wav_roundtrip_and_info(self, tmp_path):
+        sig = np.sin(np.linspace(0, 100, 4000)).astype(np.float32)[None, :]
+        p = str(tmp_path / "t.wav")
+        A.save(p, sig, 16000)
+        wav, sr = A.load(p)
+        assert sr == 16000
+        np.testing.assert_allclose(np.asarray(wav), sig, atol=1e-3)
+        meta = A.info(p)
+        assert meta.num_channels == 1 and meta.bits_per_sample == 16
+        assert meta.num_samples == 4000
+
+    def test_frame_offset_and_count(self, tmp_path):
+        sig = np.arange(100, dtype=np.float32)[None, :] / 200.0
+        p = str(tmp_path / "t2.wav")
+        A.save(p, sig, 8000)
+        wav, _ = A.load(p, frame_offset=10, num_frames=5)
+        assert wav.shape == (1, 5)
+
+    def test_mel_hz_roundtrip(self):
+        from paddle_tpu.audio.functional import hz_to_mel, mel_to_hz
+        for htk in (False, True):
+            np.testing.assert_allclose(
+                mel_to_hz(hz_to_mel(np.array([110.0, 440.0, 4000.0]),
+                                    htk=htk), htk=htk),
+                [110.0, 440.0, 4000.0], rtol=1e-6)
+
+    def test_esc50_fold_split(self, tmp_path):
+        from paddle_tpu.audio.datasets import ESC50
+        sig = np.zeros((1, 100), np.float32)
+        for name in ("1-100-A-0.wav", "5-101-A-7.wav"):
+            A.save(str(tmp_path / name), sig, 8000)
+        train = ESC50(data_dir=str(tmp_path), mode="train")
+        valid = ESC50(data_dir=str(tmp_path), mode="valid")
+        assert len(train) == 1 and len(valid) == 1
+        wav, label = valid[0]
+        assert label == 7

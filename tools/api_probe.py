@@ -1,0 +1,254 @@
+#!/usr/bin/env python
+"""Probe which well-known reference public APIs are missing from paddle_tpu.
+
+The candidate list below is reconstructed from knowledge of the reference's
+public API surface (python/paddle/* __all__ lists); it is a superset probe —
+names listed here that the reference later removed are harmless (they just
+show as missing and can be skipped deliberately).
+
+Usage: python tools/api_probe.py [--namespace NS]
+Prints `NS MISSING name` lines plus a per-namespace summary.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CANDIDATES = {
+    "paddle_tpu": """
+        abs acos acosh add addmm all allclose amax amin angle any arange argmax argmin argsort
+        as_complex as_real as_strided asin asinh assign atan atan2 atanh atleast_1d atleast_2d atleast_3d
+        baddbmm bernoulli bernoulli_ bincount bitwise_and bitwise_invert bitwise_left_shift
+        bitwise_not bitwise_or bitwise_right_shift bitwise_xor block_diag bmm broadcast_shape
+        broadcast_tensors broadcast_to bucketize cast cat cauchy_ cdist ceil chunk clip clone
+        column_stack combinations complex concat conj copysign corrcoef cos cosh
+        count_nonzero cov cross crop cummax cummin cumprod cumsum cumulative_trapezoid deg2rad diag
+        diag_embed diagflat diagonal diagonal_scatter diff digamma dist divide dot dsplit dstack
+        einsum empty empty_like equal equal_all erf erfinv exp expand expand_as expm1 eye
+        finfo flatten flip fliplr flipud floor floor_divide floor_mod fmax fmin frac frexp full
+        full_like gammainc gammaincc gammaln gather gather_nd gcd geometric_ greater_equal
+        greater_than heaviside histogram histogram_bin_edges histogramdd hsplit hstack hypot i0
+        i0e i1 i1e iinfo imag increment index_add index_fill index_put index_sample index_select
+        inner inverse is_complex is_empty is_floating_point is_grad_enabled is_integer is_tensor
+        isclose isfinite isin isinf isnan isneginf isposinf isreal kron kthvalue lcm ldexp
+        lerp less_equal less_than lgamma linspace log log10 log1p log2 logaddexp logcumsumexp
+        logical_and logical_not logical_or logical_xor logit logspace logsumexp masked_fill
+        masked_scatter masked_select matmul max maximum mean median meshgrid min minimum mm mod
+        mode moveaxis multigammaln multinomial multiplex multiply mv nan_to_num nanmean nanmedian
+        nanquantile nansum neg nextafter nonzero norm normal not_equal numel ones ones_like outer
+        pdist permute poisson polar polygamma positive pow prod put_along_axis quantile rad2deg rand
+        randint randint_like randn randperm rank real reciprocal remainder renorm repeat_interleave
+        reshape roll rot90 round row_stack rsqrt scale scatter scatter_nd scatter_nd_add searchsorted
+        select_scatter sgn shard_index sign signbit sin sinc sinh slice slice_scatter sort split
+        sqrt square squeeze stack stanh std strided_slice subtract sum t take take_along_axis tan
+        tanh tensor_split tensordot tile to_tensor tolist topk trace transpose trapezoid tril
+        tril_indices triu triu_indices trunc unbind unflatten unfold uniform unique
+        unique_consecutive unsqueeze unstack vander var vdot view view_as vsplit vstack where
+        zeros zeros_like
+        abs_ acos_ acosh_ add_ addmm_ asin_ asinh_ atan_ atan2_ atanh_ ceil_ clip_ copysign_
+        cos_ cosh_ cumprod_ cumsum_ digamma_ divide_ erf_ erfinv_ exp_ expm1_ fill_ fill_diagonal_
+        flatten_ floor_ floor_divide_ gammainc_ gammaincc_ gammaln_ hypot_ i0_ index_add_
+        index_fill_ index_put_ lcm_ gcd_ ldexp_ lerp_ lgamma_ log_ log10_ log1p_ log2_ logical_and_
+        logical_not_ logical_or_ logical_xor_ logit_ masked_fill_ masked_scatter_ multigammaln_
+        multiply_ nan_to_num_ neg_ nextafter_ normal_ pow_ reciprocal_ remainder_ renorm_ reshape_
+        round_ rsqrt_ scale_ scatter_ sigmoid_ sin_ sinh_ sqrt_ square_ squeeze_ stanh_ subtract_
+        t_ tan_ tanh_ tril_ triu_ trunc_ unsqueeze_ uniform_ where_ zero_ exponential_ polygamma_
+        set_printoptions get_default_dtype set_default_dtype disable_static enable_static
+        in_dynamic_mode grad no_grad enable_grad set_grad_enabled is_grad_enabled save load seed
+        get_cuda_rng_state set_cuda_rng_state get_rng_state set_rng_state summary flops
+        device_count set_device get_device CPUPlace CUDAPlace CUDAPinnedPlace XPUPlace
+        to_dlpack from_dlpack LazyGuard
+        histc bfloat16 float16 float32 float64 int8 int16 int32 int64 uint8 bool complex64
+        complex128 dtype Tensor
+    """,
+    "paddle_tpu.linalg": """
+        cholesky cholesky_inverse cholesky_solve cond corrcoef cov det eig eigh eigvals eigvalsh
+        householder_product inv lstsq lu lu_unpack lu_solve matrix_exp matrix_norm matrix_power matrix_rank
+        multi_dot norm ormqr pca_lowrank pinv qr slogdet solve svd svd_lowrank svdvals
+        triangular_solve vector_norm
+    """,
+    "paddle_tpu.fft": """
+        fft fft2 fftn fftfreq fftshift hfft hfft2 hfftn ifft ifft2 ifftn ifftshift ihfft ihfft2
+        ihfftn irfft irfft2 irfftn rfft rfft2 rfftn rfftfreq
+    """,
+    "paddle_tpu.signal": """
+        stft istft
+    """,
+    "paddle_tpu.nn": """
+        AdaptiveAvgPool1D AdaptiveAvgPool2D AdaptiveAvgPool3D AdaptiveMaxPool1D AdaptiveMaxPool2D
+        AdaptiveMaxPool3D AlphaDropout AvgPool1D AvgPool2D AvgPool3D BCELoss BCEWithLogitsLoss
+        BatchNorm BatchNorm1D BatchNorm2D BatchNorm3D BeamSearchDecoder Bilinear CELU CTCLoss
+        ChannelShuffle ClipGradByGlobalNorm ClipGradByNorm ClipGradByValue Conv1D Conv1DTranspose
+        Conv2D Conv2DTranspose Conv3D Conv3DTranspose CosineEmbeddingLoss CosineSimilarity
+        CrossEntropyLoss Dropout Dropout2D Dropout3D ELU Embedding Flatten Fold FractionalMaxPool2D
+        FractionalMaxPool3D GELU GLU GRU GRUCell GaussianNLLLoss GroupNorm GumbelSoftmax HSigmoidLoss
+        Hardshrink Hardsigmoid Hardswish Hardtanh HingeEmbeddingLoss Identity InstanceNorm1D
+        InstanceNorm2D InstanceNorm3D KLDivLoss L1Loss LSTM LSTMCell LayerDict LayerList LayerNorm
+        LeakyReLU Linear LocalResponseNorm LogSigmoid LogSoftmax MSELoss MarginRankingLoss
+        MaxPool1D MaxPool2D MaxPool3D MaxUnPool1D MaxUnPool2D MaxUnPool3D Maxout Mish
+        MultiHeadAttention MultiLabelSoftMarginLoss MultiMarginLoss NLLLoss PReLU Pad1D Pad2D Pad3D
+        PairwiseDistance ParameterList PixelShuffle PixelUnshuffle PoissonNLLLoss RNN RNNCellBase
+        RReLU ReLU ReLU6 SELU Sequential SiLU Sigmoid SimpleRNN SimpleRNNCell SmoothL1Loss
+        SoftMarginLoss Softmax Softmax2D Softplus Softshrink Softsign SpectralNorm SyncBatchNorm
+        Tanh Tanhshrink ThresholdedReLU Transformer TransformerDecoder TransformerDecoderLayer
+        TransformerEncoder TransformerEncoderLayer TripletMarginLoss TripletMarginWithDistanceLoss
+        Unflatten Unfold Upsample UpsamplingBilinear2D UpsamplingNearest2D ZeroPad1D ZeroPad2D ZeroPad3D
+        Layer Parameter dynamic_decode initializer utils functional quant
+    """,
+    "paddle_tpu.nn.functional": """
+        adaptive_avg_pool1d adaptive_avg_pool2d adaptive_avg_pool3d adaptive_max_pool1d
+        adaptive_max_pool2d adaptive_max_pool3d affine_grid alpha_dropout avg_pool1d avg_pool2d
+        avg_pool3d batch_norm bilinear binary_cross_entropy binary_cross_entropy_with_logits
+        celu channel_shuffle class_center_sample conv1d conv1d_transpose conv2d conv2d_transpose
+        conv3d conv3d_transpose cosine_embedding_loss cosine_similarity cross_entropy ctc_loss
+        dice_loss dropout dropout2d dropout3d elu elu_ embedding flash_attention fold
+        fractional_max_pool2d fractional_max_pool3d gather_tree gaussian_nll_loss gelu glu
+        grid_sample group_norm gumbel_softmax hardshrink hardsigmoid hardswish hardtanh
+        hinge_embedding_loss hsigmoid_loss instance_norm interpolate kl_div l1_loss label_smooth
+        layer_norm leaky_relu linear local_response_norm log_loss log_sigmoid log_softmax
+        margin_cross_entropy margin_ranking_loss max_pool1d max_pool2d max_pool3d max_unpool1d
+        max_unpool2d max_unpool3d maxout mish mse_loss multi_label_soft_margin_loss multi_margin_loss
+        nll_loss normalize npair_loss one_hot pad pairwise_distance pixel_shuffle pixel_unshuffle
+        poisson_nll_loss prelu relu relu6 relu_ rrelu scaled_dot_product_attention selu sequence_mask
+        sigmoid sigmoid_focal_loss silu smooth_l1_loss soft_margin_loss softmax softmax_ softplus
+        softshrink softsign sparse_attention square_error_cost swish tanhshrink temporal_shift
+        thresholded_relu triplet_margin_loss triplet_margin_with_distance_loss unfold upsample
+        zeropad2d
+    """,
+    "paddle_tpu.distribution": """
+        AbsTransform AffineTransform Bernoulli Beta Binomial Categorical Cauchy ChainTransform
+        ChiSquared ContinuousBernoulli Dirichlet Distribution Exponential ExponentialFamily
+        ExpTransform Gamma Geometric Gumbel Independent IndependentTransform Laplace LKJCholesky
+        LogNormal Multinomial MultivariateNormal Normal Poisson PowerTransform ReshapeTransform
+        SigmoidTransform SoftmaxTransform StackTransform StickBreakingTransform StudentT
+        TanhTransform Transform TransformedDistribution Uniform kl_divergence register_kl
+    """,
+    "paddle_tpu.incubate": """
+        segment_max segment_mean segment_min segment_sum identity_loss graph_khop_sampler
+        graph_reindex graph_sample_neighbors softmax_mask_fuse softmax_mask_fuse_upper_triangle
+        asp autograd nn
+    """,
+    "paddle_tpu.geometric": """
+        reindex_graph reindex_heter_graph sample_neighbors segment_max segment_mean segment_min
+        segment_sum send_u_recv send_ue_recv send_uv weighted_sample_neighbors
+    """,
+    "paddle_tpu.utils": """
+        deprecated try_import require_version run_check unique_name dlpack download cpp_extension
+    """,
+    "paddle_tpu.vision.ops": """
+        DeformConv2D PSRoIPool RoIAlign RoIPool batched_nms box_coder decode_jpeg deform_conv2d
+        distribute_fpn_proposals generate_proposals matrix_nms nms prior_box psroi_pool read_file
+        roi_align roi_pool yolo_box yolo_loss
+    """,
+    "paddle_tpu.sparse": """
+        abs add addmm asin asinh atan atanh cast coalesce deg2rad divide expm1 is_same_shape
+        isnan log1p mask_as masked_matmul matmul multiply mv nn rad2deg reshape sin sinh slice
+        sparse_coo_tensor sparse_csr_tensor sqrt square subtract sum tan tanh transpose
+    """,
+    "paddle_tpu.static": """
+        InputSpec Program Variable append_backward cpu_places cuda_places data default_main_program
+        default_startup_program device_guard global_scope gradients ipu_shard_guard load
+        load_inference_model load_program_state name_scope normalize_program npu_places nn
+        program_guard py_func save save_inference_model scope_guard set_program_state xpu_places
+        WeightNormParamAttr ExponentialMovingAverage
+    """,
+    "paddle_tpu.static.nn": """
+        batch_norm case cond conv2d conv2d_transpose conv3d conv3d_transpose data_norm deform_conv2d
+        embedding fc group_norm instance_norm layer_norm nce prelu py_func row_conv sequence_concat
+        sequence_conv sequence_enumerate sequence_expand sequence_expand_as sequence_first_step
+        sequence_last_step sequence_pad sequence_pool sequence_reshape sequence_reverse
+        sequence_scatter sequence_slice sequence_softmax sequence_unpad sparse_embedding spectral_norm
+        static_pylayer switch_case while_loop
+    """,
+    "paddle_tpu.text": """
+        Conll05st Imdb Imikolov Movielens UCIHousing WMT14 WMT16 ViterbiDecoder viterbi_decode
+    """,
+    "paddle_tpu.audio": """
+        backends datasets features functional info load save
+    """,
+    "paddle_tpu.vision.transforms": """
+        BaseTransform BrightnessTransform CenterCrop ColorJitter Compose ContrastTransform Grayscale
+        HueTransform Normalize Pad RandomAffine RandomCrop RandomErasing RandomHorizontalFlip
+        RandomPerspective RandomResizedCrop RandomRotation RandomVerticalFlip Resize SaturationTransform
+        ToTensor Transpose adjust_brightness adjust_contrast adjust_hue affine center_crop crop erase
+        hflip normalize pad perspective resize rotate to_grayscale to_tensor vflip
+    """,
+    "paddle_tpu.optimizer": """
+        Adadelta Adagrad Adam Adamax AdamW ASGD LBFGS Lamb LarsMomentum Momentum NAdam Optimizer
+        RAdam RMSProp Rprop SGD lr
+    """,
+    "paddle_tpu.optimizer.lr": """
+        CosineAnnealingDecay CosineAnnealingWarmRestarts CyclicLR ExponentialDecay InverseTimeDecay
+        LRScheduler LambdaDecay LinearLR LinearWarmup MultiStepDecay MultiplicativeDecay NaturalExpDecay
+        NoamDecay OneCycleLR PiecewiseDecay PolynomialDecay ReduceOnPlateau StepDecay
+    """,
+    "paddle_tpu.distributed": """
+        all_gather all_gather_object all_reduce alltoall alltoall_single barrier broadcast
+        broadcast_object_list destroy_process_group get_backend get_group get_rank get_world_size
+        gloo_barrier gloo_init_parallel_env gloo_release init_parallel_env irecv is_available
+        is_initialized isend launch new_group recv reduce reduce_scatter scatter scatter_object_list
+        send spawn split stream wait ParallelEnv DistAttr DistModel Partial Placement Replicate Shard
+        Strategy dtensor_from_fn reshard shard_dataloader shard_layer shard_optimizer shard_tensor
+        to_static unshard_dtensor load_state_dict save_state_dict
+    """,
+    "paddle_tpu.metrics": """
+        Accuracy Auc Metric Precision Recall accuracy
+    """,
+    "paddle_tpu.hub": """
+        help list load
+    """,
+    "paddle_tpu.onnx": """
+        export
+    """,
+    "paddle_tpu.autograd": """
+        PyLayer PyLayerContext backward hessian jacobian saved_tensors_hooks
+    """,
+    "paddle_tpu.nn.initializer": """
+        Assign Bilinear Constant Dirac Initializer KaimingNormal KaimingUniform Normal Orthogonal
+        TruncatedNormal Uniform XavierNormal XavierUniform calculate_gain set_global_initializer
+    """,
+    "paddle_tpu.nn.utils": """
+        clip_grad_norm_ clip_grad_value_ parameters_to_vector remove_weight_norm spectral_norm
+        vector_to_parameters weight_norm
+    """,
+    "paddle_tpu.io": """
+        BatchSampler ChainDataset ComposeDataset ConcatDataset DataLoader Dataset DistributedBatchSampler
+        IterableDataset RandomSampler Sampler SequenceSampler Subset SubsetRandomSampler TensorDataset
+        WeightedRandomSampler get_worker_info random_split
+    """,
+}
+
+
+def main():
+    import importlib
+    only = sys.argv[2] if len(sys.argv) > 2 and sys.argv[1] == "--namespace" else None
+    total_missing = 0
+    summary = []
+    for ns, blob in CANDIDATES.items():
+        if only and ns != only:
+            continue
+        names = blob.split()
+        try:
+            parts = ns.split(".")
+            mod = importlib.import_module(parts[0])
+            obj = mod
+            for p in parts[1:]:
+                obj = getattr(obj, p)
+        except Exception as e:
+            print(f"{ns} IMPORT-FAIL {e}")
+            summary.append((ns, len(names), len(names)))
+            total_missing += len(names)
+            continue
+        missing = [n for n in names if not hasattr(obj, n)]
+        for n in missing:
+            print(f"{ns} MISSING {n}")
+        summary.append((ns, len(names), len(missing)))
+        total_missing += len(missing)
+    print("\n== summary ==")
+    for ns, tot, miss in summary:
+        print(f"{ns}: {tot - miss}/{tot} present, {miss} missing")
+    print(f"TOTAL missing: {total_missing}")
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,11 @@ NAMESPACES = [
     "paddle_tpu.io", "paddle_tpu.metrics", "paddle_tpu.distributed",
     "paddle_tpu.distributed.fleet", "paddle_tpu.distribution",
     "paddle_tpu.signal", "paddle_tpu.geometric", "paddle_tpu.regularizer",
+    "paddle_tpu.linalg", "paddle_tpu.fft", "paddle_tpu.static.nn",
+    "paddle_tpu.text", "paddle_tpu.hub", "paddle_tpu.onnx",
+    "paddle_tpu.audio.backends", "paddle_tpu.audio.functional",
+    "paddle_tpu.audio.datasets", "paddle_tpu.utils.download",
+    "paddle_tpu.incubate.asp",
     "paddle_tpu.callbacks", "paddle_tpu.jit", "paddle_tpu.ckpt",
     "paddle_tpu.hapi", "paddle_tpu.vision", "paddle_tpu.vision.ops",
     "paddle_tpu.vision.models", "paddle_tpu.vision.transforms",
@@ -69,6 +74,13 @@ def collect():
                 continue
             sig = signature_of(obj) if callable(obj) else ""
             lines.append(f"{ns}.{name}{sig}")
+    # Tensor METHOD surface (core/tensor_methods.py installs it onto
+    # jax.Array): every installed method is public API a ported script
+    # calls as x.<name>(...) — removals must fail the gate like any other
+    from paddle_tpu.core import tensor_methods
+    tensor_methods.install()
+    for name in tensor_methods.installed_names():
+        lines.append(f"paddle_tpu.Tensor.{name}()")
     return sorted(set(lines))
 
 
