@@ -120,6 +120,15 @@ CASES = [
          "llama2-70b", 1, batch=32, seq=4096,
          pipeline_stages=4, num_microbatches=8, loss_seq_chunks=8,
          note="corrected: TP8 x PP4 x sharded-opt(4) + ZeRO-1"),
+    # BASELINE config 3: SDXL UNet (conv/GroupNorm/attn workload class) at
+    # real 1024^2 resolution (latent 128x128x4), dp over a v5e-8.  seq is
+    # the text-context length here (77 CLIP tokens).
+    Case("sdxl-dp8-v5e8", "v5e", "v5e:2x4", {"dp_degree": 8},
+         "sdxl", 1, batch=8, seq=77, use_recompute=False,
+         note="BASELINE config 3: SDXL UNet 1024^2 training, bs1/chip"),
+    Case("sdxl-dp8-b32-v5e8", "v5e", "v5e:2x4", {"dp_degree": 8},
+         "sdxl", 1, batch=32, seq=77, use_recompute=False,
+         note="SDXL UNet 1024^2, bs4/chip"),
 ]
 
 
@@ -154,6 +163,16 @@ def build_case(case: Case):
         with nn.meta_init():
             model = llama(cfg)
         loss_fn = causal_lm_loss
+    elif case.model == "sdxl":
+        from paddle_tpu.models.sdxl_unet import sdxl_unet
+        with nn.meta_init():
+            model = sdxl_unet("sdxl")
+        cfg = model.config
+
+        def loss_fn(mm, b):
+            pred = mm(b["x"], b["t"], b["ctx"], b["added"])
+            return jnp.mean(jnp.square(pred.astype(jnp.float32)
+                                       - b["eps"].astype(jnp.float32)))
     else:
         from paddle_tpu.models.gpt import PRESETS, gpt
         cfg = dataclasses.replace(
@@ -177,8 +196,22 @@ def build_case(case: Case):
     step = TrainStep(model, loss_fn, opt, zero_stage=case.zero_stage)
     astate = step.abstract_state()
     bsh = NamedSharding(step.mesh, step.batch_spec)
-    batch = {"input_ids": jax.ShapeDtypeStruct((case.batch, case.seq),
-                                               jnp.int32, sharding=bsh),
+    if case.model == "sdxl":
+        # 1024^2 images -> VAE latent 128x128x4; 77 CLIP context tokens;
+        # 2816 = pooled text embed (1280) + 6x256 micro-conditioning
+        B = case.batch
+        lat = jax.ShapeDtypeStruct((B, 4, 128, 128), jnp.bfloat16,
+                                   sharding=bsh)
+        batch = {"x": lat,
+                 "t": jax.ShapeDtypeStruct((B,), jnp.int32, sharding=bsh),
+                 "ctx": jax.ShapeDtypeStruct((B, case.seq, 2048),
+                                             jnp.bfloat16, sharding=bsh),
+                 "added": jax.ShapeDtypeStruct((B, 2816), jnp.bfloat16,
+                                               sharding=bsh),
+                 "eps": lat}
+    else:
+        batch = {"input_ids": jax.ShapeDtypeStruct((case.batch, case.seq),
+                                                   jnp.int32, sharding=bsh),
              "labels": jax.ShapeDtypeStruct((case.batch, case.seq),
                                             jnp.int64, sharding=bsh)}
     return step, astate, batch, cfg
