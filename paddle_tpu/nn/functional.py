@@ -209,10 +209,30 @@ def _rotate_half(x):
     return jnp.concatenate([-x2, x1], axis=-1)
 
 
-def _rotate_every_two(x):
+def _rotate_every_two_layout(x):
     # GPT-J / non-NeoX style: pairs are (even, odd) interleaved
     x1, x2 = x[..., 0::2], x[..., 1::2]
     return jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+
+
+def _rotate_every_two_mm(x):
+    """Interleaved rotation as a {0, ±1} matmul — same rationale and
+    precision note as _rotate_half_mm: R[2i+1, 2i] = −1, R[2i, 2i+1] = 1."""
+    d = x.shape[-1]
+    import numpy as _np
+    r = _np.zeros((d, d), _np.float32)
+    idx = _np.arange(0, d, 2)
+    r[idx + 1, idx] = -1.0
+    r[idx, idx + 1] = 1.0
+    return jax.lax.dot_general(
+        x, jnp.asarray(r, x.dtype),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST)
+
+
+def _rotate_every_two(x):
+    return _rotate_every_two_mm(x) if _rope_impl() == "matmul" \
+        else _rotate_every_two_layout(x)
 
 
 def _rotate_half_mm(x):
@@ -240,12 +260,16 @@ def _rotate_half_mm(x):
 _ROPE_IMPL = None  # resolved lazily from PDTPU_ROPE_IMPL (matmul|layout)
 
 
-def _rope_rotate_half(x):
+def _rope_impl():
     global _ROPE_IMPL
     if _ROPE_IMPL is None:
         import os as _os
         _ROPE_IMPL = _os.environ.get("PDTPU_ROPE_IMPL", "matmul")
-    return _rotate_half_mm(x) if _ROPE_IMPL == "matmul" else _rotate_half(x)
+    return _ROPE_IMPL
+
+
+def _rope_rotate_half(x):
+    return _rotate_half_mm(x) if _rope_impl() == "matmul" else _rotate_half(x)
 
 
 def apply_rotary_pos_emb(q, k, cos, sin, interleaved=False):

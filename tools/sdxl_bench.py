@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""On-chip UNet perf point (VERDICT r3 directive #8, BENCH.md §SDXL):
+one training step (fwd+bwd+AdamW, bf16 + f32 master) of the sd15-preset
+UNet (~860M — the largest of the family whose optimizer state fits one
+v5e) at latent 32x32 and 64x64, bs2.  The conv/GroupNorm/cross-attention
+workload class, measured end-to-end like bench.py; the full SDXL preset
+is the multi-chip memory-proof case (docs/MEMPROOF.md).
+
+Usage: python tools/sdxl_bench.py [--steps 10] [--windows 2]
+Prints a markdown row per shape + one JSON line.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def measure(latent, batch, steps, windows, preset="sd15"):
+    """Single-chip: the SDXL-preset UNet's 2.6B-param train state
+    (bf16 + f32 master + AdamW moments ~ 36 GiB) exceeds one v5e's
+    16 GiB by construction — that config is what the multi-chip memproof
+    covers.  The single-chip perf point uses the same workload class
+    (ResBlocks/GroupNorm/cross-attention) at sd15 scale (~860M)."""
+    import gc
+
+    import paddle_tpu as pt
+    from paddle_tpu import amp, nn, optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.sdxl_unet import sdxl_unet
+
+    pt.seed(0)
+    model = sdxl_unet(preset)
+    opt = optimizer.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters())
+    model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+
+    cfg = model.config
+    has_added = cfg.projection_class_embeddings_input_dim > 0
+
+    def loss_fn(mm, b):
+        pred = mm(b["x"], b["t"], b["ctx"],
+                  b["added"] if has_added else None)
+        return jnp.mean(jnp.square(pred.astype(jnp.float32)
+                                   - b["eps"].astype(jnp.float32)))
+
+    step = TrainStep(model, loss_fn, opt)
+    state = step.init_state(seed=0)
+    rng = np.random.RandomState(0)
+    bf = jnp.bfloat16
+    batch_d = {
+        "x": jnp.asarray(rng.randn(batch, 4, latent, latent), bf),
+        "t": jnp.asarray(rng.randint(0, 1000, (batch,)), jnp.int32),
+        "ctx": jnp.asarray(rng.randn(batch, 77, cfg.cross_attention_dim),
+                           bf),
+        "eps": jnp.asarray(rng.randn(batch, 4, latent, latent), bf),
+    }
+    if has_added:
+        batch_d["added"] = jnp.asarray(
+            rng.randn(batch, cfg.projection_class_embeddings_input_dim), bf)
+    state, m = step(state, batch_d)
+    _ = float(m["loss"])
+    dts = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = step(state, batch_d)
+        _ = float(m["loss"])
+        dts.append(time.perf_counter() - t0)
+    ms = min(dts) * 1000 / steps
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    del state, step, model, opt, batch_d
+    gc.collect()
+    return ms, dts, n_params
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--windows", type=int, default=2)
+    args = ap.parse_args()
+    out = {}
+    print("| preset | latent (image) | batch | ms/step | img/s/chip |")
+    print("|---|---|---|---|---|")
+    for preset, latent, batch in (("sd15", 32, 2), ("sd15", 64, 2)):
+        ms, dts, n_params = measure(latent, batch, args.steps,
+                                    args.windows, preset=preset)
+        ips = batch / (ms / 1000)
+        print(f"| {preset} | {latent}x{latent} ({latent*8}^2) | {batch} "
+              f"| {ms:.1f} | {ips:.2f} |", flush=True)
+        out[f"{preset}_l{latent}_b{batch}"] = {
+            "ms_per_step": round(ms, 1),
+            "images_per_sec": round(ips, 2),
+            "window_ms": [round(d * 1000 / args.steps, 1) for d in dts]}
+    out["params"] = n_params
+    print()
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
