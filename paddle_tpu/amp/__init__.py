@@ -174,3 +174,15 @@ class GradScaler:
     def load_state_dict(self, d):
         self._state = dict(d)
 from . import debugging  # noqa: F401
+
+
+def is_bfloat16_supported(device=None) -> bool:
+    """Reference: paddle.amp.is_bfloat16_supported — bf16 is the TPU
+    native compute dtype (MXU) and jax's CPU mesh emulates it."""
+    return True
+
+
+def is_float16_supported(device=None) -> bool:
+    """Reference: paddle.amp.is_float16_supported — fp16 storage/compute
+    works through XLA on TPU (bf16 is preferred; see docs/MIGRATION.md)."""
+    return True

@@ -4,9 +4,9 @@ ModelCheckpoint,EarlyStopping,LRScheduler,VisualDL,...})."""
 
 from .hapi.callbacks import (Callback, CallbackList, EarlyStopping,  # noqa: F401
                              LogWriterCallback, LRScheduler,
-                             ModelCheckpoint, ProgBarLogger, SpeedMonitor,
-                             VisualDL)
+                             ModelCheckpoint, ProgBarLogger,
+                             ReduceLROnPlateau, SpeedMonitor, VisualDL)
 
 __all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
-           "EarlyStopping", "LRScheduler", "SpeedMonitor",
+           "EarlyStopping", "LRScheduler", "ReduceLROnPlateau", "SpeedMonitor",
            "LogWriterCallback", "VisualDL"]

@@ -72,8 +72,13 @@ def init(role_maker=None, is_collective: bool = True,
     """
     global _HYBRID_PARALLEL_GROUP, _PS_RUNTIME
     from ..ps import PaddleCloudRoleMaker, PsRuntime
-    if isinstance(role_maker, PaddleCloudRoleMaker) or (
-            role_maker is None and not is_collective):
+    # PS mode: any role-maker object (PaddleCloudRoleMaker OR
+    # UserDefinedRoleMaker — duck-typed on is_server/is_worker) with
+    # is_collective=False, or env-discovered when none is given
+    is_role_obj = role_maker is not None and \
+        callable(getattr(role_maker, "is_server", None))
+    if (is_role_obj and not getattr(role_maker, "is_collective", False)) \
+            or (role_maker is None and not is_collective):
         role = role_maker or PaddleCloudRoleMaker()
         _PS_RUNTIME = PsRuntime(role, configs=[])
         return _PS_RUNTIME
@@ -167,3 +172,119 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
     # attach the hcg so the optimizer can consult the topology.
     optimizer._hcg = hcg
     return optimizer
+
+
+# ---------------------------------------------------------------------------
+# role/topology introspection (reference: fleet/base/role_maker.py surface
+# re-exported on the fleet object — worker/server counts and endpoints)
+# ---------------------------------------------------------------------------
+
+def _role_env():
+    import os as _os
+    return _os.environ
+
+
+def worker_index() -> int:
+    """Reference: fleet.worker_index — this trainer's rank."""
+    if _PS_RUNTIME is not None:
+        return _PS_RUNTIME.role.trainer_id
+    from ..communication import get_rank
+    return get_rank()
+
+
+def worker_num() -> int:
+    if _PS_RUNTIME is not None:
+        return _PS_RUNTIME.role.trainer_num
+    from ..communication import get_world_size
+    return get_world_size()
+
+
+def is_first_worker() -> bool:
+    return worker_index() == 0
+
+
+def worker_endpoints(to_string: bool = False):
+    eps = [p for p in _role_env().get("PADDLE_TRAINER_ENDPOINTS",
+                                      "").split(",") if p]
+    return ",".join(eps) if to_string else eps
+
+
+def server_num() -> int:
+    return len(server_endpoints())
+
+
+def server_index() -> int:
+    if _PS_RUNTIME is not None:
+        return _PS_RUNTIME.role.server_id
+    return -1
+
+
+def server_endpoints(to_string: bool = False):
+    if _PS_RUNTIME is not None:
+        eps = _PS_RUNTIME.role.server_endpoints
+    else:
+        eps = [p for p in _role_env().get("PADDLE_PSERVERS_IP_PORT_LIST",
+                                          "").split(",") if p]
+    return ",".join(eps) if to_string else eps
+
+
+def barrier_worker():
+    """Reference: fleet.barrier_worker — block until every trainer
+    arrives (maps onto the collective barrier; no-op at world 1)."""
+    from ..communication import barrier, is_initialized
+    if is_initialized() or worker_num() > 1:
+        barrier()
+
+
+class UserDefinedRoleMaker:
+    """Reference: fleet.UserDefinedRoleMaker — explicit role assignment
+    instead of env discovery."""
+
+    def __init__(self, is_collective=False, current_id=0,
+                 role="worker", worker_num=1, server_endpoints=None,
+                 **kw):
+        self.is_collective = is_collective
+        self.trainer_id = int(current_id)
+        self.trainer_num = int(worker_num)
+        self._role = role.lower()
+        self.server_endpoints = list(server_endpoints or [])
+        self.server_id = int(current_id) if self._role == "server" else -1
+
+    def is_server(self) -> bool:
+        return self._role == "server"
+
+    def is_worker(self) -> bool:
+        return self._role == "worker"
+
+
+class UtilBase:
+    """Reference: fleet.UtilBase — cross-worker small-data utilities."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as _np
+
+        import jax.numpy as _jnp
+
+        from ..communication import ReduceOp, all_reduce
+        op = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX,
+              "min": ReduceOp.MIN}.get(str(mode).lower())
+        if op is None:
+            raise ValueError(f"UtilBase.all_reduce: mode {mode!r} not in "
+                             "sum/max/min")
+        out = all_reduce(_jnp.asarray(input), op=op)
+        return _np.asarray(out)
+
+    def barrier(self, comm_world="worker"):
+        barrier_worker()
+
+    def all_gather(self, input, comm_world="worker"):
+        from ..misc import all_gather_object
+        out = []
+        all_gather_object(out, input)
+        return out
+
+
+util = UtilBase()
+
+# reference exports the role makers on the fleet namespace too
+from ..ps import PaddleCloudRoleMaker  # noqa: E402,F401

@@ -64,8 +64,14 @@ def to_static(function=None, input_spec=None, full_graph=True, backend=None,
     graph-break diagnostic / eager-fallback behavior.
     """
     def deco(fn):
+        if getattr(fn, "_pdtpu_not_to_static", False):
+            return fn
         target = fn
-        if convert_control_flow:
+        # SOT conversion is skipped for functions whose defining module
+        # was registered via jit.ignore_module (the transform is local to
+        # the decorated function, so the decoration site is the scope)
+        skip_sot = getattr(target, "__module__", None) in _IGNORED_MODULES
+        if convert_control_flow and not skip_sot:
             from . import sot as _sot
             from ..nn.layer import Layer
             if isinstance(fn, Layer):
@@ -91,7 +97,20 @@ def to_static(function=None, input_spec=None, full_graph=True, backend=None,
                     "to_static input_spec has dynamic dims; XLA requires "
                     "static shapes — compiling lazily per concrete shape "
                     "instead", stacklevel=2)
-        return control_flow.intercept_graph_breaks(fn, jitted, full_graph)
+        compiled = control_flow.intercept_graph_breaks(fn, jitted,
+                                                       full_graph)
+
+        # enable_to_static is a CALL-time switch (reference semantics:
+        # flipping it off routes already-decorated functions to eager)
+        def dispatch(*args, **kwargs):
+            if not _TO_STATIC_ENABLED[0]:
+                return fn(*args, **kwargs)
+            return compiled(*args, **kwargs)
+
+        if callable(fn) and hasattr(fn, "__name__"):
+            functools.update_wrapper(dispatch, fn, updated=[])
+        dispatch._pdtpu_compiled = compiled
+        return dispatch
     return deco(function) if function is not None else deco
 
 
@@ -531,15 +550,20 @@ def _current_lr(optimizer, state):
 # AOT export (paddle.jit.save / load parity for inference graphs)
 # ---------------------------------------------------------------------------
 
-def save(fn, path: str, *example_args):
+def save(fn, path: str, *example_args, input_spec=None):
     """Serialize a jitted function to StableHLO bytes + npz side-car.
 
-    Reference: paddle.jit.save -> *.pdmodel/*.pdiparams.  Here the "model"
-    is a serialized StableHLO program (jax.export) that can be reloaded and
-    executed without the Python model definition.
+    Reference: paddle.jit.save -> *.pdmodel/*.pdiparams, whose signature
+    takes either example tensors or ``input_spec=[InputSpec(...)]``.
+    Here the "model" is a serialized StableHLO program (jax.export) that
+    can be reloaded and executed without the Python model definition.
     """
     from jax import export as jexport
     jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    if input_spec is not None and not example_args:
+        specs = [s if isinstance(s, InputSpec) else InputSpec(*s)
+                 for s in input_spec]
+        example_args = tuple(s.to_shape_struct() for s in specs)
     exp = jexport.export(jitted)(*example_args)
     with open(path + ".stablehlo", "wb") as f:
         f.write(exp.serialize())
@@ -550,4 +574,62 @@ def load(path: str):
     from jax import export as jexport
     with open(path if path.endswith(".stablehlo") else path + ".stablehlo", "rb") as f:
         exp = jexport.deserialize(f.read())
-    return exp.call
+    return TranslatedLayer(exp.call, path)
+
+
+# ---------------------------------------------------------------------------
+# conversion controls (reference: paddle.jit.{enable_to_static,
+# not_to_static, ignore_module} — python/paddle/jit/api.py and
+# sot/opcode_translator skip lists)
+# ---------------------------------------------------------------------------
+
+_TO_STATIC_ENABLED = [True]
+_IGNORED_MODULES: set = set()
+
+
+def enable_to_static(enable: bool = True):
+    """Globally toggle to_static conversion: when off, decorated
+    functions run eagerly (the reference's debugging switch)."""
+    _TO_STATIC_ENABLED[0] = bool(enable)
+
+
+def not_to_static(function=None):
+    """Decorator: mark a function to stay eager inside to_static capture
+    (its body executes at trace time as plain Python)."""
+    def mark(fn):
+        fn._pdtpu_not_to_static = True
+        return fn
+    return mark(function) if function is not None else mark
+
+
+def ignore_module(modules):
+    """Register modules whose functions the SOT transform must leave
+    untouched (reference: sot skip-module list)."""
+    for m in (modules if isinstance(modules, (list, tuple)) else [modules]):
+        _IGNORED_MODULES.add(getattr(m, "__name__", str(m)))
+    return _IGNORED_MODULES
+
+
+class TranslatedLayer:
+    """Reference: paddle.jit.TranslatedLayer — the callable a jit.load
+    returns, Layer-shaped (``__call__``/``eval``/``train`` no-ops for
+    inference artifacts).  Wraps the deserialized StableHLO callable."""
+
+    def __init__(self, fn, path=None):
+        self._fn = fn
+        self._path = path
+        self.training = False
+
+    def __call__(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+    forward = __call__
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def train(self):
+        raise RuntimeError(
+            "TranslatedLayer is an inference artifact (AOT StableHLO); "
+            "training needs the original Layer")

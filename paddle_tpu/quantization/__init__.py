@@ -250,3 +250,50 @@ class MovingAverageAbsmaxObserver(AbsmaxObserver):
 
 
 __all__ += ["FakeQuanterChannelWiseAbsMax", "MovingAverageAbsmaxObserver"]
+
+
+class BaseQuanter(Layer):
+    """Reference: paddle.quantization.BaseQuanter — the abstract fake-
+    quant node contract (FakeQuanterWithAbsMax implements it)."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return None
+
+    def quant_axis(self):
+        return None
+
+    def bit_length(self):
+        return 8
+
+
+class BaseObserver(Layer):
+    """Reference: paddle.quantization.BaseObserver — statistics
+    collectors for PTQ calibration (AbsmaxObserver implements it)."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+
+def quanter(name):
+    """Reference: paddle.quantization.quanter — class decorator that
+    registers a quanter under a config-referencable name."""
+    def wrap(cls):
+        _QUANTER_REGISTRY[name] = cls
+        cls._quanter_name = name
+        return cls
+    return wrap
+
+
+_QUANTER_REGISTRY = {"FakeQuanterWithAbsMax": FakeQuanterWithAbsMax,
+                     "AbsmaxObserver": AbsmaxObserver}
+
+__all__ += ["BaseQuanter", "BaseObserver", "quanter"]

@@ -97,3 +97,160 @@ class Cifar10(Dataset):
         if self.transform is not None:
             img = self.transform(img)
         return img, self.labels[idx]
+
+
+class FashionMNIST(MNIST):
+    """Same idx wire format as MNIST (reference:
+    python/paddle/vision/datasets/mnist.py FashionMNIST subclass)."""
+
+
+class Cifar100(Dataset):
+    """CIFAR-100 python pickle (train/test files, fine labels)."""
+
+    def __init__(self, data_dir: str, mode="train",
+                 transform: Optional[Callable] = None):
+        self.transform = transform
+        fn = "train" if mode == "train" else "test"
+        with open(os.path.join(data_dir, fn), "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        self.images = d[b"data"].reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(d[b"fine_labels"], "int64")
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+def _pil_loader(path):
+    from PIL import Image
+    with open(path, "rb") as f:
+        return np.asarray(Image.open(f).convert("RGB"))
+
+
+class DatasetFolder(Dataset):
+    """Class-per-subfolder sample tree (reference:
+    python/paddle/vision/datasets/folder.py): root/<class>/<file>."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _pil_loader
+        self.transform = transform
+        exts = tuple(e.lower() for e in (extensions or
+                     (".jpg", ".jpeg", ".png", ".bmp", ".gif", ".webp")))
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise FileNotFoundError(
+                f"DatasetFolder: no class subfolders under {root!r}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for base, _, names in sorted(os.walk(cdir)):
+                for n in sorted(names):
+                    p = os.path.join(base, n)
+                    ok = (is_valid_file(p) if is_valid_file
+                          else n.lower().endswith(exts))
+                    if ok:
+                        self.samples.append((p, self.class_to_idx[c]))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+class ImageFolder(DatasetFolder):
+    """Flat image list (labels ignored — reference ImageFolder yields
+    images only); also accepts the class-tree layout."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        exts = tuple(e.lower() for e in (extensions or
+                     (".jpg", ".jpeg", ".png", ".bmp", ".gif", ".webp")))
+        self.root = root
+        self.loader = loader or _pil_loader
+        self.transform = transform
+        self.samples = []
+        for base, _, names in sorted(os.walk(root)):
+            for n in sorted(names):
+                if n.lower().endswith(exts):
+                    self.samples.append(os.path.join(base, n))
+        if not self.samples:
+            raise FileNotFoundError(f"ImageFolder: no images under {root!r}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return (img,)
+
+
+class Flowers(Dataset):
+    """Oxford-102 flowers from a local extracted layout: jpg/ images +
+    imagelabels.mat + setid.mat (reference:
+    python/paddle/vision/datasets/flowers.py; downloads disabled)."""
+
+    _SPLIT_KEY = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+    def __init__(self, data_dir, mode="train", transform=None):
+        from scipy.io import loadmat
+        labels = loadmat(os.path.join(data_dir, "imagelabels.mat"))
+        setid = loadmat(os.path.join(data_dir, "setid.mat"))
+        ids = setid[self._SPLIT_KEY[mode]].reshape(-1)
+        self.files = [os.path.join(data_dir, "jpg",
+                                   f"image_{i:05d}.jpg") for i in ids]
+        self.labels = labels["labels"].reshape(-1)[ids - 1].astype("int64") - 1
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.files)
+
+    def __getitem__(self, idx):
+        img = _pil_loader(self.files[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+class VOC2012(Dataset):
+    """Pascal VOC 2012 segmentation pairs from the extracted VOCdevkit
+    (reference: python/paddle/vision/datasets/voc2012.py)."""
+
+    def __init__(self, data_dir, mode="train", transform=None):
+        base = os.path.join(data_dir, "VOC2012") \
+            if os.path.isdir(os.path.join(data_dir, "VOC2012")) else data_dir
+        split_file = os.path.join(base, "ImageSets", "Segmentation",
+                                  ("train.txt" if mode == "train" else
+                                   "val.txt"))
+        with open(split_file) as f:
+            names = [l.strip() for l in f if l.strip()]
+        self.images = [os.path.join(base, "JPEGImages", n + ".jpg")
+                       for n in names]
+        self.masks = [os.path.join(base, "SegmentationClass", n + ".png")
+                      for n in names]
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        img = _pil_loader(self.images[idx])
+        with open(self.masks[idx], "rb") as f:
+            mask = np.asarray(Image.open(f))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask

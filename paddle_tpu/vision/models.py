@@ -944,3 +944,18 @@ def lenet(num_classes=10):
 
 __all__ += ["MobileNetV3", "mobilenet_v3_large", "mobilenet_v3_small",
             "InceptionV3", "inception_v3", "lenet"]
+
+
+def densenet161(**kw):
+    return DenseNet(161, **kw)
+
+
+def densenet169(**kw):
+    return DenseNet(169, **kw)
+
+
+def densenet201(**kw):
+    return DenseNet(201, **kw)
+
+
+__all__ += ["densenet161", "densenet169", "densenet201"]

@@ -216,6 +216,33 @@ CANDIDATES = {
         IterableDataset RandomSampler Sampler SequenceSampler Subset SubsetRandomSampler TensorDataset
         WeightedRandomSampler get_worker_info random_split
     """,
+    "paddle_tpu.vision.datasets": """
+        MNIST FashionMNIST Cifar10 Cifar100 Flowers VOC2012 DatasetFolder ImageFolder
+    """,
+    "paddle_tpu.vision.models": """
+        ResNet resnet18 resnet34 resnet50 resnet101 resnet152 vgg11 vgg13 vgg16 vgg19
+        mobilenet_v1 mobilenet_v2 mobilenet_v3_small mobilenet_v3_large alexnet
+        densenet121 densenet161 densenet169 densenet201 googlenet inception_v3
+        shufflenet_v2_x1_0 squeezenet1_0 wide_resnet50_2 resnext50_32x4d LeNet
+    """,
+    "paddle_tpu.distributed.fleet": """
+        init is_first_worker worker_index worker_num is_worker worker_endpoints server_num
+        server_index server_endpoints is_server barrier_worker init_worker init_server run_server
+        stop_worker distributed_model distributed_optimizer DistributedStrategy
+        UserDefinedRoleMaker PaddleCloudRoleMaker UtilBase utils
+    """,
+    "paddle_tpu.quantization": """
+        QAT PTQ QuantConfig quanter BaseQuanter BaseObserver
+    """,
+    "paddle_tpu.callbacks": """
+        Callback EarlyStopping LRScheduler ModelCheckpoint ProgBarLogger ReduceLROnPlateau VisualDL
+    """,
+    "paddle_tpu.jit": """
+        to_static save load ignore_module not_to_static enable_to_static TranslatedLayer InputSpec
+    """,
+    "paddle_tpu.amp": """
+        auto_cast decorate GradScaler is_bfloat16_supported is_float16_supported debugging
+    """,
 }
 
 
