@@ -140,6 +140,19 @@ _SPECIALS = {
     "backward": _backward, "set_value": _set_value,
 }
 
+
+def _place(self):
+    """Reference: Tensor.place — the resident device as a Place object."""
+    from ..device import CPUPlace, TPUPlace
+    dev = getattr(self, "device", None)
+    if dev is None or isinstance(self, jax.core.Tracer):
+        return TPUPlace(0) if jax.default_backend() != "cpu" else CPUPlace()
+    if callable(dev):  # older jax: .devices() set
+        dev = next(iter(self.devices()))
+    if getattr(dev, "platform", "cpu") == "cpu":
+        return CPUPlace()
+    return TPUPlace(getattr(dev, "id", 0))
+
 _installed = []
 
 
@@ -184,6 +197,18 @@ def install():
             put(name, _bind(fn, name))
     for name, fn in _SPECIALS.items():
         put(name, fn)
+    # properties (attribute access, not calls) — only recorded as
+    # installed if the class actually accepted the attribute
+    place_ok = False
+    for t in targets:
+        if not hasattr(t, "place"):
+            try:
+                setattr(t, "place", property(_place))
+                place_ok = True
+            except (AttributeError, TypeError):  # pragma: no cover
+                pass
+    if place_ok and "place" not in _installed:
+        _installed.append("place")
     return len(_installed)
 
 

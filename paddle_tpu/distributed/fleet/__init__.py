@@ -238,7 +238,8 @@ def barrier_worker():
 
 class UserDefinedRoleMaker:
     """Reference: fleet.UserDefinedRoleMaker — explicit role assignment
-    instead of env discovery."""
+    instead of env discovery.  Implements the full role interface
+    PsRuntime consumes (same protocol as ps.PaddleCloudRoleMaker)."""
 
     def __init__(self, is_collective=False, current_id=0,
                  role="worker", worker_num=1, server_endpoints=None,
@@ -255,6 +256,15 @@ class UserDefinedRoleMaker:
 
     def is_worker(self) -> bool:
         return self._role == "worker"
+
+    def worker_index(self) -> int:
+        return self.trainer_id
+
+    def worker_num(self) -> int:  # noqa: F811 — mirrors the role protocol
+        return self.trainer_num
+
+    def server_num(self) -> int:
+        return len(self.server_endpoints)
 
 
 class UtilBase:

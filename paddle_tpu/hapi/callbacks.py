@@ -351,33 +351,28 @@ class VisualDL(LogWriterCallback):
 class ReduceLROnPlateau(Callback):
     """Reference: paddle.callbacks.ReduceLROnPlateau — scale the LR by
     ``factor`` after ``patience`` epochs without improvement in the
-    monitored metric.  Works with Model.prepare'd optimizers exposing
-    ``get_lr``/``set_lr`` (ours do, like the reference's)."""
+    monitored metric.
+
+    The plateau state machine is optimizer.lr.ReduceOnPlateau (ONE
+    implementation of best/bad-count/cooldown semantics); this callback
+    only monitors the metric, drives ``scheduler.step(metric)``, and
+    copies the resulting LR onto the Model's optimizer via
+    ``get_lr``/``set_lr``."""
 
     def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
                  mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
         super().__init__()
         self.monitor = monitor
-        self.factor = float(factor)
-        self.patience = int(patience)
         self.verbose = verbose
-        self.min_delta = abs(min_delta)
-        self.cooldown = int(cooldown)
-        self.min_lr = float(min_lr)
         if mode not in ("auto", "min", "max"):
             mode = "auto"
-        self.mode = "max" if (mode == "auto" and "acc" in monitor) else \
+        mode = "max" if (mode == "auto" and "acc" in monitor) else \
             ("min" if mode == "auto" else mode)
-        self.best = None
-        self.wait = 0
-        self.cooldown_counter = 0
-
-    def _better(self, cur, best):
-        if best is None:
-            return True
-        d = cur - best
-        return d > self.min_delta if self.mode == "max" \
-            else -d > self.min_delta
+        self._sched_kw = dict(mode=mode, factor=float(factor),
+                              patience=int(patience),
+                              threshold=abs(min_delta),
+                              cooldown=int(cooldown), min_lr=float(min_lr))
+        self._sched = None
 
     def on_epoch_end(self, epoch, logs=None):
         logs = logs or {}
@@ -385,24 +380,19 @@ class ReduceLROnPlateau(Callback):
         if cur is None:
             return
         cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
-        if self.cooldown_counter > 0:
-            self.cooldown_counter -= 1
-            self.wait = 0
-        if self._better(cur, self.best):
-            self.best = cur
-            self.wait = 0
+        opt = getattr(self.model, "_optimizer", None) if self.model else None
+        if opt is None or not hasattr(opt, "get_lr"):
             return
-        self.wait += 1
-        if self.wait >= self.patience and self.cooldown_counter == 0:
-            opt = getattr(self.model, "_optimizer", None) if self.model \
-                else None
-            if opt is not None and hasattr(opt, "get_lr"):
-                old = float(opt.get_lr())
-                new = max(old * self.factor, self.min_lr)
-                if new < old:
-                    opt.set_lr(new)
-                    if self.verbose:
-                        print(f"ReduceLROnPlateau: epoch {epoch}: "
-                              f"lr {old:.2e} -> {new:.2e}")
-            self.cooldown_counter = self.cooldown
-            self.wait = 0
+        if self._sched is None:
+            from ..optimizer.lr import ReduceOnPlateau
+            self._sched = ReduceOnPlateau(float(opt.get_lr()),
+                                          **self._sched_kw)
+        old = float(opt.get_lr())
+        self._sched.current = old  # track external LR changes
+        self._sched.step(cur)
+        new = float(self._sched.current)
+        if new < old:
+            opt.set_lr(new)
+            if self.verbose:
+                print(f"ReduceLROnPlateau: epoch {epoch}: "
+                      f"lr {old:.2e} -> {new:.2e}")

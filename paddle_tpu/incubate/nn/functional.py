@@ -327,3 +327,58 @@ def variable_length_memory_efficient_attention(q, k, v, seq_lens=None,
         mask = jnp.where(km[:, None, None, :], 0.0, -jnp.inf)
     return F.scaled_dot_product_attention(q, k, v, attn_mask=mask,
                                           is_causal=causal)
+
+
+def fused_moe(x, gate_weight, ffn1_weights, ffn2_weights, ffn1_biases=None,
+              ffn2_biases=None, moe_topk=2, norm_topk_prob=True,
+              act="silu_glu"):
+    """Reference: paddle.incubate.nn.functional.fused_moe — one fused op
+    for topk gating + per-expert FFN + weighted combine.
+
+    TPU formulation: every token runs EVERY expert densely
+    (``einsum('nh,ehi->nei')`` — weights stay (E, H, *), activations are
+    the N×E×I transient) and the top-k probabilities zero out the
+    non-selected experts in the combine.  Gathering per-token weight
+    copies (``w[topi]``) would materialize N×K full weight matrices —
+    terabytes at Mixtral scale.  The dense form trades E/K× extra FLOPs
+    for static shapes and no routing; for large-scale training use
+    MoELayer's capacity-based dispatch (distributed/moe.py), which is
+    the ep-sharded production path.
+
+    Shapes: x (..., H); gate_weight (H, E); ffn1_weights (E, H, 2I) for
+    the silu-glu act (gate|up packed) or (E, H, I); ffn2_weights
+    (E, I, H).  Returns (..., H).
+    """
+    import jax
+
+    orig = x.shape
+    H = orig[-1]
+    t = x.reshape(-1, H)                                    # (N, H)
+    logits = t.astype(jnp.float32) @ jnp.asarray(gate_weight,
+                                                 jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)                 # (N, E)
+    E = probs.shape[-1]
+    topv, topi = jax.lax.top_k(probs, moe_topk)             # (N, K)
+    if norm_topk_prob:
+        topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    # (N, E) combine weights: top-k probs scattered back, zeros elsewhere
+    combine = jnp.sum(jax.nn.one_hot(topi, E, dtype=topv.dtype)
+                      * topv[..., None], axis=1)            # (N, E)
+
+    w1 = jnp.asarray(ffn1_weights)
+    w2 = jnp.asarray(ffn2_weights)
+    h1 = jnp.einsum("nh,ehi->nei", t, w1.astype(t.dtype))
+    if ffn1_biases is not None:
+        h1 = h1 + jnp.asarray(ffn1_biases)[None].astype(h1.dtype)
+    if act == "silu_glu":
+        gate_part, up = jnp.split(h1, 2, axis=-1)
+        h1 = jax.nn.silu(gate_part) * up
+    elif act == "gelu":
+        h1 = jax.nn.gelu(h1)
+    else:
+        h1 = jax.nn.silu(h1)
+    h2 = jnp.einsum("nei,eih->neh", h1, w2.astype(h1.dtype))
+    if ffn2_biases is not None:
+        h2 = h2 + jnp.asarray(ffn2_biases)[None].astype(h2.dtype)
+    out = jnp.einsum("neh,ne->nh", h2, combine.astype(h2.dtype))
+    return out.reshape(orig).astype(x.dtype)

@@ -69,8 +69,13 @@ def to_static(function=None, input_spec=None, full_graph=True, backend=None,
         target = fn
         # SOT conversion is skipped for functions whose defining module
         # was registered via jit.ignore_module (the transform is local to
-        # the decorated function, so the decoration site is the scope)
-        skip_sot = getattr(target, "__module__", None) in _IGNORED_MODULES
+        # the decorated function, so the decoration site is the scope).
+        # With enable_to_static(False) active at DECORATION time, the
+        # transform and the eager AOT compile below are also skipped —
+        # debugging mode must not mutate layer.forward or trigger XLA
+        # (re-enabling later jits the unconverted function).
+        skip_sot = (getattr(target, "__module__", None) in _IGNORED_MODULES
+                    or not _TO_STATIC_ENABLED[0])
         if convert_control_flow and not skip_sot:
             from . import sot as _sot
             from ..nn.layer import Layer
@@ -86,7 +91,7 @@ def to_static(function=None, input_spec=None, full_graph=True, backend=None,
                          static_argnums=static_argnums)
         if not isinstance(fn, type) and callable(fn) and hasattr(fn, "__name__"):
             functools.update_wrapper(jitted, fn, updated=[])
-        if input_spec:
+        if input_spec and _TO_STATIC_ENABLED[0]:
             specs = [s if isinstance(s, InputSpec) else InputSpec(*s)
                      for s in input_spec]
             if all(s.is_static() for s in specs):

@@ -186,8 +186,11 @@ class ImageFolder(DatasetFolder):
         self.samples = []
         for base, _, names in sorted(os.walk(root)):
             for n in sorted(names):
-                if n.lower().endswith(exts):
-                    self.samples.append(os.path.join(base, n))
+                p = os.path.join(base, n)
+                ok = (is_valid_file(p) if is_valid_file
+                      else n.lower().endswith(exts))
+                if ok:
+                    self.samples.append(p)
         if not self.samples:
             raise FileNotFoundError(f"ImageFolder: no images under {root!r}")
 

@@ -160,7 +160,9 @@ class TestReduceLROnPlateau:
         cb.set_model(m)
         cb.on_epoch_end(0, {"loss": 1.0})
         cb.on_epoch_end(1, {"loss": 0.5})   # improved
-        cb.on_epoch_end(2, {"loss": 0.5})   # plateau -> reduce
+        cb.on_epoch_end(2, {"loss": 0.5})   # bad 1 (<= patience): hold
+        assert abs(m._optimizer.lr - 0.1) < 1e-9
+        cb.on_epoch_end(3, {"loss": 0.5})   # bad 2 (> patience): reduce
         assert abs(m._optimizer.lr - 0.05) < 1e-9
 
     def test_min_lr_floor(self):
@@ -183,8 +185,8 @@ class TestReduceLROnPlateau:
         m = FakeModel()
         cb.set_model(m)
         cb.on_epoch_end(0, {"loss": 1.0})
-        cb.on_epoch_end(1, {"loss": 1.0})
-        assert m._optimizer.lr == 1e-5
+        cb.on_epoch_end(1, {"loss": 1.0})   # bad 1 > patience 0: reduce,
+        assert m._optimizer.lr == 1e-5      # floored at min_lr
 
 
 class TestJitControls:
@@ -313,3 +315,56 @@ class TestReviewFixesTail5:
                                    [2.0])
         with pytest.raises(ValueError, match="sum/max/min"):
             u.all_reduce(np.asarray([1.0]), mode="mean")
+
+
+class TestFusedMoeAndPlace:
+    def test_fused_moe_matches_manual(self):
+        from paddle_tpu.incubate.nn import functional as IF
+        rng = np.random.RandomState(0)
+        H, I, E = 8, 16, 4
+        x = jnp.asarray(rng.randn(2, 3, H).astype(np.float32))
+        gw = jnp.asarray(rng.randn(H, E).astype(np.float32))
+        w1 = jnp.asarray(rng.randn(E, H, 2 * I).astype(np.float32) / 4)
+        w2 = jnp.asarray(rng.randn(E, I, H).astype(np.float32) / 4)
+        out = IF.fused_moe(x, gw, w1, w2, moe_topk=2)
+        assert out.shape == x.shape
+        t = np.asarray(x).reshape(-1, H)
+        logits = t @ np.asarray(gw)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        want = np.zeros_like(t)
+        for n in range(t.shape[0]):
+            idx = np.argsort(-p[n])[:2]
+            wsum = p[n][idx].sum()
+            for e in idx:
+                h1 = t[n] @ np.asarray(w1)[e]
+                g, u = h1[:I], h1[I:]
+                act = (g / (1 + np.exp(-g))) * u
+                want[n] += (p[n][e] / wsum) * (act @ np.asarray(w2)[e])
+        np.testing.assert_allclose(np.asarray(out).reshape(-1, H), want,
+                                   atol=2e-5)
+
+    def test_fused_moe_jits(self):
+        import jax as _jax
+
+        from paddle_tpu.incubate.nn import functional as IF
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(4, 8).astype(np.float32))
+        gw = jnp.asarray(rng.randn(8, 2).astype(np.float32))
+        w1 = jnp.asarray(rng.randn(2, 8, 8).astype(np.float32))
+        w2 = jnp.asarray(rng.randn(2, 4, 8).astype(np.float32))
+        f = _jax.jit(lambda a: IF.fused_moe(a, gw, w1, w2, moe_topk=1))
+        assert f(x).shape == x.shape
+
+    def test_tensor_place_property(self):
+        import jax as _jax
+        x = P.to_tensor([1.0])
+        from paddle_tpu.device import CPUPlace, TPUPlace
+        assert isinstance(x.place, (CPUPlace, TPUPlace))
+
+        @_jax.jit
+        def f(v):
+            assert v.place is not None  # tracer path
+            return v
+
+        f(x)
