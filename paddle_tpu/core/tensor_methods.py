@@ -142,14 +142,27 @@ _SPECIALS = {
 
 
 def _place(self):
-    """Reference: Tensor.place — the resident device as a Place object."""
+    """Reference: Tensor.place — the resident device as a Place object.
+
+    Sharded arrays: ``.device`` is a Sharding (not a Device), so resolve
+    through ``.devices()`` — the platform of the first device in the
+    sharding (all devices of one array share a platform)."""
     from ..device import CPUPlace, TPUPlace
-    dev = getattr(self, "device", None)
-    if dev is None or isinstance(self, jax.core.Tracer):
+    if isinstance(self, jax.core.Tracer):
         return TPUPlace(0) if jax.default_backend() != "cpu" else CPUPlace()
-    if callable(dev):  # older jax: .devices() set
-        dev = next(iter(self.devices()))
-    if getattr(dev, "platform", "cpu") == "cpu":
+    dev = None
+    devs = getattr(self, "devices", None)
+    if callable(devs):
+        try:
+            dev = next(iter(devs()))
+        except Exception:
+            dev = None
+    if dev is None:
+        dev = getattr(self, "device", None)
+    platform = getattr(dev, "platform", None)
+    if platform is None:  # unknown handle: fall back to the backend
+        return TPUPlace(0) if jax.default_backend() != "cpu" else CPUPlace()
+    if platform == "cpu":
         return CPUPlace()
     return TPUPlace(getattr(dev, "id", 0))
 

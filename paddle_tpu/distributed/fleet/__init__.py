@@ -264,7 +264,9 @@ class UserDefinedRoleMaker:
         return self.trainer_num
 
     def server_num(self) -> int:
-        return len(self.server_endpoints)
+        # `or 1` floor matches PaddleCloudRoleMaker: an endpoint-less
+        # config still describes a 1-server world (PsClient needs >= 1)
+        return len(self.server_endpoints) or 1
 
 
 class UtilBase:

@@ -357,7 +357,12 @@ class ReduceLROnPlateau(Callback):
     implementation of best/bad-count/cooldown semantics); this callback
     only monitors the metric, drives ``scheduler.step(metric)``, and
     copies the resulting LR onto the Model's optimizer via
-    ``get_lr``/``set_lr``."""
+    ``get_lr``/``set_lr``.  The scheduler fires when bad epochs EXCEED
+    its patience, while the callback contract is "reduce once
+    ``patience`` epochs fail to improve" — so the scheduler is built
+    with ``patience - 1`` to keep callback semantics.  (Known minor
+    divergence: the scheduler ticks cooldown only on non-improving
+    epochs.)"""
 
     def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
                  mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
@@ -369,7 +374,7 @@ class ReduceLROnPlateau(Callback):
         mode = "max" if (mode == "auto" and "acc" in monitor) else \
             ("min" if mode == "auto" else mode)
         self._sched_kw = dict(mode=mode, factor=float(factor),
-                              patience=int(patience),
+                              patience=int(patience) - 1,
                               threshold=abs(min_delta),
                               cooldown=int(cooldown), min_lr=float(min_lr))
         self._sched = None

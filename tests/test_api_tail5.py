@@ -160,9 +160,7 @@ class TestReduceLROnPlateau:
         cb.set_model(m)
         cb.on_epoch_end(0, {"loss": 1.0})
         cb.on_epoch_end(1, {"loss": 0.5})   # improved
-        cb.on_epoch_end(2, {"loss": 0.5})   # bad 1 (<= patience): hold
-        assert abs(m._optimizer.lr - 0.1) < 1e-9
-        cb.on_epoch_end(3, {"loss": 0.5})   # bad 2 (> patience): reduce
+        cb.on_epoch_end(2, {"loss": 0.5})   # patience=1 bad epoch: reduce
         assert abs(m._optimizer.lr - 0.05) < 1e-9
 
     def test_min_lr_floor(self):
@@ -185,8 +183,8 @@ class TestReduceLROnPlateau:
         m = FakeModel()
         cb.set_model(m)
         cb.on_epoch_end(0, {"loss": 1.0})
-        cb.on_epoch_end(1, {"loss": 1.0})   # bad 1 > patience 0: reduce,
-        assert m._optimizer.lr == 1e-5      # floored at min_lr
+        cb.on_epoch_end(1, {"loss": 1.0})   # patience=0: first bad epoch
+        assert m._optimizer.lr == 1e-5      # reduces, floored at min_lr
 
 
 class TestJitControls:

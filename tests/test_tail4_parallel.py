@@ -1,0 +1,135 @@
+"""Round-4 features under the virtual 8-device mesh: Tensor methods
+inside shard_map, new losses/ops under dp sharding, fused_moe under jit
+with sharded batch, sequence ops in a dp data pipeline.
+
+Pattern follows tests/test_*parallel*.py: parallel-vs-serial numerics.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp",))
+
+
+class TestTensorMethodsSharded:
+    def test_methods_inside_shard_map(self):
+        from jax.experimental.shard_map import shard_map
+        mesh = _mesh()
+
+        def block(x):
+            return x.abs().add(x.sign()).multiply(x.sigmoid())
+
+        x = jnp.asarray(np.random.RandomState(0).randn(16, 4)
+                        .astype(np.float32))
+        f = shard_map(block, mesh=mesh, in_specs=P("dp"),
+                      out_specs=P("dp"))
+        np.testing.assert_allclose(np.asarray(f(x)), np.asarray(block(x)),
+                                   rtol=1e-6)
+
+    def test_methods_on_sharded_global_array(self):
+        mesh = _mesh()
+        x = jax.device_put(jnp.arange(32.0).reshape(8, 4),
+                           NamedSharding(mesh, P("dp")))
+        out = jax.jit(lambda v: v.square().cumsum(0))(x)
+        np.testing.assert_allclose(
+            np.asarray(out), np.cumsum(np.arange(32.0).reshape(8, 4) ** 2,
+                                       axis=0))
+
+
+class TestLossesUnderDp:
+    def test_margin_ce_dp_sharded_matches_serial(self):
+        mesh = _mesh()
+        rs = np.random.RandomState(1)
+        cos = np.clip(rs.randn(16, 10), -0.99, 0.99).astype(np.float32)
+        lab = rs.randint(0, 10, (16,))
+        serial = float(F.margin_cross_entropy(jnp.asarray(cos),
+                                              jnp.asarray(lab), scale=4.0))
+        csh = jax.device_put(jnp.asarray(cos), NamedSharding(mesh, P("dp")))
+        lsh = jax.device_put(jnp.asarray(lab), NamedSharding(mesh, P("dp")))
+        par = float(jax.jit(lambda c, l: F.margin_cross_entropy(
+            c, l, scale=4.0))(csh, lsh))
+        assert abs(serial - par) < 1e-5
+
+    def test_hsigmoid_dp_sharded(self):
+        mesh = _mesh()
+        rs = np.random.RandomState(2)
+        x = rs.randn(16, 8).astype(np.float32)
+        lab = rs.randint(0, 10, (16,))
+        w = rs.randn(9, 8).astype(np.float32)
+        serial = np.asarray(F.hsigmoid_loss(jnp.asarray(x),
+                                            jnp.asarray(lab), 10,
+                                            jnp.asarray(w)))
+        xs = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("dp")))
+        ls = jax.device_put(jnp.asarray(lab), NamedSharding(mesh, P("dp")))
+        par = np.asarray(jax.jit(lambda a, b: F.hsigmoid_loss(
+            a, b, 10, jnp.asarray(w)))(xs, ls))
+        np.testing.assert_allclose(par, serial, rtol=1e-5)
+
+    def test_sparse_attention_under_jit_dp(self):
+        mesh = _mesh()
+        rs = np.random.RandomState(3)
+        B, H, M, D = 8, 2, 4, 8
+        q = rs.randn(B, H, M, D).astype(np.float32)
+        k = rs.randn(B, H, M, D).astype(np.float32)
+        v = rs.randn(B, H, M, D).astype(np.float32)
+        off = np.tile(np.arange(0, 17, 4, dtype=np.int32), (B, H, 1))
+        cols = np.tile(np.tile(np.arange(4, dtype=np.int32), 4), (B, H, 1))
+        serial = np.asarray(F.sparse_attention(q, k, v, off, cols))
+        sh = lambda a: jax.device_put(jnp.asarray(a),
+                                      NamedSharding(mesh, P("dp")))
+        par = np.asarray(jax.jit(F.sparse_attention)(
+            sh(q), sh(k), sh(v), sh(off), sh(cols)))
+        np.testing.assert_allclose(par, serial, atol=1e-5)
+
+
+class TestFusedMoeUnderMesh:
+    def test_dp_sharded_batch_matches_serial(self):
+        from paddle_tpu.incubate.nn import functional as IF
+        mesh = _mesh()
+        rs = np.random.RandomState(4)
+        H, I, E = 8, 16, 4
+        x = rs.randn(16, H).astype(np.float32)
+        gw = rs.randn(H, E).astype(np.float32)
+        w1 = (rs.randn(E, H, 2 * I) / 4).astype(np.float32)
+        w2 = (rs.randn(E, I, H) / 4).astype(np.float32)
+        serial = np.asarray(IF.fused_moe(jnp.asarray(x), gw, w1, w2))
+        xs = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("dp")))
+        par = np.asarray(jax.jit(lambda a: IF.fused_moe(a, gw, w1, w2))(xs))
+        np.testing.assert_allclose(par, serial, atol=1e-5)
+
+
+class TestSequenceOpsInPipeline:
+    def test_sequence_pool_softmax_under_jit_dp(self):
+        import paddle_tpu.static as S
+        mesh = _mesh()
+        rs = np.random.RandomState(5)
+        x = rs.randn(8, 6, 4).astype(np.float32)
+        ln = np.array([3, 6, 2, 4, 5, 1, 6, 3], np.int32)
+        serial_pool = np.asarray(S.nn.sequence_pool(jnp.asarray(x),
+                                                    "average",
+                                                    jnp.asarray(ln)))
+        xs = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("dp")))
+        ls = jax.device_put(jnp.asarray(ln), NamedSharding(mesh, P("dp")))
+        par = np.asarray(jax.jit(
+            lambda a, l: S.nn.sequence_pool(a, "average", l))(xs, ls))
+        np.testing.assert_allclose(par, serial_pool, rtol=1e-5)
+        sm = np.asarray(jax.jit(
+            lambda a, l: S.nn.sequence_softmax(a, l))(xs, ls))
+        np.testing.assert_allclose(sm[0, :3].sum(0), 1.0, atol=1e-5)
+        assert np.abs(sm[0, 3:]).max() == 0.0
+
+    def test_gather_tree_under_jit(self):
+        ids = jnp.asarray(np.random.RandomState(6)
+                          .randint(0, 9, (5, 8, 3)).astype(np.int32))
+        parents = jnp.asarray(np.random.RandomState(7)
+                              .randint(0, 3, (5, 8, 3)).astype(np.int32))
+        serial = np.asarray(F.gather_tree(ids, parents))
+        par = np.asarray(jax.jit(F.gather_tree)(ids, parents))
+        np.testing.assert_array_equal(par, serial)
