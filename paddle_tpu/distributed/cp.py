@@ -97,12 +97,12 @@ def _ring_step(carry, k_t, v_t, qg, q_pos, k_pos0, *, causal, scale, chunk):
     return m_new, l, acc
 
 
-def _ring_inner(q, k, v, *, axis, n, causal, scale):
+def _ring_inner(q, k, v, rank_arr, *, axis, n, causal, scale):
     b, c, h, d = q.shape
     hkv = k.shape[2]
     g = h // hkv
     qg = q.reshape(b, c, hkv, g, d)
-    rank = jax.lax.axis_index(axis)
+    rank = rank_arr[0]
     q_pos = rank * c + jnp.arange(c)
 
     m = jnp.full((b, hkv, g, c), NEG_INF, jnp.float32)
@@ -133,7 +133,7 @@ def _ring_inner(q, k, v, *, axis, n, causal, scale):
     return out.reshape(b, c, h, d).astype(q.dtype)
 
 
-def _ring_inner_flash(q, k, v, *, axis, n, causal, scale):
+def _ring_inner_flash(q, k, v, rank_arr, *, axis, n, causal, scale):
     """Ring step with the Pallas flash kernel per visiting chunk.
 
     Each chunk pair is one of three STATIC cases — fully visible
@@ -144,7 +144,7 @@ def _ring_inner_flash(q, k, v, *, axis, n, causal, scale):
     carries the lse cotangent, so autodiff through the merge is exact)."""
     from ..ops.pallas import flash_attention as fa
     b, c, h, d = q.shape
-    rank = jax.lax.axis_index(axis)
+    rank = rank_arr[0]
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def full_chunk(kv):
@@ -227,13 +227,53 @@ def ring_attention(q, k, v, causal=False, scale=None, axis="sep", mesh=None,
                      and _fa.supported(q_chunk, kv_chunk, kv_chunk,
                                        causal=False))
     inner = _ring_inner_flash if use_flash else _ring_inner
-    spec = P(None, axis, None, None)
+    # With the FLASH inner, the shard_map must be manual over EVERY mesh
+    # axis the operands are sharded on — a pallas_call inside a
+    # partial-manual region would need auto-partitioning over the
+    # remaining axes (batch over dp/sharding, heads over mp), which
+    # Mosaic kernels cannot do.  The einsum inner auto-partitions fine
+    # and keeps the minimal {sep} manual set.
+    manual = {axis}
+    bspec = hspec = None
+    if use_flash:
+        names = set(mesh.axis_names)
+        batch_axes = tuple(a for a in ("dp", "sharding")
+                           if a in names and mesh.shape[a] > 1)
+        bdeg = math.prod(mesh.shape[a] for a in batch_axes) \
+            if batch_axes else 1
+        mp_ax = "mp" if "mp" in names and mesh.shape["mp"] > 1 else None
+        mdeg = mesh.shape[mp_ax] if mp_ax else 1
+        if not batch_axes or q.shape[0] % bdeg == 0:
+            bspec = batch_axes or None
+        else:
+            # batch not divisible: fall back to the einsum inner rather
+            # than risk a Mosaic auto-partition error
+            inner, use_flash = _ring_inner, False
+        if use_flash and mp_ax:
+            if q.shape[2] % mdeg or k.shape[2] % mdeg:
+                inner, use_flash = _ring_inner, False
+                bspec = None
+            else:
+                hspec = mp_ax
+        if use_flash:
+            # ALL mesh axes go manual (size-1 axes included): any axis
+            # left in auto mode keeps the SPMD partitioner responsible
+            # for the pallas_call inside, which Mosaic rejects
+            manual |= set(mesh.axis_names)
+    spec = P(bspec, axis, hspec, None)
+    # the ring rank rides in as DATA (arange sharded over the sep axis:
+    # each shard sees its own index) instead of lax.axis_index — the
+    # axis_index form lowers to a PartitionId op that the TPU SPMD
+    # partitioner rejects when the shard_map covers only some mesh axes
+    rank_ids = jax.lax.with_sharding_constraint(
+        jnp.arange(n, dtype=jnp.int32),
+        jax.sharding.NamedSharding(mesh, P(axis)))
     fn = shard_map(
         functools.partial(inner, axis=axis, n=n, causal=causal,
                           scale=float(scale)),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        axis_names=frozenset({axis}), check_vma=False)
-    return fn(q, k, v)
+        mesh=mesh, in_specs=(spec, spec, spec, P(axis)), out_specs=spec,
+        axis_names=frozenset(manual), check_vma=False)
+    return fn(q, k, v, rank_ids)
 
 
 # ---------------------------------------------------------------------------

@@ -78,7 +78,14 @@ def _shard_bytes(leaf) -> int:
 
 
 def gate_memproof_lite() -> int:
+    # deviceless gate: never initialize the TPU plugin — a concurrent
+    # TPU-holding process makes plugin init fail on the libtpu lockfile
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
 
     import memproof
 

@@ -50,6 +50,7 @@ class Case:
     num_microbatches: int = 1
     loss_seq_chunks: int = 1   # llama: rematerialized seq-chunked vocab CE
     offload: bool = False      # ZeRO optimizer states in pinned host memory
+    context_parallel: str = None  # None | "ring" | "ulysses" (sep axis)
     note: str = ""
 
 
@@ -120,6 +121,22 @@ CASES = [
          "llama2-70b", 1, batch=32, seq=4096,
          pipeline_stages=4, num_microbatches=8, loss_seq_chunks=8,
          note="corrected: TP8 x PP4 x sharded-opt(4) + ZeRO-1"),
+    # long-context first-class claim (SURVEY §5.7): 7B at 32k sequence via
+    # RING attention over sep=8, ZeRO-3 over the other axis of a v5e-64 —
+    # the configuration class ring attention exists for, compiler-verified
+    Case("7b-sep8-sh8-seq32k-v5e64", "v5e", "v5e:8x8",
+         {"sharding_degree": 8, "sep_degree": 8},
+         "llama2-7b", 3, batch=8, seq=32768, loss_seq_chunks=16,
+         context_parallel="ring",
+         note="long-context attempt on v5e-64: does NOT fit (ZeRO-3(8) "
+              "argument bytes alone are 11 GiB/chip) — kept as the "
+              "honest negative; the v5p row is the working recipe"),
+    Case("7b-sep8-sh16-seq32k-v5p128", "v5p", "v5p:4x4x8",
+         {"sharding_degree": 16, "sep_degree": 8},
+         "llama2-7b", 3, batch=16, seq=32768, loss_seq_chunks=16,
+         context_parallel="ring",
+         note="long-context recipe: ring attention sep8 x ZeRO-3(16), "
+              "seq 32k on a v5p-128"),
     # BASELINE config 3: SDXL UNet (conv/GroupNorm/attn workload class) at
     # real 1024^2 resolution (latent 128x128x4), dp over a v5e-8.  seq is
     # the text-context length here (77 CLIP tokens).
@@ -158,6 +175,7 @@ def build_case(case: Case):
             num_microbatches=(case.num_microbatches
                               if case.pipeline_stages > 1 else None),
             loss_seq_chunks=case.loss_seq_chunks,
+            context_parallel=case.context_parallel,
             max_position_embeddings=max(case.seq,
                                         PRESETS[case.model].max_position_embeddings))
         with nn.meta_init():
