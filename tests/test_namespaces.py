@@ -130,7 +130,10 @@ class TestQuantization:
         import jax
         import jax.numpy as jnp
         from paddle_tpu.quantization import FakeQuanterWithAbsMax
-        x = np.random.randn(32).astype("float32")
+        # seeded: the unseeded global stream made this order-dependent —
+        # ~1% of draws put a SECOND element on a rounding/clip tie where
+        # the STE subgradient is 0.5 (only the argmax was excluded below)
+        x = np.random.RandomState(0).randn(32).astype("float32")
         fq = FakeQuanterWithAbsMax(bits=8)
         out = np.asarray(fq(jnp.asarray(x)))
         assert np.abs(out - x).max() < np.abs(x).max() / 100  # 8-bit error
