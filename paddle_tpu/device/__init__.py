@@ -126,3 +126,13 @@ _default_stream = Stream()
 def current_stream(device=None) -> Stream:
     del device
     return _default_stream
+
+
+def __getattr__(name):
+    if name == "cuda":  # paddle.device.cuda — the accelerator stats API
+        import importlib
+        mod = importlib.import_module(".cuda", __name__)
+        globals()["cuda"] = mod
+        return mod
+    raise AttributeError(f"module 'paddle_tpu.device' has no attribute "
+                         f"{name!r}")

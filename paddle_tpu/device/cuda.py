@@ -1,0 +1,125 @@
+"""paddle_tpu.device.cuda — accelerator device API at the reference's
+CUDA path (reference: python/paddle/device/cuda/__init__.py).
+
+"cuda" here means THE accelerator: every query maps onto the TPU chip's
+PJRT runtime stats (``Device.memory_stats()``), so ported OOM-debugging
+code (``max_memory_allocated`` prints and friends) reports real HBM
+numbers.  Streams/events re-export the device module's TPU-semantic
+implementations (XLA owns scheduling; see device/__init__.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import re
+
+import jax
+
+from . import Event, Stream, current_stream, synchronize  # noqa: F401
+
+
+def _accel_devices():
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    return devs or jax.devices()
+
+
+def _dev(device=None):
+    devs = _accel_devices()
+    if device is None:
+        return devs[0]
+    idx = getattr(device, "idx", device)
+    if isinstance(idx, str):
+        # reference accepts 'gpu:0' / 'gpu' / 'tpu:1' string forms
+        tail = idx.rsplit(":", 1)[-1]
+        idx = int(tail) if tail.isdigit() else 0
+    return devs[int(idx) % len(devs)]
+
+
+def device_count() -> int:
+    return len(_accel_devices())
+
+
+def get_device_name(device=None) -> str:
+    return getattr(_dev(device), "device_kind", "cpu")
+
+
+def get_device_capability(device=None):
+    """Reference returns (major, minor) CUDA capability; the TPU analogue
+    is (generation, core-count-on-chip)."""
+    d = _dev(device)
+    kind = getattr(d, "device_kind", "")
+    m = re.search(r"\d+", kind)  # FIRST number: 'TPU v5 lite' -> 5
+    return (int(m.group()) if m else 0, getattr(d, "num_cores", 1) or 1)
+
+
+class _DeviceProperties:
+    def __init__(self, name, total_memory, major, minor,
+                 multi_processor_count):
+        self.name = name
+        self.total_memory = total_memory
+        self.major, self.minor = major, minor
+        self.multi_processor_count = multi_processor_count
+
+    def __repr__(self):
+        return (f"_gpuDeviceProperties(name='{self.name}', "
+                f"major={self.major}, minor={self.minor}, "
+                f"total_memory={self.total_memory // (1024 ** 2)}MB, "
+                f"multi_processor_count={self.multi_processor_count})")
+
+
+def get_device_properties(device=None):
+    d = _dev(device)
+    major, minor = get_device_capability(device)
+    return _DeviceProperties(getattr(d, "device_kind", "cpu"),
+                             _stats(d).get("bytes_limit", 0), major, minor,
+                             getattr(d, "num_cores", 1) or 1)
+
+
+def _stats(d) -> dict:
+    try:
+        return d.memory_stats() or {}
+    except Exception:  # backend without stats (CPU test mesh)
+        return {}
+
+
+def memory_allocated(device=None) -> int:
+    """Reference: paddle.device.cuda.memory_allocated — live bytes."""
+    return int(_stats(_dev(device)).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    s = _stats(_dev(device))
+    return int(s.get("peak_bytes_in_use", s.get("bytes_in_use", 0)))
+
+
+def memory_reserved(device=None) -> int:
+    """Reference: allocator-pool bytes; PJRT reports the HBM limit as the
+    reservation (the TPU runtime owns all of HBM)."""
+    s = _stats(_dev(device))
+    return int(s.get("bytes_reserved", s.get("bytes_limit", 0)))
+
+
+def max_memory_reserved(device=None) -> int:
+    s = _stats(_dev(device))
+    return int(s.get("peak_bytes_reserved", s.get("bytes_limit", 0)))
+
+
+def empty_cache():
+    """Reference: release cached allocator blocks.  XLA's allocator keeps
+    HBM for the process; freeing Python references is what actually
+    releases buffers — this triggers a GC pass for parity."""
+    import gc
+    gc.collect()
+
+
+def stream_guard(stream):
+    """Reference: paddle.device.cuda.stream_guard — XLA schedules its own
+    streams, so the guard is a no-op context (kept for ported code)."""
+    return contextlib.nullcontext(stream)
+
+
+__all__ = ["Stream", "Event", "current_stream", "synchronize",
+           "device_count", "get_device_name", "get_device_capability",
+           "get_device_properties", "memory_allocated",
+           "max_memory_allocated", "memory_reserved",
+           "max_memory_reserved", "empty_cache", "stream_guard"]

@@ -366,3 +366,26 @@ class TestFusedMoeAndPlace:
             return v
 
         f(x)
+
+
+class TestDeviceCuda:
+    def test_stats_api_surface(self):
+        import paddle_tpu.device.cuda as C
+        assert C.device_count() >= 1
+        assert isinstance(C.get_device_name(), str)
+        # stats are >= 0 (0 on backends whose PJRT reports none)
+        assert C.memory_allocated() >= 0
+        assert C.max_memory_allocated() >= C.memory_allocated() or \
+            C.max_memory_allocated() == 0
+        assert C.memory_reserved() >= 0
+        props = C.get_device_properties()
+        assert hasattr(props, "total_memory") and hasattr(props, "name")
+        cap = C.get_device_capability()
+        assert isinstance(cap, tuple) and len(cap) == 2
+        C.empty_cache()
+        with C.stream_guard(C.current_stream()):
+            pass
+
+    def test_lazy_module_attr(self):
+        import paddle_tpu.device as D
+        assert D.cuda.device_count() >= 1

@@ -28,6 +28,7 @@ NAMESPACES = [
     "paddle_tpu.linalg", "paddle_tpu.fft", "paddle_tpu.static.nn",
     "paddle_tpu.text", "paddle_tpu.hub", "paddle_tpu.onnx",
     "paddle_tpu.audio.backends", "paddle_tpu.audio.functional",
+    "paddle_tpu.device.cuda",
     "paddle_tpu.audio.datasets", "paddle_tpu.utils.download",
     "paddle_tpu.incubate.asp",
     "paddle_tpu.callbacks", "paddle_tpu.jit", "paddle_tpu.ckpt",
