@@ -122,7 +122,14 @@ def main():
                     help="~10x fewer iterations + 2 reps: noisier, meant "
                          "for the standing CI gate (tools/ci.py) where the "
                          "tolerance is loose anyway")
+    ap.add_argument("--platform", default=None,
+                    help="pin the jax backend (the CI gate passes 'cpu': "
+                         "fast-mode timings through the tunneled TPU are "
+                         "RTT-dominated and do not match the recorded TPU "
+                         "baselines, which come from full runs)")
     args = ap.parse_args()
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
     if args.fast:
         global ITER_SCALE, REPS
         ITER_SCALE, REPS = 0.1, 2
