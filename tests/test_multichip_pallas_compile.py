@@ -76,3 +76,49 @@ def test_flash_ring_compiles_for_multichip_tpu(monkeypatch):
         assert ma.temp_size_in_bytes > 0
     finally:
         fleet._reset()
+
+
+def test_flash_ring_with_mp_head_sharding(monkeypatch):
+    """The hspec path: heads sharded over mp WHILE the flash ring runs —
+    exercises the manual-over-all axis set with a >1 mp axis."""
+    from jax.experimental import topologies
+    from jax.sharding import NamedSharding
+
+    from paddle_tpu import amp, nn, optimizer
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.llama import LlamaConfig, causal_lm_loss, llama
+
+    monkeypatch.setenv("PDTPU_RING_FLASH_MIN_CHUNK", "64")
+    from paddle_tpu.ops import dispatch
+    monkeypatch.setattr(dispatch, "_backend", lambda: "tpu")
+
+    td = topologies.get_topology_desc(platform="tpu",
+                                      topology_name="v5e:2x2")
+    fleet._reset()
+    try:
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = {"mp_degree": 2, "sep_degree": 2}
+        fleet.init(is_collective=True, strategy=s, devices=list(td.devices))
+        cfg = LlamaConfig(hidden_size=128, intermediate_size=256,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=4, vocab_size=256,
+                          max_position_embeddings=512, dtype="bfloat16",
+                          context_parallel="ring")
+        with nn.meta_init():
+            model = llama(cfg)
+        opt = optimizer.AdamW(learning_rate=1e-4,
+                              parameters=model.parameters())
+        model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+        step = TrainStep(model, causal_lm_loss, opt)
+        astate = step.abstract_state()
+        bsh = NamedSharding(step.mesh, step.batch_spec)
+        batch = {"input_ids": jax.ShapeDtypeStruct((2, 512), jnp.int32,
+                                                   sharding=bsh),
+                 "labels": jax.ShapeDtypeStruct((2, 512), jnp.int64,
+                                                sharding=bsh)}
+        compiled = step.lower(astate, batch).compile()
+        assert "tpu_custom_call" in compiled.as_text(), \
+            "flash ring with mp head sharding did not engage"
+    finally:
+        fleet._reset()
