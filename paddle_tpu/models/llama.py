@@ -46,6 +46,7 @@ class LlamaConfig:
     initializer_range: float = 0.02
     use_recompute: bool = False
     recompute_policy: Optional[str] = None  # full recompute; "dots" saves s×s attn probs = OOM at long seq
+    recompute_num_layers: Optional[int] = None  # Megatron-style partial remat: only the first N layers
     sequence_parallel: bool = False
     context_parallel: Optional[str] = None  # None | "ring" | "ulysses" (sep axis)
     pipeline_stages: int = 1        # >1: stacked pp-sharded decoder body
@@ -253,7 +254,17 @@ class LlamaModel(Layer):
         cls = type(self).decoder_layer_cls
         self.embed_tokens = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
         from ..nn.layers_common import LayerList
+        if cfg.recompute_num_layers is not None and not (
+                0 < cfg.recompute_num_layers <= cfg.num_hidden_layers):
+            raise ValueError(
+                f"recompute_num_layers={cfg.recompute_num_layers} must be in "
+                f"[1, num_hidden_layers={cfg.num_hidden_layers}]")
         if cfg.pipeline_stages > 1:
+            if cfg.recompute_num_layers is not None:
+                raise NotImplementedError(
+                    "recompute_num_layers applies per stacked layer; the "
+                    "pp-scanned body remats uniformly — drop "
+                    "recompute_num_layers under pipeline_stages > 1")
             # pipeline-parallel body: per-layer params stacked and sharded
             # over the pp mesh axis (distributed/pipeline.py)
             from ..distributed.pipeline import StackedPipelineStages
@@ -268,9 +279,13 @@ class LlamaModel(Layer):
                 has_aux=getattr(cls, "returns_aux", False))
         else:
             layers = []
-            for _ in range(cfg.num_hidden_layers):
+            for i in range(cfg.num_hidden_layers):
                 layer = cls(cfg)
-                if cfg.use_recompute:
+                # partial remat (Megatron's --recompute-num-layers): the
+                # non-rematted tail keeps its activations, trading leftover
+                # HBM for recompute FLOPs layer by layer
+                if cfg.use_recompute and (cfg.recompute_num_layers is None
+                                          or i < cfg.recompute_num_layers):
                     layer = RecomputeWrapper(layer, policy=cfg.recompute_policy)
                 layers.append(layer)
             self.layers = LayerList(layers)

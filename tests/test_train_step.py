@@ -272,3 +272,31 @@ def test_is_initialized_truthful():
         assert dist.is_initialized()
     finally:
         fleet._reset()
+
+
+def test_partial_remat_num_layers():
+    """recompute_num_layers (Megatron --recompute-num-layers parity): only
+    the first N decoder layers run under remat; forward/backward results
+    are identical either way (remat changes memory, not math)."""
+    from paddle_tpu.distributed.recompute import RecomputeWrapper
+    from paddle_tpu.models.llama import causal_lm_loss, llama
+
+    def run(**kw):
+        pt.seed(0)
+        model = llama("tiny", num_hidden_layers=4, **kw)
+        wrapped = sum(isinstance(l, RecomputeWrapper) for l in model.model.layers)
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+        step = TrainStep(model, causal_lm_loss, opt)
+        state = step.init_state(seed=0)
+        ids = jax.random.randint(jax.random.key(0), (2, 16), 0, 256)
+        batch = {"input_ids": ids, "labels": jnp.roll(ids, -1, axis=1)}
+        _, m = step(state, batch)
+        return wrapped, float(m["loss"])
+
+    n_full, l_full = run(use_recompute=True)
+    n_part, l_part = run(use_recompute=True, recompute_num_layers=2)
+    n_off, l_off = run(use_recompute=False)
+    assert (n_full, n_part, n_off) == (4, 2, 0)
+    np.testing.assert_allclose(l_full, l_part, rtol=1e-5)
+    np.testing.assert_allclose(l_full, l_off, rtol=1e-5)
