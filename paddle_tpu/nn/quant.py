@@ -37,6 +37,17 @@ def _check_algo(algo: str) -> None:
         raise ValueError(f"unsupported algo {algo!r}; one of {list(_QMAX)}")
 
 
+def _use_int4_kernel() -> bool:
+    """The fused int4 kernel is a TPU Mosaic kernel; CPU tests keep the
+    XLA reference formulation (numerically identical — the kernel's own
+    tests assert exactness in interpret mode)."""
+    import os
+
+    if os.environ.get("PDTPU_INT4_KERNEL", "1") == "0":
+        return False
+    return jax.default_backend() == "tpu"
+
+
 def _pack_int4(q):
     """(in, out) int4-valued int8 -> (in//2, out) int8, two nibbles per
     byte: row 2i in the low nibble, row 2i+1 in the high nibble.  Packing
@@ -118,6 +129,23 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
     x = jnp.asarray(x)
     if weight_scale is None:
         raise ValueError("weight_scale is required (from weight_quantize)")
+    n_tokens = 1
+    for d in x.shape[:-1]:
+        n_tokens *= d
+    if (algo == "weight_only_int4" and weight_scale.ndim == 1
+            and n_tokens <= 256 and _use_int4_kernel()):
+        # decode/serving shapes only: prefill's big-M matmuls amortise the
+        # weight stream (XLA path) and would blow the kernel's VMEM x-tiles
+        # fused dequant-in-matmul Pallas kernel: nibbles unpacked in VMEM,
+        # HBM streams the PACKED bytes.  The XLA formulation below
+        # materialises the unpacked weight to HBM every call — measured
+        # ~8x slower at 7B-shaped GEMVs (docs/BENCH.md round 5)
+        from ..ops.pallas.int4_matmul import int4_matmul
+        lead = x.shape[:-1]
+        y = int4_matmul(x.reshape(-1, x.shape[-1]), jnp.asarray(weight),
+                        weight_scale)
+        y = y.reshape(*lead, y.shape[-1])
+        return y if bias is None else y + bias
     if weight_scale.ndim == 2:  # groupwise: dequant fuses into the dot
         w = weight_dequantize(weight, weight_scale, algo=algo,
                               group_size=group_size, out_dtype=x.dtype)

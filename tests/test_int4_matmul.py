@@ -1,0 +1,91 @@
+"""Fused int4 dequant-in-matmul kernel (ops/pallas/int4_matmul).
+
+Reference capability: the Cutlass fpA_intB int4 GEMM (SURVEY §2.1).
+The kernel must be EXACT vs the XLA unpack formulation — both compute
+x @ dequant(W) in f32 accumulation over identical nibble values.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn.quant import (weight_dequantize, weight_only_linear,
+                                 weight_quantize)
+from paddle_tpu.ops.pallas.int4_matmul import MAX_1D_K2, int4_matmul
+
+
+@pytest.mark.parametrize("m,k,n", [(1, 256, 512), (8, 512, 384),
+                                   (4, 128, 128), (3, 256, 256)])
+def test_kernel_exact_vs_dequant_1d(m, k, n):
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((k, n)).astype(np.float32) * 0.05
+    x = jnp.asarray(rng.standard_normal((m, k)), jnp.float32)
+    q, s = weight_quantize(w, algo="weight_only_int4")
+    ref = x @ weight_dequantize(q, s, algo="weight_only_int4")
+    got = int4_matmul(x, q, s, block_n=128, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_exact_2d_grid_path():
+    # contraction tall enough to take the 2-D accumulator path
+    k = 2 * MAX_1D_K2 + 512
+    rng = np.random.default_rng(1)
+    w = rng.standard_normal((k, 256)).astype(np.float32) * 0.02
+    x = jnp.asarray(rng.standard_normal((2, k)), jnp.float32)
+    q, s = weight_quantize(w, algo="weight_only_int4")
+    ref = x @ weight_dequantize(q, s, algo="weight_only_int4")
+    got = int4_matmul(x, q, s, block_k2=512, block_n=128, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_shape_validation():
+    q = jnp.zeros((8, 128), jnp.int8)
+    with pytest.raises(ValueError, match="K"):
+        int4_matmul(jnp.zeros((1, 100)), q, jnp.ones((128,)), interpret=True)
+    with pytest.raises(ValueError, match="scale"):
+        int4_matmul(jnp.zeros((1, 16)), q, jnp.ones((4,)), interpret=True)
+
+
+def test_weight_only_linear_kernel_dispatch(monkeypatch):
+    """The kernel-dispatch branch of weight_only_linear (lead-dim
+    reshape, bias add, per-channel gating) — forced on with the kernel in
+    interpret mode so it runs on the CPU suite."""
+    import functools
+
+    from paddle_tpu.nn import quant as QN
+    from paddle_tpu.ops.pallas import int4_matmul as kernel_mod
+
+    monkeypatch.setattr(QN, "_use_int4_kernel", lambda: True)
+    monkeypatch.setattr(
+        kernel_mod, "int4_matmul",
+        functools.partial(int4_matmul, block_n=128, interpret=True))
+
+    rng = np.random.default_rng(2)
+    w = rng.standard_normal((64, 128)).astype(np.float32)
+    bias = rng.standard_normal((128,)).astype(np.float32)
+    x3d = jnp.asarray(rng.standard_normal((2, 3, 64)), jnp.float32)
+    q, s = weight_quantize(w, algo="weight_only_int4")
+    got = weight_only_linear(x3d, q, bias=bias, weight_scale=s,
+                             weight_dtype="int4")
+    ref = x3d @ weight_dequantize(q, s, algo="weight_only_int4") + bias
+    assert got.shape == (2, 3, 128)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    # groupwise scales must NOT take the kernel (scale.ndim == 2)
+    qg, sg = weight_quantize(w, algo="weight_only_int4", group_size=32)
+    got_g = weight_only_linear(x3d, qg, weight_scale=sg, weight_dtype="int4",
+                               group_size=32)
+    assert got_g.shape == (2, 3, 128)
+
+    # prefill-sized token counts must NOT take the kernel (n_tokens > 256)
+    xbig = jnp.asarray(rng.standard_normal((300, 64)), jnp.float32)
+    got_big = weight_only_linear(xbig, q, weight_scale=s,
+                                 weight_dtype="int4")
+    ref_big = xbig @ weight_dequantize(q, s, algo="weight_only_int4")
+    np.testing.assert_allclose(np.asarray(got_big), np.asarray(ref_big),
+                               rtol=2e-5, atol=2e-5)

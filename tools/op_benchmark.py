@@ -54,6 +54,7 @@ def suite():
     from paddle_tpu.incubate.nn import functional as IF
     from paddle_tpu.nn import functional as F
     from paddle_tpu.nn import quant as QN
+    from paddle_tpu.ops.pallas.int4_matmul import int4_matmul as _int4_kernel
 
     key = jax.random.key(0)
     x = jax.random.normal(key, (4096, 1024), jnp.bfloat16)
@@ -99,6 +100,13 @@ def suite():
             lambda a, qw, s: QN.weight_only_linear(
                 a, qw, weight_scale=s, weight_dtype="int4")),
             (x, *_wq4)),
+        # the fused dequant-in-matmul kernel at a decode (GEMV) shape —
+        # interpret mode on CPU is far off the Mosaic cost, so few iters
+        "int4_gemm_kernel": (
+            (lambda a, qw, s: _int4_kernel(
+                a, qw, s, interpret=jax.default_backend() != "tpu")),
+            (x[:8], *_wq4),
+            {"iters": 100 if jax.default_backend() == "tpu" else 2}),
         "rms_norm": (jax.jit(lambda a: a * jax.lax.rsqrt(
             jnp.mean(a.astype(jnp.float32) ** 2, -1, keepdims=True) + 1e-6
         ).astype(a.dtype)), (x,)),
