@@ -76,12 +76,20 @@ def _unpack(b):
     return lo.astype(jnp.bfloat16), hi.astype(jnp.bfloat16)
 
 
+def _precision(dtype):
+    # f32 activations must NOT be truncated to bf16 by the MXU default —
+    # the XLA path this kernel replaces keeps full f32 (nibble values are
+    # exact in bf16, so only the activation side needs HIGHEST)
+    return (jax.lax.Precision.HIGHEST if dtype == jnp.float32 else None)
+
+
 def _kernel_1d(xe_ref, xo_ref, w_ref, s_ref, o_ref, *, out_dtype):
     lo, hi = _unpack(w_ref[...])
     cdt = xe_ref.dtype
-    acc = (jax.lax.dot(xe_ref[...], lo.astype(cdt),
+    prec = _precision(cdt)
+    acc = (jax.lax.dot(xe_ref[...], lo.astype(cdt), precision=prec,
                        preferred_element_type=jnp.float32)
-           + jax.lax.dot(xo_ref[...], hi.astype(cdt),
+           + jax.lax.dot(xo_ref[...], hi.astype(cdt), precision=prec,
                          preferred_element_type=jnp.float32))
     o_ref[...] = (acc * s_ref[...].astype(jnp.float32)).astype(out_dtype)
 
@@ -96,10 +104,11 @@ def _kernel_2d(xe_ref, xo_ref, w_ref, s_ref, o_ref, acc_scr, *, k_blocks,
 
     lo, hi = _unpack(w_ref[...])
     cdt = xe_ref.dtype
+    prec = _precision(cdt)
     acc_scr[...] += (
-        jax.lax.dot(xe_ref[...], lo.astype(cdt),
+        jax.lax.dot(xe_ref[...], lo.astype(cdt), precision=prec,
                     preferred_element_type=jnp.float32)
-        + jax.lax.dot(xo_ref[...], hi.astype(cdt),
+        + jax.lax.dot(xo_ref[...], hi.astype(cdt), precision=prec,
                       preferred_element_type=jnp.float32))
 
     @pl.when(kb == k_blocks - 1)

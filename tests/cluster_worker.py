@@ -20,9 +20,11 @@ Env protocol (PDTPU_TEST_*):
             fresh (non-resumed) run, so the relaunch survives
   STEP_SLEEP  seconds to sleep after each step (gives an external killer a
             window to land mid-training; default 0)
-  TOPO      "dp" (default) or "zero": (dp, sharding=2) mesh with ZeRO-2
+  TOPO      "dp" (default), "zero": (dp, sharding=2) mesh with ZeRO-2
             partitioned optimizer state — a shrink/grow across THIS
-            topology forces reshard-on-load of partitioned moments
+            topology forces reshard-on-load of partitioned moments;
+            "zero_scale": sharding=devices//2, so growing the world SPLITS
+            each moment shard across more devices (not just remaps it)
   DIM       feature width (default 16; "zero" runs need >= 64 so the
             weights clear the ZERO_MIN_SIZE sharding floor)
 """
@@ -67,11 +69,15 @@ def main():
                           nn.Linear(HIDDEN, DIM))
     opt = AdamW(learning_rate=1e-2, parameters=model.parameters())
     loss_fn = lambda m, b: ((m(b["x"]) - b["y"]) ** 2).mean()  # noqa: E731
-    if topo == "zero":
-        # (dp, sharding=2) hybrid: optimizer moments ZeRO-partitioned over
+    if topo in ("zero", "zero_scale"):
+        # (dp, sharding) hybrid: optimizer moments ZeRO-partitioned over
         # the sharding axis — world changes across THIS mesh exercise
-        # reshard-on-load of partitioned state, not just dp data resharding
-        devs = np.array(jax.devices()).reshape(-1, 2)
+        # reshard-on-load of partitioned state, not just dp data resharding.
+        # "zero": sharding=2 fixed (the shrink e2e).  "zero_scale":
+        # sharding=devices//2, so a 1->2 grow SPLITS each previously-held
+        # moment shard across twice as many devices (VERDICT r4 #5b).
+        shard_deg = 2 if topo == "zero" else max(2, jax.device_count() // 2)
+        devs = np.array(jax.devices()).reshape(-1, shard_deg)
         mesh = Mesh(devs, ("dp", "sharding"))
         step = TrainStep(model, loss_fn, opt, mesh=mesh, zero_stage=2)
         batch_sharding = NamedSharding(mesh, P(("dp", "sharding")))

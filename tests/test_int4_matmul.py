@@ -82,10 +82,19 @@ def test_weight_only_linear_kernel_dispatch(monkeypatch):
                                group_size=32)
     assert got_g.shape == (2, 3, 128)
 
-    # prefill-sized token counts must NOT take the kernel (n_tokens > 256)
+    # prefill-sized token counts must NOT take the kernel (n_tokens > 256):
+    # swap in a tripwire so mis-routing FAILS rather than coincidentally
+    # matching numerics
+    def _boom(*a, **k):
+        raise AssertionError("prefill-sized call routed to the int4 kernel")
+    monkeypatch.setattr(kernel_mod, "int4_matmul", _boom)
     xbig = jnp.asarray(rng.standard_normal((300, 64)), jnp.float32)
     got_big = weight_only_linear(xbig, q, weight_scale=s,
                                  weight_dtype="int4")
     ref_big = xbig @ weight_dequantize(q, s, algo="weight_only_int4")
     np.testing.assert_allclose(np.asarray(got_big), np.asarray(ref_big),
                                rtol=2e-5, atol=2e-5)
+    # ...and the groupwise guard with the tripwire still armed
+    got_g2 = weight_only_linear(x3d, qg, weight_scale=sg,
+                                weight_dtype="int4", group_size=32)
+    np.testing.assert_allclose(np.asarray(got_g2), np.asarray(got_g))

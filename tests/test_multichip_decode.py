@@ -1,0 +1,69 @@
+"""TP-sharded (mp) KV-cache decode — multichip serving (VERDICT r4 #3).
+
+Reference capability: fused_multi_transformer serving under model
+parallelism (SURVEY §2.1 masked_multihead_attention serving mode): vocab/
+head-parallel projections, KV caches sharded over the mp axis, greedy
+tokens identical to the single-device rollout.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.distributed import fleet
+from paddle_tpu.models.llama import llama
+from paddle_tpu.nn.layer import raw_params
+
+
+@pytest.fixture(autouse=True)
+def reset_fleet():
+    yield
+    fleet._reset()
+
+
+def _serial_reference(ids, new, eos=None):
+    pt.seed(0)
+    m = llama("tiny", max_position_embeddings=64).eval()
+    sd = {k: np.asarray(v) for k, v in m.state_dict().items()}
+    out = np.asarray(m.generate(ids, max_new_tokens=new, eos_token_id=eos))
+    return sd, out
+
+
+@pytest.mark.parametrize("eos", [None, 7])
+def test_mp_sharded_greedy_decode_matches_serial(eos):
+    ids = jax.random.randint(jax.random.key(1), (4, 12), 0, 256)
+    sd, ref = _serial_reference(ids, 10, eos)
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"mp_degree": 2, "dp_degree": 2}
+    hcg = fleet.init(is_collective=True, strategy=strategy)
+    m = llama("tiny", max_position_embeddings=64).eval()
+    m.set_state_dict(sd)
+    with hcg.mesh:
+        got = np.asarray(m.generate(ids, max_new_tokens=10,
+                                    eos_token_id=eos))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_mp_sharded_decode_cache_layout_sharded():
+    """The KV caches inside the sharded decode really are head-sharded
+    over mp (not replicated): check the prefilled cache's sharding."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"mp_degree": 2, "dp_degree": 2}
+    hcg = fleet.init(is_collective=True, strategy=strategy)
+    pt.seed(0)
+    m = llama("tiny", max_position_embeddings=64).eval()
+    ids = jax.random.randint(jax.random.key(1), (4, 12), 0, 256)
+    from paddle_tpu.nn.layer import serving_params
+    with hcg.mesh:
+        params = serving_params(m)
+        prefill = m._prefill_fn()
+        caches = m.model.init_cache(4, 32)
+        _, caches = prefill(params, ids, caches)
+        k0 = jax.tree.leaves(caches)[0]
+        # (b, s, h_kv, d): the head axis must be split over mp
+        spec_parts = getattr(k0.sharding, "spec", None)
+        assert k0.sharding.is_fully_replicated is False, \
+            f"cache replicated: {k0.sharding}"

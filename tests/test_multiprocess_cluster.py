@@ -217,66 +217,99 @@ class TestElasticShrinkResumeSharded:
 class TestElasticGrowResume:
     """Scale-UP: node B joins a healthy world-1 job mid-run."""
 
-    STEPS = 12
-    JOIN_DELAY = 22      # seconds before node B even starts booting
-    ELASTIC_TIMEOUT = 3  # gen-0 settle window = this + 15s < JOIN_DELAY
-
     def test_node_join_grows_world_resume_from_ckpt(self, tmp_path,
                                                     monkeypatch):
-        out = str(tmp_path / "grow.jsonl")
-        ckpt_dir = str(tmp_path / "ckpt")
-        master = f"127.0.0.1:{free_port()}"
-
-        monkeypatch.setenv("PDTPU_REPO", REPO)
-        monkeypatch.setenv("PDTPU_TEST_DEVICES", "4")
-        monkeypatch.setenv("PDTPU_TEST_STEPS", str(self.STEPS))
-        monkeypatch.setenv("PDTPU_TEST_OUT", out)
-        monkeypatch.setenv("PDTPU_TEST_CKPT_DIR", ckpt_dir)
-        # stretch training so node A is still mid-run when B's join lands:
-        # A settles alone at ~18s, then 12 steps x 2.5s = 30s of training
-        monkeypatch.setenv("PDTPU_TEST_STEP_SLEEP", "2.5")
-        monkeypatch.delenv("PDTPU_TEST_KILL_RANK", raising=False)
-        monkeypatch.delenv("PDTPU_TEST_KILL_STEP", raising=False)
-
-        common = ["--nnodes", "1:2", "--master", master,
-                  "--nproc_per_node", "1", "--elastic_level", "1",
-                  "--elastic_timeout", str(self.ELASTIC_TIMEOUT),
-                  "--max_restarts", "2", "--job_id", "mpc3"]
-        env_b = {**os.environ, "PYTHONPATH": REPO}
-        cmd_b = " ".join(
-            [sys.executable, "-m", "paddle_tpu.launch", "--rank", "1",
-             "--log_dir", str(tmp_path / "log_b")] + common + [WORKER])
-        node_b = subprocess.Popen(
-            ["/bin/sh", "-c", f"sleep {self.JOIN_DELAY} && exec {cmd_b}"],
-            env=env_b, cwd=REPO, start_new_session=True,
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-
-        # node A: boots alone (gen-0 elastic settle admits a 1-node
-        # quorum), trains, then grows when B's join request arrives
-        ctx = parse_args(["--rank", "0",
-                          "--log_dir", str(tmp_path / "log_a")]
-                         + common + [WORKER])
-        try:
-            rc = CollectiveController(ctx).run()
-        finally:
-            try:
-                os.killpg(node_b.pid, signal.SIGKILL)
-            except ProcessLookupError:
-                pass
-            node_b.wait(timeout=30)
-
-        assert rc == 0
-        records = _read_records(out)
-        final = records[-1]
+        final, steps_total = _run_grow_e2e(tmp_path, monkeypatch,
+                                           job_id="mpc3", out_name="grow")
         # the job finished at the GROWN world, resumed from a checkpoint
         # taken while running alone
         assert final["world"] == 2 and final["devices"] == 8
         assert final["resumed_from"] is not None
-        assert 1 <= final["start"] <= self.STEPS - 1
+        assert 1 <= final["start"] <= steps_total - 1
 
-        single = _run_single_reference(tmp_path, self.STEPS)
+        single = _run_single_reference(tmp_path, steps_total)
         steps = sorted(int(s) for s in final["losses"])
-        assert steps[-1] == self.STEPS - 1
+        assert steps[-1] == steps_total - 1
+        a = [final["losses"][str(i)] for i in steps]
+        b = [single["losses"][str(i)] for i in steps]
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+def _run_grow_e2e(tmp_path, monkeypatch, job_id, out_name, steps=12,
+                  join_delay=22, elastic_timeout=3, extra_env=None):
+    """Shared elastic scale-UP choreography: node A boots alone (gen-0
+    settle admits a 1-node quorum), trains with per-step checkpoints, and
+    node B's delayed join grows the world mid-run.  join_delay must exceed
+    A's settle window (elastic_timeout + 15s) plus a couple of steps; the
+    2.5 s/step sleep stretches training so the join lands mid-run."""
+    out = str(tmp_path / f"{out_name}.jsonl")
+    ckpt_dir = str(tmp_path / "ckpt")
+    master = f"127.0.0.1:{free_port()}"
+
+    monkeypatch.setenv("PDTPU_REPO", REPO)
+    monkeypatch.setenv("PDTPU_TEST_DEVICES", "4")
+    monkeypatch.setenv("PDTPU_TEST_STEPS", str(steps))
+    monkeypatch.setenv("PDTPU_TEST_OUT", out)
+    monkeypatch.setenv("PDTPU_TEST_CKPT_DIR", ckpt_dir)
+    monkeypatch.setenv("PDTPU_TEST_STEP_SLEEP", "2.5")
+    monkeypatch.delenv("PDTPU_TEST_KILL_RANK", raising=False)
+    monkeypatch.delenv("PDTPU_TEST_KILL_STEP", raising=False)
+    for k, v in (extra_env or {}).items():
+        monkeypatch.setenv(k, v)
+
+    common = ["--nnodes", "1:2", "--master", master,
+              "--nproc_per_node", "1", "--elastic_level", "1",
+              "--elastic_timeout", str(elastic_timeout),
+              "--max_restarts", "2", "--job_id", job_id]
+    env_b = {**os.environ, "PYTHONPATH": REPO}
+    cmd_b = " ".join(
+        [sys.executable, "-m", "paddle_tpu.launch", "--rank", "1",
+         "--log_dir", str(tmp_path / "log_b")] + common + [WORKER])
+    node_b = subprocess.Popen(
+        ["/bin/sh", "-c", f"sleep {join_delay} && exec {cmd_b}"],
+        env=env_b, cwd=REPO, start_new_session=True,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    ctx = parse_args(["--rank", "0",
+                      "--log_dir", str(tmp_path / "log_a")]
+                     + common + [WORKER])
+    try:
+        rc = CollectiveController(ctx).run()
+    finally:
+        try:
+            os.killpg(node_b.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        node_b.wait(timeout=30)
+
+    assert rc == 0
+    return _read_records(out)[-1], steps
+
+
+class TestElasticGrowResumeSharded:
+    """Scale-UP into a SHARDED topology (VERDICT r4 #5b): node B joins a
+    healthy world-1 ZeRO-2 job; the relaunch lands on sharding=4 (was 2),
+    so every previously-held partitioned moment must SPLIT across twice
+    as many devices on reshard-on-load — the direction a recovering
+    preemptible fleet executes."""
+
+    def test_node_join_grow_splits_sharded_state(self, tmp_path,
+                                                 monkeypatch):
+        final, steps_total = _run_grow_e2e(
+            tmp_path, monkeypatch, job_id="mpc5", out_name="grow_sharded",
+            extra_env={"PDTPU_TEST_TOPO": "zero_scale",
+                       "PDTPU_TEST_DIM": "64"})
+        # finished at the grown world: 8 devices, sharding=4 (split from 2)
+        assert final["world"] == 2 and final["devices"] == 8
+        assert final["resumed_from"] is not None
+        assert 1 <= final["start"] <= steps_total - 1
+
+        # reference inherits TOPO=zero_scale (8 devices -> (2,4) mesh),
+        # matching the sharded-shrink test's pattern: ZeRO partitioning
+        # must not change numerics at any world size
+        single = _run_single_reference(tmp_path, steps_total)
+        steps = sorted(int(s) for s in final["losses"])
+        assert steps[-1] == steps_total - 1
         a = [final["losses"][str(i)] for i in steps]
         b = [single["losses"][str(i)] for i in steps]
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)

@@ -295,3 +295,39 @@ class TestRematMemoryBound:
             f"remat temp {remat/1e6:.1f} MB vs no-remat "
             f"{no_remat/1e6:.1f} MB — recompute no longer bounds the "
             "pipeline activation highwater")
+
+
+def test_llama_interleaved_pp_tied_matches_single_device():
+    """Interleaved schedule (virtual_pp_degree=2) + tied embeddings at
+    pp=2 on the full hybrid mesh: loss trajectory equals the unsharded
+    run — the reference's production PP mode (VERDICT r4 #5a)."""
+    ids = np.random.default_rng(0).integers(0, 256, size=(4, 32))
+    batch = {"input_ids": jnp.asarray(ids, jnp.int32),
+             "labels": jnp.asarray(np.roll(ids, -1, 1), jnp.int32)}
+
+    def run(hybrid, pp_stages, vpp):
+        fleet._reset()
+        pt.seed(0)
+        if hybrid:
+            strategy = fleet.DistributedStrategy()
+            strategy.hybrid_configs = hybrid
+            hcg = fleet.init(strategy=strategy)
+            mesh = hcg.mesh
+        else:
+            mesh = None
+        model = llama("tiny", num_hidden_layers=8,
+                      pipeline_stages=pp_stages, num_microbatches=2,
+                      virtual_pp_degree=vpp, tie_word_embeddings=True)
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+        step = TrainStep(model, causal_lm_loss, opt, mesh=mesh)
+        state = step.init_state(seed=0)
+        losses = []
+        for _ in range(3):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+        return losses
+
+    base = run(None, 1, 1)
+    inter = run({"pp_degree": 2, "dp_degree": 2, "mp_degree": 2}, 2, 2)
+    np.testing.assert_allclose(base, inter, rtol=2e-3)
