@@ -1,0 +1,29 @@
+"""Public-namespace hygiene: ``__all__`` builder.
+
+The reference never re-exports its implementation imports (``paddle.nn.
+functional`` has no ``paddle.nn.functional.paddle`` attribute); a module
+here that does ``import jax`` without an ``__all__`` leaks ``jax`` into
+``from paddle_tpu.x import *`` and into API-surface probes.  Modules call
+``__all__ = public_all(globals())`` as their last statement: every public
+global EXCEPT foreign (non-paddle_tpu) modules.  ``check_api_compat``
+enforces the invariant — a foreign module reachable as a public attribute
+fails the gate.
+"""
+
+from __future__ import annotations
+
+import types
+
+
+def is_foreign_module(v) -> bool:
+    """A module object that is not part of the paddle_tpu package tree —
+    the one kind of public attribute the reference never exposes.  The
+    single definition of the invariant; ``check_api_compat`` and
+    ``api_probe`` import it rather than re-deriving it."""
+    return isinstance(v, types.ModuleType) \
+        and not (v.__name__ + ".").startswith("paddle_tpu.")
+
+
+def public_all(g: dict) -> list:
+    return sorted(n for n, v in g.items()
+                  if not n.startswith("_") and not is_foreign_module(v))

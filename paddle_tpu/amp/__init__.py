@@ -186,3 +186,8 @@ def is_float16_supported(device=None) -> bool:
     """Reference: paddle.amp.is_float16_supported — fp16 storage/compute
     works through XLA on TPU (bf16 is preferred; see docs/MIGRATION.md)."""
     return True
+
+
+# public namespace hygiene: no foreign-module re-exports (tools/check_api_compat)
+from paddle_tpu._export import public_all as _public_all
+__all__ = _public_all(globals())

@@ -99,3 +99,8 @@ def save(filepath: str, src, sample_rate: int, channels_first=True,
         w.setsampwidth(2)
         w.setframerate(int(sample_rate))
         w.writeframes(data.astype(np.int16).tobytes())
+
+
+# public namespace hygiene: no foreign-module re-exports (tools/check_api_compat)
+from paddle_tpu._export import public_all as _public_all
+__all__ = _public_all(globals())

@@ -77,3 +77,8 @@ class ESC50(_FolderAudioDataset):
                     files.append(os.path.join(root, n))
                     labels.append(label)
         return files, labels
+
+
+# public namespace hygiene: no foreign-module re-exports (tools/check_api_compat)
+from paddle_tpu._export import public_all as _public_all
+__all__ = _public_all(globals())

@@ -242,3 +242,8 @@ def vjp(func, xs, v=None):
         v = jnp.ones_like(out)
     grads = pullback(v)
     return out, grads if len(grads) > 1 else grads[0]
+
+
+# public namespace hygiene: no foreign-module re-exports (tools/check_api_compat)
+from paddle_tpu._export import public_all as _public_all
+__all__ = _public_all(globals())

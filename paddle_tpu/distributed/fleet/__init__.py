@@ -300,3 +300,8 @@ util = UtilBase()
 
 # reference exports the role makers on the fleet namespace too
 from ..ps import PaddleCloudRoleMaker  # noqa: E402,F401
+
+
+# public namespace hygiene: no foreign-module re-exports (tools/check_api_compat)
+from paddle_tpu._export import public_all as _public_all
+__all__ = _public_all(globals())

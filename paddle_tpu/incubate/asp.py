@@ -69,3 +69,8 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
             holder = getattr(holder, part)
         setattr(holder, attr[-1], jnp.asarray(p) * mask)
     return masks
+
+
+# public namespace hygiene: no foreign-module re-exports (tools/check_api_compat)
+from paddle_tpu._export import public_all as _public_all
+__all__ = _public_all(globals())

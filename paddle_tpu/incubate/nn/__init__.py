@@ -270,3 +270,8 @@ class FusedTransformerEncoderLayer(Layer):
 
     def forward(self, src, src_mask=None):
         return self.ffn(self.fused_attn(src, src_mask))
+
+
+# public namespace hygiene: no foreign-module re-exports (tools/check_api_compat)
+from paddle_tpu._export import public_all as _public_all
+__all__ = _public_all(globals())

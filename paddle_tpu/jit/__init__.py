@@ -638,3 +638,8 @@ class TranslatedLayer:
         raise RuntimeError(
             "TranslatedLayer is an inference artifact (AOT StableHLO); "
             "training needs the original Layer")
+
+
+# public namespace hygiene: no foreign-module re-exports (tools/check_api_compat)
+from paddle_tpu._export import public_all as _public_all
+__all__ = _public_all(globals())

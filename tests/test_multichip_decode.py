@@ -7,14 +7,12 @@ tokens identical to the single-device rollout.
 """
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
 import paddle_tpu as pt
 from paddle_tpu.distributed import fleet
 from paddle_tpu.models.llama import llama
-from paddle_tpu.nn.layer import raw_params
 
 
 @pytest.fixture(autouse=True)
@@ -63,7 +61,8 @@ def test_mp_sharded_decode_cache_layout_sharded():
         caches = m.model.init_cache(4, 32)
         _, caches = prefill(params, ids, caches)
         k0 = jax.tree.leaves(caches)[0]
-        # (b, s, h_kv, d): the head axis must be split over mp
-        spec_parts = getattr(k0.sharding, "spec", None)
-        assert k0.sharding.is_fully_replicated is False, \
-            f"cache replicated: {k0.sharding}"
+        # (b, s, h_kv, d): the HEAD axis (dim 2) must be split over mp —
+        # batch-only sharding would pass a mere not-replicated check
+        spec = tuple(k0.sharding.spec)
+        assert len(spec) >= 3 and spec[2] == "mp", \
+            f"kv cache head axis not mp-sharded: {k0.sharding}"

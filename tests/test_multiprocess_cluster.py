@@ -30,6 +30,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.cluster  # OS-process e2e: excluded by -m "not cluster"
+
 from paddle_tpu.launch import CollectiveController, parse_args
 from paddle_tpu.launch.store import free_port
 

@@ -7,6 +7,8 @@ half runs as a jitted device step and embedding rows ride pull/push."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.cluster  # OS-process e2e: excluded by -m "not cluster"
+
 import jax
 import jax.numpy as jnp
 

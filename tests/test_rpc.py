@@ -7,6 +7,8 @@ import time
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.cluster  # OS-process e2e: excluded by -m "not cluster"
+
 from paddle_tpu.distributed import rpc
 from paddle_tpu.launch.store import free_port
 
