@@ -171,7 +171,21 @@ _installed = []
 
 def install():
     """Install the method surface on the concrete array type and the
-    Tracer base (idempotent)."""
+    Tracer base (idempotent).
+
+    PROCESS-GLOBAL SIDE EFFECT (ADVICE r4): this patches jax's own
+    ArrayImpl/Tracer classes, so every jax consumer in-process gains
+    methods like ``.cpu()``/``.numpy()``/``.dim()`` — third-party code
+    that duck-types tensor kinds via ``hasattr(x, "numpy")`` will now
+    classify jax arrays as tensor-like.  That is the point (ported
+    reference scripts call ``x.numpy()`` on our arrays), but it is
+    opt-outable: set ``PDTPU_NO_TENSOR_METHODS=1`` before importing
+    paddle_tpu and the jax classes stay untouched (paddle_tpu itself
+    only needs the methods for reference-script parity, not its own
+    operation).  Existing attributes are never overwritten."""
+    import os
+    if os.environ.get("PDTPU_NO_TENSOR_METHODS") == "1":
+        return 0
     if _installed:
         return len(_installed)
     from .. import ops

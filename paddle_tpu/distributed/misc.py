@@ -24,15 +24,30 @@ from .communication import (all_gather, broadcast, get_rank,
 # reference's _convert_object_to_tensor scheme)
 # ---------------------------------------------------------------------------
 
-_MAX_OBJ_BYTES = 1 << 20
+def _padded_size(nbytes: int) -> int:
+    """Collective byte-buffer size for an ``nbytes`` pickle: the next
+    256-byte multiple.  The reference sizes the tensor to the object
+    (ADVICE r4); small objects no longer move a fixed 1 MB and large
+    ones are no longer rejected.
+
+    Shape-agreement invariant: these object collectives run in the
+    single-controller SPMD model — one program, global (replicated)
+    objects on every rank (the ``scatter_object_list`` docstring
+    codifies this; there is no per-process-different-object path here,
+    unlike the reference's multi-process runtime).  Sizing from the
+    local pickle is therefore identical on all ranks by construction.
+    If a per-rank-payload path is ever added, it must first agree on a
+    size (max-reduce of lengths) before padding."""
+    return max(256, (nbytes + 255) // 256 * 256)
 
 
-def _obj_to_padded(obj, max_bytes=_MAX_OBJ_BYTES):
+def _obj_to_padded(obj, max_bytes=None):
     raw = pickle.dumps(obj)
-    if len(raw) > max_bytes:
+    size = max_bytes if max_bytes is not None else _padded_size(len(raw))
+    if len(raw) > size:
         raise ValueError(f"object of {len(raw)} bytes exceeds the "
-                         f"{max_bytes}-byte object-collective budget")
-    buf = np.zeros((max_bytes + 8,), np.uint8)
+                         f"{size}-byte object-collective budget")
+    buf = np.zeros((size + 8,), np.uint8)
     buf[:8] = np.frombuffer(np.int64(len(raw)).tobytes(), np.uint8)
     buf[8:8 + len(raw)] = np.frombuffer(raw, np.uint8)
     return jnp.asarray(buf)
@@ -114,7 +129,11 @@ def get_group(id=0):
 def destroy_process_group(group=None):
     """Reference: paddle.distributed.destroy_process_group — tear down the
     bootstrap (jax.distributed) connection; mesh-axis groups are pure
-    values and need no teardown."""
+    values and need no teardown.  Destroying a SUBGROUP (``group`` given,
+    valid reference usage) is therefore a no-op here — it must NOT tear
+    down the global bootstrap for everyone (ADVICE r4)."""
+    if group is not None:
+        return
     try:
         jax.distributed.shutdown()
     except Exception:

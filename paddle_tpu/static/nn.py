@@ -39,6 +39,21 @@ def _time_mask(length, T, dtype=jnp.float32):
 # parameterised builders (create params eagerly, then run/record)
 # ---------------------------------------------------------------------------
 
+def _builder_param(shape, tag, init, attr=None, is_bias=False):
+    """One tracked trainable parameter for an inline builder (nce/prelu/
+    sequence_conv/row_conv): created on a host Layer so _track_params
+    registers it on the active Program (persisted by static.save,
+    visible to optimizers) — ADVICE r4: builders must not bake frozen
+    seeded constants."""
+    from ..nn.layer import Layer
+    host = Layer()
+    name = "bias" if is_bias else "weight"
+    setattr(host, name, host.create_parameter(
+        shape, attr=attr, is_bias=is_bias, default_initializer=init))
+    _track_params(host, tag)
+    return getattr(host, name)
+
+
 def _track_params(layer, prefix):
     """Register a builder-created layer's parameters on the active
     Program so static.save/save_program_state persist them (the
@@ -243,15 +258,28 @@ def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
 
 
 def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
-    """mode: all (one alpha) / channel / element."""
+    """mode: all (one alpha) / channel / element.  ``alpha`` is a tracked
+    TRAINABLE parameter (reference: the builder creates a Parameter the
+    optimizer updates and static.save persists), not a frozen constant."""
     from ..nn import functional as F
+    from ..nn import initializer as I
+    # channel axis follows data_format (NCHW: axis 1; NHWC/NLC: last)
+    ch_ax = 1 if data_format.startswith("NC") else getattr(x, "ndim", 2) - 1
     if mode == "all":
         shape = (1,)
     elif mode == "channel":
-        shape = (int(x.shape[1]),)
+        shape = (int(x.shape[ch_ax]),)
     else:
         shape = tuple(int(s) for s in x.shape[1:])
-    alpha = jnp.full(shape, 0.25, jnp.float32)
+
+    alpha = _builder_param(shape, "prelu", I.Constant(0.25),
+                           attr=param_attr)
+    if mode == "channel" and getattr(x, "ndim", 2) > 2:
+        # per-channel alpha must broadcast along the channel axis, not the
+        # trailing one (pre-round-5 this path raised on NCHW inputs)
+        bshape = [1] * x.ndim
+        bshape[ch_ax] = shape[0]
+        alpha = alpha.reshape(bshape)
     return F.prelu(x, alpha)
 
 
@@ -275,7 +303,9 @@ def row_conv(input, future_context_size, param_attr=None, act=None):
     x = jnp.asarray(input)                      # (B, T, D)
     C = int(future_context_size)
     D = int(x.shape[-1])
-    filt = jnp.full((C + 1, D), 1.0 / (C + 1), jnp.float32)
+    from ..nn import initializer as I
+    filt = _builder_param((C + 1, D), "row_conv",
+                          I.Constant(1.0 / (C + 1)), attr=param_attr)
     outs = 0.0
     for i in range(C + 1):
         shifted = jnp.pad(x[:, i:], ((0, 0), (0, i), (0, 0)))
@@ -290,15 +320,19 @@ def nce(input, label, num_total_classes, sample_weight=None,
         param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
         sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
     """Reference: static.nn.nce — noise-contrastive estimation loss with a
-    uniform negative sampler; per-sample loss (B, 1)."""
+    uniform negative sampler; per-sample loss (B, 1).  The class weights/
+    bias are tracked TRAINABLE parameters (the reference builder creates
+    Parameters the optimizer updates and static.save persists)."""
     from ..core import random as prandom
+    from ..nn import initializer as I
     x = jnp.asarray(input)                       # (B, D)
     lab = jnp.asarray(label).reshape(-1)
     B, D = x.shape
     V, S = int(num_total_classes), int(num_neg_samples)
-    w = jax.random.normal(jax.random.PRNGKey(seed or 7), (V, D)) \
-        * (1.0 / math.sqrt(D))
-    b = jnp.zeros((V,))
+    w = _builder_param((V, D), "nce", I.Normal(0.0, 1.0 / math.sqrt(D)),
+                       attr=param_attr)
+    b = _builder_param((V,), "nce", I.Constant(0.0), attr=bias_attr,
+                       is_bias=True)
     key = jax.random.PRNGKey(int(seed)) if seed else \
         prandom.next_key("nce")
     neg = jax.random.randint(key, (B, S), 0, V)
@@ -536,9 +570,11 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
             shifted = x
         ctx.append(shifted)
     stacked = jnp.concatenate(ctx, axis=-1)     # (B, T, fs*D)
-    w = jax.random.normal(jax.random.PRNGKey(11),
-                          (stacked.shape[-1], num_filters)) \
-        / math.sqrt(stacked.shape[-1])
+    from ..nn import initializer as I
+    fan_in = int(stacked.shape[-1])
+    w = _builder_param((fan_in, int(num_filters)), "sequence_conv",
+                       I.Normal(0.0, 1.0 / math.sqrt(fan_in)),
+                       attr=param_attr)
     out = stacked @ w
     if length is not None:
         out = out * _time_mask(length, T, out.dtype)[..., None]

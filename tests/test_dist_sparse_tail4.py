@@ -28,10 +28,17 @@ class TestObjectCollectives:
         dist.scatter_object_list(out, [{"x": i} for i in range(world)])
         assert out[0] == {"x": dist.get_rank() if world > 1 else 0}
 
-    def test_oversized_object_rejected(self):
+    def test_buffer_sized_to_object(self):
+        # ADVICE r4: the buffer tracks the pickle (256-B granularity) —
+        # big objects no longer rejected, small ones no longer pay 1 MB
         from paddle_tpu.distributed.misc import _obj_to_padded
+        big = _obj_to_padded(b"x" * (2 << 20))
+        assert (2 << 20) < big.shape[0] < (2 << 20) + 1024
+        small = _obj_to_padded(0)
+        assert small.shape[0] <= 264
+        # an explicit budget still rejects
         with pytest.raises(ValueError, match="budget"):
-            _obj_to_padded(b"x" * (2 << 20))
+            _obj_to_padded(b"x" * 1024, max_bytes=512)
 
 
 class TestGroupLifecycle:

@@ -249,3 +249,72 @@ class TestStaticTail:
         with ema.apply() as shadow:
             assert "w" in shadow
         ema.restore()
+
+
+class TestBuilderParamsTracked:
+    """ADVICE r4 (medium): nce/sequence_conv/prelu/row_conv must create
+    TRACKED parameters — registered on the active Program so static.save
+    persists them — not frozen seeded constants."""
+
+    def test_builders_register_params(self):
+        import paddle_tpu.static as static
+        import paddle_tpu.static.nn as snn
+
+        with static.program_guard(static.Program()):
+            x = jnp.ones((4, 8))
+            lab = jnp.zeros((4, 1), jnp.int32)
+            snn.nce(x, lab, 16, num_neg_samples=4, seed=3)
+            snn.prelu(jnp.ones((2, 3, 4, 4)) * -1.0, mode="channel")
+            snn.sequence_conv(jnp.ones((2, 5, 8)), 6)
+            snn.row_conv(jnp.ones((2, 5, 8)), 2)
+            names = sorted(static.default_main_program().params)
+        for tag in ("nce", "prelu", "sequence_conv", "row_conv"):
+            assert any(tag in n for n in names), (tag, names)
+        # nce registers weight AND bias
+        assert sum(n.startswith("nce_") for n in names) == 2, names
+
+    def test_prelu_channel_mode_nchw(self):
+        import paddle_tpu.static as static
+        import paddle_tpu.static.nn as snn
+
+        with static.program_guard(static.Program()):
+            y = snn.prelu(jnp.full((2, 3, 4, 4), -1.0), mode="channel")
+        # alpha init 0.25, negative input: y = -0.25 everywhere
+        np.testing.assert_allclose(np.asarray(y), -0.25)
+
+
+class TestObjectCollectiveSizing:
+    """ADVICE r4 (low): object collectives size the byte buffer to the
+    pickle (256-B multiples), not a fixed 1 MB pad, and large objects
+    are no longer rejected."""
+
+    def test_small_object_small_buffer(self):
+        from paddle_tpu.distributed.misc import _obj_to_padded
+        buf = _obj_to_padded({"a": 1})
+        assert buf.shape[0] <= 256 + 8, buf.shape
+
+    def test_large_object_roundtrip(self):
+        from paddle_tpu.distributed.misc import (_obj_to_padded,
+                                                 _padded_to_obj)
+        big = list(range(400_000))        # pickles well past the old 1 MB
+        assert _padded_to_obj(_obj_to_padded(big)) == big
+
+    def test_all_gather_object_world1(self):
+        import paddle_tpu.distributed as dist
+        out = []
+        dist.all_gather_object(out, {"rank": 0, "blob": "x" * 2_000_000})
+        assert out[0]["rank"] == 0 and len(out[0]["blob"]) == 2_000_000
+
+
+def test_destroy_process_group_subgroup_noop(monkeypatch):
+    """ADVICE r4 (low): destroying a subgroup must NOT tear down the
+    global jax.distributed bootstrap."""
+    import paddle_tpu.distributed as dist
+
+    calls = []
+    monkeypatch.setattr(jax.distributed, "shutdown",
+                        lambda: calls.append(1))
+    dist.destroy_process_group(group=object())
+    assert not calls
+    dist.destroy_process_group()
+    assert calls == [1]
