@@ -89,7 +89,10 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
             "scatter_object_list: in_object_list must be provided on every "
             "rank — SPMD programs see the same global inputs (the "
             "reference's None-on-non-src convention does not apply)")
-    tensors = [_obj_to_padded(o) for o in in_object_list]
+    # one shared buffer size: scatter stacks the buffers, so DIFFERENT
+    # objects (the whole point of scatter) must pad to the max pickle
+    common = max(_padded_size(len(pickle.dumps(o))) for o in in_object_list)
+    tensors = [_obj_to_padded(o, max_bytes=common) for o in in_object_list]
     got = scatter(None, tensor_list=tensors, src=src, group=group)
     if got is None:  # world of 1 (no comm context): src keeps its element
         out_object_list.append(in_object_list[src])

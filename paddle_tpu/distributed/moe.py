@@ -229,7 +229,14 @@ class MoELayer(Layer):
         metas = instances[0].param_meta()
         self._param_names = list(per_exp[0].keys())
         for name in self._param_names:
-            stacked = jnp.stack([pe[name] for pe in per_exp], axis=0)
+            first = per_exp[0][name]
+            if isinstance(first, jax.ShapeDtypeStruct):
+                # nn.meta_init() construction (deviceless memory proofs):
+                # stack abstractly — jnp.stack rejects ShapeDtypeStructs
+                stacked = jax.ShapeDtypeStruct(
+                    (num_experts,) + tuple(first.shape), first.dtype)
+            else:
+                stacked = jnp.stack([pe[name] for pe in per_exp], axis=0)
             meta = metas.get(name, ParamMeta())
             base = list(meta.partition) if meta.partition is not None else []
             base += [None] * (stacked.ndim - 1 - len(base))

@@ -154,6 +154,15 @@ def zero_shard_spec(spec: P, shape, axis_name: str, axis_size: int,
     if n < min_size:
         return spec
     entries = list(spec) + [None] * (len(shape) - len(spec))
+    if any(axis_name in (e if isinstance(e, tuple) else (e,))
+           for e in entries):
+        # already ZeRO-sharded over this axis (e.g. the param spec passed
+        # through stage-3 before the opt-state pass re-applies): sharding
+        # twice is meaningless and an invalid NamedSharding.  Surfaced by
+        # the MoE router gate (4096, 8) whose free dim-1 is divisible by
+        # the axis size — llama params dodge it only because 'mp'
+        # annotations occupy every dim.
+        return spec
     for i, (dim, cur) in enumerate(zip(shape, entries)):
         if cur is None and dim % axis_size == 0:
             entries[i] = axis_name

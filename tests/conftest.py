@@ -5,6 +5,10 @@ host replaces the reference's multi-process NCCL test rigs)."""
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: the shell presets JAX_PLATFORMS=axon (TPU)
+# deviceless-topology tests (test_memproof_dcn) load libtpu for COMPILE-ONLY
+# use; without this the process holds the libtpu lockfile and the ci-gate
+# subprocesses (test_ci_gates -> tools/memproof topologies) abort on it
+os.environ.setdefault("ALLOW_MULTIPLE_LIBTPU_LOAD", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

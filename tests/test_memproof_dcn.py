@@ -1,0 +1,90 @@
+"""Deviceless 2-slice (DCN) compile proof (VERDICT r4 missing #5).
+
+A REAL multi-slice TPU topology (compile-only devices with slice_index),
+not the _FakeDev shape check: _device_grid must place a data axis across
+the DCN and keep mp on ICI, and the TrainStep must actually COMPILE over
+the hybrid mesh.  tools/memproof.py runs the 13B-scale version; this is
+the fast sentinel at tiny shapes.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+
+def _two_slice_topology():
+    from jax.experimental import topologies
+    try:
+        return topologies.get_topology_desc(
+            platform="tpu", topology_name="v5e:2x2", num_slices=2)
+    except Exception as e:  # pragma: no cover — environment-specific
+        pytest.skip(f"no compile-only TPU topology available: {e}")
+
+
+def test_two_slice_train_step_compiles_dp_over_dcn():
+    import paddle_tpu as pt
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.llama import causal_lm_loss, llama
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+    import memproof
+
+    td = _two_slice_topology()
+    devs = list(td.devices)
+    assert len(devs) == 8
+    slices = {getattr(d, "slice_index", 0) for d in devs}
+    assert slices == {0, 1}, slices
+
+    fleet._reset()
+    try:
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = {"mp_degree": 2, "dp_degree": 2,
+                            "sharding_degree": 2}
+        hcg = fleet.init(is_collective=True, strategy=s, devices=devs)
+        mesh = hcg.mesh
+
+        # the DCN axis landed on dp: every device row along mp/sharding
+        # stays within one slice; moving along dp crosses slices
+        grid = mesh.devices
+        ax = dict(zip(mesh.axis_names, range(len(mesh.axis_names))))
+        sl = np.vectorize(lambda d: getattr(d, "slice_index", 0))(grid)
+        assert np.all(np.ptp(sl, axis=ax["mp"]) == 0), "mp crosses DCN"
+        assert np.all(np.ptp(sl, axis=ax["sharding"]) == 0), \
+            "sharding crosses DCN"
+        assert np.any(np.ptp(sl, axis=ax["dp"]) > 0), "dp not across DCN"
+
+        with nn.meta_init():
+            model = llama("tiny", sequence_parallel=True)
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+        from paddle_tpu import amp
+        model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+        step = TrainStep(model, causal_lm_loss, opt, zero_stage=1)
+        astate = step.abstract_state()
+        from jax.sharding import NamedSharding
+        bsh = NamedSharding(step.mesh, step.batch_spec)
+        batch = {
+            "input_ids": jax.ShapeDtypeStruct((4, 32), np.int32,
+                                              sharding=bsh),
+            "labels": jax.ShapeDtypeStruct((4, 32), np.int32,
+                                           sharding=bsh),
+        }
+        compiled = step.lower(astate, batch).compile()   # REAL compile
+        ma = compiled.memory_analysis()
+        assert ma.argument_size_in_bytes > 0
+
+        # DCN traffic analysis over the real compiled HLO: within-slice
+        # collectives ride ICI; the cross-slice hops are MegaScale
+        # send/recv ops — there must be some (dp gradients cross), and
+        # the per-slice collectives must exist too
+        kinds = memproof.dcn_collectives(compiled)
+        assert kinds["ici_collectives"], kinds
+        assert kinds["dcn_send_ops"] > 0, \
+            f"no cross-slice (DCN) transfers in 2-slice HLO: {kinds}"
+        assert kinds["dcn_payload_bytes"] > 0, kinds
+    finally:
+        fleet._reset()

@@ -41,7 +41,17 @@ sys.path.insert(0, REPO)
 # padding; 5% flags a real change (an unsharded moment tensor alone would
 # be +25%) without tripping on layout noise.
 MEMPROOF_TOL = 0.05
-MEMPROOF_CASE = "13b-mp8pp4dp2-v5e64"
+# one sentinel per BASELINE workload class (VERDICT r4 #7: breaking ANY
+# config's sharding must fail pytest in seconds, not just the 13B row):
+# 7B ZeRO-3, 13B TP+PP, 70B hybrid, SDXL, MoE EP, 32k-ring long-context
+MEMPROOF_CASES = [
+    "7b-sh8-zero3-v5e8",
+    "13b-mp8pp4dp2-v5e64",
+    "70b-mp8pp4sh4-v5p128",
+    "sdxl-dp8-v5e8",
+    "moe-8x7b-ep8sh8-v5e64",
+    "7b-sep8-sh16-seq32k-v5p128",
+]
 
 
 def gate_api_compat() -> int:
@@ -98,29 +108,43 @@ def gate_memproof_lite() -> int:
 
     import memproof
 
-    case = next(c for c in memproof.CASES if c.name == MEMPROOF_CASE)
     with open(os.path.join(REPO, "docs", "memproof.json")) as f:
-        recorded = next(r for r in json.load(f)
-                        if r["name"] == MEMPROOF_CASE)
-    step, astate, batch, _ = memproof.build_case(case)
-    leaves = jax.tree_util.tree_leaves(astate) + jax.tree_util.tree_leaves(batch)
-    est = sum(_shard_bytes(l) for l in leaves)
-    ref = recorded["argument_bytes"]
-    drift = abs(est - ref) / ref
-    print(f"memproof-lite: {MEMPROOF_CASE} abstract argument bytes "
-          f"{est:,} vs recorded {ref:,} (drift {drift:.2%}, "
-          f"tol {MEMPROOF_TOL:.0%})")
-    if drift > MEMPROOF_TOL:
-        print("memproof-lite gate FAILED — the sharded memory layout "
+        recorded_all = {r["name"]: r for r in json.load(f)}
+
+    failures = []
+    for name in MEMPROOF_CASES:
+        case = next((c for c in memproof.CASES if c.name == name), None)
+        recorded = recorded_all.get(name)
+        if case is None or recorded is None:
+            # the gate's own failure message, not a StopIteration — a
+            # renamed/removed sentinel IS a layout-config change
+            failures.append(
+                f"{name}: missing from "
+                f"{'memproof.CASES' if case is None else 'docs/memproof.json'}"
+                " — update MEMPROOF_CASES or restore the case")
+            continue
+        step, astate, batch, _ = memproof.build_case(case)
+        leaves = (jax.tree_util.tree_leaves(astate)
+                  + jax.tree_util.tree_leaves(batch))
+        est = sum(_shard_bytes(l) for l in leaves)
+        ref = recorded["argument_bytes"]
+        drift = abs(est - ref) / ref
+        print(f"memproof-lite: {name} abstract argument bytes "
+              f"{est:,} vs recorded {ref:,} (drift {drift:.2%}, "
+              f"tol {MEMPROOF_TOL:.0%})")
+        if drift > MEMPROOF_TOL:
+            failures.append(f"{name}: drift {drift:.2%}")
+        # the recorded full proof must still say the config fits
+        if not recorded.get("fits"):
+            failures.append(f"{name}: recorded proof says it does not fit")
+    if failures:
+        print("memproof-lite gate FAILED — a sharded memory layout "
               "changed; re-run tools/memproof.py for the full compiler "
-              "proof and update docs/memproof.json")
+              "proof and update docs/memproof.json:")
+        for f_ in failures:
+            print(f"  {f_}")
         return 1
-    # the recorded full proof must still say the config fits
-    if not recorded.get("fits"):
-        print("memproof-lite gate FAILED — recorded proof says the config "
-              "does not fit")
-        return 1
-    print("memproof-lite gate OK")
+    print(f"memproof-lite gate OK ({len(MEMPROOF_CASES)} configs)")
     return 0
 
 
