@@ -48,6 +48,68 @@ def _use_int4_kernel() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def _active_mesh():
+    """The physical mesh entered via ``with mesh:`` (None outside).
+    Mosaic kernels cannot be auto-partitioned by GSPMD: under a mesh the
+    kernel needs an explicit shard_map (column-parallel path below) or
+    the XLA fallback.  Same accessor as ops/pallas — jax has no public
+    ambient-mesh getter, so guard the internal import."""
+    try:
+        from jax._src.mesh import thread_resources
+    except ImportError:  # pragma: no cover — jax internals moved
+        return None
+    mesh = thread_resources.env.physical_mesh
+    return None if (mesh.empty or mesh.size == 1) else mesh
+
+
+def _kernel_eligible(x, weight_scale, n_tokens) -> bool:
+    """One definition of when the fused int4 kernel serves: per-channel
+    scales and decode/serving token counts (prefill's big-M matmuls
+    amortise the weight stream in XLA and would blow the kernel's VMEM
+    x-tiles)."""
+    return (weight_scale.ndim == 1 and n_tokens <= 256
+            and _use_int4_kernel())
+
+
+def _n_tokens(x) -> int:
+    n = 1
+    for d in x.shape[:-1]:
+        n *= d
+    return n
+
+
+def _int4_kernel_column_sharded(x2d, weight, scale, mesh):
+    """shard_map'd int4 kernel for the COLUMN-parallel layout: weight
+    (K2, N) split over mp on N, per-channel scales split with it — each
+    shard runs the kernel on its own columns and no cross-device
+    reduction is needed (that is what makes column the safe case;
+    row-parallel contracts over a sharded K and keeps the XLA path,
+    whose psum GSPMD inserts).  The token dim rides the data axes when
+    it divides them, so a dp-sharded serving batch is not gathered."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    data_axes = tuple(a for a in ("dp", "sharding")
+                      if a in mesh.axis_names and mesh.shape[a] > 1)
+    dsize = 1
+    for a in data_axes:
+        dsize *= mesh.shape[a]
+    bt = data_axes if (data_axes and x2d.shape[0] % dsize == 0) else None
+
+    f = shard_map(
+        lambda a, w, s: _int4_matmul_fn()(a, w, s),
+        mesh=mesh,
+        in_specs=(P(bt, None), P(None, "mp"), P("mp")),
+        out_specs=P(bt, "mp"),
+        check_vma=False)
+    return f(x2d, weight, scale)
+
+
+def _int4_matmul_fn():
+    from ..ops.pallas.int4_matmul import int4_matmul
+    return int4_matmul
+
+
 def _pack_int4(q):
     """(in, out) int4-valued int8 -> (in//2, out) int8, two nibbles per
     byte: row 2i in the low nibble, row 2i+1 in the high nibble.  Packing
@@ -129,21 +191,20 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
     x = jnp.asarray(x)
     if weight_scale is None:
         raise ValueError("weight_scale is required (from weight_quantize)")
-    n_tokens = 1
-    for d in x.shape[:-1]:
-        n_tokens *= d
-    if (algo == "weight_only_int4" and weight_scale.ndim == 1
-            and n_tokens <= 256 and _use_int4_kernel()):
-        # decode/serving shapes only: prefill's big-M matmuls amortise the
-        # weight stream (XLA path) and would blow the kernel's VMEM x-tiles
+    if (algo == "weight_only_int4" and _kernel_eligible(x, weight_scale,
+                                                        _n_tokens(x))
+            and _active_mesh() is None):
+        # Under an ACTIVE MESH this generic entry falls back to XLA (GSPMD
+        # cannot auto-partition Mosaic kernels, and this entry cannot know
+        # the caller's weight sharding); the column-parallel layer routes
+        # through the explicit shard_map instead.
         # fused dequant-in-matmul Pallas kernel: nibbles unpacked in VMEM,
         # HBM streams the PACKED bytes.  The XLA formulation below
         # materialises the unpacked weight to HBM every call — measured
         # ~8x slower at 7B-shaped GEMVs (docs/BENCH.md round 5)
-        from ..ops.pallas.int4_matmul import int4_matmul
         lead = x.shape[:-1]
-        y = int4_matmul(x.reshape(-1, x.shape[-1]), jnp.asarray(weight),
-                        weight_scale)
+        y = _int4_matmul_fn()(x.reshape(-1, x.shape[-1]),
+                              jnp.asarray(weight), weight_scale)
         y = y.reshape(*lead, y.shape[-1])
         return y if bias is None else y + bias
     if weight_scale.ndim == 2:  # groupwise: dequant fuses into the dot
@@ -245,10 +306,23 @@ class QuantizedColumnParallelLinear(Layer):
         from ..distributed.mp_layers import act_constrain
         if self.sequence_parallel:
             x = act_constrain(x, "mp", None)
-        y = weight_only_linear(x, self.weight, bias=self.bias,
-                               weight_scale=self.weight_scale,
-                               weight_dtype=self._wdtype,
-                               group_size=self.group_size)
+        mesh = _active_mesh()
+        if (mesh is not None and "mp" in mesh.axis_names
+                and self._wdtype == "int4"
+                and _kernel_eligible(x, self.weight_scale, _n_tokens(x))):
+            # multi-chip serving: explicit shard_map over mp (column split
+            # needs no reduction) — GSPMD cannot partition the kernel
+            y = _int4_kernel_column_sharded(
+                x.reshape(-1, x.shape[-1]), self.weight,
+                self.weight_scale, mesh)
+            y = y.reshape(*x.shape[:-1], y.shape[-1])
+            if self.bias is not None:
+                y = y + self.bias
+        else:
+            y = weight_only_linear(x, self.weight, bias=self.bias,
+                                   weight_scale=self.weight_scale,
+                                   weight_dtype=self._wdtype,
+                                   group_size=self.group_size)
         return act_constrain(y, None,
                              None if self.gather_output else "mp")
 

@@ -98,3 +98,38 @@ def test_weight_only_linear_kernel_dispatch(monkeypatch):
     got_g2 = weight_only_linear(x3d, qg, weight_scale=sg,
                                 weight_dtype="int4", group_size=32)
     np.testing.assert_allclose(np.asarray(got_g2), np.asarray(got_g))
+
+
+def test_column_parallel_kernel_matches_xla_on_mesh(monkeypatch):
+    """Multi-chip serving path: QuantizedColumnParallelLinear's
+    shard_map'd int4 kernel (mp-split columns, no reduction) must equal
+    the XLA path under the same mesh."""
+    import functools
+
+    import paddle_tpu as pt
+    import paddle_tpu.nn.quant as QN
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.mp_layers import ColumnParallelLinear
+    from paddle_tpu.ops.pallas import int4_matmul as kernel_mod
+
+    fleet._reset()
+    try:
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"mp_degree": 2, "dp_degree": 4}
+        hcg = fleet.init(is_collective=True, strategy=strategy)
+        pt.seed(0)
+        host = ColumnParallelLinear(64, 256, has_bias=True)
+        q = QN.QuantizedColumnParallelLinear(host, algo="weight_only_int4")
+        x = jnp.asarray(np.random.default_rng(0).standard_normal((4, 1, 64)),
+                        jnp.float32)
+        with hcg.mesh:
+            ref = np.asarray(q(x))                      # XLA path
+        monkeypatch.setattr(QN, "_use_int4_kernel", lambda: True)
+        monkeypatch.setattr(
+            kernel_mod, "int4_matmul",
+            functools.partial(int4_matmul, block_n=128, interpret=True))
+        with hcg.mesh:
+            got = np.asarray(q(x))                      # shard_map kernel
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+    finally:
+        fleet._reset()
