@@ -173,6 +173,26 @@ def main():
                           "tokens_per_sec_per_chip", "params"),
                     remat=True, remat_layers=12)
 
+    # serving decode at the recommended quantized point (int8 weights +
+    # int8 KV — docs/BENCH.md "stacked serving quantization"), slope
+    # protocol so relay RTT cancels; non-fatal like the other extras
+    if on_tpu and os.environ.get("PDTPU_BENCH_DECODE", "1") == "1":
+        try:
+            import contextlib
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "tools"))
+            from decode_bench import bench_generate
+            with contextlib.redirect_stdout(sys.stderr):  # ONE-JSON-line contract
+                # full decode_bench protocol (512-token slope, 3 repeats):
+                # shorter windows measured 4x-impossible throughputs
+                # through the relay's RTT jitter
+                r = bench_generate(batch=1, n_lo=16, n_hi=528, repeats=3,
+                                   kv_cache_dtype="int8", weight_quant="int8")
+            extra["decode_bs1_int8w_int8kv_tok_s"] = r["tokens_per_sec"]
+            extra["decode_bs1_ms_per_token"] = r["ms_per_token"]
+        except Exception as e:  # noqa: BLE001
+            extra["decode_error"] = f"{type(e).__name__}: {e}"[:300]
+
     print(json.dumps({
         "metric": "llama_train_mfu",
         "value": round(mfu, 4),

@@ -52,17 +52,15 @@ def _active_mesh():
     """The physical mesh entered via ``with mesh:`` (None outside).
     Mosaic kernels cannot be auto-partitioned by GSPMD: under a mesh the
     kernel needs an explicit shard_map (column-parallel path below) or
-    the XLA fallback.  Same accessor as ops/pallas — jax has no public
-    ambient-mesh getter, so guard the internal import."""
+    the XLA fallback.  One definition lives in ops/pallas."""
     try:
-        from jax._src.mesh import thread_resources
+        from ..ops.pallas import _active_mesh as impl
     except ImportError:  # pragma: no cover — jax internals moved
         return None
-    mesh = thread_resources.env.physical_mesh
-    return None if (mesh.empty or mesh.size == 1) else mesh
+    return impl()
 
 
-def _kernel_eligible(x, weight_scale, n_tokens) -> bool:
+def _kernel_eligible(weight_scale, n_tokens) -> bool:
     """One definition of when the fused int4 kernel serves: per-channel
     scales and decode/serving token counts (prefill's big-M matmuls
     amortise the weight stream in XLA and would blow the kernel's VMEM
@@ -191,8 +189,7 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
     x = jnp.asarray(x)
     if weight_scale is None:
         raise ValueError("weight_scale is required (from weight_quantize)")
-    if (algo == "weight_only_int4" and _kernel_eligible(x, weight_scale,
-                                                        _n_tokens(x))
+    if (algo == "weight_only_int4" and _kernel_eligible(weight_scale, _n_tokens(x))
             and _active_mesh() is None):
         # Under an ACTIVE MESH this generic entry falls back to XLA (GSPMD
         # cannot auto-partition Mosaic kernels, and this entry cannot know
@@ -309,7 +306,7 @@ class QuantizedColumnParallelLinear(Layer):
         mesh = _active_mesh()
         if (mesh is not None and "mp" in mesh.axis_names
                 and self._wdtype == "int4"
-                and _kernel_eligible(x, self.weight_scale, _n_tokens(x))):
+                and _kernel_eligible(self.weight_scale, _n_tokens(x))):
             # multi-chip serving: explicit shard_map over mp (column split
             # needs no reduction) — GSPMD cannot partition the kernel
             y = _int4_kernel_column_sharded(
