@@ -1,0 +1,97 @@
+"""Quantized serving demo: weight-only int8/int4 + int8 KV cache, optional
+tensor-parallel decode.
+
+The L10 serving recipe (reference: PaddleNLP inference with
+fused-multi-transformer weight-only mode — SURVEY §2.1):
+
+1. build/load a causal-LM, ``.eval()`` it;
+2. ``quantize_linears(model, algo=...)`` swaps every Linear (incl. the
+   Column/RowParallel variants) for its weight-only quantized form —
+   int8 for speed (the v5e recommendation), packed int4 for capacity
+   (half the weight HBM; served by the fused dequant-in-matmul Pallas
+   kernel on TPU);
+3. ``generate(..., kv_cache_dtype="int8")`` quantizes the other half of
+   the decode byte stream;
+4. under a fleet mp mesh the same ``generate()`` call runs TP-sharded
+   (head-parallel projections, mp-sharded KV cache) — greedy tokens are
+   identical to the serial rollout.
+
+Run (CPU mesh):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/serve_quantized.py
+TP decode (same env — 8 virtual devices, or a real multi-chip TPU):
+    ... python examples/serve_quantized.py --algo weight_only_int4 --mp 2
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.models.llama import llama
+from paddle_tpu.nn.quant import quantize_linears
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--algo", default="weight_only_int8",
+                    choices=["weight_only_int8", "weight_only_int4"])
+    ap.add_argument("--mp", type=int, default=1,
+                    help=">1: tensor-parallel decode over the mp axis")
+    ap.add_argument("--new-tokens", type=int, default=24)
+    args = ap.parse_args()
+
+    pt.seed(0)
+    model = llama("tiny", max_position_embeddings=128).eval()
+    prompt = jax.random.randint(jax.random.key(1), (2, 12), 0,
+                                model.cfg.vocab_size)
+
+    # full-precision greedy reference BEFORE quantizing
+    ref = np.asarray(model.generate(prompt, max_new_tokens=args.new_tokens))
+
+    n = quantize_linears(model, algo=args.algo)
+    print(f"quantized {n} linears to {args.algo}")
+
+    # serial quantized rollout — the binding TP invariant below
+    serial = np.asarray(model.generate(prompt,
+                                       max_new_tokens=args.new_tokens,
+                                       kv_cache_dtype="int8"))
+
+    if args.mp > 1:
+        from paddle_tpu.distributed import fleet
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {
+            "mp_degree": args.mp,
+            "dp_degree": max(1, len(jax.devices()) // args.mp)}
+        hcg = fleet.init(is_collective=True, strategy=strategy)
+        with hcg.mesh:
+            out = np.asarray(model.generate(prompt,
+                                            max_new_tokens=args.new_tokens,
+                                            kv_cache_dtype="int8"))
+        print(f"TP decode over mesh {dict(hcg.mesh.shape)}")
+        # greedy TP decode must be token-identical to the serial rollout
+        assert np.array_equal(out, serial), "TP decode diverged from serial"
+        print("TP greedy tokens == serial quantized rollout")
+    else:
+        out = serial
+
+    agree = float((out == ref).mean())
+    print(f"greedy agreement vs full precision: {agree:.2f} "
+          f"(quantization noise on an untrained tiny model is expected; "
+          f"real checkpoints track much closer — see the M94 logit gates)")
+    assert out.shape == (2, 12 + args.new_tokens)
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -52,3 +52,11 @@ def test_train_long_context_ulysses():
     out = _run("train_long_context.py", "--steps", "4", "--seq", "128",
                "--sep", "2", "--dp", "2", "--impl", "ulysses")
     assert "loss=" in out
+
+
+@pytest.mark.parametrize("argv", [
+    ("--algo", "weight_only_int8"),
+    ("--algo", "weight_only_int4", "--mp", "2"),
+])
+def test_serve_quantized(argv):
+    _run("serve_quantized.py", *argv)
