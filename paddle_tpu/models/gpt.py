@@ -47,6 +47,7 @@ class GPTConfig:
     tie_word_embeddings: bool = True
     use_recompute: bool = False
     recompute_policy: Optional[str] = None
+    recompute_num_layers: Optional[int] = None  # Megatron-style partial remat
     sequence_parallel: bool = False
     pipeline_stages: int = 1
     num_microbatches: Optional[int] = None
@@ -188,6 +189,11 @@ class GPTModel(Layer):
                                          weight_attr=_attr(cfg))
         self.embed_dropout = Dropout(cfg.hidden_dropout)
         if cfg.pipeline_stages > 1:
+            if cfg.recompute_num_layers is not None:
+                raise NotImplementedError(
+                    "recompute_num_layers applies per stacked layer; the "
+                    "pp-scanned body remats uniformly — drop "
+                    "recompute_num_layers under pipeline_stages > 1")
             from ..distributed.pipeline import StackedPipelineStages
             self.h = StackedPipelineStages(
                 lambda: GPTDecoderLayer(cfg), cfg.num_hidden_layers,
@@ -199,10 +205,19 @@ class GPTModel(Layer):
                 extra_is_batched=(True,),
                 has_aux=False)
         else:
+            if cfg.recompute_num_layers is not None and not (
+                    0 < cfg.recompute_num_layers <= cfg.num_hidden_layers):
+                raise ValueError(
+                    f"recompute_num_layers={cfg.recompute_num_layers} must "
+                    f"be in [1, num_hidden_layers={cfg.num_hidden_layers}]")
             layers = []
-            for _ in range(cfg.num_hidden_layers):
+            for i in range(cfg.num_hidden_layers):
                 layer = GPTDecoderLayer(cfg)
-                if cfg.use_recompute:
+                # partial remat (Megatron --recompute-num-layers): only
+                # the first N layers re-run in backward
+                if cfg.use_recompute and (
+                        cfg.recompute_num_layers is None
+                        or i < cfg.recompute_num_layers):
                     layer = RecomputeWrapper(layer,
                                              policy=cfg.recompute_policy)
                 layers.append(layer)

@@ -429,3 +429,21 @@ class TestEosGeneration:
         a = np.asarray(m.generate(ids, max_new_tokens=4))
         b = np.asarray(m.generate(ids, max_new_tokens=4, eos_token_id=None))
         np.testing.assert_array_equal(a, b)
+
+
+def test_gpt_partial_remat_num_layers():
+    """recompute_num_layers parity with the llama family: only the first
+    N layers wrapped; math unchanged."""
+    from paddle_tpu.distributed.recompute import RecomputeWrapper
+    from paddle_tpu.models.gpt import gpt
+
+    def count(**kw):
+        pt.seed(0)
+        m = gpt("tiny", num_hidden_layers=4, **kw)
+        body = m.gpt if hasattr(m, "gpt") else m.model
+        return sum(isinstance(l, RecomputeWrapper) for l in body.h)
+
+    assert count(use_recompute=True) == 4
+    assert count(use_recompute=True, recompute_num_layers=2) == 2
+    with pytest.raises(ValueError, match="recompute_num_layers"):
+        count(use_recompute=True, recompute_num_layers=9)
