@@ -188,6 +188,11 @@ class GPTModel(Layer):
                                          cfg.hidden_size,
                                          weight_attr=_attr(cfg))
         self.embed_dropout = Dropout(cfg.hidden_dropout)
+        if cfg.recompute_num_layers is not None and not (
+                0 < cfg.recompute_num_layers <= cfg.num_hidden_layers):
+            raise ValueError(
+                f"recompute_num_layers={cfg.recompute_num_layers} must "
+                f"be in [1, num_hidden_layers={cfg.num_hidden_layers}]")
         if cfg.pipeline_stages > 1:
             if cfg.recompute_num_layers is not None:
                 raise NotImplementedError(
@@ -205,11 +210,6 @@ class GPTModel(Layer):
                 extra_is_batched=(True,),
                 has_aux=False)
         else:
-            if cfg.recompute_num_layers is not None and not (
-                    0 < cfg.recompute_num_layers <= cfg.num_hidden_layers):
-                raise ValueError(
-                    f"recompute_num_layers={cfg.recompute_num_layers} must "
-                    f"be in [1, num_hidden_layers={cfg.num_hidden_layers}]")
             layers = []
             for i in range(cfg.num_hidden_layers):
                 layer = GPTDecoderLayer(cfg)

@@ -440,8 +440,7 @@ def test_gpt_partial_remat_num_layers():
     def count(**kw):
         pt.seed(0)
         m = gpt("tiny", num_hidden_layers=4, **kw)
-        body = m.gpt if hasattr(m, "gpt") else m.model
-        return sum(isinstance(l, RecomputeWrapper) for l in body.h)
+        return sum(isinstance(l, RecomputeWrapper) for l in m.model.h)
 
     assert count(use_recompute=True) == 4
     assert count(use_recompute=True, recompute_num_layers=2) == 2
