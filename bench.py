@@ -21,25 +21,14 @@ import jax
 import jax.numpy as jnp
 
 
-PEAK_BF16_FLOPS = {
-    # per-chip peak bf16 FLOP/s
-    "TPU v5 lite": 197e12,   # v5e
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,        # v5p
-    "TPU v5p": 459e12,
-    "TPU v4": 275e12,
-    "TPU v6 lite": 918e12,   # v6e
-    "cpu": 1e12,             # nominal, CI only
-}
-
+# MFU accounting (peak table + flops/token formula) lives in
+# paddle_tpu/observability/mfu.py — ONE source shared with the runtime
+# StepMonitor, so bench numbers and telemetry step events agree by
+# construction.  Thin re-exports keep the historical bench.py surface.
 
 def peak_flops() -> float:
-    d = jax.devices()[0]
-    kind = getattr(d, "device_kind", "cpu")
-    for k, v in PEAK_BF16_FLOPS.items():
-        if kind.startswith(k):
-            return v
-    return PEAK_BF16_FLOPS.get(kind, 197e12)
+    from paddle_tpu.observability.mfu import peak_flops as _pf
+    return _pf()
 
 
 def measure(preset, batch_size, seq_len, steps, windows, remat=False,
@@ -89,9 +78,11 @@ def measure(preset, batch_size, seq_len, steps, windows, remat=False,
     steps_per_sec = steps / dt
     tokens_per_sec = steps_per_sec * batch_size * seq_len
     n_params = cfg.num_params()
-    # causal-attention-aware model flops per token: 6N + 6*L*h*T
-    flops_per_token = 6 * n_params + 6 * cfg.num_hidden_layers * \
-        cfg.hidden_size * seq_len
+    # causal-attention-aware model flops per token: 6N + 6*L*h*T (the
+    # shared accounting in observability/mfu.py)
+    from paddle_tpu.observability.mfu import causal_lm_flops_per_token
+    flops_per_token = causal_lm_flops_per_token(
+        n_params, cfg.num_hidden_layers, cfg.hidden_size, seq_len)
     mfu = tokens_per_sec * flops_per_token / peak_flops()
     stats = {
         "preset": preset, "params": n_params,
@@ -112,6 +103,19 @@ def main():
     on_tpu = jax.default_backend() != "cpu"
     preset = os.environ.get("PDTPU_BENCH_PRESET",
                             "llama-350m" if on_tpu else "tiny")
+    # telemetry sidecar: every bench run also produces a runtime-schema
+    # JSONL stream (step/compile/metrics events — docs/OBSERVABILITY.md),
+    # so BENCH_r*.json and production telemetry share one vocabulary.
+    # Set PDTPU_BENCH_TELEMETRY="" to disable.
+    tel = None
+    tel_path = os.environ.get("PDTPU_BENCH_TELEMETRY",
+                              "bench_telemetry.jsonl")
+    if tel_path:
+        from paddle_tpu import observability as obs
+        tel = obs.enable(jsonl_path=tel_path)
+        tel.emit({"event": "run_meta", "kind": "bench", "preset": preset,
+                  "backend": jax.default_backend(),
+                  "device": getattr(jax.devices()[0], "device_kind", "cpu")})
     # defaults picked by on-chip sweep (v5e, 2026-07-30): bs4/seq2048 with
     # recompute OFF fits 16 GiB HBM and lands 0.42 MFU; remat ON costs an
     # uncredited extra forward (0.32), bs8 no-remat OOMs by 1.7 GiB
@@ -193,13 +197,20 @@ def main():
         except Exception as e:  # noqa: BLE001
             extra["decode_error"] = f"{type(e).__name__}: {e}"[:300]
 
-    print(json.dumps({
+    result = {
         "metric": "llama_train_mfu",
         "value": round(mfu, 4),
         "unit": "mfu_fraction",
         "vs_baseline": round(mfu / 0.40, 4),
         "extra": extra,
-    }))
+    }
+    if tel is not None:
+        # the sidecar carries the same payload the driver records, plus
+        # the final registry snapshot (via disable's flush)
+        tel.emit({"event": "bench_result", **result})
+        from paddle_tpu import observability as obs
+        obs.disable()
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -13,6 +13,8 @@ Architecture (see SURVEY.md for the reference map):
 - ``paddle_tpu.io``         Dataset/DataLoader/DistributedBatchSampler
 - ``paddle_tpu.ckpt``       sharded checkpoint save/load with reshard-on-load
 - ``paddle_tpu.profiler``   jax.profiler façade (chrome trace export)
+- ``paddle_tpu.observability`` always-on runtime telemetry (step metrics,
+                            recompile sentinel, collective accounting)
 - ``paddle_tpu.models``     in-repo model zoo (llama, gpt/ernie, mixtral-moe, sdxl-unet)
 """
 
@@ -71,7 +73,7 @@ def __getattr__(name):
                 "vision", "incubate", "hapi", "static", "device", "launch",
                 "utils", "config", "sparse", "quantization", "inference",
                 "audio", "distribution", "geometric", "signal", "regularizer",
-                "callbacks", "text", "hub", "onnx"):
+                "callbacks", "text", "hub", "onnx", "observability"):
         try:
             mod = importlib.import_module(f".{name}", __name__)
         except ModuleNotFoundError as e:
@@ -122,7 +124,7 @@ def __dir__():
         "vision", "incubate", "hapi", "static", "device", "launch", "utils",
         "config", "sparse", "quantization", "inference", "audio",
         "distribution", "geometric", "signal", "regularizer", "callbacks",
-        "text", "hub", "onnx",
+        "text", "hub", "onnx", "observability",
         "Model", "DataParallel", "flops", "summary", "version", "metric",
         "enable_static", "disable_static", "in_dynamic_mode"})
 

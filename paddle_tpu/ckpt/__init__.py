@@ -58,7 +58,11 @@ def _from_host(obj, to_device: bool):
             return jax.random.wrap_key_data(jnp.asarray(x["__prng_key__"]),
                                             impl=x["impl"])
         if to_device and isinstance(x, np.ndarray):
-            return jnp.asarray(x)
+            # COPY, never zero-copy: jax CPU aliases host numpy buffers,
+            # and a loaded state fed to a donating TrainStep would have
+            # XLA free/overwrite memory numpy still owns (observed as a
+            # segfault on the resume-after-preemption path)
+            return jnp.array(x)
         return x
     return jax.tree_util.tree_map(leaf, obj,
                                   is_leaf=lambda x: isinstance(x, dict)
@@ -306,7 +310,10 @@ class _ShardReader:
                 continue
             src = np.load(os.path.join(self.path, fdesc["file"]), mmap_mode="r")
             if out_shape == ():
-                return np.asarray(src).reshape(())
+                # np.array (copy): never hand out a view of the read-only
+                # mmap — jax zero-copies host arrays and a donated write
+                # into PROT_READ pages is a SIGSEGV
+                return np.array(src).reshape(())
             src_sel = tuple(slice(a - ra, b - ra)
                             for (a, b), (ra, _) in zip(inter, ranges))
             dst_sel = tuple(slice(a - wa, b - wa)

@@ -24,7 +24,7 @@ from typing import Optional, Sequence, Union
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ..core.compat import shard_map
 
 from . import fleet
 
@@ -99,7 +99,11 @@ def _eager_wrap(fn, tensor, axes, out_specs_fn=None, in_spec=None):
     mesh = Group(axes).mesh
     in_spec = in_spec if in_spec is not None else P(axes)
     out_spec = out_specs_fn(in_spec) if out_specs_fn else in_spec
-    f = shard_map(fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec)
+    # check_vma off: older jax cannot infer replication through tiled
+    # all_gather/psum_scatter bodies and rejects the P() out_specs the
+    # replicated-in/replicated-out eager contract uses
+    f = shard_map(fn, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec,
+                  check_vma=False)
     return f(tensor)
 
 
@@ -429,25 +433,39 @@ def init_parallel_env(cluster_env: Optional[dict] = None):
 
 
 # ---------------------------------------------------------------------------
-# collective-consistency watchdog hooks (SURVEY §5.2): when
-# debug.collective_debug() is active, every collective issued through this
-# module is recorded for cross-rank sequence verification
+# collective-consistency watchdog + telemetry hooks (SURVEY §5.2/§5.5):
+# when debug.collective_debug() is active, every collective issued through
+# this module is recorded for cross-rank sequence verification; when
+# observability is enabled, byte/call counters are routed into the metrics
+# registry.  Both hooks are one falsy check when off.
 # ---------------------------------------------------------------------------
 
 import functools as _functools
 
 from . import debug as _debug
+from ..observability import _state as _obs_state
 
 
 def _traced(fn, name):
     @_functools.wraps(fn)
     def wrapper(tensor, *a, **kw):
-        if _debug.get_trace() is not None:
+        rec = _obs_state.COLLECTIVE[0]
+        tracing = _debug.get_trace() is not None
+        if tracing or rec is not None:
             grp = kw.get("group", kw.get("axis"))
             axes = _axis_tuple(grp) if not isinstance(grp, str) else (grp,)
-            _debug.record(name, axes or ("world",),
-                          getattr(tensor, "shape", None),
-                          getattr(tensor, "dtype", None))
+            if tracing:
+                _debug.record(name, axes or ("world",),
+                              getattr(tensor, "shape", None),
+                              getattr(tensor, "dtype", None))
+            if rec is not None:
+                payload = tensor
+                if isinstance(tensor, list) and not tensor:
+                    # paddle-style all_gather(tensor_list, tensor, ...):
+                    # the first positional is the (empty) OUTPUT list —
+                    # the payload is the second argument
+                    payload = a[0] if a else kw.get("tensor", tensor)
+                rec(name, axes, payload)
         return fn(tensor, *a, **kw)
     return wrapper
 

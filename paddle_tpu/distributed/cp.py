@@ -41,7 +41,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ..core.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from . import fleet

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from ..nn.layer import Layer
+from ..observability import _state as _obs_state
 from . import fleet
 from .auto import _to_jax_mesh, shard_dataloader
 
@@ -105,16 +106,24 @@ class Engine:
                 "run zero steps")
         for epoch in range(epochs):
             loader = self._loader(train_data)
+            i = -1
             for i, batch in enumerate(loader):
                 # the step donates the state buffers: keep self._state
                 # pointing at the LIVE pytree so mid-fit evaluate() (and a
-                # user interrupt) never reads donated arrays
+                # user interrupt) never reads donated arrays.  Per-step
+                # telemetry (wall time, tokens/sec, MFU) is emitted by
+                # TrainStep.__call__ itself when observability is enabled.
                 self._state, metrics = self._step(self.state, batch)
                 if callback is not None and i % log_freq == 0:
                     callback(epoch, i, {k: float(v)
                                         for k, v in metrics.items()})
             if valid_data is not None:
                 metrics["eval_loss"] = self.evaluate(valid_data)["loss"]
+            emit = _obs_state.EMIT[0]
+            if emit is not None:
+                emit({"event": "epoch", "site": self._step._site,
+                      "epoch": epoch, "steps": i + 1,
+                      **{k: float(v) for k, v in metrics.items()}})
         return {k: float(v) for k, v in metrics.items()}
 
     def evaluate(self, valid_data):

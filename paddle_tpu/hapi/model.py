@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from ..nn.layer import Layer, functional_call, raw_params
+from ..observability import _state as _obs_state
 from .callbacks import config_callbacks
 
 
@@ -36,6 +37,7 @@ class Model:
 
     def __init__(self, network: Layer, inputs=None, labels=None):
         self.network = network
+        self._site = f"hapi.Model({type(network).__name__})"
         self.stop_training = False
         self._inputs = inputs
         self._labels = labels
@@ -134,7 +136,16 @@ class Model:
             self._train_step = self._build_train_step()
         state = self._ensure_state()
         inputs, labels = _as_tuple(inputs), _as_tuple(labels)
-        self._state, loss, preds = self._train_step(state, inputs, labels)
+        # telemetry: one falsy check when disabled (same contract as
+        # jit.TrainStep.__call__); hapi drives its own jitted step, so it
+        # feeds the StepMonitor directly
+        mon = _obs_state.MONITOR[0]
+        if mon is not None:
+            self._state, loss, preds = mon.timed_step(
+                self._site, self.network, inputs,
+                lambda: self._train_step(state, inputs, labels))
+        else:
+            self._state, loss, preds = self._train_step(state, inputs, labels)
         metric_out = self._update_metrics(preds, labels) if self._metrics else {}
         return loss, metric_out
 

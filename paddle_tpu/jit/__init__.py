@@ -26,6 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import random as prandom
 from ..nn.layer import Layer, functional_call, raw_params, trainable_mask
+from ..observability import _state as _obs_state
 from . import control_flow
 from .control_flow import (GraphBreakError, case, cond, switch_case,
                            while_loop)
@@ -107,9 +108,15 @@ def to_static(function=None, input_spec=None, full_graph=True, backend=None,
 
         # enable_to_static is a CALL-time switch (reference semantics:
         # flipping it off routes already-decorated functions to eager)
+        site = f"to_static({getattr(fn, '__name__', type(fn).__name__)})"
+
         def dispatch(*args, **kwargs):
             if not _TO_STATIC_ENABLED[0]:
                 return fn(*args, **kwargs)
+            mon = _obs_state.MONITOR[0]
+            if mon is not None:
+                with mon.compile_site(site):
+                    return compiled(*args, **kwargs)
             return compiled(*args, **kwargs)
 
         if callable(fn) and hasattr(fn, "__name__"):
@@ -249,6 +256,7 @@ class TrainStep:
         self._mask = trainable_mask(model)
         self._compiled = jax.jit(self._step, donate_argnums=(0,),
                                  static_argnums=(2,))
+        self._site = f"TrainStep({type(model).__name__})"
 
     # -- sharding specs ----------------------------------------------------
 
@@ -538,6 +546,17 @@ class TrainStep:
                 "gradient accumulation requested but this TrainStep was "
                 "built without buffers: wrap the model in "
                 "paddle_tpu.DataParallel or pass gradient_accumulation=True")
+        # telemetry: exactly ONE falsy check on the disabled path (the
+        # distributed/debug.py zero-overhead contract, enforced by the
+        # telemetry-overhead CI gate)
+        mon = _obs_state.MONITOR[0]
+        if mon is not None:
+            return mon.timed_step(
+                self._site, self.model, batch,
+                lambda: self._run(state, batch, accumulate))
+        return self._run(state, batch, accumulate)
+
+    def _run(self, state, batch, accumulate):
         if self.mesh is not None:
             with self.mesh:
                 return self._compiled(state, batch, accumulate)

@@ -22,6 +22,8 @@ import signal
 import threading
 from typing import Callable, Optional
 
+from ..observability import _state as _obs_state
+
 
 class PreemptionGuard:
     """Installs a SIGTERM (and optionally SIGINT) handler that flips
@@ -42,7 +44,25 @@ class PreemptionGuard:
         return self._event.is_set()
 
     def _handler(self, signum, frame):
+        first = not self._event.is_set()
         self._event.set()
+        # structured telemetry (timestamp is stamped by the sink layer):
+        # interrupted runs are diagnosable from the JSONL stream.  First
+        # signal only — the repeat SIGTERM before SIGKILL is not a new
+        # preemption.  Guarded hard: a telemetry failure inside a signal
+        # handler must never turn a graceful preemption into a crash.
+        if first and _obs_state.EMIT[0] is not None:
+            try:
+                try:
+                    reason = signal.Signals(signum).name
+                except Exception:
+                    reason = str(signum)
+                mon = _obs_state.MONITOR[0]
+                _obs_state.EMIT[0]({
+                    "event": "preemption", "reason": reason,
+                    "step": mon.total_steps if mon is not None else None})
+            except Exception:
+                pass
 
     def __enter__(self):
         # fresh lifecycle per entry: a guard object may be reused across

@@ -84,7 +84,7 @@ def _int4_kernel_column_sharded(x2d, weight, scale, mesh):
     row-parallel contracts over a sharded K and keeps the XLA path,
     whose psum GSPMD inserts).  The token dim rides the data axes when
     it divides them, so a dp-sharded serving batch is not gathered."""
-    from jax import shard_map
+    from ..core.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     data_axes = tuple(a for a in ("dp", "sharding")

@@ -1,0 +1,236 @@
+"""Runtime telemetry (reference capability: PaddlePaddle's profiler /
+monitor stack, SURVEY §5.5 — always-on runtime statistics, not one-off
+benchmarks).
+
+Three pillars:
+
+1. **Metrics registry** (``registry.py``): thread-safe counters, gauges,
+   histograms with rolling p50/p95; pluggable sinks (``sinks.py``) —
+   in-memory for tests, JSONL file, stdout/stderr — process-0-gated
+   under multihost.
+2. **StepMonitor** (``step_monitor.py``): ``jit.TrainStep.__call__``,
+   ``hapi.Model`` (and through TrainStep, ``distributed.Engine.fit``)
+   emit one structured event per step with wall time, tokens/sec and
+   MFU, sharing bench.py's flops-per-token math (``mfu.py``) so runtime
+   and bench numbers agree by construction.
+3. **Recompile sentinel** (``recompile.py``): counts XLA backend
+   compiles via ``jax.monitoring``, attributes them to the TrainStep /
+   ``to_static`` site, and warns loudly on recompile storms.
+
+Collectives issued through ``paddle_tpu.distributed`` additionally feed
+byte/call counters into the registry (``distributed/communication.py``).
+
+Zero overhead when disabled (the default): every producer does ONE falsy
+check against a ``_state`` hook container (the ``distributed/debug.py``
+pattern) — enforced by the ``telemetry-overhead`` CI gate in
+``tools/ci.py``.
+
+Usage::
+
+    import paddle_tpu.observability as obs
+    tel = obs.enable(jsonl_path="run_telemetry.jsonl")
+    ... train ...
+    obs.disable()          # final metrics snapshot + sink close
+
+Event schema: docs/OBSERVABILITY.md.  Report folding:
+``python tools/telemetry_report.py run_telemetry.jsonl``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from . import _state
+from .mfu import (PEAK_BF16_FLOPS, causal_lm_flops_per_token,  # noqa: F401
+                  dense_flops_per_token, flops_per_token_of, peak_flops)
+from .recompile import (BACKEND_COMPILE_EVENT, RecompileSentinel,  # noqa: F401
+                        RecompileStormWarning)
+from .registry import Counter, Gauge, Histogram, MetricsRegistry  # noqa: F401
+from .sinks import (InMemorySink, JsonlSink, Sink,  # noqa: F401
+                    StdoutSink, _ProcessZeroGate)
+from .step_monitor import StepMonitor  # noqa: F401
+
+_ACTIVE: List[Optional["Telemetry"]] = [None]
+
+
+class Telemetry:
+    """One enabled telemetry session: registry + sinks + monitors."""
+
+    def __init__(self, registry: MetricsRegistry, sinks: List[Sink],
+                 monitor: Optional[StepMonitor],
+                 sentinel: Optional[RecompileSentinel]):
+        self.registry = registry
+        self.sinks = list(sinks)
+        self.monitor = monitor
+        self.sentinel = sentinel
+        # RLock, not Lock: the preemption SIGTERM handler emits from the
+        # main thread, possibly interrupting an emit already holding the
+        # lock — a plain Lock would self-deadlock the dying process
+        self._lock = threading.RLock()
+
+    def emit(self, event: dict) -> None:
+        """Stamp ``ts`` and fan out to every sink (serialized: events may
+        come from the trainer thread and the compile listener at once)."""
+        if "ts" not in event:
+            event = {"ts": round(time.time(), 3), **event}
+        with self._lock:
+            for s in self.sinks:
+                try:
+                    s.write(event)
+                except Exception:
+                    # a broken sink must never take down a train step
+                    pass
+
+    def flush(self, emit_metrics: bool = True) -> None:
+        """Emit a ``metrics`` registry snapshot, then flush sinks."""
+        if emit_metrics:
+            self.emit({"event": "metrics",
+                       "metrics": self.registry.snapshot()})
+        with self._lock:
+            for s in self.sinks:
+                s.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            for s in self.sinks:
+                s.close()
+
+
+def enabled() -> bool:
+    return _ACTIVE[0] is not None
+
+
+def get_telemetry() -> Optional[Telemetry]:
+    return _ACTIVE[0]
+
+
+def get_registry() -> Optional[MetricsRegistry]:
+    tel = _ACTIVE[0]
+    return tel.registry if tel is not None else None
+
+
+def emit_event(event: str, **fields) -> None:
+    """Fire-and-forget structured event; no-op when disabled."""
+    emit = _state.EMIT[0]
+    if emit is not None:
+        emit({"event": event, **fields})
+
+
+def _record_collective(op: str, axes, arg) -> None:
+    """COLLECTIVE hook target: byte/call counters per collective op.
+
+    ``arg`` is the collective's first positional (a tensor, a tensor
+    list for the paddle-style all_gather signature, or a P2POp list for
+    batch_isend_irecv).  Eager calls count per call; calls inside a jit
+    trace count once per trace — per-execution counting would need a
+    host callback in the compiled hot path, which is exactly what this
+    subsystem promises not to do.
+    """
+    tel = _ACTIVE[0]
+    if tel is None:
+        return
+    tensors = []
+    if hasattr(arg, "shape"):
+        tensors = [arg]
+    elif isinstance(arg, (list, tuple)):
+        for o in arg:
+            t = getattr(o, "tensor", o)
+            if hasattr(t, "shape"):
+                tensors.append(t)
+    nbytes = 0
+    for t in tensors:
+        try:
+            n = 1
+            for d in t.shape:
+                n *= int(d)
+            nbytes += n * t.dtype.itemsize
+        except Exception:
+            pass
+    label = ",".join(axes) if axes else "world"
+    reg = tel.registry
+    reg.counter(f"collective.{op}.calls").inc()
+    reg.counter(f"collective.{op}.bytes").inc(nbytes)
+    reg.counter(f"collective.{op}[{label}].bytes").inc(nbytes)
+
+
+def enable(jsonl_path: Optional[str] = None, stdout: bool = False,
+           sinks: Optional[List[Sink]] = None, *,
+           step_monitor: bool = True, recompile_sentinel: bool = True,
+           collectives: bool = True, warmup_steps: int = 1,
+           sentinel_warmup: int = 1, storm_threshold: int = 3,
+           storm_window_s: float = 60.0, storm_all_sites: bool = False,
+           all_processes: bool = False,
+           registry: Optional[MetricsRegistry] = None) -> Telemetry:
+    """Turn telemetry on (replacing any active session) and return the
+    ``Telemetry`` handle.
+
+    With no sink arguments an ``InMemorySink`` is installed so events are
+    at least inspectable via ``get_telemetry().sinks[0]``.  File/stdout
+    sinks only write on process 0 unless ``all_processes=True``;
+    in-memory sinks are never gated.
+    """
+    disable()
+    out: List[Sink] = list(sinks) if sinks else []
+    file_sinks: List[Sink] = []
+    if jsonl_path:
+        file_sinks.append(JsonlSink(jsonl_path))
+    if stdout:
+        file_sinks.append(StdoutSink())
+    if file_sinks and not all_processes:
+        is_zero = True
+        try:
+            import jax
+            is_zero = jax.process_index() == 0
+        except Exception:
+            pass
+        file_sinks = [_ProcessZeroGate(s, is_zero) for s in file_sinks]
+    out.extend(file_sinks)
+    if not out:
+        # bounded: a sinkless enable() (sentinel/registry only) on a
+        # long-running job must not grow an event list without limit
+        out = [InMemorySink(maxlen=65536)]
+
+    reg = registry if registry is not None else MetricsRegistry()
+    tel = Telemetry(reg, out, None, None)
+    sent = None
+    if recompile_sentinel:
+        sent = RecompileSentinel(tel, reg, warmup=sentinel_warmup,
+                                 storm_threshold=storm_threshold,
+                                 storm_window_s=storm_window_s,
+                                 storm_all_sites=storm_all_sites)
+        sent.install()
+        tel.sentinel = sent
+    if step_monitor:
+        tel.monitor = StepMonitor(tel, reg, sentinel=sent,
+                                  warmup_steps=warmup_steps)
+
+    _ACTIVE[0] = tel
+    _state.MONITOR[0] = tel.monitor
+    _state.EMIT[0] = tel.emit
+    _state.COLLECTIVE[0] = _record_collective if collectives else None
+    return tel
+
+
+def disable() -> None:
+    """Tear down: unhook producers, emit a final metrics snapshot, close
+    sinks.  Idempotent."""
+    tel = _ACTIVE[0]
+    if tel is None:
+        return
+    _state.MONITOR[0] = None
+    _state.COLLECTIVE[0] = None
+    _state.EMIT[0] = None
+    _ACTIVE[0] = None
+    if tel.sentinel is not None:
+        tel.sentinel.uninstall()
+    try:
+        tel.flush(emit_metrics=True)
+    finally:
+        tel.close()
+
+
+# public namespace hygiene: no foreign-module re-exports (tools/check_api_compat)
+from paddle_tpu._export import public_all as _public_all
+__all__ = _public_all(globals())

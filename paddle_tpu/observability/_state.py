@@ -1,0 +1,30 @@
+"""Hot-path hook containers — the whole disabled-telemetry surface.
+
+Mirrors ``distributed/debug.py``'s zero-overhead contract: a producer on
+a hot path does ONE falsy check against a module-level container::
+
+    hook = _obs_state.MONITOR[0]
+    if hook is not None:
+        ...telemetry path...
+
+With telemetry disabled (the default) every container holds ``None`` and
+the check costs ~0.2 µs — no lock, no dict, no registry, no import of
+anything heavier than this (stdlib-free) module.  ``enable()`` /
+``disable()`` in ``observability/__init__`` are the only writers.
+
+Containers are single-element lists (not bare globals) so hot modules
+can bind the list object once at import time and still observe
+enable/disable flips.
+"""
+
+# StepMonitor instance, or None. Read by jit.TrainStep.__call__,
+# jit.to_static dispatch, hapi.Model._train_one.
+MONITOR = [None]
+
+# callable(op_name, axes, first_arg) or None. Read by
+# distributed.communication's _traced wrapper per collective call.
+COLLECTIVE = [None]
+
+# callable(event_dict) (Telemetry.emit) or None. Read by
+# launch.preempt's signal handler and distributed.Engine.fit.
+EMIT = [None]

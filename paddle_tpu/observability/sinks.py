@@ -1,0 +1,138 @@
+"""Telemetry sinks: where structured events go.
+
+One event = one flat-ish JSON-serializable dict with at least ``event``
+(kind) and ``ts`` (unix seconds, stamped by ``Telemetry.emit``).  Sinks
+are deliberately dumb — no buffering policy beyond line-flush, no
+schema enforcement — so a sink can never stall a train step for long,
+and the JSONL stream stays greppable/tail-able while the job runs.
+
+Multihost: ``enable()`` wraps file/stdout sinks in process-0 gating (see
+``__init__.enable``); ``InMemorySink`` is never gated (tests assert on
+every process).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List, Optional
+
+__all__ = ["Sink", "InMemorySink", "JsonlSink", "StdoutSink"]
+
+
+def _jsonable(v):
+    """Best-effort scalarization: device arrays / numpy scalars become
+    Python floats so a sink never triggers a surprising repr or keeps a
+    buffer alive inside the event stream."""
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    try:
+        return float(v)
+    except Exception:
+        return repr(v)
+
+
+class Sink:
+    """Interface: ``write(event_dict)`` + optional ``flush``/``close``."""
+
+    def write(self, event: dict) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        self.flush()
+
+
+class InMemorySink(Sink):
+    """Keeps events in memory — the test/inspection sink.
+
+    ``maxlen`` bounds the buffer (oldest events dropped); ``enable()``'s
+    default sink passes one so a sinkless long-running job cannot grow
+    an event list without bound."""
+
+    def __init__(self, maxlen: Optional[int] = None):
+        from collections import deque
+        self.records = deque(maxlen=maxlen)
+
+    def write(self, event: dict) -> None:
+        self.records.append(event)
+
+    def events(self, kind: Optional[str] = None) -> List[dict]:
+        if kind is None:
+            return list(self.records)
+        return [e for e in self.records if e.get("event") == kind]
+
+    def clear(self) -> None:
+        self.records.clear()
+
+
+class JsonlSink(Sink):
+    """Appends one JSON line per event to ``path``.
+
+    The file is opened lazily (first event) and flushed per line, so a
+    preemption event emitted from a SIGTERM handler is on disk before the
+    process exits, and ``tail -f`` sees steps as they happen.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fh = None
+
+    def write(self, event: dict) -> None:
+        if self._fh is None:
+            self._fh = open(self.path, "a")
+        # serialize fully, then ONE write call: a signal handler emitting
+        # mid-write (preemption) must not interleave half-built lines
+        self._fh.write(json.dumps(_jsonable(event),
+                                  separators=(",", ":")) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+class StdoutSink(Sink):
+    """One JSON line per event to stderr by default.
+
+    Default stream is *stderr*, not stdout: bench.py and the driver own a
+    one-JSON-line-on-stdout contract that interleaved telemetry would
+    corrupt.  Pass ``stream=sys.stdout`` explicitly to opt in.
+    """
+
+    def __init__(self, stream=None):
+        self._stream = stream if stream is not None else sys.stderr
+
+    def write(self, event: dict) -> None:
+        self._stream.write(json.dumps(_jsonable(event),
+                                      separators=(",", ":")) + "\n")
+        self._stream.flush()
+
+
+class _ProcessZeroGate(Sink):
+    """Wraps a sink; drops events on non-zero processes (multihost: one
+    JSONL stream per job, not per host, matching how the reference gates
+    its logging on rank 0)."""
+
+    def __init__(self, inner: Sink, is_zero: bool):
+        self.inner = inner
+        self._is_zero = is_zero
+
+    def write(self, event: dict) -> None:
+        if self._is_zero:
+            self.inner.write(event)
+
+    def flush(self) -> None:
+        if self._is_zero:
+            self.inner.flush()
+
+    def close(self) -> None:
+        if self._is_zero:
+            self.inner.close()

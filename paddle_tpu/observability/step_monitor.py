@@ -1,0 +1,151 @@
+"""StepMonitor: per-step wall time, tokens/sec, MFU — one event per step.
+
+Fed by the three training front doors (``jit.TrainStep.__call__``,
+``hapi.Model._train_one``, and therefore ``distributed.Engine.fit``,
+which drives a TrainStep) through the one-falsy-check hook in
+``_state.MONITOR``.
+
+Timing protocol — why two durations per event:
+
+- ``wall_ms``: dispatch-to-return of this call.  Under jax's async
+  dispatch this can undershoot the real step time until the pipeline
+  backpressures (the host runs ahead), and the first call absorbs the
+  XLA compile.
+- ``interval_ms``: end-to-end time since the previous step of the same
+  site.  In steady state this is exactly what bench.py measures (a
+  timed loop over steps), so ``tokens_per_sec`` and ``mfu`` are derived
+  from the interval once one exists — runtime numbers and bench numbers
+  share both the clock protocol and the flops formula (``mfu.py``).
+
+Warmup events (first ``warmup_steps`` per site — the compile) are
+emitted but flagged ``"warmup": true`` so report tooling excludes them
+from throughput aggregates.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional
+
+from .mfu import flops_per_token_of, peak_flops
+
+__all__ = ["StepMonitor"]
+
+
+def _first_array(batch):
+    """The leaf whose shape defines the token count: ``input_ids`` when
+    present (the LM convention bench.py uses), else the first
+    shaped leaf found."""
+    if hasattr(batch, "shape"):
+        return batch
+    if isinstance(batch, dict):
+        ids = batch.get("input_ids")
+        if hasattr(ids, "shape"):
+            return ids
+        for v in batch.values():
+            if hasattr(v, "shape"):
+                return v
+    if isinstance(batch, (list, tuple)):
+        for v in batch:
+            a = _first_array(v)
+            if a is not None:
+                return a
+    return None
+
+
+def _tokens_of(batch):
+    """(tokens, seq_len) from the batch's leading array: B·S for ndim≥2
+    (seq = dim 1), B for ndim 1, None when nothing is shaped."""
+    arr = _first_array(batch)
+    if arr is None or not getattr(arr, "shape", None):
+        return None, None
+    shape = arr.shape
+    if len(shape) >= 2:
+        return int(shape[0]) * int(shape[1]), int(shape[1])
+    return int(shape[0]), None
+
+
+class StepMonitor:
+    """Emits one ``step`` event per training step through the Telemetry
+    sinks and mirrors the numbers into the registry."""
+
+    def __init__(self, telemetry, registry, sentinel=None,
+                 warmup_steps: int = 1):
+        self._tel = telemetry
+        self._reg = registry
+        self.sentinel = sentinel
+        self.warmup_steps = int(warmup_steps)
+        self.total_steps = 0
+        self.last_event: Optional[dict] = None
+        self._sites: dict = {}   # site -> {"steps", "last_t", "fpt", "fpt_seq"}
+
+    # -- hot-path entry points --------------------------------------------
+
+    def timed_step(self, site: str, model, batch,
+                   thunk: Callable[[], Any]):
+        """Run one training step under timing + compile attribution."""
+        sent = self.sentinel
+        t0 = time.perf_counter()
+        if sent is not None:
+            with sent.site(site):
+                out = thunk()
+        else:
+            out = thunk()
+        t1 = time.perf_counter()
+        self._record(site, model, batch, t0, t1)
+        return out
+
+    def compile_site(self, site: str):
+        """Attribution-only scope for non-step jit entries (to_static)."""
+        if self.sentinel is not None:
+            return self.sentinel.site(site)
+        import contextlib
+        return contextlib.nullcontext()
+
+    # -- accounting --------------------------------------------------------
+
+    def _record(self, site, model, batch, t0, t1):
+        info = self._sites.get(site)
+        if info is None:
+            info = self._sites[site] = {
+                "steps": 0, "last_t": None, "fpt": None, "fpt_seq": None}
+        info["steps"] += 1
+        self.total_steps += 1
+        n = info["steps"]
+        wall_s = t1 - t0
+        interval_s = (t1 - info["last_t"]) if info["last_t"] is not None \
+            else wall_s
+        info["last_t"] = t1
+        ev = {"event": "step", "site": site, "step": n,
+              "wall_ms": round(wall_s * 1e3, 3),
+              "interval_ms": round(interval_s * 1e3, 3),
+              "warmup": n <= self.warmup_steps}
+        tokens, seq = _tokens_of(batch)
+        if tokens:
+            tps = tokens / interval_s if interval_s > 0 else 0.0
+            ev["tokens"] = tokens
+            ev["tokens_per_sec"] = round(tps, 1)
+            fpt = self._flops_per_token(info, model, seq)
+            if fpt:
+                ev["mfu"] = round(tps * fpt / peak_flops(), 4)
+        self.last_event = ev
+        reg = self._reg
+        if reg is not None:
+            reg.counter(f"step[{site}].count").inc()
+            if not ev["warmup"]:
+                reg.histogram(f"step[{site}].interval_ms").observe(
+                    interval_s * 1e3)
+                if "tokens_per_sec" in ev:
+                    reg.gauge(f"step[{site}].tokens_per_sec").set(
+                        ev["tokens_per_sec"])
+                if "mfu" in ev:
+                    reg.gauge(f"step[{site}].mfu").set(ev["mfu"])
+        self._tel.emit(ev)
+
+    def _flops_per_token(self, info, model, seq):
+        # cached per site; recomputed only if the seq length changes
+        # (shape churn — which the sentinel is already yelling about)
+        if info["fpt"] is None or info["fpt_seq"] != seq:
+            info["fpt"] = flops_per_token_of(model, seq)
+            info["fpt_seq"] = seq
+        return info["fpt"]

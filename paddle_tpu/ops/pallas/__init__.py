@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import jax
 
+from ...core import compat as _compat
 from .. import dispatch
 from . import flash_attention as _fa
 
@@ -58,7 +59,7 @@ def _flash_attention_dispatch(q, k, v, causal=False, scale=None):
     spec = _flash_shard_spec(mesh, q, k)
     if spec is None:
         return _xla_fallback(q, k, v, causal, scale)
-    fn = jax.shard_map(
+    fn = _compat.shard_map(
         lambda q_, k_, v_: _fa.flash_attention(q_, k_, v_, causal=causal,
                                                scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

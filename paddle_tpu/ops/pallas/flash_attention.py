@@ -28,6 +28,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...core.compat import pallas_compiler_params as _pcp
+
 import os
 
 # Block sizes are tunable per hardware generation via PDTPU_FLASH_BLOCK_Q/K.
@@ -53,7 +55,7 @@ NEG_INF = -1e30
 LOG2E = math.log2(math.e)
 # grid = (batch, head, major-block, minor-block): only the innermost dim
 # carries the running-statistics dependency; the rest are parallel
-_DIMS = pltpu.CompilerParams(
+_DIMS = _pcp()(
     dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
 
 

@@ -51,6 +51,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...core.compat import pallas_compiler_params as _pcp
+
 DEFAULT_BLOCK_K2 = 1024     # 2-D path: packed rows per tile (= 2048 rows)
 DEFAULT_BLOCK_N = 256
 MAX_1D_K2 = 6144            # above this, full-K2 stripes blow VMEM
@@ -149,7 +151,7 @@ def int4_matmul(x, packed, scale, block_k2: int = DEFAULT_BLOCK_K2,
             ],
             out_specs=pl.BlockSpec((m, bn), lambda jn: (0, jn)),
             out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_pcp()(
                 dimension_semantics=("parallel",)),
             interpret=interpret,
         )(xe, xo, packed, s2)
@@ -168,7 +170,7 @@ def int4_matmul(x, packed, scale, block_k2: int = DEFAULT_BLOCK_K2,
         out_specs=pl.BlockSpec((m, bn), lambda jn, jk: (0, jn)),
         out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
         scratch_shapes=[pltpu.VMEM((m, bn), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_pcp()(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(xe, xo, packed, s2)

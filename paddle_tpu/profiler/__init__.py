@@ -157,6 +157,11 @@ class Profiler:
         self._recording = False
         self._device_tracing = False
         self._state = ProfilerState.CLOSED
+        # export dedupe: each record window fires on_trace_ready exactly
+        # once.  Without this, a window ending in RECORD_AND_RETURN whose
+        # next scheduled state is still recording (closed=0 back-to-back
+        # cycles) was exported by step() AND re-exported by stop().
+        self._window_exported = False
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -169,7 +174,11 @@ class Profiler:
         return self
 
     def stop(self):
-        if self._recording and self._on_trace_ready:
+        # export only a window step() has not already exported (and that
+        # has content): a stop() right after a RECORD_AND_RETURN boundary
+        # used to re-fire on_trace_ready for the same window
+        if self._recording and self._events and not self._window_exported \
+                and self._on_trace_ready:
             self._on_trace_ready(self)
         self._apply_state(ProfilerState.CLOSED)
         with _lock:
@@ -182,18 +191,29 @@ class Profiler:
         if self._recording and self._step_t0 is not None:
             self._events.append(_HostEvent(f"ProfileStep#{self._step}",
                                            self._step_t0, t1, 0))
+        fired = False
         if self._state == ProfilerState.RECORD_AND_RETURN and self._on_trace_ready:
             self._on_trace_ready(self)
+            self._window_exported = True
+            fired = True
         self._step += 1
         self._step_t0 = t1
         if self._schedule:
             self._apply_state(self._schedule(self._step))
+        if fired and self._recording:
+            # back-to-back record windows (closed=0 cycles): the exported
+            # window's events must not leak into — and be re-exported
+            # with — the next window
+            self._events = []
+            self._window_exported = False
 
     def _apply_state(self, state: ProfilerState):
         was_recording = self._recording
         self._state = state
         self._recording = state in (ProfilerState.RECORD,
                                     ProfilerState.RECORD_AND_RETURN)
+        if not was_recording and self._recording:
+            self._window_exported = False
         if self.timer_only:
             return
         want_device = self._recording and self.trace_dir is not None

@@ -150,6 +150,86 @@ def test_profiler_scheduler_gates_recording():
     p.stop()
 
 
+def test_make_scheduler_skip_first_and_repeat_edges():
+    from paddle_tpu.profiler import ProfilerState, make_scheduler
+    # repeat=0: cycles forever — the record window recurs every cycle
+    sch = make_scheduler(closed=1, ready=0, record=1)
+    assert [sch(i) for i in range(6)] == [
+        ProfilerState.CLOSED, ProfilerState.RECORD_AND_RETURN] * 3
+    # closed=0, ready=0: every step is a one-step record window
+    sch = make_scheduler(closed=0, ready=0, record=1)
+    assert sch(0) == sch(7) == ProfilerState.RECORD_AND_RETURN
+    # skip_first offsets the whole cycle train; repeat counts cycles
+    # AFTER the skip (reference semantics)
+    sch = make_scheduler(closed=0, ready=1, record=1, repeat=2,
+                         skip_first=3)
+    assert [sch(i) for i in range(3)] == [ProfilerState.CLOSED] * 3
+    assert sch(3) == ProfilerState.READY
+    assert sch(4) == ProfilerState.RECORD_AND_RETURN
+    assert sch(5) == ProfilerState.READY
+    assert sch(6) == ProfilerState.RECORD_AND_RETURN
+    assert sch(7) == ProfilerState.CLOSED          # repeat exhausted
+    # a multi-step record window: last step is RECORD_AND_RETURN
+    sch = make_scheduler(closed=0, ready=0, record=3, repeat=1)
+    assert [sch(i) for i in range(4)] == [
+        ProfilerState.RECORD, ProfilerState.RECORD,
+        ProfilerState.RECORD_AND_RETURN, ProfilerState.CLOSED]
+
+
+def test_profiler_window_exports_exactly_once():
+    """Regression: a RECORD_AND_RETURN boundary whose next scheduled
+    state is still recording (closed=0 back-to-back cycles) fired
+    on_trace_ready in step() AND again in stop() for the same window."""
+    from paddle_tpu import profiler as prof_mod
+    exports = []
+    p = prof_mod.Profiler(
+        timer_only=True,
+        scheduler=prof_mod.make_scheduler(closed=0, ready=0, record=1),
+        on_trace_ready=lambda prof: exports.append(prof._step))
+    p.start()
+    with prof_mod.RecordEvent("op"):
+        pass
+    p.step()          # window 0 exports here...
+    p.stop()          # ...and must NOT re-export it
+    assert exports == [0]
+
+
+def test_profiler_stop_still_exports_partial_window():
+    """stop() mid-window (no RECORD_AND_RETURN seen) keeps exporting —
+    the dedupe only suppresses the double fire."""
+    from paddle_tpu import profiler as prof_mod
+    exports = []
+    p = prof_mod.Profiler(timer_only=True,
+                          on_trace_ready=lambda prof: exports.append(1))
+    p.start()
+    with prof_mod.RecordEvent("op"):
+        pass
+    p.stop()
+    assert exports == [1]
+
+
+def test_profiler_chrome_trace_export_content(tmp_path):
+    import json as _json
+    from paddle_tpu import profiler as prof_mod
+    p = prof_mod.Profiler(timer_only=True).start()
+    with prof_mod.RecordEvent("fwd"):
+        with prof_mod.RecordEvent("attn"):
+            pass
+    p.step()
+    path = p.export(str(tmp_path / "trace.json"))
+    p.stop()
+    trace = _json.load(open(path))
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "fwd" in names and "attn" in names and "ProfileStep#0" in names
+    fwd = next(e for e in trace["traceEvents"] if e["name"] == "fwd")
+    attn = next(e for e in trace["traceEvents"] if e["name"] == "attn")
+    # chrome trace units are microseconds; nesting must be containment
+    assert fwd["dur"] >= attn["dur"] >= 0
+    assert fwd["ts"] <= attn["ts"]
+    with pytest.raises(ValueError):
+        p.export(str(tmp_path / "x.bin"), format="proto")
+
+
 def test_summary_table():
     from paddle_tpu import profiler as prof_mod
     p = prof_mod.Profiler(timer_only=True).start()

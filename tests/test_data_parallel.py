@@ -127,7 +127,8 @@ class TestNoSyncAccumulation:
         mesh = _mesh()
         dp, step = _make(wrap=True, mesh=mesh)
         state = step.init_state(0)
-        p0 = {k: np.asarray(v) for k, v in state["params"].items()}
+        # np.array (copy): the donated step reuses these buffers
+        p0 = {k: np.array(v) for k, v in state["params"].items()}
         with dp.no_sync():
             state, m = step(state, _batch(jax.random.key(2), 8))
         for k, v in state["params"].items():
@@ -174,7 +175,8 @@ class TestNoSyncScalerOverflow:
                                 use_dynamic_loss_scaling=dynamic)
         step = TrainStep(dp, loss_fn, opt, scaler=scaler)
         state = step.init_state(0)
-        p0 = {k: np.asarray(v) for k, v in state["params"].items()}
+        # np.array (copy): the donated step reuses these buffers
+        p0 = {k: np.array(v) for k, v in state["params"].items()}
         bad = _batch(jax.random.key(0), 8)
         bad["x"] = bad["x"].at[0, 0].set(jnp.inf)
         with dp.no_sync():

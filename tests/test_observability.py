@@ -1,0 +1,348 @@
+"""Runtime telemetry subsystem (paddle_tpu/observability): registry,
+sinks, StepMonitor math, recompile sentinel, collective accounting,
+preemption events.
+
+Reference capability: PaddlePaddle's profiler/monitor stack (SURVEY
+§5.5) — always-on runtime statistics.  Everything here runs on the CPU
+backend; MFU uses the nominal 1e12 cpu peak from observability/mfu.py.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import warnings
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.observability as obs
+from paddle_tpu.observability import _state as obs_state
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def telemetry():
+    sink = obs.InMemorySink()
+    tel = obs.enable(sinks=[sink], storm_threshold=2, storm_window_s=60.0)
+    yield tel, sink
+    obs.disable()
+
+
+@pytest.fixture(autouse=True)
+def _always_disabled_after():
+    yield
+    obs.disable()
+
+
+# -- registry ----------------------------------------------------------------
+
+def test_registry_counter_gauge():
+    reg = obs.MetricsRegistry()
+    reg.counter("c").inc()
+    reg.counter("c").inc(41)
+    reg.gauge("g").set(3.5)
+    assert reg.counter("c").value == 42
+    assert reg.gauge("g").value == 3.5
+    assert reg.snapshot()["c"] == 42
+
+
+def test_registry_kind_collision_raises():
+    reg = obs.MetricsRegistry()
+    reg.counter("x")
+    with pytest.raises(TypeError):
+        reg.gauge("x")
+
+
+def test_histogram_rolling_percentiles():
+    reg = obs.MetricsRegistry()
+    h = reg.histogram("h", window=1000)
+    for v in range(1, 101):   # 1..100
+        h.observe(v)
+    # nearest-rank: p50 = 50th smallest, p95 = 95th smallest
+    assert h.percentile(50) == 50
+    assert h.percentile(95) == 95
+    snap = reg.snapshot()["h"]
+    assert snap["count"] == 100 and snap["p50"] == 50 and snap["p95"] == 95
+    # rolling: a small window only sees the latest observations
+    h2 = obs.Histogram("h2", window=10)
+    for v in range(1, 101):
+        h2.observe(v)
+    assert h2.percentile(50) == 95  # window holds 91..100
+
+
+def test_registry_thread_safety():
+    reg = obs.MetricsRegistry()
+
+    def work():
+        for _ in range(2000):
+            reg.counter("n").inc()
+            reg.histogram("hh").observe(1.0)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert reg.counter("n").value == 16000
+    assert reg.histogram("hh").count == 16000
+
+
+# -- sinks -------------------------------------------------------------------
+
+def test_jsonl_sink_roundtrip(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    arr = jnp.float32(2.5)   # before enable: its jit is not an event
+    tel = obs.enable(jsonl_path=path)
+    tel.emit({"event": "custom", "n": 1, "arr": arr})
+    obs.disable()   # metrics snapshot + close
+    lines = [json.loads(l) for l in open(path)]
+    custom = next(l for l in lines if l["event"] == "custom")
+    assert custom["n"] == 1 and custom["arr"] == 2.5 and "ts" in custom
+    assert lines[-1]["event"] == "metrics"
+
+
+def test_disabled_by_default_and_hooks_clear():
+    assert not obs.enabled()
+    assert obs_state.MONITOR[0] is None
+    assert obs_state.COLLECTIVE[0] is None
+    assert obs_state.EMIT[0] is None
+    obs.emit_event("nothing")  # no-op, must not raise
+    tel = obs.enable()
+    assert obs.enabled() and obs_state.MONITOR[0] is tel.monitor
+    obs.disable()
+    assert not obs.enabled() and obs_state.MONITOR[0] is None
+
+
+# -- StepMonitor -------------------------------------------------------------
+
+def _tiny_trainstep():
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.jit import TrainStep
+    model = nn.Linear(8, 8)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    loss = lambda m, b: ((m(b["x"]) - b["y"]) ** 2).mean()
+    step = TrainStep(model, loss, opt)
+    state = step.init_state()
+    batch = {"x": jnp.ones((4, 8)), "y": jnp.zeros((4, 8))}
+    return step, state, batch
+
+
+def test_step_monitor_emits_step_events(telemetry):
+    tel, sink = telemetry
+    step, state, batch = _tiny_trainstep()
+    for _ in range(5):
+        state, _ = step(state, batch)
+    events = sink.events("step")
+    assert len(events) == 5
+    for ev in events:
+        assert ev["site"] == "TrainStep(Linear)"
+        assert ev["wall_ms"] > 0 and ev["interval_ms"] > 0
+        assert ev["tokens"] == 32                    # 4 x 8 batch
+        assert "tokens_per_sec" in ev and "mfu" in ev
+    assert events[0]["warmup"] is True               # compile step
+    assert events[-1]["warmup"] is False
+    # registry mirrors: count + rolling interval histogram
+    reg = tel.registry
+    assert reg.counter("step[TrainStep(Linear)].count").value == 5
+    assert reg.histogram("step[TrainStep(Linear)].interval_ms").count == 4
+
+
+def test_step_monitor_mfu_matches_bench_math(telemetry):
+    """Runtime MFU and bench.py's MFU use the same formula by
+    construction: recompute the event's mfu from its own tokens_per_sec
+    and the shared flops-per-token function."""
+    tel, sink = telemetry
+    from paddle_tpu import optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.llama import causal_lm_loss, llama
+    from paddle_tpu.observability.mfu import (causal_lm_flops_per_token,
+                                              peak_flops)
+    pt.seed(0)
+    model = llama("tiny", max_position_embeddings=16)
+    opt = optimizer.AdamW(learning_rate=1e-3,
+                          parameters=model.parameters())
+    step = TrainStep(model, causal_lm_loss, opt)
+    state = step.init_state(seed=0)
+    ids = jax.random.randint(jax.random.key(0), (2, 16), 0,
+                             model.cfg.vocab_size)
+    batch = {"input_ids": ids, "labels": jnp.roll(ids, -1, axis=1)}
+    for _ in range(5):
+        state, _ = step(state, batch)
+    events = sink.events("step")
+    assert len(events) >= 5    # the 5-step llama smoke contract
+    assert all("tokens_per_sec" in e and "mfu" in e for e in events)
+    ev = events[-1]
+    assert ev["tokens"] == 32                        # 2 x 16
+    fpt = causal_lm_flops_per_token(model.cfg.num_params(),
+                                    model.cfg.num_hidden_layers,
+                                    model.cfg.hidden_size, 16)
+    expect = ev["tokens_per_sec"] * fpt / peak_flops()
+    assert ev["mfu"] == pytest.approx(expect, rel=1e-3, abs=1e-4)
+
+
+def test_hapi_model_feeds_monitor(telemetry):
+    tel, sink = telemetry
+    from paddle_tpu import nn, optimizer
+    net = nn.Linear(4, 2)
+    model = pt.Model(net)
+    model.prepare(optimizer.SGD(learning_rate=0.1,
+                                parameters=net.parameters()),
+                  loss=lambda pred, label: ((pred - label) ** 2).mean())
+    x = jnp.ones((4, 4))
+    y = jnp.zeros((4, 2))
+    for _ in range(3):
+        model.train_batch([x], [y])
+    events = [e for e in sink.events("step")
+              if e["site"] == "hapi.Model(Linear)"]
+    assert len(events) == 3
+    assert events[-1]["tokens"] == 16                # 4 x 4 input
+
+
+def test_engine_fit_emits_steps_and_epochs(telemetry):
+    tel, sink = telemetry
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import nn, optimizer
+    model = nn.Linear(8, 8)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    loss = lambda m, b: ((m(b["x"]) - b["y"]) ** 2).mean()
+    engine = dist.Engine(model, loss=loss, optimizer=opt)
+    data = [{"x": jnp.ones((2, 8)), "y": jnp.zeros((2, 8))}] * 3
+    engine.fit(data, epochs=2)
+    steps = sink.events("step")
+    epochs = sink.events("epoch")
+    assert len(steps) == 6 and len(epochs) == 2
+    assert epochs[0]["steps"] == 3 and "loss" in epochs[0]
+
+
+# -- recompile sentinel ------------------------------------------------------
+
+def test_recompile_sentinel_counts_shape_change(telemetry):
+    tel, sink = telemetry
+    before = tel.sentinel.compiles()
+    f = jax.jit(lambda x: x * 2 + 1)
+    f(jnp.ones((3,)))
+    f(jnp.ones((3,)))        # cache hit: no compile
+    f(jnp.ones((5,)))        # shape change: recompile
+    assert tel.sentinel.compiles() - before >= 2
+    compiles = sink.events("compile")
+    assert len(compiles) >= 2
+    assert all(c["duration_ms"] >= 0 for c in compiles)
+    assert tel.registry.counter("compile.count").value >= 2
+
+
+def test_recompile_storm_warning(telemetry):
+    """The classic shape-churn failure: one jit site compiling on every
+    call trips the loud warning (threshold 2 in the fixture)."""
+    tel, sink = telemetry
+    f = jax.jit(lambda x: x + 1)
+    # inputs built OUTSIDE the scope: jnp.ones itself compiles per shape
+    # and those compiles must not be attributed to the churny site
+    xs = [jnp.ones((n,)) for n in (3, 5, 7, 9, 11)]
+    with pytest.warns(obs.RecompileStormWarning, match="recompile storm"):
+        with tel.sentinel.site("churny-step"):
+            for x in xs:
+                f(x)
+    storms = sink.events("recompile_storm")
+    assert storms and storms[0]["site"] == "churny-step"
+    assert storms[0]["compiles_after_warmup"] >= 2
+    assert tel.sentinel.compiles("churny-step") == 5
+
+
+def test_trainstep_shape_churn_attributed(telemetry):
+    """Shape churn THROUGH TrainStep is attributed to its site and
+    trips the storm warning without any manual site scope."""
+    tel, sink = telemetry
+    step, state, _ = _tiny_trainstep()
+    with pytest.warns(obs.RecompileStormWarning):
+        for b in (2, 3, 4, 5):   # batch-size churn: recompile per step
+            batch = {"x": jnp.ones((b, 8)), "y": jnp.zeros((b, 8))}
+            state, _ = step(state, batch)
+    sites = {c["site"] for c in sink.events("compile")}
+    assert "TrainStep(Linear)" in sites
+    storms = sink.events("recompile_storm")
+    assert any(s["site"] == "TrainStep(Linear)" for s in storms)
+
+
+def test_unattributed_compiles_do_not_storm(telemetry):
+    tel, sink = telemetry
+    f = jax.jit(lambda x: x - 1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", obs.RecompileStormWarning)
+        for n in (2, 3, 4, 5, 6):   # no site scope: counted, never warns
+            f(jnp.ones((n,)))
+    assert tel.sentinel.compiles() >= 5
+    assert not sink.events("recompile_storm")
+
+
+# -- collective accounting ---------------------------------------------------
+
+def test_collective_byte_counters(telemetry):
+    tel, sink = telemetry
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import fleet
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": jax.device_count()}
+    fleet.init(strategy=strategy)
+    try:
+        x = jnp.ones((4, 4), jnp.float32)
+        dist.all_reduce(x)
+        dist.all_reduce(x)
+        reg = tel.registry
+        assert reg.counter("collective.all_reduce.calls").value == 2
+        assert reg.counter("collective.all_reduce.bytes").value == 2 * 64
+        # paddle-style list signature: the payload is the SECOND arg (the
+        # first is the empty output list) — bytes must still be counted
+        out = []
+        dist.all_gather(out, x)
+        assert reg.counter("collective.all_gather.bytes").value == 64
+    finally:
+        fleet._reset()
+    obs.disable()
+    # snapshot carried into the final metrics event
+    snap = [e for e in sink.events("metrics")][-1]["metrics"]
+    assert snap["collective.all_reduce.bytes"] == 128
+
+
+# -- preemption events -------------------------------------------------------
+
+def test_preemption_event(telemetry):
+    tel, sink = telemetry
+    from paddle_tpu.launch.preempt import PreemptionGuard
+    saved = []
+    guard = PreemptionGuard(save_fn=lambda: saved.append(1))
+    with guard:
+        signal.raise_signal(signal.SIGTERM)
+        signal.raise_signal(signal.SIGTERM)   # repeat signal: one event
+    assert guard.preempted and saved == [1]
+    events = sink.events("preemption")
+    assert len(events) == 1
+    assert events[0]["reason"] == "SIGTERM"
+    assert "ts" in events[0] and "step" in events[0]
+
+
+# -- telemetry_report tool ---------------------------------------------------
+
+def test_telemetry_report_folds_jsonl(tmp_path, telemetry):
+    tel, sink = telemetry
+    path = str(tmp_path / "run.jsonl")
+    js = obs.JsonlSink(path)
+    tel.sinks.append(js)
+    step, state, batch = _tiny_trainstep()
+    for _ in range(4):
+        state, _ = step(state, batch)
+    tel.flush()
+    js.close()
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "telemetry_report.py"),
+         path], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "| TrainStep(Linear) |" in r.stdout
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["sites"]["TrainStep(Linear)"]["steps"] == 4
+    assert summary["compiles"]  # the TrainStep compile was attributed

@@ -20,7 +20,7 @@ def _mesh():
 
 class TestTensorMethodsSharded:
     def test_methods_inside_shard_map(self):
-        from jax import shard_map
+        from paddle_tpu.core.compat import shard_map
         mesh = _mesh()
 
         def block(x):

@@ -57,8 +57,10 @@ def test_train_step_matches_manual():
     state = step.init_state(seed=0)
     batch = _make_batch(jax.random.key(0))
 
-    # manual: value_and_grad + apply
-    params0 = {k: np.asarray(v) for k, v in state["params"].items()}
+    # manual: value_and_grad + apply.  np.array (copy), NOT np.asarray:
+    # jax CPU hands back zero-copy views, and the donated step below
+    # overwrites those buffers — the "before" params must be a snapshot
+    params0 = {k: np.array(v) for k, v in state["params"].items()}
     vag = pt.autograd.value_and_grad(model, lambda out, b: nn.functional.mse_loss(out, b["y"]))
     # build manual loss via functional call on the x input
     def manual_loss(p):

@@ -5,7 +5,7 @@ regression, or a sharding-memory regression fails ``pytest`` instead of
 waiting for a user (or a real pod OOM) to notice.
 
 Reference: the reference repo's CI stack (SURVEY §2.8 — API-approval diff
-job, op-benchmark job, model memory checks) — here collapsed into three
+job, op-benchmark job, model memory checks) — here collapsed into four
 in-repo gates over artifacts committed alongside the code:
 
   api-compat      tools/check_api_compat.py vs tools/api_spec.txt
@@ -20,8 +20,13 @@ in-repo gates over artifacts committed alongside the code:
                   a sharding spec or amp-dtype regression that would
                   re-break the proven memory fit.
 
+  telemetry-overhead  the disabled-observability train-step path stays
+                  zero-overhead (one falsy check — see
+                  paddle_tpu/observability/_state.py): registry/sink
+                  calls are poisoned and the dispatch cost is bounded
+
 Run all:  python tools/ci.py            (exit 0 = all gates pass)
-One:      python tools/ci.py --only api-compat|op-benchmark|memproof-lite
+One:      python tools/ci.py --only api-compat|op-benchmark|memproof-lite|telemetry-overhead
 """
 
 from __future__ import annotations
@@ -148,10 +153,79 @@ def gate_memproof_lite() -> int:
     return 0
 
 
+def gate_telemetry_overhead(iters: int = 100_000,
+                            budget_us: float = 10.0) -> int:
+    """The disabled-telemetry train-step path must stay zero-overhead.
+
+    Two checks, both deterministic:
+
+    1. POISON: with telemetry disabled (the default), a TrainStep call
+       must never touch the metrics registry or emit an event — the
+       registry methods and Telemetry.emit are monkeypatched to raise,
+       and a dispatch-only TrainStep (compiled fn stubbed out) is driven
+       through ``__call__``.  Accidentally hot-pathing the registry
+       fails loudly regardless of timing noise.
+    2. TIMING: the same dispatch-only ``__call__`` must average under
+       ``budget_us`` per call (measured ~1 µs; the contract is ONE falsy
+       hook-container check — see observability/_state.py).  A stray
+       per-step file write or lock acquisition blows the budget.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import time
+
+    import paddle_tpu.observability as obs
+    from paddle_tpu.jit import TrainStep
+
+    if obs.enabled():
+        print("telemetry-overhead gate FAILED: telemetry is enabled by "
+              "default — it must be opt-in")
+        return 1
+
+    # dispatch-only TrainStep: real __call__ code path, no XLA
+    step = TrainStep.__new__(TrainStep)
+    step.model = type("M", (), {"_grad_sync": True})()
+    step._accum = False
+    step.mesh = None
+    step._site = "TrainStep(M)"
+    step._compiled = lambda s, b, a: (s, {})
+
+    def boom(self, *a, **kw):
+        raise AssertionError(
+            "disabled-telemetry path touched the metrics registry / sinks")
+
+    saved = {}
+    poisoned = [(obs.MetricsRegistry, n) for n in
+                ("counter", "gauge", "histogram")] + \
+               [(obs.Telemetry, "emit")]
+    for cls, name in poisoned:
+        saved[(cls, name)] = getattr(cls, name)
+        setattr(cls, name, boom)
+    try:
+        state, batch = {"step": 0}, {"x": None}
+        step(state, batch)  # poison probe: one call is enough to detonate
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            step(state, batch)
+        per_call_us = (time.perf_counter() - t0) / iters * 1e6
+    finally:
+        for (cls, name), fn in saved.items():
+            setattr(cls, name, fn)
+    print(f"telemetry-overhead: disabled-path TrainStep dispatch "
+          f"{per_call_us:.2f} us/call (budget {budget_us:.0f} us)")
+    if per_call_us > budget_us:
+        print("telemetry-overhead gate FAILED: the disabled path grew a "
+              "measurable per-step cost — keep it to one falsy check "
+              "(observability/_state.py)")
+        return 1
+    print("telemetry-overhead gate OK")
+    return 0
+
+
 GATES = {
     "api-compat": gate_api_compat,
     "op-benchmark": gate_op_benchmark,
     "memproof-lite": gate_memproof_lite,
+    "telemetry-overhead": gate_telemetry_overhead,
 }
 
 

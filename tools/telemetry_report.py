@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Fold a telemetry JSONL stream into the docs/BENCH.md table format.
+
+Input: one or more JSONL files produced by ``paddle_tpu.observability``
+(a training run's sink, or bench.py's sidecar).  Output: markdown tables
+(per-site step stats, compile attribution, collective volume) on stdout,
+plus ONE JSON summary line on the last line — the same artifact
+convention every other tool in this repo follows.
+
+Pure stdlib on purpose: the report runs anywhere the JSONL landed (a CI
+box, a laptop) without jax or the framework installed.
+
+Usage:  python tools/telemetry_report.py run_telemetry.jsonl [more.jsonl]
+        python tools/telemetry_report.py --json run.jsonl   # JSON only
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from collections import defaultdict
+
+
+def _pct(sorted_vals, p):
+    """Nearest-rank percentile — the registry Histogram's convention."""
+    if not sorted_vals:
+        return None
+    rank = max(1, math.ceil(p / 100.0 * len(sorted_vals)))
+    return sorted_vals[min(rank, len(sorted_vals)) - 1]
+
+
+def load_events(paths):
+    events = []
+    for path in paths:
+        with open(path) as f:
+            for ln, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    print(f"warning: {path}:{ln}: unparseable line skipped",
+                          file=sys.stderr)
+    return events
+
+
+def summarize(events):
+    steps = defaultdict(lambda: {"n": 0, "warmup": 0, "intervals": [],
+                                 "tps": [], "mfu": [], "tokens": 0})
+    compiles = defaultdict(lambda: {"n": 0, "total_ms": 0.0})
+    storms, preemptions = [], []
+    last_metrics = None
+    bench_result = None
+    for e in events:
+        kind = e.get("event")
+        if kind == "step":
+            s = steps[e.get("site", "?")]
+            s["n"] += 1
+            s["tokens"] += e.get("tokens") or 0
+            if e.get("warmup"):
+                s["warmup"] += 1
+                continue
+            if e.get("interval_ms") is not None:
+                s["intervals"].append(e["interval_ms"])
+            if e.get("tokens_per_sec") is not None:
+                s["tps"].append(e["tokens_per_sec"])
+            if e.get("mfu") is not None:
+                s["mfu"].append(e["mfu"])
+        elif kind == "compile":
+            c = compiles[e.get("site", "?")]
+            c["n"] += 1
+            c["total_ms"] += e.get("duration_ms") or 0.0
+        elif kind == "recompile_storm":
+            storms.append(e)
+        elif kind == "preemption":
+            preemptions.append(e)
+        elif kind == "metrics":
+            last_metrics = e.get("metrics") or {}
+        elif kind == "bench_result":
+            bench_result = e
+    return steps, compiles, storms, preemptions, last_metrics, bench_result
+
+
+def render(steps, compiles, storms, preemptions, metrics):
+    lines = ["## Telemetry report", ""]
+    if steps:
+        lines += ["| Site | Steps | ms/step p50 | ms/step p95 | tok/s | MFU |",
+                  "|---|---|---|---|---|---|"]
+        for site, s in sorted(steps.items()):
+            iv = sorted(s["intervals"])
+            p50 = _pct(iv, 50)
+            p95 = _pct(iv, 95)
+            tps = (sum(s["tps"]) / len(s["tps"])) if s["tps"] else None
+            mfu = (sum(s["mfu"]) / len(s["mfu"])) if s["mfu"] else None
+
+            def fmt(v, nd=2):
+                return f"{v:.{nd}f}" if v is not None else "—"
+            lines.append(
+                f"| {site} | {s['n']} ({s['warmup']} warmup) | {fmt(p50)} "
+                f"| {fmt(p95)} | {fmt(tps, 1)} | {fmt(mfu, 4)} |")
+        lines.append("")
+    if compiles:
+        lines += ["| Compile site | Compiles | Total compile ms |",
+                  "|---|---|---|"]
+        for site, c in sorted(compiles.items()):
+            lines.append(f"| {site} | {c['n']} | {c['total_ms']:.1f} |")
+        lines.append("")
+    coll = {k: v for k, v in (metrics or {}).items()
+            if k.startswith("collective.") and "[" not in k}
+    if coll:
+        ops = sorted({k.split(".")[1] for k in coll})
+        lines += ["| Collective | Calls | Bytes |", "|---|---|---|"]
+        for op in ops:
+            lines.append(
+                f"| {op} | {coll.get(f'collective.{op}.calls', 0)} "
+                f"| {coll.get(f'collective.{op}.bytes', 0):,} |")
+        lines.append("")
+    for st in storms:
+        lines.append(f"**RECOMPILE STORM**: `{st.get('site')}` — "
+                     f"{st.get('compiles_after_warmup')} compiles beyond "
+                     f"warmup within {st.get('window_s')}s "
+                     "(see docs/OBSERVABILITY.md)")
+    for p in preemptions:
+        lines.append(f"**PREEMPTION**: {p.get('reason')} at step "
+                     f"{p.get('step')} (ts {p.get('ts')})")
+    if not (steps or compiles or coll or storms or preemptions):
+        lines.append("(no telemetry events found)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("paths", nargs="+", help="telemetry JSONL file(s)")
+    ap.add_argument("--json", action="store_true",
+                    help="print only the JSON summary line")
+    args = ap.parse_args(argv)
+
+    events = load_events(args.paths)
+    steps, compiles, storms, preemptions, metrics, bench = summarize(events)
+    if not args.json:
+        print(render(steps, compiles, storms, preemptions, metrics))
+    summary = {
+        "metric": "telemetry_report",
+        "events": len(events),
+        "sites": {site: {"steps": s["n"],
+                         "p50_ms": _pct(sorted(s["intervals"]), 50),
+                         "p95_ms": _pct(sorted(s["intervals"]), 95),
+                         "mean_mfu": (round(sum(s["mfu"]) / len(s["mfu"]), 4)
+                                      if s["mfu"] else None)}
+                  for site, s in sorted(steps.items())},
+        "compiles": {site: c["n"] for site, c in sorted(compiles.items())},
+        "storms": len(storms),
+        "preemptions": len(preemptions),
+    }
+    if bench is not None:
+        summary["bench_value"] = bench.get("value")
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
