@@ -66,6 +66,9 @@ def main():
     serial = np.asarray(model.generate(prompt,
                                        max_new_tokens=args.new_tokens,
                                        kv_cache_dtype="int8"))
+    # teacher-forced logits over the whole serial rollout: the numeric
+    # reference the TP run must match within tolerance (ADVICE r5)
+    serial_logits = np.asarray(model(jnp.asarray(serial)))
 
     if args.mp > 1:
         from paddle_tpu.distributed import fleet
@@ -78,10 +81,37 @@ def main():
             out = np.asarray(model.generate(prompt,
                                             max_new_tokens=args.new_tokens,
                                             kv_cache_dtype="int8"))
+            # the eager TP forward shards the batch over the data axes:
+            # tile the 2-row rollout up to a divisible batch, compare the
+            # original rows
+            import math
+            need = 1
+            for ax in ("dp", "sharding"):
+                if ax in hcg.mesh.shape:
+                    need *= hcg.mesh.shape[ax]
+            # tile to lcm(rows, need): reps*rows must be divisible by the
+            # data-axis product, not merely >= it (dp=3 vs 2 rows)
+            reps = need // math.gcd(serial.shape[0], need)
+            tiled = jnp.asarray(np.tile(serial, (reps, 1)))
+            tp_logits = np.asarray(model(tiled))[:serial.shape[0]]
         print(f"TP decode over mesh {dict(hcg.mesh.shape)}")
-        # greedy TP decode must be token-identical to the serial rollout
-        assert np.array_equal(out, serial), "TP decode diverged from serial"
-        print("TP greedy tokens == serial quantized rollout")
+        # ADVICE r5: the BINDING invariant is numeric — TP logits must
+        # match the serial logits within tolerance at every position of
+        # the serial rollout.  Greedy token identity is checked after,
+        # but psum reduction order can legitimately flip an argmax
+        # between two near-tied logits, so a token mismatch on top of
+        # in-tolerance logits is reported as a tie-break, not a failure.
+        np.testing.assert_allclose(
+            tp_logits, serial_logits, rtol=1e-2, atol=1e-2,
+            err_msg="TP logits diverged from serial beyond tolerance — "
+                    "a real TP numeric bug, not argmax tie-breaking")
+        mismatch = out != serial
+        if mismatch.any():
+            print(f"TP decode: {int(mismatch.sum())} token(s) differ from "
+                  "the serial rollout with logits in tolerance — psum "
+                  "reduction order flipped a near-tie argmax")
+        else:
+            print("TP greedy tokens == serial quantized rollout")
     else:
         out = serial
 

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability.spans import span as _span
+
 __all__ = ["save", "load", "save_state_dict", "load_state_dict",
            "async_save", "AsyncCheckpointer", "latest_checkpoint"]
 
@@ -72,19 +74,23 @@ def _from_host(obj, to_device: bool):
 def save(obj: Any, path: str, protocol: int = 4) -> None:
     """``paddle.save`` parity: pickle a (possibly nested) object, with array
     leaves materialised to host numpy."""
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        pickle.dump(_to_host(obj), f, protocol=protocol)
-    os.replace(tmp, path)  # atomic: no torn checkpoint on preemption
+    # span: ckpt I/O is where jobs wedge on dead filesystems — the
+    # span_begin breadcrumb makes that the last thing a hang dump shows
+    with _span("ckpt.save", path=path):
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(_to_host(obj), f, protocol=protocol)
+        os.replace(tmp, path)  # atomic: no torn checkpoint on preemption
 
 
 def load(path: str, return_numpy: bool = False) -> Any:
     """``paddle.load`` parity: returns device arrays by default, matching the
     reference (``return_numpy=True`` keeps host numpy)."""
-    with open(path, "rb") as f:
-        obj = pickle.load(f)
-    return _from_host(obj, to_device=not return_numpy)
+    with _span("ckpt.load", path=path):
+        with open(path, "rb") as f:
+            obj = pickle.load(f)
+        return _from_host(obj, to_device=not return_numpy)
 
 
 # ---------------------------------------------------------------------------
@@ -197,8 +203,9 @@ def save_state_dict(state_dict: Any, path: str, overwrite: bool = True) -> None:
 
     Every process writes only the shards it owns (lazily, one host copy at a
     time), so no rank ever materialises the full state."""
-    _write_entries(_snapshot_entries(state_dict, materialize=False),
-                   path, overwrite=overwrite)
+    with _span("ckpt.save_state_dict", path=path):
+        _write_entries(_snapshot_entries(state_dict, materialize=False),
+                       path, overwrite=overwrite)
 
 
 def _jsonable(x):
@@ -338,6 +345,11 @@ def load_state_dict(path: str, template: Any = None,
     - ``shardings``: optional ``{key: jax.sharding.Sharding}`` overriding /
       supplementing the template's shardings.
     """
+    with _span("ckpt.load_state_dict", path=path):
+        return _load_state_dict(path, template, shardings)
+
+
+def _load_state_dict(path, template, shardings):
     meta = _load_meta(path)
     readers = {k: _ShardReader(path, e) for k, e in meta["arrays"].items()}
 
@@ -414,7 +426,11 @@ class AsyncCheckpointer:
 
         def run():
             try:
-                _write_entries(entries, path)
+                # span from the writer thread: the begin breadcrumb marks
+                # the write in flight, so a wedged background save is
+                # attributed in a hang dump (its stack is there too)
+                with _span("ckpt.async_save", path=path):
+                    _write_entries(entries, path)
             except BaseException as e:
                 self._error = e
 

@@ -444,6 +444,7 @@ import functools as _functools
 
 from . import debug as _debug
 from ..observability import _state as _obs_state
+from ..observability.spans import span as _span, spans_active as _spans_active
 
 
 def _traced(fn, name):
@@ -451,9 +452,11 @@ def _traced(fn, name):
     def wrapper(tensor, *a, **kw):
         rec = _obs_state.COLLECTIVE[0]
         tracing = _debug.get_trace() is not None
+        label = None
         if tracing or rec is not None:
             grp = kw.get("group", kw.get("axis"))
             axes = _axis_tuple(grp) if not isinstance(grp, str) else (grp,)
+            label = ",".join(axes) if axes else "world"
             if tracing:
                 _debug.record(name, axes or ("world",),
                               getattr(tensor, "shape", None),
@@ -466,7 +469,18 @@ def _traced(fn, name):
                     # the payload is the second argument
                     payload = a[0] if a else kw.get("tensor", tensor)
                 rec(name, axes, payload)
-        return fn(tensor, *a, **kw)
+        # span OUTSIDE the hook gates (ckpt-style): the span_begin
+        # breadcrumb lands in the flight recorder BEFORE the collective
+        # blocks — so a wedged collective is the last thing a hang dump
+        # shows even with collectives=False — and the profiler bridge
+        # works without telemetry.  Same once-per-trace caveat as the
+        # byte counters for calls inside jit.  The spans_active() fast
+        # path keeps the fully-disabled cost at two falsy checks (no
+        # span or f-string construction).
+        if not _spans_active():
+            return fn(tensor, *a, **kw)
+        with _span(f"collective.{name}", axes=label):
+            return fn(tensor, *a, **kw)
     return wrapper
 
 

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from ..nn.layer import Layer
 from ..observability import _state as _obs_state
+from ..observability.spans import span as _span
 from . import fleet
 from .auto import _to_jax_mesh, shard_dataloader
 
@@ -107,16 +108,22 @@ class Engine:
         for epoch in range(epochs):
             loader = self._loader(train_data)
             i = -1
-            for i, batch in enumerate(loader):
-                # the step donates the state buffers: keep self._state
-                # pointing at the LIVE pytree so mid-fit evaluate() (and a
-                # user interrupt) never reads donated arrays.  Per-step
-                # telemetry (wall time, tokens/sec, MFU) is emitted by
-                # TrainStep.__call__ itself when observability is enabled.
-                self._state, metrics = self._step(self.state, batch)
-                if callback is not None and i % log_freq == 0:
-                    callback(epoch, i, {k: float(v)
-                                        for k, v in metrics.items()})
+            # epoch span: duration histogram + a chrome-trace slot in the
+            # same vocabulary as the per-step events
+            with _span("Engine.fit.epoch",
+                       site=getattr(self._step, "_site", None),
+                       epoch=epoch):
+                for i, batch in enumerate(loader):
+                    # the step donates the state buffers: keep self._state
+                    # pointing at the LIVE pytree so mid-fit evaluate()
+                    # (and a user interrupt) never reads donated arrays.
+                    # Per-step telemetry (wall time, tokens/sec, MFU) is
+                    # emitted by TrainStep.__call__ itself when
+                    # observability is enabled.
+                    self._state, metrics = self._step(self.state, batch)
+                    if callback is not None and i % log_freq == 0:
+                        callback(epoch, i, {k: float(v)
+                                            for k, v in metrics.items()})
             if valid_data is not None:
                 metrics["eval_loss"] = self.evaluate(valid_data)["loss"]
             emit = _obs_state.EMIT[0]

@@ -15,8 +15,8 @@ import jax
 import jax.numpy as jnp
 
 from .auto import Partial, Replicate, Shard
-from .communication import (all_gather, broadcast, get_rank,
-                            get_world_size, scatter)
+from .communication import (ReduceOp, all_gather, all_reduce, broadcast,
+                            get_rank, get_world_size, scatter)
 
 
 # ---------------------------------------------------------------------------
@@ -24,26 +24,35 @@ from .communication import (all_gather, broadcast, get_rank,
 # reference's _convert_object_to_tensor scheme)
 # ---------------------------------------------------------------------------
 
-def _padded_size(nbytes: int) -> int:
+def _padded_size(nbytes: int, group=None) -> int:
     """Collective byte-buffer size for an ``nbytes`` pickle: the next
-    256-byte multiple.  The reference sizes the tensor to the object
-    (ADVICE r4); small objects no longer move a fixed 1 MB and large
-    ones are no longer rejected.
+    256-byte multiple, MAX-REDUCED across the group's ranks (ADVICE r5).
 
-    Shape-agreement invariant: these object collectives run in the
-    single-controller SPMD model — one program, global (replicated)
-    objects on every rank (the ``scatter_object_list`` docstring
-    codifies this; there is no per-process-different-object path here,
-    unlike the reference's multi-process runtime).  Sizing from the
-    local pickle is therefore identical on all ranks by construction.
-    If a per-rank-payload path is ever added, it must first agree on a
-    size (max-reduce of lengths) before padding."""
-    return max(256, (nbytes + 255) // 256 * 256)
+    The reference sizes the tensor to the object (ADVICE r4); small
+    objects no longer move a fixed 1 MB and large ones are no longer
+    rejected.  In the single-controller SPMD model the local pickle is
+    identical on every rank by construction, so the max-reduce is a
+    cheap identity — but a per-rank-divergent payload (a bug today, a
+    multi-process object path tomorrow) now pads every rank to the
+    global maximum, so the byte collective runs with agreeing shapes
+    and the truth surfaces in the unpickled objects, instead of an XLA
+    shape mismatch (or silent corruption) downstream.  Explicit
+    ``max_bytes`` callers (scatter) keep the loud over-budget raise in
+    ``_obj_to_padded``."""
+    padded = max(256, (nbytes + 255) // 256 * 256)
+    try:
+        agreed = int(all_reduce(jnp.asarray(padded, jnp.int32),
+                                op=ReduceOp.MAX, group=group))
+    except Exception:
+        # no mesh / no parallel env: single-rank, local size is global
+        return padded
+    return max(padded, agreed)
 
 
-def _obj_to_padded(obj, max_bytes=None):
+def _obj_to_padded(obj, max_bytes=None, group=None):
     raw = pickle.dumps(obj)
-    size = max_bytes if max_bytes is not None else _padded_size(len(raw))
+    size = max_bytes if max_bytes is not None \
+        else _padded_size(len(raw), group=group)
     if len(raw) > size:
         raise ValueError(f"object of {len(raw)} bytes exceeds the "
                          f"{size}-byte object-collective budget")
@@ -63,15 +72,22 @@ def all_gather_object(object_list, obj, group=None):
     """Reference: paddle.distributed.all_gather_object — every rank
     contributes one picklable object; all ranks receive all of them."""
     gathered = []
-    all_gather(gathered, _obj_to_padded(obj), group=group)
+    all_gather(gathered, _obj_to_padded(obj, group=group), group=group)
     object_list.extend(_padded_to_obj(t) for t in gathered)
     return object_list
 
 
 def broadcast_object_list(object_list, src=0, group=None):
     """Reference: paddle.distributed.broadcast_object_list (in place)."""
+    if not object_list:
+        return object_list
+    # one group max-reduce over the local max, not one per element (the
+    # scatter path's convention); elements then share one buffer size
+    common = _padded_size(max(len(pickle.dumps(o)) for o in object_list),
+                          group=group)
     for i, obj in enumerate(object_list):
-        t = broadcast(_obj_to_padded(obj), src=src, group=group)
+        t = broadcast(_obj_to_padded(obj, max_bytes=common), src=src,
+                      group=group)
         object_list[i] = _padded_to_obj(t)
     return object_list
 
@@ -90,8 +106,10 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
             "rank — SPMD programs see the same global inputs (the "
             "reference's None-on-non-src convention does not apply)")
     # one shared buffer size: scatter stacks the buffers, so DIFFERENT
-    # objects (the whole point of scatter) must pad to the max pickle
-    common = max(_padded_size(len(pickle.dumps(o))) for o in in_object_list)
+    # objects (the whole point of scatter) must pad to the max pickle;
+    # one group max-reduce over the local max, not one per element
+    common = _padded_size(max(len(pickle.dumps(o)) for o in in_object_list),
+                          group=group)
     tensors = [_obj_to_padded(o, max_bytes=common) for o in in_object_list]
     got = scatter(None, tensor_list=tensors, src=src, group=group)
     if got is None:  # world of 1 (no comm context): src keeps its element

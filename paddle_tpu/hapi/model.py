@@ -18,6 +18,7 @@ import jax.numpy as jnp
 
 from ..nn.layer import Layer, functional_call, raw_params
 from ..observability import _state as _obs_state
+from ..observability.spans import span as _span
 from .callbacks import config_callbacks
 
 
@@ -211,16 +212,20 @@ class Model:
             for m in self._metrics:
                 m.reset()
             logs = {}
-            for step, batch in enumerate(loader):
-                cbks.on_train_batch_begin(step)
-                inputs, labels = self._split_batch(batch)
-                # loss stays a device array here; callbacks materialize it
-                # only when they actually log (log_freq / epoch end)
-                loss, metric_out = self._train_one(inputs, labels)
-                logs = {"loss": loss, **metric_out}
-                cbks.on_train_batch_end(step, logs)
-                if self.stop_training:
-                    break
+            # epoch span: duration histogram + chrome-trace slot sharing
+            # the per-step event vocabulary (docs/OBSERVABILITY.md)
+            with _span("hapi.fit.epoch", site=self._site, epoch=epoch):
+                for step, batch in enumerate(loader):
+                    cbks.on_train_batch_begin(step)
+                    inputs, labels = self._split_batch(batch)
+                    # loss stays a device array here; callbacks
+                    # materialize it only when they actually log
+                    # (log_freq / epoch end)
+                    loss, metric_out = self._train_one(inputs, labels)
+                    logs = {"loss": loss, **metric_out}
+                    cbks.on_train_batch_end(step, logs)
+                    if self.stop_training:
+                        break
             logs = {k: (float(v) if hasattr(v, "ndim") else v)
                     for k, v in logs.items()}
             cbks.on_epoch_end(epoch, logs)

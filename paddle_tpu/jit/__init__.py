@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core import random as prandom
 from ..nn.layer import Layer, functional_call, raw_params, trainable_mask
 from ..observability import _state as _obs_state
+from ..observability.spans import span as _span
 from . import control_flow
 from .control_flow import (GraphBreakError, case, cond, switch_case,
                            while_loop)
@@ -597,16 +598,20 @@ def save(fn, path: str, *example_args, input_spec=None):
         specs = [s if isinstance(s, InputSpec) else InputSpec(*s)
                  for s in input_spec]
         example_args = tuple(s.to_shape_struct() for s in specs)
-    exp = jexport.export(jitted)(*example_args)
-    with open(path + ".stablehlo", "wb") as f:
-        f.write(exp.serialize())
+    # span: AOT export traces + lowers the whole program — a multi-second
+    # cold op worth a first-class slot in the trace/JSONL vocabulary
+    with _span("jit.save", path=path):
+        exp = jexport.export(jitted)(*example_args)
+        with open(path + ".stablehlo", "wb") as f:
+            f.write(exp.serialize())
     return path + ".stablehlo"
 
 
 def load(path: str):
     from jax import export as jexport
-    with open(path if path.endswith(".stablehlo") else path + ".stablehlo", "rb") as f:
-        exp = jexport.deserialize(f.read())
+    with _span("jit.load", path=path):
+        with open(path if path.endswith(".stablehlo") else path + ".stablehlo", "rb") as f:
+            exp = jexport.deserialize(f.read())
     return TranslatedLayer(exp.call, path)
 
 

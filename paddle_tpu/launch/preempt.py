@@ -51,16 +51,28 @@ class PreemptionGuard:
         # signal only — the repeat SIGTERM before SIGKILL is not a new
         # preemption.  Guarded hard: a telemetry failure inside a signal
         # handler must never turn a graceful preemption into a crash.
-        if first and _obs_state.EMIT[0] is not None:
+        if not first:
+            return
+        try:
+            reason = signal.Signals(signum).name
+        except Exception:
+            reason = str(signum)
+        if _obs_state.EMIT[0] is not None:
             try:
-                try:
-                    reason = signal.Signals(signum).name
-                except Exception:
-                    reason = str(signum)
                 mon = _obs_state.MONITOR[0]
                 _obs_state.EMIT[0]({
                     "event": "preemption", "reason": reason,
                     "step": mon.total_steps if mon is not None else None})
+            except Exception:
+                pass
+        # drain the flight-recorder ring to the .postmortem file NOW: the
+        # grace window may not be honored (SIGKILL follows), and a killed
+        # run must never be blind.  write_postmortem never raises, but the
+        # hook read is guarded anyway — this is a signal frame.
+        pm = _obs_state.POSTMORTEM[0]
+        if pm is not None:
+            try:
+                pm(reason=f"preemption:{reason}")
             except Exception:
                 pass
 

@@ -15,6 +15,7 @@ recompute, vocab-parallel CE — all inside one jit program.
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from typing import Optional
 
 import jax
@@ -193,6 +194,16 @@ class GPTModel(Layer):
             raise ValueError(
                 f"recompute_num_layers={cfg.recompute_num_layers} must "
                 f"be in [1, num_hidden_layers={cfg.num_hidden_layers}]")
+        if cfg.recompute_num_layers is not None and not cfg.use_recompute \
+                and cfg.pipeline_stages <= 1:
+            # ADVICE r5: the partial-remat count only takes effect under
+            # use_recompute=True — say so instead of silently ignoring it
+            # (under pipeline the combination is rejected outright below)
+            warnings.warn(
+                f"recompute_num_layers={cfg.recompute_num_layers} is "
+                "ignored because use_recompute=False — set "
+                "use_recompute=True to remat the first N layers",
+                UserWarning, stacklevel=2)
         if cfg.pipeline_stages > 1:
             if cfg.recompute_num_layers is not None:
                 raise NotImplementedError(

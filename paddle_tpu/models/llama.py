@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import warnings
 from typing import Optional
 
 import jax
@@ -259,6 +260,16 @@ class LlamaModel(Layer):
             raise ValueError(
                 f"recompute_num_layers={cfg.recompute_num_layers} must be in "
                 f"[1, num_hidden_layers={cfg.num_hidden_layers}]")
+        if cfg.recompute_num_layers is not None and not cfg.use_recompute \
+                and cfg.pipeline_stages <= 1:
+            # ADVICE r5: the partial-remat count only takes effect under
+            # use_recompute=True — say so instead of silently ignoring it
+            # (under pipeline the combination is rejected outright below)
+            warnings.warn(
+                f"recompute_num_layers={cfg.recompute_num_layers} is "
+                "ignored because use_recompute=False — set "
+                "use_recompute=True to remat the first N layers",
+                UserWarning, stacklevel=2)
         if cfg.pipeline_stages > 1:
             if cfg.recompute_num_layers is not None:
                 raise NotImplementedError(

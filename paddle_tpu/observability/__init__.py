@@ -43,6 +43,9 @@ import time
 from typing import List, Optional
 
 from . import _state
+from .flight_recorder import (FlightRecorder, install_crash_hooks,  # noqa: F401
+                              uninstall_crash_hooks, write_postmortem)
+from .flight_recorder import _reset_postmortem, configure_postmortem
 from .mfu import (PEAK_BF16_FLOPS, causal_lm_flops_per_token,  # noqa: F401
                   dense_flops_per_token, flops_per_token_of, peak_flops)
 from .recompile import (BACKEND_COMPILE_EVENT, RecompileSentinel,  # noqa: F401
@@ -50,7 +53,9 @@ from .recompile import (BACKEND_COMPILE_EVENT, RecompileSentinel,  # noqa: F401
 from .registry import Counter, Gauge, Histogram, MetricsRegistry  # noqa: F401
 from .sinks import (InMemorySink, JsonlSink, Sink,  # noqa: F401
                     StdoutSink, _ProcessZeroGate)
+from .spans import _SpanHook, span  # noqa: F401
 from .step_monitor import StepMonitor  # noqa: F401
+from .watchdog import HangWarning, HangWatchdog  # noqa: F401
 
 _ACTIVE: List[Optional["Telemetry"]] = [None]
 
@@ -60,11 +65,15 @@ class Telemetry:
 
     def __init__(self, registry: MetricsRegistry, sinks: List[Sink],
                  monitor: Optional[StepMonitor],
-                 sentinel: Optional[RecompileSentinel]):
+                 sentinel: Optional[RecompileSentinel],
+                 recorder: Optional[FlightRecorder] = None,
+                 watchdog: Optional[HangWatchdog] = None):
         self.registry = registry
         self.sinks = list(sinks)
         self.monitor = monitor
         self.sentinel = sentinel
+        self.recorder = recorder
+        self.watchdog = watchdog
         # RLock, not Lock: the preemption SIGTERM handler emits from the
         # main thread, possibly interrupting an emit already holding the
         # lock — a plain Lock would self-deadlock the dying process
@@ -75,6 +84,11 @@ class Telemetry:
         come from the trainer thread and the compile listener at once)."""
         if "ts" not in event:
             event = {"ts": round(time.time(), 3), **event}
+        # the flight ring sees every event, BEFORE the sink lock: a sink
+        # wedged on a dead filesystem must not starve the post-mortem ring
+        rec = self.recorder
+        if rec is not None:
+            rec.record_event(event)
         with self._lock:
             for s in self.sinks:
                 try:
@@ -109,6 +123,15 @@ def get_telemetry() -> Optional[Telemetry]:
 def get_registry() -> Optional[MetricsRegistry]:
     tel = _ACTIVE[0]
     return tel.registry if tel is not None else None
+
+
+def get_flight_recorder() -> Optional[FlightRecorder]:
+    return _state.RECORDER[0]
+
+
+def get_watchdog() -> Optional[HangWatchdog]:
+    tel = _ACTIVE[0]
+    return tel.watchdog if tel is not None else None
 
 
 def emit_event(event: str, **fields) -> None:
@@ -162,7 +185,13 @@ def enable(jsonl_path: Optional[str] = None, stdout: bool = False,
            sentinel_warmup: int = 1, storm_threshold: int = 3,
            storm_window_s: float = 60.0, storm_all_sites: bool = False,
            all_processes: bool = False,
-           registry: Optional[MetricsRegistry] = None) -> Telemetry:
+           registry: Optional[MetricsRegistry] = None,
+           flight_recorder: bool = True,
+           flight_recorder_capacity: int = 256,
+           spans: bool = True, crash_hooks: bool = True,
+           postmortem_path: Optional[str] = None,
+           watchdog_s: Optional[float] = None, on_hang=None,
+           watchdog_abort: bool = False) -> Telemetry:
     """Turn telemetry on (replacing any active session) and return the
     ``Telemetry`` handle.
 
@@ -170,7 +199,26 @@ def enable(jsonl_path: Optional[str] = None, stdout: bool = False,
     at least inspectable via ``get_telemetry().sinks[0]``.  File/stdout
     sinks only write on process 0 unless ``all_processes=True``;
     in-memory sinks are never gated.
+
+    Crash/hang diagnostics (docs/OBSERVABILITY.md): ``flight_recorder``
+    keeps the last ``flight_recorder_capacity`` events/breadcrumbs in a
+    ring even when sinks are off; ``crash_hooks`` drains it to
+    ``postmortem_path`` (default ``<jsonl_path>.postmortem``, else
+    ``run.postmortem``) on unhandled exceptions / ``sys.exit`` mid-run /
+    SIGQUIT — call ``disable()`` for a clean shutdown without a dump.
+    ``watchdog_s`` starts a :class:`HangWatchdog` with that deadline;
+    ``on_hang`` (callable) and ``watchdog_abort`` pick the escalation
+    beyond the warning+dump.  ``spans`` installs the ``span(...)`` hook
+    (per-span events + ``span[<name>].ms`` histograms).
     """
+    # validate BEFORE any side effect: raising after disable()/sink
+    # creation/sentinel install would leak a registered jax.monitoring
+    # listener with no _ACTIVE session to tear it down
+    if watchdog_s and not flight_recorder:
+        raise ValueError(
+            "watchdog_s needs the flight recorder: its ring beat is "
+            "the liveness signal — drop flight_recorder=False or run "
+            "a standalone HangWatchdog with manual beat()s")
     disable()
     out: List[Sink] = list(sinks) if sinks else []
     file_sinks: List[Sink] = []
@@ -193,7 +241,9 @@ def enable(jsonl_path: Optional[str] = None, stdout: bool = False,
         out = [InMemorySink(maxlen=65536)]
 
     reg = registry if registry is not None else MetricsRegistry()
-    tel = Telemetry(reg, out, None, None)
+    rec = FlightRecorder(flight_recorder_capacity) if flight_recorder \
+        else None
+    tel = Telemetry(reg, out, None, None, recorder=rec)
     sent = None
     if recompile_sentinel:
         sent = RecompileSentinel(tel, reg, warmup=sentinel_warmup,
@@ -206,10 +256,29 @@ def enable(jsonl_path: Optional[str] = None, stdout: bool = False,
         tel.monitor = StepMonitor(tel, reg, sentinel=sent,
                                   warmup_steps=warmup_steps)
 
+    pm_path = postmortem_path or (
+        jsonl_path + ".postmortem" if jsonl_path else None)
+    if rec is not None:
+        configure_postmortem(pm_path, recorder=rec,
+                             registry_fn=reg.snapshot)
+        if crash_hooks:
+            install_crash_hooks()
+    if watchdog_s:
+        tel.watchdog = HangWatchdog(
+            deadline_s=watchdog_s, recorder=rec, registry=reg,
+            emit=tel.emit, postmortem_path=pm_path, on_hang=on_hang,
+            abort=watchdog_abort)
+
     _ACTIVE[0] = tel
     _state.MONITOR[0] = tel.monitor
     _state.EMIT[0] = tel.emit
     _state.COLLECTIVE[0] = _record_collective if collectives else None
+    _state.RECORDER[0] = rec
+    if spans:
+        _state.SPAN[0] = _SpanHook(registry=reg, emit=tel.emit,
+                                   recorder=rec)
+    if tel.watchdog is not None:
+        tel.watchdog.start()
     return tel
 
 
@@ -222,7 +291,14 @@ def disable() -> None:
     _state.MONITOR[0] = None
     _state.COLLECTIVE[0] = None
     _state.EMIT[0] = None
+    _state.SPAN[0] = None
+    _state.RECORDER[0] = None
     _ACTIVE[0] = None
+    if tel.watchdog is not None:
+        tel.watchdog.stop()
+    # a clean disable() means the run ended on purpose: no atexit dump
+    uninstall_crash_hooks()
+    _reset_postmortem()
     if tel.sentinel is not None:
         tel.sentinel.uninstall()
     try:

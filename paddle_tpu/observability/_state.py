@@ -28,3 +28,18 @@ COLLECTIVE = [None]
 # callable(event_dict) (Telemetry.emit) or None. Read by
 # launch.preempt's signal handler and distributed.Engine.fit.
 EMIT = [None]
+
+# FlightRecorder instance, or None. Read by cold-path breadcrumb
+# producers (ckpt save/load, the watchdog, crash hooks); hot paths feed
+# it through MONITOR/SPAN so their disabled cost stays one falsy check.
+RECORDER = [None]
+
+# spans._SpanHook instance, or None. Read by every ``span(...)`` scope
+# (ckpt, Engine.fit epochs, eager collectives, jit AOT export).
+SPAN = [None]
+
+# callable(reason=...) -> path|None (flight_recorder.write_postmortem)
+# or None. Read by launch.preempt's signal handler so a preempted run
+# drains the flight-recorder ring without importing anything inside a
+# signal frame.
+POSTMORTEM = [None]

@@ -28,6 +28,7 @@ import time
 from typing import Any, Callable, Optional
 
 from .mfu import flops_per_token_of, peak_flops
+from .spans import span
 
 __all__ = ["StepMonitor"]
 
@@ -83,14 +84,23 @@ class StepMonitor:
 
     def timed_step(self, site: str, model, batch,
                    thunk: Callable[[], Any]):
-        """Run one training step under timing + compile attribution."""
+        """Run one training step under timing + compile attribution.
+
+        The step runs inside an ``emit=False`` span: the ``step`` event
+        already carries the numbers, but the span's ``span_begin``
+        breadcrumb (BEFORE the thunk — a wedged step must beat on entry,
+        then go visibly silent) feeds the flight recorder / hang
+        watchdog, and the profiler bridge puts the site name on the
+        chrome-trace host timeline while a Profiler is recording.
+        """
         sent = self.sentinel
         t0 = time.perf_counter()
-        if sent is not None:
-            with sent.site(site):
+        with span(site, emit=False):
+            if sent is not None:
+                with sent.site(site):
+                    out = thunk()
+            else:
                 out = thunk()
-        else:
-            out = thunk()
         t1 = time.perf_counter()
         self._record(site, model, batch, t0, t1)
         return out

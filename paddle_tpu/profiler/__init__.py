@@ -26,7 +26,8 @@ from typing import Callable, Iterable, List, Optional, Tuple
 import jax
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
-           "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+           "active_profilers", "is_recording"]
 
 
 class ProfilerState(Enum):
@@ -55,6 +56,22 @@ class _HostEvent:
 
 _active_profilers: List["Profiler"] = []
 _lock = threading.Lock()
+
+
+def active_profilers() -> List["Profiler"]:
+    """Profilers between ``start()`` and ``stop()`` (any scheduler state).
+
+    ``observability.span`` keys its chrome-trace bridge off this list —
+    the same names flow to the always-on JSONL stream and the deep-dive
+    trace (docs/OBSERVABILITY.md, "Trace spans")."""
+    with _lock:
+        return list(_active_profilers)
+
+
+def is_recording() -> bool:
+    """True while any active profiler is in a RECORD window."""
+    with _lock:
+        return any(p._recording for p in _active_profilers)
 
 
 class RecordEvent:

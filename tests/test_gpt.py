@@ -446,3 +446,7 @@ def test_gpt_partial_remat_num_layers():
     assert count(use_recompute=True, recompute_num_layers=2) == 2
     with pytest.raises(ValueError, match="recompute_num_layers"):
         count(use_recompute=True, recompute_num_layers=9)
+    # ADVICE r5: set without use_recompute → warn, not silently ignore
+    with pytest.warns(UserWarning, match="ignored because "
+                                         "use_recompute=False"):
+        assert count(use_recompute=False, recompute_num_layers=2) == 0

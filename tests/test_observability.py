@@ -27,9 +27,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.fixture
-def telemetry():
+def telemetry(tmp_path):
     sink = obs.InMemorySink()
-    tel = obs.enable(sinks=[sink], storm_threshold=2, storm_window_s=60.0)
+    # postmortem path pinned into tmp (the preemption test drains the
+    # ring); crash hooks off — pytest owns excepthook/atexit
+    tel = obs.enable(sinks=[sink], storm_threshold=2, storm_window_s=60.0,
+                     postmortem_path=str(tmp_path / "t.postmortem"),
+                     crash_hooks=False)
     yield tel, sink
     obs.disable()
 
@@ -112,11 +116,18 @@ def test_disabled_by_default_and_hooks_clear():
     assert obs_state.MONITOR[0] is None
     assert obs_state.COLLECTIVE[0] is None
     assert obs_state.EMIT[0] is None
+    assert obs_state.SPAN[0] is None
+    assert obs_state.RECORDER[0] is None
+    assert obs_state.POSTMORTEM[0] is None
     obs.emit_event("nothing")  # no-op, must not raise
-    tel = obs.enable()
+    tel = obs.enable(crash_hooks=False)
     assert obs.enabled() and obs_state.MONITOR[0] is tel.monitor
+    assert obs_state.RECORDER[0] is tel.recorder
+    assert obs_state.SPAN[0] is not None
     obs.disable()
     assert not obs.enabled() and obs_state.MONITOR[0] is None
+    assert obs_state.SPAN[0] is None and obs_state.RECORDER[0] is None
+    assert obs_state.POSTMORTEM[0] is None
 
 
 # -- StepMonitor -------------------------------------------------------------
@@ -326,6 +337,306 @@ def test_preemption_event(telemetry):
     assert "ts" in events[0] and "step" in events[0]
 
 
+def test_preemption_drains_postmortem(telemetry, tmp_path):
+    """The first SIGTERM drains the flight ring to the .postmortem file
+    from inside the signal handler — a preempted run is never blind even
+    if the SIGKILL follow-up lands before the grace window ends."""
+    tel, sink = telemetry
+    from paddle_tpu.launch.preempt import PreemptionGuard
+    tel.emit({"event": "custom", "marker": 17})
+    with PreemptionGuard():
+        signal.raise_signal(signal.SIGTERM)
+    pm_path = tmp_path / "t.postmortem"   # fixture-pinned path
+    assert pm_path.exists()
+    lines = [json.loads(l) for l in open(pm_path)]
+    assert lines[0]["event"] == "postmortem"
+    assert lines[0]["reason"] == "preemption:SIGTERM"
+    kinds = [l["event"] for l in lines]
+    assert "thread_stack" in kinds and "metrics" in kinds
+    assert any(l.get("marker") == 17 for l in lines)   # ring drained
+    # the preemption event itself was emitted first, so it is in the ring
+    assert any(l.get("event") == "preemption" for l in lines)
+
+
+# -- flight recorder ---------------------------------------------------------
+
+def test_flight_recorder_ring_bounded():
+    rec = obs.FlightRecorder(capacity=8)
+    for i in range(50):
+        rec.record("beat", i=i)
+    assert len(rec) == 8 and rec.total == 50
+    events = rec.snapshot()
+    assert [e["i"] for e in events] == list(range(42, 50))
+    assert rec.age_s() < 5.0
+
+
+def test_flight_recorder_sees_events_and_breadcrumbs(telemetry):
+    """Every emitted event lands in the ring, and the step span leaves
+    begin breadcrumbs even though the step event carries the numbers."""
+    tel, sink = telemetry
+    step, state, batch = _tiny_trainstep()
+    for _ in range(2):
+        state, _ = step(state, batch)
+    rec = obs.get_flight_recorder()
+    assert rec is tel.recorder and rec is not None
+    kinds = [e["event"] for e in rec.snapshot()]
+    assert "step" in kinds           # emitted event recorded
+    assert "span_begin" in kinds     # breadcrumb BEFORE the step ran
+    begins = [e for e in rec.snapshot() if e["event"] == "span_begin"]
+    assert any(e["name"] == "TrainStep(Linear)" for e in begins)
+
+
+# -- trace spans -------------------------------------------------------------
+
+def test_span_disabled_is_noop():
+    assert obs_state.SPAN[0] is None
+    with obs.span("nothing"):
+        pass                          # no telemetry, no profiler: no-op
+
+
+def test_span_event_registry_breadcrumb(telemetry):
+    tel, sink = telemetry
+    with obs.span("my.op", tag="x"):
+        pass
+    ev = sink.events("span")
+    assert len(ev) == 1
+    assert ev[0]["name"] == "my.op" and ev[0]["tag"] == "x"
+    assert ev[0]["ms"] >= 0
+    assert tel.registry.histogram("span[my.op].ms").count == 1
+    kinds = [e["event"] for e in tel.recorder.snapshot()]
+    assert "span_begin" in kinds
+    # emitted span event is in the ring once (no duplicate span_end)
+    assert kinds.count("span") == 1 and "span_end" not in kinds
+
+
+def test_span_feeds_profiler_chrome_trace(tmp_path):
+    """The profiler bridge works WITHOUT telemetry: a span inside a
+    recording Profiler lands on the host timeline under the same name —
+    one vocabulary for JSONL and the deep-dive trace."""
+    from paddle_tpu import profiler
+    assert not obs.enabled()
+    prof = profiler.Profiler(timer_only=True)
+    prof.start()
+    assert profiler.is_recording()
+    with obs.span("bridge.op"):
+        pass
+    rows = {r[0] for r in prof.aggregate()}
+    assert "bridge.op" in rows
+    path = str(tmp_path / "trace.json")
+    prof.export(path)
+    prof.stop()
+    names = {e["name"] for e in profiler.load_profiler_result(path)["traceEvents"]}
+    assert "bridge.op" in names
+
+
+def test_ckpt_and_collective_spans(telemetry, tmp_path):
+    tel, sink = telemetry
+    import paddle_tpu as pt
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import fleet
+    path = str(tmp_path / "obj.pd")
+    pt.save({"w": jnp.ones((3,))}, path)
+    pt.load(path)
+    names = [e["name"] for e in sink.events("span")]
+    assert "ckpt.save" in names and "ckpt.load" in names
+    # eager collective span: begin breadcrumb lands before the op blocks
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": jax.device_count()}
+    fleet.init(strategy=strategy)
+    try:
+        dist.all_reduce(jnp.ones((2, 2)))
+    finally:
+        fleet._reset()
+    names = [e["name"] for e in sink.events("span")]
+    assert "collective.all_reduce" in names
+    begins = [e["name"] for e in tel.recorder.snapshot()
+              if e["event"] == "span_begin"]
+    assert "collective.all_reduce" in begins
+
+
+def test_engine_epoch_span(telemetry):
+    tel, sink = telemetry
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import nn, optimizer
+    model = nn.Linear(8, 8)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    loss = lambda m, b: ((m(b["x"]) - b["y"]) ** 2).mean()
+    engine = dist.Engine(model, loss=loss, optimizer=opt)
+    data = [{"x": jnp.ones((2, 8)), "y": jnp.zeros((2, 8))}] * 2
+    engine.fit(data, epochs=2)
+    spans = [e for e in sink.events("span")
+             if e["name"] == "Engine.fit.epoch"]
+    assert len(spans) == 2 and spans[1]["epoch"] == 1
+
+
+# -- hang watchdog -----------------------------------------------------------
+
+def test_watchdog_fires_on_wedged_step(telemetry, tmp_path):
+    """Acceptance: a wedged fake step trips the watchdog within its
+    deadline and the post-mortem holds thread stacks, the last-N flight
+    events, and a registry snapshot."""
+    tel, sink = telemetry
+    import time
+    pm = str(tmp_path / "hang.postmortem")
+    wd = obs.HangWatchdog(deadline_s=0.3, recorder=tel.recorder,
+                          registry=tel.registry, emit=tel.emit,
+                          postmortem_path=pm)
+    wd.start()
+    try:
+        tel.registry.counter("sentinel.metric").inc(5)
+
+        def wedged():
+            time.sleep(1.0)       # > deadline: the step enters, then hangs
+            return None, {}
+
+        tel.monitor.timed_step("TrainStep(Wedged)", None,
+                               {"x": jnp.ones((2, 4))}, wedged)
+    finally:
+        wd.stop()
+    assert wd.fired == 1          # one dump per stall episode
+    assert wd.last_dump == pm and os.path.exists(pm)
+    lines = [json.loads(l) for l in open(pm)]
+    head = lines[0]
+    assert head["event"] == "postmortem" and "hang" in head["reason"]
+    stacks = [l for l in lines if l["event"] == "thread_stack"]
+    assert stacks
+    # the wedged thread's stack shows WHERE it is stuck
+    assert any("wedged" in "\n".join(s["frames"]) for s in stacks)
+    # flight ring drained: the step's begin breadcrumb is the last beat
+    begins = [l for l in lines if l.get("event") == "span_begin"]
+    assert any(b["name"] == "TrainStep(Wedged)" for b in begins)
+    # registry snapshot present
+    metrics = [l for l in lines if l.get("event") == "metrics"]
+    assert metrics and metrics[-1]["metrics"]["sentinel.metric"] == 5
+    # the hang event reached the sinks too
+    hangs = sink.events("hang")
+    assert hangs and hangs[0]["postmortem"] == pm
+
+
+def test_watchdog_enable_wiring_and_rearm(tmp_path):
+    import time
+    sink = obs.InMemorySink()
+    pm = str(tmp_path / "wd.postmortem")
+    hangs = []
+    tel = obs.enable(sinks=[sink], crash_hooks=False, watchdog_s=0.25,
+                     postmortem_path=pm, on_hang=hangs.append)
+    try:
+        assert tel.watchdog is not None and obs.get_watchdog() is tel.watchdog
+        time.sleep(0.7)
+        assert tel.watchdog.fired == 1     # stalled: exactly one dump
+        assert hangs and hangs[0] is tel.watchdog
+        with obs.span("progress"):          # beat: re-arms the watchdog
+            pass
+        time.sleep(0.6)
+        assert tel.watchdog.fired == 2     # second stall, second dump
+    finally:
+        obs.disable()
+    assert tel.watchdog._thread is None    # disable() stopped the thread
+    assert os.path.exists(pm)
+
+
+def test_enable_watchdog_requires_recorder_validates_first(telemetry):
+    tel, sink = telemetry
+    with pytest.raises(ValueError, match="flight recorder"):
+        obs.enable(flight_recorder=False, watchdog_s=1.0)
+    # validated BEFORE any side effect: the active session survives, no
+    # extra compile listener / sink was created and leaked
+    assert obs.get_telemetry() is tel
+
+
+def test_watchdog_manual_beat_prevents_fire():
+    import time
+    wd = obs.HangWatchdog(deadline_s=0.3, poll_s=0.05,
+                          recorder=obs.FlightRecorder())
+    wd.start()
+    try:
+        for _ in range(10):
+            time.sleep(0.1)
+            wd.beat()
+        assert wd.fired == 0
+    finally:
+        wd.stop()
+
+
+# -- crash post-mortems ------------------------------------------------------
+
+def test_write_postmortem_contents(tmp_path):
+    from paddle_tpu.observability.flight_recorder import write_postmortem
+    rec = obs.FlightRecorder(capacity=4)
+    for i in range(6):
+        rec.record("crumb", i=i)
+    reg = obs.MetricsRegistry()
+    reg.counter("c").inc(3)
+    path = str(tmp_path / "pm.postmortem")
+    out = write_postmortem(reason="test", path=path, recorder=rec,
+                           registry_fn=reg.snapshot)
+    assert out == path
+    lines = [json.loads(l) for l in open(path)]
+    assert lines[0]["reason"] == "test" and lines[0]["pid"] == os.getpid()
+    meta = next(l for l in lines if l["event"] == "flight_recorder")
+    assert meta["recorded"] == 4 and meta["total"] == 6
+    crumbs = [l for l in lines if l["event"] == "crumb"]
+    assert [c["i"] for c in crumbs] == [2, 3, 4, 5]   # last-N only
+    assert lines[-1]["metrics"]["c"] == 3
+
+
+_CRASH_SCRIPT = """
+import sys
+sys.path.insert(0, {repo!r})
+import paddle_tpu.observability as obs
+tel = obs.enable(jsonl_path={jsonl!r})
+tel.emit({{"event": "custom", "marker": 23}})
+{death}
+"""
+
+
+@pytest.mark.parametrize("death,reason,rc", [
+    ("raise RuntimeError('boom')", "unhandled_exception", 1),
+    ("sys.exit(7)", "atexit", 7),
+])
+def test_hard_exit_leaves_postmortem(tmp_path, death, reason, rc):
+    """Acceptance: a run that dies mid-stream (unhandled exception, or a
+    bare sys.exit) still leaves a readable .postmortem next to its JSONL
+    — a killed run is never blind."""
+    jsonl = str(tmp_path / "run.jsonl")
+    r = subprocess.run(
+        [sys.executable, "-c",
+         _CRASH_SCRIPT.format(repo=REPO, jsonl=jsonl, death=death)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == rc, r.stderr
+    pm = jsonl + ".postmortem"
+    assert os.path.exists(pm)
+    lines = [json.loads(l) for l in open(pm)]
+    assert lines[0]["event"] == "postmortem"
+    assert lines[0]["reason"] == reason
+    if reason == "unhandled_exception":
+        assert lines[0]["exception"]["message"] == "boom"
+    kinds = [l["event"] for l in lines]
+    assert "thread_stack" in kinds and "metrics" in kinds
+    assert any(l.get("marker") == 23 for l in lines)
+    # the post-mortem is itself a telemetry_report-readable stream
+    rep = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "telemetry_report.py"),
+         "--json", pm], capture_output=True, text=True, timeout=60)
+    assert rep.returncode == 0, rep.stderr
+    summary = json.loads(rep.stdout.strip().splitlines()[-1])
+    assert summary["postmortems"] == [reason]
+    assert summary["thread_stacks"] >= 1
+
+
+def test_clean_disable_means_no_postmortem(tmp_path):
+    """obs.disable() is the clean-shutdown signal: no dump on exit."""
+    jsonl = str(tmp_path / "clean.jsonl")
+    script = _CRASH_SCRIPT.format(repo=REPO, jsonl=jsonl,
+                                  death="obs.disable()")
+    r = subprocess.run([sys.executable, "-c", script],
+                       capture_output=True, text=True, timeout=120,
+                       env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr
+    assert not os.path.exists(jsonl + ".postmortem")
+
+
 # -- telemetry_report tool ---------------------------------------------------
 
 def test_telemetry_report_folds_jsonl(tmp_path, telemetry):
@@ -346,3 +657,44 @@ def test_telemetry_report_folds_jsonl(tmp_path, telemetry):
     summary = json.loads(r.stdout.strip().splitlines()[-1])
     assert summary["sites"]["TrainStep(Linear)"]["steps"] == 4
     assert summary["compiles"]  # the TrainStep compile was attributed
+    assert summary["malformed_lines"] == 0
+
+
+def test_telemetry_report_truncated_and_malformed_lines(tmp_path):
+    """A crash cuts the JSONL mid-line: the reporter must skip, COUNT,
+    and report damaged lines — and still summarize what survived."""
+    path = str(tmp_path / "cut.jsonl")
+    good = {"event": "step", "site": "S", "step": 1, "wall_ms": 2.0,
+            "interval_ms": 2.0, "warmup": False}
+    with open(path, "w") as f:
+        f.write(json.dumps(good) + "\n")
+        f.write(json.dumps({"event": "span", "name": "ckpt.save",
+                            "ms": 3.25}) + "\n")
+        cut = json.dumps({**good, "step": 2})
+        f.write(cut[:len(cut) // 2] + "\n")     # crash-truncated line
+        f.write("not json at all\n")            # garbage
+        f.write("1234\n")                       # parses, but not an event
+        f.write("\n")                           # blank: NOT damage
+        f.write(json.dumps({**good, "step": 3}) + "\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "telemetry_report.py"),
+         path], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr           # must not raise
+    assert "unparseable line skipped" in r.stderr
+    assert "3 malformed/truncated line(s) skipped" in r.stdout
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["malformed_lines"] == 3
+    assert summary["sites"]["S"]["steps"] == 2   # survivors summarized
+    assert summary["spans"]["ckpt.save"]["n"] == 1
+
+
+def test_telemetry_report_json_only_mode_counts_malformed(tmp_path):
+    path = str(tmp_path / "bad.jsonl")
+    with open(path, "w") as f:
+        f.write('{"event":"step","site":"S","wall_\n')
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "telemetry_report.py"),
+         "--json", path], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["events"] == 0 and summary["malformed_lines"] == 1

@@ -8,6 +8,7 @@ eager computation, and a small model must actually learn.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import paddle_tpu as pt
 from paddle_tpu import amp, nn, optimizer
@@ -302,3 +303,18 @@ def test_partial_remat_num_layers():
     assert (n_full, n_part, n_off) == (4, 2, 0)
     np.testing.assert_allclose(l_full, l_part, rtol=1e-5)
     np.testing.assert_allclose(l_full, l_off, rtol=1e-5)
+
+
+def test_recompute_num_layers_without_use_recompute_warns():
+    """ADVICE r5: the partial-remat count is ignored without
+    use_recompute=True — warn instead of silently dropping it."""
+    import warnings
+    from paddle_tpu.models.llama import llama
+    with pytest.warns(UserWarning, match="recompute_num_layers=2 is "
+                                         "ignored"):
+        llama("tiny", num_hidden_layers=4, use_recompute=False,
+              recompute_num_layers=2)
+    with warnings.catch_warnings():   # the effective combo stays silent
+        warnings.simplefilter("error", UserWarning)
+        llama("tiny", num_hidden_layers=4, use_recompute=True,
+              recompute_num_layers=2)

@@ -154,10 +154,12 @@ def gate_memproof_lite() -> int:
 
 
 def gate_telemetry_overhead(iters: int = 100_000,
-                            budget_us: float = 10.0) -> int:
-    """The disabled-telemetry train-step path must stay zero-overhead.
+                            budget_us: float = 10.0,
+                            ring_budget_us: float = 5.0) -> int:
+    """The disabled-telemetry train-step path must stay zero-overhead,
+    and the enabled flight-recorder ring append must stay O(µs).
 
-    Two checks, both deterministic:
+    Four checks, all deterministic:
 
     1. POISON: with telemetry disabled (the default), a TrainStep call
        must never touch the metrics registry or emit an event — the
@@ -169,11 +171,20 @@ def gate_telemetry_overhead(iters: int = 100_000,
        ``budget_us`` per call (measured ~1 µs; the contract is ONE falsy
        hook-container check — see observability/_state.py).  A stray
        per-step file write or lock acquisition blows the budget.
+    3. RING: the enabled-recorder cost is one dict build + one deque
+       append — ``FlightRecorder.record`` must average under
+       ``ring_budget_us`` per call and the ring must stay bounded at
+       its capacity (a lock, a copy, or an unbounded buffer blows it).
+    4. RE-CHECK: after a full ``enable(flight_recorder=True, watchdog)``
+       /``disable`` cycle, every hook container is None again and the
+       poisoned dispatch probe still passes — enabling the recorder once
+       must not leave residue on the disabled path.
     """
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import time
 
     import paddle_tpu.observability as obs
+    from paddle_tpu.observability import _state as obs_state
     from paddle_tpu.jit import TrainStep
 
     if obs.enabled():
@@ -217,6 +228,55 @@ def gate_telemetry_overhead(iters: int = 100_000,
               "measurable per-step cost — keep it to one falsy check "
               "(observability/_state.py)")
         return 1
+
+    # 3. enabled-recorder ring append: one dict build + one deque append
+    rec = obs.FlightRecorder(capacity=512)
+    ring_iters = max(iters, 1024)
+    t0 = time.perf_counter()
+    for _ in range(ring_iters):
+        rec.record("beat", site="gate")
+    ring_us = (time.perf_counter() - t0) / ring_iters * 1e6
+    print(f"telemetry-overhead: enabled-recorder ring append "
+          f"{ring_us:.2f} us/record (budget {ring_budget_us:.0f} us)")
+    if ring_us > ring_budget_us:
+        print("telemetry-overhead gate FAILED: FlightRecorder.record grew "
+              "beyond one append — no locks, no copies, no I/O on the "
+              "breadcrumb path (observability/flight_recorder.py)")
+        return 1
+    if len(rec) != 512 or rec.total != ring_iters:
+        print(f"telemetry-overhead gate FAILED: ring not bounded at its "
+              f"capacity (len {len(rec)}, capacity 512, total {rec.total})")
+        return 1
+
+    # 4. an enable/disable cycle (recorder + watchdog + spans on) leaves
+    # the disabled path exactly as it was: all hooks None, poison-clean
+    tel = obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False,
+                     watchdog_s=3600.0)
+    step(state, batch)
+    obs.disable()
+    hooks = {"MONITOR": obs_state.MONITOR[0],
+             "COLLECTIVE": obs_state.COLLECTIVE[0],
+             "EMIT": obs_state.EMIT[0],
+             "SPAN": obs_state.SPAN[0],
+             "RECORDER": obs_state.RECORDER[0],
+             "POSTMORTEM": obs_state.POSTMORTEM[0]}
+    stale = [k for k, v in hooks.items() if v is not None]
+    if stale:
+        print(f"telemetry-overhead gate FAILED: disable() left hook "
+              f"containers set: {stale}")
+        return 1
+    if tel.watchdog is None or tel.watchdog._thread is not None:
+        print("telemetry-overhead gate FAILED: disable() left the hang "
+              "watchdog thread running")
+        return 1
+    for cls, name in poisoned:
+        saved[(cls, name)] = getattr(cls, name)
+        setattr(cls, name, boom)
+    try:
+        step(state, batch)   # re-poison probe after the cycle
+    finally:
+        for (cls, name), fn in saved.items():
+            setattr(cls, name, fn)
     print("telemetry-overhead gate OK")
     return 0
 

@@ -2,15 +2,25 @@
 """Fold a telemetry JSONL stream into the docs/BENCH.md table format.
 
 Input: one or more JSONL files produced by ``paddle_tpu.observability``
-(a training run's sink, or bench.py's sidecar).  Output: markdown tables
-(per-site step stats, compile attribution, collective volume) on stdout,
-plus ONE JSON summary line on the last line — the same artifact
-convention every other tool in this repo follows.
+(a training run's sink, bench.py's sidecar, or a ``*.postmortem`` crash
+dump — same line format).  Output: markdown tables (per-site step stats,
+span durations, compile attribution, collective volume, post-mortem
+summary) on stdout, plus ONE JSON summary line on the last line — the
+same artifact convention every other tool in this repo follows.
+
+Crash-time streams get cut mid-line (the process died between ``write``
+and ``flush``): unparseable/truncated lines are skipped, COUNTED, and
+reported — never raised on.
+
+Note: a ``.postmortem`` REPLAYS the last-N ring events; folding it in
+the same invocation as its source JSONL double-counts that tail —
+report them separately when exact step counts matter.
 
 Pure stdlib on purpose: the report runs anywhere the JSONL landed (a CI
 box, a laptop) without jax or the framework installed.
 
 Usage:  python tools/telemetry_report.py run_telemetry.jsonl [more.jsonl]
+        python tools/telemetry_report.py run.jsonl run.jsonl.postmortem
         python tools/telemetry_report.py --json run.jsonl   # JSON only
 """
 
@@ -32,32 +42,49 @@ def _pct(sorted_vals, p):
 
 
 def load_events(paths):
-    events = []
+    """Parse JSONL files; returns (events, malformed_line_count).
+
+    A crash cuts the stream mid-line; a malformed tail (or any garbage
+    line) is skipped and counted so the report can say how much of the
+    stream was lost, instead of raising and reporting nothing."""
+    events, malformed = [], 0
     for path in paths:
-        with open(path) as f:
+        with open(path, errors="replace") as f:
             for ln, line in enumerate(f, 1):
                 line = line.strip()
                 if not line:
                     continue
                 try:
-                    events.append(json.loads(line))
+                    ev = json.loads(line)
                 except json.JSONDecodeError:
+                    malformed += 1
                     print(f"warning: {path}:{ln}: unparseable line skipped",
                           file=sys.stderr)
-    return events
+                    continue
+                # a JSONL event is an object; a bare scalar that happens
+                # to parse (a cut line like `42`) is stream damage too
+                if isinstance(ev, dict):
+                    events.append(ev)
+                else:
+                    malformed += 1
+                    print(f"warning: {path}:{ln}: non-object line skipped",
+                          file=sys.stderr)
+    return events, malformed
 
 
 def summarize(events):
-    steps = defaultdict(lambda: {"n": 0, "warmup": 0, "intervals": [],
-                                 "tps": [], "mfu": [], "tokens": 0})
-    compiles = defaultdict(lambda: {"n": 0, "total_ms": 0.0})
-    storms, preemptions = [], []
-    last_metrics = None
-    bench_result = None
+    agg = {
+        "steps": defaultdict(lambda: {"n": 0, "warmup": 0, "intervals": [],
+                                      "tps": [], "mfu": [], "tokens": 0}),
+        "spans": defaultdict(lambda: {"n": 0, "ms": []}),
+        "compiles": defaultdict(lambda: {"n": 0, "total_ms": 0.0}),
+        "storms": [], "preemptions": [], "hangs": [], "postmortems": [],
+        "thread_stacks": [], "metrics": None, "bench_result": None,
+    }
     for e in events:
         kind = e.get("event")
         if kind == "step":
-            s = steps[e.get("site", "?")]
+            s = agg["steps"][e.get("site", "?")]
             s["n"] += 1
             s["tokens"] += e.get("tokens") or 0
             if e.get("warmup"):
@@ -69,23 +96,42 @@ def summarize(events):
                 s["tps"].append(e["tokens_per_sec"])
             if e.get("mfu") is not None:
                 s["mfu"].append(e["mfu"])
+        elif kind == "span":
+            sp = agg["spans"][e.get("name", "?")]
+            sp["n"] += 1
+            if e.get("ms") is not None:
+                sp["ms"].append(e["ms"])
         elif kind == "compile":
-            c = compiles[e.get("site", "?")]
+            c = agg["compiles"][e.get("site", "?")]
             c["n"] += 1
             c["total_ms"] += e.get("duration_ms") or 0.0
         elif kind == "recompile_storm":
-            storms.append(e)
+            agg["storms"].append(e)
         elif kind == "preemption":
-            preemptions.append(e)
+            agg["preemptions"].append(e)
+        elif kind == "hang":
+            agg["hangs"].append(e)
+        elif kind == "postmortem":
+            agg["postmortems"].append(e)
+        elif kind == "thread_stack":
+            agg["thread_stacks"].append(e)
         elif kind == "metrics":
-            last_metrics = e.get("metrics") or {}
+            agg["metrics"] = e.get("metrics") or {}
         elif kind == "bench_result":
-            bench_result = e
-    return steps, compiles, storms, preemptions, last_metrics, bench_result
+            agg["bench_result"] = e
+    return agg
 
 
-def render(steps, compiles, storms, preemptions, metrics):
+def render(agg, malformed=0):
+    steps, compiles = agg["steps"], agg["compiles"]
+    storms, preemptions = agg["storms"], agg["preemptions"]
+    metrics = agg["metrics"]
     lines = ["## Telemetry report", ""]
+    if malformed:
+        lines.append(f"**{malformed} malformed/truncated line(s) skipped** "
+                     "(a crash cuts the stream mid-line; the rest of the "
+                     "report covers what survived)")
+        lines.append("")
     if steps:
         lines += ["| Site | Steps | ms/step p50 | ms/step p95 | tok/s | MFU |",
                   "|---|---|---|---|---|---|"]
@@ -101,6 +147,16 @@ def render(steps, compiles, storms, preemptions, metrics):
             lines.append(
                 f"| {site} | {s['n']} ({s['warmup']} warmup) | {fmt(p50)} "
                 f"| {fmt(p95)} | {fmt(tps, 1)} | {fmt(mfu, 4)} |")
+        lines.append("")
+    if agg["spans"]:
+        lines += ["| Span | Count | ms p50 | ms p95 |", "|---|---|---|---|"]
+        for name, sp in sorted(agg["spans"].items()):
+            ms = sorted(sp["ms"])
+            p50, p95 = _pct(ms, 50), _pct(ms, 95)
+
+            def fmt(v):
+                return f"{v:.2f}" if v is not None else "—"
+            lines.append(f"| {name} | {sp['n']} | {fmt(p50)} | {fmt(p95)} |")
         lines.append("")
     if compiles:
         lines += ["| Compile site | Compiles | Total compile ms |",
@@ -126,7 +182,34 @@ def render(steps, compiles, storms, preemptions, metrics):
     for p in preemptions:
         lines.append(f"**PREEMPTION**: {p.get('reason')} at step "
                      f"{p.get('step')} (ts {p.get('ts')})")
-    if not (steps or compiles or coll or storms or preemptions):
+    for h in agg["hangs"]:
+        lines.append(f"**HANG**: no progress for {h.get('age_s')}s "
+                     f"(deadline {h.get('deadline_s')}s) — post-mortem: "
+                     f"{h.get('postmortem')}")
+    if agg["postmortems"]:
+        lines.append("")
+        lines.append("### Post-mortem")
+        for pm in agg["postmortems"]:
+            lines.append(f"- reason: `{pm.get('reason')}` (ts {pm.get('ts')}"
+                         f", pid {pm.get('pid')})")
+            exc = pm.get("exception")
+            if exc:
+                lines.append(f"  - exception: `{exc.get('type')}: "
+                             f"{exc.get('message')}`")
+        n_threads = len(agg["thread_stacks"])
+        if n_threads:
+            lines.append(f"- {n_threads} thread stack(s) captured:")
+            for ts_ in agg["thread_stacks"]:
+                frames = ts_.get("frames") or []
+                # the innermost frame is where the thread was stuck
+                tail = (" — ".join(l.strip() for l in
+                                   frames[-1].strip().splitlines())
+                        if frames else "?")
+                lines.append(f"  - `{ts_.get('thread')}`"
+                             f"{' (daemon)' if ts_.get('daemon') else ''}: "
+                             f"{tail}")
+    if not (steps or agg["spans"] or compiles or coll or storms
+            or preemptions or agg["hangs"] or agg["postmortems"]):
         lines.append("(no telemetry events found)")
     return "\n".join(lines)
 
@@ -138,25 +221,34 @@ def main(argv=None) -> int:
                     help="print only the JSON summary line")
     args = ap.parse_args(argv)
 
-    events = load_events(args.paths)
-    steps, compiles, storms, preemptions, metrics, bench = summarize(events)
+    events, malformed = load_events(args.paths)
+    agg = summarize(events)
     if not args.json:
-        print(render(steps, compiles, storms, preemptions, metrics))
+        print(render(agg, malformed))
     summary = {
         "metric": "telemetry_report",
         "events": len(events),
+        "malformed_lines": malformed,
         "sites": {site: {"steps": s["n"],
                          "p50_ms": _pct(sorted(s["intervals"]), 50),
                          "p95_ms": _pct(sorted(s["intervals"]), 95),
                          "mean_mfu": (round(sum(s["mfu"]) / len(s["mfu"]), 4)
                                       if s["mfu"] else None)}
-                  for site, s in sorted(steps.items())},
-        "compiles": {site: c["n"] for site, c in sorted(compiles.items())},
-        "storms": len(storms),
-        "preemptions": len(preemptions),
+                  for site, s in sorted(agg["steps"].items())},
+        "spans": {name: {"n": sp["n"],
+                         "p50_ms": _pct(sorted(sp["ms"]), 50),
+                         "p95_ms": _pct(sorted(sp["ms"]), 95)}
+                  for name, sp in sorted(agg["spans"].items())},
+        "compiles": {site: c["n"]
+                     for site, c in sorted(agg["compiles"].items())},
+        "storms": len(agg["storms"]),
+        "preemptions": len(agg["preemptions"]),
+        "hangs": len(agg["hangs"]),
+        "postmortems": [pm.get("reason") for pm in agg["postmortems"]],
+        "thread_stacks": len(agg["thread_stacks"]),
     }
-    if bench is not None:
-        summary["bench_value"] = bench.get("value")
+    if agg["bench_result"] is not None:
+        summary["bench_value"] = agg["bench_result"].get("value")
     print(json.dumps(summary))
     return 0
 
