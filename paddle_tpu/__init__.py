@@ -73,7 +73,8 @@ def __getattr__(name):
                 "vision", "incubate", "hapi", "static", "device", "launch",
                 "utils", "config", "sparse", "quantization", "inference",
                 "audio", "distribution", "geometric", "signal", "regularizer",
-                "callbacks", "text", "hub", "onnx", "observability"):
+                "callbacks", "text", "hub", "onnx", "observability",
+                "resilience"):
         try:
             mod = importlib.import_module(f".{name}", __name__)
         except ModuleNotFoundError as e:
@@ -124,7 +125,7 @@ def __dir__():
         "vision", "incubate", "hapi", "static", "device", "launch", "utils",
         "config", "sparse", "quantization", "inference", "audio",
         "distribution", "geometric", "signal", "regularizer", "callbacks",
-        "text", "hub", "onnx", "observability",
+        "text", "hub", "onnx", "observability", "resilience",
         "Model", "DataParallel", "flops", "summary", "version", "metric",
         "enable_static", "disable_static", "in_dynamic_mode"})
 

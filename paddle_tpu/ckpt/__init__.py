@@ -25,6 +25,7 @@ import json
 import os
 import pickle
 import threading
+import zlib
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -32,11 +33,57 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..observability.spans import span as _span
+from ..resilience import _state as _rs_state
 
 __all__ = ["save", "load", "save_state_dict", "load_state_dict",
-           "async_save", "AsyncCheckpointer", "latest_checkpoint"]
+           "async_save", "AsyncCheckpointer", "latest_checkpoint",
+           "verify_checkpoint", "CheckpointCorruptError"]
 
 _META = "metadata.json"
+# commit sentinel: last file rank 0 writes; a directory without it is a
+# torn save and reads as incomplete (v2 checkpoints — see _is_complete)
+_COMMIT = "COMMITTED"
+_FORMAT = "paddle_tpu.ckpt.v2"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A shard file failed its recorded checksum (or is unreadable).
+
+    Deliberately NOT in ``resilience.DEFAULT_RETRYABLE``: re-reading the
+    same bytes cannot fix them.  The recovery path is fallback —
+    ``latest_checkpoint(root, valid_only=True)`` skips the corrupt
+    directory, and the resilience supervisor restarts onto the previous
+    valid checkpoint (docs/RESILIENCE.md, "Recovering a torn
+    checkpoint")."""
+
+
+def _crc32_of(arr) -> int:
+    """Checksum of an array's data bytes (C-order, layout-independent)."""
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
+def _crc32_of_file(fpath: str) -> int:
+    """Streaming checksum of a shard file's array bytes: mmap + fixed-size
+    slices, so verifying a multi-GB shard costs O(chunk) resident memory
+    instead of two full in-RAM copies.  Matches ``_crc32_of``'s C-order
+    convention (non-C-contiguous saves fall back to the copying path)."""
+    arr = np.load(fpath, mmap_mode="r")
+    if not arr.flags.c_contiguous:
+        return _crc32_of(np.asarray(arr))
+    flat = arr.reshape(-1).view(np.uint8)
+    crc = 0
+    step = 16 << 20
+    for off in range(0, flat.size, step):
+        crc = zlib.crc32(flat[off:off + step], crc)
+    return crc & 0xFFFFFFFF
+
+
+def _fault(site: str) -> None:
+    """Fault-injection site: one falsy check when disabled (the
+    observability zero-overhead contract)."""
+    fi = _rs_state.FAULTS[0]
+    if fi is not None:
+        fi(site)
 
 
 # ---------------------------------------------------------------------------
@@ -71,25 +118,50 @@ def _from_host(obj, to_device: bool):
                                   and "__prng_key__" in x)
 
 
-def save(obj: Any, path: str, protocol: int = 4) -> None:
+def save(obj: Any, path: str, protocol: int = 4, retry=None) -> None:
     """``paddle.save`` parity: pickle a (possibly nested) object, with array
-    leaves materialised to host numpy."""
+    leaves materialised to host numpy.  ``retry`` (a
+    ``resilience.RetryPolicy``) re-attempts a failed write."""
     # span: ckpt I/O is where jobs wedge on dead filesystems — the
     # span_begin breadcrumb makes that the last thing a hang dump shows
     with _span("ckpt.save", path=path):
-        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(_to_host(obj), f, protocol=protocol)
-        os.replace(tmp, path)  # atomic: no torn checkpoint on preemption
+        host = _to_host(obj)
+
+        def write():
+            _fault("ckpt.save")
+            os.makedirs(os.path.dirname(os.path.abspath(path)),
+                        exist_ok=True)
+            tmp = path + ".tmp"
+            try:
+                with open(tmp, "wb") as f:
+                    pickle.dump(host, f, protocol=protocol)
+                os.replace(tmp, path)  # atomic: no torn ckpt on preemption
+            except BaseException:
+                # a failed write must not litter .tmp debris that a later
+                # save (or a directory scan) trips on
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+
+        if retry is not None:
+            retry.run(write, site="ckpt.save")
+        else:
+            write()
 
 
-def load(path: str, return_numpy: bool = False) -> Any:
+def load(path: str, return_numpy: bool = False, retry=None) -> Any:
     """``paddle.load`` parity: returns device arrays by default, matching the
     reference (``return_numpy=True`` keeps host numpy)."""
     with _span("ckpt.load", path=path):
-        with open(path, "rb") as f:
-            obj = pickle.load(f)
+        def read():
+            _fault("ckpt.load")
+            with open(path, "rb") as f:
+                return pickle.load(f)
+
+        obj = retry.run(read, site="ckpt.load") if retry is not None \
+            else read()
         return _from_host(obj, to_device=not return_numpy)
 
 
@@ -157,18 +229,22 @@ def _snapshot_entries(state_dict: Any, materialize: bool):
 
 
 def _write_entries(entries, path: str, overwrite: bool = True) -> None:
-    """The single writer of the v1 on-disk format (shard .npy files + a
-    per-rank metadata JSON)."""
+    """The single writer of the v2 on-disk format (shard .npy files + a
+    per-rank metadata JSON carrying per-file checksums + a rank-0 commit
+    sentinel making the directory save atomic)."""
+    _fault("ckpt.save")
     os.makedirs(path, exist_ok=True)
-    # re-saving in place: drop rank 0's metadata FIRST so the directory reads
-    # as incomplete (and is skipped by latest_checkpoint) while shard files
-    # are being rewritten; it is atomically re-created at the end
+    # re-saving in place: drop the commit sentinel and rank 0's metadata
+    # FIRST so the directory reads as incomplete (and is skipped by
+    # latest_checkpoint) while shard files are being rewritten; both are
+    # atomically re-created at the end
     if jax.process_index() == 0:
-        try:
-            os.remove(os.path.join(path, _META))
-        except FileNotFoundError:
-            pass
-    meta: Dict[str, Any] = {"format": "paddle_tpu.ckpt.v1",
+        for stale in (_COMMIT, _META):
+            try:
+                os.remove(os.path.join(path, stale))
+            except FileNotFoundError:
+                pass
+    meta: Dict[str, Any] = {"format": _FORMAT,
                             "process_count": jax.process_count(),
                             "arrays": {}, "objects": {}}
     for item in entries:
@@ -184,28 +260,80 @@ def _write_entries(entries, path: str, overwrite: bool = True) -> None:
             fname = (f"{_key_to_fname(key)}"
                      f".{'_'.join(f'{a}-{b}' for a, b in idx) or 'scalar'}.npy")
             fpath = os.path.join(path, fname)
+            fdesc: Dict[str, Any] = {"ranges": idx, "file": fname}
             if overwrite or not os.path.exists(fpath):
-                np.save(fpath, data() if callable(data) else data)
-            entry["files"].append({"ranges": idx, "file": fname})
+                arr = np.asarray(data() if callable(data) else data)
+                try:
+                    np.save(fpath, arr)
+                except BaseException:
+                    # a torn shard from a failed write must not survive:
+                    # an overwrite=False retry would see the file, skip
+                    # rewriting it, record no crc, and COMMIT a directory
+                    # that verifies clean but cannot be read
+                    try:
+                        os.unlink(fpath)
+                    except OSError:
+                        pass
+                    raise
+                fdesc["crc32"] = _crc32_of(arr)
+                fdesc["nbytes"] = int(arr.nbytes)
+            else:
+                # overwrite=False reuse: this save REPLACES the metadata,
+                # so re-checksum the existing file — dropping the crc here
+                # would silently disable corruption detection for every
+                # reused shard.  An unreadable reused file stays un-crc'd
+                # (the load will fail on it anyway).
+                try:
+                    fdesc["crc32"] = _crc32_of_file(fpath)
+                    fdesc["nbytes"] = int(
+                        np.load(fpath, mmap_mode="r").nbytes)
+                except Exception:
+                    pass
+            entry["files"].append(fdesc)
         meta["arrays"][key] = entry
     # each process writes its own metadata file; rank 0's name is canonical
     # and load() unions them all (multi-host writes to a shared fs compose)
     rank = jax.process_index()
     mname = _META if rank == 0 else f"metadata.{rank}.json"
-    tmp = os.path.join(path, mname + f".tmp{os.getpid()}")
-    with open(tmp, "w") as f:
-        json.dump(meta, f, indent=1)
-    os.replace(tmp, os.path.join(path, mname))
+    _atomic_json(meta, os.path.join(path, mname))
+    if rank == 0:
+        # commit sentinel LAST: its presence means rank 0's save finished
+        # (other ranks' metadata is checked separately by _is_complete)
+        _atomic_json({"format": _FORMAT,
+                      "process_count": jax.process_count()},
+                     os.path.join(path, _COMMIT))
 
 
-def save_state_dict(state_dict: Any, path: str, overwrite: bool = True) -> None:
+def _atomic_json(obj, dest: str) -> None:
+    tmp = dest + f".tmp{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(obj, f, indent=1)
+        os.replace(tmp, dest)
+    except BaseException:
+        # no .tmp debris after a failed write (a fault mid-save must not
+        # leave files a later overwrite=True save trips on)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def save_state_dict(state_dict: Any, path: str, overwrite: bool = True,
+                    retry=None) -> None:
     """Write a sharded checkpoint directory for a pytree of arrays.
 
     Every process writes only the shards it owns (lazily, one host copy at a
-    time), so no rank ever materialises the full state."""
+    time), so no rank ever materialises the full state.  ``retry`` (a
+    ``resilience.RetryPolicy``) re-attempts a failed write from scratch."""
     with _span("ckpt.save_state_dict", path=path):
-        _write_entries(_snapshot_entries(state_dict, materialize=False),
-                       path, overwrite=overwrite)
+        entries = _snapshot_entries(state_dict, materialize=False)
+        if retry is not None:
+            retry.run(_write_entries, entries, path, overwrite,
+                      site="ckpt.save")
+        else:
+            _write_entries(entries, path, overwrite)
 
 
 def _jsonable(x):
@@ -247,17 +375,21 @@ def _meta_files(path: str) -> List[str]:
 
 
 def _is_complete(path: str) -> bool:
-    """True iff rank 0's metadata exists AND every writer rank's metadata is
-    present (a multi-host save is torn until the last rank finishes)."""
+    """True iff rank 0's metadata exists, every writer rank's metadata is
+    present (a multi-host save is torn until the last rank finishes), and —
+    for v2 checkpoints — the commit sentinel landed."""
     full = os.path.join(path, _META)
     if not os.path.exists(full):
         return False
     try:
         with open(full) as f:
-            expected = json.load(f).get("process_count", 1)
+            meta = json.load(f)
     except (OSError, json.JSONDecodeError):
         return False
-    return len(_meta_files(path)) >= expected
+    if meta.get("format") == _FORMAT \
+            and not os.path.exists(os.path.join(path, _COMMIT)):
+        return False   # v2 without its sentinel: save died mid-write
+    return len(_meta_files(path)) >= meta.get("process_count", 1)
 
 
 def _load_meta(path: str) -> Dict[str, Any]:
@@ -292,13 +424,40 @@ def _load_meta(path: str) -> Dict[str, Any]:
 
 class _ShardReader:
     """Reads an arbitrary index-window of one global array from its shard
-    files (mmap'd, so only the needed bytes are touched)."""
+    files (mmap'd, so only the needed bytes are touched).
 
-    def __init__(self, path: str, entry: Dict[str, Any]):
+    With ``verify=True`` (the default), every shard file that is actually
+    read is checked once against the checksum the save recorded — a
+    bit-flipped or truncated shard raises :class:`CheckpointCorruptError`
+    instead of silently restoring garbage weights.  Verification reads
+    the whole file (checksums are per-file); pass
+    ``load_state_dict(..., verify=False)`` to keep window reads lazy on
+    trusted storage."""
+
+    def __init__(self, path: str, entry: Dict[str, Any],
+                 verify: bool = True):
         self.path = path
         self.entry = entry
         self.shape = tuple(entry["shape"])
         self.dtype = np.dtype(entry["dtype"])
+        self._verify = verify
+        self._checked: set = set()
+
+    def _check(self, fdesc) -> None:
+        if not self._verify or "crc32" not in fdesc \
+                or fdesc["file"] in self._checked:
+            return
+        fpath = os.path.join(self.path, fdesc["file"])
+        try:
+            crc = _crc32_of_file(fpath)
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"unreadable shard file {fpath}: {e}") from e
+        if crc != fdesc["crc32"]:
+            raise CheckpointCorruptError(
+                f"checksum mismatch in {fpath}: metadata records "
+                f"{fdesc['crc32']:#010x}, file has {crc:#010x}")
+        self._checked.add(fdesc["file"])
 
     def read(self, index: Tuple[slice, ...]) -> np.ndarray:
         want = _index_to_ranges(index, self.shape)
@@ -315,7 +474,16 @@ class _ShardReader:
                      for (a, b), (wa, wb) in zip(ranges, want)]
             if any(a >= b for a, b in inter) and out_shape != ():
                 continue
-            src = np.load(os.path.join(self.path, fdesc["file"]), mmap_mode="r")
+            self._check(fdesc)
+            fpath = os.path.join(self.path, fdesc["file"])
+            try:
+                src = np.load(fpath, mmap_mode="r")
+            except Exception as e:
+                # a truncated/garbled npy raises a plain ValueError from
+                # numpy; type it so the supervisor's fallback path (pick
+                # an older valid checkpoint) recognises the condition
+                raise CheckpointCorruptError(
+                    f"unreadable shard file {fpath}: {e}") from e
             if out_shape == ():
                 # np.array (copy): never hand out a view of the read-only
                 # mmap — jax zero-copies host arrays and a donated write
@@ -335,31 +503,47 @@ class _ShardReader:
 
 
 def load_state_dict(path: str, template: Any = None,
-                    shardings: Optional[Dict[str, Any]] = None) -> Any:
+                    shardings: Optional[Dict[str, Any]] = None, *,
+                    verify: bool = True, retry=None) -> Any:
     """Load a sharded checkpoint.
 
     - ``template=None``: returns a flat ``{key: np.ndarray}`` dict.
-    - ``template`` a pytree: returns the same structure; any ``jax.Array``
-      leaf in the template is restored **with the template's sharding**
+    - ``template`` a pytree: returns the same structure; any leaf carrying
+      a ``.sharding`` (a ``jax.Array`` or an abstract
+      ``jax.ShapeDtypeStruct``) is restored **with that sharding**
       (reshard-on-load: each device reads only its window).
     - ``shardings``: optional ``{key: jax.sharding.Sharding}`` overriding /
       supplementing the template's shardings.
+    - ``verify``: check each shard file read against its recorded
+      checksum (raises :class:`CheckpointCorruptError` on mismatch);
+      ``False`` skips the integrity pass and keeps window reads lazy.
+    - ``retry``: a ``resilience.RetryPolicy`` re-attempting transient
+      read failures (corruption is NOT retried — fall back via
+      ``latest_checkpoint(..., valid_only=True)`` instead).
     """
     with _span("ckpt.load_state_dict", path=path):
-        return _load_state_dict(path, template, shardings)
+        if retry is not None:
+            return retry.run(_load_state_dict, path, template, shardings,
+                             verify, site="ckpt.load")
+        return _load_state_dict(path, template, shardings, verify)
 
 
-def _load_state_dict(path, template, shardings):
+def _load_state_dict(path, template, shardings, verify=True):
+    _fault("ckpt.load")
     meta = _load_meta(path)
-    readers = {k: _ShardReader(path, e) for k, e in meta["arrays"].items()}
+    readers = {k: _ShardReader(path, e, verify=verify)
+               for k, e in meta["arrays"].items()}
 
     def materialize(key: str, like=None):
         if key in readers:
             r = readers[key]
             prng_impl = meta["arrays"][key].get("prng_impl")
             shard = (shardings or {}).get(key)
-            if shard is None and isinstance(like, jax.Array) and hasattr(like, "sharding"):
-                shard = like.sharding
+            if shard is None and like is not None:
+                # jax.Array AND abstract ShapeDtypeStruct templates both
+                # carry .sharding — the supervisor restores through
+                # buffer-free struct templates (donation-proof)
+                shard = getattr(like, "sharding", None)
             if prng_impl is not None:
                 # typed PRNG key: stored as raw uint32 key data; place the
                 # raw data on the target sharding FIRST (device_put rejects
@@ -385,13 +569,20 @@ def _load_state_dict(path, template, shardings):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
-def latest_checkpoint(root: str, prefix: str = "step_") -> Optional[str]:
+def latest_checkpoint(root: str, prefix: str = "step_",
+                      valid_only: bool = False) -> Optional[str]:
     """Return the highest-numbered ``{prefix}{N}`` checkpoint dir under root
-    that finished writing (metadata from every writer rank), for
-    resume-after-preemption."""
+    that finished writing (metadata from every writer rank + commit
+    sentinel), for resume-after-preemption.
+
+    ``valid_only=True`` additionally verifies data integrity
+    (:func:`verify_checkpoint`: every shard file present and matching its
+    recorded checksum) and **falls back**: a torn or corrupt newest
+    directory is skipped in favor of the last *good* one, so resume never
+    crashes on the checkpoint the failure tore."""
     if not os.path.isdir(root):
         return None
-    best, best_n = None, -1
+    candidates = []
     for name in os.listdir(root):
         if not name.startswith(prefix):
             continue
@@ -399,10 +590,57 @@ def latest_checkpoint(root: str, prefix: str = "step_") -> Optional[str]:
             n = int(name[len(prefix):])
         except ValueError:
             continue
-        full = os.path.join(root, name)
-        if n > best_n and _is_complete(full):
-            best, best_n = full, n
-    return best
+        candidates.append((n, os.path.join(root, name)))
+    for _n, full in sorted(candidates, reverse=True):
+        if valid_only:
+            if not verify_checkpoint(full):
+                return full
+        elif _is_complete(full):
+            return full
+    return None
+
+
+def verify_checkpoint(root: str, *, data: bool = True) -> List[str]:
+    """Integrity-check one checkpoint directory; returns a list of
+    problems (empty == valid).
+
+    Checks: completeness (every writer rank's metadata + the v2 commit
+    sentinel), every referenced shard file present, and — with
+    ``data=True`` — every shard file matching its recorded checksum.
+    Never raises: a verdict on a half-deleted directory is still a
+    verdict."""
+    if not os.path.isdir(root):
+        return [f"{root}: not a directory"]
+    if not _is_complete(root):
+        return [f"{root}: incomplete (missing metadata or commit sentinel)"]
+    try:
+        meta = _load_meta(root)
+    except Exception as e:  # noqa: BLE001 — verdict, not crash
+        return [f"{root}: unreadable metadata: {e}"]
+    problems: List[str] = []
+    seen = set()
+    for key, entry in sorted(meta["arrays"].items()):
+        for fdesc in entry["files"]:
+            fname = fdesc["file"]
+            if fname in seen:
+                continue
+            seen.add(fname)
+            fpath = os.path.join(root, fname)
+            if not os.path.exists(fpath):
+                problems.append(f"{key}: missing shard file {fname}")
+                continue
+            if not data or "crc32" not in fdesc:
+                continue
+            try:
+                crc = _crc32_of_file(fpath)
+            except Exception as e:  # noqa: BLE001
+                problems.append(f"{key}: unreadable shard {fname}: {e}")
+                continue
+            if crc != fdesc["crc32"]:
+                problems.append(
+                    f"{key}: checksum mismatch in {fname} (metadata "
+                    f"{fdesc['crc32']:#010x}, file {crc:#010x})")
+    return problems
 
 
 # ---------------------------------------------------------------------------
@@ -412,11 +650,17 @@ def latest_checkpoint(root: str, prefix: str = "step_") -> Optional[str]:
 class AsyncCheckpointer:
     """Serialises saves onto a background thread so the train loop only
     blocks for the device→host copy of the *previous* save (if still
-    running), never for disk IO."""
+    running), never for disk IO.
 
-    def __init__(self):
+    ``retry`` (a ``resilience.RetryPolicy``) re-attempts a failed
+    background write before the error is surfaced; a background failure
+    that exhausts it is re-raised from ``wait()`` — or from the *next*
+    ``save()``, which waits first."""
+
+    def __init__(self, retry=None):
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
+        self._retry = retry
 
     def save(self, state_dict: Any, path: str) -> None:
         self.wait()
@@ -430,7 +674,11 @@ class AsyncCheckpointer:
                 # the write in flight, so a wedged background save is
                 # attributed in a hang dump (its stack is there too)
                 with _span("ckpt.async_save", path=path):
-                    _write_entries(entries, path)
+                    if self._retry is not None:
+                        self._retry.run(_write_entries, entries, path,
+                                        site="ckpt.save")
+                    else:
+                        _write_entries(entries, path)
             except BaseException as e:
                 self._error = e
 

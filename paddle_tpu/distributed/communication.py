@@ -445,11 +445,17 @@ import functools as _functools
 from . import debug as _debug
 from ..observability import _state as _obs_state
 from ..observability.spans import span as _span, spans_active as _spans_active
+from ..resilience import _state as _rs_state
 
 
 def _traced(fn, name):
     @_functools.wraps(fn)
     def wrapper(tensor, *a, **kw):
+        # fault-injection site "collective": one falsy check when no
+        # injector is installed (resilience/_state.py contract)
+        fi = _rs_state.FAULTS[0]
+        if fi is not None:
+            fi("collective")
         rec = _obs_state.COLLECTIVE[0]
         tracing = _debug.get_trace() is not None
         label = None

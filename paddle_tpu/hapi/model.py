@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from ..nn.layer import Layer, functional_call, raw_params
 from ..observability import _state as _obs_state
 from ..observability.spans import span as _span
+from ..resilience import _state as _rs_state
 from .callbacks import config_callbacks
 
 
@@ -133,6 +134,11 @@ class Model:
         fit() materializes it only at log boundaries)."""
         if self._optimizer is None or self._loss is None:
             raise RuntimeError("call prepare(optimizer, loss) before training")
+        # fault-injection site "step" (hapi drives its own jitted step, so
+        # it checks the hook itself, like the telemetry hook below)
+        fi = _rs_state.FAULTS[0]
+        if fi is not None:
+            fi("step")
         if self._train_step is None:
             self._train_step = self._build_train_step()
         state = self._ensure_state()

@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core import random as prandom
 from ..nn.layer import Layer, functional_call, raw_params, trainable_mask
 from ..observability import _state as _obs_state
+from ..resilience import _state as _rs_state
 from ..observability.spans import span as _span
 from . import control_flow
 from .control_flow import (GraphBreakError, case, cond, switch_case,
@@ -547,6 +548,13 @@ class TrainStep:
                 "gradient accumulation requested but this TrainStep was "
                 "built without buffers: wrap the model in "
                 "paddle_tpu.DataParallel or pass gradient_accumulation=True")
+        # fault-injection site "step": same one-falsy-check discipline as
+        # the telemetry hook below (enforced by the same CI gate); fires
+        # BEFORE the compiled call so the incoming state is never donated
+        # when the supervisor catches the injected failure
+        fi = _rs_state.FAULTS[0]
+        if fi is not None:
+            fi("step")
         # telemetry: exactly ONE falsy check on the disabled path (the
         # distributed/debug.py zero-overhead contract, enforced by the
         # telemetry-overhead CI gate)

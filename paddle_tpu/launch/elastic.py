@@ -89,7 +89,16 @@ class ElasticManager:
             if raw is None:
                 if not in_grace:
                     dead.append(r)
-            elif now - float(raw) > self.timeout:
+                continue
+            try:
+                fresh = now - float(raw) <= self.timeout
+            except (TypeError, ValueError):
+                # an unparsable heartbeat payload (corrupt store value,
+                # torn write) means the node's liveness is unknowable —
+                # treat it as dead rather than crash the watcher that
+                # every OTHER node's recovery depends on
+                fresh = False
+            if not fresh:
                 dead.append(r)
         return dead
 

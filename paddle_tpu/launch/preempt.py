@@ -88,13 +88,18 @@ class PreemptionGuard:
 
     def __exit__(self, exc_type, exc, tb):
         # Save BEFORE restoring handlers: a second SIGTERM during the
-        # checkpoint write must not kill the process mid-save.
-        if self.preempted and self.save_fn is not None and not self._saved:
-            self._saved = True
-            self.save_fn()
-        for s, prev in self._prev.items():
-            signal.signal(s, prev)
-        self._prev.clear()
+        # checkpoint write must not kill the process mid-save.  Restore
+        # in a finally: a raising save_fn must not leave the SIGTERM
+        # handler installed forever on a dead guard.
+        try:
+            if self.preempted and self.save_fn is not None \
+                    and not self._saved:
+                self._saved = True
+                self.save_fn()
+        finally:
+            for s, prev in self._prev.items():
+                signal.signal(s, prev)
+            self._prev.clear()
         return False
 
     def checkpoint_now(self):

@@ -26,6 +26,8 @@ import threading
 import time
 from typing import Dict, Optional
 
+from ..resilience import _state as _rs_state
+
 _OPS = {"set": 0, "get": 1, "add": 2, "wait": 3, "delete": 4, "cas": 5,
         "list": 6}
 
@@ -127,10 +129,18 @@ class TCPStore:
     ``TCPStore(addr, is_master=True)`` starts the server thread; every
     process (master included) talks to it through a client socket, like the
     reference where rank 0 hosts the store in-process.
+
+    ``retry`` (a ``resilience.RetryPolicy``) makes ``set``/``get``
+    survive transient socket failures: a failed op reconnects the client
+    socket and re-attempts under the policy (a blip in the master's
+    network must cost a heartbeat, not the job).  ``store.set`` /
+    ``store.get`` are registered fault-injection sites.
     """
 
     def __init__(self, endpoint: str, is_master: bool = False,
-                 timeout: float = 60.0, native: Optional[bool] = None):
+                 timeout: float = 60.0, native: Optional[bool] = None,
+                 retry=None):
+        self.retry = retry
         host, port = endpoint.rsplit(":", 1)
         self.endpoint = endpoint
         self.timeout = timeout
@@ -178,11 +188,45 @@ class TCPStore:
                 if sock_timeout is not None:
                     self._sock.settimeout(self.timeout)
 
+    def _reconnect(self) -> None:
+        with self._lock:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            host, port = self.endpoint.rsplit(":", 1)
+            self._sock = self._connect(host, int(port))
+
+    def _resilient(self, site: str, fn):
+        """Fault-injection check + (optional) retry-with-reconnect around
+        one store op.  One falsy check when no injector is installed and
+        no policy is configured."""
+        def attempt():
+            fi = _rs_state.FAULTS[0]
+            if fi is not None:
+                fi(site)
+            try:
+                return fn()
+            except (ConnectionError, OSError, TimeoutError):
+                # the request/response stream is desynchronized (or the
+                # socket is dead) — a retry on the same socket would read
+                # the wrong reply; reconnect before the next attempt
+                try:
+                    self._reconnect()
+                except OSError:
+                    pass   # next attempt's send will surface it
+                raise
+        if self.retry is None:
+            return attempt()
+        return self.retry.run(attempt, site=site)
+
     def set(self, key: str, value: bytes) -> None:
-        self._call("set", key.encode(), value)
+        self._resilient("store.set",
+                        lambda: self._call("set", key.encode(), value))
 
     def get(self, key: str) -> Optional[bytes]:
-        r = self._call("get", key.encode())
+        r = self._resilient("store.get",
+                            lambda: self._call("get", key.encode()))
         return r[1] if r[0] == b"ok" else None
 
     def add(self, key: str, amount: int = 1) -> int:

@@ -1,0 +1,38 @@
+"""Resilience: fault injection, retry/backoff, and the auto-resuming
+supervisor — the *acting* half of the elastic story (SURVEY §5.3).
+
+The reference's elastic manager is restart-based: kill, relaunch, resume
+from user checkpoints.  ``paddle_tpu.observability`` (PRs 1-2) made a
+failed run diagnosable; this package makes it survivable:
+
+- **Fault injection** (``faults.py``): deterministic, call-indexed fault
+  plans at registered sites (``ckpt.save``, ``ckpt.load``,
+  ``collective``, ``step``, ``store.get``, ``store.set``), configured in
+  code or via ``PDTPU_FAULTS``.  One falsy check when disabled (the
+  observability zero-overhead contract, enforced by the
+  ``telemetry-overhead`` CI gate).
+- **RetryPolicy** (``retry.py``): bounded exponential backoff with
+  deterministic jitter and a retryable-exception filter; applied to
+  ``launch.TCPStore`` ops and ``paddle_tpu.ckpt`` I/O; per-attempt
+  ``retry`` events into the metrics registry and flight-recorder ring.
+- **Supervisor / run_resilient** (``supervisor.py``): wraps
+  ``Engine.fit`` / ``hapi.Model.fit`` / custom step loops; on a
+  retryable or injected failure it restores the newest *valid*
+  checkpoint (``ckpt.latest_checkpoint(valid_only=True)`` skips torn and
+  corrupt directories), resumes at the recorded step, bounds restarts,
+  and cooperates with ``launch.PreemptionGuard``.
+
+The ``chaos`` CI gate (tools/ci.py) drives a tiny deterministic train
+run with a fault injected at every registered site and demands final
+params bitwise-equal to the fault-free run.  Docs: docs/RESILIENCE.md.
+"""
+
+from .faults import (FaultInjector, FaultPlan, InjectedFault,  # noqa: F401
+                     SITES, active_injector, clear_faults, install_faults,
+                     install_faults_from_env, parse_faults)
+from .retry import DEFAULT_RETRYABLE, RetryPolicy, retry_call  # noqa: F401
+from .supervisor import Supervisor, run_resilient  # noqa: F401
+
+# public namespace hygiene: no foreign-module re-exports (tools/check_api_compat)
+from paddle_tpu._export import public_all as _public_all
+__all__ = _public_all(globals())

@@ -1,0 +1,208 @@
+"""Deterministic fault injection for chaos testing.
+
+A *fault site* is a named point in the runtime where a failure is
+plausible in production: checkpoint I/O, rendezvous-store ops, a
+collective, a training step.  Each site does one falsy check against
+``_state.FAULTS`` (zero overhead when disabled — the observability
+contract, enforced by the ``telemetry-overhead`` CI gate); when an
+injector is installed, the site's per-call counter advances and any plan
+matching ``(site, call_index)`` raises its exception.
+
+Plans are deterministic and step-indexed: the N-th invocation of a site
+fires, never a random one, so a chaos run is exactly reproducible — the
+property the ``chaos`` CI gate leans on when it demands bitwise-equal
+final params between a faulted and a fault-free run.
+
+Spec grammar (code or the ``PDTPU_FAULTS`` env var)::
+
+    spec    = entry ("," | ";") entry ...
+    entry   = site "@" index ["x" times] [":" exc]
+    site    = ckpt.save | ckpt.load | collective | step | store.get | store.set
+    index   = 0-based per-site call counter value at which firing starts
+    times   = number of consecutive calls that fire (default 1)
+    exc     = InjectedFault | RuntimeError | OSError | ConnectionError
+              | TimeoutError | ValueError        (default InjectedFault)
+
+    PDTPU_FAULTS="ckpt.save@1,step@3x2:OSError"
+
+Pure stdlib: importable from ``launch.store`` and other featherweight
+modules without dragging jax in.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+
+from ..observability import _state as _obs_state
+from . import _state
+
+__all__ = ["SITES", "InjectedFault", "FaultPlan", "FaultInjector",
+           "parse_faults", "install_faults", "clear_faults",
+           "install_faults_from_env", "active_injector"]
+
+#: the registered fault sites — a plan for any other name is a spec typo,
+#: rejected at parse/construction time rather than silently never firing
+SITES = ("ckpt.save", "ckpt.load", "collective", "step",
+         "store.get", "store.set")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by the injector at a planned site.  Retryable by default
+    (``retry.DEFAULT_RETRYABLE``) so chaos runs exercise the same
+    recovery paths a transient production fault would."""
+
+
+_EXC_NAMES = {
+    "InjectedFault": InjectedFault,
+    "RuntimeError": RuntimeError,
+    "OSError": OSError,
+    "IOError": OSError,
+    "ConnectionError": ConnectionError,
+    "TimeoutError": TimeoutError,
+    "ValueError": ValueError,
+}
+
+_ENTRY_RE = re.compile(r"^(?P<site>[\w.]+)@(?P<at>\d+)(?:x(?P<times>\d+))?$")
+
+
+class FaultPlan:
+    """One deterministic fault: fire ``times`` consecutive calls of
+    ``site`` starting at per-site call index ``at`` (0-based)."""
+
+    __slots__ = ("site", "at", "times", "exc", "message")
+
+    def __init__(self, site, at, times=1, exc=InjectedFault, message=None):
+        if site not in SITES:
+            raise ValueError(
+                f"unknown fault site {site!r}; registered sites: {SITES}")
+        if int(times) < 1:
+            raise ValueError(f"fault times must be >= 1, got {times}")
+        self.site = site
+        self.at = int(at)
+        self.times = int(times)
+        self.exc = exc
+        self.message = message
+
+    def __repr__(self):
+        return (f"FaultPlan({self.site}@{self.at}x{self.times}"
+                f":{self.exc.__name__})")
+
+
+def parse_faults(spec):
+    """Parse a ``PDTPU_FAULTS``-grammar string into ``FaultPlan``s."""
+    plans = []
+    for entry in re.split(r"[,;]", spec):
+        entry = entry.strip()
+        if not entry:
+            continue
+        head, _, exc_name = entry.partition(":")
+        exc = InjectedFault
+        if exc_name:
+            exc_name = exc_name.strip()
+            if exc_name not in _EXC_NAMES:
+                raise ValueError(
+                    f"unknown fault exception {exc_name!r}; allowed: "
+                    f"{sorted(_EXC_NAMES)}")
+            exc = _EXC_NAMES[exc_name]
+        m = _ENTRY_RE.match(head.strip())
+        if m is None:
+            raise ValueError(
+                f"bad fault entry {entry!r}; grammar: "
+                "site@index[xTimes][:ExcName]")
+        plans.append(FaultPlan(m.group("site"), m.group("at"),
+                               times=m.group("times") or 1, exc=exc))
+    return plans
+
+
+class FaultInjector:
+    """Per-site call counters + the plans that fire against them.
+
+    Installed via :func:`install_faults`; producers call the injector
+    with a site name.  Thread-safe: ckpt faults may fire from the async
+    checkpoint writer thread while store faults fire from a heartbeat
+    thread."""
+
+    def __init__(self, plans):
+        if isinstance(plans, str):
+            plans = parse_faults(plans)
+        self.plans = list(plans)
+        self.fired = []          # [(site, call_index)] — audit log
+        self._calls = {}
+        self._lock = threading.Lock()
+
+    def calls(self, site):
+        """Lifetime invocation count of ``site`` (fired or not)."""
+        return self._calls.get(site, 0)
+
+    def __call__(self, site):
+        with self._lock:
+            n = self._calls.get(site, 0)
+            self._calls[site] = n + 1
+            plan = next((p for p in self.plans
+                         if p.site == site and p.at <= n < p.at + p.times),
+                        None)
+            if plan is None:
+                return
+            self.fired.append((site, n))
+        _emit_fault(site, n, plan)
+        raise plan.exc(plan.message
+                       or f"injected fault at {site} (call #{n})")
+
+
+def _emit_telemetry(event, counters=()):
+    """Shared guarded emit for the resilience vocabulary (``fault`` /
+    ``retry`` / ``resume`` / ``restart``): one falsy check when telemetry
+    is off, counter bumps + event fan-out when on, and never allowed to
+    raise — the callers sit inside recovery paths where a telemetry
+    failure must not mask (or become) the real exception."""
+    emit = _obs_state.EMIT[0]
+    if emit is None:
+        return
+    try:
+        from .. import observability as obs
+        reg = obs.get_registry()
+        if reg is not None:
+            for name in counters:
+                reg.counter(name).inc()
+        emit(event)
+    except Exception:
+        pass
+
+
+def _emit_fault(site, index, plan):
+    _emit_telemetry({"event": "fault", "site": site, "call": index,
+                     "exc": plan.exc.__name__},
+                    (f"fault[{site}].count",))
+
+
+def install_faults(plans):
+    """Install an injector (a :class:`FaultInjector`, a plan list, or a
+    spec string) into the hook container; returns it."""
+    inj = plans if isinstance(plans, FaultInjector) else FaultInjector(plans)
+    _state.FAULTS[0] = inj
+    return inj
+
+
+def clear_faults():
+    """Remove any installed injector (restores the zero-overhead path)."""
+    _state.FAULTS[0] = None
+
+
+def active_injector():
+    """The installed :class:`FaultInjector`, or None."""
+    return _state.FAULTS[0]
+
+
+def install_faults_from_env(var="PDTPU_FAULTS"):
+    """Install from the env spec if set; never clobbers an injector that
+    is already installed (code-configured plans win).  Returns the active
+    injector or None.  Called by the supervisor on entry so a launcher
+    can chaos-test a whole job with one env var."""
+    if _state.FAULTS[0] is not None:
+        return _state.FAULTS[0]
+    spec = os.environ.get(var)
+    if not spec:
+        return None
+    return install_faults(spec)

@@ -1,0 +1,313 @@
+"""Auto-resuming supervisor: the acting half of elasticity.
+
+``launch.PreemptionGuard`` and ``launch.elastic.ElasticManager`` detect
+trouble; this module makes a run *survive* it.  The supervisor owns a
+checkpoint directory and drives a training loop with a restart policy:
+
+1. **Bootstrap**: before the first step it writes a ``step_<start>``
+   checkpoint, so a valid fallback point exists from second zero.
+2. **Restore-first**: every (re)start loads the newest *valid* checkpoint
+   (``ckpt.latest_checkpoint(valid_only=True)`` — integrity-checked, so a
+   torn or corrupt newest directory is skipped in favor of the last good
+   one) and resumes at the step it recorded.  One code path for cold
+   start, restart-after-fault, and resume-after-relaunch.
+3. **Retry**: checkpoint I/O runs under the supervisor's ``RetryPolicy``;
+   a retryable step failure (transport error, injected chaos fault)
+   triggers restore + replay instead of a crash, bounded by
+   ``policy.max_attempts``.
+4. **Preemption**: with a ``PreemptionGuard`` attached, a SIGTERM makes
+   the supervisor checkpoint at the current step and return cleanly, so
+   the relaunched job resumes exactly where this one stopped.
+
+Determinism contract: ``step_fn`` (or the dataloader) must be a
+deterministic function of the step index for replay-after-restore to
+reproduce the fault-free run — the property the ``chaos`` CI gate
+asserts bitwise.  Events: ``resume``/``restart`` into the telemetry
+stream (one falsy check when disabled), schema in docs/RESILIENCE.md.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+from .faults import _emit_telemetry, install_faults_from_env
+from .retry import RetryPolicy
+
+__all__ = ["Supervisor", "run_resilient"]
+
+
+def _emit(event, counters=(), **fields):
+    _emit_telemetry({"event": event, **fields}, counters)
+
+
+class Supervisor:
+    """Checkpoint-directory owner + bounded-restart driver.
+
+    ``policy`` covers both per-I/O retries (passed through to ckpt
+    save/load) and the restart bound (``max_attempts`` total attempts of
+    the training loop).  ``keep`` prunes old checkpoints after each save,
+    always retaining at least 2 so last-good fallback stays possible.
+    """
+
+    def __init__(self, ckpt_dir, *, policy=None, save_every=1,
+                 prefix="step_", guard=None, keep=None):
+        if int(save_every) < 1:
+            raise ValueError(f"save_every must be >= 1, got {save_every}")
+        if keep is not None and int(keep) < 2:
+            raise ValueError(
+                "keep must be >= 2: pruning to a single checkpoint would "
+                "leave no last-good fallback when the newest one is torn")
+        self.ckpt_dir = ckpt_dir
+        self.policy = policy if policy is not None else RetryPolicy()
+        self.save_every = int(save_every)
+        self.prefix = prefix
+        self.guard = guard
+        self.keep = None if keep is None else int(keep)
+        # one env knob chaos-tests a whole job (never clobbers code plans)
+        install_faults_from_env()
+
+    # -- checkpoint plumbing ----------------------------------------------
+
+    def _ckpt(self):
+        from .. import ckpt  # lazy: keep this module jax-free at import
+        return ckpt
+
+    def path_for(self, step):
+        return os.path.join(self.ckpt_dir, f"{self.prefix}{int(step)}")
+
+    def step_of(self, path):
+        return int(os.path.basename(path)[len(self.prefix):])
+
+    def latest(self):
+        """Newest checkpoint that passes integrity verification."""
+        return self._ckpt().latest_checkpoint(self.ckpt_dir, self.prefix,
+                                              valid_only=True)
+
+    def _any_complete(self):
+        """Cheap structural probe (completeness only, no shard reads) —
+        just enough to decide whether a bootstrap save is needed, without
+        paying a full data verification that restore() repeats anyway."""
+        return self._ckpt().latest_checkpoint(self.ckpt_dir,
+                                              self.prefix) is not None
+
+    def save(self, state, step):
+        self._ckpt().save_state_dict(state, self.path_for(step),
+                                     retry=self.policy)
+        self._prune()
+
+    def restore(self, template):
+        """(state, step) from the newest valid checkpoint, or (None, 0)."""
+        path = self.latest()
+        if path is None:
+            return None, 0
+        # verify=False: latest() just data-verified every shard of this
+        # directory (valid_only) — re-checksumming inside the load would
+        # full-read each shard a second time on every (re)start
+        state = self._ckpt().load_state_dict(path, template=template,
+                                             retry=self.policy,
+                                             verify=False)
+        return state, self.step_of(path)
+
+    def _prune(self):
+        if self.keep is None:
+            return
+        steps = []
+        for name in os.listdir(self.ckpt_dir):
+            if not name.startswith(self.prefix):
+                continue
+            try:
+                steps.append(int(name[len(self.prefix):]))
+            except ValueError:
+                continue
+        for n in sorted(steps, reverse=True)[self.keep:]:
+            shutil.rmtree(self.path_for(n), ignore_errors=True)
+
+    @staticmethod
+    def abstract_template(state):
+        """Buffer-free restore template: shape/dtype/sharding structs for
+        array leaves (donation-proof — a live state pytree dies with the
+        next donated step; a struct template never does)."""
+        import jax
+
+        def leaf(x):
+            if isinstance(x, jax.Array):
+                return jax.ShapeDtypeStruct(
+                    x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+            return x
+        return jax.tree_util.tree_map(leaf, state)
+
+    # -- restart loop ------------------------------------------------------
+
+    def _restart_loop(self, attempt_fn):
+        """Run ``attempt_fn(restarts)``; on a retryable failure, back off
+        and re-enter (the attempt restores from the newest valid
+        checkpoint itself).  Bounded by ``policy.max_attempts``."""
+        from ..ckpt import CheckpointCorruptError
+        restarts = 0
+        while True:
+            try:
+                return attempt_fn(restarts)
+            except Exception as e:
+                # corruption is restartable here even though it is not
+                # *retryable*: the next attempt's valid_only restore
+                # skips the bad directory instead of re-reading it
+                recoverable = (self.policy.is_retryable(e)
+                               or isinstance(e, CheckpointCorruptError))
+                restarts += 1
+                if not recoverable or restarts >= self.policy.max_attempts:
+                    raise
+                _emit("restart", counters=("resilience.restarts",),
+                      exc=type(e).__name__, message=str(e),
+                      restarts=restarts)
+                self.policy.sleep(self.policy.delay_s(restarts,
+                                                      site="supervisor"))
+
+    def run(self, step_fn, state, num_steps, *, start_step=0):
+        """Drive ``state = step_fn(state, i)`` for ``i`` in
+        ``[start_step, num_steps)`` with checkpointing every
+        ``save_every`` steps, restore-first restarts, and preemption
+        cooperation.  Returns the final state."""
+        template = self.abstract_template(state)
+        if not self._any_complete():
+            self.save(state, start_step)   # bootstrap fallback point
+
+        def attempt(restarts):
+            st, step0 = self.restore(template)
+            if st is None:   # every existing checkpoint failed validation
+                st, step0 = state, start_step
+            if restarts or step0 != start_step:
+                _emit("resume", counters=("resilience.resumes",),
+                      step=step0, ckpt=self.path_for(step0),
+                      restarts=restarts)
+            i = step0
+            while i < num_steps:
+                if self.guard is not None and self.guard.preempted:
+                    self.save(st, i)
+                    _emit("preempt_stop", step=i)
+                    return st
+                st = step_fn(st, i)
+                i += 1
+                if i % self.save_every == 0 or i == num_steps:
+                    self.save(st, i)
+            return st
+
+        return self._restart_loop(attempt)
+
+
+def run_resilient(target, *, ckpt_dir, state=None, num_steps=None,
+                  train_data=None, epochs=1, policy=None, save_every=1,
+                  prefix="step_", guard=None, keep=None):
+    """Supervised training: survive retryable/injected faults by
+    restoring the last valid checkpoint and replaying.
+
+    Three target shapes:
+
+    - a **custom step function** ``step_fn(state, i) -> state`` — pass
+      ``state`` and ``num_steps``; returns the final state;
+    - a ``distributed.Engine`` (with loss+optimizer) — pass
+      ``train_data`` (re-iterable, deterministic order) and ``epochs``;
+      returns the last step's metrics;
+    - a ``hapi.Model`` (after ``prepare``) — same as Engine, batches are
+      split with the model's input/label convention.
+
+    The loop checkpoints every ``save_every`` steps under ``ckpt_dir``,
+    restores the newest *valid* checkpoint on entry (so re-running after
+    a crash or preemption resumes, not restarts), and bounds restarts by
+    ``policy.max_attempts``.  With ``guard`` (a ``PreemptionGuard``), a
+    SIGTERM checkpoints the current step and returns cleanly.
+    """
+    sup = Supervisor(ckpt_dir, policy=policy, save_every=save_every,
+                     prefix=prefix, guard=guard, keep=keep)
+    if callable(target) and not _is_fit_target(target):
+        if state is None or num_steps is None:
+            raise TypeError(
+                "run_resilient(step_fn, ...) needs state= and num_steps=")
+        return sup.run(target, state, num_steps)
+    if train_data is None:
+        raise TypeError(
+            "run_resilient(engine_or_model, ...) needs train_data=")
+    return _fit_resilient(sup, target, train_data, epochs)
+
+
+def _is_fit_target(target):
+    from ..distributed.engine import Engine
+    from ..hapi.model import Model
+    return isinstance(target, (Engine, Model))
+
+
+def _fit_resilient(sup, target, train_data, epochs):
+    """Step-granular supervised fit over Engine / hapi.Model: skip-replay
+    the (deterministic) loader up to the restored step, then train."""
+    from ..distributed.engine import Engine
+    from ..hapi.model import Model
+
+    if isinstance(target, Engine):
+        state0 = target.state            # builds the compiled step
+
+        def get_state():
+            return target.state
+
+        def set_state(s):
+            target._state = s
+
+        def loader():
+            return target._loader(train_data)
+
+        def one_step(batch):
+            target._state, m = target._step(target.state, batch)
+            return m
+    elif isinstance(target, Model):
+        state0 = target._ensure_state()
+
+        def get_state():
+            return target._ensure_state()
+
+        def set_state(s):
+            target._state = s
+
+        def loader():
+            return train_data
+
+        def one_step(batch):
+            inputs, labels = target._split_batch(batch)
+            loss, metric_out = target._train_one(inputs, labels)
+            return {"loss": loss, **metric_out}
+    else:
+        raise TypeError(
+            f"run_resilient target must be a step function, a "
+            f"distributed.Engine, or a hapi.Model; got {type(target)!r}")
+
+    template = sup.abstract_template(state0)
+    if not sup._any_complete():
+        sup.save(state0, 0)
+
+    def attempt(restarts):
+        st, start = sup.restore(template)
+        set_state(st)
+        if restarts or start:
+            _emit("resume", counters=("resilience.resumes",),
+                  step=start, ckpt=sup.path_for(start), restarts=restarts)
+        i, last = 0, None
+        for _epoch in range(epochs):
+            for batch in loader():
+                if i < start:
+                    i += 1            # replay the loader, not the compute
+                    continue
+                if sup.guard is not None and sup.guard.preempted:
+                    sup.save(get_state(), i)
+                    _emit("preempt_stop", step=i)
+                    return last
+                last = one_step(batch)
+                i += 1
+                if i % sup.save_every == 0:
+                    sup.save(get_state(), i)
+        if i % sup.save_every != 0:
+            sup.save(get_state(), i)
+        return last
+
+    metrics = sup._restart_loop(attempt)
+    if metrics is None:
+        return None
+    return {k: (float(v) if hasattr(v, "ndim") or hasattr(v, "item")
+                else v) for k, v in metrics.items()}

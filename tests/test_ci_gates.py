@@ -60,6 +60,16 @@ def test_telemetry_overhead_gate():
     assert "telemetry-overhead gate OK" in out
 
 
+def test_chaos_gate():
+    """Resilience end-to-end (tools/ci.py gate_chaos): with a fault
+    injected at every registered site, the supervised train run finishes
+    with params bitwise-equal to the fault-free run; with the newest
+    checkpoint corrupted, resume falls back to the previous valid one
+    and still reproduces the baseline."""
+    out = _run_gate("chaos", timeout=900)
+    assert "chaos gate OK" in out
+
+
 def test_api_compat_rejects_foreign_module_leak(monkeypatch):
     """A leaked implementation import (jax/os/...) reachable as a public
     attribute hard-fails collect() (VERDICT r4 weak #1: the gate must

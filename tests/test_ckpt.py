@@ -176,6 +176,94 @@ def test_missing_key_raises(tmp_path):
         ckpt.load_state_dict(d, template={"zzz": np.zeros(2)})
 
 
+class TestAsyncCheckpointerFailures:
+    """Background-save failure paths: the error must surface on the next
+    synchronization point (wait() or the following save()), and
+    overlapping saves must serialize in order."""
+
+    def test_background_error_reraised_from_wait(self, tmp_path,
+                                                 monkeypatch):
+        def boom(*a, **k):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(ckpt, "_write_entries", boom)
+        saver = ckpt.AsyncCheckpointer()
+        saver.save({"x": np.ones(2)}, str(tmp_path / "a"))
+        with pytest.raises(OSError, match="disk full"):
+            saver.wait()
+        # the error is consumed by the raise: a second wait is clean
+        saver.wait()
+
+    def test_background_error_reraised_from_next_save(self, tmp_path,
+                                                      monkeypatch):
+        calls = []
+        orig = ckpt._write_entries
+
+        def flaky(entries, path, overwrite=True):
+            calls.append(path)
+            if len(calls) == 1:
+                raise OSError("disk full")
+            orig(entries, path, overwrite)
+
+        monkeypatch.setattr(ckpt, "_write_entries", flaky)
+        saver = ckpt.AsyncCheckpointer()
+        saver.save({"x": np.ones(2)}, str(tmp_path / "a"))
+        # next save() waits for the failed one first and re-raises
+        with pytest.raises(OSError, match="disk full"):
+            saver.save({"x": np.ones(2)}, str(tmp_path / "b"))
+        # the failed-save error must not poison the checkpointer: the
+        # save after the raise goes through
+        saver.save({"x": np.full(2, 7.0)}, str(tmp_path / "c"))
+        saver.wait()
+        np.testing.assert_array_equal(
+            ckpt.load_state_dict(str(tmp_path / "c"))["x"], np.full(2, 7.0))
+
+    def test_overlapping_saves_serialize_in_order(self, tmp_path,
+                                                  monkeypatch):
+        import time
+        order = []
+        orig = ckpt._write_entries
+
+        def slow(entries, path, overwrite=True):
+            if not order:
+                time.sleep(0.3)   # first save lingers in the background
+            orig(entries, path, overwrite)
+            order.append(path)
+
+        monkeypatch.setattr(ckpt, "_write_entries", slow)
+        d = str(tmp_path / "ck")
+        saver = ckpt.AsyncCheckpointer()
+        saver.save({"x": np.full(2, 1.0)}, d)
+        saver.save({"x": np.full(2, 2.0)}, d)   # waits for save #1 first
+        saver.wait()
+        assert order == [d, d]
+        # the LAST save's payload wins — no torn interleaving
+        np.testing.assert_array_equal(ckpt.load_state_dict(d)["x"],
+                                      np.full(2, 2.0))
+
+    def test_async_save_retry_absorbs_transient(self, tmp_path,
+                                                monkeypatch):
+        from paddle_tpu import resilience as rs
+        calls = []
+        orig = ckpt._write_entries
+
+        def flaky(entries, path, overwrite=True):
+            calls.append(path)
+            if len(calls) == 1:
+                raise OSError("transient")
+            orig(entries, path, overwrite)
+
+        monkeypatch.setattr(ckpt, "_write_entries", flaky)
+        d = str(tmp_path / "ck")
+        saver = ckpt.AsyncCheckpointer(
+            retry=rs.RetryPolicy(max_attempts=2, backoff_s=0.0, jitter=0.0,
+                                 sleep=lambda _s: None))
+        saver.save({"x": np.ones(2)}, d)
+        saver.wait()   # transient absorbed in the background thread
+        np.testing.assert_array_equal(ckpt.load_state_dict(d)["x"],
+                                      np.ones(2))
+
+
 class TestOrbaxInterop:
     def test_roundtrip(self, tmp_path):
         import jax.numpy as jnp

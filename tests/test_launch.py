@@ -190,6 +190,22 @@ class TestCLI:
 
 
 class TestElasticManager:
+    def test_corrupt_heartbeat_counts_as_dead(self):
+        """An unparsable heartbeat payload (torn store write) must read
+        as a dead node, not crash the liveness watcher every other
+        node's recovery depends on."""
+        s = TCPStore(f"127.0.0.1:{free_port()}", is_master=True)
+        try:
+            em = ElasticManager(s, "ejc", node_rank=0, nnodes=2,
+                                timeout=0.3, heartbeat_period=0.1)
+            em.start()
+            s.set(em._key(1), b"not-a-float")
+            time.sleep(0.5)   # past the startup grace period
+            assert em.dead_nodes() == [1]
+            em.stop()
+        finally:
+            s.close()
+
     def test_heartbeat_and_dead_detection(self):
         s = TCPStore(f"127.0.0.1:{free_port()}", is_master=True)
         try:
@@ -309,6 +325,24 @@ class TestPreemptionGuard:
         for _ in range(10):
             state2, met2 = step2(state2, batch)
         assert float(met2["loss"]) < loss_at_preempt
+
+    def test_raising_save_fn_still_restores_handlers(self):
+        """A save_fn that raises on exit must not leave the SIGTERM
+        handler installed forever on a dead guard."""
+        import signal as sig
+        from paddle_tpu.launch import PreemptionGuard
+
+        prev = sig.getsignal(sig.SIGTERM)
+
+        def boom():
+            raise RuntimeError("ckpt write failed")
+
+        with pytest.raises(RuntimeError, match="ckpt write failed"):
+            with PreemptionGuard(save_fn=boom) as guard:
+                os.kill(os.getpid(), sig.SIGTERM)
+                time.sleep(0.05)
+                assert guard.preempted
+        assert sig.getsignal(sig.SIGTERM) is prev
 
     def test_guard_reusable_across_runs(self, tmp_path):
         import signal as sig

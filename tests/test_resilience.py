@@ -1,0 +1,515 @@
+"""Resilience subsystem tests: deterministic fault injection, retry with
+backoff, checkpoint integrity + last-good fallback, and the auto-resuming
+supervisor (docs/RESILIENCE.md).
+
+The load-bearing property throughout: a supervised run with injected
+faults must reproduce the fault-free run EXACTLY — recovery that loses or
+replays work incorrectly is worse than a crash (it corrupts training
+silently).  The chaos CI gate (tools/ci.py --only chaos) asserts the same
+contract end-to-end in a fresh process."""
+
+import os
+import signal as sig
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import paddle_tpu as pt
+from paddle_tpu import ckpt, nn, optimizer
+from paddle_tpu import resilience as rs
+from paddle_tpu.jit import TrainStep
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _no_persistent_compile_cache():
+    """Bitwise-reproducibility tests must not mix persistent-cache
+    DESERIALIZED executables with fresh compiles: on this jax/XLA the two
+    can differ numerically (and a torn cache entry can crash outright) —
+    the same reason the chaos gate runs uncached.  Compiles here are tiny
+    Linear(4,4) programs; caching buys nothing."""
+    jax.config.update("jax_enable_compilation_cache", False)
+    yield
+    jax.config.update("jax_enable_compilation_cache", True)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    rs.clear_faults()
+
+
+def _make_step():
+    pt.seed(0)
+    m = nn.Linear(4, 4)
+    opt = optimizer.AdamW(learning_rate=1e-2, parameters=m.parameters())
+    return TrainStep(m, lambda mm, b: ((mm(b["x"]) - b["y"]) ** 2).mean(),
+                     opt)
+
+
+def _batch_of(i):
+    r = np.random.default_rng(i)   # batch = f(step index): replayable
+    return {"x": jnp.asarray(r.normal(size=(4, 4)), jnp.float32),
+            "y": jnp.asarray(r.normal(size=(4, 4)), jnp.float32)}
+
+
+def _params_bytes(state):
+    return b"".join(np.asarray(l).tobytes()
+                    for l in jax.tree_util.tree_leaves(state["params"]))
+
+
+_NOSLEEP = dict(backoff_s=0.0, jitter=0.0, sleep=lambda _s: None)
+
+
+class TestFaultSpec:
+    def test_parse_grammar(self):
+        plans = rs.parse_faults("ckpt.save@1, step@3x2:OSError; store.get@0")
+        assert [(p.site, p.at, p.times) for p in plans] == [
+            ("ckpt.save", 1, 1), ("step", 3, 2), ("store.get", 0, 1)]
+        assert plans[1].exc is OSError
+        assert plans[0].exc is rs.InjectedFault
+
+    def test_bad_site_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault site"):
+            rs.parse_faults("nope@0")
+
+    def test_bad_exc_rejected(self):
+        # only a whitelist of exception names — an env var must not be
+        # able to name arbitrary types (and SystemExit would skip every
+        # recovery path)
+        with pytest.raises(ValueError, match="unknown fault exception"):
+            rs.parse_faults("step@0:SystemExit")
+
+    def test_bad_entry_rejected(self):
+        with pytest.raises(ValueError, match="grammar"):
+            rs.parse_faults("step")
+
+    def test_injector_counts_and_fires(self):
+        inj = rs.install_faults("step@1x2")
+        inj("step")                      # call 0: passes
+        for _ in range(2):               # calls 1-2: planned window
+            with pytest.raises(rs.InjectedFault):
+                inj("step")
+        inj("step")                      # call 3: plan exhausted
+        assert inj.calls("step") == 4
+        assert inj.fired == [("step", 1), ("step", 2)]
+
+    def test_env_install_and_no_clobber(self, monkeypatch):
+        monkeypatch.setenv("PDTPU_FAULTS", "collective@0")
+        inj = rs.install_faults_from_env()
+        assert inj is rs.active_injector()
+        with pytest.raises(rs.InjectedFault):
+            inj("collective")
+        # a code-configured injector is never clobbered by the env spec
+        assert rs.install_faults_from_env() is inj
+        rs.clear_faults()
+        assert rs.active_injector() is None
+
+
+class TestRetryPolicy:
+    def test_retries_then_succeeds(self):
+        sleeps = []
+        p = rs.RetryPolicy(max_attempts=3, backoff_s=0.01, multiplier=2.0,
+                           jitter=0.0, sleep=sleeps.append)
+        calls = [0]
+
+        def flaky():
+            calls[0] += 1
+            if calls[0] < 3:
+                raise ConnectionError("blip")
+            return 42
+
+        assert p.run(flaky, site="t") == 42
+        assert calls[0] == 3 and len(sleeps) == 2
+        assert sleeps[1] == pytest.approx(2 * sleeps[0])   # exponential
+
+    def test_gives_up_and_reraises_original(self):
+        p = rs.RetryPolicy(max_attempts=2, **_NOSLEEP)
+        with pytest.raises(OSError, match="disk"):
+            p.run(lambda: (_ for _ in ()).throw(OSError("disk")), site="t")
+
+    def test_non_retryable_raises_immediately(self):
+        sleeps = []
+        p = rs.RetryPolicy(max_attempts=5, backoff_s=0.0, jitter=0.0,
+                           sleep=sleeps.append)
+        with pytest.raises(ValueError):
+            p.run(lambda: (_ for _ in ()).throw(ValueError("logic")))
+        assert sleeps == []   # never slept: not a transient
+
+    def test_jitter_deterministic_and_bounded(self):
+        p = rs.RetryPolicy(backoff_s=0.1, multiplier=2.0, max_backoff_s=0.5,
+                           jitter=0.25)
+        assert p.delay_s(1, "x") == p.delay_s(1, "x")   # no RNG anywhere
+        for attempt in range(1, 8):
+            d = p.delay_s(attempt, "x")
+            assert 0.0 < d <= 0.5 * 1.25   # capped base * (1 + jitter)
+
+    def test_retry_events_and_counters(self):
+        import paddle_tpu.observability as obs
+        sink = obs.InMemorySink()
+        obs.enable(sinks=[sink], crash_hooks=False)
+        try:
+            p = rs.RetryPolicy(max_attempts=3, **_NOSLEEP)
+            calls = [0]
+
+            def flaky():
+                calls[0] += 1
+                if calls[0] < 2:
+                    raise TimeoutError("slow store")
+                return "ok"
+
+            assert p.run(flaky, site="store.get") == "ok"
+            evs = sink.events("retry")
+            assert len(evs) == 1
+            ev = evs[0]
+            assert ev["site"] == "store.get" and ev["attempt"] == 1
+            assert ev["exc"] == "TimeoutError" and "delay_s" in ev
+            reg = obs.get_registry()
+            assert reg.counter("retry[store.get].count").value == 1
+            # the event also landed in the flight-recorder ring
+            ring = [e for e in obs.get_flight_recorder().snapshot()
+                    if e.get("event") == "retry"]
+            assert ring
+        finally:
+            obs.disable()
+
+
+class TestStoreResilience:
+    def test_store_ops_survive_injected_faults(self):
+        from paddle_tpu.launch import TCPStore
+        from paddle_tpu.launch.store import free_port
+        rs.install_faults("store.set@0,store.get@0")
+        s = TCPStore(f"127.0.0.1:{free_port()}", is_master=True,
+                     retry=rs.RetryPolicy(max_attempts=3, **_NOSLEEP))
+        try:
+            s.set("k", b"v")
+            assert s.get("k") == b"v"
+            inj = rs.active_injector()
+            assert {f[0] for f in inj.fired} == {"store.set", "store.get"}
+        finally:
+            s.close()
+
+    def test_store_without_policy_raises(self):
+        from paddle_tpu.launch import TCPStore
+        from paddle_tpu.launch.store import free_port
+        s = TCPStore(f"127.0.0.1:{free_port()}", is_master=True)
+        try:
+            rs.install_faults("store.set@0")
+            with pytest.raises(rs.InjectedFault):
+                s.set("k", b"v")
+            rs.clear_faults()
+            s.set("k", b"v")          # the store itself is still healthy
+            assert s.get("k") == b"v"
+        finally:
+            s.close()
+
+
+class TestCkptIntegrity:
+    def test_checksums_recorded(self, tmp_path):
+        import json
+        d = str(tmp_path / "ck")
+        ckpt.save_state_dict({"w": np.arange(8.0, dtype=np.float32)}, d)
+        meta = json.load(open(os.path.join(d, "metadata.json")))
+        files = meta["arrays"]["w"]["files"]
+        assert all("crc32" in f and "nbytes" in f for f in files)
+        assert os.path.exists(os.path.join(d, "COMMITTED"))
+        assert ckpt.verify_checkpoint(d) == []
+
+    def test_corrupt_shard_raises_and_verify_false_skips(self, tmp_path):
+        d = str(tmp_path / "ck")
+        ckpt.save_state_dict({"w": np.arange(8.0, dtype=np.float32)}, d)
+        shard = next(f for f in os.listdir(d) if f.endswith(".npy"))
+        p = os.path.join(d, shard)
+        raw = bytearray(open(p, "rb").read())
+        raw[-1] ^= 0xFF
+        open(p, "wb").write(bytes(raw))
+        with pytest.raises(ckpt.CheckpointCorruptError, match="checksum"):
+            ckpt.load_state_dict(d)
+        assert ckpt.verify_checkpoint(d)          # non-empty problem list
+        ckpt.load_state_dict(d, verify=False)     # opt-out still reads
+
+    def test_missing_commit_sentinel_means_incomplete(self, tmp_path):
+        root = str(tmp_path)
+        d = os.path.join(root, "step_5")
+        ckpt.save_state_dict({"w": np.ones(2)}, d)
+        assert ckpt.latest_checkpoint(root) == d
+        os.remove(os.path.join(d, "COMMITTED"))
+        # a v2 directory without its sentinel is a torn save
+        assert ckpt.latest_checkpoint(root) is None
+        assert ckpt.verify_checkpoint(d)
+
+    def test_latest_valid_only_falls_back_past_corruption(self, tmp_path):
+        root = str(tmp_path)
+        for n in (2, 4):
+            ckpt.save_state_dict({"w": np.full(4, float(n))},
+                                 os.path.join(root, f"step_{n}"))
+        newest = os.path.join(root, "step_4")
+        shard = next(f for f in os.listdir(newest) if f.endswith(".npy"))
+        p = os.path.join(newest, shard)
+        raw = bytearray(open(p, "rb").read())
+        raw[-1] ^= 0xFF
+        open(p, "wb").write(bytes(raw))
+        # default: newest complete dir (corruption unseen without reads)
+        assert ckpt.latest_checkpoint(root) == newest
+        # valid_only: data-verified, falls back to the last GOOD one
+        assert ckpt.latest_checkpoint(root, valid_only=True) == \
+            os.path.join(root, "step_2")
+
+    def test_resave_overwrite_false_keeps_checksums(self, tmp_path):
+        """A re-save that reuses existing shard files (overwrite=False)
+        replaces the metadata — it must re-checksum the reused files, not
+        silently drop corruption detection for them."""
+        import json
+        d = str(tmp_path / "ck")
+        ckpt.save_state_dict({"w": np.arange(4.0)}, d)
+        ckpt.save_state_dict({"w": np.arange(4.0)}, d, overwrite=False)
+        meta = json.load(open(os.path.join(d, "metadata.json")))
+        assert all("crc32" in f for f in meta["arrays"]["w"]["files"])
+        shard = next(f for f in os.listdir(d) if f.endswith(".npy"))
+        p = os.path.join(d, shard)
+        raw = bytearray(open(p, "rb").read())
+        raw[-1] ^= 0xFF
+        open(p, "wb").write(bytes(raw))
+        with pytest.raises(ckpt.CheckpointCorruptError):
+            ckpt.load_state_dict(d)
+
+    def test_verify_checkpoint_reports_missing_shard(self, tmp_path):
+        d = str(tmp_path / "ck")
+        ckpt.save_state_dict({"w": np.ones(4)}, d)
+        shard = next(f for f in os.listdir(d) if f.endswith(".npy"))
+        os.remove(os.path.join(d, shard))
+        assert any("missing shard" in p for p in ckpt.verify_checkpoint(d))
+
+    def test_save_unlinks_tmp_on_failed_write(self, tmp_path, monkeypatch):
+        import pickle as _pickle
+        path = str(tmp_path / "m.pd")
+
+        def boom(*a, **k):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(_pickle, "dump", boom)
+        with pytest.raises(OSError):
+            ckpt.save({"w": np.ones(2)}, path)
+        monkeypatch.undo()
+        assert not os.path.exists(path + ".tmp")   # no debris
+        ckpt.save({"w": np.ones(2)}, path)         # clean retry-by-hand
+        np.testing.assert_array_equal(
+            np.asarray(ckpt.load(path)["w"]), np.ones(2))
+
+    def test_write_entries_unlinks_metadata_tmp_on_failure(self, tmp_path,
+                                                           monkeypatch):
+        import json as _json
+        d = str(tmp_path / "ck")
+
+        def boom(*a, **k):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(_json, "dump", boom)
+        with pytest.raises(OSError):
+            ckpt.save_state_dict({"w": np.ones(2)}, d)
+        monkeypatch.undo()
+        assert not [f for f in os.listdir(d) if ".tmp" in f]
+        ckpt.save_state_dict({"w": np.ones(2)}, d)   # debris-free re-save
+        assert ckpt.verify_checkpoint(d) == []
+
+    def test_ckpt_retry_absorbs_injected_faults(self, tmp_path):
+        d = str(tmp_path / "ck")
+        pol = rs.RetryPolicy(max_attempts=3, **_NOSLEEP)
+        rs.install_faults("ckpt.save@0,ckpt.load@0")
+        ckpt.save_state_dict({"w": np.arange(3.0)}, d, retry=pol)
+        out = ckpt.load_state_dict(d, retry=pol)
+        np.testing.assert_array_equal(out["w"], np.arange(3.0))
+        inj = rs.active_injector()
+        assert {f[0] for f in inj.fired} == {"ckpt.save", "ckpt.load"}
+
+
+class TestSupervisor:
+    def _run(self, ckpt_dir, num_steps=4, faults=None, calls=None,
+             max_attempts=4, guard=None):
+        rs.clear_faults()
+        if faults:
+            rs.install_faults(faults)
+        step = _make_step()
+
+        def step_fn(state, i):
+            if calls is not None:
+                calls.append(i)
+            st, _ = step(state, _batch_of(i))
+            return st
+
+        pol = rs.RetryPolicy(max_attempts=max_attempts, **_NOSLEEP)
+        final = rs.run_resilient(step_fn, state=step.init_state(),
+                                 num_steps=num_steps, ckpt_dir=ckpt_dir,
+                                 policy=pol, save_every=2, guard=guard)
+        return final
+
+    def test_fault_free_supervised_run_matches_plain_loop(self, tmp_path):
+        final = self._run(str(tmp_path / "ck"))
+        step = _make_step()
+        st = step.init_state()
+        for i in range(4):
+            st, _ = step(st, _batch_of(i))
+        assert _params_bytes(final) == _params_bytes(st)
+
+    def test_resume_after_step_fault_bitwise(self, tmp_path):
+        p0 = _params_bytes(self._run(str(tmp_path / "a")))
+        calls = []
+        p1 = _params_bytes(self._run(str(tmp_path / "b"), faults="step@3",
+                                     calls=calls))
+        assert p1 == p0
+        # the fault hit at i=3; the restart restored step_2 and replayed
+        # steps 2..3 — the call log shows the replay, not silent skips
+        assert calls == [0, 1, 2, 3, 2, 3]
+        assert rs.active_injector().fired == [("step", 3)]
+
+    def test_restart_bound_exhausts(self, tmp_path):
+        with pytest.raises(rs.InjectedFault):
+            self._run(str(tmp_path / "ck"), faults="step@0x99",
+                      max_attempts=2)
+
+    def test_non_retryable_step_error_propagates(self, tmp_path):
+        step = _make_step()
+
+        def bad_step(state, i):
+            raise ValueError("logic bug, not a transient")
+
+        pol = rs.RetryPolicy(max_attempts=5, **_NOSLEEP)
+        with pytest.raises(ValueError, match="logic bug"):
+            rs.run_resilient(bad_step, state=step.init_state(), num_steps=2,
+                             ckpt_dir=str(tmp_path / "ck"), policy=pol)
+
+    def test_corrupted_newest_falls_back_and_reproduces(self, tmp_path):
+        d = str(tmp_path / "ck")
+        p0 = _params_bytes(self._run(d))
+        newest = ckpt.latest_checkpoint(d)
+        assert newest.endswith("step_4")
+        shard = next(f for f in sorted(os.listdir(newest))
+                     if f.endswith(".npy"))
+        p = os.path.join(newest, shard)
+        raw = bytearray(open(p, "rb").read())
+        raw[-1] ^= 0xFF
+        open(p, "wb").write(bytes(raw))
+        # re-running the same job restores step_2 and replays to the end,
+        # reproducing the original params despite the torn newest ckpt
+        assert _params_bytes(self._run(d)) == p0
+
+    def test_preemption_guard_cooperation(self, tmp_path):
+        from paddle_tpu.launch import PreemptionGuard
+        d = str(tmp_path / "ck")
+        calls = []
+        with PreemptionGuard() as guard:
+            os.kill(os.getpid(), sig.SIGTERM)   # preempt before the loop
+            time.sleep(0.05)
+            assert guard.preempted
+            self._run(d, calls=calls, guard=guard)
+        # supervisor stopped at the preemption check: no steps ran, and a
+        # resumable checkpoint exists at the stop point
+        assert calls == []
+        assert ckpt.latest_checkpoint(d, valid_only=True) is not None
+
+    def test_resume_restart_events_emitted(self, tmp_path):
+        import paddle_tpu.observability as obs
+        sink = obs.InMemorySink()
+        obs.enable(sinks=[sink], crash_hooks=False)
+        try:
+            self._run(str(tmp_path / "ck"), faults="step@3")
+            kinds = [e.get("event") for e in sink.events()]
+            assert "fault" in kinds and "restart" in kinds \
+                and "resume" in kinds
+            resume = sink.events("resume")[0]
+            assert resume["step"] == 2 and resume["restarts"] == 1
+            reg = obs.get_registry()
+            assert reg.counter("resilience.restarts").value == 1
+        finally:
+            obs.disable()
+
+    def test_keep_prunes_but_retains_fallback(self, tmp_path):
+        d = str(tmp_path / "ck")
+        step = _make_step()
+        pol = rs.RetryPolicy(max_attempts=2, **_NOSLEEP)
+        rs.run_resilient(lambda st, i: step(st, _batch_of(i))[0],
+                         state=step.init_state(), num_steps=6,
+                         ckpt_dir=d, policy=pol, save_every=1, keep=2)
+        names = sorted(os.listdir(d))
+        assert names == ["step_5", "step_6"]
+        with pytest.raises(ValueError, match="keep"):
+            rs.Supervisor(d, keep=1)
+
+
+class TestFitResilient:
+    def _batches(self, n=6):
+        out = []
+        for i in range(n):
+            r = np.random.default_rng(100 + i)
+            out.append((jnp.asarray(r.normal(size=(4, 4)), jnp.float32),
+                        jnp.asarray(r.normal(size=(4, 4)), jnp.float32)))
+        return out
+
+    def _hapi_model(self):
+        from paddle_tpu.hapi import Model
+        pt.seed(0)
+        net = nn.Linear(4, 4)
+        model = Model(net)
+        model.prepare(
+            optimizer.AdamW(learning_rate=1e-2, parameters=net.parameters()),
+            lambda pred, label: ((pred - label) ** 2).mean())
+        return model
+
+    def test_hapi_fit_resumes_bitwise(self, tmp_path):
+        batches = self._batches()
+        pol = rs.RetryPolicy(max_attempts=4, **_NOSLEEP)
+
+        def fit(d, faults=None):
+            rs.clear_faults()
+            if faults:
+                rs.install_faults(faults)
+            model = self._hapi_model()
+            metrics = rs.run_resilient(model, train_data=batches, epochs=1,
+                                       ckpt_dir=d, policy=pol, save_every=2)
+            return _params_bytes(model._state), metrics
+
+        p0, m0 = fit(str(tmp_path / "a"))
+        p1, m1 = fit(str(tmp_path / "b"), faults="step@3")
+        assert p1 == p0
+        assert m1["loss"] == pytest.approx(m0["loss"])
+        assert rs.active_injector().fired == [("step", 3)]
+
+    def test_engine_fit_resumes_bitwise(self, tmp_path):
+        from paddle_tpu import distributed as dist
+        batches = [{"x": x, "y": y} for x, y in self._batches()]
+        pol = rs.RetryPolicy(max_attempts=4, **_NOSLEEP)
+
+        def fit(d, faults=None):
+            rs.clear_faults()
+            if faults:
+                rs.install_faults(faults)
+            pt.seed(0)
+            m = nn.Linear(4, 4)
+            eng = dist.Engine(
+                m, loss=lambda mm, b: ((mm(b["x"]) - b["y"]) ** 2).mean(),
+                optimizer=optimizer.AdamW(learning_rate=1e-2,
+                                          parameters=m.parameters()))
+            rs.run_resilient(eng, train_data=batches, epochs=1,
+                             ckpt_dir=d, policy=pol, save_every=2)
+            return _params_bytes(eng.state)
+
+        p0 = fit(str(tmp_path / "a"))
+        p1 = fit(str(tmp_path / "b"), faults="step@2")
+        assert p1 == p0
+
+    def test_rerun_after_completion_is_stable(self, tmp_path):
+        # re-invoking a finished supervised fit resumes past the end and
+        # must not retrain or corrupt the checkpoints
+        batches = self._batches(4)
+        pol = rs.RetryPolicy(max_attempts=2, **_NOSLEEP)
+        d = str(tmp_path / "ck")
+        model = self._hapi_model()
+        rs.run_resilient(model, train_data=batches, epochs=1,
+                         ckpt_dir=d, policy=pol, save_every=2)
+        p0 = _params_bytes(model._state)
+        model2 = self._hapi_model()
+        rs.run_resilient(model2, train_data=batches, epochs=1,
+                         ckpt_dir=d, policy=pol, save_every=2)
+        assert _params_bytes(model2._state) == p0

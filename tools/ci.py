@@ -24,9 +24,20 @@ in-repo gates over artifacts committed alongside the code:
                   zero-overhead (one falsy check — see
                   paddle_tpu/observability/_state.py): registry/sink
                   calls are poisoned and the dispatch cost is bounded
+                  (the fault-injection hook rides the same contract)
+
+  chaos           the resilience subsystem actually recovers: a tiny
+                  deterministic train run, supervised by
+                  resilience.run_resilient, must finish with final
+                  params BITWISE-equal to the fault-free run while a
+                  fault is injected at every registered site (step,
+                  collective, ckpt.save, ckpt.load, store.get/set);
+                  and with the newest checkpoint deliberately
+                  corrupted, resume must fall back to the previous
+                  valid one and still reproduce the same params
 
 Run all:  python tools/ci.py            (exit 0 = all gates pass)
-One:      python tools/ci.py --only api-compat|op-benchmark|memproof-lite|telemetry-overhead
+One:      python tools/ci.py --only api-compat|op-benchmark|memproof-lite|telemetry-overhead|chaos
 """
 
 from __future__ import annotations
@@ -249,17 +260,24 @@ def gate_telemetry_overhead(iters: int = 100_000,
         return 1
 
     # 4. an enable/disable cycle (recorder + watchdog + spans on) leaves
-    # the disabled path exactly as it was: all hooks None, poison-clean
+    # the disabled path exactly as it was: all hooks None, poison-clean.
+    # The fault-injection hook rides the same contract: an
+    # install/clear cycle must leave FAULTS None too.
+    from paddle_tpu import resilience as rs
+    from paddle_tpu.resilience import _state as rs_state
     tel = obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False,
                      watchdog_s=3600.0)
+    rs.install_faults("step@999999999")   # installed but never firing
     step(state, batch)
+    rs.clear_faults()
     obs.disable()
     hooks = {"MONITOR": obs_state.MONITOR[0],
              "COLLECTIVE": obs_state.COLLECTIVE[0],
              "EMIT": obs_state.EMIT[0],
              "SPAN": obs_state.SPAN[0],
              "RECORDER": obs_state.RECORDER[0],
-             "POSTMORTEM": obs_state.POSTMORTEM[0]}
+             "POSTMORTEM": obs_state.POSTMORTEM[0],
+             "FAULTS": rs_state.FAULTS[0]}
     stale = [k for k, v in hooks.items() if v is not None]
     if stale:
         print(f"telemetry-overhead gate FAILED: disable() left hook "
@@ -281,11 +299,174 @@ def gate_telemetry_overhead(iters: int = 100_000,
     return 0
 
 
+def gate_chaos(num_steps: int = 6, save_every: int = 2) -> int:
+    """Chaos gate: the resilience subsystem must turn injected faults
+    into retries/restarts that reproduce the fault-free run EXACTLY.
+
+    Five checks, all deterministic (docs/RESILIENCE.md):
+
+    1. BASELINE: a tiny supervised train run (Linear(4,4) + AdamW,
+       batches derived from the step index) with no faults.
+    2. PER-SITE FAULTS: the same run with a fault injected at each
+       registered train-path site (step, collective, ckpt.save,
+       ckpt.load — the load fires because the supervisor restores-first
+       on every start) must complete and end with params bitwise-equal
+       to the baseline.
+    3. ALL-AT-ONCE: one run with faults at every one of those sites.
+    4. STORE: TCPStore set/get survive injected store.set/store.get
+       faults under a RetryPolicy (and raise without one).
+    5. FALLBACK: with the newest checkpoint's shard bytes flipped,
+       ``latest_checkpoint(valid_only=True)`` lands on the previous
+       valid directory, and a resumed supervised run still reproduces
+       the baseline params bitwise.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import tempfile
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu import ckpt, distributed as dist, nn, optimizer
+    from paddle_tpu import resilience as rs
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.launch import TCPStore
+    from paddle_tpu.launch.store import free_port
+
+    # NO persistent compile cache here, deliberately: the gate's whole
+    # contract is bitwise reproducibility, and mixing cache-hit
+    # executables from older sessions with fresh compiles has been
+    # observed to break it.  The programs are tiny; compiling them
+    # fresh keeps every run of this gate self-contained.
+
+    def make_step():
+        pt.seed(0)
+        m = nn.Linear(4, 4)
+        opt = optimizer.AdamW(learning_rate=1e-2,
+                              parameters=m.parameters())
+        return TrainStep(
+            m, lambda mm, b: ((mm(b["x"]) - b["y"]) ** 2).mean(), opt)
+
+    def batch_of(i):
+        r = np.random.default_rng(i)   # batch = f(step index): replayable
+        return {"x": jnp.asarray(r.normal(size=(4, 4)), jnp.float32),
+                "y": jnp.asarray(r.normal(size=(4, 4)), jnp.float32)}
+
+    def params_bytes(state):
+        return b"".join(np.asarray(l).tobytes()
+                        for l in jax.tree_util.tree_leaves(state["params"]))
+
+    policy = rs.RetryPolicy(max_attempts=4, backoff_s=0.0, jitter=0.0,
+                            sleep=lambda _s: None)
+
+    def run(ckpt_dir, faults=None):
+        rs.clear_faults()
+        if faults:
+            rs.install_faults(faults)
+        try:
+            step = make_step()
+
+            def step_fn(state, i):
+                st, _metrics = step(state, batch_of(i))
+                # eager collective on the no-op world group: exercises
+                # the "collective" fault site without a multi-host run
+                dist.all_reduce(jnp.zeros(()))
+                return st
+
+            final = rs.run_resilient(step_fn, state=step.init_state(),
+                                     num_steps=num_steps, ckpt_dir=ckpt_dir,
+                                     policy=policy, save_every=save_every)
+            return params_bytes(final)
+        finally:
+            rs.clear_faults()
+
+    failures = []
+    with tempfile.TemporaryDirectory() as root:
+        base_dir = os.path.join(root, "baseline")
+        p0 = run(base_dir)
+
+        site_faults = {
+            "step": "step@3",
+            "collective": "collective@4",
+            "ckpt.save": "ckpt.save@1",
+            "ckpt.load": "ckpt.load@0",
+        }
+        for site, spec in site_faults.items():
+            p = run(os.path.join(root, site.replace(".", "_")), spec)
+            ok = p == p0
+            print(f"chaos: fault at {site:10s} ({spec}): params "
+                  f"{'bitwise-equal' if ok else 'DIVERGED'}")
+            if not ok:
+                failures.append(f"{site}: params diverged from fault-free run")
+        p = run(os.path.join(root, "all_sites"),
+                ",".join(site_faults.values()))
+        if p != p0:
+            failures.append("all-sites run: params diverged")
+        else:
+            print("chaos: all sites at once: params bitwise-equal")
+
+        # store.set / store.get: retried under a policy, raise without one
+        rs.install_faults("store.set@0,store.get@0")
+        s = TCPStore(f"127.0.0.1:{free_port()}", is_master=True,
+                     retry=policy)
+        try:
+            s.set("chaos", b"ok")
+            got = s.get("chaos")
+            inj = rs.active_injector()
+            if got != b"ok" or {f[0] for f in inj.fired} != {"store.set",
+                                                            "store.get"}:
+                failures.append(
+                    f"store faults not absorbed by retry (got {got!r}, "
+                    f"fired {inj.fired})")
+            else:
+                print("chaos: store.set/store.get faults absorbed by retry")
+        finally:
+            s.close()
+            rs.clear_faults()
+
+        # fallback: corrupt the newest checkpoint of the baseline dir,
+        # then resume — must land on the previous valid one and still
+        # reproduce the baseline params
+        newest = ckpt.latest_checkpoint(base_dir)
+        shard = next(f for f in sorted(os.listdir(newest))
+                     if f.endswith(".npy"))
+        fpath = os.path.join(newest, shard)
+        raw = bytearray(open(fpath, "rb").read())
+        raw[-1] ^= 0xFF
+        open(fpath, "wb").write(bytes(raw))
+        fallback = ckpt.latest_checkpoint(base_dir, valid_only=True)
+        want = os.path.join(base_dir, f"step_{num_steps - save_every}")
+        if fallback != want:
+            failures.append(
+                f"corrupted newest: valid_only fallback returned "
+                f"{fallback}, wanted {want}")
+        else:
+            print(f"chaos: corrupt newest skipped, fallback to "
+                  f"{os.path.basename(want)}")
+            if run(base_dir) != p0:
+                failures.append(
+                    "resume from fallback checkpoint diverged from baseline")
+            else:
+                print("chaos: resume from fallback reproduces baseline "
+                      "params bitwise")
+
+    if failures:
+        print("chaos gate FAILED — resilience does not reproduce the "
+              "fault-free run (docs/RESILIENCE.md):")
+        for f_ in failures:
+            print(f"  {f_}")
+        return 1
+    print("chaos gate OK")
+    return 0
+
+
 GATES = {
     "api-compat": gate_api_compat,
     "op-benchmark": gate_op_benchmark,
     "memproof-lite": gate_memproof_lite,
     "telemetry-overhead": gate_telemetry_overhead,
+    "chaos": gate_chaos,
 }
 
 

@@ -80,6 +80,10 @@ def summarize(events):
         "compiles": defaultdict(lambda: {"n": 0, "total_ms": 0.0}),
         "storms": [], "preemptions": [], "hangs": [], "postmortems": [],
         "thread_stacks": [], "metrics": None, "bench_result": None,
+        # resilience vocabulary (docs/RESILIENCE.md): per-site retry /
+        # injected-fault counts, plus resume/restart occurrences
+        "retries": defaultdict(int), "faults": defaultdict(int),
+        "resumes": [], "restarts": [],
     }
     for e in events:
         kind = e.get("event")
@@ -105,6 +109,14 @@ def summarize(events):
             c = agg["compiles"][e.get("site", "?")]
             c["n"] += 1
             c["total_ms"] += e.get("duration_ms") or 0.0
+        elif kind == "retry":
+            agg["retries"][e.get("site") or "?"] += 1
+        elif kind == "fault":
+            agg["faults"][e.get("site") or "?"] += 1
+        elif kind == "resume":
+            agg["resumes"].append(e)
+        elif kind == "restart":
+            agg["restarts"].append(e)
         elif kind == "recompile_storm":
             agg["storms"].append(e)
         elif kind == "preemption":
@@ -174,6 +186,19 @@ def render(agg, malformed=0):
                 f"| {op} | {coll.get(f'collective.{op}.calls', 0)} "
                 f"| {coll.get(f'collective.{op}.bytes', 0):,} |")
         lines.append("")
+    if agg["retries"] or agg["faults"]:
+        lines += ["| Resilience site | Retries | Injected faults |",
+                  "|---|---|---|"]
+        for site in sorted(set(agg["retries"]) | set(agg["faults"])):
+            lines.append(f"| {site} | {agg['retries'].get(site, 0)} "
+                         f"| {agg['faults'].get(site, 0)} |")
+        lines.append("")
+    for r in agg["resumes"]:
+        lines.append(f"**RESUME**: step {r.get('step')} from "
+                     f"`{r.get('ckpt')}` (restart {r.get('restarts')})")
+    for r in agg["restarts"]:
+        lines.append(f"**RESTART** #{r.get('restarts')}: {r.get('exc')}: "
+                     f"{r.get('message')}")
     for st in storms:
         lines.append(f"**RECOMPILE STORM**: `{st.get('site')}` — "
                      f"{st.get('compiles_after_warmup')} compiles beyond "
@@ -209,7 +234,9 @@ def render(agg, malformed=0):
                              f"{' (daemon)' if ts_.get('daemon') else ''}: "
                              f"{tail}")
     if not (steps or agg["spans"] or compiles or coll or storms
-            or preemptions or agg["hangs"] or agg["postmortems"]):
+            or preemptions or agg["hangs"] or agg["postmortems"]
+            or agg["retries"] or agg["faults"] or agg["resumes"]
+            or agg["restarts"]):
         lines.append("(no telemetry events found)")
     return "\n".join(lines)
 
@@ -244,6 +271,10 @@ def main(argv=None) -> int:
         "storms": len(agg["storms"]),
         "preemptions": len(agg["preemptions"]),
         "hangs": len(agg["hangs"]),
+        "retries": dict(sorted(agg["retries"].items())),
+        "faults": dict(sorted(agg["faults"].items())),
+        "resumes": len(agg["resumes"]),
+        "restarts": len(agg["restarts"]),
         "postmortems": [pm.get("reason") for pm in agg["postmortems"]],
         "thread_stacks": len(agg["thread_stacks"]),
     }
