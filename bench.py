@@ -197,6 +197,35 @@ def main():
         except Exception as e:  # noqa: BLE001
             extra["decode_error"] = f"{type(e).__name__}: {e}"[:300]
 
+    # aggregate continuous-batching serving throughput (serving.Engine
+    # over the paged KV pools — docs/SERVING.md): mixed prompt lengths
+    # churning through max_batch=8 slots.  Runs on CPU too (tiny preset,
+    # small budget) so the metric's PLUMBING is exercised everywhere;
+    # the numbers that matter come from TPU rounds.  Non-fatal like the
+    # other extras.
+    if os.environ.get("PDTPU_BENCH_SERVE", "1") == "1":
+        try:
+            import contextlib
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "tools"))
+            from decode_bench import bench_serve
+            with contextlib.redirect_stdout(sys.stderr):
+                if on_tpu:
+                    r = bench_serve(max_batch=8, kv_cache_dtype="int8")
+                else:
+                    r = bench_serve(preset="tiny", max_batch=4,
+                                    n_requests=6, max_new=8,
+                                    prompt_lens=(5, 12, 9, 17),
+                                    page_size=8, repeats=1)
+            extra["serve_bs8_tok_s" if on_tpu else "serve_cpu_tok_s"] = \
+                r["agg_tokens_per_sec"]
+            extra["serve_detail"] = {k: r[k] for k in
+                                     ("max_batch", "requests", "kv",
+                                      "max_new_tokens", "gen_tokens",
+                                      "wall_s")}
+        except Exception as e:  # noqa: BLE001
+            extra["serve_error"] = f"{type(e).__name__}: {e}"[:300]
+
     result = {
         "metric": "llama_train_mfu",
         "value": round(mfu, 4),

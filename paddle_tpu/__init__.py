@@ -74,7 +74,7 @@ def __getattr__(name):
                 "utils", "config", "sparse", "quantization", "inference",
                 "audio", "distribution", "geometric", "signal", "regularizer",
                 "callbacks", "text", "hub", "onnx", "observability",
-                "resilience"):
+                "resilience", "serving"):
         try:
             mod = importlib.import_module(f".{name}", __name__)
         except ModuleNotFoundError as e:
@@ -125,7 +125,7 @@ def __dir__():
         "vision", "incubate", "hapi", "static", "device", "launch", "utils",
         "config", "sparse", "quantization", "inference", "audio",
         "distribution", "geometric", "signal", "regularizer", "callbacks",
-        "text", "hub", "onnx", "observability", "resilience",
+        "text", "hub", "onnx", "observability", "resilience", "serving",
         "Model", "DataParallel", "flops", "summary", "version", "metric",
         "enable_static", "disable_static", "in_dynamic_mode"})
 

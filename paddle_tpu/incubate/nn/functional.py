@@ -277,24 +277,9 @@ def paged_attention(q, k_cache, v_cache, block_tables, context_lens,
                      scale=scale)
         if out is not None:
             return out
-    b, h, d = q.shape
-    nb, bs, h_kv, _ = k_cache.shape
-    mb = block_tables.shape[1]
-    scale = scale if scale is not None else 1.0 / math.sqrt(d)
-    # (B, mb, bs, H_kv, D) → (B, S=mb*bs, H_kv, D)
-    k = k_cache[block_tables].reshape(b, mb * bs, h_kv, d)
-    v = v_cache[block_tables].reshape(b, mb * bs, h_kv, d)
-    rep = h // h_kv
-    if rep > 1:
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    scores = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) * scale
-    mask = jnp.arange(mb * bs)[None, None, :] < context_lens[:, None, None]
-    scores = jnp.where(mask, scores, -jnp.inf)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhs,bshd->bhd", probs, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    k, v = _paged_gather_dense(k_cache, v_cache, block_tables)
+    return _attend_dense_gqa(q, k, v, context_lens, scale)
 
 
 def write_paged_kv(k_cache, v_cache, new_k, new_v, block_tables,
@@ -310,6 +295,118 @@ def write_paged_kv(k_cache, v_cache, new_k, new_v, block_tables,
     k_cache = k_cache.at[blk, off].set(new_k)
     v_cache = v_cache.at[blk, off].set(new_v)
     return k_cache, v_cache
+
+
+def _paged_gather_dense(k_cache, v_cache, block_tables, k_scale=None,
+                        v_scale=None):
+    """Gather a batch's pages from the pool into dense (B, S, H_kv, D)
+    fp32 K/V — dequantizing through the per-(position, head) scales for
+    int8 pools.  Only the gathered blocks materialize, never the pool."""
+    nb, bs, h_kv, d = k_cache.shape
+    b, mb = block_tables.shape
+    k = k_cache[block_tables].reshape(b, mb * bs, h_kv, d)
+    v = v_cache[block_tables].reshape(b, mb * bs, h_kv, d)
+    if k_scale is not None:
+        k = k.astype(jnp.float32) * \
+            k_scale[block_tables].reshape(b, mb * bs, h_kv)[..., None]
+        v = v.astype(jnp.float32) * \
+            v_scale[block_tables].reshape(b, mb * bs, h_kv)[..., None]
+    return k, v
+
+
+def _attend_dense_gqa(q, k, v, context_lens, scale):
+    """Masked decode attention over dense (B, S, H_kv, D) K/V without
+    repeating KV across the GQA groups (shared by the paged fallbacks)."""
+    b, h, d = q.shape
+    s = k.shape[1]
+    h_kv = k.shape[2]
+    g = h // h_kv
+    qg = q.reshape(b, h_kv, g, d).astype(jnp.float32)
+    scores = jnp.einsum("bkgd,bskd->bkgs", qg, k.astype(jnp.float32),
+                        preferred_element_type=jnp.float32) * scale
+    mask = jnp.arange(s)[None, None, None, :] < \
+        context_lens[:, None, None, None]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgs,bskd->bkgd", probs, v.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, h, d).astype(q.dtype)
+
+
+def paged_decode_attend(cache, q, new_k, new_v, block_tables, write_pos,
+                        scale: Optional[float] = None):
+    """One decode step against PAGED pools — the serving analogue of
+    :func:`decode_attend_cache`, sharing its cache-arity dispatch.
+
+    ``cache`` is the per-layer pool tuple: fp ``(k, v)`` with shape
+    ``(num_blocks, page, H_kv, D)``, or int8-quantized
+    ``(k_i8, v_i8, k_scale, v_scale)`` with ``(num_blocks, page, H_kv)``
+    f32 scales (the :func:`quantize_kv` formula, same as the dense
+    4-tuple caches).  ``write_pos`` (B,) is the new token's position —
+    i.e. the number of tokens already cached; the step writes this
+    token's ``(B, H_kv, D)`` k/v at that position and attends over
+    ``write_pos + 1`` tokens.
+
+    A slot whose block-table entries are out of range (the serving
+    scheduler's inactive-slot sentinel) drops its write (out-of-bounds
+    scatter) and produces a garbage-but-finite output the caller
+    discards — nothing a dead slot does can corrupt live blocks.
+
+    Returns ``(out, new_cache)``.
+    """
+    bs = cache[0].shape[1]
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    ctx = write_pos + 1
+    if len(cache) == 4:
+        kc, vc, ks, vs = cache
+        blk = jnp.take_along_axis(block_tables, (write_pos // bs)[:, None],
+                                  axis=1)[:, 0]
+        off = write_pos % bs
+        k_q, ks_new = quantize_kv(new_k)
+        v_q, vs_new = quantize_kv(new_v)
+        kc = kc.at[blk, off].set(k_q)
+        vc = vc.at[blk, off].set(v_q)
+        ks = ks.at[blk, off].set(ks_new)
+        vs = vs.at[blk, off].set(vs_new)
+        # int8 pools attend through the XLA gather+dequant formulation on
+        # every backend: the Pallas kernel is fp-only, and int8 halves
+        # the gathered bytes, which is the traffic that matters
+        kd, vd = _paged_gather_dense(kc, vc, block_tables, ks, vs)
+        out = _attend_dense_gqa(q, kd, vd, ctx, scale)
+        return out, (kc, vc, ks, vs)
+    kc, vc = cache
+    kc, vc = write_paged_kv(kc, vc, new_k.astype(kc.dtype),
+                            new_v.astype(vc.dtype), block_tables, ctx)
+    out = paged_attention(q, kc, vc, block_tables, ctx, scale=scale)
+    return out, (kc, vc)
+
+
+def paged_prefill_write(cache, k, v, block_tables, prompt_lens):
+    """Scatter a prefill chunk ``k``/``v`` (B, S, H_kv, D) into the paged
+    pools at positions ``[0, prompt_lens)`` of each sequence.
+
+    The chunk may be padded past the real prompt (fixed-shape prefill
+    buckets): positions ``>= prompt_lens`` get an out-of-range block id
+    and are DROPPED by the scatter, so padding never lands in the pool.
+    Same cache-arity dispatch as :func:`paged_decode_attend`."""
+    b, s = k.shape[:2]
+    nb, bs = cache[0].shape[:2]
+    mb = block_tables.shape[1]
+    pos = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    blk = jnp.take_along_axis(block_tables, jnp.minimum(pos // bs, mb - 1),
+                              axis=1)
+    blk = jnp.where(pos < prompt_lens[:, None], blk, nb)  # OOB → dropped
+    off = pos % bs
+    if len(cache) == 4:
+        kc, vc, ks, vs = cache
+        k_q, ks_new = quantize_kv(k)
+        v_q, vs_new = quantize_kv(v)
+        return (kc.at[blk, off].set(k_q), vc.at[blk, off].set(v_q),
+                ks.at[blk, off].set(ks_new), vs.at[blk, off].set(vs_new))
+    kc, vc = cache
+    return (kc.at[blk, off].set(k.astype(kc.dtype)),
+            vc.at[blk, off].set(v.astype(vc.dtype)))
 
 
 def variable_length_memory_efficient_attention(q, k, v, seq_lens=None,

@@ -33,13 +33,19 @@ class Config:
 
 
 class Predictor:
-    """paddle_infer.Predictor parity: run() over named/positional inputs."""
+    """paddle_infer.Predictor parity: run() over named/positional inputs.
+
+    ``run()`` executes through a real AOT executable: the first call (or
+    an explicit :meth:`warmup`) does ``jit.lower(*args).compile()`` —
+    the reference's analysis/optimization-pass moment — and subsequent
+    calls dispatch the compiled artifact directly.  ``__call__`` keeps
+    the plain jit path (trace-compatible, e.g. under vmap/grad)."""
 
     def __init__(self, config: Config):
         self._config = config
         if config.model_path is not None:
             from .. import jit as pjit
-            self._fn = pjit.load(config.model_path)
+            self._fn = jax.jit(pjit.load(config.model_path))
         else:
             model = config.model
             from ..nn.layer import Layer, functional_call, serving_params
@@ -54,9 +60,48 @@ class Predictor:
             else:
                 self._fn = jax.jit(model)
         self._compiled = None
+        self._compiled_key = None
+        self._executables = {}   # arg_key -> compiled executable
+
+    @staticmethod
+    def _arg_key(args):
+        # the treedef matters, not just the leaves: run(x, y) and
+        # run((x, y)) flatten to the same leaves but need different
+        # executables (an AOT artifact is fixed to one call structure)
+        leaves, treedef = jax.tree.flatten(list(args))
+        return (treedef, tuple(
+            (tuple(getattr(leaf, "shape", ())),
+             str(getattr(leaf, "dtype", type(leaf).__name__)))
+            for leaf in leaves))
+
+    def warmup(self, *example_args) -> "Predictor":
+        """AOT-compile for the given (or ``Config.example_args``) input
+        shapes; ``run()`` then dispatches the compiled executable."""
+        args = example_args or tuple(self._config.example_args or ())
+        if not args:
+            raise ValueError(
+                "warmup() needs example inputs: pass them here or in "
+                "Config(example_args=...)")
+        key = self._arg_key(args)
+        compiled = self._executables.get(key)
+        if compiled is None:
+            compiled = self._fn.lower(*args).compile()
+            # recorded only after a SUCCESSFUL compile: a raising
+            # lower/compile must not leave a stale executable keyed to
+            # the new geometry
+            self._executables[key] = compiled
+        self._compiled = compiled
+        self._compiled_key = key
+        return self
 
     def run(self, *inputs):
-        out = self._fn(*inputs)
+        # AOT memo per input geometry (like the jit cache it replaces):
+        # a NEW geometry lowers+compiles once, alternating geometries
+        # dispatch their recorded executables
+        if self._compiled is None or self._arg_key(inputs) != \
+                self._compiled_key:
+            self.warmup(*inputs)
+        out = self._compiled(*inputs)
         return jax.tree.leaves(out) if not isinstance(out, (list, tuple)) \
             else list(out)
 

@@ -104,7 +104,8 @@ class GPTAttention(Layer):
                                           sequence_parallel=sp)
         self.dropout = Dropout(cfg.hidden_dropout)
 
-    def forward(self, x, attn_mask=None, cache=None, seq_lens=None):
+    def forward(self, x, attn_mask=None, cache=None, seq_lens=None,
+                block_tables=None):
         cfg = self.cfg
         b, s = x.shape[:2]
         qkv = self.qkv_proj(x).reshape(b, s, 3, cfg.num_attention_heads,
@@ -113,6 +114,25 @@ class GPTAttention(Layer):
         q = constrain(q, ("dp", "sharding"), None, "mp", None)
         k = constrain(k, ("dp", "sharding"), None, "mp", None)
         v = constrain(v, ("dp", "sharding"), None, "mp", None)
+        if cache is not None and block_tables is not None:
+            # paged KV pools (serving.Engine) — see LlamaAttention
+            from ..incubate.nn.functional import (paged_decode_attend,
+                                                  paged_prefill_write)
+            if s == 1 and seq_lens is not None:
+                out, new_cache = paged_decode_attend(
+                    cache, q[:, 0], k[:, 0], v[:, 0], block_tables,
+                    seq_lens)
+                out = out[:, None].reshape(b, s, cfg.hidden_size)
+                return self.dropout(self.out_proj(out)), new_cache
+            plens = seq_lens if seq_lens is not None else \
+                jnp.full((b,), s, jnp.int32)
+            new_cache = paged_prefill_write(cache, k, v, block_tables,
+                                            plens)
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True,
+                dropout_p=cfg.attention_dropout, training=self.training)
+            out = out.reshape(b, s, cfg.hidden_size)
+            return self.dropout(self.out_proj(out)), new_cache
         if cache is not None and s == 1 and seq_lens is not None:
             # single-token decode against the dense (or int8-quantized
             # 4-tuple) KV cache — shared cache-arity dispatch
@@ -157,6 +177,7 @@ class GPTMLP(Layer):
 class GPTDecoderLayer(Layer):
     returns_aux = False
     supports_cache = True
+    supports_paged = True   # paged-pool serving path (serving.Engine)
 
     def __init__(self, cfg: GPTConfig):
         super().__init__()
@@ -165,10 +186,12 @@ class GPTDecoderLayer(Layer):
         self.ln_2 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
         self.mlp = GPTMLP(cfg)
 
-    def forward(self, x, attn_mask=None, cache=None, seq_lens=None):
+    def forward(self, x, attn_mask=None, cache=None, seq_lens=None,
+                block_tables=None):
         if cache is not None:
             attn, cache = self.attn(self.ln_1(x), attn_mask, cache=cache,
-                                    seq_lens=seq_lens)
+                                    seq_lens=seq_lens,
+                                    block_tables=block_tables)
             x = x + attn
             x = x + self.mlp(self.ln_2(x))
             return x, cache
@@ -178,6 +201,8 @@ class GPTDecoderLayer(Layer):
 
 
 class GPTModel(Layer):
+    decoder_layer_cls: type = GPTDecoderLayer
+
     def __init__(self, cfg: GPTConfig):
         super().__init__()
         self.cfg = cfg
@@ -251,8 +276,11 @@ class GPTModel(Layer):
             cfg.num_attention_heads, cfg.head_dim,
             dtype if dtype is not None else cfg.dtype)
 
-    def _forward_cached(self, input_ids, caches, seq_lens):
+    def _forward_cached(self, input_ids, caches, seq_lens,
+                        block_tables=None):
         """Prefill (seq_lens None) or one-token decode against the caches.
+        With ``block_tables`` the caches are paged pools (serving path);
+        prefill then takes ``seq_lens`` as the real prompt lengths.
         Returns (hidden, new_caches)."""
         b, s = input_ids.shape
         decode = (s == 1 and seq_lens is not None)
@@ -260,15 +288,18 @@ class GPTModel(Layer):
                else jnp.arange(s)[None, :])
         x = self.embed_tokens(input_ids) + self.embed_positions(pos)
         x = self.embed_dropout(x)
+        kw = {} if block_tables is None else {"block_tables": block_tables}
+        lens_arg = seq_lens if (decode or block_tables is not None) \
+            else None
         from .generation import run_cached_layers
         x, new_caches = run_cached_layers(
             self.h, x, caches,
             lambda inner, x, cache: inner(
-                x, cache=cache, seq_lens=seq_lens if decode else None))
+                x, cache=cache, seq_lens=lens_arg, **kw))
         return self.ln_f(x), new_caches
 
     def forward(self, input_ids, attn_mask=None, position_ids=None,
-                caches=None, seq_lens=None):
+                caches=None, seq_lens=None, block_tables=None):
         cfg = self.cfg
         if caches is not None:
             if attn_mask is not None or position_ids is not None:
@@ -276,7 +307,8 @@ class GPTModel(Layer):
                     "cached forward supports dense causal prefill/decode "
                     "only — attn_mask/position_ids would be silently "
                     "ignored")
-            return self._forward_cached(input_ids, caches, seq_lens)
+            return self._forward_cached(input_ids, caches, seq_lens,
+                                        block_tables)
         if input_ids.shape[1] > cfg.max_position_embeddings:
             # learned absolute positions: jax's OOB gather would silently
             # clamp every index past the table to its last row

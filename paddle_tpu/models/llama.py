@@ -132,7 +132,7 @@ class LlamaAttention(Layer):
                                         weight_attr=attr, sequence_parallel=sp)
 
     def forward(self, x, cos, sin, attn_mask=None, cache=None,
-                seq_lens=None):
+                seq_lens=None, block_tables=None):
         cfg = self.cfg
         b, s = x.shape[:2]
         if cfg.fuse_qkv_mlp and not cfg.sequence_parallel:
@@ -160,6 +160,29 @@ class LlamaAttention(Layer):
         k = constrain(k, ("dp", "sharding"), None, "mp", None)
         v = constrain(v, ("dp", "sharding"), None, "mp", None)
         q, k = F.apply_rotary_pos_emb(q, k, cos, sin)
+        if cache is not None and block_tables is not None:
+            # paged KV pools (serving.Engine): the cache is the GLOBAL
+            # (num_blocks, page, H_kv, D) pool pair (or int8 4-tuple),
+            # addressed through this batch's block tables
+            from ..incubate.nn.functional import (paged_decode_attend,
+                                                  paged_prefill_write)
+            if s == 1 and seq_lens is not None:
+                out, new_cache = paged_decode_attend(
+                    cache, q[:, 0], k[:, 0], v[:, 0], block_tables,
+                    seq_lens)
+                out = out[:, None].reshape(
+                    b, s, cfg.num_attention_heads * cfg.head_dim)
+                return self.o_proj(out), new_cache
+            # paged prefill: causal attention over the (bucket-padded)
+            # prompt; pages written only at positions < seq_lens, so
+            # padding rows never land in the pool
+            plens = seq_lens if seq_lens is not None else \
+                jnp.full((b,), s, jnp.int32)
+            new_cache = paged_prefill_write(cache, k, v, block_tables,
+                                            plens)
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+            out = out.reshape(b, s, cfg.num_attention_heads * cfg.head_dim)
+            return self.o_proj(out), new_cache
         if cache is not None and s == 1 and seq_lens is not None:
             # single-token decode against the dense KV cache (2-tuple fp
             # or int8-quantized 4-tuple) — shared cache-arity dispatch
@@ -220,6 +243,7 @@ class LlamaMLP(Layer):
 class LlamaDecoderLayer(Layer):
     returns_aux = False     # MoE variants return (x, aux_loss)
     supports_cache = True   # opt-in flag checked by init_cache/generate
+    supports_paged = True   # paged-pool serving path (serving.Engine)
 
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
@@ -229,11 +253,12 @@ class LlamaDecoderLayer(Layer):
         self.mlp = LlamaMLP(cfg)
 
     def forward(self, x, cos, sin, attn_mask=None, cache=None,
-                seq_lens=None):
+                seq_lens=None, block_tables=None):
         if cache is not None:
             attn, cache = self.self_attn(self.input_layernorm(x), cos, sin,
                                          attn_mask, cache=cache,
-                                         seq_lens=seq_lens)
+                                         seq_lens=seq_lens,
+                                         block_tables=block_tables)
             x = x + attn
             x = x + self.mlp(self.post_attention_layernorm(x))
             return x, cache
@@ -321,7 +346,7 @@ class LlamaModel(Layer):
             dtype if dtype is not None else cfg.dtype)
 
     def forward(self, input_ids, attn_mask=None, position_ids=None,
-                caches=None, seq_lens=None):
+                caches=None, seq_lens=None, block_tables=None):
         cfg = self.cfg
         if caches is not None:
             if attn_mask is not None or position_ids is not None:
@@ -329,7 +354,8 @@ class LlamaModel(Layer):
                     "cached forward supports dense causal prefill/decode "
                     "only — attn_mask/position_ids would be silently "
                     "ignored (left-pad or trim prompts instead)")
-            return self._forward_cached(input_ids, caches, seq_lens)
+            return self._forward_cached(input_ids, caches, seq_lens,
+                                        block_tables)
         x = self.embed_tokens(input_ids)
         cos, sin = F.rope_cos_sin(input_ids.shape[1], cfg.head_dim,
                                   base=cfg.rope_theta, dtype=x.dtype,
@@ -350,9 +376,13 @@ class LlamaModel(Layer):
         self.__dict__["_moe_aux"] = aux
         return self.norm(x)
 
-    def _forward_cached(self, input_ids, caches, seq_lens):
+    def _forward_cached(self, input_ids, caches, seq_lens,
+                        block_tables=None):
         """Prefill (seq_lens None) or one-token decode against the caches.
-        Returns (hidden, new_caches)."""
+        With ``block_tables`` the caches are paged pools (serving path):
+        prefill also takes ``seq_lens`` as the real prompt lengths so
+        bucket padding never lands in the pool.  Returns
+        (hidden, new_caches)."""
         cfg = self.cfg
         x = self.embed_tokens(input_ids)
         b, s = input_ids.shape
@@ -364,12 +394,16 @@ class LlamaModel(Layer):
         else:
             cos, sin = F.rope_cos_sin(s, cfg.head_dim, base=cfg.rope_theta,
                                       dtype=x.dtype)
+        # the paged kwarg is only threaded when present: decoder-layer
+        # subclasses without paged support (MoE) keep their signature
+        kw = {} if block_tables is None else {"block_tables": block_tables}
+        lens_arg = seq_lens if (decode or block_tables is not None) \
+            else None
         from .generation import run_cached_layers
         x, new_caches = run_cached_layers(
             self.layers, x, caches,
             lambda inner, x, cache: inner(
-                x, cos, sin, cache=cache,
-                seq_lens=seq_lens if decode else None))
+                x, cos, sin, cache=cache, seq_lens=lens_arg, **kw))
         self.__dict__["_moe_aux"] = 0.0
         return self.norm(x), new_caches
 

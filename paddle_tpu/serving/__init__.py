@@ -1,0 +1,27 @@
+"""``paddle_tpu.serving`` — continuous-batching inference on the paged
+KV cache (docs/SERVING.md).
+
+The reference stack serves through PaddleNLP's inference engine over the
+fused decode kernels; here the serving tier is TPU-native: one global
+paged KV pool per layer, a fixed-slot scheduler so the decode step
+compiles exactly once, and the Pallas paged-attention kernel
+(``ops/pallas/decode_attention.py``) doing the reads.
+
+Usage::
+
+    from paddle_tpu import serving
+    eng = serving.Engine(model, max_batch=8, max_seq_len=512).warmup()
+    rid = eng.add_request(prompt_ids, max_new_tokens=64)
+    for ev in eng.stream():
+        ...  # ev.token_id as it decodes
+"""
+
+from __future__ import annotations
+
+from .block_allocator import BlockAllocator, PagedKVCache  # noqa: F401
+from .engine import Engine, TokenEvent  # noqa: F401
+from .scheduler import Request, RequestState, Scheduler  # noqa: F401
+
+# public namespace hygiene: no foreign-module re-exports (tools/check_api_compat)
+from paddle_tpu._export import public_all as _public_all
+__all__ = _public_all(globals())

@@ -1,0 +1,121 @@
+"""Block allocator + paged KV pools — the serving engine's memory layer.
+
+Reference capability: vLLM-style paged KV management (PAPERS.md "Ragged
+Paged Attention" describes the TPU kernel shape this feeds).  The pool
+is ONE global ``(num_blocks, page, H_kv, D)`` k/v array pair per decoder
+layer; requests own disjoint block-id sets and address them through
+per-request block tables, so `max_batch` concurrent sequences share the
+HBM a single dense `(B, S_max, ...)` cache would burn on padding.
+
+Invariants (enforced here, relied on by the engine — docs/SERVING.md):
+
+- a block id is owned by at most one request at a time (`allocate` pops
+  from the free list, `free` returns; double-free raises);
+- the engine reserves ALL blocks a request can ever touch at admission
+  (`ceil((prompt + max_new_tokens) / page)`), so a running request can
+  never fail mid-decode on pool exhaustion — exhaustion only delays
+  admission;
+- at drain (no waiting, no active requests) `used_blocks == 0`, checked
+  by the `serving-smoke` CI gate.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+
+__all__ = ["BlockAllocator", "PagedKVCache"]
+
+
+class BlockAllocator:
+    """Free-list allocation over block ids ``[0, num_blocks)``."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks <= 0:
+            raise ValueError(f"num_blocks must be positive, got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        # pop() takes from the tail → low ids hand out first (stable
+        # tests and readable block tables)
+        self._free: List[int] = list(range(self.num_blocks - 1, -1, -1))
+        self._used = set()
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return len(self._used)
+
+    def can_allocate(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def allocate(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise RuntimeError(
+                f"KV pool exhausted: asked for {n} blocks, "
+                f"{len(self._free)} free of {self.num_blocks} — admission "
+                "should have gated this request (serving/scheduler.py)")
+        ids = [self._free.pop() for _ in range(n)]
+        self._used.update(ids)
+        return ids
+
+    def free(self, ids: Sequence[int]) -> None:
+        for i in ids:
+            if i not in self._used:
+                raise ValueError(
+                    f"double free of KV block {i} — a request's block list "
+                    "was reclaimed twice")
+            self._used.discard(i)
+            self._free.append(i)
+
+
+class PagedKVCache:
+    """Per-layer paged k/v pools + their allocator.
+
+    ``caches`` is a list (one entry per decoder layer) of pool tuples in
+    the :mod:`paddle_tpu.incubate.nn.functional` cache-arity convention:
+    fp ``(k, v)`` of shape ``(num_blocks, page, H_kv, D)``, or — with
+    ``dtype="int8"`` — quantized ``(k_i8, v_i8, k_scale, v_scale)`` with
+    per-(slot, position, head) f32 scales, reusing the
+    :func:`quantize_kv` formula the dense int8 caches use.  The engine
+    donates the whole list through its compiled step and writes the
+    returned buffers back here.
+    """
+
+    def __init__(self, num_layers: int, num_blocks: int, page_size: int,
+                 num_kv_heads: int, head_dim: int, dtype="float32"):
+        if page_size <= 0:
+            raise ValueError(f"page_size must be positive, got {page_size}")
+        self.num_layers = int(num_layers)
+        self.num_blocks = int(num_blocks)
+        self.page_size = int(page_size)
+        self.num_kv_heads = int(num_kv_heads)
+        self.head_dim = int(head_dim)
+        shape = (self.num_blocks, self.page_size, self.num_kv_heads,
+                 self.head_dim)
+        from ..models.generation import _is_int8
+        self.quantized = _is_int8(dtype)
+        if self.quantized:
+            sshape = shape[:3]
+            self.caches = [
+                (jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+                 jnp.ones(sshape, jnp.float32), jnp.ones(sshape, jnp.float32))
+                for _ in range(self.num_layers)]
+        else:
+            jdt = jnp.dtype(dtype)
+            self.caches = [(jnp.zeros(shape, jdt), jnp.zeros(shape, jdt))
+                           for _ in range(self.num_layers)]
+        self.allocator = BlockAllocator(self.num_blocks)
+
+    @property
+    def oob_block(self) -> int:
+        """The out-of-range block-id sentinel: scatters to it DROP, so a
+        table row full of it makes a slot's writes inert."""
+        return self.num_blocks
+
+    def nbytes(self) -> int:
+        per_layer = sum(int(a.size) * a.dtype.itemsize
+                        for a in self.caches[0])
+        return per_layer * self.num_layers

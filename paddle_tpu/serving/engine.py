@@ -1,0 +1,475 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+The throughput story (docs/SERVING.md): instead of one ``generate()``
+call per tenant — dense per-sequence caches, per-sequence latency —
+``Engine`` keeps ``max_batch`` decode slots running through ONE compiled
+decode step and admits/retires requests between steps.  The decode step
+reads attention via :func:`incubate.nn.functional.paged_attention`
+(Pallas scalar-prefetch kernel on TPU) and appends via the paged scatter
+ops, over a global block pool shared by all requests.
+
+Recompile contract: after :meth:`warmup` — one compile for the decode
+step + one per prefill bucket — requests of ANY length mix joining and
+leaving the batch trigger ZERO further compiles (fixed slot shapes, see
+``scheduler.py``; enforced by the ``serving-smoke`` CI gate).
+
+Step anatomy (one :meth:`step` call):
+
+1. **admit**: waiting requests move into free slots while blocks last;
+   each admission runs one bucket-padded prefill (writes the prompt's
+   KV into its reserved pages, samples the first token → TTFT);
+2. **decode**: one compiled step over ALL slots — every active slot's
+   pending token is embedded, its KV appended at ``context_len``, paged
+   attention over its block table, next token sampled (per-slot
+   greedy/temperature);
+3. **retire**: EOS / max-token requests leave their slot, their blocks
+   return to the free list, callbacks/stream consumers get the tokens.
+
+Telemetry (all zero-overhead when observability is disabled):
+``serve.ttft_ms``, ``serve.step_ms``, ``serve.tok_s``,
+``serve.queue_depth``, ``serve.kv_blocks_used``, ``serve.active_requests``
++ ``serve_request`` / ``serve_step`` / ``serve_finish`` events and a
+``serve.step`` flight-recorder span per step.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+import traceback
+import warnings
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import observability as obs
+from ..observability.spans import span
+from ..nn.layer import _swapped_params, functional_call, serving_params
+from .block_allocator import PagedKVCache
+from .scheduler import Request, RequestState, Scheduler
+
+__all__ = ["Engine", "TokenEvent"]
+
+# Incremental detokenization re-runs the tokenizer over a bounded tail
+# window of this many tokens (re-anchoring at half-window), keeping
+# streaming-text cost linear in output length instead of quadratic.
+_DETOK_WINDOW = 64
+
+
+class TokenEvent(NamedTuple):
+    """One emitted token, as returned by ``step()``/``stream()``."""
+
+    request_id: str
+    token_id: int
+    text: Optional[str]          # incremental detokenized text, if enabled
+    finished: bool
+    finish_reason: Optional[str]  # "eos" | "length" when finished
+
+
+def _kv_geometry(model):
+    """(num_layers, kv_heads, head_dim) from a CausalLM config."""
+    cfg = model.cfg
+    kv = getattr(cfg, "num_key_value_heads", None) or \
+        cfg.num_attention_heads
+    return cfg.num_hidden_layers, kv, cfg.head_dim
+
+
+def _paged_supported(model) -> bool:
+    mdl = getattr(model, "model", None)
+    if mdl is None or getattr(model.cfg, "pipeline_stages", 1) != 1:
+        return False
+    cls = getattr(type(mdl), "decoder_layer_cls", None)
+    return cls is not None and getattr(cls, "supports_paged", False)
+
+
+def _sample(logits, temps, key, step_i):
+    """Per-slot greedy (temp==0) or temperature sampling, on device."""
+    lg = logits.astype(jnp.float32)
+    greedy = jnp.argmax(lg, axis=-1)
+    k = jax.random.fold_in(key, step_i)
+    sampled = jax.random.categorical(
+        k, lg / jnp.maximum(temps, 1e-6)[:, None], axis=-1)
+    return jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
+
+
+class Engine:
+    """Continuous-batching serving engine (docs/SERVING.md).
+
+    ``model`` is a Llama/GPT-family CausalLM (any config with
+    ``supports_paged`` decoder layers and ``pipeline_stages == 1``);
+    weights are shared with the dense training/generate() paths via
+    ``serving_params``.  ``kv_cache_dtype="int8"`` allocates quantized
+    pools (the :func:`quantize_kv` scales, halved KV traffic).
+
+    ``detokenize``: optional ``callable(list[int]) -> str``; when given,
+    token events and ``on_token`` callbacks carry the incremental text.
+    For streaming it is called on a sliding tail window of the output
+    (last ``_DETOK_WINDOW`` tokens), so tokenizers whose suffix output
+    differs from the suffix of the full output may see a character-level
+    seam at window re-anchors (docs/SERVING.md).
+
+    ``keep_finished``: how many finished requests stay queryable via
+    :meth:`output_ids` after completion — older ones are evicted so a
+    long-running engine's per-request state stays bounded.
+    """
+
+    def __init__(self, model, *, max_batch: int = 8,
+                 max_seq_len: int = 256, page_size: int = 16,
+                 num_blocks: Optional[int] = None,
+                 kv_cache_dtype=None,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 detokenize: Optional[Callable] = None, seed: int = 0,
+                 keep_finished: int = 1024):
+        if not _paged_supported(model):
+            raise NotImplementedError(
+                f"{type(model).__name__} does not support the paged "
+                "serving path (needs supports_paged decoder layers and "
+                "pipeline_stages == 1)")
+        if max_batch < 1 or max_seq_len < page_size:
+            raise ValueError(
+                f"bad geometry: max_batch={max_batch}, "
+                f"max_seq_len={max_seq_len}, page_size={page_size}")
+        max_pos = getattr(model.cfg, "max_position_embeddings", None)
+        if max_pos is not None and max_seq_len > max_pos:
+            raise ValueError(
+                f"max_seq_len={max_seq_len} exceeds the model's "
+                f"max_position_embeddings={max_pos}")
+        model.eval()
+        self.model = model
+        self.max_batch = int(max_batch)
+        self.max_seq_len = int(max_seq_len)
+        self.page_size = int(page_size)
+        self.max_blocks_per_seq = -(-self.max_seq_len // self.page_size)
+        if num_blocks is None:
+            # enough for every slot to run a full-length sequence
+            num_blocks = self.max_batch * self.max_blocks_per_seq
+        n_layers, kv_heads, head_dim = _kv_geometry(model)
+        dtype = kv_cache_dtype if kv_cache_dtype is not None else \
+            getattr(model.cfg, "dtype", "float32")
+        self.kv = PagedKVCache(n_layers, num_blocks, self.page_size,
+                               kv_heads, head_dim, dtype=dtype)
+        self.scheduler = Scheduler(self.max_batch, self.page_size,
+                                   self.max_blocks_per_seq,
+                                   self.kv.allocator, self.kv.oob_block)
+        self.params = serving_params(model)
+        if prefill_buckets is None:
+            buckets, b = [], 16
+            while b < self.max_seq_len:
+                buckets.append(b)
+                b *= 2
+            buckets.append(self.max_seq_len)
+            prefill_buckets = buckets
+        self._buckets = sorted(set(int(b) for b in prefill_buckets))
+        if self._buckets[-1] > self.max_seq_len:
+            raise ValueError(
+                f"prefill bucket {self._buckets[-1]} exceeds "
+                f"max_seq_len={self.max_seq_len}")
+        self._detokenize = detokenize
+        self._key = jax.random.key(seed)
+        self._step_i = 0
+        self._states: Dict[str, RequestState] = {}
+        # a long-running engine must not leak one RequestState (plus its
+        # token list) per request served: only the `keep_finished` most
+        # recently finished requests stay queryable via output_ids()
+        self.keep_finished = int(keep_finished)
+        self._finished_order: "collections.deque[str]" = collections.deque()
+        # set by run() while draining: finish-time output capture that
+        # eviction can't outrun (None outside run(), so step()/stream()
+        # users accumulate no unbounded side state)
+        self._drain_capture: Optional[Dict[str, List[int]]] = None
+        self._build_fns()
+
+    # -- compiled paths ----------------------------------------------------
+
+    def _build_fns(self):
+        model = self.model
+
+        def _logits_of(params, hidden):
+            with _swapped_params(model, params):
+                return model.logits(hidden)[:, 0]
+
+        def decode_fn(params, caches, tokens, tables, lens, temps, key,
+                      step_i):
+            mp = {k[len("model."):]: v for k, v in params.items()
+                  if k.startswith("model.")}
+            hidden, caches = functional_call(
+                model.model, mp, tokens[:, None], caches=caches,
+                seq_lens=lens, block_tables=tables, training=False)
+            lg = _logits_of(params, hidden[:, -1:])
+            return _sample(lg, temps, key, step_i), caches
+
+        def prefill_fn(params, caches, ids, tables, plens, temps, key,
+                       step_i):
+            mp = {k[len("model."):]: v for k, v in params.items()
+                  if k.startswith("model.")}
+            hidden, caches = functional_call(
+                model.model, mp, ids, caches=caches, seq_lens=plens,
+                block_tables=tables, training=False)
+            # the LAST REAL token's hidden state, not the padded tail's
+            idx = (plens - 1)[:, None, None]
+            h_last = jnp.take_along_axis(hidden, idx, axis=1)
+            lg = _logits_of(params, h_last)
+            return _sample(lg, temps, key, step_i), caches
+
+        # pools are donated: the engine owns exactly one copy in HBM
+        self._decode_fn = jax.jit(decode_fn, donate_argnums=(1,))
+        self._prefill_fn = jax.jit(prefill_fn, donate_argnums=(1,))
+
+    def _bucket_for(self, prompt_len: int) -> int:
+        for b in self._buckets:
+            if b >= prompt_len:
+                return b
+        raise ValueError(
+            f"prompt of {prompt_len} tokens exceeds the largest prefill "
+            f"bucket ({self._buckets[-1]})")
+
+    def warmup(self) -> "Engine":
+        """Compile the decode step and every prefill bucket up front.
+
+        Uses all-out-of-range block tables, so the warmup traffic's
+        writes are dropped — no allocator interaction, no pool pollution.
+        After this, serving traffic compiles NOTHING (the serving-smoke
+        gate's contract)."""
+        with span("serve.warmup"):
+            b, mb = self.max_batch, self.max_blocks_per_seq
+            oob = np.full((b, mb), self.kv.oob_block, np.int32)
+            step0 = jnp.asarray(np.int32(0))
+            nxt, caches = self._decode_fn(
+                self.params, self.kv.caches,
+                jnp.asarray(np.zeros((b,), np.int32)), jnp.asarray(oob),
+                jnp.asarray(np.zeros((b,), np.int32)),
+                jnp.asarray(np.zeros((b,), np.float32)),
+                self._key, step0)
+            jax.block_until_ready(nxt)
+            self.kv.caches = caches
+            for bucket in self._buckets:
+                nxt, caches = self._prefill_fn(
+                    self.params, self.kv.caches,
+                    jnp.asarray(np.zeros((1, bucket), np.int32)),
+                    jnp.asarray(oob[:1]),
+                    jnp.asarray(np.ones((1,), np.int32)),
+                    jnp.asarray(np.zeros((1,), np.float32)),
+                    self._key, step0)
+                jax.block_until_ready(nxt)
+                self.kv.caches = caches
+        return self
+
+    # -- request lifecycle -------------------------------------------------
+
+    def add_request(self, prompt_ids, max_new_tokens: int = 16,
+                    temperature: float = 0.0,
+                    eos_token_id: Optional[int] = None,
+                    on_token: Optional[Callable] = None,
+                    request_id: Optional[str] = None) -> str:
+        """Queue one request; returns its id.  The request joins the
+        running batch at the next ``step()`` with a free slot and enough
+        free blocks for its WHOLE budget (prompt + max_new_tokens)."""
+        req = Request(prompt_ids=prompt_ids,
+                      max_new_tokens=int(max_new_tokens),
+                      temperature=float(temperature),
+                      eos_token_id=eos_token_id, on_token=on_token,
+                      request_id=request_id)
+        if req.request_id in self._states:
+            # a silent overwrite would orphan the first request's slot /
+            # blocks bookkeeping and lose its output
+            raise ValueError(
+                f"request_id {req.request_id!r} is already in use by a "
+                "live or retained request")
+        p = int(req.prompt_ids.size)
+        if p + req.max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({p}) + max_new_tokens ({req.max_new_tokens}) "
+                f"exceeds max_seq_len={self.max_seq_len}")
+        need = self.scheduler.blocks_for(p + req.max_new_tokens)
+        if need > self.kv.num_blocks:
+            # an unsatisfiable reservation would sit at the queue head
+            # forever and make run()/stream() spin — reject it up front
+            raise ValueError(
+                f"request needs {need} KV blocks (prompt {p} + "
+                f"max_new_tokens {req.max_new_tokens} @ page "
+                f"{self.page_size}) but the pool has only "
+                f"{self.kv.num_blocks} — raise num_blocks or lower the "
+                "budget")
+        self._bucket_for(p)   # validates against the bucket ladder
+        st = self.scheduler.submit(req)
+        self._states[req.request_id] = st
+        reg = obs.get_registry()
+        if reg is not None:
+            reg.counter("serve.requests").inc()
+            reg.gauge("serve.queue_depth").set(self.scheduler.queue_depth())
+        return req.request_id
+
+    def output_ids(self, request_id: str) -> List[int]:
+        return list(self._states[request_id].output_ids)
+
+    def has_work(self) -> bool:
+        return self.scheduler.has_work()
+
+    @property
+    def kv_blocks_used(self) -> int:
+        return self.kv.allocator.used_blocks
+
+    # -- the loop ----------------------------------------------------------
+
+    def _run_prefill(self, st: RequestState, events: List[TokenEvent]):
+        req = st.request
+        p = int(req.prompt_ids.size)
+        bucket = self._bucket_for(p)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :p] = req.prompt_ids
+        # device_put of ready numpy arrays only: jnp.asarray of a Python
+        # list/scalar traces a tiny program whose one-off compile would
+        # break the zero-compiles-after-warmup contract
+        nxt, caches = self._prefill_fn(
+            self.params, self.kv.caches, jnp.asarray(ids),
+            jnp.asarray(st.table[None]),
+            jnp.asarray(np.asarray([p], np.int32)),
+            jnp.asarray(np.asarray([req.temperature], np.float32)),
+            self._key, jnp.asarray(np.int32(self._step_i)))
+        self.kv.caches = caches
+        self._step_i += 1
+        # np.asarray is the device sync: JAX dispatch is async, so the
+        # clock must stop AFTER the first token materializes or TTFT
+        # reports queueing overhead instead of time-to-first-token
+        nxt_tok = int(np.asarray(nxt)[0])
+        st.kv_len = p
+        st.first_token_t = time.perf_counter()
+        reg = obs.get_registry()
+        if reg is not None:
+            reg.histogram("serve.ttft_ms").observe(
+                (st.first_token_t - st.submit_t) * 1e3)
+        obs.emit_event("serve_request", id=req.request_id, prompt_len=p,
+                       bucket=bucket, slot=st.slot,
+                       blocks=len(st.blocks))
+        self._emit(st, nxt_tok, events)
+
+    def _emit(self, st: RequestState, token: int,
+              events: List[TokenEvent]):
+        req = st.request
+        st.output_ids.append(token)
+        text = None
+        if self._detokenize is not None:
+            # linear-cost streaming: detokenize only a bounded tail
+            # window, emit its growth, and re-anchor at half-window so
+            # per-token work never scales with the full output length
+            w = st.detok_offset
+            full = self._detokenize(list(st.output_ids[w:]))
+            text = full[st.text_len:]
+            st.text_len = len(full)
+            if len(st.output_ids) - w >= _DETOK_WINDOW:
+                st.detok_offset = len(st.output_ids) - _DETOK_WINDOW // 2
+                st.text_len = len(self._detokenize(
+                    list(st.output_ids[st.detok_offset:])))
+        done_eos = (req.eos_token_id is not None
+                    and token == req.eos_token_id)
+        done_len = len(st.output_ids) >= req.max_new_tokens
+        if done_eos or done_len:
+            self.scheduler.finish(st, "eos" if done_eos else "length")
+            if self._drain_capture is not None:
+                # BEFORE the eviction below: when more requests than
+                # keep_finished retire in one step, the state may be
+                # gone by the time run() sees the events
+                self._drain_capture[req.request_id] = list(st.output_ids)
+                st.drained = True
+            self._finished_order.append(req.request_id)
+            while len(self._finished_order) > self.keep_finished:
+                self._states.pop(self._finished_order.popleft(), None)
+            reg = obs.get_registry()
+            if reg is not None:
+                reg.counter("serve.finished").inc()
+            obs.emit_event(
+                "serve_finish", id=req.request_id,
+                reason=st.finish_reason, tokens=len(st.output_ids),
+                ms=round((time.perf_counter() - st.submit_t) * 1e3, 3))
+        else:
+            st.pending_token = token
+        events.append(TokenEvent(req.request_id, token, text, st.finished,
+                                 st.finish_reason))
+        if req.on_token is not None:
+            try:
+                req.on_token(req.request_id, token, text)
+            except Exception:
+                # a raising callback must not tear down the whole step:
+                # the batch's other requests already produced events this
+                # step and their consumers would silently lose them
+                warnings.warn(
+                    f"on_token callback for request "
+                    f"{req.request_id!r} raised; continuing "
+                    f"({traceback.format_exc(limit=3).strip()})",
+                    RuntimeWarning, stacklevel=2)
+
+    def step(self) -> List[TokenEvent]:
+        """Admit what fits, run one decode step, retire what finished.
+        Returns the tokens emitted (one per prefilled/active request)."""
+        t0 = time.perf_counter()
+        events: List[TokenEvent] = []
+        with span("serve.step", emit=False):
+            while True:
+                st = self.scheduler.admit_next()
+                if st is None:
+                    break
+                self._run_prefill(st, events)
+            active = self.scheduler.active()
+            if active:
+                tokens, tables, lens, temps = self.scheduler.batch_arrays()
+                nxt, caches = self._decode_fn(
+                    self.params, self.kv.caches, jnp.asarray(tokens),
+                    jnp.asarray(tables), jnp.asarray(lens),
+                    jnp.asarray(temps), self._key,
+                    jnp.asarray(np.int32(self._step_i)))
+                self.kv.caches = caches
+                self._step_i += 1
+                nxt = np.asarray(nxt)
+                for i, st in active:
+                    st.kv_len += 1   # the pending token's KV just landed
+                    self._emit(st, int(nxt[i]), events)
+        n_tok = len(events)
+        dt = time.perf_counter() - t0
+        reg = obs.get_registry()
+        if reg is not None and n_tok:
+            reg.counter("serve.tokens").inc(n_tok)
+            reg.gauge("serve.tok_s").set(round(n_tok / max(dt, 1e-9), 1))
+            reg.gauge("serve.queue_depth").set(self.scheduler.queue_depth())
+            reg.gauge("serve.kv_blocks_used").set(
+                self.kv.allocator.used_blocks)
+            reg.gauge("serve.active_requests").set(
+                len(self.scheduler.active()))
+            reg.histogram("serve.step_ms").observe(dt * 1e3)
+        if n_tok:
+            obs.emit_event("serve_step", ms=round(dt * 1e3, 3),
+                           tokens=n_tok,
+                           active=len(self.scheduler.active()),
+                           queue=self.scheduler.queue_depth(),
+                           kv_blocks_used=self.kv.allocator.used_blocks)
+        return events
+
+    def stream(self):
+        """Generator: run ``step()`` until drained, yielding each
+        :class:`TokenEvent` as it is produced.  More requests may be
+        added while streaming — they join the running batch."""
+        while self.has_work():
+            for ev in self.step():
+                yield ev
+
+    def run(self) -> Dict[str, List[int]]:
+        """Drain everything; returns {request_id: generated token ids}
+        for every request finished since the last ``run()`` — including
+        (still-retained) requests that finished during manual ``step()``
+        calls before this one (staggered admission).  Outputs are
+        captured at finish time, so the dict is complete even when more
+        than ``keep_finished`` requests retire in one drain."""
+        drained: Dict[str, List[int]] = {}
+        for rid, st in self._states.items():
+            if st.finished and not st.drained:
+                st.drained = True
+                drained[rid] = list(st.output_ids)
+        self._drain_capture = drained
+        try:
+            while self.has_work():
+                self.step()
+        finally:
+            self._drain_capture = None
+        return drained

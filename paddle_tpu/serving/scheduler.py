@@ -1,0 +1,182 @@
+"""Continuous-batching scheduler: admission queue + fixed-shape slots.
+
+The whole point of this module is that the compiled decode step NEVER
+retraces: the decode batch is always ``max_batch`` slots with static
+array shapes — ``tokens (B,)``, ``block_tables (B, MB)``,
+``context_lens (B,)``, ``temps (B,)`` — and requests join/leave a
+running batch purely by editing the VALUES in those arrays:
+
+- an **active** slot carries its real block-table row, KV length and
+  pending token;
+- an **inactive** slot carries the out-of-range block sentinel
+  (scatters drop), length 0 and token 0 — its lane computes garbage the
+  engine discards, which on TPU is cheaper than a recompile by ~5
+  orders of magnitude (see the recompile sentinel's storm warning).
+
+Admission reserves every block a request can ever need
+(``ceil((prompt + max_new) / page)``) up front, so decode can never die
+on pool exhaustion — a full pool only delays the waiting queue.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["Request", "RequestState", "Scheduler"]
+
+_ids = itertools.count()
+
+
+@dataclasses.dataclass
+class Request:
+    """One user request: prompt + decode policy."""
+
+    prompt_ids: np.ndarray
+    max_new_tokens: int = 16
+    temperature: float = 0.0        # 0 = greedy, >0 = sampling
+    eos_token_id: Optional[int] = None
+    on_token: Optional[Callable] = None   # cb(request_id, token_id, text)
+    request_id: Optional[str] = None
+
+    def __post_init__(self):
+        self.prompt_ids = np.asarray(self.prompt_ids, np.int32).reshape(-1)
+        if self.prompt_ids.size == 0:
+            raise ValueError("empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
+        if self.request_id is None:
+            self.request_id = f"req-{next(_ids)}"
+
+
+class RequestState:
+    """A request occupying a slot (or still waiting)."""
+
+    __slots__ = ("request", "slot", "blocks", "table", "kv_len",
+                 "pending_token", "output_ids", "text_len", "detok_offset",
+                 "submit_t", "first_token_t", "finished", "finish_reason",
+                 "drained")
+
+    def __init__(self, request: Request):
+        self.request = request
+        self.slot: Optional[int] = None
+        self.blocks: List[int] = []
+        self.table: Optional[np.ndarray] = None   # (MB,) int32
+        self.kv_len = 0              # tokens whose KV sits in the pool
+        self.pending_token: Optional[int] = None  # emitted, KV not written
+        self.output_ids: List[int] = []
+        self.text_len = 0            # chars already streamed from the
+        self.detok_offset = 0        # ...detok window starting here
+        self.submit_t = time.perf_counter()
+        self.first_token_t: Optional[float] = None
+        self.finished = False
+        self.finish_reason: Optional[str] = None
+        self.drained = False         # returned by an Engine.run() already
+
+    @property
+    def total_len(self) -> int:
+        return int(self.request.prompt_ids.size) + self.request.max_new_tokens
+
+
+class Scheduler:
+    """Waiting queue + the fixed slot bucket."""
+
+    def __init__(self, max_batch: int, page_size: int,
+                 max_blocks_per_seq: int, allocator, oob_block: int):
+        self.max_batch = int(max_batch)
+        self.page_size = int(page_size)
+        self.max_blocks_per_seq = int(max_blocks_per_seq)
+        self.allocator = allocator
+        self.oob_block = int(oob_block)
+        self.waiting: "collections.deque[RequestState]" = collections.deque()
+        self.slots: List[Optional[RequestState]] = [None] * self.max_batch
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, request: Request) -> RequestState:
+        st = RequestState(request)
+        self.waiting.append(st)
+        return st
+
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    def blocks_for(self, total_len: int) -> int:
+        """Blocks a ``total_len``-token sequence reserves: ceil(len/page).
+        The ONE place this formula lives — Engine.add_request's
+        unsatisfiable-budget rejection must agree with admission."""
+        return -(-int(total_len) // self.page_size)
+
+    def blocks_needed(self, st: RequestState) -> int:
+        return self.blocks_for(st.total_len)
+
+    def admit_next(self) -> Optional[RequestState]:
+        """Move the head of the waiting queue into a slot, reserving its
+        full block budget.  FIFO head-of-line: a large head request
+        waits for blocks rather than being starved by later small ones.
+        Returns the admitted state, or None (no slot / no blocks / no
+        waiters)."""
+        if not self.waiting:
+            return None
+        slot = self._free_slot()
+        if slot is None:
+            return None
+        st = self.waiting[0]
+        need = self.blocks_needed(st)
+        if not self.allocator.can_allocate(need):
+            return None
+        self.waiting.popleft()
+        st.slot = slot
+        st.blocks = self.allocator.allocate(need)
+        st.table = np.full((self.max_blocks_per_seq,), self.oob_block,
+                           np.int32)
+        st.table[:need] = st.blocks
+        self.slots[slot] = st
+        return st
+
+    # -- the running batch -------------------------------------------------
+
+    def active(self) -> List[Tuple[int, RequestState]]:
+        return [(i, s) for i, s in enumerate(self.slots) if s is not None]
+
+    def batch_arrays(self):
+        """The fixed-shape decode inputs: (tokens, tables, lens, temps)
+        as numpy arrays.  Inactive slots get the inert sentinel values —
+        shapes NEVER depend on occupancy."""
+        b, mb = self.max_batch, self.max_blocks_per_seq
+        tokens = np.zeros((b,), np.int32)
+        tables = np.full((b, mb), self.oob_block, np.int32)
+        lens = np.zeros((b,), np.int32)
+        temps = np.zeros((b,), np.float32)
+        for i, st in self.active():
+            tokens[i] = st.pending_token
+            tables[i] = st.table
+            lens[i] = st.kv_len
+            temps[i] = st.request.temperature
+        return tokens, tables, lens, temps
+
+    def finish(self, st: RequestState, reason: str) -> None:
+        """Release the slot and reclaim every reserved block."""
+        st.finished = True
+        st.finish_reason = reason
+        if st.slot is not None:
+            self.slots[st.slot] = None
+            st.slot = None
+        if st.blocks:
+            self.allocator.free(st.blocks)
+            st.blocks = []
+
+    def has_work(self) -> bool:
+        return bool(self.waiting) or any(s is not None for s in self.slots)

@@ -70,6 +70,16 @@ def test_chaos_gate():
     assert "chaos gate OK" in out
 
 
+def test_serving_smoke_gate():
+    """The continuous-batching engine's contracts (tools/ci.py
+    gate_serving_smoke): mixed-length requests joining/leaving the
+    running batch trigger zero recompiles after warmup, and every KV
+    block is reclaimed at drain (docs/SERVING.md)."""
+    out = _run_gate("serving-smoke", timeout=600)
+    assert "serving-smoke gate OK" in out
+    assert "0 compiles after warmup" in out
+
+
 def test_api_compat_rejects_foreign_module_leak(monkeypatch):
     """A leaked implementation import (jax/os/...) reachable as a public
     attribute hard-fails collect() (VERDICT r4 weak #1: the gate must

@@ -103,3 +103,51 @@ class TestPagedDecodeKernel:
             jnp.asarray(tables), jnp.asarray(lens)))
         np.testing.assert_allclose(out, _oracle(q, kp, vp, tables, lens),
                                    rtol=2e-4, atol=2e-5)
+
+
+class TestKernelVsFallbackEquivalence:
+    """The Pallas kernel (interpret mode) and the XLA gather fallback in
+    incubate/nn/functional.py must agree — the serving engine dispatches
+    between them by backend, so a drift here would make TPU and CPU CI
+    disagree about what the engine decodes."""
+
+    @pytest.mark.parametrize("h,hkv,lens", [
+        (4, 2, [64, 33, 5, 17]),        # GQA 2x, ragged lens
+        (8, 2, [40, 1, 64, 23]),        # GQA 4x, len-1 edge
+        (4, 4, [12, 50, 7, 64]),        # MHA, ragged
+    ])
+    def test_interpret_matches_xla_fallback(self, h, hkv, lens):
+        from paddle_tpu.incubate.nn import functional as IF
+        q, kp, vp, tables, lens = _case(B=4, H=h, HKV=hkv, lens=lens)
+        args = (jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                jnp.asarray(tables), jnp.asarray(lens))
+        kernel = np.asarray(DA.paged_attention(*args, interpret=True))
+        # the incubate entry point on CPU takes the XLA gather fallback
+        # (ops.dispatch declines: backend != tpu)
+        fallback = np.asarray(IF.paged_attention(*args))
+        np.testing.assert_allclose(kernel, fallback, rtol=2e-4, atol=2e-5)
+
+    def test_serving_write_then_attend_equivalence(self):
+        """The engine's per-step pair (write_paged_kv → attention): both
+        attention formulations read back the token just scattered."""
+        from paddle_tpu.incubate.nn import functional as IF
+        q, kp, vp, tables, lens = _case(B=3, H=4, HKV=2,
+                                        lens=[30, 8, 55])
+        new_k = R.normal(size=(3, 2, 128)).astype("float32")
+        new_v = R.normal(size=(3, 2, 128)).astype("float32")
+        ctx = jnp.asarray(lens + 1)
+        kc, vc = IF.write_paged_kv(jnp.asarray(kp), jnp.asarray(vp),
+                                   jnp.asarray(new_k), jnp.asarray(new_v),
+                                   jnp.asarray(tables), ctx)
+        kernel = np.asarray(DA.paged_attention(
+            jnp.asarray(q), kc, vc, jnp.asarray(tables), ctx,
+            interpret=True))
+        fallback = np.asarray(IF.paged_attention(
+            jnp.asarray(q), kc, vc, jnp.asarray(tables), ctx))
+        np.testing.assert_allclose(kernel, fallback, rtol=2e-4, atol=2e-5)
+        # and the scatter actually landed: position lens of each row
+        kc_np = np.asarray(kc)
+        for b in range(3):
+            blk = tables[b, lens[b] // 16]
+            np.testing.assert_array_equal(kc_np[blk, lens[b] % 16],
+                                          new_k[b])

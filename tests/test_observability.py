@@ -688,6 +688,43 @@ def test_telemetry_report_truncated_and_malformed_lines(tmp_path):
     assert summary["spans"]["ckpt.save"]["n"] == 1
 
 
+def test_telemetry_report_folds_serving_events(tmp_path):
+    """The serve_* vocabulary (docs/SERVING.md) folds into a serving
+    table + `serving` summary block — no engine needed, the reporter is
+    pure stdlib over the event schema."""
+    path = str(tmp_path / "serve.jsonl")
+    with open(path, "w") as f:
+        for i, (n, b) in enumerate([(5, 16), (23, 32), (9, 16)]):
+            f.write(json.dumps({"event": "serve_request", "id": f"r{i}",
+                                "prompt_len": n, "bucket": b, "slot": i,
+                                "blocks": 2}) + "\n")
+        for ms, tok, act, q in [(4.0, 1, 1, 2), (2.0, 3, 3, 0),
+                                (2.5, 3, 3, 0), (3.0, 2, 2, 0)]:
+            f.write(json.dumps({"event": "serve_step", "ms": ms,
+                                "tokens": tok, "active": act, "queue": q,
+                                "kv_blocks_used": 2 * act}) + "\n")
+        f.write(json.dumps({"event": "serve_finish", "id": "r0",
+                            "reason": "length", "tokens": 4,
+                            "ms": 11.0}) + "\n")
+        f.write(json.dumps({"event": "serve_finish", "id": "r1",
+                            "reason": "eos", "tokens": 2,
+                            "ms": 8.0}) + "\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "telemetry_report.py"),
+         path], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "| Serving | |" in r.stdout
+    assert "| requests (finished) | 3 (1 eos, 1 length) |" in r.stdout
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    sv = summary["serving"]
+    assert sv["requests"] == 3 and sv["steps"] == 4
+    assert sv["tokens"] == 9
+    assert sv["finished"] == {"eos": 1, "length": 1}
+    assert sv["peak_active"] == 3 and sv["peak_queue"] == 2
+    assert sv["peak_kv_blocks"] == 6
+    assert sv["agg_tok_s"] == round(9 / (11.5 / 1e3), 1)
+
+
 def test_telemetry_report_json_only_mode_counts_malformed(tmp_path):
     path = str(tmp_path / "bad.jsonl")
     with open(path, "w") as f:

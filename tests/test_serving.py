@@ -1,0 +1,482 @@
+"""Continuous-batching serving engine (paddle_tpu.serving).
+
+The load-bearing guarantee: under greedy decoding, every request served
+through the shared paged pools is TOKEN-IDENTICAL to a standalone
+``model.generate()`` call — continuous batching is a throughput
+optimization, not an accuracy trade.  Plus the allocator/scheduler
+invariants the engine's safety rests on (reservation at admission,
+reclaim at finish, inert inactive slots).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import serving
+from paddle_tpu.serving.block_allocator import BlockAllocator, PagedKVCache
+from paddle_tpu.serving.scheduler import Request, Scheduler
+
+R = np.random.default_rng(0)
+
+
+def _prompt(n):
+    return R.integers(0, 256, size=n).astype(np.int32)
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    from paddle_tpu.models.llama import llama
+    pt.seed(0)
+    return llama("tiny")
+
+
+# ---------------------------------------------------------------------------
+# allocator / pools
+# ---------------------------------------------------------------------------
+
+class TestBlockAllocator:
+    def test_allocate_free_roundtrip(self):
+        a = BlockAllocator(8)
+        ids = a.allocate(5)
+        assert len(set(ids)) == 5 and a.used_blocks == 5
+        assert not a.can_allocate(4)
+        a.free(ids[:2])
+        assert a.free_blocks == 5
+        a.free(ids[2:])
+        assert a.used_blocks == 0 and a.free_blocks == 8
+
+    def test_exhaustion_raises(self):
+        a = BlockAllocator(2)
+        a.allocate(2)
+        with pytest.raises(RuntimeError, match="exhausted"):
+            a.allocate(1)
+
+    def test_double_free_raises(self):
+        a = BlockAllocator(2)
+        ids = a.allocate(1)
+        a.free(ids)
+        with pytest.raises(ValueError, match="double free"):
+            a.free(ids)
+
+    def test_pool_shapes_and_int8(self):
+        kv = PagedKVCache(num_layers=2, num_blocks=4, page_size=8,
+                          num_kv_heads=2, head_dim=16)
+        assert len(kv.caches) == 2
+        assert kv.caches[0][0].shape == (4, 8, 2, 16)
+        assert kv.oob_block == 4
+        kv8 = PagedKVCache(2, 4, 8, 2, 16, dtype="int8")
+        assert kv8.quantized and len(kv8.caches[0]) == 4
+        assert kv8.caches[0][2].shape == (4, 8, 2)
+        assert kv8.nbytes() < kv.nbytes()
+
+
+class TestScheduler:
+    def test_fixed_shapes_and_inert_slots(self):
+        a = BlockAllocator(16)
+        s = Scheduler(max_batch=3, page_size=8, max_blocks_per_seq=4,
+                      allocator=a, oob_block=16)
+        s.submit(Request(prompt_ids=_prompt(5), max_new_tokens=3))
+        st = s.admit_next()
+        st.pending_token, st.kv_len = 7, 5
+        tokens, tables, lens, temps = s.batch_arrays()
+        assert tokens.shape == (3,) and tables.shape == (3, 4)
+        # inactive slots carry the OOB sentinel everywhere
+        assert (tables[1:] == 16).all() and lens[1] == 0
+        assert tokens[0] == 7 and lens[0] == 5
+        # reservation covers prompt + max_new (5+3 → 1 block of 8)
+        assert a.used_blocks == 1
+        s.finish(st, "length")
+        assert a.used_blocks == 0 and s.slots[0] is None
+
+    def test_admission_gates_on_blocks_fifo(self):
+        a = BlockAllocator(2)
+        s = Scheduler(max_batch=4, page_size=8, max_blocks_per_seq=2,
+                      allocator=a, oob_block=2)
+        s.submit(Request(prompt_ids=_prompt(10), max_new_tokens=6))  # 2 blk
+        s.submit(Request(prompt_ids=_prompt(3), max_new_tokens=2))   # 1 blk
+        first = s.admit_next()
+        assert first is not None and a.free_blocks == 0
+        # pool empty: the small request WAITS (no starvation reorder)
+        assert s.admit_next() is None and s.queue_depth() == 1
+        s.finish(first, "length")
+        assert s.admit_next() is not None
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+class TestEngine:
+    def test_greedy_token_identity_vs_generate(self, tiny_llama):
+        """The acceptance bar: every request in a mixed continuous batch
+        decodes exactly what a standalone generate() would."""
+        model = tiny_llama
+        eng = serving.Engine(model, max_batch=4, max_seq_len=64,
+                             page_size=8).warmup()
+        prompts = [_prompt(n) for n in (3, 7, 12, 5, 9, 17)]
+        new = [8, 5, 10, 3, 7, 6]
+        rids = [eng.add_request(p, max_new_tokens=m)
+                for p, m in zip(prompts, new)]
+        outs = eng.run()
+        assert eng.kv_blocks_used == 0
+        for p, m, rid in zip(prompts, new, rids):
+            ref = np.asarray(model.generate(
+                jnp.asarray(p)[None], max_new_tokens=m,
+                temperature=0.0))[0, len(p):]
+            assert np.array_equal(ref, np.asarray(outs[rid])), rid
+
+    def test_join_leave_mid_flight_identity(self, tiny_llama):
+        """Requests entering a RUNNING batch must not perturb the ones
+        already decoding (slot isolation through the paged pools)."""
+        model = tiny_llama
+        eng = serving.Engine(model, max_batch=3, max_seq_len=64,
+                             page_size=8).warmup()
+        p1, p2 = _prompt(6), _prompt(11)
+        r1 = eng.add_request(p1, max_new_tokens=9)
+        for _ in range(3):
+            eng.step()
+        r2 = eng.add_request(p2, max_new_tokens=4)   # joins mid-flight
+        while eng.has_work():
+            eng.step()
+        for p, m, rid in ((p1, 9, r1), (p2, 4, r2)):
+            ref = np.asarray(model.generate(
+                jnp.asarray(p)[None], max_new_tokens=m,
+                temperature=0.0))[0, len(p):]
+            assert np.array_equal(ref, np.asarray(eng.output_ids(rid)))
+
+    def test_eos_stops_and_reclaims(self, tiny_llama):
+        model = tiny_llama
+        eng = serving.Engine(model, max_batch=2, max_seq_len=64,
+                             page_size=8).warmup()
+        p = _prompt(5)
+        # find what greedy emits first, then use it as the eos id
+        first = int(np.asarray(model.generate(
+            jnp.asarray(p)[None], max_new_tokens=1, temperature=0.0))[0, -1])
+        rid = eng.add_request(p, max_new_tokens=32, eos_token_id=first)
+        eng.run()
+        st = eng._states[rid]
+        assert st.finish_reason == "eos"
+        assert eng.output_ids(rid) == [first]
+        assert eng.kv_blocks_used == 0
+
+    def test_queueing_beyond_capacity(self, tiny_llama):
+        """More requests than slots: the overflow waits, then joins as
+        slots free — everything still drains token-identical."""
+        model = tiny_llama
+        eng = serving.Engine(model, max_batch=2, max_seq_len=32,
+                             page_size=8).warmup()
+        prompts = [_prompt(n) for n in (4, 6, 3, 9, 5)]
+        rids = [eng.add_request(p, max_new_tokens=4) for p in prompts]
+        assert eng.scheduler.queue_depth() == 5
+        outs = eng.run()
+        assert len(outs) == 5 and eng.kv_blocks_used == 0
+        for p, rid in zip(prompts, rids):
+            ref = np.asarray(model.generate(
+                jnp.asarray(p)[None], max_new_tokens=4,
+                temperature=0.0))[0, len(p):]
+            assert np.array_equal(ref, np.asarray(outs[rid]))
+
+    def test_int8_pools_serve(self, tiny_llama):
+        eng = serving.Engine(tiny_llama, max_batch=2, max_seq_len=64,
+                             page_size=8, kv_cache_dtype="int8").warmup()
+        assert eng.kv.quantized
+        rid = eng.add_request(_prompt(7), max_new_tokens=6)
+        outs = eng.run()
+        assert len(outs[rid]) == 6 and eng.kv_blocks_used == 0
+
+    def test_sampling_and_mixed_policies(self, tiny_llama):
+        """Greedy and sampling requests share one compiled step; the
+        sampled stream is deterministic per engine seed."""
+        pg, ps = _prompt(5), _prompt(5)
+        outs = []
+        for _ in range(2):
+            eng = serving.Engine(tiny_llama, max_batch=2, max_seq_len=64,
+                                 page_size=8, seed=7).warmup()
+            g = eng.add_request(pg, max_new_tokens=6)
+            s = eng.add_request(ps, max_new_tokens=6,
+                                temperature=0.8)
+            o = eng.run()
+            outs.append((o[g], o[s]))
+        assert outs[0] == outs[1]
+
+    def test_streaming_callbacks_and_detokenize(self, tiny_llama):
+        got = []
+        eng = serving.Engine(
+            tiny_llama, max_batch=2, max_seq_len=64, page_size=8,
+            detokenize=lambda ids: " ".join(str(i) for i in ids)).warmup()
+        rid = eng.add_request(
+            _prompt(4), max_new_tokens=3,
+            on_token=lambda r, t, txt: got.append((r, t, txt)))
+        events = [ev for ev in eng.stream()]
+        assert [t for _, t, _ in got] == eng.output_ids(rid)
+        # incremental text concatenates back to the full detokenization
+        assert "".join(txt for _, _, txt in got) == \
+            " ".join(str(i) for i in eng.output_ids(rid))
+        assert events[-1].finished and events[-1].finish_reason == "length"
+
+    def test_gpt_family(self):
+        from paddle_tpu.models.gpt import gpt
+        pt.seed(0)
+        model = gpt("tiny")
+        eng = serving.Engine(model, max_batch=2, max_seq_len=64,
+                             page_size=8).warmup()
+        p = _prompt(9)
+        rid = eng.add_request(p, max_new_tokens=6)
+        outs = eng.run()
+        ref = np.asarray(model.generate(
+            jnp.asarray(p)[None], max_new_tokens=6,
+            temperature=0.0))[0, len(p):]
+        assert np.array_equal(ref, np.asarray(outs[rid]))
+        assert eng.kv_blocks_used == 0
+
+    def test_unsupported_configs_raise(self, tiny_llama):
+        from paddle_tpu.models.mixtral import mixtral
+        pt.seed(0)
+        with pytest.raises(NotImplementedError, match="paged"):
+            serving.Engine(mixtral("tiny"))
+        with pytest.raises(ValueError, match="max_seq_len"):
+            eng = serving.Engine(tiny_llama, max_batch=2, max_seq_len=32,
+                                 page_size=8)
+            eng.add_request(_prompt(30), max_new_tokens=8)
+
+    def test_request_validation(self, tiny_llama):
+        eng = serving.Engine(tiny_llama, max_batch=2, max_seq_len=32,
+                             page_size=8)
+        with pytest.raises(ValueError, match="empty"):
+            eng.add_request(np.zeros((0,), np.int32))
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            eng.add_request(_prompt(3), max_new_tokens=0)
+
+    def test_unsatisfiable_budget_rejected_at_add(self, tiny_llama):
+        """A request needing more blocks than the WHOLE pool could sit
+        at the queue head forever (admit_next never succeeds, no slot
+        active, has_work() true) — run()/stream() would spin.  It must
+        be rejected at add_request."""
+        eng = serving.Engine(tiny_llama, max_batch=2, max_seq_len=64,
+                             page_size=8, num_blocks=2)
+        with pytest.raises(ValueError, match="KV blocks"):
+            eng.add_request(_prompt(20), max_new_tokens=20)  # 5 > 2
+        # a satisfiable one still serves
+        rid = eng.add_request(_prompt(5), max_new_tokens=3)
+        outs = eng.run()
+        assert len(outs[rid]) == 3 and eng.kv_blocks_used == 0
+
+    def test_run_returns_requests_finished_in_manual_steps(self,
+                                                           tiny_llama):
+        """run()'s drain dict must include requests that finished during
+        manual step() calls BEFORE run() (staggered admission), and a
+        second run() must not re-report them."""
+        eng = serving.Engine(tiny_llama, max_batch=2, max_seq_len=64,
+                             page_size=8).warmup()
+        r1 = eng.add_request(_prompt(4), max_new_tokens=1)
+        eng.step()                       # r1 finishes right here
+        assert eng._states[r1].finished
+        r2 = eng.add_request(_prompt(7), max_new_tokens=3)
+        outs = eng.run()
+        assert set(outs) == {r1, r2}
+        assert outs[r1] == eng.output_ids(r1)
+        assert eng.run() == {}           # nothing new since
+
+    def test_finished_state_retention_is_bounded(self, tiny_llama):
+        """A long-running engine must not leak one RequestState per
+        request served: only the `keep_finished` most recent stay
+        queryable, older ones are evicted."""
+        eng = serving.Engine(tiny_llama, max_batch=2, max_seq_len=32,
+                             page_size=8, keep_finished=2).warmup()
+        rids = [eng.add_request(_prompt(3), max_new_tokens=2)
+                for _ in range(5)]
+        outs = eng.run()
+        assert set(outs) == set(rids)    # run() reported ALL of them
+        assert len(eng._states) == 2     # ...but retains only the cap
+        assert eng.output_ids(rids[-1])  # newest still queryable
+        with pytest.raises(KeyError):
+            eng.output_ids(rids[0])      # oldest evicted
+
+    def test_run_burst_finish_beats_eviction(self, tiny_llama):
+        """More requests than keep_finished retiring in ONE decode step:
+        run() must still report every one of them (outputs are captured
+        at finish time, before the retention cap evicts the state)."""
+        eng = serving.Engine(tiny_llama, max_batch=4, max_seq_len=32,
+                             page_size=8, keep_finished=1).warmup()
+        rids = [eng.add_request(_prompt(3), max_new_tokens=2)
+                for _ in range(4)]   # same budget → all 4 finish together
+        outs = eng.run()
+        assert set(outs) == set(rids)
+        assert all(len(v) == 2 for v in outs.values())
+        assert len(eng._states) == 1   # the cap still holds afterwards
+
+    def test_duplicate_request_id_rejected(self, tiny_llama):
+        """A user-supplied id colliding with a live or retained request
+        must raise — a silent overwrite would lose the first request's
+        output and double-count it in the retention deque."""
+        eng = serving.Engine(tiny_llama, max_batch=2, max_seq_len=32,
+                             page_size=8).warmup()
+        eng.add_request(_prompt(3), max_new_tokens=2, request_id="x")
+        with pytest.raises(ValueError, match="already in use"):
+            eng.add_request(_prompt(4), max_new_tokens=2, request_id="x")
+        eng.run()
+        # still retained (finished) → still a collision
+        with pytest.raises(ValueError, match="already in use"):
+            eng.add_request(_prompt(4), max_new_tokens=2, request_id="x")
+
+    def test_raising_on_token_callback_is_isolated(self, tiny_llama):
+        """One request's broken callback must not tear down step() —
+        the batch's OTHER requests' events would be lost mid-stream."""
+        eng = serving.Engine(tiny_llama, max_batch=2, max_seq_len=32,
+                             page_size=8).warmup()
+        got = []
+        def bad(r, t, txt):
+            raise RuntimeError("consumer bug")
+        r1 = eng.add_request(_prompt(3), max_new_tokens=3, on_token=bad)
+        r2 = eng.add_request(_prompt(5), max_new_tokens=3,
+                             on_token=lambda r, t, txt: got.append(t))
+        with pytest.warns(RuntimeWarning, match="on_token"):
+            outs = eng.run()
+        assert len(outs[r1]) == 3 and len(outs[r2]) == 3
+        assert got == outs[r2]           # healthy consumer saw everything
+        assert eng.kv_blocks_used == 0
+
+    def test_streaming_detok_window_stays_linear(self, tiny_llama,
+                                                 monkeypatch):
+        """The incremental text path re-detokenizes only a bounded tail
+        window; across re-anchors the streamed pieces still concatenate
+        to the full detokenization (compositional tokenizer)."""
+        from paddle_tpu.serving import engine as engine_mod
+        monkeypatch.setattr(engine_mod, "_DETOK_WINDOW", 4)
+        calls = []
+        detok = lambda ids: (calls.append(len(ids)),
+                             " ".join(str(i) for i in ids))[1]
+        eng = serving.Engine(tiny_llama, max_batch=1, max_seq_len=64,
+                             page_size=8, detokenize=detok).warmup()
+        rid = eng.add_request(_prompt(5), max_new_tokens=14)
+        text = "".join(ev.text for ev in eng.stream())
+        assert text == " ".join(str(i) for i in eng.output_ids(rid))
+        assert max(calls) <= 4           # never the full 14-token list
+
+
+class TestServingTelemetry:
+    def test_metrics_and_events(self, tiny_llama):
+        import paddle_tpu.observability as obs
+        tel = obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        try:
+            eng = serving.Engine(tiny_llama, max_batch=2, max_seq_len=64,
+                                 page_size=8).warmup()
+            eng.add_request(_prompt(5), max_new_tokens=4)
+            eng.run()
+            snap = tel.registry.snapshot()
+            assert snap["serve.requests"] == 1
+            assert snap["serve.finished"] == 1
+            assert snap["serve.kv_blocks_used"] == 0
+            assert snap["serve.tokens"] == 4
+            assert snap["serve.ttft_ms"]["count"] == 1
+            sink = tel.sinks[0]
+            assert len(sink.events("serve_request")) == 1
+            fin = sink.events("serve_finish")
+            assert fin and fin[0]["reason"] == "length"
+            assert sink.events("serve_step")
+        finally:
+            obs.disable()
+
+    def test_disabled_telemetry_is_silent(self, tiny_llama):
+        """With observability off (default), serving never touches the
+        registry — same zero-overhead contract as the train step."""
+        import paddle_tpu.observability as obs
+        assert not obs.enabled()
+
+        def boom(self, *a, **kw):
+            raise AssertionError("serving touched the registry while "
+                                 "telemetry is disabled")
+        saved = {}
+        for name in ("counter", "gauge", "histogram"):
+            saved[name] = getattr(obs.MetricsRegistry, name)
+            setattr(obs.MetricsRegistry, name, boom)
+        try:
+            eng = serving.Engine(tiny_llama, max_batch=2, max_seq_len=64,
+                                 page_size=8).warmup()
+            eng.add_request(_prompt(4), max_new_tokens=3)
+            eng.run()
+        finally:
+            for name, fn in saved.items():
+                setattr(obs.MetricsRegistry, name, fn)
+
+
+class TestBenchServePlumbing:
+    def test_bench_serve_runs_on_cpu(self):
+        """The aggregate serving metric bench.py reports
+        (tools/decode_bench.bench_serve) runs end-to-end on CPU — the
+        acceptance bar here is plumbing only; throughput numbers come
+        from TPU BENCH rounds."""
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        from decode_bench import bench_serve
+        r = bench_serve(preset="tiny", max_batch=2, n_requests=3,
+                        max_new=4, prompt_lens=(4, 9, 6), page_size=8,
+                        repeats=1)
+        assert r["metric"] == "serve_continuous_batching_tok_s"
+        assert r["gen_tokens"] == 3 * 4
+        assert r["agg_tokens_per_sec"] > 0
+
+
+class TestPredictorWarmup:
+    def test_aot_compile_and_shape_key(self):
+        from paddle_tpu import nn
+        from paddle_tpu.inference import Config, create_predictor
+        pt.seed(0)
+        net = nn.Linear(4, 3)
+        x = jnp.ones((2, 4))
+        p = create_predictor(Config(model=net, example_args=(x,)))
+        assert p._compiled is None
+        p.warmup()
+        assert p._compiled is not None
+        key = p._compiled_key
+        out = p.run(x)
+        assert p._compiled_key == key      # same geometry: no re-lower
+        np.testing.assert_allclose(np.asarray(out[0]),
+                                   np.asarray(net(x)), rtol=1e-6)
+        p.run(jnp.ones((5, 4)))            # new geometry: re-lowers
+        assert p._compiled_key != key
+
+    def test_alternating_geometries_compile_once_each(self):
+        """run() keeps one executable PER input geometry (like the jit
+        cache it replaces) — alternating shapes must not re-lower."""
+        from paddle_tpu import nn
+        from paddle_tpu.inference import Config, create_predictor
+        pt.seed(0)
+        p = create_predictor(Config(model=nn.Linear(4, 3)))
+        a, b = jnp.ones((2, 4)), jnp.ones((5, 4))
+        p.run(a), p.run(b)
+        assert len(p._executables) == 2
+        exe_a = p._executables[p._arg_key((a,))]
+        p.run(a), p.run(b), p.run(a)
+        assert len(p._executables) == 2            # no re-lower
+        assert p._executables[p._arg_key((a,))] is exe_a
+
+    def test_first_run_compiles_lazily(self):
+        from paddle_tpu import nn
+        from paddle_tpu.inference import Config, create_predictor
+        pt.seed(0)
+        p = create_predictor(Config(model=nn.Linear(4, 3)))
+        with pytest.raises(ValueError, match="example"):
+            p.warmup()
+        out = p.run(jnp.ones((2, 4)))
+        assert p._compiled is not None and np.asarray(out[0]).shape == (2, 3)
+
+    def test_arg_key_distinguishes_pytree_structure(self):
+        """run(x, y) and run((x, y)) flatten to the same leaves; the AOT
+        dispatch key must include the treedef or the wrong executable is
+        handed arguments of the wrong structure."""
+        import jax
+        from paddle_tpu.inference import Config, create_predictor
+        p = create_predictor(
+            Config(model=lambda *a: sum(jax.tree.leaves(list(a)))))
+        a, b = jnp.ones((2, 4)), jnp.full((2, 4), 2.0)
+        out1 = p.run(a, b)
+        out2 = p.run((a, b))           # same leaves, different structure
+        assert len(p._executables) == 2
+        np.testing.assert_allclose(np.asarray(out1[0]),
+                                   np.asarray(out2[0]))

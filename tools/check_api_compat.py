@@ -33,6 +33,7 @@ NAMESPACES = [
     "paddle_tpu.incubate.asp",
     "paddle_tpu.callbacks", "paddle_tpu.jit", "paddle_tpu.ckpt",
     "paddle_tpu.observability", "paddle_tpu.resilience",
+    "paddle_tpu.serving",
     "paddle_tpu.hapi", "paddle_tpu.vision", "paddle_tpu.vision.ops",
     "paddle_tpu.vision.models", "paddle_tpu.vision.transforms",
     "paddle_tpu.audio",

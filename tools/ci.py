@@ -36,8 +36,14 @@ in-repo gates over artifacts committed alongside the code:
                   corrupted, resume must fall back to the previous
                   valid one and still reproduce the same params
 
+  serving-smoke   the continuous-batching engine's standing contracts
+                  (docs/SERVING.md): after warmup, mixed-length requests
+                  joining/leaving the running batch trigger ZERO
+                  recompiles (recompile sentinel + jit cache sizes), and
+                  every KV block is reclaimed at drain
+
 Run all:  python tools/ci.py            (exit 0 = all gates pass)
-One:      python tools/ci.py --only api-compat|op-benchmark|memproof-lite|telemetry-overhead|chaos
+One:      python tools/ci.py --only api-compat|op-benchmark|memproof-lite|telemetry-overhead|chaos|serving-smoke
 """
 
 from __future__ import annotations
@@ -461,12 +467,124 @@ def gate_chaos(num_steps: int = 6, save_every: int = 2) -> int:
     return 0
 
 
+def gate_serving_smoke(max_batch: int = 4, n_requests: int = 10) -> int:
+    """Serving smoke: the continuous-batching engine's two standing
+    contracts (docs/SERVING.md), end to end on a tiny model:
+
+    1. ZERO RECOMPILES UNDER CHURN: after ``Engine.warmup()`` —
+       one compile for the decode step + one per prefill bucket —
+       requests of varying lengths joining and leaving the running
+       batch must not trigger a single further compile.  Checked two
+       ways: the recompile sentinel's backend-compile count stays at
+       its warmup level, and the jit caches of the decode/prefill
+       callables hold exactly (1, num_buckets) executables at drain
+       (the second check also catches re-TRACES that the persistent
+       XLA compile cache would hide from the sentinel).
+    2. FULL RECLAIM AT DRAIN: when the queue and every slot are empty,
+       ``used_blocks == 0`` — no leaked KV pages.
+
+    Plus the correctness floor: every request produced exactly its
+    ``max_new_tokens`` greedy tokens (EOS unset), token-identical
+    across a re-serve of the same prompts on the churned engine.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import observability as obs
+    from paddle_tpu import serving
+    from paddle_tpu.models.llama import llama
+
+    failures = []
+    tel = obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+    try:
+        pt.seed(0)
+        model = llama("tiny")
+        eng = serving.Engine(model, max_batch=max_batch, max_seq_len=64,
+                             page_size=8).warmup()
+        compiles_at_warmup = tel.sentinel.compiles()
+
+        rng = np.random.default_rng(0)
+        lens = [3, 17, 9, 33, 5, 26, 12, 40, 7, 21][:n_requests]
+        prompts = [rng.integers(0, model.cfg.vocab_size,
+                                size=n).astype(np.int32) for n in lens]
+        budgets = [3 + (i % 5) for i in range(len(prompts))]
+
+        def serve_all():
+            rids = []
+            for p, m in zip(prompts, budgets):
+                rids.append(eng.add_request(p, max_new_tokens=m))
+                # staggered admission: step between submits so requests
+                # join a RUNNING batch (and finished ones leave it)
+                eng.step()
+            outs = eng.run()
+            # run()'s contract: every request finished since the last
+            # run() is in the dict, INCLUDING ones that finished during
+            # the staggered step()s above
+            return [outs[r] for r in rids]
+
+        first = serve_all()
+        again = serve_all()   # re-serve on the churned engine
+
+        churn_compiles = tel.sentinel.compiles() - compiles_at_warmup
+        if churn_compiles:
+            failures.append(
+                f"{churn_compiles} backend compile(s) AFTER warmup — "
+                "the fixed-slot shape contract is broken "
+                "(serving/scheduler.py)")
+        else:
+            print(f"serving-smoke: {2 * len(prompts)} requests "
+                  f"(lens {min(lens)}..{max(lens)}) joined/left the "
+                  "batch: 0 compiles after warmup")
+        sizes = []
+        for fn, want, name in ((eng._decode_fn, 1, "decode"),
+                               (eng._prefill_fn, len(eng._buckets),
+                                "prefill")):
+            n = getattr(fn, "_cache_size", lambda: None)()
+            sizes.append(f"{name}={n}")
+            if n is not None and n > want:
+                failures.append(
+                    f"{name} jit cache holds {n} entries, expected "
+                    f"{want} — a retrace slipped past the sentinel")
+        print(f"serving-smoke: jit cache sizes at drain: "
+              f"{', '.join(sizes)} (buckets: {eng._buckets})")
+
+        if eng.kv_blocks_used != 0:
+            failures.append(
+                f"{eng.kv_blocks_used} KV block(s) still allocated at "
+                "drain — reclaim leak (serving/block_allocator.py)")
+        else:
+            print("serving-smoke: all KV blocks reclaimed at drain")
+
+        for i, (a, b, m) in enumerate(zip(first, again, budgets)):
+            if len(a) != m:
+                failures.append(
+                    f"request {i}: {len(a)} tokens, budget {m}")
+            if a != b:
+                failures.append(
+                    f"request {i}: re-serve on the churned engine "
+                    "diverged — slot state leaked between requests")
+        if not any("request" in f for f in failures):
+            print("serving-smoke: greedy outputs stable across re-serve")
+    finally:
+        obs.disable()
+
+    if failures:
+        print("serving-smoke gate FAILED (docs/SERVING.md):")
+        for f_ in failures:
+            print(f"  {f_}")
+        return 1
+    print("serving-smoke gate OK")
+    return 0
+
+
 GATES = {
     "api-compat": gate_api_compat,
     "op-benchmark": gate_op_benchmark,
     "memproof-lite": gate_memproof_lite,
     "telemetry-overhead": gate_telemetry_overhead,
     "chaos": gate_chaos,
+    "serving-smoke": gate_serving_smoke,
 }
 
 

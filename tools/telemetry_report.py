@@ -84,6 +84,11 @@ def summarize(events):
         # injected-fault counts, plus resume/restart occurrences
         "retries": defaultdict(int), "faults": defaultdict(int),
         "resumes": [], "restarts": [],
+        # serving vocabulary (docs/SERVING.md): admission / step / finish
+        "serving": {"requests": 0, "prompt_lens": [], "steps": 0,
+                    "step_ms": [], "tokens": 0, "max_active": 0,
+                    "max_queue": 0, "max_kv_blocks": 0,
+                    "finished": defaultdict(int), "req_ms": []},
     }
     for e in events:
         kind = e.get("event")
@@ -117,6 +122,26 @@ def summarize(events):
             agg["resumes"].append(e)
         elif kind == "restart":
             agg["restarts"].append(e)
+        elif kind == "serve_request":
+            sv = agg["serving"]
+            sv["requests"] += 1
+            if e.get("prompt_len") is not None:
+                sv["prompt_lens"].append(e["prompt_len"])
+        elif kind == "serve_step":
+            sv = agg["serving"]
+            sv["steps"] += 1
+            sv["tokens"] += e.get("tokens") or 0
+            if e.get("ms") is not None:
+                sv["step_ms"].append(e["ms"])
+            sv["max_active"] = max(sv["max_active"], e.get("active") or 0)
+            sv["max_queue"] = max(sv["max_queue"], e.get("queue") or 0)
+            sv["max_kv_blocks"] = max(sv["max_kv_blocks"],
+                                      e.get("kv_blocks_used") or 0)
+        elif kind == "serve_finish":
+            sv = agg["serving"]
+            sv["finished"][e.get("reason") or "?"] += 1
+            if e.get("ms") is not None:
+                sv["req_ms"].append(e["ms"])
         elif kind == "recompile_storm":
             agg["storms"].append(e)
         elif kind == "preemption":
@@ -193,6 +218,32 @@ def render(agg, malformed=0):
             lines.append(f"| {site} | {agg['retries'].get(site, 0)} "
                          f"| {agg['faults'].get(site, 0)} |")
         lines.append("")
+    sv = agg["serving"]
+    if sv["requests"] or sv["steps"]:
+        ms = sorted(sv["step_ms"])
+        busy_s = sum(sv["step_ms"]) / 1e3
+        agg_tps = (sv["tokens"] / busy_s) if busy_s else None
+        fin = ", ".join(f"{n} {r}" for r, n in sorted(sv["finished"].items())) \
+            or "—"
+        pl = sorted(sv["prompt_lens"])
+        ttft = (metrics or {}).get("serve.ttft_ms") or {}
+
+        def fmt(v, nd=2):
+            return f"{v:.{nd}f}" if v is not None else "—"
+        lines += ["| Serving | |", "|---|---|",
+                  f"| requests (finished) | {sv['requests']} ({fin}) |",
+                  f"| prompt lens | {pl[0]}..{pl[-1]} |" if pl else
+                  "| prompt lens | — |",
+                  f"| steps | {sv['steps']} |",
+                  f"| step ms p50 / p95 | {fmt(_pct(ms, 50))} / "
+                  f"{fmt(_pct(ms, 95))} |",
+                  f"| tokens (agg tok/s) | {sv['tokens']} "
+                  f"({fmt(agg_tps, 1)}) |",
+                  f"| ttft ms p50 / p95 | {fmt(ttft.get('p50'))} / "
+                  f"{fmt(ttft.get('p95'))} |",
+                  f"| peak active / queue / kv blocks | {sv['max_active']} "
+                  f"/ {sv['max_queue']} / {sv['max_kv_blocks']} |",
+                  ""]
     for r in agg["resumes"]:
         lines.append(f"**RESUME**: step {r.get('step')} from "
                      f"`{r.get('ckpt')}` (restart {r.get('restarts')})")
@@ -236,7 +287,7 @@ def render(agg, malformed=0):
     if not (steps or agg["spans"] or compiles or coll or storms
             or preemptions or agg["hangs"] or agg["postmortems"]
             or agg["retries"] or agg["faults"] or agg["resumes"]
-            or agg["restarts"]):
+            or agg["restarts"] or sv["requests"] or sv["steps"]):
         lines.append("(no telemetry events found)")
     return "\n".join(lines)
 
@@ -278,6 +329,23 @@ def main(argv=None) -> int:
         "postmortems": [pm.get("reason") for pm in agg["postmortems"]],
         "thread_stacks": len(agg["thread_stacks"]),
     }
+    sv = agg["serving"]
+    if sv["requests"] or sv["steps"]:
+        busy_s = sum(sv["step_ms"]) / 1e3
+        summary["serving"] = {
+            "requests": sv["requests"],
+            "finished": dict(sorted(sv["finished"].items())),
+            "steps": sv["steps"],
+            "tokens": sv["tokens"],
+            "agg_tok_s": (round(sv["tokens"] / busy_s, 1)
+                          if busy_s else None),
+            "step_p50_ms": _pct(sorted(sv["step_ms"]), 50),
+            "step_p95_ms": _pct(sorted(sv["step_ms"]), 95),
+            "req_p50_ms": _pct(sorted(sv["req_ms"]), 50),
+            "peak_active": sv["max_active"],
+            "peak_queue": sv["max_queue"],
+            "peak_kv_blocks": sv["max_kv_blocks"],
+        }
     if agg["bench_result"] is not None:
         summary["bench_value"] = agg["bench_result"].get("value")
     print(json.dumps(summary))
