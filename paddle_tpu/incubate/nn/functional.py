@@ -382,21 +382,21 @@ def paged_decode_attend(cache, q, new_k, new_v, block_tables, write_pos,
     return out, (kc, vc)
 
 
-def paged_prefill_write(cache, k, v, block_tables, prompt_lens):
-    """Scatter a prefill chunk ``k``/``v`` (B, S, H_kv, D) into the paged
-    pools at positions ``[0, prompt_lens)`` of each sequence.
-
-    The chunk may be padded past the real prompt (fixed-shape prefill
-    buckets): positions ``>= prompt_lens`` get an out-of-range block id
-    and are DROPPED by the scatter, so padding never lands in the pool.
-    Same cache-arity dispatch as :func:`paged_decode_attend`."""
+def _paged_span_write(cache, k, v, block_tables, span_starts, span_lens):
+    """Scatter a token span ``k``/``v`` (B, C, H_kv, D) into the paged
+    pools at positions ``[span_starts, span_starts + span_lens)`` of each
+    sequence.  Rows ``>= span_lens`` (chunk padding, idle slots) get an
+    out-of-range block id and are DROPPED by the scatter, so padding
+    never lands in the pool.  Shared cache-arity dispatch (fp 2-tuple or
+    int8 4-tuple)."""
     b, s = k.shape[:2]
     nb, bs = cache[0].shape[:2]
     mb = block_tables.shape[1]
-    pos = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    pos = span_starts[:, None] + jnp.arange(s)[None, :]       # (B, C)
     blk = jnp.take_along_axis(block_tables, jnp.minimum(pos // bs, mb - 1),
                               axis=1)
-    blk = jnp.where(pos < prompt_lens[:, None], blk, nb)  # OOB → dropped
+    live = jnp.arange(s)[None, :] < span_lens[:, None]
+    blk = jnp.where(live, blk, nb)                            # OOB → dropped
     off = pos % bs
     if len(cache) == 4:
         kc, vc, ks, vs = cache
@@ -407,6 +407,96 @@ def paged_prefill_write(cache, k, v, block_tables, prompt_lens):
     kc, vc = cache
     return (kc.at[blk, off].set(k.astype(kc.dtype)),
             vc.at[blk, off].set(v.astype(vc.dtype)))
+
+
+def paged_prefill_write(cache, k, v, block_tables, prompt_lens):
+    """Scatter a prefill chunk ``k``/``v`` (B, S, H_kv, D) into the paged
+    pools at positions ``[0, prompt_lens)`` of each sequence — the
+    span write with every span starting at position 0 (the legacy
+    bucket-prefill path; the ragged serving step uses
+    :func:`ragged_paged_attend`)."""
+    b = k.shape[0]
+    return _paged_span_write(cache, k, v, block_tables,
+                             jnp.zeros((b,), jnp.int32), prompt_lens)
+
+
+def _ragged_attend_dense(q, k, v, span_starts, scale):
+    """Span attention over dense gathered (B, S, H_kv, D) K/V: query row
+    ``j`` of slot ``b`` (position ``span_starts[b] + j``) attends over
+    positions ``[0, span_starts[b] + j]``.  GQA without repeating KV,
+    fp32 accumulation — the (B, C)-shaped analogue of
+    :func:`_attend_dense_gqa` (shared by the ragged fallbacks)."""
+    b, c, h, d = q.shape
+    s = k.shape[1]
+    h_kv = k.shape[2]
+    g = h // h_kv
+    qg = q.reshape(b, c, h_kv, g, d).astype(jnp.float32)
+    scores = jnp.einsum("bckgd,bskd->bckgs", qg, k.astype(jnp.float32),
+                        preferred_element_type=jnp.float32) * scale
+    pos = span_starts[:, None] + jnp.arange(c)[None, :]       # (B, C)
+    # position 0 is always visible (pos >= 0), so no row softmaxes over
+    # an empty set — dead rows produce finite garbage the caller discards
+    mask = jnp.arange(s)[None, None, :] <= pos[:, :, None]    # (B, C, S)
+    scores = jnp.where(mask[:, :, None, None, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bckgs,bskd->bckgd", probs, v.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, c, h, d).astype(q.dtype)
+
+
+def ragged_paged_attend(cache, q, new_k, new_v, block_tables, span_starts,
+                        span_lens, scale: Optional[float] = None):
+    """ONE serving step for a ragged batch of token spans — the unified
+    replacement for the separate :func:`paged_decode_attend` /
+    bucket-prefill dispatches (PAPERS.md "Ragged Paged Attention").
+
+    Each slot ``b`` carries a span of ``span_lens[b]`` tokens starting at
+    pool position ``span_starts[b]``: a chunked-prefill segment
+    (``len > 1``), a single decode token (``len == 1``), or nothing
+    (``len == 0`` — idle or dead slot; with an out-of-range block table
+    its writes drop and its garbage output is discarded, so nothing a
+    dead slot does can corrupt live blocks).
+
+    ``q``/``new_k``/``new_v`` are ``(B, C, H|H_kv, D)``; the span's k/v
+    is written at ``[start, start + len)`` and query row ``j`` attends
+    over pool positions ``[0, start + j]`` — the cached prefix plus the
+    causal part of its own span.  ``cache`` is the per-layer pool tuple
+    (fp 2-tuple or int8 4-tuple with :func:`quantize_kv` scales); int8
+    pools attend through the XLA gather+dequant formulation on every
+    backend (the Pallas kernel is fp-only), fp pools dispatch to the
+    ragged Pallas kernel on TPU.
+
+    Returns ``(out (B, C, H, D), new_cache)``.
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    new_cache = _paged_span_write(cache, new_k, new_v, block_tables,
+                                  span_starts, span_lens)
+    if len(new_cache) == 4:
+        kc, vc, ks, vs = new_cache
+        kd, vd = _paged_gather_dense(kc, vc, block_tables, ks, vs)
+        return (_ragged_attend_dense(q, kd, vd, span_starts, scale),
+                new_cache)
+    kc, vc = new_cache
+    from ...ops import dispatch as _dispatch
+    kernel = _dispatch.get("ragged_paged_attention")
+    if kernel is not None:
+        out = kernel(q, kc, vc, block_tables, span_starts, span_lens,
+                     scale=scale)
+        if out is not None:
+            return out, new_cache
+    kd, vd = _paged_gather_dense(kc, vc, block_tables)
+    return _ragged_attend_dense(q, kd, vd, span_starts, scale), new_cache
+
+
+def paged_copy_blocks(cache, src_blocks, dst_blocks):
+    """Copy whole pages ``src_blocks[i] → dst_blocks[i]`` inside the
+    paged pools — the device half of copy-on-write block sharing
+    (serving/block_allocator.py).  Fixed-shape: pad unused entries with
+    the out-of-range sentinel (``num_blocks``) — OOB destinations DROP
+    and OOB sources clamp to a real page that is then never written.
+    Shared cache-arity dispatch; returns the new cache tuple."""
+    return tuple(a.at[dst_blocks].set(a[src_blocks]) for a in cache)
 
 
 def variable_length_memory_efficient_attention(q, k, v, seq_lens=None,

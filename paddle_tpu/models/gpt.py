@@ -105,7 +105,7 @@ class GPTAttention(Layer):
         self.dropout = Dropout(cfg.hidden_dropout)
 
     def forward(self, x, attn_mask=None, cache=None, seq_lens=None,
-                block_tables=None):
+                block_tables=None, span_starts=None):
         cfg = self.cfg
         b, s = x.shape[:2]
         qkv = self.qkv_proj(x).reshape(b, s, 3, cfg.num_attention_heads,
@@ -117,7 +117,14 @@ class GPTAttention(Layer):
         if cache is not None and block_tables is not None:
             # paged KV pools (serving.Engine) — see LlamaAttention
             from ..incubate.nn.functional import (paged_decode_attend,
-                                                  paged_prefill_write)
+                                                  paged_prefill_write,
+                                                  ragged_paged_attend)
+            if span_starts is not None:
+                # unified ragged step — see LlamaAttention
+                out, new_cache = ragged_paged_attend(
+                    cache, q, k, v, block_tables, span_starts, seq_lens)
+                out = out.reshape(b, s, cfg.hidden_size)
+                return self.dropout(self.out_proj(out)), new_cache
             if s == 1 and seq_lens is not None:
                 out, new_cache = paged_decode_attend(
                     cache, q[:, 0], k[:, 0], v[:, 0], block_tables,
@@ -187,11 +194,12 @@ class GPTDecoderLayer(Layer):
         self.mlp = GPTMLP(cfg)
 
     def forward(self, x, attn_mask=None, cache=None, seq_lens=None,
-                block_tables=None):
+                block_tables=None, span_starts=None):
         if cache is not None:
             attn, cache = self.attn(self.ln_1(x), attn_mask, cache=cache,
                                     seq_lens=seq_lens,
-                                    block_tables=block_tables)
+                                    block_tables=block_tables,
+                                    span_starts=span_starts)
             x = x + attn
             x = x + self.mlp(self.ln_2(x))
             return x, cache
@@ -277,18 +285,26 @@ class GPTModel(Layer):
             dtype if dtype is not None else cfg.dtype)
 
     def _forward_cached(self, input_ids, caches, seq_lens,
-                        block_tables=None):
+                        block_tables=None, span_starts=None):
         """Prefill (seq_lens None) or one-token decode against the caches.
         With ``block_tables`` the caches are paged pools (serving path);
-        prefill then takes ``seq_lens`` as the real prompt lengths.
+        prefill then takes ``seq_lens`` as the real prompt lengths.  With
+        ``span_starts`` the batch is the unified RAGGED serving step
+        (chunked prefill + decode spans, ``seq_lens`` = span lengths).
         Returns (hidden, new_caches)."""
         b, s = input_ids.shape
         decode = (s == 1 and seq_lens is not None)
-        pos = (seq_lens[:, None] if decode
-               else jnp.arange(s)[None, :])
+        if span_starts is not None:
+            pos = span_starts[:, None] + jnp.arange(s)[None, :]
+        elif decode:
+            pos = seq_lens[:, None]
+        else:
+            pos = jnp.arange(s)[None, :]
         x = self.embed_tokens(input_ids) + self.embed_positions(pos)
         x = self.embed_dropout(x)
         kw = {} if block_tables is None else {"block_tables": block_tables}
+        if span_starts is not None:
+            kw["span_starts"] = span_starts
         lens_arg = seq_lens if (decode or block_tables is not None) \
             else None
         from .generation import run_cached_layers
@@ -299,7 +315,8 @@ class GPTModel(Layer):
         return self.ln_f(x), new_caches
 
     def forward(self, input_ids, attn_mask=None, position_ids=None,
-                caches=None, seq_lens=None, block_tables=None):
+                caches=None, seq_lens=None, block_tables=None,
+                span_starts=None):
         cfg = self.cfg
         if caches is not None:
             if attn_mask is not None or position_ids is not None:
@@ -308,7 +325,7 @@ class GPTModel(Layer):
                     "only — attn_mask/position_ids would be silently "
                     "ignored")
             return self._forward_cached(input_ids, caches, seq_lens,
-                                        block_tables)
+                                        block_tables, span_starts)
         if input_ids.shape[1] > cfg.max_position_embeddings:
             # learned absolute positions: jax's OOB gather would silently
             # clamp every index past the table to its last row

@@ -132,7 +132,7 @@ class LlamaAttention(Layer):
                                         weight_attr=attr, sequence_parallel=sp)
 
     def forward(self, x, cos, sin, attn_mask=None, cache=None,
-                seq_lens=None, block_tables=None):
+                seq_lens=None, block_tables=None, span_starts=None):
         cfg = self.cfg
         b, s = x.shape[:2]
         if cfg.fuse_qkv_mlp and not cfg.sequence_parallel:
@@ -165,7 +165,18 @@ class LlamaAttention(Layer):
             # (num_blocks, page, H_kv, D) pool pair (or int8 4-tuple),
             # addressed through this batch's block tables
             from ..incubate.nn.functional import (paged_decode_attend,
-                                                  paged_prefill_write)
+                                                  paged_prefill_write,
+                                                  ragged_paged_attend)
+            if span_starts is not None:
+                # unified ragged step: each slot's span (prefill chunk
+                # or decode token) writes at [start, start+len) and
+                # every row attends its causal prefix — one dispatch
+                # for the whole mixed batch
+                out, new_cache = ragged_paged_attend(
+                    cache, q, k, v, block_tables, span_starts, seq_lens)
+                out = out.reshape(
+                    b, s, cfg.num_attention_heads * cfg.head_dim)
+                return self.o_proj(out), new_cache
             if s == 1 and seq_lens is not None:
                 out, new_cache = paged_decode_attend(
                     cache, q[:, 0], k[:, 0], v[:, 0], block_tables,
@@ -253,12 +264,13 @@ class LlamaDecoderLayer(Layer):
         self.mlp = LlamaMLP(cfg)
 
     def forward(self, x, cos, sin, attn_mask=None, cache=None,
-                seq_lens=None, block_tables=None):
+                seq_lens=None, block_tables=None, span_starts=None):
         if cache is not None:
             attn, cache = self.self_attn(self.input_layernorm(x), cos, sin,
                                          attn_mask, cache=cache,
                                          seq_lens=seq_lens,
-                                         block_tables=block_tables)
+                                         block_tables=block_tables,
+                                         span_starts=span_starts)
             x = x + attn
             x = x + self.mlp(self.post_attention_layernorm(x))
             return x, cache
@@ -346,7 +358,8 @@ class LlamaModel(Layer):
             dtype if dtype is not None else cfg.dtype)
 
     def forward(self, input_ids, attn_mask=None, position_ids=None,
-                caches=None, seq_lens=None, block_tables=None):
+                caches=None, seq_lens=None, block_tables=None,
+                span_starts=None):
         cfg = self.cfg
         if caches is not None:
             if attn_mask is not None or position_ids is not None:
@@ -355,7 +368,7 @@ class LlamaModel(Layer):
                     "only — attn_mask/position_ids would be silently "
                     "ignored (left-pad or trim prompts instead)")
             return self._forward_cached(input_ids, caches, seq_lens,
-                                        block_tables)
+                                        block_tables, span_starts)
         x = self.embed_tokens(input_ids)
         cos, sin = F.rope_cos_sin(input_ids.shape[1], cfg.head_dim,
                                   base=cfg.rope_theta, dtype=x.dtype,
@@ -377,26 +390,36 @@ class LlamaModel(Layer):
         return self.norm(x)
 
     def _forward_cached(self, input_ids, caches, seq_lens,
-                        block_tables=None):
+                        block_tables=None, span_starts=None):
         """Prefill (seq_lens None) or one-token decode against the caches.
         With ``block_tables`` the caches are paged pools (serving path):
         prefill also takes ``seq_lens`` as the real prompt lengths so
-        bucket padding never lands in the pool.  Returns
+        padding never lands in the pool.  With ``span_starts`` the batch
+        is the unified RAGGED serving step: per-slot spans (chunked
+        prefill or decode tokens) at positions ``[start, start+len)``,
+        ``seq_lens`` carrying the span lengths.  Returns
         (hidden, new_caches)."""
         cfg = self.cfg
         x = self.embed_tokens(input_ids)
         b, s = input_ids.shape
         decode = (s == 1 and seq_lens is not None)
-        if decode:
+        if span_starts is not None:
+            # per-slot positions: the span's tokens sit at start..start+s
+            cos, sin = F.rope_cos_sin(
+                s, cfg.head_dim, base=cfg.rope_theta, dtype=x.dtype,
+                position_ids=span_starts[:, None] + jnp.arange(s)[None, :])
+        elif decode:
             cos, sin = F.rope_cos_sin(1, cfg.head_dim, base=cfg.rope_theta,
                                       dtype=x.dtype,
                                       position_ids=seq_lens[:, None])
         else:
             cos, sin = F.rope_cos_sin(s, cfg.head_dim, base=cfg.rope_theta,
                                       dtype=x.dtype)
-        # the paged kwarg is only threaded when present: decoder-layer
+        # the paged kwargs are only threaded when present: decoder-layer
         # subclasses without paged support (MoE) keep their signature
         kw = {} if block_tables is None else {"block_tables": block_tables}
+        if span_starts is not None:
+            kw["span_starts"] = span_starts
         lens_arg = seq_lens if (decode or block_tables is not None) \
             else None
         from .generation import run_cached_layers

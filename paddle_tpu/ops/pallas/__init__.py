@@ -83,3 +83,17 @@ def _paged_attention_dispatch(q, k_pool, v_pool, block_tables, lens,
 
 dispatch.register("paged_attention", _paged_attention_dispatch,
                   platform="tpu")
+
+from . import ragged_attention as _ra
+
+
+def _ragged_paged_attention_dispatch(q, k_pool, v_pool, block_tables,
+                                     starts, lens, scale=None):
+    if not _ra.supported(q, k_pool, v_pool, block_tables, starts, lens):
+        return None  # caller falls back to the XLA gather formulation
+    return _ra.ragged_paged_attention(q, k_pool, v_pool, block_tables,
+                                      starts, lens, scale=scale)
+
+
+dispatch.register("ragged_paged_attention", _ragged_paged_attention_dispatch,
+                  platform="tpu")

@@ -3,9 +3,11 @@ KV cache (docs/SERVING.md).
 
 The reference stack serves through PaddleNLP's inference engine over the
 fused decode kernels; here the serving tier is TPU-native: one global
-paged KV pool per layer, a fixed-slot scheduler so the decode step
-compiles exactly once, and the Pallas paged-attention kernel
-(``ops/pallas/decode_attention.py``) doing the reads.
+paged KV pool per layer with hash-based prefix sharing (refcounted
+copy-on-write blocks, LRU eviction), a fixed-slot scheduler so the WHOLE
+serving step — chunked prefill spans and decode tokens in one ragged
+batch — compiles exactly once, and the ragged paged-attention Pallas
+kernel (``ops/pallas/ragged_attention.py``) doing the reads.
 
 Usage::
 
@@ -18,7 +20,8 @@ Usage::
 
 from __future__ import annotations
 
-from .block_allocator import BlockAllocator, PagedKVCache  # noqa: F401
+from .block_allocator import (BlockAllocator, PagedKVCache,  # noqa: F401
+                              PrefixCache)
 from .engine import Engine, TokenEvent  # noqa: F401
 from .scheduler import Request, RequestState, Scheduler  # noqa: F401
 

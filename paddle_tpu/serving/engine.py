@@ -2,32 +2,46 @@
 
 The throughput story (docs/SERVING.md): instead of one ``generate()``
 call per tenant — dense per-sequence caches, per-sequence latency —
-``Engine`` keeps ``max_batch`` decode slots running through ONE compiled
-decode step and admits/retires requests between steps.  The decode step
-reads attention via :func:`incubate.nn.functional.paged_attention`
-(Pallas scalar-prefetch kernel on TPU) and appends via the paged scatter
-ops, over a global block pool shared by all requests.
+``Engine`` keeps ``max_batch`` slots running through ONE compiled ragged
+step and admits/retires requests between steps.  Every step dispatches a
+single fixed-shape batch of per-slot token SPANS — chunked-prefill
+segments and single decode tokens side by side — through
+:func:`incubate.nn.functional.ragged_paged_attend` (the ragged Pallas
+kernel on TPU, the XLA gather fallback elsewhere), over a global block
+pool shared by all requests.  Repeated prompt prefixes share physical
+blocks via the hash-based prefix cache (block_allocator.PrefixCache):
+admission maps hit pages into the new table, reserves only the
+remainder, and the step copy-on-writes any borrowed page before writing
+into it.
 
-Recompile contract: after :meth:`warmup` — one compile for the decode
-step + one per prefill bucket — requests of ANY length mix joining and
-leaving the batch trigger ZERO further compiles (fixed slot shapes, see
-``scheduler.py``; enforced by the ``serving-smoke`` CI gate).
+Recompile contract: after :meth:`warmup` — ONE compile for the unified
+step plus one for the CoW page-copy helper — requests of ANY length mix
+joining and leaving the batch trigger ZERO further compiles (fixed span
+shapes, see ``scheduler.py``; enforced by the ``serving-smoke`` CI gate).
+Chunked prefill is what keeps that single shape honest: a 2k-token
+prompt and a decode token ride the same ``(B, C)`` dispatch, so heavy
+admission can no longer stall decode behind per-bucket prefill programs
+(head-of-line TTFT — the "Ragged Paged Attention" design, PAPERS.md).
 
 Step anatomy (one :meth:`step` call):
 
 1. **admit**: waiting requests move into free slots while blocks last;
-   each admission runs one bucket-padded prefill (writes the prompt's
-   KV into its reserved pages, samples the first token → TTFT);
-2. **decode**: one compiled step over ALL slots — every active slot's
-   pending token is embedded, its KV appended at ``context_len``, paged
-   attention over its block table, next token sampled (per-slot
-   greedy/temperature);
-3. **retire**: EOS / max-token requests leave their slot, their blocks
-   return to the free list, callbacks/stream consumers get the tokens.
+   prefix-cache hits skip straight to their first uncached token;
+2. **plan + CoW**: each active slot gets its span (next prefill chunk,
+   bounded by the per-step token budget, or its pending decode token);
+   spans landing in borrowed pages trigger the copy-on-write dispatch;
+3. **one ragged step**: every span's KV is scattered at its positions,
+   every query row attends its prefix, one token is sampled per slot —
+   consumed only by slots that completed their prompt (TTFT) or decoded;
+4. **retire**: EOS / max-token requests leave their slot; their private
+   full-prompt pages stay indexed in the prefix cache (evictable LRU),
+   everything else returns to the free list.
 
 Telemetry (all zero-overhead when observability is disabled):
 ``serve.ttft_ms``, ``serve.step_ms``, ``serve.tok_s``,
-``serve.queue_depth``, ``serve.kv_blocks_used``, ``serve.active_requests``
+``serve.queue_depth``, ``serve.kv_blocks_used``, ``serve.active_requests``,
+``serve.ragged_occupancy``, ``serve.prefix_hits``/``misses``,
+``serve.shared_blocks``, ``serve.cached_blocks``, ``serve.cow_copies``
 + ``serve_request`` / ``serve_step`` / ``serve_finish`` events and a
 ``serve.step`` flight-recorder span per step.
 """
@@ -48,7 +62,7 @@ import jax.numpy as jnp
 from .. import observability as obs
 from ..observability.spans import span
 from ..nn.layer import _swapped_params, functional_call, serving_params
-from .block_allocator import PagedKVCache
+from .block_allocator import PagedKVCache, PrefixCache
 from .scheduler import Request, RequestState, Scheduler
 
 __all__ = ["Engine", "TokenEvent"]
@@ -104,6 +118,20 @@ class Engine:
     ``serving_params``.  ``kv_cache_dtype="int8"`` allocates quantized
     pools (the :func:`quantize_kv` scales, halved KV traffic).
 
+    ``prefill_chunk``: span width C of the unified step (default
+    ``min(16, max_seq_len)``) — prompts prefill in ≤C-token chunks
+    interleaved with decode, so one compiled
+    ``(B, C)`` program serves every batch mix.  ``prefill_token_budget``
+    caps the TOTAL prefill tokens scheduled per step (default:
+    unbounded, i.e. ``max_batch * prefill_chunk``) — on TPU the ragged
+    kernel skips dead pages, so a tighter budget bounds per-step latency
+    under bursty admission.
+
+    ``enable_prefix_caching``: hash-based sharing of page-aligned prompt
+    prefixes across requests (copy-on-write on shared-page writes, LRU
+    eviction of unreferenced cached blocks).  Greedy outputs remain
+    token-identical to ``model.generate()`` either way.
+
     ``detokenize``: optional ``callable(list[int]) -> str``; when given,
     token events and ``on_token`` callbacks carry the incremental text.
     For streaming it is called on a sliding tail window of the output
@@ -120,7 +148,9 @@ class Engine:
                  max_seq_len: int = 256, page_size: int = 16,
                  num_blocks: Optional[int] = None,
                  kv_cache_dtype=None,
-                 prefill_buckets: Optional[Sequence[int]] = None,
+                 prefill_chunk: Optional[int] = None,
+                 prefill_token_budget: Optional[int] = None,
+                 enable_prefix_caching: bool = True,
                  detokenize: Optional[Callable] = None, seed: int = 0,
                  keep_finished: int = 1024):
         if not _paged_supported(model):
@@ -132,6 +162,12 @@ class Engine:
             raise ValueError(
                 f"bad geometry: max_batch={max_batch}, "
                 f"max_seq_len={max_seq_len}, page_size={page_size}")
+        if prefill_chunk is None:
+            prefill_chunk = min(16, int(max_seq_len))
+        if not 1 <= prefill_chunk <= max_seq_len:
+            raise ValueError(
+                f"prefill_chunk={prefill_chunk} must be in "
+                f"[1, max_seq_len={max_seq_len}]")
         max_pos = getattr(model.cfg, "max_position_embeddings", None)
         if max_pos is not None and max_seq_len > max_pos:
             raise ValueError(
@@ -142,6 +178,10 @@ class Engine:
         self.max_batch = int(max_batch)
         self.max_seq_len = int(max_seq_len)
         self.page_size = int(page_size)
+        self.prefill_chunk = int(prefill_chunk)
+        # a zero/negative budget would idle every prefilling slot forever
+        self.prefill_token_budget = None if prefill_token_budget is None \
+            else max(1, int(prefill_token_budget))
         self.max_blocks_per_seq = -(-self.max_seq_len // self.page_size)
         if num_blocks is None:
             # enough for every slot to run a full-length sequence
@@ -151,22 +191,13 @@ class Engine:
             getattr(model.cfg, "dtype", "float32")
         self.kv = PagedKVCache(n_layers, num_blocks, self.page_size,
                                kv_heads, head_dim, dtype=dtype)
+        self.prefix_cache = PrefixCache(self.kv.allocator, self.page_size) \
+            if enable_prefix_caching else None
         self.scheduler = Scheduler(self.max_batch, self.page_size,
                                    self.max_blocks_per_seq,
-                                   self.kv.allocator, self.kv.oob_block)
+                                   self.kv.allocator, self.kv.oob_block,
+                                   prefix_cache=self.prefix_cache)
         self.params = serving_params(model)
-        if prefill_buckets is None:
-            buckets, b = [], 16
-            while b < self.max_seq_len:
-                buckets.append(b)
-                b *= 2
-            buckets.append(self.max_seq_len)
-            prefill_buckets = buckets
-        self._buckets = sorted(set(int(b) for b in prefill_buckets))
-        if self._buckets[-1] > self.max_seq_len:
-            raise ValueError(
-                f"prefill bucket {self._buckets[-1]} exceeds "
-                f"max_seq_len={self.max_seq_len}")
         self._detokenize = detokenize
         self._key = jax.random.key(seed)
         self._step_i = 0
@@ -180,6 +211,7 @@ class Engine:
         # eviction can't outrun (None outside run(), so step()/stream()
         # users accumulate no unbounded side state)
         self._drain_capture: Optional[Dict[str, List[int]]] = None
+        self._cow_copies = 0
         self._build_fns()
 
     # -- compiled paths ----------------------------------------------------
@@ -191,70 +223,58 @@ class Engine:
             with _swapped_params(model, params):
                 return model.logits(hidden)[:, 0]
 
-        def decode_fn(params, caches, tokens, tables, lens, temps, key,
-                      step_i):
+        def step_fn(params, caches, tokens, tables, starts, lens, temps,
+                    key, step_i):
+            """The ONE serving program: every slot's span (prefill chunk
+            or decode token) writes its KV and attends in a single
+            ragged dispatch; one token is sampled per slot from the last
+            real span position (hosts of mid-prefill slots discard it)."""
             mp = {k[len("model."):]: v for k, v in params.items()
                   if k.startswith("model.")}
             hidden, caches = functional_call(
-                model.model, mp, tokens[:, None], caches=caches,
-                seq_lens=lens, block_tables=tables, training=False)
-            lg = _logits_of(params, hidden[:, -1:])
-            return _sample(lg, temps, key, step_i), caches
-
-        def prefill_fn(params, caches, ids, tables, plens, temps, key,
-                       step_i):
-            mp = {k[len("model."):]: v for k, v in params.items()
-                  if k.startswith("model.")}
-            hidden, caches = functional_call(
-                model.model, mp, ids, caches=caches, seq_lens=plens,
-                block_tables=tables, training=False)
-            # the LAST REAL token's hidden state, not the padded tail's
-            idx = (plens - 1)[:, None, None]
+                model.model, mp, tokens, caches=caches, seq_lens=lens,
+                block_tables=tables, span_starts=starts, training=False)
+            # the last REAL span token's hidden state, not the padding's
+            idx = jnp.clip(lens - 1, 0, tokens.shape[1] - 1)[:, None, None]
             h_last = jnp.take_along_axis(hidden, idx, axis=1)
             lg = _logits_of(params, h_last)
             return _sample(lg, temps, key, step_i), caches
 
-        # pools are donated: the engine owns exactly one copy in HBM
-        self._decode_fn = jax.jit(decode_fn, donate_argnums=(1,))
-        self._prefill_fn = jax.jit(prefill_fn, donate_argnums=(1,))
+        def cow_fn(caches, src, dst):
+            """Copy-on-write page copies src[i] → dst[i] in every layer's
+            pools; padded entries carry the OOB sentinel (dropped)."""
+            from ..incubate.nn.functional import paged_copy_blocks
+            return [paged_copy_blocks(c, src, dst) for c in caches]
 
-    def _bucket_for(self, prompt_len: int) -> int:
-        for b in self._buckets:
-            if b >= prompt_len:
-                return b
-        raise ValueError(
-            f"prompt of {prompt_len} tokens exceeds the largest prefill "
-            f"bucket ({self._buckets[-1]})")
+        # pools are donated: the engine owns exactly one copy in HBM
+        self._step_fn = jax.jit(step_fn, donate_argnums=(1,))
+        self._cow_fn = jax.jit(cow_fn, donate_argnums=(0,))
 
     def warmup(self) -> "Engine":
-        """Compile the decode step and every prefill bucket up front.
+        """Compile the unified ragged step and the CoW helper up front.
 
-        Uses all-out-of-range block tables, so the warmup traffic's
-        writes are dropped — no allocator interaction, no pool pollution.
-        After this, serving traffic compiles NOTHING (the serving-smoke
-        gate's contract)."""
+        Uses all-out-of-range block tables and zero span lengths, so the
+        warmup traffic's writes are dropped — no allocator interaction,
+        no pool pollution.  After this, serving traffic compiles NOTHING
+        (the serving-smoke gate's contract)."""
         with span("serve.warmup"):
-            b, mb = self.max_batch, self.max_blocks_per_seq
+            b, mb, c = self.max_batch, self.max_blocks_per_seq, \
+                self.prefill_chunk
             oob = np.full((b, mb), self.kv.oob_block, np.int32)
-            step0 = jnp.asarray(np.int32(0))
-            nxt, caches = self._decode_fn(
+            zeros_i = np.zeros((b,), np.int32)
+            nxt, caches = self._step_fn(
                 self.params, self.kv.caches,
-                jnp.asarray(np.zeros((b,), np.int32)), jnp.asarray(oob),
-                jnp.asarray(np.zeros((b,), np.int32)),
+                jnp.asarray(np.zeros((b, c), np.int32)), jnp.asarray(oob),
+                jnp.asarray(zeros_i), jnp.asarray(zeros_i),
                 jnp.asarray(np.zeros((b,), np.float32)),
-                self._key, step0)
+                self._key, jnp.asarray(np.int32(0)))
             jax.block_until_ready(nxt)
             self.kv.caches = caches
-            for bucket in self._buckets:
-                nxt, caches = self._prefill_fn(
-                    self.params, self.kv.caches,
-                    jnp.asarray(np.zeros((1, bucket), np.int32)),
-                    jnp.asarray(oob[:1]),
-                    jnp.asarray(np.ones((1,), np.int32)),
-                    jnp.asarray(np.zeros((1,), np.float32)),
-                    self._key, step0)
-                jax.block_until_ready(nxt)
-                self.kv.caches = caches
+            pad = np.full((b,), self.kv.oob_block, np.int32)
+            caches = self._cow_fn(self.kv.caches, jnp.asarray(pad),
+                                  jnp.asarray(pad))
+            jax.block_until_ready(jax.tree_util.tree_leaves(caches)[0])
+            self.kv.caches = caches
         return self
 
     # -- request lifecycle -------------------------------------------------
@@ -266,7 +286,8 @@ class Engine:
                     request_id: Optional[str] = None) -> str:
         """Queue one request; returns its id.  The request joins the
         running batch at the next ``step()`` with a free slot and enough
-        free blocks for its WHOLE budget (prompt + max_new_tokens)."""
+        free blocks for its budget (prompt + max_new_tokens, minus any
+        prefix-cache hit)."""
         req = Request(prompt_ids=prompt_ids,
                       max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature),
@@ -293,7 +314,6 @@ class Engine:
                 f"{self.page_size}) but the pool has only "
                 f"{self.kv.num_blocks} — raise num_blocks or lower the "
                 "budget")
-        self._bucket_for(p)   # validates against the bucket ladder
         st = self.scheduler.submit(req)
         self._states[req.request_id] = st
         reg = obs.get_registry()
@@ -312,39 +332,67 @@ class Engine:
     def kv_blocks_used(self) -> int:
         return self.kv.allocator.used_blocks
 
+    def prefix_stats(self) -> Dict[str, float]:
+        """Prefix-cache counters (hits/misses/hit_rate/registered_pages/
+        evictions) plus the current CoW copy count — zeros when prefix
+        caching is disabled."""
+        s = self.prefix_cache.stats() if self.prefix_cache is not None \
+            else {"hits": 0, "misses": 0, "hit_rate": 0.0,
+                  "registered_pages": 0, "evictions": 0}
+        s["cow_copies"] = self._cow_copies
+        return s
+
     # -- the loop ----------------------------------------------------------
 
-    def _run_prefill(self, st: RequestState, events: List[TokenEvent]):
-        req = st.request
-        p = int(req.prompt_ids.size)
-        bucket = self._bucket_for(p)
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, :p] = req.prompt_ids
-        # device_put of ready numpy arrays only: jnp.asarray of a Python
-        # list/scalar traces a tiny program whose one-off compile would
-        # break the zero-compiles-after-warmup contract
-        nxt, caches = self._prefill_fn(
-            self.params, self.kv.caches, jnp.asarray(ids),
-            jnp.asarray(st.table[None]),
-            jnp.asarray(np.asarray([p], np.int32)),
-            jnp.asarray(np.asarray([req.temperature], np.float32)),
-            self._key, jnp.asarray(np.int32(self._step_i)))
-        self.kv.caches = caches
-        self._step_i += 1
-        # np.asarray is the device sync: JAX dispatch is async, so the
-        # clock must stop AFTER the first token materializes or TTFT
-        # reports queueing overhead instead of time-to-first-token
-        nxt_tok = int(np.asarray(nxt)[0])
-        st.kv_len = p
-        st.first_token_t = time.perf_counter()
+    def _run_cow(self, plan) -> None:
+        """Copy-on-write: any span about to write into a borrowed
+        (shared) page gets a private copy first — the reserved spare
+        block takes the page's content via one fixed-shape device copy,
+        the table is repointed, and the shared reference is dropped."""
+        copies = []
+        for i, st, n, is_prefill in plan:
+            if not st.borrowed:
+                continue
+            first = st.kv_len // self.page_size
+            last = (st.kv_len + n - 1) // self.page_size
+            for pg in range(first, last + 1):
+                if pg not in st.borrowed:
+                    continue
+                src = int(st.table[pg])
+                dst = st.cow_spare.pop(pg)
+                st.table[pg] = dst
+                st.borrowed.discard(pg)
+                st.num_cowed += 1
+                st.blocks.remove(src)
+                self.kv.allocator.free([src])   # drop OUR shared ref
+                copies.append((src, dst))
+        if not copies:
+            return
+        k = self.max_batch
+        for lo in range(0, len(copies), k):
+            batch = copies[lo:lo + k]
+            src = np.full((k,), self.kv.oob_block, np.int32)
+            dst = np.full((k,), self.kv.oob_block, np.int32)
+            for j, (s_, d_) in enumerate(batch):
+                src[j], dst[j] = s_, d_
+            self.kv.caches = self._cow_fn(self.kv.caches,
+                                          jnp.asarray(src),
+                                          jnp.asarray(dst))
+        self._cow_copies += len(copies)
         reg = obs.get_registry()
         if reg is not None:
-            reg.histogram("serve.ttft_ms").observe(
-                (st.first_token_t - st.submit_t) * 1e3)
-        obs.emit_event("serve_request", id=req.request_id, prompt_len=p,
-                       bucket=bucket, slot=st.slot,
-                       blocks=len(st.blocks))
-        self._emit(st, nxt_tok, events)
+            reg.counter("serve.cow_copies").inc(len(copies))
+
+    def _register_prefix(self, st: RequestState) -> None:
+        """Index this request's freshly-written full prompt pages so
+        later requests with the same prefix hit them.  Pages borrowed
+        from the cache are already indexed (register no-ops on a live
+        key); first writer wins when two identical prompts prefill
+        concurrently."""
+        if self.prefix_cache is None:
+            return
+        for pg, key in enumerate(st.page_keys):
+            self.prefix_cache.register(key, int(st.table[pg]))
 
     def _emit(self, st: RequestState, token: int,
               events: List[TokenEvent]):
@@ -402,34 +450,67 @@ class Engine:
                     RuntimeWarning, stacklevel=2)
 
     def step(self) -> List[TokenEvent]:
-        """Admit what fits, run one decode step, retire what finished.
-        Returns the tokens emitted (one per prefilled/active request)."""
+        """Admit what fits, run ONE unified ragged step (prefill chunks
+        + decode tokens together), retire what finished.  Returns the
+        tokens emitted (one per decoded / prompt-completed request)."""
         t0 = time.perf_counter()
         events: List[TokenEvent] = []
         with span("serve.step", emit=False):
-            while True:
-                st = self.scheduler.admit_next()
-                if st is None:
-                    break
-                self._run_prefill(st, events)
-            active = self.scheduler.active()
-            if active:
-                tokens, tables, lens, temps = self.scheduler.batch_arrays()
-                nxt, caches = self._decode_fn(
+            while self.scheduler.admit_next() is not None:
+                pass
+            plan = self.scheduler.plan_spans(self.prefill_chunk,
+                                             self.prefill_token_budget)
+            live_tokens = sum(n for _, _, n, _ in plan)
+            if plan:
+                self._run_cow(plan)
+                tokens, tables, starts, lens, temps = \
+                    self.scheduler.span_arrays(plan, self.prefill_chunk)
+                # device_put of ready numpy arrays only: jnp.asarray of
+                # a Python list/scalar traces a tiny program whose
+                # one-off compile would break the zero-compiles-after-
+                # warmup contract
+                nxt, caches = self._step_fn(
                     self.params, self.kv.caches, jnp.asarray(tokens),
-                    jnp.asarray(tables), jnp.asarray(lens),
-                    jnp.asarray(temps), self._key,
+                    jnp.asarray(tables), jnp.asarray(starts),
+                    jnp.asarray(lens), jnp.asarray(temps), self._key,
                     jnp.asarray(np.int32(self._step_i)))
                 self.kv.caches = caches
                 self._step_i += 1
+                # np.asarray is the device sync: JAX dispatch is async,
+                # so the TTFT clock below must stop AFTER the first
+                # token materializes, or it reports queueing overhead
                 nxt = np.asarray(nxt)
-                for i, st in active:
-                    st.kv_len += 1   # the pending token's KV just landed
+                for i, st, n, is_prefill in plan:
+                    st.kv_len += n
+                    if is_prefill and st.prefilling:
+                        continue        # mid-prefill: sample discarded
+                    if is_prefill:
+                        # prompt complete: this sample is the request's
+                        # first token — TTFT stops here
+                        self._register_prefix(st)
+                        st.first_token_t = time.perf_counter()
+                        req = st.request
+                        reg = obs.get_registry()
+                        if reg is not None:
+                            reg.histogram("serve.ttft_ms").observe(
+                                (st.first_token_t - st.submit_t) * 1e3)
+                            if st.num_shared:
+                                reg.counter("serve.prefix_hits").inc(
+                                    st.num_shared)
+                            misses = len(st.page_keys) - st.num_shared
+                            if misses:
+                                reg.counter("serve.prefix_misses").inc(
+                                    misses)
+                        obs.emit_event(
+                            "serve_request", id=req.request_id,
+                            prompt_len=int(req.prompt_ids.size),
+                            slot=st.slot, blocks=len(st.blocks),
+                            cached_tokens=st.cached_tokens)
                     self._emit(st, int(nxt[i]), events)
         n_tok = len(events)
         dt = time.perf_counter() - t0
         reg = obs.get_registry()
-        if reg is not None and n_tok:
+        if reg is not None and plan:
             reg.counter("serve.tokens").inc(n_tok)
             reg.gauge("serve.tok_s").set(round(n_tok / max(dt, 1e-9), 1))
             reg.gauge("serve.queue_depth").set(self.scheduler.queue_depth())
@@ -438,9 +519,20 @@ class Engine:
             reg.gauge("serve.active_requests").set(
                 len(self.scheduler.active()))
             reg.histogram("serve.step_ms").observe(dt * 1e3)
-        if n_tok:
+            # how full the ragged dispatch ran: real span tokens over the
+            # (B, C) capacity — low occupancy means idle lanes, not bugs
+            reg.histogram("serve.ragged_occupancy").observe(
+                live_tokens / (self.max_batch * self.prefill_chunk))
+            reg.gauge("serve.cached_blocks").set(
+                self.kv.allocator.cached_blocks)
+            # pages still physically shared: admission hits minus the
+            # ones CoW has since privatized
+            reg.gauge("serve.shared_blocks").set(
+                sum(s.num_shared - s.num_cowed
+                    for _, s in self.scheduler.active()))
+        if plan:
             obs.emit_event("serve_step", ms=round(dt * 1e3, 3),
-                           tokens=n_tok,
+                           tokens=n_tok, span_tokens=live_tokens,
                            active=len(self.scheduler.active()),
                            queue=self.scheduler.queue_depth(),
                            kv_blocks_used=self.kv.allocator.used_blocks)

@@ -1,21 +1,35 @@
-"""Continuous-batching scheduler: admission queue + fixed-shape slots.
+"""Continuous-batching scheduler: admission queue + fixed-shape ragged
+slots.
 
-The whole point of this module is that the compiled decode step NEVER
-retraces: the decode batch is always ``max_batch`` slots with static
-array shapes — ``tokens (B,)``, ``block_tables (B, MB)``,
-``context_lens (B,)``, ``temps (B,)`` — and requests join/leave a
-running batch purely by editing the VALUES in those arrays:
+The whole point of this module is that the compiled serving step NEVER
+retraces: the batch is always ``max_batch`` slots with static array
+shapes — ``tokens (B, C)``, ``block_tables (B, MB)``,
+``span_starts (B,)``, ``span_lens (B,)``, ``temps (B,)`` — and requests
+join/leave a running batch purely by editing the VALUES in those arrays:
 
-- an **active** slot carries its real block-table row, KV length and
-  pending token;
-- an **inactive** slot carries the out-of-range block sentinel
-  (scatters drop), length 0 and token 0 — its lane computes garbage the
-  engine discards, which on TPU is cheaper than a recompile by ~5
-  orders of magnitude (see the recompile sentinel's storm warning).
+- a slot mid-PREFILL carries its next ≤C-token prompt chunk starting at
+  ``kv_len`` (chunked prefill — no per-length bucket programs, no
+  head-of-line stall while a long prompt prefills);
+- a DECODING slot carries its single pending token (span length 1);
+- an idle/inactive slot carries span length 0 and the out-of-range
+  block sentinel (scatters drop) — its lane computes garbage the engine
+  discards, which on TPU is cheaper than a recompile by ~5 orders of
+  magnitude (see the recompile sentinel's storm warning).
 
-Admission reserves every block a request can ever need
-(``ceil((prompt + max_new) / page)``) up front, so decode can never die
-on pool exhaustion — a full pool only delays the waiting queue.
+Admission reserves every block a request can ever WRITE up front
+(``ceil((prompt + max_new) / page)`` minus read-only prefix-cache hits),
+so decode can never die on pool exhaustion — a full pool only delays the
+waiting queue.  Prefix-cache hits map shared blocks into the new table
+and reserve only the remainder; a hit covering the WHOLE prompt keeps
+the last matched page borrowed, re-prefills its final token, and
+reserves a private replacement for the copy-on-write the engine performs
+before that write (serving/block_allocator.py has the lifecycle).
+
+Per-step chunk budgeting: ``plan_spans(chunk, budget)`` caps the TOTAL
+prefill tokens scheduled per step and round-robins the budget across
+prefilling slots, so on TPU (where the ragged kernel skips dead pages) a
+burst of admissions cannot stretch one step's latency unboundedly —
+decode slots always advance.
 """
 
 from __future__ import annotations
@@ -24,9 +38,11 @@ import collections
 import dataclasses
 import itertools
 import time
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
+
+from .block_allocator import PrefixCache
 
 __all__ = ["Request", "RequestState", "Scheduler"]
 
@@ -61,7 +77,8 @@ class RequestState:
     __slots__ = ("request", "slot", "blocks", "table", "kv_len",
                  "pending_token", "output_ids", "text_len", "detok_offset",
                  "submit_t", "first_token_t", "finished", "finish_reason",
-                 "drained")
+                 "drained", "num_shared", "num_cowed", "cached_tokens",
+                 "borrowed", "cow_spare", "page_keys")
 
     def __init__(self, request: Request):
         self.request = request
@@ -78,29 +95,49 @@ class RequestState:
         self.finished = False
         self.finish_reason: Optional[str] = None
         self.drained = False         # returned by an Engine.run() already
+        self.num_shared = 0          # prefix-cache pages borrowed
+        self.num_cowed = 0           # of those, privatized by CoW since
+        self.cached_tokens = 0       # prompt tokens skipped via the cache
+        self.borrowed: Set[int] = set()   # shared pages we may yet write
+        self.cow_spare: Dict[int, int] = {}   # page → reserved CoW block
+        self.page_keys: List[bytes] = []      # full-prompt-page digests
 
     @property
     def total_len(self) -> int:
         return int(self.request.prompt_ids.size) + self.request.max_new_tokens
+
+    @property
+    def prefilling(self) -> bool:
+        return self.kv_len < int(self.request.prompt_ids.size)
 
 
 class Scheduler:
     """Waiting queue + the fixed slot bucket."""
 
     def __init__(self, max_batch: int, page_size: int,
-                 max_blocks_per_seq: int, allocator, oob_block: int):
+                 max_blocks_per_seq: int, allocator, oob_block: int,
+                 prefix_cache: Optional[PrefixCache] = None):
         self.max_batch = int(max_batch)
         self.page_size = int(page_size)
         self.max_blocks_per_seq = int(max_blocks_per_seq)
         self.allocator = allocator
         self.oob_block = int(oob_block)
+        self.prefix_cache = prefix_cache
         self.waiting: "collections.deque[RequestState]" = collections.deque()
         self.slots: List[Optional[RequestState]] = [None] * self.max_batch
+        self._rr = 0   # round-robin origin for the prefill token budget
 
     # -- admission ---------------------------------------------------------
 
     def submit(self, request: Request) -> RequestState:
         st = RequestState(request)
+        if self.prefix_cache is not None:
+            # hash the prompt's pages ONCE here: admit_next runs every
+            # step, and a request parked at the queue head under
+            # pool-exhaustion backpressure must not re-run O(prompt)
+            # blake2b chains per retry
+            st.page_keys = PrefixCache.page_keys(request.prompt_ids,
+                                                 self.page_size)
         self.waiting.append(st)
         return st
 
@@ -123,26 +160,70 @@ class Scheduler:
         return self.blocks_for(st.total_len)
 
     def admit_next(self) -> Optional[RequestState]:
-        """Move the head of the waiting queue into a slot, reserving its
-        full block budget.  FIFO head-of-line: a large head request
-        waits for blocks rather than being starved by later small ones.
-        Returns the admitted state, or None (no slot / no blocks / no
-        waiters)."""
+        """Move the head of the waiting queue into a slot.  FIFO
+        head-of-line: a large head request waits for blocks rather than
+        being starved by later small ones.  With a prefix cache, hit
+        pages are borrowed (refcount shared) and only the remainder is
+        reserved; prefill resumes at the cached length.  Returns the
+        admitted state, or None (no slot / no blocks / no waiters)."""
         if not self.waiting:
             return None
         slot = self._free_slot()
         if slot is None:
             return None
         st = self.waiting[0]
-        need = self.blocks_needed(st)
-        if not self.allocator.can_allocate(need):
-            return None
+        plen = int(st.request.prompt_ids.size)
+        total = self.blocks_needed(st)
+        keys = st.page_keys                    # hashed once at submit()
+        hit_blocks: List[int] = []
+        if self.prefix_cache is not None:
+            hit_blocks = self.prefix_cache.lookup(keys)
+        shared = len(hit_blocks)
+        # physical capacity: reviving a refcount-0 cached hit consumes a
+        # unit of free capacity too (can_allocate counts evictable blocks
+        # as free, but share() takes them out of that pool), and a fully
+        # cached prompt's CoW spare needs one block beyond
+        # blocks_for(total) — so the full hit may not fit even when the
+        # no-hit path would.  Degrade the hit page by page until it
+        # fits; shared == 0 is the plain path, eventually satisfiable
+        # because add_request guarantees total <= num_blocks.
+        while True:
+            # always leave >= 1 prompt token to prefill: the first
+            # output token needs the last prompt position's logits, and
+            # a fully cached prompt would otherwise skip the forward
+            first_write = min(shared * self.page_size, plen - 1)
+            ro_pages = first_write // self.page_size   # never written
+            need_private = total - ro_pages
+            revive = sum(1 for bid in hit_blocks[:shared]
+                         if self.allocator.refcount(bid) == 0)
+            if self.allocator.can_allocate(need_private + revive):
+                break
+            if shared == 0:
+                return None
+            shared -= 1
+        hit_blocks = hit_blocks[:shared]
+        for bid in hit_blocks:                     # commit the hit
+            self.allocator.share(bid)
+        priv = self.allocator.allocate(need_private)
+        if self.prefix_cache is not None and keys:
+            self.prefix_cache.record(shared, len(keys) - shared)
         self.waiting.popleft()
         st.slot = slot
-        st.blocks = self.allocator.allocate(need)
+        st.blocks = list(hit_blocks) + priv        # one reference each
         st.table = np.full((self.max_blocks_per_seq,), self.oob_block,
                            np.int32)
-        st.table[:need] = st.blocks
+        st.table[:shared] = hit_blocks
+        tail = total - shared                      # pages past the hit
+        st.table[shared:total] = priv[:tail]
+        # leftover private blocks are CoW replacements for borrowed
+        # pages the prefill will write into (at most one: the last
+        # matched page of a fully-cached prompt)
+        st.cow_spare = {pg: priv[tail + k]
+                        for k, pg in enumerate(range(ro_pages, shared))}
+        st.borrowed = set(range(ro_pages, shared))
+        st.num_shared = shared
+        st.cached_tokens = first_write
+        st.kv_len = first_write
         self.slots[slot] = st
         return st
 
@@ -151,24 +232,62 @@ class Scheduler:
     def active(self) -> List[Tuple[int, RequestState]]:
         return [(i, s) for i, s in enumerate(self.slots) if s is not None]
 
-    def batch_arrays(self):
-        """The fixed-shape decode inputs: (tokens, tables, lens, temps)
-        as numpy arrays.  Inactive slots get the inert sentinel values —
-        shapes NEVER depend on occupancy."""
-        b, mb = self.max_batch, self.max_blocks_per_seq
-        tokens = np.zeros((b,), np.int32)
+    def plan_spans(self, chunk: int, budget: Optional[int] = None
+                   ) -> List[Tuple[int, "RequestState", int, bool]]:
+        """Decide each active slot's span for this step: ``(slot, state,
+        span_len, is_prefill)``.  Decode slots always get their 1 token;
+        prefilling slots split ``budget`` prefill tokens (default: no
+        cap) in ≤``chunk`` chunks, round-robined across steps so a tight
+        budget starves nobody.  Slots left out idle this step (span 0).
+        The engine runs copy-on-write for spans that land in borrowed
+        pages BEFORE materializing the batch arrays (span_arrays)."""
+        c = int(chunk)
+        left = int(budget) if budget is not None else self.max_batch * c
+        self._rr = (self._rr + 1) % max(self.max_batch, 1)
+        order = sorted(self.active(),
+                       key=lambda t: (t[0] - self._rr) % self.max_batch)
+        plan = []
+        for i, st in order:
+            if st.prefilling:
+                plen = int(st.request.prompt_ids.size)
+                n = min(c, plen - st.kv_len, left)
+                if n <= 0:
+                    continue                       # budget spent: idle
+                left -= n
+                plan.append((i, st, n, True))
+            else:
+                plan.append((i, st, 1, False))
+        plan.sort(key=lambda t: t[0])
+        return plan
+
+    def span_arrays(self, plan, chunk: int):
+        """The fixed-shape ragged step inputs for a span plan:
+        ``(tokens (B,C), tables (B,MB), starts (B,), lens (B,),
+        temps (B,))`` as numpy arrays.  Idle/empty slots get the inert
+        sentinel values — shapes NEVER depend on occupancy.  Call AFTER
+        copy-on-write has patched the tables."""
+        b, mb, c = self.max_batch, self.max_blocks_per_seq, int(chunk)
+        tokens = np.zeros((b, c), np.int32)
         tables = np.full((b, mb), self.oob_block, np.int32)
+        starts = np.zeros((b,), np.int32)
         lens = np.zeros((b,), np.int32)
         temps = np.zeros((b,), np.float32)
-        for i, st in self.active():
-            tokens[i] = st.pending_token
+        for i, st, n, is_prefill in plan:
+            req = st.request
+            if is_prefill:
+                tokens[i, :n] = req.prompt_ids[st.kv_len:st.kv_len + n]
+            else:
+                tokens[i, 0] = st.pending_token
             tables[i] = st.table
-            lens[i] = st.kv_len
-            temps[i] = st.request.temperature
-        return tokens, tables, lens, temps
+            starts[i] = st.kv_len
+            lens[i] = n
+            temps[i] = req.temperature
+        return tokens, tables, starts, lens, temps
 
     def finish(self, st: RequestState, reason: str) -> None:
-        """Release the slot and reclaim every reserved block."""
+        """Release the slot and drop every block reference (shared pages
+        decref; private pages return to the free list or, if registered
+        in the prefix cache, to the evictable LRU pool)."""
         st.finished = True
         st.finish_reason = reason
         if st.slot is not None:

@@ -694,14 +694,15 @@ def test_telemetry_report_folds_serving_events(tmp_path):
     pure stdlib over the event schema."""
     path = str(tmp_path / "serve.jsonl")
     with open(path, "w") as f:
-        for i, (n, b) in enumerate([(5, 16), (23, 32), (9, 16)]):
+        for i, (n, ct) in enumerate([(5, 0), (23, 16), (9, 8)]):
             f.write(json.dumps({"event": "serve_request", "id": f"r{i}",
-                                "prompt_len": n, "bucket": b, "slot": i,
-                                "blocks": 2}) + "\n")
-        for ms, tok, act, q in [(4.0, 1, 1, 2), (2.0, 3, 3, 0),
-                                (2.5, 3, 3, 0), (3.0, 2, 2, 0)]:
+                                "prompt_len": n, "slot": i, "blocks": 2,
+                                "cached_tokens": ct}) + "\n")
+        for ms, tok, act, q, sp in [(4.0, 1, 1, 2, 9), (2.0, 3, 3, 0, 3),
+                                    (2.5, 3, 3, 0, 3), (3.0, 2, 2, 0, 2)]:
             f.write(json.dumps({"event": "serve_step", "ms": ms,
                                 "tokens": tok, "active": act, "queue": q,
+                                "span_tokens": sp,
                                 "kv_blocks_used": 2 * act}) + "\n")
         f.write(json.dumps({"event": "serve_finish", "id": "r0",
                             "reason": "length", "tokens": 4,
@@ -709,12 +710,23 @@ def test_telemetry_report_folds_serving_events(tmp_path):
         f.write(json.dumps({"event": "serve_finish", "id": "r1",
                             "reason": "eos", "tokens": 2,
                             "ms": 8.0}) + "\n")
+        f.write(json.dumps({"event": "metrics", "metrics": {
+            "serve.prefix_hits": 3, "serve.prefix_misses": 1,
+            "serve.cow_copies": 1, "serve.shared_blocks": 2,
+            "serve.cached_blocks": 4,
+            "serve.ragged_occupancy": {"count": 4, "sum": 1.06,
+                                       "p50": 0.19, "p95": 0.56}}}) + "\n")
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "telemetry_report.py"),
          path], capture_output=True, text=True, timeout=60)
     assert r.returncode == 0, r.stderr
     assert "| Serving | |" in r.stdout
     assert "| requests (finished) | 3 (1 eos, 1 length) |" in r.stdout
+    assert "| prefix pages hit / missed | 3 / 1 (0.750) |" in r.stdout
+    assert "| prompt tokens from cache | 24 / 37 (0.649) |" in r.stdout
+    assert "| CoW copies | 1 |" in r.stdout
+    assert "| ragged occupancy p50 / p95 | 0.19 / 0.56 " \
+           "(17 span tokens) |" in r.stdout
     summary = json.loads(r.stdout.strip().splitlines()[-1])
     sv = summary["serving"]
     assert sv["requests"] == 3 and sv["steps"] == 4
@@ -723,6 +735,11 @@ def test_telemetry_report_folds_serving_events(tmp_path):
     assert sv["peak_active"] == 3 and sv["peak_queue"] == 2
     assert sv["peak_kv_blocks"] == 6
     assert sv["agg_tok_s"] == round(9 / (11.5 / 1e3), 1)
+    assert sv["prefix_hits"] == 3 and sv["prefix_hit_rate"] == 0.75
+    assert sv["cached_tokens"] == 24 and sv["span_tokens"] == 17
+    assert sv["cow_copies"] == 1 and sv["shared_blocks"] == 2
+    assert sv["cached_blocks"] == 4
+    assert sv["ragged_occupancy_p95"] == 0.56
 
 
 def test_telemetry_report_json_only_mode_counts_malformed(tmp_path):

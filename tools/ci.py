@@ -468,20 +468,28 @@ def gate_chaos(num_steps: int = 6, save_every: int = 2) -> int:
 
 
 def gate_serving_smoke(max_batch: int = 4, n_requests: int = 10) -> int:
-    """Serving smoke: the continuous-batching engine's two standing
+    """Serving smoke: the continuous-batching engine's standing
     contracts (docs/SERVING.md), end to end on a tiny model:
 
-    1. ZERO RECOMPILES UNDER CHURN: after ``Engine.warmup()`` —
-       one compile for the decode step + one per prefill bucket —
-       requests of varying lengths joining and leaving the running
-       batch must not trigger a single further compile.  Checked two
-       ways: the recompile sentinel's backend-compile count stays at
-       its warmup level, and the jit caches of the decode/prefill
-       callables hold exactly (1, num_buckets) executables at drain
-       (the second check also catches re-TRACES that the persistent
-       XLA compile cache would hide from the sentinel).
+    1. ZERO RECOMPILES UNDER CHURN: after ``Engine.warmup()`` — ONE
+       compile for the unified ragged step plus one for the CoW page
+       copy — requests of varying lengths joining and leaving the
+       running batch, prefilling in chunks interleaved with decode,
+       must not trigger a single further compile.  Checked two ways:
+       the recompile sentinel's backend-compile count stays at its
+       warmup level, and the jit caches of the step/CoW callables hold
+       exactly one executable each at drain (the second check also
+       catches re-TRACES that the persistent XLA compile cache would
+       hide from the sentinel).
     2. FULL RECLAIM AT DRAIN: when the queue and every slot are empty,
-       ``used_blocks == 0`` — no leaked KV pages.
+       ``used_blocks == 0`` — every refcount back to zero, shared and
+       private blocks alike; prefix-cached pages linger only as
+       EVICTABLE capacity (still allocatable).
+    3. PREFIX CACHING IS AN OPTIMIZATION, NOT A TRADE: with shared
+       prompt prefixes and chunked prefill, greedy outputs stay
+       token-identical to ``model.generate()``, cache hits are > 0 on
+       the re-serve, and the fully-cached page-aligned prompt exercises
+       copy-on-write.
 
     Plus the correctness floor: every request produced exactly its
     ``max_new_tokens`` greedy tokens (EOS unset), token-identical
@@ -500,8 +508,10 @@ def gate_serving_smoke(max_batch: int = 4, n_requests: int = 10) -> int:
     try:
         pt.seed(0)
         model = llama("tiny")
+        # prefill_chunk below the longest prompt → chunked prefill is
+        # actually exercised (40-token prompts take 5 ragged steps)
         eng = serving.Engine(model, max_batch=max_batch, max_seq_len=64,
-                             page_size=8).warmup()
+                             page_size=8, prefill_chunk=8).warmup()
         compiles_at_warmup = tel.sentinel.compiles()
 
         rng = np.random.default_rng(0)
@@ -534,12 +544,11 @@ def gate_serving_smoke(max_batch: int = 4, n_requests: int = 10) -> int:
                 "(serving/scheduler.py)")
         else:
             print(f"serving-smoke: {2 * len(prompts)} requests "
-                  f"(lens {min(lens)}..{max(lens)}) joined/left the "
-                  "batch: 0 compiles after warmup")
+                  f"(lens {min(lens)}..{max(lens)}, chunked prefill) "
+                  "joined/left the batch: 0 compiles after warmup")
         sizes = []
-        for fn, want, name in ((eng._decode_fn, 1, "decode"),
-                               (eng._prefill_fn, len(eng._buckets),
-                                "prefill")):
+        for fn, want, name in ((eng._step_fn, 1, "step"),
+                               (eng._cow_fn, 1, "cow")):
             n = getattr(fn, "_cache_size", lambda: None)()
             sizes.append(f"{name}={n}")
             if n is not None and n > want:
@@ -547,14 +556,25 @@ def gate_serving_smoke(max_batch: int = 4, n_requests: int = 10) -> int:
                     f"{name} jit cache holds {n} entries, expected "
                     f"{want} — a retrace slipped past the sentinel")
         print(f"serving-smoke: jit cache sizes at drain: "
-              f"{', '.join(sizes)} (buckets: {eng._buckets})")
+              f"{', '.join(sizes)} "
+              f"(chunk={eng.prefill_chunk})")
 
         if eng.kv_blocks_used != 0:
             failures.append(
-                f"{eng.kv_blocks_used} KV block(s) still allocated at "
-                "drain — reclaim leak (serving/block_allocator.py)")
+                f"{eng.kv_blocks_used} KV block(s) still referenced at "
+                "drain — reclaim/refcount leak "
+                "(serving/block_allocator.py)")
         else:
-            print("serving-smoke: all KV blocks reclaimed at drain")
+            alloc = eng.kv.allocator
+            print(f"serving-smoke: all KV blocks reclaimed at drain "
+                  f"(refcounts 0; {alloc.cached_blocks} prefix-cached "
+                  f"pages evictable, {alloc.free_blocks} allocatable "
+                  f"of {alloc.num_blocks})")
+            if alloc.free_blocks != alloc.num_blocks:
+                failures.append(
+                    f"only {alloc.free_blocks}/{alloc.num_blocks} blocks "
+                    "allocatable at drain — cached pages must stay "
+                    "evictable capacity")
 
         for i, (a, b, m) in enumerate(zip(first, again, budgets)):
             if len(a) != m:
@@ -566,6 +586,53 @@ def gate_serving_smoke(max_batch: int = 4, n_requests: int = 10) -> int:
                     "diverged — slot state leaked between requests")
         if not any("request" in f for f in failures):
             print("serving-smoke: greedy outputs stable across re-serve")
+
+        # 3. prefix caching: shared prefixes + a fully-cached prompt,
+        # outputs token-identical to generate(), hits and CoW observed
+        import jax.numpy as jnp
+        common = rng.integers(0, model.cfg.vocab_size,
+                              size=16).astype(np.int32)   # 2 full pages
+        shared_prompts = [np.concatenate(
+            [common, rng.integers(0, model.cfg.vocab_size,
+                                  size=t).astype(np.int32)])
+            for t in (6, 11, 4)] + [common]   # last: fully cached → CoW
+        served = []
+        for p, m in zip(shared_prompts, (5, 4, 6, 5)):
+            rid = eng.add_request(p, max_new_tokens=m)
+            outs = eng.run()
+            served.append((p, m, outs[rid]))
+        churn_compiles = tel.sentinel.compiles() - compiles_at_warmup
+        # the generate() references below compile their own programs —
+        # check the engine's zero-compile contract BEFORE running them
+        for p, m, got in served:
+            ref = np.asarray(model.generate(
+                jnp.asarray(p)[None], max_new_tokens=m,
+                temperature=0.0))[0, len(p):]
+            if not np.array_equal(ref, np.asarray(got)):
+                failures.append(
+                    f"prefix-cached request (prompt {len(p)}) diverged "
+                    "from model.generate() — sharing corrupted the KV")
+        stats = eng.prefix_stats()
+        if stats["hits"] == 0:
+            failures.append("no prefix-cache hits across shared-prefix "
+                            "requests — the cache never engaged")
+        if stats["cow_copies"] == 0:
+            failures.append("fully-cached prompt did not trigger "
+                            "copy-on-write")
+        if eng.kv_blocks_used != 0:
+            failures.append(
+                f"{eng.kv_blocks_used} KV block(s) still referenced "
+                "after the prefix-cache runs")
+        if churn_compiles:
+            failures.append(
+                f"{churn_compiles} compile(s) after warmup once prefix "
+                "caching + CoW engaged")
+        if not any("prefix" in f or "cached" in f for f in failures):
+            print(f"serving-smoke: prefix caching token-identical to "
+                  f"generate() (hit rate {stats['hit_rate']:.0%}, "
+                  f"{stats['cow_copies']} CoW cop"
+                  f"{'y' if stats['cow_copies'] == 1 else 'ies'}, "
+                  "0 compiles)")
     finally:
         obs.disable()
 

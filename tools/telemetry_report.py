@@ -84,11 +84,14 @@ def summarize(events):
         # injected-fault counts, plus resume/restart occurrences
         "retries": defaultdict(int), "faults": defaultdict(int),
         "resumes": [], "restarts": [],
-        # serving vocabulary (docs/SERVING.md): admission / step / finish
+        # serving vocabulary (docs/SERVING.md): admission / step / finish,
+        # plus the prefix-cache / ragged-step columns (prompt tokens
+        # skipped via cache hits, real span tokens per dispatch)
         "serving": {"requests": 0, "prompt_lens": [], "steps": 0,
                     "step_ms": [], "tokens": 0, "max_active": 0,
                     "max_queue": 0, "max_kv_blocks": 0,
-                    "finished": defaultdict(int), "req_ms": []},
+                    "finished": defaultdict(int), "req_ms": [],
+                    "cached_tokens": 0, "span_tokens": 0},
     }
     for e in events:
         kind = e.get("event")
@@ -127,10 +130,12 @@ def summarize(events):
             sv["requests"] += 1
             if e.get("prompt_len") is not None:
                 sv["prompt_lens"].append(e["prompt_len"])
+            sv["cached_tokens"] += e.get("cached_tokens") or 0
         elif kind == "serve_step":
             sv = agg["serving"]
             sv["steps"] += 1
             sv["tokens"] += e.get("tokens") or 0
+            sv["span_tokens"] += e.get("span_tokens") or 0
             if e.get("ms") is not None:
                 sv["step_ms"].append(e["ms"])
             sv["max_active"] = max(sv["max_active"], e.get("active") or 0)
@@ -226,7 +231,9 @@ def render(agg, malformed=0):
         fin = ", ".join(f"{n} {r}" for r, n in sorted(sv["finished"].items())) \
             or "—"
         pl = sorted(sv["prompt_lens"])
-        ttft = (metrics or {}).get("serve.ttft_ms") or {}
+        m = metrics or {}
+        ttft = m.get("serve.ttft_ms") or {}
+        occ = m.get("serve.ragged_occupancy") or {}
 
         def fmt(v, nd=2):
             return f"{v:.{nd}f}" if v is not None else "—"
@@ -242,8 +249,34 @@ def render(agg, malformed=0):
                   f"| ttft ms p50 / p95 | {fmt(ttft.get('p50'))} / "
                   f"{fmt(ttft.get('p95'))} |",
                   f"| peak active / queue / kv blocks | {sv['max_active']} "
-                  f"/ {sv['max_queue']} / {sv['max_kv_blocks']} |",
-                  ""]
+                  f"/ {sv['max_queue']} / {sv['max_kv_blocks']} |"]
+        # prefix-cache / ragged-step columns (docs/SERVING.md): page
+        # hit rate from the counters, prompt tokens the cache skipped
+        # from serve_request events, sharing + CoW from gauges/counters,
+        # dispatch occupancy from the step histogram
+        hits = m.get("serve.prefix_hits") or 0
+        misses = m.get("serve.prefix_misses") or 0
+        probes = hits + misses
+        prompt_toks = sum(pl)
+        if probes or sv["cached_tokens"]:
+            rate = f" ({hits / probes:.3f})" if probes else ""
+            lines.append(f"| prefix pages hit / missed | {hits} / "
+                         f"{misses}{rate} |")
+            cached_pct = (f" ({sv['cached_tokens'] / prompt_toks:.3f})"
+                          if prompt_toks else "")
+            lines.append(f"| prompt tokens from cache | "
+                         f"{sv['cached_tokens']} / {prompt_toks}"
+                         f"{cached_pct} |")
+            lines.append(f"| shared / cached blocks (last) | "
+                         f"{m.get('serve.shared_blocks', 0)} / "
+                         f"{m.get('serve.cached_blocks', 0)} |")
+            lines.append(f"| CoW copies | "
+                         f"{m.get('serve.cow_copies', 0)} |")
+        if occ or sv["span_tokens"]:
+            lines.append(f"| ragged occupancy p50 / p95 | "
+                         f"{fmt(occ.get('p50'))} / {fmt(occ.get('p95'))} "
+                         f"({sv['span_tokens']} span tokens) |")
+        lines.append("")
     for r in agg["resumes"]:
         lines.append(f"**RESUME**: step {r.get('step')} from "
                      f"`{r.get('ckpt')}` (restart {r.get('restarts')})")
@@ -332,6 +365,10 @@ def main(argv=None) -> int:
     sv = agg["serving"]
     if sv["requests"] or sv["steps"]:
         busy_s = sum(sv["step_ms"]) / 1e3
+        m = agg["metrics"] or {}
+        hits = m.get("serve.prefix_hits") or 0
+        misses = m.get("serve.prefix_misses") or 0
+        occ = m.get("serve.ragged_occupancy") or {}
         summary["serving"] = {
             "requests": sv["requests"],
             "finished": dict(sorted(sv["finished"].items())),
@@ -345,6 +382,17 @@ def main(argv=None) -> int:
             "peak_active": sv["max_active"],
             "peak_queue": sv["max_queue"],
             "peak_kv_blocks": sv["max_kv_blocks"],
+            "prefix_hits": hits,
+            "prefix_misses": misses,
+            "prefix_hit_rate": (round(hits / (hits + misses), 3)
+                                if hits + misses else None),
+            "cached_tokens": sv["cached_tokens"],
+            "cow_copies": m.get("serve.cow_copies") or 0,
+            "shared_blocks": m.get("serve.shared_blocks") or 0,
+            "cached_blocks": m.get("serve.cached_blocks") or 0,
+            "span_tokens": sv["span_tokens"],
+            "ragged_occupancy_p50": occ.get("p50"),
+            "ragged_occupancy_p95": occ.get("p95"),
         }
     if agg["bench_result"] is not None:
         summary["bench_value"] = agg["bench_result"].get("value")
