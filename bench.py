@@ -254,6 +254,32 @@ def main():
         except Exception as e:  # noqa: BLE001
             extra["serve_prefix_error"] = f"{type(e).__name__}: {e}"[:300]
 
+        # overload: offered load > capacity through the bounded front
+        # door (docs/SERVING.md "Front door") — goodput tok/s, shed
+        # rate, and TTFT p95 for the traffic that WAS admitted.  Same
+        # CPU-plumbing / TPU-numbers split and non-fatality as above.
+        try:
+            from decode_bench import bench_serve_burst
+            with contextlib.redirect_stdout(sys.stderr):
+                if on_tpu:
+                    r = bench_serve_burst(max_batch=8,
+                                          kv_cache_dtype="int8")
+                else:
+                    r = bench_serve_burst(preset="tiny", max_batch=2,
+                                          offered=8, max_queue_depth=3,
+                                          prompt_lens=(5, 11, 8),
+                                          max_new=6, page_size=8)
+            pre = "serve_burst" if on_tpu else "serve_burst_cpu"
+            extra[f"{pre}_goodput_tok_s"] = r["goodput_tok_s"]
+            extra[f"{pre}_shed_rate"] = r["shed_rate"]
+            extra[f"{pre}_ttft_p95_ms"] = r["admitted_ttft_p95_ms"]
+            extra[f"{pre}_detail"] = {
+                k: r[k] for k in ("offered", "admitted", "shed",
+                                  "max_queue_depth", "gen_tokens",
+                                  "wall_s", "admitted_ttft_p50_ms")}
+        except Exception as e:  # noqa: BLE001
+            extra["serve_burst_error"] = f"{type(e).__name__}: {e}"[:300]
+
     result = {
         "metric": "llama_train_mfu",
         "value": round(mfu, 4),

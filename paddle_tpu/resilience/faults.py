@@ -18,6 +18,7 @@ Spec grammar (code or the ``PDTPU_FAULTS`` env var)::
     spec    = entry ("," | ";") entry ...
     entry   = site "@" index ["x" times] [":" exc]
     site    = ckpt.save | ckpt.load | collective | step | store.get | store.set
+            | serve.admit | serve.prefill | serve.step | serve.cow | serve.swap
     index   = 0-based per-site call counter value at which firing starts
     times   = number of consecutive calls that fire (default 1)
     exc     = InjectedFault | RuntimeError | OSError | ConnectionError
@@ -43,9 +44,17 @@ __all__ = ["SITES", "InjectedFault", "FaultPlan", "FaultInjector",
            "install_faults_from_env", "active_injector"]
 
 #: the registered fault sites — a plan for any other name is a spec typo,
-#: rejected at parse/construction time rather than silently never firing
+#: rejected at parse/construction time rather than silently never firing.
+#: The serve.* sites cover the serving engine's host-side request
+#: lifecycle (docs/RESILIENCE.md "Serving sites"): admission, per-slot
+#: prefill/decode bookkeeping, copy-on-write, and KV page swap I/O —
+#: each confined by the engine to retire/re-admit of the ONE affected
+#: request (the compiled step and the other slots survive; the
+#: ``chaos-serving`` CI gate's contract).
 SITES = ("ckpt.save", "ckpt.load", "collective", "step",
-         "store.get", "store.set")
+         "store.get", "store.set",
+         "serve.admit", "serve.prefill", "serve.step", "serve.cow",
+         "serve.swap")
 
 
 class InjectedFault(RuntimeError):

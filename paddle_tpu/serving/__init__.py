@@ -9,6 +9,16 @@ serving step — chunked prefill spans and decode tokens in one ragged
 batch — compiles exactly once, and the ragged paged-attention Pallas
 kernel (``ops/pallas/ragged_attention.py``) doing the reads.
 
+Production front door (docs/SERVING.md "Front door"): ``FrontDoor``
+layers multi-tenant SLO admission on the Engine — per-tenant
+token-bucket rate limits and quotas, priority + deficit-round-robin
+fairness, telemetry-driven load shedding with typed retry-after
+answers, and KV-block preemption (``SwapManager`` pages victims to host
+RAM) instead of rejection; ``ServingServer`` is the stdlib streaming
+HTTP process over it, with graceful SIGTERM drain.  Admission failures
+are typed (``errors.AdmissionError`` and friends, all ``ValueError``
+subclasses).
+
 Usage::
 
     from paddle_tpu import serving
@@ -16,14 +26,27 @@ Usage::
     rid = eng.add_request(prompt_ids, max_new_tokens=64)
     for ev in eng.stream():
         ...  # ev.token_id as it decodes
+
+    door = serving.FrontDoor(eng, policies={
+        "paid": serving.TenantPolicy(priority=1),
+        "free": serving.TenantPolicy(rate_tokens_per_s=500)})
+    adm = door.submit(prompt_ids, tenant="free", max_new_tokens=64)
+    if not adm.admitted:
+        ...  # adm.reason, adm.retry_after_s — typed, not an exception
+    serving.ServingServer(door, port=8000).serve_forever()
 """
 
 from __future__ import annotations
 
 from .block_allocator import (BlockAllocator, PagedKVCache,  # noqa: F401
-                              PrefixCache)
+                              PrefixCache, SwapManager)
 from .engine import Engine, TokenEvent  # noqa: F401
+from .errors import (AdmissionError, BudgetUnsatisfiable,  # noqa: F401
+                     QueueFull, RateLimited)
+from .frontdoor import (Admission, FrontDoor, TenantPolicy,  # noqa: F401
+                        TokenBucket)
 from .scheduler import Request, RequestState, Scheduler  # noqa: F401
+from .server import ServingServer  # noqa: F401
 
 # public namespace hygiene: no foreign-module re-exports (tools/check_api_compat)
 from paddle_tpu._export import public_all as _public_all

@@ -42,9 +42,12 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
-__all__ = ["BlockAllocator", "PagedKVCache", "PrefixCache"]
+from ..resilience import _state as _rs_state
+
+__all__ = ["BlockAllocator", "PagedKVCache", "PrefixCache", "SwapManager"]
 
 
 class BlockAllocator:
@@ -286,3 +289,119 @@ class PagedKVCache:
         per_layer = sum(int(a.size) * a.dtype.itemsize
                         for a in self.caches[0])
         return per_layer * self.num_layers
+
+
+class SwapManager:
+    """Host-RAM swap space for preempted requests' KV pages.
+
+    The preemption half of the front door (docs/SERVING.md "Front
+    door"): instead of rejecting work when the pool is tight, the engine
+    picks a victim, ``swap_out``s the content of its allocated pages —
+    every layer's k/v rows, and for int8 pools the scale rows too — into
+    host numpy buffers, frees the blocks, and later ``swap_in``s the
+    bytes into freshly allocated blocks so the request resumes
+    token-identical.
+
+    Both directions run through ONE fixed-shape compiled program each (a
+    ``(chunk,)``-row gather and a donated scatter), padded with the OOB
+    sentinel: gather padding reads a clamped row the host copy discards,
+    scatter padding drops (jax OOB-scatter semantics).  Any page count
+    rides the same two executables — compiled once at
+    ``Engine.warmup()``, zero recompiles under preemption churn (the
+    ``chaos-serving`` gate's contract).
+
+    Refcount discipline: swap only COPIES content — shared prefix-cache
+    pages a victim borrowed are read, never mutated, so they are never
+    swapped out from under the other slots (or cache entries) still
+    referencing them; the victim merely drops its references and
+    re-materializes private copies at restore.
+    """
+
+    def __init__(self, kv: PagedKVCache, chunk: int = 8):
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        self.kv = kv
+        self.chunk = int(chunk)
+        self.pages_out = 0           # lifetime pages swapped to host
+        self.pages_in = 0            # lifetime pages restored
+
+        def gather(caches, ids):
+            return [tuple(c[ids] for c in layer) for layer in caches]
+
+        def scatter(caches, ids, payload):
+            return [tuple(c.at[ids].set(p) for c, p in zip(layer, pl))
+                    for layer, pl in zip(caches, payload)]
+
+        self._gather = jax.jit(gather)
+        # pools are donated, same as the engine's step/CoW programs: the
+        # engine owns exactly one copy in HBM
+        self._scatter = jax.jit(scatter, donate_argnums=(0,))
+
+    def warmup(self) -> "SwapManager":
+        """Compile both directions against all-OOB ids (gather rows are
+        discarded, scatter rows drop) so preemption traffic compiles
+        nothing."""
+        ids = jnp.asarray(np.full((self.chunk,), self.kv.oob_block,
+                                  np.int32))
+        out = self._gather(self.kv.caches, ids)
+        jax.block_until_ready(jax.tree_util.tree_leaves(out)[0])
+        payload = [tuple(jnp.zeros((self.chunk,) + tuple(c.shape[1:]),
+                                   c.dtype) for c in layer)
+                   for layer in self.kv.caches]
+        caches = self._scatter(self.kv.caches, ids, payload)
+        jax.block_until_ready(jax.tree_util.tree_leaves(caches)[0])
+        self.kv.caches = caches
+        return self
+
+    @staticmethod
+    def payload_nbytes(host) -> int:
+        return sum(int(a.nbytes) for layer in host for a in layer)
+
+    def swap_out(self, block_ids: Sequence[int]):
+        """Copy ``block_ids``'s rows from every layer's pools to host
+        numpy; returns the payload ``swap_in`` takes.  Read-only on
+        device state."""
+        fi = _rs_state.FAULTS[0]
+        if fi is not None:
+            fi("serve.swap")
+        n = len(block_ids)
+        host = [tuple(np.empty((n,) + tuple(c.shape[1:]),
+                               np.dtype(c.dtype)) for c in layer)
+                for layer in self.kv.caches]
+        for lo in range(0, n, self.chunk):
+            m = min(self.chunk, n - lo)
+            ids = np.full((self.chunk,), self.kv.oob_block, np.int32)
+            ids[:m] = np.asarray(block_ids[lo:lo + m], np.int32)
+            out = self._gather(self.kv.caches, jnp.asarray(ids))
+            for layer, hlayer in zip(out, host):
+                for arr, h in zip(layer, hlayer):
+                    h[lo:lo + m] = np.asarray(arr)[:m]
+        self.pages_out += n
+        return host
+
+    def swap_in(self, block_ids: Sequence[int], host) -> None:
+        """Scatter a ``swap_out`` payload into ``block_ids`` (freshly
+        allocated blocks) across every layer's pools."""
+        fi = _rs_state.FAULTS[0]
+        if fi is not None:
+            fi("serve.swap")
+        n = len(block_ids)
+        for lo in range(0, n, self.chunk):
+            m = min(self.chunk, n - lo)
+            ids = np.full((self.chunk,), self.kv.oob_block, np.int32)
+            ids[:m] = np.asarray(block_ids[lo:lo + m], np.int32)
+            payload = []
+            for hlayer in host:
+                rows = []
+                for h in hlayer:
+                    r = h[lo:lo + m]
+                    if m < self.chunk:     # pad: OOB rows drop anyway
+                        full = np.zeros((self.chunk,) + r.shape[1:],
+                                        r.dtype)
+                        full[:m] = r
+                        r = full
+                    rows.append(jnp.asarray(r))
+                payload.append(tuple(rows))
+            self.kv.caches = self._scatter(self.kv.caches,
+                                           jnp.asarray(ids), payload)
+        self.pages_in += n

@@ -37,13 +37,27 @@ Step anatomy (one :meth:`step` call):
    full-prompt pages stay indexed in the prefix cache (evictable LRU),
    everything else returns to the free list.
 
+Robustness (docs/SERVING.md "Front door", docs/RESILIENCE.md):
+:meth:`preempt` swaps a running request's KV pages to host RAM
+(``SwapManager``) instead of rejecting new work, and re-admission
+restores it token-identical; a host-side failure in one request's
+bookkeeping — or an injected fault at the ``serve.admit`` /
+``serve.prefill`` / ``serve.step`` / ``serve.cow`` / ``serve.swap``
+sites — is confined to THAT request (rewind → preempt → re-admit),
+never tearing down the compiled step or the other slots.  Admission
+rejections are typed (``serving.errors``).  ``serving.FrontDoor``
+layers multi-tenant SLO admission on top.
+
 Telemetry (all zero-overhead when observability is disabled):
 ``serve.ttft_ms``, ``serve.step_ms``, ``serve.tok_s``,
 ``serve.queue_depth``, ``serve.kv_blocks_used``, ``serve.active_requests``,
 ``serve.ragged_occupancy``, ``serve.prefix_hits``/``misses``,
-``serve.shared_blocks``, ``serve.cached_blocks``, ``serve.cow_copies``
-+ ``serve_request`` / ``serve_step`` / ``serve_finish`` events and a
-``serve.step`` flight-recorder span per step.
+``serve.shared_blocks``, ``serve.cached_blocks``, ``serve.cow_copies``,
+``serve.preemptions``/``restores``/``swapped_pages``/
+``isolated_failures``
++ ``serve_request`` / ``serve_step`` / ``serve_finish`` /
+``serve_preempt`` / ``serve_restore`` / ``serve_isolated_failure``
+events and a ``serve.step`` flight-recorder span per step.
 """
 
 from __future__ import annotations
@@ -62,7 +76,10 @@ import jax.numpy as jnp
 from .. import observability as obs
 from ..observability.spans import span
 from ..nn.layer import _swapped_params, functional_call, serving_params
-from .block_allocator import PagedKVCache, PrefixCache
+from ..resilience import _state as _rs_state
+from ..resilience.retry import RetryPolicy
+from .block_allocator import PagedKVCache, PrefixCache, SwapManager
+from .errors import AdmissionError, BudgetUnsatisfiable, QueueFull
 from .scheduler import Request, RequestState, Scheduler
 
 __all__ = ["Engine", "TokenEvent"]
@@ -142,6 +159,13 @@ class Engine:
     ``keep_finished``: how many finished requests stay queryable via
     :meth:`output_ids` after completion — older ones are evicted so a
     long-running engine's per-request state stays bounded.
+
+    ``max_queue``: bound on the waiting queue; beyond it
+    ``add_request`` raises :class:`serving.errors.QueueFull` (default
+    unbounded — the FrontDoor applies its own shed policy).
+    ``retry``: the :class:`resilience.RetryPolicy` wrapped around
+    host-side serving I/O (the preemption swap dispatches); defaults to
+    3 attempts with 20 ms base backoff.
     """
 
     def __init__(self, model, *, max_batch: int = 8,
@@ -152,7 +176,9 @@ class Engine:
                  prefill_token_budget: Optional[int] = None,
                  enable_prefix_caching: bool = True,
                  detokenize: Optional[Callable] = None, seed: int = 0,
-                 keep_finished: int = 1024):
+                 keep_finished: int = 1024,
+                 max_queue: Optional[int] = None,
+                 retry: Optional[RetryPolicy] = None):
         if not _paged_supported(model):
             raise NotImplementedError(
                 f"{type(model).__name__} does not support the paged "
@@ -197,6 +223,14 @@ class Engine:
                                    self.max_blocks_per_seq,
                                    self.kv.allocator, self.kv.oob_block,
                                    prefix_cache=self.prefix_cache)
+        # preemption/restore machinery: host-RAM page swap plus the
+        # retry policy wrapped around serving host I/O (swap dispatches)
+        # so a transient (or injected) fault becomes a logged retry, not
+        # a dead request
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self._retry = retry if retry is not None else \
+            RetryPolicy(max_attempts=3, backoff_s=0.02)
+        self._swap = SwapManager(self.kv, chunk=self.max_blocks_per_seq)
         self.params = serving_params(model)
         self._detokenize = detokenize
         self._key = jax.random.key(seed)
@@ -251,12 +285,14 @@ class Engine:
         self._cow_fn = jax.jit(cow_fn, donate_argnums=(0,))
 
     def warmup(self) -> "Engine":
-        """Compile the unified ragged step and the CoW helper up front.
+        """Compile the unified ragged step, the CoW helper, and the two
+        swap programs (preemption gather/scatter) up front.
 
         Uses all-out-of-range block tables and zero span lengths, so the
         warmup traffic's writes are dropped — no allocator interaction,
         no pool pollution.  After this, serving traffic compiles NOTHING
-        (the serving-smoke gate's contract)."""
+        — preemption, restore, and fault-isolation churn included (the
+        serving-smoke and chaos-serving gates' contract)."""
         with span("serve.warmup"):
             b, mb, c = self.max_batch, self.max_blocks_per_seq, \
                 self.prefill_chunk
@@ -275,6 +311,7 @@ class Engine:
                                   jnp.asarray(pad))
             jax.block_until_ready(jax.tree_util.tree_leaves(caches)[0])
             self.kv.caches = caches
+            self._swap.warmup()
         return self
 
     # -- request lifecycle -------------------------------------------------
@@ -283,32 +320,46 @@ class Engine:
                     temperature: float = 0.0,
                     eos_token_id: Optional[int] = None,
                     on_token: Optional[Callable] = None,
-                    request_id: Optional[str] = None) -> str:
+                    request_id: Optional[str] = None,
+                    tenant: Optional[str] = None) -> str:
         """Queue one request; returns its id.  The request joins the
         running batch at the next ``step()`` with a free slot and enough
         free blocks for its budget (prompt + max_new_tokens, minus any
-        prefix-cache hit)."""
+        prefix-cache hit).
+
+        Rejections are typed (``serving.errors``, all ``ValueError``
+        subclasses): :class:`QueueFull` when ``max_queue`` is set and
+        the waiting queue is at capacity (transient — retry later),
+        :class:`BudgetUnsatisfiable` when the request can never fit this
+        engine's geometry, plain :class:`AdmissionError` for a duplicate
+        ``request_id``."""
         req = Request(prompt_ids=prompt_ids,
                       max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature),
                       eos_token_id=eos_token_id, on_token=on_token,
-                      request_id=request_id)
+                      request_id=request_id, tenant=tenant)
         if req.request_id in self._states:
             # a silent overwrite would orphan the first request's slot /
             # blocks bookkeeping and lose its output
-            raise ValueError(
+            raise AdmissionError(
                 f"request_id {req.request_id!r} is already in use by a "
                 "live or retained request")
+        if self.max_queue is not None \
+                and self.scheduler.queue_depth() >= self.max_queue:
+            raise QueueFull(
+                f"waiting queue is at max_queue={self.max_queue} — "
+                "retry later (or put a serving.FrontDoor in front for "
+                "retry-after answers instead of exceptions)")
         p = int(req.prompt_ids.size)
         if p + req.max_new_tokens > self.max_seq_len:
-            raise ValueError(
+            raise BudgetUnsatisfiable(
                 f"prompt ({p}) + max_new_tokens ({req.max_new_tokens}) "
                 f"exceeds max_seq_len={self.max_seq_len}")
         need = self.scheduler.blocks_for(p + req.max_new_tokens)
         if need > self.kv.num_blocks:
             # an unsatisfiable reservation would sit at the queue head
             # forever and make run()/stream() spin — reject it up front
-            raise ValueError(
+            raise BudgetUnsatisfiable(
                 f"request needs {need} KV blocks (prompt {p} + "
                 f"max_new_tokens {req.max_new_tokens} @ page "
                 f"{self.page_size}) but the pool has only "
@@ -342,22 +393,144 @@ class Engine:
         s["cow_copies"] = self._cow_copies
         return s
 
+    # -- preemption / restore / fault isolation ----------------------------
+
+    def preempt(self, request_id: str, *, requeue_head: bool = False,
+                reason: str = "preempted") -> bool:
+        """Swap a RUNNING request's KV pages to host RAM, free its
+        blocks and slot, and requeue it for transparent restoration —
+        the front door's alternative to rejecting new work when the
+        pool is tight (docs/SERVING.md "Front door").
+
+        Returns False when the request is not currently in a slot
+        (waiting, already preempted, finished, or unknown).  The
+        restored request resumes token-identical under greedy decoding:
+        the swap round-trips the exact page bytes (int8 scales
+        included), and shared prefix pages are only COPIED — never
+        pulled out from under the other slots referencing them."""
+        st = self._states.get(request_id)
+        if st is None or st.finished or st.slot is None:
+            return False
+        self._preempt_state(st, head=requeue_head, reason=reason)
+        return True
+
+    def _preempt_state(self, st: RequestState, head: bool,
+                       reason: str) -> None:
+        pages = -(-st.kv_len // self.page_size)
+        host = None
+        if pages:
+            ids = [int(b) for b in st.table[:pages]]
+            host = self._retry.run(self._swap.swap_out, ids,
+                                   site="serve.swap")
+        self.scheduler.release_slot(st)
+        # everything comes back private at restore: for the shared-pages
+        # gauge the borrowed pages count as privatized from here on
+        st.num_cowed = st.num_shared
+        st.swapped = (pages, host)
+        st.preempts += 1
+        self.scheduler.requeue(st, head=head)
+        reg = obs.get_registry()
+        if reg is not None:
+            reg.counter("serve.preemptions").inc()
+            if pages:
+                reg.counter("serve.swapped_pages").inc(pages)
+        obs.emit_event("serve_preempt", id=st.request.request_id,
+                       tenant=st.request.tenant, pages=pages,
+                       kv_len=st.kv_len, reason=reason,
+                       preempts=st.preempts)
+
+    def _restore(self, st: RequestState) -> None:
+        """Scatter a freshly re-admitted request's host payload into its
+        new (all-private) blocks; prefill/decode resumes at kv_len."""
+        pages, host = st.swapped
+        if pages:
+            ids = [int(b) for b in st.table[:pages]]
+            self._retry.run(self._swap.swap_in, ids, host,
+                            site="serve.swap")
+        st.swapped = None
+        reg = obs.get_registry()
+        if reg is not None:
+            reg.counter("serve.restores").inc()
+        obs.emit_event("serve_restore", id=st.request.request_id,
+                       tenant=st.request.tenant, pages=pages,
+                       kv_len=st.kv_len)
+
+    def _isolate(self, st: RequestState, exc: Exception) -> None:
+        """Confine a failing request to ITS slot: the compiled step and
+        the batch's other requests survive; the victim is preempted to
+        host and transparently re-admitted (queue head — it was
+        mid-flight).  Greedy outputs stay token-identical because the
+        caller rewound the host bookkeeping to the pre-span snapshot
+        and re-running a span is idempotent (same values, same
+        positions)."""
+        rid = st.request.request_id
+        warnings.warn(
+            f"request {rid!r} failed host-side and was isolated "
+            f"(preempt + re-admit; {type(exc).__name__}: {exc})",
+            RuntimeWarning, stacklevel=3)
+        reg = obs.get_registry()
+        if reg is not None:
+            reg.counter("serve.isolated_failures").inc()
+        obs.emit_event("serve_isolated_failure", id=rid,
+                       tenant=st.request.tenant,
+                       exc=type(exc).__name__, message=str(exc)[:200])
+        self._preempt_state(st, head=True, reason="isolated_failure")
+
+    def _admit_all(self) -> None:
+        """Admission loop with the ``serve.admit`` fault site: an
+        injected/host fault here leaves the queue intact (nothing has
+        been allocated yet) and admission simply resumes next step."""
+        fi = _rs_state.FAULTS[0]
+        while self.scheduler.waiting:
+            if fi is not None:
+                try:
+                    fi("serve.admit")
+                except Exception as e:  # noqa: BLE001
+                    reg = obs.get_registry()
+                    if reg is not None:
+                        reg.counter("serve.isolated_failures").inc()
+                    obs.emit_event(
+                        "serve_isolated_failure", id=None, tenant=None,
+                        exc=type(e).__name__, message=str(e)[:200],
+                        site="serve.admit")
+                    break
+            st = self.scheduler.admit_next()
+            if st is None:
+                break
+            if st.swapped is not None:
+                self._restore(st)
+
     # -- the loop ----------------------------------------------------------
 
-    def _run_cow(self, plan) -> None:
+    def _run_cow(self, plan):
         """Copy-on-write: any span about to write into a borrowed
         (shared) page gets a private copy first — the reserved spare
         block takes the page's content via one fixed-shape device copy,
-        the table is repointed, and the shared reference is dropped."""
+        the table is repointed, and the shared reference is dropped.
+        Returns the plan minus any request isolated by a ``serve.cow``
+        fault (fired BEFORE that request's tables are touched, so
+        isolation sees consistent state)."""
+        fi = _rs_state.FAULTS[0]
         copies = []
+        dropped = []
         for i, st, n, is_prefill in plan:
             if not st.borrowed:
                 continue
             first = st.kv_len // self.page_size
             last = (st.kv_len + n - 1) // self.page_size
-            for pg in range(first, last + 1):
-                if pg not in st.borrowed:
+            pgs = [pg for pg in range(first, last + 1) if pg in st.borrowed]
+            if not pgs:
+                continue
+            if fi is not None:
+                try:
+                    fi("serve.cow")
+                except Exception as e:  # noqa: BLE001
+                    # nothing mutated for this request yet this step:
+                    # plain isolation, and its span leaves the plan
+                    self._isolate(st, e)
+                    dropped.append(i)
                     continue
+            for pg in pgs:
                 src = int(st.table[pg])
                 dst = st.cow_spare.pop(pg)
                 st.table[pg] = dst
@@ -366,8 +539,10 @@ class Engine:
                 st.blocks.remove(src)
                 self.kv.allocator.free([src])   # drop OUR shared ref
                 copies.append((src, dst))
+        if dropped:
+            plan = [it for it in plan if it[0] not in dropped]
         if not copies:
-            return
+            return plan
         k = self.max_batch
         for lo in range(0, len(copies), k):
             batch = copies[lo:lo + k]
@@ -382,6 +557,7 @@ class Engine:
         reg = obs.get_registry()
         if reg is not None:
             reg.counter("serve.cow_copies").inc(len(copies))
+        return plan
 
     def _register_prefix(self, st: RequestState) -> None:
         """Index this request's freshly-written full prompt pages so
@@ -452,17 +628,25 @@ class Engine:
     def step(self) -> List[TokenEvent]:
         """Admit what fits, run ONE unified ragged step (prefill chunks
         + decode tokens together), retire what finished.  Returns the
-        tokens emitted (one per decoded / prompt-completed request)."""
+        tokens emitted (one per decoded / prompt-completed request).
+
+        Per-request fault isolation (docs/RESILIENCE.md "Serving
+        sites"): a host-side failure in one request's bookkeeping —
+        admission, CoW, prefill/decode post-processing, or an injected
+        ``serve.*`` fault — never tears down the compiled step or the
+        other slots.  The victim is rewound to its pre-span snapshot,
+        preempted to host RAM, and transparently re-admitted; everyone
+        else's events are delivered normally."""
         t0 = time.perf_counter()
         events: List[TokenEvent] = []
         with span("serve.step", emit=False):
-            while self.scheduler.admit_next() is not None:
-                pass
+            self._admit_all()
             plan = self.scheduler.plan_spans(self.prefill_chunk,
                                              self.prefill_token_budget)
+            if plan:
+                plan = self._run_cow(plan)
             live_tokens = sum(n for _, _, n, _ in plan)
             if plan:
-                self._run_cow(plan)
                 tokens, tables, starts, lens, temps = \
                     self.scheduler.span_arrays(plan, self.prefill_chunk)
                 # device_put of ready numpy arrays only: jnp.asarray of
@@ -480,33 +664,51 @@ class Engine:
                 # so the TTFT clock below must stop AFTER the first
                 # token materializes, or it reports queueing overhead
                 nxt = np.asarray(nxt)
+                fi = _rs_state.FAULTS[0]
                 for i, st, n, is_prefill in plan:
-                    st.kv_len += n
-                    if is_prefill and st.prefilling:
-                        continue        # mid-prefill: sample discarded
-                    if is_prefill:
-                        # prompt complete: this sample is the request's
-                        # first token — TTFT stops here
-                        self._register_prefix(st)
-                        st.first_token_t = time.perf_counter()
-                        req = st.request
-                        reg = obs.get_registry()
-                        if reg is not None:
-                            reg.histogram("serve.ttft_ms").observe(
-                                (st.first_token_t - st.submit_t) * 1e3)
-                            if st.num_shared:
-                                reg.counter("serve.prefix_hits").inc(
-                                    st.num_shared)
-                            misses = len(st.page_keys) - st.num_shared
-                            if misses:
-                                reg.counter("serve.prefix_misses").inc(
-                                    misses)
-                        obs.emit_event(
-                            "serve_request", id=req.request_id,
-                            prompt_len=int(req.prompt_ids.size),
-                            slot=st.slot, blocks=len(st.blocks),
-                            cached_tokens=st.cached_tokens)
-                    self._emit(st, int(nxt[i]), events)
+                    # pre-span snapshot: isolation rewinds to here, and
+                    # re-running the span after restore is idempotent
+                    # (the dispatch above already wrote this span's KV;
+                    # the rewound re-run rewrites identical bytes)
+                    snap = (st.kv_len, st.pending_token,
+                            len(st.output_ids), st.text_len,
+                            st.detok_offset)
+                    try:
+                        if fi is not None:
+                            fi("serve.prefill" if is_prefill
+                               else "serve.step")
+                        st.kv_len += n
+                        if is_prefill and st.prefilling:
+                            continue    # mid-prefill: sample discarded
+                        if is_prefill:
+                            # prompt complete: this sample is the
+                            # request's first token — TTFT stops here
+                            self._register_prefix(st)
+                            st.first_token_t = time.perf_counter()
+                            req = st.request
+                            reg = obs.get_registry()
+                            if reg is not None:
+                                reg.histogram("serve.ttft_ms").observe(
+                                    (st.first_token_t - st.submit_t) * 1e3)
+                                if st.num_shared:
+                                    reg.counter("serve.prefix_hits").inc(
+                                        st.num_shared)
+                                misses = len(st.page_keys) - st.num_shared
+                                if misses:
+                                    reg.counter(
+                                        "serve.prefix_misses").inc(misses)
+                            obs.emit_event(
+                                "serve_request", id=req.request_id,
+                                tenant=req.tenant,
+                                prompt_len=int(req.prompt_ids.size),
+                                slot=st.slot, blocks=len(st.blocks),
+                                cached_tokens=st.cached_tokens)
+                        self._emit(st, int(nxt[i]), events)
+                    except Exception as e:  # noqa: BLE001
+                        st.kv_len, st.pending_token = snap[0], snap[1]
+                        del st.output_ids[snap[2]:]
+                        st.text_len, st.detok_offset = snap[3], snap[4]
+                        self._isolate(st, e)
         n_tok = len(events)
         dt = time.perf_counter() - t0
         reg = obs.get_registry()
@@ -546,6 +748,23 @@ class Engine:
             for ev in self.step():
                 yield ev
 
+    def _begin_drain(self) -> Dict[str, List[int]]:
+        """Start a drain capture (shared by :meth:`run` and
+        ``FrontDoor.run``): collect requests already finished since the
+        last drain, and arm finish-time capture so eviction under
+        ``keep_finished`` can't outrun the drain dict.  Pair with
+        :meth:`_end_drain` in a finally."""
+        drained: Dict[str, List[int]] = {}
+        for rid, st in self._states.items():
+            if st.finished and not st.drained:
+                st.drained = True
+                drained[rid] = list(st.output_ids)
+        self._drain_capture = drained
+        return drained
+
+    def _end_drain(self) -> None:
+        self._drain_capture = None
+
     def run(self) -> Dict[str, List[int]]:
         """Drain everything; returns {request_id: generated token ids}
         for every request finished since the last ``run()`` — including
@@ -553,15 +772,10 @@ class Engine:
         calls before this one (staggered admission).  Outputs are
         captured at finish time, so the dict is complete even when more
         than ``keep_finished`` requests retire in one drain."""
-        drained: Dict[str, List[int]] = {}
-        for rid, st in self._states.items():
-            if st.finished and not st.drained:
-                st.drained = True
-                drained[rid] = list(st.output_ids)
-        self._drain_capture = drained
+        drained = self._begin_drain()
         try:
             while self.has_work():
                 self.step()
         finally:
-            self._drain_capture = None
+            self._end_drain()
         return drained

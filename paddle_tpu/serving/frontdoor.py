@@ -1,0 +1,510 @@
+"""Multi-tenant SLO front door for the serving engine.
+
+The Engine (engine.py) is a lab-grade batcher: FIFO admission, hard
+typed rejection, no notion of who a request belongs to.  ``FrontDoor``
+is what a fleet puts in front of it (ROADMAP item 4 — docs/SERVING.md
+"Front door"):
+
+- **Per-tenant policy** (:class:`TenantPolicy`): token-bucket rate
+  limits (cost = prompt + max_new tokens), a live-request quota, a
+  strict priority tier, and a deficit-round-robin weight within the
+  tier.
+- **Load shedding with typed answers**: a shed request gets an
+  :class:`Admission` carrying the reason and a ``retry_after_s``
+  estimate — not an exception (an overloaded server answering
+  thousands of sheds per second should not pay exception unwinding per
+  shed; ``submit(raise_on_shed=True)`` opts into the
+  ``serving.errors`` hierarchy instead).  Shedding decisions are driven
+  by the live ``serve.*`` telemetry when observability is enabled —
+  queue depth, TTFT p95 (``serve.ttft_ms``), KV block occupancy — and
+  by the same engine-local signals when it is not.
+- **Fairness**: strict priority across tiers (a starving high-priority
+  tenant always goes first), weighted deficit round-robin within a tier
+  (two equal-priority floods split admissions by their weights instead
+  of by arrival order).
+- **KV preemption instead of rejection**: when a higher-priority
+  request is block-starved at the engine's queue head, the door picks a
+  victim (lowest priority, then youngest) and ``Engine.preempt``s it —
+  the victim's pages swap to host RAM and it transparently re-admits
+  later, token-identical (block_allocator.SwapManager).
+
+Every decision is deterministic given the submission sequence and the
+injected ``clock`` — the chaos-serving CI gate and the fairness tests
+rely on that.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Callable, Dict, List, NamedTuple, Optional, Set
+
+from .. import observability as obs
+from .errors import (AdmissionError, BudgetUnsatisfiable, QueueFull,
+                     RateLimited)
+from .scheduler import Request, RequestState
+
+__all__ = ["Admission", "FrontDoor", "TenantPolicy", "TokenBucket"]
+
+
+@dataclasses.dataclass
+class TenantPolicy:
+    """One tenant's admission contract.
+
+    ``priority``: strict tier — all queued work of a higher tier is
+    admitted before any lower tier, and under an SLO breach only
+    tenants at or above the door's ``slo_priority_floor`` are admitted.
+    ``weight``: deficit-round-robin share *within* a tier.
+    ``rate_tokens_per_s`` / ``burst_tokens``: token-bucket rate limit
+    over the request token cost (prompt + max_new_tokens); None = no
+    limit.  ``max_live_requests``: cap on this tenant's queued + active
+    requests; None = no quota."""
+
+    priority: int = 0
+    weight: float = 1.0
+    rate_tokens_per_s: Optional[float] = None
+    burst_tokens: Optional[float] = None
+    max_live_requests: Optional[int] = None
+
+
+class TokenBucket:
+    """Deterministic token bucket (``clock`` injectable for tests)."""
+
+    __slots__ = ("rate", "capacity", "level", "clock", "_t")
+
+    def __init__(self, rate: float, capacity: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rate = float(rate)
+        self.capacity = float(capacity)
+        self.level = float(capacity)
+        self.clock = clock
+        self._t = clock()
+
+    def _refill(self) -> None:
+        now = self.clock()
+        if now > self._t:
+            self.level = min(self.capacity,
+                             self.level + (now - self._t) * self.rate)
+        self._t = now
+
+    def try_take(self, cost: float) -> float:
+        """0.0 on success (cost deducted), else seconds until ``cost``
+        becomes affordable — inf for a zero-rate bucket OR a cost
+        beyond ``capacity`` (the level can never exceed capacity, so a
+        finite hint would send the client into an endless retry loop)."""
+        self._refill()
+        if cost <= self.level + 1e-9:
+            self.level -= cost
+            return 0.0
+        if self.rate <= 0 or cost > self.capacity + 1e-9:
+            return float("inf")
+        return (cost - self.level) / self.rate
+
+
+class Admission(NamedTuple):
+    """The typed answer to :meth:`FrontDoor.submit` — admitted or shed,
+    never an exception (unless ``raise_on_shed``)."""
+
+    admitted: bool
+    request_id: Optional[str]
+    reason: Optional[str]        # None | "rate_limited" | "quota" |
+    #                              "queue_full" | "slo_shed" | "budget"
+    retry_after_s: Optional[float]
+
+
+class _Pending(NamedTuple):
+    request: Request
+    tenant: str
+    cost: int                    # prompt + max_new tokens
+    submit_t: float              # perf_counter at door submit: TTFT
+    #                              must include time queued in the door
+
+
+class FrontDoor:
+    """SLO-aware multi-tenant admission in front of a warmed
+    :class:`~paddle_tpu.serving.Engine`.
+
+    ``policies`` maps tenant name → :class:`TenantPolicy`; unknown
+    tenants get ``default_policy``.  ``max_queue_depth`` bounds the
+    TOTAL queued work (door queues + engine staging); beyond it
+    submissions shed with ``reason="queue_full"``.  ``slo_ttft_p95_ms``
+    / ``slo_occupancy`` arm telemetry-driven backpressure: when the
+    rolling TTFT p95 or the KV-pool occupancy crosses its threshold,
+    tenants below ``slo_priority_floor`` shed with
+    ``reason="slo_shed"`` until the signal recovers.
+    ``enable_preemption`` lets the door preempt lower-priority running
+    requests when a higher-priority admission is block-starved.
+
+    The door feeds the engine's FIFO staging queue at most
+    ``engine.max_batch`` deep, so ordering decisions stay here — the
+    engine only ever sees work the door already sequenced.
+    """
+
+    def __init__(self, engine, *,
+                 policies: Optional[Dict[str, TenantPolicy]] = None,
+                 default_policy: Optional[TenantPolicy] = None,
+                 max_queue_depth: int = 64,
+                 slo_ttft_p95_ms: Optional[float] = None,
+                 slo_occupancy: Optional[float] = None,
+                 slo_priority_floor: int = 1,
+                 drr_quantum: int = 32,
+                 enable_preemption: bool = True,
+                 retry_after_floor_s: float = 0.05,
+                 clock: Callable[[], float] = time.monotonic):
+        if max_queue_depth < 1:
+            raise ValueError(
+                f"max_queue_depth must be >= 1, got {max_queue_depth}")
+        self.engine = engine
+        self.policies = dict(policies or {})
+        self.default_policy = default_policy or TenantPolicy()
+        self.max_queue_depth = int(max_queue_depth)
+        self.slo_ttft_p95_ms = slo_ttft_p95_ms
+        self.slo_occupancy = slo_occupancy
+        self.slo_priority_floor = int(slo_priority_floor)
+        self.drr_quantum = int(drr_quantum)
+        self.enable_preemption = bool(enable_preemption)
+        self.retry_after_floor_s = float(retry_after_floor_s)
+        self.clock = clock
+        self._queues: Dict[str, "collections.deque[_Pending]"] = {}
+        self._buckets: Dict[str, TokenBucket] = {}
+        self._outstanding: Dict[str, Set[str]] = {}
+        self._deficit: Dict[str, float] = {}
+        self._rr: Dict[int, int] = {}
+        self.sheds = 0               # lifetime shed count (all reasons)
+
+    # -- policy plumbing ---------------------------------------------------
+
+    def policy(self, tenant: Optional[str]) -> TenantPolicy:
+        if tenant is None:
+            return self.default_policy
+        return self.policies.get(tenant, self.default_policy)
+
+    def _bucket(self, tenant: str,
+                pol: TenantPolicy) -> Optional[TokenBucket]:
+        if pol.rate_tokens_per_s is None:
+            return None
+        b = self._buckets.get(tenant)
+        if b is None:
+            cap = pol.burst_tokens if pol.burst_tokens is not None \
+                else 4.0 * pol.rate_tokens_per_s
+            b = self._buckets[tenant] = TokenBucket(
+                pol.rate_tokens_per_s, cap, clock=self.clock)
+        return b
+
+    # -- live signals (serve.* telemetry when on, engine-local when off) ---
+
+    def queue_depth(self) -> int:
+        """Door queues + the engine's staging queue."""
+        return sum(len(q) for q in self._queues.values()) \
+            + self.engine.scheduler.queue_depth()
+
+    def _total_queued(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def _ttft_p95(self) -> Optional[float]:
+        reg = obs.get_registry()
+        if reg is None:
+            return None
+        h = reg.get("serve.ttft_ms")
+        return h.percentile(95) if h is not None else None
+
+    def _occupancy(self) -> float:
+        alloc = self.engine.kv.allocator
+        return alloc.used_blocks / max(self.engine.kv.num_blocks, 1)
+
+    def _retry_after(self) -> float:
+        """Load-proportional retry hint: pending token cost over the
+        live aggregate tok/s when telemetry has one, else a queue-depth
+        multiple of the floor.  Deterministic given the signals."""
+        rate = None
+        reg = obs.get_registry()
+        if reg is not None:
+            g = reg.get("serve.tok_s")
+            rate = g.value if g is not None else None
+        if rate:
+            pending = sum(p.cost for q in self._queues.values() for p in q)
+            est = pending / max(float(rate), 1e-6)
+        else:
+            est = self.retry_after_floor_s * (1 + self.queue_depth())
+        return round(max(self.retry_after_floor_s, est), 4)
+
+    # -- admission ---------------------------------------------------------
+
+    def _shed(self, tenant: str, reason: str,
+              retry_after_s: Optional[float], raise_on_shed: bool,
+              message: str) -> Admission:
+        self.sheds += 1
+        reg = obs.get_registry()
+        if reg is not None:
+            reg.counter("serve.shed").inc()
+            reg.counter(f"serve.shed[{reason}].count").inc()
+        obs.emit_event("serve_shed", tenant=tenant, reason=reason,
+                       retry_after_s=retry_after_s)
+        if raise_on_shed:
+            if reason == "budget":
+                raise BudgetUnsatisfiable(message)
+            if reason in ("rate_limited", "quota"):
+                raise RateLimited(message, retry_after_s or
+                                  self.retry_after_floor_s)
+            raise QueueFull(message, retry_after_s)
+        return Admission(False, None, reason, retry_after_s)
+
+    def submit(self, prompt_ids, *, tenant: str = "default",
+               max_new_tokens: int = 16, temperature: float = 0.0,
+               eos_token_id: Optional[int] = None,
+               on_token: Optional[Callable] = None,
+               request_id: Optional[str] = None,
+               raise_on_shed: bool = False) -> Admission:
+        """Admit or shed one request; always returns an
+        :class:`Admission` (malformed requests — empty prompt, bad
+        max_new_tokens, duplicate id — still raise, they are caller
+        bugs, not load)."""
+        pol = self.policy(tenant)
+        req = Request(prompt_ids=prompt_ids,
+                      max_new_tokens=int(max_new_tokens),
+                      temperature=float(temperature),
+                      eos_token_id=eos_token_id, on_token=on_token,
+                      request_id=request_id, tenant=tenant)
+        eng = self.engine
+        p = int(req.prompt_ids.size)
+        cost = p + req.max_new_tokens
+        if req.request_id in eng._states or any(
+                pnd.request.request_id == req.request_id
+                for q in self._queues.values() for pnd in q):
+            raise AdmissionError(
+                f"request_id {req.request_id!r} is already in use")
+        if cost > eng.max_seq_len or \
+                eng.scheduler.blocks_for(cost) > eng.kv.num_blocks:
+            return self._shed(
+                tenant, "budget", None, raise_on_shed,
+                f"prompt {p} + max_new {req.max_new_tokens} can never "
+                f"fit this engine (max_seq_len={eng.max_seq_len}, "
+                f"{eng.kv.num_blocks} KV blocks)")
+        if pol.max_live_requests is not None and \
+                self._live_count(tenant) >= pol.max_live_requests:
+            return self._shed(
+                tenant, "quota", self._retry_after(), raise_on_shed,
+                f"tenant {tenant!r} is at its live-request quota "
+                f"({pol.max_live_requests})")
+        if self.queue_depth() >= self.max_queue_depth:
+            return self._shed(
+                tenant, "queue_full", self._retry_after(), raise_on_shed,
+                f"queue at max_queue_depth={self.max_queue_depth}")
+        if pol.priority < self.slo_priority_floor:
+            ttft = self._ttft_p95() if self.slo_ttft_p95_ms is not None \
+                else None
+            if ttft is not None and ttft > self.slo_ttft_p95_ms:
+                return self._shed(
+                    tenant, "slo_shed", self._retry_after(),
+                    raise_on_shed,
+                    f"TTFT p95 {ttft:.1f}ms over SLO "
+                    f"{self.slo_ttft_p95_ms}ms; shedding below "
+                    f"priority {self.slo_priority_floor}")
+            if self.slo_occupancy is not None \
+                    and self._occupancy() >= self.slo_occupancy:
+                return self._shed(
+                    tenant, "slo_shed", self._retry_after(),
+                    raise_on_shed,
+                    f"KV occupancy {self._occupancy():.2f} over "
+                    f"{self.slo_occupancy}; shedding below priority "
+                    f"{self.slo_priority_floor}")
+        # the token bucket is the LAST gate, so a request shed for any
+        # other reason is never charged tokens it got nothing for (a
+        # queue_full burst must not morph into a rate_limited lockout)
+        bucket = self._bucket(tenant, pol)
+        if bucket is not None:
+            wait = bucket.try_take(cost)
+            if wait == float("inf"):
+                # beyond burst capacity: no amount of waiting helps
+                return self._shed(
+                    tenant, "budget", None, raise_on_shed,
+                    f"request cost {cost} tokens exceeds tenant "
+                    f"{tenant!r}'s burst capacity {bucket.capacity}")
+            if wait > 0:
+                wait = round(max(wait, self.retry_after_floor_s), 4)
+                return self._shed(
+                    tenant, "rate_limited", wait, raise_on_shed,
+                    f"tenant {tenant!r} over its token rate "
+                    f"({pol.rate_tokens_per_s}/s); retry in {wait}s")
+        self._queues.setdefault(
+            tenant, collections.deque()).append(
+                _Pending(req, tenant, cost, time.perf_counter()))
+        self._outstanding.setdefault(tenant, set()).add(req.request_id)
+        reg = obs.get_registry()
+        if reg is not None:
+            reg.counter(f"serve.tenant[{tenant}].requests").inc()
+            reg.gauge("serve.frontdoor_depth").set(self._total_queued())
+        self.pump()
+        return Admission(True, req.request_id, None, None)
+
+    def _live_count(self, tenant: str) -> int:
+        self._gc_outstanding()
+        return len(self._outstanding.get(tenant, ()))
+
+    def _gc_outstanding(self) -> None:
+        eng = self.engine
+        queued = {p.request.request_id
+                  for q in self._queues.values() for p in q}
+        for rids in self._outstanding.values():
+            dead = [r for r in rids if r not in queued
+                    and (eng._states.get(r) is None
+                         or eng._states[r].finished)]
+            for r in dead:
+                rids.discard(r)
+
+    # -- scheduling: strict priority tiers + weighted DRR ------------------
+
+    def _engine_room(self) -> bool:
+        return len(self.engine.scheduler.waiting) < self.engine.max_batch
+
+    def _next_pending(self) -> Optional[_Pending]:
+        nonempty = [t for t, q in self._queues.items() if q]
+        if not nonempty:
+            return None
+        tier = max(self.policy(t).priority for t in nonempty)
+        tenants = sorted(t for t in nonempty
+                         if self.policy(t).priority == tier)
+        rr = self._rr.get(tier, 0)
+        n = len(tenants)
+        # each visit grants quantum*weight deficit; the head admits once
+        # its tenant's deficit covers its token cost, so admissions
+        # interleave by weight.  Bound: a head costs <= max_seq_len, so
+        # within ~cost/quantum visits per tenant someone can pay.
+        max_hops = n * (2 + int(self.engine.max_seq_len
+                                / max(self.drr_quantum, 1)))
+        for hop in range(max_hops):
+            t = tenants[(rr + hop) % n]
+            q = self._queues[t]
+            if not q:
+                continue
+            pol = self.policy(t)
+            self._deficit[t] = self._deficit.get(t, 0.0) \
+                + self.drr_quantum * max(pol.weight, 1e-6)
+            head = q[0]
+            if self._deficit[t] + 1e-9 >= head.cost:
+                self._deficit[t] -= head.cost
+                q.popleft()
+                self._rr[tier] = (rr + hop + 1) % n
+                if not q:
+                    self._deficit[t] = 0.0   # no banking while idle
+                return head
+        # unreachable with drr_quantum >= 1 (max_hops covers the largest
+        # possible head cost), but never wedge: serve the tier FIFO
+        for t in tenants:
+            if self._queues[t]:
+                return self._queues[t].popleft()
+        return None
+
+    def pump(self) -> int:
+        """Feed sequenced work into the engine's staging queue and run
+        the preemption policy; returns the number admitted.  Called by
+        :meth:`submit` and :meth:`step` — idempotent and cheap when
+        there is nothing to do."""
+        self._gc_outstanding()
+        admitted = 0
+        while self._total_queued() and self._engine_room():
+            pnd = self._next_pending()
+            if pnd is None:
+                break
+            req = pnd.request
+            try:
+                self.engine.add_request(
+                    req.prompt_ids, max_new_tokens=req.max_new_tokens,
+                    temperature=req.temperature,
+                    eos_token_id=req.eos_token_id, on_token=req.on_token,
+                    request_id=req.request_id, tenant=pnd.tenant)
+            except QueueFull:
+                # transient: the engine's own max_queue bound tripped —
+                # the request stays OURS (front of its tenant queue) and
+                # feeds once the staging drains; it was already answered
+                # admitted=True, so it must not be shed as permanent
+                self._queues[pnd.tenant].appendleft(pnd)
+                break
+            except AdmissionError as e:
+                # an already-vetted request the engine still refused
+                # (e.g. an id raced into the retained set): shed it
+                # instead of wedging the tenant queue behind it
+                self._outstanding.get(pnd.tenant, set()).discard(
+                    req.request_id)
+                self._shed(pnd.tenant, "budget", None, False, str(e))
+                continue
+            # TTFT starts at DOOR submission: time queued here is load
+            # the serve.ttft_ms signal (and the SLO shed driven by it)
+            # must see
+            st = self.engine._states.get(req.request_id)
+            if st is not None:
+                st.submit_t = pnd.submit_t
+            admitted += 1
+        if self.enable_preemption:
+            self._maybe_preempt()
+        reg = obs.get_registry()
+        if reg is not None:
+            reg.gauge("serve.frontdoor_depth").set(self._total_queued())
+        return admitted
+
+    def _priority_of(self, st: RequestState) -> int:
+        return self.policy(st.request.tenant).priority
+
+    def _maybe_preempt(self) -> None:
+        """When the engine's queue head is BLOCK-starved (a slot is
+        free, blocks are not) and outranks a running request, preempt
+        one victim: lowest priority first, youngest within a priority.
+        One victim per pump — preemption is a pressure valve, not a
+        scheduler."""
+        sch = self.engine.scheduler
+        if not sch.waiting:
+            return
+        head = sch.waiting[0]
+        if head.swapped is not None:
+            # a restore waiting on blocks: preempting someone else to
+            # restore a preemptee would thrash
+            return
+        if sch._free_slot() is None:
+            return
+        if sch.allocator.can_allocate(sch.blocks_needed(head)):
+            return                  # it will admit on the next step
+        hp = self._priority_of(head)
+        victims = sorted(
+            (self._priority_of(st), -st.submit_t, st.request.request_id)
+            for _slot, st in sch.active()
+            if self._priority_of(st) < hp)
+        if victims:
+            self.engine.preempt(victims[0][2], reason="pool_pressure")
+
+    # -- the loop ----------------------------------------------------------
+
+    def has_work(self) -> bool:
+        return self._total_queued() > 0 or self.engine.has_work()
+
+    def step(self):
+        """One pump + one engine step; returns the engine's events."""
+        self.pump()
+        return self.engine.step()
+
+    def run(self) -> Dict[str, List[int]]:
+        """Drain door + engine; same contract as ``Engine.run()`` —
+        {request_id: generated ids} for everything finished since the
+        last drain."""
+        eng = self.engine
+        drained = eng._begin_drain()
+        try:
+            while self.has_work():
+                self.pump()
+                if eng.has_work():
+                    eng.step()
+                elif self._total_queued():
+                    break           # safety: cannot make progress
+        finally:
+            eng._end_drain()
+        return drained
+
+    def stream(self):
+        """Generator over :class:`TokenEvent`s until door + engine
+        drain (submissions may keep arriving mid-stream)."""
+        while self.has_work():
+            self.pump()
+            for ev in self.engine.step():
+                yield ev
+            if not self.engine.has_work() and not self._total_queued():
+                return

@@ -59,6 +59,7 @@ class Request:
     eos_token_id: Optional[int] = None
     on_token: Optional[Callable] = None   # cb(request_id, token_id, text)
     request_id: Optional[str] = None
+    tenant: Optional[str] = None    # front-door attribution (telemetry)
 
     def __post_init__(self):
         self.prompt_ids = np.asarray(self.prompt_ids, np.int32).reshape(-1)
@@ -78,7 +79,8 @@ class RequestState:
                  "pending_token", "output_ids", "text_len", "detok_offset",
                  "submit_t", "first_token_t", "finished", "finish_reason",
                  "drained", "num_shared", "num_cowed", "cached_tokens",
-                 "borrowed", "cow_spare", "page_keys")
+                 "borrowed", "cow_spare", "page_keys", "swapped",
+                 "preempts")
 
     def __init__(self, request: Request):
         self.request = request
@@ -101,6 +103,10 @@ class RequestState:
         self.borrowed: Set[int] = set()   # shared pages we may yet write
         self.cow_spare: Dict[int, int] = {}   # page → reserved CoW block
         self.page_keys: List[bytes] = []      # full-prompt-page digests
+        # preemption: (pages, host payload) while swapped to host RAM —
+        # admission takes the restore path instead of a fresh prefill
+        self.swapped: Optional[tuple] = None
+        self.preempts = 0            # times this request was preempted
 
     @property
     def total_len(self) -> int:
@@ -172,6 +178,25 @@ class Scheduler:
         if slot is None:
             return None
         st = self.waiting[0]
+        if st.swapped is not None:
+            # RESTORE path: a preempted request re-enters with its KV
+            # bytes parked on host.  Every page is re-materialized as a
+            # PRIVATE block (no prefix borrowing: the cached entry that
+            # backed a borrowed page may have been evicted since, and
+            # the host payload is the authoritative content) — the
+            # engine swap_ins pages [0, ceil(kv_len/page)) right after
+            # this returns, then prefill/decode resumes at kv_len.
+            total = self.blocks_needed(st)
+            if not self.allocator.can_allocate(total):
+                return None
+            self.waiting.popleft()
+            st.slot = slot
+            st.blocks = self.allocator.allocate(total)
+            st.table = np.full((self.max_blocks_per_seq,), self.oob_block,
+                               np.int32)
+            st.table[:total] = st.blocks
+            self.slots[slot] = st
+            return st
         plen = int(st.request.prompt_ids.size)
         total = self.blocks_needed(st)
         keys = st.page_keys                    # hashed once at submit()
@@ -290,12 +315,34 @@ class Scheduler:
         in the prefix cache, to the evictable LRU pool)."""
         st.finished = True
         st.finish_reason = reason
+        self.release_slot(st)
+
+    def release_slot(self, st: RequestState) -> None:
+        """Vacate ``st``'s slot and drop every block reference WITHOUT
+        finishing it — the preemption/isolation half of ``finish``.
+        Shared pages decref (never touched under other readers); CoW
+        spares and private pages return to the pool.  The caller
+        requeues the state for restoration."""
         if st.slot is not None:
             self.slots[st.slot] = None
             st.slot = None
         if st.blocks:
             self.allocator.free(st.blocks)
             st.blocks = []
+        st.table = None
+        st.borrowed = set()
+        st.cow_spare = {}
+
+    def requeue(self, st: RequestState, head: bool = False) -> None:
+        """Put a preempted/isolated request back on the waiting queue —
+        at the head for fault isolation (it was mid-flight; resume
+        ASAP), at the tail for front-door preemption (the preemptor is
+        already queued ahead of it, plain FIFO restores the victim once
+        the pressure passes)."""
+        if head:
+            self.waiting.appendleft(st)
+        else:
+            self.waiting.append(st)
 
     def has_work(self) -> bool:
         return bool(self.waiting) or any(s is not None for s in self.slots)

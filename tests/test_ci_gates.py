@@ -80,6 +80,17 @@ def test_serving_smoke_gate():
     assert "0 compiles after warmup" in out
 
 
+def test_chaos_serving_gate():
+    """Serving-path resilience (tools/ci.py gate_chaos_serving): with a
+    PDTPU_FAULTS plan firing at every serving site during a mixed churn
+    run with preemption and CoW, the engine never tears down the
+    compiled step, reclaims every KV block at drain, and greedy outputs
+    stay token-identical to the fault-free run (docs/RESILIENCE.md)."""
+    out = _run_gate("chaos-serving", timeout=900)
+    assert "chaos-serving gate OK" in out
+    assert "token-identical to the fault-free run" in out
+
+
 def test_api_compat_rejects_foreign_module_leak(monkeypatch):
     """A leaked implementation import (jax/os/...) reachable as a public
     attribute hard-fails collect() (VERDICT r4 weak #1: the gate must

@@ -1,0 +1,463 @@
+"""Multi-tenant front door + streaming server (paddle_tpu.serving).
+
+The production story on top of the engine: typed shed answers with
+retry-after, token-bucket rate limits per tenant, strict-priority +
+weighted-DRR fairness, preemption under pool pressure, and the stdlib
+HTTP server with graceful SIGTERM drain.  Everything deterministic:
+buckets run on an injected clock, and greedy outputs stay
+token-identical through every admission decision.
+"""
+
+import http.client
+import json
+import os
+import signal
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import serving
+from paddle_tpu.serving import (Admission, FrontDoor, ServingServer,
+                                TenantPolicy, TokenBucket)
+
+R = np.random.default_rng(0)
+
+
+def _prompt(n):
+    return R.integers(0, 256, size=n).astype(np.int32)
+
+
+def _ref(model, p, m):
+    return np.asarray(model.generate(
+        jnp.asarray(p)[None], max_new_tokens=m,
+        temperature=0.0))[0, len(p):]
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    from paddle_tpu.models.llama import llama
+    pt.seed(0)
+    return llama("tiny")
+
+
+class TestTokenBucket:
+    def test_deterministic_refill_and_wait(self):
+        t = [0.0]
+        b = TokenBucket(rate=10.0, capacity=20.0, clock=lambda: t[0])
+        assert b.try_take(15) == 0.0
+        wait = b.try_take(10)              # level 5, short by 5
+        assert wait == pytest.approx(0.5)
+        t[0] += 0.5
+        assert b.try_take(10) == 0.0
+        assert TokenBucket(0.0, 1.0, clock=lambda: t[0]).try_take(2) \
+            == float("inf")
+
+
+class TestFrontDoorShedding:
+    def test_shed_then_retry_after_flow(self, tiny_llama):
+        """The overload contract: a shed is a TYPED ANSWER with a
+        retry-after hint, not an exception — and retrying after the
+        drain is admitted."""
+        eng = serving.Engine(tiny_llama, max_batch=2, max_seq_len=32,
+                             page_size=8).warmup()
+        door = FrontDoor(eng, max_queue_depth=3)
+        admitted = [door.submit(_prompt(4), max_new_tokens=3)
+                    for _ in range(8)]
+        sheds = [a for a in admitted if not a.admitted]
+        assert sheds and all(a.reason == "queue_full" for a in sheds)
+        assert all(a.retry_after_s > 0 for a in sheds)
+        assert all(a.request_id is None for a in sheds)
+        outs = door.run()
+        assert len(outs) == sum(a.admitted for a in admitted)
+        assert eng.kv_blocks_used == 0
+        retry = door.submit(_prompt(4), max_new_tokens=3)
+        assert retry.admitted                 # the hint was honest
+        door.run()
+
+    def test_rate_limit_with_injected_clock(self, tiny_llama):
+        eng = serving.Engine(tiny_llama, max_batch=2, max_seq_len=32,
+                             page_size=8).warmup()
+        t = [0.0]
+        door = FrontDoor(eng, policies={
+            "free": TenantPolicy(rate_tokens_per_s=1.0,
+                                 burst_tokens=10.0)},
+            clock=lambda: t[0])
+        a1 = door.submit(_prompt(4), tenant="free", max_new_tokens=4)
+        assert a1.admitted
+        a2 = door.submit(_prompt(4), tenant="free", max_new_tokens=4)
+        assert not a2.admitted and a2.reason == "rate_limited"
+        assert a2.retry_after_s >= 6          # 8 tokens short at 1/s
+        t[0] += a2.retry_after_s              # wait as told → admitted
+        a3 = door.submit(_prompt(4), tenant="free", max_new_tokens=4)
+        assert a3.admitted
+        door.run()
+        assert eng.kv_blocks_used == 0
+
+    def test_quota_and_budget_sheds(self, tiny_llama):
+        eng = serving.Engine(tiny_llama, max_batch=1, max_seq_len=32,
+                             page_size=8).warmup()
+        door = FrontDoor(eng, policies={
+            "q": TenantPolicy(max_live_requests=1)})
+        assert door.submit(_prompt(3), tenant="q",
+                           max_new_tokens=8).admitted
+        a = door.submit(_prompt(3), tenant="q", max_new_tokens=8)
+        assert not a.admitted and a.reason == "quota"
+        b = door.submit(_prompt(40), max_new_tokens=8)   # never fits
+        assert not b.admitted and b.reason == "budget"
+        assert b.retry_after_s is None        # retrying cannot help
+        door.run()
+        assert door.submit(_prompt(3), tenant="q",
+                           max_new_tokens=8).admitted   # quota released
+        door.run()
+
+    def test_raise_on_shed_typed_exceptions(self, tiny_llama):
+        eng = serving.Engine(tiny_llama, max_batch=1, max_seq_len=32,
+                             page_size=8).warmup()
+        t = [0.0]
+        door = FrontDoor(eng, policies={
+            "free": TenantPolicy(rate_tokens_per_s=1.0,
+                                 burst_tokens=5.0)},
+            max_queue_depth=2, clock=lambda: t[0])
+        assert door.submit(_prompt(3), tenant="free",
+                           max_new_tokens=2).admitted
+        with pytest.raises(serving.RateLimited) as e:
+            door.submit(_prompt(3), tenant="free", max_new_tokens=2,
+                        raise_on_shed=True)
+        assert e.value.retry_after_s > 0
+        assert door.submit(_prompt(3), tenant="other",
+                           max_new_tokens=2).admitted   # depth now 2
+        with pytest.raises(serving.QueueFull):
+            door.submit(_prompt(3), tenant="other", max_new_tokens=2,
+                        raise_on_shed=True)
+        door.run()
+
+    def test_rate_bucket_not_charged_for_other_sheds(self, tiny_llama):
+        """Review fix: the token bucket is the LAST gate — a request
+        shed for queue_full must not burn the tenant's tokens, and a
+        cost beyond burst capacity sheds as budget (a finite
+        retry-after would be a lie: the level can never reach it)."""
+        eng = serving.Engine(tiny_llama, max_batch=1, max_seq_len=32,
+                             page_size=8).warmup()
+        t = [0.0]
+        door = FrontDoor(eng, policies={
+            "free": TenantPolicy(rate_tokens_per_s=1.0,
+                                 burst_tokens=6.0)},
+            max_queue_depth=1, clock=lambda: t[0])
+        assert door.submit(_prompt(4), max_new_tokens=2).admitted
+        # queue now full: these shed BEFORE touching free's bucket
+        for _ in range(5):
+            a = door.submit(_prompt(4), tenant="free", max_new_tokens=2)
+            assert a.reason == "queue_full"
+        door.run()
+        a = door.submit(_prompt(4), tenant="free", max_new_tokens=2)
+        assert a.admitted, a                  # bucket was never charged
+        door.run()
+        t[0] += 10.0                          # refill for the next probe
+        b = door.submit(_prompt(4), tenant="free", max_new_tokens=8)
+        assert not b.admitted and b.reason == "budget"   # 12 > burst 6
+        assert b.retry_after_s is None
+        door.run()
+        assert eng.kv_blocks_used == 0
+
+    def test_slo_ttft_backpressure_sheds_low_priority(self, tiny_llama):
+        """With the TTFT p95 signal over its SLO, tenants below the
+        priority floor shed (reason slo_shed) while protected tenants
+        keep being admitted — the telemetry-driven decision."""
+        import paddle_tpu.observability as obs
+        tel = obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        try:
+            eng = serving.Engine(tiny_llama, max_batch=2, max_seq_len=32,
+                                 page_size=8).warmup()
+            door = FrontDoor(eng, policies={
+                "lo": TenantPolicy(priority=0),
+                "hi": TenantPolicy(priority=1)},
+                slo_ttft_p95_ms=0.000001)     # any real TTFT breaches
+            assert door.submit(_prompt(3), tenant="lo",
+                               max_new_tokens=2).admitted
+            door.run()                        # populates serve.ttft_ms
+            a = door.submit(_prompt(3), tenant="lo", max_new_tokens=2)
+            assert not a.admitted and a.reason == "slo_shed"
+            assert a.retry_after_s > 0
+            b = door.submit(_prompt(3), tenant="hi", max_new_tokens=2)
+            assert b.admitted                 # protected tier unaffected
+            door.run()
+            assert tel.registry.snapshot()["serve.shed"] == 1
+            shed_evs = tel.sinks[0].events("serve_shed")
+            assert shed_evs and shed_evs[0]["tenant"] == "lo" \
+                and shed_evs[0]["reason"] == "slo_shed"
+        finally:
+            obs.disable()
+
+
+class TestFairness:
+    def test_high_priority_not_starved_by_flood(self, tiny_llama):
+        """A flood of low-priority work queued ahead must not starve a
+        high-priority tenant: strict tiers admit its requests next."""
+        model = tiny_llama
+        eng = serving.Engine(model, max_batch=2, max_seq_len=32,
+                             page_size=8).warmup()
+        door = FrontDoor(eng, policies={
+            "lo": TenantPolicy(priority=0),
+            "hi": TenantPolicy(priority=1)}, max_queue_depth=64)
+        finish_order = []
+        lo = [door.submit(_prompt(4), tenant="lo",
+                          max_new_tokens=4).request_id
+              for _ in range(10)]
+        hi = [door.submit(_prompt(4), tenant="hi",
+                          max_new_tokens=4).request_id
+              for _ in range(2)]
+        for ev in door.stream():
+            if ev.finished:
+                finish_order.append(ev.request_id)
+        assert set(finish_order) == set(lo + hi)
+        assert eng.kv_blocks_used == 0
+        # the hi requests (submitted LAST, behind 10 queued lo) finish
+        # before the tail of the flood
+        last_lo_positions = sorted(finish_order.index(r) for r in lo)[-4:]
+        for r in hi:
+            assert finish_order.index(r) < last_lo_positions[0], \
+                (finish_order, r)
+        # greedy outputs unaffected by the reordering
+        for rid in lo + hi:
+            assert len(eng.output_ids(rid)) == 4
+
+    def test_weighted_drr_within_a_tier(self, tiny_llama):
+        """Two equal-priority floods under contention split engine
+        admissions by weight, not by arrival order: the 2x-weight
+        tenant lands ~2x the admissions once both queues contend, and
+        the 1x tenant is not starved."""
+        eng = serving.Engine(tiny_llama, max_batch=3, max_seq_len=32,
+                             page_size=8).warmup()
+        door = FrontDoor(eng, policies={
+            "a": TenantPolicy(weight=2.0), "b": TenantPolicy(weight=1.0)},
+            max_queue_depth=64, drr_quantum=4)
+        order = []
+        orig = eng.add_request
+
+        def tracking(*a, **kw):
+            order.append(kw.get("tenant"))
+            return orig(*a, **kw)
+
+        eng.add_request = tracking
+        # b's flood arrives FIRST: pure FIFO would drain all of b before
+        # any of a.  Staging (3 deep) takes the head of b's flood, the
+        # rest contends through DRR.
+        for _ in range(6):
+            door.submit(_prompt(4), tenant="b", max_new_tokens=2)
+        for _ in range(6):
+            door.submit(_prompt(4), tenant="a", max_new_tokens=2)
+        door.run()
+        assert eng.kv_blocks_used == 0
+        assert order[:3] == ["b", "b", "b"]   # pre-contention staging
+        contended = order[3:9]                # both queues nonempty here
+        assert contended.count("a") > contended.count("b") >= 1, order
+
+    def test_preemption_under_pool_pressure(self, tiny_llama):
+        """A block-starved high-priority admission preempts the
+        lowest-priority victim (swap to host) instead of waiting out
+        its whole decode — and the victim still completes
+        token-identical afterwards."""
+        model = tiny_llama
+        eng = serving.Engine(model, max_batch=2, max_seq_len=32,
+                             page_size=8, num_blocks=4).warmup()
+        door = FrontDoor(eng, policies={
+            "lo": TenantPolicy(priority=0),
+            "hi": TenantPolicy(priority=1)})
+        p_lo, p_hi = _prompt(9), _prompt(11)
+        lo = door.submit(p_lo, tenant="lo", max_new_tokens=12)
+        door.step(); door.step()              # lo occupies 3 of 4 blocks
+        hi = door.submit(p_hi, tenant="hi", max_new_tokens=12)
+        door.step()                           # pressure → lo preempted
+        st_lo = eng._states[lo.request_id]
+        assert st_lo.preempts == 1
+        outs = door.run()
+        assert np.array_equal(_ref(model, p_hi, 12),
+                              np.asarray(outs[hi.request_id]))
+        assert np.array_equal(_ref(model, p_lo, 12),
+                              np.asarray(outs[lo.request_id]))
+        assert eng.kv_blocks_used == 0
+
+    def test_no_preemption_within_same_priority(self, tiny_llama):
+        eng = serving.Engine(tiny_llama, max_batch=2, max_seq_len=32,
+                             page_size=8, num_blocks=4).warmup()
+        door = FrontDoor(eng)                 # everyone default priority
+        r1 = door.submit(_prompt(9), max_new_tokens=12)
+        door.step(); door.step()
+        door.submit(_prompt(11), max_new_tokens=12)
+        door.step(); door.step()
+        assert eng._states[r1.request_id].preempts == 0   # FIFO waits
+        door.run()
+        assert eng.kv_blocks_used == 0
+
+
+class TestServingServer:
+    def _post(self, conn, body):
+        conn.request("POST", "/v1/completions", json.dumps(body),
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        return r, r.read()
+
+    def test_server_smoke_request_stream_drain(self, tiny_llama):
+        """The satellite smoke test: request in → streamed tokens out
+        (token-identical to generate()) → graceful drain → every KV
+        block reclaimed."""
+        model = tiny_llama
+        eng = serving.Engine(model, max_batch=2, max_seq_len=64,
+                             page_size=8).warmup()
+        srv = ServingServer(eng, port=0)
+        host, port = srv.start()
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=60)
+            conn.request("GET", "/healthz")
+            r = conn.getresponse()
+            assert r.status == 200 \
+                and json.loads(r.read())["status"] == "serving"
+
+            p = _prompt(6)
+            ref = _ref(model, p, 5).tolist()
+            r, raw = self._post(conn, {"prompt": p.tolist(),
+                                       "max_tokens": 5})
+            assert r.status == 200
+            out = json.loads(raw)
+            assert out["choices"][0]["token_ids"] == ref
+            assert out["choices"][0]["finish_reason"] == "length"
+            assert out["usage"]["completion_tokens"] == 5
+
+            r, raw = self._post(conn, {"prompt": p.tolist(),
+                                       "max_tokens": 4, "stream": True})
+            assert r.status == 200
+            assert r.getheader("Content-Type") == "text/event-stream"
+            toks, done = [], False
+            for line in raw.decode().splitlines():
+                if line == "data: [DONE]":
+                    done = True
+                elif line.startswith("data: "):
+                    toks.append(
+                        json.loads(line[6:])["choices"][0]["token_id"])
+            assert done and toks == ref[:4]
+
+            # malformed + draining answers are typed
+            r, raw = self._post(conn, {"prompt": "text, no tokenizer"})
+            assert r.status == 400
+            srv.begin_drain()
+            r, raw = self._post(conn, {"prompt": p.tolist(),
+                                       "max_tokens": 2})
+            assert r.status == 503 and r.getheader("Retry-After")
+            assert json.loads(raw)["error"]["type"] == "draining"
+            assert srv.wait_drained(timeout=30)
+        finally:
+            srv.close()
+        assert eng.kv_blocks_used == 0
+
+    def test_sigterm_graceful_drain(self, tiny_llama):
+        """serve_forever() + SIGTERM (PreemptionGuard): the in-flight
+        request completes, the server drains and returns, nothing
+        leaks.  Runs serve_forever on the MAIN thread — signal handlers
+        can only install there."""
+        model = tiny_llama
+        eng = serving.Engine(model, max_batch=2, max_seq_len=64,
+                             page_size=8).warmup()
+        srv = ServingServer(eng, port=0)
+        host, port = srv.start()             # bind before the client runs
+        p = _prompt(5)
+        ref = _ref(model, p, 3).tolist()
+        result = {}
+
+        def client():
+            try:
+                conn = http.client.HTTPConnection(host, port, timeout=60)
+                conn.request("POST", "/v1/completions",
+                             json.dumps({"prompt": p.tolist(),
+                                         "max_tokens": 3}),
+                             {"Content-Type": "application/json"})
+                result["out"] = json.loads(conn.getresponse().read())
+            finally:
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        srv.serve_forever()                   # returns after the drain
+        t.join(timeout=30)
+        assert result["out"]["choices"][0]["token_ids"] == ref
+        assert eng.kv_blocks_used == 0
+
+    def test_shed_maps_to_http_status(self, tiny_llama):
+        eng = serving.Engine(tiny_llama, max_batch=1, max_seq_len=32,
+                             page_size=8).warmup()
+        t = [0.0]
+        door = FrontDoor(eng, policies={
+            "free": TenantPolicy(rate_tokens_per_s=1.0,
+                                 burst_tokens=6.0)},
+            max_queue_depth=2, clock=lambda: t[0])
+        srv = ServingServer(door, port=0)
+        host, port = srv.start()
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=60)
+            p = _prompt(3)
+            r, _ = self._post(conn, {"prompt": p.tolist(),
+                                     "max_tokens": 3, "tenant": "free"})
+            assert r.status == 200
+            r, raw = self._post(conn, {"prompt": p.tolist(),
+                                       "max_tokens": 3,
+                                       "tenant": "free"})
+            assert r.status == 429 and r.getheader("Retry-After")
+            assert json.loads(raw)["error"]["type"] == "rate_limited"
+            # a request that can never fit → 400, no Retry-After story
+            r, raw = self._post(conn, {"prompt": _prompt(40).tolist(),
+                                       "max_tokens": 8})
+            assert r.status == 400
+            assert json.loads(raw)["error"]["type"] == "budget"
+        finally:
+            srv.begin_drain()
+            srv.wait_drained(timeout=30)
+            srv.close()
+        assert eng.kv_blocks_used == 0
+
+
+class TestFrontDoorTelemetry:
+    def test_tenant_counters_and_report_fold(self, tiny_llama, tmp_path):
+        """serve.tenant[...] counters + shed/preempt events land in the
+        registry and telemetry_report folds the new columns."""
+        import subprocess
+        import sys as _sys
+
+        import paddle_tpu.observability as obs
+        path = str(tmp_path / "serve.jsonl")
+        tel = obs.enable(jsonl_path=path, crash_hooks=False)
+        try:
+            eng = serving.Engine(tiny_llama, max_batch=2, max_seq_len=32,
+                                 page_size=8).warmup()
+            door = FrontDoor(eng, policies={
+                "a": TenantPolicy(priority=1)}, max_queue_depth=2)
+            rid = door.submit(_prompt(4), tenant="a",
+                              max_new_tokens=6).request_id
+            door.step(); door.step()
+            eng.preempt(rid)
+            for _ in range(6):
+                door.submit(_prompt(4), tenant="b", max_new_tokens=4)
+            door.run()
+            snap = tel.registry.snapshot()
+            assert snap["serve.tenant[a].requests"] == 1
+            assert snap["serve.preemptions"] >= 1
+            assert snap["serve.shed"] > 0
+            assert snap[
+                "serve.shed[queue_full].count"] == snap["serve.shed"]
+        finally:
+            obs.disable()
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            [_sys.executable,
+             os.path.join(repo, "tools", "telemetry_report.py"),
+             "--json", path],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+        summary = json.loads(r.stdout.strip().splitlines()[-1])
+        sv = summary["serving"]
+        assert sv["preempts"] >= 1 and sv["restores"] >= 1
+        assert sv["sheds"].get("queue_full", 0) > 0
+        assert sv["tenants"].get("a") == 1
+        assert sv["swapped_pages"] >= 1

@@ -646,6 +646,221 @@ class TestRaggedPrefixServing:
         assert eng.kv_blocks_used == 0
 
 
+class TestPreemption:
+    """preempt → swap → restore (serving.SwapManager): the front door's
+    alternative to rejection.  The bar: a preempted request resumes
+    TOKEN-IDENTICAL (the swap round-trips exact page bytes, int8 scales
+    included), and refcounted prefix-shared pages are never swapped out
+    from under the other slots reading them."""
+
+    def _ref(self, model, p, m):
+        return np.asarray(model.generate(
+            jnp.asarray(p)[None], max_new_tokens=m,
+            temperature=0.0))[0, len(p):]
+
+    def test_preempt_swap_restore_token_identity(self, tiny_llama):
+        model = tiny_llama
+        eng = serving.Engine(model, max_batch=2, max_seq_len=64,
+                             page_size=8).warmup()
+        p1, p2 = _prompt(6), _prompt(11)
+        r1 = eng.add_request(p1, max_new_tokens=12)
+        r2 = eng.add_request(p2, max_new_tokens=8)
+        for _ in range(4):
+            eng.step()
+        used_before = eng.kv_blocks_used
+        assert eng.preempt(r1)
+        st = eng._states[r1]
+        assert st.swapped is not None and st.slot is None
+        assert eng.kv_blocks_used < used_before   # victim's blocks freed
+        assert eng._swap.pages_out > 0
+        eng.run()
+        assert st.preempts == 1 and st.swapped is None
+        assert eng._swap.pages_in > 0
+        for p, m, rid in ((p1, 12, r1), (p2, 8, r2)):
+            assert np.array_equal(self._ref(model, p, m),
+                                  np.asarray(eng.output_ids(rid))), rid
+        assert eng.kv_blocks_used == 0
+
+    def test_preempt_mid_prefill_restores(self, tiny_llama):
+        """A victim still chunk-prefilling swaps its written prefix and
+        resumes prefill at kv_len — not from scratch."""
+        model = tiny_llama
+        eng = serving.Engine(model, max_batch=2, max_seq_len=64,
+                             page_size=8, prefill_chunk=4).warmup()
+        p = _prompt(41)
+        rid = eng.add_request(p, max_new_tokens=5)
+        eng.step(); eng.step()                    # 8 of 41 prompt tokens
+        st = eng._states[rid]
+        assert st.prefilling and 0 < st.kv_len < 41
+        kv_at_preempt = st.kv_len
+        assert eng.preempt(rid)
+        eng.run()
+        assert st.kv_len > kv_at_preempt          # resumed, not reset
+        assert np.array_equal(self._ref(model, p, 5),
+                              np.asarray(eng.output_ids(rid)))
+        assert eng.kv_blocks_used == 0
+
+    def test_preempt_int8_pools_round_trips_scales(self, tiny_llama):
+        """int8 pools: the swap must carry values AND scales — compare
+        against an unpreempted int8 engine (generate() is fp, not the
+        reference here)."""
+        outs = []
+        for do_preempt in (False, True):
+            pt.seed(0)
+            eng = serving.Engine(tiny_llama, max_batch=2, max_seq_len=64,
+                                 page_size=8,
+                                 kv_cache_dtype="int8").warmup()
+            R2 = np.random.default_rng(7)
+            p = R2.integers(0, 256, size=13).astype(np.int32)
+            rid = eng.add_request(p, max_new_tokens=10)
+            for _ in range(4):
+                eng.step()
+            if do_preempt:
+                assert eng.preempt(rid)
+            eng.run()
+            outs.append(eng.output_ids(rid))
+            assert eng.kv_blocks_used == 0
+        assert outs[0] == outs[1]
+
+    def test_preempt_with_shared_prefix_pages(self, tiny_llama):
+        """Preempting a borrower must not disturb the donor (still
+        decoding through the same physical pages) or the cache: the
+        shared pages are copied, the victim's refs drop, and later
+        requests still hit the cached pages."""
+        model = tiny_llama
+        eng = serving.Engine(model, max_batch=2, max_seq_len=64,
+                             page_size=8, prefill_chunk=16).warmup()
+        common = _prompt(16)                      # 2 full pages
+        p1 = np.concatenate([common, _prompt(3)])
+        p2 = np.concatenate([common, _prompt(5)])
+        r1 = eng.add_request(p1, max_new_tokens=20)   # donor, long decode
+        eng.step(); eng.step()
+        r2 = eng.add_request(p2, max_new_tokens=10)   # borrows the pages
+        eng.step(); eng.step()
+        st2 = eng._states[r2]
+        assert st2.num_shared == 2                # the borrow happened
+        assert eng.preempt(r2)                    # victim = the borrower
+        eng.run()
+        assert np.array_equal(self._ref(model, p1, 20),
+                              np.asarray(eng.output_ids(r1)))
+        assert np.array_equal(self._ref(model, p2, 10),
+                              np.asarray(eng.output_ids(r2)))
+        hits_before = eng.prefix_stats()["hits"]
+        r3 = eng.add_request(np.concatenate([common, _prompt(2)]),
+                             max_new_tokens=3)
+        eng.run()
+        assert eng.prefix_stats()["hits"] > hits_before   # cache intact
+        assert eng.kv_blocks_used == 0
+
+    def test_preempt_non_running_returns_false(self, tiny_llama):
+        eng = serving.Engine(tiny_llama, max_batch=1, max_seq_len=32,
+                             page_size=8).warmup()
+        r1 = eng.add_request(_prompt(4), max_new_tokens=2)
+        r2 = eng.add_request(_prompt(5), max_new_tokens=2)  # waits
+        assert not eng.preempt("nope")            # unknown
+        eng.step()
+        assert not eng.preempt(r2)                # waiting, not in a slot
+        eng.run()
+        assert not eng.preempt(r1)                # finished
+        assert eng.kv_blocks_used == 0
+
+
+class TestTypedAdmissionErrors:
+    """Satellite: add_request failure modes are a typed hierarchy
+    (serving.errors), all ValueError subclasses so existing handlers
+    keep working."""
+
+    def test_budget_unsatisfiable(self, tiny_llama):
+        eng = serving.Engine(tiny_llama, max_batch=2, max_seq_len=32,
+                             page_size=8, num_blocks=2)
+        with pytest.raises(serving.BudgetUnsatisfiable):
+            eng.add_request(_prompt(20), max_new_tokens=20)
+        with pytest.raises(serving.BudgetUnsatisfiable):
+            eng.add_request(_prompt(30), max_new_tokens=8)
+        assert issubclass(serving.BudgetUnsatisfiable, ValueError)
+
+    def test_queue_full_typed(self, tiny_llama):
+        eng = serving.Engine(tiny_llama, max_batch=1, max_seq_len=32,
+                             page_size=8, max_queue=2).warmup()
+        eng.add_request(_prompt(3), max_new_tokens=2)
+        eng.add_request(_prompt(3), max_new_tokens=2)
+        with pytest.raises(serving.QueueFull):
+            eng.add_request(_prompt(3), max_new_tokens=2)
+        outs = eng.run()
+        assert len(outs) == 2 and eng.kv_blocks_used == 0
+        eng.add_request(_prompt(3), max_new_tokens=2)   # room again
+
+    def test_duplicate_id_is_admission_error(self, tiny_llama):
+        eng = serving.Engine(tiny_llama, max_batch=1, max_seq_len=32,
+                             page_size=8).warmup()
+        eng.add_request(_prompt(3), max_new_tokens=2, request_id="dup")
+        with pytest.raises(serving.AdmissionError):
+            eng.add_request(_prompt(4), max_new_tokens=2,
+                            request_id="dup")
+        eng.run()
+
+
+class TestFaultIsolation:
+    """Injected serve.* faults are confined to the ONE affected request
+    (rewind → preempt → re-admit): the compiled step and the other
+    slots survive, outputs stay token-identical (the chaos-serving CI
+    gate runs the full multi-site version of this)."""
+
+    def _ref(self, model, p, m):
+        return np.asarray(model.generate(
+            jnp.asarray(p)[None], max_new_tokens=m,
+            temperature=0.0))[0, len(p):]
+
+    def test_step_and_prefill_faults_confined(self, tiny_llama):
+        from paddle_tpu import resilience as rs
+        model = tiny_llama
+        eng = serving.Engine(model, max_batch=2, max_seq_len=64,
+                             page_size=8, prefill_chunk=4).warmup()
+        prompts = [_prompt(9), _prompt(14)]
+        inj = rs.install_faults("serve.step@2,serve.prefill@1,"
+                                "serve.admit@1")
+        try:
+            rids = [eng.add_request(p, max_new_tokens=6)
+                    for p in prompts]
+            with pytest.warns(RuntimeWarning, match="isolated"):
+                eng.run()
+        finally:
+            rs.clear_faults()
+        fired = {s for s, _ in inj.fired}
+        assert {"serve.step", "serve.prefill", "serve.admit"} <= fired
+        for p, rid in zip(prompts, rids):
+            assert np.array_equal(self._ref(model, p, 6),
+                                  np.asarray(eng.output_ids(rid))), rid
+        assert eng.kv_blocks_used == 0
+        # the victims went through the preempt/restore machinery
+        assert any(eng._states[r].preempts > 0 for r in rids)
+
+    def test_isolation_emits_events(self, tiny_llama):
+        import paddle_tpu.observability as obs
+        from paddle_tpu import resilience as rs
+        tel = obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        inj = rs.install_faults("serve.step@1")
+        try:
+            eng = serving.Engine(tiny_llama, max_batch=1, max_seq_len=32,
+                                 page_size=8).warmup()
+            rid = eng.add_request(_prompt(4), max_new_tokens=4)
+            with pytest.warns(RuntimeWarning, match="isolated"):
+                eng.run()
+            assert len(eng.output_ids(rid)) == 4
+            sink = tel.sinks[0]
+            iso = sink.events("serve_isolated_failure")
+            assert iso and iso[0]["exc"] == "InjectedFault"
+            assert sink.events("serve_preempt") \
+                and sink.events("serve_restore")
+            snap = tel.registry.snapshot()
+            assert snap["serve.isolated_failures"] == 1
+            assert snap["serve.preemptions"] == 1
+            assert snap["serve.restores"] == 1
+        finally:
+            rs.clear_faults()
+            obs.disable()
+
+
 class TestServingTelemetry:
     def test_metrics_and_events(self, tiny_llama):
         import paddle_tpu.observability as obs
@@ -686,6 +901,10 @@ class TestServingTelemetry:
             eng = serving.Engine(tiny_llama, max_batch=2, max_seq_len=64,
                                  page_size=8).warmup()
             eng.add_request(_prompt(4), max_new_tokens=3)
+            # the preempt/swap/restore path rides the same contract
+            rid = eng.add_request(_prompt(6), max_new_tokens=6)
+            eng.step(); eng.step()
+            eng.preempt(rid)
             eng.run()
         finally:
             for name, fn in saved.items():
@@ -728,6 +947,25 @@ class TestBenchServePlumbing:
         assert r["cold_ttft_p95_ms"] > 0 and r["warm_ttft_p95_ms"] > 0
         assert r["warm_agg_tokens_per_sec"] > 0
         assert r["warm_prefix_hits"] > 0 and r["prefix_hit_rate"] > 0
+
+    def test_bench_serve_burst_runs_on_cpu(self):
+        """Overload workload (offered > capacity through the bounded
+        front door): goodput, shed rate and admitted-TTFT all recorded;
+        every shed carried a retry-after answer (asserted inside)."""
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        from decode_bench import bench_serve_burst
+        r = bench_serve_burst(preset="tiny", max_batch=2, offered=8,
+                              max_queue_depth=3, prompt_lens=(5, 11, 8),
+                              max_new=6, page_size=8)
+        assert r["metric"] == "serve_burst_goodput"
+        assert r["admitted"] + r["shed"] == 8 and r["shed"] > 0
+        assert 0 < r["shed_rate"] < 1
+        assert r["goodput_tok_s"] > 0
+        assert r["admitted_ttft_p95_ms"] > 0
 
 
 class TestPredictorWarmup:

@@ -42,8 +42,15 @@ in-repo gates over artifacts committed alongside the code:
                   recompiles (recompile sentinel + jit cache sizes), and
                   every KV block is reclaimed at drain
 
+  chaos-serving   the resilience machinery applied to the serving path:
+                  a PDTPU_FAULTS plan firing at every serving site
+                  (serve.admit/prefill/step/cow/swap) during a mixed
+                  churn run with preemption + CoW → zero step
+                  recompiles, all KV blocks reclaimed at drain, and
+                  greedy outputs token-identical to the fault-free run
+
 Run all:  python tools/ci.py            (exit 0 = all gates pass)
-One:      python tools/ci.py --only api-compat|op-benchmark|memproof-lite|telemetry-overhead|chaos|serving-smoke
+One:      python tools/ci.py --only api-compat|op-benchmark|memproof-lite|telemetry-overhead|chaos|serving-smoke|chaos-serving
 """
 
 from __future__ import annotations
@@ -263,6 +270,91 @@ def gate_telemetry_overhead(iters: int = 100_000,
     if len(rec) != 512 or rec.total != ring_iters:
         print(f"telemetry-overhead gate FAILED: ring not bounded at its "
               f"capacity (len {len(rec)}, capacity 512, total {rec.total})")
+        return 1
+
+    # 3b. serving fault sites + front-door decisions ride the same
+    # contract: the serve.* sites are registered (a PDTPU_FAULTS plan
+    # naming them parses), and with telemetry disabled a FrontDoor
+    # submit — admitted or shed — touches neither registry nor sinks
+    # (poison probe) and costs O(µs) per decision.
+    import numpy as np
+
+    from paddle_tpu.resilience import faults as rs_faults
+    serve_sites = ("serve.admit", "serve.prefill", "serve.step",
+                   "serve.cow", "serve.swap")
+    missing = [s for s in serve_sites if s not in rs_faults.SITES]
+    if missing:
+        print(f"telemetry-overhead gate FAILED: serving fault sites "
+              f"not registered: {missing}")
+        return 1
+    rs_faults.parse_faults(",".join(f"{s}@0" for s in serve_sites))
+
+    from paddle_tpu.serving.frontdoor import FrontDoor, TenantPolicy
+
+    class _Alloc:
+        used_blocks = 0
+
+        def can_allocate(self, n):
+            return True
+
+    class _KV:
+        num_blocks = 64
+        allocator = _Alloc()
+
+    class _Sched:
+        waiting = ()
+
+        def queue_depth(self):
+            return 0
+
+        def blocks_for(self, n):
+            return 1
+
+    class _Eng:
+        """The attribute surface FrontDoor reads — no jax, no model."""
+        max_batch = 4
+        max_seq_len = 128
+        kv = _KV()
+
+        def __init__(self):
+            self.scheduler = _Sched()
+            self._states = {}
+
+        def add_request(self, *a, **kw):
+            return kw.get("request_id")
+
+    door = FrontDoor(_Eng(), policies={
+        "t": TenantPolicy(rate_tokens_per_s=1.0, burst_tokens=8.0)})
+    prompt = np.arange(4, dtype=np.int32)
+    for cls, name in poisoned:
+        setattr(cls, name, boom)
+    try:
+        first = door.submit(prompt, tenant="t", max_new_tokens=4)
+        second = door.submit(prompt, tenant="t", max_new_tokens=4)
+        shed_iters = 2000
+        t0 = time.perf_counter()
+        for _ in range(shed_iters):
+            door.submit(prompt, tenant="t", max_new_tokens=4)
+        shed_us = (time.perf_counter() - t0) / shed_iters * 1e6
+    except AssertionError:
+        print("telemetry-overhead gate FAILED: the disabled-telemetry "
+              "front door touched the metrics registry / sinks "
+              "(serving/frontdoor.py must guard every emit)")
+        return 1
+    finally:
+        for (cls, name), fn in saved.items():
+            setattr(cls, name, fn)
+    if not first.admitted or second.admitted \
+            or second.reason != "rate_limited":
+        print(f"telemetry-overhead gate FAILED: front-door stub "
+              f"decisions wrong ({first}, {second})")
+        return 1
+    print(f"telemetry-overhead: disabled-path FrontDoor shed decision "
+          f"{shed_us:.2f} us/call (budget 50 us)")
+    if shed_us > 50.0:
+        print("telemetry-overhead gate FAILED: the front door's shed "
+              "path grew a measurable cost — sheds happen thousands of "
+              "times per second under overload")
         return 1
 
     # 4. an enable/disable cycle (recorder + watchdog + spans on) leaves
@@ -645,6 +737,173 @@ def gate_serving_smoke(max_batch: int = 4, n_requests: int = 10) -> int:
     return 0
 
 
+def gate_chaos_serving(max_batch: int = 4) -> int:
+    """Chaos-serving gate: the PR-3 resilience machinery applied to the
+    serving path (docs/RESILIENCE.md "Serving sites").
+
+    One mixed churn scenario — staggered multi-tenant admission through
+    a FrontDoor, chunked prefill, a fully-cached duplicate prompt
+    (prefix share + CoW), and a mid-flight preemption (host swap +
+    restore) — runs twice on fresh engines: fault-free, then with a
+    ``PDTPU_FAULTS`` plan firing at EVERY serving site
+    (serve.admit/prefill/step/cow/swap).  The contract:
+
+    1. ZERO step recompiles in both runs: the sentinel's backend-compile
+       count stays at its warmup level and the step/CoW/swap jit caches
+       hold exactly one executable each — faults are confined to host
+       bookkeeping, the compiled programs are never torn down.
+    2. FULL RECLAIM at drain: ``used_blocks == 0``, every block
+       allocatable — isolation/preempt/restore leaks nothing.
+    3. TOKEN IDENTITY: every request's greedy output in the faulted run
+       equals the fault-free run — isolation rewinds + swap round-trips
+       are byte-exact, and injected swap faults are absorbed by the
+       RetryPolicy.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import warnings
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import observability as obs
+    from paddle_tpu import resilience as rs
+    from paddle_tpu import serving
+
+    SPEC = ("serve.admit@1,serve.prefill@1,serve.step@2,"
+            "serve.cow@0,serve.swap@0:OSError")
+    serve_sites = ("serve.admit", "serve.prefill", "serve.step",
+                   "serve.cow", "serve.swap")
+    failures = []
+    tel = obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+    try:
+        from paddle_tpu.models.llama import llama
+        pt.seed(0)
+        model = llama("tiny")
+        rng = np.random.default_rng(0)
+        lens = [3, 17, 9, 33, 5, 26, 12, 21]
+        prompts = [rng.integers(0, model.cfg.vocab_size,
+                                size=n).astype(np.int32) for n in lens]
+        budgets = [3 + (i % 4) for i in range(len(prompts))]
+        # page-aligned 2-page prompt, served twice: the second serve is
+        # fully cached → borrows both pages and copy-on-writes the last
+        shared = rng.integers(0, model.cfg.vocab_size,
+                              size=16).astype(np.int32)
+
+        def scenario(spec, tag):
+            rs.clear_faults()
+            inj = None
+            if spec:
+                os.environ["PDTPU_FAULTS"] = spec
+                inj = rs.install_faults_from_env()
+            try:
+                eng = serving.Engine(
+                    model, max_batch=max_batch, max_seq_len=64,
+                    page_size=8, prefill_chunk=8,
+                    retry=rs.RetryPolicy(max_attempts=4, backoff_s=0.0,
+                                         jitter=0.0,
+                                         sleep=lambda _s: None)).warmup()
+                c0 = tel.sentinel.compiles()
+                door = serving.FrontDoor(eng, policies={
+                    "lo": serving.TenantPolicy(priority=0),
+                    "hi": serving.TenantPolicy(priority=1)},
+                    max_queue_depth=64)
+                rids = []
+                preempted = False
+                with warnings.catch_warnings():
+                    # isolation warns per injected fault by design
+                    warnings.simplefilter("ignore", RuntimeWarning)
+                    for i, (p, m) in enumerate(zip(prompts, budgets)):
+                        a = door.submit(
+                            p, tenant="hi" if i % 3 == 0 else "lo",
+                            max_new_tokens=m)
+                        rids.append(a.request_id)
+                        door.step()    # staggered: join a RUNNING batch
+                    a = door.submit(shared, tenant="lo", max_new_tokens=4)
+                    rids.append(a.request_id)
+                    door.run()         # registers the shared pages
+                    a = door.submit(shared, tenant="lo", max_new_tokens=4)
+                    rids.append(a.request_id)
+                    door.step()        # fully-cached admission + CoW
+                    for _ in range(200):
+                        if not preempted:
+                            act = eng.scheduler.active()
+                            if act:
+                                preempted = eng.preempt(
+                                    act[0][1].request.request_id)
+                        if not door.has_work():
+                            break
+                        door.step()
+                    door.run()
+                churn = tel.sentinel.compiles() - c0
+                if churn:
+                    failures.append(
+                        f"{tag}: {churn} backend compile(s) after warmup "
+                        "— a fault tore into the compiled path")
+                if not preempted:
+                    failures.append(f"{tag}: preemption never engaged")
+                if eng.kv_blocks_used != 0:
+                    failures.append(
+                        f"{tag}: {eng.kv_blocks_used} KV block(s) still "
+                        "referenced at drain")
+                alloc = eng.kv.allocator
+                if alloc.free_blocks != alloc.num_blocks:
+                    failures.append(
+                        f"{tag}: only {alloc.free_blocks}/"
+                        f"{alloc.num_blocks} blocks allocatable at drain")
+                for fn, name in ((eng._step_fn, "step"),
+                                 (eng._cow_fn, "cow"),
+                                 (eng._swap._gather, "swap_out"),
+                                 (eng._swap._scatter, "swap_in")):
+                    n = getattr(fn, "_cache_size", lambda: None)()
+                    if n is not None and n > 1:
+                        failures.append(
+                            f"{tag}: {name} jit cache holds {n} entries "
+                            "— a retrace slipped past the sentinel")
+                if eng.prefix_stats()["cow_copies"] == 0 and not spec:
+                    failures.append(
+                        f"{tag}: the duplicate prompt never exercised "
+                        "copy-on-write — the scenario lost its cow "
+                        "coverage")
+                return [eng.output_ids(r) for r in rids], inj
+            finally:
+                rs.clear_faults()
+                os.environ.pop("PDTPU_FAULTS", None)
+
+        base, _ = scenario(None, "baseline")
+        if not failures:
+            print(f"chaos-serving: baseline churn ({len(base)} requests, "
+                  "preempt+restore, CoW) clean: 0 compiles after warmup, "
+                  "all blocks reclaimed")
+        faulted, inj = scenario(SPEC, "faulted")
+        fired = {site for site, _idx in inj.fired}
+        missing = [s for s in serve_sites if s not in fired]
+        if missing:
+            failures.append(
+                f"faulted: plan never fired at {missing} — the scenario "
+                "lost coverage of those sites")
+        diverged = [i for i, (a, b) in enumerate(zip(base, faulted))
+                    if a != b]
+        if diverged:
+            failures.append(
+                f"faulted: requests {diverged} diverged from the "
+                "fault-free run — isolation/restore is not "
+                "token-preserving")
+        elif not missing:
+            print(f"chaos-serving: faults at all {len(serve_sites)} "
+                  "serving sites absorbed: outputs token-identical to "
+                  "the fault-free run, 0 compiles, all blocks reclaimed")
+    finally:
+        obs.disable()
+
+    if failures:
+        print("chaos-serving gate FAILED (docs/RESILIENCE.md):")
+        for f_ in failures:
+            print(f"  {f_}")
+        return 1
+    print("chaos-serving gate OK")
+    return 0
+
+
 GATES = {
     "api-compat": gate_api_compat,
     "op-benchmark": gate_op_benchmark,
@@ -652,6 +911,7 @@ GATES = {
     "telemetry-overhead": gate_telemetry_overhead,
     "chaos": gate_chaos,
     "serving-smoke": gate_serving_smoke,
+    "chaos-serving": gate_chaos_serving,
 }
 
 

@@ -210,6 +210,74 @@ def bench_serve_prefix(preset="llama-350m", max_batch=8, n_requests=None,
     return out
 
 
+def bench_serve_burst(preset="llama-350m", max_batch=8, offered=None,
+                      prompt_lens=(24, 64, 40, 96), max_new=32,
+                      page_size=16, max_queue_depth=None,
+                      kv_cache_dtype=None):
+    """Overload serving benchmark: offered load ABOVE capacity through
+    the bounded front door (docs/SERVING.md "Front door").
+
+    ``offered`` requests (default 6x the slot count) hit a FrontDoor
+    whose queue bound (default 2x the slot count) is far below the
+    burst, so most of it sheds with a typed retry-after answer and the
+    admitted remainder drains.  The numbers a fleet sizes against:
+    GOODPUT tok/s (generated tokens over wall-clock — what survived the
+    overload), the SHED RATE (offered minus admitted over offered), and
+    TTFT p95 FOR ADMITTED requests (the latency the accepted traffic
+    actually saw while the door was slamming)."""
+    import paddle_tpu as pt
+    from paddle_tpu import serving
+    from paddle_tpu.models.llama import llama
+
+    if offered is None:
+        offered = 6 * max_batch
+    if max_queue_depth is None:
+        max_queue_depth = 2 * max_batch
+    lens = [prompt_lens[i % len(prompt_lens)] for i in range(offered)]
+    max_seq_len = max(lens) + max_new
+    pt.seed(0)
+    model = llama(preset, max_position_embeddings=max_seq_len,
+                  dtype="bfloat16")
+    model.astype("bfloat16")
+    eng = serving.Engine(model, max_batch=max_batch,
+                         max_seq_len=max_seq_len, page_size=page_size,
+                         kv_cache_dtype=kv_cache_dtype).warmup()
+    door = serving.FrontDoor(eng, max_queue_depth=max_queue_depth)
+    rng = np.random.default_rng(0)
+
+    admitted, sheds = [], 0
+    t0 = time.perf_counter()
+    for n in lens:
+        a = door.submit(rng.integers(0, model.cfg.vocab_size,
+                                     size=n).astype(np.int32),
+                        max_new_tokens=max_new)
+        if a.admitted:
+            admitted.append(a.request_id)
+        else:
+            sheds += 1
+            assert a.retry_after_s and a.retry_after_s > 0, \
+                "shed without a retry-after answer"
+    outs = door.run()
+    dt = time.perf_counter() - t0
+    assert eng.kv_blocks_used == 0, "KV blocks leaked at drain"
+    tokens = sum(len(outs[r]) for r in admitted)
+    ttfts = sorted(
+        (eng._states[r].first_token_t - eng._states[r].submit_t) * 1e3
+        for r in admitted)
+    p = lambda q: ttfts[min(len(ttfts) - 1,
+                            int(q / 100 * len(ttfts)))]  # noqa: E731
+    return {"metric": "serve_burst_goodput", "preset": preset,
+            "kv": str(kv_cache_dtype or "bf16"), "max_batch": max_batch,
+            "offered": offered, "admitted": len(admitted),
+            "shed": sheds, "shed_rate": round(sheds / offered, 3),
+            "max_queue_depth": max_queue_depth,
+            "max_new_tokens": max_new, "page_size": page_size,
+            "gen_tokens": tokens, "wall_s": round(dt, 3),
+            "goodput_tok_s": round(tokens / dt, 1),
+            "admitted_ttft_p50_ms": round(p(50), 2),
+            "admitted_ttft_p95_ms": round(p(95), 2)}
+
+
 def bench_decode_attention(batch=8, heads=16, head_dim=64, ctx=1024,
                            block_size=64, iters=200):
     """Paged vs contiguous decode attention, op-level, slope-amortized."""
@@ -277,6 +345,9 @@ def main():
     print(json.dumps(bench_serve(kv_cache_dtype="int8")), flush=True)
     # shared-prefix burst: prefix-cache hit rate + TTFT under load
     print(json.dumps(bench_serve_prefix(kv_cache_dtype="int8")), flush=True)
+    # overload: offered > capacity through the bounded front door —
+    # goodput, shed rate, TTFT p95 for the admitted traffic
+    print(json.dumps(bench_serve_burst(kv_cache_dtype="int8")), flush=True)
     print(json.dumps(bench_decode_attention()), flush=True)
 
 

@@ -86,12 +86,17 @@ def summarize(events):
         "resumes": [], "restarts": [],
         # serving vocabulary (docs/SERVING.md): admission / step / finish,
         # plus the prefix-cache / ragged-step columns (prompt tokens
-        # skipped via cache hits, real span tokens per dispatch)
+        # skipped via cache hits, real span tokens per dispatch) and the
+        # front-door robustness columns (preempt/restore/shed/isolation,
+        # per-tenant attribution)
         "serving": {"requests": 0, "prompt_lens": [], "steps": 0,
                     "step_ms": [], "tokens": 0, "max_active": 0,
                     "max_queue": 0, "max_kv_blocks": 0,
                     "finished": defaultdict(int), "req_ms": [],
-                    "cached_tokens": 0, "span_tokens": 0},
+                    "cached_tokens": 0, "span_tokens": 0,
+                    "preempts": 0, "restores": 0, "swapped_pages": 0,
+                    "sheds": defaultdict(int), "isolated": 0,
+                    "tenants": defaultdict(int)},
     }
     for e in events:
         kind = e.get("event")
@@ -131,6 +136,18 @@ def summarize(events):
             if e.get("prompt_len") is not None:
                 sv["prompt_lens"].append(e["prompt_len"])
             sv["cached_tokens"] += e.get("cached_tokens") or 0
+            if e.get("tenant"):
+                sv["tenants"][e["tenant"]] += 1
+        elif kind == "serve_preempt":
+            sv = agg["serving"]
+            sv["preempts"] += 1
+            sv["swapped_pages"] += e.get("pages") or 0
+        elif kind == "serve_restore":
+            agg["serving"]["restores"] += 1
+        elif kind == "serve_shed":
+            agg["serving"]["sheds"][e.get("reason") or "?"] += 1
+        elif kind == "serve_isolated_failure":
+            agg["serving"]["isolated"] += 1
         elif kind == "serve_step":
             sv = agg["serving"]
             sv["steps"] += 1
@@ -224,7 +241,7 @@ def render(agg, malformed=0):
                          f"| {agg['faults'].get(site, 0)} |")
         lines.append("")
     sv = agg["serving"]
-    if sv["requests"] or sv["steps"]:
+    if sv["requests"] or sv["steps"] or sv["sheds"] or sv["preempts"]:
         ms = sorted(sv["step_ms"])
         busy_s = sum(sv["step_ms"]) / 1e3
         agg_tps = (sv["tokens"] / busy_s) if busy_s else None
@@ -276,6 +293,23 @@ def render(agg, malformed=0):
             lines.append(f"| ragged occupancy p50 / p95 | "
                          f"{fmt(occ.get('p50'))} / {fmt(occ.get('p95'))} "
                          f"({sv['span_tokens']} span tokens) |")
+        # front-door robustness columns (docs/SERVING.md "Front door"):
+        # preemption/swap volume, shed reasons, isolation count, and
+        # per-tenant attribution — only when the run exercised them
+        if sv["preempts"] or sv["restores"]:
+            lines.append(f"| preempted / restored (pages swapped) | "
+                         f"{sv['preempts']} / {sv['restores']} "
+                         f"({sv['swapped_pages']}) |")
+        if sv["sheds"]:
+            shed = ", ".join(f"{n} {r}" for r, n in
+                             sorted(sv["sheds"].items()))
+            lines.append(f"| shed (by reason) | {shed} |")
+        if sv["isolated"]:
+            lines.append(f"| isolated failures | {sv['isolated']} |")
+        if sv["tenants"]:
+            ten = ", ".join(f"{t}: {n}" for t, n in
+                            sorted(sv["tenants"].items()))
+            lines.append(f"| requests by tenant | {ten} |")
         lines.append("")
     for r in agg["resumes"]:
         lines.append(f"**RESUME**: step {r.get('step')} from "
@@ -320,7 +354,8 @@ def render(agg, malformed=0):
     if not (steps or agg["spans"] or compiles or coll or storms
             or preemptions or agg["hangs"] or agg["postmortems"]
             or agg["retries"] or agg["faults"] or agg["resumes"]
-            or agg["restarts"] or sv["requests"] or sv["steps"]):
+            or agg["restarts"] or sv["requests"] or sv["steps"]
+            or sv["sheds"] or sv["preempts"]):
         lines.append("(no telemetry events found)")
     return "\n".join(lines)
 
@@ -363,7 +398,7 @@ def main(argv=None) -> int:
         "thread_stacks": len(agg["thread_stacks"]),
     }
     sv = agg["serving"]
-    if sv["requests"] or sv["steps"]:
+    if sv["requests"] or sv["steps"] or sv["sheds"] or sv["preempts"]:
         busy_s = sum(sv["step_ms"]) / 1e3
         m = agg["metrics"] or {}
         hits = m.get("serve.prefix_hits") or 0
@@ -393,6 +428,12 @@ def main(argv=None) -> int:
             "span_tokens": sv["span_tokens"],
             "ragged_occupancy_p50": occ.get("p50"),
             "ragged_occupancy_p95": occ.get("p95"),
+            "preempts": sv["preempts"],
+            "restores": sv["restores"],
+            "swapped_pages": sv["swapped_pages"],
+            "sheds": dict(sorted(sv["sheds"].items())),
+            "isolated_failures": sv["isolated"],
+            "tenants": dict(sorted(sv["tenants"].items())),
         }
     if agg["bench_result"] is not None:
         summary["bench_value"] = agg["bench_result"].get("value")
