@@ -1,0 +1,47 @@
+"""pdtpu-lint: framework-invariant static analysis (docs/ANALYSIS.md).
+
+An AST-based analyzer (stdlib ``ast`` only — importable and runnable
+with no jax on the box) that encodes the framework invariants this
+repo's hardest bugs have violated, as machine-checked rules:
+
+==================  =====================================================
+rule                invariant
+==================  =====================================================
+donation-safety     no reads of a buffer after it was donated to a
+                    compiled callable (the PR 1 read-after-free class)
+compat-symbol       version-moved jax symbols only via core/compat.py
+unguarded-telemetry observability/resilience hooks behind ONE falsy
+                    check outside their packages (zero-overhead
+                    contract)
+retrace-hazard      nothing feeds a compiled callable that defeats its
+                    cache (host scalars, jit-in-loop, mutable-global
+                    capture, unhashable statics)
+fault-site          fault sites exist in resilience.SITES and in the
+                    docs/RESILIENCE.md tables — both directions
+lock-discipline     ``# guarded_by:`` fields only touched under their
+                    lock or in ``# requires-lock:`` functions
+==================  =====================================================
+
+Suppress a deliberate violation inline::
+
+    ...  # pdtpu-lint: disable=<rule> — <why>
+
+Pre-existing findings live in ``tools/lint_baseline.json`` (matched by
+rule + file + source line text, so they survive line drift); the
+``lint`` CI gate (``python tools/ci.py --only lint``) fails on any NEW
+finding and warns on stale suppressions/baseline entries so the
+baseline only shrinks.  CLI: ``python tools/pdtpu_lint.py``.
+
+This package is deliberately NOT imported by ``paddle_tpu/__init__``:
+it is a dev tool, not user API, and it must load without jax.
+"""
+
+from __future__ import annotations
+
+from .core import Finding, ParsedFile  # noqa: F401
+from .driver import (DEFAULT_SCAN, LintResult, TreeContext,  # noqa: F401
+                     analyze, load_baseline)
+from .rules import ALL_RULES  # noqa: F401
+
+__all__ = ["Finding", "ParsedFile", "LintResult", "TreeContext",
+           "analyze", "load_baseline", "ALL_RULES", "DEFAULT_SCAN"]

@@ -1,0 +1,356 @@
+"""Shared machinery for the pdtpu-lint rule engine.
+
+Everything here is pure stdlib (``ast``, ``re``, ``dataclasses``) — the
+analyzer must run on a box with no jax installed in well under the CI
+gate's 30 s budget, so no rule may import ``paddle_tpu`` proper.  Facts
+about the runtime (the fault-site registry, the hook-container names)
+are recovered from the *scanned sources' ASTs*, never from imports.
+
+The pieces:
+
+- :class:`Finding` — one rule violation, with enough identity
+  (rule, file, source snippet) for baseline matching to survive line
+  drift.
+- :class:`ParsedFile` — a parsed module: AST with parent links,
+  raw lines, and the per-line ``# pdtpu-lint: disable=`` suppressions.
+- expression keys (:func:`expr_key`) — a stable dotted string for
+  ``Name``/``Attribute``/``[0]``-subscript chains (``self.kv.caches``,
+  ``_obs_state.EMIT[0]``) so rules can compare "the same place" across
+  statements without object identity.
+- guard analysis (:func:`is_guarded`) — whether a use site is dominated
+  by the one-falsy-check idiom (``if x is not None:`` /  ``if x:`` /
+  ``x.f() if x is not None else ...`` / an ``if x is None: return``
+  early exit), the contract the ``telemetry-overhead`` CI gate enforces
+  dynamically.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "Finding", "ParsedFile", "Suppression", "expr_key", "call_name",
+    "is_guarded", "enclosing_statement", "enclosing_function",
+    "stmt_position", "node_position", "int_literals",
+    "scope_walk",
+]
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*pdtpu-lint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at a source location.
+
+    ``snippet`` (the stripped source line) plus ``rule`` and ``path``
+    form the baseline identity: recorded findings keep matching after
+    unrelated edits move the line number."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+    suppressed: bool = False
+    baselined: bool = False
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+    def to_baseline_entry(self) -> Dict[str, object]:
+        return {"rule": self.rule, "file": self.path, "line": self.line,
+                "code": self.snippet}
+
+
+@dataclasses.dataclass
+class Suppression:
+    """One inline ``# pdtpu-lint: disable=<rules>`` comment."""
+
+    line: int
+    rules: Set[str]
+    used: bool = False
+
+
+class ParsedFile:
+    """One scanned module: source, AST (with ``.parent`` backlinks on
+    every node), and inline suppressions."""
+
+    def __init__(self, path: str, rel_path: str, source: str):
+        self.path = path
+        self.rel_path = rel_path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self._parents: Dict[int, ast.AST] = {}
+        # one walk, cached: every rule iterates the whole module and
+        # re-walking per rule dominated the analyzer's runtime
+        self.nodes: List[ast.AST] = list(ast.walk(self.tree))
+        for node in self.nodes:
+            for child in ast.iter_child_nodes(node):
+                self._parents[id(child)] = node
+        self._rule_cache: Dict[str, object] = {}
+        self.suppressions: List[Suppression] = []
+        for i, text in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")
+                         if r.strip()}
+                self.suppressions.append(Suppression(i, rules))
+
+    # -- navigation --------------------------------------------------------
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(id(node))
+
+    def parents(self, node: ast.AST) -> Iterable[ast.AST]:
+        p = self.parent(node)
+        while p is not None:
+            yield p
+            p = self.parent(p)
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    # -- findings ----------------------------------------------------------
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        f = Finding(rule=rule, path=self.rel_path, line=line, col=col,
+                    message=message, snippet=self.line_text(line))
+        sup = self._suppression_for(rule, node)
+        if sup is not None:
+            sup.used = True
+            f.suppressed = True
+        return f
+
+    def _suppression_for(self, rule: str,
+                         node: ast.AST) -> Optional[Suppression]:
+        """A finding is suppressed by a ``disable=`` comment on any line
+        of its enclosing statement, or on a standalone comment line
+        directly above it.  The line-above form deliberately requires a
+        comment-only line: a trailing comment on the PREVIOUS statement
+        must not leak onto this one and silently mask its findings."""
+        stmt = enclosing_statement(self, node) or node
+        lo = getattr(stmt, "lineno", getattr(node, "lineno", 1))
+        hi = getattr(stmt, "end_lineno", lo) or lo
+        for sup in self.suppressions:
+            if not (rule in sup.rules or "all" in sup.rules):
+                continue
+            if lo <= sup.line <= hi:
+                return sup
+            if sup.line == lo - 1 \
+                    and self.line_text(sup.line).startswith("#"):
+                return sup
+        return None
+
+
+# ---------------------------------------------------------------------------
+# expression identity
+# ---------------------------------------------------------------------------
+
+def expr_key(node: ast.AST) -> Optional[str]:
+    """Stable dotted key for a Name/Attribute/``[const]``-subscript
+    chain: ``self.kv.caches``, ``_obs_state.EMIT[0]``.  ``None`` for
+    anything whose identity a linear scan cannot track (call results,
+    arbitrary subscripts)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = expr_key(node.value)
+        return None if base is None else f"{base}.{node.attr}"
+    if isinstance(node, ast.Subscript):
+        base = expr_key(node.value)
+        if base is None:
+            return None
+        sl = node.slice
+        if isinstance(sl, ast.Constant) and isinstance(sl.value, int):
+            return f"{base}[{sl.value}]"
+        return None
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """Dotted name of a call's callee (``jax.jit``, ``obs.emit_event``)."""
+    return expr_key(node.func)
+
+
+def int_literals(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """An int, or tuple/list of ints, as a literal — the shapes
+    ``donate_argnums``/``static_argnums`` take.  None if not literal."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# positions / enclosing scopes
+# ---------------------------------------------------------------------------
+
+def node_position(node: ast.AST) -> Tuple[int, int]:
+    return (getattr(node, "lineno", 0), getattr(node, "col_offset", 0))
+
+
+def stmt_position(node: ast.AST) -> Tuple[int, int]:
+    """End position of a statement — loads *inside* the statement sort
+    before it, loads on later lines after it."""
+    return (getattr(node, "end_lineno", getattr(node, "lineno", 0)),
+            getattr(node, "end_col_offset", 0))
+
+
+def enclosing_statement(pf: ParsedFile,
+                        node: ast.AST) -> Optional[ast.AST]:
+    """The outermost simple statement containing ``node`` (the node
+    whose parent holds a statement list)."""
+    cur = node
+    for p in pf.parents(node):
+        if isinstance(p, (ast.Module, ast.FunctionDef,
+                          ast.AsyncFunctionDef, ast.ClassDef, ast.If,
+                          ast.For, ast.AsyncFor, ast.While, ast.With,
+                          ast.AsyncWith, ast.Try, ast.ExceptHandler)):
+            return cur
+        cur = p
+    return cur
+
+
+def scope_walk(scope: ast.AST) -> Iterable[ast.AST]:
+    """Walk ``scope``'s subtree EXCLUDING nested function/lambda bodies
+    (they are scopes of their own — ``ast.walk`` cannot prune)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(scope))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def enclosing_function(pf: ParsedFile, node: ast.AST):
+    for p in pf.parents(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return p
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the one-falsy-check guard idiom
+# ---------------------------------------------------------------------------
+
+def _test_implies_live(test: ast.AST, key: str) -> bool:
+    """Does ``test`` being truthy imply ``key`` is not None?"""
+    if expr_key(test) == key:                       # if x:
+        return True
+    if isinstance(test, ast.Compare) and len(test.ops) == 1:
+        left, op, right = test.left, test.ops[0], test.comparators[0]
+        if isinstance(op, ast.IsNot) and expr_key(left) == key \
+                and isinstance(right, ast.Constant) and right.value is None:
+            return True                             # if x is not None:
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+        return any(_test_implies_live(v, key) for v in test.values)
+    return False
+
+
+def _test_implies_dead(test: ast.AST, key: str) -> bool:
+    """Does ``test`` being truthy imply ``key`` IS None (so the else
+    branch / fallthrough has it live)?"""
+    if isinstance(test, ast.Compare) and len(test.ops) == 1:
+        left, op, right = test.left, test.ops[0], test.comparators[0]
+        if isinstance(op, ast.Is) and expr_key(left) == key \
+                and isinstance(right, ast.Constant) and right.value is None:
+            return True                             # if x is None:
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not) \
+            and expr_key(test.operand) == key:
+        return True                                 # if not x:
+    return False
+
+
+def _is_terminal(stmts: Sequence[ast.stmt]) -> bool:
+    """Does this block always leave the enclosing suite (return/raise/
+    continue/break as its last statement)?"""
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+def _early_exit_guards(pf: ParsedFile, node: ast.AST, key: str) -> bool:
+    """``if x is None: return`` (or raise/continue/break) earlier in any
+    enclosing suite puts every later statement on the not-None path."""
+    cur: ast.AST = node
+    for p in pf.parents(node):
+        for field in ("body", "orelse", "finalbody"):
+            suite = getattr(p, field, None)
+            if isinstance(suite, list) and cur in suite:
+                idx = suite.index(cur)
+                for prev in suite[:idx]:
+                    if isinstance(prev, ast.If) \
+                            and _test_implies_dead(prev.test, key) \
+                            and _is_terminal(prev.body):
+                        return True
+        cur = p
+    return False
+
+
+def is_guarded(pf: ParsedFile, node: ast.AST, key: str) -> bool:
+    """Is the use of ``key`` at ``node`` dominated by a falsy check —
+    the ``observability/_state.py`` contract?
+
+    Recognized forms (all of which appear in the live tree):
+
+    - ``if x is not None: <use>`` / ``if x: <use>``
+    - ``if x is not None and <more>: <use>``
+    - ``if x is None: ... else: <use>`` / ``if not x: ... else: <use>``
+    - ``<use> if x is not None else <fallback>`` (conditional expr)
+    - ``if x is None: return`` earlier in the suite (early exit)
+    - ``while <...> x is not None <...>: <use>``
+    """
+    child = node
+    for p in pf.parents(node):
+        if isinstance(p, ast.If) or isinstance(p, ast.While):
+            in_body = _contains(p.body, child)
+            in_orelse = _contains(getattr(p, "orelse", []), child)
+            if in_body and _test_implies_live(p.test, key):
+                return True
+            if in_orelse and isinstance(p, ast.If) \
+                    and _test_implies_dead(p.test, key):
+                return True
+        if isinstance(p, ast.IfExp):
+            if (p.body is child or _in_subtree(p.body, node)) \
+                    and _test_implies_live(p.test, key):
+                return True
+            if (p.orelse is child or _in_subtree(p.orelse, node)) \
+                    and _test_implies_dead(p.test, key):
+                return True
+        if isinstance(p, ast.BoolOp) and isinstance(p.op, ast.And):
+            # x is not None and x.f(): every operand after a live-check
+            # only evaluates when the check passed
+            for i, v in enumerate(p.values):
+                if (v is child or _in_subtree(v, node)) and any(
+                        _test_implies_live(u, key) for u in p.values[:i]):
+                    return True
+        child = p
+    return _early_exit_guards(pf, node, key)
+
+
+def _contains(suite: Sequence[ast.AST], node: ast.AST) -> bool:
+    return any(s is node for s in suite)
+
+
+def _in_subtree(root: ast.AST, node: ast.AST) -> bool:
+    return any(n is node for n in ast.walk(root))

@@ -1,0 +1,201 @@
+"""pdtpu-lint driver: scan a tree, run every rule, apply suppressions
+and the committed baseline.
+
+Two passes:
+
+1. **pre-pass** over all parsed files building the
+   :class:`TreeContext` — the fault-site registry (parsed from
+   ``resilience/faults.py``), the ``# guarded_by:`` field annotations
+   (tree-wide, so cross-module accesses are checked), and the
+   docs/RESILIENCE.md sites tables;
+2. **rule pass** per file, then the tree-level docs↔registry
+   consistency check.
+
+Pure stdlib; jax is never imported (the ``lint`` CI gate asserts it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .core import Finding, ParsedFile
+from .rules import ALL_RULES
+from .rules import fault_sites as _fault_sites
+from .rules import locks as _locks
+
+__all__ = ["TreeContext", "LintResult", "analyze", "load_baseline",
+           "DEFAULT_SCAN", "FAULTS_PY", "RESILIENCE_DOC"]
+
+#: repo-relative roots scanned by default (tests are exempt — they
+#: deliberately poke the internals every rule exists to protect)
+DEFAULT_SCAN = ("paddle_tpu", "tools", "examples", "bench.py")
+FAULTS_PY = os.path.join("paddle_tpu", "resilience", "faults.py")
+RESILIENCE_DOC = os.path.join("docs", "RESILIENCE.md")
+
+
+@dataclasses.dataclass
+class TreeContext:
+    """Cross-file facts shared with every rule's ``check(pf, ctx)``."""
+
+    root: str
+    fault_sites: Tuple[str, ...] = ()
+    fault_excs: Tuple[str, ...] = ()
+    guarded_fields: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]          # new, actionable (exit-1) findings
+    suppressed: List[Finding]
+    baselined: List[Finding]
+    stale_suppressions: List[str]    # warnings, never failures
+    stale_baseline: List[str]
+    errors: List[str]                # unparsable files
+    files_scanned: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.errors
+
+
+def _iter_py_files(root: str, paths: Sequence[str]):
+    for p in paths:
+        full = os.path.join(root, p)
+        if os.path.isfile(full) and full.endswith(".py"):
+            yield full
+        elif os.path.isdir(full):
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__")
+                for f in sorted(filenames):
+                    if f.endswith(".py"):
+                        yield os.path.join(dirpath, f)
+
+
+def load_baseline(path: str) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    return list(data.get("findings", data) if isinstance(data, dict)
+                else data)
+
+
+def _baseline_match(entry: dict, finding: Finding) -> bool:
+    return entry.get("rule") == finding.rule \
+        and entry.get("file") == finding.path \
+        and entry.get("code", "") == finding.snippet
+
+
+def analyze(root: str, paths: Optional[Sequence[str]] = None,
+            baseline: Optional[List[dict]] = None,
+            rules: Optional[Sequence[str]] = None) -> LintResult:
+    """Run the analyzer over ``paths`` (repo-relative) under ``root``."""
+    paths = list(paths) if paths else list(DEFAULT_SCAN)
+    baseline = list(baseline or [])
+    active = {r: m for r, m in ALL_RULES.items()
+              if rules is None or r in rules}
+
+    parsed: List[ParsedFile] = []
+    errors: List[str] = []
+    for full in _iter_py_files(root, paths):
+        rel = os.path.relpath(full, root).replace(os.sep, "/")
+        try:
+            with open(full, encoding="utf-8") as f:
+                src = f.read()
+            parsed.append(ParsedFile(full, rel, src))
+        except (SyntaxError, UnicodeDecodeError) as e:
+            errors.append(f"{rel}: unparsable: {e}")
+
+    ctx = TreeContext(root=root)
+    faults_file = os.path.join(root, FAULTS_PY)
+    if os.path.exists(faults_file):
+        with open(faults_file, encoding="utf-8") as f:
+            ctx.fault_sites, ctx.fault_excs = \
+                _fault_sites.extract_registry(f.read())
+    for pf in parsed:
+        ctx.guarded_fields.update(_locks.extract_guarded_fields(pf))
+
+    all_findings: List[Finding] = []
+    for pf in parsed:
+        for rule_id, mod in active.items():
+            all_findings.extend(mod.check(pf, ctx))
+
+    # tree-level: docs/RESILIENCE.md sites tables ↔ resilience.SITES
+    if "fault-site" in active and ctx.fault_sites:
+        all_findings.extend(_docs_consistency(root, ctx))
+
+    findings, suppressed, baselined = [], [], []
+    used_baseline = [False] * len(baseline)
+    for f in all_findings:
+        if f.suppressed:
+            suppressed.append(f)
+            continue
+        hit = next((i for i, e in enumerate(baseline)
+                    if not used_baseline[i] and _baseline_match(e, f)),
+                   None)
+        if hit is not None:
+            used_baseline[hit] = True
+            f.baselined = True
+            baselined.append(f)
+        else:
+            findings.append(f)
+
+    # a suppression is only provably stale when every rule it names
+    # actually ran this pass — under a --rules subset the others were
+    # never evaluated, and "remove the comment" advice would break the
+    # next full gate run
+    checked = set(active)
+    all_ran = set(active) == set(ALL_RULES)
+    stale_sup = []
+    for pf in parsed:
+        for sup in pf.suppressions:
+            evaluated = all_ran if "all" in sup.rules \
+                else sup.rules <= checked
+            if not sup.used and evaluated:
+                stale_sup.append(
+                    f"{pf.rel_path}:{sup.line}: stale suppression "
+                    f"(disable={','.join(sorted(sup.rules))}) — no "
+                    "finding matches it any more; remove the comment")
+    stale_base = [
+        f"baseline entry matches no finding any more — drop it: "
+        f"{e.get('rule')} @ {e.get('file')}: {e.get('code', '')!r}"
+        for i, e in enumerate(baseline) if not used_baseline[i]]
+
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return LintResult(findings=findings, suppressed=suppressed,
+                      baselined=baselined, stale_suppressions=stale_sup,
+                      stale_baseline=stale_base, errors=errors,
+                      files_scanned=len(parsed))
+
+
+def _docs_consistency(root: str, ctx: TreeContext) -> List[Finding]:
+    doc_rel = RESILIENCE_DOC.replace(os.sep, "/")
+    doc_path = os.path.join(root, RESILIENCE_DOC)
+    out: List[Finding] = []
+    if not os.path.exists(doc_path):
+        return out
+    with open(doc_path, encoding="utf-8") as f:
+        text = f.read()
+    doc_sites = _fault_sites.extract_doc_sites(text)
+    doc_names = {s for s, _ in doc_sites}
+    for site, line in doc_sites:
+        if site not in ctx.fault_sites:
+            out.append(Finding(
+                rule="fault-site", path=doc_rel, line=line, col=0,
+                message=f"docs table lists {site!r} which is not in "
+                        "resilience.SITES — stale doc or missing "
+                        "registration",
+                snippet=text.splitlines()[line - 1].strip()))
+    for site in ctx.fault_sites:
+        if site not in doc_names:
+            out.append(Finding(
+                rule="fault-site", path=doc_rel, line=1, col=0,
+                message=f"registered site {site!r} is missing from the "
+                        f"sites tables in {doc_rel} — document where it "
+                        "fires and what recovery looks like",
+                snippet="(sites tables)"))
+    return out

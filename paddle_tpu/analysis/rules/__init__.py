@@ -1,0 +1,25 @@
+"""pdtpu-lint rules.
+
+Each rule module exposes ``RULE`` (its id) and ``check(pf, ctx)``
+yielding :class:`~..core.Finding`s.  ``ctx`` is the
+:class:`~..driver.TreeContext` — cross-file facts (the fault-site
+registry parsed out of ``resilience/faults.py``, the ``guarded_by``
+field annotations) collected in the driver's pre-pass.
+"""
+
+from __future__ import annotations
+
+from . import (compat, donation, fault_sites, locks,  # noqa: F401
+               retrace, telemetry)
+
+#: rule id → module, in report order
+ALL_RULES = {
+    donation.RULE: donation,
+    compat.RULE: compat,
+    telemetry.RULE: telemetry,
+    retrace.RULE: retrace,
+    fault_sites.RULE: fault_sites,
+    locks.RULE: locks,
+}
+
+__all__ = ["ALL_RULES"]

@@ -1,0 +1,186 @@
+"""Shared discovery of jit-compiled callables in one module.
+
+Both ``donation-safety`` and ``retrace-hazard`` need to know, per
+module, *which names are compiled callables* and with what
+``donate_argnums``/``static_argnums``.  The forms recognized (all live
+in this tree):
+
+- ``f = jax.jit(fn, donate_argnums=(2,))``            (local/module name)
+- ``self._step_fn = jax.jit(step_fn, donate_argnums=(1,))``
+  (instance attribute — registered class-wide, so a call in another
+  method of the same class resolves)
+- ``@jax.jit`` / ``@functools.partial(jax.jit, static_argnames=...)``
+  decorated defs (``static_argnames`` are resolved to positions against
+  the wrapped def's signature; unresolvable names set
+  ``static_unknown`` so rules stay silent rather than misclassify)
+- ``g = f.lower(...).compile()`` — the AOT executable inherits ``f``'s
+  donation vector
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from ..core import ParsedFile, call_name, expr_key, int_literals
+
+__all__ = ["JittedCallable", "discover", "jit_call_of"]
+
+_JIT_NAMES = ("jax.jit", "jit", "pjit", "jax.pjit")
+
+
+@dataclasses.dataclass
+class JittedCallable:
+    """One known-compiled callable binding."""
+
+    key: str                     # expr key it is bound to (may be self.X)
+    donate: Tuple[int, ...]      # donated positional indices
+    static: Tuple[int, ...]      # static positional indices
+    node: ast.AST                # the jax.jit(...) call (or def) site
+    wrapped: Optional[str] = None   # name of the wrapped function, if a Name
+    # static_argnames present but the named positions could not be
+    # resolved (no visible wrapped def): rules must not classify any
+    # position of this callable as traced-vs-static
+    static_unknown: bool = False
+
+
+def jit_call_of(node: ast.AST) -> Optional[ast.Call]:
+    """``node`` as a ``jax.jit(...)``/``jit(...)`` call, else None."""
+    if isinstance(node, ast.Call) and call_name(node) in _JIT_NAMES:
+        return node
+    return None
+
+
+def _argnums(call: ast.Call, kw_name: str) -> Tuple[int, ...]:
+    for kw in call.keywords:
+        if kw.arg == kw_name:
+            lits = int_literals(kw.value)
+            return lits if lits is not None else ()
+    return ()
+
+
+def _argnames(call: ast.Call, kw_name: str) -> Optional[Tuple[str, ...]]:
+    """String-literal tuple/list (or single string) of ``kw_name``;
+    None when the keyword is absent, () when present but non-literal."""
+    for kw in call.keywords:
+        if kw.arg != kw_name:
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in v.elts):
+            return tuple(e.value for e in v.elts)
+        return ()
+    return None
+
+
+def _resolve_static(call: ast.Call, fn_def) -> Tuple[Tuple[int, ...], bool]:
+    """(static positional indices, unknown?) from static_argnums and/or
+    static_argnames, resolving names against ``fn_def``'s parameters."""
+    static = list(_argnums(call, "static_argnums"))
+    names = _argnames(call, "static_argnames")
+    unknown = False
+    if names:
+        if fn_def is not None:
+            params = [a.arg for a in fn_def.args.args]
+            for n in names:
+                if n in params:
+                    static.append(params.index(n))
+                else:
+                    unknown = True      # kw-only / unknown name
+        else:
+            unknown = True              # no visible signature to map
+    return tuple(sorted(set(static))), unknown
+
+
+def _normalize_key(target: ast.AST) -> Optional[str]:
+    """Binding key for a jit assignment target.  Instance attributes
+    are normalized to ``self.<attr>`` so discovery in ``__init__`` /
+    ``_build_fns`` matches calls in other methods of the class."""
+    key = expr_key(target)
+    if key is None:
+        return None
+    parts = key.split(".")
+    if parts[0] == "self" and len(parts) == 2:
+        return key
+    return key
+
+
+def discover(pf: ParsedFile) -> Dict[str, JittedCallable]:
+    """All jit-compiled callable bindings in the module, keyed by the
+    expression they are bound to.  Memoized per file — both the
+    donation and retrace rules need it."""
+    cached = pf._rule_cache.get("jit")
+    if cached is not None:
+        return cached
+    found: Dict[str, JittedCallable] = {}
+    defs_by_name = {n.name: n for n in reversed(pf.nodes)
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))}
+
+    for node in pf.nodes:
+        # f = jax.jit(...) / self.f = jax.jit(...)
+        if isinstance(node, ast.Assign):
+            call = jit_call_of(node.value)
+            if call is not None:
+                wrapped = None
+                if call.args and isinstance(call.args[0], ast.Name):
+                    wrapped = call.args[0].id
+                static, unknown = _resolve_static(
+                    call, defs_by_name.get(wrapped))
+                for tgt in node.targets:
+                    key = _normalize_key(tgt)
+                    if key is not None:
+                        found[key] = JittedCallable(
+                            key, _argnums(call, "donate_argnums"),
+                            static, call, wrapped,
+                            static_unknown=unknown)
+            continue
+        # @jax.jit / @functools.partial(jax.jit, ...) decorated defs
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                donate: Tuple[int, ...] = ()
+                static: Tuple[int, ...] = ()
+                unknown = False
+                hit = False
+                if expr_key(dec) in _JIT_NAMES:
+                    hit = True
+                elif isinstance(dec, ast.Call) and (
+                        call_name(dec) in _JIT_NAMES
+                        or (call_name(dec) in ("functools.partial",
+                                               "partial")
+                            and dec.args
+                            and expr_key(dec.args[0]) in _JIT_NAMES)):
+                    hit = True
+                    donate = _argnums(dec, "donate_argnums")
+                    static, unknown = _resolve_static(dec, node)
+                if hit:
+                    found[node.name] = JittedCallable(
+                        node.name, donate, static, node, node.name,
+                        static_unknown=unknown)
+                    break
+
+    # g = f.lower(...).compile(): inherit f's donation vector
+    for node in pf.nodes:
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        if isinstance(v, ast.Call) and isinstance(v.func, ast.Attribute) \
+                and v.func.attr == "compile" \
+                and isinstance(v.func.value, ast.Call) \
+                and isinstance(v.func.value.func, ast.Attribute) \
+                and v.func.value.func.attr == "lower":
+            src = _normalize_key(v.func.value.func.value)
+            if src in found:
+                for tgt in node.targets:
+                    key = _normalize_key(tgt)
+                    if key is not None:
+                        found[key] = JittedCallable(
+                            key, found[src].donate, found[src].static,
+                            v, found[src].wrapped,
+                            static_unknown=found[src].static_unknown)
+    pf._rule_cache["jit"] = found
+    return found

@@ -1,0 +1,244 @@
+"""donation-safety: no reads of a donated buffer after its dispatch.
+
+The PR 1 crash class: ``jax.jit(..., donate_argnums=...)`` hands the
+argument's buffer to XLA — after the call the Python object still
+exists but its memory is gone (or reused as the output).  Reading it —
+directly, or through an ``np.asarray``/zero-copy view taken earlier —
+segfaults on CPU and silently corrupts on TPU.  The seed hit this twice
+(donated TrainStep state read on resume; ``np.asarray`` views of
+donated params), both fixed dynamically in PR 1; this rule catches the
+pattern at review time.
+
+What is checked, per function scope, in source order:
+
+1. a call to a known-donating callable (``_jit.discover``: local /
+   ``self.``-bound ``jax.jit(..., donate_argnums=...)`` results and
+   their ``.lower().compile()`` executables) *poisons* the expression
+   keys passed at the donated positional indices (``self.kv.caches``,
+   ``state``) — plus any alias previously taken from them via plain
+   assignment or ``np.asarray``/``jnp.asarray`` (the view class);
+2. a later load of a poisoned key (or any deeper path under it) is a
+   finding;
+3. a store to the key (or a prefix of it) un-poisons — the normal
+   ``self.kv.caches = self._step_fn(..., self.kv.caches, ...)`` /
+   ``new, _ = f(state); state = new`` lifecycle never fires.
+
+The scan is linear in line order, refined with suite ordering: a read
+only counts as "after" a dispatch when their deepest common suite runs
+the read's statement strictly later (so the two arms of an ``if``/
+``else`` never poison each other), and the ``x = f(x)`` rebind idiom —
+a store to the donated key in the dispatch statement itself — clears
+the poison immediately.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..core import (Finding, ParsedFile, call_name, expr_key,
+                    enclosing_statement, node_position, stmt_position)
+from . import _jit
+
+RULE = "donation-safety"
+
+_VIEW_CALLS = ("np.asarray", "jnp.asarray", "numpy.asarray", "asarray")
+
+
+def _functions(pf: ParsedFile) -> Iterable[ast.AST]:
+    for node in pf.nodes:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _store_keys(stmt: ast.AST) -> List[str]:
+    """Expression keys (re)bound by a statement."""
+    keys: List[str] = []
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.With):
+        targets = [i.optional_vars for i in stmt.items
+                   if i.optional_vars is not None]
+    elif isinstance(stmt, ast.Delete):
+        targets = list(stmt.targets)
+    for tgt in targets:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                k = expr_key(elt)
+                if k is not None:
+                    keys.append(k)
+        else:
+            k = expr_key(tgt)
+            if k is not None:
+                keys.append(k)
+    return keys
+
+
+def _covers(stored: str, poisoned: str) -> bool:
+    """Does a store to ``stored`` re-materialize ``poisoned``?"""
+    return poisoned == stored or poisoned.startswith(stored + ".") \
+        or poisoned.startswith(stored + "[")
+
+
+def _under(key: str, poisoned: str) -> bool:
+    """Is a load of ``key`` a read of (or through) ``poisoned``?"""
+    return key == poisoned or key.startswith(poisoned + ".") \
+        or key.startswith(poisoned + "[")
+
+
+def check(pf: ParsedFile, ctx) -> Iterable[Finding]:
+    jitted = _jit.discover(pf)
+    donating = {k: j for k, j in jitted.items() if j.donate}
+    if not donating:
+        return
+    for fn in _functions(pf):
+        yield from _check_function(pf, fn, donating)
+
+
+def _stmt_chain(pf: ParsedFile, node: ast.AST, fn: ast.AST) -> List[ast.stmt]:
+    """Statement ancestors of ``node`` inside ``fn``, outermost first."""
+    chain: List[ast.stmt] = []
+    if isinstance(node, ast.stmt):
+        chain.append(node)
+    for p in pf.parents(node):
+        if p is fn:
+            break
+        if isinstance(p, ast.stmt):
+            chain.append(p)
+    chain.reverse()
+    return chain
+
+
+def _suite_of(pf: ParsedFile, stmt: ast.stmt):
+    """(field name, index) of ``stmt`` in its parent's suite."""
+    p = pf.parent(stmt)
+    for field in ("body", "orelse", "finalbody"):
+        suite = getattr(p, field, None)
+        if isinstance(suite, list):
+            for i, s in enumerate(suite):
+                if s is stmt:
+                    return field, i
+    return None, -1
+
+
+def _ordered_after(pf: ParsedFile, fn: ast.AST, dispatch: ast.AST,
+                   load: ast.AST) -> bool:
+    """Does ``load`` execute after ``dispatch`` on a straight-line
+    reading?  True only when their deepest common suite runs the load's
+    statement strictly later — sibling branches of one ``if`` (and the
+    dispatch statement itself) never count."""
+    dc = _stmt_chain(pf, dispatch, fn)
+    lc = _stmt_chain(pf, load, fn)
+    if dc and isinstance(dc[-1], (ast.Return, ast.Raise)):
+        return False    # control leaves the function at the dispatch
+    for ds, ls in zip(dc, lc):
+        if ds is ls:
+            continue
+        if pf.parent(ds) is not pf.parent(ls):
+            return False        # e.g. try body vs except handler
+        d_field, d_i = _suite_of(pf, ds)
+        l_field, l_i = _suite_of(pf, ls)
+        return d_field == l_field and l_i > d_i
+    return False        # one contains the other (same statement)
+
+
+def _check_function(pf: ParsedFile, fn: ast.AST,
+                    donating) -> Iterable[Finding]:
+    # gather events in source order
+    loads: List[Tuple[Tuple[int, int], str, ast.AST]] = []
+    stores: List[Tuple[Tuple[int, int], str]] = []
+    aliases: List[Tuple[Tuple[int, int], str, str]] = []  # (pos, alias, src)
+    dispatches = []   # (poison_pos, donated_keys, callee_key, call_node)
+
+    own_stmts = set()
+    for node in ast.walk(fn):
+        if node is fn:
+            continue
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # nested scopes get their own pass / are opaque
+            for sub in ast.walk(node):
+                own_stmts.add(id(sub))
+            continue
+        if id(node) in own_stmts:
+            continue
+        if isinstance(node, (ast.Name, ast.Attribute)) \
+                and isinstance(getattr(node, "ctx", None), ast.Load):
+            # record only the outermost chain: for snap.sum both the
+            # Name and the Attribute would otherwise double-report
+            if not isinstance(pf.parent(node), ast.Attribute):
+                k = expr_key(node)
+                if k is not None:
+                    loads.append((node_position(node), k, node))
+        if isinstance(node, ast.stmt):
+            stmt_end = stmt_position(node)
+            for k in _store_keys(node):
+                stores.append((stmt_end, k))
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt_key = expr_key(node.targets[0])
+                src_key = None
+                v = node.value
+                if expr_key(v) is not None:
+                    src_key = expr_key(v)
+                elif isinstance(v, ast.Call) \
+                        and call_name(v) in _VIEW_CALLS and v.args:
+                    src_key = expr_key(v.args[0])
+                if tgt_key and src_key:
+                    aliases.append((stmt_end, tgt_key, src_key))
+        if isinstance(node, ast.Call):
+            callee = expr_key(node.func)
+            j = donating.get(callee) if callee else None
+            if j is not None:
+                keys = []
+                for idx in j.donate:
+                    if idx < len(node.args):
+                        k = expr_key(node.args[idx])
+                        if k is not None:
+                            keys.append((idx, k))
+                if keys:
+                    stmt = enclosing_statement(pf, node) or node
+                    dispatches.append((stmt_position(stmt), keys,
+                                       callee, node))
+
+    for poison_pos, keys, callee, call in dispatches:
+        for idx, key in keys:
+            # aliases of the donated key taken BEFORE the dispatch are
+            # views of the same buffer
+            poisoned = {key}
+            for apos, alias, src in aliases:
+                if apos <= poison_pos and _under(src, key):
+                    poisoned.add(alias)
+            for pkey in poisoned:
+                # the x = f(x) rebind idiom: a store in the dispatch
+                # statement itself (spos == poison_pos) clears the key
+                kill = min((spos for spos, skey in stores
+                            if spos >= poison_pos and _covers(skey, pkey)),
+                           default=(1 << 30, 0))
+                if kill == poison_pos:
+                    continue
+                for lpos, lkey, lnode in loads:
+                    if poison_pos < lpos < kill and _under(lkey, pkey) \
+                            and _ordered_after(pf, fn, call, lnode):
+                        via = "" if pkey == key else \
+                            f" (a view of it taken at line " \
+                            f"{_alias_line(aliases, pkey)})"
+                        yield pf.finding(
+                            RULE, lnode,
+                            f"'{lkey}' is read after being donated to "
+                            f"'{callee}' (donate_argnums position {idx}, "
+                            f"dispatched at line {call.lineno}){via} — "
+                            "the buffer is dead after dispatch; rebind "
+                            "the result first (read-after-free, the PR 1 "
+                            "crash class)")
+
+
+def _alias_line(aliases, alias_key: str) -> int:
+    for (line, _col), a, _s in aliases:
+        if a == alias_key:
+            return line
+    return 0

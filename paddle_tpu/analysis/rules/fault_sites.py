@@ -1,0 +1,192 @@
+"""fault-site: every named fault site exists — in code AND in the docs.
+
+``resilience.SITES`` is the single registry of injectable fault sites;
+a plan naming anything else is rejected at parse time, but a *producer*
+calling the injector with a typo'd site (or a doc table drifting from
+the registry) fails silently — the plan simply never fires and a chaos
+run quietly loses coverage.  This rule pins all three surfaces to the
+registry, which it recovers by PARSING ``resilience/faults.py`` (no
+import — the analyzer must run jax-free):
+
+- string literals fired at the injector (calls of a name bound from
+  ``FAULTS[0]``, or of a callee named ``maybe_fault``/``_fault``) must
+  be registered sites;
+- fault-plan spec literals (``install_faults("step@3")``,
+  ``parse_faults(...)``, ``FaultPlan("site", ...)``, and literal
+  ``PDTPU_FAULTS`` env assignments) must parse under the grammar and
+  name only registered sites and whitelisted exception types;
+- ``site=`` keyword literals that LOOK like registry sites (a
+  ``ckpt.``/``store.``/``serve.`` prefix, or exactly ``step``/
+  ``collective``) must be registered — free-form retry labels
+  (``site="supervisor"``) stay allowed;
+- the sites tables in ``docs/RESILIENCE.md`` must list exactly the
+  registered sites (both directions).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional, Set
+
+from ..core import Finding, ParsedFile, call_name, expr_key
+
+RULE = "fault-site"
+
+_ENTRY_RE = re.compile(r"^(?P<site>[\w.]+)@(?P<at>\d+)(?:x(?P<times>\d+))?$")
+_SITE_LIKE = re.compile(r"^(ckpt|store|serve)\.[\w.]+$|^(step|collective)$")
+_INJECTOR_CALLEES = ("maybe_fault", "_fault")
+_PLAN_CALLEES = ("install_faults", "parse_faults")
+
+
+def extract_registry(source: str):
+    """``(SITES, exception names)`` parsed out of faults.py's AST."""
+    tree = ast.parse(source)
+    sites: List[str] = []
+    excs: List[str] = []
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            name = stmt.targets[0].id
+            if name == "SITES" and isinstance(stmt.value,
+                                              (ast.Tuple, ast.List)):
+                sites = [e.value for e in stmt.value.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, str)]
+            elif name == "_EXC_NAMES" and isinstance(stmt.value, ast.Dict):
+                excs = [k.value for k in stmt.value.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)]
+    return tuple(sites), tuple(excs)
+
+
+def extract_doc_sites(doc_text: str):
+    """Site names from the ``| site | ... |`` tables in
+    docs/RESILIENCE.md: ``[(site, line)]``."""
+    out = []
+    in_table = False
+    for i, line in enumerate(doc_text.splitlines(), start=1):
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            in_table = False
+            continue
+        cells = [c.strip() for c in stripped.strip("|").split("|")]
+        if not cells:
+            continue
+        first = cells[0].lower()
+        if first == "site":
+            in_table = True
+            continue
+        if in_table:
+            if set(first) <= {"-", " ", ":"}:
+                continue
+            for tok in re.findall(r"`([\w.]+)`", cells[0]):
+                out.append((tok, i))
+    return out
+
+
+def _spec_findings(pf: ParsedFile, node: ast.AST, spec: str,
+                   sites, excs) -> Iterable[Finding]:
+    for entry in re.split(r"[,;]", spec):
+        entry = entry.strip()
+        if not entry:
+            continue
+        head, _, exc_name = entry.partition(":")
+        m = _ENTRY_RE.match(head.strip())
+        if m is None:
+            yield pf.finding(
+                RULE, node,
+                f"fault spec entry {entry!r} does not parse "
+                "(grammar: site@index[xTimes][:ExcName])")
+            continue
+        if m.group("site") not in sites:
+            yield pf.finding(
+                RULE, node,
+                f"fault spec names unregistered site "
+                f"{m.group('site')!r} — registered: "
+                f"{', '.join(sites)} (resilience/faults.py SITES)")
+        if exc_name and exc_name.strip() not in excs:
+            yield pf.finding(
+                RULE, node,
+                f"fault spec names unknown exception "
+                f"{exc_name.strip()!r} — allowed: {', '.join(excs)}")
+
+
+def _literal_strings(node: ast.AST) -> List[ast.Constant]:
+    """String constants reachable through trivial expressions (a bare
+    literal, or both arms of a conditional expression)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node]
+    if isinstance(node, ast.IfExp):
+        return _literal_strings(node.body) + _literal_strings(node.orelse)
+    return []
+
+
+def check(pf: ParsedFile, ctx) -> Iterable[Finding]:
+    sites = ctx.fault_sites
+    excs = ctx.fault_excs
+    if not sites:
+        return
+
+    # which locals are FAULTS[0] bindings, per scope — collected
+    # module-wide (the binding and the call share a function in every
+    # real producer, and a name bound from FAULTS[0] anywhere is an
+    # injector by construction)
+    injector_names: Set[str] = set()
+    for node in pf.nodes:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            key = expr_key(node.value)
+            if key is not None and key.endswith("FAULTS[0]"):
+                injector_names.add(node.targets[0].id)
+
+    for node in pf.nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        cn = call_name(node)
+        short = cn.split(".")[-1] if cn else ""
+        callee_is_injector = (
+            (isinstance(node.func, ast.Name)
+             and node.func.id in injector_names)
+            or short in _INJECTOR_CALLEES)
+        if callee_is_injector and node.args:
+            for lit in _literal_strings(node.args[0]):
+                if lit.value not in sites:
+                    yield pf.finding(
+                        RULE, lit,
+                        f"injector fired at unregistered site "
+                        f"{lit.value!r} — the plan can never match; "
+                        f"registered: {', '.join(sites)}")
+        if short in _PLAN_CALLEES and node.args:
+            for lit in _literal_strings(node.args[0]):
+                yield from _spec_findings(pf, lit, lit.value, sites, excs)
+        if short == "FaultPlan" and node.args:
+            for lit in _literal_strings(node.args[0]):
+                if lit.value not in sites:
+                    yield pf.finding(
+                        RULE, lit,
+                        f"FaultPlan site {lit.value!r} is not "
+                        f"registered; registered: {', '.join(sites)}")
+        for kw in node.keywords:
+            if kw.arg == "site":
+                for lit in _literal_strings(kw.value):
+                    if _SITE_LIKE.match(lit.value) \
+                            and lit.value not in sites:
+                        yield pf.finding(
+                            RULE, lit,
+                            f"site={lit.value!r} looks like a fault "
+                            "site but is not in resilience.SITES — "
+                            "typo, or register it")
+
+    # literal PDTPU_FAULTS env assignments
+    for node in pf.nodes:
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript) \
+                        and isinstance(tgt.slice, ast.Constant) \
+                        and tgt.slice.value == "PDTPU_FAULTS":
+                    yield from _spec_findings(pf, node.value,
+                                              node.value.value,
+                                              sites, excs)

@@ -1,0 +1,108 @@
+"""lock-discipline: ``# guarded_by:`` fields are only touched under
+their lock.
+
+The serving stack crosses threads in exactly one place — HTTP handler
+threads submit/route while ONE engine-loop thread drives the step
+(``serving/server.py``) — and the shared mutable state is serialized by
+``ServingServer._lock``.  That contract lived only in a docstring; now
+it is machine-checked, Clang-thread-safety style:
+
+- a field annotated on its assignment line with
+  ``# guarded_by: <lock>`` may only be loaded/stored
+
+  * inside a ``with <...>.<lock>:`` block (any receiver — the analyzer
+    matches the lock by its final attribute name),
+  * inside a function annotated ``# requires-lock: <lock>`` (on the
+    ``def`` line or the line above): the documented "caller must hold
+    it / externally serialize" contract — e.g. every ``FrontDoor`` and
+    ``Engine`` entry point, which the server only ever calls under its
+    lock,
+  * or inside ``__init__`` (construction precedes sharing).
+
+Annotations are collected tree-wide in the driver pre-pass, so a module
+reaching into another module's annotated field (``eng._states`` from
+``frontdoor.py``) is checked too.  Fields are matched by attribute
+name; keep annotated names unique across the tree (they are all
+``_``-private today).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, Optional
+
+from ..core import Finding, ParsedFile, expr_key
+
+RULE = "lock-discipline"
+
+GUARDED_RE = re.compile(r"#\s*guarded_by:\s*([A-Za-z_][\w]*)")
+REQUIRES_RE = re.compile(r"#\s*requires-lock:\s*([A-Za-z_][\w]*)")
+
+
+def extract_guarded_fields(pf: ParsedFile) -> Dict[str, str]:
+    """``self.<field> = ...  # guarded_by: <lock>`` lines → field→lock."""
+    fields: Dict[str, str] = {}
+    ann_lines = {}
+    for i, text in enumerate(pf.lines, start=1):
+        m = GUARDED_RE.search(text)
+        if m:
+            ann_lines[i] = m.group(1)
+    if not ann_lines:
+        return fields
+    for node in pf.nodes:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            end = getattr(node, "end_lineno", node.lineno) or node.lineno
+            lock = next((ann_lines[ln] for ln in range(node.lineno, end + 1)
+                         if ln in ann_lines), None)
+            if lock is None:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                if isinstance(tgt, ast.Attribute):
+                    fields[tgt.attr] = lock
+    return fields
+
+
+def _requires_lock(pf: ParsedFile, fn: ast.AST) -> Optional[str]:
+    for line in (fn.lineno, fn.lineno - 1):
+        m = REQUIRES_RE.search(pf.line_text(line))
+        if m:
+            return m.group(1)
+    return None
+
+
+def _with_lock_names(node: ast.With):
+    for item in node.items:
+        key = expr_key(item.context_expr)
+        if key is not None:
+            yield key.rsplit(".", 1)[-1]
+
+
+def check(pf: ParsedFile, ctx) -> Iterable[Finding]:
+    fields = ctx.guarded_fields
+    if not fields:
+        return
+    for node in pf.nodes:
+        if not isinstance(node, ast.Attribute) or node.attr not in fields:
+            continue
+        lock = fields[node.attr]
+        ok = False
+        for p in pf.parents(node):
+            if isinstance(p, (ast.With, ast.AsyncWith)) \
+                    and lock in _with_lock_names(p):
+                ok = True
+                break
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if p.name == "__init__" or _requires_lock(pf, p) == lock:
+                    ok = True
+                break
+        if not ok:
+            kind = "written" if isinstance(node.ctx, ast.Store) else "read"
+            yield pf.finding(
+                RULE, node,
+                f"'{node.attr}' is guarded_by '{lock}' but {kind} "
+                f"outside a 'with ...{lock}:' block (and the enclosing "
+                f"function does not declare '# requires-lock: {lock}') "
+                "— cross-thread access without the lock")

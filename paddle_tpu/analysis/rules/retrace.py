@@ -1,0 +1,144 @@
+"""retrace-hazard: nothing flows into a compiled callable that defeats
+its cache.
+
+The serving/TrainStep zero-recompile contract (the recompile sentinel's
+bug class, PR 1/5): ONE compiled program per callable, re-dispatched
+forever.  Four statically-checkable ways the tree has (nearly) broken
+that:
+
+- **R4a host-scalar feed**: ``float(x)`` / ``int(x)`` / ``x.item()`` /
+  ``x.tolist()`` used directly as an argument to a known-jitted
+  callable — a device→host sync on the hot path, and if the position is
+  (or later becomes) static, a retrace per VALUE.
+- **R4b jit-in-loop**: ``jax.jit(...)`` called inside a ``for``/
+  ``while`` body — a fresh callable (fresh cache) per iteration; every
+  dispatch recompiles.  Memoize the jitted callable outside the loop
+  (the ``generation.py`` ``_decode_loop_memo`` pattern).
+- **R4c mutable-global capture**: a jit-decorated function reading a
+  module-level ``list``/``dict``/``set`` — the value is baked in at
+  trace time, later mutations are silently ignored (or, via hashable
+  wrappers, force a retrace).  Thread state through arguments instead.
+- **R4d unhashable static**: a ``list``/``dict``/``set`` literal passed
+  at a ``static_argnums`` position — unhashable, so every call dies (or
+  the caller "fixes" it with a tuple whose contents still churn the
+  cache).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from ..core import Finding, ParsedFile, call_name, expr_key, scope_walk
+from . import _jit
+
+RULE = "retrace-hazard"
+
+_HOST_CALLS = ("float", "int")
+_HOST_METHODS = ("item", "tolist")
+
+
+def _host_scalar(node: ast.AST) -> str:
+    """Describe ``node`` if it materializes a host scalar, else ''."""
+    if isinstance(node, ast.Call):
+        cn = call_name(node)
+        if cn in _HOST_CALLS:
+            return f"{cn}(...)"
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _HOST_METHODS:
+            return f".{node.func.attr}()"
+    return ""
+
+
+def check(pf: ParsedFile, ctx) -> Iterable[Finding]:
+    jitted = _jit.discover(pf)
+    module_defs = {n.name for n in pf.tree.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+    mutable_globals: Set[str] = set()
+    for stmt in pf.tree.body:
+        if isinstance(stmt, ast.Assign) \
+                and isinstance(stmt.value, (ast.List, ast.Dict, ast.Set,
+                                            ast.ListComp, ast.DictComp,
+                                            ast.SetComp)):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    mutable_globals.add(tgt.id)
+
+    for node in pf.nodes:
+        if isinstance(node, ast.Call):
+            # R4a/R4d: calls of known-jitted callables
+            callee = expr_key(node.func)
+            j = jitted.get(callee) if callee else None
+            if j is not None and j.static_unknown:
+                j = None    # can't tell traced from static: stay silent
+            if j is not None:
+                for i, arg in enumerate(node.args):
+                    desc = _host_scalar(arg)
+                    if desc and i not in j.static:
+                        yield pf.finding(
+                            RULE, arg,
+                            f"{desc} feeds traced position {i} of "
+                            f"jitted '{callee}' — a device→host sync "
+                            "per call on the dispatch path; pass the "
+                            "device value (or make the position "
+                            "static) instead")
+                    if i in j.static and isinstance(
+                            arg, (ast.List, ast.Dict, ast.Set)):
+                        yield pf.finding(
+                            RULE, arg,
+                            f"unhashable {type(arg).__name__.lower()} "
+                            f"literal at static position {i} of jitted "
+                            f"'{callee}' — static args must be "
+                            "hashable and stable or every call "
+                            "retraces")
+            # R4b: jax.jit inside a loop body
+            if _jit.jit_call_of(node) is not None \
+                    and _inside_loop(pf, node):
+                yield pf.finding(
+                    RULE, node,
+                    "jax.jit(...) called inside a loop — a fresh "
+                    "callable (and compile cache) per iteration; hoist "
+                    "or memoize the jitted callable outside the loop")
+
+    # R4c: jitted module-level defs reading mutable module globals
+    if mutable_globals:
+        for key, j in jitted.items():
+            fn_node = None
+            if isinstance(j.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn_node = j.node
+            elif j.wrapped in module_defs:
+                fn_node = next(n for n in pf.tree.body
+                               if isinstance(n, (ast.FunctionDef,
+                                                 ast.AsyncFunctionDef))
+                               and n.name == j.wrapped)
+            if fn_node is None:
+                continue
+            local_stores = {t.id for n in scope_walk(fn_node)
+                            if isinstance(n, (ast.Assign,))
+                            for t in n.targets if isinstance(t, ast.Name)}
+            local_stores |= {a.arg for a in fn_node.args.args}
+            for n in scope_walk(fn_node):
+                if isinstance(n, ast.Name) \
+                        and isinstance(n.ctx, ast.Load) \
+                        and n.id in mutable_globals \
+                        and n.id not in local_stores:
+                    yield pf.finding(
+                        RULE, n,
+                        f"jit-compiled '{fn_node.name}' reads mutable "
+                        f"module state '{n.id}' — the value is frozen "
+                        "at trace time and later mutations are "
+                        "silently ignored (the recompile-sentinel bug "
+                        "class); pass it as an argument")
+
+
+def _inside_loop(pf: ParsedFile, node: ast.AST) -> bool:
+    """Nearest loop ancestor is closer than the nearest enclosing
+    function (a jit inside a def inside a loop is the def's business)."""
+    for p in pf.parents(node):
+        if isinstance(p, (ast.For, ast.AsyncFor, ast.While)):
+            return True
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return False
+    return False

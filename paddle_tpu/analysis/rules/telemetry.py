@@ -1,0 +1,136 @@
+"""unguarded-telemetry: hot-path emits sit behind ONE falsy check.
+
+The zero-overhead contract (``observability/_state.py``,
+``resilience/_state.py``, enforced dynamically by the
+``telemetry-overhead`` CI gate at a handful of probed sites): with
+telemetry/fault-injection disabled, a producer pays exactly one falsy
+check — no registry lookups, no event dicts, no lock.  This rule checks
+the *whole tree* statically: outside the ``observability`` and
+``resilience`` packages, every use of
+
+- a registry handle from ``obs.get_registry()``,
+- a telemetry handle from ``obs.get_telemetry()``,
+- a hook container read (``_obs_state.EMIT[0]``,
+  ``_rs_state.FAULTS[0]``, ``MONITOR``/``COLLECTIVE``/``SPAN``/
+  ``RECORDER``/``POSTMORTEM`` — bound to a local or used in place),
+
+must be dominated by the falsy-check idiom recognized by
+:func:`~..core.is_guarded` (``if x is not None:``, ``if x:``, the
+conditional expression, the early-exit, or an ``and`` chain).
+
+Sanctioned wrappers need no local guard — they ARE the one check:
+``obs.emit_event(...)``, ``span(...)``, ``obs.enable/disable`` and the
+``get_*`` accessors themselves.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Optional
+
+from ..core import (Finding, ParsedFile, call_name, expr_key,
+                    is_guarded, scope_walk)
+
+RULE = "unguarded-telemetry"
+
+_EXEMPT_PARTS = ("/observability/", "/resilience/")
+_HOOKS = ("MONITOR", "COLLECTIVE", "EMIT", "SPAN", "RECORDER",
+          "POSTMORTEM", "FAULTS")
+_GETTERS = {
+    "get_registry": "obs.get_registry()",
+    "get_telemetry": "obs.get_telemetry()",
+    "get_flight_recorder": "obs.get_flight_recorder()",
+    "get_watchdog": "obs.get_watchdog()",
+}
+
+
+def _exempt(pf: ParsedFile) -> bool:
+    p = "/" + pf.rel_path.replace("\\", "/")
+    return any(part in p for part in _EXEMPT_PARTS)
+
+
+def _hook_subscript_key(node: ast.AST) -> Optional[str]:
+    """``<chain>.<HOOK>[0]`` → its expr key, else None."""
+    if isinstance(node, ast.Subscript):
+        key = expr_key(node)
+        if key is None or not key.endswith("[0]"):
+            return None
+        base = key[:-3].rsplit(".", 1)[-1]
+        if base in _HOOKS:
+            return key
+    return None
+
+
+def _getter_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Call):
+        cn = call_name(node)
+        if cn is not None and cn.split(".")[-1] in _GETTERS:
+            return cn.split(".")[-1]
+    return None
+
+
+def check(pf: ParsedFile, ctx) -> Iterable[Finding]:
+    if _exempt(pf):
+        return
+    # per function scope: names bound from a getter / hook container
+    for node in pf.nodes:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Module)):
+            continue
+        yield from _check_scope(pf, node)
+
+
+def _check_scope(pf: ParsedFile, scope: ast.AST) -> Iterable[Finding]:
+    tracked: Dict[str, str] = {}     # local name -> origin description
+    nodes = list(scope_walk(scope))
+    for node in nodes:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            getter = _getter_name(node.value)
+            hook = _hook_subscript_key(node.value)
+            if getter is not None:
+                tracked[name] = _GETTERS[getter]
+            elif hook is not None:
+                tracked[name] = hook
+    for node in nodes:
+        # 1. uses of tracked locals: attribute access or direct call
+        use_key = None
+        use_node = None
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in tracked:
+            use_key, use_node = node.value.id, node
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Name) \
+                and node.func.id in tracked:
+            use_key, use_node = node.func.id, node
+        if use_node is not None:
+            if not is_guarded(pf, use_node, use_key):
+                yield pf.finding(
+                    RULE, use_node,
+                    f"'{use_key}' (from {tracked[use_key]}) is used "
+                    "without a dominating falsy check — the disabled "
+                    "path must cost exactly one 'if x is not None' "
+                    "(observability/_state.py contract, telemetry-"
+                    "overhead gate)")
+            continue
+        # 2. in-place hook-container use: _obs_state.EMIT[0](...) /
+        #    chained getter use: obs.get_registry().counter(...)
+        if isinstance(node, ast.Call):
+            hook = _hook_subscript_key(node.func)
+            if hook is not None and not is_guarded(pf, node, hook):
+                yield pf.finding(
+                    RULE, node,
+                    f"direct call of hook container {hook} without a "
+                    "dominating falsy check — it is None whenever "
+                    "telemetry/fault-injection is disabled")
+                continue
+            if isinstance(node.func, ast.Attribute) \
+                    and _getter_name(node.func.value) is not None:
+                getter = _getter_name(node.func.value)
+                yield pf.finding(
+                    RULE, node,
+                    f"chained use {getter}().{node.func.attr}(...) — "
+                    "the getter returns None when telemetry is "
+                    "disabled; bind it and guard with one falsy check")

@@ -235,16 +235,26 @@ class Engine:
         self._detokenize = detokenize
         self._key = jax.random.key(seed)
         self._step_i = 0
-        self._states: Dict[str, RequestState] = {}
+        # Cross-thread state (the HTTP-handler / engine-loop boundary,
+        # serving/server.py): when the engine sits behind a
+        # ServingServer, handler threads reach these through
+        # FrontDoor.submit while the loop thread mutates them in
+        # step().  The guarding lock is ServingServer._lock; methods
+        # marked `# requires-lock: _lock` must be entered with it held
+        # (single-threaded drivers — tests, benches — satisfy that
+        # trivially).  Checked by pdtpu-lint's lock-discipline rule.
+        self._states: Dict[str, RequestState] = {}   # guarded_by: _lock
         # a long-running engine must not leak one RequestState (plus its
         # token list) per request served: only the `keep_finished` most
         # recently finished requests stay queryable via output_ids()
         self.keep_finished = int(keep_finished)
-        self._finished_order: "collections.deque[str]" = collections.deque()
+        self._finished_order: "collections.deque[str]" = \
+            collections.deque()                      # guarded_by: _lock
         # set by run() while draining: finish-time output capture that
         # eviction can't outrun (None outside run(), so step()/stream()
         # users accumulate no unbounded side state)
-        self._drain_capture: Optional[Dict[str, List[int]]] = None
+        self._drain_capture: Optional[Dict[str, List[int]]] = \
+            None                                     # guarded_by: _lock
         self._cow_copies = 0
         self._build_fns()
 
@@ -316,6 +326,7 @@ class Engine:
 
     # -- request lifecycle -------------------------------------------------
 
+    # requires-lock: _lock — touches _states (see __init__)
     def add_request(self, prompt_ids, max_new_tokens: int = 16,
                     temperature: float = 0.0,
                     eos_token_id: Optional[int] = None,
@@ -373,6 +384,7 @@ class Engine:
             reg.gauge("serve.queue_depth").set(self.scheduler.queue_depth())
         return req.request_id
 
+    # requires-lock: _lock
     def output_ids(self, request_id: str) -> List[int]:
         return list(self._states[request_id].output_ids)
 
@@ -395,6 +407,7 @@ class Engine:
 
     # -- preemption / restore / fault isolation ----------------------------
 
+    # requires-lock: _lock — reads _states
     def preempt(self, request_id: str, *, requeue_head: bool = False,
                 reason: str = "preempted") -> bool:
         """Swap a RUNNING request's KV pages to host RAM, free its
@@ -476,6 +489,7 @@ class Engine:
                        exc=type(exc).__name__, message=str(exc)[:200])
         self._preempt_state(st, head=True, reason="isolated_failure")
 
+    # requires-lock: _lock — drains scheduler.waiting
     def _admit_all(self) -> None:
         """Admission loop with the ``serve.admit`` fault site: an
         injected/host fault here leaves the queue intact (nothing has
@@ -570,6 +584,7 @@ class Engine:
         for pg, key in enumerate(st.page_keys):
             self.prefix_cache.register(key, int(st.table[pg]))
 
+    # requires-lock: _lock — retires into _states/_finished_order/_drain_capture
     def _emit(self, st: RequestState, token: int,
               events: List[TokenEvent]):
         req = st.request
@@ -748,6 +763,7 @@ class Engine:
             for ev in self.step():
                 yield ev
 
+    # requires-lock: _lock
     def _begin_drain(self) -> Dict[str, List[int]]:
         """Start a drain capture (shared by :meth:`run` and
         ``FrontDoor.run``): collect requests already finished since the
@@ -762,6 +778,7 @@ class Engine:
         self._drain_capture = drained
         return drained
 
+    # requires-lock: _lock
     def _end_drain(self) -> None:
         self._drain_capture = None
 

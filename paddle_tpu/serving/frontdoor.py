@@ -166,11 +166,20 @@ class FrontDoor:
         self.enable_preemption = bool(enable_preemption)
         self.retry_after_floor_s = float(retry_after_floor_s)
         self.clock = clock
-        self._queues: Dict[str, "collections.deque[_Pending]"] = {}
-        self._buckets: Dict[str, TokenBucket] = {}
-        self._outstanding: Dict[str, Set[str]] = {}
-        self._deficit: Dict[str, float] = {}
-        self._rr: Dict[int, int] = {}
+        # Cross-thread state (HTTP handler threads submit, the
+        # engine-loop thread pumps — serving/server.py): guarded by
+        # ServingServer._lock; methods marked `# requires-lock:
+        # _lock` must be entered with it held (single-threaded
+        # drivers satisfy that trivially).  Checked by pdtpu-lint.
+        self._queues: Dict[str, "collections.deque[_Pending]"] = \
+            {}                                   # guarded_by: _lock
+        self._buckets: Dict[str, TokenBucket] = \
+            {}                                   # guarded_by: _lock
+        self._outstanding: Dict[str, Set[str]] = \
+            {}                                   # guarded_by: _lock
+        self._deficit: Dict[str, float] = \
+            {}                                   # guarded_by: _lock
+        self._rr: Dict[int, int] = {}            # guarded_by: _lock
         self.sheds = 0               # lifetime shed count (all reasons)
 
     # -- policy plumbing ---------------------------------------------------
@@ -180,6 +189,7 @@ class FrontDoor:
             return self.default_policy
         return self.policies.get(tenant, self.default_policy)
 
+    # requires-lock: _lock — lazily materializes _buckets entries
     def _bucket(self, tenant: str,
                 pol: TenantPolicy) -> Optional[TokenBucket]:
         if pol.rate_tokens_per_s is None:
@@ -194,11 +204,13 @@ class FrontDoor:
 
     # -- live signals (serve.* telemetry when on, engine-local when off) ---
 
+    # requires-lock: _lock
     def queue_depth(self) -> int:
         """Door queues + the engine's staging queue."""
         return sum(len(q) for q in self._queues.values()) \
             + self.engine.scheduler.queue_depth()
 
+    # requires-lock: _lock
     def _total_queued(self) -> int:
         return sum(len(q) for q in self._queues.values())
 
@@ -213,6 +225,7 @@ class FrontDoor:
         alloc = self.engine.kv.allocator
         return alloc.used_blocks / max(self.engine.kv.num_blocks, 1)
 
+    # requires-lock: _lock — sums the pending queues
     def _retry_after(self) -> float:
         """Load-proportional retry hint: pending token cost over the
         live aggregate tok/s when telemetry has one, else a queue-depth
@@ -250,6 +263,7 @@ class FrontDoor:
             raise QueueFull(message, retry_after_s)
         return Admission(False, None, reason, retry_after_s)
 
+    # requires-lock: _lock — the handler-thread entry point
     def submit(self, prompt_ids, *, tenant: str = "default",
                max_new_tokens: int = 16, temperature: float = 0.0,
                eos_token_id: Optional[int] = None,
@@ -338,10 +352,12 @@ class FrontDoor:
         self.pump()
         return Admission(True, req.request_id, None, None)
 
+    # requires-lock: _lock
     def _live_count(self, tenant: str) -> int:
         self._gc_outstanding()
         return len(self._outstanding.get(tenant, ()))
 
+    # requires-lock: _lock
     def _gc_outstanding(self) -> None:
         eng = self.engine
         queued = {p.request.request_id
@@ -355,9 +371,11 @@ class FrontDoor:
 
     # -- scheduling: strict priority tiers + weighted DRR ------------------
 
+    # requires-lock: _lock
     def _engine_room(self) -> bool:
         return len(self.engine.scheduler.waiting) < self.engine.max_batch
 
+    # requires-lock: _lock
     def _next_pending(self) -> Optional[_Pending]:
         nonempty = [t for t, q in self._queues.items() if q]
         if not nonempty:
@@ -396,6 +414,7 @@ class FrontDoor:
                 return self._queues[t].popleft()
         return None
 
+    # requires-lock: _lock — the loop-thread entry point
     def pump(self) -> int:
         """Feed sequenced work into the engine's staging queue and run
         the preemption policy; returns the number admitted.  Called by
@@ -446,6 +465,7 @@ class FrontDoor:
     def _priority_of(self, st: RequestState) -> int:
         return self.policy(st.request.tenant).priority
 
+    # requires-lock: _lock — inspects scheduler.waiting
     def _maybe_preempt(self) -> None:
         """When the engine's queue head is BLOCK-starved (a slot is
         free, blocks are not) and outranks a running request, preempt

@@ -129,12 +129,19 @@ class Scheduler:
         self.allocator = allocator
         self.oob_block = int(oob_block)
         self.prefix_cache = prefix_cache
-        self.waiting: "collections.deque[RequestState]" = collections.deque()
+        # Cross-thread when driven through a ServingServer: handler
+        # threads observe the queue via FrontDoor while the loop
+        # thread admits from it — serialized by ServingServer._lock
+        # (pdtpu-lint lock-discipline; single-threaded drivers
+        # trivially hold it).
+        self.waiting: "collections.deque[RequestState]" = \
+            collections.deque()                  # guarded_by: _lock
         self.slots: List[Optional[RequestState]] = [None] * self.max_batch
         self._rr = 0   # round-robin origin for the prefill token budget
 
     # -- admission ---------------------------------------------------------
 
+    # requires-lock: _lock
     def submit(self, request: Request) -> RequestState:
         st = RequestState(request)
         if self.prefix_cache is not None:
@@ -147,6 +154,7 @@ class Scheduler:
         self.waiting.append(st)
         return st
 
+    # requires-lock: _lock
     def queue_depth(self) -> int:
         return len(self.waiting)
 
@@ -165,6 +173,7 @@ class Scheduler:
     def blocks_needed(self, st: RequestState) -> int:
         return self.blocks_for(st.total_len)
 
+    # requires-lock: _lock
     def admit_next(self) -> Optional[RequestState]:
         """Move the head of the waiting queue into a slot.  FIFO
         head-of-line: a large head request waits for blocks rather than
@@ -257,6 +266,7 @@ class Scheduler:
     def active(self) -> List[Tuple[int, RequestState]]:
         return [(i, s) for i, s in enumerate(self.slots) if s is not None]
 
+    # requires-lock: _lock — advances the _rr round-robin origin
     def plan_spans(self, chunk: int, budget: Optional[int] = None
                    ) -> List[Tuple[int, "RequestState", int, bool]]:
         """Decide each active slot's span for this step: ``(slot, state,
@@ -333,6 +343,7 @@ class Scheduler:
         st.borrowed = set()
         st.cow_spare = {}
 
+    # requires-lock: _lock
     def requeue(self, st: RequestState, head: bool = False) -> None:
         """Put a preempted/isolated request back on the waiting queue —
         at the head for fault isolation (it was mid-flight; resume
@@ -344,5 +355,6 @@ class Scheduler:
         else:
             self.waiting.append(st)
 
+    # requires-lock: _lock
     def has_work(self) -> bool:
         return bool(self.waiting) or any(s is not None for s in self.slots)

@@ -239,7 +239,10 @@ class ServingServer:
         self.drain_retry_after_s = float(drain_retry_after_s)
         self._host, self._port = host, int(port)
         self._lock = threading.Lock()
-        self._routes: dict = {}
+        # request_id → handler-thread event queue; written by handler
+        # threads at submit, read/pruned by the engine-loop thread —
+        # every touch under _lock (pdtpu-lint lock-discipline)
+        self._routes: dict = {}                      # guarded_by: _lock
         self._stop = threading.Event()
         self._draining = threading.Event()
         self._drained = threading.Event()
@@ -285,11 +288,16 @@ class ServingServer:
                 if self.door.has_work():
                     evs = self.door.step()
             for ev in evs:
-                q = self._routes.get(ev.request_id)
+                # under the lock: handler threads insert routes
+                # concurrently (lint's lock-discipline rule flagged the
+                # bare read here — a handler registering its queue
+                # between this get and the pop could be missed)
+                with self._lock:
+                    q = self._routes.get(ev.request_id)
+                    if q is not None and ev.finished:
+                        self._routes.pop(ev.request_id, None)
                 if q is not None:
                     q.put(ev)
-                    if ev.finished:
-                        self._routes.pop(ev.request_id, None)
             if self._draining.is_set():
                 with self._lock:
                     idle = not self.door.has_work()

@@ -52,6 +52,18 @@ def test_op_benchmark_gate():
     assert "op-benchmark gate OK" in out
 
 
+def test_lint_gate():
+    """pdtpu-lint (paddle_tpu/analysis) runs clean over the whole tree:
+    zero non-baselined findings across the six invariant rules
+    (donation/compat/zero-overhead/retrace/fault-site/lock), jax-free
+    and in seconds (docs/ANALYSIS.md; fast path:
+    ``python tools/ci.py --only lint``)."""
+    out = _run_gate("lint", timeout=300)
+    assert "lint gate OK" in out
+    assert "0 new finding(s)" in out
+    assert "(jax imported: False)" in out
+
+
 def test_telemetry_overhead_gate():
     """The disabled-observability TrainStep dispatch stays one falsy
     check: registry/sink calls are poisoned and the per-call cost is

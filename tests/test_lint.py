@@ -1,0 +1,701 @@
+"""pdtpu-lint — the framework-invariant static analyzer
+(paddle_tpu/analysis, docs/ANALYSIS.md).
+
+Each of the six rules is proven on small fixture snippets: a true
+positive, a true negative, a suppressed positive, and (shared) a
+baselined positive; plus the whole-tree smoke test the ``lint`` CI
+gate stands on, the SITES-extraction parity check against the real
+``resilience.SITES``, and the jax-free CLI contract.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from pdtpu_lint import load_analysis  # noqa: E402
+
+analysis = load_analysis()
+
+FAULTS_FIXTURE = '''
+SITES = ("step", "ckpt.save", "serve.swap")
+_EXC_NAMES = {"InjectedFault": None, "OSError": None}
+'''
+
+DOC_FIXTURE = """
+### Sites
+
+| site | fires in |
+|---|---|
+| `step` | the train step |
+| `ckpt.save` | checkpoint writes |
+| `serve.swap` | swap I/O |
+"""
+
+
+def run_lint(tmp_path, files, baseline=None, rules=None,
+             with_registry=True):
+    """Write ``files`` (rel → source) under a scratch repo root and
+    analyze them."""
+    paths = []
+    for rel, content in files.items():
+        full = tmp_path / rel
+        full.parent.mkdir(parents=True, exist_ok=True)
+        full.write_text(textwrap.dedent(content))
+        paths.append(rel)
+    if with_registry:
+        f = tmp_path / "paddle_tpu" / "resilience" / "faults.py"
+        if not f.exists():
+            f.parent.mkdir(parents=True, exist_ok=True)
+            f.write_text(FAULTS_FIXTURE)
+        d = tmp_path / "docs" / "RESILIENCE.md"
+        if not d.exists():
+            d.parent.mkdir(parents=True, exist_ok=True)
+            d.write_text(DOC_FIXTURE)
+    return analysis.analyze(str(tmp_path), paths=paths,
+                            baseline=baseline, rules=rules)
+
+
+def rules_of(res):
+    return [f.rule for f in res.findings]
+
+
+# ---------------------------------------------------------------------------
+# rule 1: donation-safety
+# ---------------------------------------------------------------------------
+
+class TestDonationSafety:
+    def test_positive_read_after_dispatch(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            import jax
+
+            def go(state, batch):
+                step = jax.jit(run, donate_argnums=(0,))
+                new_state = step(state, batch)
+                return state["loss"]     # read-after-free
+        """})
+        assert rules_of(res) == ["donation-safety"]
+        assert "'state'" in res.findings[0].message
+
+    def test_positive_view_alias(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            import jax
+            import numpy as np
+
+            def go(params, batch):
+                snap = np.asarray(params)    # zero-copy view
+                step = jax.jit(run, donate_argnums=(0,))
+                params = step(params, batch)
+                return snap.sum()            # view of the dead buffer
+        """})
+        assert rules_of(res) == ["donation-safety"]
+        assert "view" in res.findings[0].message
+
+    def test_negative_rebind_and_branches(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            import jax
+
+            def go(state, batch, mesh):
+                step = jax.jit(run, donate_argnums=(0,))
+                state = step(state, batch)       # x = f(x) rebind
+                ok = state["loss"]
+                if mesh is not None:
+                    with mesh:
+                        return step(state, batch)
+                return step(state, batch)        # sibling, not "after"
+        """})
+        assert rules_of(res) == []
+
+    def test_negative_self_attr_lifecycle(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            import jax
+
+            class Eng:
+                def build(self):
+                    self._fn = jax.jit(run, donate_argnums=(1,))
+
+                def step(self, tok):
+                    out, caches = self._fn(tok, self.kv.caches)
+                    self.kv.caches = caches
+                    return out
+        """})
+        assert rules_of(res) == []
+
+    def test_positive_cross_method_self_attr(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            import jax
+
+            class Eng:
+                def build(self):
+                    self._fn = jax.jit(run, donate_argnums=(1,))
+
+                def step(self, tok):
+                    out, caches = self._fn(tok, self.kv.caches)
+                    stale = self.kv.caches[0]    # donated, not rebound
+                    self.kv.caches = caches
+                    return out, stale
+        """})
+        assert rules_of(res) == ["donation-safety"]
+
+    def test_suppressed(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            import jax
+
+            def go(state, batch):
+                step = jax.jit(run, donate_argnums=(0,))
+                new_state = step(state, batch)
+                # pdtpu-lint: disable=donation-safety — fixture
+                return state["loss"]
+        """})
+        assert rules_of(res) == []
+        assert [f.rule for f in res.suppressed] == ["donation-safety"]
+        assert res.stale_suppressions == []
+
+
+# ---------------------------------------------------------------------------
+# rule 2: compat-symbol
+# ---------------------------------------------------------------------------
+
+class TestCompatSymbol:
+    def test_positives(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            from jax.experimental.shard_map import shard_map
+            from jax.experimental.pallas import tpu as pltpu
+
+            def f(mesh):
+                params = pltpu.TPUCompilerParams()
+                g = getattr(pltpu, "CompilerParams")
+                return shard_map(f, mesh=mesh, in_specs=(), out_specs=(),
+                                 check_rep=False)
+        """})
+        assert rules_of(res) == ["compat-symbol"] * 4
+
+    def test_negative_via_compat(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            from paddle_tpu.core.compat import (pallas_compiler_params,
+                                                shard_map)
+
+            def f(mesh):
+                p = pallas_compiler_params()
+                return shard_map(f, mesh=mesh, in_specs=(), out_specs=(),
+                                 check_vma=False)
+        """})
+        assert rules_of(res) == []
+
+    def test_compat_module_exempt(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/core/compat.py": """
+            from jax.experimental.shard_map import shard_map as _old
+        """})
+        assert rules_of(res) == []
+
+    def test_suppressed(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            # pdtpu-lint: disable=compat-symbol — fixture
+            from jax.experimental.shard_map import shard_map
+        """})
+        assert rules_of(res) == []
+        assert len(res.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# rule 3: unguarded-telemetry
+# ---------------------------------------------------------------------------
+
+class TestUnguardedTelemetry:
+    def test_positive_registry(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            from paddle_tpu import observability as obs
+
+            def hot():
+                reg = obs.get_registry()
+                reg.counter("serve.steps").inc()    # None when disabled
+        """})
+        assert rules_of(res) == ["unguarded-telemetry"]
+
+    def test_positive_hook_container(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            from paddle_tpu.resilience import _state as _rs_state
+
+            def hot():
+                fi = _rs_state.FAULTS[0]
+                fi("step")                          # unguarded fire
+        """})
+        assert rules_of(res) == ["unguarded-telemetry"]
+
+    def test_positive_chained_getter(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            from paddle_tpu import observability as obs
+
+            def hot():
+                obs.get_telemetry().emit({"event": "x"})
+        """})
+        assert rules_of(res) == ["unguarded-telemetry"]
+
+    def test_negative_guard_idioms(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            from paddle_tpu import observability as obs
+            from paddle_tpu.observability import _state as _obs_state
+            from paddle_tpu.resilience import _state as _rs_state
+
+            def a():
+                reg = obs.get_registry()
+                if reg is not None:
+                    reg.counter("x").inc()
+
+            def b():
+                reg = obs.get_registry()
+                if reg is None:
+                    return
+                reg.gauge("y").set(1)
+
+            def c():
+                fi = _rs_state.FAULTS[0]
+                if fi is not None:
+                    fi("step")
+                mon = _obs_state.MONITOR[0]
+                steps = mon.total_steps if mon is not None else None
+                obs.emit_event("done", steps=steps)   # sanctioned wrapper
+                if _obs_state.EMIT[0] is not None:
+                    _obs_state.EMIT[0]({"event": "z"})
+
+            def d(plan):
+                reg = obs.get_registry()
+                if reg is not None and plan:
+                    reg.counter("x").inc()
+                e = _obs_state.EMIT[0]
+                ok = e is not None and e({"event": "w"})
+        """})
+        assert rules_of(res) == []
+
+    def test_exempt_inside_packages(self, tmp_path):
+        res = run_lint(tmp_path, {
+            "paddle_tpu/observability/thing.py": """
+                def hot(reg):
+                    reg = get_registry()
+                    reg.counter("x").inc()
+            """})
+        assert rules_of(res) == []
+
+    def test_suppressed(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            from paddle_tpu import observability as obs
+
+            def cold():
+                reg = obs.get_registry()
+                # pdtpu-lint: disable=unguarded-telemetry — cold path
+                reg.counter("x").inc()
+        """})
+        assert rules_of(res) == []
+        assert len(res.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# rule 4: retrace-hazard
+# ---------------------------------------------------------------------------
+
+class TestRetraceHazard:
+    def test_positive_host_scalar(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            import jax
+
+            def go(x, t):
+                f = jax.jit(run)
+                return f(x.item(), float(t))
+        """})
+        assert rules_of(res) == ["retrace-hazard"] * 2
+
+    def test_positive_jit_in_loop(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            import jax
+
+            def go(fns, x):
+                for fn in fns:
+                    out = jax.jit(fn)(x)
+        """})
+        assert rules_of(res) == ["retrace-hazard"]
+
+    def test_positive_unhashable_static(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            import jax
+
+            def go(x):
+                f = jax.jit(run, static_argnums=(1,))
+                return f(x, [1, 2, 3])
+        """})
+        assert rules_of(res) == ["retrace-hazard"]
+        assert "unhashable" in res.findings[0].message
+
+    def test_positive_mutable_global(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            import jax
+
+            _CFG = {"scale": 2.0}
+
+            @jax.jit
+            def scaled(x):
+                return x * _CFG["scale"]
+        """})
+        assert rules_of(res) == ["retrace-hazard"]
+        assert "_CFG" in res.findings[0].message
+
+    def test_static_argnames_resolved_to_positions(self, tmp_path):
+        """static_argnames map to positions via the wrapped signature:
+        a host scalar at a name-static position is NOT flagged, and an
+        unhashable literal there IS (review finding)."""
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            import jax
+
+            def run(x, mode):
+                return x
+
+            def go(x, m):
+                f = jax.jit(run, static_argnames=("mode",))
+                ok = f(x, int(m))            # static position: fine
+                bad = f(x, [1, 2])           # unhashable static
+                return ok, bad
+        """})
+        assert rules_of(res) == ["retrace-hazard"]
+        assert "unhashable" in res.findings[0].message
+
+    def test_static_argnames_unresolvable_stays_silent(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            import jax
+
+            def go(fn, x, m):
+                f = jax.jit(fn, static_argnames=("mode",))
+                return f(x, float(m))        # can't map: no finding
+        """})
+        assert rules_of(res) == []
+
+    def test_negatives(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            import functools
+            import jax
+            import jax.numpy as jnp
+
+            _CFG = {"scale": 2.0}
+
+            @functools.partial(jax.jit, static_argnums=(1,))
+            def powed(x, n):
+                return x ** n
+
+            def go(fn, x, arr):
+                memo = None
+                for _ in range(3):
+                    if memo is None:
+                        memo = make(fn)       # jit made elsewhere
+                f = jax.jit(fn)
+                y = f(jnp.asarray(arr))       # device value: fine
+                z = powed(y, 2)               # hashable static: fine
+                s = float(_CFG["scale"])      # outside jit: fine
+                return y, z, s
+        """})
+        assert rules_of(res) == []
+
+    def test_suppressed(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            import jax
+
+            def bench(fns, x):
+                for fn in fns:
+                    # pdtpu-lint: disable=retrace-hazard — deliberate
+                    out = jax.jit(fn)(x)
+        """})
+        assert rules_of(res) == []
+        assert len(res.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# rule 5: fault-site
+# ---------------------------------------------------------------------------
+
+class TestFaultSite:
+    def test_positive_unregistered_fire(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            from paddle_tpu.resilience import _state as _rs_state
+
+            def hot():
+                fi = _rs_state.FAULTS[0]
+                if fi is not None:
+                    fi("serve.swpa")        # typo'd site
+        """})
+        assert rules_of(res) == ["fault-site"]
+
+    def test_positive_bad_spec_and_kwarg(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            import os
+            from paddle_tpu import resilience as rs
+
+            def go(pol, fn):
+                rs.install_faults("nosuch@1")
+                rs.install_faults("step@@")
+                os.environ["PDTPU_FAULTS"] = "step@1:NoSuchError"
+                pol.run(fn, site="serve.swpa")
+        """})
+        assert sorted(rules_of(res)) == ["fault-site"] * 4
+
+    def test_negative(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            from paddle_tpu import resilience as rs
+            from paddle_tpu.resilience import _state as _rs_state
+
+            def go(pol, fn, is_save):
+                rs.install_faults("step@3x2:OSError,serve.swap@0")
+                fi = _rs_state.FAULTS[0]
+                if fi is not None:
+                    fi("ckpt.save" if is_save else "step")
+                pol.run(fn, site="supervisor")   # retry label, not a site
+                pol.run(fn, site="serve.swap")
+        """})
+        assert rules_of(res) == []
+
+    def test_docs_drift_both_directions(self, tmp_path):
+        (tmp_path / "docs").mkdir()
+        (tmp_path / "docs" / "RESILIENCE.md").write_text(
+            "| site | fires in |\n|---|---|\n"
+            "| `step` | x |\n| `ckpt.save` | y |\n| `ghost.site` | z |\n")
+        res = run_lint(tmp_path, {"pkg/a.py": "x = 1\n"},
+                       with_registry=True)
+        msgs = " ".join(f.message for f in res.findings)
+        assert rules_of(res) == ["fault-site"] * 2
+        assert "ghost.site" in msgs          # doc lists unregistered
+        assert "serve.swap" in msgs          # registered missing in doc
+
+    def test_suppressed(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            from paddle_tpu.resilience import _state as _rs_state
+
+            def hot():
+                fi = _rs_state.FAULTS[0]
+                if fi is not None:
+                    # pdtpu-lint: disable=fault-site — fixture
+                    fi("serve.swpa")
+        """})
+        assert rules_of(res) == []
+        assert len(res.suppressed) == 1
+
+    def test_registry_extraction_matches_runtime(self):
+        """The AST-extracted registry IS resilience.SITES/_EXC_NAMES."""
+        with open(os.path.join(REPO, "paddle_tpu", "resilience",
+                               "faults.py")) as f:
+            sites, excs = analysis.ALL_RULES[
+                "fault-site"].extract_registry(f.read())
+        from paddle_tpu.resilience import faults
+        assert sites == faults.SITES
+        assert set(excs) == set(faults._EXC_NAMES)
+
+
+# ---------------------------------------------------------------------------
+# rule 6: lock-discipline
+# ---------------------------------------------------------------------------
+
+_LOCK_HEADER = """
+    import threading
+
+    class Srv:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._routes: dict = {}     # guarded_by: _lock
+"""
+
+
+class TestLockDiscipline:
+    def test_positive_unlocked_access(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": _LOCK_HEADER + """
+        def loop(self):
+            q = self._routes.get("x")   # no lock held
+    """})
+        assert rules_of(res) == ["lock-discipline"]
+
+    def test_negative_with_lock_requires_and_init(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": _LOCK_HEADER + """
+        def loop(self):
+            with self._lock:
+                q = self._routes.get("x")
+
+        # requires-lock: _lock
+        def pump(srv):
+            return len(srv._routes)
+    """})
+        assert rules_of(res) == []
+
+    def test_cross_module_access_checked(self, tmp_path):
+        res = run_lint(tmp_path, {
+            "pkg/a.py": _LOCK_HEADER,
+            "pkg/b.py": """
+                def peek(srv):
+                    return srv._routes   # other module, still checked
+            """})
+        assert rules_of(res) == ["lock-discipline"]
+        assert res.findings[0].path == "pkg/b.py"
+
+    def test_suppressed(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": _LOCK_HEADER + """
+        def bench(self):
+            # pdtpu-lint: disable=lock-discipline — single-threaded
+            return self._routes
+    """})
+        assert rules_of(res) == []
+        assert len(res.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# baseline / stale handling
+# ---------------------------------------------------------------------------
+
+class TestBaseline:
+    SRC = {"pkg/a.py": """
+        from paddle_tpu import observability as obs
+
+        def hot():
+            reg = obs.get_registry()
+            reg.counter("x").inc()
+    """}
+
+    def test_baselined_finding_passes(self, tmp_path):
+        first = run_lint(tmp_path, self.SRC)
+        assert not first.ok
+        baseline = [f.to_baseline_entry() for f in first.findings]
+        second = run_lint(tmp_path, self.SRC, baseline=baseline)
+        assert second.ok
+        assert [f.rule for f in second.baselined] == ["unguarded-telemetry"]
+        assert second.stale_baseline == []
+
+    def test_baseline_survives_line_drift(self, tmp_path):
+        first = run_lint(tmp_path, self.SRC)
+        baseline = [dict(f.to_baseline_entry(), line=999)
+                    for f in first.findings]
+        second = run_lint(tmp_path, self.SRC, baseline=baseline)
+        assert second.ok and len(second.baselined) == 1
+
+    def test_stale_baseline_warns(self, tmp_path):
+        baseline = [{"rule": "unguarded-telemetry", "file": "pkg/a.py",
+                     "line": 1, "code": "gone_line()"}]
+        res = run_lint(tmp_path, self.SRC, baseline=baseline)
+        assert not res.ok                   # the live finding is NOT eaten
+        assert len(res.stale_baseline) == 1
+
+    def test_stale_suppression_warns(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            def fine():
+                # pdtpu-lint: disable=donation-safety — obsolete
+                return 1
+        """})
+        assert res.ok
+        assert len(res.stale_suppressions) == 1
+
+    def test_trailing_suppression_does_not_leak_to_next_statement(
+            self, tmp_path):
+        """A trailing disable on statement N must not also suppress
+        statement N+1 (review finding: the 'line above' form only
+        counts on comment-only lines)."""
+        res = run_lint(tmp_path, {"pkg/a.py": _LOCK_HEADER + """
+        def loop(self):
+            a = self._routes.get("x")   # pdtpu-lint: disable=lock-discipline
+            b = self._routes.get("y")
+    """})
+        assert rules_of(res) == ["lock-discipline"]
+        assert res.findings[0].line == res.suppressed[0].line + 1
+
+    def test_rule_subset_does_not_report_live_suppressions_stale(
+            self, tmp_path):
+        """Under --rules subsets the un-run rules' suppressions were
+        never evaluated — 'remove the comment' advice would break the
+        next full run (review finding)."""
+        files = {"pkg/a.py": """
+            import jax
+
+            def bench(fns, x):
+                for fn in fns:
+                    # pdtpu-lint: disable=retrace-hazard — deliberate
+                    out = jax.jit(fn)(x)
+        """}
+        res = run_lint(tmp_path, files, rules=["compat-symbol"])
+        assert res.ok and res.stale_suppressions == []
+        res = run_lint(tmp_path, files)      # full run: evaluated, used
+        assert res.ok and res.stale_suppressions == []
+
+
+# ---------------------------------------------------------------------------
+# whole tree + CLI
+# ---------------------------------------------------------------------------
+
+class TestWholeTree:
+    def test_full_tree_clean_and_fast(self):
+        """The standing scan set has zero non-baselined findings (the
+        lint gate's contract) and completes well inside the 30 s
+        budget."""
+        t0 = time.perf_counter()
+        baseline = analysis.load_baseline(
+            os.path.join(REPO, "tools", "lint_baseline.json"))
+        res = analysis.analyze(REPO, baseline=baseline)
+        dt = time.perf_counter() - t0
+        assert res.errors == []
+        assert res.findings == [], "\n".join(
+            f"{f.location()}: {f.rule}: {f.message}" for f in res.findings)
+        assert res.files_scanned > 100
+        assert dt < 30.0, f"analyzer took {dt:.1f}s (budget 30s)"
+
+    def test_live_suppressions_not_stale(self):
+        """Every inline disable in the tree still suppresses a real
+        finding — the satellite-6 only-shrinks contract."""
+        res = analysis.analyze(REPO)
+        assert res.stale_suppressions == []
+        assert len(res.suppressed) >= 1     # decode_bench keeps some
+
+    def test_cli_runs_jax_free(self):
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "pdtpu_lint.py")],
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "(jax imported: False)" in r.stdout
+
+    def test_cli_json_reports_and_enforces_jax_free(self):
+        """--json carries the jax_imported flag and keeps the same
+        hard-fail contract as text mode (review finding)."""
+        import json as _json
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "pdtpu_lint.py"),
+             "--json"],
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        payload = _json.loads(r.stdout)
+        assert payload["jax_imported"] is False
+        assert payload["findings"] == []
+
+    def test_cli_scoped_update_baseline_refused(self):
+        """--update-baseline under explicit paths/--rules would rewrite
+        the baseline from a partial scan, silently deleting entries for
+        everything unscanned (review finding) — it must refuse."""
+        for extra in (["paddle_tpu/serving"], ["--rules", "compat-symbol"]):
+            r = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "tools", "pdtpu_lint.py"),
+                 "--update-baseline", "--no-baseline"] + extra,
+                cwd=REPO, capture_output=True, text=True, timeout=120)
+            assert r.returncode == 2, (extra, r.stdout, r.stderr)
+            assert "full scan" in r.stderr
+
+    def test_cli_rule_subset_and_unknown(self):
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "pdtpu_lint.py"),
+             "--rules", "compat-symbol", "paddle_tpu/serving"],
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "pdtpu_lint.py"),
+             "--rules", "nope"],
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+        assert r.returncode == 2
+
+    def test_package_importable_under_paddle_tpu(self):
+        """``import paddle_tpu.analysis`` (the package spelling) exposes
+        the same surface the CLI loader does."""
+        import paddle_tpu.analysis as pa
+        assert set(pa.ALL_RULES) == set(analysis.ALL_RULES)

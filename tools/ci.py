@@ -42,6 +42,13 @@ in-repo gates over artifacts committed alongside the code:
                   recompiles (recompile sentinel + jit cache sizes), and
                   every KV block is reclaimed at drain
 
+  lint            pdtpu-lint (paddle_tpu/analysis, docs/ANALYSIS.md):
+                  the framework-invariant static analyzer — donation
+                  safety, compat discipline, zero-overhead guards,
+                  retrace hazards, fault-site consistency, lock
+                  discipline — runs clean over the whole tree, jax-free
+                  and in seconds; any non-baselined finding fails
+
   chaos-serving   the resilience machinery applied to the serving path:
                   a PDTPU_FAULTS plan firing at every serving site
                   (serve.admit/prefill/step/cow/swap) during a mixed
@@ -50,7 +57,7 @@ in-repo gates over artifacts committed alongside the code:
                   greedy outputs token-identical to the fault-free run
 
 Run all:  python tools/ci.py            (exit 0 = all gates pass)
-One:      python tools/ci.py --only api-compat|op-benchmark|memproof-lite|telemetry-overhead|chaos|serving-smoke|chaos-serving
+One:      python tools/ci.py --only api-compat|op-benchmark|memproof-lite|telemetry-overhead|chaos|serving-smoke|chaos-serving|lint
 """
 
 from __future__ import annotations
@@ -904,8 +911,34 @@ def gate_chaos_serving(max_batch: int = 4) -> int:
     return 0
 
 
+def gate_lint(timeout_s: float = 120.0) -> int:
+    """Lint gate: pdtpu-lint runs clean over the whole tree with NO jax
+    import (subprocess, bare env — the analyzer must work on a jax-less
+    box; the CLI itself hard-fails if jax sneaks into sys.modules) and
+    well inside the 30 s budget.  Stale suppressions / baseline entries
+    print as warnings in the CLI output but do not fail — the baseline
+    only shrinks (docs/ANALYSIS.md)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(HERE, "pdtpu_lint.py")],
+        cwd=REPO, capture_output=True, text=True, timeout=timeout_s)
+    sys.stdout.write(r.stdout)
+    sys.stderr.write(r.stderr)
+    if r.returncode != 0:
+        print("lint gate FAILED — fix the finding or suppress it inline "
+              "with a reason (# pdtpu-lint: disable=<rule> — <why>); "
+              "see docs/ANALYSIS.md")
+        return 1
+    if "(jax imported: False)" not in r.stdout:
+        print("lint gate FAILED — the analyzer imported jax (or did not "
+              "report); it must stay importable on a jax-less box")
+        return 1
+    print("lint gate OK")
+    return 0
+
+
 GATES = {
     "api-compat": gate_api_compat,
+    "lint": gate_lint,
     "op-benchmark": gate_op_benchmark,
     "memproof-lite": gate_memproof_lite,
     "telemetry-overhead": gate_telemetry_overhead,

@@ -184,6 +184,7 @@ def bench_serve_prefix(preset="llama-350m", max_batch=8, n_requests=None,
         outs = eng.run()
         dt = time.perf_counter() - t0
         assert eng.kv_blocks_used == 0, "KV blocks leaked at drain"
+        # pdtpu-lint: disable=lock-discipline — single-threaded bench
         ttfts = sorted(
             (eng._states[r].first_token_t - eng._states[r].submit_t) * 1e3
             for r in rids)
@@ -261,6 +262,7 @@ def bench_serve_burst(preset="llama-350m", max_batch=8, offered=None,
     dt = time.perf_counter() - t0
     assert eng.kv_blocks_used == 0, "KV blocks leaked at drain"
     tokens = sum(len(outs[r]) for r in admitted)
+    # pdtpu-lint: disable=lock-discipline — single-threaded bench driver
     ttfts = sorted(
         (eng._states[r].first_token_t - eng._states[r].submit_t) * 1e3
         for r in admitted)
@@ -312,6 +314,9 @@ def bench_decode_attention(batch=8, heads=16, head_dim=64, ctx=1024,
 
     out = {}
     for name, fn in (("contiguous_masked", contiguous), ("paged", paged)):
+        # one fresh jit per benchmarked variant is the point here: each
+        # is compiled, warmed, and timed exactly once (two iterations)
+        # pdtpu-lint: disable=retrace-hazard — deliberate per-variant jit
         jitted = jax.jit(lambda fn=fn: loop(fn))
         try:
             _ = float(jitted())            # compile + warm

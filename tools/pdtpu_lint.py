@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""pdtpu-lint CLI — the framework-invariant static analyzer
+(paddle_tpu/analysis, docs/ANALYSIS.md) as a command.
+
+    python tools/pdtpu_lint.py                     # scan the default tree
+    python tools/pdtpu_lint.py paddle_tpu/serving  # scan a subtree
+    python tools/pdtpu_lint.py --rules lock-discipline,fault-site
+    python tools/pdtpu_lint.py --update-baseline   # re-record findings
+    python tools/pdtpu_lint.py --json              # machine-readable
+
+Exit 0 when every finding is suppressed inline or recorded in
+``tools/lint_baseline.json``; exit 1 on any NEW finding (the ``lint``
+CI gate's contract).  Stale suppressions and stale baseline entries are
+WARNINGS — the baseline only shrinks, it never silently pads.
+
+The analyzer is loaded straight from its package directory, bypassing
+``paddle_tpu/__init__`` — no jax import, so this runs on a jax-less
+box and finishes in ~1 s (the gate budget is 30 s).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+BASELINE = os.path.join(HERE, "lint_baseline.json")
+
+
+def load_analysis():
+    """Import ``paddle_tpu/analysis`` WITHOUT importing ``paddle_tpu``
+    (whose ``__init__`` drags in jax)."""
+    if "paddle_tpu.analysis" in sys.modules:
+        return sys.modules["paddle_tpu.analysis"]
+    pkg_dir = os.path.join(REPO, "paddle_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        "pdtpu_analysis", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["pdtpu_analysis"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="repo-relative files/dirs (default: the "
+                         "standing scan set)")
+    ap.add_argument("--rules", help="comma-separated rule subset")
+    ap.add_argument("--root", default=REPO,
+                    help="tree root to analyze (default: this repo)")
+    ap.add_argument("--baseline", default=BASELINE)
+    ap.add_argument("--no-baseline", action="store_true")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="re-record current findings as the baseline")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    if args.update_baseline and (args.paths or args.rules):
+        # a scoped scan sees only a slice of the findings — writing it
+        # out would silently delete every entry for unscanned
+        # files/rules and break the next full gate run
+        print("pdtpu-lint: --update-baseline requires a full scan — "
+              "drop the explicit paths/--rules", file=sys.stderr)
+        return 2
+
+    t0 = time.perf_counter()
+    analysis = load_analysis()
+    baseline = [] if args.no_baseline \
+        else analysis.load_baseline(args.baseline)
+    rules = [r.strip() for r in args.rules.split(",")] if args.rules \
+        else None
+    if rules:
+        unknown = [r for r in rules if r not in analysis.ALL_RULES]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)}; have: "
+                  f"{', '.join(analysis.ALL_RULES)}", file=sys.stderr)
+            return 2
+    root = os.path.abspath(args.root)
+    res = analysis.analyze(root, paths=args.paths or None,
+                           baseline=baseline, rules=rules)
+    dt = time.perf_counter() - t0
+
+    if args.update_baseline:
+        entries = [f.to_baseline_entry() for f in res.findings
+                   + res.baselined]
+        with open(args.baseline, "w") as f:
+            json.dump({"findings": entries}, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"pdtpu-lint: baseline re-recorded with {len(entries)} "
+              f"finding(s) -> {os.path.relpath(args.baseline, root)}")
+        return 0
+
+    if args.as_json:
+        jax_imported = "jax" in sys.modules
+        print(json.dumps({
+            "findings": [vars(f) for f in res.findings],
+            "baselined": [vars(f) for f in res.baselined],
+            "suppressed": [vars(f) for f in res.suppressed],
+            "stale_suppressions": res.stale_suppressions,
+            "stale_baseline": res.stale_baseline,
+            "errors": res.errors,
+            "files_scanned": res.files_scanned,
+            "jax_imported": jax_imported,
+        }, indent=1))
+        # same hard-fail contract as text mode: the analyzer must stay
+        # runnable on a jax-less box
+        return 1 if (jax_imported or not res.ok) else 0
+
+    for f in res.findings:
+        print(f"{f.location()}: {f.rule}: {f.message}")
+        if f.snippet:
+            print(f"    {f.snippet}")
+    for e in res.errors:
+        print(f"ERROR: {e}")
+    for w in res.stale_suppressions + res.stale_baseline:
+        print(f"WARNING: {w}")
+
+    # the gate's contract: this process must never have imported jax —
+    # the analyzer has to work on a jax-less box, and an accidental
+    # import would also blow the 30 s budget
+    jax_free = "jax" not in sys.modules
+    print(f"pdtpu-lint: {res.files_scanned} files, "
+          f"{len(res.findings)} new finding(s), "
+          f"{len(res.baselined)} baselined, "
+          f"{len(res.suppressed)} suppressed, "
+          f"{len(res.stale_suppressions) + len(res.stale_baseline)} "
+          f"stale warning(s) in {dt:.2f}s (jax imported: "
+          f"{not jax_free})")
+    if not jax_free:
+        print("pdtpu-lint FAILED: the analyzer imported jax — it must "
+              "stay importable on a jax-less box (docs/ANALYSIS.md)")
+        return 1
+    return 0 if res.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
