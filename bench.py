@@ -280,6 +280,54 @@ def main():
         except Exception as e:  # noqa: BLE001
             extra["serve_burst_error"] = f"{type(e).__name__}: {e}"[:300]
 
+        # sharded serving (docs/SERVING.md "Sharded serving"): the
+        # TP-partitioned engine and the DP replica router need >= 2
+        # devices (a multi-chip slice, or the forced virtual CPU mesh
+        # the CI gate / tests run under).  Same CPU-plumbing /
+        # TPU-numbers split and non-fatality as the rows above.
+        if len(jax.devices()) >= 2:
+            try:
+                from decode_bench import bench_serve_tp
+                with contextlib.redirect_stdout(sys.stderr):
+                    if on_tpu:
+                        r = bench_serve_tp(tp=2, max_batch=8,
+                                           kv_cache_dtype="int8")
+                    else:
+                        r = bench_serve_tp(preset="tiny", tp=2,
+                                           max_batch=2, n_requests=4,
+                                           max_new=8,
+                                           prompt_lens=(5, 12, 9, 17),
+                                           page_size=8, repeats=1)
+                extra["serve_tp_tok_s" if on_tpu
+                      else "serve_tp_cpu_tok_s"] = r["agg_tokens_per_sec"]
+                extra["serve_tp_detail"] = {
+                    k: r[k] for k in ("tp", "max_batch", "requests", "kv",
+                                      "gen_tokens", "wall_s")}
+            except Exception as e:  # noqa: BLE001
+                extra["serve_tp_error"] = f"{type(e).__name__}: {e}"[:300]
+
+            try:
+                from decode_bench import bench_serve_dp
+                with contextlib.redirect_stdout(sys.stderr):
+                    if on_tpu:
+                        r = bench_serve_dp(replicas=2, max_batch=8,
+                                           kv_cache_dtype="int8")
+                    else:
+                        r = bench_serve_dp(preset="tiny", replicas=2,
+                                           max_batch=4, n_requests=16,
+                                           prompt_lens=(24,), max_new=32,
+                                           page_size=8)
+                pre = "serve_dp" if on_tpu else "serve_dp_cpu"
+                extra[f"{pre}_agg_tok_s"] = r["agg_tokens_per_sec"]
+                extra[f"{pre}_vs_single_replica"] = r["vs_single_replica"]
+                extra[f"{pre}_detail"] = {
+                    k: r[k] for k in ("replicas", "tp", "max_batch",
+                                      "requests", "gen_tokens", "wall_s",
+                                      "wall_tokens_per_sec",
+                                      "single_replica_tok_s")}
+            except Exception as e:  # noqa: BLE001
+                extra["serve_dp_error"] = f"{type(e).__name__}: {e}"[:300]
+
     result = {
         "metric": "llama_train_mfu",
         "value": round(mfu, 4),

@@ -31,7 +31,18 @@ from ..nn.layer import Layer
 from . import fleet
 
 
+# Trace-time mesh override (serving/distributed.py): the sharded serving
+# engine traces its compiled step under a PER-ENGINE mesh — DP replicas
+# each own a submesh, so the global fleet HCG cannot carry it.  Installed
+# only around trace-triggering calls (Engine.warmup) on one thread;
+# constrain() captures the NamedSharding into the jaxpr at trace time, so
+# steady-state dispatches never read this.
+_MESH_OVERRIDE = [None]
+
+
 def _mesh():
+    if _MESH_OVERRIDE[0] is not None:
+        return _MESH_OVERRIDE[0]
     hcg = fleet.get_hybrid_communicate_group()
     return hcg.mesh if hcg is not None else None
 

@@ -19,6 +19,7 @@ Spec grammar (code or the ``PDTPU_FAULTS`` env var)::
     entry   = site "@" index ["x" times] [":" exc]
     site    = ckpt.save | ckpt.load | collective | step | store.get | store.set
             | serve.admit | serve.prefill | serve.step | serve.cow | serve.swap
+            | serve.route | serve.replica
     index   = 0-based per-site call counter value at which firing starts
     times   = number of consecutive calls that fire (default 1)
     exc     = InjectedFault | RuntimeError | OSError | ConnectionError
@@ -50,11 +51,17 @@ __all__ = ["SITES", "InjectedFault", "FaultPlan", "FaultInjector",
 #: prefill/decode bookkeeping, copy-on-write, and KV page swap I/O —
 #: each confined by the engine to retire/re-admit of the ONE affected
 #: request (the compiled step and the other slots survive; the
-#: ``chaos-serving`` CI gate's contract).
+#: ``chaos-serving`` CI gate's contract).  ``serve.route`` /
+#: ``serve.replica`` cover the DP replica router
+#: (``serving.distributed.EngineReplicaSet``): a route fault leaves the
+#: request queued at the door (typed ``QueueFull``, retried next pump);
+#: a replica fault fails THAT replica — its in-flight requests evacuate
+#: through preempt→swap→restore onto the healthy replicas (the
+#: ``serving-dist`` CI gate's contract).
 SITES = ("ckpt.save", "ckpt.load", "collective", "step",
          "store.get", "store.set",
          "serve.admit", "serve.prefill", "serve.step", "serve.cow",
-         "serve.swap")
+         "serve.swap", "serve.route", "serve.replica")
 
 
 class InjectedFault(RuntimeError):

@@ -19,6 +19,15 @@ HTTP process over it, with graceful SIGTERM drain.  Admission failures
 are typed (``errors.AdmissionError`` and friends, all ``ValueError``
 subclasses).
 
+Sharded serving (docs/SERVING.md "Sharded serving"):
+``Engine(mesh=serving_mesh(tp))`` TP-partitions one engine over the
+mesh (params by partition spec, paged pools head-sharded over ``mp`` —
+token-identical to single-chip, zero-recompile contract intact);
+``EngineReplicaSet`` runs N engines on disjoint submeshes
+(``replica_meshes``) behind the same FrontDoor with prefix-affinity +
+least-loaded routing and replica-failure evacuation through the
+preempt→restore path.
+
 Usage::
 
     from paddle_tpu import serving
@@ -40,6 +49,8 @@ from __future__ import annotations
 
 from .block_allocator import (BlockAllocator, PagedKVCache,  # noqa: F401
                               PrefixCache, SwapManager)
+from .distributed import (EngineReplicaSet, replica_meshes,  # noqa: F401
+                          serving_mesh)
 from .engine import Engine, TokenEvent  # noqa: F401
 from .errors import (AdmissionError, BudgetUnsatisfiable,  # noqa: F401
                      QueueFull, RateLimited)

@@ -252,10 +252,17 @@ class PagedKVCache:
     :func:`quantize_kv` formula the dense int8 caches use.  The engine
     donates the whole list through its compiled step and writes the
     returned buffers back here.
+
+    ``mesh``: a serving mesh (``serving.distributed.serving_mesh``) puts
+    every pool on the mesh with the KV-HEAD axis sharded over ``mp`` and
+    the block axis replicated — block ids and tables stay mesh-invariant
+    host integers, so the allocator, prefix cache, and CoW bookkeeping
+    are untouched by sharding (docs/SERVING.md "Sharded serving").
     """
 
     def __init__(self, num_layers: int, num_blocks: int, page_size: int,
-                 num_kv_heads: int, head_dim: int, dtype="float32"):
+                 num_kv_heads: int, head_dim: int, dtype="float32",
+                 mesh=None):
         if page_size <= 0:
             raise ValueError(f"page_size must be positive, got {page_size}")
         self.num_layers = int(num_layers)
@@ -263,6 +270,23 @@ class PagedKVCache:
         self.page_size = int(page_size)
         self.num_kv_heads = int(num_kv_heads)
         self.head_dim = int(head_dim)
+        self.mesh = mesh
+        if mesh is not None:
+            # TP pool layout (docs/SERVING.md "Sharded serving"): the KV
+            # HEAD axis is split over the mesh's mp axis — each shard
+            # holds its heads' slice of EVERY block — while the block
+            # axis stays replicated so block ids, tables, and the
+            # allocator's host bookkeeping are mesh-invariant.
+            if "mp" not in mesh.axis_names:
+                raise ValueError(
+                    f"serving mesh must carry an 'mp' axis, got "
+                    f"{mesh.axis_names} (serving.distributed.serving_mesh)")
+            tp = mesh.shape["mp"]
+            if self.num_kv_heads % tp:
+                raise ValueError(
+                    f"num_kv_heads={self.num_kv_heads} not divisible by "
+                    f"the mesh's mp degree {tp} — the paged pools shard "
+                    "the head axis")
         shape = (self.num_blocks, self.page_size, self.num_kv_heads,
                  self.head_dim)
         from ..models.generation import _is_int8
@@ -277,6 +301,19 @@ class PagedKVCache:
             jdt = jnp.dtype(dtype)
             self.caches = [(jnp.zeros(shape, jdt), jnp.zeros(shape, jdt))
                            for _ in range(self.num_layers)]
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            # (num_blocks, page, H_kv[, D]) pools and int8 scale arrays:
+            # head axis over mp, everything else replicated.  The spec
+            # deliberately omits the trailing dim (jax normalizes output
+            # specs that way) so the warmup dispatch and every
+            # steady-state dispatch see IDENTICAL input shardings — a
+            # trailing-None mismatch would add a second jit-cache entry
+            # and break the one-executable contract the serving gates
+            # check.
+            sharding = NamedSharding(mesh, P(None, None, "mp"))
+            self.caches = [tuple(jax.device_put(c, sharding)
+                                 for c in layer) for layer in self.caches]
         self.allocator = BlockAllocator(self.num_blocks)
 
     @property

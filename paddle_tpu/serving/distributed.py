@@ -1,0 +1,617 @@
+"""Sharded serving: TP paged decode and DP replica routing over the mesh.
+
+The serving stack below this module is single-chip; ``distributed/``
+already carries the full hybrid mesh (docs/PARALLELISM.md).  This module
+composes them two ways (docs/SERVING.md "Sharded serving"):
+
+**Tensor parallelism** — a model too big for one chip serves through ONE
+engine whose compiled step is GSPMD-partitioned over a mesh's ``mp``
+axis: :func:`serving_mesh` builds the mesh, ``Engine(mesh=...)`` lands
+the parameters sharded by their partition specs
+(:func:`shard_serving_params`) and the paged KV pools with the HEAD axis
+sharded / the block axis replicated (``block_allocator.PagedKVCache``).
+Block ids, tables, the allocator, prefix cache, and CoW bookkeeping are
+host integers untouched by sharding, so the whole single-chip contract
+carries over: warmup still compiles exactly the same program set (one
+step, one CoW, the two swap gather/scatter), churn triggers zero
+compiles, greedy outputs stay token-identical to the single-chip
+engine.  The model's TP sharding constraints
+(``mp_layers.constrain``) are anchored at trace time through
+:func:`trace_mesh` — per ENGINE, not through the global fleet state, so
+replicas can each trace under their own submesh.
+
+**Data parallelism** — throughput beyond one engine comes from
+:class:`EngineReplicaSet`: N independent engines (each single-chip or
+TP-sharded on its own submesh, :func:`replica_meshes`) behind the
+existing :class:`~paddle_tpu.serving.FrontDoor`.  The set duck-types the
+Engine surface the door drives (``add_request``/``step``/``run``/
+``has_work``/aggregate scheduler+kv facades), so multi-tenant policy,
+shedding, and SLO backpressure stay in the door while THIS class decides
+*which replica*:
+
+- **least-loaded dispatch** scored from the live per-replica signals the
+  ``serve.*`` telemetry exports — queue depth, free KV blocks, a rolling
+  TTFT p95 — engine-local when telemetry is off;
+- **prefix-affinity routing**: the chained page digests of the prompt
+  (``PrefixCache.page_keys``) are probed against every replica's prefix
+  cache, and a repeat tenant pins to the replica already holding its
+  pages (a shared system prompt must not re-prefill once per replica);
+- **replica-failure handling**: a replica that throws (or an injected
+  ``serve.replica`` fault) is marked unhealthy and EVACUATED — running
+  requests ride the existing preempt path (KV pages swap to host RAM),
+  then every queued/preempted state migrates to a healthy replica whose
+  restore path scatters the same bytes into its own pools; greedy
+  outputs complete token-identical instead of being dropped.  A hard
+  failure (the swap itself dies) falls back to a fresh re-prefill of the
+  victim, which under greedy decoding regenerates the same tokens.
+
+Stepping is two-phase (``Engine.step_begin``/``step_finish``): the set
+dispatches EVERY healthy replica's compiled step back-to-back, then
+finishes them in order, so replica ``j``'s device compute overlaps
+replica ``i``'s host bookkeeping and device sync — that overlap is where
+the aggregate-throughput win over one replica comes from (the
+``serve_dp_agg_tok_s`` bench row and the ``serving-dist`` CI gate).
+
+Telemetry: replica-labelled gauges (``serve.replica[i].free_blocks`` /
+``queue_depth`` / ``active``), routed/requeued/failure counters, and
+``serve_route`` / ``serve_replica_fail`` events
+(``tools/telemetry_report.py`` folds a per-replica table).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import warnings
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import observability as obs
+from ..distributed import mp_layers
+from ..distributed.topology import HybridTopology
+from ..resilience import _state as _rs_state
+from .block_allocator import PrefixCache
+from .errors import AdmissionError, QueueFull
+from .frontdoor import relieve_block_pressure
+
+__all__ = ["EngineReplicaSet", "replica_meshes", "serving_mesh",
+           "shard_serving_params", "trace_mesh"]
+
+# rolling per-replica TTFT window the router scores p95 over: small
+# enough to track load shifts, large enough to ride out one burst
+_TTFT_WINDOW = 64
+
+
+def serving_mesh(tp: int = 1, devices: Optional[Sequence] = None):
+    """A serving mesh: the standard hybrid axis order with ``mp=tp`` and
+    every other axis degree 1, over ``devices`` (default: the first
+    ``tp`` of ``jax.devices()``).  Carrying ALL the standard axis names
+    (not just ``mp``) lets the model's existing sharding constraints —
+    which mention ``dp``/``sharding`` for activations — apply unchanged
+    (docs/PARALLELISM.md)."""
+    if devices is None:
+        devices = jax.devices()[:tp]
+    if len(devices) < tp:
+        raise ValueError(
+            f"serving_mesh(tp={tp}) needs {tp} devices, got "
+            f"{len(devices)}")
+    return HybridTopology(mp_degree=tp).build_mesh(devices)
+
+
+def replica_meshes(n_replicas: int, tp: int = 1,
+                   devices: Optional[Sequence] = None):
+    """``n_replicas`` disjoint serving meshes of ``tp`` devices each —
+    the DP layout: replica ``i`` owns devices ``[i*tp, (i+1)*tp)``."""
+    if devices is None:
+        devices = jax.devices()
+    need = n_replicas * tp
+    if len(devices) < need:
+        raise ValueError(
+            f"replica_meshes({n_replicas}, tp={tp}) needs {need} "
+            f"devices, got {len(devices)}")
+    return [serving_mesh(tp, devices[i * tp:(i + 1) * tp])
+            for i in range(n_replicas)]
+
+
+@contextlib.contextmanager
+def trace_mesh(mesh):
+    """Install ``mesh`` as the trace-time mesh the model's TP sharding
+    constraints (``mp_layers.constrain``) resolve against — around
+    trace-triggering calls only (``Engine.warmup``).  The constraint is
+    captured into the jaxpr, so steady-state dispatches never read the
+    override; DP replicas therefore trace one at a time under their own
+    submesh without touching the global fleet state."""
+    prev = mp_layers._MESH_OVERRIDE[0]
+    mp_layers._MESH_OVERRIDE[0] = mesh
+    try:
+        yield
+    finally:
+        mp_layers._MESH_OVERRIDE[0] = prev
+
+
+def shard_serving_params(model, params: Dict[str, jax.Array], mesh):
+    """Commit a ``serving_params`` dict onto ``mesh``, each array under
+    the partition spec its layer declared at creation
+    (``create_parameter(partition=...)`` — the same specs the training
+    path shards by).  Un-annotated parameters and buffers replicate."""
+    meta = model.param_meta()
+    out = {}
+    for name, arr in params.items():
+        part = meta[name].partition if name in meta else None
+        if part is None:
+            spec = P()
+        elif isinstance(part, P):
+            spec = part
+        else:
+            spec = P(*part)
+        out[name] = jax.device_put(arr, NamedSharding(mesh, spec))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Engine-surface facades: what FrontDoor reads off its engine, aggregated
+# ---------------------------------------------------------------------------
+
+class _AggAllocator:
+    """Pool-occupancy view over the HEALTHY replicas' allocators: a
+    failed replica's (evacuated, empty) pool must drop out of both the
+    numerator and the denominator, or the door's SLO-occupancy
+    backpressure deflates exactly when the survivors are saturated."""
+
+    def __init__(self, rs: "EngineReplicaSet"):
+        self._rs = rs
+
+    @property
+    # requires-lock: _lock — reads the health map
+    def used_blocks(self) -> int:
+        return sum(r.kv.allocator.used_blocks
+                   for r in self._rs._healthy_replicas())
+
+    @property
+    # requires-lock: _lock — reads the health map
+    def free_blocks(self) -> int:
+        return sum(r.kv.allocator.free_blocks
+                   for r in self._rs._healthy_replicas())
+
+    # requires-lock: _lock — reads the health map
+    def can_allocate(self, n: int) -> bool:
+        return any(r.kv.allocator.can_allocate(n)
+                   for r in self._rs._healthy_replicas())
+
+
+class _AggKV:
+    """KV-capacity view (``FrontDoor._occupancy`` reads this), healthy
+    replicas only — see :class:`_AggAllocator`."""
+
+    def __init__(self, rs: "EngineReplicaSet"):
+        self._rs = rs
+        self.allocator = _AggAllocator(rs)
+
+    @property
+    # requires-lock: _lock — reads the health map
+    def num_blocks(self) -> int:
+        return sum(r.kv.num_blocks for r in self._rs._healthy_replicas())
+
+
+class _AggScheduler:
+    """Admission-pressure view (``FrontDoor`` room/queue checks)."""
+
+    def __init__(self, rs: "EngineReplicaSet"):
+        self._rs = rs
+
+    # requires-lock: _lock — sums the replicas' waiting queues
+    def queue_depth(self) -> int:
+        return sum(r.scheduler.queue_depth() for r in self._rs.replicas)
+
+    def blocks_for(self, total_len: int) -> int:
+        return self._rs.replicas[0].scheduler.blocks_for(total_len)
+
+    def active(self) -> List:
+        """All replicas' running (slot, state) pairs — slot indices are
+        replica-LOCAL (consumers count entries: the server's /healthz)."""
+        return [p for r in self._rs.replicas for p in r.scheduler.active()]
+
+
+class EngineReplicaSet:
+    """N independent serving engines behind one Engine-shaped surface.
+
+    ``engines`` must share geometry (``max_seq_len``, ``page_size``,
+    pool dtype/arity) so a preempted request's host payload restores
+    into ANY replica's pools — that is what replica-failure migration
+    leans on.  Meshes may differ per replica (``replica_meshes``).
+
+    Drive it exactly like an Engine — ``add_request`` routes, ``step``
+    dispatches every healthy replica then finishes them in order,
+    ``run``/``stream`` drain — or put a :class:`FrontDoor` in front for
+    multi-tenant policy; the door's staging, preemption, and drain
+    protocols all delegate here unchanged.
+
+    Cross-thread contract: same as the Engine's — behind a
+    ``ServingServer``, handler threads route through ``FrontDoor.submit``
+    while the loop thread steps, serialized by ``ServingServer._lock``;
+    single-threaded drivers hold it trivially (pdtpu-lint
+    lock-discipline)."""
+
+    def __init__(self, engines: Sequence, *, prefix_affinity: bool = True):
+        engines = list(engines)
+        if not engines:
+            raise ValueError("EngineReplicaSet needs at least one engine")
+        head = engines[0]
+
+        def _pool_dtypes(e):
+            # the actual per-layer pool leaf dtypes (covers fp32 vs
+            # bf16, and int8 4-tuple arity), not just the quantized flag
+            return tuple(str(c.dtype) for c in e.kv.caches[0])
+
+        for i, e in enumerate(engines[1:], start=1):
+            same = (e.max_seq_len == head.max_seq_len
+                    and e.page_size == head.page_size
+                    and e.kv.num_blocks == head.kv.num_blocks
+                    and e.kv.num_kv_heads == head.kv.num_kv_heads
+                    and e.kv.head_dim == head.kv.head_dim
+                    and e.kv.num_layers == head.kv.num_layers
+                    and _pool_dtypes(e) == _pool_dtypes(head))
+            if not same:
+                raise ValueError(
+                    f"replica {i} geometry differs from replica 0 — "
+                    "failure migration restores one replica's swapped "
+                    "pages into another's pools and routing assumes any "
+                    "replica can hold any admitted request, so "
+                    "max_seq_len, page_size, num_blocks, KV dims and "
+                    "pool dtype must match")
+        self.replicas = engines
+        self.prefix_affinity = bool(prefix_affinity)
+        self.max_seq_len = head.max_seq_len
+        self.page_size = head.page_size
+        self.kv = _AggKV(self)
+        self.scheduler = _AggScheduler(self)
+        # Cross-thread state (HTTP handler threads route via
+        # FrontDoor.submit while the loop thread steps — serialized by
+        # ServingServer._lock; see the class docstring):
+        self._placements: Dict[str, int] = {}    # guarded_by: _lock
+        self._health: List[bool] = [True] * len(engines)  # guarded_by: _lock
+        # rolling TTFT ms per replica: the router-local p95 signal
+        # (engine-local so scoring works with telemetry disabled)
+        self._ttft = [collections.deque(maxlen=_TTFT_WINDOW)
+                      for _ in engines]          # guarded_by: _lock
+        self.failures = 0            # lifetime replica failures
+        self.requeued = 0            # lifetime requests migrated off a
+        #                              failed replica
+        # placement entries outlive their engine states only until the
+        # next sweep: beyond this bound, step() drops every rid whose
+        # state has been evicted (keep_finished), so a long-running
+        # router's memory stays bounded like the engines' own retention
+        self._placements_cap = 2 * sum(
+            e.max_batch + e.keep_finished for e in engines) + 64
+
+    # -- Engine surface ----------------------------------------------------
+
+    def warmup(self) -> "EngineReplicaSet":
+        for r in self.replicas:
+            r.warmup()
+        return self
+
+    @property
+    # requires-lock: _lock — merges the replicas' state dicts
+    def _states(self):
+        return collections.ChainMap(*(r._states for r in self.replicas))
+
+    @property
+    def kv_blocks_used(self) -> int:
+        return sum(r.kv_blocks_used for r in self.replicas)
+
+    @property
+    # requires-lock: _lock — reads the health map
+    def max_batch(self) -> int:
+        """Healthy staging capacity: the FrontDoor bounds its engine
+        staging at this depth, and a failed replica's slots must drop
+        out with it — a static all-replicas sum would let the door
+        over-stage into the survivors exactly when capacity halved
+        (same healthy-only rule as the kv/allocator facades)."""
+        return sum(r.max_batch for r in self._healthy_replicas())
+
+    @property
+    def budget_num_blocks(self) -> int:
+        """The can-this-EVER-fit bound the FrontDoor vets against: one
+        replica's pool (geometry is homogeneous), NOT the aggregate —
+        a request no single replica can hold must shed up front as
+        ``budget``, not be answered admitted and dropped at pump."""
+        return self.replicas[0].kv.num_blocks
+
+    # requires-lock: _lock
+    def has_work(self) -> bool:
+        return any(r.has_work() for i, r in enumerate(self.replicas)
+                   if self._health[i])
+
+    # requires-lock: _lock
+    def output_ids(self, request_id: str) -> List[int]:
+        return self.replicas[self._placements[request_id]].output_ids(
+            request_id)
+
+    def prefix_stats(self) -> Dict[str, float]:
+        """Summed prefix-cache counters across replicas."""
+        out: Dict[str, float] = {}
+        for r in self.replicas:
+            for k, v in r.prefix_stats().items():
+                if k != "hit_rate":
+                    out[k] = out.get(k, 0) + v
+        probes = out.get("hits", 0) + out.get("misses", 0)
+        out["hit_rate"] = (out.get("hits", 0) / probes) if probes else 0.0
+        return out
+
+    # requires-lock: _lock
+    def preempt(self, request_id: str, **kw) -> bool:
+        idx = self._placements.get(request_id)
+        if idx is None:
+            return False
+        return self.replicas[idx].preempt(request_id, **kw)
+
+    # requires-lock: _lock — reads the health map for the door's policy
+    def relieve_pressure(self, priority_of) -> None:
+        """The FrontDoor's block-pressure preemption, applied per
+        healthy replica (each replica's pool starves independently)."""
+        for r in self._healthy_replicas():
+            relieve_block_pressure(r, priority_of)
+
+    # -- routing -----------------------------------------------------------
+
+    # requires-lock: _lock
+    def _healthy_replicas(self):
+        return [r for i, r in enumerate(self.replicas) if self._health[i]]
+
+    # requires-lock: _lock
+    def _ttft_p95(self, i: int) -> float:
+        win = sorted(self._ttft[i])
+        return win[max(0, int(0.95 * len(win)) - 1)] if win else 0.0
+
+    # requires-lock: _lock
+    def _load_key(self, i: int):
+        """Least-loaded ordering: shortest queue first, most free KV
+        blocks next, best rolling TTFT p95 last — the same three
+        signals the per-replica ``serve.*`` gauges export."""
+        r = self.replicas[i]
+        return (r.scheduler.queue_depth(),
+                -r.kv.allocator.free_blocks,
+                self._ttft_p95(i), i)
+
+    # requires-lock: _lock
+    def _pick_replica(self, prompt_ids) -> tuple:
+        """(replica index, affinity page hits, page keys) for one
+        prompt.  The chained page digests are hashed ONCE here and
+        forwarded to the chosen engine's submit, which would otherwise
+        re-run the O(prompt) blake2b chain (the PR-5 hash-once rule)."""
+        healthy = [i for i in range(len(self.replicas)) if self._health[i]]
+        if not healthy:
+            # typed TRANSIENT rejection, not a plain AdmissionError: the
+            # front door's pump would shed that as reason="budget" and
+            # silently drop requests it already answered admitted=True.
+            # QueueFull keeps them queued at the door (an operator-visible
+            # outage, retried if replicas are revived/replaced).
+            raise QueueFull(
+                "no healthy replicas — every engine in the set has "
+                "failed; requests stay queued until the set is revived")
+        keys = None
+        hits = 0
+        if self.prefix_affinity:
+            by_hits: Dict[int, int] = {}
+            for i in healthy:
+                pc = self.replicas[i].prefix_cache
+                if pc is None:
+                    continue
+                if keys is None:
+                    keys = PrefixCache.page_keys(
+                        np.asarray(prompt_ids, np.int32).reshape(-1),
+                        self.page_size)
+                if keys:
+                    by_hits[i] = len(pc.lookup(keys))
+            hits = max(by_hits.values()) if by_hits else 0
+            if hits > 0:
+                pinned = [i for i, h in by_hits.items() if h == hits]
+                return min(pinned, key=self._load_key), hits, keys
+        return min(healthy, key=self._load_key), 0, keys
+
+    # requires-lock: _lock — the routing entry point (door pump / direct)
+    def add_request(self, prompt_ids, **kw) -> str:
+        """Route one request to the best healthy replica and queue it
+        there.  An injected ``serve.route`` fault surfaces as a typed
+        :class:`QueueFull` BEFORE any routing state mutates — the front
+        door keeps the request queued and retries next pump."""
+        fi = _rs_state.FAULTS[0]
+        if fi is not None:
+            try:
+                fi("serve.route")
+            except Exception as e:  # noqa: BLE001
+                raise QueueFull(
+                    f"routing fault ({type(e).__name__}: {e}) — the "
+                    "request stays queued and routes next pump") from e
+        rid = kw.get("request_id")
+        if rid is not None and rid in self._states:
+            raise AdmissionError(
+                f"request_id {rid!r} is already in use by a live or "
+                "retained request (on any replica)")
+        idx, hits, keys = self._pick_replica(prompt_ids)
+        if keys is not None:
+            kw["_page_keys"] = keys
+        rid = self.replicas[idx].add_request(prompt_ids, **kw)
+        self._placements[rid] = idx
+        reg = obs.get_registry()
+        if reg is not None:
+            reg.counter("serve.routed").inc()
+            reg.counter(f"serve.replica[{idx}].routed").inc()
+        obs.emit_event("serve_route", id=rid, replica=idx,
+                       affinity_hits=hits)
+        return rid
+
+    # -- stepping ----------------------------------------------------------
+
+    # requires-lock: _lock — the loop-thread entry point
+    def step(self) -> List:
+        """One step across the set: DISPATCH every healthy replica's
+        compiled step back-to-back (``step_begin``), then finish them in
+        dispatch order — replica ``j`` computes while replica ``i``
+        syncs and does host bookkeeping, which is where the aggregate
+        tok/s win over a single replica comes from.
+
+        A replica whose step (or injected ``serve.replica`` fault)
+        raises is failed and evacuated: running requests preempt to host
+        RAM and migrate, queued ones migrate as-is — nothing is
+        dropped."""
+        fi = _rs_state.FAULTS[0]
+        pendings = []
+        for i, r in enumerate(self.replicas):
+            if not self._health[i] or not r.has_work():
+                continue
+            try:
+                if fi is not None:
+                    fi("serve.replica")
+                pendings.append((i, r.step_begin()))
+            except Exception as e:  # noqa: BLE001
+                self._fail_replica(i, e)
+        events: List = []
+        for i, pending in pendings:
+            r = self.replicas[i]
+            try:
+                evs = r.step_finish(pending)
+            except Exception as e:  # noqa: BLE001
+                self._fail_replica(i, e)
+                continue
+            for ev in evs:
+                if ev.finished:
+                    st = r._states.get(ev.request_id)
+                    if st is not None and st.first_token_t is not None:
+                        self._ttft[i].append(
+                            (st.first_token_t - st.submit_t) * 1e3)
+            events.extend(evs)
+        if len(self._placements) > self._placements_cap:
+            # keep_finished eviction on the engines has outpaced the
+            # routing map: drop placements whose state is gone (queued,
+            # active, and retained requests all live in some _states)
+            live = self._states
+            self._placements = {rid: i for rid, i in
+                                self._placements.items() if rid in live}
+        reg = obs.get_registry()
+        if reg is not None:
+            for i, r in enumerate(self.replicas):
+                alloc = r.kv.allocator
+                reg.gauge(f"serve.replica[{i}].free_blocks").set(
+                    alloc.free_blocks)
+                reg.gauge(f"serve.replica[{i}].queue_depth").set(
+                    r.scheduler.queue_depth())
+                reg.gauge(f"serve.replica[{i}].active").set(
+                    len(r.scheduler.active()))
+        return events
+
+    def stream(self):
+        """Generator over token events until the set drains."""
+        while self.has_work():
+            for ev in self.step():
+                yield ev
+
+    # requires-lock: _lock — arms every replica's shared drain capture
+    def _begin_drain(self) -> Dict[str, List[int]]:
+        """One SHARED drain dict across replicas: each engine's
+        finish-time capture writes into it, so the ``run()`` contract
+        (complete even past ``keep_finished`` eviction, and across a
+        mid-drain replica migration) holds set-wide."""
+        drained: Dict[str, List[int]] = {}
+        for r in self.replicas:
+            for rid, st in r._states.items():
+                if st.finished and not st.drained:
+                    st.drained = True
+                    drained[rid] = list(st.output_ids)
+            r._drain_capture = drained
+        return drained
+
+    # requires-lock: _lock
+    def _end_drain(self) -> None:
+        for r in self.replicas:
+            r._drain_capture = None
+
+    def run(self) -> Dict[str, List[int]]:
+        """Drain every replica; same contract as ``Engine.run()``."""
+        drained = self._begin_drain()
+        try:
+            while self.has_work():
+                self.step()
+        finally:
+            self._end_drain()
+        return drained
+
+    # -- replica failure ---------------------------------------------------
+
+    # requires-lock: _lock
+    def _fail_replica(self, idx: int, exc: Exception) -> None:
+        """Mark replica ``idx`` unhealthy and EVACUATE it: running
+        requests ride the existing preempt path (KV pages swap to host
+        RAM), then every waiting state — fresh, mid-prefill, or just
+        preempted — migrates to a healthy replica, whose restore path
+        scatters the same bytes into its own pools.  A hard failure in
+        the swap itself degrades that request to a fresh re-prefill
+        (greedy decoding regenerates the same tokens)."""
+        self._health[idx] = False
+        self.failures += 1
+        warnings.warn(
+            f"serving replica {idx} failed and was evacuated "
+            f"({type(exc).__name__}: {exc})", RuntimeWarning,
+            stacklevel=3)
+        rep = self.replicas[idx]
+        for _slot, st in list(rep.scheduler.active()):
+            try:
+                rep.preempt(st.request.request_id,
+                            reason="replica_failure")
+            except Exception:  # noqa: BLE001 — hard failure: swap died
+                rep.scheduler.release_slot(st)
+                self._reset_to_fresh(st)
+                rep.scheduler.requeue(st, head=True)
+        moved = 0
+        while rep.scheduler.waiting:
+            st = rep.scheduler.waiting.popleft()
+            rid = st.request.request_id
+            rep._states.pop(rid, None)
+            try:
+                tgt = min((i for i in range(len(self.replicas))
+                           if self._health[i]), key=self._load_key)
+            except ValueError:
+                raise RuntimeError(
+                    "no healthy replicas left to evacuate onto") from exc
+            self.replicas[tgt]._states[rid] = st
+            self.replicas[tgt].scheduler.waiting.append(st)
+            self._placements[rid] = tgt
+            moved += 1
+        self.requeued += moved
+        reg = obs.get_registry()
+        if reg is not None:
+            reg.counter("serve.replica_failures").inc()
+            reg.counter(f"serve.replica[{idx}].failed").inc()
+            if moved:
+                reg.counter(f"serve.replica[{idx}].requeued").inc(moved)
+        obs.emit_event("serve_replica_fail", replica=idx,
+                       exc=type(exc).__name__, message=str(exc)[:200],
+                       moved=moved)
+
+    @staticmethod
+    def _reset_to_fresh(st) -> None:
+        """Rewind a request state to pre-prefill (its KV is gone): the
+        degraded path when a failed replica cannot even swap out.  The
+        prompt re-prefills on the target replica; already-emitted
+        greedy tokens are regenerated identically (temperature > 0
+        re-samples), so ``run()``'s finish-time dict stays correct —
+        but a STREAMING consumer (``stream()``/``on_token``/SSE) sees
+        the regenerated prefix a second time.  The trade for not
+        dropping the request; the soft path (swap succeeded) resumes
+        mid-sequence and never re-emits."""
+        st.swapped = None
+        st.kv_len = 0
+        st.pending_token = None
+        del st.output_ids[:]
+        st.text_len = 0
+        st.detok_offset = 0
+        st.num_shared = 0
+        st.num_cowed = 0
+        st.cached_tokens = 0
+        st.borrowed = set()
+        st.cow_spare = {}

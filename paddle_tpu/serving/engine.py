@@ -57,12 +57,14 @@ Telemetry (all zero-overhead when observability is disabled):
 ``isolated_failures``
 + ``serve_request`` / ``serve_step`` / ``serve_finish`` /
 ``serve_preempt`` / ``serve_restore`` / ``serve_isolated_failure``
-events and a ``serve.step`` flight-recorder span per step.
+events and ``serve.step`` / ``serve.step.finish`` flight-recorder spans
+per step (dispatch and sync/post-processing phases).
 """
 
 from __future__ import annotations
 
 import collections
+import contextlib
 import time
 import traceback
 import warnings
@@ -166,6 +168,15 @@ class Engine:
     ``retry``: the :class:`resilience.RetryPolicy` wrapped around
     host-side serving I/O (the preemption swap dispatches); defaults to
     3 attempts with 20 ms base backoff.
+
+    ``mesh``: a serving mesh (``serving.distributed.serving_mesh``)
+    makes this engine TENSOR-PARALLEL: parameters land sharded by their
+    partition specs, the paged KV pools shard their head axis over the
+    mesh's ``mp`` axis (block axis replicated, so the allocator/prefix
+    cache/CoW host bookkeeping is untouched), and the one compiled step
+    + CoW + swap programs partition under GSPMD — same zero-recompile
+    contract, greedy outputs token-identical to the single-chip engine
+    (docs/SERVING.md "Sharded serving").
     """
 
     def __init__(self, model, *, max_batch: int = 8,
@@ -178,7 +189,8 @@ class Engine:
                  detokenize: Optional[Callable] = None, seed: int = 0,
                  keep_finished: int = 1024,
                  max_queue: Optional[int] = None,
-                 retry: Optional[RetryPolicy] = None):
+                 retry: Optional[RetryPolicy] = None,
+                 mesh=None):
         if not _paged_supported(model):
             raise NotImplementedError(
                 f"{type(model).__name__} does not support the paged "
@@ -215,8 +227,9 @@ class Engine:
         n_layers, kv_heads, head_dim = _kv_geometry(model)
         dtype = kv_cache_dtype if kv_cache_dtype is not None else \
             getattr(model.cfg, "dtype", "float32")
+        self.mesh = mesh
         self.kv = PagedKVCache(n_layers, num_blocks, self.page_size,
-                               kv_heads, head_dim, dtype=dtype)
+                               kv_heads, head_dim, dtype=dtype, mesh=mesh)
         self.prefix_cache = PrefixCache(self.kv.allocator, self.page_size) \
             if enable_prefix_caching else None
         self.scheduler = Scheduler(self.max_batch, self.page_size,
@@ -232,6 +245,9 @@ class Engine:
             RetryPolicy(max_attempts=3, backoff_s=0.02)
         self._swap = SwapManager(self.kv, chunk=self.max_blocks_per_seq)
         self.params = serving_params(model)
+        if mesh is not None:
+            from .distributed import shard_serving_params
+            self.params = shard_serving_params(model, self.params, mesh)
         self._detokenize = detokenize
         self._key = jax.random.key(seed)
         self._step_i = 0
@@ -256,6 +272,14 @@ class Engine:
         self._drain_capture: Optional[Dict[str, List[int]]] = \
             None                                     # guarded_by: _lock
         self._cow_copies = 0
+        # lifetime serving-work accounting: seconds this engine spent in
+        # its own step phases (dispatch + sync/post-processing — NOT
+        # time a replica-set loop spent on its siblings) and tokens it
+        # emitted.  tokens_emitted / busy_s is the per-replica rate the
+        # DP aggregate-throughput projection sums (tools/decode_bench).
+        self.busy_s = 0.0
+        self.tokens_emitted = 0
+        self._warmed = False
         self._build_fns()
 
     # -- compiled paths ----------------------------------------------------
@@ -294,6 +318,18 @@ class Engine:
         self._step_fn = jax.jit(step_fn, donate_argnums=(1,))
         self._cow_fn = jax.jit(cow_fn, donate_argnums=(0,))
 
+    def _trace_mesh(self):
+        """Mesh-override context for trace-triggering calls: under a
+        serving mesh the model's TP sharding constraints
+        (``mp_layers.constrain``) must see THIS engine's mesh while the
+        step traces — DP replicas each trace under their own submesh, so
+        the global fleet state cannot carry it.  No-op single-chip;
+        steady-state dispatches hit the jit cache and never re-enter."""
+        if self.mesh is None:
+            return contextlib.nullcontext()
+        from .distributed import trace_mesh
+        return trace_mesh(self.mesh)
+
     def warmup(self) -> "Engine":
         """Compile the unified ragged step, the CoW helper, and the two
         swap programs (preemption gather/scatter) up front.
@@ -303,7 +339,7 @@ class Engine:
         no pool pollution.  After this, serving traffic compiles NOTHING
         — preemption, restore, and fault-isolation churn included (the
         serving-smoke and chaos-serving gates' contract)."""
-        with span("serve.warmup"):
+        with span("serve.warmup"), self._trace_mesh():
             b, mb, c = self.max_batch, self.max_blocks_per_seq, \
                 self.prefill_chunk
             oob = np.full((b, mb), self.kv.oob_block, np.int32)
@@ -322,6 +358,9 @@ class Engine:
             jax.block_until_ready(jax.tree_util.tree_leaves(caches)[0])
             self.kv.caches = caches
             self._swap.warmup()
+        # only AFTER the work: a failed warmup must leave step_begin's
+        # auto-warmup safety net armed for mesh engines
+        self._warmed = True
         return self
 
     # -- request lifecycle -------------------------------------------------
@@ -332,7 +371,8 @@ class Engine:
                     eos_token_id: Optional[int] = None,
                     on_token: Optional[Callable] = None,
                     request_id: Optional[str] = None,
-                    tenant: Optional[str] = None) -> str:
+                    tenant: Optional[str] = None,
+                    _page_keys: Optional[List[bytes]] = None) -> str:
         """Queue one request; returns its id.  The request joins the
         running batch at the next ``step()`` with a free slot and enough
         free blocks for its budget (prompt + max_new_tokens, minus any
@@ -376,7 +416,10 @@ class Engine:
                 f"{self.page_size}) but the pool has only "
                 f"{self.kv.num_blocks} — raise num_blocks or lower the "
                 "budget")
-        st = self.scheduler.submit(req)
+        # _page_keys: prompt page digests a router already computed for
+        # its affinity probe — forwarded so submit() does not re-run the
+        # O(prompt) blake2b chain (serving/distributed.py)
+        st = self.scheduler.submit(req, page_keys=_page_keys)
         self._states[req.request_id] = st
         reg = obs.get_registry()
         if reg is not None:
@@ -640,20 +683,20 @@ class Engine:
                     f"({traceback.format_exc(limit=3).strip()})",
                     RuntimeWarning, stacklevel=2)
 
-    def step(self) -> List[TokenEvent]:
-        """Admit what fits, run ONE unified ragged step (prefill chunks
-        + decode tokens together), retire what finished.  Returns the
-        tokens emitted (one per decoded / prompt-completed request).
-
-        Per-request fault isolation (docs/RESILIENCE.md "Serving
-        sites"): a host-side failure in one request's bookkeeping —
-        admission, CoW, prefill/decode post-processing, or an injected
-        ``serve.*`` fault — never tears down the compiled step or the
-        other slots.  The victim is rewound to its pre-span snapshot,
-        preempted to host RAM, and transparently re-admitted; everyone
-        else's events are delivered normally."""
+    def step_begin(self):
+        """Admit + plan + CoW + DISPATCH the compiled step without
+        waiting for the device; returns the opaque pending handle
+        :meth:`step_finish` consumes.  The two-phase split is what lets
+        a DP replica set keep every replica's device busy: dispatch all
+        replicas back-to-back, then finish them in order, so replica
+        ``j``'s compute overlaps replica ``i``'s host bookkeeping
+        (serving/distributed.py)."""
+        if self.mesh is not None and not self._warmed:
+            # a mesh engine must never trace its programs outside the
+            # trace-mesh context (the TP constraints would resolve
+            # against global fleet state, or nothing) — warm up now
+            self.warmup()
         t0 = time.perf_counter()
-        events: List[TokenEvent] = []
         with span("serve.step", emit=False):
             self._admit_all()
             plan = self.scheduler.plan_spans(self.prefill_chunk,
@@ -661,6 +704,7 @@ class Engine:
             if plan:
                 plan = self._run_cow(plan)
             live_tokens = sum(n for _, _, n, _ in plan)
+            nxt = None
             if plan:
                 tokens, tables, starts, lens, temps = \
                     self.scheduler.span_arrays(plan, self.prefill_chunk)
@@ -675,57 +719,37 @@ class Engine:
                     jnp.asarray(np.int32(self._step_i)))
                 self.kv.caches = caches
                 self._step_i += 1
-                # np.asarray is the device sync: JAX dispatch is async,
-                # so the TTFT clock below must stop AFTER the first
-                # token materializes, or it reports queueing overhead
-                nxt = np.asarray(nxt)
-                fi = _rs_state.FAULTS[0]
-                for i, st, n, is_prefill in plan:
-                    # pre-span snapshot: isolation rewinds to here, and
-                    # re-running the span after restore is idempotent
-                    # (the dispatch above already wrote this span's KV;
-                    # the rewound re-run rewrites identical bytes)
-                    snap = (st.kv_len, st.pending_token,
-                            len(st.output_ids), st.text_len,
-                            st.detok_offset)
-                    try:
-                        if fi is not None:
-                            fi("serve.prefill" if is_prefill
-                               else "serve.step")
-                        st.kv_len += n
-                        if is_prefill and st.prefilling:
-                            continue    # mid-prefill: sample discarded
-                        if is_prefill:
-                            # prompt complete: this sample is the
-                            # request's first token — TTFT stops here
-                            self._register_prefix(st)
-                            st.first_token_t = time.perf_counter()
-                            req = st.request
-                            reg = obs.get_registry()
-                            if reg is not None:
-                                reg.histogram("serve.ttft_ms").observe(
-                                    (st.first_token_t - st.submit_t) * 1e3)
-                                if st.num_shared:
-                                    reg.counter("serve.prefix_hits").inc(
-                                        st.num_shared)
-                                misses = len(st.page_keys) - st.num_shared
-                                if misses:
-                                    reg.counter(
-                                        "serve.prefix_misses").inc(misses)
-                            obs.emit_event(
-                                "serve_request", id=req.request_id,
-                                tenant=req.tenant,
-                                prompt_len=int(req.prompt_ids.size),
-                                slot=st.slot, blocks=len(st.blocks),
-                                cached_tokens=st.cached_tokens)
-                        self._emit(st, int(nxt[i]), events)
-                    except Exception as e:  # noqa: BLE001
-                        st.kv_len, st.pending_token = snap[0], snap[1]
-                        del st.output_ids[snap[2]:]
-                        st.text_len, st.detok_offset = snap[3], snap[4]
-                        self._isolate(st, e)
+        # busy accounting covers THIS engine's own engagement only
+        # (begin and finish timed separately): under a replica set the
+        # phases interleave across engines, so begin-to-finish wall
+        # clock would charge every engine for its siblings' slices.
+        # The same own-time sum feeds serve.step_ms / serve.tok_s in
+        # step_finish and the DP throughput projection (decode_bench).
+        begin_s = time.perf_counter() - t0
+        self.busy_s += begin_s
+        return plan, nxt, live_tokens, begin_s
+
+    def step_finish(self, pending) -> List[TokenEvent]:
+        """Wait for a :meth:`step_begin` dispatch and run its host
+        post-processing: sample consumption, retirement, events,
+        per-request fault isolation, telemetry.  ``step_begin`` and
+        ``step_finish`` must alternate on one engine (the replica set's
+        loop does); :meth:`step` composes them for everyone else."""
+        plan, nxt, live_tokens, begin_s = pending
+        tf = time.perf_counter()
+        events: List[TokenEvent] = []
+        # own span so a crash in device sync / post-processing still
+        # lands inside a serve.step.* breadcrumb pair on the flight
+        # ring (the serve.step span closed with step_begin's dispatch)
+        with span("serve.step.finish", emit=False):
+            self._finish_events(plan, nxt, events)
         n_tok = len(events)
-        dt = time.perf_counter() - t0
+        now = time.perf_counter()
+        # this engine's own step time: begin + finish phases, excluding
+        # any sibling-replica slices interleaved between them
+        dt = begin_s + (now - tf)
+        self.busy_s += now - tf
+        self.tokens_emitted += n_tok
         reg = obs.get_registry()
         if reg is not None and plan:
             reg.counter("serve.tokens").inc(n_tok)
@@ -754,6 +778,83 @@ class Engine:
                            queue=self.scheduler.queue_depth(),
                            kv_blocks_used=self.kv.allocator.used_blocks)
         return events
+
+    def _finish_events(self, plan, nxt,
+                       events: List[TokenEvent]) -> None:
+        if plan:
+            # np.asarray is the device sync: JAX dispatch is async,
+            # so the TTFT clock below must stop AFTER the first
+            # token materializes, or it reports queueing overhead
+            nxt = np.asarray(nxt)
+            fi = _rs_state.FAULTS[0]
+            for i, st, n, is_prefill in plan:
+                # pre-span snapshot: isolation rewinds to here, and
+                # re-running the span after restore is idempotent
+                # (the dispatch above already wrote this span's KV;
+                # the rewound re-run rewrites identical bytes)
+                snap = (st.kv_len, st.pending_token,
+                        len(st.output_ids), st.text_len,
+                        st.detok_offset)
+                try:
+                    if fi is not None:
+                        fi("serve.prefill" if is_prefill
+                           else "serve.step")
+                    st.kv_len += n
+                    if is_prefill and st.prefilling:
+                        continue    # mid-prefill: sample discarded
+                    if is_prefill:
+                        # prompt complete: this sample is the
+                        # request's first token — TTFT stops here.
+                        # first_token_t survives a hard replica-failure
+                        # reset (the request re-prefills from scratch),
+                        # so the re-completion must not re-emit
+                        # serve_request / re-observe TTFT for the same
+                        # request (serving/distributed.py).
+                        self._register_prefix(st)
+                        if st.first_token_t is not None:
+                            self._emit(st, int(nxt[i]), events)
+                            continue
+                        st.first_token_t = time.perf_counter()
+                        req = st.request
+                        reg = obs.get_registry()
+                        if reg is not None:
+                            reg.histogram("serve.ttft_ms").observe(
+                                (st.first_token_t - st.submit_t) * 1e3)
+                            if st.num_shared:
+                                reg.counter("serve.prefix_hits").inc(
+                                    st.num_shared)
+                            misses = len(st.page_keys) - st.num_shared
+                            if misses:
+                                reg.counter(
+                                    "serve.prefix_misses").inc(misses)
+                        obs.emit_event(
+                            "serve_request", id=req.request_id,
+                            tenant=req.tenant,
+                            prompt_len=int(req.prompt_ids.size),
+                            slot=st.slot, blocks=len(st.blocks),
+                            cached_tokens=st.cached_tokens)
+                    self._emit(st, int(nxt[i]), events)
+                except Exception as e:  # noqa: BLE001
+                    st.kv_len, st.pending_token = snap[0], snap[1]
+                    del st.output_ids[snap[2]:]
+                    st.text_len, st.detok_offset = snap[3], snap[4]
+                    self._isolate(st, e)
+
+    def step(self) -> List[TokenEvent]:
+        """Admit what fits, run ONE unified ragged step (prefill chunks
+        + decode tokens together), retire what finished.  Returns the
+        tokens emitted (one per decoded / prompt-completed request).
+        Composes :meth:`step_begin` (dispatch) + :meth:`step_finish`
+        (device sync + host post-processing).
+
+        Per-request fault isolation (docs/RESILIENCE.md "Serving
+        sites"): a host-side failure in one request's bookkeeping —
+        admission, CoW, prefill/decode post-processing, or an injected
+        ``serve.*`` fault — never tears down the compiled step or the
+        other slots.  The victim is rewound to its pre-span snapshot,
+        preempted to host RAM, and transparently re-admitted; everyone
+        else's events are delivered normally."""
+        return self.step_finish(self.step_begin())
 
     def stream(self):
         """Generator: run ``step()`` until drained, yielding each

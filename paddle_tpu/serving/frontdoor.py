@@ -48,6 +48,37 @@ from .scheduler import Request, RequestState
 __all__ = ["Admission", "FrontDoor", "TenantPolicy", "TokenBucket"]
 
 
+# requires-lock: _lock — inspects scheduler.waiting
+def relieve_block_pressure(engine, priority_of) -> bool:
+    """One engine's pool-pressure preemption policy (shared by
+    :meth:`FrontDoor._maybe_preempt` and the DP replica set, which
+    applies it per replica): when the queue head is BLOCK-starved (a
+    slot is free, blocks are not) and outranks a running request,
+    preempt one victim — lowest priority first, youngest within a
+    priority.  One victim per call: preemption is a pressure valve, not
+    a scheduler.  Returns True when a victim was preempted."""
+    sch = engine.scheduler
+    if not sch.waiting:
+        return False
+    head = sch.waiting[0]
+    if head.swapped is not None:
+        # a restore waiting on blocks: preempting someone else to
+        # restore a preemptee would thrash
+        return False
+    if sch._free_slot() is None:
+        return False
+    if sch.allocator.can_allocate(sch.blocks_needed(head)):
+        return False                # it will admit on the next step
+    hp = priority_of(head)
+    victims = sorted(
+        (priority_of(st), -st.submit_t, st.request.request_id)
+        for _slot, st in sch.active()
+        if priority_of(st) < hp)
+    if victims:
+        return engine.preempt(victims[0][2], reason="pool_pressure")
+    return False
+
+
 @dataclasses.dataclass
 class TenantPolicy:
     """One tenant's admission contract.
@@ -139,6 +170,13 @@ class FrontDoor:
     The door feeds the engine's FIFO staging queue at most
     ``engine.max_batch`` deep, so ordering decisions stay here — the
     engine only ever sees work the door already sequenced.
+
+    ``engine`` may also be a DP replica set
+    (``serving.distributed.EngineReplicaSet``): the door's policy runs
+    unchanged over the set's aggregate surface, the set decides WHICH
+    replica each admitted request lands on, and pool-pressure
+    preemption delegates to its per-replica policy (docs/SERVING.md
+    "Sharded serving").
     """
 
     def __init__(self, engine, *,
@@ -288,13 +326,20 @@ class FrontDoor:
                 for q in self._queues.values() for pnd in q):
             raise AdmissionError(
                 f"request_id {req.request_id!r} is already in use")
+        # feasibility bound: the request must fit ONE engine — a replica
+        # set exposes its per-replica pool size here, because the summed
+        # kv.num_blocks would answer "admitted" for a request no single
+        # replica can ever hold (it would then shed silently at pump)
+        cap = getattr(eng, "budget_num_blocks", None)
+        if cap is None:
+            cap = eng.kv.num_blocks
         if cost > eng.max_seq_len or \
-                eng.scheduler.blocks_for(cost) > eng.kv.num_blocks:
+                eng.scheduler.blocks_for(cost) > cap:
             return self._shed(
                 tenant, "budget", None, raise_on_shed,
                 f"prompt {p} + max_new {req.max_new_tokens} can never "
                 f"fit this engine (max_seq_len={eng.max_seq_len}, "
-                f"{eng.kv.num_blocks} KV blocks)")
+                f"{cap} KV blocks)")
         if pol.max_live_requests is not None and \
                 self._live_count(tenant) >= pol.max_live_requests:
             return self._shed(
@@ -373,7 +418,10 @@ class FrontDoor:
 
     # requires-lock: _lock
     def _engine_room(self) -> bool:
-        return len(self.engine.scheduler.waiting) < self.engine.max_batch
+        # queue_depth() == len(waiting) on a plain Engine, and the O(1)
+        # aggregate sum on a replica set (whose waiting tuple would be
+        # materialized per check otherwise)
+        return self.engine.scheduler.queue_depth() < self.engine.max_batch
 
     # requires-lock: _lock
     def _next_pending(self) -> Optional[_Pending]:
@@ -467,30 +515,16 @@ class FrontDoor:
 
     # requires-lock: _lock — inspects scheduler.waiting
     def _maybe_preempt(self) -> None:
-        """When the engine's queue head is BLOCK-starved (a slot is
-        free, blocks are not) and outranks a running request, preempt
-        one victim: lowest priority first, youngest within a priority.
-        One victim per pump — preemption is a pressure valve, not a
-        scheduler."""
-        sch = self.engine.scheduler
-        if not sch.waiting:
+        """Apply :func:`relieve_block_pressure` — directly on a plain
+        engine, or delegated when the engine is a replica set
+        (``serving.distributed.EngineReplicaSet`` exposes
+        ``relieve_pressure`` and applies the policy per healthy
+        replica, since each replica's pool starves independently)."""
+        relieve = getattr(self.engine, "relieve_pressure", None)
+        if relieve is not None:
+            relieve(self._priority_of)
             return
-        head = sch.waiting[0]
-        if head.swapped is not None:
-            # a restore waiting on blocks: preempting someone else to
-            # restore a preemptee would thrash
-            return
-        if sch._free_slot() is None:
-            return
-        if sch.allocator.can_allocate(sch.blocks_needed(head)):
-            return                  # it will admit on the next step
-        hp = self._priority_of(head)
-        victims = sorted(
-            (self._priority_of(st), -st.submit_t, st.request.request_id)
-            for _slot, st in sch.active()
-            if self._priority_of(st) < hp)
-        if victims:
-            self.engine.preempt(victims[0][2], reason="pool_pressure")
+        relieve_block_pressure(self.engine, self._priority_of)
 
     # -- the loop ----------------------------------------------------------
 

@@ -142,15 +142,17 @@ class Scheduler:
     # -- admission ---------------------------------------------------------
 
     # requires-lock: _lock
-    def submit(self, request: Request) -> RequestState:
+    def submit(self, request: Request,
+               page_keys: Optional[List[bytes]] = None) -> RequestState:
         st = RequestState(request)
         if self.prefix_cache is not None:
             # hash the prompt's pages ONCE here: admit_next runs every
             # step, and a request parked at the queue head under
             # pool-exhaustion backpressure must not re-run O(prompt)
-            # blake2b chains per retry
-            st.page_keys = PrefixCache.page_keys(request.prompt_ids,
-                                                 self.page_size)
+            # blake2b chains per retry.  A caller that already hashed
+            # them (the replica router's affinity probe) passes them in.
+            st.page_keys = page_keys if page_keys is not None else \
+                PrefixCache.page_keys(request.prompt_ids, self.page_size)
         self.waiting.append(st)
         return st
 

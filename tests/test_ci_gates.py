@@ -103,6 +103,19 @@ def test_chaos_serving_gate():
     assert "token-identical to the fault-free run" in out
 
 
+def test_serving_dist_gate():
+    """Sharded serving (tools/ci.py gate_serving_dist): on the forced
+    8-device CPU mesh, a TP=2 engine serves greedy outputs
+    token-identical to the single-chip engine with zero compiles after
+    warmup, and a 2-replica DP set behind the FrontDoor survives an
+    injected serve.replica fault with every in-flight request re-queued
+    and completed (docs/SERVING.md "Sharded serving")."""
+    out = _run_gate("serving-dist", timeout=1500)
+    assert "serving-dist gate OK" in out
+    assert "token-identical to single-chip" in out
+    assert "survived an injected replica fault" in out
+
+
 def test_api_compat_rejects_foreign_module_leak(monkeypatch):
     """A leaked implementation import (jax/os/...) reachable as a public
     attribute hard-fails collect() (VERDICT r4 weak #1: the gate must

@@ -1,0 +1,439 @@
+"""Sharded serving (paddle_tpu.serving.distributed): TP paged decode and
+DP replica routing over the mesh.
+
+The load-bearing guarantees (docs/SERVING.md "Sharded serving"):
+
+- a TP-sharded engine — params by their partition specs, paged KV pools
+  head-sharded over ``mp`` — serves greedy outputs TOKEN-IDENTICAL to
+  the single-chip engine, with the zero-recompile contract intact;
+- an ``EngineReplicaSet`` routes by prefix affinity then load, survives
+  a replica failure by evacuating every in-flight request through the
+  existing preempt→swap→restore path (nothing dropped, outputs
+  unchanged), and presents the Engine surface the FrontDoor drives.
+
+The suite runs on the conftest-forced 8-device virtual CPU mesh.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as pt
+from paddle_tpu import resilience as rs
+from paddle_tpu import serving
+from paddle_tpu.serving.distributed import (EngineReplicaSet,
+                                            replica_meshes, serving_mesh)
+from paddle_tpu.serving.errors import AdmissionError, QueueFull
+
+R = np.random.default_rng(0)
+
+
+def _prompt(n):
+    return R.integers(0, 256, size=n).astype(np.int32)
+
+
+def _tiny():
+    from paddle_tpu.models.llama import llama
+    pt.seed(0)
+    return llama("tiny")
+
+
+def _engine(mesh=None, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq_len", 48)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("prefill_chunk", 8)
+    return serving.Engine(_tiny(), mesh=mesh, **kw)
+
+
+def _serve(eng, prompts, max_new=6):
+    rids = [eng.add_request(p, max_new_tokens=max_new) for p in prompts]
+    outs = eng.run()
+    return [outs[r] for r in rids]
+
+
+@pytest.fixture(scope="module")
+def reference():
+    """Single-chip outputs for the shared prompt mix."""
+    prompts = [_prompt(n) for n in (5, 17, 9, 26)]
+    eng = _engine().warmup()
+    return prompts, _serve(eng, prompts)
+
+
+# ---------------------------------------------------------------------------
+# mesh helpers
+# ---------------------------------------------------------------------------
+
+class TestMeshes:
+    def test_serving_mesh_axes(self):
+        m = serving_mesh(tp=2)
+        assert m.shape["mp"] == 2
+        assert set(m.axis_names) >= {"dp", "sharding", "mp"}
+
+    def test_serving_mesh_needs_devices(self):
+        with pytest.raises(ValueError, match="devices"):
+            serving_mesh(tp=2, devices=jax.devices()[:1])
+
+    def test_replica_meshes_disjoint(self):
+        meshes = replica_meshes(2, tp=2)
+        flat = [d for m in meshes for d in m.devices.flat]
+        assert len(flat) == len(set(flat)) == 4
+
+    def test_replica_meshes_needs_devices(self):
+        with pytest.raises(ValueError, match="devices"):
+            replica_meshes(5, tp=2)
+
+    def test_pool_head_axis_must_divide(self):
+        # tiny has 2 kv heads; tp=8 cannot shard them (8 devices exist)
+        with pytest.raises(ValueError, match="num_kv_heads"):
+            _engine(mesh=serving_mesh(tp=8))
+
+
+# ---------------------------------------------------------------------------
+# TP-sharded engine
+# ---------------------------------------------------------------------------
+
+class TestTPEngine:
+    def test_token_identical_and_zero_retrace(self, reference):
+        prompts, ref = reference
+        eng = _engine(mesh=serving_mesh(tp=2)).warmup()
+        got = _serve(eng, prompts)
+        assert got == ref
+        # churn on the warmed engine must not add jit-cache entries
+        got = _serve(eng, prompts)
+        assert got == ref
+        for fn in (eng._step_fn, eng._cow_fn):
+            n = getattr(fn, "_cache_size", lambda: None)()
+            assert n in (None, 1), f"jit cache grew to {n}"
+        assert eng.kv_blocks_used == 0
+
+    def test_pools_head_sharded(self):
+        eng = _engine(mesh=serving_mesh(tp=2))
+        for arr in eng.kv.caches[0]:
+            spec = tuple(arr.sharding.spec)
+            assert len(spec) >= 3 and spec[2] == "mp", spec
+
+    def test_params_follow_partition_specs(self):
+        eng = _engine(mesh=serving_mesh(tp=2))
+        spec = tuple(
+            eng.params["model.embed_tokens.weight"].sharding.spec)
+        assert spec and spec[0] == "mp"      # vocab-parallel embedding
+
+    def test_int8_pools_token_identical(self, reference):
+        prompts, _ = reference
+        ref = _serve(_engine(kv_cache_dtype="int8").warmup(), prompts)
+        got = _serve(_engine(kv_cache_dtype="int8",
+                             mesh=serving_mesh(tp=2)).warmup(), prompts)
+        assert got == ref
+
+    def test_lazy_first_step_warms_under_mesh(self, reference):
+        """A mesh engine driven without an explicit warmup() must not
+        trace its programs outside the trace-mesh context — the first
+        step self-warms, and outputs stay token-identical."""
+        prompts, ref = reference
+        eng = _engine(mesh=serving_mesh(tp=2))     # no .warmup()
+        got = _serve(eng, prompts)
+        assert got == ref
+        assert eng._warmed
+
+    def test_preempt_restore_under_mesh(self, reference):
+        prompts, ref = reference
+        eng = _engine(mesh=serving_mesh(tp=2)).warmup()
+        rids = [eng.add_request(p, max_new_tokens=6) for p in prompts]
+        eng.step()
+        eng.step()
+        # preempt a running slot mid-flight: the swap gather/scatter run
+        # over the sharded pools and the restore stays token-identical
+        act = eng.scheduler.active()
+        assert act and eng.preempt(act[0][1].request.request_id)
+        outs = eng.run()
+        assert [outs[r] for r in rids] == ref
+
+
+# ---------------------------------------------------------------------------
+# EngineReplicaSet
+# ---------------------------------------------------------------------------
+
+def _replica_set(n=2, tp=1, **kw):
+    meshes = replica_meshes(n, tp) if tp > 1 else [None] * n
+    return EngineReplicaSet([_engine(mesh=m, **kw) for m in meshes])
+
+
+class TestReplicaSet:
+    def test_geometry_must_match(self):
+        with pytest.raises(ValueError, match="geometry"):
+            EngineReplicaSet([_engine(), _engine(page_size=16)])
+        # pool DTYPE is geometry too: migration scatters one replica's
+        # swapped bytes into another's pools
+        with pytest.raises(ValueError, match="geometry"):
+            EngineReplicaSet([_engine(), _engine(kv_cache_dtype="bfloat16")])
+
+    def test_scheduler_facade_active_for_healthz(self):
+        """ServingServer's /healthz counts eng.scheduler.active()."""
+        rset = _replica_set().warmup()
+        rset.add_request(_prompt(5), max_new_tokens=4)
+        rset.step()
+        assert len(rset.scheduler.active()) == 1
+        rset.run()
+        assert rset.scheduler.active() == []
+
+    def test_routes_and_matches_single_chip(self, reference):
+        prompts, ref = reference
+        rset = _replica_set().warmup()
+        got = _serve(rset, prompts)
+        assert got == ref
+        assert rset.kv_blocks_used == 0
+        # both replicas actually saw work (least-loaded spreads a burst)
+        assert set(rset._placements.values()) == {0, 1}
+
+    def test_least_loaded_prefers_idle_replica(self):
+        rset = _replica_set().warmup()
+        r1 = rset.add_request(_prompt(9), max_new_tokens=4)
+        r2 = rset.add_request(_prompt(9), max_new_tokens=4)
+        assert rset._placements[r1] != rset._placements[r2]
+        rset.run()
+
+    def test_prefix_affinity_pins_repeat_prompts(self):
+        rset = _replica_set().warmup()
+        shared = _prompt(16)                 # two full pages
+        r1 = rset.add_request(shared, max_new_tokens=4)
+        rset.run()
+        # load the other replica so pure least-loaded would pick it
+        rset.add_request(_prompt(5), max_new_tokens=4)
+        r2 = rset.add_request(shared, max_new_tokens=4)
+        assert rset._placements[r2] == rset._placements[r1]
+        rset.run()
+        assert rset.prefix_stats()["hits"] > 0
+
+    def test_duplicate_request_id_rejected_across_replicas(self):
+        rset = _replica_set().warmup()
+        rset.add_request(_prompt(5), max_new_tokens=4, request_id="dup")
+        with pytest.raises(AdmissionError, match="dup"):
+            rset.add_request(_prompt(7), max_new_tokens=4,
+                             request_id="dup")
+        rset.run()
+
+    def test_output_ids_routed(self):
+        rset = _replica_set().warmup()
+        rid = rset.add_request(_prompt(5), max_new_tokens=4)
+        rset.run()
+        assert len(rset.output_ids(rid)) == 4
+
+    def test_all_replicas_dead_is_typed_queue_full(self):
+        """With every replica failed, routing answers a typed transient
+        QueueFull (the door requeues) — never a silent budget shed."""
+        rset = _replica_set().warmup()
+        # pdtpu-lint: disable=lock-discipline — single-threaded test
+        rset._health = [False, False]
+        with pytest.raises(QueueFull, match="no healthy"):
+            rset.add_request(_prompt(5), max_new_tokens=4)
+
+    def test_route_fault_is_typed_queue_full(self):
+        rset = _replica_set().warmup()
+        rs.install_faults("serve.route@0")
+        try:
+            with pytest.raises(QueueFull, match="routing fault"):
+                rset.add_request(_prompt(5), max_new_tokens=4)
+            # next attempt (fault spent) routes normally
+            rset.add_request(_prompt(5), max_new_tokens=4)
+            rset.run()
+        finally:
+            rs.clear_faults()
+
+
+class TestReplicaFailure:
+    def _churn(self, rset, prompts, fault=None):
+        rs.clear_faults()
+        if fault:
+            rs.install_faults(fault)
+        try:
+            rids = []
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                for p in prompts:
+                    rids.append(rset.add_request(p, max_new_tokens=6))
+                    rset.step()
+                outs = rset.run()
+            return [outs[r] for r in rids]
+        finally:
+            rs.clear_faults()
+
+    def test_injected_fault_evacuates_token_identical(self, reference):
+        prompts, _ = reference
+        base = self._churn(_replica_set().warmup(), prompts)
+        rset = _replica_set().warmup()
+        got = self._churn(rset, prompts, fault="serve.replica@4")
+        assert got == base, "evacuated requests diverged"
+        assert rset.failures == 1
+        # pdtpu-lint: disable=lock-discipline — single-threaded test
+        assert sum(rset._health) == 1
+        for rep in rset.replicas:
+            assert rep.kv_blocks_used == 0
+        # the survivor finished everything that was in flight
+        assert rset.requeued >= 1
+
+    def test_hard_failure_falls_back_to_fresh_prefill(self, reference):
+        """When the failing replica cannot even swap out (every
+        serve.swap call faults past the retry budget), its running
+        requests restart from a fresh prefill on the survivor — greedy
+        outputs still complete identically."""
+        prompts, _ = reference
+        base = self._churn(_replica_set().warmup(), prompts)
+        rset = _replica_set().warmup()
+        got = self._churn(rset, prompts,
+                          fault="serve.replica@4,serve.swap@0x999")
+        assert got == base
+        assert rset.failures == 1
+        for rep in rset.replicas:
+            assert rep.kv_blocks_used == 0
+
+    def test_no_healthy_replicas_is_typed(self):
+        rset = _replica_set().warmup()
+        rid = rset.add_request(_prompt(5), max_new_tokens=4)
+        rs.install_faults("serve.replica@0x999")
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                with pytest.raises(RuntimeError, match="no healthy"):
+                    for _ in range(50):
+                        rset.step()
+                        if not rset.has_work():
+                            break
+        finally:
+            rs.clear_faults()
+        del rid
+
+
+# ---------------------------------------------------------------------------
+# FrontDoor over a replica set
+# ---------------------------------------------------------------------------
+
+class TestDoorOverReplicas:
+    def test_multi_tenant_drain_matches_single_chip(self, reference):
+        prompts, ref = reference
+        door = serving.FrontDoor(_replica_set().warmup(), policies={
+            "hi": serving.TenantPolicy(priority=1),
+            "lo": serving.TenantPolicy(priority=0)})
+        rids = []
+        for i, p in enumerate(prompts):
+            a = door.submit(p, tenant="hi" if i % 2 else "lo",
+                            max_new_tokens=6)
+            assert a.admitted
+            rids.append(a.request_id)
+        outs = door.run()
+        assert [outs[r] for r in rids] == ref
+
+    def test_budget_vetted_per_replica_not_aggregate(self):
+        """A request no SINGLE replica can hold must shed up front with
+        reason='budget' — the summed pool would answer admitted=True
+        and then drop it silently at pump time."""
+        rset = _replica_set(max_batch=2, num_blocks=4).warmup()
+        door = serving.FrontDoor(rset)
+        # 5 pages needed > 4 per replica, <= 8 aggregate
+        a = door.submit(_prompt(30), max_new_tokens=10)
+        assert not a.admitted and a.reason == "budget"
+
+    def test_pressure_relief_delegates_per_replica(self):
+        """A block-starved high-priority head preempts a low-priority
+        runner on ITS replica (the door's policy, applied through
+        EngineReplicaSet.relieve_pressure)."""
+        # pool of exactly one sequence budget per replica
+        rset = _replica_set(max_batch=2, num_blocks=6).warmup()
+        door = serving.FrontDoor(rset, policies={
+            "hi": serving.TenantPolicy(priority=1),
+            "lo": serving.TenantPolicy(priority=0)})
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            for _ in range(2):           # fill both replicas' pools
+                assert door.submit(_prompt(30), tenant="lo",
+                                   max_new_tokens=17).admitted
+            door.step()
+            assert door.submit(_prompt(30), tenant="hi",
+                               max_new_tokens=17).admitted
+            for _ in range(60):
+                if not door.has_work():
+                    break
+                door.step()
+            outs = door.run()
+        assert len(outs) == 3            # nobody dropped
+        pages_swapped = sum(r._swap.pages_out for r in rset.replicas)
+        assert pages_swapped > 0         # pressure valve engaged
+
+
+# ---------------------------------------------------------------------------
+# bench plumbing + telemetry fold
+# ---------------------------------------------------------------------------
+
+class TestPlumbing:
+    def test_bench_serve_tp_runs_on_cpu(self):
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools"))
+        from decode_bench import bench_serve_tp
+        r = bench_serve_tp(preset="tiny", tp=2, max_batch=2, n_requests=3,
+                           prompt_lens=(5, 12, 9), max_new=6,
+                           page_size=8, repeats=1)
+        assert r["metric"] == "serve_tp_tok_s"
+        assert r["agg_tokens_per_sec"] > 0 and r["gen_tokens"] == 18
+
+    def test_bench_serve_dp_ratio_on_cpu(self):
+        """The serving-dist acceptance bar: the 2-replica aggregate
+        (per-replica busy-time projection — replicas time-slice this
+        one-core host, docs/SERVING.md) is >= 1.5x a single replica
+        serving the same offered load."""
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools"))
+        from decode_bench import bench_serve_dp
+        r = bench_serve_dp(preset="tiny", replicas=2, max_batch=4,
+                           n_requests=16, prompt_lens=(24,), max_new=32,
+                           page_size=8)
+        assert r["metric"] == "serve_dp_agg_tok_s"
+        assert r["vs_single_replica"] >= 1.5, r
+
+    def test_telemetry_report_folds_replicas(self, tmp_path):
+        import json
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools"))
+        import telemetry_report as tr
+        events = [
+            {"event": "serve_route", "id": "a", "replica": 0,
+             "affinity_hits": 0},
+            {"event": "serve_route", "id": "b", "replica": 1,
+             "affinity_hits": 2},
+            {"event": "serve_replica_fail", "replica": 1,
+             "exc": "InjectedFault", "moved": 3},
+        ]
+        p = tmp_path / "t.jsonl"
+        p.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+        agg = tr.summarize(tr.load_events([str(p)])[0])
+        assert agg["replicas"][0]["routed"] == 1
+        assert agg["replicas"][1] == {"routed": 1, "affinity": 1,
+                                      "failures": 1, "requeued": 3}
+        out = tr.render(agg)
+        assert "| Replica |" in out
+
+    def test_replica_telemetry_labels(self):
+        from paddle_tpu import observability as obs
+        tel = obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        try:
+            rset = _replica_set().warmup()
+            rid = rset.add_request(_prompt(5), max_new_tokens=4)
+            rset.run()
+            snap = tel.registry.snapshot()
+            assert snap.get("serve.routed") == 1
+            idx = rset._placements[rid]
+            assert snap.get(f"serve.replica[{idx}].routed") == 1
+            assert f"serve.replica[{idx}].free_blocks" in snap
+            evs = [e for s in tel.sinks for e in s.records
+                   if e.get("event") == "serve_route"]
+            assert evs and evs[0]["replica"] == idx
+        finally:
+            obs.disable()

@@ -56,8 +56,17 @@ in-repo gates over artifacts committed alongside the code:
                   recompiles, all KV blocks reclaimed at drain, and
                   greedy outputs token-identical to the fault-free run
 
+  serving-dist    sharded serving on a forced 8-device CPU mesh: a TP=2
+                  engine (head-sharded paged pools) serves greedy
+                  outputs token-identical to the single-chip engine
+                  with zero compiles after warmup, and a 2-replica DP
+                  set behind the FrontDoor survives an injected
+                  serve.replica fault — every in-flight request
+                  re-queued through preempt→restore and completed,
+                  all blocks reclaimed on every replica
+
 Run all:  python tools/ci.py            (exit 0 = all gates pass)
-One:      python tools/ci.py --only api-compat|op-benchmark|memproof-lite|telemetry-overhead|chaos|serving-smoke|chaos-serving|lint
+One:      python tools/ci.py --only api-compat|op-benchmark|memproof-lite|telemetry-overhead|chaos|serving-smoke|chaos-serving|serving-dist|lint
 """
 
 from __future__ import annotations
@@ -911,6 +920,239 @@ def gate_chaos_serving(max_batch: int = 4) -> int:
     return 0
 
 
+def gate_serving_dist(max_batch: int = 4) -> int:
+    """Serving-dist gate: sharded serving keeps every single-chip
+    contract (docs/SERVING.md "Sharded serving"), on a forced 8-device
+    CPU host platform (the gate re-execs itself in a subprocess when
+    the already-initialized backend has fewer devices):
+
+    1. TP IDENTITY: a TP=2 engine (params sharded by their partition
+       specs, paged KV pools head-sharded over ``mp``) serves a mixed
+       churn workload with prefix-cache hits and produces greedy
+       outputs TOKEN-IDENTICAL to the single-chip engine — with zero
+       compiles after warmup (sentinel + step/CoW jit-cache sizes) and
+       the pools verifiably mp-sharded.
+    2. DP REPLICA ROUTING: two TP=2 replicas (disjoint submeshes)
+       behind the existing FrontDoor, multi-tenant staggered churn with
+       a duplicated prompt (prefix-affinity routing), and ONE injected
+       ``serve.replica`` fault mid-churn.  The failed replica must be
+       evacuated through preempt→swap→restore onto the survivor, every
+       request must complete token-identical to the single-chip run —
+       nothing dropped, nothing recompiled, and every KV block
+       reclaimed on EVERY replica (the dead one included).
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    if len(jax.devices()) < 8:
+        # an 8-device virtual mesh needs XLA_FLAGS before backend init —
+        # too late in this process, so run the gate in a child
+        pp = os.environ.get("PYTHONPATH")
+        flags = " ".join(
+            f for f in os.environ.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f)
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "PYTHONPATH": REPO + (os.pathsep + pp if pp else ""),
+               "XLA_FLAGS": (flags +
+                             " --xla_force_host_platform_device_count=8"
+                             ).strip()}
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--only",
+             "serving-dist"],
+            env=env, cwd=REPO, capture_output=True, text=True,
+            timeout=1500)
+        sys.stdout.write(r.stdout)
+        sys.stderr.write(r.stderr)
+        return r.returncode
+
+    import warnings
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import observability as obs
+    from paddle_tpu import resilience as rs
+    from paddle_tpu import serving
+    from paddle_tpu.models.llama import llama
+
+    # Persistent compile cache (the same dir tests/conftest.py uses):
+    # this gate compiles four engines' worth of sharded programs, the
+    # suite's wall-clock budget is tight, and the contract here is
+    # WITHIN-RUN token equality across different programs — a cache-hit
+    # executable cannot skew that (unlike the chaos gate's
+    # bitwise-across-runs contract, which deliberately avoids the cache).
+    try:
+        cache_dir = os.path.join(REPO, ".pytest_cache", "xla_cache")
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass
+
+    failures = []
+    tel = obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+    try:
+        rng = np.random.default_rng(0)
+        lens = [3, 17, 9, 33, 5, 26, 12, 21]
+        prompts = [rng.integers(0, 256, size=n).astype(np.int32)
+                   for n in lens]
+        budgets = [3 + (i % 4) for i in range(len(prompts))]
+        # page-aligned 2-page prompt served twice: prefix hits on the
+        # re-serve, and (in the DP phase) affinity pins the repeat to
+        # the replica already holding the pages
+        shared = rng.integers(0, 256, size=16).astype(np.int32)
+
+        def build_model():
+            pt.seed(0)
+            return llama("tiny")
+
+        def churn(target, submit, step, drain):
+            """The one workload every phase runs: staggered admission,
+            then the duplicated shared prompt twice (hits + CoW)."""
+            rids = []
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                for p, m in zip(prompts, budgets):
+                    rids.append(submit(p, m))
+                    step()
+                rids.append(submit(shared, 4))
+                outs = drain()
+                rids.append(submit(shared, 4))
+                outs.update(drain())
+            return [outs[r] for r in rids]
+
+        def engine_churn(eng):
+            return churn(eng,
+                         lambda p, m: eng.add_request(p, max_new_tokens=m),
+                         eng.step, eng.run)
+
+        # single-chip reference
+        ref_eng = serving.Engine(build_model(), max_batch=max_batch,
+                                 max_seq_len=64, page_size=8,
+                                 prefill_chunk=8).warmup()
+        ref = engine_churn(ref_eng)
+
+        # 1. TP=2: identical outputs, zero compiles, sharded pools
+        mesh = serving.serving_mesh(tp=2)
+        eng = serving.Engine(build_model(), max_batch=max_batch,
+                             max_seq_len=64, page_size=8,
+                             prefill_chunk=8, mesh=mesh).warmup()
+        c0 = tel.sentinel.compiles()
+        got = engine_churn(eng)
+        churn_compiles = tel.sentinel.compiles() - c0
+        spec = tuple(eng.kv.caches[0][0].sharding.spec)
+        if len(spec) < 3 or spec[2] != "mp":
+            failures.append(
+                f"TP pools not head-sharded over mp: spec {spec}")
+        if got != ref:
+            bad = [i for i, (a, b) in enumerate(zip(got, ref)) if a != b]
+            failures.append(
+                f"TP=2 outputs diverged from single-chip at requests "
+                f"{bad} — GSPMD partitioning changed the decode")
+        if churn_compiles:
+            failures.append(
+                f"TP=2: {churn_compiles} compile(s) after warmup")
+        for fn, name in ((eng._step_fn, "step"), (eng._cow_fn, "cow")):
+            n = getattr(fn, "_cache_size", lambda: None)()
+            if n is not None and n > 1:
+                failures.append(
+                    f"TP=2: {name} jit cache holds {n} entries — the "
+                    "sharded dispatch re-traced")
+        if eng.kv_blocks_used != 0:
+            failures.append(
+                f"TP=2: {eng.kv_blocks_used} KV block(s) leaked")
+        if not failures:
+            print(f"serving-dist: TP=2 engine token-identical to "
+                  f"single-chip over {len(ref)} requests "
+                  f"(pools {spec}, 0 compiles after warmup)")
+
+        # 2. DP: 2 TP=2 replicas behind the FrontDoor, one injected
+        # replica fault mid-churn
+        rs.clear_faults()
+        meshes = serving.replica_meshes(2, tp=2)
+        reps = [serving.Engine(build_model(), max_batch=max_batch,
+                               max_seq_len=64, page_size=8,
+                               prefill_chunk=8, mesh=m) for m in meshes]
+        rset = serving.EngineReplicaSet(reps).warmup()
+        door = serving.FrontDoor(rset, policies={
+            "lo": serving.TenantPolicy(priority=0),
+            "hi": serving.TenantPolicy(priority=1)}, max_queue_depth=64)
+        c0 = tel.sentinel.compiles()
+        inj = rs.install_faults("serve.replica@6")
+        try:
+            i_box = [0]
+
+            def submit(p, m):
+                i_box[0] += 1
+                a = door.submit(
+                    p, tenant="hi" if i_box[0] % 3 == 0 else "lo",
+                    max_new_tokens=m)
+                return a.request_id
+
+            got = churn(door, submit, door.step, door.run)
+        finally:
+            rs.clear_faults()
+        churn_compiles = tel.sentinel.compiles() - c0
+        if not inj.fired:
+            failures.append("DP: the serve.replica fault never fired — "
+                            "the scenario lost its failure coverage")
+        # pdtpu-lint: disable=lock-discipline — single-threaded gate driver
+        health = list(rset._health)
+        if rset.failures != 1 or all(health):
+            failures.append(
+                f"DP: expected exactly one failed replica, got "
+                f"failures={rset.failures}, health={health}")
+        if got != ref:
+            bad = [i for i, (a, b) in enumerate(zip(got, ref)) if a != b]
+            failures.append(
+                f"DP: requests {bad} diverged from the single-chip run "
+                "— evacuation/restore is not token-preserving")
+        if churn_compiles:
+            failures.append(
+                f"DP: {churn_compiles} compile(s) after warmup")
+        for i, rep in enumerate(reps):
+            if rep.kv_blocks_used != 0:
+                failures.append(
+                    f"DP: replica {i} holds {rep.kv_blocks_used} KV "
+                    "block(s) at drain (evacuation leaked)")
+            alloc = rep.kv.allocator
+            if alloc.free_blocks != alloc.num_blocks:
+                failures.append(
+                    f"DP: replica {i} has only {alloc.free_blocks}/"
+                    f"{alloc.num_blocks} blocks allocatable at drain")
+            for fn, name in ((rep._step_fn, "step"), (rep._cow_fn, "cow")):
+                n = getattr(fn, "_cache_size", lambda: None)()
+                if n is not None and n > 1:
+                    failures.append(
+                        f"DP: replica {i} {name} jit cache holds {n} "
+                        "entries")
+        hits = rset.prefix_stats()["hits"]
+        if hits == 0:
+            failures.append("DP: no prefix-cache hits — affinity "
+                            "routing never engaged the duplicate prompt")
+        if not any(f.startswith("DP") for f in failures):
+            print(f"serving-dist: DP 2x(TP=2) replicas survived an "
+                  f"injected replica fault ({rset.requeued} request(s) "
+                  f"requeued) — all {len(ref)} outputs token-identical, "
+                  f"0 compiles, all blocks reclaimed, "
+                  f"{hits} prefix hit(s)")
+    finally:
+        obs.disable()
+
+    if failures:
+        print("serving-dist gate FAILED (docs/SERVING.md \"Sharded "
+              "serving\"):")
+        for f_ in failures:
+            print(f"  {f_}")
+        return 1
+    print("serving-dist gate OK")
+    return 0
+
+
 def gate_lint(timeout_s: float = 120.0) -> int:
     """Lint gate: pdtpu-lint runs clean over the whole tree with NO jax
     import (subprocess, bare env — the analyzer must work on a jax-less
@@ -945,6 +1187,7 @@ GATES = {
     "chaos": gate_chaos,
     "serving-smoke": gate_serving_smoke,
     "chaos-serving": gate_chaos_serving,
+    "serving-dist": gate_serving_dist,
 }
 
 

@@ -280,6 +280,132 @@ def bench_serve_burst(preset="llama-350m", max_batch=8, offered=None,
             "admitted_ttft_p95_ms": round(p(95), 2)}
 
 
+def bench_serve_tp(preset="llama-350m", tp=2, max_batch=8, n_requests=None,
+                   prompt_lens=(16, 96, 32, 128, 64, 48, 112, 80),
+                   max_new=64, page_size=16, repeats=2,
+                   kv_cache_dtype=None):
+    """TP-sharded continuous-batching throughput: the ``bench_serve``
+    churn workload through ONE engine whose compiled step is
+    GSPMD-partitioned over a ``tp``-device mesh (params by their
+    partition specs, paged KV pools head-sharded — docs/SERVING.md
+    "Sharded serving").  The number that matters on hardware: what a
+    model too big for one chip serves at once it spans the mesh."""
+    import paddle_tpu as pt
+    from paddle_tpu import serving
+    from paddle_tpu.models.llama import llama
+
+    if n_requests is None:
+        n_requests = 3 * max_batch
+    lens = [prompt_lens[i % len(prompt_lens)] for i in range(n_requests)]
+    max_seq_len = max(lens) + max_new
+    pt.seed(0)
+    model = llama(preset, max_position_embeddings=max_seq_len,
+                  dtype="bfloat16")
+    model.astype("bfloat16")
+    mesh = serving.serving_mesh(tp=tp)
+    eng = serving.Engine(model, max_batch=max_batch,
+                         max_seq_len=max_seq_len, page_size=page_size,
+                         kv_cache_dtype=kv_cache_dtype, mesh=mesh).warmup()
+    rng = np.random.default_rng(0)
+
+    def one_pass():
+        rids = [eng.add_request(
+            rng.integers(0, model.cfg.vocab_size, size=n).astype(np.int32),
+            max_new_tokens=max_new) for n in lens]
+        t0 = time.perf_counter()
+        outs = eng.run()
+        dt = time.perf_counter() - t0
+        assert eng.kv_blocks_used == 0, "KV blocks leaked at drain"
+        return sum(len(outs[r]) for r in rids), dt
+
+    best, tokens = float("inf"), 0
+    for _ in range(repeats):
+        tokens, dt = one_pass()
+        best = min(best, dt)
+    return {"metric": "serve_tp_tok_s", "preset": preset, "tp": tp,
+            "kv": str(kv_cache_dtype or "bf16"),
+            "max_batch": max_batch, "requests": n_requests,
+            "max_new_tokens": max_new, "page_size": page_size,
+            "gen_tokens": tokens, "wall_s": round(best, 3),
+            "agg_tokens_per_sec": round(tokens / best, 1)}
+
+
+def bench_serve_dp(preset="llama-350m", replicas=2, tp=1, max_batch=8,
+                   n_requests=None, prompt_lens=(24, 24, 24, 24),
+                   max_new=32, page_size=8, kv_cache_dtype=None):
+    """DP replica-set throughput: ``n_requests`` prompts routed across
+    ``replicas`` engines (each ``tp`` devices) by the least-loaded /
+    prefix-affinity router, against a single-replica baseline of the
+    SAME per-replica config serving the same offered load.
+
+    Two aggregate numbers per config: ``wall`` tok/s (generated tokens
+    over this host's wall clock) and the PROJECTED tok/s — total tokens
+    over the SLOWEST replica's own busy time (``Engine.busy_s``, each
+    engine's dispatch+sync+bookkeeping seconds only).  On real hardware
+    replicas own their chips and run concurrently, so projected ≈ wall;
+    on the CPU plumbing run replicas time-slice one host, so wall is
+    flat by construction and projected is the honest estimator of the
+    deployed aggregate — the ``serve_dp_agg_tok_s`` headline and the
+    ≥1.5x-of-single-replica bar the serving-dist plumbing asserts."""
+    import paddle_tpu as pt
+    from paddle_tpu import serving
+    from paddle_tpu.models.llama import llama
+
+    if n_requests is None:
+        n_requests = 2 * replicas * max_batch
+    lens = [prompt_lens[i % len(prompt_lens)] for i in range(n_requests)]
+    max_seq_len = max(lens) + max_new
+    rng = np.random.default_rng(0)
+    prompts = None
+
+    def build_set(n_reps):
+        # one submesh per replica even at tp=1 (a 1-device mesh): each
+        # replica owns its devices, which is the deployed DP layout
+        meshes = serving.replica_meshes(n_reps, tp)
+        reps = []
+        for m in meshes:
+            pt.seed(0)
+            model = llama(preset, max_position_embeddings=max_seq_len,
+                          dtype="bfloat16")
+            model.astype("bfloat16")
+            reps.append(serving.Engine(
+                model, max_batch=max_batch, max_seq_len=max_seq_len,
+                page_size=page_size, kv_cache_dtype=kv_cache_dtype,
+                mesh=m))
+        return serving.EngineReplicaSet(reps).warmup(), reps
+
+    def one_pass(n_reps):
+        nonlocal prompts
+        rset, reps = build_set(n_reps)
+        if prompts is None:
+            prompts = [rng.integers(0, reps[0].model.cfg.vocab_size,
+                                    size=n).astype(np.int32) for n in lens]
+        rids = [rset.add_request(p, max_new_tokens=max_new)
+                for p in prompts]
+        t0 = time.perf_counter()
+        outs = rset.run()
+        wall = time.perf_counter() - t0
+        assert rset.kv_blocks_used == 0, "KV blocks leaked at drain"
+        tokens = sum(len(outs[r]) for r in rids)
+        return tokens, wall, max(r.busy_s for r in reps)
+
+    base_tokens, base_wall, base_busy = one_pass(1)
+    tokens, wall, busy = one_pass(replicas)
+    agg = round(tokens / busy, 1)
+    single = round(base_tokens / base_busy, 1)
+    return {"metric": "serve_dp_agg_tok_s", "preset": preset,
+            "replicas": replicas, "tp": tp,
+            "kv": str(kv_cache_dtype or "bf16"), "max_batch": max_batch,
+            "requests": n_requests, "max_new_tokens": max_new,
+            "page_size": page_size, "gen_tokens": tokens,
+            "wall_s": round(wall, 3),
+            "agg_tokens_per_sec": agg,
+            "wall_tokens_per_sec": round(tokens / wall, 1),
+            "single_replica_tok_s": single,
+            "single_replica_wall_s": round(base_wall, 3),
+            "vs_single_replica": round(agg / single, 2) if single else None}
+
+
 def bench_decode_attention(batch=8, heads=16, head_dim=64, ctx=1024,
                            block_size=64, iters=200):
     """Paged vs contiguous decode attention, op-level, slope-amortized."""
@@ -353,6 +479,14 @@ def main():
     # overload: offered > capacity through the bounded front door —
     # goodput, shed rate, TTFT p95 for the admitted traffic
     print(json.dumps(bench_serve_burst(kv_cache_dtype="int8")), flush=True)
+    # sharded serving (docs/SERVING.md "Sharded serving"): TP-partitioned
+    # engine + DP replica routing — needs a multi-chip slice
+    if len(jax.devices()) >= 2:
+        print(json.dumps(bench_serve_tp(tp=2, kv_cache_dtype="int8")),
+              flush=True)
+        print(json.dumps(bench_serve_dp(replicas=2,
+                                        kv_cache_dtype="int8")),
+              flush=True)
     print(json.dumps(bench_decode_attention()), flush=True)
 
 

@@ -97,6 +97,11 @@ def summarize(events):
                     "preempts": 0, "restores": 0, "swapped_pages": 0,
                     "sheds": defaultdict(int), "isolated": 0,
                     "tenants": defaultdict(int)},
+        # DP replica routing (docs/SERVING.md "Sharded serving"):
+        # per-replica routed/affinity counts from serve_route events,
+        # failures/requeues from serve_replica_fail
+        "replicas": defaultdict(lambda: {"routed": 0, "affinity": 0,
+                                         "failures": 0, "requeued": 0}),
     }
     for e in events:
         kind = e.get("event")
@@ -148,6 +153,15 @@ def summarize(events):
             agg["serving"]["sheds"][e.get("reason") or "?"] += 1
         elif kind == "serve_isolated_failure":
             agg["serving"]["isolated"] += 1
+        elif kind == "serve_route":
+            rp = agg["replicas"][e.get("replica", "?")]
+            rp["routed"] += 1
+            if e.get("affinity_hits"):
+                rp["affinity"] += 1
+        elif kind == "serve_replica_fail":
+            rp = agg["replicas"][e.get("replica", "?")]
+            rp["failures"] += 1
+            rp["requeued"] += e.get("moved") or 0
         elif kind == "serve_step":
             sv = agg["serving"]
             sv["steps"] += 1
@@ -311,6 +325,20 @@ def render(agg, malformed=0):
                             sorted(sv["tenants"].items()))
             lines.append(f"| requests by tenant | {ten} |")
         lines.append("")
+    if agg["replicas"]:
+        # DP replica routing: where requests landed and what failed;
+        # the live per-replica gauges (serve.replica[i].free_blocks /
+        # queue_depth) ride the metrics snapshot below
+        m = metrics or {}
+        lines += ["| Replica | Routed | Affinity-pinned | Failures "
+                  "| Requeued off | Free blocks (last) |",
+                  "|---|---|---|---|---|---|"]
+        for rep, rp in sorted(agg["replicas"].items(), key=str):
+            free = m.get(f"serve.replica[{rep}].free_blocks", "—")
+            lines.append(
+                f"| {rep} | {rp['routed']} | {rp['affinity']} "
+                f"| {rp['failures']} | {rp['requeued']} | {free} |")
+        lines.append("")
     for r in agg["resumes"]:
         lines.append(f"**RESUME**: step {r.get('step')} from "
                      f"`{r.get('ckpt')}` (restart {r.get('restarts')})")
@@ -355,7 +383,7 @@ def render(agg, malformed=0):
             or preemptions or agg["hangs"] or agg["postmortems"]
             or agg["retries"] or agg["faults"] or agg["resumes"]
             or agg["restarts"] or sv["requests"] or sv["steps"]
-            or sv["sheds"] or sv["preempts"]):
+            or sv["sheds"] or sv["preempts"] or agg["replicas"]):
         lines.append("(no telemetry events found)")
     return "\n".join(lines)
 
@@ -435,6 +463,10 @@ def main(argv=None) -> int:
             "isolated_failures": sv["isolated"],
             "tenants": dict(sorted(sv["tenants"].items())),
         }
+    if agg["replicas"]:
+        summary["replicas"] = {
+            str(rep): dict(rp)
+            for rep, rp in sorted(agg["replicas"].items(), key=str)}
     if agg["bench_result"] is not None:
         summary["bench_value"] = agg["bench_result"].get("value")
     print(json.dumps(summary))
