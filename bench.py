@@ -32,9 +32,15 @@ def peak_flops() -> float:
 
 
 def measure(preset, batch_size, seq_len, steps, windows, remat=False,
-            loss_chunks=1, fuse=False, remat_layers=None):
+            loss_chunks=1, fuse=False, remat_layers=None,
+            fused_ops="auto"):
     """One full measurement: build model+step, warm up, time `windows`
-    independent windows of `steps` steps.  Returns (mfu, stats dict)."""
+    independent windows of `steps` steps.  Returns (mfu, stats dict).
+
+    ``fused_ops`` routes the model through the fused-kernel library
+    (docs/KERNELS.md): "on"/"off"/"auto" — the one-flag MFU A/B
+    (``--fused`` on the CLI).  ``fuse`` is the older trace-time
+    weight-concat knob, kept for tune_sweep compatibility."""
     import gc
 
     import paddle_tpu as pt
@@ -45,7 +51,8 @@ def measure(preset, batch_size, seq_len, steps, windows, remat=False,
     pt.seed(0)
     model = llama(preset, max_position_embeddings=seq_len,
                   use_recompute=remat, loss_seq_chunks=loss_chunks,
-                  fuse_qkv_mlp=fuse, recompute_num_layers=remat_layers)
+                  fuse_qkv_mlp=fuse, recompute_num_layers=remat_layers,
+                  fused_ops=fused_ops)
     cfg = model.cfg
     opt = optimizer.AdamW(learning_rate=3e-4, weight_decay=0.1,
                           grad_clip=nn.ClipGradByGlobalNorm(1.0),
@@ -92,6 +99,7 @@ def measure(preset, batch_size, seq_len, steps, windows, remat=False,
                                for w in window_dts],
         "batch": batch_size, "seq": seq_len,
         "loss": float(m["loss"]),
+        "fused": fused_ops,
     }
     # free this model's device buffers before a follow-up measurement
     del state, step, model, opt, batch, ids
@@ -100,6 +108,23 @@ def measure(preset, batch_size, seq_len, steps, windows, remat=False,
 
 
 def main():
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__)
+    # the one-flag fused-kernel A/B (docs/KERNELS.md): --fused off is
+    # the pre-fusion baseline, --fused on forces the fused entry points
+    # everywhere, auto (default) fuses where a kernel serves.  Env
+    # PDTPU_BENCH_FUSED_OPS backs the flag for driver scripts.
+    ap.add_argument("--fused", choices=("on", "off", "auto"),
+                    default=os.environ.get("PDTPU_BENCH_FUSED_OPS",
+                                           "auto"))
+    args, _ = ap.parse_known_args()
+    fused_ops = args.fused
+    if fused_ops not in ("on", "off", "auto"):
+        # argparse only validates choices for EXPLICIT flags — a typo'd
+        # env default would otherwise die mid-trace, long after telemetry
+        # already recorded the bogus mode
+        ap.error(f"PDTPU_BENCH_FUSED_OPS={fused_ops!r}: expected "
+                 "on|off|auto")
     on_tpu = jax.default_backend() != "cpu"
     preset = os.environ.get("PDTPU_BENCH_PRESET",
                             "llama-350m" if on_tpu else "tiny")
@@ -114,7 +139,7 @@ def main():
         from paddle_tpu import observability as obs
         tel = obs.enable(jsonl_path=tel_path)
         tel.emit({"event": "run_meta", "kind": "bench", "preset": preset,
-                  "backend": jax.default_backend(),
+                  "backend": jax.default_backend(), "fused": fused_ops,
                   "device": getattr(jax.devices()[0], "device_kind", "cpu")})
     # defaults picked by on-chip sweep (v5e, 2026-07-30): bs4/seq2048 with
     # recompute OFF fits 16 GiB HBM and lands 0.42 MFU; remat ON costs an
@@ -136,7 +161,8 @@ def main():
                                         2 if on_tpu else 1)))
 
     mfu, stats = measure(preset, batch_size, seq_len, steps, windows,
-                         remat=remat, loss_chunks=loss_chunks, fuse=fuse)
+                         remat=remat, loss_chunks=loss_chunks, fuse=fuse,
+                         fused_ops=fused_ops)
     extra = {**stats,
              "backend": jax.default_backend(),
              "device": getattr(jax.devices()[0], "device_kind", "cpu")}
@@ -161,7 +187,7 @@ def main():
     # (VERDICT r2 weak #1 / next-round #4)
     if on_tpu and os.environ.get("PDTPU_BENCH_HD128", "1") == "1":
         extra_point("hd128", "llama-350m-hd128", batch_size, seq_len,
-                    max(20, steps // 2), windows)
+                    max(20, steps // 2), windows, fused_ops=fused_ops)
 
     # first measured point above 350M: llama-1b (h=2048, 16×d128, 0.94B
     # params).  fp32 master + AdamW moments alone are 10.5 GiB of the
@@ -175,7 +201,7 @@ def main():
                     max(20, steps // 2), windows,
                     keys=("ms_per_step", "window_ms_per_step",
                           "tokens_per_sec_per_chip", "params"),
-                    remat=True, remat_layers=12)
+                    remat=True, remat_layers=12, fused_ops=fused_ops)
 
     # serving decode at the recommended quantized point (int8 weights +
     # int8 KV — docs/BENCH.md "stacked serving quantization"), slope

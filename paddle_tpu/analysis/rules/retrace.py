@@ -22,6 +22,17 @@ that:
   at a ``static_argnums`` position — unhashable, so every call dies (or
   the caller "fixes" it with a tuple whose contents still churn the
   cache).
+- **R4e per-step tuned-config read**: ``ops.tuning.tuned_config(...)``
+  called inside a loop body.  The tuned-config store is the SANCTIONED
+  trace-time-frozen lookup (kernel wrappers and Engine construction
+  resolve it once, before warmup — reading it inside a jit-traced
+  function is fine and NOT flagged): its values bake into compiled
+  programs by design.  A per-step read inside a dispatch loop breaks
+  that contract both ways — it pretends the value can change mid-run
+  (it cannot: the compiled program keeps what it traced), and if the
+  value feeds a static position of a jitted callable, an actual change
+  (``tuning.reload()``) retraces per new value.  Resolve the config
+  before the loop.
 """
 
 from __future__ import annotations
@@ -100,6 +111,19 @@ def check(pf: ParsedFile, ctx) -> Iterable[Finding]:
                     "jax.jit(...) called inside a loop — a fresh "
                     "callable (and compile cache) per iteration; hoist "
                     "or memoize the jitted callable outside the loop")
+            # R4e: tuned-config lookup inside a loop body (the
+            # trace-time read — in a jitted function, a kernel wrapper,
+            # or construction code — is the sanctioned idiom and stays
+            # silent; see module docstring)
+            if _is_tuned_config_call(node) and _inside_loop(pf, node):
+                yield pf.finding(
+                    RULE, node,
+                    "tuned_config(...) read inside a loop — tuned "
+                    "configs are trace-time-frozen (ops/tuning.py): "
+                    "compiled programs keep the values they resolved "
+                    "before warmup, so a per-step read is at best dead "
+                    "and at worst a retrace per reload; resolve the "
+                    "config once before the loop")
 
     # R4c: jitted module-level defs reading mutable module globals
     if mutable_globals:
@@ -130,6 +154,20 @@ def check(pf: ParsedFile, ctx) -> Iterable[Finding]:
                         "at trace time and later mutations are "
                         "silently ignored (the recompile-sentinel bug "
                         "class); pass it as an argument")
+
+
+_CONFIG_ACCESSORS = ("tuned_config",)
+
+
+def _is_tuned_config_call(node: ast.Call) -> bool:
+    """``tuned_config(...)`` / ``tuning.tuned_config(...)`` — the
+    sanctioned accessor's name, however it was imported."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id in _CONFIG_ACCESSORS
+    if isinstance(fn, ast.Attribute):
+        return fn.attr in _CONFIG_ACCESSORS
+    return False
 
 
 def _inside_loop(pf: ParsedFile, node: ast.AST) -> bool:

@@ -16,6 +16,7 @@ attention, the TPU analogue of the reference's decode kernels.
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Optional, Tuple
 
@@ -82,6 +83,195 @@ def fused_dropout_add(x, y, p=0.0, training=True, mode="upscale_in_train"):
 
 def swiglu(x, y=None):
     return F.swiglu(x, y)
+
+
+# ---------------------------------------------------------------------------
+# fused-kernel library entry points (docs/KERNELS.md)
+#
+# Each op dispatches to its Pallas kernel on TPU (ops/pallas) and
+# otherwise runs the XLA composition below — the composition IS the
+# kernel's numerical contract (same op order, f32 accumulation), so the
+# interpret-mode equivalence tests in tests/test_fused_kernels.py pin
+# the two together.  Backward passes recompute through the composition
+# (jax.vjp over the reference), the flash-attention remat recipe: the
+# fused forward saves the HBM traffic, the backward pays one extra
+# forward in exchange for standard XLA gradients.
+# ---------------------------------------------------------------------------
+
+def _prec(dtype):
+    # HIGHEST only where it means something: the TPU MXU truncates f32
+    # operands to bf16 by default (the int4_matmul note).  On CPU the
+    # default f32 dot is already exact and HIGHEST picks a measurably
+    # slower codegen path (autotune sweep, 2026-08-04: 57 → 37 ms on the
+    # 350m MLP shape).
+    return (jax.lax.Precision.HIGHEST
+            if dtype == jnp.float32 and jax.default_backend() == "tpu"
+            else None)
+
+
+def _fused_swiglu_mlp_ref(x, w_gate, w_up, w_down):
+    """XLA composition mirroring the fused_mlp kernel's numerics."""
+    p = _prec(x.dtype)
+    g = jax.lax.dot(x, w_gate.astype(x.dtype), precision=p,
+                    preferred_element_type=jnp.float32)
+    u = jax.lax.dot(x, w_up.astype(x.dtype), precision=p,
+                    preferred_element_type=jnp.float32)
+    h = (jax.nn.silu(g) * u).astype(x.dtype)
+    return jax.lax.dot(h, w_down.astype(x.dtype), precision=p,
+                       preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def _fused_swiglu_mlp_impl(x, w_gate, w_up, w_down):
+    from ...ops import dispatch as _dispatch
+    kernel = _dispatch.get("fused_swiglu_mlp")
+    if kernel is not None:
+        out = kernel(x, w_gate.astype(x.dtype), w_up.astype(x.dtype),
+                     w_down.astype(x.dtype))
+        if out is not None:
+            return out
+    return _fused_swiglu_mlp_ref(x, w_gate, w_up, w_down)
+
+
+@jax.custom_vjp
+def fused_swiglu_mlp(x, w_gate, w_up, w_down):
+    """``silu(x @ Wg) · (x @ Wu) @ Wd`` in one pass — the (T, I) gate/up
+    intermediate never round-trips HBM on TPU (ops/pallas/fused_mlp.py);
+    XLA composition elsewhere.  x: (T, H); returns (T, H) in x.dtype."""
+    return _fused_swiglu_mlp_impl(x, w_gate, w_up, w_down)
+
+
+def _fused_swiglu_mlp_fwd(x, w_gate, w_up, w_down):
+    return _fused_swiglu_mlp_impl(x, w_gate, w_up, w_down), \
+        (x, w_gate, w_up, w_down)
+
+
+def _fused_swiglu_mlp_bwd(res, ct):
+    _, vjp = jax.vjp(_fused_swiglu_mlp_ref, *res)
+    return vjp(ct)
+
+
+fused_swiglu_mlp.defvjp(_fused_swiglu_mlp_fwd, _fused_swiglu_mlp_bwd)
+
+
+def _fused_gelu_mlp_ref(x, w1, b1, w2, b2):
+    p = _prec(x.dtype)
+    h1 = jax.lax.dot(x, w1.astype(x.dtype), precision=p,
+                     preferred_element_type=jnp.float32)
+    h1 = h1 + b1.astype(jnp.float32)
+    h = jax.nn.gelu(h1, approximate=False).astype(x.dtype)
+    y = jax.lax.dot(h, w2.astype(x.dtype), precision=p,
+                    preferred_element_type=jnp.float32)
+    return (y + b2.astype(jnp.float32)).astype(x.dtype)
+
+
+def _fused_gelu_mlp_impl(x, w1, b1, w2, b2):
+    from ...ops import dispatch as _dispatch
+    kernel = _dispatch.get("fused_gelu_mlp")
+    if kernel is not None:
+        out = kernel(x, w1.astype(x.dtype), b1, w2.astype(x.dtype), b2)
+        if out is not None:
+            return out
+    return _fused_gelu_mlp_ref(x, w1, b1, w2, b2)
+
+
+@jax.custom_vjp
+def fused_gelu_mlp(x, w1, b1, w2, b2):
+    """``gelu(x @ W1 + b1) @ W2 + b2`` in one pass (the GPT 4h FFN
+    analogue of :func:`fused_swiglu_mlp`)."""
+    return _fused_gelu_mlp_impl(x, w1, b1, w2, b2)
+
+
+def _fused_gelu_mlp_fwd(x, w1, b1, w2, b2):
+    return _fused_gelu_mlp_impl(x, w1, b1, w2, b2), (x, w1, b1, w2, b2)
+
+
+def _fused_gelu_mlp_bwd(res, ct):
+    _, vjp = jax.vjp(_fused_gelu_mlp_ref, *res)
+    return vjp(ct)
+
+
+fused_gelu_mlp.defvjp(_fused_gelu_mlp_fwd, _fused_gelu_mlp_bwd)
+
+
+def _fused_rms_rope_qkv_ref(x, norm_weight, w_q, w_k, w_v, cos, sin,
+                            head_dim, eps):
+    """XLA composition mirroring the fused_norm_qkv kernel: rms-norm in
+    f32, projections with f32 accumulation, rotate-half rope in f32.
+    The kernel's selector-matmul rotation is exact (±1 entries), so the
+    concat formulation here is the same arithmetic."""
+    p = _prec(x.dtype)
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    nx = (xf * jax.lax.rsqrt(ms + eps)
+          * norm_weight.astype(jnp.float32)).astype(x.dtype)
+
+    def proj(w):
+        return jax.lax.dot(nx, w.astype(x.dtype), precision=p,
+                           preferred_element_type=jnp.float32)
+
+    def rope(y):
+        # rope runs on the x.dtype-ROUNDED projection (mirroring both
+        # the kernel and the unfused path, where the projection layer's
+        # output dtype is what the rotary pass sees), products in f32
+        t, n = y.shape
+        yh = y.astype(x.dtype).astype(jnp.float32) \
+            .reshape(t, n // head_dim, head_dim)
+        half = head_dim // 2
+        rot = jnp.concatenate([-yh[..., half:], yh[..., :half]], axis=-1)
+        c = cos.astype(jnp.float32)[:, None, :]
+        s = sin.astype(jnp.float32)[:, None, :]
+        return (yh * c + rot * s).reshape(t, n)
+
+    q = proj(w_q)
+    k = proj(w_k)
+    return (rope(q).astype(x.dtype), rope(k).astype(x.dtype),
+            proj(w_v).astype(x.dtype))
+
+
+def _fused_rms_rope_qkv_impl(x, norm_weight, w_q, w_k, w_v, cos, sin,
+                             head_dim, eps):
+    from ...ops import dispatch as _dispatch
+    kernel = _dispatch.get("fused_rms_rope_qkv")
+    if kernel is not None:
+        out = kernel(x, norm_weight, w_q.astype(x.dtype),
+                     w_k.astype(x.dtype), w_v.astype(x.dtype), cos, sin,
+                     head_dim, eps)
+        if out is not None:
+            return out
+    return _fused_rms_rope_qkv_ref(x, norm_weight, w_q, w_k, w_v, cos,
+                                   sin, head_dim, eps)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8))
+def fused_rms_rope_qkv(x, norm_weight, w_q, w_k, w_v, cos, sin,
+                       head_dim, eps=1e-5):
+    """rms_norm → q/k/v projections → rotate-half rope on q/k in ONE
+    pass over the hidden states (ops/pallas/fused_norm_qkv.py on TPU;
+    XLA composition elsewhere).
+
+    x: (T, H) flattened hidden states; norm_weight: (H,); w_q: (H, Nq);
+    w_k/w_v: (H, Nk); cos/sin: (T, head_dim).  Returns ``(q, k, v)``
+    with rope already applied to q and k, in ``x.dtype``.
+    """
+    return _fused_rms_rope_qkv_impl(x, norm_weight, w_q, w_k, w_v, cos,
+                                    sin, head_dim, eps)
+
+
+def _fused_rms_rope_qkv_fwd(x, norm_weight, w_q, w_k, w_v, cos, sin,
+                            head_dim, eps):
+    out = _fused_rms_rope_qkv_impl(x, norm_weight, w_q, w_k, w_v, cos,
+                                   sin, head_dim, eps)
+    return out, (x, norm_weight, w_q, w_k, w_v, cos, sin)
+
+
+def _fused_rms_rope_qkv_bwd(head_dim, eps, res, ct):
+    _, vjp = jax.vjp(
+        lambda *a: _fused_rms_rope_qkv_ref(*a, head_dim, eps), *res)
+    return vjp(ct)
+
+
+fused_rms_rope_qkv.defvjp(_fused_rms_rope_qkv_fwd,
+                          _fused_rms_rope_qkv_bwd)
 
 
 # ---------------------------------------------------------------------------

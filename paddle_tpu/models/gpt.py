@@ -53,6 +53,12 @@ class GPTConfig:
     pipeline_stages: int = 1
     num_microbatches: Optional[int] = None
     virtual_pp_degree: int = 1
+    # fused-kernel library (docs/KERNELS.md): GPT's qkv is already one
+    # matmul and its norm is LayerNorm (no fused-rms op applies), so the
+    # flag routes the 4h GELU FFN through incubate.fused_gelu_mlp — the
+    # Pallas fused-MLP kernel on TPU, the same-numerics XLA composition
+    # elsewhere.  "auto" fuses only where a kernel will serve.
+    fused_ops: str = "auto"
     dtype: str = "float32"
 
     @property
@@ -166,6 +172,7 @@ class GPTAttention(Layer):
 class GPTMLP(Layer):
     def __init__(self, cfg: GPTConfig):
         super().__init__()
+        self.cfg = cfg
         sp = cfg.sequence_parallel
         self.fc_in = ColumnParallelLinear(cfg.hidden_size, cfg.ffn_size,
                                           has_bias=True,
@@ -178,6 +185,28 @@ class GPTMLP(Layer):
         self.dropout = Dropout(cfg.hidden_dropout)
 
     def forward(self, x):
+        cfg = self.cfg
+        from .llama import _use_fused
+        from ..ops.tuning import geom_key
+
+        def _kernel_serves():
+            from ..ops.pallas import fused_mlp as _fm
+            return _fm.supported(x.reshape(-1, cfg.hidden_size),
+                                 self.fc_in.weight, self.fc_out.weight,
+                                 op="fused_gelu_mlp")
+
+        if _use_fused(cfg, "fused_gelu_mlp",
+                      geom_key(h=cfg.hidden_size, i=cfg.ffn_size),
+                      probe=_kernel_serves,
+                      layers=(self.fc_in, self.fc_out)):
+            # one pass over the FFN weights (incubate fused entry —
+            # Pallas kernel on TPU, XLA composition elsewhere)
+            from ..incubate.nn.functional import fused_gelu_mlp
+            lead = x.shape[:-1]
+            y = fused_gelu_mlp(x.reshape(-1, cfg.hidden_size),
+                               self.fc_in.weight, self.fc_in.bias,
+                               self.fc_out.weight, self.fc_out.bias)
+            return self.dropout(y.reshape(*lead, cfg.hidden_size))
         return self.dropout(self.fc_out(F.gelu(self.fc_in(x))))
 
 

@@ -55,6 +55,14 @@ class LlamaConfig:
     virtual_pp_degree: int = 1      # interleaved-schedule chunks per stage
     loss_seq_chunks: int = 1        # >1: rematerialized seq-chunked vocab CE
     fuse_qkv_mlp: bool = False      # trace-time concat of qkv / gate+up kernels
+    # fused-kernel library (docs/KERNELS.md): "on" routes norm+rope+qkv
+    # and the swiglu MLP through incubate's fused entry points (Pallas
+    # kernels on TPU, the equivalent XLA composition elsewhere); "auto"
+    # fuses only where a kernel will actually serve (TPU, no mesh, not
+    # vetoed by tools/tuned_configs.json) so CPU behavior is unchanged;
+    # "off" keeps the unfused projections.  Takes precedence over
+    # fuse_qkv_mlp where both apply.
+    fused_ops: str = "auto"
     dtype: str = "float32"
 
     @property
@@ -103,6 +111,35 @@ def _weight_attr(cfg: LlamaConfig):
     return ParamAttr(initializer=I.Normal(0.0, cfg.initializer_range))
 
 
+def _use_fused(cfg, op: str, key=None, probe=None, layers=()) -> bool:
+    """Trace-time fused-op resolution (ops.tuning owns the policy).
+
+    Sequence-parallel keeps the unfused path (the fused entry points
+    bypass the Column/RowParallel scatter-gather the sp layout needs),
+    and so does ANY quantized projection in ``layers`` — weight-only
+    quantized layers keep raw int8/int4 codes in ``.weight`` with the
+    scale in a separate buffer, so the fused entries (which read
+    ``.weight`` directly) would silently drop the scales; their decode
+    fusion is the int8/int4 matmul kernel inside the layer's own
+    forward instead.  ``probe`` (called only under ``"auto"``) is the
+    kernel's ``supported()`` shape gate: auto means "only where a
+    kernel will actually serve", so a geometry the kernel declines
+    (e.g. llama-1b's VMEM overflow) keeps the cheaper unfused path
+    rather than paying the fused entry's recompute backward for an XLA
+    composition forward."""
+    if getattr(cfg, "sequence_parallel", False):
+        return False
+    if any(hasattr(l, "weight_scale") for l in layers):
+        return False
+    from ..ops import tuning
+    mode = getattr(cfg, "fused_ops", "off")
+    if not tuning.fusion_enabled(mode, op, key):
+        return False
+    if mode == "auto" and probe is not None and not probe():
+        return False
+    return True
+
+
 class LlamaRMSNorm(Layer):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
@@ -132,10 +169,36 @@ class LlamaAttention(Layer):
                                         weight_attr=attr, sequence_parallel=sp)
 
     def forward(self, x, cos, sin, attn_mask=None, cache=None,
-                seq_lens=None, block_tables=None, span_starts=None):
+                seq_lens=None, block_tables=None, span_starts=None,
+                norm_weight=None):
         cfg = self.cfg
         b, s = x.shape[:2]
-        if cfg.fuse_qkv_mlp and not cfg.sequence_parallel:
+        roped = False
+        if norm_weight is not None:
+            # fused RMSNorm→QKV→RoPE (docs/KERNELS.md): ``x`` is the
+            # UN-NORMED residual stream — the decoder layer skipped its
+            # input_layernorm and handed us its weight, so the fused op
+            # reads the hidden states from HBM exactly once.  cos/sin
+            # arrive (s, d) for the shared-position paths or (b, s, d)
+            # for per-slot serving positions; either way the kernel
+            # wants per-token (b·s, d) tables.
+            from ..incubate.nn.functional import fused_rms_rope_qkv
+            hd = cfg.head_dim
+            if cos.ndim == 2:
+                cos2 = jnp.broadcast_to(cos[None], (b, s, hd))
+                sin2 = jnp.broadcast_to(sin[None], (b, s, hd))
+            else:
+                cos2, sin2 = cos, sin
+            q, k, v = fused_rms_rope_qkv(
+                x.reshape(b * s, cfg.hidden_size), norm_weight,
+                self.q_proj.weight, self.k_proj.weight,
+                self.v_proj.weight, cos2.reshape(b * s, hd),
+                sin2.reshape(b * s, hd), hd, cfg.rms_norm_eps)
+            q = q.reshape(b, s, cfg.num_attention_heads, hd)
+            k = k.reshape(b, s, cfg.num_key_value_heads, hd)
+            v = v.reshape(b, s, cfg.num_key_value_heads, hd)
+            roped = True
+        elif cfg.fuse_qkv_mlp and not cfg.sequence_parallel:
             # one [h, h+2kv] matmul instead of three — parameters stay
             # separate (HF import / TP specs untouched); the concat is a
             # cheap trace-time reshuffle XLA schedules once per step
@@ -159,7 +222,8 @@ class LlamaAttention(Layer):
         q = constrain(q, ("dp", "sharding"), None, "mp", None)
         k = constrain(k, ("dp", "sharding"), None, "mp", None)
         v = constrain(v, ("dp", "sharding"), None, "mp", None)
-        q, k = F.apply_rotary_pos_emb(q, k, cos, sin)
+        if not roped:
+            q, k = F.apply_rotary_pos_emb(q, k, cos, sin)
         if cache is not None and block_tables is not None:
             # paged KV pools (serving.Engine): the cache is the GLOBAL
             # (num_blocks, page, H_kv, D) pool pair (or int8 4-tuple),
@@ -242,6 +306,30 @@ class LlamaMLP(Layer):
 
     def forward(self, x):
         cfg = self.cfg
+        from ..ops.tuning import geom_key
+
+        def _kernel_serves():
+            from ..ops.pallas import fused_mlp as _fm
+            return _fm.supported(x.reshape(-1, cfg.hidden_size),
+                                 self.gate_proj.weight,
+                                 self.down_proj.weight)
+
+        if _use_fused(cfg, "fused_swiglu_mlp",
+                      geom_key(h=cfg.hidden_size,
+                               i=cfg.intermediate_size),
+                      probe=_kernel_serves,
+                      layers=(self.gate_proj, self.up_proj,
+                              self.down_proj)):
+            # one pass over the weights, the (T, I) gate/up intermediate
+            # stays in VMEM on TPU (incubate fused entry; XLA
+            # composition where the kernel cannot serve)
+            from ..incubate.nn.functional import fused_swiglu_mlp
+            lead = x.shape[:-1]
+            y = fused_swiglu_mlp(x.reshape(-1, cfg.hidden_size),
+                                 self.gate_proj.weight,
+                                 self.up_proj.weight,
+                                 self.down_proj.weight)
+            return y.reshape(*lead, cfg.hidden_size)
         if cfg.fuse_qkv_mlp and not cfg.sequence_parallel:
             w = jnp.concatenate([self.gate_proj.weight, self.up_proj.weight],
                                 axis=1)
@@ -258,26 +346,55 @@ class LlamaDecoderLayer(Layer):
 
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
+        self.cfg = cfg
         self.input_layernorm = LlamaRMSNorm(cfg)
         self.self_attn = LlamaAttention(cfg)
         self.post_attention_layernorm = LlamaRMSNorm(cfg)
         self.mlp = LlamaMLP(cfg)
 
+    def _attn_input(self, x):
+        """(attention input, norm_weight kwarg): under the fused qkv op
+        the layernorm folds INTO the attention projection — hand the raw
+        residual stream plus the norm weight down instead of norming
+        here (resolved at trace time, ops.tuning)."""
+        cfg = self.cfg
+        from ..ops.tuning import geom_key
+        hd = cfg.head_dim
+        key = geom_key(h=cfg.hidden_size,
+                       nq=cfg.num_attention_heads * hd,
+                       nk=cfg.num_key_value_heads * hd, hd=hd)
+        attn = self.self_attn
+
+        def _kernel_serves():
+            from ..ops.pallas import fused_norm_qkv as _fq
+            return _fq.supported(x.reshape(-1, cfg.hidden_size),
+                                 attn.q_proj.weight, attn.k_proj.weight,
+                                 hd)
+
+        if _use_fused(cfg, "fused_rms_rope_qkv", key,
+                      probe=_kernel_serves,
+                      layers=(attn.q_proj, attn.k_proj, attn.v_proj)):
+            return x, self.input_layernorm.weight
+        return self.input_layernorm(x), None
+
     def forward(self, x, cos, sin, attn_mask=None, cache=None,
                 seq_lens=None, block_tables=None, span_starts=None):
         if cache is not None:
-            attn, cache = self.self_attn(self.input_layernorm(x), cos, sin,
+            attn_in, nw = self._attn_input(x)
+            attn, cache = self.self_attn(attn_in, cos, sin,
                                          attn_mask, cache=cache,
                                          seq_lens=seq_lens,
                                          block_tables=block_tables,
-                                         span_starts=span_starts)
+                                         span_starts=span_starts,
+                                         norm_weight=nw)
             x = x + attn
             x = x + self.mlp(self.post_attention_layernorm(x))
             return x, cache
         # named scopes → readable xprof/Perfetto traces (profiler facade)
         with jax.named_scope("attn"):
-            x = x + self.self_attn(self.input_layernorm(x), cos, sin,
-                                   attn_mask)
+            attn_in, nw = self._attn_input(x)
+            x = x + self.self_attn(attn_in, cos, sin, attn_mask,
+                                   norm_weight=nw)
         with jax.named_scope("mlp"):
             x = x + self.mlp(self.post_attention_layernorm(x))
         return x

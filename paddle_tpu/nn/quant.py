@@ -48,6 +48,16 @@ def _use_int4_kernel() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def _use_int8_kernel() -> bool:
+    """Same gate for the fused int8 kernel (ops/pallas/int8_matmul.py);
+    PDTPU_INT8_KERNEL=0 pins the XLA formulation for A/B runs."""
+    import os
+
+    if os.environ.get("PDTPU_INT8_KERNEL", "1") == "0":
+        return False
+    return jax.default_backend() == "tpu"
+
+
 def _active_mesh():
     """The physical mesh entered via ``with mesh:`` (None outside).
     Mosaic kernels cannot be auto-partitioned by GSPMD: under a mesh the
@@ -69,6 +79,18 @@ def _kernel_eligible(weight_scale, n_tokens) -> bool:
             and _use_int4_kernel())
 
 
+def _int8_kernel_eligible(weight_scale, n_tokens) -> bool:
+    """Same shape gate for the fused int8 kernel: decode-sized token
+    counts where the weight stream is the roofline."""
+    return (weight_scale.ndim == 1 and n_tokens <= 256
+            and _use_int8_kernel())
+
+
+def _int8_matmul_fn():
+    from ..ops.pallas.int8_matmul import int8_matmul
+    return int8_matmul
+
+
 def _n_tokens(x) -> int:
     n = 1
     for d in x.shape[:-1]:
@@ -76,14 +98,15 @@ def _n_tokens(x) -> int:
     return n
 
 
-def _int4_kernel_column_sharded(x2d, weight, scale, mesh):
-    """shard_map'd int4 kernel for the COLUMN-parallel layout: weight
-    (K2, N) split over mp on N, per-channel scales split with it — each
-    shard runs the kernel on its own columns and no cross-device
-    reduction is needed (that is what makes column the safe case;
-    row-parallel contracts over a sharded K and keeps the XLA path,
-    whose psum GSPMD inserts).  The token dim rides the data axes when
-    it divides them, so a dp-sharded serving batch is not gathered."""
+def _kernel_column_sharded(matmul_fn, x2d, weight, scale, mesh):
+    """shard_map'd quantized matmul kernel for the COLUMN-parallel
+    layout: weight (K|K2, N) split over mp on N, per-channel scales
+    split with it — each shard runs the kernel on its own columns and no
+    cross-device reduction is needed (that is what makes column the safe
+    case; row-parallel contracts over a sharded K and keeps the XLA
+    path, whose psum GSPMD inserts).  The token dim rides the data axes
+    when it divides them, so a dp-sharded serving batch is not gathered.
+    Shared by the int4 and int8 kernels."""
     from ..core.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -95,12 +118,17 @@ def _int4_kernel_column_sharded(x2d, weight, scale, mesh):
     bt = data_axes if (data_axes and x2d.shape[0] % dsize == 0) else None
 
     f = shard_map(
-        lambda a, w, s: _int4_matmul_fn()(a, w, s),
+        lambda a, w, s: matmul_fn(a, w, s),
         mesh=mesh,
         in_specs=(P(bt, None), P(None, "mp"), P("mp")),
         out_specs=P(bt, "mp"),
         check_vma=False)
     return f(x2d, weight, scale)
+
+
+def _int4_kernel_column_sharded(x2d, weight, scale, mesh):
+    return _kernel_column_sharded(_int4_matmul_fn(), x2d, weight, scale,
+                                  mesh)
 
 
 def _int4_matmul_fn():
@@ -204,6 +232,19 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
                               jnp.asarray(weight), weight_scale)
         y = y.reshape(*lead, y.shape[-1])
         return y if bias is None else y + bias
+    if (algo == "weight_only_int8"
+            and _int8_kernel_eligible(weight_scale, _n_tokens(x))
+            and _active_mesh() is None):
+        # fused int8 dequant-in-matmul (ops/pallas/int8_matmul.py): HBM
+        # streams the raw int8 bytes, the widening + per-channel scale
+        # run in VMEM — serving's decode GEMVs stop dequantizing in fp.
+        # Same mesh caveat as int4: the column-parallel layer routes
+        # multi-chip through the explicit shard_map instead.
+        lead = x.shape[:-1]
+        y = _int8_matmul_fn()(x.reshape(-1, x.shape[-1]),
+                              jnp.asarray(weight), weight_scale)
+        y = y.reshape(*lead, y.shape[-1])
+        return y if bias is None else y + bias
     if weight_scale.ndim == 2:  # groupwise: dequant fuses into the dot
         w = weight_dequantize(weight, weight_scale, algo=algo,
                               group_size=group_size, out_dtype=x.dtype)
@@ -304,13 +345,19 @@ class QuantizedColumnParallelLinear(Layer):
         if self.sequence_parallel:
             x = act_constrain(x, "mp", None)
         mesh = _active_mesh()
-        if (mesh is not None and "mp" in mesh.axis_names
-                and self._wdtype == "int4"
-                and _kernel_eligible(self.weight_scale, _n_tokens(x))):
+        sharded_fn = None
+        if mesh is not None and "mp" in mesh.axis_names:
+            if self._wdtype == "int4" and _kernel_eligible(
+                    self.weight_scale, _n_tokens(x)):
+                sharded_fn = _int4_matmul_fn()
+            elif self._wdtype == "int8" and _int8_kernel_eligible(
+                    self.weight_scale, _n_tokens(x)):
+                sharded_fn = _int8_matmul_fn()
+        if sharded_fn is not None:
             # multi-chip serving: explicit shard_map over mp (column split
             # needs no reduction) — GSPMD cannot partition the kernel
-            y = _int4_kernel_column_sharded(
-                x.reshape(-1, x.shape[-1]), self.weight,
+            y = _kernel_column_sharded(
+                sharded_fn, x.reshape(-1, x.shape[-1]), self.weight,
                 self.weight_scale, mesh)
             y = y.reshape(*x.shape[:-1], y.shape[-1])
             if self.bias is not None:

@@ -97,3 +97,57 @@ def _ragged_paged_attention_dispatch(q, k_pool, v_pool, block_tables,
 
 dispatch.register("ragged_paged_attention", _ragged_paged_attention_dispatch,
                   platform="tpu")
+
+# -- fused-kernel library (docs/KERNELS.md) ---------------------------------
+# Each dispatch returns None when the kernel cannot serve (shape gate or
+# an active mesh — GSPMD cannot auto-partition Mosaic kernels) and the
+# caller falls back to the XLA composition in incubate.nn.functional.
+
+from . import fused_mlp as _fm
+from . import fused_norm_qkv as _fq
+from . import fused_adamw as _fadamw
+
+
+def _fused_swiglu_dispatch(x, w_gate, w_up, w_down):
+    if _active_mesh() is not None or not _fm.supported(x, w_gate, w_down):
+        return None
+    return _fm.fused_swiglu_mlp(x, w_gate, w_up, w_down)
+
+
+dispatch.register("fused_swiglu_mlp", _fused_swiglu_dispatch,
+                  platform="tpu")
+
+
+def _fused_gelu_dispatch(x, w1, b1, w2, b2):
+    if _active_mesh() is not None \
+            or not _fm.supported(x, w1, w2, op="fused_gelu_mlp"):
+        return None
+    return _fm.fused_gelu_mlp(x, w1, b1, w2, b2)
+
+
+dispatch.register("fused_gelu_mlp", _fused_gelu_dispatch, platform="tpu")
+
+
+def _fused_rms_rope_qkv_dispatch(x, norm_weight, w_q, w_k, w_v, cos, sin,
+                                 head_dim, eps):
+    if _active_mesh() is not None \
+            or not _fq.supported(x, w_q, w_k, head_dim):
+        return None
+    return _fq.fused_rms_rope_qkv(x, norm_weight, w_q, w_k, w_v, cos,
+                                  sin, head_dim, eps=eps)
+
+
+dispatch.register("fused_rms_rope_qkv", _fused_rms_rope_qkv_dispatch,
+                  platform="tpu")
+
+
+def _fused_adamw_dispatch(p, g, m, v, lr, c1, c2, *, beta1, beta2, eps,
+                          wd):
+    if _active_mesh() is not None or not _fadamw.eligible(p):
+        return None
+    return _fadamw.fused_adamw_update(p, g, m, v, lr, c1, c2,
+                                      beta1=beta1, beta2=beta2, eps=eps,
+                                      wd=wd)
+
+
+dispatch.register("fused_adamw", _fused_adamw_dispatch, platform="tpu")

@@ -326,16 +326,39 @@ class Adam(Optimizer):
 
 
 class AdamW(Adam):
-    """Decoupled weight decay (reference: AdamwDenseKernel)."""
+    """Decoupled weight decay (reference: AdamwDenseKernel).
+
+    ``use_fused``: route eligible parameter updates through the fused
+    Pallas AdamW kernel (ops/pallas/fused_adamw.py) — moments + param in
+    one elementwise pass over aliased buffers on TPU.  ``None`` (auto)
+    uses the kernel wherever its dispatch serves (TPU backend, f32
+    lane-aligned params); ``False`` pins the XLA composition.  Both
+    compute the same formula (tests/test_fused_kernels.py)."""
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=0.01, grad_clip=None,
-                 multi_precision=False, apply_decay_param_fun=None, lr_ratio=None):
+                 multi_precision=False, apply_decay_param_fun=None, lr_ratio=None,
+                 use_fused=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          weight_decay, grad_clip, multi_precision)
         self.apply_decay_param_fun = apply_decay_param_fun
+        self.use_fused = use_fused
 
     def _update_one(self, name, p, g, lr, slots, step, wd):
+        if self.use_fused is not False:
+            from ..ops import dispatch
+            impl = dispatch.get("fused_adamw")
+            if impl is not None:
+                t = (step + 1).astype(jnp.float32)
+                out = impl(p, g, slots["moment1"], slots["moment2"],
+                           jnp.asarray(lr, jnp.float32),
+                           1.0 / (1.0 - self.beta1 ** t),
+                           1.0 / (1.0 - self.beta2 ** t),
+                           beta1=self.beta1, beta2=self.beta2,
+                           eps=self.epsilon, wd=float(wd))
+                if out is not None:
+                    new_p, m, v = out
+                    return new_p, {"moment1": m, "moment2": v}
         new_p, m, v = self._adam_core(p, g, lr, slots["moment1"], slots["moment2"],
                                       step, wd, decoupled=True)
         return new_p, {"moment1": m, "moment2": v}

@@ -169,6 +169,15 @@ class Engine:
     host-side serving I/O (the preemption swap dispatches); defaults to
     3 attempts with 20 ms base backoff.
 
+    ``weight_quant``: ``"int8"``/``"int4"`` applies the weight-only
+    serving transform (``nn.quant.quantize_linears``, IN PLACE on
+    ``model``) before the step traces, so decode's projection GEMVs
+    stream quantized bytes — on TPU through the fused dequant-in-matmul
+    kernels (ops/pallas/int8_matmul.py, int4_matmul.py).  ``page_size``
+    and ``prefill_chunk`` also accept ``"auto"``: the values come from
+    ``tools/tuned_configs.json`` (per model geometry and backend,
+    resolved at construction — never per step).
+
     ``mesh``: a serving mesh (``serving.distributed.serving_mesh``)
     makes this engine TENSOR-PARALLEL: parameters land sharded by their
     partition specs, the paged KV pools shard their head axis over the
@@ -190,12 +199,28 @@ class Engine:
                  keep_finished: int = 1024,
                  max_queue: Optional[int] = None,
                  retry: Optional[RetryPolicy] = None,
-                 mesh=None):
+                 mesh=None,
+                 weight_quant: Optional[str] = None):
         if not _paged_supported(model):
             raise NotImplementedError(
                 f"{type(model).__name__} does not support the paged "
                 "serving path (needs supports_paged decoder layers and "
                 "pipeline_stages == 1)")
+        n_layers, kv_heads, head_dim = _kv_geometry(model)
+        if page_size == "auto" or prefill_chunk == "auto":
+            # tuned serving knobs (tools/tuned_configs.json): resolved
+            # HERE, before any trace — warmup compiles against the
+            # resolved values and steady state never re-reads them (the
+            # zero-recompile contract; ops.tuning docstring)
+            from ..ops import tuning
+            scfg = tuning.tuned_config(
+                "serving", tuning.geom_key(
+                    h=model.cfg.hidden_size, l=n_layers, kv=kv_heads,
+                    hd=head_dim))
+            if page_size == "auto":
+                page_size = scfg.get("page_size", 16)
+            if prefill_chunk == "auto":
+                prefill_chunk = scfg.get("prefill_chunk", None)
         if max_batch < 1 or max_seq_len < page_size:
             raise ValueError(
                 f"bad geometry: max_batch={max_batch}, "
@@ -211,6 +236,22 @@ class Engine:
             raise ValueError(
                 f"max_seq_len={max_seq_len} exceeds the model's "
                 f"max_position_embeddings={max_pos}")
+        if weight_quant is not None:
+            # decode weight path (docs/KERNELS.md): swap the model's
+            # Linears for weight-only quantized variants IN PLACE (the
+            # serving transform, nn.quant) so the decode GEMVs stream
+            # int8/int4 — on TPU through the fused dequant-in-matmul
+            # kernels.  Done AFTER every constructor validation above (a
+            # rejected construction must not corrupt the caller's still-
+            # usable model) and before serving_params below, so the
+            # quantized buffers ride the compiled step as inputs;
+            # model.generate() on the same object sees the same weights,
+            # keeping greedy token-identity checkable.
+            from ..nn.quant import quantize_linears
+            algo = {"int8": "weight_only_int8",
+                    "int4": "weight_only_int4"}.get(weight_quant,
+                                                    weight_quant)
+            quantize_linears(model, algo=algo)
         model.eval()
         self.model = model
         self.max_batch = int(max_batch)
@@ -224,7 +265,6 @@ class Engine:
         if num_blocks is None:
             # enough for every slot to run a full-length sequence
             num_blocks = self.max_batch * self.max_blocks_per_seq
-        n_layers, kv_heads, head_dim = _kv_geometry(model)
         dtype = kv_cache_dtype if kv_cache_dtype is not None else \
             getattr(model.cfg, "dtype", "float32")
         self.mesh = mesh

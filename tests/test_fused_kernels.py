@@ -1,0 +1,525 @@
+"""Fused-kernel library (docs/KERNELS.md): interpret-mode kernel vs XLA
+fallback equivalence, gradients, model/optimizer/engine wiring, tuned
+configs, and the bench plumbing.
+
+The engine/model dispatch between the Pallas kernels (TPU) and the XLA
+compositions (CPU/other) per backend, so a drift here would make TPU and
+CPU CI disagree about what the fused paths compute."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.incubate.nn import functional as IF
+from paddle_tpu.nn import functional as F
+from paddle_tpu.ops import tuning
+from paddle_tpu.ops.pallas import fused_adamw as FA
+from paddle_tpu.ops.pallas import fused_mlp as FM
+from paddle_tpu.ops.pallas import fused_norm_qkv as FQ
+from paddle_tpu.ops.pallas import int8_matmul as I8
+
+R = np.random.default_rng(0)
+
+
+def _arr(*shape, dtype=jnp.float32, scale=0.05):
+    return jnp.asarray(R.normal(size=shape) * scale, dtype)
+
+
+def _tol(dtype):
+    return dict(rtol=2e-2, atol=2e-2) if dtype == jnp.bfloat16 \
+        else dict(rtol=2e-5, atol=2e-5)
+
+
+def _cos_sin(t, hd, dtype=jnp.float32):
+    inv = 1.0 / (10000.0 ** (np.arange(0, hd, 2) / hd))
+    fr = np.einsum("s,d->sd", np.arange(t), inv)
+    emb = np.concatenate([fr, fr], -1)
+    return (jnp.asarray(np.cos(emb), dtype),
+            jnp.asarray(np.sin(emb), dtype))
+
+
+class TestFusedMLPKernel:
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    @pytest.mark.parametrize("t", [64, 37])    # odd T pads internally
+    def test_swiglu_kernel_matches_fallback(self, dtype, t):
+        h, i = 128, 256
+        x = _arr(t, h, dtype=dtype, scale=1.0)
+        wg, wu, wd = _arr(h, i, dtype=dtype), _arr(h, i, dtype=dtype), \
+            _arr(i, h, dtype=dtype)
+        got = FM.fused_swiglu_mlp(x, wg, wu, wd, interpret=True)
+        want = IF._fused_swiglu_mlp_ref(x, wg, wu, wd)
+        assert got.shape == (t, h) and got.dtype == dtype
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want, np.float32),
+                                   **_tol(dtype))
+
+    def test_swiglu_kernel_blocked_inner_axis(self):
+        # block_i < I exercises the accumulating 2-D grid
+        h, i, t = 128, 512, 32
+        x = _arr(t, h, scale=1.0)
+        wg, wu, wd = _arr(h, i), _arr(h, i), _arr(i, h)
+        got = FM.fused_swiglu_mlp(x, wg, wu, wd, block_t=16, block_i=128,
+                                  interpret=True)
+        want = IF._fused_swiglu_mlp_ref(x, wg, wu, wd)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_gelu_kernel_matches_fallback(self):
+        h, f, t = 128, 256, 50
+        x = _arr(t, h, scale=1.0)
+        w1, b1 = _arr(h, f), _arr(f)
+        w2, b2 = _arr(f, h), _arr(h)
+        got = FM.fused_gelu_mlp(x, w1, b1, w2, b2, interpret=True)
+        want = IF._fused_gelu_mlp_ref(x, w1, b1, w2, b2)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_entry_matches_unfused_model_path(self):
+        # semantic pin: the fused entry ≈ the pre-fusion LlamaMLP math
+        h, i, t = 128, 256, 16
+        x = _arr(t, h, scale=1.0)
+        wg, wu, wd = _arr(h, i), _arr(h, i), _arr(i, h)
+        got = IF.fused_swiglu_mlp(x, wg, wu, wd)
+        want = F.swiglu(x @ wg, x @ wu) @ wd
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_gradients_match_composition(self):
+        h, i, t = 64, 128, 8
+        x = _arr(t, h, scale=1.0)
+        wg, wu, wd = _arr(h, i), _arr(h, i), _arr(i, h)
+
+        def loss_fused(x, wg, wu, wd):
+            return jnp.sum(IF.fused_swiglu_mlp(x, wg, wu, wd) ** 2)
+
+        def loss_ref(x, wg, wu, wd):
+            return jnp.sum((F.swiglu(x @ wg, x @ wu) @ wd) ** 2)
+
+        gf = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(x, wg, wu, wd)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, wg, wu, wd)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+
+class TestFusedNormRopeQKV:
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    @pytest.mark.parametrize("t,nk", [(32, 256), (29, 128)])
+    def test_kernel_matches_fallback(self, dtype, t, nk):
+        """GQA (nk < nq), odd seq lens, both dtypes."""
+        h, nq, hd = 128, 256, 32
+        x = _arr(t, h, dtype=dtype, scale=1.0)
+        gw = jnp.asarray(1.0 + 0.1 * R.normal(size=(h,)), dtype)
+        wq, wk, wv = (_arr(h, nq, dtype=dtype), _arr(h, nk, dtype=dtype),
+                      _arr(h, nk, dtype=dtype))
+        cos, sin = _cos_sin(t, hd, dtype)
+        got = FQ.fused_rms_rope_qkv(x, gw, wq, wk, wv, cos, sin, hd,
+                                    eps=1e-5, interpret=True)
+        want = IF._fused_rms_rope_qkv_ref(x, gw, wq, wk, wv, cos, sin,
+                                          hd, 1e-5)
+        for g, w in zip(got, want):
+            assert g.shape == w.shape and g.dtype == dtype
+            np.testing.assert_allclose(np.asarray(g, np.float32),
+                                       np.asarray(w, np.float32),
+                                       **_tol(dtype))
+
+    def test_entry_matches_unfused_model_path(self):
+        """Semantic pin against the pre-fusion composition: rms_norm →
+        projections → apply_rotary_pos_emb."""
+        t, h, nq, nk, hd = 24, 128, 256, 128, 32
+        x = _arr(t, h, scale=1.0)
+        gw = jnp.asarray(1.0 + 0.1 * R.normal(size=(h,)), jnp.float32)
+        wq, wk, wv = _arr(h, nq), _arr(h, nk), _arr(h, nk)
+        cos, sin = _cos_sin(t, hd)
+        q, k, v = IF.fused_rms_rope_qkv(x, gw, wq, wk, wv, cos, sin, hd,
+                                        1e-5)
+        nx = F.rms_norm(x, gw, 1e-5)
+        q_ref = (nx @ wq).reshape(1, t, nq // hd, hd)
+        k_ref = (nx @ wk).reshape(1, t, nk // hd, hd)
+        qr, kr = F.apply_rotary_pos_emb(q_ref, k_ref, cos, sin)
+        np.testing.assert_allclose(np.asarray(q),
+                                   np.asarray(qr.reshape(t, nq)),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(k),
+                                   np.asarray(kr.reshape(t, nk)),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(v), np.asarray(nx @ wv),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_gradients_match_composition(self):
+        t, h, nq, nk, hd = 8, 64, 128, 128, 32
+        x = _arr(t, h, scale=1.0)
+        gw = jnp.ones((h,), jnp.float32)
+        wq, wk, wv = _arr(h, nq), _arr(h, nk), _arr(h, nk)
+        cos, sin = _cos_sin(t, hd)
+
+        def loss_fused(x, wq):
+            q, k, v = IF.fused_rms_rope_qkv(x, gw, wq, wk, wv, cos, sin,
+                                            hd, 1e-5)
+            return jnp.sum(q ** 2) + jnp.sum(k * v)
+
+        def loss_ref(x, wq):
+            nx = F.rms_norm(x, gw, 1e-5)
+            qr, kr = F.apply_rotary_pos_emb(
+                (nx @ wq).reshape(1, t, nq // hd, hd),
+                (nx @ wk).reshape(1, t, nk // hd, hd), cos, sin)
+            return jnp.sum(qr.reshape(t, nq) ** 2) \
+                + jnp.sum(kr.reshape(t, nk) * (nx @ wv))
+
+        gf = jax.grad(loss_fused, argnums=(0, 1))(x, wq)
+        gr = jax.grad(loss_ref, argnums=(0, 1))(x, wq)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_supported_gates(self):
+        x = _arr(8, 128)
+        assert FQ.supported(x, _arr(128, 256), _arr(128, 128), 64)
+        # misaligned widths / wrong dtypes / giant geometry fall back
+        assert not FQ.supported(x, _arr(128, 200), _arr(128, 128), 64)
+        assert not FQ.supported(x.astype(jnp.float16), _arr(128, 256),
+                                _arr(128, 128), 64)
+        big = jax.ShapeDtypeStruct((8, 8192), jnp.float32)
+        assert not FQ.supported(
+            jnp.zeros((8, 8192), jnp.bfloat16),
+            jnp.zeros((8192, 8192), jnp.bfloat16),
+            jnp.zeros((8192, 8192), jnp.bfloat16), 128), big
+
+
+class TestInt8MatmulKernel:
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_kernel_matches_xla_int8_path(self, dtype):
+        from paddle_tpu.nn.quant import weight_quantize, weight_only_linear
+        k, n = 256, 384
+        w_fp = np.asarray(R.normal(size=(k, n)) * 0.1, np.float32)
+        qw, sc = weight_quantize(jnp.asarray(w_fp),
+                                 algo="weight_only_int8")
+        x = _arr(8, k, dtype=dtype, scale=1.0)
+        got = I8.int8_matmul(x, qw, sc, interpret=True)
+        want = weight_only_linear(x, qw, weight_scale=sc)
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want, np.float32),
+                                   **_tol(dtype))
+
+    def test_kernel_within_quant_tolerance_of_fp(self):
+        from paddle_tpu.nn.quant import weight_quantize
+        k, n = 256, 256
+        w_fp = np.asarray(R.normal(size=(k, n)) * 0.1, np.float32)
+        qw, sc = weight_quantize(jnp.asarray(w_fp),
+                                 algo="weight_only_int8")
+        x = _arr(4, k, scale=1.0)
+        got = np.asarray(I8.int8_matmul(x, qw, sc, interpret=True))
+        ref = np.asarray(x) @ w_fp
+        # int8 per-channel symmetric quantization: ~0.4% relative error
+        assert np.abs(got - ref).max() <= 2e-2 * np.abs(ref).max() + 1e-3
+
+    def test_blocked_k_path(self):
+        from paddle_tpu.nn.quant import weight_quantize, weight_only_linear
+        k, n = 512, 256
+        qw, sc = weight_quantize(
+            jnp.asarray(R.normal(size=(k, n)) * 0.1, jnp.float32),
+            algo="weight_only_int8")
+        x = _arr(4, k, scale=1.0)
+        got = I8.int8_matmul(x, qw, sc, block_k=128, block_n=128,
+                             interpret=True)
+        # force the 2-D accumulating grid via a tiny MAX_1D_K
+        old = I8.MAX_1D_K
+        try:
+            I8.MAX_1D_K = 256
+            got2 = I8.int8_matmul(x, qw, sc, block_k=128, block_n=128,
+                                  interpret=True)
+        finally:
+            I8.MAX_1D_K = old
+        want = weight_only_linear(x, qw, weight_scale=sc)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(got2), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_shape_validation(self):
+        with pytest.raises(ValueError):
+            I8.int8_matmul(_arr(4, 128), jnp.zeros((64, 128), jnp.int8),
+                           jnp.ones((128,)), interpret=True)
+        with pytest.raises(ValueError):
+            I8.int8_matmul(_arr(4, 128), jnp.zeros((128, 128), jnp.int8),
+                           jnp.ones((64,)), interpret=True)
+
+
+class TestFusedAdamWKernel:
+    def _legs(self, p, g, m, v, step, wd):
+        from paddle_tpu import optimizer as opt
+        aw = opt.AdamW(learning_rate=1e-3, weight_decay=wd,
+                       use_fused=False)
+        lr = jnp.float32(1e-3)
+        t = jnp.float32(step + 1)
+        c1 = 1.0 / (1.0 - 0.9 ** t)
+        c2 = 1.0 / (1.0 - 0.999 ** t)
+        got = FA.fused_adamw_update(p, g, m, v, lr, c1, c2, beta1=0.9,
+                                    beta2=0.999, eps=1e-8, wd=wd,
+                                    interpret=True)
+        want_p, slots = aw._update_one(
+            "w", p, g, lr, {"moment1": m, "moment2": v},
+            jnp.int32(step), wd)
+        return got, (want_p, slots["moment1"], slots["moment2"])
+
+    @pytest.mark.parametrize("wd", [0.0, 0.01])
+    @pytest.mark.parametrize("shape", [(16, 128), (1024,)])
+    def test_kernel_matches_adam_core(self, wd, shape):
+        p = jnp.asarray(R.normal(size=shape), jnp.float32)
+        g = jnp.asarray(R.normal(size=shape), jnp.float32)
+        m = jnp.asarray(R.normal(size=shape) * 0.1, jnp.float32)
+        v = jnp.asarray(np.abs(R.normal(size=shape)) * 0.01, jnp.float32)
+        got, want = self._legs(p, g, m, v, step=7, wd=wd)
+        for a, b in zip(got, want):
+            assert a.shape == shape
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-6)
+
+    def test_eligibility(self):
+        assert FA.eligible(jnp.zeros((8, 128), jnp.float32))
+        assert FA.eligible(jnp.zeros((1024,), jnp.float32))
+        assert not FA.eligible(jnp.zeros((100,), jnp.float32))   # ragged
+        assert not FA.eligible(jnp.zeros((8, 128), jnp.bfloat16))
+        assert not FA.eligible(jnp.zeros((512,), jnp.float32))   # < 1024
+
+    def test_adamw_use_fused_kwarg_cpu_noop(self):
+        """On CPU the dispatch declines and use_fused falls back to the
+        XLA core — updates bitwise-identical to use_fused=False."""
+        from paddle_tpu import optimizer as opt
+        p = jnp.asarray(R.normal(size=(16, 128)), jnp.float32)
+        g = jnp.asarray(R.normal(size=(16, 128)), jnp.float32)
+        slots = {"moment1": jnp.zeros_like(p), "moment2": jnp.zeros_like(p)}
+        lr = jnp.float32(1e-3)
+        outs = []
+        for fused in (None, False):
+            aw = opt.AdamW(learning_rate=1e-3, weight_decay=0.01,
+                           use_fused=fused)
+            outs.append(aw._update_one("w", p, g, lr, dict(slots),
+                                       jnp.int32(0), 0.01))
+        np.testing.assert_array_equal(np.asarray(outs[0][0]),
+                                      np.asarray(outs[1][0]))
+
+
+class TestTuningRegistry:
+    def test_geom_key_is_canonical(self):
+        assert tuning.geom_key(h=1024, i=2816) == "h1024_i2816"
+        assert tuning.geom_key(i=2816, h=1024) == "h1024_i2816"
+
+    def test_lookup_and_reload(self, tmp_path, monkeypatch):
+        path = tmp_path / "tuned.json"
+        path.write_text(json.dumps(
+            {"cpu": {"fused_swiglu_mlp": {"h64_i128": {"block_t": 64}},
+                     "serving": {"k": {"page_size": 8}}}}))
+        monkeypatch.setenv("PDTPU_TUNED_CONFIGS", str(path))
+        tuning.reload()
+        try:
+            assert tuning.tuned_config("fused_swiglu_mlp",
+                                       "h64_i128") == {"block_t": 64}
+            assert tuning.tuned_config("fused_swiglu_mlp", "nope") == {}
+            assert tuning.tuned_config("absent", "x") == {}
+            assert tuning.tuned_config(
+                "serving", "k", backend="cpu")["page_size"] == 8
+        finally:
+            monkeypatch.delenv("PDTPU_TUNED_CONFIGS")
+            tuning.reload()
+
+    def test_missing_file_means_defaults(self, monkeypatch):
+        monkeypatch.setenv("PDTPU_TUNED_CONFIGS", "/nonexistent/x.json")
+        tuning.reload()
+        try:
+            assert tuning.tuned_config("fused_swiglu_mlp", "any") == {}
+        finally:
+            monkeypatch.delenv("PDTPU_TUNED_CONFIGS")
+            tuning.reload()
+
+    def test_fusion_enabled_modes(self):
+        assert tuning.fusion_enabled("off", "fused_swiglu_mlp") is False
+        assert tuning.fusion_enabled("on", "fused_swiglu_mlp") is True
+        # auto on CPU: the kernel dispatch is TPU-only → stays unfused
+        assert tuning.fusion_enabled("auto", "fused_swiglu_mlp") is False
+        with pytest.raises(ValueError):
+            tuning.fusion_enabled("maybe", "fused_swiglu_mlp")
+
+    def test_committed_configs_parse(self):
+        """tools/tuned_configs.json (the committed winners) loads
+        through the real registry path."""
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "tuned_configs.json")
+        assert os.path.exists(path)
+        with open(path) as f:
+            data = json.load(f)
+        assert "cpu" in data
+        assert "serving" in data["cpu"]
+
+
+class TestModelWiring:
+    def test_llama_fused_matches_unfused(self):
+        from paddle_tpu.models.llama import llama
+        pt.seed(0)
+        m_off = llama("tiny", fused_ops="off")
+        pt.seed(0)
+        m_on = llama("tiny", fused_ops="on")
+        ids = jnp.asarray(R.integers(0, 256, size=(2, 13)))
+        lo, ln = m_off(ids), m_on(ids)
+        np.testing.assert_allclose(np.asarray(lo), np.asarray(ln),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_llama_auto_is_unfused_on_cpu(self):
+        from paddle_tpu.models.llama import llama
+        pt.seed(0)
+        m_off = llama("tiny", fused_ops="off")
+        pt.seed(0)
+        m_auto = llama("tiny")    # default auto
+        ids = jnp.asarray(R.integers(0, 256, size=(1, 9)))
+        np.testing.assert_array_equal(np.asarray(m_off(ids)),
+                                      np.asarray(m_auto(ids)))
+
+    def test_gpt_fused_matches_unfused(self):
+        from paddle_tpu.models.gpt import gpt
+        pt.seed(0)
+        g_off = gpt("tiny", fused_ops="off")
+        pt.seed(0)
+        g_on = gpt("tiny", fused_ops="on")
+        ids = jnp.asarray(R.integers(0, 256, size=(2, 11)))
+        np.testing.assert_allclose(np.asarray(g_off(ids)),
+                                   np.asarray(g_on(ids)),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_fused_generate_and_train_step(self):
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.jit import TrainStep
+        from paddle_tpu.models.llama import causal_lm_loss, llama
+        pt.seed(0)
+        model = llama("tiny", fused_ops="on")
+        ids = jnp.asarray(R.integers(0, 256, size=(1, 7)))
+        out = model.generate(ids, max_new_tokens=3, temperature=0.0)
+        assert out.shape == (1, 10)
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+        step = TrainStep(model, causal_lm_loss, opt)
+        state = step.init_state(seed=0)
+        batch = {"input_ids": jnp.asarray(R.integers(0, 256, size=(2, 16))),
+                 "labels": jnp.asarray(R.integers(0, 256, size=(2, 16)))}
+        state, met = step(state, batch)
+        state, met = step(state, batch)
+        assert np.isfinite(float(met["loss"]))
+
+
+class TestEngineWiring:
+    def test_weight_quant_fused_token_identity(self):
+        from paddle_tpu import serving
+        from paddle_tpu.models.llama import llama
+        pt.seed(0)
+        model = llama("tiny", fused_ops="on")
+        eng = serving.Engine(model, max_batch=2, max_seq_len=48,
+                             page_size=8, prefill_chunk=8,
+                             weight_quant="int8").warmup()
+        prompt = R.integers(0, 256, size=11).astype(np.int32)
+        rid = eng.add_request(prompt, max_new_tokens=5)
+        outs = eng.run()
+        ref = np.asarray(model.generate(
+            jnp.asarray(prompt)[None], max_new_tokens=5,
+            temperature=0.0))[0, len(prompt):]
+        assert list(outs[rid]) == list(ref)
+        assert eng.kv_blocks_used == 0
+
+    def test_quantized_model_keeps_scales_under_fused_on(self):
+        """Review regression: the fused model paths read `.weight`
+        directly, but weight-only quantized layers keep raw int8 codes
+        there (scale in a separate buffer) — the fused branches must
+        step aside for quantized projections or outputs silently lose
+        the scales."""
+        from paddle_tpu.models.llama import llama
+        from paddle_tpu.nn.quant import quantize_linears
+        ids = jnp.asarray(R.integers(0, 256, size=(1, 9)))
+        outs = {}
+        for mode in ("on", "off"):
+            pt.seed(0)
+            m = llama("tiny", fused_ops=mode)
+            quantize_linears(m, algo="weight_only_int8")
+            outs[mode] = np.asarray(m(ids))
+        np.testing.assert_allclose(outs["on"], outs["off"],
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_auto_serving_knobs_resolve_from_tuned_configs(
+            self, tmp_path, monkeypatch):
+        from paddle_tpu import serving
+        from paddle_tpu.models.llama import llama
+        pt.seed(0)
+        model = llama("tiny")
+        cfg = model.cfg
+        key = tuning.geom_key(h=cfg.hidden_size, l=cfg.num_hidden_layers,
+                              kv=cfg.num_key_value_heads,
+                              hd=cfg.head_dim)
+        path = tmp_path / "tuned.json"
+        path.write_text(json.dumps(
+            {"cpu": {"serving": {key: {"page_size": 4,
+                                       "prefill_chunk": 12}}}}))
+        monkeypatch.setenv("PDTPU_TUNED_CONFIGS", str(path))
+        tuning.reload()
+        try:
+            eng = serving.Engine(model, max_batch=2, max_seq_len=48,
+                                 page_size="auto", prefill_chunk="auto")
+            assert eng.page_size == 4
+            assert eng.prefill_chunk == 12
+        finally:
+            monkeypatch.delenv("PDTPU_TUNED_CONFIGS")
+            tuning.reload()
+
+    def test_auto_knobs_default_without_configs(self, monkeypatch):
+        from paddle_tpu import serving
+        from paddle_tpu.models.llama import llama
+        monkeypatch.setenv("PDTPU_TUNED_CONFIGS", "")
+        tuning.reload()
+        try:
+            pt.seed(0)
+            eng = serving.Engine(llama("tiny"), max_batch=2,
+                                 max_seq_len=48, page_size="auto",
+                                 prefill_chunk="auto")
+            assert eng.page_size == 16
+            assert eng.prefill_chunk == min(16, 48)
+        finally:
+            monkeypatch.delenv("PDTPU_TUNED_CONFIGS")
+            tuning.reload()
+
+
+class TestBenchPlumbing:
+    def test_measure_with_fused_on(self):
+        import bench
+        mfu, stats = bench.measure("tiny", 2, 32, 1, 1, fused_ops="on")
+        assert mfu > 0
+        assert stats["fused"] == "on"
+        assert np.isfinite(stats["loss"])
+
+    def _tools(self):
+        import sys
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+
+    def test_op_benchmark_rows_present(self):
+        import importlib
+        self._tools()
+        ob = importlib.import_module("op_benchmark")
+        rows = ob._fused_ops()
+        for op in ob.FUSED_PAIRS:
+            assert f"fused_{op}" in rows
+            assert f"unfused_{op}" in rows
+
+    def test_telemetry_report_folds_fused(self):
+        import importlib
+        self._tools()
+        tr = importlib.import_module("telemetry_report")
+        agg = tr.summarize([
+            {"event": "run_meta", "kind": "bench", "fused": "on"},
+            {"event": "step", "site": "train", "interval_ms": 10.0},
+        ])
+        assert tr._fused_mode(agg) == "on"
+        assert "| on |" in tr.render(agg)

@@ -410,6 +410,58 @@ class TestRetraceHazard:
         assert rules_of(res) == []
         assert len(res.suppressed) == 1
 
+    def test_positive_per_step_tuned_config_read(self, tmp_path):
+        """R4e: a tuned-config lookup inside the dispatch loop is a
+        per-step read of trace-time-frozen state — flagged."""
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            import jax
+            from paddle_tpu.ops.tuning import tuned_config
+
+            def serve_loop(step, x):
+                while True:
+                    cfg = tuned_config("serving", "h64_l2")
+                    x = step(x, cfg["prefill_chunk"])
+        """})
+        assert rules_of(res) == ["retrace-hazard"]
+        assert "tuned_config" in res.findings[0].message
+
+    def test_positive_tuned_config_attr_call_in_loop(self, tmp_path):
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            from paddle_tpu.ops import tuning
+
+            def pump(engines):
+                for e in engines:
+                    e.chunk = tuning.tuned_config("serving")["c"]
+        """})
+        assert rules_of(res) == ["retrace-hazard"]
+
+    def test_negative_tuned_config_trace_time(self, tmp_path):
+        """The sanctioned idiom: tuned-config lookups at construction
+        time or inside a jit-traced function (resolved once, baked into
+        the compiled program) stay silent."""
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            import jax
+            from paddle_tpu.ops.tuning import tuned_config
+
+            class Engine:
+                def __init__(self):
+                    # construction time: resolved before warmup
+                    self.page = tuned_config("serving").get("page", 16)
+
+            @jax.jit
+            def kernel_wrapper(x):
+                # trace time: runs once per compile, frozen after
+                cfg = tuned_config("fused_swiglu_mlp", "h64_i128")
+                return x * cfg.get("block_t", 256)
+
+            def run(fns, x):
+                cfg = tuned_config("serving")   # hoisted: fine
+                for fn in fns:
+                    x = fn(x, cfg)
+                return x
+        """})
+        assert rules_of(res) == []
+
 
 # ---------------------------------------------------------------------------
 # rule 5: fault-site

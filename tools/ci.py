@@ -741,6 +741,54 @@ def gate_serving_smoke(max_batch: int = 4, n_requests: int = 10) -> int:
                   f"{stats['cow_copies']} CoW cop"
                   f"{'y' if stats['cow_copies'] == 1 else 'ies'}, "
                   "0 compiles)")
+
+        # 4. FUSED DECODE PATH (docs/KERNELS.md): the same contracts
+        # hold with the fused-kernel entry points forced on and the
+        # decode weight path quantized — one warmup compile set, zero
+        # compiles under churn, greedy outputs token-identical to
+        # model.generate() on the same (quantized, fused) model.
+        pt.seed(0)
+        fmodel = llama("tiny", fused_ops="on")
+        feng = serving.Engine(fmodel, max_batch=max_batch,
+                              max_seq_len=64, page_size=8,
+                              prefill_chunk=8,
+                              weight_quant="int8").warmup()
+        fused_warmup = tel.sentinel.compiles()
+        fprompts = [rng.integers(0, fmodel.cfg.vocab_size,
+                                 size=n).astype(np.int32)
+                    for n in (3, 17, 9, 26)]
+        served = []
+        for p in fprompts:
+            rid = feng.add_request(p, max_new_tokens=5)
+            feng.step()     # staggered: join a running batch
+            outs = feng.run()
+            served.append((p, outs[rid]))
+        fused_churn = tel.sentinel.compiles() - fused_warmup
+        if fused_churn:
+            failures.append(
+                f"{fused_churn} compile(s) after warmup with the fused "
+                "decode path on — a fused entry point re-traces under "
+                "churn (ops/tuning must resolve before warmup)")
+        for fn, name in ((feng._step_fn, "fused step"),
+                         (feng._cow_fn, "fused cow")):
+            n = getattr(fn, "_cache_size", lambda: None)()
+            if n is not None and n > 1:
+                failures.append(
+                    f"{name} jit cache holds {n} entries, expected 1")
+        for p, got in served:
+            ref = np.asarray(fmodel.generate(
+                jnp.asarray(p)[None], max_new_tokens=5,
+                temperature=0.0))[0, len(p):]
+            if not np.array_equal(ref, np.asarray(got)):
+                failures.append(
+                    f"fused+int8 request (prompt {len(p)}) diverged "
+                    "from model.generate() — the fused decode path "
+                    "changed greedy outputs")
+        if not any("fused" in f for f in failures):
+            print(f"serving-smoke: fused decode path (fused_ops=on + "
+                  f"int8 weights): {len(fprompts)} requests "
+                  "token-identical to generate(), 0 compiles after "
+                  "warmup")
     finally:
         obs.disable()
 

@@ -80,6 +80,7 @@ def summarize(events):
         "compiles": defaultdict(lambda: {"n": 0, "total_ms": 0.0}),
         "storms": [], "preemptions": [], "hangs": [], "postmortems": [],
         "thread_stacks": [], "metrics": None, "bench_result": None,
+        "run_meta": None,
         # resilience vocabulary (docs/RESILIENCE.md): per-site retry /
         # injected-fault counts, plus resume/restart occurrences
         "retries": defaultdict(int), "faults": defaultdict(int),
@@ -192,7 +193,23 @@ def summarize(events):
             agg["metrics"] = e.get("metrics") or {}
         elif kind == "bench_result":
             agg["bench_result"] = e
+        elif kind == "run_meta":
+            agg["run_meta"] = e
     return agg
+
+
+def _fused_mode(agg):
+    """The run's fused-kernel mode (bench.py --fused), from run_meta or
+    the bench result's stats — None when the stream predates the flag."""
+    for src in (agg.get("run_meta"), agg.get("bench_result")):
+        if src is None:
+            continue
+        if src.get("fused") is not None:
+            return src["fused"]
+        extra = src.get("extra") or {}
+        if extra.get("fused") is not None:
+            return extra["fused"]
+    return None
 
 
 def render(agg, malformed=0):
@@ -206,8 +223,12 @@ def render(agg, malformed=0):
                      "report covers what survived)")
         lines.append("")
     if steps:
-        lines += ["| Site | Steps | ms/step p50 | ms/step p95 | tok/s | MFU |",
-                  "|---|---|---|---|---|---|"]
+        # `fused` column: the run-level fused-kernel mode (bench.py
+        # --fused A/B) so two streams' step tables identify their leg
+        fused = _fused_mode(agg) or "—"
+        lines += ["| Site | Steps | ms/step p50 | ms/step p95 | tok/s "
+                  "| MFU | Fused |",
+                  "|---|---|---|---|---|---|---|"]
         for site, s in sorted(steps.items()):
             iv = sorted(s["intervals"])
             p50 = _pct(iv, 50)
@@ -219,7 +240,8 @@ def render(agg, malformed=0):
                 return f"{v:.{nd}f}" if v is not None else "—"
             lines.append(
                 f"| {site} | {s['n']} ({s['warmup']} warmup) | {fmt(p50)} "
-                f"| {fmt(p95)} | {fmt(tps, 1)} | {fmt(mfu, 4)} |")
+                f"| {fmt(p95)} | {fmt(tps, 1)} | {fmt(mfu, 4)} "
+                f"| {fused} |")
         lines.append("")
     if agg["spans"]:
         lines += ["| Span | Count | ms p50 | ms p95 |", "|---|---|---|---|"]
@@ -469,6 +491,9 @@ def main(argv=None) -> int:
             for rep, rp in sorted(agg["replicas"].items(), key=str)}
     if agg["bench_result"] is not None:
         summary["bench_value"] = agg["bench_result"].get("value")
+    fused = _fused_mode(agg)
+    if fused is not None:
+        summary["fused"] = fused
     print(json.dumps(summary))
     return 0
 
