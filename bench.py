@@ -272,6 +272,14 @@ def main():
             extra[f"{pre}_ttft_p95_ms"] = r["warm_ttft_p95_ms"]
             extra[f"{pre}_tok_s"] = r["warm_agg_tokens_per_sec"]
             extra[f"{pre}_hit_rate"] = r["prefix_hit_rate"]
+            if tel is not None and (r.get("cold_trace")
+                                    or r.get("warm_trace")):
+                # sampled per-request phase breakdown (one cold, one
+                # prefix-warm) into the sidecar: BENCH rounds carry
+                # attribution, not just aggregates (OBSERVABILITY.md)
+                tel.emit({"event": "serve_trace_sample", "row": pre,
+                          "cold": r.get("cold_trace"),
+                          "warm": r.get("warm_trace")})
             extra[f"{pre}_detail"] = {
                 k: r[k] for k in ("requests", "shared_prefix",
                                   "prefill_chunk", "cold_ttft_p95_ms",

@@ -1,0 +1,224 @@
+"""End-to-end serving-observability demo (docs/OBSERVABILITY.md
+"Tracing a request"): mixed multi-tenant churn — prefix-cache hits, a
+mid-flight preemption, an injected replica failure — through a
+2-replica set behind the FrontDoor and the HTTP server, then every
+operational surface is exercised and validated:
+
+1. ``GET /metrics``      — live Prometheus text exposition;
+2. ``GET /v1/requests/<rid>`` — one complete ordered lifecycle
+   timeline per request (trace ids from ``X-Trace-Id`` headers, exact
+   queue/prefill/decode phase accounting, preempt/restore + migrate
+   events where the churn forced them);
+3. ``tools/trace_export.py``  — the JSONL sink folded into
+   Perfetto-loadable Chrome trace-event JSON covering every request;
+4. a ``serve_slo_capture`` fired by an (aggressively thresholded)
+   :class:`observability.SLOCapture` on one replica.
+
+Run (CPU):
+    JAX_PLATFORMS=cpu python examples/trace_serving.py
+"""
+
+import http.client
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+import warnings
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu import observability as obs  # noqa: E402
+from paddle_tpu import resilience as rs  # noqa: E402
+from paddle_tpu import serving  # noqa: E402
+from paddle_tpu.models.llama import llama  # noqa: E402
+from paddle_tpu.serving.distributed import EngineReplicaSet  # noqa: E402
+
+
+def build_replicas(n=2, slo_dir="slo_traces"):
+    reps = []
+    for i in range(n):
+        pt.seed(0)
+        cap = None
+        if i == 0:
+            # aggressive threshold: on this tiny demo ANY TTFT breaches,
+            # so the capture demonstrably arms and completes
+            cap = obs.SLOCapture(ttft_p95_ms=1e-6, trace_dir=slo_dir,
+                                 window_steps=4, windows=2,
+                                 capture_steps=4, min_samples=2)
+        reps.append(serving.Engine(llama("tiny"), max_batch=4,
+                                   max_seq_len=64, page_size=8,
+                                   prefill_chunk=8, slo_capture=cap))
+    return EngineReplicaSet(reps).warmup()
+
+
+def main():
+    jsonl = "trace_demo_telemetry.jsonl"
+    for p in (jsonl, jsonl + ".trace.json"):
+        if os.path.exists(p):
+            os.remove(p)
+    obs.enable(jsonl_path=jsonl, crash_hooks=False)
+    rset = build_replicas()
+    door = serving.FrontDoor(rset, policies={
+        "free": serving.TenantPolicy(priority=0),
+        "pro": serving.TenantPolicy(priority=1, weight=2.0)},
+        max_queue_depth=64)
+    srv = serving.ServingServer(door, poll_s=0.001)
+    host, port = srv.start()
+    print(f"serving on {host}:{port}")
+
+    rng = np.random.default_rng(0)
+    shared = rng.integers(0, 256, size=16).tolist()   # 2 full pages
+    prompts = [rng.integers(0, 256, size=n).tolist()
+               for n in (9, 21, 6, 14, 11, 26)]
+    jobs = [(p, "pro" if i % 3 == 0 else "free")
+            for i, p in enumerate(prompts)]
+
+    # one injected replica failure mid-churn: the victim's requests
+    # evacuate through preempt->swap->restore onto the survivor
+    rs.install_faults("serve.replica@10")
+    results, rids = {}, []
+
+    def post(i, prompt, tenant):
+        conn = http.client.HTTPConnection(host, port, timeout=60)
+        body = json.dumps({"prompt": prompt, "max_tokens": 6,
+                           "tenant": tenant})
+        conn.request("POST", "/v1/completions", body,
+                     {"Content-Type": "application/json",
+                      "X-Trace-Id": f"demo-{i}"})
+        r = conn.getresponse()
+        results[i] = (r.status, json.loads(r.read()))
+        conn.close()
+
+    threads = []
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        for i, (p, tenant) in enumerate(jobs):
+            t = threading.Thread(target=post, args=(i, p, tenant))
+            t.start()
+            threads.append(t)
+            time.sleep(0.02)
+        # a mid-flight preemption: swap a running request to host RAM
+        # under the server lock (the loop thread owns the engine)
+        preempted = False
+        for _ in range(200):
+            with srv._lock:
+                act = rset.scheduler.active()
+                if act:
+                    preempted = rset.preempt(
+                        act[0][1].request.request_id,
+                        reason="demo_preempt")
+            if preempted:
+                break
+            time.sleep(0.005)
+        for t in threads:
+            t.join()
+        # the shared-prefix pair runs SEQUENTIALLY after the burst (and
+        # after the replica failure): prefix pages register at prompt
+        # COMPLETION on whichever healthy replica served the cold pass,
+        # and the warm pass's affinity probe pins to it — a hit by
+        # construction, independent of which replica the fault killed
+        post(len(jobs), shared, "free")          # cold: registers pages
+        post(len(jobs) + 1, shared, "free")      # warm: hits them
+    rs.clear_faults()
+
+    n_requests = len(jobs) + 2
+    ok = [i for i, (st, _) in sorted(results.items()) if st == 200]
+    assert len(ok) == n_requests, f"non-200 answers: {results}"
+    rids = [results[i][1]["id"] for i in ok]
+    print(f"{len(rids)} requests served across {n_requests} submissions "
+          f"(replica failures: {rset.failures}, evacuated: "
+          f"{rset.requeued}, preempted: {int(preempted)})")
+    assert rset.failures == 1, "the injected replica failure never fired"
+    assert preempted, "the demo preemption never engaged"
+    assert rset.prefix_stats()["hits"] > 0, "no prefix-cache hits"
+
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+
+    # 1. /metrics: valid Prometheus text exposition
+    conn.request("GET", "/metrics")
+    r = conn.getresponse()
+    prom = r.read().decode()
+    assert r.status == 200 and "text/plain" in r.getheader("Content-Type")
+    sample = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? \S+")
+    for line in prom.strip().splitlines():
+        assert line.startswith("# TYPE ") or sample.fullmatch(line), line
+    for needle in ("serve_queue_ms", "serve_prefill_ms",
+                   'serve_replica_free_blocks{replica="0"}',
+                   'serve_tenant_ttft_ms{tenant="pro"'):
+        assert needle in prom, f"/metrics missing {needle}"
+    print(f"/metrics: {len(prom.splitlines())} exposition lines, e.g.")
+    for line in prom.splitlines():
+        if line.startswith("serve_queue_ms") or "replica=" in line:
+            print(f"  {line}")
+
+    # 2. /v1/requests/<rid>: complete ordered timelines
+    detours = 0
+    for i, rid in zip(ok, rids):
+        conn.request("GET", f"/v1/requests/{rid}")
+        r = conn.getresponse()
+        tl = json.loads(r.read())
+        assert r.status == 200, tl
+        assert tl["trace_id"] == f"demo-{i}"
+        phases = [e["phase"] for e in tl["events"]]
+        for ph in ("submit", "first_token", "retire"):
+            assert phases.count(ph) == 1, (rid, phases)
+        ts = [e["t_ms"] for e in tl["events"]]
+        assert ts == sorted(ts), "timeline out of order"
+        s = tl["summary"]
+        # one admit per queue episode: first admission + each re-admit
+        # after a preempt/evacuation
+        assert phases.count("admit") == 1 + s["preempts"], (rid, phases)
+        assert abs(s["queue_ms"] + s["prefill_ms"] + s["decode_ms"]
+                   - s["wall_ms"]) < 1e-9
+        detours += sum(phases.count(p) for p in
+                       ("preempt", "migrate", "reset_fresh"))
+    print(f"/v1/requests: {len(rids)} complete timelines "
+          f"({detours} preempt/migrate detours recorded); e.g. "
+          f"{json.dumps(tl['summary'])}")
+    assert detours > 0, "churn produced no traced detours"
+    conn.close()
+
+    srv.begin_drain()
+    srv.wait_drained(10)
+    srv.close()
+    obs.disable()
+
+    # 3. Perfetto export covers every request
+    out = jsonl + ".trace.json"
+    r = subprocess.run([sys.executable,
+                        os.path.join(os.path.dirname(__file__), os.pardir,
+                                     "tools", "trace_export.py"),
+                        jsonl, "-o", out],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["requests"] >= len(rids)
+    with open(out) as f:
+        trace = json.load(f)
+    tracks = {e["args"]["name"] for e in trace["traceEvents"]
+              if e.get("ph") == "M" and e["name"] == "thread_name"}
+    for rid in rids:
+        assert any(rid in name for name in tracks), f"{rid} not exported"
+    print(f"trace_export: {summary['trace_events']} Chrome events for "
+          f"{summary['requests']} requests -> {out} (load in "
+          "ui.perfetto.dev)")
+
+    # 4. the SLO capture fired on replica 0
+    with open(jsonl) as f:
+        caps = [json.loads(l) for l in f
+                if '"serve_slo_capture"' in l]
+    done = [c for c in caps if c.get("state") == "done"]
+    assert done, "SLO capture never completed"
+    print(f"slo capture: TTFT p95 breach -> jax.profiler trace at "
+          f"{done[0]['trace_dir']}")
+    print("trace_serving demo OK")
+
+
+if __name__ == "__main__":
+    main()

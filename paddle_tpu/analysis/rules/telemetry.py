@@ -35,12 +35,13 @@ RULE = "unguarded-telemetry"
 
 _EXEMPT_PARTS = ("/observability/", "/resilience/")
 _HOOKS = ("MONITOR", "COLLECTIVE", "EMIT", "SPAN", "RECORDER",
-          "POSTMORTEM", "FAULTS")
+          "POSTMORTEM", "FAULTS", "TRACE")
 _GETTERS = {
     "get_registry": "obs.get_registry()",
     "get_telemetry": "obs.get_telemetry()",
     "get_flight_recorder": "obs.get_flight_recorder()",
     "get_watchdog": "obs.get_watchdog()",
+    "get_request_tracer": "obs.get_request_tracer()",
 }
 
 

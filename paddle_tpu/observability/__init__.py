@@ -55,6 +55,8 @@ from .sinks import (InMemorySink, JsonlSink, Sink,  # noqa: F401
                     StdoutSink, _ProcessZeroGate)
 from .spans import _SpanHook, span  # noqa: F401
 from .step_monitor import StepMonitor  # noqa: F401
+from .trace import (RequestTrace, RequestTracer, SLOCapture,  # noqa: F401
+                    current_trace_id, new_trace_id, trace_context)
 from .watchdog import HangWarning, HangWatchdog  # noqa: F401
 
 _ACTIVE: List[Optional["Telemetry"]] = [None]
@@ -74,6 +76,7 @@ class Telemetry:
         self.sentinel = sentinel
         self.recorder = recorder
         self.watchdog = watchdog
+        self.tracer: Optional[RequestTracer] = None
         # RLock, not Lock: the preemption SIGTERM handler emits from the
         # main thread, possibly interrupting an emit already holding the
         # lock — a plain Lock would self-deadlock the dying process
@@ -134,6 +137,12 @@ def get_watchdog() -> Optional[HangWatchdog]:
     return tel.watchdog if tel is not None else None
 
 
+def get_request_tracer() -> Optional[RequestTracer]:
+    """The active request-lifecycle tracer (serving timelines), or None
+    when telemetry is disabled / tracing was opted out."""
+    return _state.TRACE[0]
+
+
 def emit_event(event: str, **fields) -> None:
     """Fire-and-forget structured event; no-op when disabled."""
     emit = _state.EMIT[0]
@@ -191,7 +200,9 @@ def enable(jsonl_path: Optional[str] = None, stdout: bool = False,
            spans: bool = True, crash_hooks: bool = True,
            postmortem_path: Optional[str] = None,
            watchdog_s: Optional[float] = None, on_hang=None,
-           watchdog_abort: bool = False) -> Telemetry:
+           watchdog_abort: bool = False,
+           request_tracing: bool = True,
+           trace_capacity: int = 2048) -> Telemetry:
     """Turn telemetry on (replacing any active session) and return the
     ``Telemetry`` handle.
 
@@ -210,6 +221,13 @@ def enable(jsonl_path: Optional[str] = None, stdout: bool = False,
     ``on_hang`` (callable) and ``watchdog_abort`` pick the escalation
     beyond the warning+dump.  ``spans`` installs the ``span(...)`` hook
     (per-span events + ``span[<name>].ms`` histograms).
+
+    ``request_tracing`` installs a :class:`RequestTracer` — one
+    per-request lifecycle timeline across the serving stack (admission,
+    queue wait, prefill chunks, decode, preempt/restore, migration,
+    retire; docs/OBSERVABILITY.md "Tracing a request"), retaining the
+    last ``trace_capacity`` retired traces for ``GET /v1/requests/<rid>``
+    and emitting one ``serve_trace`` event per retired request.
     """
     # validate BEFORE any side effect: raising after disable()/sink
     # creation/sentinel install would leak a registered jax.monitoring
@@ -269,9 +287,14 @@ def enable(jsonl_path: Optional[str] = None, stdout: bool = False,
             emit=tel.emit, postmortem_path=pm_path, on_hang=on_hang,
             abort=watchdog_abort)
 
+    if request_tracing:
+        tel.tracer = RequestTracer(capacity=trace_capacity, registry=reg,
+                                   emit=tel.emit)
+
     _ACTIVE[0] = tel
     _state.MONITOR[0] = tel.monitor
     _state.EMIT[0] = tel.emit
+    _state.TRACE[0] = tel.tracer
     _state.COLLECTIVE[0] = _record_collective if collectives else None
     _state.RECORDER[0] = rec
     if spans:
@@ -293,6 +316,7 @@ def disable() -> None:
     _state.EMIT[0] = None
     _state.SPAN[0] = None
     _state.RECORDER[0] = None
+    _state.TRACE[0] = None
     _ACTIVE[0] = None
     if tel.watchdog is not None:
         tel.watchdog.stop()

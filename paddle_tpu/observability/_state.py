@@ -43,3 +43,9 @@ SPAN = [None]
 # drains the flight-recorder ring without importing anything inside a
 # signal frame.
 POSTMORTEM = [None]
+
+# trace.RequestTracer instance, or None. Read by every serving
+# request-lifecycle site (FrontDoor.submit, Engine admission/step/
+# preempt/restore/retire, EngineReplicaSet routing/evacuation) — the
+# per-request timeline producer (observability/trace.py).
+TRACE = [None]

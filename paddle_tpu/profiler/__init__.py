@@ -27,7 +27,7 @@ import jax
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result",
-           "active_profilers", "is_recording"]
+           "active_profilers", "is_recording", "windowed_profiler"]
 
 
 class ProfilerState(Enum):
@@ -289,6 +289,25 @@ class Profiler:
         table = "\n".join(lines)
         print(table)
         return table
+
+
+def windowed_profiler(trace_dir: str, steps: Optional[int] = None,
+                      on_trace_ready=None) -> Profiler:
+    """A STARTED :class:`Profiler` recording host scopes + the device
+    trace (``jax.profiler`` start/stop) into ``trace_dir`` — the
+    bounded-capture entry the SLO-triggered capture arms
+    (``observability.trace.SLOCapture``): the caller advances it with
+    ``step()`` and ``stop()``s it after its window.  With ``steps``
+    given, a ``make_scheduler`` window additionally closes the device
+    trace on its own after that many ``step()`` calls (``stop()`` is
+    still required to flush the host events / deregister)."""
+    os.makedirs(trace_dir, exist_ok=True)
+    sched = None
+    if steps is not None:
+        sched = make_scheduler(closed=0, ready=0, record=int(steps),
+                               repeat=1)
+    return Profiler(scheduler=sched, on_trace_ready=on_trace_ready,
+                    trace_dir=trace_dir).start()
 
 
 def load_profiler_result(path: str):

@@ -71,6 +71,7 @@ import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import observability as obs
+from ..observability import _state as _obs_state
 from ..distributed import mp_layers
 from ..distributed.topology import HybridTopology
 from ..resilience import _state as _rs_state
@@ -438,6 +439,11 @@ class EngineReplicaSet:
             kw["_page_keys"] = keys
         rid = self.replicas[idx].add_request(prompt_ids, **kw)
         self._placements[rid] = idx
+        tr = _obs_state.TRACE[0]
+        if tr is not None:
+            # trace exists by now (begun in the engine's add_request, or
+            # at the door): the routing decision joins its timeline
+            tr.point(rid, "route", replica=idx, affinity_hits=hits)
         reg = obs.get_registry()
         if reg is not None:
             reg.counter("serve.routed").inc()
@@ -559,6 +565,7 @@ class EngineReplicaSet:
             f"({type(exc).__name__}: {exc})", RuntimeWarning,
             stacklevel=3)
         rep = self.replicas[idx]
+        tr = _obs_state.TRACE[0]
         for _slot, st in list(rep.scheduler.active()):
             try:
                 rep.preempt(st.request.request_id,
@@ -567,6 +574,12 @@ class EngineReplicaSet:
                 rep.scheduler.release_slot(st)
                 self._reset_to_fresh(st)
                 rep.scheduler.requeue(st, head=True)
+                if tr is not None:
+                    # the degraded path: KV gone, prompt re-prefills on
+                    # the target — the timeline records it was a reset,
+                    # not a byte-exact restore
+                    tr.transition(st.request.request_id, "queue",
+                                  event="reset_fresh", replica=idx)
         moved = 0
         while rep.scheduler.waiting:
             st = rep.scheduler.waiting.popleft()
@@ -582,6 +595,12 @@ class EngineReplicaSet:
             self.replicas[tgt].scheduler.waiting.append(st)
             self._placements[rid] = tgt
             moved += 1
+            if tr is not None:
+                # same trace id before and after: the tracer is keyed by
+                # request id and the id rides Request.trace_id, so the
+                # migrated state keeps feeding the same timeline
+                tr.point(rid, "migrate", from_replica=idx,
+                         to_replica=tgt)
         self.requeued += moved
         reg = obs.get_registry()
         if reg is not None:

@@ -58,7 +58,14 @@ Telemetry (all zero-overhead when observability is disabled):
 + ``serve_request`` / ``serve_step`` / ``serve_finish`` /
 ``serve_preempt`` / ``serve_restore`` / ``serve_isolated_failure``
 events and ``serve.step`` / ``serve.step.finish`` flight-recorder spans
-per step (dispatch and sync/post-processing phases).
+per step (dispatch and sync/post-processing phases).  With request
+tracing on, every lifecycle transition additionally feeds the
+per-request timeline (``observability/trace.py``: submit → admit →
+prefill chunks → first token → preempt/restore → retire, with exact
+queue/prefill/decode phase accounting) plus the ``serve.queue_ms`` /
+``serve.prefill_ms`` / ``serve.decode_ms_per_token`` histograms and
+their ``serve.tenant[<t>].*`` twins — docs/OBSERVABILITY.md "Tracing a
+request".
 """
 
 from __future__ import annotations
@@ -76,6 +83,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import observability as obs
+from ..observability import _state as _obs_state
 from ..observability.spans import span
 from ..nn.layer import _swapped_params, functional_call, serving_params
 from ..resilience import _state as _rs_state
@@ -178,6 +186,11 @@ class Engine:
     ``tools/tuned_configs.json`` (per model geometry and backend,
     resolved at construction — never per step).
 
+    ``slo_capture``: an :class:`observability.SLOCapture` (or anything
+    with ``on_step()``) consulted after each non-empty step — arms a
+    bounded ``jax.profiler`` capture when TTFT p95 breaches its SLO for
+    K consecutive windows (docs/OBSERVABILITY.md "Tracing a request").
+
     ``mesh``: a serving mesh (``serving.distributed.serving_mesh``)
     makes this engine TENSOR-PARALLEL: parameters land sharded by their
     partition specs, the paged KV pools shard their head axis over the
@@ -200,7 +213,8 @@ class Engine:
                  max_queue: Optional[int] = None,
                  retry: Optional[RetryPolicy] = None,
                  mesh=None,
-                 weight_quant: Optional[str] = None):
+                 weight_quant: Optional[str] = None,
+                 slo_capture=None):
         if not _paged_supported(model):
             raise NotImplementedError(
                 f"{type(model).__name__} does not support the paged "
@@ -319,6 +333,10 @@ class Engine:
         # DP aggregate-throughput projection sums (tools/decode_bench).
         self.busy_s = 0.0
         self.tokens_emitted = 0
+        # SLO-triggered on-chip capture (observability.trace.SLOCapture
+        # or anything with on_step()): consulted once per non-empty
+        # step_finish — None (the default) costs one falsy check
+        self._slo_capture = slo_capture
         self._warmed = False
         self._build_fns()
 
@@ -461,6 +479,13 @@ class Engine:
         # O(prompt) blake2b chain (serving/distributed.py)
         st = self.scheduler.submit(req, page_keys=_page_keys)
         self._states[req.request_id] = st
+        tr = _obs_state.TRACE[0]
+        if tr is not None:
+            # get-or-create: a door-submitted request already began its
+            # trace at door submit (queue time there is queue time here)
+            req.trace_id = tr.begin(
+                req.request_id, tenant=req.tenant, trace_id=req.trace_id,
+                prompt_len=p, max_new=req.max_new_tokens)
         reg = obs.get_registry()
         if reg is not None:
             reg.counter("serve.requests").inc()
@@ -525,6 +550,10 @@ class Engine:
         st.swapped = (pages, host)
         st.preempts += 1
         self.scheduler.requeue(st, head=head)
+        tr = _obs_state.TRACE[0]
+        if tr is not None:
+            tr.transition(st.request.request_id, "queue", event="preempt",
+                          reason=reason, pages=pages, kv_len=st.kv_len)
         reg = obs.get_registry()
         if reg is not None:
             reg.counter("serve.preemptions").inc()
@@ -544,6 +573,10 @@ class Engine:
             self._retry.run(self._swap.swap_in, ids, host,
                             site="serve.swap")
         st.swapped = None
+        tr = _obs_state.TRACE[0]
+        if tr is not None:
+            tr.point(st.request.request_id, "restore", pages=pages,
+                     kv_len=st.kv_len)
         reg = obs.get_registry()
         if reg is not None:
             reg.counter("serve.restores").inc()
@@ -570,6 +603,9 @@ class Engine:
         obs.emit_event("serve_isolated_failure", id=rid,
                        tenant=st.request.tenant,
                        exc=type(exc).__name__, message=str(exc)[:200])
+        tr = _obs_state.TRACE[0]
+        if tr is not None:
+            tr.point(rid, "isolated", exc=type(exc).__name__)
         self._preempt_state(st, head=True, reason="isolated_failure")
 
     # requires-lock: _lock — drains scheduler.waiting
@@ -594,6 +630,15 @@ class Engine:
             st = self.scheduler.admit_next()
             if st is None:
                 break
+            tr = _obs_state.TRACE[0]
+            if tr is not None:
+                # queue→slot transition: closes the queue-wait segment
+                # (first admission AND each post-preempt re-admission)
+                tr.transition(
+                    st.request.request_id,
+                    "prefill" if st.prefilling else "decode",
+                    event="admit", slot=st.slot, kv_len=st.kv_len,
+                    cached_tokens=st.cached_tokens)
             if st.swapped is not None:
                 self._restore(st)
 
@@ -690,6 +735,10 @@ class Engine:
         done_len = len(st.output_ids) >= req.max_new_tokens
         if done_eos or done_len:
             self.scheduler.finish(st, "eos" if done_eos else "length")
+            tr = _obs_state.TRACE[0]
+            if tr is not None:
+                tr.retire(req.request_id, reason=st.finish_reason,
+                          tokens=len(st.output_ids))
             if self._drain_capture is not None:
                 # BEFORE the eviction below: when more requests than
                 # keep_finished retire in one step, the state may be
@@ -817,6 +866,11 @@ class Engine:
                            active=len(self.scheduler.active()),
                            queue=self.scheduler.queue_depth(),
                            kv_blocks_used=self.kv.allocator.used_blocks)
+            cap = self._slo_capture
+            if cap is not None:
+                # SLO-triggered capture bookkeeping: host-side counters
+                # only, until a breach arms the bounded profiler window
+                cap.on_step()
         return events
 
     def _finish_events(self, plan, nxt,
@@ -827,6 +881,7 @@ class Engine:
             # token materializes, or it reports queueing overhead
             nxt = np.asarray(nxt)
             fi = _rs_state.FAULTS[0]
+            tr = _obs_state.TRACE[0]
             for i, st, n, is_prefill in plan:
                 # pre-span snapshot: isolation rewinds to here, and
                 # re-running the span after restore is idempotent
@@ -840,6 +895,9 @@ class Engine:
                         fi("serve.prefill" if is_prefill
                            else "serve.step")
                     st.kv_len += n
+                    if is_prefill and tr is not None:
+                        tr.point(st.request.request_id, "prefill_chunk",
+                                 tokens=n, kv_len=st.kv_len)
                     if is_prefill and st.prefilling:
                         continue    # mid-prefill: sample discarded
                     if is_prefill:
@@ -851,6 +909,18 @@ class Engine:
                         # serve_request / re-observe TTFT for the same
                         # request (serving/distributed.py).
                         self._register_prefix(st)
+                        if tr is not None:
+                            # prefill→decode transition (closes the
+                            # prefill segment).  A re-completion after a
+                            # hard replica reset accumulates under its
+                            # own event name, so `first_token` stays
+                            # exactly-once per request — same dedupe
+                            # marker as the serve_request event below.
+                            tr.transition(
+                                st.request.request_id, "decode",
+                                event="first_token"
+                                if st.first_token_t is None
+                                else "re_prefilled")
                         if st.first_token_t is not None:
                             self._emit(st, int(nxt[i]), events)
                             continue
@@ -858,8 +928,14 @@ class Engine:
                         req = st.request
                         reg = obs.get_registry()
                         if reg is not None:
-                            reg.histogram("serve.ttft_ms").observe(
-                                (st.first_token_t - st.submit_t) * 1e3)
+                            ttft = (st.first_token_t - st.submit_t) * 1e3
+                            reg.histogram("serve.ttft_ms").observe(ttft)
+                            if req.tenant:
+                                # the per-tenant aggregate the FrontDoor
+                                # SLO policy reads (frontdoor._ttft_p95)
+                                reg.histogram(
+                                    f"serve.tenant[{req.tenant}]"
+                                    ".ttft_ms").observe(ttft)
                             if st.num_shared:
                                 reg.counter("serve.prefix_hits").inc(
                                     st.num_shared)

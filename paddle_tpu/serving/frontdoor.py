@@ -41,6 +41,7 @@ import time
 from typing import Callable, Dict, List, NamedTuple, Optional, Set
 
 from .. import observability as obs
+from ..observability import _state as _obs_state
 from .errors import (AdmissionError, BudgetUnsatisfiable, QueueFull,
                      RateLimited)
 from .scheduler import Request, RequestState
@@ -252,12 +253,31 @@ class FrontDoor:
     def _total_queued(self) -> int:
         return sum(len(q) for q in self._queues.values())
 
-    def _ttft_p95(self) -> Optional[float]:
+    def _ttft_p95(self, tenant: Optional[str] = None) -> Optional[float]:
+        """Rolling TTFT p95 for the SLO shed decision.  The GLOBAL
+        ``serve.ttft_ms`` signal gates: while it is healthy, nobody is
+        shed on TTFT.  Once it breaches, the SUBMITTING tenant's own
+        aggregate (``serve.tenant[<t>].ttft_ms``, fed by the engine at
+        first token) refines the decision — a below-floor tenant whose
+        own latency is healthy is not shed for another tenant's breach.
+        The global signal must stay the gate: a shed tenant gets no new
+        observations of its own, so deciding on the per-tenant window
+        alone would freeze a transient spike into a permanent lockout;
+        the global window keeps refreshing off admitted traffic and
+        un-sheds everyone when the system recovers."""
         reg = obs.get_registry()
         if reg is None:
             return None
         h = reg.get("serve.ttft_ms")
-        return h.percentile(95) if h is not None else None
+        g = h.percentile(95) if h is not None else None
+        if tenant is None or g is None \
+                or self.slo_ttft_p95_ms is None \
+                or g <= self.slo_ttft_p95_ms:
+            return g
+        th = reg.get(f"serve.tenant[{tenant}].ttft_ms")
+        if th is not None and th.count:
+            return th.percentile(95)
+        return g
 
     def _occupancy(self) -> float:
         alloc = self.engine.kv.allocator
@@ -351,8 +371,8 @@ class FrontDoor:
                 tenant, "queue_full", self._retry_after(), raise_on_shed,
                 f"queue at max_queue_depth={self.max_queue_depth}")
         if pol.priority < self.slo_priority_floor:
-            ttft = self._ttft_p95() if self.slo_ttft_p95_ms is not None \
-                else None
+            ttft = self._ttft_p95(tenant) \
+                if self.slo_ttft_p95_ms is not None else None
             if ttft is not None and ttft > self.slo_ttft_p95_ms:
                 return self._shed(
                     tenant, "slo_shed", self._retry_after(),
@@ -390,6 +410,16 @@ class FrontDoor:
             tenant, collections.deque()).append(
                 _Pending(req, tenant, cost, time.perf_counter()))
         self._outstanding.setdefault(tenant, set()).add(req.request_id)
+        tr = _obs_state.TRACE[0]
+        if tr is not None:
+            # the trace clock starts HERE: time queued in the door is
+            # queue-wait the timeline must attribute (same rule as the
+            # submit_t handoff in pump()).  The id comes from the
+            # current_trace_id contextvar when a caller (the HTTP
+            # server's X-Trace-Id) set one.
+            req.trace_id = tr.begin(req.request_id, tenant=tenant,
+                                    prompt_len=p,
+                                    max_new=req.max_new_tokens)
         reg = obs.get_registry()
         if reg is not None:
             reg.counter(f"serve.tenant[{tenant}].requests").inc()
@@ -494,6 +524,16 @@ class FrontDoor:
                 # instead of wedging the tenant queue behind it
                 self._outstanding.get(pnd.tenant, set()).discard(
                     req.request_id)
+                tr = _obs_state.TRACE[0]
+                if tr is not None:
+                    # the trace begun at door submit must not stay live
+                    # forever — tracer retention only reaps DONE traces.
+                    # (An id collision shares the rid's trace by
+                    # construction; if the colliding request is still
+                    # live its trace closes early here — ids are the
+                    # caller's uniqueness contract, and bounding the
+                    # tracer beats preserving an ambiguous timeline.)
+                    tr.retire(req.request_id, reason="shed")
                 self._shed(pnd.tenant, "budget", None, False, str(e))
                 continue
             # TTFT starts at DOOR submission: time queued here is load

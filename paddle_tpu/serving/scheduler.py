@@ -60,6 +60,10 @@ class Request:
     on_token: Optional[Callable] = None   # cb(request_id, token_id, text)
     request_id: Optional[str] = None
     tenant: Optional[str] = None    # front-door attribution (telemetry)
+    # request-lifecycle trace id (observability/trace.py): filled by the
+    # tracer at submit when tracing is on; riding the Request keeps the
+    # id with the state through preempt/restore and replica migration
+    trace_id: Optional[str] = None
 
     def __post_init__(self):
         self.prompt_ids = np.asarray(self.prompt_ids, np.int32).reshape(-1)

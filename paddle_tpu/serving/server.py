@@ -23,6 +23,16 @@ chunks ending in ``data: [DONE]``.  Sheds map to HTTP: 429 for
 ``queue_full``/``slo_shed``/draining, 400 for ``budget`` and malformed
 bodies.  ``GET /healthz`` reports serving/draining and live depths.
 
+Operational surface (docs/OBSERVABILITY.md "Tracing a request"):
+``GET /metrics`` serves the live registry as Prometheus text exposition
+(``observability.sinks.registry_to_prometheus``; engine-local gauges
+when telemetry is off, so the endpoint is always scrape-able), and
+``GET /v1/requests/<rid>`` returns that request's lifecycle timeline
+from the request tracer (404 unknown, 503 when tracing is off).  An
+``X-Trace-Id`` request header on ``POST /v1/completions`` propagates
+the caller's trace id into the request's timeline
+(``observability.trace_context``).
+
 Threading model: handler threads only ever *submit* (under the server
 lock) and then read their request's event queue; ONE loop thread drives
 ``FrontDoor.step()`` and routes events — the engine itself is never
@@ -31,6 +41,7 @@ entered concurrently.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import queue
 import threading
@@ -39,6 +50,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
 from .. import observability as obs
+from ..observability.sinks import registry_to_prometheus
+from ..observability.trace import trace_context
 from ..launch.preempt import PreemptionGuard
 from .engine import Engine
 from .frontdoor import FrontDoor
@@ -76,19 +89,65 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self):  # noqa: N802
-        if self.path != "/healthz":
+        if self.path == "/healthz":
+            srv = self.srv
+            with srv._lock:
+                eng = srv.door.engine
+                payload = {
+                    "status": "draining" if srv.draining else "serving",
+                    "queue_depth": srv.door.queue_depth(),
+                    "active_requests": len(eng.scheduler.active()),
+                    "kv_blocks_used": eng.kv_blocks_used,
+                }
+            self._json(200, payload)
+        elif self.path == "/metrics":
+            self._metrics()
+        elif self.path.startswith("/v1/requests/"):
+            from urllib.parse import unquote
+            # strip any query string: /v1/requests/req-7?pretty=1 must
+            # look up "req-7", not "req-7?pretty=1"
+            rid = self.path[len("/v1/requests/"):].split("?", 1)[0]
+            self._request_timeline(unquote(rid))
+        else:
             self._json(404, {"error": {"type": "not_found"}})
-            return
+
+    def _metrics(self):
+        """Prometheus text exposition of the live registry; with
+        telemetry disabled, the engine-local gauges still render so the
+        endpoint is always scrape-able (never a 500 or an empty 200)."""
         srv = self.srv
         with srv._lock:
             eng = srv.door.engine
-            payload = {
-                "status": "draining" if srv.draining else "serving",
-                "queue_depth": srv.door.queue_depth(),
-                "active_requests": len(eng.scheduler.active()),
-                "kv_blocks_used": eng.kv_blocks_used,
+            live = {
+                "serve.queue_depth": srv.door.queue_depth(),
+                "serve.active_requests": len(eng.scheduler.active()),
+                "serve.kv_blocks_used": eng.kv_blocks_used,
+                "serve.draining": 1 if srv.draining else 0,
             }
-        self._json(200, payload)
+        reg = obs.get_registry()
+        body = registry_to_prometheus(reg, extra=live).encode()
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _request_timeline(self, rid: str):
+        """One request's lifecycle timeline (docs/OBSERVABILITY.md):
+        the request tracer's ordered events + exact phase summary."""
+        tr = obs.get_request_tracer()
+        if tr is None:
+            self._json(503, {"error": {
+                "type": "tracing_disabled",
+                "message": "enable observability with request_tracing "
+                           "to serve request timelines"}})
+            return
+        tl = tr.timeline(rid)
+        if tl is None:
+            self._json(404, {"error": {"type": "not_found", "id": rid}})
+            return
+        self._json(200, tl)
 
     def do_POST(self):  # noqa: N802
         if self.path != "/v1/completions":
@@ -132,7 +191,13 @@ class _Handler(BaseHTTPRequestHandler):
             return
 
         q: "queue.Queue" = queue.Queue()
-        with srv._lock:
+        # a caller-supplied trace id joins the request's lifecycle
+        # timeline (GET /v1/requests/<rid>); contextvars keep concurrent
+        # handler threads' ids from bleeding into each other
+        trace_id = self.headers.get("X-Trace-Id")
+        ctx = trace_context(trace_id) if trace_id \
+            else contextlib.nullcontext()
+        with srv._lock, ctx:
             adm = srv.door.submit(prompt, tenant=tenant,
                                   max_new_tokens=max_tokens,
                                   temperature=temperature)

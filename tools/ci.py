@@ -22,9 +22,12 @@ in-repo gates over artifacts committed alongside the code:
 
   telemetry-overhead  the disabled-observability train-step path stays
                   zero-overhead (one falsy check — see
-                  paddle_tpu/observability/_state.py): registry/sink
-                  calls are poisoned and the dispatch cost is bounded
-                  (the fault-injection hook rides the same contract)
+                  paddle_tpu/observability/_state.py): registry/sink/
+                  request-tracer calls are poisoned and the dispatch
+                  cost is bounded (the fault-injection hook rides the
+                  same contract); the /metrics + /v1/requests HTTP
+                  surface renders on a no-jax stub engine within a
+                  time budget
 
   chaos           the resilience subsystem actually recovers: a tiny
                   deterministic train run, supervised by
@@ -245,9 +248,14 @@ def gate_telemetry_overhead(iters: int = 100_000,
             "disabled-telemetry path touched the metrics registry / sinks")
 
     saved = {}
+    # the request tracer rides the same contract: with tracing off every
+    # serving site is ONE falsy check on _state.TRACE[0], so a poisoned
+    # tracer method must never fire during the disabled-path probes
     poisoned = [(obs.MetricsRegistry, n) for n in
                 ("counter", "gauge", "histogram")] + \
-               [(obs.Telemetry, "emit")]
+               [(obs.Telemetry, "emit")] + \
+               [(obs.RequestTracer, n) for n in
+                ("begin", "point", "transition", "retire")]
     for cls, name in poisoned:
         saved[(cls, name)] = getattr(cls, name)
         setattr(cls, name, boom)
@@ -326,11 +334,15 @@ def gate_telemetry_overhead(iters: int = 100_000,
         def blocks_for(self, n):
             return 1
 
+        def active(self):
+            return []
+
     class _Eng:
         """The attribute surface FrontDoor reads — no jax, no model."""
         max_batch = 4
         max_seq_len = 128
         kv = _KV()
+        kv_blocks_used = 0
 
         def __init__(self):
             self.scheduler = _Sched()
@@ -338,6 +350,9 @@ def gate_telemetry_overhead(iters: int = 100_000,
 
         def add_request(self, *a, **kw):
             return kw.get("request_id")
+
+        def has_work(self):
+            return False
 
     door = FrontDoor(_Eng(), policies={
         "t": TenantPolicy(rate_tokens_per_s=1.0, burst_tokens=8.0)})
@@ -373,6 +388,69 @@ def gate_telemetry_overhead(iters: int = 100_000,
               "times per second under overload")
         return 1
 
+    # 3c. the live operational surface renders on the SAME no-jax stub
+    # engine, telemetry off, registry/tracer methods still poisoned:
+    # GET /metrics must fall back to valid prom text from engine-local
+    # gauges (never 500, never empty) and GET /v1/requests must answer
+    # its typed tracing-disabled 503 — each within a small time budget
+    # (an operator's scrape loop must not perturb the engine loop).
+    import http.client
+
+    from paddle_tpu.serving.server import ServingServer
+
+    for cls, name in poisoned:
+        setattr(cls, name, boom)
+    srv = ServingServer(door)
+    try:
+        host, port = srv.start()
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        conn.request("GET", "/metrics")   # first call pays thread spin-up
+        conn.getresponse().read()
+        t0 = time.perf_counter()
+        conn.request("GET", "/metrics")
+        r = conn.getresponse()
+        body = r.read().decode()
+        metrics_ms = (time.perf_counter() - t0) * 1e3
+        t0 = time.perf_counter()
+        conn.request("GET", "/v1/requests/no-such-request")
+        r2 = conn.getresponse()
+        body2 = r2.read().decode()
+        req_ms = (time.perf_counter() - t0) * 1e3
+        conn.close()
+    except (OSError, http.client.HTTPException):
+        # a poisoned registry/tracer method fires in the HANDLER thread:
+        # http.server swallows the AssertionError and drops the
+        # connection, which the client sees as RemoteDisconnected (an
+        # HTTPException) or ConnectionReset (an OSError) — that IS the
+        # poison-probe failure signal
+        print("telemetry-overhead gate FAILED: the disabled-telemetry "
+              "/metrics //v1/requests surface dropped the connection — "
+              "a handler touched the poisoned registry / tracer "
+              "(serving/server.py must ride the guarded getters)")
+        return 1
+    finally:
+        for (cls, name), fn in saved.items():
+            setattr(cls, name, fn)
+        srv.close()
+    if r.status != 200 or "text/plain" not in (r.getheader(
+            "Content-Type") or "") or "serve_queue_depth 0" not in body:
+        print(f"telemetry-overhead gate FAILED: GET /metrics on the "
+              f"stub engine answered {r.status} with body "
+              f"{body[:200]!r} — expected prom text exposition with "
+              "the engine-local fallback gauges")
+        return 1
+    if r2.status != 503 or "tracing_disabled" not in body2:
+        print(f"telemetry-overhead gate FAILED: GET /v1/requests with "
+              f"tracing off answered {r2.status} {body2[:200]!r} — "
+              "expected the typed tracing_disabled 503")
+        return 1
+    print(f"telemetry-overhead: stub-engine /metrics {metrics_ms:.1f} ms"
+          f" / /v1/requests {req_ms:.1f} ms (budget 250 ms each)")
+    if metrics_ms > 250.0 or req_ms > 250.0:
+        print("telemetry-overhead gate FAILED: the operational HTTP "
+              "surface blew its render budget on an IDLE stub engine")
+        return 1
+
     # 4. an enable/disable cycle (recorder + watchdog + spans on) leaves
     # the disabled path exactly as it was: all hooks None, poison-clean.
     # The fault-injection hook rides the same contract: an
@@ -391,6 +469,7 @@ def gate_telemetry_overhead(iters: int = 100_000,
              "SPAN": obs_state.SPAN[0],
              "RECORDER": obs_state.RECORDER[0],
              "POSTMORTEM": obs_state.POSTMORTEM[0],
+             "TRACE": obs_state.TRACE[0],
              "FAULTS": rs_state.FAULTS[0]}
     stale = [k for k, v in hooks.items() if v is not None]
     if stale:
@@ -928,6 +1007,41 @@ def gate_chaos_serving(max_batch: int = 4) -> int:
                         f"{tag}: the duplicate prompt never exercised "
                         "copy-on-write — the scenario lost its cow "
                         "coverage")
+                # request-lifecycle tracing rode the whole chaos run
+                # (zero compiles above PROVES trace reads stay host-
+                # side): every request must carry a complete timeline
+                # with the lifecycle phases exactly once, and the
+                # preempted request a preempt/restore pair
+                tracer = obs.get_request_tracer()
+                if tracer is None:
+                    failures.append(
+                        f"{tag}: request tracing was not active — the "
+                        "gate must run with tracing enabled")
+                else:
+                    saw_preempt = False
+                    for r in rids:
+                        tl = tracer.timeline(r)
+                        if tl is None or not tl["summary"]["done"]:
+                            failures.append(
+                                f"{tag}: request {r} has no complete "
+                                "trace at drain")
+                            continue
+                        phases = [e["phase"] for e in tl["events"]]
+                        once = [ph for ph in ("submit", "first_token",
+                                              "retire")
+                                if phases.count(ph) != 1]
+                        if once or "admit" not in phases:
+                            failures.append(
+                                f"{tag}: request {r} lifecycle phases "
+                                f"malformed ({once or 'no admit'}; "
+                                f"{phases})")
+                        if "preempt" in phases:
+                            saw_preempt = "restore" in phases \
+                                or "reset_fresh" in phases or saw_preempt
+                    if not saw_preempt:
+                        failures.append(
+                            f"{tag}: no trace carries the preempt→"
+                            "restore pair the scenario forces")
                 return [eng.output_ids(r) for r in rids], inj
             finally:
                 rs.clear_faults()
@@ -1058,7 +1172,7 @@ def gate_serving_dist(max_batch: int = 4) -> int:
             pt.seed(0)
             return llama("tiny")
 
-        def churn(target, submit, step, drain):
+        def churn(target, submit, step, drain, rid_sink=None):
             """The one workload every phase runs: staggered admission,
             then the duplicated shared prompt twice (hits + CoW)."""
             rids = []
@@ -1071,6 +1185,8 @@ def gate_serving_dist(max_batch: int = 4) -> int:
                 outs = drain()
                 rids.append(submit(shared, 4))
                 outs.update(drain())
+            if rid_sink is not None:
+                rid_sink.extend(rids)
             return [outs[r] for r in rids]
 
         def engine_churn(eng):
@@ -1141,7 +1257,9 @@ def gate_serving_dist(max_batch: int = 4) -> int:
                     max_new_tokens=m)
                 return a.request_id
 
-            got = churn(door, submit, door.step, door.run)
+            dp_rids = []
+            got = churn(door, submit, door.step, door.run,
+                        rid_sink=dp_rids)
         finally:
             rs.clear_faults()
         churn_compiles = tel.sentinel.compiles() - c0
@@ -1182,6 +1300,40 @@ def gate_serving_dist(max_batch: int = 4) -> int:
         if hits == 0:
             failures.append("DP: no prefix-cache hits — affinity "
                             "routing never engaged the duplicate prompt")
+        # trace continuity across the injected replica failure (the
+        # zero-compiles check above already proved tracing stayed
+        # host-side): every DP request keeps ONE complete timeline with
+        # a route decision, and the evacuation shows up as migrate (or
+        # degraded reset_fresh) events on the survivors' traces
+        tracer = obs.get_request_tracer()
+        if tracer is None:
+            failures.append("DP: request tracing was not active")
+        else:
+            migrated = 0
+            for r in dp_rids:
+                tl = tracer.timeline(r)
+                if tl is None or not tl["summary"]["done"] \
+                        or not tl["trace_id"]:
+                    failures.append(
+                        f"DP: request {r} lost its trace across the "
+                        "replica failure")
+                    continue
+                phases = [e["phase"] for e in tl["events"]]
+                if phases.count("retire") != 1 \
+                        or phases.count("submit") != 1:
+                    failures.append(
+                        f"DP: request {r} lifecycle phases malformed "
+                        f"({phases})")
+                if "route" not in phases:
+                    failures.append(
+                        f"DP: request {r} trace carries no routing "
+                        "decision")
+                migrated += sum(1 for ph in phases
+                                if ph in ("migrate", "reset_fresh"))
+            if rset.requeued and migrated == 0:
+                failures.append(
+                    "DP: replicas evacuated requests but no trace "
+                    "carries a migrate event")
         if not any(f.startswith("DP") for f in failures):
             print(f"serving-dist: DP 2x(TP=2) replicas survived an "
                   f"injected replica fault ({rset.requeued} request(s) "

@@ -191,11 +191,23 @@ def bench_serve_prefix(preset="llama-350m", max_batch=8, n_requests=None,
         p = lambda q: ttfts[min(len(ttfts) - 1,
                                 int(q / 100 * len(ttfts)))]  # noqa: E731
         st = eng.prefix_stats()
+        # sampled request-lifecycle attribution (one request per pass):
+        # the BENCH round carries WHERE the cold vs prefix-warm request
+        # spent its time (queue/prefill/decode), not just aggregates —
+        # bench.py forwards it to the bench_telemetry.jsonl sidecar
+        from paddle_tpu import observability as obs
+        tracer = obs.get_request_tracer()
+        trace = None
+        if tracer is not None:
+            tl = tracer.timeline(rids[0])
+            if tl is not None:
+                trace = {"id": rids[0], **tl["summary"]}
         return {f"{tag}_ttft_p50_ms": round(p(50), 2),
                 f"{tag}_ttft_p95_ms": round(p(95), 2),
                 f"{tag}_agg_tokens_per_sec": round(
                     sum(len(outs[r]) for r in rids) / dt, 1),
-                f"{tag}_prefix_hits": st["hits"] - hits0}
+                f"{tag}_prefix_hits": st["hits"] - hits0,
+                f"{tag}_trace": trace}
 
     out = {"metric": "serve_shared_prefix_ttft", "preset": preset,
            "kv": str(kv_cache_dtype or "bf16"), "max_batch": max_batch,

@@ -27,10 +27,45 @@ Usage:  python tools/telemetry_report.py run_telemetry.jsonl [more.jsonl]
 from __future__ import annotations
 
 import argparse
+import importlib.util
 import json
 import math
+import os
 import sys
 from collections import defaultdict
+
+_SINKS = None
+
+
+def _sinks():
+    """Load observability/sinks.py STANDALONE (no package import, no
+    jax): the report shares its prom name grammar — ``prom_split`` —
+    with the live ``/metrics`` exporter, so bracketed registry names
+    (``serve.tenant[acme].ttft_ms``) parse identically in both and the
+    two surfaces cannot drift."""
+    global _SINKS
+    if _SINKS is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            os.pardir, "paddle_tpu", "observability",
+                            "sinks.py")
+        spec = importlib.util.spec_from_file_location(
+            "_pdtpu_obs_sinks", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _SINKS = mod
+    return _SINKS
+
+
+def _tenant_metric(key):
+    """``serve.tenant[acme].ttft_ms`` -> ("acme", "ttft_ms"), else
+    None — parsed with the exporter's own grammar."""
+    base, labels = _sinks().prom_split(key)
+    if not base.startswith("serve_tenant_") or not labels:
+        return None
+    k, v = labels[0]
+    if k != "tenant":
+        return None
+    return v, base[len("serve_tenant_"):]
 
 
 def _pct(sorted_vals, p):
@@ -103,6 +138,10 @@ def summarize(events):
         # failures/requeues from serve_replica_fail
         "replicas": defaultdict(lambda: {"routed": 0, "affinity": 0,
                                          "failures": 0, "requeued": 0}),
+        # request-lifecycle traces (docs/OBSERVABILITY.md "Tracing a
+        # request"): one serve_trace event per retired request carries
+        # the exact per-phase breakdown queue/prefill/decode
+        "traces": [], "slo_captures": [],
     }
     for e in events:
         kind = e.get("event")
@@ -163,6 +202,17 @@ def summarize(events):
             rp = agg["replicas"][e.get("replica", "?")]
             rp["failures"] += 1
             rp["requeued"] += e.get("moved") or 0
+        elif kind == "serve_trace":
+            s = e.get("summary") or {}
+            agg["traces"].append({"tenant": e.get("tenant"),
+                                  "queue_ms": s.get("queue_ms"),
+                                  "prefill_ms": s.get("prefill_ms"),
+                                  "decode_ms": s.get("decode_ms"),
+                                  "wall_ms": s.get("wall_ms"),
+                                  "decode_tokens": s.get("decode_tokens"),
+                                  "preempts": s.get("preempts") or 0})
+        elif kind == "serve_slo_capture":
+            agg["slo_captures"].append(e)
         elif kind == "serve_step":
             sv = agg["serving"]
             sv["steps"] += 1
@@ -196,6 +246,50 @@ def summarize(events):
         elif kind == "run_meta":
             agg["run_meta"] = e
     return agg
+
+
+def _phase_stats(traces):
+    """Per-phase p50/p95 over the folded serve_trace summaries."""
+    out = {}
+    for phase in ("queue_ms", "prefill_ms", "decode_ms", "wall_ms"):
+        vals = sorted(t[phase] for t in traces
+                      if t.get(phase) is not None)
+        out[phase] = {"n": len(vals), "p50": _pct(vals, 50),
+                      "p95": _pct(vals, 95)}
+    per_tok = sorted(t["decode_ms"] / t["decode_tokens"]
+                     for t in traces
+                     if t.get("decode_ms") is not None
+                     and t.get("decode_tokens"))
+    out["decode_ms_per_token"] = {"n": len(per_tok),
+                                  "p50": _pct(per_tok, 50),
+                                  "p95": _pct(per_tok, 95)}
+    return out
+
+
+def _tenant_stats(agg):
+    """Per-tenant fold: trace phase breakdowns grouped by tenant merged
+    with the per-tenant registry aggregates (serve.tenant[<t>].ttft_ms),
+    parsed with the exporter's prom grammar."""
+    tenants = defaultdict(lambda: {"traces": [], "ttft_p50": None,
+                                   "ttft_p95": None})
+    for t in agg["traces"]:
+        tenants[t.get("tenant") or "—"]["traces"].append(t)
+    for key, snap in (agg["metrics"] or {}).items():
+        tm = _tenant_metric(key)
+        if tm is None or not isinstance(snap, dict):
+            continue
+        tenant, metric = tm
+        if metric == "ttft_ms":
+            tenants[tenant]["ttft_p50"] = snap.get("p50")
+            tenants[tenant]["ttft_p95"] = snap.get("p95")
+    out = {}
+    for tenant, d in tenants.items():
+        ph = _phase_stats(d["traces"]) if d["traces"] else None
+        out[tenant] = {"traces": len(d["traces"]),
+                       "ttft_p50": d["ttft_p50"],
+                       "ttft_p95": d["ttft_p95"],
+                       "phases": ph}
+    return out
 
 
 def _fused_mode(agg):
@@ -347,6 +441,45 @@ def render(agg, malformed=0):
                             sorted(sv["tenants"].items()))
             lines.append(f"| requests by tenant | {ten} |")
         lines.append("")
+    if agg["traces"]:
+        # request-lifecycle attribution (docs/OBSERVABILITY.md "Tracing
+        # a request"): where requests spent their time, per phase
+        ph = _phase_stats(agg["traces"])
+
+        def fmt(v, nd=2):
+            return f"{v:.{nd}f}" if v is not None else "—"
+        lines += [f"| Request phase ({len(agg['traces'])} traces) "
+                  "| p50 ms | p95 ms |", "|---|---|---|"]
+        for phase in ("queue_ms", "prefill_ms", "decode_ms",
+                      "decode_ms_per_token", "wall_ms"):
+            s = ph[phase]
+            lines.append(f"| {phase.replace('_ms', '').replace('_', ' ')} "
+                         f"| {fmt(s['p50'])} | {fmt(s['p95'])} |")
+        preempted = sum(1 for t in agg["traces"] if t["preempts"])
+        if preempted:
+            lines.append(f"| traces with preemptions | {preempted} | |")
+        lines.append("")
+        tstats = _tenant_stats(agg)
+        if len(tstats) > 1 or (tstats and "—" not in tstats):
+            lines += ["| Tenant | Traces | queue p50/p95 "
+                      "| ttft p50/p95 | decode ms/tok p50/p95 |",
+                      "|---|---|---|---|---|"]
+            for tenant, d in sorted(tstats.items()):
+                p = d["phases"] or {}
+                q = p.get("queue_ms") or {}
+                dk = p.get("decode_ms_per_token") or {}
+                lines.append(
+                    f"| {tenant} | {d['traces']} "
+                    f"| {fmt(q.get('p50'))} / {fmt(q.get('p95'))} "
+                    f"| {fmt(d['ttft_p50'])} / {fmt(d['ttft_p95'])} "
+                    f"| {fmt(dk.get('p50'))} / {fmt(dk.get('p95'))} |")
+            lines.append("")
+    for cap in agg["slo_captures"]:
+        if cap.get("state") == "done":
+            lines.append(f"**SLO CAPTURE**: TTFT p95 "
+                         f"{cap.get('ttft_p95_ms')}ms breached — "
+                         f"profiler trace at `{cap.get('trace_dir')}` "
+                         f"({cap.get('capture_steps')} steps)")
     if agg["replicas"]:
         # DP replica routing: where requests landed and what failed;
         # the live per-replica gauges (serve.replica[i].free_blocks /
@@ -405,7 +538,8 @@ def render(agg, malformed=0):
             or preemptions or agg["hangs"] or agg["postmortems"]
             or agg["retries"] or agg["faults"] or agg["resumes"]
             or agg["restarts"] or sv["requests"] or sv["steps"]
-            or sv["sheds"] or sv["preempts"] or agg["replicas"]):
+            or sv["sheds"] or sv["preempts"] or agg["replicas"]
+            or agg["traces"] or agg["slo_captures"]):
         lines.append("(no telemetry events found)")
     return "\n".join(lines)
 
@@ -489,6 +623,13 @@ def main(argv=None) -> int:
         summary["replicas"] = {
             str(rep): dict(rp)
             for rep, rp in sorted(agg["replicas"].items(), key=str)}
+    if agg["traces"]:
+        summary["trace_phases"] = _phase_stats(agg["traces"])
+        summary["trace_tenants"] = _tenant_stats(agg)
+    if agg["slo_captures"]:
+        summary["slo_captures"] = [
+            c.get("trace_dir") for c in agg["slo_captures"]
+            if c.get("state") == "done"]
     if agg["bench_result"] is not None:
         summary["bench_value"] = agg["bench_result"].get("value")
     fused = _fused_mode(agg)

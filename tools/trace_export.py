@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Fold ``serve_trace`` events from a telemetry JSONL stream into
+Chrome trace-event JSON (Perfetto / chrome://tracing loadable).
+
+Every retired serving request emits one ``serve_trace`` event carrying
+its full lifecycle timeline (observability/trace.py): this tool turns
+each request into one track — phase segments (queue / prefill / decode)
+as duration slices, lifecycle markers (prefill chunks, preempt, restore,
+route, migrate, isolated) as instant events — grouped by the replica
+the request was routed to (pid), one thread (tid) per request.
+
+Pure stdlib, no framework import: runs anywhere the JSONL landed (same
+contract as tools/telemetry_report.py, whose line parser it reuses).
+
+Usage:
+    python tools/trace_export.py run_telemetry.jsonl -o run_trace.json
+    python tools/trace_export.py a.jsonl b.jsonl          # -> a.trace.json
+
+Prints ONE JSON summary line on stdout (the repo's artifact convention).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from telemetry_report import load_events  # noqa: E402
+
+# lifecycle markers worth an instant event on the track (segment-closing
+# transitions already render as slices; prefill_chunk kept — per-chunk
+# attribution is the whole point of chunk tracing)
+_INSTANTS = {"submit", "prefill_chunk", "preempt", "restore", "route",
+             "migrate", "isolated", "reset_fresh", "admit",
+             "first_token", "retire"}
+
+
+def _track_events(trace: dict, tid: int):
+    """Chrome events for ONE serve_trace payload.  The pid FOLLOWS the
+    request across replicas — `route` sets it, `migrate` moves it — so
+    an evacuated request's post-migration slices render under the
+    replica that actually did the work, not the dead one."""
+    out = []
+    events = trace.get("events") or []
+    rid = trace.get("id") or trace.get("request_id") or f"req?{tid}"
+    label = rid
+    if trace.get("trace_id"):
+        label = f"{rid} [{trace['trace_id']}]"
+    if trace.get("tenant"):
+        label += f" ({trace['tenant']})"
+    base_us = float(trace.get("t0") or trace.get("ts") or 0.0) * 1e6
+    pid = 0
+    pids = set()
+    for ev in events:
+        name = ev.get("phase") or "?"
+        if name == "route" and ev.get("replica") is not None:
+            pid = int(ev["replica"])
+        elif name == "migrate" and ev.get("to_replica") is not None:
+            pid = int(ev["to_replica"])
+        t_us = base_us + float(ev.get("t_ms") or 0.0) * 1e3
+        args = {k: v for k, v in ev.items()
+                if k not in ("phase", "t_ms", "closed", "ms")}
+        closed, ms = ev.get("closed"), ev.get("ms")
+        if closed and ms is not None:
+            # the segment this transition closed: a duration slice
+            # ending exactly at the transition's timestamp
+            out.append({"ph": "X", "name": str(closed), "pid": pid,
+                        "tid": tid, "ts": t_us - float(ms) * 1e3,
+                        "dur": float(ms) * 1e3,
+                        "args": {"ended_by": name, **args}})
+            pids.add(pid)
+        if name in _INSTANTS:
+            out.append({"ph": "i", "name": name, "pid": pid, "tid": tid,
+                        "ts": t_us, "s": "t", "args": args})
+            pids.add(pid)
+    if not pids:
+        pids.add(pid)
+    for p in sorted(pids):
+        out.append({"ph": "M", "name": "thread_name", "pid": p,
+                    "tid": tid, "args": {"name": label}})
+    return pids, out
+
+
+def chrome_trace(events):
+    """All serve_trace events -> the Chrome trace-event JSON object."""
+    out = []
+    pids = set()
+    requests = 0
+    for e in events:
+        if e.get("event") != "serve_trace":
+            continue
+        requests += 1
+        track_pids, evs = _track_events(e, requests)
+        pids |= track_pids
+        out.extend(evs)
+    for pid in sorted(pids):
+        out.append({"ph": "M", "name": "process_name", "pid": pid,
+                    "tid": 0, "args": {"name": f"serving replica {pid}"}})
+    return {"traceEvents": out, "displayTimeUnit": "ms"}, requests
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="+", help="telemetry JSONL file(s)")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output path (default: <first input>.trace.json)")
+    args = ap.parse_args(argv)
+
+    events, malformed = load_events(args.paths)
+    trace, requests = chrome_trace(events)
+    out_path = args.out or (os.path.splitext(args.paths[0])[0]
+                            + ".trace.json")
+    with open(out_path, "w") as f:
+        json.dump(trace, f)
+    print(json.dumps({"metric": "trace_export", "requests": requests,
+                      "trace_events": len(trace["traceEvents"]),
+                      "malformed_lines": malformed, "out": out_path}))
+    return 0 if requests else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
