@@ -314,6 +314,33 @@ def main():
         except Exception as e:  # noqa: BLE001
             extra["serve_burst_error"] = f"{type(e).__name__}: {e}"[:300]
 
+        # speculative decoding (docs/SERVING.md "Speculative
+        # decoding"): n-gram self-drafting through the one compiled
+        # verify step on a repetitive (code/templated) workload —
+        # acceptance rate and tok/s vs the spec-off engine.  Same
+        # CPU-plumbing / TPU-numbers split and non-fatality as above.
+        try:
+            from decode_bench import bench_serve_spec
+            with contextlib.redirect_stdout(sys.stderr):
+                if on_tpu:
+                    r = bench_serve_spec(max_batch=8,
+                                         kv_cache_dtype="int8")
+                else:
+                    r = bench_serve_spec(preset="tiny", max_batch=4,
+                                         n_requests=6, max_new=24,
+                                         motif_len=6, motif_reps=3,
+                                         draft_depth=4, page_size=8)
+            pre = "serve_spec" if on_tpu else "serve_spec_cpu"
+            extra[f"{pre}_tok_s"] = r["agg_tokens_per_sec"]
+            extra[f"{pre}_accept_rate"] = r["accept_rate"]
+            extra[f"{pre}_detail"] = {
+                k: r[k] for k in ("draft_depth", "proposed", "accepted",
+                                  "tokens_per_verify_step", "steps",
+                                  "base_steps", "base_tokens_per_sec",
+                                  "vs_spec_off", "gen_tokens", "wall_s")}
+        except Exception as e:  # noqa: BLE001
+            extra["serve_spec_error"] = f"{type(e).__name__}: {e}"[:300]
+
         # sharded serving (docs/SERVING.md "Sharded serving"): the
         # TP-partitioned engine and the DP replica router need >= 2
         # devices (a multi-chip slice, or the forced virtual CPU mesh

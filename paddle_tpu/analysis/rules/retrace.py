@@ -22,6 +22,17 @@ that:
   at a ``static_argnums`` position — unhashable, so every call dies (or
   the caller "fixes" it with a tuple whose contents still churn the
   cache).
+- **R4f per-step draft-length scalar**: speculative decoding's draft
+  length reaching a known-jitted callable as a fresh host ``int`` —
+  ``len(draft)`` / a ``draft*``-named local bound to ``len(...)`` or
+  ``int(...)`` — at a traced position.  The serving contract
+  (docs/SERVING.md "Speculative decoding") is that per-slot draft
+  length is DATA inside the fixed-shape span arrays (``span lens`` /
+  ``tokens``) or a depth fixed at construction and warmup-compiled
+  (static position); a per-step Python scalar is at best a host sync
+  per dispatch and, the moment it shapes an array or turns static, a
+  retrace per draft length — exactly the churn the draft-hit/miss mix
+  produces every step.
 - **R4e per-step tuned-config read**: ``ops.tuning.tuned_config(...)``
   called inside a loop body.  The tuned-config store is the SANCTIONED
   trace-time-frozen lookup (kernel wrappers and Engine construction
@@ -94,6 +105,24 @@ def check(pf: ParsedFile, ctx) -> Iterable[Finding]:
                             "per call on the dispatch path; pass the "
                             "device value (or make the position "
                             "static) instead")
+                    # R4f is about a FRESH scalar per step: only calls
+                    # inside a loop body can churn per step, so a
+                    # one-shot construction-time feed stays silent
+                    ddesc = "" if (i in j.static
+                                   or not _inside_loop(pf, node)) \
+                        else _draft_scalar(pf, arg)
+                    if ddesc:
+                        yield pf.finding(
+                            RULE, arg,
+                            f"draft length ({ddesc}) reaches traced "
+                            f"position {i} of jitted '{callee}' as a "
+                            "fresh Python int per step — per-slot "
+                            "draft length must ride the step as DATA "
+                            "inside the fixed-shape span arrays, or be "
+                            "a depth fixed at construction and "
+                            "warmup-compiled at a static position "
+                            "(docs/SERVING.md \"Speculative "
+                            "decoding\")")
                     if i in j.static and isinstance(
                             arg, (ast.List, ast.Dict, ast.Set)):
                         yield pf.finding(
@@ -154,6 +183,48 @@ def check(pf: ParsedFile, ctx) -> Iterable[Finding]:
                         "at trace time and later mutations are "
                         "silently ignored (the recompile-sentinel bug "
                         "class); pass it as an argument")
+
+
+def _draftish(name: str) -> bool:
+    """Identifier that names a speculative draft length/depth."""
+    return "draft" in name.lower()
+
+
+def _draft_scalar(pf: ParsedFile, arg: ast.AST) -> str:
+    """Describe ``arg`` if it feeds a DRAFT length into a jitted call
+    as a per-call Python scalar (R4f), else ''.
+
+    Two shapes: a direct ``len(<draft-ish>)`` call, or a draft-ish
+    NAME bound somewhere in the enclosing function from ``len(...)`` /
+    ``int(...)``.  Array conversions (``jnp.asarray`` /
+    ``np.asarray``), parameters, and constants are the sanctioned data
+    path and stay silent — so does anything the pass cannot resolve
+    (conservative: no guessing).  The caller additionally gates on the
+    call sitting inside a loop body (a one-shot feed cannot churn
+    per step)."""
+    if isinstance(arg, ast.Call) and call_name(arg) == "len" \
+            and arg.args:
+        src = expr_key(arg.args[0]) or ""
+        if _draftish(src):
+            return f"len({src})"
+        return ""
+    if not (isinstance(arg, ast.Name) and _draftish(arg.id)):
+        return ""
+    fn = None
+    for p in pf.parents(arg):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = p
+            break
+    if fn is None:
+        return ""
+    for n in scope_walk(fn):
+        if isinstance(n, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == arg.id
+                for t in n.targets):
+            v = n.value
+            if isinstance(v, ast.Call) and call_name(v) in ("len", "int"):
+                return f"'{arg.id}' = {call_name(v)}(...)"
+    return ""
 
 
 _CONFIG_ACCESSORS = ("tuned_config",)

@@ -57,11 +57,16 @@ __all__ = ["SITES", "InjectedFault", "FaultPlan", "FaultInjector",
 #: request queued at the door (typed ``QueueFull``, retried next pump);
 #: a replica fault fails THAT replica — its in-flight requests evacuate
 #: through preempt→swap→restore onto the healthy replicas (the
-#: ``serving-dist`` CI gate's contract).
+#: ``serving-dist`` CI gate's contract).  ``serve.spec`` fires in the
+#: speculative-decoding draft proposer (``serving/spec.py``): drafting
+#: is best-effort, so the fault degrades that slot to ``draft_len = 0``
+#: for the step — never the request; a fault during VERIFY is the
+#: ``serve.step`` site (per-slot decode bookkeeping), rolled back to
+#: the pre-span snapshot like any other isolated failure.
 SITES = ("ckpt.save", "ckpt.load", "collective", "step",
          "store.get", "store.set",
          "serve.admit", "serve.prefill", "serve.step", "serve.cow",
-         "serve.swap", "serve.route", "serve.replica")
+         "serve.swap", "serve.route", "serve.replica", "serve.spec")
 
 
 class InjectedFault(RuntimeError):

@@ -58,6 +58,7 @@ from .frontdoor import (Admission, FrontDoor, TenantPolicy,  # noqa: F401
                         TokenBucket)
 from .scheduler import Request, RequestState, Scheduler  # noqa: F401
 from .server import ServingServer  # noqa: F401
+from .spec import NgramProposer  # noqa: F401
 
 # public namespace hygiene: no foreign-module re-exports (tools/check_api_compat)
 from paddle_tpu._export import public_all as _public_all

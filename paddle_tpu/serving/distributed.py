@@ -344,6 +344,24 @@ class EngineReplicaSet:
         out["hit_rate"] = (out.get("hits", 0) / probes) if probes else 0.0
         return out
 
+    def spec_stats(self) -> Dict[str, float]:
+        """Summed speculative-decoding counters across replicas
+        (docs/SERVING.md "Speculative decoding").  Draft state composes
+        with evacuation for free: the n-gram index is a pure function
+        of ``prompt + output_ids``, so a request migrating off a failed
+        replica rebuilds it lazily on the destination's proposer, and
+        preempt→swap→restore snapshots never carry unaccepted
+        speculative tokens (they are never in ``output_ids``)."""
+        out: Dict[str, float] = {}
+        for r in self.replicas:
+            for k, v in r.spec_stats().items():
+                if k != "accept_rate":
+                    out[k] = out.get(k, 0) + v
+        prop = out.get("proposed", 0)
+        out["accept_rate"] = (out.get("accepted", 0) / prop) if prop \
+            else 0.0
+        return out
+
     # requires-lock: _lock
     def preempt(self, request_id: str, **kw) -> bool:
         idx = self._placements.get(request_id)

@@ -54,7 +54,9 @@ Telemetry (all zero-overhead when observability is disabled):
 ``serve.ragged_occupancy``, ``serve.prefix_hits``/``misses``,
 ``serve.shared_blocks``, ``serve.cached_blocks``, ``serve.cow_copies``,
 ``serve.preemptions``/``restores``/``swapped_pages``/
-``isolated_failures``
+``isolated_failures``, and — with speculative decoding on —
+``serve.spec.proposed``/``accepted``/``draft_errors`` +
+``serve.spec.accept_len``
 + ``serve_request`` / ``serve_step`` / ``serve_finish`` /
 ``serve_preempt`` / ``serve_restore`` / ``serve_isolated_failure``
 events and ``serve.step`` / ``serve.step.finish`` flight-recorder spans
@@ -126,14 +128,53 @@ def _paged_supported(model) -> bool:
     return cls is not None and getattr(cls, "supports_paged", False)
 
 
-def _sample(logits, temps, key, step_i):
-    """Per-slot greedy (temp==0) or temperature sampling, on device."""
+def _sample(logits, temps, key, seeds, emit):
+    """Per-slot greedy (temp==0) or temperature sampling, on device.
+
+    PRNG keys are derived per EMITTED-TOKEN INDEX, never per step:
+    slot ``b``'s token at emit index ``emit[b]`` draws from
+    ``fold_in(fold_in(key, seeds[b]), emit[b])``, a pure function of
+    (engine key, request sample seed, emit index).  A speculative
+    engine emitting several tokens in one step therefore draws the
+    SAME stream as the non-speculative engine emitting one per step —
+    the reproducibility contract that makes spec-on/spec-off
+    temperature sampling comparable (docs/SERVING.md "Speculative
+    decoding")."""
     lg = logits.astype(jnp.float32)
     greedy = jnp.argmax(lg, axis=-1)
-    k = jax.random.fold_in(key, step_i)
-    sampled = jax.random.categorical(
-        k, lg / jnp.maximum(temps, 1e-6)[:, None], axis=-1)
+    scaled = lg / jnp.maximum(temps, 1e-6)[:, None]
+
+    def draw(seed, idx, row):
+        k = jax.random.fold_in(jax.random.fold_in(key, seed), idx)
+        return jax.random.categorical(k, row)
+
+    sampled = jax.vmap(draw)(seeds, emit, scaled)
     return jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
+
+
+def _sample_span(logits, temps, key, seeds, emit):
+    """Per-POSITION sampling over a whole ``(B, C, V)`` span — the
+    speculative verify step's sampler.  Position ``j`` of slot ``b``
+    uses emit index ``emit[b] + j``, so the token drawn at any given
+    emit index matches :func:`_sample`'s bit-for-bit (same fold chain),
+    whatever mix of spans produced it."""
+    lg = logits.astype(jnp.float32)
+    greedy = jnp.argmax(lg, axis=-1)
+    c = lg.shape[1]
+    scaled = lg / jnp.maximum(temps, 1e-6)[:, None, None]
+
+    def draw_row(seed, base, rows):
+        kb = jax.random.fold_in(key, seed)
+
+        def draw(j, row):
+            return jax.random.categorical(
+                jax.random.fold_in(kb, base + j), row)
+
+        return jax.vmap(draw)(jnp.arange(c, dtype=jnp.int32), rows)
+
+    sampled = jax.vmap(draw_row)(seeds, emit, scaled)
+    return jnp.where(temps[:, None] > 0.0, sampled, greedy).astype(
+        jnp.int32)
 
 
 class Engine:
@@ -191,6 +232,19 @@ class Engine:
     bounded ``jax.profiler`` capture when TTFT p95 breaches its SLO for
     K consecutive windows (docs/OBSERVABILITY.md "Tracing a request").
 
+    ``spec_decode``: self-speculative decoding (docs/SERVING.md
+    "Speculative decoding") — a host-side n-gram proposer
+    (``serving.spec.NgramProposer``) drafts up to ``draft_depth``
+    tokens per decode slot per step and the SAME unified ragged step
+    verifies the whole ``[pending, d_1..d_k]`` span like a prefill
+    chunk, emitting the accepted prefix plus one bonus token.  Greedy
+    outputs stay token-identical to the non-speculative engine;
+    temperature slots ride the same program at ``draft_len = 0`` (v1).
+    Enabling it widens the compiled span to
+    ``max(prefill_chunk, draft_depth + 1)`` — ONE step program per
+    engine either way, and the zero-recompile contract is unchanged
+    (a slot with no viable draft is just ``draft_len = 0`` data).
+
     ``mesh``: a serving mesh (``serving.distributed.serving_mesh``)
     makes this engine TENSOR-PARALLEL: parameters land sharded by their
     partition specs, the paged KV pools shard their head axis over the
@@ -214,7 +268,9 @@ class Engine:
                  retry: Optional[RetryPolicy] = None,
                  mesh=None,
                  weight_quant: Optional[str] = None,
-                 slo_capture=None):
+                 slo_capture=None,
+                 spec_decode: bool = False,
+                 draft_depth: int = 4):
         if not _paged_supported(model):
             raise NotImplementedError(
                 f"{type(model).__name__} does not support the paged "
@@ -245,6 +301,21 @@ class Engine:
             raise ValueError(
                 f"prefill_chunk={prefill_chunk} must be in "
                 f"[1, max_seq_len={max_seq_len}]")
+        self.spec = None
+        self.draft_depth = 0
+        if spec_decode:
+            if not 1 <= int(draft_depth) <= max_seq_len - 1:
+                raise ValueError(
+                    f"draft_depth={draft_depth} must be in "
+                    f"[1, max_seq_len-1={max_seq_len - 1}]")
+            self.draft_depth = int(draft_depth)
+            from .spec import NgramProposer
+            self.spec = NgramProposer(self.draft_depth)
+            # the verify span [pending, d_1..d_K] must fit the one
+            # compiled (B, C) step: widen C once, HERE, before any
+            # trace — warmup compiles against the widened span and
+            # every draft depth 0..K rides it as span-length DATA
+            prefill_chunk = max(int(prefill_chunk), self.draft_depth + 1)
         max_pos = getattr(model.cfg, "max_position_embeddings", None)
         if max_pos is not None and max_seq_len > max_pos:
             raise ValueError(
@@ -304,7 +375,6 @@ class Engine:
             self.params = shard_serving_params(model, self.params, mesh)
         self._detokenize = detokenize
         self._key = jax.random.key(seed)
-        self._step_i = 0
         # Cross-thread state (the HTTP-handler / engine-loop boundary,
         # serving/server.py): when the engine sits behind a
         # ServingServer, handler threads reach these through
@@ -344,27 +414,37 @@ class Engine:
 
     def _build_fns(self):
         model = self.model
+        spec = self.spec is not None
 
         def _logits_of(params, hidden):
             with _swapped_params(model, params):
                 return model.logits(hidden)[:, 0]
 
         def step_fn(params, caches, tokens, tables, starts, lens, temps,
-                    key, step_i):
-            """The ONE serving program: every slot's span (prefill chunk
-            or decode token) writes its KV and attends in a single
-            ragged dispatch; one token is sampled per slot from the last
-            real span position (hosts of mid-prefill slots discard it)."""
+                    key, seeds, emit):
+            """The ONE serving program: every slot's span (prefill
+            chunk, decode token, or decode-plus-draft verify span)
+            writes its KV and attends in a single ragged dispatch.
+            Non-speculative engines sample one token per slot from the
+            last real span position (hosts of mid-prefill slots discard
+            it); speculative engines sample EVERY span position — the
+            per-position argmax IS the verification (position ``j``'s
+            sample is the model's token after consuming draft ``j``),
+            so accept/reject needs no second dispatch."""
             mp = {k[len("model."):]: v for k, v in params.items()
                   if k.startswith("model.")}
             hidden, caches = functional_call(
                 model.model, mp, tokens, caches=caches, seq_lens=lens,
                 block_tables=tables, span_starts=starts, training=False)
+            if spec:
+                with _swapped_params(model, params):
+                    lg = model.logits(hidden)          # (B, C, V)
+                return _sample_span(lg, temps, key, seeds, emit), caches
             # the last REAL span token's hidden state, not the padding's
             idx = jnp.clip(lens - 1, 0, tokens.shape[1] - 1)[:, None, None]
             h_last = jnp.take_along_axis(hidden, idx, axis=1)
             lg = _logits_of(params, h_last)
-            return _sample(lg, temps, key, step_i), caches
+            return _sample(lg, temps, key, seeds, emit), caches
 
         def cow_fn(caches, src, dst):
             """Copy-on-write page copies src[i] → dst[i] in every layer's
@@ -407,7 +487,7 @@ class Engine:
                 jnp.asarray(np.zeros((b, c), np.int32)), jnp.asarray(oob),
                 jnp.asarray(zeros_i), jnp.asarray(zeros_i),
                 jnp.asarray(np.zeros((b,), np.float32)),
-                self._key, jnp.asarray(np.int32(0)))
+                self._key, jnp.asarray(zeros_i), jnp.asarray(zeros_i))
             jax.block_until_ready(nxt)
             self.kv.caches = caches
             pad = np.full((b,), self.kv.oob_block, np.int32)
@@ -735,10 +815,18 @@ class Engine:
         done_len = len(st.output_ids) >= req.max_new_tokens
         if done_eos or done_len:
             self.scheduler.finish(st, "eos" if done_eos else "length")
+            if self.spec is not None:
+                # bounded proposer retention: the n-gram index dies
+                # with the request (it rebuilds lazily if the id is
+                # ever reused)
+                self.spec.drop(req.request_id)
             tr = _obs_state.TRACE[0]
             if tr is not None:
+                spec_kw = {} if self.spec is None else {
+                    "spec_proposed": st.spec_proposed,
+                    "spec_accepted": st.spec_accepted}
                 tr.retire(req.request_id, reason=st.finish_reason,
-                          tokens=len(st.output_ids))
+                          tokens=len(st.output_ids), **spec_kw)
             if self._drain_capture is not None:
                 # BEFORE the eviction below: when more requests than
                 # keep_finished retire in one step, the state may be
@@ -772,6 +860,51 @@ class Engine:
                     f"({traceback.format_exc(limit=3).strip()})",
                     RuntimeWarning, stacklevel=2)
 
+    def _propose_drafts(self) -> None:
+        """Attach this step's n-gram draft to every eligible decode
+        slot (``serving/spec.py``).  Drafting is BEST-EFFORT: a propose
+        failure — including an injected ``serve.spec`` fault — degrades
+        THAT slot to ``draft_len = 0`` (a plain decode step through the
+        same compiled program); it never isolates the request or tears
+        into the step.  The cap keeps speculative KV inside the pages
+        the request reserved at admission and accepted tokens inside
+        its remaining output budget — rollback can then always be pure
+        kv_len bookkeeping."""
+        fi = _rs_state.FAULTS[0]
+        for _i, st in self.scheduler.active():
+            st.draft = []
+            if st.prefilling or st.request.temperature > 0.0:
+                continue             # v1: greedy slots only
+            cap = min(self.draft_depth,
+                      st.total_len - (st.kv_len + 1),
+                      st.request.max_new_tokens - len(st.output_ids) - 1)
+            if cap < 1:
+                continue
+            try:
+                if fi is not None:
+                    fi("serve.spec")
+                st.draft = self.spec.propose(st, cap)
+            except Exception as e:  # noqa: BLE001
+                self.spec.errors += 1
+                reg = obs.get_registry()
+                if reg is not None:
+                    reg.counter("serve.spec.draft_errors").inc()
+                obs.emit_event("serve_spec_error",
+                               id=st.request.request_id,
+                               exc=type(e).__name__,
+                               message=str(e)[:200])
+                st.draft = []
+
+    def spec_stats(self) -> Dict[str, float]:
+        """Speculative-decoding counters (proposed/accepted/
+        accept_rate/verifies/draft_hits/draft_misses/errors/
+        tracked_requests) — zeros when ``spec_decode`` is off."""
+        if self.spec is None:
+            return {"proposed": 0, "accepted": 0, "accept_rate": 0.0,
+                    "verifies": 0, "draft_hits": 0, "draft_misses": 0,
+                    "errors": 0, "tracked_requests": 0}
+        return self.spec.stats()
+
     def step_begin(self):
         """Admit + plan + CoW + DISPATCH the compiled step without
         waiting for the device; returns the opaque pending handle
@@ -788,6 +921,8 @@ class Engine:
         t0 = time.perf_counter()
         with span("serve.step", emit=False):
             self._admit_all()
+            if self.spec is not None:
+                self._propose_drafts()
             plan = self.scheduler.plan_spans(self.prefill_chunk,
                                              self.prefill_token_budget)
             if plan:
@@ -795,19 +930,22 @@ class Engine:
             live_tokens = sum(n for _, _, n, _ in plan)
             nxt = None
             if plan:
-                tokens, tables, starts, lens, temps = \
-                    self.scheduler.span_arrays(plan, self.prefill_chunk)
+                tokens, tables, starts, lens, temps, seeds, emit = \
+                    self.scheduler.span_arrays(
+                        plan, self.prefill_chunk,
+                        spec_emit=self.spec is not None)
                 # device_put of ready numpy arrays only: jnp.asarray of
                 # a Python list/scalar traces a tiny program whose
                 # one-off compile would break the zero-compiles-after-
-                # warmup contract
+                # warmup contract — draft length reaches the step ONLY
+                # inside these traced arrays (span lens/tokens), never
+                # as a per-step Python scalar (pdtpu-lint R4f)
                 nxt, caches = self._step_fn(
                     self.params, self.kv.caches, jnp.asarray(tokens),
                     jnp.asarray(tables), jnp.asarray(starts),
                     jnp.asarray(lens), jnp.asarray(temps), self._key,
-                    jnp.asarray(np.int32(self._step_i)))
+                    jnp.asarray(seeds), jnp.asarray(emit))
                 self.kv.caches = caches
-                self._step_i += 1
         # busy accounting covers THIS engine's own engagement only
         # (begin and finish timed separately): under a replica set the
         # phases interleave across engines, so begin-to-finish wall
@@ -886,75 +1024,160 @@ class Engine:
                 # pre-span snapshot: isolation rewinds to here, and
                 # re-running the span after restore is idempotent
                 # (the dispatch above already wrote this span's KV;
-                # the rewound re-run rewrites identical bytes)
+                # the rewound re-run rewrites identical bytes — a
+                # speculative span's rejected tail is re-proposed from
+                # the same context, and kv_len only ever covered the
+                # accepted prefix)
                 snap = (st.kv_len, st.pending_token,
                         len(st.output_ids), st.text_len,
-                        st.detok_offset)
+                        st.detok_offset, st.spec_proposed,
+                        st.spec_accepted)
                 try:
                     if fi is not None:
                         fi("serve.prefill" if is_prefill
                            else "serve.step")
+                    if not is_prefill:
+                        # decode: plain single token, or the
+                        # speculative verify span (mid-verify faults
+                        # fired above land in the rollback below)
+                        self._consume_decode(st, i, n, nxt, events)
+                        continue
                     st.kv_len += n
-                    if is_prefill and tr is not None:
+                    if tr is not None:
                         tr.point(st.request.request_id, "prefill_chunk",
                                  tokens=n, kv_len=st.kv_len)
-                    if is_prefill and st.prefilling:
+                    if st.prefilling:
                         continue    # mid-prefill: sample discarded
-                    if is_prefill:
-                        # prompt complete: this sample is the
-                        # request's first token — TTFT stops here.
-                        # first_token_t survives a hard replica-failure
-                        # reset (the request re-prefills from scratch),
-                        # so the re-completion must not re-emit
-                        # serve_request / re-observe TTFT for the same
-                        # request (serving/distributed.py).
-                        self._register_prefix(st)
-                        if tr is not None:
-                            # prefill→decode transition (closes the
-                            # prefill segment).  A re-completion after a
-                            # hard replica reset accumulates under its
-                            # own event name, so `first_token` stays
-                            # exactly-once per request — same dedupe
-                            # marker as the serve_request event below.
-                            tr.transition(
-                                st.request.request_id, "decode",
-                                event="first_token"
-                                if st.first_token_t is None
-                                else "re_prefilled")
-                        if st.first_token_t is not None:
-                            self._emit(st, int(nxt[i]), events)
-                            continue
-                        st.first_token_t = time.perf_counter()
-                        req = st.request
-                        reg = obs.get_registry()
-                        if reg is not None:
-                            ttft = (st.first_token_t - st.submit_t) * 1e3
-                            reg.histogram("serve.ttft_ms").observe(ttft)
-                            if req.tenant:
-                                # the per-tenant aggregate the FrontDoor
-                                # SLO policy reads (frontdoor._ttft_p95)
-                                reg.histogram(
-                                    f"serve.tenant[{req.tenant}]"
-                                    ".ttft_ms").observe(ttft)
-                            if st.num_shared:
-                                reg.counter("serve.prefix_hits").inc(
-                                    st.num_shared)
-                            misses = len(st.page_keys) - st.num_shared
-                            if misses:
-                                reg.counter(
-                                    "serve.prefix_misses").inc(misses)
-                        obs.emit_event(
-                            "serve_request", id=req.request_id,
-                            tenant=req.tenant,
-                            prompt_len=int(req.prompt_ids.size),
-                            slot=st.slot, blocks=len(st.blocks),
-                            cached_tokens=st.cached_tokens)
-                    self._emit(st, int(nxt[i]), events)
+                    # prompt complete: this sample is the request's
+                    # first token — TTFT stops here.  first_token_t
+                    # survives a hard replica-failure reset (the
+                    # request re-prefills from scratch), so the
+                    # re-completion must not re-emit serve_request /
+                    # re-observe TTFT for the same request
+                    # (serving/distributed.py).  The speculative
+                    # program samples every span position; the prompt's
+                    # last position carries the first token.
+                    tok = int(nxt[i]) if nxt.ndim == 1 \
+                        else int(nxt[i, n - 1])
+                    self._register_prefix(st)
+                    if tr is not None:
+                        # prefill→decode transition (closes the
+                        # prefill segment).  A re-completion after a
+                        # hard replica reset accumulates under its
+                        # own event name, so `first_token` stays
+                        # exactly-once per request — same dedupe
+                        # marker as the serve_request event below.
+                        tr.transition(
+                            st.request.request_id, "decode",
+                            event="first_token"
+                            if st.first_token_t is None
+                            else "re_prefilled")
+                    if st.first_token_t is not None:
+                        self._emit(st, tok, events)
+                        continue
+                    st.first_token_t = time.perf_counter()
+                    req = st.request
+                    reg = obs.get_registry()
+                    if reg is not None:
+                        ttft = (st.first_token_t - st.submit_t) * 1e3
+                        reg.histogram("serve.ttft_ms").observe(ttft)
+                        if req.tenant:
+                            # the per-tenant aggregate the FrontDoor
+                            # SLO policy reads (frontdoor._ttft_p95)
+                            reg.histogram(
+                                f"serve.tenant[{req.tenant}]"
+                                ".ttft_ms").observe(ttft)
+                        if st.num_shared:
+                            reg.counter("serve.prefix_hits").inc(
+                                st.num_shared)
+                        misses = len(st.page_keys) - st.num_shared
+                        if misses:
+                            reg.counter(
+                                "serve.prefix_misses").inc(misses)
+                    obs.emit_event(
+                        "serve_request", id=req.request_id,
+                        tenant=req.tenant,
+                        prompt_len=int(req.prompt_ids.size),
+                        slot=st.slot, blocks=len(st.blocks),
+                        cached_tokens=st.cached_tokens)
+                    self._emit(st, tok, events)
                 except Exception as e:  # noqa: BLE001
                     st.kv_len, st.pending_token = snap[0], snap[1]
                     del st.output_ids[snap[2]:]
                     st.text_len, st.detok_offset = snap[3], snap[4]
+                    st.spec_proposed, st.spec_accepted = snap[5], snap[6]
+                    # a multi-token (speculative) span may have emitted
+                    # part of its acceptance before failing: those
+                    # tokens were rewound and will re-emit after
+                    # restore, so their events must not ALSO be
+                    # delivered from this step (already-fired on_token
+                    # callbacks can't be recalled — same caveat as the
+                    # hard replica-reset path)
+                    rid = st.request.request_id
+                    events[:] = [ev for ev in events
+                                 if ev.request_id != rid]
                     self._isolate(st, e)
+
+    def _consume_decode(self, st: RequestState, i: int, n: int, nxt,
+                        events: List[TokenEvent]) -> None:
+        """Consume a decode slot's sample(s): a plain single-token
+        decode (non-speculative program, or a spec slot with no
+        draft), or the speculative VERIFY — greedy acceptance takes the
+        longest draft prefix the per-position samples reproduce, plus
+        one bonus token (so a total miss still emits one token, never
+        worse than plain decode).  Rolling back the rejected tail is
+        kv_len bookkeeping ONLY: the speculative writes sit in pages
+        the request already reserved, beyond the new kv_len, where the
+        next span overwrites them and attention never reads
+        (serving/spec.py)."""
+        if nxt.ndim == 1:               # non-speculative program: (B,)
+            st.kv_len += 1
+            self._emit(st, int(nxt[i]), events)
+            return
+        row = nxt[i]
+        req = st.request
+        k = n - 1
+        a = 0
+        while a < k and int(row[a]) == st.draft[a]:
+            a += 1
+        # eos-aware emission length, decided BEFORE emitting: an
+        # accepted token that IS the eos finishes the request there and
+        # the rest of the accepted span is dropped.  (The draft cap
+        # already keeps a+1 inside the max_new budget.)
+        will = a + 1
+        if req.eos_token_id is not None:
+            for j in range(will):
+                if int(row[j]) == req.eos_token_id:
+                    will = j + 1
+                    break
+        acc = will - 1                  # drafts actually consumed
+        st.kv_len += 1 + acc
+        if k:
+            # PER-REQUEST accounting lands BEFORE emission (the last
+            # emitted token may retire the request, and the retire
+            # event/trace must carry this span's acceptance) — it is
+            # part of the rollback snapshot, so a mid-emission failure
+            # rewinds it with the rest of the state
+            st.spec_proposed += k
+            st.spec_accepted += acc
+        for j in range(will):
+            self._emit(st, int(row[j]), events)
+            if st.finished:
+                break                   # safety net: must match `will`
+        if k:
+            # GLOBAL counters land AFTER emission: they are not in the
+            # snapshot, so counting before _emit could raise would
+            # double-count this span when isolation re-runs it
+            sp = self.spec
+            sp.verifies += 1
+            sp.proposed += k
+            sp.accepted += acc
+            reg = obs.get_registry()
+            if reg is not None:
+                reg.counter("serve.spec.proposed").inc(k)
+                if acc:
+                    reg.counter("serve.spec.accepted").inc(acc)
+                reg.histogram("serve.spec.accept_len").observe(acc)
 
     def step(self) -> List[TokenEvent]:
         """Admit what fits, run ONE unified ragged step (prefill chunks

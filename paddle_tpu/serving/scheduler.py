@@ -38,6 +38,7 @@ import collections
 import dataclasses
 import itertools
 import time
+import zlib
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
@@ -84,7 +85,8 @@ class RequestState:
                  "submit_t", "first_token_t", "finished", "finish_reason",
                  "drained", "num_shared", "num_cowed", "cached_tokens",
                  "borrowed", "cow_spare", "page_keys", "swapped",
-                 "preempts")
+                 "preempts", "sample_seed", "draft", "spec_proposed",
+                 "spec_accepted")
 
     def __init__(self, request: Request):
         self.request = request
@@ -111,6 +113,23 @@ class RequestState:
         # admission takes the restore path instead of a fresh prefill
         self.swapped: Optional[tuple] = None
         self.preempts = 0            # times this request was preempted
+        # per-request sampling stream seed (finalized in
+        # Scheduler.submit, which folds in its per-engine submission
+        # ordinal): the temperature stream depends only on (engine key,
+        # prompt, submission index, emit index) — reproducible across
+        # identical engines and the speculative/non-speculative split,
+        # while DUPLICATE prompts in one engine still sample distinct
+        # streams (best-of-n must not collapse to n copies).  Stored on
+        # the state, so it survives preempt→restore, replica migration,
+        # and hard re-prefill resets (engine._sample).
+        self.sample_seed = zlib.crc32(
+            request.prompt_ids.tobytes()) & 0x7FFFFFFF
+        # speculative decoding (serving/spec.py): this step's draft
+        # tokens (transient — set by the engine before planning, never
+        # part of any snapshot) and lifetime acceptance accounting
+        self.draft: List[int] = []
+        self.spec_proposed = 0       # draft tokens sent to verification
+        self.spec_accepted = 0       # of those, accepted
 
     @property
     def total_len(self) -> int:
@@ -142,6 +161,7 @@ class Scheduler:
             collections.deque()                  # guarded_by: _lock
         self.slots: List[Optional[RequestState]] = [None] * self.max_batch
         self._rr = 0   # round-robin origin for the prefill token budget
+        self._submits = 0   # submission ordinal folded into sample seeds
 
     # -- admission ---------------------------------------------------------
 
@@ -149,6 +169,13 @@ class Scheduler:
     def submit(self, request: Request,
                page_keys: Optional[List[bytes]] = None) -> RequestState:
         st = RequestState(request)
+        # fold the submission ordinal into the sampling seed: identical
+        # prompts submitted twice must draw DISTINCT temperature
+        # streams (best-of-n), while the same engine driven the same
+        # way stays reproducible (RequestState.sample_seed)
+        st.sample_seed = (st.sample_seed ^ (self._submits * 0x9E3779B1)
+                          ) & 0x7FFFFFFF
+        self._submits += 1
         if self.prefix_cache is not None:
             # hash the prompt's pages ONCE here: admit_next runs every
             # step, and a request parked at the queue head under
@@ -276,12 +303,15 @@ class Scheduler:
     def plan_spans(self, chunk: int, budget: Optional[int] = None
                    ) -> List[Tuple[int, "RequestState", int, bool]]:
         """Decide each active slot's span for this step: ``(slot, state,
-        span_len, is_prefill)``.  Decode slots always get their 1 token;
-        prefilling slots split ``budget`` prefill tokens (default: no
-        cap) in ≤``chunk`` chunks, round-robined across steps so a tight
-        budget starves nobody.  Slots left out idle this step (span 0).
-        The engine runs copy-on-write for spans that land in borrowed
-        pages BEFORE materializing the batch arrays (span_arrays)."""
+        span_len, is_prefill)``.  Decode slots get their pending token
+        plus any speculative draft the engine attached (``st.draft`` —
+        span ``1 + len(draft)``, still ≤ chunk by the engine's draft
+        cap); prefilling slots split ``budget`` prefill tokens (default:
+        no cap) in ≤``chunk`` chunks, round-robined across steps so a
+        tight budget starves nobody.  Slots left out idle this step
+        (span 0).  The engine runs copy-on-write for spans that land in
+        borrowed pages BEFORE materializing the batch arrays
+        (span_arrays) — draft positions included."""
         c = int(chunk)
         left = int(budget) if budget is not None else self.max_batch * c
         self._rr = (self._rr + 1) % max(self.max_batch, 1)
@@ -297,33 +327,51 @@ class Scheduler:
                 left -= n
                 plan.append((i, st, n, True))
             else:
-                plan.append((i, st, 1, False))
+                # draft tokens are NOT prefill work: they ride the
+                # decode slot's lane for free (the ragged kernel skips
+                # dead rows either way) and never touch the budget
+                plan.append((i, st, 1 + min(len(st.draft), c - 1), False))
         plan.sort(key=lambda t: t[0])
         return plan
 
-    def span_arrays(self, plan, chunk: int):
+    def span_arrays(self, plan, chunk: int, spec_emit: bool = False):
         """The fixed-shape ragged step inputs for a span plan:
         ``(tokens (B,C), tables (B,MB), starts (B,), lens (B,),
-        temps (B,))`` as numpy arrays.  Idle/empty slots get the inert
-        sentinel values — shapes NEVER depend on occupancy.  Call AFTER
-        copy-on-write has patched the tables."""
+        temps (B,), seeds (B,), emit (B,))`` as numpy arrays.
+        Idle/empty slots get the inert sentinel values — shapes NEVER
+        depend on occupancy (a draft miss is ``len 1``, never a new
+        shape).  Call AFTER copy-on-write has patched the tables.
+
+        ``seeds``/``emit`` drive the per-emitted-token-index PRNG key
+        derivation (``engine._sample``): ``emit[i]`` is the emit index
+        of the slot's FIRST sampled position — for the speculative step
+        (``spec_emit=True``, which samples every span position) a
+        completing prefill span is rebased so its LAST position lands
+        on emit index ``len(output_ids)``."""
         b, mb, c = self.max_batch, self.max_blocks_per_seq, int(chunk)
         tokens = np.zeros((b, c), np.int32)
         tables = np.full((b, mb), self.oob_block, np.int32)
         starts = np.zeros((b,), np.int32)
         lens = np.zeros((b,), np.int32)
         temps = np.zeros((b,), np.float32)
+        seeds = np.zeros((b,), np.int32)
+        emit = np.zeros((b,), np.int32)
         for i, st, n, is_prefill in plan:
             req = st.request
             if is_prefill:
                 tokens[i, :n] = req.prompt_ids[st.kv_len:st.kv_len + n]
             else:
                 tokens[i, 0] = st.pending_token
+                if n > 1:
+                    tokens[i, 1:n] = st.draft[:n - 1]
             tables[i] = st.table
             starts[i] = st.kv_len
             lens[i] = n
             temps[i] = req.temperature
-        return tokens, tables, starts, lens, temps
+            seeds[i] = st.sample_seed
+            emit[i] = len(st.output_ids) - \
+                ((n - 1) if (spec_emit and is_prefill) else 0)
+        return tokens, tables, starts, lens, temps, seeds, emit
 
     def finish(self, st: RequestState, reason: str) -> None:
         """Release the slot and drop every block reference (shared pages
@@ -348,6 +396,10 @@ class Scheduler:
         st.table = None
         st.borrowed = set()
         st.cow_spare = {}
+        # unaccepted speculative tokens never outlive the slot: a
+        # preempt/finish snapshot carries only accepted state (kv_len
+        # covers exactly pending + accepted; the draft was transient)
+        st.draft = []
 
     # requires-lock: _lock
     def requeue(self, st: RequestState, head: bool = False) -> None:

@@ -462,10 +462,42 @@ class TestRetraceHazard:
         """})
         assert rules_of(res) == []
 
+    def test_positive_draft_len_scalar(self, tmp_path):
+        """R4f: the speculative draft length fed to the compiled step
+        as a fresh Python int per step — directly as len(draft) and as
+        a draft-named local bound to len(...) — flagged."""
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            import jax
 
-# ---------------------------------------------------------------------------
-# rule 5: fault-site
-# ---------------------------------------------------------------------------
+            def serve(step, x, drafts):
+                f = jax.jit(step)
+                for d in drafts:
+                    out = f(x, len(d.draft))
+                    draft_len = len(d.draft)
+                    out = f(x, draft_len)
+        """})
+        assert rules_of(res) == ["retrace-hazard"] * 2
+        assert all("draft" in f.message for f in res.findings)
+
+    def test_negative_draft_len_as_data_or_static(self, tmp_path):
+        """The sanctioned paths: draft length riding the traced span
+        arrays (jnp.asarray of numpy), and a construction-fixed depth
+        at a warmup-compiled STATIC position — both silent."""
+        res = run_lint(tmp_path, {"pkg/a.py": """
+            import jax
+            import numpy as np
+            import jax.numpy as jnp
+
+            def serve(step, x, plan, depth):
+                f = jax.jit(step, static_argnums=(2,))
+                lens = np.zeros((8,), np.int32)
+                for i, st in plan:
+                    lens[i] = 1 + len(st.draft)
+                draft_depth = int(depth)      # construction-time once
+                for _ in range(4):
+                    out = f(x, jnp.asarray(lens), draft_depth)
+        """})
+        assert rules_of(res) == []
 
 class TestFaultSite:
     def test_positive_unregistered_fire(self, tmp_path):

@@ -868,6 +868,75 @@ def gate_serving_smoke(max_batch: int = 4, n_requests: int = 10) -> int:
                   f"int8 weights): {len(fprompts)} requests "
                   "token-identical to generate(), 0 compiles after "
                   "warmup")
+
+        # 5. SPECULATIVE DECODING (docs/SERVING.md "Speculative
+        # decoding"): n-gram self-drafting through the one compiled
+        # verify step.  Same standing contracts — one warmup compile
+        # set, ZERO compiles under draft-HIT churn (looping prompts,
+        # verify spans > 1) interleaved with draft-MISS churn (random
+        # prompts, draft_len=0 rides the same program), jit caches at
+        # one entry, full reclaim — and greedy outputs token-identical
+        # to model.generate() (speculation is a perf lever, never a
+        # quality trade).
+        seng = serving.Engine(model, max_batch=max_batch,
+                              max_seq_len=64, page_size=8,
+                              prefill_chunk=8, spec_decode=True,
+                              draft_depth=4).warmup()
+        spec_warmup = tel.sentinel.compiles()
+        motif = rng.integers(0, model.cfg.vocab_size,
+                             size=5).astype(np.int32)
+        sprompts = [np.tile(motif, 3)] + \
+            [rng.integers(0, model.cfg.vocab_size,
+                          size=n).astype(np.int32)
+             for n in (3, 17, 9)] + [np.tile(motif, 3)]
+        served = []
+        for p in sprompts:
+            rid = seng.add_request(p, max_new_tokens=12)
+            seng.step()     # staggered: join a running batch
+            outs = seng.run()
+            served.append((p, outs[rid]))
+        spec_churn = tel.sentinel.compiles() - spec_warmup
+        if spec_churn:
+            failures.append(
+                f"{spec_churn} compile(s) after warmup with "
+                "speculative decoding on — draft-hit/miss churn must "
+                "ride the one compiled (B, C) step as span-length "
+                "data, never a new shape")
+        for fn, name in ((seng._step_fn, "spec step"),
+                         (seng._cow_fn, "spec cow")):
+            n = getattr(fn, "_cache_size", lambda: None)()
+            if n is not None and n > 1:
+                failures.append(
+                    f"{name} jit cache holds {n} entries, expected 1")
+        for p, got in served:
+            ref = np.asarray(model.generate(
+                jnp.asarray(p)[None], max_new_tokens=12,
+                temperature=0.0))[0, len(p):]
+            if not np.array_equal(ref, np.asarray(got)):
+                failures.append(
+                    f"speculative request (prompt {len(p)}) diverged "
+                    "from model.generate() — accept/rollback "
+                    "bookkeeping corrupted the stream")
+        sstats = seng.spec_stats()
+        if sstats["proposed"] == 0:
+            failures.append(
+                "speculative engine never proposed a draft — the "
+                "n-gram proposer lost its looping-prompt coverage")
+        if sstats["accepted"] == 0:
+            failures.append(
+                "no draft token was ever accepted on the looping "
+                "prompts — speculative verification or acceptance is "
+                "broken")
+        if seng.kv_blocks_used != 0:
+            failures.append(
+                f"{seng.kv_blocks_used} KV block(s) still referenced "
+                "after the speculative runs")
+        if not any("spec" in f for f in failures):
+            print(f"serving-smoke: speculative decoding "
+                  f"({sstats['proposed']} drafted, "
+                  f"{sstats['accept_rate']:.0%} accepted) "
+                  "token-identical to generate(), 0 compiles after "
+                  "warmup")
     finally:
         obs.disable()
 
@@ -1070,6 +1139,110 @@ def gate_chaos_serving(max_batch: int = 4) -> int:
             print(f"chaos-serving: faults at all {len(serve_sites)} "
                   "serving sites absorbed: outputs token-identical to "
                   "the fault-free run, 0 compiles, all blocks reclaimed")
+
+        # SPECULATIVE DECODING under chaos (docs/SERVING.md
+        # "Speculative decoding"): the same run with verify spans in
+        # flight.  serve.step is the per-decode-slot bookkeeping site,
+        # so with drafts attached it fires MID-VERIFY — the rollback
+        # must rewind the pre-span snapshot (kv_len only ever covered
+        # accepted tokens, so the speculative tail needs no undo);
+        # serve.spec degrades one slot's drafting to draft_len=0; an
+        # injected swap fault plus a manual mid-decode preemption ride
+        # the preempt→restore path with speculation live.  Greedy
+        # outputs must stay token-identical to the fault-free
+        # speculative run, with zero compiles and full reclaim.
+        SSPEC = "serve.spec@1,serve.step@3x2,serve.swap@0:OSError"
+        spec_sites = ("serve.spec", "serve.step", "serve.swap")
+        motif = rng.integers(0, model.cfg.vocab_size,
+                             size=5).astype(np.int32)
+        spec_prompts = [np.tile(motif, 3),
+                        rng.integers(0, model.cfg.vocab_size,
+                                     size=9).astype(np.int32),
+                        np.tile(rng.integers(0, model.cfg.vocab_size,
+                                             size=4).astype(np.int32), 4),
+                        rng.integers(0, model.cfg.vocab_size,
+                                     size=17).astype(np.int32)]
+        spec_budgets = (8, 5, 10, 6)
+
+        def spec_scenario(spec, tag):
+            rs.clear_faults()
+            inj = None
+            if spec:
+                os.environ["PDTPU_FAULTS"] = spec
+                inj = rs.install_faults_from_env()
+            try:
+                eng = serving.Engine(
+                    model, max_batch=max_batch, max_seq_len=64,
+                    page_size=8, prefill_chunk=8, spec_decode=True,
+                    draft_depth=3,
+                    retry=rs.RetryPolicy(max_attempts=4, backoff_s=0.0,
+                                         jitter=0.0,
+                                         sleep=lambda _s: None)).warmup()
+                c0 = tel.sentinel.compiles()
+                rids = []
+                preempted = False
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore", RuntimeWarning)
+                    for p, m_ in zip(spec_prompts, spec_budgets):
+                        rids.append(eng.add_request(p, max_new_tokens=m_))
+                        eng.step()
+                    for _ in range(200):
+                        if not preempted:
+                            # victim a DECODING slot so the preemption
+                            # lands mid-speculation: kv_len covers only
+                            # accepted tokens, so the swap/restore must
+                            # round-trip exactly that prefix
+                            for _slot, st in eng.scheduler.active():
+                                if not st.prefilling:
+                                    preempted = eng.preempt(
+                                        st.request.request_id)
+                                    break
+                        if not eng.has_work():
+                            break
+                        eng.step()
+                    eng.run()
+                churn = tel.sentinel.compiles() - c0
+                if churn:
+                    failures.append(
+                        f"{tag}: {churn} compile(s) after warmup on "
+                        "the speculative engine")
+                if not preempted:
+                    failures.append(
+                        f"{tag}: mid-decode preemption never engaged "
+                        "on the speculative engine")
+                if eng.kv_blocks_used != 0:
+                    failures.append(
+                        f"{tag}: {eng.kv_blocks_used} KV block(s) "
+                        "leaked on the speculative engine")
+                if eng.spec_stats()["accepted"] == 0:
+                    failures.append(
+                        f"{tag}: no draft token accepted — the "
+                        "scenario lost its speculative coverage")
+                return [eng.output_ids(r) for r in rids], inj
+            finally:
+                rs.clear_faults()
+                os.environ.pop("PDTPU_FAULTS", None)
+
+        sbase, _ = spec_scenario(None, "spec-baseline")
+        sfault, sinj = spec_scenario(SSPEC, "spec-faulted")
+        sfired = {site for site, _idx in sinj.fired}
+        smissing = [s for s in spec_sites if s not in sfired]
+        if smissing:
+            failures.append(
+                f"spec-faulted: plan never fired at {smissing} — the "
+                "scenario lost coverage of those sites")
+        sdiverged = [i for i, (a, b) in enumerate(zip(sbase, sfault))
+                     if a != b]
+        if sdiverged:
+            failures.append(
+                f"spec-faulted: requests {sdiverged} diverged from the "
+                "fault-free speculative run — mid-verify rollback or "
+                "preempt→restore is not token-preserving")
+        elif not smissing:
+            print("chaos-serving: mid-verify + draft-proposer faults "
+                  "and a mid-decode preemption absorbed on the "
+                  "speculative engine: outputs token-identical, "
+                  "0 compiles, all blocks reclaimed")
     finally:
         obs.disable()
 

@@ -132,7 +132,7 @@ def summarize(events):
                     "cached_tokens": 0, "span_tokens": 0,
                     "preempts": 0, "restores": 0, "swapped_pages": 0,
                     "sheds": defaultdict(int), "isolated": 0,
-                    "tenants": defaultdict(int)},
+                    "tenants": defaultdict(int), "spec_errors": 0},
         # DP replica routing (docs/SERVING.md "Sharded serving"):
         # per-replica routed/affinity counts from serve_route events,
         # failures/requeues from serve_replica_fail
@@ -204,13 +204,23 @@ def summarize(events):
             rp["requeued"] += e.get("moved") or 0
         elif kind == "serve_trace":
             s = e.get("summary") or {}
+            # per-request speculative acceptance rides the retire event
+            # of the timeline (engine._emit; zero for spec-off engines)
+            retire = next((ev for ev in (e.get("events") or [])
+                           if ev.get("phase") == "retire"), {})
             agg["traces"].append({"tenant": e.get("tenant"),
                                   "queue_ms": s.get("queue_ms"),
                                   "prefill_ms": s.get("prefill_ms"),
                                   "decode_ms": s.get("decode_ms"),
                                   "wall_ms": s.get("wall_ms"),
                                   "decode_tokens": s.get("decode_tokens"),
-                                  "preempts": s.get("preempts") or 0})
+                                  "preempts": s.get("preempts") or 0,
+                                  "spec_proposed":
+                                      retire.get("spec_proposed"),
+                                  "spec_accepted":
+                                      retire.get("spec_accepted")})
+        elif kind == "serve_spec_error":
+            agg["serving"]["spec_errors"] += 1
         elif kind == "serve_slo_capture":
             agg["slo_captures"].append(e)
         elif kind == "serve_step":
@@ -423,6 +433,24 @@ def render(agg, malformed=0):
             lines.append(f"| ragged occupancy p50 / p95 | "
                          f"{fmt(occ.get('p50'))} / {fmt(occ.get('p95'))} "
                          f"({sv['span_tokens']} span tokens) |")
+        # speculative decoding (docs/SERVING.md "Speculative decoding"):
+        # acceptance-rate column from the serve.spec.* counters, accept
+        # length distribution from the histogram
+        spec_prop = m.get("serve.spec.proposed") or 0
+        spec_acc = m.get("serve.spec.accepted") or 0
+        spec_err = m.get("serve.spec.draft_errors") or sv["spec_errors"]
+        if spec_prop:
+            al = m.get("serve.spec.accept_len") or {}
+            lines.append(f"| spec drafts proposed / accepted | "
+                         f"{spec_prop} / {spec_acc} "
+                         f"({spec_acc / spec_prop:.3f}) |")
+            lines.append(f"| spec accept len p50 / p95 | "
+                         f"{fmt(al.get('p50'))} / {fmt(al.get('p95'))} |")
+        if spec_err:
+            # NOT nested under spec_prop: a run where drafting is
+            # fully broken (errors > 0, proposed == 0) must still
+            # surface the one signal that says so
+            lines.append(f"| spec draft errors | {spec_err} |")
         # front-door robustness columns (docs/SERVING.md "Front door"):
         # preemption/swap volume, shed reasons, isolation count, and
         # per-tenant attribution — only when the run exercised them
@@ -618,6 +646,13 @@ def main(argv=None) -> int:
             "sheds": dict(sorted(sv["sheds"].items())),
             "isolated_failures": sv["isolated"],
             "tenants": dict(sorted(sv["tenants"].items())),
+            "spec_proposed": m.get("serve.spec.proposed") or 0,
+            "spec_accepted": m.get("serve.spec.accepted") or 0,
+            "spec_accept_rate": (
+                round((m.get("serve.spec.accepted") or 0)
+                      / m["serve.spec.proposed"], 3)
+                if m.get("serve.spec.proposed") else None),
+            "spec_draft_errors": m.get("serve.spec.draft_errors") or 0,
         }
     if agg["replicas"]:
         summary["replicas"] = {
