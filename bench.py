@@ -341,6 +341,38 @@ def main():
         except Exception as e:  # noqa: BLE001
             extra["serve_spec_error"] = f"{type(e).__name__}: {e}"[:300]
 
+        # disaggregated serving (docs/SERVING.md "Disaggregated
+        # serving"): bursty long-prompt admission against 1 prefill +
+        # N decode replicas — decode tok/s (busy-time projection)
+        # scaling with N while admitted-TTFT p95 stays flat vs the
+        # 1-decode configuration.  Same CPU-plumbing / TPU-numbers
+        # split and non-fatality as above.
+        try:
+            from decode_bench import bench_serve_disagg
+            with contextlib.redirect_stdout(sys.stderr):
+                if on_tpu:
+                    r = bench_serve_disagg(n_decode=2, max_batch=8,
+                                           kv_cache_dtype="int8")
+                else:
+                    r = bench_serve_disagg(preset="tiny", n_decode=2,
+                                           max_batch=4, n_requests=10,
+                                           prompt_lens=(24, 33, 28, 30),
+                                           max_new=24, page_size=8)
+            pre = "serve_disagg" if on_tpu else "serve_disagg_cpu"
+            extra[f"{pre}_decode_tok_s"] = r["decode_tok_s"]
+            extra[f"{pre}_vs_1_decode"] = r["vs_1_decode"]
+            extra[f"{pre}_ttft_p95_ms"] = r["ttft_p95_ms"]
+            extra[f"{pre}_detail"] = {
+                k: r[k] for k in ("n_decode", "requests", "kv",
+                                  "gen_tokens", "wall_s", "handoffs",
+                                  "xfer_bytes",
+                                  "ttft_p95_1_decode_ms",
+                                  "ttft_p95_colocated_ms",
+                                  "decode_tok_s_1_decode",
+                                  "colocated_tok_s")}
+        except Exception as e:  # noqa: BLE001
+            extra["serve_disagg_error"] = f"{type(e).__name__}: {e}"[:300]
+
         # sharded serving (docs/SERVING.md "Sharded serving"): the
         # TP-partitioned engine and the DP replica router need >= 2
         # devices (a multi-chip slice, or the forced virtual CPU mesh

@@ -135,6 +135,12 @@ class TCPStore:
     socket and re-attempts under the policy (a blip in the master's
     network must cost a heartbeat, not the job).  ``store.set`` /
     ``store.get`` are registered fault-injection sites.
+
+    ``set``/``get`` also take a per-call ``timeout=`` override on the
+    client socket: one store serves both sub-second heartbeats and
+    multi-megabyte KV-page transfer chunks (``serving/disagg.py``), and
+    the big payloads need a longer deadline than the liveness probes
+    without reconfiguring (or duplicating) the store client.
     """
 
     def __init__(self, endpoint: str, is_master: bool = False,
@@ -220,13 +226,19 @@ class TCPStore:
             return attempt()
         return self.retry.run(attempt, site=site)
 
-    def set(self, key: str, value: bytes) -> None:
-        self._resilient("store.set",
-                        lambda: self._call("set", key.encode(), value))
+    def set(self, key: str, value: bytes,
+            timeout: Optional[float] = None) -> None:
+        self._resilient(
+            "store.set",
+            lambda: self._call("set", key.encode(), value,
+                               sock_timeout=timeout))
 
-    def get(self, key: str) -> Optional[bytes]:
-        r = self._resilient("store.get",
-                            lambda: self._call("get", key.encode()))
+    def get(self, key: str,
+            timeout: Optional[float] = None) -> Optional[bytes]:
+        r = self._resilient(
+            "store.get",
+            lambda: self._call("get", key.encode(),
+                               sock_timeout=timeout))
         return r[1] if r[0] == b"ok" else None
 
     def add(self, key: str, amount: int = 1) -> int:

@@ -22,11 +22,17 @@ Identity and propagation:
   migrated state keeps feeding the same timeline.
 
 Phase accounting is exact by construction: a trace is always in exactly
-one of the phases ``queue`` / ``prefill`` / ``decode``; every transition
-closes the current segment at the same clock read that opens the next,
-so ``queue_ms + prefill_ms + decode_ms == wall_ms`` to float precision.
-Transitions observe the phase histograms ``serve.queue_ms`` (per
-queue-wait episode), ``serve.prefill_ms`` (once, at first token) and
+one of the phases ``queue`` / ``prefill`` / ``xfer`` / ``decode``; every
+transition closes the current segment at the same clock read that opens
+the next, so ``queue_ms + prefill_ms + xfer_ms + decode_ms == wall_ms``
+to float precision.  The ``xfer`` phase is the disaggregated-serving
+handoff window (docs/SERVING.md "Disaggregated serving"): a prefill
+replica enters it at first token when the request will stream its KV
+pages to a decode replica, and the transfer transition back to ``queue``
+closes it — colocated serving never enters it, so its accumulator stays
+zero.  Transitions observe the phase histograms ``serve.queue_ms`` (per
+queue-wait episode), ``serve.prefill_ms`` (once, at first token),
+``serve.xfer_ms`` (per handoff episode) and
 ``serve.decode_ms_per_token`` (at retire), plus their
 ``serve.tenant[<t>].*`` per-tenant aggregates.
 
@@ -59,7 +65,7 @@ from . import _state
 __all__ = ["RequestTrace", "RequestTracer", "SLOCapture", "current_trace_id",
            "new_trace_id", "trace_context"]
 
-_PHASES = ("queue", "prefill", "decode")
+_PHASES = ("queue", "prefill", "xfer", "decode")
 _ids = itertools.count()
 
 # the cross-boundary propagation channel: a caller (HTTP handler, test,
@@ -93,9 +99,10 @@ class RequestTrace:
 
     __slots__ = ("trace_id", "request_id", "tenant", "t0", "_p0",
                  "events", "phase", "_phase_t", "queue_ms", "prefill_ms",
-                 "decode_ms", "decode_tokens", "prefill_chunks",
-                 "preempts", "done", "finish_reason", "dropped",
-                 "_prefill_obs", "max_events")
+                 "xfer_ms", "decode_ms", "decode_tokens",
+                 "prefill_chunks", "preempts", "handoffs", "done",
+                 "finish_reason", "dropped", "_prefill_obs",
+                 "max_events")
 
     def __init__(self, trace_id: str, request_id: str,
                  tenant: Optional[str], p_now: float,
@@ -110,10 +117,12 @@ class RequestTrace:
         self._phase_t = p_now
         self.queue_ms = 0.0
         self.prefill_ms = 0.0
+        self.xfer_ms = 0.0
         self.decode_ms = 0.0
         self.decode_tokens = 0
         self.prefill_chunks = 0
         self.preempts = 0
+        self.handoffs = 0            # prefill→decode replica transfers
         self.done = False
         self.finish_reason: Optional[str] = None
         self.dropped = 0             # events beyond max_events
@@ -143,6 +152,8 @@ class RequestTrace:
             self.queue_ms += seg_ms
         elif closed == "prefill":
             self.prefill_ms += seg_ms
+        elif closed == "xfer":
+            self.xfer_ms += seg_ms
         elif closed == "decode":
             self.decode_ms += seg_ms
         self.phase = phase
@@ -156,16 +167,21 @@ class RequestTrace:
     def summary(self) -> dict:
         q = round(self.queue_ms, 3)
         p = round(self.prefill_ms, 3)
+        x = round(self.xfer_ms, 3)
         d = round(self.decode_ms, 3)
         # wall from the ROUNDED parts: the reported invariant
-        # queue + prefill + decode == wall holds exactly as printed
+        # queue + prefill + xfer + decode == wall holds exactly as
+        # printed (xfer is 0.0 outside disaggregated serving, so the
+        # colocated three-phase sum is unchanged)
         return {"queue_ms": q,
                 "prefill_ms": p,
+                "xfer_ms": x,
                 "decode_ms": d,
-                "wall_ms": round(q + p + d, 3),
+                "wall_ms": round(q + p + x + d, 3),
                 "decode_tokens": self.decode_tokens,
                 "prefill_chunks": self.prefill_chunks,
                 "preempts": self.preempts,
+                "handoffs": self.handoffs,
                 "done": self.done,
                 "reason": self.finish_reason,
                 "dropped_events": self.dropped}
@@ -261,6 +277,8 @@ class RequestTracer:
             closed, seg_ms = t.to_phase(phase, now)
             if event == "preempt":
                 t.preempts += 1
+            if phase == "xfer":
+                t.handoffs += 1
             t.add(event or phase, now, closed=closed,
                   ms=round(seg_ms, 3), **attrs)
             reg = self._reg
@@ -273,6 +291,14 @@ class RequestTracer:
                 if t.tenant:
                     reg.histogram(
                         f"serve.tenant[{t.tenant}].queue_ms").observe(
+                            seg_ms)
+            if closed == "xfer":
+                # one observation per handoff EPISODE (first token →
+                # pages landed on the decode replica's queue)
+                reg.histogram("serve.xfer_ms").observe(seg_ms)
+                if t.tenant:
+                    reg.histogram(
+                        f"serve.tenant[{t.tenant}].xfer_ms").observe(
                             seg_ms)
             if phase == "decode" and closed == "prefill" \
                     and not t._prefill_obs:

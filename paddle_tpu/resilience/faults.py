@@ -19,7 +19,8 @@ Spec grammar (code or the ``PDTPU_FAULTS`` env var)::
     entry   = site "@" index ["x" times] [":" exc]
     site    = ckpt.save | ckpt.load | collective | step | store.get | store.set
             | serve.admit | serve.prefill | serve.step | serve.cow | serve.swap
-            | serve.route | serve.replica
+            | serve.route | serve.replica | serve.spec
+            | serve.xfer.put | serve.xfer.get
     index   = 0-based per-site call counter value at which firing starts
     times   = number of consecutive calls that fire (default 1)
     exc     = InjectedFault | RuntimeError | OSError | ConnectionError
@@ -63,10 +64,18 @@ __all__ = ["SITES", "InjectedFault", "FaultPlan", "FaultInjector",
 #: for the step — never the request; a fault during VERIFY is the
 #: ``serve.step`` site (per-slot decode bookkeeping), rolled back to
 #: the pre-span snapshot like any other isolated failure.
+#: ``serve.xfer.put`` / ``serve.xfer.get`` fire per CHUNK of a
+#: disaggregated KV-page transfer (``serving/disagg.py KVTransport``):
+#: both are wrapped in the transport's ``RetryPolicy``, so a transient
+#: fault becomes a logged retry; exhausting the retries is a HARD
+#: transfer failure and the replica set degrades that request to a
+#: fresh re-prefill on the destination (the ``serving-disagg`` CI
+#: gate's contract — greedy outputs stay token-identical either way).
 SITES = ("ckpt.save", "ckpt.load", "collective", "step",
          "store.get", "store.set",
          "serve.admit", "serve.prefill", "serve.step", "serve.cow",
-         "serve.swap", "serve.route", "serve.replica", "serve.spec")
+         "serve.swap", "serve.route", "serve.replica", "serve.spec",
+         "serve.xfer.put", "serve.xfer.get")
 
 
 class InjectedFault(RuntimeError):

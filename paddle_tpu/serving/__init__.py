@@ -28,6 +28,16 @@ token-identical to single-chip, zero-recompile contract intact);
 least-loaded routing and replica-failure evacuation through the
 preempt→restore path.
 
+Disaggregated serving (docs/SERVING.md "Disaggregated serving"):
+``DisaggReplicaSet`` splits the fleet into ``Engine(role="prefill")``
+replicas (retire at prefill-complete: first token emitted, pages
+swapped out) and ``Engine(role="decode")`` replicas that resume from a
+transferred ``KVHandout`` — pages stream over a ``KVTransport``
+(in-process ``LoopbackTransport``, or ``StoreTransport`` over the
+TCPStore for multi-host) with chunked crc-verified, retried I/O — so
+TTFT and aggregate tok/s scale on independent axes behind the same
+FrontDoor.
+
 Usage::
 
     from paddle_tpu import serving
@@ -49,6 +59,9 @@ from __future__ import annotations
 
 from .block_allocator import (BlockAllocator, PagedKVCache,  # noqa: F401
                               PrefixCache, SwapManager)
+from .disagg import (DisaggReplicaSet, HeartbeatMonitor,  # noqa: F401
+                     KVHandout, KVTransport, LoopbackTransport,
+                     StoreTransport, TransferError)
 from .distributed import (EngineReplicaSet, replica_meshes,  # noqa: F401
                           serving_mesh)
 from .engine import Engine, TokenEvent  # noqa: F401

@@ -38,6 +38,8 @@ from __future__ import annotations
 
 import collections
 import hashlib
+import json
+import struct
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -393,6 +395,53 @@ class SwapManager:
     @staticmethod
     def payload_nbytes(host) -> int:
         return sum(int(a.nbytes) for layer in host for a in layer)
+
+    @staticmethod
+    def payload_to_bytes(host) -> bytes:
+        """Frame a ``swap_out`` payload as one bytes blob: a
+        length-prefixed JSON header (per-layer array dtypes + shapes —
+        int8 pools carry four arrays per layer, the scale rows
+        included) followed by each array's raw bytes in header order.
+        This is the WIRE FORMAT the disaggregated KV transport ships
+        between hosts (``serving/disagg.py``): ``payload_from_bytes``
+        on any engine with the same pool geometry reconstructs a
+        payload whose ``swap_in`` scatters byte-identical rows."""
+        # dtype by NAME, not .str: custom dtypes (ml_dtypes bfloat16)
+        # collapse to an anonymous void under .str ("<V2") and would
+        # not round-trip; the registered name does.  Native byte order
+        # assumed — the tier is homogeneous hosts.
+        header = json.dumps(
+            [[{"dtype": np.dtype(a.dtype).name, "shape": list(a.shape)}
+              for a in layer] for layer in host]).encode()
+        parts = [struct.pack("<I", len(header)), header]
+        for layer in host:
+            for a in layer:
+                parts.append(np.ascontiguousarray(a).tobytes())
+        return b"".join(parts)
+
+    @staticmethod
+    def payload_from_bytes(data: bytes):
+        """Inverse of :meth:`payload_to_bytes`.  The returned arrays are
+        read-only views over ``data`` (``swap_in`` only reads them) —
+        copy before mutating."""
+        (hlen,) = struct.unpack_from("<I", data, 0)
+        metas = json.loads(data[4:4 + hlen].decode())
+        host, off = [], 4 + hlen
+        for layer in metas:
+            rows = []
+            for m in layer:
+                dt = np.dtype(m["dtype"])
+                n = int(np.prod(m["shape"])) if m["shape"] else 1
+                a = np.frombuffer(data, dtype=dt, count=n,
+                                  offset=off).reshape(m["shape"])
+                off += n * dt.itemsize
+                rows.append(a)
+            host.append(tuple(rows))
+        if off != len(data):
+            raise ValueError(
+                f"swap payload framing mismatch: header describes {off} "
+                f"bytes, blob carries {len(data)}")
+        return host
 
     def swap_out(self, block_ids: Sequence[int]):
         """Copy ``block_ids``'s rows from every layer's pools to host

@@ -1,0 +1,842 @@
+"""Disaggregated serving: prefill/decode role specialization with
+KV-page streaming over a cluster tier (docs/SERVING.md "Disaggregated
+serving").
+
+The colocated stack runs both phases in every replica, so a burst of
+long prompts stalls every decode slot behind prefill compute.  This
+module splits the fleet: ``role="prefill"`` engines retire each request
+at prefill-complete (first token sampled and emitted — TTFT stops on
+the prefill tier — pages swapped to host, slot freed) and
+``role="decode"`` engines resume the request from a transferred
+:class:`KVHandout` through the existing restore path, so TTFT and
+aggregate tok/s scale on INDEPENDENT axes.  The transfer primitive is
+the one PR 6 built: ``SwapManager``'s fixed-shape compiled
+gather/scatter already turns "move a request between hosts" into "ship
+its KV pages as host-RAM bytes" — this module only frames, verifies,
+and routes those bytes.
+
+Three layers:
+
+- :class:`KVHandout` — the wire unit: one request's identity (prompt,
+  budget, sampling seed, trace id) plus its resume state (``kv_len``,
+  pending first token, emitted ids) plus the swapped page payload
+  (``SwapManager.payload_to_bytes`` framing — int8 scale rows
+  included), round-tripping through bytes so any engine with the same
+  pool geometry restores byte-identical KV.
+- :class:`KVTransport` — chunked puts with per-chunk AND whole-payload
+  crc32 verification on receive, ``RetryPolicy``-wrapped I/O over the
+  ``serve.xfer.put`` / ``serve.xfer.get`` fault sites.  Two
+  implementations: :class:`LoopbackTransport` (in-process dict — tests
+  and single-host sets) and :class:`StoreTransport` (TCPStore-keyed —
+  the multi-host tier, using the store client's per-call ``timeout=``
+  override so multi-megabyte page chunks get a longer deadline than
+  heartbeats).
+- :class:`DisaggReplicaSet` — duck-types the ``EngineReplicaSet``
+  surface behind the unchanged FrontDoor: admissions route to the
+  least-loaded prefill replica (prefix affinity probes the prefill
+  tier's caches), handoffs stream to the decode replica with the most
+  free blocks, trace ids and exact phase accounting survive the hop
+  (the transfer is the ``xfer`` trace segment between ``prefill`` and
+  the decode-side ``queue`` wait).  A hard transfer failure degrades
+  that request to a fresh re-prefill on the decode replica — greedy
+  outputs regenerate token-identical, exactly like the DP evacuation
+  fallback.  Replica failure is role-aware: a dead decode replica's
+  in-flight requests re-enter the handoff queue; a dead prefill
+  replica's queued admissions re-route to the surviving prefill tier
+  (or, when a whole tier is gone, the other tier runs colocated).
+  :class:`HeartbeatMonitor` wires the TCPStore heartbeat machinery in:
+  stale or unparsable beats fail the replica through the same
+  evacuation path.
+
+Zero-recompile contract: every path here is host bookkeeping plus the
+already-compiled step/CoW/swap programs — the ``serving-disagg`` CI
+gate churns the set under injected ``serve.xfer.*`` faults and a
+decode-replica kill and demands token-identity with a colocated run
+and zero compiles after warmup.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import json
+import struct
+import time
+import warnings
+import zlib
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import observability as obs
+from ..observability import _state as _obs_state
+from ..resilience import _state as _rs_state
+from ..resilience.retry import DEFAULT_RETRYABLE, RetryPolicy
+from .block_allocator import SwapManager
+from .distributed import EngineReplicaSet
+from .scheduler import Request, RequestState
+
+__all__ = ["DisaggReplicaSet", "HeartbeatMonitor", "KVHandout",
+           "KVTransport", "LoopbackTransport", "StoreTransport",
+           "TransferError"]
+
+
+class TransferError(RuntimeError):
+    """A KV-page transfer chunk is missing or failed its crc32 check.
+    Retryable under the transport's policy (a torn concurrent put may
+    resolve); exhausting the retries is a HARD transfer failure and the
+    replica set degrades the request to a fresh re-prefill."""
+
+
+# ---------------------------------------------------------------------------
+# the wire unit
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class KVHandout:
+    """One request, packaged to move between replicas: identity +
+    resume state + the swapped KV page payload.
+
+    ``payload`` is a ``SwapManager.swap_out`` host payload (per-layer
+    tuples of ``(pages, page, H_kv, D)`` numpy rows; int8 pools carry
+    the two scale arrays per layer too).  ``to_bytes``/``from_bytes``
+    round-trip the whole handout through one bytes blob — the format
+    :class:`KVTransport` ships and the ``serving-disagg`` gate's
+    token-identity leans on.  Host-local fields that cannot ride a wire
+    (the ``on_token`` streaming callback) re-attach at
+    ``Engine.admit_handout``."""
+
+    request_id: str
+    prompt_ids: np.ndarray
+    max_new_tokens: int
+    temperature: float
+    eos_token_id: Optional[int]
+    tenant: Optional[str]
+    trace_id: Optional[str]
+    kv_len: int
+    pending_token: Optional[int]
+    output_ids: List[int]
+    sample_seed: int
+    preempts: int
+    handoffs: int
+    submit_t: float
+    first_token_t: Optional[float]
+    pages: int
+    payload: Optional[list]
+
+    @classmethod
+    def from_state(cls, st: RequestState) -> "KVHandout":
+        """Package a handed-off (swapped) request state."""
+        if st.swapped is None:
+            raise ValueError(
+                f"request {st.request.request_id!r} has no swapped "
+                "payload — only a prefill-complete (or preempted) state "
+                "can be handed out")
+        pages, host = st.swapped
+        req = st.request
+        return cls(
+            request_id=req.request_id,
+            prompt_ids=np.asarray(req.prompt_ids, np.int32),
+            max_new_tokens=int(req.max_new_tokens),
+            temperature=float(req.temperature),
+            eos_token_id=req.eos_token_id,
+            tenant=req.tenant, trace_id=req.trace_id,
+            kv_len=int(st.kv_len), pending_token=st.pending_token,
+            output_ids=list(st.output_ids),
+            sample_seed=int(st.sample_seed), preempts=int(st.preempts),
+            handoffs=int(st.handoffs), submit_t=float(st.submit_t),
+            first_token_t=st.first_token_t,
+            pages=int(pages), payload=host)
+
+    def to_state(self, on_token=None) -> RequestState:
+        """Reconstruct the request state on the receiving engine; the
+        restore path scatters ``payload`` into freshly allocated blocks
+        and decode resumes at ``kv_len`` (scheduler.admit_next)."""
+        req = Request(prompt_ids=self.prompt_ids,
+                      max_new_tokens=self.max_new_tokens,
+                      temperature=self.temperature,
+                      eos_token_id=self.eos_token_id, on_token=on_token,
+                      request_id=self.request_id, tenant=self.tenant)
+        req.trace_id = self.trace_id
+        st = RequestState(req)
+        st.kv_len = int(self.kv_len)
+        st.pending_token = self.pending_token
+        st.output_ids = list(self.output_ids)
+        st.sample_seed = int(self.sample_seed)
+        st.preempts = int(self.preempts)
+        st.handoffs = int(self.handoffs)
+        st.submit_t = float(self.submit_t)
+        st.first_token_t = self.first_token_t
+        # restored pages come back all-private (same rule as preemption)
+        st.swapped = (int(self.pages), self.payload) if self.pages \
+            else None
+        return st
+
+    def to_bytes(self) -> bytes:
+        """One blob: length-prefixed JSON meta, then the prompt's raw
+        int32 bytes, then the ``SwapManager.payload_to_bytes`` frame."""
+        prompt = np.ascontiguousarray(
+            np.asarray(self.prompt_ids, np.int32))
+        blob = SwapManager.payload_to_bytes(self.payload) if self.pages \
+            else b""
+        meta = {"v": 1, "request_id": self.request_id,
+                "max_new_tokens": self.max_new_tokens,
+                "temperature": self.temperature,
+                "eos_token_id": self.eos_token_id,
+                "tenant": self.tenant, "trace_id": self.trace_id,
+                "kv_len": self.kv_len,
+                "pending_token": self.pending_token,
+                "output_ids": list(self.output_ids),
+                "sample_seed": self.sample_seed,
+                "preempts": self.preempts, "handoffs": self.handoffs,
+                "submit_t": self.submit_t,
+                "first_token_t": self.first_token_t,
+                "pages": self.pages,
+                "prompt_len": int(prompt.size),
+                "payload_nbytes": len(blob)}
+        hdr = json.dumps(meta).encode()
+        return b"".join([struct.pack("<I", len(hdr)), hdr,
+                         prompt.tobytes(), blob])
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "KVHandout":
+        (hlen,) = struct.unpack_from("<I", data, 0)
+        meta = json.loads(data[4:4 + hlen].decode())
+        if meta.get("v") != 1:
+            raise ValueError(
+                f"unknown KVHandout format version {meta.get('v')!r}")
+        off = 4 + hlen
+        plen = int(meta["prompt_len"])
+        prompt = np.frombuffer(data, dtype=np.int32, count=plen,
+                               offset=off)
+        off += plen * 4
+        blob = data[off:]
+        if len(blob) != int(meta["payload_nbytes"]):
+            raise TransferError(
+                f"handout framing mismatch: meta promises "
+                f"{meta['payload_nbytes']} payload bytes, blob carries "
+                f"{len(blob)}")
+        payload = SwapManager.payload_from_bytes(blob) if meta["pages"] \
+            else None
+        return cls(
+            request_id=meta["request_id"], prompt_ids=prompt,
+            max_new_tokens=int(meta["max_new_tokens"]),
+            temperature=float(meta["temperature"]),
+            eos_token_id=meta["eos_token_id"], tenant=meta["tenant"],
+            trace_id=meta["trace_id"], kv_len=int(meta["kv_len"]),
+            pending_token=meta["pending_token"],
+            output_ids=[int(t) for t in meta["output_ids"]],
+            sample_seed=int(meta["sample_seed"]),
+            preempts=int(meta["preempts"]),
+            handoffs=int(meta["handoffs"]),
+            submit_t=float(meta["submit_t"]),
+            first_token_t=meta["first_token_t"],
+            pages=int(meta["pages"]), payload=payload)
+
+
+# ---------------------------------------------------------------------------
+# transports
+# ---------------------------------------------------------------------------
+
+class KVTransport:
+    """Chunked, crc-verified, retried KV-page transfer.
+
+    Subclasses provide the byte store (``_put_chunk``/``_get_chunk``/
+    ``_put_meta``/``_get_meta``/``_delete``); this base owns the
+    framing every implementation shares — ``chunk_bytes``-sized pieces,
+    each framed as ``crc32 + length + bytes`` and verified on receive
+    (a corrupt chunk raises :class:`TransferError` and re-fetches under
+    the retry policy), plus a whole-payload crc in the meta record so a
+    reassembly bug can never hand the engine silently wrong pages.  The
+    meta record lands LAST on put, so a concurrent getter never
+    observes a half-written transfer.  Every chunk I/O runs through the
+    ``serve.xfer.put`` / ``serve.xfer.get`` fault sites inside the
+    ``RetryPolicy`` (default: 3 attempts, crc failures retryable), so
+    an injected or transient fault is a logged retry and exhaustion is
+    the hard failure the replica set degrades on."""
+
+    def __init__(self, *, chunk_bytes: int = 1 << 20,
+                 retry: Optional[RetryPolicy] = None):
+        if chunk_bytes < 16:
+            raise ValueError(
+                f"chunk_bytes must be >= 16, got {chunk_bytes}")
+        self.chunk_bytes = int(chunk_bytes)
+        self.retry = retry if retry is not None else RetryPolicy(
+            max_attempts=3, backoff_s=0.01,
+            retryable=DEFAULT_RETRYABLE + (TransferError,))
+        self.puts = 0            # lifetime completed transfers out
+        self.gets = 0            # lifetime completed transfers in
+        self.bytes_out = 0
+        self.bytes_in = 0
+        self.crc_errors = 0      # chunks that failed verification
+
+    # -- the byte store (subclass responsibility) --------------------------
+
+    def _put_chunk(self, key: str, i: int, framed: bytes) -> None:
+        raise NotImplementedError
+
+    def _get_chunk(self, key: str, i: int) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def _put_meta(self, key: str, meta: bytes) -> None:
+        raise NotImplementedError
+
+    def _get_meta(self, key: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def _delete(self, key: str, chunks: int) -> None:
+        raise NotImplementedError
+
+    # -- framing -----------------------------------------------------------
+
+    def put(self, key: str, data: bytes) -> int:
+        """Stream ``data`` under ``key`` in verified chunks; returns the
+        chunk count.  Meta lands last."""
+        n = max(1, -(-len(data) // self.chunk_bytes))
+        for i in range(n):
+            blob = data[i * self.chunk_bytes:(i + 1) * self.chunk_bytes]
+            framed = struct.pack("<II", zlib.crc32(blob), len(blob)) + blob
+
+            def attempt(i=i, framed=framed):
+                fi = _rs_state.FAULTS[0]
+                if fi is not None:
+                    fi("serve.xfer.put")
+                self._put_chunk(key, i, framed)
+
+            self.retry.run(attempt, site="serve.xfer.put")
+        meta = json.dumps({"chunks": n, "nbytes": len(data),
+                           "crc32": zlib.crc32(data)}).encode()
+
+        def put_meta():
+            fi = _rs_state.FAULTS[0]
+            if fi is not None:
+                fi("serve.xfer.put")
+            self._put_meta(key, meta)
+
+        self.retry.run(put_meta, site="serve.xfer.put")
+        self.puts += 1
+        self.bytes_out += len(data)
+        reg = obs.get_registry()
+        if reg is not None:
+            reg.counter("serve.xfer.puts").inc()
+            reg.counter("serve.xfer.bytes_out").inc(len(data))
+        return n
+
+    def get(self, key: str, *, delete: bool = True) -> bytes:
+        """Reassemble ``key``'s payload, verifying every chunk's crc32
+        and the whole-payload crc; ``delete`` reclaims the store entry
+        once the bytes are safely out."""
+        def get_meta():
+            fi = _rs_state.FAULTS[0]
+            if fi is not None:
+                fi("serve.xfer.get")
+            m = self._get_meta(key)
+            if m is None:
+                raise TransferError(f"transfer {key!r}: no meta record")
+            return m
+
+        meta = json.loads(self.retry.run(get_meta,
+                                         site="serve.xfer.get").decode())
+        parts = []
+        for i in range(int(meta["chunks"])):
+
+            def attempt(i=i):
+                fi = _rs_state.FAULTS[0]
+                if fi is not None:
+                    fi("serve.xfer.get")
+                framed = self._get_chunk(key, i)
+                if framed is None:
+                    raise TransferError(
+                        f"transfer {key!r}: chunk {i} missing")
+                crc, ln = struct.unpack_from("<II", framed, 0)
+                blob = framed[8:]
+                if len(blob) != ln or zlib.crc32(blob) != crc:
+                    self.crc_errors += 1
+                    reg = obs.get_registry()
+                    if reg is not None:
+                        reg.counter("serve.xfer.crc_errors").inc()
+                    raise TransferError(
+                        f"transfer {key!r}: chunk {i} failed crc32 "
+                        "verification")
+                return blob
+
+            parts.append(self.retry.run(attempt, site="serve.xfer.get"))
+        data = b"".join(parts)
+        if len(data) != int(meta["nbytes"]) \
+                or zlib.crc32(data) != int(meta["crc32"]):
+            raise TransferError(
+                f"transfer {key!r}: reassembled payload failed the "
+                "whole-blob crc32 check")
+        if delete:
+            self._delete(key, int(meta["chunks"]))
+        self.gets += 1
+        self.bytes_in += len(data)
+        reg = obs.get_registry()
+        if reg is not None:
+            reg.counter("serve.xfer.gets").inc()
+            reg.counter("serve.xfer.bytes_in").inc(len(data))
+        return data
+
+    def discard(self, key: str, nbytes: int) -> None:
+        """Best-effort cleanup of an ABANDONED transfer (a hard put/get
+        failure): delete the meta record and every chunk an
+        ``nbytes``-sized payload could have written.  Without this, a
+        half-put transfer's multi-megabyte chunks would pin the store's
+        RAM forever — keys are unique per attempt, so nothing ever
+        overwrites them."""
+        chunks = max(1, -(-int(nbytes) // self.chunk_bytes))
+        try:
+            self._delete(key, chunks)
+        except Exception:  # noqa: BLE001 — cleanup must never mask the
+            pass           # failure that got us here
+
+    def stats(self) -> Dict[str, int]:
+        return {"puts": self.puts, "gets": self.gets,
+                "bytes_out": self.bytes_out, "bytes_in": self.bytes_in,
+                "crc_errors": self.crc_errors}
+
+
+class LoopbackTransport(KVTransport):
+    """In-process transport: the byte store is a dict.  Tests and
+    single-host disaggregated sets — the full framing (chunking, crc,
+    retries, fault sites) still runs, so loopback exercises the same
+    wire format the store transport ships."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self._blobs: Dict[tuple, bytes] = {}
+
+    def _put_chunk(self, key, i, framed):
+        self._blobs[(key, "c", i)] = framed
+
+    def _get_chunk(self, key, i):
+        return self._blobs.get((key, "c", i))
+
+    def _put_meta(self, key, meta):
+        self._blobs[(key, "m")] = meta
+
+    def _get_meta(self, key):
+        return self._blobs.get((key, "m"))
+
+    def _delete(self, key, chunks):
+        self._blobs.pop((key, "m"), None)
+        for i in range(chunks):
+            self._blobs.pop((key, "c", i), None)
+
+    def __len__(self):
+        return len(self._blobs)
+
+
+class StoreTransport(KVTransport):
+    """TCPStore-keyed transport: the multi-host tier.  Chunks land
+    under ``<prefix>/<key>/c<i>`` and the meta record under
+    ``<prefix>/<key>/meta`` on the rendezvous store every host already
+    reaches.  Page chunks are megabytes where heartbeats are bytes, so
+    every store op uses the client's per-call ``timeout=`` override
+    (``op_timeout_s``) instead of stretching the store's default
+    deadline for everyone."""
+
+    def __init__(self, store, *, prefix: str = "serve/xfer",
+                 op_timeout_s: float = 30.0, **kw):
+        super().__init__(**kw)
+        self.store = store
+        self.prefix = prefix.rstrip("/")
+        self.op_timeout_s = float(op_timeout_s)
+
+    def _k(self, key: str, part: str) -> str:
+        return f"{self.prefix}/{key}/{part}"
+
+    def _put_chunk(self, key, i, framed):
+        self.store.set(self._k(key, f"c{i}"), framed,
+                       timeout=self.op_timeout_s)
+
+    def _get_chunk(self, key, i):
+        return self.store.get(self._k(key, f"c{i}"),
+                              timeout=self.op_timeout_s)
+
+    def _put_meta(self, key, meta):
+        self.store.set(self._k(key, "meta"), meta,
+                       timeout=self.op_timeout_s)
+
+    def _get_meta(self, key):
+        return self.store.get(self._k(key, "meta"),
+                              timeout=self.op_timeout_s)
+
+    def _delete(self, key, chunks):
+        self.store.delete(self._k(key, "meta"))
+        for i in range(chunks):
+            self.store.delete(self._k(key, f"c{i}"))
+
+
+# ---------------------------------------------------------------------------
+# heartbeats (the TCPStore liveness half of cross-role health)
+# ---------------------------------------------------------------------------
+
+class HeartbeatMonitor:
+    """TCPStore-keyed replica liveness: each replica's host loop writes
+    ``<prefix>/<i>`` with a monotonic timestamp (:meth:`beat`);
+    :meth:`stale` names the replicas whose beat is older than
+    ``deadline_s`` — or unparsable, which counts as dead (the
+    ElasticManager rule: garbage from a dying process is not a
+    heartbeat).  ``DisaggReplicaSet.attach_heartbeats`` reaps stale
+    replicas through the same ``_fail_replica`` evacuation path an
+    in-step exception takes, so a host that stops beating loses its
+    requests to the survivors, not to the void."""
+
+    def __init__(self, store, n_replicas: int, *,
+                 prefix: str = "serve/hb", deadline_s: float = 10.0,
+                 interval_s: Optional[float] = None,
+                 clock=time.monotonic):
+        self.store = store
+        self.n = int(n_replicas)
+        self.prefix = prefix.rstrip("/")
+        self.deadline_s = float(deadline_s)
+        # how often the set runs a beat+reap round: stepping is
+        # per-token cadence and a round costs 2N store RPCs, so probing
+        # every step would turn liveness into hot-path I/O — a third of
+        # the deadline keeps detection latency identical at a fraction
+        # of the traffic (tests pass 0.0 for every-step rounds)
+        self.interval_s = float(deadline_s) / 3.0 if interval_s is None \
+            else float(interval_s)
+        self.clock = clock
+
+    def beat(self, i: int) -> None:
+        self.store.set(f"{self.prefix}/{i}",
+                       f"{self.clock():.6f}".encode())
+
+    def stale(self) -> List[int]:
+        """Replica indices whose beat is missing-after-first-beat is NOT
+        stale (a replica that never registered is simply not monitored
+        yet); present-but-old or unparsable IS."""
+        out = []
+        now = self.clock()
+        for i in range(self.n):
+            raw = self.store.get(f"{self.prefix}/{i}")
+            if raw is None:
+                continue
+            try:
+                ts = float(raw.decode())
+            except (ValueError, UnicodeDecodeError):
+                out.append(i)        # unparsable == dead
+                continue
+            if now - ts > self.deadline_s:
+                out.append(i)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# the disaggregated replica set
+# ---------------------------------------------------------------------------
+
+class DisaggReplicaSet(EngineReplicaSet):
+    """Prefill tier + decode tier behind one Engine-shaped surface.
+
+    ``prefill`` engines must be ``role="prefill"``, ``decode`` engines
+    ``role="decode"``; all share geometry (the base class check — a
+    handout must restore into any decode replica's pools).  The
+    FrontDoor drives this exactly like an ``EngineReplicaSet``: its
+    tenancy/shed/SLO policy is unchanged, only placement differs —
+
+    - **admission** routes to the least-loaded healthy PREFILL replica
+      (prefix affinity probes the prefill tier's caches, so a repeated
+      system prompt pins to the replica already holding its pages);
+    - **handoff**: after each step, every prefill-complete state
+      streams through ``transport`` (put → get → crc verify) to the
+      healthy decode replica with the most free blocks, arriving via
+      ``Engine.admit_handout`` — the ``xfer`` trace segment between
+      prefill and the decode-side queue wait.  A HARD transfer failure
+      (retries exhausted) degrades that request to a fresh re-prefill
+      on the decode replica: greedy outputs regenerate identically,
+      the same trade as DP evacuation's reset path;
+    - **replica failure** is role-aware: a dead decode replica's
+      in-flight requests re-enter the handoff queue (their page
+      payloads already live in host RAM); a dead prefill replica's
+      queued admissions re-route to the surviving prefill tier.  When
+      a whole tier is gone the other tier runs colocated — a
+      prefill-role engine with no decode capacity keeps decoding
+      locally (the ``_handoff_ok`` veto), and with no prefill tier
+      fresh prompts land on decode replicas, whose unified step
+      prefills them just fine.
+    """
+
+    def __init__(self, prefill: Sequence, decode: Sequence, *,
+                 transport: Optional[KVTransport] = None,
+                 prefix_affinity: bool = True):
+        prefill, decode = list(prefill), list(decode)
+        if not prefill or not decode:
+            raise ValueError(
+                "DisaggReplicaSet needs at least one prefill and one "
+                "decode replica")
+        for tier, want in ((prefill, "prefill"), (decode, "decode")):
+            for e in tier:
+                if getattr(e, "role", "both") != want:
+                    raise ValueError(
+                        f"every {want}-tier engine must be built with "
+                        f"role={want!r}, got role={getattr(e, 'role', None)!r} "
+                        "(Engine(role=...))")
+        super().__init__(prefill + decode, prefix_affinity=prefix_affinity)
+        self.n_prefill = len(prefill)
+        self._prefill_idx = tuple(range(len(prefill)))
+        self._decode_idx = tuple(range(len(prefill),
+                                       len(prefill) + len(decode)))
+        self.transport = transport if transport is not None \
+            else LoopbackTransport()
+        # states popped off a prefill engine (or a dead decode replica)
+        # awaiting transfer: drained to empty inside every step(), so
+        # run()'s has_work never races a parked request
+        self._handoff_queue: "collections.deque" = \
+            collections.deque()                  # guarded_by: _lock
+        self._xfer_seq = itertools.count()
+        self.xfers = 0               # lifetime completed transfers
+        self.xfer_failures = 0       # hard failures (degraded to reset)
+        self.xfer_bytes = 0
+        self._hb: Optional[HeartbeatMonitor] = None
+        self._hb_next = 0.0          # next beat+reap round (monitor clock)
+        self._hb_last: Optional[float] = None   # our last beat round
+        for e in prefill:
+            # veto hook: with no healthy decode replica, prefill
+            # engines keep decoding locally instead of parking requests
+            # nobody will ever pick up
+            e._handoff_ok = self._decode_capacity
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def roles(self) -> List[str]:
+        return [r.role for r in self.replicas]
+
+    def disagg_stats(self) -> Dict[str, float]:
+        """Handoff/transfer counters + the transport's own."""
+        out = {"handoffs": sum(self.replicas[i].handoffs
+                               for i in self._prefill_idx),
+               "xfers": self.xfers,
+               "xfer_failures": self.xfer_failures,
+               "xfer_bytes": self.xfer_bytes}
+        for k, v in self.transport.stats().items():
+            out[f"transport_{k}"] = v
+        return out
+
+    # requires-lock: _lock — reads the health map
+    def _decode_capacity(self) -> bool:
+        return any(self._health[i] for i in self._decode_idx)
+
+    # -- routing (admission goes to the prefill tier) ----------------------
+
+    # requires-lock: _lock
+    def _route_candidates(self) -> List[int]:
+        cands = [i for i in self._prefill_idx if self._health[i]]
+        if cands:
+            return cands
+        # the whole prefill tier is down: decode replicas' unified step
+        # can prefill too — degraded colocated mode beats an outage
+        return [i for i in self._decode_idx if self._health[i]]
+
+    # requires-lock: _lock
+    def _pick_decode(self) -> Optional[int]:
+        """The handoff target: healthy decode replica with the most
+        free blocks (pages land there), ties broken by the router's
+        load key."""
+        cands = [i for i in self._decode_idx if self._health[i]]
+        if not cands:
+            return None
+        return min(cands, key=lambda i: (
+            -self.replicas[i].kv.allocator.free_blocks,
+            *self._load_key(i)))
+
+    # -- stepping + handoff draining ---------------------------------------
+
+    # requires-lock: _lock — the loop-thread entry point
+    def step(self) -> List:
+        events = super().step()
+        self._drain_handoffs()
+        if self._hb is not None:
+            self._beat_and_reap()
+        return events
+
+    # requires-lock: _lock
+    def has_work(self) -> bool:
+        return bool(self._handoff_queue) or any(
+            r.has_work() or bool(r.handed_off)
+            for i, r in enumerate(self.replicas) if self._health[i])
+
+    # requires-lock: _lock — drains handed_off/_handoff_queue
+    def _drain_handoffs(self) -> None:
+        """Transfers run SYNCHRONOUSLY inside step(), like the
+        preemption swap I/O they are built from: a slow store op holds
+        the step for its retry budget, so size ``op_timeout_s`` and the
+        transport retry policy for the data plane, not the default
+        store deadline (a future multi-process tier moves this off the
+        step loop entirely — each decode host pulls from the store)."""
+        for i in self._prefill_idx:
+            r = self.replicas[i]
+            while r.handed_off:
+                st = r.handed_off.popleft()
+                r._states.pop(st.request.request_id, None)
+                self._handoff_queue.append((i, st))
+        while self._handoff_queue:
+            src, st = self._handoff_queue.popleft()
+            self._transfer(src, st)
+
+    # requires-lock: _lock — places into _states/_placements
+    def _adopt(self, tgt: int, st, rid: str) -> None:
+        self.replicas[tgt]._states[rid] = st
+        self.replicas[tgt].scheduler.requeue(st)
+        self._placements[rid] = tgt
+
+    # requires-lock: _lock
+    def _transfer(self, src: int, st) -> None:
+        """Stream ONE handed-off state to the decode tier: serialize →
+        chunked put → get + crc verify → ``admit_handout`` on the
+        target.  The round-trip through bytes runs even on loopback —
+        the wire format IS the contract, so the in-process set proves
+        exactly what a multi-host set ships."""
+        rid = st.request.request_id
+        tr = _obs_state.TRACE[0]
+        tgt = self._pick_decode()
+        if tgt is None:
+            # no decode tier left: adopt on any healthy replica and let
+            # it decode locally (its restore path consumes st.swapped)
+            cands = [i for i in range(len(self.replicas))
+                     if self._health[i]]
+            if not cands:
+                raise RuntimeError(
+                    "no healthy replicas left to place a handoff")
+            tgt = min(cands, key=self._load_key)
+            self._adopt(tgt, st, rid)
+            if tr is not None:
+                tr.transition(rid, "queue", event="xfer",
+                              from_replica=src, to_replica=tgt,
+                              degraded="no_decode_replica")
+            return
+        t0 = time.perf_counter()
+        key = f"{rid}/{next(self._xfer_seq)}"
+        on_token = st.request.on_token
+        data = None
+        try:
+            handout = KVHandout.from_state(st)
+            data = handout.to_bytes()
+            self.transport.put(key, data)
+            raw = self.transport.get(key)
+            self.replicas[tgt].admit_handout(raw, on_token=on_token)
+        except Exception as e:  # noqa: BLE001 — hard transfer failure
+            if data is not None:
+                # reclaim whatever the dead transfer left in the store
+                # (half-put chunks, or a full payload whose get failed)
+                self.transport.discard(key, len(data))
+            self.xfer_failures += 1
+            warnings.warn(
+                f"KV transfer for request {rid!r} failed hard "
+                f"({type(e).__name__}: {e}); degrading to a fresh "
+                f"re-prefill on replica {tgt}", RuntimeWarning,
+                stacklevel=3)
+            reg = obs.get_registry()
+            if reg is not None:
+                reg.counter("serve.xfer.failures").inc()
+            obs.emit_event("serve_xfer_fail", id=rid,
+                           from_replica=src, to_replica=tgt,
+                           exc=type(e).__name__, message=str(e)[:200])
+            # the PR-8 evacuation fallback: KV is unrecoverable over
+            # this transport — re-prefill from scratch on the target
+            # (greedy regenerates identical tokens; a streaming
+            # consumer sees the regenerated prefix twice, same caveat
+            # as the DP hard-reset path)
+            self._reset_to_fresh(st)
+            self._adopt(tgt, st, rid)
+            if tr is not None:
+                tr.transition(rid, "queue", event="reset_fresh",
+                              from_replica=src, to_replica=tgt)
+            return
+        ms = (time.perf_counter() - t0) * 1e3
+        self.xfers += 1
+        self.xfer_bytes += len(data)
+        self._placements[rid] = tgt
+        if tr is not None:
+            # closes the xfer segment opened at first token on the
+            # prefill replica; the decode-side queue wait starts here
+            tr.transition(rid, "queue", event="xfer", from_replica=src,
+                          to_replica=tgt, bytes=len(data),
+                          pages=handout.pages)
+        reg = obs.get_registry()
+        if reg is not None:
+            reg.counter("serve.xfer.transfers").inc()
+        obs.emit_event("serve_xfer", id=rid, from_replica=src,
+                       to_replica=tgt, bytes=len(data),
+                       pages=handout.pages, ms=round(ms, 3))
+
+    # -- role-aware failure handling ---------------------------------------
+
+    # requires-lock: _lock
+    def _fail_replica(self, idx: int, exc: Exception) -> None:
+        rep = self.replicas[idx]
+        # parked handoffs survive their replica: the page payloads are
+        # already host-RAM bytes, so they just re-enter the queue
+        while rep.handed_off:
+            st = rep.handed_off.popleft()
+            rep._states.pop(st.request.request_id, None)
+            self._handoff_queue.append((idx, st))
+        super()._fail_replica(idx, exc)
+        # place everything the evacuation queued (including the dead
+        # decode replica's preempted in-flight requests) right away
+        self._drain_handoffs()
+
+    # requires-lock: _lock
+    def _evacuate_waiting(self, idx: int, st, exc, tr) -> None:
+        rid = st.request.request_id
+        if st.swapped is not None and not st.prefilling:
+            # decode-ready state off a dead decode replica: its pages
+            # are host bytes — re-enter the handoff queue and stream to
+            # a surviving decode replica
+            self._handoff_queue.append((idx, st))
+            return
+        # fresh / reset / mid-prefill state: back to the prefill tier
+        cands = [i for i in self._prefill_idx if self._health[i]] or \
+            [i for i in range(len(self.replicas)) if self._health[i]]
+        if not cands:
+            raise RuntimeError(
+                "no healthy replicas left to evacuate onto") from exc
+        tgt = min(cands, key=self._load_key)
+        self._adopt(tgt, st, rid)
+        if tr is not None:
+            tr.point(rid, "migrate", from_replica=idx, to_replica=tgt)
+
+    # -- heartbeats --------------------------------------------------------
+
+    def attach_heartbeats(self, monitor: HeartbeatMonitor
+                          ) -> "DisaggReplicaSet":
+        """Wire TCPStore liveness into the step loop: every step first
+        reaps replicas whose beat went stale (through the same
+        role-aware evacuation as an in-step failure), then beats for
+        the replicas this process drives."""
+        if monitor.n != len(self.replicas):
+            raise ValueError(
+                f"monitor covers {monitor.n} replicas, the set has "
+                f"{len(self.replicas)}")
+        self._hb = monitor
+        return self
+
+    # requires-lock: _lock
+    def _beat_and_reap(self) -> None:
+        hb = self._hb
+        now = hb.clock()
+        if now < self._hb_next:
+            return                   # rate-limited: see interval_s
+        self._hb_next = now + hb.interval_s
+        # self-stall guard: when THIS driver also writes the beats (the
+        # in-process set), a step-loop pause longer than the deadline
+        # would make every beat look stale at once and the reap below
+        # would destroy the whole healthy set over a transient GC/host
+        # hiccup.  If WE have not beaten within the deadline, the
+        # staleness is ours — re-beat and let the next round measure.
+        stalled = self._hb_last is not None \
+            and now - self._hb_last > hb.deadline_s
+        if not stalled:
+            for i in hb.stale():
+                if self._health[i]:
+                    self._fail_replica(i, TimeoutError(
+                        f"replica {i} heartbeat stale (>"
+                        f"{hb.deadline_s}s or unparsable)"))
+        for i in range(len(self.replicas)):
+            if self._health[i]:
+                hb.beat(i)
+        self._hb_last = now

@@ -383,6 +383,13 @@ class EngineReplicaSet:
         return [r for i, r in enumerate(self.replicas) if self._health[i]]
 
     # requires-lock: _lock
+    def _route_candidates(self) -> List[int]:
+        """Replica indices admission may route to — every healthy
+        replica here; the disaggregated subclass narrows this to the
+        prefill tier (``serving/disagg.py``)."""
+        return [i for i in range(len(self.replicas)) if self._health[i]]
+
+    # requires-lock: _lock
     def _ttft_p95(self, i: int) -> float:
         win = sorted(self._ttft[i])
         return win[max(0, int(0.95 * len(win)) - 1)] if win else 0.0
@@ -403,7 +410,7 @@ class EngineReplicaSet:
         prompt.  The chained page digests are hashed ONCE here and
         forwarded to the chosen engine's submit, which would otherwise
         re-run the O(prompt) blake2b chain (the PR-5 hash-once rule)."""
-        healthy = [i for i in range(len(self.replicas)) if self._health[i]]
+        healthy = self._route_candidates()
         if not healthy:
             # typed TRANSIENT rejection, not a plain AdmissionError: the
             # front door's pump would shed that as reason="budget" and
@@ -603,22 +610,8 @@ class EngineReplicaSet:
             st = rep.scheduler.waiting.popleft()
             rid = st.request.request_id
             rep._states.pop(rid, None)
-            try:
-                tgt = min((i for i in range(len(self.replicas))
-                           if self._health[i]), key=self._load_key)
-            except ValueError:
-                raise RuntimeError(
-                    "no healthy replicas left to evacuate onto") from exc
-            self.replicas[tgt]._states[rid] = st
-            self.replicas[tgt].scheduler.waiting.append(st)
-            self._placements[rid] = tgt
+            self._evacuate_waiting(idx, st, exc, tr)
             moved += 1
-            if tr is not None:
-                # same trace id before and after: the tracer is keyed by
-                # request id and the id rides Request.trace_id, so the
-                # migrated state keeps feeding the same timeline
-                tr.point(rid, "migrate", from_replica=idx,
-                         to_replica=tgt)
         self.requeued += moved
         reg = obs.get_registry()
         if reg is not None:
@@ -629,6 +622,31 @@ class EngineReplicaSet:
         obs.emit_event("serve_replica_fail", replica=idx,
                        exc=type(exc).__name__, message=str(exc)[:200],
                        moved=moved)
+
+    # requires-lock: _lock
+    def _evacuate_waiting(self, idx: int, st, exc, tr) -> None:
+        """Re-home ONE waiting state popped off failed replica ``idx``
+        (already removed from its ``_states``): move it — host payload
+        and all — to the least-loaded healthy replica, whose restore
+        path scatters the same bytes.  The disaggregated subclass
+        overrides this with role-aware routing (swapped decode work
+        re-enters the handoff queue; fresh prompts re-route to the
+        prefill tier)."""
+        rid = st.request.request_id
+        try:
+            tgt = min((i for i in range(len(self.replicas))
+                       if self._health[i]), key=self._load_key)
+        except ValueError:
+            raise RuntimeError(
+                "no healthy replicas left to evacuate onto") from exc
+        self.replicas[tgt]._states[rid] = st
+        self.replicas[tgt].scheduler.waiting.append(st)
+        self._placements[rid] = tgt
+        if tr is not None:
+            # same trace id before and after: the tracer is keyed by
+            # request id and the id rides Request.trace_id, so the
+            # migrated state keeps feeding the same timeline
+            tr.point(rid, "migrate", from_replica=idx, to_replica=tgt)
 
     @staticmethod
     def _reset_to_fresh(st) -> None:

@@ -253,6 +253,19 @@ class Engine:
     + CoW + swap programs partition under GSPMD — same zero-recompile
     contract, greedy outputs token-identical to the single-chip engine
     (docs/SERVING.md "Sharded serving").
+
+    ``role``: disaggregated serving (docs/SERVING.md "Disaggregated
+    serving").  ``"both"`` (default) is the colocated engine above.
+    ``"prefill"`` retires every request at prefill-complete — the first
+    token is sampled and emitted (TTFT stops on this replica), the KV
+    pages swap to host, the slot frees — and parks the state on
+    ``handed_off`` for a ``serving.DisaggReplicaSet`` (or any driver)
+    to stream to a decode replica.  ``"decode"`` receives transferred
+    ``KVHandout``s via :meth:`admit_handout` and resumes decode at
+    ``kv_len`` through the restore path; its ``add_request`` still
+    accepts fresh prompts (the re-prefill fallback after a hard
+    transfer failure).  All three roles run the SAME compiled step —
+    role changes which host paths fire, never the program.
     """
 
     def __init__(self, model, *, max_batch: int = 8,
@@ -270,12 +283,17 @@ class Engine:
                  weight_quant: Optional[str] = None,
                  slo_capture=None,
                  spec_decode: bool = False,
-                 draft_depth: int = 4):
+                 draft_depth: int = 4,
+                 role: str = "both"):
         if not _paged_supported(model):
             raise NotImplementedError(
                 f"{type(model).__name__} does not support the paged "
                 "serving path (needs supports_paged decoder layers and "
                 "pipeline_stages == 1)")
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'both', 'prefill' or 'decode', got "
+                f"{role!r} (docs/SERVING.md \"Disaggregated serving\")")
         n_layers, kv_heads, head_dim = _kv_geometry(model)
         if page_size == "auto" or prefill_chunk == "auto":
             # tuned serving knobs (tools/tuned_configs.json): resolved
@@ -407,6 +425,22 @@ class Engine:
         # or anything with on_step()): consulted once per non-empty
         # step_finish — None (the default) costs one falsy check
         self._slo_capture = slo_capture
+        # disaggregated serving (docs/SERVING.md "Disaggregated
+        # serving"): a role="prefill" engine RETIRES each request at
+        # prefill-complete — first token sampled and emitted (TTFT stops
+        # here), pages swapped to host, slot freed — parking the state
+        # on `handed_off` for a DisaggReplicaSet (or any driver) to
+        # stream to a decode replica.  A role="decode" engine's work
+        # arrives as transferred KVHandouts via admit_handout(); its
+        # add_request path still accepts fresh prompts, which is the
+        # re-prefill fallback after a hard transfer failure.  _handoff_ok
+        # is an optional veto hook the replica set installs (e.g. "no
+        # healthy decode replica right now" → keep decoding locally).
+        self.role = role
+        self._handoff_ok: Optional[Callable[[], bool]] = None
+        self.handed_off: "collections.deque[RequestState]" = \
+            collections.deque()                  # guarded_by: _lock
+        self.handoffs = 0            # lifetime prefill-complete handoffs
         self._warmed = False
         self._build_fns()
 
@@ -792,6 +826,133 @@ class Engine:
         for pg, key in enumerate(st.page_keys):
             self.prefix_cache.register(key, int(st.table[pg]))
 
+    # -- disaggregated roles (docs/SERVING.md "Disaggregated serving") -----
+
+    def _prepare_handoff(self, st: RequestState, tok: int):
+        """Stage a prefill-complete request's handoff to a decode
+        replica: when this engine is ``role="prefill"``, the request
+        will keep decoding, and the handoff hook (if any) approves,
+        gather its KV pages to host and return ``(pages, payload)`` for
+        :meth:`_commit_handoff`.  Returns None to decode locally — a
+        finishing request, a vetoed handoff (no decode capacity), or a
+        hard swap failure (nothing has mutated yet, so degrading to
+        local decode is free and the request is never lost)."""
+        if self.role != "prefill":
+            return None
+        req = st.request
+        if req.eos_token_id is not None and tok == req.eos_token_id:
+            return None              # finishes right here: plain retire
+        if len(st.output_ids) + 1 >= req.max_new_tokens:
+            return None
+        ok = self._handoff_ok
+        if ok is not None and not ok():
+            return None              # the set vetoed: decode locally
+        pages = -(-st.kv_len // self.page_size)
+        try:
+            ids = [int(b) for b in st.table[:pages]]
+            return pages, self._retry.run(self._swap.swap_out, ids,
+                                          site="serve.swap")
+        except Exception as e:  # noqa: BLE001 — hard swap failure
+            warnings.warn(
+                f"handoff swap-out for request {req.request_id!r} "
+                f"failed ({type(e).__name__}: {e}); decoding locally",
+                RuntimeWarning, stacklevel=3)
+            reg = obs.get_registry()
+            if reg is not None:
+                reg.counter("serve.handoff_failures").inc()
+            return None
+
+    # requires-lock: _lock — parks onto handed_off
+    def _commit_handoff(self, st: RequestState, handoff) -> None:
+        """Retire a prefill-complete request from THIS engine: free its
+        slot and blocks (the prompt pages stay indexed in the prefix
+        cache as evictable capacity — the prefill tier keeps its hit
+        rate), park the swapped state on ``handed_off`` for the replica
+        set to stream to a decode replica.  The state carries the
+        emitted first token as ``pending_token``, so the decode side
+        resumes exactly where a colocated engine would."""
+        pages, host = handoff
+        self.scheduler.release_slot(st)
+        # everything comes back private at restore: for the shared-pages
+        # gauge the borrowed pages count as privatized from here on
+        st.num_cowed = st.num_shared
+        st.swapped = (pages, host)
+        st.handoffs += 1
+        self.handoffs += 1
+        self.handed_off.append(st)
+        reg = obs.get_registry()
+        if reg is not None:
+            reg.counter("serve.handoffs").inc()
+            if pages:
+                reg.counter("serve.swapped_pages").inc(pages)
+        obs.emit_event("serve_handoff", id=st.request.request_id,
+                       tenant=st.request.tenant, pages=pages,
+                       kv_len=st.kv_len, handoffs=st.handoffs)
+
+    # requires-lock: _lock — touches _states
+    def admit_handout(self, handout, *, on_token: Optional[Callable] = None,
+                      head: bool = False) -> str:
+        """Queue a transferred :class:`~paddle_tpu.serving.KVHandout`
+        (bytes straight off a transport, or an already-decoded one) for
+        admission — the disaggregated decode replica's intake path.  The
+        next step with a free slot and enough blocks restores the pages
+        through the compiled swap scatter and decode resumes at
+        ``kv_len``; no token is ever re-prefilled.  ``on_token``
+        re-attaches a host-local streaming callback (callbacks cannot
+        ride the wire format; in-process drivers pass the original)."""
+        from .disagg import KVHandout
+        if isinstance(handout, (bytes, bytearray, memoryview)):
+            handout = KVHandout.from_bytes(bytes(handout))
+        st = handout.to_state(on_token=on_token)
+        req = st.request
+        rid = req.request_id
+        if rid in self._states:
+            raise AdmissionError(
+                f"request_id {rid!r} is already in use by a live or "
+                "retained request")
+        total = int(req.prompt_ids.size) + req.max_new_tokens
+        if total > self.max_seq_len or \
+                self.scheduler.blocks_for(total) > self.kv.num_blocks:
+            raise BudgetUnsatisfiable(
+                f"handout {rid!r} needs {total} positions / "
+                f"{self.scheduler.blocks_for(total)} KV blocks but this "
+                f"engine caps at max_seq_len={self.max_seq_len}, "
+                f"{self.kv.num_blocks} blocks — disaggregated roles "
+                "must share geometry")
+        if st.swapped is not None:
+            _pages, host = st.swapped
+            ok = len(host) == len(self.kv.caches) and all(
+                len(hl) == len(cl) and all(
+                    tuple(h.shape[1:]) == tuple(c.shape[1:])
+                    and np.dtype(h.dtype) == np.dtype(c.dtype)
+                    for h, c in zip(hl, cl))
+                for hl, cl in zip(host, self.kv.caches))
+            if not ok:
+                # a mismatched payload would retrace the swap scatter
+                # (breaking the zero-recompile contract) or silently
+                # corrupt the restored KV — reject before any state lands
+                raise ValueError(
+                    "handout payload geometry does not match this "
+                    "engine's paged pools (page_size / kv heads / "
+                    "head_dim / cache dtype must agree across roles)")
+        self._states[rid] = st
+        self.scheduler.requeue(st, head=head)
+        tr = _obs_state.TRACE[0]
+        if tr is not None:
+            # get-or-create keyed by request id: in-process, the trace
+            # begun at the door/prefill side just continues; on a
+            # separate decode host a fresh timeline begins under the
+            # trace id carried by the handout
+            req.trace_id = tr.begin(rid, tenant=req.tenant,
+                                    trace_id=req.trace_id,
+                                    prompt_len=int(req.prompt_ids.size),
+                                    max_new=req.max_new_tokens)
+        reg = obs.get_registry()
+        if reg is not None:
+            reg.counter("serve.handouts_admitted").inc()
+            reg.gauge("serve.queue_depth").set(self.scheduler.queue_depth())
+        return rid
+
     # requires-lock: _lock — retires into _states/_finished_order/_drain_capture
     def _emit(self, st: RequestState, token: int,
               events: List[TokenEvent]):
@@ -1060,20 +1221,31 @@ class Engine:
                     tok = int(nxt[i]) if nxt.ndim == 1 \
                         else int(nxt[i, n - 1])
                     self._register_prefix(st)
+                    # disaggregated prefill role: stage the handoff
+                    # (swap the pages to host) BEFORE any state
+                    # mutates — a failed swap degrades cleanly to
+                    # local decode, and the trace phase below can
+                    # honestly say which way the request went
+                    handoff = self._prepare_handoff(st, tok)
                     if tr is not None:
                         # prefill→decode transition (closes the
-                        # prefill segment).  A re-completion after a
+                        # prefill segment) — or prefill→xfer when this
+                        # prefill replica hands the request off to a
+                        # decode replica.  A re-completion after a
                         # hard replica reset accumulates under its
                         # own event name, so `first_token` stays
                         # exactly-once per request — same dedupe
                         # marker as the serve_request event below.
                         tr.transition(
-                            st.request.request_id, "decode",
+                            st.request.request_id,
+                            "xfer" if handoff is not None else "decode",
                             event="first_token"
                             if st.first_token_t is None
                             else "re_prefilled")
                     if st.first_token_t is not None:
                         self._emit(st, tok, events)
+                        if handoff is not None and not st.finished:
+                            self._commit_handoff(st, handoff)
                         continue
                     st.first_token_t = time.perf_counter()
                     req = st.request
@@ -1101,6 +1273,8 @@ class Engine:
                         slot=st.slot, blocks=len(st.blocks),
                         cached_tokens=st.cached_tokens)
                     self._emit(st, tok, events)
+                    if handoff is not None and not st.finished:
+                        self._commit_handoff(st, handoff)
                 except Exception as e:  # noqa: BLE001
                     st.kv_len, st.pending_token = snap[0], snap[1]
                     del st.output_ids[snap[2]:]

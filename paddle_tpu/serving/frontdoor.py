@@ -173,11 +173,14 @@ class FrontDoor:
     engine only ever sees work the door already sequenced.
 
     ``engine`` may also be a DP replica set
-    (``serving.distributed.EngineReplicaSet``): the door's policy runs
+    (``serving.distributed.EngineReplicaSet``) or a disaggregated one
+    (``serving.disagg.DisaggReplicaSet``): the door's policy runs
     unchanged over the set's aggregate surface, the set decides WHICH
-    replica each admitted request lands on, and pool-pressure
-    preemption delegates to its per-replica policy (docs/SERVING.md
-    "Sharded serving").
+    replica each admitted request lands on — for the disaggregated set
+    that means the prefill tier, with the prefill→decode handoff
+    happening entirely below this admission surface — and
+    pool-pressure preemption delegates to its per-replica policy
+    (docs/SERVING.md "Sharded serving", "Disaggregated serving").
     """
 
     def __init__(self, engine, *,

@@ -85,8 +85,8 @@ class RequestState:
                  "submit_t", "first_token_t", "finished", "finish_reason",
                  "drained", "num_shared", "num_cowed", "cached_tokens",
                  "borrowed", "cow_spare", "page_keys", "swapped",
-                 "preempts", "sample_seed", "draft", "spec_proposed",
-                 "spec_accepted")
+                 "preempts", "handoffs", "sample_seed", "draft",
+                 "spec_proposed", "spec_accepted")
 
     def __init__(self, request: Request):
         self.request = request
@@ -113,6 +113,8 @@ class RequestState:
         # admission takes the restore path instead of a fresh prefill
         self.swapped: Optional[tuple] = None
         self.preempts = 0            # times this request was preempted
+        self.handoffs = 0            # prefill→decode replica transfers
+        #                              (disaggregated serving, disagg.py)
         # per-request sampling stream seed (finalized in
         # Scheduler.submit, which folds in its per-engine submission
         # ordinal): the temperature stream depends only on (engine key,

@@ -21,7 +21,12 @@ engine detokenizes); ``"stream": true`` switches to ``text/event-stream``
 chunks ending in ``data: [DONE]``.  Sheds map to HTTP: 429 for
 ``rate_limited``/``quota`` (with ``Retry-After``), 503 for
 ``queue_full``/``slo_shed``/draining, 400 for ``budget`` and malformed
-bodies.  ``GET /healthz`` reports serving/draining and live depths.
+bodies.  ``GET /healthz`` reports serving/degraded/draining and live
+depths — over a replica set (DP or disaggregated) it carries one row
+per replica with its role, health, queue depth, and free blocks, and
+the top-level status flips to ``degraded`` the moment any replica is
+dead (before this, a degraded set answered healthy with no way to see
+which replica died).
 
 Operational surface (docs/OBSERVABILITY.md "Tracing a request"):
 ``GET /metrics`` serves the live registry as Prometheus text exposition
@@ -88,17 +93,44 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    @staticmethod
+    def _replica_health(eng):
+        """Per-replica role/health rows when ``eng`` is a replica set
+        (``EngineReplicaSet`` / ``DisaggReplicaSet``), else None.  A
+        degraded set must SAY so: before this, a set with a dead
+        replica answered ``healthy`` with no way to see which replica
+        died or what role the fleet lost."""
+        replicas = getattr(eng, "replicas", None)
+        if replicas is None:
+            return None, True
+        health = list(getattr(eng, "_health", [True] * len(replicas)))
+        rows = [{"index": i,
+                 "role": getattr(r, "role", "both"),
+                 "healthy": bool(health[i]),
+                 "queue_depth": r.scheduler.queue_depth(),
+                 "active": len(r.scheduler.active()),
+                 "free_blocks": r.kv.allocator.free_blocks}
+                for i, r in enumerate(replicas)]
+        return rows, all(health)
+
     def do_GET(self):  # noqa: N802
         if self.path == "/healthz":
             srv = self.srv
             with srv._lock:
                 eng = srv.door.engine
+                replicas, all_healthy = self._replica_health(eng)
+                status = "draining" if srv.draining else \
+                    ("serving" if all_healthy else "degraded")
                 payload = {
-                    "status": "draining" if srv.draining else "serving",
+                    "status": status,
                     "queue_depth": srv.door.queue_depth(),
                     "active_requests": len(eng.scheduler.active()),
                     "kv_blocks_used": eng.kv_blocks_used,
                 }
+                if replicas is not None:
+                    payload["replicas"] = replicas
+                else:
+                    payload["role"] = getattr(eng, "role", "both")
             self._json(200, payload)
         elif self.path == "/metrics":
             self._metrics()
@@ -124,6 +156,17 @@ class _Handler(BaseHTTPRequestHandler):
                 "serve.kv_blocks_used": eng.kv_blocks_used,
                 "serve.draining": 1 if srv.draining else 0,
             }
+            replicas, all_healthy = self._replica_health(eng)
+            if replicas is not None:
+                # per-replica liveness is scrape-able even with the
+                # telemetry registry off: serve_replica_healthy{replica=i}
+                live["serve.degraded"] = 0 if all_healthy else 1
+                for row in replicas:
+                    i = row["index"]
+                    live[f"serve.replica[{i}].healthy"] = \
+                        1 if row["healthy"] else 0
+                    live[f"serve.replica[{i}].is_prefill"] = \
+                        1 if row["role"] == "prefill" else 0
         reg = obs.get_registry()
         body = registry_to_prometheus(reg, extra=live).encode()
         self.send_response(200)

@@ -116,6 +116,20 @@ def test_serving_dist_gate():
     assert "survived an injected replica fault" in out
 
 
+def test_serving_disagg_gate():
+    """Disaggregated serving (tools/ci.py gate_serving_disagg): 2
+    prefill + 2 decode replicas stream KV pages over a TCPStore
+    transport through injected serve.xfer.* faults (transient retried,
+    hard burst degraded to re-prefill) and a decode-replica kill, with
+    greedy outputs token-identical to a colocated run, zero compiles,
+    all blocks reclaimed, and every trace timeline complete with an
+    xfer segment (docs/SERVING.md "Disaggregated serving")."""
+    out = _run_gate("serving-disagg", timeout=1200)
+    assert "serving-disagg gate OK" in out
+    assert "token-identical to the colocated run" in out
+    assert "decode-replica kill" in out
+
+
 def test_api_compat_rejects_foreign_module_leak(monkeypatch):
     """A leaked implementation import (jax/os/...) reachable as a public
     attribute hard-fails collect() (VERDICT r4 weak #1: the gate must

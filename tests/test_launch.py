@@ -39,6 +39,41 @@ class TestTCPStore:
         finally:
             s.close()
 
+    def test_per_call_timeout_override(self):
+        """set/get take a per-call timeout= (KV-page transfer chunks
+        need a longer deadline than heartbeats — serving/disagg.py):
+        the override lands on the client socket for exactly that call
+        and the store's default deadline is restored afterwards."""
+        s = TCPStore(f"127.0.0.1:{free_port()}", is_master=True,
+                     timeout=30.0)
+        applied = []
+
+        class _Spy:
+            def __init__(self, sock):
+                self._sock = sock
+
+            def settimeout(self, v):
+                applied.append(v)
+                self._sock.settimeout(v)
+
+            def __getattr__(self, name):
+                return getattr(self._sock, name)
+
+        s._sock = _Spy(s._sock)
+        try:
+            s.set("big", b"x" * 4096, timeout=75.0)
+            assert applied == [75.0, 30.0]          # applied + restored
+            assert s._sock.gettimeout() == 30.0
+            del applied[:]
+            assert s.get("big", timeout=75.0) == b"x" * 4096
+            assert applied == [75.0, 30.0]
+            del applied[:]
+            # no override → the socket deadline is never touched
+            assert s.get("big") == b"x" * 4096
+            assert applied == []
+        finally:
+            s.close()
+
     def test_wait_and_two_clients(self):
         master = TCPStore(f"127.0.0.1:{free_port()}", is_master=True)
         client = TCPStore(master.endpoint)

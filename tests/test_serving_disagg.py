@@ -1,0 +1,570 @@
+"""Disaggregated serving (paddle_tpu.serving.disagg): prefill/decode
+role specialization with KV-page streaming.
+
+The load-bearing guarantees (docs/SERVING.md "Disaggregated serving"):
+
+- a ``role="prefill"`` engine retires each request at prefill-complete
+  (first token emitted, pages swapped out, slot freed) and a
+  ``role="decode"`` engine resumes it from a transferred ``KVHandout``
+  through the restore path — greedy outputs TOKEN-IDENTICAL to a
+  colocated engine, zero recompiles;
+- the ``KVTransport`` wire format round-trips pages (int8 scales and
+  mid-prefill kv_len included) through bytes with chunked crc-verified
+  retried I/O; a hard transfer failure degrades to a fresh re-prefill;
+- the ``DisaggReplicaSet`` duck-types the Engine surface behind the
+  unchanged FrontDoor, keeps trace ids + exact phase accounting across
+  the handoff (the ``xfer`` segment), and survives replica death in
+  either role.
+"""
+
+import http.client
+import json
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import resilience as rs
+from paddle_tpu import serving
+from paddle_tpu.launch.store import TCPStore
+from paddle_tpu.serving import (DisaggReplicaSet, HeartbeatMonitor,
+                                KVHandout, LoopbackTransport,
+                                StoreTransport, SwapManager,
+                                TransferError)
+
+R = np.random.default_rng(0)
+PROMPTS = [R.integers(0, 256, size=n).astype(np.int32)
+           for n in (5, 17, 9, 26)]
+SHARED = R.integers(0, 256, size=16).astype(np.int32)   # 2 full pages
+
+
+def _prompt(n):
+    return R.integers(0, 256, size=n).astype(np.int32)
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    from paddle_tpu.models.llama import llama
+    pt.seed(0)
+    return llama("tiny")
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq_len", 48)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("prefill_chunk", 8)
+    return serving.Engine(model, **kw)
+
+
+def _serve(tgt, prompts, max_new=6, **kw):
+    rids = [tgt.add_request(p, max_new_tokens=max_new, **kw)
+            for p in prompts]
+    outs = tgt.run()
+    return [outs[r] for r in rids]
+
+
+@pytest.fixture(scope="module")
+def reference(tiny_llama):
+    """Colocated greedy outputs for the shared prompt mix."""
+    eng = _engine(tiny_llama).warmup()
+    return _serve(eng, PROMPTS)
+
+
+def _disagg(model, n_prefill=1, n_decode=2, transport=None, **kw):
+    pre = [_engine(model, role="prefill", **kw).warmup()
+           for _ in range(n_prefill)]
+    dec = [_engine(model, role="decode", **kw).warmup()
+           for _ in range(n_decode)]
+    return DisaggReplicaSet(pre, dec, transport=transport), pre, dec
+
+
+# ---------------------------------------------------------------------------
+# SwapManager wire format (the contract KVTransport relies on)
+# ---------------------------------------------------------------------------
+
+class TestSwapPayloadBytes:
+    def _payload_roundtrip(self, model, dtype):
+        eng = _engine(model, kv_cache_dtype=dtype).warmup()
+        rid = eng.add_request(_prompt(19), max_new_tokens=4)
+        eng.step()                       # mid-prefill: 8 of 19 tokens
+        # pdtpu-lint: disable=lock-discipline — single-threaded test
+        st = eng._states[rid]
+        assert st.prefilling and 0 < st.kv_len < 19
+        assert eng.preempt(rid)
+        pages, host = st.swapped
+        blob = SwapManager.payload_to_bytes(host)
+        back = SwapManager.payload_from_bytes(blob)
+        assert len(back) == len(host)
+        for hl, bl in zip(host, back):
+            assert len(hl) == len(bl)
+            for h, b in zip(hl, bl):
+                assert h.dtype == b.dtype and h.shape == b.shape
+                assert h.tobytes() == b.tobytes()
+        eng.run()
+        assert eng.kv_blocks_used == 0
+        return host
+
+    def test_fp32_roundtrip_mid_prefill(self, tiny_llama):
+        host = self._payload_roundtrip(tiny_llama, None)
+        assert len(host[0]) == 2         # (k, v) per layer
+
+    def test_int8_scales_ride_the_blob(self, tiny_llama):
+        host = self._payload_roundtrip(tiny_llama, "int8")
+        # int8 pools: (k_i8, v_i8, k_scale, v_scale) per layer — the
+        # scale rows MUST survive the wire or restored KV dequantizes
+        # wrong
+        assert len(host[0]) == 4
+        assert str(host[0][0].dtype) == "int8"
+        assert str(host[0][2].dtype) == "float32"
+
+    def test_bfloat16_dtype_survives(self):
+        # regression: np.dtype(bf16).str collapses to "<V2" and does
+        # not round-trip; the wire format must serialize by NAME
+        import jax.numpy as jnp
+        a = np.asarray(jnp.arange(8, dtype=jnp.bfloat16)).reshape(2, 4)
+        host = [(a, a + 1)]
+        back = SwapManager.payload_from_bytes(
+            SwapManager.payload_to_bytes(host))
+        assert str(back[0][0].dtype) == "bfloat16"
+        assert back[0][1].tobytes() == (a + 1).tobytes()
+
+    def test_framing_mismatch_raises(self):
+        host = [(np.zeros((1, 2), np.float32),)]
+        blob = SwapManager.payload_to_bytes(host)
+        with pytest.raises(ValueError, match="framing"):
+            SwapManager.payload_from_bytes(blob + b"\x00")
+
+
+# ---------------------------------------------------------------------------
+# transports
+# ---------------------------------------------------------------------------
+
+class TestKVTransport:
+    def test_loopback_roundtrip_chunked(self):
+        tp = LoopbackTransport(chunk_bytes=16)
+        data = bytes(range(256)) * 3
+        n = tp.put("k1", data)
+        assert n == -(-len(data) // 16)
+        assert tp.get("k1") == data
+        # delete-on-get reclaimed the store
+        assert len(tp) == 0
+        with pytest.raises(TransferError, match="meta"):
+            tp.get("k1")
+
+    def test_get_without_delete_rereads(self):
+        tp = LoopbackTransport()
+        tp.put("k", b"payload")
+        assert tp.get("k", delete=False) == b"payload"
+        assert tp.get("k") == b"payload"
+
+    def test_crc_corruption_detected(self):
+        tp = LoopbackTransport(chunk_bytes=16)
+        tp.put("k", b"x" * 40)
+        # flip a byte inside chunk 1's payload
+        framed = bytearray(tp._blobs[("k", "c", 1)])
+        framed[10] ^= 0xFF
+        tp._blobs[("k", "c", 1)] = bytes(framed)
+        with pytest.raises(TransferError, match="crc32"):
+            tp.get("k")
+        assert tp.crc_errors >= 1
+
+    def test_transient_fault_retried(self):
+        tp = LoopbackTransport()
+        rs.install_faults("serve.xfer.put@0:ConnectionError,"
+                          "serve.xfer.get@0:ConnectionError")
+        try:
+            tp.put("k", b"abc")          # first attempt faults, retry lands
+            assert tp.get("k") == b"abc"
+        finally:
+            rs.clear_faults()
+
+    def test_fault_exhaustion_is_hard(self):
+        tp = LoopbackTransport()
+        rs.install_faults("serve.xfer.put@0x9")
+        try:
+            with pytest.raises(rs.InjectedFault):
+                tp.put("k", b"abc")
+        finally:
+            rs.clear_faults()
+
+    def test_store_transport_over_tcpstore(self):
+        store = TCPStore("127.0.0.1:0", is_master=True)
+        try:
+            tp = StoreTransport(store, chunk_bytes=32, op_timeout_s=15.0)
+            data = bytes(range(200)) * 2
+            tp.put("req-1/0", data)
+            # chunks + meta actually live on the store under the prefix
+            assert store.get("serve/xfer/req-1/0/meta") is not None
+            assert tp.get("req-1/0") == data
+            assert store.get("serve/xfer/req-1/0/meta") is None
+        finally:
+            store.close()
+
+
+# ---------------------------------------------------------------------------
+# the wire unit
+# ---------------------------------------------------------------------------
+
+class TestKVHandout:
+    def _handed_off_state(self, model, **kw):
+        eng = _engine(model, role="prefill", **kw).warmup()
+        rid = eng.add_request(_prompt(9), max_new_tokens=5,
+                              temperature=0.7, tenant="acme")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            while not eng.handed_off:
+                eng.step()
+        st = eng.handed_off.popleft()
+        assert st.request.request_id == rid
+        return eng, st
+
+    def test_roundtrip_preserves_resume_state(self, tiny_llama):
+        _eng, st = self._handed_off_state(tiny_llama)
+        st.request.trace_id = "tr-test-1"
+        h = KVHandout.from_state(st)
+        h2 = KVHandout.from_bytes(h.to_bytes())
+        cb_hits = []
+        st2 = h2.to_state(on_token=lambda *a: cb_hits.append(a))
+        assert st2.request.request_id == st.request.request_id
+        assert st2.request.trace_id == "tr-test-1"
+        assert st2.request.tenant == "acme"
+        assert st2.request.temperature == pytest.approx(0.7)
+        assert np.array_equal(st2.request.prompt_ids,
+                              st.request.prompt_ids)
+        assert st2.kv_len == st.kv_len == 9
+        assert st2.pending_token == st.pending_token
+        assert st2.output_ids == st.output_ids and len(st2.output_ids) == 1
+        assert st2.sample_seed == st.sample_seed
+        assert st2.first_token_t == st.first_token_t
+        assert st2.swapped[0] == st.swapped[0]
+        assert st2.request.on_token is not None
+        for hl, bl in zip(st.swapped[1], st2.swapped[1]):
+            for a, b in zip(hl, bl):
+                assert a.tobytes() == b.tobytes()
+
+    def test_from_state_requires_swapped(self, tiny_llama):
+        eng = _engine(tiny_llama).warmup()
+        rid = eng.add_request(_prompt(5), max_new_tokens=2)
+        # pdtpu-lint: disable=lock-discipline — single-threaded test
+        with pytest.raises(ValueError, match="swapped"):
+            KVHandout.from_state(eng._states[rid])
+        eng.run()
+
+
+# ---------------------------------------------------------------------------
+# role-specialized engines
+# ---------------------------------------------------------------------------
+
+class TestPrefillRole:
+    def test_retires_at_prefill_complete(self, tiny_llama):
+        eng = _engine(tiny_llama, role="prefill").warmup()
+        rid = eng.add_request(_prompt(9), max_new_tokens=5)
+        events = []
+        while eng.has_work():
+            events.extend(eng.step())
+        # exactly the first token was emitted here (TTFT is prefill-side)
+        assert [e.request_id for e in events] == [rid]
+        assert not events[0].finished
+        st = eng.handed_off[0]
+        assert st.slot is None and not st.blocks   # slot freed, pages out
+        assert st.swapped is not None and st.swapped[0] == 2
+        assert eng.kv_blocks_used == 0             # only cached pages left
+        assert eng.handoffs == 1
+        # pdtpu-lint: disable=lock-discipline — single-threaded test
+        assert eng._states[rid] is st              # set pops it from here
+
+    def test_finishing_request_never_hands_off(self, tiny_llama):
+        eng = _engine(tiny_llama, role="prefill").warmup()
+        rid = eng.add_request(_prompt(7), max_new_tokens=1)
+        outs = eng.run()
+        assert len(outs[rid]) == 1 and not eng.handed_off
+        assert eng.handoffs == 0
+
+    def test_veto_hook_decodes_locally(self, tiny_llama, reference):
+        eng = _engine(tiny_llama, role="prefill").warmup()
+        eng._handoff_ok = lambda: False
+        got = _serve(eng, PROMPTS)
+        assert got == reference and not eng.handed_off
+
+    def test_bad_role_rejected(self, tiny_llama):
+        with pytest.raises(ValueError, match="role"):
+            _engine(tiny_llama, role="verifier")
+
+    def test_decode_engine_geometry_mismatch_rejected(self, tiny_llama):
+        _eng, st = TestKVHandout()._handed_off_state(tiny_llama)
+        other = _engine(tiny_llama, role="decode",
+                        kv_cache_dtype="int8").warmup()
+        with pytest.raises(ValueError, match="geometry"):
+            other.admit_handout(KVHandout.from_state(st))
+
+
+# ---------------------------------------------------------------------------
+# the disaggregated set
+# ---------------------------------------------------------------------------
+
+class TestDisaggSet:
+    def test_token_identity_vs_colocated(self, tiny_llama, reference):
+        ds, _pre, _dec = _disagg(tiny_llama)
+        got = _serve(ds, PROMPTS)
+        assert got == reference
+        st = ds.disagg_stats()
+        assert st["handoffs"] == len(PROMPTS) and st["xfers"] > 0
+        assert st["xfer_bytes"] > 0
+        for r in ds.replicas:
+            assert r.kv_blocks_used == 0
+
+    def test_token_identity_int8_pools(self, tiny_llama):
+        ref = _serve(_engine(tiny_llama, kv_cache_dtype="int8").warmup(),
+                     PROMPTS)
+        ds, _p, _d = _disagg(tiny_llama, kv_cache_dtype="int8")
+        assert _serve(ds, PROMPTS) == ref
+
+    def test_temperature_stream_reproducible(self, tiny_llama):
+        # one prefill replica → same per-engine submission ordinals as
+        # the colocated engine → identical sampling streams
+        ref = _serve(_engine(tiny_llama).warmup(), PROMPTS,
+                     temperature=0.8)
+        ds, _p, _d = _disagg(tiny_llama, n_decode=2)
+        assert _serve(ds, PROMPTS, temperature=0.8) == ref
+
+    def test_prefix_hits_on_the_prefill_tier(self, tiny_llama):
+        ds, pre, _d = _disagg(tiny_llama)
+        _serve(ds, [SHARED], max_new=4)
+        _serve(ds, [SHARED], max_new=4)
+        assert sum(e.prefix_stats()["hits"] for e in pre) > 0
+
+    def test_requires_both_tiers_and_roles(self, tiny_llama):
+        e = _engine(tiny_llama, role="prefill")
+        with pytest.raises(ValueError, match="at least one"):
+            DisaggReplicaSet([e], [])
+        with pytest.raises(ValueError, match="role"):
+            DisaggReplicaSet([e], [_engine(tiny_llama, role="both")])
+
+    def test_decode_replica_kill_reenters_handoff_queue(
+            self, tiny_llama, reference):
+        ds, _pre, _dec = _disagg(tiny_llama, n_decode=2)
+        rids = [ds.add_request(p, max_new_tokens=6) for p in PROMPTS]
+        for _ in range(4):
+            ds.step()
+        victim = ds._decode_idx[0]
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            ds._fail_replica(victim, RuntimeError("killed"))
+            outs = ds.run()
+        assert [outs[r] for r in rids] == reference
+        assert not ds._health[victim] and ds.failures == 1
+        for r in ds.replicas:
+            assert r.kv_blocks_used == 0
+
+    def test_prefill_replica_kill_reroutes_admissions(self, tiny_llama,
+                                                      reference):
+        ds, _pre, _dec = _disagg(tiny_llama, n_prefill=2, n_decode=1)
+        rids = [ds.add_request(p, max_new_tokens=6) for p in PROMPTS]
+        ds.step()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            ds._fail_replica(0, RuntimeError("prefill host lost"))
+            outs = ds.run()
+        assert [outs[r] for r in rids] == reference
+        for r in ds.replicas:
+            assert r.kv_blocks_used == 0
+
+    def test_hard_xfer_failure_falls_back_to_reprefill(
+            self, tiny_llama, reference):
+        ds, _pre, _dec = _disagg(tiny_llama)
+        rs.install_faults("serve.xfer.put@0x50")   # every put dies hard
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                got = _serve(ds, PROMPTS)
+        finally:
+            rs.clear_faults()
+        assert got == reference                    # greedy regenerates
+        assert ds.xfer_failures == len(PROMPTS) and ds.xfers == 0
+        for r in ds.replicas:
+            assert r.kv_blocks_used == 0
+
+    def test_no_decode_tier_degrades_to_colocated(self, tiny_llama,
+                                                  reference):
+        ds, _pre, _dec = _disagg(tiny_llama, n_decode=1)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            ds._fail_replica(ds._decode_idx[0], RuntimeError("gone"))
+            got = _serve(ds, PROMPTS)
+        # the prefill replica kept every request and decoded locally
+        assert got == reference
+        assert ds.disagg_stats()["handoffs"] == 0
+
+    def test_duplicate_request_id_rejected(self, tiny_llama):
+        ds, _p, _d = _disagg(tiny_llama, n_decode=1)
+        ds.add_request(_prompt(5), max_new_tokens=2, request_id="dup")
+        with pytest.raises(serving.AdmissionError, match="dup"):
+            ds.add_request(_prompt(5), max_new_tokens=2,
+                           request_id="dup")
+        ds.run()
+
+    def test_frontdoor_drives_the_set_unchanged(self, tiny_llama,
+                                                reference):
+        ds, _p, _d = _disagg(tiny_llama)
+        door = serving.FrontDoor(ds, policies={
+            "hi": serving.TenantPolicy(priority=1)})
+        adms = [door.submit(p, tenant="hi" if i % 2 else "default",
+                            max_new_tokens=6)
+                for i, p in enumerate(PROMPTS)]
+        assert all(a.admitted for a in adms)
+        outs = door.run()
+        assert [outs[a.request_id] for a in adms] == reference
+
+    def test_heartbeat_reap_evacuates(self, tiny_llama, reference):
+        store = TCPStore("127.0.0.1:0", is_master=True)
+        try:
+            ds, _p, _d = _disagg(tiny_llama, n_decode=2)
+            # interval_s=0: beat+reap every step (production defaults
+            # to deadline/3 so liveness is not per-token store I/O)
+            ds.attach_heartbeats(HeartbeatMonitor(store, 3,
+                                                  deadline_s=30.0,
+                                                  interval_s=0.0))
+            rids = [ds.add_request(p, max_new_tokens=6) for p in PROMPTS]
+            ds.step()
+            ds.step()
+            victim = ds._decode_idx[0]
+            store.set(f"serve/hb/{victim}", b"not-a-heartbeat")
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                outs = ds.run()
+            assert not ds._health[victim]
+            assert [outs[r] for r in rids] == reference
+        finally:
+            store.close()
+
+    def test_heartbeat_driver_stall_does_not_self_destruct(
+            self, tiny_llama, reference):
+        """A step-loop pause longer than the deadline makes every beat
+        look stale at once — the reap must recognize its OWN stall and
+        re-beat instead of destroying the whole healthy set."""
+        store = TCPStore("127.0.0.1:0", is_master=True)
+        try:
+            clk = [100.0]
+            ds, _p, _d = _disagg(tiny_llama, n_decode=1)
+            ds.attach_heartbeats(HeartbeatMonitor(
+                store, 2, deadline_s=5.0, interval_s=0.0,
+                clock=lambda: clk[0]))
+            rids = [ds.add_request(p, max_new_tokens=6) for p in PROMPTS]
+            ds.step()                 # beats land at t=100
+            clk[0] += 60.0            # the driver stalls 60s > deadline
+            outs = ds.run()
+            assert all(ds._health), "a driver stall reaped live replicas"
+            assert [outs[r] for r in rids] == reference
+        finally:
+            store.close()
+
+    def test_hard_transfer_failure_reclaims_store_entries(
+            self, tiny_llama):
+        """A half-put transfer must not pin its chunks in the store
+        forever — the hard-failure path discards them."""
+        ds, _p, _d = _disagg(tiny_llama, n_decode=1)
+        tp = ds.transport
+        rs.install_faults("serve.xfer.get@0x99")   # every get dies hard
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                _serve(ds, PROMPTS[:2])
+        finally:
+            rs.clear_faults()
+        assert ds.xfer_failures == 2
+        assert len(tp) == 0, "abandoned transfers left store entries"
+
+    def test_trace_xfer_segment_and_exact_sum(self, tiny_llama):
+        from paddle_tpu import observability as obs
+        obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        try:
+            ds, _p, _d = _disagg(tiny_llama)
+            rids = [ds.add_request(p, max_new_tokens=6) for p in PROMPTS]
+            ds.run()
+            tracer = obs.get_request_tracer()
+            for r in rids:
+                tl = tracer.timeline(r)
+                assert tl["summary"]["done"]
+                assert tl["summary"]["handoffs"] == 1
+                xfer = [e for e in tl["events"]
+                        if e.get("closed") == "xfer"]
+                assert len(xfer) == 1 and xfer[0]["ms"] >= 0
+                assert xfer[0]["phase"] == "xfer"
+                s = tl["summary"]
+                assert abs(s["queue_ms"] + s["prefill_ms"] + s["xfer_ms"]
+                           + s["decode_ms"] - s["wall_ms"]) < 1e-9
+        finally:
+            obs.disable()
+
+    def test_bench_plumbing_scaling_and_flat_ttft(self):
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        from decode_bench import bench_serve_disagg
+        r = bench_serve_disagg(preset="tiny", n_decode=2, max_batch=4,
+                               n_requests=10,
+                               prompt_lens=(24, 33, 28, 30),
+                               max_new=24, page_size=8)
+        assert r["handoffs"] > 0 and r["xfer_bytes"] > 0
+        # decode throughput (busy-time projection) must SCALE with the
+        # decode tier while the prefill tier — and so admitted TTFT —
+        # is unchanged; generous noise bounds for the CPU plumbing run
+        assert r["vs_1_decode"] >= 1.2, r
+        assert r["ttft_p95_ms"] <= 3.0 * r["ttft_p95_1_decode_ms"], r
+
+
+# ---------------------------------------------------------------------------
+# server surface (the healthz/metrics role-visibility fix)
+# ---------------------------------------------------------------------------
+
+class TestServerDisagg:
+    def test_healthz_and_metrics_report_roles_and_health(self,
+                                                         tiny_llama):
+        from paddle_tpu.serving.server import ServingServer
+        ds, _p, _d = _disagg(tiny_llama, n_decode=2)
+        srv = ServingServer(serving.FrontDoor(ds), port=0)
+        host, port = srv.start()
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=30)
+            conn.request("GET", "/healthz")
+            r = conn.getresponse()
+            body = json.loads(r.read())
+            assert r.status == 200 and body["status"] == "serving"
+            assert [x["role"] for x in body["replicas"]] == \
+                ["prefill", "decode", "decode"]
+            assert all(x["healthy"] for x in body["replicas"])
+            # a dead replica must flip the surface to degraded and name
+            # the victim — before this fix the set answered healthy
+            with srv._lock, warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                ds._fail_replica(2, RuntimeError("died"))
+            conn.request("GET", "/healthz")
+            body = json.loads(conn.getresponse().read())
+            assert body["status"] == "degraded"
+            assert body["replicas"][2] == dict(
+                body["replicas"][2], healthy=False, role="decode")
+            conn.request("GET", "/metrics")
+            text = conn.getresponse().read().decode()
+            assert 'serve_replica_healthy{replica="2"} 0' in text
+            assert 'serve_replica_healthy{replica="0"} 1' in text
+            assert 'serve_replica_is_prefill{replica="0"} 1' in text
+            assert "serve_degraded 1" in text
+        finally:
+            srv.close()
+
+    def test_healthz_plain_engine_reports_role(self, tiny_llama):
+        from paddle_tpu.serving.server import ServingServer
+        eng = _engine(tiny_llama).warmup()
+        srv = ServingServer(eng, port=0)
+        host, port = srv.start()
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=30)
+            conn.request("GET", "/healthz")
+            body = json.loads(conn.getresponse().read())
+            assert body["status"] == "serving" and body["role"] == "both"
+        finally:
+            srv.close()

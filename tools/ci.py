@@ -1526,6 +1526,218 @@ def gate_serving_dist(max_batch: int = 4) -> int:
     return 0
 
 
+def gate_serving_disagg(max_batch: int = 4) -> int:
+    """Serving-disagg gate: the prefill/decode split keeps every
+    colocated contract (docs/SERVING.md "Disaggregated serving"):
+
+    mixed churn (staggered admissions + a duplicated page-aligned
+    prompt for prefix hits on the prefill tier, int8 pools) runs
+    through 2 prefill + 2 decode replicas whose KV pages stream over a
+    StoreTransport on a real in-process TCPStore, with injected
+    ``serve.xfer.put``/``serve.xfer.get`` faults (two transient — the
+    transport's RetryPolicy absorbs them — and one burst long enough
+    to exhaust retries, forcing the hard-failure fresh-re-prefill
+    fallback) and ONE decode-replica kill mid-churn (its in-flight
+    requests re-enter the handoff queue).  Demands: greedy outputs
+    TOKEN-IDENTICAL to a colocated engine's run, zero compiles after
+    warmup on every replica, every KV block reclaimed on every replica
+    (the dead one included), and every request's trace timeline
+    complete — exactly one submit and one retire, an ``xfer`` segment,
+    and queue+prefill+xfer+decode summing exactly to wall.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import warnings
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import observability as obs
+    from paddle_tpu import resilience as rs
+    from paddle_tpu import serving
+    from paddle_tpu.launch.store import TCPStore
+    from paddle_tpu.models.llama import llama
+
+    failures = []
+    tel = obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+    store = TCPStore("127.0.0.1:0", is_master=True)
+    try:
+        rng = np.random.default_rng(0)
+        lens = [3, 17, 9, 33, 5, 26, 12, 21]
+        prompts = [rng.integers(0, 256, size=n).astype(np.int32)
+                   for n in lens]
+        budgets = [3 + (i % 4) for i in range(len(prompts))]
+        # page-aligned 2-page prompt served twice: prefix hits land on
+        # the PREFILL tier (the decode tier never prefills a hit)
+        shared = rng.integers(0, 256, size=16).astype(np.int32)
+
+        def build_engine(role):
+            pt.seed(0)
+            return serving.Engine(
+                llama("tiny"), max_batch=max_batch, max_seq_len=64,
+                page_size=8, prefill_chunk=8, kv_cache_dtype="int8",
+                role=role)
+
+        def churn(submit, step, drain, rid_sink=None):
+            rids = []
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                for p, m in zip(prompts, budgets):
+                    rids.append(submit(p, m))
+                    step()
+                rids.append(submit(shared, 4))
+                outs = drain()
+                rids.append(submit(shared, 4))
+                outs.update(drain())
+            if rid_sink is not None:
+                rid_sink.extend(rids)
+            return [outs[r] for r in rids]
+
+        # colocated reference (same int8 pools, same workload)
+        ref_eng = build_engine("both").warmup()
+        ref = churn(lambda p, m: ref_eng.add_request(p, max_new_tokens=m),
+                    ref_eng.step, ref_eng.run)
+
+        transport = serving.StoreTransport(store, op_timeout_s=20.0)
+        pre = [build_engine("prefill").warmup(),
+               build_engine("prefill").warmup()]
+        dec = [build_engine("decode").warmup(),
+               build_engine("decode").warmup()]
+        dset = serving.DisaggReplicaSet(pre, dec, transport=transport)
+        c0 = tel.sentinel.compiles()
+        # two transient xfer faults (absorbed by the retry policy) plus
+        # a 12-call burst that exhausts the 3-attempt policy — the hard
+        # transfer failure the fresh-re-prefill fallback covers
+        inj = rs.install_faults(
+            "serve.xfer.put@2:ConnectionError,"
+            "serve.xfer.get@5:ConnectionError,serve.xfer.put@9x12")
+        killed = [False]
+        steps = [0]
+
+        def step():
+            steps[0] += 1
+            dset.step()
+            if steps[0] == 6 and not killed[0]:
+                killed[0] = True
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore", RuntimeWarning)
+                    dset._fail_replica(
+                        dset._decode_idx[0],
+                        RuntimeError("injected decode-replica kill"))
+
+        try:
+            ds_rids = []
+            got = churn(
+                lambda p, m: dset.add_request(p, max_new_tokens=m),
+                step, dset.run, rid_sink=ds_rids)
+        finally:
+            rs.clear_faults()
+        churn_compiles = tel.sentinel.compiles() - c0
+
+        if len(inj.fired) < 3:
+            failures.append(
+                f"xfer faults under-fired ({inj.fired}) — the scenario "
+                "lost its transfer-fault coverage")
+        if not killed[0]:
+            failures.append("the decode-replica kill never happened")
+        # pdtpu-lint: disable=lock-discipline — single-threaded gate
+        health = list(dset._health)
+        if dset.failures != 1 or health[dset._decode_idx[0]]:
+            failures.append(
+                f"expected exactly the killed decode replica dead, got "
+                f"failures={dset.failures}, health={health}")
+        st = dset.disagg_stats()
+        if st["handoffs"] == 0 or st["xfers"] == 0:
+            failures.append(
+                f"no KV-page handoffs happened ({st}) — the set ran "
+                "colocated and proved nothing")
+        if st["xfer_failures"] == 0:
+            failures.append(
+                "the hard xfer-fault burst never exhausted the retries "
+                "— the fresh-re-prefill fallback went unexercised")
+        if got != ref:
+            bad = [i for i, (a, b) in enumerate(zip(got, ref)) if a != b]
+            failures.append(
+                f"disagg outputs diverged from the colocated run at "
+                f"requests {bad} — the handoff is not token-preserving")
+        if churn_compiles:
+            failures.append(
+                f"{churn_compiles} compile(s) after warmup — the "
+                "transfer path retraced something")
+        for i, rep in enumerate(dset.replicas):
+            if rep.kv_blocks_used != 0:
+                failures.append(
+                    f"replica {i} ({rep.role}) holds "
+                    f"{rep.kv_blocks_used} KV block(s) at drain")
+            alloc = rep.kv.allocator
+            if alloc.free_blocks != alloc.num_blocks:
+                failures.append(
+                    f"replica {i} has only {alloc.free_blocks}/"
+                    f"{alloc.num_blocks} blocks allocatable at drain")
+            for fn, name in ((rep._step_fn, "step"),
+                             (rep._cow_fn, "cow")):
+                n = getattr(fn, "_cache_size", lambda: None)()
+                if n is not None and n > 1:
+                    failures.append(
+                        f"replica {i} {name} jit cache holds {n} "
+                        "entries — something re-traced")
+        hits = sum(pre[i].prefix_stats()["hits"] for i in range(len(pre)))
+        if hits == 0:
+            failures.append(
+                "no prefix-cache hits on the prefill tier — the "
+                "duplicate prompt re-prefilled from scratch")
+        # trace completeness across handoff + kill + fallback: one
+        # timeline per request, exactly one submit/retire, an xfer
+        # segment, and the four-phase sum exact as printed
+        tracer = obs.get_request_tracer()
+        if tracer is None:
+            failures.append("request tracing was not active")
+        else:
+            for r in ds_rids:
+                tl = tracer.timeline(r)
+                if tl is None or not tl["summary"]["done"]:
+                    failures.append(
+                        f"request {r} lost its trace across the handoff")
+                    continue
+                phases = [e["phase"] for e in tl["events"]]
+                if phases.count("submit") != 1 \
+                        or phases.count("retire") != 1:
+                    failures.append(
+                        f"request {r} lifecycle phases malformed "
+                        f"({phases})")
+                if not any(e.get("closed") == "xfer"
+                           for e in tl["events"]):
+                    failures.append(
+                        f"request {r} timeline has no xfer segment — "
+                        "the handoff left the trace")
+                s = tl["summary"]
+                if abs(s["queue_ms"] + s["prefill_ms"] + s["xfer_ms"]
+                       + s["decode_ms"] - s["wall_ms"]) > 1e-9:
+                    failures.append(
+                        f"request {r} phase sum != wall ({s})")
+        if not failures:
+            print(f"serving-disagg: 2 prefill + 2 decode replicas over "
+                  f"a TCPStore transport survived {len(inj.fired)} "
+                  f"injected xfer fault(s) ({st['xfer_failures']} hard, "
+                  f"degraded to re-prefill) and a decode-replica kill — "
+                  f"all {len(ref)} outputs token-identical to the "
+                  f"colocated run, {st['xfers']} transfer(s) / "
+                  f"{st['xfer_bytes']} bytes shipped, 0 compiles, all "
+                  f"blocks reclaimed, {hits} prefix hit(s), every "
+                  f"timeline complete with an xfer segment")
+    finally:
+        obs.disable()
+        store.close()
+
+    if failures:
+        print("serving-disagg gate FAILED (docs/SERVING.md "
+              "\"Disaggregated serving\"):")
+        for f_ in failures:
+            print(f"  {f_}")
+        return 1
+    print("serving-disagg gate OK")
+    return 0
+
+
 def gate_lint(timeout_s: float = 120.0) -> int:
     """Lint gate: pdtpu-lint runs clean over the whole tree with NO jax
     import (subprocess, bare env — the analyzer must work on a jax-less
@@ -1561,6 +1773,7 @@ GATES = {
     "serving-smoke": gate_serving_smoke,
     "chaos-serving": gate_chaos_serving,
     "serving-dist": gate_serving_dist,
+    "serving-disagg": gate_serving_disagg,
 }
 
 

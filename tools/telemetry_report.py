@@ -132,7 +132,13 @@ def summarize(events):
                     "cached_tokens": 0, "span_tokens": 0,
                     "preempts": 0, "restores": 0, "swapped_pages": 0,
                     "sheds": defaultdict(int), "isolated": 0,
-                    "tenants": defaultdict(int), "spec_errors": 0},
+                    "tenants": defaultdict(int), "spec_errors": 0,
+                    # disaggregated serving (docs/SERVING.md
+                    # "Disaggregated serving"): prefill-complete
+                    # handoffs, completed/failed KV-page transfers,
+                    # bytes shipped, and per-transfer wall ms
+                    "handoffs": 0, "xfers": 0, "xfer_failures": 0,
+                    "xfer_bytes": 0, "xfer_ms": []},
         # DP replica routing (docs/SERVING.md "Sharded serving"):
         # per-replica routed/affinity counts from serve_route events,
         # failures/requeues from serve_replica_fail
@@ -193,6 +199,16 @@ def summarize(events):
             agg["serving"]["sheds"][e.get("reason") or "?"] += 1
         elif kind == "serve_isolated_failure":
             agg["serving"]["isolated"] += 1
+        elif kind == "serve_handoff":
+            agg["serving"]["handoffs"] += 1
+        elif kind == "serve_xfer":
+            sv = agg["serving"]
+            sv["xfers"] += 1
+            sv["xfer_bytes"] += e.get("bytes") or 0
+            if e.get("ms") is not None:
+                sv["xfer_ms"].append(e["ms"])
+        elif kind == "serve_xfer_fail":
+            agg["serving"]["xfer_failures"] += 1
         elif kind == "serve_route":
             rp = agg["replicas"][e.get("replica", "?")]
             rp["routed"] += 1
@@ -211,6 +227,8 @@ def summarize(events):
             agg["traces"].append({"tenant": e.get("tenant"),
                                   "queue_ms": s.get("queue_ms"),
                                   "prefill_ms": s.get("prefill_ms"),
+                                  "xfer_ms": s.get("xfer_ms"),
+                                  "handoffs": s.get("handoffs") or 0,
                                   "decode_ms": s.get("decode_ms"),
                                   "wall_ms": s.get("wall_ms"),
                                   "decode_tokens": s.get("decode_tokens"),
@@ -261,7 +279,8 @@ def summarize(events):
 def _phase_stats(traces):
     """Per-phase p50/p95 over the folded serve_trace summaries."""
     out = {}
-    for phase in ("queue_ms", "prefill_ms", "decode_ms", "wall_ms"):
+    for phase in ("queue_ms", "prefill_ms", "xfer_ms", "decode_ms",
+                  "wall_ms"):
         vals = sorted(t[phase] for t in traces
                       if t.get(phase) is not None)
         out[phase] = {"n": len(vals), "p50": _pct(vals, 50),
@@ -464,6 +483,16 @@ def render(agg, malformed=0):
             lines.append(f"| shed (by reason) | {shed} |")
         if sv["isolated"]:
             lines.append(f"| isolated failures | {sv['isolated']} |")
+        # disaggregated handoff columns (docs/SERVING.md
+        # "Disaggregated serving") — only when the run handed off
+        if sv["handoffs"] or sv["xfers"] or sv["xfer_failures"]:
+            xms = sorted(sv["xfer_ms"])
+            lines.append(
+                f"| handoffs / transfers (failed) | {sv['handoffs']} / "
+                f"{sv['xfers']} ({sv['xfer_failures']}) |")
+            lines.append(
+                f"| xfer bytes, ms p50 / p95 | {sv['xfer_bytes']} , "
+                f"{fmt(_pct(xms, 50))} / {fmt(_pct(xms, 95))} |")
         if sv["tenants"]:
             ten = ", ".join(f"{t}: {n}" for t, n in
                             sorted(sv["tenants"].items()))
@@ -478,9 +507,11 @@ def render(agg, malformed=0):
             return f"{v:.{nd}f}" if v is not None else "—"
         lines += [f"| Request phase ({len(agg['traces'])} traces) "
                   "| p50 ms | p95 ms |", "|---|---|---|"]
-        for phase in ("queue_ms", "prefill_ms", "decode_ms",
+        for phase in ("queue_ms", "prefill_ms", "xfer_ms", "decode_ms",
                       "decode_ms_per_token", "wall_ms"):
             s = ph[phase]
+            if phase == "xfer_ms" and not s["n"]:
+                continue             # colocated runs never enter xfer
             lines.append(f"| {phase.replace('_ms', '').replace('_', ' ')} "
                          f"| {fmt(s['p50'])} | {fmt(s['p95'])} |")
         preempted = sum(1 for t in agg["traces"] if t["preempts"])
@@ -653,6 +684,14 @@ def main(argv=None) -> int:
                       / m["serve.spec.proposed"], 3)
                 if m.get("serve.spec.proposed") else None),
             "spec_draft_errors": m.get("serve.spec.draft_errors") or 0,
+            # disaggregated handoff/transfer fold (docs/SERVING.md
+            # "Disaggregated serving")
+            "handoffs": sv["handoffs"],
+            "xfers": sv["xfers"],
+            "xfer_failures": sv["xfer_failures"],
+            "xfer_bytes": sv["xfer_bytes"],
+            "xfer_p50_ms": _pct(sorted(sv["xfer_ms"]), 50),
+            "xfer_p95_ms": _pct(sorted(sv["xfer_ms"]), 95),
         }
     if agg["replicas"]:
         summary["replicas"] = {

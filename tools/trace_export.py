@@ -58,7 +58,11 @@ def _track_events(trace: dict, tid: int):
         name = ev.get("phase") or "?"
         if name == "route" and ev.get("replica") is not None:
             pid = int(ev["replica"])
-        elif name == "migrate" and ev.get("to_replica") is not None:
+        elif name in ("migrate", "xfer") \
+                and ev.get("to_replica") is not None:
+            # migrate: DP evacuation; xfer: the disaggregated
+            # prefill→decode handoff — both move the request's work to
+            # another replica's track
             pid = int(ev["to_replica"])
         t_us = base_us + float(ev.get("t_ms") or 0.0) * 1e3
         args = {k: v for k, v in ev.items()
