@@ -373,6 +373,36 @@ def main():
         except Exception as e:  # noqa: BLE001
             extra["serve_disagg_error"] = f"{type(e).__name__}: {e}"[:300]
 
+        # batched multi-LoRA (docs/SERVING.md "Multi-LoRA"): N adapters
+        # + base mixed in one engine's batch (grouped BGMV over the
+        # stacked pools) vs the serial one-merged-engine-per-tenant
+        # deployment — batched tok/s over the serial busy-time
+        # projection.  Same CPU-plumbing / TPU-numbers split and
+        # non-fatality as above.
+        try:
+            from decode_bench import bench_serve_lora
+            with contextlib.redirect_stdout(sys.stderr):
+                if on_tpu:
+                    r = bench_serve_lora(n_adapters=3, rank=8,
+                                         max_batch=8,
+                                         kv_cache_dtype="int8")
+                else:
+                    r = bench_serve_lora(preset="tiny", n_adapters=3,
+                                         rank=8, max_batch=4,
+                                         n_requests=8,
+                                         prompt_lens=(5, 9, 7, 12),
+                                         max_new=8, page_size=8)
+            pre = "serve_lora" if on_tpu else "serve_lora_cpu"
+            extra[f"{pre}_tok_s"] = r["batched_tok_s"]
+            extra[f"{pre}_vs_serial"] = r["vs_serial"]
+            extra[f"{pre}_detail"] = {
+                k: r[k] for k in ("adapters", "rank", "requests", "kv",
+                                  "gen_tokens", "wall_s",
+                                  "serial_tok_s", "serial_wall_s",
+                                  "active_adapters")}
+        except Exception as e:  # noqa: BLE001
+            extra["serve_lora_error"] = f"{type(e).__name__}: {e}"[:300]
+
         # sharded serving (docs/SERVING.md "Sharded serving"): the
         # TP-partitioned engine and the DP replica router need >= 2
         # devices (a multi-chip slice, or the forced virtual CPU mesh

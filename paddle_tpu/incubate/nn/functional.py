@@ -274,6 +274,66 @@ fused_rms_rope_qkv.defvjp(_fused_rms_rope_qkv_fwd,
                           _fused_rms_rope_qkv_bwd)
 
 
+def _lora_bgmv_ref(x, a, b, idx):
+    """XLA composition mirroring the grouped-BGMV kernel's numerics
+    (ops/pallas/lora_matmul.py): gather each slot's adapter blocks,
+    shrink then expand with f32 accumulation, the rank-r intermediate
+    rounded to ``x.dtype`` between the two dots.  Slot 0 rows multiply
+    all-zero stacks, so their delta is EXACTLY 0.0 — adding it leaves
+    base-only outputs bitwise unchanged."""
+    p = _prec(x.dtype)
+    ai = jnp.take(a, idx, axis=0).astype(x.dtype)      # (B, d_in, r)
+    bi = jnp.take(b, idx, axis=0).astype(x.dtype)      # (B, r, d_out)
+    h = jax.lax.dot_general(x, ai, (((2,), (1,)), ((0,), (0,))),
+                            precision=p,
+                            preferred_element_type=jnp.float32)
+    h = h.astype(x.dtype)                              # (B, C, r)
+    out = jax.lax.dot_general(h, bi, (((2,), (1,)), ((0,), (0,))),
+                              precision=p,
+                              preferred_element_type=jnp.float32)
+    return out.astype(x.dtype)
+
+
+def lora_bgmv(x, a, b, idx):
+    """Grouped batched-gather matrix-vector product — the multi-LoRA
+    serving delta ``x[s] @ A[idx[s]] @ B[idx[s]]`` per batch slot
+    (docs/SERVING.md "Multi-LoRA").
+
+    ``x`` is ``(B, C, d_in)`` (the projection's input span batch),
+    ``a``/``b`` the stacked adapter pools ``(N, d_in, r)`` /
+    ``(N, r, d_out)`` (``serving.LoRAPool.device_stacks``), ``idx``
+    the per-slot adapter indices ``(B,)`` int32.  Mixed indices within
+    one batch are the point; index 0 is the reserved exact no-op.
+    Dispatches to the Pallas grouped-BGMV kernel on TPU (adapter blocks
+    DMA'd by scalar-prefetched index, rank-r intermediate
+    VMEM-resident); the gather+einsum composition above is the
+    numerical contract and the fallback everywhere else.  Serving-only:
+    no custom VJP (LoRA *training* is out of scope — deltas are jit
+    inputs, not trained parameters here)."""
+    from ...ops import dispatch as _dispatch
+    kernel = _dispatch.get("lora_bgmv")
+    if kernel is not None:
+        out = kernel(x, a, b, idx)
+        if out is not None:
+            return out
+    return _lora_bgmv_ref(x, a, b, idx)
+
+
+def lora_delta(lora, inp, key):
+    """The one adapter-delta call the model forwards share: resolve
+    projection ``key`` in the threaded ``(layer pack, adapter ids)``
+    pair and run :func:`lora_bgmv` on its stacks — ``None`` when no
+    pack is threaded or the pool does not target this projection (the
+    caller then skips the add outright)."""
+    if lora is None:
+        return None
+    lpack, laids = lora
+    e = lpack.get(key)
+    if e is None:
+        return None
+    return lora_bgmv(inp, e["a"], e["b"], laids)
+
+
 # ---------------------------------------------------------------------------
 # decode attention (KV cache)
 # ---------------------------------------------------------------------------

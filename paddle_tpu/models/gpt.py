@@ -111,11 +111,25 @@ class GPTAttention(Layer):
         self.dropout = Dropout(cfg.hidden_dropout)
 
     def forward(self, x, attn_mask=None, cache=None, seq_lens=None,
-                block_tables=None, span_starts=None):
+                block_tables=None, span_starts=None, lora=None):
         cfg = self.cfg
         b, s = x.shape[:2]
-        qkv = self.qkv_proj(x).reshape(b, s, 3, cfg.num_attention_heads,
-                                       cfg.head_dim)
+        # multi-LoRA serving (docs/SERVING.md "Multi-LoRA"): per-slot
+        # adapter deltas on the packed qkv projection and on out_proj —
+        # x here is already ln_1-normed, exactly the projections' input
+        from ..incubate.nn.functional import lora_delta
+
+        def _out(t):
+            y = self.out_proj(t)
+            d = lora_delta(lora, t, "attn.out_proj")
+            return y if d is None else y + d
+
+        qkv = self.qkv_proj(x)
+        dqkv = lora_delta(lora, x, "attn.qkv_proj")
+        if dqkv is not None:
+            qkv = qkv + dqkv
+        qkv = qkv.reshape(b, s, 3, cfg.num_attention_heads,
+                          cfg.head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         q = constrain(q, ("dp", "sharding"), None, "mp", None)
         k = constrain(k, ("dp", "sharding"), None, "mp", None)
@@ -130,13 +144,13 @@ class GPTAttention(Layer):
                 out, new_cache = ragged_paged_attend(
                     cache, q, k, v, block_tables, span_starts, seq_lens)
                 out = out.reshape(b, s, cfg.hidden_size)
-                return self.dropout(self.out_proj(out)), new_cache
+                return self.dropout(_out(out)), new_cache
             if s == 1 and seq_lens is not None:
                 out, new_cache = paged_decode_attend(
                     cache, q[:, 0], k[:, 0], v[:, 0], block_tables,
                     seq_lens)
                 out = out[:, None].reshape(b, s, cfg.hidden_size)
-                return self.dropout(self.out_proj(out)), new_cache
+                return self.dropout(_out(out)), new_cache
             plens = seq_lens if seq_lens is not None else \
                 jnp.full((b,), s, jnp.int32)
             new_cache = paged_prefill_write(cache, k, v, block_tables,
@@ -145,7 +159,7 @@ class GPTAttention(Layer):
                 q, k, v, is_causal=True,
                 dropout_p=cfg.attention_dropout, training=self.training)
             out = out.reshape(b, s, cfg.hidden_size)
-            return self.dropout(self.out_proj(out)), new_cache
+            return self.dropout(_out(out)), new_cache
         if cache is not None and s == 1 and seq_lens is not None:
             # single-token decode against the dense (or int8-quantized
             # 4-tuple) KV cache — shared cache-arity dispatch
@@ -153,7 +167,7 @@ class GPTAttention(Layer):
             out, new_cache = decode_attend_cache(
                 cache, q[:, 0], k[:, 0], v[:, 0], seq_lens)
             out = out[:, None].reshape(b, s, cfg.hidden_size)
-            return self.dropout(self.out_proj(out)), new_cache
+            return self.dropout(_out(out)), new_cache
         if cache is not None:
             from ..incubate.nn.functional import prefill_write_cache
             new_cache = prefill_write_cache(cache, k, v)
@@ -161,12 +175,12 @@ class GPTAttention(Layer):
                 q, k, v, is_causal=True,
                 dropout_p=cfg.attention_dropout, training=self.training)
             out = out.reshape(b, s, cfg.hidden_size)
-            return self.dropout(self.out_proj(out)), new_cache
+            return self.dropout(_out(out)), new_cache
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None,
             dropout_p=cfg.attention_dropout, training=self.training)
         out = out.reshape(b, s, cfg.hidden_size)
-        return self.dropout(self.out_proj(out))
+        return self.dropout(_out(out))
 
 
 class GPTMLP(Layer):
@@ -184,10 +198,24 @@ class GPTMLP(Layer):
                                         sequence_parallel=sp)
         self.dropout = Dropout(cfg.hidden_dropout)
 
-    def forward(self, x):
+    def forward(self, x, lora=None):
         cfg = self.cfg
         from .llama import _use_fused
         from ..ops.tuning import geom_key
+
+        if lora is not None:
+            # multi-LoRA: the fc_out delta needs the GELU intermediate,
+            # so the LoRA path pins the unfused FFN composition
+            from ..incubate.nn.functional import lora_delta
+
+            h1 = self.fc_in(x)
+            d1 = lora_delta(lora, x, "mlp.fc_in")
+            if d1 is not None:
+                h1 = h1 + d1
+            h = F.gelu(h1)
+            y = self.fc_out(h)
+            d2 = lora_delta(lora, h, "mlp.fc_out")
+            return self.dropout(y if d2 is None else y + d2)
 
         def _kernel_serves():
             from ..ops.pallas import fused_mlp as _fm
@@ -223,14 +251,14 @@ class GPTDecoderLayer(Layer):
         self.mlp = GPTMLP(cfg)
 
     def forward(self, x, attn_mask=None, cache=None, seq_lens=None,
-                block_tables=None, span_starts=None):
+                block_tables=None, span_starts=None, lora=None):
         if cache is not None:
             attn, cache = self.attn(self.ln_1(x), attn_mask, cache=cache,
                                     seq_lens=seq_lens,
                                     block_tables=block_tables,
-                                    span_starts=span_starts)
+                                    span_starts=span_starts, lora=lora)
             x = x + attn
-            x = x + self.mlp(self.ln_2(x))
+            x = x + self.mlp(self.ln_2(x), lora=lora)
             return x, cache
         x = x + self.attn(self.ln_1(x), attn_mask)
         x = x + self.mlp(self.ln_2(x))
@@ -314,13 +342,14 @@ class GPTModel(Layer):
             dtype if dtype is not None else cfg.dtype)
 
     def _forward_cached(self, input_ids, caches, seq_lens,
-                        block_tables=None, span_starts=None):
+                        block_tables=None, span_starts=None, lora=None):
         """Prefill (seq_lens None) or one-token decode against the caches.
         With ``block_tables`` the caches are paged pools (serving path);
         prefill then takes ``seq_lens`` as the real prompt lengths.  With
         ``span_starts`` the batch is the unified RAGGED serving step
         (chunked prefill + decode spans, ``seq_lens`` = span lengths).
-        Returns (hidden, new_caches)."""
+        ``lora`` is the multi-LoRA pair (per-layer adapter packs,
+        per-slot adapter ids).  Returns (hidden, new_caches)."""
         b, s = input_ids.shape
         decode = (s == 1 and seq_lens is not None)
         if span_starts is not None:
@@ -336,16 +365,19 @@ class GPTModel(Layer):
             kw["span_starts"] = span_starts
         lens_arg = seq_lens if (decode or block_tables is not None) \
             else None
+        lit = iter(lora[0]) if lora is not None else None
+        laids = lora[1] if lora is not None else None
         from .generation import run_cached_layers
         x, new_caches = run_cached_layers(
             self.h, x, caches,
             lambda inner, x, cache: inner(
-                x, cache=cache, seq_lens=lens_arg, **kw))
+                x, cache=cache, seq_lens=lens_arg,
+                lora=None if lit is None else (next(lit), laids), **kw))
         return self.ln_f(x), new_caches
 
     def forward(self, input_ids, attn_mask=None, position_ids=None,
                 caches=None, seq_lens=None, block_tables=None,
-                span_starts=None):
+                span_starts=None, lora=None):
         cfg = self.cfg
         if caches is not None:
             if attn_mask is not None or position_ids is not None:
@@ -354,7 +386,7 @@ class GPTModel(Layer):
                     "only — attn_mask/position_ids would be silently "
                     "ignored")
             return self._forward_cached(input_ids, caches, seq_lens,
-                                        block_tables, span_starts)
+                                        block_tables, span_starts, lora)
         if input_ids.shape[1] > cfg.max_position_embeddings:
             # learned absolute positions: jax's OOB gather would silently
             # clamp every index past the table to its last row

@@ -170,10 +170,21 @@ class LlamaAttention(Layer):
 
     def forward(self, x, cos, sin, attn_mask=None, cache=None,
                 seq_lens=None, block_tables=None, span_starts=None,
-                norm_weight=None):
+                norm_weight=None, lora=None):
         cfg = self.cfg
         b, s = x.shape[:2]
         roped = False
+        # batched multi-LoRA (docs/SERVING.md "Multi-LoRA"): ``lora`` is
+        # (per-layer stack pack, per-slot adapter ids).  Deltas inject
+        # at the PROJECTION OUTPUTS — pre-RoPE for q/k, which is why the
+        # LoRA path never takes the fused norm→qkv→rope kernel (the
+        # decoder layer pins norm_weight=None when lora is threaded).
+        from ..incubate.nn.functional import lora_delta
+
+        def _o(t):
+            y = self.o_proj(t)
+            d = lora_delta(lora, t, "self_attn.o_proj")
+            return y if d is None else y + d
         if norm_weight is not None:
             # fused RMSNorm→QKV→RoPE (docs/KERNELS.md): ``x`` is the
             # UN-NORMED residual stream — the decoder layer skipped its
@@ -212,12 +223,21 @@ class LlamaAttention(Layer):
             k = k.reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
             v = v.reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
         else:
-            q = self.q_proj(x).reshape(b, s, cfg.num_attention_heads,
-                                       cfg.head_dim)
-            k = self.k_proj(x).reshape(b, s, cfg.num_key_value_heads,
-                                       cfg.head_dim)
-            v = self.v_proj(x).reshape(b, s, cfg.num_key_value_heads,
-                                       cfg.head_dim)
+            q, k, v = self.q_proj(x), self.k_proj(x), self.v_proj(x)
+            if lora is not None:
+                # per-slot adapter deltas on the projection outputs
+                # (pre-RoPE, pre-reshape — exactly where a merged
+                # W + B_k A_k weight would land them); slot 0 rows add
+                # an exact 0.0, keeping base requests bitwise unchanged
+                dq = lora_delta(lora, x, "self_attn.q_proj")
+                dk = lora_delta(lora, x, "self_attn.k_proj")
+                dv = lora_delta(lora, x, "self_attn.v_proj")
+                q = q if dq is None else q + dq
+                k = k if dk is None else k + dk
+                v = v if dv is None else v + dv
+            q = q.reshape(b, s, cfg.num_attention_heads, cfg.head_dim)
+            k = k.reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
+            v = v.reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
         # heads are mp-sharded (they came from column-parallel projections)
         q = constrain(q, ("dp", "sharding"), None, "mp", None)
         k = constrain(k, ("dp", "sharding"), None, "mp", None)
@@ -240,14 +260,14 @@ class LlamaAttention(Layer):
                     cache, q, k, v, block_tables, span_starts, seq_lens)
                 out = out.reshape(
                     b, s, cfg.num_attention_heads * cfg.head_dim)
-                return self.o_proj(out), new_cache
+                return _o(out), new_cache
             if s == 1 and seq_lens is not None:
                 out, new_cache = paged_decode_attend(
                     cache, q[:, 0], k[:, 0], v[:, 0], block_tables,
                     seq_lens)
                 out = out[:, None].reshape(
                     b, s, cfg.num_attention_heads * cfg.head_dim)
-                return self.o_proj(out), new_cache
+                return _o(out), new_cache
             # paged prefill: causal attention over the (bucket-padded)
             # prompt; pages written only at positions < seq_lens, so
             # padding rows never land in the pool
@@ -257,7 +277,7 @@ class LlamaAttention(Layer):
                                             plens)
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
             out = out.reshape(b, s, cfg.num_attention_heads * cfg.head_dim)
-            return self.o_proj(out), new_cache
+            return _o(out), new_cache
         if cache is not None and s == 1 and seq_lens is not None:
             # single-token decode against the dense KV cache (2-tuple fp
             # or int8-quantized 4-tuple) — shared cache-arity dispatch
@@ -266,7 +286,7 @@ class LlamaAttention(Layer):
                 cache, q[:, 0], k[:, 0], v[:, 0], seq_lens)
             out = out[:, None].reshape(b, s,
                                        cfg.num_attention_heads * cfg.head_dim)
-            return self.o_proj(out), new_cache
+            return _o(out), new_cache
         if cache is not None:
             # single-shot prefill: causal attention over the prompt, cache
             # written at [0, s) (chunked prefill lives in incubate's
@@ -275,7 +295,7 @@ class LlamaAttention(Layer):
             new_cache = prefill_write_cache(cache, k, v)
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
             out = out.reshape(b, s, cfg.num_attention_heads * cfg.head_dim)
-            return self.o_proj(out), new_cache
+            return _o(out), new_cache
         if cfg.context_parallel and attn_mask is None:
             from ..distributed import cp
             q = cp.split_sequence(q)
@@ -287,7 +307,7 @@ class LlamaAttention(Layer):
             out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
                                                  is_causal=attn_mask is None)
         out = out.reshape(b, s, cfg.num_attention_heads * cfg.head_dim)
-        return self.o_proj(out)
+        return _o(out)
 
 
 class LlamaMLP(Layer):
@@ -304,9 +324,27 @@ class LlamaMLP(Layer):
         self.down_proj = RowParallelLinear(i, h, has_bias=False,
                                            weight_attr=attr, sequence_parallel=sp)
 
-    def forward(self, x):
+    def forward(self, x, lora=None):
         cfg = self.cfg
         from ..ops.tuning import geom_key
+
+        if lora is not None:
+            # multi-LoRA serving: the gate/up deltas need x and the down
+            # delta needs the swiglu intermediate, so the LoRA engine
+            # pins the UNFUSED composition (the one-pass fused kernel
+            # never materializes that intermediate) — the added fusion
+            # here is the grouped BGMV itself
+            from ..incubate.nn.functional import lora_delta
+
+            g, u = self.gate_proj(x), self.up_proj(x)
+            dg = lora_delta(lora, x, "mlp.gate_proj")
+            du = lora_delta(lora, x, "mlp.up_proj")
+            g = g if dg is None else g + dg
+            u = u if du is None else u + du
+            h = F.swiglu(g, u)
+            y = self.down_proj(h)
+            dd = lora_delta(lora, h, "mlp.down_proj")
+            return y if dd is None else y + dd
 
         def _kernel_serves():
             from ..ops.pallas import fused_mlp as _fm
@@ -378,17 +416,25 @@ class LlamaDecoderLayer(Layer):
         return self.input_layernorm(x), None
 
     def forward(self, x, cos, sin, attn_mask=None, cache=None,
-                seq_lens=None, block_tables=None, span_starts=None):
+                seq_lens=None, block_tables=None, span_starts=None,
+                lora=None):
         if cache is not None:
-            attn_in, nw = self._attn_input(x)
+            if lora is None:
+                attn_in, nw = self._attn_input(x)
+            else:
+                # LoRA deltas inject pre-RoPE at the projection outputs,
+                # which the fused norm→qkv→rope single pass cannot
+                # expose — the multi-LoRA engine pins the unfused path
+                attn_in, nw = self.input_layernorm(x), None
             attn, cache = self.self_attn(attn_in, cos, sin,
                                          attn_mask, cache=cache,
                                          seq_lens=seq_lens,
                                          block_tables=block_tables,
                                          span_starts=span_starts,
-                                         norm_weight=nw)
+                                         norm_weight=nw, lora=lora)
             x = x + attn
-            x = x + self.mlp(self.post_attention_layernorm(x))
+            x = x + self.mlp(self.post_attention_layernorm(x),
+                             lora=lora)
             return x, cache
         # named scopes → readable xprof/Perfetto traces (profiler facade)
         with jax.named_scope("attn"):
@@ -476,7 +522,7 @@ class LlamaModel(Layer):
 
     def forward(self, input_ids, attn_mask=None, position_ids=None,
                 caches=None, seq_lens=None, block_tables=None,
-                span_starts=None):
+                span_starts=None, lora=None):
         cfg = self.cfg
         if caches is not None:
             if attn_mask is not None or position_ids is not None:
@@ -485,7 +531,7 @@ class LlamaModel(Layer):
                     "only — attn_mask/position_ids would be silently "
                     "ignored (left-pad or trim prompts instead)")
             return self._forward_cached(input_ids, caches, seq_lens,
-                                        block_tables, span_starts)
+                                        block_tables, span_starts, lora)
         x = self.embed_tokens(input_ids)
         cos, sin = F.rope_cos_sin(input_ids.shape[1], cfg.head_dim,
                                   base=cfg.rope_theta, dtype=x.dtype,
@@ -507,15 +553,17 @@ class LlamaModel(Layer):
         return self.norm(x)
 
     def _forward_cached(self, input_ids, caches, seq_lens,
-                        block_tables=None, span_starts=None):
+                        block_tables=None, span_starts=None, lora=None):
         """Prefill (seq_lens None) or one-token decode against the caches.
         With ``block_tables`` the caches are paged pools (serving path):
         prefill also takes ``seq_lens`` as the real prompt lengths so
         padding never lands in the pool.  With ``span_starts`` the batch
         is the unified RAGGED serving step: per-slot spans (chunked
         prefill or decode tokens) at positions ``[start, start+len)``,
-        ``seq_lens`` carrying the span lengths.  Returns
-        (hidden, new_caches)."""
+        ``seq_lens`` carrying the span lengths.  ``lora`` is the
+        multi-LoRA pair (per-layer stacked adapter packs, per-slot
+        adapter ids) — each decoder layer consumes its own pack.
+        Returns (hidden, new_caches)."""
         cfg = self.cfg
         x = self.embed_tokens(input_ids)
         b, s = input_ids.shape
@@ -539,11 +587,17 @@ class LlamaModel(Layer):
             kw["span_starts"] = span_starts
         lens_arg = seq_lens if (decode or block_tables is not None) \
             else None
+        # per-layer LoRA packs: run_cached_layers walks the stack in
+        # order, so a sequential iterator hands each layer its own pack
+        # at trace time (adapter ids are shared batch data)
+        lit = iter(lora[0]) if lora is not None else None
+        laids = lora[1] if lora is not None else None
         from .generation import run_cached_layers
         x, new_caches = run_cached_layers(
             self.layers, x, caches,
             lambda inner, x, cache: inner(
-                x, cos, sin, cache=cache, seq_lens=lens_arg, **kw))
+                x, cos, sin, cache=cache, seq_lens=lens_arg,
+                lora=None if lit is None else (next(lit), laids), **kw))
         self.__dict__["_moe_aux"] = 0.0
         return self.norm(x), new_caches
 

@@ -151,3 +151,17 @@ def _fused_adamw_dispatch(p, g, m, v, lr, c1, c2, *, beta1, beta2, eps,
 
 
 dispatch.register("fused_adamw", _fused_adamw_dispatch, platform="tpu")
+
+from . import lora_matmul as _lora
+
+
+def _lora_bgmv_dispatch(x, a, b, idx):
+    # GSPMD cannot auto-partition Mosaic kernels: a meshed (TP) engine
+    # takes the XLA gather+einsum composition, which partitions fine
+    # (the stacks are small and replicated)
+    if _active_mesh() is not None or not _lora.supported(x, a, b):
+        return None
+    return _lora.grouped_bgmv(x, a, b, idx)
+
+
+dispatch.register("lora_bgmv", _lora_bgmv_dispatch, platform="tpu")

@@ -38,6 +38,16 @@ TCPStore for multi-host) with chunked crc-verified, retried I/O — so
 TTFT and aggregate tok/s scale on independent axes behind the same
 FrontDoor.
 
+Batched multi-LoRA (docs/SERVING.md "Multi-LoRA"):
+``Engine(lora=LoRAPool(model, ...))`` serves many fine-tuned adapters
+from ONE engine — stacked low-rank weight pools ride the compiled step
+as fixed-shape inputs, per-slot adapter ids are batch data (mixed
+tenants in one ragged dispatch through the grouped BGMV), adapter
+load/evict is a buffer write (zero recompiles), and ``FrontDoor`` maps
+tenants to adapters via ``TenantPolicy(adapter=)``.  Admission of an
+unloaded adapter raises the typed ``errors.UnknownAdapter``; evicting
+an adapter with live requests raises ``errors.AdapterInUse``.
+
 Usage::
 
     from paddle_tpu import serving
@@ -65,8 +75,10 @@ from .disagg import (DisaggReplicaSet, HeartbeatMonitor,  # noqa: F401
 from .distributed import (EngineReplicaSet, replica_meshes,  # noqa: F401
                           serving_mesh)
 from .engine import Engine, TokenEvent  # noqa: F401
-from .errors import (AdmissionError, BudgetUnsatisfiable,  # noqa: F401
-                     QueueFull, RateLimited)
+from .errors import (AdapterInUse, AdmissionError,  # noqa: F401
+                     BudgetUnsatisfiable, QueueFull, RateLimited,
+                     UnknownAdapter)
+from .lora import LoRAPool, merge_adapter, random_adapter  # noqa: F401
 from .frontdoor import (Admission, FrontDoor, TenantPolicy,  # noqa: F401
                         TokenBucket)
 from .scheduler import Request, RequestState, Scheduler  # noqa: F401

@@ -184,10 +184,20 @@ class PrefixCache:
         allocator.on_evict = self._on_evict
 
     @staticmethod
-    def page_keys(prompt_ids, page_size: int) -> List[bytes]:
-        """Chained digests for every FULL page of ``prompt_ids``."""
+    def page_keys(prompt_ids, page_size: int,
+                  salt: bytes = b"") -> List[bytes]:
+        """Chained digests for every FULL page of ``prompt_ids``.
+
+        ``salt`` seeds the chain: pages written under different salts
+        never share, however identical their tokens.  Multi-LoRA uses
+        the adapter name here (scheduler.submit) — an adapter's q/k/v
+        deltas change the KV CONTENT at every position, so a page
+        prefilled under adapter A must never be borrowed by a request
+        on adapter B (or the base model), and the chained digest is
+        exactly the right place to encode that: one seed, every
+        downstream page key diverges."""
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
-        keys, prev = [], b""
+        keys, prev = [], bytes(salt)
         for p in range(ids.size // page_size):
             h = hashlib.blake2b(digest_size=16)
             h.update(prev)

@@ -124,6 +124,10 @@ class KVHandout:
     first_token_t: Optional[float]
     pages: int
     payload: Optional[list]
+    # multi-LoRA: the adapter NAME rides the wire (slot indices are
+    # engine-local — the receiving engine re-resolves against its own
+    # pool at admit_handout, rejecting typed if the adapter is absent)
+    adapter: Optional[str] = None
 
     @classmethod
     def from_state(cls, st: RequestState) -> "KVHandout":
@@ -147,7 +151,7 @@ class KVHandout:
             sample_seed=int(st.sample_seed), preempts=int(st.preempts),
             handoffs=int(st.handoffs), submit_t=float(st.submit_t),
             first_token_t=st.first_token_t,
-            pages=int(pages), payload=host)
+            pages=int(pages), payload=host, adapter=req.adapter)
 
     def to_state(self, on_token=None) -> RequestState:
         """Reconstruct the request state on the receiving engine; the
@@ -157,7 +161,8 @@ class KVHandout:
                       max_new_tokens=self.max_new_tokens,
                       temperature=self.temperature,
                       eos_token_id=self.eos_token_id, on_token=on_token,
-                      request_id=self.request_id, tenant=self.tenant)
+                      request_id=self.request_id, tenant=self.tenant,
+                      adapter=self.adapter)
         req.trace_id = self.trace_id
         st = RequestState(req)
         st.kv_len = int(self.kv_len)
@@ -185,6 +190,7 @@ class KVHandout:
                 "temperature": self.temperature,
                 "eos_token_id": self.eos_token_id,
                 "tenant": self.tenant, "trace_id": self.trace_id,
+                "adapter": self.adapter,
                 "kv_len": self.kv_len,
                 "pending_token": self.pending_token,
                 "output_ids": list(self.output_ids),
@@ -232,7 +238,8 @@ class KVHandout:
             handoffs=int(meta["handoffs"]),
             submit_t=float(meta["submit_t"]),
             first_token_t=meta["first_token_t"],
-            pages=int(meta["pages"]), payload=payload)
+            pages=int(meta["pages"]), payload=payload,
+            adapter=meta.get("adapter"))
 
 
 # ---------------------------------------------------------------------------
@@ -680,8 +687,19 @@ class DisaggReplicaSet(EngineReplicaSet):
 
     # requires-lock: _lock — places into _states/_placements
     def _adopt(self, tgt: int, st, rid: str) -> None:
-        self.replicas[tgt]._states[rid] = st
-        self.replicas[tgt].scheduler.requeue(st)
+        eng = self.replicas[tgt]
+        if eng.lora is not None and st.request.adapter is not None:
+            # the prefill engine released its reference at handoff
+            # commit; adoption bypasses admit_handout, so re-resolve
+            # the slot and re-acquire BEFORE any state lands (same
+            # order as admit_handout): a typed UnknownAdapter from an
+            # evict that raced the zero-ref handoff window must not
+            # leave a half-adopted request on tgt's scheduler
+            st.request.adapter_slot = eng.lora.slot_of(
+                st.request.adapter)
+            eng.lora.acquire(st.request.adapter, rid)
+        eng._states[rid] = st
+        eng.scheduler.requeue(st)
         self._placements[rid] = tgt
 
     # requires-lock: _lock

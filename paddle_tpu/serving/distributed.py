@@ -264,6 +264,16 @@ class EngineReplicaSet:
                     "replica can hold any admitted request, so "
                     "max_seq_len, page_size, num_blocks, KV dims and "
                     "pool dtype must match")
+        pools = {id(getattr(e, "lora", None)) for e in engines}
+        if len(pools) > 1:
+            # migration moves RequestStates between replicas WITHOUT
+            # re-admission, so Request.adapter_slot must stay valid on
+            # the destination — one shared LoRAPool guarantees that
+            # (distinct pools could map the same name to different
+            # slots, silently decoding with another tenant's weights)
+            raise ValueError(
+                "replicas of one set must share a single LoRAPool "
+                "object (or none) — docs/SERVING.md \"Multi-LoRA\"")
         self.replicas = engines
         self.prefix_affinity = bool(prefix_affinity)
         self.max_seq_len = head.max_seq_len
@@ -322,6 +332,17 @@ class EngineReplicaSet:
         a request no single replica can hold must shed up front as
         ``budget``, not be answered admitted and dropped at pump."""
         return self.replicas[0].kv.num_blocks
+
+    @property
+    def lora(self):
+        """The set's shared LoRAPool (construction enforces one object
+        across replicas) — the FrontDoor validates tenant→adapter
+        mappings against this, exactly as on a plain Engine."""
+        return getattr(self.replicas[0], "lora", None)
+
+    def lora_stats(self):
+        """Multi-LoRA pool counters (the shared pool's — not summed)."""
+        return self.replicas[0].lora_stats()
 
     # requires-lock: _lock
     def has_work(self) -> bool:
@@ -405,7 +426,7 @@ class EngineReplicaSet:
                 self._ttft_p95(i), i)
 
     # requires-lock: _lock
-    def _pick_replica(self, prompt_ids) -> tuple:
+    def _pick_replica(self, prompt_ids, adapter=None) -> tuple:
         """(replica index, affinity page hits, page keys) for one
         prompt.  The chained page digests are hashed ONCE here and
         forwarded to the chosen engine's submit, which would otherwise
@@ -429,9 +450,13 @@ class EngineReplicaSet:
                 if pc is None:
                     continue
                 if keys is None:
+                    # same adapter-salted chain as scheduler.submit:
+                    # the affinity probe must see the keys admission
+                    # will use, or the pin lands on the wrong replica
                     keys = PrefixCache.page_keys(
                         np.asarray(prompt_ids, np.int32).reshape(-1),
-                        self.page_size)
+                        self.page_size,
+                        salt=adapter.encode() if adapter else b"")
                 if keys:
                     by_hits[i] = len(pc.lookup(keys))
             hits = max(by_hits.values()) if by_hits else 0
@@ -459,7 +484,8 @@ class EngineReplicaSet:
             raise AdmissionError(
                 f"request_id {rid!r} is already in use by a live or "
                 "retained request (on any replica)")
-        idx, hits, keys = self._pick_replica(prompt_ids)
+        idx, hits, keys = self._pick_replica(prompt_ids,
+                                             kw.get("adapter"))
         if keys is not None:
             kw["_page_keys"] = keys
         rid = self.replicas[idx].add_request(prompt_ids, **kw)

@@ -91,7 +91,8 @@ from ..nn.layer import _swapped_params, functional_call, serving_params
 from ..resilience import _state as _rs_state
 from ..resilience.retry import RetryPolicy
 from .block_allocator import PagedKVCache, PrefixCache, SwapManager
-from .errors import AdmissionError, BudgetUnsatisfiable, QueueFull
+from .errors import (AdmissionError, BudgetUnsatisfiable, QueueFull,
+                     UnknownAdapter)
 from .scheduler import Request, RequestState, Scheduler
 
 __all__ = ["Engine", "TokenEvent"]
@@ -254,6 +255,22 @@ class Engine:
     contract, greedy outputs token-identical to the single-chip engine
     (docs/SERVING.md "Sharded serving").
 
+    ``lora``: a :class:`serving.LoRAPool` makes this engine MULTI-LORA
+    (docs/SERVING.md "Multi-LoRA"): many fine-tuned adapters resident
+    at once as stacked low-rank deltas, each request naming its adapter
+    at ``add_request(adapter=...)`` (``FrontDoor`` maps tenants via
+    ``TenantPolicy(adapter=)``).  The per-slot adapter index rides
+    ``span_arrays`` as DATA into the one compiled step, where the
+    grouped BGMV (``incubate.nn.functional.lora_bgmv``) adds
+    ``x @ A_i @ B_i`` to every LoRA-targeted projection — mixed
+    adapters in one batch, zero recompiles on adapter load/evict
+    (buffer writes into the fixed-shape stacks), and base-model
+    requests ride slot 0's exact no-op bitwise-unchanged.  Greedy
+    outputs under adapter ``k`` are token-identical to a merged-weight
+    (``W + B_k A_k``) model.  The LoRA engine pins the UNFUSED
+    qkv/MLP projection path (the deltas inject pre-RoPE and around the
+    activation, which the fused single-pass kernels cannot expose).
+
     ``role``: disaggregated serving (docs/SERVING.md "Disaggregated
     serving").  ``"both"`` (default) is the colocated engine above.
     ``"prefill"`` retires every request at prefill-complete — the first
@@ -284,7 +301,8 @@ class Engine:
                  slo_capture=None,
                  spec_decode: bool = False,
                  draft_depth: int = 4,
-                 role: str = "both"):
+                 role: str = "both",
+                 lora=None):
         if not _paged_supported(model):
             raise NotImplementedError(
                 f"{type(model).__name__} does not support the paged "
@@ -339,6 +357,16 @@ class Engine:
             raise ValueError(
                 f"max_seq_len={max_seq_len} exceeds the model's "
                 f"max_position_embeddings={max_pos}")
+        if weight_quant is not None and lora is not None:
+            # the stacked-delta path targets the model's float 2-D
+            # projection weights; quantized layers keep int codes +
+            # separate scales, so the pool's geometry check (and the
+            # merged-weight identity contract) cannot hold — reject
+            # loudly instead of failing with a misleading shape error
+            raise ValueError(
+                "Engine(lora=...) does not compose with weight_quant "
+                "yet — serve LoRA adapters on the float decode path "
+                "(docs/SERVING.md \"Multi-LoRA\")")
         if weight_quant is not None:
             # decode weight path (docs/KERNELS.md): swap the model's
             # Linears for weight-only quantized variants IN PLACE (the
@@ -436,6 +464,13 @@ class Engine:
         # re-prefill fallback after a hard transfer failure.  _handoff_ok
         # is an optional veto hook the replica set installs (e.g. "no
         # healthy decode replica right now" → keep decoding locally).
+        # batched multi-LoRA (docs/SERVING.md "Multi-LoRA"): the stacked
+        # adapter pools ride every step as fixed-shape jit inputs, so
+        # the pool may be hot-loaded/evicted between steps (value edits
+        # only — the zero-recompile contract extends to adapter churn)
+        if lora is not None:
+            lora.validate(model)
+        self.lora = lora
         self.role = role
         self._handoff_ok: Optional[Callable[[], bool]] = None
         self.handed_off: "collections.deque[RequestState]" = \
@@ -455,7 +490,7 @@ class Engine:
                 return model.logits(hidden)[:, 0]
 
         def step_fn(params, caches, tokens, tables, starts, lens, temps,
-                    key, seeds, emit):
+                    key, seeds, emit, lora_ab, adapters):
             """The ONE serving program: every slot's span (prefill
             chunk, decode token, or decode-plus-draft verify span)
             writes its KV and attends in a single ragged dispatch.
@@ -464,12 +499,17 @@ class Engine:
             it); speculative engines sample EVERY span position — the
             per-position argmax IS the verification (position ``j``'s
             sample is the model's token after consuming draft ``j``),
-            so accept/reject needs no second dispatch."""
+            so accept/reject needs no second dispatch.  ``lora_ab`` is
+            the stacked adapter pytree (None on non-LoRA engines — the
+            model path is then byte-for-byte today's) and ``adapters``
+            the per-slot stack indices the grouped BGMV gathers by."""
             mp = {k[len("model."):]: v for k, v in params.items()
                   if k.startswith("model.")}
             hidden, caches = functional_call(
                 model.model, mp, tokens, caches=caches, seq_lens=lens,
-                block_tables=tables, span_starts=starts, training=False)
+                block_tables=tables, span_starts=starts,
+                lora=None if lora_ab is None else (lora_ab, adapters),
+                training=False)
             if spec:
                 with _swapped_params(model, params):
                     lg = model.logits(hidden)          # (B, C, V)
@@ -489,6 +529,14 @@ class Engine:
         # pools are donated: the engine owns exactly one copy in HBM
         self._step_fn = jax.jit(step_fn, donate_argnums=(1,))
         self._cow_fn = jax.jit(cow_fn, donate_argnums=(0,))
+
+    def _lora_stacks(self):
+        """The stacked adapter pytree threaded through every step — the
+        pool's cached device arrays (fixed shapes, so a hot load/evict
+        between steps is a new VALUE at the same jit entry), or None
+        when this engine serves the base model only."""
+        return self.lora.device_stacks() if self.lora is not None \
+            else None
 
     def _trace_mesh(self):
         """Mesh-override context for trace-triggering calls: under a
@@ -521,7 +569,8 @@ class Engine:
                 jnp.asarray(np.zeros((b, c), np.int32)), jnp.asarray(oob),
                 jnp.asarray(zeros_i), jnp.asarray(zeros_i),
                 jnp.asarray(np.zeros((b,), np.float32)),
-                self._key, jnp.asarray(zeros_i), jnp.asarray(zeros_i))
+                self._key, jnp.asarray(zeros_i), jnp.asarray(zeros_i),
+                self._lora_stacks(), jnp.asarray(zeros_i))
             jax.block_until_ready(nxt)
             self.kv.caches = caches
             pad = np.full((b,), self.kv.oob_block, np.int32)
@@ -530,6 +579,10 @@ class Engine:
             jax.block_until_ready(jax.tree_util.tree_leaves(caches)[0])
             self.kv.caches = caches
             self._swap.warmup()
+            if self.lora is not None:
+                # compile the pool's per-slot scatter programs here so
+                # hot-load/evict under churn stays at 0 compiles
+                self.lora.prime_updates()
         # only AFTER the work: a failed warmup must leave step_begin's
         # auto-warmup safety net armed for mesh engines
         self._warmed = True
@@ -544,23 +597,70 @@ class Engine:
                     on_token: Optional[Callable] = None,
                     request_id: Optional[str] = None,
                     tenant: Optional[str] = None,
+                    adapter: Optional[str] = None,
                     _page_keys: Optional[List[bytes]] = None) -> str:
         """Queue one request; returns its id.  The request joins the
         running batch at the next ``step()`` with a free slot and enough
         free blocks for its budget (prompt + max_new_tokens, minus any
-        prefix-cache hit).
+        prefix-cache hit).  ``adapter`` names a LoRA adapter resident in
+        this engine's pool (``Engine(lora=...)``); the request then
+        decodes through ``W + B_k A_k`` while sharing the batch, the
+        cache and the one compiled step with every other tenant.
 
         Rejections are typed (``serving.errors``, all ``ValueError``
         subclasses): :class:`QueueFull` when ``max_queue`` is set and
         the waiting queue is at capacity (transient — retry later),
         :class:`BudgetUnsatisfiable` when the request can never fit this
-        engine's geometry, plain :class:`AdmissionError` for a duplicate
+        engine's geometry, :class:`UnknownAdapter` for an adapter this
+        engine has not loaded (validated HERE, at admission — a bad
+        tenant→model mapping must never strand a half-admitted
+        request), plain :class:`AdmissionError` for a duplicate
         ``request_id``."""
         req = Request(prompt_ids=prompt_ids,
                       max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature),
                       eos_token_id=eos_token_id, on_token=on_token,
-                      request_id=request_id, tenant=tenant)
+                      request_id=request_id, tenant=tenant,
+                      adapter=adapter)
+        if adapter is not None:
+            if self.lora is None:
+                raise UnknownAdapter(
+                    f"request names adapter {adapter!r} but this engine "
+                    "has no LoRA pool (Engine(lora=serving.LoRAPool(...)))")
+            req.adapter_slot = self.lora.slot_of(adapter)
+            # refcount from the moment the slot resolves (released below
+            # on any rejection): an evict racing the admission checks
+            # must hit typed AdapterInUse, never strand a half-admitted
+            # request on a vanished slot
+            self.lora.acquire(adapter, req.request_id)
+        try:
+            self._admission_checks(req, _page_keys=_page_keys)
+        except Exception:
+            if adapter is not None:
+                self.lora.release(adapter, req.request_id)
+            raise
+        tr = _obs_state.TRACE[0]
+        if tr is not None:
+            # get-or-create: a door-submitted request already began its
+            # trace at door submit (queue time there is queue time here)
+            req.trace_id = tr.begin(
+                req.request_id, tenant=req.tenant, trace_id=req.trace_id,
+                prompt_len=int(req.prompt_ids.size),
+                max_new=req.max_new_tokens)
+        reg = obs.get_registry()
+        if reg is not None:
+            reg.counter("serve.requests").inc()
+            reg.gauge("serve.queue_depth").set(self.scheduler.queue_depth())
+            if adapter is not None:
+                reg.counter(
+                    f"serve.lora.adapter[{adapter}].requests").inc()
+        return req.request_id
+
+    # add_request's validate+submit body, split out so the adapter
+    # refcount above wraps EVERY rejection path
+    # requires-lock: _lock — touches _states
+    def _admission_checks(self, req: Request,
+                          _page_keys: Optional[List[bytes]] = None):
         if req.request_id in self._states:
             # a silent overwrite would orphan the first request's slot /
             # blocks bookkeeping and lose its output
@@ -593,18 +693,6 @@ class Engine:
         # O(prompt) blake2b chain (serving/distributed.py)
         st = self.scheduler.submit(req, page_keys=_page_keys)
         self._states[req.request_id] = st
-        tr = _obs_state.TRACE[0]
-        if tr is not None:
-            # get-or-create: a door-submitted request already began its
-            # trace at door submit (queue time there is queue time here)
-            req.trace_id = tr.begin(
-                req.request_id, tenant=req.tenant, trace_id=req.trace_id,
-                prompt_len=p, max_new=req.max_new_tokens)
-        reg = obs.get_registry()
-        if reg is not None:
-            reg.counter("serve.requests").inc()
-            reg.gauge("serve.queue_depth").set(self.scheduler.queue_depth())
-        return req.request_id
 
     # requires-lock: _lock
     def output_ids(self, request_id: str) -> List[int]:
@@ -879,6 +967,12 @@ class Engine:
         st.swapped = (pages, host)
         st.handoffs += 1
         self.handoffs += 1
+        if self.lora is not None and st.request.adapter is not None:
+            # the request leaves THIS engine; the decode tier's
+            # admit_handout re-acquires on its pool (same object
+            # in-process — the id-keyed refcount makes that a no-op
+            # overlap, not a double count)
+            self.lora.release(st.request.adapter, st.request.request_id)
         self.handed_off.append(st)
         reg = obs.get_registry()
         if reg is not None:
@@ -910,6 +1004,16 @@ class Engine:
             raise AdmissionError(
                 f"request_id {rid!r} is already in use by a live or "
                 "retained request")
+        if req.adapter is not None:
+            # the adapter NAME is the wire identity; the slot index is
+            # engine-local and re-resolves against THIS engine's pool
+            # (typed UnknownAdapter before any state lands — disagg
+            # tiers must load the same adapters)
+            if self.lora is None:
+                raise UnknownAdapter(
+                    f"handout {rid!r} names adapter {req.adapter!r} but "
+                    "this engine has no LoRA pool")
+            req.adapter_slot = self.lora.slot_of(req.adapter)
         total = int(req.prompt_ids.size) + req.max_new_tokens
         if total > self.max_seq_len or \
                 self.scheduler.blocks_for(total) > self.kv.num_blocks:
@@ -937,6 +1041,10 @@ class Engine:
                     "head_dim / cache dtype must agree across roles)")
         self._states[rid] = st
         self.scheduler.requeue(st, head=head)
+        if self.lora is not None and req.adapter is not None:
+            # request-id keyed: re-acquire after a shared-pool handoff
+            # is idempotent, a distinct-pool decode tier counts its own
+            self.lora.acquire(req.adapter, rid)
         tr = _obs_state.TRACE[0]
         if tr is not None:
             # get-or-create keyed by request id: in-process, the trace
@@ -976,6 +1084,10 @@ class Engine:
         done_len = len(st.output_ids) >= req.max_new_tokens
         if done_eos or done_len:
             self.scheduler.finish(st, "eos" if done_eos else "length")
+            if self.lora is not None and req.adapter is not None:
+                # the adapter's slot becomes evictable once its last
+                # live reader retires
+                self.lora.release(req.adapter, req.request_id)
             if self.spec is not None:
                 # bounded proposer retention: the n-gram index dies
                 # with the request (it rebuilds lazily if the id is
@@ -1066,6 +1178,14 @@ class Engine:
                     "errors": 0, "tracked_requests": 0}
         return self.spec.stats()
 
+    def lora_stats(self) -> Dict[str, float]:
+        """Multi-LoRA pool counters (active_adapters/max_adapters/rank/
+        loads/evictions/live_refs) — zeros when no pool is attached."""
+        if self.lora is None:
+            return {"active_adapters": 0, "max_adapters": 0, "rank": 0,
+                    "loads": 0, "evictions": 0, "live_refs": 0}
+        return self.lora.stats()
+
     def step_begin(self):
         """Admit + plan + CoW + DISPATCH the compiled step without
         waiting for the device; returns the opaque pending handle
@@ -1091,21 +1211,24 @@ class Engine:
             live_tokens = sum(n for _, _, n, _ in plan)
             nxt = None
             if plan:
-                tokens, tables, starts, lens, temps, seeds, emit = \
-                    self.scheduler.span_arrays(
-                        plan, self.prefill_chunk,
-                        spec_emit=self.spec is not None)
+                (tokens, tables, starts, lens, temps, seeds, emit,
+                 adapters) = self.scheduler.span_arrays(
+                    plan, self.prefill_chunk,
+                    spec_emit=self.spec is not None)
                 # device_put of ready numpy arrays only: jnp.asarray of
                 # a Python list/scalar traces a tiny program whose
                 # one-off compile would break the zero-compiles-after-
                 # warmup contract — draft length reaches the step ONLY
                 # inside these traced arrays (span lens/tokens), never
-                # as a per-step Python scalar (pdtpu-lint R4f)
+                # as a per-step Python scalar (pdtpu-lint R4f).  The
+                # same rule covers adapter ids: per-slot DATA in the
+                # adapters array, never a static argument.
                 nxt, caches = self._step_fn(
                     self.params, self.kv.caches, jnp.asarray(tokens),
                     jnp.asarray(tables), jnp.asarray(starts),
                     jnp.asarray(lens), jnp.asarray(temps), self._key,
-                    jnp.asarray(seeds), jnp.asarray(emit))
+                    jnp.asarray(seeds), jnp.asarray(emit),
+                    self._lora_stacks(), jnp.asarray(adapters))
                 self.kv.caches = caches
         # busy accounting covers THIS engine's own engagement only
         # (begin and finish timed separately): under a replica set the
@@ -1117,6 +1240,7 @@ class Engine:
         self.busy_s += begin_s
         return plan, nxt, live_tokens, begin_s
 
+    # requires-lock: _lock — reads _states (per-adapter token counters)
     def step_finish(self, pending) -> List[TokenEvent]:
         """Wait for a :meth:`step_begin` dispatch and run its host
         post-processing: sample consumption, retirement, events,
@@ -1141,6 +1265,20 @@ class Engine:
         reg = obs.get_registry()
         if reg is not None and plan:
             reg.counter("serve.tokens").inc(n_tok)
+            if self.lora is not None:
+                # per-adapter token accounting AFTER isolation filtered
+                # the events (a rewound span's tokens re-emit after
+                # restore and must not count twice); aggregated first so
+                # the registry sees one inc per adapter, not per token
+                per_ad: Dict[str, int] = {}
+                for ev in events:
+                    est = self._states.get(ev.request_id)
+                    ad = est.request.adapter if est is not None else None
+                    if ad is not None:
+                        per_ad[ad] = per_ad.get(ad, 0) + 1
+                for ad, n in per_ad.items():
+                    reg.counter(
+                        f"serve.lora.adapter[{ad}].tokens").inc(n)
             reg.gauge("serve.tok_s").set(round(n_tok / max(dt, 1e-9), 1))
             reg.gauge("serve.queue_depth").set(self.scheduler.queue_depth())
             reg.gauge("serve.kv_blocks_used").set(
@@ -1268,7 +1406,7 @@ class Engine:
                                 "serve.prefix_misses").inc(misses)
                     obs.emit_event(
                         "serve_request", id=req.request_id,
-                        tenant=req.tenant,
+                        tenant=req.tenant, adapter=req.adapter,
                         prompt_len=int(req.prompt_ids.size),
                         slot=st.slot, blocks=len(st.blocks),
                         cached_tokens=st.cached_tokens)

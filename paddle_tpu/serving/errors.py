@@ -26,8 +26,8 @@ from __future__ import annotations
 
 from typing import Optional
 
-__all__ = ["AdmissionError", "BudgetUnsatisfiable", "QueueFull",
-           "RateLimited"]
+__all__ = ["AdapterInUse", "AdmissionError", "BudgetUnsatisfiable",
+           "QueueFull", "RateLimited", "UnknownAdapter"]
 
 
 class AdmissionError(ValueError):
@@ -62,3 +62,18 @@ class RateLimited(AdmissionError):
     def __init__(self, message: str, retry_after_s: float):
         super().__init__(message)
         self.retry_after_s = float(retry_after_s)
+
+
+class UnknownAdapter(AdmissionError):
+    """The request names a LoRA adapter this engine has not loaded
+    (``serving.LoRAPool`` — docs/SERVING.md "Multi-LoRA").  Raised at
+    admission (``Engine.add_request`` / ``FrontDoor.submit``), never
+    mid-decode: tenant→adapter mapping is validated before any state
+    lands, so a bad mapping cannot strand a half-admitted request."""
+
+
+class AdapterInUse(ValueError):
+    """``LoRAPool.evict`` refused: live requests still reference the
+    adapter's slot.  Evicting under readers would repoint their slot at
+    zeros (or a later adapter's weights) mid-decode — the caller must
+    drain or wait, not corrupt."""

@@ -43,7 +43,7 @@ from typing import Callable, Dict, List, NamedTuple, Optional, Set
 from .. import observability as obs
 from ..observability import _state as _obs_state
 from .errors import (AdmissionError, BudgetUnsatisfiable, QueueFull,
-                     RateLimited)
+                     RateLimited, UnknownAdapter)
 from .scheduler import Request, RequestState
 
 __all__ = ["Admission", "FrontDoor", "TenantPolicy", "TokenBucket"]
@@ -91,13 +91,19 @@ class TenantPolicy:
     ``rate_tokens_per_s`` / ``burst_tokens``: token-bucket rate limit
     over the request token cost (prompt + max_new_tokens); None = no
     limit.  ``max_live_requests``: cap on this tenant's queued + active
-    requests; None = no quota."""
+    requests; None = no quota.  ``adapter``: the tenant's LoRA adapter
+    (docs/SERVING.md "Multi-LoRA") — every submission for this tenant
+    decodes through that adapter's stacked weights unless the call
+    names one explicitly; validated against the engine's
+    ``serving.LoRAPool`` at submit (typed
+    :class:`~paddle_tpu.serving.errors.UnknownAdapter`)."""
 
     priority: int = 0
     weight: float = 1.0
     rate_tokens_per_s: Optional[float] = None
     burst_tokens: Optional[float] = None
     max_live_requests: Optional[int] = None
+    adapter: Optional[str] = None
 
 
 class TokenBucket:
@@ -141,7 +147,8 @@ class Admission(NamedTuple):
     admitted: bool
     request_id: Optional[str]
     reason: Optional[str]        # None | "rate_limited" | "quota" |
-    #                              "queue_full" | "slo_shed" | "budget"
+    #                              "queue_full" | "slo_shed" | "budget" |
+    #                              "unknown_adapter" (evicted at pump)
     retry_after_s: Optional[float]
 
 
@@ -330,18 +337,32 @@ class FrontDoor:
                eos_token_id: Optional[int] = None,
                on_token: Optional[Callable] = None,
                request_id: Optional[str] = None,
+               adapter: Optional[str] = None,
                raise_on_shed: bool = False) -> Admission:
         """Admit or shed one request; always returns an
         :class:`Admission` (malformed requests — empty prompt, bad
-        max_new_tokens, duplicate id — still raise, they are caller
-        bugs, not load)."""
+        max_new_tokens, duplicate id, an adapter the engine has not
+        loaded — still raise, they are caller bugs, not load).
+        ``adapter`` overrides the tenant policy's ``adapter`` mapping
+        for this one request."""
         pol = self.policy(tenant)
+        eng = self.engine
+        ad = adapter if adapter is not None else pol.adapter
+        if ad is not None:
+            # tenant→model mapping validated at the DOOR, before any
+            # queueing: a bad mapping answers typed at submit instead of
+            # shedding mysteriously at pump time
+            pool = getattr(eng, "lora", None)
+            if pool is None:
+                raise UnknownAdapter(
+                    f"tenant {tenant!r} maps to adapter {ad!r} but the "
+                    "engine has no LoRA pool (Engine(lora=...))")
+            pool.slot_of(ad)          # raises UnknownAdapter if absent
         req = Request(prompt_ids=prompt_ids,
                       max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature),
                       eos_token_id=eos_token_id, on_token=on_token,
-                      request_id=request_id, tenant=tenant)
-        eng = self.engine
+                      request_id=request_id, tenant=tenant, adapter=ad)
         p = int(req.prompt_ids.size)
         cost = p + req.max_new_tokens
         if req.request_id in eng._states or any(
@@ -409,6 +430,15 @@ class FrontDoor:
                     tenant, "rate_limited", wait, raise_on_shed,
                     f"tenant {tenant!r} over its token rate "
                     f"({pol.rate_tokens_per_s}/s); retry in {wait}s")
+        if ad is not None:
+            # hold a door-level reference from ADMISSION (same
+            # request-id the engine acquires at add_request, so the
+            # overlap is a no-op in the id-keyed set): once answered
+            # admitted=True, the adapter cannot be evicted out from
+            # under a door-queued request (typed AdapterInUse at the
+            # evict) — pump can never strand a vetted request on a
+            # vanished adapter
+            self.engine.lora.acquire(ad, req.request_id)
         self._queues.setdefault(
             tenant, collections.deque()).append(
                 _Pending(req, tenant, cost, time.perf_counter()))
@@ -513,12 +543,20 @@ class FrontDoor:
                     req.prompt_ids, max_new_tokens=req.max_new_tokens,
                     temperature=req.temperature,
                     eos_token_id=req.eos_token_id, on_token=req.on_token,
-                    request_id=req.request_id, tenant=pnd.tenant)
+                    request_id=req.request_id, tenant=pnd.tenant,
+                    adapter=req.adapter)
             except QueueFull:
                 # transient: the engine's own max_queue bound tripped —
                 # the request stays OURS (front of its tenant queue) and
                 # feeds once the staging drains; it was already answered
-                # admitted=True, so it must not be shed as permanent
+                # admitted=True, so it must not be shed as permanent.
+                # add_request released the shared id-keyed adapter ref
+                # on its way out — re-take it, or the door-queued
+                # request loses its evict protection (AdapterInUse)
+                if req.adapter is not None:
+                    pool = getattr(self.engine, "lora", None)
+                    if pool is not None:
+                        pool.acquire(req.adapter, req.request_id)
                 self._queues[pnd.tenant].appendleft(pnd)
                 break
             except AdmissionError as e:
@@ -527,6 +565,12 @@ class FrontDoor:
                 # instead of wedging the tenant queue behind it
                 self._outstanding.get(pnd.tenant, set()).discard(
                     req.request_id)
+                if req.adapter is not None:
+                    # the door's admission-time adapter reference must
+                    # not outlive the request it protected
+                    pool = getattr(self.engine, "lora", None)
+                    if pool is not None:
+                        pool.release(req.adapter, req.request_id)
                 tr = _obs_state.TRACE[0]
                 if tr is not None:
                     # the trace begun at door submit must not stay live
@@ -537,7 +581,10 @@ class FrontDoor:
                     # caller's uniqueness contract, and bounding the
                     # tracer beats preserving an ambiguous timeline.)
                     tr.retire(req.request_id, reason="shed")
-                self._shed(pnd.tenant, "budget", None, False, str(e))
+                self._shed(pnd.tenant,
+                           "unknown_adapter" if isinstance(
+                               e, UnknownAdapter) else "budget",
+                           None, False, str(e))
                 continue
             # TTFT starts at DOOR submission: time queued here is load
             # the serve.ttft_ms signal (and the SLO shed driven by it)

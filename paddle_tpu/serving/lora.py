@@ -1,0 +1,409 @@
+"""Batched multi-LoRA serving: stacked adapter pools for the one
+compiled step (docs/SERVING.md "Multi-LoRA").
+
+The tenancy problem this solves (ROADMAP item 4a): without it, every
+fine-tuned tenant model needs its OWN engine — N adapters means N full
+weight copies, N compiled programs, N half-empty batches.  With it, one
+engine holds every adapter's low-rank deltas STACKED along a leading
+adapter axis — per LoRA-targeted projection ``p`` of every decoder
+layer, ``a[p]`` is ``(num_adapters+1, d_in, r)`` and ``b[p]`` is
+``(num_adapters+1, r, d_out)`` — and each batch slot carries its
+adapter INDEX as per-slot data (``scheduler.span_arrays``), so a mixed
+batch of tenants rides the same compiled ``(B, C)`` ragged step the
+base model uses.  The grouped BGMV (``incubate.nn.functional.lora_bgmv``
+→ ``ops/pallas/lora_matmul.py`` on TPU) gathers each slot's ``A_i``/
+``B_i`` by that index and adds ``x @ A_i @ B_i`` to the base
+projection.
+
+Zero-recompile contract: the stacks are jit INPUTS of fixed shape, so
+loading or evicting an adapter is a buffer write (host mirror edit +
+``device_put``) — never a retrace.  Slot 0 is reserved as the EXACT
+no-op (all-zero ``A``/``B``): a base-model request contributes
+``x @ 0 @ 0 == 0.0`` and its outputs stay bitwise identical to a
+LoRA-less engine; on TPU the kernel skips slot-0 rows outright.
+
+Lifecycle: adapters are registered by NAME (``load``), mapped to slots
+on a free list, and refcounted by the LIVE REQUEST IDS using them
+(``acquire``/``release`` — the Engine calls these at admission and
+retirement; request-id keyed, so the preempt→restore, DP-migration and
+disagg-handoff paths can re-acquire idempotently).  ``evict`` of a
+referenced adapter raises the typed :class:`errors.AdapterInUse`
+instead of repointing live slots at garbage.  ``alpha / rank`` is
+folded into ``B`` at load time, so the serving delta is a plain
+two-matmul chain and the merged-weight reference is
+``W + A @ (B * alpha/r)`` (:func:`merge_adapter`).
+
+One pool may back several engines (a DP replica set MUST share one —
+slot indices ride ``Request.adapter_slot`` across migration); the
+device arrays are plain jit inputs, so sharing is safe.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .. import observability as obs
+from .errors import AdapterInUse, UnknownAdapter
+
+__all__ = ["LoRAPool", "merge_adapter", "random_adapter"]
+
+
+def _decoder_layers(model) -> list:
+    """The decoder-layer list of a paged-serving CausalLM (Llama's
+    ``model.layers`` / GPT's ``model.h``), RecomputeWrapper unwrapped."""
+    from ..distributed.recompute import RecomputeWrapper
+    mdl = getattr(model, "model", None)
+    if mdl is None:
+        raise ValueError(
+            f"{type(model).__name__} is not a CausalLM (no .model)")
+    for attr in ("layers", "h"):
+        ll = getattr(mdl, attr, None)
+        if ll is not None and hasattr(ll, "__iter__"):
+            return [l.inner if isinstance(l, RecomputeWrapper) else l
+                    for l in ll]
+    raise ValueError(
+        f"{type(mdl).__name__} has no decoder-layer list "
+        "(expected .layers or .h)")
+
+
+def _targets(layer) -> Dict[str, Tuple[int, int]]:
+    """LoRA-targeted projections of one decoder layer: every 2-D weight
+    parameter (q/k/v/o + gate/up/down on Llama; qkv/out + fc_in/fc_out
+    on GPT — norms and biases are 1-D and excluded), keyed by its
+    dotted path minus ``.weight`` — the same key the model forwards
+    index the per-layer pack by."""
+    out = {}
+    for path, p in layer.named_parameters():
+        if path.endswith(".weight") and getattr(p, "ndim", 0) == 2:
+            out[path[:-len(".weight")]] = (int(p.shape[0]),
+                                           int(p.shape[1]))
+    if not out:
+        raise ValueError(
+            f"{type(layer).__name__} exposes no 2-D projection weights "
+            "to target (is the model already weight-quantized? build "
+            "the LoRAPool BEFORE Engine(weight_quant=...))")
+    return out
+
+
+class LoRAPool:
+    """Stacked multi-adapter LoRA weights for one model geometry.
+
+    ``max_adapters`` named adapters can be resident at once (slot 0 is
+    the reserved base no-op on top of that).  ``rank`` is the shared
+    LoRA rank r; ``alpha`` the scaling numerator (default ``rank``, i.e.
+    scale 1.0) folded into ``B`` at load.  ``dtype`` defaults to the
+    model's config dtype — the stacks are cast there on device upload,
+    matching what the projections compute in.
+
+    The HOST mirror (float32 numpy) is authoritative; ``device_stacks``
+    lazily uploads and caches the jit-input pytree, invalidated by
+    ``load``/``evict``.  Uploads are ``device_put`` only — no program
+    ever compiles on adapter churn (the serving-smoke gate's multi-LoRA
+    pass pins this).
+    """
+
+    def __init__(self, model, *, max_adapters: int = 8, rank: int = 8,
+                 alpha: Optional[float] = None, dtype=None):
+        if max_adapters < 1:
+            raise ValueError(f"max_adapters must be >= 1, got "
+                             f"{max_adapters}")
+        if rank < 1:
+            raise ValueError(f"rank must be >= 1, got {rank}")
+        layers = _decoder_layers(model)
+        self.targets = _targets(layers[0])
+        for i, l in enumerate(layers[1:], 1):
+            if _targets(l) != self.targets:
+                raise ValueError(
+                    f"decoder layer {i} exposes different projections "
+                    "than layer 0 — heterogeneous stacks are not "
+                    "supported")
+        self.num_layers = len(layers)
+        self.max_adapters = int(max_adapters)
+        self.rank = int(rank)
+        self.alpha = float(alpha) if alpha is not None else float(rank)
+        self.dtype = dtype if dtype is not None else \
+            getattr(model.cfg, "dtype", "float32")
+        n = self.max_adapters + 1      # +1: slot 0 = exact no-op
+        # host mirror: per layer, per projection, f32 zero stacks
+        self._host: List[Dict[str, Dict[str, np.ndarray]]] = [
+            {p: {"a": np.zeros((n, di, self.rank), np.float32),
+                 "b": np.zeros((n, self.rank, do), np.float32)}
+             for p, (di, do) in self.targets.items()}
+            for _ in range(self.num_layers)]
+        self._device = None            # lazy jit-input pytree cache
+        self._slots: Dict[str, int] = {}          # name -> slot (>= 1)
+        self._free: List[int] = list(range(n - 1, 0, -1))  # pop() -> 1..
+        # live refs: adapter name -> request ids currently decoding with
+        # it (id-keyed so re-acquire across preempt/migration/handoff is
+        # idempotent; evict refuses while nonempty)
+        self._refs: Dict[str, Set[str]] = {}
+        self.loads = 0                 # lifetime load count
+        self.evictions = 0
+
+    # -- registry ----------------------------------------------------------
+
+    def has(self, name: str) -> bool:
+        return name in self._slots
+
+    def adapters(self) -> Dict[str, int]:
+        """{name: slot} for every resident adapter."""
+        return dict(self._slots)
+
+    @property
+    def active_adapters(self) -> int:
+        return len(self._slots)
+
+    def slot_of(self, name: str) -> int:
+        """Resolve an adapter name to its stack slot; typed
+        :class:`UnknownAdapter` when it is not resident."""
+        slot = self._slots.get(name)
+        if slot is None:
+            known = sorted(self._slots) or ["<none>"]
+            raise UnknownAdapter(
+                f"adapter {name!r} is not loaded in this pool "
+                f"(resident: {', '.join(known)}) — LoRAPool.load it "
+                "before admission")
+        return slot
+
+    def refcount(self, name: str) -> int:
+        return len(self._refs.get(name, ()))
+
+    # -- refcounts (Engine calls these; request-id keyed) ------------------
+
+    def acquire(self, name: str, request_id: str) -> None:
+        """Pin ``name`` for ``request_id`` (id-keyed set: idempotent).
+        Typed :class:`UnknownAdapter` when the adapter is not resident —
+        a blind ref on an evicted name would let its slot be zeroed or
+        reused under the request."""
+        self.slot_of(name)
+        self._refs.setdefault(name, set()).add(request_id)
+
+    def release(self, name: str, request_id: str) -> None:
+        refs = self._refs.get(name)
+        if refs is not None:
+            refs.discard(request_id)
+
+    # -- load / evict (value edits only — never a compile) -----------------
+
+    def load(self, name: str, weights: Sequence[Dict[str, tuple]]) -> int:
+        """Load (or hot-reload) adapter ``name``; returns its slot.
+
+        ``weights`` is a per-layer sequence of ``{proj: (A, B)}`` dicts
+        (``A (d_in, r)``, ``B (r, d_out)``; projections an adapter does
+        not target may be omitted — their delta stays zero).  Reloading
+        a resident name overwrites its slot in place (refcounts and the
+        slot index survive, so live requests see the new weights on
+        their next step — hot adapter UPDATE is the same buffer write
+        as hot load)."""
+        if len(weights) != self.num_layers:
+            raise ValueError(
+                f"adapter {name!r} carries {len(weights)} layers, pool "
+                f"expects {self.num_layers}")
+        scale = self.alpha / self.rank
+        # validate + normalize EVERY row before touching pool state: a
+        # mid-load failure must neither leak a popped slot nor leave a
+        # resident adapter half-overwritten (live requests would decode
+        # with mixed old/new layers on the next stack rebuild)
+        rows = []
+        for li, pack in enumerate(weights):
+            unknown = set(pack or {}) - set(self.targets)
+            if unknown:
+                # a misnamed key (e.g. PEFT-style 'q_proj' for
+                # 'self_attn.q_proj') silently loading as an all-zero
+                # adapter would serve base outputs under the tenant's
+                # name — reject loudly instead
+                raise ValueError(
+                    f"adapter {name!r} layer {li} targets unknown "
+                    f"projection(s) {sorted(unknown)} — this pool "
+                    f"targets {sorted(self.targets)}")
+            for proj, (di, do) in self.targets.items():
+                entry = (pack or {}).get(proj)
+                if entry is None:
+                    rows.append((li, proj, None, None))
+                    continue
+                a, b = entry
+                a = np.asarray(a, np.float32)
+                b = np.asarray(b, np.float32)
+                if a.shape != (di, self.rank) or \
+                        b.shape != (self.rank, do):
+                    raise ValueError(
+                        f"adapter {name!r} layer {li} {proj}: A{a.shape}"
+                        f"/B{b.shape} do not match ({di}, {self.rank})/"
+                        f"({self.rank}, {do})")
+                # alpha/r folds here: the serving delta is then the
+                # plain chain x @ A @ B and merge_adapter's reference
+                # is W + A @ (B * alpha/r) — one scale definition
+                rows.append((li, proj, a, b * scale))
+        slot = self._slots.get(name)
+        if slot is None:
+            if not self._free:
+                raise ValueError(
+                    f"pool is full ({self.max_adapters} adapters) — "
+                    f"evict one before loading {name!r}")
+            slot = self._free.pop()
+        for li, proj, a, b in rows:
+            ha = self._host[li][proj]["a"]
+            hb = self._host[li][proj]["b"]
+            ha[slot] = 0.0 if a is None else a
+            hb[slot] = 0.0 if b is None else b
+        self._slots[name] = slot
+        self._write_device_slot(slot)
+        self.loads += 1
+        reg = obs.get_registry()
+        if reg is not None:
+            reg.counter("serve.lora.loads").inc()
+            reg.gauge("serve.lora.active_adapters").set(
+                self.active_adapters)
+        obs.emit_event("serve_lora_load", adapter=name, slot=slot,
+                       rank=self.rank)
+        return slot
+
+    def evict(self, name: str) -> None:
+        """Free ``name``'s slot (zeroing its rows).  Typed
+        :class:`AdapterInUse` while live requests still reference it —
+        never corrupt a decoding slot."""
+        slot = self.slot_of(name)
+        refs = self._refs.get(name)
+        if refs:
+            raise AdapterInUse(
+                f"adapter {name!r} is referenced by {len(refs)} live "
+                f"request(s) (e.g. {sorted(refs)[0]!r}) — drain before "
+                "evicting")
+        for li in range(self.num_layers):
+            for proj in self.targets:
+                self._host[li][proj]["a"][slot] = 0.0
+                self._host[li][proj]["b"][slot] = 0.0
+        del self._slots[name]
+        self._refs.pop(name, None)
+        self._free.append(slot)
+        self._write_device_slot(slot)
+        self.evictions += 1
+        reg = obs.get_registry()
+        if reg is not None:
+            reg.counter("serve.lora.evictions").inc()
+            reg.gauge("serve.lora.active_adapters").set(
+                self.active_adapters)
+        obs.emit_event("serve_lora_evict", adapter=name, slot=slot)
+
+    # -- the jit-input pytree ----------------------------------------------
+
+    def _write_device_slot(self, slot: int) -> None:
+        """Scatter ONE slot's host rows into the cached device stacks —
+        adapter churn then moves O(one slot) bytes instead of
+        re-uploading the whole pool (num_slots× larger, on exactly the
+        hot-load path the feature advertises as cheap).  The row index
+        rides as a device scalar so every slot shares one compiled
+        scatter per entry geometry; :meth:`prime_updates` compiles them
+        at warmup, keeping churn inside the zero-compile contract."""
+        if self._device is None:
+            return                  # next device_stacks() builds fresh
+        idx = jnp.asarray(slot, jnp.int32)
+        for li in range(self.num_layers):
+            for proj in self.targets:
+                ent = self._device[li][proj]
+                hp = self._host[li][proj]
+                for k in ("a", "b"):
+                    row = jnp.asarray(hp[k][slot], dtype=self.dtype)
+                    ent[k] = ent[k].at[idx].set(row)
+
+    def prime_updates(self) -> None:
+        """Build the stacks and compile the per-slot scatter programs
+        (a no-op rewrite of slot 0's zero rows) so the first real
+        hot-load/evict after warmup hits the jit cache —
+        ``Engine.warmup()`` calls this inside its compile window."""
+        self.device_stacks()
+        self._write_device_slot(0)
+
+    def device_stacks(self):
+        """Per-layer ``{proj: {"a": (N, d_in, r), "b": (N, r, d_out)}}``
+        device arrays in the pool dtype — the fixed-shape jit input the
+        engine threads through the compiled step.  Built once by full
+        upload; adapter churn then edits slots in place
+        (:meth:`_write_device_slot`) — fixed shapes throughout, so the
+        step never retraces."""
+        if self._device is None:
+            self._device = [
+                {proj: {k: jnp.asarray(arr, dtype=self.dtype)
+                        for k, arr in ab.items()}
+                 for proj, ab in pack.items()}
+                for pack in self._host]
+        return self._device
+
+    def validate(self, model) -> None:
+        """Geometry check at Engine construction: a pool built for one
+        model family/shape must not silently serve another (the delta
+        matmuls would retrace or misapply)."""
+        layers = _decoder_layers(model)
+        if len(layers) != self.num_layers or \
+                _targets(layers[0]) != self.targets:
+            raise ValueError(
+                "LoRAPool geometry does not match this model "
+                f"({self.num_layers} layers × {sorted(self.targets)} "
+                "vs the engine's) — build the pool for the model the "
+                "engine serves")
+
+    def stats(self) -> Dict[str, float]:
+        """Pool counters for telemetry/debugging."""
+        return {"active_adapters": self.active_adapters,
+                "max_adapters": self.max_adapters,
+                "rank": self.rank, "loads": self.loads,
+                "evictions": self.evictions,
+                "live_refs": sum(len(v) for v in self._refs.values())}
+
+
+def random_adapter(model, *, rank: int = 8, rng=None, scale: float = 0.05,
+                   projs: Optional[Sequence[str]] = None):
+    """Random adapter weights for tests/benches: per-layer
+    ``{proj: (A, B)}`` with ``A ~ N(0, scale)`` and ``B ~ N(0, scale)``
+    (non-zero B so the adapter visibly changes outputs — real LoRA
+    training starts B at zero).  ``projs`` restricts the targeted
+    projections (default: all)."""
+    rng = rng if rng is not None else np.random.default_rng(0)
+    layers = _decoder_layers(model)
+    targets = _targets(layers[0])
+    keys = list(targets) if projs is None else list(projs)
+    out = []
+    for _ in layers:
+        pack = {}
+        for p in keys:
+            di, do = targets[p]
+            pack[p] = (rng.normal(0.0, scale, (di, rank))
+                       .astype(np.float32),
+                       rng.normal(0.0, scale, (rank, do))
+                       .astype(np.float32))
+        out.append(pack)
+    return out
+
+
+def merge_adapter(model, weights, *, alpha: Optional[float] = None) -> int:
+    """Fold adapter ``weights`` into ``model``'s projection weights IN
+    PLACE: ``W += A @ B * (alpha/r)`` — the merged-weight REFERENCE the
+    multi-LoRA identity tests compare the batched path against
+    (token-identical greedy outputs; docs/SERVING.md "Multi-LoRA").
+    Returns the number of projections merged."""
+    layers = _decoder_layers(model)
+    if len(weights) != len(layers):
+        raise ValueError(
+            f"adapter carries {len(weights)} layers, model has "
+            f"{len(layers)}")
+    merged = 0
+    for layer, pack in zip(layers, weights):
+        for proj, entry in (pack or {}).items():
+            a, b = entry
+            a = np.asarray(a, np.float32)
+            b = np.asarray(b, np.float32)
+            r = a.shape[1]
+            scale = (float(alpha) if alpha is not None else float(r)) / r
+            sub, name = layer._resolve_path(proj + ".weight")
+            w = sub._parameters[name]
+            delta = (a @ (b * scale)).astype(np.float32)
+            layer._assign_by_path(
+                proj + ".weight",
+                (w.astype(jnp.float32) + jnp.asarray(delta))
+                .astype(w.dtype))
+            merged += 1
+    return merged

@@ -65,6 +65,13 @@ class Request:
     # tracer at submit when tracing is on; riding the Request keeps the
     # id with the state through preempt/restore and replica migration
     trace_id: Optional[str] = None
+    # multi-LoRA (docs/SERVING.md "Multi-LoRA"): the adapter NAME is the
+    # request's portable identity (it rides preempt/restore, replica
+    # migration and the disagg wire format); adapter_slot is the
+    # engine-local stack index the admitting engine resolves via its
+    # LoRAPool — 0 (the exact no-op) for base-model requests
+    adapter: Optional[str] = None
+    adapter_slot: int = 0
 
     def __post_init__(self):
         self.prompt_ids = np.asarray(self.prompt_ids, np.int32).reshape(-1)
@@ -184,8 +191,13 @@ class Scheduler:
             # pool-exhaustion backpressure must not re-run O(prompt)
             # blake2b chains per retry.  A caller that already hashed
             # them (the replica router's affinity probe) passes them in.
+            # The adapter name salts the chain: adapter deltas change
+            # the KV content, so prefix sharing is PER ADAPTER.
             st.page_keys = page_keys if page_keys is not None else \
-                PrefixCache.page_keys(request.prompt_ids, self.page_size)
+                PrefixCache.page_keys(
+                    request.prompt_ids, self.page_size,
+                    salt=request.adapter.encode()
+                    if request.adapter else b"")
         self.waiting.append(st)
         return st
 
@@ -339,10 +351,12 @@ class Scheduler:
     def span_arrays(self, plan, chunk: int, spec_emit: bool = False):
         """The fixed-shape ragged step inputs for a span plan:
         ``(tokens (B,C), tables (B,MB), starts (B,), lens (B,),
-        temps (B,), seeds (B,), emit (B,))`` as numpy arrays.
-        Idle/empty slots get the inert sentinel values — shapes NEVER
-        depend on occupancy (a draft miss is ``len 1``, never a new
-        shape).  Call AFTER copy-on-write has patched the tables.
+        temps (B,), seeds (B,), emit (B,), adapters (B,))`` as numpy
+        arrays.  Idle/empty slots get the inert sentinel values —
+        shapes NEVER depend on occupancy (a draft miss is ``len 1``,
+        never a new shape; an adapter change is a new VALUE in
+        ``adapters``, never a new program).  Call AFTER copy-on-write
+        has patched the tables.
 
         ``seeds``/``emit`` drive the per-emitted-token-index PRNG key
         derivation (``engine._sample``): ``emit[i]`` is the emit index
@@ -358,6 +372,7 @@ class Scheduler:
         temps = np.zeros((b,), np.float32)
         seeds = np.zeros((b,), np.int32)
         emit = np.zeros((b,), np.int32)
+        adapters = np.zeros((b,), np.int32)   # 0 = base no-op slot
         for i, st, n, is_prefill in plan:
             req = st.request
             if is_prefill:
@@ -373,7 +388,8 @@ class Scheduler:
             seeds[i] = st.sample_seed
             emit[i] = len(st.output_ids) - \
                 ((n - 1) if (spec_emit and is_prefill) else 0)
-        return tokens, tables, starts, lens, temps, seeds, emit
+            adapters[i] = req.adapter_slot
+        return tokens, tables, starts, lens, temps, seeds, emit, adapters
 
     def finish(self, st: RequestState, reason: str) -> None:
         """Release the slot and drop every block reference (shared pages

@@ -1,0 +1,678 @@
+"""Batched multi-LoRA serving (docs/SERVING.md "Multi-LoRA").
+
+The load-bearing guarantees:
+
+- the grouped BGMV kernel (ops/pallas/lora_matmul.py, run in the Pallas
+  interpreter here) matches its XLA gather+einsum contract
+  (``incubate.nn.functional._lora_bgmv_ref``) across mixed adapter ids,
+  ranks, and dtypes — with adapter 0 an EXACT no-op;
+- an engine serving adapter ``k`` produces greedy outputs
+  token-identical to a merged-weight (``W + B_k A_k``) reference model,
+  across prefix-cache hits, int8 KV pools, preempt→swap→restore,
+  speculative decoding, TP=2, DP evacuation, and the disaggregated
+  handoff — while base requests stay bitwise identical to a LoRA-less
+  engine (slot 0's zero stacks);
+- adapter churn (load / hot-load / evict) never recompiles, and the
+  lifecycle errors are typed (UnknownAdapter at admission, AdapterInUse
+  on a refcount-held evict).
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import resilience as rs
+from paddle_tpu import serving
+from paddle_tpu.serving import LoRAPool, merge_adapter, random_adapter
+from paddle_tpu.serving.errors import AdapterInUse, UnknownAdapter
+
+R = np.random.default_rng(0)
+
+
+def _prompt(n):
+    return R.integers(0, 256, size=n).astype(np.int32)
+
+
+def _tiny():
+    from paddle_tpu.models.llama import llama
+    pt.seed(0)
+    return llama("tiny")
+
+
+def _tiny_gpt():
+    from paddle_tpu.models.gpt import gpt
+    pt.seed(0)
+    return gpt("tiny")
+
+
+def _engine(model=None, lora=None, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq_len", 48)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("prefill_chunk", 8)
+    return serving.Engine(model if model is not None else _tiny(),
+                          lora=lora, **kw)
+
+
+def _weights(model, rank=8, seed=7, scale=0.05, projs=None):
+    return random_adapter(model, rank=rank,
+                          rng=np.random.default_rng(seed), scale=scale,
+                          projs=projs)
+
+
+def _merged_ref(weights, prompt, max_new, builder=_tiny):
+    """Greedy generate() on a fresh model with the adapter merged in."""
+    m = builder()
+    merge_adapter(m, weights)
+    out = m.generate(jnp.asarray(np.asarray(prompt))[None],
+                     max_new_tokens=max_new, temperature=0.0)
+    return list(np.asarray(out)[0, len(prompt):])
+
+
+# ---------------------------------------------------------------------------
+# pool lifecycle
+# ---------------------------------------------------------------------------
+
+class TestLoRAPool:
+    def test_slots_and_registry(self):
+        model = _tiny()
+        pool = LoRAPool(model, max_adapters=2, rank=8)
+        assert pool.active_adapters == 0
+        s1 = pool.load("a", _weights(model))
+        s2 = pool.load("b", _weights(model, seed=8))
+        assert {s1, s2} == {1, 2}          # slot 0 stays the base no-op
+        assert pool.adapters() == {"a": s1, "b": s2}
+        assert pool.slot_of("a") == s1
+
+    def test_unknown_adapter_typed(self):
+        pool = LoRAPool(_tiny(), max_adapters=1, rank=8)
+        with pytest.raises(UnknownAdapter, match="not loaded"):
+            pool.slot_of("ghost")
+
+    def test_pool_full(self):
+        model = _tiny()
+        pool = LoRAPool(model, max_adapters=1, rank=8)
+        pool.load("a", _weights(model))
+        with pytest.raises(ValueError, match="full"):
+            pool.load("b", _weights(model))
+
+    def test_reload_keeps_slot(self):
+        model = _tiny()
+        pool = LoRAPool(model, max_adapters=1, rank=8)
+        s = pool.load("a", _weights(model))
+        assert pool.load("a", _weights(model, seed=9)) == s
+
+    def test_evict_in_use_typed_then_ok(self):
+        model = _tiny()
+        pool = LoRAPool(model, max_adapters=1, rank=8)
+        pool.load("a", _weights(model))
+        pool.acquire("a", "req-x")
+        pool.acquire("a", "req-x")          # id-keyed: idempotent
+        assert pool.refcount("a") == 1
+        with pytest.raises(AdapterInUse, match="live"):
+            pool.evict("a")
+        pool.release("a", "req-x")
+        pool.evict("a")
+        assert not pool.has("a") and pool.active_adapters == 0
+        # the freed slot is reusable
+        assert pool.load("b", _weights(model)) == 1
+
+    def test_bad_shapes_rejected(self):
+        model = _tiny()
+        pool = LoRAPool(model, max_adapters=1, rank=8)
+        w = _weights(model)
+        a, b = w[0]["self_attn.q_proj"]
+        w[0]["self_attn.q_proj"] = (a[:, :4], b)   # wrong rank
+        with pytest.raises(ValueError, match="do not match"):
+            pool.load("a", w)
+
+    def test_failed_load_leaks_nothing(self):
+        # a mid-load shape failure must neither consume the popped slot
+        # nor half-overwrite a resident adapter (load validates every
+        # row BEFORE mutating pool state)
+        model = _tiny()
+        pool = LoRAPool(model, max_adapters=1, rank=8)
+        bad = _weights(model)
+        a, b = bad[1]["self_attn.q_proj"]          # fail at layer 1:
+        bad[1]["self_attn.q_proj"] = (a[:, :4], b)  # layer 0 was valid
+        with pytest.raises(ValueError, match="do not match"):
+            pool.load("a", bad)
+        assert pool.active_adapters == 0
+        good = _weights(model, seed=9)
+        slot = pool.load("a", good)                 # slot NOT leaked
+        snap = np.array(pool._host[0]["self_attn.q_proj"]["a"][slot])
+        bad2 = _weights(model, seed=10)
+        a2, b2 = bad2[1]["self_attn.q_proj"]
+        bad2[1]["self_attn.q_proj"] = (a2[:, :4], b2)
+        with pytest.raises(ValueError, match="do not match"):
+            pool.load("a", bad2)                    # failed hot-reload
+        np.testing.assert_array_equal(               # old rows intact
+            pool._host[0]["self_attn.q_proj"]["a"][slot], snap)
+
+    def test_unknown_projection_keys_rejected(self):
+        # PEFT-style short keys ('q_proj') silently missing every pool
+        # target would load an all-zero adapter that serves BASE
+        # outputs under the tenant's name — reject loudly
+        model = _tiny()
+        pool = LoRAPool(model, max_adapters=1, rank=8)
+        w = _weights(model)
+        a, b = w[0].pop("self_attn.q_proj")
+        w[0]["q_proj"] = (a, b)
+        with pytest.raises(ValueError, match="unknown projection"):
+            pool.load("a", w)
+        assert pool.active_adapters == 0
+
+    def test_acquire_unknown_adapter_typed(self):
+        # a blind ref on a non-resident name would let its slot be
+        # zeroed or reused under the request (disagg adoption window)
+        pool = LoRAPool(_tiny(), max_adapters=1, rank=8)
+        with pytest.raises(UnknownAdapter, match="not loaded"):
+            pool.acquire("ghost", "rid-1")
+
+    def test_geometry_validation_at_engine(self):
+        pool = LoRAPool(_tiny(), max_adapters=1, rank=8)
+        with pytest.raises(ValueError, match="geometry"):
+            _engine(model=_tiny_gpt(), lora=pool)
+
+    def test_quantized_model_rejected(self):
+        model = _tiny()
+        from paddle_tpu.nn.quant import quantize_linears
+        quantize_linears(model, algo="weight_only_int8")
+        with pytest.raises(ValueError, match="quantized"):
+            LoRAPool(model, max_adapters=1, rank=8)
+
+
+# ---------------------------------------------------------------------------
+# grouped BGMV kernel vs the XLA contract (interpret mode)
+# ---------------------------------------------------------------------------
+
+class TestGroupedBGMV:
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    @pytest.mark.parametrize("rank", [8, 16, 64])
+    def test_kernel_matches_contract(self, dtype, rank):
+        from paddle_tpu.incubate.nn.functional import _lora_bgmv_ref
+        from paddle_tpu.ops.pallas.lora_matmul import grouped_bgmv
+        rng = np.random.default_rng(3)
+        B, C, H, O, N = 4, 16, 256, 384, 5
+        x = jnp.asarray(rng.normal(size=(B, C, H)), dtype)
+        a = jnp.asarray(rng.normal(size=(N, H, rank)) * 0.05, dtype)
+        b = jnp.asarray(rng.normal(size=(N, rank, O)) * 0.05, dtype)
+        a = a.at[0].set(0.0)
+        b = b.at[0].set(0.0)
+        idx = jnp.asarray(np.array([0, 3, 1, 3], np.int32))  # mixed ids
+        got = np.asarray(grouped_bgmv(x, a, b, idx, interpret=True),
+                         np.float32)
+        ref = np.asarray(_lora_bgmv_ref(x, a, b, idx), np.float32)
+        np.testing.assert_allclose(got, ref, rtol=0, atol=0)
+        # adapter 0 is the EXACT no-op: all-zero delta, bit for bit
+        assert (got[0] == 0.0).all()
+
+    def test_expand_stripes_match(self):
+        from paddle_tpu.incubate.nn.functional import _lora_bgmv_ref
+        from paddle_tpu.ops.pallas.lora_matmul import grouped_bgmv
+        rng = np.random.default_rng(4)
+        x = jnp.asarray(rng.normal(size=(2, 8, 128)), jnp.float32)
+        a = jnp.asarray(rng.normal(size=(3, 128, 16)), jnp.float32)
+        b = jnp.asarray(rng.normal(size=(3, 16, 384)), jnp.float32)
+        idx = jnp.asarray(np.array([2, 1], np.int32))
+        got = grouped_bgmv(x, a, b, idx, block_o=128, interpret=True)
+        ref = _lora_bgmv_ref(x, a, b, idx)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=0, atol=0)
+
+    def test_dispatch_declines_off_tpu(self):
+        # CPU: the incubate entry must take the XLA composition
+        from paddle_tpu.incubate.nn import functional as IF
+        rng = np.random.default_rng(5)
+        x = jnp.asarray(rng.normal(size=(2, 4, 64)), jnp.float32)
+        a = jnp.asarray(rng.normal(size=(2, 64, 8)), jnp.float32)
+        b = jnp.asarray(rng.normal(size=(2, 8, 64)), jnp.float32)
+        idx = jnp.asarray(np.array([1, 0], np.int32))
+        out = IF.lora_bgmv(x, a, b, idx)
+        ref = IF._lora_bgmv_ref(x, a, b, idx)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+# ---------------------------------------------------------------------------
+# engine identity: batched adapters vs merged-weight references
+# ---------------------------------------------------------------------------
+
+class TestEngineIdentity:
+    def _pooled_engine(self, n_adapters=2, builder=_tiny, **kw):
+        model = builder()
+        pool = LoRAPool(model, max_adapters=n_adapters, rank=8)
+        ws = {}
+        for i in range(n_adapters):
+            name = f"ad{i}"
+            ws[name] = _weights(model, seed=20 + i)
+            pool.load(name, ws[name])
+        return _engine(model=model, lora=pool, **kw), pool, ws
+
+    def test_base_bitwise_identical_to_plain_engine(self):
+        prompts = [_prompt(5), _prompt(17)]
+        eng, _, _ = self._pooled_engine()
+        rids = [eng.add_request(p, max_new_tokens=6) for p in prompts]
+        eng.warmup()
+        outs = eng.run()
+        plain = _engine().warmup()
+        prids = [plain.add_request(p, max_new_tokens=6) for p in prompts]
+        pouts = plain.run()
+        assert [outs[r] for r in rids] == [pouts[r] for r in prids]
+
+    def test_mixed_batch_matches_merged_references(self):
+        eng, pool, ws = self._pooled_engine(max_batch=4)
+        eng.warmup()
+        prompts = [_prompt(n) for n in (5, 17, 9, 26)]
+        mix = [None, "ad0", "ad1", "ad0"]
+        rids = [eng.add_request(p, max_new_tokens=6, adapter=ad)
+                for p, ad in zip(prompts, mix)]
+        outs = eng.run()
+        for p, ad, rid in zip(prompts, mix, rids):
+            if ad is None:
+                m = _tiny()
+                ref = list(np.asarray(m.generate(
+                    jnp.asarray(p)[None], max_new_tokens=6,
+                    temperature=0.0))[0, len(p):])
+            else:
+                ref = _merged_ref(ws[ad], p, 6)
+            assert outs[rid] == ref, f"adapter {ad} diverged"
+        assert eng.kv_blocks_used == 0
+
+    def test_gpt_family(self):
+        eng, pool, ws = self._pooled_engine(builder=_tiny_gpt)
+        eng.warmup()
+        p = _prompt(9)
+        rid = eng.add_request(p, max_new_tokens=6, adapter="ad0")
+        outs = eng.run()
+        assert outs[rid] == _merged_ref(ws["ad0"], p, 6,
+                                        builder=_tiny_gpt)
+
+    def test_prefix_cache_hits_with_adapters(self):
+        """Two tenants share a prompt prefix: the KV pages are
+        adapter-INDEPENDENT up to the divergence point only if the
+        adapter is the same — different adapters write different KV, so
+        identity must hold precisely because each request's pages are
+        its own (prefix sharing keys on content, and adapter deltas
+        change the content hash's PAYLOAD, not the hash: the test pins
+        that sharing never crosses adapters incorrectly)."""
+        eng, pool, ws = self._pooled_engine(max_batch=2)
+        eng.warmup()
+        p = _prompt(16)            # 2 full pages: registered at retire
+        r1 = eng.add_request(p, max_new_tokens=5, adapter="ad0")
+        o1 = eng.run()[r1]
+        # same prompt, same adapter → prefix hit, identical outputs
+        r2 = eng.add_request(p, max_new_tokens=5, adapter="ad0")
+        o2 = eng.run()[r2]
+        assert o1 == o2 == _merged_ref(ws["ad0"], p, 5)
+        assert eng.prefix_stats()["hits"] > 0
+
+    def test_adapters_change_kv_so_prefix_sharing_must_not_cross(self):
+        """The sharp edge of prefix caching under multi-LoRA: adapter
+        deltas change K/V at every position, so a page prefilled under
+        adapter A must never be borrowed by a request on adapter B (or
+        the base model) however identical their tokens.  The adapter
+        name SALTS the chained page digests (scheduler.submit →
+        PrefixCache.page_keys(salt=)), so colliding prompts on
+        different adapters key disjoint cache entries — this test
+        caught the unsalted version serving adapter B from A's pages."""
+        eng, pool, ws = self._pooled_engine(max_batch=2)
+        eng.warmup()
+        p = _prompt(16)
+        r1 = eng.add_request(p, max_new_tokens=5, adapter="ad0")
+        o1 = eng.run()[r1]
+        r2 = eng.add_request(p, max_new_tokens=5, adapter="ad1")
+        o2 = eng.run()[r2]
+        assert o1 == _merged_ref(ws["ad0"], p, 5)
+        assert o2 == _merged_ref(ws["ad1"], p, 5)
+
+    def test_int8_kv_pool(self):
+        eng, pool, ws = self._pooled_engine(kv_cache_dtype="int8")
+        eng.warmup()
+        p = _prompt(11)
+        rid = eng.add_request(p, max_new_tokens=6, adapter="ad1")
+        outs = eng.run()
+        m = _tiny()
+        merge_adapter(m, ws["ad1"])
+        ref = list(np.asarray(m.generate(
+            jnp.asarray(p)[None], max_new_tokens=6, temperature=0.0,
+            kv_cache_dtype="int8"))[0, len(p):])
+        assert outs[rid] == ref
+
+    def test_preempt_swap_restore(self):
+        eng, pool, ws = self._pooled_engine(max_batch=2)
+        eng.warmup()
+        p = _prompt(9)
+        rid = eng.add_request(p, max_new_tokens=8, adapter="ad0")
+        eng.step(); eng.step(); eng.step()
+        assert eng.preempt(rid)
+        # pdtpu-lint: disable=lock-discipline — single-threaded test
+        assert eng._states[rid].preempts == 1
+        outs = eng.run()
+        assert outs[rid] == _merged_ref(ws["ad0"], p, 8)
+        assert pool.refcount("ad0") == 0   # released at retire
+        assert eng.kv_blocks_used == 0
+
+    def test_spec_decode_composes(self):
+        model = _tiny()
+        pool = LoRAPool(model, max_adapters=1, rank=8)
+        ws = _weights(model, seed=33)
+        pool.load("a", ws)
+        eng = _engine(model=model, lora=pool, spec_decode=True,
+                      max_seq_len=64).warmup()
+        motif = _prompt(6)
+        p = np.tile(motif, 3)
+        rid = eng.add_request(p, max_new_tokens=10, adapter="a")
+        outs = eng.run()
+        assert outs[rid] == _merged_ref(ws, p, 10)
+        assert eng.kv_blocks_used == 0
+
+    def test_hot_load_and_churn_zero_recompiles(self):
+        from paddle_tpu import observability as obs
+        tel = obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        try:
+            model = _tiny()
+            pool = LoRAPool(model, max_adapters=2, rank=8)
+            wa = _weights(model, seed=40)
+            pool.load("a", wa)
+            eng = _engine(model=model, lora=pool,
+                          max_batch=2).warmup()
+            c0 = tel.sentinel.compiles()
+            pa, pb = _prompt(9), _prompt(5)
+            eng.add_request(pa, max_new_tokens=6, adapter="a")
+            eng.step(); eng.step()
+            wb = _weights(model, seed=41)
+            pool.load("b", wb)          # hot-load mid-churn
+            r1 = eng.add_request(pb, max_new_tokens=6, adapter="b")
+            outs = eng.run()
+            pool.evict("a")
+            eng.add_request(_prompt(7), max_new_tokens=4, adapter="b")
+            outs.update(eng.run())
+            assert tel.sentinel.compiles() - c0 == 0
+            assert eng._step_fn._cache_size() == 1
+            assert outs[r1] == _merged_ref(wb, pb, 6)
+        finally:
+            obs.disable()
+
+    def test_unknown_adapter_typed_at_add_request(self):
+        eng, pool, _ = self._pooled_engine()
+        with pytest.raises(UnknownAdapter, match="not loaded"):
+            eng.add_request(_prompt(5), adapter="ghost")
+        # engine without a pool: also typed
+        with pytest.raises(UnknownAdapter, match="no LoRA pool"):
+            _engine().add_request(_prompt(5), adapter="ad0")
+
+    def test_eviction_blocked_by_live_request(self):
+        eng, pool, _ = self._pooled_engine()
+        eng.warmup()
+        eng.add_request(_prompt(9), max_new_tokens=8, adapter="ad0")
+        eng.step()
+        with pytest.raises(AdapterInUse):
+            pool.evict("ad0")
+        eng.run()
+        pool.evict("ad0")                  # drained: fine
+
+
+# ---------------------------------------------------------------------------
+# front door tenancy
+# ---------------------------------------------------------------------------
+
+class TestFrontDoorTenancy:
+    def test_tenant_policy_maps_adapter(self):
+        model = _tiny()
+        pool = LoRAPool(model, max_adapters=1, rank=8)
+        ws = _weights(model, seed=50)
+        pool.load("fr-legal", ws)
+        eng = _engine(model=model, lora=pool).warmup()
+        door = serving.FrontDoor(eng, policies={
+            "acme": serving.TenantPolicy(adapter="fr-legal")})
+        p = _prompt(9)
+        adm = door.submit(p, tenant="acme", max_new_tokens=6)
+        assert adm.admitted
+        outs = door.run()
+        assert outs[adm.request_id] == _merged_ref(ws, p, 6)
+
+    def test_explicit_adapter_overrides_policy(self):
+        model = _tiny()
+        pool = LoRAPool(model, max_adapters=2, rank=8)
+        wa, wb = _weights(model, seed=51), _weights(model, seed=52)
+        pool.load("a", wa)
+        pool.load("b", wb)
+        eng = _engine(model=model, lora=pool).warmup()
+        door = serving.FrontDoor(eng, policies={
+            "t": serving.TenantPolicy(adapter="a")})
+        p = _prompt(7)
+        adm = door.submit(p, tenant="t", max_new_tokens=5, adapter="b")
+        outs = door.run()
+        assert outs[adm.request_id] == _merged_ref(wb, p, 5)
+
+    def test_unknown_mapping_typed_at_submit(self):
+        eng = _engine().warmup()
+        door = serving.FrontDoor(eng, policies={
+            "bad": serving.TenantPolicy(adapter="ghost")})
+        with pytest.raises(UnknownAdapter):
+            door.submit(_prompt(5), tenant="bad")
+
+    def test_admitted_request_pins_adapter_until_retire(self):
+        # an admitted=True answer is a promise: the adapter cannot be
+        # evicted out from under a request the door still holds (the
+        # door acquires the same id-keyed reference the engine takes
+        # over at add_request), so pump never sheds a vetted request
+        # on a vanished adapter
+        model = _tiny()
+        pool = LoRAPool(model, max_adapters=1, rank=8)
+        ws = _weights(model, seed=53)
+        pool.load("pinned", ws)
+        eng = _engine(model=model, lora=pool).warmup()
+        door = serving.FrontDoor(eng, policies={
+            "t": serving.TenantPolicy(adapter="pinned")})
+        adms = [door.submit(_prompt(5 + i), tenant="t",
+                            max_new_tokens=4) for i in range(3)]
+        assert all(a.admitted for a in adms)
+        with pytest.raises(AdapterInUse, match="live"):
+            pool.evict("pinned")            # queued + staged requests
+        outs = door.run()
+        assert len(outs) == 3
+        pool.evict("pinned")                # all retired: refs cleared
+
+    def test_queuefull_requeue_keeps_adapter_pinned(self):
+        # the engine's transient QueueFull at pump releases the shared
+        # id-keyed ref on its way out of add_request; the door must
+        # re-take it when it re-queues the pending, or the admitted
+        # request loses its evict protection while waiting at the door
+        model = _tiny()
+        pool = LoRAPool(model, max_adapters=1, rank=8)
+        ws = _weights(model, seed=54)
+        pool.load("pinned", ws)
+        # max_queue < max_batch: _engine_room says feed, the engine's
+        # own bound answers QueueFull -> door re-queues the pending
+        eng = _engine(model=model, lora=pool, max_queue=1).warmup()
+        door = serving.FrontDoor(eng, policies={
+            "t": serving.TenantPolicy(adapter="pinned")})
+        adms = [door.submit(_prompt(5 + i), tenant="t",
+                            max_new_tokens=3) for i in range(3)]
+        assert all(a.admitted for a in adms)
+        assert door._total_queued() >= 1    # at least one bounced back
+        with pytest.raises(AdapterInUse, match="live"):
+            pool.evict("pinned")
+        outs = door.run()
+        assert len(outs) == 3
+        pool.evict("pinned")
+
+
+# ---------------------------------------------------------------------------
+# distributed: TP sharding, DP evacuation, disaggregated handoff
+# ---------------------------------------------------------------------------
+
+class TestDistributed:
+    def test_tp2_token_identical(self):
+        model = _tiny()
+        pool = LoRAPool(model, max_adapters=1, rank=8)
+        ws = _weights(model, seed=60)
+        pool.load("a", ws)
+        mesh = serving.serving_mesh(tp=2)
+        eng = _engine(model=model, lora=pool, mesh=mesh).warmup()
+        p = _prompt(9)
+        rid = eng.add_request(p, max_new_tokens=6, adapter="a")
+        outs = eng.run()
+        assert outs[rid] == _merged_ref(ws, p, 6)
+        assert eng.kv_blocks_used == 0
+
+    def test_replica_set_requires_shared_pool(self):
+        m1, m2 = _tiny(), _tiny()
+        p1 = LoRAPool(m1, max_adapters=1, rank=8)
+        p2 = LoRAPool(m2, max_adapters=1, rank=8)
+        with pytest.raises(ValueError, match="share a single LoRAPool"):
+            serving.EngineReplicaSet([
+                _engine(model=m1, lora=p1), _engine(model=m2, lora=p2)])
+
+    def test_dp_evacuation_preserves_adapter(self):
+        """A replica failure mid-decode evacuates the adapter request
+        through preempt→swap→restore onto the survivor — the adapter id
+        must survive the migration like trace_id does, and outputs stay
+        identical to the merged reference."""
+        def build_set():
+            m1, m2 = _tiny(), _tiny()
+            pool = LoRAPool(m1, max_adapters=1, rank=8)
+            ws = _weights(m1, seed=61)
+            pool.load("a", ws)
+            rset = serving.EngineReplicaSet(
+                [_engine(model=m1, lora=pool),
+                 _engine(model=m2, lora=pool)]).warmup()
+            return rset, ws
+
+        rset, ws = build_set()
+        prompts = [_prompt(n) for n in (5, 17, 9, 26)]
+        rs.clear_faults()
+        rs.install_faults("serve.replica@4")
+        try:
+            rids = []
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                for p in prompts:
+                    rids.append(rset.add_request(p, max_new_tokens=6,
+                                                 adapter="a"))
+                    rset.step()
+                outs = rset.run()
+        finally:
+            rs.clear_faults()
+        assert rset.failures == 1
+        for p, rid in zip(prompts, rids):
+            assert outs[rid] == _merged_ref(ws, p, 6), \
+                "evacuated adapter request diverged"
+        for rep in rset.replicas:
+            assert rep.kv_blocks_used == 0
+
+    def test_handout_wire_carries_adapter(self):
+        from paddle_tpu.serving.disagg import KVHandout
+        model = _tiny()
+        pool = LoRAPool(model, max_adapters=1, rank=8)
+        pool.load("a", _weights(model, seed=62))
+        eng = _engine(model=model, lora=pool, role="prefill").warmup()
+        rid = eng.add_request(_prompt(9), max_new_tokens=6, adapter="a")
+        while eng.has_work():
+            eng.step()
+        assert len(eng.handed_off) == 1
+        st = eng.handed_off.popleft()
+        h = KVHandout.from_bytes(KVHandout.from_state(st).to_bytes())
+        assert h.adapter == "a"
+        assert h.to_state().request.adapter == "a"
+        assert pool.refcount("a") == 0     # released at handoff commit
+
+    def test_disagg_handoff_token_identical(self):
+        model_p, model_d = _tiny(), _tiny()
+        pool = LoRAPool(model_p, max_adapters=1, rank=8)
+        ws = _weights(model_p, seed=63)
+        pool.load("a", ws)
+        ds = serving.DisaggReplicaSet(
+            [_engine(model=model_p, lora=pool, role="prefill")],
+            [_engine(model=model_d, lora=pool, role="decode")]).warmup()
+        p = _prompt(9)
+        rid = ds.add_request(p, max_new_tokens=6, adapter="a")
+        outs = ds.run()
+        assert outs[rid] == _merged_ref(ws, p, 6)
+        assert ds.disagg_stats()["handoffs"] == 1
+        for rep in ds.replicas:
+            assert rep.kv_blocks_used == 0
+        assert pool.refcount("a") == 0
+
+    def test_decode_tier_missing_adapter_typed(self):
+        model = _tiny()
+        from paddle_tpu.serving.disagg import KVHandout
+        pool = LoRAPool(model, max_adapters=1, rank=8)
+        pool.load("a", _weights(model, seed=64))
+        pre = _engine(model=model, lora=pool, role="prefill").warmup()
+        rid = pre.add_request(_prompt(9), max_new_tokens=6, adapter="a")
+        while pre.has_work():
+            pre.step()
+        blob = KVHandout.from_state(pre.handed_off.popleft()).to_bytes()
+        bare = _engine(role="decode").warmup()   # no pool loaded
+        with pytest.raises(UnknownAdapter):
+            bare.admit_handout(blob)
+
+
+# ---------------------------------------------------------------------------
+# telemetry + bench plumbing
+# ---------------------------------------------------------------------------
+
+class TestTelemetryAndBench:
+    def test_metrics_and_report_fold(self, tmp_path):
+        from paddle_tpu import observability as obs
+        path = tmp_path / "tel.jsonl"
+        tel = obs.enable(sinks=[obs.JsonlSink(str(path))],
+                         crash_hooks=False)
+        try:
+            model = _tiny()
+            pool = LoRAPool(model, max_adapters=2, rank=8)
+            pool.load("a", _weights(model, seed=70))
+            pool.load("b", _weights(model, seed=71))
+            eng = _engine(model=model, lora=pool, max_batch=2).warmup()
+            for ad in ("a", "b", None):
+                eng.add_request(_prompt(5), max_new_tokens=4,
+                                adapter=ad)
+            eng.run()
+            pool.evict("b")
+            reg = obs.get_registry()
+            snap = reg.snapshot()
+            assert snap.get("serve.lora.active_adapters") == 1
+            assert snap.get("serve.lora.loads") == 2
+            assert snap.get("serve.lora.evictions") == 1
+            assert snap.get("serve.lora.adapter[a].requests") == 1
+            assert snap.get("serve.lora.adapter[a].tokens") == 4
+            assert eng.lora_stats()["active_adapters"] == 1
+        finally:
+            obs.disable()
+        import sys
+        sys.path.insert(0, "tools")
+        import telemetry_report as tr
+        events, malformed = tr.load_events([str(path)])
+        agg = tr.summarize(events)
+        lora = tr._lora_stats(agg)
+        assert lora["loads"] == 2 and lora["evictions"] == 1
+        assert lora["adapters"]["a"]["tokens"] == 4
+        assert lora["adapters"]["a"]["requests"] == 1
+        text = tr.render(agg, malformed)
+        assert "LoRA" in text
+
+    @pytest.mark.slow
+    def test_bench_serve_lora_plumbing(self):
+        """CPU plumbing for the serve_lora_* bench rows: the batched
+        multi-LoRA engine must beat the serial one-merged-engine-per-
+        tenant deployment by >= 1.3x on the busy-time projection, with
+        in-bench token identity (asserted inside the bench)."""
+        import sys
+        sys.path.insert(0, "tools")
+        from decode_bench import bench_serve_lora
+        r = bench_serve_lora(preset="tiny", n_adapters=3, rank=8,
+                             max_batch=4, n_requests=8,
+                             prompt_lens=(5, 9, 7, 12), max_new=8,
+                             page_size=8)
+        assert r["active_adapters"] == 3
+        assert r["gen_tokens"] == 8 * 8
+        assert r["vs_serial"] is not None and r["vs_serial"] >= 1.3, \
+            f"batched multi-LoRA only {r['vs_serial']}x the serial " \
+            "busy-time projection"

@@ -173,9 +173,10 @@ class TestScheduler:
         st = s.admit_next()
         st.pending_token, st.kv_len = 7, 5
         plan = s.plan_spans(chunk=4)
-        tokens, tables, starts, lens, temps, seeds, emit = \
+        tokens, tables, starts, lens, temps, seeds, emit, adapters = \
             s.span_arrays(plan, 4)
         assert tokens.shape == (3, 4) and tables.shape == (3, 4)
+        assert adapters.shape == (3,) and (adapters == 0).all()
         # inactive slots carry the OOB sentinel everywhere
         assert (tables[1:] == 16).all() and lens[1] == 0
         # prompt fully written → a single decode-token span at kv_len

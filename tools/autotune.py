@@ -219,6 +219,36 @@ def sweep_blocks(preset, t, dtype, iters):
     if best[1] is not None:
         results["fused_swiglu_mlp"] = {key: {"block_t": best[1][0],
                                              "block_i": best[1][1]}}
+
+    # grouped BGMV (multi-LoRA decode, ops/pallas/lora_matmul.py): the
+    # expand stripe width over d_out, at the serving shapes — decode
+    # span batches (B slots x chunk C) against a stacked pool
+    from paddle_tpu.ops.pallas import lora_matmul as LM
+    r_ = np.random.default_rng(0)
+    bsz, c, rank, n_ad = 8, 16, 16, 9
+    h, nq = geom["h"], geom["nq"]
+    lx = jnp.asarray(r_.normal(size=(bsz, c, h)), dtype)
+    la = jnp.asarray(r_.normal(size=(n_ad, h, rank)) * 0.05, dtype)
+    lb = jnp.asarray(r_.normal(size=(n_ad, rank, nq)) * 0.05, dtype)
+    lidx = jnp.asarray(r_.integers(0, n_ad, size=(bsz,)).astype(np.int32))
+    key = tuning.geom_key(h=h, r=rank, o=nq)
+    best = (float("inf"), None)
+    for bo in (256, 512, 1024, 2048):
+        if bo > nq:
+            continue
+        try:
+            # one compile per swept config, by design (grouped_bgmv is
+            # its own jit entry with block_o static)
+            ms = _time(lambda x_, a_, b_, i_, _bo=bo: LM.grouped_bgmv(
+                x_, a_, b_, i_, block_o=_bo), lx, la, lb, lidx,
+                iters=iters)
+        except Exception as e:  # noqa: BLE001 — VMEM overflow etc.
+            print(f"# lora_bgmv bo={bo}: {type(e).__name__}")
+            continue
+        print(f"# lora_bgmv bo={bo}: {ms:.3f} ms")
+        best = min(best, (ms, bo))
+    if best[1] is not None:
+        results["lora_bgmv"] = {key: {"block_o": best[1]}}
     return results
 
 

@@ -937,6 +937,85 @@ def gate_serving_smoke(max_batch: int = 4, n_requests: int = 10) -> int:
                   f"{sstats['accept_rate']:.0%} accepted) "
                   "token-identical to generate(), 0 compiles after "
                   "warmup")
+
+        # 6. BATCHED MULTI-LORA (docs/SERVING.md "Multi-LoRA"): many
+        # adapters + the base model churning through ONE engine.  The
+        # standing contracts, extended to adapter churn: loading /
+        # hot-loading / evicting adapters and mixing adapter ids within
+        # a batch are VALUE edits (0 compiles after warmup, jit caches
+        # unchanged at 1), and each adapter's greedy outputs are
+        # token-identical to a merged-weight (W + B_k A_k) reference
+        # model while base requests stay identical to generate() on the
+        # unmerged model.
+        pt.seed(0)
+        lomodel = llama("tiny")
+        pool = serving.LoRAPool(lomodel, max_adapters=3, rank=8)
+        lrng = np.random.default_rng(7)
+        adapter_w = {name: serving.random_adapter(
+            lomodel, rank=8, rng=lrng, scale=0.05)
+            for name in ("ad-a", "ad-b", "ad-c")}
+        pool.load("ad-a", adapter_w["ad-a"])
+        pool.load("ad-b", adapter_w["ad-b"])    # ad-c hot-loads below
+        leng = serving.Engine(lomodel, max_batch=max_batch,
+                              max_seq_len=64, page_size=8,
+                              prefill_chunk=8, lora=pool).warmup()
+        lora_warmup = tel.sentinel.compiles()
+        lprompts = [lrng.integers(0, lomodel.cfg.vocab_size,
+                                  size=n).astype(np.int32)
+                    for n in (5, 17, 9, 26, 12, 7)]
+        mix = [None, "ad-a", "ad-b", "ad-a", "ad-c", "ad-c"]
+        served = []
+        for i, (p, ad) in enumerate(zip(lprompts, mix)):
+            if i == 4:
+                # hot-load mid-churn: a buffer write into the stacked
+                # pool while requests are in flight — never a retrace
+                pool.load("ad-c", adapter_w["ad-c"])
+            rid = leng.add_request(p, max_new_tokens=6, adapter=ad)
+            leng.step()     # staggered: join a running batch
+            served.append((p, ad, rid))
+        louts = leng.run()
+        leng.add_request(lprompts[0], max_new_tokens=4, adapter="ad-b")
+        pool.evict("ad-a")              # idle: evictable mid-serve
+        louts.update(leng.run())
+        lora_churn = tel.sentinel.compiles() - lora_warmup
+        if lora_churn:
+            failures.append(
+                f"{lora_churn} compile(s) after warmup under multi-LoRA "
+                "churn — adapter load/evict/mixed batches must be value "
+                "edits into the stacked pool, never a retrace")
+        for fn, name in ((leng._step_fn, "lora step"),
+                         (leng._cow_fn, "lora cow")):
+            n = getattr(fn, "_cache_size", lambda: None)()
+            if n is not None and n > 1:
+                failures.append(
+                    f"{name} jit cache holds {n} entries, expected 1")
+        if leng.kv_blocks_used != 0:
+            failures.append(
+                f"{leng.kv_blocks_used} KV block(s) still referenced "
+                "after the multi-LoRA runs")
+        merged_models = {}
+        for name, w in adapter_w.items():
+            pt.seed(0)
+            m_ = llama("tiny")
+            serving.merge_adapter(m_, w)
+            merged_models[name] = m_
+        for p, ad, rid in served:
+            refm = lomodel if ad is None else merged_models[ad]
+            ref = np.asarray(refm.generate(
+                jnp.asarray(p)[None], max_new_tokens=6,
+                temperature=0.0))[0, len(p):]
+            if not np.array_equal(ref, np.asarray(louts[rid])):
+                failures.append(
+                    f"multi-LoRA request (adapter {ad!r}, prompt "
+                    f"{len(p)}) diverged from its "
+                    f"{'base' if ad is None else 'merged-weight'} "
+                    "reference — the grouped BGMV or slot routing is "
+                    "wrong")
+        if not any("LoRA" in f or "lora" in f for f in failures):
+            print(f"serving-smoke: multi-LoRA ({pool.loads} loads incl. "
+                  "1 hot-load mid-churn, 1 evict, mixed "
+                  "base+3-adapter batches) token-identical to "
+                  "merged-weight references, 0 compiles after warmup")
     finally:
         obs.disable()
 

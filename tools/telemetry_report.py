@@ -56,16 +56,27 @@ def _sinks():
     return _SINKS
 
 
-def _tenant_metric(key):
-    """``serve.tenant[acme].ttft_ms`` -> ("acme", "ttft_ms"), else
-    None — parsed with the exporter's own grammar."""
+def _labeled_metric(key, base_prefix, label_key):
+    """``serve.tenant[acme].ttft_ms`` -> ("acme", "ttft_ms") for
+    (``serve_tenant_``, ``tenant``), else None — parsed with the
+    exporter's own grammar so report and /metrics never drift."""
     base, labels = _sinks().prom_split(key)
-    if not base.startswith("serve_tenant_") or not labels:
+    if not base.startswith(base_prefix) or not labels:
         return None
     k, v = labels[0]
-    if k != "tenant":
+    if k != label_key:
         return None
-    return v, base[len("serve_tenant_"):]
+    return v, base[len(base_prefix):]
+
+
+def _tenant_metric(key):
+    return _labeled_metric(key, "serve_tenant_", "tenant")
+
+
+def _adapter_metric(key):
+    """``serve.lora.adapter[fr-legal].tokens`` -> ("fr-legal",
+    "tokens")."""
+    return _labeled_metric(key, "serve_lora_adapter_", "adapter")
 
 
 def _pct(sorted_vals, p):
@@ -138,7 +149,12 @@ def summarize(events):
                     # handoffs, completed/failed KV-page transfers,
                     # bytes shipped, and per-transfer wall ms
                     "handoffs": 0, "xfers": 0, "xfer_failures": 0,
-                    "xfer_bytes": 0, "xfer_ms": []},
+                    "xfer_bytes": 0, "xfer_ms": [],
+                    # batched multi-LoRA (docs/SERVING.md "Multi-LoRA"):
+                    # pool churn from serve_lora_load/evict events,
+                    # per-adapter request attribution off serve_request
+                    "lora_loads": 0, "lora_evicts": 0,
+                    "adapters": defaultdict(int)},
         # DP replica routing (docs/SERVING.md "Sharded serving"):
         # per-replica routed/affinity counts from serve_route events,
         # failures/requeues from serve_replica_fail
@@ -189,6 +205,12 @@ def summarize(events):
             sv["cached_tokens"] += e.get("cached_tokens") or 0
             if e.get("tenant"):
                 sv["tenants"][e["tenant"]] += 1
+            if e.get("adapter"):
+                sv["adapters"][e["adapter"]] += 1
+        elif kind == "serve_lora_load":
+            agg["serving"]["lora_loads"] += 1
+        elif kind == "serve_lora_evict":
+            agg["serving"]["lora_evicts"] += 1
         elif kind == "serve_preempt":
             sv = agg["serving"]
             sv["preempts"] += 1
@@ -293,6 +315,32 @@ def _phase_stats(traces):
                                   "p50": _pct(per_tok, 50),
                                   "p95": _pct(per_tok, 95)}
     return out
+
+
+def _lora_stats(agg):
+    """Multi-LoRA fold (docs/SERVING.md "Multi-LoRA"): pool gauges and
+    churn counters plus the per-adapter request/token counters
+    (``serve.lora.adapter[<name>].requests/tokens``), merged with the
+    serve_request event attribution for telemetry-off runs."""
+    m = agg["metrics"] or {}
+    sv = agg["serving"]
+    adapters = defaultdict(lambda: {"requests": 0, "tokens": 0})
+    for key, snap in m.items():
+        am = _adapter_metric(key)
+        if am is None or isinstance(snap, dict):
+            continue
+        name, metric = am
+        if metric in ("requests", "tokens"):
+            adapters[name][metric] = snap
+    for name, n in sv["adapters"].items():
+        if name not in adapters:
+            adapters[name]["requests"] = n
+    return {"active_adapters": m.get("serve.lora.active_adapters") or 0,
+            "loads": m.get("serve.lora.loads") or sv["lora_loads"],
+            "evictions": m.get("serve.lora.evictions")
+            or sv["lora_evicts"],
+            "adapters": {k: dict(v)
+                         for k, v in sorted(adapters.items())}}
 
 
 def _tenant_stats(agg):
@@ -470,6 +518,17 @@ def render(agg, malformed=0):
             # fully broken (errors > 0, proposed == 0) must still
             # surface the one signal that says so
             lines.append(f"| spec draft errors | {spec_err} |")
+        # batched multi-LoRA (docs/SERVING.md "Multi-LoRA"): pool churn
+        # plus per-adapter attribution — only when the run used a pool
+        lstats = _lora_stats(agg)
+        if lstats["loads"] or lstats["adapters"]:
+            lines.append(f"| LoRA adapters active (loads / evicts) | "
+                         f"{lstats['active_adapters']} "
+                         f"({lstats['loads']} / "
+                         f"{lstats['evictions']}) |")
+            for name, d in lstats["adapters"].items():
+                lines.append(f"| LoRA `{name}` requests / tokens | "
+                             f"{d['requests']} / {d['tokens']} |")
         # front-door robustness columns (docs/SERVING.md "Front door"):
         # preemption/swap volume, shed reasons, isolation count, and
         # per-tenant attribution — only when the run exercised them
@@ -692,6 +751,8 @@ def main(argv=None) -> int:
             "xfer_bytes": sv["xfer_bytes"],
             "xfer_p50_ms": _pct(sorted(sv["xfer_ms"]), 50),
             "xfer_p95_ms": _pct(sorted(sv["xfer_ms"]), 95),
+            # batched multi-LoRA (docs/SERVING.md "Multi-LoRA")
+            "lora": _lora_stats(agg),
         }
     if agg["replicas"]:
         summary["replicas"] = {
