@@ -34,7 +34,8 @@ from ..core import Finding, ParsedFile, call_name, expr_key
 RULE = "fault-site"
 
 _ENTRY_RE = re.compile(r"^(?P<site>[\w.]+)@(?P<at>\d+)(?:x(?P<times>\d+))?$")
-_SITE_LIKE = re.compile(r"^(ckpt|store|serve)\.[\w.]+$|^(step|collective)$")
+_SITE_LIKE = re.compile(
+    r"^(ckpt|store|serve|cluster)\.[\w.]+$|^(step|collective)$")
 _INJECTOR_CALLEES = ("maybe_fault", "_fault")
 _PLAN_CALLEES = ("install_faults", "parse_faults")
 

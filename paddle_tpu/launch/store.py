@@ -130,11 +130,20 @@ class TCPStore:
     process (master included) talks to it through a client socket, like the
     reference where rank 0 hosts the store in-process.
 
-    ``retry`` (a ``resilience.RetryPolicy``) makes ``set``/``get``
-    survive transient socket failures: a failed op reconnects the client
-    socket and re-attempts under the policy (a blip in the master's
-    network must cost a heartbeat, not the job).  ``store.set`` /
-    ``store.get`` are registered fault-injection sites.
+    ``retry`` (a ``resilience.RetryPolicy``) makes ``set``/``get`` —
+    and the control-plane ops ``add``/``delete``/``compare_set``/
+    ``keys`` — survive transient socket failures: a failed op
+    reconnects the client socket and re-attempts under the policy (a
+    blip in the master's network must cost a heartbeat, not the job;
+    a bounced controller must cost a serving worker one retry, not its
+    lease mid-epoch).  ``store.set`` / ``store.get`` are registered
+    fault-injection sites; the mutating control ops fire ``store.set``
+    and ``keys`` fires ``store.get``.  ``compare_set`` is made
+    reconnect-idempotent: a retried CAS whose FIRST attempt applied
+    server-side (the reply died with the socket) reports success when
+    the key now holds the desired value, so a lease-renew chain never
+    breaks on its own ghost write.  ``wait`` is deliberately NOT
+    retried — its timeout is an answer, not a transient.
 
     ``set``/``get`` also take a per-call ``timeout=`` override on the
     client socket: one store serves both sub-second heartbeats and
@@ -242,17 +251,38 @@ class TCPStore:
         return r[1] if r[0] == b"ok" else None
 
     def add(self, key: str, amount: int = 1) -> int:
-        r = self._call("add", key.encode(), str(amount).encode())
+        # NOTE: add is retried for connectivity, not idempotency — a
+        # reply lost to a reconnect may double-apply the increment.
+        # Every caller treats the counter as an allocator of unique /
+        # monotonic values (barrier arrivals excepted, which never
+        # share a socket failure with a healthy barrier), so a skipped
+        # value is safe where a dead client socket is not.
+        r = self._resilient(
+            "store.set",
+            lambda: self._call("add", key.encode(), str(amount).encode()))
         return int(r[1])
 
     def delete(self, key: str) -> bool:
-        return self._call("delete", key.encode())[0] == b"ok"
+        r = self._resilient(
+            "store.set", lambda: self._call("delete", key.encode()))
+        return r[0] == b"ok"
 
     def compare_set(self, key: str, expect: bytes, value: bytes) -> bool:
-        return self._call("cas", key.encode(), expect, value)[0] == b"ok"
+        r = self._resilient(
+            "store.set",
+            lambda: self._call("cas", key.encode(), expect, value))
+        if r[0] == b"ok":
+            return True
+        # Reconnect idempotency: if an earlier attempt applied but its
+        # reply died with the socket, the retried CAS sees expect-
+        # mismatch with the key already holding OUR value — that is a
+        # success, not a conflict (lease renewal chains CAS on the
+        # previous value, so a ghost write must not drop the lease).
+        return len(r) > 1 and r[1] == value and value != expect
 
     def keys(self, prefix: str = "") -> list:
-        r = self._call("list", prefix.encode())
+        r = self._resilient(
+            "store.get", lambda: self._call("list", prefix.encode()))
         return [k.decode() for k in r[1:]]
 
     def wait(self, key: str, timeout: Optional[float] = None) -> bytes:

@@ -21,6 +21,7 @@ Spec grammar (code or the ``PDTPU_FAULTS`` env var)::
             | serve.admit | serve.prefill | serve.step | serve.cow | serve.swap
             | serve.route | serve.replica | serve.spec
             | serve.xfer.put | serve.xfer.get
+            | cluster.register | cluster.lease | cluster.command
     index   = 0-based per-site call counter value at which firing starts
     times   = number of consecutive calls that fire (default 1)
     exc     = InjectedFault | RuntimeError | OSError | ConnectionError
@@ -71,11 +72,22 @@ __all__ = ["SITES", "InjectedFault", "FaultPlan", "FaultInjector",
 #: transfer failure and the replica set degrades that request to a
 #: fresh re-prefill on the destination (the ``serving-disagg`` CI
 #: gate's contract — greedy outputs stay token-identical either way).
+#: The ``cluster.*`` sites cover the serving control plane
+#: (``serving/cluster.py`` + ``serving/worker.py``):
+#: ``cluster.register`` fires in the worker's register/re-register
+#: store transaction, ``cluster.lease`` in its lease-renew CAS, and
+#: ``cluster.command`` in the command-apply path — register and renew
+#: are retried under the worker's ``RetryPolicy`` (a transient fault is
+#: a logged retry; renew exhaustion is treated as a LOST lease, so the
+#: worker stops acting on its epoch and rejoins fresh), while a command
+#: fault requeues the command for the next loop iteration (commands are
+#: idempotent per epoch — the ``serving-cluster`` CI gate's contract).
 SITES = ("ckpt.save", "ckpt.load", "collective", "step",
          "store.get", "store.set",
          "serve.admit", "serve.prefill", "serve.step", "serve.cow",
          "serve.swap", "serve.route", "serve.replica", "serve.spec",
-         "serve.xfer.put", "serve.xfer.get")
+         "serve.xfer.put", "serve.xfer.get",
+         "cluster.register", "cluster.lease", "cluster.command")
 
 
 class InjectedFault(RuntimeError):

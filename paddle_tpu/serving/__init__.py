@@ -48,6 +48,16 @@ tenants to adapters via ``TenantPolicy(adapter=)``.  Admission of an
 unloaded adapter raises the typed ``errors.UnknownAdapter``; evicting
 an adapter with live requests raises ``errors.AdapterInUse``.
 
+Cluster serving (docs/SERVING.md "Cluster serving"): per-host
+``ServingWorker`` loops (``python -m paddle_tpu.serving.worker``)
+register with the TCPStore under epoch-fenced leases and step their
+local Engine independently; a thin ``ClusterController`` routes
+admissions/handoffs through store-backed queues, evacuates dead or
+draining workers' requests from their last ``KVHandout`` snapshots,
+and drives SLO-based elasticity (``role_flip`` / ``drain`` /
+``rolling_upgrade``) — no shared driver, zero recompiles across
+membership churn.
+
 Usage::
 
     from paddle_tpu import serving
@@ -69,6 +79,8 @@ from __future__ import annotations
 
 from .block_allocator import (BlockAllocator, PagedKVCache,  # noqa: F401
                               PrefixCache, SwapManager)
+from .cluster import (ClusterController, LeaseLost,  # noqa: F401
+                      LeaseMonitor, StoreQueue)
 from .disagg import (DisaggReplicaSet, HeartbeatMonitor,  # noqa: F401
                      KVHandout, KVTransport, LoopbackTransport,
                      StoreTransport, TransferError)
@@ -84,6 +96,7 @@ from .frontdoor import (Admission, FrontDoor, TenantPolicy,  # noqa: F401
 from .scheduler import Request, RequestState, Scheduler  # noqa: F401
 from .server import ServingServer  # noqa: F401
 from .spec import NgramProposer  # noqa: F401
+from .worker import ServingWorker  # noqa: F401
 
 # public namespace hygiene: no foreign-module re-exports (tools/check_api_compat)
 from paddle_tpu._export import public_all as _public_all

@@ -1,0 +1,657 @@
+"""Cluster control plane: store-backed membership, leases, evacuation.
+
+Reference: python/paddle/distributed/launch/controllers (master/elastic
+controllers) — restart-the-world elasticity for training jobs.  The
+serving tier needs the LIVE version: per-host worker loops that keep
+decoding through membership churn, with one thin controller that owns
+routing and failure handling but never steps an engine.
+
+Design (docs/SERVING.md "Cluster serving"):
+
+- **Workers** (``serving/worker.py``) register with the TCPStore, renew
+  an epoch-fenced lease, and pull admissions / KV handoffs / control
+  commands from per-worker store queues — no shared driver, so a host
+  failure, GC pause, or upgrade is confined to its failure domain.
+- **The controller** (:class:`ClusterController`) is the
+  ``EngineReplicaSet``/``DisaggReplicaSet`` routing policy lifted behind
+  a store-backed membership view: it routes fresh admissions to the
+  prefill tier, prefill-complete ``KVHandout`` refs to the decode tier
+  (most-free-blocks, the disagg rule), detects dead workers through
+  :class:`LeaseMonitor` (the PR-12 ``HeartbeatMonitor`` with dynamic
+  membership), and **evacuates** a dead worker's in-flight requests:
+  refs whose KV payload already landed in the transport re-route
+  token-identically, the rest re-enter admission as a fresh re-prefill
+  (PR 8/12 semantics — greedy outputs are token-identical either way).
+- **Epoch fencing**: every lease, queue item, command and output record
+  carries the worker's registration epoch (a store counter).  A
+  paused-then-resumed worker whose lease was revoked fails its next
+  CAS renew (:class:`LeaseLost`), aborts without publishing, and
+  rejoins under a fresh epoch; its late writes are fenced at collection
+  (``cluster_stale_out``) because the assignment moved on.
+- **SLO-driven elasticity**: workers publish live status (queue depth,
+  free blocks, rolling ``serve.ttft_ms`` p95, ``SLOCapture`` breaches);
+  the controller compares tiers and issues typed commands —
+  ``role_flip`` (drain → ``engine.role`` attribute write → re-register;
+  the compiled programs are role-independent, so ZERO recompiles),
+  ``drain`` (scale-down), ``rolling_upgrade`` (drain → hot-swap params
+  → rejoin under a new epoch).  Every transition rides the same
+  evacuation machinery as a kill, which is what the ``serving-cluster``
+  CI gate pins: token-identity and zero recompiles across flips, kills
+  and upgrades.
+
+Store schema (all under ``<prefix>/``, default ``cluster/``)::
+
+    epoch                 global epoch counter (store.add)
+    workers/<wid>         JSON {role, epoch, pid, state, version}
+    lease/<wid>           JSON {epoch, t} — CAS-chained by the worker;
+                          the controller revokes with a tombstone
+    status/<wid>          JSON load/SLO snapshot (worker, ~1 Hz)
+    q/adm/<wid>/…         per-worker admission queue   (StoreQueue)
+    q/hoff/<wid>/…        per-worker handoff-ref queue (StoreQueue)
+    q/cmd/<wid>/…         per-worker command queue     (StoreQueue)
+    q/handoffs/…          global prefill→controller handoff refs
+    q/evac/…              global drain/evacuation refs
+    assign/<rid>          JSON {wid, epoch, ref} — routing fence
+    out/<rid>             JSON {tokens, reason, worker, epoch}
+    cmdack/<cid>          JSON {ok, reason} — command acknowledgement
+    xfer/…                KV page payloads (``StoreTransport``)
+
+Only the worker half touches jax; this module is host-side bookkeeping
+over the store plus the PR-12 transport, so the controller can run on a
+CPU-only coordinator host.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from .. import observability as obs
+from .disagg import HeartbeatMonitor, StoreTransport
+
+__all__ = ["ClusterController", "LeaseMonitor", "LeaseLost", "StoreQueue"]
+
+
+class LeaseLost(RuntimeError):
+    """The worker's lease-renew CAS lost its chain: the controller
+    revoked the lease (presumed dead / fenced) or renewal exhausted its
+    retries.  The worker must stop acting on its epoch — abort in-flight
+    work WITHOUT publishing, clear engine state, and re-register under a
+    fresh epoch.  Deliberately not retryable (``retry.DEFAULT_RETRYABLE``
+    excludes it): retrying a lost lease is exactly the stale-ownership
+    bug the fence exists to prevent."""
+
+
+# ---------------------------------------------------------------------------
+# store-backed primitives
+# ---------------------------------------------------------------------------
+
+class StoreQueue:
+    """A single-reader FIFO over store keys: ``<base>/tail`` is an
+    ``add`` counter allocating sequence numbers, ``<base>/<seq>`` holds
+    one JSON item.  The reader owns a local head cursor and deletes
+    consumed keys.
+
+    Hole-tolerant: a push is add-then-set, so the reader can observe the
+    tail before the item body lands (break and retry next poll), and a
+    retried ``add`` whose first reply died with its socket may skip a
+    sequence number forever — after ``MISS_LIMIT`` polls the reader
+    steps over the hole and counts it (``holes``) instead of wedging the
+    queue.
+
+    The head cursor is persisted under ``<base>/head`` after each
+    consuming ``pop_all``, so a fresh reader (restarted process) resumes
+    exactly where its predecessor stopped — it neither replays consumed
+    items nor (by scanning for survivors) races an in-flight push whose
+    body hasn't landed yet."""
+
+    MISS_LIMIT = 8
+
+    def __init__(self, store, base: str):
+        self.store = store
+        self.base = base.rstrip("/")
+        self.holes = 0
+        self._head: Optional[int] = None
+        self._miss: Dict[int, int] = {}
+
+    def _catch_up(self) -> None:
+        if self._head is not None:
+            return
+        raw = self.store.get(f"{self.base}/head")
+        self._head = int(raw) if raw else 0
+
+    def push(self, item: dict) -> int:
+        seq = self.store.add(f"{self.base}/tail", 1) - 1
+        self.store.set(f"{self.base}/{seq}",
+                       json.dumps(item).encode())
+        return seq
+
+    def pop_all(self) -> List[dict]:
+        raw = self.store.get(f"{self.base}/tail")
+        tail = int(raw) if raw else 0
+        self._catch_up()
+        head0 = self._head
+        out: List[dict] = []
+        while self._head < tail:
+            key = f"{self.base}/{self._head}"
+            blob = self.store.get(key)
+            if blob is None:
+                n = self._miss.get(self._head, 0) + 1
+                if n < self.MISS_LIMIT:
+                    self._miss[self._head] = n
+                    break           # in-flight push: retry next poll
+                self._miss.pop(self._head, None)
+                self.holes += 1     # skipped seq from a retried add
+                self._head += 1
+                continue
+            self._miss.pop(self._head, None)
+            self.store.delete(key)
+            out.append(json.loads(blob.decode()))
+            self._head += 1
+        if self._head != head0:
+            self.store.set(f"{self.base}/head",
+                           str(self._head).encode())
+        return out
+
+
+class LeaseMonitor(HeartbeatMonitor):
+    """Dynamic-membership :class:`~paddle_tpu.serving.HeartbeatMonitor`:
+    leases double as heartbeats.  A lease value is the worker's
+    CAS-chained JSON ``{"epoch": E, "t": wall}``; :meth:`stale_workers`
+    applies the same rules as the indexed ``stale()`` — missing means
+    not-yet-monitored, present-but-old or unparsable (including the
+    controller's revocation tombstone) means dead.  Wall clock, not
+    monotonic: the timestamps are compared across processes."""
+
+    def __init__(self, store, *, prefix: str = "cluster/lease",
+                 deadline_s: float = 10.0,
+                 interval_s: Optional[float] = None, clock=time.time):
+        super().__init__(store, 0, prefix=prefix, deadline_s=deadline_s,
+                         interval_s=interval_s, clock=clock)
+
+    def lease(self, wid: str) -> Optional[dict]:
+        raw = self.store.get(f"{self.prefix}/{wid}")
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw.decode())
+        except (ValueError, UnicodeDecodeError):
+            return {}               # tombstone / garbage: dead
+
+    def stale_workers(self, wids) -> List[str]:
+        out = []
+        now = self.clock()
+        for wid in wids:
+            lease = self.lease(wid)
+            if lease is None:
+                continue            # never registered: not monitored
+            try:
+                ts = float(lease["t"])
+            except (KeyError, TypeError, ValueError):
+                out.append(wid)     # unparsable == dead
+                continue
+            if now - ts > self.deadline_s:
+                out.append(wid)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# admission wire helpers (shared with serving/worker.py)
+# ---------------------------------------------------------------------------
+
+def admission_of(req) -> dict:
+    """A scheduler ``Request`` flattened to the JSON the admission
+    queues carry — everything a fresh re-prefill needs.  Streaming
+    callbacks cannot ride (same rule as ``KVHandout``); greedy outputs
+    are identical on re-prefill, sampled ones re-seed."""
+    return {"rid": req.request_id,
+            "prompt": [int(t) for t in np.asarray(req.prompt_ids).ravel()],
+            "max_new_tokens": int(req.max_new_tokens),
+            "temperature": float(req.temperature),
+            "eos_token_id": req.eos_token_id,
+            "tenant": req.tenant,
+            "adapter": req.adapter}
+
+
+def admit_admission(engine, adm: dict) -> str:
+    """Queue a flattened admission on ``engine``; duplicate request ids
+    surface as ``AdmissionError`` (callers treat that as already-admitted
+    and skip — controller re-routes are at-least-once)."""
+    return engine.add_request(
+        np.asarray(adm["prompt"], np.int32),
+        max_new_tokens=int(adm["max_new_tokens"]),
+        temperature=float(adm.get("temperature", 0.0)),
+        eos_token_id=adm.get("eos_token_id"),
+        request_id=adm["rid"],
+        tenant=adm.get("tenant"),
+        adapter=adm.get("adapter"))
+
+
+# ---------------------------------------------------------------------------
+# the controller
+# ---------------------------------------------------------------------------
+
+class ClusterController:
+    """Routing + failure handling for a store-registered worker fleet.
+
+    The controller never steps an engine and holds no KV: its whole
+    state is the store (assignments, outs, membership) plus local read
+    cursors, so a bounced controller process recovers by re-reading
+    ``assign/`` and ``out/`` (:meth:`_recover`) while the workers ride
+    out the blip under ``TCPStore``'s reconnect-with-retry.
+
+    Drive it with :meth:`pump` (one control round: route queued refs,
+    reap stale leases, collect outputs, autoscale) — from a loop, a
+    thread, or interleaved with in-process worker ``step()`` calls in
+    tests.  ``submit``/``collect`` give it the Engine-shaped
+    producer/consumer surface the tests and the gate drive."""
+
+    def __init__(self, store, *, prefix: str = "cluster",
+                 lease_deadline_s: float = 10.0, clock=time.time,
+                 transport=None, autoscale: bool = False,
+                 min_tier: int = 1, flip_queue_ratio: float = 4.0,
+                 flip_cooldown_s: float = 5.0,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.store = store
+        self.prefix = prefix.rstrip("/")
+        self.clock = clock
+        self.transport = transport if transport is not None else \
+            StoreTransport(store, prefix=f"{self.prefix}/xfer")
+        self.monitor = LeaseMonitor(
+            store, prefix=f"{self.prefix}/lease",
+            deadline_s=lease_deadline_s, clock=clock)
+        self.autoscale = autoscale
+        self.min_tier = int(min_tier)
+        self.flip_queue_ratio = float(flip_queue_ratio)
+        self.flip_cooldown_s = float(flip_cooldown_s)
+        self._sleep = sleep
+        self._handoff_q = StoreQueue(store, f"{self.prefix}/q/handoffs")
+        self._evac_q = StoreQueue(store, f"{self.prefix}/q/evac")
+        self._workers: Dict[str, dict] = {}
+        self._status: Dict[str, dict] = {}
+        self._assigned: Dict[str, dict] = {}   # rid -> {wid, epoch, ref}
+        self._payloads: Dict[str, list] = {}   # rid -> [(xfer key, nbytes)]
+        self._outs: Dict[str, dict] = {}
+        self._pending: List[dict] = []         # refs with no target yet
+        self._cmd_seq = 0
+        self._rid_seq = 0
+        self._flip_ok_at = 0.0
+        self._push_queues: Dict[str, StoreQueue] = {}
+        self._recover()
+
+    def _q(self, path: str) -> StoreQueue:
+        q = self._push_queues.get(path)
+        if q is None:
+            q = self._push_queues[path] = StoreQueue(
+                self.store, f"{self.prefix}/{path}")
+        return q
+
+    # -- producer / consumer surface ---------------------------------------
+
+    def submit(self, prompt_ids, max_new_tokens: int = 16,
+               temperature: float = 0.0,
+               eos_token_id: Optional[int] = None,
+               request_id: Optional[str] = None,
+               tenant: Optional[str] = None,
+               adapter: Optional[str] = None) -> str:
+        """Queue one request for the prefill tier; returns its id.
+        Routing happens on the next :meth:`pump` if no worker is
+        eligible yet (startup races are pending work, not errors)."""
+        if request_id is None:
+            request_id = f"creq-{self._rid_seq}"
+            self._rid_seq += 1
+        adm = {"rid": request_id,
+               "prompt": [int(t) for t in
+                          np.asarray(prompt_ids).ravel()],
+               "max_new_tokens": int(max_new_tokens),
+               "temperature": float(temperature),
+               "eos_token_id": eos_token_id,
+               "tenant": tenant, "adapter": adapter}
+        self._route({"rid": request_id, "xfer": None, "adm": adm,
+                     "from": "controller"})
+        return request_id
+
+    @property
+    def outputs(self) -> Dict[str, dict]:
+        """Collected output records: ``rid -> {tokens, reason, worker,
+        epoch}`` (fenced — only the live assignment's write counts)."""
+        return dict(self._outs)
+
+    def collect(self, request_id: str, *, timeout_s: float = 30.0,
+                poll_s: float = 0.005,
+                advance: Optional[Callable[[], None]] = None) -> dict:
+        """Pump until ``request_id``'s output lands (or raise
+        ``TimeoutError``).  ``advance`` runs every poll — in-process
+        tests pass a closure stepping their workers; cross-process
+        deployments leave it None and the workers make progress on
+        their own."""
+        deadline = self.clock() + timeout_s
+        while True:
+            if request_id in self._outs:
+                return self._outs[request_id]
+            if advance is not None:
+                advance()
+            self.pump()
+            if request_id in self._outs:
+                return self._outs[request_id]
+            if self.clock() > deadline:
+                raise TimeoutError(
+                    f"no output for {request_id!r} within {timeout_s}s "
+                    f"(assigned: {self._assigned.get(request_id)})")
+            self._sleep(poll_s)
+
+    # -- membership --------------------------------------------------------
+
+    def members(self, *, refresh: bool = True) -> Dict[str, dict]:
+        """``wid -> record`` for every registered worker (any state)."""
+        if refresh:
+            base = f"{self.prefix}/workers/"
+            recs = {}
+            for key in self.store.keys(base):
+                raw = self.store.get(key)
+                if raw is None:
+                    continue
+                try:
+                    recs[key[len(base):]] = json.loads(raw.decode())
+                except (ValueError, UnicodeDecodeError):
+                    continue
+            self._workers = recs
+        return dict(self._workers)
+
+    def wait_for_workers(self, n: int, *, timeout_s: float = 60.0,
+                         role: Optional[str] = None) -> List[str]:
+        """Block until ``n`` workers (optionally of ``role``) are up."""
+        deadline = self.clock() + timeout_s
+        while True:
+            up = [w for w, r in self.members().items()
+                  if r.get("state") == "up"
+                  and (role is None or r.get("role") == role)]
+            if len(up) >= n:
+                return sorted(up)
+            if self.clock() > deadline:
+                raise TimeoutError(
+                    f"only {len(up)}/{n} workers up within {timeout_s}s")
+            self._sleep(0.02)
+
+    def _live(self, role: Optional[str] = None) -> List[str]:
+        return [w for w, r in self._workers.items()
+                if r.get("state") == "up"
+                and (role is None or r.get("role") in (role, "both"))]
+
+    def _refresh_status(self) -> None:
+        for wid in self._workers:
+            raw = self.store.get(f"{self.prefix}/status/{wid}")
+            if raw is None:
+                continue
+            try:
+                self._status[wid] = json.loads(raw.decode())
+            except (ValueError, UnicodeDecodeError):
+                continue
+
+    # -- routing -----------------------------------------------------------
+
+    def _pick(self, tier: str) -> Optional[str]:
+        """Healthiest eligible worker: decode refs go to most free
+        blocks (the disagg rule — a restore needs contiguous budget),
+        admissions to the shallowest prefill queue.  Deterministic
+        (ties break on wid) so chaos runs replay."""
+        cands = self._live(tier)
+        if not cands:
+            return None
+
+        def load(w):
+            s = self._status.get(w, {})
+            return (s.get("queue_depth", 0) + s.get("active", 0),
+                    -s.get("free_blocks", 0), w)
+
+        if tier == "decode":
+            return min(cands, key=lambda w: (
+                -self._status.get(w, {}).get("free_blocks", 0),
+                self._status.get(w, {}).get("queue_depth", 0), w))
+        return min(cands, key=load)
+
+    def _route(self, ref: dict) -> bool:
+        """Route one ref: a KV handoff (``xfer`` set) to the decode
+        tier — unless the snapshot is mid-prefill, which resumes on the
+        prefill tier — and a bare admission to the prefill tier.
+        Unroutable refs pend for the next pump."""
+        tier = "decode" if ref.get("xfer") and not ref.get("prefilling") \
+            else "prefill"
+        wid = self._pick(tier)
+        if wid is None:
+            self._pending.append(ref)
+            return False
+        rec = self._workers[wid]
+        rid = ref["rid"]
+        item = dict(ref, wid=wid, epoch=rec.get("epoch"))
+        q = "hoff" if ref.get("xfer") else "adm"
+        self._q(f"q/{q}/{wid}").push(item)
+        assign = {"wid": wid, "epoch": rec.get("epoch"), "ref": ref}
+        self._assigned[rid] = assign
+        self.store.set(f"{self.prefix}/assign/{rid}",
+                       json.dumps(assign).encode())
+        if ref.get("xfer"):
+            pl = self._payloads.setdefault(rid, [])
+            ent = (ref["xfer"], int(ref.get("nbytes", 0)))
+            if ent not in pl:
+                pl.append(ent)
+        obs.emit_event("cluster_route", id=rid, worker=wid, tier=tier,
+                       xfer=bool(ref.get("xfer")))
+        return True
+
+    # -- control commands --------------------------------------------------
+
+    def _command(self, wid: str, cmd: dict) -> str:
+        rec = self._workers.get(wid) or self.members().get(wid)
+        if rec is None:
+            raise KeyError(f"unknown worker {wid!r}")
+        cid = f"cmd-{self._cmd_seq}"
+        self._cmd_seq += 1
+        item = dict(cmd, id=cid, epoch=rec.get("epoch"))
+        self._q(f"q/cmd/{wid}").push(item)
+        obs.emit_event("cluster_command", worker=wid, id=cid,
+                       kind=cmd.get("kind"), epoch=rec.get("epoch"))
+        return cid
+
+    def role_flip(self, wid: str, role: str) -> str:
+        """Drain ``wid`` and re-register it as ``role`` — the elasticity
+        primitive.  Zero recompiles: the worker's compiled programs are
+        role-independent; the flip is an attribute write between
+        epochs."""
+        if role not in ("prefill", "decode"):
+            raise ValueError(f"role_flip target must be prefill/decode, "
+                             f"got {role!r}")
+        reg = obs.get_registry()
+        if reg is not None:
+            reg.counter("cluster.role_flips").inc()
+        return self._command(wid, {"kind": "role_flip", "role": role})
+
+    def drain_worker(self, wid: str) -> str:
+        """Graceful scale-down: evacuate and deregister ``wid``."""
+        return self._command(wid, {"kind": "drain"})
+
+    def rolling_upgrade(self, wid: str, version: str) -> str:
+        """Drain → hot-swap params (the worker's ``param_source``) →
+        rejoin under a new lease epoch."""
+        reg = obs.get_registry()
+        if reg is not None:
+            reg.counter("cluster.upgrades").inc()
+        return self._command(wid, {"kind": "rolling_upgrade",
+                                   "version": version})
+
+    def command_ack(self, cid: str) -> Optional[dict]:
+        raw = self.store.get(f"{self.prefix}/cmdack/{cid}")
+        return json.loads(raw.decode()) if raw is not None else None
+
+    # -- failure detection + evacuation ------------------------------------
+
+    def _fail_worker(self, wid: str, *, reason: str = "lease_expired"
+                     ) -> int:
+        """Declare ``wid`` dead: revoke its lease (tombstone — the
+        worker's next CAS renew raises :class:`LeaseLost`, fencing a
+        paused-then-resumed process out of its old epoch) and re-route
+        every unfinished assignment.  Refs whose payload already landed
+        in the transport move token-identically; the rest re-enter
+        admission as a fresh re-prefill."""
+        rec = self._workers.get(wid, {})
+        epoch = rec.get("epoch")
+        self.store.set(f"{self.prefix}/lease/{wid}",
+                       f"revoked:{epoch}".encode())
+        rec = dict(rec, state="dead")
+        self._workers[wid] = rec
+        self.store.set(f"{self.prefix}/workers/{wid}",
+                       json.dumps(rec).encode())
+        reg = obs.get_registry()
+        if reg is not None:
+            reg.counter("cluster.deaths").inc()
+        obs.emit_event("cluster_dead", worker=wid, epoch=epoch,
+                       reason=reason)
+        moved = 0
+        for rid, a in list(self._assigned.items()):
+            if a.get("wid") != wid or rid in self._outs:
+                continue
+            self._route(a["ref"])
+            moved += 1
+        if reg is not None and moved:
+            reg.counter("cluster.evacuated").inc(moved)
+        obs.emit_event("cluster_evacuate", worker=wid, moved=moved,
+                       by="controller", reason=reason)
+        return moved
+
+    # -- output collection -------------------------------------------------
+
+    def _collect_outs(self) -> int:
+        got = 0
+        for rid, a in list(self._assigned.items()):
+            if rid in self._outs:
+                continue
+            raw = self.store.get(f"{self.prefix}/out/{rid}")
+            if raw is None:
+                continue
+            try:
+                out = json.loads(raw.decode())
+            except (ValueError, UnicodeDecodeError):
+                continue
+            if out.get("worker") != a.get("wid") \
+                    or out.get("epoch") != a.get("epoch"):
+                # a fenced write from a stale epoch: the assignment
+                # moved on — drop it so the live worker's record lands
+                obs.emit_event("cluster_stale_out", id=rid,
+                               worker=out.get("worker"),
+                               epoch=out.get("epoch"),
+                               expected=a.get("wid"))
+                self.store.delete(f"{self.prefix}/out/{rid}")
+                continue
+            self._outs[rid] = out
+            self.store.delete(f"{self.prefix}/out/{rid}")
+            for key, nbytes in self._payloads.pop(rid, []):
+                try:
+                    self.transport.discard(key, nbytes)
+                except Exception:  # noqa: BLE001 — best-effort cleanup
+                    pass
+            got += 1
+        return got
+
+    # -- elasticity --------------------------------------------------------
+
+    def _tier_load(self, wids) -> float:
+        return sum(self._status.get(w, {}).get("queue_depth", 0)
+                   + self._status.get(w, {}).get("active", 0)
+                   for w in wids)
+
+    def _tier_breached(self, wids) -> bool:
+        return any(self._status.get(w, {}).get("slo_breached")
+                   for w in wids)
+
+    def _autoscale(self) -> Optional[str]:
+        """One SLO/load-driven rebalance decision per cooldown window:
+        when a tier is starved (queue imbalance beyond
+        ``flip_queue_ratio``, or breaching its TTFT SLO while the other
+        tier is healthy) and the donor tier can spare a worker
+        (``min_tier``), flip the donor's idlest worker over.  The flip
+        itself is the same drain→re-register evacuation as a kill."""
+        if not self.autoscale or self.clock() < self._flip_ok_at:
+            return None
+        pre, dec = self._live("prefill"), self._live("decode")
+        if not pre or not dec:
+            return None
+        pre_load, dec_load = self._tier_load(pre), self._tier_load(dec)
+        pre_hot = pre_load > self.flip_queue_ratio * max(dec_load, 1) \
+            or (self._tier_breached(pre) and not self._tier_breached(dec))
+        dec_hot = dec_load > self.flip_queue_ratio * max(pre_load, 1) \
+            or (self._tier_breached(dec) and not self._tier_breached(pre))
+
+        def idlest(wids):
+            return min(wids, key=lambda w: (
+                self._status.get(w, {}).get("queue_depth", 0)
+                + self._status.get(w, {}).get("active", 0), w))
+
+        if pre_hot and pre_load > len(pre) and len(dec) > self.min_tier:
+            wid = idlest(dec)
+            self.role_flip(wid, "prefill")
+        elif dec_hot and dec_load > len(dec) and len(pre) > self.min_tier:
+            wid = idlest(pre)
+            self.role_flip(wid, "decode")
+        else:
+            return None
+        self._flip_ok_at = self.clock() + self.flip_cooldown_s
+        obs.emit_event("cluster_autoscale", worker=wid,
+                       prefill_load=pre_load, decode_load=dec_load)
+        return wid
+
+    # -- the control round -------------------------------------------------
+
+    def pump(self) -> Dict[str, int]:
+        """One control round: refresh membership/status, route queued
+        handoff + evacuation refs (and anything pending), reap stale
+        leases into evacuation, collect fenced outputs, autoscale."""
+        self.members()
+        self._refresh_status()
+        routed = 0
+        pending, self._pending = self._pending, []
+        for ref in pending:
+            routed += bool(self._route(ref))
+        for ref in self._handoff_q.pop_all():
+            routed += bool(self._route(ref))
+        for ref in self._evac_q.pop_all():
+            routed += bool(self._route(ref))
+        reaped = 0
+        for wid in self.monitor.stale_workers(self._live()):
+            self._fail_worker(wid)
+            reaped += 1
+        got = self._collect_outs()
+        self._autoscale()
+        reg = obs.get_registry()
+        if reg is not None:
+            reg.gauge("cluster.workers").set(len(self._live()))
+            reg.gauge("cluster.pending").set(len(self._pending))
+        return {"routed": routed, "reaped": reaped, "collected": got,
+                "pending": len(self._pending)}
+
+    # -- recovery ----------------------------------------------------------
+
+    def _recover(self) -> None:
+        """Rebuild assignment state from the store after a controller
+        restart: ``assign/`` is the source of truth, ``out/`` keys are
+        collected on the next pump.  Queue read cursors restart at the
+        tail... of nothing — unconsumed global-queue items are re-read
+        from seq 0 and re-routing an already-assigned rid just updates
+        its assignment (workers skip duplicate admissions)."""
+        base = f"{self.prefix}/assign/"
+        for key in self.store.keys(base):
+            raw = self.store.get(key)
+            if raw is None:
+                continue
+            try:
+                a = json.loads(raw.decode())
+            except (ValueError, UnicodeDecodeError):
+                continue
+            rid = key[len(base):]
+            self._assigned[rid] = a
+            ref = a.get("ref") or {}
+            if ref.get("xfer"):
+                self._payloads.setdefault(rid, []).append(
+                    (ref["xfer"], int(ref.get("nbytes", 0))))

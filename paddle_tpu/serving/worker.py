@@ -1,0 +1,671 @@
+"""Per-host serving worker: one Engine, one lease, no shared driver.
+
+``python -m paddle_tpu.serving.worker --store=HOST:PORT --role=decode
+--factory=pkg.mod:make_engine`` runs the per-host half of the cluster
+control plane (``serving/cluster.py``): register with the TCPStore,
+renew an epoch-fenced lease, pull admissions / KV-handoff refs /
+control commands from this worker's store queues, step the local
+Engine, and publish handoffs, outputs and load status back.  The
+controller never steps anything — a host failure, GC pause, or upgrade
+is confined to this process's failure domain.
+
+Lifecycle (docs/SERVING.md "Cluster serving")::
+
+    register ──► lease renew loop ──► serve (intake/step/publish)
+        ▲                                   │
+        │        drain (evacuate KV ► evac queue)
+        └── re-register ◄── role_flip / rolling_upgrade
+                     deregister ◄── drain cmd / SIGTERM
+
+Fencing rules this module owns:
+
+- ``renew_lease`` CAS-chains the lease value; a revoked lease (the
+  controller's tombstone) or exhausted retries raise
+  :class:`~paddle_tpu.serving.cluster.LeaseLost` — the worker aborts
+  its in-flight work WITHOUT publishing, clears engine state, and
+  rejoins under a fresh epoch.  A paused-then-resumed process can
+  therefore never act on stale ownership: its queue items, commands
+  and output writes all carry the old epoch and are dropped/fenced.
+- Commands are applied only when their epoch matches; stale ones are
+  acked ``stale_epoch`` (``cluster_stale_command``).
+- SIGTERM (``launch.PreemptionGuard``) enters the same drain as a
+  ``drain`` command: publish finished outputs, hand off / checkpoint
+  every live request's KV to the evacuation queue, assert all blocks
+  reclaimed, deregister — pages are never stranded.
+
+Fault sites (docs/RESILIENCE.md "Cluster sites"): ``cluster.register``
+and ``cluster.lease`` fire inside the retried store transactions;
+``cluster.command`` fires before a command applies and requeues it for
+the next loop (commands are idempotent per epoch).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import importlib.util
+import json
+import os
+import socket
+import time
+from typing import Callable, List, Optional
+
+from .. import observability as obs
+from ..launch.preempt import PreemptionGuard
+from ..resilience import _state as _rs_state
+from ..resilience.retry import RetryPolicy
+from .cluster import (LeaseLost, StoreQueue, admission_of,
+                      admit_admission)
+from .disagg import KVHandout, StoreTransport
+from .errors import AdmissionError
+
+__all__ = ["ServingWorker", "main"]
+
+
+class ServingWorker:
+    """Drives one Engine against the cluster store.
+
+    Drivable two ways: :meth:`run` is the process loop (subprocess
+    workers, with ``PreemptionGuard`` drain on SIGTERM), :meth:`step`
+    is one loop iteration (in-process tests interleave worker steps
+    with controller pumps deterministically — no threads, no sleeps).
+
+    ``param_source`` (optional ``callable(version) -> params``) is the
+    rolling-upgrade hook: the default ``None`` keeps the current params
+    (an upgrade is then provably output-identical); production passes a
+    checkpoint loader."""
+
+    def __init__(self, engine, store, *, worker_id: Optional[str] = None,
+                 prefix: str = "cluster",
+                 lease_deadline_s: float = 10.0,
+                 lease_interval_s: Optional[float] = None,
+                 status_interval_s: float = 0.2,
+                 steps_per_poll: int = 4,
+                 clock=time.time, retry: Optional[RetryPolicy] = None,
+                 transport=None,
+                 slo_ttft_p95_ms: Optional[float] = None,
+                 param_source: Optional[Callable] = None,
+                 version: str = "v0"):
+        self.engine = engine
+        self.store = store
+        self.worker_id = worker_id or \
+            f"{socket.gethostname()}-{os.getpid()}"
+        self.prefix = prefix.rstrip("/")
+        self.role = engine.role
+        self.clock = clock
+        self.retry = retry if retry is not None else RetryPolicy()
+        self.transport = transport if transport is not None else \
+            StoreTransport(store, prefix=f"{self.prefix}/xfer")
+        self.lease_deadline_s = float(lease_deadline_s)
+        self.lease_interval_s = float(lease_deadline_s) / 3.0 \
+            if lease_interval_s is None else float(lease_interval_s)
+        self.status_interval_s = float(status_interval_s)
+        self.steps_per_poll = max(1, int(steps_per_poll))
+        self.slo_ttft_p95_ms = slo_ttft_p95_ms
+        self.param_source = param_source
+        self.version = version
+        self.epoch: Optional[int] = None
+        self.lease_losses = 0
+        self.stale_commands = 0
+        self._lease_val: Optional[bytes] = None
+        self._last_renew = 0.0
+        self._last_status = 0.0
+        self._stopping = False
+        self._published = set()
+        self._pending_cmds: List[dict] = []
+        self._xfer_seq = 0
+        self._adm_q = self._hoff_q = self._cmd_q = None
+        self._rid_seen = set()       # for the exit report's trace audit
+
+    # -- store keys --------------------------------------------------------
+
+    @property
+    def _rec_key(self) -> str:
+        return f"{self.prefix}/workers/{self.worker_id}"
+
+    @property
+    def _lease_key(self) -> str:
+        return f"{self.prefix}/lease/{self.worker_id}"
+
+    def _xfer_key(self, rid: str) -> str:
+        self._xfer_seq += 1
+        return f"{rid}/{self.worker_id}/{self._xfer_seq}"
+
+    # -- membership / lease ------------------------------------------------
+
+    def register(self) -> int:
+        """Join (or rejoin) the cluster under a fresh epoch: allocate
+        the epoch, write the worker record and the first lease value.
+        Retried as one idempotent transaction (a half-applied attempt
+        is simply overwritten by the retry's fresh epoch); the
+        ``cluster.register`` fault site fires per attempt."""
+        def attempt():
+            fi = _rs_state.FAULTS[0]
+            if fi is not None:
+                fi("cluster.register")
+            epoch = self.store.add(f"{self.prefix}/epoch", 1)
+            lease = json.dumps(
+                {"epoch": epoch, "t": self.clock()}).encode()
+            rec = {"worker": self.worker_id, "role": self.role,
+                   "epoch": epoch, "pid": os.getpid(), "state": "up",
+                   "version": self.version}
+            self.store.set(self._rec_key, json.dumps(rec).encode())
+            self.store.set(self._lease_key, lease)
+            return epoch, lease
+
+        self.epoch, self._lease_val = self.retry.run(
+            attempt, site="cluster.register")
+        self._last_renew = self.clock()
+        # queue cursors survive a re-register on purpose: items stamped
+        # with the old epoch are consumed and dropped as stale, which
+        # self-cleans the queues after a flip or rejoin
+        if self._adm_q is None:
+            base = f"{self.prefix}/q"
+            self._adm_q = StoreQueue(self.store,
+                                     f"{base}/adm/{self.worker_id}")
+            self._hoff_q = StoreQueue(self.store,
+                                      f"{base}/hoff/{self.worker_id}")
+            self._cmd_q = StoreQueue(self.store,
+                                     f"{base}/cmd/{self.worker_id}")
+        obs.emit_event("cluster_register", worker=self.worker_id,
+                       role=self.role, epoch=self.epoch,
+                       version=self.version)
+        reg = obs.get_registry()
+        if reg is not None:
+            reg.counter("cluster.registers").inc()
+        return self.epoch
+
+    def renew_lease(self) -> None:
+        """CAS-chain the lease: expected value is OUR previous write,
+        so the controller's revocation tombstone (or any other writer)
+        breaks the chain and raises :class:`LeaseLost`.  Transient
+        failures retry under the policy (``cluster.lease`` site);
+        exhaustion is ALSO a lost lease — the worker cannot know how
+        long it was dark, so it must stop acting on the epoch."""
+        def attempt():
+            fi = _rs_state.FAULTS[0]
+            if fi is not None:
+                fi("cluster.lease")
+            new = json.dumps(
+                {"epoch": self.epoch, "t": self.clock()}).encode()
+            if not self.store.compare_set(self._lease_key,
+                                          self._lease_val, new):
+                raise LeaseLost(
+                    f"worker {self.worker_id!r} lost lease for epoch "
+                    f"{self.epoch} (revoked or superseded)")
+            return new
+
+        try:
+            self._lease_val = self.retry.run(attempt,
+                                             site="cluster.lease")
+        except LeaseLost:
+            raise
+        except Exception as e:  # noqa: BLE001 — retries exhausted
+            raise LeaseLost(
+                f"worker {self.worker_id!r} lease renew exhausted "
+                f"retries ({type(e).__name__}: {e})") from e
+        self._last_renew = self.clock()
+
+    def deregister(self, reason: str = "drain") -> None:
+        rec = {"worker": self.worker_id, "role": self.role,
+               "epoch": self.epoch, "pid": os.getpid(), "state": "left",
+               "version": self.version}
+        self.store.set(self._rec_key, json.dumps(rec).encode())
+        self.store.delete(self._lease_key)
+        obs.emit_event("cluster_deregister", worker=self.worker_id,
+                       epoch=self.epoch, reason=reason)
+
+    # -- status ------------------------------------------------------------
+
+    def publish_status(self) -> dict:
+        eng = self.engine
+        reg = obs.get_registry()
+        p95 = None
+        if reg is not None:
+            h = reg.get("serve.ttft_ms")
+            if h is not None and h.count:
+                p95 = h.percentile(95)
+        cap = getattr(eng, "_slo_capture", None)
+        captures = len(cap.captures) if cap is not None \
+            and hasattr(cap, "captures") else 0
+        breached = bool(captures) or (
+            p95 is not None and self.slo_ttft_p95_ms is not None
+            and p95 > self.slo_ttft_p95_ms)
+        status = {"t": self.clock(), "worker": self.worker_id,
+                  "role": self.role, "epoch": self.epoch,
+                  "queue_depth": eng.scheduler.queue_depth(),
+                  "active": len(eng.scheduler.active()),
+                  "free_blocks": eng.kv.allocator.free_blocks,
+                  "num_blocks": eng.kv.num_blocks,
+                  "handoffs": eng.handoffs,
+                  "published": len(self._published),
+                  "ttft_p95": p95, "slo_breached": breached,
+                  "slo_captures": captures}
+        self.store.set(f"{self.prefix}/status/{self.worker_id}",
+                       json.dumps(status).encode())
+        self._last_status = self.clock()
+        return status
+
+    # -- intake ------------------------------------------------------------
+
+    def poll_intake(self) -> int:
+        """Consume this worker's admission and handoff-ref queues.
+        Items stamped with a different epoch were re-routed by the
+        controller when the previous incarnation died — drop them.
+        Duplicate request ids (at-least-once re-routes) are skipped."""
+        taken = 0
+        for adm in self._adm_q.pop_all():
+            if adm.get("epoch") != self.epoch:
+                obs.emit_event("cluster_stale_item", kind="adm",
+                               worker=self.worker_id, id=adm.get("rid"),
+                               epoch=adm.get("epoch"))
+                continue
+            try:
+                admit_admission(self.engine, adm["adm"])
+                self._rid_seen.add(adm["rid"])
+                taken += 1
+            except AdmissionError:
+                continue            # already admitted: re-route overlap
+        for ref in self._hoff_q.pop_all():
+            if ref.get("epoch") != self.epoch:
+                obs.emit_event("cluster_stale_item", kind="hoff",
+                               worker=self.worker_id, id=ref.get("rid"),
+                               epoch=ref.get("epoch"))
+                continue
+            try:
+                raw = self.transport.get(ref["xfer"], delete=False)
+                self.engine.admit_handout(raw)
+                self._rid_seen.add(ref["rid"])
+                taken += 1
+            except AdmissionError:
+                continue
+            except Exception as e:  # noqa: BLE001 — hard transfer failure
+                # PR-12 degradation rule: the payload is unusable here,
+                # so hand the request back as a fresh re-prefill (greedy
+                # outputs stay token-identical)
+                obs.emit_event("cluster_transfer_failed",
+                               worker=self.worker_id, id=ref.get("rid"),
+                               exc=type(e).__name__)
+                evac = {"rid": ref["rid"], "xfer": None,
+                        "adm": ref.get("adm"), "from": self.worker_id}
+                StoreQueue(self.store,
+                           f"{self.prefix}/q/evac").push(evac)
+        return taken
+
+    # -- publish -----------------------------------------------------------
+
+    # the worker loop is the engine's only thread — sole ownership
+    # stands in for the lock on every annotated entry point below
+    # requires-lock: _lock — drains handed_off/_states
+    def publish_handoffs(self) -> int:
+        """Stream prefill-complete handoffs: pop the engine's parked
+        states, put the ``KVHandout`` payload on the transport, publish
+        a ref on the global handoff queue for the controller to route
+        to the decode tier.  A hard put failure degrades that request
+        to a fresh re-prefill via the evacuation queue."""
+        eng = self.engine
+        n = 0
+        while eng.handed_off:
+            st = eng.handed_off.popleft()
+            rid = st.request.request_id
+            eng._states.pop(rid, None)
+            ref = self._snapshot_ref(st)
+            q = "q/handoffs" if ref.get("xfer") else "q/evac"
+            StoreQueue(self.store, f"{self.prefix}/{q}").push(ref)
+            n += 1
+        return n
+
+    def _snapshot_ref(self, st) -> dict:
+        """Package one swapped state as a routable ref: transport
+        payload + admission fallback.  Falls back to admission-only
+        (fresh re-prefill) when the payload cannot be shipped."""
+        rid = st.request.request_id
+        adm = admission_of(st.request)
+        if st.swapped is not None and st.swapped[0]:
+            key = self._xfer_key(rid)
+            payload = None
+            try:
+                payload = KVHandout.from_state(st).to_bytes()
+                self.transport.put(key, payload)
+                return {"rid": rid, "xfer": key, "nbytes": len(payload),
+                        "pages": int(st.swapped[0]),
+                        "prefilling": bool(st.prefilling),
+                        "adm": adm, "from": self.worker_id}
+            except Exception as e:  # noqa: BLE001 — hard put failure
+                if payload is not None:
+                    self.transport.discard(key, len(payload))
+                obs.emit_event("cluster_snapshot_failed",
+                               worker=self.worker_id, id=rid,
+                               exc=type(e).__name__)
+        return {"rid": rid, "xfer": None, "adm": adm,
+                "from": self.worker_id}
+
+    # requires-lock: _lock — reads _states (sole-owner worker loop)
+    def publish_outputs(self) -> int:
+        """Write finished requests' output records (fenced by worker +
+        epoch — the controller only accepts the live assignment's
+        write)."""
+        eng = self.engine
+        n = 0
+        for rid, st in list(eng._states.items()):
+            if not st.finished or rid in self._published:
+                continue
+            out = {"tokens": [int(t) for t in st.output_ids],
+                   "reason": st.finish_reason,
+                   "worker": self.worker_id, "epoch": self.epoch,
+                   "tenant": st.request.tenant}
+            self.store.set(f"{self.prefix}/out/{rid}",
+                           json.dumps(out).encode())
+            self._published.add(rid)
+            n += 1
+        return n
+
+    # -- drain / evacuation ------------------------------------------------
+
+    # requires-lock: _lock — drains waiting/_states (sole-owner loop)
+    def drain(self, *, reason: str = "drain") -> int:
+        """Evacuate every live request and reclaim every KV block:
+        finished outputs publish, parked handoffs stream normally, every
+        slotted request preempts (KV pages to host), and each waiting
+        state ships as a transport snapshot (token-identical resume —
+        ``output_ids`` ride the handout) or, failing that, a fresh
+        re-prefill admission.  Post-condition: the allocator is fully
+        free and the scheduler empty — the invariant the graceful-
+        shutdown regression test pins."""
+        eng = self.engine
+        self.publish_outputs()
+        moved = self.publish_handoffs()
+        for st in [s for s in eng.scheduler.slots if s is not None]:
+            if st.finished:
+                continue
+            rid = st.request.request_id
+            try:
+                eng.preempt(rid, reason=reason)
+            except Exception:  # noqa: BLE001 — swap-out exhausted retries
+                # pages are unsalvageable: free the slot and fall back
+                # to a fresh re-prefill for this request
+                eng.scheduler.release_slot(st)
+                st.swapped = None
+                eng.scheduler.requeue(st)
+        snapshots = readmits = 0
+        while eng.scheduler.waiting:
+            st = eng.scheduler.waiting.popleft()
+            rid = st.request.request_id
+            eng._states.pop(rid, None)
+            if eng.lora is not None and st.request.adapter is not None:
+                eng.lora.release(st.request.adapter, rid)
+            ref = self._snapshot_ref(st)
+            StoreQueue(self.store, f"{self.prefix}/q/evac").push(ref)
+            if ref.get("xfer"):
+                snapshots += 1
+            else:
+                readmits += 1
+            moved += 1
+        obs.emit_event("cluster_evacuate", worker=self.worker_id,
+                       epoch=self.epoch, reason=reason, moved=moved,
+                       snapshots=snapshots, readmits=readmits,
+                       free_blocks=eng.kv.allocator.free_blocks,
+                       num_blocks=eng.kv.num_blocks)
+        reg = obs.get_registry()
+        if reg is not None and moved:
+            reg.counter("cluster.evacuated").inc(moved)
+        return moved
+
+    # requires-lock: _lock — clears waiting/_states (sole-owner loop)
+    def _abort_epoch(self) -> None:
+        """Lost lease: drop every live request WITHOUT publishing — the
+        controller already (or will) re-route them under the fence.
+        Blocks are reclaimed locally; nothing leaves this process."""
+        eng = self.engine
+        for st in [s for s in eng.scheduler.slots if s is not None]:
+            eng.scheduler.release_slot(st)
+        eng.scheduler.waiting.clear()
+        for rid, st in list(eng._states.items()):
+            if not st.finished:
+                if eng.lora is not None \
+                        and st.request.adapter is not None:
+                    eng.lora.release(st.request.adapter, rid)
+                del eng._states[rid]
+
+    # -- commands ----------------------------------------------------------
+
+    def _ack(self, cmd: dict, *, ok: bool, reason: str = "") -> None:
+        self.store.set(f"{self.prefix}/cmdack/{cmd.get('id')}",
+                       json.dumps({"ok": ok, "reason": reason,
+                                   "worker": self.worker_id}).encode())
+
+    def poll_commands(self) -> None:
+        cmds = self._pending_cmds + self._cmd_q.pop_all()
+        self._pending_cmds = []
+        for cmd in cmds:
+            if cmd.get("epoch") != self.epoch:
+                self.stale_commands += 1
+                obs.emit_event("cluster_stale_command",
+                               worker=self.worker_id, id=cmd.get("id"),
+                               kind=cmd.get("kind"),
+                               epoch=cmd.get("epoch"),
+                               current_epoch=self.epoch)
+                self._ack(cmd, ok=False, reason="stale_epoch")
+                continue
+            fi = _rs_state.FAULTS[0]
+            if fi is not None:
+                try:
+                    fi("cluster.command")
+                except Exception:  # noqa: BLE001 — injected/host fault
+                    # requeue: commands are idempotent per epoch, the
+                    # next loop iteration re-applies
+                    self._pending_cmds.append(cmd)
+                    continue
+            self.apply_command(cmd)
+            if self._stopping:
+                break
+
+    def apply_command(self, cmd: dict) -> None:
+        kind = cmd.get("kind")
+        t0 = self.clock()
+        if kind == "drain":
+            self.drain(reason="drain")
+            self.deregister("drain")
+            self._stopping = True
+        elif kind == "role_flip":
+            # ordering contract (tested): evacuate under the OLD role
+            # and epoch first, THEN flip the attribute and re-register —
+            # the compiled programs are role-independent, so the flip
+            # itself recompiles nothing
+            old = self.role
+            moved = self.drain(reason="role_flip")
+            self.engine.role = cmd["role"]
+            self.role = cmd["role"]
+            self.register()
+            obs.emit_event(
+                "cluster_role_flip", worker=self.worker_id,
+                role_from=old, role_to=self.role, epoch=self.epoch,
+                moved=moved, ms=(self.clock() - t0) * 1000.0)
+        elif kind == "rolling_upgrade":
+            moved = self.drain(reason="rolling_upgrade")
+            if self.param_source is not None:
+                self.engine.params = self.param_source(
+                    cmd.get("version"))
+            self.version = cmd.get("version", self.version)
+            self.register()
+            obs.emit_event(
+                "cluster_upgrade", worker=self.worker_id,
+                version=self.version, epoch=self.epoch, moved=moved,
+                ms=(self.clock() - t0) * 1000.0)
+        else:
+            self._ack(cmd, ok=False, reason=f"unknown kind {kind!r}")
+            return
+        self._ack(cmd, ok=True)
+
+    # -- the loop ----------------------------------------------------------
+
+    def step(self) -> bool:
+        """One worker loop iteration: renew the lease when due, apply
+        commands, take intake, run up to ``steps_per_poll`` engine
+        steps, publish handoffs/outputs/status.  Returns False once the
+        worker is stopping.  Raises :class:`LeaseLost` through to the
+        caller (``run`` converts it into abort + rejoin; in-process
+        tests assert on it directly)."""
+        if self._stopping:
+            return False
+        if self.clock() - self._last_renew >= self.lease_interval_s:
+            self.renew_lease()
+        self.poll_commands()
+        if self._stopping:
+            return False
+        self.poll_intake()
+        eng = self.engine
+        for _ in range(self.steps_per_poll):
+            if not eng.has_work():
+                break
+            eng.step()
+        self.publish_handoffs()
+        self.publish_outputs()
+        if self.clock() - self._last_status >= self.status_interval_s:
+            self.publish_status()
+        return True
+
+    def run(self, *, guard: Optional[PreemptionGuard] = None,
+            until: Optional[Callable[["ServingWorker"], bool]] = None,
+            idle_s: float = 0.005,
+            sleep: Callable[[float], None] = time.sleep) -> None:
+        """The process loop: warm up, register, serve until a drain
+        command, SIGTERM (graceful drain via ``guard``), or ``until``
+        returns True.  A lost lease aborts the epoch and rejoins."""
+        if not self.engine._warmed:
+            self.engine.warmup()
+        if self.epoch is None:
+            self.register()
+        while not self._stopping:
+            if guard is not None and guard.preempted:
+                self.drain(reason="sigterm")
+                self.deregister("sigterm")
+                self._stopping = True
+                break
+            try:
+                self.step()
+            except LeaseLost:
+                self.lease_losses += 1
+                obs.emit_event("cluster_lease_lost",
+                               worker=self.worker_id, epoch=self.epoch)
+                reg = obs.get_registry()
+                if reg is not None:
+                    reg.counter("cluster.lease_losses").inc()
+                self._abort_epoch()
+                self.register()
+                continue
+            if until is not None and until(self):
+                break
+            if not self.engine.has_work():
+                sleep(idle_s)
+
+    def report(self, *, compiles_baseline: int = 0) -> dict:
+        """The exit report the multiprocess tests and the CI gate
+        consume (one JSON line on stdout from :func:`main`)."""
+        eng = self.engine
+        tel = obs.get_telemetry()
+        compiles = tel.sentinel.compiles() if tel is not None else None
+        tr = obs.get_request_tracer()
+        incomplete = []
+        if tr is not None:
+            for rid in sorted(self._rid_seen | self._published):
+                t = tr.timeline(rid)
+                if t is not None and not t.get("done") \
+                        and rid not in self._published:
+                    # undone AND unpublished: fine only if it left this
+                    # worker through a handoff/evacuation
+                    incomplete.append(rid)
+        return {"worker": self.worker_id, "role": self.role,
+                "epoch": self.epoch, "version": self.version,
+                "compiles_after_warmup":
+                    None if compiles is None
+                    else compiles - compiles_baseline,
+                "free_blocks": eng.kv.allocator.free_blocks,
+                "num_blocks": eng.kv.num_blocks,
+                "published": sorted(self._published),
+                "handoffs": eng.handoffs,
+                "lease_losses": self.lease_losses,
+                "stale_commands": self.stale_commands,
+                "queue_holes": (self._adm_q.holes + self._hoff_q.holes
+                                + self._cmd_q.holes)
+                if self._adm_q is not None else 0,
+                "incomplete_timelines": incomplete,
+                "fired": [list(f) for f in getattr(
+                    _rs_state.FAULTS[0], "fired", [])]
+                if _rs_state.FAULTS[0] is not None else []}
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _load_factory(spec: str):
+    """``pkg.mod:callable`` or ``path/to/file.py:callable`` — the
+    engine factory receives the parsed argparse namespace and returns a
+    ready (ideally warmed) Engine."""
+    target, _, fn = spec.rpartition(":")
+    if not target or not fn:
+        raise ValueError(
+            f"--factory must be module:callable or file.py:callable, "
+            f"got {spec!r}")
+    if target.endswith(".py") or os.sep in target:
+        name = os.path.splitext(os.path.basename(target))[0]
+        loader = importlib.util.spec_from_file_location(name, target)
+        mod = importlib.util.module_from_spec(loader)
+        loader.loader.exec_module(mod)
+    else:
+        mod = importlib.import_module(target)
+    return getattr(mod, fn)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.serving.worker",
+        description="per-host cluster serving worker")
+    ap.add_argument("--store", required=True, help="TCPStore HOST:PORT")
+    ap.add_argument("--role", default="decode",
+                    choices=("prefill", "decode", "both"))
+    ap.add_argument("--factory", required=True,
+                    help="engine factory: module:callable or "
+                         "file.py:callable (receives the args "
+                         "namespace, returns an Engine)")
+    ap.add_argument("--worker-id", default=None)
+    ap.add_argument("--prefix", default="cluster")
+    ap.add_argument("--lease-deadline-s", type=float, default=10.0)
+    ap.add_argument("--status-interval-s", type=float, default=0.2)
+    ap.add_argument("--steps-per-poll", type=int, default=4)
+    ap.add_argument("--slo-ttft-p95-ms", type=float, default=None)
+    ap.add_argument("--version", default="v0")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="forwarded to the factory for model builds")
+    args = ap.parse_args(argv)
+
+    from ..launch.store import TCPStore
+    from ..resilience import install_faults_from_env
+
+    obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+    install_faults_from_env()
+    store = TCPStore(args.store, is_master=False,
+                     retry=RetryPolicy(max_attempts=5, backoff_s=0.05))
+    factory = _load_factory(args.factory)
+    engine = factory(args)
+    if engine.role != args.role:
+        engine.role = args.role
+    engine.warmup()
+    tel = obs.get_telemetry()
+    c0 = tel.sentinel.compiles() if tel is not None else 0
+    worker = ServingWorker(
+        engine, store, worker_id=args.worker_id, prefix=args.prefix,
+        lease_deadline_s=args.lease_deadline_s,
+        status_interval_s=args.status_interval_s,
+        steps_per_poll=args.steps_per_poll,
+        slo_ttft_p95_ms=args.slo_ttft_p95_ms, version=args.version)
+    guard = PreemptionGuard()
+    with guard:
+        worker.run(guard=guard)
+    print(json.dumps(worker.report(compiles_baseline=c0)), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -53,6 +53,23 @@ DIM = int(os.environ.get("PDTPU_TEST_DIM", "16"))
 HIDDEN = max(32, 2 * DIM)
 
 
+def make_serving_engine(args):
+    """Engine factory for the cluster serving worker CLI
+    (``python -m paddle_tpu.serving.worker --factory
+    tests/cluster_worker.py:make_serving_engine``): a tiny llama built
+    under ``--seed`` so every process — and the in-test reference —
+    holds identical weights."""
+    import paddle_tpu as pt
+    from paddle_tpu import serving
+
+    from paddle_tpu.models.llama import llama
+
+    pt.seed(args.seed)
+    model = llama("tiny")
+    return serving.Engine(model, max_batch=2, max_seq_len=64,
+                          page_size=8, prefill_chunk=8, role=args.role)
+
+
 def global_batch(step: int):
     g = np.random.default_rng(1000 + step)
     return {"x": g.standard_normal((GLOBAL_BATCH, DIM)).astype(np.float32),

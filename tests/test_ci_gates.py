@@ -130,6 +130,21 @@ def test_serving_disagg_gate():
     assert "decode-replica kill" in out
 
 
+@pytest.mark.slow
+def test_serving_cluster_gate():
+    """Cluster control plane (tools/ci.py gate_serving_cluster): 2
+    prefill + 2 decode ``serving.worker`` OS processes under
+    epoch-fenced leases survive a mid-churn SIGKILL (lease-expiry
+    evacuation), a forced role flip, and injected ``cluster.*`` faults
+    in every worker — greedy outputs token-identical to a colocated
+    run, zero compiles after warmup, all blocks reclaimed, zero lease
+    losses on the survivors (docs/SERVING.md "Cluster serving")."""
+    out = _run_gate("serving-cluster", timeout=1200)
+    assert "serving-cluster gate OK" in out
+    assert "token-identical to the colocated run" in out
+    assert "SIGKILL" in out and "role flip" in out
+
+
 def test_api_compat_rejects_foreign_module_leak(monkeypatch):
     """A leaked implementation import (jax/os/...) reachable as a public
     attribute hard-fails collect() (VERDICT r4 weak #1: the gate must

@@ -101,6 +101,82 @@ class TestTCPStore:
         finally:
             s.close()
 
+    def test_reconnect_with_backoff_after_socket_death(self):
+        """A bounced controller kills every client socket.  With a
+        ``retry`` policy configured, add/compare_set/keys/delete
+        transparently reconnect-and-retry (serving workers must cost a
+        controller restart one retry, not their lease)."""
+        from paddle_tpu.resilience.retry import RetryPolicy
+        master = TCPStore(f"127.0.0.1:{free_port()}", is_master=True)
+        client = TCPStore(master.endpoint,
+                          retry=RetryPolicy(max_attempts=4,
+                                            backoff_s=0.001))
+        try:
+            client.set("n", b"v")
+            for op in (lambda: client.add("ctr", 1),
+                       lambda: client.compare_set("c", b"", b"1"),
+                       lambda: client.keys(""),
+                       lambda: client.delete("n")):
+                dead = client._sock
+                dead.close()        # the restart: next send dies
+                op()                # reconnects under the policy
+                assert client._sock is not dead
+            assert client.get("c") == b"1"
+            assert client.add("ctr", 1) == 2
+            assert client.get("n") is None      # the delete applied
+        finally:
+            client.close()
+            master.close()
+
+    def test_no_retry_policy_still_surfaces_socket_death(self):
+        """Without a policy the store keeps its fail-fast contract —
+        the reconnect-with-backoff behaviour is strictly opt-in."""
+        master = TCPStore(f"127.0.0.1:{free_port()}", is_master=True)
+        client = TCPStore(master.endpoint)
+        try:
+            client._sock.close()
+            with pytest.raises(OSError):
+                client.add("ctr", 1)
+        finally:
+            client.close()
+            master.close()
+
+    def test_compare_set_ghost_write_is_idempotent(self):
+        """A CAS whose reply died with its socket may have applied
+        server-side; the retried attempt then sees expect-mismatch with
+        the key already holding OUR value.  That reads as success —
+        lease renewal chains CAS on the previous value, so a ghost
+        write must not drop the lease."""
+        s = TCPStore(f"127.0.0.1:{free_port()}", is_master=True)
+        try:
+            # server state after the ghost write: v1 -> v2 applied,
+            # reply lost; the client retries the same CAS
+            s.set("lease", b"v2")
+            assert s.compare_set("lease", b"v1", b"v2")
+            # a genuine conflict (someone ELSE's value) still fails
+            assert not s.compare_set("lease", b"v1", b"v3")
+        finally:
+            s.close()
+
+    def test_injected_store_faults_retried_under_policy(self):
+        """Chaos plans on ``store.set``/``store.get`` cover the cluster
+        write ops (add/delete/cas map to set; keys maps to get) and are
+        absorbed by the client retry policy."""
+        from paddle_tpu import resilience as rs
+        from paddle_tpu.resilience.retry import RetryPolicy
+        s = TCPStore(f"127.0.0.1:{free_port()}", is_master=True,
+                     retry=RetryPolicy(max_attempts=4, backoff_s=0.001))
+        inj = rs.install_faults(
+            "store.set@0x2:ConnectionError;store.get@0:ConnectionError")
+        try:
+            assert s.add("ctr", 1) == 1
+            assert s.keys("") == ["ctr"]
+            assert ("store.set", 0) in inj.fired
+            assert ("store.get", 0) in inj.fired
+        finally:
+            rs.clear_faults()
+            s.close()
+
     def test_barrier(self):
         s = TCPStore(f"127.0.0.1:{free_port()}", is_master=True)
         c = TCPStore(s.endpoint)

@@ -315,3 +315,176 @@ class TestElasticGrowResumeSharded:
         a = [final["losses"][str(i)] for i in steps]
         b = [single["losses"][str(i)] for i in steps]
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+class TestClusterServing:
+    """Cluster serving control plane across real OS processes: per-host
+    ``python -m paddle_tpu.serving.worker`` loops over a shared
+    TCPStore, an in-test ``ClusterController``, and the full failure
+    menu in one fleet lifetime — SIGKILL a decode worker mid-churn
+    (lease-expiry evacuation), SIGTERM a prefill worker (PreemptionGuard
+    graceful drain), then command-driven drain of the rest — with every
+    batch greedy token-identical to a colocated single-engine reference
+    and every worker's exit report showing zero compiles after warmup
+    and a fully reclaimed KV pool."""
+
+    ROLES = ("prefill", "prefill", "decode", "decode")
+
+    def _env(self):
+        cache = os.path.abspath(
+            os.path.join(REPO, ".pytest_cache", "xla_cache"))
+        env = {**os.environ,
+               "PDTPU_REPO": REPO,
+               "JAX_PLATFORMS": "cpu",
+               "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+               "JAX_COMPILATION_CACHE_DIR": cache,
+               "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
+               "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "-1",
+               "ALLOW_MULTIPLE_LIBTPU_LOAD": "1"}
+        env.pop("PDTPU_FAULTS", None)
+        return env
+
+    def _spawn(self, endpoint, wid, role, env):
+        return subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.serving.worker",
+             "--store", endpoint, "--role", role,
+             "--factory", WORKER + ":make_serving_engine",
+             "--worker-id", wid, "--lease-deadline-s", "6",
+             "--status-interval-s", "0.05", "--steps-per-poll", "2",
+             "--seed", "0"],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+
+    @staticmethod
+    def _assert_alive(procs, may_exit=()):
+        for wid, p in procs.items():
+            if wid not in may_exit and p.poll() is not None:
+                out, err = p.communicate(timeout=10)
+                raise AssertionError(
+                    f"{wid} died rc={p.returncode}\n{out}\n{err}")
+
+    def _pump_until(self, ctl, procs, rids, *, timeout_s, may_exit=()):
+        import time
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            ctl.pump()
+            if all(r in ctl.outputs for r in rids):
+                return
+            self._assert_alive(procs, may_exit)
+            time.sleep(0.01)
+        missing = [r for r in rids if r not in ctl.outputs]
+        raise AssertionError(f"undelivered after {timeout_s}s: {missing}")
+
+    @staticmethod
+    def _report(proc, *, timeout=90):
+        out, err = proc.communicate(timeout=timeout)
+        assert proc.returncode == 0, f"rc={proc.returncode}\n{out}\n{err}"
+        lines = [ln for ln in out.splitlines() if ln.strip()]
+        assert lines, f"no report on stdout\n{err}"
+        return json.loads(lines[-1])
+
+    def test_fleet_kill_sigterm_drain_token_identity(self, tmp_path):
+        import time
+
+        import paddle_tpu as pt
+        from paddle_tpu import serving
+        from paddle_tpu.launch.store import TCPStore
+        from paddle_tpu.models.llama import llama
+
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 256, size=n).astype(np.int32)
+                   for n in (5, 17, 9, 26)]
+        pt.seed(0)
+        ref_eng = serving.Engine(llama("tiny"), max_batch=2,
+                                 max_seq_len=64, page_size=8,
+                                 prefill_chunk=8).warmup()
+        ref_rids = [ref_eng.add_request(p, max_new_tokens=8)
+                    for p in prompts]
+        ref_outs = ref_eng.run()
+        ref = [ref_outs[r] for r in ref_rids]
+        ref_rids = [ref_eng.add_request(p, max_new_tokens=24)
+                    for p in prompts]
+        ref_outs = ref_eng.run()
+        ref24 = [ref_outs[r] for r in ref_rids]
+
+        env = self._env()
+        store = TCPStore(f"127.0.0.1:{free_port()}", is_master=True)
+        procs = {}
+        try:
+            for i, role in enumerate(self.ROLES):
+                wid = f"w{i}-{role}"
+                procs[wid] = self._spawn(store.endpoint, wid, role, env)
+            ctl = serving.ClusterController(store, lease_deadline_s=6.0)
+            deadline = time.time() + 300
+            while True:
+                self._assert_alive(procs)
+                try:
+                    ctl.wait_for_workers(4, timeout_s=2.0)
+                    break
+                except TimeoutError:
+                    if time.time() > deadline:
+                        raise
+
+            # phase 1: disagg fleet serves token-identically
+            rids = [ctl.submit(p, max_new_tokens=8) for p in prompts]
+            self._pump_until(ctl, procs, rids, timeout_s=180)
+            assert [ctl.outputs[r]["tokens"] for r in rids] == ref
+
+            # phase 2: SIGKILL a decode worker the moment it owns an
+            # uncollected assignment (waves of long decodes keep the
+            # tier busy — a fixed batch outruns the poll on this tiny
+            # model); lease-expiry evacuation re-delivers every wave
+            # token-identically
+            victim, rids = None, []
+            deadline = time.time() + 120
+            while victim is None and time.time() < deadline:
+                rids += [ctl.submit(p, max_new_tokens=24)
+                         for p in prompts]
+                wave_end = time.time() + 5
+                while victim is None and time.time() < wave_end:
+                    ctl.pump()
+                    for r in rids:
+                        a = ctl._assigned.get(r)
+                        if r not in ctl.outputs and a \
+                                and a["wid"].endswith("decode"):
+                            victim = a["wid"]
+                            break
+            assert victim, "no decode worker ever owned an assignment"
+            procs[victim].kill()
+            self._pump_until(ctl, procs, rids, timeout_s=180,
+                             may_exit=(victim,))
+            for i, r in enumerate(rids):
+                assert ctl.outputs[r]["tokens"] == ref24[i % len(ref24)]
+            assert ctl.members()[victim]["state"] == "dead"
+            survivor = {"w2-decode": "w3-decode",
+                        "w3-decode": "w2-decode"}[victim]
+
+            # phase 3: SIGTERM a prefill worker mid-batch — graceful
+            # drain hands off, deregisters, exits 0 with a clean report
+            rids = [ctl.submit(p, max_new_tokens=8) for p in prompts]
+            for _ in range(5):
+                ctl.pump()
+                time.sleep(0.01)
+            procs["w1-prefill"].send_signal(signal.SIGTERM)
+            self._pump_until(ctl, procs, rids, timeout_s=180,
+                             may_exit=(victim, "w1-prefill"))
+            assert [ctl.outputs[r]["tokens"] for r in rids] == ref
+            rep = self._report(procs["w1-prefill"])
+            assert rep["free_blocks"] == rep["num_blocks"]
+            assert rep["compiles_after_warmup"] == 0
+            assert ctl.members()["w1-prefill"]["state"] == "left"
+
+            # phase 4: command-driven drain of the survivors
+            for wid in ("w0-prefill", survivor):
+                ctl.drain_worker(wid)
+            for wid in ("w0-prefill", survivor):
+                rep = self._report(procs[wid])
+                assert rep["free_blocks"] == rep["num_blocks"]
+                assert rep["compiles_after_warmup"] == 0
+                assert rep["lease_losses"] == 0
+                assert ctl.members()[wid]["state"] == "left"
+        finally:
+            for p in procs.values():
+                if p.poll() is None:
+                    p.kill()
+            store.close()

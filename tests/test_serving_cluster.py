@@ -1,0 +1,824 @@
+"""Cluster control plane (paddle_tpu.serving.cluster + .worker).
+
+The load-bearing guarantees (docs/SERVING.md "Cluster serving"):
+
+- per-host ``ServingWorker`` loops register with the TCPStore under
+  epoch-fenced leases and step their local Engine independently; the
+  ``ClusterController`` owns routing/failure handling and never steps
+  an engine;
+- a dead worker (stale lease) is revoked and its in-flight requests
+  re-enter the queues from their last ``KVHandout`` snapshots —
+  token-identical where pages were already streamed, fresh re-prefill
+  otherwise;
+- a paused-then-resumed worker cannot act on stale ownership: its CAS
+  lease-renew raises ``LeaseLost``, its commands/queue items/output
+  writes carry the old epoch and are dropped or fenced;
+- elasticity transitions (``role_flip`` / ``drain`` /
+  ``rolling_upgrade``) ride the same evacuation machinery — zero
+  recompiles, greedy token-identity across flips, kills and upgrades.
+
+Control-plane unit tests run on fakes (no jax, no engine — fast);
+the end-to-end tests drive real engines and are marked ``slow``
+(the ``serving-cluster`` CI gate runs the cross-process version).
+"""
+
+import collections
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu import resilience as rs
+from paddle_tpu import serving
+from paddle_tpu.launch.preempt import PreemptionGuard
+from paddle_tpu.launch.store import TCPStore, free_port
+from paddle_tpu.serving.cluster import (ClusterController, LeaseLost,
+                                        LeaseMonitor, StoreQueue)
+from paddle_tpu.serving.worker import ServingWorker
+from paddle_tpu.resilience.retry import RetryPolicy
+
+R = np.random.default_rng(0)
+PROMPTS = [R.integers(0, 256, size=n).astype(np.int32)
+           for n in (5, 17, 9, 26)]
+
+
+@pytest.fixture
+def store():
+    s = TCPStore(f"127.0.0.1:{free_port()}", is_master=True)
+    yield s
+    s.close()
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    rs.clear_faults()
+    obs.disable()
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# -- control-plane fakes (no jax) -------------------------------------------
+
+class _FakeAllocator:
+    def __init__(self, n):
+        self.free_blocks = n
+
+
+class _FakeKV:
+    def __init__(self, n=8):
+        self.num_blocks = n
+        self.allocator = _FakeAllocator(n)
+
+
+class _FakeScheduler:
+    def __init__(self):
+        self.slots = []
+        self.waiting = collections.deque()
+
+    def queue_depth(self):
+        return len(self.waiting)
+
+    def active(self):
+        return []
+
+
+class _FakeEngine:
+    role = "decode"
+
+    def __init__(self):
+        self.scheduler = _FakeScheduler()
+        self.kv = _FakeKV()
+        self.handoffs = 0
+        self.handed_off = collections.deque()
+        self._states = {}
+        self.lora = None
+        self._warmed = True
+
+    def has_work(self):
+        return False
+
+    def step(self):
+        pass
+
+
+def _fake_worker(store, wid="w0", **kw):
+    kw.setdefault("retry", RetryPolicy(max_attempts=3, backoff_s=0.001))
+    kw.setdefault("status_interval_s", 0.0)
+    return ServingWorker(_FakeEngine(), store, worker_id=wid, **kw)
+
+
+# ---------------------------------------------------------------------------
+# StoreQueue
+# ---------------------------------------------------------------------------
+
+class TestStoreQueue:
+    def test_fifo_roundtrip_and_consumed_keys_deleted(self, store):
+        w = StoreQueue(store, "q/t")
+        r = StoreQueue(store, "q/t")
+        for i in range(3):
+            w.push({"i": i})
+        assert [x["i"] for x in r.pop_all()] == [0, 1, 2]
+        assert r.pop_all() == []
+        # consumed item keys are deleted; only the cursors remain
+        assert sorted(store.keys("q/t/")) == ["q/t/head", "q/t/tail"]
+
+    def test_reader_waits_for_inflight_push(self, store):
+        """Push is add-then-set: a reader racing between the two sees
+        the tail but not the item — it must wait, not skip."""
+        r = StoreQueue(store, "q/t")
+        store.add("q/t/tail", 1)            # add landed, set hasn't
+        assert r.pop_all() == []
+        store.set("q/t/0", json.dumps({"i": 0}).encode())
+        assert [x["i"] for x in r.pop_all()] == [0]
+        assert r.holes == 0
+
+    def test_permanent_hole_skipped_after_miss_limit(self, store):
+        """A retried ``add`` whose first reply died may allocate a seq
+        that is never written; the reader steps over it instead of
+        wedging the queue forever."""
+        w = StoreQueue(store, "q/t")
+        store.add("q/t/tail", 1)            # seq 0: the hole
+        w.push({"i": 1})                    # seq 1: real item
+        r = StoreQueue(store, "q/t")
+        got = []
+        for _ in range(StoreQueue.MISS_LIMIT + 1):
+            got += r.pop_all()
+        assert [x["i"] for x in got] == [1]
+        assert r.holes == 1
+
+    def test_restarted_reader_catches_up_past_consumed(self, store):
+        """A fresh reader (bounced process) starts at the smallest
+        surviving key — it neither replays consumed items nor grinds
+        through their deleted sequence numbers via the miss limit."""
+        w = StoreQueue(store, "q/t")
+        r1 = StoreQueue(store, "q/t")
+        for i in range(5):
+            w.push({"i": i})
+        assert len(r1.pop_all()) == 5
+        r2 = StoreQueue(store, "q/t")       # restart
+        w.push({"i": 99})
+        assert [x["i"] for x in r2.pop_all()] == [99]
+        assert r2.holes == 0
+
+
+# ---------------------------------------------------------------------------
+# LeaseMonitor
+# ---------------------------------------------------------------------------
+
+class TestLeaseMonitor:
+    def test_staleness_rules(self, store):
+        clock = _Clock(100.0)
+        mon = LeaseMonitor(store, prefix="cl/lease", deadline_s=5.0,
+                           clock=clock)
+        store.set("cl/lease/fresh",
+                  json.dumps({"epoch": 1, "t": 99.0}).encode())
+        store.set("cl/lease/old",
+                  json.dumps({"epoch": 1, "t": 10.0}).encode())
+        store.set("cl/lease/tomb", b"revoked:1")
+        # missing == not yet monitored; old/tombstone == dead
+        assert mon.stale_workers(["fresh", "old", "tomb", "absent"]) \
+            == ["old", "tomb"]
+
+    def test_monitor_is_a_heartbeat_monitor(self, store):
+        """The dynamic-membership monitor reuses the PR-12 indexed one:
+        same deadline semantics, same store, one implementation of the
+        liveness rules."""
+        mon = LeaseMonitor(store, deadline_s=3.0)
+        assert isinstance(mon, serving.HeartbeatMonitor)
+        assert mon.deadline_s == 3.0
+        assert mon.interval_s == 1.0        # deadline / 3, inherited
+
+
+# ---------------------------------------------------------------------------
+# worker control plane (fakes: register / lease / commands)
+# ---------------------------------------------------------------------------
+
+class TestWorkerLease:
+    def test_register_allocates_fresh_epochs(self, store):
+        w = _fake_worker(store)
+        e1 = w.register()
+        e2 = w.register()
+        assert e2 > e1
+        rec = json.loads(store.get(f"cluster/workers/{w.worker_id}"))
+        assert rec["state"] == "up" and rec["epoch"] == e2
+        lease = json.loads(store.get(f"cluster/lease/{w.worker_id}"))
+        assert lease["epoch"] == e2
+
+    def test_renew_chains_and_tombstone_is_lease_lost(self, store):
+        clock = _Clock()
+        w = _fake_worker(store, clock=clock)
+        w.register()
+        clock.t += 1.0
+        w.renew_lease()                     # CAS on our previous value
+        lease = json.loads(store.get(f"cluster/lease/{w.worker_id}"))
+        assert lease["t"] == clock.t
+        # the controller revokes: the worker's chain is broken
+        store.set(f"cluster/lease/{w.worker_id}", b"revoked:1")
+        with pytest.raises(LeaseLost):
+            w.renew_lease()
+
+    def test_renew_retry_exhaustion_is_lease_lost(self, store):
+        """A worker dark for longer than its retries cannot know whether
+        it was revoked — exhaustion must be treated as a lost lease."""
+        w = _fake_worker(store)
+        w.register()
+        rs.install_faults("cluster.lease@0x9:ConnectionError")
+        with pytest.raises(LeaseLost):
+            w.renew_lease()
+
+    def test_register_transient_fault_is_retried(self, store):
+        inj = rs.install_faults("cluster.register@0")
+        w = _fake_worker(store)
+        assert w.register() >= 1
+        assert ("cluster.register", 0) in inj.fired
+
+    def test_abort_epoch_reclaims_without_publishing(self, store):
+        w = _fake_worker(store)
+        w.register()
+
+        class _St:
+            finished = False
+            slot = None
+
+            class request:
+                adapter = None
+                request_id = "r1"
+        w.engine._states["r1"] = _St()
+        w._abort_epoch()
+        assert w.engine._states == {}
+        assert store.get("cluster/out/r1") is None
+
+
+class TestCommandFencing:
+    def _push_cmd(self, store, wid, cmd):
+        StoreQueue(store, f"cluster/q/cmd/{wid}").push(cmd)
+
+    def test_stale_epoch_command_rejected(self, store):
+        w = _fake_worker(store)
+        epoch = w.register()
+        self._push_cmd(store, w.worker_id,
+                       {"kind": "drain", "id": "c0", "epoch": epoch - 1})
+        w.poll_commands()
+        assert not w._stopping              # fenced, not applied
+        assert w.stale_commands == 1
+        ack = json.loads(store.get("cluster/cmdack/c0"))
+        assert ack == {"ok": False, "reason": "stale_epoch",
+                       "worker": w.worker_id}
+
+    def test_command_fault_requeues_then_applies(self, store):
+        """``cluster.command`` fires before the apply: the command is
+        requeued for the next loop (idempotent per epoch), never lost
+        and never half-applied."""
+        w = _fake_worker(store)
+        epoch = w.register()
+        self._push_cmd(store, w.worker_id,
+                       {"kind": "drain", "id": "c1", "epoch": epoch})
+        inj = rs.install_faults("cluster.command@0")
+        w.poll_commands()
+        assert not w._stopping and len(w._pending_cmds) == 1
+        assert ("cluster.command", 0) in inj.fired
+        w.poll_commands()                   # fault plan spent: applies
+        assert w._stopping
+        rec = json.loads(store.get(f"cluster/workers/{w.worker_id}"))
+        assert rec["state"] == "left"
+        assert json.loads(store.get("cluster/cmdack/c1"))["ok"] is True
+
+    def test_unknown_command_acked_not_fatal(self, store):
+        w = _fake_worker(store)
+        epoch = w.register()
+        self._push_cmd(store, w.worker_id,
+                       {"kind": "frobnicate", "id": "c2", "epoch": epoch})
+        w.poll_commands()
+        assert not w._stopping
+        ack = json.loads(store.get("cluster/cmdack/c2"))
+        assert ack["ok"] is False and "frobnicate" in ack["reason"]
+
+
+# ---------------------------------------------------------------------------
+# controller unit tests (records/statuses written directly — no engines)
+# ---------------------------------------------------------------------------
+
+def _seed_worker(store, wid, role, *, epoch=1, free_blocks=8,
+                 queue_depth=0, lease_t=None, slo_breached=False):
+    store.set(f"cluster/workers/{wid}", json.dumps(
+        {"worker": wid, "role": role, "epoch": epoch,
+         "state": "up", "version": "v0"}).encode())
+    store.set(f"cluster/status/{wid}", json.dumps(
+        {"worker": wid, "role": role, "epoch": epoch,
+         "queue_depth": queue_depth, "active": 0,
+         "free_blocks": free_blocks, "num_blocks": 8,
+         "slo_breached": slo_breached}).encode())
+    if lease_t is not None:
+        store.set(f"cluster/lease/{wid}", json.dumps(
+            {"epoch": epoch, "t": lease_t}).encode())
+
+
+class TestControllerRouting:
+    def test_admission_routes_to_shallowest_prefill_queue(self, store):
+        _seed_worker(store, "p0", "prefill", queue_depth=5)
+        _seed_worker(store, "p1", "prefill", queue_depth=1)
+        _seed_worker(store, "d0", "decode")
+        ctl = ClusterController(store)
+        rid = ctl.submit(PROMPTS[0], max_new_tokens=4)
+        ctl.pump()
+        items = StoreQueue(store, "cluster/q/adm/p1").pop_all()
+        assert [i["rid"] for i in items] == [rid]
+        assert items[0]["epoch"] == 1
+        assign = json.loads(store.get(f"cluster/assign/{rid}"))
+        assert assign["wid"] == "p1"
+
+    def test_handoff_ref_routes_to_most_free_decode(self, store):
+        _seed_worker(store, "p0", "prefill")
+        _seed_worker(store, "d0", "decode", free_blocks=2)
+        _seed_worker(store, "d1", "decode", free_blocks=7)
+        ctl = ClusterController(store)
+        StoreQueue(store, "cluster/q/handoffs").push(
+            {"rid": "r0", "xfer": "r0/p0/1", "nbytes": 64, "pages": 2,
+             "prefilling": False, "adm": {"rid": "r0", "prompt": [1],
+                                          "max_new_tokens": 2},
+             "from": "p0"})
+        ctl.pump()
+        items = StoreQueue(store, "cluster/q/hoff/d1").pop_all()
+        assert [i["rid"] for i in items] == ["r0"]
+
+    def test_mid_prefill_snapshot_resumes_on_prefill_tier(self, store):
+        _seed_worker(store, "p0", "prefill")
+        _seed_worker(store, "d0", "decode")
+        ctl = ClusterController(store)
+        StoreQueue(store, "cluster/q/evac").push(
+            {"rid": "r1", "xfer": "r1/p9/1", "nbytes": 64, "pages": 1,
+             "prefilling": True, "adm": {"rid": "r1", "prompt": [1],
+                                         "max_new_tokens": 2},
+             "from": "p9"})
+        ctl.pump()
+        assert StoreQueue(store, "cluster/q/hoff/p0").pop_all() != []
+
+    def test_unroutable_ref_pends_until_a_worker_joins(self, store):
+        ctl = ClusterController(store)
+        rid = ctl.submit(PROMPTS[0], max_new_tokens=4)
+        assert ctl.pump()["pending"] == 1
+        _seed_worker(store, "p0", "prefill")
+        assert ctl.pump()["pending"] == 0
+        assert [i["rid"] for i in
+                StoreQueue(store, "cluster/q/adm/p0").pop_all()] == [rid]
+
+
+class TestControllerFailureHandling:
+    def test_stale_lease_reaped_and_assignments_rerouted(self, store):
+        obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        clock = _Clock(100.0)
+        _seed_worker(store, "d0", "decode", lease_t=99.0)
+        _seed_worker(store, "d1", "decode", lease_t=99.0)
+        _seed_worker(store, "p0", "prefill", lease_t=99.0)
+        ctl = ClusterController(store, lease_deadline_s=5.0, clock=clock)
+        StoreQueue(store, "cluster/q/handoffs").push(
+            {"rid": "r0", "xfer": "r0/p0/1", "nbytes": 64, "pages": 2,
+             "prefilling": False, "adm": {"rid": "r0", "prompt": [1],
+                                          "max_new_tokens": 2},
+             "from": "p0"})
+        ctl.pump()
+        victim = json.loads(
+            store.get("cluster/assign/r0").decode())["wid"]
+        other = {"d0": "d1", "d1": "d0"}[victim]
+        # the victim stops renewing; the others stay fresh
+        clock.t = 110.0
+        for w in ("p0", other):
+            store.set(f"cluster/lease/{w}", json.dumps(
+                {"epoch": 1, "t": clock.t}).encode())
+        ctl.pump()
+        rec = json.loads(store.get(f"cluster/workers/{victim}"))
+        assert rec["state"] == "dead"
+        assert store.get(f"cluster/lease/{victim}") \
+            == f"revoked:1".encode()
+        # the ref moved, token-identically (same xfer payload key)
+        assign = json.loads(store.get("cluster/assign/r0"))
+        assert assign["wid"] == other
+        items = StoreQueue(store,
+                           f"cluster/q/hoff/{other}").pop_all()
+        assert [i["xfer"] for i in items] == ["r0/p0/1"]
+        sink = obs.get_telemetry().sinks[0]
+        assert [e["worker"] for e in sink.events("cluster_dead")] \
+            == [victim]
+
+    def test_stale_epoch_out_is_fenced(self, store):
+        obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        _seed_worker(store, "p0", "prefill", epoch=4)
+        ctl = ClusterController(store)
+        rid = ctl.submit(PROMPTS[0], max_new_tokens=4)
+        ctl.pump()
+        # a zombie write from a previous epoch: dropped, key cleared
+        store.set(f"cluster/out/{rid}", json.dumps(
+            {"tokens": [1, 2], "reason": "eos", "worker": "p0",
+             "epoch": 3}).encode())
+        ctl.pump()
+        assert rid not in ctl.outputs
+        assert store.get(f"cluster/out/{rid}") is None
+        sink = obs.get_telemetry().sinks[0]
+        assert sink.events("cluster_stale_out")
+        # the live epoch's write is collected
+        store.set(f"cluster/out/{rid}", json.dumps(
+            {"tokens": [1, 2, 3], "reason": "eos", "worker": "p0",
+             "epoch": 4}).encode())
+        ctl.pump()
+        assert ctl.outputs[rid]["tokens"] == [1, 2, 3]
+
+
+class TestAutoscale:
+    def test_starved_prefill_tier_flips_idlest_decode(self, store):
+        clock = _Clock(100.0)
+        for wid, role, q in (("p0", "prefill", 10), ("p1", "prefill", 8),
+                             ("d0", "decode", 2), ("d1", "decode", 0)):
+            _seed_worker(store, wid, role, queue_depth=q)
+        ctl = ClusterController(store, autoscale=True,
+                                flip_queue_ratio=2.0, min_tier=1,
+                                flip_cooldown_s=60.0, clock=clock)
+        ctl.pump()
+        items = StoreQueue(store, "cluster/q/cmd/d1").pop_all()
+        assert [i["kind"] for i in items] == ["role_flip"]
+        assert items[0]["role"] == "prefill"
+        # cooldown: no second flip within the window
+        ctl.pump()
+        assert StoreQueue(store, "cluster/q/cmd/d1").pop_all() == []
+        assert StoreQueue(store, "cluster/q/cmd/d0").pop_all() == []
+
+    def test_slo_breach_flips_even_without_queue_imbalance(self, store):
+        clock = _Clock(100.0)
+        _seed_worker(store, "p0", "prefill", queue_depth=2,
+                     slo_breached=True)
+        _seed_worker(store, "d0", "decode")
+        _seed_worker(store, "d1", "decode")
+        ctl = ClusterController(store, autoscale=True,
+                                flip_queue_ratio=100.0, min_tier=1,
+                                clock=clock)
+        ctl.pump()
+        flips = (StoreQueue(store, "cluster/q/cmd/d0").pop_all()
+                 + StoreQueue(store, "cluster/q/cmd/d1").pop_all())
+        assert [i["kind"] for i in flips] == ["role_flip"]
+
+    def test_min_tier_floor_blocks_flip(self, store):
+        _seed_worker(store, "p0", "prefill", queue_depth=50)
+        _seed_worker(store, "d0", "decode")
+        ctl = ClusterController(store, autoscale=True,
+                                flip_queue_ratio=2.0, min_tier=1)
+        ctl.pump()
+        assert StoreQueue(store, "cluster/q/cmd/d0").pop_all() == []
+
+
+class TestControllerRecovery:
+    def test_bounced_controller_rebuilds_assignments(self, store):
+        _seed_worker(store, "p0", "prefill", epoch=2)
+        ctl = ClusterController(store)
+        rid = ctl.submit(PROMPTS[0], max_new_tokens=4)
+        ctl.pump()
+        # a fresh controller over the same store sees the assignment
+        # and collects the (correct-epoch) out
+        ctl2 = ClusterController(store)
+        store.set(f"cluster/out/{rid}", json.dumps(
+            {"tokens": [7], "reason": "eos", "worker": "p0",
+             "epoch": 2}).encode())
+        ctl2.pump()
+        assert ctl2.outputs[rid]["tokens"] == [7]
+
+
+class TestTelemetryReport:
+    def test_cluster_events_fold_into_table_and_json(self, tmp_path,
+                                                     capsys):
+        """tools/telemetry_report.py folds cluster_* events: membership
+        churn, evacuations with requests moved, elasticity transitions
+        with their wall ms, and the epoch-fence drop counts."""
+        events = [
+            {"event": "cluster_register", "worker": "w0", "epoch": 1},
+            {"event": "cluster_register", "worker": "w0", "epoch": 2},
+            {"event": "cluster_route", "id": "r0", "worker": "w0",
+             "tier": "prefill", "xfer": False},
+            {"event": "cluster_dead", "worker": "w1",
+             "reason": "lease_expired"},
+            {"event": "cluster_evacuate", "worker": "w1", "moved": 3,
+             "by": "controller", "reason": "lease_expired"},
+            {"event": "cluster_command", "worker": "w0", "id": "c0",
+             "kind": "role_flip"},
+            {"event": "cluster_role_flip", "worker": "w0",
+             "role_from": "prefill", "role_to": "decode", "moved": 1,
+             "ms": 12.5},
+            {"event": "cluster_upgrade", "worker": "w0",
+             "version": "v1", "moved": 0, "ms": 8.0},
+            {"event": "cluster_lease_lost", "worker": "w1"},
+            {"event": "cluster_autoscale", "worker": "w0"},
+            {"event": "cluster_stale_command", "worker": "w1"},
+            {"event": "cluster_stale_out", "id": "r9"},
+            {"event": "cluster_transfer_failed", "id": "r3"},
+            {"event": "cluster_deregister", "worker": "w0"},
+        ]
+        path = tmp_path / "cluster.jsonl"
+        path.write_text(
+            "\n".join(json.dumps(e) for e in events) + "\n")
+        import sys
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        sys.path.insert(0, os.path.join(repo, "tools"))
+        import telemetry_report as tr
+        evs, malformed = tr.load_events([str(path)])
+        cl = tr.summarize(evs)["cluster"]
+        assert cl["registers"] == 2 and cl["deregisters"] == 1
+        assert cl["deaths"] == 1
+        assert cl["evacuations"] == 1 and cl["evacuated"] == 3
+        assert cl["role_flips"] == 1 and cl["flip_ms"] == [12.5]
+        assert cl["upgrades"] == 1 and cl["upgrade_ms"] == [8.0]
+        assert cl["lease_losses"] == 1 and cl["autoscales"] == 1
+        assert cl["transfer_failures"] == 1
+        assert cl["commands"] == {"role_flip": 1}
+        assert cl["stale"] == {"command": 1, "out": 1}
+        text = tr.render(tr.summarize(evs), malformed)
+        assert "Cluster control plane" in text
+        assert "role flips, ms p50 / p95 | 1 , 12.5 / 12.5" in text
+        assert "evacuations (requests moved) | 1 (3)" in text
+        # the one-line JSON summary carries the same fold
+        assert tr.main([str(path), "--json"]) == 0
+        summary = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert summary["cluster"]["deaths"] == 1
+        assert summary["cluster"]["flip_p95_ms"] == 12.5
+        assert summary["cluster"]["evacuated_requests"] == 3
+        assert summary["cluster"]["stale_drops"] == {"command": 1,
+                                                     "out": 1}
+
+
+# ---------------------------------------------------------------------------
+# end-to-end with real engines (slow; the CI gate runs these cross-process)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    from paddle_tpu.models.llama import llama
+    pt.seed(0)
+    return llama("tiny")
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("prefill_chunk", 8)
+    return serving.Engine(model, **kw)
+
+
+@pytest.fixture(scope="module")
+def reference(tiny_llama):
+    eng = _engine(tiny_llama).warmup()
+    rids = [eng.add_request(p, max_new_tokens=10) for p in PROMPTS]
+    outs = eng.run()
+    return [outs[r] for r in rids]
+
+
+def _spin_up(model, store, roles, *, clock=None, **wkw):
+    workers = []
+    for i, role in enumerate(roles):
+        eng = _engine(model, role=role).warmup()
+        kw = dict(status_interval_s=0.0, steps_per_poll=1)
+        if clock is not None:
+            kw["clock"] = clock
+        kw.update(wkw)
+        w = ServingWorker(eng, store, worker_id=f"w{i}-{role}", **kw)
+        w.register()
+        w.publish_status()
+        workers.append(w)
+    return workers
+
+
+def _drive(ctl, workers, rids, *, rounds=600, tick=None):
+    for _ in range(rounds):
+        for w in workers:
+            if not w._stopping:
+                w.step()
+        ctl.pump()
+        if tick is not None:
+            tick()
+        if all(r in ctl.outputs for r in rids):
+            return
+    raise AssertionError(
+        f"undelivered: {[r for r in rids if r not in ctl.outputs]}")
+
+
+def _blocks_clean(workers):
+    for w in workers:
+        alloc = w.engine.kv.allocator
+        assert alloc.free_blocks == w.engine.kv.num_blocks, w.worker_id
+
+
+@pytest.mark.slow
+class TestClusterServing:
+    def test_disagg_fleet_token_identity(self, tiny_llama, reference,
+                                         store):
+        """2 prefill + 2 decode workers over a real TCPStore serve the
+        prompt mix greedy token-identical to the colocated engine, with
+        every KV block reclaimed on every worker."""
+        ctl = ClusterController(store, lease_deadline_s=100.0)
+        workers = _spin_up(tiny_llama, store,
+                           ("prefill", "prefill", "decode", "decode"))
+        rids = [ctl.submit(p, max_new_tokens=10) for p in PROMPTS]
+        _drive(ctl, workers, rids)
+        assert [ctl.outputs[r]["tokens"] for r in rids] == reference
+        # handoffs actually crossed tiers (not all decoded locally)
+        assert all(ctl.outputs[r]["worker"].endswith("decode")
+                   for r in rids)
+        _blocks_clean(workers)
+
+    def test_kill_evacuation_token_identity(self, tiny_llama, reference,
+                                            store):
+        """A decode worker SIGKILLed mid-churn (modeled as: stops
+        stepping, lease ages out): its requests re-route from the
+        still-present transport payloads and finish token-identical on
+        the survivors; the controller marks it dead."""
+        clock = _Clock()
+        ctl = ClusterController(store, lease_deadline_s=5.0,
+                                clock=clock)
+        workers = _spin_up(tiny_llama, store,
+                           ("prefill", "prefill", "decode", "decode"),
+                           clock=clock)
+        victim = workers[2]
+        rids = [ctl.submit(p, max_new_tokens=10) for p in PROMPTS]
+        for _ in range(200):
+            ctl.pump()
+            for w in workers:
+                w.step()
+            clock.t += 0.1
+            if any(not s.finished
+                   for s in victim.engine._states.values()):
+                break
+        else:
+            raise AssertionError("victim never got live work")
+        survivors = [w for w in workers if w is not victim]
+        _drive(ctl, survivors, rids,
+               tick=lambda: setattr(clock, "t", clock.t + 0.5))
+        assert [ctl.outputs[r]["tokens"] for r in rids] == reference
+        assert ctl.members()[victim.worker_id]["state"] == "dead"
+        _blocks_clean(survivors)
+        # the paused-then-resumed victim is fenced out of its epoch
+        with pytest.raises(LeaseLost):
+            victim.renew_lease()
+
+    def test_sigterm_graceful_drain_completes_elsewhere(
+            self, tiny_llama, reference, store):
+        """Regression (worker graceful shutdown): SIGTERM enters the
+        PreemptionGuard drain — in-flight KV hands off to the
+        evacuation queue, every block is reclaimed, the lease
+        deregisters, and the requests complete on other workers."""
+        ctl = ClusterController(store, lease_deadline_s=100.0)
+        workers = _spin_up(tiny_llama, store,
+                           ("prefill", "decode", "decode"))
+        victim = workers[1]
+        rids = [ctl.submit(p, max_new_tokens=10) for p in PROMPTS]
+        for _ in range(200):
+            ctl.pump()
+            for w in workers:
+                w.step()
+            if any(not s.finished
+                   for s in victim.engine._states.values()):
+                break
+        else:
+            raise AssertionError("victim never got live work")
+        victim_rids = set(victim.engine._states)
+        guard = PreemptionGuard()
+        with guard:
+            os.kill(os.getpid(), signal.SIGTERM)
+            victim.run(guard=guard, sleep=lambda s: None)
+        assert victim._stopping
+        alloc = victim.engine.kv.allocator
+        assert alloc.free_blocks == victim.engine.kv.num_blocks
+        assert ctl.members()[victim.worker_id]["state"] == "left"
+        survivors = [w for w in workers if w is not victim]
+        _drive(ctl, survivors, rids)
+        assert [ctl.outputs[r]["tokens"] for r in rids] == reference
+        assert victim_rids        # the drain actually moved live work
+        for r in victim_rids & set(rids):
+            assert ctl.outputs[r]["worker"] != victim.worker_id
+        _blocks_clean(survivors)
+
+    def test_role_flip_drain_ordering_and_zero_recompiles(
+            self, tiny_llama, reference, store):
+        """A forced prefill→decode flip mid-churn: the worker evacuates
+        under its OLD role/epoch BEFORE re-registering under the new
+        one (event order pinned), outputs stay token-identical, and the
+        flip triggers zero recompiles — the compiled programs are
+        role-independent."""
+        obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        ctl = ClusterController(store, lease_deadline_s=100.0)
+        workers = _spin_up(tiny_llama, store,
+                           ("prefill", "prefill", "decode"))
+        rids = [ctl.submit(p, max_new_tokens=10) for p in PROMPTS]
+        for _ in range(3):
+            ctl.pump()
+            for w in workers:
+                w.step()
+        tel = obs.get_telemetry()
+        c0 = tel.sentinel.compiles()
+        flipped = workers[1]
+        old_epoch = flipped.epoch
+        cid = ctl.role_flip(flipped.worker_id, "decode")
+        _drive(ctl, workers, rids)
+        assert [ctl.outputs[r]["tokens"] for r in rids] == reference
+        assert tel.sentinel.compiles() == c0
+        assert ctl.command_ack(cid)["ok"] is True
+        assert flipped.role == "decode" \
+            and flipped.engine.role == "decode"
+        assert flipped.epoch > old_epoch
+        sink = tel.sinks[0]
+        evs = [e for e in sink.records
+               if e.get("worker") == flipped.worker_id
+               and e.get("event") in ("cluster_evacuate",
+                                      "cluster_register")]
+        flip_evac = [i for i, e in enumerate(evs)
+                     if e["event"] == "cluster_evacuate"
+                     and e.get("reason") == "role_flip"]
+        re_reg = [i for i, e in enumerate(evs)
+                  if e["event"] == "cluster_register"
+                  and e.get("epoch") == flipped.epoch]
+        assert flip_evac and re_reg and flip_evac[0] < re_reg[0]
+        _blocks_clean(workers)
+
+    def test_rolling_upgrade_token_identity(self, tiny_llama, reference,
+                                            store):
+        """drain → hot-swap params → rejoin under a new epoch, mid
+        churn; the default param_source keeps the params so the upgrade
+        is provably output-identical."""
+        ctl = ClusterController(store, lease_deadline_s=100.0)
+        workers = _spin_up(tiny_llama, store,
+                           ("prefill", "decode", "decode"))
+        upgraded = workers[2]
+        old_epoch = upgraded.epoch
+        rids = [ctl.submit(p, max_new_tokens=10) for p in PROMPTS]
+        for _ in range(3):
+            ctl.pump()
+            for w in workers:
+                w.step()
+        cid = ctl.rolling_upgrade(upgraded.worker_id, "v1")
+        _drive(ctl, workers, rids)
+        assert [ctl.outputs[r]["tokens"] for r in rids] == reference
+        assert ctl.command_ack(cid)["ok"] is True
+        assert upgraded.version == "v1"
+        assert upgraded.epoch > old_epoch
+        rec = ctl.members()[upgraded.worker_id]
+        assert rec["version"] == "v1" and rec["state"] == "up"
+        _blocks_clean(workers)
+
+    def test_lease_lost_worker_rejoins_fresh(self, tiny_llama,
+                                             reference, store):
+        """A paused worker whose lease was revoked aborts its epoch
+        (nothing published), rejoins fresh, and serves again — the
+        run-loop recovery path."""
+        clock = _Clock()
+        ctl = ClusterController(store, lease_deadline_s=5.0,
+                                clock=clock)
+        workers = _spin_up(tiny_llama, store, ("prefill", "decode"),
+                           clock=clock)
+        paused = workers[1]
+        rids = [ctl.submit(p, max_new_tokens=10) for p in PROMPTS]
+        for _ in range(200):
+            ctl.pump()
+            for w in workers:
+                w.step()
+            clock.t += 0.1
+            if any(not s.finished
+                   for s in paused.engine._states.values()):
+                break
+        else:
+            raise AssertionError("never got live work")
+        old_epoch = paused.epoch
+        # the pause: only the prefill worker keeps renewing
+        for _ in range(30):
+            workers[0].step()
+            ctl.pump()
+            clock.t += 0.5
+            if ctl.members()[paused.worker_id]["state"] == "dead":
+                break
+        # resume: the worker's next step loses the lease; mirror the
+        # run()-loop recovery (abort + re-register) and keep serving
+        with pytest.raises(LeaseLost):
+            for _ in range(20):
+                paused.step()
+                clock.t += 0.5
+        paused._abort_epoch()
+        alloc = paused.engine.kv.allocator
+        assert alloc.free_blocks == paused.engine.kv.num_blocks
+        paused.register()
+        assert paused.epoch > old_epoch
+        paused.publish_status()
+        _drive(ctl, workers, rids,
+               tick=lambda: setattr(clock, "t", clock.t + 0.1))
+        assert [ctl.outputs[r]["tokens"] for r in rids] == reference
+        # every collected out is from a live epoch (fence held)
+        for r in rids:
+            if ctl.outputs[r]["worker"] == paused.worker_id:
+                assert ctl.outputs[r]["epoch"] == paused.epoch

@@ -1817,6 +1817,233 @@ def gate_serving_disagg(max_batch: int = 4) -> int:
     return 0
 
 
+def gate_serving_cluster(n_prefill: int = 2, n_decode: int = 2) -> int:
+    """Serving-cluster gate: the control plane keeps every colocated
+    contract across real OS processes (docs/SERVING.md "Cluster
+    serving"):
+
+    2 prefill + 2 decode ``python -m paddle_tpu.serving.worker``
+    processes register with a real TCPStore under epoch-fenced leases,
+    with ``cluster.register``/``cluster.lease``/``cluster.command``
+    faults injected in EVERY worker via ``PDTPU_FAULTS`` (transient —
+    the worker's RetryPolicy and command-requeue absorb them without a
+    lease loss).  Mid-churn a decode worker is SIGKILLed the moment it
+    owns an uncollected assignment (lease-expiry evacuation) and a
+    prefill worker is force-``role_flip``ped to decode.  Demands:
+    every wave greedy TOKEN-IDENTICAL to a colocated engine, the flip
+    acked with the membership record showing the new role, and every
+    surviving worker's exit report showing 0 compiles after warmup,
+    every KV block reclaimed, 0 lease losses, and the injected faults
+    actually fired."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import time
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import serving
+    from paddle_tpu.launch.store import TCPStore, free_port
+    from paddle_tpu.models.llama import llama
+
+    failures = []
+    rng = np.random.default_rng(0)
+    lens = [5, 17, 9, 26]
+    prompts = [rng.integers(0, 256, size=n).astype(np.int32)
+               for n in lens]
+
+    def build_engine():
+        pt.seed(0)
+        return serving.Engine(llama("tiny"), max_batch=2,
+                              max_seq_len=64, page_size=8,
+                              prefill_chunk=8)
+
+    ref_eng = build_engine().warmup()
+    refs = {}
+    for budget in (8, 24):
+        rids = [ref_eng.add_request(p, max_new_tokens=budget)
+                for p in prompts]
+        outs = ref_eng.run()
+        refs[budget] = [outs[r] for r in rids]
+
+    cache = os.path.join(REPO, ".pytest_cache", "xla_cache")
+    env = {**os.environ,
+           "PDTPU_REPO": REPO,
+           "PYTHONPATH": REPO,
+           "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+           "JAX_COMPILATION_CACHE_DIR": cache,
+           "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
+           "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "-1",
+           "ALLOW_MULTIPLE_LIBTPU_LOAD": "1",
+           # transient control-plane faults in EVERY worker: a retried
+           # register, a retried lease renew, a requeued first command
+           "PDTPU_FAULTS": ("cluster.register@1;"
+                            "cluster.lease@1:ConnectionError;"
+                            "cluster.command@0")}
+    store = TCPStore(f"127.0.0.1:{free_port()}", is_master=True)
+    factory = os.path.join(REPO, "tests", "cluster_worker.py") \
+        + ":make_serving_engine"
+    roles = ["prefill"] * n_prefill + ["decode"] * n_decode
+    procs = {}
+    reports = {}
+    try:
+        for i, role in enumerate(roles):
+            wid = f"cw{i}-{role}"
+            procs[wid] = subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.serving.worker",
+                 "--store", store.endpoint, "--role", role,
+                 "--factory", factory, "--worker-id", wid,
+                 "--lease-deadline-s", "6",
+                 "--status-interval-s", "0.05",
+                 "--steps-per-poll", "2", "--seed", "0"],
+                env=env, cwd=REPO, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True)
+        ctl = serving.ClusterController(store, lease_deadline_s=6.0)
+
+        def alive_or_fail(may_exit=()):
+            for wid, p in procs.items():
+                if wid not in may_exit and p.poll() is not None:
+                    out, err = p.communicate(timeout=10)
+                    raise RuntimeError(
+                        f"{wid} died rc={p.returncode}\n{out}\n{err}")
+
+        deadline = time.time() + 300
+        while True:
+            alive_or_fail()
+            try:
+                ctl.wait_for_workers(len(roles), timeout_s=2.0)
+                break
+            except TimeoutError:
+                if time.time() > deadline:
+                    raise
+
+        def pump_until(rids, *, timeout_s=240.0, may_exit=()):
+            end = time.time() + timeout_s
+            while time.time() < end:
+                ctl.pump()
+                if all(r in ctl.outputs for r in rids):
+                    return
+                alive_or_fail(may_exit)
+                time.sleep(0.01)
+            missing = [r for r in rids if r not in ctl.outputs]
+            raise RuntimeError(f"undelivered: {missing}")
+
+        # wave 1: plain disagg churn across the fleet
+        w1 = [ctl.submit(p, max_new_tokens=8) for p in prompts]
+        pump_until(w1)
+        got = [ctl.outputs[r]["tokens"] for r in w1]
+        if got != refs[8]:
+            failures.append(
+                "wave-1 outputs diverged from the colocated run — "
+                "the fleet is not token-preserving")
+
+        # wave 2 under load: SIGKILL a decode worker that owns an
+        # uncollected assignment, and force-flip a prefill worker
+        victim, w2 = None, []
+        flipped = f"cw{n_prefill - 1}-prefill"
+        cid = ctl.role_flip(flipped, "decode")
+        end = time.time() + 120
+        while victim is None and time.time() < end:
+            w2 += [ctl.submit(p, max_new_tokens=24) for p in prompts]
+            wave_end = time.time() + 5
+            while victim is None and time.time() < wave_end:
+                ctl.pump()
+                for r in w2:
+                    a = ctl._assigned.get(r)
+                    if r not in ctl.outputs and a \
+                            and a["wid"].endswith("decode") \
+                            and a["wid"] != flipped:
+                        victim = a["wid"]
+                        break
+        if victim is None:
+            failures.append("no decode worker ever owned an "
+                            "assignment — nothing was killed")
+        else:
+            procs[victim].kill()
+            pump_until(w2, may_exit=(victim,))
+            for i, r in enumerate(w2):
+                if ctl.outputs[r]["tokens"] != refs[24][i % len(lens)]:
+                    failures.append(
+                        f"wave-2 request {r} diverged after the kill/"
+                        "flip — evacuation is not token-preserving")
+                    break
+            if ctl.members()[victim].get("state") != "dead":
+                failures.append(
+                    f"killed worker {victim} never marked dead")
+        ack = ctl.command_ack(cid)
+        if not ack or not ack.get("ok"):
+            failures.append(f"role_flip never acked ok ({ack})")
+        if ctl.members().get(flipped, {}).get("role") != "decode":
+            failures.append(
+                f"{flipped} membership record still shows "
+                f"{ctl.members().get(flipped, {}).get('role')!r} "
+                "after the flip")
+
+        # drain the survivors and audit their exit reports
+        for wid in procs:
+            if wid != victim:
+                ctl.drain_worker(wid)
+        for wid, p in procs.items():
+            if wid == victim:
+                continue
+            out, err = p.communicate(timeout=120)
+            if p.returncode != 0:
+                failures.append(f"{wid} exited rc={p.returncode}: {err}")
+                continue
+            lines = [ln for ln in out.splitlines() if ln.strip()]
+            reports[wid] = json.loads(lines[-1])
+        for wid, rep in reports.items():
+            if rep["compiles_after_warmup"] != 0:
+                failures.append(
+                    f"{wid}: {rep['compiles_after_warmup']} compile(s) "
+                    "after warmup — membership churn retraced something")
+            if rep["free_blocks"] != rep["num_blocks"]:
+                failures.append(
+                    f"{wid} holds {rep['num_blocks'] - rep['free_blocks']}"
+                    " KV block(s) at drain")
+            if rep["lease_losses"] != 0:
+                failures.append(
+                    f"{wid} lost its lease {rep['lease_losses']}x — the "
+                    "injected transients were not absorbed")
+            fired = {f[0] for f in rep["fired"]}
+            if "cluster.lease" not in fired \
+                    or "cluster.command" not in fired:
+                failures.append(
+                    f"{wid} fired only {sorted(fired)} — the cluster.* "
+                    "fault plans went unexercised")
+        flip_rep = reports.get(flipped)
+        if flip_rep and flip_rep["role"] != "decode":
+            failures.append(
+                f"{flipped} exit report still says {flip_rep['role']!r}")
+        if flip_rep and "cluster.register" not in \
+                {f[0] for f in flip_rep["fired"]}:
+            failures.append(
+                f"{flipped} re-register never hit cluster.register")
+
+        if not failures:
+            print(f"serving-cluster: {n_prefill} prefill + {n_decode} "
+                  f"decode worker processes survived a SIGKILL "
+                  f"({victim}), a forced role flip ({flipped}) and "
+                  f"injected cluster.* faults in every worker — all "
+                  f"{len(w1) + len(w2)} outputs token-identical to the "
+                  f"colocated run, 0 compiles after warmup, all blocks "
+                  f"reclaimed, 0 lease losses on the survivors")
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        store.close()
+
+    if failures:
+        print("serving-cluster gate FAILED (docs/SERVING.md "
+              "\"Cluster serving\"):")
+        for f_ in failures:
+            print(f"  {f_}")
+        return 1
+    print("serving-cluster gate OK")
+    return 0
+
+
 def gate_lint(timeout_s: float = 120.0) -> int:
     """Lint gate: pdtpu-lint runs clean over the whole tree with NO jax
     import (subprocess, bare env — the analyzer must work on a jax-less
@@ -1853,6 +2080,7 @@ GATES = {
     "chaos-serving": gate_chaos_serving,
     "serving-dist": gate_serving_dist,
     "serving-disagg": gate_serving_disagg,
+    "serving-cluster": gate_serving_cluster,
 }
 
 

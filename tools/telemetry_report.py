@@ -160,6 +160,17 @@ def summarize(events):
         # failures/requeues from serve_replica_fail
         "replicas": defaultdict(lambda: {"routed": 0, "affinity": 0,
                                          "failures": 0, "requeued": 0}),
+        # cluster control plane (docs/SERVING.md "Cluster serving"):
+        # membership churn, evacuations (requests moved), elasticity
+        # transitions with their wall ms, and the epoch-fence drops
+        "cluster": {"registers": 0, "deregisters": 0, "deaths": 0,
+                    "evacuations": 0, "evacuated": 0,
+                    "commands": defaultdict(int), "routes": 0,
+                    "role_flips": 0, "flip_ms": [],
+                    "upgrades": 0, "upgrade_ms": [],
+                    "lease_losses": 0, "autoscales": 0,
+                    "transfer_failures": 0,
+                    "stale": defaultdict(int)},
         # request-lifecycle traces (docs/OBSERVABILITY.md "Tracing a
         # request"): one serve_trace event per retired request carries
         # the exact per-phase breakdown queue/prefill/decode
@@ -279,6 +290,39 @@ def summarize(events):
             sv["finished"][e.get("reason") or "?"] += 1
             if e.get("ms") is not None:
                 sv["req_ms"].append(e["ms"])
+        elif kind == "cluster_register":
+            agg["cluster"]["registers"] += 1
+        elif kind == "cluster_deregister":
+            agg["cluster"]["deregisters"] += 1
+        elif kind == "cluster_dead":
+            agg["cluster"]["deaths"] += 1
+        elif kind == "cluster_evacuate":
+            cl = agg["cluster"]
+            cl["evacuations"] += 1
+            cl["evacuated"] += e.get("moved") or 0
+        elif kind == "cluster_command":
+            agg["cluster"]["commands"][e.get("kind") or "?"] += 1
+        elif kind == "cluster_route":
+            agg["cluster"]["routes"] += 1
+        elif kind == "cluster_role_flip":
+            cl = agg["cluster"]
+            cl["role_flips"] += 1
+            if e.get("ms") is not None:
+                cl["flip_ms"].append(e["ms"])
+        elif kind == "cluster_upgrade":
+            cl = agg["cluster"]
+            cl["upgrades"] += 1
+            if e.get("ms") is not None:
+                cl["upgrade_ms"].append(e["ms"])
+        elif kind == "cluster_lease_lost":
+            agg["cluster"]["lease_losses"] += 1
+        elif kind == "cluster_autoscale":
+            agg["cluster"]["autoscales"] += 1
+        elif kind == "cluster_transfer_failed":
+            agg["cluster"]["transfer_failures"] += 1
+        elif kind in ("cluster_stale_command", "cluster_stale_item",
+                      "cluster_stale_out"):
+            agg["cluster"]["stale"][kind[len("cluster_stale_"):]] += 1
         elif kind == "recompile_storm":
             agg["storms"].append(e)
         elif kind == "preemption":
@@ -612,6 +656,39 @@ def render(agg, malformed=0):
                 f"| {rep} | {rp['routed']} | {rp['affinity']} "
                 f"| {rp['failures']} | {rp['requeued']} | {free} |")
         lines.append("")
+    cl = agg["cluster"]
+    if cl["registers"] or cl["routes"] or cl["deaths"]:
+        # cluster control plane (docs/SERVING.md "Cluster serving"):
+        # membership churn + elasticity transitions with their cost
+        def fmt_ms(vals):
+            if not vals:
+                return "—"
+            v = sorted(vals)
+            return f"{_pct(v, 50):.1f} / {_pct(v, 95):.1f}"
+        lines += ["| Cluster control plane | |", "|---|---|",
+                  f"| registers / deregisters | {cl['registers']} / "
+                  f"{cl['deregisters']} |",
+                  f"| routes | {cl['routes']} |",
+                  f"| deaths (lease expiry) | {cl['deaths']} |",
+                  f"| evacuations (requests moved) | "
+                  f"{cl['evacuations']} ({cl['evacuated']}) |",
+                  f"| role flips, ms p50 / p95 | {cl['role_flips']} , "
+                  f"{fmt_ms(cl['flip_ms'])} |",
+                  f"| rolling upgrades, ms p50 / p95 | "
+                  f"{cl['upgrades']} , {fmt_ms(cl['upgrade_ms'])} |",
+                  f"| lease losses | {cl['lease_losses']} |",
+                  f"| autoscale flips | {cl['autoscales']} |",
+                  f"| hard transfer failures (re-prefilled) | "
+                  f"{cl['transfer_failures']} |"]
+        if cl["commands"]:
+            cmds = ", ".join(f"{k}: {n}" for k, n in
+                             sorted(cl["commands"].items()))
+            lines.append(f"| commands (by kind) | {cmds} |")
+        if cl["stale"]:
+            stale = ", ".join(f"{k}: {n}" for k, n in
+                              sorted(cl["stale"].items()))
+            lines.append(f"| epoch-fence drops (by kind) | {stale} |")
+        lines.append("")
     for r in agg["resumes"]:
         lines.append(f"**RESUME**: step {r.get('step')} from "
                      f"`{r.get('ckpt')}` (restart {r.get('restarts')})")
@@ -758,6 +835,26 @@ def main(argv=None) -> int:
         summary["replicas"] = {
             str(rep): dict(rp)
             for rep, rp in sorted(agg["replicas"].items(), key=str)}
+    cl = agg["cluster"]
+    if cl["registers"] or cl["routes"] or cl["deaths"]:
+        summary["cluster"] = {
+            "registers": cl["registers"],
+            "deregisters": cl["deregisters"],
+            "routes": cl["routes"],
+            "deaths": cl["deaths"],
+            "evacuations": cl["evacuations"],
+            "evacuated_requests": cl["evacuated"],
+            "role_flips": cl["role_flips"],
+            "flip_p50_ms": _pct(sorted(cl["flip_ms"]), 50),
+            "flip_p95_ms": _pct(sorted(cl["flip_ms"]), 95),
+            "upgrades": cl["upgrades"],
+            "upgrade_p50_ms": _pct(sorted(cl["upgrade_ms"]), 50),
+            "upgrade_p95_ms": _pct(sorted(cl["upgrade_ms"]), 95),
+            "lease_losses": cl["lease_losses"],
+            "autoscale_flips": cl["autoscales"],
+            "transfer_failures": cl["transfer_failures"],
+            "commands": dict(sorted(cl["commands"].items())),
+            "stale_drops": dict(sorted(cl["stale"].items()))}
     if agg["traces"]:
         summary["trace_phases"] = _phase_stats(agg["traces"])
         summary["trace_tenants"] = _tenant_stats(agg)
