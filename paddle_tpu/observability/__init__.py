@@ -43,6 +43,9 @@ import time
 from typing import List, Optional
 
 from . import _state
+from .aggregate import (FleetRegistry, HistogramSketch,  # noqa: F401
+                        fleet_fold, registry_to_wire,
+                        stitch_trace_segments)
 from .flight_recorder import (FlightRecorder, install_crash_hooks,  # noqa: F401
                               uninstall_crash_hooks, write_postmortem)
 from .flight_recorder import _reset_postmortem, configure_postmortem

@@ -25,6 +25,8 @@ import threading
 from collections import deque
 from typing import Dict, Optional, Union
 
+from .aggregate import HistogramSketch
+
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry"]
 
 
@@ -79,9 +81,17 @@ class Histogram:
     p50/p95 cover only the ring, so they track the *current* regime.
     Percentile convention: nearest-rank (``ceil(p/100 * n)``-th smallest),
     the same convention tools/telemetry_report.py applies offline.
+
+    ``sketch`` is the histogram's lifetime fleet-mergeable shadow
+    (:class:`~paddle_tpu.observability.aggregate.HistogramSketch`):
+    fixed log-spaced buckets a controller can merge across workers so
+    the fleet p95 is computed from merged counts, never from averaged
+    per-worker p95s.  The ring cannot serve that role — two rings merge
+    into neither worker's distribution.
     """
 
-    __slots__ = ("name", "_ring", "_count", "_sum", "_max", "_lock")
+    __slots__ = ("name", "_ring", "_count", "_sum", "_max", "_lock",
+                 "sketch")
 
     def __init__(self, name: str, window: int = 512):
         self.name = name
@@ -90,6 +100,7 @@ class Histogram:
         self._sum = 0.0
         self._max = None
         self._lock = threading.Lock()
+        self.sketch = HistogramSketch()
 
     def observe(self, v: float) -> None:
         v = float(v)
@@ -99,6 +110,7 @@ class Histogram:
             self._sum += v
             if self._max is None or v > self._max:
                 self._max = v
+        self.sketch.observe(v)
 
     @property
     def count(self) -> int:

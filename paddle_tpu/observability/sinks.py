@@ -69,7 +69,12 @@ def prom_split(name: str) -> Tuple[str, List[Tuple[str, str]]]:
     [("replica", "0")])``; the label key is the dotted component the
     bracket is attached to (``serve.tenant[acme].requests`` →
     ``tenant="acme"``, ``span[ckpt.save].ms`` → ``span="ckpt.save"``).
-    Unbracketed names pass through with no labels.
+    Bracket content containing ``=`` is the fleet-fold grammar
+    (``observability/aggregate.py``): explicit comma-separated label
+    pairs — ``serve.ttft_ms[worker=w0,role=decode]`` →
+    ``("serve_ttft_ms", [("worker", "w0"), ("role", "decode")])`` — so
+    per-worker series and the unlabelled fleet rollup share one prom
+    family.  Unbracketed names pass through with no labels.
     """
     labels: List[Tuple[str, str]] = []
     out: List[str] = []
@@ -85,8 +90,15 @@ def prom_split(name: str) -> Tuple[str, List[Tuple[str, str]]]:
             break
         head = rest[:i]
         out.append(head)
-        key = head.rsplit(".", 1)[-1]
-        labels.append((prom_name(key) or "label", rest[i + 1:j]))
+        content = rest[i + 1:j]
+        if "=" in content:
+            for part in content.split(","):
+                k, _, v = part.partition("=")
+                labels.append((prom_name(k.strip()) or "label",
+                               v.strip()))
+        else:
+            key = head.rsplit(".", 1)[-1]
+            labels.append((prom_name(key) or "label", content))
         rest = rest[j + 1:]
     base = "".join(out).strip(".")
     return prom_name(base), labels

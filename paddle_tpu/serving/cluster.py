@@ -46,6 +46,16 @@ Store schema (all under ``<prefix>/``, default ``cluster/``)::
     lease/<wid>           JSON {epoch, t} — CAS-chained by the worker;
                           the controller revokes with a tombstone
     status/<wid>          JSON load/SLO snapshot (worker, ~1 Hz)
+    telemetry/<wid>       JSON mergeable registry snapshot (counters /
+                          gauges / histogram SKETCHES — the fleet
+                          ``/metrics`` fold; docs/OBSERVABILITY.md
+                          "Fleet observability")
+    trace/<rid>/<seg>     JSON per-worker ``serve_trace`` segment
+                          (worker/role/epoch/clock_offset envelope);
+                          the stitcher joins them cross-host
+    clock                 JSON {t} — controller wall clock, re-stamped
+                          every pump; workers estimate their skew from
+                          store round-trips against it
     q/adm/<wid>/…         per-worker admission queue   (StoreQueue)
     q/hoff/<wid>/…        per-worker handoff-ref queue (StoreQueue)
     q/cmd/<wid>/…         per-worker command queue     (StoreQueue)
@@ -63,13 +73,18 @@ CPU-only coordinator host.
 
 from __future__ import annotations
 
+import collections
 import json
+import threading
 import time
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
 from .. import observability as obs
+from ..observability.aggregate import (fleet_fold, registry_to_wire,
+                                       stitch_trace_segments)
+from ..observability.sinks import registry_to_prometheus
 from .disagg import HeartbeatMonitor, StoreTransport
 
 __all__ = ["ClusterController", "LeaseMonitor", "LeaseLost", "StoreQueue"]
@@ -254,6 +269,11 @@ class ClusterController:
                  transport=None, autoscale: bool = False,
                  min_tier: int = 1, flip_queue_ratio: float = 4.0,
                  flip_cooldown_s: float = 5.0,
+                 status_stale_s: Optional[float] = None,
+                 straggler_factor: float = 3.0,
+                 straggler_windows: int = 3,
+                 straggler_min_ms: float = 1.0,
+                 trace_retention: int = 1024,
                  sleep: Callable[[float], None] = time.sleep):
         self.store = store
         self.prefix = prefix.rstrip("/")
@@ -267,6 +287,12 @@ class ClusterController:
         self.min_tier = int(min_tier)
         self.flip_queue_ratio = float(flip_queue_ratio)
         self.flip_cooldown_s = float(flip_cooldown_s)
+        self.status_stale_s = float(lease_deadline_s) \
+            if status_stale_s is None else float(status_stale_s)
+        self.straggler_factor = float(straggler_factor)
+        self.straggler_windows = max(1, int(straggler_windows))
+        self.straggler_min_ms = float(straggler_min_ms)
+        self.trace_retention = int(trace_retention)
         self._sleep = sleep
         self._handoff_q = StoreQueue(store, f"{self.prefix}/q/handoffs")
         self._evac_q = StoreQueue(store, f"{self.prefix}/q/evac")
@@ -280,7 +306,23 @@ class ClusterController:
         self._rid_seq = 0
         self._flip_ok_at = 0.0
         self._push_queues: Dict[str, StoreQueue] = {}
+        # fleet observability state (docs/OBSERVABILITY.md "Fleet
+        # observability"): status-demoted workers (unparsable/stale
+        # snapshots — out of routing, still lease-monitored),
+        # straggler detection windows, per-(wid, epoch) recompile
+        # baselines, a bounded decision log for GET /v1/cluster, and
+        # the trace-record retention queue
+        self._status_demoted: set = set()
+        self._stragglers: set = set()
+        self._straggle_counts: Dict[tuple, int] = {}
+        self._compile_base: Dict[tuple, int] = {}
+        self._decisions: "collections.deque[dict]" = \
+            collections.deque(maxlen=64)
+        self._trace_rids: "collections.deque[str]" = collections.deque()
+        self._http = None
+        self._http_thread = None
         self._recover()
+        self._publish_clock()
 
     def _q(self, path: str) -> StoreQueue:
         q = self._push_queues.get(path)
@@ -381,15 +423,130 @@ class ClusterController:
                 if r.get("state") == "up"
                 and (role is None or r.get("role") in (role, "both"))]
 
+    def _routable(self, role: Optional[str] = None) -> List[str]:
+        """Routing candidates: live AND not status-demoted.  Demotion
+        only narrows routing — the lease monitor stays the authority on
+        death, so a worker with a healthy lease but a wedged status
+        publisher keeps its lease and rejoins routing on its next good
+        snapshot."""
+        return [w for w in self._live(role)
+                if w not in self._status_demoted]
+
+    def _demote_status(self, wid: str, why: str) -> None:
+        if wid in self._status_demoted:
+            return
+        self._status_demoted.add(wid)
+        reg = obs.get_registry()
+        if reg is not None:
+            reg.counter("cluster.status_demotions").inc()
+        obs.emit_event("cluster_status_demoted", worker=wid, reason=why)
+
     def _refresh_status(self) -> None:
+        """Pull every registered worker's status snapshot.  A snapshot
+        that is present but unparsable, or whose stamp is older than
+        ``status_stale_s``, is treated like a stale heartbeat: the
+        worker is DEMOTED from routing (plus one
+        ``cluster_status_demoted`` event), never silently kept as the
+        last good reading — routing on a frozen ``free_blocks`` is how
+        a wedged worker becomes a black hole.  A missing key means
+        not-yet-published (startup), same rule as the lease monitor's
+        never-registered case."""
+        now = self.clock()
         for wid in self._workers:
             raw = self.store.get(f"{self.prefix}/status/{wid}")
             if raw is None:
                 continue
             try:
-                self._status[wid] = json.loads(raw.decode())
-            except (ValueError, UnicodeDecodeError):
+                st = json.loads(raw.decode())
+                ts = float(st["t"])
+            except (KeyError, TypeError, ValueError,
+                    UnicodeDecodeError):
+                self._demote_status(wid, "unparsable")
                 continue
+            if now - ts > self.status_stale_s:
+                self._demote_status(wid, "stale")
+                continue
+            if wid in self._status_demoted:
+                self._status_demoted.discard(wid)
+                obs.emit_event("cluster_status_recovered", worker=wid)
+            self._status[wid] = st
+        if obs.get_telemetry() is not None:
+            self._scan_anomalies()
+
+    # -- fleet anomaly detection -------------------------------------------
+
+    def _scan_anomalies(self) -> None:
+        """Status-driven fleet anomaly pass (one falsy check upstream —
+        never runs with telemetry disabled).
+
+        Stragglers: a worker whose rolling ``ttft_p95``/``step_p95``
+        exceeds ``straggler_factor`` × the median of its TIER PEERS for
+        ``straggler_windows`` consecutive refreshes is flagged
+        (``cluster_straggler``) and counted as an SLO breach by
+        :meth:`_tier_breached`, feeding the autoscaler's flip
+        heuristic.  The median is over the OTHER workers so a 2-worker
+        tier can still convict (a worker can never be 3× a median its
+        own sample dominates).
+
+        Recompile escalation: any worker's recompile sentinel count
+        rising after its first status of the epoch (post-warmup by
+        construction — workers warm up before registering) raises
+        ``cluster_recompile_alert`` once per new compile observed."""
+        reg = obs.get_registry()
+        for wid, st in self._status.items():
+            c = st.get("compiles")
+            if c is None:
+                continue
+            key = (wid, st.get("epoch"))
+            base = self._compile_base.get(key)
+            if base is None:
+                self._compile_base[key] = c
+            elif c > base:
+                self._compile_base[key] = c
+                obs.emit_event("cluster_recompile_alert", worker=wid,
+                               epoch=st.get("epoch"), compiles=c,
+                               new=c - base)
+                if reg is not None:
+                    reg.counter("cluster.recompile_alerts").inc(c - base)
+        flagged: set = set()
+        for role in ("prefill", "decode"):
+            wids = [w for w in self._live(role) if w in self._status]
+            for metric in ("ttft_p95", "step_p95"):
+                vals = {}
+                for w in wids:
+                    v = self._status[w].get(metric)
+                    if isinstance(v, (int, float)):
+                        vals[w] = float(v)
+                if len(vals) < 2:
+                    continue
+                for w, v in vals.items():
+                    others = sorted(x for ww, x in vals.items()
+                                    if ww != w)
+                    med = others[len(others) // 2]
+                    bar = self.straggler_factor \
+                        * max(med, self.straggler_min_ms)
+                    key = (w, metric)
+                    if v > bar:
+                        n = self._straggle_counts.get(key, 0) + 1
+                        self._straggle_counts[key] = n
+                        if n >= self.straggler_windows:
+                            flagged.add(w)
+                    else:
+                        self._straggle_counts.pop(key, None)
+        for w in flagged - self._stragglers:
+            if reg is not None:
+                reg.counter("cluster.stragglers").inc()
+            obs.emit_event("cluster_straggler", worker=w,
+                           role=self._workers.get(w, {}).get("role"),
+                           ttft_p95=self._status.get(w, {})
+                           .get("ttft_p95"),
+                           step_p95=self._status.get(w, {})
+                           .get("step_p95"))
+            self._decisions.append(
+                {"t": self.clock(), "kind": "straggler", "worker": w})
+        for w in self._stragglers - flagged:
+            obs.emit_event("cluster_straggler_recovered", worker=w)
+        self._stragglers = flagged
 
     # -- routing -----------------------------------------------------------
 
@@ -397,8 +554,11 @@ class ClusterController:
         """Healthiest eligible worker: decode refs go to most free
         blocks (the disagg rule — a restore needs contiguous budget),
         admissions to the shallowest prefill queue.  Deterministic
-        (ties break on wid) so chaos runs replay."""
-        cands = self._live(tier)
+        (ties break on wid) so chaos runs replay.  Status-demoted
+        workers are excluded (:meth:`_routable`) — routing needs a
+        fresh load snapshot; falls back to the full live set when the
+        whole tier is demoted (a slow worker beats a dropped ref)."""
+        cands = self._routable(tier) or self._live(tier)
         if not cands:
             return None
 
@@ -519,6 +679,9 @@ class ClusterController:
             reg.counter("cluster.evacuated").inc(moved)
         obs.emit_event("cluster_evacuate", worker=wid, moved=moved,
                        by="controller", reason=reason)
+        self._decisions.append(
+            {"t": self.clock(), "kind": "evacuate", "worker": wid,
+             "reason": reason, "moved": moved})
         return moved
 
     # -- output collection -------------------------------------------------
@@ -553,7 +716,199 @@ class ClusterController:
                 except Exception:  # noqa: BLE001 — best-effort cleanup
                     pass
             got += 1
+            # trace retention: keep segments for the last
+            # ``trace_retention`` finished requests (GET /v1/requests),
+            # reap the oldest beyond that so trace/ keys stay bounded
+            self._trace_rids.append(rid)
+            while len(self._trace_rids) > self.trace_retention:
+                old = self._trace_rids.popleft()
+                for key in self.store.keys(
+                        f"{self.prefix}/trace/{old}/"):
+                    self.store.delete(key)
         return got
+
+    # -- fleet observability surface ---------------------------------------
+
+    def _publish_clock(self) -> None:
+        """Re-stamp ``<prefix>/clock`` with the controller's wall clock
+        (every pump).  Workers estimate their skew from store
+        round-trips against it (``ServingWorker._sync_clock``); the
+        stitcher subtracts that offset so cross-host segment starts
+        order correctly.  One falsy check — free when disabled."""
+        if obs.get_telemetry() is None:
+            return
+        self.store.set(f"{self.prefix}/clock",
+                       json.dumps({"t": self.clock()}).encode())
+
+    def fleet_registry(self):
+        """Fold every worker's published telemetry snapshot
+        (``telemetry/<wid>``) plus the controller's own registry
+        (pseudo-worker ``controller``) into one
+        :class:`~paddle_tpu.observability.aggregate.FleetRegistry`:
+        per-worker labelled series, per-role tier rollups, and
+        unlabelled fleet rollups whose p95s come from MERGED histogram
+        sketches — never from averaging per-worker p95s.  Snapshots are
+        fetched on demand (scrape time), not per pump, so an unscraped
+        controller pays nothing."""
+        snaps: Dict[str, dict] = {}
+        for wid in self.members():
+            raw = self.store.get(f"{self.prefix}/telemetry/{wid}")
+            if raw is None:
+                continue
+            try:
+                snap = json.loads(raw.decode())
+            except (ValueError, UnicodeDecodeError):
+                continue
+            if isinstance(snap.get("metrics"), dict):
+                snaps[wid] = snap
+        reg = obs.get_registry()
+        if reg is not None:
+            snaps["controller"] = {"role": "controller",
+                                   "metrics": registry_to_wire(reg)}
+        return fleet_fold(snaps)
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the fleet fold — the body of
+        ``GET /metrics``.  Controller-local bookkeeping gauges ride as
+        ``extra`` so the surface is never empty mid-startup."""
+        return registry_to_prometheus(
+            self.fleet_registry(),
+            extra={"cluster.live_workers": len(self._live()),
+                   "cluster.pending_refs": len(self._pending),
+                   "cluster.collected_outputs": len(self._outs)})
+
+    def cluster_view(self) -> dict:
+        """The ``GET /v1/cluster`` body: membership with lease/status
+        health, routing demotions, stragglers, and the recent decision
+        log (evacuations, autoscale flips)."""
+        self.members()
+        now = self.clock()
+        raw = self.store.get(f"{self.prefix}/epoch")
+        workers = {}
+        for wid, rec in self._workers.items():
+            lease = self.monitor.lease(wid)
+            workers[wid] = {
+                **rec,
+                "lease": lease,
+                "lease_age_s": (round(now - float(lease["t"]), 3)
+                                if lease and "t" in lease else None),
+                "status": self._status.get(wid),
+                "status_demoted": wid in self._status_demoted,
+                "straggler": wid in self._stragglers,
+            }
+        return {"t": now,
+                "epoch": int(raw) if raw else 0,
+                "workers": workers,
+                "autoscale": self.autoscale,
+                "assigned": len(self._assigned),
+                "outputs": len(self._outs),
+                "pending": len(self._pending),
+                "decisions": list(self._decisions)}
+
+    def trace_segments(self, rid: str) -> List[dict]:
+        """Every published per-worker trace segment for ``rid``
+        (unstitched, in store-key order)."""
+        segs = []
+        for key in sorted(self.store.keys(
+                f"{self.prefix}/trace/{rid}/")):
+            raw = self.store.get(key)
+            if raw is None:
+                continue
+            try:
+                segs.append(json.loads(raw.decode()))
+            except (ValueError, UnicodeDecodeError):
+                continue
+        return segs
+
+    def request_timeline(self, rid: str) -> Optional[dict]:
+        """The ``GET /v1/requests/<rid>`` body: ``rid``'s per-worker
+        segments federated from the store and stitched into one
+        cross-host timeline (skew-corrected ordering, inter-segment
+        gaps attributed to xfer — see
+        ``observability.aggregate.stitch_trace_segments``).  None when
+        no worker published a segment."""
+        return stitch_trace_segments(self.trace_segments(rid))
+
+    def serve_http(self, host: str = "127.0.0.1", port: int = 0):
+        """Start the controller's stdlib HTTP surface on a daemon
+        thread; returns the bound ``(host, port)``.
+
+        Endpoints (docs/OBSERVABILITY.md "Fleet observability"):
+
+        - ``GET /metrics``      Prometheus fleet fold (text 0.0.4)
+        - ``GET /v1/cluster``   membership / leases / decisions JSON
+        - ``GET /v1/requests/<rid>``  stitched cross-host timeline
+        - ``GET /healthz``      liveness probe
+        """
+        if self._http is not None:
+            return self._http.server_address
+        from http.server import BaseHTTPRequestHandler, \
+            ThreadingHTTPServer
+        ctl = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: N802 — stdlib name
+                pass
+
+            def _send(self, code, body, ctype):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802 — stdlib name
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        self._send(200, ctl.metrics_text(),
+                                   "text/plain; version=0.0.4")
+                    elif path == "/healthz":
+                        self._send(200, "ok\n", "text/plain")
+                    elif path == "/v1/cluster":
+                        self._send(200, json.dumps(ctl.cluster_view()),
+                                   "application/json")
+                    elif path.startswith("/v1/requests/"):
+                        rid = path[len("/v1/requests/"):]
+                        tl = ctl.request_timeline(rid)
+                        if tl is None:
+                            self._send(404, json.dumps(
+                                {"error": "no trace", "id": rid}),
+                                "application/json")
+                        else:
+                            self._send(200, json.dumps(tl),
+                                       "application/json")
+                    else:
+                        self._send(404, json.dumps(
+                            {"error": "not found", "path": path}),
+                            "application/json")
+                except Exception as e:  # noqa: BLE001 — surface, don't die
+                    try:
+                        self._send(500, json.dumps(
+                            {"error": repr(e)}), "application/json")
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        self._http = ThreadingHTTPServer((host, port), _Handler)
+        self._http.daemon_threads = True
+        self._http_thread = threading.Thread(
+            target=self._http.serve_forever, kwargs={"poll_interval": 0.1},
+            name="cluster-http", daemon=True)
+        self._http_thread.start()
+        obs.emit_event("cluster_http", host=self._http.server_address[0],
+                       port=self._http.server_address[1])
+        return self._http.server_address
+
+    def close_http(self) -> None:
+        if self._http is None:
+            return
+        self._http.shutdown()
+        self._http.server_close()
+        if self._http_thread is not None:
+            self._http_thread.join(timeout=5.0)
+        self._http = None
+        self._http_thread = None
 
     # -- elasticity --------------------------------------------------------
 
@@ -563,7 +918,10 @@ class ClusterController:
                    for w in wids)
 
     def _tier_breached(self, wids) -> bool:
+        # a convicted straggler counts as a breach: the anomaly scan
+        # feeds the same flip heuristic the SLOCapture breach does
         return any(self._status.get(w, {}).get("slo_breached")
+                   or w in self._stragglers
                    for w in wids)
 
     def _autoscale(self) -> Optional[str]:
@@ -598,6 +956,9 @@ class ClusterController:
         else:
             return None
         self._flip_ok_at = self.clock() + self.flip_cooldown_s
+        self._decisions.append(
+            {"t": self.clock(), "kind": "autoscale", "worker": wid,
+             "prefill_load": pre_load, "decode_load": dec_load})
         obs.emit_event("cluster_autoscale", worker=wid,
                        prefill_load=pre_load, decode_load=dec_load)
         return wid
@@ -608,6 +969,7 @@ class ClusterController:
         """One control round: refresh membership/status, route queued
         handoff + evacuation refs (and anything pending), reap stale
         leases into evacuation, collect fenced outputs, autoscale."""
+        self._publish_clock()
         self.members()
         self._refresh_status()
         routed = 0

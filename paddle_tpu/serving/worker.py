@@ -51,6 +51,7 @@ import time
 from typing import Callable, List, Optional
 
 from .. import observability as obs
+from ..observability.aggregate import registry_to_wire
 from ..launch.preempt import PreemptionGuard
 from ..resilience import _state as _rs_state
 from ..resilience.retry import RetryPolicy
@@ -116,6 +117,13 @@ class ServingWorker:
         self._xfer_seq = 0
         self._adm_q = self._hoff_q = self._cmd_q = None
         self._rid_seen = set()       # for the exit report's trace audit
+        # wall-clock offset vs the controller (local − controller),
+        # estimated from store round-trips against the controller's
+        # published clock key; rides every trace segment so the
+        # stitcher can put cross-host timelines on one timebase
+        self.clock_offset = 0.0
+        self.clock_rtt: Optional[float] = None
+        self._trace_seq = 0
 
     # -- store keys --------------------------------------------------------
 
@@ -173,7 +181,33 @@ class ServingWorker:
         reg = obs.get_registry()
         if reg is not None:
             reg.counter("cluster.registers").inc()
+        self._sync_clock()
         return self.epoch
+
+    def _sync_clock(self) -> None:
+        """Re-estimate ``clock_offset`` against the controller's
+        published ``clock`` key: read it between two local clock reads
+        and take the midpoint, so half the round-trip cancels.  The
+        residual error is bounded by RTT/2 plus the key's staleness
+        (the controller re-stamps it every pump).  Runs at registration
+        and after each successful lease renewal; one falsy check when
+        tracing is disabled — no store traffic, no attribute writes."""
+        if obs.get_request_tracer() is None:
+            return
+        try:
+            t0 = self.clock()
+            raw = self.store.get(f"{self.prefix}/clock")
+            t1 = self.clock()
+        except Exception:  # noqa: BLE001 — telemetry must not kill serving
+            return
+        if raw is None:
+            return                   # no controller clock published yet
+        try:
+            ctl_t = float(json.loads(raw.decode())["t"])
+        except (KeyError, TypeError, ValueError, UnicodeDecodeError):
+            return
+        self.clock_rtt = t1 - t0
+        self.clock_offset = (t0 + t1) / 2.0 - ctl_t
 
     def renew_lease(self) -> None:
         """CAS-chain the lease: expected value is OUR previous write,
@@ -205,6 +239,7 @@ class ServingWorker:
                 f"worker {self.worker_id!r} lease renew exhausted "
                 f"retries ({type(e).__name__}: {e})") from e
         self._last_renew = self.clock()
+        self._sync_clock()
 
     def deregister(self, reason: str = "drain") -> None:
         rec = {"worker": self.worker_id, "role": self.role,
@@ -220,11 +255,17 @@ class ServingWorker:
     def publish_status(self) -> dict:
         eng = self.engine
         reg = obs.get_registry()
-        p95 = None
+        p95 = step_p95 = None
         if reg is not None:
             h = reg.get("serve.ttft_ms")
             if h is not None and h.count:
                 p95 = h.percentile(95)
+            h = reg.get("serve.step_ms")
+            if h is not None and h.count:
+                step_p95 = h.percentile(95)
+        tel = obs.get_telemetry()
+        compiles = tel.sentinel.compiles() \
+            if tel is not None and tel.sentinel is not None else None
         cap = getattr(eng, "_slo_capture", None)
         captures = len(cap.captures) if cap is not None \
             and hasattr(cap, "captures") else 0
@@ -239,12 +280,61 @@ class ServingWorker:
                   "num_blocks": eng.kv.num_blocks,
                   "handoffs": eng.handoffs,
                   "published": len(self._published),
-                  "ttft_p95": p95, "slo_breached": breached,
+                  "ttft_p95": p95, "step_p95": step_p95,
+                  "compiles": compiles,
+                  "clock_offset": self.clock_offset,
+                  "slo_breached": breached,
                   "slo_captures": captures}
         self.store.set(f"{self.prefix}/status/{self.worker_id}",
                        json.dumps(status).encode())
         self._last_status = self.clock()
         return status
+
+    def publish_telemetry(self) -> bool:
+        """Ship this worker's mergeable registry snapshot (counters /
+        gauges / histogram SKETCHES — ``aggregate.registry_to_wire``)
+        to ``telemetry/<wid>`` at status cadence; the controller folds
+        the fleet's snapshots into per-worker-labelled series and
+        merged-sketch rollups for ``GET /metrics``.  One falsy check
+        when telemetry is disabled: no snapshot, no store write."""
+        reg = obs.get_registry()
+        if reg is None:
+            return False
+        snap = {"t": self.clock(), "worker": self.worker_id,
+                "role": self.role, "epoch": self.epoch,
+                "clock_offset": self.clock_offset,
+                "metrics": registry_to_wire(reg)}
+        self.store.set(f"{self.prefix}/telemetry/{self.worker_id}",
+                       json.dumps(snap).encode())
+        return True
+
+    def _publish_trace_segment(self, rid: str, *,
+                               close: Optional[str] = None) -> bool:
+        """Write this worker's segment of ``rid``'s lifecycle timeline
+        to ``trace/<rid>/<wid>:<epoch>:<seq>`` — the cross-host half of
+        request tracing.  ``close`` retires the local trace first
+        (handoff / evacuation: the request leaves this process
+        mid-flight, so the local segment must end at the same point the
+        payload ships); retired requests pass ``close=None`` and reuse
+        the engine's own retire.  The envelope carries worker / role /
+        epoch / ``clock_offset`` so the stitcher can order segments on
+        the controller's timebase.  One falsy check when tracing is
+        disabled."""
+        tr = obs.get_request_tracer()
+        if tr is None:
+            return False
+        if close is not None:
+            tr.retire(rid, reason=close)
+        t = tr.timeline(rid)
+        if t is None:
+            return False
+        self._trace_seq += 1
+        seg = dict(t, id=rid, worker=self.worker_id, role=self.role,
+                   epoch=self.epoch, clock_offset=self.clock_offset)
+        self.store.set(
+            f"{self.prefix}/trace/{rid}/{self.worker_id}:{self.epoch}:"
+            f"{self._trace_seq}", json.dumps(seg).encode())
+        return True
 
     # -- intake ------------------------------------------------------------
 
@@ -312,6 +402,11 @@ class ServingWorker:
             ref = self._snapshot_ref(st)
             q = "q/handoffs" if ref.get("xfer") else "q/evac"
             StoreQueue(self.store, f"{self.prefix}/{q}").push(ref)
+            # the request leaves this failure domain here: close the
+            # local timeline as a handoff SEGMENT (the decode worker
+            # opens the next one under the same trace id off the
+            # KVHandout) and publish it for the stitcher
+            self._publish_trace_segment(rid, close="handoff")
             n += 1
         return n
 
@@ -350,6 +445,10 @@ class ServingWorker:
         for rid, st in list(eng._states.items()):
             if not st.finished or rid in self._published:
                 continue
+            # segment BEFORE the output record: once the controller
+            # sees the out, the stitched timeline must already be
+            # readable (GET /v1/requests after collect)
+            self._publish_trace_segment(rid)
             out = {"tokens": [int(t) for t in st.output_ids],
                    "reason": st.finish_reason,
                    "worker": self.worker_id, "epoch": self.epoch,
@@ -396,6 +495,7 @@ class ServingWorker:
                 eng.lora.release(st.request.adapter, rid)
             ref = self._snapshot_ref(st)
             StoreQueue(self.store, f"{self.prefix}/q/evac").push(ref)
+            self._publish_trace_segment(rid, close="evacuate")
             if ref.get("xfer"):
                 snapshots += 1
             else:
@@ -523,6 +623,7 @@ class ServingWorker:
         self.publish_outputs()
         if self.clock() - self._last_status >= self.status_interval_s:
             self.publish_status()
+            self.publish_telemetry()
         return True
 
     def run(self, *, guard: Optional[PreemptionGuard] = None,
@@ -590,6 +691,12 @@ class ServingWorker:
                                 + self._cmd_q.holes)
                 if self._adm_q is not None else 0,
                 "incomplete_timelines": incomplete,
+                # final mergeable registry snapshot: post-mortem fleet
+                # accounting works even when the worker died before its
+                # last telemetry publish (the fleet-test audit reads it)
+                "telemetry": registry_to_wire(reg)
+                if (reg := obs.get_registry()) is not None
+                else None,
                 "fired": [list(f) for f in getattr(
                     _rs_state.FAULTS[0], "fired", [])]
                 if _rs_state.FAULTS[0] is not None else []}

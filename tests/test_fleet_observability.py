@@ -1,0 +1,468 @@
+"""Fleet observability plane (observability/aggregate.py + tools).
+
+The load-bearing guarantees (docs/OBSERVABILITY.md "Fleet
+observability"):
+
+- ``HistogramSketch`` is MERGEABLE: fixed log-spaced buckets so the
+  fleet p95 is computed from merged counts (order-independent,
+  associative), never from averaging per-worker p95s — and the
+  per-value quantile error stays bounded by the bucket width
+  (16 buckets/decade → < 16 % relative).
+- ``fleet_fold`` turns per-worker wire snapshots into one registry of
+  per-worker-labelled series + per-role + fleet rollups, rendering
+  through the UNCHANGED prom exporter (one ``# TYPE`` per family).
+- ``stitch_trace_segments`` joins per-worker trace segments on the
+  controller timebase: clock-skew corrected ordering, inter-segment
+  gaps attributed to xfer, each segment's exact-sum phase accounting
+  preserved verbatim.
+- The offline tools (telemetry_report fleet fold, trace_export
+  stitching) reuse the same implementations standalone.
+
+No jax anywhere in this file — the aggregation layer is host-side.
+"""
+
+import importlib.util
+import json
+import math
+import os
+import re
+import sys
+
+import pytest
+
+from paddle_tpu.observability.aggregate import (
+    NUM_BUCKETS, FleetRegistry, HistogramSketch, fleet_fold,
+    registry_to_wire, stitch_trace_segments)
+from paddle_tpu.observability.registry import MetricsRegistry
+from paddle_tpu.observability.sinks import (prom_split,
+                                            registry_to_prometheus)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PROM_SAMPLE = re.compile(
+    r"^[A-Za-z_:][A-Za-z0-9_:]*(\{[^{}]*\})? \S+$")
+
+
+def _assert_valid_prom(text):
+    for ln in text.splitlines():
+        if ln and not ln.startswith("# "):
+            assert _PROM_SAMPLE.match(ln), ln
+
+
+# ---------------------------------------------------------------------------
+# the mergeable sketch
+# ---------------------------------------------------------------------------
+
+class TestHistogramSketch:
+    def test_percentile_error_bounded_by_bucket_width(self):
+        """Nearest-rank percentiles off the sketch stay within one
+        bucket (< 16 % relative at 16 buckets/decade) of the exact
+        nearest-rank value, across four decades."""
+        import random
+        rng = random.Random(7)
+        vals = [rng.uniform(0.5, 5000.0) for _ in range(5000)]
+        sk = HistogramSketch()
+        for v in vals:
+            sk.observe(v)
+        exact = sorted(vals)
+        for p in (50, 90, 95, 99):
+            rank = max(1, math.ceil(p / 100.0 * len(exact)))
+            want = exact[rank - 1]
+            got = sk.percentile(p)
+            assert abs(got - want) / want < 0.16, (p, got, want)
+
+    def test_merge_commutative_and_associative(self):
+        def mk(seed, n):
+            import random
+            rng = random.Random(seed)
+            s = HistogramSketch()
+            for _ in range(n):
+                s.observe(rng.uniform(0.1, 900.0))
+            return s
+
+        a, b, c = mk(1, 400), mk(2, 300), mk(3, 500)
+        ab_c = a.copy().merge(b).merge(c)
+        c_ba = c.copy().merge(b).merge(a)
+        a_cb = a.copy().merge(c.copy().merge(b))
+        for other in (c_ba, a_cb):
+            assert ab_c.to_dict() == other.to_dict()
+        assert ab_c.snapshot()["count"] == 1200
+
+    def test_merged_percentile_is_not_averaged(self):
+        """The whole point: a fleet of one fast and one slow worker has
+        a merged p95 near the slow worker's tail — averaging the two
+        per-worker p95s would split the difference and hide it."""
+        fast, slow = HistogramSketch(), HistogramSketch()
+        for _ in range(100):
+            fast.observe(1.0)
+            slow.observe(1000.0)
+        merged = fast.copy().merge(slow)
+        avg = (fast.percentile(95) + slow.percentile(95)) / 2
+        assert merged.percentile(95) > 900.0
+        assert avg < 600.0
+
+    def test_empty_sketch(self):
+        sk = HistogramSketch()
+        assert sk.percentile(95) is None
+        assert sk.snapshot() == {"count": 0, "sum": 0.0}
+        assert HistogramSketch.from_dict(sk.to_dict()).to_dict() \
+            == sk.to_dict()
+
+    def test_underflow_and_overflow_buckets(self):
+        sk = HistogramSketch()
+        sk.observe(0.0)          # below 1e-3: underflow bucket
+        sk.observe(-5.0)         # negative clamps to underflow too
+        sk.observe(1e9)          # above 1e7: overflow bucket
+        snap = sk.snapshot()
+        assert snap["count"] == 3
+        # percentiles stay within the observed range even at the edges
+        assert sk.percentile(1) >= -5.0
+        assert sk.percentile(99) <= 1e9
+        wire = sk.to_dict()
+        assert all(0 <= int(k) < NUM_BUCKETS
+                   for k in wire["buckets"])
+
+    def test_wire_round_trip_preserves_merge(self):
+        a, b = HistogramSketch(), HistogramSketch()
+        for i in range(1, 200):
+            a.observe(i * 0.7)
+            b.observe(i * 13.0)
+        back = HistogramSketch.from_dict(
+            json.loads(json.dumps(a.to_dict())))
+        assert back.to_dict() == a.to_dict()
+        assert back.merge(b).percentile(95) == \
+            a.copy().merge(b).percentile(95)
+
+    def test_lifetime_not_rolling(self):
+        """Fleet series must stay monotone across publishes: the sketch
+        never forgets (unlike the registry Histogram's ring)."""
+        sk = HistogramSketch()
+        for _ in range(10_000):
+            sk.observe(1.0)
+        assert sk.snapshot()["count"] == 10_000
+
+    def test_registry_histogram_carries_sketch_shadow(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("serve.ttft_ms", window=4)
+        for v in (1.0, 2.0, 3.0, 4.0, 100.0):
+            h.observe(v)
+        # the ring forgot 1.0; the lifetime sketch did not
+        assert h.sketch.snapshot()["count"] == 5
+        assert h.sketch.percentile(1) <= 1.0 * 1.16
+
+
+# ---------------------------------------------------------------------------
+# wire snapshots + the fleet fold
+# ---------------------------------------------------------------------------
+
+def _worker_registry(ttfts, tokens):
+    reg = MetricsRegistry()
+    for v in ttfts:
+        reg.histogram("serve.ttft_ms").observe(v)
+    reg.counter("serve.tokens").inc(tokens)
+    reg.gauge("serve.queue_depth").set(2)
+    return reg
+
+
+class TestFleetFold:
+    def test_registry_to_wire_kinds(self):
+        wire = registry_to_wire(_worker_registry([5.0], 7))
+        assert wire["serve.tokens"] == {"kind": "counter", "value": 7}
+        assert wire["serve.queue_depth"] == {"kind": "gauge", "value": 2}
+        assert wire["serve.ttft_ms"]["kind"] == "sketch"
+
+    def test_fold_labels_and_rollups(self):
+        snaps = {
+            "w0": {"role": "prefill",
+                   "metrics": registry_to_wire(
+                       _worker_registry([10.0] * 50, 100))},
+            "w1": {"role": "decode",
+                   "metrics": registry_to_wire(
+                       _worker_registry([1000.0] * 50, 900))},
+        }
+        fleet = fleet_fold(snaps)
+        assert isinstance(fleet, FleetRegistry)
+        names = fleet.names()
+        assert "serve.tokens[worker=w0,role=prefill]" in names
+        assert "serve.tokens[role=decode]" in names
+        assert "serve.tokens" in names
+        assert fleet.get("serve.tokens").snapshot() == 1000
+        # fleet p95 from MERGED sketches: the slow worker's tail, not
+        # the average of the two per-worker p95s
+        fleet_p95 = fleet.get("serve.ttft_ms").snapshot()["p95"]
+        assert fleet_p95 > 900.0
+        merged = HistogramSketch.from_dict(
+            snaps["w0"]["metrics"]["serve.ttft_ms"]).merge(
+            HistogramSketch.from_dict(
+                snaps["w1"]["metrics"]["serve.ttft_ms"]))
+        assert fleet_p95 == merged.percentile(95)
+
+    def test_fold_renders_through_unchanged_prom_exporter(self):
+        snaps = {
+            "w0": {"role": "prefill",
+                   "metrics": registry_to_wire(
+                       _worker_registry([10.0], 3))},
+            "w1": {"role": "decode",
+                   "metrics": registry_to_wire(
+                       _worker_registry([20.0], 4))},
+        }
+        text = registry_to_prometheus(fleet_fold(snaps))
+        _assert_valid_prom(text)
+        assert 'serve_tokens{worker="w0",role="prefill"} 3' in text
+        assert 'serve_tokens{role="decode"} 4' in text
+        assert "\nserve_tokens 7" in text
+        # per-worker + tier + fleet series share ONE family: exactly
+        # one TYPE line per metric name
+        types = [ln for ln in text.splitlines()
+                 if ln.startswith("# TYPE serve_tokens ")]
+        assert len(types) == 1
+
+    def test_prom_grammar_round_trip_of_worker_labels(self):
+        name = "serve.ttft_ms[worker=w0,role=decode]"
+        base, labels = prom_split(name)
+        assert base == "serve_ttft_ms"
+        assert labels == [("worker", "w0"), ("role", "decode")]
+        # the single-bracket legacy grammar is untouched
+        base, labels = prom_split("serve.replica[0].free_blocks")
+        assert base == "serve_replica_free_blocks"
+        assert labels == [("replica", "0")]
+
+    def test_hostile_worker_ids_are_sanitized(self):
+        snaps = {"w[0],x=y": {"role": "decode", "metrics":
+                              {"serve.tokens": {"kind": "counter",
+                                                "value": 1}}}}
+        text = registry_to_prometheus(fleet_fold(snaps))
+        _assert_valid_prom(text)
+        assert "w_0__x_y" in text
+
+
+# ---------------------------------------------------------------------------
+# cross-host trace stitching
+# ---------------------------------------------------------------------------
+
+def _segment(worker, role, t0, *, offset=0.0, queue=0.0, prefill=0.0,
+             xfer=0.0, decode=0.0, tokens=0, reason=None, events=()):
+    wall = round(queue + prefill + xfer + decode, 3)
+    return {"id": "r0", "trace_id": "tr0", "tenant": "acme",
+            "worker": worker, "role": role, "epoch": 1,
+            "clock_offset": offset, "t0": t0,
+            "events": list(events),
+            "summary": {"queue_ms": queue, "prefill_ms": prefill,
+                        "xfer_ms": xfer, "decode_ms": decode,
+                        "wall_ms": wall, "decode_tokens": tokens,
+                        "reason": reason}}
+
+
+class TestStitchTraceSegments:
+    def test_two_host_stitch_gap_is_xfer(self):
+        pre = _segment("wA", "prefill", 100.0, queue=2.0, prefill=8.0)
+        dec = _segment("wB", "decode", 100.030, decode=40.0, tokens=8,
+                       reason="length")
+        tl = stitch_trace_segments([dec, pre])   # order-independent
+        assert tl["hosts"] == ["wA", "wB"]
+        assert [s["worker"] for s in tl["segments"]] == ["wA", "wB"]
+        assert tl["monotonic"]
+        # gap = 30 ms − the 10 ms prefill segment wall
+        assert tl["xfer_gap_ms"] == pytest.approx(20.0, abs=0.01)
+        assert tl["xfer_ms"] == pytest.approx(20.0, abs=0.01)
+        assert tl["queue_ms"] == 2.0 and tl["prefill_ms"] == 8.0
+        assert tl["decode_ms"] == 40.0
+        # exact-sum invariant reproduced at the top level
+        assert tl["wall_ms"] == pytest.approx(
+            tl["queue_ms"] + tl["prefill_ms"] + tl["xfer_ms"]
+            + tl["decode_ms"], abs=1e-9)
+        assert tl["decode_tokens"] == 8 and tl["reason"] == "length"
+
+    def test_clock_skew_correction_restores_order(self):
+        """The decode host's clock runs 5 s ahead: raw t0s would order
+        the segments decode-first.  Correcting by each segment's
+        published offset restores the true order and a true gap."""
+        pre = _segment("wA", "prefill", 100.0, prefill=10.0)
+        dec = _segment("wB", "decode", 105.020, offset=5.0, decode=20.0,
+                       tokens=4)
+        tl = stitch_trace_segments([pre, dec])
+        assert [s["worker"] for s in tl["segments"]] == ["wA", "wB"]
+        assert tl["monotonic"]
+        assert tl["xfer_ms"] == pytest.approx(10.0, abs=0.01)
+
+    def test_residual_skew_reports_non_monotonic(self):
+        """Uncorrected residual skew: the decode segment starts INSIDE
+        the prefill segment (overlap beyond the 0.5 ms tolerance) —
+        stitching still succeeds, but flags the timeline."""
+        pre = _segment("wA", "prefill", 100.0, prefill=10.0)
+        dec = _segment("wB", "decode", 100.002, decode=20.0)
+        tl = stitch_trace_segments([pre, dec])
+        assert not tl["monotonic"]
+        # negative gap clamps: phases never go negative
+        assert tl["xfer_ms"] == 0.0
+
+    def test_segment_accounting_preserved_verbatim(self):
+        pre = _segment("wA", "prefill", 10.0, queue=1.5, prefill=3.25)
+        dec = _segment("wB", "decode", 10.1, xfer=0.75, decode=9.0)
+        tl = stitch_trace_segments([pre, dec])
+        for seg, src in zip(tl["segments"], (pre, dec)):
+            assert seg["summary"] == src["summary"]
+        assert tl["xfer_ms"] == pytest.approx(
+            0.75 + tl["xfer_gap_ms"], abs=1e-9)
+
+    def test_empty_and_single_segment(self):
+        assert stitch_trace_segments([]) is None
+        tl = stitch_trace_segments(
+            [_segment("wA", "both", 5.0, queue=1.0, decode=2.0,
+                      tokens=2)])
+        assert tl["hosts"] == ["wA"]
+        assert tl["xfer_gap_ms"] == 0.0
+        assert tl["wall_ms"] == pytest.approx(3.0)
+
+
+# ---------------------------------------------------------------------------
+# offline tools: fleet sidecar folding + stitched export
+# ---------------------------------------------------------------------------
+
+def _tools(name):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+def _sidecar(path, wid, role, ttft, tokens):
+    events = [
+        {"event": "cluster_register", "worker": wid, "role": role,
+         "epoch": 1, "ts": 1.0},
+        {"event": "serve_step", "ms": 2.0, "tokens": tokens,
+         "active": 1, "queue": 0, "ts": 2.0},
+        {"event": "serve_request", "id": f"{wid}-r0", "prompt_len": 4,
+         "ts": 2.0},
+        {"event": "serve_trace", "id": f"{wid}-r0", "t0": 1.0,
+         "events": [], "ts": 3.0,
+         "summary": {"queue_ms": 1.0, "prefill_ms": ttft,
+                     "xfer_ms": 0.0, "decode_ms": 5.0,
+                     "wall_ms": 6.0 + ttft, "decode_tokens": tokens}},
+    ]
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    return path
+
+
+class TestTelemetryReportFleet:
+    def test_multi_input_folds_with_worker_breakdown(self, tmp_path,
+                                                     capsys):
+        tr = _tools("telemetry_report")
+        a = _sidecar(tmp_path / "w0.jsonl", "w0", "prefill", 10.0, 3)
+        b = _sidecar(tmp_path / "w1.jsonl", "w1", "decode", 90.0, 9)
+        rc = tr.main(["--input", str(a), "--input", str(b), "--json"])
+        assert rc == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        summary = json.loads(out[-1])
+        # fleet fold: both streams in one summary...
+        assert summary["serving"]["requests"] == 2
+        assert summary["serving"]["tokens"] == 12
+        # ...plus the per-worker breakdown keyed by registered id
+        assert set(summary["workers"]) == {"w0", "w1"}
+        assert summary["workers"]["w0"]["tokens"] == 3
+        assert summary["workers"]["w1"]["traces"] == 1
+
+    def test_glob_and_dedup(self, tmp_path, capsys):
+        tr = _tools("telemetry_report")
+        _sidecar(tmp_path / "w0.jsonl", "w0", "prefill", 10.0, 3)
+        _sidecar(tmp_path / "w1.jsonl", "w1", "decode", 90.0, 9)
+        pattern = str(tmp_path / "w*.jsonl")
+        paths = tr.expand_inputs([pattern],
+                                 [str(tmp_path / "w0.jsonl")])
+        assert [os.path.basename(p) for p in paths] == ["w0.jsonl",
+                                                        "w1.jsonl"]
+        rc = tr.main([pattern, "--json"])
+        assert rc == 0
+        summary = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert summary["serving"]["requests"] == 2
+
+    def test_worker_table_renders(self, tmp_path, capsys):
+        tr = _tools("telemetry_report")
+        a = _sidecar(tmp_path / "w0.jsonl", "w0", "prefill", 10.0, 3)
+        b = _sidecar(tmp_path / "w1.jsonl", "w1", "decode", 90.0, 9)
+        assert tr.main([str(a), str(b)]) == 0
+        out = capsys.readouterr().out
+        assert "| Worker (2 streams) |" in out
+        assert "| w0 |" in out and "| w1 |" in out
+
+    def test_single_input_has_no_worker_breakdown(self, tmp_path,
+                                                  capsys):
+        tr = _tools("telemetry_report")
+        a = _sidecar(tmp_path / "w0.jsonl", "w0", "prefill", 10.0, 3)
+        assert tr.main([str(a), "--json"]) == 0
+        summary = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert "workers" not in summary
+
+
+class TestTraceExportStitching:
+    def test_cross_host_segments_stitch_into_one_track(self, tmp_path):
+        te = _tools("trace_export")
+        pre = dict(_segment("wA", "prefill", 100.0, queue=2.0,
+                            prefill=8.0,
+                            events=[{"phase": "admit", "t_ms": 2.0,
+                                     "closed": "queue", "ms": 2.0},
+                                    {"phase": "handoff", "t_ms": 10.0,
+                                     "closed": "prefill", "ms": 8.0}]),
+                   event="serve_trace")
+        dec = dict(_segment("wB", "decode", 100.030, decode=40.0,
+                            tokens=8,
+                            events=[{"phase": "retire", "t_ms": 40.0,
+                                     "closed": "decode", "ms": 40.0}]),
+                   event="serve_trace")
+        trace, n, stitched = te.chrome_trace([pre, dec])
+        assert n == 1 and stitched == 1
+        evs = trace["traceEvents"]
+        xfer = [e for e in evs if e["ph"] == "X" and e["name"] == "xfer"
+                and e.get("args", {}).get("cross_host")]
+        assert len(xfer) == 1
+        assert xfer[0]["args"] == {"cross_host": True, "from": "wA",
+                                   "to": "wB"}
+        procs = {e["args"]["name"] for e in evs
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert {"worker wA", "worker wB"} <= procs
+        # both segments share one tid: one request, one row
+        tids = {e["tid"] for e in evs if e["ph"] == "X"}
+        assert tids == {1}
+
+    def test_export_cli_reports_stitched_count(self, tmp_path, capsys):
+        te = _tools("trace_export")
+        path = tmp_path / "fleet.jsonl"
+        with open(path, "w") as f:
+            for seg in (_segment("wA", "prefill", 100.0, prefill=8.0),
+                        _segment("wB", "decode", 100.030, decode=40.0,
+                                 tokens=8)):
+                f.write(json.dumps(dict(seg, event="serve_trace"))
+                        + "\n")
+        out = tmp_path / "fleet.trace.json"
+        assert te.main([str(path), "-o", str(out)]) == 0
+        summary = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert summary["requests"] == 1
+        assert summary["stitched"] == 1
+        data = json.loads(out.read_text())
+        assert data["traceEvents"]
+
+
+# ---------------------------------------------------------------------------
+# standalone-load contract
+# ---------------------------------------------------------------------------
+
+def test_aggregate_loads_standalone_without_package():
+    """tools/ load aggregate.py by path on jax-less boxes: it must not
+    import the package (or anything beyond stdlib)."""
+    path = os.path.join(REPO, "paddle_tpu", "observability",
+                        "aggregate.py")
+    spec = importlib.util.spec_from_file_location("_agg_standalone",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    sk = mod.HistogramSketch()
+    sk.observe(5.0)
+    assert mod.stitch_trace_segments(
+        [{"id": "r", "worker": "w", "t0": 1.0,
+          "summary": {"wall_ms": 1.0}}])["hosts"] == ["w"]

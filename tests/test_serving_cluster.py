@@ -311,15 +311,17 @@ class TestCommandFencing:
 # ---------------------------------------------------------------------------
 
 def _seed_worker(store, wid, role, *, epoch=1, free_blocks=8,
-                 queue_depth=0, lease_t=None, slo_breached=False):
+                 queue_depth=0, lease_t=None, slo_breached=False,
+                 status_t=None, **status_extra):
     store.set(f"cluster/workers/{wid}", json.dumps(
         {"worker": wid, "role": role, "epoch": epoch,
          "state": "up", "version": "v0"}).encode())
     store.set(f"cluster/status/{wid}", json.dumps(
         {"worker": wid, "role": role, "epoch": epoch,
+         "t": time.time() if status_t is None else status_t,
          "queue_depth": queue_depth, "active": 0,
          "free_blocks": free_blocks, "num_blocks": 8,
-         "slo_breached": slo_breached}).encode())
+         "slo_breached": slo_breached, **status_extra}).encode())
     if lease_t is not None:
         store.set(f"cluster/lease/{wid}", json.dumps(
             {"epoch": epoch, "t": lease_t}).encode())
@@ -822,3 +824,352 @@ class TestClusterServing:
         for r in rids:
             if ctl.outputs[r]["worker"] == paused.worker_id:
                 assert ctl.outputs[r]["epoch"] == paused.epoch
+
+
+# ---------------------------------------------------------------------------
+# fleet observability plane (docs/OBSERVABILITY.md "Fleet observability")
+# ---------------------------------------------------------------------------
+
+_PROM_SAMPLE_RE = __import__("re").compile(
+    r"^[A-Za-z_:][A-Za-z0-9_:]*(\{[^{}]*\})? \S+$")
+
+
+class TestStatusHardening:
+    def test_unparsable_status_demotes_from_routing(self, store):
+        obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        _seed_worker(store, "p0", "prefill", queue_depth=5)
+        _seed_worker(store, "p1", "prefill", queue_depth=0)
+        store.set("cluster/status/p1", b"\x80 not json")
+        ctl = ClusterController(store)
+        rid = ctl.submit(PROMPTS[0], max_new_tokens=4)
+        ctl.pump()
+        # p1 would win on queue depth; the garbage snapshot demotes it
+        assert "p1" in ctl._status_demoted
+        assert ctl._routable("prefill") == ["p0"]
+        assert json.loads(
+            store.get(f"cluster/assign/{rid}"))["wid"] == "p0"
+        sink = obs.get_telemetry().sinks[0]
+        assert [(e["worker"], e["reason"])
+                for e in sink.events("cluster_status_demoted")] \
+            == [("p1", "unparsable")]
+        assert obs.get_registry().get(
+            "cluster.status_demotions").snapshot() == 1
+
+    def test_stale_status_demotes_and_recovers(self, store):
+        obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        clock = _Clock(1000.0)
+        _seed_worker(store, "d0", "decode", status_t=999.0)
+        _seed_worker(store, "d1", "decode", status_t=990.0)  # frozen
+        ctl = ClusterController(store, clock=clock, status_stale_s=5.0)
+        ctl.pump()
+        assert ctl._routable("decode") == ["d0"]
+        # demotion narrows ROUTING only — the lease monitor still owns
+        # death, so the member record stays "up"
+        assert ctl.members()["d1"]["state"] == "up"
+        # a fresh snapshot rejoins routing, with a recovery event
+        _seed_worker(store, "d1", "decode", status_t=1000.5)
+        ctl.pump()
+        assert sorted(ctl._routable("decode")) == ["d0", "d1"]
+        sink = obs.get_telemetry().sinks[0]
+        assert [e["worker"]
+                for e in sink.events("cluster_status_recovered")] \
+            == ["d1"]
+        # one demotion transition, not one per pump
+        assert obs.get_registry().get(
+            "cluster.status_demotions").snapshot() == 1
+
+    def test_fully_demoted_tier_falls_back_to_live(self, store):
+        """Demotion must degrade routing, not wedge it: with every
+        prefill worker demoted, admission falls back to the full live
+        set rather than dropping the request."""
+        clock = _Clock(1000.0)
+        _seed_worker(store, "p0", "prefill", status_t=1.0)
+        _seed_worker(store, "p1", "prefill", status_t=1.0)
+        ctl = ClusterController(store, clock=clock, status_stale_s=5.0)
+        rid = ctl.submit(PROMPTS[0], max_new_tokens=4)
+        ctl.pump()
+        assert ctl._routable("prefill") == []
+        assert json.loads(
+            store.get(f"cluster/assign/{rid}"))["wid"] in ("p0", "p1")
+
+    def test_demotion_is_free_with_telemetry_disabled(self, store):
+        """The hardening itself is NOT telemetry: demotion still
+        protects routing with observability off (only the anomaly scan
+        is gated)."""
+        _seed_worker(store, "p0", "prefill", queue_depth=5)
+        _seed_worker(store, "p1", "prefill", queue_depth=0)
+        store.set("cluster/status/p1", b"garbage")
+        ctl = ClusterController(store)
+        rid = ctl.submit(PROMPTS[0], max_new_tokens=4)
+        ctl.pump()
+        assert json.loads(
+            store.get(f"cluster/assign/{rid}"))["wid"] == "p0"
+
+
+class TestFleetAnomalies:
+    def test_straggler_convicted_after_consecutive_windows(self, store):
+        obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        _seed_worker(store, "d0", "decode", ttft_p95=10.0)
+        _seed_worker(store, "d1", "decode", ttft_p95=12.0)
+        _seed_worker(store, "d2", "decode", ttft_p95=100.0)
+        ctl = ClusterController(store)
+        ctl.pump()
+        ctl.pump()
+        assert ctl._stragglers == set()     # 2 windows: not yet
+        ctl.pump()
+        assert ctl._stragglers == {"d2"}
+        sink = obs.get_telemetry().sinks[0]
+        evs = sink.events("cluster_straggler")
+        assert [(e["worker"], e["ttft_p95"]) for e in evs] \
+            == [("d2", 100.0)]
+        assert obs.get_registry().get(
+            "cluster.stragglers").snapshot() == 1
+        # a straggler counts as an SLO breach for the autoscaler
+        assert ctl._tier_breached(["d0", "d1", "d2"])
+        assert any(d["kind"] == "straggler" and d["worker"] == "d2"
+                   for d in ctl.cluster_view()["decisions"])
+        # back under the bar: unflag + recovery event
+        _seed_worker(store, "d2", "decode", ttft_p95=11.0)
+        ctl.pump()
+        assert ctl._stragglers == set()
+        assert [e["worker"] for e in
+                sink.events("cluster_straggler_recovered")] == ["d2"]
+
+    def test_two_worker_tier_uses_peer_median(self, store):
+        """With 2 workers the median is the OTHER worker's value — a
+        worker can never dodge conviction by dominating the sample."""
+        obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        _seed_worker(store, "d0", "decode", step_p95=4.0)
+        _seed_worker(store, "d1", "decode", step_p95=40.0)
+        ctl = ClusterController(store)
+        for _ in range(3):
+            ctl.pump()
+        assert ctl._stragglers == {"d1"}
+
+    def test_recompile_escalation_alert(self, store):
+        obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        _seed_worker(store, "d0", "decode", compiles=3)
+        ctl = ClusterController(store)
+        ctl.pump()                          # first status = baseline
+        sink = obs.get_telemetry().sinks[0]
+        assert sink.events("cluster_recompile_alert") == []
+        _seed_worker(store, "d0", "decode", compiles=5)
+        ctl.pump()
+        evs = sink.events("cluster_recompile_alert")
+        assert [(e["worker"], e["compiles"], e["new"])
+                for e in evs] == [("d0", 5, 2)]
+        assert obs.get_registry().get(
+            "cluster.recompile_alerts").snapshot() == 2
+        ctl.pump()                          # no re-alert at 5
+        assert len(sink.events("cluster_recompile_alert")) == 1
+
+    def test_scan_gated_on_telemetry(self, store):
+        _seed_worker(store, "d0", "decode", ttft_p95=10.0)
+        _seed_worker(store, "d1", "decode", ttft_p95=900.0)
+        ctl = ClusterController(store)
+        for _ in range(5):
+            ctl.pump()
+        assert ctl._stragglers == set()
+
+
+class TestWorkerTelemetryShipping:
+    def test_publish_telemetry_ships_wire_snapshot(self, store):
+        obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        w = _fake_worker(store)
+        w.register()
+        obs.get_registry().histogram("serve.ttft_ms").observe(7.0)
+        assert w.publish_telemetry()
+        snap = json.loads(store.get("cluster/telemetry/w0").decode())
+        assert snap["worker"] == "w0" and snap["role"] == "decode"
+        assert snap["metrics"]["cluster.registers"] \
+            == {"kind": "counter", "value": 1}
+        assert snap["metrics"]["serve.ttft_ms"]["kind"] == "sketch"
+
+    def test_publish_telemetry_disabled_is_inert(self, store):
+        w = _fake_worker(store)
+        w.register()
+        assert w.publish_telemetry() is False
+        assert store.get("cluster/telemetry/w0") is None
+        assert w._publish_trace_segment("r0") is False
+        assert store.keys("cluster/trace/") == []
+
+    def test_sync_clock_estimates_offset(self, store):
+        obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        ctl = ClusterController(store, clock=_Clock(500.0))
+        ctl.pump()                      # stamps cluster/clock
+        w = _fake_worker(store, clock=_Clock(520.0))
+        w.register()                    # register syncs
+        assert w.clock_offset == pytest.approx(20.0)
+        # skew rides every status so the stitcher can read it back
+        w.publish_status()
+        st = json.loads(store.get("cluster/status/w0").decode())
+        assert st["clock_offset"] == pytest.approx(20.0)
+
+    def test_sync_clock_without_tracer_is_inert(self, store):
+        ctl = ClusterController(store, clock=_Clock(500.0))
+        ctl.pump()
+        assert store.get("cluster/clock") is None  # controller gated too
+        w = _fake_worker(store, clock=_Clock(520.0))
+        w.register()
+        assert w.clock_offset == 0.0
+
+    def test_exit_report_carries_mergeable_snapshot(self, store):
+        obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        w = _fake_worker(store)
+        w.register()
+        rep = w.report(compiles_baseline=0)
+        assert rep["telemetry"]["cluster.registers"]["value"] == 1
+
+    def test_exit_report_telemetry_none_when_disabled(self, store):
+        w = _fake_worker(store)
+        w.register()
+        assert w.report(compiles_baseline=0)["telemetry"] is None
+
+
+class TestControllerSurface:
+    def _segment(self, rid, worker, role, t0, **summary):
+        wall = round(sum(summary.values()), 3)
+        return {"id": rid, "worker": worker, "role": role, "epoch": 1,
+                "clock_offset": 0.0, "t0": t0, "events": [],
+                "summary": {"queue_ms": 0.0, "prefill_ms": 0.0,
+                            "xfer_ms": 0.0, "decode_ms": 0.0,
+                            "wall_ms": wall, "decode_tokens": 0,
+                            **summary}}
+
+    def test_metrics_text_folds_worker_snapshots(self, store):
+        obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        w = _fake_worker(store)
+        w.register()
+        obs.get_registry().histogram("serve.ttft_ms").observe(7.0)
+        w.publish_telemetry()
+        ctl = ClusterController(store)
+        text = ctl.metrics_text()
+        for ln in text.splitlines():
+            if ln and not ln.startswith("# "):
+                assert _PROM_SAMPLE_RE.match(ln), ln
+        assert 'serve_ttft_ms{worker="w0",role="decode",' in text
+        assert "serve_ttft_ms_count" in text
+        assert "\ncluster_live_workers 1" in text
+
+    def test_http_surface(self, store):
+        import http.client
+        obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        _seed_worker(store, "d0", "decode", lease_t=time.time())
+        for seg in (self._segment("r7", "wA", "prefill", 100.0,
+                                  prefill_ms=8.0),
+                    self._segment("r7", "wB", "decode", 100.020,
+                                  decode_ms=30.0, decode_tokens=6)):
+            store.set(f"cluster/trace/r7/{seg['worker']}:1:1",
+                      json.dumps(seg).encode())
+        ctl = ClusterController(store)
+        ctl.pump()
+        host, port = ctl.serve_http()
+        try:
+            def get(path):
+                conn = http.client.HTTPConnection(host, port,
+                                                  timeout=10)
+                conn.request("GET", path)
+                r = conn.getresponse()
+                body = r.read().decode()
+                conn.close()
+                return r.status, r.getheader("Content-Type"), body
+
+            code, ctype, body = get("/healthz")
+            assert (code, body) == (200, "ok\n")
+            code, ctype, body = get("/metrics")
+            assert code == 200
+            assert ctype == "text/plain; version=0.0.4"
+            assert "cluster_live_workers 1" in body
+            code, ctype, body = get("/v1/cluster")
+            assert code == 200 and ctype == "application/json"
+            view = json.loads(body)
+            assert view["workers"]["d0"]["lease_age_s"] is not None
+            assert view["workers"]["d0"]["status_demoted"] is False
+            # the stitched cross-host timeline, straight off the store
+            code, ctype, body = get("/v1/requests/r7")
+            assert code == 200
+            tl = json.loads(body)
+            assert tl["hosts"] == ["wA", "wB"]
+            assert tl["xfer_ms"] == pytest.approx(12.0, abs=0.01)
+            assert tl["monotonic"]
+            code, _, body = get("/v1/requests/nope")
+            assert code == 404 and json.loads(body)["id"] == "nope"
+            code, _, _ = get("/v1/bogus")
+            assert code == 404
+            # idempotent: a second serve_http returns the same bind
+            assert ctl.serve_http() == (host, port)
+        finally:
+            ctl.close_http()
+
+    def test_trace_gc_bounds_store_keys(self, store):
+        obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        _seed_worker(store, "p0", "prefill")
+        ctl = ClusterController(store, trace_retention=2)
+        rids = []
+        for i in range(4):
+            rid = ctl.submit(PROMPTS[0], max_new_tokens=2)
+            rids.append(rid)
+            ctl.pump()
+            store.set(f"cluster/trace/{rid}/p0:1:1", json.dumps(
+                self._segment(rid, "p0", "prefill", 100.0 + i,
+                              prefill_ms=1.0)).encode())
+            store.set(f"cluster/out/{rid}", json.dumps(
+                {"tokens": [1], "reason": "eos", "worker": "p0",
+                 "epoch": 1}).encode())
+            ctl.pump()
+        assert all(r in ctl.outputs for r in rids)
+        # only the newest `trace_retention` requests keep segments
+        assert ctl.trace_segments(rids[0]) == []
+        assert ctl.trace_segments(rids[1]) == []
+        assert len(ctl.trace_segments(rids[2])) == 1
+        assert len(ctl.trace_segments(rids[3])) == 1
+
+
+@pytest.mark.slow
+class TestFleetTracingEndToEnd:
+    def test_cross_host_request_stitches_into_one_timeline(
+            self, tiny_llama, store):
+        """Real engines, real clocks (segment t0s are wall time —
+        fake clocks would corrupt the corrected ordering): a request
+        prefilled on w0 and decoded on w1 yields ONE stitched timeline
+        with both hosts, a positive xfer phase, skew-corrected
+        monotone segments, and the exact-sum invariant intact on every
+        segment."""
+        obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        ctl = ClusterController(store, lease_deadline_s=100.0)
+        ctl.pump()                       # publish the controller clock
+        workers = _spin_up(tiny_llama, store, ("prefill", "decode"))
+        # registration read a clock stamp as stale as the engine
+        # warmups are long; steady state re-syncs at every lease
+        # renewal against the per-pump re-stamp — emulate one cycle
+        ctl.pump()
+        for w in workers:
+            w._sync_clock()
+        rids = [ctl.submit(p, max_new_tokens=10) for p in PROMPTS[:2]]
+        _drive(ctl, workers, rids)
+        for rid in rids:
+            segs = ctl.trace_segments(rid)
+            assert [s["worker"] for s in segs] \
+                == ["w0-prefill", "w1-decode"]
+            tl = ctl.request_timeline(rid)
+            assert tl["hosts"] == ["w0-prefill", "w1-decode"]
+            assert tl["monotonic"], tl
+            assert tl["xfer_ms"] > 0
+            assert tl["decode_tokens"] == 10
+            for seg in tl["segments"]:
+                s = seg["summary"]
+                parts = sum(s[k] for k in ("queue_ms", "prefill_ms",
+                                           "xfer_ms", "decode_ms"))
+                assert abs(parts - s["wall_ms"]) <= 0.005
+            # top-level accounting re-sums to the stitched wall
+            assert tl["wall_ms"] == pytest.approx(
+                tl["queue_ms"] + tl["prefill_ms"] + tl["xfer_ms"]
+                + tl["decode_ms"], abs=1e-6)
+        # the scrapeable surface saw the same fleet: per-worker rows
+        # from shipped snapshots, tokens from merged counters
+        text = ctl.metrics_text()
+        assert 'worker="w0-prefill"' in text
+        assert 'worker="w1-decode"' in text
+        fleet = ctl.fleet_registry()
+        assert fleet.get("serve.tokens").snapshot() >= 20
+        _blocks_clean(workers)

@@ -752,8 +752,8 @@ class TestTraceTools:
                   {"phase": "retire", "t_ms": 2.0, "closed": "decode",
                    "ms": 0.5}],
               "summary": {}}
-        trace, n = trace_export.chrome_trace([ev])
-        assert n == 1
+        trace, n, stitched = trace_export.chrome_trace([ev])
+        assert n == 1 and stitched == 0
         by_name = {e["name"]: e for e in trace["traceEvents"]
                    if e["ph"] == "X"}
         assert by_name["queue"]["pid"] == 0

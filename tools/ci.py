@@ -251,11 +251,20 @@ def gate_telemetry_overhead(iters: int = 100_000,
     # the request tracer rides the same contract: with tracing off every
     # serving site is ONE falsy check on _state.TRACE[0], so a poisoned
     # tracer method must never fire during the disabled-path probes
+    # the fleet aggregation layer (observability/aggregate.py) rides the
+    # same contract: with telemetry disabled no sketch is observed or
+    # merged, no registry is folded to the wire, no segments stitched
+    from paddle_tpu.observability import aggregate as obs_agg
     poisoned = [(obs.MetricsRegistry, n) for n in
                 ("counter", "gauge", "histogram")] + \
                [(obs.Telemetry, "emit")] + \
                [(obs.RequestTracer, n) for n in
-                ("begin", "point", "transition", "retire")]
+                ("begin", "point", "transition", "retire")] + \
+               [(obs_agg.HistogramSketch, n) for n in
+                ("observe", "merge")] + \
+               [(obs_agg, n) for n in
+                ("registry_to_wire", "fleet_fold",
+                 "stitch_trace_segments")]
     for cls, name in poisoned:
         saved[(cls, name)] = getattr(cls, name)
         setattr(cls, name, boom)
@@ -449,6 +458,114 @@ def gate_telemetry_overhead(iters: int = 100_000,
     if metrics_ms > 250.0 or req_ms > 250.0:
         print("telemetry-overhead gate FAILED: the operational HTTP "
               "surface blew its render budget on an IDLE stub engine")
+        return 1
+
+    # 3d. the fleet observability plane rides the same contract: with
+    # telemetry disabled, a worker's telemetry/trace/clock publishers
+    # and a controller pump touch neither the registry/tracer (poison)
+    # nor the store's telemetry keys (write audit) — and each disabled
+    # publisher call stays O(µs).
+    from paddle_tpu.serving import cluster as cluster_mod
+    from paddle_tpu.serving import worker as worker_mod
+
+    class _DictStore:
+        """Minimal in-memory store; records every key written."""
+
+        def __init__(self):
+            self.kv = {}
+            self.writes = []
+
+        def set(self, k, v):
+            self.writes.append(k)
+            self.kv[k] = v
+
+        def get(self, k):
+            return self.kv.get(k)
+
+        def add(self, k, n):
+            cur = int(self.kv.get(k, b"0")) + n
+            self.kv[k] = str(cur).encode()
+            return cur
+
+        def delete(self, k):
+            return self.kv.pop(k, None) is not None
+
+        def compare_set(self, k, expected, new):
+            if self.kv.get(k) == expected or (
+                    expected in (b"", None) and k not in self.kv):
+                self.kv[k] = new
+                return True
+            return False
+
+        def keys(self, pfx):
+            return [k for k in self.kv if k.startswith(pfx)]
+
+    class _CSched:
+        def queue_depth(self):
+            return 0
+
+        def active(self):
+            return []
+
+    class _CAlloc:
+        free_blocks = 8
+
+    class _CKV:
+        num_blocks = 8
+        allocator = _CAlloc()
+
+    class _CEng:
+        role = "both"
+        handoffs = 0
+        scheduler = _CSched()
+        kv = _CKV()
+
+    fleet_poisoned = poisoned + \
+        [(worker_mod, "registry_to_wire")] + \
+        [(cluster_mod, n) for n in
+         ("registry_to_wire", "fleet_fold", "stitch_trace_segments")]
+    dstore = _DictStore()
+    fw = worker_mod.ServingWorker(_CEng(), dstore, worker_id="gate-w",
+                                  status_interval_s=0.0)
+    for cls, name in fleet_poisoned:
+        saved[(cls, name)] = getattr(cls, name)
+        setattr(cls, name, boom)
+    try:
+        fw.register()
+        fw.publish_status()
+        ctl = cluster_mod.ClusterController(dstore, autoscale=True)
+        ctl.pump()
+        pub_iters = 20_000
+        t0 = time.perf_counter()
+        for _ in range(pub_iters):
+            fw.publish_telemetry()
+            fw._sync_clock()
+            fw._publish_trace_segment("gate-r0")
+        pub_us = (time.perf_counter() - t0) / pub_iters * 1e6
+    except AssertionError:
+        print("telemetry-overhead gate FAILED: the disabled-telemetry "
+              "fleet plane (worker publish / controller pump) touched "
+              "the registry / tracer / aggregation layer — every site "
+              "must be one falsy check (serving/worker.py, "
+              "serving/cluster.py)")
+        return 1
+    finally:
+        for (cls, name), fn in saved.items():
+            setattr(cls, name, fn)
+    leaked = [k for k in dstore.writes
+              if "/telemetry/" in k or "/trace/" in k
+              or k.endswith("/clock")]
+    if leaked:
+        print(f"telemetry-overhead gate FAILED: disabled-telemetry "
+              f"fleet plane still wrote observability store keys: "
+              f"{leaked[:4]} — the publishers must return before any "
+              "store traffic")
+        return 1
+    print(f"telemetry-overhead: disabled-path fleet publishers "
+          f"{pub_us:.2f} us/cycle (budget {budget_us:.0f} us)")
+    if pub_us > budget_us:
+        print("telemetry-overhead gate FAILED: the disabled fleet "
+              "publishers grew a measurable per-cycle cost")
         return 1
 
     # 4. an enable/disable cycle (recorder + watchdog + spans on) leaves
@@ -1834,8 +1951,19 @@ def gate_serving_cluster(n_prefill: int = 2, n_decode: int = 2) -> int:
     acked with the membership record showing the new role, and every
     surviving worker's exit report showing 0 compiles after warmup,
     every KV block reclaimed, 0 lease losses, and the injected faults
-    actually fired."""
+    actually fired.
+
+    Fleet observability demands (docs/OBSERVABILITY.md "Fleet
+    observability"), scraped from the controller's own HTTP surface
+    MID-CHURN (right after the SIGKILL): ``GET /metrics`` is valid
+    prom exposition carrying per-worker-labelled rows AND merged fleet
+    rollups with fleet tokens advancing between scrapes; and after the
+    waves drain, EVERY request has one stitched cross-host timeline —
+    ≥ 2 hosts, per-segment exact-sum phase accounting, a positive xfer
+    phase, monotonic after clock-skew correction."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import http.client
+    import re as _re
     import time
 
     import numpy as np
@@ -1844,6 +1972,7 @@ def gate_serving_cluster(n_prefill: int = 2, n_decode: int = 2) -> int:
     from paddle_tpu import serving
     from paddle_tpu.launch.store import TCPStore, free_port
     from paddle_tpu.models.llama import llama
+    from paddle_tpu.observability import aggregate as obs_agg
 
     failures = []
     rng = np.random.default_rng(0)
@@ -1899,6 +2028,36 @@ def gate_serving_cluster(n_prefill: int = 2, n_decode: int = 2) -> int:
                 env=env, cwd=REPO, stdout=subprocess.PIPE,
                 stderr=subprocess.PIPE, text=True)
         ctl = serving.ClusterController(store, lease_deadline_s=6.0)
+        http_host, http_port = ctl.serve_http()
+
+        def scrape():
+            conn = http.client.HTTPConnection(http_host, http_port,
+                                              timeout=30)
+            conn.request("GET", "/metrics")
+            r = conn.getresponse()
+            body = r.read().decode()
+            conn.close()
+            if r.status != 200 or "text/plain" not in (
+                    r.getheader("Content-Type") or ""):
+                failures.append(
+                    f"GET /metrics answered {r.status} "
+                    f"{r.getheader('Content-Type')!r}")
+            sample = _re.compile(
+                r"^[A-Za-z_:][A-Za-z0-9_:]*(\{[^{}]*\})? \S+$")
+            bad = [ln for ln in body.splitlines()
+                   if ln and not ln.startswith("# ")
+                   and not sample.match(ln)]
+            if bad:
+                failures.append(
+                    f"/metrics is not valid prom exposition: {bad[:3]}")
+
+            def fleet_counter(name):
+                tot = 0.0
+                for ln in body.splitlines():
+                    if ln.startswith(f"{name} "):
+                        tot += float(ln.split()[-1])
+                return tot
+            return body, fleet_counter
 
         def alive_or_fail(may_exit=()):
             for wid, p in procs.items():
@@ -1936,6 +2095,21 @@ def gate_serving_cluster(n_prefill: int = 2, n_decode: int = 2) -> int:
             failures.append(
                 "wave-1 outputs diverged from the colocated run — "
                 "the fleet is not token-preserving")
+        body1, fleet1 = scrape()
+        toks1 = fleet1("serve_tokens")
+        if toks1 <= 0:
+            failures.append(
+                f"post-wave-1 /metrics fleet serve_tokens = {toks1} — "
+                "the fold dropped the workers' counters")
+        for wid in procs:
+            if f'worker="{wid}"' not in body1:
+                failures.append(
+                    f"/metrics carries no per-worker rows for {wid}")
+        if 'quantile="0.95"' not in body1 \
+                or "serve_ttft_ms_count" not in body1:
+            failures.append(
+                "/metrics fleet rollup has no merged-sketch ttft "
+                "summary (serve_ttft_ms quantile rows)")
 
         # wave 2 under load: SIGKILL a decode worker that owns an
         # uncollected assignment, and force-flip a prefill worker
@@ -1960,6 +2134,22 @@ def gate_serving_cluster(n_prefill: int = 2, n_decode: int = 2) -> int:
                             "assignment — nothing was killed")
         else:
             procs[victim].kill()
+            # MID-CHURN scrape: a dead worker and an in-flight role
+            # flip must not break the exposition, and fleet tokens
+            # must keep advancing.  Snapshots land at status cadence,
+            # so poll — every iteration still demands a valid scrape
+            # (grammar + per-worker rows) with the victim dead.
+            end2 = time.time() + 60
+            body2, fleet2 = scrape()
+            while fleet2("serve_tokens") <= toks1 \
+                    and time.time() < end2:
+                ctl.pump()
+                time.sleep(0.2)
+                body2, fleet2 = scrape()
+            if fleet2("serve_tokens") <= toks1:
+                failures.append(
+                    f"mid-churn fleet serve_tokens stuck at {toks1} "
+                    "— the fold stopped advancing under churn")
             pump_until(w2, may_exit=(victim,))
             for i, r in enumerate(w2):
                 if ctl.outputs[r]["tokens"] != refs[24][i % len(lens)]:
@@ -1978,6 +2168,46 @@ def gate_serving_cluster(n_prefill: int = 2, n_decode: int = 2) -> int:
                 f"{flipped} membership record still shows "
                 f"{ctl.members().get(flipped, {}).get('role')!r} "
                 "after the flip")
+
+        # every delivered request must stitch into ONE cross-host
+        # timeline: prefill on one host, decode on another, the
+        # inter-host gap attributed to xfer, each segment keeping its
+        # exact-sum phase accounting, ordering monotonic after the
+        # workers' clock-skew correction
+        n_fail0 = len(failures)
+        for rid in w1 + w2:
+            tl = ctl.request_timeline(rid)
+            if tl is None:
+                failures.append(f"{rid}: no stitched timeline "
+                                "(workers published no trace segments)")
+                continue
+            if len(tl["hosts"]) < 2:
+                failures.append(
+                    f"{rid}: timeline covers hosts {tl['hosts']} — a "
+                    "disagg request must cross prefill → decode")
+            if not tl["monotonic"]:
+                failures.append(
+                    f"{rid}: segments out of order after skew "
+                    f"correction ({[s['worker'] for s in tl['segments']]})")
+            if not tl["xfer_ms"] > 0:
+                failures.append(
+                    f"{rid}: no xfer phase in the stitched timeline "
+                    f"({tl['xfer_ms']} ms)")
+            if tl["decode_tokens"] is None or tl["decode_tokens"] <= 0:
+                failures.append(
+                    f"{rid}: stitched timeline lost the decode tokens")
+            for seg in tl["segments"]:
+                s = seg["summary"]
+                parts = sum(s.get(k) or 0.0 for k in
+                            ("queue_ms", "prefill_ms", "xfer_ms",
+                             "decode_ms"))
+                if abs(parts - (s.get("wall_ms") or 0.0)) > 0.005:
+                    failures.append(
+                        f"{rid}: segment on {seg['worker']} broke the "
+                        f"exact-sum invariant ({parts} vs "
+                        f"{s.get('wall_ms')})")
+            if len(failures) > n_fail0:
+                break                # one broken timeline is enough
 
         # drain the survivors and audit their exit reports
         for wid in procs:
@@ -2011,6 +2241,31 @@ def gate_serving_cluster(n_prefill: int = 2, n_decode: int = 2) -> int:
                 failures.append(
                     f"{wid} fired only {sorted(fired)} — the cluster.* "
                     "fault plans went unexercised")
+            # final mergeable snapshot: the exit report must carry the
+            # worker's registry in wire form (every worker registers,
+            # so cluster.registers is always present even for a worker
+            # the router never handed work)
+            wire = rep.get("telemetry")
+            regs = (wire or {}).get("cluster.registers")
+            if not wire or not isinstance(regs, dict) \
+                    or not regs.get("value"):
+                failures.append(
+                    f"{wid} exit report has no mergeable telemetry "
+                    f"snapshot (cluster.registers: {regs!r})")
+        # post-mortem fleet accounting from the reports ALONE (no
+        # store): merging the survivors' step sketches must recover a
+        # fleet step distribution — p95 from merged counts, never from
+        # averaging per-worker p95s
+        fleet_step = obs_agg.HistogramSketch()
+        for rep in reports.values():
+            sw = (rep.get("telemetry") or {}).get("serve.step_ms")
+            if isinstance(sw, dict) and sw.get("kind") == "sketch":
+                fleet_step.merge(obs_agg.HistogramSketch.from_dict(sw))
+        if reports and (not fleet_step.snapshot()["count"]
+                        or not (fleet_step.percentile(95) or 0) > 0):
+            failures.append(
+                "survivor exit reports merged into an empty fleet "
+                f"serve.step_ms sketch ({fleet_step.snapshot()!r})")
         flip_rep = reports.get(flipped)
         if flip_rep and flip_rep["role"] != "decode":
             failures.append(
@@ -2027,8 +2282,15 @@ def gate_serving_cluster(n_prefill: int = 2, n_decode: int = 2) -> int:
                   f"injected cluster.* faults in every worker — all "
                   f"{len(w1) + len(w2)} outputs token-identical to the "
                   f"colocated run, 0 compiles after warmup, all blocks "
-                  f"reclaimed, 0 lease losses on the survivors")
+                  f"reclaimed, 0 lease losses on the survivors; "
+                  f"/metrics scraped valid per-worker + fleet rollups "
+                  f"mid-churn and every request stitched into one "
+                  f"cross-host timeline")
     finally:
+        try:
+            ctl.close_http()
+        except Exception:  # noqa: BLE001 — ctl may not exist
+            pass
         for p in procs.values():
             if p.poll() is None:
                 p.kill()

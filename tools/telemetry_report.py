@@ -19,14 +19,24 @@ report them separately when exact step counts matter.
 Pure stdlib on purpose: the report runs anywhere the JSONL landed (a CI
 box, a laptop) without jax or the framework installed.
 
+Fleet mode (docs/OBSERVABILITY.md "Fleet observability"): every
+cluster worker writes its own JSONL sidecar; pass them all — as a
+shell glob, a quoted glob this tool expands itself, or repeated
+``--input`` flags — and the report folds them into ONE fleet view
+plus a per-worker breakdown table (worker id taken from each file's
+``cluster_register`` event, falling back to the file name).
+
 Usage:  python tools/telemetry_report.py run_telemetry.jsonl [more.jsonl]
         python tools/telemetry_report.py run.jsonl run.jsonl.postmortem
         python tools/telemetry_report.py --json run.jsonl   # JSON only
+        python tools/telemetry_report.py 'fleet/w*.jsonl'   # fleet fold
+        python tools/telemetry_report.py --input w0.jsonl --input w1.jsonl
 """
 
 from __future__ import annotations
 
 import argparse
+import glob as _glob
 import importlib.util
 import json
 import math
@@ -739,17 +749,102 @@ def render(agg, malformed=0):
     return "\n".join(lines)
 
 
+def expand_inputs(paths, inputs):
+    """Positionals + repeated ``--input`` flags, each glob-expanded
+    (quoted globs work without shell help); order-preserving dedup so
+    ``w*.jsonl w0.jsonl`` doesn't double-count a stream."""
+    out, seen = [], set()
+    for p in list(paths or []) + list(inputs or []):
+        matches = sorted(_glob.glob(p)) or [p]  # non-glob / missing:
+        for m in matches:                       # open() reports it
+            if m not in seen:
+                seen.add(m)
+                out.append(m)
+    return out
+
+
+def _worker_label(path, events):
+    """A per-file worker label for the fleet breakdown: the worker id
+    the stream registered under, else the file's basename."""
+    for e in events:
+        if e.get("event") == "cluster_register" and e.get("worker"):
+            return str(e["worker"])
+    return os.path.basename(path)
+
+
+def worker_breakdown(per_file):
+    """``[(path, events)] -> {label: row}`` — the per-worker fold
+    behind the fleet report's breakdown table."""
+    rows = {}
+    for path, events in per_file:
+        label = _worker_label(path, events)
+        if label in rows:            # two streams, one worker id
+            label = f"{label} ({os.path.basename(path)})"
+        a = summarize(events)
+        sv = a["serving"]
+        step_ms = sorted(sv["step_ms"])
+        walls = sorted(t["wall_ms"] for t in a["traces"]
+                       if t.get("wall_ms") is not None)
+        rows[label] = {
+            "file": path,
+            "events": len(events),
+            "requests": sv["requests"],
+            "traces": len(a["traces"]),
+            "tokens": sv["tokens"],
+            "steps": sv["steps"],
+            "step_p95_ms": _pct(step_ms, 95),
+            "wall_p95_ms": _pct(walls, 95),
+            "handoffs": sv["handoffs"],
+            "evacuations": a["cluster"]["evacuations"],
+        }
+    return rows
+
+
+def render_workers(rows):
+    lines = [f"| Worker ({len(rows)} streams) | Events | Requests "
+             "| Traces | Tokens | step p95 ms | wall p95 ms "
+             "| Handoffs |",
+             "|---|---|---|---|---|---|---|---|"]
+
+    def fmt(v, nd=2):
+        return f"{v:.{nd}f}" if v is not None else "—"
+    for label, r in sorted(rows.items()):
+        lines.append(
+            f"| {label} | {r['events']} | {r['requests']} "
+            f"| {r['traces']} | {r['tokens']} "
+            f"| {fmt(r['step_p95_ms'])} | {fmt(r['wall_p95_ms'])} "
+            f"| {r['handoffs']} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("paths", nargs="+", help="telemetry JSONL file(s)")
+    ap.add_argument("paths", nargs="*", help="telemetry JSONL file(s); "
+                    "globs are expanded")
+    ap.add_argument("--input", action="append", default=[],
+                    metavar="PATH", help="additional JSONL file/glob "
+                    "(repeatable) — fleet sidecars")
     ap.add_argument("--json", action="store_true",
                     help="print only the JSON summary line")
     args = ap.parse_args(argv)
+    paths = expand_inputs(args.paths, args.input)
+    if not paths:
+        ap.error("no input files (positional paths or --input)")
 
-    events, malformed = load_events(args.paths)
+    per_file, events, malformed = [], [], 0
+    for path in paths:
+        evs, bad = load_events([path])
+        per_file.append((path, evs))
+        events.extend(evs)
+        malformed += bad
     agg = summarize(events)
+    workers = worker_breakdown(per_file) if len(per_file) > 1 else None
     if not args.json:
         print(render(agg, malformed))
+        if workers:
+            print()
+            print(render_workers(workers))
     summary = {
         "metric": "telemetry_report",
         "events": len(events),
@@ -862,6 +957,8 @@ def main(argv=None) -> int:
         summary["slo_captures"] = [
             c.get("trace_dir") for c in agg["slo_captures"]
             if c.get("state") == "done"]
+    if workers:
+        summary["workers"] = workers
     if agg["bench_result"] is not None:
         summary["bench_value"] = agg["bench_result"].get("value")
     fused = _fused_mode(agg)

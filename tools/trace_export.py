@@ -9,12 +9,23 @@ as duration slices, lifecycle markers (prefill chunks, preempt, restore,
 route, migrate, isolated) as instant events — grouped by the replica
 the request was routed to (pid), one thread (tid) per request.
 
+Fleet mode (docs/OBSERVABILITY.md "Fleet observability"): pass every
+cluster worker's sidecar at once (globs expand) and a request that
+crossed hosts — prefill on worker A, decode on worker B — arrives as
+MULTIPLE ``serve_trace`` segments sharing one request id.  Those are
+stitched into one cross-host timeline
+(``observability/aggregate.stitch_trace_segments``: clock-skew
+corrected ordering, inter-segment gaps rendered as explicit ``xfer``
+slices), one Perfetto process per worker.
+
 Pure stdlib, no framework import: runs anywhere the JSONL landed (same
-contract as tools/telemetry_report.py, whose line parser it reuses).
+contract as tools/telemetry_report.py, whose line parser it reuses;
+the stitcher is loaded standalone from observability/aggregate.py).
 
 Usage:
     python tools/trace_export.py run_telemetry.jsonl -o run_trace.json
     python tools/trace_export.py a.jsonl b.jsonl          # -> a.trace.json
+    python tools/trace_export.py 'fleet/w*.jsonl' -o fleet.json
 
 Prints ONE JSON summary line on stdout (the repo's artifact convention).
 """
@@ -22,13 +33,34 @@ Prints ONE JSON summary line on stdout (the repo's artifact convention).
 from __future__ import annotations
 
 import argparse
+import importlib.util
 import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from telemetry_report import load_events  # noqa: E402
+from telemetry_report import expand_inputs, load_events  # noqa: E402
+
+_AGG = None
+
+
+def _aggregate():
+    """Load observability/aggregate.py STANDALONE (no package import,
+    no jax) — same pattern as telemetry_report's ``_sinks()`` — so the
+    offline stitcher and the controller's ``/v1/requests`` endpoint
+    share one implementation and cannot drift."""
+    global _AGG
+    if _AGG is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            os.pardir, "paddle_tpu", "observability",
+                            "aggregate.py")
+        spec = importlib.util.spec_from_file_location(
+            "_pdtpu_obs_aggregate", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _AGG = mod
+    return _AGG
 
 # lifecycle markers worth an instant event on the track (segment-closing
 # transitions already render as slices; prefill_chunk kept — per-chunk
@@ -38,11 +70,14 @@ _INSTANTS = {"submit", "prefill_chunk", "preempt", "restore", "route",
              "first_token", "retire"}
 
 
-def _track_events(trace: dict, tid: int):
+def _track_events(trace: dict, tid: int, pid0: int = 0,
+                  base_s: float = None):
     """Chrome events for ONE serve_trace payload.  The pid FOLLOWS the
     request across replicas — `route` sets it, `migrate` moves it — so
     an evacuated request's post-migration slices render under the
-    replica that actually did the work, not the dead one."""
+    replica that actually did the work, not the dead one.  Fleet mode
+    passes ``pid0`` (the worker's process) and ``base_s`` (the
+    segment's skew-corrected start on the controller timebase)."""
     out = []
     events = trace.get("events") or []
     rid = trace.get("id") or trace.get("request_id") or f"req?{tid}"
@@ -51,8 +86,10 @@ def _track_events(trace: dict, tid: int):
         label = f"{rid} [{trace['trace_id']}]"
     if trace.get("tenant"):
         label += f" ({trace['tenant']})"
-    base_us = float(trace.get("t0") or trace.get("ts") or 0.0) * 1e6
-    pid = 0
+    if base_s is None:
+        base_s = float(trace.get("t0") or trace.get("ts") or 0.0)
+    base_us = base_s * 1e6
+    pid = pid0
     pids = set()
     for ev in events:
         name = ev.get("phase") or "?"
@@ -88,38 +125,94 @@ def _track_events(trace: dict, tid: int):
     return pids, out
 
 
+_WORKER_PID0 = 1000   # fleet worker pids live above any replica pid
+
+
 def chrome_trace(events):
-    """All serve_trace events -> the Chrome trace-event JSON object."""
+    """All serve_trace events -> the Chrome trace-event JSON object.
+
+    Events sharing one request id are that request's per-worker
+    segments (cross-host prefill→decode): they are stitched on the
+    controller timebase and rendered as one tid spanning one process
+    per worker, with each positive inter-segment gap drawn as an
+    explicit ``xfer`` slice on the receiving worker's track."""
     out = []
     pids = set()
-    requests = 0
+    worker_pids = {}          # wid -> fleet pid (>= _WORKER_PID0)
+    requests = stitched = 0
+    by_rid, order = {}, []
     for e in events:
         if e.get("event") != "serve_trace":
             continue
+        rid = e.get("id") or e.get("request_id")
+        key = rid if rid is not None else object()
+        if key not in by_rid:
+            by_rid[key] = []
+            order.append(key)
+        by_rid[key].append(e)
+
+    def _wpid(wid):
+        if wid not in worker_pids:
+            worker_pids[wid] = _WORKER_PID0 + len(worker_pids)
+        return worker_pids[wid]
+
+    for key in order:
+        group = by_rid[key]
         requests += 1
-        track_pids, evs = _track_events(e, requests)
-        pids |= track_pids
-        out.extend(evs)
+        tid = requests
+        if len(group) == 1:
+            track_pids, evs = _track_events(group[0], tid)
+            pids |= track_pids
+            out.extend(evs)
+            continue
+        tl = _aggregate().stitch_trace_segments(group)
+        stitched += 1
+        prev_end = None
+        for seg in tl["segments"]:
+            pid = _wpid(seg.get("worker") or "?")
+            pseudo = {"id": tl.get("id"), "trace_id": tl.get("trace_id"),
+                      "tenant": tl.get("tenant"),
+                      "events": seg.get("events")}
+            track_pids, evs = _track_events(
+                pseudo, tid, pid0=pid, base_s=seg["start"])
+            pids |= track_pids
+            out.extend(evs)
+            if prev_end is not None and seg["start"] > prev_end:
+                out.append({"ph": "X", "name": "xfer", "pid": pid,
+                            "tid": tid, "ts": prev_end * 1e6,
+                            "dur": (seg["start"] - prev_end) * 1e6,
+                            "args": {"cross_host": True,
+                                     "from": prev_worker,
+                                     "to": seg.get("worker")}})
+            prev_end = seg["end"]
+            prev_worker = seg.get("worker")
+    wids = {p: w for w, p in worker_pids.items()}
     for pid in sorted(pids):
+        name = (f"worker {wids[pid]}" if pid in wids
+                else f"serving replica {pid}")
         out.append({"ph": "M", "name": "process_name", "pid": pid,
-                    "tid": 0, "args": {"name": f"serving replica {pid}"}})
-    return {"traceEvents": out, "displayTimeUnit": "ms"}, requests
+                    "tid": 0, "args": {"name": name}})
+    return ({"traceEvents": out, "displayTimeUnit": "ms"},
+            requests, stitched)
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("paths", nargs="+", help="telemetry JSONL file(s)")
+    ap.add_argument("paths", nargs="+", help="telemetry JSONL file(s); "
+                    "globs are expanded")
     ap.add_argument("-o", "--out", default=None,
                     help="output path (default: <first input>.trace.json)")
     args = ap.parse_args(argv)
 
-    events, malformed = load_events(args.paths)
-    trace, requests = chrome_trace(events)
-    out_path = args.out or (os.path.splitext(args.paths[0])[0]
+    paths = expand_inputs(args.paths, None)
+    events, malformed = load_events(paths)
+    trace, requests, stitched = chrome_trace(events)
+    out_path = args.out or (os.path.splitext(paths[0])[0]
                             + ".trace.json")
     with open(out_path, "w") as f:
         json.dump(trace, f)
     print(json.dumps({"metric": "trace_export", "requests": requests,
+                      "stitched": stitched,
                       "trace_events": len(trace["traceEvents"]),
                       "malformed_lines": malformed, "out": out_path}))
     return 0 if requests else 1
