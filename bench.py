@@ -31,6 +31,28 @@ def peak_flops() -> float:
     return _pf()
 
 
+def provenance(fused_ops="auto") -> dict:
+    """Attribution block stamped into every bench JSON so
+    tools/bench_compare.py trajectories can say WHICH code/toolchain
+    produced each point (r01–r05 predate this; the compare tool
+    backfills).  Never fatal — a missing .git dir just yields null."""
+    git_sha = None
+    try:
+        import subprocess
+        git_sha = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or None
+    except Exception:
+        pass
+    return {"git_sha": git_sha,
+            "jax": getattr(jax, "__version__", None),
+            "backend": jax.default_backend(),
+            "device": getattr(jax.devices()[0], "device_kind", "cpu"),
+            "fused": fused_ops}
+
+
 def measure(preset, batch_size, seq_len, steps, windows, remat=False,
             loss_chunks=1, fuse=False, remat_layers=None,
             fused_ops="auto"):
@@ -165,7 +187,8 @@ def main():
                          fused_ops=fused_ops)
     extra = {**stats,
              "backend": jax.default_backend(),
-             "device": getattr(jax.devices()[0], "device_kind", "cpu")}
+             "device": getattr(jax.devices()[0], "device_kind", "cpu"),
+             "provenance": provenance(fused_ops)}
 
     def extra_point(prefix, *args, keys=("ms_per_step",
                                          "window_ms_per_step",

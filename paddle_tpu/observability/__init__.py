@@ -46,6 +46,8 @@ from . import _state
 from .aggregate import (FleetRegistry, HistogramSketch,  # noqa: F401
                         fleet_fold, registry_to_wire,
                         stitch_trace_segments)
+from .compiled import (CHIP_SPECS, CompiledArtifactLedger,  # noqa: F401
+                       chip_spec, roofline)
 from .flight_recorder import (FlightRecorder, install_crash_hooks,  # noqa: F401
                               uninstall_crash_hooks, write_postmortem)
 from .flight_recorder import _reset_postmortem, configure_postmortem
@@ -80,6 +82,7 @@ class Telemetry:
         self.recorder = recorder
         self.watchdog = watchdog
         self.tracer: Optional[RequestTracer] = None
+        self.ledger: Optional[CompiledArtifactLedger] = None
         # RLock, not Lock: the preemption SIGTERM handler emits from the
         # main thread, possibly interrupting an emit already holding the
         # lock — a plain Lock would self-deadlock the dying process
@@ -146,6 +149,13 @@ def get_request_tracer() -> Optional[RequestTracer]:
     return _state.TRACE[0]
 
 
+def get_ledger() -> Optional[CompiledArtifactLedger]:
+    """The active compiled-artifact ledger (per-program cost/memory
+    rows + roofline spec), or None when telemetry is disabled / the
+    ledger was opted out."""
+    return _state.LEDGER[0]
+
+
 def emit_event(event: str, **fields) -> None:
     """Fire-and-forget structured event; no-op when disabled."""
     emit = _state.EMIT[0]
@@ -205,7 +215,9 @@ def enable(jsonl_path: Optional[str] = None, stdout: bool = False,
            watchdog_s: Optional[float] = None, on_hang=None,
            watchdog_abort: bool = False,
            request_tracing: bool = True,
-           trace_capacity: int = 2048) -> Telemetry:
+           trace_capacity: int = 2048,
+           compiled_ledger: bool = True,
+           chip_spec_override: Optional[dict] = None) -> Telemetry:
     """Turn telemetry on (replacing any active session) and return the
     ``Telemetry`` handle.
 
@@ -231,6 +243,13 @@ def enable(jsonl_path: Optional[str] = None, stdout: bool = False,
     retire; docs/OBSERVABILITY.md "Tracing a request"), retaining the
     last ``trace_capacity`` retired traces for ``GET /v1/requests/<rid>``
     and emitting one ``serve_trace`` event per retired request.
+
+    ``compiled_ledger`` installs a :class:`CompiledArtifactLedger` —
+    one row per real backend compile with XLA's cost/memory analysis,
+    compile wall-ms, sentinel site attribution, and the analytic
+    roofline minimum under the chip spec (``chip_spec_override`` merges
+    ``peak_flops``/``hbm_gbps`` on top of the built-in table; see
+    docs/OBSERVABILITY.md "Reading the roofline").
     """
     # validate BEFORE any side effect: raising after disable()/sink
     # creation/sentinel install would leak a registered jax.monitoring
@@ -294,10 +313,17 @@ def enable(jsonl_path: Optional[str] = None, stdout: bool = False,
         tel.tracer = RequestTracer(capacity=trace_capacity, registry=reg,
                                    emit=tel.emit)
 
+    if compiled_ledger:
+        tel.ledger = CompiledArtifactLedger(
+            sentinel=sent, telemetry=tel,
+            spec=dict(chip_spec_override) if chip_spec_override else None)
+        tel.ledger.install()
+
     _ACTIVE[0] = tel
     _state.MONITOR[0] = tel.monitor
     _state.EMIT[0] = tel.emit
     _state.TRACE[0] = tel.tracer
+    _state.LEDGER[0] = tel.ledger
     _state.COLLECTIVE[0] = _record_collective if collectives else None
     _state.RECORDER[0] = rec
     if spans:
@@ -320,6 +346,7 @@ def disable() -> None:
     _state.SPAN[0] = None
     _state.RECORDER[0] = None
     _state.TRACE[0] = None
+    _state.LEDGER[0] = None
     _ACTIVE[0] = None
     if tel.watchdog is not None:
         tel.watchdog.stop()
@@ -328,6 +355,8 @@ def disable() -> None:
     _reset_postmortem()
     if tel.sentinel is not None:
         tel.sentinel.uninstall()
+    if tel.ledger is not None:
+        tel.ledger.uninstall()
     try:
         tel.flush(emit_metrics=True)
     finally:

@@ -49,3 +49,10 @@ POSTMORTEM = [None]
 # preempt/restore/retire, EngineReplicaSet routing/evacuation) — the
 # per-request timeline producer (observability/trace.py).
 TRACE = [None]
+
+# compiled.CompiledArtifactLedger instance, or None. Read by the
+# serve/train roofline gauge producers (Engine.step_finish,
+# StepMonitor._record) and the HBM gauge publisher (Engine.warmup) —
+# the compile-time capture itself rides a method wrap installed only
+# while telemetry is enabled, so it has NO disabled-path check at all.
+LEDGER = [None]

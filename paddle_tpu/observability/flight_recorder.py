@@ -172,6 +172,14 @@ def write_postmortem(reason: str = "unknown", path: Optional[str] = None,
             head.update(extra)
         lines = [head]
         lines.extend(_thread_stacks())
+        led = _state.LEDGER[0]
+        if led is not None:
+            # the compiled-program cost/memory rows + the last HBM pool
+            # snapshot: an OOM/stall dump names which program or pool
+            # owned the bytes.  Pure host-side copies — never touches a
+            # device buffer from a dying process.
+            lines.append({"event": "compiled_artifacts",
+                          "rows": led.snapshot(), "hbm": led.hbm})
         if recorder is not None:
             lines.append({"event": "flight_recorder",
                           "recorded": len(recorder),

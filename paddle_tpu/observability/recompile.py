@@ -82,31 +82,42 @@ class RecompileSentinel:
     # -- site attribution --------------------------------------------------
 
     class _SiteScope:
-        __slots__ = ("_sent", "_name")
+        __slots__ = ("_sent", "_name", "_warmup")
 
-        def __init__(self, sent, name):
+        def __init__(self, sent, name, warmup):
             self._sent = sent
             self._name = name
+            self._warmup = warmup
 
         def __enter__(self):
             stack = getattr(self._sent._tls, "stack", None)
             if stack is None:
                 stack = self._sent._tls.stack = []
-            stack.append(self._name)
+            stack.append((self._name, self._warmup))
             return self
 
         def __exit__(self, *exc):
             self._sent._tls.stack.pop()
             return False
 
-    def site(self, name: str) -> "_SiteScope":
+    def site(self, name: str, *, warmup: bool = False) -> "_SiteScope":
         """Context manager: compiles fired inside are attributed to
-        ``name`` (a TrainStep/to_static call site)."""
-        return self._SiteScope(self, name)
+        ``name`` (a TrainStep/to_static call site).  ``warmup=True``
+        marks the compiles as EXPECTED — they count and attribute like
+        any other but never enter the storm window, so a process that
+        legitimately warms the same site repeatedly (bench scenarios,
+        one engine per test, a re-built engine after evacuation) stays
+        quiet while genuine shape churn outside a warmup scope still
+        warns."""
+        return self._SiteScope(self, name, warmup)
 
     def current_site(self) -> str:
         stack = getattr(self._tls, "stack", None)
-        return stack[-1] if stack else UNATTRIBUTED
+        return stack[-1][0] if stack else UNATTRIBUTED
+
+    def _current_scope(self):
+        stack = getattr(self._tls, "stack", None)
+        return stack[-1] if stack else (UNATTRIBUTED, False)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -137,15 +148,15 @@ class RecompileSentinel:
     def _on_event(self, event: str, duration_secs: float, **kw) -> None:
         if not self._active or event != BACKEND_COMPILE_EVENT:
             return
-        site = self.current_site()
+        site, expected = self._current_scope()
         now = time.monotonic()
         storm = None
         with self._lock:
             self.total_compiles += 1
             n = self._per_site.get(site, 0) + 1
             self._per_site[site] = n
-            if n > self.warmup and (site != UNATTRIBUTED
-                                    or self.storm_all_sites):
+            if n > self.warmup and not expected \
+                    and (site != UNATTRIBUTED or self.storm_all_sites):
                 window = self._post_warmup.setdefault(site, deque())
                 window.append(now)
                 while window and now - window[0] > self.storm_window_s:
@@ -161,6 +172,17 @@ class RecompileSentinel:
             self._reg.counter(f"compile[{site}].count").inc()
             self._reg.histogram("compile.duration_ms").observe(
                 duration_secs * 1e3)
+            # scrapeable per-site attribution: the bracket=pair grammar
+            # renders as recompiles_total{site="..."} on /metrics (both
+            # the engine surface and the cluster fleet fold), where the
+            # compile[<site>].count spelling above becomes a label on
+            # the *compile_count* family keyed by the dotted head.  The
+            # reserved grammar chars ("[],=") are squashed exactly like
+            # aggregate._label_value so wire snapshots round-trip.
+            site_l = site
+            for ch in "[],=":
+                site_l = site_l.replace(ch, "_")
+            self._reg.counter(f"recompiles_total[site={site_l}]").inc()
         if self._tel is not None:
             self._tel.emit({"event": "compile", "site": site,
                             "duration_ms": round(duration_secs * 1e3, 3),

@@ -27,6 +27,7 @@ from __future__ import annotations
 import time
 from typing import Any, Callable, Optional
 
+from . import _state
 from .mfu import flops_per_token_of, peak_flops
 from .spans import span
 
@@ -150,6 +151,23 @@ class StepMonitor:
                         ev["tokens_per_sec"])
                 if "mfu" in ev:
                     reg.gauge(f"step[{site}].mfu").set(ev["mfu"])
+                # roofline attribution: measured interval vs this
+                # site's compiled-program analytic minimum (ledger
+                # rows land under the SAME site string because
+                # timed_step wraps the thunk in sent.site(site)).
+                # Unlike mfu this also sees the bandwidth-bound limit,
+                # so a memory-bound step can read 0.9 roofline at 0.1
+                # MFU — that gap IS the diagnosis.
+                led = _state.LEDGER[0]
+                if led is not None:
+                    min_ms = led.min_ms_for(site)
+                    if min_ms and interval_s > 0:
+                        ev["roofline_frac"] = round(
+                            min_ms / (interval_s * 1e3), 4)
+                        reg.gauge(f"train.roofline[{site}].frac").set(
+                            ev["roofline_frac"])
+                        reg.gauge(f"train.roofline[{site}].min_ms").set(
+                            round(min_ms, 6))
         self._tel.emit(ev)
 
     def _flops_per_token(self, info, model, seq):

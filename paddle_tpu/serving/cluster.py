@@ -760,6 +760,17 @@ class ClusterController:
             except (ValueError, UnicodeDecodeError):
                 continue
             if isinstance(snap.get("metrics"), dict):
+                # the hbm block (ServingWorker.publish_telemetry) folds
+                # like any other gauge family: per-worker serve.hbm.*
+                # series on the one fleet /metrics surface.  setdefault
+                # — a registry-carried series of the same name wins.
+                hbm = snap.get("hbm")
+                if isinstance(hbm, dict):
+                    for k, v in hbm.items():
+                        if isinstance(v, (int, float)):
+                            snap["metrics"].setdefault(
+                                f"serve.hbm.{k}",
+                                {"kind": "gauge", "value": v})
                 snaps[wid] = snap
         reg = obs.get_registry()
         if reg is not None:

@@ -477,6 +477,10 @@ class Engine:
             collections.deque()                  # guarded_by: _lock
         self.handoffs = 0            # lifetime prefill-complete handoffs
         self._warmed = False
+        # analytic roofline minimums per warmup program (filled by
+        # _publish_compiled_obs when the compiled-artifact ledger is
+        # active; None keeps the disabled path at one falsy check)
+        self._roofline_min_ms: Optional[Dict[str, float]] = None
         self._build_fns()
 
     # -- compiled paths ----------------------------------------------------
@@ -558,35 +562,112 @@ class Engine:
         warmup traffic's writes are dropped — no allocator interaction,
         no pool pollution.  After this, serving traffic compiles NOTHING
         — preemption, restore, and fault-isolation churn included (the
-        serving-smoke and chaos-serving gates' contract)."""
+        serving-smoke and chaos-serving gates' contract).
+
+        Each compile group runs inside a recompile-sentinel site scope
+        (serve.step / serve.cow / serve.swap / serve.lora) so the
+        compiled-artifact ledger's rows land with attribution — a pure
+        labelling change; the program set and compile count are
+        byte-for-byte the pre-ledger warmup's."""
+        tel = obs.get_telemetry()
+        sent = tel.sentinel if tel is not None else None
+
+        def _site(name):
+            # warmup=True: these compiles are the expected one-per-group
+            # set — attributed and counted, but never storm candidates
+            # (a process may legitimately warm many engines)
+            return sent.site(name, warmup=True) if sent is not None \
+                else contextlib.nullcontext()
+
         with span("serve.warmup"), self._trace_mesh():
             b, mb, c = self.max_batch, self.max_blocks_per_seq, \
                 self.prefill_chunk
             oob = np.full((b, mb), self.kv.oob_block, np.int32)
             zeros_i = np.zeros((b,), np.int32)
-            nxt, caches = self._step_fn(
-                self.params, self.kv.caches,
-                jnp.asarray(np.zeros((b, c), np.int32)), jnp.asarray(oob),
-                jnp.asarray(zeros_i), jnp.asarray(zeros_i),
-                jnp.asarray(np.zeros((b,), np.float32)),
-                self._key, jnp.asarray(zeros_i), jnp.asarray(zeros_i),
-                self._lora_stacks(), jnp.asarray(zeros_i))
-            jax.block_until_ready(nxt)
+            with _site("serve.step"):
+                nxt, caches = self._step_fn(
+                    self.params, self.kv.caches,
+                    jnp.asarray(np.zeros((b, c), np.int32)),
+                    jnp.asarray(oob),
+                    jnp.asarray(zeros_i), jnp.asarray(zeros_i),
+                    jnp.asarray(np.zeros((b,), np.float32)),
+                    self._key, jnp.asarray(zeros_i), jnp.asarray(zeros_i),
+                    self._lora_stacks(), jnp.asarray(zeros_i))
+                jax.block_until_ready(nxt)
             self.kv.caches = caches
             pad = np.full((b,), self.kv.oob_block, np.int32)
-            caches = self._cow_fn(self.kv.caches, jnp.asarray(pad),
-                                  jnp.asarray(pad))
-            jax.block_until_ready(jax.tree_util.tree_leaves(caches)[0])
+            with _site("serve.cow"):
+                caches = self._cow_fn(self.kv.caches, jnp.asarray(pad),
+                                      jnp.asarray(pad))
+                jax.block_until_ready(
+                    jax.tree_util.tree_leaves(caches)[0])
             self.kv.caches = caches
-            self._swap.warmup()
+            with _site("serve.swap"):
+                self._swap.warmup()
             if self.lora is not None:
                 # compile the pool's per-slot scatter programs here so
                 # hot-load/evict under churn stays at 0 compiles
-                self.lora.prime_updates()
+                with _site("serve.lora"):
+                    self.lora.prime_updates()
         # only AFTER the work: a failed warmup must leave step_begin's
         # auto-warmup safety net armed for mesh engines
         self._warmed = True
+        self._publish_compiled_obs()
         return self
+
+    def hbm_stats(self) -> Dict[str, int]:
+        """Live HBM accounting: bytes owned by each device-resident
+        pool — ``kv_pool_bytes`` (the paged KV pools), ``lora_pool_bytes``
+        (stacked adapter pools), ``param_bytes`` (serving weights) —
+        plus ``peak_temp_bytes``, the largest XLA scratch allocation any
+        compiled program needs while running (from the compiled-artifact
+        ledger's memory_analysis; 0 when telemetry is off).  Pure buffer
+        arithmetic — safe without telemetry, used by worker exit
+        reports."""
+
+        def _nbytes(tree) -> int:
+            return sum(int(getattr(leaf, "nbytes", 0) or 0)
+                       for leaf in jax.tree_util.tree_leaves(tree))
+
+        stats = {"kv_pool_bytes": int(self.kv.nbytes()),
+                 "lora_pool_bytes": _nbytes(self._lora_stacks()),
+                 "param_bytes": _nbytes(self.params),
+                 "peak_temp_bytes": 0}
+        led = _obs_state.LEDGER[0]
+        if led is not None:
+            stats["peak_temp_bytes"] = max(
+                (r.get("temp_bytes", 0) for r in led.snapshot()),
+                default=0)
+        return stats
+
+    def _publish_compiled_obs(self) -> None:
+        """Post-warmup: the ``serve.hbm.*`` gauge block and per-program
+        analytic roofline minimums (``serve.roofline.<prog>.min_ms``)
+        from the compiled-artifact ledger.  Cold path (runs once per
+        warmup); with telemetry off it is exactly two falsy checks."""
+        reg = obs.get_registry()
+        led = _obs_state.LEDGER[0]
+        if reg is None and led is None:
+            return
+        hbm = self.hbm_stats()
+        if led is not None:
+            # snapshot for exit reports / postmortems: the memory
+            # picture survives even after the engine is gone
+            led.set_hbm(hbm)
+            mins: Dict[str, float] = {}
+            for key, site in (("step", "serve.step"),
+                              ("cow", "serve.cow"),
+                              ("swap", "serve.swap"),
+                              ("lora", "serve.lora")):
+                m = led.min_ms_for(site)
+                if m:
+                    mins[key] = m
+            self._roofline_min_ms = mins
+        if reg is not None:
+            for k, v in hbm.items():
+                reg.gauge(f"serve.hbm.{k}").set(v)
+            for key, m in (self._roofline_min_ms or {}).items():
+                reg.gauge(f"serve.roofline.{key}.min_ms").set(round(m, 6))
 
     # -- request lifecycle -------------------------------------------------
 
@@ -1297,6 +1378,23 @@ class Engine:
             reg.gauge("serve.shared_blocks").set(
                 sum(s.num_shared - s.num_cowed
                     for _, s in self.scheduler.active()))
+            # roofline attribution: measured step wall vs the analytic
+            # minimum of the ONE compiled step program (constant per
+            # warmup — serve.roofline.step.min_ms).  frac is limit over
+            # measured (1.0 = running at the hardware roofline); the
+            # step is classed prefill or decode by which token kind
+            # dominated its span plan, so the two regimes' distance
+            # from the limit is scrapeable separately.
+            rf = self._roofline_min_ms
+            if rf is not None:
+                m = rf.get("step")
+                if m:
+                    frac = round(m / max(dt * 1e3, 1e-9), 4)
+                    n_pref = sum(n for _, _, n, p in plan if p)
+                    cls = "prefill" if 2 * n_pref >= live_tokens \
+                        else "decode"
+                    reg.gauge("serve.roofline.step.frac").set(frac)
+                    reg.gauge(f"serve.roofline.{cls}.frac").set(frac)
         if plan:
             obs.emit_event("serve_step", ms=round(dt * 1e3, 3),
                            tokens=n_tok, span_tokens=live_tokens,

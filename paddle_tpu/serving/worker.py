@@ -304,6 +304,12 @@ class ServingWorker:
                 "role": self.role, "epoch": self.epoch,
                 "clock_offset": self.clock_offset,
                 "metrics": registry_to_wire(reg)}
+        led = obs.get_ledger()
+        if led is not None and led.hbm:
+            # live HBM block (engine warmup's pool accounting): the
+            # controller folds it into per-worker serve.hbm.* series
+            # on the cluster /metrics surface
+            snap["hbm"] = led.hbm
         self.store.set(f"{self.prefix}/telemetry/{self.worker_id}",
                        json.dumps(snap).encode())
         return True
@@ -697,6 +703,13 @@ class ServingWorker:
                 "telemetry": registry_to_wire(reg)
                 if (reg := obs.get_registry()) is not None
                 else None,
+                # memory + compiled-program picture at exit: an on-chip
+                # OOM or stall postmortem can say which pool/program
+                # owned the bytes without the worker still being alive
+                "hbm": led.hbm or None
+                if (led := obs.get_ledger()) is not None else None,
+                "compiled_artifacts": led.snapshot()
+                if led is not None else None,
                 "fired": [list(f) for f in getattr(
                     _rs_state.FAULTS[0], "fired", [])]
                 if _rs_state.FAULTS[0] is not None else []}

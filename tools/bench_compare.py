@@ -1,0 +1,282 @@
+#!/usr/bin/env python
+"""Perf-regression ledger: fold BENCH rounds into one trajectory and
+gate fresh runs against a committed baseline.
+
+Two jobs (docs/BENCH.md "Trajectory"):
+
+1. **Trajectory fold** — every ``BENCH_r*.json`` driver artifact plus
+   any ``bench_telemetry*.jsonl`` sidecar (their ``bench_result``
+   events carry the same payload) becomes one table: per-row series
+   across rounds, best, last, delta vs baseline.  ``--md`` prints it
+   as markdown for docs/BENCH.md.
+
+2. **Regression check** — ``--check --fresh RUN.json`` compares a
+   fresh bench run against ``tools/bench_baseline.json`` rows (each
+   ``{"value", "band", "better"}``) and exits nonzero if any row is
+   worse than ``value`` by more than its fractional noise ``band``.
+   The committed baseline covers the CPU-plumbing rows (the ones every
+   environment can reproduce); TPU rows join when a proof round lands.
+   Wired as the ``bench-regression`` CI gate (tools/ci.py).
+
+Provenance: rounds since r06 carry ``extra.provenance`` (git_sha, jax,
+device, fused — stamped by bench.py); r01–r05 predate it and are
+backfilled from their loose ``extra`` fields, so parsing never assumes
+the block exists.
+
+Stdlib-only; loads standalone (no package import, no jax).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(REPO, "tools", "bench_baseline.json")
+
+# row-name → comparison direction.  Substring matching, first hit wins;
+# rows matching nothing are informational (folded, never gated).
+_HIGHER = ("mfu", "tok_s", "tokens_per_sec", "hit_rate", "accept_rate",
+           "goodput", "vs_")
+_LOWER = ("ms_per_step", "ms_per_token", "ttft", "_ms")
+
+
+def direction(row: str) -> Optional[str]:
+    """"higher" / "lower" (better) / None (informational) for a row."""
+    low = row.lower()
+    for pat in _HIGHER:
+        if pat in low:
+            return "higher"
+    for pat in _LOWER:
+        if pat in low:
+            return "lower"
+    return None
+
+
+def _backfill_provenance(extra: dict) -> dict:
+    """Attribution for pre-provenance artifacts (r01–r05): pull what
+    their loose extra fields carried; everything else stays null."""
+    return {"git_sha": None, "jax": None,
+            "backend": extra.get("backend"),
+            "device": extra.get("device"),
+            "fused": extra.get("fused")}
+
+
+def _rows_of(parsed: dict) -> Dict[str, float]:
+    """Flatten one bench payload into comparable scalar rows: the
+    headline metric plus every numeric ``extra`` field (nested detail
+    dicts, window lists, and strings are context, not rows)."""
+    rows: Dict[str, float] = {}
+    if isinstance(parsed.get("value"), (int, float)):
+        rows[str(parsed.get("metric", "value"))] = float(parsed["value"])
+    extra = parsed.get("extra") or {}
+    for k, v in extra.items():
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        rows[k] = float(v)
+    return rows
+
+
+def load_round(path: str) -> List[dict]:
+    """Parse one artifact into round dicts ``{"label", "rows",
+    "provenance"}``.  Accepts the driver format (``BENCH_r*.json``:
+    ``{"n", "parsed": {...}}``), a raw bench.py stdout line, or a
+    telemetry sidecar (``*.jsonl`` — one round per ``bench_result``
+    event).  Unparseable files yield ``[]``, never raise: the
+    trajectory must survive a truncated round."""
+    out: List[dict] = []
+    base = os.path.basename(path)
+    try:
+        with open(path) as f:
+            if path.endswith(".jsonl"):
+                payloads = []
+                for line in f:
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(ev, dict) \
+                            and ev.get("event") == "bench_result":
+                        payloads.append((base, ev))
+            else:
+                doc = json.load(f)
+                label = base
+                if isinstance(doc, dict) and "parsed" in doc:
+                    label = f"r{int(doc.get('n', 0)):02d}" \
+                        if doc.get("n") else base
+                    doc = doc.get("parsed")
+                payloads = [(label, doc)] if isinstance(doc, dict) else []
+    except (OSError, ValueError):
+        return []
+    for label, parsed in payloads:
+        extra = parsed.get("extra") or {}
+        prov = extra.get("provenance")
+        if not isinstance(prov, dict):
+            prov = _backfill_provenance(extra)
+        rows = _rows_of(parsed)
+        if rows:
+            out.append({"label": label, "rows": rows,
+                        "provenance": prov})
+    return out
+
+
+def fold_trajectory(rounds: List[dict],
+                    baseline: Optional[dict] = None) -> dict:
+    """All rounds → ``{row: {"series", "best", "last", "dir",
+    "baseline", "delta_vs_baseline"}}``.  ``best`` honors the row's
+    direction (None direction → best is last).  ``delta_vs_baseline``
+    is fractional: +0.1 = 10% better than baseline."""
+    table: Dict[str, dict] = {}
+    base_rows = (baseline or {}).get("rows", {})
+    for rnd in rounds:
+        for row, v in rnd["rows"].items():
+            ent = table.setdefault(
+                row, {"series": [], "dir": direction(row)})
+            ent["series"].append((rnd["label"], v))
+    for row, ent in table.items():
+        vals = [v for _, v in ent["series"]]
+        ent["last"] = vals[-1]
+        if ent["dir"] == "higher":
+            ent["best"] = max(vals)
+        elif ent["dir"] == "lower":
+            ent["best"] = min(vals)
+        else:
+            ent["best"] = vals[-1]
+        b = base_rows.get(row)
+        if isinstance(b, dict) and isinstance(b.get("value"),
+                                              (int, float)) \
+                and b["value"] != 0:
+            ent["baseline"] = float(b["value"])
+            delta = (ent["last"] - ent["baseline"]) / abs(ent["baseline"])
+            if ent["dir"] == "lower":
+                delta = -delta
+            ent["delta_vs_baseline"] = round(delta, 4)
+    return table
+
+
+def check(fresh_rows: Dict[str, float], baseline: dict
+          ) -> Tuple[bool, List[str]]:
+    """Gate a fresh run: every baseline row present in the run must not
+    be worse than ``value`` by more than ``band`` (fractional).  Rows
+    the fresh run lacks are reported but do not fail (a CPU run cannot
+    produce TPU rows); rows without a direction never gate."""
+    lines: List[str] = []
+    ok = True
+    for row, spec in sorted(baseline.get("rows", {}).items()):
+        base_v = spec.get("value")
+        band = float(spec.get("band", 0.25))
+        better = spec.get("better") or direction(row)
+        if not isinstance(base_v, (int, float)) or base_v == 0:
+            continue
+        v = fresh_rows.get(row)
+        if v is None:
+            lines.append(f"  skip  {row}: not in fresh run")
+            continue
+        if better == "higher":
+            worse_by = (base_v - v) / abs(base_v)
+        elif better == "lower":
+            worse_by = (v - base_v) / abs(base_v)
+        else:
+            continue
+        verdict = "OK"
+        if worse_by > band:
+            verdict = "REGRESSION"
+            ok = False
+        lines.append(f"  {verdict:<10} {row}: fresh={v:.6g} "
+                     f"baseline={base_v:.6g} band=±{band:.0%} "
+                     f"worse_by={worse_by:+.1%}")
+    return ok, lines
+
+
+def render_md(table: dict, max_series: int = 6) -> str:
+    """The docs/BENCH.md trajectory section: one markdown table, rows
+    sorted, series truncated to the last ``max_series`` rounds."""
+    lines = ["| row | series (last {}) | best | last | Δ vs baseline |"
+             .format(max_series),
+             "|---|---|---|---|---|"]
+    for row in sorted(table):
+        ent = table[row]
+        ser = " → ".join(f"{v:.4g}"
+                         for _, v in ent["series"][-max_series:])
+        delta = ent.get("delta_vs_baseline")
+        dcell = f"{delta:+.1%}" if delta is not None else "—"
+        lines.append(f"| `{row}` | {ser} | {ent['best']:.4g} "
+                     f"| {ent['last']:.4g} | {dcell} |")
+    return "\n".join(lines)
+
+
+def _fresh_round_from(path: str) -> Optional[dict]:
+    rounds = load_round(path)
+    return rounds[-1] if rounds else None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("artifacts", nargs="*",
+                    help="BENCH_r*.json / bench stdout JSON / telemetry "
+                         "sidecar .jsonl (default: repo BENCH_r*.json)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--check", action="store_true",
+                    help="gate --fresh (or the newest artifact) against "
+                         "the baseline; exit 1 on regression")
+    ap.add_argument("--fresh", default=None,
+                    help="fresh bench run to gate (with --check)")
+    ap.add_argument("--md", action="store_true",
+                    help="print the trajectory as markdown")
+    args = ap.parse_args(argv)
+
+    baseline = {}
+    try:
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+    except (OSError, ValueError):
+        pass
+
+    paths = args.artifacts or sorted(
+        glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    rounds: List[dict] = []
+    for p in paths:
+        rounds.extend(load_round(p))
+
+    if args.check:
+        fresh_path = args.fresh or (paths[-1] if paths else None)
+        if not fresh_path:
+            print("bench_compare: no fresh run to check", file=sys.stderr)
+            return 2
+        fresh_rnd = _fresh_round_from(fresh_path)
+        if fresh_rnd is None:
+            print(f"bench_compare: no rows parsed from {fresh_path}",
+                  file=sys.stderr)
+            return 2
+        # numbers only compare within a platform: a TPU run shares row
+        # NAMES (ms_per_step, ...) with the CPU baseline but not scales,
+        # so a backend mismatch gates nothing rather than everything
+        base_be = baseline.get("backend")
+        fresh_be = (fresh_rnd.get("provenance") or {}).get("backend")
+        if base_be and fresh_be and base_be != fresh_be:
+            print(f"bench_compare: backend mismatch (fresh={fresh_be}, "
+                  f"baseline={base_be}) — nothing to gate")
+            print("bench_compare: PASS")
+            return 0
+        ok, lines = check(fresh_rnd["rows"], baseline)
+        print(f"bench_compare --check: {os.path.basename(fresh_path)} "
+              f"vs {os.path.basename(args.baseline)}")
+        for ln in lines:
+            print(ln)
+        print("bench_compare: PASS" if ok else "bench_compare: FAIL")
+        return 0 if ok else 1
+
+    table = fold_trajectory(rounds, baseline)
+    if args.md:
+        print(render_md(table))
+    else:
+        print(json.dumps(table, indent=2, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -255,6 +255,12 @@ def gate_telemetry_overhead(iters: int = 100_000,
     # same contract: with telemetry disabled no sketch is observed or
     # merged, no registry is folded to the wire, no segments stitched
     from paddle_tpu.observability import aggregate as obs_agg
+    # the compiled-artifact ledger rides the contract too: with
+    # telemetry disabled no row is recorded or read, no roofline is
+    # evaluated, no HBM snapshot is taken (its compile-path capture is
+    # a method wrap that only exists while enabled — zero checks, not
+    # even one)
+    from paddle_tpu.observability import compiled as obs_compiled
     poisoned = [(obs.MetricsRegistry, n) for n in
                 ("counter", "gauge", "histogram")] + \
                [(obs.Telemetry, "emit")] + \
@@ -264,7 +270,11 @@ def gate_telemetry_overhead(iters: int = 100_000,
                 ("observe", "merge")] + \
                [(obs_agg, n) for n in
                 ("registry_to_wire", "fleet_fold",
-                 "stitch_trace_segments")]
+                 "stitch_trace_segments")] + \
+               [(obs.CompiledArtifactLedger, n) for n in
+                ("record_executable", "snapshot", "min_ms_for",
+                 "rows_for", "set_hbm")] + \
+               [(obs_compiled, n) for n in ("roofline", "chip_spec")]
     for cls, name in poisoned:
         saved[(cls, name)] = getattr(cls, name)
         setattr(cls, name, boom)
@@ -587,12 +597,24 @@ def gate_telemetry_overhead(iters: int = 100_000,
              "RECORDER": obs_state.RECORDER[0],
              "POSTMORTEM": obs_state.POSTMORTEM[0],
              "TRACE": obs_state.TRACE[0],
+             "LEDGER": obs_state.LEDGER[0],
              "FAULTS": rs_state.FAULTS[0]}
     stale = [k for k, v in hooks.items() if v is not None]
     if stale:
         print(f"telemetry-overhead gate FAILED: disable() left hook "
               f"containers set: {stale}")
         return 1
+    # the ledger's compile wrap must not outlive the session either:
+    # disable() restores pxla.MeshComputation.compile verbatim
+    try:
+        from jax._src.interpreters import pxla
+        if pxla.MeshComputation.compile.__name__ == "_ledger_compile":
+            print("telemetry-overhead gate FAILED: disable() left the "
+                  "compiled-artifact ledger's compile wrap installed "
+                  "(observability/compiled.py uninstall)")
+            return 1
+    except ImportError:
+        pass
     if tel.watchdog is None or tel.watchdog._thread is not None:
         print("telemetry-overhead gate FAILED: disable() left the hang "
               "watchdog thread running")
@@ -2306,6 +2328,90 @@ def gate_serving_cluster(n_prefill: int = 2, n_decode: int = 2) -> int:
     return 0
 
 
+def gate_bench_regression(timeout_s: float = 120.0) -> int:
+    """bench-regression gate: the perf-regression ledger's check mode
+    (tools/bench_compare.py --check vs tools/bench_baseline.json) must
+    PASS on the committed seed numbers and FAIL on an injected 2×
+    CPU-plumbing slowdown — both enforced end-to-end through the CLI's
+    exit code, so the gate catches a broken comparator as loudly as a
+    broken bench.  When the driver provides a real fresh run
+    (``PDTPU_BENCH_FRESH=<bench stdout JSON>``) that run is gated too.
+    """
+    import tempfile
+
+    baseline_path = os.path.join(HERE, "bench_baseline.json")
+    try:
+        with open(baseline_path) as f:
+            rows = json.load(f).get("rows") or {}
+    except (OSError, ValueError) as e:
+        print(f"bench-regression gate FAILED: unreadable baseline "
+              f"{baseline_path}: {e}")
+        return 1
+    gated = {k: s for k, s in rows.items()
+             if isinstance(s.get("value"), (int, float))
+             and s.get("better") in ("higher", "lower")}
+    if not gated:
+        print("bench-regression gate FAILED: baseline carries no "
+              "gateable rows (tools/bench_baseline.json)")
+        return 1
+
+    def _payload(vals: dict) -> dict:
+        extra = {k: v for k, v in vals.items()
+                 if k != "llama_train_mfu"}
+        return {"metric": "llama_train_mfu",
+                "value": vals.get("llama_train_mfu", 0.0),
+                "unit": "mfu_fraction", "extra": extra}
+
+    seed_vals = {k: s["value"] for k, s in gated.items()}
+    slowed = dict(seed_vals)
+    # inject a 2× slowdown into the first CPU-plumbing throughput row:
+    # halved tok/s (or doubled ms) is exactly the regression the
+    # acceptance contract names
+    victim = sorted(gated)[0]
+    if gated[victim]["better"] == "higher":
+        slowed[victim] = seed_vals[victim] / 2.0
+    else:
+        slowed[victim] = seed_vals[victim] * 2.0
+
+    compare = os.path.join(HERE, "bench_compare.py")
+    with tempfile.TemporaryDirectory() as td:
+        cases = [("seed", _payload(seed_vals), 0),
+                 ("slowed-2x", _payload(slowed), 1)]
+        for name, payload, want_rc in cases:
+            p = os.path.join(td, f"{name}.json")
+            with open(p, "w") as f:
+                json.dump(payload, f)
+            r = subprocess.run(
+                [sys.executable, compare, "--check", "--fresh", p,
+                 "--baseline", baseline_path],
+                capture_output=True, text=True, timeout=timeout_s)
+            ok = (r.returncode == 0) == (want_rc == 0)
+            print(f"bench-regression: {name} run → rc={r.returncode} "
+                  f"(want {'0' if want_rc == 0 else 'nonzero'})")
+            if not ok:
+                sys.stdout.write(r.stdout)
+                sys.stderr.write(r.stderr)
+                print(f"bench-regression gate FAILED: --check "
+                      f"{'passed' if r.returncode == 0 else 'failed'} "
+                      f"on the {name} numbers "
+                      f"(injected victim row: {victim})")
+                return 1
+
+    fresh = os.environ.get("PDTPU_BENCH_FRESH")
+    if fresh:
+        r = subprocess.run(
+            [sys.executable, compare, "--check", "--fresh", fresh,
+             "--baseline", baseline_path],
+            capture_output=True, text=True, timeout=timeout_s)
+        sys.stdout.write(r.stdout)
+        if r.returncode != 0:
+            print(f"bench-regression gate FAILED: fresh run {fresh} "
+                  "regressed vs tools/bench_baseline.json")
+            return 1
+    print("bench-regression gate OK")
+    return 0
+
+
 def gate_lint(timeout_s: float = 120.0) -> int:
     """Lint gate: pdtpu-lint runs clean over the whole tree with NO jax
     import (subprocess, bare env — the analyzer must work on a jax-less
@@ -2343,6 +2449,7 @@ GATES = {
     "serving-dist": gate_serving_dist,
     "serving-disagg": gate_serving_disagg,
     "serving-cluster": gate_serving_cluster,
+    "bench-regression": gate_bench_regression,
 }
 
 
