@@ -20,8 +20,9 @@ Spec grammar (code or the ``PDTPU_FAULTS`` env var)::
     site    = ckpt.save | ckpt.load | collective | step | store.get | store.set
             | serve.admit | serve.prefill | serve.step | serve.cow | serve.swap
             | serve.route | serve.replica | serve.spec
-            | serve.xfer.put | serve.xfer.get
+            | serve.xfer.put | serve.xfer.get | serve.gateway
             | cluster.register | cluster.lease | cluster.command
+            | cluster.journal | cluster.takeover
     index   = 0-based per-site call counter value at which firing starts
     times   = number of consecutive calls that fire (default 1)
     exc     = InjectedFault | RuntimeError | OSError | ConnectionError
@@ -82,12 +83,25 @@ __all__ = ["SITES", "InjectedFault", "FaultPlan", "FaultInjector",
 #: worker stops acting on its epoch and rejoins fresh), while a command
 #: fault requeues the command for the next loop iteration (commands are
 #: idempotent per epoch — the ``serving-cluster`` CI gate's contract).
+#: ``cluster.journal`` fires inside the controller's retried
+#: admission-journal write (``ClusterController.submit`` CAS-writes
+#: ``journal/<rid>`` before returning): a transient fault is a logged
+#: retry, exhaustion rejects THAT submission typed — never a silently
+#: half-admitted request.  ``cluster.takeover`` fires in the standby
+#: controller's takeover path before the lease CAS: a fault aborts the
+#: attempt cleanly and the follower retries on its next pump (the
+#: zombie fence never depends on takeover succeeding first try).
+#: ``serve.gateway`` fires per gateway admission
+#: (``serving/gateway.py``), after policy shed checks and before the
+#: journal write: a fault sheds that ONE request as a typed 503 —
+#: the gateway process and its in-flight streams survive.
 SITES = ("ckpt.save", "ckpt.load", "collective", "step",
          "store.get", "store.set",
          "serve.admit", "serve.prefill", "serve.step", "serve.cow",
          "serve.swap", "serve.route", "serve.replica", "serve.spec",
-         "serve.xfer.put", "serve.xfer.get",
-         "cluster.register", "cluster.lease", "cluster.command")
+         "serve.xfer.put", "serve.xfer.get", "serve.gateway",
+         "cluster.register", "cluster.lease", "cluster.command",
+         "cluster.journal", "cluster.takeover")
 
 
 class InjectedFault(RuntimeError):

@@ -55,8 +55,14 @@ local Engine independently; a thin ``ClusterController`` routes
 admissions/handoffs through store-backed queues, evacuates dead or
 draining workers' requests from their last ``KVHandout`` snapshots,
 and drives SLO-based elasticity (``role_flip`` / ``drain`` /
-``rolling_upgrade``) — no shared driver, zero recompiles across
-membership churn.
+``rolling_upgrade``, plus ``WorkerSpawner`` scale-up/scale-down) — no
+shared driver, zero recompiles across membership churn.  The
+controller itself is as killable as the workers: ``submit`` journals
+every admission durably before returning, a standby under
+``ControllerLease`` takes over on lease staleness and replays the
+journal, and ``ClusterGateway`` is the HTTP front door over it all
+(SSE off fenced output records, ``Idempotency-Key`` dedupe, typed
+shed, graceful drain).
 
 Usage::
 
@@ -79,8 +85,9 @@ from __future__ import annotations
 
 from .block_allocator import (BlockAllocator, PagedKVCache,  # noqa: F401
                               PrefixCache, SwapManager)
-from .cluster import (ClusterController, LeaseLost,  # noqa: F401
-                      LeaseMonitor, StoreQueue)
+from .cluster import (ClusterController, ControllerLease,  # noqa: F401
+                      LeaseLost, LeaseMonitor, StoreQueue,
+                      WorkerSpawner)
 from .disagg import (DisaggReplicaSet, HeartbeatMonitor,  # noqa: F401
                      KVHandout, KVTransport, LoopbackTransport,
                      StoreTransport, TransferError)
@@ -93,6 +100,7 @@ from .errors import (AdapterInUse, AdmissionError,  # noqa: F401
 from .lora import LoRAPool, merge_adapter, random_adapter  # noqa: F401
 from .frontdoor import (Admission, FrontDoor, TenantPolicy,  # noqa: F401
                         TokenBucket)
+from .gateway import ClusterGateway  # noqa: F401
 from .scheduler import Request, RequestState, Scheduler  # noqa: F401
 from .server import ServingServer  # noqa: F401
 from .spec import NgramProposer  # noqa: F401

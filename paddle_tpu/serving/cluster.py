@@ -39,9 +39,42 @@ Design (docs/SERVING.md "Cluster serving"):
   CI gate pins: token-identity and zero recompiles across flips, kills
   and upgrades.
 
+- **The controller is as killable as the workers** (PR 19): ``submit``
+  CAS-writes a **durable admission journal** entry
+  (``journal/<rid>`` — prompt, params, tenant/adapter, client
+  idempotency key) *before* returning, unroutable refs mirror to
+  ``pend/<rid>``, and retirement writes a tombstone carrying the
+  output — so :meth:`ClusterController._recover` can rebuild the whole
+  admission surface from the store and a duplicate idempotency key
+  answers with the EXISTING rid/output (exactly-once at the client
+  surface).  A :class:`ControllerLease` on the same epoch-fenced CAS
+  primitive the workers use makes failover automatic: a standby
+  controller constructed with ``follower=True`` watches the lease,
+  takes over on staleness (``cluster_takeover``), replays the journal,
+  and bumps the **controller epoch** — stamped on every queue item,
+  command and assignment — so a zombie controller's late writes are
+  fenced by the workers exactly like stale worker epochs are today.
+  Request ids are salted with that epoch (``creq-<ctl>-<seq>``), so a
+  bounced controller can never re-issue a rid that collides with a
+  prior assignment.
+- **Scale-up beyond role flips**: with a pluggable
+  :class:`WorkerSpawner` attached, the autoscaler spawns a fresh
+  worker process (locally: ``python -m paddle_tpu.serving.worker``)
+  when an SLO breach persists with both tiers at the flip floor, and
+  drains the emptiest worker back out after a sustained idle run.
+
 Store schema (all under ``<prefix>/``, default ``cluster/``)::
 
     epoch                 global epoch counter (store.add)
+    ctl/epoch             controller epoch counter (store.add) — the
+                          rid salt + zombie fence token
+    ctl/lease             JSON {holder, epoch, t} — CAS-chained by the
+                          active controller (ControllerLease)
+    journal/<rid>         JSON admission journal entry; retirement
+                          overwrites it with a {done, tokens, reason}
+                          tombstone, reaped beyond journal_retention
+    jkey/<key>            idempotency-key index: key -> rid (CAS once)
+    pend/<rid>            JSON mirror of an unroutable pending ref
     workers/<wid>         JSON {role, epoch, pid, state, version}
     lease/<wid>           JSON {epoch, t} — CAS-chained by the worker;
                           the controller revokes with a tombstone
@@ -75,9 +108,13 @@ from __future__ import annotations
 
 import collections
 import json
+import os
+import socket
+import subprocess
+import sys
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -85,9 +122,12 @@ from .. import observability as obs
 from ..observability.aggregate import (fleet_fold, registry_to_wire,
                                        stitch_trace_segments)
 from ..observability.sinks import registry_to_prometheus
+from ..resilience import _state as _rs_state
+from ..resilience.retry import RetryPolicy
 from .disagg import HeartbeatMonitor, StoreTransport
 
-__all__ = ["ClusterController", "LeaseMonitor", "LeaseLost", "StoreQueue"]
+__all__ = ["ClusterController", "ControllerLease", "LeaseMonitor",
+           "LeaseLost", "StoreQueue", "WorkerSpawner"]
 
 
 class LeaseLost(RuntimeError):
@@ -213,6 +253,192 @@ class LeaseMonitor(HeartbeatMonitor):
         return out
 
 
+class ControllerLease:
+    """The controller-side twin of the worker lease: one CAS-chained
+    claim on ``<prefix>/ctl/lease`` deciding WHICH controller process
+    routes, fails and collects.
+
+    Same primitive, same rules as ``ServingWorker.renew_lease``: the
+    holder CAS-chains ``{holder, epoch, t}`` records (expected value is
+    its OWN previous write, so any other writer breaks the chain and
+    raises :class:`LeaseLost`); a standby judges staleness with the
+    lease-monitor rules (absent = free, unparsable = dead, old = dead)
+    and :meth:`acquire`\\ s over the observed value — the CAS makes the
+    takeover single-winner.  Every acquisition bumps the
+    ``ctl/epoch`` counter; the winner stamps that epoch on its queue
+    items / commands / assignments, which is what fences the previous
+    holder's late writes (workers drop items below the highest
+    controller epoch they have seen).
+
+    ``renew`` is interval-gated (``deadline_s / 3``) so the active
+    controller can call it every pump without a store round-trip per
+    pump."""
+
+    def __init__(self, store, *, prefix: str = "cluster",
+                 holder: Optional[str] = None,
+                 deadline_s: float = 10.0,
+                 interval_s: Optional[float] = None, clock=time.time):
+        self.store = store
+        self.prefix = prefix.rstrip("/")
+        self.key = f"{self.prefix}/ctl/lease"
+        self.epoch_key = f"{self.prefix}/ctl/epoch"
+        self.holder = holder or \
+            f"ctl-{socket.gethostname()}-{os.getpid()}"
+        self.deadline_s = float(deadline_s)
+        self.interval_s = float(deadline_s) / 3.0 \
+            if interval_s is None else float(interval_s)
+        self.clock = clock
+        self.epoch: Optional[int] = None
+        self._val: Optional[bytes] = None
+        self._last = 0.0
+
+    def observe(self) -> Optional[dict]:
+        """The current lease record (None when absent, ``{}`` when
+        unparsable/tombstoned — same vocabulary as the worker
+        monitor)."""
+        raw = self.store.get(self.key)
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw.decode())
+        except (ValueError, UnicodeDecodeError):
+            return {}
+
+    def stale(self) -> bool:
+        """True when the lease is up for grabs: absent, unparsable, or
+        older than ``deadline_s``."""
+        lease = self.observe()
+        if lease is None:
+            return True
+        try:
+            ts = float(lease["t"])
+        except (KeyError, TypeError, ValueError):
+            return True
+        return self.clock() - ts > self.deadline_s
+
+    def acquire(self) -> int:
+        """Claim the lease (fresh start or takeover): allocate a new
+        controller epoch and CAS over the observed value.  Raises
+        :class:`LeaseLost` when the lease is freshly held by someone
+        else, or when another standby won the CAS race."""
+        cur = self.store.get(self.key)
+        if cur is not None and not self.stale():
+            raise LeaseLost(
+                f"controller lease freshly held; {self.holder!r} "
+                f"cannot acquire")
+        epoch = int(self.store.add(self.epoch_key, 1))
+        new = json.dumps({"holder": self.holder, "epoch": epoch,
+                          "t": self.clock()}).encode()
+        if not self.store.compare_set(self.key,
+                                      cur if cur is not None else b"",
+                                      new):
+            raise LeaseLost(
+                f"controller lease CAS lost: another standby took "
+                f"over before {self.holder!r}")
+        self.epoch = epoch
+        self._val = new
+        self._last = self.clock()
+        return epoch
+
+    def renew(self, *, force: bool = False) -> None:
+        """CAS-chain the lease (interval-gated).  A broken chain — a
+        standby took over while this process was dark — raises
+        :class:`LeaseLost`: the caller is a zombie and must stop
+        routing immediately."""
+        if self._val is None:
+            raise LeaseLost(f"{self.holder!r} holds no controller lease")
+        now = self.clock()
+        if not force and now - self._last < self.interval_s:
+            return
+        new = json.dumps({"holder": self.holder, "epoch": self.epoch,
+                          "t": now}).encode()
+        if not self.store.compare_set(self.key, self._val, new):
+            self._val = None
+            raise LeaseLost(
+                f"controller {self.holder!r} lost the lease for epoch "
+                f"{self.epoch} (superseded)")
+        self._val = new
+        self._last = now
+
+    def release(self) -> None:
+        """Graceful handover: tombstone the lease so a standby takes
+        over immediately instead of waiting out the deadline."""
+        if self._val is None:
+            return
+        self.store.compare_set(self.key, self._val,
+                               f"released:{self.epoch}".encode())
+        self._val = None
+
+
+class WorkerSpawner:
+    """Scale-up beyond role flips: launches fresh ``serving.worker``
+    OS processes for the autoscaler (docs/SERVING.md "Elasticity").
+
+    The default implementation runs ``python -m
+    paddle_tpu.serving.worker`` subprocesses on the local host; the
+    controller only calls :meth:`spawn` / :meth:`reap`, so a
+    deployment substitutes any duck-typed spawner (k8s pod create, MIG
+    resize, ...).  A spawned worker *adopts itself*: it registers with
+    the store under a fresh epoch like any other worker — the
+    controller sees it appear in the membership view and starts
+    routing to it, with no side channel."""
+
+    def __init__(self, store_addr: str, factory: str, *,
+                 prefix: str = "cluster",
+                 python: Optional[str] = None,
+                 lease_deadline_s: float = 10.0,
+                 extra_args: Tuple[str, ...] = (),
+                 env: Optional[dict] = None,
+                 cwd: Optional[str] = None):
+        self.store_addr = store_addr
+        self.factory = factory
+        self.prefix = prefix
+        self.python = python or sys.executable
+        self.lease_deadline_s = float(lease_deadline_s)
+        self.extra_args = tuple(extra_args)
+        self.env = env
+        self.cwd = cwd
+        self.procs: Dict[str, subprocess.Popen] = {}
+        self._seq = 0
+
+    def spawn(self, role: str) -> str:
+        """Launch one worker of ``role``; returns its worker id (the
+        spawned process registers under it on its own)."""
+        wid = f"spawn-{role}-{os.getpid()}-{self._seq}"
+        self._seq += 1
+        cmd = [self.python, "-m", "paddle_tpu.serving.worker",
+               "--store", self.store_addr, "--role", role,
+               "--factory", self.factory, "--worker-id", wid,
+               "--prefix", self.prefix,
+               "--lease-deadline-s", str(self.lease_deadline_s),
+               *self.extra_args]
+        self.procs[wid] = subprocess.Popen(
+            cmd, env=self.env, cwd=self.cwd)
+        return wid
+
+    def reap(self) -> Dict[str, int]:
+        """Collect exited spawned processes: ``wid -> returncode``."""
+        done = {}
+        for wid, p in list(self.procs.items()):
+            rc = p.poll()
+            if rc is not None:
+                done[wid] = rc
+                del self.procs[wid]
+        return done
+
+    def terminate_all(self, *, timeout_s: float = 10.0) -> None:
+        for p in self.procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=timeout_s)
+        self.procs.clear()
+
+
 # ---------------------------------------------------------------------------
 # admission wire helpers (shared with serving/worker.py)
 # ---------------------------------------------------------------------------
@@ -274,6 +500,14 @@ class ClusterController:
                  straggler_windows: int = 3,
                  straggler_min_ms: float = 1.0,
                  trace_retention: int = 1024,
+                 journal_retention: int = 1024,
+                 lease: Optional[ControllerLease] = None,
+                 follower: bool = False,
+                 retry: Optional[RetryPolicy] = None,
+                 spawner: Optional[WorkerSpawner] = None,
+                 max_workers: int = 8,
+                 spawn_breach_windows: int = 3,
+                 scale_down_windows: int = 8,
                  sleep: Callable[[float], None] = time.sleep):
         self.store = store
         self.prefix = prefix.rstrip("/")
@@ -293,6 +527,14 @@ class ClusterController:
         self.straggler_windows = max(1, int(straggler_windows))
         self.straggler_min_ms = float(straggler_min_ms)
         self.trace_retention = int(trace_retention)
+        self.journal_retention = int(journal_retention)
+        self.lease = lease
+        self.follower = bool(follower)
+        self.retry = retry if retry is not None else RetryPolicy()
+        self.spawner = spawner
+        self.max_workers = int(max_workers)
+        self.spawn_breach_windows = max(1, int(spawn_breach_windows))
+        self.scale_down_windows = max(1, int(scale_down_windows))
         self._sleep = sleep
         self._handoff_q = StoreQueue(store, f"{self.prefix}/q/handoffs")
         self._evac_q = StoreQueue(store, f"{self.prefix}/q/evac")
@@ -302,16 +544,20 @@ class ClusterController:
         self._payloads: Dict[str, list] = {}   # rid -> [(xfer key, nbytes)]
         self._outs: Dict[str, dict] = {}
         self._pending: List[dict] = []         # refs with no target yet
+        self._pended: set = set()              # rids mirrored to pend/
+        self._jkeys: Dict[str, str] = {}       # idempotency key -> rid
         self._cmd_seq = 0
         self._rid_seq = 0
         self._flip_ok_at = 0.0
+        self._breach_windows = 0
+        self._idle_windows = 0
         self._push_queues: Dict[str, StoreQueue] = {}
         # fleet observability state (docs/OBSERVABILITY.md "Fleet
         # observability"): status-demoted workers (unparsable/stale
         # snapshots — out of routing, still lease-monitored),
         # straggler detection windows, per-(wid, epoch) recompile
         # baselines, a bounded decision log for GET /v1/cluster, and
-        # the trace-record retention queue
+        # the trace/journal retention queues
         self._status_demoted: set = set()
         self._stragglers: set = set()
         self._straggle_counts: Dict[tuple, int] = {}
@@ -319,8 +565,28 @@ class ClusterController:
         self._decisions: "collections.deque[dict]" = \
             collections.deque(maxlen=64)
         self._trace_rids: "collections.deque[str]" = collections.deque()
+        self._journal_rids: "collections.deque[tuple]" = \
+            collections.deque()                # (rid, idempotency key)
         self._http = None
         self._http_thread = None
+        # controller epoch: the rid salt + zombie fence token.  A
+        # follower allocates nothing — it gets its epoch at takeover;
+        # an active controller without a lease (colocated/test drivers)
+        # still bumps the counter so a bounced controller can never
+        # re-issue a colliding rid.
+        self.ctl_epoch: Optional[int] = None
+        if self.follower:
+            if self.lease is None:
+                raise ValueError(
+                    "a follower controller needs a ControllerLease "
+                    "to watch")
+            return
+        if self.lease is not None:
+            self.ctl_epoch = self.lease.epoch \
+                if self.lease.epoch is not None else self.lease.acquire()
+        else:
+            self.ctl_epoch = int(
+                self.store.add(f"{self.prefix}/ctl/epoch", 1))
         self._recover()
         self._publish_clock()
 
@@ -338,12 +604,32 @@ class ClusterController:
                eos_token_id: Optional[int] = None,
                request_id: Optional[str] = None,
                tenant: Optional[str] = None,
-               adapter: Optional[str] = None) -> str:
+               adapter: Optional[str] = None,
+               idempotency_key: Optional[str] = None) -> str:
         """Queue one request for the prefill tier; returns its id.
         Routing happens on the next :meth:`pump` if no worker is
-        eligible yet (startup races are pending work, not errors)."""
+        eligible yet (startup races are pending work, not errors).
+
+        Durable before visible: the admission is CAS-journaled to
+        ``journal/<rid>`` BEFORE this returns (``cluster.journal``
+        fault site, retried under the controller's ``RetryPolicy``;
+        exhaustion rejects THIS submission to the caller — nothing was
+        journaled, so nothing is half-admitted).  A duplicate
+        ``idempotency_key`` returns the EXISTING rid without a second
+        admission — the ``jkey/<key>`` index is CAS-created once, so
+        concurrent duplicates race to a single winner."""
+        if self.follower:
+            raise LeaseLost(
+                "follower controller cannot admit: it holds no "
+                "controller lease (pump() until takeover)")
+        if idempotency_key is not None:
+            dup = self._jkey_lookup(idempotency_key)
+            if dup is not None:
+                obs.emit_event("cluster_journal_dup", id=dup,
+                               key=idempotency_key)
+                return dup
         if request_id is None:
-            request_id = f"creq-{self._rid_seq}"
+            request_id = f"creq-{self.ctl_epoch}-{self._rid_seq}"
             self._rid_seq += 1
         adm = {"rid": request_id,
                "prompt": [int(t) for t in
@@ -351,10 +637,53 @@ class ClusterController:
                "max_new_tokens": int(max_new_tokens),
                "temperature": float(temperature),
                "eos_token_id": eos_token_id,
-               "tenant": tenant, "adapter": adapter}
+               "tenant": tenant, "adapter": adapter,
+               "key": idempotency_key}
+        rid = self._journal(request_id, adm, idempotency_key)
+        if rid != request_id:
+            # lost the idempotency-key race to a concurrent duplicate
+            obs.emit_event("cluster_journal_dup", id=rid,
+                           key=idempotency_key)
+            return rid
+        if idempotency_key is not None:
+            self._jkeys[idempotency_key] = rid
         self._route({"rid": request_id, "xfer": None, "adm": adm,
                      "from": "controller"})
         return request_id
+
+    def _jkey_lookup(self, key: str) -> Optional[str]:
+        rid = self._jkeys.get(key)
+        if rid is not None:
+            return rid
+        raw = self.store.get(f"{self.prefix}/jkey/{key}")
+        if raw is None:
+            return None
+        rid = raw.decode()
+        self._jkeys[key] = rid
+        return rid
+
+    def _journal(self, rid: str, adm: dict,
+                 key: Optional[str]) -> str:
+        """CAS-write the admission journal entry (and the idempotency
+        index) before ``submit`` returns.  Returns the rid that OWNS
+        the idempotency key — ours, or the concurrent winner's."""
+        def attempt():
+            fi = _rs_state.FAULTS[0]
+            if fi is not None:
+                fi("cluster.journal")
+            if key is not None and not self.store.compare_set(
+                    f"{self.prefix}/jkey/{key}", b"", rid.encode()):
+                raw = self.store.get(f"{self.prefix}/jkey/{key}")
+                owner = raw.decode() if raw is not None else None
+                if owner is not None and owner != rid:
+                    return owner
+            entry = {"adm": adm, "key": key, "ctl": self.ctl_epoch,
+                     "t": self.clock()}
+            self.store.compare_set(f"{self.prefix}/journal/{rid}",
+                                   b"", json.dumps(entry).encode())
+            return rid
+
+        return self.retry.run(attempt, site="cluster.journal")
 
     @property
     def outputs(self) -> Dict[str, dict]:
@@ -580,19 +909,32 @@ class ClusterController:
         Unroutable refs pend for the next pump."""
         tier = "decode" if ref.get("xfer") and not ref.get("prefilling") \
             else "prefill"
+        rid = ref["rid"]
         wid = self._pick(tier)
         if wid is None:
+            # store-backed pending: a controller that dies here leaves
+            # the ref recoverable under pend/<rid> (journal entries
+            # cover bare admissions; this covers unroutable HANDOFF
+            # refs whose queue item was already consumed)
             self._pending.append(ref)
+            if rid not in self._pended:
+                self._pended.add(rid)
+                self.store.set(f"{self.prefix}/pend/{rid}",
+                               json.dumps(ref).encode())
             return False
         rec = self._workers[wid]
-        rid = ref["rid"]
-        item = dict(ref, wid=wid, epoch=rec.get("epoch"))
+        item = dict(ref, wid=wid, epoch=rec.get("epoch"),
+                    ctl=self.ctl_epoch)
         q = "hoff" if ref.get("xfer") else "adm"
         self._q(f"q/{q}/{wid}").push(item)
-        assign = {"wid": wid, "epoch": rec.get("epoch"), "ref": ref}
+        assign = {"wid": wid, "epoch": rec.get("epoch"), "ref": ref,
+                  "ctl": self.ctl_epoch}
         self._assigned[rid] = assign
         self.store.set(f"{self.prefix}/assign/{rid}",
                        json.dumps(assign).encode())
+        if rid in self._pended:
+            self._pended.discard(rid)
+            self.store.delete(f"{self.prefix}/pend/{rid}")
         if ref.get("xfer"):
             pl = self._payloads.setdefault(rid, [])
             ent = (ref["xfer"], int(ref.get("nbytes", 0)))
@@ -608,9 +950,10 @@ class ClusterController:
         rec = self._workers.get(wid) or self.members().get(wid)
         if rec is None:
             raise KeyError(f"unknown worker {wid!r}")
-        cid = f"cmd-{self._cmd_seq}"
+        cid = f"cmd-{self.ctl_epoch}-{self._cmd_seq}"
         self._cmd_seq += 1
-        item = dict(cmd, id=cid, epoch=rec.get("epoch"))
+        item = dict(cmd, id=cid, epoch=rec.get("epoch"),
+                    ctl=self.ctl_epoch)
         self._q(f"q/cmd/{wid}").push(item)
         obs.emit_event("cluster_command", worker=wid, id=cid,
                        kind=cmd.get("kind"), epoch=rec.get("epoch"))
@@ -716,6 +1059,7 @@ class ClusterController:
                 except Exception:  # noqa: BLE001 — best-effort cleanup
                     pass
             got += 1
+            self._retire_journal(rid, a, out)
             # trace retention: keep segments for the last
             # ``trace_retention`` finished requests (GET /v1/requests),
             # reap the oldest beyond that so trace/ keys stay bounded
@@ -726,6 +1070,33 @@ class ClusterController:
                         f"{self.prefix}/trace/{old}/"):
                     self.store.delete(key)
         return got
+
+    def _retire_journal(self, rid: str, assign: dict, out: dict) -> None:
+        """Retirement tombstone: overwrite ``journal/<rid>`` with the
+        collected output, so a takeover (or a duplicate idempotency
+        key) can answer with the finished tokens without the worker —
+        and reap the oldest retired entries (journal + jkey index +
+        assign record) beyond ``journal_retention``, bounding the
+        store's key count under sustained churn."""
+        ref = assign.get("ref") or {}
+        adm = ref.get("adm") or {}
+        key = adm.get("key")
+        tomb = {"done": True, "key": key,
+                "tokens": out.get("tokens"),
+                "reason": out.get("reason"),
+                "worker": out.get("worker"), "epoch": out.get("epoch"),
+                "tenant": out.get("tenant"),
+                "ctl": self.ctl_epoch, "t": self.clock()}
+        self.store.set(f"{self.prefix}/journal/{rid}",
+                       json.dumps(tomb).encode())
+        self._journal_rids.append((rid, key))
+        while len(self._journal_rids) > self.journal_retention:
+            old_rid, old_key = self._journal_rids.popleft()
+            self.store.delete(f"{self.prefix}/journal/{old_rid}")
+            self.store.delete(f"{self.prefix}/assign/{old_rid}")
+            if old_key is not None:
+                self.store.delete(f"{self.prefix}/jkey/{old_key}")
+                self._jkeys.pop(old_key, None)
 
     # -- fleet observability surface ---------------------------------------
 
@@ -809,6 +1180,9 @@ class ClusterController:
             }
         return {"t": now,
                 "epoch": int(raw) if raw else 0,
+                "ctl_epoch": self.ctl_epoch,
+                "follower": self.follower,
+                "journaled": len(self._journal_rids),
                 "workers": workers,
                 "autoscale": self.autoscale,
                 "assigned": len(self._assigned),
@@ -941,7 +1315,17 @@ class ClusterController:
         ``flip_queue_ratio``, or breaching its TTFT SLO while the other
         tier is healthy) and the donor tier can spare a worker
         (``min_tier``), flip the donor's idlest worker over.  The flip
-        itself is the same drain→re-register evacuation as a kill."""
+        itself is the same drain→re-register evacuation as a kill.
+
+        With a :class:`WorkerSpawner` attached, two more moves open up
+        beyond role flips: when the breach PERSISTS
+        (``spawn_breach_windows`` consecutive evaluations) with the
+        donor tier already at the flip floor, SPAWN a fresh worker for
+        the hot tier (it registers and adopts itself into the
+        membership view); and after ``scale_down_windows`` consecutive
+        fully-idle, breach-free evaluations, DRAIN the emptiest worker
+        of the larger tier back out — the same graceful evacuation as
+        a ``drain`` command."""
         if not self.autoscale or self.clock() < self._flip_ok_at:
             return None
         pre, dec = self._live("prefill"), self._live("decode")
@@ -958,12 +1342,58 @@ class ClusterController:
                 self._status.get(w, {}).get("queue_depth", 0)
                 + self._status.get(w, {}).get("active", 0), w))
 
+        if pre_hot or dec_hot:
+            self._idle_windows = 0
         if pre_hot and pre_load > len(pre) and len(dec) > self.min_tier:
             wid = idlest(dec)
             self.role_flip(wid, "prefill")
         elif dec_hot and dec_load > len(dec) and len(pre) > self.min_tier:
             wid = idlest(pre)
             self.role_flip(wid, "decode")
+        elif (pre_hot or dec_hot) and self.spawner is not None:
+            # both tiers at the flip floor: a flip would just move the
+            # starvation.  Require the breach to persist before paying
+            # for a fresh worker process.
+            self._breach_windows += 1
+            if self._breach_windows < self.spawn_breach_windows \
+                    or len(self._live()) >= self.max_workers:
+                return None
+            role = "prefill" if pre_hot else "decode"
+            wid = self.spawner.spawn(role)
+            self._breach_windows = 0
+            self._flip_ok_at = self.clock() + self.flip_cooldown_s
+            reg = obs.get_registry()
+            if reg is not None:
+                reg.counter("cluster.spawns").inc()
+            self._decisions.append(
+                {"t": self.clock(), "kind": "spawn", "worker": wid,
+                 "role": role, "prefill_load": pre_load,
+                 "decode_load": dec_load})
+            obs.emit_event("cluster_spawn", worker=wid, role=role,
+                           prefill_load=pre_load, decode_load=dec_load)
+            return wid
+        elif self.spawner is not None and pre_load + dec_load == 0 \
+                and not self._tier_breached(pre) \
+                and not self._tier_breached(dec):
+            self._breach_windows = 0
+            self._idle_windows += 1
+            if self._idle_windows < self.scale_down_windows:
+                return None
+            donor = dec if len(dec) > len(pre) else pre
+            if len(donor) <= self.min_tier:
+                return None
+            wid = idlest(donor)
+            self.drain_worker(wid)
+            self._idle_windows = 0
+            self._flip_ok_at = self.clock() + self.flip_cooldown_s
+            reg = obs.get_registry()
+            if reg is not None:
+                reg.counter("cluster.scale_downs").inc()
+            self._decisions.append(
+                {"t": self.clock(), "kind": "scale_down",
+                 "worker": wid})
+            obs.emit_event("cluster_scale_down", worker=wid)
+            return wid
         else:
             return None
         self._flip_ok_at = self.clock() + self.flip_cooldown_s
@@ -979,7 +1409,23 @@ class ClusterController:
     def pump(self) -> Dict[str, int]:
         """One control round: refresh membership/status, route queued
         handoff + evacuation refs (and anything pending), reap stale
-        leases into evacuation, collect fenced outputs, autoscale."""
+        leases into evacuation, collect fenced outputs, autoscale.
+
+        With a :class:`ControllerLease` attached, every round first
+        renews it (interval-gated) — a broken chain raises
+        :class:`LeaseLost` and this controller must stop: it is the
+        zombie now, and its late writes are fenced by the new
+        controller's epoch.  In ``follower`` mode the round only
+        watches the lease and takes over when it goes stale."""
+        if self.follower:
+            return self._follow()
+        if self.lease is not None:
+            try:
+                self.lease.renew()
+            except LeaseLost:
+                obs.emit_event("cluster_fenced", ctl=self.ctl_epoch,
+                               holder=self.lease.holder)
+                raise
         self._publish_clock()
         self.members()
         self._refresh_status()
@@ -1004,15 +1450,67 @@ class ClusterController:
         return {"routed": routed, "reaped": reaped, "collected": got,
                 "pending": len(self._pending)}
 
+    # -- failover ----------------------------------------------------------
+
+    def _follow(self) -> Dict[str, int]:
+        """One follower round: watch the controller lease; when it
+        goes stale, take over — single CAS winner, fresh controller
+        epoch, full rebuild from journal + ``assign/`` + ``pend/``.
+        The ``cluster.takeover`` fault site fires after staleness is
+        observed and before the CAS: a fault aborts the attempt
+        cleanly and the follower retries next pump."""
+        idle = {"routed": 0, "reaped": 0, "collected": 0,
+                "pending": 0, "follower": 1}
+        if not self.lease.stale():
+            return idle
+        try:
+            fi = _rs_state.FAULTS[0]
+            if fi is not None:
+                fi("cluster.takeover")
+            epoch = self.lease.acquire()
+        except LeaseLost:
+            return idle             # another standby won the race
+        except Exception as e:  # noqa: BLE001 — injected/host fault
+            obs.emit_event("cluster_takeover_retry",
+                           holder=self.lease.holder,
+                           exc=type(e).__name__)
+            return idle
+        self.follower = False
+        self.ctl_epoch = epoch
+        self._assigned.clear()
+        self._payloads.clear()
+        self._outs.clear()
+        self._pending = []
+        self._pended = set()
+        self._jkeys = {}
+        self._journal_rids.clear()
+        self._recover()
+        self._publish_clock()
+        reg = obs.get_registry()
+        if reg is not None:
+            reg.counter("cluster.takeovers").inc()
+        obs.emit_event("cluster_takeover", ctl=epoch,
+                       holder=self.lease.holder,
+                       assigned=len(self._assigned),
+                       pending=len(self._pending))
+        self._decisions.append(
+            {"t": self.clock(), "kind": "takeover", "ctl": epoch,
+             "holder": self.lease.holder})
+        return self.pump()          # first active round immediately
+
     # -- recovery ----------------------------------------------------------
 
     def _recover(self) -> None:
-        """Rebuild assignment state from the store after a controller
-        restart: ``assign/`` is the source of truth, ``out/`` keys are
-        collected on the next pump.  Queue read cursors restart at the
-        tail... of nothing — unconsumed global-queue items are re-read
-        from seq 0 and re-routing an already-assigned rid just updates
-        its assignment (workers skip duplicate admissions)."""
+        """Rebuild admission state from the store after a controller
+        restart or takeover: ``assign/`` holds the routed surface,
+        ``journal/`` the admitted one, ``pend/`` the unroutable refs.
+        ``out/`` keys are collected on the next pump.  Journaled but
+        never-assigned entries — the exact submit-returned/not-yet-
+        routed crash window — are re-routed as fresh admissions;
+        retirement tombstones repopulate the collected outputs (and
+        the idempotency index), so duplicate keys still answer with
+        the finished tokens.  Re-routing an already-assigned rid just
+        updates its assignment (workers skip duplicate admissions)."""
         base = f"{self.prefix}/assign/"
         for key in self.store.keys(base):
             raw = self.store.get(key)
@@ -1028,3 +1526,62 @@ class ClusterController:
             if ref.get("xfer"):
                 self._payloads.setdefault(rid, []).append(
                     (ref["xfer"], int(ref.get("nbytes", 0))))
+        jbase = f"{self.prefix}/journal/"
+        replayed = finished = 0
+        for key in sorted(self.store.keys(jbase)):
+            raw = self.store.get(key)
+            if raw is None:
+                continue
+            try:
+                entry = json.loads(raw.decode())
+            except (ValueError, UnicodeDecodeError):
+                continue
+            rid = key[len(jbase):]
+            jkey = entry.get("key")
+            if jkey is not None:
+                self._jkeys[jkey] = rid
+            if entry.get("done"):
+                finished += 1
+                self._journal_rids.append((rid, jkey))
+                if rid not in self._outs:
+                    self._outs[rid] = {
+                        "tokens": entry.get("tokens"),
+                        "reason": entry.get("reason"),
+                        "worker": entry.get("worker"),
+                        "epoch": entry.get("epoch"),
+                        "tenant": entry.get("tenant")}
+                continue
+            if rid in self._assigned:
+                continue            # routed before the crash
+            adm = entry.get("adm")
+            if adm is not None:
+                self._route({"rid": rid, "xfer": None, "adm": adm,
+                             "from": "journal"})
+                replayed += 1
+        pbase = f"{self.prefix}/pend/"
+        pended = 0
+        for key in sorted(self.store.keys(pbase)):
+            rid = key[len(pbase):]
+            raw = self.store.get(key)
+            if rid in self._assigned or rid in self._outs \
+                    or raw is None:
+                self.store.delete(key)
+                continue
+            try:
+                ref = json.loads(raw.decode())
+            except (ValueError, UnicodeDecodeError):
+                self.store.delete(key)
+                continue
+            if rid in self._pended \
+                    or any(p.get("rid") == rid for p in self._pending):
+                continue            # journal replay already pended it
+            self._pended.add(rid)
+            self._pending.append(ref)
+            pended += 1
+        if replayed or finished or pended:
+            reg = obs.get_registry()
+            if reg is not None:
+                reg.counter("cluster.journal_replayed").inc(replayed)
+            obs.emit_event("cluster_journal_replay", ctl=self.ctl_epoch,
+                           replayed=replayed, finished=finished,
+                           pended=pended, assigned=len(self._assigned))

@@ -1,0 +1,495 @@
+"""Durable cluster gateway: the HTTP front door over ``ClusterController``.
+
+``ServingServer`` (server.py) fronts ONE engine replica in-process; the
+gateway is the same OpenAI-ish surface over the *cluster* control plane
+(serving/cluster.py) — the process a fleet actually exposes:
+
+- ``POST /v1/completions`` admits through the controller's **durable
+  admission journal** (``ClusterController.submit`` CAS-writes
+  ``journal/<rid>`` before returning), so a request the gateway has
+  answered with a rid survives a controller SIGKILL and is replayed by
+  the standby's takeover.  An ``Idempotency-Key`` header (or body
+  field) dedupes through the journal's ``jkey/<key>`` index: a
+  duplicate POST returns the SAME rid and stream — never a second
+  admission.
+- **Tenancy/SLO shed in front of submit** reuses the front door's
+  vocabulary (:class:`~paddle_tpu.serving.frontdoor.TenantPolicy`,
+  :class:`~paddle_tpu.serving.frontdoor.TokenBucket`,
+  :class:`~paddle_tpu.serving.frontdoor.Admission`): token-bucket rate
+  limits, per-tenant live-request quotas, a gateway-wide live cap, and
+  a backlog-driven SLO shed for tenants below the priority floor.
+  Sheds map to HTTP exactly like server.py: 429 for
+  ``rate_limited``/``quota`` (+ ``Retry-After``), 503 otherwise, and a
+  draining gateway answers a typed 503 ``{"error": {"type":
+  "draining"}}`` with a retry hint.
+- **SSE streams off the fenced output record**: cluster workers publish
+  one COMPLETE fenced record per request (``out/<rid>``, stale-epoch
+  writes dropped), so the stream replays that record's tokens as SSE
+  chunks the moment the controller collects it — the chunk shapes match
+  server.py's, the delivery contract is the cluster's (exactly-once,
+  epoch-fenced).
+- **Graceful SIGTERM drain** via
+  :class:`~paddle_tpu.launch.preempt.PreemptionGuard`: in-flight
+  streams finish off the journal/outputs, new POSTs get the typed 503.
+
+Fault site ``serve.gateway`` (docs/RESILIENCE.md) fires per admission
+after the policy sheds and before the journal write: a fault sheds that
+ONE request as a typed 503 — the gateway process and its in-flight
+streams survive.
+
+Threading model mirrors server.py: handler threads only *submit* (under
+the gateway lock) and then wait on their request's delivery queue; ONE
+loop thread drives ``ClusterController.pump()`` and routes collected
+output records — the controller is never entered concurrently.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from .. import observability as obs
+from ..observability.sinks import registry_to_prometheus
+from ..launch.preempt import PreemptionGuard
+from ..resilience import _state as _rs_state
+from .cluster import ClusterController, LeaseLost
+from .frontdoor import Admission, TenantPolicy, TokenBucket
+
+__all__ = ["ClusterGateway"]
+
+_MAX_BODY = 8 << 20          # 8 MiB: a prompt, not an upload endpoint
+
+#: the front door's shed vocabulary over HTTP (server.py's map; every
+#: reason the gateway itself mints — draining, queue_full, slo_shed,
+#: gateway_fault, journal, not_leader — lands on the 503 default)
+_SHED_HTTP = {"rate_limited": 429, "quota": 429, "budget": 400}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "paddle-tpu-gateway"
+
+    def log_message(self, fmt, *args):  # noqa: D102 — stderr per request
+        pass
+
+    @property
+    def gw(self) -> "ClusterGateway":
+        return self.server.cluster_gateway  # type: ignore[attr-defined]
+
+    def _json(self, code: int, payload: dict,
+              headers: Optional[dict] = None) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if self.close_connection:
+            self.send_header("Connection", "close")
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 — stdlib name
+        if self.path == "/healthz":
+            self._json(200, self.gw.health())
+        elif self.path == "/metrics":
+            body = self.gw.metrics_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self._json(404, {"error": {"type": "not_found"}})
+
+    def do_POST(self):  # noqa: N802 — stdlib name
+        if self.path != "/v1/completions":
+            self._json(404, {"error": {"type": "not_found"}})
+            return
+        gw = self.gw
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            if not 0 < n <= _MAX_BODY:
+                raise ValueError(f"bad Content-Length {n}")
+            body = json.loads(self.rfile.read(n))
+            prompt = [int(t) for t in body["prompt"]]
+            max_tokens = int(body.get("max_tokens", 16))
+            temperature = float(body.get("temperature", 0.0))
+            stream = bool(body.get("stream", False))
+            tenant = body.get("tenant") or body.get("user") \
+                or self.headers.get("X-Tenant") or "default"
+            key = self.headers.get("Idempotency-Key") \
+                or body.get("idempotency_key")
+        except Exception as e:  # noqa: BLE001 — malformed body
+            # partly-read body on keep-alive would desync the next
+            # request's parse: drop the connection with the error
+            self.close_connection = True
+            self._json(400, {"error": {"type": "invalid_request",
+                                       "message": str(e)[:300]}})
+            return
+
+        q: "queue.Queue" = queue.Queue()
+        adm = gw.submit_request(
+            prompt, tenant=tenant, max_new_tokens=max_tokens,
+            temperature=temperature, idempotency_key=key, deliver_to=q)
+        if not adm.admitted:
+            headers = {}
+            if adm.retry_after_s is not None:
+                headers["Retry-After"] = str(int(adm.retry_after_s + 0.5)
+                                             or 1)
+            self._json(_SHED_HTTP.get(adm.reason, 503),
+                       {"error": {"type": adm.reason,
+                                  "retry_after_s": adm.retry_after_s}},
+                       headers=headers)
+            return
+        if stream:
+            self._stream_response(adm.request_id, q, len(prompt))
+        else:
+            self._full_response(adm.request_id, q, len(prompt))
+
+    def _wait(self, q):
+        return q.get(timeout=self.gw.output_timeout_s)
+
+    def _full_response(self, rid, q, prompt_len):
+        try:
+            rec = self._wait(q)
+        except queue.Empty:
+            self._json(504, {"error": {"type": "timeout", "id": rid}})
+            return
+        tokens = list(rec.get("tokens") or ())
+        self._json(200, {
+            "id": rid, "object": "text_completion",
+            "choices": [{"index": 0, "token_ids": tokens,
+                         "finish_reason": rec.get("reason")}],
+            "usage": {"prompt_tokens": prompt_len,
+                      "completion_tokens": len(tokens),
+                      "total_tokens": prompt_len + len(tokens)}})
+
+    def _stream_response(self, rid, q, prompt_len):
+        """Replay the fenced output record as SSE chunks (server.py's
+        chunk shapes): workers publish one COMPLETE record per request,
+        so the stream opens when the controller collects it."""
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-store")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def chunk(data: str):
+            payload = f"data: {data}\n\n".encode()
+            self.wfile.write(f"{len(payload):x}\r\n".encode()
+                             + payload + b"\r\n")
+
+        try:
+            rec = self._wait(q)
+            tokens = list(rec.get("tokens") or ())
+            for i, tok in enumerate(tokens):
+                fin = rec.get("reason") if i == len(tokens) - 1 else None
+                chunk(json.dumps({
+                    "id": rid, "object": "text_completion.chunk",
+                    "choices": [{"index": 0, "token_id": int(tok),
+                                 "finish_reason": fin}]}))
+            chunk("[DONE]")
+            self.wfile.write(b"0\r\n\r\n")
+        except queue.Empty:
+            chunk(json.dumps({"error": {"type": "timeout", "id": rid}}))
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass      # client went away; the cluster finishes anyway
+
+
+class ClusterGateway:
+    """The cluster's HTTP front door (module docstring for the full
+    contract).  ``start()`` binds and returns ``(host, port)``;
+    ``serve_forever()`` additionally installs a
+    :class:`PreemptionGuard` and drains gracefully on SIGTERM (main
+    thread only).  ``submit_request`` is the same admission path
+    programmatically — the telemetry-overhead gate's poison probe and
+    the unit tests drive it without a socket."""
+
+    def __init__(self, controller: ClusterController,
+                 host: str = "127.0.0.1", port: int = 0,
+                 tenants: Optional[Dict[str, TenantPolicy]] = None,
+                 max_live: int = 64,
+                 slo_queue_depth: Optional[int] = None,
+                 slo_priority_floor: int = 1,
+                 poll_s: float = 0.005,
+                 output_timeout_s: float = 120.0,
+                 drain_retry_after_s: float = 1.0):
+        self.ctl = controller
+        self.tenants = dict(tenants) if tenants else \
+            {"default": TenantPolicy()}
+        self.max_live = int(max_live)
+        self.slo_queue_depth = slo_queue_depth
+        self.slo_priority_floor = int(slo_priority_floor)
+        self.poll_s = float(poll_s)
+        self.output_timeout_s = float(output_timeout_s)
+        self.drain_retry_after_s = float(drain_retry_after_s)
+        self._host, self._port = host, int(port)
+        self._lock = threading.Lock()
+        # rid → delivery queues (one per waiting handler thread;
+        # duplicate Idempotency-Key streams share the rid) and
+        # rid → tenant for quota accounting — written by handler
+        # threads at submit, read/pruned by the pump loop; every touch
+        # under _lock (pdtpu-lint lock-discipline)
+        self._routes: Dict[str, List["queue.Queue"]] = {}  # guarded_by: _lock
+        self._live_reqs: Dict[str, str] = {}               # guarded_by: _lock
+        self._buckets: Dict[str, TokenBucket] = {}         # guarded_by: _lock
+        self.shed_counts: Dict[str, int] = {}              # guarded_by: _lock
+        self.n_admitted = 0                                # guarded_by: _lock
+        self.dup_hits = 0                                  # guarded_by: _lock
+        self._stop = threading.Event()
+        self._draining = threading.Event()
+        self._drained = threading.Event()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._threads: list = []
+
+    # -- admission ---------------------------------------------------------
+
+    def _policy(self, tenant: str) -> TenantPolicy:
+        return self.tenants.get(tenant) \
+            or self.tenants.get("default") or TenantPolicy()
+
+    # requires-lock: _lock
+    def _bucket(self, tenant: str, pol: TenantPolicy) \
+            -> Optional[TokenBucket]:
+        if pol.rate_tokens_per_s is None:
+            return None
+        b = self._buckets.get(tenant)
+        if b is None:
+            cap = pol.burst_tokens if pol.burst_tokens is not None \
+                else pol.rate_tokens_per_s
+            b = self._buckets[tenant] = TokenBucket(
+                pol.rate_tokens_per_s, cap)
+        return b
+
+    # requires-lock: _lock
+    def _shed(self, tenant: str, reason: str,
+              retry_after_s: Optional[float]) -> Admission:
+        self.shed_counts[reason] = self.shed_counts.get(reason, 0) + 1
+        reg = obs.get_registry()
+        if reg is not None:
+            reg.counter(f"gateway.shed[{reason}]").inc()
+        obs.emit_event("serve_gateway", state="shed", tenant=tenant,
+                       reason=reason, retry_after_s=retry_after_s)
+        return Admission(False, None, reason, retry_after_s)
+
+    def submit_request(self, prompt_ids, *, tenant: str = "default",
+                       max_new_tokens: int = 16,
+                       temperature: float = 0.0,
+                       eos_token_id: Optional[int] = None,
+                       idempotency_key: Optional[str] = None,
+                       deliver_to: Optional["queue.Queue"] = None) \
+            -> Admission:
+        """Admit one request through shed policy → fault site → durable
+        journal; returns the front door's typed :class:`Admission`.
+        ``deliver_to`` (when given) receives the fenced output record
+        once the controller collects it — the HTTP handlers' path."""
+        prompt = [int(t) for t in prompt_ids]
+        with self._lock:
+            if self._draining.is_set():
+                return self._shed(tenant, "draining",
+                                  self.drain_retry_after_s)
+            # a duplicate key is NOT a new admission: it bypasses the
+            # policy sheds and replays the journaled rid's stream
+            if idempotency_key is not None:
+                dup = self.ctl._jkey_lookup(idempotency_key)
+                if dup is not None:
+                    self.dup_hits += 1
+                    reg = obs.get_registry()
+                    if reg is not None:
+                        reg.counter("gateway.dup_hits").inc()
+                    if deliver_to is not None:
+                        self._routes.setdefault(dup, []).append(
+                            deliver_to)
+                    return Admission(True, dup, "duplicate", None)
+            pol = self._policy(tenant)
+            bucket = self._bucket(tenant, pol)
+            if bucket is not None:
+                wait = bucket.try_take(len(prompt) + int(max_new_tokens))
+                if wait > 0:
+                    return self._shed(tenant, "rate_limited",
+                                      None if wait == float("inf")
+                                      else wait)
+            if pol.max_live_requests is not None:
+                live = sum(1 for t in self._live_reqs.values() if t == tenant)
+                if live >= pol.max_live_requests:
+                    return self._shed(tenant, "quota",
+                                      self.drain_retry_after_s)
+            if len(self._live_reqs) >= self.max_live:
+                return self._shed(tenant, "queue_full",
+                                  self.drain_retry_after_s)
+            if (self.slo_queue_depth is not None
+                    and pol.priority < self.slo_priority_floor
+                    and len(self.ctl._pending) + len(self._live_reqs)
+                    >= self.slo_queue_depth):
+                return self._shed(tenant, "slo_shed",
+                                  self.drain_retry_after_s)
+            fi = _rs_state.FAULTS[0]
+            if fi is not None:
+                try:
+                    fi("serve.gateway")
+                except Exception:  # noqa: BLE001 — typed shed, not a 500
+                    return self._shed(tenant, "gateway_fault",
+                                      self.drain_retry_after_s)
+            try:
+                rid = self.ctl.submit(
+                    prompt, max_new_tokens=max_new_tokens,
+                    temperature=temperature, eos_token_id=eos_token_id,
+                    tenant=tenant, adapter=pol.adapter,
+                    idempotency_key=idempotency_key)
+            except LeaseLost:
+                return self._shed(tenant, "not_leader",
+                                  self.drain_retry_after_s)
+            except Exception:  # noqa: BLE001 — journal retry exhausted
+                return self._shed(tenant, "journal",
+                                  self.drain_retry_after_s)
+            self._live_reqs.setdefault(rid, tenant)
+            if deliver_to is not None:
+                self._routes.setdefault(rid, []).append(deliver_to)
+            self.n_admitted += 1
+            reg = obs.get_registry()
+            if reg is not None:
+                reg.counter("gateway.admitted").inc()
+            return Admission(True, rid, None, None)
+
+    # -- delivery loop -----------------------------------------------------
+
+    def _loop(self):
+        while not self._stop.is_set():
+            delivered = []
+            with self._lock:
+                try:
+                    if not self.ctl.follower:
+                        self.ctl.pump()
+                except LeaseLost:
+                    pass      # fenced controller: streams time out typed
+                except Exception:  # noqa: BLE001 — keep the loop alive
+                    pass
+                if self._routes:
+                    outs = self.ctl.outputs
+                    for rid in list(self._routes):
+                        rec = outs.get(rid)
+                        if rec is None:
+                            continue
+                        delivered.extend(
+                            (q, rec) for q in self._routes.pop(rid))
+                        self._live_reqs.pop(rid, None)
+                if self._draining.is_set() and not self._live_reqs:
+                    self._drained.set()
+            for q, rec in delivered:
+                q.put(rec)
+            if not delivered:
+                time.sleep(self.poll_s)
+
+    # -- operational surface -----------------------------------------------
+
+    def health(self) -> dict:
+        """The ``GET /healthz`` body: gateway lifecycle + the
+        controller's cheap local counters (no store scan per probe)."""
+        with self._lock:
+            return {
+                "status": ("draining" if self._draining.is_set()
+                           else "serving"),
+                "follower": self.ctl.follower,
+                "ctl_epoch": self.ctl.ctl_epoch,
+                "live_requests": len(self._live_reqs),
+                "pending": len(self.ctl._pending),
+                "assigned": len(self.ctl._assigned),
+                "admitted": self.n_admitted,
+                "dup_hits": self.dup_hits,
+                "shed": dict(self.shed_counts),
+            }
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition: the fleet fold + gateway-local
+        gauges (always scrape-able, telemetry on or off)."""
+        with self._lock:
+            extra = {
+                "gateway.live_requests": len(self._live_reqs),
+                "gateway.draining": 1 if self._draining.is_set() else 0,
+                "gateway.admitted": self.n_admitted,
+                "gateway.dup_hits": self.dup_hits,
+                "cluster.pending_refs": len(self.ctl._pending),
+                "cluster.collected_outputs": len(self.ctl._outs),
+            }
+            for reason, n in self.shed_counts.items():
+                extra[f"gateway.shed[{reason}]"] = n
+        return registry_to_prometheus(self.ctl.fleet_registry(),
+                                      extra=extra)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def address(self):
+        return (self._host, self._port)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def start(self):
+        """Bind, start the HTTP listener + pump loop threads; returns
+        ``(host, port)`` (the OS-assigned port when built with 0)."""
+        if self._httpd is not None:
+            return self.address
+
+        class _Srv(ThreadingHTTPServer):
+            daemon_threads = True
+
+        self._httpd = _Srv((self._host, self._port), _Handler)
+        self._httpd.cluster_gateway = self     # type: ignore[attr-defined]
+        self._host, self._port = self._httpd.server_address[:2]
+        for target, name in ((self._httpd.serve_forever, "http"),
+                             (self._loop, "pump-loop")):
+            t = threading.Thread(target=target, daemon=True,
+                                 name=f"cluster-gateway-{name}")
+            t.start()
+            self._threads.append(t)
+        obs.emit_event("serve_gateway", state="started",
+                       host=self._host, port=self._port)
+        return self.address
+
+    def begin_drain(self, reason: str = "requested") -> None:
+        """Stop admitting (typed 503 + Retry-After); in-flight streams
+        finish off the fenced output records."""
+        if not self._draining.is_set():
+            self._draining.set()
+            obs.emit_event("serve_gateway", state="draining",
+                           reason=reason)
+
+    def wait_drained(self, timeout: Optional[float] = None) -> bool:
+        return self._drained.wait(timeout)
+
+    def close(self) -> None:
+        """Tear down listener + loop threads (does NOT wait for drain —
+        ``begin_drain()``/``wait_drained()`` first for graceful)."""
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads = []
+        obs.emit_event("serve_gateway", state="closed")
+
+    def serve_forever(self) -> None:
+        """Block until SIGTERM, then drain gracefully and return.  Main
+        thread only (installs a signal handler via PreemptionGuard)."""
+        self.start()
+        guard = PreemptionGuard()
+        try:
+            with guard:
+                while not self._stop.is_set() and not guard.preempted:
+                    time.sleep(max(self.poll_s, 0.01))
+        finally:
+            self.begin_drain(reason="sigterm" if guard.preempted
+                             else "closed")
+            self.wait_drained(timeout=self.output_timeout_s)
+            self.close()

@@ -117,6 +117,11 @@ class ServingWorker:
         self._xfer_seq = 0
         self._adm_q = self._hoff_q = self._cmd_q = None
         self._rid_seen = set()       # for the exit report's trace audit
+        # highest controller epoch observed on any queue item: a
+        # fencing token (ClusterController failover) — items stamped
+        # below it came from a superseded zombie controller and are
+        # dropped, exactly like stale WORKER epochs are
+        self._ctl_seen = 0
         # wall-clock offset vs the controller (local − controller),
         # estimated from store round-trips against the controller's
         # published clock key; rides every trace segment so the
@@ -344,10 +349,29 @@ class ServingWorker:
 
     # -- intake ------------------------------------------------------------
 
+    def _ctl_fenced(self, item: dict, kind: str) -> bool:
+        """Controller-epoch fence: once a queue item from controller
+        epoch N is seen, items stamped below N are a superseded
+        zombie's late writes — dropped, like stale worker epochs.
+        Unstamped items (pre-failover controllers) always pass."""
+        ctl = item.get("ctl")
+        if ctl is None:
+            return False
+        if ctl < self._ctl_seen:
+            obs.emit_event("cluster_stale_item", kind=kind,
+                           worker=self.worker_id, id=item.get("rid")
+                           or item.get("id"), ctl=ctl,
+                           ctl_seen=self._ctl_seen)
+            return True
+        self._ctl_seen = ctl
+        return False
+
     def poll_intake(self) -> int:
         """Consume this worker's admission and handoff-ref queues.
         Items stamped with a different epoch were re-routed by the
-        controller when the previous incarnation died — drop them.
+        controller when the previous incarnation died — drop them;
+        items stamped with a superseded CONTROLLER epoch are a zombie
+        controller's late writes — drop them too.
         Duplicate request ids (at-least-once re-routes) are skipped."""
         taken = 0
         for adm in self._adm_q.pop_all():
@@ -355,6 +379,8 @@ class ServingWorker:
                 obs.emit_event("cluster_stale_item", kind="adm",
                                worker=self.worker_id, id=adm.get("rid"),
                                epoch=adm.get("epoch"))
+                continue
+            if self._ctl_fenced(adm, "adm"):
                 continue
             try:
                 admit_admission(self.engine, adm["adm"])
@@ -367,6 +393,8 @@ class ServingWorker:
                 obs.emit_event("cluster_stale_item", kind="hoff",
                                worker=self.worker_id, id=ref.get("rid"),
                                epoch=ref.get("epoch"))
+                continue
+            if self._ctl_fenced(ref, "hoff"):
                 continue
             try:
                 raw = self.transport.get(ref["xfer"], delete=False)
@@ -552,6 +580,10 @@ class ServingWorker:
                                epoch=cmd.get("epoch"),
                                current_epoch=self.epoch)
                 self._ack(cmd, ok=False, reason="stale_epoch")
+                continue
+            if self._ctl_fenced(cmd, "cmd"):
+                self.stale_commands += 1
+                self._ack(cmd, ok=False, reason="stale_ctl")
                 continue
             fi = _rs_state.FAULTS[0]
             if fi is not None:

@@ -1,0 +1,72 @@
+"""Active cluster controller for the ``serving-cluster`` CI gate's
+controller-SIGKILL scenario (tools/ci.py gate_serving_cluster).
+
+Runs ONE :class:`ClusterController` under a :class:`ControllerLease`
+against an existing TCPStore and consumes gateway-style submissions
+from the ``<prefix>/gate/req`` StoreQueue: each item is
+``{"prompt": [...], "max_new_tokens": N, "key": idempotency-key}``;
+the rid it admits under is acked back to ``<prefix>/gate/ack/<key>``
+AFTER the durable journal write, so the gate can verify that a
+duplicate idempotency key re-submitted through the standby (after this
+process is SIGKILLed mid-churn) resolves to the SAME rid.
+
+Faults ride ``PDTPU_FAULTS`` like the worker processes do — the gate
+injects transient ``cluster.journal`` faults here, absorbed by the
+controller's RetryPolicy.
+
+The process never exits on its own: the gate SIGKILLs it mid-churn and
+the in-gate standby takes over off the stale controller lease.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.environ["PDTPU_REPO"])
+
+import numpy as np  # noqa: E402
+
+from paddle_tpu import resilience as rs  # noqa: E402
+from paddle_tpu.launch.store import TCPStore  # noqa: E402
+from paddle_tpu.resilience.retry import RetryPolicy  # noqa: E402
+from paddle_tpu.serving.cluster import (ClusterController,  # noqa: E402
+                                        ControllerLease, StoreQueue)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--store", required=True)
+    ap.add_argument("--prefix", default="cluster")
+    ap.add_argument("--lease-deadline-s", type=float, default=3.0)
+    ap.add_argument("--worker-lease-deadline-s", type=float, default=6.0)
+    args = ap.parse_args()
+
+    rs.install_faults_from_env()
+    store = TCPStore(args.store, is_master=False)
+    lease = ControllerLease(store, prefix=args.prefix,
+                            holder=f"ctl-sub-{os.getpid()}",
+                            deadline_s=args.lease_deadline_s)
+    ctl = ClusterController(
+        store, prefix=args.prefix, lease=lease,
+        lease_deadline_s=args.worker_lease_deadline_s,
+        retry=RetryPolicy(max_attempts=5, backoff_s=0.01))
+    req = StoreQueue(store, f"{args.prefix}/gate/req")
+    print(json.dumps({"ready": True, "ctl_epoch": ctl.ctl_epoch}),
+          flush=True)
+    while True:
+        for item in req.pop_all():
+            rid = ctl.submit(
+                np.asarray(item["prompt"], np.int32),
+                max_new_tokens=int(item.get("max_new_tokens", 8)),
+                idempotency_key=item.get("key"))
+            if item.get("key") is not None:
+                store.set(f"{args.prefix}/gate/ack/{item['key']}",
+                          rid.encode())
+        ctl.pump()
+        time.sleep(0.01)
+
+
+if __name__ == "__main__":
+    main()

@@ -138,11 +138,19 @@ def test_serving_cluster_gate():
     evacuation), a forced role flip, and injected ``cluster.*`` faults
     in every worker — greedy outputs token-identical to a colocated
     run, zero compiles after warmup, all blocks reclaimed, zero lease
-    losses on the survivors (docs/SERVING.md "Cluster serving")."""
-    out = _run_gate("serving-cluster", timeout=1200)
+    losses on the survivors (docs/SERVING.md "Cluster serving").
+    Phase B SIGKILLs the CONTROLLER: a standby takes over off the
+    stale ``ControllerLease``, replays the admission journal, answers
+    every re-submitted idempotency key with the same rid, and a
+    ``ClusterGateway`` smoke proves SSE/dup/drain semantics over the
+    takeover winner."""
+    out = _run_gate("serving-cluster", timeout=1800)
     assert "serving-cluster gate OK" in out
     assert "token-identical to the colocated run" in out
     assert "SIGKILL" in out and "role flip" in out
+    assert "standby controller takeover" in out
+    assert "zero duplicates" in out
+    assert "drain answered the typed 503" in out
 
 
 def test_bench_regression_gate():

@@ -37,8 +37,11 @@ from paddle_tpu import resilience as rs
 from paddle_tpu import serving
 from paddle_tpu.launch.preempt import PreemptionGuard
 from paddle_tpu.launch.store import TCPStore, free_port
-from paddle_tpu.serving.cluster import (ClusterController, LeaseLost,
-                                        LeaseMonitor, StoreQueue)
+from paddle_tpu.serving.cluster import (ClusterController, ControllerLease,
+                                        LeaseLost, LeaseMonitor, StoreQueue,
+                                        WorkerSpawner)
+from paddle_tpu.serving.frontdoor import TenantPolicy
+from paddle_tpu.serving.gateway import ClusterGateway
 from paddle_tpu.serving.worker import ServingWorker
 from paddle_tpu.resilience.retry import RetryPolicy
 
@@ -1173,3 +1176,634 @@ class TestFleetTracingEndToEnd:
         fleet = ctl.fleet_registry()
         assert fleet.get("serve.tokens").snapshot() >= 20
         _blocks_clean(workers)
+
+# ---------------------------------------------------------------------------
+# durable admission journal + controller failover (docs/SERVING.md
+# "Cluster serving" failure matrix: controller-death rows)
+# ---------------------------------------------------------------------------
+
+def _retry():
+    return RetryPolicy(max_attempts=3, backoff_s=0.0)
+
+
+class TestControllerLease:
+    def test_acquire_renew_release_chain(self, store):
+        clock = _Clock(100.0)
+        lease = ControllerLease(store, holder="ctlA", deadline_s=6.0,
+                                clock=clock)
+        assert lease.stale()                # absent == up for grabs
+        assert lease.acquire() == 1
+        rec = lease.observe()
+        assert rec["holder"] == "ctlA" and rec["epoch"] == 1
+        clock.t += 3.0                      # past interval (deadline/3)
+        lease.renew()
+        assert lease.observe()["t"] == 103.0
+        lease.release()
+        assert lease.observe() == {}        # tombstone: unparsable
+        assert lease.stale()                # a standby takes over now
+
+    def test_fresh_lease_blocks_second_acquire(self, store):
+        clock = _Clock(100.0)
+        ControllerLease(store, holder="ctlA", deadline_s=6.0,
+                        clock=clock).acquire()
+        standby = ControllerLease(store, holder="ctlB", deadline_s=6.0,
+                                  clock=clock)
+        with pytest.raises(LeaseLost):
+            standby.acquire()
+
+    def test_stale_takeover_bumps_epoch_and_fences_old_holder(
+            self, store):
+        clock = _Clock(100.0)
+        old = ControllerLease(store, holder="ctlA", deadline_s=6.0,
+                              clock=clock)
+        assert old.acquire() == 1
+        clock.t += 10.0                     # ctlA went dark
+        standby = ControllerLease(store, holder="ctlB", deadline_s=6.0,
+                                  clock=clock)
+        assert standby.stale()
+        assert standby.acquire() == 2       # counter, never reused
+        # the zombie's chain is broken: its next renew is LeaseLost
+        with pytest.raises(LeaseLost):
+            old.renew(force=True)
+
+    def test_epoch_counter_shared_with_leaseless_controllers(self, store):
+        """One ``ctl/epoch`` counter serves lease acquisitions AND
+        bare controller construction, so ``creq-<ctl>-<seq>`` rids can
+        never collide between any two controller incarnations."""
+        ctl = ClusterController(store)
+        assert ctl.ctl_epoch == 1
+        lease = ControllerLease(store, holder="ctlB", deadline_s=6.0)
+        assert lease.acquire() == 2
+
+
+class TestAdmissionJournal:
+    def test_submit_is_durable_before_visible(self, store):
+        """No workers yet: the admission is journaled and the
+        unroutable ref mirrored to ``pend/`` before submit returns —
+        a controller dying the instant after return loses nothing."""
+        ctl = ClusterController(store, retry=_retry())
+        rid = ctl.submit(PROMPTS[0], max_new_tokens=4)
+        entry = json.loads(store.get(f"cluster/journal/{rid}"))
+        assert entry["adm"]["prompt"] == [int(t) for t in PROMPTS[0]]
+        assert entry["ctl"] == ctl.ctl_epoch and not entry.get("done")
+        assert store.get(f"cluster/pend/{rid}") is not None
+        assert ctl.pump()["pending"] == 1
+
+    def test_rid_salted_with_ctl_epoch_across_bounce(self, store):
+        """Regression: ``_rid_seq`` restarts at 0 on a controller
+        bounce — without the epoch salt, the new controller's first
+        rid collides with the old ``assign/``/``out/`` records."""
+        _seed_worker(store, "p0", "prefill")
+        ctl1 = ClusterController(store, retry=_retry())
+        rid1 = ctl1.submit(PROMPTS[0], max_new_tokens=4)
+        ctl1.pump()
+        ctl2 = ClusterController(store, retry=_retry())   # the bounce
+        rid2 = ctl2.submit(PROMPTS[1], max_new_tokens=4)
+        assert rid1 != rid2
+        assert rid1 == f"creq-{ctl1.ctl_epoch}-0"
+        assert rid2 == f"creq-{ctl2.ctl_epoch}-0"
+        assert ctl2.ctl_epoch > ctl1.ctl_epoch
+        # rid1's recovered assignment survived untouched
+        assert json.loads(
+            store.get(f"cluster/assign/{rid1}"))["wid"] == "p0"
+
+    def test_idempotency_key_dedupes_within_and_across_controllers(
+            self, store):
+        obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        _seed_worker(store, "p0", "prefill")
+        ctl = ClusterController(store, retry=_retry())
+        rid = ctl.submit(PROMPTS[0], max_new_tokens=4,
+                         idempotency_key="k-1")
+        assert ctl.submit(PROMPTS[0], max_new_tokens=4,
+                          idempotency_key="k-1") == rid
+        sink = obs.get_telemetry().sinks[0]
+        assert [e["id"] for e in sink.events("cluster_journal_dup")] \
+            == [rid]
+        # a bounced controller answers the same key from the store index
+        ctl2 = ClusterController(store, retry=_retry())
+        assert ctl2.submit(PROMPTS[0], max_new_tokens=4,
+                           idempotency_key="k-1") == rid
+        # exactly one admission was ever journaled
+        assert store.keys("cluster/journal/") \
+            == [f"cluster/journal/{rid}"]
+
+    def test_journal_fault_retried_then_exhaustion_rejects_typed(
+            self, store):
+        _seed_worker(store, "p0", "prefill")
+        ctl = ClusterController(store, retry=_retry())
+        inj = rs.install_faults("cluster.journal@0")
+        rid = ctl.submit(PROMPTS[0], max_new_tokens=4)
+        assert ("cluster.journal", 0) in inj.fired
+        assert store.get(f"cluster/journal/{rid}") is not None
+        # exhaustion: the submission is rejected to the caller and
+        # NOTHING was journaled — no half-admitted request
+        rs.install_faults("cluster.journal@0x9")
+        with pytest.raises(rs.InjectedFault):
+            ctl.submit(PROMPTS[1], max_new_tokens=4,
+                       idempotency_key="k-lost")
+        assert store.get("cluster/jkey/k-lost") is None
+        assert store.keys("cluster/journal/") \
+            == [f"cluster/journal/{rid}"]
+
+    def test_crash_at_submit_returned_not_yet_assigned_window(
+            self, store):
+        """The acceptance regression: a journaled submit whose
+        controller dies before routing (journal entry, no ``assign/``,
+        no ``pend/``) is re-routed by the next controller's recovery."""
+        ctlA = ClusterController(store, retry=_retry())
+        adm = {"rid": "creq-9-0", "prompt": [1, 2, 3],
+               "max_new_tokens": 2, "temperature": 0.0,
+               "eos_token_id": None, "tenant": None, "adapter": None,
+               "key": None}
+        # the exact window, frozen: the journal write landed, the
+        # crash hit before _route could run
+        assert ctlA._journal("creq-9-0", adm, None) == "creq-9-0"
+        assert store.get("cluster/assign/creq-9-0") is None
+        # ...and a second admission that pended (no eligible worker)
+        rid2 = ctlA.submit(PROMPTS[0], max_new_tokens=4)
+        del ctlA                            # the crash
+        obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        _seed_worker(store, "p0", "prefill")
+        ctlB = ClusterController(store, retry=_retry())
+        ctlB.pump()
+        for rid in ("creq-9-0", rid2):
+            assert json.loads(
+                store.get(f"cluster/assign/{rid}"))["wid"] == "p0"
+        items = StoreQueue(store, "cluster/q/adm/p0").pop_all()
+        assert sorted(i["rid"] for i in items) \
+            == sorted(["creq-9-0", rid2])
+        sink = obs.get_telemetry().sinks[0]
+        replays = sink.events("cluster_journal_replay")
+        # both live entries replay from the journal scan (the pend/
+        # mirror of rid2 is then recognised as already pending)
+        assert replays and replays[0]["replayed"] == 2
+        assert replays[0]["pended"] == 0
+
+    def test_follower_takeover_replays_journal_and_resumes(self, store):
+        obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        clock = _Clock(100.0)
+        _seed_worker(store, "p0", "prefill", lease_t=99.0)
+        active = ClusterController(
+            store, clock=clock, retry=_retry(),
+            lease=ControllerLease(store, holder="ctlA", deadline_s=5.0,
+                                  clock=clock))
+        rid = active.submit(PROMPTS[0], max_new_tokens=4,
+                            idempotency_key="k-t")
+        active.pump()
+        standby = ClusterController(
+            store, clock=clock, retry=_retry(), follower=True,
+            lease=ControllerLease(store, holder="ctlB", deadline_s=5.0,
+                                  clock=clock))
+        assert standby.pump()["follower"] == 1      # lease still fresh
+        assert standby.follower
+        with pytest.raises(LeaseLost):              # cannot admit yet
+            standby.submit(PROMPTS[1])
+        # ctlA is SIGKILLed: it stops renewing; its lease ages out
+        clock.t += 10.0
+        store.set("cluster/lease/p0", json.dumps(
+            {"epoch": 1, "t": clock.t}).encode())   # worker stays live
+        res = standby.pump()                        # the takeover
+        assert "follower" not in res
+        assert not standby.follower
+        assert standby.ctl_epoch > active.ctl_epoch
+        assert rid in standby._assigned             # rebuilt from assign/
+        assert standby._jkeys["k-t"] == rid         # index rebuilt
+        sink = obs.get_telemetry().sinks[0]
+        assert [e["ctl"] for e in sink.events("cluster_takeover")] \
+            == [standby.ctl_epoch]
+        # the worker's fenced output lands on the NEW controller
+        store.set(f"cluster/out/{rid}", json.dumps(
+            {"tokens": [4, 2], "reason": "eos", "worker": "p0",
+             "epoch": 1}).encode())
+        standby.pump()
+        assert standby.outputs[rid]["tokens"] == [4, 2]
+        # duplicate key against the standby: same rid, no re-admission
+        assert standby.submit(PROMPTS[0], idempotency_key="k-t") == rid
+        # the zombie is fenced the moment it wakes up
+        with pytest.raises(LeaseLost):
+            active.pump()
+        assert sink.events("cluster_fenced")
+
+    def test_takeover_fault_aborts_cleanly_and_retries(self, store):
+        clock = _Clock(100.0)
+        ControllerLease(store, holder="ctlA", deadline_s=5.0,
+                        clock=clock).acquire()
+        standby = ClusterController(
+            store, clock=clock, retry=_retry(), follower=True,
+            lease=ControllerLease(store, holder="ctlB", deadline_s=5.0,
+                                  clock=clock))
+        clock.t += 10.0
+        inj = rs.install_faults("cluster.takeover@0")
+        assert standby.pump()["follower"] == 1      # aborted, still
+        assert standby.follower                     # a follower
+        assert ("cluster.takeover", 0) in inj.fired
+        standby.pump()                              # plan spent: wins
+        assert not standby.follower
+        assert standby.ctl_epoch == 2
+
+    def test_tombstone_answers_dup_after_bounce(self, store):
+        """Retirement keeps the finished tokens in the journal
+        tombstone, so a bounced controller (whose ``out/`` keys were
+        consumed) still answers a duplicate key with the output."""
+        _seed_worker(store, "p0", "prefill")
+        ctl = ClusterController(store, retry=_retry())
+        rid = ctl.submit(PROMPTS[0], max_new_tokens=4,
+                         idempotency_key="k-d")
+        ctl.pump()
+        store.set(f"cluster/out/{rid}", json.dumps(
+            {"tokens": [7, 8], "reason": "eos", "worker": "p0",
+             "epoch": 1}).encode())
+        ctl.pump()
+        assert store.get(f"cluster/out/{rid}") is None  # consumed
+        tomb = json.loads(store.get(f"cluster/journal/{rid}"))
+        assert tomb["done"] and tomb["tokens"] == [7, 8]
+        ctl2 = ClusterController(store, retry=_retry())
+        assert ctl2.submit(PROMPTS[0], idempotency_key="k-d") == rid
+        assert ctl2.outputs[rid]["tokens"] == [7, 8]
+
+    def test_journal_gc_bounds_store_keys_under_churn(self, store):
+        """Sustained churn with a small retention: journal, assign and
+        jkey key counts PLATEAU instead of growing without bound."""
+        _seed_worker(store, "p0", "prefill")
+        ctl = ClusterController(store, retry=_retry(),
+                                journal_retention=2)
+        sizes = []
+        for i in range(6):
+            rid = ctl.submit(PROMPTS[0], max_new_tokens=2,
+                             idempotency_key=f"k-{i}")
+            ctl.pump()
+            store.set(f"cluster/out/{rid}", json.dumps(
+                {"tokens": [i], "reason": "eos", "worker": "p0",
+                 "epoch": 1}).encode())
+            ctl.pump()
+            sizes.append((len(store.keys("cluster/journal/")),
+                          len(store.keys("cluster/assign/")),
+                          len(store.keys("cluster/jkey/"))))
+        assert sizes[-1] == (2, 2, 2)
+        assert sizes[-1] == sizes[-2] == sizes[-3]      # the plateau
+        # the newest entries are the survivors
+        kept = store.keys("cluster/journal/")
+        assert all(json.loads(store.get(k))["done"] for k in kept)
+
+
+class TestWorkerCtlFencing:
+    def test_command_below_ctl_watermark_is_fenced(self, store):
+        obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        w = _fake_worker(store)
+        epoch = w.register()
+        q = StoreQueue(store, f"cluster/q/cmd/{w.worker_id}")
+        # a command from controller epoch 2 raises the watermark...
+        q.push({"kind": "frobnicate", "id": "cA", "epoch": epoch,
+                "ctl": 2})
+        w.poll_commands()
+        assert w._ctl_seen == 2
+        # ...so the SIGKILLed controller's late command (ctl 1) is
+        # fenced: acked typed, never applied
+        q.push({"kind": "drain", "id": "cB", "epoch": epoch, "ctl": 1})
+        w.poll_commands()
+        assert not w._stopping
+        ack = json.loads(store.get("cluster/cmdack/cB"))
+        assert ack == {"ok": False, "reason": "stale_ctl",
+                       "worker": w.worker_id}
+
+    def test_stale_ctl_queue_item_dropped(self, store):
+        obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        w = _fake_worker(store)
+        epoch = w.register()
+        w._ctl_seen = 5                     # saw controller epoch 5
+        StoreQueue(store, f"cluster/q/adm/{w.worker_id}").push(
+            {"rid": "r0", "adm": {"rid": "r0", "prompt": [1],
+                                  "max_new_tokens": 2},
+             "wid": w.worker_id, "epoch": epoch, "ctl": 3})
+        w.poll_intake()                     # dropped before the engine
+        assert w.engine._states == {}
+        sink = obs.get_telemetry().sinks[0]
+        assert [(e["id"], e["ctl"], e["ctl_seen"])
+                for e in sink.events("cluster_stale_item")] \
+            == [("r0", 3, 5)]
+
+    def test_unstamped_items_pass(self, store):
+        """Items without a ``ctl`` stamp (pre-journal controllers,
+        direct test pushes) are never fenced."""
+        w = _fake_worker(store)
+        epoch = w.register()
+        w._ctl_seen = 5
+        q = StoreQueue(store, f"cluster/q/cmd/{w.worker_id}")
+        q.push({"kind": "frobnicate", "id": "cC", "epoch": epoch})
+        w.poll_commands()
+        ack = json.loads(store.get("cluster/cmdack/cC"))
+        assert "frobnicate" in ack["reason"]    # reached the apply
+
+
+class _StubSpawner:
+    def __init__(self):
+        self.spawned = []
+
+    def spawn(self, role):
+        wid = f"spawn-{role}-{len(self.spawned)}"
+        self.spawned.append((role, wid))
+        return wid
+
+
+class TestSpawnerAutoscale:
+    def _fleet_at_floor(self, store, *, breached=True):
+        _seed_worker(store, "p0", "prefill", queue_depth=2,
+                     slo_breached=breached)
+        _seed_worker(store, "d0", "decode")
+
+    def test_persistent_breach_at_flip_floor_spawns(self, store):
+        obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        clock = _Clock(100.0)
+        self._fleet_at_floor(store)
+        sp = _StubSpawner()
+        ctl = ClusterController(store, autoscale=True, min_tier=1,
+                                flip_queue_ratio=100.0,
+                                flip_cooldown_s=0.0, clock=clock,
+                                spawner=sp, spawn_breach_windows=3)
+        ctl.pump()
+        ctl.pump()
+        assert sp.spawned == []             # breach must PERSIST
+        ctl.pump()
+        assert [r for r, _ in sp.spawned] == ["prefill"]
+        sink = obs.get_telemetry().sinks[0]
+        assert [e["role"] for e in sink.events("cluster_spawn")] \
+            == ["prefill"]
+        assert obs.get_registry().get("cluster.spawns").snapshot() == 1
+        assert any(d["kind"] == "spawn"
+                   for d in ctl.cluster_view()["decisions"])
+
+    def test_max_workers_caps_spawn(self, store):
+        self._fleet_at_floor(store)
+        sp = _StubSpawner()
+        ctl = ClusterController(store, autoscale=True, min_tier=1,
+                                flip_queue_ratio=100.0,
+                                flip_cooldown_s=0.0, spawner=sp,
+                                spawn_breach_windows=1, max_workers=2)
+        for _ in range(4):
+            ctl.pump()
+        assert sp.spawned == []             # 2 live == the cap
+
+    def test_idle_fleet_drains_emptiest_of_larger_tier(self, store):
+        obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        clock = _Clock(100.0)
+        _seed_worker(store, "p0", "prefill")
+        _seed_worker(store, "d0", "decode")
+        _seed_worker(store, "d1", "decode")
+        ctl = ClusterController(store, autoscale=True, min_tier=1,
+                                flip_cooldown_s=0.0, clock=clock,
+                                spawner=_StubSpawner(),
+                                scale_down_windows=2)
+        ctl.pump()
+        assert StoreQueue(store, "cluster/q/cmd/d0").pop_all() == []
+        ctl.pump()                          # second idle window: drain
+        items = StoreQueue(store, "cluster/q/cmd/d0").pop_all()
+        assert [i["kind"] for i in items] == ["drain"]
+        sink = obs.get_telemetry().sinks[0]
+        assert [e["worker"] for e in sink.events("cluster_scale_down")] \
+            == ["d0"]
+
+    def test_subprocess_spawner_argv_and_reap(self, store, monkeypatch):
+        """The default spawner launches ``python -m
+        paddle_tpu.serving.worker`` with the store/role/factory wiring;
+        reap() harvests exits without blocking."""
+        import subprocess as sp_mod
+
+        class _Proc:
+            def __init__(self, cmd, env=None, cwd=None):
+                self.cmd = cmd
+                self._rc = None
+
+            def poll(self):
+                return self._rc
+
+        launched = []
+
+        def fake_popen(cmd, env=None, cwd=None):
+            p = _Proc(cmd, env, cwd)
+            launched.append(p)
+            return p
+
+        monkeypatch.setattr(sp_mod, "Popen", fake_popen)
+        sp = WorkerSpawner("127.0.0.1:9", "mod:factory",
+                           lease_deadline_s=3.0,
+                           extra_args=("--seed", "7"))
+        wid = sp.spawn("decode")
+        assert wid.startswith("spawn-decode-")
+        cmd = launched[0].cmd
+        assert cmd[1:3] == ["-m", "paddle_tpu.serving.worker"]
+        for flag, val in (("--store", "127.0.0.1:9"),
+                          ("--role", "decode"),
+                          ("--factory", "mod:factory"),
+                          ("--worker-id", wid),
+                          ("--lease-deadline-s", "3.0"),
+                          ("--seed", "7")):
+            assert val == cmd[cmd.index(flag) + 1] if flag != "--seed" \
+                else val in cmd
+        assert sp.reap() == {}              # still running
+        launched[0]._rc = 0
+        assert sp.reap() == {wid: 0}
+        assert sp.procs == {}
+
+
+# ---------------------------------------------------------------------------
+# cluster gateway (serving/gateway.py)
+# ---------------------------------------------------------------------------
+
+class TestClusterGatewayPolicy:
+    def _gw(self, store, **kw):
+        _seed_worker(store, "p0", "prefill")
+        ctl = ClusterController(store, retry=_retry())
+        return ClusterGateway(ctl, **kw)
+
+    def test_admit_then_rate_limited_with_retry_hint(self, store):
+        gw = self._gw(store, tenants={"free": TenantPolicy(
+            rate_tokens_per_s=1.0, burst_tokens=5.0)})
+        adm = gw.submit_request([1, 2], tenant="free", max_new_tokens=2)
+        assert adm.admitted and adm.request_id
+        shed = gw.submit_request([1, 2], tenant="free", max_new_tokens=2)
+        assert (shed.admitted, shed.reason) == (False, "rate_limited")
+        assert shed.retry_after_s > 0
+        assert gw.shed_counts == {"rate_limited": 1}
+
+    def test_quota_queue_full_and_slo_shed(self, store):
+        gw = self._gw(store, max_live=2, slo_queue_depth=1,
+                      tenants={"default": TenantPolicy(),
+                               "small": TenantPolicy(max_live_requests=1),
+                               "paid": TenantPolicy(priority=1)})
+        assert gw.submit_request([1], tenant="small").admitted
+        assert gw.submit_request(
+            [1], tenant="small").reason == "quota"
+        # backlog >= slo_queue_depth: default-tier (priority 0) sheds,
+        # the paid tier rides over the floor
+        assert gw.submit_request([1], tenant="default").reason \
+            == "slo_shed"
+        assert gw.submit_request([1], tenant="paid").admitted
+        # the gateway-wide live cap is last
+        assert gw.submit_request([1], tenant="paid").reason \
+            == "queue_full"
+
+    def test_gateway_fault_sheds_one_request_typed(self, store):
+        obs.enable(sinks=[obs.InMemorySink()], crash_hooks=False)
+        gw = self._gw(store)
+        inj = rs.install_faults("serve.gateway@0")
+        shed = gw.submit_request([1, 2, 3])
+        assert (shed.admitted, shed.reason) == (False, "gateway_fault")
+        assert ("serve.gateway", 0) in inj.fired
+        assert gw.submit_request([1, 2, 3]).admitted  # gateway survives
+        sink = obs.get_telemetry().sinks[0]
+        assert [e["reason"] for e in sink.events("serve_gateway")
+                if e.get("state") == "shed"] == ["gateway_fault"]
+
+    def test_duplicate_key_bypasses_policy_sheds(self, store):
+        gw = self._gw(store, max_live=1)
+        adm = gw.submit_request([1], idempotency_key="k-g")
+        assert adm.admitted
+        dup = gw.submit_request([1], idempotency_key="k-g")
+        assert dup.admitted and dup.request_id == adm.request_id
+        assert dup.reason == "duplicate" and gw.dup_hits == 1
+        assert gw.ctl.store.keys("cluster/journal/") \
+            == [f"cluster/journal/{adm.request_id}"]
+
+    def test_draining_sheds_typed(self, store):
+        gw = self._gw(store)
+        gw.begin_drain(reason="test")
+        shed = gw.submit_request([1])
+        assert (shed.admitted, shed.reason) == (False, "draining")
+        assert shed.retry_after_s == gw.drain_retry_after_s
+
+    def test_health_and_metrics_surface(self, store):
+        gw = self._gw(store)
+        gw.submit_request([1, 2])
+        h = gw.health()
+        assert h["status"] == "serving" and h["live_requests"] == 1
+        assert h["ctl_epoch"] == gw.ctl.ctl_epoch
+        text = gw.metrics_text()
+        assert "gateway_live_requests 1" in text
+        assert "gateway_draining 0" in text
+
+
+class TestClusterGatewayHTTP:
+    @pytest.fixture
+    def gw(self, store):
+        _seed_worker(store, "p0", "prefill")
+        ctl = ClusterController(store, retry=_retry())
+        gw = ClusterGateway(ctl, poll_s=0.002, output_timeout_s=20.0)
+        gw.start()
+        yield gw
+        gw.close()
+
+    def _post(self, gw, body, headers=None):
+        import http.client
+        host, port = gw.address
+        conn = http.client.HTTPConnection(host, port, timeout=20)
+        conn.request("POST", "/v1/completions", json.dumps(body),
+                     {"Content-Type": "application/json",
+                      **(headers or {})})
+        r = conn.getresponse()
+        out = (r.status, dict(r.getheaders()), r.read().decode())
+        conn.close()
+        return out
+
+    def _complete(self, gw, key, tokens):
+        """Worker stand-in: wait for the routed assignment of the
+        journaled key, then publish its fenced output record."""
+        store = gw.ctl.store
+        for _ in range(2000):
+            raw = store.get(f"cluster/jkey/{key}")
+            if raw is not None:
+                rid = raw.decode()
+                a = store.get(f"cluster/assign/{rid}")
+                if a is not None:
+                    a = json.loads(a)
+                    store.set(f"cluster/out/{rid}", json.dumps(
+                        {"tokens": tokens, "reason": "eos",
+                         "worker": a["wid"], "epoch": a["epoch"]}).encode())
+                    return rid
+            time.sleep(0.002)
+        raise AssertionError(f"key {key!r} never routed")
+
+    def test_post_sse_stream_and_idempotent_replay(self, gw):
+        import threading
+        done = threading.Thread(
+            target=self._complete, args=(gw, "k-http", [5, 6, 7]))
+        done.start()
+        code, hdrs, body = self._post(
+            gw, {"prompt": [1, 2, 3], "max_tokens": 4, "stream": True},
+            {"Idempotency-Key": "k-http"})
+        done.join()
+        assert code == 200
+        assert hdrs["Content-Type"] == "text/event-stream"
+        datas = [ln[len("data: "):] for ln in body.splitlines()
+                 if ln.startswith("data: ")]
+        assert datas[-1] == "[DONE]"
+        chunks = [json.loads(d) for d in datas[:-1]]
+        assert [c["choices"][0]["token_id"] for c in chunks] == [5, 6, 7]
+        assert chunks[-1]["choices"][0]["finish_reason"] == "eos"
+        rid = chunks[0]["id"]
+        # the duplicate POST: same rid, same stream, no new admission
+        code2, _, body2 = self._post(
+            gw, {"prompt": [1, 2, 3], "max_tokens": 4},
+            {"Idempotency-Key": "k-http"})
+        assert code2 == 200
+        rep = json.loads(body2)
+        assert rep["id"] == rid
+        assert rep["choices"][0]["token_ids"] == [5, 6, 7]
+        assert rep["usage"]["completion_tokens"] == 3
+        assert gw.ctl.store.keys("cluster/journal/") \
+            == [f"cluster/journal/{rid}"]
+        assert gw.dup_hits == 1
+
+    def test_drain_answers_typed_503_then_drains(self, gw):
+        import threading
+        done = threading.Thread(
+            target=self._complete, args=(gw, "k-dr", [9]))
+        done.start()
+        code, _, body = self._post(
+            gw, {"prompt": [1], "max_tokens": 2},
+            {"Idempotency-Key": "k-dr"})
+        done.join()
+        assert code == 200
+        gw.begin_drain(reason="test")
+        code, hdrs, body = self._post(gw, {"prompt": [1]})
+        assert code == 503
+        err = json.loads(body)["error"]
+        assert err["type"] == "draining"
+        assert int(hdrs["Retry-After"]) >= 1
+        assert gw.wait_drained(timeout=10.0)
+        assert gw.health()["status"] == "draining"
+
+    def test_malformed_body_is_400(self, gw):
+        code, _, body = self._post(gw, {"max_tokens": 2})
+        assert code == 400
+        assert json.loads(body)["error"]["type"] == "invalid_request"
+
+    def test_healthz_and_metrics_endpoints(self, gw):
+        import http.client
+        host, port = gw.address
+        for path, marker in (("/healthz", '"status": "serving"'),
+                             ("/metrics", "gateway_draining 0"),
+                             ("/nope", "not_found")):
+            conn = http.client.HTTPConnection(host, port, timeout=10)
+            conn.request("GET", path)
+            r = conn.getresponse()
+            assert marker in r.read().decode()
+            conn.close()
+
+
+class TestGatewayQueueCursor:
+    def test_gateway_request_queue_cursor_survives_restart(self, store):
+        """The gateway-facing submission queue (``gate/req``, consumed
+        by the cross-process controller helper) persists its consumer
+        cursor: a bounced consumer resumes exactly after the consumed
+        prefix — no replay, no hole-grinding."""
+        w = StoreQueue(store, "cluster/gate/req")
+        r1 = StoreQueue(store, "cluster/gate/req")
+        for i in range(3):
+            w.push({"i": i})
+        assert [x["i"] for x in r1.pop_all()] == [0, 1, 2]
+        assert store.get("cluster/gate/req/head") == b"3"
+        w.push({"i": 3})
+        r2 = StoreQueue(store, "cluster/gate/req")    # the bounce
+        assert [x["i"] for x in r2.pop_all()] == [3]
+        assert r2.holes == 0
+        assert store.get("cluster/gate/req/head") == b"4"

@@ -324,7 +324,8 @@ def gate_telemetry_overhead(iters: int = 100_000,
 
     from paddle_tpu.resilience import faults as rs_faults
     serve_sites = ("serve.admit", "serve.prefill", "serve.step",
-                   "serve.cow", "serve.swap")
+                   "serve.cow", "serve.swap", "serve.gateway",
+                   "cluster.journal", "cluster.takeover")
     missing = [s for s in serve_sites if s not in rs_faults.SITES]
     if missing:
         print(f"telemetry-overhead gate FAILED: serving fault sites "
@@ -476,6 +477,7 @@ def gate_telemetry_overhead(iters: int = 100_000,
     # nor the store's telemetry keys (write audit) — and each disabled
     # publisher call stays O(µs).
     from paddle_tpu.serving import cluster as cluster_mod
+    from paddle_tpu.serving import gateway as gateway_mod
     from paddle_tpu.serving import worker as worker_mod
 
     class _DictStore:
@@ -545,6 +547,14 @@ def gate_telemetry_overhead(iters: int = 100_000,
         fw.publish_status()
         ctl = cluster_mod.ClusterController(dstore, autoscale=True)
         ctl.pump()
+        # the gateway's admission path rides the contract too: with
+        # telemetry disabled an admit (through the controller's durable
+        # journal) and a typed policy shed touch neither registry nor
+        # sinks (serving/gateway.py guards every emit)
+        fgw = gateway_mod.ClusterGateway(ctl, max_live=1)
+        gw_admit = fgw.submit_request([1, 2, 3], max_new_tokens=2,
+                                      idempotency_key="gate-k")
+        gw_shed = fgw.submit_request([1, 2, 3], max_new_tokens=2)
         pub_iters = 20_000
         t0 = time.perf_counter()
         for _ in range(pub_iters):
@@ -554,14 +564,20 @@ def gate_telemetry_overhead(iters: int = 100_000,
         pub_us = (time.perf_counter() - t0) / pub_iters * 1e6
     except AssertionError:
         print("telemetry-overhead gate FAILED: the disabled-telemetry "
-              "fleet plane (worker publish / controller pump) touched "
-              "the registry / tracer / aggregation layer — every site "
-              "must be one falsy check (serving/worker.py, "
-              "serving/cluster.py)")
+              "fleet plane (worker publish / controller pump / gateway "
+              "admission) touched the registry / tracer / aggregation "
+              "layer — every site must be one falsy check "
+              "(serving/worker.py, serving/cluster.py, "
+              "serving/gateway.py)")
         return 1
     finally:
         for (cls, name), fn in saved.items():
             setattr(cls, name, fn)
+    if not gw_admit.admitted or gw_shed.admitted \
+            or gw_shed.reason != "queue_full":
+        print(f"telemetry-overhead gate FAILED: gateway stub decisions "
+              f"wrong ({gw_admit}, {gw_shed})")
+        return 1
     leaked = [k for k in dstore.writes
               if "/telemetry/" in k or "/trace/" in k
               or k.endswith("/clock")]
@@ -1982,7 +1998,22 @@ def gate_serving_cluster(n_prefill: int = 2, n_decode: int = 2) -> int:
     rollups with fleet tokens advancing between scrapes; and after the
     waves drain, EVERY request has one stitched cross-host timeline —
     ≥ 2 hosts, per-segment exact-sum phase accounting, a positive xfer
-    phase, monotonic after clock-skew correction."""
+    phase, monotonic after clock-skew correction.
+
+    Phase B kills the CONTROLLER: an active controller subprocess
+    (tests/cluster_controller.py, 3s ``ControllerLease``, transient
+    ``cluster.journal`` fault in its submit path) journals keyed
+    submissions and is SIGKILLed mid-churn; an in-gate standby
+    follower takes over off the stale lease (first attempt aborted by
+    an injected ``cluster.takeover`` fault), replays the journal, and
+    every re-submitted ``Idempotency-Key`` resolves to the SAME rid —
+    token-identical, zero duplicate admissions, ctl epoch bumped past
+    the corpse.  A ``ClusterGateway`` smoke over the winner then
+    demands: SSE stream off the fenced record token-identical to the
+    colocated refs, a duplicate Idempotency-Key POST replaying the
+    same rid, and a draining gateway shedding the typed 503 +
+    Retry-After.  Worker drain + exit-report audits (0 compiles, all
+    blocks reclaimed) run through the takeover winner."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import http.client
     import re as _re
@@ -2037,6 +2068,8 @@ def gate_serving_cluster(n_prefill: int = 2, n_decode: int = 2) -> int:
     roles = ["prefill"] * n_prefill + ["decode"] * n_decode
     procs = {}
     reports = {}
+    ctl_proc = None
+    gw = None
     try:
         for i, role in enumerate(roles):
             wid = f"cw{i}-{role}"
@@ -2098,15 +2131,16 @@ def gate_serving_cluster(n_prefill: int = 2, n_decode: int = 2) -> int:
                 if time.time() > deadline:
                     raise
 
-        def pump_until(rids, *, timeout_s=240.0, may_exit=()):
+        def pump_until(rids, *, timeout_s=240.0, may_exit=(), c=None):
+            c = ctl if c is None else c
             end = time.time() + timeout_s
             while time.time() < end:
-                ctl.pump()
-                if all(r in ctl.outputs for r in rids):
+                c.pump()
+                if all(r in c.outputs for r in rids):
                     return
                 alive_or_fail(may_exit)
                 time.sleep(0.01)
-            missing = [r for r in rids if r not in ctl.outputs]
+            missing = [r for r in rids if r not in c.outputs]
             raise RuntimeError(f"undelivered: {missing}")
 
         # wave 1: plain disagg churn across the fleet
@@ -2231,10 +2265,202 @@ def gate_serving_cluster(n_prefill: int = 2, n_decode: int = 2) -> int:
             if len(failures) > n_fail0:
                 break                # one broken timeline is enough
 
-        # drain the survivors and audit their exit reports
+        # ---- phase B: the controller is as killable as the workers
+        # (docs/SERVING.md "Cluster serving" failure matrix).  An
+        # ACTIVE controller subprocess under a 3s ControllerLease —
+        # with a transient cluster.journal fault injected into its
+        # submit path — journals keyed submissions pushed through the
+        # store-backed gate/req queue and acks each key's rid AFTER
+        # the durable journal write.  It is SIGKILLed mid-churn; the
+        # in-gate standby follower must take over off the stale lease
+        # (first attempt aborted by an injected cluster.takeover
+        # fault), replay the journal, and answer EVERY re-submitted
+        # idempotency key with the SAME rid it acked — token-identical
+        # outputs, zero duplicate admissions, zero recompiles.
+        from paddle_tpu import resilience as rs
+        env_ctl = {**env, "PDTPU_FAULTS": "cluster.journal@1"}
+        ctl_proc = subprocess.Popen(
+            [sys.executable,
+             os.path.join(REPO, "tests", "cluster_controller.py"),
+             "--store", store.endpoint, "--lease-deadline-s", "3",
+             "--worker-lease-deadline-s", "6"],
+            env=env_ctl, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+
+        def ctl_proc_alive_or_fail():
+            if ctl_proc.poll() is not None:
+                out_, err_ = ctl_proc.communicate(timeout=10)
+                raise RuntimeError(
+                    f"controller subprocess died early "
+                    f"rc={ctl_proc.returncode}\n{out_}\n{err_}")
+
+        end = time.time() + 300
+        while store.get("cluster/ctl/lease") is None:
+            ctl_proc_alive_or_fail()
+            if time.time() > end:
+                raise RuntimeError(
+                    "controller subprocess never acquired the lease")
+            time.sleep(0.05)
+        standby = serving.ClusterController(
+            store, follower=True, lease_deadline_s=6.0,
+            lease=serving.ControllerLease(store, holder="standby",
+                                          deadline_s=3.0))
+        req_q = serving.StoreQueue(store, "cluster/gate/req")
+        bkeys = [f"bk-{i}" for i in range(2 * len(lens))]
+        for i, key in enumerate(bkeys):
+            req_q.push({"prompt": prompts[i % len(lens)].tolist(),
+                        "max_new_tokens": 8, "key": key})
+        end = time.time() + 300
+        while sum(store.get(f"cluster/gate/ack/{k}") is not None
+                  for k in bkeys) < 2:
+            ctl_proc_alive_or_fail()
+            if time.time() > end:
+                raise RuntimeError(
+                    "controller subprocess never acked a submission")
+            time.sleep(0.02)
+        ctl_proc.kill()
+        killed_at = time.time()
+        acked = {}
+        for k in bkeys:
+            raw = store.get(f"cluster/gate/ack/{k}")
+            if raw is not None:
+                acked[k] = raw.decode()
+
+        inj = rs.install_faults("cluster.takeover@0")
+        try:
+            end = time.time() + 120
+            while standby.follower and time.time() < end:
+                standby.pump()
+                time.sleep(0.02)
+            took = time.time() - killed_at
+            if standby.follower:
+                raise RuntimeError(
+                    "standby never took over the stale controller lease")
+        finally:
+            rs.clear_faults()
+        if ("cluster.takeover", 0) not in inj.fired:
+            failures.append(
+                "the injected cluster.takeover fault never fired — the "
+                "takeover-abort path went unexercised")
+        if took > 8.0:
+            failures.append(
+                f"standby takeover took {took:.1f}s after the "
+                "controller SIGKILL — the 3s lease staleness window "
+                "was missed by more than the allowed slack")
+        if standby.ctl_epoch < 3:
+            failures.append(
+                f"standby ctl epoch {standby.ctl_epoch} was not bumped "
+                "past the killed controller's — zombie writes unfenced")
+
+        # re-submit EVERY key through the standby: acked keys must
+        # resolve to the SAME rid (journal dedupe across controllers);
+        # unacked keys land in the crash window (journaled-but-unacked
+        # dedupes too; never-submitted admits fresh) — either way one
+        # rid per key, one jkey index entry, no duplicate output
+        rids_b = {}
+        for i, key in enumerate(bkeys):
+            rids_b[key] = standby.submit(
+                prompts[i % len(lens)], max_new_tokens=8,
+                idempotency_key=key)
+        for key, rid in acked.items():
+            if rids_b[key] != rid:
+                failures.append(
+                    f"idempotency key {key} re-submitted through the "
+                    f"standby got rid {rids_b[key]} but the killed "
+                    f"controller acked {rid} — duplicate admission")
+        if len(set(rids_b.values())) != len(bkeys):
+            failures.append(
+                f"{len(bkeys)} idempotency keys mapped onto "
+                f"{len(set(rids_b.values()))} rids")
+        pump_until(list(rids_b.values()), may_exit=(victim,), c=standby)
+        for i, key in enumerate(bkeys):
+            if standby.outputs[rids_b[key]]["tokens"] \
+                    != refs[8][i % len(lens)]:
+                failures.append(
+                    f"phase-B request {key} diverged after the "
+                    "controller failover — journal replay is not "
+                    "token-preserving")
+                break
+        for key in bkeys:
+            raw = store.get(f"cluster/jkey/{key}")
+            if raw is None or raw.decode() != rids_b[key]:
+                failures.append(
+                    f"jkey index for {key} is {raw!r}, expected "
+                    f"{rids_b[key]} — lost or duplicated journal index")
+                break
+
+        # ---- gateway smoke over the takeover winner: POST → SSE off
+        # the fenced output record, a duplicate Idempotency-Key POST
+        # replays the SAME rid, and a draining gateway sheds a typed
+        # 503 + Retry-After.  The gateway's pump loop owns the
+        # controller from here until close().
+        gw = serving.ClusterGateway(standby, poll_s=0.005)
+        gw_host, gw_port = gw.start()
+
+        def gpost(body, headers=None):
+            conn = http.client.HTTPConnection(gw_host, gw_port,
+                                              timeout=240)
+            conn.request("POST", "/v1/completions",
+                         body=json.dumps(body),
+                         headers={"Content-Type": "application/json",
+                                  **(headers or {})})
+            r = conn.getresponse()
+            data = r.read().decode()
+            hdrs = {k.lower(): v for k, v in r.getheaders()}
+            conn.close()
+            return r.status, data, hdrs
+
+        st, data, _h = gpost(
+            {"prompt": prompts[0].tolist(), "max_tokens": 8,
+             "stream": True},
+            {"Idempotency-Key": "gw-0"})
+        sse_toks, gw_rid, fin = [], None, None
+        for ln in data.splitlines():
+            if not ln.startswith("data: ") or ln == "data: [DONE]":
+                continue
+            ev = json.loads(ln[len("data: "):])
+            gw_rid = ev.get("id", gw_rid)
+            for ch in ev.get("choices", []):
+                if "token_id" in ch:
+                    sse_toks.append(ch["token_id"])
+                fin = ch.get("finish_reason") or fin
+        if st != 200 or sse_toks != list(refs[8][0]) or fin is None \
+                or "data: [DONE]" not in data:
+            failures.append(
+                f"gateway SSE stream answered {st} with tokens "
+                f"{sse_toks} (finish {fin!r}) — expected the colocated "
+                "reference stream")
+        st2, data2, _h2 = gpost(
+            {"prompt": prompts[0].tolist(), "max_tokens": 8},
+            {"Idempotency-Key": "gw-0"})
+        rep2 = json.loads(data2)
+        if st2 != 200 or rep2.get("id") != gw_rid \
+                or rep2["choices"][0]["token_ids"] != list(refs[8][0]):
+            failures.append(
+                f"duplicate Idempotency-Key POST answered {st2} id "
+                f"{rep2.get('id')!r} — expected the SAME rid "
+                f"({gw_rid!r}) and stream, never a second admission")
+        gw.begin_drain(reason="gate")
+        st3, data3, h3 = gpost(
+            {"prompt": prompts[0].tolist(), "max_tokens": 8})
+        err3 = json.loads(data3).get("error", {})
+        if st3 != 503 or err3.get("type") != "draining" \
+                or "retry-after" not in h3:
+            failures.append(
+                f"draining gateway answered {st3} {err3!r} "
+                f"(Retry-After: {h3.get('retry-after')!r}) — expected "
+                "the typed 503 with a retry hint")
+        if not gw.wait_drained(timeout=60):
+            failures.append("gateway never drained its live requests")
+        gw.close()
+        gw = None
+
+        # drain the survivors and audit their exit reports — through
+        # the takeover winner: its bumped ctl epoch must still command
+        # the fleet
         for wid in procs:
             if wid != victim:
-                ctl.drain_worker(wid)
+                standby.drain_worker(wid)
         for wid, p in procs.items():
             if wid == victim:
                 continue
@@ -2307,12 +2533,26 @@ def gate_serving_cluster(n_prefill: int = 2, n_decode: int = 2) -> int:
                   f"reclaimed, 0 lease losses on the survivors; "
                   f"/metrics scraped valid per-worker + fleet rollups "
                   f"mid-churn and every request stitched into one "
-                  f"cross-host timeline")
+                  f"cross-host timeline; controller SIGKILL mid-churn "
+                  f"→ standby controller takeover in {took:.1f}s "
+                  f"(epoch {standby.ctl_epoch}), journal replayed, all "
+                  f"{len(bkeys)} re-submitted idempotency keys "
+                  f"answered with the same rid — zero duplicates; "
+                  f"gateway smoke: SSE stream token-identical, "
+                  f"duplicate Idempotency-Key POST replayed the same "
+                  f"rid, drain answered the typed 503")
     finally:
         try:
             ctl.close_http()
         except Exception:  # noqa: BLE001 — ctl may not exist
             pass
+        if gw is not None:
+            try:
+                gw.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        if ctl_proc is not None and ctl_proc.poll() is None:
+            ctl_proc.kill()
         for p in procs.values():
             if p.poll() is None:
                 p.kill()

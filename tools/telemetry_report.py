@@ -180,7 +180,14 @@ def summarize(events):
                     "upgrades": 0, "upgrade_ms": [],
                     "lease_losses": 0, "autoscales": 0,
                     "transfer_failures": 0,
-                    "stale": defaultdict(int)},
+                    "stale": defaultdict(int),
+                    # controller durability (docs/SERVING.md "Durable
+                    # gateway"): lease takeovers, journal replay/dedupe,
+                    # zombie fencing, spawner elasticity, gateway sheds
+                    "takeovers": 0, "takeover_retries": 0, "fenced": 0,
+                    "journal_replays": 0, "journal_replayed": 0,
+                    "journal_dups": 0, "spawns": 0, "scale_downs": 0,
+                    "gateway_sheds": defaultdict(int)},
         # request-lifecycle traces (docs/OBSERVABILITY.md "Tracing a
         # request"): one serve_trace event per retired request carries
         # the exact per-phase breakdown queue/prefill/decode
@@ -333,6 +340,24 @@ def summarize(events):
         elif kind in ("cluster_stale_command", "cluster_stale_item",
                       "cluster_stale_out"):
             agg["cluster"]["stale"][kind[len("cluster_stale_"):]] += 1
+        elif kind == "cluster_takeover":
+            agg["cluster"]["takeovers"] += 1
+        elif kind == "cluster_takeover_retry":
+            agg["cluster"]["takeover_retries"] += 1
+        elif kind == "cluster_fenced":
+            agg["cluster"]["fenced"] += 1
+        elif kind == "cluster_journal_replay":
+            cl = agg["cluster"]
+            cl["journal_replays"] += 1
+            cl["journal_replayed"] += e.get("replayed") or 0
+        elif kind == "cluster_journal_dup":
+            agg["cluster"]["journal_dups"] += 1
+        elif kind == "cluster_spawn":
+            agg["cluster"]["spawns"] += 1
+        elif kind == "cluster_scale_down":
+            agg["cluster"]["scale_downs"] += 1
+        elif kind == "serve_gateway" and e.get("state") == "shed":
+            agg["cluster"]["gateway_sheds"][e.get("reason") or "?"] += 1
         elif kind == "recompile_storm":
             agg["storms"].append(e)
         elif kind == "preemption":
@@ -698,6 +723,23 @@ def render(agg, malformed=0):
             stale = ", ".join(f"{k}: {n}" for k, n in
                               sorted(cl["stale"].items()))
             lines.append(f"| epoch-fence drops (by kind) | {stale} |")
+        if cl["takeovers"] or cl["takeover_retries"] or cl["fenced"]:
+            lines.append(
+                f"| controller takeovers (retried / fenced zombies) | "
+                f"{cl['takeovers']} ({cl['takeover_retries']} / "
+                f"{cl['fenced']}) |")
+        if cl["journal_replays"] or cl["journal_dups"]:
+            lines.append(
+                f"| journal replays (entries) / idempotent dups | "
+                f"{cl['journal_replays']} ({cl['journal_replayed']}) / "
+                f"{cl['journal_dups']} |")
+        if cl["spawns"] or cl["scale_downs"]:
+            lines.append(f"| worker spawns / scale-downs | "
+                         f"{cl['spawns']} / {cl['scale_downs']} |")
+        if cl["gateway_sheds"]:
+            sheds = ", ".join(f"{k}: {n}" for k, n in
+                              sorted(cl["gateway_sheds"].items()))
+            lines.append(f"| gateway sheds (by reason) | {sheds} |")
         lines.append("")
     for r in agg["resumes"]:
         lines.append(f"**RESUME**: step {r.get('step')} from "
@@ -949,7 +991,16 @@ def main(argv=None) -> int:
             "autoscale_flips": cl["autoscales"],
             "transfer_failures": cl["transfer_failures"],
             "commands": dict(sorted(cl["commands"].items())),
-            "stale_drops": dict(sorted(cl["stale"].items()))}
+            "stale_drops": dict(sorted(cl["stale"].items())),
+            "takeovers": cl["takeovers"],
+            "takeover_retries": cl["takeover_retries"],
+            "fenced_controllers": cl["fenced"],
+            "journal_replays": cl["journal_replays"],
+            "journal_replayed_entries": cl["journal_replayed"],
+            "journal_dups": cl["journal_dups"],
+            "worker_spawns": cl["spawns"],
+            "worker_scale_downs": cl["scale_downs"],
+            "gateway_sheds": dict(sorted(cl["gateway_sheds"].items()))}
     if agg["traces"]:
         summary["trace_phases"] = _phase_stats(agg["traces"])
         summary["trace_tenants"] = _tenant_stats(agg)
