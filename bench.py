@@ -134,19 +134,21 @@ def main():
     ap = argparse.ArgumentParser(description=__doc__)
     # the one-flag fused-kernel A/B (docs/KERNELS.md): --fused off is
     # the pre-fusion baseline, --fused on forces the fused entry points
-    # everywhere, auto (default) fuses where a kernel serves.  Env
+    # everywhere, auto (default) fuses where a kernel serves, mega
+    # additionally collapses each cached decoder layer into the
+    # one-dispatch megakernel ("Decode megakernel").  Env
     # PDTPU_BENCH_FUSED_OPS backs the flag for driver scripts.
-    ap.add_argument("--fused", choices=("on", "off", "auto"),
+    ap.add_argument("--fused", choices=("on", "off", "auto", "mega"),
                     default=os.environ.get("PDTPU_BENCH_FUSED_OPS",
                                            "auto"))
     args, _ = ap.parse_known_args()
     fused_ops = args.fused
-    if fused_ops not in ("on", "off", "auto"):
+    if fused_ops not in ("on", "off", "auto", "mega"):
         # argparse only validates choices for EXPLICIT flags — a typo'd
         # env default would otherwise die mid-trace, long after telemetry
         # already recorded the bogus mode
         ap.error(f"PDTPU_BENCH_FUSED_OPS={fused_ops!r}: expected "
-                 "on|off|auto")
+                 "on|off|auto|mega")
     on_tpu = jax.default_backend() != "cpu"
     preset = os.environ.get("PDTPU_BENCH_PRESET",
                             "llama-350m" if on_tpu else "tiny")
@@ -425,6 +427,32 @@ def main():
                                   "active_adapters")}
         except Exception as e:  # noqa: BLE001
             extra["serve_lora_error"] = f"{type(e).__name__}: {e}"[:300]
+
+        # decode megakernel (docs/KERNELS.md "Decode megakernel"): bs=1
+        # paged decode with the whole decoder layer in ONE dispatch
+        # (fused_ops="mega") vs the per-stage fused path.  Rows are
+        # backend-tagged (serve_mega vs serve_mega_cpu) so TPU numbers
+        # never gate against the CPU baseline; off the chip the Pallas
+        # kernel declines and the honest signal is the recorded
+        # dispatches-per-step delta, not the tok/s ratio.
+        try:
+            from decode_bench import bench_decode_mega
+            with contextlib.redirect_stdout(sys.stderr):
+                if on_tpu:
+                    r = bench_decode_mega()
+                else:
+                    r = bench_decode_mega(preset="tiny", prefill=16,
+                                          max_new=24, repeats=2)
+            pre = "serve_mega" if on_tpu else "serve_mega_cpu"
+            extra[f"{pre}_tok_s"] = r["mega_tok_s"]
+            extra[f"{pre}_vs_fused_on"] = r["vs_fused_on"]
+            extra[f"{pre}_dispatches_per_step"] = \
+                r["mega_dispatches_per_step"]
+            extra[f"{pre}_detail"] = {
+                k: r[k] for k in ("preset", "prefill", "max_new_tokens",
+                                  "on_tok_s", "on_dispatches_per_step")}
+        except Exception as e:  # noqa: BLE001
+            extra["serve_mega_error"] = f"{type(e).__name__}: {e}"[:300]
 
         # sharded serving (docs/SERVING.md "Sharded serving"): the
         # TP-partitioned engine and the DP replica router need >= 2

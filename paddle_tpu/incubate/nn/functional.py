@@ -739,6 +739,114 @@ def ragged_paged_attend(cache, q, new_k, new_v, block_tables, span_starts,
     return _ragged_attend_dense(q, kd, vd, span_starts, scale), new_cache
 
 
+def _mega_decode_layer_ref(x, norm_weight, w_q, w_k, w_v, w_o, cos, sin,
+                           cache, block_tables, span_starts, span_lens,
+                           head_dim, eps, scale):
+    """THE megakernel's numerical contract: the existing fused
+    entry points chained — :func:`fused_rms_rope_qkv` →
+    :func:`ragged_paged_attend` → O-proj (f32 accumulation, x.dtype
+    rounding exactly where the kernel rounds) → residual add.  This is
+    what runs on CPU, under meshes, for int8 KV pools (whose
+    gather+dequant lives inside :func:`ragged_paged_attend`), and
+    wherever ``mega_decode.supported()`` declines; the interpret-mode
+    equivalence tests (tests/test_mega_decode.py) pin the Pallas kernel
+    to it."""
+    b, c, h = x.shape
+    q, k, v = fused_rms_rope_qkv(
+        x.reshape(b * c, h), norm_weight, w_q, w_k, w_v,
+        cos.reshape(b * c, head_dim), sin.reshape(b * c, head_dim),
+        head_dim, eps)
+    nh = q.shape[-1] // head_dim
+    nkh = k.shape[-1] // head_dim
+    attn, new_cache = ragged_paged_attend(
+        cache, q.reshape(b, c, nh, head_dim),
+        k.reshape(b, c, nkh, head_dim), v.reshape(b, c, nkh, head_dim),
+        block_tables, span_starts, span_lens, scale=scale)
+    p = _prec(x.dtype)
+    y = jax.lax.dot(attn.reshape(b * c, nh * head_dim),
+                    w_o.astype(x.dtype), precision=p,
+                    preferred_element_type=jnp.float32)
+    return x + y.astype(x.dtype).reshape(b, c, h), new_cache
+
+
+def _mega_decode_layer_impl(x, norm_weight, w_q, w_k, w_v, w_o, cos, sin,
+                            cache, block_tables, span_starts, span_lens,
+                            head_dim, eps, scale):
+    from ...ops import dispatch as _dispatch
+    kernel = _dispatch.get("mega_decode_layer")
+    if kernel is not None and len(cache) == 2:
+        xd = x.dtype
+        res = kernel(x, norm_weight, w_q.astype(xd), w_k.astype(xd),
+                     w_v.astype(xd), w_o.astype(xd), cos, sin,
+                     cache[0], cache[1], block_tables, span_starts,
+                     span_lens, head_dim, eps, scale=scale)
+        if res is not None:
+            out, k_new, v_new = res
+            # the pool scatter stays the ONE shared _paged_span_write
+            # (same OOB dead-slot drop, same dtype rounding) — the
+            # kernel only computes the span k/v, it never touches the
+            # pools' write path
+            nkh = k_new.shape[-1] // head_dim
+            b, c = k_new.shape[:2]
+            new_cache = _paged_span_write(
+                cache, k_new.reshape(b, c, nkh, head_dim),
+                v_new.reshape(b, c, nkh, head_dim), block_tables,
+                span_starts, span_lens)
+            return out, new_cache
+    return _mega_decode_layer_ref(x, norm_weight, w_q, w_k, w_v, w_o,
+                                  cos, sin, cache, block_tables,
+                                  span_starts, span_lens, head_dim, eps,
+                                  scale)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(12, 13, 14))
+def mega_decode_layer(x, norm_weight, w_q, w_k, w_v, w_o, cos, sin,
+                      cache, block_tables, span_starts, span_lens,
+                      head_dim, eps=1e-5, scale=None):
+    """One decoder layer's whole ragged attention block —
+    rms_norm → q/k/v projections → rotate-half rope → ragged paged
+    attention (span write included) → O-proj → residual — as ONE entry
+    point, dispatching to the decode megakernel on TPU
+    (ops/pallas/mega_decode.py: one Pallas dispatch per layer,
+    activations VMEM-resident between stages) and running the pinned
+    XLA composition everywhere else.
+
+    x: (B, C, H) residual-stream span batch (UN-normed; the rms-norm
+    happens inside); norm_weight: (H,); w_q: (H, Nq); w_k/w_v: (H, Nk);
+    w_o: (Nq, H); cos/sin: (B, C, head_dim) per-slot rope tables;
+    ``cache``/``block_tables``/``span_starts``/``span_lens`` exactly as
+    :func:`ragged_paged_attend`.  Returns ``(x + o_proj(attend),
+    new_cache)``.  The custom VJP recomputes through the composition
+    (the library's remat recipe) — and makes the whole block ONE closed
+    call in the traced step, which is what the Engine's
+    ``dispatches_per_step`` gauge counts.
+    """
+    return _mega_decode_layer_impl(x, norm_weight, w_q, w_k, w_v, w_o,
+                                   cos, sin, cache, block_tables,
+                                   span_starts, span_lens, head_dim, eps,
+                                   scale)
+
+
+def _mega_decode_layer_fwd(x, norm_weight, w_q, w_k, w_v, w_o, cos, sin,
+                           cache, block_tables, span_starts, span_lens,
+                           head_dim, eps, scale):
+    out = _mega_decode_layer_impl(x, norm_weight, w_q, w_k, w_v, w_o,
+                                  cos, sin, cache, block_tables,
+                                  span_starts, span_lens, head_dim, eps,
+                                  scale)
+    return out, (x, norm_weight, w_q, w_k, w_v, w_o, cos, sin, cache,
+                 block_tables, span_starts, span_lens)
+
+
+def _mega_decode_layer_bwd(head_dim, eps, scale, res, ct):
+    _, vjp = jax.vjp(
+        lambda *a: _mega_decode_layer_ref(*a, head_dim, eps, scale), *res)
+    return vjp(ct)
+
+
+mega_decode_layer.defvjp(_mega_decode_layer_fwd, _mega_decode_layer_bwd)
+
+
 def paged_copy_blocks(cache, src_blocks, dst_blocks):
     """Copy whole pages ``src_blocks[i] → dst_blocks[i]`` inside the
     paged pools — the device half of copy-on-write block sharing

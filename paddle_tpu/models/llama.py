@@ -57,10 +57,15 @@ class LlamaConfig:
     fuse_qkv_mlp: bool = False      # trace-time concat of qkv / gate+up kernels
     # fused-kernel library (docs/KERNELS.md): "on" routes norm+rope+qkv
     # and the swiglu MLP through incubate's fused entry points (Pallas
-    # kernels on TPU, the equivalent XLA composition elsewhere); "auto"
-    # fuses only where a kernel will actually serve (TPU, no mesh, not
-    # vetoed by tools/tuned_configs.json) so CPU behavior is unchanged;
-    # "off" keeps the unfused projections.  Takes precedence over
+    # kernels on TPU, the equivalent XLA composition elsewhere); "mega"
+    # is "on" plus the decode megakernel — the whole decoder-layer
+    # attention block (norm→qkv→rope→ragged attention→o_proj+residual)
+    # as ONE dispatch on the ragged serving step
+    # (ops/pallas/mega_decode.py; XLA composition off-TPU and wherever
+    # its supported() gate declines); "auto" fuses only where a kernel
+    # will actually serve (TPU, no mesh, not vetoed by
+    # tools/tuned_configs.json) so CPU behavior is unchanged; "off"
+    # keeps the unfused projections.  Takes precedence over
     # fuse_qkv_mlp where both apply.
     fused_ops: str = "auto"
     dtype: str = "float32"
@@ -415,10 +420,66 @@ class LlamaDecoderLayer(Layer):
             return x, self.input_layernorm.weight
         return self.input_layernorm(x), None
 
+    def _use_mega(self, x, cache) -> bool:
+        """Trace-time gate for the decode megakernel (the whole
+        attention block as one dispatch — ops/pallas/mega_decode.py).
+        ``"mega"`` always takes the entry point (which still falls back
+        to its XLA composition where the kernel cannot serve, e.g. int8
+        KV pools); ``"auto"`` takes it only when the kernel will
+        actually run — dispatch live AND ``supported()`` accepting this
+        geometry, pool and VMEM footprint.  Quantized projections and
+        sequence parallel step aside inside ``_use_fused``; the LoRA
+        path never reaches here (the caller pins unfused)."""
+        cfg = self.cfg
+        mode = getattr(cfg, "fused_ops", "off")
+        if mode not in ("mega", "auto"):
+            return False
+        from ..ops.tuning import geom_key
+        hd = cfg.head_dim
+        key = geom_key(h=cfg.hidden_size,
+                       nq=cfg.num_attention_heads * hd,
+                       nk=cfg.num_key_value_heads * hd, hd=hd)
+        attn = self.self_attn
+
+        def _kernel_serves():
+            from ..ops.pallas import mega_decode as _md
+            return _md.supported(x, attn.q_proj.weight,
+                                 attn.k_proj.weight, attn.o_proj.weight,
+                                 hd, cache=cache)
+
+        return _use_fused(cfg, "mega_decode_layer", key,
+                          probe=_kernel_serves,
+                          layers=(attn.q_proj, attn.k_proj, attn.v_proj,
+                                  attn.o_proj))
+
     def forward(self, x, cos, sin, attn_mask=None, cache=None,
                 seq_lens=None, block_tables=None, span_starts=None,
                 lora=None):
         if cache is not None:
+            if (span_starts is not None and block_tables is not None
+                    and lora is None and self._use_mega(x, cache)):
+                # decode megakernel: the whole attention block — norm →
+                # qkv → rope → ragged paged attention → o_proj +
+                # residual — as ONE entry point (one Pallas dispatch on
+                # TPU, the pinned XLA composition elsewhere)
+                from ..incubate.nn.functional import mega_decode_layer
+                cfg = self.cfg
+                b, s = x.shape[:2]
+                hd = cfg.head_dim
+                if cos.ndim == 2:
+                    cos2 = jnp.broadcast_to(cos[None], (b, s, hd))
+                    sin2 = jnp.broadcast_to(sin[None], (b, s, hd))
+                else:
+                    cos2, sin2 = cos, sin
+                attn = self.self_attn
+                x, cache = mega_decode_layer(
+                    x, self.input_layernorm.weight, attn.q_proj.weight,
+                    attn.k_proj.weight, attn.v_proj.weight,
+                    attn.o_proj.weight, cos2, sin2, cache, block_tables,
+                    span_starts, seq_lens, hd, cfg.rms_norm_eps)
+                x = x + self.mlp(self.post_attention_layernorm(x),
+                                 lora=lora)
+                return x, cache
             if lora is None:
                 attn_in, nw = self._attn_input(x)
             else:

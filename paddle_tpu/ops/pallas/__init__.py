@@ -152,6 +152,24 @@ def _fused_adamw_dispatch(p, g, m, v, lr, c1, c2, *, beta1, beta2, eps,
 
 dispatch.register("fused_adamw", _fused_adamw_dispatch, platform="tpu")
 
+from . import mega_decode as _md
+
+
+def _mega_decode_layer_dispatch(x, norm_weight, w_q, w_k, w_v, w_o, cos,
+                                sin, k_pool, v_pool, block_tables, starts,
+                                lens, head_dim, eps, scale=None):
+    if _active_mesh() is not None \
+            or not _md.supported(x, w_q, w_k, w_o, head_dim,
+                                 cache=(k_pool, v_pool)):
+        return None
+    return _md.mega_decode(x, norm_weight, w_q, w_k, w_v, w_o, cos, sin,
+                           k_pool, v_pool, block_tables, starts, lens,
+                           head_dim=head_dim, eps=eps, scale=scale)
+
+
+dispatch.register("mega_decode_layer", _mega_decode_layer_dispatch,
+                  platform="tpu")
+
 from . import lora_matmul as _lora
 
 

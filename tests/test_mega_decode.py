@@ -1,0 +1,306 @@
+"""Decode megakernel (docs/KERNELS.md "Decode megakernel"): interpret-mode
+kernel vs the pinned ``mega_decode_layer`` XLA composition vs the fully
+unfused path, plus the model/engine wiring and the dispatch-count A/B.
+
+The composition (``incubate.nn.functional._mega_decode_layer_ref``) is
+the numerical contract: what runs on CPU, under meshes, for int8 KV
+pools, and wherever ``mega_decode.supported()`` declines.  A drift here
+would make a ``fused_ops="mega"`` TPU engine disagree with CPU CI."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.incubate.nn import functional as IF
+from paddle_tpu.nn import functional as F
+from paddle_tpu.ops import tuning
+from paddle_tpu.ops.pallas import mega_decode as MD
+
+R = np.random.default_rng(0)
+
+
+def _arr(*shape, dtype=jnp.float32, scale=0.1):
+    return jnp.asarray(R.normal(size=shape) * scale, dtype)
+
+
+def _tol(dtype):
+    return dict(rtol=2e-2, atol=2e-2) if dtype == jnp.bfloat16 \
+        else dict(rtol=2e-5, atol=2e-5)
+
+
+def _case(dtype, b, c, h, nh, nkh, hd, page, nb, mb, starts, lens,
+          int8=False):
+    """One ragged layer case: weights, per-slot rope tables at the span
+    positions, a randomized pool, and a permuted block table."""
+    x = _arr(b, c, h, dtype=dtype, scale=1.0)
+    gw = jnp.asarray(1.0 + 0.1 * R.normal(size=(h,)), dtype)
+    wq, wk, wv = (_arr(h, nh * hd, dtype=dtype),
+                  _arr(h, nkh * hd, dtype=dtype),
+                  _arr(h, nkh * hd, dtype=dtype))
+    wo = _arr(nh * hd, h, dtype=dtype)
+    st = jnp.asarray(np.asarray(starts, np.int32))
+    ln = jnp.asarray(np.asarray(lens, np.int32))
+    cos, sin = F.rope_cos_sin(
+        c, hd, dtype=dtype,
+        position_ids=st[:, None] + jnp.arange(c)[None, :])
+    kp = _arr(nb, page, nkh, hd, dtype=dtype, scale=0.5)
+    vp = _arr(nb, page, nkh, hd, dtype=dtype, scale=0.5)
+    if int8:
+        kq, ks = IF.quantize_kv(kp)
+        vq, vs = IF.quantize_kv(vp)
+        cache = (kq, vq, ks, vs)
+    else:
+        cache = (kp, vp)
+    tables = jnp.asarray(
+        R.permutation(nb)[:b * mb].reshape(b, mb).astype(np.int32))
+    return (x, gw, wq, wk, wv, wo, cos, sin, cache, tables, st, ln, hd)
+
+
+def _unfused(x, gw, wq, wk, wv, wo, cos, sin, cache, tables, st, ln, hd,
+             eps=1e-5):
+    """The pre-megakernel model path: rms_norm → projections →
+    apply_rotary_pos_emb → ragged_paged_attend → o_proj → residual."""
+    b, c, h = x.shape
+    nx = F.rms_norm(x, gw, eps)
+    q = (nx @ wq).reshape(b, c, -1, hd)
+    k = (nx @ wk).reshape(b, c, -1, hd)
+    v = (nx @ wv).reshape(b, c, -1, hd)
+    q, k = F.apply_rotary_pos_emb(q, k, cos, sin)
+    attn, new_cache = IF.ragged_paged_attend(cache, q, k, v, tables,
+                                             st, ln)
+    y = attn.reshape(b, c, -1) @ wo.astype(x.dtype)
+    return x + y.astype(x.dtype), new_cache
+
+
+class TestMegaKernelEquivalence:
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    @pytest.mark.parametrize(
+        "starts,lens",
+        [([13, 0, 5], [1, 8, 0]),      # decode + full chunk + dead slot
+         ([7, 21, 3], [3, 1, 5])])     # odd lens mid-chunk
+    def test_kernel_matches_composition(self, dtype, starts, lens):
+        """GQA, mixed prefill/decode spans, odd lens, both dtypes: the
+        Pallas kernel (interpret mode) against the pinned composition —
+        outputs on live rows, and the pool after the shared span
+        write."""
+        args = _case(dtype, b=3, c=8, h=32, nh=4, nkh=2, hd=16, page=8,
+                     nb=24, mb=6, starts=starts, lens=lens)
+        (x, gw, wq, wk, wv, wo, cos, sin, cache, tables, st, ln,
+         hd) = args
+        b, c = x.shape[:2]
+        out, k_new, v_new = MD.mega_decode(
+            x, gw, wq, wk, wv, wo, cos, sin, cache[0], cache[1],
+            tables, st, ln, hd, interpret=True)
+        ref, (kp2, vp2) = IF._mega_decode_layer_ref(*args, 1e-5, None)
+        live = np.arange(c)[None, :] < np.asarray(ln)[:, None]
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32)[live],
+            np.asarray(ref, np.float32)[live], **_tol(dtype))
+        # pool update through the ONE shared _paged_span_write
+        nkh = k_new.shape[-1] // hd
+        kc, vc = IF._paged_span_write(
+            cache, k_new.reshape(b, c, nkh, hd),
+            v_new.reshape(b, c, nkh, hd), tables, st, ln)
+        np.testing.assert_allclose(np.asarray(kc, np.float32),
+                                   np.asarray(kp2, np.float32),
+                                   **_tol(dtype))
+        np.testing.assert_allclose(np.asarray(vc, np.float32),
+                                   np.asarray(vp2, np.float32),
+                                   **_tol(dtype))
+
+    def test_composition_matches_unfused_path(self):
+        """Semantic pin: the mega entry ≈ the pre-fusion decoder-layer
+        math (norm → proj → rope → ragged attend → o_proj →
+        residual)."""
+        args = _case(jnp.float32, b=3, c=8, h=32, nh=4, nkh=2, hd=16,
+                     page=8, nb=24, mb=6, starts=[13, 0, 5],
+                     lens=[1, 8, 0])
+        c = args[0].shape[1]
+        ln = args[11]
+        got, (kg, vg) = IF.mega_decode_layer(*args)
+        want, (kw, vw) = _unfused(*args)
+        live = np.arange(c)[None, :] < np.asarray(ln)[:, None]
+        np.testing.assert_allclose(np.asarray(got)[live],
+                                   np.asarray(want)[live],
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(kg), np.asarray(kw),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(vg), np.asarray(vw),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_int8_kv_pool_through_composition(self):
+        """int8 4-tuple pools take the gather+dequant attention inside
+        ragged_paged_attend on every backend (the kernel is fp-only):
+        the mega entry must route them bitwise-identically to the
+        composition, and land within quantization tolerance of the fp
+        path."""
+        kw = dict(b=2, c=8, h=32, nh=4, nkh=2, hd=16, page=8, nb=24,
+                  mb=6, starts=[9, 2], lens=[1, 6])
+        args_q = _case(jnp.float32, int8=True, **kw)
+        got, cache_q = IF.mega_decode_layer(*args_q)
+        ref, cache_r = IF._mega_decode_layer_ref(*args_q, 1e-5, None)
+        assert len(cache_q) == 4
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+        for a, b_ in zip(cache_q, cache_r):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+
+    def test_dead_slots_are_inert(self):
+        """All-dead batch (len 0, OOB block tables — the engine's idle
+        sentinel): the pool stays bitwise-untouched and outputs are
+        finite garbage, both for the composition and the kernel."""
+        args = _case(jnp.float32, b=2, c=8, h=32, nh=4, nkh=2, hd=16,
+                     page=8, nb=24, mb=6, starts=[0, 0], lens=[0, 0])
+        (x, gw, wq, wk, wv, wo, cos, sin, cache, _t, st, ln, hd) = args
+        nb = cache[0].shape[0]
+        oob = jnp.full_like(_t, nb)
+        out, (kc, vc) = IF.mega_decode_layer(
+            x, gw, wq, wk, wv, wo, cos, sin, cache, oob, st, ln, hd)
+        assert np.all(np.isfinite(np.asarray(out, np.float32)))
+        np.testing.assert_array_equal(np.asarray(kc),
+                                      np.asarray(cache[0]))
+        np.testing.assert_array_equal(np.asarray(vc),
+                                      np.asarray(cache[1]))
+        k_out, k_new, v_new = MD.mega_decode(
+            x, gw, wq, wk, wv, wo, cos, sin, cache[0], cache[1], oob,
+            st, ln, hd, interpret=True)
+        assert np.all(np.isfinite(np.asarray(k_out, np.float32)))
+        b, c = x.shape[:2]
+        nkh = k_new.shape[-1] // hd
+        kc2, vc2 = IF._paged_span_write(
+            cache, k_new.reshape(b, c, nkh, hd),
+            v_new.reshape(b, c, nkh, hd), oob, st, ln)
+        np.testing.assert_array_equal(np.asarray(kc2),
+                                      np.asarray(cache[0]))
+
+    def test_supported_decline_falls_back_bitwise(self):
+        """Where supported() declines (everywhere on CPU — backend gate)
+        the entry point and the raw composition are the same code path:
+        outputs bitwise identical."""
+        args = _case(jnp.float32, b=2, c=8, h=32, nh=4, nkh=2, hd=16,
+                     page=8, nb=24, mb=6, starts=[9, 2], lens=[1, 6])
+        assert not MD.supported(args[0], args[2], args[3], args[5],
+                                args[12], cache=args[8])
+        got, (kg, vg) = IF.mega_decode_layer(*args)
+        ref, (kr, vr) = IF._mega_decode_layer_ref(*args, 1e-5, None)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+        np.testing.assert_array_equal(np.asarray(kg), np.asarray(kr))
+        np.testing.assert_array_equal(np.asarray(vg), np.asarray(vr))
+
+
+class TestSupportedGate:
+    def test_shape_and_dtype_gates(self):
+        x = jnp.zeros((2, 8, 256), jnp.float32)
+        wq = jnp.zeros((256, 512), jnp.float32)
+        wk = jnp.zeros((256, 256), jnp.float32)
+        wo = jnp.zeros((512, 256), jnp.float32)
+        pool = jnp.zeros((8, 16, 2, 128), jnp.float32)
+        ok = lambda **kw: MD.supported(
+            kw.pop("x", x), kw.pop("wq", wq), kw.pop("wk", wk),
+            kw.pop("wo", wo), kw.pop("hd", 128),
+            cache=kw.pop("cache", (pool, pool)))
+        # every shape gate passes except the TPU backend requirement
+        import jax as _jax
+        expected = _jax.default_backend() == "tpu"
+        assert ok() is expected
+        # misaligned head_dim / widths
+        assert ok(hd=64) is False
+        assert ok(wq=jnp.zeros((256, 320), jnp.float32)) is False
+        # fp16 / int8 activations decline
+        assert ok(x=x.astype(jnp.float16)) is False
+        # int8 4-tuple pool → composition
+        s = jnp.zeros((8, 16, 2), jnp.float32)
+        assert ok(cache=(pool.astype(jnp.int8), pool.astype(jnp.int8),
+                         s, s)) is False
+        # pool dtype must match activations (span scratch rounds like
+        # the pool write)
+        assert ok(cache=(pool.astype(jnp.bfloat16),
+                         pool.astype(jnp.bfloat16))) is False
+        # page-size rule shared with the ragged kernel
+        bad = jnp.zeros((8, 32, 2, 128), jnp.float32)
+        assert ok(cache=(bad, bad)) is False
+        # span rows must be sublane-aligned
+        assert ok(x=jnp.zeros((2, 7, 256), jnp.float32)) is False
+
+    def test_vmem_budget_gate(self):
+        # 7B-class geometry blows the resident-weight budget
+        x = jnp.zeros((1, 8, 4096), jnp.bfloat16)
+        w = jnp.zeros((4096, 4096), jnp.bfloat16)
+        pool = jnp.zeros((8, 16, 32, 128), jnp.bfloat16)
+        assert MD.supported(x, w, w, w, 128, cache=(pool, pool)) is False
+
+
+class TestPolicyWiring:
+    def test_fusion_enabled_mega_mode(self):
+        # "mega" ⊇ "on": every fused entry point engages
+        assert tuning.fusion_enabled("mega", "fused_swiglu_mlp") is True
+        assert tuning.fusion_enabled("mega", "mega_decode_layer") is True
+        with pytest.raises(ValueError):
+            tuning.fusion_enabled("maybe", "mega_decode_layer")
+
+    def test_mega_dense_forward_matches_on(self):
+        """Outside the ragged serving step (dense generate()/training
+        paths) "mega" behaves exactly like "on" — the megakernel only
+        exists on the span branch."""
+        from paddle_tpu.models.llama import llama
+        ids = jnp.asarray(R.integers(0, 256, size=(2, 13)))
+        outs = {}
+        for mode in ("on", "mega"):
+            pt.seed(0)
+            outs[mode] = np.asarray(llama("tiny", fused_ops=mode)(ids))
+        np.testing.assert_array_equal(outs["on"], outs["mega"])
+
+    def test_auto_mega_stays_off_cpu(self):
+        """auto on CPU: the mega dispatch is TPU-only, so the span
+        branch keeps today's path (0 behavior change)."""
+        assert tuning.fusion_enabled(
+            "auto", "mega_decode_layer") is False
+
+    def test_tuned_veto_honored_under_auto(self, tmp_path, monkeypatch):
+        import json
+        key = tuning.geom_key(h=64, nq=64, nk=32, hd=16)
+        path = tmp_path / "tuned.json"
+        path.write_text(json.dumps(
+            {"cpu": {"mega_decode_layer": {key: {"enabled": False}}}}))
+        monkeypatch.setenv("PDTPU_TUNED_CONFIGS", str(path))
+        tuning.reload()
+        try:
+            # even if the dispatch were live, the veto gates auto off;
+            # on CPU the dispatch gate already returns False — this
+            # pins the lookup path end-to-end
+            assert tuning.fusion_enabled(
+                "auto", "mega_decode_layer", key) is False
+        finally:
+            monkeypatch.delenv("PDTPU_TUNED_CONFIGS")
+            tuning.reload()
+
+
+class TestEngineWiring:
+    def test_mega_engine_token_identity_and_dispatch_drop(self):
+        """A fused_ops="mega" engine on CPU (composition path) decodes
+        token-identically to model.generate(), and the traced step
+        program is structurally smaller — dispatches_per_step asserted
+        lower with mega on vs off."""
+        from paddle_tpu import serving
+        from paddle_tpu.models.llama import llama
+        pt.seed(0)
+        model = llama("tiny", fused_ops="mega")
+        eng = serving.Engine(model, max_batch=2, max_seq_len=48,
+                             page_size=8, prefill_chunk=8).warmup()
+        prompt = R.integers(0, 256, size=11).astype(np.int32)
+        rid = eng.add_request(prompt, max_new_tokens=5)
+        outs = eng.run()
+        ref = np.asarray(model.generate(
+            jnp.asarray(prompt)[None], max_new_tokens=5,
+            temperature=0.0))[0, len(prompt):]
+        assert list(outs[rid]) == list(ref)
+        assert eng.kv_blocks_used == 0
+        pt.seed(0)
+        eng_off = serving.Engine(llama("tiny", fused_ops="off"),
+                                 max_batch=2, max_seq_len=48,
+                                 page_size=8, prefill_chunk=8)
+        # dispatches_per_step is a pure abstract trace — no warmup, no
+        # compile, no sentinel interaction
+        assert eng.dispatches_per_step() < eng_off.dispatches_per_step()

@@ -124,7 +124,13 @@ def suite():
 
 # fused-op rows come in (fused_X, unfused_X) pairs; the ratio per op is
 # printed as `fused_speedups` and tracked by tests/test_fused_kernels.py
-FUSED_PAIRS = ("rms_rope_qkv", "swiglu_mlp", "int8_gemv", "adamw")
+FUSED_PAIRS = ("rms_rope_qkv", "swiglu_mlp", "int8_gemv", "adamw",
+               "mega_decode")
+
+# set by _fused_ops(): top-level jaxpr equation counts of the two
+# mega_decode legs — the dispatch-count half of the megakernel's A/B
+# (the ms rows above are the timing half).  Printed with the results.
+MEGA_DISPATCHES = None
 
 
 def _fused_ops():
@@ -339,6 +345,71 @@ def _fused_ops():
         v2 = v_up(v, g)
         return axpy(p, quot(mhat(m2), vhat(v2))), m2, v2
 
+    # -- mega_decode: the whole ragged decoder-layer attention block as
+    # ONE closed dispatch (docs/KERNELS.md "Decode megakernel") vs the
+    # pre-fusion serving path's per-stage dispatches (fused qkv+rope /
+    # ragged paged attention + span pool write / o-proj + residual).
+    # The fused leg goes through the public mega_decode_layer entry —
+    # the Pallas megakernel on TPU, the one-dispatch XLA composition on
+    # CPU (exactly what fused_ops="mega" executes there), so the CPU
+    # row measures the dispatch-boundary cost the fusion deletes, not a
+    # kernel-vs-XLA claim.  MEGA_DISPATCHES records the structural half
+    # of the A/B: top-level jaxpr equations per leg.
+    bm, cm, hdm = 8, 8, 128
+    hm, nqm, nkm = 1024, 1024, 512        # GQA 8q/4kv at MXU-wide heads
+    hkv = nkm // hdm
+    pagem, mbm = 64, 16
+    nbm = bm * mbm
+    gwm = jnp.ones((hm,), dt)
+    wqm = r.normal(r.fold_in(key, 10), (hm, nqm), dt) * 0.05
+    wkm = r.normal(r.fold_in(key, 11), (hm, nkm), dt) * 0.05
+    wvm = r.normal(r.fold_in(key, 12), (hm, nkm), dt) * 0.05
+    wom = r.normal(r.fold_in(key, 13), (nqm, hm), dt) * 0.05
+    xm = r.normal(r.fold_in(key, 14), (bm, cm, hm), dt)
+    kpm = r.normal(r.fold_in(key, 15), (nbm, pagem, hkv, hdm), dt) * 0.5
+    vpm = r.normal(r.fold_in(key, 16), (nbm, pagem, hkv, hdm), dt) * 0.5
+    tbm = r.permutation(r.fold_in(key, 17),
+                        nbm).reshape(bm, mbm).astype(jnp.int32)
+    # mixed decode (len 1, long prefix) + chunked-prefill-tail spans
+    stm = jnp.asarray([1016, 37, 512, 0, 777, 128, 960, 7], jnp.int32)
+    lnm = jnp.asarray([1, cm, 1, cm, 1, 1, cm, 1], jnp.int32)
+    posm = stm[:, None] + jnp.arange(cm)[None, :]
+    invm = 1.0 / (10000.0 ** (jnp.arange(0, hdm, 2, jnp.float32) / hdm))
+    angm = posm[..., None].astype(jnp.float32) * invm
+    cosm = jnp.concatenate([jnp.cos(angm)] * 2, -1).astype(dt)
+    sinm = jnp.concatenate([jnp.sin(angm)] * 2, -1).astype(dt)
+
+    def _mega_one(a, kp, vp):
+        return IF.mega_decode_layer(a, gwm, wqm, wkm, wvm, wom, cosm,
+                                    sinm, (kp, vp), tbm, stm, lnm, hdm,
+                                    1e-5)
+
+    mega_fused = jax.jit(_mega_one)
+    qkv_stage = jax.jit(lambda a: IF.fused_rms_rope_qkv(
+        a.reshape(bm * cm, hm), gwm, wqm, wkm, wvm,
+        cosm.reshape(bm * cm, hdm), sinm.reshape(bm * cm, hdm), hdm,
+        1e-5))
+    att_stage = jax.jit(lambda kp, vp, q, k, v: IF.ragged_paged_attend(
+        (kp, vp), q.reshape(bm, cm, nqm // hdm, hdm),
+        k.reshape(bm, cm, hkv, hdm), v.reshape(bm, cm, hkv, hdm),
+        tbm, stm, lnm))
+    oproj_stage = jax.jit(lambda a, attn: a + (
+        attn.reshape(bm * cm, nqm) @ wom.astype(a.dtype)
+    ).astype(a.dtype).reshape(bm, cm, hm))
+
+    def mega_unfused(a, kp, vp):
+        q, k, v = qkv_stage(a)
+        attn, new_cache = att_stage(kp, vp, q, k, v)
+        return oproj_stage(a, attn), new_cache
+
+    global MEGA_DISPATCHES
+    MEGA_DISPATCHES = {
+        "fused": len(jax.make_jaxpr(_mega_one)(xm, kpm, vpm).jaxpr.eqns),
+        "unfused": len(jax.make_jaxpr(mega_unfused)(xm, kpm,
+                                                    vpm).jaxpr.eqns),
+    }
+    mega_iters = {"iters": 100 if on_tpu else 3}
+
     return {
         "fused_rms_rope_qkv": (fused_qkv, (x,), pair_iters),
         "unfused_rms_rope_qkv": (unfused_qkv, (x,), pair_iters),
@@ -348,6 +419,8 @@ def _fused_ops():
         "unfused_int8_gemv": (i8_unfused, (xd,)),
         "fused_adamw": (aw_fused, (p0, g0, m0, v0)),
         "unfused_adamw": (aw_unfused, (p0, g0, m0, v0)),
+        "fused_mega_decode": (mega_fused, (xm, kpm, vpm), mega_iters),
+        "unfused_mega_decode": (mega_unfused, (xm, kpm, vpm), mega_iters),
     }
 
 
@@ -380,8 +453,13 @@ def main():
                           3)
                 for op in FUSED_PAIRS
                 if f"fused_{op}" in results and f"unfused_{op}" in results}
-    print(json.dumps({"backend": backend, "ms": results,
-                      "fused_speedups": speedups}, indent=2))
+    payload = {"backend": backend, "ms": results,
+               "fused_speedups": speedups}
+    if MEGA_DISPATCHES is not None:
+        # structural half of the megakernel A/B: top-level equations of
+        # the one-dispatch layer vs the per-stage composition
+        payload["mega_dispatches"] = MEGA_DISPATCHES
+    print(json.dumps(payload, indent=2))
 
     base = {}
     if os.path.exists(BASE_PATH):
